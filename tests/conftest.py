@@ -1,0 +1,17 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+
+Real-TPU benchmarking happens in bench.py, not in tests; tests must run
+anywhere (including the driver's CPU-only environment) and must exercise
+multi-device sharding, so we ask XLA for 8 virtual CPU devices before JAX
+initialises.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
