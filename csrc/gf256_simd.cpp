@@ -1,0 +1,125 @@
+// GF(2^8) Reed-Solomon host codec with AVX2 PSHUFB nibble tables.
+//
+// This is the host-side equivalent of the reference's SIMD dependency
+// (klauspost/reedsolomon, used from /root/reference/cmd/erasure-coding.go:63):
+// multiplication by a constant c is two 16-entry table lookups (low/high
+// nibble) XORed together; PSHUFB does 32 byte-lookups per instruction.
+// Serves as (a) the CPU fallback codec when no TPU is attached and (b) the
+// same-host AVX2 baseline that bench.py compares the TPU kernels against.
+//
+// Field: polynomial 0x11D, generator 2 — identical to minio_tpu.ops.gf256.
+
+#include <cstdint>
+#include <cstring>
+
+#if defined(__AVX2__)
+#include <immintrin.h>
+#endif
+
+namespace {
+
+uint8_t MUL[256][256];
+uint8_t LOW_TBL[256][16];   // LOW_TBL[c][n]  = c * n
+uint8_t HIGH_TBL[256][16];  // HIGH_TBL[c][n] = c * (n << 4)
+
+struct TableInit {
+  TableInit() {
+    uint8_t exp[512];
+    int log[256] = {0};
+    int x = 1;
+    for (int i = 0; i < 255; i++) {
+      exp[i] = (uint8_t)x;
+      log[x] = i;
+      x <<= 1;
+      if (x & 0x100) x ^= 0x11D;
+    }
+    for (int i = 255; i < 512; i++) exp[i] = exp[i - 255];
+    for (int a = 0; a < 256; a++)
+      for (int b = 0; b < 256; b++)
+        MUL[a][b] = (a && b) ? exp[log[a] + log[b]] : 0;
+    for (int c = 0; c < 256; c++)
+      for (int n = 0; n < 16; n++) {
+        LOW_TBL[c][n] = MUL[c][n];
+        HIGH_TBL[c][n] = MUL[c][n << 4];
+      }
+  }
+} table_init;
+
+inline void mul_acc_scalar(uint8_t c, const uint8_t* src, uint8_t* dst,
+                           size_t n, bool first) {
+  const uint8_t* row = MUL[c];
+  if (first) {
+    for (size_t i = 0; i < n; i++) dst[i] = row[src[i]];
+  } else {
+    for (size_t i = 0; i < n; i++) dst[i] ^= row[src[i]];
+  }
+}
+
+#if defined(__AVX2__)
+// dst ^= c * src (or dst = c * src when first), 32 bytes per step.
+inline void mul_acc_avx2(uint8_t c, const uint8_t* src, uint8_t* dst, size_t n,
+                         bool first) {
+  const __m256i lo_tbl = _mm256_broadcastsi128_si256(
+      _mm_loadu_si128((const __m128i*)LOW_TBL[c]));
+  const __m256i hi_tbl = _mm256_broadcastsi128_si256(
+      _mm_loadu_si128((const __m128i*)HIGH_TBL[c]));
+  const __m256i mask = _mm256_set1_epi8(0x0f);
+  size_t i = 0;
+  for (; i + 32 <= n; i += 32) {
+    __m256i v = _mm256_loadu_si256((const __m256i*)(src + i));
+    __m256i lo = _mm256_and_si256(v, mask);
+    __m256i hi = _mm256_and_si256(_mm256_srli_epi64(v, 4), mask);
+    __m256i prod = _mm256_xor_si256(_mm256_shuffle_epi8(lo_tbl, lo),
+                                    _mm256_shuffle_epi8(hi_tbl, hi));
+    if (!first)
+      prod = _mm256_xor_si256(prod, _mm256_loadu_si256((const __m256i*)(dst + i)));
+    _mm256_storeu_si256((__m256i*)(dst + i), prod);
+  }
+  if (i < n) mul_acc_scalar(c, src + i, dst + i, n - i, first);
+}
+#endif
+
+}  // namespace
+
+extern "C" {
+
+// out[r] = XOR_k mat[r*k + j] * src[j]   (all rows length `n`)
+// mat: rows x k coefficients; src: k contiguous shards of n bytes;
+// out: rows contiguous shards of n bytes.
+void gf256_matmul(const uint8_t* mat, int rows, int k, const uint8_t* src,
+                  uint8_t* out, size_t n) {
+  for (int r = 0; r < rows; r++) {
+    uint8_t* dst = out + (size_t)r * n;
+    bool first = true;
+    for (int j = 0; j < k; j++) {
+      uint8_t c = mat[r * k + j];
+      if (c == 0) continue;
+#if defined(__AVX2__)
+      mul_acc_avx2(c, src + (size_t)j * n, dst, n, first);
+#else
+      mul_acc_scalar(c, src + (size_t)j * n, dst, n, first);
+#endif
+      first = false;
+    }
+    if (first) memset(dst, 0, n);
+  }
+}
+
+// Convenience single multiply: dst = c * src.
+void gf256_mul(uint8_t c, const uint8_t* src, uint8_t* dst, size_t n) {
+#if defined(__AVX2__)
+  mul_acc_avx2(c, src, dst, n, true);
+#else
+  mul_acc_scalar(c, src, dst, n, true);
+#endif
+}
+
+int gf256_has_avx2(void) {
+#if defined(__AVX2__)
+  return 1;
+#else
+  return 0;
+#endif
+}
+
+}  // extern "C"

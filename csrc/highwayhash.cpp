@@ -1,0 +1,206 @@
+// HighwayHash-256: bit-exact host implementation for bitrot checksums.
+//
+// The reference protects every shard block with HighwayHash256S keyed by the
+// pi-derived magic key (/root/reference/cmd/bitrot.go:37,55-59).  This is a
+// clean-room implementation of the public-domain HighwayHash algorithm
+// (portable formulation); correctness is pinned by the reference's bitrot
+// self-test vectors (cmd/bitrot.go:215-220) in tests/test_bitrot.py.
+//
+// Streaming API mirrors Go's hash.Hash: init/update/final, with final
+// operating on a copy so the running state can keep accepting writes.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+struct HHState {
+  uint64_t v0[4];
+  uint64_t v1[4];
+  uint64_t mul0[4];
+  uint64_t mul1[4];
+  uint8_t buf[32];
+  uint32_t buflen;
+};
+
+const uint64_t kInit0[4] = {0xdbe6d5d5fe4cce2full, 0xa4093822299f31d0ull,
+                            0x13198a2e03707344ull, 0x243f6a8885a308d3ull};
+const uint64_t kInit1[4] = {0x3bd39e10cb0ef593ull, 0xc0acf169b5f18a8cull,
+                            0xbe5466cf34e90c6cull, 0x452821e638d01377ull};
+
+inline uint64_t Rot32(uint64_t x) { return (x >> 32) | (x << 32); }
+
+inline uint64_t ReadLE64(const uint8_t* p) {
+  uint64_t v;
+  memcpy(&v, p, 8);
+  return v;  // little-endian hosts only (x86/TPU VMs)
+}
+
+void Reset(HHState* s, const uint64_t key[4]) {
+  for (int i = 0; i < 4; i++) {
+    s->mul0[i] = kInit0[i];
+    s->mul1[i] = kInit1[i];
+    s->v0[i] = s->mul0[i] ^ key[i];
+    s->v1[i] = s->mul1[i] ^ Rot32(key[i]);
+  }
+  s->buflen = 0;
+}
+
+inline void ZipperMergeAndAdd(const uint64_t v1, const uint64_t v0,
+                              uint64_t* add1, uint64_t* add0) {
+  *add0 += (((v0 & 0xff000000ull) | (v1 & 0xff00000000ull)) >> 24) |
+           (((v0 & 0xff0000000000ull) | (v1 & 0xff000000000000ull)) >> 16) |
+           (v0 & 0xff0000ull) | ((v0 & 0xff00ull) << 32) |
+           ((v1 & 0xff00000000000000ull) >> 8) | (v0 << 56);
+  *add1 += (((v1 & 0xff000000ull) | (v0 & 0xff00000000ull)) >> 24) |
+           (v1 & 0xff0000ull) | ((v1 & 0xff0000000000ull) >> 16) |
+           ((v1 & 0xff00ull) << 24) | ((v0 & 0xff000000000000ull) >> 8) |
+           ((v1 & 0xffull) << 48) | (v0 & 0xff00000000000000ull);
+}
+
+void Update(HHState* s, const uint64_t lanes[4]) {
+  for (int i = 0; i < 4; i++) {
+    s->v1[i] += s->mul0[i] + lanes[i];
+    s->mul0[i] ^= (s->v1[i] & 0xffffffff) * (s->v0[i] >> 32);
+    s->v0[i] += s->mul1[i];
+    s->mul1[i] ^= (s->v0[i] & 0xffffffff) * (s->v1[i] >> 32);
+  }
+  ZipperMergeAndAdd(s->v1[1], s->v1[0], &s->v0[1], &s->v0[0]);
+  ZipperMergeAndAdd(s->v1[3], s->v1[2], &s->v0[3], &s->v0[2]);
+  ZipperMergeAndAdd(s->v0[1], s->v0[0], &s->v1[1], &s->v1[0]);
+  ZipperMergeAndAdd(s->v0[3], s->v0[2], &s->v1[3], &s->v1[2]);
+}
+
+inline void UpdatePacket(HHState* s, const uint8_t* packet) {
+  uint64_t lanes[4] = {ReadLE64(packet), ReadLE64(packet + 8),
+                       ReadLE64(packet + 16), ReadLE64(packet + 24)};
+  Update(s, lanes);
+}
+
+void Rotate32By(uint32_t count, uint64_t lanes[4]) {
+  for (int i = 0; i < 4; i++) {
+    uint32_t half0 = (uint32_t)(lanes[i] & 0xffffffff);
+    uint32_t half1 = (uint32_t)(lanes[i] >> 32);
+    uint32_t r0 = count ? ((half0 << count) | (half0 >> (32 - count))) : half0;
+    uint32_t r1 = count ? ((half1 << count) | (half1 >> (32 - count))) : half1;
+    lanes[i] = (uint64_t)r0 | ((uint64_t)r1 << 32);
+  }
+}
+
+void UpdateRemainder(HHState* s, const uint8_t* bytes, size_t size_mod32) {
+  const size_t size_mod4 = size_mod32 & 3;
+  const uint8_t* remainder = bytes + (size_mod32 & ~(size_t)3);
+  uint8_t packet[32] = {0};
+  for (int i = 0; i < 4; i++)
+    s->v0[i] += ((uint64_t)size_mod32 << 32) + size_mod32;
+  Rotate32By((uint32_t)size_mod32, s->v1);
+  for (size_t i = 0; i < (size_t)(remainder - bytes); i++) packet[i] = bytes[i];
+  if (size_mod32 & 16) {
+    for (int i = 0; i < 4; i++) packet[28 + i] = remainder[i + size_mod4 - 4];
+  } else if (size_mod4) {
+    packet[16 + 0] = remainder[0];
+    packet[16 + 1] = remainder[size_mod4 >> 1];
+    packet[16 + 2] = remainder[size_mod4 - 1];
+  }
+  UpdatePacket(s, packet);
+}
+
+void Permute(const uint64_t v[4], uint64_t* permuted) {
+  permuted[0] = Rot32(v[2]);
+  permuted[1] = Rot32(v[3]);
+  permuted[2] = Rot32(v[0]);
+  permuted[3] = Rot32(v[1]);
+}
+
+void ModularReduction(uint64_t a3_unmasked, uint64_t a2, uint64_t a1,
+                      uint64_t a0, uint64_t* m1, uint64_t* m0) {
+  uint64_t a3 = a3_unmasked & 0x3FFFFFFFFFFFFFFFull;
+  *m1 = a1 ^ ((a3 << 1) | (a2 >> 63)) ^ ((a3 << 2) | (a2 >> 62));
+  *m0 = a0 ^ (a2 << 1) ^ (a2 << 2);
+}
+
+void Finalize256(HHState* s, uint64_t hash[4]) {
+  for (int i = 0; i < 10; i++) {
+    uint64_t permuted[4];
+    Permute(s->v0, permuted);
+    Update(s, permuted);
+  }
+  ModularReduction(s->v1[1] + s->mul1[1], s->v1[0] + s->mul1[0],
+                   s->v0[1] + s->mul0[1], s->v0[0] + s->mul0[0], &hash[1],
+                   &hash[0]);
+  ModularReduction(s->v1[3] + s->mul1[3], s->v1[2] + s->mul1[2],
+                   s->v0[3] + s->mul0[3], s->v0[2] + s->mul0[2], &hash[3],
+                   &hash[2]);
+}
+
+}  // namespace
+
+extern "C" {
+
+int hh256_state_size(void) { return (int)sizeof(HHState); }
+
+void hh256_init(void* state, const uint8_t key[32]) {
+  uint64_t k[4] = {ReadLE64(key), ReadLE64(key + 8), ReadLE64(key + 16),
+                   ReadLE64(key + 24)};
+  Reset((HHState*)state, k);
+}
+
+void hh256_update(void* state, const uint8_t* data, size_t len) {
+  HHState* s = (HHState*)state;
+  if (s->buflen) {
+    uint32_t need = 32 - s->buflen;
+    uint32_t take = len < need ? (uint32_t)len : need;
+    memcpy(s->buf + s->buflen, data, take);
+    s->buflen += take;
+    data += take;
+    len -= take;
+    if (s->buflen == 32) {
+      UpdatePacket(s, s->buf);
+      s->buflen = 0;
+    }
+  }
+  while (len >= 32) {
+    UpdatePacket(s, data);
+    data += 32;
+    len -= 32;
+  }
+  if (len) {
+    memcpy(s->buf, data, len);
+    s->buflen = (uint32_t)len;
+  }
+}
+
+// Non-destructive finalize (state copied), matching Go hash.Hash.Sum.
+void hh256_final(const void* state, uint8_t out[32]) {
+  HHState s = *(const HHState*)state;
+  if (s.buflen) UpdateRemainder(&s, s.buf, s.buflen);
+  uint64_t h[4];
+  Finalize256(&s, h);
+  memcpy(out, h, 32);
+}
+
+// One-shot convenience.
+void hh256_sum(const uint8_t key[32], const uint8_t* data, size_t len,
+               uint8_t out[32]) {
+  HHState s;
+  uint64_t k[4] = {ReadLE64(key), ReadLE64(key + 8), ReadLE64(key + 16),
+                   ReadLE64(key + 24)};
+  Reset(&s, k);
+  size_t nfull = len / 32;
+  for (size_t i = 0; i < nfull; i++) UpdatePacket(&s, data + i * 32);
+  if (len % 32) UpdateRemainder(&s, data + nfull * 32, len % 32);
+  uint64_t h[4];
+  Finalize256(&s, h);
+  memcpy(out, h, 32);
+}
+
+// Batched: hash `count` independent streams laid out contiguously
+// (stream i = data[i*stride : i*stride+len]); out 32 bytes each.
+// This is the shard-block bitrot shape: many 128 KiB blocks per call.
+void hh256_batch(const uint8_t* key, const uint8_t* data, size_t count,
+                 size_t len, size_t stride, uint8_t* out) {
+  for (size_t i = 0; i < count; i++)
+    hh256_sum(key, data + i * stride, len, out + i * 32);
+}
+
+}  // extern "C"

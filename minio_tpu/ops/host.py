@@ -1,0 +1,193 @@
+"""ctypes bindings for the C++ host library (csrc/).
+
+Provides:
+- HostRSCodec: AVX2 PSHUFB GF(2^8) codec — CPU fallback and the same-host
+  baseline bench.py compares TPU kernels against (the reference's
+  equivalent is klauspost/reedsolomon's AVX2 assembly).
+- hh256 / HH256: bit-exact HighwayHash-256 for bitrot checksums
+  (reference: minio/highwayhash used at cmd/bitrot.go:55).
+
+The library is built on first use (make -C csrc) if missing; pure-numpy
+fallbacks keep everything functional without a compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from . import gf256
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "csrc")
+_LIBPATH = os.path.join(_CSRC, "libminio_tpu_host.so")
+_lock = threading.Lock()
+_lib = None
+_lib_tried = False
+
+# HighwayHash-256 of the first 100 decimals of pi (reference cmd/bitrot.go:37).
+MAGIC_HH256_KEY = bytes(
+    [0x4B, 0xE7, 0x34, 0xFA, 0x8E, 0x23, 0x8A, 0xCD, 0x26, 0x3E, 0x83, 0xE6,
+     0xBB, 0x96, 0x85, 0x52, 0x04, 0x0F, 0x93, 0x5D, 0xA3, 0x9F, 0x44, 0x14,
+     0x97, 0xE0, 0x9D, 0x13, 0x22, 0xDE, 0x36, 0xA0]
+)
+
+
+def _load():
+    global _lib, _lib_tried
+    with _lock:
+        if _lib is not None or _lib_tried:
+            return _lib
+        _lib_tried = True
+        if not os.path.exists(_LIBPATH):
+            try:
+                subprocess.run(
+                    ["make", "-C", _CSRC, "-s"], check=True, capture_output=True
+                )
+            except Exception:
+                return None
+        try:
+            lib = ctypes.CDLL(_LIBPATH)
+        except OSError:
+            return None
+        lib.gf256_matmul.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+        ]
+        lib.hh256_state_size.restype = ctypes.c_int
+        lib.hh256_init.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.hh256_update.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+        lib.hh256_final.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.hh256_sum.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p
+        ]
+        lib.hh256_batch.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_size_t, ctypes.c_size_t, ctypes.c_char_p,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _as_c(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.c_char_p)
+
+
+class HostRSCodec:
+    """CPU GF(2^8) codec with the TpuRSCodec surface (single block at a time
+    it operates on (K, S); batches loop on host)."""
+
+    def __init__(self, k: int, m: int):
+        self.k, self.m = k, m
+        self._lib = _load()
+
+    def _matmul(self, mat: np.ndarray, src: np.ndarray) -> np.ndarray:
+        rows = mat.shape[0]
+        src = np.ascontiguousarray(src, dtype=np.uint8)
+        n = src.shape[-1]
+        out = np.empty((rows, n), dtype=np.uint8)
+        if self._lib is not None:
+            self._lib.gf256_matmul(
+                _as_c(np.ascontiguousarray(mat)), rows, src.shape[0],
+                _as_c(src), out.ctypes.data_as(ctypes.c_char_p), n,
+            )
+        else:
+            for r in range(rows):
+                acc = np.zeros(n, dtype=np.uint8)
+                for j in range(src.shape[0]):
+                    c = int(mat[r, j])
+                    if c:
+                        acc ^= gf256.MUL_TABLE[c, src[j]]
+                out[r] = acc
+        return out
+
+    def encode(self, data_shards: np.ndarray) -> np.ndarray:
+        """(K, S) -> (M, S) parity (or batched (B, K, S) -> (B, M, S))."""
+        data_shards = np.asarray(data_shards, dtype=np.uint8)
+        if data_shards.ndim == 3:
+            return np.stack([self.encode(b) for b in data_shards])
+        return self._matmul(np.asarray(gf256.parity_matrix(self.k, self.m)), data_shards)
+
+    def reconstruct(self, src_shards, available_idx, wanted) -> np.ndarray:
+        """(K, S) first-K-available -> (len(wanted), S)."""
+        mat = gf256.reconstruct_matrix(
+            self.k, self.m, tuple(available_idx), tuple(wanted)
+        )
+        src = np.asarray(src_shards, dtype=np.uint8)
+        if src.ndim == 3:
+            return np.stack([self._matmul(mat, b) for b in src])
+        return self._matmul(mat, src)
+
+
+class HH256:
+    """Streaming HighwayHash-256 (Go hash.Hash semantics)."""
+
+    SIZE = 32
+    BLOCK_SIZE = 32
+
+    def __init__(self, key: bytes = MAGIC_HH256_KEY):
+        if len(key) != 32:
+            raise ValueError("key must be 32 bytes")
+        self._key = key
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(
+                "host library unavailable; build csrc/ (make -C csrc)"
+            )
+        self._lib = lib
+        self._state = ctypes.create_string_buffer(lib.hh256_state_size())
+        self.reset()
+
+    def reset(self):
+        self._lib.hh256_init(self._state, self._key)
+
+    def update(self, data: bytes | np.ndarray):
+        if isinstance(data, np.ndarray):
+            data = np.ascontiguousarray(data, dtype=np.uint8)
+            self._lib.hh256_update(
+                self._state, data.ctypes.data_as(ctypes.c_char_p), data.nbytes
+            )
+        else:
+            self._lib.hh256_update(self._state, bytes(data), len(data))
+
+    def digest(self) -> bytes:
+        out = ctypes.create_string_buffer(32)
+        self._lib.hh256_final(self._state, out)
+        return out.raw
+
+
+def hh256(data, key: bytes = MAGIC_HH256_KEY) -> bytes:
+    """One-shot HighwayHash-256."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("host library unavailable; build csrc/ (make -C csrc)")
+    out = ctypes.create_string_buffer(32)
+    if isinstance(data, np.ndarray):
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        lib.hh256_sum(key, data.ctypes.data_as(ctypes.c_char_p), data.nbytes, out)
+    else:
+        data = bytes(data)
+        lib.hh256_sum(key, data, len(data), out)
+    return out.raw
+
+
+def hh256_batch(blocks: np.ndarray, key: bytes = MAGIC_HH256_KEY) -> np.ndarray:
+    """Hash N equal-length streams: (N, L) uint8 -> (N, 32) uint8."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("host library unavailable; build csrc/ (make -C csrc)")
+    blocks = np.ascontiguousarray(blocks, dtype=np.uint8)
+    n, l = blocks.shape
+    out = np.empty((n, 32), dtype=np.uint8)
+    lib.hh256_batch(
+        key, blocks.ctypes.data_as(ctypes.c_char_p), n, l, l,
+        out.ctypes.data_as(ctypes.c_char_p),
+    )
+    return out

@@ -13,7 +13,8 @@ table lookups:
     (R*8 x K*8) GF(2) matmul — i.e. an integer matmul followed by mod 2.
 
 So: unpack uint8 shards to 0/1 int8 bits, run one int8 MXU matmul per
-block batch (counts <= K*8 = 128 fit int32 exactly), mask the low bit,
+block batch (popcounts are at most K*8 <= 2040 and accumulate exactly in
+the int32 the MXU produces), mask the low bit,
 and pack back to bytes.  Encode, degraded decode ("first K of N"), and
 heal all reduce to the same kernel with a different (R*8 x K*8) bit
 matrix, which is a tiny host-side numpy computation (gf256.py) passed in
@@ -112,6 +113,7 @@ class TpuRSCodec:
         self.k = k
         self.m = m
         self._enc = jnp.asarray(encode_bits_matrix(k, m))
+        self._rec_cache: dict[tuple, jax.Array] = {}
 
     # -- encode -------------------------------------------------------------
     def encode(self, data_shards) -> jax.Array:
@@ -140,9 +142,11 @@ class TpuRSCodec:
         wanted:     tuple of shard indices to rebuild (data and/or parity).
         returns:    (B, len(wanted), S) uint8.
         """
-        mat = jnp.asarray(
-            reconstruct_bits_matrix(self.k, self.m, tuple(available), tuple(wanted))
-        )
+        sig = (tuple(available), tuple(wanted))
+        mat = self._rec_cache.get(sig)
+        if mat is None:
+            mat = jnp.asarray(reconstruct_bits_matrix(self.k, self.m, *sig))
+            self._rec_cache[sig] = mat
         return gf_bitmatmul(mat, jnp.asarray(src_shards, dtype=jnp.uint8))
 
     def decode_data(self, src_shards, available: tuple[int, ...]) -> jax.Array:

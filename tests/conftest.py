@@ -1,17 +1,23 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh.
 
 Real-TPU benchmarking happens in bench.py, not in tests; tests must run
-anywhere (including the driver's CPU-only environment) and must exercise
-multi-device sharding, so we ask XLA for 8 virtual CPU devices before JAX
-initialises.
+anywhere (including driver environments without the TPU tunnel) and must
+exercise multi-device sharding.  Note: this environment's sitecustomize
+(/root/.axon_site) pins JAX_PLATFORMS=axon, so setdefault is not enough —
+we override explicitly and also set the config flag before first backend
+initialisation.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
