@@ -1,0 +1,120 @@
+"""Streaming bitrot protection: hash-interleaved shard files.
+
+File format matches the reference's streamingBitrotWriter
+(cmd/bitrot-streaming.go:35): every shard-size block is preceded by the
+32-byte HighwayHash-256 of that block, keyed with the magic pi key —
+    [h0 | b0 | h1 | b1 | ... | hN | bN]
+Reads must be shard-size aligned; each block is verified on read
+(cmd/bitrot-streaming.go:142).  Hashing uses the C++ host library
+(bit-exact with minio/highwayhash, pinned by cmd/bitrot.go:215 vectors).
+"""
+
+from __future__ import annotations
+
+from typing import BinaryIO
+
+from minio_tpu.ops import host
+from minio_tpu.storage import errors
+
+HASH_SIZE = 32
+DEFAULT_ALGO = "highwayhash256S"
+
+
+def bitrot_shard_file_size(size: int, shard_size: int) -> int:
+    """On-disk size of a shard file with interleaved hashes
+    (cmd/bitrot.go:146)."""
+    if size == 0:
+        return 0
+    if size < 0:
+        return -1
+    nblocks = -(-size // shard_size)
+    return nblocks * HASH_SIZE + size
+
+
+class BitrotWriter:
+    """Wraps a shard-file handle; every write() must be one erasure block's
+    shard (shard_size bytes, or less for the final block)."""
+
+    def __init__(self, w: BinaryIO, shard_size: int):
+        self.w = w
+        self.shard_size = shard_size
+        self.written = 0
+
+    def write(self, block: bytes | memoryview) -> None:
+        if len(block) > self.shard_size:
+            raise errors.InvalidArgument(
+                f"bitrot write of {len(block)} exceeds shard size {self.shard_size}"
+            )
+        h = host.hh256(bytes(block))
+        self.w.write(h)
+        self.w.write(block)
+        self.written += HASH_SIZE + len(block)
+
+    def close(self) -> None:
+        self.w.close()
+
+
+class BitrotReader:
+    """Verified reader over a hash-interleaved shard file.
+
+    read_at(offset, length): offset/length are in *logical* shard bytes and
+    offset must be shard_size aligned (cmd/bitrot-streaming.go:142-189).
+    """
+
+    def __init__(self, r: BinaryIO, till_offset: int, shard_size: int):
+        self.r = r
+        self.shard_size = shard_size
+        self.till_offset = till_offset  # logical shard bytes available
+        self._pos = -1  # current logical offset (-1: not positioned)
+
+    def read_at(self, offset: int, length: int) -> bytes:
+        if offset % self.shard_size != 0:
+            raise errors.InvalidArgument(
+                f"bitrot read offset {offset} not aligned to {self.shard_size}"
+            )
+        if self._pos != offset:
+            block_idx = offset // self.shard_size
+            file_off = block_idx * (HASH_SIZE + self.shard_size)
+            self.r.seek(file_off)
+            self._pos = offset
+        out = bytearray()
+        remaining = length
+        while remaining > 0:
+            want = min(self.shard_size, remaining)
+            h = self.r.read(HASH_SIZE)
+            if len(h) != HASH_SIZE:
+                raise errors.FileCorrupt("bitrot: truncated hash")
+            block = self.r.read(want)
+            if len(block) != want:
+                raise errors.FileCorrupt("bitrot: truncated block")
+            if host.hh256(block) != h:
+                raise errors.FileCorrupt("bitrot: hash mismatch")
+            out += block
+            self._pos += want
+            remaining -= want
+        return bytes(out)
+
+    def close(self) -> None:
+        self.r.close()
+
+
+def bitrot_verify_stream(f: BinaryIO, file_size: int, shard_file_size: int,
+                         shard_size: int) -> None:
+    """Verify a whole shard file (reference bitrotVerify, cmd/bitrot.go:154)."""
+    want_size = bitrot_shard_file_size(shard_file_size, shard_size)
+    if file_size != want_size:
+        raise errors.FileCorrupt(
+            f"bitrot: file size {file_size} != expected {want_size}"
+        )
+    left = shard_file_size
+    while left > 0:
+        h = f.read(HASH_SIZE)
+        if len(h) != HASH_SIZE:
+            raise errors.FileCorrupt("bitrot: truncated hash")
+        want = min(shard_size, left)
+        block = f.read(want)
+        if len(block) != want:
+            raise errors.FileCorrupt("bitrot: truncated block")
+        if host.hh256(block) != h:
+            raise errors.FileCorrupt("bitrot: hash mismatch")
+        left -= want

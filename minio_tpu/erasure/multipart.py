@@ -1,0 +1,380 @@
+"""Multipart uploads for ErasureObjects.
+
+Reference: cmd/erasure-multipart.go — uploads stage under
+`.minio_tpu.sys/multipart/<sha256(bucket/object)>/<uploadID>/` on every
+drive of the set; each part is EC-encoded with the same engine as
+PutObject; CompleteMultipartUpload validates the client's part list
+against stored part metadata, then commits the staged directory as the
+object's data dir with a single rename per drive (cmd/erasure-multipart.go:771).
+"""
+
+from __future__ import annotations
+
+import binascii
+import hashlib
+import io
+import time
+import uuid
+from dataclasses import dataclass, field
+
+from minio_tpu.storage import errors
+from minio_tpu.storage.local import SYSTEM_VOL
+from minio_tpu.storage.xlmeta import (
+    ChecksumInfo, ErasureInfo, FileInfo, ObjectPartInfo,
+    find_file_info_in_quorum, new_version_id,
+)
+from . import bitrot
+from .coding import BLOCK_SIZE_V2, Erasure
+from .objects import (
+    ErasureObjects, ObjectInfo, PutObjectOptions, _HashingReader,
+)
+
+MULTIPART_DIR = "multipart"
+MIN_PART_SIZE = 5 << 20  # S3 minimum for all but the last part
+
+
+@dataclass
+class PartInfo:
+    part_number: int
+    etag: str
+    size: int
+    mod_time: float = 0.0
+
+
+@dataclass
+class MultipartInfo:
+    bucket: str
+    object: str
+    upload_id: str
+    initiated: float = 0.0
+    metadata: dict = field(default_factory=dict)
+
+
+def _upload_root(bucket: str, obj: str) -> str:
+    h = hashlib.sha256(f"{bucket}/{obj}".encode()).hexdigest()
+    return f"{MULTIPART_DIR}/{h}"
+
+
+def _upload_path(bucket: str, obj: str, upload_id: str) -> str:
+    return f"{_upload_root(bucket, obj)}/{upload_id}"
+
+
+class MultipartMixin:
+    """Mixed into ErasureObjects (see bottom of module)."""
+
+    def new_multipart_upload(self: ErasureObjects, bucket: str, obj: str,
+                             opts: PutObjectOptions | None = None) -> str:
+        opts = opts or PutObjectOptions()
+        # ensure object bucket exists on quorum of drives
+        self._check_bucket(bucket)
+        upload_id = uuid.uuid4().hex
+        upath = _upload_path(bucket, obj, upload_id)
+        _, dist = self._shuffled_disks(obj)
+        n = len(self.disks)
+        parity = self._parity_for(opts)
+        k = n - parity
+        metadata = dict(opts.user_metadata)
+        if opts.content_type:
+            metadata["content-type"] = opts.content_type
+        now = time.time()
+
+        def write(i: int) -> None:
+            d = self.disks[i]
+            if d is None or not d.is_online():
+                raise errors.DiskNotFound(str(i))
+            fi = FileInfo(
+                volume=bucket, name=obj, version_id="", mod_time=now,
+                metadata=metadata,
+                erasure=ErasureInfo(
+                    algorithm="rs-vandermonde", data_blocks=k,
+                    parity_blocks=parity, block_size=BLOCK_SIZE_V2,
+                    index=i + 1, distribution=dist,
+                ),
+            )
+            d.write_metadata(SYSTEM_VOL, upath, fi)
+
+        errs = self._fan_out(write, range(n))
+        wq = k + 1 if k == parity else k
+        if sum(1 for e in errs if e is None) < wq:
+            raise errors.ErasureWriteQuorum("multipart init quorum")
+        return upload_id
+
+    def _check_bucket(self: ErasureObjects, bucket: str) -> None:
+        ok = 0
+        for d in self.disks:
+            if d is None or not d.is_online():
+                continue
+            try:
+                d.stat_volume(bucket)
+                ok += 1
+            except errors.VolumeNotFound:
+                pass
+        if ok < len(self.disks) // 2 + 1:
+            raise errors.BucketNotFound(bucket)
+
+    def _upload_meta(self: ErasureObjects, bucket: str, obj: str,
+                     upload_id: str) -> tuple[FileInfo, list]:
+        upath = _upload_path(bucket, obj, upload_id)
+        fis, errs = self._read_all_fileinfo(SYSTEM_VOL, upath)
+        nf = sum(1 for e in errs if isinstance(e, errors.FileNotFound))
+        if nf > len(self.disks) // 2:
+            raise errors.InvalidArgument(f"upload id {upload_id} not found")
+        read_q, _ = self._quorum_from(fis)
+        fi = find_file_info_in_quorum(fis, read_q)
+        return fi, fis
+
+    def put_object_part(self: ErasureObjects, bucket: str, obj: str,
+                        upload_id: str, part_number: int, reader,
+                        size: int = -1) -> PartInfo:
+        if part_number < 1 or part_number > 10000:
+            raise errors.InvalidArgument(f"part number {part_number}")
+        ufi, _ = self._upload_meta(bucket, obj, upload_id)
+        e = Erasure(ufi.erasure.data_blocks, ufi.erasure.parity_blocks,
+                    ufi.erasure.block_size)
+        n = e.k + e.m
+        wq = e.k + 1 if e.k == e.m else e.k
+        upath = _upload_path(bucket, obj, upload_id)
+        dist = ufi.erasure.distribution
+        # shard-order drives per upload distribution
+        disks_by_index = [None] * n
+        for disk_idx, pos in enumerate(dist):
+            if disk_idx < len(self.disks):
+                d = self.disks[disk_idx]
+                disks_by_index[pos - 1] = d if d is not None and d.is_online() else None
+
+        hreader = _HashingReader(reader, size)
+        tmp = f"tmp/{uuid.uuid4()}"
+
+        def cleanup_tmp() -> None:
+            for d in disks_by_index:
+                if d is not None:
+                    try:
+                        d.delete(SYSTEM_VOL, tmp, recursive=True)
+                    except errors.StorageError:
+                        pass
+
+        writers = []
+        for i in range(n):
+            d = disks_by_index[i]
+            if d is None:
+                writers.append(None)
+                continue
+            fh = d.open_file_writer(SYSTEM_VOL, f"{tmp}/part.{part_number}")
+            writers.append(bitrot.BitrotWriter(fh, e.shard_size))
+        try:
+            total, failed_shards = e.encode_stream(hreader, writers, size, wq)
+        except Exception:
+            for w in writers:
+                if w is not None:
+                    try:
+                        w.close()
+                    except Exception:
+                        pass
+            cleanup_tmp()
+            raise
+        for w in writers:
+            if w is not None:
+                try:
+                    w.close()
+                except Exception:
+                    pass
+        if size >= 0 and total != size:
+            cleanup_tmp()
+            raise errors.InvalidArgument(f"short read {total} != {size}")
+
+        etag = hreader.etag
+        now = time.time()
+
+        def commit(i_pos: int) -> None:
+            d = disks_by_index[i_pos]
+            if d is None or writers[i_pos] is None or i_pos in failed_shards:
+                raise errors.DiskNotFound(str(i_pos))
+            d.rename_file(SYSTEM_VOL, f"{tmp}/part.{part_number}",
+                          SYSTEM_VOL, f"{upath}/part.{part_number}")
+            # per-part metadata sidecar
+            import msgpack
+
+            d.write_all(
+                SYSTEM_VOL, f"{upath}/part.{part_number}.meta",
+                msgpack.packb({"n": part_number, "s": total, "e": etag,
+                               "mt": now}),
+            )
+
+        errs = [None] * n
+        for i in range(n):
+            try:
+                commit(i)
+            except Exception as ex:
+                errs[i] = ex
+        cleanup_tmp()  # leftover staging dirs (commit moves the part files)
+        if sum(1 for x in errs if x is None) < wq:
+            raise errors.ErasureWriteQuorum("part commit quorum")
+        return PartInfo(part_number, etag, total, now)
+
+    def list_object_parts(self: ErasureObjects, bucket: str, obj: str,
+                          upload_id: str) -> list[PartInfo]:
+        import msgpack
+
+        self._upload_meta(bucket, obj, upload_id)
+        upath = _upload_path(bucket, obj, upload_id)
+        parts: dict[int, PartInfo] = {}
+        for d in self.disks:
+            if d is None or not d.is_online():
+                continue
+            try:
+                names = d.list_dir(SYSTEM_VOL, upath)
+            except Exception:
+                continue
+            for nm in names:
+                if nm.endswith(".meta") and nm.startswith("part."):
+                    try:
+                        doc = msgpack.unpackb(d.read_all(SYSTEM_VOL, f"{upath}/{nm}"))
+                        parts.setdefault(
+                            doc["n"],
+                            PartInfo(doc["n"], doc["e"], doc["s"], doc["mt"]),
+                        )
+                    except Exception:
+                        continue
+        return [parts[k] for k in sorted(parts)]
+
+    def list_multipart_uploads(self: ErasureObjects, bucket: str,
+                               obj: str) -> list[MultipartInfo]:
+        root = _upload_root(bucket, obj)
+        ids: set[str] = set()
+        for d in self.disks:
+            if d is None or not d.is_online():
+                continue
+            try:
+                for nm in d.list_dir(SYSTEM_VOL, root):
+                    ids.add(nm.rstrip("/"))
+            except Exception:
+                continue
+        return [MultipartInfo(bucket, obj, i) for i in sorted(ids)]
+
+    def abort_multipart_upload(self: ErasureObjects, bucket: str, obj: str,
+                               upload_id: str) -> None:
+        self._upload_meta(bucket, obj, upload_id)
+        upath = _upload_path(bucket, obj, upload_id)
+
+        def rm(i: int) -> None:
+            d = self.disks[i]
+            if d is not None and d.is_online():
+                try:
+                    d.delete(SYSTEM_VOL, upath, recursive=True)
+                except errors.FileNotFound:
+                    pass
+
+        self._fan_out(rm, range(len(self.disks)))
+
+    def complete_multipart_upload(self: ErasureObjects, bucket: str, obj: str,
+                                  upload_id: str,
+                                  parts: list[tuple[int, str]]) -> ObjectInfo:
+        """parts: [(part_number, etag), ...] in client order."""
+        ufi, _ = self._upload_meta(bucket, obj, upload_id)
+        stored = {p.part_number: p for p in
+                  self.list_object_parts(bucket, obj, upload_id)}
+        if not parts:
+            raise errors.InvalidArgument("no parts")
+        prev = 0
+        total = 0
+        chosen: list[PartInfo] = []
+        md5cat = b""
+        for idx, (num, etag) in enumerate(parts):
+            if num <= prev:
+                raise errors.InvalidArgument("parts out of order")
+            prev = num
+            sp = stored.get(num)
+            if sp is None or sp.etag.strip('"') != etag.strip('"'):
+                raise errors.InvalidArgument(f"part {num} invalid or missing")
+            if idx != len(parts) - 1 and sp.size < MIN_PART_SIZE:
+                raise EntityTooSmall(f"part {num} is {sp.size} bytes")
+            chosen.append(sp)
+            total += sp.size
+            md5cat += binascii.unhexlify(sp.etag.strip('"'))
+        final_etag = hashlib.md5(md5cat).hexdigest() + f"-{len(parts)}"
+
+        e = Erasure(ufi.erasure.data_blocks, ufi.erasure.parity_blocks,
+                    ufi.erasure.block_size)
+        n = e.k + e.m
+        wq = e.k + 1 if e.k == e.m else e.k
+        dist = ufi.erasure.distribution
+        upath = _upload_path(bucket, obj, upload_id)
+        from minio_tpu.storage.xlmeta import new_data_dir
+
+        data_dir = new_data_dir()
+        now = time.time()
+        metadata = dict(ufi.metadata)
+        metadata["etag"] = final_etag
+        version_id = ""
+
+        part_infos = [
+            ObjectPartInfo(p.part_number, p.size, p.size, p.mod_time, p.etag)
+            for p in chosen
+        ]
+
+        disks_by_index = [None] * n
+        for disk_idx, pos in enumerate(dist):
+            if disk_idx < len(self.disks):
+                d = self.disks[disk_idx]
+                disks_by_index[pos - 1] = d if d is not None and d.is_online() else None
+
+        def commit(i_pos: int) -> None:
+            d = disks_by_index[i_pos]
+            if d is None:
+                raise errors.DiskNotFound(str(i_pos))
+            # drop sidecars & unreferenced parts, keep chosen part files
+            try:
+                names = d.list_dir(SYSTEM_VOL, upath)
+            except Exception:
+                names = []
+            keep = {f"part.{p.part_number}" for p in chosen}
+            for nm in names:
+                nm = nm.rstrip("/")
+                if nm == "xl.meta" or nm.endswith(".meta") or nm not in keep:
+                    try:
+                        d.delete(SYSTEM_VOL, f"{upath}/{nm}", recursive=True)
+                    except errors.FileNotFound:
+                        pass
+            fi = FileInfo(
+                volume=bucket, name=obj, version_id=version_id,
+                data_dir=data_dir, mod_time=now, size=total,
+                metadata=metadata, parts=part_infos,
+                erasure=ErasureInfo(
+                    algorithm="rs-vandermonde", data_blocks=e.k,
+                    parity_blocks=e.m, block_size=ufi.erasure.block_size,
+                    index=i_pos + 1, distribution=dist,
+                    checksums=[
+                        ChecksumInfo(p.part_number, bitrot.DEFAULT_ALGO, b"")
+                        for p in chosen
+                    ],
+                ),
+            )
+            d.rename_data(SYSTEM_VOL, upath, fi, bucket, obj)
+
+        with self.ns.write(f"{bucket}/{obj}"):
+            errs = [None] * n
+            for i in range(n):
+                try:
+                    commit(i)
+                except Exception as ex:
+                    errs[i] = ex
+        if sum(1 for x in errs if x is None) < wq:
+            raise errors.ErasureWriteQuorum("complete multipart quorum")
+
+        fi = FileInfo(volume=bucket, name=obj, version_id=version_id,
+                      mod_time=now, size=total, metadata=metadata,
+                      parts=part_infos)
+        return ObjectInfo.from_file_info(fi, bucket, obj)
+
+
+class EntityTooSmall(errors.InvalidArgument):
+    pass
+
+
+# Bind multipart capabilities onto ErasureObjects.
+for _name in (
+    "new_multipart_upload", "_check_bucket", "_upload_meta",
+    "put_object_part", "list_object_parts", "list_multipart_uploads",
+    "abort_multipart_upload", "complete_multipart_upload",
+):
+    setattr(ErasureObjects, _name, getattr(MultipartMixin, _name))

@@ -1,0 +1,845 @@
+"""One erasure set: quorum object operations over K+M drives.
+
+Equivalent of the reference's erasureObjects (cmd/erasure.go:43,
+cmd/erasure-object.go): PutObject encodes into per-drive bitrot shard
+files staged in tmp and committed with renameData; GetObject elects a
+metadata quorum, streams a degraded-tolerant decode, and triggers heal on
+missing/corrupt shards; deletes are version-aware with delete markers;
+small objects inline their shards into xl.meta (cmd/xl-storage.go:59).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import hashlib
+import io
+import os
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import BinaryIO, Callable, Iterator, Sequence
+
+import numpy as np
+
+from minio_tpu.ops import host as hostops
+from minio_tpu.storage import errors
+from minio_tpu.storage.api import StorageAPI
+from minio_tpu.storage.local import SYSTEM_VOL, TMP_DIR
+from minio_tpu.storage.xlmeta import (
+    ChecksumInfo, ErasureInfo, FileInfo, ObjectPartInfo,
+    find_file_info_in_quorum, new_data_dir, new_version_id,
+)
+from minio_tpu.utils.hashing import hash_order
+from . import bitrot
+from .coding import BLOCK_SIZE_V2, Erasure, _io_pool
+
+SMALL_FILE_THRESHOLD = 128 << 10  # inline shards into xl.meta below this
+MULTIPART_VOL = SYSTEM_VOL
+MULTIPART_DIR = "multipart"
+
+
+@dataclass
+class ObjectInfo:
+    bucket: str
+    name: str
+    version_id: str = ""
+    is_latest: bool = True
+    delete_marker: bool = False
+    size: int = 0
+    mod_time: float = 0.0
+    etag: str = ""
+    content_type: str = ""
+    metadata: dict = field(default_factory=dict)
+    parts: list = field(default_factory=list)
+
+    @classmethod
+    def from_file_info(cls, fi: FileInfo, bucket: str, name: str,
+                       versioned: bool = False) -> "ObjectInfo":
+        meta = dict(fi.metadata)
+        return cls(
+            bucket=bucket, name=name,
+            version_id=fi.version_id if versioned or fi.version_id else "",
+            is_latest=fi.is_latest, delete_marker=fi.deleted, size=fi.size,
+            mod_time=fi.mod_time, etag=meta.pop("etag", ""),
+            content_type=meta.pop("content-type", ""),
+            metadata=meta, parts=list(fi.parts),
+        )
+
+
+@dataclass
+class PutObjectOptions:
+    user_metadata: dict = field(default_factory=dict)
+    content_type: str = ""
+    versioned: bool = False
+    version_id: str = ""
+    storage_class: str = ""  # "STANDARD" | "REDUCED_REDUNDANCY"
+
+
+@dataclass
+class HealResult:
+    object_size: int = 0
+    drives_before: list = field(default_factory=list)
+    drives_after: list = field(default_factory=list)
+    healed_drives: int = 0
+    failed: bool = False
+
+
+class NamespaceLock:
+    """Per-object RW locks (reference nsLockMap, cmd/namespace-lock.go:86)."""
+
+    def __init__(self):
+        self._locks: dict[str, "_RWLock"] = {}
+        self._mu = threading.Lock()
+
+    def _get(self, key: str) -> "_RWLock":
+        with self._mu:
+            lk = self._locks.get(key)
+            if lk is None:
+                lk = _RWLock()
+                self._locks[key] = lk
+            lk.refs += 1
+            return lk
+
+    def _put(self, key: str, lk: "_RWLock") -> None:
+        with self._mu:
+            lk.refs -= 1
+            if lk.refs == 0 and not lk.readers and not lk.writer:
+                self._locks.pop(key, None)
+
+    def write(self, key: str):
+        return _LockCtx(self, key, write=True)
+
+    def read(self, key: str):
+        return _LockCtx(self, key, write=False)
+
+
+class _RWLock:
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.readers = 0
+        self.writer = False
+        self.refs = 0
+
+    def acquire_read(self):
+        with self.cond:
+            while self.writer:
+                self.cond.wait()
+            self.readers += 1
+
+    def release_read(self):
+        with self.cond:
+            self.readers -= 1
+            self.cond.notify_all()
+
+    def acquire_write(self):
+        with self.cond:
+            while self.writer or self.readers:
+                self.cond.wait()
+            self.writer = True
+
+    def release_write(self):
+        with self.cond:
+            self.writer = False
+            self.cond.notify_all()
+
+
+class _LockCtx:
+    def __init__(self, ns: NamespaceLock, key: str, write: bool):
+        self.ns, self.key, self.write = ns, key, write
+
+    def __enter__(self):
+        self.lk = self.ns._get(self.key)
+        if self.write:
+            self.lk.acquire_write()
+        else:
+            self.lk.acquire_read()
+        return self
+
+    def __exit__(self, *exc):
+        if self.write:
+            self.lk.release_write()
+        else:
+            self.lk.release_read()
+        self.ns._put(self.key, self.lk)
+        return False
+
+
+def _etag_of(data_hash: "hashlib._Hash") -> str:
+    return data_hash.hexdigest()
+
+
+class _HashingReader(io.RawIOBase):
+    """Single-pass MD5 + size counter (reference internal/hash.Reader)."""
+
+    def __init__(self, r: BinaryIO, expected_size: int = -1):
+        self.r = r
+        self.md5 = hashlib.md5()
+        self.count = 0
+        self.expected = expected_size
+
+    def read(self, n: int = -1) -> bytes:
+        data = self.r.read(n)
+        if data:
+            self.md5.update(data)
+            self.count += len(data)
+        return data
+
+    @property
+    def etag(self) -> str:
+        return self.md5.hexdigest()
+
+
+class ErasureObjects:
+    """One erasure set over `disks` (K+M drives)."""
+
+    def __init__(self, disks: Sequence[StorageAPI],
+                 default_parity: int | None = None,
+                 set_index: int = 0, pool_index: int = 0,
+                 ns_lock: NamespaceLock | None = None,
+                 heal_queue: Callable[[str, str, str], None] | None = None):
+        self.disks = list(disks)
+        n = len(self.disks)
+        if default_parity is None:
+            default_parity = default_parity_count(n)
+        self.default_parity = default_parity
+        self.set_index = set_index
+        self.pool_index = pool_index
+        self.ns = ns_lock or NamespaceLock()
+        self.heal_queue = heal_queue  # async heal trigger (MRF analogue)
+
+    # ------------------------------------------------------------------ util
+    @property
+    def set_drive_count(self) -> int:
+        return len(self.disks)
+
+    def _online_disks(self) -> list[StorageAPI | None]:
+        return [d if d is not None and d.is_online() else None for d in self.disks]
+
+    def _shuffled_disks(self, obj: str) -> list[StorageAPI | None]:
+        """Order drives by the object's hashOrder distribution
+        (shuffleDisksAndPartsMetadata, cmd/erasure-metadata-utils.go:212)."""
+        dist = hash_order(obj, len(self.disks))
+        disks = self._online_disks()
+        out: list[StorageAPI | None] = [None] * len(disks)
+        for idx, pos in enumerate(dist):
+            out[pos - 1] = disks[idx]
+        return out, dist
+
+    def _parity_for(self, opts: PutObjectOptions) -> int:
+        if opts.storage_class == "REDUCED_REDUNDANCY":
+            return max(1, self.default_parity - 2) if self.default_parity > 2 else self.default_parity
+        return self.default_parity
+
+    # -------------------------------------------------------------- metadata
+    def _read_all_fileinfo(self, bucket: str, obj: str, version_id: str = "",
+                           read_data: bool = False
+                           ) -> tuple[list[FileInfo | None], list[Exception | None]]:
+        disks = self.disks
+        fis: list[FileInfo | None] = [None] * len(disks)
+        errs: list[Exception | None] = [None] * len(disks)
+
+        def read(i: int):
+            d = disks[i]
+            if d is None or not d.is_online():
+                raise errors.DiskNotFound(str(i))
+            return d.read_version(bucket, obj, version_id, read_data)
+
+        futs = {i: _io_pool().submit(read, i) for i in range(len(disks))}
+        for i, f in futs.items():
+            try:
+                fis[i] = f.result()
+            except Exception as e:
+                errs[i] = e
+        return fis, errs
+
+    def _quorum_info(self, bucket, obj, version_id="", read_data=False):
+        fis, errs = self._read_all_fileinfo(bucket, obj, version_id, read_data)
+        not_found = sum(1 for e in errs if isinstance(e, errors.FileNotFound))
+        ver_not_found = sum(
+            1 for e in errs if isinstance(e, errors.FileVersionNotFound)
+        )
+        n = len(self.disks)
+        if not_found > n // 2:
+            raise errors.ObjectNotFound(f"{bucket}/{obj}")
+        if ver_not_found > n // 2:
+            raise errors.VersionNotFound(f"{bucket}/{obj}@{version_id}")
+        read_quorum, _ = self._quorum_from(fis)
+        fi = find_file_info_in_quorum(fis, read_quorum)
+        return fi, fis, errs
+
+    def _quorum_from(self, fis: list[FileInfo | None]) -> tuple[int, int]:
+        parity = self.default_parity
+        data = len(self.disks) - parity
+        for fi in fis:
+            if fi is not None and fi.erasure is not None:
+                parity = fi.erasure.parity_blocks
+                data = fi.erasure.data_blocks
+                break
+        wq = data + 1 if data == parity else data
+        return data, wq
+
+    # ------------------------------------------------------------------- PUT
+    def put_object(self, bucket: str, obj: str, reader: BinaryIO,
+                   size: int = -1, opts: PutObjectOptions | None = None
+                   ) -> ObjectInfo:
+        opts = opts or PutObjectOptions()
+        disks, dist = self._shuffled_disks(obj)
+        n = len(disks)
+        parity = self._parity_for(opts)
+        offline = sum(1 for d in disks if d is None)
+        # parity upgrade on degraded writes (cmd/erasure-object.go:770-805)
+        if offline > 0 and parity < n // 2:
+            parity = min(n // 2, parity + offline)
+        k = n - parity
+        write_quorum = k + 1 if k == parity else k
+        if n - offline < write_quorum:
+            raise errors.ErasureWriteQuorum(
+                f"{n - offline} online drives < write quorum {write_quorum}"
+            )
+
+        erasure = Erasure(k, parity, BLOCK_SIZE_V2)
+        hreader = _HashingReader(reader, size)
+        version_id = (
+            opts.version_id or (new_version_id() if opts.versioned else "")
+        )
+        data_dir = new_data_dir()
+        tmp_id = str(uuid.uuid4())
+        tmp_prefix = f"{TMP_DIR}/{tmp_id}"
+
+        inline = 0 <= size <= SMALL_FILE_THRESHOLD and \
+            erasure.shard_file_size(size) <= SMALL_FILE_THRESHOLD
+
+        shards_inline: list[bytes | None] = [None] * n
+        failed_shards: set[int] = set()
+
+        if inline:
+            payload = hreader.read(size) if size >= 0 else hreader.read()
+            if len(payload) != size:
+                raise errors.InvalidArgument(
+                    f"short read: {len(payload)} != {size}"
+                )
+            shards = erasure.encode_data(payload)
+            for i in range(n):
+                # streaming-bitrot framing even inline, for uniform verify
+                buf = io.BytesIO()
+                w = bitrot.BitrotWriter(buf, erasure.shard_size)
+                if len(shards[i]):
+                    w.write(shards[i])
+                shards_inline[i] = buf.getvalue()
+            total_size = size
+        else:
+            writers = []
+            for i in range(n):
+                d = disks[i]
+                if d is None:
+                    writers.append(None)
+                    continue
+                fh = d.open_file_writer(SYSTEM_VOL, f"{tmp_prefix}/part.1")
+                writers.append(bitrot.BitrotWriter(fh, erasure.shard_size))
+            try:
+                total_size, failed_shards = erasure.encode_stream(
+                    hreader, writers, size, write_quorum
+                )
+            finally:
+                for w in writers:
+                    if w is not None:
+                        try:
+                            w.close()
+                        except Exception:
+                            pass
+            if size >= 0 and total_size != size:
+                self._cleanup_tmp(tmp_prefix)
+                raise errors.InvalidArgument(
+                    f"short read: {total_size} != {size}"
+                )
+
+        etag = hreader.etag
+        mod_time = time.time()
+        metadata = dict(opts.user_metadata)
+        metadata["etag"] = etag
+        if opts.content_type:
+            metadata["content-type"] = opts.content_type
+
+        part = ObjectPartInfo(1, total_size, total_size, mod_time, etag)
+
+        def commit(i: int) -> None:
+            d = disks[i]
+            if d is None:
+                raise errors.DiskNotFound(str(i))
+            if i in failed_shards:
+                # this drive's shard stream failed mid-write: do not commit
+                # metadata claiming a healthy shard (reference drops failed
+                # onlineDisks before renameData, cmd/erasure-object.go:990)
+                raise errors.DiskNotFound(f"shard write failed on {i}")
+            fi = FileInfo(
+                volume=bucket, name=obj, version_id=version_id,
+                data_dir="" if inline else data_dir, mod_time=mod_time,
+                size=total_size, metadata=metadata, parts=[part],
+                erasure=ErasureInfo(
+                    algorithm="rs-vandermonde", data_blocks=k,
+                    parity_blocks=parity, block_size=BLOCK_SIZE_V2,
+                    index=i + 1, distribution=dist,
+                    checksums=[ChecksumInfo(1, bitrot.DEFAULT_ALGO, b"")],
+                ),
+                data=shards_inline[i] if inline else None,
+            )
+            if inline:
+                d.write_metadata(bucket, obj, fi)
+            else:
+                d.rename_data(SYSTEM_VOL, tmp_prefix, fi, bucket, obj)
+
+        with self.ns.write(f"{bucket}/{obj}"):
+            commit_errs = self._fan_out(commit, range(n))
+        self._cleanup_tmp(tmp_prefix)
+        ok = sum(1 for e in commit_errs if e is None)
+        if ok < write_quorum:
+            raise errors.ErasureWriteQuorum(
+                f"committed on {ok} < quorum {write_quorum}"
+            )
+        # partial-write drives -> async heal (MRF, cmd/erasure-object.go:1006)
+        if self.heal_queue and ok < n:
+            self.heal_queue(bucket, obj, version_id)
+
+        fi = FileInfo(
+            volume=bucket, name=obj, version_id=version_id, mod_time=mod_time,
+            size=total_size, metadata=metadata, parts=[part],
+        )
+        return ObjectInfo.from_file_info(fi, bucket, obj, opts.versioned)
+
+    def _fan_out(self, fn: Callable[[int], None], idxs) -> list[Exception | None]:
+        futs = {i: _io_pool().submit(fn, i) for i in idxs}
+        out: list[Exception | None] = [None] * len(self.disks)
+        for i, f in futs.items():
+            try:
+                f.result()
+            except Exception as e:
+                out[i] = e
+        return out
+
+    def _cleanup_tmp(self, tmp_prefix: str) -> None:
+        def rm(i: int) -> None:
+            d = self.disks[i]
+            if d is not None and d.is_online():
+                try:
+                    d.delete(SYSTEM_VOL, tmp_prefix, recursive=True)
+                except errors.FileNotFound:
+                    pass
+
+        self._fan_out(rm, range(len(self.disks)))
+
+    # ------------------------------------------------------------------- GET
+    def get_object_info(self, bucket: str, obj: str, version_id: str = ""
+                        ) -> ObjectInfo:
+        with self.ns.read(f"{bucket}/{obj}"):
+            fi, _, _ = self._quorum_info(bucket, obj, version_id)
+        if fi.deleted:
+            if not version_id:
+                raise errors.ObjectNotFound(f"{bucket}/{obj}")
+            oi = ObjectInfo.from_file_info(fi, bucket, obj, True)
+            raise MethodNotAllowedDeleteMarker(oi)
+        return ObjectInfo.from_file_info(fi, bucket, obj, bool(version_id))
+
+    def get_object(self, bucket: str, obj: str, offset: int = 0,
+                   length: int = -1, version_id: str = ""
+                   ) -> tuple[ObjectInfo, Iterator[bytes]]:
+        with self.ns.read(f"{bucket}/{obj}"):
+            fi, fis, _ = self._quorum_info(bucket, obj, version_id,
+                                           read_data=True)
+        if fi.deleted:
+            raise errors.ObjectNotFound(f"{bucket}/{obj}")
+        oi = ObjectInfo.from_file_info(fi, bucket, obj, bool(version_id))
+        if length < 0:
+            length = fi.size - offset
+        if offset < 0 or offset + length > fi.size:
+            raise errors.InvalidArgument(
+                f"range [{offset}, {offset + length}) outside size {fi.size}"
+            )
+        return oi, self._stream_object(bucket, obj, fi, fis, offset, length)
+
+    def _stream_object(self, bucket, obj, fi: FileInfo,
+                       fis: list[FileInfo | None], offset: int, length: int
+                       ) -> Iterator[bytes]:
+        if length == 0 or fi.size == 0:
+            return
+        e = Erasure(fi.erasure.data_blocks, fi.erasure.parity_blocks,
+                    fi.erasure.block_size)
+        n = e.k + e.m
+        # order drives by this object's distribution
+        dist = fi.erasure.distribution
+        disks_by_index: list[StorageAPI | None] = [None] * n
+        inline_by_index: list[bytes | None] = [None] * n
+        for disk_idx, pos in enumerate(dist):
+            d = self.disks[disk_idx] if disk_idx < len(self.disks) else None
+            di = fis[disk_idx] if disk_idx < len(fis) else None
+            # trust each drive's own recorded shard index when present
+            shard_pos = pos - 1
+            if di is not None and di.erasure is not None and di.data_dir == fi.data_dir:
+                shard_pos = di.erasure.index - 1
+            if 0 <= shard_pos < n and disks_by_index[shard_pos] is None:
+                disks_by_index[shard_pos] = (
+                    d if d is not None and d.is_online() else None
+                )
+                if di is not None and di.data is not None:
+                    inline_by_index[shard_pos] = di.data
+
+        heal_needed = False
+        # stream every part overlapping [offset, offset+length)
+        part_start = 0
+        remaining = length
+        for part in fi.parts:
+            part_end = part_start + part.size
+            if part_end <= offset or remaining <= 0:
+                part_start = part_end
+                continue
+            local_off = max(offset - part_start, 0)
+            local_len = min(part.size - local_off, remaining)
+
+            till = e.shard_file_size(part.size)
+            readers: list[bitrot.BitrotReader | None] = [None] * n
+            for i in range(n):
+                if inline_by_index[i] is not None:
+                    readers[i] = bitrot.BitrotReader(
+                        io.BytesIO(inline_by_index[i]), till, e.shard_size
+                    )
+                    continue
+                d = disks_by_index[i]
+                if d is None:
+                    heal_needed = True
+                    continue
+                try:
+                    fh = d.read_file_stream(
+                        bucket, f"{obj}/{fi.data_dir}/part.{part.number}",
+                        0, bitrot.bitrot_shard_file_size(till, e.shard_size),
+                    )
+                    readers[i] = bitrot.BitrotReader(fh, till, e.shard_size)
+                except Exception:
+                    heal_needed = True
+                    readers[i] = None
+            sink = _IterSink()
+            worker = threading.Thread(
+                target=self._decode_to_sink,
+                args=(e, sink, readers, local_off, local_len, part.size),
+                daemon=True,
+            )
+            worker.start()
+            try:
+                yield from sink
+            except GeneratorExit:
+                sink.abandon()
+                raise
+            finally:
+                worker.join()
+                for r in readers:
+                    if r is not None:
+                        try:
+                            r.close()
+                        except Exception:
+                            pass
+            if sink.error is not None and not isinstance(sink.error, BrokenPipeError):
+                raise sink.error
+            remaining -= local_len
+            part_start = part_end
+        if heal_needed and self.heal_queue:
+            self.heal_queue(bucket, obj, fi.version_id)
+
+    @staticmethod
+    def _decode_to_sink(e, sink, readers, offset, length, total):
+        try:
+            e.decode_stream(sink, readers, offset, length, total)
+        except Exception as ex:
+            sink.error = ex
+        finally:
+            sink.close()
+
+    # ---------------------------------------------------------------- DELETE
+    def delete_object(self, bucket: str, obj: str, version_id: str = "",
+                      versioned: bool = False) -> ObjectInfo:
+        with self.ns.write(f"{bucket}/{obj}"):
+            if versioned and not version_id:
+                # versioned delete without version: write a delete marker
+                marker = FileInfo(
+                    volume=bucket, name=obj, version_id=new_version_id(),
+                    deleted=True, mod_time=time.time(),
+                )
+
+                def put_marker(i: int) -> None:
+                    d = self.disks[i]
+                    if d is None or not d.is_online():
+                        raise errors.DiskNotFound(str(i))
+                    d.write_metadata(bucket, obj, marker)
+
+                errs = self._fan_out(put_marker, range(len(self.disks)))
+                _, wq = self._quorum_from([None] * len(self.disks))
+                if sum(1 for e2 in errs if e2 is None) < wq:
+                    raise errors.ErasureWriteQuorum("delete marker quorum")
+                oi = ObjectInfo(bucket=bucket, name=obj,
+                                version_id=marker.version_id,
+                                delete_marker=True, mod_time=marker.mod_time)
+                return oi
+
+            fi = FileInfo(volume=bucket, name=obj, version_id=version_id,
+                          deleted=False, mod_time=time.time())
+
+            def del_version(i: int) -> None:
+                d = self.disks[i]
+                if d is None or not d.is_online():
+                    raise errors.DiskNotFound(str(i))
+                d.delete_version(bucket, obj, fi)
+
+            errs = self._fan_out(del_version, range(len(self.disks)))
+            real = [e2 for e2 in errs
+                    if e2 is not None and not isinstance(e2, errors.FileNotFound)]
+            nf = sum(1 for e2 in errs if isinstance(e2, errors.FileNotFound))
+            if nf > len(self.disks) // 2 and not version_id:
+                pass  # idempotent delete of missing object is S3-legal
+            if real and len(real) > len(self.disks) - (len(self.disks) // 2):
+                raise errors.ErasureWriteQuorum("delete quorum not met")
+            return ObjectInfo(bucket=bucket, name=obj, version_id=version_id)
+
+    # ------------------------------------------------------------------ LIST
+    def list_objects(self, bucket: str, prefix: str = "") -> list[str]:
+        """Union of per-drive sorted walks (metacache-lite).
+
+        A drive missing the bucket dir (fresh replacement) must not hide the
+        set's objects; VolumeNotFound only propagates when NO drive has it.
+        """
+        names: set[str] = set()
+        vol_found = False
+        for d in self.disks:
+            if d is None or not d.is_online():
+                continue
+            try:
+                names.update(d.walk_dir(bucket, base=prefix))
+                vol_found = True
+            except errors.VolumeNotFound:
+                continue
+            except Exception:
+                continue
+        if not vol_found:
+            raise errors.VolumeNotFound(bucket)
+        return sorted(names)
+
+    # ------------------------------------------------------------------ HEAL
+    def heal_object(self, bucket: str, obj: str, version_id: str = "",
+                    deep: bool = False) -> HealResult:
+        """Rebuild missing/corrupt shards onto their drives
+        (cmd/erasure-healing.go:257)."""
+        with self.ns.write(f"{bucket}/{obj}"):
+            try:
+                fi, fis, errs = self._quorum_info(bucket, obj, version_id,
+                                                  read_data=True)
+            except (errors.ObjectNotFound, errors.VersionNotFound,
+                    errors.ErasureReadQuorum):
+                # dangling object: not enough shards/metadata survive to
+                # ever reconstruct it (isObjectDangling,
+                # cmd/erasure-healing.go:836)
+                return HealResult(failed=True)
+            if fi.deleted:
+                return HealResult(object_size=0)
+            e = Erasure(fi.erasure.data_blocks, fi.erasure.parity_blocks,
+                        fi.erasure.block_size)
+            n = e.k + e.m
+            dist = fi.erasure.distribution
+            result = HealResult(object_size=fi.size)
+
+            # classify drives (disksWithAllParts analogue)
+            shard_disk: list[StorageAPI | None] = [None] * n
+            shard_meta: list[FileInfo | None] = [None] * n
+            for disk_idx, pos in enumerate(dist):
+                if disk_idx >= len(self.disks):
+                    continue
+                shard_pos = pos - 1
+                di = fis[disk_idx]
+                if di is not None and di.erasure is not None:
+                    shard_pos = di.erasure.index - 1
+                if not (0 <= shard_pos < n):
+                    continue
+                shard_disk[shard_pos] = self.disks[disk_idx]
+                shard_meta[shard_pos] = fis[disk_idx]
+
+            healthy: list[bool] = [False] * n
+            for i in range(n):
+                d, di = shard_disk[i], shard_meta[i]
+                if d is None or not d.is_online() or di is None:
+                    continue
+                if di.data_dir != fi.data_dir or di.mod_time != fi.mod_time:
+                    continue
+                try:
+                    if di.data is not None:
+                        healthy[i] = True
+                    elif deep:
+                        d.verify_file(bucket, obj, di)
+                        healthy[i] = True
+                    else:
+                        d.check_parts(bucket, obj, di)
+                        healthy[i] = True
+                except Exception:
+                    healthy[i] = False
+            result.drives_before = list(healthy)
+
+            stale = [i for i in range(n) if not healthy[i]
+                     and shard_disk[i] is not None and shard_disk[i].is_online()]
+            if not stale:
+                result.drives_after = list(healthy)
+                return result
+            if sum(healthy) < e.k:
+                # dangling object (cmd/erasure-healing.go:836)
+                result.failed = True
+                return result
+
+            inline = fi.data is not None or (
+                fi.size <= SMALL_FILE_THRESHOLD and fi.parts and
+                e.shard_file_size(fi.parts[0].size) <= SMALL_FILE_THRESHOLD
+                and any(m is not None and m.data is not None for m in shard_meta)
+            )
+
+            # stage rebuilt shards of every part, then commit once per drive
+            tmp_ids = {i: str(uuid.uuid4()) for i in stale}
+            inline_sinks: dict[int, io.BytesIO] = {}
+            for part in fi.parts:
+                till = e.shard_file_size(part.size)
+                readers: list[bitrot.BitrotReader | None] = [None] * n
+                for i in range(n):
+                    if not healthy[i]:
+                        continue
+                    di = shard_meta[i]
+                    if di is not None and di.data is not None:
+                        readers[i] = bitrot.BitrotReader(
+                            io.BytesIO(di.data), till, e.shard_size
+                        )
+                    else:
+                        try:
+                            fh = shard_disk[i].read_file_stream(
+                                bucket, f"{obj}/{fi.data_dir}/part.{part.number}",
+                                0, bitrot.bitrot_shard_file_size(till, e.shard_size),
+                            )
+                            readers[i] = bitrot.BitrotReader(fh, till, e.shard_size)
+                        except Exception:
+                            pass
+                if sum(1 for r in readers if r) < e.k:
+                    result.failed = True
+                    return result
+
+                writers: list[bitrot.BitrotWriter | None] = [None] * n
+                for i in stale:
+                    if inline:
+                        sink = inline_sinks.setdefault(i, io.BytesIO())
+                        writers[i] = bitrot.BitrotWriter(sink, e.shard_size)
+                    else:
+                        fh = shard_disk[i].open_file_writer(
+                            SYSTEM_VOL, f"{TMP_DIR}/{tmp_ids[i]}/part.{part.number}"
+                        )
+                        writers[i] = bitrot.BitrotWriter(fh, e.shard_size)
+                try:
+                    e.heal(writers, readers, part.size)
+                finally:
+                    for i in stale:
+                        if writers[i] is not None and not inline:
+                            writers[i].close()
+                    for r in readers:
+                        if r is not None:
+                            try:
+                                r.close()
+                            except Exception:
+                                pass
+
+            for i in stale:
+                d = shard_disk[i]
+                nfi = FileInfo(
+                    volume=bucket, name=obj, version_id=fi.version_id,
+                    data_dir="" if inline else fi.data_dir,
+                    mod_time=fi.mod_time, size=fi.size,
+                    metadata=dict(fi.metadata), parts=list(fi.parts),
+                    erasure=ErasureInfo(
+                        algorithm=fi.erasure.algorithm, data_blocks=e.k,
+                        parity_blocks=e.m, block_size=fi.erasure.block_size,
+                        index=i + 1, distribution=dist,
+                        checksums=[ChecksumInfo(p.number, bitrot.DEFAULT_ALGO, b"")
+                                   for p in fi.parts],
+                    ),
+                    data=inline_sinks[i].getvalue() if inline else None,
+                )
+                try:
+                    if inline:
+                        d.write_metadata(bucket, obj, nfi)
+                    else:
+                        d.rename_data(SYSTEM_VOL, f"{TMP_DIR}/{tmp_ids[i]}",
+                                      nfi, bucket, obj)
+                    healthy[i] = True
+                    result.healed_drives += 1
+                except Exception:
+                    pass
+            result.drives_after = list(healthy)
+            return result
+
+
+class MethodNotAllowedDeleteMarker(errors.MethodNotAllowed):
+    def __init__(self, oi: ObjectInfo):
+        super().__init__(f"{oi.bucket}/{oi.name} is a delete marker")
+        self.object_info = oi
+
+
+class _IterSink:
+    """Writer-side of a bounded byte-chunk pipe (decode thread -> consumer).
+
+    Abandonment-safe: if the consumer drops the generator mid-stream (HTTP
+    client disconnect), abandon() unblocks the producer, whose next write
+    raises BrokenPipeError so the decode thread exits instead of deadlocking
+    on the full queue."""
+
+    def __init__(self, maxsize: int = 8):
+        import queue as q
+
+        self._qmod = q
+        self._q: "q.Queue" = q.Queue(maxsize=maxsize)
+        self.error: Exception | None = None
+        self.abandoned = False
+
+    def write(self, data: bytes) -> int:
+        while True:
+            if self.abandoned:
+                raise BrokenPipeError("consumer abandoned stream")
+            try:
+                self._q.put(data, timeout=0.05)
+                return len(data)
+            except self._qmod.Full:
+                continue
+
+    def abandon(self) -> None:
+        self.abandoned = True
+        while True:  # drain so a blocked put() returns promptly
+            try:
+                self._q.get_nowait()
+            except self._qmod.Empty:
+                return
+
+    def close(self) -> None:
+        while True:
+            if self.abandoned:
+                return
+            try:
+                self._q.put(None, timeout=0.05)
+                return
+            except self._qmod.Full:
+                continue
+
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            yield item
+
+
+def default_parity_count(drive_count: int) -> int:
+    """Reference defaults (cmd/format-erasure.go:873-884)."""
+    if drive_count == 1:
+        return 0
+    if drive_count <= 3:
+        return 1
+    if drive_count <= 5:
+        return 2
+    if drive_count <= 7:
+        return 3
+    return 4

@@ -1,0 +1,402 @@
+"""Erasure sets and server pools: the full ObjectLayer composition.
+
+Reference topology (cmd/erasure-sets.go:53, cmd/erasure-server-pool.go:42):
+pools -> erasure sets (4..16 drives) -> per-set erasureObjects.  Objects
+route to a set by SipHash-2-4 of the name keyed with the deployment id
+(cmd/erasure-sets.go:747); new objects route to the pool with available
+capacity (cmd/erasure-server-pool.go:222); reads probe pools in order.
+Drive membership is pinned by a per-drive `format.json`
+(cmd/format-erasure.go:111) written on first boot.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+
+import uuid
+from dataclasses import dataclass, field
+from typing import Iterator, Sequence
+
+from minio_tpu.storage import errors
+from minio_tpu.storage.api import StorageAPI
+from minio_tpu.storage.local import SYSTEM_VOL
+from minio_tpu.utils.hashing import sip_hash_mod
+from .objects import (
+    ErasureObjects, HealResult, NamespaceLock, ObjectInfo, PutObjectOptions,
+    default_parity_count,
+)
+from . import multipart  # noqa: F401  (binds multipart methods)
+
+FORMAT_FILE = "format.json"
+FORMAT_VERSION = 1
+DIST_ALGO = "SIPMOD+PARITY"  # reference formatErasureVersionV3DistributionAlgoV3
+
+MIN_SET_SIZE = 1
+MAX_SET_SIZE = 16
+
+
+def _format_doc(deployment_id: str, set_layout: list[list[str]],
+                this_disk: str) -> dict:
+    return {
+        "version": FORMAT_VERSION,
+        "format": "erasure-tpu",
+        "id": deployment_id,
+        "erasure": {
+            "version": 3,
+            "this": this_disk,
+            "sets": set_layout,
+            "distributionAlgo": DIST_ALGO,
+        },
+    }
+
+
+def choose_set_layout(n_drives: int, set_size: int | None = None) -> tuple[int, int]:
+    """(set_count, set_drive_count) — largest legal set size dividing the
+    drive count (simplified ellipses solver, cmd/endpoint-ellipses.go)."""
+    if set_size:
+        if n_drives % set_size:
+            raise errors.InvalidArgument(
+                f"{n_drives} drives not divisible into sets of {set_size}"
+            )
+        return n_drives // set_size, set_size
+    for size in range(min(MAX_SET_SIZE, n_drives), 0, -1):
+        if n_drives % size == 0:
+            return n_drives // size, size
+    return 1, n_drives
+
+
+class ErasureSets:
+    """One pool: drives split into erasure sets, sipHashMod routing."""
+
+    def __init__(self, disks: Sequence[StorageAPI], set_size: int | None = None,
+                 deployment_id: str | None = None, pool_index: int = 0,
+                 default_parity: int | None = None):
+        self.all_disks = list(disks)
+        self.set_count, self.set_drive_count = choose_set_layout(
+            len(self.all_disks), set_size
+        )
+        self.deployment_id = self._init_format(deployment_id)
+        self.ns = NamespaceLock()
+        parity = (default_parity if default_parity is not None
+                  else default_parity_count(self.set_drive_count))
+        self.sets: list[ErasureObjects] = []
+        for s in range(self.set_count):
+            sd = self.all_disks[s * self.set_drive_count:(s + 1) * self.set_drive_count]
+            self.sets.append(
+                ErasureObjects(sd, default_parity=parity, set_index=s,
+                               pool_index=pool_index, ns_lock=self.ns)
+            )
+
+    # -- format bootstrap (waitForFormatErasure analogue) -------------------
+    def _init_format(self, deployment_id: str | None) -> str:
+        existing: str | None = None
+        unformatted = []
+        for d in self.all_disks:
+            try:
+                doc = json.loads(d.read_all(SYSTEM_VOL, FORMAT_FILE))
+                existing = existing or doc["id"]
+                d.set_disk_id(doc["erasure"]["this"])
+            except (errors.FileNotFound, errors.StorageError, KeyError,
+                    json.JSONDecodeError):
+                unformatted.append(d)
+        dep_id = existing or deployment_id or str(uuid.uuid4())
+        if unformatted:
+            layout = [
+                [f"d{s}-{i}" for i in range(self.set_drive_count)]
+                for s in range(self.set_count)
+            ]
+            for idx, d in enumerate(self.all_disks):
+                if d not in unformatted:
+                    continue
+                s, i = divmod(idx, self.set_drive_count)
+                this = layout[s][i]
+                d.write_all(SYSTEM_VOL, FORMAT_FILE,
+                            json.dumps(_format_doc(dep_id, layout, this)).encode())
+                d.set_disk_id(this)
+        return dep_id
+
+    @property
+    def _dep_bytes(self) -> bytes:
+        return uuid.UUID(self.deployment_id).bytes
+
+    def get_hashed_set(self, obj: str) -> ErasureObjects:
+        return self.sets[sip_hash_mod(obj, self.set_count, self._dep_bytes)]
+
+    # -- buckets ------------------------------------------------------------
+    def make_bucket(self, bucket: str) -> None:
+        made, exists = 0, 0
+        for d in self.all_disks:
+            if d is None or not d.is_online():
+                continue
+            try:
+                d.make_volume(bucket)
+                made += 1
+            except errors.VolumeExists:
+                exists += 1
+        if made == 0 and exists == 0:
+            raise errors.ErasureWriteQuorum("no drives for make_bucket")
+        if made == 0 and exists > 0:
+            raise errors.BucketExists(bucket)
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        found = 0
+        for d in self.all_disks:
+            if d is None or not d.is_online():
+                continue
+            try:
+                d.delete_volume(bucket, force=force)
+                found += 1
+            except errors.VolumeNotFound:
+                pass
+        if found == 0:
+            raise errors.BucketNotFound(bucket)
+
+    def list_buckets(self):
+        seen = {}
+        for d in self.all_disks:
+            if d is None or not d.is_online():
+                continue
+            try:
+                for v in d.list_volumes():
+                    seen.setdefault(v.name, v)
+            except Exception:
+                continue
+        return [seen[k] for k in sorted(seen)]
+
+    def bucket_exists(self, bucket: str) -> bool:
+        for d in self.all_disks:
+            if d is None or not d.is_online():
+                continue
+            try:
+                d.stat_volume(bucket)
+                return True
+            except errors.VolumeNotFound:
+                continue
+        return False
+
+    # -- object ops (delegate to hashed set) --------------------------------
+    def put_object(self, bucket, obj, reader, size=-1, opts=None) -> ObjectInfo:
+        return self.get_hashed_set(obj).put_object(bucket, obj, reader, size, opts)
+
+    def get_object(self, bucket, obj, offset=0, length=-1, version_id=""):
+        return self.get_hashed_set(obj).get_object(bucket, obj, offset, length,
+                                                   version_id)
+
+    def get_object_info(self, bucket, obj, version_id="") -> ObjectInfo:
+        return self.get_hashed_set(obj).get_object_info(bucket, obj, version_id)
+
+    def delete_object(self, bucket, obj, version_id="", versioned=False):
+        return self.get_hashed_set(obj).delete_object(bucket, obj, version_id,
+                                                      versioned)
+
+    def heal_object(self, bucket, obj, version_id="", deep=False) -> HealResult:
+        return self.get_hashed_set(obj).heal_object(bucket, obj, version_id, deep)
+
+    def list_objects(self, bucket: str, prefix: str = "") -> list[str]:
+        names: set[str] = set()
+        any_vol = False
+        for s in self.sets:
+            try:
+                names.update(s.list_objects(bucket, prefix))
+                any_vol = True
+            except errors.VolumeNotFound:
+                continue
+        if not any_vol and not self.bucket_exists(bucket):
+            raise errors.BucketNotFound(bucket)
+        return sorted(names)
+
+    # -- multipart ----------------------------------------------------------
+    def new_multipart_upload(self, bucket, obj, opts=None) -> str:
+        return self.get_hashed_set(obj).new_multipart_upload(bucket, obj, opts)
+
+    def put_object_part(self, bucket, obj, upload_id, part_number, reader,
+                        size=-1):
+        return self.get_hashed_set(obj).put_object_part(
+            bucket, obj, upload_id, part_number, reader, size
+        )
+
+    def list_object_parts(self, bucket, obj, upload_id):
+        return self.get_hashed_set(obj).list_object_parts(bucket, obj, upload_id)
+
+    def abort_multipart_upload(self, bucket, obj, upload_id):
+        return self.get_hashed_set(obj).abort_multipart_upload(bucket, obj,
+                                                               upload_id)
+
+    def complete_multipart_upload(self, bucket, obj, upload_id, parts):
+        return self.get_hashed_set(obj).complete_multipart_upload(
+            bucket, obj, upload_id, parts
+        )
+
+    # -- info ---------------------------------------------------------------
+    def storage_info(self) -> dict:
+        disks = []
+        for d in self.all_disks:
+            try:
+                di = d.disk_info()
+                disks.append({
+                    "endpoint": di.endpoint, "total": di.total, "free": di.free,
+                    "used": di.used, "online": d.is_online(), "id": di.id,
+                    "healing": di.healing,
+                })
+            except Exception as ex:
+                disks.append({"endpoint": getattr(d, "root", "?"),
+                              "online": False, "error": str(ex)})
+        return {
+            "sets": self.set_count, "drives_per_set": self.set_drive_count,
+            "disks": disks, "deployment_id": self.deployment_id,
+        }
+
+    def free_space(self) -> int:
+        total = 0
+        for d in self.all_disks:
+            try:
+                total += d.disk_info().free
+            except Exception:
+                pass
+        return total
+
+
+class ErasureServerPools:
+    """Multiple pools; placement by free space, reads probe all pools
+    (cmd/erasure-server-pool.go:222,289)."""
+
+    def __init__(self, pools: Sequence[ErasureSets]):
+        if not pools:
+            raise errors.InvalidArgument("no pools")
+        self.pools = list(pools)
+
+    # -- bucket ops over all pools -----------------------------------------
+    def make_bucket(self, bucket: str) -> None:
+        if self.bucket_exists(bucket):
+            raise errors.BucketExists(bucket)
+        for p in self.pools:
+            p.make_bucket(bucket)
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        if not force:
+            for p in self.pools:
+                if p.list_objects(bucket):
+                    raise errors.BucketNotEmpty(bucket)
+        for p in self.pools:
+            p.delete_bucket(bucket, force=force)
+
+    def list_buckets(self):
+        return self.pools[0].list_buckets()
+
+    def bucket_exists(self, bucket: str) -> bool:
+        return any(p.bucket_exists(bucket) for p in self.pools)
+
+    # -- placement ----------------------------------------------------------
+    def _pool_of(self, bucket: str, obj: str) -> ErasureSets | None:
+        """Pool already holding the object, if any."""
+        for p in self.pools:
+            try:
+                p.get_object_info(bucket, obj)
+                return p
+            except errors.MethodNotAllowed:
+                return p  # delete marker lives here
+            except errors.StorageError:
+                continue
+        return None
+
+    def _pool_for_new(self) -> ErasureSets:
+        return max(self.pools, key=lambda p: p.free_space())
+
+    # -- object ops ---------------------------------------------------------
+    def put_object(self, bucket, obj, reader, size=-1, opts=None) -> ObjectInfo:
+        if not self.bucket_exists(bucket):
+            raise errors.BucketNotFound(bucket)
+        pool = self._pool_of(bucket, obj) if len(self.pools) > 1 else self.pools[0]
+        if pool is None:
+            pool = self._pool_for_new()
+        return pool.put_object(bucket, obj, reader, size, opts)
+
+    def get_object(self, bucket, obj, offset=0, length=-1, version_id=""):
+        last: Exception = errors.ObjectNotFound(f"{bucket}/{obj}")
+        for p in self.pools:
+            try:
+                return p.get_object(bucket, obj, offset, length, version_id)
+            except (errors.ObjectNotFound, errors.VersionNotFound) as ex:
+                last = ex
+        raise last
+
+    def get_object_info(self, bucket, obj, version_id="") -> ObjectInfo:
+        last: Exception = errors.ObjectNotFound(f"{bucket}/{obj}")
+        for p in self.pools:
+            try:
+                return p.get_object_info(bucket, obj, version_id)
+            except (errors.ObjectNotFound, errors.VersionNotFound) as ex:
+                last = ex
+        raise last
+
+    def delete_object(self, bucket, obj, version_id="", versioned=False):
+        if not self.bucket_exists(bucket):
+            raise errors.BucketNotFound(bucket)
+        pool = self._pool_of(bucket, obj)
+        if pool is None:
+            if versioned and not version_id:
+                pool = self.pools[0]
+            else:
+                return ObjectInfo(bucket=bucket, name=obj, version_id=version_id)
+        return pool.delete_object(bucket, obj, version_id, versioned)
+
+    def heal_object(self, bucket, obj, version_id="", deep=False) -> HealResult:
+        for p in self.pools:
+            res = p.heal_object(bucket, obj, version_id, deep)
+            if not res.failed:
+                return res
+        return HealResult(failed=True)
+
+    def list_objects(self, bucket: str, prefix: str = "") -> list[str]:
+        names: set[str] = set()
+        found = False
+        for p in self.pools:
+            try:
+                names.update(p.list_objects(bucket, prefix))
+                found = True
+            except errors.BucketNotFound:
+                continue
+        if not found:
+            raise errors.BucketNotFound(bucket)
+        return sorted(names)
+
+    # -- multipart (route to the pool that will own the object) -------------
+    def new_multipart_upload(self, bucket, obj, opts=None) -> str:
+        if not self.bucket_exists(bucket):
+            raise errors.BucketNotFound(bucket)
+        pool = self._pool_of(bucket, obj) or self._pool_for_new()
+        return pool.new_multipart_upload(bucket, obj, opts)
+
+    def _pool_with_upload(self, bucket, obj, upload_id) -> ErasureSets:
+        for p in self.pools:
+            try:
+                p.get_hashed_set(obj)._upload_meta(bucket, obj, upload_id)
+                return p
+            except errors.StorageError:
+                continue
+        raise errors.InvalidArgument(f"upload id {upload_id} not found")
+
+    def put_object_part(self, bucket, obj, upload_id, part_number, reader,
+                        size=-1):
+        return self._pool_with_upload(bucket, obj, upload_id).put_object_part(
+            bucket, obj, upload_id, part_number, reader, size
+        )
+
+    def list_object_parts(self, bucket, obj, upload_id):
+        return self._pool_with_upload(bucket, obj, upload_id).list_object_parts(
+            bucket, obj, upload_id
+        )
+
+    def abort_multipart_upload(self, bucket, obj, upload_id):
+        return self._pool_with_upload(bucket, obj, upload_id).abort_multipart_upload(
+            bucket, obj, upload_id
+        )
+
+    def complete_multipart_upload(self, bucket, obj, upload_id, parts):
+        return self._pool_with_upload(bucket, obj, upload_id).complete_multipart_upload(
+            bucket, obj, upload_id, parts
+        )
+
+    def storage_info(self) -> dict:
+        return {"pools": [p.storage_info() for p in self.pools]}

@@ -1,0 +1,148 @@
+"""Per-drive storage interface.
+
+Capability-equivalent of the reference's 35-method StorageAPI
+(cmd/storage-interface.go:27): volume ops, streaming shard file IO,
+version-aware metadata ops, atomic rename-into-place, sorted dir walking,
+and bitrot verification.  Implementations: LocalStorage (POSIX dirs,
+storage/local.py) and RemoteStorage (HTTP RPC, distributed plane).
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import BinaryIO, Iterator
+
+from .xlmeta import FileInfo
+
+
+@dataclass
+class DiskInfo:
+    total: int = 0
+    free: int = 0
+    used: int = 0
+    fs_type: str = ""
+    root_disk: bool = False
+    healing: bool = False
+    endpoint: str = ""
+    mount_path: str = ""
+    id: str = ""
+    error: str = ""
+    metrics: dict = field(default_factory=dict)
+
+
+@dataclass
+class VolInfo:
+    name: str
+    created: float
+
+
+class StorageAPI(abc.ABC):
+    """One drive (local directory or remote peer drive)."""
+
+    # -- identity / health --------------------------------------------------
+    @abc.abstractmethod
+    def disk_id(self) -> str: ...
+
+    @abc.abstractmethod
+    def set_disk_id(self, disk_id: str) -> None: ...
+
+    @abc.abstractmethod
+    def is_online(self) -> bool: ...
+
+    @abc.abstractmethod
+    def disk_info(self) -> DiskInfo: ...
+
+    def is_local(self) -> bool:
+        return True
+
+    def endpoint(self) -> str:
+        return ""
+
+    def close(self) -> None:
+        pass
+
+    # -- volumes ------------------------------------------------------------
+    @abc.abstractmethod
+    def make_volume(self, volume: str) -> None: ...
+
+    @abc.abstractmethod
+    def list_volumes(self) -> list[VolInfo]: ...
+
+    @abc.abstractmethod
+    def stat_volume(self, volume: str) -> VolInfo: ...
+
+    @abc.abstractmethod
+    def delete_volume(self, volume: str, force: bool = False) -> None: ...
+
+    # -- flat files ---------------------------------------------------------
+    @abc.abstractmethod
+    def read_all(self, volume: str, path: str) -> bytes: ...
+
+    @abc.abstractmethod
+    def write_all(self, volume: str, path: str, data: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, volume: str, path: str, recursive: bool = False) -> None: ...
+
+    @abc.abstractmethod
+    def rename_file(self, src_volume: str, src_path: str,
+                    dst_volume: str, dst_path: str) -> None: ...
+
+    # -- shard files --------------------------------------------------------
+    @abc.abstractmethod
+    def create_file(self, volume: str, path: str, size: int,
+                    reader: BinaryIO) -> None: ...
+
+    @abc.abstractmethod
+    def open_file_writer(self, volume: str, path: str) -> BinaryIO:
+        """Streaming writer handle (closed by caller)."""
+
+    @abc.abstractmethod
+    def read_file_stream(self, volume: str, path: str, offset: int,
+                         length: int) -> BinaryIO: ...
+
+    @abc.abstractmethod
+    def read_file(self, volume: str, path: str, offset: int,
+                  buf_size: int) -> bytes: ...
+
+    # -- object metadata ----------------------------------------------------
+    @abc.abstractmethod
+    def read_version(self, volume: str, path: str, version_id: str = "",
+                     read_data: bool = False) -> FileInfo: ...
+
+    @abc.abstractmethod
+    def read_xl(self, volume: str, path: str) -> bytes: ...
+
+    @abc.abstractmethod
+    def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None: ...
+
+    @abc.abstractmethod
+    def update_metadata(self, volume: str, path: str, fi: FileInfo) -> None: ...
+
+    @abc.abstractmethod
+    def delete_version(self, volume: str, path: str, fi: FileInfo,
+                       force_del_marker: bool = False) -> None: ...
+
+    @abc.abstractmethod
+    def rename_data(self, src_volume: str, src_path: str, fi: FileInfo,
+                    dst_volume: str, dst_path: str) -> None: ...
+
+    # -- listing / verification ---------------------------------------------
+    @abc.abstractmethod
+    def list_dir(self, volume: str, path: str, count: int = -1) -> list[str]: ...
+
+    @abc.abstractmethod
+    def walk_dir(self, volume: str, base: str = "",
+                 recursive: bool = True) -> Iterator[str]:
+        """Yield object names (entries holding xl.meta) in sorted order
+        (reference WalkDir, cmd/metacache-walk.go:62)."""
+
+    @abc.abstractmethod
+    def verify_file(self, volume: str, path: str, fi: FileInfo) -> None:
+        """Full bitrot verification of this drive's shard of every part
+        (reference VerifyFile, cmd/xl-storage.go:2341)."""
+
+    @abc.abstractmethod
+    def check_parts(self, volume: str, path: str, fi: FileInfo) -> None:
+        """Cheap existence/size check of part files (CheckParts)."""

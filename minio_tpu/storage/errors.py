@@ -1,0 +1,112 @@
+"""Storage-layer error types (reference: cmd/storage-errors.go)."""
+
+
+class StorageError(Exception):
+    pass
+
+
+class DiskNotFound(StorageError):
+    pass
+
+
+class FileNotFound(StorageError):
+    pass
+
+
+class FileVersionNotFound(StorageError):
+    pass
+
+
+class FileCorrupt(StorageError):
+    pass
+
+
+class VolumeNotFound(StorageError):
+    pass
+
+
+class VolumeExists(StorageError):
+    pass
+
+
+class DiskFull(StorageError):
+    pass
+
+
+class FileAccessDenied(StorageError):
+    pass
+
+
+class UnformattedDisk(StorageError):
+    pass
+
+
+class ErasureReadQuorum(StorageError):
+    """Not enough disks agree to serve a read (errErasureReadQuorum)."""
+
+
+class ErasureWriteQuorum(StorageError):
+    """Write did not reach quorum (errErasureWriteQuorum)."""
+
+
+class ObjectNotFound(StorageError):
+    pass
+
+
+class VersionNotFound(StorageError):
+    pass
+
+
+class BucketNotFound(StorageError):
+    pass
+
+
+class BucketExists(StorageError):
+    pass
+
+
+class BucketNotEmpty(StorageError):
+    pass
+
+
+class InvalidArgument(StorageError):
+    pass
+
+
+class MethodNotAllowed(StorageError):
+    pass
+
+
+def reduce_errs(errs: list, ignored: tuple = ()) -> tuple[Exception | None, int]:
+    """Return (most common error, count), treating None as success.
+
+    Mirrors reduceErrs (cmd/erasure-metadata-utils.go:36): the modal error
+    value decides the operation outcome.
+    """
+    counts: dict = {}
+    for e in errs:
+        if e is not None and any(isinstance(e, ig) for ig in ignored):
+            continue
+        key = None if e is None else (type(e), str(e))
+        counts[key] = counts.get(key, 0) + 1
+    if not counts:
+        return None, 0
+    # max count wins; ties prefer success (None)
+    best_key = max(counts, key=lambda k: (counts[k], k is None))
+    best = counts[best_key]
+    if best_key is None:
+        return None, best
+    for e in errs:
+        if e is not None and (type(e), str(e)) == best_key:
+            return e, best
+    return None, best
+
+
+def reduce_quorum_errs(errs: list, ignored: tuple, quorum: int,
+                       quorum_err: Exception) -> Exception | None:
+    """Modal error if it meets quorum, else quorum_err
+    (cmd/erasure-metadata-utils.go:62-90)."""
+    err, count = reduce_errs(errs, ignored)
+    if count >= quorum:
+        return err
+    return quorum_err

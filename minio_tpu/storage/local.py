@@ -1,0 +1,388 @@
+"""Local POSIX drive implementation.
+
+Equivalent of the reference's xlStorage (cmd/xl-storage.go:90): one
+directory per drive, objects stored as
+    <drive>/<bucket>/<object>/xl.meta
+    <drive>/<bucket>/<object>/<data_dir>/part.N
+with a `.minio_tpu.sys` system volume for tmp staging, multipart state and
+drive metadata (format.json, healing tracker).  Writes stage into tmp and
+move into place with atomic renames (reference RenameData,
+cmd/xl-storage.go:1964).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import shutil
+import threading
+import time
+import uuid
+from typing import BinaryIO, Iterator
+
+from . import errors
+from .api import DiskInfo, StorageAPI, VolInfo
+from .xlmeta import FileInfo, XLMeta, file_info_from_raw
+
+SYSTEM_VOL = ".minio_tpu.sys"
+TMP_DIR = "tmp"
+XL_META_FILE = "xl.meta"
+FORMAT_FILE = "format.json"
+HEALING_FILE = ".healing.bin"
+
+
+def _clean(path: str) -> str:
+    path = path.strip("/")
+    if ".." in path.split("/"):
+        raise errors.FileAccessDenied(path)
+    return path
+
+
+class LocalStorage(StorageAPI):
+    def __init__(self, root: str, endpoint: str = ""):
+        self.root = os.path.abspath(root)
+        self._endpoint = endpoint or self.root
+        self._disk_id = ""
+        self._lock = threading.Lock()
+        os.makedirs(self.root, exist_ok=True)
+        os.makedirs(os.path.join(self.root, SYSTEM_VOL, TMP_DIR), exist_ok=True)
+
+    # -- identity -----------------------------------------------------------
+    def disk_id(self) -> str:
+        return self._disk_id
+
+    def set_disk_id(self, disk_id: str) -> None:
+        self._disk_id = disk_id
+
+    def is_online(self) -> bool:
+        return os.path.isdir(self.root)
+
+    def endpoint(self) -> str:
+        return self._endpoint
+
+    def disk_info(self) -> DiskInfo:
+        st = shutil.disk_usage(self.root)
+        return DiskInfo(
+            total=st.total, free=st.free, used=st.used,
+            healing=os.path.exists(self._sys_path(HEALING_FILE)),
+            endpoint=self._endpoint, mount_path=self.root, id=self._disk_id,
+        )
+
+    def _sys_path(self, *parts: str) -> str:
+        return os.path.join(self.root, SYSTEM_VOL, *parts)
+
+    # -- path helpers -------------------------------------------------------
+    def _vol_path(self, volume: str) -> str:
+        if not volume:
+            raise errors.InvalidArgument("empty volume")
+        return os.path.join(self.root, volume)
+
+    def _file_path(self, volume: str, path: str) -> str:
+        return os.path.join(self._vol_path(volume), _clean(path))
+
+    # -- volumes ------------------------------------------------------------
+    def make_volume(self, volume: str) -> None:
+        p = self._vol_path(volume)
+        if os.path.isdir(p):
+            raise errors.VolumeExists(volume)
+        os.makedirs(p, exist_ok=True)
+
+    def list_volumes(self) -> list[VolInfo]:
+        out = []
+        for name in sorted(os.listdir(self.root)):
+            p = os.path.join(self.root, name)
+            if os.path.isdir(p) and name != SYSTEM_VOL:
+                out.append(VolInfo(name=name, created=os.stat(p).st_ctime))
+        return out
+
+    def stat_volume(self, volume: str) -> VolInfo:
+        p = self._vol_path(volume)
+        if not os.path.isdir(p):
+            raise errors.VolumeNotFound(volume)
+        return VolInfo(name=volume, created=os.stat(p).st_ctime)
+
+    def delete_volume(self, volume: str, force: bool = False) -> None:
+        p = self._vol_path(volume)
+        if not os.path.isdir(p):
+            raise errors.VolumeNotFound(volume)
+        if force:
+            shutil.rmtree(p, ignore_errors=True)
+            return
+        try:
+            os.rmdir(p)
+        except OSError:
+            raise errors.BucketNotEmpty(volume)
+
+    # -- flat files ---------------------------------------------------------
+    def read_all(self, volume: str, path: str) -> bytes:
+        p = self._file_path(volume, path)
+        try:
+            with open(p, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise errors.FileNotFound(f"{volume}/{path}")
+        except IsADirectoryError:
+            raise errors.FileNotFound(f"{volume}/{path}")
+
+    def write_all(self, volume: str, path: str, data: bytes) -> None:
+        p = self._file_path(volume, path)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = p + f".tmp.{uuid.uuid4().hex[:8]}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, p)
+
+    def delete(self, volume: str, path: str, recursive: bool = False) -> None:
+        p = self._file_path(volume, path)
+        try:
+            if os.path.isdir(p):
+                if recursive:
+                    shutil.rmtree(p)
+                else:
+                    os.rmdir(p)
+            else:
+                os.remove(p)
+        except FileNotFoundError:
+            raise errors.FileNotFound(f"{volume}/{path}")
+        # prune now-empty parents up to the volume root
+        parent = os.path.dirname(p)
+        vol_root = self._vol_path(volume)
+        while parent != vol_root and parent.startswith(vol_root):
+            try:
+                os.rmdir(parent)
+            except OSError:
+                break
+            parent = os.path.dirname(parent)
+
+    def rename_file(self, src_volume: str, src_path: str,
+                    dst_volume: str, dst_path: str) -> None:
+        src = self._file_path(src_volume, src_path)
+        dst = self._file_path(dst_volume, dst_path)
+        if not os.path.exists(src):
+            raise errors.FileNotFound(f"{src_volume}/{src_path}")
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        os.replace(src, dst)
+
+    # -- shard files --------------------------------------------------------
+    def create_file(self, volume: str, path: str, size: int,
+                    reader: BinaryIO) -> None:
+        with self.open_file_writer(volume, path) as w:
+            remaining = size if size >= 0 else None
+            while True:
+                chunk = reader.read(1 << 20)
+                if not chunk:
+                    break
+                w.write(chunk)
+                if remaining is not None:
+                    remaining -= len(chunk)
+                    if remaining <= 0:
+                        break
+
+    def open_file_writer(self, volume: str, path: str) -> BinaryIO:
+        p = self._file_path(volume, path)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        return open(p, "wb")
+
+    def read_file_stream(self, volume: str, path: str, offset: int,
+                         length: int) -> BinaryIO:
+        p = self._file_path(volume, path)
+        try:
+            f = open(p, "rb")
+        except FileNotFoundError:
+            raise errors.FileNotFound(f"{volume}/{path}")
+        if length >= 0:
+            st = os.fstat(f.fileno())
+            if st.st_size < offset + length:
+                f.close()
+                raise errors.FileCorrupt(
+                    f"{volume}/{path}: size {st.st_size} < {offset + length}"
+                )
+        f.seek(offset)
+        return f
+
+    def read_file(self, volume: str, path: str, offset: int,
+                  buf_size: int) -> bytes:
+        with self.read_file_stream(volume, path, offset, buf_size) as f:
+            return f.read(buf_size)
+
+    # -- object metadata ----------------------------------------------------
+    def _meta_path(self, volume: str, path: str) -> str:
+        return os.path.join(self._file_path(volume, path), XL_META_FILE)
+
+    def read_xl(self, volume: str, path: str) -> bytes:
+        try:
+            with open(self._meta_path(volume, path), "rb") as f:
+                return f.read()
+        except (FileNotFoundError, NotADirectoryError):
+            raise errors.FileNotFound(f"{volume}/{path}")
+
+    def read_version(self, volume: str, path: str, version_id: str = "",
+                     read_data: bool = False) -> FileInfo:
+        raw = self.read_xl(volume, path)
+        fi = file_info_from_raw(raw, volume, path, version_id, read_data)
+        return fi
+
+    def _write_xl(self, volume: str, path: str, xl: XLMeta) -> None:
+        p = self._meta_path(volume, path)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = p + f".tmp.{uuid.uuid4().hex[:8]}"
+        with open(tmp, "wb") as f:
+            f.write(xl.dumps())
+        os.replace(tmp, p)
+
+    def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
+        try:
+            xl = XLMeta.loads(self.read_xl(volume, path))
+        except errors.FileNotFound:
+            xl = XLMeta()
+        xl.add_version(fi)
+        self._write_xl(volume, path, xl)
+
+    def update_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
+        xl = XLMeta.loads(self.read_xl(volume, path))
+        if xl.find_version(fi.version_id) is None:
+            raise errors.FileVersionNotFound(f"{volume}/{path}@{fi.version_id}")
+        xl.add_version(fi)
+        self._write_xl(volume, path, xl)
+
+    def delete_version(self, volume: str, path: str, fi: FileInfo,
+                       force_del_marker: bool = False) -> None:
+        try:
+            xl = XLMeta.loads(self.read_xl(volume, path))
+        except errors.FileNotFound:
+            if fi.deleted and force_del_marker:
+                self.write_metadata(volume, path, fi)
+                return
+            raise
+        if fi.deleted and not fi.version_id:
+            # writing a delete marker on top
+            xl.add_version(fi)
+            self._write_xl(volume, path, xl)
+            return
+        v = xl.delete_version(fi.version_id)
+        if v is None and fi.version_id:
+            raise errors.FileVersionNotFound(f"{volume}/{path}@{fi.version_id}")
+        if v is not None:
+            data_dir = v.get("dd", "")
+            if data_dir:
+                dpath = os.path.join(self._file_path(volume, path), data_dir)
+                shutil.rmtree(dpath, ignore_errors=True)
+        if xl.versions:
+            self._write_xl(volume, path, xl)
+        else:
+            self.delete(volume, path, recursive=True)
+
+    def rename_data(self, src_volume: str, src_path: str, fi: FileInfo,
+                    dst_volume: str, dst_path: str) -> None:
+        """Move staged part files into place and commit xl.meta atomically."""
+        dst_obj_dir = self._file_path(dst_volume, dst_path)
+        os.makedirs(dst_obj_dir, exist_ok=True)
+        if fi.data is None and fi.data_dir:
+            src_dir = self._file_path(src_volume, src_path)
+            if not os.path.isdir(src_dir):
+                raise errors.FileNotFound(f"{src_volume}/{src_path}")
+            dst_data_dir = os.path.join(dst_obj_dir, fi.data_dir)
+            if os.path.isdir(dst_data_dir):
+                shutil.rmtree(dst_data_dir)
+            os.replace(src_dir, dst_data_dir)
+        try:
+            xl = XLMeta.loads(self.read_xl(dst_volume, dst_path))
+        except errors.FileNotFound:
+            xl = XLMeta()
+        xl.add_version(fi)
+        self._write_xl(dst_volume, dst_path, xl)
+
+    # -- listing ------------------------------------------------------------
+    def list_dir(self, volume: str, path: str, count: int = -1) -> list[str]:
+        p = self._file_path(volume, path) if path else self._vol_path(volume)
+        try:
+            entries = sorted(os.listdir(p))
+        except FileNotFoundError:
+            raise errors.FileNotFound(f"{volume}/{path}")
+        out = []
+        for e in entries:
+            if os.path.isdir(os.path.join(p, e)):
+                out.append(e + "/")
+            else:
+                out.append(e)
+            if 0 < count <= len(out):
+                break
+        return out
+
+    def walk_dir(self, volume: str, base: str = "",
+                 recursive: bool = True) -> Iterator[str]:
+        vol_root = self._vol_path(volume)
+        if not os.path.isdir(vol_root):
+            raise errors.VolumeNotFound(volume)
+        start = os.path.join(vol_root, _clean(base)) if base else vol_root
+
+        def walk(d: str, prefix: str) -> Iterator[str]:
+            try:
+                entries = sorted(os.listdir(d))
+            except (FileNotFoundError, NotADirectoryError):
+                return
+            if XL_META_FILE in entries:
+                yield prefix.rstrip("/")
+                return
+            for e in entries:
+                sub = os.path.join(d, e)
+                if os.path.isdir(sub):
+                    if recursive:
+                        yield from walk(sub, prefix + e + "/")
+                    else:
+                        yield prefix + e + "/"
+
+        yield from walk(start, _clean(base) + "/" if base else "")
+
+    # -- verification -------------------------------------------------------
+    def verify_file(self, volume: str, path: str, fi: FileInfo) -> None:
+        from minio_tpu.erasure import bitrot
+
+        if fi.erasure is None:
+            raise errors.InvalidArgument("no erasure info")
+        if fi.data is not None:
+            return  # inline data verified via xl.meta integrity
+        for part in fi.parts:
+            shard_size = fi.erasure.shard_size
+            shard_file_size = fi.erasure.shard_file_size(part.size)
+            pp = os.path.join(self._file_path(volume, path), fi.data_dir,
+                              f"part.{part.number}")
+            try:
+                f = open(pp, "rb")
+            except FileNotFoundError:
+                raise errors.FileNotFound(pp)
+            with f:
+                bitrot.bitrot_verify_stream(
+                    f, os.fstat(f.fileno()).st_size, shard_file_size,
+                    shard_size,
+                )
+
+    def check_parts(self, volume: str, path: str, fi: FileInfo) -> None:
+        if fi.data is not None:
+            return
+        from minio_tpu.erasure import bitrot
+
+        for part in fi.parts:
+            pp = os.path.join(self._file_path(volume, path), fi.data_dir,
+                              f"part.{part.number}")
+            try:
+                st = os.stat(pp)
+            except FileNotFoundError:
+                raise errors.FileNotFound(pp)
+            want = bitrot.bitrot_shard_file_size(
+                fi.erasure.shard_file_size(part.size), fi.erasure.shard_size
+            )
+            if st.st_size != want:
+                raise errors.FileCorrupt(
+                    f"{pp}: size {st.st_size} != expected {want}"
+                )
+
+    # -- misc ---------------------------------------------------------------
+    def set_healing(self, healing: bool) -> None:
+        p = self._sys_path(HEALING_FILE)
+        if healing:
+            with open(p, "w") as f:
+                json.dump({"started": time.time()}, f)
+        elif os.path.exists(p):
+            os.remove(p)

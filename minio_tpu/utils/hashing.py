@@ -1,0 +1,90 @@
+"""Placement hashes: crc32 drive ordering and SipHash-2-4 set routing.
+
+Mirrors the reference's layout math exactly so a deployment's object->set
+and object->drive-order mapping matches MinIO's:
+- hashOrder: crc32(IEEE) salted rotation (cmd/erasure-metadata-utils.go:107)
+- sipHashMod: SipHash-2-4 keyed by the 16-byte deployment id
+  (cmd/erasure-sets.go:747, dchest/siphash semantics)
+- crcHashMod: legacy v1 distribution (cmd/erasure-sets.go:758)
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+MASK64 = 0xFFFFFFFFFFFFFFFF
+
+
+def _rotl(x: int, b: int) -> int:
+    return ((x << b) | (x >> (64 - b))) & MASK64
+
+
+def siphash24(k0: int, k1: int, data: bytes) -> int:
+    """SipHash-2-4 (64-bit output), reference semantics."""
+    v0 = k0 ^ 0x736F6D6570736575
+    v1 = k1 ^ 0x646F72616E646F6D
+    v2 = k0 ^ 0x6C7967656E657261
+    v3 = k1 ^ 0x7465646279746573
+
+    def sipround(v0, v1, v2, v3):
+        v0 = (v0 + v1) & MASK64
+        v1 = _rotl(v1, 13)
+        v1 ^= v0
+        v0 = _rotl(v0, 32)
+        v2 = (v2 + v3) & MASK64
+        v3 = _rotl(v3, 16)
+        v3 ^= v2
+        v0 = (v0 + v3) & MASK64
+        v3 = _rotl(v3, 21)
+        v3 ^= v0
+        v2 = (v2 + v1) & MASK64
+        v1 = _rotl(v1, 17)
+        v1 ^= v2
+        v2 = _rotl(v2, 32)
+        return v0, v1, v2, v3
+
+    n = len(data)
+    end = n - (n % 8)
+    for off in range(0, end, 8):
+        m = struct.unpack_from("<Q", data, off)[0]
+        v3 ^= m
+        v0, v1, v2, v3 = sipround(v0, v1, v2, v3)
+        v0, v1, v2, v3 = sipround(v0, v1, v2, v3)
+        v0 ^= m
+    tail = data[end:]
+    b = (n & 0xFF) << 56
+    for i, ch in enumerate(tail):
+        b |= ch << (8 * i)
+    v3 ^= b
+    v0, v1, v2, v3 = sipround(v0, v1, v2, v3)
+    v0, v1, v2, v3 = sipround(v0, v1, v2, v3)
+    v0 ^= b
+    v2 ^= 0xFF
+    for _ in range(4):
+        v0, v1, v2, v3 = sipround(v0, v1, v2, v3)
+    return (v0 ^ v1 ^ v2 ^ v3) & MASK64
+
+
+def sip_hash_mod(key: str, cardinality: int, deployment_id: bytes) -> int:
+    """Object name -> erasure set index (cmd/erasure-sets.go:747)."""
+    if cardinality <= 0:
+        return -1
+    k0, k1 = struct.unpack("<QQ", deployment_id[:16])
+    return siphash24(k0, k1, key.encode()) % cardinality
+
+
+def crc_hash_mod(key: str, cardinality: int) -> int:
+    if cardinality <= 0:
+        return -1
+    return (zlib.crc32(key.encode()) & 0xFFFFFFFF) % cardinality
+
+
+def hash_order(key: str, cardinality: int) -> list[int]:
+    """Consistent 1-based drive order for an object
+    (cmd/erasure-metadata-utils.go:107)."""
+    if cardinality <= 0:
+        return []
+    key_crc = zlib.crc32(key.encode()) & 0xFFFFFFFF
+    start = key_crc % cardinality
+    return [1 + ((start + i) % cardinality) for i in range(1, cardinality + 1)]

@@ -1,0 +1,99 @@
+"""ErasureSets routing + ErasureServerPools placement."""
+
+import io
+
+import numpy as np
+import pytest
+
+from minio_tpu.erasure.sets import ErasureSets, ErasureServerPools, choose_set_layout
+from minio_tpu.storage import errors
+from minio_tpu.storage.local import LocalStorage
+
+
+def make_sets(tmp_path, n=8, set_size=4, tag="p0"):
+    disks = [LocalStorage(str(tmp_path / f"{tag}-d{i}")) for i in range(n)]
+    return ErasureSets(disks, set_size=set_size), disks
+
+
+def test_choose_set_layout():
+    assert choose_set_layout(16) == (1, 16)
+    assert choose_set_layout(32) == (2, 16)
+    assert choose_set_layout(6) == (1, 6)
+    assert choose_set_layout(20, set_size=10) == (2, 10)
+    with pytest.raises(errors.InvalidArgument):
+        choose_set_layout(7, set_size=4)
+
+
+def test_routing_is_stable_and_spread(tmp_path):
+    sets, disks = make_sets(tmp_path, 8, 4)
+    assert sets.set_count == 2
+    owners = {}
+    for i in range(64):
+        name = f"obj-{i}"
+        owners[name] = sets.get_hashed_set(name).set_index
+    # deterministic on re-read
+    for name, idx in owners.items():
+        assert sets.get_hashed_set(name).set_index == idx
+    # both sets get traffic
+    assert set(owners.values()) == {0, 1}
+
+
+def test_format_persisted_and_reloaded(tmp_path):
+    sets, disks = make_sets(tmp_path, 8, 4)
+    dep = sets.deployment_id
+    # reload from the same drives: same deployment id, same routing
+    sets2 = ErasureSets([LocalStorage(d.root) for d in disks], set_size=4)
+    assert sets2.deployment_id == dep
+
+
+def test_objects_roundtrip_through_sets(tmp_path):
+    sets, _ = make_sets(tmp_path, 8, 4)
+    sets.make_bucket("bkt")
+    data = np.random.default_rng(0).integers(0, 256, 300_000, dtype=np.uint8).tobytes()
+    for i in range(6):
+        sets.put_object("bkt", f"o{i}", io.BytesIO(data), len(data))
+    assert sets.list_objects("bkt") == [f"o{i}" for i in range(6)]
+    _, stream = sets.get_object("bkt", "o3")
+    assert b"".join(stream) == data
+    sets.delete_object("bkt", "o3")
+    assert "o3" not in sets.list_objects("bkt")
+
+
+def test_pools_placement_and_probe(tmp_path):
+    p0, _ = make_sets(tmp_path, 4, 4, tag="p0")
+    p1, _ = make_sets(tmp_path, 4, 4, tag="p1")
+    pools = ErasureServerPools([p0, p1])
+    pools.make_bucket("bkt")
+    pools.put_object("bkt", "obj", io.BytesIO(b"hello world"), 11)
+    _, stream = pools.get_object("bkt", "obj")
+    assert b"".join(stream) == b"hello world"
+    # object findable regardless of which pool holds it
+    assert pools.get_object_info("bkt", "obj").size == 11
+    # overwrite goes to the same pool (no duplicates)
+    pools.put_object("bkt", "obj", io.BytesIO(b"second version!"), 15)
+    assert pools.get_object_info("bkt", "obj").size == 15
+    count = sum(
+        1 for p in pools.pools
+        if "obj" in (p.list_objects("bkt") if p.bucket_exists("bkt") else [])
+    )
+    assert count == 1
+    pools.delete_object("bkt", "obj")
+    with pytest.raises(errors.ObjectNotFound):
+        pools.get_object_info("bkt", "obj")
+
+
+def test_bucket_lifecycle(tmp_path):
+    p0, _ = make_sets(tmp_path, 4, 4, tag="p0")
+    pools = ErasureServerPools([p0])
+    pools.make_bucket("b1")
+    with pytest.raises(errors.BucketExists):
+        pools.make_bucket("b1")
+    assert [v.name for v in pools.list_buckets()] == ["b1"]
+    pools.put_object("b1", "x", io.BytesIO(b"1"), 1)
+    with pytest.raises(errors.BucketNotEmpty):
+        pools.delete_bucket("b1")
+    pools.delete_object("b1", "x")
+    pools.delete_bucket("b1")
+    assert not pools.bucket_exists("b1")
+    with pytest.raises(errors.BucketNotFound):
+        pools.put_object("b1", "x", io.BytesIO(b"1"), 1)

@@ -1,0 +1,91 @@
+"""LocalStorage drive semantics (reference: cmd/xl-storage_test.go patterns)."""
+
+import io
+
+import pytest
+
+from minio_tpu.storage import errors
+from minio_tpu.storage.local import LocalStorage
+from minio_tpu.storage.xlmeta import ErasureInfo, FileInfo, ObjectPartInfo, new_data_dir
+
+
+@pytest.fixture
+def drive(tmp_path):
+    return LocalStorage(str(tmp_path / "d0"))
+
+
+def _fi(version="", data=None, data_dir="", size=0):
+    return FileInfo(
+        volume="bkt", name="obj", version_id=version, data_dir=data_dir,
+        mod_time=1000.0, size=size, data=data,
+        erasure=ErasureInfo(
+            algorithm="rs-vandermonde", data_blocks=2, parity_blocks=1,
+            block_size=1 << 20, index=1, distribution=[1, 2, 3],
+        ),
+        parts=[ObjectPartInfo(1, size, size)],
+    )
+
+
+def test_volumes(drive):
+    drive.make_volume("bkt")
+    assert [v.name for v in drive.list_volumes()] == ["bkt"]
+    with pytest.raises(errors.VolumeExists):
+        drive.make_volume("bkt")
+    drive.stat_volume("bkt")
+    drive.delete_volume("bkt")
+    with pytest.raises(errors.VolumeNotFound):
+        drive.stat_volume("bkt")
+
+
+def test_path_traversal_rejected(drive):
+    drive.make_volume("bkt")
+    with pytest.raises(errors.FileAccessDenied):
+        drive.read_all("bkt", "../escape")
+
+
+def test_write_read_metadata_versions(drive):
+    drive.make_volume("bkt")
+    drive.write_metadata("bkt", "obj", _fi("v1"))
+    drive.write_metadata("bkt", "obj", _fi("v2"))
+    fi = drive.read_version("bkt", "obj")
+    assert fi.version_id in ("v1", "v2")  # latest by mod_time (equal -> stable)
+    fi1 = drive.read_version("bkt", "obj", "v1")
+    assert fi1.version_id == "v1"
+    with pytest.raises(errors.FileVersionNotFound):
+        drive.read_version("bkt", "obj", "nope")
+
+
+def test_delete_version_cleans_object(drive):
+    drive.make_volume("bkt")
+    drive.write_metadata("bkt", "obj", _fi("v1"))
+    drive.delete_version("bkt", "obj", _fi("v1"))
+    with pytest.raises(errors.FileNotFound):
+        drive.read_xl("bkt", "obj")
+
+
+def test_rename_data_commits_parts(drive):
+    drive.make_volume("bkt")
+    dd = new_data_dir()
+    # stage part file in tmp
+    drive.create_file(".minio_tpu.sys", f"tmp/{dd}/part.1", 5, io.BytesIO(b"hello"))
+    fi = _fi("v1", data_dir=dd, size=5)
+    drive.rename_data(".minio_tpu.sys", f"tmp/{dd}", fi, "bkt", "obj")
+    got = drive.read_version("bkt", "obj", "v1")
+    assert got.data_dir == dd
+    with drive.read_file_stream("bkt", f"obj/{dd}/part.1", 0, 5) as f:
+        assert f.read() == b"hello"
+
+
+def test_walk_dir(drive):
+    drive.make_volume("bkt")
+    for name in ["a/b/obj1", "a/obj2", "zz"]:
+        drive.write_metadata("bkt", name, _fi("v1"))
+    assert list(drive.walk_dir("bkt")) == ["a/b/obj1", "a/obj2", "zz"]
+    assert list(drive.walk_dir("bkt", base="a")) == ["a/b/obj1", "a/obj2"]
+
+
+def test_inline_data_roundtrip(drive):
+    drive.make_volume("bkt")
+    drive.write_metadata("bkt", "obj", _fi("v1", data=b"\x01\x02\x03", size=3))
+    fi = drive.read_version("bkt", "obj", "", read_data=True)
+    assert fi.data == b"\x01\x02\x03"
