@@ -151,6 +151,14 @@ class ErasureSets:
                 pass
         if found == 0:
             raise errors.BucketNotFound(bucket)
+        # drop bucket metadata so a recreated bucket starts clean
+        for d in self.all_disks:
+            if d is None or not d.is_online():
+                continue
+            try:
+                d.delete(SYSTEM_VOL, f"buckets/{bucket}", recursive=True)
+            except errors.StorageError:
+                pass
 
     def list_buckets(self):
         seen = {}
@@ -227,6 +235,50 @@ class ErasureSets:
         return self.get_hashed_set(obj).complete_multipart_upload(
             bucket, obj, upload_id, parts
         )
+
+    # -- bucket metadata (bucket-metadata-sys lite) -------------------------
+    # Reference: per-bucket .metadata.bin aggregate (cmd/bucket-metadata.go);
+    # here a JSON doc persisted under the system volume on every drive.
+    def _bucket_meta_path(self, bucket: str) -> str:
+        return f"buckets/{bucket}/.metadata.json"
+
+    def get_bucket_metadata(self, bucket: str) -> dict:
+        for d in self.all_disks:
+            if d is None or not d.is_online():
+                continue
+            try:
+                return json.loads(d.read_all(SYSTEM_VOL,
+                                             self._bucket_meta_path(bucket)))
+            except errors.StorageError:
+                continue
+        return {}
+
+    def set_bucket_metadata(self, bucket: str, meta: dict) -> None:
+        raw = json.dumps(meta).encode()
+        wrote = 0
+        for d in self.all_disks:
+            if d is None or not d.is_online():
+                continue
+            try:
+                d.write_all(SYSTEM_VOL, self._bucket_meta_path(bucket), raw)
+                wrote += 1
+            except errors.StorageError:
+                continue
+        if wrote == 0:
+            raise errors.ErasureWriteQuorum("bucket metadata write failed")
+
+    def update_bucket_metadata(self, bucket: str, **kv) -> None:
+        meta = self.get_bucket_metadata(bucket)
+        meta.update(kv)
+        self.set_bucket_metadata(bucket, meta)
+
+    def versioning_enabled(self, bucket: str) -> bool:
+        return bool(self.get_bucket_metadata(bucket).get("versioning"))
+
+    def set_versioning(self, bucket: str, enabled: bool) -> None:
+        if not self.bucket_exists(bucket):
+            raise errors.BucketNotFound(bucket)
+        self.update_bucket_metadata(bucket, versioning=bool(enabled))
 
     # -- info ---------------------------------------------------------------
     def storage_info(self) -> dict:
@@ -400,3 +452,20 @@ class ErasureServerPools:
 
     def storage_info(self) -> dict:
         return {"pools": [p.storage_info() for p in self.pools]}
+
+    # -- bucket metadata ----------------------------------------------------
+    def get_bucket_metadata(self, bucket: str) -> dict:
+        for p in self.pools:
+            meta = p.get_bucket_metadata(bucket)
+            if meta:
+                return meta
+        return {}
+
+    def versioning_enabled(self, bucket: str) -> bool:
+        return bool(self.get_bucket_metadata(bucket).get("versioning"))
+
+    def set_versioning(self, bucket: str, enabled: bool) -> None:
+        if not self.bucket_exists(bucket):
+            raise errors.BucketNotFound(bucket)
+        for p in self.pools:
+            p.update_bucket_metadata(bucket, versioning=bool(enabled))

@@ -1,0 +1,71 @@
+"""Server CLI: `python -m minio_tpu.server DIR1 DIR2 ... [options]`.
+
+Equivalent of `minio server DIR{1...N}` (cmd/server-main.go:422): boots the
+erasure object layer over the given drive directories and serves the S3
+API.  Supports `{1...N}` ellipses expansion and multiple pools separated
+by repetition of drive groups.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+
+def expand_ellipses(pattern: str) -> list[str]:
+    """`/data/d{1...8}` -> [/data/d1, ..., /data/d8]
+    (cmd/endpoint-ellipses.go semantics, simplified)."""
+    m = re.search(r"\{(\d+)\.\.\.(\d+)\}", pattern)
+    if not m:
+        return [pattern]
+    lo, hi = int(m.group(1)), int(m.group(2))
+    if hi < lo:
+        raise ValueError(f"bad ellipses range in {pattern}")
+    out = []
+    for i in range(lo, hi + 1):
+        out.extend(expand_ellipses(pattern[: m.start()] + str(i) + pattern[m.end():]))
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="minio-tpu server")
+    ap.add_argument("drives", nargs="+",
+                    help="drive dirs or ellipses patterns like /data/d{1...8}")
+    ap.add_argument("--address", default="127.0.0.1:9000")
+    ap.add_argument("--access-key",
+                    default=os.environ.get("MINIO_ROOT_USER", "minioadmin"))
+    ap.add_argument("--secret-key",
+                    default=os.environ.get("MINIO_ROOT_PASSWORD", "minioadmin"))
+    ap.add_argument("--region", default="us-east-1")
+    ap.add_argument("--set-size", type=int, default=None)
+    args = ap.parse_args(argv)
+
+    drives: list[str] = []
+    for pat in args.drives:
+        drives.extend(expand_ellipses(pat))
+
+    from aiohttp import web
+
+    from minio_tpu.erasure.sets import ErasureSets, ErasureServerPools
+    from minio_tpu.storage.local import LocalStorage
+    from .app import make_app
+
+    disks = [LocalStorage(d) for d in drives]
+    pools = ErasureServerPools([ErasureSets(disks, set_size=args.set_size)])
+    info = pools.storage_info()["pools"][0]
+    print(
+        f"minio-tpu: serving {len(drives)} drives "
+        f"({info['sets']} sets x {info['drives_per_set']} drives) "
+        f"on http://{args.address}", file=sys.stderr,
+    )
+    app = make_app(pools, access_key=args.access_key,
+                   secret_key=args.secret_key, region=args.region)
+    host, port = args.address.rsplit(":", 1)
+    web.run_app(app, host=host, port=int(port), print=None)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

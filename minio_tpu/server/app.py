@@ -1,0 +1,805 @@
+"""S3-compatible HTTP server over the erasure ObjectLayer.
+
+Equivalent of the reference's router + handler stack (cmd/api-router.go:188,
+cmd/object-handlers.go, cmd/bucket-handlers.go): bucket CRUD, object
+CRUD with ranges, ListObjectsV1/V2, ListBuckets, multipart, batch delete,
+SigV4 header + presigned auth (incl. aws-chunked streaming uploads).
+
+Async front (aiohttp) with the blocking object layer driven on a thread
+pool — the asyncio analogue of the reference's goroutine-per-request
+model with the global API throttle (cmd/handler-api.go).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import io
+import queue as queue_mod
+import re
+import secrets
+import urllib.parse
+import xml.etree.ElementTree as ET
+from datetime import datetime, timezone
+from xml.sax.saxutils import escape
+
+from aiohttp import web
+
+from minio_tpu.storage import errors as st
+from minio_tpu.erasure.objects import PutObjectOptions
+from . import sigv4
+from .s3errors import S3Error, from_storage_error
+
+XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
+VALID_BUCKET = re.compile(r"^[a-z0-9][a-z0-9.\-]{2,62}$")
+
+
+def _iso(ts: float) -> str:
+    return datetime.fromtimestamp(ts, tz=timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%S.000Z"
+    )
+
+
+def _http_date(ts: float) -> str:
+    return datetime.fromtimestamp(ts, tz=timezone.utc).strftime(
+        "%a, %d %b %Y %H:%M:%S GMT"
+    )
+
+
+class _ChunkedSigReader(io.RawIOBase):
+    """Decode and VERIFY aws-chunked (STREAMING-AWS4-HMAC-SHA256-PAYLOAD)
+    framing: `hex-size;chunk-signature=...\r\n<bytes>\r\n` (reference
+    cmd/streaming-signature-v4.go).  Each chunk's signature is chained from
+    the previous one starting at the request's seed signature; a mismatch
+    aborts the upload."""
+
+    def __init__(self, raw: io.RawIOBase, ctx: sigv4.V4Context | None):
+        self.raw = raw
+        self.ctx = ctx
+        self.prev_sig = ctx.seed_signature if ctx else ""
+        self.buf = b""
+        self.out = b""  # decoded-but-undelivered bytes (read(n) contract)
+        self.eof = False
+
+    def _read_line(self) -> bytes:
+        while b"\r\n" not in self.buf:
+            chunk = self.raw.read(65536)
+            if not chunk:
+                raise S3Error("IncompleteBody")
+            self.buf += chunk
+        line, self.buf = self.buf.split(b"\r\n", 1)
+        return line
+
+    def _read_n(self, n: int) -> bytes:
+        while len(self.buf) < n:
+            chunk = self.raw.read(max(65536, n - len(self.buf)))
+            if not chunk:
+                raise S3Error("IncompleteBody")
+            self.buf += chunk
+        out, self.buf = self.buf[:n], self.buf[n:]
+        return out
+
+    def _next_chunk(self) -> None:
+        header = self._read_line()
+        parts = header.split(b";", 1)
+        try:
+            size = int(parts[0], 16)
+        except ValueError:
+            raise S3Error("IncompleteBody")
+        sig = b""
+        if len(parts) == 2 and parts[1].startswith(b"chunk-signature="):
+            sig = parts[1][len(b"chunk-signature="):].strip()
+        data = self._read_n(size) if size else b""
+        if self.ctx is not None:
+            want = sigv4.chunk_signature(
+                self.ctx.signing_key, self.prev_sig, self.ctx.amz_date,
+                self.ctx.scope, hashlib.sha256(data).hexdigest(),
+            )
+            if sig.decode(errors="replace") != want:
+                raise S3Error("SignatureDoesNotMatch",
+                              "chunk signature mismatch")
+            self.prev_sig = want
+        if size == 0:
+            self.eof = True
+        else:
+            self.out += data
+            self._read_n(2)  # trailing \r\n
+
+    def read(self, n: int = -1) -> bytes:
+        while not self.eof and (n < 0 or len(self.out) < n):
+            self._next_chunk()
+        if n < 0:
+            out, self.out = self.out, b""
+        else:
+            out, self.out = self.out[:n], self.out[n:]
+        return out
+
+
+class _QueuePipeReader(io.RawIOBase):
+    """Bridges async body chunks into the sync object layer."""
+
+    def __init__(self):
+        self.q: queue_mod.Queue = queue_mod.Queue(maxsize=16)
+        self.buf = b""
+        self.eof = False
+
+    def read(self, n: int = -1) -> bytes:
+        if n < 0:
+            chunks = [self.buf]
+            self.buf = b""
+            while not self.eof:
+                item = self.q.get()
+                if item is None:
+                    self.eof = True
+                    break
+                chunks.append(item)
+            return b"".join(chunks)
+        while len(self.buf) < n and not self.eof:
+            item = self.q.get()
+            if item is None:
+                self.eof = True
+                break
+            self.buf += item
+        out, self.buf = self.buf[:n], self.buf[n:]
+        return out
+
+
+class S3Server:
+    def __init__(self, object_layer, access_key: str = "minioadmin",
+                 secret_key: str = "minioadmin", region: str = "us-east-1",
+                 max_concurrency: int = 64):
+        import concurrent.futures as cf
+
+        self.api = object_layer
+        self.creds = {access_key: secret_key}
+        self.region = region
+        self.sem = asyncio.Semaphore(max_concurrency)
+        # Dedicated pool sized to the request semaphore so a full house of
+        # blocking object-layer calls can never starve body-feed tasks
+        # (reference analogue: maxClients semaphore, cmd/handler-api.go:108).
+        self.executor = cf.ThreadPoolExecutor(
+            max_workers=max_concurrency + 4, thread_name_prefix="s3-api"
+        )
+        self.app = web.Application(client_max_size=1 << 30)
+        self.app.router.add_route("*", "/", self.dispatch_root)
+        self.app.router.add_route("*", "/{bucket}", self.dispatch_bucket)
+        self.app.router.add_route("*", "/{bucket}/{key:.*}", self.dispatch_object)
+
+    # ------------------------------------------------------------------ util
+    async def _run(self, fn, *args, **kw):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(self.executor, lambda: fn(*args, **kw))
+
+    async def _feed(self, pipe: "_QueuePipeReader", item, task) -> None:
+        """Non-blocking queue feed from the event loop; aborts if the
+        consuming task already finished (e.g. it errored before draining)."""
+        while True:
+            if task is not None and task.done():
+                return
+            try:
+                pipe.q.put_nowait(item)
+                return
+            except queue_mod.Full:
+                await asyncio.sleep(0.005)
+
+    def _xml(self, status: int, body: str) -> web.Response:
+        return web.Response(
+            status=status, body=body.encode(),
+            content_type="application/xml",
+            headers={"Server": "MinIO-TPU"},
+        )
+
+    def _auth(self, request: web.Request, payload_hash: str | None) -> str:
+        query = [(k, v) for k, v in urllib.parse.parse_qsl(
+            request.rel_url.query_string, keep_blank_values=True
+        )]
+        headers = dict(request.headers)
+        headers["host"] = request.headers.get("Host", request.host)
+        path = urllib.parse.unquote(request.rel_url.raw_path)
+        try:
+            if "X-Amz-Signature" in dict(query):
+                return sigv4.verify_v4_presigned(
+                    request.method, path, query, headers,
+                    self.creds.get, self.region,
+                )
+            return sigv4.verify_v4(
+                request.method, path, query, headers, payload_hash,
+                self.creds.get, self.region,
+            )
+        except sigv4.SigV4Error as e:
+            raise S3Error(e.code, str(e))
+
+    async def _handle(self, request: web.Request, fn) -> web.StreamResponse:
+        async with self.sem:
+            try:
+                return await fn(request)
+            except S3Error as e:
+                return web.Response(
+                    status=e.status,
+                    body=e.to_xml(secrets.token_hex(8)),
+                    content_type="application/xml",
+                )
+            except Exception as e:  # storage & unexpected errors
+                s3e = from_storage_error(e, request.path)
+                return web.Response(
+                    status=s3e.status,
+                    body=s3e.to_xml(secrets.token_hex(8)),
+                    content_type="application/xml",
+                )
+
+    # -------------------------------------------------------------- dispatch
+    async def dispatch_root(self, request: web.Request) -> web.StreamResponse:
+        return await self._handle(request, self.list_buckets)
+
+    async def dispatch_bucket(self, request: web.Request) -> web.StreamResponse:
+        q = request.rel_url.query
+        m = request.method
+        if m == "GET":
+            if "location" in q:
+                return await self._handle(request, self.bucket_location)
+            if "versioning" in q:
+                return await self._handle(request, self.get_versioning)
+            if "uploads" in q:
+                return await self._handle(request, self.list_uploads)
+            return await self._handle(request, self.list_objects)
+        if m == "PUT":
+            if "versioning" in q:
+                return await self._handle(request, self.put_versioning)
+            return await self._handle(request, self.make_bucket)
+        if m == "DELETE":
+            return await self._handle(request, self.delete_bucket)
+        if m == "HEAD":
+            return await self._handle(request, self.head_bucket)
+        if m == "POST" and "delete" in q:
+            return await self._handle(request, self.delete_objects)
+        return await self._handle(request, self._method_not_allowed)
+
+    async def dispatch_object(self, request: web.Request) -> web.StreamResponse:
+        q = request.rel_url.query
+        m = request.method
+        if m == "GET":
+            if "uploadId" in q:
+                return await self._handle(request, self.list_parts)
+            return await self._handle(request, self.get_object)
+        if m == "HEAD":
+            return await self._handle(request, self.head_object)
+        if m == "PUT":
+            if "uploadId" in q and "partNumber" in q:
+                return await self._handle(request, self.upload_part)
+            return await self._handle(request, self.put_object)
+        if m == "DELETE":
+            if "uploadId" in q:
+                return await self._handle(request, self.abort_upload)
+            return await self._handle(request, self.delete_object)
+        if m == "POST":
+            if "uploads" in q:
+                return await self._handle(request, self.create_upload)
+            if "uploadId" in q:
+                return await self._handle(request, self.complete_upload)
+        return await self._handle(request, self._method_not_allowed)
+
+    @staticmethod
+    async def _method_not_allowed(request: web.Request) -> web.Response:
+        raise S3Error("MethodNotAllowed", resource=request.path)
+
+    # ------------------------------------------------------------- service
+    async def list_buckets(self, request: web.Request) -> web.Response:
+        self._auth(request, None)
+        vols = await self._run(self.api.list_buckets)
+        buckets = "".join(
+            f"<Bucket><Name>{escape(v.name)}</Name>"
+            f"<CreationDate>{_iso(v.created)}</CreationDate></Bucket>"
+            for v in vols
+        )
+        return self._xml(200, (
+            f'<?xml version="1.0" encoding="UTF-8"?>'
+            f'<ListAllMyBucketsResult xmlns="{XMLNS}">'
+            f"<Owner><ID>minio-tpu</ID><DisplayName>minio-tpu</DisplayName></Owner>"
+            f"<Buckets>{buckets}</Buckets></ListAllMyBucketsResult>"
+        ))
+
+    # ------------------------------------------------------------- buckets
+    def _bucket(self, request: web.Request) -> str:
+        b = request.match_info["bucket"]
+        if not VALID_BUCKET.match(b):
+            raise S3Error("InvalidBucketName", resource=b)
+        return b
+
+    async def make_bucket(self, request: web.Request) -> web.Response:
+        self._auth(request, None)
+        bucket = self._bucket(request)
+        await request.read()
+        await self._run(self.api.make_bucket, bucket)
+        return web.Response(status=200, headers={"Location": f"/{bucket}"})
+
+    async def head_bucket(self, request: web.Request) -> web.Response:
+        self._auth(request, None)
+        bucket = self._bucket(request)
+        if not await self._run(self.api.bucket_exists, bucket):
+            raise S3Error("NoSuchBucket", resource=bucket)
+        return web.Response(status=200)
+
+    async def delete_bucket(self, request: web.Request) -> web.Response:
+        self._auth(request, None)
+        bucket = self._bucket(request)
+        await self._run(self.api.delete_bucket, bucket)
+        return web.Response(status=204)
+
+    async def bucket_location(self, request: web.Request) -> web.Response:
+        self._auth(request, None)
+        bucket = self._bucket(request)
+        if not await self._run(self.api.bucket_exists, bucket):
+            raise S3Error("NoSuchBucket", resource=bucket)
+        return self._xml(200, (
+            f'<?xml version="1.0" encoding="UTF-8"?>'
+            f'<LocationConstraint xmlns="{XMLNS}">{self.region}'
+            f"</LocationConstraint>"
+        ))
+
+    async def get_versioning(self, request: web.Request) -> web.Response:
+        self._auth(request, None)
+        bucket = self._bucket(request)
+        enabled = await self._versioned(bucket)
+        inner = "<Status>Enabled</Status>" if enabled else ""
+        return self._xml(200, (
+            f'<?xml version="1.0" encoding="UTF-8"?>'
+            f'<VersioningConfiguration xmlns="{XMLNS}">{inner}'
+            f"</VersioningConfiguration>"
+        ))
+
+    async def put_versioning(self, request: web.Request) -> web.Response:
+        body = await request.read()
+        self._auth(request, hashlib.sha256(body).hexdigest())
+        bucket = self._bucket(request)
+        try:
+            root = ET.fromstring(body)
+            status = root.findtext(f"{{{XMLNS}}}Status") or root.findtext("Status")
+        except ET.ParseError:
+            raise S3Error("MalformedXML")
+        setter = getattr(self.api, "set_versioning", None)
+        if setter is None:
+            raise S3Error("NotImplemented")
+        await self._run(setter, bucket, status == "Enabled")
+        return web.Response(status=200)
+
+    async def list_objects(self, request: web.Request) -> web.Response:
+        self._auth(request, None)
+        bucket = self._bucket(request)
+        q = request.rel_url.query
+        prefix = q.get("prefix", "")
+        delimiter = q.get("delimiter", "")
+        max_keys = min(int(q.get("max-keys", "1000") or "1000"), 1000)
+        v2 = q.get("list-type") == "2"
+        start_after = q.get("start-after", "") if v2 else q.get("marker", "")
+        token = q.get("continuation-token", "")
+        if token:
+            start_after = token
+
+        names = await self._run(self.api.list_objects, bucket, prefix)
+        names = [n for n in names if n.startswith(prefix)]
+        if start_after:
+            names = [n for n in names if n > start_after]
+
+        contents, prefixes = [], []
+        seen_prefixes = set()
+        for n in names:
+            if delimiter:
+                rest = n[len(prefix):]
+                if delimiter in rest:
+                    cp = prefix + rest.split(delimiter, 1)[0] + delimiter
+                    if cp not in seen_prefixes:
+                        seen_prefixes.add(cp)
+                        prefixes.append(cp)
+                    continue
+            contents.append(n)
+        truncated = len(contents) > max_keys
+        contents = contents[:max_keys]
+
+        parts = []
+        for n in contents:
+            try:
+                oi = await self._run(self.api.get_object_info, bucket, n)
+            except Exception:
+                continue
+            parts.append(
+                f"<Contents><Key>{escape(n)}</Key>"
+                f"<LastModified>{_iso(oi.mod_time)}</LastModified>"
+                f'<ETag>&quot;{oi.etag}&quot;</ETag>'
+                f"<Size>{oi.size}</Size>"
+                f"<StorageClass>STANDARD</StorageClass></Contents>"
+            )
+        for cp in prefixes:
+            parts.append(
+                f"<CommonPrefixes><Prefix>{escape(cp)}</Prefix></CommonPrefixes>"
+            )
+        next_token = (
+            f"<NextContinuationToken>{escape(contents[-1])}"
+            f"</NextContinuationToken>" if truncated and v2 and contents else ""
+        )
+        tag = "ListBucketResult"
+        return self._xml(200, (
+            f'<?xml version="1.0" encoding="UTF-8"?>'
+            f'<{tag} xmlns="{XMLNS}">'
+            f"<Name>{escape(bucket)}</Name><Prefix>{escape(prefix)}</Prefix>"
+            f"<KeyCount>{len(contents)}</KeyCount><MaxKeys>{max_keys}</MaxKeys>"
+            f"<Delimiter>{escape(delimiter)}</Delimiter>"
+            f"<IsTruncated>{'true' if truncated else 'false'}</IsTruncated>"
+            f"{next_token}{''.join(parts)}</{tag}>"
+        ))
+
+    async def delete_objects(self, request: web.Request) -> web.Response:
+        body = await request.read()
+        self._auth(request, hashlib.sha256(body).hexdigest())
+        bucket = self._bucket(request)
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise S3Error("MalformedXML")
+        ns = f"{{{XMLNS}}}"
+        versioned = await self._versioned(bucket)
+        results = []
+        for obj in root.findall(f"{ns}Object") + root.findall("Object"):
+            key = obj.findtext(f"{ns}Key") or obj.findtext("Key") or ""
+            vid = obj.findtext(f"{ns}VersionId") or obj.findtext("VersionId") or ""
+            try:
+                await self._run(
+                    self.api.delete_object, bucket, key, vid, versioned
+                )
+                results.append(f"<Deleted><Key>{escape(key)}</Key></Deleted>")
+            except Exception as e:
+                s3e = from_storage_error(e)
+                results.append(
+                    f"<Error><Key>{escape(key)}</Key><Code>{s3e.code}</Code>"
+                    f"<Message>{escape(s3e.message)}</Message></Error>"
+                )
+        return self._xml(200, (
+            f'<?xml version="1.0" encoding="UTF-8"?>'
+            f'<DeleteResult xmlns="{XMLNS}">{"".join(results)}</DeleteResult>'
+        ))
+
+    # ------------------------------------------------------------- objects
+    def _object(self, request: web.Request) -> tuple[str, str]:
+        bucket = self._bucket(request)
+        key = request.match_info["key"]
+        if not key:
+            raise S3Error("InvalidArgument", "empty object key")
+        return bucket, key
+
+    @staticmethod
+    def _obj_headers(oi) -> dict[str, str]:
+        h = {
+            "ETag": f'"{oi.etag}"',
+            "Last-Modified": _http_date(oi.mod_time),
+            "Content-Type": oi.content_type or "application/octet-stream",
+            "Accept-Ranges": "bytes",
+        }
+        if oi.version_id:
+            h["x-amz-version-id"] = oi.version_id
+        for k, v in oi.metadata.items():
+            if k.startswith("x-amz-meta-"):
+                h[k] = v
+        return h
+
+    async def put_object(self, request: web.Request) -> web.Response:
+        bucket, key = self._object(request)
+        sha_claim = request.headers.get("x-amz-content-sha256", "")
+        copy_src = request.headers.get("x-amz-copy-source")
+        if copy_src:
+            self._auth(request, sha_claim or sigv4.EMPTY_SHA256)
+            return await self.copy_object(request, bucket, key, copy_src)
+
+        size = request.content_length
+        streaming = sha_claim.startswith("STREAMING-")
+        ctx = self._auth(request, sha_claim or None)
+
+        decoded_len = request.headers.get("x-amz-decoded-content-length")
+        real_size = int(decoded_len) if streaming and decoded_len else (
+            size if size is not None else -1
+        )
+        opts = PutObjectOptions(
+            content_type=request.headers.get("Content-Type", ""),
+            user_metadata={
+                k.lower(): v for k, v in request.headers.items()
+                if k.lower().startswith("x-amz-meta-")
+            },
+            versioned=await self._versioned(bucket),
+        )
+
+        pipe = _QueuePipeReader()
+        reader: io.RawIOBase = (
+            _ChunkedSigReader(pipe, ctx) if streaming else pipe
+        )
+        put_task = asyncio.ensure_future(self._run(
+            self.api.put_object, bucket, key, reader, real_size, opts
+        ))
+        check_hash = (
+            sha_claim and not streaming
+            and sha_claim != sigv4.UNSIGNED_PAYLOAD
+        )
+        body_sha = hashlib.sha256() if check_hash else None
+        feed_err = None
+        try:
+            async for chunk in request.content.iter_chunked(1 << 20):
+                if body_sha is not None:
+                    body_sha.update(chunk)
+                await self._feed(pipe, chunk, put_task)
+        except Exception as e:
+            feed_err = e
+        await self._feed(pipe, None, put_task)
+        try:
+            oi = await put_task
+        except Exception:
+            if feed_err is not None:
+                raise S3Error("IncompleteBody")
+            raise
+        if feed_err is not None:
+            raise S3Error("IncompleteBody")
+        if body_sha is not None and body_sha.hexdigest() != sha_claim:
+            # tampered/corrupted body: roll back the just-written version
+            # (reference rejects with content-sha256 mismatch during stream)
+            try:
+                await self._run(
+                    self.api.delete_object, bucket, key, oi.version_id, False
+                )
+            except Exception:
+                pass
+            raise S3Error("BadDigest",
+                          "x-amz-content-sha256 does not match body")
+        headers = {"ETag": f'"{oi.etag}"'}
+        if oi.version_id:
+            headers["x-amz-version-id"] = oi.version_id
+        return web.Response(status=200, headers=headers)
+
+    async def _versioned(self, bucket: str) -> bool:
+        fn = getattr(self.api, "versioning_enabled", None)
+        if fn is None:
+            return False
+        return bool(await self._run(fn, bucket))
+
+    async def copy_object(self, request: web.Request, bucket: str, key: str,
+                          copy_src: str) -> web.Response:
+        src = urllib.parse.unquote(copy_src)
+        src = src.lstrip("/")
+        if "?versionId=" in src:
+            src, vid = src.split("?versionId=", 1)
+        else:
+            vid = ""
+        try:
+            sbucket, skey = src.split("/", 1)
+        except ValueError:
+            raise S3Error("InvalidArgument", "bad x-amz-copy-source")
+        oi, stream = await self._run(
+            self.api.get_object, sbucket, skey, 0, -1, vid
+        )
+        data = await self._run(lambda: b"".join(stream))
+        opts = PutObjectOptions(
+            content_type=oi.content_type,
+            user_metadata=dict(oi.metadata),
+            versioned=await self._versioned(bucket),
+        )
+        new_oi = await self._run(
+            self.api.put_object, bucket, key, io.BytesIO(data), len(data), opts
+        )
+        return self._xml(200, (
+            f'<?xml version="1.0" encoding="UTF-8"?>'
+            f'<CopyObjectResult xmlns="{XMLNS}">'
+            f'<ETag>&quot;{new_oi.etag}&quot;</ETag>'
+            f"<LastModified>{_iso(new_oi.mod_time)}</LastModified>"
+            f"</CopyObjectResult>"
+        ))
+
+    def _parse_range(self, header: str, size: int) -> tuple[int, int]:
+        m = re.match(r"^bytes=(\d*)-(\d*)$", header.strip())
+        if not m:
+            raise S3Error("InvalidRange")
+        first, last = m.group(1), m.group(2)
+        if first == "" and last == "":
+            raise S3Error("InvalidRange")
+        if first == "":
+            n = int(last)
+            if n == 0:
+                raise S3Error("InvalidRange")
+            start = max(size - n, 0)
+            end = size - 1
+        else:
+            start = int(first)
+            end = int(last) if last else size - 1
+            end = min(end, size - 1)
+        if start > end or start >= size:
+            raise S3Error("InvalidRange")
+        return start, end
+
+    async def get_object(self, request: web.Request) -> web.StreamResponse:
+        self._auth(request, None)
+        bucket, key = self._object(request)
+        vid = request.rel_url.query.get("versionId", "")
+        oi = await self._run(self.api.get_object_info, bucket, key, vid)
+
+        status = 200
+        offset, length = 0, oi.size
+        headers = self._obj_headers(oi)
+        rng = request.headers.get("Range")
+        if rng and oi.size > 0:
+            start, end = self._parse_range(rng, oi.size)
+            offset, length = start, end - start + 1
+            status = 206
+            headers["Content-Range"] = f"bytes {start}-{end}/{oi.size}"
+        headers["Content-Length"] = str(length)
+
+        _, stream = await self._run(
+            self.api.get_object, bucket, key, offset, length, vid
+        )
+        resp = web.StreamResponse(status=status, headers=headers)
+        await resp.prepare(request)
+        it = iter(stream)
+        try:
+            while True:
+                chunk = await self._run(next, it, None)
+                if chunk is None:
+                    break
+                await resp.write(chunk)
+        finally:
+            await self._run(lambda: stream.close() if hasattr(stream, "close") else None)
+        await resp.write_eof()
+        return resp
+
+    async def head_object(self, request: web.Request) -> web.Response:
+        self._auth(request, None)
+        bucket, key = self._object(request)
+        vid = request.rel_url.query.get("versionId", "")
+        oi = await self._run(self.api.get_object_info, bucket, key, vid)
+        headers = self._obj_headers(oi)
+        headers["Content-Length"] = str(oi.size)
+        return web.Response(status=200, headers=headers)
+
+    async def delete_object(self, request: web.Request) -> web.Response:
+        self._auth(request, None)
+        bucket, key = self._object(request)
+        vid = request.rel_url.query.get("versionId", "")
+        versioned = await self._versioned(bucket)
+        oi = await self._run(
+            self.api.delete_object, bucket, key, vid, versioned
+        )
+        headers = {}
+        if oi.delete_marker:
+            headers["x-amz-delete-marker"] = "true"
+        if oi.version_id:
+            headers["x-amz-version-id"] = oi.version_id
+        return web.Response(status=204, headers=headers)
+
+    # ----------------------------------------------------------- multipart
+    async def create_upload(self, request: web.Request) -> web.Response:
+        self._auth(request, None)
+        bucket, key = self._object(request)
+        opts = PutObjectOptions(
+            content_type=request.headers.get("Content-Type", ""),
+            user_metadata={
+                k.lower(): v for k, v in request.headers.items()
+                if k.lower().startswith("x-amz-meta-")
+            },
+        )
+        uid = await self._run(self.api.new_multipart_upload, bucket, key, opts)
+        return self._xml(200, (
+            f'<?xml version="1.0" encoding="UTF-8"?>'
+            f'<InitiateMultipartUploadResult xmlns="{XMLNS}">'
+            f"<Bucket>{escape(bucket)}</Bucket><Key>{escape(key)}</Key>"
+            f"<UploadId>{uid}</UploadId></InitiateMultipartUploadResult>"
+        ))
+
+    async def upload_part(self, request: web.Request) -> web.Response:
+        bucket, key = self._object(request)
+        q = request.rel_url.query
+        uid = q["uploadId"]
+        part_num = int(q["partNumber"])
+        sha_claim = request.headers.get("x-amz-content-sha256", "")
+        streaming = sha_claim.startswith("STREAMING-")
+        ctx = self._auth(request, sha_claim or None)
+        decoded_len = request.headers.get("x-amz-decoded-content-length")
+        size = request.content_length
+        real_size = int(decoded_len) if streaming and decoded_len else (
+            size if size is not None else -1
+        )
+        pipe = _QueuePipeReader()
+        reader: io.RawIOBase = (
+            _ChunkedSigReader(pipe, ctx) if streaming else pipe
+        )
+        task = asyncio.ensure_future(self._run(
+            self.api.put_object_part, bucket, key, uid, part_num, reader,
+            real_size
+        ))
+        try:
+            async for chunk in request.content.iter_chunked(1 << 20):
+                await self._feed(pipe, chunk, task)
+        finally:
+            await self._feed(pipe, None, task)
+        try:
+            pi = await task
+        except st.InvalidArgument as e:
+            if "upload id" in str(e):
+                raise S3Error("NoSuchUpload")
+            raise
+        return web.Response(status=200, headers={"ETag": f'"{pi.etag}"'})
+
+    async def list_parts(self, request: web.Request) -> web.Response:
+        self._auth(request, None)
+        bucket, key = self._object(request)
+        uid = request.rel_url.query["uploadId"]
+        try:
+            parts = await self._run(self.api.list_object_parts, bucket, key, uid)
+        except st.InvalidArgument:
+            raise S3Error("NoSuchUpload")
+        inner = "".join(
+            f"<Part><PartNumber>{p.part_number}</PartNumber>"
+            f'<ETag>&quot;{p.etag}&quot;</ETag><Size>{p.size}</Size>'
+            f"<LastModified>{_iso(p.mod_time)}</LastModified></Part>"
+            for p in parts
+        )
+        return self._xml(200, (
+            f'<?xml version="1.0" encoding="UTF-8"?>'
+            f'<ListPartsResult xmlns="{XMLNS}">'
+            f"<Bucket>{escape(bucket)}</Bucket><Key>{escape(key)}</Key>"
+            f"<UploadId>{uid}</UploadId>{inner}</ListPartsResult>"
+        ))
+
+    async def list_uploads(self, request: web.Request) -> web.Response:
+        self._auth(request, None)
+        bucket = self._bucket(request)
+        return self._xml(200, (
+            f'<?xml version="1.0" encoding="UTF-8"?>'
+            f'<ListMultipartUploadsResult xmlns="{XMLNS}">'
+            f"<Bucket>{escape(bucket)}</Bucket>"
+            f"<IsTruncated>false</IsTruncated>"
+            f"</ListMultipartUploadsResult>"
+        ))
+
+    async def abort_upload(self, request: web.Request) -> web.Response:
+        self._auth(request, None)
+        bucket, key = self._object(request)
+        uid = request.rel_url.query["uploadId"]
+        try:
+            await self._run(self.api.abort_multipart_upload, bucket, key, uid)
+        except st.InvalidArgument:
+            raise S3Error("NoSuchUpload")
+        return web.Response(status=204)
+
+    async def complete_upload(self, request: web.Request) -> web.Response:
+        body = await request.read()
+        self._auth(request, hashlib.sha256(body).hexdigest())
+        bucket, key = self._object(request)
+        uid = request.rel_url.query["uploadId"]
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise S3Error("MalformedXML")
+        ns = f"{{{XMLNS}}}"
+        parts = []
+        for p in root.findall(f"{ns}Part") + root.findall("Part"):
+            num = p.findtext(f"{ns}PartNumber") or p.findtext("PartNumber")
+            etag = (p.findtext(f"{ns}ETag") or p.findtext("ETag") or "").strip('"')
+            parts.append((int(num), etag))
+        from minio_tpu.erasure.multipart import EntityTooSmall
+
+        try:
+            oi = await self._run(
+                self.api.complete_multipart_upload, bucket, key, uid, parts
+            )
+        except EntityTooSmall:
+            raise S3Error("EntityTooSmall")
+        except st.InvalidArgument as e:
+            if "upload id" in str(e):
+                raise S3Error("NoSuchUpload")
+            if "out of order" in str(e):
+                raise S3Error("InvalidPartOrder")
+            raise S3Error("InvalidPart", str(e))
+        return self._xml(200, (
+            f'<?xml version="1.0" encoding="UTF-8"?>'
+            f'<CompleteMultipartUploadResult xmlns="{XMLNS}">'
+            f"<Location>/{escape(bucket)}/{escape(key)}</Location>"
+            f"<Bucket>{escape(bucket)}</Bucket><Key>{escape(key)}</Key>"
+            f'<ETag>&quot;{oi.etag}&quot;</ETag>'
+            f"</CompleteMultipartUploadResult>"
+        ))
+
+
+def make_app(object_layer, **kw) -> web.Application:
+    return S3Server(object_layer, **kw).app

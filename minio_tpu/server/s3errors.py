@@ -1,0 +1,89 @@
+"""S3 API error codes and XML rendering (reference: cmd/api-errors.go)."""
+
+from __future__ import annotations
+
+from xml.sax.saxutils import escape
+
+from minio_tpu.storage import errors as st
+
+# code -> (http status, default message)
+S3_ERRORS = {
+    "AccessDenied": (403, "Access Denied."),
+    "BadDigest": (400, "The Content-Md5 you specified did not match what we received."),
+    "BucketAlreadyExists": (409, "The requested bucket name is not available."),
+    "BucketAlreadyOwnedByYou": (409, "Your previous request to create the named bucket succeeded and you already own it."),
+    "BucketNotEmpty": (409, "The bucket you tried to delete is not empty."),
+    "EntityTooSmall": (400, "Your proposed upload is smaller than the minimum allowed object size."),
+    "EntityTooLarge": (400, "Your proposed upload exceeds the maximum allowed object size."),
+    "IncompleteBody": (400, "You did not provide the number of bytes specified by the Content-Length HTTP header."),
+    "InternalError": (500, "We encountered an internal error, please try again."),
+    "InvalidAccessKeyId": (403, "The Access Key Id you provided does not exist in our records."),
+    "InvalidArgument": (400, "Invalid Argument."),
+    "InvalidBucketName": (400, "The specified bucket is not valid."),
+    "InvalidDigest": (400, "The Content-Md5 you specified is not valid."),
+    "InvalidPart": (400, "One or more of the specified parts could not be found."),
+    "InvalidPartOrder": (400, "The list of parts was not in ascending order."),
+    "InvalidRange": (416, "The requested range is not satisfiable."),
+    "InvalidRequest": (400, "Invalid Request."),
+    "MalformedXML": (400, "The XML you provided was not well-formed or did not validate against our published schema."),
+    "MethodNotAllowed": (405, "The specified method is not allowed against this resource."),
+    "MissingContentLength": (411, "You must provide the Content-Length HTTP header."),
+    "NoSuchBucket": (404, "The specified bucket does not exist."),
+    "NoSuchKey": (404, "The specified key does not exist."),
+    "NoSuchUpload": (404, "The specified multipart upload does not exist."),
+    "NoSuchVersion": (404, "The specified version does not exist."),
+    "NotImplemented": (501, "A header you provided implies functionality that is not implemented."),
+    "PreconditionFailed": (412, "At least one of the pre-conditions you specified did not hold."),
+    "RequestTimeTooSkewed": (403, "The difference between the request time and the server's time is too large."),
+    "SignatureDoesNotMatch": (403, "The request signature we calculated does not match the signature you provided."),
+    "ServiceUnavailable": (503, "Please reduce your request rate."),
+    "SlowDown": (503, "Please reduce your request rate."),
+    "XMinioServerNotInitialized": (503, "Server not initialized, please try again."),
+    "AuthorizationHeaderMalformed": (400, "The authorization header is malformed."),
+    "AuthorizationQueryParametersError": (400, "Error parsing the X-Amz-Credential parameter."),
+    "NotModified": (304, ""),
+    "QuorumError": (503, "Storage resources are insufficient for the operation."),
+}
+
+
+class S3Error(Exception):
+    def __init__(self, code: str, message: str | None = None,
+                 resource: str = ""):
+        status, default = S3_ERRORS.get(code, (500, "Unknown error."))
+        super().__init__(message or default)
+        self.code = code
+        self.status = status
+        self.message = message or default
+        self.resource = resource
+
+    def to_xml(self, request_id: str = "") -> bytes:
+        return (
+            '<?xml version="1.0" encoding="UTF-8"?>\n'
+            f"<Error><Code>{escape(self.code)}</Code>"
+            f"<Message>{escape(self.message)}</Message>"
+            f"<Resource>{escape(self.resource)}</Resource>"
+            f"<RequestId>{escape(request_id)}</RequestId></Error>"
+        ).encode()
+
+
+def from_storage_error(e: Exception, resource: str = "") -> S3Error:
+    """Map object-layer errors to S3 errors (reference toAPIErrorCode)."""
+    mapping = [
+        (st.BucketNotFound, "NoSuchBucket"),
+        (st.BucketExists, "BucketAlreadyExists"),
+        (st.BucketNotEmpty, "BucketNotEmpty"),
+        (st.ObjectNotFound, "NoSuchKey"),
+        (st.VersionNotFound, "NoSuchVersion"),
+        (st.FileNotFound, "NoSuchKey"),
+        (st.MethodNotAllowed, "MethodNotAllowed"),
+        (st.ErasureWriteQuorum, "QuorumError"),
+        (st.ErasureReadQuorum, "QuorumError"),
+        (st.InvalidArgument, "InvalidArgument"),
+        (st.FileCorrupt, "InternalError"),
+    ]
+    if isinstance(e, S3Error):
+        return e
+    for etype, code in mapping:
+        if isinstance(e, etype):
+            return S3Error(code, resource=resource)
+    return S3Error("InternalError", str(e), resource=resource)

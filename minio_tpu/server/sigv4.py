@@ -1,0 +1,267 @@
+"""AWS Signature Version 4 — signing and verification.
+
+Reference: cmd/signature-v4.go (doesSignatureMatch, presigned variant).
+Implements header-based auth and presigned-URL auth for the S3 service;
+the client-side signer is used by tests and by the internode RPC layer.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import urllib.parse
+from datetime import datetime, timezone
+
+ALGORITHM = "AWS4-HMAC-SHA256"
+UNSIGNED_PAYLOAD = "UNSIGNED-PAYLOAD"
+STREAMING_PAYLOAD = "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"
+EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+
+
+class SigV4Error(Exception):
+    def __init__(self, code: str, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def signing_key(secret: str, date: str, region: str, service: str = "s3") -> bytes:
+    k = _hmac(("AWS4" + secret).encode(), date)
+    k = _hmac(k, region)
+    k = _hmac(k, service)
+    return _hmac(k, "aws4_request")
+
+
+def _uri_encode(s: str, encode_slash: bool = True) -> str:
+    safe = "-_.~" + ("" if encode_slash else "/")
+    return urllib.parse.quote(s, safe=safe)
+
+
+def canonical_query(query: dict[str, str] | list[tuple[str, str]],
+                    skip: set[str] = frozenset()) -> str:
+    items = query.items() if isinstance(query, dict) else query
+    pairs = sorted(
+        (_uri_encode(k), _uri_encode(v)) for k, v in items if k not in skip
+    )
+    return "&".join(f"{k}={v}" for k, v in pairs)
+
+
+def canonical_request(method: str, path: str, query_str: str,
+                      headers: dict[str, str], signed_headers: list[str],
+                      payload_hash: str) -> str:
+    canon_headers = "".join(
+        f"{h}:{' '.join(headers.get(h, '').split())}\n" for h in signed_headers
+    )
+    return "\n".join([
+        method.upper(),
+        _uri_encode(path, encode_slash=False) or "/",
+        query_str,
+        canon_headers,
+        ";".join(signed_headers),
+        payload_hash,
+    ])
+
+
+def string_to_sign(canon_req: str, amz_date: str, scope: str) -> str:
+    return "\n".join([
+        ALGORITHM, amz_date, scope,
+        hashlib.sha256(canon_req.encode()).hexdigest(),
+    ])
+
+
+def sign_request(method: str, path: str, query: list[tuple[str, str]],
+                 headers: dict[str, str], payload: bytes | None,
+                 access_key: str, secret_key: str, region: str = "us-east-1",
+                 amz_date: str | None = None,
+                 payload_hash: str | None = None) -> dict[str, str]:
+    """Client-side signer: returns headers with Authorization added.
+
+    Pass payload_hash=STREAMING_PAYLOAD (with payload=None) to produce the
+    seed signature of an aws-chunked upload."""
+    now = amz_date or datetime.now(timezone.utc).strftime("%Y%m%dT%H%M%SZ")
+    date = now[:8]
+    headers = {k.lower(): v for k, v in headers.items()}
+    headers["x-amz-date"] = now
+    if payload_hash is None:
+        payload_hash = (
+            UNSIGNED_PAYLOAD if payload is None
+            else hashlib.sha256(payload).hexdigest()
+        )
+    headers["x-amz-content-sha256"] = payload_hash
+    signed = sorted(h for h in headers if h == "host" or h.startswith("x-amz-")
+                    or h in ("content-type", "content-md5"))
+    scope = f"{date}/{region}/s3/aws4_request"
+    creq = canonical_request(method, path, canonical_query(query), headers,
+                             signed, payload_hash)
+    sts = string_to_sign(creq, now, scope)
+    sig = hmac.new(signing_key(secret_key, date, region), sts.encode(),
+                   hashlib.sha256).hexdigest()
+    headers["authorization"] = (
+        f"{ALGORITHM} Credential={access_key}/{scope}, "
+        f"SignedHeaders={';'.join(signed)}, Signature={sig}"
+    )
+    return headers
+
+
+def presign_url(method: str, host: str, path: str,
+                query: list[tuple[str, str]], access_key: str,
+                secret_key: str, expires: int = 3600,
+                region: str = "us-east-1") -> str:
+    now = datetime.now(timezone.utc).strftime("%Y%m%dT%H%M%SZ")
+    date = now[:8]
+    scope = f"{date}/{region}/s3/aws4_request"
+    q = list(query) + [
+        ("X-Amz-Algorithm", ALGORITHM),
+        ("X-Amz-Credential", f"{access_key}/{scope}"),
+        ("X-Amz-Date", now),
+        ("X-Amz-Expires", str(expires)),
+        ("X-Amz-SignedHeaders", "host"),
+    ]
+    creq = canonical_request(method, path, canonical_query(q),
+                             {"host": host}, ["host"], UNSIGNED_PAYLOAD)
+    sts = string_to_sign(creq, now, scope)
+    sig = hmac.new(signing_key(secret_key, date, region), sts.encode(),
+                   hashlib.sha256).hexdigest()
+    q.append(("X-Amz-Signature", sig))
+    qs = "&".join(f"{urllib.parse.quote(k, safe='')}={urllib.parse.quote(v, safe='')}"
+                  for k, v in q)
+    return f"http://{host}{urllib.parse.quote(path)}?{qs}"
+
+
+class Credentials:
+    def __init__(self, access_key: str, secret_key: str):
+        self.access_key = access_key
+        self.secret_key = secret_key
+
+
+MAX_CLOCK_SKEW_SECONDS = 15 * 60  # reference globalMaxSkewTime
+
+
+class V4Context:
+    """Verified-request context; carries what streaming-chunk verification
+    needs (reference: seed signature in newSignV4ChunkedReader)."""
+
+    def __init__(self, access_key: str, signing_key: bytes, seed_signature: str,
+                 amz_date: str, scope: str):
+        self.access_key = access_key
+        self.signing_key = signing_key
+        self.seed_signature = seed_signature
+        self.amz_date = amz_date
+        self.scope = scope
+
+
+def verify_v4(method: str, path: str, query: list[tuple[str, str]],
+              headers: dict[str, str], payload_hash_claim: str | None,
+              creds_lookup, region: str = "us-east-1") -> V4Context:
+    """Verify a header-signed request; returns the V4Context.
+
+    `creds_lookup(access_key) -> secret or None`.
+    Raises SigV4Error on any mismatch (reference doesSignatureMatch).
+    """
+    headers = {k.lower(): v for k, v in headers.items()}
+    auth = headers.get("authorization", "")
+    if not auth.startswith(ALGORITHM):
+        raise SigV4Error("AccessDenied", "unsupported authorization")
+    try:
+        fields = dict(
+            part.strip().split("=", 1)
+            for part in auth[len(ALGORITHM):].strip().split(",")
+        )
+        credential = fields["Credential"]
+        signed_headers = fields["SignedHeaders"].split(";")
+        got_sig = fields["Signature"]
+        access_key, date, cred_region, service, terminal = (
+            credential.split("/", 4)
+        )
+    except (KeyError, ValueError):
+        raise SigV4Error("AuthorizationHeaderMalformed", "bad auth header")
+    if service != "s3" or terminal != "aws4_request":
+        raise SigV4Error("AuthorizationHeaderMalformed", "bad credential scope")
+    if cred_region != region:
+        raise SigV4Error(
+            "AuthorizationHeaderMalformed", f"region must be {region}"
+        )
+    secret = creds_lookup(access_key)
+    if secret is None:
+        raise SigV4Error("InvalidAccessKeyId", "unknown access key")
+    amz_date = headers.get("x-amz-date", "")
+    if not amz_date:
+        raise SigV4Error("AccessDenied", "missing x-amz-date")
+    if amz_date[:8] != date:
+        raise SigV4Error("AccessDenied", "credential date mismatch")
+    try:
+        req_time = datetime.strptime(amz_date, "%Y%m%dT%H%M%SZ").replace(
+            tzinfo=timezone.utc
+        )
+    except ValueError:
+        raise SigV4Error("AccessDenied", "malformed x-amz-date")
+    skew = abs((datetime.now(timezone.utc) - req_time).total_seconds())
+    if skew > MAX_CLOCK_SKEW_SECONDS:
+        raise SigV4Error("RequestTimeTooSkewed", "request time skew too large")
+    payload_hash = payload_hash_claim or headers.get(
+        "x-amz-content-sha256", UNSIGNED_PAYLOAD
+    )
+    scope = f"{date}/{region}/s3/aws4_request"
+    creq = canonical_request(method, path, canonical_query(query), headers,
+                             signed_headers, payload_hash)
+    sts = string_to_sign(creq, amz_date, scope)
+    skey = signing_key(secret, date, region)
+    want = hmac.new(skey, sts.encode(), hashlib.sha256).hexdigest()
+    if not hmac.compare_digest(want, got_sig):
+        raise SigV4Error("SignatureDoesNotMatch", "signature mismatch")
+    return V4Context(access_key, skey, got_sig, amz_date, scope)
+
+
+def chunk_signature(signing_key_: bytes, prev_signature: str, amz_date: str,
+                    scope: str, chunk_sha256: str) -> str:
+    """Per-chunk signature for aws-chunked bodies
+    (reference getChunkSignature, cmd/streaming-signature-v4.go)."""
+    sts = "\n".join([
+        "AWS4-HMAC-SHA256-PAYLOAD", amz_date, scope, prev_signature,
+        EMPTY_SHA256, chunk_sha256,
+    ])
+    return hmac.new(signing_key_, sts.encode(), hashlib.sha256).hexdigest()
+
+
+def verify_v4_presigned(method: str, path: str,
+                        query: list[tuple[str, str]], headers: dict[str, str],
+                        creds_lookup, region: str = "us-east-1") -> str:
+    q = dict(query)
+    try:
+        credential = q["X-Amz-Credential"]
+        amz_date = q["X-Amz-Date"]
+        expires = int(q.get("X-Amz-Expires", "3600"))
+        signed_headers = q["X-Amz-SignedHeaders"].split(";")
+        got_sig = q["X-Amz-Signature"]
+        access_key, date, cred_region, service, terminal = credential.split("/", 4)
+    except (KeyError, ValueError):
+        raise SigV4Error("AuthorizationQueryParametersError", "bad query auth")
+    if service != "s3" or terminal != "aws4_request" or cred_region != region:
+        raise SigV4Error("AuthorizationQueryParametersError", "bad scope")
+    secret = creds_lookup(access_key)
+    if secret is None:
+        raise SigV4Error("InvalidAccessKeyId", "unknown access key")
+    try:
+        t = datetime.strptime(amz_date, "%Y%m%dT%H%M%SZ").replace(
+            tzinfo=timezone.utc
+        )
+    except ValueError:
+        raise SigV4Error("AuthorizationQueryParametersError", "bad date")
+    if (datetime.now(timezone.utc) - t).total_seconds() > expires:
+        raise SigV4Error("AccessDenied", "request has expired")
+    creq = canonical_request(
+        method, path,
+        canonical_query(query, skip={"X-Amz-Signature"}),
+        {k.lower(): v for k, v in headers.items()}, signed_headers,
+        q.get("X-Amz-Content-Sha256", UNSIGNED_PAYLOAD),
+    )
+    scope = f"{date}/{region}/s3/aws4_request"
+    sts = string_to_sign(creq, amz_date, scope)
+    skey = signing_key(secret, date, region)
+    want = hmac.new(skey, sts.encode(), hashlib.sha256).hexdigest()
+    if not hmac.compare_digest(want, got_sig):
+        raise SigV4Error("SignatureDoesNotMatch", "signature mismatch")
+    return V4Context(access_key, skey, got_sig, amz_date, scope)

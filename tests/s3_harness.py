@@ -1,0 +1,105 @@
+"""Sync HTTP test harness: boots the real S3 server on a localhost socket
+in a background thread (reference analogue: TestServer at
+cmd/test-utils_test.go:294)."""
+
+from __future__ import annotations
+
+import asyncio
+import http.client
+import threading
+import urllib.parse
+
+from minio_tpu.erasure.sets import ErasureSets, ErasureServerPools
+from minio_tpu.server import sigv4
+from minio_tpu.server.app import make_app
+from minio_tpu.storage.local import LocalStorage
+
+
+class Resp:
+    def __init__(self, status: int, headers: dict, body: bytes):
+        self.status = status
+        self.headers = headers
+        self.body = body
+
+    def text(self) -> str:
+        return self.body.decode(errors="replace")
+
+
+class S3TestServer:
+    def __init__(self, root: str, n_drives: int = 4,
+                 access_key: str = "testadmin", secret_key: str = "testsecret"):
+        self.ak, self.sk = access_key, secret_key
+        disks = [LocalStorage(f"{root}/d{i}") for i in range(n_drives)]
+        self.pools = ErasureServerPools([ErasureSets(disks)])
+        self.app = make_app(self.pools, access_key=access_key,
+                            secret_key=secret_key)
+        self._loop = asyncio.new_event_loop()
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        self._started.wait(10)
+
+    def _serve(self):
+        from aiohttp import web
+
+        asyncio.set_event_loop(self._loop)
+
+        async def start():
+            runner = web.AppRunner(self.app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            self.port = runner.addresses[0][1]
+            self._runner = runner
+            self._started.set()
+
+        self._loop.run_until_complete(start())
+        self._loop.run_forever()
+
+    def close(self):
+        async def stop():
+            await self._runner.cleanup()
+
+        fut = asyncio.run_coroutine_threadsafe(stop(), self._loop)
+        fut.result(10)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(10)
+
+    @property
+    def host(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def request(self, method: str, path: str, *, data: bytes | None = None,
+                query: list | None = None, headers: dict | None = None,
+                unsigned: bool = False) -> Resp:
+        query = list(query or [])
+        headers = dict(headers or {})
+        headers["host"] = self.host
+        if not unsigned:
+            headers = sigv4.sign_request(
+                method, urllib.parse.quote(path), query, headers,
+                data if data is not None else b"", self.ak, self.sk,
+            )
+        qs = "&".join(
+            f"{urllib.parse.quote(k, safe='')}={urllib.parse.quote(v, safe='')}"
+            for k, v in query
+        )
+        url = urllib.parse.quote(path) + ("?" + qs if qs else "")
+        conn = http.client.HTTPConnection("127.0.0.1", self.port, timeout=30)
+        try:
+            conn.request(method, url, body=data, headers=headers)
+            r = conn.getresponse()
+            body = r.read()
+            return Resp(r.status, dict(r.getheaders()), body)
+        finally:
+            conn.close()
+
+    def raw_request(self, method: str, path_qs: str, *, data=None,
+                    headers=None) -> Resp:
+        conn = http.client.HTTPConnection("127.0.0.1", self.port, timeout=30)
+        try:
+            conn.request(method, path_qs, body=data, headers=headers or {})
+            r = conn.getresponse()
+            return Resp(r.status, dict(r.getheaders()), r.read())
+        finally:
+            conn.close()
