@@ -1,0 +1,283 @@
+"""Distributed quorum RW locks (dsync).
+
+Equivalent of the reference's internal/dsync (DRWMutex at
+internal/dsync/drwmutex.go:64) + local locker (cmd/local-locker.go:53):
+a lock is acquired by winning n/2+1 of the cluster's lockers (read locks
+tolerate the same quorum, shared among readers); held locks are refreshed
+periodically and expire server-side when the owner dies, so crashed nodes
+cannot wedge the namespace (lock maintenance in cmd/lock-rest-server.go).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+
+from minio_tpu.storage import errors
+from .rpc import RpcClient, RpcRouter
+
+LOCK_TTL = 30.0          # server-side expiry without refresh
+REFRESH_INTERVAL = 10.0
+RETRY_DELAY = 0.05
+
+
+class LocalLocker:
+    """One node's lock table (cmd/local-locker.go)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        # name -> {"writer": uid|None, "readers": {uid}, "expiry": {uid: t}}
+        self._locks: dict[str, dict] = {}
+
+    def _entry(self, name: str) -> dict:
+        e = self._locks.get(name)
+        if e is None:
+            e = {"writer": None, "readers": set(), "expiry": {}}
+            self._locks[name] = e
+        return e
+
+    def _expire(self, e: dict) -> None:
+        now = time.time()
+        dead = [u for u, t in e["expiry"].items() if t < now]
+        for u in dead:
+            del e["expiry"][u]
+            if e["writer"] == u:
+                e["writer"] = None
+            e["readers"].discard(u)
+
+    def lock(self, name: str, uid: str) -> bool:
+        with self._mu:
+            e = self._entry(name)
+            self._expire(e)
+            if e["writer"] is None and not e["readers"]:
+                e["writer"] = uid
+                e["expiry"][uid] = time.time() + LOCK_TTL
+                return True
+            return e["writer"] == uid  # idempotent re-acquire
+
+    def rlock(self, name: str, uid: str) -> bool:
+        with self._mu:
+            e = self._entry(name)
+            self._expire(e)
+            if e["writer"] is None:
+                e["readers"].add(uid)
+                e["expiry"][uid] = time.time() + LOCK_TTL
+                return True
+            return False
+
+    def unlock(self, name: str, uid: str) -> bool:
+        with self._mu:
+            e = self._locks.get(name)
+            if e is None:
+                return False
+            if e["writer"] == uid:
+                e["writer"] = None
+            e["readers"].discard(uid)
+            e["expiry"].pop(uid, None)
+            if e["writer"] is None and not e["readers"]:
+                self._locks.pop(name, None)
+            return True
+
+    def refresh(self, name: str, uid: str) -> bool:
+        with self._mu:
+            e = self._locks.get(name)
+            if e is None or uid not in e["expiry"]:
+                return False
+            e["expiry"][uid] = time.time() + LOCK_TTL
+            return True
+
+    def force_unlock(self, name: str) -> bool:
+        with self._mu:
+            return self._locks.pop(name, None) is not None
+
+    def top_locks(self) -> list[dict]:
+        with self._mu:
+            out = []
+            for name, e in self._locks.items():
+                self._expire(e)
+                out.append({
+                    "name": name, "writer": e["writer"],
+                    "readers": sorted(e["readers"]),
+                })
+            return out
+
+
+def register_lock_rpc(router: RpcRouter, locker: LocalLocker) -> None:
+    router.register("lock.lock",
+                    lambda a, b: {"ok": locker.lock(a["name"], a["uid"])})
+    router.register("lock.rlock",
+                    lambda a, b: {"ok": locker.rlock(a["name"], a["uid"])})
+    router.register("lock.unlock",
+                    lambda a, b: {"ok": locker.unlock(a["name"], a["uid"])})
+    router.register("lock.refresh",
+                    lambda a, b: {"ok": locker.refresh(a["name"], a["uid"])})
+    router.register("lock.force_unlock",
+                    lambda a, b: {"ok": locker.force_unlock(a["name"])})
+    router.register("lock.top", lambda a, b: {"locks": locker.top_locks()})
+
+
+class _LocalLockerClient:
+    """In-process adapter so the local node participates without HTTP."""
+
+    def __init__(self, locker: LocalLocker):
+        self.locker = locker
+
+    def call(self, method: str, args: dict):
+        op = method.split(".", 1)[1]
+        fn = {
+            "lock": lambda: self.locker.lock(args["name"], args["uid"]),
+            "rlock": lambda: self.locker.rlock(args["name"], args["uid"]),
+            "unlock": lambda: self.locker.unlock(args["name"], args["uid"]),
+            "refresh": lambda: self.locker.refresh(args["name"], args["uid"]),
+        }[op]
+        return {"ok": fn()}
+
+    def is_online(self) -> bool:
+        return True
+
+
+class DRWMutex:
+    """Quorum RW mutex over a set of lockers (drwmutex.go:64)."""
+
+    def __init__(self, name: str, clients: list, timeout: float = 30.0):
+        self.name = name
+        self.clients = clients
+        self.timeout = timeout
+        self.uid = ""
+        self._refresher: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._is_read = False
+        # set when the refresh loop loses quorum: the lock may have been
+        # granted to someone else (the reference cancels the operation's
+        # context in this case, drwmutex.go:221)
+        self.lost = threading.Event()
+
+    @property
+    def quorum(self) -> int:
+        """Write quorum: strict majority (drwmutex.go dquorum)."""
+        return len(self.clients) // 2 + 1
+
+    @property
+    def read_quorum(self) -> int:
+        """Read quorum: half is enough — read locks are shared, so two
+        disjoint halves both holding read locks is consistent
+        (drwmutex.go dquorumReads)."""
+        return max(1, len(self.clients) // 2)
+
+    def _broadcast(self, op: str, uid: str) -> int:
+        ok = 0
+        for c in self.clients:
+            try:
+                r = c.call(f"lock.{op}", {"name": self.name, "uid": uid})
+                if r and r.get("ok"):
+                    ok += 1
+            except Exception:
+                continue
+        return ok
+
+    def _acquire(self, op: str) -> bool:
+        deadline = time.time() + self.timeout
+        uid = str(uuid.uuid4())
+        need = self.read_quorum if op == "rlock" else self.quorum
+        while time.time() < deadline:
+            got = self._broadcast(op, uid)
+            if got >= need:
+                self.uid = uid
+                self._is_read = op == "rlock"
+                self._need = need
+                self._start_refresher()
+                return True
+            # failed: release whatever we got, back off, retry
+            self._broadcast("unlock", uid)
+            time.sleep(RETRY_DELAY)
+        return False
+
+    def lock(self) -> None:
+        if not self._acquire("lock"):
+            raise errors.StorageError(f"lock timeout on {self.name}")
+
+    def rlock(self) -> None:
+        if not self._acquire("rlock"):
+            raise errors.StorageError(f"rlock timeout on {self.name}")
+
+    def unlock(self) -> None:
+        self._stop_refresher()
+        if self.uid:
+            self._broadcast("unlock", self.uid)
+            self.uid = ""
+
+    # -- refresh loop (drwmutex.go:221 startContinuousLockRefresh) ----------
+    def _start_refresher(self) -> None:
+        self._stop.clear()
+        t = threading.Thread(target=self._refresh_loop, daemon=True)
+        t.start()
+        self._refresher = t
+
+    def _stop_refresher(self) -> None:
+        self._stop.set()
+
+    def _refresh_loop(self) -> None:
+        uid = self.uid
+        need = getattr(self, "_need", self.quorum)
+        while not self._stop.wait(REFRESH_INTERVAL):
+            ok = self._broadcast("refresh", uid)
+            if ok < need:
+                # lost the lock (e.g. partition or force-unlock): flag it so
+                # the operation holding us can abort instead of silently
+                # racing the next owner
+                self.lost.set()
+                return
+
+    # context helpers
+    def __enter__(self):
+        self.lock()
+        return self
+
+    def __exit__(self, *a):
+        self.unlock()
+        return False
+
+
+class DistributedNamespaceLock:
+    """Drop-in for erasure.objects.NamespaceLock backed by dsync quorum.
+
+    write(key)/read(key) context managers acquire cluster-wide locks
+    (reference nsLockMap with distributed lockers,
+    cmd/namespace-lock.go:86)."""
+
+    def __init__(self, clients_factory, prefix: str = ""):
+        """clients_factory() -> list of lock RPC clients (incl. local)."""
+        self._factory = clients_factory
+        self.prefix = prefix
+
+    def _mutex(self, key: str) -> DRWMutex:
+        return DRWMutex(f"{self.prefix}{key}", self._factory())
+
+    class _Ctx:
+        def __init__(self, m: DRWMutex, write: bool):
+            self.m, self.write = m, write
+
+        def __enter__(self):
+            if self.write:
+                self.m.lock()
+            else:
+                self.m.rlock()
+            return self
+
+        def __exit__(self, exc_type, exc, tb):
+            lost = self.m.lost.is_set()
+            self.m.unlock()
+            if lost and exc_type is None and self.write:
+                # the write lock expired mid-operation: the result may race
+                # another owner — surface it rather than report success
+                raise errors.StorageError(
+                    f"write lock on {self.m.name} lost during operation"
+                )
+            return False
+
+    def write(self, key: str):
+        return self._Ctx(self._mutex(key), True)
+
+    def read(self, key: str):
+        return self._Ctx(self._mutex(key), False)

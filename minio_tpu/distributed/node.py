@@ -1,0 +1,179 @@
+"""Symmetric cluster node: S3 front end + internode RPC planes.
+
+Equivalent of the reference's distributed serverMain wiring
+(cmd/routers.go:27 registerDistErasureRouters + cmd/server-main.go): every
+node runs the same process, serves its local drives to peers over the
+storage RPC plane, participates in dsync locking, and answers S3 on the
+same port.  Endpoints are symmetric URL patterns like
+`http://host:port/path/d{1...4}`; a node recognises its own drives by
+host:port match.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+import urllib.parse
+import uuid
+
+from minio_tpu.erasure.sets import ErasureSets, ErasureServerPools
+from minio_tpu.server.app import S3Server
+from minio_tpu.storage import errors
+from minio_tpu.storage.local import LocalStorage
+from .dsync import (
+    DistributedNamespaceLock, LocalLocker, _LocalLockerClient,
+    register_lock_rpc,
+)
+from .rpc import RpcClient, RpcRouter
+from .storage_rpc import RemoteStorage, register_storage_rpc
+
+
+def expand_ellipses(pattern: str) -> list[str]:
+    m = re.search(r"\{(\d+)\.\.\.(\d+)\}", pattern)
+    if not m:
+        return [pattern]
+    lo, hi = int(m.group(1)), int(m.group(2))
+    if hi < lo:
+        raise ValueError(f"bad ellipses range in {pattern}")
+    out = []
+    for i in range(lo, hi + 1):
+        out.extend(expand_ellipses(pattern[: m.start()] + str(i) + pattern[m.end():]))
+    return out
+
+
+def parse_endpoint(ep: str) -> tuple[str | None, int | None, str]:
+    """-> (host, port, path); host None for plain local paths."""
+    if ep.startswith(("http://", "https://")):
+        u = urllib.parse.urlparse(ep)
+        return u.hostname, u.port or 9000, u.path
+    return None, None, ep
+
+
+def _local_host_addrs() -> set[str]:
+    """Hostnames/IPs that mean 'this machine' (reference: set of interface
+    addresses, cmd/endpoint.go)."""
+    import socket
+
+    addrs = {"127.0.0.1", "localhost", "::1"}
+    try:
+        hostname = socket.gethostname()
+        addrs.add(hostname)
+        for info in socket.getaddrinfo(hostname, None):
+            addrs.add(info[4][0])
+    except OSError:
+        pass
+    return addrs
+
+
+def _host_is_me(host: str | None, my_host: str | None,
+                local_addrs: set[str]) -> bool:
+    if host is None:
+        return True
+    if my_host not in (None, "", "0.0.0.0", "::"):
+        if host == my_host:
+            return True
+    # wildcard bind (or alias): local only if the host resolves to us
+    if host in local_addrs:
+        return True
+    try:
+        import socket
+
+        return socket.gethostbyname(host) in local_addrs
+    except OSError:
+        return False
+
+
+class ClusterNode:
+    """One node of a (possibly single-node) deployment."""
+
+    def __init__(self, endpoints: list[str], my_address: str = "",
+                 access_key: str = "minioadmin", secret_key: str = "minioadmin",
+                 region: str = "us-east-1", set_size: int | None = None):
+        self.secret = secret_key
+        expanded: list[tuple[str | None, int | None, str]] = []
+        for ep in endpoints:
+            for e in expand_ellipses(ep):
+                expanded.append(parse_endpoint(e))
+        my_host, my_port = None, None
+        if my_address:
+            h, p = my_address.rsplit(":", 1)
+            my_host, my_port = h, int(p)
+
+        # deterministic deployment id so all nodes agree without consensus
+        dep_id = str(uuid.UUID(bytes=hashlib.md5(
+            ",".join(f"{h}:{p}{path}" for h, p, path in expanded).encode()
+        ).digest()))
+
+        self.local_drives: dict[str, LocalStorage] = {}
+        self.peer_clients: dict[str, RpcClient] = {}
+        disks = []
+        n_nodes = set()
+        local_addrs = _local_host_addrs()
+        for host, port, path in expanded:
+            is_local = host is None or (
+                port == my_port and _host_is_me(host, my_host, local_addrs)
+            )
+            n_nodes.add((host, port))
+            if is_local:
+                d = LocalStorage(path, endpoint=f"{host}:{port}{path}"
+                                 if host else path)
+                self.local_drives[path] = d
+                disks.append(d)
+            else:
+                key = f"{host}:{port}"
+                client = self.peer_clients.get(key)
+                if client is None:
+                    client = RpcClient(host, port, secret_key)
+                    self.peer_clients[key] = client
+                disks.append(RemoteStorage(client, path))
+
+        self.locker = LocalLocker()
+        self.distributed = len(n_nodes) > 1
+        if self.distributed:
+            def lock_clients():
+                return [_LocalLockerClient(self.locker)] + list(
+                    self.peer_clients.values()
+                )
+            ns_lock = DistributedNamespaceLock(lock_clients)
+        else:
+            ns_lock = None
+
+        sets = ErasureSets(disks, set_size=set_size, deployment_id=dep_id,
+                           ns_lock=ns_lock)
+        self.pools = ErasureServerPools([sets])
+
+        self.s3 = S3Server(self.pools, access_key=access_key,
+                           secret_key=secret_key, region=region)
+        self.app = self.s3.app
+        self.router = RpcRouter(secret_key)
+        register_storage_rpc(self.router, self.local_drives)
+        register_lock_rpc(self.router, self.locker)
+        self.router.register("peer.info", self._peer_info)
+        self.router.mount(self.app)
+        # format bootstrap probes peers before their servers are up; reset
+        # the health cache so the first real use re-probes immediately
+        for c in self.peer_clients.values():
+            c._last_check = 0.0
+
+    def _peer_info(self, args, body) -> dict:
+        return {
+            "drives": sorted(self.local_drives),
+            "deployment_id": self.pools.pools[0].deployment_id,
+        }
+
+    def verify_cluster(self) -> list[str]:
+        """Bootstrap config consistency check across peers
+        (cmd/bootstrap-peer-server.go:129)."""
+        problems = []
+        my_dep = self.pools.pools[0].deployment_id
+        for key, client in self.peer_clients.items():
+            try:
+                info = client.call("peer.info", {})
+                if info["deployment_id"] != my_dep:
+                    problems.append(
+                        f"{key}: deployment id mismatch "
+                        f"{info['deployment_id']} != {my_dep}"
+                    )
+            except Exception as e:
+                problems.append(f"{key}: unreachable ({e})")
+        return problems

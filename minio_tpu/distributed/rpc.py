@@ -1,0 +1,250 @@
+"""Internode RPC plumbing: msgpack-over-HTTP with HMAC auth.
+
+Equivalent of the reference's generic REST RPC client/server
+(internal/rest/client.go:76, JWT auth at cmd/jwt.go): every remote-drive,
+lock, and peer call is an HTTP POST of msgpack-encoded args to
+`/minio_tpu/<plane>/v1/<method>`, authenticated with an HMAC token derived
+from the cluster credentials.  Clients track peer health with a background
+probe and mark endpoints offline/online (internal/rest/client.go:219).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import http.client
+import threading
+import time
+import urllib.parse
+
+import msgpack
+
+from minio_tpu.storage import errors
+
+RPC_PREFIX = "/minio_tpu/rpc/v1"
+HEALTH_INTERVAL = 5.0
+
+# exception class name <-> type, for transporting storage errors
+_ERR_TYPES = {
+    cls.__name__: cls
+    for cls in vars(errors).values()
+    if isinstance(cls, type) and issubclass(cls, Exception)
+}
+
+
+def auth_token(secret: str) -> str:
+    day = int(time.time() // 86400)
+    return hmac.new(secret.encode(), f"minio-tpu-rpc:{day}".encode(),
+                    hashlib.sha256).hexdigest()
+
+
+def check_token(secret: str, token: str) -> bool:
+    day = int(time.time() // 86400)
+    for d in (day, day - 1):
+        want = hmac.new(secret.encode(), f"minio-tpu-rpc:{d}".encode(),
+                        hashlib.sha256).hexdigest()
+        if hmac.compare_digest(want, token):
+            return True
+    return False
+
+
+def pack_error(e: Exception) -> dict:
+    return {"__err__": type(e).__name__, "msg": str(e)}
+
+
+def unpack_error(doc: dict) -> Exception:
+    cls = _ERR_TYPES.get(doc.get("__err__", ""), errors.StorageError)
+    return cls(doc.get("msg", ""))
+
+
+class RpcClient:
+    """Sync msgpack RPC client for one peer endpoint (host:port)."""
+
+    def __init__(self, host: str, port: int, secret: str, timeout: float = 30.0):
+        self.host = host
+        self.port = port
+        self.secret = secret
+        self.timeout = timeout
+        self._online = True
+        self._last_check = 0.0
+        self._lock = threading.Lock()
+        self._pool: list = []  # idle keep-alive connections
+
+    def _get_conn(self):
+        with self._lock:
+            if self._pool:
+                return self._pool.pop()
+        return http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout)
+
+    def _put_conn(self, conn) -> None:
+        with self._lock:
+            if len(self._pool) < 8:
+                self._pool.append(conn)
+                return
+        conn.close()
+
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # -- health -------------------------------------------------------------
+    def is_online(self) -> bool:
+        # positive results cached HEALTH_INTERVAL; negative ones retried
+        # quickly so a peer coming up is noticed promptly (the reference's
+        # reconnect loop, internal/rest/client.go:219)
+        now = time.time()
+        with self._lock:
+            ttl = HEALTH_INTERVAL if self._online else 0.25
+            if now - self._last_check < ttl:
+                return self._online
+            self._last_check = now
+        try:
+            self.call("health.ping", {})
+            ok = True
+        except errors.StorageError:
+            ok = True  # RPC-level error still proves liveness
+        except Exception:
+            ok = False
+        with self._lock:
+            self._online = ok
+        return ok
+
+    def mark_offline(self) -> None:
+        with self._lock:
+            self._online = False
+            self._last_check = time.time()
+
+    def _mark_online(self) -> None:
+        with self._lock:
+            if not self._online:
+                self._online = True
+                self._last_check = time.time()
+
+    # -- calls --------------------------------------------------------------
+    def call(self, method: str, args: dict, body: bytes = b"",
+             want_stream: bool = False):
+        """POST args (+ raw body tail); returns decoded result (or a
+        response object for streaming reads)."""
+        payload = msgpack.packb(args, use_bin_type=True)
+        # one retry on a stale pooled connection
+        for attempt in (0, 1):
+            conn = self._get_conn()
+            try:
+                path = f"{RPC_PREFIX}/{urllib.parse.quote(method)}"
+                conn.putrequest("POST", path)
+                conn.putheader("x-minio-tpu-token", auth_token(self.secret))
+                conn.putheader("x-args-length", str(len(payload)))
+                conn.putheader("Content-Length", str(len(payload) + len(body)))
+                conn.endheaders()
+                conn.send(payload)
+                if body:
+                    conn.send(body)
+                resp = conn.getresponse()
+            except (OSError, http.client.HTTPException) as e:
+                conn.close()
+                if attempt == 0:
+                    continue  # stale keep-alive connection; retry fresh
+                self.mark_offline()
+                raise errors.DiskNotFound(f"rpc {method}: {e}")
+            self._mark_online()  # any HTTP response proves liveness
+            if resp.status != 200:
+                data = resp.read()
+                self._put_conn(conn)
+                try:
+                    doc = msgpack.unpackb(data, raw=False)
+                    raise unpack_error(doc)
+                except (ValueError, msgpack.UnpackException):
+                    raise errors.DiskNotFound(
+                        f"rpc {method} -> HTTP {resp.status}"
+                    )
+            if want_stream:
+                return _StreamResponse(conn, resp)  # conn not pooled
+            data = resp.read()
+            self._put_conn(conn)
+            if not data:
+                return None
+            return msgpack.unpackb(data, raw=False)
+
+
+class _StreamResponse:
+    """File-like over a streaming RPC response body."""
+
+    def __init__(self, conn, resp):
+        self.conn = conn
+        self.resp = resp
+
+    def read(self, n: int = -1) -> bytes:
+        return self.resp.read() if n < 0 else self.resp.read(n)
+
+    def close(self) -> None:
+        try:
+            self.resp.close()
+        finally:
+            self.conn.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+        return False
+
+
+class RpcRouter:
+    """Server side: method registry mounted into the aiohttp app."""
+
+    def __init__(self, secret: str):
+        self.secret = secret
+        self.methods: dict = {"health.ping": lambda args, body: {}}
+
+    def register(self, name: str, fn) -> None:
+        """fn(args: dict, body: bytes) -> result dict | (headers, byte-iter)"""
+        self.methods[name] = fn
+
+    def mount(self, app) -> None:
+        from aiohttp import web
+
+        async def handler(request: web.Request) -> web.StreamResponse:
+            token = request.headers.get("x-minio-tpu-token", "")
+            if not check_token(self.secret, token):
+                return web.Response(status=403)
+            method = request.match_info["method"]
+            fn = self.methods.get(method)
+            if fn is None:
+                return web.Response(status=404)
+            raw = await request.read()
+            args_len = int(request.headers.get("x-args-length", len(raw)))
+            args = msgpack.unpackb(raw[:args_len], raw=False) if args_len else {}
+            body = raw[args_len:]
+            import asyncio
+            loop = asyncio.get_running_loop()
+            try:
+                result = await loop.run_in_executor(None, fn, args, body)
+            except Exception as e:
+                return web.Response(
+                    status=500, body=msgpack.packb(pack_error(e))
+                )
+            if isinstance(result, StreamResult):
+                resp = web.StreamResponse(status=200)
+                await resp.prepare(request)
+                it = iter(result.chunks)
+                while True:
+                    chunk = await loop.run_in_executor(None, next, it, None)
+                    if chunk is None:
+                        break
+                    await resp.write(chunk)
+                await resp.write_eof()
+                return resp
+            return web.Response(
+                status=200,
+                body=msgpack.packb(result, use_bin_type=True) if result is not None else b"",
+            )
+
+        app.router.add_post(RPC_PREFIX + "/{method}", handler)
+
+
+class StreamResult:
+    """Marker for streaming byte responses from an RPC method."""
+
+    def __init__(self, chunks):
+        self.chunks = chunks

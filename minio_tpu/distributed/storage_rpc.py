@@ -1,0 +1,371 @@
+"""Remote-drive data plane: StorageAPI over internode RPC.
+
+Equivalent of the reference's storage REST server/client
+(cmd/storage-rest-server.go:1209, cmd/storage-rest-client.go): every
+StorageAPI method of a node's local drives is callable by peers; shard
+streams travel as HTTP bodies.  The RemoteStorage client satisfies the
+same StorageAPI contract as LocalStorage, so erasure sets compose local
+and remote drives transparently.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import BinaryIO, Iterator
+
+from minio_tpu.storage import errors
+from minio_tpu.storage.api import DiskInfo, StorageAPI, VolInfo
+from minio_tpu.storage.local import LocalStorage
+from minio_tpu.storage.xlmeta import FileInfo
+from .rpc import RpcClient, RpcRouter, StreamResult
+
+_CHUNK = 1 << 20
+
+
+def _fi_to_wire(fi: FileInfo) -> dict:
+    d = fi.to_obj()
+    d["__vol"] = fi.volume
+    d["__name"] = fi.name
+    return d
+
+
+def _fi_from_wire(d: dict) -> FileInfo:
+    fi = FileInfo.from_obj(d.get("__vol", ""), d.get("__name", ""), d)
+    return fi
+
+
+def register_storage_rpc(router: RpcRouter, drives: dict[str, LocalStorage]) -> None:
+    """Expose `drives` (keyed by drive path/id) on the RPC router."""
+
+    def drive(args) -> LocalStorage:
+        d = drives.get(args["drive"])
+        if d is None:
+            raise errors.DiskNotFound(args.get("drive", "?"))
+        return d
+
+    def h(name):
+        def deco(fn):
+            router.register(f"storage.{name}", fn)
+            return fn
+        return deco
+
+    @h("disk_info")
+    def _disk_info(args, body):
+        di = drive(args).disk_info()
+        return {"total": di.total, "free": di.free, "used": di.used,
+                "healing": di.healing, "endpoint": di.endpoint, "id": di.id}
+
+    @h("make_volume")
+    def _make_volume(args, body):
+        drive(args).make_volume(args["volume"])
+
+    @h("list_volumes")
+    def _list_volumes(args, body):
+        return [{"name": v.name, "created": v.created}
+                for v in drive(args).list_volumes()]
+
+    @h("stat_volume")
+    def _stat_volume(args, body):
+        v = drive(args).stat_volume(args["volume"])
+        return {"name": v.name, "created": v.created}
+
+    @h("delete_volume")
+    def _delete_volume(args, body):
+        drive(args).delete_volume(args["volume"], args.get("force", False))
+
+    @h("read_all")
+    def _read_all(args, body):
+        return {"data": drive(args).read_all(args["volume"], args["path"])}
+
+    @h("write_all")
+    def _write_all(args, body):
+        drive(args).write_all(args["volume"], args["path"], body)
+
+    @h("delete")
+    def _delete(args, body):
+        drive(args).delete(args["volume"], args["path"],
+                           args.get("recursive", False))
+
+    @h("rename_file")
+    def _rename_file(args, body):
+        drive(args).rename_file(args["src_volume"], args["src_path"],
+                                args["dst_volume"], args["dst_path"])
+
+    @h("create_file")
+    def _create_file(args, body):
+        drive(args).create_file(args["volume"], args["path"], len(body),
+                                io.BytesIO(body))
+
+    @h("append_file")
+    def _append_file(args, body):
+        drive(args).append_file(args["volume"], args["path"], body,
+                                args.get("append", True))
+
+    @h("read_file_stream")
+    def _read_file_stream(args, body):
+        f = drive(args).read_file_stream(
+            args["volume"], args["path"], args["offset"], args["length"]
+        )
+
+        def chunks():
+            remaining = args["length"] if args["length"] >= 0 else None
+            try:
+                while True:
+                    want = _CHUNK if remaining is None else min(_CHUNK, remaining)
+                    if want == 0:
+                        break
+                    data = f.read(want)
+                    if not data:
+                        break
+                    if remaining is not None:
+                        remaining -= len(data)
+                    yield data
+            finally:
+                f.close()
+
+        return StreamResult(chunks())
+
+    @h("read_version")
+    def _read_version(args, body):
+        fi = drive(args).read_version(
+            args["volume"], args["path"], args.get("version_id", ""),
+            args.get("read_data", False),
+        )
+        return _fi_to_wire(fi)
+
+    @h("read_xl")
+    def _read_xl(args, body):
+        return {"data": drive(args).read_xl(args["volume"], args["path"])}
+
+    @h("write_metadata")
+    def _write_metadata(args, body):
+        drive(args).write_metadata(args["volume"], args["path"],
+                                   _fi_from_wire(args["fi"]))
+
+    @h("update_metadata")
+    def _update_metadata(args, body):
+        drive(args).update_metadata(args["volume"], args["path"],
+                                    _fi_from_wire(args["fi"]))
+
+    @h("delete_version")
+    def _delete_version(args, body):
+        drive(args).delete_version(args["volume"], args["path"],
+                                   _fi_from_wire(args["fi"]),
+                                   args.get("force_del_marker", False))
+
+    @h("rename_data")
+    def _rename_data(args, body):
+        drive(args).rename_data(args["src_volume"], args["src_path"],
+                                _fi_from_wire(args["fi"]),
+                                args["dst_volume"], args["dst_path"])
+
+    @h("list_dir")
+    def _list_dir(args, body):
+        return {"entries": drive(args).list_dir(
+            args["volume"], args.get("path", ""), args.get("count", -1)
+        )}
+
+    @h("walk_dir")
+    def _walk_dir(args, body):
+        return {"entries": list(drive(args).walk_dir(
+            args["volume"], args.get("base", ""), args.get("recursive", True)
+        ))}
+
+    @h("verify_file")
+    def _verify_file(args, body):
+        drive(args).verify_file(args["volume"], args["path"],
+                                _fi_from_wire(args["fi"]))
+
+    @h("check_parts")
+    def _check_parts(args, body):
+        drive(args).check_parts(args["volume"], args["path"],
+                                _fi_from_wire(args["fi"]))
+
+
+class _RemoteWriter(io.RawIOBase):
+    """Buffers writes, ships whole file on close (small control files) or
+    appends in chunks (shard streams)."""
+
+    def __init__(self, client: RpcClient, drive_id: str, volume: str, path: str):
+        self.client = client
+        self.args = {"drive": drive_id, "volume": volume, "path": path}
+        self.buf = bytearray()
+        self.first = True
+        self.closed_ = False
+
+    def write(self, data) -> int:
+        # normalise numpy shard slices: bytearray += ndarray would trigger
+        # numpy broadcasting instead of byte append
+        if not isinstance(data, (bytes, bytearray, memoryview)):
+            data = bytes(data)
+        self.buf += data
+        if len(self.buf) >= 4 * _CHUNK:
+            self._flush()
+        return len(data)
+
+    def _flush(self) -> None:
+        if self.buf or self.first:
+            self.client.call(
+                "storage.append_file",
+                {**self.args, "append": not self.first},
+                bytes(self.buf),
+            )
+            self.buf.clear()
+            self.first = False
+
+    def close(self) -> None:
+        if not self.closed_:
+            self._flush()
+            self.closed_ = True
+
+
+class RemoteStorage(StorageAPI):
+    """StorageAPI client for one drive on a peer node."""
+
+    def __init__(self, client: RpcClient, drive_id: str):
+        self.client = client
+        self.drive = drive_id
+        self._disk_id = ""
+
+    def _call(self, method: str, args: dict | None = None, body: bytes = b"",
+              want_stream: bool = False):
+        a = {"drive": self.drive}
+        if args:
+            a.update(args)
+        return self.client.call(f"storage.{method}", a, body, want_stream)
+
+    # identity / health
+    def disk_id(self) -> str:
+        return self._disk_id
+
+    def set_disk_id(self, disk_id: str) -> None:
+        self._disk_id = disk_id
+
+    def is_online(self) -> bool:
+        return self.client.is_online()
+
+    def is_local(self) -> bool:
+        return False
+
+    def endpoint(self) -> str:
+        return f"{self.client.endpoint()}/{self.drive}"
+
+    def disk_info(self) -> DiskInfo:
+        d = self._call("disk_info")
+        return DiskInfo(total=d["total"], free=d["free"], used=d["used"],
+                        healing=d["healing"], endpoint=self.endpoint(),
+                        id=d["id"])
+
+    # volumes
+    def make_volume(self, volume: str) -> None:
+        self._call("make_volume", {"volume": volume})
+
+    def list_volumes(self) -> list[VolInfo]:
+        return [VolInfo(v["name"], v["created"])
+                for v in self._call("list_volumes")]
+
+    def stat_volume(self, volume: str) -> VolInfo:
+        v = self._call("stat_volume", {"volume": volume})
+        return VolInfo(v["name"], v["created"])
+
+    def delete_volume(self, volume: str, force: bool = False) -> None:
+        self._call("delete_volume", {"volume": volume, "force": force})
+
+    # flat files
+    def read_all(self, volume: str, path: str) -> bytes:
+        return self._call("read_all", {"volume": volume, "path": path})["data"]
+
+    def write_all(self, volume: str, path: str, data: bytes) -> None:
+        self._call("write_all", {"volume": volume, "path": path}, data)
+
+    def delete(self, volume: str, path: str, recursive: bool = False) -> None:
+        self._call("delete", {"volume": volume, "path": path,
+                              "recursive": recursive})
+
+    def rename_file(self, src_volume: str, src_path: str,
+                    dst_volume: str, dst_path: str) -> None:
+        self._call("rename_file", {
+            "src_volume": src_volume, "src_path": src_path,
+            "dst_volume": dst_volume, "dst_path": dst_path,
+        })
+
+    # shard files
+    def create_file(self, volume: str, path: str, size: int,
+                    reader: BinaryIO) -> None:
+        w = self.open_file_writer(volume, path)
+        while True:
+            chunk = reader.read(_CHUNK)
+            if not chunk:
+                break
+            w.write(chunk)
+        w.close()
+
+    def open_file_writer(self, volume: str, path: str) -> BinaryIO:
+        return _RemoteWriter(self.client, self.drive, volume, path)
+
+    def read_file_stream(self, volume: str, path: str, offset: int,
+                         length: int) -> BinaryIO:
+        return self._call(
+            "read_file_stream",
+            {"volume": volume, "path": path, "offset": offset,
+             "length": length},
+            want_stream=True,
+        )
+
+    def read_file(self, volume: str, path: str, offset: int,
+                  buf_size: int) -> bytes:
+        with self.read_file_stream(volume, path, offset, buf_size) as f:
+            return f.read(buf_size)
+
+    # metadata
+    def read_version(self, volume: str, path: str, version_id: str = "",
+                     read_data: bool = False) -> FileInfo:
+        return _fi_from_wire(self._call("read_version", {
+            "volume": volume, "path": path, "version_id": version_id,
+            "read_data": read_data,
+        }))
+
+    def read_xl(self, volume: str, path: str) -> bytes:
+        return self._call("read_xl", {"volume": volume, "path": path})["data"]
+
+    def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
+        self._call("write_metadata", {"volume": volume, "path": path,
+                                      "fi": _fi_to_wire(fi)})
+
+    def update_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
+        self._call("update_metadata", {"volume": volume, "path": path,
+                                       "fi": _fi_to_wire(fi)})
+
+    def delete_version(self, volume: str, path: str, fi: FileInfo,
+                       force_del_marker: bool = False) -> None:
+        self._call("delete_version", {
+            "volume": volume, "path": path, "fi": _fi_to_wire(fi),
+            "force_del_marker": force_del_marker,
+        })
+
+    def rename_data(self, src_volume: str, src_path: str, fi: FileInfo,
+                    dst_volume: str, dst_path: str) -> None:
+        self._call("rename_data", {
+            "src_volume": src_volume, "src_path": src_path,
+            "fi": _fi_to_wire(fi), "dst_volume": dst_volume,
+            "dst_path": dst_path,
+        })
+
+    # listing / verification
+    def list_dir(self, volume: str, path: str, count: int = -1) -> list[str]:
+        return self._call("list_dir", {"volume": volume, "path": path,
+                                       "count": count})["entries"]
+
+    def walk_dir(self, volume: str, base: str = "",
+                 recursive: bool = True) -> Iterator[str]:
+        yield from self._call("walk_dir", {
+            "volume": volume, "base": base, "recursive": recursive
+        })["entries"]
+
+    def verify_file(self, volume: str, path: str, fi: FileInfo) -> None:
+        self._call("verify_file", {"volume": volume, "path": path,
+                                   "fi": _fi_to_wire(fi)})
+
+    def check_parts(self, volume: str, path: str, fi: FileInfo) -> None:
+        self._call("check_parts", {"volume": volume, "path": path,
+                                   "fi": _fi_to_wire(fi)})

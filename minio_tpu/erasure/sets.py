@@ -71,13 +71,13 @@ class ErasureSets:
 
     def __init__(self, disks: Sequence[StorageAPI], set_size: int | None = None,
                  deployment_id: str | None = None, pool_index: int = 0,
-                 default_parity: int | None = None):
+                 default_parity: int | None = None, ns_lock=None):
         self.all_disks = list(disks)
         self.set_count, self.set_drive_count = choose_set_layout(
             len(self.all_disks), set_size
         )
         self.deployment_id = self._init_format(deployment_id)
-        self.ns = NamespaceLock()
+        self.ns = ns_lock if ns_lock is not None else NamespaceLock()
         parity = (default_parity if default_parity is not None
                   else default_parity_count(self.set_drive_count))
         self.sets: list[ErasureObjects] = []
@@ -108,6 +108,11 @@ class ErasureSets:
             ]
             for idx, d in enumerate(self.all_disks):
                 if d not in unformatted:
+                    continue
+                if not d.is_local():
+                    # a peer's drive: its owning node formats it (the
+                    # deployment id is deterministic across nodes, so the
+                    # results agree — waitForFormatErasure analogue)
                     continue
                 s, i = divmod(idx, self.set_drive_count)
                 this = layout[s][i]

@@ -1,38 +1,29 @@
-"""Server CLI: `python -m minio_tpu.server DIR1 DIR2 ... [options]`.
+"""Server CLI: `python -m minio_tpu.server ENDPOINT... [options]`.
 
-Equivalent of `minio server DIR{1...N}` (cmd/server-main.go:422): boots the
-erasure object layer over the given drive directories and serves the S3
-API.  Supports `{1...N}` ellipses expansion and multiple pools separated
-by repetition of drive groups.
+Equivalent of `minio server` (cmd/server-main.go:422).  Endpoints are
+drive dirs or `{1...N}` ellipses patterns; with `http://host:port/path`
+endpoints the node boots in distributed mode, serving its local drives to
+peers over the storage RPC plane and locking via dsync:
+
+    # single node, 8 drives
+    python -m minio_tpu.server /data/d{1...8}
+
+    # 2 nodes x 4 drives (run on each host with the same arguments)
+    python -m minio_tpu.server --address 0.0.0.0:9000 \\
+        http://node1:9000/data/d{1...4} http://node2:9000/data/d{1...4}
 """
 
 from __future__ import annotations
 
 import argparse
 import os
-import re
 import sys
-
-
-def expand_ellipses(pattern: str) -> list[str]:
-    """`/data/d{1...8}` -> [/data/d1, ..., /data/d8]
-    (cmd/endpoint-ellipses.go semantics, simplified)."""
-    m = re.search(r"\{(\d+)\.\.\.(\d+)\}", pattern)
-    if not m:
-        return [pattern]
-    lo, hi = int(m.group(1)), int(m.group(2))
-    if hi < lo:
-        raise ValueError(f"bad ellipses range in {pattern}")
-    out = []
-    for i in range(lo, hi + 1):
-        out.extend(expand_ellipses(pattern[: m.start()] + str(i) + pattern[m.end():]))
-    return out
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="minio-tpu server")
-    ap.add_argument("drives", nargs="+",
-                    help="drive dirs or ellipses patterns like /data/d{1...8}")
+    ap.add_argument("endpoints", nargs="+",
+                    help="drive dirs / URLs, ellipses like /data/d{1...8}")
     ap.add_argument("--address", default="127.0.0.1:9000")
     ap.add_argument("--access-key",
                     default=os.environ.get("MINIO_ROOT_USER", "minioadmin"))
@@ -42,28 +33,43 @@ def main(argv=None) -> int:
     ap.add_argument("--set-size", type=int, default=None)
     args = ap.parse_args(argv)
 
-    drives: list[str] = []
-    for pat in args.drives:
-        drives.extend(expand_ellipses(pat))
-
     from aiohttp import web
 
-    from minio_tpu.erasure.sets import ErasureSets, ErasureServerPools
-    from minio_tpu.storage.local import LocalStorage
-    from .app import make_app
+    from minio_tpu.distributed.node import ClusterNode
 
-    disks = [LocalStorage(d) for d in drives]
-    pools = ErasureServerPools([ErasureSets(disks, set_size=args.set_size)])
-    info = pools.storage_info()["pools"][0]
-    print(
-        f"minio-tpu: serving {len(drives)} drives "
-        f"({info['sets']} sets x {info['drives_per_set']} drives) "
-        f"on http://{args.address}", file=sys.stderr,
+    node = ClusterNode(
+        args.endpoints, my_address=args.address,
+        access_key=args.access_key, secret_key=args.secret_key,
+        region=args.region, set_size=args.set_size,
     )
-    app = make_app(pools, access_key=args.access_key,
-                   secret_key=args.secret_key, region=args.region)
+    info = node.pools.storage_info()["pools"][0]
+    mode = "distributed" if node.distributed else "standalone"
+    print(
+        f"minio-tpu: {mode}, {len(node.local_drives)} local drives, "
+        f"{info['sets']} sets x {info['drives_per_set']} drives total, "
+        f"S3 on http://{args.address}", file=sys.stderr,
+    )
+    if node.distributed:
+        # peers may still be starting: retry bootstrap verification in the
+        # background for a bounded window (waitForFormatErasure analogue)
+        import threading
+        import time as _time
+
+        def verify_with_retry():
+            for _ in range(30):
+                problems = node.verify_cluster()
+                if not problems:
+                    print("minio-tpu: cluster bootstrap verified",
+                          file=sys.stderr)
+                    return
+                _time.sleep(1)
+            for p in problems:
+                print(f"minio-tpu: bootstrap warning: {p}", file=sys.stderr)
+
+        threading.Thread(target=verify_with_retry, daemon=True).start()
+
     host, port = args.address.rsplit(":", 1)
-    web.run_app(app, host=host, port=int(port), print=None)
+    web.run_app(node.app, host=host, port=int(port), print=None)
     return 0
 
 

@@ -184,6 +184,16 @@ class LocalStorage(StorageAPI):
         os.makedirs(os.path.dirname(p), exist_ok=True)
         return open(p, "wb")
 
+    def append_file(self, volume: str, path: str, data: bytes,
+                    append: bool = True) -> None:
+        """Append (or truncate-then-write) a chunk; the remote shard-stream
+        protocol's write primitive (reference AppendFile,
+        cmd/xl-storage.go)."""
+        p = self._file_path(volume, path)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        with open(p, "ab" if append else "wb") as f:
+            f.write(data)
+
     def read_file_stream(self, volume: str, path: str, offset: int,
                          length: int) -> BinaryIO:
         p = self._file_path(volume, path)
