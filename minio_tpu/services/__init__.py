@@ -1,0 +1,47 @@
+"""Background services: MRF heal queue, heal sequences, data scanner.
+
+ServiceManager wires them onto an object layer the way serverMain starts
+initAutoHeal/initHealMRF/initDataScanner (cmd/server-main.go:528-585).
+"""
+
+from __future__ import annotations
+
+from .heal import (BackgroundHealer, HealManager, HealSequence,
+                   HealSequenceStatus, heal_fresh_disks,
+                   load_healing_tracker, mark_disk_healing)
+from .mrf import MRFQueue
+from .scanner import BucketUsage, DataScanner, DataUsageInfo
+
+
+class ServiceManager:
+    """Owns the background workers for one server process."""
+
+    def __init__(self, object_layer, scan_interval: float = 60.0,
+                 heal_interval: float = 3600.0, lifecycle_fn=None):
+        self.ol = object_layer
+        self.mrf = MRFQueue(object_layer)
+        self.heals = HealManager(object_layer)
+        self.scanner = DataScanner(object_layer, interval=scan_interval,
+                                   heal_queue=self.mrf.enqueue,
+                                   lifecycle_fn=lifecycle_fn)
+        self.bg_heal = BackgroundHealer(object_layer, interval=heal_interval)
+        self._attach_heal_queue()
+
+    def _attach_heal_queue(self) -> None:
+        """Point every erasure set's async-heal hook at the MRF queue."""
+        for pool in getattr(self.ol, "pools", [self.ol]):
+            for es in getattr(pool, "sets", []):
+                es.heal_queue = self.mrf.enqueue
+
+    def close(self) -> None:
+        self.scanner.close()
+        self.bg_heal.close()
+        self.mrf.close()
+
+
+__all__ = [
+    "BackgroundHealer", "BucketUsage", "DataScanner", "DataUsageInfo",
+    "HealManager", "HealSequence", "HealSequenceStatus", "MRFQueue",
+    "ServiceManager", "heal_fresh_disks", "load_healing_tracker",
+    "mark_disk_healing",
+]

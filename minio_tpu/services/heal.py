@@ -1,0 +1,264 @@
+"""Heal sequences and drive healing.
+
+Equivalents of the reference's admin-driven heal walks (healSequence,
+cmd/admin-heal-ops.go:396), the always-on background heal
+(cmd/global-heal.go:41) and new-disk auto-heal with an on-drive healing
+tracker (cmd/background-newdisks-heal-ops.go).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+
+from minio_tpu.storage import errors
+from minio_tpu.storage.local import SYSTEM_VOL, HEALING_FILE
+
+
+@dataclass
+class HealSequenceStatus:
+    heal_id: str = ""
+    state: str = "running"          # running | finished | stopped | failed
+    bucket: str = ""
+    prefix: str = ""
+    start_time: float = 0.0
+    end_time: float = 0.0
+    objects_scanned: int = 0
+    objects_healed: int = 0
+    objects_failed: int = 0
+    bytes_healed: int = 0
+    failed_items: list = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "healId": self.heal_id, "state": self.state,
+            "bucket": self.bucket, "prefix": self.prefix,
+            "startTime": self.start_time, "endTime": self.end_time,
+            "objectsScanned": self.objects_scanned,
+            "objectsHealed": self.objects_healed,
+            "objectsFailed": self.objects_failed,
+            "bytesHealed": self.bytes_healed,
+            "failedItems": self.failed_items[:64],
+        }
+
+
+class HealSequence:
+    """One traversal healing every object under bucket/prefix."""
+
+    def __init__(self, object_layer, bucket: str = "", prefix: str = "",
+                 deep: bool = False, remove_dangling: bool = False):
+        self.ol = object_layer
+        self.status = HealSequenceStatus(
+            heal_id=uuid.uuid4().hex, bucket=bucket, prefix=prefix,
+            start_time=time.time(),
+        )
+        self.deep = deep
+        self.remove_dangling = remove_dangling
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "HealSequence":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"heal-{self.status.heal_id[:8]}")
+        self._thread.start()
+        return self
+
+    def run_sync(self) -> HealSequenceStatus:
+        self._run()
+        return self.status
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def join(self, timeout: float | None = None) -> None:
+        if self._thread:
+            self._thread.join(timeout)
+
+    # -- traversal ----------------------------------------------------------
+    def _buckets(self) -> list[str]:
+        if self.status.bucket:
+            return [self.status.bucket]
+        names = [b["name"] if isinstance(b, dict) else b.name
+                 for b in self.ol.list_buckets()]
+        return [n for n in names if not n.startswith(".")]
+
+    def _run(self) -> None:
+        st = self.status
+        try:
+            for bucket in self._buckets():
+                if self._stop.is_set():
+                    st.state = "stopped"
+                    break
+                try:
+                    names = self.ol.list_objects(bucket, prefix=st.prefix)
+                except errors.BucketNotFound:
+                    continue
+                for name in names:
+                    if self._stop.is_set():
+                        st.state = "stopped"
+                        break
+                    st.objects_scanned += 1
+                    try:
+                        res = self.ol.heal_object(bucket, name,
+                                                  deep=self.deep)
+                        if getattr(res, "failed", False):
+                            st.objects_failed += 1
+                            st.failed_items.append(f"{bucket}/{name}")
+                        else:
+                            st.objects_healed += 1
+                            st.bytes_healed += getattr(res, "object_size", 0)
+                    except Exception as ex:
+                        st.objects_failed += 1
+                        st.failed_items.append(f"{bucket}/{name}: {ex}")
+            if st.state == "running":
+                st.state = "finished"
+        except Exception:
+            st.state = "failed"
+        finally:
+            st.end_time = time.time()
+
+
+class HealManager:
+    """Registry of heal sequences (admin-heal-ops' allHealState analogue)."""
+
+    def __init__(self, object_layer):
+        self.ol = object_layer
+        self._seqs: dict[str, HealSequence] = {}
+        self._mu = threading.Lock()
+
+    def launch(self, bucket: str = "", prefix: str = "",
+               deep: bool = False) -> HealSequenceStatus:
+        seq = HealSequence(self.ol, bucket, prefix, deep).start()
+        with self._mu:
+            self._seqs[seq.status.heal_id] = seq
+        return seq.status
+
+    def get(self, heal_id: str) -> HealSequenceStatus | None:
+        with self._mu:
+            seq = self._seqs.get(heal_id)
+        return seq.status if seq else None
+
+    def stop(self, heal_id: str) -> bool:
+        with self._mu:
+            seq = self._seqs.get(heal_id)
+        if not seq:
+            return False
+        seq.stop()
+        return True
+
+    def statuses(self) -> list[dict]:
+        with self._mu:
+            return [s.status.to_dict() for s in self._seqs.values()]
+
+
+class BackgroundHealer:
+    """Always-on periodic full-cluster heal (global-heal.go:41)."""
+
+    def __init__(self, object_layer, interval: float = 3600.0):
+        self.ol = object_layer
+        self.interval = interval
+        self.last_status: HealSequenceStatus | None = None
+        self.cycles = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="bg-heal")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.heal_once()
+
+    def heal_once(self) -> HealSequenceStatus:
+        seq = HealSequence(self.ol)
+        self.last_status = seq.run_sync()
+        self.cycles += 1
+        return self.last_status
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)
+
+
+# ---------------------------------------------------------------------------
+# New-disk auto-heal: healing tracker persisted on the drive so interrupted
+# heals resume (cmd/background-newdisks-heal-ops.go).
+
+def load_healing_tracker(disk) -> dict | None:
+    try:
+        return json.loads(disk.read_all(SYSTEM_VOL, HEALING_FILE))
+    except Exception:
+        return None
+
+
+def save_healing_tracker(disk, tracker: dict) -> None:
+    disk.write_all(SYSTEM_VOL, HEALING_FILE, json.dumps(tracker).encode())
+
+
+def clear_healing_tracker(disk) -> None:
+    try:
+        disk.delete(SYSTEM_VOL, HEALING_FILE)
+    except errors.StorageError:
+        pass
+
+
+def mark_disk_healing(disk) -> dict:
+    tracker = {"id": uuid.uuid4().hex, "started": time.time(),
+               "objects_healed": 0, "objects_failed": 0, "finished": False}
+    save_healing_tracker(disk, tracker)
+    return tracker
+
+
+def heal_fresh_disks(pools) -> list[dict]:
+    """Find drives carrying a healing tracker and re-heal their erasure
+    sets onto them; returns the completed trackers."""
+    done: list[dict] = []
+    for pool in getattr(pools, "pools", [pools]):
+        for es in pool.sets:
+            fresh = [d for d in es.disks
+                     if d is not None and d.is_online()
+                     and load_healing_tracker(d) is not None]
+            if not fresh:
+                continue
+            trackers = {id(d): load_healing_tracker(d) for d in fresh}
+            # heal every bucket+object in this set
+            for vol in _set_buckets(es):
+                for name in _set_objects(es, vol):
+                    try:
+                        res = es.heal_object(vol, name)
+                        ok = not getattr(res, "failed", False)
+                    except Exception:
+                        ok = False
+                    for t in trackers.values():
+                        t["objects_healed" if ok else "objects_failed"] += 1
+            for d in fresh:
+                t = trackers[id(d)]
+                t["finished"] = True
+                t["ended"] = time.time()
+                clear_healing_tracker(d)
+                done.append(t)
+    return done
+
+
+def _set_buckets(es) -> list[str]:
+    vols: set[str] = set()
+    for d in es.disks:
+        if d is None or not d.is_online():
+            continue
+        try:
+            for v in d.list_volumes():
+                if not v.name.startswith("."):
+                    vols.add(v.name)
+        except Exception:
+            continue
+    return sorted(vols)
+
+
+def _set_objects(es, bucket: str) -> list[str]:
+    try:
+        return es.list_objects(bucket)
+    except errors.StorageError:
+        return []

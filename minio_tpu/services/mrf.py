@@ -1,0 +1,121 @@
+"""MRF ("most recently failed") heal queue.
+
+Equivalent of the reference's in-memory partial-write queue
+(cmd/mrf.go:47-60): PutObject enqueues objects whose write met quorum but
+missed some drives; a background worker re-heals them shortly after.  The
+read path enqueues objects observed missing/corrupt shards
+(cmd/erasure-object.go:316-339, cmd/background-heal-ops.go).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class MRFStats:
+    enqueued: int = 0
+    healed: int = 0
+    failed: int = 0
+    dropped: int = 0
+    pending: int = 0
+
+    def to_dict(self) -> dict:
+        return {"enqueued": self.enqueued, "healed": self.healed,
+                "failed": self.failed, "dropped": self.dropped,
+                "pending": self.pending}
+
+
+@dataclass(frozen=True)
+class _HealTask:
+    bucket: str
+    obj: str
+    version_id: str = ""
+
+
+class MRFQueue:
+    """Bounded queue + worker thread re-healing partial writes.
+
+    `object_layer` needs a `heal_object(bucket, obj, version_id)` method
+    (ErasureObjects / ErasureSets / ErasureServerPools all provide it).
+    """
+
+    MAX_PENDING = 10000  # reference: mrfOpsQueueSize (cmd/mrf.go:29)
+
+    def __init__(self, object_layer, delay: float = 0.05,
+                 max_retries: int = 3):
+        self.ol = object_layer
+        self.delay = delay
+        self.max_retries = max_retries
+        self.stats = MRFStats()
+        self._q: queue.Queue = queue.Queue(maxsize=self.MAX_PENDING)
+        self._inflight: set[_HealTask] = set()
+        self._mu = threading.Lock()
+        self._stop = threading.Event()
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="mrf-heal")
+        self._worker.start()
+
+    # -- producer ----------------------------------------------------------
+    def enqueue(self, bucket: str, obj: str, version_id: str = "") -> None:
+        t = _HealTask(bucket, obj, version_id)
+        with self._mu:
+            if t in self._inflight:
+                return
+            self._inflight.add(t)
+            self.stats.enqueued += 1
+        try:
+            self._q.put_nowait(t)
+            with self._mu:
+                self.stats.pending = self._q.qsize()
+        except queue.Full:
+            with self._mu:
+                self._inflight.discard(t)
+                self.stats.dropped += 1
+
+    # -- worker ------------------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                t = self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            # brief settle delay so in-flight renames finish (reference
+            # sleeps up to a second before MRF healing)
+            if self.delay:
+                time.sleep(self.delay)
+            ok = False
+            for _ in range(self.max_retries):
+                try:
+                    res = self.ol.heal_object(t.bucket, t.obj, t.version_id)
+                    ok = not getattr(res, "failed", False)
+                except Exception:
+                    ok = False
+                if ok:
+                    break
+                time.sleep(self.delay)
+            with self._mu:
+                self._inflight.discard(t)
+                if ok:
+                    self.stats.healed += 1
+                else:
+                    self.stats.failed += 1
+                self.stats.pending = self._q.qsize()
+
+    # -- control -----------------------------------------------------------
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Wait until the queue is empty and no task is in flight (tests)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._mu:
+                if self._q.empty() and not self._inflight:
+                    return True
+            time.sleep(0.02)
+        return False
+
+    def close(self) -> None:
+        self._stop.set()
+        self._worker.join(timeout=2)

@@ -1,0 +1,254 @@
+"""Data scanner + data-usage accounting.
+
+Equivalent of the reference's continuous scanner (runDataScanner,
+cmd/data-scanner.go:97) and hierarchical usage cache
+(cmd/data-usage-cache.go): walks every erasure set, aggregates per-bucket
+usage (objects, versions, bytes, size histogram), triggers heal for
+objects with missing shards, and evaluates lifecycle actions via a
+pluggable callback.  The usage cache is persisted in the system volume so
+admin/metrics queries don't rescan.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+
+from minio_tpu.storage import errors
+from minio_tpu.storage.local import SYSTEM_VOL
+
+USAGE_CACHE_FILE = "data-usage.json"
+
+# size histogram buckets, reference sizeHistogram (cmd/data-usage-cache.go)
+SIZE_BUCKETS = [
+    ("LESS_THAN_1024_B", 1024),
+    ("BETWEEN_1024_B_AND_1_MB", 1024 * 1024),
+    ("BETWEEN_1_MB_AND_10_MB", 10 * 1024 * 1024),
+    ("BETWEEN_10_MB_AND_64_MB", 64 * 1024 * 1024),
+    ("BETWEEN_64_MB_AND_128_MB", 128 * 1024 * 1024),
+    ("BETWEEN_128_MB_AND_512_MB", 512 * 1024 * 1024),
+    ("GREATER_THAN_512_MB", float("inf")),
+]
+
+
+def _histogram_bucket(size: int) -> str:
+    for name, limit in SIZE_BUCKETS:
+        if size < limit:
+            return name
+    return SIZE_BUCKETS[-1][0]
+
+
+@dataclass
+class BucketUsage:
+    objects: int = 0
+    versions: int = 0
+    delete_markers: int = 0
+    size: int = 0
+    histogram: dict = field(default_factory=dict)
+
+    def add(self, size: int, versions: int = 1, delete_markers: int = 0) -> None:
+        self.objects += 1
+        self.versions += versions
+        self.delete_markers += delete_markers
+        self.size += size
+        b = _histogram_bucket(size)
+        self.histogram[b] = self.histogram.get(b, 0) + 1
+
+    def to_dict(self) -> dict:
+        return {"objects": self.objects, "versions": self.versions,
+                "deleteMarkers": self.delete_markers, "size": self.size,
+                "histogram": self.histogram}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "BucketUsage":
+        u = cls(objects=d.get("objects", 0), versions=d.get("versions", 0),
+                delete_markers=d.get("deleteMarkers", 0),
+                size=d.get("size", 0))
+        u.histogram = dict(d.get("histogram", {}))
+        return u
+
+
+@dataclass
+class DataUsageInfo:
+    buckets: dict = field(default_factory=dict)   # bucket -> BucketUsage
+    last_update: float = 0.0
+    objects_scanned: int = 0
+    heals_triggered: int = 0
+    lifecycle_actions: int = 0
+
+    def total_size(self) -> int:
+        return sum(u.size for u in self.buckets.values())
+
+    def total_objects(self) -> int:
+        return sum(u.objects for u in self.buckets.values())
+
+    def to_dict(self) -> dict:
+        return {
+            "lastUpdate": self.last_update,
+            "objectsTotalCount": self.total_objects(),
+            "objectsTotalSize": self.total_size(),
+            "objectsScanned": self.objects_scanned,
+            "healsTriggered": self.heals_triggered,
+            "lifecycleActions": self.lifecycle_actions,
+            "bucketsUsage": {b: u.to_dict() for b, u in self.buckets.items()},
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DataUsageInfo":
+        info = cls(last_update=d.get("lastUpdate", 0.0),
+                   objects_scanned=d.get("objectsScanned", 0),
+                   heals_triggered=d.get("healsTriggered", 0),
+                   lifecycle_actions=d.get("lifecycleActions", 0))
+        info.buckets = {b: BucketUsage.from_dict(u)
+                        for b, u in d.get("bucketsUsage", {}).items()}
+        return info
+
+
+class DataScanner:
+    """Periodic scan of all sets: usage accounting + heal + lifecycle.
+
+    lifecycle_fn(bucket, object_info) -> bool is called per scanned object
+    version; returning True means the version was removed (expired /
+    transitioned) and should not be counted.
+    """
+
+    def __init__(self, pools, interval: float = 60.0,
+                 heal_queue=None, lifecycle_fn=None, autostart: bool = True):
+        self.pools = pools
+        self.interval = interval
+        self.heal_queue = heal_queue
+        self.lifecycle_fn = lifecycle_fn
+        self.usage = DataUsageInfo()
+        self.cycles = 0
+        self._mu = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        if autostart:
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name="data-scanner")
+            self._thread.start()
+
+    # -- loop ---------------------------------------------------------------
+    def _run(self) -> None:
+        # initial usage from the persisted cache so restarts serve stats
+        cached = self._load_cache()
+        if cached is not None:
+            with self._mu:
+                self.usage = cached
+        while not self._stop.wait(self.interval):
+            try:
+                self.scan_cycle()
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    # -- one full cycle ------------------------------------------------------
+    def scan_cycle(self) -> DataUsageInfo:
+        info = DataUsageInfo(last_update=time.time())
+        for pool in getattr(self.pools, "pools", [self.pools]):
+            for es in pool.sets:
+                self._scan_set(es, info)
+        with self._mu:
+            self.usage = info
+        self.cycles += 1
+        self._save_cache(info)
+        return info
+
+    def _scan_set(self, es, info: DataUsageInfo) -> None:
+        n = len(es.disks)
+        for bucket in self._set_buckets(es):
+            usage = info.buckets.setdefault(bucket, BucketUsage())
+            try:
+                names = es.list_objects(bucket)
+            except errors.StorageError:
+                continue
+            for name in names:
+                info.objects_scanned += 1
+                try:
+                    fi, fis, errs2 = es._quorum_info(bucket, name)
+                except errors.StorageError:
+                    # unreadable object: a heal attempt may still recover
+                    # or purge a dangling entry
+                    if self.heal_queue:
+                        self.heal_queue(bucket, name, "")
+                        info.heals_triggered += 1
+                    continue
+                # heal trigger: any drive missing this object's version
+                missing = sum(
+                    1 for i, f in enumerate(fis)
+                    if f is None and es.disks[i] is not None
+                    and es.disks[i].is_online()
+                )
+                if missing and self.heal_queue:
+                    self.heal_queue(bucket, name, fi.version_id)
+                    info.heals_triggered += 1
+                # lifecycle evaluation
+                if self.lifecycle_fn is not None:
+                    try:
+                        from minio_tpu.erasure.objects import ObjectInfo
+                        oi = ObjectInfo.from_file_info(fi, bucket, name, True)
+                        if self.lifecycle_fn(bucket, oi):
+                            info.lifecycle_actions += 1
+                            continue
+                    except Exception:
+                        pass
+                if fi.deleted:
+                    usage.delete_markers += 1
+                else:
+                    usage.add(fi.size)
+        return
+
+    @staticmethod
+    def _set_buckets(es) -> list[str]:
+        vols: set[str] = set()
+        for d in es.disks:
+            if d is None or not d.is_online():
+                continue
+            try:
+                for v in d.list_volumes():
+                    if not v.name.startswith("."):
+                        vols.add(v.name)
+            except Exception:
+                continue
+        return sorted(vols)
+
+    # -- persistence ----------------------------------------------------------
+    def _cache_disk(self):
+        for pool in getattr(self.pools, "pools", [self.pools]):
+            for es in pool.sets:
+                for d in es.disks:
+                    if d is not None and d.is_online():
+                        return d
+        return None
+
+    def _save_cache(self, info: DataUsageInfo) -> None:
+        d = self._cache_disk()
+        if d is None:
+            return
+        try:
+            d.write_all(SYSTEM_VOL, USAGE_CACHE_FILE,
+                        json.dumps(info.to_dict()).encode())
+        except Exception:
+            pass
+
+    def _load_cache(self) -> DataUsageInfo | None:
+        d = self._cache_disk()
+        if d is None:
+            return None
+        try:
+            return DataUsageInfo.from_dict(
+                json.loads(d.read_all(SYSTEM_VOL, USAGE_CACHE_FILE))
+            )
+        except Exception:
+            return None
+
+    # -- queries --------------------------------------------------------------
+    def data_usage_info(self) -> dict:
+        with self._mu:
+            return self.usage.to_dict()

@@ -1,0 +1,249 @@
+"""Background services: MRF queue, heal sequences, fresh-disk heal,
+data scanner + usage accounting.
+
+Mirrors the reference's heal/scanner coverage (cmd/erasure-healing_test.go,
+cmd/data-usage_test.go) on tmpdir drives."""
+
+import io
+import os
+import shutil
+import time
+
+import numpy as np
+import pytest
+
+from minio_tpu.erasure.objects import ErasureObjects
+from minio_tpu.erasure.sets import ErasureSets, ErasureServerPools
+from minio_tpu.services import (
+    BackgroundHealer, DataScanner, HealManager, HealSequence, MRFQueue,
+    ServiceManager, heal_fresh_disks, load_healing_tracker,
+    mark_disk_healing,
+)
+from minio_tpu.storage import errors
+from minio_tpu.storage.local import LocalStorage
+
+
+def payload(size, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size=size, dtype=np.uint8
+    ).tobytes()
+
+
+def make_pools(tmp_path, n=6):
+    disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(n)]
+    sets = ErasureSets(disks)
+    pools = ErasureServerPools([sets])
+    pools.make_bucket("bkt")
+    return pools, disks
+
+
+def shard_dirs(disks, bucket, obj):
+    return [os.path.join(d.root, bucket, obj) for d in disks]
+
+
+class TestMRF:
+    def test_partial_write_heals(self, tmp_path):
+        pools, disks = make_pools(tmp_path)
+        mrf = MRFQueue(pools, delay=0.01)
+        es = pools.pools[0].sets[0]
+        es.heal_queue = mrf.enqueue
+
+        data = payload(1 << 20)
+        pools.put_object("bkt", "obj", io.BytesIO(data), len(data))
+
+        # nuke one drive's shard dir -> read path should enqueue a heal
+        victim = next(p for p in shard_dirs(disks, "bkt", "obj")
+                      if os.path.isdir(p))
+        shutil.rmtree(victim)
+        _, stream = pools.get_object("bkt", "obj")
+        assert b"".join(stream) == data
+        assert mrf.drain(5.0)
+        assert mrf.stats.healed >= 1
+        assert os.path.isdir(victim)
+        mrf.close()
+
+    def test_dedup(self, tmp_path):
+        pools, _ = make_pools(tmp_path)
+        data = payload(4096)
+        pools.put_object("bkt", "o", io.BytesIO(data), len(data))
+        mrf = MRFQueue(pools, delay=0.2)
+        for _ in range(50):
+            mrf.enqueue("bkt", "o", "")
+        assert mrf.stats.enqueued < 50  # duplicates suppressed
+        mrf.close()
+
+
+class TestHealSequence:
+    def test_full_walk_heals_everything(self, tmp_path):
+        pools, disks = make_pools(tmp_path)
+        objs = {}
+        for i in range(5):
+            data = payload(100_000 + i, seed=i)
+            pools.put_object("bkt", f"o{i}", io.BytesIO(data), len(data))
+            objs[f"o{i}"] = data
+
+        # kill one drive's copy of every object
+        for name in objs:
+            for p in shard_dirs(disks, "bkt", name):
+                if os.path.isdir(p):
+                    shutil.rmtree(p)
+                    break
+
+        st = HealSequence(pools).run_sync()
+        assert st.state == "finished"
+        assert st.objects_scanned == 5
+        assert st.objects_healed == 5
+        assert st.objects_failed == 0
+        # every drive again holds every object's metadata
+        for name, data in objs.items():
+            present = sum(os.path.isdir(p)
+                          for p in shard_dirs(disks, "bkt", name))
+            assert present == len(disks)
+            _, stream = pools.get_object("bkt", name)
+            assert b"".join(stream) == data
+
+    def test_manager_launch_and_status(self, tmp_path):
+        pools, _ = make_pools(tmp_path)
+        data = payload(10_000)
+        pools.put_object("bkt", "x", io.BytesIO(data), len(data))
+        hm = HealManager(pools)
+        st = hm.launch(bucket="bkt")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            cur = hm.get(st.heal_id)
+            if cur and cur.state == "finished":
+                break
+            time.sleep(0.02)
+        assert hm.get(st.heal_id).state == "finished"
+        assert hm.statuses()[0]["objectsScanned"] == 1
+
+    def test_background_healer_cycle(self, tmp_path):
+        pools, _ = make_pools(tmp_path)
+        data = payload(5000)
+        pools.put_object("bkt", "x", io.BytesIO(data), len(data))
+        bg = BackgroundHealer(pools, interval=3600)
+        st = bg.heal_once()
+        assert st.objects_scanned == 1 and bg.cycles == 1
+        bg.close()
+
+
+class TestFreshDiskHeal:
+    def test_tracker_roundtrip(self, tmp_path):
+        d = LocalStorage(str(tmp_path / "d"))
+        assert load_healing_tracker(d) is None
+        t = mark_disk_healing(d)
+        got = load_healing_tracker(d)
+        assert got["id"] == t["id"]
+        assert d.disk_info().healing
+
+    def test_replaced_drive_refills(self, tmp_path):
+        pools, disks = make_pools(tmp_path)
+        datas = {}
+        for i in range(4):
+            data = payload(50_000 + i, seed=i)
+            pools.put_object("bkt", f"f{i}", io.BytesIO(data), len(data))
+            datas[f"f{i}"] = data
+
+        # simulate drive replacement: wipe the drive dir entirely
+        victim = disks[2]
+        shutil.rmtree(victim.root)
+        fresh = LocalStorage(victim.root)
+        fresh.make_volume("bkt")
+        mark_disk_healing(fresh)
+        pools.pools[0].all_disks[2] = fresh
+        pools.pools[0].sets[0].disks[2] = fresh
+
+        done = heal_fresh_disks(pools)
+        assert done and done[0]["finished"]
+        assert done[0]["objects_healed"] == 4
+        assert load_healing_tracker(fresh) is None
+        for name in datas:
+            assert os.path.isfile(
+                os.path.join(fresh.root, "bkt", name, "xl.meta")
+            )
+
+
+class TestScanner:
+    def test_usage_accounting(self, tmp_path):
+        pools, _ = make_pools(tmp_path)
+        sizes = [100, 2048, 1 << 20, 5 << 20]
+        for i, sz in enumerate(sizes):
+            data = payload(sz, seed=i)
+            pools.put_object("bkt", f"s{i}", io.BytesIO(data), len(data))
+        sc = DataScanner(pools, autostart=False)
+        info = sc.scan_cycle()
+        u = info.buckets["bkt"]
+        assert u.objects == 4
+        assert u.size == sum(sizes)
+        assert u.histogram["LESS_THAN_1024_B"] == 1
+        assert u.histogram["BETWEEN_1024_B_AND_1_MB"] == 1
+        assert u.histogram["BETWEEN_1_MB_AND_10_MB"] == 2
+        d = sc.data_usage_info()
+        assert d["objectsTotalCount"] == 4
+        assert d["objectsTotalSize"] == sum(sizes)
+
+    def test_usage_cache_persists(self, tmp_path):
+        pools, _ = make_pools(tmp_path)
+        data = payload(1234)
+        pools.put_object("bkt", "x", io.BytesIO(data), len(data))
+        DataScanner(pools, autostart=False).scan_cycle()
+        # a new scanner loads the persisted cache before any cycle
+        sc2 = DataScanner(pools, autostart=False)
+        cached = sc2._load_cache()
+        assert cached is not None
+        assert cached.buckets["bkt"].objects == 1
+
+    def test_scanner_triggers_heal(self, tmp_path):
+        pools, disks = make_pools(tmp_path)
+        data = payload(200_000)
+        pools.put_object("bkt", "h", io.BytesIO(data), len(data))
+        victim = next(p for p in shard_dirs(disks, "bkt", "h")
+                      if os.path.isdir(p))
+        shutil.rmtree(victim)
+        healed = []
+        sc = DataScanner(pools, autostart=False,
+                         heal_queue=lambda b, o, v: healed.append((b, o)))
+        info = sc.scan_cycle()
+        assert info.heals_triggered == 1
+        assert healed == [("bkt", "h")]
+
+    def test_lifecycle_hook(self, tmp_path):
+        pools, _ = make_pools(tmp_path)
+        for i in range(3):
+            data = payload(1000, seed=i)
+            pools.put_object("bkt", f"l{i}", io.BytesIO(data), len(data))
+        expired = []
+
+        def lc(bucket, oi):
+            if oi.name == "l1":
+                pools.delete_object(bucket, oi.name)
+                expired.append(oi.name)
+                return True
+            return False
+
+        sc = DataScanner(pools, autostart=False, lifecycle_fn=lc)
+        info = sc.scan_cycle()
+        assert info.lifecycle_actions == 1
+        assert info.buckets["bkt"].objects == 2
+        with pytest.raises(errors.ObjectNotFound):
+            pools.get_object_info("bkt", "l1")
+
+
+class TestServiceManager:
+    def test_wiring(self, tmp_path):
+        pools, disks = make_pools(tmp_path)
+        svc = ServiceManager(pools, scan_interval=3600, heal_interval=3600)
+        try:
+            es = pools.pools[0].sets[0]
+            assert es.heal_queue is not None
+            data = payload(300_000)
+            pools.put_object("bkt", "w", io.BytesIO(data), len(data))
+            victim = next(p for p in shard_dirs(disks, "bkt", "w")
+                          if os.path.isdir(p))
+            shutil.rmtree(victim)
+            _, stream = pools.get_object("bkt", "w")
+            assert b"".join(stream) == data
+            assert svc.mrf.drain(5.0)
+            assert os.path.isdir(victim)
+        finally:
+            svc.close()
