@@ -152,6 +152,9 @@ class DRWMutex:
         # granted to someone else (the reference cancels the operation's
         # context in this case, drwmutex.go:221)
         self.lost = threading.Event()
+        # set once unlock() ran: straggler grants landing after this must
+        # release themselves (see _broadcast)
+        self._released = threading.Event()
 
     @property
     def quorum(self) -> int:
@@ -160,28 +163,63 @@ class DRWMutex:
 
     @property
     def read_quorum(self) -> int:
-        """Read quorum: half is enough — read locks are shared, so two
-        disjoint halves both holding read locks is consistent
-        (drwmutex.go dquorumReads)."""
-        return max(1, len(self.clients) // 2)
+        """Read quorum: n - n//2, so any read quorum intersects any write
+        quorum (n//2 + 1) — matching the reference's dquorumReads
+        (internal/dsync/drwmutex.go).  With plain n//2 an odd cluster
+        could grant a read lock and a write lock simultaneously from
+        disjoint halves."""
+        n = len(self.clients)
+        return n - n // 2
 
-    def _broadcast(self, op: str, uid: str) -> int:
-        ok = 0
-        for c in self.clients:
+    def _broadcast(self, op: str, uid: str, need: int | None = None) -> int:
+        """Fan the RPC out to all lockers concurrently (the reference uses
+        a goroutine per locker).  When `need` is given, return as soon as
+        that many grants arrive.  A straggler grant can land AFTER the
+        mutex was unlocked (the unlock broadcast is a no-op on a locker
+        that had not granted yet); each straggler therefore checks
+        _released when its grant completes and releases itself, so no
+        phantom lock outlives the operation."""
+        n = len(self.clients)
+        results: list[bool] = []
+        cv = threading.Condition()
+        acquiring = op in ("lock", "rlock")
+
+        def one(c) -> None:
+            ok = False
             try:
                 r = c.call(f"lock.{op}", {"name": self.name, "uid": uid})
-                if r and r.get("ok"):
-                    ok += 1
+                ok = bool(r and r.get("ok"))
             except Exception:
-                continue
-        return ok
+                ok = False
+            with cv:
+                results.append(ok)
+                cv.notify()
+            if ok and acquiring and self._released.is_set():
+                # grant landed after unlock(): release it on this locker
+                try:
+                    c.call("lock.unlock", {"name": self.name, "uid": uid})
+                except Exception:
+                    pass
+
+        for c in self.clients:
+            threading.Thread(target=one, args=(c,), daemon=True).start()
+        deadline = time.time() + self.timeout + 1.0
+        with cv:
+            while len(results) < n:
+                if need is not None and sum(results) >= need:
+                    break
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    break
+                cv.wait(timeout=remaining)
+            return sum(results)
 
     def _acquire(self, op: str) -> bool:
         deadline = time.time() + self.timeout
         uid = str(uuid.uuid4())
         need = self.read_quorum if op == "rlock" else self.quorum
         while time.time() < deadline:
-            got = self._broadcast(op, uid)
+            got = self._broadcast(op, uid, need=need)
             if got >= need:
                 self.uid = uid
                 self._is_read = op == "rlock"
@@ -191,6 +229,9 @@ class DRWMutex:
             # failed: release whatever we got, back off, retry
             self._broadcast("unlock", uid)
             time.sleep(RETRY_DELAY)
+        # timed out entirely: make any still-in-flight grants self-release
+        self._released.set()
+        self._broadcast("unlock", uid)
         return False
 
     def lock(self) -> None:
@@ -203,6 +244,7 @@ class DRWMutex:
 
     def unlock(self) -> None:
         self._stop_refresher()
+        self._released.set()
         if self.uid:
             self._broadcast("unlock", self.uid)
             self.uid = ""
@@ -221,7 +263,7 @@ class DRWMutex:
         uid = self.uid
         need = getattr(self, "_need", self.quorum)
         while not self._stop.wait(REFRESH_INTERVAL):
-            ok = self._broadcast("refresh", uid)
+            ok = self._broadcast("refresh", uid, need=need)
             if ok < need:
                 # lost the lock (e.g. partition or force-unlock): flag it so
                 # the operation holding us can abort instead of silently

@@ -32,6 +32,11 @@ _ERR_TYPES = {
 }
 
 
+class RpcTransportError(errors.DiskNotFound):
+    """Network-level RPC failure (connection refused/reset/timeout) — as
+    opposed to a storage error returned by a live peer."""
+
+
 def auth_token(secret: str) -> str:
     day = int(time.time() // 86400)
     return hmac.new(secret.encode(), f"minio-tpu-rpc:{day}".encode(),
@@ -101,6 +106,8 @@ class RpcClient:
         try:
             self.call("health.ping", {})
             ok = True
+        except RpcTransportError:
+            ok = False  # no HTTP response at all: the peer is down
         except errors.StorageError:
             ok = True  # RPC-level error still proves liveness
         except Exception:
@@ -122,13 +129,22 @@ class RpcClient:
 
     # -- calls --------------------------------------------------------------
     def call(self, method: str, args: dict, body: bytes = b"",
-             want_stream: bool = False):
+             want_stream: bool = False, idempotent: bool = True):
         """POST args (+ raw body tail); returns decoded result (or a
-        response object for streaming reads)."""
+        response object for streaming reads).
+
+        Non-idempotent calls (appends, renames) get a fresh connection and
+        NO retry: a retry after a mid-request failure could re-apply an
+        operation the server already performed."""
         payload = msgpack.packb(args, use_bin_type=True)
-        # one retry on a stale pooled connection
-        for attempt in (0, 1):
-            conn = self._get_conn()
+        # one retry on a stale pooled connection (idempotent calls only)
+        attempts = (0, 1) if idempotent else (1,)
+        for attempt in attempts:
+            if idempotent:
+                conn = self._get_conn()
+            else:
+                conn = http.client.HTTPConnection(self.host, self.port,
+                                                  timeout=self.timeout)
             try:
                 path = f"{RPC_PREFIX}/{urllib.parse.quote(method)}"
                 conn.putrequest("POST", path)
@@ -145,7 +161,7 @@ class RpcClient:
                 if attempt == 0:
                     continue  # stale keep-alive connection; retry fresh
                 self.mark_offline()
-                raise errors.DiskNotFound(f"rpc {method}: {e}")
+                raise RpcTransportError(f"rpc {method}: {e}")
             self._mark_online()  # any HTTP response proves liveness
             if resp.status != 200:
                 data = resp.read()

@@ -13,6 +13,8 @@ from __future__ import annotations
 import io
 from typing import BinaryIO, Iterator
 
+import msgpack
+
 from minio_tpu.storage import errors
 from minio_tpu.storage.api import DiskInfo, StorageAPI, VolInfo
 from minio_tpu.storage.local import LocalStorage
@@ -167,9 +169,33 @@ def register_storage_rpc(router: RpcRouter, drives: dict[str, LocalStorage]) -> 
 
     @h("walk_dir")
     def _walk_dir(args, body):
-        return {"entries": list(drive(args).walk_dir(
+        # stream msgpack-framed batches so million-entry walks never
+        # materialize on either end (reference WalkDir streams msgp entries,
+        # cmd/metacache-walk.go:62)
+        it = drive(args).walk_dir(
             args["volume"], args.get("base", ""), args.get("recursive", True)
-        ))}
+        )
+        # pull the first batch eagerly: walk_dir raises VolumeNotFound on
+        # first next(), which must surface as an RPC error, not a truncated
+        # 200 stream the client would read as an empty listing
+        first: list[str] = []
+        for name in it:
+            first.append(name)
+            if len(first) >= 1000:
+                break
+
+        def chunks():
+            yield msgpack.packb(first, use_bin_type=True)
+            batch: list[str] = []
+            for name in it:
+                batch.append(name)
+                if len(batch) >= 1000:
+                    yield msgpack.packb(batch, use_bin_type=True)
+                    batch = []
+            if batch:
+                yield msgpack.packb(batch, use_bin_type=True)
+
+        return StreamResult(chunks())
 
     @h("verify_file")
     def _verify_file(args, body):
@@ -209,6 +235,7 @@ class _RemoteWriter(io.RawIOBase):
                 "storage.append_file",
                 {**self.args, "append": not self.first},
                 bytes(self.buf),
+                idempotent=False,  # a blind retry would double-append
             )
             self.buf.clear()
             self.first = False
@@ -228,11 +255,12 @@ class RemoteStorage(StorageAPI):
         self._disk_id = ""
 
     def _call(self, method: str, args: dict | None = None, body: bytes = b"",
-              want_stream: bool = False):
+              want_stream: bool = False, idempotent: bool = True):
         a = {"drive": self.drive}
         if args:
             a.update(args)
-        return self.client.call(f"storage.{method}", a, body, want_stream)
+        return self.client.call(f"storage.{method}", a, body, want_stream,
+                                idempotent=idempotent)
 
     # identity / health
     def disk_id(self) -> str:
@@ -287,7 +315,7 @@ class RemoteStorage(StorageAPI):
         self._call("rename_file", {
             "src_volume": src_volume, "src_path": src_path,
             "dst_volume": dst_volume, "dst_path": dst_path,
-        })
+        }, idempotent=False)
 
     # shard files
     def create_file(self, volume: str, path: str, size: int,
@@ -341,7 +369,7 @@ class RemoteStorage(StorageAPI):
         self._call("delete_version", {
             "volume": volume, "path": path, "fi": _fi_to_wire(fi),
             "force_del_marker": force_del_marker,
-        })
+        }, idempotent=False)
 
     def rename_data(self, src_volume: str, src_path: str, fi: FileInfo,
                     dst_volume: str, dst_path: str) -> None:
@@ -349,7 +377,7 @@ class RemoteStorage(StorageAPI):
             "src_volume": src_volume, "src_path": src_path,
             "fi": _fi_to_wire(fi), "dst_volume": dst_volume,
             "dst_path": dst_path,
-        })
+        }, idempotent=False)
 
     # listing / verification
     def list_dir(self, volume: str, path: str, count: int = -1) -> list[str]:
@@ -358,9 +386,20 @@ class RemoteStorage(StorageAPI):
 
     def walk_dir(self, volume: str, base: str = "",
                  recursive: bool = True) -> Iterator[str]:
-        yield from self._call("walk_dir", {
+        resp = self._call("walk_dir", {
             "volume": volume, "base": base, "recursive": recursive
-        })["entries"]
+        }, want_stream=True)
+        unpacker = msgpack.Unpacker(raw=False)
+        try:
+            while True:
+                data = resp.read(1 << 16)
+                if not data:
+                    break
+                unpacker.feed(data)
+                for batch in unpacker:
+                    yield from batch
+        finally:
+            resp.close()
 
     def verify_file(self, volume: str, path: str, fi: FileInfo) -> None:
         self._call("verify_file", {"volume": volume, "path": path,

@@ -440,6 +440,19 @@ class ErasureObjects:
             raise MethodNotAllowedDeleteMarker(oi)
         return ObjectInfo.from_file_info(fi, bucket, obj, bool(version_id))
 
+    def object_health(self, bucket: str, obj: str, version_id: str = ""
+                      ) -> tuple[FileInfo, int]:
+        """Quorum FileInfo plus the number of ONLINE drives missing this
+        version — the scanner's heal-trigger signal (the reference's
+        disksWithAllParts classification, cmd/erasure-healing-common.go:184)."""
+        fi, fis, _ = self._quorum_info(bucket, obj, version_id)
+        missing = sum(
+            1 for i, f in enumerate(fis)
+            if f is None and self.disks[i] is not None
+            and self.disks[i].is_online()
+        )
+        return fi, missing
+
     def get_object(self, bucket: str, obj: str, offset: int = 0,
                    length: int = -1, version_id: str = ""
                    ) -> tuple[ObjectInfo, Iterator[bytes]]:

@@ -218,12 +218,17 @@ def heal_fresh_disks(pools) -> list[dict]:
     done: list[dict] = []
     for pool in getattr(pools, "pools", [pools]):
         for es in pool.sets:
-            fresh = [d for d in es.disks
-                     if d is not None and d.is_online()
-                     and load_healing_tracker(d) is not None]
+            trackers = {}
+            fresh = []
+            for d in es.disks:
+                if d is None or not d.is_online():
+                    continue
+                t = load_healing_tracker(d)
+                if t is not None:
+                    trackers[id(d)] = t
+                    fresh.append(d)
             if not fresh:
                 continue
-            trackers = {id(d): load_healing_tracker(d) for d in fresh}
             # heal every bucket+object in this set
             for vol in _set_buckets(es):
                 for name in _set_objects(es, vol):
@@ -244,6 +249,7 @@ def heal_fresh_disks(pools) -> list[dict]:
 
 
 def _set_buckets(es) -> list[str]:
+    """Non-system buckets visible on any online drive of one erasure set."""
     vols: set[str] = set()
     for d in es.disks:
         if d is None or not d.is_online():

@@ -53,6 +53,7 @@ class MRFQueue:
         self.stats = MRFStats()
         self._q: queue.Queue = queue.Queue(maxsize=self.MAX_PENDING)
         self._inflight: set[_HealTask] = set()
+        self._active = 0  # heals currently executing (for drain)
         self._mu = threading.Lock()
         self._stop = threading.Event()
         self._worker = threading.Thread(target=self._run, daemon=True,
@@ -83,6 +84,12 @@ class MRFQueue:
                 t = self._q.get(timeout=0.2)
             except queue.Empty:
                 continue
+            # drop the dedup entry as soon as the task is picked up (like
+            # the reference mrf): damage inflicted while this heal runs
+            # must be re-enqueueable, not silently discarded
+            with self._mu:
+                self._inflight.discard(t)
+                self._active += 1
             # brief settle delay so in-flight renames finish (reference
             # sleeps up to a second before MRF healing)
             if self.delay:
@@ -98,7 +105,7 @@ class MRFQueue:
                     break
                 time.sleep(self.delay)
             with self._mu:
-                self._inflight.discard(t)
+                self._active -= 1
                 if ok:
                     self.stats.healed += 1
                 else:
@@ -111,7 +118,7 @@ class MRFQueue:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._mu:
-                if self._q.empty() and not self._inflight:
+                if self._q.empty() and not self._inflight and not self._active:
                     return True
             time.sleep(0.02)
         return False

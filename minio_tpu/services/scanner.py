@@ -161,8 +161,8 @@ class DataScanner:
         return info
 
     def _scan_set(self, es, info: DataUsageInfo) -> None:
-        n = len(es.disks)
-        for bucket in self._set_buckets(es):
+        from .heal import _set_buckets
+        for bucket in _set_buckets(es):
             usage = info.buckets.setdefault(bucket, BucketUsage())
             try:
                 names = es.list_objects(bucket)
@@ -171,7 +171,7 @@ class DataScanner:
             for name in names:
                 info.objects_scanned += 1
                 try:
-                    fi, fis, errs2 = es._quorum_info(bucket, name)
+                    fi, missing = es.object_health(bucket, name)
                 except errors.StorageError:
                     # unreadable object: a heal attempt may still recover
                     # or purge a dangling entry
@@ -179,12 +179,6 @@ class DataScanner:
                         self.heal_queue(bucket, name, "")
                         info.heals_triggered += 1
                     continue
-                # heal trigger: any drive missing this object's version
-                missing = sum(
-                    1 for i, f in enumerate(fis)
-                    if f is None and es.disks[i] is not None
-                    and es.disks[i].is_online()
-                )
                 if missing and self.heal_queue:
                     self.heal_queue(bucket, name, fi.version_id)
                     info.heals_triggered += 1
@@ -203,20 +197,6 @@ class DataScanner:
                 else:
                     usage.add(fi.size)
         return
-
-    @staticmethod
-    def _set_buckets(es) -> list[str]:
-        vols: set[str] = set()
-        for d in es.disks:
-            if d is None or not d.is_online():
-                continue
-            try:
-                for v in d.list_volumes():
-                    if not v.name.startswith("."):
-                        vols.add(v.name)
-            except Exception:
-                continue
-        return sorted(vols)
 
     # -- persistence ----------------------------------------------------------
     def _cache_disk(self):

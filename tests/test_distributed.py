@@ -189,3 +189,44 @@ def test_dsync_local_expiry():
     # simulate owner death: expire the entry
     lk._locks["a"]["expiry"]["u1"] = time.time() - 1
     assert lk.rlock("a", "u2"), "expired writer must not block new readers"
+
+
+def test_quorum_overlap_odd_cluster():
+    """Read and write quorums must intersect: n=3 -> reads need 2, so a
+    1-grant read cannot coexist with a 2-grant write (review regression)."""
+    lockers = [LocalLocker() for _ in range(3)]
+    clients = [_LocalLockerClient(l) for l in lockers]
+    m = DRWMutex("k", clients)
+    assert m.quorum == 2
+    assert m.read_quorum == 2  # n - n//2, not n//2
+
+
+def test_dead_peer_is_offline():
+    """A connection-refused peer must report offline, not alive
+    (review regression: transport errors used to count as liveness)."""
+    from minio_tpu.distributed.rpc import RpcClient
+
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()  # nothing listens here now
+    c = RpcClient("127.0.0.1", port, "secret", timeout=1.0)
+    assert c.is_online() is False
+
+
+def test_remote_walk_dir_streams(cluster, tmp_path):
+    """walk_dir over RPC streams batches and surfaces VolumeNotFound."""
+    n1, n2 = cluster
+    n1.pools.make_bucket("wb")
+    for i in range(7):
+        d = bytes([i]) * 100
+        n1.pools.put_object("wb", f"dir{i % 2}/o{i}", io.BytesIO(d), len(d))
+    # find a drive that is remote from node 2's perspective
+    remote = next(d for d in n2.pools.pools[0].all_disks if not d.is_local())
+    names = sorted(remote.walk_dir("wb"))
+    assert names == sorted(
+        f"dir{i % 2}/o{i}" for i in range(7)
+    )
+    with pytest.raises(errors.VolumeNotFound):
+        list(remote.walk_dir("no-such-bucket"))
