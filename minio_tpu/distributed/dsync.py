@@ -215,6 +215,10 @@ class DRWMutex:
             return sum(results)
 
     def _acquire(self, op: str) -> bool:
+        # re-arm for re-acquisition: a stale _released from a previous
+        # lock/unlock cycle would make every new grant self-release
+        self._released.clear()
+        self.lost.clear()
         deadline = time.time() + self.timeout
         uid = str(uuid.uuid4())
         need = self.read_quorum if op == "rlock" else self.quorum

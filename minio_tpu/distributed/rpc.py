@@ -128,14 +128,51 @@ class RpcClient:
                 self._last_check = time.time()
 
     # -- calls --------------------------------------------------------------
+    def _send_request(self, conn, method: str, payload: bytes,
+                      body: bytes) -> "http.client.HTTPResponse":
+        path = f"{RPC_PREFIX}/{urllib.parse.quote(method)}"
+        conn.putrequest("POST", path)
+        conn.putheader("x-minio-tpu-token", auth_token(self.secret))
+        conn.putheader("x-args-length", str(len(payload)))
+        conn.putheader("Content-Length", str(len(payload) + len(body)))
+        conn.endheaders()
+        conn.send(payload)
+        if body:
+            conn.send(body)
+        return conn.getresponse()
+
+    def _decode_response(self, conn, resp, method: str,
+                         want_stream: bool, pool: bool):
+        self._mark_online()  # any HTTP response proves liveness
+        if resp.status != 200:
+            data = resp.read()
+            if pool:
+                self._put_conn(conn)
+            try:
+                doc = msgpack.unpackb(data, raw=False)
+                raise unpack_error(doc)
+            except (ValueError, msgpack.UnpackException):
+                raise errors.DiskNotFound(
+                    f"rpc {method} -> HTTP {resp.status}"
+                )
+        if want_stream:
+            return _StreamResponse(conn, resp)  # conn not pooled
+        data = resp.read()
+        if pool:
+            self._put_conn(conn)
+        if not data:
+            return None
+        return msgpack.unpackb(data, raw=False)
+
     def call(self, method: str, args: dict, body: bytes = b"",
              want_stream: bool = False, idempotent: bool = True):
         """POST args (+ raw body tail); returns decoded result (or a
         response object for streaming reads).
 
-        Non-idempotent calls (appends, renames) get a fresh connection and
-        NO retry: a retry after a mid-request failure could re-apply an
-        operation the server already performed."""
+        Non-idempotent calls (appends, renames) get NO retry: a retry
+        after a mid-request failure could re-apply an operation the server
+        already performed.  For sequences of non-idempotent calls use
+        session() to keep one persistent connection."""
         payload = msgpack.packb(args, use_bin_type=True)
         # one retry on a stale pooled connection (idempotent calls only)
         attempts = (0, 1) if idempotent else (1,)
@@ -146,40 +183,49 @@ class RpcClient:
                 conn = http.client.HTTPConnection(self.host, self.port,
                                                   timeout=self.timeout)
             try:
-                path = f"{RPC_PREFIX}/{urllib.parse.quote(method)}"
-                conn.putrequest("POST", path)
-                conn.putheader("x-minio-tpu-token", auth_token(self.secret))
-                conn.putheader("x-args-length", str(len(payload)))
-                conn.putheader("Content-Length", str(len(payload) + len(body)))
-                conn.endheaders()
-                conn.send(payload)
-                if body:
-                    conn.send(body)
-                resp = conn.getresponse()
+                resp = self._send_request(conn, method, payload, body)
             except (OSError, http.client.HTTPException) as e:
                 conn.close()
                 if attempt == 0:
                     continue  # stale keep-alive connection; retry fresh
                 self.mark_offline()
                 raise RpcTransportError(f"rpc {method}: {e}")
-            self._mark_online()  # any HTTP response proves liveness
-            if resp.status != 200:
-                data = resp.read()
-                self._put_conn(conn)
-                try:
-                    doc = msgpack.unpackb(data, raw=False)
-                    raise unpack_error(doc)
-                except (ValueError, msgpack.UnpackException):
-                    raise errors.DiskNotFound(
-                        f"rpc {method} -> HTTP {resp.status}"
-                    )
-            if want_stream:
-                return _StreamResponse(conn, resp)  # conn not pooled
-            data = resp.read()
-            self._put_conn(conn)
-            if not data:
-                return None
-            return msgpack.unpackb(data, raw=False)
+            return self._decode_response(conn, resp, method, want_stream,
+                                         pool=True)
+
+    def session(self) -> "RpcSession":
+        return RpcSession(self)
+
+
+class RpcSession:
+    """One persistent connection for a sequence of non-idempotent calls
+    (e.g. the chunked appends of a remote shard write).  No retries: any
+    transport failure surfaces immediately and poisons the session."""
+
+    def __init__(self, client: RpcClient):
+        self.client = client
+        self._conn = None
+
+    def call(self, method: str, args: dict, body: bytes = b""):
+        c = self.client
+        if self._conn is None:
+            self._conn = http.client.HTTPConnection(
+                c.host, c.port, timeout=c.timeout
+            )
+        payload = msgpack.packb(args, use_bin_type=True)
+        try:
+            resp = c._send_request(self._conn, method, payload, body)
+        except (OSError, http.client.HTTPException) as e:
+            self.close()
+            c.mark_offline()
+            raise RpcTransportError(f"rpc {method}: {e}")
+        return c._decode_response(self._conn, resp, method,
+                                  want_stream=False, pool=False)
+
+    def close(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
 
 
 class _StreamResponse:

@@ -213,7 +213,7 @@ class _RemoteWriter(io.RawIOBase):
     appends in chunks (shard streams)."""
 
     def __init__(self, client: RpcClient, drive_id: str, volume: str, path: str):
-        self.client = client
+        self.session = client.session()
         self.args = {"drive": drive_id, "volume": volume, "path": path}
         self.buf = bytearray()
         self.first = True
@@ -231,18 +231,22 @@ class _RemoteWriter(io.RawIOBase):
 
     def _flush(self) -> None:
         if self.buf or self.first:
-            self.client.call(
+            # persistent session, no blind retry: a retry after a
+            # mid-request failure would double-append
+            self.session.call(
                 "storage.append_file",
                 {**self.args, "append": not self.first},
                 bytes(self.buf),
-                idempotent=False,  # a blind retry would double-append
             )
             self.buf.clear()
             self.first = False
 
     def close(self) -> None:
         if not self.closed_:
-            self._flush()
+            try:
+                self._flush()
+            finally:
+                self.session.close()
             self.closed_ = True
 
 
@@ -386,13 +390,21 @@ class RemoteStorage(StorageAPI):
 
     def walk_dir(self, volume: str, base: str = "",
                  recursive: bool = True) -> Iterator[str]:
+        import http.client as _hc
+
         resp = self._call("walk_dir", {
             "volume": volume, "base": base, "recursive": recursive
         }, want_stream=True)
         unpacker = msgpack.Unpacker(raw=False)
         try:
             while True:
-                data = resp.read(1 << 16)
+                try:
+                    data = resp.read(1 << 16)
+                except (OSError, _hc.HTTPException) as e:
+                    # mid-stream drive error aborts the chunked response;
+                    # surface it as a storage error like the pre-streaming
+                    # path did, so callers' drive-failure handling fires
+                    raise errors.DiskNotFound(f"walk_dir stream: {e}")
                 if not data:
                     break
                 unpacker.feed(data)

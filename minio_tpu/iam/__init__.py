@@ -1,0 +1,10 @@
+"""IAM: identities, policies, STS (reference cmd/iam.go + internal policy)."""
+
+from .policy import (CANNED_POLICIES, Policy, PolicyArgs, PolicyError,
+                     Statement, match_pattern)
+from .sys import IAMError, IAMSys, Identity
+
+__all__ = [
+    "CANNED_POLICIES", "IAMError", "IAMSys", "Identity", "Policy",
+    "PolicyArgs", "PolicyError", "Statement", "match_pattern",
+]

@@ -1,0 +1,287 @@
+"""IAM/bucket policy documents and evaluation.
+
+Equivalent of the reference's policy engine (internal/bucket/policy +
+the iam policy package used by cmd/iam.go): JSON policy documents with
+Version/Statement/Effect/Action/Resource/Condition, wildcard matching,
+and deny-overrides-allow evaluation.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+from dataclasses import dataclass, field
+
+ARN_PREFIX = "arn:aws:s3:::"
+
+
+class PolicyError(ValueError):
+    pass
+
+
+def match_pattern(pattern: str, value: str) -> bool:
+    """AWS-style wildcard match: * crosses '/' boundaries, ? is one char."""
+    # fnmatch translates * to .* (crossing /) and ? to . — matching AWS
+    # semantics; escape [ ] which fnmatch treats as character classes
+    pattern = pattern.replace("[", "[[]")
+    return fnmatch.fnmatchcase(value, pattern)
+
+
+@dataclass
+class PolicyArgs:
+    action: str                      # e.g. "s3:GetObject"
+    bucket: str = ""
+    object: str = ""
+    account: str = ""                # requesting access key
+    conditions: dict = field(default_factory=dict)
+    is_owner: bool = False
+
+    @property
+    def resource(self) -> str:
+        if self.object:
+            return f"{self.bucket}/{self.object}"
+        return self.bucket
+
+
+@dataclass
+class Statement:
+    effect: str                      # "Allow" | "Deny"
+    actions: list[str]
+    resources: list[str]             # without the arn prefix
+    not_actions: list[str] = field(default_factory=list)
+    conditions: dict = field(default_factory=dict)
+    principals: list[str] | None = None   # None = IAM policy (no principal)
+    sid: str = ""
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Statement":
+        effect = d.get("Effect", "")
+        if effect not in ("Allow", "Deny"):
+            raise PolicyError(f"invalid Effect {effect!r}")
+
+        def as_list(v):
+            if v is None:
+                return []
+            return [v] if isinstance(v, str) else list(v)
+
+        resources = [
+            r[len(ARN_PREFIX):] if r.startswith(ARN_PREFIX) else r
+            for r in as_list(d.get("Resource"))
+        ]
+        principals = None
+        if "Principal" in d:
+            p = d["Principal"]
+            if p == "*" or p == {"AWS": "*"}:
+                principals = ["*"]
+            elif isinstance(p, dict):
+                principals = as_list(p.get("AWS"))
+            else:
+                principals = as_list(p)
+        conditions = d.get("Condition", {}) or {}
+        for op in conditions:
+            if op not in cls.KNOWN_CONDITION_OPS:
+                raise PolicyError(f"unsupported condition operator {op!r}")
+        return cls(
+            effect=effect,
+            actions=as_list(d.get("Action")),
+            not_actions=as_list(d.get("NotAction")),
+            resources=resources,
+            conditions=conditions,
+            principals=principals,
+            sid=d.get("Sid", ""),
+        )
+
+    def to_dict(self) -> dict:
+        d: dict = {"Effect": self.effect}
+        if self.sid:
+            d["Sid"] = self.sid
+        if self.principals is not None:
+            d["Principal"] = {"AWS": self.principals}
+        if self.actions:
+            d["Action"] = self.actions
+        if self.not_actions:
+            d["NotAction"] = self.not_actions
+        d["Resource"] = [ARN_PREFIX + r for r in self.resources]
+        if self.conditions:
+            d["Condition"] = self.conditions
+        return d
+
+    # -- matching ------------------------------------------------------------
+    def _action_matches(self, action: str) -> bool:
+        if self.not_actions:
+            return not any(match_pattern(a, action) for a in self.not_actions)
+        return any(match_pattern(a, action) for a in self.actions)
+
+    def _resource_matches(self, args: PolicyArgs) -> bool:
+        if not self.resources:
+            return False
+        res = args.resource
+        for r in self.resources:
+            if match_pattern(r, res):
+                return True
+            # bucket-level actions also match "bucket/*" statements
+            if not args.object and r.endswith("/*") and \
+                    match_pattern(r[:-2], args.bucket):
+                return True
+        return False
+
+    def _principal_matches(self, account: str) -> bool:
+        if self.principals is None:
+            return True  # IAM policy: applies to the attached identity
+        return any(p == "*" or match_pattern(p, account)
+                   for p in self.principals)
+
+    def _conditions_match(self, args: PolicyArgs) -> bool:
+        for op, kv in self.conditions.items():
+            for key, want in kv.items():
+                want_list = [want] if isinstance(want, (str, bool)) \
+                    else list(want)
+                got = args.conditions.get(key, "")
+                if op == "StringEquals":
+                    if not any(got == w for w in want_list):
+                        return False
+                elif op == "StringNotEquals":
+                    if any(got == w for w in want_list):
+                        return False
+                elif op == "StringEqualsIgnoreCase":
+                    if not any(str(got).lower() == str(w).lower()
+                               for w in want_list):
+                        return False
+                elif op == "StringLike":
+                    if not any(match_pattern(w, got) for w in want_list):
+                        return False
+                elif op == "StringNotLike":
+                    if any(match_pattern(w, got) for w in want_list):
+                        return False
+                elif op == "Bool":
+                    want_b = str(want_list[0]).lower() == "true"
+                    got_b = str(got).lower() == "true"
+                    if got_b != want_b:
+                        return False
+                elif op == "IpAddress":
+                    if not any(_ip_in_cidr(got, w) for w in want_list):
+                        return False
+                elif op == "NotIpAddress":
+                    if any(_ip_in_cidr(got, w) for w in want_list):
+                        return False
+                else:
+                    # unknown operator (e.g. from a doc persisted by a
+                    # newer version): fail CLOSED — a Deny with an
+                    # unevaluable condition must still deny, and an Allow
+                    # must not grant
+                    return self.effect == "Deny"
+        return True
+
+    KNOWN_CONDITION_OPS = frozenset({
+        "StringEquals", "StringNotEquals", "StringEqualsIgnoreCase",
+        "StringLike", "StringNotLike", "Bool", "IpAddress", "NotIpAddress",
+    })
+
+    def matches(self, args: PolicyArgs) -> bool:
+        return (self._action_matches(args.action)
+                and self._resource_matches(args)
+                and self._principal_matches(args.account)
+                and self._conditions_match(args))
+
+
+def _ip_in_cidr(ip: str, cidr: str) -> bool:
+    import ipaddress
+    try:
+        return ipaddress.ip_address(ip) in ipaddress.ip_network(cidr,
+                                                                strict=False)
+    except ValueError:
+        return False
+
+
+@dataclass
+class Policy:
+    statements: list[Statement] = field(default_factory=list)
+    version: str = "2012-10-17"
+    id: str = ""
+
+    @classmethod
+    def from_json(cls, raw: str | bytes) -> "Policy":
+        try:
+            d = json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise PolicyError(f"malformed policy JSON: {e}")
+        stmts = d.get("Statement", [])
+        if isinstance(stmts, dict):
+            stmts = [stmts]
+        return cls(
+            statements=[Statement.from_dict(s) for s in stmts],
+            version=d.get("Version", "2012-10-17"),
+            id=d.get("Id", ""),
+        )
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "Version": self.version,
+            **({"Id": self.id} if self.id else {}),
+            "Statement": [s.to_dict() for s in self.statements],
+        })
+
+    def is_allowed(self, args: PolicyArgs) -> bool:
+        """Deny overrides allow (reference policy.Policy.IsAllowed)."""
+        allowed = False
+        for s in self.statements:
+            if s.matches(args):
+                if s.effect == "Deny":
+                    return False
+                allowed = True
+        return allowed
+
+    def is_empty(self) -> bool:
+        return not self.statements
+
+    def merge(self, other: "Policy") -> "Policy":
+        return Policy(statements=self.statements + other.statements)
+
+
+# -- canned policies (reference: iampolicy predefined policies) -------------
+
+READ_ONLY = Policy.from_json(json.dumps({
+    "Version": "2012-10-17",
+    "Statement": [{
+        "Effect": "Allow",
+        "Action": ["s3:GetBucketLocation", "s3:GetObject", "s3:ListBucket",
+                   "s3:ListAllMyBuckets", "s3:GetBucketVersioning"],
+        "Resource": ["arn:aws:s3:::*"],
+    }],
+}))
+
+WRITE_ONLY = Policy.from_json(json.dumps({
+    "Version": "2012-10-17",
+    "Statement": [{
+        "Effect": "Allow",
+        "Action": ["s3:PutObject", "s3:AbortMultipartUpload",
+                   "s3:ListMultipartUploadParts",
+                   "s3:ListBucketMultipartUploads"],
+        "Resource": ["arn:aws:s3:::*"],
+    }],
+}))
+
+READ_WRITE = Policy.from_json(json.dumps({
+    "Version": "2012-10-17",
+    "Statement": [{
+        "Effect": "Allow",
+        "Action": ["s3:*"],
+        "Resource": ["arn:aws:s3:::*"],
+    }],
+}))
+
+CONSOLE_ADMIN = Policy.from_json(json.dumps({
+    "Version": "2012-10-17",
+    "Statement": [{
+        "Effect": "Allow",
+        "Action": ["s3:*", "admin:*"],
+        "Resource": ["arn:aws:s3:::*"],
+    }],
+}))
+
+CANNED_POLICIES: dict[str, Policy] = {
+    "readonly": READ_ONLY,
+    "writeonly": WRITE_ONLY,
+    "readwrite": READ_WRITE,
+    "consoleAdmin": CONSOLE_ADMIN,
+}

@@ -1,0 +1,367 @@
+"""IAM subsystem: users, groups, service accounts, STS, policy attachment.
+
+Equivalent of the reference's IAMSys (cmd/iam.go:1537) with the
+object-backend store (cmd/iam-object-store.go): identities and policy
+documents persist as JSON blobs under `config/iam/` in the system volume
+of the first pool's drives, mirrored to every drive and read from the
+first healthy one (the same pattern the bucket metadata system uses).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import secrets as pysecrets
+import threading
+import time
+from dataclasses import dataclass, field
+
+from minio_tpu.storage import errors
+from minio_tpu.storage.local import SYSTEM_VOL
+
+from .policy import CANNED_POLICIES, Policy, PolicyArgs
+
+IAM_PREFIX = "config/iam"
+
+
+class IAMError(Exception):
+    pass
+
+
+@dataclass
+class Identity:
+    access_key: str
+    secret_key: str
+    kind: str = "user"               # user | svc | sts | root
+    status: str = "enabled"          # enabled | disabled
+    policies: list[str] = field(default_factory=list)
+    groups: list[str] = field(default_factory=list)
+    parent: str = ""                 # svc/sts: owning user
+    session_policy: str = ""         # svc/sts: inline policy JSON (intersect)
+    session_token: str = ""
+    expiry: float = 0.0              # sts: unix expiry (0 = never)
+
+    def expired(self) -> bool:
+        return self.expiry > 0 and time.time() > self.expiry
+
+    def to_dict(self) -> dict:
+        return self.__dict__.copy()
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Identity":
+        return cls(**{k: d[k] for k in cls.__dataclass_fields__ if k in d})
+
+
+class IamStore:
+    """JSON-blob KV over the system volume of a pool's drives."""
+
+    def __init__(self, pools):
+        self.pools = pools
+
+    def _disks(self):
+        pool = getattr(self.pools, "pools", [self.pools])[0]
+        return [d for d in pool.all_disks if d is not None and d.is_online()]
+
+    def save(self, path: str, doc: dict) -> None:
+        raw = json.dumps(doc).encode()
+        ok = 0
+        for d in self._disks():
+            try:
+                d.write_all(SYSTEM_VOL, f"{IAM_PREFIX}/{path}", raw)
+                ok += 1
+            except errors.StorageError:
+                continue
+        if ok == 0:
+            raise IAMError(f"cannot persist {path}")
+
+    def load(self, path: str) -> dict | None:
+        for d in self._disks():
+            try:
+                return json.loads(d.read_all(SYSTEM_VOL, f"{IAM_PREFIX}/{path}"))
+            except errors.StorageError:
+                continue
+            except json.JSONDecodeError:
+                continue
+        return None
+
+    def delete(self, path: str) -> None:
+        for d in self._disks():
+            try:
+                d.delete(SYSTEM_VOL, f"{IAM_PREFIX}/{path}")
+            except errors.StorageError:
+                continue
+
+    def list(self, prefix: str) -> list[str]:
+        names: set[str] = set()
+        for d in self._disks():
+            try:
+                for e in d.list_dir(SYSTEM_VOL, f"{IAM_PREFIX}/{prefix}"):
+                    if e.endswith(".json"):
+                        names.add(e[:-5])
+            except errors.StorageError:
+                continue
+        return sorted(names)
+
+
+class IAMSys:
+    """In-memory identity/policy maps + persistent store."""
+
+    def __init__(self, pools, root_access_key: str, root_secret_key: str):
+        self.store = IamStore(pools)
+        self.root = Identity(root_access_key, root_secret_key, kind="root",
+                             policies=["consoleAdmin"])
+        self._mu = threading.RLock()
+        self.users: dict[str, Identity] = {}
+        self.policies: dict[str, Policy] = dict(CANNED_POLICIES)
+        self.groups: dict[str, dict] = {}   # name -> {"members": [...], "policies": [...]}
+        self._load()
+
+    # -- persistence --------------------------------------------------------
+    def _load(self) -> None:
+        with self._mu:
+            for name in self.store.list("policies"):
+                doc = self.store.load(f"policies/{name}.json")
+                if doc:
+                    try:
+                        self.policies[name] = Policy.from_json(
+                            json.dumps(doc))
+                    except Exception:
+                        continue
+            for ak in self.store.list("users"):
+                doc = self.store.load(f"users/{ak}.json")
+                if doc:
+                    ident = Identity.from_dict(doc)
+                    if not ident.expired():
+                        self.users[ak] = ident
+            for name in self.store.list("groups"):
+                doc = self.store.load(f"groups/{name}.json")
+                if doc:
+                    self.groups[name] = doc
+
+    def _save_user(self, ident: Identity) -> None:
+        self.store.save(f"users/{ident.access_key}.json", ident.to_dict())
+
+    # -- user CRUD ----------------------------------------------------------
+    def add_user(self, access_key: str, secret_key: str,
+                 policies: list[str] | None = None) -> Identity:
+        if access_key == self.root.access_key:
+            raise IAMError("cannot shadow root credentials")
+        with self._mu:
+            ident = Identity(access_key, secret_key,
+                             policies=list(policies or []))
+            self.users[access_key] = ident
+            self._save_user(ident)
+            return ident
+
+    def remove_user(self, access_key: str) -> None:
+        with self._mu:
+            if access_key not in self.users:
+                raise IAMError(f"no such user {access_key}")
+            del self.users[access_key]
+            self.store.delete(f"users/{access_key}.json")
+            # cascade: drop service accounts/STS creds owned by this user
+            for ak, ident in list(self.users.items()):
+                if ident.parent == access_key:
+                    del self.users[ak]
+                    self.store.delete(f"users/{ak}.json")
+
+    def set_user_status(self, access_key: str, enabled: bool) -> None:
+        with self._mu:
+            ident = self.users.get(access_key)
+            if ident is None:
+                raise IAMError(f"no such user {access_key}")
+            ident.status = "enabled" if enabled else "disabled"
+            self._save_user(ident)
+
+    def list_users(self) -> list[dict]:
+        with self._mu:
+            return [
+                {"accessKey": ak, "status": u.status, "policies": u.policies,
+                 "groups": u.groups}
+                for ak, u in sorted(self.users.items()) if u.kind == "user"
+            ]
+
+    # -- policy CRUD --------------------------------------------------------
+    def set_policy(self, name: str, doc_json: str | bytes) -> None:
+        pol = Policy.from_json(doc_json)  # validates
+        with self._mu:
+            self.policies[name] = pol
+            self.store.save(f"policies/{name}.json",
+                            json.loads(pol.to_json()))
+
+    def delete_policy(self, name: str) -> None:
+        with self._mu:
+            if name in CANNED_POLICIES:
+                raise IAMError(f"cannot delete canned policy {name}")
+            if name not in self.policies:
+                raise IAMError(f"no such policy {name}")
+            del self.policies[name]
+            self.store.delete(f"policies/{name}.json")
+
+    def get_policy(self, name: str) -> Policy | None:
+        with self._mu:
+            return self.policies.get(name)
+
+    def list_policies(self) -> list[str]:
+        with self._mu:
+            return sorted(self.policies)
+
+    def attach_policy(self, access_key: str, names: list[str]) -> None:
+        with self._mu:
+            for n in names:
+                if n not in self.policies:
+                    raise IAMError(f"no such policy {n}")
+            ident = self.users.get(access_key)
+            if ident is None:
+                raise IAMError(f"no such user {access_key}")
+            ident.policies = list(dict.fromkeys(names))
+            self._save_user(ident)
+
+    # -- groups -------------------------------------------------------------
+    def add_group_members(self, group: str, members: list[str]) -> None:
+        with self._mu:
+            g = self.groups.setdefault(group,
+                                       {"members": [], "policies": []})
+            for m in members:
+                if m not in self.users:
+                    raise IAMError(f"no such user {m}")
+                if m not in g["members"]:
+                    g["members"].append(m)
+                u = self.users[m]
+                if group not in u.groups:
+                    u.groups.append(group)
+                    self._save_user(u)
+            self.store.save(f"groups/{group}.json", g)
+
+    def remove_group_members(self, group: str, members: list[str]) -> None:
+        with self._mu:
+            g = self.groups.get(group)
+            if g is None:
+                raise IAMError(f"no such group {group}")
+            for m in members:
+                if m in g["members"]:
+                    g["members"].remove(m)
+                u = self.users.get(m)
+                if u and group in u.groups:
+                    u.groups.remove(group)
+                    self._save_user(u)
+            if g["members"]:
+                self.store.save(f"groups/{group}.json", g)
+            else:
+                del self.groups[group]
+                self.store.delete(f"groups/{group}.json")
+
+    def attach_group_policy(self, group: str, names: list[str]) -> None:
+        with self._mu:
+            g = self.groups.get(group)
+            if g is None:
+                raise IAMError(f"no such group {group}")
+            for n in names:
+                if n not in self.policies:
+                    raise IAMError(f"no such policy {n}")
+            g["policies"] = list(dict.fromkeys(names))
+            self.store.save(f"groups/{group}.json", g)
+
+    def list_groups(self) -> list[str]:
+        with self._mu:
+            return sorted(self.groups)
+
+    # -- service accounts ----------------------------------------------------
+    def create_service_account(self, parent_ak: str,
+                               session_policy: str = "") -> Identity:
+        with self._mu:
+            if parent_ak != self.root.access_key and \
+                    parent_ak not in self.users:
+                raise IAMError(f"no such user {parent_ak}")
+            ak = "SVC" + pysecrets.token_hex(8).upper()
+            sk = base64.urlsafe_b64encode(pysecrets.token_bytes(24)).decode()
+            ident = Identity(ak, sk, kind="svc", parent=parent_ak,
+                             session_policy=session_policy)
+            self.users[ak] = ident
+            self._save_user(ident)
+            return ident
+
+    # -- STS -----------------------------------------------------------------
+    def assume_role(self, parent_ak: str, duration: int = 3600,
+                    session_policy: str = "") -> Identity:
+        """Temporary credentials inheriting (or restricting) the parent's
+        permissions (reference AssumeRole, cmd/sts-handlers.go)."""
+        with self._mu:
+            if parent_ak != self.root.access_key and \
+                    parent_ak not in self.users:
+                raise IAMError(f"no such user {parent_ak}")
+            duration = max(900, min(duration, 7 * 24 * 3600))
+            ak = "STS" + pysecrets.token_hex(8).upper()
+            sk = base64.urlsafe_b64encode(pysecrets.token_bytes(24)).decode()
+            expiry = time.time() + duration
+            token = self._session_token(ak, parent_ak, expiry)
+            ident = Identity(ak, sk, kind="sts", parent=parent_ak,
+                             session_policy=session_policy,
+                             session_token=token, expiry=expiry)
+            self.users[ak] = ident
+            self._save_user(ident)
+            return ident
+
+    def _session_token(self, ak: str, parent: str, expiry: float) -> str:
+        claims = json.dumps({"ak": ak, "parent": parent, "exp": expiry})
+        mac = hmac.new(self.root.secret_key.encode(), claims.encode(),
+                       hashlib.sha256).hexdigest()[:32]
+        return base64.urlsafe_b64encode(
+            f"{claims}.{mac}".encode()
+        ).decode()
+
+    # -- auth hooks -----------------------------------------------------------
+    def get_secret(self, access_key: str) -> str | None:
+        """creds_lookup for SigV4 verification."""
+        if access_key == self.root.access_key:
+            return self.root.secret_key
+        with self._mu:
+            ident = self.users.get(access_key)
+            if ident is None or ident.status != "enabled" or ident.expired():
+                return None
+            return ident.secret_key
+
+    def _effective_policy(self, ident: Identity) -> Policy:
+        names = list(ident.policies)
+        for g in ident.groups:
+            names += self.groups.get(g, {}).get("policies", [])
+        stmts = []
+        for n in dict.fromkeys(names):
+            p = self.policies.get(n)
+            if p:
+                stmts += p.statements
+        return Policy(statements=stmts)
+
+    def is_allowed(self, access_key: str, action: str, bucket: str = "",
+                   obj: str = "", conditions: dict | None = None) -> bool:
+        if access_key == self.root.access_key:
+            return True
+        with self._mu:
+            ident = self.users.get(access_key)
+            if ident is None or ident.status != "enabled" or ident.expired():
+                return False
+            args = PolicyArgs(action=action, bucket=bucket, object=obj,
+                              account=access_key,
+                              conditions=conditions or {})
+            if ident.kind in ("svc", "sts"):
+                # inherit the parent's permission set
+                if ident.parent == self.root.access_key:
+                    base_ok = True
+                else:
+                    parent = self.users.get(ident.parent)
+                    if parent is None or parent.status != "enabled":
+                        return False
+                    base_ok = self._effective_policy(parent).is_allowed(args)
+                if not base_ok:
+                    return False
+                # session policy (if any) further restricts
+                if ident.session_policy:
+                    try:
+                        sp = Policy.from_json(ident.session_policy)
+                    except Exception:
+                        return False
+                    return sp.is_allowed(args)
+                return True
+            return self._effective_policy(ident).is_allowed(args)

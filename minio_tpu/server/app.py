@@ -147,11 +147,14 @@ class _QueuePipeReader(io.RawIOBase):
 class S3Server:
     def __init__(self, object_layer, access_key: str = "minioadmin",
                  secret_key: str = "minioadmin", region: str = "us-east-1",
-                 max_concurrency: int = 64):
+                 max_concurrency: int = 64, iam=None):
         import concurrent.futures as cf
+        from minio_tpu.iam import IAMSys
 
         self.api = object_layer
-        self.creds = {access_key: secret_key}
+        self.iam = iam if iam is not None else IAMSys(
+            object_layer, access_key, secret_key
+        )
         self.region = region
         self.sem = asyncio.Semaphore(max_concurrency)
         # Dedicated pool sized to the request semaphore so a full house of
@@ -189,7 +192,10 @@ class S3Server:
             headers={"Server": "MinIO-TPU"},
         )
 
-    def _auth(self, request: web.Request, payload_hash: str | None) -> str:
+    def _auth(self, request: web.Request, payload_hash: str | None,
+              action: str = "", bucket: str = "", obj: str = ""):
+        """SigV4 verification + IAM authorization for `action` on the
+        resource (reference checkRequestAuthType, cmd/auth-handler.go)."""
         query = [(k, v) for k, v in urllib.parse.parse_qsl(
             request.rel_url.query_string, keep_blank_values=True
         )]
@@ -198,16 +204,24 @@ class S3Server:
         path = urllib.parse.unquote(request.rel_url.raw_path)
         try:
             if "X-Amz-Signature" in dict(query):
-                return sigv4.verify_v4_presigned(
+                ctx = sigv4.verify_v4_presigned(
                     request.method, path, query, headers,
-                    self.creds.get, self.region,
+                    self.iam.get_secret, self.region,
                 )
-            return sigv4.verify_v4(
-                request.method, path, query, headers, payload_hash,
-                self.creds.get, self.region,
-            )
+            else:
+                ctx = sigv4.verify_v4(
+                    request.method, path, query, headers, payload_hash,
+                    self.iam.get_secret, self.region,
+                )
         except sigv4.SigV4Error as e:
             raise S3Error(e.code, str(e))
+        if action and not self.iam.is_allowed(
+            ctx.access_key, action, bucket, obj,
+            conditions={"aws:SourceIp": request.remote or ""},
+        ):
+            raise S3Error("AccessDenied", f"not allowed to {action}",
+                          resource=request.path)
+        return ctx
 
     async def _handle(self, request: web.Request, fn) -> web.StreamResponse:
         async with self.sem:
@@ -229,7 +243,45 @@ class S3Server:
 
     # -------------------------------------------------------------- dispatch
     async def dispatch_root(self, request: web.Request) -> web.StreamResponse:
+        if request.method == "POST":
+            return await self._handle(request, self.sts_handler)
         return await self._handle(request, self.list_buckets)
+
+    # ------------------------------------------------------------------ STS
+    async def sts_handler(self, request: web.Request) -> web.Response:
+        """AssumeRole: temporary credentials for the signing identity
+        (reference AssumeRole, cmd/sts-handlers.go)."""
+        body = await request.read()
+        form = dict(urllib.parse.parse_qsl(body.decode("utf-8", "replace")))
+        ctx = self._auth(request, hashlib.sha256(body).hexdigest())
+        action = form.get("Action", "")
+        if action != "AssumeRole":
+            raise S3Error("InvalidArgument", f"unsupported STS action {action}")
+        try:
+            duration = int(form.get("DurationSeconds", "3600") or "3600")
+        except ValueError:
+            raise S3Error("InvalidArgument", "malformed DurationSeconds")
+        session_policy = form.get("Policy", "")
+        from minio_tpu.iam import IAMError
+
+        try:
+            ident = await self._run(
+                self.iam.assume_role, ctx.access_key, duration, session_policy
+            )
+        except IAMError as e:
+            raise S3Error("AccessDenied", str(e))
+        exp = _iso(ident.expiry)
+        return self._xml(200, (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            '<AssumeRoleResponse xmlns='
+            '"https://sts.amazonaws.com/doc/2011-06-15/">'
+            "<AssumeRoleResult><Credentials>"
+            f"<AccessKeyId>{escape(ident.access_key)}</AccessKeyId>"
+            f"<SecretAccessKey>{escape(ident.secret_key)}</SecretAccessKey>"
+            f"<SessionToken>{escape(ident.session_token)}</SessionToken>"
+            f"<Expiration>{exp}</Expiration>"
+            "</Credentials></AssumeRoleResult></AssumeRoleResponse>"
+        ))
 
     async def dispatch_bucket(self, request: web.Request) -> web.StreamResponse:
         q = request.rel_url.query
@@ -284,7 +336,7 @@ class S3Server:
 
     # ------------------------------------------------------------- service
     async def list_buckets(self, request: web.Request) -> web.Response:
-        self._auth(request, None)
+        self._auth(request, None, "s3:ListAllMyBuckets")
         vols = await self._run(self.api.list_buckets)
         buckets = "".join(
             f"<Bucket><Name>{escape(v.name)}</Name>"
@@ -306,28 +358,28 @@ class S3Server:
         return b
 
     async def make_bucket(self, request: web.Request) -> web.Response:
-        self._auth(request, None)
         bucket = self._bucket(request)
+        self._auth(request, None, "s3:CreateBucket", bucket)
         await request.read()
         await self._run(self.api.make_bucket, bucket)
         return web.Response(status=200, headers={"Location": f"/{bucket}"})
 
     async def head_bucket(self, request: web.Request) -> web.Response:
-        self._auth(request, None)
         bucket = self._bucket(request)
+        self._auth(request, None, "s3:ListBucket", bucket)
         if not await self._run(self.api.bucket_exists, bucket):
             raise S3Error("NoSuchBucket", resource=bucket)
         return web.Response(status=200)
 
     async def delete_bucket(self, request: web.Request) -> web.Response:
-        self._auth(request, None)
         bucket = self._bucket(request)
+        self._auth(request, None, "s3:DeleteBucket", bucket)
         await self._run(self.api.delete_bucket, bucket)
         return web.Response(status=204)
 
     async def bucket_location(self, request: web.Request) -> web.Response:
-        self._auth(request, None)
         bucket = self._bucket(request)
+        self._auth(request, None, "s3:GetBucketLocation", bucket)
         if not await self._run(self.api.bucket_exists, bucket):
             raise S3Error("NoSuchBucket", resource=bucket)
         return self._xml(200, (
@@ -337,8 +389,8 @@ class S3Server:
         ))
 
     async def get_versioning(self, request: web.Request) -> web.Response:
-        self._auth(request, None)
         bucket = self._bucket(request)
+        self._auth(request, None, "s3:GetBucketVersioning", bucket)
         enabled = await self._versioned(bucket)
         inner = "<Status>Enabled</Status>" if enabled else ""
         return self._xml(200, (
@@ -349,8 +401,9 @@ class S3Server:
 
     async def put_versioning(self, request: web.Request) -> web.Response:
         body = await request.read()
-        self._auth(request, hashlib.sha256(body).hexdigest())
         bucket = self._bucket(request)
+        self._auth(request, hashlib.sha256(body).hexdigest(),
+                   "s3:PutBucketVersioning", bucket)
         try:
             root = ET.fromstring(body)
             status = root.findtext(f"{{{XMLNS}}}Status") or root.findtext("Status")
@@ -363,8 +416,8 @@ class S3Server:
         return web.Response(status=200)
 
     async def list_objects(self, request: web.Request) -> web.Response:
-        self._auth(request, None)
         bucket = self._bucket(request)
+        self._auth(request, None, "s3:ListBucket", bucket)
         q = request.rel_url.query
         prefix = q.get("prefix", "")
         delimiter = q.get("delimiter", "")
@@ -429,8 +482,8 @@ class S3Server:
 
     async def delete_objects(self, request: web.Request) -> web.Response:
         body = await request.read()
-        self._auth(request, hashlib.sha256(body).hexdigest())
         bucket = self._bucket(request)
+        ctx = self._auth(request, hashlib.sha256(body).hexdigest())
         try:
             root = ET.fromstring(body)
         except ET.ParseError:
@@ -441,6 +494,16 @@ class S3Server:
         for obj in root.findall(f"{ns}Object") + root.findall("Object"):
             key = obj.findtext(f"{ns}Key") or obj.findtext("Key") or ""
             vid = obj.findtext(f"{ns}VersionId") or obj.findtext("VersionId") or ""
+            # per-key authorization: object-scoped Deny statements must
+            # apply to bulk deletes exactly as to single DELETEs
+            if not self.iam.is_allowed(ctx.access_key, "s3:DeleteObject",
+                                       bucket, key):
+                results.append(
+                    f"<Error><Key>{escape(key)}</Key>"
+                    f"<Code>AccessDenied</Code>"
+                    f"<Message>Access Denied</Message></Error>"
+                )
+                continue
             try:
                 await self._run(
                     self.api.delete_object, bucket, key, vid, versioned
@@ -485,12 +548,13 @@ class S3Server:
         sha_claim = request.headers.get("x-amz-content-sha256", "")
         copy_src = request.headers.get("x-amz-copy-source")
         if copy_src:
-            self._auth(request, sha_claim or sigv4.EMPTY_SHA256)
-            return await self.copy_object(request, bucket, key, copy_src)
+            ctx = self._auth(request, sha_claim or sigv4.EMPTY_SHA256,
+                             "s3:PutObject", bucket, key)
+            return await self.copy_object(request, bucket, key, copy_src, ctx)
 
         size = request.content_length
         streaming = sha_claim.startswith("STREAMING-")
-        ctx = self._auth(request, sha_claim or None)
+        ctx = self._auth(request, sha_claim or None, "s3:PutObject", bucket, key)
 
         decoded_len = request.headers.get("x-amz-decoded-content-length")
         real_size = int(decoded_len) if streaming and decoded_len else (
@@ -557,7 +621,7 @@ class S3Server:
         return bool(await self._run(fn, bucket))
 
     async def copy_object(self, request: web.Request, bucket: str, key: str,
-                          copy_src: str) -> web.Response:
+                          copy_src: str, ctx=None) -> web.Response:
         src = urllib.parse.unquote(copy_src)
         src = src.lstrip("/")
         if "?versionId=" in src:
@@ -568,6 +632,10 @@ class S3Server:
             sbucket, skey = src.split("/", 1)
         except ValueError:
             raise S3Error("InvalidArgument", "bad x-amz-copy-source")
+        if ctx is not None and not self.iam.is_allowed(
+            ctx.access_key, "s3:GetObject", sbucket, skey
+        ):
+            raise S3Error("AccessDenied", "not allowed to read copy source")
         oi, stream = await self._run(
             self.api.get_object, sbucket, skey, 0, -1, vid
         )
@@ -610,8 +678,8 @@ class S3Server:
         return start, end
 
     async def get_object(self, request: web.Request) -> web.StreamResponse:
-        self._auth(request, None)
         bucket, key = self._object(request)
+        self._auth(request, None, "s3:GetObject", bucket, key)
         vid = request.rel_url.query.get("versionId", "")
         oi = await self._run(self.api.get_object_info, bucket, key, vid)
 
@@ -644,8 +712,8 @@ class S3Server:
         return resp
 
     async def head_object(self, request: web.Request) -> web.Response:
-        self._auth(request, None)
         bucket, key = self._object(request)
+        self._auth(request, None, "s3:GetObject", bucket, key)
         vid = request.rel_url.query.get("versionId", "")
         oi = await self._run(self.api.get_object_info, bucket, key, vid)
         headers = self._obj_headers(oi)
@@ -653,8 +721,8 @@ class S3Server:
         return web.Response(status=200, headers=headers)
 
     async def delete_object(self, request: web.Request) -> web.Response:
-        self._auth(request, None)
         bucket, key = self._object(request)
+        self._auth(request, None, "s3:DeleteObject", bucket, key)
         vid = request.rel_url.query.get("versionId", "")
         versioned = await self._versioned(bucket)
         oi = await self._run(
@@ -669,8 +737,8 @@ class S3Server:
 
     # ----------------------------------------------------------- multipart
     async def create_upload(self, request: web.Request) -> web.Response:
-        self._auth(request, None)
         bucket, key = self._object(request)
+        self._auth(request, None, "s3:PutObject", bucket, key)
         opts = PutObjectOptions(
             content_type=request.headers.get("Content-Type", ""),
             user_metadata={
@@ -693,7 +761,7 @@ class S3Server:
         part_num = int(q["partNumber"])
         sha_claim = request.headers.get("x-amz-content-sha256", "")
         streaming = sha_claim.startswith("STREAMING-")
-        ctx = self._auth(request, sha_claim or None)
+        ctx = self._auth(request, sha_claim or None, "s3:PutObject", bucket, key)
         decoded_len = request.headers.get("x-amz-decoded-content-length")
         size = request.content_length
         real_size = int(decoded_len) if streaming and decoded_len else (
@@ -721,8 +789,8 @@ class S3Server:
         return web.Response(status=200, headers={"ETag": f'"{pi.etag}"'})
 
     async def list_parts(self, request: web.Request) -> web.Response:
-        self._auth(request, None)
         bucket, key = self._object(request)
+        self._auth(request, None, "s3:ListMultipartUploadParts", bucket, key)
         uid = request.rel_url.query["uploadId"]
         try:
             parts = await self._run(self.api.list_object_parts, bucket, key, uid)
@@ -742,8 +810,8 @@ class S3Server:
         ))
 
     async def list_uploads(self, request: web.Request) -> web.Response:
-        self._auth(request, None)
         bucket = self._bucket(request)
+        self._auth(request, None, "s3:ListBucketMultipartUploads", bucket)
         return self._xml(200, (
             f'<?xml version="1.0" encoding="UTF-8"?>'
             f'<ListMultipartUploadsResult xmlns="{XMLNS}">'
@@ -753,8 +821,8 @@ class S3Server:
         ))
 
     async def abort_upload(self, request: web.Request) -> web.Response:
-        self._auth(request, None)
         bucket, key = self._object(request)
+        self._auth(request, None, "s3:AbortMultipartUpload", bucket, key)
         uid = request.rel_url.query["uploadId"]
         try:
             await self._run(self.api.abort_multipart_upload, bucket, key, uid)
@@ -764,8 +832,9 @@ class S3Server:
 
     async def complete_upload(self, request: web.Request) -> web.Response:
         body = await request.read()
-        self._auth(request, hashlib.sha256(body).hexdigest())
         bucket, key = self._object(request)
+        self._auth(request, hashlib.sha256(body).hexdigest(),
+                   "s3:PutObject", bucket, key)
         uid = request.rel_url.query["uploadId"]
         try:
             root = ET.fromstring(body)
@@ -802,4 +871,6 @@ class S3Server:
 
 
 def make_app(object_layer, **kw) -> web.Application:
-    return S3Server(object_layer, **kw).app
+    srv = S3Server(object_layer, **kw)
+    srv.app["s3_server"] = srv
+    return srv.app

@@ -76,7 +76,8 @@ def sign_request(method: str, path: str, query: list[tuple[str, str]],
                  headers: dict[str, str], payload: bytes | None,
                  access_key: str, secret_key: str, region: str = "us-east-1",
                  amz_date: str | None = None,
-                 payload_hash: str | None = None) -> dict[str, str]:
+                 payload_hash: str | None = None,
+                 service: str = "s3") -> dict[str, str]:
     """Client-side signer: returns headers with Authorization added.
 
     Pass payload_hash=STREAMING_PAYLOAD (with payload=None) to produce the
@@ -93,12 +94,12 @@ def sign_request(method: str, path: str, query: list[tuple[str, str]],
     headers["x-amz-content-sha256"] = payload_hash
     signed = sorted(h for h in headers if h == "host" or h.startswith("x-amz-")
                     or h in ("content-type", "content-md5"))
-    scope = f"{date}/{region}/s3/aws4_request"
+    scope = f"{date}/{region}/{service}/aws4_request"
     creq = canonical_request(method, path, canonical_query(query), headers,
                              signed, payload_hash)
     sts = string_to_sign(creq, now, scope)
-    sig = hmac.new(signing_key(secret_key, date, region), sts.encode(),
-                   hashlib.sha256).hexdigest()
+    sig = hmac.new(signing_key(secret_key, date, region, service),
+                   sts.encode(), hashlib.sha256).hexdigest()
     headers["authorization"] = (
         f"{ALGORITHM} Credential={access_key}/{scope}, "
         f"SignedHeaders={';'.join(signed)}, Signature={sig}"
@@ -178,7 +179,7 @@ def verify_v4(method: str, path: str, query: list[tuple[str, str]],
         )
     except (KeyError, ValueError):
         raise SigV4Error("AuthorizationHeaderMalformed", "bad auth header")
-    if service != "s3" or terminal != "aws4_request":
+    if service not in ("s3", "sts") or terminal != "aws4_request":
         raise SigV4Error("AuthorizationHeaderMalformed", "bad credential scope")
     if cred_region != region:
         raise SigV4Error(
@@ -204,11 +205,11 @@ def verify_v4(method: str, path: str, query: list[tuple[str, str]],
     payload_hash = payload_hash_claim or headers.get(
         "x-amz-content-sha256", UNSIGNED_PAYLOAD
     )
-    scope = f"{date}/{region}/s3/aws4_request"
+    scope = f"{date}/{region}/{service}/aws4_request"
     creq = canonical_request(method, path, canonical_query(query), headers,
                              signed_headers, payload_hash)
     sts = string_to_sign(creq, amz_date, scope)
-    skey = signing_key(secret, date, region)
+    skey = signing_key(secret, date, region, service)
     want = hmac.new(skey, sts.encode(), hashlib.sha256).hexdigest()
     if not hmac.compare_digest(want, got_sig):
         raise SigV4Error("SignatureDoesNotMatch", "signature mismatch")

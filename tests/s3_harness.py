@@ -33,6 +33,8 @@ class S3TestServer:
         self.pools = ErasureServerPools([ErasureSets(disks)])
         self.app = make_app(self.pools, access_key=access_key,
                             secret_key=secret_key)
+        self.server = self.app["s3_server"]
+        self.iam = self.server.iam
         self._loop = asyncio.new_event_loop()
         self._started = threading.Event()
         self._thread = threading.Thread(target=self._serve, daemon=True)
@@ -71,14 +73,16 @@ class S3TestServer:
 
     def request(self, method: str, path: str, *, data: bytes | None = None,
                 query: list | None = None, headers: dict | None = None,
-                unsigned: bool = False) -> Resp:
+                unsigned: bool = False, creds: tuple[str, str] | None = None,
+                service: str = "s3") -> Resp:
         query = list(query or [])
         headers = dict(headers or {})
         headers["host"] = self.host
         if not unsigned:
+            ak, sk = creds if creds is not None else (self.ak, self.sk)
             headers = sigv4.sign_request(
                 method, urllib.parse.quote(path), query, headers,
-                data if data is not None else b"", self.ak, self.sk,
+                data if data is not None else b"", ak, sk, service=service,
             )
         qs = "&".join(
             f"{urllib.parse.quote(k, safe='')}={urllib.parse.quote(v, safe='')}"

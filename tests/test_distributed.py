@@ -230,3 +230,19 @@ def test_remote_walk_dir_streams(cluster, tmp_path):
     )
     with pytest.raises(errors.VolumeNotFound):
         list(remote.walk_dir("no-such-bucket"))
+
+
+def test_drwmutex_reacquire_after_unlock():
+    """Regression: _released must re-arm, or every grant of the second
+    acquisition self-releases while lock() still reports success."""
+    lockers = [LocalLocker() for _ in range(3)]
+    clients = [_LocalLockerClient(l) for l in lockers]
+    m = DRWMutex("re", clients)
+    with m:
+        pass
+    with m:  # second acquisition must genuinely hold the lock
+        held = sum(1 for l in lockers if l.top_locks()
+                   and l.top_locks()[0]["writer"])
+        assert held >= m.quorum
+    # and unlock released it everywhere
+    assert all(not l.top_locks() for l in lockers)

@@ -1,0 +1,284 @@
+"""IAM: policy evaluation, identity CRUD + persistence, STS, and
+server-level enforcement over signed HTTP (reference: cmd/iam_test.go,
+internal/bucket/policy tests, cmd/sts-handlers.go)."""
+
+import json
+import re
+import time
+
+import pytest
+
+from minio_tpu.erasure.sets import ErasureSets, ErasureServerPools
+from minio_tpu.iam import (
+    IAMError, IAMSys, Policy, PolicyArgs, PolicyError, match_pattern,
+)
+from minio_tpu.storage.local import LocalStorage
+
+from .s3_harness import S3TestServer
+
+
+def make_pools(tmp_path, n=4):
+    disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(n)]
+    return ErasureServerPools([ErasureSets(disks)])
+
+
+class TestPolicyEval:
+    def test_wildcard_matching(self):
+        assert match_pattern("s3:*", "s3:GetObject")
+        assert match_pattern("s3:Get*", "s3:GetObject")
+        assert not match_pattern("s3:Get*", "s3:PutObject")
+        assert match_pattern("mybucket/*", "mybucket/a/b/c")
+        assert match_pattern("*", "")
+
+    def test_allow_and_deny(self):
+        pol = Policy.from_json(json.dumps({
+            "Version": "2012-10-17",
+            "Statement": [
+                {"Effect": "Allow", "Action": "s3:*",
+                 "Resource": "arn:aws:s3:::data/*"},
+                {"Effect": "Deny", "Action": "s3:DeleteObject",
+                 "Resource": "arn:aws:s3:::data/protected/*"},
+            ],
+        }))
+        ok = PolicyArgs("s3:GetObject", "data", "x.txt")
+        assert pol.is_allowed(ok)
+        assert pol.is_allowed(PolicyArgs("s3:DeleteObject", "data", "tmp/x"))
+        assert not pol.is_allowed(
+            PolicyArgs("s3:DeleteObject", "data", "protected/x")
+        )
+        assert not pol.is_allowed(PolicyArgs("s3:GetObject", "other", "x"))
+
+    def test_bucket_level_action_matches_slash_star(self):
+        pol = Policy.from_json(json.dumps({
+            "Statement": [{"Effect": "Allow", "Action": "s3:ListBucket",
+                           "Resource": "arn:aws:s3:::logs/*"}],
+        }))
+        assert pol.is_allowed(PolicyArgs("s3:ListBucket", "logs"))
+
+    def test_condition_source_ip(self):
+        pol = Policy.from_json(json.dumps({
+            "Statement": [{
+                "Effect": "Allow", "Action": "s3:GetObject",
+                "Resource": "arn:aws:s3:::b/*",
+                "Condition": {"IpAddress": {"aws:SourceIp": "10.0.0.0/8"}},
+            }],
+        }))
+        ok = PolicyArgs("s3:GetObject", "b", "k",
+                        conditions={"aws:SourceIp": "10.1.2.3"})
+        bad = PolicyArgs("s3:GetObject", "b", "k",
+                         conditions={"aws:SourceIp": "192.168.1.1"})
+        assert pol.is_allowed(ok)
+        assert not pol.is_allowed(bad)
+
+    def test_malformed_policy_rejected(self):
+        with pytest.raises(PolicyError):
+            Policy.from_json("{not json")
+        with pytest.raises(PolicyError):
+            Policy.from_json(json.dumps(
+                {"Statement": [{"Effect": "Maybe", "Action": "s3:*",
+                                "Resource": "*"}]}
+            ))
+
+
+class TestIAMSys:
+    def test_user_crud_and_policy_attach(self, tmp_path):
+        iam = IAMSys(make_pools(tmp_path), "root", "rootsecret")
+        iam.add_user("alice", "alicesecret")
+        assert iam.get_secret("alice") == "alicesecret"
+        # no policy yet: everything denied
+        assert not iam.is_allowed("alice", "s3:GetObject", "b", "k")
+        iam.attach_policy("alice", ["readonly"])
+        assert iam.is_allowed("alice", "s3:GetObject", "b", "k")
+        assert not iam.is_allowed("alice", "s3:PutObject", "b", "k")
+        iam.set_user_status("alice", enabled=False)
+        assert iam.get_secret("alice") is None
+        assert not iam.is_allowed("alice", "s3:GetObject", "b", "k")
+        iam.set_user_status("alice", enabled=True)
+        iam.remove_user("alice")
+        assert iam.get_secret("alice") is None
+
+    def test_root_always_allowed(self, tmp_path):
+        iam = IAMSys(make_pools(tmp_path), "root", "rs")
+        assert iam.is_allowed("root", "admin:ServerInfo")
+        assert iam.is_allowed("root", "s3:DeleteBucket", "any")
+
+    def test_persistence_across_restart(self, tmp_path):
+        pools = make_pools(tmp_path)
+        iam = IAMSys(pools, "root", "rs")
+        iam.set_policy("projread", json.dumps({
+            "Statement": [{"Effect": "Allow", "Action": "s3:GetObject",
+                           "Resource": "arn:aws:s3:::proj/*"}],
+        }))
+        iam.add_user("bob", "bobsecret", policies=["projread"])
+        # new IAMSys over the same drives sees everything
+        iam2 = IAMSys(pools, "root", "rs")
+        assert iam2.get_secret("bob") == "bobsecret"
+        assert iam2.is_allowed("bob", "s3:GetObject", "proj", "f")
+        assert not iam2.is_allowed("bob", "s3:GetObject", "other", "f")
+        assert "projread" in iam2.list_policies()
+
+    def test_groups(self, tmp_path):
+        iam = IAMSys(make_pools(tmp_path), "root", "rs")
+        iam.add_user("u1", "s1")
+        iam.add_user("u2", "s2")
+        iam.add_group_members("devs", ["u1", "u2"])
+        iam.attach_group_policy("devs", ["readwrite"])
+        assert iam.is_allowed("u1", "s3:PutObject", "b", "k")
+        assert iam.is_allowed("u2", "s3:GetObject", "b", "k")
+        iam.remove_group_members("devs", ["u2"])
+        assert not iam.is_allowed("u2", "s3:GetObject", "b", "k")
+
+    def test_service_account_inherits_parent(self, tmp_path):
+        iam = IAMSys(make_pools(tmp_path), "root", "rs")
+        iam.add_user("carol", "cs", policies=["readonly"])
+        svc = iam.create_service_account("carol")
+        assert svc.access_key.startswith("SVC")
+        assert iam.get_secret(svc.access_key) == svc.secret_key
+        assert iam.is_allowed(svc.access_key, "s3:GetObject", "b", "k")
+        assert not iam.is_allowed(svc.access_key, "s3:PutObject", "b", "k")
+        # removing the parent cascades
+        iam.remove_user("carol")
+        assert iam.get_secret(svc.access_key) is None
+
+    def test_sts_expiry_and_session_policy(self, tmp_path):
+        iam = IAMSys(make_pools(tmp_path), "root", "rs")
+        iam.add_user("dave", "ds", policies=["readwrite"])
+        restrict = json.dumps({
+            "Statement": [{"Effect": "Allow", "Action": "s3:GetObject",
+                           "Resource": "arn:aws:s3:::pub/*"}],
+        })
+        tmp = iam.assume_role("dave", duration=900, session_policy=restrict)
+        assert tmp.access_key.startswith("STS")
+        assert iam.is_allowed(tmp.access_key, "s3:GetObject", "pub", "k")
+        # session policy restricts below the parent's readwrite
+        assert not iam.is_allowed(tmp.access_key, "s3:PutObject", "pub", "k")
+        assert not iam.is_allowed(tmp.access_key, "s3:GetObject", "priv", "k")
+        # expiry
+        tmp.expiry = time.time() - 1
+        assert iam.get_secret(tmp.access_key) is None
+        assert not iam.is_allowed(tmp.access_key, "s3:GetObject", "pub", "k")
+
+
+class TestServerEnforcement:
+    @pytest.fixture
+    def srv(self, tmp_path):
+        s = S3TestServer(str(tmp_path))
+        yield s
+        s.close()
+
+    def test_readonly_user_cannot_write(self, srv):
+        iam = srv.iam
+        iam.add_user("reader", "readersecret", policies=["readonly"])
+        assert srv.request("PUT", "/bkt1").status == 200  # root makes bucket
+        assert srv.request("PUT", "/bkt1/obj", data=b"hello").status == 200
+
+        r = srv.request("GET", "/bkt1/obj", creds=("reader", "readersecret"))
+        assert r.status == 200 and r.body == b"hello"
+        r = srv.request("PUT", "/bkt1/obj2", data=b"x",
+                        creds=("reader", "readersecret"))
+        assert r.status == 403
+        assert "AccessDenied" in r.text()
+        r = srv.request("DELETE", "/bkt1/obj",
+                        creds=("reader", "readersecret"))
+        assert r.status == 403
+
+    def test_unknown_key_rejected(self, srv):
+        r = srv.request("GET", "/", creds=("ghost", "nope"))
+        assert r.status == 403
+        assert "InvalidAccessKeyId" in r.text()
+
+    def test_scoped_policy_on_server(self, srv):
+        srv.iam.set_policy("b2only", json.dumps({
+            "Statement": [
+                {"Effect": "Allow",
+                 "Action": ["s3:GetObject", "s3:PutObject"],
+                 "Resource": "arn:aws:s3:::bkt2/*"},
+                {"Effect": "Allow", "Action": "s3:ListBucket",
+                 "Resource": "arn:aws:s3:::bkt2"},
+            ],
+        }))
+        srv.iam.add_user("scoped", "scopedsecret", policies=["b2only"])
+        assert srv.request("PUT", "/bkt2").status == 200
+        assert srv.request("PUT", "/bkt3").status == 200
+        c = ("scoped", "scopedsecret")
+        assert srv.request("PUT", "/bkt2/k", data=b"v", creds=c).status == 200
+        assert srv.request("GET", "/bkt2/k", creds=c).body == b"v"
+        assert srv.request("PUT", "/bkt3/k", data=b"v", creds=c).status == 403
+        assert srv.request("GET", "/bkt2", creds=c).status == 200
+        assert srv.request("GET", "/bkt3", creds=c).status == 403
+        # bucket creation denied
+        assert srv.request("PUT", "/bkt4", creds=c).status == 403
+
+    def test_sts_assume_role_over_http(self, srv):
+        srv.iam.add_user("erin", "erinsecret", policies=["readwrite"])
+        body = "Action=AssumeRole&Version=2011-06-15&DurationSeconds=900".encode()
+        r = srv.request(
+            "POST", "/", data=body, creds=("erin", "erinsecret"),
+            service="sts",
+            headers={"content-type": "application/x-www-form-urlencoded"},
+        )
+        assert r.status == 200, r.text()
+        ak = re.search(r"<AccessKeyId>([^<]+)</AccessKeyId>", r.text()).group(1)
+        sk = re.search(r"<SecretAccessKey>([^<]+)</SecretAccessKey>",
+                       r.text()).group(1)
+        assert ak.startswith("STS")
+        # temp creds work for S3 calls with the parent's permissions
+        assert srv.request("PUT", "/stsb").status == 200
+        assert srv.request("PUT", "/stsb/o", data=b"1",
+                           creds=(ak, sk)).status == 200
+        assert srv.request("GET", "/stsb/o", creds=(ak, sk)).body == b"1"
+
+
+class TestReviewRegressions:
+    def test_unknown_condition_op_rejected_at_parse(self):
+        with pytest.raises(PolicyError):
+            Policy.from_json(json.dumps({
+                "Statement": [{"Effect": "Deny", "Action": "s3:*",
+                               "Resource": "arn:aws:s3:::*",
+                               "Condition": {"NumericLessThan":
+                                             {"s3:max-keys": "10"}}}],
+            }))
+
+    def test_unknown_condition_op_fails_closed_at_eval(self):
+        # a doc persisted by a newer engine version: Deny must still deny
+        from minio_tpu.iam.policy import Statement
+        deny = Statement(effect="Deny", actions=["s3:*"], resources=["*"],
+                         conditions={"FutureOp": {"x": "y"}})
+        allow = Statement(effect="Allow", actions=["s3:*"], resources=["*"],
+                          conditions={"FutureOp": {"x": "y"}})
+        args = PolicyArgs("s3:GetObject", "b", "k")
+        assert deny.matches(args)        # deny applies
+        assert not allow.matches(args)   # allow does not grant
+
+    def test_bulk_delete_respects_object_scoped_deny(self, tmp_path):
+        srv = S3TestServer(str(tmp_path))
+        try:
+            srv.iam.set_policy("guard", json.dumps({
+                "Statement": [
+                    {"Effect": "Allow", "Action": "s3:*",
+                     "Resource": ["arn:aws:s3:::data/*",
+                                  "arn:aws:s3:::data"]},
+                    {"Effect": "Deny", "Action": "s3:DeleteObject",
+                     "Resource": "arn:aws:s3:::data/protected/*"},
+                ],
+            }))
+            srv.iam.add_user("op", "opsecret99", policies=["guard"])
+            assert srv.request("PUT", "/data").status == 200
+            for k in ("protected/keep", "tmp/x"):
+                assert srv.request("PUT", f"/data/{k}",
+                                   data=b"v").status == 200
+            body = (
+                '<Delete><Object><Key>protected/keep</Key></Object>'
+                '<Object><Key>tmp/x</Key></Object></Delete>'
+            ).encode()
+            r = srv.request("POST", "/data", data=body,
+                            query=[("delete", "")],
+                            creds=("op", "opsecret99"))
+            assert r.status == 200
+            assert "<Error><Key>protected/keep</Key>" in r.text()
+            assert "<Deleted><Key>tmp/x</Key></Deleted>" in r.text()
+            # protected object survived, tmp/x is gone
+            assert srv.request("GET", "/data/protected/keep").status == 200
+            assert srv.request("GET", "/data/tmp/x").status == 404
+        finally:
+            srv.close()
