@@ -1,0 +1,216 @@
+"""Metacache-style listing: merged per-drive walks resolved to versioned
+object entries.
+
+Reference: cmd/metacache-set.go:532 (listPath), cmd/metacache-walk.go:62
+(WalkDir sorted streaming walk), cmd/metacache-entries.go (per-drive entry
+resolution).  The reference lists by asking `askDisks` drives for sorted
+dir walks, merging the streams, and resolving disagreements by quorum of
+the per-drive xl.meta; results feed ListObjects V1/V2/Versions.
+
+This implementation keeps the same shape, TPU-framework style: each set
+yields a sorted stream of (name, versions) entries — names come from the
+union of per-drive walks, version metadata from the first healthy drive
+that can serve the object's xl.meta (askDisks=1 with fallback, the
+reference's "optimistic" listing mode) — and sets/pools are merged with
+`heapq.merge` into one globally sorted stream.  Delimiter grouping and
+truncation happen once, at the top, in `list_objects`.
+"""
+
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass, field
+from typing import Iterator
+
+from minio_tpu.storage import errors
+from minio_tpu.storage.xlmeta import FileInfo, XLMeta
+
+from .objects import ObjectInfo
+
+
+@dataclass
+class ListEntry:
+    """One object name with all its versions, newest first."""
+
+    name: str
+    versions: list[ObjectInfo] = field(default_factory=list)
+
+    @property
+    def latest(self) -> ObjectInfo | None:
+        return self.versions[0] if self.versions else None
+
+
+@dataclass
+class ListResult:
+    entries: list[ObjectInfo] = field(default_factory=list)
+    common_prefixes: list[str] = field(default_factory=list)
+    is_truncated: bool = False
+    next_marker: str = ""
+    next_version_marker: str = ""
+
+
+def entry_from_xl(bucket: str, name: str, raw: bytes) -> ListEntry:
+    xl = XLMeta.loads(raw)
+    versions = []
+    for i, v in enumerate(xl.versions):
+        fi = FileInfo.from_obj(bucket, name, v)
+        fi.is_latest = i == 0
+        fi.data = None
+        versions.append(ObjectInfo.from_file_info(fi, bucket, name,
+                                                  versioned=True))
+    return ListEntry(name=name, versions=versions)
+
+
+def union_walk(disks, bucket: str, prefix: str = "") -> list[str]:
+    """Union of per-drive sorted walks, filtered to the (arbitrary string)
+    prefix.  The walk starts from the deepest directory the prefix implies
+    — an S3 prefix need not end on a '/' boundary, so 'photos/sum' walks
+    'photos/' and string-filters the rest.  Raises VolumeNotFound only
+    when NO drive has the bucket dir (a fresh replacement drive must not
+    hide the set's objects)."""
+    base = prefix.rsplit("/", 1)[0] if "/" in prefix else ""
+    names: set[str] = set()
+    vol_found = False
+    for d in disks:
+        if d is None or not d.is_online():
+            continue
+        try:
+            names.update(d.walk_dir(bucket, base=base))
+            vol_found = True
+        except errors.VolumeNotFound:
+            continue
+        except Exception:
+            continue
+    if not vol_found:
+        raise errors.VolumeNotFound(bucket)
+    return sorted(n for n in names if n.startswith(prefix))
+
+
+def set_list_entries(eo, bucket: str, prefix: str = "", marker: str = "",
+                     include_marker: bool = False) -> Iterator[ListEntry]:
+    """Sorted entry stream for one erasure set (listPathRaw analogue)."""
+    for name in union_walk(eo.disks, bucket, prefix):
+        if marker and (name < marker
+                       or (name == marker and not include_marker)):
+            continue
+        # resolve versions from the first drive that can serve xl.meta
+        for d in eo.disks:
+            if d is None or not d.is_online():
+                continue
+            try:
+                raw = d.read_xl(bucket, name)
+            except Exception:
+                continue
+            try:
+                yield entry_from_xl(bucket, name, raw)
+            except Exception:
+                continue
+            break
+
+
+def merge_entry_streams(streams: list[Iterator[ListEntry]]
+                        ) -> Iterator[ListEntry]:
+    """K-way merge of sorted entry streams; same-name entries across
+    streams (an object visible in several pools) resolve to the one with
+    the newest top version (reference pool-probe order semantics)."""
+    merged = heapq.merge(*streams, key=lambda e: e.name)
+    pending: ListEntry | None = None
+    for e in merged:
+        if pending is None:
+            pending = e
+            continue
+        if e.name == pending.name:
+            pt = pending.latest.mod_time if pending.latest else 0.0
+            et = e.latest.mod_time if e.latest else 0.0
+            if et > pt:
+                pending = e
+            continue
+        yield pending
+        pending = e
+    if pending is not None:
+        yield pending
+
+
+def list_objects(api, bucket: str, prefix: str = "", delimiter: str = "",
+                 marker: str = "", version_marker: str = "",
+                 max_keys: int = 1000,
+                 include_versions: bool = False) -> ListResult:
+    """Shared engine behind ListObjectsV1/V2/Versions.
+
+    `max_keys` counts contents + common prefixes, per S3.  For versioned
+    listings, `marker`/`version_marker` are the key-marker/version-id-marker
+    pair and every version (incl. delete markers) is emitted; otherwise
+    only latest non-delete-marker versions appear.
+    """
+    res = ListResult()
+    budget = max(0, max_keys)
+    if budget == 0:
+        return res
+    seen_prefixes: set[str] = set()
+    emitted = 0
+    last_display = ""          # last key or common prefix emitted
+
+    def truncate() -> ListResult:
+        res.is_truncated = True
+        res.next_marker = last_display
+        if res.entries and res.entries[-1].name == last_display:
+            res.next_version_marker = res.entries[-1].version_id or "null"
+        return res
+
+    # push the marker down so earlier pages aren't re-resolved (xl.meta is
+    # only read for names past the marker); the partial-key resume needs
+    # the marker key itself back to filter its remaining versions
+    partial_resume = include_versions and bool(version_marker) and bool(marker)
+    stream = api.list_entries(bucket, prefix=prefix, marker=marker,
+                              include_marker=partial_resume)
+    for entry in stream:
+        name = entry.name
+        cp = ""
+        if delimiter:
+            rest = name[len(prefix):]
+            if delimiter in rest:
+                cp = prefix + rest.split(delimiter, 1)[0] + delimiter
+        display = cp or name
+        partial_key = (include_versions and version_marker
+                       and name == marker and not cp)
+        # marker compares against the rolled-up display name, so a marker
+        # equal to a CommonPrefix skips every key grouped under it (S3
+        # delimiter+marker continuation semantics)
+        if marker and not partial_key and display <= marker:
+            continue
+
+        if cp:
+            if cp in seen_prefixes:
+                continue
+            if emitted >= budget:
+                return truncate()
+            seen_prefixes.add(cp)
+            res.common_prefixes.append(cp)
+            emitted += 1
+            last_display = cp
+            continue
+
+        if include_versions:
+            versions = entry.versions
+            if partial_key:
+                idx = next(
+                    (i for i, v in enumerate(versions)
+                     if (v.version_id or "null") == version_marker), None,
+                )
+                versions = versions[idx + 1:] if idx is not None else versions
+            for v in versions:
+                if emitted >= budget:
+                    return truncate()
+                res.entries.append(v)
+                emitted += 1
+                last_display = name
+        else:
+            latest = entry.latest
+            if latest is None or latest.delete_marker:
+                continue
+            if emitted >= budget:
+                return truncate()
+            res.entries.append(latest)
+            emitted += 1
+            last_display = name
+    return res

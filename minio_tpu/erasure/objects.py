@@ -612,26 +612,18 @@ class ErasureObjects:
 
     # ------------------------------------------------------------------ LIST
     def list_objects(self, bucket: str, prefix: str = "") -> list[str]:
-        """Union of per-drive sorted walks (metacache-lite).
+        """Union of per-drive sorted walks (metacache-lite)."""
+        from . import listing
 
-        A drive missing the bucket dir (fresh replacement) must not hide the
-        set's objects; VolumeNotFound only propagates when NO drive has it.
-        """
-        names: set[str] = set()
-        vol_found = False
-        for d in self.disks:
-            if d is None or not d.is_online():
-                continue
-            try:
-                names.update(d.walk_dir(bucket, base=prefix))
-                vol_found = True
-            except errors.VolumeNotFound:
-                continue
-            except Exception:
-                continue
-        if not vol_found:
-            raise errors.VolumeNotFound(bucket)
-        return sorted(names)
+        return listing.union_walk(self.disks, bucket, prefix)
+
+    def list_entries(self, bucket: str, prefix: str = "", marker: str = "",
+                     include_marker: bool = False):
+        """Sorted (name, versions) entry stream for this set."""
+        from . import listing
+
+        return listing.set_list_entries(self, bucket, prefix, marker,
+                                        include_marker)
 
     # ------------------------------------------------------------------ HEAL
     def heal_object(self, bucket: str, obj: str, version_id: str = "",

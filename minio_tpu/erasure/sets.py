@@ -219,6 +219,29 @@ class ErasureSets:
             raise errors.BucketNotFound(bucket)
         return sorted(names)
 
+    def list_entries(self, bucket: str, prefix: str = "", marker: str = "",
+                     include_marker: bool = False):
+        """Merged sorted (name, versions) stream across this pool's sets
+        (cmd/metacache-set.go listPath per set, merged)."""
+        from . import listing
+
+        if not self.bucket_exists(bucket):
+            raise errors.BucketNotFound(bucket)
+
+        # set_list_entries raises VolumeNotFound lazily on first iteration;
+        # a set whose drives all lost the bucket dir must not kill the merge
+        def safe(it):
+            try:
+                yield from it
+            except errors.VolumeNotFound:
+                return
+
+        return listing.merge_entry_streams([
+            safe(listing.set_list_entries(s, bucket, prefix, marker,
+                                          include_marker))
+            for s in self.sets
+        ])
+
     # -- multipart ----------------------------------------------------------
     def new_multipart_upload(self, bucket, obj, opts=None) -> str:
         return self.get_hashed_set(obj).new_multipart_upload(bucket, obj, opts)
@@ -417,6 +440,26 @@ class ErasureServerPools:
         if not found:
             raise errors.BucketNotFound(bucket)
         return sorted(names)
+
+    def list_entries(self, bucket: str, prefix: str = "", marker: str = "",
+                     include_marker: bool = False):
+        """Globally sorted entry stream across pools; same-name collisions
+        resolve to the newest version (pool-probe semantics)."""
+        from . import listing
+
+        streams = []
+        found = False
+        for p in self.pools:
+            try:
+                streams.append(
+                    p.list_entries(bucket, prefix, marker, include_marker)
+                )
+                found = True
+            except errors.BucketNotFound:
+                continue
+        if not found:
+            raise errors.BucketNotFound(bucket)
+        return listing.merge_entry_streams(streams)
 
     # -- multipart (route to the pool that will own the object) -------------
     def new_multipart_upload(self, bucket, obj, opts=None) -> str:

@@ -21,6 +21,7 @@ import secrets
 import urllib.parse
 import xml.etree.ElementTree as ET
 from datetime import datetime, timezone
+from urllib.parse import quote
 from xml.sax.saxutils import escape
 
 from aiohttp import web
@@ -293,6 +294,8 @@ class S3Server:
                 return await self._handle(request, self.get_versioning)
             if "uploads" in q:
                 return await self._handle(request, self.list_uploads)
+            if "versions" in q:
+                return await self._handle(request, self.list_object_versions)
             return await self._handle(request, self.list_objects)
         if m == "PUT":
             if "versioning" in q:
@@ -415,69 +418,157 @@ class S3Server:
         await self._run(setter, bucket, status == "Enabled")
         return web.Response(status=200)
 
+    @staticmethod
+    def _enc_key(s: str, enc: str) -> str:
+        if enc == "url":
+            return quote(s, safe="")
+        return escape(s)
+
     async def list_objects(self, request: web.Request) -> web.Response:
+        """ListObjectsV1 + V2 (cmd/bucket-handlers.go ListObjects*Handler)."""
+        from minio_tpu.erasure import listing as listing_mod
+
         bucket = self._bucket(request)
         self._auth(request, None, "s3:ListBucket", bucket)
         q = request.rel_url.query
         prefix = q.get("prefix", "")
         delimiter = q.get("delimiter", "")
-        max_keys = min(int(q.get("max-keys", "1000") or "1000"), 1000)
+        enc = q.get("encoding-type", "")
+        try:
+            max_keys = min(int(q.get("max-keys", "1000") or "1000"), 1000)
+        except ValueError:
+            raise S3Error("InvalidArgument", "invalid max-keys")
+        if max_keys < 0:
+            raise S3Error("InvalidArgument", "invalid max-keys")
         v2 = q.get("list-type") == "2"
-        start_after = q.get("start-after", "") if v2 else q.get("marker", "")
-        token = q.get("continuation-token", "")
-        if token:
-            start_after = token
+        if v2:
+            marker = q.get("continuation-token", "") or q.get("start-after", "")
+        else:
+            marker = q.get("marker", "")
 
-        names = await self._run(self.api.list_objects, bucket, prefix)
-        names = [n for n in names if n.startswith(prefix)]
-        if start_after:
-            names = [n for n in names if n > start_after]
-
-        contents, prefixes = [], []
-        seen_prefixes = set()
-        for n in names:
-            if delimiter:
-                rest = n[len(prefix):]
-                if delimiter in rest:
-                    cp = prefix + rest.split(delimiter, 1)[0] + delimiter
-                    if cp not in seen_prefixes:
-                        seen_prefixes.add(cp)
-                        prefixes.append(cp)
-                    continue
-            contents.append(n)
-        truncated = len(contents) > max_keys
-        contents = contents[:max_keys]
-
+        res = await self._run(
+            listing_mod.list_objects, self.api, bucket, prefix, delimiter,
+            marker, "", max_keys, False,
+        )
         parts = []
-        for n in contents:
-            try:
-                oi = await self._run(self.api.get_object_info, bucket, n)
-            except Exception:
-                continue
+        for oi in res.entries:
             parts.append(
-                f"<Contents><Key>{escape(n)}</Key>"
+                f"<Contents><Key>{self._enc_key(oi.name, enc)}</Key>"
                 f"<LastModified>{_iso(oi.mod_time)}</LastModified>"
                 f'<ETag>&quot;{oi.etag}&quot;</ETag>'
                 f"<Size>{oi.size}</Size>"
+                f"<Owner><ID>minio-tpu</ID>"
+                f"<DisplayName>minio-tpu</DisplayName></Owner>"
                 f"<StorageClass>STANDARD</StorageClass></Contents>"
             )
-        for cp in prefixes:
+        for cp in res.common_prefixes:
             parts.append(
-                f"<CommonPrefixes><Prefix>{escape(cp)}</Prefix></CommonPrefixes>"
+                f"<CommonPrefixes><Prefix>{self._enc_key(cp, enc)}</Prefix>"
+                f"</CommonPrefixes>"
             )
-        next_token = (
-            f"<NextContinuationToken>{escape(contents[-1])}"
-            f"</NextContinuationToken>" if truncated and v2 and contents else ""
-        )
-        tag = "ListBucketResult"
+        extra = ""
+        if v2:
+            extra += f"<KeyCount>{len(res.entries) + len(res.common_prefixes)}</KeyCount>"
+            if q.get("continuation-token"):
+                extra += (f"<ContinuationToken>"
+                          f"{escape(q['continuation-token'])}"
+                          f"</ContinuationToken>")
+            if res.is_truncated:
+                extra += (f"<NextContinuationToken>"
+                          f"{escape(res.next_marker)}"
+                          f"</NextContinuationToken>")
+        else:
+            extra += f"<Marker>{self._enc_key(marker, enc)}</Marker>"
+            if res.is_truncated and delimiter:
+                extra += (f"<NextMarker>{self._enc_key(res.next_marker, enc)}"
+                          f"</NextMarker>")
+        if enc:
+            extra += f"<EncodingType>{escape(enc)}</EncodingType>"
         return self._xml(200, (
             f'<?xml version="1.0" encoding="UTF-8"?>'
-            f'<{tag} xmlns="{XMLNS}">'
-            f"<Name>{escape(bucket)}</Name><Prefix>{escape(prefix)}</Prefix>"
-            f"<KeyCount>{len(contents)}</KeyCount><MaxKeys>{max_keys}</MaxKeys>"
-            f"<Delimiter>{escape(delimiter)}</Delimiter>"
-            f"<IsTruncated>{'true' if truncated else 'false'}</IsTruncated>"
-            f"{next_token}{''.join(parts)}</{tag}>"
+            f'<ListBucketResult xmlns="{XMLNS}">'
+            f"<Name>{escape(bucket)}</Name>"
+            f"<Prefix>{self._enc_key(prefix, enc)}</Prefix>"
+            f"<MaxKeys>{max_keys}</MaxKeys>"
+            f"<Delimiter>{self._enc_key(delimiter, enc)}</Delimiter>"
+            f"<IsTruncated>{'true' if res.is_truncated else 'false'}</IsTruncated>"
+            f"{extra}{''.join(parts)}</ListBucketResult>"
+        ))
+
+    async def list_object_versions(self, request: web.Request) -> web.Response:
+        """ListObjectVersions (cmd/bucket-handlers.go:188)."""
+        from minio_tpu.erasure import listing as listing_mod
+
+        bucket = self._bucket(request)
+        self._auth(request, None, "s3:ListBucketVersions", bucket)
+        q = request.rel_url.query
+        prefix = q.get("prefix", "")
+        delimiter = q.get("delimiter", "")
+        enc = q.get("encoding-type", "")
+        try:
+            max_keys = min(int(q.get("max-keys", "1000") or "1000"), 1000)
+        except ValueError:
+            raise S3Error("InvalidArgument", "invalid max-keys")
+        if max_keys < 0:
+            raise S3Error("InvalidArgument", "invalid max-keys")
+        key_marker = q.get("key-marker", "")
+        vid_marker = q.get("version-id-marker", "")
+
+        res = await self._run(
+            listing_mod.list_objects, self.api, bucket, prefix, delimiter,
+            key_marker, vid_marker, max_keys, True,
+        )
+        parts = []
+        for oi in res.entries:
+            vid = oi.version_id or "null"
+            latest = "true" if oi.is_latest else "false"
+            if oi.delete_marker:
+                parts.append(
+                    f"<DeleteMarker><Key>{self._enc_key(oi.name, enc)}</Key>"
+                    f"<VersionId>{vid}</VersionId>"
+                    f"<IsLatest>{latest}</IsLatest>"
+                    f"<LastModified>{_iso(oi.mod_time)}</LastModified>"
+                    f"<Owner><ID>minio-tpu</ID>"
+                    f"<DisplayName>minio-tpu</DisplayName></Owner>"
+                    f"</DeleteMarker>"
+                )
+            else:
+                parts.append(
+                    f"<Version><Key>{self._enc_key(oi.name, enc)}</Key>"
+                    f"<VersionId>{vid}</VersionId>"
+                    f"<IsLatest>{latest}</IsLatest>"
+                    f"<LastModified>{_iso(oi.mod_time)}</LastModified>"
+                    f'<ETag>&quot;{oi.etag}&quot;</ETag>'
+                    f"<Size>{oi.size}</Size>"
+                    f"<Owner><ID>minio-tpu</ID>"
+                    f"<DisplayName>minio-tpu</DisplayName></Owner>"
+                    f"<StorageClass>STANDARD</StorageClass></Version>"
+                )
+        for cp in res.common_prefixes:
+            parts.append(
+                f"<CommonPrefixes><Prefix>{self._enc_key(cp, enc)}</Prefix>"
+                f"</CommonPrefixes>"
+            )
+        extra = ""
+        if res.is_truncated:
+            extra += (f"<NextKeyMarker>{self._enc_key(res.next_marker, enc)}"
+                      f"</NextKeyMarker>")
+            if res.next_version_marker:
+                extra += (f"<NextVersionIdMarker>{res.next_version_marker}"
+                          f"</NextVersionIdMarker>")
+        if enc:
+            extra += f"<EncodingType>{escape(enc)}</EncodingType>"
+        return self._xml(200, (
+            f'<?xml version="1.0" encoding="UTF-8"?>'
+            f'<ListVersionsResult xmlns="{XMLNS}">'
+            f"<Name>{escape(bucket)}</Name>"
+            f"<Prefix>{self._enc_key(prefix, enc)}</Prefix>"
+            f"<KeyMarker>{self._enc_key(key_marker, enc)}</KeyMarker>"
+            f"<VersionIdMarker>{escape(vid_marker)}</VersionIdMarker>"
+            f"<MaxKeys>{max_keys}</MaxKeys>"
+            f"<Delimiter>{self._enc_key(delimiter, enc)}</Delimiter>"
+            f"<IsTruncated>{'true' if res.is_truncated else 'false'}</IsTruncated>"
+            f"{extra}{''.join(parts)}</ListVersionsResult>"
         ))
 
     async def delete_objects(self, request: web.Request) -> web.Response:

@@ -1,0 +1,221 @@
+"""Listing semantics: V1/V2 pagination, delimiter grouping, versions
+listing (reference cmd/metacache-*, cmd/bucket-handlers.go listing
+handlers, cmd/erasure-server-pool.go:1022)."""
+
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from .s3_harness import S3TestServer
+
+NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+
+
+def _q(qs: str) -> list[tuple[str, str]]:
+    out = []
+    for part in qs.split("&"):
+        k, _, v = part.partition("=")
+        out.append((k, v))
+    return out
+
+
+@pytest.fixture(scope="module")
+def srv(tmp_path_factory):
+    s = S3TestServer(str(tmp_path_factory.mktemp("drives")))
+    s.request("PUT", "/listb")
+    for k in ["a.txt", "b/one", "b/two", "b/sub/three", "c.txt", "d/x"]:
+        s.request("PUT", f"/listb/{k}", data=k.encode())
+    yield s
+    s.close()
+
+
+def _keys(root):
+    return [c.findtext(f"{NS}Key") for c in root.findall(f"{NS}Contents")]
+
+
+def _prefixes(root):
+    return [c.findtext(f"{NS}Prefix")
+            for c in root.findall(f"{NS}CommonPrefixes")]
+
+
+class TestListV2:
+    def test_flat(self, srv):
+        r = srv.request("GET", "/listb", query=_q("list-type=2"))
+        root = ET.fromstring(r.text())
+        assert _keys(root) == ["a.txt", "b/one", "b/sub/three", "b/two",
+                               "c.txt", "d/x"]
+        assert root.findtext(f"{NS}KeyCount") == "6"
+        assert root.findtext(f"{NS}IsTruncated") == "false"
+
+    def test_delimiter(self, srv):
+        r = srv.request("GET", "/listb", query=_q("list-type=2&delimiter=/"))
+        root = ET.fromstring(r.text())
+        assert _keys(root) == ["a.txt", "c.txt"]
+        assert _prefixes(root) == ["b/", "d/"]
+
+    def test_prefix_delimiter(self, srv):
+        r = srv.request("GET", "/listb",
+                        query=_q("list-type=2&delimiter=/&prefix=b/"))
+        root = ET.fromstring(r.text())
+        assert _keys(root) == ["b/one", "b/two"]
+        assert _prefixes(root) == ["b/sub/"]
+
+    def test_pagination(self, srv):
+        keys, token, pages = [], "", 0
+        while True:
+            q = "list-type=2&max-keys=2"
+            if token:
+                q += f"&continuation-token={token}"
+            root = ET.fromstring(
+                srv.request("GET", "/listb", query=_q(q)).text())
+            keys += _keys(root)
+            pages += 1
+            if root.findtext(f"{NS}IsTruncated") != "true":
+                break
+            token = root.findtext(f"{NS}NextContinuationToken")
+            assert token
+        assert keys == ["a.txt", "b/one", "b/sub/three", "b/two", "c.txt",
+                        "d/x"]
+        assert pages == 3
+
+    def test_pagination_with_delimiter(self, srv):
+        # page size 3 → page 1: a.txt, b/, c.txt; page 2: d/
+        root = ET.fromstring(
+            srv.request("GET", "/listb",
+                        query=_q("list-type=2&delimiter=/&max-keys=3")).text()
+        )
+        assert _keys(root) == ["a.txt", "c.txt"]
+        assert _prefixes(root) == ["b/"]
+        assert root.findtext(f"{NS}IsTruncated") == "true"
+        token = root.findtext(f"{NS}NextContinuationToken")
+        root = ET.fromstring(
+            srv.request(
+                "GET", "/listb",
+                query=_q(f"list-type=2&delimiter=/&max-keys=3"
+                         f"&continuation-token={token}")).text()
+        )
+        assert _keys(root) == []
+        assert _prefixes(root) == ["d/"]
+        assert root.findtext(f"{NS}IsTruncated") == "false"
+
+    def test_mid_segment_prefix(self, srv):
+        # S3 prefixes are string prefixes, not directory paths
+        root = ET.fromstring(
+            srv.request("GET", "/listb",
+                        query=_q("list-type=2&prefix=b/su")).text()
+        )
+        assert _keys(root) == ["b/sub/three"]
+        root = ET.fromstring(
+            srv.request("GET", "/listb",
+                        query=_q("list-type=2&prefix=a")).text()
+        )
+        assert _keys(root) == ["a.txt"]
+
+    def test_max_keys_zero(self, srv):
+        root = ET.fromstring(
+            srv.request("GET", "/listb",
+                        query=_q("list-type=2&max-keys=0")).text()
+        )
+        assert _keys(root) == []
+        assert root.findtext(f"{NS}IsTruncated") == "false"
+        assert root.findtext(f"{NS}NextContinuationToken") is None
+
+    def test_negative_max_keys_rejected(self, srv):
+        r = srv.request("GET", "/listb", query=_q("list-type=2&max-keys=-5"))
+        assert r.status == 400
+        r = srv.request("GET", "/listb", query=_q("versions&max-keys=-5"))
+        assert r.status == 400
+
+    def test_start_after(self, srv):
+        root = ET.fromstring(
+            srv.request("GET", "/listb",
+                        query=_q("list-type=2&start-after=b/two")).text()
+        )
+        assert _keys(root) == ["c.txt", "d/x"]
+
+
+class TestListV1:
+    def test_marker(self, srv):
+        root = ET.fromstring(
+            srv.request("GET", "/listb", query=_q("marker=b/one")).text()
+        )
+        assert _keys(root) == ["b/sub/three", "b/two", "c.txt", "d/x"]
+        assert root.findtext(f"{NS}Marker") == "b/one"
+
+
+class TestListVersions:
+    def test_versions_and_delete_markers(self, srv):
+        srv.request("PUT", "/verlist")
+        body = (b'<VersioningConfiguration><Status>Enabled</Status>'
+                b'</VersioningConfiguration>')
+        assert srv.request("PUT", "/verlist", query=_q("versioning"),
+                           data=body).status == 200
+        srv.request("PUT", "/verlist/k", data=b"v1")
+        srv.request("PUT", "/verlist/k", data=b"v2")
+        srv.request("DELETE", "/verlist/k")
+        r = srv.request("GET", "/verlist", query=_q("versions"))
+        root = ET.fromstring(r.text())
+        vers = root.findall(f"{NS}Version")
+        dms = root.findall(f"{NS}DeleteMarker")
+        assert len(vers) == 2 and len(dms) == 1
+        assert dms[0].findtext(f"{NS}IsLatest") == "true"
+        latest_flags = [v.findtext(f"{NS}IsLatest") for v in vers]
+        assert latest_flags == ["false", "false"]
+        # plain list hides the delete-marked object
+        root = ET.fromstring(
+            srv.request("GET", "/verlist", query=_q("list-type=2")).text()
+        )
+        assert _keys(root) == []
+
+    def test_versions_pagination(self, srv):
+        srv.request("PUT", "/verpage")
+        body = (b'<VersioningConfiguration><Status>Enabled</Status>'
+                b'</VersioningConfiguration>')
+        srv.request("PUT", "/verpage", query=_q("versioning"), data=body)
+        for i in range(3):
+            srv.request("PUT", "/verpage/obj", data=f"v{i}".encode())
+        srv.request("PUT", "/verpage/zzz", data=b"z")
+        got = []
+        key_marker = vid_marker = ""
+        pages = 0
+        while True:
+            q = "versions&max-keys=2"
+            if key_marker:
+                q += f"&key-marker={key_marker}"
+            if vid_marker:
+                q += f"&version-id-marker={vid_marker}"
+            root = ET.fromstring(
+                srv.request("GET", "/verpage", query=_q(q)).text())
+            for v in root.findall(f"{NS}Version"):
+                got.append((v.findtext(f"{NS}Key"),
+                            v.findtext(f"{NS}VersionId")))
+            pages += 1
+            if root.findtext(f"{NS}IsTruncated") != "true":
+                break
+            key_marker = root.findtext(f"{NS}NextKeyMarker")
+            vid_marker = root.findtext(f"{NS}NextVersionIdMarker") or ""
+        assert pages == 2
+        assert len(got) == 4
+        assert [k for k, _ in got] == ["obj", "obj", "obj", "zzz"]
+        assert len({v for _, v in got}) == 4
+
+
+class TestObjectLayerListing:
+    def test_list_entries_across_sets(self, tmp_path):
+        from minio_tpu.erasure.sets import ErasureServerPools, ErasureSets
+        from minio_tpu.erasure import listing
+        from minio_tpu.storage.local import LocalStorage
+
+        disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(8)]
+        pools = ErasureServerPools(
+            [ErasureSets(disks, set_size=4)]
+        )
+        pools.make_bucket("b")
+        import io as _io
+        for k in ["x/1", "x/2", "y"]:
+            pools.put_object("b", k, _io.BytesIO(b"data"), 4)
+        res = listing.list_objects(pools, "b", max_keys=10)
+        assert [e.name for e in res.entries] == ["x/1", "x/2", "y"]
+        res = listing.list_objects(pools, "b", delimiter="/", max_keys=10)
+        assert [e.name for e in res.entries] == ["y"]
+        assert res.common_prefixes == ["x/"]
