@@ -1,0 +1,5 @@
+"""Per-bucket metadata subsystems: policy, lifecycle, tagging, object
+lock, quota, SSE config, notification, replication (reference
+cmd/bucket-metadata-sys.go + internal/bucket/*)."""
+
+from .metadata import BucketMetadataSys  # noqa: F401

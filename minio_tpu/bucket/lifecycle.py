@@ -1,0 +1,262 @@
+"""Bucket lifecycle (ILM) configuration model and evaluation.
+
+Reference: internal/bucket/lifecycle/lifecycle.go (rule matching +
+`ComputeAction`), internal/bucket/lifecycle/rule.go (XML schema).
+Supports Expiration (Days/Date/ExpiredObjectDeleteMarker),
+NoncurrentVersionExpiration, Transition / NoncurrentVersionTransition
+(StorageClass = tier name), AbortIncompleteMultipartUpload, and
+Prefix/Tag/And filters.  The data scanner evaluates every scanned version
+against `compute_action` (reference cmd/data-scanner.go:891).
+"""
+
+from __future__ import annotations
+
+import time
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass, field
+from enum import Enum
+
+_NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+
+
+def _find(el, tag):
+    e = el.find(f"{_NS}{tag}")
+    if e is None:
+        e = el.find(tag)
+    return e
+
+
+def _findall(el, tag):
+    return el.findall(f"{_NS}{tag}") or el.findall(tag)
+
+
+def _text(el, tag, default=""):
+    e = _find(el, tag)
+    return (e.text or default) if e is not None else default
+
+
+class Action(Enum):
+    NONE = "none"
+    DELETE = "delete"                       # expire latest version
+    DELETE_VERSION = "delete-version"       # expire noncurrent version
+    DELETE_MARKER = "delete-marker"         # remove expired delete marker
+    TRANSITION = "transition"
+    TRANSITION_VERSION = "transition-version"
+    ABORT_MULTIPART = "abort-multipart"
+
+
+DAY = 24 * 3600.0
+
+
+@dataclass
+class Filter:
+    prefix: str = ""
+    tags: dict = field(default_factory=dict)
+
+    @classmethod
+    def from_xml(cls, el) -> "Filter":
+        f = cls()
+        if el is None:
+            return f
+        and_el = _find(el, "And")
+        scope = and_el if and_el is not None else el
+        f.prefix = _text(scope, "Prefix")
+        for tag_el in _findall(scope, "Tag"):
+            k = _text(tag_el, "Key")
+            if k:
+                f.tags[k] = _text(tag_el, "Value")
+        return f
+
+    def matches(self, name: str, obj_tags: dict | None) -> bool:
+        if self.prefix and not name.startswith(self.prefix):
+            return False
+        if self.tags:
+            obj_tags = obj_tags or {}
+            for k, v in self.tags.items():
+                if obj_tags.get(k) != v:
+                    return False
+        return True
+
+
+@dataclass
+class Rule:
+    rule_id: str = ""
+    status: str = "Enabled"
+    filter: Filter = field(default_factory=Filter)
+    expiration_days: int = 0
+    expiration_date: float = 0.0
+    expire_delete_marker: bool = False
+    noncurrent_days: int = 0
+    newer_noncurrent_versions: int = 0
+    transition_days: int = -1
+    transition_date: float = 0.0
+    transition_tier: str = ""
+    nc_transition_days: int = -1
+    nc_transition_tier: str = ""
+    abort_mpu_days: int = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.status == "Enabled"
+
+    @classmethod
+    def from_xml(cls, el) -> "Rule":
+        r = cls(rule_id=_text(el, "ID"), status=_text(el, "Status", "Enabled"))
+        fil = _find(el, "Filter")
+        if fil is not None:
+            r.filter = Filter.from_xml(fil)
+        else:
+            # legacy top-level <Prefix>
+            r.filter = Filter(prefix=_text(el, "Prefix"))
+        exp = _find(el, "Expiration")
+        if exp is not None:
+            r.expiration_days = int(_text(exp, "Days", "0") or 0)
+            d = _text(exp, "Date")
+            if d:
+                r.expiration_date = _parse_date(d)
+            r.expire_delete_marker = (
+                _text(exp, "ExpiredObjectDeleteMarker").lower() == "true"
+            )
+        nce = _find(el, "NoncurrentVersionExpiration")
+        if nce is not None:
+            r.noncurrent_days = int(_text(nce, "NoncurrentDays", "0") or 0)
+            r.newer_noncurrent_versions = int(
+                _text(nce, "NewerNoncurrentVersions", "0") or 0
+            )
+        tr = _find(el, "Transition")
+        if tr is not None:
+            r.transition_days = int(_text(tr, "Days", "0") or 0)
+            d = _text(tr, "Date")
+            if d:
+                r.transition_date = _parse_date(d)
+            r.transition_tier = _text(tr, "StorageClass")
+        nct = _find(el, "NoncurrentVersionTransition")
+        if nct is not None:
+            r.nc_transition_days = int(_text(nct, "NoncurrentDays", "0") or 0)
+            r.nc_transition_tier = _text(nct, "StorageClass")
+        ab = _find(el, "AbortIncompleteMultipartUpload")
+        if ab is not None:
+            r.abort_mpu_days = int(_text(ab, "DaysAfterInitiation", "0") or 0)
+        return r
+
+
+def _parse_date(s: str) -> float:
+    s = s.strip().rstrip("Z")
+    try:
+        return time.mktime(time.strptime(s[:10], "%Y-%m-%d"))
+    except ValueError:
+        return 0.0
+
+
+@dataclass
+class ObjectOpts:
+    """Evaluation input (reference lifecycle.ObjectOpts)."""
+
+    name: str
+    mod_time: float = 0.0
+    is_latest: bool = True
+    delete_marker: bool = False
+    num_versions: int = 1
+    successor_mod_time: float = 0.0   # for noncurrent: when superseded
+    tags: dict | None = None
+    transition_status: str = ""       # "complete" once tiered
+
+
+@dataclass
+class Event:
+    action: Action = Action.NONE
+    tier: str = ""
+    rule_id: str = ""
+    due: float = 0.0
+
+
+class Lifecycle:
+    def __init__(self, rules: list[Rule]):
+        self.rules = rules
+
+    @classmethod
+    def from_xml(cls, raw: str | bytes) -> "Lifecycle":
+        root = ET.fromstring(raw)
+        rules = [Rule.from_xml(el) for el in _findall(root, "Rule")]
+        if not rules:
+            raise ValueError("lifecycle config with no rules")
+        if len(rules) > 1000:
+            raise ValueError("too many lifecycle rules")
+        return cls(rules)
+
+    def compute_action(self, obj: ObjectOpts, now: float | None = None) -> Event:
+        """Pick the applicable action for one object version
+        (reference lifecycle.Lifecycle.ComputeAction / Eval)."""
+        now = time.time() if now is None else now
+        ev = Event()
+        for rule in self.rules:
+            if not rule.enabled or not rule.filter.matches(obj.name, obj.tags):
+                continue
+
+            if not obj.is_latest:
+                # noncurrent expiration / transition
+                base = obj.successor_mod_time or obj.mod_time
+                if rule.noncurrent_days and base:
+                    due = base + rule.noncurrent_days * DAY
+                    if now >= due:
+                        ev = _pick(ev, Event(Action.DELETE_VERSION,
+                                             rule_id=rule.rule_id, due=due))
+                if (rule.nc_transition_days >= 0 and rule.nc_transition_tier
+                        and not obj.transition_status and base):
+                    due = base + rule.nc_transition_days * DAY
+                    if now >= due:
+                        ev = _pick(ev, Event(Action.TRANSITION_VERSION,
+                                             tier=rule.nc_transition_tier,
+                                             rule_id=rule.rule_id, due=due))
+                continue
+
+            if obj.delete_marker:
+                # a delete marker with no other versions left is "expired"
+                if rule.expire_delete_marker and obj.num_versions == 1:
+                    ev = _pick(ev, Event(Action.DELETE_MARKER,
+                                         rule_id=rule.rule_id, due=now))
+                continue
+
+            if rule.expiration_days and obj.mod_time:
+                due = obj.mod_time + rule.expiration_days * DAY
+                if now >= due:
+                    ev = _pick(ev, Event(Action.DELETE,
+                                         rule_id=rule.rule_id, due=due))
+            if rule.expiration_date and now >= rule.expiration_date:
+                ev = _pick(ev, Event(Action.DELETE, rule_id=rule.rule_id,
+                                     due=rule.expiration_date))
+            if (rule.transition_tier and not obj.transition_status
+                    and obj.mod_time):
+                due = (rule.transition_date
+                       or obj.mod_time + max(rule.transition_days, 0) * DAY)
+                if rule.transition_days >= 0 and now >= due:
+                    ev = _pick(ev, Event(Action.TRANSITION,
+                                         tier=rule.transition_tier,
+                                         rule_id=rule.rule_id, due=due))
+        return ev
+
+    def abort_multipart_days(self, name: str) -> int:
+        """Smallest DaysAfterInitiation among matching rules (0 = none)."""
+        days = 0
+        for rule in self.rules:
+            if not rule.enabled or not rule.filter.matches(name, None):
+                continue
+            if rule.abort_mpu_days and (not days or rule.abort_mpu_days < days):
+                days = rule.abort_mpu_days
+        return days
+
+
+def _pick(cur: Event, new: Event) -> Event:
+    """Deletion beats transition; earlier due date wins within a class
+    (reference lifecycle.go Eval ordering)."""
+    if cur.action == Action.NONE:
+        return new
+    cur_del = cur.action in (Action.DELETE, Action.DELETE_VERSION,
+                             Action.DELETE_MARKER)
+    new_del = new.action in (Action.DELETE, Action.DELETE_VERSION,
+                             Action.DELETE_MARKER)
+    if new_del and not cur_del:
+        return new
+    if cur_del and not new_del:
+        return cur
+    return new if new.due < cur.due else cur

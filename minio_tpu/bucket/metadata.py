@@ -1,0 +1,130 @@
+"""Bucket metadata system: one cached, persisted aggregate per bucket.
+
+Reference: `BucketMetadata` (cmd/bucket-metadata.go:76) persists
+policy/lifecycle/sse/tagging/object-lock/quota/notification/replication
+configs in one `.metadata.bin` per bucket, fronted by the cached
+`BucketMetadataSys` (cmd/bucket-metadata-sys.go) with peer invalidation.
+
+Here the aggregate rides the object layer's bucket-metadata JSON doc
+(replicated to every drive's system volume); config payloads are stored
+as strings (XML/JSON as the S3 API supplied them) under well-known keys,
+parsed on demand and cached parsed-form by generation counter.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from minio_tpu.iam.policy import Policy
+from minio_tpu.storage import errors
+
+# aggregate keys (values are the raw config documents)
+POLICY = "policy"              # JSON policy document
+LIFECYCLE = "lifecycle"        # LifecycleConfiguration XML
+TAGGING = "tagging"            # Tagging XML
+SSE_CONFIG = "sse"             # ServerSideEncryptionConfiguration XML
+OBJECT_LOCK = "object_lock"    # ObjectLockConfiguration XML
+QUOTA = "quota"                # JSON {"quota": bytes, "quotatype": "hard"}
+NOTIFICATION = "notification"  # NotificationConfiguration XML
+REPLICATION = "replication"    # ReplicationConfiguration XML
+VERSIONING = "versioning"      # bool (managed by set_versioning)
+
+
+class BucketMetadataSys:
+    """Cached view over per-bucket metadata with explicit invalidation."""
+
+    def __init__(self, api):
+        self.api = api
+        self._lock = threading.Lock()
+        self._cache: dict[str, tuple[float, dict]] = {}
+        self.ttl = 5.0  # seconds; single-node writes invalidate eagerly
+
+    # ------------------------------------------------------------- raw doc
+    def get(self, bucket: str) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            hit = self._cache.get(bucket)
+            if hit and now - hit[0] < self.ttl:
+                return hit[1]
+        meta = self.api.get_bucket_metadata(bucket)
+        with self._lock:
+            self._cache[bucket] = (now, meta)
+        return meta
+
+    def invalidate(self, bucket: str) -> None:
+        with self._lock:
+            self._cache.pop(bucket, None)
+
+    def set_config(self, bucket: str, key: str, value) -> None:
+        if not self.api.bucket_exists(bucket):
+            raise errors.BucketNotFound(bucket)
+        self.api.update_bucket_metadata(bucket, **{key: value})
+        self.invalidate(bucket)
+
+    def delete_config(self, bucket: str, key: str) -> None:
+        if not self.api.bucket_exists(bucket):
+            raise errors.BucketNotFound(bucket)
+        meta = self.api.get_bucket_metadata(bucket)
+        if key in meta:
+            meta.pop(key)
+            self.api.set_bucket_metadata(bucket, meta)
+        self.invalidate(bucket)
+
+    def get_config(self, bucket: str, key: str):
+        if not self.api.bucket_exists(bucket):
+            raise errors.BucketNotFound(bucket)
+        return self.get(bucket).get(key)
+
+    # ------------------------------------------------------------ typed views
+    def policy(self, bucket: str) -> Policy | None:
+        raw = self.get(bucket).get(POLICY)
+        if not raw:
+            return None
+        try:
+            return Policy.from_json(raw)
+        except Exception:
+            return None
+
+    def lifecycle(self, bucket: str):
+        from . import lifecycle as lc
+
+        raw = self.get(bucket).get(LIFECYCLE)
+        if not raw:
+            return None
+        try:
+            return lc.Lifecycle.from_xml(raw)
+        except Exception:
+            return None
+
+    def quota(self, bucket: str) -> int:
+        q = self.get(bucket).get(QUOTA) or {}
+        try:
+            return int(q.get("quota", 0))
+        except (TypeError, AttributeError, ValueError):
+            return 0
+
+    def object_lock_enabled(self, bucket: str) -> bool:
+        return bool(self.get(bucket).get(OBJECT_LOCK))
+
+    def replication_config(self, bucket: str):
+        from . import replication as repl
+
+        raw = self.get(bucket).get(REPLICATION)
+        if not raw:
+            return None
+        try:
+            return repl.ReplicationConfig.from_xml(raw)
+        except Exception:
+            return None
+
+    def notification_config(self, bucket: str):
+        from minio_tpu.events import config as ncfg
+
+        raw = self.get(bucket).get(NOTIFICATION)
+        if not raw:
+            return None
+        try:
+            return ncfg.NotificationConfig.from_xml(raw)
+        except Exception:
+            return None

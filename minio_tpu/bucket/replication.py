@@ -1,0 +1,88 @@
+"""Bucket replication configuration model.
+
+Reference: internal/bucket/replication/{replication,rule,destination}.go.
+Rules carry Status/Priority/Filter/Destination plus the MinIO extensions
+(DeleteMarkerReplication, DeleteReplication, ExistingObjectReplication).
+"""
+
+from __future__ import annotations
+
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass, field
+
+from .lifecycle import Filter, _find, _findall, _text
+
+
+@dataclass
+class ReplicationRule:
+    rule_id: str = ""
+    status: str = "Enabled"
+    priority: int = 0
+    filter: Filter = field(default_factory=Filter)
+    destination_arn: str = ""      # arn:minio:replication::<id>:<bucket>
+    delete_marker_replication: bool = True
+    delete_replication: bool = True
+    existing_objects: bool = False
+
+    @property
+    def enabled(self) -> bool:
+        return self.status == "Enabled"
+
+    @classmethod
+    def from_xml(cls, el) -> "ReplicationRule":
+        r = cls(rule_id=_text(el, "ID"),
+                status=_text(el, "Status", "Enabled"),
+                priority=int(_text(el, "Priority", "0") or 0))
+        fil = _find(el, "Filter")
+        r.filter = Filter.from_xml(fil) if fil is not None else Filter(
+            prefix=_text(el, "Prefix"))
+        dst = _find(el, "Destination")
+        if dst is not None:
+            r.destination_arn = _text(dst, "Bucket")
+        dmr = _find(el, "DeleteMarkerReplication")
+        if dmr is not None:
+            r.delete_marker_replication = _text(dmr, "Status") != "Disabled"
+        dr = _find(el, "DeleteReplication")
+        if dr is not None:
+            r.delete_replication = _text(dr, "Status") != "Disabled"
+        eo = _find(el, "ExistingObjectReplication")
+        if eo is not None:
+            r.existing_objects = _text(eo, "Status") == "Enabled"
+        return r
+
+    @property
+    def target_bucket(self) -> str:
+        # "arn:aws:s3:::bkt" or "arn:minio:replication::id:bkt" or plain name
+        arn = self.destination_arn
+        if arn.startswith("arn:"):
+            return arn.rsplit(":", 1)[-1]
+        return arn
+
+
+class ReplicationConfig:
+    def __init__(self, rules: list[ReplicationRule], role: str = ""):
+        self.rules = sorted(rules, key=lambda r: -r.priority)
+        self.role = role
+
+    @classmethod
+    def from_xml(cls, raw: str | bytes) -> "ReplicationConfig":
+        root = ET.fromstring(raw)
+        rules = [ReplicationRule.from_xml(el) for el in _findall(root, "Rule")]
+        if not rules:
+            raise ValueError("replication config with no rules")
+        return cls(rules, role=_text(root, "Role"))
+
+    def match(self, name: str, tags: dict | None = None) -> ReplicationRule | None:
+        """Highest-priority enabled rule matching the object."""
+        for r in self.rules:
+            if r.enabled and r.filter.matches(name, tags):
+                return r
+        return None
+
+    def replicate_deletes(self, name: str) -> bool:
+        r = self.match(name)
+        return bool(r and r.delete_replication)
+
+    def replicate_delete_markers(self, name: str) -> bool:
+        r = self.match(name)
+        return bool(r and r.delete_marker_replication)

@@ -30,14 +30,30 @@ from .objects import ObjectInfo
 
 @dataclass
 class ListEntry:
-    """One object name with all its versions, newest first."""
+    """One object name with all its versions, newest first.
+
+    Version resolution is LAZY: a delimiter listing that rolls thousands
+    of keys up into one CommonPrefix must not read one xl.meta per rolled-
+    up key, so the entry carries a resolver and only touches metadata when
+    `.versions` is actually consumed (post delimiter/marker filtering)."""
 
     name: str
-    versions: list[ObjectInfo] = field(default_factory=list)
+    _versions: list[ObjectInfo] | None = None
+    _resolve: object = None   # () -> list[ObjectInfo]
+
+    @property
+    def versions(self) -> list[ObjectInfo]:
+        if self._versions is None:
+            try:
+                self._versions = self._resolve() if self._resolve else []
+            except Exception:
+                self._versions = []
+        return self._versions
 
     @property
     def latest(self) -> ObjectInfo | None:
-        return self.versions[0] if self.versions else None
+        v = self.versions
+        return v[0] if v else None
 
 
 @dataclass
@@ -49,7 +65,7 @@ class ListResult:
     next_version_marker: str = ""
 
 
-def entry_from_xl(bucket: str, name: str, raw: bytes) -> ListEntry:
+def versions_from_xl(bucket: str, name: str, raw: bytes) -> list[ObjectInfo]:
     xl = XLMeta.loads(raw)
     versions = []
     for i, v in enumerate(xl.versions):
@@ -58,7 +74,7 @@ def entry_from_xl(bucket: str, name: str, raw: bytes) -> ListEntry:
         fi.data = None
         versions.append(ObjectInfo.from_file_info(fi, bucket, name,
                                                   versioned=True))
-    return ListEntry(name=name, versions=versions)
+    return versions
 
 
 def union_walk(disks, bucket: str, prefix: str = "") -> list[str]:
@@ -89,23 +105,25 @@ def union_walk(disks, bucket: str, prefix: str = "") -> list[str]:
 def set_list_entries(eo, bucket: str, prefix: str = "", marker: str = "",
                      include_marker: bool = False) -> Iterator[ListEntry]:
     """Sorted entry stream for one erasure set (listPathRaw analogue)."""
+    def resolver(obj_name: str):
+        # resolve versions from the first drive that can serve xl.meta
+        def resolve() -> list[ObjectInfo]:
+            for d in eo.disks:
+                if d is None or not d.is_online():
+                    continue
+                try:
+                    raw = d.read_xl(bucket, obj_name)
+                    return versions_from_xl(bucket, obj_name, raw)
+                except Exception:
+                    continue
+            return []
+        return resolve
+
     for name in union_walk(eo.disks, bucket, prefix):
         if marker and (name < marker
                        or (name == marker and not include_marker)):
             continue
-        # resolve versions from the first drive that can serve xl.meta
-        for d in eo.disks:
-            if d is None or not d.is_online():
-                continue
-            try:
-                raw = d.read_xl(bucket, name)
-            except Exception:
-                continue
-            try:
-                yield entry_from_xl(bucket, name, raw)
-            except Exception:
-                continue
-            break
+        yield ListEntry(name=name, _resolve=resolver(name))
 
 
 def merge_entry_streams(streams: list[Iterator[ListEntry]]
@@ -197,7 +215,12 @@ def list_objects(api, bucket: str, prefix: str = "", delimiter: str = "",
                     (i for i, v in enumerate(versions)
                      if (v.version_id or "null") == version_marker), None,
                 )
-                versions = versions[idx + 1:] if idx is not None else versions
+                if idx is None:
+                    # a marker naming a nonexistent version would re-emit
+                    # the whole key and duplicate pages (S3: InvalidArgument)
+                    raise errors.InvalidArgument(
+                        f"invalid version-id-marker {version_marker}")
+                versions = versions[idx + 1:]
             for v in versions:
                 if emitted >= budget:
                     return truncate()

@@ -509,6 +509,14 @@ class ErasureServerPools:
                 return meta
         return {}
 
+    def set_bucket_metadata(self, bucket: str, meta: dict) -> None:
+        for p in self.pools:
+            p.set_bucket_metadata(bucket, meta)
+
+    def update_bucket_metadata(self, bucket: str, **kv) -> None:
+        for p in self.pools:
+            p.update_bucket_metadata(bucket, **kv)
+
     def versioning_enabled(self, bucket: str) -> bool:
         return bool(self.get_bucket_metadata(bucket).get("versioning"))
 

@@ -1,0 +1,4 @@
+"""Bucket event notification subsystem (reference internal/event/)."""
+
+from .config import NotificationConfig  # noqa: F401
+from .event import Event, EventName, new_event  # noqa: F401

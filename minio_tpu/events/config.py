@@ -1,0 +1,80 @@
+"""Notification configuration: XML parse + event-to-target rule routing.
+
+Reference: internal/event/config.go (NotificationConfiguration XML with
+QueueConfiguration/TopicConfiguration/CloudFunctionConfiguration) and
+internal/event/rules.go (prefix/suffix filter rule maps).
+"""
+
+from __future__ import annotations
+
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass, field
+
+from minio_tpu.bucket.lifecycle import _findall, _text
+from .event import expand_event_name
+
+
+@dataclass
+class QueueConfig:
+    config_id: str = ""
+    arn: str = ""                  # arn:minio:sqs:<region>:<id>:<type>
+    events: list[str] = field(default_factory=list)   # expanded names
+    prefix: str = ""
+    suffix: str = ""
+
+    def matches(self, event_name: str, key: str) -> bool:
+        if event_name not in self.events:
+            return False
+        if self.prefix and not key.startswith(self.prefix):
+            return False
+        if self.suffix and not key.endswith(self.suffix):
+            return False
+        return True
+
+    @property
+    def target_id(self) -> str:
+        """'<id>:<type>' from the ARN tail (reference TargetID)."""
+        parts = self.arn.split(":")
+        return ":".join(parts[-2:]) if len(parts) >= 2 else self.arn
+
+
+class NotificationConfig:
+    def __init__(self, queues: list[QueueConfig]):
+        self.queues = queues
+
+    @classmethod
+    def from_xml(cls, raw: str | bytes) -> "NotificationConfig":
+        root = ET.fromstring(raw)
+        queues: list[QueueConfig] = []
+        for tag, arn_tag in (("QueueConfiguration", "Queue"),
+                             ("TopicConfiguration", "Topic"),
+                             ("CloudFunctionConfiguration", "CloudFunction")):
+            for el in _findall(root, tag):
+                qc = QueueConfig(config_id=_text(el, "Id"),
+                                 arn=_text(el, arn_tag))
+                for ev in _findall(el, "Event"):
+                    qc.events.extend(expand_event_name(ev.text or ""))
+                fil = el.find(
+                    "{http://s3.amazonaws.com/doc/2006-03-01/}Filter"
+                ) or el.find("Filter")
+                if fil is not None:
+                    for r in fil.iter():
+                        if r.tag.endswith("FilterRule"):
+                            n = _text(r, "Name").lower()
+                            v = _text(r, "Value")
+                            if n == "prefix":
+                                qc.prefix = v
+                            elif n == "suffix":
+                                qc.suffix = v
+                queues.append(qc)
+        return cls(queues)
+
+    def targets_for(self, event_name: str, key: str) -> list[QueueConfig]:
+        return [q for q in self.queues if q.matches(event_name, key)]
+
+    def validate(self, known_target_ids) -> list[str]:
+        """ARNs whose target id is not registered (reference config
+        validation returns ErrARNNotFound)."""
+        known = set(known_target_ids)
+        return [q.arn for q in self.queues
+                if q.target_id not in known and q.arn]

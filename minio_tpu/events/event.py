@@ -1,0 +1,108 @@
+"""S3 event model (reference internal/event/event.go, name.go).
+
+Events serialize to the S3 notification record shape
+(`Records: [{eventVersion, eventSource, s3: {bucket, object}, ...}]`).
+"""
+
+from __future__ import annotations
+
+import time
+import urllib.parse
+from dataclasses import dataclass, field
+from enum import Enum
+
+
+class EventName(str, Enum):
+    OBJECT_CREATED_PUT = "s3:ObjectCreated:Put"
+    OBJECT_CREATED_POST = "s3:ObjectCreated:Post"
+    OBJECT_CREATED_COPY = "s3:ObjectCreated:Copy"
+    OBJECT_CREATED_COMPLETE_MULTIPART = \
+        "s3:ObjectCreated:CompleteMultipartUpload"
+    OBJECT_REMOVED_DELETE = "s3:ObjectRemoved:Delete"
+    OBJECT_REMOVED_DELETE_MARKER = "s3:ObjectRemoved:DeleteMarkerCreated"
+    OBJECT_ACCESSED_GET = "s3:ObjectAccessed:Get"
+    OBJECT_ACCESSED_HEAD = "s3:ObjectAccessed:Head"
+    OBJECT_RESTORE_POST = "s3:ObjectRestore:Post"
+    OBJECT_RESTORE_COMPLETED = "s3:ObjectRestore:Completed"
+    OBJECT_TRANSITION_COMPLETE = "s3:ObjectTransition:Complete"
+    ILM_DEL = "s3:ObjectRemoved:Delete"  # scanner expiry fires Removed
+    REPLICATION_FAILED = "s3:Replication:OperationFailedReplication"
+    REPLICATION_COMPLETE = "s3:Replication:OperationCompletedReplication"
+
+    def expand(self) -> list[str]:
+        return [self.value]
+
+
+def expand_event_name(name: str) -> list[str]:
+    """'s3:ObjectCreated:*' → all Created events (reference name.go Expand)."""
+    if not name.endswith(":*"):
+        return [name]
+    prefix = name[:-1]  # keep trailing ':'
+    return [e.value for e in EventName if e.value.startswith(prefix)]
+
+
+@dataclass
+class Identity:
+    principal_id: str = "minio-tpu"
+
+
+@dataclass
+class Event:
+    event_name: str
+    bucket: str
+    object_key: str
+    size: int = 0
+    etag: str = ""
+    version_id: str = ""
+    sequencer: str = ""
+    time: float = field(default_factory=time.time)
+    region: str = "us-east-1"
+    user_identity: str = "minio-tpu"
+    source_host: str = ""
+    user_agent: str = ""
+    response_elements: dict = field(default_factory=dict)
+
+    def to_record(self) -> dict:
+        """One entry of the `Records` array (reference event.Event)."""
+        return {
+            "eventVersion": "2.0",
+            "eventSource": "minio-tpu:s3",
+            "awsRegion": self.region,
+            "eventTime": time.strftime(
+                "%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(self.time)),
+            "eventName": self.event_name.replace("s3:", "", 1),
+            "userIdentity": {"principalId": self.user_identity},
+            "requestParameters": {"sourceIPAddress": self.source_host},
+            "responseElements": self.response_elements,
+            "s3": {
+                "s3SchemaVersion": "1.0",
+                "configurationId": "Config",
+                "bucket": {
+                    "name": self.bucket,
+                    "ownerIdentity": {"principalId": self.user_identity},
+                    "arn": f"arn:aws:s3:::{self.bucket}",
+                },
+                "object": {
+                    "key": urllib.parse.quote(self.object_key),
+                    "size": self.size,
+                    "eTag": self.etag,
+                    "versionId": self.version_id,
+                    "sequencer": self.sequencer or f"{int(self.time*1e9):016X}",
+                },
+            },
+            "source": {
+                "host": self.source_host,
+                "port": "",
+                "userAgent": self.user_agent,
+            },
+        }
+
+
+def new_event(name: EventName | str, bucket: str, key: str, *,
+              size: int = 0, etag: str = "", version_id: str = "",
+              host: str = "", user: str = "minio-tpu") -> Event:
+    return Event(
+        event_name=name.value if isinstance(name, EventName) else name,
+        bucket=bucket, object_key=key, size=size, etag=etag,
+        version_id=version_id, source_host=host, user_identity=user,
+    )

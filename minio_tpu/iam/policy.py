@@ -223,13 +223,20 @@ class Policy:
 
     def is_allowed(self, args: PolicyArgs) -> bool:
         """Deny overrides allow (reference policy.Policy.IsAllowed)."""
+        return self.evaluate(args) == "allow"
+
+    def evaluate(self, args: PolicyArgs) -> str:
+        """Three-valued decision: 'deny' (explicit), 'allow', or 'none'
+        (no matching statement).  Callers combining several policy layers
+        (IAM + bucket policy) need to distinguish an explicit Deny —
+        which must win across layers — from mere absence of an Allow."""
         allowed = False
         for s in self.statements:
             if s.matches(args):
                 if s.effect == "Deny":
-                    return False
+                    return "deny"
                 allowed = True
-        return allowed
+        return "allow" if allowed else "none"
 
     def is_empty(self) -> bool:
         return not self.statements

@@ -336,32 +336,40 @@ class IAMSys:
 
     def is_allowed(self, access_key: str, action: str, bucket: str = "",
                    obj: str = "", conditions: dict | None = None) -> bool:
+        return self.evaluate(access_key, action, bucket, obj,
+                             conditions) == "allow"
+
+    def evaluate(self, access_key: str, action: str, bucket: str = "",
+                 obj: str = "", conditions: dict | None = None) -> str:
+        """'allow' | 'deny' | 'none'.  An explicit IAM Deny must override
+        any grant from other policy layers (e.g. a bucket policy), so
+        callers need the three-way result, not just a bool."""
         if access_key == self.root.access_key:
-            return True
+            return "allow"
         with self._mu:
             ident = self.users.get(access_key)
             if ident is None or ident.status != "enabled" or ident.expired():
-                return False
+                return "deny"
             args = PolicyArgs(action=action, bucket=bucket, object=obj,
                               account=access_key,
                               conditions=conditions or {})
             if ident.kind in ("svc", "sts"):
                 # inherit the parent's permission set
                 if ident.parent == self.root.access_key:
-                    base_ok = True
+                    base = "allow"
                 else:
                     parent = self.users.get(ident.parent)
                     if parent is None or parent.status != "enabled":
-                        return False
-                    base_ok = self._effective_policy(parent).is_allowed(args)
-                if not base_ok:
-                    return False
+                        return "deny"
+                    base = self._effective_policy(parent).evaluate(args)
+                if base != "allow":
+                    return base
                 # session policy (if any) further restricts
                 if ident.session_policy:
                     try:
                         sp = Policy.from_json(ident.session_policy)
                     except Exception:
-                        return False
-                    return sp.is_allowed(args)
-                return True
-            return self._effective_policy(ident).is_allowed(args)
+                        return "deny"
+                    return sp.evaluate(args)
+                return "allow"
+            return self._effective_policy(ident).evaluate(args)

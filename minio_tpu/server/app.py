@@ -29,6 +29,7 @@ from aiohttp import web
 from minio_tpu.storage import errors as st
 from minio_tpu.erasure.objects import PutObjectOptions
 from . import sigv4
+from .bucket_meta import BucketMetaHandlers
 from .s3errors import S3Error, from_storage_error
 
 XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
@@ -145,17 +146,19 @@ class _QueuePipeReader(io.RawIOBase):
         return out
 
 
-class S3Server:
+class S3Server(BucketMetaHandlers):
     def __init__(self, object_layer, access_key: str = "minioadmin",
                  secret_key: str = "minioadmin", region: str = "us-east-1",
                  max_concurrency: int = 64, iam=None):
         import concurrent.futures as cf
+        from minio_tpu.bucket import BucketMetadataSys
         from minio_tpu.iam import IAMSys
 
         self.api = object_layer
         self.iam = iam if iam is not None else IAMSys(
             object_layer, access_key, secret_key
         )
+        self.meta = BucketMetadataSys(object_layer)
         self.region = region
         self.sem = asyncio.Semaphore(max_concurrency)
         # Dedicated pool sized to the request semaphore so a full house of
@@ -193,16 +196,33 @@ class S3Server:
             headers={"Server": "MinIO-TPU"},
         )
 
-    def _auth(self, request: web.Request, payload_hash: str | None,
-              action: str = "", bucket: str = "", obj: str = ""):
-        """SigV4 verification + IAM authorization for `action` on the
-        resource (reference checkRequestAuthType, cmd/auth-handler.go)."""
+    async def _auth(self, request: web.Request, payload_hash: str | None,
+                    action: str = "", bucket: str = "", obj: str = ""):
+        """SigV4 verification + IAM/bucket-policy authorization for
+        `action` on the resource (reference checkRequestAuthType,
+        cmd/auth-handler.go).  Decision combines the IAM layer with the
+        bucket policy; an explicit Deny in either layer wins."""
         query = [(k, v) for k, v in urllib.parse.parse_qsl(
             request.rel_url.query_string, keep_blank_values=True
         )]
         headers = dict(request.headers)
         headers["host"] = request.headers.get("Host", request.host)
         path = urllib.parse.unquote(request.rel_url.raw_path)
+        conditions = {"aws:SourceIp": request.remote or ""}
+
+        if ("Authorization" not in request.headers
+                and "X-Amz-Signature" not in dict(query)):
+            # anonymous request: the bucket policy alone decides
+            # (reference cmd/auth-handler.go authTypeAnonymous path)
+            if action and bucket:
+                decision = await self._run(
+                    self._bucket_policy_decision, "*", action, bucket, obj,
+                    conditions)
+                if decision == "allow":
+                    return sigv4.V4Context("", b"", "", "", "")
+            raise S3Error("AccessDenied", "anonymous access denied",
+                          resource=request.path)
+
         try:
             if "X-Amz-Signature" in dict(query):
                 ctx = sigv4.verify_v4_presigned(
@@ -216,13 +236,45 @@ class S3Server:
                 )
         except sigv4.SigV4Error as e:
             raise S3Error(e.code, str(e))
-        if action and not self.iam.is_allowed(
-            ctx.access_key, action, bucket, obj,
-            conditions={"aws:SourceIp": request.remote or ""},
-        ):
-            raise S3Error("AccessDenied", f"not allowed to {action}",
-                          resource=request.path)
+        if action:
+            iam_decision = self.iam.evaluate(
+                ctx.access_key, action, bucket, obj, conditions=conditions,
+            )
+            allowed = iam_decision == "allow"
+            if iam_decision == "none" and bucket:
+                # no IAM statement matched: the bucket policy may grant
+                # (an explicit IAM Deny is final and never reaches here)
+                decision = await self._run(
+                    self._bucket_policy_decision, ctx.access_key, action,
+                    bucket, obj, conditions)
+                allowed = decision == "allow"
+            elif allowed and bucket:
+                # bucket-policy Deny overrides an IAM allow (deny-wins
+                # across layers), except for the root account
+                if ctx.access_key != self.iam.root.access_key:
+                    decision = await self._run(
+                        self._bucket_policy_decision, ctx.access_key, action,
+                        bucket, obj, conditions)
+                    allowed = decision != "deny"
+            if not allowed:
+                raise S3Error("AccessDenied", f"not allowed to {action}",
+                              resource=request.path)
         return ctx
+
+    def _bucket_policy_decision(self, account: str, action: str, bucket: str,
+                                obj: str, conditions: dict) -> str:
+        from minio_tpu.iam.policy import PolicyArgs
+
+        try:
+            pol = self.meta.policy(bucket)
+        except Exception:
+            return "none"
+        if pol is None:
+            return "none"
+        return pol.evaluate(PolicyArgs(
+            action=action, bucket=bucket, object=obj, account=account,
+            conditions=conditions,
+        ))
 
     async def _handle(self, request: web.Request, fn) -> web.StreamResponse:
         async with self.sem:
@@ -254,7 +306,7 @@ class S3Server:
         (reference AssumeRole, cmd/sts-handlers.go)."""
         body = await request.read()
         form = dict(urllib.parse.parse_qsl(body.decode("utf-8", "replace")))
-        ctx = self._auth(request, hashlib.sha256(body).hexdigest())
+        ctx = await self._auth(request, hashlib.sha256(body).hexdigest())
         action = form.get("Action", "")
         if action != "AssumeRole":
             raise S3Error("InvalidArgument", f"unsupported STS action {action}")
@@ -284,25 +336,70 @@ class S3Server:
             "</Credentials></AssumeRoleResult></AssumeRoleResponse>"
         ))
 
+    # bucket sub-resources routed by query parameter (reference
+    # cmd/api-router.go Queries(...) matchers)
+    _BUCKET_GET = {
+        "location": "bucket_location", "versioning": "get_versioning",
+        "uploads": "list_uploads", "versions": "list_object_versions",
+        "policy": "get_bucket_policy", "lifecycle": "get_bucket_lifecycle",
+        "tagging": "get_bucket_tagging", "encryption": "get_bucket_encryption",
+        "object-lock": "get_object_lock_config",
+        "notification": "get_bucket_notification",
+        "replication": "get_bucket_replication", "quota": "get_bucket_quota",
+        "acl": "get_bucket_acl", "cors": "get_bucket_cors",
+    }
+    _BUCKET_PUT = {
+        "versioning": "put_versioning", "policy": "put_bucket_policy",
+        "lifecycle": "put_bucket_lifecycle", "tagging": "put_bucket_tagging",
+        "encryption": "put_bucket_encryption",
+        "object-lock": "put_object_lock_config",
+        "notification": "put_bucket_notification",
+        "replication": "put_bucket_replication", "quota": "put_bucket_quota",
+        "acl": "put_bucket_acl",
+    }
+    _BUCKET_DELETE = {
+        "policy": "delete_bucket_policy",
+        "lifecycle": "delete_bucket_lifecycle",
+        "tagging": "delete_bucket_tagging",
+        "encryption": "delete_bucket_encryption",
+        "replication": "delete_bucket_replication",
+    }
+    # every S3 bucket sub-resource: an unhandled one must answer
+    # NotImplemented, NEVER fall through to make/delete-bucket
+    _BUCKET_SUBRESOURCES = frozenset({
+        "accelerate", "acl", "analytics", "cors", "encryption",
+        "intelligent-tiering", "inventory", "lifecycle", "location",
+        "logging", "metrics", "notification", "object-lock",
+        "ownershipControls", "policy", "policyStatus", "publicAccessBlock",
+        "quota", "replication", "requestPayment", "tagging", "uploads",
+        "versioning", "versions", "website",
+    })
+
+    @staticmethod
+    async def _not_implemented(request: web.Request) -> web.Response:
+        raise S3Error("NotImplemented", resource=request.path)
+
+    def _subresource_route(self, q, table):
+        for param, handler in table.items():
+            if param in q:
+                return getattr(self, handler)
+        for param in q:
+            if param in self._BUCKET_SUBRESOURCES:
+                return self._not_implemented
+        return None
+
     async def dispatch_bucket(self, request: web.Request) -> web.StreamResponse:
         q = request.rel_url.query
         m = request.method
         if m == "GET":
-            if "location" in q:
-                return await self._handle(request, self.bucket_location)
-            if "versioning" in q:
-                return await self._handle(request, self.get_versioning)
-            if "uploads" in q:
-                return await self._handle(request, self.list_uploads)
-            if "versions" in q:
-                return await self._handle(request, self.list_object_versions)
-            return await self._handle(request, self.list_objects)
+            fn = self._subresource_route(q, self._BUCKET_GET)
+            return await self._handle(request, fn or self.list_objects)
         if m == "PUT":
-            if "versioning" in q:
-                return await self._handle(request, self.put_versioning)
-            return await self._handle(request, self.make_bucket)
+            fn = self._subresource_route(q, self._BUCKET_PUT)
+            return await self._handle(request, fn or self.make_bucket)
         if m == "DELETE":
-            return await self._handle(request, self.delete_bucket)
+            fn = self._subresource_route(q, self._BUCKET_DELETE)
+            return await self._handle(request, fn or self.delete_bucket)
         if m == "HEAD":
             return await self._handle(request, self.head_bucket)
         if m == "POST" and "delete" in q:
@@ -339,7 +436,7 @@ class S3Server:
 
     # ------------------------------------------------------------- service
     async def list_buckets(self, request: web.Request) -> web.Response:
-        self._auth(request, None, "s3:ListAllMyBuckets")
+        await self._auth(request, None, "s3:ListAllMyBuckets")
         vols = await self._run(self.api.list_buckets)
         buckets = "".join(
             f"<Bucket><Name>{escape(v.name)}</Name>"
@@ -362,27 +459,27 @@ class S3Server:
 
     async def make_bucket(self, request: web.Request) -> web.Response:
         bucket = self._bucket(request)
-        self._auth(request, None, "s3:CreateBucket", bucket)
+        await self._auth(request, None, "s3:CreateBucket", bucket)
         await request.read()
         await self._run(self.api.make_bucket, bucket)
         return web.Response(status=200, headers={"Location": f"/{bucket}"})
 
     async def head_bucket(self, request: web.Request) -> web.Response:
         bucket = self._bucket(request)
-        self._auth(request, None, "s3:ListBucket", bucket)
+        await self._auth(request, None, "s3:ListBucket", bucket)
         if not await self._run(self.api.bucket_exists, bucket):
             raise S3Error("NoSuchBucket", resource=bucket)
         return web.Response(status=200)
 
     async def delete_bucket(self, request: web.Request) -> web.Response:
         bucket = self._bucket(request)
-        self._auth(request, None, "s3:DeleteBucket", bucket)
+        await self._auth(request, None, "s3:DeleteBucket", bucket)
         await self._run(self.api.delete_bucket, bucket)
         return web.Response(status=204)
 
     async def bucket_location(self, request: web.Request) -> web.Response:
         bucket = self._bucket(request)
-        self._auth(request, None, "s3:GetBucketLocation", bucket)
+        await self._auth(request, None, "s3:GetBucketLocation", bucket)
         if not await self._run(self.api.bucket_exists, bucket):
             raise S3Error("NoSuchBucket", resource=bucket)
         return self._xml(200, (
@@ -393,7 +490,7 @@ class S3Server:
 
     async def get_versioning(self, request: web.Request) -> web.Response:
         bucket = self._bucket(request)
-        self._auth(request, None, "s3:GetBucketVersioning", bucket)
+        await self._auth(request, None, "s3:GetBucketVersioning", bucket)
         enabled = await self._versioned(bucket)
         inner = "<Status>Enabled</Status>" if enabled else ""
         return self._xml(200, (
@@ -405,7 +502,7 @@ class S3Server:
     async def put_versioning(self, request: web.Request) -> web.Response:
         body = await request.read()
         bucket = self._bucket(request)
-        self._auth(request, hashlib.sha256(body).hexdigest(),
+        await self._auth(request, hashlib.sha256(body).hexdigest(),
                    "s3:PutBucketVersioning", bucket)
         try:
             root = ET.fromstring(body)
@@ -416,6 +513,7 @@ class S3Server:
         if setter is None:
             raise S3Error("NotImplemented")
         await self._run(setter, bucket, status == "Enabled")
+        self.meta.invalidate(bucket)
         return web.Response(status=200)
 
     @staticmethod
@@ -429,7 +527,7 @@ class S3Server:
         from minio_tpu.erasure import listing as listing_mod
 
         bucket = self._bucket(request)
-        self._auth(request, None, "s3:ListBucket", bucket)
+        await self._auth(request, None, "s3:ListBucket", bucket)
         q = request.rel_url.query
         prefix = q.get("prefix", "")
         delimiter = q.get("delimiter", "")
@@ -500,7 +598,7 @@ class S3Server:
         from minio_tpu.erasure import listing as listing_mod
 
         bucket = self._bucket(request)
-        self._auth(request, None, "s3:ListBucketVersions", bucket)
+        await self._auth(request, None, "s3:ListBucketVersions", bucket)
         q = request.rel_url.query
         prefix = q.get("prefix", "")
         delimiter = q.get("delimiter", "")
@@ -574,7 +672,7 @@ class S3Server:
     async def delete_objects(self, request: web.Request) -> web.Response:
         body = await request.read()
         bucket = self._bucket(request)
-        ctx = self._auth(request, hashlib.sha256(body).hexdigest())
+        ctx = await self._auth(request, hashlib.sha256(body).hexdigest())
         try:
             root = ET.fromstring(body)
         except ET.ParseError:
@@ -639,13 +737,13 @@ class S3Server:
         sha_claim = request.headers.get("x-amz-content-sha256", "")
         copy_src = request.headers.get("x-amz-copy-source")
         if copy_src:
-            ctx = self._auth(request, sha_claim or sigv4.EMPTY_SHA256,
+            ctx = await self._auth(request, sha_claim or sigv4.EMPTY_SHA256,
                              "s3:PutObject", bucket, key)
             return await self.copy_object(request, bucket, key, copy_src, ctx)
 
         size = request.content_length
         streaming = sha_claim.startswith("STREAMING-")
-        ctx = self._auth(request, sha_claim or None, "s3:PutObject", bucket, key)
+        ctx = await self._auth(request, sha_claim or None, "s3:PutObject", bucket, key)
 
         decoded_len = request.headers.get("x-amz-decoded-content-length")
         real_size = int(decoded_len) if streaming and decoded_len else (
@@ -770,7 +868,7 @@ class S3Server:
 
     async def get_object(self, request: web.Request) -> web.StreamResponse:
         bucket, key = self._object(request)
-        self._auth(request, None, "s3:GetObject", bucket, key)
+        await self._auth(request, None, "s3:GetObject", bucket, key)
         vid = request.rel_url.query.get("versionId", "")
         oi = await self._run(self.api.get_object_info, bucket, key, vid)
 
@@ -804,7 +902,7 @@ class S3Server:
 
     async def head_object(self, request: web.Request) -> web.Response:
         bucket, key = self._object(request)
-        self._auth(request, None, "s3:GetObject", bucket, key)
+        await self._auth(request, None, "s3:GetObject", bucket, key)
         vid = request.rel_url.query.get("versionId", "")
         oi = await self._run(self.api.get_object_info, bucket, key, vid)
         headers = self._obj_headers(oi)
@@ -813,7 +911,7 @@ class S3Server:
 
     async def delete_object(self, request: web.Request) -> web.Response:
         bucket, key = self._object(request)
-        self._auth(request, None, "s3:DeleteObject", bucket, key)
+        await self._auth(request, None, "s3:DeleteObject", bucket, key)
         vid = request.rel_url.query.get("versionId", "")
         versioned = await self._versioned(bucket)
         oi = await self._run(
@@ -829,7 +927,7 @@ class S3Server:
     # ----------------------------------------------------------- multipart
     async def create_upload(self, request: web.Request) -> web.Response:
         bucket, key = self._object(request)
-        self._auth(request, None, "s3:PutObject", bucket, key)
+        await self._auth(request, None, "s3:PutObject", bucket, key)
         opts = PutObjectOptions(
             content_type=request.headers.get("Content-Type", ""),
             user_metadata={
@@ -852,7 +950,7 @@ class S3Server:
         part_num = int(q["partNumber"])
         sha_claim = request.headers.get("x-amz-content-sha256", "")
         streaming = sha_claim.startswith("STREAMING-")
-        ctx = self._auth(request, sha_claim or None, "s3:PutObject", bucket, key)
+        ctx = await self._auth(request, sha_claim or None, "s3:PutObject", bucket, key)
         decoded_len = request.headers.get("x-amz-decoded-content-length")
         size = request.content_length
         real_size = int(decoded_len) if streaming and decoded_len else (
@@ -881,7 +979,7 @@ class S3Server:
 
     async def list_parts(self, request: web.Request) -> web.Response:
         bucket, key = self._object(request)
-        self._auth(request, None, "s3:ListMultipartUploadParts", bucket, key)
+        await self._auth(request, None, "s3:ListMultipartUploadParts", bucket, key)
         uid = request.rel_url.query["uploadId"]
         try:
             parts = await self._run(self.api.list_object_parts, bucket, key, uid)
@@ -902,7 +1000,7 @@ class S3Server:
 
     async def list_uploads(self, request: web.Request) -> web.Response:
         bucket = self._bucket(request)
-        self._auth(request, None, "s3:ListBucketMultipartUploads", bucket)
+        await self._auth(request, None, "s3:ListBucketMultipartUploads", bucket)
         return self._xml(200, (
             f'<?xml version="1.0" encoding="UTF-8"?>'
             f'<ListMultipartUploadsResult xmlns="{XMLNS}">'
@@ -913,7 +1011,7 @@ class S3Server:
 
     async def abort_upload(self, request: web.Request) -> web.Response:
         bucket, key = self._object(request)
-        self._auth(request, None, "s3:AbortMultipartUpload", bucket, key)
+        await self._auth(request, None, "s3:AbortMultipartUpload", bucket, key)
         uid = request.rel_url.query["uploadId"]
         try:
             await self._run(self.api.abort_multipart_upload, bucket, key, uid)
@@ -924,7 +1022,7 @@ class S3Server:
     async def complete_upload(self, request: web.Request) -> web.Response:
         body = await request.read()
         bucket, key = self._object(request)
-        self._auth(request, hashlib.sha256(body).hexdigest(),
+        await self._auth(request, hashlib.sha256(body).hexdigest(),
                    "s3:PutObject", bucket, key)
         uid = request.rel_url.query["uploadId"]
         try:

@@ -1,0 +1,366 @@
+"""Bucket configuration handlers: policy, lifecycle, tagging, encryption,
+object-lock, notification, replication, ACL/CORS stubs.
+
+Reference: cmd/bucket-policy-handlers.go, cmd/bucket-lifecycle-handlers.go,
+cmd/bucket-handlers.go (tagging/notification), cmd/bucket-encryption-
+handlers.go, cmd/bucket-object-lock-handlers.go, cmd/bucket-replication-
+handlers.go.  Mixed into S3Server; config payloads persist through
+BucketMetadataSys into the per-bucket metadata aggregate.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import xml.etree.ElementTree as ET
+
+from aiohttp import web
+
+from minio_tpu.bucket import metadata as bm
+from minio_tpu.bucket.lifecycle import Lifecycle
+from minio_tpu.bucket.replication import ReplicationConfig
+from minio_tpu.events.config import NotificationConfig
+from minio_tpu.iam.policy import Policy
+
+from .s3errors import S3Error
+
+XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
+
+
+class BucketMetaHandlers:
+    """Handler mixin; expects self.api, self.meta, self._auth, self._xml."""
+
+    # ----------------------------------------------------------- policy
+    async def get_bucket_policy(self, request: web.Request) -> web.Response:
+        bucket = self._bucket(request)
+        await self._auth(request, None, "s3:GetBucketPolicy", bucket)
+        raw = await self._run(self.meta.get_config, bucket, bm.POLICY)
+        if not raw:
+            raise S3Error("NoSuchBucketPolicy", resource=bucket)
+        return web.Response(status=200, body=raw.encode(),
+                            content_type="application/json")
+
+    async def put_bucket_policy(self, request: web.Request) -> web.Response:
+        body = await request.read()
+        bucket = self._bucket(request)
+        await self._auth(request, hashlib.sha256(body).hexdigest(),
+                   "s3:PutBucketPolicy", bucket)
+        if len(body) > 20 * 1024:
+            raise S3Error("PolicyTooLarge", resource=bucket)
+        try:
+            pol = Policy.from_json(body)
+        except Exception as e:
+            raise S3Error("MalformedPolicy", str(e), resource=bucket)
+        # bucket policies must be scoped to this bucket
+        for st in pol.statements:
+            for res in st.resources:
+                r = res.removeprefix("arn:aws:s3:::")
+                if not (r == bucket or r.startswith(bucket + "/")):
+                    raise S3Error("MalformedPolicy",
+                                  f"resource {res} outside bucket {bucket}")
+        await self._run(self.meta.set_config, bucket, bm.POLICY,
+                        body.decode())
+        return web.Response(status=204)
+
+    async def delete_bucket_policy(self, request: web.Request) -> web.Response:
+        bucket = self._bucket(request)
+        await self._auth(request, None, "s3:DeleteBucketPolicy", bucket)
+        await self._run(self.meta.delete_config, bucket, bm.POLICY)
+        return web.Response(status=204)
+
+    # -------------------------------------------------------- lifecycle
+    async def get_bucket_lifecycle(self, request: web.Request) -> web.Response:
+        bucket = self._bucket(request)
+        await self._auth(request, None, "s3:GetLifecycleConfiguration", bucket)
+        raw = await self._run(self.meta.get_config, bucket, bm.LIFECYCLE)
+        if not raw:
+            raise S3Error("NoSuchLifecycleConfiguration", resource=bucket)
+        return self._xml(200, raw)
+
+    async def put_bucket_lifecycle(self, request: web.Request) -> web.Response:
+        body = await request.read()
+        bucket = self._bucket(request)
+        await self._auth(request, hashlib.sha256(body).hexdigest(),
+                   "s3:PutLifecycleConfiguration", bucket)
+        try:
+            Lifecycle.from_xml(body)
+        except ET.ParseError:
+            raise S3Error("MalformedXML")
+        except ValueError as e:
+            raise S3Error("InvalidArgument", str(e))
+        await self._run(self.meta.set_config, bucket, bm.LIFECYCLE,
+                        body.decode())
+        return web.Response(status=200)
+
+    async def delete_bucket_lifecycle(self, request: web.Request
+                                      ) -> web.Response:
+        bucket = self._bucket(request)
+        await self._auth(request, None, "s3:PutLifecycleConfiguration", bucket)
+        await self._run(self.meta.delete_config, bucket, bm.LIFECYCLE)
+        return web.Response(status=204)
+
+    # ---------------------------------------------------------- tagging
+    async def get_bucket_tagging(self, request: web.Request) -> web.Response:
+        bucket = self._bucket(request)
+        await self._auth(request, None, "s3:GetBucketTagging", bucket)
+        raw = await self._run(self.meta.get_config, bucket, bm.TAGGING)
+        if not raw:
+            raise S3Error("NoSuchTagSet", resource=bucket)
+        return self._xml(200, raw)
+
+    async def put_bucket_tagging(self, request: web.Request) -> web.Response:
+        body = await request.read()
+        bucket = self._bucket(request)
+        await self._auth(request, hashlib.sha256(body).hexdigest(),
+                   "s3:PutBucketTagging", bucket)
+        parse_tagging_xml(body)  # validates
+        await self._run(self.meta.set_config, bucket, bm.TAGGING,
+                        body.decode())
+        return web.Response(status=200)
+
+    async def delete_bucket_tagging(self, request: web.Request
+                                    ) -> web.Response:
+        bucket = self._bucket(request)
+        await self._auth(request, None, "s3:PutBucketTagging", bucket)
+        await self._run(self.meta.delete_config, bucket, bm.TAGGING)
+        return web.Response(status=204)
+
+    # ------------------------------------------------------- encryption
+    async def get_bucket_encryption(self, request: web.Request
+                                    ) -> web.Response:
+        bucket = self._bucket(request)
+        await self._auth(request, None, "s3:GetEncryptionConfiguration", bucket)
+        raw = await self._run(self.meta.get_config, bucket, bm.SSE_CONFIG)
+        if not raw:
+            raise S3Error("ServerSideEncryptionConfigurationNotFoundError",
+                          resource=bucket)
+        return self._xml(200, raw)
+
+    async def put_bucket_encryption(self, request: web.Request
+                                    ) -> web.Response:
+        body = await request.read()
+        bucket = self._bucket(request)
+        await self._auth(request, hashlib.sha256(body).hexdigest(),
+                   "s3:PutEncryptionConfiguration", bucket)
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise S3Error("MalformedXML")
+        algos = [e.text for e in root.iter() if e.tag.endswith("SSEAlgorithm")]
+        if not algos or any(a not in ("AES256", "aws:kms") for a in algos):
+            raise S3Error("InvalidArgument",
+                          "SSEAlgorithm must be AES256 or aws:kms")
+        await self._run(self.meta.set_config, bucket, bm.SSE_CONFIG,
+                        body.decode())
+        return web.Response(status=200)
+
+    async def delete_bucket_encryption(self, request: web.Request
+                                       ) -> web.Response:
+        bucket = self._bucket(request)
+        await self._auth(request, None, "s3:PutEncryptionConfiguration", bucket)
+        await self._run(self.meta.delete_config, bucket, bm.SSE_CONFIG)
+        return web.Response(status=204)
+
+    # ------------------------------------------------------ object lock
+    async def get_object_lock_config(self, request: web.Request
+                                     ) -> web.Response:
+        bucket = self._bucket(request)
+        await self._auth(request, None, "s3:GetBucketObjectLockConfiguration",
+                   bucket)
+        raw = await self._run(self.meta.get_config, bucket, bm.OBJECT_LOCK)
+        if not raw:
+            raise S3Error("ObjectLockConfigurationNotFoundError",
+                          resource=bucket)
+        return self._xml(200, raw)
+
+    async def put_object_lock_config(self, request: web.Request
+                                     ) -> web.Response:
+        body = await request.read()
+        bucket = self._bucket(request)
+        await self._auth(request, hashlib.sha256(body).hexdigest(),
+                   "s3:PutBucketObjectLockConfiguration", bucket)
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise S3Error("MalformedXML")
+        enabled = any(e.tag.endswith("ObjectLockEnabled")
+                      and (e.text or "") == "Enabled" for e in root.iter())
+        if not enabled:
+            raise S3Error("MalformedXML", "ObjectLockEnabled must be Enabled")
+        # object lock requires versioning (S3 invariant)
+        if not await self._versioned(bucket):
+            setter = getattr(self.api, "set_versioning", None)
+            if setter is not None:
+                await self._run(setter, bucket, True)
+        await self._run(self.meta.set_config, bucket, bm.OBJECT_LOCK,
+                        body.decode())
+        return web.Response(status=200)
+
+    # ----------------------------------------------------- notification
+    async def get_bucket_notification(self, request: web.Request
+                                      ) -> web.Response:
+        bucket = self._bucket(request)
+        await self._auth(request, None, "s3:GetBucketNotification", bucket)
+        raw = await self._run(self.meta.get_config, bucket, bm.NOTIFICATION)
+        if not raw:
+            return self._xml(200, (
+                f'<?xml version="1.0" encoding="UTF-8"?>'
+                f'<NotificationConfiguration xmlns="{XMLNS}">'
+                f"</NotificationConfiguration>"
+            ))
+        return self._xml(200, raw)
+
+    async def put_bucket_notification(self, request: web.Request
+                                      ) -> web.Response:
+        body = await request.read()
+        bucket = self._bucket(request)
+        await self._auth(request, hashlib.sha256(body).hexdigest(),
+                   "s3:PutBucketNotification", bucket)
+        try:
+            cfg = NotificationConfig.from_xml(body)
+        except ET.ParseError:
+            raise S3Error("MalformedXML")
+        notifier = getattr(self, "notifier", None)
+        if notifier is not None:
+            missing = cfg.validate(notifier.target_ids())
+            if missing:
+                raise S3Error("InvalidArgument",
+                              f"unknown notification target ARN {missing[0]}")
+        await self._run(self.meta.set_config, bucket, bm.NOTIFICATION,
+                        body.decode())
+        return web.Response(status=200)
+
+    # ------------------------------------------------------ replication
+    async def get_bucket_replication(self, request: web.Request
+                                     ) -> web.Response:
+        bucket = self._bucket(request)
+        await self._auth(request, None, "s3:GetReplicationConfiguration", bucket)
+        raw = await self._run(self.meta.get_config, bucket, bm.REPLICATION)
+        if not raw:
+            raise S3Error("ReplicationConfigurationNotFoundError",
+                          resource=bucket)
+        return self._xml(200, raw)
+
+    async def put_bucket_replication(self, request: web.Request
+                                     ) -> web.Response:
+        body = await request.read()
+        bucket = self._bucket(request)
+        await self._auth(request, hashlib.sha256(body).hexdigest(),
+                   "s3:PutReplicationConfiguration", bucket)
+        try:
+            ReplicationConfig.from_xml(body)
+        except ET.ParseError:
+            raise S3Error("MalformedXML")
+        except ValueError as e:
+            raise S3Error("InvalidArgument", str(e))
+        if not await self._versioned(bucket):
+            raise S3Error("InvalidRequest",
+                          "replication requires bucket versioning")
+        await self._run(self.meta.set_config, bucket, bm.REPLICATION,
+                        body.decode())
+        return web.Response(status=200)
+
+    async def delete_bucket_replication(self, request: web.Request
+                                        ) -> web.Response:
+        bucket = self._bucket(request)
+        await self._auth(request, None, "s3:PutReplicationConfiguration", bucket)
+        await self._run(self.meta.delete_config, bucket, bm.REPLICATION)
+        return web.Response(status=204)
+
+    # ------------------------------------------------------------ quota
+    # (MinIO sets quota via admin API; kept here with the bucket configs)
+    async def get_bucket_quota(self, request: web.Request) -> web.Response:
+        bucket = self._bucket(request)
+        await self._auth(request, None, "admin:GetBucketQuota", bucket)
+        q = await self._run(self.meta.get_config, bucket, bm.QUOTA)
+        return web.json_response(q or {"quota": 0, "quotatype": "hard"})
+
+    async def put_bucket_quota(self, request: web.Request) -> web.Response:
+        body = await request.read()
+        bucket = self._bucket(request)
+        await self._auth(request, hashlib.sha256(body).hexdigest(),
+                   "admin:SetBucketQuota", bucket)
+        try:
+            q = json.loads(body)
+            int(q.get("quota", 0))
+        except (ValueError, AttributeError):
+            raise S3Error("InvalidArgument", "malformed quota json")
+        await self._run(self.meta.set_config, bucket, bm.QUOTA, q)
+        return web.Response(status=200)
+
+    # -------------------------------------------------------- acl / cors
+    async def get_bucket_acl(self, request: web.Request) -> web.Response:
+        bucket = self._bucket(request)
+        await self._auth(request, None, "s3:GetBucketAcl", bucket)
+        if not await self._run(self.api.bucket_exists, bucket):
+            raise S3Error("NoSuchBucket", resource=bucket)
+        return self._xml(200, (
+            f'<?xml version="1.0" encoding="UTF-8"?>'
+            f'<AccessControlPolicy xmlns="{XMLNS}">'
+            f"<Owner><ID>minio-tpu</ID></Owner>"
+            f"<AccessControlList><Grant>"
+            f'<Grantee xmlns:xsi="http://www.w3.org/2001/XMLSchema-instance"'
+            f' xsi:type="CanonicalUser"><ID>minio-tpu</ID></Grantee>'
+            f"<Permission>FULL_CONTROL</Permission>"
+            f"</Grant></AccessControlList></AccessControlPolicy>"
+        ))
+
+    async def put_bucket_acl(self, request: web.Request) -> web.Response:
+        # only the private canned ACL is supported (MinIO behaviour)
+        body = await request.read()
+        bucket = self._bucket(request)
+        await self._auth(request, hashlib.sha256(body).hexdigest(),
+                   "s3:PutBucketAcl", bucket)
+        acl = request.headers.get("x-amz-acl", "private")
+        if acl != "private":
+            raise S3Error("NotImplemented", "only private ACL supported")
+        return web.Response(status=200)
+
+    async def get_bucket_cors(self, request: web.Request) -> web.Response:
+        bucket = self._bucket(request)
+        await self._auth(request, None, "s3:GetBucketCORS", bucket)
+        if not await self._run(self.api.bucket_exists, bucket):
+            raise S3Error("NoSuchBucket", resource=bucket)
+        raise S3Error("NoSuchCORSConfiguration", resource=bucket)
+
+
+def parse_tagging_xml(body: bytes) -> dict[str, str]:
+    """Parse a <Tagging> document into a tag dict; raises S3Error on
+    malformed/invalid input (reference internal/bucket/object/tags)."""
+    try:
+        root = ET.fromstring(body)
+    except ET.ParseError:
+        raise S3Error("MalformedXML")
+    tags: dict[str, str] = {}
+    for tag_el in root.iter():
+        if not tag_el.tag.endswith("Tag"):
+            continue
+        k = v = None
+        for c in tag_el:
+            if c.tag.endswith("Key"):
+                k = c.text or ""
+            elif c.tag.endswith("Value"):
+                v = c.text or ""
+        if k is None:
+            raise S3Error("InvalidTag", "tag without key")
+        if len(k) > 128 or len(v or "") > 256:
+            raise S3Error("InvalidTag", "tag too long")
+        if k in tags:
+            raise S3Error("InvalidTag", f"duplicate tag key {k}")
+        tags[k] = v or ""
+    if len(tags) > 50:
+        raise S3Error("InvalidTag", "too many tags")
+    return tags
+
+
+def tagging_to_xml(tags: dict[str, str]) -> str:
+    from xml.sax.saxutils import escape
+
+    inner = "".join(
+        f"<Tag><Key>{escape(k)}</Key><Value>{escape(v)}</Value></Tag>"
+        for k, v in tags.items()
+    )
+    return (
+        f'<?xml version="1.0" encoding="UTF-8"?>'
+        f'<Tagging xmlns="{XMLNS}"><TagSet>{inner}</TagSet></Tagging>'
+    )

@@ -43,6 +43,23 @@ S3_ERRORS = {
     "AuthorizationQueryParametersError": (400, "Error parsing the X-Amz-Credential parameter."),
     "NotModified": (304, ""),
     "QuorumError": (503, "Storage resources are insufficient for the operation."),
+    # bucket configuration sub-resources (cmd/api-errors.go)
+    "NoSuchBucketPolicy": (404, "The bucket policy does not exist."),
+    "MalformedPolicy": (400, "Policy has invalid resource."),
+    "PolicyTooLarge": (400, "Policy exceeds the maximum allowed document size."),
+    "NoSuchLifecycleConfiguration": (404, "The lifecycle configuration does not exist."),
+    "NoSuchTagSet": (404, "The TagSet does not exist."),
+    "InvalidTag": (400, "The tag provided was not a valid tag."),
+    "ServerSideEncryptionConfigurationNotFoundError": (404, "The server side encryption configuration was not found."),
+    "ObjectLockConfigurationNotFoundError": (404, "Object Lock configuration does not exist for this bucket."),
+    "ReplicationConfigurationNotFoundError": (404, "The replication configuration was not found."),
+    "NoSuchCORSConfiguration": (404, "The CORS configuration does not exist."),
+    "ObjectLocked": (403, "Object is WORM protected and cannot be overwritten or deleted."),
+    "NoSuchObjectLockConfiguration": (404, "The specified object does not have an ObjectLock configuration."),
+    "BucketQuotaExceeded": (409, "Bucket quota exceeded."),
+    "RestoreAlreadyInProgress": (409, "Object restore is already in progress."),
+    "InvalidObjectState": (403, "The operation is not valid for the current state of the object."),
+    "SelectParseError": (400, "The SQL expression contains an error."),
 }
 
 

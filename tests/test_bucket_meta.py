@@ -1,0 +1,326 @@
+"""Bucket metadata subsystems: policy (incl. anonymous access),
+lifecycle, tagging, encryption config, object-lock, notification,
+replication config, quota (reference cmd/bucket-*-handlers.go,
+cmd/bucket-metadata-sys.go, internal/bucket/*)."""
+
+import json
+
+import pytest
+
+from .s3_harness import S3TestServer
+
+
+@pytest.fixture(scope="module")
+def srv(tmp_path_factory):
+    s = S3TestServer(str(tmp_path_factory.mktemp("drives")))
+    yield s
+    s.close()
+
+
+def _q(qs):
+    return [tuple(p.partition("=")[::2]) for p in qs.split("&")]
+
+
+class TestBucketPolicy:
+    def test_policy_crud(self, srv):
+        srv.request("PUT", "/polb")
+        r = srv.request("GET", "/polb", query=_q("policy"))
+        assert r.status == 404 and "NoSuchBucketPolicy" in r.text()
+        pol = json.dumps({
+            "Version": "2012-10-17",
+            "Statement": [{
+                "Effect": "Allow", "Principal": {"AWS": ["*"]},
+                "Action": ["s3:GetObject"],
+                "Resource": ["arn:aws:s3:::polb/*"],
+            }],
+        }).encode()
+        assert srv.request("PUT", "/polb", query=_q("policy"),
+                           data=pol).status == 204
+        r = srv.request("GET", "/polb", query=_q("policy"))
+        assert r.status == 200
+        assert json.loads(r.text())["Statement"]
+        assert srv.request("DELETE", "/polb",
+                           query=_q("policy")).status == 204
+        assert srv.request("GET", "/polb", query=_q("policy")).status == 404
+
+    def test_policy_must_scope_to_bucket(self, srv):
+        srv.request("PUT", "/polscope")
+        pol = json.dumps({
+            "Statement": [{
+                "Effect": "Allow", "Principal": "*",
+                "Action": "s3:GetObject",
+                "Resource": "arn:aws:s3:::otherbucket/*",
+            }],
+        }).encode()
+        r = srv.request("PUT", "/polscope", query=_q("policy"), data=pol)
+        assert r.status == 400 and "MalformedPolicy" in r.text()
+
+    def test_anonymous_download_via_policy(self, srv):
+        srv.request("PUT", "/pubb")
+        srv.request("PUT", "/pubb/file.txt", data=b"public data")
+        # anonymous denied before policy exists
+        r = srv.raw_request("GET", "/pubb/file.txt",
+                            headers={"host": srv.host})
+        assert r.status == 403
+        pol = json.dumps({
+            "Statement": [{
+                "Effect": "Allow", "Principal": {"AWS": ["*"]},
+                "Action": ["s3:GetObject"],
+                "Resource": ["arn:aws:s3:::pubb/*"],
+            }],
+        }).encode()
+        srv.request("PUT", "/pubb", query=_q("policy"), data=pol)
+        r = srv.raw_request("GET", "/pubb/file.txt",
+                            headers={"host": srv.host})
+        assert r.status == 200 and r.body == b"public data"
+        # write still denied for anonymous
+        r = srv.raw_request("PUT", "/pubb/new.txt", data=b"x",
+                            headers={"host": srv.host})
+        assert r.status == 403
+
+
+class TestPolicyLayering:
+    def test_iam_deny_beats_bucket_policy_allow(self, srv):
+        srv.request("PUT", "/dwb")
+        srv.request("PUT", "/dwb/o.txt", data=b"data")
+        pol = json.dumps({
+            "Statement": [{
+                "Effect": "Allow", "Principal": {"AWS": ["*"]},
+                "Action": ["s3:GetObject"],
+                "Resource": ["arn:aws:s3:::dwb/*"],
+            }],
+        }).encode()
+        srv.request("PUT", "/dwb", query=_q("policy"), data=pol)
+        # user with an explicit IAM Deny on GetObject for this bucket
+        srv.iam.add_user("denied-u", "denied-secret-key")
+        srv.iam.set_policy("deny-dwb", json.dumps({
+            "Statement": [
+                {"Effect": "Allow", "Action": ["s3:*"], "Resource": ["*"]},
+                {"Effect": "Deny", "Action": ["s3:GetObject"],
+                 "Resource": ["arn:aws:s3:::dwb/*"]},
+            ],
+        }))
+        srv.iam.attach_policy("denied-u", ["deny-dwb"])
+        srv.server.meta.invalidate("dwb")
+        r = srv.request("GET", "/dwb/o.txt",
+                        creds=("denied-u", "denied-secret-key"))
+        assert r.status == 403, (
+            "bucket-policy allow must not override IAM explicit deny")
+        # anonymous still allowed by the bucket policy
+        r = srv.raw_request("GET", "/dwb/o.txt", headers={"host": srv.host})
+        assert r.status == 200
+
+    def test_subresource_never_falls_through(self, srv):
+        srv.request("PUT", "/safeb")
+        # DELETE ?cors must NOT delete the bucket (real S3 DeleteBucketCors)
+        r = srv.request("DELETE", "/safeb", query=_q("cors"))
+        assert r.status == 501
+        assert srv.request("HEAD", "/safeb").status == 200
+        # PUT ?website must NOT create/replace the bucket
+        r = srv.request("PUT", "/safeb", query=_q("website"), data=b"<x/>")
+        assert r.status == 501
+
+
+class TestLifecycleConfig:
+    LC = (b'<LifecycleConfiguration><Rule><ID>r1</ID>'
+          b'<Status>Enabled</Status><Filter><Prefix>logs/</Prefix></Filter>'
+          b'<Expiration><Days>30</Days></Expiration></Rule>'
+          b'</LifecycleConfiguration>')
+
+    def test_lifecycle_crud(self, srv):
+        srv.request("PUT", "/lcb")
+        r = srv.request("GET", "/lcb", query=_q("lifecycle"))
+        assert r.status == 404
+        assert srv.request("PUT", "/lcb", query=_q("lifecycle"),
+                           data=self.LC).status == 200
+        r = srv.request("GET", "/lcb", query=_q("lifecycle"))
+        assert r.status == 200 and "<Days>30</Days>" in r.text()
+        assert srv.request("DELETE", "/lcb",
+                           query=_q("lifecycle")).status == 204
+
+    def test_malformed_lifecycle_rejected(self, srv):
+        srv.request("PUT", "/lcbad")
+        r = srv.request("PUT", "/lcbad", query=_q("lifecycle"),
+                        data=b"<not-xml")
+        assert r.status == 400
+        r = srv.request("PUT", "/lcbad", query=_q("lifecycle"),
+                        data=b"<LifecycleConfiguration>"
+                             b"</LifecycleConfiguration>")
+        assert r.status == 400
+
+
+class TestTaggingConfig:
+    TAGS = (b'<Tagging><TagSet><Tag><Key>env</Key><Value>prod</Value></Tag>'
+            b'</TagSet></Tagging>')
+
+    def test_tagging_crud(self, srv):
+        srv.request("PUT", "/tagb")
+        assert srv.request("GET", "/tagb",
+                           query=_q("tagging")).status == 404
+        assert srv.request("PUT", "/tagb", query=_q("tagging"),
+                           data=self.TAGS).status == 200
+        r = srv.request("GET", "/tagb", query=_q("tagging"))
+        assert "<Key>env</Key>" in r.text()
+        assert srv.request("DELETE", "/tagb",
+                           query=_q("tagging")).status == 204
+
+
+class TestEncryptionConfig:
+    SSE = (b'<ServerSideEncryptionConfiguration><Rule>'
+           b'<ApplyServerSideEncryptionByDefault>'
+           b'<SSEAlgorithm>AES256</SSEAlgorithm>'
+           b'</ApplyServerSideEncryptionByDefault></Rule>'
+           b'</ServerSideEncryptionConfiguration>')
+
+    def test_encryption_crud(self, srv):
+        srv.request("PUT", "/sseb")
+        assert srv.request("GET", "/sseb",
+                           query=_q("encryption")).status == 404
+        assert srv.request("PUT", "/sseb", query=_q("encryption"),
+                           data=self.SSE).status == 200
+        assert "AES256" in srv.request("GET", "/sseb",
+                                       query=_q("encryption")).text()
+        assert srv.request("DELETE", "/sseb",
+                           query=_q("encryption")).status == 204
+
+    def test_bad_algo_rejected(self, srv):
+        srv.request("PUT", "/ssebad")
+        bad = self.SSE.replace(b"AES256", b"ROT13")
+        r = srv.request("PUT", "/ssebad", query=_q("encryption"), data=bad)
+        assert r.status == 400
+
+
+class TestObjectLockConfig:
+    OL = (b'<ObjectLockConfiguration>'
+          b'<ObjectLockEnabled>Enabled</ObjectLockEnabled>'
+          b'</ObjectLockConfiguration>')
+
+    def test_object_lock_crud(self, srv):
+        srv.request("PUT", "/olb")
+        r = srv.request("GET", "/olb", query=_q("object-lock"))
+        assert r.status == 404
+        assert srv.request("PUT", "/olb", query=_q("object-lock"),
+                           data=self.OL).status == 200
+        r = srv.request("GET", "/olb", query=_q("object-lock"))
+        assert "Enabled" in r.text()
+        # object lock forces versioning on
+        r = srv.request("GET", "/olb", query=_q("versioning"))
+        assert "<Status>Enabled</Status>" in r.text()
+
+
+class TestNotificationConfig:
+    NC = (b'<NotificationConfiguration><QueueConfiguration>'
+          b'<Id>1</Id><Queue>arn:minio:sqs:us-east-1:1:webhook</Queue>'
+          b'<Event>s3:ObjectCreated:*</Event>'
+          b'</QueueConfiguration></NotificationConfiguration>')
+
+    def test_notification_roundtrip(self, srv):
+        srv.request("PUT", "/ntfb")
+        # empty config returned when unset
+        r = srv.request("GET", "/ntfb", query=_q("notification"))
+        assert r.status == 200
+        assert srv.request("PUT", "/ntfb", query=_q("notification"),
+                           data=self.NC).status == 200
+        r = srv.request("GET", "/ntfb", query=_q("notification"))
+        assert "webhook" in r.text()
+
+
+class TestReplicationConfig:
+    RC = (b'<ReplicationConfiguration><Rule><ID>r</ID>'
+          b'<Status>Enabled</Status><Priority>1</Priority>'
+          b'<Destination><Bucket>arn:aws:s3:::dstb</Bucket></Destination>'
+          b'</Rule></ReplicationConfiguration>')
+
+    def test_replication_requires_versioning(self, srv):
+        srv.request("PUT", "/replb")
+        r = srv.request("PUT", "/replb", query=_q("replication"),
+                        data=self.RC)
+        assert r.status == 400
+        vc = (b'<VersioningConfiguration><Status>Enabled</Status>'
+              b'</VersioningConfiguration>')
+        srv.request("PUT", "/replb", query=_q("versioning"), data=vc)
+        assert srv.request("PUT", "/replb", query=_q("replication"),
+                           data=self.RC).status == 200
+        r = srv.request("GET", "/replb", query=_q("replication"))
+        assert "dstb" in r.text()
+
+
+class TestQuotaAndAcl:
+    def test_quota_roundtrip(self, srv):
+        srv.request("PUT", "/quotab")
+        body = json.dumps({"quota": 1048576, "quotatype": "hard"}).encode()
+        assert srv.request("PUT", "/quotab", query=_q("quota"),
+                           data=body).status == 200
+        r = srv.request("GET", "/quotab", query=_q("quota"))
+        assert json.loads(r.text())["quota"] == 1048576
+
+    def test_acl_static(self, srv):
+        srv.request("PUT", "/aclb")
+        r = srv.request("GET", "/aclb", query=_q("acl"))
+        assert r.status == 200 and "FULL_CONTROL" in r.text()
+        r = srv.request("GET", "/aclb", query=_q("cors"))
+        assert r.status == 404
+
+
+class TestLifecycleEvaluation:
+    def test_compute_action(self):
+        from minio_tpu.bucket.lifecycle import (
+            Action, Lifecycle, ObjectOpts, DAY,
+        )
+
+        lc = Lifecycle.from_xml(
+            '<LifecycleConfiguration>'
+            '<Rule><ID>exp</ID><Status>Enabled</Status>'
+            '<Filter><Prefix>logs/</Prefix></Filter>'
+            '<Expiration><Days>30</Days></Expiration></Rule>'
+            '<Rule><ID>tier</ID><Status>Enabled</Status>'
+            '<Filter><Prefix>data/</Prefix></Filter>'
+            '<Transition><Days>7</Days><StorageClass>COLD</StorageClass>'
+            '</Transition></Rule>'
+            '<Rule><ID>nc</ID><Status>Enabled</Status><Filter/>'
+            '<NoncurrentVersionExpiration><NoncurrentDays>5</NoncurrentDays>'
+            '</NoncurrentVersionExpiration></Rule>'
+            '</LifecycleConfiguration>'
+        )
+        now = 1_000_000_000.0
+        # young object in logs/ -> none
+        ev = lc.compute_action(
+            ObjectOpts("logs/a", mod_time=now - DAY), now=now)
+        assert ev.action == Action.NONE
+        # old object in logs/ -> delete
+        ev = lc.compute_action(
+            ObjectOpts("logs/a", mod_time=now - 31 * DAY), now=now)
+        assert ev.action == Action.DELETE
+        # data/ object past transition -> transition to COLD
+        ev = lc.compute_action(
+            ObjectOpts("data/a", mod_time=now - 8 * DAY), now=now)
+        assert ev.action == Action.TRANSITION and ev.tier == "COLD"
+        # already-transitioned object stays put
+        ev = lc.compute_action(
+            ObjectOpts("data/a", mod_time=now - 8 * DAY,
+                       transition_status="complete"), now=now)
+        assert ev.action == Action.NONE
+        # noncurrent version superseded 6 days ago -> delete-version
+        ev = lc.compute_action(
+            ObjectOpts("any/x", mod_time=now - 30 * DAY, is_latest=False,
+                       successor_mod_time=now - 6 * DAY), now=now)
+        assert ev.action == Action.DELETE_VERSION
+
+    def test_deletion_beats_transition(self, srv=None):
+        from minio_tpu.bucket.lifecycle import (
+            Action, Lifecycle, ObjectOpts, DAY,
+        )
+
+        lc = Lifecycle.from_xml(
+            '<LifecycleConfiguration>'
+            '<Rule><ID>t</ID><Status>Enabled</Status><Filter/>'
+            '<Transition><Days>5</Days><StorageClass>COLD</StorageClass>'
+            '</Transition></Rule>'
+            '<Rule><ID>e</ID><Status>Enabled</Status><Filter/>'
+            '<Expiration><Days>10</Days></Expiration></Rule>'
+            '</LifecycleConfiguration>'
+        )
+        now = 1_000_000_000.0
+        ev = lc.compute_action(
+            ObjectOpts("k", mod_time=now - 11 * DAY), now=now)
+        assert ev.action == Action.DELETE
