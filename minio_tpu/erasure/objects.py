@@ -610,6 +610,58 @@ class ErasureObjects:
                 raise errors.ErasureWriteQuorum("delete quorum not met")
             return ObjectInfo(bucket=bucket, name=obj, version_id=version_id)
 
+    # ------------------------------------------------------------- METADATA
+    TAGS_KEY = "x-minio-tags"  # urlencoded tag set on a version
+
+    def update_object_metadata(self, bucket: str, obj: str,
+                               updates: dict, version_id: str = ""
+                               ) -> ObjectInfo:
+        """Set (value) / remove (None) metadata keys on one version across
+        all drives under write quorum (reference PutObjectTags →
+        updateObjectMeta, cmd/erasure-object.go:1530)."""
+        with self.ns.write(f"{bucket}/{obj}"):
+            fi, fis, _ = self._quorum_info(bucket, obj, version_id)
+            if fi.deleted:
+                raise errors.MethodNotAllowed(f"{bucket}/{obj}")
+
+            def upd(i: int) -> None:
+                d = self.disks[i]
+                fi_i = fis[i]
+                if d is None or not d.is_online() or fi_i is None:
+                    raise errors.DiskNotFound(str(i))
+                for k, v in updates.items():
+                    if v is None:
+                        fi_i.metadata.pop(k, None)
+                    else:
+                        fi_i.metadata[k] = v
+                d.update_metadata(bucket, obj, fi_i)
+
+            errs = self._fan_out(upd, range(len(self.disks)))
+            _, wq = self._quorum_from(fis)
+            if sum(1 for e in errs if e is None) < wq:
+                raise errors.ErasureWriteQuorum("metadata update quorum")
+            for k, v in updates.items():
+                if v is None:
+                    fi.metadata.pop(k, None)
+                else:
+                    fi.metadata[k] = v
+            return ObjectInfo.from_file_info(fi, bucket, obj)
+
+    def put_object_tags(self, bucket: str, obj: str, tags: str,
+                        version_id: str = "") -> ObjectInfo:
+        return self.update_object_metadata(
+            bucket, obj, {self.TAGS_KEY: tags}, version_id)
+
+    def get_object_tags(self, bucket: str, obj: str,
+                        version_id: str = "") -> str:
+        oi = self.get_object_info(bucket, obj, version_id)
+        return oi.metadata.get(self.TAGS_KEY, "")
+
+    def delete_object_tags(self, bucket: str, obj: str,
+                           version_id: str = "") -> ObjectInfo:
+        return self.update_object_metadata(
+            bucket, obj, {self.TAGS_KEY: None}, version_id)
+
     # ------------------------------------------------------------------ LIST
     def list_objects(self, bucket: str, prefix: str = "") -> list[str]:
         """Union of per-drive sorted walks (metacache-lite)."""

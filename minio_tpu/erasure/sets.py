@@ -206,6 +206,22 @@ class ErasureSets:
     def heal_object(self, bucket, obj, version_id="", deep=False) -> HealResult:
         return self.get_hashed_set(obj).heal_object(bucket, obj, version_id, deep)
 
+    def update_object_metadata(self, bucket, obj, updates, version_id=""):
+        return self.get_hashed_set(obj).update_object_metadata(
+            bucket, obj, updates, version_id)
+
+    def put_object_tags(self, bucket, obj, tags, version_id=""):
+        return self.get_hashed_set(obj).put_object_tags(
+            bucket, obj, tags, version_id)
+
+    def get_object_tags(self, bucket, obj, version_id=""):
+        return self.get_hashed_set(obj).get_object_tags(
+            bucket, obj, version_id)
+
+    def delete_object_tags(self, bucket, obj, version_id=""):
+        return self.get_hashed_set(obj).delete_object_tags(
+            bucket, obj, version_id)
+
     def list_objects(self, bucket: str, prefix: str = "") -> list[str]:
         names: set[str] = set()
         any_vol = False
@@ -427,6 +443,24 @@ class ErasureServerPools:
             if not res.failed:
                 return res
         return HealResult(failed=True)
+
+    def update_object_metadata(self, bucket, obj, updates, version_id=""):
+        p = self._pool_of(bucket, obj)
+        if p is None:
+            raise errors.ObjectNotFound(f"{bucket}/{obj}")
+        return p.update_object_metadata(bucket, obj, updates, version_id)
+
+    def put_object_tags(self, bucket, obj, tags, version_id=""):
+        return self.update_object_metadata(
+            bucket, obj, {ErasureObjects.TAGS_KEY: tags}, version_id)
+
+    def get_object_tags(self, bucket, obj, version_id=""):
+        return self.get_object_info(
+            bucket, obj, version_id).metadata.get(ErasureObjects.TAGS_KEY, "")
+
+    def delete_object_tags(self, bucket, obj, version_id=""):
+        return self.update_object_metadata(
+            bucket, obj, {ErasureObjects.TAGS_KEY: None}, version_id)
 
     def list_objects(self, bucket: str, prefix: str = "") -> list[str]:
         names: set[str] = set()

@@ -18,6 +18,7 @@ import io
 import queue as queue_mod
 import re
 import secrets
+import time
 import urllib.parse
 import xml.etree.ElementTree as ET
 from datetime import datetime, timezone
@@ -30,6 +31,10 @@ from minio_tpu.storage import errors as st
 from minio_tpu.erasure.objects import PutObjectOptions
 from . import sigv4
 from .bucket_meta import BucketMetaHandlers
+from .object_extras import (
+    LOCK_HOLD_KEY, LOCK_MODE_KEY, LOCK_UNTIL_KEY, TAGS_KEY,
+    ObjectExtraHandlers, parse_tag_query,
+)
 from .s3errors import S3Error, from_storage_error
 
 XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
@@ -146,7 +151,7 @@ class _QueuePipeReader(io.RawIOBase):
         return out
 
 
-class S3Server(BucketMetaHandlers):
+class S3Server(BucketMetaHandlers, ObjectExtraHandlers):
     def __init__(self, object_layer, access_key: str = "minioadmin",
                  secret_key: str = "minioadmin", region: str = "us-east-1",
                  max_concurrency: int = 64, iam=None):
@@ -402,8 +407,12 @@ class S3Server(BucketMetaHandlers):
             return await self._handle(request, fn or self.delete_bucket)
         if m == "HEAD":
             return await self._handle(request, self.head_bucket)
-        if m == "POST" and "delete" in q:
-            return await self._handle(request, self.delete_objects)
+        if m == "POST":
+            if "delete" in q:
+                return await self._handle(request, self.delete_objects)
+            ctype = request.headers.get("Content-Type", "")
+            if ctype.startswith("multipart/form-data"):
+                return await self._handle(request, self.post_policy_upload)
         return await self._handle(request, self._method_not_allowed)
 
     async def dispatch_object(self, request: web.Request) -> web.StreamResponse:
@@ -412,16 +421,32 @@ class S3Server(BucketMetaHandlers):
         if m == "GET":
             if "uploadId" in q:
                 return await self._handle(request, self.list_parts)
+            if "tagging" in q:
+                return await self._handle(request, self.get_object_tagging)
+            if "retention" in q:
+                return await self._handle(request, self.get_object_retention)
+            if "legal-hold" in q:
+                return await self._handle(request, self.get_object_legal_hold)
+            if "acl" in q:
+                return await self._handle(request, self.get_object_acl)
             return await self._handle(request, self.get_object)
         if m == "HEAD":
             return await self._handle(request, self.head_object)
         if m == "PUT":
             if "uploadId" in q and "partNumber" in q:
                 return await self._handle(request, self.upload_part)
+            if "tagging" in q:
+                return await self._handle(request, self.put_object_tagging)
+            if "retention" in q:
+                return await self._handle(request, self.put_object_retention)
+            if "legal-hold" in q:
+                return await self._handle(request, self.put_object_legal_hold)
             return await self._handle(request, self.put_object)
         if m == "DELETE":
             if "uploadId" in q:
                 return await self._handle(request, self.abort_upload)
+            if "tagging" in q:
+                return await self._handle(request, self.delete_object_tagging)
             return await self._handle(request, self.delete_object)
         if m == "POST":
             if "uploads" in q:
@@ -694,6 +719,15 @@ class S3Server(BucketMetaHandlers):
                 )
                 continue
             try:
+                await self.enforce_retention_for_delete(
+                    request, bucket, key, vid, ctx.access_key)
+            except S3Error as s3e:
+                results.append(
+                    f"<Error><Key>{escape(key)}</Key><Code>{s3e.code}</Code>"
+                    f"<Message>{escape(s3e.message)}</Message></Error>"
+                )
+                continue
+            try:
                 await self._run(
                     self.api.delete_object, bucket, key, vid, versioned
                 )
@@ -730,6 +764,12 @@ class S3Server(BucketMetaHandlers):
         for k, v in oi.metadata.items():
             if k.startswith("x-amz-meta-"):
                 h[k] = v
+        tag_str = oi.metadata.get(TAGS_KEY, "")
+        if tag_str:
+            h["x-amz-tagging-count"] = str(len(parse_tag_query(tag_str)))
+        for lk in (LOCK_MODE_KEY, LOCK_UNTIL_KEY, LOCK_HOLD_KEY):
+            if oi.metadata.get(lk):
+                h[lk] = oi.metadata[lk]
         return h
 
     async def put_object(self, request: web.Request) -> web.Response:
@@ -749,12 +789,42 @@ class S3Server(BucketMetaHandlers):
         real_size = int(decoded_len) if streaming and decoded_len else (
             size if size is not None else -1
         )
+        user_meta = {
+            k.lower(): v for k, v in request.headers.items()
+            if k.lower().startswith("x-amz-meta-")
+        }
+        tag_hdr = request.headers.get("x-amz-tagging", "")
+        if tag_hdr:
+            parse_tag_query(tag_hdr)  # validates
+            user_meta[TAGS_KEY] = tag_hdr
+        if any(request.headers.get(lk)
+               for lk in (LOCK_MODE_KEY, LOCK_UNTIL_KEY, LOCK_HOLD_KEY)):
+            if not await self._run(self.meta.object_lock_enabled, bucket):
+                raise S3Error("InvalidRequest",
+                              "bucket is not object-lock enabled")
+            mode = request.headers.get(LOCK_MODE_KEY, "")
+            until = request.headers.get(LOCK_UNTIL_KEY, "")
+            hold = request.headers.get(LOCK_HOLD_KEY, "")
+            if bool(mode) != bool(until):
+                raise S3Error("InvalidArgument",
+                              "lock mode and retain-until must both be set")
+            if mode:
+                if mode not in ("GOVERNANCE", "COMPLIANCE"):
+                    raise S3Error("InvalidArgument", "bad object-lock mode")
+                from .object_extras import _parse_amz_date
+
+                if _parse_amz_date(until) <= time.time():
+                    raise S3Error("InvalidArgument",
+                                  "retain-until date must be in the future")
+                user_meta[LOCK_MODE_KEY] = mode
+                user_meta[LOCK_UNTIL_KEY] = until
+            if hold:
+                if hold not in ("ON", "OFF"):
+                    raise S3Error("InvalidArgument", "bad legal-hold status")
+                user_meta[LOCK_HOLD_KEY] = hold
         opts = PutObjectOptions(
             content_type=request.headers.get("Content-Type", ""),
-            user_metadata={
-                k.lower(): v for k, v in request.headers.items()
-                if k.lower().startswith("x-amz-meta-")
-            },
+            user_metadata=user_meta,
             versioned=await self._versioned(bucket),
         )
 
@@ -871,6 +941,7 @@ class S3Server(BucketMetaHandlers):
         await self._auth(request, None, "s3:GetObject", bucket, key)
         vid = request.rel_url.query.get("versionId", "")
         oi = await self._run(self.api.get_object_info, bucket, key, vid)
+        self.check_preconditions(request, oi)
 
         status = 200
         offset, length = 0, oi.size
@@ -905,15 +976,18 @@ class S3Server(BucketMetaHandlers):
         await self._auth(request, None, "s3:GetObject", bucket, key)
         vid = request.rel_url.query.get("versionId", "")
         oi = await self._run(self.api.get_object_info, bucket, key, vid)
+        self.check_preconditions(request, oi)
         headers = self._obj_headers(oi)
         headers["Content-Length"] = str(oi.size)
         return web.Response(status=200, headers=headers)
 
     async def delete_object(self, request: web.Request) -> web.Response:
         bucket, key = self._object(request)
-        await self._auth(request, None, "s3:DeleteObject", bucket, key)
+        ctx = await self._auth(request, None, "s3:DeleteObject", bucket, key)
         vid = request.rel_url.query.get("versionId", "")
         versioned = await self._versioned(bucket)
+        await self.enforce_retention_for_delete(request, bucket, key, vid,
+                                                ctx.access_key)
         oi = await self._run(
             self.api.delete_object, bucket, key, vid, versioned
         )

@@ -1,0 +1,429 @@
+"""Object sub-resource handlers and request conditions: tagging,
+object-lock retention / legal hold, HTTP preconditions, and browser POST
+policy uploads.
+
+Reference: cmd/object-handlers.go (PutObjectTaggingHandler :3178,
+GetObjectRetentionHandler, PutObjectLegalHoldHandler), cmd/object-lock
+enforcement in deletes (enforceRetentionForDeletion,
+cmd/admin-bucket-handlers), checkPreconditions (cmd/object-handlers-
+common.go:67), and PostPolicyBucketHandler (cmd/bucket-handlers.go:899,
+cmd/postpolicyform.go).
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import hashlib
+import io
+import json
+import time
+import urllib.parse
+import xml.etree.ElementTree as ET
+from datetime import datetime, timezone
+
+from aiohttp import web
+
+from minio_tpu.erasure.objects import PutObjectOptions
+
+from . import sigv4
+from .bucket_meta import parse_tagging_xml, tagging_to_xml
+from .s3errors import S3Error
+
+from minio_tpu.erasure.objects import ErasureObjects
+
+XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
+TAGS_KEY = ErasureObjects.TAGS_KEY
+LOCK_MODE_KEY = "x-amz-object-lock-mode"
+LOCK_UNTIL_KEY = "x-amz-object-lock-retain-until-date"
+LOCK_HOLD_KEY = "x-amz-object-lock-legal-hold"
+
+
+def parse_tag_query(s: str) -> dict[str, str]:
+    """'k=v&k2=v2' header/tag-string form (x-amz-tagging)."""
+    tags: dict[str, str] = {}
+    if not s:
+        return tags
+    for k, v in urllib.parse.parse_qsl(s, keep_blank_values=True):
+        if len(k) > 128 or len(v) > 256 or k in tags:
+            raise S3Error("InvalidTag")
+        tags[k] = v
+    if len(tags) > 50:
+        raise S3Error("InvalidTag", "too many tags")
+    return tags
+
+
+def _parse_amz_date(s: str) -> float:
+    for fmt in ("%Y-%m-%dT%H:%M:%SZ", "%Y-%m-%dT%H:%M:%S.%fZ"):
+        try:
+            return datetime.strptime(s, fmt).replace(
+                tzinfo=timezone.utc).timestamp()
+        except ValueError:
+            continue
+    raise S3Error("InvalidArgument", f"bad date {s}")
+
+
+def _http_date_parse(s: str) -> float | None:
+    try:
+        return datetime.strptime(
+            s, "%a, %d %b %Y %H:%M:%S GMT").replace(
+            tzinfo=timezone.utc).timestamp()
+    except ValueError:
+        return None
+
+
+class ObjectExtraHandlers:
+    """Mixin for S3Server: tagging / retention / legal-hold / post-policy."""
+
+    # ------------------------------------------------------ preconditions
+    @staticmethod
+    def check_preconditions(request: web.Request, oi) -> None:
+        """RFC 7232 as S3 applies it to GET/HEAD (reference
+        checkPreconditions, cmd/object-handlers-common.go:67)."""
+        etag = oi.etag
+        inm = request.headers.get("If-None-Match")
+        if inm is not None:
+            tags = [t.strip().strip('"') for t in inm.split(",")]
+            if "*" in tags or etag in tags:
+                raise S3Error("NotModified", resource=request.path)
+        im = request.headers.get("If-Match")
+        if im is not None:
+            tags = [t.strip().strip('"') for t in im.split(",")]
+            if "*" not in tags and etag not in tags:
+                raise S3Error("PreconditionFailed", resource=request.path)
+        ims = request.headers.get("If-Modified-Since")
+        if ims is not None and inm is None:
+            t = _http_date_parse(ims)
+            if t is not None and oi.mod_time <= t + 1:
+                raise S3Error("NotModified", resource=request.path)
+        ius = request.headers.get("If-Unmodified-Since")
+        if ius is not None and im is None:
+            t = _http_date_parse(ius)
+            if t is not None and oi.mod_time > t + 1:
+                raise S3Error("PreconditionFailed", resource=request.path)
+
+    # ----------------------------------------------------------- tagging
+    async def get_object_tagging(self, request: web.Request) -> web.Response:
+        bucket, key = self._object(request)
+        await self._auth(request, None, "s3:GetObjectTagging", bucket, key)
+        vid = request.rel_url.query.get("versionId", "")
+        tag_str = await self._run(self.api.get_object_tags, bucket, key, vid)
+        return self._xml(200, tagging_to_xml(parse_tag_query(tag_str)))
+
+    async def put_object_tagging(self, request: web.Request) -> web.Response:
+        body = await request.read()
+        bucket, key = self._object(request)
+        await self._auth(request, hashlib.sha256(body).hexdigest(),
+                         "s3:PutObjectTagging", bucket, key)
+        vid = request.rel_url.query.get("versionId", "")
+        tags = parse_tagging_xml(body)
+        tag_str = urllib.parse.urlencode(tags)
+        oi = await self._run(self.api.put_object_tags, bucket, key,
+                             tag_str, vid)
+        h = {}
+        if oi.version_id:
+            h["x-amz-version-id"] = oi.version_id
+        return web.Response(status=200, headers=h)
+
+    async def delete_object_tagging(self, request: web.Request
+                                    ) -> web.Response:
+        bucket, key = self._object(request)
+        await self._auth(request, None, "s3:DeleteObjectTagging", bucket, key)
+        vid = request.rel_url.query.get("versionId", "")
+        await self._run(self.api.delete_object_tags, bucket, key, vid)
+        return web.Response(status=204)
+
+    # --------------------------------------------------------- retention
+    async def get_object_retention(self, request: web.Request
+                                   ) -> web.Response:
+        bucket, key = self._object(request)
+        await self._auth(request, None, "s3:GetObjectRetention", bucket, key)
+        vid = request.rel_url.query.get("versionId", "")
+        oi = await self._run(self.api.get_object_info, bucket, key, vid)
+        mode = oi.metadata.get(LOCK_MODE_KEY, "")
+        until = oi.metadata.get(LOCK_UNTIL_KEY, "")
+        if not mode:
+            raise S3Error("NoSuchObjectLockConfiguration", resource=key)
+        return self._xml(200, (
+            f'<?xml version="1.0" encoding="UTF-8"?>'
+            f'<Retention xmlns="{XMLNS}"><Mode>{mode}</Mode>'
+            f"<RetainUntilDate>{until}</RetainUntilDate></Retention>"
+        ))
+
+    async def put_object_retention(self, request: web.Request
+                                   ) -> web.Response:
+        body = await request.read()
+        bucket, key = self._object(request)
+        ctx = await self._auth(request, hashlib.sha256(body).hexdigest(),
+                               "s3:PutObjectRetention", bucket, key)
+        if not await self._run(self.meta.object_lock_enabled, bucket):
+            raise S3Error("InvalidRequest",
+                          "bucket is not object-lock enabled")
+        vid = request.rel_url.query.get("versionId", "")
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise S3Error("MalformedXML")
+        mode = until = ""
+        for e in root.iter():
+            if e.tag.endswith("Mode"):
+                mode = e.text or ""
+            elif e.tag.endswith("RetainUntilDate"):
+                until = e.text or ""
+        if mode not in ("GOVERNANCE", "COMPLIANCE") or not until:
+            raise S3Error("MalformedXML", "bad retention mode/date")
+        _parse_amz_date(until)  # validates
+        # tightening is always allowed; weakening COMPLIANCE never is, and
+        # weakening GOVERNANCE needs the bypass header AND the
+        # s3:BypassGovernanceRetention permission (both, like the
+        # reference's objectlock enforcement)
+        oi = await self._run(self.api.get_object_info, bucket, key, vid)
+        old_mode = oi.metadata.get(LOCK_MODE_KEY, "")
+        old_until = oi.metadata.get(LOCK_UNTIL_KEY, "")
+        if old_mode == "COMPLIANCE" and old_until:
+            if (_parse_amz_date(until) < _parse_amz_date(old_until)
+                    or mode != "COMPLIANCE"):
+                raise S3Error("AccessDenied",
+                              "cannot weaken COMPLIANCE retention")
+        if old_mode == "GOVERNANCE" and old_until:
+            weakening = (_parse_amz_date(until) < _parse_amz_date(old_until)
+                         or mode != old_mode)
+            bypass_ok = (
+                request.headers.get("x-amz-bypass-governance-retention",
+                                    "").lower() == "true"
+                and self.iam.is_allowed(
+                    ctx.access_key, "s3:BypassGovernanceRetention",
+                    bucket, key)
+            )
+            if weakening and not bypass_ok:
+                raise S3Error("AccessDenied",
+                              "governance retention in effect")
+        await self._run(self.api.update_object_metadata, bucket, key,
+                        {LOCK_MODE_KEY: mode, LOCK_UNTIL_KEY: until}, vid)
+        return web.Response(status=200)
+
+    async def get_object_legal_hold(self, request: web.Request
+                                    ) -> web.Response:
+        bucket, key = self._object(request)
+        await self._auth(request, None, "s3:GetObjectLegalHold", bucket, key)
+        vid = request.rel_url.query.get("versionId", "")
+        oi = await self._run(self.api.get_object_info, bucket, key, vid)
+        hold = oi.metadata.get(LOCK_HOLD_KEY, "")
+        if not hold:
+            raise S3Error("NoSuchObjectLockConfiguration", resource=key)
+        return self._xml(200, (
+            f'<?xml version="1.0" encoding="UTF-8"?>'
+            f'<LegalHold xmlns="{XMLNS}"><Status>{hold}</Status></LegalHold>'
+        ))
+
+    async def put_object_legal_hold(self, request: web.Request
+                                    ) -> web.Response:
+        body = await request.read()
+        bucket, key = self._object(request)
+        await self._auth(request, hashlib.sha256(body).hexdigest(),
+                         "s3:PutObjectLegalHold", bucket, key)
+        if not await self._run(self.meta.object_lock_enabled, bucket):
+            raise S3Error("InvalidRequest",
+                          "bucket is not object-lock enabled")
+        vid = request.rel_url.query.get("versionId", "")
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise S3Error("MalformedXML")
+        status = ""
+        for e in root.iter():
+            if e.tag.endswith("Status"):
+                status = e.text or ""
+        if status not in ("ON", "OFF"):
+            raise S3Error("MalformedXML", "legal hold must be ON or OFF")
+        await self._run(self.api.update_object_metadata, bucket, key,
+                        {LOCK_HOLD_KEY: status}, vid)
+        return web.Response(status=200)
+
+    # ------------------------------------------------- delete enforcement
+    async def enforce_retention_for_delete(self, request: web.Request,
+                                           bucket: str, key: str,
+                                           version_id: str,
+                                           access_key: str) -> None:
+        """Deleting a SPECIFIC version under retention/legal-hold is
+        blocked; creating a delete marker is always allowed (reference
+        enforceRetentionForDeletion, cmd/object-retention.go)."""
+        if not version_id:
+            return
+        from minio_tpu.storage import errors as st
+
+        try:
+            oi = await self._run(self.api.get_object_info, bucket, key,
+                                 version_id)
+        except (st.ObjectNotFound, st.VersionNotFound, st.FileNotFound,
+                st.FileVersionNotFound, st.BucketNotFound):
+            return
+        # anything else (e.g. read-quorum loss) must FAIL CLOSED: a
+        # transient outage cannot become a WORM bypass
+        if oi.metadata.get(LOCK_HOLD_KEY) == "ON":
+            raise S3Error("ObjectLocked", resource=key)
+        mode = oi.metadata.get(LOCK_MODE_KEY, "")
+        until = oi.metadata.get(LOCK_UNTIL_KEY, "")
+        if not mode or not until:
+            return
+        try:
+            until_t = _parse_amz_date(until)
+        except S3Error:
+            # unparseable stored date: fail closed, never unlock
+            raise S3Error("ObjectLocked", resource=key)
+        if until_t <= time.time():
+            return
+        if mode == "COMPLIANCE":
+            raise S3Error("ObjectLocked", resource=key)
+        # GOVERNANCE: bypass with header + permission
+        if (request.headers.get("x-amz-bypass-governance-retention",
+                                "").lower() == "true"
+                and self.iam.is_allowed(
+                    access_key, "s3:BypassGovernanceRetention", bucket, key)):
+            return
+        raise S3Error("ObjectLocked", resource=key)
+
+    # -------------------------------------------------------- POST policy
+    async def post_policy_upload(self, request: web.Request) -> web.Response:
+        """Browser form upload (POST with multipart/form-data + signed
+        policy document; reference PostPolicyBucketHandler,
+        cmd/bucket-handlers.go:899 + cmd/postpolicyform.go)."""
+        bucket = self._bucket(request)
+        form: dict[str, str] = {}
+        file_data = b""
+        file_name = ""
+        reader = await request.multipart()
+        while True:
+            part = await reader.next()
+            if part is None:
+                break
+            name = (part.name or "").lower()
+            if name == "file":
+                file_name = part.filename or ""
+                file_data = bytes(await part.read(decode=False))
+                break  # fields after `file` are ignored, per S3
+            form[name] = (await part.text())
+
+        policy_b64 = form.get("policy", "")
+        if not policy_b64:
+            raise S3Error("InvalidArgument", "missing policy")
+        try:
+            policy_doc = json.loads(base64.b64decode(policy_b64))
+        except (binascii.Error, ValueError):
+            raise S3Error("MalformedPOSTRequest", "bad policy encoding")
+
+        # --- signature over the raw base64 policy (SigV4)
+        cred = form.get("x-amz-credential", "")
+        amz_date = form.get("x-amz-date", "")
+        signature = form.get("x-amz-signature", "")
+        algo = form.get("x-amz-algorithm", "")
+        if algo != "AWS4-HMAC-SHA256" or not cred or not signature:
+            raise S3Error("AccessDenied", "missing POST policy credentials")
+        try:
+            access_key, date_scope, region, service, terminal = \
+                cred.split("/", 4)
+        except ValueError:
+            raise S3Error("AuthorizationQueryParametersError")
+        secret = self.iam.get_secret(access_key)
+        if secret is None:
+            raise S3Error("InvalidAccessKeyId")
+        want = sigv4.sign_policy(secret, date_scope, region, service,
+                                 policy_b64)
+        if not sigv4.hmac_equal(want, signature):
+            raise S3Error("SignatureDoesNotMatch")
+
+        # --- policy condition checks
+        expiration = policy_doc.get("expiration", "")
+        if expiration:
+            if _parse_amz_date(expiration.replace(".000Z", "Z")
+                               if expiration.endswith(".000Z")
+                               else expiration) < time.time():
+                raise S3Error("AccessDenied", "policy expired")
+        key = form.get("key", "")
+        if "${filename}" in key:
+            key = key.replace("${filename}", file_name)
+        if not key:
+            raise S3Error("InvalidArgument", "missing key")
+        self._check_post_policy_conditions(
+            policy_doc.get("conditions", []), form, bucket, key,
+            len(file_data))
+
+        if not self.iam.is_allowed(access_key, "s3:PutObject", bucket, key):
+            raise S3Error("AccessDenied", "not allowed to PutObject")
+
+        opts = PutObjectOptions(
+            content_type=form.get("content-type", ""),
+            user_metadata={k: v for k, v in form.items()
+                           if k.startswith("x-amz-meta-")},
+            versioned=await self._versioned(bucket),
+        )
+        oi = await self._run(self.api.put_object, bucket, key,
+                             io.BytesIO(file_data), len(file_data), opts)
+
+        try:
+            status = int(form.get("success_action_status", "204") or 204)
+        except ValueError:
+            status = 204  # AWS ignores invalid values
+        if status not in (200, 201, 204):
+            status = 204
+        headers = {"ETag": f'"{oi.etag}"',
+                   "Location": f"/{bucket}/{key}"}
+        if oi.version_id:
+            headers["x-amz-version-id"] = oi.version_id
+        if status == 201:
+            body = (
+                f'<?xml version="1.0" encoding="UTF-8"?>'
+                f"<PostResponse><Location>/{bucket}/{key}</Location>"
+                f"<Bucket>{bucket}</Bucket><Key>{key}</Key>"
+                f'<ETag>"{oi.etag}"</ETag></PostResponse>'
+            )
+            return web.Response(status=201, body=body.encode(),
+                                content_type="application/xml",
+                                headers=headers)
+        return web.Response(status=status, headers=headers)
+
+    @staticmethod
+    def _check_post_policy_conditions(conditions, form: dict, bucket: str,
+                                      key: str, size: int) -> None:
+        """eq / starts-with / content-length-range (cmd/postpolicyform.go)."""
+        for cond in conditions:
+            if isinstance(cond, dict):
+                for k, v in cond.items():
+                    k = k.lower().lstrip("$")
+                    actual = bucket if k == "bucket" else (
+                        key if k == "key" else form.get(k, ""))
+                    if actual != str(v):
+                        raise S3Error("AccessDenied",
+                                      f"policy condition failed: {k}")
+            elif isinstance(cond, list) and len(cond) == 3:
+                op, field, val = cond[0], str(cond[1]).lstrip("$").lower(), cond[2]
+                if op == "content-length-range":
+                    lo, hi = int(cond[1]), int(cond[2])
+                    if not (lo <= size <= hi):
+                        raise S3Error("EntityTooLarge" if size > hi
+                                      else "EntityTooSmall")
+                    continue
+                actual = bucket if field == "bucket" else (
+                    key if field == "key" else form.get(field, ""))
+                if op == "eq" and actual != str(val):
+                    raise S3Error("AccessDenied",
+                                  f"policy condition failed: eq {field}")
+                if op == "starts-with" and not actual.startswith(str(val)):
+                    raise S3Error("AccessDenied",
+                                  f"policy condition failed: starts-with {field}")
+
+    # --------------------------------------------------------- object acl
+    async def get_object_acl(self, request: web.Request) -> web.Response:
+        bucket, key = self._object(request)
+        await self._auth(request, None, "s3:GetObjectAcl", bucket, key)
+        await self._run(self.api.get_object_info, bucket, key)
+        return self._xml(200, (
+            f'<?xml version="1.0" encoding="UTF-8"?>'
+            f'<AccessControlPolicy xmlns="{XMLNS}">'
+            f"<Owner><ID>minio-tpu</ID></Owner>"
+            f"<AccessControlList><Grant>"
+            f'<Grantee xmlns:xsi="http://www.w3.org/2001/XMLSchema-instance"'
+            f' xsi:type="CanonicalUser"><ID>minio-tpu</ID></Grantee>'
+            f"<Permission>FULL_CONTROL</Permission>"
+            f"</Grant></AccessControlList></AccessControlPolicy>"
+        ))

@@ -60,6 +60,7 @@ S3_ERRORS = {
     "RestoreAlreadyInProgress": (409, "Object restore is already in progress."),
     "InvalidObjectState": (403, "The operation is not valid for the current state of the object."),
     "SelectParseError": (400, "The SQL expression contains an error."),
+    "MalformedPOSTRequest": (400, "The body of your POST request is not well-formed multipart/form-data."),
 }
 
 

@@ -266,3 +266,15 @@ def verify_v4_presigned(method: str, path: str,
     if not hmac.compare_digest(want, got_sig):
         raise SigV4Error("SignatureDoesNotMatch", "signature mismatch")
     return V4Context(access_key, skey, got_sig, amz_date, scope)
+
+
+def sign_policy(secret: str, date: str, region: str, service: str,
+                policy_b64: str) -> str:
+    """POST-policy signature: HMAC chain over the raw base64 policy
+    (reference doesPolicySignatureV4Match, cmd/postpolicyform.go)."""
+    key = signing_key(secret, date, region, service)
+    return hmac.new(key, policy_b64.encode(), hashlib.sha256).hexdigest()
+
+
+def hmac_equal(a: str, b: str) -> bool:
+    return hmac.compare_digest(a, b)

@@ -1,0 +1,380 @@
+"""Object tagging, HTTP preconditions, object-lock retention/legal-hold,
+POST policy uploads (reference cmd/object-handlers.go tagging/retention
+handlers, cmd/object-handlers-common.go:67 checkPreconditions,
+cmd/bucket-handlers.go:899 PostPolicyBucketHandler)."""
+
+import base64
+import json
+import time
+import urllib.parse
+import uuid
+
+import pytest
+
+from minio_tpu.server import sigv4
+from .s3_harness import S3TestServer
+
+
+@pytest.fixture(scope="module")
+def srv(tmp_path_factory):
+    s = S3TestServer(str(tmp_path_factory.mktemp("drives")))
+    yield s
+    s.close()
+
+
+def _q(qs):
+    return [tuple(p.partition("=")[::2]) for p in qs.split("&")]
+
+
+class TestObjectTagging:
+    def test_tagging_crud(self, srv):
+        srv.request("PUT", "/otag")
+        srv.request("PUT", "/otag/obj", data=b"x")
+        # initially empty tag set
+        r = srv.request("GET", "/otag/obj", query=_q("tagging"))
+        assert r.status == 200 and "<TagSet></TagSet>" in r.text().replace(
+            "<TagSet/>", "<TagSet></TagSet>")
+        body = (b'<Tagging><TagSet><Tag><Key>team</Key><Value>ml</Value>'
+                b'</Tag><Tag><Key>env</Key><Value>dev</Value></Tag>'
+                b'</TagSet></Tagging>')
+        assert srv.request("PUT", "/otag/obj", query=_q("tagging"),
+                           data=body).status == 200
+        r = srv.request("GET", "/otag/obj", query=_q("tagging"))
+        assert "<Key>team</Key>" in r.text() and "<Value>ml</Value>" in r.text()
+        # tag count surfaces on GET
+        r = srv.request("GET", "/otag/obj")
+        assert r.headers.get("x-amz-tagging-count") == "2"
+        assert srv.request("DELETE", "/otag/obj",
+                           query=_q("tagging")).status == 204
+        r = srv.request("GET", "/otag/obj")
+        assert "x-amz-tagging-count" not in r.headers
+
+    def test_tagging_header_on_put(self, srv):
+        srv.request("PUT", "/otag2")
+        srv.request("PUT", "/otag2/h", data=b"x",
+                    headers={"x-amz-tagging": "a=1&b=2"})
+        r = srv.request("GET", "/otag2/h", query=_q("tagging"))
+        assert "<Key>a</Key>" in r.text()
+        r = srv.request("GET", "/otag2/h")
+        assert r.headers.get("x-amz-tagging-count") == "2"
+
+    def test_tagging_nonexistent_object(self, srv):
+        srv.request("PUT", "/otag3")
+        r = srv.request("GET", "/otag3/nope", query=_q("tagging"))
+        assert r.status == 404
+
+
+class TestPreconditions:
+    def test_if_match(self, srv):
+        srv.request("PUT", "/condb")
+        srv.request("PUT", "/condb/o", data=b"hello")
+        etag = srv.request("HEAD", "/condb/o").headers["ETag"].strip('"')
+        r = srv.request("GET", "/condb/o", headers={"If-Match": f'"{etag}"'})
+        assert r.status == 200
+        r = srv.request("GET", "/condb/o", headers={"If-Match": '"bogus"'})
+        assert r.status == 412
+        r = srv.request("GET", "/condb/o", headers={"If-Match": "*"})
+        assert r.status == 200
+
+    def test_if_none_match(self, srv):
+        etag = srv.request("HEAD", "/condb/o").headers["ETag"].strip('"')
+        r = srv.request("GET", "/condb/o",
+                        headers={"If-None-Match": f'"{etag}"'})
+        assert r.status == 304
+        r = srv.request("GET", "/condb/o",
+                        headers={"If-None-Match": '"other"'})
+        assert r.status == 200
+
+    def test_modified_since(self, srv):
+        future = "Fri, 01 Jan 2100 00:00:00 GMT"
+        past = "Mon, 01 Jan 2001 00:00:00 GMT"
+        r = srv.request("GET", "/condb/o",
+                        headers={"If-Modified-Since": future})
+        assert r.status == 304
+        r = srv.request("GET", "/condb/o",
+                        headers={"If-Modified-Since": past})
+        assert r.status == 200
+        r = srv.request("GET", "/condb/o",
+                        headers={"If-Unmodified-Since": past})
+        assert r.status == 412
+        r = srv.request("HEAD", "/condb/o",
+                        headers={"If-Unmodified-Since": future})
+        assert r.status == 200
+
+
+class TestObjectLock:
+    OL = (b'<ObjectLockConfiguration>'
+          b'<ObjectLockEnabled>Enabled</ObjectLockEnabled>'
+          b'</ObjectLockConfiguration>')
+
+    def _lock_bucket(self, srv, name):
+        srv.request("PUT", f"/{name}")
+        assert srv.request("PUT", f"/{name}", query=_q("object-lock"),
+                           data=self.OL).status == 200
+
+    def test_retention_blocks_version_delete(self, srv):
+        self._lock_bucket(srv, "lockb")
+        srv.request("PUT", "/lockb/doc", data=b"v1")
+        # find version id
+        import xml.etree.ElementTree as ET
+        NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+        root = ET.fromstring(
+            srv.request("GET", "/lockb", query=_q("versions")).text())
+        vid = root.find(f"{NS}Version").findtext(f"{NS}VersionId")
+        until = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                              time.gmtime(time.time() + 3600))
+        ret = (f"<Retention><Mode>COMPLIANCE</Mode>"
+               f"<RetainUntilDate>{until}</RetainUntilDate>"
+               f"</Retention>").encode()
+        assert srv.request("PUT", "/lockb/doc",
+                           query=_q(f"retention&versionId={vid}"),
+                           data=ret).status == 200
+        r = srv.request("GET", "/lockb/doc", query=_q("retention"))
+        assert "COMPLIANCE" in r.text()
+        # deleting the locked version is blocked even for root
+        r = srv.request("DELETE", "/lockb/doc",
+                        query=_q(f"versionId={vid}"))
+        assert r.status == 403 and "ObjectLocked" in r.text()
+        # a plain delete (delete marker) is fine
+        assert srv.request("DELETE", "/lockb/doc").status == 204
+        # compliance retention cannot be weakened
+        sooner = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                               time.gmtime(time.time() + 60))
+        weak = (f"<Retention><Mode>GOVERNANCE</Mode>"
+                f"<RetainUntilDate>{sooner}</RetainUntilDate>"
+                f"</Retention>").encode()
+        r = srv.request("PUT", "/lockb/doc",
+                        query=_q(f"retention&versionId={vid}"), data=weak)
+        assert r.status == 403
+
+    def test_governance_bypass(self, srv):
+        self._lock_bucket(srv, "govb")
+        srv.request("PUT", "/govb/g", data=b"v1")
+        import xml.etree.ElementTree as ET
+        NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+        root = ET.fromstring(
+            srv.request("GET", "/govb", query=_q("versions")).text())
+        vid = root.find(f"{NS}Version").findtext(f"{NS}VersionId")
+        until = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                              time.gmtime(time.time() + 3600))
+        ret = (f"<Retention><Mode>GOVERNANCE</Mode>"
+               f"<RetainUntilDate>{until}</RetainUntilDate>"
+               f"</Retention>").encode()
+        srv.request("PUT", "/govb/g", query=_q(f"retention&versionId={vid}"),
+                    data=ret)
+        r = srv.request("DELETE", "/govb/g", query=_q(f"versionId={vid}"))
+        assert r.status == 403
+        # root bypasses governance with the header
+        r = srv.request("DELETE", "/govb/g", query=_q(f"versionId={vid}"),
+                        headers={"x-amz-bypass-governance-retention": "true"})
+        assert r.status == 204
+
+    def test_legal_hold(self, srv):
+        self._lock_bucket(srv, "holdb")
+        srv.request("PUT", "/holdb/h", data=b"v1")
+        import xml.etree.ElementTree as ET
+        NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+        root = ET.fromstring(
+            srv.request("GET", "/holdb", query=_q("versions")).text())
+        vid = root.find(f"{NS}Version").findtext(f"{NS}VersionId")
+        hold = b"<LegalHold><Status>ON</Status></LegalHold>"
+        assert srv.request("PUT", "/holdb/h",
+                           query=_q(f"legal-hold&versionId={vid}"),
+                           data=hold).status == 200
+        r = srv.request("GET", "/holdb/h", query=_q("legal-hold"))
+        assert "<Status>ON</Status>" in r.text()
+        r = srv.request("DELETE", "/holdb/h", query=_q(f"versionId={vid}"),
+                        headers={"x-amz-bypass-governance-retention": "true"})
+        assert r.status == 403  # legal hold has no bypass
+        off = b"<LegalHold><Status>OFF</Status></LegalHold>"
+        srv.request("PUT", "/holdb/h",
+                    query=_q(f"legal-hold&versionId={vid}"), data=off)
+        r = srv.request("DELETE", "/holdb/h", query=_q(f"versionId={vid}"))
+        assert r.status == 204
+
+    def test_governance_retention_not_weakened_by_header_alone(self, srv):
+        """Weakening GOVERNANCE retention needs header AND the
+        BypassGovernanceRetention permission — a user with only
+        PutObjectRetention + the header must be refused."""
+        self._lock_bucket(srv, "weakb")
+        srv.request("PUT", "/weakb/w", data=b"v1")
+        import xml.etree.ElementTree as ET
+        NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+        root = ET.fromstring(
+            srv.request("GET", "/weakb", query=_q("versions")).text())
+        vid = root.find(f"{NS}Version").findtext(f"{NS}VersionId")
+        far = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                            time.gmtime(time.time() + 7200))
+        near = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                             time.gmtime(time.time() + 60))
+        ret = (f"<Retention><Mode>GOVERNANCE</Mode>"
+               f"<RetainUntilDate>{far}</RetainUntilDate>"
+               f"</Retention>").encode()
+        srv.request("PUT", "/weakb/w",
+                    query=_q(f"retention&versionId={vid}"), data=ret)
+        srv.iam.add_user("ret-only", "ret-only-secret1")
+        srv.iam.set_policy("retpol", json.dumps({"Statement": [{
+            "Effect": "Allow",
+            "Action": ["s3:PutObjectRetention", "s3:GetObjectRetention"],
+            "Resource": ["arn:aws:s3:::weakb/*"]}]}))
+        srv.iam.attach_policy("ret-only", ["retpol"])
+        weak = (f"<Retention><Mode>GOVERNANCE</Mode>"
+                f"<RetainUntilDate>{near}</RetainUntilDate>"
+                f"</Retention>").encode()
+        r = srv.request(
+            "PUT", "/weakb/w", query=_q(f"retention&versionId={vid}"),
+            data=weak, creds=("ret-only", "ret-only-secret1"),
+            headers={"x-amz-bypass-governance-retention": "true"})
+        assert r.status == 403
+        # root (has all permissions) + header may weaken
+        r = srv.request(
+            "PUT", "/weakb/w", query=_q(f"retention&versionId={vid}"),
+            data=weak,
+            headers={"x-amz-bypass-governance-retention": "true"})
+        assert r.status == 200
+
+    def test_put_rejects_malformed_lock_headers(self, srv):
+        self._lock_bucket(srv, "valb")
+        r = srv.request("PUT", "/valb/o", data=b"x", headers={
+            "x-amz-object-lock-mode": "COMPLIANCE",
+            "x-amz-object-lock-retain-until-date": "not-a-date",
+        })
+        assert r.status == 400
+        r = srv.request("PUT", "/valb/o", data=b"x", headers={
+            "x-amz-object-lock-mode": "WEIRD",
+            "x-amz-object-lock-retain-until-date":
+                time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                              time.gmtime(time.time() + 60)),
+        })
+        assert r.status == 400
+        r = srv.request("PUT", "/valb/o", data=b"x", headers={
+            "x-amz-object-lock-legal-hold": "MAYBE"})
+        assert r.status == 400
+
+    def test_lock_headers_require_lock_bucket(self, srv):
+        srv.request("PUT", "/nolock")
+        until = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                              time.gmtime(time.time() + 3600))
+        r = srv.request("PUT", "/nolock/o", data=b"x", headers={
+            "x-amz-object-lock-mode": "COMPLIANCE",
+            "x-amz-object-lock-retain-until-date": until,
+        })
+        assert r.status == 400
+
+
+class TestPostPolicy:
+    def _form_body(self, fields: dict, file_data: bytes,
+                   boundary: str) -> bytes:
+        parts = []
+        for k, v in fields.items():
+            parts.append(
+                f"--{boundary}\r\nContent-Disposition: form-data; "
+                f'name="{k}"\r\n\r\n{v}\r\n'.encode()
+            )
+        parts.append(
+            f"--{boundary}\r\nContent-Disposition: form-data; "
+            f'name="file"; filename="up.txt"\r\n'
+            f"Content-Type: text/plain\r\n\r\n".encode()
+            + file_data + b"\r\n"
+        )
+        parts.append(f"--{boundary}--\r\n".encode())
+        return b"".join(parts)
+
+    def _post(self, srv, bucket: str, fields: dict, file_data: bytes):
+        boundary = uuid.uuid4().hex
+        body = self._form_body(fields, file_data, boundary)
+        return srv.raw_request(
+            "POST", f"/{bucket}", data=body,
+            headers={
+                "host": srv.host,
+                "Content-Type": f"multipart/form-data; boundary={boundary}",
+            },
+        )
+
+    def _signed_fields(self, srv, bucket: str, key: str,
+                       conditions=None, expire_in=3600):
+        date8 = time.strftime("%Y%m%d", time.gmtime())
+        amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+        cred = f"{srv.ak}/{date8}/us-east-1/s3/aws4_request"
+        policy = {
+            "expiration": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime(time.time() + expire_in)),
+            "conditions": (conditions if conditions is not None else [
+                {"bucket": bucket},
+                ["starts-with", "$key", ""],
+            ]) + [
+                {"x-amz-algorithm": "AWS4-HMAC-SHA256"},
+                {"x-amz-credential": cred},
+                {"x-amz-date": amz_date},
+            ],
+        }
+        policy_b64 = base64.b64encode(
+            json.dumps(policy).encode()).decode()
+        sig = sigv4.sign_policy(srv.sk, date8, "us-east-1", "s3", policy_b64)
+        return {
+            "key": key,
+            "policy": policy_b64,
+            "x-amz-algorithm": "AWS4-HMAC-SHA256",
+            "x-amz-credential": cred,
+            "x-amz-date": amz_date,
+            "x-amz-signature": sig,
+        }
+
+    def test_post_upload(self, srv):
+        srv.request("PUT", "/postb")
+        fields = self._signed_fields(srv, "postb", "up.txt")
+        r = self._post(srv, "postb", fields, b"posted content")
+        assert r.status == 204, r.text()
+        r = srv.request("GET", "/postb/up.txt")
+        assert r.body == b"posted content"
+
+    def test_post_filename_substitution(self, srv):
+        fields = self._signed_fields(srv, "postb", "dir/${filename}")
+        fields["key"] = "dir/${filename}"
+        r = self._post(srv, "postb", fields, b"abc")
+        assert r.status == 204
+        assert srv.request("GET", "/postb/dir/up.txt").body == b"abc"
+
+    def test_post_bad_signature(self, srv):
+        fields = self._signed_fields(srv, "postb", "bad.txt")
+        fields["x-amz-signature"] = "0" * 64
+        r = self._post(srv, "postb", fields, b"x")
+        assert r.status == 403
+        assert srv.request("GET", "/postb/bad.txt").status == 404
+
+    def test_post_policy_conditions(self, srv):
+        # key must start with uploads/ per policy; violating key denied
+        fields = self._signed_fields(
+            srv, "postb", "elsewhere.txt",
+            conditions=[{"bucket": "postb"},
+                        ["starts-with", "$key", "uploads/"]])
+        r = self._post(srv, "postb", fields, b"x")
+        assert r.status == 403
+        fields = self._signed_fields(
+            srv, "postb", "uploads/ok.txt",
+            conditions=[{"bucket": "postb"},
+                        ["starts-with", "$key", "uploads/"]])
+        r = self._post(srv, "postb", fields, b"ok")
+        assert r.status == 204
+
+    def test_post_content_length_range(self, srv):
+        fields = self._signed_fields(
+            srv, "postb", "sized.txt",
+            conditions=[{"bucket": "postb"},
+                        ["starts-with", "$key", ""],
+                        ["content-length-range", 1, 4]])
+        r = self._post(srv, "postb", fields, b"too large body")
+        assert r.status == 400
+        fields = self._signed_fields(
+            srv, "postb", "sized.txt",
+            conditions=[{"bucket": "postb"},
+                        ["starts-with", "$key", ""],
+                        ["content-length-range", 1, 4]])
+        r = self._post(srv, "postb", fields, b"ok!")
+        assert r.status == 204
+
+    def test_post_success_action_status_201(self, srv):
+        fields = self._signed_fields(srv, "postb", "s201.txt")
+        fields["success_action_status"] = "201"
+        r = self._post(srv, "postb", fields, b"x")
+        assert r.status == 201 and "<PostResponse>" in r.text()
