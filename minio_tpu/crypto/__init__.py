@@ -1,0 +1,5 @@
+"""Server-side encryption: local KMS + DARE-style chunked AES-256-GCM
+(reference internal/crypto, internal/kms, cmd/encryption-v1.go)."""
+
+from .kms import LocalKMS  # noqa: F401
+from . import sse  # noqa: F401

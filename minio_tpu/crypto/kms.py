@@ -1,0 +1,60 @@
+"""Single-key KMS (reference internal/kms/single-key.go — the
+MINIO_KMS_SECRET_KEY mode: one 256-bit master key held by the server,
+data keys generated per object and sealed with AES-256-GCM under the
+master key, bound to a context string).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import os
+
+from cryptography.exceptions import InvalidTag
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+
+class KMSError(Exception):
+    pass
+
+
+class LocalKMS:
+    """`key_id:base64-key` like MINIO_KMS_SECRET_KEY=my-key:BASE64."""
+
+    def __init__(self, key_id: str, master_key: bytes):
+        if len(master_key) != 32:
+            raise KMSError("master key must be 256-bit")
+        self.key_id = key_id
+        self._master = master_key
+
+    @classmethod
+    def from_env_value(cls, value: str) -> "LocalKMS":
+        key_id, _, b64 = value.partition(":")
+        if not b64:
+            raise KMSError("expected <key-id>:<base64-key>")
+        return cls(key_id, base64.b64decode(b64))
+
+    @classmethod
+    def generate(cls, key_id: str = "minio-tpu-default-key") -> "LocalKMS":
+        return cls(key_id, os.urandom(32))
+
+    def generate_key(self, context: str) -> tuple[bytes, bytes]:
+        """(plaintext 256-bit data key, sealed blob)."""
+        plaintext = os.urandom(32)
+        return plaintext, self.seal(plaintext, context)
+
+    def seal(self, plaintext: bytes, context: str) -> bytes:
+        nonce = os.urandom(12)
+        ct = AESGCM(self._master).encrypt(nonce, plaintext, context.encode())
+        return nonce + ct
+
+    def decrypt_key(self, sealed: bytes, context: str) -> bytes:
+        nonce, ct = sealed[:12], sealed[12:]
+        try:
+            return AESGCM(self._master).decrypt(nonce, ct, context.encode())
+        except InvalidTag:
+            raise KMSError("sealed key authentication failed "
+                           "(wrong master key or context)")
+
+    def fingerprint(self) -> str:
+        return hashlib.sha256(self._master).hexdigest()[:16]

@@ -1,0 +1,223 @@
+"""SSE object stream encryption: chunked AES-256-GCM in the style of
+DARE (reference internal/crypto/ and the sio DARE 2.0 format MinIO
+uses: the object stream is split into fixed-size packages, each sealed
+independently so ranged reads only decrypt the chunks they touch).
+
+Format here: 64 KiB plaintext chunks; chunk i is sealed with
+AES-256-GCM under the per-object key, nonce = 8-byte random object
+prefix || uint32(i), AAD = "<bucket>/<object>".  Ciphertext chunk =
+plaintext + 16-byte tag; no framing bytes (chunk boundaries derive from
+sizes).  Truncation/tampering surfaces as an InvalidTag on decrypt.
+
+Key wrapping (cmd/encryption-v1.go, internal/crypto/key.go):
+- SSE-S3: object key from KMS.generate_key(bucket/object); sealed blob
+  stored in metadata.
+- SSE-C: object key random; sealed under the customer-supplied 256-bit
+  key; only the key's MD5 is stored (the server never persists SSE-C
+  keys).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import os
+import struct
+from typing import Iterator
+
+from cryptography.exceptions import InvalidTag
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+CHUNK = 64 * 1024
+TAG = 16
+
+# metadata keys (x-minio-internal-* are stripped from client responses)
+META_ALGO = "x-minio-internal-sse"                 # "SSE-S3" | "SSE-C"
+META_SEALED_KEY = "x-minio-internal-sse-sealed-key"
+META_NONCE = "x-minio-internal-sse-nonce"          # 8-byte b64 prefix
+META_KMS_KEY_ID = "x-minio-internal-sse-kms-key-id"
+META_SSEC_KEY_MD5 = "x-minio-internal-ssec-key-md5"
+META_ACTUAL_SIZE = "x-minio-internal-actual-size"
+
+
+class SSEError(Exception):
+    pass
+
+
+def enc_size(plain_size: int) -> int:
+    if plain_size <= 0:
+        return plain_size if plain_size < 0 else 0
+    n_chunks = (plain_size + CHUNK - 1) // CHUNK
+    return plain_size + TAG * n_chunks
+
+
+def plain_size_of(enc: int) -> int:
+    if enc <= 0:
+        return 0
+    n_chunks = (enc + CHUNK + TAG - 1) // (CHUNK + TAG)
+    return enc - TAG * n_chunks
+
+
+def _nonce(prefix: bytes, seq: int) -> bytes:
+    return prefix + struct.pack(">I", seq)
+
+
+class EncryptingReader:
+    """Wraps a plaintext reader; read() yields the sealed stream."""
+
+    def __init__(self, src, key: bytes, nonce_prefix: bytes, aad: bytes):
+        self.src = src
+        self.gcm = AESGCM(key)
+        self.prefix = nonce_prefix
+        self.aad = aad
+        self.seq = 0
+        self.buf = b""
+        self.eof = False
+
+    def _fill_chunk(self) -> None:
+        """Read exactly one plaintext chunk (or the final short one)."""
+        pt = b""
+        while len(pt) < CHUNK:
+            piece = self.src.read(CHUNK - len(pt))
+            if not piece:
+                self.eof = True
+                break
+            pt += piece
+        if pt:
+            self.buf += self.gcm.encrypt(
+                _nonce(self.prefix, self.seq), pt, self.aad)
+            self.seq += 1
+
+    def read(self, n: int = -1) -> bytes:
+        while not self.eof and (n < 0 or len(self.buf) < n):
+            self._fill_chunk()
+        if n < 0:
+            out, self.buf = self.buf, b""
+        else:
+            out, self.buf = self.buf[:n], self.buf[n:]
+        return out
+
+
+def decrypt_chunks(ct_stream: Iterator[bytes], key: bytes,
+                   nonce_prefix: bytes, aad: bytes, first_seq: int,
+                   skip: int, length: int) -> Iterator[bytes]:
+    """Decrypt a ciphertext stream that starts at chunk `first_seq`,
+    dropping `skip` leading plaintext bytes and yielding exactly
+    `length` bytes (the ranged-GET decrypt path)."""
+    gcm = AESGCM(key)
+    seq = first_seq
+    buf = b""
+    remaining = length
+    to_skip = skip
+    for piece in ct_stream:
+        buf += piece
+        while len(buf) >= CHUNK + TAG:
+            block, buf = buf[:CHUNK + TAG], buf[CHUNK + TAG:]
+            try:
+                pt = gcm.decrypt(_nonce(nonce_prefix, seq), block, aad)
+            except InvalidTag:
+                raise SSEError(f"chunk {seq} failed authentication")
+            seq += 1
+            if to_skip:
+                pt = pt[to_skip:]
+                to_skip = 0
+            if remaining >= 0:
+                pt = pt[:remaining]
+                remaining -= len(pt)
+            if pt:
+                yield pt
+            if remaining == 0:
+                return
+    if buf:
+        try:
+            pt = AESGCM(key).decrypt(_nonce(nonce_prefix, seq), buf, aad)
+        except InvalidTag:
+            raise SSEError(f"final chunk {seq} failed authentication")
+        if to_skip:
+            pt = pt[to_skip:]
+        if remaining >= 0:
+            pt = pt[:remaining]
+        if pt:
+            yield pt
+
+
+def ct_range_for(offset: int, length: int, total_plain: int
+                 ) -> tuple[int, int, int, int]:
+    """Map a plaintext range to (ct_offset, ct_length, first_seq, skip)."""
+    if length < 0:
+        length = total_plain - offset
+    end = min(offset + length, total_plain)
+    length = max(0, end - offset)
+    c0 = offset // CHUNK
+    c1 = max(c0, (end - 1) // CHUNK) if length else c0
+    ct_off = c0 * (CHUNK + TAG)
+    ct_end = min(enc_size(total_plain), (c1 + 1) * (CHUNK + TAG))
+    return ct_off, ct_end - ct_off, c0, offset - c0 * CHUNK
+
+
+# ---------------------------------------------------------------- key wrap
+def seal_object_key(object_key: bytes, wrapping_key: bytes,
+                    context: str) -> bytes:
+    nonce = os.urandom(12)
+    return nonce + AESGCM(wrapping_key).encrypt(
+        nonce, object_key, context.encode())
+
+
+def unseal_object_key(sealed: bytes, wrapping_key: bytes,
+                      context: str) -> bytes:
+    try:
+        return AESGCM(wrapping_key).decrypt(
+            sealed[:12], sealed[12:], context.encode())
+    except InvalidTag:
+        raise SSEError("object key unseal failed (wrong key?)")
+
+
+# ------------------------------------------------------------ helper views
+def new_encryption_meta(kind: str, bucket: str, obj: str, kms=None,
+                        customer_key: bytes | None = None
+                        ) -> tuple[bytes, bytes, dict]:
+    """(object_key, nonce_prefix, metadata) for a fresh encrypted PUT."""
+    context = f"{bucket}/{obj}"
+    nonce_prefix = os.urandom(8)
+    meta = {
+        META_ALGO: kind,
+        META_NONCE: base64.b64encode(nonce_prefix).decode(),
+    }
+    if kind == "SSE-S3":
+        if kms is None:
+            raise SSEError("no KMS configured")
+        object_key, sealed = kms.generate_key(context)
+        meta[META_SEALED_KEY] = base64.b64encode(sealed).decode()
+        meta[META_KMS_KEY_ID] = kms.key_id
+    elif kind == "SSE-C":
+        if customer_key is None or len(customer_key) != 32:
+            raise SSEError("SSE-C needs a 256-bit customer key")
+        object_key = os.urandom(32)
+        sealed = seal_object_key(object_key, customer_key, context)
+        meta[META_SEALED_KEY] = base64.b64encode(sealed).decode()
+        meta[META_SSEC_KEY_MD5] = base64.b64encode(
+            hashlib.md5(customer_key).digest()).decode()
+    else:
+        raise SSEError(f"unknown SSE kind {kind}")
+    return object_key, nonce_prefix, meta
+
+
+def recover_object_key(meta: dict, bucket: str, obj: str, kms=None,
+                       customer_key: bytes | None = None) -> bytes:
+    context = f"{bucket}/{obj}"
+    kind = meta.get(META_ALGO, "")
+    sealed = base64.b64decode(meta.get(META_SEALED_KEY, ""))
+    if kind == "SSE-S3":
+        if kms is None:
+            raise SSEError("no KMS configured")
+        return kms.decrypt_key(sealed, context)
+    if kind == "SSE-C":
+        if customer_key is None:
+            raise SSEError("SSE-C key required")
+        want_md5 = meta.get(META_SSEC_KEY_MD5, "")
+        got_md5 = base64.b64encode(
+            hashlib.md5(customer_key).digest()).decode()
+        if want_md5 != got_md5:
+            raise SSEError("SSE-C key does not match")
+        return unseal_object_key(sealed, customer_key, context)
+    raise SSEError(f"object is not SSE-encrypted ({kind!r})")

@@ -13,6 +13,7 @@ model with the global API throttle (cmd/handler-api.go).
 from __future__ import annotations
 
 import asyncio
+import base64
 import hashlib
 import io
 import queue as queue_mod
@@ -36,6 +37,7 @@ from .object_extras import (
     ObjectExtraHandlers, parse_tag_query,
 )
 from .s3errors import S3Error, from_storage_error
+from .sse_handlers import SSEMixin, load_or_create_kms
 
 XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
 VALID_BUCKET = re.compile(r"^[a-z0-9][a-z0-9.\-]{2,62}$")
@@ -151,7 +153,7 @@ class _QueuePipeReader(io.RawIOBase):
         return out
 
 
-class S3Server(BucketMetaHandlers, ObjectExtraHandlers):
+class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin):
     def __init__(self, object_layer, access_key: str = "minioadmin",
                  secret_key: str = "minioadmin", region: str = "us-east-1",
                  max_concurrency: int = 64, iam=None):
@@ -164,6 +166,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers):
             object_layer, access_key, secret_key
         )
         self.meta = BucketMetadataSys(object_layer)
+        self.kms = load_or_create_kms(object_layer)
         self.region = region
         self.sem = asyncio.Semaphore(max_concurrency)
         # Dedicated pool sized to the request semaphore so a full house of
@@ -579,7 +582,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers):
                 f"<Contents><Key>{self._enc_key(oi.name, enc)}</Key>"
                 f"<LastModified>{_iso(oi.mod_time)}</LastModified>"
                 f'<ETag>&quot;{oi.etag}&quot;</ETag>'
-                f"<Size>{oi.size}</Size>"
+                f"<Size>{self._display_size(oi)}</Size>"
                 f"<Owner><ID>minio-tpu</ID>"
                 f"<DisplayName>minio-tpu</DisplayName></Owner>"
                 f"<StorageClass>STANDARD</StorageClass></Contents>"
@@ -662,7 +665,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers):
                     f"<IsLatest>{latest}</IsLatest>"
                     f"<LastModified>{_iso(oi.mod_time)}</LastModified>"
                     f'<ETag>&quot;{oi.etag}&quot;</ETag>'
-                    f"<Size>{oi.size}</Size>"
+                    f"<Size>{self._display_size(oi)}</Size>"
                     f"<Owner><ID>minio-tpu</ID>"
                     f"<DisplayName>minio-tpu</DisplayName></Owner>"
                     f"<StorageClass>STANDARD</StorageClass></Version>"
@@ -832,6 +835,20 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers):
         reader: io.RawIOBase = (
             _ChunkedSigReader(pipe, ctx) if streaming else pipe
         )
+        # server-side encryption wraps the decoded plaintext stream
+        # (reference EncryptRequest, cmd/encryption-v1.go:324)
+        sse_kind, customer_key = self.sse_kind_for_put(request, bucket)
+        if sse_kind:
+            from minio_tpu.crypto import sse as sse_mod
+
+            obj_key, nonce_prefix, enc_meta = sse_mod.new_encryption_meta(
+                sse_kind, bucket, key, kms=self.kms,
+                customer_key=customer_key)
+            opts.user_metadata.update(enc_meta)
+            reader = sse_mod.EncryptingReader(
+                reader, obj_key, nonce_prefix, f"{bucket}/{key}".encode())
+            if real_size >= 0:
+                real_size = sse_mod.enc_size(real_size)
         put_task = asyncio.ensure_future(self._run(
             self.api.put_object, bucket, key, reader, real_size, opts
         ))
@@ -871,6 +888,8 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers):
         headers = {"ETag": f'"{oi.etag}"'}
         if oi.version_id:
             headers["x-amz-version-id"] = oi.version_id
+        if sse_kind:
+            headers.update(self.sse_response_headers(opts.user_metadata))
         return web.Response(status=200, headers=headers)
 
     async def _versioned(self, bucket: str) -> bool:
@@ -895,17 +914,49 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers):
             ctx.access_key, "s3:GetObject", sbucket, skey
         ):
             raise S3Error("AccessDenied", "not allowed to read copy source")
-        oi, stream = await self._run(
-            self.api.get_object, sbucket, skey, 0, -1, vid
-        )
-        data = await self._run(lambda: b"".join(stream))
+        from minio_tpu.crypto import sse as sse_mod
+
+        soi = await self._run(self.api.get_object_info, sbucket, skey, vid)
+        src_meta = dict(soi.metadata)
+        if src_meta.get(sse_mod.META_ALGO):
+            # decrypt the source (SSE-C copy-source headers not yet wired:
+            # SSE-C sources need x-amz-copy-source-sse-c keys)
+            obj_key = self.sse_object_key(soi, sbucket, skey, request)
+            nonce_prefix = base64.b64decode(
+                src_meta.get(sse_mod.META_NONCE, ""))
+            plain = sse_mod.plain_size_of(soi.size)
+            _, ct_stream = await self._run(
+                self.api.get_object, sbucket, skey, 0, -1, vid)
+            data = await self._run(lambda: b"".join(sse_mod.decrypt_chunks(
+                iter(ct_stream), obj_key, nonce_prefix,
+                f"{sbucket}/{skey}".encode(), 0, 0, plain)))
+            for k in (sse_mod.META_ALGO, sse_mod.META_SEALED_KEY,
+                      sse_mod.META_NONCE, sse_mod.META_KMS_KEY_ID,
+                      sse_mod.META_SSEC_KEY_MD5):
+                src_meta.pop(k, None)
+        else:
+            oi, stream = await self._run(
+                self.api.get_object, sbucket, skey, 0, -1, vid
+            )
+            data = await self._run(lambda: b"".join(stream))
         opts = PutObjectOptions(
-            content_type=oi.content_type,
-            user_metadata=dict(oi.metadata),
+            content_type=soi.content_type,
+            user_metadata=src_meta,
             versioned=await self._versioned(bucket),
         )
+        size = len(data)
+        reader: io.RawIOBase = io.BytesIO(data)
+        sse_kind, customer_key = self.sse_kind_for_put(request, bucket)
+        if sse_kind:
+            okey, nprefix, enc_meta = sse_mod.new_encryption_meta(
+                sse_kind, bucket, key, kms=self.kms,
+                customer_key=customer_key)
+            opts.user_metadata.update(enc_meta)
+            reader = sse_mod.EncryptingReader(
+                reader, okey, nprefix, f"{bucket}/{key}".encode())
+            size = sse_mod.enc_size(size)
         new_oi = await self._run(
-            self.api.put_object, bucket, key, io.BytesIO(data), len(data), opts
+            self.api.put_object, bucket, key, reader, size, opts
         )
         return self._xml(200, (
             f'<?xml version="1.0" encoding="UTF-8"?>'
@@ -937,26 +988,46 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers):
         return start, end
 
     async def get_object(self, request: web.Request) -> web.StreamResponse:
+        from minio_tpu.crypto import sse as sse_mod
+
         bucket, key = self._object(request)
         await self._auth(request, None, "s3:GetObject", bucket, key)
         vid = request.rel_url.query.get("versionId", "")
         oi = await self._run(self.api.get_object_info, bucket, key, vid)
         self.check_preconditions(request, oi)
 
+        encrypted = bool(oi.metadata.get(sse_mod.META_ALGO))
+        size = sse_mod.plain_size_of(oi.size) if encrypted else oi.size
+
         status = 200
-        offset, length = 0, oi.size
+        offset, length = 0, size
         headers = self._obj_headers(oi)
         rng = request.headers.get("Range")
-        if rng and oi.size > 0:
-            start, end = self._parse_range(rng, oi.size)
+        if rng and size > 0:
+            start, end = self._parse_range(rng, size)
             offset, length = start, end - start + 1
             status = 206
-            headers["Content-Range"] = f"bytes {start}-{end}/{oi.size}"
+            headers["Content-Range"] = f"bytes {start}-{end}/{size}"
         headers["Content-Length"] = str(length)
 
-        _, stream = await self._run(
-            self.api.get_object, bucket, key, offset, length, vid
-        )
+        if encrypted:
+            obj_key = self.sse_object_key(oi, bucket, key, request)
+            headers.update(self.sse_response_headers(oi.metadata))
+            ct_off, ct_len, first_seq, skip = sse_mod.ct_range_for(
+                offset, length, size)
+            nonce_prefix = base64.b64decode(
+                oi.metadata.get(sse_mod.META_NONCE, ""))
+            _, ct_stream = await self._run(
+                self.api.get_object, bucket, key, ct_off, ct_len, vid)
+            stream = sse_mod.decrypt_chunks(
+                iter(ct_stream), obj_key, nonce_prefix,
+                f"{bucket}/{key}".encode(), first_seq, skip, length)
+            closer = ct_stream
+        else:
+            _, stream = await self._run(
+                self.api.get_object, bucket, key, offset, length, vid
+            )
+            closer = stream
         resp = web.StreamResponse(status=status, headers=headers)
         await resp.prepare(request)
         it = iter(stream)
@@ -967,18 +1038,27 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers):
                     break
                 await resp.write(chunk)
         finally:
-            await self._run(lambda: stream.close() if hasattr(stream, "close") else None)
+            await self._run(lambda: closer.close()
+                            if hasattr(closer, "close") else None)
         await resp.write_eof()
         return resp
 
     async def head_object(self, request: web.Request) -> web.Response:
+        from minio_tpu.crypto import sse as sse_mod
+
         bucket, key = self._object(request)
         await self._auth(request, None, "s3:GetObject", bucket, key)
         vid = request.rel_url.query.get("versionId", "")
         oi = await self._run(self.api.get_object_info, bucket, key, vid)
         self.check_preconditions(request, oi)
         headers = self._obj_headers(oi)
-        headers["Content-Length"] = str(oi.size)
+        if oi.metadata.get(sse_mod.META_ALGO):
+            # SSE-C objects require (and verify) the key even on HEAD
+            self.sse_object_key(oi, bucket, key, request)
+            headers.update(self.sse_response_headers(oi.metadata))
+            headers["Content-Length"] = str(sse_mod.plain_size_of(oi.size))
+        else:
+            headers["Content-Length"] = str(oi.size)
         return web.Response(status=200, headers=headers)
 
     async def delete_object(self, request: web.Request) -> web.Response:

@@ -1,0 +1,136 @@
+"""SSE-S3 / SSE-C request plumbing for the S3 server.
+
+Reference: cmd/encryption-v1.go (EncryptRequest :324, DecryptRequest,
+ParseSSECustomerRequest), internal/crypto/sse-c.go, sse-s3.go.  The KMS
+master key persists in the cluster system volume so restarts keep
+decrypting (reference: KES or MINIO_KMS_SECRET_KEY; here the single-key
+LocalKMS).
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import hashlib
+import json
+
+from minio_tpu.crypto import LocalKMS, sse
+from minio_tpu.storage import errors as st_errors
+from minio_tpu.storage.local import SYSTEM_VOL
+
+from .s3errors import S3Error
+
+SSE_HDR = "x-amz-server-side-encryption"
+SSEC_ALGO_HDR = "x-amz-server-side-encryption-customer-algorithm"
+SSEC_KEY_HDR = "x-amz-server-side-encryption-customer-key"
+SSEC_MD5_HDR = "x-amz-server-side-encryption-customer-key-md5"
+
+KMS_CONFIG_PATH = "config/kms/master.json"
+
+
+def load_or_create_kms(object_layer) -> LocalKMS:
+    """Load the persisted master key, or create+persist one on first boot."""
+    pool = getattr(object_layer, "pools", [object_layer])[0]
+    disks = [d for d in pool.all_disks if d is not None and d.is_online()]
+    for d in disks:
+        try:
+            doc = json.loads(d.read_all(SYSTEM_VOL, KMS_CONFIG_PATH))
+            return LocalKMS(doc["key_id"], base64.b64decode(doc["key"]))
+        except (st_errors.StorageError, ValueError, KeyError):
+            continue
+    kms = LocalKMS.generate()
+    raw = json.dumps({
+        "key_id": kms.key_id,
+        "key": base64.b64encode(kms._master).decode(),
+    }).encode()
+    for d in disks:
+        try:
+            d.write_all(SYSTEM_VOL, KMS_CONFIG_PATH, raw)
+        except st_errors.StorageError:
+            continue
+    return kms
+
+
+def parse_ssec_key(headers) -> bytes | None:
+    """Validate and decode the SSE-C header triple; None if absent."""
+    algo = headers.get(SSEC_ALGO_HDR, "")
+    key_b64 = headers.get(SSEC_KEY_HDR, "")
+    md5_b64 = headers.get(SSEC_MD5_HDR, "")
+    if not algo and not key_b64:
+        return None
+    if algo != "AES256":
+        raise S3Error("InvalidArgument",
+                      "SSE-C algorithm must be AES256")
+    try:
+        key = base64.b64decode(key_b64, validate=True)
+    except binascii.Error:
+        raise S3Error("InvalidArgument", "SSE-C key is not valid base64")
+    if len(key) != 32:
+        raise S3Error("InvalidArgument", "SSE-C key must be 256 bits")
+    if md5_b64:
+        want = base64.b64encode(hashlib.md5(key).digest()).decode()
+        if want != md5_b64:
+            raise S3Error("InvalidArgument", "SSE-C key MD5 mismatch")
+    return key
+
+
+class SSEMixin:
+    """Handler plumbing; expects self.kms, self.meta, self.api."""
+
+    def sse_kind_for_put(self, request, bucket: str
+                         ) -> tuple[str, bytes | None]:
+        """('', None) = plaintext; ('SSE-S3', None); ('SSE-C', key)."""
+        customer_key = parse_ssec_key(request.headers)
+        if customer_key is not None:
+            if request.headers.get(SSE_HDR):
+                raise S3Error("InvalidArgument",
+                              "SSE-C and SSE-S3 are mutually exclusive")
+            return "SSE-C", customer_key
+        hdr = request.headers.get(SSE_HDR, "")
+        if hdr:
+            if hdr not in ("AES256", "aws:kms"):
+                raise S3Error("InvalidArgument",
+                              f"unsupported SSE algorithm {hdr}")
+            return "SSE-S3", None
+        # bucket-default encryption config applies SSE-S3
+        try:
+            from minio_tpu.bucket import metadata as bm
+
+            if self.meta.get_config(bucket, bm.SSE_CONFIG):
+                return "SSE-S3", None
+        except Exception:
+            pass
+        return "", None
+
+    @staticmethod
+    def sse_response_headers(meta: dict) -> dict:
+        kind = meta.get(sse.META_ALGO, "")
+        if kind == "SSE-S3":
+            return {SSE_HDR: "AES256"}
+        if kind == "SSE-C":
+            return {SSEC_ALGO_HDR: "AES256",
+                    SSEC_MD5_HDR: meta.get(sse.META_SSEC_KEY_MD5, "")}
+        return {}
+
+    def sse_object_key(self, oi, bucket: str, key: str, request) -> bytes:
+        """Recover the object key for a GET/HEAD of an encrypted object."""
+        kind = oi.metadata.get(sse.META_ALGO, "")
+        customer_key = None
+        if kind == "SSE-C":
+            customer_key = parse_ssec_key(request.headers)
+            if customer_key is None:
+                raise S3Error("InvalidRequest",
+                              "object is SSE-C encrypted: key required")
+        try:
+            return sse.recover_object_key(
+                oi.metadata, bucket, key, kms=self.kms,
+                customer_key=customer_key)
+        except sse.SSEError as e:
+            raise S3Error("AccessDenied", str(e))
+
+    @staticmethod
+    def _display_size(oi) -> int:
+        """Client-visible (decrypted) size of a possibly-SSE object."""
+        if oi.metadata.get(sse.META_ALGO):
+            return sse.plain_size_of(oi.size)
+        return oi.size
