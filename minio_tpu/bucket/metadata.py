@@ -38,6 +38,9 @@ class BucketMetadataSys:
         self.api = api
         self._lock = threading.Lock()
         self._cache: dict[str, tuple[float, dict]] = {}
+        # parsed-policy memo: bucket -> (raw json it was parsed from, Policy)
+        # so per-key authorization in bulk ops doesn't reparse per call
+        self._policy_parsed: dict[str, tuple[str, Policy | None]] = {}
         self.ttl = 5.0  # seconds; single-node writes invalidate eagerly
 
     # ------------------------------------------------------------- raw doc
@@ -55,6 +58,7 @@ class BucketMetadataSys:
     def invalidate(self, bucket: str) -> None:
         with self._lock:
             self._cache.pop(bucket, None)
+            self._policy_parsed.pop(bucket, None)
 
     def set_config(self, bucket: str, key: str, value) -> None:
         if not self.api.bucket_exists(bucket):
@@ -81,10 +85,17 @@ class BucketMetadataSys:
         raw = self.get(bucket).get(POLICY)
         if not raw:
             return None
+        with self._lock:
+            hit = self._policy_parsed.get(bucket)
+            if hit is not None and hit[0] == raw:
+                return hit[1]
         try:
-            return Policy.from_json(raw)
+            pol = Policy.from_json(raw)
         except Exception:
-            return None
+            pol = None
+        with self._lock:
+            self._policy_parsed[bucket] = (raw, pol)
+        return pol
 
     def lifecycle(self, bucket: str):
         from . import lifecycle as lc

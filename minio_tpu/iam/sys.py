@@ -362,14 +362,28 @@ class IAMSys:
                     if parent is None or parent.status != "enabled":
                         return "deny"
                     base = self._effective_policy(parent).evaluate(args)
-                if base != "allow":
-                    return base
-                # session policy (if any) further restricts
+                if base == "deny":
+                    return "deny"
+                # session policy (if any) further restricts: the session
+                # policy itself must allow the action, whatever the parent
+                # grants.  Anything short of an explicit session allow is a
+                # hard deny — returning 'none' (even when the PARENT's
+                # decision was 'none') would let a bucket policy widen a
+                # session-restricted credential (reference requires the
+                # embedded policy to grant, cmd/auth-handler.go).
                 if ident.session_policy:
-                    try:
-                        sp = Policy.from_json(ident.session_policy)
-                    except Exception:
+                    memo = getattr(ident, "_sp_parsed", None)
+                    if memo is not None and memo[0] == ident.session_policy:
+                        sp = memo[1]
+                    else:
+                        try:
+                            sp = Policy.from_json(ident.session_policy)
+                        except Exception:
+                            sp = None
+                        ident._sp_parsed = (ident.session_policy, sp)
+                    if sp is None or sp.evaluate(args) != "allow":
                         return "deny"
-                    return sp.evaluate(args)
-                return "allow"
+                # base is 'allow' or 'none' (for 'none' the bucket policy
+                # may still grant, within what the session policy permits)
+                return base
             return self._effective_policy(ident).evaluate(args)

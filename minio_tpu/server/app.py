@@ -37,7 +37,7 @@ from .object_extras import (
     ObjectExtraHandlers, parse_tag_query,
 )
 from .s3errors import S3Error, from_storage_error
-from .sse_handlers import SSEMixin, load_or_create_kms
+from .sse_handlers import SSEMixin, load_kms
 
 XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
 VALID_BUCKET = re.compile(r"^[a-z0-9][a-z0-9.\-]{2,62}$")
@@ -166,7 +166,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin):
             object_layer, access_key, secret_key
         )
         self.meta = BucketMetadataSys(object_layer)
-        self.kms = load_or_create_kms(object_layer)
+        self.kms = load_kms(object_layer)
         self.region = region
         self.sem = asyncio.Semaphore(max_concurrency)
         # Dedicated pool sized to the request semaphore so a full house of
@@ -216,18 +216,14 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin):
         headers = dict(request.headers)
         headers["host"] = request.headers.get("Host", request.host)
         path = urllib.parse.unquote(request.rel_url.raw_path)
-        conditions = {"aws:SourceIp": request.remote or ""}
+        conditions = self._request_conditions(request)
 
-        if ("Authorization" not in request.headers
-                and "X-Amz-Signature" not in dict(query)):
+        if self._is_anonymous(request):
             # anonymous request: the bucket policy alone decides
             # (reference cmd/auth-handler.go authTypeAnonymous path)
-            if action and bucket:
-                decision = await self._run(
-                    self._bucket_policy_decision, "*", action, bucket, obj,
-                    conditions)
-                if decision == "allow":
-                    return sigv4.V4Context("", b"", "", "", "")
+            if action and bucket and await self._authorized(
+                    "*", action, bucket, obj, conditions):
+                return sigv4.V4Context("", b"", "", "", "")
             raise S3Error("AccessDenied", "anonymous access denied",
                           resource=request.path)
 
@@ -245,29 +241,54 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin):
         except sigv4.SigV4Error as e:
             raise S3Error(e.code, str(e))
         if action:
-            iam_decision = self.iam.evaluate(
-                ctx.access_key, action, bucket, obj, conditions=conditions,
-            )
-            allowed = iam_decision == "allow"
-            if iam_decision == "none" and bucket:
-                # no IAM statement matched: the bucket policy may grant
-                # (an explicit IAM Deny is final and never reaches here)
-                decision = await self._run(
-                    self._bucket_policy_decision, ctx.access_key, action,
-                    bucket, obj, conditions)
-                allowed = decision == "allow"
-            elif allowed and bucket:
-                # bucket-policy Deny overrides an IAM allow (deny-wins
-                # across layers), except for the root account
-                if ctx.access_key != self.iam.root.access_key:
-                    decision = await self._run(
-                        self._bucket_policy_decision, ctx.access_key, action,
-                        bucket, obj, conditions)
-                    allowed = decision != "deny"
-            if not allowed:
+            if not await self._authorized(ctx.access_key, action, bucket,
+                                          obj, conditions):
                 raise S3Error("AccessDenied", f"not allowed to {action}",
                               resource=request.path)
         return ctx
+
+    @staticmethod
+    def _is_anonymous(request: web.Request) -> bool:
+        return ("Authorization" not in request.headers
+                and "X-Amz-Signature" not in request.rel_url.query)
+
+    @staticmethod
+    def _request_conditions(request: web.Request) -> dict:
+        """Policy condition context shared by every authorization path
+        (single-object _auth and per-key bulk checks must not diverge)."""
+        return {"aws:SourceIp": request.remote or ""}
+
+    async def _authorized(self, access_key: str, action: str, bucket: str,
+                          obj: str, conditions: dict) -> bool:
+        """Combined IAM + bucket-policy decision, deny-wins across layers.
+        Used by _auth and by per-key authorization in bulk operations so
+        both paths enforce identical semantics.  access_key '*' (or empty)
+        means anonymous: the bucket policy alone decides."""
+        if not access_key or access_key == "*":
+            decision = await self._run(
+                self._bucket_policy_decision, "*", action, bucket, obj,
+                conditions)
+            return decision == "allow"
+        iam_decision = self.iam.evaluate(
+            access_key, action, bucket, obj, conditions=conditions,
+        )
+        allowed = iam_decision == "allow"
+        if iam_decision == "none" and bucket:
+            # no IAM statement matched: the bucket policy may grant
+            # (an explicit IAM Deny is final and never reaches here)
+            decision = await self._run(
+                self._bucket_policy_decision, access_key, action,
+                bucket, obj, conditions)
+            allowed = decision == "allow"
+        elif allowed and bucket:
+            # bucket-policy Deny overrides an IAM allow (deny-wins
+            # across layers), except for the root account
+            if access_key != self.iam.root.access_key:
+                decision = await self._run(
+                    self._bucket_policy_decision, access_key, action,
+                    bucket, obj, conditions)
+                allowed = decision != "deny"
+        return allowed
 
     def _bucket_policy_decision(self, account: str, action: str, bucket: str,
                                 obj: str, conditions: dict) -> str:
@@ -537,6 +558,22 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin):
             status = root.findtext(f"{{{XMLNS}}}Status") or root.findtext("Status")
         except ET.ParseError:
             raise S3Error("MalformedXML")
+        if status not in ("Enabled", "Suspended"):
+            raise S3Error("MalformedXML")
+        if status != "Enabled":
+            # suspending versioning on a lock-enabled bucket would let an
+            # unversioned DELETE hard-delete WORM-protected objects
+            # (reference guard: cmd/bucket-versioning-handler.go:66)
+            if await self._run(self.meta.object_lock_enabled, bucket):
+                raise S3Error(
+                    "InvalidBucketState",
+                    "An Object Lock configuration is present on this bucket,"
+                    " so the versioning state cannot be changed.")
+            if await self._run(self.meta.replication_config, bucket):
+                raise S3Error(
+                    "InvalidBucketState",
+                    "A replication configuration is present on this bucket,"
+                    " so the versioning state cannot be suspended.")
         setter = getattr(self.api, "set_versioning", None)
         if setter is None:
             raise S3Error("NotImplemented")
@@ -700,21 +737,30 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin):
     async def delete_objects(self, request: web.Request) -> web.Response:
         body = await request.read()
         bucket = self._bucket(request)
-        ctx = await self._auth(request, hashlib.sha256(body).hexdigest())
+        if self._is_anonymous(request):
+            # anonymous bulk delete: allowed iff the bucket policy grants
+            # s3:DeleteObject, checked per key below — same as anonymous
+            # single-object DELETE
+            account = "*"
+        else:
+            ctx = await self._auth(request, hashlib.sha256(body).hexdigest())
+            account = ctx.access_key
         try:
             root = ET.fromstring(body)
         except ET.ParseError:
             raise S3Error("MalformedXML")
         ns = f"{{{XMLNS}}}"
+        conditions = self._request_conditions(request)
         versioned = await self._versioned(bucket)
         results = []
         for obj in root.findall(f"{ns}Object") + root.findall("Object"):
             key = obj.findtext(f"{ns}Key") or obj.findtext("Key") or ""
             vid = obj.findtext(f"{ns}VersionId") or obj.findtext("VersionId") or ""
-            # per-key authorization: object-scoped Deny statements must
-            # apply to bulk deletes exactly as to single DELETEs
-            if not self.iam.is_allowed(ctx.access_key, "s3:DeleteObject",
-                                       bucket, key):
+            # per-key authorization: the combined IAM + bucket-policy
+            # decision, exactly as for single-object DELETE (bucket-policy
+            # grants honored, object-scoped Denies enforced)
+            if not await self._authorized(
+                    account, "s3:DeleteObject", bucket, key, conditions):
                 results.append(
                     f"<Error><Key>{escape(key)}</Key>"
                     f"<Code>AccessDenied</Code>"
@@ -723,7 +769,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin):
                 continue
             try:
                 await self.enforce_retention_for_delete(
-                    request, bucket, key, vid, ctx.access_key)
+                    request, bucket, key, vid, account)
             except S3Error as s3e:
                 results.append(
                     f"<Error><Key>{escape(key)}</Key><Code>{s3e.code}</Code>"

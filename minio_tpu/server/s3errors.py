@@ -57,6 +57,7 @@ S3_ERRORS = {
     "ObjectLocked": (403, "Object is WORM protected and cannot be overwritten or deleted."),
     "NoSuchObjectLockConfiguration": (404, "The specified object does not have an ObjectLock configuration."),
     "BucketQuotaExceeded": (409, "Bucket quota exceeded."),
+    "InvalidBucketState": (409, "The request is not valid with the current state of the bucket."),
     "RestoreAlreadyInProgress": (409, "Object restore is already in progress."),
     "InvalidObjectState": (403, "The operation is not valid for the current state of the object."),
     "SelectParseError": (400, "The SQL expression contains an error."),

@@ -2,9 +2,12 @@
 
 Reference: cmd/encryption-v1.go (EncryptRequest :324, DecryptRequest,
 ParseSSECustomerRequest), internal/crypto/sse-c.go, sse-s3.go.  The KMS
-master key persists in the cluster system volume so restarts keep
-decrypting (reference: KES or MINIO_KMS_SECRET_KEY; here the single-key
-LocalKMS).
+master key is sourced from the MINIO_KMS_SECRET_KEY env var like the
+reference (KES or MINIO_KMS_SECRET_KEY) and is never written to the data
+drives — a persisted plaintext master key on the same drives as the
+sealed object keys would give anyone with drive access every SSE-S3
+object.  Without a configured key, SSE-S3 requests fail with
+KMSNotConfigured.
 """
 
 from __future__ import annotations
@@ -13,6 +16,7 @@ import base64
 import binascii
 import hashlib
 import json
+import os
 
 from minio_tpu.crypto import LocalKMS, sse
 from minio_tpu.storage import errors as st_errors
@@ -26,10 +30,24 @@ SSEC_KEY_HDR = "x-amz-server-side-encryption-customer-key"
 SSEC_MD5_HDR = "x-amz-server-side-encryption-customer-key-md5"
 
 KMS_CONFIG_PATH = "config/kms/master.json"
+KMS_ENV = "MINIO_KMS_SECRET_KEY"
 
 
-def load_or_create_kms(object_layer) -> LocalKMS:
-    """Load the persisted master key, or create+persist one on first boot."""
+def load_kms(object_layer) -> LocalKMS | None:
+    """KMS master key from the environment; None disables SSE-S3.
+
+    MINIO_KMS_SECRET_KEY takes the reference's `key-id:base64(32-byte)`
+    format.  As a legacy fallback, a key persisted on the drives by an
+    earlier release is still READ (so existing SSE-S3 objects stay
+    decryptable) but a new key is never generated or written to disk.
+    """
+    spec = os.environ.get(KMS_ENV, "")
+    if spec:
+        try:
+            return LocalKMS.from_env_value(spec)
+        except Exception as e:
+            raise ValueError(
+                f"{KMS_ENV} must be 'key-id:base64(32 bytes)': {e}")
     pool = getattr(object_layer, "pools", [object_layer])[0]
     disks = [d for d in pool.all_disks if d is not None and d.is_online()]
     for d in disks:
@@ -38,17 +56,7 @@ def load_or_create_kms(object_layer) -> LocalKMS:
             return LocalKMS(doc["key_id"], base64.b64decode(doc["key"]))
         except (st_errors.StorageError, ValueError, KeyError):
             continue
-    kms = LocalKMS.generate()
-    raw = json.dumps({
-        "key_id": kms.key_id,
-        "key": base64.b64encode(kms._master).decode(),
-    }).encode()
-    for d in disks:
-        try:
-            d.write_all(SYSTEM_VOL, KMS_CONFIG_PATH, raw)
-        except st_errors.StorageError:
-            continue
-    return kms
+    return None
 
 
 def parse_ssec_key(headers) -> bytes | None:
@@ -91,13 +99,24 @@ class SSEMixin:
             if hdr not in ("AES256", "aws:kms"):
                 raise S3Error("InvalidArgument",
                               f"unsupported SSE algorithm {hdr}")
+            if self.kms is None:
+                # reference ErrKMSNotConfigured renders as 501 NotImplemented
+                raise S3Error("NotImplemented",
+                              "Server side encryption specified but KMS "
+                              "is not configured")
             return "SSE-S3", None
         # bucket-default encryption config applies SSE-S3
         try:
             from minio_tpu.bucket import metadata as bm
 
             if self.meta.get_config(bucket, bm.SSE_CONFIG):
+                if self.kms is None:
+                    raise S3Error("NotImplemented",
+                                  "Bucket default encryption is set but "
+                                  "KMS is not configured")
                 return "SSE-S3", None
+        except S3Error:
+            raise
         except Exception:
             pass
         return "", None

@@ -5,7 +5,9 @@ cmd/test-utils_test.go:294)."""
 from __future__ import annotations
 
 import asyncio
+import base64
 import http.client
+import os
 import threading
 import urllib.parse
 
@@ -28,6 +30,12 @@ class Resp:
 class S3TestServer:
     def __init__(self, root: str, n_drives: int = 4,
                  access_key: str = "testadmin", secret_key: str = "testsecret"):
+        # SSE-S3 needs a configured KMS master key (never persisted to the
+        # drives); give tests a deterministic one unless a test overrides.
+        os.environ.setdefault(
+            "MINIO_KMS_SECRET_KEY",
+            "test-key:" + base64.b64encode(b"\x07" * 32).decode(),
+        )
         self.ak, self.sk = access_key, secret_key
         disks = [LocalStorage(f"{root}/d{i}") for i in range(n_drives)]
         self.pools = ErasureServerPools([ErasureSets(disks)])

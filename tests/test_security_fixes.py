@@ -1,0 +1,316 @@
+"""Regression tests for the round-1 advisor security findings (ADVICE.md):
+
+1. put_versioning must reject suspension on object-lock / replication
+   buckets (WORM bypass; reference cmd/bucket-versioning-handler.go:66).
+2. A session policy that doesn't allow an action must DENY it — a bucket
+   policy must not widen a session-restricted STS credential.
+3. delete_objects per-key authorization must use the combined
+   IAM + bucket-policy decision (grants honored, denies enforced).
+4. The KMS master key comes from MINIO_KMS_SECRET_KEY and is never
+   persisted in plaintext on the data drives; SSE-S3 without a
+   configured key fails with KMSNotConfigured.
+"""
+
+import base64
+import json
+import os
+
+import pytest
+
+from minio_tpu.iam import IAMSys
+from minio_tpu.erasure.sets import ErasureSets, ErasureServerPools
+from minio_tpu.storage.local import LocalStorage
+
+from .s3_harness import S3TestServer
+
+
+def make_pools(tmp_path, n=4):
+    disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(n)]
+    return ErasureServerPools([ErasureSets(disks)])
+
+
+def _q(qs):
+    return [tuple(p.partition("=")[::2]) for p in qs.split("&")]
+
+
+VERS_SUSPEND = (
+    b'<VersioningConfiguration>'
+    b'<Status>Suspended</Status></VersioningConfiguration>'
+)
+VERS_ENABLE = (
+    b'<VersioningConfiguration>'
+    b'<Status>Enabled</Status></VersioningConfiguration>'
+)
+OL_CONFIG = (
+    b'<ObjectLockConfiguration><ObjectLockEnabled>Enabled'
+    b'</ObjectLockEnabled></ObjectLockConfiguration>'
+)
+
+
+class TestVersioningSuspensionGuards:
+    @pytest.fixture
+    def srv(self, tmp_path):
+        s = S3TestServer(str(tmp_path))
+        yield s
+        s.close()
+
+    def test_suspend_rejected_on_object_lock_bucket(self, srv):
+        srv.request("PUT", "/wormb")
+        assert srv.request("PUT", "/wormb", query=_q("object-lock"),
+                           data=OL_CONFIG).status == 200
+        r = srv.request("PUT", "/wormb", query=_q("versioning"),
+                        data=VERS_SUSPEND)
+        assert r.status == 409, r.text()
+        assert "InvalidBucketState" in r.text()
+        # versioning is still on: unversioned delete of a locked object
+        # creates a delete marker rather than hard-deleting
+        r = srv.request("GET", "/wormb", query=_q("versioning"))
+        assert "<Status>Enabled</Status>" in r.text()
+        # re-enabling (a no-op) is still fine
+        assert srv.request("PUT", "/wormb", query=_q("versioning"),
+                           data=VERS_ENABLE).status == 200
+
+    def test_suspend_rejected_when_replication_configured(self, srv):
+        srv.request("PUT", "/replsrc")
+        assert srv.request("PUT", "/replsrc", query=_q("versioning"),
+                           data=VERS_ENABLE).status == 200
+        rc = (b'<ReplicationConfiguration><Rule><ID>r</ID>'
+              b'<Status>Enabled</Status><Priority>1</Priority>'
+              b'<DeleteMarkerReplication><Status>Disabled</Status>'
+              b'</DeleteMarkerReplication>'
+              b'<Destination><Bucket>arn:aws:s3:::replb</Bucket>'
+              b'</Destination></Rule></ReplicationConfiguration>')
+        assert srv.request("PUT", "/replsrc", query=_q("replication"),
+                           data=rc).status == 200
+        r = srv.request("PUT", "/replsrc", query=_q("versioning"),
+                        data=VERS_SUSPEND)
+        assert r.status == 409
+        assert "InvalidBucketState" in r.text()
+
+    def test_suspend_allowed_on_plain_bucket(self, srv):
+        srv.request("PUT", "/plainb")
+        assert srv.request("PUT", "/plainb", query=_q("versioning"),
+                           data=VERS_ENABLE).status == 200
+        assert srv.request("PUT", "/plainb", query=_q("versioning"),
+                           data=VERS_SUSPEND).status == 200
+
+    def test_bogus_status_rejected(self, srv):
+        srv.request("PUT", "/vb2")
+        bad = (b'<VersioningConfiguration><Status>Paused</Status>'
+               b'</VersioningConfiguration>')
+        r = srv.request("PUT", "/vb2", query=_q("versioning"), data=bad)
+        assert r.status == 400
+
+
+class TestSessionPolicyNotWidened:
+    def test_unit_session_policy_nonmatch_is_deny(self, tmp_path):
+        iam = IAMSys(make_pools(tmp_path), "root", "rootsecret")
+        iam.add_user("frank", "franksecret", policies=["readwrite"])
+        restrict = json.dumps({
+            "Statement": [{"Effect": "Allow", "Action": "s3:GetObject",
+                           "Resource": "arn:aws:s3:::onlythis/*"}],
+        })
+        tmp = iam.assume_role("frank", duration=900,
+                              session_policy=restrict)
+        # matching statement: allow
+        assert iam.evaluate(tmp.access_key, "s3:GetObject",
+                            "onlythis", "k") == "allow"
+        # NO matching statement must be a hard deny, not 'none' — 'none'
+        # would let a bucket policy grant what the session policy withheld
+        assert iam.evaluate(tmp.access_key, "s3:GetObject",
+                            "other", "k") == "deny"
+        assert iam.evaluate(tmp.access_key, "s3:PutObject",
+                            "onlythis", "k") == "deny"
+
+    def test_session_policy_enforced_when_parent_decision_is_none(
+            self, tmp_path):
+        # parent has NO matching IAM statement (base='none'); the session
+        # policy must still gate the action — previously evaluate()
+        # returned 'none' before reading the session policy, so a bucket
+        # policy could grant what the session policy withheld
+        iam = IAMSys(make_pools(tmp_path), "root", "rootsecret")
+        iam.add_user("nina", "ninasecret1")  # no policies: base == 'none'
+        restrict = json.dumps({
+            "Statement": [{"Effect": "Allow", "Action": "s3:GetObject",
+                           "Resource": "arn:aws:s3:::onlythis/*"}],
+        })
+        tmp = iam.assume_role("nina", duration=900,
+                              session_policy=restrict)
+        # session policy does not allow DeleteObject anywhere => hard deny
+        assert iam.evaluate(tmp.access_key, "s3:DeleteObject",
+                            "onlythis", "k") == "deny"
+        assert iam.evaluate(tmp.access_key, "s3:GetObject",
+                            "other", "k") == "deny"
+        # session policy allows GetObject on onlythis/*, parent grants
+        # nothing => 'none' (bucket policy may grant, session permits)
+        assert iam.evaluate(tmp.access_key, "s3:GetObject",
+                            "onlythis", "k") == "none"
+
+    def test_http_bucket_policy_cannot_widen_session(self, tmp_path):
+        srv = S3TestServer(str(tmp_path))
+        try:
+            srv.iam.add_user("gail", "gailsecret1", policies=["readwrite"])
+            restrict = json.dumps({
+                "Statement": [{"Effect": "Allow",
+                               "Action": "s3:GetObject",
+                               "Resource": "arn:aws:s3:::scoped/*"}],
+            })
+            tmp = srv.iam.assume_role("gail", duration=900,
+                                      session_policy=restrict)
+            sk = srv.iam.get_secret(tmp.access_key)
+            srv.request("PUT", "/open")
+            srv.request("PUT", "/open/o.txt", data=b"wide")
+            # bucket policy grants GetObject to everyone on /open
+            pol = json.dumps({
+                "Statement": [{
+                    "Effect": "Allow", "Principal": {"AWS": ["*"]},
+                    "Action": ["s3:GetObject"],
+                    "Resource": ["arn:aws:s3:::open/*"],
+                }],
+            }).encode()
+            assert srv.request("PUT", "/open", query=_q("policy"),
+                               data=pol).status == 204
+            # anonymous gets it (policy works)...
+            r = srv.raw_request("GET", "/open/o.txt",
+                                headers={"host": srv.host})
+            assert r.status == 200
+            # ...but the session-restricted credential must NOT
+            r = srv.request("GET", "/open/o.txt",
+                            creds=(tmp.access_key, sk))
+            assert r.status == 403, (
+                "bucket policy widened a session-restricted credential")
+        finally:
+            srv.close()
+
+
+class TestBulkDeleteCombinedDecision:
+    def test_bucket_policy_grant_applies_to_bulk_delete(self, tmp_path):
+        srv = S3TestServer(str(tmp_path))
+        try:
+            # user with NO IAM policies: single-object DELETE works only
+            # via the bucket policy; bulk delete must match
+            srv.iam.add_user("henry", "henrysecret1")
+            srv.request("PUT", "/bp-del")
+            for k in ("a", "b"):
+                srv.request("PUT", f"/bp-del/{k}", data=b"v")
+            pol = json.dumps({
+                "Statement": [{
+                    "Effect": "Allow", "Principal": {"AWS": ["*"]},
+                    "Action": ["s3:DeleteObject"],
+                    "Resource": ["arn:aws:s3:::bp-del/*"],
+                }],
+            }).encode()
+            assert srv.request("PUT", "/bp-del", query=_q("policy"),
+                               data=pol).status == 204
+            body = (b"<Delete><Object><Key>a</Key></Object>"
+                    b"<Object><Key>b</Key></Object></Delete>")
+            r = srv.request("POST", "/bp-del", data=body,
+                            query=[("delete", "")],
+                            creds=("henry", "henrysecret1"))
+            assert r.status == 200
+            assert "<Deleted><Key>a</Key></Deleted>" in r.text()
+            assert "<Deleted><Key>b</Key></Deleted>" in r.text()
+            assert "AccessDenied" not in r.text()
+        finally:
+            srv.close()
+
+    def test_anonymous_bulk_delete_via_bucket_policy(self, tmp_path):
+        srv = S3TestServer(str(tmp_path))
+        try:
+            srv.request("PUT", "/anon-del")
+            for k in ("x", "keep"):
+                srv.request("PUT", f"/anon-del/{k}", data=b"v")
+            body = b"<Delete><Object><Key>x</Key></Object></Delete>"
+            # without a bucket policy, anonymous bulk delete is denied
+            r = srv.raw_request("POST", "/anon-del?delete=", data=body,
+                                headers={"host": srv.host})
+            assert r.status == 200  # per-key errors, not request-level
+            assert "AccessDenied" in r.text()
+            pol = json.dumps({
+                "Statement": [{
+                    "Effect": "Allow", "Principal": {"AWS": ["*"]},
+                    "Action": ["s3:DeleteObject"],
+                    "Resource": ["arn:aws:s3:::anon-del/x"],
+                }],
+            }).encode()
+            srv.request("PUT", "/anon-del", query=_q("policy"), data=pol)
+            r = srv.raw_request("POST", "/anon-del?delete=", data=body,
+                                headers={"host": srv.host})
+            assert r.status == 200, r.text()
+            assert "<Deleted><Key>x</Key></Deleted>" in r.text()
+            # keys outside the policy's resource stay protected
+            body2 = b"<Delete><Object><Key>keep</Key></Object></Delete>"
+            r = srv.raw_request("POST", "/anon-del?delete=", data=body2,
+                                headers={"host": srv.host})
+            assert "AccessDenied" in r.text()
+            assert srv.request("GET", "/anon-del/keep").status == 200
+        finally:
+            srv.close()
+
+
+class TestKMSFromEnv:
+    SSE_HDR = "x-amz-server-side-encryption"
+
+    def test_sse_s3_roundtrip_with_env_key(self, tmp_path):
+        srv = S3TestServer(str(tmp_path))  # harness sets the env key
+        try:
+            srv.request("PUT", "/sseb")
+            r = srv.request("PUT", "/sseb/enc.txt", data=b"secret payload",
+                            headers={self.SSE_HDR: "AES256"})
+            assert r.status == 200, r.text()
+            r = srv.request("GET", "/sseb/enc.txt")
+            assert r.status == 200
+            assert r.body == b"secret payload"
+            assert r.headers.get(self.SSE_HDR) == "AES256"
+            # the master key must not be persisted anywhere on the drives
+            for root, _dirs, files in os.walk(str(tmp_path)):
+                assert "master.json" not in files, (
+                    f"plaintext KMS master key written under {root}")
+        finally:
+            srv.close()
+
+    def test_sse_s3_fails_without_kms(self, tmp_path, monkeypatch):
+        # constructing the server with no env key => SSE-S3 disabled
+        monkeypatch.setenv("MINIO_KMS_SECRET_KEY", "")
+        monkeypatch.delenv("MINIO_KMS_SECRET_KEY", raising=False)
+        # the harness setdefault must not resurrect it
+        monkeypatch.setattr(os.environ, "setdefault",
+                            lambda *a, **k: None)
+        srv = S3TestServer(str(tmp_path))
+        try:
+            assert srv.server.kms is None
+            srv.request("PUT", "/nokms")
+            r = srv.request("PUT", "/nokms/x", data=b"v",
+                            headers={self.SSE_HDR: "AES256"})
+            # reference ErrKMSNotConfigured maps to 501 NotImplemented
+            assert r.status == 501
+            assert "KMS is not configured" in r.text()
+            # plaintext puts still work
+            assert srv.request("PUT", "/nokms/plain", data=b"v").status == 200
+        finally:
+            srv.close()
+
+    def test_env_key_format_validated(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MINIO_KMS_SECRET_KEY", "not-a-valid-spec")
+        with pytest.raises(ValueError):
+            S3TestServer(str(tmp_path))
+
+    def test_legacy_persisted_key_still_readable(self, tmp_path,
+                                                 monkeypatch):
+        # an older release persisted config/kms/master.json: reading it
+        # keeps existing SSE-S3 objects decryptable, but nothing new is
+        # ever written
+        from minio_tpu.storage.local import SYSTEM_VOL
+
+        pools = make_pools(tmp_path)
+        raw = json.dumps({
+            "key_id": "legacy",
+            "key": base64.b64encode(b"\x05" * 32).decode(),
+        }).encode()
+        for d in pools.pools[0].all_disks:
+            d.write_all(SYSTEM_VOL, "config/kms/master.json", raw)
+        monkeypatch.delenv("MINIO_KMS_SECRET_KEY", raising=False)
+        from minio_tpu.server.sse_handlers import load_kms
+
+        kms = load_kms(pools)
+        assert kms is not None and kms.key_id == "legacy"
