@@ -88,7 +88,9 @@ class ClusterNode:
 
     def __init__(self, endpoints: list[str], my_address: str = "",
                  access_key: str = "minioadmin", secret_key: str = "minioadmin",
-                 region: str = "us-east-1", set_size: int | None = None):
+                 region: str = "us-east-1", set_size: int | None = None,
+                 start_services: bool = True,
+                 scan_interval: float = 60.0, heal_interval: float = 3600.0):
         self.secret = secret_key
         expanded: list[tuple[str | None, int | None, str]] = []
         for ep in endpoints:
@@ -144,6 +146,18 @@ class ClusterNode:
 
         self.s3 = S3Server(self.pools, access_key=access_key,
                            secret_key=secret_key, region=region)
+        self.s3.locker = self.locker
+        self.services = None
+        if start_services:
+            # the real server runs heal/MRF/scanner from boot (reference
+            # serverMain: initAutoHeal/initHealMRF/initDataScanner,
+            # cmd/server-main.go:528-585)
+            from minio_tpu.services import ServiceManager
+
+            self.services = ServiceManager(
+                self.pools, scan_interval=scan_interval,
+                heal_interval=heal_interval)
+            self.s3.attach_services(self.services)
         self.app = self.s3.app
         self.router = RpcRouter(secret_key)
         register_storage_rpc(self.router, self.local_drives)
@@ -154,6 +168,12 @@ class ClusterNode:
         # the health cache so the first real use re-probes immediately
         for c in self.peer_clients.values():
             c._last_check = 0.0
+
+    def close(self) -> None:
+        if self.services is not None:
+            self.services.close()
+        for c in self.peer_clients.values():
+            c.close()
 
     def _peer_info(self, args, body) -> dict:
         return {

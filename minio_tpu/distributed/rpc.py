@@ -92,6 +92,16 @@ class RpcClient:
     def endpoint(self) -> str:
         return f"{self.host}:{self.port}"
 
+    def close(self) -> None:
+        """Close idle pooled connections (in-flight ones close on return)."""
+        with self._lock:
+            pool, self._pool = self._pool, []
+        for conn in pool:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
     # -- health -------------------------------------------------------------
     def is_online(self) -> bool:
         # positive results cached HEALTH_INTERVAL; negative ones retried

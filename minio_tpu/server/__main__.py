@@ -31,6 +31,14 @@ def main(argv=None) -> int:
                     default=os.environ.get("MINIO_ROOT_PASSWORD", "minioadmin"))
     ap.add_argument("--region", default="us-east-1")
     ap.add_argument("--set-size", type=int, default=None)
+    ap.add_argument("--scan-interval", type=float,
+                    default=float(os.environ.get(
+                        "MINIO_TPU_SCAN_INTERVAL", "60")))
+    ap.add_argument("--heal-interval", type=float,
+                    default=float(os.environ.get(
+                        "MINIO_TPU_HEAL_INTERVAL", "3600")))
+    ap.add_argument("--no-services", action="store_true",
+                    help="do not start heal/MRF/scanner background services")
     args = ap.parse_args(argv)
 
     from aiohttp import web
@@ -41,6 +49,9 @@ def main(argv=None) -> int:
         args.endpoints, my_address=args.address,
         access_key=args.access_key, secret_key=args.secret_key,
         region=args.region, set_size=args.set_size,
+        start_services=not args.no_services,
+        scan_interval=args.scan_interval,
+        heal_interval=args.heal_interval,
     )
     info = node.pools.storage_info()["pools"][0]
     mode = "distributed" if node.distributed else "standalone"
@@ -69,7 +80,10 @@ def main(argv=None) -> int:
         threading.Thread(target=verify_with_retry, daemon=True).start()
 
     host, port = args.address.rsplit(":", 1)
-    web.run_app(node.app, host=host, port=int(port), print=None)
+    try:
+        web.run_app(node.app, host=host, port=int(port), print=None)
+    finally:
+        node.close()
     return 0
 
 

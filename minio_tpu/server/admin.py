@@ -1,0 +1,308 @@
+"""Admin API plane: heal control, server/storage/data-usage info, user &
+policy CRUD, top locks, service control.
+
+Reference: cmd/admin-router.go:40 (route table), cmd/admin-handlers.go
+(ServerInfoHandler, StorageInfoHandler, DataUsageInfoHandler),
+cmd/admin-heal-ops.go:280 (LaunchNewHealSequence / status polling),
+cmd/admin-handlers-users.go (user/policy CRUD).  Divergence from the
+reference: madmin encrypts credential-bearing bodies with the admin
+secret; here bodies are plain JSON over the SigV4-authenticated channel
+(which the reference also relies on for integrity).
+
+All admin requests must be SigV4-signed; the root account is always
+allowed, other accounts need an IAM policy granting the `admin:<Op>`
+action (reference cmd/admin-handler-utils.go checkAdminRequestAuth).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+
+from aiohttp import web
+
+from .s3errors import S3Error
+
+ADMIN_PREFIX = "/minio/admin/v3"
+
+
+class AdminMixin:
+    """Admin handlers; expects self.api, self.iam, self.services,
+    self.locker, self.executor from S3Server."""
+
+    def register_admin_routes(self, app: web.Application) -> None:
+        r = app.router
+        p = ADMIN_PREFIX
+        wrap = self._admin_wrap
+        r.add_get(f"{p}/info", wrap(self.admin_info, "ServerInfo"))
+        r.add_get(f"{p}/storageinfo", wrap(self.admin_storage_info, "StorageInfo"))
+        r.add_get(f"{p}/datausageinfo", wrap(self.admin_data_usage, "DataUsageInfo"))
+        r.add_get(f"{p}/top/locks", wrap(self.admin_top_locks, "TopLocksAdmin"))
+        r.add_post(f"{p}/service", wrap(self.admin_service, "ServiceRestart"))
+        # heal: POST launches / polls / stops (reference HealHandler takes
+        # bucket/prefix in the path and clientToken/forceStop in the query)
+        for path in (f"{p}/heal/", f"{p}/heal/{{bucket}}",
+                     f"{p}/heal/{{bucket}}/{{prefix:.*}}"):
+            r.add_post(path, wrap(self.admin_heal, "Heal"))
+        r.add_get(f"{p}/background-heal/status",
+                  wrap(self.admin_bg_heal_status, "Heal"))
+        # users / policies / groups / service accounts
+        r.add_put(f"{p}/add-user", wrap(self.admin_add_user, "CreateUser"))
+        r.add_delete(f"{p}/remove-user", wrap(self.admin_remove_user, "DeleteUser"))
+        r.add_get(f"{p}/list-users", wrap(self.admin_list_users, "ListUsers"))
+        r.add_put(f"{p}/set-user-status",
+                  wrap(self.admin_set_user_status, "EnableUser"))
+        r.add_put(f"{p}/add-canned-policy",
+                  wrap(self.admin_add_policy, "CreatePolicy"))
+        r.add_delete(f"{p}/remove-canned-policy",
+                     wrap(self.admin_remove_policy, "DeletePolicy"))
+        r.add_get(f"{p}/list-canned-policies",
+                  wrap(self.admin_list_policies, "ListUserPolicies"))
+        r.add_put(f"{p}/set-user-or-group-policy",
+                  wrap(self.admin_set_policy_mapping, "AttachUserOrGroupPolicy"))
+        r.add_put(f"{p}/update-group-members",
+                  wrap(self.admin_update_group, "AddUserToGroup"))
+        r.add_get(f"{p}/groups", wrap(self.admin_list_groups, "ListGroups"))
+        r.add_put(f"{p}/add-service-account",
+                  wrap(self.admin_add_service_account, "CreateServiceAccount"))
+
+    # ---------------------------------------------------------------- auth
+    def _admin_wrap(self, fn, op: str):
+        async def handler(request: web.Request) -> web.StreamResponse:
+            try:
+                body = await request.read()
+                await self._admin_auth(request, body, op)
+                return await fn(request, body)
+            except S3Error as e:
+                return web.Response(
+                    status=e.status,
+                    body=json.dumps({"Code": e.code,
+                                     "Message": e.message}).encode(),
+                    content_type="application/json",
+                )
+        return handler
+
+    async def _admin_auth(self, request: web.Request, body: bytes,
+                          op: str) -> None:
+        if self._is_anonymous(request):
+            raise S3Error("AccessDenied", "admin API requires signing")
+        ctx = await self._auth(request, hashlib.sha256(body).hexdigest())
+        if ctx.access_key == self.iam.root.access_key:
+            return
+        # service accounts / STS credentials never get admin access, even
+        # when parented to root — a leaked app credential must not become
+        # full admin (reference checkAdminRequestAuth denies svc/sts)
+        ident = self.iam.users.get(ctx.access_key)
+        if ident is None or ident.kind in ("svc", "sts"):
+            raise S3Error("AccessDenied",
+                          "admin API denied to service/STS credentials")
+        if self.iam.evaluate(ctx.access_key, f"admin:{op}") != "allow":
+            raise S3Error("AccessDenied", f"admin:{op} denied")
+
+    def _json(self, obj, status: int = 200) -> web.Response:
+        return web.Response(status=status, body=json.dumps(obj).encode(),
+                            content_type="application/json")
+
+    def _services_or_503(self):
+        svcs = getattr(self, "services", None)
+        if svcs is None:
+            raise S3Error("XMinioServerNotInitialized",
+                          "background services are not running")
+        return svcs
+
+    # ---------------------------------------------------------------- info
+    async def admin_info(self, request: web.Request, body: bytes):
+        si = await self._run(self.api.storage_info)
+        drives = [d for pool in si["pools"] for d in pool["disks"]]
+        info = {
+            "mode": "online",
+            "deploymentID": si["pools"][0].get("deployment_id", ""),
+            "region": self.region,
+            "uptimeSeconds": int(time.time() - self._start_time),
+            "drives": {
+                "total": len(drives),
+                "online": sum(1 for d in drives if d.get("online")),
+                "offline": sum(1 for d in drives if not d.get("online")),
+                "healing": sum(1 for d in drives if d.get("healing")),
+            },
+            "pools": [{
+                "sets": p["sets"], "drivesPerSet": p["drives_per_set"],
+            } for p in si["pools"]],
+        }
+        svcs = getattr(self, "services", None)
+        if svcs is not None:
+            info["usage"] = svcs.scanner.data_usage_info()
+        return self._json(info)
+
+    async def admin_storage_info(self, request: web.Request, body: bytes):
+        return self._json(await self._run(self.api.storage_info))
+
+    async def admin_data_usage(self, request: web.Request, body: bytes):
+        svcs = self._services_or_503()
+        return self._json(svcs.scanner.data_usage_info())
+
+    async def admin_top_locks(self, request: web.Request, body: bytes):
+        locker = getattr(self, "locker", None)
+        locks = locker.top_locks() if locker is not None else []
+        return self._json({"locks": locks})
+
+    async def admin_service(self, request: web.Request, body: bytes):
+        action = request.rel_url.query.get("action", "")
+        if action not in ("restart", "stop"):
+            raise S3Error("InvalidArgument", f"unknown action {action!r}")
+        # in-process server: acknowledge; the supervisor owns the lifecycle
+        return self._json({"action": action, "accepted": True})
+
+    # ---------------------------------------------------------------- heal
+    async def admin_heal(self, request: web.Request, body: bytes):
+        svcs = self._services_or_503()
+        bucket = request.match_info.get("bucket", "")
+        prefix = request.match_info.get("prefix", "")
+        q = request.rel_url.query
+        token = q.get("clientToken", "")
+        if token:
+            if q.get("forceStop") == "true":
+                ok = svcs.heals.stop(token)
+                return self._json({"stopped": bool(ok)})
+            status = svcs.heals.get(token)
+            if status is None:
+                raise S3Error("InvalidArgument", "unknown heal token")
+            return self._json(status.to_dict())
+        deep = False
+        if body:
+            try:
+                opts = json.loads(body)
+                deep = bool(opts.get("scanMode") == 2 or opts.get("deep"))
+            except ValueError:
+                raise S3Error("InvalidArgument", "heal options must be JSON")
+        status = await self._run(svcs.heals.launch, bucket, prefix, deep)
+        return self._json({"clientToken": status.heal_id, "started": True})
+
+    async def admin_bg_heal_status(self, request: web.Request, body: bytes):
+        svcs = self._services_or_503()
+        return self._json({
+            "mrf": svcs.mrf.stats.to_dict(),
+            "scanner": {
+                "cycles": svcs.scanner.cycles,
+                "last_update": svcs.scanner.usage.last_update,
+            },
+            "heals": svcs.heals.statuses(),
+        })
+
+    # ------------------------------------------------------- users/policies
+    async def admin_add_user(self, request: web.Request, body: bytes):
+        ak = request.rel_url.query.get("accessKey", "")
+        if not ak:
+            raise S3Error("InvalidArgument", "accessKey required")
+        try:
+            doc = json.loads(body)
+            sk = doc["secretKey"]
+        except (ValueError, KeyError):
+            raise S3Error("InvalidArgument",
+                          'body must be {"secretKey": ...}')
+        policies = doc.get("policies", [])
+        try:
+            await self._run(self.iam.add_user, ak, sk, policies)
+        except Exception as e:
+            raise S3Error("InvalidArgument", str(e))
+        return self._json({"accessKey": ak})
+
+    async def admin_remove_user(self, request: web.Request, body: bytes):
+        ak = request.rel_url.query.get("accessKey", "")
+        try:
+            await self._run(self.iam.remove_user, ak)
+        except Exception as e:
+            raise S3Error("InvalidArgument", str(e))
+        return self._json({"removed": ak})
+
+    async def admin_list_users(self, request: web.Request, body: bytes):
+        return self._json({"users": await self._run(self.iam.list_users)})
+
+    async def admin_set_user_status(self, request: web.Request, body: bytes):
+        q = request.rel_url.query
+        ak = q.get("accessKey", "")
+        status = q.get("status", "")
+        if status not in ("enabled", "disabled"):
+            raise S3Error("InvalidArgument", "status must be enabled|disabled")
+        try:
+            await self._run(self.iam.set_user_status, ak,
+                            status == "enabled")
+        except Exception as e:
+            raise S3Error("InvalidArgument", str(e))
+        return self._json({"accessKey": ak, "status": status})
+
+    async def admin_add_policy(self, request: web.Request, body: bytes):
+        name = request.rel_url.query.get("name", "")
+        if not name:
+            raise S3Error("InvalidArgument", "policy name required")
+        try:
+            await self._run(self.iam.set_policy, name, body)
+        except Exception as e:
+            raise S3Error("MalformedPolicy", str(e))
+        return self._json({"policy": name})
+
+    async def admin_remove_policy(self, request: web.Request, body: bytes):
+        name = request.rel_url.query.get("name", "")
+        try:
+            await self._run(self.iam.delete_policy, name)
+        except Exception as e:
+            raise S3Error("InvalidArgument", str(e))
+        return self._json({"removed": name})
+
+    async def admin_list_policies(self, request: web.Request, body: bytes):
+        return self._json(
+            {"policies": await self._run(self.iam.list_policies)})
+
+    async def admin_set_policy_mapping(self, request: web.Request,
+                                       body: bytes):
+        q = request.rel_url.query
+        names = [n for n in q.get("policyName", "").split(",") if n]
+        target = q.get("userOrGroup", "")
+        is_group = q.get("isGroup") == "true"
+        try:
+            if is_group:
+                await self._run(self.iam.attach_group_policy, target, names)
+            else:
+                await self._run(self.iam.attach_policy, target, names)
+        except Exception as e:
+            raise S3Error("InvalidArgument", str(e))
+        return self._json({"userOrGroup": target, "policies": names})
+
+    async def admin_update_group(self, request: web.Request, body: bytes):
+        try:
+            doc = json.loads(body)
+            group = doc["group"]
+            members = doc.get("members", [])
+            remove = bool(doc.get("isRemove"))
+        except (ValueError, KeyError):
+            raise S3Error("InvalidArgument",
+                          'body must be {"group":..., "members":[...]}')
+        fn = (self.iam.remove_group_members if remove
+              else self.iam.add_group_members)
+        try:
+            await self._run(fn, group, members)
+        except Exception as e:
+            raise S3Error("InvalidArgument", str(e))
+        return self._json({"group": group})
+
+    async def admin_list_groups(self, request: web.Request, body: bytes):
+        return self._json({"groups": await self._run(self.iam.list_groups)})
+
+    async def admin_add_service_account(self, request: web.Request,
+                                        body: bytes):
+        try:
+            doc = json.loads(body) if body else {}
+        except ValueError:
+            raise S3Error("InvalidArgument", "body must be JSON")
+        parent = doc.get("targetUser", "")
+        policy = doc.get("policy", "")
+        if not parent:
+            raise S3Error("InvalidArgument", "targetUser required")
+        try:
+            ident = await self._run(
+                self.iam.create_service_account, parent, policy)
+        except Exception as e:
+            raise S3Error("InvalidArgument", str(e))
+        return self._json({"accessKey": ident.access_key,
+                           "secretKey": ident.secret_key})

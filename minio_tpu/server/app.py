@@ -37,6 +37,7 @@ from .object_extras import (
     ObjectExtraHandlers, parse_tag_query,
 )
 from .s3errors import S3Error, from_storage_error
+from .admin import AdminMixin
 from .sse_handlers import SSEMixin, load_kms
 
 XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
@@ -153,11 +154,12 @@ class _QueuePipeReader(io.RawIOBase):
         return out
 
 
-class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin):
+class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
     def __init__(self, object_layer, access_key: str = "minioadmin",
                  secret_key: str = "minioadmin", region: str = "us-east-1",
                  max_concurrency: int = 64, iam=None):
         import concurrent.futures as cf
+        import time as time_mod
         from minio_tpu.bucket import BucketMetadataSys
         from minio_tpu.iam import IAMSys
 
@@ -168,6 +170,9 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin):
         self.meta = BucketMetadataSys(object_layer)
         self.kms = load_kms(object_layer)
         self.region = region
+        self.services = None   # ServiceManager, via attach_services()
+        self.locker = None     # LocalLocker, set by ClusterNode
+        self._start_time = time_mod.time()
         self.sem = asyncio.Semaphore(max_concurrency)
         # Dedicated pool sized to the request semaphore so a full house of
         # blocking object-layer calls can never starve body-feed tasks
@@ -176,9 +181,17 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin):
             max_workers=max_concurrency + 4, thread_name_prefix="s3-api"
         )
         self.app = web.Application(client_max_size=1 << 30)
+        # fixed-prefix routes (admin plane) win over the S3 catch-alls
+        self.register_admin_routes(self.app)
         self.app.router.add_route("*", "/", self.dispatch_root)
         self.app.router.add_route("*", "/{bucket}", self.dispatch_bucket)
         self.app.router.add_route("*", "/{bucket}/{key:.*}", self.dispatch_object)
+
+    def attach_services(self, services) -> None:
+        """Adopt the background ServiceManager (heal/MRF/scanner) so the
+        admin plane can reach it (reference: serverMain starting
+        initAutoHeal/initHealMRF/initDataScanner, cmd/server-main.go:528)."""
+        self.services = services
 
     # ------------------------------------------------------------------ util
     async def _run(self, fn, *args, **kw):
@@ -1259,7 +1272,13 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin):
         ))
 
 
-def make_app(object_layer, **kw) -> web.Application:
+def make_app(object_layer, start_services: bool = False,
+             scan_interval: float = 60.0, **kw) -> web.Application:
     srv = S3Server(object_layer, **kw)
+    if start_services:
+        from minio_tpu.services import ServiceManager
+
+        srv.attach_services(
+            ServiceManager(object_layer, scan_interval=scan_interval))
     srv.app["s3_server"] = srv
     return srv.app

@@ -29,7 +29,8 @@ class Resp:
 
 class S3TestServer:
     def __init__(self, root: str, n_drives: int = 4,
-                 access_key: str = "testadmin", secret_key: str = "testsecret"):
+                 access_key: str = "testadmin", secret_key: str = "testsecret",
+                 start_services: bool = False, scan_interval: float = 60.0):
         # SSE-S3 needs a configured KMS master key (never persisted to the
         # drives); give tests a deterministic one unless a test overrides.
         os.environ.setdefault(
@@ -40,7 +41,9 @@ class S3TestServer:
         disks = [LocalStorage(f"{root}/d{i}") for i in range(n_drives)]
         self.pools = ErasureServerPools([ErasureSets(disks)])
         self.app = make_app(self.pools, access_key=access_key,
-                            secret_key=secret_key)
+                            secret_key=secret_key,
+                            start_services=start_services,
+                            scan_interval=scan_interval)
         self.server = self.app["s3_server"]
         self.iam = self.server.iam
         self._loop = asyncio.new_event_loop()
@@ -67,6 +70,9 @@ class S3TestServer:
         self._loop.run_forever()
 
     def close(self):
+        if self.server.services is not None:
+            self.server.services.close()
+
         async def stop():
             await self._runner.cleanup()
 

@@ -1,0 +1,255 @@
+"""Admin API plane + background services running in the real server.
+
+Reference: cmd/admin-router.go:40, cmd/admin-heal-ops.go:280,
+cmd/admin-handlers-users.go, cmd/server-main.go:528-585 (serverMain
+starting heal/MRF/scanner).  The headline scenario (VERDICT r1 #2): boot
+the real HTTP server WITH services, kill a shard on one drive, and watch
+it get healed with status visible through the admin endpoints.
+"""
+
+import json
+import os
+import shutil
+import time
+
+import pytest
+
+from .s3_harness import S3TestServer
+
+ADMIN = "/minio/admin/v3"
+
+
+@pytest.fixture()
+def srv(tmp_path):
+    s = S3TestServer(str(tmp_path), n_drives=6, start_services=True,
+                     scan_interval=0.3)
+    yield s
+    s.close()
+
+
+def _wait(cond, timeout=15.0, step=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return cond()
+
+
+class TestAdminAuth:
+    def test_anonymous_denied(self, srv):
+        r = srv.raw_request("GET", f"{ADMIN}/info",
+                            headers={"host": srv.host})
+        assert r.status == 403
+
+    def test_non_root_without_admin_policy_denied(self, srv):
+        srv.iam.add_user("plainuser", "plainsecret1", policies=["readwrite"])
+        r = srv.request("GET", f"{ADMIN}/info",
+                        creds=("plainuser", "plainsecret1"))
+        assert r.status == 403
+
+    def test_non_root_with_admin_policy_allowed(self, srv):
+        srv.iam.set_policy("adminish", json.dumps({
+            "Statement": [{"Effect": "Allow", "Action": ["admin:*"],
+                           "Resource": ["*"]}],
+        }))
+        srv.iam.add_user("opsuser", "opssecret12", policies=["adminish"])
+        r = srv.request("GET", f"{ADMIN}/info",
+                        creds=("opsuser", "opssecret12"))
+        assert r.status == 200, r.text()
+
+    def test_root_allowed(self, srv):
+        assert srv.request("GET", f"{ADMIN}/info").status == 200
+
+    def test_service_account_of_root_denied(self, srv):
+        # a leaked app credential parented to root must NOT become admin
+        ident = srv.iam.create_service_account(srv.iam.root.access_key)
+        r = srv.request("GET", f"{ADMIN}/info",
+                        creds=(ident.access_key, ident.secret_key))
+        assert r.status == 403
+
+    def test_sts_credential_denied(self, srv):
+        srv.iam.set_policy("adminish2", json.dumps({
+            "Statement": [{"Effect": "Allow", "Action": ["admin:*"],
+                           "Resource": ["*"]}],
+        }))
+        srv.iam.add_user("stsadmin", "stssecret123", policies=["adminish2"])
+        tmp = srv.iam.assume_role("stsadmin", duration=900)
+        sk = srv.iam.get_secret(tmp.access_key)
+        r = srv.request("GET", f"{ADMIN}/info",
+                        creds=(tmp.access_key, sk))
+        assert r.status == 403
+
+    def test_add_user_shadowing_root_is_400(self, srv):
+        r = srv.request("PUT", f"{ADMIN}/add-user",
+                        query=[("accessKey", srv.ak)],
+                        data=json.dumps({"secretKey": "xsecret12345"}).encode())
+        assert r.status == 400
+        r = srv.request("PUT", f"{ADMIN}/add-user", query=[],
+                        data=json.dumps({"secretKey": "xsecret12345"}).encode())
+        assert r.status == 400
+
+
+class TestAdminInfo:
+    def test_info_shape(self, srv):
+        r = srv.request("GET", f"{ADMIN}/info")
+        info = json.loads(r.text())
+        assert info["drives"]["total"] == 6
+        assert info["drives"]["online"] == 6
+        assert info["pools"][0]["drivesPerSet"] == 6
+
+    def test_storage_info(self, srv):
+        r = srv.request("GET", f"{ADMIN}/storageinfo")
+        si = json.loads(r.text())
+        assert len(si["pools"][0]["disks"]) == 6
+
+    def test_data_usage_after_scan(self, srv):
+        srv.request("PUT", "/usageb")
+        srv.request("PUT", "/usageb/o1", data=b"x" * 1000)
+        srv.request("PUT", "/usageb/o2", data=b"y" * 2000)
+        assert _wait(lambda: json.loads(
+            srv.request("GET", f"{ADMIN}/datausageinfo").text()
+        ).get("bucketsUsage", {}).get("usageb", {}).get("size", 0) >= 3000)
+        usage = json.loads(srv.request("GET", f"{ADMIN}/datausageinfo").text())
+        assert usage["bucketsUsage"]["usageb"]["objects"] == 2
+
+    def test_service_action(self, srv):
+        r = srv.request("POST", f"{ADMIN}/service",
+                        query=[("action", "restart")])
+        assert r.status == 200
+        r = srv.request("POST", f"{ADMIN}/service",
+                        query=[("action", "bogus")])
+        assert r.status == 400
+
+    def test_top_locks_empty(self, srv):
+        r = srv.request("GET", f"{ADMIN}/top/locks")
+        assert r.status == 200
+        assert json.loads(r.text())["locks"] == []
+
+
+class TestHealOverAdminAPI:
+    def _kill_one_shard(self, srv, bucket, key):
+        """Remove the object's data entirely from one drive."""
+        killed = None
+        for i in range(6):
+            obj_dir = os.path.join(srv.pools.pools[0].all_disks[i].root
+                                   if hasattr(srv.pools.pools[0].all_disks[i],
+                                              "root") else "", bucket, key)
+            if os.path.isdir(obj_dir):
+                shutil.rmtree(obj_dir)
+                killed = obj_dir
+                break
+        assert killed, "no shard directory found to kill"
+        return killed
+
+    def test_heal_sequence_restores_killed_shard(self, srv):
+        srv.request("PUT", "/healb")
+        data = b"h" * 400_000
+        assert srv.request("PUT", "/healb/obj", data=data).status == 200
+        obj_dir = self._kill_one_shard(srv, "healb", "obj")
+        # launch a heal sequence over the bucket via the admin API
+        r = srv.request("POST", f"{ADMIN}/heal/healb")
+        assert r.status == 200, r.text()
+        token = json.loads(r.text())["clientToken"]
+        # poll status until finished
+        def done():
+            s = json.loads(srv.request(
+                "POST", f"{ADMIN}/heal/healb",
+                query=[("clientToken", token)]).text())
+            return s["state"] in ("finished", "stopped", "failed")
+        assert _wait(done)
+        s = json.loads(srv.request(
+            "POST", f"{ADMIN}/heal/healb",
+            query=[("clientToken", token)]).text())
+        assert s["state"] == "finished"
+        assert s["objectsHealed"] >= 1
+        # the killed shard is back on disk
+        assert _wait(lambda: os.path.isdir(obj_dir))
+        assert srv.request("GET", "/healb/obj").body == data
+
+    def test_read_path_heal_trigger_mrf(self, srv):
+        """A degraded GET on the running server must enqueue MRF heal
+        (read-path trigger, cmd/erasure-object.go:316-339)."""
+        srv.request("PUT", "/mrfb")
+        data = b"m" * 400_000
+        assert srv.request("PUT", "/mrfb/obj", data=data).status == 200
+        obj_dir = self._kill_one_shard(srv, "mrfb", "obj")
+        # degraded read succeeds and triggers async heal
+        assert srv.request("GET", "/mrfb/obj").body == data
+        # MRF heals it back without any admin interaction
+        assert _wait(lambda: os.path.isdir(obj_dir)), (
+            "MRF did not restore the killed shard; bg status: " +
+            srv.request("GET", f"{ADMIN}/background-heal/status").text())
+        st = json.loads(srv.request(
+            "GET", f"{ADMIN}/background-heal/status").text())
+        assert st["mrf"]["healed"] >= 1
+
+    def test_bad_heal_token(self, srv):
+        r = srv.request("POST", f"{ADMIN}/heal/",
+                        query=[("clientToken", "nope")])
+        assert r.status == 400
+
+
+class TestAdminUserCRUD:
+    def test_user_lifecycle(self, srv):
+        r = srv.request("PUT", f"{ADMIN}/add-user",
+                        query=[("accessKey", "carol")],
+                        data=json.dumps({"secretKey": "carolsecret1",
+                                         "policies": ["readwrite"]}).encode())
+        assert r.status == 200, r.text()
+        users = json.loads(srv.request(
+            "GET", f"{ADMIN}/list-users").text())["users"]
+        assert any(u["accessKey"] == "carol" for u in users)
+        # the new user can use S3
+        assert srv.request("PUT", "/crudb",
+                           creds=("carol", "carolsecret1")).status == 200
+        # disable => denied
+        r = srv.request("PUT", f"{ADMIN}/set-user-status",
+                        query=[("accessKey", "carol"),
+                               ("status", "disabled")])
+        assert r.status == 200
+        assert srv.request("PUT", "/crudb2",
+                           creds=("carol", "carolsecret1")).status == 403
+        # remove
+        assert srv.request("DELETE", f"{ADMIN}/remove-user",
+                           query=[("accessKey", "carol")]).status == 200
+        users = json.loads(srv.request(
+            "GET", f"{ADMIN}/list-users").text())["users"]
+        assert not any(u["accessKey"] == "carol" for u in users)
+
+    def test_policy_lifecycle(self, srv):
+        pol = json.dumps({"Statement": [
+            {"Effect": "Allow", "Action": ["s3:GetObject"],
+             "Resource": ["arn:aws:s3:::polb/*"]}]})
+        r = srv.request("PUT", f"{ADMIN}/add-canned-policy",
+                        query=[("name", "getonly")], data=pol.encode())
+        assert r.status == 200, r.text()
+        pols = json.loads(srv.request(
+            "GET", f"{ADMIN}/list-canned-policies").text())["policies"]
+        assert "getonly" in pols
+        # attach via set-user-or-group-policy
+        srv.request("PUT", f"{ADMIN}/add-user",
+                    query=[("accessKey", "dan")],
+                    data=json.dumps({"secretKey": "dansecret123"}).encode())
+        r = srv.request("PUT", f"{ADMIN}/set-user-or-group-policy",
+                        query=[("policyName", "getonly"),
+                               ("userOrGroup", "dan")])
+        assert r.status == 200
+        assert srv.request("PUT", "/polb",
+                           creds=("dan", "dansecret123")).status == 403
+        # remove policy
+        assert srv.request("DELETE", f"{ADMIN}/remove-canned-policy",
+                           query=[("name", "getonly")]).status == 200
+
+    def test_service_account_over_admin(self, srv):
+        srv.request("PUT", f"{ADMIN}/add-user",
+                    query=[("accessKey", "eve")],
+                    data=json.dumps({"secretKey": "evesecret123",
+                                     "policies": ["readwrite"]}).encode())
+        r = srv.request("PUT", f"{ADMIN}/add-service-account",
+                        data=json.dumps({"targetUser": "eve"}).encode())
+        assert r.status == 200, r.text()
+        doc = json.loads(r.text())
+        assert srv.request("PUT", "/svcb",
+                           creds=(doc["accessKey"],
+                                  doc["secretKey"])).status == 200
