@@ -38,9 +38,11 @@ class BucketMetadataSys:
         self.api = api
         self._lock = threading.Lock()
         self._cache: dict[str, tuple[float, dict]] = {}
-        # parsed-policy memo: bucket -> (raw json it was parsed from, Policy)
-        # so per-key authorization in bulk ops doesn't reparse per call
+        # parsed-config memos: bucket -> (raw doc it was parsed from,
+        # parsed form) so hot paths (per-key auth, per-event notification
+        # matching) don't reparse per call
         self._policy_parsed: dict[str, tuple[str, Policy | None]] = {}
+        self._notif_parsed: dict[str, tuple[str, object]] = {}
         self.ttl = 5.0  # seconds; single-node writes invalidate eagerly
 
     # ------------------------------------------------------------- raw doc
@@ -59,6 +61,7 @@ class BucketMetadataSys:
         with self._lock:
             self._cache.pop(bucket, None)
             self._policy_parsed.pop(bucket, None)
+            self._notif_parsed.pop(bucket, None)
 
     def set_config(self, bucket: str, key: str, value) -> None:
         if not self.api.bucket_exists(bucket):
@@ -135,7 +138,14 @@ class BucketMetadataSys:
         raw = self.get(bucket).get(NOTIFICATION)
         if not raw:
             return None
+        with self._lock:
+            hit = self._notif_parsed.get(bucket)
+            if hit is not None and hit[0] == raw:
+                return hit[1]
         try:
-            return ncfg.NotificationConfig.from_xml(raw)
+            cfg = ncfg.NotificationConfig.from_xml(raw)
         except Exception:
-            return None
+            cfg = None
+        with self._lock:
+            self._notif_parsed[bucket] = (raw, cfg)
+        return cfg

@@ -172,6 +172,7 @@ class ClusterNode:
     def close(self) -> None:
         if self.services is not None:
             self.services.close()
+        self.s3.notifier.close()
         for c in self.peer_clients.values():
             c.close()
 

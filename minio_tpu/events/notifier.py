@@ -1,0 +1,161 @@
+"""EventNotifier: routes S3 events to registered targets with a
+store-backed async delivery loop.
+
+Reference: cmd/event-notification.go (EventNotifier.Send matching the
+bucket's notification rules), internal/store streamItems (per-target
+goroutine replaying the queue store until delivery succeeds).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+
+from .event import Event
+from .targets import QueueStore, StoreFull, TargetError
+
+
+class _TargetWorker:
+    """One delivery thread per target draining its persistent store in
+    order; failures back off and retry forever (events survive restarts
+    in the store)."""
+
+    def __init__(self, target, store: QueueStore, retry_interval: float):
+        self.target = target
+        self.store = store
+        self.retry_interval = retry_interval
+        self._wake = threading.Event()   # new-event arrival signal
+        self._stop = threading.Event()   # close signal (retry sleeps on it)
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"notify-{target.target_id}")
+        self._thread.start()
+
+    def signal(self) -> None:
+        self._wake.set()
+
+    def _loop(self) -> None:
+        while not self._closed:
+            keys = self.store.keys()
+            if not keys:
+                # bounded wait so a wakeup consumed during a retry cycle
+                # can never strand store entries
+                self._wake.wait(1.0)
+                self._wake.clear()
+                continue
+            for key in keys:
+                if self._closed:
+                    return
+                log = self.store.get(key)
+                if log is None:
+                    self.store.delete(key)
+                    continue
+                while not self._closed:
+                    try:
+                        self.target.send(log)
+                        self.store.delete(key)
+                        break
+                    except TargetError:
+                        # endpoint down: hold the entry, back off, retry
+                        self._stop.wait(self.retry_interval)
+
+    def close(self) -> None:
+        self._closed = True
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(2)
+
+
+class EventNotifier:
+    """Matches events against each bucket's notification config and
+    enqueues them to the owning targets (cmd/event-notification.go:248)."""
+
+    def __init__(self, meta, targets=(), queue_dir: str | None = None,
+                 region: str = "us-east-1", retry_interval: float = 0.2,
+                 store_limit: int = 10000):
+        self.meta = meta
+        self.region = region
+        if queue_dir is None:
+            queue_dir = tempfile.mkdtemp(prefix="minio-tpu-events-")
+        self.queue_dir = queue_dir
+        self._workers: dict[str, _TargetWorker] = {}
+        self._lock = threading.Lock()
+        self._retry = retry_interval
+        self._limit = store_limit
+        for t in targets:
+            self.register(t)
+
+    # -------------------------------------------------------------- targets
+    def register(self, target) -> None:
+        store = QueueStore(
+            os.path.join(self.queue_dir, target.target_id.replace(":", "_")),
+            limit=self._limit)
+        with self._lock:
+            old = self._workers.pop(target.target_id, None)
+            self._workers[target.target_id] = _TargetWorker(
+                target, store, self._retry)
+        if old is not None:
+            # stop the displaced worker so two threads never race on the
+            # same queue directory
+            old.close()
+
+    def target_ids(self) -> list[str]:
+        with self._lock:
+            return list(self._workers)
+
+    @property
+    def targets(self) -> list:
+        with self._lock:
+            return [w.target for w in self._workers.values()]
+
+    def arns(self) -> list[str]:
+        return [t.arn(self.region) for t in self.targets]
+
+    # ---------------------------------------------------------------- send
+    def notify(self, event: Event) -> None:
+        """Match the event against the bucket's stored notification
+        config; persist + signal each matched target.  Blocking (config
+        may read the object layer) — call from a worker thread."""
+        if not self._workers:
+            return
+        try:
+            cfg = self.meta.notification_config(event.bucket)
+        except Exception:
+            return
+        if cfg is None:
+            return
+        matched = cfg.targets_for(event.event_name, event.object_key)
+        if not matched:
+            return
+        log = {
+            "EventName": event.event_name,
+            "Key": f"{event.bucket}/{event.object_key}",
+            "Records": [event.to_record()],
+        }
+        seen: set[str] = set()
+        for qc in matched:
+            tid = qc.target_id
+            if tid in seen:
+                continue
+            seen.add(tid)
+            with self._lock:
+                worker = self._workers.get(tid)
+            if worker is None:
+                continue
+            try:
+                worker.store.put(log)
+            except StoreFull:
+                continue  # drop: bounded queue (reference store semantics)
+            worker.signal()
+
+    def pending(self) -> dict[str, int]:
+        with self._lock:
+            return {tid: len(w.store) for tid, w in self._workers.items()}
+
+    def close(self) -> None:
+        with self._lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            w.close()

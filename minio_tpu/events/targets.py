@@ -1,0 +1,139 @@
+"""Notification delivery targets and the persistent event queue store.
+
+Reference: internal/event/target/webhook.go (WebhookTarget with
+Send/SendFromStore), internal/store/queuestore.go (file-per-entry
+persistent queue replayed on boot so undelivered events survive a
+restart).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+import urllib.request
+
+
+class TargetError(Exception):
+    """Delivery to a notification target failed (retryable)."""
+
+
+class StoreFull(TargetError):
+    """The persistent queue hit its entry limit."""
+
+
+class QueueStore:
+    """File-per-event FIFO persisted under one directory.
+
+    Entry names sort in insertion order (monotonic counter seeded past
+    any replayed entries) so `keys()` yields delivery order; writes go
+    through a dot-prefixed temp name + rename so a crash never leaves a
+    half-written entry visible (reference internal/store/queuestore.go).
+    """
+
+    def __init__(self, directory: str, limit: int = 10000):
+        self.dir = directory
+        self.limit = limit
+        self._lock = threading.Lock()
+        os.makedirs(directory, exist_ok=True)
+        existing = self.keys()
+        last = int(existing[-1].split("-")[0]) if existing else 0
+        self._seq = itertools.count(last + 1)
+
+    def put(self, item: dict) -> str:
+        with self._lock:
+            if len(os.listdir(self.dir)) >= self.limit:
+                raise StoreFull(f"event store at limit {self.limit}")
+            key = f"{next(self._seq):016d}-{int(time.time())}"
+            tmp = os.path.join(self.dir, "." + key)
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(item, f)
+            os.replace(tmp, os.path.join(self.dir, key))
+            return key
+
+    def keys(self) -> list[str]:
+        try:
+            names = os.listdir(self.dir)
+        except FileNotFoundError:
+            return []
+        return sorted(n for n in names if not n.startswith("."))
+
+    def get(self, key: str) -> dict | None:
+        try:
+            with open(os.path.join(self.dir, key), encoding="utf-8") as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+
+    def delete(self, key: str) -> None:
+        try:
+            os.remove(os.path.join(self.dir, key))
+        except FileNotFoundError:
+            pass
+
+    def __len__(self) -> int:
+        return len(self.keys())
+
+
+class WebhookTarget:
+    """POSTs the event log to an HTTP endpoint (reference
+    internal/event/target/webhook.go:207 Send)."""
+
+    kind = "webhook"
+
+    def __init__(self, target_name: str, endpoint: str, auth_token: str = "",
+                 timeout: float = 5.0):
+        self.name = target_name
+        self.endpoint = endpoint
+        self.auth_token = auth_token
+        self.timeout = timeout
+
+    @property
+    def target_id(self) -> str:
+        return f"{self.name}:{self.kind}"
+
+    def arn(self, region: str) -> str:
+        return f"arn:minio:sqs:{region}:{self.name}:{self.kind}"
+
+    def send(self, log: dict) -> None:
+        """One delivery attempt; raises TargetError so the notifier's
+        store-backed retry loop keeps the event."""
+        data = json.dumps(log).encode()
+        headers = {"Content-Type": "application/json"}
+        if self.auth_token:
+            headers["Authorization"] = self.auth_token
+        req = urllib.request.Request(
+            self.endpoint, data=data, headers=headers, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                if resp.status // 100 != 2:
+                    raise TargetError(
+                        f"webhook {self.endpoint} returned {resp.status}")
+        except TargetError:
+            raise
+        except Exception as e:  # connection refused, timeout, 4xx/5xx
+            raise TargetError(f"webhook {self.endpoint}: {e}") from e
+
+    def close(self) -> None:
+        pass
+
+
+def load_targets_from_env(environ=None) -> list[WebhookTarget]:
+    """MINIO_NOTIFY_WEBHOOK_ENABLE_<ID>=on +
+    MINIO_NOTIFY_WEBHOOK_ENDPOINT_<ID>=url [+ _AUTH_TOKEN_<ID>]
+    (reference internal/config/notify/parse.go webhook section)."""
+    env = os.environ if environ is None else environ
+    targets: list[WebhookTarget] = []
+    prefix = "MINIO_NOTIFY_WEBHOOK_ENABLE_"
+    for k, v in env.items():
+        if not k.startswith(prefix) or v.lower() not in ("on", "true", "1"):
+            continue
+        tid = k[len(prefix):]
+        endpoint = env.get(f"MINIO_NOTIFY_WEBHOOK_ENDPOINT_{tid}", "")
+        if not endpoint:
+            continue
+        token = env.get(f"MINIO_NOTIFY_WEBHOOK_AUTH_TOKEN_{tid}", "")
+        targets.append(WebhookTarget(tid.lower(), endpoint, auth_token=token))
+    return targets

@@ -42,6 +42,10 @@ from .sse_handlers import SSEMixin, load_kms
 
 XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
 VALID_BUCKET = re.compile(r"^[a-z0-9][a-z0-9.\-]{2,62}$")
+# "minio" is reserved: the admin plane lives under /minio/... so a bucket
+# of that name would shadow it (reference isMinioReservedBucket,
+# cmd/generic-handlers.go guardReservedBucket)
+RESERVED_BUCKETS = frozenset({"minio"})
 
 
 def _iso(ts: float) -> str:
@@ -161,6 +165,8 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
         import concurrent.futures as cf
         import time as time_mod
         from minio_tpu.bucket import BucketMetadataSys
+        from minio_tpu.events.notifier import EventNotifier
+        from minio_tpu.events.targets import load_targets_from_env
         from minio_tpu.iam import IAMSys
 
         self.api = object_layer
@@ -169,6 +175,9 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
         )
         self.meta = BucketMetadataSys(object_layer)
         self.kms = load_kms(object_layer)
+        self.notifier = EventNotifier(
+            self.meta, targets=load_targets_from_env(),
+            queue_dir=_event_queue_dir(object_layer), region=region)
         self.region = region
         self.services = None   # ServiceManager, via attach_services()
         self.locker = None     # LocalLocker, set by ClusterNode
@@ -186,6 +195,22 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
         self.app.router.add_route("*", "/", self.dispatch_root)
         self.app.router.add_route("*", "/{bucket}", self.dispatch_bucket)
         self.app.router.add_route("*", "/{bucket}/{key:.*}", self.dispatch_object)
+
+    def _emit(self, name, bucket: str, key: str, *, size: int = 0,
+              etag: str = "", version_id: str = "", request=None) -> None:
+        """Fire-and-forget S3 event emission (reference sendEvent,
+        cmd/event-notification.go:248).  Matching + delivery happen on
+        the thread pool so the response path never blocks on targets."""
+        if not self.notifier.target_ids():
+            return
+        from minio_tpu.events.event import new_event
+
+        ev = new_event(name, bucket, key, size=size, etag=etag,
+                       version_id=version_id,
+                       host=(request.remote or "") if request else "")
+        if request is not None:
+            ev.user_agent = request.headers.get("User-Agent", "")
+        self.executor.submit(self.notifier.notify, ev)
 
     def attach_services(self, services) -> None:
         """Adopt the background ServiceManager (heal/MRF/scanner) so the
@@ -515,7 +540,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
     # ------------------------------------------------------------- buckets
     def _bucket(self, request: web.Request) -> str:
         b = request.match_info["bucket"]
-        if not VALID_BUCKET.match(b):
+        if not VALID_BUCKET.match(b) or b in RESERVED_BUCKETS:
             raise S3Error("InvalidBucketName", resource=b)
         return b
 
@@ -790,10 +815,16 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
                 )
                 continue
             try:
-                await self._run(
+                doi = await self._run(
                     self.api.delete_object, bucket, key, vid, versioned
                 )
                 results.append(f"<Deleted><Key>{escape(key)}</Key></Deleted>")
+                from minio_tpu.events.event import EventName
+
+                self._emit(
+                    EventName.OBJECT_REMOVED_DELETE_MARKER
+                    if doi.delete_marker else EventName.OBJECT_REMOVED_DELETE,
+                    bucket, key, version_id=doi.version_id, request=request)
             except Exception as e:
                 s3e = from_storage_error(e)
                 results.append(
@@ -949,6 +980,10 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
             headers["x-amz-version-id"] = oi.version_id
         if sse_kind:
             headers.update(self.sse_response_headers(opts.user_metadata))
+        from minio_tpu.events.event import EventName
+
+        self._emit(EventName.OBJECT_CREATED_PUT, bucket, key, size=oi.size,
+                   etag=oi.etag, version_id=oi.version_id, request=request)
         return web.Response(status=200, headers=headers)
 
     async def _versioned(self, bucket: str) -> bool:
@@ -1017,6 +1052,11 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
         new_oi = await self._run(
             self.api.put_object, bucket, key, reader, size, opts
         )
+        from minio_tpu.events.event import EventName
+
+        self._emit(EventName.OBJECT_CREATED_COPY, bucket, key,
+                   size=new_oi.size, etag=new_oi.etag,
+                   version_id=new_oi.version_id, request=request)
         return self._xml(200, (
             f'<?xml version="1.0" encoding="UTF-8"?>'
             f'<CopyObjectResult xmlns="{XMLNS}">'
@@ -1087,6 +1127,10 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
                 self.api.get_object, bucket, key, offset, length, vid
             )
             closer = stream
+        from minio_tpu.events.event import EventName
+
+        self._emit(EventName.OBJECT_ACCESSED_GET, bucket, key, size=size,
+                   etag=oi.etag, version_id=oi.version_id, request=request)
         resp = web.StreamResponse(status=status, headers=headers)
         await resp.prepare(request)
         it = iter(stream)
@@ -1118,6 +1162,10 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
             headers["Content-Length"] = str(sse_mod.plain_size_of(oi.size))
         else:
             headers["Content-Length"] = str(oi.size)
+        from minio_tpu.events.event import EventName
+
+        self._emit(EventName.OBJECT_ACCESSED_HEAD, bucket, key, size=oi.size,
+                   etag=oi.etag, version_id=oi.version_id, request=request)
         return web.Response(status=200, headers=headers)
 
     async def delete_object(self, request: web.Request) -> web.Response:
@@ -1135,6 +1183,12 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
             headers["x-amz-delete-marker"] = "true"
         if oi.version_id:
             headers["x-amz-version-id"] = oi.version_id
+        from minio_tpu.events.event import EventName
+
+        self._emit(
+            EventName.OBJECT_REMOVED_DELETE_MARKER if oi.delete_marker
+            else EventName.OBJECT_REMOVED_DELETE,
+            bucket, key, version_id=oi.version_id, request=request)
         return web.Response(status=204, headers=headers)
 
     # ----------------------------------------------------------- multipart
@@ -1262,6 +1316,11 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
             if "out of order" in str(e):
                 raise S3Error("InvalidPartOrder")
             raise S3Error("InvalidPart", str(e))
+        from minio_tpu.events.event import EventName
+
+        self._emit(EventName.OBJECT_CREATED_COMPLETE_MULTIPART, bucket, key,
+                   size=oi.size, etag=oi.etag, version_id=oi.version_id,
+                   request=request)
         return self._xml(200, (
             f'<?xml version="1.0" encoding="UTF-8"?>'
             f'<CompleteMultipartUploadResult xmlns="{XMLNS}">'
@@ -1270,6 +1329,22 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
             f'<ETag>&quot;{oi.etag}&quot;</ETag>'
             f"</CompleteMultipartUploadResult>"
         ))
+
+
+def _event_queue_dir(object_layer) -> str | None:
+    """Persist undelivered events on the first local drive's system
+    volume (reference queueDir under .minio.sys); None → temp dir."""
+    import os
+
+    from minio_tpu.storage.local import SYSTEM_VOL
+
+    for pool in getattr(object_layer, "pools", [object_layer]):
+        for es in getattr(pool, "sets", [pool]):
+            for d in getattr(es, "disks", []):
+                root = getattr(d, "root", None)
+                if root:
+                    return os.path.join(root, SYSTEM_VOL, "events")
+    return None
 
 
 def make_app(object_layer, start_services: bool = False,

@@ -360,6 +360,10 @@ class ObjectExtraHandlers:
         oi = await self._run(self.api.put_object, bucket, key,
                              io.BytesIO(file_data), len(file_data), opts)
 
+        from minio_tpu.events.event import EventName
+
+        self._emit(EventName.OBJECT_CREATED_POST, bucket, key, size=oi.size,
+                   etag=oi.etag, version_id=oi.version_id, request=request)
         try:
             status = int(form.get("success_action_status", "204") or 204)
         except ValueError:

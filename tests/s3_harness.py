@@ -72,6 +72,7 @@ class S3TestServer:
     def close(self):
         if self.server.services is not None:
             self.server.services.close()
+        self.server.notifier.close()
 
         async def stop():
             await self._runner.cleanup()

@@ -215,10 +215,17 @@ class TestNotificationConfig:
           b'</QueueConfiguration></NotificationConfiguration>')
 
     def test_notification_roundtrip(self, srv):
+        from minio_tpu.events.targets import WebhookTarget
+
         srv.request("PUT", "/ntfb")
         # empty config returned when unset
         r = srv.request("GET", "/ntfb", query=_q("notification"))
         assert r.status == 200
+        # unknown target ARN is rejected (reference ErrARNNotFound)
+        assert srv.request("PUT", "/ntfb", query=_q("notification"),
+                           data=self.NC).status == 400
+        srv.server.notifier.register(
+            WebhookTarget("1", "http://127.0.0.1:1/unused"))
         assert srv.request("PUT", "/ntfb", query=_q("notification"),
                            data=self.NC).status == 200
         r = srv.request("GET", "/ntfb", query=_q("notification"))

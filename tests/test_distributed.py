@@ -71,10 +71,14 @@ def cluster(tmp_path):
         f"http://127.0.0.1:{p1}{tmp_path}/n1/d{{1...3}}",
         f"http://127.0.0.1:{p2}{tmp_path}/n2/d{{1...3}}",
     ]
-    n1 = ClusterNode(eps, my_address=f"127.0.0.1:{p1}")
-    n2 = ClusterNode(eps, my_address=f"127.0.0.1:{p2}")
+    # start_services=False: these tests tear drives down mid-test, and a
+    # live scanner/MRF would heal them back concurrently with assertions
+    n1 = ClusterNode(eps, my_address=f"127.0.0.1:{p1}", start_services=False)
+    n2 = ClusterNode(eps, my_address=f"127.0.0.1:{p2}", start_services=False)
     h1, h2 = NodeHarness(n1, p1), NodeHarness(n2, p2)
     yield n1, n2
+    n1.close()
+    n2.close()
     h1.close()
     h2.close()
 
