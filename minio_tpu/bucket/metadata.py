@@ -43,6 +43,10 @@ class BucketMetadataSys:
         # matching) don't reparse per call
         self._policy_parsed: dict[str, tuple[str, Policy | None]] = {}
         self._notif_parsed: dict[str, tuple[str, object]] = {}
+        # peer-broadcast hook set by ClusterNode: fn(bucket) after a
+        # config mutation, so other nodes invalidate their caches
+        # (reference globalNotificationSys.LoadBucketMetadata)
+        self.on_change = None
         self.ttl = 5.0  # seconds; single-node writes invalidate eagerly
 
     # ------------------------------------------------------------- raw doc
@@ -63,11 +67,20 @@ class BucketMetadataSys:
             self._policy_parsed.pop(bucket, None)
             self._notif_parsed.pop(bucket, None)
 
+    def changed(self, bucket: str) -> None:
+        """Invalidate locally and broadcast to peers."""
+        self.invalidate(bucket)
+        if self.on_change is not None:
+            try:
+                self.on_change(bucket)
+            except Exception:
+                pass  # peers converge via TTL
+
     def set_config(self, bucket: str, key: str, value) -> None:
         if not self.api.bucket_exists(bucket):
             raise errors.BucketNotFound(bucket)
         self.api.update_bucket_metadata(bucket, **{key: value})
-        self.invalidate(bucket)
+        self.changed(bucket)
 
     def delete_config(self, bucket: str, key: str) -> None:
         if not self.api.bucket_exists(bucket):
@@ -76,7 +89,7 @@ class BucketMetadataSys:
         if key in meta:
             meta.pop(key)
             self.api.set_bucket_metadata(bucket, meta)
-        self.invalidate(bucket)
+        self.changed(bucket)
 
     def get_config(self, bucket: str, key: str):
         if not self.api.bucket_exists(bucket):

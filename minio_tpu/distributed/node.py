@@ -163,6 +163,18 @@ class ClusterNode:
         register_storage_rpc(self.router, self.local_drives)
         register_lock_rpc(self.router, self.locker)
         self.router.register("peer.info", self._peer_info)
+        # control-plane fan-out: IAM + bucket-metadata mutations broadcast
+        # reloads so peer caches never serve stale policy decisions
+        # (reference cmd/peer-rest-client.go LoadUser/LoadBucketMetadata)
+        from .peers import PeerNotifier, register_peer_rpc
+
+        register_peer_rpc(self.router, self.s3)
+        if self.distributed:
+            self.peers = PeerNotifier(self.peer_clients)
+            self.s3.meta.on_change = self.peers.reload_bucket_meta
+            self.s3.iam.on_change = self.peers.reload_iam
+        else:
+            self.peers = None
         self.router.mount(self.app)
         # format bootstrap probes peers before their servers are up; reset
         # the health cache so the first real use re-probes immediately

@@ -1,0 +1,77 @@
+"""Peer control-plane fan-out: cache-invalidation broadcasts.
+
+Reference: cmd/peer-rest-client.go:92-755 (LoadBucketMetadata, LoadPolicy,
+LoadUser, LoadGroup, DeleteUser...) and cmd/notification.go's
+NotificationSys fan-out.  A mutation on one node persists to the shared
+store first, then broadcasts a reload so every peer's in-memory cache
+refreshes immediately instead of waiting out a TTL.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class PeerNotifier:
+    """Broadcasts control-plane RPCs to every peer concurrently.
+
+    Failures are non-fatal by design: the authoritative state is already
+    persisted on the shared drives, so a peer that misses a broadcast
+    (down, partitioned) converges via its cache TTL / lazy store reload.
+    """
+
+    def __init__(self, peer_clients: dict, timeout: float = 5.0):
+        self.clients = peer_clients
+        self.timeout = timeout
+
+    def _broadcast(self, method: str, args: dict) -> None:
+        threads = []
+        for client in self.clients.values():
+            if not client.is_online():
+                continue
+
+            def call(c=client):
+                try:
+                    c.call(method, args)
+                except Exception:
+                    pass  # peer converges via TTL / lazy reload
+
+            t = threading.Thread(target=call, daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(self.timeout)
+
+    # ------------------------------------------------------------ bucket meta
+    def reload_bucket_meta(self, bucket: str) -> None:
+        """cmd/peer-rest-client.go LoadBucketMetadata analogue."""
+        self._broadcast("peer.reload_bucket_meta", {"bucket": bucket})
+
+    # -------------------------------------------------------------------- iam
+    def reload_iam(self, kind: str, name: str) -> None:
+        """kind: 'user' | 'policy' | 'group' (LoadUser/LoadPolicy/
+        LoadGroup analogues; deletions ride the same reload — the store
+        no longer has the item, so peers drop it)."""
+        self._broadcast("peer.reload_iam", {"kind": kind, "name": name})
+
+
+def register_peer_rpc(router, s3_server) -> None:
+    """Server side of the control plane (cmd/peer-rest-server.go)."""
+
+    def reload_bucket_meta(args, body):
+        s3_server.meta.invalidate(args.get("bucket", ""))
+        return {}
+
+    def reload_iam(args, body):
+        kind, name = args.get("kind", ""), args.get("name", "")
+        iam = s3_server.iam
+        if kind == "user":
+            iam.reload_user(name)
+        elif kind == "policy":
+            iam.reload_policy(name)
+        elif kind == "group":
+            iam.reload_group(name)
+        return {}
+
+    router.register("peer.reload_bucket_meta", reload_bucket_meta)
+    router.register("peer.reload_iam", reload_iam)

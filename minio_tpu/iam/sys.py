@@ -116,7 +116,18 @@ class IAMSys:
         self.users: dict[str, Identity] = {}
         self.policies: dict[str, Policy] = dict(CANNED_POLICIES)
         self.groups: dict[str, dict] = {}   # name -> {"members": [...], "policies": [...]}
+        # peer-broadcast hook set by ClusterNode: fn(kind, name) called
+        # after a mutation persists, so other nodes reload immediately
+        # (reference cmd/iam.go notifyForUser/notifyForPolicy)
+        self.on_change = None
         self._load()
+
+    def _notify(self, kind: str, name: str) -> None:
+        if self.on_change is not None:
+            try:
+                self.on_change(kind, name)
+            except Exception:
+                pass  # peers converge via lazy reload
 
     # -- persistence --------------------------------------------------------
     def _load(self) -> None:
@@ -143,6 +154,61 @@ class IAMSys:
     def _save_user(self, ident: Identity) -> None:
         self.store.save(f"users/{ident.access_key}.json", ident.to_dict())
 
+    # -- peer reload (receiving side of the control-plane broadcast) --------
+    def reload_user(self, access_key: str) -> None:
+        """Refresh one identity from the shared store; absent there means
+        deleted (reference LoadUser, cmd/peer-rest-server.go)."""
+        doc = self.store.load(f"users/{access_key}.json")
+        with self._mu:
+            if doc is None:
+                self.users.pop(access_key, None)
+                return
+            ident = Identity.from_dict(doc)
+            if ident.expired():
+                self.users.pop(access_key, None)
+            else:
+                self.users[access_key] = ident
+
+    def reload_policy(self, name: str) -> None:
+        doc = self.store.load(f"policies/{name}.json")
+        with self._mu:
+            if doc is None:
+                if name in CANNED_POLICIES:
+                    self.policies[name] = CANNED_POLICIES[name]
+                else:
+                    self.policies.pop(name, None)
+                return
+            try:
+                self.policies[name] = Policy.from_json(json.dumps(doc))
+            except Exception:
+                pass
+
+    def reload_group(self, name: str) -> None:
+        doc = self.store.load(f"groups/{name}.json")
+        with self._mu:
+            if doc is None:
+                self.groups.pop(name, None)
+            else:
+                self.groups[name] = doc
+
+    def _lookup(self, access_key: str) -> Identity | None:
+        """Memory first, then the shared store: credentials created on a
+        peer (e.g. STS from another node) resolve without waiting for a
+        broadcast (reference: IAM store fallback load on miss)."""
+        with self._mu:
+            ident = self.users.get(access_key)
+        if ident is not None:
+            return ident
+        doc = self.store.load(f"users/{access_key}.json")
+        if doc is None:
+            return None
+        ident = Identity.from_dict(doc)
+        if ident.expired():
+            return None
+        with self._mu:
+            self.users.setdefault(access_key, ident)
+            return self.users[access_key]
+
     # -- user CRUD ----------------------------------------------------------
     def add_user(self, access_key: str, secret_key: str,
                  policies: list[str] | None = None) -> Identity:
@@ -153,9 +219,11 @@ class IAMSys:
                              policies=list(policies or []))
             self.users[access_key] = ident
             self._save_user(ident)
-            return ident
+        self._notify("user", access_key)
+        return ident
 
     def remove_user(self, access_key: str) -> None:
+        removed = [access_key]
         with self._mu:
             if access_key not in self.users:
                 raise IAMError(f"no such user {access_key}")
@@ -166,6 +234,9 @@ class IAMSys:
                 if ident.parent == access_key:
                     del self.users[ak]
                     self.store.delete(f"users/{ak}.json")
+                    removed.append(ak)
+        for ak in removed:
+            self._notify("user", ak)
 
     def set_user_status(self, access_key: str, enabled: bool) -> None:
         with self._mu:
@@ -174,6 +245,7 @@ class IAMSys:
                 raise IAMError(f"no such user {access_key}")
             ident.status = "enabled" if enabled else "disabled"
             self._save_user(ident)
+        self._notify("user", access_key)
 
     def list_users(self) -> list[dict]:
         with self._mu:
@@ -190,6 +262,7 @@ class IAMSys:
             self.policies[name] = pol
             self.store.save(f"policies/{name}.json",
                             json.loads(pol.to_json()))
+        self._notify("policy", name)
 
     def delete_policy(self, name: str) -> None:
         with self._mu:
@@ -199,6 +272,7 @@ class IAMSys:
                 raise IAMError(f"no such policy {name}")
             del self.policies[name]
             self.store.delete(f"policies/{name}.json")
+        self._notify("policy", name)
 
     def get_policy(self, name: str) -> Policy | None:
         with self._mu:
@@ -218,6 +292,7 @@ class IAMSys:
                 raise IAMError(f"no such user {access_key}")
             ident.policies = list(dict.fromkeys(names))
             self._save_user(ident)
+        self._notify("user", access_key)
 
     # -- groups -------------------------------------------------------------
     def add_group_members(self, group: str, members: list[str]) -> None:
@@ -234,6 +309,9 @@ class IAMSys:
                     u.groups.append(group)
                     self._save_user(u)
             self.store.save(f"groups/{group}.json", g)
+        self._notify("group", group)
+        for m in members:
+            self._notify("user", m)
 
     def remove_group_members(self, group: str, members: list[str]) -> None:
         with self._mu:
@@ -252,6 +330,9 @@ class IAMSys:
             else:
                 del self.groups[group]
                 self.store.delete(f"groups/{group}.json")
+        self._notify("group", group)
+        for m in members:
+            self._notify("user", m)
 
     def attach_group_policy(self, group: str, names: list[str]) -> None:
         with self._mu:
@@ -263,6 +344,7 @@ class IAMSys:
                     raise IAMError(f"no such policy {n}")
             g["policies"] = list(dict.fromkeys(names))
             self.store.save(f"groups/{group}.json", g)
+        self._notify("group", group)
 
     def list_groups(self) -> list[str]:
         with self._mu:
@@ -281,7 +363,8 @@ class IAMSys:
                              session_policy=session_policy)
             self.users[ak] = ident
             self._save_user(ident)
-            return ident
+        self._notify("user", ak)
+        return ident
 
     # -- STS -----------------------------------------------------------------
     def assume_role(self, parent_ak: str, duration: int = 3600,
@@ -302,7 +385,8 @@ class IAMSys:
                              session_token=token, expiry=expiry)
             self.users[ak] = ident
             self._save_user(ident)
-            return ident
+        self._notify("user", ak)
+        return ident
 
     def _session_token(self, ak: str, parent: str, expiry: float) -> str:
         claims = json.dumps({"ak": ak, "parent": parent, "exp": expiry})
@@ -317,11 +401,10 @@ class IAMSys:
         """creds_lookup for SigV4 verification."""
         if access_key == self.root.access_key:
             return self.root.secret_key
-        with self._mu:
-            ident = self.users.get(access_key)
-            if ident is None or ident.status != "enabled" or ident.expired():
-                return None
-            return ident.secret_key
+        ident = self._lookup(access_key)
+        if ident is None or ident.status != "enabled" or ident.expired():
+            return None
+        return ident.secret_key
 
     def _effective_policy(self, ident: Identity) -> Policy:
         names = list(ident.policies)
@@ -346,9 +429,11 @@ class IAMSys:
         callers need the three-way result, not just a bool."""
         if access_key == self.root.access_key:
             return "allow"
+        ident = self._lookup(access_key)
+        if ident is None:
+            return "deny"
         with self._mu:
-            ident = self.users.get(access_key)
-            if ident is None or ident.status != "enabled" or ident.expired():
+            if ident.status != "enabled" or ident.expired():
                 return "deny"
             args = PolicyArgs(action=action, bucket=bucket, object=obj,
                               account=access_key,
@@ -358,7 +443,7 @@ class IAMSys:
                 if ident.parent == self.root.access_key:
                     base = "allow"
                 else:
-                    parent = self.users.get(ident.parent)
+                    parent = self._lookup(ident.parent)
                     if parent is None or parent.status != "enabled":
                         return "deny"
                     base = self._effective_policy(parent).evaluate(args)

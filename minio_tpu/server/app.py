@@ -616,7 +616,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
         if setter is None:
             raise S3Error("NotImplemented")
         await self._run(setter, bucket, status == "Enabled")
-        self.meta.invalidate(bucket)
+        self.meta.changed(bucket)
         return web.Response(status=200)
 
     @staticmethod
