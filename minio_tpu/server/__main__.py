@@ -44,6 +44,15 @@ def main(argv=None) -> int:
     from aiohttp import web
 
     from minio_tpu.distributed.node import ClusterNode
+    from minio_tpu.selftest import SelfTestError, run_self_tests
+
+    # refuse to serve IO with a broken codec/hash (reference
+    # erasureSelfTest/bitrotSelfTest fatal at boot)
+    try:
+        run_self_tests()
+    except SelfTestError as e:
+        print(f"minio-tpu: FATAL: {e}", file=sys.stderr)
+        return 1
 
     node = ClusterNode(
         args.endpoints, my_address=args.address,

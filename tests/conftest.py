@@ -17,6 +17,10 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# fsync-per-commit is production behaviour; tests skip it for speed
+# (dedicated durability tests re-enable via monkeypatching
+# minio_tpu.storage.local.FSYNC_ENABLED)
+os.environ.setdefault("MINIO_TPU_FSYNC", "0")
 
 import jax  # noqa: E402
 
