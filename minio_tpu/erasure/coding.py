@@ -22,6 +22,7 @@ import concurrent.futures as cf
 import os
 
 import threading
+import time
 from typing import BinaryIO, Sequence
 
 import numpy as np
@@ -52,27 +53,75 @@ def _io_pool() -> cf.ThreadPoolExecutor:
 
 
 class _DeviceCodec:
-    """Lazy singleton per (k, m): Pallas codec when a TPU is attached."""
+    """Lazy singleton per (k, m): Pallas codec when a TPU is attached.
 
-    _cache: dict = {}
+    `get(k, m)` additionally runs a one-time calibration probe: the device
+    path is only selected for backend "auto" if a transfer-inclusive encode
+    actually beats the host codec on this machine.  A TPU reached over a
+    slow tunnel (high per-dispatch latency, low host<->device bandwidth)
+    loses the probe and the scheduler stays on the AVX2 host codec; a
+    co-located TPU wins it.  `get(k, m, probe=False)` (backend "tpu")
+    bypasses the verdict and always returns the codec when one exists.
+    """
+
+    _cache: dict = {}  # (k, m) -> (codec | None, device_wins: bool)
     _lock = threading.Lock()
 
     @classmethod
-    def get(cls, k: int, m: int):
+    def _probe(cls, codec, k: int, m: int) -> bool:
+        """True if transfer-inclusive device encode beats the host codec."""
+        try:
+            host_codec = host.HostRSCodec(k, m)
+            shard = 128 * 1024
+
+            def time_pair(nblocks: int) -> tuple[float, float]:
+                batch = np.zeros((nblocks, k, shard), dtype=np.uint8)
+                best_d = best_h = float("inf")
+                for _ in range(2):
+                    t0 = time.perf_counter()
+                    np.asarray(codec.encode(batch))
+                    best_d = min(best_d, time.perf_counter() - t0)
+                    t0 = time.perf_counter()
+                    host_codec.encode(batch)
+                    best_h = min(best_h, time.perf_counter() - t0)
+                return best_d, best_h
+
+            dev_t, host_t = time_pair(8)
+            if dev_t > 4 * host_t:
+                return False
+            # close call at 8 blocks: fixed dispatch latency may dominate;
+            # re-probe at the steady-state batch size before deciding.
+            dev_t, host_t = time_pair(DEVICE_BATCH_BLOCKS)
+            return dev_t <= host_t
+        except Exception:
+            return False
+
+    @classmethod
+    def get(cls, k: int, m: int, probe: bool = True):
         with cls._lock:
             key = (k, m)
             if key not in cls._cache:
+                codec = None
                 try:
                     import jax
                     from minio_tpu.ops import rs_pallas
 
-                    if jax.default_backend() == "cpu":
-                        cls._cache[key] = None
-                    else:
-                        cls._cache[key] = rs_pallas.PallasRSCodec(k, m)
+                    if jax.default_backend() != "cpu":
+                        codec = rs_pallas.PallasRSCodec(k, m)
                 except Exception:
-                    cls._cache[key] = None
-            return cls._cache[key]
+                    codec = None
+                # verdict computed lazily on the first probe=True caller;
+                # backend="tpu" callers never pay for it
+                cls._cache[key] = (codec, None)
+            codec, wins = cls._cache[key]
+            if not probe:
+                return codec
+            if codec is None:
+                return None
+            if wins is None:
+                wins = cls._probe(codec, k, m)
+                cls._cache[key] = (codec, wins)
+            return codec if wins else None
 
 
 class Erasure:
@@ -123,33 +172,31 @@ class Erasure:
         parity = self._encode_shards(shards[None, ...])[0]
         return [shards[i] for i in range(self.k)] + list(parity)
 
-    def _use_device(self, nbytes: int, shard_len: int) -> bool:
-        if self.m == 0:
-            return False
-        if self.backend == "host":
-            return False
-        dev = _DeviceCodec.get(self.k, self.m)
-        if dev is None:
-            return False
+    def _device(self, nbytes: int, shard_len: int):
+        """The device codec to use for this dispatch, or None for host."""
+        if self.m == 0 or self.backend == "host":
+            return None
         if shard_len % 8192 != 0:
-            return False
+            return None
         if self.backend == "tpu":
-            return True
-        return nbytes >= DEVICE_MIN_BYTES
+            return _DeviceCodec.get(self.k, self.m, probe=False)
+        if nbytes < DEVICE_MIN_BYTES:
+            return None
+        return _DeviceCodec.get(self.k, self.m)
 
     def _encode_shards(self, batch: np.ndarray) -> np.ndarray:
         """(B, K, S) -> (B, M, S) parity via the selected backend."""
         b, k, s = batch.shape
-        if self._use_device(batch.nbytes, s):
-            dev = _DeviceCodec.get(self.k, self.m)
+        dev = self._device(batch.nbytes, s)
+        if dev is not None:
             return np.asarray(dev.encode(batch))
         return self._host.encode(batch)
 
     def _reconstruct_shards(self, batch: np.ndarray, available: tuple,
                             wanted: tuple) -> np.ndarray:
         b, k, s = batch.shape
-        if self._use_device(batch.nbytes, s):
-            dev = _DeviceCodec.get(self.k, self.m)
+        dev = self._device(batch.nbytes, s)
+        if dev is not None:
             return np.asarray(dev.reconstruct(batch, available, wanted))
         return self._host.reconstruct(batch, available, wanted)
 
@@ -202,15 +249,34 @@ class Erasure:
             )
         pool = _io_pool()
         total = 0
+        # Double buffering: while batch N's shard writes are in flight on the
+        # I/O pool, the main thread reads + splits + encodes batch N+1 (device
+        # compute or host SIMD).  Per-drive write order is preserved because a
+        # batch's writes are only submitted after the previous batch's future
+        # for that drive has completed.
+        inflight: dict[int, cf.Future] = {}
+
+        def reap_inflight() -> None:
+            nonlocal dead
+            for i, fut in inflight.items():
+                try:
+                    fut.result()
+                except Exception:
+                    dead.add(i)
+            inflight.clear()
+            if n - len(dead) < write_quorum:
+                raise errors.ErasureWriteQuorum(
+                    f"{n - len(dead)} writers < quorum {write_quorum}"
+                )
 
         def flush_batch(blocks: list[np.ndarray], lens: list[int]) -> None:
             # blocks: list of (K, S) aligned same-size data-shard arrays.
             # One future per drive (goroutine-per-writer analog of
             # parallelWriter, cmd/erasure-encode.go:36); a drive writes its
             # shard of every block in order, so per-file layout is stable.
-            nonlocal dead
             batch = np.stack(blocks)
             parity = self._encode_shards(batch)
+            reap_inflight()
 
             def write_drive(i: int) -> None:
                 for bi in range(batch.shape[0]):
@@ -221,55 +287,115 @@ class Erasure:
                     )
                     writers[i].write(shard)
 
-            futures = {
+            inflight.update({
                 i: pool.submit(write_drive, i)
                 for i in range(n)
                 if i not in dead and writers[i] is not None
-            }
-            for i, fut in futures.items():
-                try:
-                    fut.result()
-                except Exception:
-                    dead.add(i)
-            if n - len(dead) < write_quorum:
-                raise errors.ErasureWriteQuorum(
-                    f"{n - len(dead)} writers < quorum {write_quorum}"
-                )
+            })
 
         pending: list[np.ndarray] = []
         pending_lens: list[int] = []
         batch_max = DEVICE_BATCH_BLOCKS
-        while True:
-            want = self.block_size if total_size < 0 else min(
-                self.block_size, total_size - total
-            )
-            if want == 0:
-                break
-            data = self._read_full(reader, want)
-            if not data:
-                break
-            total += len(data)
-            shards = gf256.split(data, self.k)
-            if len(data) == self.block_size:
-                # full blocks all share a shard shape: batch them
-                pending.append(shards)
-                pending_lens.append(len(data))
-                if len(pending) >= batch_max:
-                    flush_batch(pending, pending_lens)
-                    pending, pending_lens = [], []
-            else:
-                # odd-sized (tail) block: flush pending, then encode alone
-                if pending:
-                    flush_batch(pending, pending_lens)
-                    pending, pending_lens = [], []
-                flush_batch([shards], [len(data)])
-            if len(data) < want:
-                break
-        if pending:
-            flush_batch(pending, pending_lens)
+        try:
+            while True:
+                want = self.block_size if total_size < 0 else min(
+                    self.block_size, total_size - total
+                )
+                if want == 0:
+                    break
+                data = self._read_full(reader, want)
+                if not data:
+                    break
+                total += len(data)
+                shards = gf256.split(data, self.k)
+                if len(data) == self.block_size:
+                    # full blocks all share a shard shape: batch them
+                    pending.append(shards)
+                    pending_lens.append(len(data))
+                    if len(pending) >= batch_max:
+                        flush_batch(pending, pending_lens)
+                        pending, pending_lens = [], []
+                else:
+                    # odd-sized (tail) block: flush pending, then encode alone
+                    if pending:
+                        flush_batch(pending, pending_lens)
+                        pending, pending_lens = [], []
+                    flush_batch([shards], [len(data)])
+                if len(data) < want:
+                    break
+            if pending:
+                flush_batch(pending, pending_lens)
+            reap_inflight()
+        except BaseException:
+            # unwind: wait out in-flight shard writes so callers can safely
+            # close/clean up writers the pool threads were still feeding
+            for fut in inflight.values():
+                try:
+                    fut.result()
+                except Exception:
+                    pass
+            inflight.clear()
+            raise
         return total, dead
 
     # -- streaming decode (cmd/erasure-decode.go:206) -----------------------
+    def _read_group(self, readers: Sequence, broken: set[int],
+                    shard_off: int, read_len: int, nblocks: int,
+                    shard_len: int, pool) -> dict[int, np.ndarray]:
+        """Read one group of `nblocks` consecutive shard blocks from the
+        first k healthy readers, work-stealing to spare drives on failure
+        (parallelReader.Read trigger channels, cmd/erasure-decode.go:101).
+
+        Returns {shard_index: (nblocks, shard_len) uint8}; exactly k entries.
+        """
+        n = self.k + self.m
+        got: dict[int, np.ndarray] = {}
+        order = [i for i in range(n) if readers[i] is not None and i not in broken]
+        idx_iter = iter(order)
+        active = []
+        try:
+            for _ in range(self.k):
+                active.append(next(idx_iter))
+        except StopIteration:
+            raise errors.ErasureReadQuorum("not enough shard streams")
+        while len(got) < self.k:
+            futs = {
+                i: pool.submit(readers[i].read_at, shard_off, read_len)
+                for i in active
+            }
+            active = []
+            for i, fut in futs.items():
+                try:
+                    got[i] = np.frombuffer(fut.result(), dtype=np.uint8).reshape(
+                        nblocks, shard_len
+                    )
+                except Exception:
+                    broken.add(i)
+                    try:
+                        active.append(next(idx_iter))
+                    except StopIteration:
+                        raise errors.ErasureReadQuorum(
+                            f"shard {i} failed and no spare drives remain"
+                        )
+        return got
+
+    def _assemble_data(self, got: dict[int, np.ndarray], nblocks: int,
+                       shard_len: int) -> np.ndarray:
+        """(nblocks, k, shard_len) data shards from k read shards,
+        reconstructing missing data shards in one batched dispatch."""
+        data = np.empty((nblocks, self.k, shard_len), dtype=np.uint8)
+        missing = tuple(i for i in range(self.k) if i not in got)
+        for i in range(self.k):
+            if i in got:
+                data[:, i, :] = got[i]
+        if missing:
+            avail = tuple(sorted(got))[: self.k]
+            src = np.stack([got[i] for i in avail], axis=1)
+            rebuilt = self._reconstruct_shards(src, avail, missing)
+            for j, w in enumerate(missing):
+                data[:, w, :] = rebuilt[:, j, :]
+        return data
+
     def decode_stream(self, writer, readers: Sequence, offset: int,
                       length: int, total_length: int) -> int:
         """Read shard streams (None = unavailable), reconstruct if needed,
@@ -279,6 +405,10 @@ class Erasure:
         first-K-of-N degraded read: starts with the first k available
         shards; on a shard read/verify failure it advances to the next
         available drive (work-stealing trigger of parallelReader.Read).
+        Consecutive full blocks are read and reconstructed in groups of up
+        to DEVICE_BATCH_BLOCKS: one contiguous read per drive per group and
+        one batched (G, K, S) reconstruct dispatch, instead of per-block
+        round trips.
         """
         if length == 0:
             return 0
@@ -293,95 +423,96 @@ class Erasure:
         written = 0
         pool = _io_pool()
         broken: set[int] = set()
+        full_blocks_total = total_length // self.block_size
 
-        for block_idx in range(start_block, end_block + 1):
+        block_idx = start_block
+        while block_idx <= end_block:
             block_off = block_idx * self.block_size
             cur_size = min(self.block_size, total_length - block_off)
             if cur_size <= 0:
                 break
-            shard_len = -(-cur_size // self.k)
-            shard_off = block_idx * self.shard_size
-
-            # choose k source shards among healthy readers
-            shards: list[np.ndarray | None] = [None] * n
-            got = 0
-            order = [i for i in range(n) if readers[i] is not None and i not in broken]
-            idx_iter = iter(order)
-            active = []
-            try:
-                for _ in range(self.k):
-                    active.append(next(idx_iter))
-            except StopIteration:
-                raise errors.ErasureReadQuorum("not enough shard streams")
-            while got < self.k:
-                futs = {
-                    i: pool.submit(readers[i].read_at, shard_off, shard_len)
-                    for i in active
-                }
-                active = []
-                for i, fut in futs.items():
-                    try:
-                        shards[i] = np.frombuffer(fut.result(), dtype=np.uint8)
-                        got += 1
-                    except Exception:
-                        broken.add(i)
-                        try:
-                            nxt = next(idx_iter)
-                            active.append(nxt)
-                        except StopIteration:
-                            raise errors.ErasureReadQuorum(
-                                f"shard {i} failed and no spare drives remain"
-                            )
-
-            if any(shards[i] is None for i in range(self.k)):
-                avail = tuple(i for i in range(n) if shards[i] is not None)
-                wanted = tuple(i for i in range(self.k) if shards[i] is None)
-                src = np.stack([shards[i] for i in avail[: self.k]])[None, ...]
-                rebuilt = self._reconstruct_shards(src, avail, wanted)[0]
-                for j, w in enumerate(wanted):
-                    shards[w] = rebuilt[j]
-
-            block = np.concatenate(shards[: self.k])[:cur_size]
-            lo = max(offset, block_off) - block_off
-            hi = min(offset + length, block_off + cur_size) - block_off
-            if hi > lo:
-                writer.write(block[lo:hi].tobytes())
-                written += hi - lo
+            if cur_size == self.block_size:
+                # group of consecutive full blocks
+                g = min(
+                    end_block - block_idx + 1,
+                    full_blocks_total - block_idx,
+                    DEVICE_BATCH_BLOCKS,
+                )
+                shard_len = self.shard_size
+                got = self._read_group(
+                    readers, broken, block_idx * shard_len, g * shard_len,
+                    g, shard_len, pool,
+                )
+                data = self._assemble_data(got, g, shard_len)
+                flat = data.reshape(g, self.k * shard_len)
+                if self.k * shard_len != self.block_size:
+                    # k does not divide block_size: drop per-block shard padding
+                    flat = np.ascontiguousarray(flat[:, : self.block_size])
+                span = g * self.block_size
+                lo = max(offset, block_off) - block_off
+                hi = min(offset + length, block_off + span) - block_off
+                if hi > lo:
+                    # contiguous uint8 slice: hand the buffer to the writer
+                    # without a tobytes() copy
+                    writer.write(flat.reshape(-1)[lo:hi].data)
+                    written += hi - lo
+                block_idx += g
+            else:
+                # tail block (shorter shard length)
+                shard_len = -(-cur_size // self.k)
+                got = self._read_group(
+                    readers, broken, block_idx * self.shard_size, shard_len,
+                    1, shard_len, pool,
+                )
+                data = self._assemble_data(got, 1, shard_len)
+                block = data.reshape(-1)[:cur_size]
+                lo = max(offset, block_off) - block_off
+                hi = min(offset + length, block_off + cur_size) - block_off
+                if hi > lo:
+                    writer.write(block[lo:hi].tobytes())
+                    written += hi - lo
+                block_idx += 1
         return written
 
     # -- heal (cmd/erasure-decode.go:287) -----------------------------------
     def heal(self, writers: Sequence, readers: Sequence, total_length: int) -> None:
         """Rebuild the shards of drives whose writer is non-None from any k
-        healthy readers, streaming block by block."""
+        healthy readers, streaming in groups of full blocks with one batched
+        reconstruct dispatch per group."""
         n = self.k + self.m
         writers = list(writers)
         readers = list(readers)
         wanted = tuple(i for i in range(n) if writers[i] is not None)
         if not wanted:
             return
-        avail_all = [i for i in range(n) if readers[i] is not None]
-        if len(avail_all) < self.k:
+        if sum(1 for r in readers if r is not None) < self.k:
             raise errors.ErasureReadQuorum("not enough shards to heal")
+        pool = _io_pool()
+        broken: set[int] = set()
         nblocks = -(-total_length // self.block_size) if total_length else 0
-        for block_idx in range(nblocks):
-            block_off = block_idx * self.block_size
-            cur_size = min(self.block_size, total_length - block_off)
-            shard_len = -(-cur_size // self.k)
-            shard_off = block_idx * self.shard_size
-            shards: dict[int, np.ndarray] = {}
-            for i in avail_all:
-                if len(shards) >= self.k:
-                    break
-                try:
-                    shards[i] = np.frombuffer(
-                        readers[i].read_at(shard_off, shard_len), dtype=np.uint8
-                    )
-                except Exception:
-                    continue
-            if len(shards) < self.k:
+        full_blocks = total_length // self.block_size
+
+        block_idx = 0
+        while block_idx < nblocks:
+            if block_idx < full_blocks:
+                g = min(full_blocks - block_idx, DEVICE_BATCH_BLOCKS)
+                shard_len = self.shard_size
+            else:
+                g = 1
+                cur_size = total_length - block_idx * self.block_size
+                shard_len = -(-cur_size // self.k)
+            try:
+                got = self._read_group(
+                    readers, broken, block_idx * self.shard_size,
+                    g * shard_len if shard_len == self.shard_size else shard_len,
+                    g, shard_len, pool,
+                )
+            except errors.ErasureReadQuorum:
                 raise errors.ErasureReadQuorum("healing read quorum lost")
-            avail = tuple(sorted(shards))[: self.k]
-            src = np.stack([shards[i] for i in avail])[None, ...]
-            rebuilt = self._reconstruct_shards(src, avail, wanted)[0]
-            for j, w in enumerate(wanted):
-                writers[w].write(rebuilt[j])
+            avail = tuple(sorted(got))[: self.k]
+            src = np.stack([got[i] for i in avail], axis=1)
+            rebuilt = self._reconstruct_shards(src, avail, wanted)
+            for bi in range(g):
+                for j, w in enumerate(wanted):
+                    writers[w].write(rebuilt[bi, j])
+            block_idx += g

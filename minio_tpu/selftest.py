@@ -33,29 +33,48 @@ _HH256_GOLDEN = "39c0407ed3f01b18d22c85db4aeff11e060ca5f43131b0126731ca197cd4231
 
 def erasure_self_test() -> None:
     """Encode a fixed pattern and compare shard hashes with the pinned
-    reference values; then reconstruct a dropped shard."""
+    reference values; then reconstruct a dropped shard.
+
+    Runs against BOTH codecs that can serve IO: the pure-numpy table path
+    (gf256) and the C++ SIMD codec (host.HostRSCodec) that Erasure
+    dispatches to on the hot path — a miscompiled csrc build must refuse
+    to boot, exactly like the reference's erasureSelfTest."""
     import numpy as np
     import xxhash
 
-    from minio_tpu.ops import gf256
+    from minio_tpu.ops import gf256, host
 
     data = bytes(range(256))
     for (k, m), want in _EC_GOLDEN.items():
-        shards = gf256.encode_data_np(data, k, m)
-        h = xxhash.xxh64()
-        for i, s in enumerate(shards):
-            h.update(bytes([i]))
-            h.update(np.asarray(s, dtype=np.uint8).tobytes())
-        if h.intdigest() != want:
-            raise SelfTestError(
-                f"erasure self-test failed for {k}+{m}: shards are not "
-                f"byte-identical with the reference codec")
-        first = shards[0].copy()
-        rebuilt = gf256.reconstruct_np([None] + shards[1:], k, m)
+        data_shards = gf256.split(data, k)
+        codec = host.HostRSCodec(k, m)
+        for label, parity in (
+            ("numpy", gf256.encode_data_np(data, k, m)[k:]),
+            ("host-simd", list(codec.encode(data_shards))),
+        ):
+            shards = [data_shards[i] for i in range(k)] + list(parity)
+            h = xxhash.xxh64()
+            for i, s in enumerate(shards):
+                h.update(bytes([i]))
+                h.update(np.asarray(s, dtype=np.uint8).tobytes())
+            if h.intdigest() != want:
+                raise SelfTestError(
+                    f"erasure self-test failed for {k}+{m} ({label}): shards "
+                    f"are not byte-identical with the reference codec")
+        full = gf256.encode_data_np(data, k, m)
+        first = full[0].copy()
+        rebuilt = gf256.reconstruct_np([None] + full[1:], k, m)
         if not np.array_equal(rebuilt[0], first):
             raise SelfTestError(
                 f"erasure self-test failed for {k}+{m}: reconstruction "
                 f"does not round-trip")
+        # SIMD reconstruct must agree as well
+        avail = tuple(range(1, k + 1))
+        rec = codec.reconstruct(np.stack(full[1:k + 1]), avail, (0,))
+        if not np.array_equal(rec[0], first):
+            raise SelfTestError(
+                f"erasure self-test failed for {k}+{m} (host-simd): "
+                f"reconstruction does not round-trip")
 
 
 def bitrot_self_test() -> None:

@@ -104,6 +104,8 @@ class LocalStorage(StorageAPI):
         self.root = os.path.abspath(root)
         self._endpoint = endpoint or self.root
         self._disk_id = ""
+        # staged files written unsynced (append_file) pending a commit sync
+        self._unsynced: set[str] = set()
         self._lock = threading.Lock()
         os.makedirs(self.root, exist_ok=True)
         os.makedirs(os.path.join(self.root, SYSTEM_VOL, TMP_DIR), exist_ok=True)
@@ -251,11 +253,13 @@ class LocalStorage(StorageAPI):
                     append: bool = True) -> None:
         """Append (or truncate-then-write) a chunk; the remote shard-stream
         protocol's write primitive (reference AppendFile,
-        cmd/xl-storage.go)."""
+        cmd/xl-storage.go).  Not synced per-chunk: the path is recorded so
+        rename_data fdatasyncs it once at commit."""
         p = self._file_path(volume, path)
         os.makedirs(os.path.dirname(p), exist_ok=True)
         with open(p, "ab" if append else "wb") as f:
             f.write(data)
+        self._unsynced.add(p)
 
     def read_file_stream(self, volume: str, path: str, offset: int,
                          length: int) -> BinaryIO:
@@ -359,13 +363,15 @@ class LocalStorage(StorageAPI):
                 raise errors.FileNotFound(f"{src_volume}/{src_path}")
             if FSYNC_ENABLED:
                 # shards written via append_file (remote streams) were not
-                # synced per-chunk; make every staged file durable before
-                # the rename publishes the version
+                # synced per-chunk; make those durable before the rename
+                # publishes the version.  Locally-streamed shards were
+                # already fdatasync'd by _SyncedWriter.close — skip them.
                 for name in os.listdir(src_dir):
                     fp = os.path.join(src_dir, name)
-                    if os.path.isfile(fp):
+                    if fp in self._unsynced and os.path.isfile(fp):
                         with open(fp, "rb+") as f:
                             _fdatasync(f)
+                        self._unsynced.discard(fp)
             dst_data_dir = os.path.join(dst_obj_dir, fi.data_dir)
             if os.path.isdir(dst_data_dir):
                 shutil.rmtree(dst_data_dir)

