@@ -149,6 +149,30 @@ def merge_entry_streams(streams: list[Iterator[ListEntry]]
         yield pending
 
 
+def resolve_entry_versions(api, bucket: str, name: str) -> list[ObjectInfo]:
+    """Live version resolution for one name, routed to the owning set
+    (used when serving names from a persisted metacache)."""
+    def disk_groups():
+        if hasattr(api, "pools"):
+            for p in api.pools:
+                yield p.get_hashed_set(name).disks
+        elif hasattr(api, "get_hashed_set"):
+            yield api.get_hashed_set(name).disks
+        else:
+            yield api.disks
+
+    for disks in disk_groups():
+        for d in disks:
+            if d is None or not d.is_online():
+                continue
+            try:
+                raw = d.read_xl(bucket, name)
+                return versions_from_xl(bucket, name, raw)
+            except Exception:
+                continue
+    return []
+
+
 def list_objects(api, bucket: str, prefix: str = "", delimiter: str = "",
                  marker: str = "", version_marker: str = "",
                  max_keys: int = 1000,
@@ -159,7 +183,13 @@ def list_objects(api, bucket: str, prefix: str = "", delimiter: str = "",
     listings, `marker`/`version_marker` are the key-marker/version-id-marker
     pair and every version (incl. delete markers) is emitted; otherwise
     only latest non-delete-marker versions appear.
+
+    Continuation pages are served from the persisted metacache when one is
+    usable (zero drive walks, cmd/metacache-set.go:532); a truncated walk
+    saves its full name stream for the following pages (:277).
     """
+    from . import metacache
+
     res = ListResult()
     budget = max(0, max_keys)
     if budget == 0:
@@ -167,21 +197,53 @@ def list_objects(api, bucket: str, prefix: str = "", delimiter: str = "",
     seen_prefixes: set[str] = set()
     emitted = 0
     last_display = ""          # last key or common prefix emitted
+    walked: list[str] = []     # every name the walk yields (for cache save)
+
+    # push the marker down so earlier pages aren't re-resolved (xl.meta is
+    # only read for names past the marker); the partial-key resume needs
+    # the marker key itself back to filter its remaining versions
+    partial_resume = include_versions and bool(version_marker) and bool(marker)
+
+    mc = metacache.attach(api)
+    cached_names = (
+        mc.lookup(bucket, prefix, marker, partial_resume) if mc else None
+    )
+    if cached_names is not None and hasattr(api, "bucket_exists") \
+            and not api.bucket_exists(bucket):
+        cached_names = None
+    if cached_names is not None:
+        stream = (
+            ListEntry(
+                name=n,
+                _resolve=(lambda n=n: resolve_entry_versions(api, bucket, n)),
+            )
+            for n in cached_names
+        )
+        from_cache = True
+    else:
+        stream = api.list_entries(bucket, prefix=prefix, marker=marker,
+                                  include_marker=partial_resume)
+        from_cache = False
 
     def truncate() -> ListResult:
         res.is_truncated = True
         res.next_marker = last_display
         if res.entries and res.entries[-1].name == last_display:
             res.next_version_marker = res.entries[-1].version_id or "null"
+        if not from_cache and mc is not None:
+            # a next page is certain: drain the remaining (already-walked)
+            # names and persist the stream for it (no version resolution)
+            try:
+                for e in stream:
+                    walked.append(e.name)
+                mc.save(bucket, prefix, marker, walked)
+            except Exception:
+                pass
         return res
 
-    # push the marker down so earlier pages aren't re-resolved (xl.meta is
-    # only read for names past the marker); the partial-key resume needs
-    # the marker key itself back to filter its remaining versions
-    partial_resume = include_versions and bool(version_marker) and bool(marker)
-    stream = api.list_entries(bucket, prefix=prefix, marker=marker,
-                              include_marker=partial_resume)
     for entry in stream:
+        if not from_cache:
+            walked.append(entry.name)
         name = entry.name
         cp = ""
         if delimiter:

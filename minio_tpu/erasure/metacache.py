@@ -1,0 +1,200 @@
+"""Persisted listing metacache: continuation pages without drive re-walks.
+
+Reference: cmd/metacache-set.go:277 (saveMetaCacheStream persists listing
+blocks under `.minio.sys/buckets/<bkt>/.metacache/<id>/block-N`),
+cmd/metacache-set.go:532 (listPath checks for a usable existing cache
+before walking), cmd/metacache-bucket.go / cmd/metacache-manager.go
+(cache lifecycle).
+
+Design here (TPU build): the expensive part of a listing is the
+union-of-sorted-walks across every drive of every set; version metadata
+is resolved lazily per consumed name either way.  So the cache stores the
+*sorted name stream* of one (bucket, prefix) walk, split into blocks and
+persisted on the system volume of two drives; a continuation request
+binary-searches the manifest for its marker and streams names from the
+saved blocks — zero drive walks — while versions are still resolved live
+from xl.meta (so deleted objects drop out and metadata is never stale).
+
+Cache usability rules (mirroring the reference's handout semantics):
+- continuation (marker != ""): any cache whose start <= marker and age <
+  CACHE_TTL (default 300s) serves the page;
+- fresh listings (marker == ""): only a very recent cache (FRESH_TTL) is
+  reused, so newly created objects appear promptly;
+- caches are written when a listing truncates (a next page is certain),
+  by draining the remaining merged name stream (names are already
+  materialized per set; no extra IO).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+
+from minio_tpu.storage import errors
+from minio_tpu.storage.local import SYSTEM_VOL
+
+CACHE_TTL = 300.0   # continuation reuse window (reference keep-alive)
+FRESH_TTL = 3.0     # marker-less reuse window (burst listings)
+BLOCK_NAMES = 8192  # names per persisted block
+REPLICAS = 2        # drives that hold a copy of each cache
+
+
+def _cache_id(bucket: str, prefix: str, start: str) -> str:
+    h = hashlib.sha1(f"{bucket}\x00{prefix}\x00{start}".encode()).hexdigest()
+    return h[:20]
+
+
+class MetacacheManager:
+    """Per-process listing cache over an object-layer api (ErasureObjects /
+    ErasureSets / ErasureServerPools duck-typed via their disk lists)."""
+
+    def __init__(self, api, mem_entries: int = 8):
+        self.api = api
+        self._mem: dict[tuple, tuple[float, list[str]]] = {}
+        self._mem_cap = mem_entries
+        self._lock = threading.Lock()
+
+    # -- drive access -------------------------------------------------------
+    def _disks(self):
+        api = self.api
+        if hasattr(api, "pools"):
+            api = api.pools[0]
+        if hasattr(api, "all_disks"):
+            return api.all_disks
+        return api.disks
+
+    def _online_disks(self):
+        return [d for d in self._disks() if d is not None and d.is_online()]
+
+    @staticmethod
+    def _path(bucket: str, cid: str, name: str) -> str:
+        return f"buckets/{bucket}/.metacache/{cid}/{name}"
+
+    # -- persistence --------------------------------------------------------
+    def save(self, bucket: str, prefix: str, start: str,
+             names: list[str]) -> None:
+        """Persist one walked name stream; failures are non-fatal (the next
+        page just re-walks)."""
+        if bucket.startswith("."):
+            return
+        cid = _cache_id(bucket, prefix, start)
+        created = time.time()
+        blocks = [
+            names[i:i + BLOCK_NAMES] for i in range(0, len(names), BLOCK_NAMES)
+        ] or [[]]
+        manifest = {
+            "v": 1,
+            "bucket": bucket,
+            "prefix": prefix,
+            "start": start,
+            "created": created,
+            "nblocks": len(blocks),
+            "first": [b[0] if b else "" for b in blocks],
+            "count": len(names),
+        }
+        targets = self._online_disks()[:REPLICAS]
+        if not targets:
+            return
+        for d in targets:
+            try:
+                for i, blk in enumerate(blocks):
+                    d.write_all(SYSTEM_VOL, self._path(bucket, cid, f"block-{i}.json"),
+                                json.dumps(blk).encode())
+                d.write_all(SYSTEM_VOL, self._path(bucket, cid, "manifest.json"),
+                            json.dumps(manifest).encode())
+            except errors.StorageError:
+                continue
+        with self._lock:
+            self._mem[(bucket, prefix, start)] = (created, names)
+            while len(self._mem) > self._mem_cap:
+                oldest = min(self._mem, key=lambda k: self._mem[k][0])
+                del self._mem[oldest]
+
+    def _load_persisted(self, bucket: str, prefix: str,
+                        start: str) -> tuple[float, list[str]] | None:
+        cid = _cache_id(bucket, prefix, start)
+        for d in self._online_disks():
+            try:
+                raw = d.read_all(SYSTEM_VOL, self._path(bucket, cid, "manifest.json"))
+            except errors.StorageError:
+                continue
+            try:
+                man = json.loads(raw)
+                if man.get("bucket") != bucket or man.get("prefix") != prefix:
+                    continue
+                names: list[str] = []
+                for i in range(man["nblocks"]):
+                    blk = d.read_all(SYSTEM_VOL,
+                                     self._path(bucket, cid, f"block-{i}.json"))
+                    names.extend(json.loads(blk))
+                return float(man["created"]), names
+            except (errors.StorageError, ValueError, KeyError):
+                continue
+        return None
+
+    # -- lookup -------------------------------------------------------------
+    def _usable(self, created: float, marker: str) -> bool:
+        age = time.time() - created
+        if marker:
+            return age < CACHE_TTL
+        return age < FRESH_TTL
+
+    def lookup(self, bucket: str, prefix: str, marker: str,
+               include_marker: bool) -> list[str] | None:
+        """Names >= marker from a usable cache, or None on miss."""
+        if bucket.startswith("."):
+            return None
+        # candidate starts: exact-marker continuation caches are keyed by
+        # the start they were saved under; try the full-walk cache (start
+        # "") first, any in-memory cache whose start precedes the marker
+        # (page chains that began mid-namespace), then the marker itself.
+        candidates = [""]
+        if marker:
+            with self._lock:
+                candidates.extend(
+                    s for (b, p, s) in self._mem
+                    if b == bucket and p == prefix and s and s <= marker
+                )
+            candidates.append(marker)
+            candidates = list(dict.fromkeys(candidates))
+        for start in candidates:
+            if start and not (start <= marker):
+                continue
+            with self._lock:
+                hit = self._mem.get((bucket, prefix, start))
+            if hit is None:
+                hit = self._load_persisted(bucket, prefix, start)
+                if hit is not None:
+                    with self._lock:
+                        self._mem[(bucket, prefix, start)] = hit
+            if hit is None:
+                continue
+            created, names = hit
+            if not self._usable(created, marker):
+                continue
+            if marker:
+                import bisect
+                if include_marker:
+                    idx = bisect.bisect_left(names, marker)
+                else:
+                    idx = bisect.bisect_right(names, marker)
+                return names[idx:]
+            return list(names)
+        return None
+
+
+def attach(api) -> MetacacheManager | None:
+    """Get (lazily creating) the api object's metacache manager."""
+    mc = getattr(api, "_metacache", None)
+    if mc is None:
+        try:
+            mc = MetacacheManager(api)
+        except Exception:
+            return None
+        try:
+            api._metacache = mc
+        except Exception:
+            return None
+    return mc
