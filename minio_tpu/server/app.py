@@ -217,6 +217,26 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
         admin plane can reach it (reference: serverMain starting
         initAutoHeal/initHealMRF/initDataScanner, cmd/server-main.go:528)."""
         self.services = services
+        if services is not None and services.scanner.lifecycle_fn is None:
+            # scanner applies this server's stored ILM configs
+            # (cmd/data-scanner.go:891 applyActions)
+            from minio_tpu.services.lifecycle import LifecycleRunner
+
+            services.scanner.lifecycle_fn = LifecycleRunner(self.api, self.meta)
+
+    def _quota_check(self, bucket: str, size: int) -> None:
+        """Hard-quota enforcement against the scanner's usage cache
+        (reference enforceBucketQuota, cmd/bucket-quota.go:112)."""
+        quota = self.meta.quota(bucket)
+        if quota <= 0:
+            return
+        usage = 0
+        if self.services is not None:
+            bu = self.services.scanner.usage.buckets.get(bucket)
+            if bu is not None:
+                usage = bu.size
+        if usage + max(size, 0) > quota:
+            raise S3Error("XMinioAdminBucketQuotaExceeded", resource=bucket)
 
     # ------------------------------------------------------------------ util
     async def _run(self, fn, *args, **kw):
@@ -882,6 +902,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
         real_size = int(decoded_len) if streaming and decoded_len else (
             size if size is not None else -1
         )
+        await self._run(self._quota_check, bucket, real_size)
         user_meta = {
             k.lower(): v for k, v in request.headers.items()
             if k.lower().startswith("x-amz-meta-")
@@ -1011,6 +1032,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
         from minio_tpu.crypto import sse as sse_mod
 
         soi = await self._run(self.api.get_object_info, sbucket, skey, vid)
+        await self._run(self._quota_check, bucket, soi.size)
         src_meta = dict(soi.metadata)
         if src_meta.get(sse_mod.META_ALGO):
             # decrypt the source (SSE-C copy-source headers not yet wired:
@@ -1223,6 +1245,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
         real_size = int(decoded_len) if streaming and decoded_len else (
             size if size is not None else -1
         )
+        await self._run(self._quota_check, bucket, real_size)
         pipe = _QueuePipeReader()
         reader: io.RawIOBase = (
             _ChunkedSigReader(pipe, ctx) if streaming else pipe

@@ -350,6 +350,7 @@ class ObjectExtraHandlers:
 
         if not self.iam.is_allowed(access_key, "s3:PutObject", bucket, key):
             raise S3Error("AccessDenied", "not allowed to PutObject")
+        await self._run(self._quota_check, bucket, len(file_data))
 
         opts = PutObjectOptions(
             content_type=form.get("content-type", ""),

@@ -39,6 +39,7 @@ S3_ERRORS = {
     "ServiceUnavailable": (503, "Please reduce your request rate."),
     "SlowDown": (503, "Please reduce your request rate."),
     "XMinioServerNotInitialized": (503, "Server not initialized, please try again."),
+    "XMinioAdminBucketQuotaExceeded": (400, "Bucket quota exceeded"),
     "AuthorizationHeaderMalformed": (400, "The authorization header is malformed."),
     "AuthorizationQueryParametersError": (400, "Error parsing the X-Amz-Credential parameter."),
     "NotModified": (304, ""),

@@ -77,6 +77,7 @@ class DataUsageInfo:
     objects_scanned: int = 0
     heals_triggered: int = 0
     lifecycle_actions: int = 0
+    lifecycle_errors: int = 0
 
     def total_size(self) -> int:
         return sum(u.size for u in self.buckets.values())
@@ -92,6 +93,7 @@ class DataUsageInfo:
             "objectsScanned": self.objects_scanned,
             "healsTriggered": self.heals_triggered,
             "lifecycleActions": self.lifecycle_actions,
+            "lifecycleErrors": self.lifecycle_errors,
             "bucketsUsage": {b: u.to_dict() for b, u in self.buckets.items()},
         }
 
@@ -100,7 +102,8 @@ class DataUsageInfo:
         info = cls(last_update=d.get("lastUpdate", 0.0),
                    objects_scanned=d.get("objectsScanned", 0),
                    heals_triggered=d.get("healsTriggered", 0),
-                   lifecycle_actions=d.get("lifecycleActions", 0))
+                   lifecycle_actions=d.get("lifecycleActions", 0),
+                   lifecycle_errors=d.get("lifecycleErrors", 0))
         info.buckets = {b: BucketUsage.from_dict(u)
                         for b, u in d.get("bucketsUsage", {}).items()}
         return info
@@ -191,7 +194,9 @@ class DataScanner:
                             info.lifecycle_actions += 1
                             continue
                     except Exception:
-                        pass
+                        # evaluation failures must not stop the scan, but a
+                        # silently-broken ILM pipeline must be observable
+                        info.lifecycle_errors += 1
                 if fi.deleted:
                     usage.delete_markers += 1
                 else:
