@@ -66,6 +66,16 @@ class AdminMixin:
         r.add_get(f"{p}/groups", wrap(self.admin_list_groups, "ListGroups"))
         r.add_put(f"{p}/add-service-account",
                   wrap(self.admin_add_service_account, "CreateServiceAccount"))
+        # replication remote targets (reference cmd/admin-bucket-handlers.go
+        # SetRemoteTargetHandler / ListRemoteTargetsHandler)
+        r.add_put(f"{p}/set-remote-target",
+                  wrap(self.admin_set_remote_target, "SetBucketTarget"))
+        r.add_get(f"{p}/list-remote-targets",
+                  wrap(self.admin_list_remote_targets, "GetBucketTarget"))
+        r.add_delete(f"{p}/remove-remote-target",
+                     wrap(self.admin_remove_remote_target, "SetBucketTarget"))
+        r.add_put(f"{p}/replication-resync",
+                  wrap(self.admin_replication_resync, "SetBucketTarget"))
 
     # ---------------------------------------------------------------- auth
     def _admin_wrap(self, fn, op: str):
@@ -306,3 +316,78 @@ class AdminMixin:
             raise S3Error("InvalidArgument", str(e))
         return self._json({"accessKey": ident.access_key,
                            "secretKey": ident.secret_key})
+
+    # ---------------------------------------------------- replication targets
+    def _load_targets(self, bucket: str) -> list[dict]:
+        raw = self.meta.get(bucket).get("replication_targets")
+        try:
+            return json.loads(raw) if raw else []
+        except ValueError:
+            return []
+
+    async def admin_set_remote_target(self, request: web.Request, body: bytes):
+        import uuid
+
+        from minio_tpu.services.replication import ReplicationTarget
+
+        bucket = request.rel_url.query.get("bucket", "")
+        if not bucket:
+            raise S3Error("InvalidArgument", "bucket query param required")
+        try:
+            doc = json.loads(body)
+        except ValueError:
+            raise S3Error("InvalidArgument", "body must be JSON")
+        creds = doc.get("credentials") or {}
+        tgt = ReplicationTarget(
+            arn=doc.get("arn") or
+            f"arn:minio:replication::{uuid.uuid4().hex[:12]}:"
+            f"{doc.get('targetbucket', doc.get('bucket', ''))}",
+            endpoint=doc.get("endpoint", ""),
+            bucket=doc.get("targetbucket", doc.get("bucket", "")),
+            access_key=doc.get("accessKey", creds.get("accessKey", "")),
+            secret_key=doc.get("secretKey", creds.get("secretKey", "")),
+            region=doc.get("region", "us-east-1"),
+        )
+        if not tgt.endpoint or not tgt.bucket:
+            raise S3Error("InvalidArgument", "endpoint and targetbucket required")
+        targets = [t for t in self._load_targets(bucket)
+                   if t.get("arn") != tgt.arn]
+        targets.append(tgt.to_dict())
+        await self._run(self.meta.set_config, bucket, "replication_targets",
+                        json.dumps(targets))
+        return self._json({"arn": tgt.arn})
+
+    async def admin_list_remote_targets(self, request: web.Request,
+                                        body: bytes):
+        bucket = request.rel_url.query.get("bucket", "")
+        if not bucket:
+            raise S3Error("InvalidArgument", "bucket query param required")
+        targets = await self._run(self._load_targets, bucket)
+        for t in targets:
+            t.pop("secretKey", None)  # never return credentials
+        return self._json(targets)
+
+    async def admin_remove_remote_target(self, request: web.Request,
+                                         body: bytes):
+        bucket = request.rel_url.query.get("bucket", "")
+        arn = request.rel_url.query.get("arn", "")
+        if not bucket or not arn:
+            raise S3Error("InvalidArgument", "bucket and arn required")
+        targets = [t for t in await self._run(self._load_targets, bucket)
+                   if t.get("arn") != arn]
+        await self._run(self.meta.set_config, bucket, "replication_targets",
+                        json.dumps(targets))
+        return self._json({})
+
+    async def admin_replication_resync(self, request: web.Request,
+                                       body: bytes):
+        """Re-enqueue every object of the bucket for replication
+        (reference startReplicationResync)."""
+        bucket = request.rel_url.query.get("bucket", "")
+        if not bucket:
+            raise S3Error("InvalidArgument", "bucket query param required")
+        services = self._services_or_503()
+        if services.replication is None:
+            raise S3Error("XMinioServerNotInitialized")
+        n = await self._run(services.replication.resync, bucket)
+        return self._json({"enqueued": n})

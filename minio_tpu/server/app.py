@@ -223,6 +223,11 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
             from minio_tpu.services.lifecycle import LifecycleRunner
 
             services.scanner.lifecycle_fn = LifecycleRunner(self.api, self.meta)
+        if services is not None \
+                and getattr(services, "replication", None) is None:
+            from minio_tpu.services.replication import ReplicationPool
+
+            services.replication = ReplicationPool(self.api, self.meta)
 
     def _quota_check(self, bucket: str, size: int) -> None:
         """Hard-quota enforcement against the scanner's usage cache
@@ -883,6 +888,10 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
         for lk in (LOCK_MODE_KEY, LOCK_UNTIL_KEY, LOCK_HOLD_KEY):
             if oi.metadata.get(lk):
                 h[lk] = oi.metadata[lk]
+        from minio_tpu.services.replication import REPL_STATUS_KEY
+
+        if oi.metadata.get(REPL_STATUS_KEY):
+            h["x-amz-replication-status"] = oi.metadata[REPL_STATUS_KEY]
         return h
 
     async def put_object(self, request: web.Request) -> web.Response:
@@ -936,6 +945,23 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
                 if hold not in ("ON", "OFF"):
                     raise S3Error("InvalidArgument", "bad legal-hold status")
                 user_meta[LOCK_HOLD_KEY] = hold
+        # replication decision (reference mustReplicate,
+        # cmd/bucket-replication.go:169): a matching rule marks the version
+        # PENDING and enqueues after commit; an incoming replica PUT from a
+        # source cluster is marked REPLICA and never re-replicated
+        from minio_tpu.services import replication as repl
+
+        must_replicate = False
+        if request.headers.get(repl.REPLICA_HEADER):
+            user_meta[repl.REPL_STATUS_KEY] = repl.REPLICA
+        else:
+            rcfg = await self._run(self.meta.replication_config, bucket)
+            if rcfg is not None and rcfg.match(key) is not None \
+                    and self.services is not None \
+                    and getattr(self.services, "replication", None) is not None:
+                must_replicate = True
+                user_meta[repl.REPL_STATUS_KEY] = repl.PENDING
+
         opts = PutObjectOptions(
             content_type=request.headers.get("Content-Type", ""),
             user_metadata=user_meta,
@@ -1001,6 +1027,10 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
             headers["x-amz-version-id"] = oi.version_id
         if sse_kind:
             headers.update(self.sse_response_headers(opts.user_metadata))
+        if must_replicate:
+            headers["x-amz-replication-status"] = repl.PENDING
+            self.services.replication.replicate_object(bucket, key,
+                                                       oi.version_id)
         from minio_tpu.events.event import EventName
 
         self._emit(EventName.OBJECT_CREATED_PUT, bucket, key, size=oi.size,
@@ -1205,6 +1235,14 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
             headers["x-amz-delete-marker"] = "true"
         if oi.version_id:
             headers["x-amz-version-id"] = oi.version_id
+        # delete / delete-marker replication (replicateDelete,
+        # cmd/bucket-replication.go)
+        if self.services is not None \
+                and getattr(self.services, "replication", None) is not None:
+            rcfg = await self._run(self.meta.replication_config, bucket)
+            if rcfg is not None and rcfg.match(key) is not None:
+                self.services.replication.replicate_delete(
+                    bucket, key, vid, delete_marker=oi.delete_marker)
         from minio_tpu.events.event import EventName
 
         self._emit(

@@ -25,6 +25,7 @@ class ServiceManager:
                                    heal_queue=self.mrf.enqueue,
                                    lifecycle_fn=lifecycle_fn)
         self.bg_heal = BackgroundHealer(object_layer, interval=heal_interval)
+        self.replication = None  # ReplicationPool, wired by attach_services
         self._attach_heal_queue()
 
     def _attach_heal_queue(self) -> None:
@@ -37,6 +38,8 @@ class ServiceManager:
         self.scanner.close()
         self.bg_heal.close()
         self.mrf.close()
+        if self.replication is not None:
+            self.replication.close()
 
 
 __all__ = [

@@ -1,0 +1,235 @@
+"""Async bucket replication: worker pool, remote targets, resync.
+
+Reference: cmd/bucket-replication.go:826 (replicateObject via a worker
+pool fed from replicationPool), cmd/bucket-targets.go (remote-target
+registry with ARNs), delete/delete-marker replication
+(cmd/bucket-replication.go replicateDelete), and resync of existing
+objects.
+
+Flow: PutObject under a matching replication rule stores
+`x-minio-replication-status: PENDING` in the version's metadata and
+enqueues a replicate op; a worker streams the object from the local
+layer, PUTs it to the rule's remote target with replica markers, then
+flips the source status to COMPLETED (FAILED after retries exhaust,
+left for the next resync).  Deletes replicate as deletes (or delete
+markers on versioned targets).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+
+from minio_tpu.utils.s3client import S3Client, S3ClientError
+
+# version-metadata key carrying replication state (surfaced as the
+# x-amz-replication-status response header)
+REPL_STATUS_KEY = "x-minio-replication-status"
+# marker a replica PUT carries so the target records REPLICA status
+REPLICA_HEADER = "x-minio-source-replication-request"
+
+PENDING = "PENDING"
+COMPLETED = "COMPLETED"
+FAILED = "FAILED"
+REPLICA = "REPLICA"
+
+MAX_ATTEMPTS = 3
+
+
+@dataclass
+class ReplicationTarget:
+    """One remote target (reference madmin.BucketTarget)."""
+
+    arn: str
+    endpoint: str
+    bucket: str
+    access_key: str
+    secret_key: str
+    region: str = "us-east-1"
+
+    def to_dict(self) -> dict:
+        return {"arn": self.arn, "endpoint": self.endpoint,
+                "bucket": self.bucket, "accessKey": self.access_key,
+                "secretKey": self.secret_key, "region": self.region}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ReplicationTarget":
+        return cls(arn=d["arn"], endpoint=d["endpoint"], bucket=d["bucket"],
+                   access_key=d.get("accessKey", ""),
+                   secret_key=d.get("secretKey", ""),
+                   region=d.get("region", "us-east-1"))
+
+    def client(self) -> S3Client:
+        return S3Client(self.endpoint, self.access_key, self.secret_key,
+                        region=self.region)
+
+
+@dataclass
+class ReplicationOp:
+    bucket: str
+    name: str
+    version_id: str = ""
+    delete: bool = False
+    delete_marker: bool = False
+    attempts: int = 0
+    not_before: float = 0.0
+
+
+@dataclass
+class ReplicationStats:
+    queued: int = 0
+    completed: int = 0
+    failed: int = 0
+    deletes: int = 0
+    bytes_replicated: int = 0
+
+    def to_dict(self) -> dict:
+        return {"queued": self.queued, "completed": self.completed,
+                "failed": self.failed, "deletes": self.deletes,
+                "bytesReplicated": self.bytes_replicated}
+
+
+class ReplicationPool:
+    """Background replicate workers for one server process
+    (reference replicationPool, cmd/bucket-replication.go bottom)."""
+
+    def __init__(self, api, meta, workers: int = 2):
+        self.api = api
+        self.meta = meta
+        self.stats = ReplicationStats()
+        self._q: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._work, daemon=True,
+                             name=f"replication-{i}")
+            for i in range(workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        for _ in self._threads:
+            self._q.put(None)
+        for t in self._threads:
+            t.join(timeout=2)
+
+    # -- enqueue ------------------------------------------------------------
+    def enqueue(self, op: ReplicationOp) -> None:
+        self.stats.queued += 1
+        self._q.put(op)
+
+    def replicate_object(self, bucket: str, name: str,
+                         version_id: str = "") -> None:
+        self.enqueue(ReplicationOp(bucket, name, version_id))
+
+    def replicate_delete(self, bucket: str, name: str, version_id: str = "",
+                         delete_marker: bool = False) -> None:
+        self.enqueue(ReplicationOp(bucket, name, version_id, delete=True,
+                                   delete_marker=delete_marker))
+
+    def resync(self, bucket: str) -> int:
+        """Enqueue every existing object of the bucket (reference
+        startReplicationResync)."""
+        n = 0
+        for name in self.api.list_objects(bucket):
+            self.replicate_object(bucket, name)
+            n += 1
+        return n
+
+    # -- target registry ----------------------------------------------------
+    def target_for(self, bucket: str, arn: str) -> ReplicationTarget | None:
+        for t in self.targets(bucket):
+            if t.arn == arn or t.bucket == arn:
+                return t
+        return None
+
+    def targets(self, bucket: str) -> list[ReplicationTarget]:
+        raw = self.meta.get(bucket).get("replication_targets")
+        if not raw:
+            return []
+        try:
+            return [ReplicationTarget.from_dict(d) for d in json.loads(raw)]
+        except (ValueError, KeyError):
+            return []
+
+    # -- worker -------------------------------------------------------------
+    def _work(self) -> None:
+        while not self._stop.is_set():
+            try:
+                op = self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if op is None:
+                return
+            delay = op.not_before - time.time()
+            if delay > 0:
+                time.sleep(min(delay, 2.0))
+                if op.not_before > time.time():
+                    self._q.put(op)
+                    continue
+            try:
+                self._process(op)
+            except Exception:
+                op.attempts += 1
+                if op.attempts < MAX_ATTEMPTS:
+                    op.not_before = time.time() + 0.5 * (2 ** op.attempts)
+                    self._q.put(op)
+                else:
+                    self.stats.failed += 1
+                    if not op.delete:
+                        self._set_status(op, FAILED)
+
+    def _rule_and_target(self, op: ReplicationOp):
+        cfg = self.meta.replication_config(op.bucket)
+        if cfg is None:
+            return None, None
+        rule = cfg.match(op.name)
+        if rule is None:
+            return None, None
+        tgt = self.target_for(op.bucket, rule.destination_arn) \
+            or self.target_for(op.bucket, rule.target_bucket)
+        return rule, tgt
+
+    def _process(self, op: ReplicationOp) -> None:
+        rule, tgt = self._rule_and_target(op)
+        if rule is None or tgt is None:
+            return  # config/target removed since enqueue
+        client = tgt.client()
+        if op.delete:
+            if op.delete_marker and not rule.delete_marker_replication:
+                return
+            if not op.delete_marker and not rule.delete_replication:
+                return
+            try:
+                client.delete_object(tgt.bucket, op.name)
+            except S3ClientError as e:
+                if e.status != 404:
+                    raise
+            self.stats.deletes += 1
+            return
+
+        oi, stream = self.api.get_object(op.bucket, op.name,
+                                         version_id=op.version_id)
+        data = b"".join(stream)
+        headers = {REPLICA_HEADER: "true"}
+        if oi.content_type:
+            headers["Content-Type"] = oi.content_type
+        for k, v in (oi.metadata or {}).items():
+            if k.startswith("x-amz-meta-"):
+                headers[k] = v
+        client.put_object(tgt.bucket, op.name, data, headers=headers)
+        self.stats.completed += 1
+        self.stats.bytes_replicated += len(data)
+        self._set_status(op, COMPLETED)
+
+    def _set_status(self, op: ReplicationOp, status: str) -> None:
+        try:
+            self.api.update_object_metadata(
+                op.bucket, op.name, {REPL_STATUS_KEY: status},
+                version_id=op.version_id)
+        except Exception:
+            pass

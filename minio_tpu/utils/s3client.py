@@ -1,0 +1,92 @@
+"""Minimal SigV4 S3 client for server-to-server traffic.
+
+Used by the replication workers and warm-tier backends to talk to remote
+clusters (reference: the madmin/minio-go clients behind
+cmd/bucket-targets.go and cmd/warm-backend-s3.go).  Synchronous
+http.client on purpose: callers run on worker threads.
+"""
+
+from __future__ import annotations
+
+import http.client
+import urllib.parse
+
+from minio_tpu.server import sigv4
+
+
+class S3ClientError(Exception):
+    def __init__(self, status: int, body: bytes = b""):
+        super().__init__(f"remote returned {status}")
+        self.status = status
+        self.body = body
+
+
+class S3Client:
+    def __init__(self, endpoint: str, access_key: str, secret_key: str,
+                 region: str = "us-east-1", timeout: float = 30.0):
+        # endpoint: "host:port" or "http://host:port"
+        ep = endpoint
+        if "://" in ep:
+            ep = ep.split("://", 1)[1]
+        self.netloc = ep.rstrip("/")
+        self.ak = access_key
+        self.sk = secret_key
+        self.region = region
+        self.timeout = timeout
+
+    def _request(self, method: str, bucket: str, key: str = "",
+                 body: bytes = b"", headers: dict | None = None,
+                 query: list[tuple[str, str]] | None = None,
+                 ok: tuple = (200, 204)) -> tuple[int, dict, bytes]:
+        path = f"/{bucket}" + (f"/{key}" if key else "")
+        quoted = urllib.parse.quote(path)
+        headers = dict(headers or {})
+        headers["host"] = self.netloc
+        query = list(query or [])
+        signed = sigv4.sign_request(method, quoted, query, headers, body,
+                                    self.ak, self.sk, region=self.region)
+        qs = "&".join(
+            f"{urllib.parse.quote(k, safe='')}={urllib.parse.quote(v, safe='')}"
+            for k, v in query
+        )
+        url = quoted + (f"?{qs}" if qs else "")
+        host, _, port = self.netloc.partition(":")
+        conn = http.client.HTTPConnection(host, int(port or 80),
+                                          timeout=self.timeout)
+        try:
+            conn.request(method, url, body=body or None, headers=signed)
+            resp = conn.getresponse()
+            data = resp.read()
+            rh = {k.lower(): v for k, v in resp.getheaders()}
+            if resp.status not in ok:
+                raise S3ClientError(resp.status, data)
+            return resp.status, rh, data
+        finally:
+            conn.close()
+
+    # -- object ops ---------------------------------------------------------
+    def put_object(self, bucket: str, key: str, data: bytes,
+                   headers: dict | None = None) -> dict:
+        _, rh, _ = self._request("PUT", bucket, key, body=data,
+                                 headers=headers)
+        return rh
+
+    def get_object(self, bucket: str, key: str) -> tuple[dict, bytes]:
+        _, rh, data = self._request("GET", bucket, key)
+        return rh, data
+
+    def head_object(self, bucket: str, key: str) -> dict:
+        _, rh, _ = self._request("HEAD", bucket, key)
+        return rh
+
+    def delete_object(self, bucket: str, key: str,
+                      version_id: str = "") -> None:
+        q = [("versionId", version_id)] if version_id else None
+        self._request("DELETE", bucket, key, query=q, ok=(200, 204))
+
+    def bucket_exists(self, bucket: str) -> bool:
+        try:
+            self._request("HEAD", bucket, ok=(200,))
+            return True
+        except (S3ClientError, OSError):
+            return False

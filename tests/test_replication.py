@@ -1,0 +1,112 @@
+"""Bucket replication: async replicate-on-put across two clusters,
+delete replication, status headers, resync (VERDICT r1 item 9).
+
+Reference: cmd/bucket-replication.go:826 (replicateObject),
+cmd/bucket-targets.go (remote targets)."""
+
+import json
+import time
+
+import pytest
+
+from .s3_harness import S3TestServer
+
+ADMIN = "/minio/admin/v3"
+
+REPL_CFG = (
+    '<ReplicationConfiguration>'
+    '<Role>arn:minio:replication</Role>'
+    '<Rule><ID>r1</ID><Status>Enabled</Status><Priority>1</Priority>'
+    '<Filter><Prefix></Prefix></Filter>'
+    '<DeleteMarkerReplication><Status>Enabled</Status></DeleteMarkerReplication>'
+    '<DeleteReplication><Status>Enabled</Status></DeleteReplication>'
+    '<Destination><Bucket>{arn}</Bucket></Destination>'
+    '</Rule></ReplicationConfiguration>'
+)
+
+
+def _wait(cond, timeout=10.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(0.1)
+    return False
+
+
+@pytest.fixture
+def pair(tmp_path):
+    src = S3TestServer(str(tmp_path / "src"), start_services=True,
+                       scan_interval=3600.0)
+    dst = S3TestServer(str(tmp_path / "dst"), start_services=True,
+                       scan_interval=3600.0)
+    src.request("PUT", "/srcbkt")
+    dst.request("PUT", "/dstbkt")
+    ver = (b'<VersioningConfiguration><Status>Enabled</Status>'
+           b'</VersioningConfiguration>')
+    src.request("PUT", "/srcbkt", query=[("versioning", "")], data=ver)
+    dst.request("PUT", "/dstbkt", query=[("versioning", "")], data=ver)
+    # register the remote target and wire the replication config
+    r = src.request("PUT", f"{ADMIN}/set-remote-target",
+                    query=[("bucket", "srcbkt")],
+                    data=json.dumps({
+                        "endpoint": dst.host, "targetbucket": "dstbkt",
+                        "accessKey": dst.ak, "secretKey": dst.sk,
+                    }).encode())
+    assert r.status == 200, r.text()
+    arn = json.loads(r.text())["arn"]
+    r = src.request("PUT", "/srcbkt", query=[("replication", "")],
+                    data=REPL_CFG.format(arn=arn).encode())
+    assert r.status == 200, r.text()
+    yield src, dst
+    src.close()
+    dst.close()
+
+
+class TestReplication:
+    def test_put_replicates(self, pair):
+        src, dst = pair
+        r = src.request("PUT", "/srcbkt/hello", data=b"replicated world",
+                        headers={"x-amz-meta-color": "blue"})
+        assert r.status == 200
+        assert r.headers.get("x-amz-replication-status") == "PENDING"
+        assert _wait(lambda: dst.request("GET", "/dstbkt/hello").status == 200)
+        got = dst.request("GET", "/dstbkt/hello")
+        assert got.body == b"replicated world"
+        assert got.headers.get("x-amz-meta-color") == "blue"
+        # replica is marked REPLICA on the target, COMPLETED on the source
+        assert got.headers.get("x-amz-replication-status") == "REPLICA"
+        assert _wait(lambda: src.request("HEAD", "/srcbkt/hello").headers.get(
+            "x-amz-replication-status") == "COMPLETED")
+
+    def test_delete_replicates(self, pair):
+        src, dst = pair
+        src.request("PUT", "/srcbkt/gone", data=b"x")
+        assert _wait(lambda: dst.request("GET", "/dstbkt/gone").status == 200)
+        assert src.request("DELETE", "/srcbkt/gone").status == 204
+        assert _wait(lambda: dst.request("GET", "/dstbkt/gone").status == 404)
+
+    def test_resync_replicates_existing(self, pair):
+        src, dst = pair
+        # objects written while the target bucket is unreachable: simulate by
+        # writing directly through the object layer (no enqueue)
+        import io
+
+        src.server.api.put_object("srcbkt", "pre/one", io.BytesIO(b"a"), 1)
+        src.server.api.put_object("srcbkt", "pre/two", io.BytesIO(b"b"), 1)
+        assert dst.request("GET", "/dstbkt/pre/one").status == 404
+        r = src.request("PUT", f"{ADMIN}/replication-resync",
+                        query=[("bucket", "srcbkt")])
+        assert r.status == 200
+        assert json.loads(r.text())["enqueued"] >= 2
+        assert _wait(lambda: dst.request("GET", "/dstbkt/pre/one").status == 200)
+        assert _wait(lambda: dst.request("GET", "/dstbkt/pre/two").status == 200)
+
+    def test_targets_listed_without_secrets(self, pair):
+        src, _ = pair
+        r = src.request("GET", f"{ADMIN}/list-remote-targets",
+                        query=[("bucket", "srcbkt")])
+        targets = json.loads(r.text())
+        assert len(targets) == 1
+        assert targets[0]["bucket"] == "dstbkt"
+        assert "secretKey" not in targets[0]
