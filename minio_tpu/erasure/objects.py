@@ -428,6 +428,17 @@ class ErasureObjects:
 
         self._fan_out(rm, range(len(self.disks)))
 
+    def contains(self, bucket: str, obj: str) -> bool:
+        """Quorum-visible object record exists (ANY version, including a
+        delete-marker latest) — the pool-routing probe (reference probes
+        pools with a raw meta read, cmd/erasure-server-pool.go:289)."""
+        try:
+            with self.ns.read(f"{bucket}/{obj}"):
+                self._quorum_info(bucket, obj)
+            return True
+        except errors.StorageError:
+            return False
+
     # ------------------------------------------------------------------- GET
     def get_object_info(self, bucket: str, obj: str, version_id: str = ""
                         ) -> ObjectInfo:
@@ -567,8 +578,34 @@ class ErasureObjects:
 
     # ---------------------------------------------------------------- DELETE
     def delete_object(self, bucket: str, obj: str, version_id: str = "",
-                      versioned: bool = False) -> ObjectInfo:
+                      versioned: bool = False,
+                      suspended: bool = False) -> ObjectInfo:
         with self.ns.write(f"{bucket}/{obj}"):
+            if suspended and not version_id:
+                # versioning suspended: the delete marker takes the null id,
+                # permanently replacing any existing null version while
+                # leaving real versions intact (AWS suspended semantics;
+                # reference null-version handling in DeleteObject)
+                from minio_tpu.storage.xlmeta import NULL_VERSION_ID
+
+                marker = FileInfo(volume=bucket, name=obj, version_id="",
+                                  deleted=True, mod_time=time.time())
+
+                def put_null_marker(i: int) -> None:
+                    d = self.disks[i]
+                    if d is None or not d.is_online():
+                        raise errors.DiskNotFound(str(i))
+                    d.delete_version(bucket, obj, marker,
+                                     force_del_marker=True)
+
+                errs = self._fan_out(put_null_marker, range(len(self.disks)))
+                _, wq = self._quorum_from([None] * len(self.disks))
+                if sum(1 for e2 in errs if e2 is None) < wq:
+                    raise errors.ErasureWriteQuorum("delete marker quorum")
+                return ObjectInfo(bucket=bucket, name=obj,
+                                  version_id=NULL_VERSION_ID,
+                                  delete_marker=True,
+                                  mod_time=marker.mod_time)
             if versioned and not version_id:
                 # versioned delete without version: write a delete marker
                 marker = FileInfo(

@@ -66,6 +66,20 @@ def choose_set_layout(n_drives: int, set_size: int | None = None) -> tuple[int, 
     return 1, n_drives
 
 
+def _versioning_status_of(meta: dict) -> str:
+    """Normalize the stored versioning value: legacy bool True reads as
+    Enabled; otherwise the stored status string ('' | Enabled | Suspended)."""
+    v = meta.get("versioning")
+    if v is True:
+        return "Enabled"
+    return v or ""
+
+
+def _versioning_status_arg(status) -> str:
+    return ("Enabled" if status else "Suspended") \
+        if isinstance(status, bool) else status
+
+
 class ErasureSets:
     """One pool: drives split into erasure sets, sipHashMod routing."""
 
@@ -199,9 +213,13 @@ class ErasureSets:
     def get_object_info(self, bucket, obj, version_id="") -> ObjectInfo:
         return self.get_hashed_set(obj).get_object_info(bucket, obj, version_id)
 
-    def delete_object(self, bucket, obj, version_id="", versioned=False):
+    def contains(self, bucket, obj) -> bool:
+        return self.get_hashed_set(obj).contains(bucket, obj)
+
+    def delete_object(self, bucket, obj, version_id="", versioned=False,
+                      suspended=False):
         return self.get_hashed_set(obj).delete_object(bucket, obj, version_id,
-                                                      versioned)
+                                                      versioned, suspended)
 
     def heal_object(self, bucket, obj, version_id="", deep=False) -> HealResult:
         return self.get_hashed_set(obj).heal_object(bucket, obj, version_id, deep)
@@ -316,13 +334,17 @@ class ErasureSets:
         meta.update(kv)
         self.set_bucket_metadata(bucket, meta)
 
-    def versioning_enabled(self, bucket: str) -> bool:
-        return bool(self.get_bucket_metadata(bucket).get("versioning"))
+    def versioning_status(self, bucket: str) -> str:
+        return _versioning_status_of(self.get_bucket_metadata(bucket))
 
-    def set_versioning(self, bucket: str, enabled: bool) -> None:
+    def versioning_enabled(self, bucket: str) -> bool:
+        return self.versioning_status(bucket) == "Enabled"
+
+    def set_versioning(self, bucket: str, status) -> None:
         if not self.bucket_exists(bucket):
             raise errors.BucketNotFound(bucket)
-        self.update_bucket_metadata(bucket, versioning=bool(enabled))
+        self.update_bucket_metadata(
+            bucket, versioning=_versioning_status_arg(status))
 
     # -- info ---------------------------------------------------------------
     def storage_info(self) -> dict:
@@ -385,15 +407,12 @@ class ErasureServerPools:
 
     # -- placement ----------------------------------------------------------
     def _pool_of(self, bucket: str, obj: str) -> ErasureSets | None:
-        """Pool already holding the object, if any."""
+        """Pool already holding the object — ANY version counts, including
+        a delete-marker latest (else a marker-topped object could never be
+        version-addressed or permanently deleted)."""
         for p in self.pools:
-            try:
-                p.get_object_info(bucket, obj)
+            if p.contains(bucket, obj):
                 return p
-            except errors.MethodNotAllowed:
-                return p  # delete marker lives here
-            except errors.StorageError:
-                continue
         return None
 
     def _pool_for_new(self) -> ErasureSets:
@@ -426,16 +445,17 @@ class ErasureServerPools:
                 last = ex
         raise last
 
-    def delete_object(self, bucket, obj, version_id="", versioned=False):
+    def delete_object(self, bucket, obj, version_id="", versioned=False,
+                      suspended=False):
         if not self.bucket_exists(bucket):
             raise errors.BucketNotFound(bucket)
         pool = self._pool_of(bucket, obj)
         if pool is None:
-            if versioned and not version_id:
+            if (versioned or suspended) and not version_id:
                 pool = self.pools[0]
             else:
                 return ObjectInfo(bucket=bucket, name=obj, version_id=version_id)
-        return pool.delete_object(bucket, obj, version_id, versioned)
+        return pool.delete_object(bucket, obj, version_id, versioned, suspended)
 
     def heal_object(self, bucket, obj, version_id="", deep=False) -> HealResult:
         for p in self.pools:
@@ -551,11 +571,15 @@ class ErasureServerPools:
         for p in self.pools:
             p.update_bucket_metadata(bucket, **kv)
 
-    def versioning_enabled(self, bucket: str) -> bool:
-        return bool(self.get_bucket_metadata(bucket).get("versioning"))
+    def versioning_status(self, bucket: str) -> str:
+        return _versioning_status_of(self.get_bucket_metadata(bucket))
 
-    def set_versioning(self, bucket: str, enabled: bool) -> None:
+    def versioning_enabled(self, bucket: str) -> bool:
+        return self.versioning_status(bucket) == "Enabled"
+
+    def set_versioning(self, bucket: str, status) -> None:
         if not self.bucket_exists(bucket):
             raise errors.BucketNotFound(bucket)
         for p in self.pools:
-            p.update_bucket_metadata(bucket, versioning=bool(enabled))
+            p.update_bucket_metadata(
+                bucket, versioning=_versioning_status_arg(status))

@@ -319,11 +319,9 @@ class AdminMixin:
 
     # ---------------------------------------------------- replication targets
     def _load_targets(self, bucket: str) -> list[dict]:
-        raw = self.meta.get(bucket).get("replication_targets")
-        try:
-            return json.loads(raw) if raw else []
-        except ValueError:
-            return []
+        from minio_tpu.services.replication import load_targets
+
+        return [t.to_dict() for t in load_targets(self.meta, bucket)]
 
     async def admin_set_remote_target(self, request: web.Request, body: bytes):
         import uuid

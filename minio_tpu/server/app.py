@@ -260,11 +260,14 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
             except queue_mod.Full:
                 await asyncio.sleep(0.005)
 
-    def _xml(self, status: int, body: str) -> web.Response:
+    def _xml(self, status: int, body: str,
+             headers: dict | None = None) -> web.Response:
+        h = {"Server": "MinIO-TPU"}
+        if headers:
+            h.update(headers)
         return web.Response(
             status=status, body=body.encode(),
-            content_type="application/xml",
-            headers={"Server": "MinIO-TPU"},
+            content_type="application/xml", headers=h,
         )
 
     async def _auth(self, request: web.Request, payload_hash: str | None,
@@ -603,8 +606,8 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
     async def get_versioning(self, request: web.Request) -> web.Response:
         bucket = self._bucket(request)
         await self._auth(request, None, "s3:GetBucketVersioning", bucket)
-        enabled = await self._versioned(bucket)
-        inner = "<Status>Enabled</Status>" if enabled else ""
+        status = await self._vstatus(bucket)
+        inner = f"<Status>{status}</Status>" if status else ""
         return self._xml(200, (
             f'<?xml version="1.0" encoding="UTF-8"?>'
             f'<VersioningConfiguration xmlns="{XMLNS}">{inner}'
@@ -640,7 +643,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
         setter = getattr(self.api, "set_versioning", None)
         if setter is None:
             raise S3Error("NotImplemented")
-        await self._run(setter, bucket, status == "Enabled")
+        await self._run(setter, bucket, status)
         self.meta.changed(bucket)
         return web.Response(status=200)
 
@@ -814,7 +817,15 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
             raise S3Error("MalformedXML")
         ns = f"{{{XMLNS}}}"
         conditions = self._request_conditions(request)
-        versioned = await self._versioned(bucket)
+        vstatus = await self._vstatus(bucket)
+        repl_pool = None
+        rcfg_for_delete = None
+        if self.services is not None \
+                and getattr(self.services, "replication", None) is not None:
+            rcfg_for_delete = await self._run(
+                self.meta.replication_config, bucket)
+            if rcfg_for_delete is not None:
+                repl_pool = self.services.replication
         results = []
         for obj in root.findall(f"{ns}Object") + root.findall("Object"):
             key = obj.findtext(f"{ns}Key") or obj.findtext("Key") or ""
@@ -841,9 +852,14 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
                 continue
             try:
                 doi = await self._run(
-                    self.api.delete_object, bucket, key, vid, versioned
+                    self.api.delete_object, bucket, key, vid,
+                    vstatus == "Enabled", vstatus == "Suspended"
                 )
                 results.append(f"<Deleted><Key>{escape(key)}</Key></Deleted>")
+                if repl_pool is not None \
+                        and rcfg_for_delete.match(key) is not None:
+                    repl_pool.replicate_delete(
+                        bucket, key, vid, delete_marker=doi.delete_marker)
                 from minio_tpu.events.event import EventName
 
                 self._emit(
@@ -953,6 +969,15 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
 
         must_replicate = False
         if request.headers.get(repl.REPLICA_HEADER):
+            # only a principal holding s3:ReplicateObject may mark a PUT as
+            # an incoming replica (otherwise any writer could suppress the
+            # bucket's outbound replication with one header — reference
+            # checks ReplicateObjectAction, cmd/object-handlers.go)
+            if not await self._authorized(
+                    ctx.access_key, "s3:ReplicateObject", bucket, key,
+                    self._request_conditions(request)):
+                raise S3Error("AccessDenied",
+                              "s3:ReplicateObject permission required")
             user_meta[repl.REPL_STATUS_KEY] = repl.REPLICA
         else:
             rcfg = await self._run(self.meta.replication_config, bucket)
@@ -962,10 +987,11 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
                 must_replicate = True
                 user_meta[repl.REPL_STATUS_KEY] = repl.PENDING
 
+        vstatus = await self._vstatus(bucket)
         opts = PutObjectOptions(
             content_type=request.headers.get("Content-Type", ""),
             user_metadata=user_meta,
-            versioned=await self._versioned(bucket),
+            versioned=vstatus == "Enabled",
         )
 
         pipe = _QueuePipeReader()
@@ -1025,6 +1051,9 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
         headers = {"ETag": f'"{oi.etag}"'}
         if oi.version_id:
             headers["x-amz-version-id"] = oi.version_id
+        elif vstatus == "Suspended":
+            # suspended bucket: the write landed as the null version
+            headers["x-amz-version-id"] = "null"
         if sse_kind:
             headers.update(self.sse_response_headers(opts.user_metadata))
         if must_replicate:
@@ -1037,11 +1066,45 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
                    etag=oi.etag, version_id=oi.version_id, request=request)
         return web.Response(status=200, headers=headers)
 
+    async def _maybe_replicate(self, request, bucket: str, key: str,
+                               oi) -> str | None:
+        """Post-commit replication decision for paths that bypass the
+        simple-PUT pipeline (CompleteMultipartUpload, CopyObject): mark
+        the new version PENDING and enqueue it.  Returns the status header
+        value, or None when no rule matches (reference mustReplicate is
+        checked on every write path, cmd/bucket-replication.go:169)."""
+        from minio_tpu.services import replication as repl
+
+        if request is not None and request.headers.get(repl.REPLICA_HEADER):
+            return None  # incoming replica: never re-replicate
+        if self.services is None \
+                or getattr(self.services, "replication", None) is None:
+            return None
+        rcfg = await self._run(self.meta.replication_config, bucket)
+        if rcfg is None or rcfg.match(key) is None:
+            return None
+        try:
+            await self._run(self.api.update_object_metadata, bucket, key,
+                            {repl.REPL_STATUS_KEY: repl.PENDING},
+                            oi.version_id)
+        except Exception:
+            pass
+        self.services.replication.replicate_object(bucket, key,
+                                                   oi.version_id)
+        return repl.PENDING
+
     async def _versioned(self, bucket: str) -> bool:
+        return (await self._vstatus(bucket)) == "Enabled"
+
+    async def _vstatus(self, bucket: str) -> str:
+        """Bucket versioning status: '' | 'Enabled' | 'Suspended'."""
+        fn = getattr(self.api, "versioning_status", None)
+        if fn is not None:
+            return await self._run(fn, bucket)
         fn = getattr(self.api, "versioning_enabled", None)
         if fn is None:
-            return False
-        return bool(await self._run(fn, bucket))
+            return ""
+        return "Enabled" if await self._run(fn, bucket) else ""
 
     async def copy_object(self, request: web.Request, bucket: str, key: str,
                           copy_src: str, ctx=None) -> web.Response:
@@ -1104,6 +1167,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
         new_oi = await self._run(
             self.api.put_object, bucket, key, reader, size, opts
         )
+        await self._maybe_replicate(request, bucket, key, new_oi)
         from minio_tpu.events.event import EventName
 
         self._emit(EventName.OBJECT_CREATED_COPY, bucket, key,
@@ -1145,6 +1209,8 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
         await self._auth(request, None, "s3:GetObject", bucket, key)
         vid = request.rel_url.query.get("versionId", "")
         oi = await self._run(self.api.get_object_info, bucket, key, vid)
+        if vid == "null":
+            oi.version_id = "null"
         self.check_preconditions(request, oi)
 
         encrypted = bool(oi.metadata.get(sse_mod.META_ALGO))
@@ -1205,6 +1271,8 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
         await self._auth(request, None, "s3:GetObject", bucket, key)
         vid = request.rel_url.query.get("versionId", "")
         oi = await self._run(self.api.get_object_info, bucket, key, vid)
+        if vid == "null":
+            oi.version_id = "null"
         self.check_preconditions(request, oi)
         headers = self._obj_headers(oi)
         if oi.metadata.get(sse_mod.META_ALGO):
@@ -1224,11 +1292,12 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
         bucket, key = self._object(request)
         ctx = await self._auth(request, None, "s3:DeleteObject", bucket, key)
         vid = request.rel_url.query.get("versionId", "")
-        versioned = await self._versioned(bucket)
+        vstatus = await self._vstatus(bucket)
         await self.enforce_retention_for_delete(request, bucket, key, vid,
                                                 ctx.access_key)
         oi = await self._run(
-            self.api.delete_object, bucket, key, vid, versioned
+            self.api.delete_object, bucket, key, vid,
+            vstatus == "Enabled", vstatus == "Suspended"
         )
         headers = {}
         if oi.delete_marker:
@@ -1377,11 +1446,14 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
             if "out of order" in str(e):
                 raise S3Error("InvalidPartOrder")
             raise S3Error("InvalidPart", str(e))
+        repl_status = await self._maybe_replicate(request, bucket, key, oi)
         from minio_tpu.events.event import EventName
 
         self._emit(EventName.OBJECT_CREATED_COMPLETE_MULTIPART, bucket, key,
                    size=oi.size, etag=oi.etag, version_id=oi.version_id,
                    request=request)
+        hdrs = {"x-amz-replication-status": repl_status} if repl_status \
+            else None
         return self._xml(200, (
             f'<?xml version="1.0" encoding="UTF-8"?>'
             f'<CompleteMultipartUploadResult xmlns="{XMLNS}">'
@@ -1389,7 +1461,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
             f"<Bucket>{escape(bucket)}</Bucket><Key>{escape(key)}</Key>"
             f'<ETag>&quot;{oi.etag}&quot;</ETag>'
             f"</CompleteMultipartUploadResult>"
-        ))
+        ), headers=hdrs)
 
 
 def _event_queue_dir(object_layer) -> str | None:

@@ -258,6 +258,10 @@ class ObjectExtraHandlers:
         except (st.ObjectNotFound, st.VersionNotFound, st.FileNotFound,
                 st.FileVersionNotFound, st.BucketNotFound):
             return
+        except st.MethodNotAllowed:
+            # the addressed version is a delete marker: no retention
+            # metadata to enforce, and deleting a marker is always allowed
+            return
         # anything else (e.g. read-quorum loss) must FAIL CLOSED: a
         # transient outage cannot become a WORM bypass
         if oi.metadata.get(LOCK_HOLD_KEY) == "ON":

@@ -39,6 +39,19 @@ REPLICA = "REPLICA"
 MAX_ATTEMPTS = 3
 
 
+def load_targets(meta, bucket: str) -> list[ReplicationTarget]:
+    """Parse the bucket's registered remote targets — the ONE place the
+    replication_targets JSON schema is interpreted (admin handlers and the
+    worker pool both call this)."""
+    raw = meta.get(bucket).get("replication_targets")
+    if not raw:
+        return []
+    try:
+        return [ReplicationTarget.from_dict(d) for d in json.loads(raw)]
+    except (ValueError, KeyError):
+        return []
+
+
 @dataclass
 class ReplicationTarget:
     """One remote target (reference madmin.BucketTarget)."""
@@ -148,13 +161,7 @@ class ReplicationPool:
         return None
 
     def targets(self, bucket: str) -> list[ReplicationTarget]:
-        raw = self.meta.get(bucket).get("replication_targets")
-        if not raw:
-            return []
-        try:
-            return [ReplicationTarget.from_dict(d) for d in json.loads(raw)]
-        except (ValueError, KeyError):
-            return []
+        return load_targets(self.meta, bucket)
 
     # -- worker -------------------------------------------------------------
     def _work(self) -> None:
@@ -200,6 +207,13 @@ class ReplicationPool:
             return  # config/target removed since enqueue
         client = tgt.client()
         if op.delete:
+            if op.version_id and not op.delete_marker:
+                # version-specific (permanent) deletes do NOT replicate:
+                # replica versions get fresh ids at the target, so the
+                # source vid is meaningless there, and deleting the
+                # target's live version would diverge the clusters
+                # (reference VersionPurgeStatus gating)
+                return
             if op.delete_marker and not rule.delete_marker_replication:
                 return
             if not op.delete_marker and not rule.delete_replication:
@@ -214,16 +228,21 @@ class ReplicationPool:
 
         oi, stream = self.api.get_object(op.bucket, op.name,
                                          version_id=op.version_id)
-        data = b"".join(stream)
         headers = {REPLICA_HEADER: "true"}
         if oi.content_type:
             headers["Content-Type"] = oi.content_type
         for k, v in (oi.metadata or {}).items():
             if k.startswith("x-amz-meta-"):
                 headers[k] = v
-        client.put_object(tgt.bucket, op.name, data, headers=headers)
+        # stream the shards straight to the remote: no full-object buffer
+        try:
+            client.put_object(tgt.bucket, op.name, iter(stream),
+                              headers=headers, length=oi.size)
+        finally:
+            if hasattr(stream, "close"):
+                stream.close()
         self.stats.completed += 1
-        self.stats.bytes_replicated += len(data)
+        self.stats.bytes_replicated += oi.size
         self._set_status(op, COMPLETED)
 
     def _set_status(self, op: ReplicationOp, status: str) -> None:

@@ -23,7 +23,7 @@ from typing import BinaryIO, Iterator
 
 from . import errors
 from .api import DiskInfo, StorageAPI, VolInfo
-from .xlmeta import FileInfo, XLMeta, file_info_from_raw
+from .xlmeta import NULL_VERSION_ID, FileInfo, XLMeta, file_info_from_raw
 
 SYSTEM_VOL = ".minio_tpu.sys"
 TMP_DIR = "tmp"
@@ -327,6 +327,11 @@ class LocalStorage(StorageAPI):
 
     def delete_version(self, volume: str, path: str, fi: FileInfo,
                        force_del_marker: bool = False) -> None:
+        if fi.version_id == NULL_VERSION_ID:
+            # API sentinel for the internal empty-id null version
+            import dataclasses
+
+            fi = dataclasses.replace(fi, version_id="")
         try:
             xl = XLMeta.loads(self.read_xl(volume, path))
         except errors.FileNotFound:
@@ -335,8 +340,15 @@ class LocalStorage(StorageAPI):
                 return
             raise
         if fi.deleted and not fi.version_id:
-            # writing a delete marker on top
-            xl.add_version(fi)
+            # writing a delete marker on top; under suspended versioning the
+            # marker has the null id and permanently replaces any existing
+            # null version (AWS suspended-bucket semantics) — reclaim its data
+            replaced = xl.add_version(fi)
+            if replaced is not None and replaced.get("dd"):
+                shutil.rmtree(
+                    os.path.join(self._file_path(volume, path),
+                                 replaced["dd"]),
+                    ignore_errors=True)
             self._write_xl(volume, path, xl)
             return
         v = xl.delete_version(fi.version_id)
@@ -381,8 +393,15 @@ class LocalStorage(StorageAPI):
             xl = XLMeta.loads(self.read_xl(dst_volume, dst_path))
         except errors.FileNotFound:
             xl = XLMeta()
-        xl.add_version(fi)
+        replaced = xl.add_version(fi)
         self._write_xl(dst_volume, dst_path, xl)
+        if replaced is not None and replaced.get("dd") \
+                and replaced["dd"] != fi.data_dir:
+            # overwrite of an unversioned / null version: reclaim the old
+            # data dir (reference deletes old dataDir in RenameData,
+            # cmd/xl-storage.go:1964)
+            shutil.rmtree(os.path.join(dst_obj_dir, replaced["dd"]),
+                          ignore_errors=True)
 
     # -- listing ------------------------------------------------------------
     def list_dir(self, volume: str, path: str, count: int = -1) -> list[str]:

@@ -183,20 +183,40 @@ class XLMeta:
         return cls(doc.get("vers", []))
 
     # -- version operations -------------------------------------------------
-    def add_version(self, fi: FileInfo) -> None:
+    def add_version(self, fi: FileInfo) -> dict | None:
+        """Insert a version, replacing any same-id entry.  Returns the
+        replaced entry (if any) so the caller can reclaim its data dir."""
         obj = fi.to_obj()
         vid = obj.get("v", "")
-        self.versions = [v for v in self.versions if v.get("v", "") != vid]
+        replaced = None
+        kept = []
+        for v in self.versions:
+            if v.get("v", "") == vid:
+                replaced = v
+            else:
+                kept.append(v)
+        self.versions = kept
         self.versions.insert(0, obj)
         self.versions.sort(key=lambda v: v.get("mt", 0.0), reverse=True)
+        return replaced
 
     def delete_version(self, version_id: str) -> dict | None:
+        # the API-level sentinel "null" addresses the internal empty-id
+        # version (the "null version" written while versioning is off or
+        # suspended — reference nullVersionID, cmd/xl-storage-format-v2.go)
+        if version_id == NULL_VERSION_ID:
+            version_id = ""
         for i, v in enumerate(self.versions):
             if v.get("v", "") == version_id:
                 return self.versions.pop(i)
         return None
 
     def find_version(self, version_id: str) -> dict | None:
+        if version_id == NULL_VERSION_ID:
+            for v in self.versions:
+                if v.get("v", "") == "":
+                    return v
+            return None
         if not version_id:
             return self.versions[0] if self.versions else None
         for v in self.versions:

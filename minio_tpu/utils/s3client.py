@@ -24,37 +24,62 @@ class S3ClientError(Exception):
 class S3Client:
     def __init__(self, endpoint: str, access_key: str, secret_key: str,
                  region: str = "us-east-1", timeout: float = 30.0):
-        # endpoint: "host:port" or "http://host:port"
+        # endpoint: "host:port", "http://host:port" or "https://host[:port]"
         ep = endpoint
+        self.tls = False
         if "://" in ep:
-            ep = ep.split("://", 1)[1]
+            scheme, ep = ep.split("://", 1)
+            if scheme == "https":
+                self.tls = True
+            elif scheme != "http":
+                raise ValueError(f"unsupported endpoint scheme {scheme!r}")
         self.netloc = ep.rstrip("/")
         self.ak = access_key
         self.sk = secret_key
         self.region = region
         self.timeout = timeout
 
+    def _connect(self) -> http.client.HTTPConnection:
+        host, _, port = self.netloc.partition(":")
+        default = 443 if self.tls else 80
+        cls = http.client.HTTPSConnection if self.tls \
+            else http.client.HTTPConnection
+        return cls(host, int(port or default), timeout=self.timeout)
+
     def _request(self, method: str, bucket: str, key: str = "",
-                 body: bytes = b"", headers: dict | None = None,
+                 body=b"", headers: dict | None = None,
                  query: list[tuple[str, str]] | None = None,
-                 ok: tuple = (200, 204)) -> tuple[int, dict, bytes]:
+                 ok: tuple = (200, 204),
+                 length: int | None = None) -> tuple[int, dict, bytes]:
+        """`body` may be bytes (signed payload) or an iterable of bytes
+        chunks: iterables stream with Content-Length=`length` and an
+        UNSIGNED-PAYLOAD signature, so large objects never materialize
+        in memory."""
         path = f"/{bucket}" + (f"/{key}" if key else "")
         quoted = urllib.parse.quote(path)
         headers = dict(headers or {})
         headers["host"] = self.netloc
         query = list(query or [])
-        signed = sigv4.sign_request(method, quoted, query, headers, body,
-                                    self.ak, self.sk, region=self.region)
+        streaming = not isinstance(body, (bytes, bytearray))
+        if streaming:
+            if length is None:
+                raise ValueError("streaming body requires explicit length")
+            headers["content-length"] = str(length)
+            signed = sigv4.sign_request(method, quoted, query, headers, None,
+                                        self.ak, self.sk, region=self.region)
+        else:
+            signed = sigv4.sign_request(method, quoted, query, headers, body,
+                                        self.ak, self.sk, region=self.region)
         qs = "&".join(
             f"{urllib.parse.quote(k, safe='')}={urllib.parse.quote(v, safe='')}"
             for k, v in query
         )
         url = quoted + (f"?{qs}" if qs else "")
-        host, _, port = self.netloc.partition(":")
-        conn = http.client.HTTPConnection(host, int(port or 80),
-                                          timeout=self.timeout)
+        conn = self._connect()
         try:
-            conn.request(method, url, body=body or None, headers=signed)
+            conn.request(method, url,
+                         body=body if streaming else (body or None),
+                         headers=signed)
             resp = conn.getresponse()
             data = resp.read()
             rh = {k.lower(): v for k, v in resp.getheaders()}
@@ -65,10 +90,12 @@ class S3Client:
             conn.close()
 
     # -- object ops ---------------------------------------------------------
-    def put_object(self, bucket: str, key: str, data: bytes,
-                   headers: dict | None = None) -> dict:
+    def put_object(self, bucket: str, key: str, data,
+                   headers: dict | None = None,
+                   length: int | None = None) -> dict:
+        """`data`: bytes, or an iterable of chunks with `length` set."""
         _, rh, _ = self._request("PUT", bucket, key, body=data,
-                                 headers=headers)
+                                 headers=headers, length=length)
         return rh
 
     def get_object(self, bucket: str, key: str) -> tuple[dict, bytes]:

@@ -110,3 +110,73 @@ class TestReplication:
         assert len(targets) == 1
         assert targets[0]["bucket"] == "dstbkt"
         assert "secretKey" not in targets[0]
+
+
+class TestReplicationReviewFixes:
+    """Regressions for the round-2 review findings: batch-delete
+    replication, multipart replication, version-delete skip, and the
+    REPLICA-header permission gate."""
+
+    def test_batch_delete_replicates(self, pair):
+        src, dst = pair
+        src.request("PUT", "/srcbkt/bd1", data=b"x")
+        assert _wait(lambda: dst.request("GET", "/dstbkt/bd1").status == 200)
+        body = (
+            '<Delete><Object><Key>bd1</Key></Object></Delete>'
+        ).encode()
+        r = src.request("POST", "/srcbkt", query=[("delete", "")], data=body)
+        assert r.status == 200 and "<Deleted>" in r.text()
+        # the delete-marker must reach the target
+        assert _wait(lambda: dst.request("GET", "/dstbkt/bd1").status == 404)
+
+    def test_multipart_replicates(self, pair):
+        src, dst = pair
+        r = src.request("POST", "/srcbkt/mp1", query=[("uploads", "")])
+        uid = r.text().split("<UploadId>")[1].split("</UploadId>")[0]
+        part = b"p" * (5 << 20)
+        r = src.request("PUT", "/srcbkt/mp1",
+                        query=[("partNumber", "1"), ("uploadId", uid)],
+                        data=part)
+        etag = r.headers["ETag"].strip('"')
+        done = (f'<CompleteMultipartUpload><Part><PartNumber>1</PartNumber>'
+                f'<ETag>"{etag}"</ETag></Part>'
+                f'</CompleteMultipartUpload>').encode()
+        r = src.request("POST", "/srcbkt/mp1", query=[("uploadId", uid)],
+                        data=done)
+        assert r.status == 200
+        assert r.headers.get("x-amz-replication-status") == "PENDING"
+        assert _wait(lambda: dst.request("GET", "/dstbkt/mp1").status == 200)
+        assert dst.request("GET", "/dstbkt/mp1").body == part
+
+    def test_version_specific_delete_not_replicated(self, pair):
+        src, dst = pair
+        r = src.request("PUT", "/srcbkt/vd1", data=b"keepme")
+        vid = r.headers.get("x-amz-version-id")
+        assert _wait(lambda: dst.request("GET", "/dstbkt/vd1").status == 200)
+        # permanent version delete on the source must NOT delete the
+        # target's live replica
+        r = src.request("DELETE", "/srcbkt/vd1", query=[("versionId", vid)])
+        assert r.status == 204
+        time.sleep(1.0)
+        assert dst.request("GET", "/dstbkt/vd1").status == 200
+
+    def test_replica_header_requires_permission(self, pair):
+        src, _ = pair
+        # a user without s3:ReplicateObject cannot mark its PUT as replica
+        src.iam.set_policy("putonly", json.dumps({
+            "Version": "2012-10-17",
+            "Statement": [{"Effect": "Allow",
+                           "Action": ["s3:PutObject", "s3:GetObject"],
+                           "Resource": ["arn:aws:s3:::srcbkt/*"]}],
+        }))
+        src.iam.add_user("limited", "limitedsecret", policies=["putonly"])
+        r = src.request("PUT", "/srcbkt/rh1", data=b"x",
+                        headers={"x-minio-source-replication-request": "true"},
+                        creds=("limited", "limitedsecret"))
+        assert r.status == 403, r.text()
+        # root (implicit admin) may
+        r = src.request("PUT", "/srcbkt/rh2", data=b"x",
+                        headers={"x-minio-source-replication-request": "true"})
+        assert r.status == 200
+        assert _wait(lambda: src.request("HEAD", "/srcbkt/rh2").headers.get(
+            "x-amz-replication-status") == "REPLICA")

@@ -1,0 +1,130 @@
+"""Suspended-versioning (null version) semantics.
+
+AWS behavior being pinned (reference null-version handling in
+cmd/erasure-object.go + cmd/bucket-handlers.go):
+- PUT on a Suspended bucket writes the *null version* (versionId "null"),
+  overwriting any previous null version while keeping real versions.
+- DELETE without versionId inserts a delete marker with versionId "null",
+  permanently removing any existing null version.
+- versionId=null addresses the null version for GET/HEAD/DELETE.
+- GetBucketVersioning reports Suspended.
+"""
+
+import os
+
+import pytest
+
+from tests.s3_harness import S3TestServer
+
+XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
+
+
+def _vcfg(status: str) -> bytes:
+    return (
+        f'<VersioningConfiguration xmlns="{XMLNS}">'
+        f"<Status>{status}</Status></VersioningConfiguration>"
+    ).encode()
+
+
+@pytest.fixture(scope="module")
+def srv(tmp_path_factory):
+    os.environ["MINIO_TPU_FSYNC"] = "0"
+    s = S3TestServer(str(tmp_path_factory.mktemp("sv")))
+    yield s
+    s.close()
+
+
+class TestSuspendedVersioning:
+    def test_status_round_trip(self, srv):
+        srv.request("PUT", "/svb")
+        assert srv.request("PUT", "/svb", query=[("versioning", "")],
+                           data=_vcfg("Enabled")).status == 200
+        assert srv.request("PUT", "/svb", query=[("versioning", "")],
+                           data=_vcfg("Suspended")).status == 200
+        assert "<Status>Suspended</Status>" in srv.request(
+            "GET", "/svb", query=[("versioning", "")]).text()
+
+    def test_null_version_put_get(self, srv):
+        srv.request("PUT", "/svb2")
+        srv.request("PUT", "/svb2", query=[("versioning", "")],
+                    data=_vcfg("Enabled"))
+        v1 = srv.request("PUT", "/svb2/doc", data=b"v1").headers.get(
+            "x-amz-version-id")
+        assert v1 and v1 != "null"
+        srv.request("PUT", "/svb2", query=[("versioning", "")],
+                    data=_vcfg("Suspended"))
+        # suspended PUT lands as the null version
+        r = srv.request("PUT", "/svb2/doc", data=b"null-1")
+        assert r.headers.get("x-amz-version-id") == "null"
+        # a second suspended PUT overwrites the null version in place
+        r = srv.request("PUT", "/svb2/doc", data=b"null-2")
+        assert r.headers.get("x-amz-version-id") == "null"
+
+        assert srv.request("GET", "/svb2/doc").body == b"null-2"
+        rn = srv.request("GET", "/svb2/doc", query=[("versionId", "null")])
+        assert rn.body == b"null-2"
+        assert rn.headers.get("x-amz-version-id") == "null"
+        # the pre-suspension real version is still addressable
+        assert srv.request("GET", "/svb2/doc",
+                           query=[("versionId", v1)]).body == b"v1"
+        # exactly one null version + one real version listed
+        body = srv.request("GET", "/svb2", query=[("versions", "")]).text()
+        assert body.count("<VersionId>null</VersionId>") == 1
+        assert f"<VersionId>{v1}</VersionId>" in body
+
+    def test_suspended_delete_writes_null_marker(self, srv):
+        srv.request("PUT", "/svb3")
+        srv.request("PUT", "/svb3", query=[("versioning", "")],
+                    data=_vcfg("Enabled"))
+        v1 = srv.request("PUT", "/svb3/doc", data=b"v1").headers.get(
+            "x-amz-version-id")
+        srv.request("PUT", "/svb3", query=[("versioning", "")],
+                    data=_vcfg("Suspended"))
+        srv.request("PUT", "/svb3/doc", data=b"null-data")
+
+        r = srv.request("DELETE", "/svb3/doc")
+        assert r.status == 204
+        assert r.headers.get("x-amz-delete-marker") == "true"
+        assert r.headers.get("x-amz-version-id") == "null"
+
+        # the null DATA version is gone for good; marker took its id
+        assert srv.request("GET", "/svb3/doc").status == 404
+        body = srv.request("GET", "/svb3", query=[("versions", "")]).text()
+        assert "<DeleteMarker>" in body
+        assert body.count("<VersionId>null</VersionId>") == 1
+        # real version survives
+        assert srv.request("GET", "/svb3/doc",
+                           query=[("versionId", v1)]).body == b"v1"
+
+        # deleting versionId=null removes the marker; latest resolves to v1
+        r = srv.request("DELETE", "/svb3/doc", query=[("versionId", "null")])
+        assert r.status == 204
+        assert srv.request("GET", "/svb3/doc").body == b"v1"
+
+    def test_suspended_delete_idempotent_without_object(self, srv):
+        srv.request("PUT", "/svb4")
+        srv.request("PUT", "/svb4", query=[("versioning", "")],
+                    data=_vcfg("Enabled"))
+        srv.request("PUT", "/svb4", query=[("versioning", "")],
+                    data=_vcfg("Suspended"))
+        # delete of a nonexistent key still inserts a null marker (AWS does)
+        r = srv.request("DELETE", "/svb4/ghost")
+        assert r.status == 204
+        assert r.headers.get("x-amz-delete-marker") == "true"
+
+    def test_reenable_after_suspension(self, srv):
+        srv.request("PUT", "/svb5")
+        srv.request("PUT", "/svb5", query=[("versioning", "")],
+                    data=_vcfg("Enabled"))
+        srv.request("PUT", "/svb5", query=[("versioning", "")],
+                    data=_vcfg("Suspended"))
+        srv.request("PUT", "/svb5/doc", data=b"null-v")
+        srv.request("PUT", "/svb5", query=[("versioning", "")],
+                    data=_vcfg("Enabled"))
+        v2 = srv.request("PUT", "/svb5/doc", data=b"v2").headers.get(
+            "x-amz-version-id")
+        assert v2 and v2 != "null"
+        # null version preserved underneath the new real version
+        assert srv.request("GET", "/svb5/doc").body == b"v2"
+        assert srv.request("GET", "/svb5/doc",
+                           query=[("versionId", "null")]).body == b"null-v"
