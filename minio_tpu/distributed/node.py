@@ -19,6 +19,7 @@ import uuid
 from minio_tpu.erasure.sets import ErasureSets, ErasureServerPools
 from minio_tpu.server.app import S3Server
 from minio_tpu.storage import errors
+from minio_tpu.storage.instrumented import InstrumentedStorage
 from minio_tpu.storage.local import LocalStorage
 from .dsync import (
     DistributedNamespaceLock, LocalLocker, _LocalLockerClient,
@@ -120,14 +121,16 @@ class ClusterNode:
                 d = LocalStorage(path, endpoint=f"{host}:{port}{path}"
                                  if host else path)
                 self.local_drives[path] = d
-                disks.append(d)
+                # the object layer sees the instrumented view (per-op
+                # counters + EWMA latency, reference xlStorageDiskIDCheck)
+                disks.append(InstrumentedStorage(d))
             else:
                 key = f"{host}:{port}"
                 client = self.peer_clients.get(key)
                 if client is None:
                     client = RpcClient(host, port, secret_key)
                     self.peer_clients[key] = client
-                disks.append(RemoteStorage(client, path))
+                disks.append(InstrumentedStorage(RemoteStorage(client, path)))
 
         self.locker = LocalLocker()
         self.distributed = len(n_nodes) > 1

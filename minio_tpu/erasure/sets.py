@@ -352,11 +352,15 @@ class ErasureSets:
         for d in self.all_disks:
             try:
                 di = d.disk_info()
-                disks.append({
+                entry = {
                     "endpoint": di.endpoint, "total": di.total, "free": di.free,
                     "used": di.used, "online": d.is_online(), "id": di.id,
                     "healing": di.healing,
-                })
+                }
+                if hasattr(d, "op_stats"):
+                    # instrumented wrapper: per-op counters + EWMA latency
+                    entry["opStats"] = d.op_stats()
+                disks.append(entry)
             except Exception as ex:
                 disks.append({"endpoint": getattr(d, "root", "?"),
                               "online": False, "error": str(ex)})

@@ -38,6 +38,7 @@ from .object_extras import (
 )
 from .s3errors import S3Error, from_storage_error
 from .admin import AdminMixin
+from .metrics import MetricsMixin
 from .sse_handlers import SSEMixin, load_kms
 
 XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
@@ -158,7 +159,8 @@ class _QueuePipeReader(io.RawIOBase):
         return out
 
 
-class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
+class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
+               MetricsMixin):
     def __init__(self, object_layer, access_key: str = "minioadmin",
                  secret_key: str = "minioadmin", region: str = "us-east-1",
                  max_concurrency: int = 64, iam=None):
@@ -190,8 +192,11 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
             max_workers=max_concurrency + 4, thread_name_prefix="s3-api"
         )
         self.app = web.Application(client_max_size=1 << 30)
-        # fixed-prefix routes (admin plane) win over the S3 catch-alls
+        self.init_metrics()
+        # fixed-prefix routes (admin + metrics/health) win over the S3
+        # catch-alls
         self.register_admin_routes(self.app)
+        self.register_metrics_routes(self.app)
         self.app.router.add_route("*", "/", self.dispatch_root)
         self.app.router.add_route("*", "/{bucket}", self.dispatch_bucket)
         self.app.router.add_route("*", "/{bucket}/{key:.*}", self.dispatch_object)
@@ -372,22 +377,41 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin):
         ))
 
     async def _handle(self, request: web.Request, fn) -> web.StreamResponse:
-        async with self.sem:
-            try:
-                return await fn(request)
-            except S3Error as e:
-                return web.Response(
-                    status=e.status,
-                    body=e.to_xml(secrets.token_hex(8)),
-                    content_type="application/xml",
-                )
-            except Exception as e:  # storage & unexpected errors
-                s3e = from_storage_error(e, request.path)
-                return web.Response(
-                    status=s3e.status,
-                    body=s3e.to_xml(secrets.token_hex(8)),
-                    content_type="application/xml",
-                )
+        t0 = time.monotonic()
+        api = getattr(fn, "__name__", "unknown")
+        self._m_inflight.inc()
+        status = 500
+        tx = 0
+        try:
+            async with self.sem:
+                try:
+                    resp = await fn(request)
+                    status = resp.status
+                    tx = resp.content_length or 0
+                    return resp
+                except asyncio.CancelledError:
+                    # client went away mid-request: not a server error
+                    status = 499
+                    raise
+                except S3Error as e:
+                    status = e.status
+                    return web.Response(
+                        status=e.status,
+                        body=e.to_xml(secrets.token_hex(8)),
+                        content_type="application/xml",
+                    )
+                except Exception as e:  # storage & unexpected errors
+                    s3e = from_storage_error(e, request.path)
+                    status = s3e.status
+                    return web.Response(
+                        status=s3e.status,
+                        body=s3e.to_xml(secrets.token_hex(8)),
+                        content_type="application/xml",
+                    )
+        finally:
+            self._m_inflight.dec()
+            self.record_api(api, status, time.monotonic() - t0,
+                            rx=request.content_length or 0, tx=tx)
 
     # -------------------------------------------------------------- dispatch
     async def dispatch_root(self, request: web.Request) -> web.StreamResponse:

@@ -1,0 +1,228 @@
+"""Prometheus metrics + health endpoints.
+
+Reference: cmd/metrics-v2.go (metric groups for capacity, drives, API
+requests, heal, replication, scanner) served at
+/minio/v2/metrics/{cluster,node}, and cmd/healthcheck-handler.go:36
+(/minio/health/{live,ready,cluster} with quorum awareness).
+
+Auth follows the reference default: metrics require an authenticated
+admin principal (admin:Prometheus) unless MINIO_PROMETHEUS_AUTH_TYPE is
+set to "public".  Health endpoints are always unauthenticated.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from aiohttp import web
+
+from minio_tpu.utils.prom import Registry, _fmt_labels
+from .s3errors import S3Error
+
+METRICS_PREFIX = "/minio/v2/metrics"
+HEALTH_PREFIX = "/minio/health"
+
+# request-duration buckets tuned for object storage (reference uses
+# 8 buckets from 50ms..10s plus the Go client defaults)
+API_BUCKETS = (.005, .025, .05, .1, .25, .5, 1, 2.5, 5, 10, 30)
+
+
+class MetricsMixin:
+    """Mixin for S3Server: registry, per-request recording, endpoints."""
+
+    def init_metrics(self) -> None:
+        r = Registry()
+        self.metrics = r
+        self._m_requests = r.counter(
+            "minio_s3_requests_total",
+            "Total S3 API requests", ("api",))
+        self._m_errors = r.counter(
+            "minio_s3_requests_errors_total",
+            "S3 requests that returned an error", ("api",))
+        self._m_4xx = r.counter(
+            "minio_s3_requests_4xx_errors_total",
+            "S3 requests with a 4xx response", ("api",))
+        self._m_5xx = r.counter(
+            "minio_s3_requests_5xx_errors_total",
+            "S3 requests with a 5xx response", ("api",))
+        self._m_ttfb = r.histogram(
+            "minio_s3_ttfb_seconds",
+            "Time to serve an S3 request", ("api",), buckets=API_BUCKETS)
+        self._m_inflight = r.gauge(
+            "minio_s3_requests_inflight_total",
+            "Currently executing S3 requests")
+        self._m_rx = r.counter(
+            "minio_s3_traffic_received_bytes",
+            "Bytes received from S3 clients")
+        self._m_tx = r.counter(
+            "minio_s3_traffic_sent_bytes",
+            "Bytes sent to S3 clients")
+        self._m_uptime = r.gauge(
+            "minio_node_uptime_seconds", "Server uptime")
+        self._m_uptime.set_function(
+            lambda: time.time() - self._start_time)
+
+    # -- recording (called from the request funnel) --------------------------
+    def record_api(self, api: str, status: int, dt: float,
+                   rx: int = 0, tx: int = 0) -> None:
+        self._m_requests.labels(api).inc()
+        self._m_ttfb.labels(api).observe(dt)
+        if status >= 500:
+            self._m_5xx.labels(api).inc()
+            self._m_errors.labels(api).inc()
+        elif status >= 400:
+            self._m_4xx.labels(api).inc()
+            self._m_errors.labels(api).inc()
+        if rx:
+            self._m_rx.inc(rx)
+        if tx:
+            self._m_tx.inc(tx)
+
+    # -- routes --------------------------------------------------------------
+    def register_metrics_routes(self, app: web.Application) -> None:
+        r = app.router
+        r.add_get(f"{METRICS_PREFIX}/cluster", self.handle_metrics)
+        r.add_get(f"{METRICS_PREFIX}/node", self.handle_metrics)
+        r.add_get(f"{HEALTH_PREFIX}/live", self.handle_health_live)
+        r.add_get(f"{HEALTH_PREFIX}/ready", self.handle_health_ready)
+        r.add_get(f"{HEALTH_PREFIX}/cluster", self.handle_health_cluster)
+        # reference also answers HEAD for the probes
+        r.add_head(f"{HEALTH_PREFIX}/live", self.handle_health_live)
+        r.add_head(f"{HEALTH_PREFIX}/ready", self.handle_health_ready)
+
+    async def _metrics_auth(self, request: web.Request) -> None:
+        if os.environ.get(
+                "MINIO_PROMETHEUS_AUTH_TYPE", "").lower() == "public":
+            return
+        # same admin gate as every other admin op (incl. the service-
+        # account/STS denial), action admin:Prometheus
+        await self._admin_auth(request, await request.read(), "Prometheus")
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        try:
+            await self._metrics_auth(request)
+        except S3Error as e:
+            return web.Response(status=e.status, text=e.code)
+        text = await self._run(self._render_metrics)
+        return web.Response(
+            text=text, content_type="text/plain", charset="utf-8")
+
+    def _render_metrics(self) -> str:
+        """Registry counters + point-in-time cluster gauges."""
+        lines = [self.metrics.render()]
+        g = lines.append
+
+        def gauge(name, help_, value, labels=""):
+            g(f"# HELP {name} {help_}\n# TYPE {name} gauge\n"
+              f"{name}{labels} {value}\n")
+
+        # capacity + drive status (reference ClusterCapacity/ClusterDrive)
+        try:
+            si = self.api.storage_info()
+            drives = [d for pool in si["pools"] for d in pool["disks"]]
+            total = sum(d.get("total", 0) for d in drives)
+            free = sum(d.get("free", 0) for d in drives)
+            gauge("minio_cluster_capacity_raw_total_bytes",
+                  "Total raw drive capacity", total)
+            gauge("minio_cluster_capacity_raw_free_bytes",
+                  "Free raw drive capacity", free)
+            gauge("minio_cluster_drive_total", "Drives in the cluster",
+                  len(drives))
+            gauge("minio_cluster_drive_online_total", "Online drives",
+                  sum(1 for d in drives if d.get("online")))
+            gauge("minio_cluster_drive_offline_total", "Offline drives",
+                  sum(1 for d in drives if not d.get("online")))
+            # per-drive EWMA latency from the instrumented wrapper
+            lat = ["# HELP minio_drive_latency_ms Per-op EWMA drive latency",
+                   "# TYPE minio_drive_latency_ms gauge"]
+            n_lat = 0
+            for d in drives:
+                for op, s in (d.get("opStats") or {}).items():
+                    lbl = _fmt_labels(("drive", "api"),
+                                      (d["endpoint"], op))
+                    lat.append(
+                        f'minio_drive_latency_ms{lbl} {s["ewmaMillis"]}')
+                    n_lat += 1
+            if n_lat:
+                g("\n".join(lat) + "\n")
+        except Exception:
+            pass
+
+        # usage from the scanner cache (reference BucketUsage group)
+        svcs = getattr(self, "services", None)
+        if svcs is not None:
+            usage = svcs.scanner.usage
+            gauge("minio_cluster_usage_total_bytes",
+                  "Scanned object bytes", usage.total_size())
+            gauge("minio_cluster_usage_object_total",
+                  "Scanned object count", usage.total_objects())
+            gauge("minio_cluster_bucket_total", "Buckets with usage data",
+                  len(usage.buckets))
+            bu = ["# HELP minio_bucket_usage_total_bytes Bucket byte usage",
+                  "# TYPE minio_bucket_usage_total_bytes gauge"]
+            for b, u in sorted(usage.buckets.items()):
+                lbl = _fmt_labels(("bucket",), (b,))
+                bu.append(f"minio_bucket_usage_total_bytes{lbl} {u.size}")
+            if len(bu) > 2:
+                g("\n".join(bu) + "\n")
+            # heal/MRF (reference HealObjects group)
+            ms = svcs.mrf.stats
+            gauge("minio_heal_objects_healed_total",
+                  "Objects healed by the MRF queue", ms.healed)
+            gauge("minio_heal_objects_failed_total",
+                  "Objects the MRF queue failed to heal", ms.failed)
+            gauge("minio_heal_mrf_pending", "MRF queue depth", ms.pending)
+            if svcs.replication is not None:
+                rs = svcs.replication.stats
+                gauge("minio_replication_completed_total",
+                      "Replication ops completed", rs.completed)
+                gauge("minio_replication_failed_total",
+                      "Replication ops failed", rs.failed)
+                gauge("minio_replication_sent_bytes",
+                      "Bytes replicated to targets", rs.bytes_replicated)
+        # event notification backlog
+        notifier = getattr(self, "notifier", None)
+        if notifier is not None:
+            pend = notifier.pending()
+            gauge("minio_notify_target_queue_length",
+                  "Undelivered events across targets",
+                  sum(pend.values()))
+        return "".join(lines)
+
+    # -- health (always unauthenticated, reference
+    #    cmd/healthcheck-handler.go) ----------------------------------------
+    async def handle_health_live(self, request: web.Request) -> web.Response:
+        return web.Response(status=200)
+
+    async def handle_health_ready(self, request: web.Request) -> web.Response:
+        ok = await self._run(self._cluster_healthy)
+        return web.Response(status=200 if ok else 503,
+                            headers={} if ok else
+                            {"X-Minio-Error": "read quorum not available"})
+
+    async def handle_health_cluster(self,
+                                    request: web.Request) -> web.Response:
+        ok = await self._run(self._cluster_healthy,
+                             "maintenance" in request.rel_url.query)
+        return web.Response(status=200 if ok else 503)
+
+    def _cluster_healthy(self, maintenance: bool = False) -> bool:
+        """Every erasure set must keep read quorum (one extra drive of
+        headroom under ?maintenance).  Uses each set's ACTUAL configured
+        parity and the drives' cached online state — no per-probe
+        disk-info RPCs, so a hung peer can't stall the readiness probe
+        (reference ClusterCheckHandler, cmd/healthcheck-handler.go:36)."""
+        pools = getattr(self.api, "pools", None)
+        if pools is None:
+            return True
+        for pool in pools:
+            for es in getattr(pool, "sets", []):
+                n = len(es.disks)
+                online = sum(
+                    1 for d in es.disks
+                    if d is not None and d.is_online())
+                need = n - es.default_parity + (1 if maintenance else 0)
+                if online < max(need, 1):
+                    return False
+        return True

@@ -1,0 +1,102 @@
+"""Instrumented per-drive decorator: per-op counters + EWMA latencies.
+
+Equivalent of the reference's xlStorageDiskIDCheck
+(cmd/xl-storage-disk-id-check.go:68): wraps any StorageAPI and records,
+per storage operation, the call count, error count, cumulative wall time
+and an exponentially-weighted moving average latency.  The numbers feed
+the admin StorageInfo plane and the Prometheus drive metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+# every data-plane method of StorageAPI gets a timer (control accessors
+# like disk_id/is_online are left untimed on purpose — they are hot and
+# trivially cheap)
+TIMED_OPS = (
+    "make_volume", "list_volumes", "stat_volume", "delete_volume",
+    "read_all", "write_all", "delete", "rename_file", "create_file",
+    "open_file_writer", "append_file", "read_file_stream", "read_file",
+    "read_version", "read_xl", "write_metadata", "update_metadata",
+    "delete_version", "rename_data", "list_dir", "walk_dir",
+    "verify_file", "check_parts", "disk_info",
+)
+
+EWMA_ALPHA = 0.2  # same smoothing idea as the reference's EWMA latency
+
+
+class OpStats:
+    __slots__ = ("count", "errors", "total_s", "ewma_s", "mu")
+
+    def __init__(self):
+        self.count = 0
+        self.errors = 0
+        self.total_s = 0.0
+        self.ewma_s = 0.0
+        self.mu = threading.Lock()
+
+    def record(self, dt: float, failed: bool) -> None:
+        with self.mu:
+            self.count += 1
+            if failed:
+                self.errors += 1
+            self.total_s += dt
+            self.ewma_s = (dt if self.count == 1
+                           else EWMA_ALPHA * dt
+                           + (1 - EWMA_ALPHA) * self.ewma_s)
+
+    def to_dict(self) -> dict:
+        with self.mu:
+            return {
+                "count": self.count, "errors": self.errors,
+                "totalSeconds": round(self.total_s, 6),
+                "ewmaMillis": round(self.ewma_s * 1e3, 3),
+            }
+
+
+class InstrumentedStorage:
+    """Transparent timing wrapper around a StorageAPI instance."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self._ops: dict[str, OpStats] = {op: OpStats() for op in TIMED_OPS}
+        for op in TIMED_OPS:
+            target = getattr(inner, op, None)
+            if target is not None:
+                setattr(self, op, self._wrap(op, target))
+
+    def _wrap(self, op: str, fn):
+        stats = self._ops[op]
+
+        def timed(*a, **kw):
+            t0 = time.monotonic()
+            try:
+                out = fn(*a, **kw)
+            except Exception:
+                stats.record(time.monotonic() - t0, failed=True)
+                raise
+            stats.record(time.monotonic() - t0, failed=False)
+            return out
+
+        timed.__name__ = op
+        return timed
+
+    # untimed passthroughs (and anything a backend adds beyond the ABC)
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    # -- metrics surface -----------------------------------------------------
+    def op_stats(self) -> dict[str, dict]:
+        """{op: {count, errors, totalSeconds, ewmaMillis}} for ops used."""
+        return {op: s.to_dict() for op, s in self._ops.items() if s.count}
+
+    def unwrap(self):
+        return self._inner
+
+
+def instrument(disks):
+    """Wrap a list of drives (None entries pass through)."""
+    return [InstrumentedStorage(d) if d is not None
+            and not isinstance(d, InstrumentedStorage) else d for d in disks]

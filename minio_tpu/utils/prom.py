@@ -1,0 +1,221 @@
+"""Minimal Prometheus client: counters, gauges, histograms + text
+exposition.
+
+The reference serves ~200 metric descriptors from its own registry
+(cmd/metrics-v2.go); this is the same idea without an external client
+library — thread-safe metric families rendered in the text format that
+Prometheus scrapes.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_right
+
+
+def _fmt_labels(labelnames: tuple, labelvalues: tuple) -> str:
+    if not labelnames:
+        return ""
+    esc = lambda v: str(v).replace("\\", r"\\").replace('"', r"\"").replace(
+        "\n", r"\n")
+    inner = ",".join(f'{k}="{esc(v)}"'
+                     for k, v in zip(labelnames, labelvalues))
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+class _Family:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str, labelnames: tuple = ()):
+        self.name = name
+        self.help = help_
+        self.labelnames = tuple(labelnames)
+        self._children: dict[tuple, object] = {}
+        self._mu = threading.Lock()
+
+    def labels(self, *labelvalues):
+        if len(labelvalues) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected {len(self.labelnames)} labels")
+        key = tuple(str(v) for v in labelvalues)
+        with self._mu:
+            ch = self._children.get(key)
+            if ch is None:
+                ch = self._new_child()
+                self._children[key] = ch
+            return ch
+
+    def _default(self):
+        return self.labels()
+
+    def collect(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} {self.kind}"]
+        with self._mu:
+            items = list(self._children.items())
+        for key, ch in items:
+            out.extend(self._render_child(key, ch))
+        return out
+
+
+class Counter(_Family):
+    kind = "counter"
+
+    class _Child:
+        __slots__ = ("v", "mu")
+
+        def __init__(self):
+            self.v = 0.0
+            self.mu = threading.Lock()
+
+        def inc(self, amount: float = 1.0) -> None:
+            with self.mu:
+                self.v += amount
+
+    def _new_child(self):
+        return Counter._Child()
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default().inc(amount)
+
+    def _render_child(self, key, ch):
+        return [f"{self.name}{_fmt_labels(self.labelnames, key)} "
+                f"{_fmt_value(ch.v)}"]
+
+
+class Gauge(_Family):
+    kind = "gauge"
+
+    class _Child:
+        __slots__ = ("v", "mu", "fn")
+
+        def __init__(self):
+            self.v = 0.0
+            self.mu = threading.Lock()
+            self.fn = None
+
+        def set(self, value: float) -> None:
+            with self.mu:
+                self.v = float(value)
+
+        def inc(self, amount: float = 1.0) -> None:
+            with self.mu:
+                self.v += amount
+
+        def dec(self, amount: float = 1.0) -> None:
+            self.inc(-amount)
+
+        def set_function(self, fn) -> None:
+            self.fn = fn
+
+    def _new_child(self):
+        return Gauge._Child()
+
+    def set(self, value: float) -> None:
+        self._default().set(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._default().dec(amount)
+
+    def set_function(self, fn) -> None:
+        self._default().set_function(fn)
+
+    def _render_child(self, key, ch):
+        v = ch.v
+        if ch.fn is not None:
+            try:
+                v = float(ch.fn())
+            except Exception:
+                v = ch.v
+        return [f"{self.name}{_fmt_labels(self.labelnames, key)} "
+                f"{_fmt_value(v)}"]
+
+
+DEF_BUCKETS = (.001, .005, .01, .025, .05, .1, .25, .5, 1, 2.5, 5, 10)
+
+
+class Histogram(_Family):
+    kind = "histogram"
+
+    def __init__(self, name, help_, labelnames=(), buckets=DEF_BUCKETS):
+        super().__init__(name, help_, labelnames)
+        self.buckets = tuple(sorted(buckets))
+
+    class _Child:
+        __slots__ = ("counts", "sum", "count", "mu", "buckets")
+
+        def __init__(self, buckets):
+            self.buckets = buckets
+            self.counts = [0] * (len(buckets) + 1)
+            self.sum = 0.0
+            self.count = 0
+            self.mu = threading.Lock()
+
+        def observe(self, v: float) -> None:
+            i = bisect_right(self.buckets, v)
+            with self.mu:
+                self.counts[i] += 1
+                self.sum += v
+                self.count += 1
+
+    def _new_child(self):
+        return Histogram._Child(self.buckets)
+
+    def observe(self, v: float) -> None:
+        self._default().observe(v)
+
+    def _render_child(self, key, ch):
+        out = []
+        acc = 0
+        for ub, c in zip(self.buckets + (float("inf"),), ch.counts):
+            acc += c
+            lbl = _fmt_labels(self.labelnames + ("le",),
+                              key + (_fmt_value(float(ub)),))
+            out.append(f"{self.name}_bucket{lbl} {acc}")
+        lbl = _fmt_labels(self.labelnames, key)
+        out.append(f"{self.name}_sum{lbl} {_fmt_value(ch.sum)}")
+        out.append(f"{self.name}_count{lbl} {ch.count}")
+        return out
+
+
+class Registry:
+    def __init__(self):
+        self._families: list[_Family] = []
+        self._mu = threading.Lock()
+
+    def counter(self, name, help_, labelnames=()) -> Counter:
+        return self._add(Counter(name, help_, labelnames))
+
+    def gauge(self, name, help_, labelnames=()) -> Gauge:
+        return self._add(Gauge(name, help_, labelnames))
+
+    def histogram(self, name, help_, labelnames=(),
+                  buckets=DEF_BUCKETS) -> Histogram:
+        return self._add(Histogram(name, help_, labelnames, buckets))
+
+    def _add(self, fam):
+        with self._mu:
+            for f in self._families:
+                if f.name == fam.name:
+                    return f  # idempotent re-registration
+            self._families.append(fam)
+        return fam
+
+    def render(self) -> str:
+        lines = []
+        with self._mu:
+            fams = list(self._families)
+        for f in fams:
+            lines.extend(f.collect())
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,173 @@
+"""Prometheus metrics + health endpoints + instrumented drive wrapper.
+
+Reference: cmd/metrics-v2.go, cmd/healthcheck-handler.go:36,
+cmd/xl-storage-disk-id-check.go:68.
+"""
+
+import os
+
+import pytest
+
+from minio_tpu.utils.prom import Counter, Gauge, Histogram, Registry
+from tests.s3_harness import S3TestServer
+
+
+class TestPromRegistry:
+    def test_counter_and_labels(self):
+        r = Registry()
+        c = r.counter("t_total", "help", ("api",))
+        c.labels("get").inc()
+        c.labels("get").inc(2)
+        c.labels("put").inc()
+        out = r.render()
+        assert '# TYPE t_total counter' in out
+        assert 't_total{api="get"} 3' in out
+        assert 't_total{api="put"} 1' in out
+
+    def test_gauge_function(self):
+        r = Registry()
+        g = r.gauge("t_up", "help")
+        g.set_function(lambda: 42)
+        assert "t_up 42" in r.render()
+
+    def test_histogram_cumulative(self):
+        r = Registry()
+        h = r.histogram("t_sec", "help", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(5.0)
+        out = r.render()
+        assert 't_sec_bucket{le="0.1"} 1' in out
+        assert 't_sec_bucket{le="1"} 2' in out
+        assert 't_sec_bucket{le="+Inf"} 3' in out
+        assert "t_sec_count 3" in out
+
+    def test_idempotent_registration(self):
+        r = Registry()
+        a = r.counter("dup_total", "x")
+        b = r.counter("dup_total", "x")
+        assert a is b
+
+
+class TestInstrumentedStorage:
+    def test_op_stats(self, tmp_path):
+        from minio_tpu.storage.instrumented import InstrumentedStorage
+        from minio_tpu.storage.local import LocalStorage
+
+        d = InstrumentedStorage(LocalStorage(str(tmp_path / "d0")))
+        d.make_volume("vol")
+        d.write_all("vol", "a.txt", b"hello")
+        assert d.read_all("vol", "a.txt") == b"hello"
+        stats = d.op_stats()
+        assert stats["make_volume"]["count"] == 1
+        assert stats["write_all"]["count"] == 1
+        assert stats["read_all"]["count"] == 1
+        assert stats["read_all"]["ewmaMillis"] >= 0
+
+    def test_errors_counted(self, tmp_path):
+        from minio_tpu.storage.errors import FileNotFound
+        from minio_tpu.storage.instrumented import InstrumentedStorage
+        from minio_tpu.storage.local import LocalStorage
+
+        d = InstrumentedStorage(LocalStorage(str(tmp_path / "d0")))
+        d.make_volume("vol")
+        with pytest.raises(FileNotFound):
+            d.read_all("vol", "missing")
+        assert d.op_stats()["read_all"]["errors"] == 1
+
+
+@pytest.fixture(scope="module")
+def srv(tmp_path_factory):
+    os.environ["MINIO_TPU_FSYNC"] = "0"
+    s = S3TestServer(str(tmp_path_factory.mktemp("metrics")),
+                     start_services=True, scan_interval=3600.0)
+    yield s
+    s.close()
+
+
+class TestMetricsEndpoint:
+    def test_requires_auth_by_default(self, srv):
+        os.environ.pop("MINIO_PROMETHEUS_AUTH_TYPE", None)
+        r = srv.raw_request("GET", "/minio/v2/metrics/cluster")
+        assert r.status == 403
+
+    def test_signed_scrape(self, srv):
+        import time
+
+        srv.request("PUT", "/mbkt")
+        srv.request("PUT", "/mbkt/obj", data=b"hello metrics")
+        srv.request("GET", "/mbkt/obj")
+        # streamed GETs record in the handler's finally, which runs after
+        # the client already saw EOF — give it a beat
+        time.sleep(0.2)
+        r = srv.request("GET", "/minio/v2/metrics/cluster")
+        assert r.status == 200
+        body = r.text()
+        assert "minio_s3_requests_total" in body
+        assert 'api="put_object"' in body
+        assert 'api="get_object"' in body
+        assert "minio_s3_ttfb_seconds_bucket" in body
+        assert "minio_cluster_capacity_raw_total_bytes" in body
+        assert "minio_cluster_drive_online_total 4" in body
+        assert "minio_node_uptime_seconds" in body
+        assert "minio_heal_mrf_pending" in body
+
+    def test_public_env_allows_anonymous(self, srv):
+        os.environ["MINIO_PROMETHEUS_AUTH_TYPE"] = "public"
+        try:
+            r = srv.raw_request("GET", "/minio/v2/metrics/node")
+            assert r.status == 200
+            assert "minio_s3_requests_total" in r.text()
+        finally:
+            os.environ.pop("MINIO_PROMETHEUS_AUTH_TYPE", None)
+
+    def test_error_counters(self, srv):
+        srv.request("GET", "/mbkt/definitely-missing")
+        r = srv.request("GET", "/minio/v2/metrics/cluster")
+        assert "minio_s3_requests_4xx_errors_total" in r.text()
+
+    def test_drive_latency_series(self, srv):
+        # object IO above ran through InstrumentedStorage in the harness?
+        # harness builds raw LocalStorage; instrumenting happens in
+        # ClusterNode — so only assert the scrape stays well-formed here.
+        r = srv.request("GET", "/minio/v2/metrics/cluster")
+        for line in r.text().splitlines():
+            if line and not line.startswith("#"):
+                parts = line.rsplit(" ", 1)
+                assert len(parts) == 2, line
+                float(parts[1])  # parses as a number
+
+
+class TestHealthEndpoints:
+    def test_live(self, srv):
+        assert srv.raw_request("GET", "/minio/health/live").status == 200
+        assert srv.raw_request("HEAD", "/minio/health/live").status == 200
+
+    def test_ready(self, srv):
+        assert srv.raw_request("GET", "/minio/health/ready").status == 200
+
+    def test_cluster(self, srv):
+        assert srv.raw_request("GET", "/minio/health/cluster").status == 200
+
+    def test_ready_degraded(self, tmp_path):
+        os.environ["MINIO_TPU_FSYNC"] = "0"
+        s = S3TestServer(str(tmp_path / "deg"))
+        try:
+            es = s.pools.pools[0].sets[0]
+            saved = list(es.disks)
+            # lose read quorum: 4 drives parity 2 -> need 2 online
+            es.disks[0] = None
+            es.disks[1] = None
+            es.disks[2] = None
+            assert s.raw_request("GET", "/minio/health/ready").status == 503
+            # maintenance mode needs one extra drive of headroom
+            es.disks[:] = saved
+            es.disks[0] = None
+            es.disks[1] = None
+            assert s.raw_request("GET", "/minio/health/ready").status == 200
+            assert s.raw_request(
+                "GET", "/minio/health/cluster?maintenance=true").status == 503
+            es.disks[:] = saved
+            assert s.raw_request("GET", "/minio/health/ready").status == 200
+        finally:
+            s.close()
