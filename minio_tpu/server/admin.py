@@ -76,6 +76,10 @@ class AdminMixin:
                      wrap(self.admin_remove_remote_target, "SetBucketTarget"))
         r.add_put(f"{p}/replication-resync",
                   wrap(self.admin_replication_resync, "SetBucketTarget"))
+        # observability: live trace + console log streams (reference
+        # TraceHandler cmd/admin-handlers.go:1108, ConsoleLogHandler)
+        r.add_get(f"{p}/trace", wrap(self.admin_trace, "ServerTrace"))
+        r.add_get(f"{p}/log", wrap(self.admin_console_log, "ConsoleLog"))
 
     # ---------------------------------------------------------------- auth
     def _admin_wrap(self, fn, op: str):
@@ -92,6 +96,89 @@ class AdminMixin:
                     content_type="application/json",
                 )
         return handler
+
+    # -------------------------------------------------------- observability
+    async def admin_trace(self, request: web.Request,
+                          body: bytes) -> web.StreamResponse:
+        """Long-poll NDJSON stream of per-request trace entries
+        (reference TraceHandler, cmd/admin-handlers.go:1108; `mc admin
+        trace` client).  ?err=true filters to error responses only."""
+        import asyncio
+
+        errs_only = request.rel_url.query.get("err", "") in ("true", "1")
+        flt = (lambda e: e.get("statusCode", 0) >= 400) if errs_only else None
+        resp = web.StreamResponse(
+            status=200, headers={"Content-Type": "application/x-ndjson"})
+        sub = None
+        try:
+            await resp.prepare(request)
+            sub = self.trace.subscribe(filter_fn=flt)
+            idle = 0.0
+            while True:
+                # poll on the event loop: a follower must never park one
+                # of the shared executor's threads
+                entry = sub.get_nowait()
+                if entry is None:
+                    await asyncio.sleep(0.2)
+                    idle += 0.2
+                    if idle >= 1.0:
+                        # keepalive so dead clients surface quickly
+                        await resp.write(b"\n")
+                        idle = 0.0
+                    continue
+                idle = 0.0
+                await resp.write(json.dumps(entry).encode() + b"\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            if sub is not None:
+                sub.close()
+        return resp
+
+    async def admin_console_log(self, request: web.Request,
+                                body: bytes) -> web.StreamResponse:
+        """Recent console-log ring + live follow (reference
+        ConsoleLogHandler, cmd/admin-handlers.go; cmd/consolelogger.go
+        ring buffer)."""
+        import asyncio
+
+        from minio_tpu.utils.logger import log as logger
+
+        try:
+            n = int(request.rel_url.query.get("limit", "100"))
+        except ValueError:
+            raise S3Error("InvalidArgument", "limit must be an integer")
+        follow = request.rel_url.query.get("follow", "") in ("true", "1")
+        resp = web.StreamResponse(
+            status=200, headers={"Content-Type": "application/x-ndjson"})
+        sub = None
+        try:
+            await resp.prepare(request)
+            # snapshot BEFORE subscribing: an entry logged in between is
+            # dropped from the live tail rather than streamed twice
+            backlog = logger.recent(n)
+            if follow:
+                sub = logger.pubsub.subscribe()
+            for entry in backlog:
+                await resp.write(json.dumps(entry).encode() + b"\n")
+            idle = 0.0
+            while follow:
+                entry = sub.get_nowait()
+                if entry is None:
+                    await asyncio.sleep(0.2)
+                    idle += 0.2
+                    if idle >= 1.0:
+                        await resp.write(b"\n")
+                        idle = 0.0
+                    continue
+                idle = 0.0
+                await resp.write(json.dumps(entry).encode() + b"\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            if sub is not None:
+                sub.close()
+        return resp
 
     async def _admin_auth(self, request: web.Request, body: bytes,
                           op: str) -> None:

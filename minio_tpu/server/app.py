@@ -16,6 +16,7 @@ import asyncio
 import base64
 import hashlib
 import io
+import os
 import queue as queue_mod
 import re
 import secrets
@@ -37,6 +38,8 @@ from .object_extras import (
     ObjectExtraHandlers, parse_tag_query,
 )
 from .s3errors import S3Error, from_storage_error
+from minio_tpu.utils.logger import log
+from minio_tpu.utils.pubsub import PubSub
 from .admin import AdminMixin
 from .metrics import MetricsMixin
 from .sse_handlers import SSEMixin, load_kms
@@ -191,6 +194,10 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         self.executor = cf.ThreadPoolExecutor(
             max_workers=max_concurrency + 4, thread_name_prefix="s3-api"
         )
+        self.trace = PubSub()
+        eq = _event_queue_dir(object_layer)
+        log.init_audit(queue_dir=os.path.join(os.path.dirname(eq), "audit")
+                       if eq else None)
         self.app = web.Application(client_max_size=1 << 30)
         self.init_metrics()
         # fixed-prefix routes (admin + metrics/health) win over the S3
@@ -311,6 +318,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 )
         except sigv4.SigV4Error as e:
             raise S3Error(e.code, str(e))
+        request["accessKey"] = ctx.access_key  # for audit/trace entries
         if action:
             if not await self._authorized(ctx.access_key, action, bucket,
                                           obj, conditions):
@@ -403,15 +411,38 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 except Exception as e:  # storage & unexpected errors
                     s3e = from_storage_error(e, request.path)
                     status = s3e.status
+                    if status >= 500:
+                        log.error("request failed", api=api,
+                                  path=request.path, error=repr(e))
                     return web.Response(
                         status=s3e.status,
                         body=s3e.to_xml(secrets.token_hex(8)),
                         content_type="application/xml",
                     )
         finally:
+            dt = time.monotonic() - t0
             self._m_inflight.dec()
-            self.record_api(api, status, time.monotonic() - t0,
+            self.record_api(api, status, dt,
                             rx=request.content_length or 0, tx=tx)
+            # live trace + audit (reference httpTraceAll publishing
+            # madmin.TraceInfo, cmd/http-tracer.go:39; audit entries,
+            # internal/logger/audit.go)
+            if self.trace.num_subscribers or log.audit_enabled:
+                entry = {
+                    "api": api,
+                    "method": request.method,
+                    "path": request.path,
+                    "query": request.rel_url.query_string,
+                    "statusCode": status,
+                    "durationMs": round(dt * 1e3, 3),
+                    "remotehost": request.remote or "",
+                    "userAgent": request.headers.get("User-Agent", ""),
+                    "accessKey": request.get("accessKey", ""),
+                }
+                self.trace.publish(entry)
+                if log.audit_enabled:
+                    # queue-store I/O must not run on the event loop
+                    self.executor.submit(log.audit, entry)
 
     # -------------------------------------------------------------- dispatch
     async def dispatch_root(self, request: web.Request) -> web.StreamResponse:

@@ -1,0 +1,131 @@
+"""Structured logger + audit pipeline.
+
+Reference: internal/logger (leveled console/JSON logger with reqInfo
+context, HTTP targets), cmd/consolelogger.go (bounded ring buffer the
+admin console-log endpoint streams from), and audit-log entries
+(internal/logger/audit.go) delivered to webhook targets.
+
+One process-wide `Logger` instance (module `log` helpers) writes JSON
+lines to stderr, keeps the last N entries in a ring for the admin
+endpoint, publishes to an in-proc PubSub for live streaming, and —
+when MINIO_AUDIT_WEBHOOK_ENDPOINT is set — ships per-request audit
+entries through the same persistent-queue webhook machinery the event
+notifier uses.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import sys
+import threading
+import time
+
+from .pubsub import PubSub
+
+LEVELS = ("DEBUG", "INFO", "WARNING", "ERROR", "FATAL")
+
+
+class Logger:
+    def __init__(self, ring_size: int = 1000, stream=None):
+        self.ring: collections.deque = collections.deque(maxlen=ring_size)
+        self.pubsub = PubSub()
+        self._mu = threading.Lock()
+        self._stream = stream if stream is not None else sys.stderr
+        self.min_level = os.environ.get("MINIO_TPU_LOG_LEVEL", "INFO").upper()
+        self._audit = None  # AuditTarget, wired by init_audit
+
+    def _enabled(self, level: str) -> bool:
+        try:
+            return LEVELS.index(level) >= LEVELS.index(self.min_level)
+        except ValueError:
+            return True
+
+    def log(self, level: str, message: str, **ctx) -> None:
+        level = level.upper()
+        if not self._enabled(level):
+            return
+        entry = {
+            "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "level": level,
+            "message": message,
+        }
+        if ctx:
+            entry.update(ctx)
+        with self._mu:
+            self.ring.append(entry)
+            try:
+                self._stream.write(json.dumps(entry) + "\n")
+                self._stream.flush()
+            except Exception:
+                pass
+        self.pubsub.publish(entry)
+
+    def debug(self, msg: str, **ctx) -> None:
+        self.log("DEBUG", msg, **ctx)
+
+    def info(self, msg: str, **ctx) -> None:
+        self.log("INFO", msg, **ctx)
+
+    def warning(self, msg: str, **ctx) -> None:
+        self.log("WARNING", msg, **ctx)
+
+    def error(self, msg: str, **ctx) -> None:
+        self.log("ERROR", msg, **ctx)
+
+    def recent(self, n: int = 100) -> list[dict]:
+        with self._mu:
+            return list(self.ring)[-n:]
+
+    # -- audit ---------------------------------------------------------------
+    def init_audit(self, queue_dir: str | None = None) -> None:
+        """Wire the audit webhook from env (idempotent; no-op without
+        MINIO_AUDIT_WEBHOOK_ENDPOINT).  Delivery reuses the notifier's
+        persistent-queue worker so audit entries survive restarts and
+        endpoint outages."""
+        endpoint = os.environ.get("MINIO_AUDIT_WEBHOOK_ENDPOINT", "")
+        if not endpoint or self._audit is not None:
+            return
+        import tempfile
+
+        from minio_tpu.events.notifier import _TargetWorker
+        from minio_tpu.events.targets import QueueStore, WebhookTarget
+
+        target = WebhookTarget(
+            "audit-webhook", endpoint,
+            auth_token=os.environ.get("MINIO_AUDIT_WEBHOOK_AUTH_TOKEN", ""))
+        store = QueueStore(queue_dir or os.path.join(
+            tempfile.gettempdir(), "minio-tpu-audit"))
+        self._audit = _TargetWorker(target, store, retry_interval=3.0)
+        self._audit_store = store
+
+    def audit(self, entry: dict) -> None:
+        """Ship one audit entry (reference AuditLog, internal/logger).
+        Fire-and-forget; ordering/retry handled by the queue worker."""
+        if self._audit is None:
+            return
+        try:
+            self._audit_store.put({
+                "version": "1",
+                "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                **entry})
+            self._audit.signal()
+        except Exception:
+            pass
+
+    @property
+    def audit_enabled(self) -> bool:
+        return self._audit is not None
+
+    def close(self) -> None:
+        if self._audit is not None:
+            try:
+                self._audit.close()
+            except Exception:
+                pass
+            self._audit = None
+
+
+# process-wide instance (reference's global logger singletons)
+log = Logger()

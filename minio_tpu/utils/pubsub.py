@@ -1,0 +1,78 @@
+"""In-process pub/sub for trace and console-log fan-in.
+
+Reference: internal/pubsub/pubsub.go:32-80 — bounded per-subscriber
+queues, a subscriber count that lets publishers skip work when nobody
+listens, and non-blocking publish (slow subscribers drop, they never
+stall the hot path).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+
+class Subscription:
+    def __init__(self, ps: "PubSub", filter_fn=None, maxsize: int = 1024):
+        self._ps = ps
+        self.q: queue.Queue = queue.Queue(maxsize=maxsize)
+        self.filter = filter_fn
+        self.dropped = 0
+
+    def get(self, timeout: float | None = None):
+        """Next item, or None on timeout."""
+        try:
+            return self.q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def get_nowait(self):
+        """Next item without blocking, or None — lets async consumers
+        poll from the event loop instead of parking an executor thread."""
+        try:
+            return self.q.get_nowait()
+        except queue.Empty:
+            return None
+
+    def close(self) -> None:
+        self._ps._unsubscribe(self)
+
+
+class PubSub:
+    def __init__(self):
+        self._subs: list[Subscription] = []
+        self._mu = threading.Lock()
+
+    def subscribe(self, filter_fn=None, maxsize: int = 1024) -> Subscription:
+        sub = Subscription(self, filter_fn, maxsize)
+        with self._mu:
+            self._subs.append(sub)
+        return sub
+
+    def _unsubscribe(self, sub: Subscription) -> None:
+        with self._mu:
+            try:
+                self._subs.remove(sub)
+            except ValueError:
+                pass
+
+    @property
+    def num_subscribers(self) -> int:
+        return len(self._subs)
+
+    def publish(self, item) -> None:
+        if not self._subs:
+            return
+        with self._mu:
+            subs = list(self._subs)
+        for sub in subs:
+            if sub.filter is not None:
+                try:
+                    if not sub.filter(item):
+                        continue
+                except Exception:
+                    continue
+            try:
+                sub.q.put_nowait(item)
+            except queue.Full:
+                sub.dropped += 1
