@@ -1,0 +1,260 @@
+"""Server configuration: subsystem KVS registry with env override.
+
+Reference: internal/config/config.go:188-668 — a registry of subsystems,
+each with default KVS and help text; values resolve as
+    env MINIO_<SUBSYS>_<KEY>  >  stored config  >  defaults
+(env always wins, reference LookupEnv precedence).  The merged config is
+persisted as JSON on the drives' system volume
+(cmd/config-current.go + cmd/config-encrypted.go storage path), and a
+subset of subsystems applies dynamically at runtime via registered
+apply-callbacks (reference dynamic config, applyDynamicConfig).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from minio_tpu.storage import errors
+from minio_tpu.storage.local import SYSTEM_VOL
+
+CONFIG_PATH = "config/config.json"
+
+# -- subsystem registry (reference DefaultKVS + HelpSubSysMap) --------------
+
+
+class HelpKV:
+    def __init__(self, key: str, description: str, optional: bool = True,
+                 typ: str = "string"):
+        self.key = key
+        self.description = description
+        self.optional = optional
+        self.type = typ
+
+    def to_dict(self) -> dict:
+        return {"key": self.key, "description": self.description,
+                "optional": self.optional, "type": self.type}
+
+
+SUBSYSTEMS: dict[str, dict[str, str]] = {}
+HELP: dict[str, list[HelpKV]] = {}
+DYNAMIC: set[str] = set()
+
+
+def register_subsystem(name: str, defaults: dict[str, str],
+                       help_kvs: list[HelpKV] | None = None,
+                       dynamic: bool = False) -> None:
+    SUBSYSTEMS[name] = dict(defaults)
+    HELP[name] = help_kvs or []
+    if dynamic:
+        DYNAMIC.add(name)
+
+
+register_subsystem("api", {
+    "requests_max": "auto",
+}, [
+    HelpKV("requests_max",
+           "max concurrent S3 requests (auto = default; needs restart)"),
+])
+
+register_subsystem("scanner", {
+    "interval": "60",
+}, [
+    HelpKV("interval", "seconds between data-scanner cycles", typ="number"),
+], dynamic=True)
+
+register_subsystem("heal", {
+    "interval": "3600",
+}, [
+    HelpKV("interval", "seconds between background heal sweeps",
+           typ="number"),
+], dynamic=True)
+
+register_subsystem("replication", {
+    "workers": "2",
+}, [
+    HelpKV("workers", "replication worker threads (needs restart)",
+           typ="number"),
+])
+
+register_subsystem("compression", {
+    "enable": "off",
+    "extensions": ".txt,.log,.csv,.json,.tar,.xml,.bin",
+    "mime_types": "text/*,application/json,application/xml",
+}, [
+    HelpKV("enable", "transparent object compression", typ="boolean"),
+    HelpKV("extensions", "comma-separated extensions to compress"),
+    HelpKV("mime_types", "comma-separated content-types to compress"),
+], dynamic=True)
+
+register_subsystem("storage_class", {
+    "standard": "",
+    "rrs": "",
+}, [
+    HelpKV("standard", "parity for STANDARD objects, e.g. EC:4"),
+    HelpKV("rrs", "parity for REDUCED_REDUNDANCY objects, e.g. EC:2"),
+])
+
+register_subsystem("logger_webhook", {
+    "enable": "off",
+    "endpoint": "",
+    "auth_token": "",
+}, [
+    HelpKV("endpoint", "HTTP endpoint receiving log events"),
+])
+
+register_subsystem("audit_webhook", {
+    "enable": "off",
+    "endpoint": "",
+    "auth_token": "",
+}, [
+    HelpKV("endpoint", "HTTP endpoint receiving audit events"),
+])
+
+
+class ConfigError(Exception):
+    pass
+
+
+class ServerConfig:
+    """Merged (defaults <- stored <- env) config with persistence."""
+
+    def __init__(self, pools=None, environ=None):
+        import os
+
+        self.pools = pools
+        self.env = os.environ if environ is None else environ
+        self._stored: dict[str, dict[str, str]] = {}
+        self._mu = threading.Lock()
+        self._apply_fns: dict[str, list] = {}
+        if pools is not None:
+            self._load()
+
+    # -- persistence ---------------------------------------------------------
+    def _disks(self):
+        pool = getattr(self.pools, "pools", [self.pools])[0]
+        return [d for d in pool.all_disks
+                if d is not None and d.is_online()]
+
+    def _load(self) -> None:
+        for d in self._disks():
+            try:
+                doc = json.loads(d.read_all(SYSTEM_VOL, CONFIG_PATH))
+                if isinstance(doc, dict):
+                    self._stored = {
+                        s: dict(kv) for s, kv in doc.items()
+                        if isinstance(kv, dict)}
+                    return
+            except (errors.StorageError, json.JSONDecodeError, ValueError):
+                continue
+
+    def _save(self) -> None:
+        raw = json.dumps(self._stored).encode()
+        ok = 0
+        for d in self._disks():
+            try:
+                d.write_all(SYSTEM_VOL, CONFIG_PATH, raw)
+                ok += 1
+            except errors.StorageError:
+                continue
+        if ok == 0:
+            raise ConfigError("cannot persist config to any drive")
+
+    # -- resolution ----------------------------------------------------------
+    def get(self, subsys: str, key: str, default: str | None = None) -> str:
+        """env > stored > registered default (reference env precedence,
+        internal/config/config.go LookupEnv)."""
+        if subsys not in SUBSYSTEMS:
+            if default is None:
+                raise ConfigError(f"unknown config subsystem {subsys!r}")
+            return default
+        env_key = f"MINIO_{subsys.upper()}_{key.upper()}"
+        v = self.env.get(env_key)
+        if v is not None:
+            return v
+        with self._mu:
+            v = self._stored.get(subsys, {}).get(key)
+        if v is not None:
+            return v
+        if key in SUBSYSTEMS[subsys]:
+            return SUBSYSTEMS[subsys][key]
+        return default if default is not None else ""
+
+    def get_int(self, subsys: str, key: str, default: int) -> int:
+        try:
+            return int(float(self.get(subsys, key, str(default))))
+        except ValueError:
+            return default
+
+    def get_bool(self, subsys: str, key: str, default: bool = False) -> bool:
+        return self.get(subsys, key, "on" if default else "off").lower() \
+            in ("on", "true", "1", "yes", "enable", "enabled")
+
+    def merged(self) -> dict[str, dict[str, str]]:
+        """Full effective config (defaults overlaid with stored + env)."""
+        out: dict[str, dict[str, str]] = {}
+        for sub, defaults in SUBSYSTEMS.items():
+            kv = dict(defaults)
+            with self._mu:
+                kv.update(self._stored.get(sub, {}))
+            for key in kv:
+                env_key = f"MINIO_{sub.upper()}_{key.upper()}"
+                ev = self.env.get(env_key)
+                if ev is not None:
+                    kv[key] = ev
+            out[sub] = kv
+        return out
+
+    # -- mutation (admin SetConfigKV) ---------------------------------------
+    def set_kv(self, subsys: str, kvs: dict[str, str]) -> None:
+        if subsys not in SUBSYSTEMS:
+            raise ConfigError(f"unknown config subsystem {subsys!r}")
+        bad = [k for k in kvs if k not in SUBSYSTEMS[subsys]]
+        if bad:
+            raise ConfigError(
+                f"unknown keys for {subsys}: {', '.join(sorted(bad))}")
+        with self._mu:
+            self._stored.setdefault(subsys, {}).update(
+                {k: str(v) for k, v in kvs.items()})
+        if self.pools is not None:
+            self._save()
+        self._apply(subsys)
+
+    def del_kv(self, subsys: str, keys: list[str] | None = None) -> None:
+        """Reset keys (or the whole subsystem) to defaults."""
+        if subsys not in SUBSYSTEMS:
+            raise ConfigError(f"unknown config subsystem {subsys!r}")
+        with self._mu:
+            if keys:
+                sub = self._stored.get(subsys, {})
+                for k in keys:
+                    sub.pop(k, None)
+            else:
+                self._stored.pop(subsys, None)
+        if self.pools is not None:
+            self._save()
+        self._apply(subsys)
+
+    # -- dynamic apply -------------------------------------------------------
+    def on_change(self, subsys: str, fn) -> None:
+        """Register a callback fired after set/del of a dynamic subsystem
+        (reference applyDynamicConfig)."""
+        self._apply_fns.setdefault(subsys, []).append(fn)
+
+    def _apply(self, subsys: str) -> None:
+        if subsys not in DYNAMIC:
+            return
+        for fn in self._apply_fns.get(subsys, []):
+            try:
+                fn(self)
+            except Exception:
+                pass
+
+    # -- help ----------------------------------------------------------------
+    @staticmethod
+    def help(subsys: str | None = None) -> dict:
+        if subsys:
+            if subsys not in HELP:
+                raise ConfigError(f"unknown config subsystem {subsys!r}")
+            return {subsys: [h.to_dict() for h in HELP[subsys]]}
+        return {s: [h.to_dict() for h in hs] for s, hs in HELP.items()}

@@ -74,6 +74,10 @@ class PutObjectOptions:
     versioned: bool = False
     version_id: str = ""
     storage_class: str = ""  # "STANDARD" | "REDUCED_REDUNDANCY"
+    # called after the stream is fully consumed, just before metadata
+    # commit — lets transforming wrappers (compression) contribute the
+    # original size/ETag they only know at EOF
+    finalize_metadata: Callable[[], dict] | None = None
 
 
 @dataclass
@@ -360,6 +364,9 @@ class ErasureObjects:
         metadata["etag"] = etag
         if opts.content_type:
             metadata["content-type"] = opts.content_type
+        if opts.finalize_metadata is not None:
+            metadata.update(opts.finalize_metadata() or {})
+            etag = metadata.get("etag", etag)
 
         part = ObjectPartInfo(1, total_size, total_size, mod_time, etag)
 

@@ -80,6 +80,16 @@ class AdminMixin:
         # TraceHandler cmd/admin-handlers.go:1108, ConsoleLogHandler)
         r.add_get(f"{p}/trace", wrap(self.admin_trace, "ServerTrace"))
         r.add_get(f"{p}/log", wrap(self.admin_console_log, "ConsoleLog"))
+        # config KVS (reference cmd/admin-handlers-config-kv.go:
+        # GetConfigKVHandler / SetConfigKVHandler / DelConfigKVHandler /
+        # HelpConfigKVHandler)
+        r.add_get(f"{p}/get-config", wrap(self.admin_get_config, "ConfigUpdate"))
+        r.add_put(f"{p}/set-config-kv",
+                  wrap(self.admin_set_config_kv, "ConfigUpdate"))
+        r.add_delete(f"{p}/del-config-kv",
+                     wrap(self.admin_del_config_kv, "ConfigUpdate"))
+        r.add_get(f"{p}/help-config-kv",
+                  wrap(self.admin_help_config, "ConfigUpdate"))
 
     # ---------------------------------------------------------------- auth
     def _admin_wrap(self, fn, op: str):
@@ -97,26 +107,83 @@ class AdminMixin:
                 )
         return handler
 
+    # -------------------------------------------------------------- config
+    async def admin_get_config(self, request: web.Request, body: bytes):
+        """Effective merged config; secrets redacted like the reference
+        (madmin redacts env-sensitive values on Get)."""
+        cfg = await self._run(self.config.merged)
+        for sub in cfg.values():
+            for k in sub:
+                if "secret" in k or "token" in k or "password" in k:
+                    if sub[k]:
+                        sub[k] = "*REDACTED*"
+        return self._json(cfg)
+
+    async def admin_set_config_kv(self, request: web.Request, body: bytes):
+        from minio_tpu.config import ConfigError
+
+        try:
+            doc = json.loads(body)
+            subsys = doc["subsys"]
+            kvs = doc["kv"]
+            if not isinstance(kvs, dict):
+                raise ValueError("kv must be an object")
+        except (ValueError, KeyError, TypeError):
+            raise S3Error("InvalidArgument",
+                          'body must be {"subsys": ..., "kv": {...}}')
+        try:
+            await self._run(self.config.set_kv, subsys, kvs)
+        except ConfigError as e:
+            raise S3Error("InvalidArgument", str(e))
+        from minio_tpu.config import DYNAMIC
+
+        return self._json({"restart": subsys not in DYNAMIC})
+
+    async def admin_del_config_kv(self, request: web.Request, body: bytes):
+        from minio_tpu.config import ConfigError
+
+        subsys = request.rel_url.query.get("subsys", "")
+        keys = [k for k in
+                request.rel_url.query.get("keys", "").split(",") if k]
+        if not subsys:
+            raise S3Error("InvalidArgument", "subsys query param required")
+        try:
+            await self._run(self.config.del_kv, subsys, keys or None)
+        except ConfigError as e:
+            raise S3Error("InvalidArgument", str(e))
+        return self._json({})
+
+    async def admin_help_config(self, request: web.Request, body: bytes):
+        from minio_tpu.config import ConfigError, ServerConfig
+
+        subsys = request.rel_url.query.get("subsys", "") or None
+        try:
+            return self._json(ServerConfig.help(subsys))
+        except ConfigError as e:
+            raise S3Error("InvalidArgument", str(e))
+
     # -------------------------------------------------------- observability
-    async def admin_trace(self, request: web.Request,
-                          body: bytes) -> web.StreamResponse:
-        """Long-poll NDJSON stream of per-request trace entries
-        (reference TraceHandler, cmd/admin-handlers.go:1108; `mc admin
-        trace` client).  ?err=true filters to error responses only."""
+    async def _stream_ndjson(self, request: web.Request, subscribe,
+                             backlog=()) -> web.StreamResponse:
+        """Shared NDJSON streamer: write `backlog`, then follow the
+        subscription (created AFTER prepare so a failed handshake never
+        leaks it) with idle keepalives.  Polls on the event loop — a
+        follower must never park one of the shared executor's threads."""
         import asyncio
 
-        errs_only = request.rel_url.query.get("err", "") in ("true", "1")
-        flt = (lambda e: e.get("statusCode", 0) >= 400) if errs_only else None
         resp = web.StreamResponse(
             status=200, headers={"Content-Type": "application/x-ndjson"})
         sub = None
         try:
             await resp.prepare(request)
-            sub = self.trace.subscribe(filter_fn=flt)
+            # snapshot the backlog BEFORE subscribing: an entry published
+            # in between is dropped from the tail, never streamed twice
+            items = backlog() if callable(backlog) else backlog
+            sub = subscribe() if subscribe is not None else None
+            for entry in items:
+                await resp.write(json.dumps(entry).encode() + b"\n")
             idle = 0.0
-            while True:
-                # poll on the event loop: a follower must never park one
-                # of the shared executor's threads
+            while sub is not None:
                 entry = sub.get_nowait()
                 if entry is None:
                     await asyncio.sleep(0.2)
@@ -135,50 +202,37 @@ class AdminMixin:
                 sub.close()
         return resp
 
+    async def admin_trace(self, request: web.Request,
+                          body: bytes) -> web.StreamResponse:
+        """Long-poll NDJSON stream of per-request trace entries
+        (reference TraceHandler, cmd/admin-handlers.go:1108; `mc admin
+        trace` client).  ?err=true filters to error responses only."""
+        errs_only = request.rel_url.query.get("err", "") in ("true", "1")
+        flt = (lambda e: e.get("statusCode", 0) >= 400) if errs_only else None
+        return await self._stream_ndjson(
+            request, lambda: self.trace.subscribe(filter_fn=flt))
+
     async def admin_console_log(self, request: web.Request,
                                 body: bytes) -> web.StreamResponse:
         """Recent console-log ring + live follow (reference
         ConsoleLogHandler, cmd/admin-handlers.go; cmd/consolelogger.go
         ring buffer)."""
-        import asyncio
-
         from minio_tpu.utils.logger import log as logger
 
         try:
             n = int(request.rel_url.query.get("limit", "100"))
         except ValueError:
             raise S3Error("InvalidArgument", "limit must be an integer")
+        if n < 1:
+            raise S3Error("InvalidArgument", "limit must be >= 1")
         follow = request.rel_url.query.get("follow", "") in ("true", "1")
-        resp = web.StreamResponse(
-            status=200, headers={"Content-Type": "application/x-ndjson"})
-        sub = None
-        try:
-            await resp.prepare(request)
-            # snapshot BEFORE subscribing: an entry logged in between is
-            # dropped from the live tail rather than streamed twice
-            backlog = logger.recent(n)
-            if follow:
-                sub = logger.pubsub.subscribe()
-            for entry in backlog:
-                await resp.write(json.dumps(entry).encode() + b"\n")
-            idle = 0.0
-            while follow:
-                entry = sub.get_nowait()
-                if entry is None:
-                    await asyncio.sleep(0.2)
-                    idle += 0.2
-                    if idle >= 1.0:
-                        await resp.write(b"\n")
-                        idle = 0.0
-                    continue
-                idle = 0.0
-                await resp.write(json.dumps(entry).encode() + b"\n")
-        except (ConnectionResetError, asyncio.CancelledError):
-            pass
-        finally:
-            if sub is not None:
-                sub.close()
-        return resp
+        # backlog is snapshotted inside the streamer AFTER prepare but
+        # BEFORE subscribing, so entries in between are dropped from the
+        # tail rather than streamed twice
+        return await self._stream_ndjson(
+            request,
+            (lambda: logger.pubsub.subscribe()) if follow else None,
+            backlog=lambda: logger.recent(n))
 
     async def _admin_auth(self, request: web.Request, body: bytes,
                           op: str) -> None:

@@ -187,6 +187,15 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         self.services = None   # ServiceManager, via attach_services()
         self.locker = None     # LocalLocker, set by ClusterNode
         self._start_time = time_mod.time()
+        from minio_tpu.config import ServerConfig
+
+        self.config = ServerConfig(object_layer)
+        cfg_max = self.config.get("api", "requests_max")
+        if cfg_max not in ("", "auto"):
+            try:
+                max_concurrency = max(1, int(cfg_max))
+            except ValueError:
+                pass
         self.sem = asyncio.Semaphore(max_concurrency)
         # Dedicated pool sized to the request semaphore so a full house of
         # blocking object-layer calls can never starve body-feed tasks
@@ -239,7 +248,21 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 and getattr(services, "replication", None) is None:
             from minio_tpu.services.replication import ReplicationPool
 
-            services.replication = ReplicationPool(self.api, self.meta)
+            services.replication = ReplicationPool(
+                self.api, self.meta,
+                workers=self.config.get_int("replication", "workers", 2))
+        if services is not None:
+            # dynamic config application (reference applyDynamicConfig)
+            def _apply_scanner(cfg):
+                services.scanner.interval = cfg.get_int(
+                    "scanner", "interval", 60)
+
+            def _apply_heal(cfg):
+                services.bg_heal.interval = cfg.get_int(
+                    "heal", "interval", 3600)
+
+            self.config.on_change("scanner", _apply_scanner)
+            self.config.on_change("heal", _apply_heal)
 
     def _quota_check(self, bucket: str, size: int) -> None:
         """Hard-quota enforcement against the scanner's usage cache
@@ -1067,6 +1090,20 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 reader, obj_key, nonce_prefix, f"{bucket}/{key}".encode())
             if real_size >= 0:
                 real_size = sse_mod.enc_size(real_size)
+        elif self._compress_eligible(key, opts.content_type):
+            # transparent compression (reference cmd/object-api-utils.go:907;
+            # never combined with SSE, matching the reference default)
+            from minio_tpu.utils import compress as compress_mod
+
+            creader = compress_mod.CompressingReader(reader)
+            reader = creader
+            opts.user_metadata[compress_mod.META_COMPRESSION] = (
+                compress_mod.SCHEME)
+            opts.finalize_metadata = lambda: {
+                compress_mod.META_ACTUAL_SIZE: str(creader.actual_size),
+                "etag": creader.etag,  # ETag of the ORIGINAL bytes
+            }
+            real_size = -1  # compressed length unknown until EOF
         put_task = asyncio.ensure_future(self._run(
             self.api.put_object, bucket, key, reader, real_size, opts
         ))
@@ -1148,6 +1185,16 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                                                    oi.version_id)
         return repl.PENDING
 
+    def _compress_eligible(self, key: str, content_type: str) -> bool:
+        if not self.config.get_bool("compression", "enable"):
+            return False
+        from minio_tpu.utils import compress as compress_mod
+
+        return compress_mod.eligible(
+            key, content_type,
+            self.config.get("compression", "extensions").split(","),
+            self.config.get("compression", "mime_types").split(","))
+
     async def _versioned(self, bucket: str) -> bool:
         return (await self._vstatus(bucket)) == "Enabled"
 
@@ -1203,6 +1250,17 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 self.api.get_object, sbucket, skey, 0, -1, vid
             )
             data = await self._run(lambda: b"".join(stream))
+        from minio_tpu.utils import compress as compress_mod
+
+        if src_meta.get(
+                compress_mod.META_COMPRESSION) == compress_mod.SCHEME:
+            # normalize compressed sources to their ORIGINAL bytes before
+            # any destination transform (an SSE destination would
+            # otherwise encrypt the frames while the copy kept the
+            # compression metadata -> unreadable object)
+            data = b"".join(compress_mod.decompress_stream(iter([data])))
+            src_meta.pop(compress_mod.META_COMPRESSION, None)
+            src_meta.pop(compress_mod.META_ACTUAL_SIZE, None)
         opts = PutObjectOptions(
             content_type=soi.content_type,
             user_metadata=src_meta,
@@ -1219,6 +1277,16 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             reader = sse_mod.EncryptingReader(
                 reader, okey, nprefix, f"{bucket}/{key}".encode())
             size = sse_mod.enc_size(size)
+        elif self._compress_eligible(key, soi.content_type):
+            creader = compress_mod.CompressingReader(reader)
+            reader = creader
+            opts.user_metadata[compress_mod.META_COMPRESSION] = (
+                compress_mod.SCHEME)
+            opts.finalize_metadata = lambda: {
+                compress_mod.META_ACTUAL_SIZE: str(creader.actual_size),
+                "etag": creader.etag,
+            }
+            size = -1
         new_oi = await self._run(
             self.api.put_object, bucket, key, reader, size, opts
         )
@@ -1268,8 +1336,18 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             oi.version_id = "null"
         self.check_preconditions(request, oi)
 
+        from minio_tpu.utils import compress as compress_mod
+
         encrypted = bool(oi.metadata.get(sse_mod.META_ALGO))
-        size = sse_mod.plain_size_of(oi.size) if encrypted else oi.size
+        compressed = oi.metadata.get(
+            compress_mod.META_COMPRESSION) == compress_mod.SCHEME
+        if encrypted:
+            size = sse_mod.plain_size_of(oi.size)
+        elif compressed:
+            size = int(oi.metadata.get(
+                compress_mod.META_ACTUAL_SIZE, oi.size))
+        else:
+            size = oi.size
 
         status = 200
         offset, length = 0, size
@@ -1295,6 +1373,14 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 iter(ct_stream), obj_key, nonce_prefix,
                 f"{bucket}/{key}".encode(), first_seq, skip, length)
             closer = ct_stream
+        elif compressed:
+            # stored frames are opaque: decompress from the start and
+            # skip to the requested range (reference non-indexed
+            # compressed reads)
+            _, raw = await self._run(
+                self.api.get_object, bucket, key, 0, -1, vid)
+            stream = compress_mod.decompress_range(iter(raw), offset, length)
+            closer = raw
         else:
             _, stream = await self._run(
                 self.api.get_object, bucket, key, offset, length, vid
@@ -1330,11 +1416,17 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             oi.version_id = "null"
         self.check_preconditions(request, oi)
         headers = self._obj_headers(oi)
+        from minio_tpu.utils import compress as compress_mod
+
         if oi.metadata.get(sse_mod.META_ALGO):
             # SSE-C objects require (and verify) the key even on HEAD
             self.sse_object_key(oi, bucket, key, request)
             headers.update(self.sse_response_headers(oi.metadata))
             headers["Content-Length"] = str(sse_mod.plain_size_of(oi.size))
+        elif oi.metadata.get(
+                compress_mod.META_COMPRESSION) == compress_mod.SCHEME:
+            headers["Content-Length"] = oi.metadata.get(
+                compress_mod.META_ACTUAL_SIZE, str(oi.size))
         else:
             headers["Content-Length"] = str(oi.size)
         from minio_tpu.events.event import EventName

@@ -234,15 +234,25 @@ class ReplicationPool:
         for k, v in (oi.metadata or {}).items():
             if k.startswith("x-amz-meta-"):
                 headers[k] = v
+        # transparently-compressed objects replicate as their ORIGINAL
+        # bytes (the internal framing is node-local storage detail)
+        from minio_tpu.utils import compress as compress_mod
+
+        size = oi.size
+        body = iter(stream)
+        if oi.metadata.get(
+                compress_mod.META_COMPRESSION) == compress_mod.SCHEME:
+            size = int(oi.metadata.get(compress_mod.META_ACTUAL_SIZE, 0))
+            body = compress_mod.decompress_stream(body)
         # stream the shards straight to the remote: no full-object buffer
         try:
-            client.put_object(tgt.bucket, op.name, iter(stream),
-                              headers=headers, length=oi.size)
+            client.put_object(tgt.bucket, op.name, body,
+                              headers=headers, length=size)
         finally:
             if hasattr(stream, "close"):
                 stream.close()
         self.stats.completed += 1
-        self.stats.bytes_replicated += oi.size
+        self.stats.bytes_replicated += size
         self._set_status(op, COMPLETED)
 
     def _set_status(self, op: ReplicationOp, status: str) -> None:

@@ -1,0 +1,131 @@
+"""Transparent object compression (framed zlib).
+
+Reference: cmd/object-api-utils.go:455 (isCompressible — extension and
+content-type allow-lists, incompressible/encrypted exclusions) and :907
+(compression wrapping on PUT with internal metadata carrying the actual
+size).  The reference uses S2; here the codec is stdlib zlib at level 1
+in a self-describing block framing so range GETs can stream-decompress:
+
+    [u32 LE compressed-len][zlib block] ...   (1 MiB of input per block)
+
+Internal metadata (never surfaced to clients):
+    x-minio-internal-compression: zlib/blocked-v1
+    x-minio-internal-actual-size: <original byte count>
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import struct
+import zlib
+from typing import Iterator
+
+META_COMPRESSION = "x-minio-internal-compression"
+META_ACTUAL_SIZE = "x-minio-internal-actual-size"
+SCHEME = "zlib/blocked-v1"
+
+BLOCK = 1 << 20
+_LEVEL = 1  # speed over ratio, like S2
+
+
+def eligible(key: str, content_type: str, extensions: list[str],
+             mime_types: list[str]) -> bool:
+    """isCompressible (cmd/object-api-utils.go:455): any allow-list match;
+    an empty rule set matches nothing."""
+    key = key.lower()
+    for ext in extensions:
+        ext = ext.strip().lower()
+        if ext and key.endswith(ext):
+            return True
+    ct = (content_type or "").split(";")[0].strip().lower()
+    for pat in mime_types:
+        pat = pat.strip().lower()
+        if not pat:
+            continue
+        if pat.endswith("/*"):
+            if ct.startswith(pat[:-1]):
+                return True
+        elif ct == pat:
+            return True
+    return False
+
+
+class CompressingReader(io.RawIOBase):
+    """Wraps a plaintext stream, yields the framed compressed stream.
+
+    Tracks the original byte count and MD5 so the caller can store the
+    client-visible ETag/actual-size (the object layer hashes only what it
+    stores — the compressed frames)."""
+
+    def __init__(self, src):
+        self.src = src
+        self.md5 = hashlib.md5()
+        self.actual_size = 0
+        self._buf = b""
+        self._eof = False
+
+    def _fill(self) -> None:
+        while not self._eof and not self._buf:
+            chunk = self.src.read(BLOCK)
+            if not chunk:
+                self._eof = True
+                return
+            self.md5.update(chunk)
+            self.actual_size += len(chunk)
+            comp = zlib.compress(chunk, _LEVEL)
+            self._buf = struct.pack("<I", len(comp)) + comp
+
+    def read(self, n: int = -1) -> bytes:
+        if n is None or n < 0:
+            out = [self._buf]
+            self._buf = b""
+            while not self._eof:
+                self._fill()
+                out.append(self._buf)
+                self._buf = b""
+            return b"".join(out)
+        if not self._buf:
+            self._fill()
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    @property
+    def etag(self) -> str:
+        return self.md5.hexdigest()
+
+
+def decompress_stream(chunks: Iterator[bytes]) -> Iterator[bytes]:
+    """Invert the framing: yield original data blocks."""
+    buf = b""
+    for chunk in chunks:
+        buf += chunk
+        while True:
+            if len(buf) < 4:
+                break
+            (clen,) = struct.unpack("<I", buf[:4])
+            if len(buf) < 4 + clen:
+                break
+            yield zlib.decompress(buf[4:4 + clen])
+            buf = buf[4 + clen:]
+    if buf:
+        raise ValueError("truncated compressed stream")
+
+
+def decompress_range(chunks: Iterator[bytes], offset: int,
+                     length: int) -> Iterator[bytes]:
+    """Stream `length` original bytes starting at `offset` (blocks before
+    the offset are decompressed and skipped — same as the reference's
+    non-indexed compressed range reads)."""
+    remaining = length
+    for block in decompress_stream(chunks):
+        if remaining <= 0:
+            break
+        if offset >= len(block):
+            offset -= len(block)
+            continue
+        piece = block[offset:offset + remaining]
+        offset = 0
+        remaining -= len(piece)
+        if piece:
+            yield piece

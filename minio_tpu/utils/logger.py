@@ -75,6 +75,8 @@ class Logger:
         self.log("ERROR", msg, **ctx)
 
     def recent(self, n: int = 100) -> list[dict]:
+        if n <= 0:
+            return []
         with self._mu:
             return list(self.ring)[-n:]
 

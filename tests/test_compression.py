@@ -1,0 +1,215 @@
+"""Transparent compression: codec framing, eligibility, end-to-end PUT/
+GET/HEAD/range/copy, replication of original bytes.
+
+Reference: cmd/object-api-utils.go:455 (isCompressible), :907 (PUT
+wrapping), internal compression metadata.
+"""
+
+import io
+import json
+import os
+
+import pytest
+
+from minio_tpu.utils import compress
+from tests.s3_harness import S3TestServer
+
+ADMIN = "/minio/admin/v3"
+
+
+class TestCodec:
+    def test_round_trip(self):
+        data = b"hello world " * 100000  # compressible, multi-block
+        r = compress.CompressingReader(io.BytesIO(data))
+        framed = r.read()
+        assert len(framed) < len(data) // 4
+        assert r.actual_size == len(data)
+        out = b"".join(compress.decompress_stream(iter([framed])))
+        assert out == data
+
+    def test_range(self):
+        data = bytes(range(256)) * 8192  # 2 MiB
+        r = compress.CompressingReader(io.BytesIO(data))
+        framed = r.read()
+        got = b"".join(compress.decompress_range(
+            iter([framed[:100], framed[100:]]), 1 << 20, 1000))
+        assert got == data[1 << 20:(1 << 20) + 1000]
+
+    def test_truncated_raises(self):
+        data = b"x" * 1000
+        framed = compress.CompressingReader(io.BytesIO(data)).read()
+        with pytest.raises(ValueError):
+            list(compress.decompress_stream(iter([framed[:-3]])))
+
+    def test_eligibility(self):
+        exts = [".txt", ".log"]
+        mimes = ["text/*", "application/json"]
+        assert compress.eligible("a.txt", "", exts, mimes)
+        assert compress.eligible("a.bin", "text/plain", exts, mimes)
+        assert compress.eligible("a.bin", "application/json; charset=utf-8",
+                                 exts, mimes)
+        assert not compress.eligible("a.bin", "video/mp4", exts, mimes)
+        assert not compress.eligible("a.bin", "", [], [])
+
+
+@pytest.fixture(scope="module")
+def srv(tmp_path_factory):
+    os.environ["MINIO_TPU_FSYNC"] = "0"
+    s = S3TestServer(str(tmp_path_factory.mktemp("compr")))
+    # enable compression via the admin config API (dynamic subsystem)
+    r = s.request("PUT", f"{ADMIN}/set-config-kv", data=json.dumps(
+        {"subsys": "compression", "kv": {"enable": "on"}}).encode())
+    assert r.status == 200
+    yield s
+    s.close()
+
+
+DATA = (b"compress me please -- " * 8192) + b"tail"  # ~180 KiB, 2 blocks no
+
+
+class TestCompressionE2E:
+    def test_put_get_head(self, srv):
+        srv.request("PUT", "/czbkt")
+        import hashlib
+
+        r = srv.request("PUT", "/czbkt/doc.txt", data=DATA)
+        assert r.status == 200
+        # ETag is the md5 of the ORIGINAL bytes
+        assert r.headers["ETag"].strip('"') == hashlib.md5(DATA).hexdigest()
+
+        g = srv.request("GET", "/czbkt/doc.txt")
+        assert g.body == DATA
+        assert int(g.headers["Content-Length"]) == len(DATA)
+
+        h = srv.request("HEAD", "/czbkt/doc.txt")
+        assert int(h.headers["Content-Length"]) == len(DATA)
+        # internal metadata never leaks to clients
+        assert not any("internal" in k.lower() for k in h.headers)
+
+        # it actually stored compressed shards: object-layer size is the
+        # framed length, far below the original
+        oi = srv.pools.get_object_info("czbkt", "doc.txt")
+        assert oi.size < len(DATA) // 2
+        assert oi.metadata[compress.META_COMPRESSION] == compress.SCHEME
+
+    def test_range_get(self, srv):
+        r = srv.request("GET", "/czbkt/doc.txt",
+                        headers={"Range": "bytes=100000-100099"})
+        assert r.status == 206
+        assert r.body == DATA[100000:100100]
+        assert r.headers["Content-Range"] == \
+            f"bytes 100000-100099/{len(DATA)}"
+
+    def test_uncompressible_key_skipped(self, srv):
+        r = srv.request("PUT", "/czbkt/photo.jpgx", data=b"\x00" * 1000,
+                        headers={"Content-Type": "image/jpeg"})
+        assert r.status == 200
+        oi = srv.pools.get_object_info("czbkt", "photo.jpgx")
+        assert compress.META_COMPRESSION not in oi.metadata
+
+    def test_copy_preserves_data_and_etag(self, srv):
+        r = srv.request("PUT", "/czbkt/copy.txt",
+                        headers={"x-amz-copy-source": "/czbkt/doc.txt"})
+        assert r.status == 200, r.text()
+        g = srv.request("GET", "/czbkt/copy.txt")
+        assert g.body == DATA
+        import hashlib
+
+        assert g.headers["ETag"].strip('"') == \
+            hashlib.md5(DATA).hexdigest()
+
+    def test_sse_takes_precedence(self, srv):
+        r = srv.request(
+            "PUT", "/czbkt/enc.txt", data=DATA[:4096],
+            headers={"x-amz-server-side-encryption": "AES256"})
+        assert r.status == 200
+        oi = srv.pools.get_object_info("czbkt", "enc.txt")
+        assert compress.META_COMPRESSION not in oi.metadata
+        g = srv.request("GET", "/czbkt/enc.txt")
+        assert g.body == DATA[:4096]
+
+    def test_compressed_replication_sends_original(self, tmp_path):
+        """A compressed source object must arrive at the replication
+        target as its original bytes."""
+        import time
+
+        src = S3TestServer(str(tmp_path / "rsrc"), start_services=True,
+                           scan_interval=3600.0)
+        dst = S3TestServer(str(tmp_path / "rdst"), start_services=True,
+                           scan_interval=3600.0)
+        try:
+            src.request("PUT", f"{ADMIN}/set-config-kv", data=json.dumps(
+                {"subsys": "compression", "kv": {"enable": "on"}}).encode())
+            src.request("PUT", "/rsbkt")
+            dst.request("PUT", "/rdbkt")
+            ver = (b'<VersioningConfiguration><Status>Enabled</Status>'
+                   b'</VersioningConfiguration>')
+            src.request("PUT", "/rsbkt", query=[("versioning", "")], data=ver)
+            dst.request("PUT", "/rdbkt", query=[("versioning", "")], data=ver)
+            r = src.request("PUT", f"{ADMIN}/set-remote-target",
+                            query=[("bucket", "rsbkt")],
+                            data=json.dumps({
+                                "endpoint": dst.host, "targetbucket": "rdbkt",
+                                "accessKey": dst.ak, "secretKey": dst.sk,
+                            }).encode())
+            arn = json.loads(r.text())["arn"]
+            cfg = (
+                '<ReplicationConfiguration><Role>r</Role>'
+                '<Rule><ID>r1</ID><Status>Enabled</Status>'
+                '<Priority>1</Priority><Filter><Prefix></Prefix></Filter>'
+                f'<Destination><Bucket>{arn}</Bucket></Destination>'
+                '</Rule></ReplicationConfiguration>'
+            ).encode()
+            assert src.request("PUT", "/rsbkt",
+                               query=[("replication", "")],
+                               data=cfg).status == 200
+            assert src.request("PUT", "/rsbkt/c.txt",
+                               data=DATA).status == 200
+            t0 = time.time()
+            while time.time() - t0 < 10:
+                g = dst.request("GET", "/rdbkt/c.txt")
+                if g.status == 200:
+                    break
+                time.sleep(0.2)
+            assert g.status == 200
+            assert g.body == DATA
+        finally:
+            src.close()
+            dst.close()
+
+
+class TestCompressedSSECopy:
+    def test_sse_copy_of_compressed_source(self, srv):
+        """Copying a compressed object into an SSE destination must
+        normalize to original bytes (review regression: encrypted frames
+        with stale compression metadata were unreadable)."""
+        import hashlib
+
+        srv.request("PUT", "/czbkt/ssecopy-src.txt", data=DATA)
+        r = srv.request(
+            "PUT", "/czbkt/ssecopy-dst.txt",
+            headers={"x-amz-copy-source": "/czbkt/ssecopy-src.txt",
+                     "x-amz-server-side-encryption": "AES256"})
+        assert r.status == 200, r.text()
+        g = srv.request("GET", "/czbkt/ssecopy-dst.txt")
+        assert g.status == 200
+        assert g.body == DATA
+        assert int(g.headers["Content-Length"]) == len(DATA)
+        oi = srv.pools.get_object_info("czbkt", "ssecopy-dst.txt")
+        assert compress.META_COMPRESSION not in oi.metadata
+
+    def test_plain_copy_recompresses(self, srv):
+        """A plain copy of a compressed source stays compressed on disk
+        and keeps the original-bytes ETag."""
+        import hashlib
+
+        srv.request("PUT", "/czbkt/rc-src.txt", data=DATA)
+        r = srv.request("PUT", "/czbkt/rc-dst.txt",
+                        headers={"x-amz-copy-source": "/czbkt/rc-src.txt"})
+        assert r.status == 200, r.text()
+        g = srv.request("GET", "/czbkt/rc-dst.txt")
+        assert g.body == DATA
+        assert g.headers["ETag"].strip('"') == hashlib.md5(DATA).hexdigest()
+        oi = srv.pools.get_object_info("czbkt", "rc-dst.txt")
+        assert oi.metadata.get(compress.META_COMPRESSION) == compress.SCHEME
+        assert oi.size < len(DATA) // 2
