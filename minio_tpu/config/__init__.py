@@ -148,8 +148,7 @@ class ServerConfig:
             except (errors.StorageError, json.JSONDecodeError, ValueError):
                 continue
 
-    def _save(self) -> None:
-        raw = json.dumps(self._stored).encode()
+    def _save(self, raw: bytes) -> None:
         ok = 0
         for d in self._disks():
             try:
@@ -216,8 +215,9 @@ class ServerConfig:
         with self._mu:
             self._stored.setdefault(subsys, {}).update(
                 {k: str(v) for k, v in kvs.items()})
+            raw = json.dumps(self._stored).encode()
         if self.pools is not None:
-            self._save()
+            self._save(raw)
         self._apply(subsys)
 
     def del_kv(self, subsys: str, keys: list[str] | None = None) -> None:
@@ -231,8 +231,9 @@ class ServerConfig:
                     sub.pop(k, None)
             else:
                 self._stored.pop(subsys, None)
+            raw = json.dumps(self._stored).encode()
         if self.pools is not None:
-            self._save()
+            self._save(raw)
         self._apply(subsys)
 
     # -- dynamic apply -------------------------------------------------------

@@ -1,0 +1,128 @@
+"""AWS event-stream binary framing for SelectObjectContent responses.
+
+Reference: internal/s3select/message.go — the response body is a
+sequence of messages, each:
+
+    [4B total-length][4B headers-length][4B prelude CRC32]
+    [headers][payload][4B message CRC32]
+
+Headers are (1B name-len)(name)(1B type=7 string)(2B value-len)(value).
+Events: Records (payload = serialized rows), Progress/Stats (XML
+payload), Cont (keepalive), End.  The S3 SDKs parse exactly this.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+
+def _header(name: str, value: str) -> bytes:
+    nb = name.encode()
+    vb = value.encode()
+    return bytes([len(nb)]) + nb + b"\x07" + struct.pack(">H", len(vb)) + vb
+
+
+def message(headers: list[tuple[str, str]], payload: bytes) -> bytes:
+    hdrs = b"".join(_header(k, v) for k, v in headers)
+    total = 16 + len(hdrs) + len(payload)
+    prelude = struct.pack(">II", total, len(hdrs))
+    prelude_crc = struct.pack(">I", zlib.crc32(prelude) & 0xFFFFFFFF)
+    body = prelude + prelude_crc + hdrs + payload
+    return body + struct.pack(">I", zlib.crc32(body) & 0xFFFFFFFF)
+
+
+def records_message(payload: bytes) -> bytes:
+    return message([
+        (":message-type", "event"),
+        (":event-type", "Records"),
+        (":content-type", "application/octet-stream"),
+    ], payload)
+
+
+def _stats_xml(scanned: int, processed: int, returned: int) -> bytes:
+    return (
+        f"<Stats><BytesScanned>{scanned}</BytesScanned>"
+        f"<BytesProcessed>{processed}</BytesProcessed>"
+        f"<BytesReturned>{returned}</BytesReturned></Stats>"
+    ).encode()
+
+
+def progress_message(scanned: int, processed: int, returned: int) -> bytes:
+    return message([
+        (":message-type", "event"),
+        (":event-type", "Progress"),
+        (":content-type", "text/xml"),
+    ], _stats_xml(scanned, processed, returned).replace(
+        b"Stats>", b"Progress>"))
+
+
+def stats_message(scanned: int, processed: int, returned: int) -> bytes:
+    return message([
+        (":message-type", "event"),
+        (":event-type", "Stats"),
+        (":content-type", "text/xml"),
+    ], _stats_xml(scanned, processed, returned))
+
+
+def cont_message() -> bytes:
+    return message([
+        (":message-type", "event"),
+        (":event-type", "Cont"),
+    ], b"")
+
+
+def end_message() -> bytes:
+    return message([
+        (":message-type", "event"),
+        (":event-type", "End"),
+    ], b"")
+
+
+def error_message(code: str, desc: str) -> bytes:
+    return message([
+        (":message-type", "error"),
+        (":error-code", code),
+        (":error-message", desc),
+    ], b"")
+
+
+# ------------------------------------------------------------- decoding
+# (test-side helper; also useful for a future client)
+
+
+def decode_all(data: bytes) -> list[dict]:
+    """Parse a concatenated event-stream buffer into
+    [{headers: {...}, payload: bytes}, ...] with CRC verification."""
+    out = []
+    off = 0
+    while off < len(data):
+        if len(data) - off < 16:
+            raise ValueError("truncated prelude")
+        total, hlen = struct.unpack_from(">II", data, off)
+        (pcrc,) = struct.unpack_from(">I", data, off + 8)
+        if zlib.crc32(data[off:off + 8]) & 0xFFFFFFFF != pcrc:
+            raise ValueError("prelude CRC mismatch")
+        msg = data[off:off + total]
+        (mcrc,) = struct.unpack_from(">I", msg, total - 4)
+        if zlib.crc32(msg[:-4]) & 0xFFFFFFFF != mcrc:
+            raise ValueError("message CRC mismatch")
+        hdrs = {}
+        p = 12
+        end = 12 + hlen
+        while p < end:
+            nlen = msg[p]
+            p += 1
+            name = msg[p:p + nlen].decode()
+            p += nlen
+            typ = msg[p]
+            p += 1
+            if typ != 7:
+                raise ValueError(f"unsupported header type {typ}")
+            (vlen,) = struct.unpack_from(">H", msg, p)
+            p += 2
+            hdrs[name] = msg[p:p + vlen].decode()
+            p += vlen
+        out.append({"headers": hdrs, "payload": msg[end:total - 4]})
+        off += total
+    return out

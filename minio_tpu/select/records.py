@@ -1,0 +1,167 @@
+"""S3 Select input readers (CSV / JSON, optional gzip) and output writers.
+
+Reference: internal/s3select/csv/reader.go (FileHeaderInfo USE/IGNORE/
+NONE, custom delimiters, positional _N columns), internal/s3select/json
+(DOCUMENT and LINES types), internal/s3select/select.go (CSV/JSON
+output serialization with RecordDelimiter).
+"""
+
+from __future__ import annotations
+
+import csv
+import gzip
+import io
+import json
+from typing import Iterator
+
+from .sql import SQLError
+
+
+def _decomp(stream: io.RawIOBase, compression: str) -> io.RawIOBase:
+    comp = (compression or "NONE").upper()
+    if comp in ("NONE", ""):
+        return stream
+    if comp == "GZIP":
+        return gzip.GzipFile(fileobj=stream)
+    if comp == "BZIP2":
+        import bz2
+
+        return bz2.BZ2File(stream)
+    raise SQLError(f"unsupported CompressionType {compression}")
+
+
+class CSVInput:
+    """Streaming CSV records as dicts.
+
+    header USE  -> keys are the header names (positional _N also works)
+    header IGNORE/NONE -> keys are _1.._N only.
+    """
+
+    def __init__(self, stream, header_info: str = "USE",
+                 delimiter: str = ",", quote: str = '"',
+                 record_delimiter: str = "\n", compression: str = "NONE",
+                 comment: str = ""):
+        self.raw = _decomp(stream, compression)
+        text = io.TextIOWrapper(self.raw, encoding="utf-8",
+                                errors="replace", newline="")
+        self.reader = csv.reader(
+            text, delimiter=delimiter or ",", quotechar=quote or '"')
+        self.header_info = (header_info or "USE").upper()
+        self.comment = comment
+        self.header: list[str] | None = None
+
+    def __iter__(self) -> Iterator[dict]:
+        first = True
+        for row in self.reader:
+            if not row:
+                continue
+            if self.comment and row[0].startswith(self.comment):
+                continue
+            if first:
+                first = False
+                if self.header_info == "USE":
+                    self.header = [h.strip() for h in row]
+                    continue
+                if self.header_info == "IGNORE":
+                    continue
+            if self.header:
+                # header-named keys only: SELECT * must not double the
+                # columns; positional _N lookups resolve by index in the
+                # evaluator's fallback
+                rec = {}
+                for i, v in enumerate(row):
+                    h = self.header[i] if i < len(self.header) else ""
+                    rec[h or f"_{i + 1}"] = v
+                yield rec
+            else:
+                yield {f"_{i + 1}": v for i, v in enumerate(row)}
+
+
+class JSONInput:
+    """DOCUMENT (one or more whitespace-separated JSON docs) or LINES."""
+
+    def __init__(self, stream, json_type: str = "DOCUMENT",
+                 compression: str = "NONE"):
+        self.raw = _decomp(stream, compression)
+        self.json_type = (json_type or "DOCUMENT").upper()
+
+    def __iter__(self) -> Iterator[dict]:
+        if self.json_type == "LINES":
+            for line in io.TextIOWrapper(self.raw, encoding="utf-8",
+                                         errors="replace"):
+                line = line.strip()
+                if not line:
+                    continue
+                yield self._rec(line)
+            return
+        # DOCUMENT: parse concatenated top-level values
+        data = self.raw.read()
+        if isinstance(data, bytes):
+            data = data.decode("utf-8", "replace")
+        dec = json.JSONDecoder()
+        idx = 0
+        n = len(data)
+        while idx < n:
+            while idx < n and data[idx] in " \t\r\n":
+                idx += 1
+            if idx >= n:
+                break
+            try:
+                doc, idx = dec.raw_decode(data, idx)
+            except ValueError as e:
+                raise SQLError(f"invalid JSON input: {e}")
+            if isinstance(doc, list):
+                for item in doc:
+                    yield self._wrap(item)
+            else:
+                yield self._wrap(doc)
+
+    def _rec(self, line: str) -> dict:
+        try:
+            return self._wrap(json.loads(line))
+        except ValueError as e:
+            raise SQLError(f"invalid JSON line: {e}")
+
+    @staticmethod
+    def _wrap(doc) -> dict:
+        return doc if isinstance(doc, dict) else {"_1": doc}
+
+
+# ------------------------------------------------------------------ output
+
+
+def _csv_cell(v) -> str:
+    if v is None:
+        return ""
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return str(v)
+
+
+class CSVOutput:
+    def __init__(self, delimiter: str = ",", record_delimiter: str = "\n",
+                 quote: str = '"'):
+        self.delim = delimiter or ","
+        self.rdelim = record_delimiter or "\n"
+        self.quote = quote or '"'
+
+    def serialize(self, rec: dict) -> bytes:
+        buf = io.StringIO()
+        w = csv.writer(buf, delimiter=self.delim, quotechar=self.quote,
+                       lineterminator=self.rdelim)
+        w.writerow([_csv_cell(v) for v in rec.values()])
+        return buf.getvalue().encode()
+
+
+class JSONOutput:
+    def __init__(self, record_delimiter: str = "\n"):
+        self.rdelim = record_delimiter or "\n"
+
+    def serialize(self, rec: dict) -> bytes:
+        def default(o):
+            return str(o)
+
+        return json.dumps(rec, default=default).encode() + \
+            self.rdelim.encode()

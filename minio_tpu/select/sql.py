@@ -1,0 +1,758 @@
+"""S3 Select SQL: tokenizer, recursive-descent parser, evaluator.
+
+Reference: internal/s3select/sql (parser.go, evaluate.go, aggregation.go)
+— the S3 Select dialect: single-table SELECT over `S3Object` with
+projections, WHERE, LIMIT, aggregates, and a small scalar-function
+library.  This is an original implementation of the same dialect.
+
+Supported grammar (case-insensitive keywords):
+
+    SELECT <proj> [, <proj>...] FROM <table> [alias] [WHERE <expr>]
+                                              [LIMIT <n>]
+    proj   := * | expr [AS name]
+    expr   := or-chain of AND-chains of comparisons
+    cmp    := add (=|!=|<>|<|<=|>|>=) add | add [NOT] LIKE pattern
+              | add [NOT] IN (expr,...) | add [NOT] BETWEEN a AND b
+              | add IS [NOT] NULL | NOT cmp
+    add    := mul ((+|-) mul)* ; mul := unary ((*|/|%) unary)*
+    unary  := [-] primary
+    primary:= literal | column | function(args) | (expr)
+    column := name | alias.name | "quoted name" | s.[_1] style positions
+    funcs  := COUNT SUM MIN MAX AVG (aggregate)
+              LOWER UPPER LENGTH CHAR_LENGTH TRIM LTRIM RTRIM SUBSTRING
+              CAST(x AS INT|INTEGER|FLOAT|DECIMAL|STRING|BOOL|TIMESTAMP)
+              COALESCE NULLIF ABS
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+
+class SQLError(Exception):
+    """Maps to S3 error InvalidQuery / ParseSelectFailure."""
+
+
+# ----------------------------------------------------------------- lexer
+
+_TOKEN_RE = re.compile(r"""
+    \s*(?:
+      (?P<number>\d+\.\d*|\.\d+|\d+)
+    | (?P<dqstring>"(?:[^"]|"")*")
+    | (?P<string>'(?:[^']|'')*')
+    | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+    | (?P<bracket>\[[^\]]*\])
+    | (?P<op><>|!=|<=|>=|=|<|>|\(|\)|,|\*|/|%|\+|-|\.|;)
+    )""", re.VERBOSE)
+
+KEYWORDS = {
+    "select", "from", "where", "limit", "as", "and", "or", "not", "like",
+    "escape", "in", "between", "is", "null", "true", "false", "cast",
+}
+
+
+@dataclass
+class Tok:
+    kind: str  # number|string|ident|op|kw|bracket
+    val: str
+
+
+def tokenize(s: str) -> list[Tok]:
+    out: list[Tok] = []
+    pos = 0
+    while pos < len(s):
+        m = _TOKEN_RE.match(s, pos)
+        if not m or m.end() == pos:
+            rest = s[pos:].strip()
+            if not rest:
+                break
+            raise SQLError(f"unexpected character at: {rest[:20]!r}")
+        pos = m.end()
+        if m.lastgroup == "number":
+            out.append(Tok("number", m.group("number")))
+        elif m.lastgroup == "string":
+            out.append(Tok("string",
+                           m.group("string")[1:-1].replace("''", "'")))
+        elif m.lastgroup == "dqstring":
+            out.append(Tok("qident",
+                           m.group("dqstring")[1:-1].replace('""', '"')))
+        elif m.lastgroup == "ident":
+            v = m.group("ident")
+            out.append(Tok("kw" if v.lower() in KEYWORDS else "ident", v))
+        elif m.lastgroup == "bracket":
+            out.append(Tok("bracket", m.group("bracket")[1:-1]))
+        else:
+            out.append(Tok("op", m.group("op")))
+    return out
+
+
+# ------------------------------------------------------------------- AST
+
+
+@dataclass
+class Lit:
+    v: object
+
+
+@dataclass
+class Col:
+    name: str           # column name, or _N positional
+    def __post_init__(self):
+        self.lower = self.name.lower()
+
+
+@dataclass
+class Star:
+    pass
+
+
+@dataclass
+class Un:
+    op: str
+    e: object
+
+
+@dataclass
+class Bin:
+    op: str
+    l: object
+    r: object
+
+
+@dataclass
+class Like:
+    e: object
+    pat: object
+    negate: bool
+    esc: object = None
+
+
+@dataclass
+class InList:
+    e: object
+    items: list
+    negate: bool
+
+
+@dataclass
+class Between:
+    e: object
+    lo: object
+    hi: object
+    negate: bool
+
+
+@dataclass
+class IsNull:
+    e: object
+    negate: bool
+
+
+@dataclass
+class Func:
+    name: str
+    args: list
+    star: bool = False  # COUNT(*)
+
+
+@dataclass
+class Cast:
+    e: object
+    typ: str
+
+
+@dataclass
+class Projection:
+    expr: object
+    alias: str = ""
+
+
+@dataclass
+class Query:
+    projections: list[Projection] = field(default_factory=list)
+    star: bool = False
+    where: object = None
+    limit: int | None = None
+    table_alias: str = ""
+
+
+AGGREGATES = {"count", "sum", "min", "max", "avg"}
+SCALARS = {
+    "lower", "upper", "length", "char_length", "character_length", "trim",
+    "ltrim", "rtrim", "substring", "coalesce", "nullif", "abs", "utcnow",
+}
+
+
+class Parser:
+    def __init__(self, toks: list[Tok]):
+        self.toks = toks
+        self.i = 0
+
+    def peek(self) -> Tok | None:
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def next(self) -> Tok:
+        t = self.peek()
+        if t is None:
+            raise SQLError("unexpected end of query")
+        self.i += 1
+        return t
+
+    def accept_kw(self, *kws: str) -> str | None:
+        t = self.peek()
+        if t and t.kind == "kw" and t.val.lower() in kws:
+            self.i += 1
+            return t.val.lower()
+        return None
+
+    def expect_kw(self, kw: str) -> None:
+        if not self.accept_kw(kw):
+            raise SQLError(f"expected {kw.upper()}")
+
+    def accept_op(self, *ops: str) -> str | None:
+        t = self.peek()
+        if t and t.kind == "op" and t.val in ops:
+            self.i += 1
+            return t.val
+        return None
+
+    def expect_op(self, op: str) -> None:
+        if not self.accept_op(op):
+            got = self.peek()
+            raise SQLError(f"expected {op!r}, got {got.val if got else 'EOF'}")
+
+    # -- grammar ------------------------------------------------------------
+    def parse(self) -> Query:
+        q = Query()
+        self.expect_kw("select")
+        if self.accept_op("*"):
+            q.star = True
+        else:
+            q.projections.append(self.projection())
+            while self.accept_op(","):
+                q.projections.append(self.projection())
+        self.expect_kw("from")
+        self.table(q)
+        if self.accept_kw("where"):
+            q.where = self.expr()
+        if self.accept_kw("limit"):
+            t = self.next()
+            if t.kind != "number" or "." in t.val:
+                raise SQLError("LIMIT expects an integer")
+            q.limit = int(t.val)
+        self.accept_op(";")
+        if self.peek() is not None:
+            raise SQLError(f"trailing tokens near {self.peek().val!r}")
+        return q
+
+    def table(self, q: Query) -> None:
+        t = self.next()
+        name = t.val
+        if t.kind not in ("ident", "qident", "bracket"):
+            raise SQLError("bad FROM clause")
+        if name.lower() not in ("s3object", "s3objects"):
+            raise SQLError("FROM must reference S3Object")
+        # optional .something path (JSON documents) — consumed, top-level
+        while self.accept_op("."):
+            self.next()
+        t = self.peek()
+        if t and t.kind == "ident":
+            q.table_alias = self.next().val.lower()
+
+    def projection(self) -> Projection:
+        e = self.expr()
+        alias = ""
+        if self.accept_kw("as"):
+            t = self.next()
+            if t.kind not in ("ident", "qident"):
+                raise SQLError("bad alias")
+            alias = t.val
+        return Projection(e, alias)
+
+    def expr(self):
+        e = self.and_expr()
+        while self.accept_kw("or"):
+            e = Bin("or", e, self.and_expr())
+        return e
+
+    def and_expr(self):
+        e = self.not_expr()
+        while self.accept_kw("and"):
+            e = Bin("and", e, self.not_expr())
+        return e
+
+    def not_expr(self):
+        if self.accept_kw("not"):
+            return Un("not", self.not_expr())
+        return self.cmp_expr()
+
+    def cmp_expr(self):
+        e = self.add_expr()
+        t = self.peek()
+        negate = False
+        if t and t.kind == "kw" and t.val.lower() == "not":
+            nxt = self.toks[self.i + 1] if self.i + 1 < len(self.toks) else None
+            if nxt and nxt.kind == "kw" and nxt.val.lower() in (
+                    "like", "in", "between"):
+                self.i += 1
+                negate = True
+                t = self.peek()
+        if t and t.kind == "op" and t.val in ("=", "!=", "<>", "<", "<=",
+                                              ">", ">="):
+            self.i += 1
+            op = "!=" if t.val == "<>" else t.val
+            return Bin(op, e, self.add_expr())
+        if self.accept_kw("like"):
+            pat = self.add_expr()
+            esc = None
+            if self.accept_kw("escape"):
+                esc = self.add_expr()
+            return Like(e, pat, negate, esc)
+        if self.accept_kw("in"):
+            self.expect_op("(")
+            items = [self.expr()]
+            while self.accept_op(","):
+                items.append(self.expr())
+            self.expect_op(")")
+            return InList(e, items, negate)
+        if self.accept_kw("between"):
+            lo = self.add_expr()
+            self.expect_kw("and")
+            hi = self.add_expr()
+            return Between(e, lo, hi, negate)
+        if self.accept_kw("is"):
+            neg = bool(self.accept_kw("not"))
+            self.expect_kw("null")
+            return IsNull(e, neg)
+        return e
+
+    def add_expr(self):
+        e = self.mul_expr()
+        while True:
+            op = self.accept_op("+", "-")
+            if not op:
+                return e
+            e = Bin(op, e, self.mul_expr())
+
+    def mul_expr(self):
+        e = self.unary()
+        while True:
+            op = self.accept_op("*", "/", "%")
+            if not op:
+                return e
+            e = Bin(op, e, self.unary())
+
+    def unary(self):
+        if self.accept_op("-"):
+            return Un("neg", self.unary())
+        if self.accept_op("+"):
+            return self.unary()
+        return self.primary()
+
+    def primary(self):
+        t = self.next()
+        if t.kind == "number":
+            return Lit(float(t.val) if "." in t.val else int(t.val))
+        if t.kind == "string":
+            return Lit(t.val)
+        if t.kind == "kw":
+            kw = t.val.lower()
+            if kw == "null":
+                return Lit(None)
+            if kw == "true":
+                return Lit(True)
+            if kw == "false":
+                return Lit(False)
+            if kw == "cast":
+                self.expect_op("(")
+                e = self.expr()
+                self.expect_kw("as")
+                ty = self.next()
+                if ty.kind not in ("ident", "kw"):
+                    raise SQLError("bad CAST type")
+                self.expect_op(")")
+                return Cast(e, ty.val.lower())
+            raise SQLError(f"unexpected keyword {t.val!r}")
+        if t.kind == "op" and t.val == "(":
+            e = self.expr()
+            self.expect_op(")")
+            return e
+        if t.kind == "bracket":
+            return Col(t.val)
+        if t.kind in ("ident", "qident"):
+            name = t.val
+            # function call?
+            if t.kind == "ident" and self.accept_op("("):
+                fname = name.lower()
+                if fname not in AGGREGATES and fname not in SCALARS:
+                    raise SQLError(f"unknown function {name!r}")
+                if fname == "count" and self.accept_op("*"):
+                    self.expect_op(")")
+                    return Func("count", [], star=True)
+                args = []
+                if not self.accept_op(")"):
+                    args.append(self.expr())
+                    while self.accept_op(","):
+                        args.append(self.expr())
+                    self.expect_op(")")
+                return Func(fname, args)
+            # dotted path: alias.col or record.path
+            parts = [name]
+            while self.accept_op("."):
+                nt = self.next()
+                if nt.kind == "bracket":
+                    parts.append(nt.val)
+                elif nt.kind in ("ident", "qident"):
+                    parts.append(nt.val)
+                else:
+                    raise SQLError("bad column path")
+            return Col(".".join(parts))
+        raise SQLError(f"unexpected token {t.val!r}")
+
+
+def parse(query: str) -> Query:
+    return Parser(tokenize(query)).parse()
+
+
+# -------------------------------------------------------------- evaluate
+
+
+def _like_to_re(pat: str, esc: str | None) -> re.Pattern:
+    out = []
+    i = 0
+    while i < len(pat):
+        c = pat[i]
+        if esc and c == esc and i + 1 < len(pat):
+            out.append(re.escape(pat[i + 1]))
+            i += 2
+            continue
+        if c == "%":
+            out.append(".*")
+        elif c == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(c))
+        i += 1
+    return re.compile("^" + "".join(out) + "$", re.DOTALL)
+
+
+def _num(v):
+    """Coerce a CSV string (everything is text) to a number if possible."""
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)):
+        return v
+    if isinstance(v, str):
+        try:
+            return int(v)
+        except ValueError:
+            try:
+                return float(v)
+            except ValueError:
+                return v
+    return v
+
+
+def _cmp_pair(a, b):
+    a2, b2 = _num(a), _num(b)
+    if isinstance(a2, (int, float)) and not isinstance(a2, bool) \
+            and isinstance(b2, (int, float)) and not isinstance(b2, bool):
+        return a2, b2
+    return str(a), str(b)
+
+
+class Evaluator:
+    """Evaluates a parsed query against record dicts."""
+
+    def __init__(self, q: Query):
+        self.q = q
+        self._agg = any(
+            isinstance(p.expr, Func) and p.expr.name in AGGREGATES
+            for p in q.projections)
+        if self._agg and any(
+                not (isinstance(p.expr, Func)
+                     and p.expr.name in AGGREGATES)
+                for p in q.projections):
+            raise SQLError(
+                "cannot mix aggregate and non-aggregate projections")
+        self._agg_state = [dict(count=0, sum=0.0, min=None, max=None)
+                           for _ in q.projections]
+
+    @property
+    def is_aggregate(self) -> bool:
+        return self._agg
+
+    # -- scalar evaluation ---------------------------------------------------
+    def value(self, e, rec: dict):
+        if isinstance(e, Lit):
+            return e.v
+        if isinstance(e, Col):
+            return self._col(e, rec)
+        if isinstance(e, Un):
+            v = self.value(e.e, rec)
+            if e.op == "neg":
+                v = _num(v)
+                if not isinstance(v, (int, float)):
+                    raise SQLError("cannot negate non-number")
+                return -v
+            return not self._truth(v)
+        if isinstance(e, Bin):
+            return self._bin(e, rec)
+        if isinstance(e, Like):
+            v = self.value(e.e, rec)
+            if v is None:
+                return None
+            pat = self.value(e.pat, rec)
+            escv = self.value(e.esc, rec) if e.esc is not None else None
+            ok = bool(_like_to_re(str(pat), escv).match(str(v)))
+            return not ok if e.negate else ok
+        if isinstance(e, InList):
+            v = self.value(e.e, rec)
+            vals = [self.value(x, rec) for x in e.items]
+            hit = any(self._eq(v, x) for x in vals)
+            return not hit if e.negate else hit
+        if isinstance(e, Between):
+            v = self.value(e.e, rec)
+            lo = self.value(e.lo, rec)
+            hi = self.value(e.hi, rec)
+            a, l2 = _cmp_pair(v, lo)
+            b, h2 = _cmp_pair(v, hi)
+            ok = l2 <= a and b <= h2
+            return not ok if e.negate else ok
+        if isinstance(e, IsNull):
+            v = self.value(e.e, rec)
+            isnull = v is None or v == ""
+            return not isnull if e.negate else isnull
+        if isinstance(e, Cast):
+            return self._cast(self.value(e.e, rec), e.typ)
+        if isinstance(e, Func):
+            return self._scalar_fn(e, rec)
+        if isinstance(e, Star):
+            return rec
+        raise SQLError(f"cannot evaluate {type(e).__name__}")
+
+    def _col(self, c: Col, rec: dict):
+        name = c.name
+        alias = self.q.table_alias
+        parts = name.split(".")
+        if alias and parts and parts[0].lower() == alias:
+            parts = parts[1:]
+        if not parts:
+            return rec
+        cur = rec
+        for p in parts:
+            if isinstance(cur, dict):
+                if p in cur:
+                    cur = cur[p]
+                    continue
+                # case-insensitive fallback
+                lowered = {k.lower(): v for k, v in cur.items()}
+                if p.lower() in lowered:
+                    cur = lowered[p.lower()]
+                    continue
+                # positional _N over a named-column record (CSV with
+                # FileHeaderInfo=USE keeps only header keys)
+                if re.fullmatch(r"_\d+", p):
+                    vals = list(cur.values())
+                    i = int(p[1:]) - 1
+                    if 0 <= i < len(vals):
+                        cur = vals[i]
+                        continue
+                return None
+            elif isinstance(cur, list):
+                try:
+                    cur = cur[int(p.lstrip("_")) - 1]
+                except (ValueError, IndexError):
+                    return None
+            else:
+                return None
+        return cur
+
+    @staticmethod
+    def _truth(v) -> bool:
+        if v is None:
+            return False
+        if isinstance(v, bool):
+            return v
+        if isinstance(v, str):
+            return v.lower() == "true"
+        return bool(v)
+
+    def _eq(self, a, b) -> bool:
+        if a is None or b is None:
+            return False
+        x, y = _cmp_pair(a, b)
+        return x == y
+
+    def _bin(self, e: Bin, rec: dict):
+        if e.op == "and":
+            return self._truth(self.value(e.l, rec)) and \
+                self._truth(self.value(e.r, rec))
+        if e.op == "or":
+            return self._truth(self.value(e.l, rec)) or \
+                self._truth(self.value(e.r, rec))
+        lv = self.value(e.l, rec)
+        rv = self.value(e.r, rec)
+        if e.op in ("=", "!="):
+            eq = self._eq(lv, rv)
+            return eq if e.op == "=" else (
+                False if lv is None or rv is None else not eq)
+        if e.op in ("<", "<=", ">", ">="):
+            if lv is None or rv is None:
+                return False
+            a, b = _cmp_pair(lv, rv)
+            try:
+                return {"<": a < b, "<=": a <= b,
+                        ">": a > b, ">=": a >= b}[e.op]
+            except TypeError:
+                raise SQLError("incomparable operands")
+        # arithmetic
+        a, b = _num(lv), _num(rv)
+        if not isinstance(a, (int, float)) or isinstance(a, bool) \
+                or not isinstance(b, (int, float)) or isinstance(b, bool):
+            raise SQLError(f"arithmetic on non-numbers: {lv!r} {e.op} {rv!r}")
+        if e.op == "+":
+            return a + b
+        if e.op == "-":
+            return a - b
+        if e.op == "*":
+            return a * b
+        if e.op == "/":
+            if b == 0:
+                raise SQLError("division by zero")
+            return a / b
+        if e.op == "%":
+            if b == 0:
+                raise SQLError("division by zero")
+            return a % b
+        raise SQLError(f"bad operator {e.op}")
+
+    def _cast(self, v, typ: str):
+        if v is None:
+            return None
+        try:
+            if typ in ("int", "integer"):
+                return int(float(v))
+            if typ in ("float", "decimal", "numeric", "double"):
+                return float(v)
+            if typ in ("string", "varchar", "char"):
+                return str(v)
+            if typ in ("bool", "boolean"):
+                return self._truth(v)
+            if typ == "timestamp":
+                return str(v)
+        except (ValueError, TypeError):
+            raise SQLError(f"cannot CAST {v!r} to {typ}")
+        raise SQLError(f"unsupported CAST type {typ}")
+
+    def _scalar_fn(self, f: Func, rec: dict):
+        args = [self.value(a, rec) for a in f.args]
+        n = f.name
+        if n in AGGREGATES:
+            raise SQLError("aggregate in scalar context")
+        if n == "lower":
+            return None if args[0] is None else str(args[0]).lower()
+        if n == "upper":
+            return None if args[0] is None else str(args[0]).upper()
+        if n in ("length", "char_length", "character_length"):
+            return None if args[0] is None else len(str(args[0]))
+        if n == "trim":
+            return None if args[0] is None else str(args[0]).strip()
+        if n == "ltrim":
+            return None if args[0] is None else str(args[0]).lstrip()
+        if n == "rtrim":
+            return None if args[0] is None else str(args[0]).rstrip()
+        if n == "substring":
+            if args[0] is None:
+                return None
+            s = str(args[0])
+            start = int(_num(args[1])) if len(args) > 1 else 1
+            ln = int(_num(args[2])) if len(args) > 2 else None
+            start0 = max(start - 1, 0)
+            return s[start0:start0 + ln] if ln is not None else s[start0:]
+        if n == "coalesce":
+            for a in args:
+                if a is not None and a != "":
+                    return a
+            return None
+        if n == "nullif":
+            return None if self._eq(args[0], args[1]) else args[0]
+        if n == "abs":
+            v = _num(args[0])
+            if not isinstance(v, (int, float)):
+                raise SQLError("ABS expects a number")
+            return abs(v)
+        if n == "utcnow":
+            import time
+
+            return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        raise SQLError(f"unknown function {n}")
+
+    # -- per-record driving --------------------------------------------------
+    def matches(self, rec: dict) -> bool:
+        if self.q.where is None:
+            return True
+        return self._truth(self.value(self.q.where, rec))
+
+    def project(self, rec: dict) -> dict:
+        """Non-aggregate projection of one record."""
+        if self.q.star:
+            return rec
+        out = {}
+        for i, p in enumerate(self.q.projections):
+            name = p.alias or self._auto_name(p.expr, i)
+            out[name] = self.value(p.expr, rec)
+        return out
+
+    def accumulate(self, rec: dict) -> None:
+        for i, p in enumerate(self.q.projections):
+            f = p.expr
+            st = self._agg_state[i]
+            if f.star:
+                st["count"] += 1
+                continue
+            v = self.value(f.args[0], rec) if f.args else None
+            if v is None or v == "":
+                continue
+            st["count"] += 1
+            if f.name in ("sum", "avg"):
+                nv = _num(v)
+                if not isinstance(nv, (int, float)) or isinstance(nv, bool):
+                    raise SQLError(f"{f.name.upper()} over non-number")
+                st["sum"] += nv
+            if f.name in ("min", "max"):
+                nv = _num(v)
+                if st["min"] is None:
+                    st["min"] = st["max"] = nv
+                else:
+                    a, b = _cmp_pair(nv, st["min"])
+                    if a < b:
+                        st["min"] = nv
+                    a, b = _cmp_pair(nv, st["max"])
+                    if a > b:
+                        st["max"] = nv
+
+    def aggregate_result(self) -> dict:
+        out = {}
+        for i, p in enumerate(self.q.projections):
+            f = p.expr
+            name = p.alias or self._auto_name(f, i)
+            st = self._agg_state[i]
+            if f.name == "count":
+                out[name] = st["count"]
+            elif f.name == "sum":
+                out[name] = st["sum"] if st["count"] else None
+            elif f.name == "avg":
+                out[name] = (st["sum"] / st["count"]) if st["count"] else None
+            elif f.name == "min":
+                out[name] = st["min"]
+            elif f.name == "max":
+                out[name] = st["max"]
+        return out
+
+    @staticmethod
+    def _auto_name(e, i: int) -> str:
+        if isinstance(e, Col):
+            return e.name.split(".")[-1]
+        return f"_{i + 1}"

@@ -133,6 +133,31 @@ class _ChunkedSigReader(io.RawIOBase):
         return out
 
 
+class _IterStream(io.RawIOBase):
+    """Read()-able view over an iterator of byte chunks."""
+
+    def __init__(self, it):
+        self.it = it
+        self.buf = b""
+
+    def readable(self) -> bool:
+        return True
+
+    def read(self, n: int = -1) -> bytes:
+        if n is None or n < 0:
+            parts = [self.buf]
+            self.buf = b""
+            parts.extend(self.it)
+            return b"".join(parts)
+        while len(self.buf) < n:
+            chunk = next(self.it, None)
+            if chunk is None:
+                break
+            self.buf += chunk
+        out, self.buf = self.buf[:n], self.buf[n:]
+        return out
+
+
 class _QueuePipeReader(io.RawIOBase):
     """Bridges async body chunks into the sync object layer."""
 
@@ -263,6 +288,10 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
 
             self.config.on_change("scanner", _apply_scanner)
             self.config.on_change("heal", _apply_heal)
+            # persisted dynamic settings must take effect NOW, not only
+            # on the next admin write (review: restart lost them)
+            _apply_scanner(self.config)
+            _apply_heal(self.config)
 
     def _quota_check(self, bucket: str, size: int) -> None:
         """Hard-quota enforcement against the scanner's usage cache
@@ -621,6 +650,8 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 return await self._handle(request, self.create_upload)
             if "uploadId" in q:
                 return await self._handle(request, self.complete_upload)
+            if "select" in q:
+                return await self._handle(request, self.select_object_content)
         return await self._handle(request, self._method_not_allowed)
 
     @staticmethod
@@ -1466,6 +1497,83 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             else EventName.OBJECT_REMOVED_DELETE,
             bucket, key, version_id=oi.version_id, request=request)
         return web.Response(status=204, headers=headers)
+
+    async def select_object_content(
+            self, request: web.Request) -> web.StreamResponse:
+        """SelectObjectContent: SQL over one CSV/JSON object, streamed
+        back in AWS event-stream framing (reference
+        SelectObjectContentHandler, cmd/object-handlers.go;
+        internal/s3select/select.go:218)."""
+        from minio_tpu.crypto import sse as sse_mod
+        from minio_tpu.select import SelectRequest, run_select
+        from minio_tpu.select.sql import SQLError
+        from minio_tpu.utils import compress as compress_mod
+
+        body = await request.read()
+        bucket, key = self._object(request)
+        await self._auth(request, hashlib.sha256(body).hexdigest(),
+                         "s3:GetObject", bucket, key)
+        if request.rel_url.query.get("select-type") != "2":
+            raise S3Error("InvalidArgument",
+                          "select-type=2 query parameter is required")
+        try:
+            sreq = SelectRequest.from_xml(body)
+        except SQLError as e:
+            raise S3Error("InvalidArgument", str(e))
+        vid = request.rel_url.query.get("versionId", "")
+        oi = await self._run(self.api.get_object_info, bucket, key, vid)
+
+        # plaintext source stream (decompress / decrypt like GET)
+        if oi.metadata.get(sse_mod.META_ALGO):
+            obj_key = self.sse_object_key(oi, bucket, key, request)
+            nonce_prefix = base64.b64decode(
+                oi.metadata.get(sse_mod.META_NONCE, ""))
+            plain = sse_mod.plain_size_of(oi.size)
+            _, raw = await self._run(
+                self.api.get_object, bucket, key, 0, -1, vid)
+            chunks = sse_mod.decrypt_chunks(
+                iter(raw), obj_key, nonce_prefix,
+                f"{bucket}/{key}".encode(), 0, 0, plain)
+            src_size = plain
+        elif oi.metadata.get(
+                compress_mod.META_COMPRESSION) == compress_mod.SCHEME:
+            _, raw = await self._run(
+                self.api.get_object, bucket, key, 0, -1, vid)
+            chunks = compress_mod.decompress_stream(iter(raw))
+            src_size = int(oi.metadata.get(
+                compress_mod.META_ACTUAL_SIZE, oi.size))
+        else:
+            _, raw = await self._run(
+                self.api.get_object, bucket, key, 0, -1, vid)
+            chunks = iter(raw)
+            src_size = oi.size
+
+        stream = _IterStream(chunks)
+        try:
+            gen = run_select(sreq, stream, src_size)
+            # produce the FIRST message on the executor before preparing
+            # the response: parse/plan errors still map to clean HTTP 4xx
+            first = await self._run(next, gen, None)
+        except SQLError as e:
+            raise S3Error("InvalidArgument", str(e))
+        from minio_tpu.events.event import EventName
+
+        self._emit(EventName.OBJECT_ACCESSED_GET, bucket, key,
+                   size=oi.size, etag=oi.etag, version_id=oi.version_id,
+                   request=request)
+        resp = web.StreamResponse(status=200, headers={
+            "Content-Type": "application/octet-stream"})
+        await resp.prepare(request)
+        try:
+            msg = first
+            while msg is not None:
+                await resp.write(msg)
+                msg = await self._run(next, gen, None)
+        finally:
+            if hasattr(raw, "close"):
+                await self._run(raw.close)
+        await resp.write_eof()
+        return resp
 
     # ----------------------------------------------------------- multipart
     async def create_upload(self, request: web.Request) -> web.Response:

@@ -149,7 +149,17 @@ class SSEMixin:
 
     @staticmethod
     def _display_size(oi) -> int:
-        """Client-visible (decrypted) size of a possibly-SSE object."""
+        """Client-visible size of a possibly-SSE / possibly-compressed
+        object (listings must agree with GET/HEAD Content-Length)."""
         if oi.metadata.get(sse.META_ALGO):
             return sse.plain_size_of(oi.size)
+        from minio_tpu.utils import compress as compress_mod
+
+        if oi.metadata.get(
+                compress_mod.META_COMPRESSION) == compress_mod.SCHEME:
+            try:
+                return int(oi.metadata.get(
+                    compress_mod.META_ACTUAL_SIZE, oi.size))
+            except (TypeError, ValueError):
+                return oi.size
         return oi.size
