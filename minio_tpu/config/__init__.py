@@ -179,6 +179,15 @@ class ServerConfig:
             return SUBSYSTEMS[subsys][key]
         return default if default is not None else ""
 
+    def is_set(self, subsys: str, key: str) -> bool:
+        """True when env or stored config explicitly sets the key (used
+        so startup apply never stomps CLI/operator values with registry
+        defaults)."""
+        if self.env.get(f"MINIO_{subsys.upper()}_{key.upper()}") is not None:
+            return True
+        with self._mu:
+            return key in self._stored.get(subsys, {})
+
     def get_int(self, subsys: str, key: str, default: int) -> int:
         try:
             return int(float(self.get(subsys, key, str(default))))

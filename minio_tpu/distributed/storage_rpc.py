@@ -197,6 +197,16 @@ def register_storage_rpc(router: RpcRouter, drives: dict[str, LocalStorage]) -> 
 
         return StreamResult(chunks())
 
+    @h("free_version_data")
+    def _free_version_data(args, body):
+        import json as _json
+
+        doc = _json.loads(body)
+        drive(args).free_version_data(
+            args["volume"], args["path"], doc.get("versionId", ""),
+            doc.get("meta", {}))
+        return {}, b""
+
     @h("verify_file")
     def _verify_file(args, body):
         drive(args).verify_file(args["volume"], args["path"],
@@ -412,6 +422,14 @@ class RemoteStorage(StorageAPI):
                     yield from batch
         finally:
             resp.close()
+
+    def free_version_data(self, volume: str, path: str, version_id: str,
+                          meta_updates: dict) -> None:
+        import json as _json
+
+        self._call("free_version_data", {"volume": volume, "path": path},
+                   body=_json.dumps({"versionId": version_id,
+                                     "meta": meta_updates}).encode())
 
     def verify_file(self, volume: str, path: str, fi: FileInfo) -> None:
         self._call("verify_file", {"volume": volume, "path": path,

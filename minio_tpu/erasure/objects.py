@@ -35,6 +35,12 @@ from . import bitrot
 from .coding import BLOCK_SIZE_V2, Erasure, _io_pool
 
 SMALL_FILE_THRESHOLD = 128 << 10  # inline shards into xl.meta below this
+
+# tiering stub metadata (never surfaced to clients)
+TRANSITION_STATUS_KEY = "x-minio-internal-transition-status"
+TRANSITION_TIER_KEY = "x-minio-internal-transition-tier"
+TRANSITION_KEY_KEY = "x-minio-internal-transition-key"
+TRANSITION_COMPLETE = "complete"
 MULTIPART_VOL = SYSTEM_VOL
 MULTIPART_DIR = "multipart"
 
@@ -211,6 +217,7 @@ class ErasureObjects:
         self.pool_index = pool_index
         self.ns = ns_lock or NamespaceLock()
         self.heal_queue = heal_queue  # async heal trigger (MRF analogue)
+        self.tier_delete_hook = None  # wired by the tiering subsystem
 
     # ------------------------------------------------------------------ util
     @property
@@ -583,6 +590,35 @@ class ErasureObjects:
         finally:
             sink.close()
 
+    # ------------------------------------------------------------ TIERING
+    def transition_version(self, bucket: str, obj: str, version_id: str,
+                           meta_updates: dict,
+                           expected_mod_time: float = 0.0) -> None:
+        """Free the version's local shard data on every drive, leaving a
+        metadata stub pointing at the warm tier (reference transition
+        path, cmd/bucket-lifecycle.go + xl free-versions).
+
+        `expected_mod_time` guards against freeing a version that was
+        overwritten while its bytes were being uploaded to the tier (the
+        upload happens outside this lock)."""
+        with self.ns.write(f"{bucket}/{obj}"):
+            if expected_mod_time:
+                fi0, _, _ = self._quorum_info(bucket, obj, version_id)
+                if abs(fi0.mod_time - expected_mod_time) > 1e-6:
+                    raise errors.InvalidArgument(
+                        "version changed during transition")
+
+            def free(i: int) -> None:
+                d = self.disks[i]
+                if d is None or not d.is_online():
+                    raise errors.DiskNotFound(str(i))
+                d.free_version_data(bucket, obj, version_id, meta_updates)
+
+            errs = self._fan_out(free, range(len(self.disks)))
+            _, wq = self._quorum_from([None] * len(self.disks))
+            if sum(1 for e2 in errs if e2 is None) < wq:
+                raise errors.ErasureWriteQuorum("transition quorum not met")
+
     # ---------------------------------------------------------------- DELETE
     def delete_object(self, bucket: str, obj: str, version_id: str = "",
                       versioned: bool = False,
@@ -635,6 +671,20 @@ class ErasureObjects:
                                 delete_marker=True, mod_time=marker.mod_time)
                 return oi
 
+            tier_meta = None
+            if self.tier_delete_hook is not None:
+                # capture the stub's tier pointer now, enqueue the remote
+                # reclaim only AFTER the local delete succeeds (a failed
+                # delete must not strand a live stub pointing at deleted
+                # tier data) — reference tier-journal, cmd/tier-journal.go
+                try:
+                    fi0, _, _ = self._quorum_info(bucket, obj, version_id)
+                    if fi0.metadata.get(TRANSITION_STATUS_KEY) == \
+                            TRANSITION_COMPLETE:
+                        tier_meta = dict(fi0.metadata)
+                except errors.StorageError:
+                    pass
+
             fi = FileInfo(volume=bucket, name=obj, version_id=version_id,
                           deleted=False, mod_time=time.time())
 
@@ -652,6 +702,8 @@ class ErasureObjects:
                 pass  # idempotent delete of missing object is S3-legal
             if real and len(real) > len(self.disks) - (len(self.disks) // 2):
                 raise errors.ErasureWriteQuorum("delete quorum not met")
+            if tier_meta is not None:
+                self.tier_delete_hook(tier_meta)
             return ObjectInfo(bucket=bucket, name=obj, version_id=version_id)
 
     # ------------------------------------------------------------- METADATA
@@ -738,6 +790,26 @@ class ErasureObjects:
                 return HealResult(failed=True)
             if fi.deleted:
                 return HealResult(object_size=0)
+            if fi.metadata.get(TRANSITION_STATUS_KEY) == TRANSITION_COMPLETE:
+                # tiered stub: no shards to rebuild, but the xl.meta stub
+                # itself must exist on every drive or the tier pointer can
+                # fall below quorum as drives are replaced
+                result = HealResult(object_size=fi.size)
+                fi.data = None
+                for i, d in enumerate(self.disks):
+                    result.drives_before.append(
+                        "missing" if fis[i] is None else "ok")
+                    if d is not None and d.is_online() and fis[i] is None:
+                        try:
+                            d.write_metadata(bucket, obj, fi)
+                            result.healed_drives += 1
+                            result.drives_after.append("healed")
+                            continue
+                        except errors.StorageError:
+                            pass
+                    result.drives_after.append(
+                        "missing" if fis[i] is None else "ok")
+                return result
             e = Erasure(fi.erasure.data_blocks, fi.erasure.parity_blocks,
                         fi.erasure.block_size)
             n = e.k + e.m

@@ -224,6 +224,11 @@ class ErasureSets:
     def heal_object(self, bucket, obj, version_id="", deep=False) -> HealResult:
         return self.get_hashed_set(obj).heal_object(bucket, obj, version_id, deep)
 
+    def transition_version(self, bucket, obj, version_id, meta_updates,
+                           expected_mod_time=0.0):
+        return self.get_hashed_set(obj).transition_version(
+            bucket, obj, version_id, meta_updates, expected_mod_time)
+
     def update_object_metadata(self, bucket, obj, updates, version_id=""):
         return self.get_hashed_set(obj).update_object_metadata(
             bucket, obj, updates, version_id)
@@ -467,6 +472,14 @@ class ErasureServerPools:
             if not res.failed:
                 return res
         return HealResult(failed=True)
+
+    def transition_version(self, bucket, obj, version_id, meta_updates,
+                           expected_mod_time=0.0):
+        p = self._pool_of(bucket, obj)
+        if p is None:
+            raise errors.ObjectNotFound(f"{bucket}/{obj}")
+        return p.transition_version(bucket, obj, version_id, meta_updates,
+                                    expected_mod_time)
 
     def update_object_metadata(self, bucket, obj, updates, version_id=""):
         p = self._pool_of(bucket, obj)

@@ -128,6 +128,8 @@ def run_select(req: SelectRequest, stream,
                 continue
             if not ev.matches(rec):
                 continue
+            if limit is not None and n_out >= limit:
+                break
             buf += out.serialize(ev.project(rec))
             n_out += 1
             if len(buf) >= FLUSH:

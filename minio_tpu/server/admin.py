@@ -80,6 +80,11 @@ class AdminMixin:
         # TraceHandler cmd/admin-handlers.go:1108, ConsoleLogHandler)
         r.add_get(f"{p}/trace", wrap(self.admin_trace, "ServerTrace"))
         r.add_get(f"{p}/log", wrap(self.admin_console_log, "ConsoleLog"))
+        # tiering (reference cmd/admin-handlers.go AddTierHandler /
+        # ListTierHandler / RemoveTierHandler)
+        r.add_put(f"{p}/tier", wrap(self.admin_add_tier, "SetTier"))
+        r.add_get(f"{p}/tier", wrap(self.admin_list_tiers, "ListTier"))
+        r.add_delete(f"{p}/tier", wrap(self.admin_remove_tier, "SetTier"))
         # config KVS (reference cmd/admin-handlers-config-kv.go:
         # GetConfigKVHandler / SetConfigKVHandler / DelConfigKVHandler /
         # HelpConfigKVHandler)
@@ -106,6 +111,49 @@ class AdminMixin:
                     content_type="application/json",
                 )
         return handler
+
+    # ------------------------------------------------------------- tiering
+    def _tier_mgr(self):
+        services = self._services_or_503()
+        if getattr(services, "tier", None) is None:
+            raise S3Error("XMinioServerNotInitialized")
+        return services.tier
+
+    async def admin_add_tier(self, request: web.Request, body: bytes):
+        from minio_tpu.services.tier import TierError
+
+        try:
+            doc = json.loads(body)
+            name = doc.pop("name")
+        except (ValueError, KeyError, TypeError, AttributeError):
+            raise S3Error("InvalidArgument",
+                          'body must be {"name": ..., "type": ..., ...}')
+        try:
+            await self._run(self._tier_mgr().add_tier, name, doc)
+        except TierError as e:
+            raise S3Error("InvalidArgument", str(e))
+        return self._json({})
+
+    async def admin_list_tiers(self, request: web.Request, body: bytes):
+        mgr = self._tier_mgr()
+        out = await self._run(mgr.list_tiers)
+        return self._json({
+            "tiers": out,
+            "journalPending": mgr.journal.pending(),
+            "transitioned": mgr.transitioned,
+        })
+
+    async def admin_remove_tier(self, request: web.Request, body: bytes):
+        from minio_tpu.services.tier import TierError
+
+        name = request.rel_url.query.get("name", "")
+        if not name:
+            raise S3Error("InvalidArgument", "name query param required")
+        try:
+            await self._run(self._tier_mgr().remove_tier, name)
+        except TierError as e:
+            raise S3Error("InvalidArgument", str(e))
+        return self._json({})
 
     # -------------------------------------------------------------- config
     async def admin_get_config(self, request: web.Request, body: bytes):

@@ -263,12 +263,22 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         admin plane can reach it (reference: serverMain starting
         initAutoHeal/initHealMRF/initDataScanner, cmd/server-main.go:528)."""
         self.services = services
+        if services is not None and getattr(services, "tier", None) is None:
+            from minio_tpu.services.tier import TierManager
+
+            eq = _event_queue_dir(self.api)
+            services.tier = TierManager(
+                self.api,
+                journal_dir=os.path.join(os.path.dirname(eq),
+                                         "tier-journal") if eq else None)
         if services is not None and services.scanner.lifecycle_fn is None:
             # scanner applies this server's stored ILM configs
             # (cmd/data-scanner.go:891 applyActions)
             from minio_tpu.services.lifecycle import LifecycleRunner
 
-            services.scanner.lifecycle_fn = LifecycleRunner(self.api, self.meta)
+            services.scanner.lifecycle_fn = LifecycleRunner(
+                self.api, self.meta,
+                transition_fn=services.tier.transition)
         if services is not None \
                 and getattr(services, "replication", None) is None:
             from minio_tpu.services.replication import ReplicationPool
@@ -289,9 +299,12 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             self.config.on_change("scanner", _apply_scanner)
             self.config.on_change("heal", _apply_heal)
             # persisted dynamic settings must take effect NOW, not only
-            # on the next admin write (review: restart lost them)
-            _apply_scanner(self.config)
-            _apply_heal(self.config)
+            # on the next admin write — but only when explicitly set:
+            # registry defaults must not stomp CLI/env-chosen intervals
+            if self.config.is_set("scanner", "interval"):
+                _apply_scanner(self.config)
+            if self.config.is_set("heal", "interval"):
+                _apply_heal(self.config)
 
     def _quota_check(self, bucket: str, size: int) -> None:
         """Hard-quota enforcement against the scanner's usage cache
@@ -1216,6 +1229,22 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                                                    oi.version_id)
         return repl.PENDING
 
+    async def _obj_stream(self, bucket: str, key: str, vid: str,
+                          offset: int, length: int, oi):
+        """Stored-bytes stream for GET/Select: local shards normally, the
+        warm tier for transitioned stubs (reference getTransitionedObject
+        read-through, cmd/bucket-lifecycle.go)."""
+        svcs = self.services
+        if svcs is not None and getattr(svcs, "tier", None) is not None:
+            from minio_tpu.services.tier import TierManager
+
+            if TierManager.is_transitioned(oi.metadata):
+                return svcs.tier.read(oi.metadata, offset,
+                                      length if length >= 0 else -1)
+        _, stream = await self._run(
+            self.api.get_object, bucket, key, offset, length, vid)
+        return stream
+
     def _compress_eligible(self, key: str, content_type: str) -> bool:
         if not self.config.get_bool("compression", "enable"):
             return False
@@ -1398,8 +1427,8 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 offset, length, size)
             nonce_prefix = base64.b64decode(
                 oi.metadata.get(sse_mod.META_NONCE, ""))
-            _, ct_stream = await self._run(
-                self.api.get_object, bucket, key, ct_off, ct_len, vid)
+            ct_stream = await self._obj_stream(bucket, key, vid,
+                                               ct_off, ct_len, oi)
             stream = sse_mod.decrypt_chunks(
                 iter(ct_stream), obj_key, nonce_prefix,
                 f"{bucket}/{key}".encode(), first_seq, skip, length)
@@ -1408,14 +1437,12 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             # stored frames are opaque: decompress from the start and
             # skip to the requested range (reference non-indexed
             # compressed reads)
-            _, raw = await self._run(
-                self.api.get_object, bucket, key, 0, -1, vid)
+            raw = await self._obj_stream(bucket, key, vid, 0, -1, oi)
             stream = compress_mod.decompress_range(iter(raw), offset, length)
             closer = raw
         else:
-            _, stream = await self._run(
-                self.api.get_object, bucket, key, offset, length, vid
-            )
+            stream = await self._obj_stream(bucket, key, vid,
+                                            offset, length, oi)
             closer = stream
         from minio_tpu.events.event import EventName
 
@@ -1529,22 +1556,19 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             nonce_prefix = base64.b64decode(
                 oi.metadata.get(sse_mod.META_NONCE, ""))
             plain = sse_mod.plain_size_of(oi.size)
-            _, raw = await self._run(
-                self.api.get_object, bucket, key, 0, -1, vid)
+            raw = await self._obj_stream(bucket, key, vid, 0, -1, oi)
             chunks = sse_mod.decrypt_chunks(
                 iter(raw), obj_key, nonce_prefix,
                 f"{bucket}/{key}".encode(), 0, 0, plain)
             src_size = plain
         elif oi.metadata.get(
                 compress_mod.META_COMPRESSION) == compress_mod.SCHEME:
-            _, raw = await self._run(
-                self.api.get_object, bucket, key, 0, -1, vid)
+            raw = await self._obj_stream(bucket, key, vid, 0, -1, oi)
             chunks = compress_mod.decompress_stream(iter(raw))
             src_size = int(oi.metadata.get(
                 compress_mod.META_ACTUAL_SIZE, oi.size))
         else:
-            _, raw = await self._run(
-                self.api.get_object, bucket, key, 0, -1, vid)
+            raw = await self._obj_stream(bucket, key, vid, 0, -1, oi)
             chunks = iter(raw)
             src_size = oi.size
 

@@ -26,6 +26,7 @@ class ServiceManager:
                                    lifecycle_fn=lifecycle_fn)
         self.bg_heal = BackgroundHealer(object_layer, interval=heal_interval)
         self.replication = None  # ReplicationPool, wired by attach_services
+        self.tier = None         # TierManager, wired by attach_services
         self._attach_heal_queue()
 
     def _attach_heal_queue(self) -> None:
@@ -40,6 +41,8 @@ class ServiceManager:
         self.mrf.close()
         if self.replication is not None:
             self.replication.close()
+        if self.tier is not None:
+            self.tier.close()
 
 
 __all__ = [

@@ -1,0 +1,374 @@
+"""Tiering: warm-tier backends, tier registry, transition, tier journal.
+
+Reference: cmd/tier.go:386 (TierConfigMgr — named tier registry persisted
+in the system volume), cmd/warm-backend-s3.go / warm-backend-minio.go
+(remote warm backends), cmd/bucket-lifecycle.go (transitionObject:
+upload to the tier, then replace local data with a metadata stub;
+GET of a transitioned object streams through from the tier), and
+cmd/tier-journal.go (deferred deletes of tiered data, retried until the
+remote accepts them).
+
+Backends here: `fs` (a local directory — single-host warm storage and
+the test backend) and `s3` (any S3-compatible endpoint via the repo's
+own SigV4 client).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import uuid
+from typing import Iterator
+
+from minio_tpu.erasure.objects import (
+    TRANSITION_COMPLETE, TRANSITION_KEY_KEY, TRANSITION_STATUS_KEY,
+    TRANSITION_TIER_KEY,
+)
+from minio_tpu.storage import errors
+from minio_tpu.storage.local import SYSTEM_VOL
+from minio_tpu.utils.s3client import S3Client, S3ClientError
+
+TIERS_PATH = "config/tiers.json"
+
+
+class TierError(Exception):
+    pass
+
+
+# ------------------------------------------------------------- backends
+
+
+class FSWarmBackend:
+    """Warm tier on a local directory (also the test double)."""
+
+    kind = "fs"
+
+    def __init__(self, directory: str, prefix: str = ""):
+        self.dir = directory
+        self.prefix = prefix.strip("/")
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        p = os.path.join(self.dir, self.prefix, key) if self.prefix \
+            else os.path.join(self.dir, key)
+        ap = os.path.abspath(p)
+        if not ap.startswith(os.path.abspath(self.dir) + os.sep):
+            raise TierError(f"tier key escapes backend root: {key!r}")
+        return ap
+
+    def put(self, key: str, stream, length: int) -> None:
+        p = self._path(key)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = p + f".tmp.{uuid.uuid4().hex[:8]}"
+        with open(tmp, "wb") as f:
+            for chunk in stream:
+                f.write(chunk)
+        os.replace(tmp, p)
+
+    def get(self, key: str, offset: int = 0,
+            length: int = -1) -> Iterator[bytes]:
+        p = self._path(key)
+        try:
+            f = open(p, "rb")
+        except FileNotFoundError:
+            raise TierError(f"tier object missing: {key}")
+        try:
+            f.seek(offset)
+            remaining = length if length >= 0 else None
+            while True:
+                n = 1 << 20 if remaining is None else min(1 << 20, remaining)
+                if n <= 0:
+                    break
+                chunk = f.read(n)
+                if not chunk:
+                    break
+                if remaining is not None:
+                    remaining -= len(chunk)
+                yield chunk
+        finally:
+            f.close()
+
+    def remove(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+
+
+class S3WarmBackend:
+    """Warm tier on any S3-compatible endpoint."""
+
+    kind = "s3"
+
+    def __init__(self, endpoint: str, bucket: str, access_key: str,
+                 secret_key: str, prefix: str = "",
+                 region: str = "us-east-1"):
+        self.client = S3Client(endpoint, access_key, secret_key,
+                               region=region)
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+
+    def _key(self, key: str) -> str:
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    def put(self, key: str, stream, length: int) -> None:
+        self.client.put_object(self.bucket, self._key(key), iter(stream),
+                               length=length)
+
+    def get(self, key: str, offset: int = 0,
+            length: int = -1) -> Iterator[bytes]:
+        headers = {}
+        if offset or length >= 0:
+            end = "" if length < 0 else str(offset + length - 1)
+            headers["Range"] = f"bytes={offset}-{end}"
+        try:
+            return self.client.get_object_stream(
+                self.bucket, self._key(key), headers=headers,
+                ok=(200, 206))
+        except S3ClientError as e:
+            raise TierError(f"tier GET failed: {e}")
+
+    def remove(self, key: str) -> None:
+        try:
+            self.client.delete_object(self.bucket, self._key(key))
+        except S3ClientError as e:
+            if e.status != 404:
+                raise TierError(f"tier DELETE failed: {e}")
+
+
+def _backend_from_cfg(cfg: dict):
+    typ = cfg.get("type", "")
+    if typ == "fs":
+        return FSWarmBackend(cfg["directory"], cfg.get("prefix", ""))
+    if typ == "s3":
+        return S3WarmBackend(cfg["endpoint"], cfg["bucket"],
+                             cfg.get("accessKey", ""),
+                             cfg.get("secretKey", ""),
+                             cfg.get("prefix", ""),
+                             cfg.get("region", "us-east-1"))
+    raise TierError(f"unknown tier type {typ!r}")
+
+
+# -------------------------------------------------------------- journal
+
+
+class TierJournal:
+    """Deferred deletes of tiered objects, retried until the backend
+    accepts them (reference cmd/tier-journal.go).  Reuses the notifier's
+    file-per-entry persistent queue."""
+
+    def __init__(self, directory: str, backend_for, retry: float = 5.0):
+        from minio_tpu.events.targets import QueueStore
+
+        self.store = QueueStore(directory)
+        self.backend_for = backend_for  # tier name -> backend | None
+        self._wake = threading.Event()
+        self._closed = False
+        self.retry = retry
+        self.deleted = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="tier-journal")
+        self._thread.start()
+
+    def defer(self, tier: str, key: str) -> None:
+        self.store.put({"tier": tier, "key": key})
+        self._wake.set()
+
+    def _loop(self) -> None:
+        while not self._closed:
+            keys = self.store.keys()
+            if not keys:
+                self._wake.wait(1.0)
+                self._wake.clear()
+                continue
+            progressed = False
+            for k in keys:
+                if self._closed:
+                    return
+                entry = self.store.get(k)
+                if entry is None:
+                    self.store.delete(k)
+                    continue
+                backend = self.backend_for(entry.get("tier", ""))
+                if backend is None:
+                    # tier was removed: drop the entry
+                    self.store.delete(k)
+                    continue
+                try:
+                    backend.remove(entry["key"])
+                    self.store.delete(k)
+                    self.deleted += 1
+                    progressed = True
+                except Exception:
+                    continue
+            if not progressed:
+                self._wake.wait(self.retry)
+                self._wake.clear()
+
+    def pending(self) -> int:
+        return len(self.store)
+
+    def close(self) -> None:
+        self._closed = True
+        self._wake.set()
+        self._thread.join(2)
+
+
+# -------------------------------------------------------------- manager
+
+
+class TierManager:
+    """Named tier registry + transition/read-through/delete plumbing."""
+
+    def __init__(self, api, journal_dir: str | None = None):
+        self.api = api
+        self._backends: dict[str, object] = {}
+        self._mu = threading.Lock()
+        self.transitioned = 0
+        self._load()
+        if journal_dir is None:
+            import tempfile
+
+            journal_dir = os.path.join(tempfile.gettempdir(),
+                                       "minio-tpu-tier-journal")
+        self.journal = TierJournal(journal_dir, self.backend)
+        # delete-hook wiring is gated on a non-empty tier registry: with
+        # no tiers configured, deletes must not pay the extra metadata
+        # read the hook requires
+        self._wire_hooks()
+
+    # -- registry ------------------------------------------------------------
+    def _disks(self):
+        pool = getattr(self.api, "pools", [self.api])[0]
+        return [d for d in pool.all_disks
+                if d is not None and d.is_online()]
+
+    def _load(self) -> None:
+        for d in self._disks():
+            try:
+                self._cfg = json.loads(d.read_all(SYSTEM_VOL, TIERS_PATH))
+                return
+            except (errors.StorageError, json.JSONDecodeError, ValueError):
+                continue
+        self._cfg = {}
+
+    def _save(self) -> None:
+        raw = json.dumps(self._cfg).encode()
+        ok = 0
+        for d in self._disks():
+            try:
+                d.write_all(SYSTEM_VOL, TIERS_PATH, raw)
+                ok += 1
+            except errors.StorageError:
+                continue
+        if ok == 0:
+            raise TierError("cannot persist tier config")
+
+    def _wire_hooks(self) -> None:
+        hook = self._on_deleted if self._cfg else None
+        for pool in getattr(self.api, "pools", [self.api]):
+            for es in getattr(pool, "sets", []):
+                es.tier_delete_hook = hook
+
+    def add_tier(self, name: str, cfg: dict) -> None:
+        name = name.strip()
+        if not name:
+            raise TierError("tier name required")
+        _backend_from_cfg(cfg)  # validate eagerly
+        with self._mu:
+            self._cfg[name] = dict(cfg)
+            self._backends.pop(name, None)
+            self._save()
+        self._wire_hooks()
+
+    def remove_tier(self, name: str) -> None:
+        with self._mu:
+            if name not in self._cfg:
+                raise TierError(f"no such tier {name!r}")
+            del self._cfg[name]
+            self._backends.pop(name, None)
+            self._save()
+        self._wire_hooks()
+
+    def list_tiers(self) -> list[dict]:
+        with self._mu:
+            out = []
+            for name, cfg in sorted(self._cfg.items()):
+                c = {k: v for k, v in cfg.items() if k != "secretKey"}
+                out.append({"name": name, **c})
+            return out
+
+    def backend(self, name: str):
+        with self._mu:
+            b = self._backends.get(name)
+            if b is not None:
+                return b
+            cfg = self._cfg.get(name)
+            if cfg is None:
+                return None
+            b = _backend_from_cfg(cfg)
+            self._backends[name] = b
+            return b
+
+    # -- transition ----------------------------------------------------------
+    def transition(self, bucket: str, oi, tier: str) -> bool:
+        """lifecycle transition_fn: move the version's stored bytes to
+        the tier and leave a stub (reference transitionObject)."""
+        backend = self.backend(tier)
+        if backend is None:
+            return False
+        if (oi.metadata or {}).get(TRANSITION_STATUS_KEY) == \
+                TRANSITION_COMPLETE:
+            return False  # already tiered
+        vid = oi.version_id or "null"
+        key = f"{bucket}/{oi.name}/{vid}/{uuid.uuid4().hex}"
+        oi2, stream = self.api.get_object(bucket, oi.name,
+                                          version_id=oi.version_id)
+        try:
+            backend.put(key, iter(stream), oi2.size)
+        finally:
+            if hasattr(stream, "close"):
+                stream.close()
+        try:
+            self.api.transition_version(
+                bucket, oi.name, oi.version_id,
+                {
+                    TRANSITION_STATUS_KEY: TRANSITION_COMPLETE,
+                    TRANSITION_TIER_KEY: tier,
+                    TRANSITION_KEY_KEY: key,
+                },
+                expected_mod_time=oi2.mod_time)
+        except Exception:
+            # version changed (or stub write failed) while uploading:
+            # the tier copy is an orphan — reclaim it and keep the
+            # current local object untouched
+            self.journal.defer(tier, key)
+            return False
+        self.transitioned += 1
+        return True
+
+    # -- read-through --------------------------------------------------------
+    @staticmethod
+    def is_transitioned(metadata: dict | None) -> bool:
+        return bool(metadata) and \
+            metadata.get(TRANSITION_STATUS_KEY) == TRANSITION_COMPLETE
+
+    def read(self, metadata: dict, offset: int = 0,
+             length: int = -1) -> Iterator[bytes]:
+        tier = metadata.get(TRANSITION_TIER_KEY, "")
+        key = metadata.get(TRANSITION_KEY_KEY, "")
+        backend = self.backend(tier)
+        if backend is None:
+            raise TierError(f"tier {tier!r} is not configured")
+        return backend.get(key, offset, length)
+
+    # -- delete --------------------------------------------------------------
+    def _on_deleted(self, metadata: dict) -> None:
+        tier = metadata.get(TRANSITION_TIER_KEY, "")
+        key = metadata.get(TRANSITION_KEY_KEY, "")
+        if tier and key:
+            self.journal.defer(tier, key)
+
+    def close(self) -> None:
+        self.journal.close()

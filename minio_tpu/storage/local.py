@@ -364,6 +364,30 @@ class LocalStorage(StorageAPI):
         else:
             self.delete(volume, path, recursive=True)
 
+    def free_version_data(self, volume: str, path: str, version_id: str,
+                          meta_updates: dict) -> None:
+        """Drop a version's local data (parts dir + inline bytes) while
+        keeping its xl.meta entry, merging `meta_updates` into the
+        version's metadata — the tiering stub left behind after a
+        transition (reference DeleteVersion w/ transition free-versions,
+        cmd/xl-storage-free-version.go)."""
+        if version_id == NULL_VERSION_ID:
+            version_id = ""
+        xl = XLMeta.loads(self.read_xl(volume, path))
+        v = xl.find_version(version_id or "")
+        if v is None or (version_id and v.get("v", "") != version_id):
+            raise errors.FileVersionNotFound(f"{volume}/{path}@{version_id}")
+        dd = v.get("dd", "")
+        if dd:
+            shutil.rmtree(
+                os.path.join(self._file_path(volume, path), dd),
+                ignore_errors=True)
+        v["dd"] = ""
+        v.pop("data", None)
+        meta = v.setdefault("meta", {})
+        meta.update(meta_updates)
+        self._write_xl(volume, path, xl)
+
     def rename_data(self, src_volume: str, src_path: str, fi: FileInfo,
                     dst_volume: str, dst_path: str) -> None:
         """Move staged part files into place and commit xl.meta atomically."""

@@ -102,6 +102,38 @@ class S3Client:
         _, rh, data = self._request("GET", bucket, key)
         return rh, data
 
+    def get_object_stream(self, bucket: str, key: str,
+                          headers: dict | None = None,
+                          ok: tuple = (200, 206)):
+        """Chunked GET: returns an iterator of body chunks (the
+        connection closes when the iterator is exhausted or closed) —
+        large objects never materialize in memory."""
+        path = f"/{bucket}/{key}"
+        quoted = urllib.parse.quote(path)
+        headers = dict(headers or {})
+        headers["host"] = self.netloc
+        signed = sigv4.sign_request("GET", quoted, [], headers, b"",
+                                    self.ak, self.sk, region=self.region)
+        conn = self._connect()
+        conn.request("GET", quoted, headers=signed)
+        resp = conn.getresponse()
+        if resp.status not in ok:
+            data = resp.read()
+            conn.close()
+            raise S3ClientError(resp.status, data)
+
+        def chunks():
+            try:
+                while True:
+                    chunk = resp.read(1 << 20)
+                    if not chunk:
+                        break
+                    yield chunk
+            finally:
+                conn.close()
+
+        return chunks()
+
     def head_object(self, bucket: str, key: str) -> dict:
         _, rh, _ = self._request("HEAD", bucket, key)
         return rh

@@ -132,3 +132,36 @@ class TestAdminConfigAPI:
 
     def test_requires_admin(self, srv):
         assert srv.raw_request("GET", f"{ADMIN}/get-config").status == 403
+
+
+class TestStartupApply:
+    def test_cli_interval_not_stomped_by_defaults(self, tmp_path):
+        """A server started with an explicit scan interval keeps it: the
+        config registry's default must not override CLI/env choices at
+        startup (regression: live scanner silently ran at 60s)."""
+        os.environ["MINIO_TPU_FSYNC"] = "0"
+        s = S3TestServer(str(tmp_path / "ia"), start_services=True,
+                         scan_interval=1.5)
+        try:
+            assert s.server.services.scanner.interval == 1.5
+        finally:
+            s.close()
+
+    def test_persisted_interval_applies_at_startup(self, tmp_path):
+        from minio_tpu.erasure.sets import ErasureSets, ErasureServerPools
+        from minio_tpu.storage.local import LocalStorage
+
+        os.environ["MINIO_TPU_FSYNC"] = "0"
+        root = str(tmp_path / "pa")
+        s = S3TestServer(root, start_services=True, scan_interval=1.5)
+        r = s.request("PUT", f"{ADMIN}/set-config-kv", data=json.dumps(
+            {"subsys": "scanner", "kv": {"interval": "7"}}).encode())
+        assert r.status == 200
+        assert s.server.services.scanner.interval == 7
+        s.close()
+        # restart over the same drives: stored value is explicit -> applies
+        s2 = S3TestServer(root, start_services=True, scan_interval=1.5)
+        try:
+            assert s2.server.services.scanner.interval == 7
+        finally:
+            s2.close()
