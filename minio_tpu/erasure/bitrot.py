@@ -11,16 +11,45 @@ Reads must be shard-size aligned; each block is verified on read
 
 from __future__ import annotations
 
-from typing import BinaryIO
+import hashlib
+import os
+from typing import BinaryIO, Callable
 
 from minio_tpu.ops import host
 from minio_tpu.storage import errors
 
-HASH_SIZE = 32
+HASH_SIZE = 32  # size for the default algorithm (HighwayHash-256)
 DEFAULT_ALGO = "highwayhash256S"
 
+# algorithm registry (reference BitrotAlgorithm set, cmd/bitrot.go:39-44:
+# SHA256, BLAKE2b512, HighwayHash256, HighwayHash256S).  Each entry:
+# (hash_fn(bytes)->digest, digest_size).  highwayhash256 is the same
+# function as the streaming variant — the reference distinguishes them
+# only by whole-file vs streaming framing.
+ALGORITHMS: dict[str, tuple[Callable[[bytes], bytes], int]] = {
+    "highwayhash256S": (lambda b: host.hh256(b), 32),
+    "highwayhash256": (lambda b: host.hh256(b), 32),
+    "sha256": (lambda b: hashlib.sha256(b).digest(), 32),
+    "blake2b512": (lambda b: hashlib.blake2b(b).digest(), 64),
+}
 
-def bitrot_shard_file_size(size: int, shard_size: int) -> int:
+
+def algo_from_env() -> str:
+    """Write-path algorithm (reads always honor the algo recorded in the
+    version's ChecksumInfo)."""
+    a = os.environ.get("MINIO_TPU_BITROT_ALGO", DEFAULT_ALGO)
+    return a if a in ALGORITHMS else DEFAULT_ALGO
+
+
+def hasher_of(algo: str) -> tuple[Callable[[bytes], bytes], int]:
+    try:
+        return ALGORITHMS[algo]
+    except KeyError:
+        raise errors.InvalidArgument(f"unknown bitrot algorithm {algo!r}")
+
+
+def bitrot_shard_file_size(size: int, shard_size: int,
+                           algo: str = DEFAULT_ALGO) -> int:
     """On-disk size of a shard file with interleaved hashes
     (cmd/bitrot.go:146)."""
     if size == 0:
@@ -28,27 +57,30 @@ def bitrot_shard_file_size(size: int, shard_size: int) -> int:
     if size < 0:
         return -1
     nblocks = -(-size // shard_size)
-    return nblocks * HASH_SIZE + size
+    return nblocks * hasher_of(algo)[1] + size
 
 
 class BitrotWriter:
     """Wraps a shard-file handle; every write() must be one erasure block's
     shard (shard_size bytes, or less for the final block)."""
 
-    def __init__(self, w: BinaryIO, shard_size: int):
+    def __init__(self, w: BinaryIO, shard_size: int,
+                 algo: str = DEFAULT_ALGO):
         self.w = w
         self.shard_size = shard_size
         self.written = 0
+        self.algo = algo
+        self._hash, self._hsize = hasher_of(algo)
 
     def write(self, block: bytes | memoryview) -> None:
         if len(block) > self.shard_size:
             raise errors.InvalidArgument(
                 f"bitrot write of {len(block)} exceeds shard size {self.shard_size}"
             )
-        h = host.hh256(bytes(block))
+        h = self._hash(bytes(block))
         self.w.write(h)
         self.w.write(block)
-        self.written += HASH_SIZE + len(block)
+        self.written += self._hsize + len(block)
 
     def close(self) -> None:
         self.w.close()
@@ -61,11 +93,13 @@ class BitrotReader:
     offset must be shard_size aligned (cmd/bitrot-streaming.go:142-189).
     """
 
-    def __init__(self, r: BinaryIO, till_offset: int, shard_size: int):
+    def __init__(self, r: BinaryIO, till_offset: int, shard_size: int,
+                 algo: str = DEFAULT_ALGO):
         self.r = r
         self.shard_size = shard_size
         self.till_offset = till_offset  # logical shard bytes available
         self._pos = -1  # current logical offset (-1: not positioned)
+        self._hash, self._hsize = hasher_of(algo)
 
     def read_at(self, offset: int, length: int) -> bytes:
         if offset % self.shard_size != 0:
@@ -74,20 +108,20 @@ class BitrotReader:
             )
         if self._pos != offset:
             block_idx = offset // self.shard_size
-            file_off = block_idx * (HASH_SIZE + self.shard_size)
+            file_off = block_idx * (self._hsize + self.shard_size)
             self.r.seek(file_off)
             self._pos = offset
         out = bytearray()
         remaining = length
         while remaining > 0:
             want = min(self.shard_size, remaining)
-            h = self.r.read(HASH_SIZE)
-            if len(h) != HASH_SIZE:
+            h = self.r.read(self._hsize)
+            if len(h) != self._hsize:
                 raise errors.FileCorrupt("bitrot: truncated hash")
             block = self.r.read(want)
             if len(block) != want:
                 raise errors.FileCorrupt("bitrot: truncated block")
-            if host.hh256(block) != h:
+            if self._hash(block) != h:
                 raise errors.FileCorrupt("bitrot: hash mismatch")
             out += block
             self._pos += want
@@ -99,22 +133,23 @@ class BitrotReader:
 
 
 def bitrot_verify_stream(f: BinaryIO, file_size: int, shard_file_size: int,
-                         shard_size: int) -> None:
+                         shard_size: int, algo: str = DEFAULT_ALGO) -> None:
     """Verify a whole shard file (reference bitrotVerify, cmd/bitrot.go:154)."""
-    want_size = bitrot_shard_file_size(shard_file_size, shard_size)
+    hash_fn, hsize = hasher_of(algo)
+    want_size = bitrot_shard_file_size(shard_file_size, shard_size, algo)
     if file_size != want_size:
         raise errors.FileCorrupt(
             f"bitrot: file size {file_size} != expected {want_size}"
         )
     left = shard_file_size
     while left > 0:
-        h = f.read(HASH_SIZE)
-        if len(h) != HASH_SIZE:
+        h = f.read(hsize)
+        if len(h) != hsize:
             raise errors.FileCorrupt("bitrot: truncated hash")
         want = min(shard_size, left)
         block = f.read(want)
         if len(block) != want:
             raise errors.FileCorrupt("bitrot: truncated block")
-        if host.hh256(block) != h:
+        if hash_fn(block) != h:
             raise errors.FileCorrupt("bitrot: hash mismatch")
         left -= want

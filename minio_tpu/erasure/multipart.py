@@ -76,6 +76,10 @@ class MultipartMixin:
         metadata = dict(opts.user_metadata)
         if opts.content_type:
             metadata["content-type"] = opts.content_type
+        # pin the bitrot algorithm for the whole upload: parts and the
+        # final checksums must agree even if the env changes (or another
+        # node completes the upload)
+        metadata["x-minio-internal-bitrot-algo"] = bitrot.algo_from_env()
         now = time.time()
 
         def write(i: int) -> None:
@@ -129,6 +133,8 @@ class MultipartMixin:
         if part_number < 1 or part_number > 10000:
             raise errors.InvalidArgument(f"part number {part_number}")
         ufi, _ = self._upload_meta(bucket, obj, upload_id)
+        upload_algo = ufi.metadata.get("x-minio-internal-bitrot-algo",
+                                       bitrot.DEFAULT_ALGO)
         e = Erasure(ufi.erasure.data_blocks, ufi.erasure.parity_blocks,
                     ufi.erasure.block_size)
         n = e.k + e.m
@@ -160,7 +166,8 @@ class MultipartMixin:
                 writers.append(None)
                 continue
             fh = d.open_file_writer(SYSTEM_VOL, f"{tmp}/part.{part_number}")
-            writers.append(bitrot.BitrotWriter(fh, e.shard_size))
+            writers.append(bitrot.BitrotWriter(
+                fh, e.shard_size, algo=upload_algo))
         try:
             total, failed_shards = e.encode_stream(hreader, writers, size, wq)
         except Exception:
@@ -271,6 +278,8 @@ class MultipartMixin:
                                   parts: list[tuple[int, str]]) -> ObjectInfo:
         """parts: [(part_number, etag), ...] in client order."""
         ufi, _ = self._upload_meta(bucket, obj, upload_id)
+        upload_algo = ufi.metadata.get("x-minio-internal-bitrot-algo",
+                                       bitrot.DEFAULT_ALGO)
         stored = {p.part_number: p for p in
                   self.list_object_parts(bucket, obj, upload_id)}
         if not parts:
@@ -304,6 +313,7 @@ class MultipartMixin:
         data_dir = new_data_dir()
         now = time.time()
         metadata = dict(ufi.metadata)
+        metadata.pop("x-minio-internal-bitrot-algo", None)
         metadata["etag"] = final_etag
         version_id = ""
 
@@ -344,7 +354,7 @@ class MultipartMixin:
                     parity_blocks=e.m, block_size=ufi.erasure.block_size,
                     index=i_pos + 1, distribution=dist,
                     checksums=[
-                        ChecksumInfo(p.part_number, bitrot.DEFAULT_ALGO, b"")
+                        ChecksumInfo(p.part_number, upload_algo, b"")
                         for p in chosen
                     ],
                 ),
@@ -361,6 +371,8 @@ class MultipartMixin:
         if sum(1 for x in errs if x is None) < wq:
             raise errors.ErasureWriteQuorum("complete multipart quorum")
 
+        if self.ns_updated is not None:
+            self.ns_updated(bucket, obj)
         fi = FileInfo(volume=bucket, name=obj, version_id=version_id,
                       mod_time=now, size=total, metadata=metadata,
                       parts=part_infos)

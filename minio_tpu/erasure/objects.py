@@ -200,6 +200,17 @@ class _HashingReader(io.RawIOBase):
         return self.md5.hexdigest()
 
 
+
+def _bitrot_algo_of(fi: FileInfo) -> str:
+    """Bitrot algorithm recorded for the version (reads must use the
+    writer's algorithm, whatever the current default is)."""
+    e = fi.erasure
+    if e is not None and e.checksums:
+        a = e.checksums[0].algorithm
+        if a in bitrot.ALGORITHMS:
+            return a
+    return bitrot.DEFAULT_ALGO
+
 class ErasureObjects:
     """One erasure set over `disks` (K+M drives)."""
 
@@ -218,6 +229,10 @@ class ErasureObjects:
         self.ns = ns_lock or NamespaceLock()
         self.heal_queue = heal_queue  # async heal trigger (MRF analogue)
         self.tier_delete_hook = None  # wired by the tiering subsystem
+        # change-tracking hook (bucket, obj) -> None; fed to the scanner's
+        # bloom filter so clean buckets skip re-walks (reference NSUpdated
+        # feeding dataUpdateTracker, cmd/data-update-tracker.go:59)
+        self.ns_updated = None
 
     # ------------------------------------------------------------------ util
     @property
@@ -334,7 +349,8 @@ class ErasureObjects:
             for i in range(n):
                 # streaming-bitrot framing even inline, for uniform verify
                 buf = io.BytesIO()
-                w = bitrot.BitrotWriter(buf, erasure.shard_size)
+                w = bitrot.BitrotWriter(buf, erasure.shard_size,
+                                        algo=bitrot.algo_from_env())
                 if len(shards[i]):
                     w.write(shards[i])
                 shards_inline[i] = buf.getvalue()
@@ -346,8 +362,17 @@ class ErasureObjects:
                 if d is None:
                     writers.append(None)
                     continue
-                fh = d.open_file_writer(SYSTEM_VOL, f"{tmp_prefix}/part.1")
-                writers.append(bitrot.BitrotWriter(fh, erasure.shard_size))
+                try:
+                    fh = d.open_file_writer(SYSTEM_VOL,
+                                            f"{tmp_prefix}/part.1")
+                except errors.StorageError:
+                    # faulty drive: degrade to a missing writer, the
+                    # write-quorum accounting decides (reference drops
+                    # failed disks before encode, cmd/erasure-encode.go)
+                    writers.append(None)
+                    continue
+                writers.append(bitrot.BitrotWriter(
+                    fh, erasure.shard_size, algo=bitrot.algo_from_env()))
             try:
                 total_size, failed_shards = erasure.encode_stream(
                     hreader, writers, size, write_quorum
@@ -394,7 +419,8 @@ class ErasureObjects:
                     algorithm="rs-vandermonde", data_blocks=k,
                     parity_blocks=parity, block_size=BLOCK_SIZE_V2,
                     index=i + 1, distribution=dist,
-                    checksums=[ChecksumInfo(1, bitrot.DEFAULT_ALGO, b"")],
+                    checksums=[ChecksumInfo(
+                        1, bitrot.algo_from_env(), b"")],
                 ),
                 data=shards_inline[i] if inline else None,
             )
@@ -404,6 +430,18 @@ class ErasureObjects:
                 d.rename_data(SYSTEM_VOL, tmp_prefix, fi, bucket, obj)
 
         with self.ns.write(f"{bucket}/{obj}"):
+            replaced_tier_meta = None
+            if self.tier_delete_hook is not None and not version_id:
+                # an unversioned/null-version PUT replaces the existing
+                # version in place: if that version was a tiered stub,
+                # its warm-tier copy must be reclaimed or it leaks
+                try:
+                    prev, _, _ = self._quorum_info(bucket, obj)
+                    if prev.metadata.get(TRANSITION_STATUS_KEY) == \
+                            TRANSITION_COMPLETE:
+                        replaced_tier_meta = dict(prev.metadata)
+                except errors.StorageError:
+                    pass
             commit_errs = self._fan_out(commit, range(n))
         self._cleanup_tmp(tmp_prefix)
         ok = sum(1 for e in commit_errs if e is None)
@@ -415,6 +453,10 @@ class ErasureObjects:
         if self.heal_queue and ok < n:
             self.heal_queue(bucket, obj, version_id)
 
+        if self.ns_updated is not None:
+            self.ns_updated(bucket, obj)
+        if replaced_tier_meta is not None:
+            self.tier_delete_hook(replaced_tier_meta)
         fi = FileInfo(
             volume=bucket, name=obj, version_id=version_id, mod_time=mod_time,
             size=total_size, metadata=metadata, parts=[part],
@@ -548,9 +590,11 @@ class ErasureObjects:
                 try:
                     fh = d.read_file_stream(
                         bucket, f"{obj}/{fi.data_dir}/part.{part.number}",
-                        0, bitrot.bitrot_shard_file_size(till, e.shard_size),
+                        0, bitrot.bitrot_shard_file_size(
+                            till, e.shard_size, _bitrot_algo_of(fi)),
                     )
-                    readers[i] = bitrot.BitrotReader(fh, till, e.shard_size)
+                    readers[i] = bitrot.BitrotReader(
+                        fh, till, e.shard_size, algo=_bitrot_algo_of(fi))
                 except Exception:
                     heal_needed = True
                     readers[i] = None
@@ -645,6 +689,8 @@ class ErasureObjects:
                 _, wq = self._quorum_from([None] * len(self.disks))
                 if sum(1 for e2 in errs if e2 is None) < wq:
                     raise errors.ErasureWriteQuorum("delete marker quorum")
+                if self.ns_updated is not None:
+                    self.ns_updated(bucket, obj)
                 return ObjectInfo(bucket=bucket, name=obj,
                                   version_id=NULL_VERSION_ID,
                                   delete_marker=True,
@@ -666,6 +712,8 @@ class ErasureObjects:
                 _, wq = self._quorum_from([None] * len(self.disks))
                 if sum(1 for e2 in errs if e2 is None) < wq:
                     raise errors.ErasureWriteQuorum("delete marker quorum")
+                if self.ns_updated is not None:
+                    self.ns_updated(bucket, obj)
                 oi = ObjectInfo(bucket=bucket, name=obj,
                                 version_id=marker.version_id,
                                 delete_marker=True, mod_time=marker.mod_time)
@@ -704,6 +752,8 @@ class ErasureObjects:
                 raise errors.ErasureWriteQuorum("delete quorum not met")
             if tier_meta is not None:
                 self.tier_delete_hook(tier_meta)
+            if self.ns_updated is not None:
+                self.ns_updated(bucket, obj)
             return ObjectInfo(bucket=bucket, name=obj, version_id=version_id)
 
     # ------------------------------------------------------------- METADATA
@@ -736,6 +786,10 @@ class ErasureObjects:
             _, wq = self._quorum_from(fis)
             if sum(1 for e in errs if e is None) < wq:
                 raise errors.ErasureWriteQuorum("metadata update quorum")
+            if self.ns_updated is not None:
+                # tag changes alter tag-filtered lifecycle eligibility:
+                # the bucket must scan dirty
+                self.ns_updated(bucket, obj)
             for k, v in updates.items():
                 if v is None:
                     fi.metadata.pop(k, None)
@@ -877,17 +931,21 @@ class ErasureObjects:
                     if not healthy[i]:
                         continue
                     di = shard_meta[i]
+                    algo = _bitrot_algo_of(fi)
                     if di is not None and di.data is not None:
                         readers[i] = bitrot.BitrotReader(
-                            io.BytesIO(di.data), till, e.shard_size
+                            io.BytesIO(di.data), till, e.shard_size,
+                            algo=algo,
                         )
                     else:
                         try:
                             fh = shard_disk[i].read_file_stream(
                                 bucket, f"{obj}/{fi.data_dir}/part.{part.number}",
-                                0, bitrot.bitrot_shard_file_size(till, e.shard_size),
+                                0, bitrot.bitrot_shard_file_size(
+                                    till, e.shard_size, algo),
                             )
-                            readers[i] = bitrot.BitrotReader(fh, till, e.shard_size)
+                            readers[i] = bitrot.BitrotReader(
+                                fh, till, e.shard_size, algo=algo)
                         except Exception:
                             pass
                 if sum(1 for r in readers if r) < e.k:
@@ -896,14 +954,17 @@ class ErasureObjects:
 
                 writers: list[bitrot.BitrotWriter | None] = [None] * n
                 for i in stale:
+                    # healed shards keep the version's recorded algorithm
                     if inline:
                         sink = inline_sinks.setdefault(i, io.BytesIO())
-                        writers[i] = bitrot.BitrotWriter(sink, e.shard_size)
+                        writers[i] = bitrot.BitrotWriter(
+                            sink, e.shard_size, algo=_bitrot_algo_of(fi))
                     else:
                         fh = shard_disk[i].open_file_writer(
                             SYSTEM_VOL, f"{TMP_DIR}/{tmp_ids[i]}/part.{part.number}"
                         )
-                        writers[i] = bitrot.BitrotWriter(fh, e.shard_size)
+                        writers[i] = bitrot.BitrotWriter(
+                            fh, e.shard_size, algo=_bitrot_algo_of(fi))
                 try:
                     e.heal(writers, readers, part.size)
                 finally:
@@ -928,8 +989,9 @@ class ErasureObjects:
                         algorithm=fi.erasure.algorithm, data_blocks=e.k,
                         parity_blocks=e.m, block_size=fi.erasure.block_size,
                         index=i + 1, distribution=dist,
-                        checksums=[ChecksumInfo(p.number, bitrot.DEFAULT_ALGO, b"")
-                                   for p in fi.parts],
+                        checksums=[ChecksumInfo(
+                            p.number, _bitrot_algo_of(fi), b"")
+                            for p in fi.parts],
                     ),
                     data=inline_sinks[i].getvalue() if inline else None,
                 )

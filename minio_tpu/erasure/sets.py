@@ -130,8 +130,15 @@ class ErasureSets:
                     continue
                 s, i = divmod(idx, self.set_drive_count)
                 this = layout[s][i]
-                d.write_all(SYSTEM_VOL, FORMAT_FILE,
-                            json.dumps(_format_doc(dep_id, layout, this)).encode())
+                try:
+                    d.write_all(
+                        SYSTEM_VOL, FORMAT_FILE,
+                        json.dumps(_format_doc(dep_id, layout,
+                                               this)).encode())
+                except errors.StorageError:
+                    # faulty drive at boot: quorum still carries the set;
+                    # the drive monitor re-stamps it when it comes back
+                    continue
                 d.set_disk_id(this)
         return dep_id
 
@@ -153,6 +160,8 @@ class ErasureSets:
                 made += 1
             except errors.VolumeExists:
                 exists += 1
+            except errors.StorageError:
+                continue  # faulty drive: the others carry the bucket
         if made == 0 and exists == 0:
             raise errors.ErasureWriteQuorum("no drives for make_bucket")
         if made == 0 and exists > 0:
@@ -192,6 +201,8 @@ class ErasureSets:
         return [seen[k] for k in sorted(seen)]
 
     def bucket_exists(self, bucket: str) -> bool:
+        last_fault: Exception | None = None
+        saw_answer = False
         for d in self.all_disks:
             if d is None or not d.is_online():
                 continue
@@ -199,7 +210,13 @@ class ErasureSets:
                 d.stat_volume(bucket)
                 return True
             except errors.VolumeNotFound:
-                continue
+                saw_answer = True
+            except errors.StorageError as e:
+                last_fault = e  # faulty drive: others decide
+        if not saw_answer and last_fault is not None:
+            # EVERY drive errored: "no such bucket" would be a lie —
+            # surface the fault as a 5xx instead
+            raise last_fault
         return False
 
     # -- object ops (delegate to hashed set) --------------------------------

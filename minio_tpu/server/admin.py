@@ -149,8 +149,9 @@ class AdminMixin:
         name = request.rel_url.query.get("name", "")
         if not name:
             raise S3Error("InvalidArgument", "name query param required")
+        force = request.rel_url.query.get("force", "") in ("true", "1")
         try:
-            await self._run(self._tier_mgr().remove_tier, name)
+            await self._run(self._tier_mgr().remove_tier, name, force)
         except TierError as e:
             raise S3Error("InvalidArgument", str(e))
         return self._json({})

@@ -1239,8 +1239,10 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             from minio_tpu.services.tier import TierManager
 
             if TierManager.is_transitioned(oi.metadata):
-                return svcs.tier.read(oi.metadata, offset,
-                                      length if length >= 0 else -1)
+                # backend connect/open is blocking IO: off the event loop
+                return await self._run(
+                    svcs.tier.read, oi.metadata, offset,
+                    length if length >= 0 else -1)
         _, stream = await self._run(
             self.api.get_object, bucket, key, offset, length, vid)
         return stream

@@ -10,6 +10,7 @@ from .heal import (BackgroundHealer, HealManager, HealSequence,
                    HealSequenceStatus, heal_fresh_disks,
                    load_healing_tracker, mark_disk_healing)
 from .mrf import MRFQueue
+from .monitor import DriveMonitor
 from .scanner import BucketUsage, DataScanner, DataUsageInfo
 
 
@@ -17,27 +18,37 @@ class ServiceManager:
     """Owns the background workers for one server process."""
 
     def __init__(self, object_layer, scan_interval: float = 60.0,
-                 heal_interval: float = 3600.0, lifecycle_fn=None):
+                 heal_interval: float = 3600.0, lifecycle_fn=None,
+                 monitor_interval: float = 10.0):
+        from minio_tpu.utils.bloom import DataUpdateTracker
+
         self.ol = object_layer
         self.mrf = MRFQueue(object_layer)
         self.heals = HealManager(object_layer)
+        self.tracker = DataUpdateTracker()
         self.scanner = DataScanner(object_layer, interval=scan_interval,
                                    heal_queue=self.mrf.enqueue,
-                                   lifecycle_fn=lifecycle_fn)
+                                   lifecycle_fn=lifecycle_fn,
+                                   tracker=self.tracker)
         self.bg_heal = BackgroundHealer(object_layer, interval=heal_interval)
+        self.monitor = DriveMonitor(object_layer,
+                                    interval=monitor_interval)
         self.replication = None  # ReplicationPool, wired by attach_services
         self.tier = None         # TierManager, wired by attach_services
         self._attach_heal_queue()
 
     def _attach_heal_queue(self) -> None:
-        """Point every erasure set's async-heal hook at the MRF queue."""
+        """Point every erasure set's async-heal hook at the MRF queue and
+        its change hook at the update tracker."""
         for pool in getattr(self.ol, "pools", [self.ol]):
             for es in getattr(pool, "sets", []):
                 es.heal_queue = self.mrf.enqueue
+                es.ns_updated = self.tracker.mark
 
     def close(self) -> None:
         self.scanner.close()
         self.bg_heal.close()
+        self.monitor.close()
         self.mrf.close()
         if self.replication is not None:
             self.replication.close()
@@ -47,7 +58,7 @@ class ServiceManager:
 
 __all__ = [
     "BackgroundHealer", "BucketUsage", "DataScanner", "DataUsageInfo",
-    "HealManager", "HealSequence", "HealSequenceStatus", "MRFQueue",
-    "ServiceManager", "heal_fresh_disks", "load_healing_tracker",
-    "mark_disk_healing",
+    "DriveMonitor", "HealManager", "HealSequence", "HealSequenceStatus",
+    "MRFQueue", "ServiceManager", "heal_fresh_disks",
+    "load_healing_tracker", "mark_disk_healing",
 ]

@@ -1,0 +1,99 @@
+"""Drive monitor: detect replaced/fresh drives and kick healing.
+
+Reference: cmd/erasure-sets.go:288 (monitorAndConnectEndpoints — a
+background loop re-probing endpoints) + cmd/background-newdisks-heal-ops.go
+(the new-disk healer that notices freshly-formatted drives, marks them
+with an on-drive healing tracker, and heals the whole erasure set onto
+them).  Remote drives reconnect through their RPC client's own health
+probe; this loop handles the LOCAL cases: a drive directory that came
+back (remounted) or came back EMPTY (replaced hardware).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from minio_tpu.storage import errors
+from minio_tpu.storage.local import SYSTEM_VOL
+from minio_tpu.utils.logger import log
+
+FORMAT_FILE = "format.json"
+
+
+class DriveMonitor:
+    def __init__(self, pools, interval: float = 10.0, autostart: bool = True):
+        self.pools = pools
+        self.interval = interval
+        self.healed_drives = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        if autostart:
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name="drive-monitor")
+            self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.check_once()
+            except Exception:
+                pass
+
+    def check_once(self) -> int:
+        """One probe pass; returns the number of fresh drives healed."""
+        from .heal import heal_fresh_disks, load_healing_tracker, \
+            mark_disk_healing
+
+        healed = 0
+        kicked = False
+        for pool in getattr(self.pools, "pools", [self.pools]):
+            for es in getattr(pool, "sets", []):
+                for idx, d in enumerate(es.disks):
+                    if d is None or not getattr(d, "is_local", lambda: True)():
+                        continue
+                    if not d.is_online():
+                        continue
+                    try:
+                        d.read_all(SYSTEM_VOL, FORMAT_FILE)
+                        continue  # formatted and present
+                    except errors.StorageError:
+                        pass
+                    # a live local drive with NO format.json: replaced
+                    # hardware — re-stamp its format identity and mark it
+                    # for set healing (reference background-newdisks heal)
+                    if load_healing_tracker(d) is None:
+                        try:
+                            self._reformat(pool, es, idx, d)
+                            mark_disk_healing(d)
+                            kicked = True
+                            log.info("fresh drive detected, healing",
+                                     endpoint=d.endpoint())
+                        except errors.StorageError:
+                            continue
+        if kicked:
+            done = heal_fresh_disks(self.pools)
+            healed = len(done)
+            self.healed_drives += healed
+        return healed
+
+    @staticmethod
+    def _reformat(pool, es, idx: int, d) -> None:
+        """Write the drive's format.json from its pool's layout (the
+        deployment id is pinned by the surviving drives)."""
+        from minio_tpu.erasure.sets import _format_doc
+
+        layout = [
+            [f"d{s}-{i}" for i in range(pool.set_drive_count)]
+            for s in range(pool.set_count)
+        ]
+        this = layout[es.set_index][idx] if hasattr(es, "set_index") \
+            else layout[0][idx]
+        d.write_all(SYSTEM_VOL, FORMAT_FILE, json.dumps(
+            _format_doc(pool.deployment_id, layout, this)).encode())
+        d.set_disk_id(this)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)

@@ -118,11 +118,14 @@ class DataScanner:
     """
 
     def __init__(self, pools, interval: float = 60.0,
-                 heal_queue=None, lifecycle_fn=None, autostart: bool = True):
+                 heal_queue=None, lifecycle_fn=None, autostart: bool = True,
+                 tracker=None):
         self.pools = pools
         self.interval = interval
         self.heal_queue = heal_queue
         self.lifecycle_fn = lifecycle_fn
+        self.tracker = tracker  # DataUpdateTracker; None -> always walk
+        self.buckets_skipped = 0
         self.usage = DataUsageInfo()
         self.cycles = 0
         self._mu = threading.Lock()
@@ -160,12 +163,25 @@ class DataScanner:
         with self._mu:
             self.usage = info
         self.cycles += 1
+        if self.tracker is not None:
+            self.tracker.cycle()
         self._save_cache(info)
         return info
 
     def _scan_set(self, es, info: DataUsageInfo) -> None:
         from .heal import _set_buckets
         for bucket in _set_buckets(es):
+            if self.tracker is not None \
+                    and not self.tracker.bucket_dirty(bucket):
+                # bloom filter proves no write touched the bucket since
+                # the last cycle: reuse its usage, skip the drive walk
+                # (reference dataUpdateTracker skip,
+                # cmd/data-update-tracker.go)
+                prev = self.usage.buckets.get(bucket)
+                if prev is not None:
+                    info.buckets[bucket] = prev
+                    self.buckets_skipped += 1
+                    continue
             usage = info.buckets.setdefault(bucket, BucketUsage())
             try:
                 names = es.list_objects(bucket)

@@ -271,21 +271,44 @@ class TierManager:
             for es in getattr(pool, "sets", []):
                 es.tier_delete_hook = hook
 
+    def _count(self, name: str, delta: int) -> None:
+        """Persisted per-tier transitioned-object counter (the reference
+        tracks tier usage to refuse removing an in-use tier)."""
+        with self._mu:
+            cfg = self._cfg.get(name)
+            if cfg is None:
+                return
+            cfg["objects"] = max(0, int(cfg.get("objects", 0)) + delta)
+            try:
+                self._save()
+            except TierError:
+                pass
+
     def add_tier(self, name: str, cfg: dict) -> None:
         name = name.strip()
         if not name:
             raise TierError("tier name required")
+        cfg = dict(cfg)
+        cfg.pop("objects", None)  # counter is server-managed
         _backend_from_cfg(cfg)  # validate eagerly
         with self._mu:
-            self._cfg[name] = dict(cfg)
+            prev = self._cfg.get(name)
+            if prev is not None:
+                cfg["objects"] = int(prev.get("objects", 0))
+            self._cfg[name] = cfg
             self._backends.pop(name, None)
             self._save()
         self._wire_hooks()
 
-    def remove_tier(self, name: str) -> None:
+    def remove_tier(self, name: str, force: bool = False) -> None:
         with self._mu:
             if name not in self._cfg:
                 raise TierError(f"no such tier {name!r}")
+            in_use = int(self._cfg[name].get("objects", 0))
+            if in_use > 0 and not force:
+                raise TierError(
+                    f"tier {name!r} still holds {in_use} transitioned "
+                    "object(s); removing it would orphan them")
             del self._cfg[name]
             self._backends.pop(name, None)
             self._save()
@@ -339,13 +362,19 @@ class TierManager:
                     TRANSITION_KEY_KEY: key,
                 },
                 expected_mod_time=oi2.mod_time)
+        except errors.ErasureWriteQuorum:
+            # PARTIAL stub write: some drives already freed their shards
+            # and reference the tier key — the tier copy may now be the
+            # only full copy, never reclaim it here (heal converges the
+            # metadata; the key is reclaimed when the version is deleted)
+            return False
         except Exception:
-            # version changed (or stub write failed) while uploading:
-            # the tier copy is an orphan — reclaim it and keep the
-            # current local object untouched
+            # rejected before any drive freed data (version changed, not
+            # found): the tier copy is a true orphan — reclaim it
             self.journal.defer(tier, key)
             return False
         self.transitioned += 1
+        self._count(tier, +1)
         return True
 
     # -- read-through --------------------------------------------------------
@@ -369,6 +398,7 @@ class TierManager:
         key = metadata.get(TRANSITION_KEY_KEY, "")
         if tier and key:
             self.journal.defer(tier, key)
+            self._count(tier, -1)
 
     def close(self) -> None:
         self.journal.close()

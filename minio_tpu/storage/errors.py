@@ -9,6 +9,11 @@ class DiskNotFound(StorageError):
     pass
 
 
+class FaultyDisk(StorageError):
+    """Drive returned an IO error (reference errFaultyDisk)."""
+    pass
+
+
 class FileNotFound(StorageError):
     pass
 

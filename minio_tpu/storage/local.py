@@ -92,6 +92,18 @@ class _SyncedWriter:
         return False
 
 
+def _stored_algo(fi: FileInfo) -> str:
+    """Bitrot algorithm a version's shards were written with."""
+    from minio_tpu.erasure import bitrot
+
+    e = fi.erasure
+    if e is not None and e.checksums:
+        a = e.checksums[0].algorithm
+        if a in bitrot.ALGORITHMS:
+            return a
+    return bitrot.DEFAULT_ALGO
+
+
 def _clean(path: str) -> str:
     path = path.strip("/")
     if ".." in path.split("/"):
@@ -489,7 +501,7 @@ class LocalStorage(StorageAPI):
             with f:
                 bitrot.bitrot_verify_stream(
                     f, os.fstat(f.fileno()).st_size, shard_file_size,
-                    shard_size,
+                    shard_size, algo=_stored_algo(fi),
                 )
 
     def check_parts(self, volume: str, path: str, fi: FileInfo) -> None:
@@ -505,7 +517,8 @@ class LocalStorage(StorageAPI):
             except FileNotFoundError:
                 raise errors.FileNotFound(pp)
             want = bitrot.bitrot_shard_file_size(
-                fi.erasure.shard_file_size(part.size), fi.erasure.shard_size
+                fi.erasure.shard_file_size(part.size), fi.erasure.shard_size,
+                _stored_algo(fi),
             )
             if st.st_size != want:
                 raise errors.FileCorrupt(

@@ -1,0 +1,64 @@
+"""Programmable fault-injection drive for tests and chaos drills.
+
+Reference: cmd/naughty-disk_test.go:31 — wraps a real StorageAPI and
+fails specific call numbers with programmed errors (or every call with a
+default error), so drive loss and flaky-IO windows can be simulated
+mid-operation deterministically.
+"""
+
+from __future__ import annotations
+
+import threading
+
+# ops that count toward the programmed call sequence (identity accessors
+# never fail — matching the reference, which passes through DiskID etc.)
+FAULTABLE_OPS = (
+    "make_volume", "list_volumes", "stat_volume", "delete_volume",
+    "read_all", "write_all", "delete", "rename_file", "create_file",
+    "open_file_writer", "append_file", "read_file_stream", "read_file",
+    "read_version", "read_xl", "write_metadata", "update_metadata",
+    "delete_version", "free_version_data", "rename_data", "list_dir",
+    "walk_dir", "verify_file", "check_parts", "disk_info",
+)
+
+
+class NaughtyDisk:
+    """StorageAPI decorator injecting programmed per-call errors.
+
+    errs: {call_number: Exception} — the Nth faultable call (1-based,
+    counted across all ops) raises its exception instead of executing.
+    default_err: if set, EVERY faultable call not in `errs` raises it
+    (an always-broken disk).
+    """
+
+    def __init__(self, inner, errs: dict[int, Exception] | None = None,
+                 default_err: Exception | None = None):
+        self._inner = inner
+        self.errs = dict(errs or {})
+        self.default_err = default_err
+        self.call_count = 0
+        self._mu = threading.Lock()
+        for op in FAULTABLE_OPS:
+            target = getattr(inner, op, None)
+            if target is not None:
+                setattr(self, op, self._wrap(target))
+
+    def _wrap(self, fn):
+        def naughty(*a, **kw):
+            with self._mu:
+                self.call_count += 1
+                n = self.call_count
+            if n in self.errs:
+                raise self.errs[n]
+            if self.default_err is not None:
+                raise self.default_err
+            return fn(*a, **kw)
+
+        naughty.__name__ = fn.__name__
+        return naughty
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def unwrap(self):
+        return self._inner

@@ -1,0 +1,91 @@
+"""Bloom filter + data-update tracker for scanner change skipping.
+
+Reference: cmd/data-update-tracker.go:59 — every write marks its object
+path in a cycle-versioned bloom filter; the scanner consults the filter
+to skip subtrees that cannot have changed since the last cycle, and the
+filter resets periodically so drift (false-positive buildup, missed
+external changes) is bounded.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+
+
+class BloomFilter:
+    """Fixed-size bloom filter over strings (k hash functions derived
+    from blake2b digests)."""
+
+    def __init__(self, m_bits: int = 1 << 20, k: int = 4):
+        self.m = m_bits
+        self.k = k
+        self._bits = bytearray(m_bits // 8)
+        self.adds = 0
+
+    def _indexes(self, item: str):
+        d = hashlib.blake2b(item.encode(), digest_size=16).digest()
+        h1 = int.from_bytes(d[:8], "little")
+        h2 = int.from_bytes(d[8:], "little") | 1
+        for i in range(self.k):
+            yield (h1 + i * h2) % self.m
+
+    def add(self, item: str) -> None:
+        for idx in self._indexes(item):
+            self._bits[idx >> 3] |= 1 << (idx & 7)
+        self.adds += 1
+
+    def __contains__(self, item: str) -> bool:
+        return all(self._bits[i >> 3] & (1 << (i & 7))
+                   for i in self._indexes(item))
+
+
+class DataUpdateTracker:
+    """Marks modified paths; answers "did anything under this prefix
+    change since the last scanner cycle?".
+
+    `current` collects marks for the in-progress cycle; on cycle() it
+    becomes `history` (what the next scan consults) — double buffering so
+    writes landing DURING a scan are never lost.  Every `reset_cycles`
+    cycles the filters clear and one full scan runs (bounds bloom
+    saturation, reference dataUpdateTracker cycle handling)."""
+
+    def __init__(self, m_bits: int = 1 << 20, reset_cycles: int = 16):
+        self._mu = threading.Lock()
+        self.m_bits = m_bits
+        self.reset_cycles = reset_cycles
+        self.current = BloomFilter(m_bits)
+        self.history: BloomFilter | None = None  # None -> scan everything
+        self.cycles = 0
+
+    def mark(self, bucket: str, obj: str = "") -> None:
+        with self._mu:
+            self.current.add(bucket)
+            if obj:
+                self.current.add(f"{bucket}/{obj}")
+
+    def cycle(self) -> None:
+        """Advance at the END of a scanner cycle."""
+        with self._mu:
+            self.cycles += 1
+            if self.cycles % self.reset_cycles == 0:
+                # periodic full rescan: next cycle sees "everything dirty"
+                self.history = None
+                self.current = BloomFilter(self.m_bits)
+                return
+            merged = self.current
+            if self.history is not None:
+                # carry unscanned history forward? No: history was just
+                # scanned — only the current cycle's marks matter next
+                pass
+            self.history = merged
+            self.current = BloomFilter(self.m_bits)
+
+    def bucket_dirty(self, bucket: str) -> bool:
+        """False ONLY when the filter can prove no write touched the
+        bucket since the last cycle."""
+        with self._mu:
+            if self.history is None:
+                return True
+            # writes in the in-progress window also count as dirty
+            return bucket in self.history or bucket in self.current

@@ -215,3 +215,83 @@ class TestTransitionSafety:
             _os.path.join(d0.root, "trbkt7", "st.bin", "xl.meta"))
         # object still reads through the tier
         assert s.request("GET", "/trbkt7/st.bin").body == b"q" * 4096
+
+
+class TestTierReviewFixes:
+    def test_overwrite_of_stub_reclaims_tier_copy(self, srv):
+        """PUT over a transitioned (unversioned) object must journal the
+        old warm-tier copy for reclaim, not leak it."""
+        import time as _t
+
+        s, warm = srv
+        s.request("PUT", "/trbkt8")
+        s.request("PUT", "/trbkt8/ow.bin", data=b"old" * 1000)
+        s.request("PUT", "/trbkt8", query=[("lifecycle", "")],
+                  data=LC_TRANSITION)
+        s.server.services.scanner.scan_cycle()
+
+        def warm_files():
+            return [f for dp, _, fns in os.walk(warm) for f in fns
+                    if "ow.bin" in dp]
+
+        assert warm_files()
+        # overwrite the stub; remove the lifecycle config first so the
+        # new object does not immediately re-transition
+        s.request("DELETE", "/trbkt8", query=[("lifecycle", "")])
+        s.request("PUT", "/trbkt8/ow.bin", data=b"new" * 1000)
+        t0 = _t.time()
+        while warm_files() and _t.time() - t0 < 10:
+            _t.sleep(0.1)
+        assert not warm_files(), "overwritten stub leaked its tier copy"
+        assert s.request("GET", "/trbkt8/ow.bin").body == b"new" * 1000
+
+    def test_remove_tier_in_use_refused(self, srv):
+        s, _ = srv
+        s.request("PUT", "/trbkt9")
+        s.request("PUT", "/trbkt9/keep.bin", data=b"k" * 2048)
+        s.request("PUT", "/trbkt9", query=[("lifecycle", "")],
+                  data=LC_TRANSITION)
+        s.server.services.scanner.scan_cycle()
+        r = s.request("DELETE", f"{ADMIN}/tier", query=[("name", "WARM")])
+        assert r.status == 400
+        assert "transitioned" in r.text()
+        # force override works
+        r = s.request("DELETE", f"{ADMIN}/tier",
+                      query=[("name", "WARM"), ("force", "true")])
+        assert r.status == 200
+
+
+class TestMultipartBitrotPinning:
+    def test_algo_pinned_across_env_change(self, tmp_path):
+        """Parts hashed under one algorithm must complete and read back
+        correctly even if the env default changes mid-upload."""
+        import io
+
+        from minio_tpu.erasure.objects import PutObjectOptions
+        from minio_tpu.erasure.sets import ErasureSets, ErasureServerPools
+        from minio_tpu.storage.local import LocalStorage
+
+        os.environ["MINIO_TPU_FSYNC"] = "0"
+        disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+        pools = ErasureServerPools([ErasureSets(disks)])
+        pools.make_bucket("bkt")
+        es = pools.pools[0].get_hashed_set("mp.bin")
+        os.environ["MINIO_TPU_BITROT_ALGO"] = "sha256"
+        try:
+            uid = es.new_multipart_upload("bkt", "mp.bin",
+                                          PutObjectOptions())
+            part = os.urandom(5 << 20)
+            pi = es.put_object_part("bkt", "mp.bin", uid, 1,
+                                    io.BytesIO(part), len(part))
+        finally:
+            os.environ["MINIO_TPU_BITROT_ALGO"] = "blake2b512"
+        try:
+            oi = es.complete_multipart_upload("bkt", "mp.bin", uid,
+                                              [(1, pi.etag)])
+        finally:
+            del os.environ["MINIO_TPU_BITROT_ALGO"]
+        fi, _ = es.object_health("bkt", "mp.bin")
+        # recorded algo = the algo the parts were WRITTEN with
+        assert fi.erasure.checksums[0].algorithm == "sha256"
+        _, stream = pools.get_object("bkt", "mp.bin")
+        assert b"".join(stream) == part
