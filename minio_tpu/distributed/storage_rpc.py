@@ -197,6 +197,17 @@ def register_storage_rpc(router: RpcRouter, drives: dict[str, LocalStorage]) -> 
 
         return StreamResult(chunks())
 
+    @h("delete_versions")
+    def _delete_versions(args, body):
+        doc = msgpack.unpackb(body, raw=False)
+        items = [(it["path"], _fi_from_wire(it["fi"]),
+                  bool(it.get("force"))) for it in doc]
+        errs = drive(args).delete_versions(args["volume"], items)
+        return {}, msgpack.packb(
+            [None if e is None else
+             {"type": type(e).__name__, "msg": str(e)} for e in errs],
+            use_bin_type=True)
+
     @h("free_version_data")
     def _free_version_data(args, body):
         import json as _json
@@ -422,6 +433,23 @@ class RemoteStorage(StorageAPI):
                     yield from batch
         finally:
             resp.close()
+
+    def delete_versions(self, volume: str, items: list) -> list:
+        body = msgpack.packb(
+            [{"path": p, "fi": _fi_to_wire(fi), "force": force}
+             for p, fi, force in items], use_bin_type=True)
+        _, out = self._call("delete_versions", {"volume": volume},
+                            body=body)
+        from minio_tpu.storage import errors as st
+
+        res = []
+        for e in msgpack.unpackb(out, raw=False):
+            if e is None:
+                res.append(None)
+            else:
+                cls = getattr(st, e.get("type", ""), st.StorageError)
+                res.append(cls(e.get("msg", "")))
+        return res
 
     def free_version_data(self, volume: str, path: str, version_id: str,
                           meta_updates: dict) -> None:

@@ -756,6 +756,92 @@ class ErasureObjects:
                 self.ns_updated(bucket, obj)
             return ObjectInfo(bucket=bucket, name=obj, version_id=version_id)
 
+    def delete_objects(self, bucket: str, dels: list[dict]) -> list:
+        """Bulk delete: ONE delete_versions RPC per drive for the whole
+        batch (reference DeleteObjects -> per-disk DeleteVersions,
+        cmd/erasure-object.go DeleteObjects).
+
+        dels: [{"obj":..., "version_id":..., "versioned":bool,
+        "suspended":bool}]; returns per-entry ObjectInfo or Exception."""
+        import contextlib
+
+        results: list = [None] * len(dels)
+        items: list[tuple[int, str, FileInfo, bool]] = []
+        markers: list[tuple[int, dict]] = []
+        # hold every object's write lock for the batch, in sorted order
+        # (deadlock-free), so bulk deletes cannot race concurrent PUTs
+        # into split sub-quorum states
+        lock_keys = sorted({f"{bucket}/{d0['obj']}" for d0 in dels})
+        with contextlib.ExitStack() as stack:
+            for lk in lock_keys:
+                stack.enter_context(self.ns.write(lk))
+            for j, d0 in enumerate(dels):
+                obj = d0["obj"]
+                vid = d0.get("version_id", "")
+                versioned = d0.get("versioned", False)
+                suspended = d0.get("suspended", False)
+                if not vid and (versioned or suspended):
+                    # marker writes have per-object quorum/return
+                    # semantics: reuse the single-object path (rare in
+                    # bulk deletes compared to plain removals)
+                    markers.append((j, d0))
+                    continue
+                if self.tier_delete_hook is not None:
+                    try:
+                        fi0, _, _ = self._quorum_info(bucket, obj, vid)
+                        if fi0.metadata.get(TRANSITION_STATUS_KEY) == \
+                                TRANSITION_COMPLETE:
+                            d0["_tier_meta"] = dict(fi0.metadata)
+                    except errors.StorageError:
+                        pass
+                fi = FileInfo(volume=bucket, name=obj, version_id=vid,
+                              deleted=False, mod_time=time.time())
+                items.append((j, obj, fi, False))
+
+            if items:
+                batch = [(obj, fi, force) for _, obj, fi, force in items]
+                per_drive: dict[int, list] = {}
+
+                def run(i: int) -> None:
+                    d = self.disks[i]
+                    if d is None or not d.is_online():
+                        raise errors.DiskNotFound(str(i))
+                    per_drive[i] = d.delete_versions(bucket, batch)
+
+                drive_errs = self._fan_out(run, range(len(self.disks)))
+                n = len(self.disks)
+                for pos, (j, obj, fi, _) in enumerate(items):
+                    # SAME rule as single-object delete_object: fail only
+                    # when REAL (non-FileNotFound) errors exceed n - n//2
+                    real = 0
+                    for i in range(n):
+                        e2 = drive_errs[i] if drive_errs[i] is not None \
+                            else per_drive[i][pos]
+                        if e2 is not None and \
+                                not isinstance(e2, errors.FileNotFound):
+                            real += 1
+                    if real and real > n - (n // 2):
+                        results[j] = errors.ErasureWriteQuorum(
+                            f"delete quorum not met for {obj}")
+                        continue
+                    results[j] = ObjectInfo(bucket=bucket, name=obj,
+                                            version_id=fi.version_id)
+                    if self.ns_updated is not None:
+                        self.ns_updated(bucket, obj)
+                    tm = dels[j].get("_tier_meta")
+                    if tm is not None \
+                            and self.tier_delete_hook is not None:
+                        self.tier_delete_hook(tm)
+
+        for j, d0 in markers:
+            try:
+                results[j] = self.delete_object(
+                    bucket, d0["obj"], d0.get("version_id", ""),
+                    d0.get("versioned", False), d0.get("suspended", False))
+            except Exception as e:
+                results[j] = e
+        return results
+
     # ------------------------------------------------------------- METADATA
     TAGS_KEY = "x-minio-tags"  # urlencoded tag set on a version
 

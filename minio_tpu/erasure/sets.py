@@ -246,6 +246,20 @@ class ErasureSets:
         return self.get_hashed_set(obj).transition_version(
             bucket, obj, version_id, meta_updates, expected_mod_time)
 
+    def delete_objects(self, bucket, dels: list) -> list:
+        """Bulk delete grouped per erasure set."""
+        results = [None] * len(dels)
+        by_set: dict[int, list] = {}
+        for j, d0 in enumerate(dels):
+            idx = sip_hash_mod(d0["obj"], self.set_count, self._dep_bytes)
+            by_set.setdefault(idx, []).append(j)
+        for idx, js in by_set.items():
+            out = self.sets[idx].delete_objects(
+                bucket, [dels[j] for j in js])
+            for j, r in zip(js, out):
+                results[j] = r
+        return results
+
     def update_object_metadata(self, bucket, obj, updates, version_id=""):
         return self.get_hashed_set(obj).update_object_metadata(
             bucket, obj, updates, version_id)
@@ -470,6 +484,33 @@ class ErasureServerPools:
             except (errors.ObjectNotFound, errors.VersionNotFound) as ex:
                 last = ex
         raise last
+
+    def delete_objects(self, bucket, dels: list) -> list:
+        if not self.bucket_exists(bucket):
+            raise errors.BucketNotFound(bucket)
+        if len(self.pools) == 1:
+            return self.pools[0].delete_objects(bucket, dels)
+        # multi-pool: group by owning pool, idempotent-miss for absent
+        results: list = [None] * len(dels)
+        by_pool: dict[int, list] = {}
+        for j, d0 in enumerate(dels):
+            p = self._pool_of(bucket, d0["obj"])
+            if p is None:
+                if (d0.get("versioned") or d0.get("suspended")) \
+                        and not d0.get("version_id"):
+                    p = self.pools[0]
+                else:
+                    results[j] = ObjectInfo(
+                        bucket=bucket, name=d0["obj"],
+                        version_id=d0.get("version_id", ""))
+                    continue
+            by_pool.setdefault(self.pools.index(p), []).append(j)
+        for pi, js in by_pool.items():
+            out = self.pools[pi].delete_objects(bucket,
+                                                [dels[j] for j in js])
+            for j, r in zip(js, out):
+                results[j] = r
+        return results
 
     def delete_object(self, bucket, obj, version_id="", versioned=False,
                       suspended=False):

@@ -22,6 +22,8 @@ import time
 
 from aiohttp import web
 
+from minio_tpu.storage.local import SYSTEM_VOL
+
 from .s3errors import S3Error
 
 ADMIN_PREFIX = "/minio/admin/v3"
@@ -80,6 +82,14 @@ class AdminMixin:
         # TraceHandler cmd/admin-handlers.go:1108, ConsoleLogHandler)
         r.add_get(f"{p}/trace", wrap(self.admin_trace, "ServerTrace"))
         r.add_get(f"{p}/log", wrap(self.admin_console_log, "ConsoleLog"))
+        # speedtests (reference drive/object perf probes,
+        # cmd/peer-rest-client.go:128 dperf + SpeedtestHandler)
+        # write-heavy probes get their own action, NOT the read-only
+        # ServerInfo gate (reference SpeedtestHandler admin action)
+        r.add_post(f"{p}/speedtest/drive",
+                   wrap(self.admin_drive_speedtest, "SpeedTest"))
+        r.add_post(f"{p}/speedtest",
+                   wrap(self.admin_object_speedtest, "SpeedTest"))
         # tiering (reference cmd/admin-handlers.go AddTierHandler /
         # ListTierHandler / RemoveTierHandler)
         r.add_put(f"{p}/tier", wrap(self.admin_add_tier, "SetTier"))
@@ -111,6 +121,135 @@ class AdminMixin:
                     content_type="application/json",
                 )
         return handler
+
+    # ----------------------------------------------------------- speedtest
+    @staticmethod
+    def _int_q(request: web.Request, name: str, default: int,
+               lo: int, hi: int) -> int:
+        raw = request.rel_url.query.get(name, "")
+        if not raw:
+            return default
+        try:
+            v = int(raw)
+        except ValueError:
+            raise S3Error("AdminInvalidArgument",
+                          f"{name} must be an integer")
+        if not lo <= v <= hi:
+            raise S3Error("AdminInvalidArgument",
+                          f"{name} must be between {lo} and {hi}")
+        return v
+
+    async def admin_drive_speedtest(self, request: web.Request,
+                                    body: bytes):
+        """Sequential write+read throughput per LOCAL drive using the
+        same O_DIRECT-free path the data plane uses (reference dperf
+        drive speedtest)."""
+        import os
+        import uuid as _uuid
+
+        size = self._int_q(request, "size", 64 << 20, 1 << 20, 1 << 30)
+        block = 4 << 20
+        payload = os.urandom(block)
+
+        def run() -> list[dict]:
+            out = []
+            for pool in getattr(self.api, "pools", [self.api]):
+                for d in pool.all_disks:
+                    if d is None or not d.is_online() \
+                            or not getattr(d, "is_local", lambda: True)():
+                        continue
+                    tmp = f"tmp/speedtest-{_uuid.uuid4().hex}"
+                    try:
+                        t0 = time.monotonic()
+                        fh = d.open_file_writer(SYSTEM_VOL, tmp)
+                        written = 0
+                        while written < size:
+                            fh.write(payload)
+                            written += block
+                        fh.close()
+                        w_s = time.monotonic() - t0
+                        t0 = time.monotonic()
+                        rh = d.read_file_stream(SYSTEM_VOL, tmp,
+                                                0, written)
+                        while rh.read(block):
+                            pass
+                        rh.close()
+                        r_s = time.monotonic() - t0
+                        out.append({
+                            "endpoint": d.endpoint(),
+                            "writeMiBps": round(written / w_s / 2**20, 1),
+                            "readMiBps": round(written / r_s / 2**20, 1),
+                            "bytes": written,
+                        })
+                    except Exception as e:
+                        out.append({"endpoint": d.endpoint(),
+                                    "error": str(e)})
+                    finally:
+                        try:
+                            d.delete(SYSTEM_VOL, tmp)
+                        except Exception:
+                            pass
+            return out
+
+        return self._json({"drives": await self._run(run)})
+
+    async def admin_object_speedtest(self, request: web.Request,
+                                     body: bytes):
+        """PUT+GET throughput through the FULL object pipeline (erasure
+        encode, bitrot, commit — reference objectSpeedTest)."""
+        import io as _io
+        import os
+
+        from minio_tpu.erasure.objects import PutObjectOptions
+
+        size = self._int_q(request, "size", 16 << 20, 1 << 10, 256 << 20)
+        count = self._int_q(request, "count", 4, 1, 64)
+        concurrent = self._int_q(request, "concurrent", 2, 1, 16)
+        bucket = ".speedtest-" + os.urandom(4).hex()
+
+        def run() -> dict:
+            import concurrent.futures as cf
+
+            self.api.make_bucket(bucket)
+            data = os.urandom(size)
+            try:
+                t0 = time.monotonic()
+                with cf.ThreadPoolExecutor(concurrent) as pool:
+                    list(pool.map(
+                        lambda i: self.api.put_object(
+                            bucket, f"obj-{i}", _io.BytesIO(data), size,
+                            PutObjectOptions()),
+                        range(count)))
+                put_s = time.monotonic() - t0
+
+                def get_one(i):
+                    _, stream = self.api.get_object(bucket, f"obj-{i}")
+                    for _ in stream:
+                        pass
+
+                t0 = time.monotonic()
+                with cf.ThreadPoolExecutor(concurrent) as pool:
+                    list(pool.map(get_one, range(count)))
+                get_s = time.monotonic() - t0
+                total = size * count
+                return {
+                    "putMiBps": round(total / put_s / 2**20, 1),
+                    "getMiBps": round(total / get_s / 2**20, 1),
+                    "objectSize": size, "objects": count,
+                    "concurrent": concurrent,
+                }
+            finally:
+                try:
+                    for i in range(count):
+                        try:
+                            self.api.delete_object(bucket, f"obj-{i}")
+                        except Exception:
+                            pass
+                    self.api.delete_bucket(bucket, force=True)
+                except Exception:
+                    pass
+
+        return self._json(await self._run(run))
 
     # ------------------------------------------------------------- tiering
     def _tier_mgr(self):

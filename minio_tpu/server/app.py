@@ -949,6 +949,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             if rcfg_for_delete is not None:
                 repl_pool = self.services.replication
         results = []
+        to_delete: list[tuple[str, str]] = []  # (key, vid) passing auth
         for obj in root.findall(f"{ns}Object") + root.findall("Object"):
             key = obj.findtext(f"{ns}Key") or obj.findtext("Key") or ""
             vid = obj.findtext(f"{ns}VersionId") or obj.findtext("VersionId") or ""
@@ -972,28 +973,37 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                     f"<Message>{escape(s3e.message)}</Message></Error>"
                 )
                 continue
-            try:
-                doi = await self._run(
-                    self.api.delete_object, bucket, key, vid,
-                    vstatus == "Enabled", vstatus == "Suspended"
-                )
-                results.append(f"<Deleted><Key>{escape(key)}</Key></Deleted>")
+            to_delete.append((key, vid))
+        # one batched delete: a single delete_versions round per drive
+        # (reference DeleteObjects -> DeleteVersions,
+        # cmd/bucket-handlers.go DeleteMultipleObjectsHandler)
+        if to_delete:
+            dels = [{"obj": k, "version_id": v,
+                     "versioned": vstatus == "Enabled",
+                     "suspended": vstatus == "Suspended"}
+                    for k, v in to_delete]
+            outs = await self._run(self.api.delete_objects, bucket, dels)
+            from minio_tpu.events.event import EventName
+
+            for (key, vid), doi in zip(to_delete, outs):
+                if isinstance(doi, Exception):
+                    s3e = from_storage_error(doi)
+                    results.append(
+                        f"<Error><Key>{escape(key)}</Key>"
+                        f"<Code>{s3e.code}</Code>"
+                        f"<Message>{escape(s3e.message)}</Message></Error>"
+                    )
+                    continue
+                results.append(
+                    f"<Deleted><Key>{escape(key)}</Key></Deleted>")
                 if repl_pool is not None \
                         and rcfg_for_delete.match(key) is not None:
                     repl_pool.replicate_delete(
                         bucket, key, vid, delete_marker=doi.delete_marker)
-                from minio_tpu.events.event import EventName
-
                 self._emit(
                     EventName.OBJECT_REMOVED_DELETE_MARKER
                     if doi.delete_marker else EventName.OBJECT_REMOVED_DELETE,
                     bucket, key, version_id=doi.version_id, request=request)
-            except Exception as e:
-                s3e = from_storage_error(e)
-                results.append(
-                    f"<Error><Key>{escape(key)}</Key><Code>{s3e.code}</Code>"
-                    f"<Message>{escape(s3e.message)}</Message></Error>"
-                )
         return self._xml(200, (
             f'<?xml version="1.0" encoding="UTF-8"?>'
             f'<DeleteResult xmlns="{XMLNS}">{"".join(results)}</DeleteResult>'

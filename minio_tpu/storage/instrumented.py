@@ -20,7 +20,8 @@ TIMED_OPS = (
     "read_all", "write_all", "delete", "rename_file", "create_file",
     "open_file_writer", "append_file", "read_file_stream", "read_file",
     "read_version", "read_xl", "write_metadata", "update_metadata",
-    "delete_version", "free_version_data", "rename_data",
+    "delete_version", "delete_versions", "free_version_data",
+    "rename_data",
     "list_dir", "walk_dir",
     "verify_file", "check_parts", "disk_info",
 )

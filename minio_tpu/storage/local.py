@@ -400,6 +400,23 @@ class LocalStorage(StorageAPI):
         meta.update(meta_updates)
         self._write_xl(volume, path, xl)
 
+    def delete_versions(self, volume: str,
+                         items: list) -> list:
+        """Batched version deletes: items = [(path, FileInfo,
+        force_del_marker)], one result slot per item (None = ok).
+        Reference DeleteVersions (cmd/storage-interface.go,
+        cmd/xl-storage.go DeleteVersions) — bulk deletes hit each drive
+        once instead of once per object."""
+        out = []
+        for path, fi, force in items:
+            try:
+                self.delete_version(volume, path, fi,
+                                    force_del_marker=force)
+                out.append(None)
+            except Exception as e:
+                out.append(e)
+        return out
+
     def rename_data(self, src_volume: str, src_path: str, fi: FileInfo,
                     dst_volume: str, dst_path: str) -> None:
         """Move staged part files into place and commit xl.meta atomically."""

@@ -253,3 +253,55 @@ class TestAdminUserCRUD:
         assert srv.request("PUT", "/svcb",
                            creds=(doc["accessKey"],
                                   doc["secretKey"])).status == 200
+
+
+class TestSpeedtest:
+    def test_drive_speedtest(self, srv):
+        r = srv.request("POST", f"{ADMIN}/speedtest/drive",
+                        query=[("size", str(8 << 20))])
+        assert r.status == 200, r.text()
+        import json as _json
+
+        doc = _json.loads(r.text())
+        assert len(doc["drives"]) == len(srv.pools.pools[0].all_disks)
+        for d in doc["drives"]:
+            assert d.get("writeMiBps", 0) > 0
+            assert d.get("readMiBps", 0) > 0
+
+    def test_object_speedtest(self, srv):
+        r = srv.request("POST", f"{ADMIN}/speedtest",
+                        query=[("size", str(2 << 20)), ("count", "2"),
+                               ("concurrent", "2")])
+        assert r.status == 200, r.text()
+        import json as _json
+
+        doc = _json.loads(r.text())
+        assert doc["putMiBps"] > 0 and doc["getMiBps"] > 0
+        # scratch bucket cleaned up
+        names = [v.name for v in srv.pools.list_buckets()]
+        assert not any(n.startswith(".speedtest-") for n in names)
+
+
+class TestBulkDeleteBatch:
+    def test_bulk_delete_many(self, srv):
+        srv.request("PUT", "/bdbkt")
+        for i in range(20):
+            srv.request("PUT", f"/bdbkt/k{i}", data=b"x")
+        body = ("<Delete>" + "".join(
+            f"<Object><Key>k{i}</Key></Object>" for i in range(20))
+            + "</Delete>").encode()
+        r = srv.request("POST", "/bdbkt", query=[("delete", "")], data=body)
+        assert r.status == 200
+        assert r.text().count("<Deleted>") == 20
+        for i in range(20):
+            assert srv.request("GET", f"/bdbkt/k{i}").status == 404
+
+    def test_bulk_delete_mixed_missing(self, srv):
+        srv.request("PUT", "/bdbkt2")
+        srv.request("PUT", "/bdbkt2/real", data=b"x")
+        body = (b"<Delete><Object><Key>real</Key></Object>"
+                b"<Object><Key>ghost</Key></Object></Delete>")
+        r = srv.request("POST", "/bdbkt2", query=[("delete", "")], data=body)
+        assert r.status == 200
+        # S3: deleting a missing key still reports Deleted (idempotent)
+        assert r.text().count("<Deleted>") == 2
