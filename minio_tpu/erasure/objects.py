@@ -743,12 +743,12 @@ class ErasureObjects:
                 d.delete_version(bucket, obj, fi)
 
             errs = self._fan_out(del_version, range(len(self.disks)))
-            real = [e2 for e2 in errs
-                    if e2 is not None and not isinstance(e2, errors.FileNotFound)]
-            nf = sum(1 for e2 in errs if isinstance(e2, errors.FileNotFound))
-            if nf > len(self.disks) // 2 and not version_id:
-                pass  # idempotent delete of missing object is S3-legal
-            if real and len(real) > len(self.disks) - (len(self.disks) // 2):
+            ok = sum(1 for e2 in errs
+                     if e2 is None or isinstance(e2, errors.FileNotFound))
+            _, wq = self._quorum_from([None] * len(self.disks))
+            if ok < wq:
+                # fewer than write-quorum drives acknowledged: surviving
+                # copies could still satisfy a read -> fail loudly
                 raise errors.ErasureWriteQuorum("delete quorum not met")
             if tier_meta is not None:
                 self.tier_delete_hook(tier_meta)
@@ -786,17 +786,27 @@ class ErasureObjects:
                     # bulk deletes compared to plain removals)
                     markers.append((j, d0))
                     continue
-                if self.tier_delete_hook is not None:
-                    try:
-                        fi0, _, _ = self._quorum_info(bucket, obj, vid)
-                        if fi0.metadata.get(TRANSITION_STATUS_KEY) == \
-                                TRANSITION_COMPLETE:
-                            d0["_tier_meta"] = dict(fi0.metadata)
-                    except errors.StorageError:
-                        pass
                 fi = FileInfo(volume=bucket, name=obj, version_id=vid,
                               deleted=False, mod_time=time.time())
                 items.append((j, obj, fi, False))
+            if self.tier_delete_hook is not None and items:
+                # prefetch tier pointers CONCURRENTLY — serial quorum
+                # reads under the held locks would dwarf the single
+                # batched delete round
+                def fetch(j_obj):
+                    j, obj, _, _ = j_obj
+                    try:
+                        fi0, _, _ = self._quorum_info(
+                            bucket, obj, dels[j].get("version_id", ""))
+                        if fi0.metadata.get(TRANSITION_STATUS_KEY) == \
+                                TRANSITION_COMPLETE:
+                            dels[j]["_tier_meta"] = dict(fi0.metadata)
+                    except errors.StorageError:
+                        pass
+
+                with cf.ThreadPoolExecutor(
+                        max_workers=min(8, len(items))) as pre:
+                    list(pre.map(fetch, items))
 
             if items:
                 batch = [(obj, fi, force) for _, obj, fi, force in items]
@@ -810,28 +820,36 @@ class ErasureObjects:
 
                 drive_errs = self._fan_out(run, range(len(self.disks)))
                 n = len(self.disks)
+                _, wq = self._quorum_from([None] * n)
                 for pos, (j, obj, fi, _) in enumerate(items):
-                    # SAME rule as single-object delete_object: fail only
-                    # when REAL (non-FileNotFound) errors exceed n - n//2
-                    real = 0
+                    # success = the delete took effect on a WRITE QUORUM
+                    # of drives (already-absent counts as deleted), else
+                    # a later read could resurrect the object from the
+                    # surviving copies
+                    ok = 0
                     for i in range(n):
                         e2 = drive_errs[i] if drive_errs[i] is not None \
                             else per_drive[i][pos]
-                        if e2 is not None and \
-                                not isinstance(e2, errors.FileNotFound):
-                            real += 1
-                    if real and real > n - (n // 2):
+                        if e2 is None or isinstance(e2,
+                                                    errors.FileNotFound):
+                            ok += 1
+                    if ok < wq:
                         results[j] = errors.ErasureWriteQuorum(
                             f"delete quorum not met for {obj}")
                         continue
                     results[j] = ObjectInfo(bucket=bucket, name=obj,
                                             version_id=fi.version_id)
-                    if self.ns_updated is not None:
-                        self.ns_updated(bucket, obj)
-                    tm = dels[j].get("_tier_meta")
-                    if tm is not None \
-                            and self.tier_delete_hook is not None:
-                        self.tier_delete_hook(tm)
+                    # per-item hooks must NEVER abort the batch: the
+                    # drives are already modified for every other key
+                    try:
+                        if self.ns_updated is not None:
+                            self.ns_updated(bucket, obj)
+                        tm = dels[j].get("_tier_meta")
+                        if tm is not None \
+                                and self.tier_delete_hook is not None:
+                            self.tier_delete_hook(tm)
+                    except Exception:
+                        pass
 
         for j, d0 in markers:
             try:

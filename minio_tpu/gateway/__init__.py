@@ -1,0 +1,15 @@
+"""Gateway mode: serve the S3 front end over a remote backend.
+
+Reference: cmd/gateway-main.go + the Gateway interface
+(cmd/gateway-interface.go:33) with backends under cmd/gateway/*
+(azure/gcs/hdfs/nas/s3).  Here the first-class backend is `s3` — any
+S3-compatible remote — with the same shape the reference uses: the
+local server keeps IAM/config/bucket-metadata on its own metadata
+directory while all object data passes through to the backend;
+unsupported erasure-only operations surface as NotImplemented
+(reference GatewayUnsupported).
+"""
+
+from .s3 import S3Gateway
+
+__all__ = ["S3Gateway"]

@@ -39,6 +39,16 @@ def main(argv=None) -> int:
                         "MINIO_TPU_HEAL_INTERVAL", "3600")))
     ap.add_argument("--no-services", action="store_true",
                     help="do not start heal/MRF/scanner background services")
+    ap.add_argument("--gateway", choices=["s3"], default=None,
+                    help="gateway mode: proxy objects to a remote backend "
+                         "(endpoints arg = backend URL, plus --gateway-"
+                         "metadata-dir for local IAM/config state)")
+    ap.add_argument("--gateway-metadata-dir", default="./gateway-meta",
+                    help="local directory for gateway IAM/config state")
+    ap.add_argument("--gateway-access-key",
+                    default=os.environ.get("MINIO_GATEWAY_ACCESS_KEY", ""))
+    ap.add_argument("--gateway-secret-key",
+                    default=os.environ.get("MINIO_GATEWAY_SECRET_KEY", ""))
     args = ap.parse_args(argv)
 
     from aiohttp import web
@@ -53,6 +63,31 @@ def main(argv=None) -> int:
     except SelfTestError as e:
         print(f"minio-tpu: FATAL: {e}", file=sys.stderr)
         return 1
+
+    if args.gateway == "s3":
+        # `python -m minio_tpu.server --gateway s3 https://backend`
+        # (reference `minio gateway s3 ...`, cmd/gateway-main.go)
+        from minio_tpu.gateway import S3Gateway
+        from minio_tpu.server.app import make_app
+
+        if len(args.endpoints) != 1:
+            print("minio-tpu: gateway mode takes exactly one backend URL",
+                  file=sys.stderr)
+            return 1
+        layer = S3Gateway(
+            args.endpoints[0],
+            args.gateway_access_key or args.access_key,
+            args.gateway_secret_key or args.secret_key,
+            metadata_dir=args.gateway_metadata_dir, region=args.region)
+        app = make_app(layer, start_services=False,
+                       access_key=args.access_key,
+                       secret_key=args.secret_key, region=args.region)
+        host, _, port = args.address.partition(":")
+        print(f"minio-tpu: gateway/s3 -> {args.endpoints[0]}, "
+              f"S3 on http://{args.address}", file=sys.stderr)
+        web.run_app(app, host=host or "0.0.0.0",
+                    port=int(port or 9000), print=None)
+        return 0
 
     node = ClusterNode(
         args.endpoints, my_address=args.address,

@@ -1,0 +1,208 @@
+"""Gateway mode: S3 front end proxying to a remote S3 backend.
+
+Reference: cmd/gateway-main.go, cmd/gateway/s3/gateway-s3.go.  The
+backend here is the repo's own erasure server; the gateway is a second
+server whose object layer is an S3Gateway pointed at it.
+"""
+
+import asyncio
+import http.client
+import json
+import os
+import threading
+import urllib.parse
+
+import pytest
+
+from minio_tpu.gateway import S3Gateway
+from minio_tpu.server import sigv4
+from minio_tpu.server.app import make_app
+from tests.s3_harness import S3TestServer
+
+
+class GatewayServer:
+    """Boots make_app(S3Gateway) on a localhost socket."""
+
+    def __init__(self, backend_host: str, backend_ak: str, backend_sk: str,
+                 metadata_dir: str,
+                 access_key: str = "gwadmin", secret_key: str = "gwsecret"):
+        self.ak, self.sk = access_key, secret_key
+        self.layer = S3Gateway(backend_host, backend_ak, backend_sk,
+                               metadata_dir=metadata_dir)
+        self.app = make_app(self.layer, start_services=False,
+                            access_key=access_key, secret_key=secret_key)
+        self.server = self.app["s3_server"]
+        self._loop = asyncio.new_event_loop()
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        self._started.wait(10)
+
+    def _serve(self):
+        from aiohttp import web
+
+        asyncio.set_event_loop(self._loop)
+
+        async def start():
+            runner = web.AppRunner(self.app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            self.port = runner.addresses[0][1]
+            self._runner = runner
+            self._started.set()
+
+        self._loop.run_until_complete(start())
+        self._loop.run_forever()
+
+    def close(self):
+        self.server.notifier.close()
+
+        async def stop():
+            await self._runner.cleanup()
+
+        asyncio.run_coroutine_threadsafe(stop(), self._loop).result(10)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(10)
+
+    def request(self, method, path, *, data=None, query=None, headers=None):
+        query = list(query or [])
+        headers = dict(headers or {})
+        headers["host"] = f"127.0.0.1:{self.port}"
+        signed = sigv4.sign_request(
+            method, urllib.parse.quote(path), query, headers,
+            data if data is not None else b"", self.ak, self.sk)
+        qs = "&".join(
+            f"{urllib.parse.quote(k, safe='')}={urllib.parse.quote(v, safe='')}"
+            for k, v in query)
+        url = urllib.parse.quote(path) + ("?" + qs if qs else "")
+        conn = http.client.HTTPConnection("127.0.0.1", self.port, timeout=30)
+        try:
+            conn.request(method, url, body=data, headers=signed)
+            r = conn.getresponse()
+            body = r.read()
+
+            class Resp:
+                pass
+
+            out = Resp()
+            out.status, out.headers, out.body = r.status, dict(
+                r.getheaders()), body
+            return out
+        finally:
+            conn.close()
+
+
+@pytest.fixture(scope="module")
+def gw(tmp_path_factory):
+    os.environ["MINIO_TPU_FSYNC"] = "0"
+    backend = S3TestServer(str(tmp_path_factory.mktemp("backend")))
+    gateway = GatewayServer(backend.host, backend.ak, backend.sk,
+                            str(tmp_path_factory.mktemp("gwmeta")))
+    yield gateway, backend
+    gateway.close()
+    backend.close()
+
+
+class TestGatewayE2E:
+    def test_bucket_and_object_round_trip(self, gw):
+        g, backend = gw
+        assert g.request("PUT", "/gwbkt").status == 200
+        # the bucket actually lives on the BACKEND
+        assert backend.request("HEAD", "/gwbkt").status == 200
+
+        data = os.urandom(300_000)
+        r = g.request("PUT", "/gwbkt/obj.bin", data=data,
+                      headers={"x-amz-meta-color": "teal"})
+        assert r.status == 200
+        # object readable via gateway AND directly on the backend
+        r = g.request("GET", "/gwbkt/obj.bin")
+        assert r.status == 200 and r.body == data
+        assert r.headers.get("x-amz-meta-color") == "teal"
+        assert backend.request("GET", "/gwbkt/obj.bin").body == data
+
+        h = g.request("HEAD", "/gwbkt/obj.bin")
+        assert int(h.headers["Content-Length"]) == len(data)
+
+        r = g.request("GET", "/gwbkt/obj.bin",
+                      headers={"Range": "bytes=100-199"})
+        assert r.status == 206 and r.body == data[100:200]
+
+    def test_listing_through_gateway(self, gw):
+        g, _ = gw
+        g.request("PUT", "/gwlist")
+        for i in range(5):
+            g.request("PUT", f"/gwlist/dir/k{i}", data=b"x")
+        g.request("PUT", "/gwlist/top", data=b"y")
+        r = g.request("GET", "/gwlist", query=[("list-type", "2")])
+        assert r.status == 200
+        body = r.body.decode()
+        assert body.count("<Key>") == 6
+        # delimiter rolls up the dir
+        r = g.request("GET", "/gwlist", query=[("list-type", "2"),
+                                               ("delimiter", "/")])
+        body = r.body.decode()
+        assert "<Prefix>dir/</Prefix>" in body
+        assert "<Key>top</Key>" in body
+
+    def test_delete_via_gateway(self, gw):
+        g, backend = gw
+        g.request("PUT", "/gwdel")
+        g.request("PUT", "/gwdel/a", data=b"1")
+        assert g.request("DELETE", "/gwdel/a").status == 204
+        assert backend.request("GET", "/gwdel/a").status == 404
+        # bulk
+        for i in range(3):
+            g.request("PUT", f"/gwdel/b{i}", data=b"1")
+        body = ("<Delete>" + "".join(
+            f"<Object><Key>b{i}</Key></Object>" for i in range(3))
+            + "</Delete>").encode()
+        r = g.request("POST", "/gwdel", query=[("delete", "")], data=body)
+        assert r.status == 200 and r.body.count(b"<Deleted>") == 3
+
+    def test_multipart_through_gateway(self, gw):
+        g, backend = gw
+        g.request("PUT", "/gwmp")
+        r = g.request("POST", "/gwmp/big", query=[("uploads", "")])
+        uid = r.body.decode().split("<UploadId>")[1].split("</UploadId>")[0]
+        part = os.urandom(5 << 20)
+        r = g.request("PUT", "/gwmp/big",
+                      query=[("partNumber", "1"), ("uploadId", uid)],
+                      data=part)
+        assert r.status == 200
+        etag = r.headers["ETag"].strip('"')
+        done = (f'<CompleteMultipartUpload><Part><PartNumber>1</PartNumber>'
+                f'<ETag>"{etag}"</ETag></Part>'
+                f'</CompleteMultipartUpload>').encode()
+        r = g.request("POST", "/gwmp/big", query=[("uploadId", uid)],
+                      data=done)
+        assert r.status == 200
+        assert backend.request("GET", "/gwmp/big").body == part
+
+    def test_gateway_iam_is_local(self, gw):
+        g, backend = gw
+        # gateway admin plane works against its LOCAL metadata store
+        r = g.request("PUT", "/minio/admin/v3/add-user",
+                      query=[("accessKey", "gwuser")],
+                      data=json.dumps(
+                          {"secretKey": "gwusersecret"}).encode())
+        assert r.status == 200, r.body
+        # backend knows nothing about this user
+        r = backend.request("GET", "/", creds=("gwuser", "gwusersecret"))
+        assert r.status == 403
+
+    def test_tagging_passthrough(self, gw):
+        g, _ = gw
+        g.request("PUT", "/gwtag")
+        g.request("PUT", "/gwtag/o", data=b"z")
+        tags = ("<Tagging><TagSet><Tag><Key>env</Key><Value>prod</Value>"
+                "</Tag></TagSet></Tagging>").encode()
+        assert g.request("PUT", "/gwtag/o", query=[("tagging", "")],
+                         data=tags).status == 200
+        r = g.request("GET", "/gwtag/o", query=[("tagging", "")])
+        assert r.status == 200 and b"<Value>prod</Value>" in r.body
+
+    def test_missing_object_404(self, gw):
+        g, _ = gw
+        assert g.request("GET", "/gwbkt/never-was").status == 404
+        assert g.request("GET", "/never-bucket-xyz/obj").status == 404
