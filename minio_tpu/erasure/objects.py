@@ -745,10 +745,10 @@ class ErasureObjects:
             errs = self._fan_out(del_version, range(len(self.disks)))
             ok = sum(1 for e2 in errs
                      if e2 is None or isinstance(e2, errors.FileNotFound))
-            _, wq = self._quorum_from([None] * len(self.disks))
-            if ok < wq:
-                # fewer than write-quorum drives acknowledged: surviving
-                # copies could still satisfy a read -> fail loudly
+            # deletes use MAJORITY quorum regardless of the version's
+            # parity (reference DeleteObject writeQuorum = n/2+1) — the
+            # object's own parity is unknown without an extra read
+            if ok < len(self.disks) // 2 + 1:
                 raise errors.ErasureWriteQuorum("delete quorum not met")
             if tier_meta is not None:
                 self.tier_delete_hook(tier_meta)
@@ -820,7 +820,7 @@ class ErasureObjects:
 
                 drive_errs = self._fan_out(run, range(len(self.disks)))
                 n = len(self.disks)
-                _, wq = self._quorum_from([None] * n)
+                wq = n // 2 + 1  # majority, like single-object deletes
                 for pos, (j, obj, fi, _) in enumerate(items):
                     # success = the delete took effect on a WRITE QUORUM
                     # of drives (already-absent counts as deleted), else

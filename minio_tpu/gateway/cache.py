@@ -1,0 +1,265 @@
+"""Disk cache: local read cache wrapped around any object layer.
+
+Reference: cmd/disk-cache.go + cmd/disk-cache-backend.go (cacheObjects
+wrapping the ObjectLayer — GETs tee through local SSD cache dirs with
+ETag validation, LRU eviction between low/high watermarks, write paths
+invalidating).  Primarily used in gateway mode, where the backend is a
+remote service and a local cache saves WAN round trips.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Iterator
+
+from minio_tpu.utils.logger import log
+
+# eviction watermarks, percent of max_size (reference cache watermarks)
+LOW_WATERMARK = 0.7
+HIGH_WATERMARK = 0.9
+
+
+class _Entry:
+    __slots__ = ("etag", "size", "atime")
+
+    def __init__(self, etag: str, size: int, atime: float):
+        self.etag = etag
+        self.size = size
+        self.atime = atime
+
+
+class CacheLayer:
+    """Transparent read-through cache.
+
+    Delegates EVERYTHING to `inner`; only GETs consult/populate the
+    cache, keyed by (bucket, object) and validated by ETag.  Writes and
+    deletes invalidate.  Total cache bytes stay under `max_size` via
+    LRU eviction to the low watermark once past the high watermark.
+    """
+
+    def __init__(self, inner, cache_dir: str, max_size: int = 10 << 30):
+        self.inner = inner
+        self.dir = cache_dir
+        self.max_size = max_size
+        self.hits = 0
+        self.misses = 0
+        self._mu = threading.Lock()
+        self._entries: dict[str, _Entry] = {}
+        self._filling: set[str] = set()  # in-flight fill dedup
+        self._total = 0
+        os.makedirs(cache_dir, exist_ok=True)
+        self._load_index()
+
+    # -- delegation ----------------------------------------------------------
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    # -- index ---------------------------------------------------------------
+    def _key(self, bucket: str, obj: str) -> str:
+        return hashlib.sha256(f"{bucket}/{obj}".encode()).hexdigest()
+
+    def _data_path(self, key: str) -> str:
+        return os.path.join(self.dir, key[:2], key + ".data")
+
+    def _meta_path(self, key: str) -> str:
+        return os.path.join(self.dir, key[:2], key + ".json")
+
+    def _load_index(self) -> None:
+        for root, _, files in os.walk(self.dir):
+            for f in files:
+                if not f.endswith(".json"):
+                    continue
+                try:
+                    doc = json.loads(
+                        open(os.path.join(root, f),
+                             encoding="utf-8").read())
+                    key = f[:-5]
+                    dp = self._data_path(key)
+                    size = os.path.getsize(dp)
+                    self._entries[key] = _Entry(
+                        doc["etag"], size, os.path.getatime(dp))
+                    self._total += size
+                except (OSError, ValueError, KeyError):
+                    continue
+
+    # -- read path -----------------------------------------------------------
+    def get_object(self, bucket: str, obj: str, offset: int = 0,
+                   length: int = -1, version_id: str = ""):
+        if version_id:
+            # versioned reads bypass the cache (cache is latest-only,
+            # like the reference)
+            return self.inner.get_object(bucket, obj, offset, length,
+                                         version_id)
+        oi = self.inner.get_object_info(bucket, obj)
+        key = self._key(bucket, obj)
+        with self._mu:
+            ent = self._entries.get(key)
+        if ent is not None and ent.etag == oi.etag:
+            try:
+                stream = self._read_cached(key, offset, length)
+                self.hits += 1
+                return oi, stream
+            except OSError:
+                self._evict_one(key)
+        self.misses += 1
+        if offset == 0 and length < 0:
+            # full-object miss: tee the backend stream into the cache
+            _, stream = self.inner.get_object(bucket, obj, 0, -1)
+            return oi, self._tee(key, oi, stream)
+        # ranged miss: serve the range directly, fill the cache in the
+        # background so the next reader hits (deduped: one fill per key)
+        _, stream = self.inner.get_object(bucket, obj, offset, length)
+        with self._mu:
+            start_fill = key not in self._filling
+            if start_fill:
+                self._filling.add(key)
+        if start_fill:
+            threading.Thread(target=self._fill,
+                             args=(bucket, obj, key, oi),
+                             daemon=True).start()
+        return oi, stream
+
+    def _read_cached(self, key: str, offset: int,
+                     length: int) -> Iterator[bytes]:
+        f = open(self._data_path(key), "rb")
+
+        def chunks():
+            try:
+                f.seek(offset)
+                remaining = length if length >= 0 else None
+                while True:
+                    n = 1 << 20 if remaining is None \
+                        else min(1 << 20, remaining)
+                    if n <= 0:
+                        break
+                    data = f.read(n)
+                    if not data:
+                        break
+                    if remaining is not None:
+                        remaining -= len(data)
+                    yield data
+            finally:
+                f.close()
+
+        with self._mu:
+            ent = self._entries.get(key)
+            if ent is not None:
+                ent.atime = time.time()
+        return chunks()
+
+    def _tee(self, key: str, oi, stream) -> Iterator[bytes]:
+        import uuid
+
+        dp = self._data_path(key)
+        os.makedirs(os.path.dirname(dp), exist_ok=True)
+        # unique per writer: concurrent fills of the same key must never
+        # interleave into one file (os.replace keeps commits atomic)
+        tmp = dp + f".tmp.{uuid.uuid4().hex[:8]}"
+        try:
+            f = open(tmp, "wb")
+        except OSError:
+            yield from stream
+            return
+        ok = True
+        try:
+            for chunk in stream:
+                try:
+                    f.write(chunk)
+                except OSError:
+                    ok = False
+                yield chunk
+        except BaseException:
+            ok = False
+            raise
+        finally:
+            f.close()
+            if ok:
+                self._commit(key, oi, tmp, dp)
+            else:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+
+    def _fill(self, bucket: str, obj: str, key: str, oi) -> None:
+        try:
+            _, stream = self.inner.get_object(bucket, obj, 0, -1)
+            for _ in self._tee(key, oi, stream):
+                pass
+        except Exception:
+            pass
+        finally:
+            with self._mu:
+                self._filling.discard(key)
+
+    def _commit(self, key: str, oi, tmp: str, dp: str) -> None:
+        try:
+            size = os.path.getsize(tmp)
+            if size > self.max_size:
+                os.remove(tmp)
+                return
+            os.replace(tmp, dp)
+            with open(self._meta_path(key), "w", encoding="utf-8") as m:
+                json.dump({"etag": oi.etag, "size": size}, m)
+            with self._mu:
+                old = self._entries.get(key)
+                if old is not None:
+                    self._total -= old.size
+                self._entries[key] = _Entry(oi.etag, size, time.time())
+                self._total += size
+            self._maybe_evict()
+        except OSError:
+            pass
+
+    # -- invalidation --------------------------------------------------------
+    def _evict_one(self, key: str) -> None:
+        with self._mu:
+            ent = self._entries.pop(key, None)
+            if ent is not None:
+                self._total -= ent.size
+        for p in (self._data_path(key), self._meta_path(key)):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+    def _maybe_evict(self) -> None:
+        with self._mu:
+            if self._total <= self.max_size * HIGH_WATERMARK:
+                return
+            victims = sorted(self._entries.items(),
+                             key=lambda kv: kv[1].atime)
+        target = self.max_size * LOW_WATERMARK
+        for key, _ in victims:
+            with self._mu:
+                if self._total <= target:
+                    return
+            self._evict_one(key)
+            log.debug("cache evicted", key=key)
+
+    def put_object(self, bucket: str, obj: str, *a, **kw):
+        self._evict_one(self._key(bucket, obj))
+        return self.inner.put_object(bucket, obj, *a, **kw)
+
+    def delete_object(self, bucket: str, obj: str, *a, **kw):
+        self._evict_one(self._key(bucket, obj))
+        return self.inner.delete_object(bucket, obj, *a, **kw)
+
+    def delete_objects(self, bucket: str, dels: list, *a, **kw):
+        for d in dels:
+            self._evict_one(self._key(bucket, d.get("obj", "")))
+        return self.inner.delete_objects(bucket, dels, *a, **kw)
+
+    def complete_multipart_upload(self, bucket: str, obj: str, *a, **kw):
+        self._evict_one(self._key(bucket, obj))
+        return self.inner.complete_multipart_upload(bucket, obj, *a, **kw)
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {"hits": self.hits, "misses": self.misses,
+                    "entries": len(self._entries), "bytes": self._total,
+                    "maxBytes": self.max_size}

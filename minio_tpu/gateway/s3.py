@@ -25,6 +25,43 @@ from minio_tpu.utils.s3client import S3Client, S3ClientError
 
 _NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
 
+# internal metadata (SSE envelopes, compression markers) survives the
+# remote round trip as namespaced user metadata — dropping it would turn
+# SSE/compressed uploads into unreadable ciphertext/frames on GET
+_INTERNAL_PFX = "x-minio-internal-"
+_WIRE_PFX = "x-amz-meta-mtpu-int-"
+
+
+def _meta_to_wire(meta: dict) -> dict:
+    out = {}
+    for k, v in meta.items():
+        if k.startswith("x-amz-meta-"):
+            out[k] = v
+        elif k.startswith(_INTERNAL_PFX):
+            import base64
+
+            raw = v.encode() if isinstance(v, str) else bytes(v)
+            out[_WIRE_PFX + k[len(_INTERNAL_PFX):]] = \
+                base64.b64encode(raw).decode()
+    return out
+
+
+def _meta_from_wire(headers: dict) -> dict:
+    out = {}
+    for k, v in headers.items():
+        lk = k.lower()
+        if lk.startswith(_WIRE_PFX):
+            import base64
+
+            try:
+                out[_INTERNAL_PFX + lk[len(_WIRE_PFX):]] = \
+                    base64.b64decode(v).decode("utf-8")
+            except Exception:
+                continue
+        elif lk.startswith("x-amz-meta-"):
+            out[lk] = v
+    return out
+
 
 def _text(el, tag: str, default: str = "") -> str:
     t = el.findtext(f"{_NS}{tag}")
@@ -147,24 +184,37 @@ class S3Gateway:
         headers = {}
         if opts.content_type:
             headers["Content-Type"] = opts.content_type
-        for k, v in opts.user_metadata.items():
-            if k.startswith("x-amz-meta-"):
-                headers[k] = v
+        if opts.finalize_metadata is not None or any(
+                k.startswith(_INTERNAL_PFX) for k in opts.user_metadata):
+            # transforming wrappers (compression) only know their final
+            # metadata at EOF, but HTTP headers go first: buffer. SSE
+            # metadata is known upfront but the ciphertext length is too,
+            # so only finalize-style transforms pay this.
+            data = reader.read() if opts.finalize_metadata is not None \
+                else None
+            if data is not None:
+                size = len(data)
+                reader = io.BytesIO(data)
+        meta = dict(opts.user_metadata)
+        if opts.finalize_metadata is not None:
+            # the wrapper has been fully drained above
+            pass
         if size < 0:
             data = reader.read()
             body, length = data, len(data)
         else:
             body, length = _reader_chunks(reader, size), size
+        if opts.finalize_metadata is not None:
+            meta.update(opts.finalize_metadata() or {})
+        headers.update(_meta_to_wire(meta))
         try:
             rh = self.client.put_object(bucket, obj, body, headers=headers,
                                         length=length)
         except S3ClientError as e:
             raise _map_err(e, bucket, obj)
-        meta = dict(opts.user_metadata)
-        if opts.finalize_metadata is not None:
-            meta.update(opts.finalize_metadata() or {})
         return ObjectInfo(bucket=bucket, name=obj,
-                          etag=rh.get("etag", "").strip('"'),
+                          etag=meta.get("etag",
+                                        rh.get("etag", "").strip('"')),
                           size=size if size >= 0 else length,
                           metadata=meta)
 
@@ -180,7 +230,7 @@ class S3Gateway:
 
     @staticmethod
     def _oi_from_headers(bucket: str, obj: str, rh: dict) -> ObjectInfo:
-        meta = {k: v for k, v in rh.items() if k.startswith("x-amz-meta-")}
+        meta = _meta_from_wire(rh)
         return ObjectInfo(
             bucket=bucket, name=obj,
             version_id=rh.get("x-amz-version-id", ""),
@@ -193,23 +243,34 @@ class S3Gateway:
     def get_object(self, bucket: str, obj: str, offset: int = 0,
                    length: int = -1, version_id: str = ""
                    ) -> tuple[ObjectInfo, Iterator[bytes]]:
-        oi = self.get_object_info(bucket, obj, version_id)
+        if length == 0:
+            # empty read: no remote call, and no malformed bytes=0--1
+            return (self.get_object_info(bucket, obj, version_id),
+                    iter(()))
         headers = {}
         if offset or length >= 0:
             end = "" if length < 0 else str(offset + length - 1)
             headers["Range"] = f"bytes={offset}-{end}"
         try:
-            stream = self.client.get_object_stream(bucket, obj,
-                                                   headers=headers)
+            # ONE round trip: ObjectInfo comes from the GET response
+            # headers (a separate HEAD both costs a WAN RTT and races
+            # overwrites)
+            rh, stream = self.client.get_object_stream(
+                bucket, obj, headers=headers, with_headers=True)
         except S3ClientError as e:
             raise _map_err(e, bucket, obj)
+        oi = self._oi_from_headers(bucket, obj, rh)
+        cr = rh.get("content-range", "")
+        if "/" in cr:
+            try:
+                oi.size = int(cr.rsplit("/", 1)[1])
+            except ValueError:
+                pass
         return oi, stream
 
     def delete_object(self, bucket: str, obj: str, version_id: str = "",
                       versioned: bool = False,
                       suspended: bool = False) -> ObjectInfo:
-        if not self.bucket_exists(bucket):
-            raise errors.BucketNotFound(bucket)
         try:
             self.client.delete_object(bucket, obj, version_id)
         except S3ClientError as e:
@@ -329,8 +390,16 @@ class S3Gateway:
                     f"upload id {upload_id} not found")
             raise _map_err(e, bucket, obj)
         root = ET.fromstring(resp)
-        return ObjectInfo(bucket=bucket, name=obj,
-                          etag=_text(root, "ETag").strip('"'))
+        if root.tag.endswith("Error"):
+            # S3 CompleteMultipartUpload may return 200 with an Error body
+            raise errors.StorageError(
+                f"remote complete failed: {_text(root, 'Code')} "
+                f"{_text(root, 'Message')}")
+        etag = _text(root, "ETag").strip('"')
+        if not etag:
+            raise errors.StorageError(
+                "remote complete returned no ETag")
+        return ObjectInfo(bucket=bucket, name=obj, etag=etag)
 
     def abort_multipart_upload(self, bucket: str, obj: str,
                                upload_id: str) -> None:

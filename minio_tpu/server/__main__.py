@@ -49,6 +49,13 @@ def main(argv=None) -> int:
                     default=os.environ.get("MINIO_GATEWAY_ACCESS_KEY", ""))
     ap.add_argument("--gateway-secret-key",
                     default=os.environ.get("MINIO_GATEWAY_SECRET_KEY", ""))
+    ap.add_argument("--cache-dir",
+                    default=os.environ.get("MINIO_CACHE_DIR", ""),
+                    help="local read-cache directory (gateway mode)")
+    ap.add_argument("--cache-size", type=int,
+                    default=int(os.environ.get(
+                        "MINIO_CACHE_SIZE", str(10 << 30))),
+                    help="max cache bytes (default 10 GiB)")
     args = ap.parse_args(argv)
 
     from aiohttp import web
@@ -79,6 +86,11 @@ def main(argv=None) -> int:
             args.gateway_access_key or args.access_key,
             args.gateway_secret_key or args.secret_key,
             metadata_dir=args.gateway_metadata_dir, region=args.region)
+        if args.cache_dir:
+            from minio_tpu.gateway.cache import CacheLayer
+
+            layer = CacheLayer(layer, args.cache_dir,
+                               max_size=args.cache_size)
         app = make_app(layer, start_services=False,
                        access_key=args.access_key,
                        secret_key=args.secret_key, region=args.region)

@@ -371,10 +371,25 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                           resource=request.path)
 
         try:
-            if "X-Amz-Signature" in dict(query):
+            qd = dict(query)
+            auth_hdr = request.headers.get("Authorization", "")
+            if "X-Amz-Signature" in qd:
                 ctx = sigv4.verify_v4_presigned(
                     request.method, path, query, headers,
                     self.iam.get_secret, self.region,
+                )
+            elif "Signature" in qd and "AWSAccessKeyId" in qd:
+                # legacy V2 presigned (reference cmd/signature-v2.go)
+                ctx = sigv4.verify_v2_presigned(
+                    request.method, path, query, headers,
+                    self.iam.get_secret,
+                )
+            elif auth_hdr.startswith("AWS ") \
+                    and not auth_hdr.startswith("AWS4-"):
+                # legacy V2 header form
+                ctx = sigv4.verify_v2(
+                    request.method, path, query, headers,
+                    self.iam.get_secret,
                 )
             else:
                 ctx = sigv4.verify_v4(
@@ -393,8 +408,10 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
 
     @staticmethod
     def _is_anonymous(request: web.Request) -> bool:
+        q = request.rel_url.query
         return ("Authorization" not in request.headers
-                and "X-Amz-Signature" not in request.rel_url.query)
+                and "X-Amz-Signature" not in q
+                and not ("Signature" in q and "AWSAccessKeyId" in q))
 
     @staticmethod
     def _request_conditions(request: web.Request) -> dict:

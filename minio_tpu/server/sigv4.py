@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import hashlib
 import hmac
+import time
 import urllib.parse
 from datetime import datetime, timezone
 
@@ -278,3 +279,131 @@ def sign_policy(secret: str, date: str, region: str, service: str,
 
 def hmac_equal(a: str, b: str) -> bool:
     return hmac.compare_digest(a, b)
+
+
+# --------------------------------------------------------------- SigV2
+# (reference cmd/signature-v2.go — legacy AWS Signature Version 2:
+# HMAC-SHA1 over a canonical string; header form `AWS key:sig` and
+# presigned form ?AWSAccessKeyId=&Expires=&Signature=)
+
+# query params that are part of the V2 canonical resource, sorted
+# (reference resourceList, cmd/signature-v2.go:43)
+V2_SUBRESOURCES = sorted([
+    "acl", "delete", "lifecycle", "location", "logging", "notification",
+    "partNumber", "policy", "requestPayment", "response-cache-control",
+    "response-content-disposition", "response-content-encoding",
+    "response-content-language", "response-content-type",
+    "response-expires", "select", "select-type", "tagging", "torrent",
+    "uploadId", "uploads", "versionId", "versioning", "versions",
+    "website", "replication", "encryption", "object-lock", "retention",
+    "legal-hold", "quota",
+])
+
+
+def _v2_canonical_resource(path: str, query: list[tuple[str, str]]) -> str:
+    parts = []
+    qd = dict(query)
+    for sub in V2_SUBRESOURCES:
+        if sub in qd:
+            v = qd[sub]
+            parts.append(f"{sub}={v}" if v else sub)
+    res = path
+    if parts:
+        res += "?" + "&".join(parts)
+    return res
+
+
+def _v2_string_to_sign(method: str, path: str,
+                       query: list[tuple[str, str]],
+                       headers: dict[str, str], expires: str = "") -> str:
+    h = {k.lower(): v for k, v in headers.items()}
+    amz = sorted(
+        (k, v.strip()) for k, v in h.items() if k.startswith("x-amz-"))
+    canon_amz = "".join(f"{k}:{v}\n" for k, v in amz)
+    date = expires if expires else h.get("date", "")
+    if not expires and "x-amz-date" in h:
+        date = ""  # x-amz-date supersedes Date in the canonical headers
+    return (f"{method}\n{h.get('content-md5', '')}\n"
+            f"{h.get('content-type', '')}\n{date}\n{canon_amz}"
+            f"{_v2_canonical_resource(path, query)}")
+
+
+def _v2_signature(secret: str, sts: str) -> str:
+    import base64
+
+    return base64.b64encode(
+        hmac.new(secret.encode(), sts.encode(), hashlib.sha1).digest()
+    ).decode()
+
+
+def verify_v2(method: str, path: str, query: list[tuple[str, str]],
+              headers: dict[str, str], get_secret) -> "V4Context":
+    """Authorization: AWS <access>:<signature>  (header form)."""
+    auth = {k.lower(): v for k, v in headers.items()}.get(
+        "authorization", "")
+    if not auth.startswith("AWS ") or ":" not in auth[4:]:
+        raise SigV4Error("InvalidArgument", "malformed V2 authorization")
+    access, _, sig = auth[4:].partition(":")
+    secret = get_secret(access)
+    if secret is None:
+        raise SigV4Error("InvalidAccessKeyId",
+                         f"unknown access key {access!r}")
+    want = _v2_signature(secret, _v2_string_to_sign(
+        method, path, query, headers))
+    if not hmac.compare_digest(want, sig.strip()):
+        raise SigV4Error("SignatureDoesNotMatch", "V2 signature mismatch")
+    return V4Context(access, b"", "", "", "")
+
+
+def verify_v2_presigned(method: str, path: str,
+                        query: list[tuple[str, str]],
+                        headers: dict[str, str], get_secret) -> "V4Context":
+    """?AWSAccessKeyId=&Expires=&Signature= (presigned form)."""
+    qd = dict(query)
+    access = qd.get("AWSAccessKeyId", "")
+    expires = qd.get("Expires", "")
+    sig = qd.get("Signature", "")
+    if not access or not expires or not sig:
+        raise SigV4Error("InvalidArgument",
+                         "incomplete V2 presigned query")
+    try:
+        if int(expires) < time.time():
+            raise SigV4Error("ExpiredPresignRequest",
+                             "presigned URL has expired")
+    except ValueError:
+        raise SigV4Error("MalformedExpires", "Expires must be an integer")
+    secret = get_secret(access)
+    if secret is None:
+        raise SigV4Error("InvalidAccessKeyId",
+                         f"unknown access key {access!r}")
+    canon_q = [(k, v) for k, v in query
+               if k not in ("AWSAccessKeyId", "Expires", "Signature")]
+    want = _v2_signature(secret, _v2_string_to_sign(
+        method, path, canon_q, headers, expires=expires))
+    if not hmac.compare_digest(want, sig):
+        raise SigV4Error("SignatureDoesNotMatch", "V2 signature mismatch")
+    return V4Context(access, b"", "", "", "")
+
+
+def sign_v2(method: str, path: str, query: list[tuple[str, str]],
+            headers: dict[str, str], access_key: str,
+            secret_key: str) -> dict[str, str]:
+    """Client-side V2 signer (tests + old SDK compat)."""
+    import email.utils
+
+    headers = {k.lower(): v for k, v in headers.items()}
+    headers.setdefault("date", email.utils.formatdate(usegmt=True))
+    sig = _v2_signature(secret_key, _v2_string_to_sign(
+        method, path, query, headers))
+    headers["authorization"] = f"AWS {access_key}:{sig}"
+    return headers
+
+
+def presign_v2(method: str, path: str, query: list[tuple[str, str]],
+               access_key: str, secret_key: str,
+               expires_in: int = 600) -> list[tuple[str, str]]:
+    exp = str(int(time.time()) + expires_in)
+    sig = _v2_signature(secret_key, _v2_string_to_sign(
+        method, path, query, {}, expires=exp))
+    return list(query) + [("AWSAccessKeyId", access_key),
+                          ("Expires", exp), ("Signature", sig)]

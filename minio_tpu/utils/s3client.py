@@ -104,10 +104,12 @@ class S3Client:
 
     def get_object_stream(self, bucket: str, key: str,
                           headers: dict | None = None,
-                          ok: tuple = (200, 206)):
+                          ok: tuple = (200, 206),
+                          with_headers: bool = False):
         """Chunked GET: returns an iterator of body chunks (the
         connection closes when the iterator is exhausted or closed) —
-        large objects never materialize in memory."""
+        large objects never materialize in memory.  with_headers=True
+        returns (response_headers, iterator)."""
         path = f"/{bucket}/{key}"
         quoted = urllib.parse.quote(path)
         headers = dict(headers or {})
@@ -132,6 +134,9 @@ class S3Client:
             finally:
                 conn.close()
 
+        if with_headers:
+            rh = {k.lower(): v for k, v in resp.getheaders()}
+            return rh, chunks()
         return chunks()
 
     def head_object(self, bucket: str, key: str) -> dict:

@@ -206,3 +206,162 @@ class TestGatewayE2E:
         g, _ = gw
         assert g.request("GET", "/gwbkt/never-was").status == 404
         assert g.request("GET", "/never-bucket-xyz/obj").status == 404
+
+
+class TestDiskCache:
+    def _layer(self, tmp_path, backend, max_size=10 << 30):
+        from minio_tpu.gateway.cache import CacheLayer
+
+        inner = S3Gateway(backend.host, backend.ak, backend.sk,
+                          metadata_dir=str(tmp_path / "meta"))
+        return CacheLayer(inner, str(tmp_path / "cache"),
+                          max_size=max_size)
+
+    def test_hit_after_miss(self, tmp_path):
+        os.environ["MINIO_TPU_FSYNC"] = "0"
+        backend = S3TestServer(str(tmp_path / "be"))
+        try:
+            backend.request("PUT", "/cbkt")
+            data = os.urandom(100_000)
+            backend.request("PUT", "/cbkt/o", data=data)
+            layer = self._layer(tmp_path, backend)
+            _, s = layer.get_object("cbkt", "o")
+            assert b"".join(s) == data
+            assert layer.misses == 1 and layer.hits == 0
+            _, s = layer.get_object("cbkt", "o")
+            assert b"".join(s) == data
+            assert layer.hits == 1
+            # ranged read served from cache too
+            _, s = layer.get_object("cbkt", "o", 10, 20)
+            assert b"".join(s) == data[10:30]
+            assert layer.hits == 2
+        finally:
+            backend.close()
+
+    def test_etag_invalidation(self, tmp_path):
+        os.environ["MINIO_TPU_FSYNC"] = "0"
+        backend = S3TestServer(str(tmp_path / "be"))
+        try:
+            backend.request("PUT", "/cbkt2")
+            backend.request("PUT", "/cbkt2/o", data=b"version-one")
+            layer = self._layer(tmp_path, backend)
+            _, s = layer.get_object("cbkt2", "o")
+            b"".join(s)
+            # out-of-band change on the backend: stale etag must MISS
+            backend.request("PUT", "/cbkt2/o", data=b"version-two!")
+            _, s = layer.get_object("cbkt2", "o")
+            assert b"".join(s) == b"version-two!"
+            assert layer.misses == 2
+        finally:
+            backend.close()
+
+    def test_write_invalidates(self, tmp_path):
+        import io
+
+        from minio_tpu.erasure.objects import PutObjectOptions
+
+        os.environ["MINIO_TPU_FSYNC"] = "0"
+        backend = S3TestServer(str(tmp_path / "be"))
+        try:
+            backend.request("PUT", "/cbkt3")
+            backend.request("PUT", "/cbkt3/o", data=b"aaa")
+            layer = self._layer(tmp_path, backend)
+            _, s = layer.get_object("cbkt3", "o")
+            b"".join(s)
+            layer.put_object("cbkt3", "o", io.BytesIO(b"bbb"), 3,
+                             PutObjectOptions())
+            _, s = layer.get_object("cbkt3", "o")
+            assert b"".join(s) == b"bbb"
+        finally:
+            backend.close()
+
+    def test_lru_eviction(self, tmp_path):
+        os.environ["MINIO_TPU_FSYNC"] = "0"
+        backend = S3TestServer(str(tmp_path / "be"))
+        try:
+            backend.request("PUT", "/cbkt4")
+            for i in range(6):
+                backend.request("PUT", f"/cbkt4/k{i}", data=bytes(10_000))
+            # max 35 KB: high watermark 31.5K -> keeps ~2 after eviction
+            layer = self._layer(tmp_path, backend, max_size=35_000)
+            import time as _t
+
+            for i in range(6):
+                _, s = layer.get_object("cbkt4", f"k{i}")
+                b"".join(s)
+                _t.sleep(0.01)
+            st = layer.stats()
+            assert st["bytes"] <= 35_000
+            assert st["entries"] < 6
+        finally:
+            backend.close()
+
+    def test_index_survives_restart(self, tmp_path):
+        os.environ["MINIO_TPU_FSYNC"] = "0"
+        backend = S3TestServer(str(tmp_path / "be"))
+        try:
+            backend.request("PUT", "/cbkt5")
+            backend.request("PUT", "/cbkt5/o", data=b"persist me")
+            layer = self._layer(tmp_path, backend)
+            _, s = layer.get_object("cbkt5", "o")
+            b"".join(s)
+            # fresh CacheLayer over the same dir: index reloads -> hit
+            layer2 = self._layer(tmp_path, backend)
+            _, s = layer2.get_object("cbkt5", "o")
+            assert b"".join(s) == b"persist me"
+            assert layer2.hits == 1
+        finally:
+            backend.close()
+
+
+class TestGatewayTransforms:
+    """SSE and compression through the gateway: internal metadata must
+    round-trip via namespaced remote headers (review regression: it was
+    dropped, serving ciphertext/frames as plaintext)."""
+
+    def test_sse_through_gateway(self, gw):
+        g, backend = gw
+        g.request("PUT", "/gwsse")
+        data = os.urandom(50_000)
+        r = g.request("PUT", "/gwsse/enc.bin", data=data,
+                      headers={"x-amz-server-side-encryption": "AES256"})
+        assert r.status == 200, r.body
+        # gateway serves the plaintext back
+        r = g.request("GET", "/gwsse/enc.bin")
+        assert r.status == 200 and r.body == data
+        # the BACKEND holds ciphertext, not the plaintext
+        r = backend.request("GET", "/gwsse/enc.bin")
+        assert r.status == 200 and r.body != data
+
+    def test_compression_through_gateway(self, gw):
+        g, backend = gw
+        # enable compression on the GATEWAY (its own config store)
+        r = g.request("PUT", "/minio/admin/v3/set-config-kv",
+                      data=json.dumps({"subsys": "compression",
+                                       "kv": {"enable": "on"}}).encode())
+        assert r.status == 200
+        try:
+            g.request("PUT", "/gwcz")
+            data = b"squeeze me " * 20000
+            import hashlib
+
+            r = g.request("PUT", "/gwcz/c.txt", data=data)
+            assert r.status == 200
+            assert r.headers["ETag"].strip('"') == \
+                hashlib.md5(data).hexdigest()
+            r = g.request("GET", "/gwcz/c.txt")
+            assert r.status == 200 and r.body == data
+            assert int(r.headers["Content-Length"]) == len(data)
+            # backend stores the much-smaller frames
+            r = backend.request("GET", "/gwcz/c.txt")
+            assert len(r.body) < len(data) // 4
+        finally:
+            g.request("DELETE", "/minio/admin/v3/del-config-kv",
+                      query=[("subsys", "compression")])
+
+    def test_empty_object_get(self, gw):
+        g, _ = gw
+        g.request("PUT", "/gwsse")
+        assert g.request("PUT", "/gwsse/empty", data=b"").status == 200
+        r = g.request("GET", "/gwsse/empty")
+        assert r.status == 200 and r.body == b""

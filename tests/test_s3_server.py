@@ -285,3 +285,57 @@ class TestVersioning:
         assert srv.request("GET", "/vbk/doc").status == 404
         assert srv.request("GET", "/vbk/doc",
                            query=[("versionId", v2)]).body == b"v2"
+
+
+class TestSigV2:
+    """Legacy AWS Signature V2 (reference cmd/signature-v2.go)."""
+
+    def test_v2_header_auth(self, srv):
+        from minio_tpu.server import sigv4 as sv
+
+        srv.request("PUT", "/v2bkt")
+        srv.request("PUT", "/v2bkt/doc", data=b"v2 payload")
+        h = sv.sign_v2("GET", "/v2bkt/doc", [], {"host": srv.host},
+                       srv.ak, srv.sk)
+        r = srv.raw_request("GET", "/v2bkt/doc", headers=h)
+        assert r.status == 200 and r.body == b"v2 payload"
+
+    def test_v2_bad_signature(self, srv):
+        from minio_tpu.server import sigv4 as sv
+
+        h = sv.sign_v2("GET", "/v2bkt/doc", [], {"host": srv.host},
+                       srv.ak, "wrong-secret")
+        r = srv.raw_request("GET", "/v2bkt/doc", headers=h)
+        assert r.status == 403
+
+    def test_v2_presigned(self, srv):
+        import urllib.parse
+
+        from minio_tpu.server import sigv4 as sv
+
+        srv.request("PUT", "/v2bkt/pre", data=b"presigned v2")
+        q = sv.presign_v2("GET", "/v2bkt/pre", [], srv.ak, srv.sk)
+        qs = "&".join(f"{k}={urllib.parse.quote(v, safe='')}"
+                      for k, v in q)
+        r = srv.raw_request("GET", f"/v2bkt/pre?{qs}")
+        assert r.status == 200 and r.body == b"presigned v2"
+
+    def test_v2_presigned_expired(self, srv):
+        import urllib.parse
+
+        from minio_tpu.server import sigv4 as sv
+
+        q = sv.presign_v2("GET", "/v2bkt/pre", [], srv.ak, srv.sk,
+                          expires_in=-10)
+        qs = "&".join(f"{k}={urllib.parse.quote(v, safe='')}"
+                      for k, v in q)
+        r = srv.raw_request("GET", f"/v2bkt/pre?{qs}")
+        assert r.status == 403
+
+    def test_v2_subresource_signing(self, srv):
+        from minio_tpu.server import sigv4 as sv
+
+        h = sv.sign_v2("GET", "/v2bkt", [("versioning", "")],
+                       {"host": srv.host}, srv.ak, srv.sk)
+        r = srv.raw_request("GET", "/v2bkt?versioning=", headers=h)
+        assert r.status == 200
