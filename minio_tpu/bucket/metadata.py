@@ -47,6 +47,8 @@ class BucketMetadataSys:
         # config mutation, so other nodes invalidate their caches
         # (reference globalNotificationSys.LoadBucketMetadata)
         self.on_change = None
+        # site-replication hook set by SiteReplicationSys (fn(bucket))
+        self.on_site_change = None
         self.ttl = 5.0  # seconds; single-node writes invalidate eagerly
 
     # ------------------------------------------------------------- raw doc
@@ -75,6 +77,11 @@ class BucketMetadataSys:
                 self.on_change(bucket)
             except Exception:
                 pass  # peers converge via TTL
+        if self.on_site_change is not None:
+            try:
+                self.on_site_change(bucket)
+            except Exception:
+                pass  # pushes retry from the site worker queue
 
     def set_config(self, bucket: str, key: str, value) -> None:
         if not self.api.bucket_exists(bucket):

@@ -50,6 +50,9 @@ class CacheLayer:
         self._mu = threading.Lock()
         self._entries: dict[str, _Entry] = {}
         self._filling: set[str] = set()  # in-flight fill dedup
+        # bound TOTAL concurrent background fills (ranged-miss scans over
+        # many cold objects must not spawn unbounded WAN downloads)
+        self._fill_slots = threading.Semaphore(4)
         self._total = 0
         os.makedirs(cache_dir, exist_ok=True)
         self._load_index()
@@ -116,7 +119,9 @@ class CacheLayer:
         with self._mu:
             start_fill = key not in self._filling
             if start_fill:
-                self._filling.add(key)
+                start_fill = self._fill_slots.acquire(blocking=False)
+                if start_fill:
+                    self._filling.add(key)
         if start_fill:
             threading.Thread(target=self._fill,
                              args=(bucket, obj, key, oi),
@@ -195,6 +200,7 @@ class CacheLayer:
         finally:
             with self._mu:
                 self._filling.discard(key)
+            self._fill_slots.release()
 
     def _commit(self, key: str, oi, tmp: str, dp: str) -> None:
         try:

@@ -35,7 +35,11 @@ _WIRE_PFX = "x-amz-meta-mtpu-int-"
 def _meta_to_wire(meta: dict) -> dict:
     out = {}
     for k, v in meta.items():
-        if k.startswith("x-amz-meta-"):
+        if k == "etag":
+            # transformed uploads (compression) carry the ORIGINAL-bytes
+            # ETag in metadata; the remote's etag is of the frames
+            out[_WIRE_PFX + "etag"] = str(v)
+        elif k.startswith("x-amz-meta-"):
             out[k] = v
         elif k.startswith(_INTERNAL_PFX):
             import base64
@@ -184,21 +188,13 @@ class S3Gateway:
         headers = {}
         if opts.content_type:
             headers["Content-Type"] = opts.content_type
-        if opts.finalize_metadata is not None or any(
-                k.startswith(_INTERNAL_PFX) for k in opts.user_metadata):
-            # transforming wrappers (compression) only know their final
-            # metadata at EOF, but HTTP headers go first: buffer. SSE
-            # metadata is known upfront but the ciphertext length is too,
-            # so only finalize-style transforms pay this.
-            data = reader.read() if opts.finalize_metadata is not None \
-                else None
-            if data is not None:
-                size = len(data)
-                reader = io.BytesIO(data)
-        meta = dict(opts.user_metadata)
         if opts.finalize_metadata is not None:
-            # the wrapper has been fully drained above
-            pass
+            # transforming wrappers (compression) only know their final
+            # metadata at EOF, but HTTP headers go first: buffer
+            data = reader.read()
+            size = len(data)
+            reader = io.BytesIO(data)
+        meta = dict(opts.user_metadata)
         if size < 0:
             data = reader.read()
             body, length = data, len(data)
@@ -231,11 +227,13 @@ class S3Gateway:
     @staticmethod
     def _oi_from_headers(bucket: str, obj: str, rh: dict) -> ObjectInfo:
         meta = _meta_from_wire(rh)
+        etag = meta.pop(_INTERNAL_PFX + "etag",
+                        rh.get("etag", "").strip('"'))
         return ObjectInfo(
             bucket=bucket, name=obj,
             version_id=rh.get("x-amz-version-id", ""),
             size=int(rh.get("content-length", "0") or 0),
-            etag=rh.get("etag", "").strip('"'),
+            etag=etag,
             content_type=rh.get("content-type", ""),
             mod_time=_parse_http_date(rh.get("last-modified", "")),
             metadata=meta)

@@ -128,6 +128,11 @@ class IAMSys:
                 self.on_change(kind, name)
             except Exception:
                 pass  # peers converge via lazy reload
+        if getattr(self, "on_site_change", None) is not None:
+            try:
+                self.on_site_change(kind, name)
+            except Exception:
+                pass
 
     # -- persistence --------------------------------------------------------
     def _load(self) -> None:

@@ -95,6 +95,17 @@ class AdminMixin:
         r.add_put(f"{p}/tier", wrap(self.admin_add_tier, "SetTier"))
         r.add_get(f"{p}/tier", wrap(self.admin_list_tiers, "ListTier"))
         r.add_delete(f"{p}/tier", wrap(self.admin_remove_tier, "SetTier"))
+        # site replication (reference cmd/site-replication.go admin
+        # endpoints: SiteReplicationAdd / Info / Remove + the internal
+        # apply channel pushes arrive on)
+        r.add_post(f"{p}/site-replication/add",
+                   wrap(self.admin_site_add, "SiteReplicationAdd"))
+        r.add_get(f"{p}/site-replication/info",
+                  wrap(self.admin_site_info, "SiteReplicationInfo"))
+        r.add_post(f"{p}/site-replication/remove",
+                   wrap(self.admin_site_remove, "SiteReplicationRemove"))
+        r.add_post(f"{p}/site-replication/apply",
+                   wrap(self.admin_site_apply, "SiteReplicationOperation"))
         # config KVS (reference cmd/admin-handlers-config-kv.go:
         # GetConfigKVHandler / SetConfigKVHandler / DelConfigKVHandler /
         # HelpConfigKVHandler)
@@ -121,6 +132,52 @@ class AdminMixin:
                     content_type="application/json",
                 )
         return handler
+
+    # ----------------------------------------------------- site replication
+    async def admin_site_add(self, request: web.Request, body: bytes):
+        from minio_tpu.services.site import SitePeer
+
+        try:
+            doc = json.loads(body)
+            peers = [SitePeer.from_dict(p) for p in doc["peers"]]
+        except (ValueError, KeyError, TypeError):
+            raise S3Error("InvalidArgument",
+                          'body must be {"peers": [{name, endpoint, '
+                          'accessKey, secretKey}, ...]}')
+        try:
+            await self._run(self.site.add_peers, peers)
+        except ValueError as e:
+            raise S3Error("InvalidArgument", str(e))
+        return self._json({"status": "success",
+                           "peers": [p.name for p in peers]})
+
+    async def admin_site_info(self, request: web.Request, body: bytes):
+        return self._json(self.site.info())
+
+    async def admin_site_remove(self, request: web.Request, body: bytes):
+        name = request.rel_url.query.get("name", "")
+        if not name:
+            raise S3Error("InvalidArgument", "name query param required")
+        try:
+            await self._run(self.site.remove_peer, name)
+        except KeyError:
+            raise S3Error("InvalidArgument", f"no such peer {name!r}")
+        return self._json({})
+
+    async def admin_site_apply(self, request: web.Request, body: bytes):
+        """Receiving end of peer pushes: applies with propagation
+        suppressed so mutations never loop between sites."""
+        try:
+            doc = json.loads(body)
+        except ValueError:
+            raise S3Error("InvalidArgument", "body must be JSON")
+        try:
+            await self._run(self.site.apply, doc)
+        except ValueError as e:
+            raise S3Error("InvalidArgument", str(e))
+        except Exception as e:
+            raise S3Error("InternalError", str(e))
+        return self._json({})
 
     # ----------------------------------------------------------- speedtest
     @staticmethod

@@ -229,6 +229,9 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             max_workers=max_concurrency + 4, thread_name_prefix="s3-api"
         )
         self.trace = PubSub()
+        from minio_tpu.services.site import SiteReplicationSys
+
+        self.site = SiteReplicationSys(object_layer, self.meta, self.iam)
         eq = _event_queue_dir(object_layer)
         log.init_audit(queue_dir=os.path.join(os.path.dirname(eq), "audit")
                        if eq else None)
@@ -716,6 +719,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         await self._auth(request, None, "s3:CreateBucket", bucket)
         await request.read()
         await self._run(self.api.make_bucket, bucket)
+        self.site.on_bucket_created(bucket)
         return web.Response(status=200, headers={"Location": f"/{bucket}"})
 
     async def head_bucket(self, request: web.Request) -> web.Response:
@@ -729,6 +733,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         bucket = self._bucket(request)
         await self._auth(request, None, "s3:DeleteBucket", bucket)
         await self._run(self.api.delete_bucket, bucket)
+        self.site.on_bucket_deleted(bucket)
         return web.Response(status=204)
 
     async def bucket_location(self, request: web.Request) -> web.Response:

@@ -1,0 +1,162 @@
+"""Site replication: IAM + bucket-config convergence across clusters.
+
+Reference: cmd/site-replication.go.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from tests.s3_harness import S3TestServer
+
+ADMIN = "/minio/admin/v3"
+
+
+def _wait(cond, timeout=10.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(0.1)
+    return False
+
+
+@pytest.fixture
+def sites(tmp_path):
+    os.environ["MINIO_TPU_FSYNC"] = "0"
+    a = S3TestServer(str(tmp_path / "a"))
+    b = S3TestServer(str(tmp_path / "b"))
+    # join B as a peer of A (A pushes to B with B's admin creds)
+    r = a.request("POST", f"{ADMIN}/site-replication/add",
+                  data=json.dumps({"peers": [{
+                      "name": "siteB", "endpoint": f"http://{b.host}",
+                      "accessKey": b.ak, "secretKey": b.sk}]}).encode())
+    assert r.status == 200, r.text()
+    yield a, b
+    a.server.site.close()
+    b.server.site.close()
+    a.close()
+    b.close()
+
+
+class TestSiteReplication:
+    def test_bucket_create_and_config_propagate(self, sites):
+        a, b = sites
+        assert a.request("PUT", "/srbkt").status == 200
+        assert _wait(lambda: b.request("HEAD", "/srbkt").status == 200)
+        # bucket config (policy) propagates
+        pol = json.dumps({
+            "Version": "2012-10-17",
+            "Statement": [{"Effect": "Allow", "Principal": {"AWS": ["*"]},
+                           "Action": ["s3:GetObject"],
+                           "Resource": ["arn:aws:s3:::srbkt/*"]}],
+        }).encode()
+        assert a.request("PUT", "/srbkt", query=[("policy", "")],
+                         data=pol).status == 204
+        assert _wait(lambda: b.request(
+            "GET", "/srbkt", query=[("policy", "")]).status == 200)
+        # anonymous read allowed on site B thanks to the replicated policy
+        a.request("PUT", "/srbkt/pub.txt", data=b"hello")
+        b.request("PUT", "/srbkt/pub-b.txt", data=b"hello")
+        r = b.raw_request("GET", "/srbkt/pub-b.txt")
+        assert r.status == 200
+
+    def test_iam_user_and_policy_propagate(self, sites):
+        a, b = sites
+        pol = json.dumps({
+            "Version": "2012-10-17",
+            "Statement": [{"Effect": "Allow", "Action": ["s3:*"],
+                           "Resource": ["arn:aws:s3:::*"]}],
+        })
+        a.server.iam.set_policy("sitepol", pol)
+        a.server.iam.add_user("siteuser", "siteusersecret",
+                              policies=["sitepol"])
+        assert _wait(lambda: "siteuser" in b.server.iam.users)
+        assert b.server.iam.get_policy("sitepol") is not None
+        # the replicated credential WORKS on site B
+        b.request("PUT", "/iambkt")
+        r = b.request("PUT", "/iambkt/o", data=b"x",
+                      creds=("siteuser", "siteusersecret"))
+        assert r.status == 200
+        # deletion propagates too
+        a.server.iam.remove_user("siteuser")
+        assert _wait(lambda: "siteuser" not in b.server.iam.users)
+
+    def test_no_replication_loop(self, sites):
+        """B also peers back to A: a mutation must settle, not ping-pong."""
+        a, b = sites
+        r = b.request("POST", f"{ADMIN}/site-replication/add",
+                      data=json.dumps({"peers": [{
+                          "name": "siteA", "endpoint": f"http://{a.host}",
+                          "accessKey": a.ak, "secretKey": a.sk}]}).encode())
+        assert r.status == 200
+        a.request("PUT", "/loopbkt")
+        assert _wait(lambda: b.request("HEAD", "/loopbkt").status == 200)
+        time.sleep(1.0)
+        pushed_a = a.server.site.pushed
+        pushed_b = b.server.site.pushed
+        time.sleep(1.0)
+        # no further pushes happening: the apply side suppressed re-push
+        assert a.server.site.pushed == pushed_a
+        assert b.server.site.pushed == pushed_b
+
+    def test_initial_sync_on_join(self, tmp_path):
+        os.environ["MINIO_TPU_FSYNC"] = "0"
+        a = S3TestServer(str(tmp_path / "ia"))
+        b = S3TestServer(str(tmp_path / "ib"))
+        try:
+            # state exists on A BEFORE B joins
+            a.request("PUT", "/prebkt")
+            a.server.iam.add_user("preuser", "preusersecret")
+            r = a.request("POST", f"{ADMIN}/site-replication/add",
+                          data=json.dumps({"peers": [{
+                              "name": "siteB",
+                              "endpoint": f"http://{b.host}",
+                              "accessKey": b.ak,
+                              "secretKey": b.sk}]}).encode())
+            assert r.status == 200
+            assert _wait(lambda: b.request("HEAD", "/prebkt").status == 200)
+            assert _wait(lambda: "preuser" in b.server.iam.users)
+        finally:
+            a.server.site.close()
+            b.server.site.close()
+            a.close()
+            b.close()
+
+    def test_info_and_remove(self, sites):
+        a, _ = sites
+        doc = json.loads(a.request(
+            "GET", f"{ADMIN}/site-replication/info").text())
+        assert any(p["name"] == "siteB" for p in doc["peers"])
+        assert all("secretKey" not in p for p in doc["peers"])
+        assert a.request("POST", f"{ADMIN}/site-replication/remove",
+                         query=[("name", "siteB")]).status == 200
+        doc = json.loads(a.request(
+            "GET", f"{ADMIN}/site-replication/info").text())
+        assert not doc["peers"]
+
+
+class TestSiteReviewFixes:
+    def test_disable_propagates(self, sites):
+        a, b = sites
+        a.server.iam.add_user("togguser", "toggusersecret")
+        assert _wait(lambda: "togguser" in b.server.iam.users)
+        a.server.iam.set_user_status("togguser", enabled=False)
+        assert _wait(lambda: b.server.iam.users[
+            "togguser"].status == "disabled")
+        a.server.iam.set_user_status("togguser", enabled=True)
+        assert _wait(lambda: b.server.iam.users[
+            "togguser"].status == "enabled")
+
+    def test_group_member_removal_propagates(self, sites):
+        a, b = sites
+        a.server.iam.add_user("g1", "g1secret1234")
+        a.server.iam.add_user("g2", "g2secret1234")
+        a.server.iam.add_group_members("team", ["g1", "g2"])
+        assert _wait(lambda: set(b.server.iam.groups.get(
+            "team", {}).get("members", [])) == {"g1", "g2"})
+        a.server.iam.remove_group_members("team", ["g1"])
+        assert _wait(lambda: set(b.server.iam.groups.get(
+            "team", {}).get("members", [])) == {"g2"})
