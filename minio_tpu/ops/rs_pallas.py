@@ -30,6 +30,14 @@ from jax.experimental.pallas import tpu as pltpu
 from . import gf256, rs_tpu
 
 # Column-tile width in int32 words (bytes = 4 * _TILE_WORDS per shard row).
+# Tuning notes (measured on v5e via the bench fori_loop harness): tile
+# widths 512..8192 are within ~8% of each other (2048 best); int8/uint8
+# in-kernel unpack variants (which would cut the VPU shift count 4x) are
+# blocked by the current Mosaic lowering — `arith.shrsi/shrui` on i8
+# vectors and bitwidth-changing bitcasts both fail to legalize — so the
+# int32-word layout below stands.  Naive timing of individual dispatches
+# through the tunneled device wildly overstates throughput (dispatch
+# returns before execution); only the in-jit fori_loop numbers are real.
 _TILE_WORDS = 2048
 
 

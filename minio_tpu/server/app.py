@@ -1148,6 +1148,19 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             versioned=vstatus == "Enabled",
         )
 
+        # Content-MD5 (base64) guards the raw request body (reference
+        # hash.NewReader MD5 enforcement, internal/hash/reader.go:38);
+        # malformed values must reject BEFORE the put pipeline spins up
+        md5_claim = request.headers.get("Content-MD5", "")
+        md5_want = None
+        if md5_claim:
+            try:
+                md5_want = base64.b64decode(md5_claim, validate=True)
+                if len(md5_want) != 16:
+                    raise ValueError
+            except (ValueError, TypeError):
+                raise S3Error("InvalidDigest")
+
         pipe = _QueuePipeReader()
         reader: io.RawIOBase = (
             _ChunkedSigReader(pipe, ctx) if streaming else pipe
@@ -1188,11 +1201,15 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             and sha_claim != sigv4.UNSIGNED_PAYLOAD
         )
         body_sha = hashlib.sha256() if check_hash else None
+        body_md5 = (hashlib.md5()
+                    if md5_want is not None and not streaming else None)
         feed_err = None
         try:
             async for chunk in request.content.iter_chunked(1 << 20):
                 if body_sha is not None:
                     body_sha.update(chunk)
+                if body_md5 is not None:
+                    body_md5.update(chunk)
                 await self._feed(pipe, chunk, put_task)
         except Exception as e:
             feed_err = e
@@ -1205,17 +1222,21 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             raise
         if feed_err is not None:
             raise S3Error("IncompleteBody")
-        if body_sha is not None and body_sha.hexdigest() != sha_claim:
+        async def _digest_rollback(msg: str):
             # tampered/corrupted body: roll back the just-written version
-            # (reference rejects with content-sha256 mismatch during stream)
+            # (reference rejects digest mismatches during the stream)
             try:
                 await self._run(
                     self.api.delete_object, bucket, key, oi.version_id, False
                 )
             except Exception:
                 pass
-            raise S3Error("BadDigest",
-                          "x-amz-content-sha256 does not match body")
+            raise S3Error("BadDigest", msg)
+
+        if body_sha is not None and body_sha.hexdigest() != sha_claim:
+            await _digest_rollback("x-amz-content-sha256 does not match body")
+        if body_md5 is not None and body_md5.digest() != md5_want:
+            await _digest_rollback("Content-MD5 does not match body")
         headers = {"ETag": f'"{oi.etag}"'}
         if oi.version_id:
             headers["x-amz-version-id"] = oi.version_id
@@ -1279,6 +1300,32 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             self.api.get_object, bucket, key, offset, length, vid)
         return stream
 
+    @staticmethod
+    def _check_copy_source_conditions(request: web.Request, soi) -> None:
+        """x-amz-copy-source-if-* preconditions against the SOURCE, with
+        the same ETag-over-date precedence and whole-second tolerance as
+        check_preconditions (reference checkCopyObjectPreconditions)."""
+        from .object_extras import _http_date_parse
+
+        h = request.headers
+        im = h.get("x-amz-copy-source-if-match")
+        if im is not None and im.strip('"') != soi.etag:
+            raise S3Error("PreconditionFailed")
+        inm = h.get("x-amz-copy-source-if-none-match")
+        if inm is not None and inm.strip('"') == soi.etag:
+            raise S3Error("PreconditionFailed")
+        ums = h.get("x-amz-copy-source-if-unmodified-since")
+        if ums is not None and im is None:
+            # a passing if-match overrides the date check
+            t = _http_date_parse(ums)
+            if t is not None and soi.mod_time > t + 1:
+                raise S3Error("PreconditionFailed")
+        ms = h.get("x-amz-copy-source-if-modified-since")
+        if ms is not None and inm is None:
+            t = _http_date_parse(ms)
+            if t is not None and soi.mod_time <= t + 1:
+                raise S3Error("PreconditionFailed")
+
     def _compress_eligible(self, key: str, content_type: str) -> bool:
         if not self.config.get_bool("compression", "enable"):
             return False
@@ -1321,8 +1368,25 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         from minio_tpu.crypto import sse as sse_mod
 
         soi = await self._run(self.api.get_object_info, sbucket, skey, vid)
+        self._check_copy_source_conditions(request, soi)
         await self._run(self._quota_check, bucket, soi.size)
         src_meta = dict(soi.metadata)
+        # x-amz-metadata-directive: REPLACE swaps in the request's own
+        # metadata/content-type (reference extractMetadata + directive
+        # handling in CopyObjectHandler)
+        directive = request.headers.get(
+            "x-amz-metadata-directive", "COPY").upper()
+        if directive not in ("COPY", "REPLACE"):
+            raise S3Error("InvalidArgument", "bad x-amz-metadata-directive")
+        if directive == "REPLACE":
+            internal = {k: v for k, v in src_meta.items()
+                        if k.startswith("x-minio-internal-")
+                        or k == TAGS_KEY}
+            src_meta = {k.lower(): v for k, v in request.headers.items()
+                        if k.lower().startswith("x-amz-meta-")}
+            src_meta.update(internal)
+            soi.content_type = request.headers.get(
+                "Content-Type", soi.content_type)
         if src_meta.get(sse_mod.META_ALGO):
             # decrypt the source (SSE-C copy-source headers not yet wired:
             # SSE-C sources need x-amz-copy-source-sse-c keys)

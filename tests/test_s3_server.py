@@ -339,3 +339,129 @@ class TestSigV2:
                        {"host": srv.host}, srv.ak, srv.sk)
         r = srv.raw_request("GET", "/v2bkt?versioning=", headers=h)
         assert r.status == 200
+
+
+class TestConformanceHardening:
+    """Copy-source conditionals, metadata directive, Content-MD5."""
+
+    def test_copy_source_conditionals(self, srv):
+        srv.request("PUT", "/cchbkt")
+        r = srv.request("PUT", "/cchbkt/src", data=b"orig")
+        etag = r.headers["ETag"].strip('"')
+        # if-match pass / fail
+        r = srv.request("PUT", "/cchbkt/dst1",
+                        headers={"x-amz-copy-source": "/cchbkt/src",
+                                 "x-amz-copy-source-if-match": etag})
+        assert r.status == 200
+        r = srv.request("PUT", "/cchbkt/dst2",
+                        headers={"x-amz-copy-source": "/cchbkt/src",
+                                 "x-amz-copy-source-if-match": "wrong"})
+        assert r.status == 412
+        # if-none-match fail
+        r = srv.request("PUT", "/cchbkt/dst3",
+                        headers={"x-amz-copy-source": "/cchbkt/src",
+                                 "x-amz-copy-source-if-none-match": etag})
+        assert r.status == 412
+
+    def test_metadata_directive_replace(self, srv):
+        srv.request("PUT", "/mdbkt")
+        srv.request("PUT", "/mdbkt/src", data=b"data",
+                    headers={"x-amz-meta-color": "red",
+                             "Content-Type": "text/plain"})
+        # COPY (default): source metadata carried over
+        srv.request("PUT", "/mdbkt/copydef",
+                    headers={"x-amz-copy-source": "/mdbkt/src"})
+        h = srv.request("HEAD", "/mdbkt/copydef").headers
+        assert h.get("x-amz-meta-color") == "red"
+        # REPLACE: request metadata wins, source's dropped
+        srv.request("PUT", "/mdbkt/copyrep",
+                    headers={"x-amz-copy-source": "/mdbkt/src",
+                             "x-amz-metadata-directive": "REPLACE",
+                             "x-amz-meta-shade": "blue",
+                             "Content-Type": "application/json"})
+        h = srv.request("HEAD", "/mdbkt/copyrep").headers
+        assert h.get("x-amz-meta-shade") == "blue"
+        assert "x-amz-meta-color" not in h
+        assert h.get("Content-Type") == "application/json"
+        # body unchanged either way
+        assert srv.request("GET", "/mdbkt/copyrep").body == b"data"
+
+    def test_content_md5_validation(self, srv):
+        import base64
+        import hashlib
+
+        srv.request("PUT", "/md5bkt")
+        data = b"checked payload"
+        good = base64.b64encode(hashlib.md5(data).digest()).decode()
+        r = srv.request("PUT", "/md5bkt/ok", data=data,
+                        headers={"Content-MD5": good})
+        assert r.status == 200
+        bad = base64.b64encode(hashlib.md5(b"other").digest()).decode()
+        r = srv.request("PUT", "/md5bkt/bad", data=data,
+                        headers={"Content-MD5": bad})
+        assert r.status == 400 and "BadDigest" in r.text()
+        # the failed PUT must not leave an object behind
+        assert srv.request("GET", "/md5bkt/bad").status == 404
+        # malformed base64 -> InvalidDigest
+        r = srv.request("PUT", "/md5bkt/mal", data=data,
+                        headers={"Content-MD5": "!!!notb64"})
+        assert r.status == 400 and "InvalidDigest" in r.text()
+
+    def test_if_match_overrides_unmodified_since(self, srv):
+        srv.request("PUT", "/cchbkt2")
+        r = srv.request("PUT", "/cchbkt2/src", data=b"x")
+        etag = r.headers["ETag"].strip('"')
+        # matching if-match + ancient unmodified-since must SUCCEED
+        r = srv.request("PUT", "/cchbkt2/dst", headers={
+            "x-amz-copy-source": "/cchbkt2/src",
+            "x-amz-copy-source-if-match": etag,
+            "x-amz-copy-source-if-unmodified-since":
+                "Mon, 01 Jan 2001 00:00:00 GMT"})
+        assert r.status == 200
+
+    def test_head_then_copy_round_trip(self, srv):
+        """Copying with the exact Last-Modified a HEAD returned must not
+        412 on sub-second truncation."""
+        srv.request("PUT", "/cchbkt3")
+        srv.request("PUT", "/cchbkt3/src", data=b"x")
+        lm = srv.request("HEAD", "/cchbkt3/src").headers["Last-Modified"]
+        r = srv.request("PUT", "/cchbkt3/dst", headers={
+            "x-amz-copy-source": "/cchbkt3/src",
+            "x-amz-copy-source-if-unmodified-since": lm})
+        assert r.status == 200
+
+    def test_streaming_put_with_content_md5_ok(self, srv):
+        """aws-chunked uploads carrying Content-MD5 of the PAYLOAD must
+        not be rejected (the framed body differs from the payload)."""
+        import base64
+
+        srv.request("PUT", "/md5bkt2")
+        payload = b"streamed with md5 " * 500
+        headers = {
+            "host": srv.host,
+            "x-amz-decoded-content-length": str(len(payload)),
+            "content-encoding": "aws-chunked",
+            "content-md5": base64.b64encode(
+                hashlib.md5(payload).digest()).decode(),
+        }
+        signed = sigv4.sign_request(
+            "PUT", "/md5bkt2/obj", [], headers, None, srv.ak, srv.sk,
+            payload_hash=sigv4.STREAMING_PAYLOAD,
+        )
+        auth = signed["authorization"]
+        seed_sig = auth.split("Signature=")[1]
+        amz_date = signed["x-amz-date"]
+        scope = auth.split("Credential=")[1].split(",")[0].split("/", 1)[1]
+        skey = sigv4.signing_key(srv.sk, amz_date[:8], "us-east-1")
+        framed, prev = b"", seed_sig
+        crlf = b"\r\n"
+        for c in (payload, b""):
+            csha = hashlib.sha256(c).hexdigest()
+            sig = sigv4.chunk_signature(skey, prev, amz_date, scope, csha)
+            framed += f"{len(c):x};chunk-signature={sig}".encode() + crlf
+            framed += c + crlf
+            prev = sig
+        r = srv.raw_request("PUT", "/md5bkt2/obj", data=framed,
+                            headers=signed)
+        assert r.status == 200, r.text()
+        assert srv.request("GET", "/md5bkt2/obj").body == payload
