@@ -176,8 +176,14 @@ class ClusterNode:
             self.peers = PeerNotifier(self.peer_clients)
             self.s3.meta.on_change = self.peers.reload_bucket_meta
             self.s3.iam.on_change = self.peers.reload_iam
+            # one admin trace endpoint serves CLUSTER-wide traces: the
+            # serving node follows each peer's own trace stream
+            # (reference: peers subscribe to each other's globalTrace,
+            # cmd/admin-handlers.go TraceHandler + peer-rest subscribe)
+            self.s3.peer_trace_addrs = sorted(self.peer_clients)
         else:
             self.peers = None
+        self.s3.node_addr = my_address
         self.router.mount(self.app)
         # format bootstrap probes peers before their servers are up; reset
         # the health cache so the first real use re-probes immediately

@@ -451,11 +451,92 @@ class AdminMixin:
                           body: bytes) -> web.StreamResponse:
         """Long-poll NDJSON stream of per-request trace entries
         (reference TraceHandler, cmd/admin-handlers.go:1108; `mc admin
-        trace` client).  ?err=true filters to error responses only."""
+        trace` client).  ?err=true filters to error responses only.
+
+        In distributed mode the stream is CLUSTER-wide: follower threads
+        tail each peer's trace endpoint (?local=true) and merge entries
+        into this response.  Peers are reached with this node's root
+        credentials — bootstrap verification guarantees they match."""
         errs_only = request.rel_url.query.get("err", "") in ("true", "1")
+        local_only = request.rel_url.query.get("local", "") in ("true", "1")
         flt = (lambda e: e.get("statusCode", 0) >= 400) if errs_only else None
-        return await self._stream_ndjson(
-            request, lambda: self.trace.subscribe(filter_fn=flt))
+
+        peers = [] if local_only else getattr(self, "peer_trace_addrs", [])
+        stop = None
+        if peers:
+            import threading
+
+            stop = threading.Event()
+
+        def subscribe():
+            sub = self.trace.subscribe(filter_fn=flt)
+            for addr in peers:
+                threading.Thread(
+                    target=self._follow_peer_trace,
+                    args=(addr, sub, stop, errs_only),
+                    daemon=True).start()
+            return sub
+
+        try:
+            return await self._stream_ndjson(request, subscribe)
+        finally:
+            if stop is not None:
+                stop.set()
+
+    def _follow_peer_trace(self, addr: str, sub, stop, errs_only: bool
+                           ) -> None:
+        """Tail one peer's ?local=true trace stream into `sub`'s queue."""
+        import http.client as hc
+        import queue as queue_mod
+
+        from . import sigv4
+
+        q = [("local", "true")] + ([("err", "true")] if errs_only else [])
+        path = f"{ADMIN_PREFIX}/trace"
+        headers = {"host": addr}
+        signed = sigv4.sign_request(
+            "GET", path, q, headers, b"",
+            self.iam.root.access_key, self.iam.root.secret_key,
+            region=self.region)
+        qs = "&".join(f"{k}={v}" for k, v in q)
+        host, _, port = addr.partition(":")
+        conn = None
+        try:
+            conn = hc.HTTPConnection(host, int(port or 80), timeout=5)
+            conn.request("GET", f"{path}?{qs}", headers=signed)
+            resp = conn.getresponse()
+            if resp.status != 200:
+                from minio_tpu.utils.logger import log
+
+                log.warning("peer trace subscribe rejected",
+                            peer=addr, status=resp.status)
+                return
+            buf = b""
+            while not stop.is_set():
+                chunk = resp.read1(65536)
+                if not chunk:
+                    break
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if not line.strip():
+                        continue
+                    try:
+                        entry = json.loads(line)
+                        entry.setdefault("node", addr)
+                        sub.q.put_nowait(entry)
+                    except (ValueError, queue_mod.Full):
+                        continue
+        except Exception as e:
+            # transient peer outage: local + other peers keep streaming,
+            # but leave a breadcrumb for misconfiguration hunting
+            from minio_tpu.utils.logger import log
+
+            log.warning("peer trace follower stopped",
+                        peer=addr, error=str(e))
+        finally:
+            if conn is not None:
+                conn.close()
 
     async def admin_console_log(self, request: web.Request,
                                 body: bytes) -> web.StreamResponse:

@@ -158,6 +158,20 @@ class _IterStream(io.RawIOBase):
         return out
 
 
+class _TeeHashReader(io.RawIOBase):
+    """Pass-through reader feeding every byte into a hash object."""
+
+    def __init__(self, r: io.RawIOBase, h):
+        self.r = r
+        self.h = h
+
+    def read(self, n: int = -1) -> bytes:
+        data = self.r.read(n)
+        if data:
+            self.h.update(data)
+        return data
+
+
 class _QueuePipeReader(io.RawIOBase):
     """Bridges async body chunks into the sync object layer."""
 
@@ -514,6 +528,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             # internal/logger/audit.go)
             if self.trace.num_subscribers or log.audit_enabled:
                 entry = {
+                    "node": getattr(self, "node_addr", "local"),
                     "api": api,
                     "method": request.method,
                     "path": request.path,
@@ -1165,6 +1180,12 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         reader: io.RawIOBase = (
             _ChunkedSigReader(pipe, ctx) if streaming else pipe
         )
+        body_md5 = None
+        if md5_want is not None:
+            # hash the DECODED payload (works for aws-chunked too, where
+            # the raw body carries signature framing)
+            body_md5 = hashlib.md5()
+            reader = _TeeHashReader(reader, body_md5)
         # server-side encryption wraps the decoded plaintext stream
         # (reference EncryptRequest, cmd/encryption-v1.go:324)
         sse_kind, customer_key = self.sse_kind_for_put(request, bucket)
@@ -1201,15 +1222,11 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             and sha_claim != sigv4.UNSIGNED_PAYLOAD
         )
         body_sha = hashlib.sha256() if check_hash else None
-        body_md5 = (hashlib.md5()
-                    if md5_want is not None and not streaming else None)
         feed_err = None
         try:
             async for chunk in request.content.iter_chunked(1 << 20):
                 if body_sha is not None:
                     body_sha.update(chunk)
-                if body_md5 is not None:
-                    body_md5.update(chunk)
                 await self._feed(pipe, chunk, put_task)
         except Exception as e:
             feed_err = e
@@ -1308,12 +1325,20 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         from .object_extras import _http_date_parse
 
         h = request.headers
+
+        def tags_of(v: str) -> list[str]:
+            return [t.strip().strip('"') for t in v.split(",")]
+
         im = h.get("x-amz-copy-source-if-match")
-        if im is not None and im.strip('"') != soi.etag:
-            raise S3Error("PreconditionFailed")
+        if im is not None:
+            tags = tags_of(im)
+            if "*" not in tags and soi.etag not in tags:
+                raise S3Error("PreconditionFailed")
         inm = h.get("x-amz-copy-source-if-none-match")
-        if inm is not None and inm.strip('"') == soi.etag:
-            raise S3Error("PreconditionFailed")
+        if inm is not None:
+            tags = tags_of(inm)
+            if "*" in tags or soi.etag in tags:
+                raise S3Error("PreconditionFailed")
         ums = h.get("x-amz-copy-source-if-unmodified-since")
         if ums is not None and im is None:
             # a passing if-match overrides the date check
@@ -1387,6 +1412,17 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             src_meta.update(internal)
             soi.content_type = request.headers.get(
                 "Content-Type", soi.content_type)
+        # x-amz-tagging-directive mirrors the metadata one for the tag set
+        tag_dir = request.headers.get(
+            "x-amz-tagging-directive", "COPY").upper()
+        if tag_dir not in ("COPY", "REPLACE"):
+            raise S3Error("InvalidTagDirective")
+        if tag_dir == "REPLACE":
+            src_meta.pop(TAGS_KEY, None)
+            tag_hdr = request.headers.get("x-amz-tagging", "")
+            if tag_hdr:
+                parse_tag_query(tag_hdr)  # validates
+                src_meta[TAGS_KEY] = tag_hdr
         if src_meta.get(sse_mod.META_ALGO):
             # decrypt the source (SSE-C copy-source headers not yet wired:
             # SSE-C sources need x-amz-copy-source-sse-c keys)

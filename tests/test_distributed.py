@@ -309,3 +309,63 @@ class TestPeerControlPlane:
         # delete propagates too
         n1.s3.meta.delete_config("pb", bm.POLICY)
         assert n2.s3.meta.policy("pb") is None
+
+
+def test_cluster_wide_trace(cluster):
+    """The admin trace endpoint on one node streams requests served by
+    the OTHER node (reference: peers subscribe to each other's trace)."""
+    import http.client
+    import json as json_mod
+    import urllib.parse
+
+    from minio_tpu.server import sigv4
+
+    n1, n2 = cluster
+    assert getattr(n1.s3, "peer_trace_addrs", []), "peer addrs not wired"
+    peer_addr = n1.s3.peer_trace_addrs[0]  # node2, as node1 sees it
+
+    def signed(method, path, q, host):
+        return sigv4.sign_request(method, path, q, {"host": host}, b"",
+                                  "minioadmin", "minioadmin")
+
+    # follow node1's CLUSTER trace in a thread
+    lines = []
+    my_addr = peer_addr
+    n1_addr = n2.s3.peer_trace_addrs[0]  # node1, as node2 sees it
+    done = threading.Event()
+
+    def collect():
+        path = "/minio/admin/v3/trace"
+        h = signed("GET", path, [], n1_addr)
+        conn = http.client.HTTPConnection(
+            *n1_addr.split(":"), timeout=10)
+        conn.request("GET", path, headers=h)
+        resp = conn.getresponse()
+        buf = b""
+        t0 = time.time()
+        while time.time() - t0 < 8 and not lines:
+            chunk = resp.read1(65536)
+            if not chunk:
+                break
+            buf += chunk
+            while b"\n" in buf:
+                line, buf = buf.split(b"\n", 1)
+                if line.strip():
+                    e = json_mod.loads(line)
+                    if e.get("node") == my_addr:
+                        lines.append(e)
+        conn.close()
+        done.set()
+
+    t = threading.Thread(target=collect, daemon=True)
+    t.start()
+    time.sleep(1.0)  # let the follower attach to node2
+    # request served by NODE 2
+    h2 = signed("PUT", "/trcluster", [], my_addr)
+    conn = http.client.HTTPConnection(*my_addr.split(":"), timeout=10)
+    conn.request("PUT", "/trcluster", headers=h2)
+    conn.getresponse().read()
+    conn.close()
+    done.wait(10)
+    assert lines, "node2's request never appeared in node1's trace stream"
+    assert lines[0]["api"] == "make_bucket"

@@ -465,3 +465,26 @@ class TestConformanceHardening:
                             headers=signed)
         assert r.status == 200, r.text()
         assert srv.request("GET", "/md5bkt2/obj").body == payload
+
+    def test_tagging_directive_on_copy(self, srv):
+        srv.request("PUT", "/tgdbkt")
+        srv.request("PUT", "/tgdbkt/src", data=b"x",
+                    headers={"x-amz-tagging": "env=dev"})
+        # default COPY carries tags over
+        srv.request("PUT", "/tgdbkt/c1",
+                    headers={"x-amz-copy-source": "/tgdbkt/src"})
+        r = srv.request("GET", "/tgdbkt/c1", query=[("tagging", "")])
+        assert b"<Value>dev</Value>" in r.body
+        # REPLACE swaps the tag set
+        srv.request("PUT", "/tgdbkt/c2",
+                    headers={"x-amz-copy-source": "/tgdbkt/src",
+                             "x-amz-tagging-directive": "REPLACE",
+                             "x-amz-tagging": "env=prod"})
+        r = srv.request("GET", "/tgdbkt/c2", query=[("tagging", "")])
+        assert b"<Value>prod</Value>" in r.body and b"dev" not in r.body
+        # REPLACE with no header clears tags
+        srv.request("PUT", "/tgdbkt/c3",
+                    headers={"x-amz-copy-source": "/tgdbkt/src",
+                             "x-amz-tagging-directive": "REPLACE"})
+        r = srv.request("HEAD", "/tgdbkt/c3")
+        assert "x-amz-tagging-count" not in r.headers
