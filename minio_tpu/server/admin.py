@@ -485,58 +485,61 @@ class AdminMixin:
 
     def _follow_peer_trace(self, addr: str, sub, stop, errs_only: bool
                            ) -> None:
-        """Tail one peer's ?local=true trace stream into `sub`'s queue."""
+        """Tail one peer's ?local=true trace stream into `sub`'s queue,
+        reconnecting with backoff for as long as the client stream is
+        open (a peer restart must not silently drop its traffic from an
+        ongoing cluster-wide trace)."""
         import http.client as hc
         import queue as queue_mod
 
+        from minio_tpu.utils.logger import log
         from . import sigv4
 
         q = [("local", "true")] + ([("err", "true")] if errs_only else [])
         path = f"{ADMIN_PREFIX}/trace"
-        headers = {"host": addr}
-        signed = sigv4.sign_request(
-            "GET", path, q, headers, b"",
-            self.iam.root.access_key, self.iam.root.secret_key,
-            region=self.region)
         qs = "&".join(f"{k}={v}" for k, v in q)
         host, _, port = addr.partition(":")
-        conn = None
-        try:
-            conn = hc.HTTPConnection(host, int(port or 80), timeout=5)
-            conn.request("GET", f"{path}?{qs}", headers=signed)
-            resp = conn.getresponse()
-            if resp.status != 200:
-                from minio_tpu.utils.logger import log
-
-                log.warning("peer trace subscribe rejected",
-                            peer=addr, status=resp.status)
+        backoff = 1.0
+        while not stop.is_set():
+            signed = sigv4.sign_request(
+                "GET", path, q, {"host": addr}, b"",
+                self.iam.root.access_key, self.iam.root.secret_key,
+                region=self.region)
+            conn = None
+            try:
+                conn = hc.HTTPConnection(host, int(port or 80), timeout=5)
+                conn.request("GET", f"{path}?{qs}", headers=signed)
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    log.warning("peer trace subscribe rejected",
+                                peer=addr, status=resp.status)
+                    return  # auth/config problem: retrying won't help
+                backoff = 1.0
+                buf = b""
+                while not stop.is_set():
+                    chunk = resp.read1(65536)
+                    if not chunk:
+                        break
+                    buf += chunk
+                    while b"\n" in buf:
+                        line, buf = buf.split(b"\n", 1)
+                        if not line.strip():
+                            continue
+                        try:
+                            entry = json.loads(line)
+                            entry.setdefault("node", addr)
+                            sub.q.put_nowait(entry)
+                        except (ValueError, queue_mod.Full):
+                            continue
+            except Exception as e:
+                log.warning("peer trace follower disconnected; retrying",
+                            peer=addr, error=str(e))
+            finally:
+                if conn is not None:
+                    conn.close()
+            if stop.wait(backoff):
                 return
-            buf = b""
-            while not stop.is_set():
-                chunk = resp.read1(65536)
-                if not chunk:
-                    break
-                buf += chunk
-                while b"\n" in buf:
-                    line, buf = buf.split(b"\n", 1)
-                    if not line.strip():
-                        continue
-                    try:
-                        entry = json.loads(line)
-                        entry.setdefault("node", addr)
-                        sub.q.put_nowait(entry)
-                    except (ValueError, queue_mod.Full):
-                        continue
-        except Exception as e:
-            # transient peer outage: local + other peers keep streaming,
-            # but leave a breadcrumb for misconfiguration hunting
-            from minio_tpu.utils.logger import log
-
-            log.warning("peer trace follower stopped",
-                        peer=addr, error=str(e))
-        finally:
-            if conn is not None:
-                conn.close()
+            backoff = min(backoff * 2, 15.0)
 
     async def admin_console_log(self, request: web.Request,
                                 body: bytes) -> web.StreamResponse:

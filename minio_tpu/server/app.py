@@ -700,6 +700,8 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 return await self._handle(request, self.complete_upload)
             if "select" in q:
                 return await self._handle(request, self.select_object_content)
+            if "restore" in q:
+                return await self._handle(request, self.restore_object)
         return await self._handle(request, self._method_not_allowed)
 
     @staticmethod
@@ -1062,6 +1064,16 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             "Content-Type": oi.content_type or "application/octet-stream",
             "Accept-Ranges": "bytes",
         }
+        restore_exp = oi.metadata.get("x-minio-internal-restore-expiry")
+        if restore_exp:
+            from .object_extras import _http_date_parse
+
+            t = _http_date_parse(restore_exp)
+            if t is None or t >= time.time():
+                # expired windows disappear, matching AWS behavior
+                h["x-amz-restore"] = (
+                    f'ongoing-request="false", '
+                    f'expiry-date="{restore_exp}"')
         if oi.version_id:
             h["x-amz-version-id"] = oi.version_id
         for k, v in oi.metadata.items():
@@ -1732,6 +1744,45 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 await self._run(raw.close)
         await resp.write_eof()
         return resp
+
+    async def restore_object(self, request: web.Request) -> web.Response:
+        """RestoreObject for transitioned versions (reference
+        PostRestoreObjectHandler, cmd/object-handlers.go; restored
+        availability surfaces via the x-amz-restore header).  Data in
+        this framework streams through the warm tier transparently, so a
+        restore completes immediately — the API records the requested
+        availability window."""
+        body = await request.read()
+        bucket, key = self._object(request)
+        await self._auth(request, hashlib.sha256(body).hexdigest(),
+                         "s3:RestoreObject", bucket, key)
+        vid = request.rel_url.query.get("versionId", "")
+        days = 1
+        if body:
+            try:
+                root = ET.fromstring(body)
+                days = int(root.findtext(f"{{{XMLNS}}}Days")
+                           or root.findtext("Days") or "1")
+            except (ET.ParseError, ValueError):
+                raise S3Error("MalformedXML")
+        if days < 1:
+            raise S3Error("InvalidArgument", "Days must be >= 1")
+        oi = await self._run(self.api.get_object_info, bucket, key, vid)
+        from minio_tpu.erasure.objects import (
+            TRANSITION_COMPLETE, TRANSITION_STATUS_KEY,
+        )
+
+        if oi.metadata.get(TRANSITION_STATUS_KEY) != TRANSITION_COMPLETE:
+            raise S3Error("InvalidObjectState",
+                          "object is not in a tiered storage class")
+        expiry = time.time() + days * 86400
+        expiry_str = _http_date(expiry)
+        await self._run(
+            self.api.update_object_metadata, bucket, key,
+            {"x-minio-internal-restore-expiry": expiry_str}, vid)
+        return web.Response(status=202, headers={
+            "x-amz-restore":
+                f'ongoing-request="false", expiry-date="{expiry_str}"'})
 
     # ----------------------------------------------------------- multipart
     async def create_upload(self, request: web.Request) -> web.Response:

@@ -295,3 +295,35 @@ class TestMultipartBitrotPinning:
         assert fi.erasure.checksums[0].algorithm == "sha256"
         _, stream = pools.get_object("bkt", "mp.bin")
         assert b"".join(stream) == part
+
+
+class TestRestoreObject:
+    def test_restore_api(self, srv):
+        s, _ = srv
+        s.request("PUT", "/rsbkt1")
+        s.request("PUT", "/rsbkt1/cold.bin", data=b"r" * 4096)
+        # not tiered yet: restore is invalid
+        r = s.request("POST", "/rsbkt1/cold.bin",
+                      query=[("restore", "")],
+                      data=b"<RestoreRequest><Days>2</Days></RestoreRequest>")
+        assert r.status == 403 and "InvalidObjectState" in r.text()
+        s.request("PUT", "/rsbkt1", query=[("lifecycle", "")],
+                  data=LC_TRANSITION)
+        s.server.services.scanner.scan_cycle()
+        r = s.request("POST", "/rsbkt1/cold.bin",
+                      query=[("restore", "")],
+                      data=b"<RestoreRequest><Days>2</Days></RestoreRequest>")
+        assert r.status == 202, r.text()
+        assert 'ongoing-request="false"' in r.headers["x-amz-restore"]
+        # HEAD reflects the restore window; data still reads through
+        h = s.request("HEAD", "/rsbkt1/cold.bin")
+        assert "expiry-date=" in h.headers.get("x-amz-restore", "")
+        assert s.request("GET", "/rsbkt1/cold.bin").body == b"r" * 4096
+
+    def test_restore_bad_days(self, srv):
+        s, _ = srv
+        s.request("PUT", "/rsbkt2")
+        s.request("PUT", "/rsbkt2/o", data=b"x")
+        r = s.request("POST", "/rsbkt2/o", query=[("restore", "")],
+                      data=b"<RestoreRequest><Days>0</Days></RestoreRequest>")
+        assert r.status == 400
