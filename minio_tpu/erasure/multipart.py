@@ -80,6 +80,10 @@ class MultipartMixin:
         # final checksums must agree even if the env changes (or another
         # node completes the upload)
         metadata["x-minio-internal-bitrot-algo"] = bitrot.algo_from_env()
+        # the directory layout hashes bucket/object away: record them so
+        # bucket-wide upload enumeration can recover the logical key
+        metadata["x-minio-internal-upload-bucket"] = bucket
+        metadata["x-minio-internal-upload-object"] = obj
         now = time.time()
 
         def write(i: int) -> None:
@@ -244,6 +248,70 @@ class MultipartMixin:
                         continue
         return [parts[k] for k in sorted(parts)]
 
+    def enumerate_multipart_uploads(
+            self: ErasureObjects) -> list[MultipartInfo]:
+        """Every in-progress upload on this set, across ALL buckets, in
+        ONE walk (reference ListMultipartUploads backing + the
+        stale-upload cleanup, cmd/erasure-sets.go:489).  Object names
+        come from the upload's own metadata — the directory layout
+        hashes them away.  Entries whose metadata is unreadable on every
+        drive (or predates the recorded keys) surface with bucket="" and
+        their raw directory in metadata["__dir"], so the cleanup can
+        still reclaim them."""
+        resolved: dict[tuple[str, str], MultipartInfo] = {}
+        pending: dict[tuple[str, str], float] = {}
+        for d in self.disks:
+            if d is None or not d.is_online():
+                continue
+            try:
+                roots = d.list_dir(SYSTEM_VOL, MULTIPART_DIR)
+            except Exception:
+                continue
+            for h in roots:
+                h = h.rstrip("/")
+                try:
+                    uids = d.list_dir(SYSTEM_VOL, f"{MULTIPART_DIR}/{h}")
+                except Exception:
+                    continue
+                for uid in uids:
+                    uid = uid.rstrip("/")
+                    key = (h, uid)
+                    if key in resolved:
+                        continue
+                    try:
+                        fi = d.read_version(
+                            SYSTEM_VOL, f"{MULTIPART_DIR}/{h}/{uid}")
+                    except Exception:
+                        pending.setdefault(key, 0.0)
+                        continue
+                    up_bucket = fi.metadata.get(
+                        "x-minio-internal-upload-bucket", "")
+                    up_obj = fi.metadata.get(
+                        "x-minio-internal-upload-object", "")
+                    if not up_bucket or not up_obj:
+                        # legacy/orphan entry: readable but unmapped
+                        pending[key] = max(pending.get(key, 0.0),
+                                           fi.mod_time)
+                        continue
+                    pending.pop(key, None)
+                    resolved[key] = MultipartInfo(
+                        up_bucket, up_obj, uid, initiated=fi.mod_time,
+                        metadata=dict(fi.metadata))
+        out = list(resolved.values())
+        for (h, uid), mt in pending.items():
+            out.append(MultipartInfo(
+                "", "", uid, initiated=mt,
+                metadata={"__dir": f"{MULTIPART_DIR}/{h}/{uid}"}))
+        out.sort(key=lambda u: (u.bucket, u.object, u.upload_id))
+        return out
+
+    def list_all_multipart_uploads(self: ErasureObjects, bucket: str,
+                                   prefix: str = "") -> list[MultipartInfo]:
+        """Bucket view over enumerate_multipart_uploads."""
+        return [u for u in self.enumerate_multipart_uploads()
+                if u.bucket == bucket
+                and (not prefix or u.object.startswith(prefix))]
+
     def list_multipart_uploads(self: ErasureObjects, bucket: str,
                                obj: str) -> list[MultipartInfo]:
         root = _upload_root(bucket, obj)
@@ -314,6 +382,8 @@ class MultipartMixin:
         now = time.time()
         metadata = dict(ufi.metadata)
         metadata.pop("x-minio-internal-bitrot-algo", None)
+        metadata.pop("x-minio-internal-upload-bucket", None)
+        metadata.pop("x-minio-internal-upload-object", None)
         metadata["etag"] = final_etag
         version_id = ""
 
@@ -387,6 +457,7 @@ class EntityTooSmall(errors.InvalidArgument):
 for _name in (
     "new_multipart_upload", "_check_bucket", "_upload_meta",
     "put_object_part", "list_object_parts", "list_multipart_uploads",
+    "list_all_multipart_uploads", "enumerate_multipart_uploads",
     "abort_multipart_upload", "complete_multipart_upload",
 ):
     setattr(ErasureObjects, _name, getattr(MultipartMixin, _name))

@@ -325,6 +325,13 @@ class ErasureSets:
     def list_object_parts(self, bucket, obj, upload_id):
         return self.get_hashed_set(obj).list_object_parts(bucket, obj, upload_id)
 
+    def list_all_multipart_uploads(self, bucket, prefix=""):
+        out = []
+        for es in self.sets:
+            out += es.list_all_multipart_uploads(bucket, prefix)
+        out.sort(key=lambda u: (u.object, u.upload_id))
+        return out
+
     def abort_multipart_upload(self, bucket, obj, upload_id):
         return self.get_hashed_set(obj).abort_multipart_upload(bucket, obj,
                                                                upload_id)
@@ -591,6 +598,13 @@ class ErasureServerPools:
         return listing.merge_entry_streams(streams)
 
     # -- multipart (route to the pool that will own the object) -------------
+    def list_all_multipart_uploads(self, bucket, prefix=""):
+        out = []
+        for p in self.pools:
+            out += p.list_all_multipart_uploads(bucket, prefix)
+        out.sort(key=lambda u: (u.object, u.upload_id))
+        return out
+
     def new_multipart_upload(self, bucket, obj, opts=None) -> str:
         if not self.bucket_exists(bucket):
             raise errors.BucketNotFound(bucket)

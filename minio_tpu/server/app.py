@@ -1860,13 +1860,52 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         ))
 
     async def list_uploads(self, request: web.Request) -> web.Response:
+        """ListMultipartUploads (reference ListMultipartUploadsHandler,
+        cmd/bucket-handlers.go)."""
         bucket = self._bucket(request)
         await self._auth(request, None, "s3:ListBucketMultipartUploads", bucket)
+        q = request.rel_url.query
+        prefix = q.get("prefix", "")
+        try:
+            max_uploads = min(max(int(q.get("max-uploads", "1000")), 0), 1000)
+        except ValueError:
+            raise S3Error("InvalidArgument", "max-uploads must be an integer")
+        key_marker = q.get("key-marker", "")
+        uid_marker = q.get("upload-id-marker", "")
+        lister = getattr(self.api, "list_all_multipart_uploads", None)
+        uploads = await self._run(lister, bucket, prefix) \
+            if lister is not None else []
+        if key_marker:
+            if uid_marker:
+                uploads = [u for u in uploads
+                           if (u.object, u.upload_id)
+                           > (key_marker, uid_marker)]
+            else:
+                # key-marker alone: only keys strictly AFTER the marker
+                uploads = [u for u in uploads if u.object > key_marker]
+        truncated = len(uploads) > max_uploads
+        page = uploads[:max_uploads]
+        parts = []
+        for u in page:
+            parts.append(
+                f"<Upload><Key>{escape(u.object)}</Key>"
+                f"<UploadId>{u.upload_id}</UploadId>"
+                f"<Initiated>{_iso(u.initiated)}</Initiated>"
+                f"<StorageClass>STANDARD</StorageClass></Upload>")
+        nk = page[-1].object if truncated and page else ""
+        nu = page[-1].upload_id if truncated and page else ""
         return self._xml(200, (
             f'<?xml version="1.0" encoding="UTF-8"?>'
             f'<ListMultipartUploadsResult xmlns="{XMLNS}">'
             f"<Bucket>{escape(bucket)}</Bucket>"
-            f"<IsTruncated>false</IsTruncated>"
+            f"<Prefix>{escape(prefix)}</Prefix>"
+            f"<KeyMarker>{escape(key_marker)}</KeyMarker>"
+            f"<UploadIdMarker>{escape(uid_marker)}</UploadIdMarker>"
+            f"<NextKeyMarker>{escape(nk)}</NextKeyMarker>"
+            f"<NextUploadIdMarker>{nu}</NextUploadIdMarker>"
+            f"<MaxUploads>{max_uploads}</MaxUploads>"
+            f"<IsTruncated>{'true' if truncated else 'false'}</IsTruncated>"
+            f"{''.join(parts)}"
             f"</ListMultipartUploadsResult>"
         ))
 

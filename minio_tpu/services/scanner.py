@@ -155,6 +155,60 @@ class DataScanner:
             self._thread.join(timeout=2)
 
     # -- one full cycle ------------------------------------------------------
+    # in-progress uploads older than this are reclaimed even without a
+    # lifecycle rule (reference cleanupStaleUploads default expiry,
+    # cmd/erasure-sets.go:489)
+    STALE_UPLOAD_EXPIRY = 24 * 3600.0
+
+    def _cleanup_stale_uploads(self, es, info: DataUsageInfo) -> None:
+        """ONE multipart walk per set per cycle; per-bucket lifecycle
+        abort rules + the global stale expiry; orphaned upload dirs
+        (unreadable/legacy metadata) are reclaimed once stale."""
+        lf = self.lifecycle_fn
+        try:
+            uploads = es.enumerate_multipart_uploads()
+        except Exception:
+            return
+        now = time.time()
+        lc_cache: dict = {}
+        for up in uploads:
+            if not up.bucket:
+                # orphan: no recoverable key — remove the raw dir when
+                # old enough (initiated 0.0 = unreadable everywhere:
+                # treat as stale)
+                if now - up.initiated > self.STALE_UPLOAD_EXPIRY:
+                    d0 = up.metadata.get("__dir", "")
+                    for d in es.disks:
+                        if d is None or not d.is_online() or not d0:
+                            continue
+                        try:
+                            d.delete(SYSTEM_VOL, d0, recursive=True)
+                        except Exception:
+                            continue
+                    info.lifecycle_actions += 1
+                continue
+            lc = lc_cache.get(up.bucket, False)
+            if lc is False:
+                lc = None
+                if lf is not None and getattr(lf, "meta", None) is not None:
+                    try:
+                        lc = lf.meta.lifecycle(up.bucket)
+                    except Exception:
+                        lc = None
+                lc_cache[up.bucket] = lc
+            limit = self.STALE_UPLOAD_EXPIRY
+            if lc is not None:
+                days = lc.abort_multipart_days(up.object)
+                if days > 0:
+                    limit = min(limit, days * 86400.0)
+            if up.initiated and now - up.initiated > limit:
+                try:
+                    es.abort_multipart_upload(up.bucket, up.object,
+                                              up.upload_id)
+                    info.lifecycle_actions += 1
+                except Exception:
+                    continue
+
     def scan_cycle(self) -> DataUsageInfo:
         info = DataUsageInfo(last_update=time.time())
         for pool in getattr(self.pools, "pools", [self.pools]):
@@ -170,6 +224,7 @@ class DataScanner:
 
     def _scan_set(self, es, info: DataUsageInfo) -> None:
         from .heal import _set_buckets
+        self._cleanup_stale_uploads(es, info)
         for bucket in _set_buckets(es):
             if self.tracker is not None \
                     and not self.tracker.bucket_dirty(bucket):

@@ -102,3 +102,85 @@ def test_complete_validates(api):
 def test_unknown_upload_id(api):
     with pytest.raises(errors.InvalidArgument):
         api.put_object_part("bkt", "obj", "nope", 1, io.BytesIO(b"x"), 1)
+
+
+class TestUploadEnumeration:
+    def test_list_all_uploads_and_http(self, tmp_path):
+        import os
+
+        from tests.s3_harness import S3TestServer
+
+        os.environ["MINIO_TPU_FSYNC"] = "0"
+        s = S3TestServer(str(tmp_path / "mpl"))
+        try:
+            s.request("PUT", "/mplbkt")
+            uids = {}
+            for key in ("a/one", "a/two", "b/three"):
+                r = s.request("POST", f"/mplbkt/{key}",
+                              query=[("uploads", "")])
+                uids[key] = r.text().split("<UploadId>")[1].split(
+                    "</UploadId>")[0]
+            ups = s.pools.list_all_multipart_uploads("mplbkt")
+            assert [(u.object, u.upload_id in uids.values())
+                    for u in ups] == [("a/one", True), ("a/two", True),
+                                      ("b/three", True)]
+            # HTTP listing with prefix
+            r = s.request("GET", "/mplbkt", query=[("uploads", ""),
+                                                   ("prefix", "a/")])
+            body = r.text()
+            assert body.count("<Upload>") == 2
+            assert "a/one" in body and "b/three" not in body
+            # aborting removes it from the listing
+            s.request("DELETE", "/mplbkt/a/one",
+                      query=[("uploadId", uids["a/one"])])
+            r = s.request("GET", "/mplbkt", query=[("uploads", "")])
+            assert r.text().count("<Upload>") == 2
+        finally:
+            s.close()
+
+    def test_stale_upload_cleanup(self, tmp_path):
+        import os
+        import time as _t
+
+        from tests.s3_harness import S3TestServer
+
+        os.environ["MINIO_TPU_FSYNC"] = "0"
+        s = S3TestServer(str(tmp_path / "mps"), start_services=True,
+                         scan_interval=3600.0)
+        try:
+            s.request("PUT", "/mpsbkt")
+            r = s.request("POST", "/mpsbkt/stale.bin",
+                          query=[("uploads", "")])
+            assert r.status == 200
+            # lifecycle abort rule: 1 day after initiation
+            lc = (b'<LifecycleConfiguration><Rule><ID>a</ID>'
+                  b'<Status>Enabled</Status><Filter><Prefix></Prefix>'
+                  b'</Filter><AbortIncompleteMultipartUpload>'
+                  b'<DaysAfterInitiation>1</DaysAfterInitiation>'
+                  b'</AbortIncompleteMultipartUpload>'
+                  b'</Rule></LifecycleConfiguration>')
+            assert s.request("PUT", "/mpsbkt", query=[("lifecycle", "")],
+                             data=lc).status == 200
+            # fresh upload survives a scan
+            s.server.services.scanner.scan_cycle()
+            assert len(s.pools.list_all_multipart_uploads("mpsbkt")) == 1
+            # age the upload past the rule by rewriting its init time
+            es = s.pools.pools[0].get_hashed_set("stale.bin")
+            up = es.list_all_multipart_uploads("mpsbkt")[0]
+            from minio_tpu.erasure.multipart import _upload_path
+            from minio_tpu.storage.local import SYSTEM_VOL
+
+            upath = _upload_path("mpsbkt", "stale.bin", up.upload_id)
+            aged = _t.time() - 2 * 86400  # same instant on every drive:
+            # per-drive timestamps must agree for the metadata quorum
+            for d in es.disks:
+                try:
+                    fi = d.read_version(SYSTEM_VOL, upath)
+                    fi.mod_time = aged
+                    d.write_metadata(SYSTEM_VOL, upath, fi)
+                except Exception:
+                    pass
+            s.server.services.scanner.scan_cycle()
+            assert s.pools.list_all_multipart_uploads("mpsbkt") == []
+        finally:
+            s.close()
