@@ -17,6 +17,32 @@ from minio_tpu.server.app import make_app
 from minio_tpu.storage.local import LocalStorage
 
 
+def signed_request(host: str, port: int, method: str, path: str, *,
+                   data: bytes | None = None, query: list | None = None,
+                   headers: dict | None = None, ak: str = "",
+                   sk: str = "", service: str = "s3",
+                   timeout: float = 30.0) -> "Resp":
+    """Sign (over the RAW path — the signer canonical-encodes once, so
+    pre-quoting would double-encode specials) and send one request."""
+    query = list(query or [])
+    headers = dict(headers or {})
+    headers["host"] = f"{host}:{port}" if port else host
+    signed = sigv4.sign_request(
+        method, path, query, headers,
+        data if data is not None else b"", ak, sk, service=service)
+    qs = "&".join(
+        f"{urllib.parse.quote(k, safe='')}={urllib.parse.quote(v, safe='')}"
+        for k, v in query)
+    url = urllib.parse.quote(path) + ("?" + qs if qs else "")
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request(method, url, body=data, headers=signed)
+        r = conn.getresponse()
+        return Resp(r.status, dict(r.getheaders()), r.read())
+    finally:
+        conn.close()
+
+
 class Resp:
     def __init__(self, status: int, headers: dict, body: bytes):
         self.status = status
@@ -90,15 +116,14 @@ class S3TestServer:
                 query: list | None = None, headers: dict | None = None,
                 unsigned: bool = False, creds: tuple[str, str] | None = None,
                 service: str = "s3") -> Resp:
+        if not unsigned:
+            ak, sk = creds if creds is not None else (self.ak, self.sk)
+            return signed_request("127.0.0.1", self.port, method, path,
+                                  data=data, query=query, headers=headers,
+                                  ak=ak, sk=sk, service=service)
         query = list(query or [])
         headers = dict(headers or {})
         headers["host"] = self.host
-        if not unsigned:
-            ak, sk = creds if creds is not None else (self.ak, self.sk)
-            headers = sigv4.sign_request(
-                method, urllib.parse.quote(path), query, headers,
-                data if data is not None else b"", ak, sk, service=service,
-            )
         qs = "&".join(
             f"{urllib.parse.quote(k, safe='')}={urllib.parse.quote(v, safe='')}"
             for k, v in query
