@@ -191,9 +191,9 @@ class ClusterNode:
             c._last_check = 0.0
 
     def close(self) -> None:
-        if self.services is not None:
-            self.services.close()
-        self.s3.notifier.close()
+        # s3.close() owns the ServiceManager shutdown (attach_services
+        # aliased it) plus site/notifier/executor teardown
+        self.s3.close()
         for c in self.peer_clients.values():
             c.close()
 

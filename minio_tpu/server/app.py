@@ -275,6 +275,26 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             ev.user_agent = request.headers.get("User-Agent", "")
         self.executor.submit(self.notifier.notify, ev)
 
+    def close(self) -> None:
+        """Release every resource this server owns: background services,
+        the site-replication worker, the event notifier, and the request
+        executor (leak-checked by tests/test_leaks.py)."""
+        if self.services is not None:
+            try:
+                self.services.close()
+            except Exception:
+                pass
+            self.services = None
+        try:
+            self.site.close()
+        except Exception:
+            pass
+        try:
+            self.notifier.close()
+        except Exception:
+            pass
+        self.executor.shutdown(wait=False, cancel_futures=True)
+
     def attach_services(self, services) -> None:
         """Adopt the background ServiceManager (heal/MRF/scanner) so the
         admin plane can reach it (reference: serverMain starting
@@ -1436,9 +1456,10 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 parse_tag_query(tag_hdr)  # validates
                 src_meta[TAGS_KEY] = tag_hdr
         if src_meta.get(sse_mod.META_ALGO):
-            # decrypt the source (SSE-C copy-source headers not yet wired:
-            # SSE-C sources need x-amz-copy-source-sse-c keys)
-            obj_key = self.sse_object_key(soi, sbucket, skey, request)
+            # decrypt the source; SSE-C sources are unlocked by the
+            # x-amz-copy-source-sse-c header triple (reference SSECopy)
+            obj_key = self.sse_object_key(soi, sbucket, skey, request,
+                                          copy_source=True)
             nonce_prefix = base64.b64decode(
                 src_meta.get(sse_mod.META_NONCE, ""))
             plain = sse_mod.plain_size_of(soi.size)

@@ -28,6 +28,8 @@ SSE_HDR = "x-amz-server-side-encryption"
 SSEC_ALGO_HDR = "x-amz-server-side-encryption-customer-algorithm"
 SSEC_KEY_HDR = "x-amz-server-side-encryption-customer-key"
 SSEC_MD5_HDR = "x-amz-server-side-encryption-customer-key-md5"
+# copy-source variants (reference crypto.SSECopy, internal/crypto)
+COPY_SSEC_PREFIX = "x-amz-copy-source-server-side-encryption-customer-"
 
 KMS_CONFIG_PATH = "config/kms/master.json"
 KMS_ENV = "MINIO_KMS_SECRET_KEY"
@@ -59,11 +61,18 @@ def load_kms(object_layer) -> LocalKMS | None:
     return None
 
 
-def parse_ssec_key(headers) -> bytes | None:
-    """Validate and decode the SSE-C header triple; None if absent."""
-    algo = headers.get(SSEC_ALGO_HDR, "")
-    key_b64 = headers.get(SSEC_KEY_HDR, "")
-    md5_b64 = headers.get(SSEC_MD5_HDR, "")
+def parse_ssec_key(headers, copy_source: bool = False) -> bytes | None:
+    """Validate and decode the SSE-C header triple; None if absent.
+    copy_source=True reads the x-amz-copy-source-* variants (the key
+    protecting the SOURCE of a CopyObject)."""
+    if copy_source:
+        algo = headers.get(COPY_SSEC_PREFIX + "algorithm", "")
+        key_b64 = headers.get(COPY_SSEC_PREFIX + "key", "")
+        md5_b64 = headers.get(COPY_SSEC_PREFIX + "key-md5", "")
+    else:
+        algo = headers.get(SSEC_ALGO_HDR, "")
+        key_b64 = headers.get(SSEC_KEY_HDR, "")
+        md5_b64 = headers.get(SSEC_MD5_HDR, "")
     if not algo and not key_b64:
         return None
     if algo != "AES256":
@@ -131,12 +140,16 @@ class SSEMixin:
                     SSEC_MD5_HDR: meta.get(sse.META_SSEC_KEY_MD5, "")}
         return {}
 
-    def sse_object_key(self, oi, bucket: str, key: str, request) -> bytes:
-        """Recover the object key for a GET/HEAD of an encrypted object."""
+    def sse_object_key(self, oi, bucket: str, key: str, request,
+                       copy_source: bool = False) -> bytes:
+        """Recover the object key for a GET/HEAD of an encrypted object
+        (copy_source=True: the CopyObject SOURCE, keyed by the
+        x-amz-copy-source-sse-c headers)."""
         kind = oi.metadata.get(sse.META_ALGO, "")
         customer_key = None
         if kind == "SSE-C":
-            customer_key = parse_ssec_key(request.headers)
+            customer_key = parse_ssec_key(request.headers,
+                                          copy_source=copy_source)
             if customer_key is None:
                 raise S3Error("InvalidRequest",
                               "object is SSE-C encrypted: key required")

@@ -17,6 +17,22 @@ from minio_tpu.server.app import make_app
 from minio_tpu.storage.local import LocalStorage
 
 
+def _send(host: str, port: int, method: str, path: str,
+          query: list, data: bytes | None, headers: dict,
+          timeout: float) -> "Resp":
+    qs = "&".join(
+        f"{urllib.parse.quote(k, safe='')}={urllib.parse.quote(v, safe='')}"
+        for k, v in query)
+    url = urllib.parse.quote(path) + ("?" + qs if qs else "")
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request(method, url, body=data, headers=headers)
+        r = conn.getresponse()
+        return Resp(r.status, dict(r.getheaders()), r.read())
+    finally:
+        conn.close()
+
+
 def signed_request(host: str, port: int, method: str, path: str, *,
                    data: bytes | None = None, query: list | None = None,
                    headers: dict | None = None, ak: str = "",
@@ -30,17 +46,7 @@ def signed_request(host: str, port: int, method: str, path: str, *,
     signed = sigv4.sign_request(
         method, path, query, headers,
         data if data is not None else b"", ak, sk, service=service)
-    qs = "&".join(
-        f"{urllib.parse.quote(k, safe='')}={urllib.parse.quote(v, safe='')}"
-        for k, v in query)
-    url = urllib.parse.quote(path) + ("?" + qs if qs else "")
-    conn = http.client.HTTPConnection(host, port, timeout=timeout)
-    try:
-        conn.request(method, url, body=data, headers=signed)
-        r = conn.getresponse()
-        return Resp(r.status, dict(r.getheaders()), r.read())
-    finally:
-        conn.close()
+    return _send(host, port, method, path, query, data, signed, timeout)
 
 
 class Resp:
@@ -96,10 +102,6 @@ class S3TestServer:
         self._loop.run_forever()
 
     def close(self):
-        if self.server.services is not None:
-            self.server.services.close()
-        self.server.notifier.close()
-
         async def stop():
             await self._runner.cleanup()
 
@@ -107,6 +109,8 @@ class S3TestServer:
         fut.result(10)
         self._loop.call_soon_threadsafe(self._loop.stop)
         self._thread.join(10)
+        # after the loop stops: no in-flight requests need the executor
+        self.server.close()
 
     @property
     def host(self) -> str:
@@ -124,19 +128,8 @@ class S3TestServer:
         query = list(query or [])
         headers = dict(headers or {})
         headers["host"] = self.host
-        qs = "&".join(
-            f"{urllib.parse.quote(k, safe='')}={urllib.parse.quote(v, safe='')}"
-            for k, v in query
-        )
-        url = urllib.parse.quote(path) + ("?" + qs if qs else "")
-        conn = http.client.HTTPConnection("127.0.0.1", self.port, timeout=30)
-        try:
-            conn.request(method, url, body=data, headers=headers)
-            r = conn.getresponse()
-            body = r.read()
-            return Resp(r.status, dict(r.getheaders()), body)
-        finally:
-            conn.close()
+        return _send("127.0.0.1", self.port, method, path, query, data,
+                     headers, 30.0)
 
     def raw_request(self, method: str, path_qs: str, *, data=None,
                     headers=None) -> Resp:

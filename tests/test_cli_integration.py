@@ -31,6 +31,18 @@ def _free_port() -> int:
     return p
 
 
+def _boot_standalone(drives, extra=()):
+    """Spawn a standalone server, retrying once on a fresh port if the
+    probe-then-bind race loses the port to another process."""
+    for _ in range(2):
+        port = _free_port()
+        proc = _spawn([*drives, "--address", f"127.0.0.1:{port}", *extra])
+        if _wait_up(port):
+            return port, proc
+        _stop(proc)
+    raise AssertionError("server never became healthy on two ports")
+
+
 def _spawn(args, extra_env=None):
     env = dict(os.environ)
     env["MINIO_TPU_FSYNC"] = "0"
@@ -82,12 +94,9 @@ def _stop(proc):
 
 class TestStandaloneCLI:
     def test_boot_and_round_trip(self, tmp_path):
-        port = _free_port()
         drives = [str(tmp_path / f"d{i}") for i in range(4)]
-        proc = _spawn([*drives, "--address", f"127.0.0.1:{port}",
-                       "--scan-interval", "3600"])
+        port, proc = _boot_standalone(drives, ("--scan-interval", "3600"))
         try:
-            assert _wait_up(port), "server never became healthy"
             assert _req(port, "PUT", "/clibkt")[0] == 200
             data = os.urandom(200_000)
             assert _req(port, "PUT", "/clibkt/obj", data=data)[0] == 200

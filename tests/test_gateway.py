@@ -56,14 +56,13 @@ class GatewayServer:
         self._loop.run_forever()
 
     def close(self):
-        self.server.notifier.close()
-
         async def stop():
             await self._runner.cleanup()
 
         asyncio.run_coroutine_threadsafe(stop(), self._loop).result(10)
         self._loop.call_soon_threadsafe(self._loop.stop)
         self._thread.join(10)
+        self.server.close()
 
     def request(self, method, path, *, data=None, query=None, headers=None):
         query = list(query or [])

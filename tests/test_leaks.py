@@ -1,0 +1,98 @@
+"""Thread-leak detection for the background-service close paths.
+
+Reference analogue: leak-detect_test.go snapshotting goroutine stacks.
+Every subsystem that spawns threads must reclaim them on close():
+ServiceManager (scanner/heal/MRF/monitor/tier/replication), the event
+notifier, site replication, and the full server harness.
+"""
+
+import io
+import os
+import threading
+import time
+
+
+def _threads() -> set[str]:
+    return {t.name for t in threading.enumerate() if t.is_alive()}
+
+
+def _settle(baseline: set[str], timeout: float = 5.0) -> set[str]:
+    """Extra live threads vs baseline after letting closers finish."""
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        extra = {n for n in _threads() - baseline
+                 if not n.startswith("ThreadPoolExecutor")
+                 and not n.startswith("asyncio")
+                 # process-wide singletons, intentionally long-lived
+                 and not n.startswith("shard-io")}
+        if not extra:
+            return set()
+        time.sleep(0.2)
+    return extra
+
+
+class TestCloseReclaimsThreads:
+    def test_service_manager_close(self, tmp_path):
+        from minio_tpu.erasure.objects import PutObjectOptions
+        from minio_tpu.erasure.sets import ErasureSets, ErasureServerPools
+        from minio_tpu.services import ServiceManager
+        from minio_tpu.storage.local import LocalStorage
+
+        os.environ["MINIO_TPU_FSYNC"] = "0"
+        baseline = _threads()
+        disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+        pools = ErasureServerPools([ErasureSets(disks)])
+        pools.make_bucket("lkbkt")
+        pools.put_object("lkbkt", "o", io.BytesIO(b"x" * 1000), 1000,
+                         PutObjectOptions())
+        for _ in range(3):
+            sm = ServiceManager(pools, scan_interval=0.05,
+                                heal_interval=0.05, monitor_interval=0.05)
+            time.sleep(0.3)  # let every worker actually run
+            sm.close()
+        extra = _settle(baseline)
+        assert not extra, f"leaked threads: {extra}"
+
+    def test_full_server_close(self, tmp_path):
+        from tests.s3_harness import S3TestServer
+
+        os.environ["MINIO_TPU_FSYNC"] = "0"
+        baseline = _threads()
+        for i in range(2):
+            s = S3TestServer(str(tmp_path / f"srv{i}"),
+                             start_services=True, scan_interval=0.1)
+            s.request("PUT", "/lkb")
+            s.request("PUT", "/lkb/o", data=b"y" * 500)
+            s.close()  # the ONLY teardown call: close() must reclaim all
+        extra = _settle(baseline)
+        assert not extra, f"leaked threads: {extra}"
+
+    def test_site_replication_close(self, tmp_path):
+        from minio_tpu.erasure.sets import ErasureSets, ErasureServerPools
+        from minio_tpu.storage.local import LocalStorage
+
+        os.environ["MINIO_TPU_FSYNC"] = "0"
+        baseline = _threads()
+        disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+        pools = ErasureServerPools([ErasureSets(disks)])
+
+        class _Meta:
+            on_site_change = None
+
+            def get(self, b):
+                return {}
+
+        class _Iam:
+            on_site_change = None
+
+        from minio_tpu.services.site import SitePeer, SiteReplicationSys
+
+        site = SiteReplicationSys(pools, _Meta(), _Iam())
+        # a peer that will never answer: worker must still shut down
+        site.peers["ghost"] = SitePeer("ghost", "http://127.0.0.1:1",
+                                       "a", "b")
+        site._broadcast({"kind": "bucket-create", "bucket": "x"})
+        time.sleep(0.2)
+        site.close()
+        extra = _settle(baseline)
+        assert not extra, f"leaked threads: {extra}"

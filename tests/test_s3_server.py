@@ -488,3 +488,50 @@ class TestConformanceHardening:
                              "x-amz-tagging-directive": "REPLACE"})
         r = srv.request("HEAD", "/tgdbkt/c3")
         assert "x-amz-tagging-count" not in r.headers
+
+    def test_ssec_copy_source(self, srv):
+        """Copy of an SSE-C source requires (and honors) the
+        x-amz-copy-source-sse-c key triple."""
+        import base64
+        import hashlib as _h
+
+        key = b"\x21" * 32
+        triple = {
+            "x-amz-server-side-encryption-customer-algorithm": "AES256",
+            "x-amz-server-side-encryption-customer-key":
+                base64.b64encode(key).decode(),
+            "x-amz-server-side-encryption-customer-key-md5":
+                base64.b64encode(_h.md5(key).digest()).decode(),
+        }
+        copy_triple = {
+            k.replace("x-amz-", "x-amz-copy-source-"): v
+            for k, v in triple.items()}
+        srv.request("PUT", "/ssecbkt")
+        data = b"customer secret " * 100
+        assert srv.request("PUT", "/ssecbkt/src", data=data,
+                           headers=triple).status == 200
+        # copy without the source key fails
+        r = srv.request("PUT", "/ssecbkt/plain-dst",
+                        headers={"x-amz-copy-source": "/ssecbkt/src"})
+        assert r.status == 400
+        # with the copy-source key, decrypts and stores plaintext dest
+        r = srv.request("PUT", "/ssecbkt/plain-dst",
+                        headers={"x-amz-copy-source": "/ssecbkt/src",
+                                 **copy_triple})
+        assert r.status == 200, r.text()
+        assert srv.request("GET", "/ssecbkt/plain-dst").body == data
+        # and can re-encrypt the destination under a NEW SSE-C key
+        key2 = b"\x42" * 32
+        triple2 = {
+            "x-amz-server-side-encryption-customer-algorithm": "AES256",
+            "x-amz-server-side-encryption-customer-key":
+                base64.b64encode(key2).decode(),
+            "x-amz-server-side-encryption-customer-key-md5":
+                base64.b64encode(_h.md5(key2).digest()).decode(),
+        }
+        r = srv.request("PUT", "/ssecbkt/enc-dst",
+                        headers={"x-amz-copy-source": "/ssecbkt/src",
+                                 **copy_triple, **triple2})
+        assert r.status == 200, r.text()
+        r = srv.request("GET", "/ssecbkt/enc-dst", headers=triple2)
+        assert r.status == 200 and r.body == data

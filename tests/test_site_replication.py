@@ -35,8 +35,6 @@ def sites(tmp_path):
                       "accessKey": b.ak, "secretKey": b.sk}]}).encode())
     assert r.status == 200, r.text()
     yield a, b
-    a.server.site.close()
-    b.server.site.close()
     a.close()
     b.close()
 
@@ -120,8 +118,6 @@ class TestSiteReplication:
             assert _wait(lambda: b.request("HEAD", "/prebkt").status == 200)
             assert _wait(lambda: "preuser" in b.server.iam.users)
         finally:
-            a.server.site.close()
-            b.server.site.close()
             a.close()
             b.close()
 
