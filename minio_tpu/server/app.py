@@ -1455,6 +1455,16 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             if tag_hdr:
                 parse_tag_query(tag_hdr)  # validates
                 src_meta[TAGS_KEY] = tag_hdr
+        from .sse_handlers import parse_ssec_key as _parse_ssec
+
+        if not src_meta.get(sse_mod.META_ALGO) \
+                and _parse_ssec(request.headers,
+                                copy_source=True) is not None:
+            # key supplied for a plaintext source: a client key-management
+            # mistake AWS rejects rather than ignores
+            raise S3Error("InvalidRequest",
+                          "copy-source SSE-C headers sent but the source "
+                          "object is not SSE-C encrypted")
         if src_meta.get(sse_mod.META_ALGO):
             # decrypt the source; SSE-C sources are unlocked by the
             # x-amz-copy-source-sse-c header triple (reference SSECopy)

@@ -105,12 +105,15 @@ class S3TestServer:
         async def stop():
             await self._runner.cleanup()
 
-        fut = asyncio.run_coroutine_threadsafe(stop(), self._loop)
-        fut.result(10)
-        self._loop.call_soon_threadsafe(self._loop.stop)
-        self._thread.join(10)
-        # after the loop stops: no in-flight requests need the executor
-        self.server.close()
+        try:
+            fut = asyncio.run_coroutine_threadsafe(stop(), self._loop)
+            fut.result(10)
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(10)
+        finally:
+            # even a hung aiohttp cleanup must not leak the background
+            # threads into later tests
+            self.server.close()
 
     @property
     def host(self) -> str:

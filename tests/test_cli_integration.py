@@ -110,19 +110,17 @@ class TestStandaloneCLI:
             _stop(proc)
 
     def test_restart_preserves_data(self, tmp_path):
-        port = _free_port()
         drives = [str(tmp_path / f"d{i}") for i in range(4)]
-        args = [*drives, "--address", f"127.0.0.1:{port}",
-                "--scan-interval", "3600"]
-        proc = _spawn(args)
+        port, proc = _boot_standalone(drives, ("--scan-interval", "3600"))
         try:
-            assert _wait_up(port)
             assert _req(port, "PUT", "/persist")[0] == 200
             assert _req(port, "PUT", "/persist/o",
                         data=b"survives restarts")[0] == 200
         finally:
             _stop(proc)
-        proc = _spawn(args)
+        # restart on the SAME port (just freed by the stopped process)
+        proc = _spawn([*drives, "--address", f"127.0.0.1:{port}",
+                       "--scan-interval", "3600"])
         try:
             assert _wait_up(port)
             s, body = _req(port, "GET", "/persist/o")
@@ -133,15 +131,25 @@ class TestStandaloneCLI:
 
 class TestDistributedCLI:
     def test_two_node_cluster(self, tmp_path):
-        p1, p2 = _free_port(), _free_port()
-        eps = [
-            f"http://127.0.0.1:{p1}{tmp_path}/n1/d{{1...3}}",
-            f"http://127.0.0.1:{p2}{tmp_path}/n2/d{{1...3}}",
-        ]
-        n1 = _spawn([*eps, "--address", f"127.0.0.1:{p1}",
-                     "--no-services"])
-        n2 = _spawn([*eps, "--address", f"127.0.0.1:{p2}",
-                     "--no-services"])
+        n1 = n2 = None
+        for _ in range(2):  # retry once if a probed port is stolen
+            p1, p2 = _free_port(), _free_port()
+            eps = [
+                f"http://127.0.0.1:{p1}{tmp_path}/n1/d{{1...3}}",
+                f"http://127.0.0.1:{p2}{tmp_path}/n2/d{{1...3}}",
+            ]
+            n1 = _spawn([*eps, "--address", f"127.0.0.1:{p1}",
+                         "--no-services"])
+            n2 = _spawn([*eps, "--address", f"127.0.0.1:{p2}",
+                         "--no-services"])
+            if _wait_up(p1) and _wait_up(p2):
+                break
+            _stop(n1)
+            _stop(n2)
+            import shutil
+
+            shutil.rmtree(f"{tmp_path}/n1", ignore_errors=True)
+            shutil.rmtree(f"{tmp_path}/n2", ignore_errors=True)
         try:
             # wait for QUORUM health: a node answers /live before its
             # peer's drives connect, and an early write would 503

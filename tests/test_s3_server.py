@@ -535,3 +535,23 @@ class TestConformanceHardening:
         assert r.status == 200, r.text()
         r = srv.request("GET", "/ssecbkt/enc-dst", headers=triple2)
         assert r.status == 200 and r.body == data
+
+    def test_ssec_copy_headers_on_plaintext_source_rejected(self, srv):
+        import base64
+        import hashlib as _h
+
+        key = b"\x33" * 32
+        copy_triple = {
+            "x-amz-copy-source-server-side-encryption-customer-algorithm":
+                "AES256",
+            "x-amz-copy-source-server-side-encryption-customer-key":
+                base64.b64encode(key).decode(),
+            "x-amz-copy-source-server-side-encryption-customer-key-md5":
+                base64.b64encode(_h.md5(key).digest()).decode(),
+        }
+        srv.request("PUT", "/ssecbkt2")
+        srv.request("PUT", "/ssecbkt2/plain", data=b"open data")
+        r = srv.request("PUT", "/ssecbkt2/dst",
+                        headers={"x-amz-copy-source": "/ssecbkt2/plain",
+                                 **copy_triple})
+        assert r.status == 400 and "InvalidRequest" in r.text()
