@@ -1,0 +1,131 @@
+"""Bucket CORS configuration: parsing and request matching.
+
+Reference: S3 CORSConfiguration semantics (the reference serves CORS for
+the console via internal config; the S3-level config API and preflight
+behavior follow AWS): rules with AllowedOrigin (wildcard-able),
+AllowedMethod, AllowedHeader, ExposeHeader, MaxAgeSeconds; the first
+rule matching (origin, method, requested headers) wins.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass, field
+
+_ALLOWED_METHODS = {"GET", "PUT", "POST", "DELETE", "HEAD"}
+
+
+class CORSError(ValueError):
+    pass
+
+
+@dataclass
+class CORSRule:
+    allowed_origins: list[str] = field(default_factory=list)
+    allowed_methods: list[str] = field(default_factory=list)
+    allowed_headers: list[str] = field(default_factory=list)
+    expose_headers: list[str] = field(default_factory=list)
+    max_age_seconds: int = 0
+
+    def match_origin(self, origin: str) -> bool:
+        return any(fnmatch.fnmatchcase(origin, pat)
+                   for pat in self.allowed_origins)
+
+    def match(self, origin: str, method: str,
+              req_headers: list[str]) -> bool:
+        if not self.match_origin(origin):
+            return False
+        if method.upper() not in self.allowed_methods:
+            return False
+        if req_headers:
+            allowed = [h.lower() for h in self.allowed_headers]
+            for h in req_headers:
+                h = h.strip().lower()
+                if not h:
+                    continue
+                if "*" not in allowed and not any(
+                        fnmatch.fnmatchcase(h, a) for a in allowed):
+                    return False
+        return True
+
+
+@dataclass
+class CORSConfig:
+    rules: list[CORSRule] = field(default_factory=list)
+
+    def find(self, origin: str, method: str,
+             req_headers: list[str] | None = None) -> CORSRule | None:
+        for r in self.rules:
+            if r.match(origin, method, req_headers or []):
+                return r
+        return None
+
+
+def _texts(el, tag: str) -> list[str]:
+    ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+    return ([e.text or "" for e in el.findall(f"{ns}{tag}")]
+            or [e.text or "" for e in el.findall(tag)])
+
+
+def parse_cors_xml(body: bytes) -> CORSConfig:
+    try:
+        root = ET.fromstring(body)
+    except ET.ParseError as e:
+        raise CORSError(f"malformed XML: {e}")
+    ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+    rule_els = root.findall(f"{ns}CORSRule") or root.findall("CORSRule")
+    if not rule_els:
+        raise CORSError("at least one CORSRule is required")
+    if len(rule_els) > 100:
+        raise CORSError("no more than 100 CORSRules allowed")
+    cfg = CORSConfig()
+    for el in rule_els:
+        rule = CORSRule(
+            allowed_origins=[o for o in _texts(el, "AllowedOrigin") if o],
+            allowed_methods=[m.upper()
+                             for m in _texts(el, "AllowedMethod") if m],
+            allowed_headers=[h for h in _texts(el, "AllowedHeader") if h],
+            expose_headers=[h for h in _texts(el, "ExposeHeader") if h],
+        )
+        ages = _texts(el, "MaxAgeSeconds")
+        if ages and ages[0]:
+            try:
+                rule.max_age_seconds = int(ages[0])
+            except ValueError:
+                raise CORSError("MaxAgeSeconds must be an integer")
+        if not rule.allowed_origins:
+            raise CORSError("CORSRule requires an AllowedOrigin")
+        if not rule.allowed_methods:
+            raise CORSError("CORSRule requires an AllowedMethod")
+        bad = set(rule.allowed_methods) - _ALLOWED_METHODS
+        if bad:
+            raise CORSError(
+                f"unsupported AllowedMethod: {', '.join(sorted(bad))}")
+        cfg.rules.append(rule)
+    return cfg
+
+
+def cors_headers(rule: CORSRule, origin: str,
+                 preflight_method: str = "",
+                 req_headers: list[str] | None = None) -> dict[str, str]:
+    """Response headers for a matched rule (preflight gets the method/
+    header echoes and max-age; actual responses get expose-headers)."""
+    h = {
+        "Access-Control-Allow-Origin":
+            "*" if rule.allowed_origins == ["*"] else origin,
+        "Vary": "Origin",
+    }
+    # NOTE: no Access-Control-Allow-Credentials — AWS S3 never emits it,
+    # and echoing origins matched by wildcard patterns WITH credentials
+    # would be the exact combination the CORS spec forbids
+    if preflight_method:
+        h["Access-Control-Allow-Methods"] = ", ".join(rule.allowed_methods)
+        if req_headers:
+            h["Access-Control-Allow-Headers"] = ", ".join(
+                x.strip() for x in req_headers if x.strip())
+        if rule.max_age_seconds:
+            h["Access-Control-Max-Age"] = str(rule.max_age_seconds)
+    if rule.expose_headers:
+        h["Access-Control-Expose-Headers"] = ", ".join(rule.expose_headers)
+    return h

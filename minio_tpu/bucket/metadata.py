@@ -29,6 +29,7 @@ QUOTA = "quota"                # JSON {"quota": bytes, "quotatype": "hard"}
 NOTIFICATION = "notification"  # NotificationConfiguration XML
 REPLICATION = "replication"    # ReplicationConfiguration XML
 VERSIONING = "versioning"      # bool (managed by set_versioning)
+CORS = "cors"                  # raw CORSConfiguration XML
 
 
 class BucketMetadataSys:
@@ -43,6 +44,7 @@ class BucketMetadataSys:
         # matching) don't reparse per call
         self._policy_parsed: dict[str, tuple[str, Policy | None]] = {}
         self._notif_parsed: dict[str, tuple[str, object]] = {}
+        self._cors_parsed: dict[str, tuple[str, object]] = {}
         # peer-broadcast hook set by ClusterNode: fn(bucket) after a
         # config mutation, so other nodes invalidate their caches
         # (reference globalNotificationSys.LoadBucketMetadata)
@@ -68,6 +70,7 @@ class BucketMetadataSys:
             self._cache.pop(bucket, None)
             self._policy_parsed.pop(bucket, None)
             self._notif_parsed.pop(bucket, None)
+            self._cors_parsed.pop(bucket, None)
 
     def changed(self, bucket: str) -> None:
         """Invalidate locally and broadcast to peers."""
@@ -104,6 +107,28 @@ class BucketMetadataSys:
         return self.get(bucket).get(key)
 
     # ------------------------------------------------------------ typed views
+    def cors(self, bucket: str):
+        """Parsed CORSConfig (memoized against the raw doc) or None.
+        Served from the TTL cache — the per-response hot path must not
+        stat drives or reparse XML."""
+        raw = self.get(bucket).get(CORS)
+        if not raw:
+            return None
+        with self._lock:
+            hit = self._cors_parsed.get(bucket)
+            if hit is not None and hit[0] == raw:
+                return hit[1]
+        from .cors import CORSError, parse_cors_xml
+
+        try:
+            cfg = parse_cors_xml(raw.encode()
+                                 if isinstance(raw, str) else raw)
+        except CORSError:
+            cfg = None
+        with self._lock:
+            self._cors_parsed[bucket] = (raw, cfg)
+        return cfg
+
     def policy(self, bucket: str) -> Policy | None:
         raw = self.get(bucket).get(POLICY)
         if not raw:

@@ -255,6 +255,9 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         # catch-alls
         self.register_admin_routes(self.app)
         self.register_metrics_routes(self.app)
+        # CORS headers ride on on_response_prepare so STREAMED responses
+        # (prepared inside their handlers) are decorated too
+        self.app.on_response_prepare.append(self._cors_on_prepare)
         self.app.router.add_route("*", "/", self.dispatch_root)
         self.app.router.add_route("*", "/{bucket}", self.dispatch_bucket)
         self.app.router.add_route("*", "/{bucket}/{key:.*}", self.dispatch_object)
@@ -619,6 +622,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         "acl": "get_bucket_acl", "cors": "get_bucket_cors",
     }
     _BUCKET_PUT = {
+        "cors": "put_bucket_cors",
         "versioning": "put_versioning", "policy": "put_bucket_policy",
         "lifecycle": "put_bucket_lifecycle", "tagging": "put_bucket_tagging",
         "encryption": "put_bucket_encryption",
@@ -628,6 +632,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         "acl": "put_bucket_acl",
     }
     _BUCKET_DELETE = {
+        "cors": "delete_bucket_cors",
         "policy": "delete_bucket_policy",
         "lifecycle": "delete_bucket_lifecycle",
         "tagging": "delete_bucket_tagging",
@@ -661,6 +666,8 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
     async def dispatch_bucket(self, request: web.Request) -> web.StreamResponse:
         q = request.rel_url.query
         m = request.method
+        if m == "OPTIONS":
+            return await self._handle(request, self.cors_preflight)
         if m == "GET":
             fn = self._subresource_route(q, self._BUCKET_GET)
             return await self._handle(request, fn or self.list_objects)
@@ -683,6 +690,8 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
     async def dispatch_object(self, request: web.Request) -> web.StreamResponse:
         q = request.rel_url.query
         m = request.method
+        if m == "OPTIONS":
+            return await self._handle(request, self.cors_preflight)
         if m == "GET":
             if "uploadId" in q:
                 return await self._handle(request, self.list_parts)
@@ -1303,6 +1312,61 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         self._emit(EventName.OBJECT_CREATED_PUT, bucket, key, size=oi.size,
                    etag=oi.etag, version_id=oi.version_id, request=request)
         return web.Response(status=200, headers=headers)
+
+    async def _cors_config(self, bucket: str):
+        try:
+            return await self._run(self.meta.cors, bucket)
+        except st.BucketNotFound:
+            return None
+        # any OTHER storage error propagates: a quorum outage must
+        # surface as a 5xx, not masquerade as a CORS denial
+
+    async def cors_preflight(self, request: web.Request) -> web.Response:
+        """OPTIONS preflight against the bucket's CORS config (AWS
+        preflight semantics; unauthenticated by design)."""
+        from minio_tpu.bucket import cors as cors_mod
+
+        bucket = self._bucket(request)
+        origin = request.headers.get("Origin", "")
+        method = request.headers.get("Access-Control-Request-Method", "")
+        req_headers = [
+            h for h in request.headers.get(
+                "Access-Control-Request-Headers", "").split(",") if h]
+        if not origin or not method:
+            raise S3Error("BadRequest",
+                          "Insufficient information. Origin and "
+                          "Access-Control-Request-Method are required.")
+        cfg = await self._cors_config(bucket)
+        rule = cfg.find(origin, method, req_headers) if cfg else None
+        if rule is None:
+            raise S3Error("AccessDenied",
+                          "CORSResponse: this CORS request is not allowed")
+        return web.Response(status=200, headers=cors_mod.cors_headers(
+            rule, origin, preflight_method=method,
+            req_headers=req_headers))
+
+    async def _cors_on_prepare(self, request: web.Request, resp) -> None:
+        """Decorate ACTUAL responses with CORS headers when the bucket's
+        config matches the request's Origin (fires for plain and
+        streamed responses alike)."""
+        try:
+            origin = request.headers.get("Origin", "")
+            bucket = request.match_info.get("bucket", "")
+            if not origin or not bucket or request.method == "OPTIONS":
+                return
+            from minio_tpu.bucket import cors as cors_mod
+
+            cfg = await self._cors_config(bucket)
+            rule = cfg.find(origin, request.method) if cfg else None
+            if rule is not None:
+                for k, v in cors_mod.cors_headers(rule, origin).items():
+                    if k not in resp.headers:
+                        resp.headers[k] = v
+        except Exception as e:
+            # decoration must never break a response, but silence would
+            # make outages look like CORS misconfiguration
+            log.warning("CORS decoration failed", bucket=bucket,
+                        error=repr(e))
 
     async def _maybe_replicate(self, request, bucket: str, key: str,
                                oi) -> str | None:

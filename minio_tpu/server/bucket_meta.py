@@ -319,9 +319,40 @@ class BucketMetaHandlers:
     async def get_bucket_cors(self, request: web.Request) -> web.Response:
         bucket = self._bucket(request)
         await self._auth(request, None, "s3:GetBucketCORS", bucket)
-        if not await self._run(self.api.bucket_exists, bucket):
-            raise S3Error("NoSuchBucket", resource=bucket)
-        raise S3Error("NoSuchCORSConfiguration", resource=bucket)
+        from minio_tpu.bucket import metadata as bm
+
+        raw = await self._run(self.meta.get_config, bucket, bm.CORS)
+        if not raw:
+            raise S3Error("NoSuchCORSConfiguration", resource=bucket)
+        return self._xml(200, raw)
+
+    async def put_bucket_cors(self, request: web.Request) -> web.Response:
+        from minio_tpu.bucket import metadata as bm
+        from minio_tpu.bucket.cors import CORSError, parse_cors_xml
+
+        body = await request.read()
+        bucket = self._bucket(request)
+        await self._auth(request, hashlib.sha256(body).hexdigest(),
+                         "s3:PutBucketCORS", bucket)
+        try:
+            parse_cors_xml(body)  # validate before storing
+            raw = body.decode("utf-8")  # strict: GET must return PUT bytes
+        except CORSError as e:
+            raise S3Error("MalformedXML", str(e))
+        except UnicodeDecodeError:
+            raise S3Error("MalformedXML",
+                          "CORS configuration must be UTF-8")
+        await self._run(self.meta.set_config, bucket, bm.CORS, raw)
+        return web.Response(status=200)
+
+    async def delete_bucket_cors(self, request: web.Request
+                                 ) -> web.Response:
+        bucket = self._bucket(request)
+        await self._auth(request, None, "s3:PutBucketCORS", bucket)
+        from minio_tpu.bucket import metadata as bm
+
+        await self._run(self.meta.delete_config, bucket, bm.CORS)
+        return web.Response(status=204)
 
 
 def parse_tagging_xml(body: bytes) -> dict[str, str]:

@@ -112,8 +112,13 @@ class TestPolicyLayering:
 
     def test_subresource_never_falls_through(self, srv):
         srv.request("PUT", "/safeb")
-        # DELETE ?cors must NOT delete the bucket (real S3 DeleteBucketCors)
+        # DELETE ?cors is now a real DeleteBucketCors: it must clear the
+        # config, NEVER delete the bucket itself
         r = srv.request("DELETE", "/safeb", query=_q("cors"))
+        assert r.status == 204
+        assert srv.request("HEAD", "/safeb").status == 200
+        # an unimplemented subresource must answer 501, not fall through
+        r = srv.request("DELETE", "/safeb", query=_q("website"))
         assert r.status == 501
         assert srv.request("HEAD", "/safeb").status == 200
         # PUT ?website must NOT create/replace the bucket

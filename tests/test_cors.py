@@ -1,0 +1,111 @@
+"""Bucket CORS: config CRUD, OPTIONS preflight, actual-response headers.
+
+Reference: AWS CORSConfiguration semantics (the S3-level surface the
+reference exposes to browsers)."""
+
+import os
+
+import pytest
+
+from minio_tpu.bucket.cors import CORSError, parse_cors_xml
+from tests.s3_harness import S3TestServer
+
+CFG = (
+    '<CORSConfiguration>'
+    '<CORSRule>'
+    '<AllowedOrigin>https://app.example.com</AllowedOrigin>'
+    '<AllowedMethod>GET</AllowedMethod><AllowedMethod>PUT</AllowedMethod>'
+    '<AllowedHeader>x-amz-meta-*</AllowedHeader>'
+    '<ExposeHeader>ETag</ExposeHeader>'
+    '<MaxAgeSeconds>600</MaxAgeSeconds>'
+    '</CORSRule>'
+    '<CORSRule>'
+    '<AllowedOrigin>*</AllowedOrigin>'
+    '<AllowedMethod>HEAD</AllowedMethod>'
+    '</CORSRule>'
+    '</CORSConfiguration>'
+).encode()
+
+
+class TestParser:
+    def test_parse(self):
+        cfg = parse_cors_xml(CFG)
+        assert len(cfg.rules) == 2
+        r = cfg.find("https://app.example.com", "PUT",
+                     ["x-amz-meta-color"])
+        assert r is cfg.rules[0]
+        # header not allowed -> no match on rule 0; HEAD matches rule 1
+        assert cfg.find("https://app.example.com", "PUT",
+                        ["authorization"]) is None
+        assert cfg.find("https://other.io", "HEAD") is cfg.rules[1]
+        assert cfg.find("https://other.io", "GET") is None
+
+    def test_invalid(self):
+        with pytest.raises(CORSError):
+            parse_cors_xml(b"<CORSConfiguration></CORSConfiguration>")
+        with pytest.raises(CORSError):
+            parse_cors_xml(
+                b"<CORSConfiguration><CORSRule>"
+                b"<AllowedOrigin>*</AllowedOrigin>"
+                b"<AllowedMethod>PATCH</AllowedMethod>"
+                b"</CORSRule></CORSConfiguration>")
+
+
+@pytest.fixture(scope="module")
+def srv(tmp_path_factory):
+    os.environ["MINIO_TPU_FSYNC"] = "0"
+    s = S3TestServer(str(tmp_path_factory.mktemp("cors")))
+    s.request("PUT", "/corsbkt")
+    assert s.request("PUT", "/corsbkt", query=[("cors", "")],
+                     data=CFG).status == 200
+    yield s
+    s.close()
+
+
+class TestCORSHTTP:
+    def test_config_round_trip(self, srv):
+        r = srv.request("GET", "/corsbkt", query=[("cors", "")])
+        assert r.status == 200 and b"AllowedOrigin" in r.body
+
+    def test_preflight_allowed(self, srv):
+        r = srv.raw_request(
+            "OPTIONS", "/corsbkt/some/key",
+            headers={"Origin": "https://app.example.com",
+                     "Access-Control-Request-Method": "PUT",
+                     "Access-Control-Request-Headers": "x-amz-meta-tag"})
+        assert r.status == 200, r.text()
+        assert r.headers["Access-Control-Allow-Origin"] == \
+            "https://app.example.com"
+        assert "PUT" in r.headers["Access-Control-Allow-Methods"]
+        assert r.headers["Access-Control-Max-Age"] == "600"
+
+    def test_preflight_denied(self, srv):
+        r = srv.raw_request(
+            "OPTIONS", "/corsbkt/k",
+            headers={"Origin": "https://evil.example.com",
+                     "Access-Control-Request-Method": "DELETE"})
+        assert r.status == 403
+
+    def test_actual_response_headers(self, srv):
+        srv.request("PUT", "/corsbkt/obj", data=b"cors data")
+        r = srv.request("GET", "/corsbkt/obj",
+                        headers={"Origin": "https://app.example.com"})
+        assert r.status == 200
+        assert r.headers.get("Access-Control-Allow-Origin") == \
+            "https://app.example.com"
+        assert r.headers.get("Access-Control-Expose-Headers") == "ETag"
+        # non-matching origin: no CORS headers leak
+        r = srv.request("GET", "/corsbkt/obj",
+                        headers={"Origin": "https://evil.example.com"})
+        assert "Access-Control-Allow-Origin" not in r.headers
+
+    def test_delete_config(self, srv):
+        assert srv.request("DELETE", "/corsbkt",
+                           query=[("cors", "")]).status == 204
+        r = srv.request("GET", "/corsbkt", query=[("cors", "")])
+        assert r.status == 404
+        r = srv.raw_request(
+            "OPTIONS", "/corsbkt/k",
+            headers={"Origin": "https://app.example.com",
+                     "Access-Control-Request-Method": "GET"})
+        assert r.status == 403
