@@ -94,6 +94,8 @@ def parse_cors_xml(body: bytes) -> CORSConfig:
                 rule.max_age_seconds = int(ages[0])
             except ValueError:
                 raise CORSError("MaxAgeSeconds must be an integer")
+            if rule.max_age_seconds < 0:
+                raise CORSError("MaxAgeSeconds must not be negative")
         if not rule.allowed_origins:
             raise CORSError("CORSRule requires an AllowedOrigin")
         if not rule.allowed_methods:

@@ -120,3 +120,53 @@ def sharded_pipeline_step(mesh: Mesh, k: int, m: int, heal_wanted=(0,)):
         return parity, rebuilt, loss
 
     return step
+
+
+def reshard_blocks_to_shards(mesh: Mesh):
+    """All-to-all layout transpose over ICI: block-sharded rows become
+    shard-sharded columns.
+
+    The storage analogue of sequence-parallel all-to-all (DeepSpeed-
+    Ulysses style): after a distributed encode each device holds ALL
+    shard columns of ITS blocks; the drive-write phase wants each device
+    to hold ONE shard column of ALL blocks (so every device streams one
+    complete per-drive shard file).  One `lax.all_to_all` over the
+    blocks axis performs the exchange entirely on interconnect.
+
+    In:  (B, N, S) laid out P("blocks", "shards", None)
+         (per-device: a block-row slice of every shard column it owns)
+    Out: (B, N, S) laid out P(None, ("shards", "blocks"), None)
+         (per-device: ALL blocks of a narrower shard-column range — the
+         complete per-drive streams).  Requires the per-device shard
+         width N/ns to be divisible by the blocks axis size.
+    """
+    def local(x):  # x: (B/nb, N/ns, S)
+        return jax.lax.all_to_all(
+            x, "blocks", split_axis=1, concat_axis=0, tiled=True)
+
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=P("blocks", "shards", None),
+        out_specs=P(None, ("shards", "blocks"), None),
+    )
+
+
+def ring_rotate_shards(mesh: Mesh, shift: int = 1):
+    """Ring `ppermute` over the shards axis: every device hands its
+    shard slice to its ring neighbor.
+
+    The storage analogue of ring attention's neighbor exchange: when a
+    device's drive drops out of a write set, shard responsibility
+    rotates around the ICI ring instead of rerouting through a host.
+    """
+    ns = mesh.shape["shards"]
+    perm = [(i, (i + shift) % ns) for i in range(ns)]
+
+    def local(x):
+        return jax.lax.ppermute(x, "shards", perm)
+
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=P("blocks", "shards", None),
+        out_specs=P("blocks", "shards", None),
+    )

@@ -1314,12 +1314,13 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         return web.Response(status=200, headers=headers)
 
     async def _cors_config(self, bucket: str):
-        try:
-            return await self._run(self.meta.cors, bucket)
-        except st.BucketNotFound:
-            return None
-        # any OTHER storage error propagates: a quorum outage must
-        # surface as a 5xx, not masquerade as a CORS denial
+        # NOTE: get_bucket_metadata degrades to {} when drives are
+        # unreachable (its callers treat missing metadata as empty), so
+        # a total outage presents as "no CORS config" here — the browser
+        # sees a denial rather than a 5xx. Accepted trade-off: the
+        # alternative (erroring metadata reads) would break every
+        # config-optional caller.
+        return await self._run(self.meta.cors, bucket)
 
     async def cors_preflight(self, request: web.Request) -> web.Response:
         """OPTIONS preflight against the bucket's CORS config (AWS

@@ -109,3 +109,14 @@ class TestCORSHTTP:
             headers={"Origin": "https://app.example.com",
                      "Access-Control-Request-Method": "GET"})
         assert r.status == 403
+
+
+class TestCORSValidation:
+    def test_negative_max_age_rejected(self, srv):
+        bad = (b'<CORSConfiguration><CORSRule>'
+               b'<AllowedOrigin>*</AllowedOrigin>'
+               b'<AllowedMethod>GET</AllowedMethod>'
+               b'<MaxAgeSeconds>-1</MaxAgeSeconds>'
+               b'</CORSRule></CORSConfiguration>')
+        r = srv.request("PUT", "/corsbkt", query=[("cors", "")], data=bad)
+        assert r.status == 400
