@@ -45,6 +45,7 @@ class BucketMetadataSys:
         self._policy_parsed: dict[str, tuple[str, Policy | None]] = {}
         self._notif_parsed: dict[str, tuple[str, object]] = {}
         self._cors_parsed: dict[str, tuple[str, object]] = {}
+        self._lock_parsed: dict[str, tuple[str, tuple]] = {}
         # peer-broadcast hook set by ClusterNode: fn(bucket) after a
         # config mutation, so other nodes invalidate their caches
         # (reference globalNotificationSys.LoadBucketMetadata)
@@ -71,6 +72,7 @@ class BucketMetadataSys:
             self._policy_parsed.pop(bucket, None)
             self._notif_parsed.pop(bucket, None)
             self._cors_parsed.pop(bucket, None)
+            self._lock_parsed.pop(bucket, None)
 
     def changed(self, bucket: str) -> None:
         """Invalidate locally and broadcast to peers."""
@@ -107,6 +109,41 @@ class BucketMetadataSys:
         return self.get(bucket).get(key)
 
     # ------------------------------------------------------------ typed views
+    def default_retention(self, bucket: str) -> tuple[str, int]:
+        """(mode, seconds) of the bucket's object-lock DefaultRetention
+        rule, or ('', 0).  Memoized against the raw config — this runs
+        on every PUT."""
+        raw = self.get(bucket).get(OBJECT_LOCK)
+        if not raw:
+            return "", 0
+        with self._lock:
+            hit = self._lock_parsed.get(bucket)
+            if hit is not None and hit[0] == raw:
+                return hit[1]
+        out = ("", 0)
+        try:
+            import xml.etree.ElementTree as ET
+
+            root = ET.fromstring(raw)
+            mode = days = years = None
+            for e in root.iter():
+                tag = e.tag.rsplit("}", 1)[-1]
+                if tag == "Mode":
+                    mode = (e.text or "").strip()
+                elif tag == "Days":
+                    days = int((e.text or "0").strip() or 0)
+                elif tag == "Years":
+                    years = int((e.text or "0").strip() or 0)
+            if mode in ("GOVERNANCE", "COMPLIANCE")                     and not (days and years):
+                seconds = (days or 0) * 86400                     + (years or 0) * 365 * 86400
+                if seconds > 0:
+                    out = (mode, seconds)
+        except (ET.ParseError, ValueError):
+            out = ("", 0)  # malformed config must never break PUTs
+        with self._lock:
+            self._lock_parsed[bucket] = (raw, out)
+        return out
+
     def cors(self, bucket: str):
         """Parsed CORSConfig (memoized against the raw doc) or None.
         Served from the TTL cache — the per-response hot path must not

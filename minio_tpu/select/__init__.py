@@ -12,7 +12,8 @@ import xml.etree.ElementTree as ET
 from typing import Iterator
 
 from . import eventstream as es
-from .records import CSVInput, CSVOutput, JSONInput, JSONOutput
+from .records import (CSVInput, CSVOutput, JSONInput, JSONOutput,
+                      ParquetInput)
 from .sql import Evaluator, SQLError, parse
 
 # flush records to the client in ~256 KiB batches like the reference
@@ -84,7 +85,7 @@ def _make_input(req: SelectRequest, stream):
         return JSONInput(stream, json_type=j.get("Type", "DOCUMENT"),
                          compression=compression)
     if "Parquet" in inp:
-        raise SQLError("Parquet input is not supported")
+        return ParquetInput(stream, compression=compression)
     raise SQLError("InputSerialization requires CSV or JSON")
 
 

@@ -127,6 +127,40 @@ class JSONInput:
         return doc if isinstance(doc, dict) else {"_1": doc}
 
 
+class ParquetInput:
+    """Parquet records via pyarrow (reference internal/s3select/parquet).
+
+    Parquet needs random access (footer at the tail), so the source
+    stream is buffered before parsing; row groups then stream through
+    as python dicts."""
+
+    def __init__(self, stream, compression: str = "NONE"):
+        if (compression or "NONE").upper() not in ("NONE", ""):
+            raise SQLError(
+                "CompressionType must be NONE for Parquet input")
+        self.raw = stream
+
+    def __iter__(self) -> Iterator[dict]:
+        try:
+            import pyarrow.parquet as pq
+        except ImportError:
+            raise SQLError("Parquet input is not supported on this build")
+        data = self.raw.read()
+        try:
+            pf = pq.ParquetFile(io.BytesIO(data))
+        except Exception as e:
+            raise SQLError(f"invalid Parquet input: {e}")
+        try:
+            for batch in pf.iter_batches():
+                yield from batch.to_pylist()
+        except SQLError:
+            raise
+        except Exception as e:
+            # corrupt data pages surface in-band as InvalidQuery, not
+            # as a severed stream / 500
+            raise SQLError(f"invalid Parquet input: {e}")
+
+
 # ------------------------------------------------------------------ output
 
 

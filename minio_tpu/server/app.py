@@ -1171,6 +1171,9 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 if hold not in ("ON", "OFF"):
                     raise S3Error("InvalidArgument", "bad legal-hold status")
                 user_meta[LOCK_HOLD_KEY] = hold
+        # bucket default retention applies when the request sets none
+        # (reference filterObjectLockMetadata + default retention)
+        await self._apply_default_retention(bucket, user_meta)
         # replication decision (reference mustReplicate,
         # cmd/bucket-replication.go:169): a matching rule marks the version
         # PENDING and enqueues after commit; an incoming replica PUT from a
@@ -1448,6 +1451,35 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             if t is not None and soi.mod_time <= t + 1:
                 raise S3Error("PreconditionFailed")
 
+    async def _default_retention(self, bucket: str) -> tuple[str, str]:
+        """(mode, retain-until) from the bucket's object-lock
+        DefaultRetention rule, or ('', '') — parsed form is memoized on
+        the bucket-metadata cache."""
+        try:
+            mode, seconds = await self._run(
+                self.meta.default_retention, bucket)
+        except Exception:
+            return "", ""
+        if not mode:
+            return "", ""
+        until = datetime.fromtimestamp(
+            time.time() + seconds, timezone.utc
+        ).strftime("%Y-%m-%dT%H:%M:%SZ")
+        return mode, until
+
+    async def _apply_default_retention(self, bucket: str,
+                                       user_meta: dict) -> None:
+        """Stamp the bucket's default retention when the metadata does
+        not already carry an explicit mode (PUT/copy/multipart must all
+        agree — an unprotected copy into a WORM bucket would be a
+        bypass)."""
+        if LOCK_MODE_KEY in user_meta:
+            return
+        dmode, duntil = await self._default_retention(bucket)
+        if dmode:
+            user_meta[LOCK_MODE_KEY] = dmode
+            user_meta[LOCK_UNTIL_KEY] = duntil
+
     def _compress_eligible(self, key: str, content_type: str) -> bool:
         if not self.config.get_bool("compression", "enable"):
             return False
@@ -1563,6 +1595,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             data = b"".join(compress_mod.decompress_stream(iter([data])))
             src_meta.pop(compress_mod.META_COMPRESSION, None)
             src_meta.pop(compress_mod.META_ACTUAL_SIZE, None)
+        await self._apply_default_retention(bucket, src_meta)
         opts = PutObjectOptions(
             content_type=soi.content_type,
             user_metadata=src_meta,
@@ -1891,6 +1924,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 if k.lower().startswith("x-amz-meta-")
             },
         )
+        await self._apply_default_retention(bucket, opts.user_metadata)
         uid = await self._run(self.api.new_multipart_upload, bucket, key, opts)
         return self._xml(200, (
             f'<?xml version="1.0" encoding="UTF-8"?>'

@@ -187,6 +187,36 @@ class BucketMetaHandlers:
                       and (e.text or "") == "Enabled" for e in root.iter())
         if not enabled:
             raise S3Error("MalformedXML", "ObjectLockEnabled must be Enabled")
+        # DefaultRetention sanity: valid mode, integer Days XOR Years,
+        # positive (a malformed config must never get stored — it would
+        # poison every later PUT's retention stamping)
+        mode = days = years = None
+        for e in root.iter():
+            tag = e.tag.rsplit("}", 1)[-1]
+            if tag == "Mode":
+                mode = (e.text or "").strip()
+            elif tag in ("Days", "Years"):
+                try:
+                    v = int((e.text or "").strip())
+                except ValueError:
+                    raise S3Error("MalformedXML",
+                                  f"{tag} must be an integer")
+                if v <= 0:
+                    raise S3Error("MalformedXML",
+                                  f"{tag} must be positive")
+                if tag == "Days":
+                    days = v
+                else:
+                    years = v
+        if (days or years) and mode not in ("GOVERNANCE", "COMPLIANCE"):
+            raise S3Error("MalformedXML",
+                          "DefaultRetention requires a valid Mode")
+        if mode and not (days or years):
+            raise S3Error("MalformedXML",
+                          "DefaultRetention requires Days or Years")
+        if days and years:
+            raise S3Error("MalformedXML",
+                          "DefaultRetention takes Days OR Years, not both")
         # object lock requires versioning (S3 invariant)
         if not await self._versioned(bucket):
             setter = getattr(self.api, "set_versioning", None)

@@ -378,3 +378,104 @@ class TestPostPolicy:
         fields["success_action_status"] = "201"
         r = self._post(srv, "postb", fields, b"x")
         assert r.status == 201 and "<PostResponse>" in r.text()
+
+
+class TestDefaultRetention:
+    def test_bucket_default_retention_applies(self, srv):
+        # lock-enabled bucket with a GOVERNANCE 1-day default
+        r = srv.request("PUT", "/dretbkt",
+                        headers={"x-amz-bucket-object-lock-enabled": "true"})
+        assert r.status == 200
+        cfg = (b'<ObjectLockConfiguration>'
+               b'<ObjectLockEnabled>Enabled</ObjectLockEnabled>'
+               b'<Rule><DefaultRetention><Mode>GOVERNANCE</Mode>'
+               b'<Days>1</Days></DefaultRetention></Rule>'
+               b'</ObjectLockConfiguration>')
+        assert srv.request("PUT", "/dretbkt", query=[("object-lock", "")],
+                           data=cfg).status == 200
+        r = srv.request("PUT", "/dretbkt/locked", data=b"worm me")
+        assert r.status == 200
+        vid = r.headers.get("x-amz-version-id", "")
+        # retention visible via GetObjectRetention
+        r = srv.request("GET", "/dretbkt/locked",
+                        query=[("retention", "")])
+        assert r.status == 200 and b"GOVERNANCE" in r.body
+        # version-targeted delete without bypass is blocked
+        r = srv.request("DELETE", "/dretbkt/locked",
+                        query=[("versionId", vid)])
+        assert r.status == 403
+        # explicit request headers still override the default
+        import time as _t
+
+        until = _t.strftime("%Y-%m-%dT%H:%M:%SZ",
+                            _t.gmtime(_t.time() + 7200))
+        r = srv.request("PUT", "/dretbkt/explicit", data=b"x",
+                        headers={"x-amz-object-lock-mode": "COMPLIANCE",
+                                 "x-amz-object-lock-retain-until-date":
+                                     until})
+        assert r.status == 200
+        r = srv.request("GET", "/dretbkt/explicit",
+                        query=[("retention", "")])
+        assert b"COMPLIANCE" in r.body
+
+    def test_default_retention_covers_copy_and_multipart(self, srv):
+        r = srv.request("PUT", "/dretbkt2",
+                        headers={"x-amz-bucket-object-lock-enabled": "true"})
+        assert r.status == 200
+        cfg = (b'<ObjectLockConfiguration>'
+               b'<ObjectLockEnabled>Enabled</ObjectLockEnabled>'
+               b'<Rule><DefaultRetention><Mode>GOVERNANCE</Mode>'
+               b'<Days>1</Days></DefaultRetention></Rule>'
+               b'</ObjectLockConfiguration>')
+        assert srv.request("PUT", "/dretbkt2", query=[("object-lock", "")],
+                           data=cfg).status == 200
+        # plain source WITHOUT lock metadata, outside the bucket
+        srv.request("PUT", "/dretsrc")
+        srv.request("PUT", "/dretsrc/plain", data=b"x")
+        # copy INTO the WORM bucket gets default retention
+        r = srv.request("PUT", "/dretbkt2/copied",
+                        headers={"x-amz-copy-source": "/dretsrc/plain"})
+        assert r.status == 200
+        r = srv.request("GET", "/dretbkt2/copied",
+                        query=[("retention", "")])
+        assert r.status == 200 and b"GOVERNANCE" in r.body
+        # multipart completion gets it too
+        r = srv.request("POST", "/dretbkt2/mp", query=[("uploads", "")])
+        uid = r.body.decode().split("<UploadId>")[1].split("</UploadId>")[0]
+        r = srv.request("PUT", "/dretbkt2/mp",
+                        query=[("partNumber", "1"), ("uploadId", uid)],
+                        data=b"p" * (5 << 20))
+        etag = r.headers["ETag"].strip('"')
+        done = (f'<CompleteMultipartUpload><Part><PartNumber>1</PartNumber>'
+                f'<ETag>"{etag}"</ETag></Part>'
+                f'</CompleteMultipartUpload>').encode()
+        assert srv.request("POST", "/dretbkt2/mp",
+                           query=[("uploadId", uid)],
+                           data=done).status == 200
+        r = srv.request("GET", "/dretbkt2/mp", query=[("retention", "")])
+        assert r.status == 200 and b"GOVERNANCE" in r.body
+
+    def test_malformed_lock_config_rejected(self, srv):
+        r = srv.request("PUT", "/dretbkt3",
+                        headers={"x-amz-bucket-object-lock-enabled": "true"})
+        assert r.status == 200
+        for bad in (
+            b'<ObjectLockConfiguration>'
+            b'<ObjectLockEnabled>Enabled</ObjectLockEnabled>'
+            b'<Rule><DefaultRetention><Mode>GOVERNANCE</Mode>'
+            b'<Days>seven</Days></DefaultRetention></Rule>'
+            b'</ObjectLockConfiguration>',
+            b'<ObjectLockConfiguration>'
+            b'<ObjectLockEnabled>Enabled</ObjectLockEnabled>'
+            b'<Rule><DefaultRetention><Mode>GOVERNANCE</Mode>'
+            b'<Days>30</Days><Years>1</Years></DefaultRetention></Rule>'
+            b'</ObjectLockConfiguration>',
+            b'<ObjectLockConfiguration>'
+            b'<ObjectLockEnabled>Enabled</ObjectLockEnabled>'
+            b'<Rule><DefaultRetention><Mode>BOGUS</Mode>'
+            b'<Days>1</Days></DefaultRetention></Rule>'
+            b'</ObjectLockConfiguration>',
+        ):
+            r = srv.request("PUT", "/dretbkt3",
+                            query=[("object-lock", "")], data=bad)
+            assert r.status == 400, bad

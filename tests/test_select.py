@@ -278,3 +278,62 @@ class TestSelectHTTP:
             "POST", "/selbkt/data.csv?select=&select-type=2",
             data=_select_req("SELECT * FROM S3Object"))
         assert r.status == 403
+
+
+class TestParquet:
+    def _parquet_bytes(self):
+        import io as _io
+
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        table = pa.table({
+            "name": ["alice", "bob", "carol"],
+            "age": [30, 25, 35],
+            "city": ["paris", "london", "paris"],
+        })
+        buf = _io.BytesIO()
+        pq.write_table(table, buf)
+        return buf.getvalue()
+
+    def test_parquet_engine(self):
+        data = self._parquet_bytes()
+        req = SelectRequest(
+            "SELECT name FROM S3Object WHERE city = 'paris'",
+            {"Parquet": {}}, {"CSV": {}})
+        msgs = list(run_select(req, io.BytesIO(data), len(data)))
+        events = es.decode_all(b"".join(msgs))
+        recs = b"".join(e["payload"] for e in events
+                        if e["headers"].get(":event-type") == "Records")
+        assert recs == b"alice\ncarol\n"
+
+    def test_parquet_aggregate(self):
+        data = self._parquet_bytes()
+        req = SelectRequest(
+            "SELECT COUNT(*), AVG(age) FROM S3Object",
+            {"Parquet": {}}, {"JSON": {}})
+        msgs = list(run_select(req, io.BytesIO(data), len(data)))
+        events = es.decode_all(b"".join(msgs))
+        recs = b"".join(e["payload"] for e in events
+                        if e["headers"].get(":event-type") == "Records")
+        assert json.loads(recs)["_2"] == 30.0
+
+    def test_parquet_over_http(self, srv):
+        data = self._parquet_bytes()
+        srv.request("PUT", "/selbkt/t.parquet", data=data)
+        body = (
+            '<SelectObjectContentRequest>'
+            '<Expression>SELECT city FROM S3Object WHERE age &gt; 26'
+            '</Expression><ExpressionType>SQL</ExpressionType>'
+            '<InputSerialization><Parquet/></InputSerialization>'
+            '<OutputSerialization><CSV/></OutputSerialization>'
+            '</SelectObjectContentRequest>'
+        ).encode()
+        r = srv.request("POST", "/selbkt/t.parquet",
+                        query=[("select", ""), ("select-type", "2")],
+                        data=body)
+        assert r.status == 200, r.text()
+        events = es.decode_all(r.body)
+        recs = b"".join(e["payload"] for e in events
+                        if e["headers"].get(":event-type") == "Records")
+        assert recs == b"paris\nparis\n"
