@@ -145,10 +145,21 @@ class ParquetInput:
             import pyarrow.parquet as pq
         except ImportError:
             raise SQLError("Parquet input is not supported on this build")
-        data = self.raw.read()
+        import tempfile
+
+        # pyarrow needs random access (footer at the tail); spool to a
+        # temp file past 64 MiB so multi-GB objects never sit in RAM
+        spool = tempfile.SpooledTemporaryFile(max_size=64 << 20)
+        while True:
+            chunk = self.raw.read(1 << 20)
+            if not chunk:
+                break
+            spool.write(chunk)
+        spool.seek(0)
         try:
-            pf = pq.ParquetFile(io.BytesIO(data))
+            pf = pq.ParquetFile(spool)
         except Exception as e:
+            spool.close()
             raise SQLError(f"invalid Parquet input: {e}")
         try:
             for batch in pf.iter_batches():
@@ -159,6 +170,8 @@ class ParquetInput:
             # corrupt data pages surface in-band as InvalidQuery, not
             # as a severed stream / 500
             raise SQLError(f"invalid Parquet input: {e}")
+        finally:
+            spool.close()
 
 
 # ------------------------------------------------------------------ output

@@ -765,6 +765,20 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         await self._auth(request, None, "s3:CreateBucket", bucket)
         await request.read()
         await self._run(self.api.make_bucket, bucket)
+        if request.headers.get(
+                "x-amz-bucket-object-lock-enabled", "").lower() == "true":
+            # CreateBucket with lock enables object lock AND versioning
+            # (reference: ObjectLockEnabledForBucket -> versioned WORM)
+            from minio_tpu.bucket import metadata as bm
+
+            await self._run(
+                self.meta.set_config, bucket, bm.OBJECT_LOCK,
+                '<ObjectLockConfiguration>'
+                '<ObjectLockEnabled>Enabled</ObjectLockEnabled>'
+                '</ObjectLockConfiguration>')
+            setter = getattr(self.api, "set_versioning", None)
+            if setter is not None:
+                await self._run(setter, bucket, True)
         self.site.on_bucket_created(bucket)
         return web.Response(status=200, headers={"Location": f"/{bucket}"})
 
@@ -1146,31 +1160,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         if tag_hdr:
             parse_tag_query(tag_hdr)  # validates
             user_meta[TAGS_KEY] = tag_hdr
-        if any(request.headers.get(lk)
-               for lk in (LOCK_MODE_KEY, LOCK_UNTIL_KEY, LOCK_HOLD_KEY)):
-            if not await self._run(self.meta.object_lock_enabled, bucket):
-                raise S3Error("InvalidRequest",
-                              "bucket is not object-lock enabled")
-            mode = request.headers.get(LOCK_MODE_KEY, "")
-            until = request.headers.get(LOCK_UNTIL_KEY, "")
-            hold = request.headers.get(LOCK_HOLD_KEY, "")
-            if bool(mode) != bool(until):
-                raise S3Error("InvalidArgument",
-                              "lock mode and retain-until must both be set")
-            if mode:
-                if mode not in ("GOVERNANCE", "COMPLIANCE"):
-                    raise S3Error("InvalidArgument", "bad object-lock mode")
-                from .object_extras import _parse_amz_date
-
-                if _parse_amz_date(until) <= time.time():
-                    raise S3Error("InvalidArgument",
-                                  "retain-until date must be in the future")
-                user_meta[LOCK_MODE_KEY] = mode
-                user_meta[LOCK_UNTIL_KEY] = until
-            if hold:
-                if hold not in ("ON", "OFF"):
-                    raise S3Error("InvalidArgument", "bad legal-hold status")
-                user_meta[LOCK_HOLD_KEY] = hold
+        await self._apply_lock_headers(request, bucket, user_meta)
         # bucket default retention applies when the request sets none
         # (reference filterObjectLockMetadata + default retention)
         await self._apply_default_retention(bucket, user_meta)
@@ -1458,14 +1448,50 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         try:
             mode, seconds = await self._run(
                 self.meta.default_retention, bucket)
-        except Exception:
+        except st.BucketNotFound:
             return "", ""
+        # any OTHER failure propagates: committing an UNPROTECTED object
+        # into a WORM bucket on a transient error would be a bypass (the
+        # delete path fails closed for the same reason)
         if not mode:
             return "", ""
         until = datetime.fromtimestamp(
             time.time() + seconds, timezone.utc
         ).strftime("%Y-%m-%dT%H:%M:%SZ")
         return mode, until
+
+    async def _apply_lock_headers(self, request: web.Request, bucket: str,
+                                  user_meta: dict) -> None:
+        """Validate + apply explicit x-amz-object-lock-* request headers
+        (shared by PUT, CopyObject and CreateMultipartUpload so every
+        write path honors an explicitly requested lock)."""
+        if not any(request.headers.get(lk)
+                   for lk in (LOCK_MODE_KEY, LOCK_UNTIL_KEY,
+                              LOCK_HOLD_KEY)):
+            return
+        if not await self._run(self.meta.object_lock_enabled, bucket):
+            raise S3Error("InvalidRequest",
+                          "bucket is not object-lock enabled")
+        mode = request.headers.get(LOCK_MODE_KEY, "")
+        until = request.headers.get(LOCK_UNTIL_KEY, "")
+        hold = request.headers.get(LOCK_HOLD_KEY, "")
+        if bool(mode) != bool(until):
+            raise S3Error("InvalidArgument",
+                          "lock mode and retain-until must both be set")
+        if mode:
+            if mode not in ("GOVERNANCE", "COMPLIANCE"):
+                raise S3Error("InvalidArgument", "bad object-lock mode")
+            from .object_extras import _parse_amz_date
+
+            if _parse_amz_date(until) <= time.time():
+                raise S3Error("InvalidArgument",
+                              "retain-until date must be in the future")
+            user_meta[LOCK_MODE_KEY] = mode
+            user_meta[LOCK_UNTIL_KEY] = until
+        if hold:
+            if hold not in ("ON", "OFF"):
+                raise S3Error("InvalidArgument", "bad legal-hold status")
+            user_meta[LOCK_HOLD_KEY] = hold
 
     async def _apply_default_retention(self, bucket: str,
                                        user_meta: dict) -> None:
@@ -1595,6 +1621,12 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             data = b"".join(compress_mod.decompress_stream(iter([data])))
             src_meta.pop(compress_mod.META_COMPRESSION, None)
             src_meta.pop(compress_mod.META_ACTUAL_SIZE, None)
+        # lock metadata NEVER copies from the source (AWS semantics: an
+        # expired/stale source lock must not shadow the destination
+        # bucket's defaults); explicit request headers then defaults
+        for lk in (LOCK_MODE_KEY, LOCK_UNTIL_KEY, LOCK_HOLD_KEY):
+            src_meta.pop(lk, None)
+        await self._apply_lock_headers(request, bucket, src_meta)
         await self._apply_default_retention(bucket, src_meta)
         opts = PutObjectOptions(
             content_type=soi.content_type,
@@ -1924,6 +1956,8 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 if k.lower().startswith("x-amz-meta-")
             },
         )
+        await self._apply_lock_headers(request, bucket,
+                                       opts.user_metadata)
         await self._apply_default_retention(bucket, opts.user_metadata)
         uid = await self._run(self.api.new_multipart_upload, bucket, key, opts)
         return self._xml(200, (
@@ -2113,6 +2147,9 @@ def _event_queue_dir(object_layer) -> str | None:
     return None
 
 
+S3_SERVER_KEY = web.AppKey("s3_server", object)
+
+
 def make_app(object_layer, start_services: bool = False,
              scan_interval: float = 60.0, **kw) -> web.Application:
     srv = S3Server(object_layer, **kw)
@@ -2121,5 +2158,5 @@ def make_app(object_layer, start_services: bool = False,
 
         srv.attach_services(
             ServiceManager(object_layer, scan_interval=scan_interval))
-    srv.app["s3_server"] = srv
+    srv.app[S3_SERVER_KEY] = srv
     return srv.app

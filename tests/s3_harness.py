@@ -76,7 +76,9 @@ class S3TestServer:
                             secret_key=secret_key,
                             start_services=start_services,
                             scan_interval=scan_interval)
-        self.server = self.app["s3_server"]
+        from minio_tpu.server.app import S3_SERVER_KEY
+
+        self.server = self.app[S3_SERVER_KEY]
         self.iam = self.server.iam
         self._loop = asyncio.new_event_loop()
         self._started = threading.Event()

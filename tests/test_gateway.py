@@ -31,7 +31,9 @@ class GatewayServer:
                                metadata_dir=metadata_dir)
         self.app = make_app(self.layer, start_services=False,
                             access_key=access_key, secret_key=secret_key)
-        self.server = self.app["s3_server"]
+        from minio_tpu.server.app import S3_SERVER_KEY
+
+        self.server = self.app[S3_SERVER_KEY]
         self._loop = asyncio.new_event_loop()
         self._started = threading.Event()
         self._thread = threading.Thread(target=self._serve, daemon=True)

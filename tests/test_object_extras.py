@@ -479,3 +479,59 @@ class TestDefaultRetention:
             r = srv.request("PUT", "/dretbkt3",
                             query=[("object-lock", "")], data=bad)
             assert r.status == 400, bad
+
+    def test_copy_never_inherits_source_lock(self, srv):
+        """Source lock metadata must not shadow the destination's
+        defaults (an expired source lock would be a WORM bypass)."""
+        import time as _t
+
+        r = srv.request("PUT", "/dretbkt4",
+                        headers={"x-amz-bucket-object-lock-enabled": "true"})
+        assert r.status == 200
+        cfg = (b'<ObjectLockConfiguration>'
+               b'<ObjectLockEnabled>Enabled</ObjectLockEnabled>'
+               b'<Rule><DefaultRetention><Mode>COMPLIANCE</Mode>'
+               b'<Days>1</Days></DefaultRetention></Rule>'
+               b'</ObjectLockConfiguration>')
+        assert srv.request("PUT", "/dretbkt4", query=[("object-lock", "")],
+                           data=cfg).status == 200
+        # a source in the SAME bucket carrying a short GOVERNANCE lock
+        until = _t.strftime("%Y-%m-%dT%H:%M:%SZ",
+                            _t.gmtime(_t.time() + 3600))
+        srv.request("PUT", "/dretbkt4/src", data=b"x",
+                    headers={"x-amz-object-lock-mode": "GOVERNANCE",
+                             "x-amz-object-lock-retain-until-date": until})
+        r = srv.request("PUT", "/dretbkt4/copied",
+                        headers={"x-amz-copy-source": "/dretbkt4/src"})
+        assert r.status == 200
+        # destination got the DEFAULT (COMPLIANCE), not the source's lock
+        r = srv.request("GET", "/dretbkt4/copied",
+                        query=[("retention", "")])
+        assert b"COMPLIANCE" in r.body
+
+    def test_multipart_honors_explicit_lock_headers(self, srv):
+        import time as _t
+
+        r = srv.request("PUT", "/dretbkt5",
+                        headers={"x-amz-bucket-object-lock-enabled": "true"})
+        assert r.status == 200
+        until = _t.strftime("%Y-%m-%dT%H:%M:%SZ",
+                            _t.gmtime(_t.time() + 10 * 86400))
+        r = srv.request("POST", "/dretbkt5/mpl", query=[("uploads", "")],
+                        headers={"x-amz-object-lock-mode": "COMPLIANCE",
+                                 "x-amz-object-lock-retain-until-date":
+                                     until})
+        assert r.status == 200, r.body
+        uid = r.body.decode().split("<UploadId>")[1].split("</UploadId>")[0]
+        r = srv.request("PUT", "/dretbkt5/mpl",
+                        query=[("partNumber", "1"), ("uploadId", uid)],
+                        data=b"p" * (5 << 20))
+        etag = r.headers["ETag"].strip('"')
+        done = (f'<CompleteMultipartUpload><Part><PartNumber>1</PartNumber>'
+                f'<ETag>"{etag}"</ETag></Part>'
+                f'</CompleteMultipartUpload>').encode()
+        assert srv.request("POST", "/dretbkt5/mpl",
+                           query=[("uploadId", uid)],
+                           data=done).status == 200
+        r = srv.request("GET", "/dretbkt5/mpl", query=[("retention", "")])
+        assert b"COMPLIANCE" in r.body
