@@ -397,7 +397,8 @@ class Erasure:
         return data
 
     def decode_stream(self, writer, readers: Sequence, offset: int,
-                      length: int, total_length: int) -> int:
+                      length: int, total_length: int,
+                      broken_out: set | None = None) -> int:
         """Read shard streams (None = unavailable), reconstruct if needed,
         write plain object bytes [offset, offset+length) to writer.
 
@@ -422,7 +423,11 @@ class Erasure:
         end_block = (offset + length - 1) // self.block_size
         written = 0
         pool = _io_pool()
-        broken: set[int] = set()
+        # shard indices that failed mid-stream (bitrot/IO): shared with
+        # the caller so the read path can queue a heal — a masked
+        # corruption must not stay invisible (reference parallelReader
+        # feeds the read-trigger heal, cmd/erasure-object.go:316)
+        broken: set[int] = broken_out if broken_out is not None else set()
         full_blocks_total = total_length // self.block_size
 
         block_idx = start_block

@@ -218,7 +218,7 @@ class ErasureObjects:
                  default_parity: int | None = None,
                  set_index: int = 0, pool_index: int = 0,
                  ns_lock: NamespaceLock | None = None,
-                 heal_queue: Callable[[str, str, str], None] | None = None):
+                 heal_queue: Callable[..., None] | None = None):
         self.disks = list(disks)
         n = len(self.disks)
         if default_parity is None:
@@ -227,7 +227,9 @@ class ErasureObjects:
         self.set_index = set_index
         self.pool_index = pool_index
         self.ns = ns_lock or NamespaceLock()
-        self.heal_queue = heal_queue  # async heal trigger (MRF analogue)
+        # async heal trigger (MRF analogue): (bucket, obj, version_id,
+        # deep=False) — deep=True demands a bitrot-verifying heal
+        self.heal_queue = heal_queue
         self.tier_delete_hook = None  # wired by the tiering subsystem
         # change-tracking hook (bucket, obj) -> None; fed to the scanner's
         # bloom filter so clean buckets skip re-walks (reference NSUpdated
@@ -564,71 +566,95 @@ class ErasureObjects:
                     inline_by_index[shard_pos] = di.data
 
         heal_needed = False
+        heal_deep = False
+
+        def _queue_heal():
+            # runs in a finally: a client disconnect mid-stream must not
+            # drop the heal for corruption already detected
+            if heal_needed and self.heal_queue:
+                try:
+                    self.heal_queue(bucket, obj, fi.version_id,
+                                    deep=heal_deep)
+                except TypeError:
+                    self.heal_queue(bucket, obj, fi.version_id)
+
         # stream every part overlapping [offset, offset+length)
         part_start = 0
         remaining = length
-        for part in fi.parts:
-            part_end = part_start + part.size
-            if part_end <= offset or remaining <= 0:
-                part_start = part_end
-                continue
-            local_off = max(offset - part_start, 0)
-            local_len = min(part.size - local_off, remaining)
+        try:
+            for part in fi.parts:
+                part_end = part_start + part.size
+                if part_end <= offset or remaining <= 0:
+                    part_start = part_end
+                    continue
+                local_off = max(offset - part_start, 0)
+                local_len = min(part.size - local_off, remaining)
 
-            till = e.shard_file_size(part.size)
-            readers: list[bitrot.BitrotReader | None] = [None] * n
-            for i in range(n):
-                if inline_by_index[i] is not None:
-                    readers[i] = bitrot.BitrotReader(
-                        io.BytesIO(inline_by_index[i]), till, e.shard_size
-                    )
-                    continue
-                d = disks_by_index[i]
-                if d is None:
-                    heal_needed = True
-                    continue
+                till = e.shard_file_size(part.size)
+                readers: list[bitrot.BitrotReader | None] = [None] * n
+                for i in range(n):
+                    if inline_by_index[i] is not None:
+                        readers[i] = bitrot.BitrotReader(
+                            io.BytesIO(inline_by_index[i]), till, e.shard_size
+                        )
+                        continue
+                    d = disks_by_index[i]
+                    if d is None:
+                        heal_needed = True
+                        continue
+                    try:
+                        fh = d.read_file_stream(
+                            bucket, f"{obj}/{fi.data_dir}/part.{part.number}",
+                            0, bitrot.bitrot_shard_file_size(
+                                till, e.shard_size, _bitrot_algo_of(fi)),
+                        )
+                        readers[i] = bitrot.BitrotReader(
+                            fh, till, e.shard_size, algo=_bitrot_algo_of(fi))
+                    except Exception:
+                        heal_needed = True
+                        readers[i] = None
+                sink = _IterSink()
+                broken: set[int] = set()
+                worker = threading.Thread(
+                    target=self._decode_to_sink,
+                    args=(e, sink, readers, local_off, local_len, part.size,
+                          broken),
+                    daemon=True,
+                )
+                worker.start()
                 try:
-                    fh = d.read_file_stream(
-                        bucket, f"{obj}/{fi.data_dir}/part.{part.number}",
-                        0, bitrot.bitrot_shard_file_size(
-                            till, e.shard_size, _bitrot_algo_of(fi)),
-                    )
-                    readers[i] = bitrot.BitrotReader(
-                        fh, till, e.shard_size, algo=_bitrot_algo_of(fi))
-                except Exception:
+                    yield from sink
+                except GeneratorExit:
+                    sink.abandon()
+                    raise
+                finally:
+                    worker.join()
+                    for r in readers:
+                        if r is not None:
+                            try:
+                                r.close()
+                            except Exception:
+                                pass
+                if sink.error is not None and not isinstance(sink.error, BrokenPipeError):
+                    raise sink.error
+                if broken:
+                    # a shard failed bitrot/IO mid-stream: the client got
+                    # clean data (reconstructed) but the drive needs a
+                    # VERIFYING heal (the corrupt file is size-correct, so a
+                    # shallow part check would see nothing wrong)
                     heal_needed = True
-                    readers[i] = None
-            sink = _IterSink()
-            worker = threading.Thread(
-                target=self._decode_to_sink,
-                args=(e, sink, readers, local_off, local_len, part.size),
-                daemon=True,
-            )
-            worker.start()
-            try:
-                yield from sink
-            except GeneratorExit:
-                sink.abandon()
-                raise
-            finally:
-                worker.join()
-                for r in readers:
-                    if r is not None:
-                        try:
-                            r.close()
-                        except Exception:
-                            pass
-            if sink.error is not None and not isinstance(sink.error, BrokenPipeError):
-                raise sink.error
-            remaining -= local_len
-            part_start = part_end
-        if heal_needed and self.heal_queue:
-            self.heal_queue(bucket, obj, fi.version_id)
+                    heal_deep = True
+                remaining -= local_len
+                part_start = part_end
+        finally:
+            _queue_heal()
 
     @staticmethod
-    def _decode_to_sink(e, sink, readers, offset, length, total):
+    def _decode_to_sink(e, sink, readers, offset, length, total,
+                        broken_out=None):
         try:
-            e.decode_stream(sink, readers, offset, length, total)
+            e.decode_stream(sink, readers, offset, length, total,
+                            broken_out=broken_out)
         except Exception as ex:
             sink.error = ex
         finally:

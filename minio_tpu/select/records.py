@@ -149,12 +149,10 @@ class ParquetInput:
 
         # pyarrow needs random access (footer at the tail); spool to a
         # temp file past 64 MiB so multi-GB objects never sit in RAM
+        import shutil
+
         spool = tempfile.SpooledTemporaryFile(max_size=64 << 20)
-        while True:
-            chunk = self.raw.read(1 << 20)
-            if not chunk:
-                break
-            spool.write(chunk)
+        shutil.copyfileobj(self.raw, spool, 1 << 20)
         spool.seek(0)
         try:
             pf = pq.ParquetFile(spool)

@@ -1494,17 +1494,22 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             user_meta[LOCK_HOLD_KEY] = hold
 
     async def _apply_default_retention(self, bucket: str,
-                                       user_meta: dict) -> None:
+                                       user_meta: dict,
+                                       mark_default: bool = False) -> None:
         """Stamp the bucket's default retention when the metadata does
         not already carry an explicit mode (PUT/copy/multipart must all
         agree — an unprotected copy into a WORM bucket would be a
-        bypass)."""
+        bypass).  mark_default tags the stamp so deferred commits
+        (multipart complete) can recompute the window from CREATION
+        time rather than initiation."""
         if LOCK_MODE_KEY in user_meta:
             return
         dmode, duntil = await self._default_retention(bucket)
         if dmode:
             user_meta[LOCK_MODE_KEY] = dmode
             user_meta[LOCK_UNTIL_KEY] = duntil
+            if mark_default:
+                user_meta["x-minio-internal-lock-default"] = "true"
 
     def _compress_eligible(self, key: str, content_type: str) -> bool:
         if not self.config.get_bool("compression", "enable"):
@@ -1958,7 +1963,8 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         )
         await self._apply_lock_headers(request, bucket,
                                        opts.user_metadata)
-        await self._apply_default_retention(bucket, opts.user_metadata)
+        await self._apply_default_retention(bucket, opts.user_metadata,
+                                            mark_default=True)
         uid = await self._run(self.api.new_multipart_upload, bucket, key, opts)
         return self._xml(200, (
             f'<?xml version="1.0" encoding="UTF-8"?>'
@@ -2113,6 +2119,20 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             if "out of order" in str(e):
                 raise S3Error("InvalidPartOrder")
             raise S3Error("InvalidPart", str(e))
+        if oi.metadata.get("x-minio-internal-lock-default") == "true":
+            # default retention stamped at INITIATION: recompute the
+            # window from object creation so a long upload does not
+            # shorten the WORM period
+            dmode, duntil = await self._default_retention(bucket)
+            updates = {"x-minio-internal-lock-default": None}
+            if dmode:
+                updates[LOCK_MODE_KEY] = dmode
+                updates[LOCK_UNTIL_KEY] = duntil
+            try:
+                await self._run(self.api.update_object_metadata, bucket,
+                                key, updates, oi.version_id)
+            except Exception:
+                pass  # initiation-time stamp remains as a floor
         repl_status = await self._maybe_replicate(request, bucket, key, oi)
         from minio_tpu.events.event import EventName
 

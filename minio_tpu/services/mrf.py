@@ -34,6 +34,7 @@ class _HealTask:
     bucket: str
     obj: str
     version_id: str = ""
+    deep: bool = False
 
 
 class MRFQueue:
@@ -61,8 +62,12 @@ class MRFQueue:
         self._worker.start()
 
     # -- producer ----------------------------------------------------------
-    def enqueue(self, bucket: str, obj: str, version_id: str = "") -> None:
-        t = _HealTask(bucket, obj, version_id)
+    def enqueue(self, bucket: str, obj: str, version_id: str = "",
+                deep: bool = False) -> None:
+        """deep=True forces a bitrot-verifying heal — the read path sets
+        it when a shard failed VERIFICATION mid-stream (size-correct
+        corruption is invisible to the shallow part checks)."""
+        t = _HealTask(bucket, obj, version_id, deep)
         with self._mu:
             if t in self._inflight:
                 return
@@ -97,7 +102,9 @@ class MRFQueue:
             ok = False
             for _ in range(self.max_retries):
                 try:
-                    res = self.ol.heal_object(t.bucket, t.obj, t.version_id)
+                    res = self.ol.heal_object(t.bucket, t.obj,
+                                              t.version_id,
+                                              deep=t.deep)
                     ok = not getattr(res, "failed", False)
                 except Exception:
                     ok = False

@@ -1,0 +1,135 @@
+"""End-to-end healing: drives die under live traffic and the running
+server heals itself back to full redundancy.
+
+Reference analogue: buildscripts/verify-healing.sh — boot a cluster,
+kill drives, assert heal restores every shard (Makefile:63-71).
+"""
+
+import io
+import os
+import shutil
+import threading
+import time
+
+import pytest
+
+from tests.s3_harness import S3TestServer
+
+
+def _wait(cond, timeout=30.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(0.25)
+    return False
+
+
+@pytest.fixture
+def srv(tmp_path):
+    os.environ["MINIO_TPU_FSYNC"] = "0"
+    s = S3TestServer(str(tmp_path / "drives"), start_services=True,
+                     scan_interval=0.5)
+    # the monitor must probe fast enough for the test window
+    s.server.services.monitor.interval = 0.5
+    yield s
+    s.close()
+
+
+class TestSelfHealing:
+    def test_wiped_drive_heals_under_traffic(self, srv):
+        """Wipe one drive while writes continue; the drive monitor
+        re-stamps it and the set heals every object back onto it."""
+        srv.request("PUT", "/healbkt")
+        payloads = {}
+        for i in range(20):
+            data = os.urandom(40_000)
+            payloads[f"o{i}"] = data
+            assert srv.request("PUT", f"/healbkt/o{i}",
+                               data=data).status == 200
+
+        d0 = srv.pools.pools[0].all_disks[0]
+        root = d0.root
+        # simulate hardware replacement under live traffic
+        stop = threading.Event()
+
+        def traffic():
+            j = 0
+            while not stop.is_set():
+                srv.request("GET", f"/healbkt/o{j % 20}")
+                j += 1
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+        try:
+            shutil.rmtree(root)
+            os.makedirs(os.path.join(root, ".minio_tpu.sys", "tmp"))
+
+            def healed():
+                n = sum(
+                    1 for i in range(20)
+                    if os.path.exists(os.path.join(
+                        root, "healbkt", f"o{i}", "xl.meta")))
+                return n == 20
+
+            assert _wait(healed, timeout=45), \
+                "drive was not fully healed by the background services"
+        finally:
+            stop.set()
+            t.join(5)
+        # every object readable even with ANOTHER drive offline, so the
+        # healed drive's shards must actually participate
+        es = srv.pools.pools[0].sets[0]
+        saved = es.disks[1]
+        es.disks[1] = None
+        try:
+            for name, data in payloads.items():
+                r = srv.request("GET", f"/healbkt/{name}")
+                assert r.status == 200 and r.body == data, name
+        finally:
+            es.disks[1] = saved
+
+    def test_corrupted_shard_heals_on_read(self, srv):
+        """Bitrot on one drive: the read succeeds degraded, triggers the
+        MRF, and the corrupt shard is rewritten."""
+        srv.request("PUT", "/rotbkt")
+        data = os.urandom(300_000)  # above inline threshold
+        assert srv.request("PUT", "/rotbkt/victim",
+                           data=data).status == 200
+        # corrupt the drive holding SHARD 0 — a data shard the
+        # first-K-of-N read ALWAYS touches (corruption on an unread
+        # parity shard is lazily detected by deep scans instead, like
+        # the reference)
+        es = srv.pools.pools[0].sets[0]
+        victim_drive = None
+        for d in es.disks:
+            fi = d.read_version("rotbkt", "victim")
+            if fi.erasure.index == 1:
+                victim_drive = d
+                break
+        assert victim_drive is not None
+        part = None
+        for walk_root, _, files in os.walk(
+                os.path.join(victim_drive.root, "rotbkt", "victim")):
+            for f in files:
+                if f.startswith("part."):
+                    part = os.path.join(walk_root, f)
+        assert part, "no shard file found on the shard-0 drive"
+        with open(part, "r+b") as f:
+            f.seek(100)
+            f.write(b"\xff" * 64)
+        mtime_before = os.path.getmtime(part)
+        # degraded read still serves the bytes and enqueues a heal
+        r = srv.request("GET", "/rotbkt/victim")
+        assert r.status == 200 and r.body == data
+
+        def repaired():
+            try:
+                return os.path.getmtime(part) != mtime_before
+            except OSError:
+                return False
+
+        assert _wait(repaired, timeout=30), "MRF never healed the shard"
+        # deep verify passes again on every drive
+        res = srv.pools.heal_object("rotbkt", "victim", deep=True)
+        assert not res.failed
