@@ -9,9 +9,16 @@ Measurement notes (VERDICT r1 weak #2: report honest numbers, all of them)
 --------------------------------------------------------------------------
 - Shapes follow BASELINE.md: EC 8+4, 1 MiB erasure blocks (shard size
   128 KiB), heal = reconstruct 3 zeroed shards.
-- `value` is the device-resident kernel aggregate (a jit'd loop over
-  resident 512-block chunks): the codec throughput the TPU sustains once
-  data is in HBM — the number comparable to klauspost's AVX2 kernel loop.
+- `value` is the device-resident kernel aggregate: wall-clock time of a
+  jit'd chain of REPS sequentially-dependent encodes of a resident 2 GiB
+  batch (each iteration's input is XOR-perturbed by a word of the
+  previous parity, fused in-kernel, so no iteration can be hoisted or
+  elided) — the codec throughput the TPU sustains once data is in HBM,
+  the number comparable to klauspost's AVX2 kernel loop.  The chain
+  amortises this environment's fixed ~100 ms per-dispatch tunnel
+  round-trip (measured: detail.dispatch_fixed_ms; r2's 15 GiB/s
+  "ceiling" was that latency, not the kernel).  No fixed cost is
+  subtracted from the reported wall-clock totals.
 - `detail.tpu_stream_encode_gibs` is the transfer-inclusive number: host
   numpy -> device_put -> kernel -> parity back to host, pipelined across
   chunks.  In THIS environment the TPU is reached over a tunnel whose raw
@@ -42,8 +49,9 @@ from functools import partial
 import numpy as np
 
 K, M, S = 8, 4, 131072  # EC 8+4, 1 MiB blocks
-CHUNK = 512             # blocks per in-jit chunk (512 MiB data)
-NCHUNKS = 4
+CHUNK = 512             # blocks per resident batch unit (512 MiB data)
+NCHUNKS = 4             # resident batch = 2 GiB (NCHUNKS*CHUNK 1 MiB blocks)
+REPS = 32               # chained dependent encodes of the resident batch
 HEAL_KILL = (1, 5, 9)   # shards to rebuild in the heal config
 E2E_MB = 128            # object size for the object-layer bench
 
@@ -124,39 +132,61 @@ def bench_tpu():
     )
     interp = codec._interpret
 
-    @partial(jax.jit, static_argnums=(2, 3))
-    def run_chunks(mat, words_all, nchunks, rows):
-        def body(i, out):
-            chunk = jax.lax.dynamic_slice(words_all, (i * CHUNK, 0, 0), (CHUNK, K, W))
-            p = rs_pallas._coding_call(mat, chunk, interpret=interp)
-            return jax.lax.dynamic_update_slice(out, p, (i * CHUNK, 0, 0))
-        init = jnp.zeros((nchunks * CHUNK, rows, W), jnp.int32)
-        return jax.lax.fori_loop(0, nchunks, body, init)
+    # Chained dependent iterations of the flat (K, N) kernel: iteration i
+    # encodes (words ^ seed_i) where seed_i is a word of iteration i-1's
+    # parity (XOR fused inside the kernel, one extra VPU op).  The data
+    # dependence makes every iteration a real, distinct encode the
+    # compiler cannot hoist or elide, while amortising the fixed
+    # per-dispatch round-trip (~100 ms through this tunnel; measured and
+    # reported as detail.dispatch_fixed_ms).  Wall-clock totals over all
+    # reps are reported — no subtraction of the fixed cost.
+    @partial(jax.jit, static_argnums=(2,))
+    def run_chain(mat, flat_words, reps):
+        rows = mat.shape[0] // 8
+        def body(i, carry):
+            seed, _ = carry
+            p = rs_pallas._flat_coding_call(mat, flat_words, seed, interpret=interp)
+            return (p[0:1, 0] ^ i, p)
+        seed0 = jnp.zeros((1,), jnp.int32)
+        p0 = jnp.zeros((rows, flat_words.shape[1]), jnp.int32)
+        _, p = jax.lax.fori_loop(0, reps, body, (seed0, p0))
+        return p
 
     @partial(jax.jit, static_argnums=1)
-    def gen(key, b):
-        return jax.random.randint(key, (b, K, W), -2**31, 2**31 - 1, dtype=jnp.int32)
+    def gen(key, n):
+        return jax.random.randint(key, (K, n), -2**31, 2**31 - 1, dtype=jnp.int32)
 
-    nchunks = NCHUNKS if on_tpu else 1
-    chunkscale = 1 if on_tpu else 64  # tiny on CPU interpret mode
-    global CHUNK
-    CHUNK = CHUNK // chunkscale
-    total_blocks = nchunks * CHUNK
-    words = gen(jax.random.PRNGKey(0), total_blocks)
-    np.asarray(words[0, 0, :1])  # materialise
+    total_blocks = (NCHUNKS * CHUNK) if on_tpu else 8
+    reps = REPS if on_tpu else 2
+    N = total_blocks * W
+    words = gen(jax.random.PRNGKey(0), N)
+    np.asarray(words[0, :1])  # materialise
 
     results = {}
-    for name, mat, rows in (("encode", enc_mat, M), ("heal", heal_mat, len(HEAL_KILL))):
-        out = run_chunks(mat, words, nchunks, rows)
-        np.asarray(out[0, 0, :2])  # compile+warm
-        ts = []
+    fixed_ms = 0.0
+    for name, mat in (("encode", enc_mat), ("heal", heal_mat)):
+        def run(r):
+            out = run_chain(mat, words, r)
+            np.asarray(out[0, :2])  # block until the chain really finished
+
+        run(1)  # compile+warm both rep counts
+        run(reps)
+        t1s, ts = [], []
         for _ in range(3):
             t0 = time.perf_counter()
-            out = run_chunks(mat, words, nchunks, rows)
-            np.asarray(out[0, 0, :2])
+            run(1)
+            t1s.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            run(reps)
             ts.append(time.perf_counter() - t0)
-        dt = float(np.median(ts))
-        results[name] = total_blocks * K * S / dt / 2**30
+        dt, dt1 = float(np.median(ts)), float(np.median(t1s))
+        results[name] = reps * total_blocks * K * S / dt / 2**30
+        # fixed dispatch cost estimate: extrapolate the per-iteration
+        # marginal slope back to zero reps (diagnostic only)
+        slope = max((dt - dt1) / (reps - 1), 1e-9)
+        fixed_ms = max(fixed_ms, (dt1 - slope) * 1000)
+        results[f"{name}_marginal"] = total_blocks * K * S / slope / 2**30
+    results["dispatch_fixed_ms"] = fixed_ms
 
     # Transfer-inclusive streaming encode: host numpy in, parity bytes out,
     # chunks pipelined through JAX async dispatch.
@@ -262,6 +292,9 @@ def main():
         "detail": {
             "tpu_encode_gibs": round(tpu["encode"], 3),
             "tpu_heal_gibs": round(tpu["heal"], 3),
+            "tpu_encode_marginal_gibs": round(tpu["encode_marginal"], 3),
+            "tpu_heal_marginal_gibs": round(tpu["heal_marginal"], 3),
+            "dispatch_fixed_ms": round(tpu["dispatch_fixed_ms"], 1),
             "tpu_stream_encode_gibs": round(tpu["stream_encode"], 3),
             "link_h2d_gibs": round(link_h2d, 3),
             "link_d2h_gibs": round(link_d2h, 3),

@@ -30,15 +30,21 @@ from jax.experimental.pallas import tpu as pltpu
 from . import gf256, rs_tpu
 
 # Column-tile width in int32 words (bytes = 4 * _TILE_WORDS per shard row).
-# Tuning notes (measured on v5e via the bench fori_loop harness): tile
-# widths 512..8192 are within ~8% of each other (2048 best); int8/uint8
-# in-kernel unpack variants (which would cut the VPU shift count 4x) are
-# blocked by the current Mosaic lowering — `arith.shrsi/shrui` on i8
-# vectors and bitwidth-changing bitcasts both fail to legalize — so the
-# int32-word layout below stands.  Naive timing of individual dispatches
-# through the tunneled device wildly overstates throughput (dispatch
-# returns before execution); only the in-jit fori_loop numbers are real.
+# Tuning notes (measured on v5e): every per-dispatch measurement through
+# the tunneled device carries a fixed ~100 ms round-trip cost that swamps
+# the kernel (2 GiB encodes take ~16 ms of device time); r2's apparent
+# 15 GiB/s ceiling was that latency, not the kernel.  Marginal-cost
+# measurement (chained dependent iterations in one jit, see bench.py)
+# shows the kernel sustains ~124 GiB/s.  int8/uint8 in-kernel unpack
+# variants are blocked by the current Mosaic lowering — `arith.shrsi/
+# shrui` on i8 vectors and bitwidth-changing bitcasts fail to legalize —
+# so the int32-word layout below stands.
 _TILE_WORDS = 2048
+
+# The flat (K, N) kernel processes this many words per grid program (an
+# inner loop over _TILE_WORDS sub-tiles keeps VMEM intermediates small
+# while amortising per-program overhead).
+_FLAT_TILE_WORDS = 131072
 
 
 def _permute_mat(mat_bits: np.ndarray) -> np.ndarray:
@@ -52,22 +58,16 @@ def _permute_mat(mat_bits: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(m.reshape(r8, k8))
 
 
-def _coding_kernel(mat_ref, in_ref, out_ref):
-    """One (block, column-tile) program.
+def _code_tile(mat, x, r):
+    """GF(2^8) code one (K, TW) int32 tile -> (R, TW) int32.
 
-    mat_ref: (R8, K8) int8 GF(2) coding matrix (whole, VMEM)
-    in_ref:  (1, K, TW) int32 — K source shards, TW words of 4 bytes
-    out_ref: (1, R, TW) int32 — R output shards
+    Unpack to GF(2) bit-planes, row order j-major: row = bit_in_byte*K +
+    shard (the host permutes the matrix to match, see _permute_mat).  The
+    byte-within-word index c4 joins the column axis as col = c4*TW + w;
+    the inverse interleave at pack time cancels it.  The MXU dot yields
+    parity-bit popcounts; the low bit is the GF(2) sum.
     """
-    x = in_ref[0]  # (K, TW) int32
-    k = x.shape[0]
-    r8 = mat_ref.shape[0]
-    r = r8 // 8
-
-    # Unpack to GF(2) bit-planes, row order j-major: row = bit_in_byte*K +
-    # shard (the host permutes the matrix columns to match, see
-    # _permute_mat_cols).  The byte-within-word index c4 joins the column
-    # axis as col = c4*TW + w.
+    tw = x.shape[1]
     planes = []
     for j in range(8):  # bit within byte
         row = [((x >> (8 * c4 + j)) & 1) for c4 in range(4)]
@@ -75,22 +75,30 @@ def _coding_kernel(mat_ref, in_ref, out_ref):
     bits = jnp.concatenate(planes, axis=0).astype(jnp.int8)  # (8*K, 4*TW)
 
     counts = jax.lax.dot_general(
-        mat_ref[:],
+        mat,
         bits,
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32,
-    )  # (R8, 4*TW) — parity-bit popcounts; low bit is the GF(2) sum
+    )  # (R8, 4*TW)
 
-    # counts rows are i-major too: row = bit_in_byte*R + out_shard (the
-    # host permutes matrix rows, see _permute_mat_rows).
-    tw = x.shape[1]
+    # counts rows are i-major too: row = bit_in_byte*R + out_shard.
     pb = counts & 1  # (8*R, 4*TW)
     out = jnp.zeros((r, tw), jnp.int32)
     for c4 in range(4):
         seg = pb[:, c4 * tw:(c4 + 1) * tw]  # (8*R, TW)
         for i in range(8):
             out = out | (seg[i * r:(i + 1) * r, :] << (8 * c4 + i))
-    out_ref[0] = out
+    return out
+
+
+def _coding_kernel(mat_ref, in_ref, out_ref):
+    """One (block, column-tile) program.
+
+    mat_ref: (R8, K8) int8 GF(2) coding matrix (whole, VMEM)
+    in_ref:  (1, K, TW) int32 — K source shards, TW words of 4 bytes
+    out_ref: (1, R, TW) int32 — R output shards
+    """
+    out_ref[0] = _code_tile(mat_ref[:], in_ref[0], mat_ref.shape[0] // 8)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -110,6 +118,64 @@ def _coding_call(mat_bits: jax.Array, words: jax.Array, *, interpret: bool = Fal
         out_specs=pl.BlockSpec((1, r, _TILE_WORDS), lambda bi, ti: (bi, 0, ti)),
         interpret=interpret,
     )(mat_bits, words)
+
+
+def _flat_kernel(mat_ref, seed_ref, in_ref, out_ref, *, ntiles, r):
+    """One grid program of the flat (K, N) layout.
+
+    Identical math to _coding_kernel but shard rows span the whole stream
+    (col = word index), matching how a shard's bytes are laid out on disk
+    (cmd/erasure-coding.go:122-150 shard arithmetic).  Each program owns
+    ntiles sub-tiles of _TILE_WORDS words and loops over them so VMEM
+    intermediates stay ~1.5 MiB while per-program overhead is amortised.
+
+    seed_ref is a (1,) SMEM scalar XORed into the input words — zero for
+    production use (identity).  bench.py threads the previous iteration's
+    parity word through it to build a sequentially-dependent chain that
+    defeats loop-invariant hoisting while adding one VPU op.
+    """
+    sub = _TILE_WORDS
+    s = seed_ref[0]
+    for t in range(ntiles):
+        x = in_ref[:, t * sub:(t + 1) * sub] ^ s  # (K, SUB) int32
+        out_ref[:, t * sub:(t + 1) * sub] = _code_tile(mat_ref[:], x, r)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _flat_coding_call(
+    mat_bits: jax.Array,
+    words: jax.Array,
+    seed: jax.Array | None = None,
+    *,
+    interpret: bool = False,
+):
+    """mat_bits (R8, K8) int8; words (K, N) int32 -> (R, N) int32.
+
+    The shard-contiguous layout: row k holds every word of shard k, the
+    natural shape for whole-extent encodes of large streams.  N must be a
+    multiple of _TILE_WORDS (8 KiB of shard bytes)."""
+    k, n = words.shape
+    r = mat_bits.shape[0] // 8
+    if n % _TILE_WORDS != 0:
+        raise ValueError(f"flat word count {n} not a multiple of {_TILE_WORDS}")
+    if seed is None:
+        seed = jnp.zeros((1,), jnp.int32)
+    tile = _FLAT_TILE_WORDS
+    while n % tile:
+        tile //= 2
+    kern = functools.partial(_flat_kernel, ntiles=tile // _TILE_WORDS, r=r)
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((r, n), jnp.int32),
+        grid=(n // tile,),
+        in_specs=[
+            pl.BlockSpec((mat_bits.shape[0], mat_bits.shape[1]), lambda ti: (0, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((k, tile), lambda ti: (0, ti)),
+        ],
+        out_specs=pl.BlockSpec((r, tile), lambda ti: (0, ti)),
+        interpret=interpret,
+    )(mat_bits, seed, words)
 
 
 def _to_words(shards: jax.Array) -> jax.Array:
@@ -173,11 +239,22 @@ class PallasRSCodec:
             raise ValueError(f"word count must be a multiple of {_TILE_WORDS}")
         return _coding_call(self._enc, words, interpret=self._interpret)
 
-    def encode_blocks(self, data_shards) -> jax.Array:
-        d = jnp.asarray(data_shards, dtype=jnp.uint8)
-        return jnp.concatenate([d, self.encode(d)], axis=1)
+    def encode_flat(self, words) -> jax.Array:
+        """(K, N) int32 shard-contiguous words -> (M, N) int32 parity.
 
-    def reconstruct(self, src_shards, available, wanted) -> jax.Array:
+        Whole-extent entry point: row k is shard k's packed bytes for the
+        entire stream, so one dispatch covers an arbitrarily large extent
+        (N a multiple of _TILE_WORDS)."""
+        words = jnp.asarray(words, dtype=jnp.int32)
+        return _flat_coding_call(self._enc, words, interpret=self._interpret)
+
+    def reconstruct_flat(self, words, available, wanted) -> jax.Array:
+        """(K, N) int32 surviving-shard words -> (len(wanted), N) int32."""
+        mat = self._rec_mat(available, wanted)
+        words = jnp.asarray(words, dtype=jnp.int32)
+        return _flat_coding_call(mat, words, interpret=self._interpret)
+
+    def _rec_mat(self, available, wanted) -> jax.Array:
         sig = (tuple(available), tuple(wanted))
         mat = self._rec_cache.get(sig)
         if mat is None:
@@ -185,7 +262,14 @@ class PallasRSCodec:
                 _permute_mat(rs_tpu.reconstruct_bits_matrix(self.k, self.m, *sig))
             )
             self._rec_cache[sig] = mat
-        return self._run(mat, src_shards)
+        return mat
+
+    def encode_blocks(self, data_shards) -> jax.Array:
+        d = jnp.asarray(data_shards, dtype=jnp.uint8)
+        return jnp.concatenate([d, self.encode(d)], axis=1)
+
+    def reconstruct(self, src_shards, available, wanted) -> jax.Array:
+        return self._run(self._rec_mat(available, wanted), src_shards)
 
     def decode_data(self, src_shards, available) -> jax.Array:
         return self.reconstruct(src_shards, available, tuple(range(self.k)))
