@@ -235,7 +235,7 @@ def bench_e2e(backend):
 
         put()  # warm (includes any device probe/compile)
         ts = []
-        for _ in range(2):
+        for _ in range(3):
             t0 = time.perf_counter()
             put()
             ts.append(time.perf_counter() - t0)
@@ -256,7 +256,7 @@ def bench_e2e(backend):
 
         get()
         ts = []
-        for _ in range(2):
+        for _ in range(3):
             t0 = time.perf_counter()
             get()
             ts.append(time.perf_counter() - t0)
@@ -266,10 +266,42 @@ def bench_e2e(backend):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_host_ceilings():
+    """This host's raw memcpy and buffered-file-write rates — the physical
+    context for the e2e numbers (a PUT moves >= 4x the payload through RAM:
+    stream read, encode read+parity, hash read, page-cache write; on a
+    single-core VM none of those passes overlap)."""
+    src = np.zeros(128 << 20, dtype=np.uint8)
+    dst = np.empty_like(src)
+    dst[:] = src  # warm both buffers (cold pages measure fault cost, not copy)
+    t0 = time.perf_counter()
+    dst[:] = src
+    memcpy_gibs = src.nbytes / (time.perf_counter() - t0) / 2**30
+    tmp = tempfile.mkdtemp(prefix="minio-tpu-bench-")
+    try:
+        best = 0.0
+        for i in range(2):
+            with open(os.path.join(tmp, f"w{i}"), "wb") as f:
+                t0 = time.perf_counter()
+                f.write(src.data)
+            best = max(best, src.nbytes / (time.perf_counter() - t0) / 2**30)
+        return memcpy_gibs, best
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
     cpu_enc, cpu_heal, nthreads = bench_cpu()
+    memcpy_gibs, disk_write_gibs = bench_host_ceilings()
+    # interleave auto/host passes: background page-cache writeback from one
+    # run skews the next, so a single ordered pair is unfair to whichever
+    # ran while the disk was busiest — best of two interleaved passes
     e2e_put, e2e_get = bench_e2e("auto")
     e2e_put_host, _ = bench_e2e("host")
+    p2, g2 = bench_e2e("auto")
+    ph2, _ = bench_e2e("host")
+    e2e_put, e2e_get = max(e2e_put, p2), max(e2e_get, g2)
+    e2e_put_host = max(e2e_put_host, ph2)
     try:
         tpu, link_h2d, link_d2h = bench_tpu()
     except Exception as e:  # pragma: no cover - report CPU-only on failure
@@ -304,6 +336,8 @@ def main():
             "e2e_put_gibs": round(e2e_put, 3),
             "e2e_get_gibs": round(e2e_get, 3),
             "e2e_put_host_gibs": round(e2e_put_host, 3),
+            "host_memcpy_gibs": round(memcpy_gibs, 3),
+            "host_disk_write_gibs": round(disk_write_gibs, 3),
             "note": (
                 "value = device-resident kernel aggregate; stream number is "
                 "transfer-inclusive and link-bound in this tunneled-TPU "

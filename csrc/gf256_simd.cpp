@@ -86,22 +86,35 @@ extern "C" {
 // out[r] = XOR_k mat[r*k + j] * src[j]   (all rows length `n`)
 // mat: rows x k coefficients; src: k contiguous shards of n bytes;
 // out: rows contiguous shards of n bytes.
+//
+// Column-tiled so each 16 KiB source/destination tile stays cache-hot
+// across the whole coefficient matrix: every source byte is pulled from
+// RAM once per call instead of `rows` times (the row-major loop's RAM
+// traffic limited large batches to ~0.7 GiB/s on a ~2 GB/s-bandwidth
+// host; klauspost/reedsolomon tiles the same way for the same reason).
 void gf256_matmul(const uint8_t* mat, int rows, int k, const uint8_t* src,
                   uint8_t* out, size_t n) {
-  for (int r = 0; r < rows; r++) {
-    uint8_t* dst = out + (size_t)r * n;
-    bool first = true;
+  const size_t TILE = 16384;
+  bool started[256];
+  for (size_t off = 0; off < n; off += TILE) {
+    const size_t len = (n - off < TILE) ? (n - off) : TILE;
+    for (int r = 0; r < rows; r++) started[r] = false;
     for (int j = 0; j < k; j++) {
-      uint8_t c = mat[r * k + j];
-      if (c == 0) continue;
+      const uint8_t* s = src + (size_t)j * n + off;
+      for (int r = 0; r < rows; r++) {
+        uint8_t c = mat[r * k + j];
+        if (c == 0) continue;
+        uint8_t* dst = out + (size_t)r * n + off;
 #if defined(__AVX2__)
-      mul_acc_avx2(c, src + (size_t)j * n, dst, n, first);
+        mul_acc_avx2(c, s, dst, len, !started[r]);
 #else
-      mul_acc_scalar(c, src + (size_t)j * n, dst, n, first);
+        mul_acc_scalar(c, s, dst, len, !started[r]);
 #endif
-      first = false;
+        started[r] = true;
+      }
     }
-    if (first) memset(dst, 0, n);
+    for (int r = 0; r < rows; r++)
+      if (!started[r]) memset(out + (size_t)r * n + off, 0, len);
   }
 }
 

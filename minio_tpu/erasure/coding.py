@@ -269,23 +269,27 @@ class Erasure:
                     f"{n - len(dead)} writers < quorum {write_quorum}"
                 )
 
-        def flush_batch(blocks: list[np.ndarray], lens: list[int]) -> None:
-            # blocks: list of (K, S) aligned same-size data-shard arrays.
-            # One future per drive (goroutine-per-writer analog of
-            # parallelWriter, cmd/erasure-encode.go:36); a drive writes its
-            # shard of every block in order, so per-file layout is stable.
-            batch = np.stack(blocks)
+        def flush_batch(batch: np.ndarray, lens: list[int]) -> None:
+            # batch: (B, K, S) same-shard-size data blocks.  One future per
+            # drive (goroutine-per-writer analog of parallelWriter,
+            # cmd/erasure-encode.go:36); a drive writes its shard of every
+            # block in order, so per-file layout is stable.  Uniform
+            # batches go out as one batched-hash writev frame group per
+            # drive (BitrotWriter.write_frames); a drive's rows are a
+            # strided column of the batch, so no per-shard copies happen.
             parity = self._encode_shards(batch)
             reap_inflight()
+            uniform = len(set(lens)) == 1
+            shard_lens = [-(-ln // self.k) for ln in lens]
 
             def write_drive(i: int) -> None:
-                for bi in range(batch.shape[0]):
-                    shard_len = -(-lens[bi] // self.k)
-                    shard = (
-                        batch[bi, i, :shard_len]
-                        if i < self.k else parity[bi, i - self.k, :shard_len]
-                    )
-                    writers[i].write(shard)
+                rows = batch[:, i, :] if i < self.k else parity[:, i - self.k, :]
+                wf = getattr(writers[i], "write_frames", None)
+                if wf is not None and uniform:
+                    wf(rows[:, : shard_lens[0]])
+                else:
+                    for bi in range(rows.shape[0]):
+                        writers[i].write(rows[bi, : shard_lens[bi]])
 
             inflight.update({
                 i: pool.submit(write_drive, i)
@@ -293,13 +297,17 @@ class Erasure:
                 if i not in dead and writers[i] is not None
             })
 
-        pending: list[np.ndarray] = []
-        pending_lens: list[int] = []
+        bs = self.block_size
         batch_max = DEVICE_BATCH_BLOCKS
+        # bs % k == 0 (always true for the 1 MiB default with k <= 16 a
+        # power of two; checked so odd geometries fall back): a full
+        # block's shard split is a pure reshape, so a whole batch read is
+        # viewed as (B, K, S) with zero copies.
+        aligned = bs % self.k == 0
         try:
             while True:
-                want = self.block_size if total_size < 0 else min(
-                    self.block_size, total_size - total
+                want = bs * batch_max if total_size < 0 else min(
+                    bs * batch_max, total_size - total
                 )
                 if want == 0:
                     break
@@ -307,24 +315,26 @@ class Erasure:
                 if not data:
                     break
                 total += len(data)
-                shards = gf256.split(data, self.k)
-                if len(data) == self.block_size:
-                    # full blocks all share a shard shape: batch them
-                    pending.append(shards)
-                    pending_lens.append(len(data))
-                    if len(pending) >= batch_max:
-                        flush_batch(pending, pending_lens)
-                        pending, pending_lens = [], []
-                else:
-                    # odd-sized (tail) block: flush pending, then encode alone
-                    if pending:
-                        flush_batch(pending, pending_lens)
-                        pending, pending_lens = [], []
-                    flush_batch([shards], [len(data)])
+                mv = memoryview(data)
+                nfull = len(data) // bs
+                if nfull and aligned:
+                    batch = np.frombuffer(mv[: nfull * bs], dtype=np.uint8)
+                    flush_batch(
+                        batch.reshape(nfull, self.k, self.shard_size),
+                        [bs] * nfull,
+                    )
+                elif nfull:
+                    blocks = [
+                        gf256.split(mv[i * bs:(i + 1) * bs], self.k)
+                        for i in range(nfull)
+                    ]
+                    flush_batch(np.stack(blocks), [bs] * nfull)
+                tail = len(data) - nfull * bs
+                if tail:
+                    shards = gf256.split(mv[nfull * bs:], self.k)
+                    flush_batch(shards[None, ...], [tail])
                 if len(data) < want:
                     break
-            if pending:
-                flush_batch(pending, pending_lens)
             reap_inflight()
         except BaseException:
             # unwind: wait out in-flight shard writes so callers can safely
@@ -358,17 +368,25 @@ class Erasure:
                 active.append(next(idx_iter))
         except StopIteration:
             raise errors.ErasureReadQuorum("not enough shard streams")
+
+        def read_one(r):
+            rb = getattr(r, "read_blocks", None)
+            if rb is not None:
+                # one file read + one batched hash verify, rows returned as
+                # a zero-copy strided view of the frame buffer
+                return rb(shard_off, nblocks, shard_len)
+            return np.frombuffer(r.read_at(shard_off, read_len),
+                                 dtype=np.uint8).reshape(nblocks, shard_len)
+
         while len(got) < self.k:
             futs = {
-                i: pool.submit(readers[i].read_at, shard_off, read_len)
+                i: pool.submit(read_one, readers[i])
                 for i in active
             }
             active = []
             for i, fut in futs.items():
                 try:
-                    got[i] = np.frombuffer(fut.result(), dtype=np.uint8).reshape(
-                        nblocks, shard_len
-                    )
+                    got[i] = fut.result()
                 except Exception:
                     broken.add(i)
                     try:
@@ -517,7 +535,11 @@ class Erasure:
             avail = tuple(sorted(got))[: self.k]
             src = np.stack([got[i] for i in avail], axis=1)
             rebuilt = self._reconstruct_shards(src, avail, wanted)
-            for bi in range(g):
-                for j, w in enumerate(wanted):
-                    writers[w].write(rebuilt[bi, j])
+            for j, w in enumerate(wanted):
+                wf = getattr(writers[w], "write_frames", None)
+                if wf is not None:
+                    wf(rebuilt[:, j, :])
+                else:
+                    for bi in range(g):
+                        writers[w].write(rebuilt[bi, j])
             block_idx += g

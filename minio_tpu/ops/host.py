@@ -179,15 +179,23 @@ def hh256(data, key: bytes = MAGIC_HH256_KEY) -> bytes:
 
 
 def hh256_batch(blocks: np.ndarray, key: bytes = MAGIC_HH256_KEY) -> np.ndarray:
-    """Hash N equal-length streams: (N, L) uint8 -> (N, 32) uint8."""
+    """Hash N equal-length streams: (N, L) uint8 -> (N, 32) uint8.
+
+    Rows may be strided (e.g. one shard's column of a (B, K, S) batch, or
+    the block lanes of an interleaved [hash|block] frame buffer) as long
+    as each row itself is contiguous — the C call takes a row stride, so
+    no defensive copy is made on the hot path."""
     lib = _load()
     if lib is None:
         raise RuntimeError("host library unavailable; build csrc/ (make -C csrc)")
-    blocks = np.ascontiguousarray(blocks, dtype=np.uint8)
+    blocks = np.asarray(blocks, dtype=np.uint8)
+    if (blocks.ndim != 2 or blocks.strides[1] != 1
+            or blocks.strides[0] < blocks.shape[1]):
+        blocks = np.ascontiguousarray(blocks)
     n, l = blocks.shape
     out = np.empty((n, 32), dtype=np.uint8)
     lib.hh256_batch(
-        key, blocks.ctypes.data_as(ctypes.c_char_p), n, l, l,
+        key, ctypes.c_char_p(blocks.ctypes.data), n, l, blocks.strides[0],
         out.ctypes.data_as(ctypes.c_char_p),
     )
     return out
