@@ -271,7 +271,7 @@ def bench_host_ceilings():
     context for the e2e numbers (a PUT moves >= 4x the payload through RAM:
     stream read, encode read+parity, hash read, page-cache write; on a
     single-core VM none of those passes overlap)."""
-    src = np.zeros(128 << 20, dtype=np.uint8)
+    src = np.ones(128 << 20, dtype=np.uint8)  # real pages, not the CoW zero page
     dst = np.empty_like(src)
     dst[:] = src  # warm both buffers (cold pages measure fault cost, not copy)
     t0 = time.perf_counter()

@@ -11,6 +11,10 @@ Backend selection (reference analogue: MINIO_ERASURE_BACKEND in
 BASELINE.json's north star):
 - "host": C++ AVX2 PSHUFB codec (csrc/gf256_simd.cpp)
 - "tpu":  Pallas fused MXU kernel (ops/rs_pallas.py)
+- "mesh": multi-device jax.sharding.Mesh codec (parallel/mesh.py
+  MeshRSCodec) — (B, K, S) batches shard over (blocks, shards) axes and
+  parity/heal come from ICI psum collectives; falls back to host when
+  fewer than 2 devices are visible or K does not divide the shards axis
 - "auto": TPU when a TPU is attached AND the span is big enough to
   amortise dispatch; host otherwise (small objects are latency-bound).
 Set via env MINIO_TPU_ERASURE_BACKEND.
@@ -96,6 +100,31 @@ class _DeviceCodec:
         except Exception:
             return False
 
+    _mesh_cache: dict = {}  # (k, m) -> MeshRSCodec | None
+
+    @classmethod
+    def get_mesh(cls, k: int, m: int):
+        """Multi-device mesh codec (backend "mesh"): shards (B, K, S)
+        batches over a jax.sharding.Mesh (parallel/mesh.py), replacing the
+        reference's per-drive goroutine fan-out with ICI collectives.
+        None when fewer than 2 devices are visible or K does not divide
+        over the shards axis (callers fall back to the host codec)."""
+        with cls._lock:
+            key = (k, m)
+            if key not in cls._mesh_cache:
+                codec = None
+                try:
+                    import jax
+
+                    from minio_tpu.parallel import mesh as pmesh
+
+                    if len(jax.devices()) > 1:
+                        codec = pmesh.MeshRSCodec(k, m)
+                except Exception:
+                    codec = None
+                cls._mesh_cache[key] = codec
+            return cls._mesh_cache[key]
+
     @classmethod
     def get(cls, k: int, m: int, probe: bool = True):
         with cls._lock:
@@ -176,6 +205,12 @@ class Erasure:
         """The device codec to use for this dispatch, or None for host."""
         if self.m == 0 or self.backend == "host":
             return None
+        if self.backend == "mesh":
+            # full-shard dispatches only: tail blocks have per-object
+            # lengths and each novel shape would cost a fresh XLA compile
+            if shard_len != self.shard_size:
+                return None
+            return _DeviceCodec.get_mesh(self.k, self.m)
         if shard_len % 8192 != 0:
             return None
         if self.backend == "tpu":
@@ -269,27 +304,27 @@ class Erasure:
                     f"{n - len(dead)} writers < quorum {write_quorum}"
                 )
 
-        def flush_batch(batch: np.ndarray, lens: list[int]) -> None:
-            # batch: (B, K, S) same-shard-size data blocks.  One future per
-            # drive (goroutine-per-writer analog of parallelWriter,
-            # cmd/erasure-encode.go:36); a drive writes its shard of every
-            # block in order, so per-file layout is stable.  Uniform
-            # batches go out as one batched-hash writev frame group per
-            # drive (BitrotWriter.write_frames); a drive's rows are a
-            # strided column of the batch, so no per-shard copies happen.
+        def flush_batch(batch: np.ndarray, block_len: int) -> None:
+            # batch: (B, K, S) blocks of block_len payload bytes each (a
+            # short tail block always flushes alone, so one length covers
+            # the whole batch).  One future per drive (goroutine-per-
+            # writer analog of parallelWriter, cmd/erasure-encode.go:36);
+            # a drive writes its shard of every block in order, so
+            # per-file layout is stable.  Batches go out as one batched-
+            # hash writev frame group per drive (write_frames); a drive's
+            # rows are a strided column of the batch, no per-shard copies.
             parity = self._encode_shards(batch)
             reap_inflight()
-            uniform = len(set(lens)) == 1
-            shard_lens = [-(-ln // self.k) for ln in lens]
+            shard_len = -(-block_len // self.k)
 
             def write_drive(i: int) -> None:
                 rows = batch[:, i, :] if i < self.k else parity[:, i - self.k, :]
                 wf = getattr(writers[i], "write_frames", None)
-                if wf is not None and uniform:
-                    wf(rows[:, : shard_lens[0]])
+                if wf is not None:
+                    wf(rows[:, :shard_len])
                 else:
                     for bi in range(rows.shape[0]):
-                        writers[i].write(rows[bi, : shard_lens[bi]])
+                        writers[i].write(rows[bi, :shard_len])
 
             inflight.update({
                 i: pool.submit(write_drive, i)
@@ -319,20 +354,17 @@ class Erasure:
                 nfull = len(data) // bs
                 if nfull and aligned:
                     batch = np.frombuffer(mv[: nfull * bs], dtype=np.uint8)
-                    flush_batch(
-                        batch.reshape(nfull, self.k, self.shard_size),
-                        [bs] * nfull,
-                    )
+                    flush_batch(batch.reshape(nfull, self.k, self.shard_size), bs)
                 elif nfull:
                     blocks = [
                         gf256.split(mv[i * bs:(i + 1) * bs], self.k)
                         for i in range(nfull)
                     ]
-                    flush_batch(np.stack(blocks), [bs] * nfull)
+                    flush_batch(np.stack(blocks), bs)
                 tail = len(data) - nfull * bs
                 if tail:
                     shards = gf256.split(mv[nfull * bs:], self.k)
-                    flush_batch(shards[None, ...], [tail])
+                    flush_batch(shards[None, ...], tail)
                 if len(data) < want:
                     break
             reap_inflight()

@@ -55,14 +55,16 @@ def _partial_counts(mat_local: jax.Array, shards_local: jax.Array) -> jax.Array:
     return jnp.moveaxis(counts, 1, 0)
 
 
-def sharded_encode_fn(mesh: Mesh, k: int, m: int):
-    """Return a jitted distributed encode: (B, K, S) uint8 -> (B, M, S).
+def sharded_coding_fn(mesh: Mesh):
+    """Jitted distributed GF(2^8) coding matmul over the mesh.
 
-    B is sharded over the ``blocks`` axis, K over the ``shards`` axis; the
-    parity reduction is a psum (mod 2) over ``shards``.
+    f(mat_bits (R8, K8) int8, batch (B, K, S) uint8) -> (B, R, S) uint8
+    with B sharded over ``blocks`` and K over ``shards``; each device
+    computes partial parity-bit popcounts from its local shard columns
+    and a psum over ``shards`` (mod 2) completes the GF(2) dot — the
+    collective replacement for the reference's per-drive goroutine
+    fan-out (cmd/erasure-encode.go:36).
     """
-    mat = jnp.asarray(rs_tpu.encode_bits_matrix(k, m))  # (M8, K8)
-
     def local(mat_cols, shards_local):
         counts = _partial_counts(mat_cols, shards_local)
         total = jax.lax.psum(counts, "shards")
@@ -74,7 +76,72 @@ def sharded_encode_fn(mesh: Mesh, k: int, m: int):
         in_specs=(P(None, "shards"), P("blocks", "shards", None)),
         out_specs=P("blocks", None, None),
     )
-    return jax.jit(partial(shmapped, mat))
+    return jax.jit(shmapped)
+
+
+def sharded_encode_fn(mesh: Mesh, k: int, m: int):
+    """Return a jitted distributed encode: (B, K, S) uint8 -> (B, M, S)."""
+    mat = jnp.asarray(rs_tpu.encode_bits_matrix(k, m))  # (M8, K8)
+    return partial(sharded_coding_fn(mesh), mat)
+
+
+class MeshRSCodec:
+    """Production multi-device codec with the host/Pallas codec surface.
+
+    Selected by the streaming erasure engine via
+    MINIO_TPU_ERASURE_BACKEND=mesh (coding.Erasure._device): (B, K, S)
+    batches from the object layer's PutObject/heal paths are sharded over
+    the (blocks, shards) device mesh, so encode parity and heal
+    reconstruction emerge from ICI collectives instead of one chip.
+    Requires K to divide over the ``shards`` axis; batches are padded up
+    to the ``blocks`` axis size.
+    """
+
+    def __init__(self, k: int, m: int, mesh: Mesh | None = None):
+        if mesh is None:
+            mesh = make_mesh()
+        self.k, self.m, self.mesh = k, m, mesh
+        self.n_bl = mesh.shape["blocks"]
+        self.n_sh = mesh.shape["shards"]
+        if k % self.n_sh != 0:
+            raise ValueError(
+                f"k={k} does not divide over the {self.n_sh}-way shards axis"
+            )
+        self._fn = sharded_coding_fn(mesh)
+        self._enc = jnp.asarray(rs_tpu.encode_bits_matrix(k, m))
+        self._rec_cache: dict[tuple, jax.Array] = {}
+        self.dispatches = 0  # observability: mesh dispatch count
+        from jax.sharding import NamedSharding
+
+        self._in_sharding = NamedSharding(mesh, P("blocks", "shards", None))
+
+    def _run(self, mat: jax.Array, batch) -> jax.Array:
+        batch = np.asarray(batch, dtype=np.uint8)
+        b = batch.shape[0]
+        pad = (-b) % self.n_bl
+        if pad:
+            batch = np.concatenate(
+                [batch, np.zeros((pad,) + batch.shape[1:], np.uint8)]
+            )
+        dev = jax.device_put(batch, self._in_sharding)
+        out = self._fn(mat, dev)
+        self.dispatches += 1
+        return out[:b] if pad else out
+
+    def encode(self, data_shards) -> jax.Array:
+        """(B, K, S) uint8 -> (B, M, S) parity."""
+        return self._run(self._enc, data_shards)
+
+    def reconstruct(self, src_shards, available, wanted) -> jax.Array:
+        """(B, K, S) surviving shards -> (B, len(wanted), S)."""
+        sig = (tuple(available), tuple(wanted))
+        mat = self._rec_cache.get(sig)
+        if mat is None:
+            mat = jnp.asarray(
+                rs_tpu.reconstruct_bits_matrix(self.k, self.m, *sig)
+            )
+            self._rec_cache[sig] = mat
+        return self._run(mat, src_shards)
 
 
 def sharded_pipeline_step(mesh: Mesh, k: int, m: int, heal_wanted=(0,)):
@@ -86,33 +153,21 @@ def sharded_pipeline_step(mesh: Mesh, k: int, m: int, heal_wanted=(0,)):
     step has a scalar 'loss' observable (0 when the pipeline is correct).
     """
     n = k + m
-    enc = sharded_encode_fn(mesh, k, m)
+    coding = sharded_coding_fn(mesh)
+    enc_mat = jnp.asarray(rs_tpu.encode_bits_matrix(k, m))
     # degraded read: reconstruct from the first k surviving shards
     avail = tuple(i for i in range(n) if i not in heal_wanted)[:k]
     rec_mat = jnp.asarray(
         rs_tpu.reconstruct_bits_matrix(k, m, avail, tuple(heal_wanted))
     )
-
-    def heal_local(mat_cols, src_local):
-        counts = _partial_counts(mat_cols, src_local)
-        total = jax.lax.psum(counts, "shards")
-        return rs_tpu._pack_bits(total & 1)
-
-    heal_shmapped = jax.shard_map(
-        heal_local,
-        mesh=mesh,
-        in_specs=(P(None, "shards"), P("blocks", "shards", None)),
-        out_specs=P("blocks", None, None),
-    )
-
     srcs = avail
 
     @jax.jit
     def step(data_shards):
-        parity = enc(data_shards)  # (B, M, S)
+        parity = coding(enc_mat, data_shards)  # (B, M, S)
         full = jnp.concatenate([data_shards, parity], axis=1)
         src = full[:, list(srcs), :]  # first-k surviving shards
-        rebuilt = heal_shmapped(rec_mat, src)  # (B, len(wanted), S)
+        rebuilt = coding(rec_mat, src)  # (B, len(wanted), S)
         orig = full[:, list(heal_wanted), :]
         loss = jnp.max(
             jnp.abs(rebuilt.astype(jnp.int32) - orig.astype(jnp.int32))
