@@ -290,6 +290,46 @@ def bench_host_ceilings():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_select():
+    """S3 Select scan rate: SELECT COUNT(*) ... WHERE over a generated CSV
+    through the full engine (event-stream framing included), columnar fast
+    path vs the row engine (reference harness:
+    internal/s3select/select_benchmark_test.go)."""
+    import io as iomod
+
+    from minio_tpu import select as sel
+
+    rng = np.random.default_rng(0)
+    n = 6_000_000  # ~83 MiB, enough for a stable per-byte rate
+    a = rng.integers(0, 1000, n)
+    b = rng.integers(0, 1_000_000, n)
+    step = 100_000
+    big = ("a,b,c\n" + "\n".join(
+        "\n".join(f"k{x},{y},{y % 97}" for x, y in zip(a[i:i + step], b[i:i + step]))
+        for i in range(0, n, step)
+    ) + "\n").encode()
+    req = sel.SelectRequest(
+        "SELECT COUNT(*) FROM s3object WHERE b > 500000",
+        {"CSV": {}}, {"CSV": {}},
+    )
+
+    def run(data):
+        t0 = time.perf_counter()
+        out = b"".join(sel.run_select(req, iomod.BytesIO(data), len(data)))
+        assert b":event" in out or out  # consumed
+        return len(data) / (time.perf_counter() - t0) / 2**30
+
+    fast = max(run(big), run(big))
+    os.environ["MINIO_TPU_SELECT_COLUMNAR"] = "0"
+    try:
+        sl = big[: len(big) // 8]
+        sl = sl[: sl.rfind(b"\n") + 1]
+        slow = run(sl)
+    finally:
+        os.environ.pop("MINIO_TPU_SELECT_COLUMNAR", None)
+    return fast, slow
+
+
 def main():
     cpu_enc, cpu_heal, nthreads = bench_cpu()
     memcpy_gibs, disk_write_gibs = bench_host_ceilings()
@@ -302,6 +342,7 @@ def main():
     ph2, _ = bench_e2e("host")
     e2e_put, e2e_get = max(e2e_put, p2), max(e2e_get, g2)
     e2e_put_host = max(e2e_put_host, ph2)
+    select_fast, select_row = bench_select()
     try:
         tpu, link_h2d, link_d2h = bench_tpu()
     except Exception as e:  # pragma: no cover - report CPU-only on failure
@@ -338,6 +379,9 @@ def main():
             "e2e_put_host_gibs": round(e2e_put_host, 3),
             "host_memcpy_gibs": round(memcpy_gibs, 3),
             "host_disk_write_gibs": round(disk_write_gibs, 3),
+            "select_scan_gibs": round(select_fast, 3),
+            "select_row_engine_gibs": round(select_row, 3),
+            "select_speedup": round(select_fast / select_row, 1),
             "note": (
                 "value = device-resident kernel aggregate; stream number is "
                 "transfer-inclusive and link-bound in this tunneled-TPU "

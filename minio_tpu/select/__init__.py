@@ -114,8 +114,20 @@ def run_select(req: SelectRequest, stream,
     only option the framing leaves (reference behaves the same)."""
     query = parse(req.expression)
     ev = Evaluator(query)
-    reader = _make_input(req, stream)
     out = _make_output(req)
+
+    # columnar CSV fast path (pyarrow parse + vectorized mask/aggregates);
+    # probes the first batch and replays consumed bytes into the row
+    # engine when the query/data shape is out of scope
+    from . import columnar
+
+    rw = columnar.Rewindable(stream)
+    fast = columnar.try_columnar(req, query, rw, object_size, out)
+    if fast is not None:
+        yield from fast
+        return
+    stream = rw
+    reader = _make_input(req, stream)
 
     returned = 0
     buf = bytearray()

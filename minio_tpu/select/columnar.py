@@ -1,0 +1,560 @@
+"""Columnar CSV fast path for S3 Select.
+
+The reference accelerates Select with simdjson and a generated-assembly
+CSV scanner (internal/s3select/simdj, select_benchmark_test.go); the
+equivalent here is pyarrow's C++ CSV parser plus vectorized predicate
+masks and aggregate kernels, so a 1 GiB `SELECT COUNT(*) ... WHERE`
+scans at parser speed instead of the per-row Python loop in sql.Evaluator.
+
+Every column is parsed as a STRING (a two-pass open sniffs the column
+names, then reopens with explicit string types), so pyarrow type
+inference can never fail on a later batch, projected values reproduce the
+raw CSV text byte-for-byte, and predicates replicate the row engine's
+exact semantics: a cell that parses as a number compares numerically
+against numeric(-looking) literals, anything else compares as text —
+including empty cells, matching sql._num/_cmp_pair per element.
+
+Eligibility (everything else transparently falls back to the row engine):
+- CSV input, single-char delimiter/quote, "\n" records, no comment char
+- projections: all plain columns / `*` / all aggregates
+  (COUNT/SUM/MIN/MAX/AVG over a column or COUNT(*))
+- WHERE: AND/OR tree of comparisons `col <op> literal` (op in
+  =, !=, <, <=, >, >=), or absent
+
+Known divergences from the row engine (documented, all garbage-data
+corner cases): structurally ragged rows (wrong column count) error
+in-band instead of being padded; SUM/AVG over *fractional* values may
+differ in the final ulp (vectorized vs sequential float accumulation).
+
+Disable with MINIO_TPU_SELECT_COLUMNAR=0.
+"""
+
+from __future__ import annotations
+
+import operator
+import os
+import re
+from itertools import chain
+from typing import Iterator
+
+from . import eventstream as es
+from .records import _decomp
+from .sql import (AGGREGATES, Bin, Col, Evaluator, Func, Lit, Query,
+                  SQLError, _cmp_pair, _num)
+
+# flush size mirrors run_select
+FLUSH = 256 << 10
+
+# observability: how often the fast path engaged vs fell back
+stats = {"fast": 0, "fallback": 0}
+
+
+class _Fallback(Exception):
+    """Raised when the probe shows the fast path cannot honor row-engine
+    semantics for this query (unknown column, unsupported shape)."""
+
+
+class Rewindable:
+    """Byte-stream wrapper recording reads so probes can rewind() any
+    number of times; commit() stops recording and drops history."""
+
+    def __init__(self, raw):
+        self.raw = raw
+        self._buf = bytearray()
+        self._pos = 0  # logical offset into recorded history
+        self._recording = True
+
+    def read(self, n: int = -1):
+        out = b""
+        if self._pos < len(self._buf):
+            if n is None or n < 0:
+                out = bytes(self._buf[self._pos:])
+            else:
+                out = bytes(self._buf[self._pos:self._pos + n])
+            self._pos += len(out)
+            if n is not None and 0 <= n == len(out):
+                return out
+            n = -1 if n is None or n < 0 else n - len(out)
+        data = self.raw.read(n) or b""
+        if self._recording and data:
+            self._buf += data
+        self._pos += len(data)
+        return out + data
+
+    def rewind(self) -> None:
+        self._pos = 0
+
+    def commit(self) -> None:
+        # drop history already consumed; stop recording new reads
+        self._buf = self._buf[self._pos:]
+        self._pos = 0
+        self._recording = False
+
+    # file-like protocol bits pyarrow/gzip/TextIOWrapper probe for
+    closed = False
+
+    def readable(self) -> bool:
+        return True
+
+    def seekable(self) -> bool:
+        return False
+
+    def writable(self) -> bool:
+        return False
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        # pyarrow closes its source on reader teardown; the row engine may
+        # still need to replay, so closing is a caller decision, not ours
+        pass
+
+
+def _enabled() -> bool:
+    return os.environ.get("MINIO_TPU_SELECT_COLUMNAR", "1") != "0"
+
+
+def _eligible(req, q: Query) -> bool:
+    """Cheap pre-read eligibility: query + serialization shape only."""
+    inp = req.input_ser
+    if "CSV" not in inp:
+        return False
+    c = inp["CSV"] if isinstance(inp["CSV"], dict) else {}
+    if (c.get("RecordDelimiter", "\n") or "\n") != "\n":
+        return False
+    if len(c.get("FieldDelimiter", ",") or ",") != 1:
+        return False
+    if len(c.get("QuoteCharacter", '"') or '"') != 1:
+        return False
+    if c.get("Comments"):
+        return False
+    if not _where_ok(q.where):
+        return False
+    if q.star and not q.projections:
+        return True
+    aggs = [isinstance(p.expr, Func) and p.expr.name in AGGREGATES
+            for p in q.projections]
+    if aggs and all(aggs):
+        return all(
+            p.expr.star or (len(p.expr.args) == 1
+                            and isinstance(p.expr.args[0], Col))
+            for p in q.projections
+        )
+    return bool(q.projections) and all(
+        isinstance(p.expr, Col) for p in q.projections
+    )
+
+
+def _where_ok(e) -> bool:
+    if e is None:
+        return True
+    if isinstance(e, Bin):
+        if e.op in ("and", "or"):
+            return _where_ok(e.l) and _where_ok(e.r)
+        if e.op in ("=", "==", "!=", "<>", "<", "<=", ">", ">="):
+            return (isinstance(e.l, Col) and isinstance(e.r, Lit)) or (
+                isinstance(e.l, Lit) and isinstance(e.r, Col))
+    return False
+
+
+def _resolve(schema_names: list[str], name: str, alias: str,
+             header_use: bool) -> int:
+    """Column name -> index, mirroring Evaluator._col resolution order:
+    alias strip, exact, case-insensitive, positional _N.  Without a
+    header row only positional _N names exist (pyarrow's autogenerated
+    f0/f1 names must not leak into the query namespace)."""
+    parts = name.split(".")
+    if alias and parts and parts[0].lower() == alias:
+        parts = parts[1:]
+    if len(parts) != 1:
+        raise _Fallback(f"nested column {name}")
+    p = parts[0]
+    if header_use:
+        if p in schema_names:
+            return schema_names.index(p)
+        lowered = [s.lower() for s in schema_names]
+        if p.lower() in lowered:
+            return lowered.index(p.lower())
+    if re.fullmatch(r"_\d+", p):
+        i = int(p[1:]) - 1
+        if 0 <= i < len(schema_names):
+            return i
+    raise _Fallback(f"unknown column {name}")
+
+
+_OPS = {
+    "=": operator.eq, "==": operator.eq,
+    "!=": operator.ne, "<>": operator.ne,
+    "<": operator.lt, "<=": operator.le,
+    ">": operator.gt, ">=": operator.ge,
+}
+_FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+
+
+def _pc_ops():
+    import pyarrow.compute as pc
+
+    return {
+        "=": pc.equal, "==": pc.equal,
+        "!=": pc.not_equal, "<>": pc.not_equal,
+        "<": pc.less, "<=": pc.less_equal,
+        ">": pc.greater, ">=": pc.greater_equal,
+    }
+
+
+_PC_OPS: dict = {}
+
+
+class _Cols:
+    """Per-batch column accessor with two tiers: a pure-arrow float64
+    cast (C++-speed, succeeds only when EVERY cell parses — the common
+    clean-data case) and a pandas coercion (NaN where the text does not
+    parse) for batches containing empties or garbage."""
+
+    _MISS = object()
+
+    def __init__(self, tbl):
+        self.tbl = tbl
+        self._str: dict[int, object] = {}
+        self._num: dict[int, object] = {}
+        self._arrow_num: dict[int, object] = {}
+
+    def arrow_nums(self, idx: int):
+        """float64 ChunkedArray, or None when any cell fails to parse."""
+        n = self._arrow_num.get(idx, self._MISS)
+        if n is self._MISS:
+            import pyarrow as pa
+            import pyarrow.compute as pc
+
+            try:
+                n = pc.cast(self.tbl.column(idx), pa.float64())
+            except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+                n = None
+            self._arrow_num[idx] = n
+        return n
+
+    def text(self, idx: int):
+        s = self._str.get(idx)
+        if s is None:
+            s = self.tbl.column(idx).to_pandas().astype(object)
+            self._str[idx] = s
+        return s
+
+    def nums(self, idx: int):
+        n = self._num.get(idx)
+        if n is None:
+            import pandas as pd
+
+            n = pd.to_numeric(self.text(idx), errors="coerce")
+            self._num[idx] = n
+        return n
+
+
+def _compile_where(e, names: list[str], alias: str, header_use: bool):
+    """Predicate AST -> fn(_Cols) -> bool ndarray replicating the row
+    engine's per-element semantics exactly: numeric compare where both
+    the cell and the literal parse as numbers, text compare otherwise
+    (sql._cmp_pair)."""
+    import numpy as np
+
+    if not _PC_OPS:
+        _PC_OPS.update(_pc_ops())
+
+    def comp(node):
+        if isinstance(node, Bin) and node.op in ("and", "or"):
+            lf, rf = comp(node.l), comp(node.r)
+            if node.op == "and":
+                return lambda c: lf(c) & rf(c)
+            return lambda c: lf(c) | rf(c)
+        col, lit, flip = node.l, node.r, False
+        if isinstance(col, Lit):
+            col, lit, flip = node.r, node.l, True
+        idx = _resolve(names, col.name, alias, header_use)
+        op = _FLIP.get(node.op, node.op) if flip else node.op
+        fn = _OPS[op]
+        numlit = _num(lit.v) if not isinstance(lit.v, bool) else lit.v
+        strlit = str(lit.v)
+        pc_fn = _PC_OPS[op]
+        if isinstance(numlit, (int, float)) and not isinstance(numlit, bool):
+            def leaf(c, idx=idx, fn=fn, pc_fn=pc_fn, numlit=numlit,
+                     strlit=strlit):
+                arrow = c.arrow_nums(idx)
+                if arrow is not None:  # clean batch: stay in C++
+                    return pc_fn(arrow, float(numlit)).to_numpy(
+                        zero_copy_only=False)
+                num = c.nums(idx)
+                isnum = num.notna().to_numpy()
+                res = np.zeros(len(isnum), dtype=bool)
+                if isnum.any():
+                    res[isnum] = fn(num[isnum], numlit).to_numpy()
+                rest = ~isnum
+                if rest.any():
+                    res[rest] = fn(
+                        c.text(idx)[rest].astype(str), strlit).to_numpy()
+                return res
+            return leaf
+
+        def leaf(c, idx=idx, pc_fn=pc_fn, strlit=strlit):
+            # lexicographic string compare entirely in arrow
+            return pc_fn(c.tbl.column(idx), strlit).to_numpy(
+                zero_copy_only=False)
+        return leaf
+
+    return comp(e)
+
+
+def try_columnar(req, query: Query, rw: Rewindable, object_size: int,
+                 out) -> Iterator[bytes] | None:
+    """Probe + run the columnar path.  Returns the event-stream iterator,
+    or None (with `rw` rewound) when the row engine must take over."""
+    if not _enabled():
+        rw.rewind()
+        return None
+    if not _eligible(req, query):
+        stats["fallback"] += 1
+        rw.rewind()
+        return None
+    try:
+        import pyarrow as pa
+        import pyarrow.csv as pacsv
+    except Exception:  # pragma: no cover - pyarrow baked into this env
+        rw.rewind()
+        return None
+
+    inp = req.input_ser
+    c = inp["CSV"] if isinstance(inp["CSV"], dict) else {}
+    header = (c.get("FileHeaderInfo", "USE") or "USE").upper()
+    compression = inp.get("CompressionType", "NONE") or "NONE"
+    parse_opts = pacsv.ParseOptions(
+        delimiter=c.get("FieldDelimiter", ",") or ",",
+        quote_char=c.get("QuoteCharacter", '"') or '"',
+        newlines_in_values=True,
+    )
+
+    # pass 1: sniff column names from the first block, then rewind and
+    # reopen with every column pinned to string — no inference, so a
+    # later batch can never hit a type-conversion error
+    try:
+        raw = _decomp(rw, compression)
+        sniff = pacsv.open_csv(
+            raw,
+            read_options=pacsv.ReadOptions(
+                block_size=1 << 16,
+                autogenerate_column_names=header != "USE",
+                skip_rows=1 if header == "IGNORE" else 0,
+            ),
+            parse_options=parse_opts,
+        )
+        names = [f.name for f in sniff.schema]
+        del sniff
+    except (pa.ArrowInvalid, pa.ArrowKeyError, StopIteration, OSError):
+        stats["fallback"] += 1
+        rw.rewind()
+        return None
+
+    alias = query.table_alias
+    header_use = header == "USE"
+    try:
+        mask_fn = (_compile_where(query.where, names, alias, header_use)
+                   if query.where is not None else None)
+        agg_cols: list[int | None] = []
+        proj_cols: list[int] = []
+        ev = Evaluator(query)
+        if ev.is_aggregate:
+            for p in query.projections:
+                f = p.expr
+                agg_cols.append(
+                    None if f.star
+                    else _resolve(names, f.args[0].name, alias, header_use))
+        elif query.star:
+            proj_cols = list(range(len(names)))
+        else:
+            proj_cols = [
+                _resolve(names, p.expr.name, alias, header_use)
+                for p in query.projections
+            ]
+    except _Fallback:
+        stats["fallback"] += 1
+        rw.rewind()
+        return None
+
+    rw.rewind()
+    try:
+        raw = _decomp(rw, compression)
+        reader = pacsv.open_csv(
+            raw,
+            read_options=pacsv.ReadOptions(
+                block_size=4 << 20,
+                autogenerate_column_names=header != "USE",
+                skip_rows=1 if header == "IGNORE" else 0,
+            ),
+            parse_options=parse_opts,
+            convert_options=pacsv.ConvertOptions(
+                column_types={n: pa.string() for n in names},
+                strings_can_be_null=False,
+            ),
+        )
+        first = reader.read_next_batch()
+    except (pa.ArrowInvalid, pa.ArrowKeyError, StopIteration, OSError):
+        stats["fallback"] += 1
+        rw.rewind()
+        return None
+
+    stats["fast"] += 1
+    rw.commit()
+
+    def norm_name(i: int) -> str:
+        return names[i] if header_use else f"_{i + 1}"
+
+    def gen() -> Iterator[bytes]:
+        import numpy as np
+
+        returned = 0
+        buf = bytearray()
+        limit = query.limit
+        n_out = 0
+        try:
+            for batch in chain([first], reader):
+                if (limit is not None and n_out >= limit
+                        and not ev.is_aggregate):
+                    break
+                tbl = pa.Table.from_batches([batch])
+                if mask_fn is not None:
+                    mask = mask_fn(_Cols(tbl))
+                    if not mask.any():
+                        continue
+                    if not mask.all():
+                        tbl = tbl.filter(pa.array(mask))
+                if tbl.num_rows == 0:
+                    continue
+                if ev.is_aggregate:
+                    _accumulate(ev, tbl, agg_cols)
+                    continue
+                take = tbl.num_rows
+                if limit is not None:
+                    take = min(take, limit - n_out)
+                    tbl = tbl.slice(0, take)
+                pull = [tbl.column(i).to_pylist() for i in proj_cols]
+                if query.star:
+                    keys = [norm_name(i) for i in proj_cols]
+                else:
+                    keys = [
+                        p.alias or Evaluator._auto_name(p.expr, i)
+                        for i, p in enumerate(query.projections)
+                    ]
+                for row in zip(*pull):
+                    rec = {
+                        k: ("" if v is None else v)
+                        for k, v in zip(keys, row)
+                    }
+                    buf += out.serialize(rec)
+                    if len(buf) >= FLUSH:
+                        returned += len(buf)
+                        yield es.records_message(bytes(buf))
+                        buf.clear()
+                n_out += take
+            if ev.is_aggregate:
+                buf += out.serialize(ev.aggregate_result())
+            if buf:
+                returned += len(buf)
+                yield es.records_message(bytes(buf))
+            if req.request_progress:
+                yield es.progress_message(object_size, object_size, returned)
+            yield es.stats_message(object_size, object_size, returned)
+            yield es.end_message()
+        except SQLError as e:
+            yield es.error_message("InvalidQuery", str(e))
+        except pa.ArrowInvalid as e:
+            # structural CSV errors only (ragged rows) — types can no
+            # longer fail since every column is read as string
+            yield es.error_message("InvalidQuery", f"CSV parse: {e}")
+
+    return gen()
+
+
+def _accumulate(ev: Evaluator, tbl, agg_cols) -> None:
+    """Vectorized Evaluator.accumulate over a filtered batch: fills the
+    evaluator's _agg_state so aggregate_result() serializes identically.
+
+    Clean numeric batches take the vector path; a batch containing any
+    non-numeric non-empty cell drops to the row engine's own per-value
+    update (same _num/_cmp_pair calls), so garbage data behaves
+    identically to the slow path — including SUM/AVG raising SQLError."""
+    import pandas as pd
+
+    import pyarrow.compute as pc
+
+    cols = _Cols(tbl)
+    for i, p in enumerate(ev.q.projections):
+        f = p.expr
+        st = ev._agg_state[i]
+        if f.star:
+            st["count"] += tbl.num_rows
+            continue
+        arrow = cols.arrow_nums(agg_cols[i])
+        if arrow is not None:  # clean batch: every cell numeric, stay in C++
+            st["count"] += len(arrow)
+            if f.name in ("sum", "avg"):
+                st["sum"] += float(pc.sum(arrow).as_py())
+            if f.name in ("min", "max"):
+                mm = pc.min_max(arrow).as_py()
+                s_col = tbl.column(agg_cols[i])
+                lo = _num(s_col[pc.index(arrow, mm["min"]).as_py()].as_py())
+                hi = _num(s_col[pc.index(arrow, mm["max"]).as_py()].as_py())
+                if st["min"] is None:
+                    st["min"], st["max"] = lo, hi
+                else:
+                    a, b = _cmp_pair(lo, st["min"])
+                    if a < b:
+                        st["min"] = lo
+                    a, b = _cmp_pair(hi, st["max"])
+                    if a > b:
+                        st["max"] = hi
+            continue
+        s = cols.text(agg_cols[i])
+        nonempty = s.notna().to_numpy() & (s != "").to_numpy()
+        valid = int(nonempty.sum())
+        if valid == 0:
+            continue
+        vals = s[nonempty]
+        num = pd.to_numeric(vals, errors="coerce")
+        if num.notna().all():
+            st["count"] += valid
+            if f.name in ("sum", "avg"):
+                st["sum"] += float(num.sum())
+            if f.name in ("min", "max"):
+                # take the extreme element's OWN textual parse (first
+                # occurrence), so "5" stays int and "5.0" stays float
+                # exactly as the row engine's sequential _num updates
+                lo = _num(vals.loc[num.idxmin()])
+                hi = _num(vals.loc[num.idxmax()])
+                if st["min"] is None:
+                    st["min"], st["max"] = lo, hi
+                else:
+                    a, b = _cmp_pair(lo, st["min"])
+                    if a < b:
+                        st["min"] = lo
+                    a, b = _cmp_pair(hi, st["max"])
+                    if a > b:
+                        st["max"] = hi
+            continue
+        # garbage batch: faithful sequential update via the row engine's
+        # own coercion helpers
+        for v in vals:
+            st["count"] += 1
+            nv = _num(v)
+            if f.name in ("sum", "avg"):
+                if not isinstance(nv, (int, float)) or isinstance(nv, bool):
+                    raise SQLError(f"{f.name.upper()} over non-number")
+                st["sum"] += nv
+            if f.name in ("min", "max"):
+                if st["min"] is None:
+                    st["min"] = st["max"] = nv
+                else:
+                    a, b = _cmp_pair(nv, st["min"])
+                    if a < b:
+                        st["min"] = nv
+                    a, b = _cmp_pair(nv, st["max"])
+                    if a > b:
+                        st["max"] = nv
