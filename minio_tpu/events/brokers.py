@@ -1,0 +1,348 @@
+"""Broker-backed notification targets: Kafka, MQTT, Redis, NATS.
+
+Wire-protocol clients written directly on sockets (no client libraries in
+this image), each implementing the same target interface as
+`targets.WebhookTarget` (send raises TargetError so the notifier's
+store-backed worker holds the event and retries — the offline-queue
+semantics of the reference's store-wrapped targets).
+
+Reference: internal/event/target/kafka.go (sarama producer, :238 Send),
+internal/event/target/mqtt.go (paho client, :168 Send),
+internal/event/target/redis.go (HSET for "namespace" format, RPUSH for
+"access", :238), internal/event/target/nats.go (:301).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import zlib
+
+from .targets import TargetError
+
+_FMT_NAMESPACE = "namespace"
+_FMT_ACCESS = "access"
+
+
+class _SocketTarget:
+    """Shared connect/reconnect plumbing: one persistent TCP connection,
+    re-dialed on the next send after any failure."""
+
+    def __init__(self, host: str, port: int, timeout: float = 5.0):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self._sock: socket.socket | None = None
+        self._lock = threading.Lock()
+
+    def _dial(self) -> socket.socket:
+        sock = socket.create_connection((self.host, self.port), self.timeout)
+        sock.settimeout(self.timeout)
+        return sock
+
+    def _handshake(self, sock: socket.socket) -> None:
+        """Override: protocol-level connection setup."""
+
+    def _conn(self) -> socket.socket:
+        if self._sock is None:
+            sock = self._dial()
+            try:
+                self._handshake(sock)
+            except BaseException:
+                sock.close()
+                raise
+            self._sock = sock
+        return self._sock
+
+    def _drop(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def send(self, log: dict) -> None:
+        with self._lock:
+            try:
+                self._publish(self._conn(), log)
+            except TargetError:
+                self._drop()
+                raise
+            except Exception as e:
+                self._drop()
+                raise TargetError(f"{self.kind} {self.host}:{self.port}: {e}") from e
+
+    def _publish(self, sock: socket.socket, log: dict) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        with self._lock:
+            self._drop()
+
+    @property
+    def target_id(self) -> str:
+        return f"{self.name}:{self.kind}"
+
+    def arn(self, region: str) -> str:
+        return f"arn:minio:sqs:{region}:{self.name}:{self.kind}"
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise TargetError("connection closed mid-frame")
+        buf += chunk
+    return buf
+
+
+# ---------------------------------------------------------------------- MQTT
+
+
+def _mqtt_varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n % 128
+        n //= 128
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _mqtt_str(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack(">H", len(b)) + b
+
+
+class MQTTTarget(_SocketTarget):
+    """MQTT 3.1.1 publisher, QoS 1 (PUBLISH awaits PUBACK) — the
+    reference's paho-based target publishes the event log JSON to one
+    topic (internal/event/target/mqtt.go:168)."""
+
+    kind = "mqtt"
+
+    def __init__(self, target_name: str, host: str, port: int, topic: str,
+                 username: str = "", password: str = "", qos: int = 1,
+                 timeout: float = 5.0):
+        super().__init__(host, port, timeout)
+        self.name = target_name
+        self.topic = topic
+        self.username = username
+        self.password = password
+        self.qos = 1 if qos else 0
+        self._pkt_id = 0
+
+    def _handshake(self, sock: socket.socket) -> None:
+        flags = 0x02  # clean session
+        payload = _mqtt_str(f"minio-tpu-{self.name}")
+        if self.username:
+            flags |= 0x80
+            payload += _mqtt_str(self.username)
+            if self.password:
+                flags |= 0x40
+                payload += _mqtt_str(self.password)
+        var = _mqtt_str("MQTT") + bytes([0x04, flags]) + struct.pack(">H", 60)
+        pkt = bytes([0x10]) + _mqtt_varint(len(var) + len(payload)) + var + payload
+        sock.sendall(pkt)
+        hdr = _recv_exact(sock, 4)  # CONNACK is always 4 bytes
+        if hdr[0] != 0x20 or hdr[3] != 0:
+            raise TargetError(f"mqtt connack refused (rc={hdr[3]})")
+
+    def _publish(self, sock: socket.socket, log: dict) -> None:
+        body = json.dumps(log).encode()
+        self._pkt_id = self._pkt_id % 0xFFFF + 1
+        var = _mqtt_str(self.topic)
+        fixed = 0x30 | (self.qos << 1)
+        if self.qos:
+            var += struct.pack(">H", self._pkt_id)
+        pkt = bytes([fixed]) + _mqtt_varint(len(var) + len(body)) + var + body
+        sock.sendall(pkt)
+        if self.qos:
+            ack = _recv_exact(sock, 4)
+            if ack[0] != 0x40 or struct.unpack(">H", ack[2:4])[0] != self._pkt_id:
+                raise TargetError("mqtt puback mismatch")
+
+
+# --------------------------------------------------------------------- Redis
+
+
+class RedisTarget(_SocketTarget):
+    """RESP client. format="namespace" keeps one hash field per object
+    (HSET key objectKey log); format="access" appends to a list
+    (RPUSH key [timestamp, log]) — reference
+    internal/event/target/redis.go:238."""
+
+    kind = "redis"
+
+    def __init__(self, target_name: str, host: str, port: int, key: str,
+                 fmt: str = _FMT_ACCESS, password: str = "",
+                 timeout: float = 5.0):
+        if fmt not in (_FMT_NAMESPACE, _FMT_ACCESS):
+            raise ValueError(f"redis format {fmt!r}")
+        super().__init__(host, port, timeout)
+        self.name = target_name
+        self.key = key
+        self.fmt = fmt
+        self.password = password
+
+    @staticmethod
+    def _cmd(*args: bytes) -> bytes:
+        out = [b"*%d\r\n" % len(args)]
+        for a in args:
+            out.append(b"$%d\r\n%s\r\n" % (len(a), a))
+        return b"".join(out)
+
+    def _reply(self, sock: socket.socket) -> bytes:
+        line = b""
+        while not line.endswith(b"\r\n"):
+            c = sock.recv(1)
+            if not c:
+                raise TargetError("redis connection closed")
+            line += c
+        if line[:1] == b"-":
+            raise TargetError(f"redis error: {line[1:-2].decode()}")
+        return line[:-2]
+
+    def _handshake(self, sock: socket.socket) -> None:
+        if self.password:
+            sock.sendall(self._cmd(b"AUTH", self.password.encode()))
+            self._reply(sock)
+        sock.sendall(self._cmd(b"PING"))
+        self._reply(sock)
+
+    def _publish(self, sock: socket.socket, log: dict) -> None:
+        body = json.dumps(log).encode()
+        if self.fmt == _FMT_NAMESPACE:
+            field = log.get("Key", "").encode()
+            sock.sendall(self._cmd(b"HSET", self.key.encode(), field, body))
+        else:
+            sock.sendall(self._cmd(b"RPUSH", self.key.encode(), body))
+        self._reply(sock)
+
+
+# --------------------------------------------------------------------- Kafka
+
+
+def _kstr(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack(">h", len(b)) + b
+
+
+def _kbytes(b: bytes | None) -> bytes:
+    if b is None:
+        return struct.pack(">i", -1)
+    return struct.pack(">i", len(b)) + b
+
+
+class KafkaTarget(_SocketTarget):
+    """Minimal produce-only Kafka client: Produce v2 requests carrying a
+    message-set v1 (crc/magic/attrs/timestamp/key/value) to one
+    topic-partition, acks=1, response error-code checked — the
+    delivery semantics of the reference's sarama SyncProducer
+    (internal/event/target/kafka.go:238)."""
+
+    kind = "kafka"
+
+    def __init__(self, target_name: str, host: str, port: int, topic: str,
+                 partition: int = 0, timeout: float = 5.0):
+        super().__init__(host, port, timeout)
+        self.name = target_name
+        self.topic = topic
+        self.partition = partition
+        self._corr = 0
+
+    def _publish(self, sock: socket.socket, log: dict) -> None:
+        value = json.dumps(log).encode()
+        key = log.get("Key", "").encode() or None
+        # message v1: crc | magic=1 | attrs=0 | timestamp | key | value
+        ts = int(log.get("_ts_ms", 0))
+        tail = bytes([1, 0]) + struct.pack(">q", ts) + _kbytes(key) + _kbytes(value)
+        msg = struct.pack(">I", zlib.crc32(tail)) + tail
+        msgset = struct.pack(">q", 0) + struct.pack(">i", len(msg)) + msg
+        body = (
+            struct.pack(">h", 1)            # acks = leader
+            + struct.pack(">i", int(self.timeout * 1000))
+            + struct.pack(">i", 1) + _kstr(self.topic)
+            + struct.pack(">i", 1) + struct.pack(">i", self.partition)
+            + struct.pack(">i", len(msgset)) + msgset
+        )
+        self._corr += 1
+        hdr = (struct.pack(">hh", 0, 2)     # api_key=Produce, version=2
+               + struct.pack(">i", self._corr) + _kstr("minio-tpu"))
+        sock.sendall(struct.pack(">i", len(hdr) + len(body)) + hdr + body)
+
+        rlen = struct.unpack(">i", _recv_exact(sock, 4))[0]
+        resp = _recv_exact(sock, rlen)
+        corr = struct.unpack(">i", resp[:4])[0]
+        if corr != self._corr:
+            raise TargetError(f"kafka correlation mismatch {corr}")
+        # response v2: [topic [partition err base_offset log_append_time]] throttle
+        off = 4
+        ntopics = struct.unpack(">i", resp[off:off + 4])[0]; off += 4
+        for _ in range(ntopics):
+            tlen = struct.unpack(">h", resp[off:off + 2])[0]; off += 2 + tlen
+            nparts = struct.unpack(">i", resp[off:off + 4])[0]; off += 4
+            for _ in range(nparts):
+                _, err = struct.unpack(">ih", resp[off:off + 6])
+                off += 4 + 2 + 8 + 8
+                if err != 0:
+                    raise TargetError(f"kafka produce error code {err}")
+
+
+# ---------------------------------------------------------------------- NATS
+
+
+class NATSTarget(_SocketTarget):
+    """NATS core text protocol in verbose mode (every PUB acknowledged
+    with +OK) — reference internal/event/target/nats.go:301."""
+
+    kind = "nats"
+
+    def __init__(self, target_name: str, host: str, port: int, subject: str,
+                 username: str = "", password: str = "", timeout: float = 5.0):
+        super().__init__(host, port, timeout)
+        self.name = target_name
+        self.subject = subject
+        self.username = username
+        self.password = password
+
+    def _line(self, sock: socket.socket) -> bytes:
+        line = b""
+        while not line.endswith(b"\r\n"):
+            c = sock.recv(1)
+            if not c:
+                raise TargetError("nats connection closed")
+            line += c
+        return line[:-2]
+
+    def _expect_ok(self, sock: socket.socket) -> None:
+        while True:
+            line = self._line(sock)
+            if line.startswith(b"PING"):
+                sock.sendall(b"PONG\r\n")
+                continue
+            if line.startswith(b"+OK"):
+                return
+            if line.startswith(b"-ERR"):
+                raise TargetError(f"nats: {line.decode()}")
+
+    def _handshake(self, sock: socket.socket) -> None:
+        info = self._line(sock)
+        if not info.startswith(b"INFO"):
+            raise TargetError("nats: no INFO banner")
+        opts = {"verbose": True, "pedantic": False, "name": f"minio-tpu-{self.name}"}
+        if self.username:
+            opts["user"] = self.username
+            opts["pass"] = self.password
+        sock.sendall(b"CONNECT " + json.dumps(opts).encode() + b"\r\n")
+        self._expect_ok(sock)
+
+    def _publish(self, sock: socket.socket, log: dict) -> None:
+        body = json.dumps(log).encode()
+        sock.sendall(b"PUB %s %d\r\n%s\r\n" % (
+            self.subject.encode(), len(body), body))
+        self._expect_ok(sock)

@@ -120,20 +120,91 @@ class WebhookTarget:
         pass
 
 
-def load_targets_from_env(environ=None) -> list[WebhookTarget]:
-    """MINIO_NOTIFY_WEBHOOK_ENABLE_<ID>=on +
-    MINIO_NOTIFY_WEBHOOK_ENDPOINT_<ID>=url [+ _AUTH_TOKEN_<ID>]
-    (reference internal/config/notify/parse.go webhook section)."""
+def _host_port(addr: str, default_port: int) -> tuple[str, int]:
+    """Parse "host:port", "tcp://host:port", "[v6]:port", bare "host" or
+    bare "v6"."""
+    if "://" in addr:
+        addr = addr.split("://", 1)[1]
+    if addr.startswith("["):  # bracketed IPv6
+        host, _, rest = addr[1:].partition("]")
+        if rest.startswith(":"):
+            return host, int(rest[1:])
+        return host, default_port
+    if addr.count(":") == 1:
+        h, p = addr.rsplit(":", 1)
+        return h, int(p)
+    return addr, default_port  # bare hostname or unbracketed IPv6
+
+
+def load_targets_from_env(environ=None) -> list:
+    """MINIO_NOTIFY_<KIND>_ENABLE_<ID>=on plus per-kind keys
+    (reference internal/config/notify/parse.go):
+
+      webhook: ENDPOINT [AUTH_TOKEN]
+      kafka:   BROKERS TOPIC
+      mqtt:    BROKER TOPIC [USERNAME PASSWORD QOS]
+      redis:   ADDRESS KEY [FORMAT PASSWORD]
+      nats:    ADDRESS SUBJECT [USERNAME PASSWORD]
+    """
+    from minio_tpu.events import brokers  # circular-safe: brokers imports us
+
     env = os.environ if environ is None else environ
-    targets: list[WebhookTarget] = []
-    prefix = "MINIO_NOTIFY_WEBHOOK_ENABLE_"
+    targets: list = []
     for k, v in env.items():
-        if not k.startswith(prefix) or v.lower() not in ("on", "true", "1"):
+        if not k.startswith("MINIO_NOTIFY_") or "_ENABLE_" not in k:
             continue
-        tid = k[len(prefix):]
-        endpoint = env.get(f"MINIO_NOTIFY_WEBHOOK_ENDPOINT_{tid}", "")
-        if not endpoint:
+        if v.lower() not in ("on", "true", "1"):
             continue
-        token = env.get(f"MINIO_NOTIFY_WEBHOOK_AUTH_TOKEN_{tid}", "")
-        targets.append(WebhookTarget(tid.lower(), endpoint, auth_token=token))
+        kind, tid = k[len("MINIO_NOTIFY_"):].split("_ENABLE_", 1)
+
+        def get(key: str, default: str = "") -> str:
+            return env.get(f"MINIO_NOTIFY_{kind}_{key}_{tid}", default)
+
+        name = tid.lower()
+        try:
+            _load_one(kind, name, get, targets)
+        except (ValueError, TypeError) as e:
+            # a typo'd port/qos must not abort server startup; skip the
+            # target and leave a trace (reference logs and continues)
+            import logging
+
+            logging.getLogger("minio_tpu.events").warning(
+                "skipping notify target %s:%s: %s", kind.lower(), name, e)
     return targets
+
+
+def _load_one(kind: str, name: str, get, targets: list) -> None:
+    from minio_tpu.events import brokers
+
+    if kind == "WEBHOOK":
+        endpoint = get("ENDPOINT")
+        if endpoint:
+            targets.append(WebhookTarget(
+                name, endpoint, auth_token=get("AUTH_TOKEN")))
+    elif kind == "KAFKA":
+        addr, topic = get("BROKERS"), get("TOPIC")
+        if addr and topic:
+            h, p = _host_port(addr.split(",")[0], 9092)
+            targets.append(brokers.KafkaTarget(name, h, p, topic))
+    elif kind == "MQTT":
+        addr, topic = get("BROKER"), get("TOPIC")
+        if addr and topic:
+            h, p = _host_port(addr, 1883)
+            targets.append(brokers.MQTTTarget(
+                name, h, p, topic, username=get("USERNAME"),
+                password=get("PASSWORD"),
+                qos=int(get("QOS", "1") or 1)))
+    elif kind == "REDIS":
+        addr, key = get("ADDRESS"), get("KEY")
+        if addr and key:
+            h, p = _host_port(addr, 6379)
+            targets.append(brokers.RedisTarget(
+                name, h, p, key, fmt=get("FORMAT", "access") or "access",
+                password=get("PASSWORD")))
+    elif kind == "NATS":
+        addr, subject = get("ADDRESS"), get("SUBJECT")
+        if addr and subject:
+            h, p = _host_port(addr, 4222)
+            targets.append(brokers.NATSTarget(
+                name, h, p, subject, username=get("USERNAME"),
+                password=get("PASSWORD")))

@@ -126,6 +126,9 @@ def run_select(req: SelectRequest, stream,
     if fast is not None:
         yield from fast
         return
+    # fallback: replay the probed prefix, then stream WITHOUT recording —
+    # the row engine must not accumulate the whole object in memory
+    rw.stop_recording()
     stream = rw
     reader = _make_input(req, stream)
 

@@ -78,11 +78,19 @@ class Rewindable:
         data = self.raw.read(n) or b""
         if self._recording and data:
             self._buf += data
+        elif not self._recording and self._buf and self._pos >= len(self._buf):
+            self._buf = bytearray()  # replay done: free the prefix
+            self._pos = 0
         self._pos += len(data)
         return out + data
 
     def rewind(self) -> None:
         self._pos = 0
+
+    def stop_recording(self) -> None:
+        """Keep the already-buffered prefix for replay but stop growing
+        it — the row-engine fallback must not retain the whole object."""
+        self._recording = False
 
     def commit(self) -> None:
         # drop history already consumed; stop recording new reads
@@ -153,8 +161,22 @@ def _where_ok(e) -> bool:
         if e.op in ("and", "or"):
             return _where_ok(e.l) and _where_ok(e.r)
         if e.op in ("=", "==", "!=", "<>", "<", "<=", ">", ">="):
-            return (isinstance(e.l, Col) and isinstance(e.r, Lit)) or (
-                isinstance(e.l, Lit) and isinstance(e.r, Col))
+            if isinstance(e.l, Col) and isinstance(e.r, Lit):
+                lit = e.r
+            elif isinstance(e.l, Lit) and isinstance(e.r, Col):
+                lit = e.l
+            else:
+                return False
+            # NULL literals: the row engine's comparisons against NULL are
+            # always false; stay on it rather than comparing "None" text.
+            # Int literals past 2^53 lose precision in the float64 arrow
+            # compare while the row engine compares exact ints.
+            v = lit.v
+            if v is None:
+                return False
+            if isinstance(v, int) and not isinstance(v, bool) and abs(v) >= 2**53:
+                return False
+            return True
     return False
 
 
@@ -346,7 +368,10 @@ def try_columnar(req, query: Query, rw: Rewindable, object_size: int,
             ),
             parse_options=parse_opts,
         )
-        names = [f.name for f in sniff.schema]
+        # CSVInput strips header whitespace (records.py header row); the
+        # columnar output keys must match byte-for-byte
+        names = [f.name.strip() if header == "USE" else f.name
+                 for f in sniff.schema]
         del sniff
     except (pa.ArrowInvalid, pa.ArrowKeyError, StopIteration, OSError):
         stats["fallback"] += 1

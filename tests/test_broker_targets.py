@@ -1,0 +1,453 @@
+"""Broker notification targets (Kafka/MQTT/Redis/NATS): wire-protocol
+delivery against in-process fake brokers, offline-queue replay through
+the notifier's persistent store.
+
+Reference behaviours: internal/event/target/kafka.go, mqtt.go, redis.go,
+nats.go (each Send wrapped by the store-and-forward retry machinery).
+"""
+
+import io
+import json
+import socket
+import socketserver
+import struct
+import threading
+import time
+
+import pytest
+
+from minio_tpu.events.brokers import (KafkaTarget, MQTTTarget, NATSTarget,
+                                      RedisTarget)
+from minio_tpu.events.targets import TargetError, load_targets_from_env
+
+from .s3_harness import S3TestServer
+
+
+class _FakeBroker:
+    """TCP server harness: one handler function per connection."""
+
+    def __init__(self, handler):
+        outer = self
+
+        class H(socketserver.BaseRequestHandler):
+            def handle(self):
+                outer.conns.append(self.request)
+                handler(outer, self.request)
+
+        self.conns: list[socket.socket] = []
+        self.received: list[bytes] = []
+        self.srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), H)
+        self.srv.daemon_threads = True
+        self.port = self.srv.server_address[1]
+        threading.Thread(target=self.srv.serve_forever, daemon=True).start()
+
+    def wait(self, n: int, timeout: float = 5.0):
+        deadline = time.time() + timeout
+        while len(self.received) < n and time.time() < deadline:
+            time.sleep(0.02)
+        assert len(self.received) >= n, f"broker got {len(self.received)}/{n}"
+
+    def close(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+        for c in self.conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+                c.close()
+            except OSError:
+                pass
+
+
+def _read_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        c = sock.recv(n - len(buf))
+        if not c:
+            raise ConnectionError("eof")
+        buf += c
+    return buf
+
+
+# ----------------------------------------------------------------------- MQTT
+def _mqtt_broker(broker, sock):
+    def read_packet():
+        hdr = _read_exact(sock, 1)
+        mul, rl = 1, 0
+        while True:
+            b = _read_exact(sock, 1)[0]
+            rl += (b & 0x7F) * mul
+            mul *= 128
+            if not b & 0x80:
+                break
+        return hdr[0], _read_exact(sock, rl) if rl else b""
+
+    typ, _ = read_packet()
+    assert typ >> 4 == 1  # CONNECT
+    sock.sendall(bytes([0x20, 0x02, 0x00, 0x00]))  # CONNACK accepted
+    try:
+        while True:
+            typ, body = read_packet()
+            if typ >> 4 == 3:  # PUBLISH
+                tlen = struct.unpack(">H", body[:2])[0]
+                off = 2 + tlen
+                qos = (typ >> 1) & 3
+                if qos:
+                    pkt_id = struct.unpack(">H", body[off:off + 2])[0]
+                    off += 2
+                    sock.sendall(bytes([0x40, 0x02]) + struct.pack(">H", pkt_id))
+                broker.received.append(body[off:])
+            elif typ >> 4 == 14:  # DISCONNECT
+                return
+    except (ConnectionError, OSError):
+        return
+
+
+class TestMQTT:
+    def test_qos1_publish(self):
+        broker = _FakeBroker(_mqtt_broker)
+        try:
+            t = MQTTTarget("m1", "127.0.0.1", broker.port, "minio/events")
+            t.send({"EventName": "s3:ObjectCreated:Put", "Key": "b/k"})
+            t.send({"EventName": "s3:ObjectCreated:Put", "Key": "b/k2"})
+            broker.wait(2)
+            assert json.loads(broker.received[0])["Key"] == "b/k"
+            t.close()
+        finally:
+            broker.close()
+
+    def test_offline_raises(self):
+        # grab a free port with nothing listening on it
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        t = MQTTTarget("m1", "127.0.0.1", port, "t", timeout=0.3)
+        with pytest.raises(TargetError):
+            t.send({"Key": "x"})
+
+    def test_reconnect_after_broker_restart(self):
+        broker = _FakeBroker(_mqtt_broker)
+        t = MQTTTarget("m1", "127.0.0.1", broker.port, "t")
+        t.send({"Key": "1"})
+        broker.close()
+        with pytest.raises(TargetError):
+            t.send({"Key": "2"})  # drops the dead connection
+        broker2 = _FakeBroker(_mqtt_broker)
+        try:
+            t2 = MQTTTarget("m1", "127.0.0.1", broker2.port, "t")
+            t2.send({"Key": "3"})
+            broker2.wait(1)
+        finally:
+            broker2.close()
+
+
+# ---------------------------------------------------------------------- Redis
+def _redis_broker(broker, sock):
+    f = sock.makefile("rb")
+
+    def read_cmd():
+        line = f.readline()
+        if not line or not line.startswith(b"*"):
+            return None
+        nargs = int(line[1:])
+        args = []
+        for _ in range(nargs):
+            ln = int(f.readline()[1:])
+            args.append(f.read(ln))
+            f.read(2)
+        return args
+
+    try:
+        while True:
+            cmd = read_cmd()
+            if cmd is None:
+                return
+            name = cmd[0].upper()
+            if name == b"PING":
+                sock.sendall(b"+PONG\r\n")
+            elif name == b"AUTH":
+                ok = cmd[1] == b"sekrit"
+                sock.sendall(b"+OK\r\n" if ok else b"-ERR invalid password\r\n")
+            elif name in (b"HSET", b"RPUSH"):
+                broker.received.append(b" ".join(cmd))
+                sock.sendall(b":1\r\n")
+            else:
+                sock.sendall(b"-ERR unknown\r\n")
+    except (ConnectionError, OSError):
+        return
+
+
+class TestRedis:
+    def test_access_format_rpush(self):
+        broker = _FakeBroker(_redis_broker)
+        try:
+            t = RedisTarget("r1", "127.0.0.1", broker.port, "minioevents",
+                            fmt="access")
+            t.send({"EventName": "s3:ObjectCreated:Put", "Key": "b/k"})
+            broker.wait(1)
+            cmd = broker.received[0]
+            assert cmd.startswith(b"RPUSH minioevents ")
+            t.close()
+        finally:
+            broker.close()
+
+    def test_namespace_format_hset(self):
+        broker = _FakeBroker(_redis_broker)
+        try:
+            t = RedisTarget("r1", "127.0.0.1", broker.port, "ns",
+                            fmt="namespace")
+            t.send({"Key": "b/obj.txt"})
+            broker.wait(1)
+            assert broker.received[0].startswith(b"HSET ns b/obj.txt ")
+        finally:
+            broker.close()
+
+    def test_auth(self):
+        broker = _FakeBroker(_redis_broker)
+        try:
+            ok = RedisTarget("r", "127.0.0.1", broker.port, "k",
+                             password="sekrit")
+            ok.send({"Key": "x"})
+            broker.wait(1)
+            bad = RedisTarget("r", "127.0.0.1", broker.port, "k",
+                              password="wrong")
+            with pytest.raises(TargetError):
+                bad.send({"Key": "y"})
+        finally:
+            broker.close()
+
+
+# ---------------------------------------------------------------------- Kafka
+def _kafka_broker(broker, sock):
+    try:
+        while True:
+            rlen = struct.unpack(">i", _read_exact(sock, 4))[0]
+            req = _read_exact(sock, rlen)
+            api_key, api_ver, corr = struct.unpack(">hhi", req[:8])
+            assert api_key == 0 and api_ver == 2
+            off = 8
+            cid_len = struct.unpack(">h", req[off:off + 2])[0]
+            off += 2 + cid_len
+            off += 2 + 4  # acks, timeout
+            off += 4      # topic array len (=1)
+            tlen = struct.unpack(">h", req[off:off + 2])[0]
+            topic = req[off + 2:off + 2 + tlen].decode()
+            off += 2 + tlen
+            off += 4      # partition array len (=1)
+            partition = struct.unpack(">i", req[off:off + 4])[0]
+            off += 4
+            mslen = struct.unpack(">i", req[off:off + 4])[0]
+            msgset = req[off + 4:off + 4 + mslen]
+            # messageset v1: offset(8) size(4) crc(4) magic(1) attrs(1) ts(8) key value
+            p = 8 + 4 + 4
+            assert msgset[p] == 1  # magic v1
+            p += 1 + 1 + 8
+            klen = struct.unpack(">i", msgset[p:p + 4])[0]
+            p += 4 + max(klen, 0)
+            vlen = struct.unpack(">i", msgset[p:p + 4])[0]
+            value = msgset[p + 4:p + 4 + vlen]
+            broker.received.append(value)
+            # produce response v2
+            body = (struct.pack(">i", 1) + struct.pack(">h", tlen) +
+                    topic.encode() + struct.pack(">i", 1) +
+                    struct.pack(">ihqq", partition, 0, 0, -1) +
+                    struct.pack(">i", 0))
+            resp = struct.pack(">i", corr) + body
+            sock.sendall(struct.pack(">i", len(resp)) + resp)
+    except (ConnectionError, OSError, AssertionError):
+        return
+
+
+class TestKafka:
+    def test_produce(self):
+        broker = _FakeBroker(_kafka_broker)
+        try:
+            t = KafkaTarget("k1", "127.0.0.1", broker.port, "minio-events")
+            t.send({"EventName": "s3:ObjectCreated:Put", "Key": "b/k"})
+            broker.wait(1)
+            assert json.loads(broker.received[0])["Key"] == "b/k"
+            t.close()
+        finally:
+            broker.close()
+
+    def test_error_code_raises(self):
+        def bad_broker(broker, sock):
+            try:
+                rlen = struct.unpack(">i", _read_exact(sock, 4))[0]
+                req = _read_exact(sock, rlen)
+                corr = struct.unpack(">i", req[4:8])[0]
+                body = (struct.pack(">i", 1) + struct.pack(">h", 1) + b"t" +
+                        struct.pack(">i", 1) +
+                        struct.pack(">ihqq", 0, 3, 0, -1) +  # err 3
+                        struct.pack(">i", 0))
+                resp = struct.pack(">i", corr) + body
+                sock.sendall(struct.pack(">i", len(resp)) + resp)
+            except (ConnectionError, OSError):
+                return
+
+        broker = _FakeBroker(bad_broker)
+        try:
+            t = KafkaTarget("k1", "127.0.0.1", broker.port, "t")
+            with pytest.raises(TargetError, match="error code 3"):
+                t.send({"Key": "x"})
+        finally:
+            broker.close()
+
+
+# ----------------------------------------------------------------------- NATS
+def _nats_broker(broker, sock):
+    sock.sendall(b'INFO {"server_id":"fake"}\r\n')
+    f = sock.makefile("rb")
+    try:
+        while True:
+            line = f.readline()
+            if not line:
+                return
+            if line.startswith(b"CONNECT"):
+                sock.sendall(b"+OK\r\n")
+            elif line.startswith(b"PUB"):
+                _, subject, nbytes = line.split()
+                payload = f.read(int(nbytes))
+                f.read(2)
+                broker.received.append(subject + b" " + payload)
+                sock.sendall(b"+OK\r\n")
+            elif line.startswith(b"PING"):
+                sock.sendall(b"PONG\r\n")
+    except (ConnectionError, OSError):
+        return
+
+
+class TestNATS:
+    def test_publish(self):
+        broker = _FakeBroker(_nats_broker)
+        try:
+            t = NATSTarget("n1", "127.0.0.1", broker.port, "minio.events")
+            t.send({"EventName": "s3:ObjectCreated:Put", "Key": "b/k"})
+            broker.wait(1)
+            subject, payload = broker.received[0].split(b" ", 1)
+            assert subject == b"minio.events"
+            assert json.loads(payload)["Key"] == "b/k"
+        finally:
+            broker.close()
+
+
+# ---------------------------------------------------- end-to-end + env config
+class TestEndToEnd:
+    def test_put_event_through_kafka_with_offline_replay(self, tmp_path):
+        """s3:ObjectCreated:Put flows PUT -> notifier -> queue store ->
+        Kafka; a PUT issued while the broker is down is held in the
+        persistent queue and replayed when the broker comes back
+        (VERDICT r2 #5 done-condition)."""
+        srv = S3TestServer(str(tmp_path / "drives"))
+        try:
+            # no broker yet: reserve a port with nothing listening
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            t = KafkaTarget("k1", "127.0.0.1", port, "evts", timeout=0.3)
+            srv.server.notifier.register(t)
+            arn = t.arn("us-east-1")
+            assert srv.request("PUT", "/ebk").status == 200
+            cfg = (f"<NotificationConfiguration><QueueConfiguration>"
+                   f"<Id>c</Id><Queue>{arn}</Queue>"
+                   f"<Event>s3:ObjectCreated:*</Event>"
+                   f"</QueueConfiguration></NotificationConfiguration>")
+            assert srv.request("PUT", "/ebk", query=[("notification", "")],
+                               data=cfg.encode()).status == 200
+            assert srv.request("PUT", "/ebk/hello", data=b"hi").status == 200
+
+            # event persisted while offline
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if srv.server.notifier.pending().get("k1:kafka"):
+                    break
+                time.sleep(0.02)
+            assert srv.server.notifier.pending().get("k1:kafka") == 1
+
+            # bring the broker up on that port: the store worker replays
+            class H(socketserver.BaseRequestHandler):
+                def handle(self):
+                    _kafka_broker(broker, self.request)
+
+            broker = _FakeBroker(lambda b, s2: _kafka_broker(b, s2))
+            broker.srv.shutdown()
+            broker.srv.server_close()
+            broker.srv = socketserver.ThreadingTCPServer(("127.0.0.1", port), H)
+            broker.srv.daemon_threads = True
+            threading.Thread(target=broker.srv.serve_forever,
+                             daemon=True).start()
+            try:
+                broker.wait(1, timeout=10)
+                log = json.loads(broker.received[0])
+                assert log["EventName"] == "s3:ObjectCreated:Put"
+                assert log["Key"] == "ebk/hello"
+                deadline = time.time() + 5
+                while time.time() < deadline:
+                    if not srv.server.notifier.pending().get("k1:kafka"):
+                        break
+                    time.sleep(0.02)
+                assert srv.server.notifier.pending().get("k1:kafka") == 0
+            finally:
+                broker.close()
+        finally:
+            srv.close()
+
+    def test_env_loading_all_kinds(self):
+        env = {
+            "MINIO_NOTIFY_WEBHOOK_ENABLE_W": "on",
+            "MINIO_NOTIFY_WEBHOOK_ENDPOINT_W": "http://h/x",
+            "MINIO_NOTIFY_KAFKA_ENABLE_K": "on",
+            "MINIO_NOTIFY_KAFKA_BROKERS_K": "10.0.0.1:9092",
+            "MINIO_NOTIFY_KAFKA_TOPIC_K": "tp",
+            "MINIO_NOTIFY_MQTT_ENABLE_M": "on",
+            "MINIO_NOTIFY_MQTT_BROKER_M": "tcp://10.0.0.2:1883",
+            "MINIO_NOTIFY_MQTT_TOPIC_M": "mt",
+            "MINIO_NOTIFY_REDIS_ENABLE_R": "on",
+            "MINIO_NOTIFY_REDIS_ADDRESS_R": "10.0.0.3:6379",
+            "MINIO_NOTIFY_REDIS_KEY_R": "rk",
+            "MINIO_NOTIFY_REDIS_FORMAT_R": "namespace",
+            "MINIO_NOTIFY_NATS_ENABLE_N": "on",
+            "MINIO_NOTIFY_NATS_ADDRESS_N": "10.0.0.4:4222",
+            "MINIO_NOTIFY_NATS_SUBJECT_N": "sub",
+            "MINIO_NOTIFY_KAFKA_ENABLE_OFF": "off",
+            "MINIO_NOTIFY_KAFKA_BROKERS_OFF": "10.9.9.9:9092",
+        }
+        targets = load_targets_from_env(env)
+        ids = {t.target_id for t in targets}
+        assert ids == {"w:webhook", "k:kafka", "m:mqtt", "r:redis", "n:nats"}
+        kafka = next(t for t in targets if t.kind == "kafka")
+        assert (kafka.host, kafka.port, kafka.topic) == ("10.0.0.1", 9092, "tp")
+        mqtt = next(t for t in targets if t.kind == "mqtt")
+        assert (mqtt.host, mqtt.port, mqtt.topic) == ("10.0.0.2", 1883, "mt")
+        redis = next(t for t in targets if t.kind == "redis")
+        assert redis.fmt == "namespace"
+
+
+class TestEnvRobustness:
+    """Review findings: malformed env values and IPv6 addresses must not
+    crash target loading."""
+
+    def test_bad_numbers_are_skipped_not_fatal(self):
+        env = {
+            "MINIO_NOTIFY_MQTT_ENABLE_A": "on",
+            "MINIO_NOTIFY_MQTT_BROKER_A": "h:1883",
+            "MINIO_NOTIFY_MQTT_TOPIC_A": "t",
+            "MINIO_NOTIFY_MQTT_QOS_A": "auto",          # bad int
+            "MINIO_NOTIFY_REDIS_ENABLE_B": "on",
+            "MINIO_NOTIFY_REDIS_ADDRESS_B": "h:notaport",  # bad port
+            "MINIO_NOTIFY_REDIS_KEY_B": "k",
+            "MINIO_NOTIFY_WEBHOOK_ENABLE_C": "on",
+            "MINIO_NOTIFY_WEBHOOK_ENDPOINT_C": "http://ok/x",
+        }
+        targets = load_targets_from_env(env)
+        assert {t.target_id for t in targets} == {"c:webhook"}
+
+    def test_ipv6_addresses(self):
+        from minio_tpu.events.targets import _host_port
+        assert _host_port("[::1]:6379", 1) == ("::1", 6379)
+        assert _host_port("[fe80::2]", 9092) == ("fe80::2", 9092)
+        assert _host_port("::1", 6379) == ("::1", 6379)
+        assert _host_port("tcp://[::1]:1883", 1) == ("::1", 1883)
+        assert _host_port("host.example", 4222) == ("host.example", 4222)
+        assert _host_port("host:99", 1) == ("host", 99)

@@ -494,3 +494,63 @@ class TestColumnarFastPath:
         fast = self._run(expr, body=body, columnar=True)
         slow = self._run(expr, body=body, columnar=False)
         assert fast == slow
+
+
+class TestColumnarReviewFindings:
+    """Regression tests for the r3 code-review findings on the columnar
+    fast path: fallback memory retention, NULL literals, big-int
+    precision, header whitespace."""
+
+    def _run(self, sql, csv, out_ser=None):
+        import io as iomod
+
+        from minio_tpu import select as sel
+        req = sel.SelectRequest(sql, {"CSV": {}}, out_ser or {"CSV": {}})
+        return b"".join(sel.run_select(req, iomod.BytesIO(csv), len(csv)))
+
+    def test_fallback_does_not_buffer_whole_object(self):
+        import io as iomod
+
+        from minio_tpu import select as sel
+        from minio_tpu.select import columnar
+        csv = b"a,b\n" + b"\n".join(b"x%d,%d" % (i, i) for i in range(200000))
+        req = sel.SelectRequest(
+            "SELECT * FROM s3object WHERE a LIKE 'x1%'",  # ineligible
+            {"CSV": {}}, {"CSV": {}})
+        rw_holder = {}
+        orig = columnar.Rewindable
+
+        class Spy(orig):
+            def __init__(self, raw):
+                super().__init__(raw)
+                rw_holder["rw"] = self
+
+        columnar.Rewindable = Spy
+        try:
+            out = b"".join(sel.run_select(req, iomod.BytesIO(csv), len(csv)))
+        finally:
+            columnar.Rewindable = orig
+        assert out
+        # recording stopped and replayed prefix freed: far below object size
+        assert len(rw_holder["rw"]._buf) < len(csv) // 10
+
+    def test_null_literal_falls_back_to_row_semantics(self):
+        csv = b"a,b\n1,2\nNone,4\n"
+        out = self._run("SELECT COUNT(*) FROM s3object WHERE b != NULL", csv)
+        # row engine: comparisons against NULL are always false -> count 0
+        assert b"octet-stream0\n" in out
+
+    def test_bigint_equality_is_exact(self):
+        big = 2**53 + 1
+        csv = ("a\n%d\n%d\n" % (big, big - 1)).encode()
+        out = self._run(f"SELECT COUNT(*) FROM s3object WHERE a = {big - 1}",
+                        csv)
+        # float64 would round both cells to 2^53 and match 2; exact = 1
+        assert b"octet-stream1\n" in out
+
+    def test_select_star_json_strips_header_whitespace(self):
+        csv = b"a , b\n1,2\n"
+        out = self._run("SELECT * FROM s3object", csv,
+                        out_ser={"JSON": {}})
+        assert b'"a"' in out and b'"b"' in out
+        assert b'"a "' not in out and b'" b"' not in out
