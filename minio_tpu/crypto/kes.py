@@ -1,0 +1,103 @@
+"""External KMS client, KES-shaped (reference internal/kms/kes.go:54 —
+MinIO's KES client: per-object data keys generated and unsealed by an
+external key server over HTTPS, master keys never leave it).
+
+API surface (KES REST, api key auth):
+  POST /v1/key/create/<name>               -> 200
+  POST /v1/key/generate/<name> {context}   -> {plaintext, ciphertext}
+  POST /v1/key/decrypt/<name>  {ciphertext, context} -> {plaintext}
+
+The sealed blob this client hands to the SSE layer is a self-describing
+JSON envelope `{"key": <name>, "ct": <b64>}` so decryption keeps working
+after the default key is rotated to a new name: old objects unseal with
+the key recorded in their envelope, new writes seal under the current
+default (reference KMS key-rotation semantics, internal/kms/kms.go).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import urllib.error
+import urllib.request
+
+from .kms import KMSError
+
+
+class KESClient:
+    """Same interface the SSE layer uses for LocalKMS
+    (crypto/sse.py:176 new_encryption_meta / :205 recover_object_key):
+    generate_key/decrypt_key/key_id."""
+
+    def __init__(self, endpoint: str, key_name: str, api_key: str = "",
+                 timeout: float = 5.0):
+        self.endpoint = endpoint.rstrip("/")
+        self._default = key_name
+        self.api_key = api_key
+        self.timeout = timeout
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- transport
+    def _post(self, path: str, body: dict | None) -> bytes:
+        req = urllib.request.Request(
+            self.endpoint + path,
+            data=json.dumps(body).encode() if body is not None else b"",
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        if self.api_key:
+            req.add_header("Authorization", f"Bearer {self.api_key}")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode("utf-8", "replace")[:200]
+            raise KMSError(f"KES {path}: HTTP {e.code} {detail}")
+        except Exception as e:
+            raise KMSError(f"KES {path}: {e}") from e
+
+    # -------------------------------------------------------------- key mgmt
+    @property
+    def key_id(self) -> str:
+        with self._lock:
+            return self._default
+
+    def create_key(self, name: str) -> None:
+        self._post(f"/v1/key/create/{name}", None)
+
+    def rotate(self, new_name: str) -> None:
+        """Create `new_name` on the KES server and make it the default for
+        new writes; existing envelopes keep decrypting under their
+        recorded key."""
+        self.create_key(new_name)
+        with self._lock:
+            self._default = new_name
+
+    # ---------------------------------------------------- SSE-facing surface
+    def generate_key(self, context: str) -> tuple[bytes, bytes]:
+        """(plaintext 256-bit data key, sealed envelope)."""
+        name = self.key_id
+        out = json.loads(self._post(
+            f"/v1/key/generate/{name}",
+            {"context": base64.b64encode(context.encode()).decode()},
+        ))
+        plaintext = base64.b64decode(out["plaintext"])
+        envelope = json.dumps({"key": name, "ct": out["ciphertext"]}).encode()
+        return plaintext, envelope
+
+    def decrypt_key(self, sealed: bytes, context: str) -> bytes:
+        try:
+            env = json.loads(sealed)
+            name, ct = env["key"], env["ct"]
+        except (ValueError, KeyError, TypeError):
+            raise KMSError("malformed KES key envelope")
+        out = json.loads(self._post(
+            f"/v1/key/decrypt/{name}",
+            {"ciphertext": ct,
+             "context": base64.b64encode(context.encode()).decode()},
+        ))
+        return base64.b64decode(out["plaintext"])
+
+    def fingerprint(self) -> str:
+        return f"kes:{self.endpoint}:{self.key_id}"

@@ -18,6 +18,7 @@ import json
 import socket
 import struct
 import threading
+import time
 import zlib
 
 from .targets import TargetError
@@ -145,7 +146,10 @@ class MQTTTarget(_SocketTarget):
             if self.password:
                 flags |= 0x40
                 payload += _mqtt_str(self.password)
-        var = _mqtt_str("MQTT") + bytes([0x04, flags]) + struct.pack(">H", 60)
+        # keep-alive 0 (disabled): this client sends no PINGREQ, and a
+        # nonzero advert would let conforming brokers drop idle
+        # connections at 1.5x the interval [MQTT-3.1.2-24]
+        var = _mqtt_str("MQTT") + bytes([0x04, flags]) + struct.pack(">H", 0)
         pkt = bytes([0x10]) + _mqtt_varint(len(var) + len(payload)) + var + payload
         sock.sendall(pkt)
         hdr = _recv_exact(sock, 4)  # CONNACK is always 4 bytes
@@ -259,7 +263,7 @@ class KafkaTarget(_SocketTarget):
         value = json.dumps(log).encode()
         key = log.get("Key", "").encode() or None
         # message v1: crc | magic=1 | attrs=0 | timestamp | key | value
-        ts = int(log.get("_ts_ms", 0))
+        ts = int(time.time() * 1000)
         tail = bytes([1, 0]) + struct.pack(">q", ts) + _kbytes(key) + _kbytes(value)
         msg = struct.pack(">I", zlib.crc32(tail)) + tail
         msgset = struct.pack(">q", 0) + struct.pack(">i", len(msg)) + msg

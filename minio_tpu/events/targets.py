@@ -146,8 +146,6 @@ def load_targets_from_env(environ=None) -> list:
       redis:   ADDRESS KEY [FORMAT PASSWORD]
       nats:    ADDRESS SUBJECT [USERNAME PASSWORD]
     """
-    from minio_tpu.events import brokers  # circular-safe: brokers imports us
-
     env = os.environ if environ is None else environ
     targets: list = []
     for k, v in env.items():

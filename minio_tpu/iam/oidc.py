@@ -1,0 +1,157 @@
+"""OpenID Connect provider for STS web-identity federation.
+
+Validates RS256-signed JWTs against the IdP's JWKS and maps the token's
+policy claim to IAM policies (reference cmd/sts-handlers.go
+AssumeRoleWithWebIdentity + internal/config/identity/openid: JWKS
+validation, azp/aud check, `policy` claim lookup).
+
+Config (env, reference MINIO_IDENTITY_OPENID_*):
+  MINIO_IDENTITY_OPENID_JWKS_URL    JWKS document URL (required)
+  MINIO_IDENTITY_OPENID_CLIENT_ID   expected aud/azp (optional)
+  MINIO_IDENTITY_OPENID_ISSUER      expected iss (optional)
+  MINIO_IDENTITY_OPENID_CLAIM_NAME  policy claim (default "policy")
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import threading
+import time
+import urllib.request
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
+
+class OIDCError(Exception):
+    pass
+
+
+def _b64url(data: str | bytes) -> bytes:
+    if isinstance(data, str):
+        data = data.encode()
+    return base64.urlsafe_b64decode(data + b"=" * (-len(data) % 4))
+
+
+_HASHES = {"RS256": hashes.SHA256, "RS384": hashes.SHA384,
+           "RS512": hashes.SHA512}
+
+
+class OpenIDProvider:
+    """JWKS-backed JWT validator + claim->policy mapper."""
+
+    def __init__(self, jwks_url: str, client_id: str = "",
+                 issuer: str = "", claim_name: str = "policy",
+                 jwks_ttl: float = 300.0, timeout: float = 5.0):
+        self.jwks_url = jwks_url
+        self.client_id = client_id
+        self.issuer = issuer
+        self.claim_name = claim_name or "policy"
+        self.jwks_ttl = jwks_ttl
+        self.timeout = timeout
+        self._keys: dict[str, rsa.RSAPublicKey] = {}
+        self._fetched = 0.0
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_env(cls, environ=None) -> "OpenIDProvider | None":
+        env = os.environ if environ is None else environ
+        url = env.get("MINIO_IDENTITY_OPENID_JWKS_URL", "")
+        if not url:
+            return None
+        return cls(
+            url,
+            client_id=env.get("MINIO_IDENTITY_OPENID_CLIENT_ID", ""),
+            issuer=env.get("MINIO_IDENTITY_OPENID_ISSUER", ""),
+            claim_name=env.get("MINIO_IDENTITY_OPENID_CLAIM_NAME", "policy"),
+        )
+
+    # ----------------------------------------------------------------- JWKS
+    def _fetch_jwks(self) -> None:
+        with urllib.request.urlopen(self.jwks_url,
+                                    timeout=self.timeout) as resp:
+            doc = json.loads(resp.read())
+        keys: dict[str, rsa.RSAPublicKey] = {}
+        for jwk in doc.get("keys", []):
+            if jwk.get("kty") != "RSA":
+                continue
+            try:
+                n = int.from_bytes(_b64url(jwk["n"]), "big")
+                e = int.from_bytes(_b64url(jwk["e"]), "big")
+            except (KeyError, ValueError):
+                continue
+            keys[jwk.get("kid", "")] = rsa.RSAPublicNumbers(
+                e, n).public_key()
+        self._keys = keys
+        self._fetched = time.monotonic()
+
+    def _key_for(self, kid: str) -> rsa.RSAPublicKey:
+        with self._lock:
+            stale = time.monotonic() - self._fetched > self.jwks_ttl
+            if stale or (kid not in self._keys and
+                         time.monotonic() - self._fetched > 1.0):
+                # refresh on expiry, and on unknown kid (rotation) with a
+                # 1 s floor so bad tokens can't hammer the IdP
+                try:
+                    self._fetch_jwks()
+                except Exception as e:
+                    if not self._keys:
+                        raise OIDCError(f"JWKS fetch failed: {e}")
+            key = self._keys.get(kid)
+            if key is None and len(self._keys) == 1 and not kid:
+                key = next(iter(self._keys.values()))
+            if key is None:
+                raise OIDCError(f"no JWKS key for kid {kid!r}")
+            return key
+
+    # ------------------------------------------------------------ validation
+    def validate(self, token: str) -> dict:
+        """Verify signature + standard claims; return the claim set."""
+        try:
+            hdr_b64, claims_b64, sig_b64 = token.split(".")
+            header = json.loads(_b64url(hdr_b64))
+            claims = json.loads(_b64url(claims_b64))
+            sig = _b64url(sig_b64)
+        except (ValueError, TypeError):
+            raise OIDCError("malformed JWT")
+        alg = header.get("alg", "")
+        hash_cls = _HASHES.get(alg)
+        if hash_cls is None:
+            raise OIDCError(f"unsupported JWT alg {alg!r}")
+        key = self._key_for(header.get("kid", ""))
+        try:
+            key.verify(sig, f"{hdr_b64}.{claims_b64}".encode(),
+                       padding.PKCS1v15(), hash_cls())
+        except InvalidSignature:
+            raise OIDCError("JWT signature verification failed")
+        now = time.time()
+        exp = claims.get("exp")
+        if not isinstance(exp, (int, float)) or now > exp:
+            raise OIDCError("token expired or missing exp")
+        nbf = claims.get("nbf")
+        if isinstance(nbf, (int, float)) and now < nbf - 60:
+            raise OIDCError("token not yet valid")
+        if self.issuer and claims.get("iss") != self.issuer:
+            raise OIDCError("issuer mismatch")
+        if self.client_id:
+            aud = claims.get("aud")
+            auds = aud if isinstance(aud, list) else [aud]
+            if self.client_id not in auds and \
+                    claims.get("azp") != self.client_id:
+                raise OIDCError("audience mismatch")
+        return claims
+
+    def policies_for(self, claims: dict) -> list[str]:
+        """The policy claim, as a list (comma-separated string or JSON
+        array accepted — reference GetClaimValue policy parsing)."""
+        v = claims.get(self.claim_name)
+        if v is None:
+            return []
+        if isinstance(v, str):
+            return [p.strip() for p in v.split(",") if p.strip()]
+        if isinstance(v, list):
+            return [str(p).strip() for p in v if str(p).strip()]
+        return []

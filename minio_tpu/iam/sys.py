@@ -393,6 +393,35 @@ class IAMSys:
         self._notify("user", ak)
         return ident
 
+    def assume_role_web_identity(self, subject: str, policies: list[str],
+                                 duration: int = 3600,
+                                 session_policy: str = "") -> Identity:
+        """Temporary credentials for a validated OIDC identity: the named
+        policies (from the token's policy claim) attach directly — there
+        is no parent user (reference AssumeRoleWithWebIdentity,
+        cmd/sts-handlers.go)."""
+        with self._mu:
+            missing = [p for p in policies if p not in self.policies]
+            if missing:
+                raise IAMError(f"policy not found: {', '.join(missing)}")
+            if not policies:
+                raise IAMError("web identity token maps to no policies")
+            # no 900 s floor here: the caller caps duration by the JWT's
+            # remaining lifetime, which may legitimately be shorter
+            duration = max(1, min(duration, 7 * 24 * 3600))
+            ak = "STS" + pysecrets.token_hex(8).upper()
+            sk = base64.urlsafe_b64encode(pysecrets.token_bytes(24)).decode()
+            expiry = time.time() + duration
+            token = self._session_token(ak, f"oidc:{subject}", expiry)
+            ident = Identity(ak, sk, kind="sts", parent="",
+                             policies=list(policies),
+                             session_policy=session_policy,
+                             session_token=token, expiry=expiry)
+            self.users[ak] = ident
+            self._save_user(ident)
+        self._notify("user", ak)
+        return ident
+
     def _session_token(self, ak: str, parent: str, expiry: float) -> str:
         claims = json.dumps({"ak": ak, "parent": parent, "exp": expiry})
         mac = hmac.new(self.root.secret_key.encode(), claims.encode(),
@@ -445,7 +474,12 @@ class IAMSys:
                               conditions=conditions or {})
             if ident.kind in ("svc", "sts"):
                 # inherit the parent's permission set
-                if ident.parent == self.root.access_key:
+                if not ident.parent:
+                    # web-identity STS: no parent user — the policies named
+                    # by the token's claim are attached directly (reference
+                    # OIDC claim -> policy mapping, cmd/sts-handlers.go)
+                    base = self._effective_policy(ident).evaluate(args)
+                elif ident.parent == self.root.access_key:
                     base = "allow"
                 else:
                     parent = self._lookup(ident.parent)

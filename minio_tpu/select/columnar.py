@@ -368,10 +368,12 @@ def try_columnar(req, query: Query, rw: Rewindable, object_size: int,
             ),
             parse_options=parse_opts,
         )
-        # CSVInput strips header whitespace (records.py header row); the
-        # columnar output keys must match byte-for-byte
-        names = [f.name.strip() if header == "USE" else f.name
-                 for f in sniff.schema]
+        # raw_names key pyarrow options (they must match the file bytes);
+        # `names` are the query/output-facing forms — CSVInput strips
+        # header whitespace (records.py header row) so output keys and
+        # column resolution must use the stripped spelling
+        raw_names = [f.name for f in sniff.schema]
+        names = [n.strip() if header == "USE" else n for n in raw_names]
         del sniff
     except (pa.ArrowInvalid, pa.ArrowKeyError, StopIteration, OSError):
         stats["fallback"] += 1
@@ -416,7 +418,7 @@ def try_columnar(req, query: Query, rw: Rewindable, object_size: int,
             ),
             parse_options=parse_opts,
             convert_options=pacsv.ConvertOptions(
-                column_types={n: pa.string() for n in names},
+                column_types={n: pa.string() for n in raw_names},
                 strings_can_be_null=False,
             ),
         )

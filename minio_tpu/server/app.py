@@ -219,6 +219,8 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         )
         self.meta = BucketMetadataSys(object_layer)
         self.kms = load_kms(object_layer)
+        from minio_tpu.iam.oidc import OpenIDProvider
+        self.oidc = OpenIDProvider.from_env()
         self.notifier = EventNotifier(
             self.meta, targets=load_targets_from_env(),
             queue_dir=_event_queue_dir(object_layer), region=region)
@@ -579,10 +581,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         (reference AssumeRole, cmd/sts-handlers.go)."""
         body = await request.read()
         form = dict(urllib.parse.parse_qsl(body.decode("utf-8", "replace")))
-        ctx = await self._auth(request, hashlib.sha256(body).hexdigest())
         action = form.get("Action", "")
-        if action != "AssumeRole":
-            raise S3Error("InvalidArgument", f"unsupported STS action {action}")
         try:
             duration = int(form.get("DurationSeconds", "3600") or "3600")
         except ValueError:
@@ -590,23 +589,63 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         session_policy = form.get("Policy", "")
         from minio_tpu.iam import IAMError
 
-        try:
-            ident = await self._run(
-                self.iam.assume_role, ctx.access_key, duration, session_policy
-            )
-        except IAMError as e:
-            raise S3Error("AccessDenied", str(e))
+        if action == "AssumeRole":
+            ctx = await self._auth(request, hashlib.sha256(body).hexdigest())
+            try:
+                ident = await self._run(
+                    self.iam.assume_role, ctx.access_key, duration,
+                    session_policy
+                )
+            except IAMError as e:
+                raise S3Error("AccessDenied", str(e))
+            return self._sts_creds_xml("AssumeRole", ident)
+        if action == "AssumeRoleWithWebIdentity":
+            # the bearer token IS the credential: no SigV4 auth
+            # (reference cmd/sts-handlers.go AssumeRoleWithWebIdentity)
+            if self.oidc is None:
+                raise S3Error("NotImplemented",
+                              "no OpenID provider configured")
+            token = form.get("WebIdentityToken", "")
+            if not token:
+                raise S3Error("InvalidArgument", "missing WebIdentityToken")
+            from minio_tpu.iam.oidc import OIDCError
+
+            try:
+                claims = await self._run(self.oidc.validate, token)
+            except OIDCError as e:
+                raise S3Error("AccessDenied", f"invalid web identity: {e}")
+            subject = str(claims.get("sub", ""))
+            policies = self.oidc.policies_for(claims)
+            # credentials must not outlive the identity token that minted
+            # them (reference bounds STS expiry by the JWT exp claim)
+            token_ttl = int(claims["exp"] - time.time())
+            duration = max(1, min(duration, token_ttl))
+            try:
+                ident = await self._run(
+                    self.iam.assume_role_web_identity, subject, policies,
+                    duration, session_policy
+                )
+            except IAMError as e:
+                raise S3Error("AccessDenied", str(e))
+            return self._sts_creds_xml(
+                "AssumeRoleWithWebIdentity", ident,
+                extra=("<SubjectFromWebIdentityToken>"
+                       f"{escape(subject)}"
+                       "</SubjectFromWebIdentityToken>"))
+        raise S3Error("InvalidArgument", f"unsupported STS action {action}")
+
+    def _sts_creds_xml(self, action: str, ident, extra: str = ""):
         exp = _iso(ident.expiry)
         return self._xml(200, (
             '<?xml version="1.0" encoding="UTF-8"?>'
-            '<AssumeRoleResponse xmlns='
+            f'<{action}Response xmlns='
             '"https://sts.amazonaws.com/doc/2011-06-15/">'
-            "<AssumeRoleResult><Credentials>"
+            f"<{action}Result><Credentials>"
             f"<AccessKeyId>{escape(ident.access_key)}</AccessKeyId>"
             f"<SecretAccessKey>{escape(ident.secret_key)}</SecretAccessKey>"
             f"<SessionToken>{escape(ident.session_token)}</SessionToken>"
             f"<Expiration>{exp}</Expiration>"
-            "</Credentials></AssumeRoleResult></AssumeRoleResponse>"
+            f"</Credentials>{extra}</{action}Result></{action}Response>"
         ))
 
     # bucket sub-resources routed by query parameter (reference
@@ -1226,9 +1265,11 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         if sse_kind:
             from minio_tpu.crypto import sse as sse_mod
 
-            obj_key, nonce_prefix, enc_meta = sse_mod.new_encryption_meta(
-                sse_kind, bucket, key, kms=self.kms,
-                customer_key=customer_key)
+            # KMS may be a remote KES server: keep the HTTP round trip
+            # off the event loop
+            obj_key, nonce_prefix, enc_meta = await self._run(
+                sse_mod.new_encryption_meta,
+                sse_kind, bucket, key, self.kms, customer_key)
             opts.user_metadata.update(enc_meta)
             reader = sse_mod.EncryptingReader(
                 reader, obj_key, nonce_prefix, f"{bucket}/{key}".encode())
@@ -1596,7 +1637,8 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         if src_meta.get(sse_mod.META_ALGO):
             # decrypt the source; SSE-C sources are unlocked by the
             # x-amz-copy-source-sse-c header triple (reference SSECopy)
-            obj_key = self.sse_object_key(soi, sbucket, skey, request,
+            obj_key = await self._run(
+                self.sse_object_key, soi, sbucket, skey, request,
                                           copy_source=True)
             nonce_prefix = base64.b64decode(
                 src_meta.get(sse_mod.META_NONCE, ""))
@@ -1642,9 +1684,9 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         reader: io.RawIOBase = io.BytesIO(data)
         sse_kind, customer_key = self.sse_kind_for_put(request, bucket)
         if sse_kind:
-            okey, nprefix, enc_meta = sse_mod.new_encryption_meta(
-                sse_kind, bucket, key, kms=self.kms,
-                customer_key=customer_key)
+            okey, nprefix, enc_meta = await self._run(
+                sse_mod.new_encryption_meta,
+                sse_kind, bucket, key, self.kms, customer_key)
             opts.user_metadata.update(enc_meta)
             reader = sse_mod.EncryptingReader(
                 reader, okey, nprefix, f"{bucket}/{key}".encode())
@@ -1733,7 +1775,8 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         headers["Content-Length"] = str(length)
 
         if encrypted:
-            obj_key = self.sse_object_key(oi, bucket, key, request)
+            obj_key = await self._run(
+                self.sse_object_key, oi, bucket, key, request)
             headers.update(self.sse_response_headers(oi.metadata))
             ct_off, ct_len, first_seq, skip = sse_mod.ct_range_for(
                 offset, length, size)
@@ -1790,7 +1833,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
 
         if oi.metadata.get(sse_mod.META_ALGO):
             # SSE-C objects require (and verify) the key even on HEAD
-            self.sse_object_key(oi, bucket, key, request)
+            await self._run(self.sse_object_key, oi, bucket, key, request)
             headers.update(self.sse_response_headers(oi.metadata))
             headers["Content-Length"] = str(sse_mod.plain_size_of(oi.size))
         elif oi.metadata.get(
@@ -1864,7 +1907,8 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
 
         # plaintext source stream (decompress / decrypt like GET)
         if oi.metadata.get(sse_mod.META_ALGO):
-            obj_key = self.sse_object_key(oi, bucket, key, request)
+            obj_key = await self._run(
+                self.sse_object_key, oi, bucket, key, request)
             nonce_prefix = base64.b64decode(
                 oi.metadata.get(sse_mod.META_NONCE, ""))
             plain = sse_mod.plain_size_of(oi.size)

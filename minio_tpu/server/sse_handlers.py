@@ -33,16 +33,31 @@ COPY_SSEC_PREFIX = "x-amz-copy-source-server-side-encryption-customer-"
 
 KMS_CONFIG_PATH = "config/kms/master.json"
 KMS_ENV = "MINIO_KMS_SECRET_KEY"
+KES_ENDPOINT_ENV = "MINIO_KMS_KES_ENDPOINT"
+KES_KEY_ENV = "MINIO_KMS_KES_KEY_NAME"
+KES_API_KEY_ENV = "MINIO_KMS_KES_API_KEY"
 
 
-def load_kms(object_layer) -> LocalKMS | None:
-    """KMS master key from the environment; None disables SSE-S3.
+def load_kms(object_layer):
+    """KMS from the environment; None disables SSE-S3/SSE-KMS.
 
-    MINIO_KMS_SECRET_KEY takes the reference's `key-id:base64(32-byte)`
-    format.  As a legacy fallback, a key persisted on the drives by an
-    earlier release is still READ (so existing SSE-S3 objects stay
-    decryptable) but a new key is never generated or written to disk.
+    Precedence (reference internal/kms setup order):
+    1. MINIO_KMS_KES_ENDPOINT + MINIO_KMS_KES_KEY_NAME — external KES
+       key server (crypto/kes.py; api key via MINIO_KMS_KES_API_KEY)
+    2. MINIO_KMS_SECRET_KEY — local single key, `key-id:base64(32-byte)`
+    3. legacy fallback: a key persisted on the drives by an earlier
+       release is still READ (existing SSE-S3 objects stay decryptable)
+       but a new key is never generated or written to disk.
     """
+    kes_endpoint = os.environ.get(KES_ENDPOINT_ENV, "")
+    if kes_endpoint:
+        from minio_tpu.crypto.kes import KESClient
+
+        key_name = os.environ.get(KES_KEY_ENV, "")
+        if not key_name:
+            raise ValueError(f"{KES_ENDPOINT_ENV} set but {KES_KEY_ENV} missing")
+        return KESClient(kes_endpoint, key_name,
+                         api_key=os.environ.get(KES_API_KEY_ENV, ""))
     spec = os.environ.get(KMS_ENV, "")
     if spec:
         try:

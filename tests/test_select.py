@@ -554,3 +554,11 @@ class TestColumnarReviewFindings:
                         out_ser={"JSON": {}})
         assert b'"a"' in out and b'"b"' in out
         assert b'"a "' not in out and b'" b"' not in out
+
+    def test_padded_header_values_stay_strings(self):
+        # pass-2 string pinning must key pyarrow by the RAW header bytes;
+        # stripped keys would let type inference turn "007" into 7
+        csv = b"a , b\n007,x\n"
+        out = self._run("SELECT * FROM s3object", csv,
+                        out_ser={"JSON": {}})
+        assert b'"007"' in out

@@ -1,0 +1,383 @@
+"""STS web-identity federation (OIDC/JWKS) and the external KES KMS
+client: token exchange yields working scoped temp creds; SSE-KMS
+round-trips through a fake KES server including key rotation.
+
+Reference: cmd/sts-handlers.go (AssumeRoleWithWebIdentity),
+internal/config/identity/openid (JWKS validation), internal/kms/kes.go
+(external key server client).
+"""
+
+import base64
+import http.server
+import json
+import re
+import threading
+import time
+
+import pytest
+from cryptography.hazmat.primitives.asymmetric import padding, rsa
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+from minio_tpu.crypto.kes import KESClient
+from minio_tpu.crypto.kms import KMSError
+from minio_tpu.iam.oidc import OIDCError, OpenIDProvider
+
+from .s3_harness import S3TestServer
+
+
+def _b64url(b: bytes) -> str:
+    return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+
+class FakeIdP:
+    """RSA keypair + JWKS endpoint + JWT minting."""
+
+    def __init__(self):
+        self.key = rsa.generate_private_key(public_exponent=65537,
+                                            key_size=2048)
+        self.kid = "test-key-1"
+        pub = self.key.public_key().public_numbers()
+        jwks = {"keys": [{
+            "kty": "RSA", "kid": self.kid, "use": "sig", "alg": "RS256",
+            "n": _b64url(pub.n.to_bytes((pub.n.bit_length() + 7) // 8, "big")),
+            "e": _b64url(pub.e.to_bytes(3, "big")),
+        }]}
+        body = json.dumps(jwks).encode()
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    @property
+    def jwks_url(self) -> str:
+        return f"http://127.0.0.1:{self.port}/jwks.json"
+
+    def mint(self, claims: dict, kid: str | None = None,
+             corrupt_sig: bool = False) -> str:
+        header = {"alg": "RS256", "typ": "JWT",
+                  "kid": self.kid if kid is None else kid}
+        signing = (_b64url(json.dumps(header).encode()) + "." +
+                   _b64url(json.dumps(claims).encode()))
+        sig = self.key.sign(signing.encode(), padding.PKCS1v15(),
+                            hashes.SHA256())
+        if corrupt_sig:
+            sig = bytes([sig[0] ^ 1]) + sig[1:]
+        return signing + "." + _b64url(sig)
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+@pytest.fixture(scope="module")
+def idp():
+    p = FakeIdP()
+    yield p
+    p.close()
+
+
+# --------------------------------------------------------------- OIDC  unit
+class TestOpenIDProvider:
+    def _claims(self, **over):
+        c = {"sub": "user-42", "iss": "https://idp.test",
+             "aud": "minio-tpu", "exp": time.time() + 600,
+             "policy": "readwrite"}
+        c.update(over)
+        return c
+
+    def test_valid_token(self, idp):
+        p = OpenIDProvider(idp.jwks_url, client_id="minio-tpu",
+                           issuer="https://idp.test")
+        claims = p.validate(idp.mint(self._claims()))
+        assert claims["sub"] == "user-42"
+        assert p.policies_for(claims) == ["readwrite"]
+
+    def test_bad_signature(self, idp):
+        p = OpenIDProvider(idp.jwks_url)
+        with pytest.raises(OIDCError, match="signature"):
+            p.validate(idp.mint(self._claims(), corrupt_sig=True))
+
+    def test_expired(self, idp):
+        p = OpenIDProvider(idp.jwks_url)
+        with pytest.raises(OIDCError, match="expired"):
+            p.validate(idp.mint(self._claims(exp=time.time() - 10)))
+
+    def test_audience_mismatch(self, idp):
+        p = OpenIDProvider(idp.jwks_url, client_id="expected")
+        with pytest.raises(OIDCError, match="audience"):
+            p.validate(idp.mint(self._claims(aud="other")))
+        # azp satisfies the check even when aud differs
+        claims = p.validate(idp.mint(self._claims(aud="other",
+                                                  azp="expected")))
+        assert claims["azp"] == "expected"
+
+    def test_issuer_mismatch(self, idp):
+        p = OpenIDProvider(idp.jwks_url, issuer="https://elsewhere")
+        with pytest.raises(OIDCError, match="issuer"):
+            p.validate(idp.mint(self._claims()))
+
+    def test_unknown_kid(self, idp):
+        p = OpenIDProvider(idp.jwks_url)
+        with pytest.raises(OIDCError, match="kid"):
+            p.validate(idp.mint(self._claims(), kid="rotated-away"))
+
+    def test_policy_claim_forms(self, idp):
+        p = OpenIDProvider(idp.jwks_url, claim_name="policy")
+        assert p.policies_for({"policy": "a, b ,c"}) == ["a", "b", "c"]
+        assert p.policies_for({"policy": ["x", "y"]}) == ["x", "y"]
+        assert p.policies_for({}) == []
+
+    def test_env_construction(self, idp):
+        env = {"MINIO_IDENTITY_OPENID_JWKS_URL": idp.jwks_url,
+               "MINIO_IDENTITY_OPENID_CLIENT_ID": "cid",
+               "MINIO_IDENTITY_OPENID_CLAIM_NAME": "roles"}
+        p = OpenIDProvider.from_env(env)
+        assert p.client_id == "cid" and p.claim_name == "roles"
+        assert OpenIDProvider.from_env({}) is None
+
+
+# ------------------------------------------------------- web identity (HTTP)
+class TestWebIdentitySTS:
+    @pytest.fixture
+    def srv(self, tmp_path, idp):
+        s = S3TestServer(str(tmp_path))
+        s.server.oidc = OpenIDProvider(idp.jwks_url, client_id="minio-tpu")
+        yield s
+        s.close()
+
+    def _exchange(self, srv, token, duration=900):
+        body = ("Action=AssumeRoleWithWebIdentity&Version=2011-06-15"
+                f"&DurationSeconds={duration}&WebIdentityToken={token}")
+        return srv.raw_request(
+            "POST", "/", data=body.encode(),
+            headers={"content-type": "application/x-www-form-urlencoded",
+                     "host": srv.host})
+
+    def test_token_exchange_yields_scoped_creds(self, srv, idp):
+        srv.iam.set_policy("webread", json.dumps({
+            "Statement": [
+                {"Effect": "Allow", "Action": ["s3:GetObject"],
+                 "Resource": "arn:aws:s3:::wid/*"},
+            ],
+        }))
+        assert srv.request("PUT", "/wid").status == 200
+        assert srv.request("PUT", "/wid/o", data=b"hello").status == 200
+
+        token = idp.mint({"sub": "alice@idp", "aud": "minio-tpu",
+                          "exp": time.time() + 300, "policy": "webread"})
+        r = self._exchange(srv, token)
+        assert r.status == 200, r.text()
+        xml = r.text()
+        assert "<SubjectFromWebIdentityToken>alice@idp" in xml
+        ak = re.search(r"<AccessKeyId>([^<]+)</AccessKeyId>", xml).group(1)
+        sk = re.search(r"<SecretAccessKey>([^<]+)</SecretAccessKey>",
+                       xml).group(1)
+        assert ak.startswith("STS")
+        # the claimed policy allows GET on wid/* and nothing else
+        assert srv.request("GET", "/wid/o", creds=(ak, sk)).body == b"hello"
+        assert srv.request("PUT", "/wid/new", data=b"x",
+                           creds=(ak, sk)).status == 403
+        assert srv.request("PUT", "/elsewhere", creds=(ak, sk)).status == 403
+
+    def test_bad_token_rejected(self, srv, idp):
+        bad = idp.mint({"sub": "x", "aud": "minio-tpu",
+                        "exp": time.time() + 300, "policy": "readwrite"},
+                       corrupt_sig=True)
+        assert self._exchange(srv, bad).status == 403
+        expired = idp.mint({"sub": "x", "aud": "minio-tpu",
+                            "exp": time.time() - 5, "policy": "readwrite"})
+        assert self._exchange(srv, expired).status == 403
+
+    def test_unmapped_policy_rejected(self, srv, idp):
+        token = idp.mint({"sub": "x", "aud": "minio-tpu",
+                          "exp": time.time() + 300,
+                          "policy": "no-such-policy"})
+        assert self._exchange(srv, token).status == 403
+        nopolicy = idp.mint({"sub": "x", "aud": "minio-tpu",
+                             "exp": time.time() + 300})
+        assert self._exchange(srv, nopolicy).status == 403
+
+    def test_no_provider_configured(self, tmp_path, idp):
+        s = S3TestServer(str(tmp_path / "np"))
+        try:
+            s.server.oidc = None
+            token = idp.mint({"sub": "x", "exp": time.time() + 300})
+            r = self._exchange(s, token)
+            assert r.status == 501
+        finally:
+            s.close()
+
+
+# ----------------------------------------------------------------- fake KES
+class FakeKES:
+    """In-memory KES: named AES-256-GCM master keys, the three REST
+    endpoints, bearer-token auth."""
+
+    def __init__(self, api_key: str = ""):
+        self.keys: dict[str, bytes] = {}
+        self.api_key = api_key
+        kes = self
+
+        import os as osmod
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                if kes.api_key:
+                    if self.headers.get("Authorization") != \
+                            f"Bearer {kes.api_key}":
+                        self.send_response(401)
+                        self.end_headers()
+                        return
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                body = json.loads(self.rfile.read(n) or b"{}") if n else {}
+                parts = self.path.strip("/").split("/")
+                # v1/key/<op>/<name>
+                if len(parts) != 4 or parts[0] != "v1" or parts[1] != "key":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                op, name = parts[2], parts[3]
+                if op == "create":
+                    if name in kes.keys:
+                        self._reply(400, {"message": "key exists"})
+                        return
+                    kes.keys[name] = osmod.urandom(32)
+                    self._reply(200, {})
+                    return
+                master = kes.keys.get(name)
+                if master is None:
+                    self._reply(404, {"message": "no such key"})
+                    return
+                ctx = base64.b64decode(body.get("context", "") or "")
+                if op == "generate":
+                    dk = osmod.urandom(32)
+                    nonce = osmod.urandom(12)
+                    ct = nonce + AESGCM(master).encrypt(nonce, dk, ctx)
+                    self._reply(200, {
+                        "plaintext": base64.b64encode(dk).decode(),
+                        "ciphertext": base64.b64encode(ct).decode()})
+                elif op == "decrypt":
+                    raw = base64.b64decode(body.get("ciphertext", ""))
+                    try:
+                        dk = AESGCM(master).decrypt(raw[:12], raw[12:], ctx)
+                    except Exception:
+                        self._reply(400, {"message": "decryption failed"})
+                        return
+                    self._reply(200, {
+                        "plaintext": base64.b64encode(dk).decode()})
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+            def _reply(self, code, doc):
+                body = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+class TestKESClient:
+    def test_generate_decrypt_roundtrip(self):
+        kes = FakeKES()
+        try:
+            c = KESClient(kes.endpoint, "master-1")
+            c.create_key("master-1")
+            pk, sealed = c.generate_key("bkt/obj")
+            assert len(pk) == 32
+            assert c.decrypt_key(sealed, "bkt/obj") == pk
+            # context binds the seal
+            with pytest.raises(KMSError):
+                c.decrypt_key(sealed, "bkt/other")
+        finally:
+            kes.close()
+
+    def test_api_key_auth(self):
+        kes = FakeKES(api_key="tok123")
+        try:
+            ok = KESClient(kes.endpoint, "k", api_key="tok123")
+            ok.create_key("k")
+            bad = KESClient(kes.endpoint, "k", api_key="wrong")
+            with pytest.raises(KMSError, match="401"):
+                bad.generate_key("ctx")
+        finally:
+            kes.close()
+
+    def test_rotation_keeps_old_envelopes_decryptable(self):
+        kes = FakeKES()
+        try:
+            c = KESClient(kes.endpoint, "v1")
+            c.create_key("v1")
+            pk1, sealed1 = c.generate_key("ctx")
+            c.rotate("v2")
+            assert c.key_id == "v2"
+            pk2, sealed2 = c.generate_key("ctx")
+            # new seal under v2, old envelope still unseals (records v1)
+            assert json.loads(sealed2)["key"] == "v2"
+            assert c.decrypt_key(sealed1, "ctx") == pk1
+            assert c.decrypt_key(sealed2, "ctx") == pk2
+        finally:
+            kes.close()
+
+
+class TestSSEKMSEndToEnd:
+    def test_put_get_with_kes_and_rotation(self, tmp_path):
+        kes = FakeKES()
+        srv = S3TestServer(str(tmp_path))
+        try:
+            client = KESClient(kes.endpoint, "obj-key-v1")
+            client.create_key("obj-key-v1")
+            srv.server.kms = client
+            assert srv.request("PUT", "/enc").status == 200
+            r = srv.request("PUT", "/enc/secret", data=b"top secret",
+                            headers={"x-amz-server-side-encryption":
+                                     "aws:kms"})
+            assert r.status == 200, r.text()
+            # bytes on the drives are NOT the plaintext
+            import glob as g
+            leaked = False
+            for f in g.glob(str(tmp_path / "**" / "enc" / "**" / "part.*"),
+                            recursive=True):
+                leaked |= b"top secret" in open(f, "rb").read()
+            xl = g.glob(str(tmp_path / "**" / "enc" / "**" / "xl.meta"),
+                        recursive=True)
+            for f in xl:
+                leaked |= b"top secret" in open(f, "rb").read()
+            assert not leaked, "plaintext leaked to disk"
+            r = srv.request("GET", "/enc/secret")
+            assert r.status == 200 and r.body == b"top secret"
+            # rotate; old object still readable, new object sealed under v2
+            client.rotate("obj-key-v2")
+            r = srv.request("PUT", "/enc/secret2", data=b"newer secret",
+                            headers={"x-amz-server-side-encryption":
+                                     "aws:kms"})
+            assert r.status == 200, r.text()
+            assert srv.request("GET", "/enc/secret").body == b"top secret"
+            assert srv.request("GET", "/enc/secret2").body == b"newer secret"
+        finally:
+            srv.close()
+            kes.close()
