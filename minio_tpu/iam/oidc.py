@@ -53,8 +53,9 @@ class OpenIDProvider:
         self.jwks_ttl = jwks_ttl
         self.timeout = timeout
         self._keys: dict[str, rsa.RSAPublicKey] = {}
-        self._fetched = 0.0
+        self._fetched = float("-inf")
         self._lock = threading.Lock()
+        self._fetch_lock = threading.Lock()
 
     @classmethod
     def from_env(cls, environ=None) -> "OpenIDProvider | None":
@@ -71,41 +72,52 @@ class OpenIDProvider:
 
     # ----------------------------------------------------------------- JWKS
     def _fetch_jwks(self) -> None:
-        with urllib.request.urlopen(self.jwks_url,
-                                    timeout=self.timeout) as resp:
-            doc = json.loads(resp.read())
-        keys: dict[str, rsa.RSAPublicKey] = {}
-        for jwk in doc.get("keys", []):
-            if jwk.get("kty") != "RSA":
-                continue
-            try:
-                n = int.from_bytes(_b64url(jwk["n"]), "big")
-                e = int.from_bytes(_b64url(jwk["e"]), "big")
-            except (KeyError, ValueError):
-                continue
-            keys[jwk.get("kid", "")] = rsa.RSAPublicNumbers(
-                e, n).public_key()
-        self._keys = keys
-        self._fetched = time.monotonic()
+        """Network fetch OUTSIDE self._lock (a slow IdP must not stall
+        every concurrent validation); the parsed key map is swapped in
+        under the lock.  A separate fetch lock prevents a refresh
+        stampede."""
+        with self._fetch_lock:
+            # another thread may have refreshed while we waited
+            if time.monotonic() - self._fetched < 1.0:
+                return
+            with urllib.request.urlopen(self.jwks_url,
+                                        timeout=self.timeout) as resp:
+                doc = json.loads(resp.read())
+            keys: dict[str, rsa.RSAPublicKey] = {}
+            for jwk in doc.get("keys", []):
+                if jwk.get("kty") != "RSA":
+                    continue
+                try:
+                    n = int.from_bytes(_b64url(jwk["n"]), "big")
+                    e = int.from_bytes(_b64url(jwk["e"]), "big")
+                except (KeyError, ValueError):
+                    continue
+                keys[jwk.get("kid", "")] = rsa.RSAPublicNumbers(
+                    e, n).public_key()
+            with self._lock:
+                self._keys = keys
+                self._fetched = time.monotonic()
 
     def _key_for(self, kid: str) -> rsa.RSAPublicKey:
         with self._lock:
-            stale = time.monotonic() - self._fetched > self.jwks_ttl
-            if stale or (kid not in self._keys and
-                         time.monotonic() - self._fetched > 1.0):
-                # refresh on expiry, and on unknown kid (rotation) with a
-                # 1 s floor so bad tokens can't hammer the IdP
-                try:
-                    self._fetch_jwks()
-                except Exception as e:
-                    if not self._keys:
-                        raise OIDCError(f"JWKS fetch failed: {e}")
-            key = self._keys.get(kid)
-            if key is None and len(self._keys) == 1 and not kid:
-                key = next(iter(self._keys.values()))
-            if key is None:
-                raise OIDCError(f"no JWKS key for kid {kid!r}")
-            return key
+            keys = self._keys
+            age = time.monotonic() - self._fetched
+        if age > self.jwks_ttl or (kid not in keys and age > 1.0):
+            # refresh on expiry, and on unknown kid (rotation) with a
+            # 1 s floor so bad tokens can't hammer the IdP
+            try:
+                self._fetch_jwks()
+            except Exception as e:
+                if not keys:
+                    raise OIDCError(f"JWKS fetch failed: {e}")
+            with self._lock:
+                keys = self._keys
+        key = keys.get(kid)
+        if key is None and len(keys) == 1 and not kid:
+            key = next(iter(keys.values()))
+        if key is None:
+            raise OIDCError(f"no JWKS key for kid {kid!r}")
+        return key
 
     # ------------------------------------------------------------ validation
     def validate(self, token: str) -> dict:

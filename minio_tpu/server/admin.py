@@ -613,6 +613,10 @@ class AdminMixin:
         svcs = getattr(self, "services", None)
         if svcs is not None:
             info["usage"] = svcs.scanner.data_usage_info()
+            if svcs.replication is not None:
+                # incl. per-target pending/failed/proxied counters
+                # (reference madmin ReplicationInfo / bucket-targets state)
+                info["replication"] = svcs.replication.stats.to_dict()
         return self._json(info)
 
     async def admin_storage_info(self, request: web.Request, body: bytes):

@@ -1739,13 +1739,78 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             raise S3Error("InvalidRange")
         return start, end
 
+    # proxy a GET/HEAD miss to a replication target (reference
+    # proxyGetToReplicationTarget, cmd/bucket-replication.go): an object
+    # that has not replicated to THIS site yet is served from the remote
+    # instead of 404ing, making active-active pairs read-consistent
+    _PROXY_HDRS = ("content-type", "etag", "last-modified",
+                   "content-length", "content-range", "cache-control",
+                   "content-encoding", "content-disposition")
+
+    async def _replication_proxy(self, request, bucket: str, key: str,
+                                 vid: str, head: bool = False):
+        if vid:
+            return None  # replica versions have their own ids remotely
+        from minio_tpu.services import replication as repl_mod
+
+        pool = getattr(self.services, "replication", None) \
+            if self.services is not None else None
+        # the remote evaluates conditional requests (304/412 pass back)
+        cond = {h: request.headers[h] for h in
+                ("If-Match", "If-None-Match", "If-Modified-Since",
+                 "If-Unmodified-Since") if h in request.headers}
+        hit = await self._run(
+            repl_mod.proxy_get, self.meta, bucket, key,
+            request.headers.get("Range", ""),
+            pool.stats if pool is not None else None, head, cond)
+        if hit is None:
+            return None
+        _, rh, chunks = hit
+        headers = {"x-minio-proxied-from-target": "true"}
+        for h in self._PROXY_HDRS:
+            if rh.get(h):
+                headers[h.title()] = rh[h]
+        for k, v in rh.items():
+            if k.startswith("x-amz-meta-"):
+                headers[k] = v
+        remote_status = int(rh.get(":status", "200"))
+        if remote_status in (304, 412):
+            if chunks is not None:
+                await self._run(getattr(chunks, "close", lambda: None))
+            headers.pop("Content-Length", None)
+            return web.Response(status=remote_status, headers=headers)
+        status = 206 if rh.get("content-range") else 200
+        if head:
+            return web.Response(status=status, headers=headers)
+        resp = web.StreamResponse(status=status, headers=headers)
+        await resp.prepare(request)
+        it = iter(chunks)
+        try:
+            while True:
+                chunk = await self._run(next, it, None)
+                if chunk is None:
+                    break
+                await resp.write(chunk)
+        finally:
+            close = getattr(chunks, "close", None)
+            if close is not None:
+                await self._run(close)
+        await resp.write_eof()
+        return resp
+
     async def get_object(self, request: web.Request) -> web.StreamResponse:
         from minio_tpu.crypto import sse as sse_mod
 
         bucket, key = self._object(request)
         await self._auth(request, None, "s3:GetObject", bucket, key)
         vid = request.rel_url.query.get("versionId", "")
-        oi = await self._run(self.api.get_object_info, bucket, key, vid)
+        try:
+            oi = await self._run(self.api.get_object_info, bucket, key, vid)
+        except (st.ObjectNotFound, st.FileNotFound) as e:
+            resp = await self._replication_proxy(request, bucket, key, vid)
+            if resp is not None:
+                return resp
+            raise e
         if vid == "null":
             oi.version_id = "null"
         self.check_preconditions(request, oi)
@@ -1824,7 +1889,14 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         bucket, key = self._object(request)
         await self._auth(request, None, "s3:GetObject", bucket, key)
         vid = request.rel_url.query.get("versionId", "")
-        oi = await self._run(self.api.get_object_info, bucket, key, vid)
+        try:
+            oi = await self._run(self.api.get_object_info, bucket, key, vid)
+        except (st.ObjectNotFound, st.FileNotFound) as e:
+            resp = await self._replication_proxy(request, bucket, key, vid,
+                                                 head=True)
+            if resp is not None:
+                return resp
+            raise e
         if vid == "null":
             oi.version_id = "null"
         self.check_preconditions(request, oi)

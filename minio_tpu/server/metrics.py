@@ -181,6 +181,29 @@ class MetricsMixin:
                       "Replication ops failed", rs.failed)
                 gauge("minio_replication_sent_bytes",
                       "Bytes replicated to targets", rs.bytes_replicated)
+                gauge("minio_replication_proxied_requests_total",
+                      "GET/HEAD requests proxied to replication targets",
+                      rs.proxied)
+                per_target = rs.targets_snapshot()
+                if per_target:
+                    per = [
+                        ("minio_replication_target_completed_total",
+                         "Replication ops completed per target",
+                         "completed"),
+                        ("minio_replication_target_failed_total",
+                         "Replication ops failed per target", "failed"),
+                        ("minio_replication_target_sent_bytes",
+                         "Bytes replicated per target", "bytes_replicated"),
+                        ("minio_replication_target_proxied_total",
+                         "Requests proxied per target", "proxied"),
+                    ]
+                    for name, help_, attr in per:
+                        rows = [f"# HELP {name} {help_}",
+                                f"# TYPE {name} gauge"]
+                        for arn, ts in sorted(per_target.items()):
+                            lbl = _fmt_labels(("target",), (arn,))
+                            rows.append(f"{name}{lbl} {getattr(ts, attr)}")
+                        g("\n".join(rows) + "\n")
         # event notification backlog
         notifier = getattr(self, "notifier", None)
         if notifier is not None:

@@ -92,17 +92,56 @@ class ReplicationOp:
 
 
 @dataclass
+class TargetStats:
+    """Per-remote-target delivery state (reference
+    cmd/bucket-targets.go TargetClient health + cmd/bucket-replication-
+    stats.go per-ARN counters)."""
+
+    completed: int = 0
+    failed: int = 0
+    deletes: int = 0
+    proxied: int = 0
+    bytes_replicated: int = 0
+    last_failure: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {"completed": self.completed, "failed": self.failed,
+                "deletes": self.deletes, "proxied": self.proxied,
+                "bytesReplicated": self.bytes_replicated,
+                "lastFailure": self.last_failure}
+
+
+@dataclass
 class ReplicationStats:
     queued: int = 0
     completed: int = 0
     failed: int = 0
     deletes: int = 0
+    proxied: int = 0
     bytes_replicated: int = 0
+    per_target: dict = field(default_factory=dict)  # arn -> TargetStats
+    # worker threads insert targets while admin/metrics handlers iterate
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False, compare=False)
+
+    def target(self, arn: str) -> TargetStats:
+        with self._lock:
+            ts = self.per_target.get(arn)
+            if ts is None:
+                ts = self.per_target[arn] = TargetStats()
+            return ts
+
+    def targets_snapshot(self) -> dict:
+        with self._lock:
+            return dict(self.per_target)
 
     def to_dict(self) -> dict:
         return {"queued": self.queued, "completed": self.completed,
                 "failed": self.failed, "deletes": self.deletes,
-                "bytesReplicated": self.bytes_replicated}
+                "proxied": self.proxied,
+                "bytesReplicated": self.bytes_replicated,
+                "targets": {arn: t.to_dict()
+                            for arn, t in self.targets_snapshot().items()}}
 
 
 class ReplicationPool:
@@ -182,6 +221,15 @@ class ReplicationPool:
                 self._process(op)
             except Exception:
                 op.attempts += 1
+                try:
+                    _, tgt = self._rule_and_target(op)
+                    if tgt is not None:
+                        ts = self.stats.target(tgt.arn)
+                        ts.last_failure = time.time()
+                        if op.attempts >= MAX_ATTEMPTS:
+                            ts.failed += 1
+                except Exception:
+                    pass
                 if op.attempts < MAX_ATTEMPTS:
                     op.not_before = time.time() + 0.5 * (2 ** op.attempts)
                     self._q.put(op)
@@ -224,6 +272,7 @@ class ReplicationPool:
                 if e.status != 404:
                     raise
             self.stats.deletes += 1
+            self.stats.target(tgt.arn).deletes += 1
             return
 
         oi, stream = self.api.get_object(op.bucket, op.name,
@@ -253,6 +302,9 @@ class ReplicationPool:
                 stream.close()
         self.stats.completed += 1
         self.stats.bytes_replicated += size
+        ts = self.stats.target(tgt.arn)
+        ts.completed += 1
+        ts.bytes_replicated += size
         self._set_status(op, COMPLETED)
 
     def _set_status(self, op: ReplicationOp, status: str) -> None:
@@ -262,3 +314,58 @@ class ReplicationPool:
                 version_id=op.version_id)
         except Exception:
             pass
+
+
+def proxy_get(meta, bucket: str, key: str, range_header: str = "",
+              stats: ReplicationStats | None = None, head: bool = False,
+              cond_headers: dict | None = None):
+    """GET-miss proxying: when an object under a replication rule is not
+    (yet) present locally, serve it from the first reachable remote
+    target instead of returning 404 (reference
+    proxyGetToReplicationTarget / proxyHeadToReplicationTarget,
+    cmd/bucket-replication.go).
+
+    Returns (target, response_headers, chunk_iter|None) or None.  Only
+    unversioned requests proxy: replica versions carry fresh ids on this
+    implementation's targets, so a source version id has no meaning
+    remotely.
+    """
+    try:
+        cfg = meta.replication_config(bucket)
+    except Exception:
+        return None
+    if cfg is None or cfg.match(key) is None:
+        return None
+    # conditional headers are forwarded so the TARGET evaluates them
+    # (304/412 pass back through); the pseudo-header ":status" carries
+    # the remote status to the caller
+    fwd = dict(cond_headers or {})
+    if range_header:
+        fwd["Range"] = range_header
+    ok = (200, 206, 304, 412)
+    for tgt in load_targets(meta, bucket):
+        try:
+            client = tgt.client()
+            if head:
+                rh = client.head_object(tgt.bucket, key,
+                                        headers=fwd or None, ok=ok)
+                chunks = None
+            else:
+                rh, chunks = client.get_object_stream(
+                    tgt.bucket, key, headers=fwd or None, ok=ok,
+                    with_headers=True)
+            if stats is not None:
+                stats.proxied += 1
+                stats.target(tgt.arn).proxied += 1
+            return tgt, rh, chunks
+        except S3ClientError as e:
+            # 404 = the object simply is not on this target; anything
+            # else marks the target unhealthy
+            if e.status != 404 and stats is not None:
+                stats.target(tgt.arn).last_failure = time.time()
+            continue
+        except OSError:
+            if stats is not None:
+                stats.target(tgt.arn).last_failure = time.time()
+            continue
+    return None

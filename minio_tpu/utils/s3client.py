@@ -136,11 +136,17 @@ class S3Client:
 
         if with_headers:
             rh = {k.lower(): v for k, v in resp.getheaders()}
+            rh[":status"] = str(resp.status)
             return rh, chunks()
         return chunks()
 
-    def head_object(self, bucket: str, key: str) -> dict:
-        _, rh, _ = self._request("HEAD", bucket, key)
+    def head_object(self, bucket: str, key: str,
+                    headers: dict | None = None,
+                    ok: tuple = (200, 204)) -> dict:
+        status, rh, _ = self._request("HEAD", bucket, key, headers=headers,
+                                      ok=ok)
+        rh = dict(rh)
+        rh[":status"] = str(status)
         return rh
 
     def delete_object(self, bucket: str, key: str,
