@@ -181,6 +181,8 @@ class ClusterNode:
             # (reference: peers subscribe to each other's globalTrace,
             # cmd/admin-handlers.go TraceHandler + peer-rest subscribe)
             self.s3.peer_trace_addrs = sorted(self.peer_clients)
+            # admin info aggregates per-server health over these clients
+            self.s3.peer_clients = self.peer_clients
         else:
             self.peers = None
         self.s3.node_addr = my_address

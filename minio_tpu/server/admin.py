@@ -16,6 +16,7 @@ action (reference cmd/admin-handler-utils.go checkAdminRequestAuth).
 
 from __future__ import annotations
 
+import asyncio
 import hashlib
 import json
 import time
@@ -82,6 +83,12 @@ class AdminMixin:
         # TraceHandler cmd/admin-handlers.go:1108, ConsoleLogHandler)
         r.add_get(f"{p}/trace", wrap(self.admin_trace, "ServerTrace"))
         r.add_get(f"{p}/log", wrap(self.admin_console_log, "ConsoleLog"))
+        # on-demand cluster profiling (reference StartProfiling /
+        # DownloadProfileData, cmd/peer-rest-client.go:469-490)
+        r.add_post(f"{p}/profiling/start",
+                   wrap(self.admin_profiling_start, "Profiling"))
+        r.add_post(f"{p}/profiling/stop",
+                   wrap(self.admin_profiling_stop, "Profiling"))
         # speedtests (reference drive/object perf probes,
         # cmd/peer-rest-client.go:128 dperf + SpeedtestHandler)
         # write-heavy probes get their own action, NOT the read-only
@@ -580,6 +587,117 @@ class AdminMixin:
         if self.iam.evaluate(ctx.access_key, f"admin:{op}") != "allow":
             raise S3Error("AccessDenied", f"admin:{op} denied")
 
+    # ----------------------------------------------------------- profiling
+    def _peer_admin_post(self, addr: str, path: str,
+                         query: list) -> tuple[int, bytes]:
+        """One signed admin POST to a peer (root creds, like the trace
+        follower); returns (status, body)."""
+        import http.client as hc
+
+        from . import sigv4
+
+        qs = "&".join(f"{k}={v}" for k, v in query)
+        signed = sigv4.sign_request(
+            "POST", path, query, {"host": addr}, b"",
+            self.iam.root.access_key, self.iam.root.secret_key,
+            region=self.region)
+        host, _, port = addr.partition(":")
+        conn = hc.HTTPConnection(host, int(port or 80), timeout=30)
+        try:
+            conn.request("POST", f"{path}?{qs}" if qs else path,
+                         headers=signed)
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    def _profiler(self):
+        """Per-server sampler (NOT a module singleton: in-process
+        multi-node tests and embedded deployments need one per node)."""
+        p = getattr(self, "_profiler_inst", None)
+        if p is None:
+            from minio_tpu.utils.profiling import Sampler
+
+            p = self._profiler_inst = Sampler()
+        return p
+
+    async def admin_profiling_start(self, request: web.Request, body: bytes):
+        """Start the sampling profiler on this node and (unless
+        ?local=true) every peer concurrently (reference StartProfiling
+        fan-out)."""
+        ptype = request.rel_url.query.get("profilerType", "cpu")
+        local_only = request.rel_url.query.get("local", "") in ("true", "1")
+        ok = await self._run(self._profiler().start)
+        me = getattr(self, "node_addr", "") or "local"
+        results = [{"nodeName": me, "success": ok}]
+        if not local_only:
+            async def one(addr):
+                try:
+                    status, pb = await self._run(
+                        self._peer_admin_post, addr,
+                        f"{ADMIN_PREFIX}/profiling/start",
+                        [("local", "true"), ("profilerType", ptype)])
+                    success = status == 200
+                    if success:
+                        # the peer reports its own verdict (e.g. already
+                        # running) with HTTP 200 — honor the body
+                        try:
+                            success = bool(json.loads(pb)[0]["success"])
+                        except (ValueError, KeyError, IndexError):
+                            pass
+                    return {"nodeName": addr, "success": success}
+                except Exception as e:
+                    return {"nodeName": addr, "success": False,
+                            "error": str(e)}
+
+            results += list(await asyncio.gather(*[
+                one(a) for a in getattr(self, "peer_trace_addrs", [])
+            ]))
+        return self._json(results)
+
+    async def admin_profiling_stop(self, request: web.Request, body: bytes):
+        """Stop profiling and download the capture: raw collapsed-stack
+        report with ?local=true, else a zip with one capture per node; a
+        peer that cannot be reached contributes an ERROR entry so a
+        partial capture is visibly partial (reference
+        DownloadProfileData)."""
+        local_only = request.rel_url.query.get("local", "") in ("true", "1")
+        blob = await self._run(self._profiler().stop)
+        if local_only:
+            return web.Response(body=blob,
+                                content_type="application/octet-stream")
+        import io as iomod
+        import zipfile
+
+        async def one(addr):
+            try:
+                status, pb = await self._run(
+                    self._peer_admin_post, addr,
+                    f"{ADMIN_PREFIX}/profiling/stop", [("local", "true")])
+                if status != 200:
+                    return addr, None, f"HTTP {status}"
+                return addr, pb, None
+            except Exception as e:
+                return addr, None, str(e)
+
+        peers = list(await asyncio.gather(*[
+            one(a) for a in getattr(self, "peer_trace_addrs", [])
+        ]))
+        me = getattr(self, "node_addr", "") or "local"
+        buf = iomod.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+            z.writestr(f"profile-{me.replace(':', '_')}-cpu.txt", blob)
+            for addr, pb, err in peers:
+                name = f"profile-{addr.replace(':', '_')}-cpu"
+                if err is None:
+                    z.writestr(f"{name}.txt", pb)
+                else:
+                    z.writestr(f"{name}.ERROR.txt", err)
+        return web.Response(
+            body=buf.getvalue(), content_type="application/zip",
+            headers={"Content-Disposition":
+                     'attachment; filename="profile.zip"'})
+
     def _json(self, obj, status: int = 200) -> web.Response:
         return web.Response(status=status, body=json.dumps(obj).encode(),
                             content_type="application/json")
@@ -617,6 +735,26 @@ class AdminMixin:
                 # incl. per-target pending/failed/proxied counters
                 # (reference madmin ReplicationInfo / bucket-targets state)
                 info["replication"] = svcs.replication.stats.to_dict()
+        # per-server health fan-in (reference madmin InfoMessage.Servers
+        # via peer-rest ServerInfo)
+        peer_clients = getattr(self, "peer_clients", None)
+        if peer_clients:
+            me = getattr(self, "node_addr", "") or "local"
+            servers = [{"endpoint": me, "state": "online"}]
+
+            def probe(addr, client):
+                try:
+                    pi = client.call("peer.info", {})
+                    return {"endpoint": addr, "state": "online",
+                            "drives": len(pi.get("drives", []))}
+                except Exception:
+                    return {"endpoint": addr, "state": "offline"}
+
+            probes = await asyncio.gather(*[
+                self._run(probe, addr, c)
+                for addr, c in sorted(peer_clients.items())
+            ])
+            info["servers"] = servers + list(probes)
         return self._json(info)
 
     async def admin_storage_info(self, request: web.Request, body: bytes):

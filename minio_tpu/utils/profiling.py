@@ -1,0 +1,87 @@
+"""On-demand whole-process profiling for the admin plane.
+
+Reference: mc admin profile — StartProfiling/DownloadProfileData fan out
+pprof captures across peers (cmd/peer-rest-client.go:469-490,
+cmd/admin-handlers.go).  The Python-native equivalent here is a
+statistical sampler: a daemon thread snapshots every thread's stack via
+sys._current_frames() at a fixed rate and aggregates collapsed stacks
+("pkg.mod:fn;pkg.mod:fn2 <count>" lines, the flamegraph-collapsed
+format), which profiles ALL threads — executor pool, event loop,
+background services — without the per-call overhead or single-thread
+blindness of cProfile inside a threaded server.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import Counter
+
+
+class Sampler:
+    """One process-wide sampling profiler (start is idempotent-exclusive:
+    a second start while running fails)."""
+
+    def __init__(self, interval: float = 0.005):
+        self.interval = interval
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._stacks: Counter = Counter()
+        self._samples = 0
+        self._started_at = 0.0
+        self._lock = threading.Lock()
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> bool:
+        with self._lock:
+            if self.running:
+                return False
+            self._stop.clear()
+            self._stacks = Counter()
+            self._samples = 0
+            self._started_at = time.time()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="admin-profiler")
+            self._thread.start()
+            return True
+
+    def _loop(self) -> None:
+        me = threading.get_ident()
+        while not self._stop.wait(self.interval):
+            frames = sys._current_frames()
+            for tid, frame in frames.items():
+                if tid == me:
+                    continue
+                stack = []
+                f = frame
+                depth = 0
+                while f is not None and depth < 64:
+                    code = f.f_code
+                    stack.append(f"{code.co_filename.rsplit('/', 1)[-1]}"
+                                 f":{code.co_name}")
+                    f = f.f_back
+                    depth += 1
+                self._stacks[";".join(reversed(stack))] += 1
+                self._samples += 1
+
+    def stop(self) -> bytes:
+        """Stop and return the collapsed-stack report."""
+        with self._lock:
+            if self._thread is None:
+                return b""
+            self._stop.set()
+            self._thread.join(2)
+            self._thread = None
+            dur = time.time() - self._started_at
+            head = (f"# minio-tpu cpu profile: {self._samples} samples, "
+                    f"{dur:.1f}s, interval {self.interval * 1000:.1f}ms\n")
+            body = "".join(
+                f"{stack} {n}\n"
+                for stack, n in self._stacks.most_common()
+            )
+            return (head + body).encode()
+

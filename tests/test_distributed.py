@@ -369,3 +369,130 @@ def test_cluster_wide_trace(cluster):
     done.wait(10)
     assert lines, "node2's request never appeared in node1's trace stream"
     assert lines[0]["api"] == "make_bucket"
+
+
+def test_peer_shared_metacache(cluster, tmp_path):
+    """VERDICT r3 #8: a listing cache persisted by one node serves
+    another node's continuation with ZERO drive walks — the cache blocks
+    live on the shared (cross-node RPC) drives (reference peers reuse
+    each other's metacache, cmd/peer-rest-client.go:722
+    GetMetacacheListing)."""
+    import io as iomod
+
+    from minio_tpu.erasure import listing, metacache
+
+    n1, n2 = cluster
+    api1, api2 = n1.pools, n2.pools
+    api1.make_bucket("mcb")
+    for i in range(40):
+        api1.put_object("mcb", f"obj-{i:03d}", iomod.BytesIO(b"x"), 1)
+
+    # node 1 serves page 1 (truncated) -> saves the name stream
+    page1 = listing.list_objects(api1, "mcb", max_keys=10)
+    assert page1.is_truncated
+    marker = page1.next_marker
+
+    # node 2's first listing of the warm bucket: the continuation must be
+    # served from the persisted cache — wedge its walk to prove no drive
+    # walk happens
+    def boom(*a, **kw):
+        raise AssertionError("node2 walked the drives for a cached page")
+
+    orig = api2.list_entries
+    api2.list_entries = boom
+    try:
+        page2 = listing.list_objects(api2, "mcb", marker=marker,
+                                     max_keys=10)
+    finally:
+        api2.list_entries = orig
+    names = [e.name for e in page2.entries]
+    assert names == [f"obj-{i:03d}" for i in range(10, 20)]
+
+
+def test_cluster_wide_profiling(cluster):
+    """VERDICT r3 #8: admin profiling start/stop fans out to every node
+    and the download is a zip with one capture per node (reference
+    StartProfiling/DownloadProfileData,
+    cmd/peer-rest-client.go:469-490)."""
+    import http.client
+    import io as iomod
+    import json as json_mod
+    import zipfile
+
+    from minio_tpu.server import sigv4
+
+    n1, n2 = cluster
+    n1_addr = n2.s3.peer_trace_addrs[0]
+
+    def post(path, q=()):
+        q = list(q)
+        h = sigv4.sign_request("POST", path, q, {"host": n1_addr}, b"",
+                               "minioadmin", "minioadmin")
+        conn = http.client.HTTPConnection(*n1_addr.split(":"), timeout=30)
+        qs = "&".join(f"{k}={v}" for k, v in q)
+        conn.request("POST", f"{path}?{qs}" if qs else path, headers=h)
+        resp = conn.getresponse()
+        body = resp.read()
+        conn.close()
+        return resp.status, body
+
+    status, body = post("/minio/admin/v3/profiling/start",
+                        [("profilerType", "cpu")])
+    assert status == 200, body
+    results = json_mod.loads(body)
+    assert len(results) == 2 and all(r["success"] for r in results), results
+
+    # generate some work on both nodes while the samplers run
+    import io as io2
+    n1.pools.make_bucket("profb")
+    for i in range(10):
+        n1.pools.put_object("profb", f"o{i}", io2.BytesIO(b"x" * 40960),
+                            40960)
+    time.sleep(0.3)
+
+    status, body = post("/minio/admin/v3/profiling/stop")
+    assert status == 200
+    z = zipfile.ZipFile(iomod.BytesIO(body))
+    names = z.namelist()
+    assert len(names) == 2, names
+    assert not any("ERROR" in n for n in names), names
+    for n in names:
+        blob = z.read(n)
+        # EVERY node produced a real capture (per-instance samplers, not
+        # a process singleton) with actual stack frames
+        assert blob.startswith(b"# minio-tpu cpu profile"), (n, blob[:60])
+        assert b";" in blob and b":" in blob, n
+
+    # double start: the running profiler on each node reports failure,
+    # and the coordinator honors the peer's JSON verdict (not just HTTP
+    # 200)
+    post("/minio/admin/v3/profiling/start")
+    status, body = post("/minio/admin/v3/profiling/start")
+    results = json_mod.loads(body)
+    assert len(results) == 2
+    assert all(r["success"] is False for r in results), results
+    post("/minio/admin/v3/profiling/stop")
+
+
+def test_admin_info_server_fanin(cluster):
+    """Admin info lists every server with online state (reference madmin
+    InfoMessage.Servers via peer ServerInfo RPC)."""
+    import http.client
+    import json as json_mod
+
+    from minio_tpu.server import sigv4
+
+    n1, n2 = cluster
+    n1_addr = n2.s3.peer_trace_addrs[0]
+    path = "/minio/admin/v3/info"
+    h = sigv4.sign_request("GET", path, [], {"host": n1_addr}, b"",
+                           "minioadmin", "minioadmin")
+    conn = http.client.HTTPConnection(*n1_addr.split(":"), timeout=10)
+    conn.request("GET", path, headers=h)
+    resp = conn.getresponse()
+    info = json_mod.loads(resp.read())
+    conn.close()
+    servers = info.get("servers", [])
+    assert len(servers) == 2, servers
+    assert all(s["state"] == "online" for s in servers), servers
+    assert any(s.get("drives") == 3 for s in servers), servers
