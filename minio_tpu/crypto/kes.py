@@ -18,11 +18,23 @@ from __future__ import annotations
 
 import base64
 import json
+import re
 import threading
 import urllib.error
+import urllib.parse
 import urllib.request
 
 from .kms import KMSError
+
+_KEY_NAME_RE = re.compile(r"^[a-zA-Z0-9_.-]{1,256}$")
+
+
+def _check_key_name(name: str) -> str:
+    """Key names are path components of the KES URL: reject anything
+    that could alter the request path ('/', '..', empty)."""
+    if not _KEY_NAME_RE.fullmatch(name or "") or set(name) == {"."}:
+        raise KMSError(f"invalid KES key name {name!r}")
+    return name
 
 
 class KESClient:
@@ -33,7 +45,7 @@ class KESClient:
     def __init__(self, endpoint: str, key_name: str, api_key: str = "",
                  timeout: float = 5.0):
         self.endpoint = endpoint.rstrip("/")
-        self._default = key_name
+        self._default = _check_key_name(key_name)
         self.api_key = api_key
         self.timeout = timeout
         self._lock = threading.Lock()
@@ -64,6 +76,7 @@ class KESClient:
             return self._default
 
     def create_key(self, name: str) -> None:
+        name = urllib.parse.quote(_check_key_name(name), safe="")
         self._post(f"/v1/key/create/{name}", None)
 
     def rotate(self, new_name: str) -> None:
@@ -77,7 +90,7 @@ class KESClient:
     # ---------------------------------------------------- SSE-facing surface
     def generate_key(self, context: str) -> tuple[bytes, bytes]:
         """(plaintext 256-bit data key, sealed envelope)."""
-        name = self.key_id
+        name = urllib.parse.quote(self.key_id, safe="")
         out = json.loads(self._post(
             f"/v1/key/generate/{name}",
             {"context": base64.b64encode(context.encode()).decode()},
@@ -92,6 +105,7 @@ class KESClient:
             name, ct = env["key"], env["ct"]
         except (ValueError, KeyError, TypeError):
             raise KMSError("malformed KES key envelope")
+        name = urllib.parse.quote(_check_key_name(name), safe="")
         out = json.loads(self._post(
             f"/v1/key/decrypt/{name}",
             {"ciphertext": ct,

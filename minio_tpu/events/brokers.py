@@ -242,11 +242,50 @@ def _kbytes(b: bytes | None) -> bytes:
     return struct.pack(">i", len(b)) + b
 
 
+def _crc32c_table() -> list[int]:
+    poly = 0x82F63B78  # Castagnoli, reflected
+    table = []
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ poly if c & 1 else c >> 1
+        table.append(c)
+    return table
+
+
+_CRC32C = _crc32c_table()
+
+
+def _crc32c(data: bytes) -> int:
+    """Record-batch v2 checksums use CRC-32C (Castagnoli), not the IEEE
+    polynomial zlib provides."""
+    crc = 0xFFFFFFFF
+    tab = _CRC32C
+    for b in data:
+        crc = tab[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _kvarint(n: int) -> bytes:
+    """Zigzag varint (Kafka record fields)."""
+    z = (n << 1) ^ (n >> 63)
+    out = bytearray()
+    while True:
+        b = z & 0x7F
+        z >>= 7
+        out.append(b | (0x80 if z else 0))
+        if not z:
+            return bytes(out)
+
+
 class KafkaTarget(_SocketTarget):
-    """Minimal produce-only Kafka client: Produce v2 requests carrying a
-    message-set v1 (crc/magic/attrs/timestamp/key/value) to one
-    topic-partition, acks=1, response error-code checked — the
-    delivery semantics of the reference's sarama SyncProducer
+    """Produce-only Kafka client with version negotiation: an
+    ApiVersions request at handshake picks Produce v3+ with
+    record-batch v2 encoding (required by Kafka 4.x brokers, which
+    dropped the old message format per KIP-724 and pre-2.1 API versions
+    per KIP-896) or falls back to Produce v2 + message-set v1 for old
+    brokers; acks=1, response error-code checked — the delivery
+    semantics of the reference's sarama SyncProducer
     (internal/event/target/kafka.go:238)."""
 
     kind = "kafka"
@@ -258,41 +297,100 @@ class KafkaTarget(_SocketTarget):
         self.topic = topic
         self.partition = partition
         self._corr = 0
+        self._produce_ver = 3
 
-    def _publish(self, sock: socket.socket, log: dict) -> None:
-        value = json.dumps(log).encode()
-        key = log.get("Key", "").encode() or None
-        # message v1: crc | magic=1 | attrs=0 | timestamp | key | value
-        ts = int(time.time() * 1000)
-        tail = bytes([1, 0]) + struct.pack(">q", ts) + _kbytes(key) + _kbytes(value)
-        msg = struct.pack(">I", zlib.crc32(tail)) + tail
-        msgset = struct.pack(">q", 0) + struct.pack(">i", len(msg)) + msg
-        body = (
-            struct.pack(">h", 1)            # acks = leader
-            + struct.pack(">i", int(self.timeout * 1000))
-            + struct.pack(">i", 1) + _kstr(self.topic)
-            + struct.pack(">i", 1) + struct.pack(">i", self.partition)
-            + struct.pack(">i", len(msgset)) + msgset
-        )
+    def _roundtrip(self, sock: socket.socket, api_key: int, version: int,
+                   body: bytes) -> bytes:
         self._corr += 1
-        hdr = (struct.pack(">hh", 0, 2)     # api_key=Produce, version=2
+        hdr = (struct.pack(">hh", api_key, version)
                + struct.pack(">i", self._corr) + _kstr("minio-tpu"))
         sock.sendall(struct.pack(">i", len(hdr) + len(body)) + hdr + body)
-
         rlen = struct.unpack(">i", _recv_exact(sock, 4))[0]
         resp = _recv_exact(sock, rlen)
         corr = struct.unpack(">i", resp[:4])[0]
         if corr != self._corr:
             raise TargetError(f"kafka correlation mismatch {corr}")
-        # response v2: [topic [partition err base_offset log_append_time]] throttle
-        off = 4
+        return resp[4:]
+
+    def _handshake(self, sock: socket.socket) -> None:
+        # ApiVersions v0 (non-flexible; understood by every broker since
+        # 0.10). Brokers answer even unsupported-version requests with
+        # error 35 rather than closing, so this is safe to always send.
+        resp = self._roundtrip(sock, 18, 0, b"")
+        err = struct.unpack(">h", resp[:2])[0]
+        if err != 0:
+            raise TargetError(f"kafka ApiVersions error code {err}")
+        n = struct.unpack(">i", resp[2:6])[0]
+        produce_range = None
+        off = 6
+        for _ in range(n):
+            k, lo, hi = struct.unpack(">hhh", resp[off:off + 6])
+            off += 6
+            if k == 0:
+                produce_range = (lo, hi)
+        if produce_range is None:
+            raise TargetError("kafka broker advertises no Produce API")
+        lo, hi = produce_range
+        if hi >= 3:
+            self._produce_ver = min(hi, 8)
+        elif lo <= 2 <= hi:
+            self._produce_ver = 2
+        else:
+            raise TargetError(
+                f"kafka broker Produce versions [{lo},{hi}] unsupported "
+                "(need v2, or v3+ for record batches)")
+
+    def _record_batch(self, key: bytes | None, value: bytes, ts: int) -> bytes:
+        # record: len | attrs | ts_delta | off_delta | key | value | headers
+        rec = (bytes([0]) + _kvarint(0) + _kvarint(0)
+               + (_kvarint(-1) if key is None
+                  else _kvarint(len(key)) + key)
+               + _kvarint(len(value)) + value + _kvarint(0))
+        rec = _kvarint(len(rec)) + rec
+        # batch tail (crc'd): attrs | lastOffsetDelta | baseTs | maxTs |
+        # producerId | producerEpoch | baseSeq | count | records
+        tail = (struct.pack(">hiqqqhii", 0, 0, ts, ts, -1, -1, -1, 1) + rec)
+        # batchLength counts from partitionLeaderEpoch onward; crc covers
+        # everything after the crc field itself
+        inner = struct.pack(">i", -1) + bytes([2]) \
+            + struct.pack(">I", _crc32c(tail)) + tail
+        return struct.pack(">q", 0) + struct.pack(">i", len(inner)) + inner
+
+    def _message_set(self, key: bytes | None, value: bytes, ts: int) -> bytes:
+        # legacy message v1: crc | magic=1 | attrs=0 | timestamp | key | value
+        tail = bytes([1, 0]) + struct.pack(">q", ts) + _kbytes(key) + _kbytes(value)
+        msg = struct.pack(">I", zlib.crc32(tail)) + tail
+        return struct.pack(">q", 0) + struct.pack(">i", len(msg)) + msg
+
+    def _publish(self, sock: socket.socket, log: dict) -> None:
+        value = json.dumps(log).encode()
+        key = log.get("Key", "").encode() or None
+        ts = int(time.time() * 1000)
+        ver = self._produce_ver
+        if ver >= 3:
+            records = self._record_batch(key, value, ts)
+        else:
+            records = self._message_set(key, value, ts)
+        body = (
+            struct.pack(">h", 1)            # acks = leader
+            + struct.pack(">i", int(self.timeout * 1000))
+            + struct.pack(">i", 1) + _kstr(self.topic)
+            + struct.pack(">i", 1) + struct.pack(">i", self.partition)
+            + struct.pack(">i", len(records)) + records
+        )
+        if ver >= 3:
+            body = struct.pack(">h", -1) + body   # transactional_id = null
+        resp = self._roundtrip(sock, 0, ver, body)
+        # response v2..v8: [topic [partition err base_offset
+        #   log_append_time (v5+: log_start_offset)]] throttle
+        off = 0
         ntopics = struct.unpack(">i", resp[off:off + 4])[0]; off += 4
         for _ in range(ntopics):
             tlen = struct.unpack(">h", resp[off:off + 2])[0]; off += 2 + tlen
             nparts = struct.unpack(">i", resp[off:off + 4])[0]; off += 4
             for _ in range(nparts):
                 _, err = struct.unpack(">ih", resp[off:off + 6])
-                off += 4 + 2 + 8 + 8
+                off += 4 + 2 + 8 + 8 + (8 if ver >= 5 else 0)
                 if err != 0:
                     raise TargetError(f"kafka produce error code {err}")
 

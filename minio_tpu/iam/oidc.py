@@ -141,7 +141,9 @@ class OpenIDProvider:
             raise OIDCError("JWT signature verification failed")
         now = time.time()
         exp = claims.get("exp")
-        if not isinstance(exp, (int, float)) or now > exp:
+        # symmetric 60 s leeway with the nbf check below: minor IdP/server
+        # clock drift must not flip valid tokens to AccessDenied
+        if not isinstance(exp, (int, float)) or now > exp + 60:
             raise OIDCError("token expired or missing exp")
         nbf = claims.get("nbf")
         if isinstance(nbf, (int, float)) and now < nbf - 60:

@@ -626,6 +626,12 @@ class AdminMixin:
         ?local=true) every peer concurrently (reference StartProfiling
         fan-out)."""
         ptype = request.rel_url.query.get("profilerType", "cpu")
+        if ptype not in ("cpu", ""):
+            # Only the sampling CPU profiler exists; silently returning
+            # CPU data under a mem/block/... name would be misleading.
+            return web.json_response(
+                {"error": f"unsupported profilerType {ptype!r} (cpu only)"},
+                status=400)
         local_only = request.rel_url.query.get("local", "") in ("true", "1")
         ok = await self._run(self._profiler().start)
         me = getattr(self, "node_addr", "") or "local"

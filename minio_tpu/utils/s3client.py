@@ -124,6 +124,19 @@ class S3Client:
             conn.close()
             raise S3ClientError(resp.status, data)
 
+        if resp.status in (204, 304, 412):
+            # No useful body (conditional-GET short-circuit): close the
+            # connection now rather than relying on the caller to start
+            # and close a generator — generator.close() on a
+            # never-started generator skips its finally block.
+            resp.read()
+            conn.close()
+            if with_headers:
+                rh = {k.lower(): v for k, v in resp.getheaders()}
+                rh[":status"] = str(resp.status)
+                return rh, iter(())
+            return iter(())
+
         def chunks():
             try:
                 while True:

@@ -218,16 +218,44 @@ class TestRedis:
 
 
 # ---------------------------------------------------------------------- Kafka
-def _kafka_broker(broker, sock):
+def _kvarint_read(buf, p):
+    shift = z = 0
+    while True:
+        b = buf[p]
+        p += 1
+        z |= (b & 0x7F) << shift
+        shift += 7
+        if not b & 0x80:
+            return (z >> 1) ^ -(z & 1), p
+
+
+def _kafka_broker(broker, sock, produce_range=(0, 9)):
+    """Fake broker: answers ApiVersions with `produce_range` for the
+    Produce API, then accepts Produce v2 (message-set v1) or v3+
+    (record-batch v2, CRC32C-checked) accordingly."""
+    from minio_tpu.events.brokers import _crc32c
+
     try:
         while True:
             rlen = struct.unpack(">i", _read_exact(sock, 4))[0]
             req = _read_exact(sock, rlen)
             api_key, api_ver, corr = struct.unpack(">hhi", req[:8])
-            assert api_key == 0 and api_ver == 2
             off = 8
             cid_len = struct.unpack(">h", req[off:off + 2])[0]
             off += 2 + cid_len
+            if api_key == 18:  # ApiVersions
+                body = (struct.pack(">h", 0) + struct.pack(">i", 2) +
+                        struct.pack(">hhh", 0, *produce_range) +
+                        struct.pack(">hhh", 18, 0, 3))
+                resp = struct.pack(">i", corr) + body
+                sock.sendall(struct.pack(">i", len(resp)) + resp)
+                continue
+            assert api_key == 0
+            lo, hi = produce_range
+            assert lo <= api_ver <= hi, f"produce v{api_ver} out of range"
+            if api_ver >= 3:
+                txn_len = struct.unpack(">h", req[off:off + 2])[0]
+                off += 2 + max(txn_len, 0)
             off += 2 + 4  # acks, timeout
             off += 4      # topic array len (=1)
             tlen = struct.unpack(">h", req[off:off + 2])[0]
@@ -238,19 +266,40 @@ def _kafka_broker(broker, sock):
             off += 4
             mslen = struct.unpack(">i", req[off:off + 4])[0]
             msgset = req[off + 4:off + 4 + mslen]
-            # messageset v1: offset(8) size(4) crc(4) magic(1) attrs(1) ts(8) key value
-            p = 8 + 4 + 4
-            assert msgset[p] == 1  # magic v1
-            p += 1 + 1 + 8
-            klen = struct.unpack(">i", msgset[p:p + 4])[0]
-            p += 4 + max(klen, 0)
-            vlen = struct.unpack(">i", msgset[p:p + 4])[0]
-            value = msgset[p + 4:p + 4 + vlen]
+            if api_ver >= 3:
+                # record batch v2: baseOffset(8) batchLen(4) leaderEpoch(4)
+                # magic(1) crc(4) | attrs(2) lastOffDelta(4) baseTs(8)
+                # maxTs(8) pid(8) pepoch(2) baseSeq(4) count(4) records
+                assert msgset[16] == 2  # magic v2
+                crc = struct.unpack(">I", msgset[17:21])[0]
+                assert crc == _crc32c(msgset[21:]), "record batch crc32c"
+                p = 21 + 2 + 4 + 8 + 8 + 8 + 2 + 4
+                count = struct.unpack(">i", msgset[p:p + 4])[0]
+                assert count == 1
+                p += 4
+                _, p = _kvarint_read(msgset, p)   # record length
+                p += 1                             # attrs
+                _, p = _kvarint_read(msgset, p)   # ts delta
+                _, p = _kvarint_read(msgset, p)   # offset delta
+                klen, p = _kvarint_read(msgset, p)
+                p += max(klen, 0)
+                vlen, p = _kvarint_read(msgset, p)
+                value = msgset[p:p + vlen]
+            else:
+                # messageset v1: offset(8) size(4) crc(4) magic(1) attrs(1)
+                # ts(8) key value
+                p = 8 + 4 + 4
+                assert msgset[p] == 1  # magic v1
+                p += 1 + 1 + 8
+                klen = struct.unpack(">i", msgset[p:p + 4])[0]
+                p += 4 + max(klen, 0)
+                vlen = struct.unpack(">i", msgset[p:p + 4])[0]
+                value = msgset[p + 4:p + 4 + vlen]
             broker.received.append(value)
-            # produce response v2
             body = (struct.pack(">i", 1) + struct.pack(">h", tlen) +
                     topic.encode() + struct.pack(">i", 1) +
                     struct.pack(">ihqq", partition, 0, 0, -1) +
+                    (struct.pack(">q", 0) if api_ver >= 5 else b"") +
                     struct.pack(">i", 0))
             resp = struct.pack(">i", corr) + body
             sock.sendall(struct.pack(">i", len(resp)) + resp)
@@ -259,29 +308,65 @@ def _kafka_broker(broker, sock):
 
 
 class TestKafka:
-    def test_produce(self):
+    def test_produce_record_batch_v2(self):
+        """Modern broker: ApiVersions negotiates Produce v3+, events
+        arrive as CRC32C-checked record batches."""
         broker = _FakeBroker(_kafka_broker)
         try:
             t = KafkaTarget("k1", "127.0.0.1", broker.port, "minio-events")
             t.send({"EventName": "s3:ObjectCreated:Put", "Key": "b/k"})
             broker.wait(1)
             assert json.loads(broker.received[0])["Key"] == "b/k"
+            assert t._produce_ver >= 3
             t.close()
+        finally:
+            broker.close()
+
+    def test_produce_legacy_fallback(self):
+        """Old broker (Produce max v2): falls back to message-set v1."""
+        broker = _FakeBroker(
+            lambda b, s: _kafka_broker(b, s, produce_range=(0, 2)))
+        try:
+            t = KafkaTarget("k1", "127.0.0.1", broker.port, "minio-events")
+            t.send({"Key": "b/legacy"})
+            broker.wait(1)
+            assert json.loads(broker.received[0])["Key"] == "b/legacy"
+            assert t._produce_ver == 2
+        finally:
+            broker.close()
+
+    def test_unsupported_broker_is_explicit(self):
+        """KIP-896 broker that dropped v≤2 AND a client that can't speak
+        its floor gets a clear handshake error, not a protocol crash."""
+        broker = _FakeBroker(
+            lambda b, s: _kafka_broker(b, s, produce_range=(0, 1)))
+        try:
+            t = KafkaTarget("k1", "127.0.0.1", broker.port, "t")
+            with pytest.raises(TargetError, match="unsupported"):
+                t.send({"Key": "x"})
         finally:
             broker.close()
 
     def test_error_code_raises(self):
         def bad_broker(broker, sock):
             try:
-                rlen = struct.unpack(">i", _read_exact(sock, 4))[0]
-                req = _read_exact(sock, rlen)
-                corr = struct.unpack(">i", req[4:8])[0]
-                body = (struct.pack(">i", 1) + struct.pack(">h", 1) + b"t" +
-                        struct.pack(">i", 1) +
-                        struct.pack(">ihqq", 0, 3, 0, -1) +  # err 3
-                        struct.pack(">i", 0))
-                resp = struct.pack(">i", corr) + body
-                sock.sendall(struct.pack(">i", len(resp)) + resp)
+                while True:
+                    rlen = struct.unpack(">i", _read_exact(sock, 4))[0]
+                    req = _read_exact(sock, rlen)
+                    api_key, _, corr = struct.unpack(">hhi", req[:8])
+                    if api_key == 18:
+                        body = (struct.pack(">h", 0) + struct.pack(">i", 1) +
+                                struct.pack(">hhh", 0, 0, 9))
+                        resp = struct.pack(">i", corr) + body
+                        sock.sendall(struct.pack(">i", len(resp)) + resp)
+                        continue
+                    body = (struct.pack(">i", 1) + struct.pack(">h", 1) +
+                            b"t" + struct.pack(">i", 1) +
+                            struct.pack(">ihqq", 0, 3, 0, -1) +  # err 3
+                            struct.pack(">q", 0) +
+                            struct.pack(">i", 0))
+                    resp = struct.pack(">i", corr) + body
+                    sock.sendall(struct.pack(">i", len(resp)) + resp)
             except (ConnectionError, OSError):
                 return
 

@@ -110,7 +110,8 @@ class TestOpenIDProvider:
     def test_expired(self, idp):
         p = OpenIDProvider(idp.jwks_url)
         with pytest.raises(OIDCError, match="expired"):
-            p.validate(idp.mint(self._claims(exp=time.time() - 10)))
+            # beyond the 60 s clock-skew leeway
+            p.validate(idp.mint(self._claims(exp=time.time() - 120)))
 
     def test_audience_mismatch(self, idp):
         p = OpenIDProvider(idp.jwks_url, client_id="expected")
@@ -195,7 +196,7 @@ class TestWebIdentitySTS:
                        corrupt_sig=True)
         assert self._exchange(srv, bad).status == 403
         expired = idp.mint({"sub": "x", "aud": "minio-tpu",
-                            "exp": time.time() - 5, "policy": "readwrite"})
+                            "exp": time.time() - 120, "policy": "readwrite"})
         assert self._exchange(srv, expired).status == 403
 
     def test_unmapped_policy_rejected(self, srv, idp):
@@ -302,6 +303,27 @@ class FakeKES:
 
 
 class TestKESClient:
+    def test_key_names_cannot_alter_request_path(self):
+        """Names with '/', '..', or empty must be rejected before they
+        are interpolated into the KES URL path (advisor r3)."""
+        kes = FakeKES()
+        try:
+            c = KESClient(kes.endpoint, "master-1")
+            for bad in ("a/b", "../x", "", "a b", "x" * 300, ".", ".."):
+                with pytest.raises(KMSError, match="invalid KES key name"):
+                    c.create_key(bad)
+                with pytest.raises(KMSError, match="invalid KES key name"):
+                    c.rotate(bad)
+            with pytest.raises(KMSError):
+                KESClient(kes.endpoint, "evil/../name")
+            # a sealed envelope naming a path-traversal key is rejected
+            # at unseal time, not sent to the server
+            sealed = json.dumps({"key": "../sys", "ct": "AAAA"}).encode()
+            with pytest.raises(KMSError, match="invalid KES key name"):
+                c.decrypt_key(sealed, "ctx")
+        finally:
+            kes.close()
+
     def test_generate_decrypt_roundtrip(self):
         kes = FakeKES()
         try:
