@@ -93,44 +93,60 @@ class ClusterNode:
                  start_services: bool = True,
                  scan_interval: float = 60.0, heal_interval: float = 3600.0):
         self.secret = secret_key
-        expanded: list[tuple[str | None, int | None, str]] = []
-        for ep in endpoints:
-            for e in expand_ellipses(ep):
-                expanded.append(parse_endpoint(e))
+        # pool grouping (cmd/endpoint-ellipses.go:341
+        # createServerEndpoints): args without any ellipses form ONE pool
+        # (legacy form); when ellipses are present, each arg is its own
+        # server pool (`minio server pool1{1...4} pool2{1...4}`)
+        if any(re.search(r"\{\d+\.\.\.\d+\}", ep) for ep in endpoints):
+            pool_args = [[ep] for ep in endpoints]
+        else:
+            pool_args = [list(endpoints)]
+        pool_specs: list[list[tuple[str | None, int | None, str]]] = []
+        for group in pool_args:
+            expanded: list[tuple[str | None, int | None, str]] = []
+            for ep in group:
+                for e in expand_ellipses(ep):
+                    expanded.append(parse_endpoint(e))
+            pool_specs.append(expanded)
         my_host, my_port = None, None
         if my_address:
             h, p = my_address.rsplit(":", 1)
             my_host, my_port = h, int(p)
 
         # deterministic deployment id so all nodes agree without consensus
+        all_eps = [ep for spec in pool_specs for ep in spec]
         dep_id = str(uuid.UUID(bytes=hashlib.md5(
-            ",".join(f"{h}:{p}{path}" for h, p, path in expanded).encode()
+            ",".join(f"{h}:{p}{path}" for h, p, path in all_eps).encode()
         ).digest()))
 
         self.local_drives: dict[str, LocalStorage] = {}
         self.peer_clients: dict[str, RpcClient] = {}
-        disks = []
+        pool_disks: list[list] = []
         n_nodes = set()
         local_addrs = _local_host_addrs()
-        for host, port, path in expanded:
-            is_local = host is None or (
-                port == my_port and _host_is_me(host, my_host, local_addrs)
-            )
-            n_nodes.add((host, port))
-            if is_local:
-                d = LocalStorage(path, endpoint=f"{host}:{port}{path}"
-                                 if host else path)
-                self.local_drives[path] = d
-                # the object layer sees the instrumented view (per-op
-                # counters + EWMA latency, reference xlStorageDiskIDCheck)
-                disks.append(InstrumentedStorage(d))
-            else:
-                key = f"{host}:{port}"
-                client = self.peer_clients.get(key)
-                if client is None:
-                    client = RpcClient(host, port, secret_key)
-                    self.peer_clients[key] = client
-                disks.append(InstrumentedStorage(RemoteStorage(client, path)))
+        for spec in pool_specs:
+            disks = []
+            for host, port, path in spec:
+                is_local = host is None or (
+                    port == my_port and _host_is_me(host, my_host, local_addrs)
+                )
+                n_nodes.add((host, port))
+                if is_local:
+                    d = LocalStorage(path, endpoint=f"{host}:{port}{path}"
+                                     if host else path)
+                    self.local_drives[path] = d
+                    # the object layer sees the instrumented view (per-op
+                    # counters + EWMA latency, reference xlStorageDiskIDCheck)
+                    disks.append(InstrumentedStorage(d))
+                else:
+                    key = f"{host}:{port}"
+                    client = self.peer_clients.get(key)
+                    if client is None:
+                        client = RpcClient(host, port, secret_key)
+                        self.peer_clients[key] = client
+                    disks.append(
+                        InstrumentedStorage(RemoteStorage(client, path)))
+            pool_disks.append(disks)
 
         self.locker = LocalLocker()
         self.distributed = len(n_nodes) > 1
@@ -143,9 +159,11 @@ class ClusterNode:
         else:
             ns_lock = None
 
-        sets = ErasureSets(disks, set_size=set_size, deployment_id=dep_id,
-                           ns_lock=ns_lock)
-        self.pools = ErasureServerPools([sets])
+        self.pools = ErasureServerPools([
+            ErasureSets(disks, set_size=set_size, deployment_id=dep_id,
+                        ns_lock=ns_lock, pool_index=i)
+            for i, disks in enumerate(pool_disks)
+        ])
 
         self.s3 = S3Server(self.pools, access_key=access_key,
                            secret_key=secret_key, region=region)

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import io
 import json
-
+import random
 import uuid
 from dataclasses import dataclass, field
 from typing import Iterator, Sequence
@@ -462,8 +462,57 @@ class ErasureServerPools:
                 return p
         return None
 
-    def _pool_for_new(self) -> ErasureSets:
-        return max(self.pools, key=lambda p: p.free_space())
+    # per-drive free-space floor a PUT may not dip under (reference
+    # diskMinFreeSpace, internal/disk/disk.go)
+    MIN_FREE = 1 << 20
+
+    def _pool_available(self, obj: str, size: int) -> list[int]:
+        """Available bytes per pool on the set `obj` hashes to, 0 when the
+        pool cannot hold `size` more bytes
+        (cmd/erasure-server-pool.go:241 getServerPoolsAvailableSpace)."""
+        out = []
+        for p in self.pools:
+            s = p.get_hashed_set(obj)
+            infos = []
+            for d in s.disks:
+                try:
+                    if d is not None and d.is_online():
+                        infos.append(d.disk_info())
+                except errors.StorageError:
+                    pass
+                except Exception:
+                    pass
+            if not infos:
+                out.append(0)
+                continue
+            # an erasure write lands ~size/K bytes on every drive of the
+            # set; every reporting drive must fit that with MIN_FREE left
+            k = max(len(s.disks) - s.default_parity, 1)
+            per_drive = (max(size, 0) + k - 1) // k
+            if any(i.free < per_drive + self.MIN_FREE for i in infos):
+                out.append(0)
+                continue
+            out.append(sum(max(i.total - i.used, 0) for i in infos))
+        return out
+
+    def _pool_for_new(self, obj: str = "", size: int = 0) -> ErasureSets:
+        """Weighted-random pool choice by available space, so pools fill
+        proportionally and a full pool is never picked
+        (cmd/erasure-server-pool.go:222 getAvailablePoolIdx)."""
+        if len(self.pools) == 1:
+            return self.pools[0]
+        avail = self._pool_available(obj, size)
+        total = sum(avail)
+        if total == 0:
+            raise errors.DiskFull(
+                f"no pool has space for {size} more bytes")
+        choose = random.randrange(total)
+        at = 0
+        for p, a in zip(self.pools, avail):
+            at += a
+            if at > choose and a > 0:
+                return p
+        return max(zip(self.pools, avail), key=lambda t: t[1])[0]
 
     # -- object ops ---------------------------------------------------------
     def put_object(self, bucket, obj, reader, size=-1, opts=None) -> ObjectInfo:
@@ -471,7 +520,7 @@ class ErasureServerPools:
             raise errors.BucketNotFound(bucket)
         pool = self._pool_of(bucket, obj) if len(self.pools) > 1 else self.pools[0]
         if pool is None:
-            pool = self._pool_for_new()
+            pool = self._pool_for_new(obj, max(size, 0))
         return pool.put_object(bucket, obj, reader, size, opts)
 
     def get_object(self, bucket, obj, offset=0, length=-1, version_id=""):
@@ -608,7 +657,7 @@ class ErasureServerPools:
     def new_multipart_upload(self, bucket, obj, opts=None) -> str:
         if not self.bucket_exists(bucket):
             raise errors.BucketNotFound(bucket)
-        pool = self._pool_of(bucket, obj) or self._pool_for_new()
+        pool = self._pool_of(bucket, obj) or self._pool_for_new(obj)
         return pool.new_multipart_upload(bucket, obj, opts)
 
     def _pool_with_upload(self, bucket, obj, upload_id) -> ErasureSets:

@@ -8,9 +8,16 @@ peers over the storage RPC plane and locking via dsync:
     # single node, 8 drives
     python -m minio_tpu.server /data/d{1...8}
 
-    # 2 nodes x 4 drives (run on each host with the same arguments)
+    # 2 nodes x 4 drives, one pool (run on each host with the same args)
     python -m minio_tpu.server --address 0.0.0.0:9000 \\
-        http://node1:9000/data/d{1...4} http://node2:9000/data/d{1...4}
+        http://node{1...2}:9000/data/d{1...4}
+
+Multiple ellipses arguments define multiple server pools (reference
+cmd/endpoint-ellipses.go:341 — each arg is a pool; placement picks a
+pool by available space, reads/listing/deletes span all pools):
+
+    # expand an existing deployment with a second pool
+    python -m minio_tpu.server /data/pool1/d{1...8} /data/pool2/d{1...8}
 """
 
 from __future__ import annotations
@@ -109,11 +116,13 @@ def main(argv=None) -> int:
         scan_interval=args.scan_interval,
         heal_interval=args.heal_interval,
     )
-    info = node.pools.storage_info()["pools"][0]
+    pools_info = node.pools.storage_info()["pools"]
     mode = "distributed" if node.distributed else "standalone"
+    layout = " + ".join(
+        f"{i['sets']}x{i['drives_per_set']}" for i in pools_info)
     print(
         f"minio-tpu: {mode}, {len(node.local_drives)} local drives, "
-        f"{info['sets']} sets x {info['drives_per_set']} drives total, "
+        f"{len(pools_info)} pool(s) [{layout} drives], "
         f"S3 on http://{args.address}", file=sys.stderr,
     )
     if node.distributed:

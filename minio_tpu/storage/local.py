@@ -112,13 +112,21 @@ def _clean(path: str) -> str:
 
 
 class LocalStorage(StorageAPI):
-    def __init__(self, root: str, endpoint: str = ""):
+    def __init__(self, root: str, endpoint: str = "", quota: int | None = None):
         self.root = os.path.abspath(root)
         self._endpoint = endpoint or self.root
         self._disk_id = ""
         # staged files written unsynced (append_file) pending a commit sync
         self._unsynced: set[str] = set()
         self._lock = threading.Lock()
+        # optional per-drive capacity cap: disk_info reports
+        # total=quota / free=quota-used so pool placement (weighted by
+        # available space, cmd/erasure-server-pool.go:222) works on
+        # shared filesystems where statvfs can't tell drives apart
+        if quota is None:
+            quota = int(os.environ.get("MINIO_TPU_DRIVE_QUOTA", "0") or 0)
+        self._quota = max(quota, 0)
+        self._du_cache: tuple[float, int] = (0.0, 0)
         os.makedirs(self.root, exist_ok=True)
         os.makedirs(os.path.join(self.root, SYSTEM_VOL, TMP_DIR), exist_ok=True)
 
@@ -135,10 +143,33 @@ class LocalStorage(StorageAPI):
     def endpoint(self) -> str:
         return self._endpoint
 
+    def _used_bytes(self) -> int:
+        """Bytes stored under this drive root (0.5 s TTL cache: the pool
+        placement probe hits this on every PUT)."""
+        now = time.monotonic()
+        ts, used = self._du_cache
+        if now - ts < 0.5:
+            return used
+        used = 0
+        for dirpath, _, files in os.walk(self.root):
+            for f in files:
+                try:
+                    used += os.lstat(os.path.join(dirpath, f)).st_size
+                except OSError:
+                    pass
+        self._du_cache = (now, used)
+        return used
+
     def disk_info(self) -> DiskInfo:
         st = shutil.disk_usage(self.root)
+        total, free, used = st.total, st.free, st.used
+        if self._quota:
+            du = self._used_bytes()
+            total = self._quota
+            used = min(du, self._quota)
+            free = min(max(self._quota - du, 0), st.free)
         return DiskInfo(
-            total=st.total, free=st.free, used=st.used,
+            total=total, free=free, used=used,
             healing=os.path.exists(self._sys_path(HEALING_FILE)),
             endpoint=self._endpoint, mount_path=self.root, id=self._disk_id,
         )

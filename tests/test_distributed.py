@@ -67,10 +67,10 @@ def cluster(tmp_path):
         ports.append(s.getsockname()[1])
         s.close()
     p1, p2 = ports
-    eps = [
-        f"http://127.0.0.1:{p1}{tmp_path}/n1/d{{1...3}}",
-        f"http://127.0.0.1:{p2}{tmp_path}/n2/d{{1...3}}",
-    ]
+    # expanded form (no ellipses): all args form ONE pool — with ellipses
+    # each arg would be its own pool (cmd/endpoint-ellipses.go:341)
+    eps = [f"http://127.0.0.1:{p}{tmp_path}/n{n}/d{i}"
+           for n, p in ((1, p1), (2, p2)) for i in (1, 2, 3)]
     # start_services=False: these tests tear drives down mid-test, and a
     # live scanner/MRF would heal them back concurrently with assertions
     n1 = ClusterNode(eps, my_address=f"127.0.0.1:{p1}", start_services=False)

@@ -82,6 +82,79 @@ def test_pools_placement_and_probe(tmp_path):
         pools.get_object_info("bkt", "obj")
 
 
+def make_quota_sets(tmp_path, tag, quota, n=4):
+    disks = [LocalStorage(str(tmp_path / f"{tag}-d{i}"), quota=quota)
+             for i in range(n)]
+    return ErasureSets(disks, set_size=n), disks
+
+
+class TestPoolPlacement:
+    """Free-space placement (cmd/erasure-server-pool.go:222
+    getAvailablePoolIdx + :241 getServerPoolsAvailableSpace)."""
+
+    def test_full_pool_is_never_picked(self, tmp_path):
+        # fill pool 0's drives past quota: every new object must land in
+        # pool 1, and everything stays readable across both pools
+        p0, d0 = make_quota_sets(tmp_path, "p0", quota=4 << 20)
+        p1, _ = make_quota_sets(tmp_path, "p1", quota=256 << 20)
+        for d in d0:
+            with open(f"{d.root}/filler", "wb") as f:
+                f.write(b"f" * (4 << 20))
+        pools = ErasureServerPools([p0, p1])
+        pools.make_bucket("bkt")
+        avail = pools._pool_available("probe", 1 << 20)
+        assert avail[0] == 0 and avail[1] > 0
+        data = b"x" * (1 << 20)
+        for i in range(4):
+            pools.put_object("bkt", f"big-{i}", io.BytesIO(data), len(data))
+            assert f"big-{i}" in p1.list_objects("bkt")
+            assert f"big-{i}" not in p0.list_objects("bkt")
+            assert pools.get_object_info("bkt", f"big-{i}").size == len(data)
+
+    def test_all_pools_full_raises_disk_full(self, tmp_path):
+        p0, _ = make_quota_sets(tmp_path, "p0", quota=1 << 20)
+        p1, _ = make_quota_sets(tmp_path, "p1", quota=1 << 20)
+        pools = ErasureServerPools([p0, p1])
+        pools.make_bucket("bkt")
+        with pytest.raises(errors.DiskFull):
+            pools.put_object("bkt", "huge", io.BytesIO(b"y" * (64 << 20)),
+                             64 << 20)
+
+    def test_weighted_choice_spreads_new_objects(self, tmp_path):
+        p0, _ = make_quota_sets(tmp_path, "p0", quota=64 << 20)
+        p1, _ = make_quota_sets(tmp_path, "p1", quota=64 << 20)
+        pools = ErasureServerPools([p0, p1])
+        pools.make_bucket("bkt")
+        for i in range(24):
+            pools.put_object("bkt", f"o{i}", io.BytesIO(b"z" * 1024), 1024)
+        per_pool = [len(p.list_objects("bkt")) for p in pools.pools]
+        assert sum(per_pool) == 24
+        # weighted-random over two equal pools: both must receive traffic
+        assert all(c > 0 for c in per_pool), per_pool
+
+    def test_existing_object_pins_its_pool(self, tmp_path):
+        p0, _ = make_quota_sets(tmp_path, "p0", quota=64 << 20)
+        p1, _ = make_quota_sets(tmp_path, "p1", quota=64 << 20)
+        pools = ErasureServerPools([p0, p1])
+        pools.make_bucket("bkt")
+        pools.put_object("bkt", "pin", io.BytesIO(b"v1"), 2)
+        owner = pools._pool_of("bkt", "pin")
+        for i in range(4):
+            pools.put_object("bkt", "pin", io.BytesIO(f"v{i+2}".encode()), 2)
+            assert pools._pool_of("bkt", "pin") is owner
+
+    def test_quota_disk_info(self, tmp_path):
+        d = LocalStorage(str(tmp_path / "qd"), quota=1 << 20)
+        info = d.disk_info()
+        assert info.total == 1 << 20 and info.free <= 1 << 20
+        with open(tmp_path / "qd" / "filler", "wb") as f:
+            f.write(b"a" * (512 << 10))
+        d._du_cache = (0.0, 0)  # bust the TTL cache
+        info = d.disk_info()
+        assert info.used >= 512 << 10
+        assert info.free <= 512 << 10
+
+
 def test_bucket_lifecycle(tmp_path):
     p0, _ = make_sets(tmp_path, 4, 4, tag="p0")
     pools = ErasureServerPools([p0])
