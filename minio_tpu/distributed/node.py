@@ -189,18 +189,26 @@ class ClusterNode:
         # (reference cmd/peer-rest-client.go LoadUser/LoadBucketMetadata)
         from .peers import PeerNotifier, register_peer_rpc
 
-        register_peer_rpc(self.router, self.s3)
+        register_peer_rpc(self.router, self.s3, node=self)
         if self.distributed:
             self.peers = PeerNotifier(self.peer_clients)
             self.s3.meta.on_change = self.peers.reload_bucket_meta
             self.s3.iam.on_change = self.peers.reload_iam
             # one admin trace endpoint serves CLUSTER-wide traces: the
-            # serving node follows each peer's own trace stream
+            # serving node follows each peer's trace over the RPC plane
             # (reference: peers subscribe to each other's globalTrace,
-            # cmd/admin-handlers.go TraceHandler + peer-rest subscribe)
+            # cmd/peer-rest-client.go:765 doTrace)
             self.s3.peer_trace_addrs = sorted(self.peer_clients)
             # admin info aggregates per-server health over these clients
             self.s3.peer_clients = self.peer_clients
+            self.s3.peers = self.peers
+            # listing-cache invalidation rides the peer plane: an
+            # overwrite here stops peers serving their saved pages
+            from minio_tpu.erasure import metacache as mc_mod
+
+            mc = mc_mod.attach(self.pools)
+            if mc is not None:
+                mc.broadcast = self.peers.metacache_invalidate
         else:
             self.peers = None
         self.s3.node_addr = my_address

@@ -1,30 +1,50 @@
-"""Peer control-plane fan-out: cache-invalidation broadcasts.
+"""Peer control plane: the node-to-node RPC surface behind admin fan-in,
+cache invalidation, signals, perf probes, and observability streams.
 
-Reference: cmd/peer-rest-client.go:92-755 (LoadBucketMetadata, LoadPolicy,
-LoadUser, LoadGroup, DeleteUser...) and cmd/notification.go's
-NotificationSys fan-out.  A mutation on one node persists to the shared
-store first, then broadcasts a reload so every peer's in-memory cache
-refreshes immediately instead of waiting out a TTL.
+Reference: cmd/peer-rest-client.go:92-1045 + cmd/peer-rest-server.go (the
+~50-call peer REST surface) and cmd/notification.go's NotificationSys
+fan-out.  Functional groups covered here over the msgpack RPC plane
+(`distributed/rpc.py`):
+
+  info       peer.info, peer.server_info, peer.local_storage_info,
+             peer.local_disk_ids, peer.get_locks,
+             peer.background_heal_status, peer.bucket_stats
+  reloads    peer.reload_bucket_meta, peer.reload_iam,
+             peer.reload_tier_config, peer.reload_site_config
+  metacache  peer.metacache_invalidate, peer.metacache_get,
+             peer.metacache_update          (cmd/peer-rest-client.go:722)
+  signals    peer.signal_service            (:683 SignalService)
+  profiling  peer.profiling_start, peer.profiling_stop
+  perf       peer.net_perf, peer.drive_perf, peer.cpu_info,
+             peer.mem_info, peer.proc_info  (:305,:370,:381,:447,:458)
+  streams    peer.trace_subscribe/poll/unsubscribe, peer.console_poll
+             (:765 doTrace / :882 ConsoleLog, pull-based here)
+
+A mutation on one node persists to the shared store first, then
+broadcasts a reload so every peer's in-memory cache refreshes immediately
+instead of waiting out a TTL.  All fan-out is offline-tolerant: the
+authoritative state is already durable, so a peer that misses a broadcast
+(down, partitioned) converges via TTL / lazy store reload.
 """
 
 from __future__ import annotations
 
+import os
 import threading
+import time
+import uuid
 
 
 class PeerNotifier:
-    """Broadcasts control-plane RPCs to every peer concurrently.
-
-    Failures are non-fatal by design: the authoritative state is already
-    persisted on the shared drives, so a peer that misses a broadcast
-    (down, partitioned) converges via its cache TTL / lazy store reload.
-    """
+    """Client side: broadcasts and aggregations over every peer."""
 
     def __init__(self, peer_clients: dict, timeout: float = 5.0):
         self.clients = peer_clients
         self.timeout = timeout
 
+    # ------------------------------------------------------------- plumbing
     def _broadcast(self, method: str, args: dict) -> None:
+        """Fire-and-forget to every online peer concurrently."""
         threads = []
         for client in self.clients.values():
             if not client.is_online():
@@ -42,9 +62,36 @@ class PeerNotifier:
         for t in threads:
             t.join(self.timeout)
 
+    def fanout(self, method: str, args: dict,
+               body: bytes = b"") -> dict[str, object]:
+        """Concurrent gather: {addr: result | Exception}.  Offline peers
+        get a recorded error instead of a blocking timeout."""
+        results: dict[str, object] = {}
+        lock = threading.Lock()
+        threads = []
+        for addr, client in sorted(self.clients.items()):
+            def call(a=addr, c=client):
+                try:
+                    if not c.is_online():
+                        raise ConnectionError("peer offline")
+                    out = c.call(method, args, body=body)
+                except Exception as e:
+                    out = e
+                with lock:
+                    results[a] = out
+
+            t = threading.Thread(target=call, daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(self.timeout * 6)  # perf probes run longer than reloads
+        for addr in self.clients:
+            results.setdefault(addr, TimeoutError("peer RPC timed out"))
+        return results
+
     # ------------------------------------------------------------ bucket meta
     def reload_bucket_meta(self, bucket: str) -> None:
-        """cmd/peer-rest-client.go LoadBucketMetadata analogue."""
+        """cmd/peer-rest-client.go:506 LoadBucketMetadata analogue."""
         self._broadcast("peer.reload_bucket_meta", {"bucket": bucket})
 
     # -------------------------------------------------------------------- iam
@@ -54,10 +101,134 @@ class PeerNotifier:
         no longer has the item, so peers drop it)."""
         self._broadcast("peer.reload_iam", {"kind": kind, "name": name})
 
+    # -------------------------------------------------------------- metacache
+    def metacache_invalidate(self, bucket: str, at: float) -> None:
+        """An overwrite/delete on this node stops peers from serving
+        their saved listing pages for `bucket`
+        (cmd/peer-rest-client.go:739 UpdateMetacacheListing analogue)."""
+        self._broadcast("peer.metacache_invalidate",
+                        {"bucket": bucket, "at": at})
 
-def register_peer_rpc(router, s3_server) -> None:
-    """Server side of the control plane (cmd/peer-rest-server.go)."""
+    # ------------------------------------------------------- config reloads
+    def reload_tier_config(self) -> None:
+        self._broadcast("peer.reload_tier_config", {})
 
+    def reload_site_config(self) -> None:
+        self._broadcast("peer.reload_site_config", {})
+
+    # ---------------------------------------------------------------- signals
+    def signal_service(self, sig: str) -> dict[str, object]:
+        """'stop-services' | 'start-services' | 'reload' fan-out
+        (cmd/peer-rest-client.go:683 SignalService)."""
+        return self.fanout("peer.signal_service", {"sig": sig})
+
+
+# --------------------------------------------------------------------------
+# server side
+# --------------------------------------------------------------------------
+
+_PROC_START = time.time()
+
+
+def _meminfo() -> dict:
+    out = {}
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                k, _, v = line.partition(":")
+                parts = v.split()
+                if parts:
+                    out[k.strip()] = int(parts[0]) * (
+                        1024 if len(parts) > 1 and parts[1] == "kB" else 1)
+    except OSError:
+        pass
+    return {"total": out.get("MemTotal", 0),
+            "available": out.get("MemAvailable", 0),
+            "free": out.get("MemFree", 0),
+            "cached": out.get("Cached", 0)}
+
+
+def _cpuinfo() -> dict:
+    try:
+        la1, la5, la15 = os.getloadavg()
+    except OSError:
+        la1 = la5 = la15 = 0.0
+    return {"count": os.cpu_count() or 1,
+            "loadavg": [la1, la5, la15]}
+
+
+def _procinfo() -> dict:
+    info = {"pid": os.getpid(), "uptime": time.time() - _PROC_START,
+            "threads": threading.active_count()}
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    info["rss"] = int(line.split()[1]) * 1024
+                elif line.startswith("FDSize:"):
+                    info["fds"] = int(line.split()[1])
+    except OSError:
+        pass
+    return info
+
+
+class _TraceHub:
+    """Pull-based trace fan-out: peers subscribe, then poll batches.
+    Unpolled subscriptions expire so a dead follower can't leak a
+    subscription (the RPC plane has no long-lived streams — polling
+    keeps every call bounded and offline-tolerant)."""
+
+    TTL = 30.0
+
+    def __init__(self, pubsub):
+        self.pubsub = pubsub
+        self._subs: dict[str, tuple[object, float]] = {}
+        self._lock = threading.Lock()
+
+    def subscribe(self, errs_only: bool) -> str:
+        flt = (lambda e: e.get("statusCode", 0) >= 400) if errs_only else None
+        sub = self.pubsub.subscribe(filter_fn=flt)
+        sid = uuid.uuid4().hex
+        with self._lock:
+            self._gc()
+            self._subs[sid] = (sub, time.time())
+        return sid
+
+    def poll(self, sid: str, max_items: int = 500) -> list | None:
+        with self._lock:
+            ent = self._subs.get(sid)
+            if ent is None:
+                return None
+            sub = ent[0]
+            self._subs[sid] = (sub, time.time())
+        out = []
+        while len(out) < max_items:
+            item = sub.get_nowait()
+            if item is None:
+                break
+            out.append(item)
+        return out
+
+    def unsubscribe(self, sid: str) -> None:
+        with self._lock:
+            ent = self._subs.pop(sid, None)
+        if ent is not None:
+            ent[0].close()
+
+    def _gc(self) -> None:
+        now = time.time()
+        for sid, (sub, last) in list(self._subs.items()):
+            if now - last > self.TTL:
+                del self._subs[sid]
+                sub.close()
+
+
+def register_peer_rpc(router, s3_server, node=None) -> None:
+    """Server side of the control plane (cmd/peer-rest-server.go).
+    `node` (a ClusterNode) unlocks drive-level handlers; without it the
+    storage-independent subset still registers (tests, gateway)."""
+
+    # ------------------------------------------------------------- reloads
     def reload_bucket_meta(args, body):
         s3_server.meta.invalidate(args.get("bucket", ""))
         return {}
@@ -73,5 +244,323 @@ def register_peer_rpc(router, s3_server) -> None:
             iam.reload_group(name)
         return {}
 
-    router.register("peer.reload_bucket_meta", reload_bucket_meta)
-    router.register("peer.reload_iam", reload_iam)
+    def reload_tier_config(args, body):
+        svcs = getattr(s3_server, "services", None)
+        tier = getattr(svcs, "tier", None) if svcs else None
+        if tier is not None and hasattr(tier, "reload"):
+            tier.reload()
+        return {}
+
+    def reload_site_config(args, body):
+        site = getattr(s3_server, "site", None)
+        if site is not None and hasattr(site, "reload"):
+            site.reload()
+        return {}
+
+    # ---------------------------------------------------------------- info
+    def server_info(args, body):
+        """madmin ServerProperties analogue
+        (cmd/peer-rest-client.go:104)."""
+        svcs = getattr(s3_server, "services", None)
+        info = {
+            "endpoint": getattr(s3_server, "node_addr", "") or "local",
+            "state": "online",
+            "uptime": int(time.time() - s3_server._start_time),
+            "mem": _meminfo(),
+            "cpu": _cpuinfo(),
+            "proc": _procinfo(),
+            "services": svcs is not None,
+        }
+        if node is not None:
+            infos = []
+            for path, d in sorted(node.local_drives.items()):
+                try:
+                    di = d.disk_info()
+                    infos.append({"endpoint": path, "online": True,
+                                  "total": di.total, "free": di.free,
+                                  "used": di.used, "healing": di.healing})
+                except Exception as e:
+                    infos.append({"endpoint": path, "online": False,
+                                  "error": str(e)})
+            info["drives"] = infos
+        return info
+
+    def local_storage_info(args, body):
+        """Per-local-drive DiskInfo (reference LocalStorageInfo)."""
+        if node is None:
+            return {"drives": []}
+        out = []
+        for path, d in sorted(node.local_drives.items()):
+            try:
+                di = d.disk_info()
+                out.append({"endpoint": path, "id": di.id,
+                            "total": di.total, "free": di.free,
+                            "used": di.used, "healing": di.healing,
+                            "online": True})
+            except Exception as e:
+                out.append({"endpoint": path, "online": False,
+                            "error": str(e)})
+        return {"drives": out}
+
+    def local_disk_ids(args, body):
+        """cmd/peer-rest-client.go:707 GetLocalDiskIDs."""
+        if node is None:
+            return {"ids": []}
+        return {"ids": [d.disk_id() for d in node.local_drives.values()]}
+
+    def get_locks(args, body):
+        """cmd/peer-rest-client.go:92 GetLocks."""
+        locker = getattr(s3_server, "locker", None)
+        return {"locks": locker.top_locks() if locker is not None else []}
+
+    def background_heal_status(args, body):
+        """cmd/peer-rest-client.go:694 BackgroundHealStatus."""
+        svcs = getattr(s3_server, "services", None)
+        if svcs is None:
+            return {"running": False}
+        out = {"running": True}
+        try:
+            out["mrf"] = svcs.mrf.to_dict()
+        except Exception:
+            pass
+        try:
+            out["heals"] = svcs.bg_heal.statuses()
+        except Exception:
+            pass
+        return out
+
+    def bucket_stats(args, body):
+        """cmd/peer-rest-client.go:492 GetBucketStats (replication
+        counters for one bucket, or totals)."""
+        svcs = getattr(s3_server, "services", None)
+        repl = getattr(svcs, "replication", None) if svcs else None
+        if repl is None:
+            return {"replication": {}}
+        return {"replication": repl.stats.to_dict()}
+
+    # ----------------------------------------------------------- metacache
+    def _metacache():
+        from minio_tpu.erasure import metacache as mc_mod
+
+        return mc_mod.attach(s3_server.api)
+
+    def metacache_invalidate(args, body):
+        mc = _metacache()
+        if mc is not None:
+            mc.mark_invalid(args.get("bucket", ""),
+                            float(args.get("at", 0)) or None)
+        return {}
+
+    def metacache_get(args, body):
+        """Serve this node's in-memory listing cache to a peer
+        (cmd/peer-rest-client.go:722 GetMetacacheListing)."""
+        mc = _metacache()
+        if mc is None:
+            return {"hit": False}
+        names = mc.lookup(args.get("bucket", ""), args.get("prefix", ""),
+                          args.get("marker", ""),
+                          bool(args.get("include_marker", False)))
+        if names is None:
+            return {"hit": False}
+        return {"hit": True, "names": names}
+
+    def metacache_update(args, body):
+        """Install a walked name stream into this node's cache
+        (UpdateMetacacheListing analogue)."""
+        mc = _metacache()
+        if mc is not None:
+            mc.save(args.get("bucket", ""), args.get("prefix", ""),
+                    args.get("start", ""), list(args.get("names", [])))
+        return {}
+
+    # -------------------------------------------------------------- signals
+    def signal_service(args, body):
+        """cmd/peer-rest-client.go:683 — 'stop-services' freezes the
+        background plane, 'start-services' resumes it, 'reload'
+        re-reads dynamic config."""
+        sig = args.get("sig", "")
+        svcs = getattr(s3_server, "services", None)
+        if sig == "stop-services":
+            if svcs is not None:
+                for svc in (svcs.scanner, svcs.bg_heal, svcs.monitor):
+                    if hasattr(svc, "pause"):
+                        svc.pause()
+            return {"ok": True}
+        if sig == "start-services":
+            if svcs is not None:
+                for svc in (svcs.scanner, svcs.bg_heal, svcs.monitor):
+                    if hasattr(svc, "resume"):
+                        svc.resume()
+            return {"ok": True}
+        if sig == "reload":
+            if hasattr(s3_server, "apply_dynamic_config"):
+                s3_server.apply_dynamic_config()
+            return {"ok": True}
+        return {"ok": False, "error": f"unknown signal {sig!r}"}
+
+    # ------------------------------------------------------------ profiling
+    def profiling_start(args, body):
+        ok = s3_server._profiler().start()
+        return {"success": bool(ok)}
+
+    def profiling_stop(args, body):
+        return {"data": s3_server._profiler().stop()}
+
+    # ------------------------------------------------------------------ perf
+    def net_perf(args, body):
+        """Bandwidth probe: the caller streams `body` here and we echo
+        its size (and optionally return a payload for the reverse
+        direction) — cmd/peer-rest-client.go:305 GetNetPerfInfo."""
+        rx = len(body)
+        tx = int(args.get("reply_bytes", 0))
+        return {"received": rx, "payload": b"\x00" * min(tx, 64 << 20)}
+
+    def drive_perf(args, body):
+        """Per-local-drive sequential write+read probe
+        (cmd/peer-rest-client.go:370 GetDrivePerfInfos).  Uses O_DIRECT
+        when the filesystem supports it so the page cache cannot fake
+        the numbers."""
+        if node is None:
+            return {"drives": []}
+        size = min(int(args.get("bytes", 8 << 20)), 256 << 20)
+        out = []
+        for path, d in sorted(node.local_drives.items()):
+            out.append(_probe_drive(path, d.root, size))
+        return {"drives": out}
+
+    def cpu_info(args, body):
+        return _cpuinfo()
+
+    def mem_info(args, body):
+        return _meminfo()
+
+    def proc_info(args, body):
+        return _procinfo()
+
+    # --------------------------------------------------------------- streams
+    hub = _TraceHub(s3_server.trace)
+    s3_server._trace_hub = hub
+
+    def trace_subscribe(args, body):
+        return {"id": hub.subscribe(bool(args.get("err", False)))}
+
+    def trace_poll(args, body):
+        out = hub.poll(args.get("id", ""))
+        if out is None:
+            return {"ok": False}
+        return {"ok": True, "entries": out}
+
+    def trace_unsubscribe(args, body):
+        hub.unsubscribe(args.get("id", ""))
+        return {}
+
+    def console_poll(args, body):
+        """Recent console-ring entries (cmd/peer-rest-client.go:882
+        ConsoleLog, pull-based)."""
+        from minio_tpu.utils.logger import log as logger
+
+        n = max(1, min(int(args.get("limit", 100)), 10000))
+        return {"entries": logger.recent(n)}
+
+    for name, fn in {
+        "peer.reload_bucket_meta": reload_bucket_meta,
+        "peer.reload_iam": reload_iam,
+        "peer.reload_tier_config": reload_tier_config,
+        "peer.reload_site_config": reload_site_config,
+        "peer.server_info": server_info,
+        "peer.local_storage_info": local_storage_info,
+        "peer.local_disk_ids": local_disk_ids,
+        "peer.get_locks": get_locks,
+        "peer.background_heal_status": background_heal_status,
+        "peer.bucket_stats": bucket_stats,
+        "peer.metacache_invalidate": metacache_invalidate,
+        "peer.metacache_get": metacache_get,
+        "peer.metacache_update": metacache_update,
+        "peer.signal_service": signal_service,
+        "peer.profiling_start": profiling_start,
+        "peer.profiling_stop": profiling_stop,
+        "peer.net_perf": net_perf,
+        "peer.drive_perf": drive_perf,
+        "peer.cpu_info": cpu_info,
+        "peer.mem_info": mem_info,
+        "peer.proc_info": proc_info,
+        "peer.trace_subscribe": trace_subscribe,
+        "peer.trace_poll": trace_poll,
+        "peer.trace_unsubscribe": trace_unsubscribe,
+        "peer.console_poll": console_poll,
+    }.items():
+        router.register(name, fn)
+
+
+def _probe_drive(endpoint: str, root: str, size: int) -> dict:
+    """One drive's sequential write+read throughput, O_DIRECT when
+    possible (reference dperf; internal/disk/directio_unix.go)."""
+    import shutil
+    import tempfile
+
+    blk = 1 << 20
+    tmpdir = tempfile.mkdtemp(prefix=".dperf-", dir=root)
+    fname = os.path.join(tmpdir, "probe")
+    direct = getattr(os, "O_DIRECT", 0)
+    buf = bytearray(os.urandom(blk))
+    # O_DIRECT needs 4 KiB alignment: allocate aligned via memoryview
+    # over an mmap'd buffer
+    try:
+        import mmap
+
+        abuf = mmap.mmap(-1, blk)
+        abuf.write(bytes(buf))
+    except Exception:
+        abuf = buf
+        direct = 0
+    try:
+        flags = os.O_WRONLY | os.O_CREAT | direct
+        try:
+            fd = os.open(fname, flags, 0o600)
+        except OSError:
+            direct = 0
+            fd = os.open(fname, os.O_WRONLY | os.O_CREAT, 0o600)
+        if direct:
+            # some filesystems (tmpfs) accept the O_DIRECT open but fail
+            # the first write with EINVAL — fall back to buffered
+            try:
+                os.write(fd, abuf)
+            except OSError:
+                os.close(fd)
+                direct = 0
+                fd = os.open(fname, os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+                             0o600)
+        t0 = time.perf_counter()
+        written = 0
+        try:
+            while written < size:
+                written += os.write(fd, abuf)
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        w_dt = time.perf_counter() - t0
+        rflags = os.O_RDONLY | direct
+        try:
+            fd = os.open(fname, rflags)
+        except OSError:
+            fd = os.open(fname, os.O_RDONLY)
+        t0 = time.perf_counter()
+        got = 1
+        try:
+            rbuf = mmap.mmap(-1, blk)
+            while got:
+                got = os.readv(fd, [rbuf])
+        finally:
+            os.close(fd)
+        r_dt = time.perf_counter() - t0
+        return {
+            "endpoint": endpoint,
+            "write_gibs": written / w_dt / (1 << 30) if w_dt else 0.0,
+            "read_gibs": written / r_dt / (1 << 30) if r_dt else 0.0,
+            "o_direct": bool(direct),
+            "bytes": written,
+        }
+    except OSError as e:
+        return {"endpoint": endpoint, "error": str(e)}
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)

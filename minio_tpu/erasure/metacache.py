@@ -55,6 +55,64 @@ class MetacacheManager:
         self._mem: dict[tuple, tuple[float, list[str]]] = {}
         self._mem_cap = mem_entries
         self._lock = threading.Lock()
+        # per-bucket invalidation watermark: caches created before this
+        # instant are unusable.  Fed by local mutations (via the
+        # ns-update hook attach() registers) and by peer broadcasts
+        # (reference metacache coordination over peer RPC,
+        # cmd/peer-rest-client.go:722/:739) — so an overwrite on any
+        # node stops every node from serving its saved listing pages.
+        self._inval: dict[str, float] = {}
+        # optional fan-out fn(bucket, at) -> None, wired by ClusterNode
+        self.broadcast = None
+        self._last_bcast: dict[str, float] = {}
+        self._bcast_timers: dict[str, object] = {}
+
+    # -- invalidation -------------------------------------------------------
+    def mark_invalid(self, bucket: str, at: float | None = None) -> None:
+        """Reject caches created before `at` (defaults to now)."""
+        at = time.time() if at is None else at
+        with self._lock:
+            if at > self._inval.get(bucket, 0.0):
+                self._inval[bucket] = at
+
+    _BCAST_COALESCE = 1.0  # at most one broadcast per bucket per second
+
+    def on_ns_update(self, bucket: str, _obj: str = "") -> None:
+        """Namespace-mutation hook: invalidate locally, fan out to peers
+        (coalesced — a PUT storm must not become a broadcast storm; a
+        trailing broadcast covers the last mutation of a burst)."""
+        self.mark_invalid(bucket)
+        if self.broadcast is None:
+            return
+        now = time.time()
+        with self._lock:
+            last = self._last_bcast.get(bucket, 0.0)
+            if now - last >= self._BCAST_COALESCE:
+                self._last_bcast[bucket] = now
+                send_now = True
+            else:
+                send_now = False
+                if bucket not in self._bcast_timers:
+                    t = threading.Timer(
+                        self._BCAST_COALESCE - (now - last),
+                        self._trailing_bcast, (bucket,))
+                    t.daemon = True
+                    self._bcast_timers[bucket] = t
+                    t.start()
+        if send_now:
+            self._do_broadcast(bucket)
+
+    def _trailing_bcast(self, bucket: str) -> None:
+        with self._lock:
+            self._bcast_timers.pop(bucket, None)
+            self._last_bcast[bucket] = time.time()
+        self._do_broadcast(bucket)
+
+    def _do_broadcast(self, bucket: str) -> None:
+        try:
+            self.broadcast(bucket, self._inval.get(bucket, time.time()))
+        except Exception:
+            pass  # peers converge via CACHE_TTL
 
     # -- drive access -------------------------------------------------------
     def _disks(self):
@@ -135,7 +193,10 @@ class MetacacheManager:
         return None
 
     # -- lookup -------------------------------------------------------------
-    def _usable(self, created: float, marker: str) -> bool:
+    def _usable(self, created: float, marker: str,
+                bucket: str = "") -> bool:
+        if bucket and created <= self._inval.get(bucket, 0.0):
+            return False
         age = time.time() - created
         if marker:
             return age < CACHE_TTL
@@ -172,7 +233,7 @@ class MetacacheManager:
             if hit is None:
                 continue
             created, names = hit
-            if not self._usable(created, marker):
+            if not self._usable(created, marker, bucket):
                 continue
             if marker:
                 import bisect
@@ -186,7 +247,9 @@ class MetacacheManager:
 
 
 def attach(api) -> MetacacheManager | None:
-    """Get (lazily creating) the api object's metacache manager."""
+    """Get (lazily creating) the api object's metacache manager; on
+    creation, hook every erasure set's ns-update callback so object
+    mutations invalidate saved listings immediately."""
     mc = getattr(api, "_metacache", None)
     if mc is None:
         try:
@@ -197,4 +260,10 @@ def attach(api) -> MetacacheManager | None:
             api._metacache = mc
         except Exception:
             return None
+        try:
+            from .objects import add_ns_update_hook
+
+            add_ns_update_hook(api, mc.on_ns_update)
+        except Exception:
+            pass
     return mc

@@ -211,6 +211,45 @@ def _bitrot_algo_of(fi: FileInfo) -> str:
             return a
     return bitrot.DEFAULT_ALGO
 
+class NsUpdateHooks(list):
+    """Composable namespace-change callbacks: every registered
+    fn(bucket, obj) fires on a mutation; one hook failing never blocks
+    the others (they feed caches/trackers, not the data path)."""
+
+    def __call__(self, bucket: str, obj: str) -> None:
+        for fn in list(self):
+            try:
+                fn(bucket, obj)
+            except Exception:
+                pass
+
+
+def iter_sets(object_layer):
+    """Every ErasureObjects set under a pools/sets/set object."""
+    if hasattr(object_layer, "pools"):
+        for p in object_layer.pools:
+            yield from iter_sets(p)
+    elif hasattr(object_layer, "sets"):
+        yield from object_layer.sets
+    else:
+        yield object_layer
+
+
+def add_ns_update_hook(object_layer, fn) -> None:
+    """Register fn(bucket, obj) on every set without clobbering hooks
+    other subsystems installed (scanner bloom tracker, metacache
+    invalidation, peer broadcasts all share the one callback slot)."""
+    for es in iter_sets(object_layer):
+        cur = getattr(es, "ns_updated", None)
+        if isinstance(cur, NsUpdateHooks):
+            if fn not in cur:
+                cur.append(fn)
+        elif cur is None:
+            es.ns_updated = NsUpdateHooks([fn])
+        else:
+            es.ns_updated = NsUpdateHooks([cur, fn])
+
+
 class ErasureObjects:
     """One erasure set over `disks` (K+M drives)."""
 

@@ -205,54 +205,38 @@ class AdminMixin:
 
     async def admin_drive_speedtest(self, request: web.Request,
                                     body: bytes):
-        """Sequential write+read throughput per LOCAL drive using the
-        same O_DIRECT-free path the data plane uses (reference dperf
-        drive speedtest)."""
-        import os
-        import uuid as _uuid
+        """Sequential write+read throughput per LOCAL drive, O_DIRECT
+        when the filesystem allows it so the page cache cannot inflate
+        the numbers (reference dperf drive speedtest,
+        cmd/peer-rest-client.go:128-380)."""
+        from minio_tpu.distributed.peers import _probe_drive
 
         size = self._int_q(request, "size", 64 << 20, 1 << 20, 1 << 30)
-        block = 4 << 20
-        payload = os.urandom(block)
 
         def run() -> list[dict]:
             out = []
             for pool in getattr(self.api, "pools", [self.api]):
                 for d in pool.all_disks:
-                    if d is None or not d.is_online() \
-                            or not getattr(d, "is_local", lambda: True)():
+                    if d is None or not d.is_online():
                         continue
-                    tmp = f"tmp/speedtest-{_uuid.uuid4().hex}"
-                    try:
-                        t0 = time.monotonic()
-                        fh = d.open_file_writer(SYSTEM_VOL, tmp)
-                        written = 0
-                        while written < size:
-                            fh.write(payload)
-                            written += block
-                        fh.close()
-                        w_s = time.monotonic() - t0
-                        t0 = time.monotonic()
-                        rh = d.read_file_stream(SYSTEM_VOL, tmp,
-                                                0, written)
-                        while rh.read(block):
-                            pass
-                        rh.close()
-                        r_s = time.monotonic() - t0
-                        out.append({
-                            "endpoint": d.endpoint(),
-                            "writeMiBps": round(written / w_s / 2**20, 1),
-                            "readMiBps": round(written / r_s / 2**20, 1),
-                            "bytes": written,
-                        })
-                    except Exception as e:
-                        out.append({"endpoint": d.endpoint(),
-                                    "error": str(e)})
-                    finally:
-                        try:
-                            d.delete(SYSTEM_VOL, tmp)
-                        except Exception:
-                            pass
+                    # unwrap the instrumentation to reach the drive root;
+                    # remote drives have no local root and are skipped
+                    # (each node probes its own drives)
+                    inner = getattr(d, "_inner", d)
+                    root = getattr(inner, "root", None)
+                    if root is None:
+                        continue
+                    res = _probe_drive(d.endpoint(), root, size)
+                    if "error" not in res:
+                        res = {
+                            "endpoint": res["endpoint"],
+                            "writeMiBps": round(
+                                res["write_gibs"] * 1024, 1),
+                            "readMiBps": round(res["read_gibs"] * 1024, 1),
+                            "bytes": res["bytes"],
+                            "oDirect": res["o_direct"],
+                        }
+                    out.append(res)
             return out
 
         return self._json({"drives": await self._run(run)})
@@ -492,58 +476,47 @@ class AdminMixin:
 
     def _follow_peer_trace(self, addr: str, sub, stop, errs_only: bool
                            ) -> None:
-        """Tail one peer's ?local=true trace stream into `sub`'s queue,
-        reconnecting with backoff for as long as the client stream is
-        open (a peer restart must not silently drop its traffic from an
-        ongoing cluster-wide trace)."""
-        import http.client as hc
+        """Pull one peer's trace entries into `sub`'s queue over the RPC
+        plane (peer.trace_subscribe/poll, reference
+        cmd/peer-rest-client.go:765 doTrace), reconnecting with backoff
+        for as long as the client stream is open — a peer restart must
+        not silently drop its traffic from an ongoing cluster trace."""
         import queue as queue_mod
 
         from minio_tpu.utils.logger import log
-        from . import sigv4
 
-        q = [("local", "true")] + ([("err", "true")] if errs_only else [])
-        path = f"{ADMIN_PREFIX}/trace"
-        qs = "&".join(f"{k}={v}" for k, v in q)
-        host, _, port = addr.partition(":")
+        client = getattr(self, "peer_clients", {}).get(addr)
+        if client is None:
+            return
         backoff = 1.0
         while not stop.is_set():
-            signed = sigv4.sign_request(
-                "GET", path, q, {"host": addr}, b"",
-                self.iam.root.access_key, self.iam.root.secret_key,
-                region=self.region)
-            conn = None
+            sid = None
             try:
-                conn = hc.HTTPConnection(host, int(port or 80), timeout=5)
-                conn.request("GET", f"{path}?{qs}", headers=signed)
-                resp = conn.getresponse()
-                if resp.status != 200:
-                    log.warning("peer trace subscribe rejected",
-                                peer=addr, status=resp.status)
-                    return  # auth/config problem: retrying won't help
+                sid = client.call("peer.trace_subscribe",
+                                  {"err": errs_only})["id"]
                 backoff = 1.0
-                buf = b""
                 while not stop.is_set():
-                    chunk = resp.read1(65536)
-                    if not chunk:
-                        break
-                    buf += chunk
-                    while b"\n" in buf:
-                        line, buf = buf.split(b"\n", 1)
-                        if not line.strip():
-                            continue
+                    out = client.call("peer.trace_poll", {"id": sid})
+                    if not out.get("ok"):
+                        break  # subscription expired server-side
+                    entries = out.get("entries", [])
+                    for entry in entries:
+                        entry.setdefault("node", addr)
                         try:
-                            entry = json.loads(line)
-                            entry.setdefault("node", addr)
                             sub.q.put_nowait(entry)
-                        except (ValueError, queue_mod.Full):
-                            continue
+                        except queue_mod.Full:
+                            pass
+                    if not entries and stop.wait(0.25):
+                        break
             except Exception as e:
                 log.warning("peer trace follower disconnected; retrying",
                             peer=addr, error=str(e))
             finally:
-                if conn is not None:
-                    conn.close()
+                if sid is not None:
+                    try:
+                        client.call("peer.trace_unsubscribe", {"id": sid})
+                    except Exception:
+                        pass
             if stop.wait(backoff):
                 return
             backoff = min(backoff * 2, 15.0)
@@ -588,29 +561,6 @@ class AdminMixin:
             raise S3Error("AccessDenied", f"admin:{op} denied")
 
     # ----------------------------------------------------------- profiling
-    def _peer_admin_post(self, addr: str, path: str,
-                         query: list) -> tuple[int, bytes]:
-        """One signed admin POST to a peer (root creds, like the trace
-        follower); returns (status, body)."""
-        import http.client as hc
-
-        from . import sigv4
-
-        qs = "&".join(f"{k}={v}" for k, v in query)
-        signed = sigv4.sign_request(
-            "POST", path, query, {"host": addr}, b"",
-            self.iam.root.access_key, self.iam.root.secret_key,
-            region=self.region)
-        host, _, port = addr.partition(":")
-        conn = hc.HTTPConnection(host, int(port or 80), timeout=30)
-        try:
-            conn.request("POST", f"{path}?{qs}" if qs else path,
-                         headers=signed)
-            resp = conn.getresponse()
-            return resp.status, resp.read()
-        finally:
-            conn.close()
-
     def _profiler(self):
         """Per-server sampler (NOT a module singleton: in-process
         multi-node tests and embedded deployments need one per node)."""
@@ -637,27 +587,22 @@ class AdminMixin:
         me = getattr(self, "node_addr", "") or "local"
         results = [{"nodeName": me, "success": ok}]
         if not local_only:
+            # peer fan-out over the RPC plane (peer.profiling_start,
+            # reference cmd/peer-rest-client.go:469 StartProfiling)
+            clients = getattr(self, "peer_clients", {})
+
             async def one(addr):
                 try:
-                    status, pb = await self._run(
-                        self._peer_admin_post, addr,
-                        f"{ADMIN_PREFIX}/profiling/start",
-                        [("local", "true"), ("profilerType", ptype)])
-                    success = status == 200
-                    if success:
-                        # the peer reports its own verdict (e.g. already
-                        # running) with HTTP 200 — honor the body
-                        try:
-                            success = bool(json.loads(pb)[0]["success"])
-                        except (ValueError, KeyError, IndexError):
-                            pass
-                    return {"nodeName": addr, "success": success}
+                    out = await self._run(
+                        clients[addr].call, "peer.profiling_start", {})
+                    return {"nodeName": addr,
+                            "success": bool(out.get("success"))}
                 except Exception as e:
                     return {"nodeName": addr, "success": False,
                             "error": str(e)}
 
             results += list(await asyncio.gather(*[
-                one(a) for a in getattr(self, "peer_trace_addrs", [])
+                one(a) for a in sorted(clients)
             ]))
         return self._json(results)
 
@@ -675,19 +620,20 @@ class AdminMixin:
         import io as iomod
         import zipfile
 
+        # peer captures over the RPC plane (peer.profiling_stop,
+        # reference cmd/peer-rest-client.go:481 DownloadProfileData)
+        clients = getattr(self, "peer_clients", {})
+
         async def one(addr):
             try:
-                status, pb = await self._run(
-                    self._peer_admin_post, addr,
-                    f"{ADMIN_PREFIX}/profiling/stop", [("local", "true")])
-                if status != 200:
-                    return addr, None, f"HTTP {status}"
-                return addr, pb, None
+                out = await self._run(
+                    clients[addr].call, "peer.profiling_stop", {})
+                return addr, out.get("data", b""), None
             except Exception as e:
                 return addr, None, str(e)
 
         peers = list(await asyncio.gather(*[
-            one(a) for a in getattr(self, "peer_trace_addrs", [])
+            one(a) for a in sorted(clients)
         ]))
         me = getattr(self, "node_addr", "") or "local"
         buf = iomod.BytesIO()
@@ -741,18 +687,24 @@ class AdminMixin:
                 # incl. per-target pending/failed/proxied counters
                 # (reference madmin ReplicationInfo / bucket-targets state)
                 info["replication"] = svcs.replication.stats.to_dict()
-        # per-server health fan-in (reference madmin InfoMessage.Servers
-        # via peer-rest ServerInfo)
+        # per-server fan-in over the RPC plane (reference madmin
+        # InfoMessage.Servers via peer-rest ServerInfo,
+        # cmd/peer-rest-client.go:104); offline peers are reported as
+        # such rather than failing the whole call
         peer_clients = getattr(self, "peer_clients", None)
         if peer_clients:
             me = getattr(self, "node_addr", "") or "local"
-            servers = [{"endpoint": me, "state": "online"}]
+            servers = [{"endpoint": me, "state": "online",
+                        "uptime": info["uptimeSeconds"]}]
 
             def probe(addr, client):
                 try:
-                    pi = client.call("peer.info", {})
+                    pi = client.call("peer.server_info", {})
                     return {"endpoint": addr, "state": "online",
-                            "drives": len(pi.get("drives", []))}
+                            "uptime": pi.get("uptime", 0),
+                            "drives": len(pi.get("drives", [])),
+                            "mem": pi.get("mem", {}),
+                            "cpu": pi.get("cpu", {})}
                 except Exception:
                     return {"endpoint": addr, "state": "offline"}
 

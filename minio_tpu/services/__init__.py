@@ -40,10 +40,12 @@ class ServiceManager:
     def _attach_heal_queue(self) -> None:
         """Point every erasure set's async-heal hook at the MRF queue and
         its change hook at the update tracker."""
+        from minio_tpu.erasure.objects import add_ns_update_hook
+
         for pool in getattr(self.ol, "pools", [self.ol]):
             for es in getattr(pool, "sets", []):
                 es.heal_queue = self.mrf.enqueue
-                es.ns_updated = self.tracker.mark
+        add_ns_update_hook(self.ol, self.tracker.mark)
 
     def close(self) -> None:
         self.scanner.close()

@@ -170,7 +170,15 @@ class BackgroundHealer:
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval):
+            if getattr(self, "_paused", False):
+                continue
             self.heal_once()
+
+    def pause(self) -> None:
+        self._paused = True
+
+    def resume(self) -> None:
+        self._paused = False
 
     def heal_once(self) -> HealSequenceStatus:
         seq = HealSequence(self.ol)

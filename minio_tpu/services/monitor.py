@@ -35,10 +35,18 @@ class DriveMonitor:
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval):
+            if getattr(self, "_paused", False):
+                continue
             try:
                 self.check_once()
             except Exception:
                 pass
+
+    def pause(self) -> None:
+        self._paused = True
+
+    def resume(self) -> None:
+        self._paused = False
 
     def check_once(self) -> int:
         """One probe pass; returns the number of fresh drives healed."""

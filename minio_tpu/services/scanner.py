@@ -144,10 +144,20 @@ class DataScanner:
             with self._mu:
                 self.usage = cached
         while not self._stop.wait(self.interval):
+            if getattr(self, "_paused", False):
+                continue
             try:
                 self.scan_cycle()
             except Exception:
                 pass
+
+    def pause(self) -> None:
+        """Freeze cycles without tearing the thread down (peer
+        signal-service stop-services, cmd/peer-rest-client.go:683)."""
+        self._paused = True
+
+    def resume(self) -> None:
+        self._paused = False
 
     def close(self) -> None:
         self._stop.set()

@@ -1,0 +1,222 @@
+"""Peer control plane over the RPC plane: info/perf/signal/metacache
+RPCs between two in-process cluster nodes (reference
+cmd/peer-rest-client.go:92-1045 + cmd/peer-rest-server.go)."""
+
+import io
+import time
+
+import pytest
+
+from tests.test_distributed import cluster, NodeHarness  # noqa: F401
+
+
+def _client(from_node, to_node):
+    """RpcClient on `from_node` pointing at `to_node`."""
+    addr = to_node.s3.node_addr
+    return from_node.peer_clients[addr]
+
+
+def test_rpc_surface_breadth(cluster):
+    """VERDICT r3 #2 done-condition: >= 15 peer RPCs covering the
+    reference's functional groups."""
+    n1, _ = cluster
+    peer_methods = [m for m in n1.router.methods if m.startswith("peer.")]
+    assert len(peer_methods) >= 15, sorted(peer_methods)
+    groups = {
+        "info": {"peer.server_info", "peer.local_storage_info",
+                 "peer.local_disk_ids", "peer.get_locks",
+                 "peer.background_heal_status"},
+        "reloads": {"peer.reload_bucket_meta", "peer.reload_iam"},
+        "metacache": {"peer.metacache_invalidate", "peer.metacache_get",
+                      "peer.metacache_update"},
+        "signals": {"peer.signal_service"},
+        "profiling": {"peer.profiling_start", "peer.profiling_stop"},
+        "perf": {"peer.net_perf", "peer.drive_perf", "peer.cpu_info",
+                 "peer.mem_info"},
+        "streams": {"peer.trace_subscribe", "peer.trace_poll",
+                    "peer.console_poll"},
+    }
+    for group, methods in groups.items():
+        missing = methods - set(peer_methods)
+        assert not missing, f"group {group} missing {missing}"
+
+
+def test_server_and_storage_info_over_rpc(cluster):
+    n1, n2 = cluster
+    c = _client(n1, n2)
+    info = c.call("peer.server_info", {})
+    assert info["state"] == "online"
+    assert info["mem"]["total"] > 0
+    assert info["cpu"]["count"] >= 1
+    assert len(info["drives"]) == 3
+    si = c.call("peer.local_storage_info", {})
+    assert len(si["drives"]) == 3
+    assert all(d["online"] for d in si["drives"])
+    ids = c.call("peer.local_disk_ids", {})
+    assert len(ids["ids"]) == 3
+
+
+def test_perf_probes_over_rpc(cluster):
+    n1, n2 = cluster
+    c = _client(n1, n2)
+    # net perf: push 1 MiB, ask for 1 MiB back
+    payload = b"\x55" * (1 << 20)
+    out = c.call("peer.net_perf", {"reply_bytes": 1 << 20}, body=payload)
+    assert out["received"] == len(payload)
+    assert len(out["payload"]) == 1 << 20
+    # drive perf: every local drive reports a throughput or an error
+    out = c.call("peer.drive_perf", {"bytes": 2 << 20})
+    assert len(out["drives"]) == 3
+    for d in out["drives"]:
+        assert "error" in d or d["write_gibs"] > 0
+
+
+def test_signal_service_pauses_background_services(tmp_path):
+    """stop-services freezes scanner cycles; start-services resumes
+    (cmd/peer-rest-client.go:683 SignalService)."""
+    from minio_tpu.distributed.node import ClusterNode
+
+    drives = [str(tmp_path / f"d{i}") for i in range(4)]
+    node = ClusterNode(drives, start_services=True, scan_interval=0.1)
+    try:
+        svcs = node.s3.services
+        # wait for at least one cycle
+        deadline = time.time() + 5
+        while svcs.scanner.cycles == 0 and time.time() < deadline:
+            time.sleep(0.05)
+        assert svcs.scanner.cycles > 0
+        fn = node.router.methods["peer.signal_service"]
+        assert fn({"sig": "stop-services"}, b"")["ok"]
+        base = svcs.scanner.cycles
+        time.sleep(0.5)
+        assert svcs.scanner.cycles == base, "scanner kept cycling"
+        assert fn({"sig": "start-services"}, b"")["ok"]
+        deadline = time.time() + 5
+        while svcs.scanner.cycles == base and time.time() < deadline:
+            time.sleep(0.05)
+        assert svcs.scanner.cycles > base, "scanner never resumed"
+        assert not fn({"sig": "bogus"}, b"")["ok"]
+    finally:
+        node.close()
+
+
+def test_trace_over_rpc(cluster):
+    """Pull-based trace subscription: entries published on the peer
+    arrive through subscribe/poll (cmd/peer-rest-client.go:765)."""
+    n1, n2 = cluster
+    c = _client(n1, n2)
+    sid = c.call("peer.trace_subscribe", {})["id"]
+    try:
+        n2.s3.trace.publish({"api": "GetObject", "statusCode": 200})
+        n2.s3.trace.publish({"api": "PutObject", "statusCode": 500})
+        out = c.call("peer.trace_poll", {"id": sid})
+        assert out["ok"]
+        apis = {e["api"] for e in out["entries"]}
+        assert apis == {"GetObject", "PutObject"}
+    finally:
+        c.call("peer.trace_unsubscribe", {"id": sid})
+    # polling a dropped subscription reports not-ok (expired), no crash
+    assert c.call("peer.trace_poll", {"id": sid}) == {"ok": False}
+
+    # error-filtered subscription only sees >=400
+    sid = c.call("peer.trace_subscribe", {"err": True})["id"]
+    try:
+        n2.s3.trace.publish({"api": "GetObject", "statusCode": 200})
+        n2.s3.trace.publish({"api": "PutObject", "statusCode": 503})
+        out = c.call("peer.trace_poll", {"id": sid})
+        assert [e["api"] for e in out["entries"]] == ["PutObject"]
+    finally:
+        c.call("peer.trace_unsubscribe", {"id": sid})
+
+
+def test_console_poll_over_rpc(cluster):
+    n1, n2 = cluster
+    from minio_tpu.utils.logger import log
+
+    log.info("peer-rpc console probe", marker="xyz123")
+    c = _client(n1, n2)
+    out = c.call("peer.console_poll", {"limit": 50})
+    assert isinstance(out["entries"], list)
+
+
+def test_profiling_over_rpc(cluster):
+    n1, n2 = cluster
+    c = _client(n1, n2)
+    assert c.call("peer.profiling_start", {})["success"]
+    time.sleep(0.3)
+    out = c.call("peer.profiling_stop", {})
+    assert isinstance(out["data"], (bytes, bytearray))
+    assert len(out["data"]) > 0
+
+
+def test_overwrite_invalidates_peer_listing(cluster):
+    """VERDICT r3 #2 / Weak #3 done-condition: an overwrite on one node
+    invalidates the OTHER node's persisted listing pages — the stale
+    continuation cache is dropped instead of serving until TTL."""
+    import io as iomod
+
+    from minio_tpu.erasure import listing, metacache
+
+    n1, n2 = cluster
+    api1, api2 = n1.pools, n2.pools
+    api1.make_bucket("invb")
+    for i in range(30):
+        api1.put_object("invb", f"k-{i:03d}", iomod.BytesIO(b"x"), 1)
+
+    # node 2 serves page 1 truncated -> persists the name stream and
+    # holds it in its in-memory cache
+    page1 = listing.list_objects(api2, "invb", max_keys=10)
+    assert page1.is_truncated
+    marker = page1.next_marker
+    mc2 = metacache.attach(api2)
+    assert mc2 is not None
+
+    # a continuation on node 2 is served from cache right now
+    assert mc2.lookup("invb", "", marker, False) is not None
+
+    # node 1 writes a new object that belongs in page 2's range
+    api1.put_object("invb", "k-0105", iomod.BytesIO(b"new"), 3)
+    # the broadcast is asynchronous: wait briefly for it to land
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if mc2.lookup("invb", "", marker, False) is None:
+            break
+        time.sleep(0.05)
+    assert mc2.lookup("invb", "", marker, False) is None, \
+        "peer kept serving its stale cached listing after the overwrite"
+
+    # and the re-walked continuation includes the new name
+    page2 = listing.list_objects(api2, "invb", marker=marker, max_keys=10)
+    assert "k-0105" in [e.name for e in page2.entries]
+
+
+def test_metacache_get_and_update_over_rpc(cluster):
+    """Peers can fetch/install each other's listing caches directly
+    (GetMetacacheListing/UpdateMetacacheListing analogues)."""
+    n1, n2 = cluster
+    c = _client(n1, n2)
+    names = [f"n-{i:02d}" for i in range(20)]
+    c.call("peer.metacache_update",
+           {"bucket": "rpcb", "prefix": "", "start": "", "names": names})
+    out = c.call("peer.metacache_get",
+                 {"bucket": "rpcb", "prefix": "", "marker": ""})
+    assert out["hit"] and out["names"] == names
+    miss = c.call("peer.metacache_get",
+                  {"bucket": "nosuch", "prefix": "", "marker": ""})
+    assert not miss["hit"]
+
+
+def test_fanout_is_offline_tolerant(cluster):
+    """A dead peer contributes an error entry, not a hang/crash."""
+    from minio_tpu.distributed.peers import PeerNotifier
+    from minio_tpu.distributed.rpc import RpcClient
+
+    n1, n2 = cluster
+    dead = RpcClient("127.0.0.1", 1, n1.secret, timeout=0.5)
+    clients = dict(n1.peer_clients)
+    clients["127.0.0.1:1"] = dead
+    pn = PeerNotifier(clients, timeout=5.0)
+    out = pn.fanout("peer.cpu_info", {})
+    live_addr = n2.s3.node_addr
+    assert isinstance(out[live_addr], dict) and out[live_addr]["count"] >= 1
+    assert isinstance(out["127.0.0.1:1"], Exception)
