@@ -38,6 +38,19 @@ HEALING_FILE = ".healing.bin"
 FSYNC_ENABLED = os.environ.get("MINIO_TPU_FSYNC", "1").lower() not in (
     "0", "off", "false")
 
+# O_DIRECT streaming for shard files: bulk data bypasses the page cache so
+# a storage node's RAM stays available for caches that matter (metacache,
+# usage) and write throughput is the drive's, not the flush daemon's
+# (reference cmd/xl-storage.go:1667 CreateFile / :1558 ReadFileStream via
+# odirectReader + internal/disk/directio_unix.go:27-50).  Filesystems
+# without O_DIRECT (tmpfs) fall back to buffered IO per drive,
+# automatically.
+ODIRECT_ENABLED = os.environ.get("MINIO_TPU_ODIRECT", "1").lower() not in (
+    "0", "off", "false") and hasattr(os, "O_DIRECT")
+_ALIGN = 4096          # logical block alignment O_DIRECT demands
+_DIO_BUF = 1 << 20     # aligned staging-buffer size
+TRASH_DIR = "trash"
+
 
 def _fdatasync(fileobj) -> None:
     if not FSYNC_ENABLED:
@@ -92,6 +105,205 @@ class _SyncedWriter:
         return False
 
 
+def _disable_direct(fd: int) -> None:
+    """Drop O_DIRECT from an open fd (for the unaligned tail — reference
+    disableDirectIO, internal/disk/directio_unix.go:40)."""
+    import fcntl
+
+    flags = fcntl.fcntl(fd, fcntl.F_GETFL)
+    fcntl.fcntl(fd, fcntl.F_SETFL, flags & ~os.O_DIRECT)
+
+
+class _DirectWriter:
+    """Sequential O_DIRECT writer: data accumulates in a page-aligned
+    staging buffer and is written in aligned 1 MiB bursts; the unaligned
+    tail is written after dropping O_DIRECT at close (the reference's
+    odirectWriter tail handling, cmd/xl-storage.go:1667).  On the first
+    EINVAL (filesystem without O_DIRECT) the writer downgrades itself
+    and reports it via `storage`, so the drive stops trying."""
+
+    def __init__(self, path: str, storage: "LocalStorage"):
+        import mmap
+
+        self._storage = storage
+        self._fd = os.open(path,
+                           os.O_WRONLY | os.O_CREAT | os.O_TRUNC
+                           | os.O_DIRECT, 0o644)
+        self._buf = mmap.mmap(-1, _DIO_BUF)
+        self._view = memoryview(self._buf)
+        self._fill = 0
+        self._direct = True
+        self._closed = False
+
+    def write(self, data) -> int:
+        data = memoryview(data).cast("B") if not isinstance(data, bytes) \
+            else data
+        total = len(data)
+        pos = 0
+        while pos < total:
+            n = min(_DIO_BUF - self._fill, total - pos)
+            self._view[self._fill:self._fill + n] = data[pos:pos + n]
+            self._fill += n
+            pos += n
+            if self._fill == _DIO_BUF:
+                self._flush_aligned(_DIO_BUF)
+        return total
+
+    def _flush_aligned(self, nbytes: int) -> None:
+        done = 0
+        while done < nbytes:
+            try:
+                done += os.write(self._fd, self._view[done:nbytes])
+            except OSError as e:
+                import errno
+
+                if self._direct and e.errno == errno.EINVAL:
+                    # filesystem rejected direct IO: downgrade this fd
+                    # and remember per drive
+                    _disable_direct(self._fd)
+                    self._direct = False
+                    self._storage._odirect = False
+                    continue
+                raise
+        self._fill -= nbytes
+        if self._fill:
+            self._view[:self._fill] = self._view[nbytes:nbytes + self._fill]
+
+    def flush(self) -> None:
+        """No-op: alignment forbids partial flushes; close() drains."""
+
+    # no fileno(): raw-fd fast paths (the bitrot writev gather) would
+    # bypass the aligned staging buffer and EINVAL on the O_DIRECT fd —
+    # their AttributeError fallback routes bytes through write() instead
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            aligned = (self._fill // _ALIGN) * _ALIGN
+            if aligned:
+                self._flush_aligned(aligned)
+            if self._fill:
+                if self._direct:
+                    _disable_direct(self._fd)
+                done = 0
+                while done < self._fill:
+                    done += os.write(self._fd, self._view[done:self._fill])
+                self._fill = 0
+            if FSYNC_ENABLED:
+                if hasattr(os, "fdatasync"):
+                    os.fdatasync(self._fd)
+                else:  # pragma: no cover - non-linux
+                    os.fsync(self._fd)
+        finally:
+            os.close(self._fd)
+            self._view.release()
+            self._buf.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+        return False
+
+
+class _DirectReader:
+    """Sequential O_DIRECT reader from offset 0: refills a page-aligned
+    1 MiB buffer with os.readv and serves arbitrary read() sizes from it
+    (reference odirectReader, cmd/xl-storage.go:1558).  The final short
+    read at an unaligned EOF is legal under O_DIRECT."""
+
+    def __init__(self, path: str):
+        import mmap
+        import stat as stat_mod
+
+        self._fd = os.open(path, os.O_RDONLY | os.O_DIRECT)
+        if stat_mod.S_ISDIR(os.fstat(self._fd).st_mode):
+            os.close(self._fd)
+            raise IsADirectoryError(path)
+        self._buf = mmap.mmap(-1, _DIO_BUF)
+        self._have = 0     # valid bytes in buffer
+        self._pos = 0      # consumed bytes in buffer
+        self._buf_off = 0  # file offset of the buffer's first byte
+        self._next_off = 0  # file offset of the next readv
+        self._eof = False
+        self._final = False
+        self._closed = False
+
+    def _refill(self) -> None:
+        if self._eof:
+            return
+        if self._final:
+            # a short O_DIRECT read only happens at EOF; another readv
+            # would run from an unaligned offset
+            self._eof = True
+            return
+        self._pos = 0
+        self._buf_off = self._next_off
+        self._have = os.readv(self._fd, [self._buf])
+        self._next_off += self._have
+        if self._have == 0:
+            self._eof = True
+        elif self._have < _DIO_BUF:
+            self._final = True
+
+    def seek(self, target: int, whence: int = 0) -> int:
+        """Absolute seeks only (the shard read path positions to frame
+        boundaries); re-reads from the preceding aligned offset so the
+        fd's O_DIRECT alignment is preserved."""
+        if whence != 0:
+            raise OSError("O_DIRECT reader supports absolute seek only")
+        if self._buf_off <= target <= self._buf_off + self._have:
+            self._pos = target - self._buf_off
+            self._eof = False
+            return target
+        aligned = (target // _ALIGN) * _ALIGN
+        os.lseek(self._fd, aligned, os.SEEK_SET)
+        self._next_off = aligned
+        self._have = self._pos = 0
+        self._buf_off = aligned
+        self._eof = self._final = False
+        skip = target - aligned
+        if skip:
+            self._refill()
+            self._pos = min(skip, self._have)
+        return target
+
+    def tell(self) -> int:
+        return self._buf_off + self._pos
+
+    def read(self, n: int = -1) -> bytes:
+        out = []
+        want = n if n >= 0 else None
+        while want is None or want > 0:
+            if self._pos == self._have:
+                self._refill()
+                if self._eof:
+                    break
+            take = self._have - self._pos if want is None \
+                else min(want, self._have - self._pos)
+            out.append(self._buf[self._pos:self._pos + take])
+            self._pos += take
+            if want is not None:
+                want -= take
+        return b"".join(out)
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            os.close(self._fd)
+            self._buf.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+        return False
+
+
 def _stored_algo(fi: FileInfo) -> str:
     """Bitrot algorithm a version's shards were written with."""
     from minio_tpu.erasure import bitrot
@@ -127,8 +339,88 @@ class LocalStorage(StorageAPI):
             quota = int(os.environ.get("MINIO_TPU_DRIVE_QUOTA", "0") or 0)
         self._quota = max(quota, 0)
         self._du_cache: tuple[float, int] = (0.0, 0)
+        self._odirect = ODIRECT_ENABLED
+        self._reaper: threading.Thread | None = None
         os.makedirs(self.root, exist_ok=True)
         os.makedirs(os.path.join(self.root, SYSTEM_VOL, TMP_DIR), exist_ok=True)
+        # reap trash a previous process left behind (crash mid-reap)
+        trash = os.path.join(self.root, SYSTEM_VOL, TRASH_DIR)
+        if os.path.isdir(trash) and os.listdir(trash):
+            self._kick_reaper()
+
+    # -- trash (non-blocking deletes) ---------------------------------------
+    def _move_to_trash(self, path: str) -> bool:
+        """Rename a file/dir into the trash for background reaping — the
+        request path pays one rename, not an rmtree (reference
+        moveToTrash, cmd/xl-storage.go:950).  False -> caller deletes
+        inline."""
+        trash = self._sys_path(TRASH_DIR)
+        try:
+            os.makedirs(trash, exist_ok=True)
+            os.replace(path, os.path.join(trash, uuid.uuid4().hex))
+        except OSError:
+            return False
+        self._kick_reaper()
+        return True
+
+    def _kick_reaper(self) -> None:
+        """Reaper thread runs until the trash is empty, then exits (no
+        idle thread per drive; the next trashed item respawns it)."""
+        with self._lock:
+            if self._reaper is not None and self._reaper.is_alive():
+                return
+            t = threading.Thread(target=self._reap_loop, daemon=True,
+                                 name=f"trash-reaper:{self.root}")
+            self._reaper = t
+        t.start()
+
+    def _reap_loop(self) -> None:
+        trash = self._sys_path(TRASH_DIR)
+        while True:
+            try:
+                entries = os.listdir(trash)
+            except OSError:
+                entries = []
+            if not entries:
+                # re-check under the lock so a rename that raced the
+                # empty listing still gets a live reaper
+                with self._lock:
+                    try:
+                        if not os.listdir(trash):
+                            self._reaper = None
+                            return
+                    except OSError:
+                        self._reaper = None
+                        return
+                continue
+            for name in entries:
+                p = os.path.join(trash, name)
+                try:
+                    if os.path.isdir(p):
+                        shutil.rmtree(p, ignore_errors=True)
+                    else:
+                        os.remove(p)
+                except OSError:
+                    pass
+
+    def _discard_dir(self, path: str) -> None:
+        """Reclaim a data dir without blocking the request path."""
+        if os.path.isdir(path):
+            if not self._move_to_trash(path):
+                shutil.rmtree(path, ignore_errors=True)
+
+    def wait_trash_empty(self, timeout: float = 10.0) -> bool:
+        """Test/maintenance hook: block until the reaper drains."""
+        deadline = time.time() + timeout
+        trash = self._sys_path(TRASH_DIR)
+        while time.time() < deadline:
+            try:
+                if not os.listdir(trash):
+                    return True
+            except OSError:
+                return True
+            time.sleep(0.02)
+        return False
 
     # -- identity -----------------------------------------------------------
     def disk_id(self) -> str:
@@ -212,7 +504,8 @@ class LocalStorage(StorageAPI):
         if not os.path.isdir(p):
             raise errors.VolumeNotFound(volume)
         if force:
-            shutil.rmtree(p, ignore_errors=True)
+            if not self._move_to_trash(p):
+                shutil.rmtree(p, ignore_errors=True)
             return
         try:
             os.rmdir(p)
@@ -245,7 +538,10 @@ class LocalStorage(StorageAPI):
         try:
             if os.path.isdir(p):
                 if recursive:
-                    shutil.rmtree(p)
+                    # one rename; the reaper does the rmtree off the
+                    # request path (moveToTrash, cmd/xl-storage.go:950)
+                    if not self._move_to_trash(p):
+                        shutil.rmtree(p)
                 else:
                     os.rmdir(p)
             else:
@@ -290,6 +586,11 @@ class LocalStorage(StorageAPI):
     def open_file_writer(self, volume: str, path: str) -> BinaryIO:
         p = self._file_path(volume, path)
         os.makedirs(os.path.dirname(p), exist_ok=True)
+        if self._odirect:
+            try:
+                return _DirectWriter(p, self)
+            except OSError:
+                self._odirect = False  # fs rejected O_DIRECT at open
         return _SyncedWriter(open(p, "wb"))
 
     def append_file(self, volume: str, path: str, data: bytes,
@@ -307,9 +608,31 @@ class LocalStorage(StorageAPI):
     def read_file_stream(self, volume: str, path: str, offset: int,
                          length: int) -> BinaryIO:
         p = self._file_path(volume, path)
+        if offset == 0 and self._odirect:
+            # whole-file sequential reads ride O_DIRECT (reference
+            # odirectReader for offset 0, cmd/xl-storage.go:1558);
+            # ranged reads stay buffered — their offsets are unaligned
+            try:
+                f = _DirectReader(p)
+            except FileNotFoundError:
+                raise errors.FileNotFound(f"{volume}/{path}")
+            except IsADirectoryError:
+                raise errors.FileNotFound(f"{volume}/{path}")
+            except OSError:
+                self._odirect = False
+            else:
+                if length >= 0:
+                    size = os.fstat(f._fd).st_size
+                    if size < length:
+                        f.close()
+                        raise errors.FileCorrupt(
+                            f"{volume}/{path}: size {size} < {length}")
+                return f
         try:
             f = open(p, "rb")
         except FileNotFoundError:
+            raise errors.FileNotFound(f"{volume}/{path}")
+        except IsADirectoryError:
             raise errors.FileNotFound(f"{volume}/{path}")
         if length >= 0:
             st = os.fstat(f.fileno())
@@ -388,10 +711,9 @@ class LocalStorage(StorageAPI):
             # null version (AWS suspended-bucket semantics) — reclaim its data
             replaced = xl.add_version(fi)
             if replaced is not None and replaced.get("dd"):
-                shutil.rmtree(
+                self._discard_dir(
                     os.path.join(self._file_path(volume, path),
-                                 replaced["dd"]),
-                    ignore_errors=True)
+                                 replaced["dd"]))
             self._write_xl(volume, path, xl)
             return
         v = xl.delete_version(fi.version_id)
@@ -401,7 +723,7 @@ class LocalStorage(StorageAPI):
             data_dir = v.get("dd", "")
             if data_dir:
                 dpath = os.path.join(self._file_path(volume, path), data_dir)
-                shutil.rmtree(dpath, ignore_errors=True)
+                self._discard_dir(dpath)
         if xl.versions:
             self._write_xl(volume, path, xl)
         else:
@@ -422,9 +744,8 @@ class LocalStorage(StorageAPI):
             raise errors.FileVersionNotFound(f"{volume}/{path}@{version_id}")
         dd = v.get("dd", "")
         if dd:
-            shutil.rmtree(
-                os.path.join(self._file_path(volume, path), dd),
-                ignore_errors=True)
+            self._discard_dir(
+                os.path.join(self._file_path(volume, path), dd))
         v["dd"] = ""
         v.pop("data", None)
         meta = v.setdefault("meta", {})
@@ -470,7 +791,9 @@ class LocalStorage(StorageAPI):
                         self._unsynced.discard(fp)
             dst_data_dir = os.path.join(dst_obj_dir, fi.data_dir)
             if os.path.isdir(dst_data_dir):
-                shutil.rmtree(dst_data_dir)
+                self._discard_dir(dst_data_dir)
+            if os.path.isdir(dst_data_dir):
+                shutil.rmtree(dst_data_dir)  # trash move failed
             os.replace(src_dir, dst_data_dir)
             _fsync_dir(dst_obj_dir)
         try:
@@ -484,8 +807,7 @@ class LocalStorage(StorageAPI):
             # overwrite of an unversioned / null version: reclaim the old
             # data dir (reference deletes old dataDir in RenameData,
             # cmd/xl-storage.go:1964)
-            shutil.rmtree(os.path.join(dst_obj_dir, replaced["dd"]),
-                          ignore_errors=True)
+            self._discard_dir(os.path.join(dst_obj_dir, replaced["dd"]))
 
     # -- listing ------------------------------------------------------------
     def list_dir(self, volume: str, path: str, count: int = -1) -> list[str]:

@@ -1,0 +1,165 @@
+"""O_DIRECT shard IO + trash-based non-blocking deletes
+(reference cmd/xl-storage.go:1667 CreateFile O_DIRECT, :1558
+odirectReader, :950 moveToTrash; internal/disk/directio_unix.go)."""
+
+import io
+import os
+import time
+
+import pytest
+
+from minio_tpu.storage import errors
+from minio_tpu.storage.local import (
+    _ALIGN, _DIO_BUF, SYSTEM_VOL, TRASH_DIR, LocalStorage,
+)
+
+SIZES = [0, 1, _ALIGN - 1, _ALIGN, _ALIGN + 1, _DIO_BUF - 3, _DIO_BUF,
+         _DIO_BUF + 7, 3 * _DIO_BUF + 12345]
+
+
+class TestDirectIO:
+    @pytest.mark.parametrize("size", SIZES)
+    def test_write_read_roundtrip(self, tmp_path, size):
+        """Every alignment edge: empty, sub-block, exact block, block+1,
+        buffer boundary, multi-buffer with unaligned tail."""
+        d = LocalStorage(str(tmp_path / "drv"))
+        data = os.urandom(size)
+        with d.open_file_writer("v", "f") as w:
+            # write in awkward chunk sizes to stress the staging buffer
+            pos = 0
+            for chunk in (7, 4096, 1 << 20, 999_999):
+                w.write(data[pos:pos + chunk])
+                pos += chunk
+                if pos >= size:
+                    break
+            if pos < size:
+                w.write(data[pos:])
+        assert d.read_all("v", "f") == data
+        # streamed read (O_DIRECT reader when offset==0)
+        f = d.read_file_stream("v", "f", 0, size)
+        out = b""
+        while True:
+            got = f.read(123_457)
+            if not got:
+                break
+            out += got
+        f.close()
+        assert out == data
+
+    def test_reader_seek_to_frame_boundaries(self, tmp_path):
+        """The bitrot read path seeks to hash-frame offsets: absolute
+        seeks must land exactly, including unaligned targets."""
+        d = LocalStorage(str(tmp_path / "drv"))
+        data = os.urandom(3 * _DIO_BUF + 4321)
+        with d.open_file_writer("v", "f") as w:
+            w.write(data)
+        f = d.read_file_stream("v", "f", 0, len(data))
+        for target in (0, 32, _ALIGN, _ALIGN + 1, _DIO_BUF - 1, _DIO_BUF,
+                       2 * _DIO_BUF + 999, len(data) - 5):
+            f.seek(target)
+            assert f.read(64) == data[target:target + 64], target
+        # backwards seek after reading forward
+        f.seek(10)
+        assert f.read(16) == data[10:26]
+        f.close()
+
+    def test_ranged_get_through_object_layer(self, tmp_path):
+        """End-to-end: ranged reads decode correctly with the O_DIRECT
+        reader underneath the bitrot frames."""
+        from minio_tpu.erasure.sets import ErasureSets
+
+        disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+        api = ErasureSets(disks, set_size=4)
+        api.make_bucket("b")
+        data = os.urandom((2 << 20) + 313)
+        api.put_object("b", "o", io.BytesIO(data), len(data))
+        _, stream = api.get_object("b", "o")
+        assert b"".join(stream) == data
+        for off, ln in ((0, 100), (1 << 20, 4096), (len(data) - 10, 10),
+                        ((1 << 20) + 1, (1 << 20) - 1)):
+            _, stream = api.get_object("b", "o", offset=off, length=ln)
+            assert b"".join(stream) == data[off:off + ln], (off, ln)
+
+    def test_fallback_when_fs_rejects_odirect(self, tmp_path, monkeypatch):
+        """A filesystem without O_DIRECT downgrades the drive instead of
+        failing writes."""
+        d = LocalStorage(str(tmp_path / "drv"))
+        import minio_tpu.storage.local as local_mod
+
+        real_open = os.open
+
+        def no_direct(path, flags, *a):
+            if flags & getattr(os, "O_DIRECT", 0):
+                raise OSError(22, "EINVAL")
+            return real_open(path, flags, *a)
+
+        monkeypatch.setattr(local_mod.os, "open", no_direct)
+        data = b"x" * 10_000
+        with d.open_file_writer("v", "f") as w:
+            w.write(data)
+        assert not d._odirect
+        assert d.read_all("v", "f") == data
+
+
+class TestTrashDeletes:
+    def test_recursive_delete_is_one_rename(self, tmp_path):
+        """Deleting a large object dir returns immediately; the bytes
+        disappear via the background reaper."""
+        d = LocalStorage(str(tmp_path / "drv"))
+        d.make_volume("b")
+        big = os.urandom(1 << 20)
+        for i in range(16):
+            d.write_all("b", f"obj/dd/part.{i}", big)
+        t0 = time.perf_counter()
+        d.delete("b", "obj", recursive=True)
+        dt = time.perf_counter() - t0
+        assert dt < 0.05, f"recursive delete took {dt*1000:.0f} ms"
+        with pytest.raises(errors.FileNotFound):
+            d.read_all("b", "obj/dd/part.0")
+        assert d.wait_trash_empty(10), "reaper never drained"
+
+    def test_overwrite_reclaims_old_data_dir_async(self, tmp_path):
+        from minio_tpu.erasure.sets import ErasureSets
+
+        disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+        api = ErasureSets(disks, set_size=4)
+        api.make_bucket("b")
+        api.put_object("b", "o", io.BytesIO(b"v1" * 200_000), 400_000)
+        api.put_object("b", "o", io.BytesIO(b"v2" * 200_000), 400_000)
+        _, stream = api.get_object("b", "o")
+        assert b"".join(stream) == b"v2" * 200_000
+        for d in disks:
+            assert d.wait_trash_empty(10)
+
+    def test_leftover_trash_reaped_at_boot(self, tmp_path):
+        """A crash mid-reap leaves trash behind; the next process boot
+        drains it (healing-tracker-style resume)."""
+        root = str(tmp_path / "drv")
+        d = LocalStorage(root)
+        trash = os.path.join(root, SYSTEM_VOL, TRASH_DIR)
+        os.makedirs(trash, exist_ok=True)
+        os.makedirs(os.path.join(trash, "leftover"), exist_ok=True)
+        with open(os.path.join(trash, "leftover", "junk"), "wb") as f:
+            f.write(b"z" * 100_000)
+        d2 = LocalStorage(root)
+        assert d2.wait_trash_empty(10)
+        assert not os.listdir(trash)
+
+    def test_delete_version_nonblocking(self, tmp_path):
+        """DeleteObject on a 64 MiB object ACKs in milliseconds
+        (VERDICT r3 #3 done-condition)."""
+        from minio_tpu.erasure.sets import ErasureSets
+
+        disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+        api = ErasureSets(disks, set_size=4)
+        api.make_bucket("b")
+        size = 64 << 20
+        api.put_object("b", "big", io.BytesIO(b"\xab" * size), size)
+        t0 = time.perf_counter()
+        api.delete_object("b", "big")
+        dt = time.perf_counter() - t0
+        assert dt < 0.25, f"delete took {dt*1000:.0f} ms"
+        with pytest.raises(errors.ObjectNotFound):
+            api.get_object_info("b", "big")
+        for d in disks:
+            assert d.wait_trash_empty(15)
