@@ -40,6 +40,9 @@ BLOCK_SIZE_V2 = 1 << 20  # reference blockSizeV2, cmd/object-api-common.go:40
 DEVICE_BATCH_BLOCKS = 32
 # Use the device only when at least this many bytes are in flight.
 DEVICE_MIN_BYTES = 8 << 20
+# Encoded batches kept in flight on the device pipeline (double
+# buffering: transfer of N+1 overlaps compute of N and readback of N-1).
+PIPELINE_DEPTH = 2
 
 _pool_lock = threading.Lock()
 _shared_pool: cf.ThreadPoolExecutor | None = None
@@ -227,6 +230,25 @@ class Erasure:
             return np.asarray(dev.encode(batch))
         return self._host.encode(batch)
 
+    def _encode_shards_async(self, batch: np.ndarray):
+        """Non-blocking dispatch: returns resolve() -> (B, M, S) parity.
+
+        Device dispatches ride JAX async dispatch — device_put, the
+        kernel, and the parity readback stay in flight while the caller
+        reads + splits the NEXT batch from disk, so H2D DMA, MXU compute,
+        D2H DMA, disk reads, and bitrot hashing all overlap (the
+        double-buffered streaming BASELINE.md names as the hard part;
+        reference overlaps via per-block goroutines,
+        cmd/erasure-encode.go:73).  Host encodes compute here and resolve
+        immediately — the AVX2 path is synchronous by design."""
+        b, k, s = batch.shape
+        dev = self._device(batch.nbytes, s)
+        if dev is not None:
+            out = dev.encode(batch)
+            return lambda: np.asarray(out)
+        out = self._host.encode(batch)
+        return lambda: out
+
     def _reconstruct_shards(self, batch: np.ndarray, available: tuple,
                             wanted: tuple) -> np.ndarray:
         b, k, s = batch.shape
@@ -304,16 +326,19 @@ class Erasure:
                     f"{n - len(dead)} writers < quorum {write_quorum}"
                 )
 
-        def flush_batch(batch: np.ndarray, block_len: int) -> None:
-            # batch: (B, K, S) blocks of block_len payload bytes each (a
-            # short tail block always flushes alone, so one length covers
-            # the whole batch).  One future per drive (goroutine-per-
-            # writer analog of parallelWriter, cmd/erasure-encode.go:36);
-            # a drive writes its shard of every block in order, so
-            # per-file layout is stable.  Batches go out as one batched-
-            # hash writev frame group per drive (write_frames); a drive's
-            # rows are a strided column of the batch, no per-shard copies.
-            parity = self._encode_shards(batch)
+        # Device pipeline: up to PIPELINE_DEPTH encoded batches stay in
+        # flight (JAX async dispatch), so batch N's H2D + kernel + parity
+        # readback overlap batch N+1's disk read/split and batch N-1's
+        # shard hashing/writes.  Host encodes resolve instantly — depth
+        # stays 0 so the memory profile is unchanged.
+        pending: list = []  # [(batch, block_len, resolve)]
+        depth = PIPELINE_DEPTH if self._device(
+            self.block_size * DEVICE_BATCH_BLOCKS, self.shard_size
+        ) is not None else 0
+
+        def emit_one() -> None:
+            batch, block_len, resolve = pending.pop(0)
+            parity = resolve()
             reap_inflight()
             shard_len = -(-block_len // self.k)
 
@@ -331,6 +356,20 @@ class Erasure:
                 for i in range(n)
                 if i not in dead and writers[i] is not None
             })
+
+        def flush_batch(batch: np.ndarray, block_len: int) -> None:
+            # batch: (B, K, S) blocks of block_len payload bytes each (a
+            # short tail block always flushes alone, so one length covers
+            # the whole batch).  One future per drive (goroutine-per-
+            # writer analog of parallelWriter, cmd/erasure-encode.go:36);
+            # a drive writes its shard of every block in order, so
+            # per-file layout is stable.  Batches go out as one batched-
+            # hash writev frame group per drive (write_frames); a drive's
+            # rows are a strided column of the batch, no per-shard copies.
+            pending.append((batch, block_len,
+                            self._encode_shards_async(batch)))
+            while len(pending) > depth:
+                emit_one()
 
         bs = self.block_size
         batch_max = DEVICE_BATCH_BLOCKS
@@ -367,10 +406,13 @@ class Erasure:
                     flush_batch(shards[None, ...], tail)
                 if len(data) < want:
                     break
+            while pending:
+                emit_one()
             reap_inflight()
         except BaseException:
             # unwind: wait out in-flight shard writes so callers can safely
             # close/clean up writers the pool threads were still feeding
+            pending.clear()
             for fut in inflight.values():
                 try:
                     fut.result()
