@@ -719,7 +719,16 @@ class AdminMixin:
         return self._json(await self._run(self.api.storage_info))
 
     async def admin_data_usage(self, request: web.Request, body: bytes):
+        """Cluster usage; with ?bucket= (and optional ?prefix=) the
+        hierarchical tree answers exact per-prefix usage with immediate
+        children broken out (reference prefix usage over
+        dataUsageCache, cmd/data-usage-cache.go)."""
         svcs = self._services_or_503()
+        bucket = request.rel_url.query.get("bucket", "")
+        if bucket:
+            prefix = request.rel_url.query.get("prefix", "").strip("/")
+            return self._json(
+                svcs.scanner.usage_by_prefix(bucket, prefix))
         return self._json(svcs.scanner.data_usage_info())
 
     async def admin_top_locks(self, request: web.Request, body: bytes):

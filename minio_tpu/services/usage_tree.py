@@ -1,0 +1,217 @@
+"""Hierarchical data-usage tree: per-folder stats, persisted per set,
+merged across sets/pools.
+
+Reference: cmd/data-usage-cache.go (dataUsageCache — a tree of
+dataUsageEntry keyed by folder hash, persisted per drive, merged for
+admin queries) + cmd/data-scanner.go:368 (subtree-bounded rescans).
+
+A node holds the stats of objects directly in its folder ("own") plus
+children folders; subtree queries aggregate on demand.  Depth and fanout
+are capped like the reference's: entries below the cap fold into their
+parent's own-stats so one pathological bucket cannot balloon the tree.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+MAX_DEPTH = 8        # folders deeper than this fold into the ancestor
+MAX_CHILDREN = 1024  # per-node fanout cap below the top level
+MAX_TOP = 1 << 16    # top-level cap; beyond it entries fold into root.own
+                     # and subtree-bounded rescans degrade to full walks
+
+
+def _histogram_bucket(size: int) -> str:
+    from .scanner import _histogram_bucket as hb
+
+    return hb(size)
+
+
+@dataclass
+class _Stats:
+    objects: int = 0
+    versions: int = 0
+    delete_markers: int = 0
+    size: int = 0
+    histogram: dict = field(default_factory=dict)
+
+    def add(self, size: int, versions: int, delete_markers: int) -> None:
+        if delete_markers and not versions:
+            self.delete_markers += delete_markers
+            return
+        self.objects += 1
+        self.versions += versions
+        self.delete_markers += delete_markers
+        self.size += size
+        b = _histogram_bucket(size)
+        self.histogram[b] = self.histogram.get(b, 0) + 1
+
+    def merge(self, other: "_Stats") -> None:
+        self.objects += other.objects
+        self.versions += other.versions
+        self.delete_markers += other.delete_markers
+        self.size += other.size
+        for k, v in other.histogram.items():
+            self.histogram[k] = self.histogram.get(k, 0) + v
+
+    def to_dict(self) -> dict:
+        return {"objects": self.objects, "versions": self.versions,
+                "deleteMarkers": self.delete_markers, "size": self.size,
+                "histogram": self.histogram}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "_Stats":
+        s = cls(objects=d.get("objects", 0), versions=d.get("versions", 0),
+                delete_markers=d.get("deleteMarkers", 0),
+                size=d.get("size", 0))
+        s.histogram = dict(d.get("histogram", {}))
+        return s
+
+
+class _Node:
+    __slots__ = ("own", "children")
+
+    def __init__(self):
+        self.own = _Stats()
+        self.children: dict[str, _Node] = {}
+
+
+class UsageTree:
+    """One bucket's folder tree."""
+
+    def __init__(self):
+        self.root = _Node()
+
+    # -- building -----------------------------------------------------------
+    def add(self, obj: str, size: int, versions: int = 1,
+            delete_markers: int = 0) -> None:
+        """Objects count in their parent folder's node; root-level
+        objects become leaf children keyed by name, so every top-level
+        segment is independently replaceable by a bounded rescan."""
+        parts = obj.split("/")
+        node = self.root
+        if len(parts) == 1:
+            child = node.children.get(parts[0])
+            if child is None:
+                if len(node.children) >= MAX_TOP:
+                    node.own.add(size, versions, delete_markers)
+                    return
+                child = node.children[parts[0]] = _Node()
+            child.own.add(size, versions, delete_markers)
+            return
+        for depth, seg in enumerate(parts[:-1]):
+            if depth >= MAX_DEPTH:
+                break  # too deep: count the object at this ancestor
+            child = node.children.get(seg)
+            if child is None:
+                if depth > 0 and len(node.children) >= MAX_CHILDREN:
+                    break  # fanout cap: fold into the parent's own stats
+                child = node.children[seg] = _Node()
+            node = child
+        node.own.add(size, versions, delete_markers)
+
+    # -- selective rescan (subtree-bounded cycles) --------------------------
+    def top_segments(self) -> list[str]:
+        return sorted(self.root.children)
+
+    def drop_top(self, seg: str) -> None:
+        self.root.children.pop(seg, None)
+
+    def replace_top(self, seg: str, subtree: "UsageTree") -> None:
+        """Install `subtree`'s content under top-level `seg`.  The
+        subtree must have been built from paths that all start with
+        `seg + '/'` (or equal `seg` for a root-level object)."""
+        child = subtree.root.children.get(seg)
+        if child is None:
+            self.root.children.pop(seg, None)
+            return
+        self.root.children[seg] = child
+
+    def clone(self) -> "UsageTree":
+        t = UsageTree()
+        t.root = _clone_node(self.root)
+        return t
+
+    # -- queries ------------------------------------------------------------
+    def _find(self, prefix: str) -> _Node | None:
+        node = self.root
+        for seg in [s for s in prefix.split("/") if s]:
+            node = node.children.get(seg)
+            if node is None:
+                return None
+        return node
+
+    def subtree(self, prefix: str = "") -> dict:
+        """Aggregated usage at/under `prefix` ('' = whole bucket)."""
+        node = self._find(prefix)
+        agg = _Stats()
+        if node is not None:
+            _aggregate(node, agg)
+        return agg.to_dict()
+
+    def children_of(self, prefix: str = "") -> dict[str, dict]:
+        """Immediate sub-folders of `prefix` with their aggregates (the
+        admin 'du' view, reference madmin DataUsageInfo by prefix)."""
+        node = self._find(prefix)
+        if node is None:
+            return {}
+        out = {}
+        for seg, child in sorted(node.children.items()):
+            agg = _Stats()
+            _aggregate(child, agg)
+            out[seg] = agg.to_dict()
+        return out
+
+    def totals(self) -> dict:
+        return self.subtree("")
+
+    # -- merge / persistence -------------------------------------------------
+    def merge(self, other: "UsageTree") -> None:
+        _merge_node(self.root, other.root)
+
+    def to_dict(self) -> dict:
+        return _node_to_dict(self.root)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "UsageTree":
+        t = cls()
+        t.root = _node_from_dict(d)
+        return t
+
+
+def _aggregate(node: _Node, agg: _Stats) -> None:
+    agg.merge(node.own)
+    for child in node.children.values():
+        _aggregate(child, agg)
+
+
+def _merge_node(dst: _Node, src: _Node) -> None:
+    dst.own.merge(src.own)
+    for seg, child in src.children.items():
+        mine = dst.children.get(seg)
+        if mine is None:
+            dst.children[seg] = _clone_node(child)
+        else:
+            _merge_node(mine, child)
+
+
+def _clone_node(node: _Node) -> _Node:
+    n = _Node()
+    n.own = _Stats.from_dict(node.own.to_dict())
+    n.children = {seg: _clone_node(c) for seg, c in node.children.items()}
+    return n
+
+
+def _node_to_dict(node: _Node) -> dict:
+    d: dict = {"s": node.own.to_dict()}
+    if node.children:
+        d["c"] = {seg: _node_to_dict(c) for seg, c in node.children.items()}
+    return d
+
+
+def _node_from_dict(d: dict) -> _Node:
+    n = _Node()
+    n.own = _Stats.from_dict(d.get("s", {}))
+    n.children = {seg: _node_from_dict(c)
+                  for seg, c in d.get("c", {}).items()}
+    return n

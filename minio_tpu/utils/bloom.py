@@ -63,6 +63,12 @@ class DataUpdateTracker:
             self.current.add(bucket)
             if obj:
                 self.current.add(f"{bucket}/{obj}")
+                # top-level segment mark: lets the scanner rescan only
+                # the changed subtree of a dirty bucket
+                # (cmd/data-scanner.go:368 subtree-bounded walks)
+                seg = obj.split("/", 1)[0]
+                if seg != obj:
+                    self.current.add(f"{bucket}/{seg}")
 
     def cycle(self) -> None:
         """Advance at the END of a scanner cycle."""
@@ -89,3 +95,13 @@ class DataUpdateTracker:
                 return True
             # writes in the in-progress window also count as dirty
             return bucket in self.history or bucket in self.current
+
+    def prefix_dirty(self, bucket: str, seg: str) -> bool:
+        """False ONLY when no write can have touched top-level segment
+        `seg` of `bucket` since the last cycle (false positives rescan
+        harmlessly; false negatives are impossible)."""
+        with self._mu:
+            if self.history is None:
+                return True
+            key = f"{bucket}/{seg}"
+            return key in self.history or key in self.current
