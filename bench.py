@@ -355,14 +355,38 @@ def bench_select():
         return len(data) / (time.perf_counter() - t0) / 2**30
 
     fast = max(run(big), run(big))
+
+    # JSON LINES scan rate through the pyarrow NDJSON fast path vs the
+    # per-row engine (VERDICT r3 #6 done-condition: >= 10x)
+    step_j = 100_000
+    jbig = ("\n".join(
+        "\n".join('{"k":"k%d","b":%d,"c":%d}' % (x, y, y % 97)
+                  for x, y in zip(a[i:i + step_j], b[i:i + step_j]))
+        for i in range(0, n // 2, step_j)
+    ) + "\n").encode()
+    jreq = sel.SelectRequest(
+        "SELECT COUNT(*) FROM s3object WHERE b > 500000",
+        {"JSON": {"Type": "LINES"}}, {"JSON": {}},
+    )
+
+    def run_json(data):
+        t0 = time.perf_counter()
+        out = b"".join(sel.run_select(jreq, iomod.BytesIO(data), len(data)))
+        assert out
+        return len(data) / (time.perf_counter() - t0) / 2**30
+
+    json_fast = max(run_json(jbig), run_json(jbig))
     os.environ["MINIO_TPU_SELECT_COLUMNAR"] = "0"
     try:
         sl = big[: len(big) // 8]
         sl = sl[: sl.rfind(b"\n") + 1]
         slow = run(sl)
+        jsl = jbig[: len(jbig) // 8]
+        jsl = jsl[: jsl.rfind(b"\n") + 1]
+        json_slow = run_json(jsl)
     finally:
         os.environ.pop("MINIO_TPU_SELECT_COLUMNAR", None)
-    return fast, slow
+    return fast, slow, json_fast, json_slow
 
 
 def main():
@@ -377,7 +401,7 @@ def main():
     ph2, _ = bench_e2e("host")
     e2e_put, e2e_get = max(e2e_put, p2), max(e2e_get, g2)
     e2e_put_host = max(e2e_put_host, ph2)
-    select_fast, select_row = bench_select()
+    select_fast, select_row, select_json, select_json_row = bench_select()
     try:
         tpu, link_h2d, link_d2h = bench_tpu()
     except Exception as e:  # pragma: no cover - report CPU-only on failure
@@ -419,6 +443,9 @@ def main():
             "select_scan_gibs": round(select_fast, 3),
             "select_row_engine_gibs": round(select_row, 3),
             "select_speedup": round(select_fast / select_row, 1),
+            "select_json_scan_gibs": round(select_json, 3),
+            "select_json_row_gibs": round(select_json_row, 3),
+            "select_json_speedup": round(select_json / select_json_row, 1),
             "note": (
                 "value = device-resident kernel aggregate; stream number is "
                 "transfer-inclusive and link-bound in this tunneled-TPU "

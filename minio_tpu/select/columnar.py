@@ -1,30 +1,41 @@
-"""Columnar CSV fast path for S3 Select.
+"""Columnar CSV + JSON LINES fast paths for S3 Select.
 
 The reference accelerates Select with simdjson and a generated-assembly
 CSV scanner (internal/s3select/simdj, select_benchmark_test.go); the
-equivalent here is pyarrow's C++ CSV parser plus vectorized predicate
-masks and aggregate kernels, so a 1 GiB `SELECT COUNT(*) ... WHERE`
-scans at parser speed instead of the per-row Python loop in sql.Evaluator.
+equivalent here is pyarrow's C++ CSV/NDJSON parsers plus vectorized
+predicate masks and aggregate kernels, so a 1 GiB `SELECT COUNT(*) ...
+WHERE` scans at parser speed instead of the per-row Python loop in
+sql.Evaluator.
 
-Every column is parsed as a STRING (a two-pass open sniffs the column
-names, then reopens with explicit string types), so pyarrow type
+CSV: every column is parsed as a STRING (a two-pass open sniffs the
+column names, then reopens with explicit string types), so pyarrow type
 inference can never fail on a later batch, projected values reproduce the
 raw CSV text byte-for-byte, and predicates replicate the row engine's
 exact semantics: a cell that parses as a number compares numerically
 against numeric(-looking) literals, anything else compares as text —
 including empty cells, matching sql._num/_cmp_pair per element.
 
+JSON LINES: native types ride arrow directly; only int/float/string
+columns vectorize (bool and nested columns drop to the row engine, whose
+coercions have no byte-exact arrow equivalent).
+
 Eligibility (everything else transparently falls back to the row engine):
-- CSV input, single-char delimiter/quote, "\n" records, no comment char
+- CSV input (single-char delimiter/quote, "\n" records, no comment
+  char) or JSON input with Type=LINES
 - projections: all plain columns / `*` / all aggregates
   (COUNT/SUM/MIN/MAX/AVG over a column or COUNT(*))
-- WHERE: AND/OR tree of comparisons `col <op> literal` (op in
-  =, !=, <, <=, >, >=), or absent
+- WHERE: AND/OR/NOT tree over comparisons `col <op> literal` (op in
+  =, !=, <, <=, >, >=), `col [NOT] LIKE 'pat' [ESCAPE e]`,
+  `col [NOT] IN (literals)`, `col [NOT] BETWEEN lit AND lit`,
+  `col IS [NOT] NULL`, or absent
 
 Known divergences from the row engine (documented, all garbage-data
-corner cases): structurally ragged rows (wrong column count) error
+corner cases): structurally ragged CSV rows (wrong column count) error
 in-band instead of being padded; SUM/AVG over *fractional* values may
-differ in the final ulp (vectorized vs sequential float accumulation).
+differ in the final ulp (vectorized vs sequential float accumulation);
+JSON `SELECT *` omits keys that are null/missing (the row engine omits
+missing keys but keeps explicit nulls); a JSON type conflict in a later
+block errors in-band.
 
 Disable with MINIO_TPU_SELECT_COLUMNAR=0.
 """
@@ -39,8 +50,9 @@ from typing import Iterator
 
 from . import eventstream as es
 from .records import _decomp
-from .sql import (AGGREGATES, Bin, Col, Evaluator, Func, Lit, Query,
-                  SQLError, _cmp_pair, _num)
+from .sql import (AGGREGATES, Between, Bin, Col, Evaluator, Func, InList,
+                  IsNull, Like, Lit, Query, SQLError, Un, _cmp_pair,
+                  _like_to_re, _num)
 
 # flush size mirrors run_select
 FLUSH = 256 << 10
@@ -123,20 +135,8 @@ def _enabled() -> bool:
     return os.environ.get("MINIO_TPU_SELECT_COLUMNAR", "1") != "0"
 
 
-def _eligible(req, q: Query) -> bool:
-    """Cheap pre-read eligibility: query + serialization shape only."""
-    inp = req.input_ser
-    if "CSV" not in inp:
-        return False
-    c = inp["CSV"] if isinstance(inp["CSV"], dict) else {}
-    if (c.get("RecordDelimiter", "\n") or "\n") != "\n":
-        return False
-    if len(c.get("FieldDelimiter", ",") or ",") != 1:
-        return False
-    if len(c.get("QuoteCharacter", '"') or '"') != 1:
-        return False
-    if c.get("Comments"):
-        return False
+def _shape_ok(q: Query) -> bool:
+    """Query-shape eligibility shared by the CSV and JSON fast paths."""
     if not _where_ok(q.where):
         return False
     if q.star and not q.projections:
@@ -154,9 +154,55 @@ def _eligible(req, q: Query) -> bool:
     )
 
 
+def _eligible(req, q: Query) -> bool:
+    """Cheap pre-read eligibility: query + serialization shape only."""
+    inp = req.input_ser
+    if "CSV" not in inp:
+        return False
+    c = inp["CSV"] if isinstance(inp["CSV"], dict) else {}
+    if (c.get("RecordDelimiter", "\n") or "\n") != "\n":
+        return False
+    if len(c.get("FieldDelimiter", ",") or ",") != 1:
+        return False
+    if len(c.get("QuoteCharacter", '"') or '"') != 1:
+        return False
+    if c.get("Comments"):
+        return False
+    return _shape_ok(q)
+
+
+def _lit_ok(v) -> bool:
+    """Literals the vector compare reproduces exactly.  NULL literals:
+    the row engine's comparisons against NULL are always false; stay on
+    it rather than comparing "None" text.  Int literals past 2^53 lose
+    precision in the float64 arrow compare while the row engine compares
+    exact ints."""
+    if v is None:
+        return False
+    if isinstance(v, int) and not isinstance(v, bool) and abs(v) >= 2**53:
+        return False
+    return True
+
+
 def _where_ok(e) -> bool:
     if e is None:
         return True
+    if isinstance(e, Un):
+        return e.op == "not" and _where_ok(e.e)
+    if isinstance(e, Like):
+        return (isinstance(e.e, Col) and isinstance(e.pat, Lit)
+                and isinstance(e.pat.v, str)
+                and (e.esc is None or (isinstance(e.esc, Lit)
+                                       and isinstance(e.esc.v, str))))
+    if isinstance(e, InList):
+        return isinstance(e.e, Col) and all(
+            isinstance(x, Lit) and _lit_ok(x.v) for x in e.items)
+    if isinstance(e, Between):
+        return (isinstance(e.e, Col)
+                and isinstance(e.lo, Lit) and _lit_ok(e.lo.v)
+                and isinstance(e.hi, Lit) and _lit_ok(e.hi.v))
+    if isinstance(e, IsNull):
+        return isinstance(e.e, Col)
     if isinstance(e, Bin):
         if e.op in ("and", "or"):
             return _where_ok(e.l) and _where_ok(e.r)
@@ -167,16 +213,7 @@ def _where_ok(e) -> bool:
                 lit = e.l
             else:
                 return False
-            # NULL literals: the row engine's comparisons against NULL are
-            # always false; stay on it rather than comparing "None" text.
-            # Int literals past 2^53 lose precision in the float64 arrow
-            # compare while the row engine compares exact ints.
-            v = lit.v
-            if v is None:
-                return False
-            if isinstance(v, int) and not isinstance(v, bool) and abs(v) >= 2**53:
-                return False
-            return True
+            return _lit_ok(lit.v)
     return False
 
 
@@ -241,6 +278,24 @@ class _Cols:
         self._str: dict[int, object] = {}
         self._num: dict[int, object] = {}
         self._arrow_num: dict[int, object] = {}
+        self._valid: dict[int, object] = {}
+
+    def valid(self, idx: int):
+        """bool ndarray of non-null cells (JSON columns carry nulls for
+        missing keys; CSV string columns never do)."""
+        v = self._valid.get(idx)
+        if v is None:
+            col = self.tbl.column(idx)
+            if col.null_count == 0:
+                import numpy as np
+
+                v = np.ones(len(col), dtype=bool)
+            else:
+                import pyarrow.compute as pc
+
+                v = pc.is_valid(col).to_numpy(zero_copy_only=False)
+            self._valid[idx] = v
+        return v
 
     def arrow_nums(self, idx: int):
         """float64 ChunkedArray, or None when any cell fails to parse."""
@@ -273,17 +328,147 @@ class _Cols:
         return n
 
 
-def _compile_where(e, names: list[str], alias: str, header_use: bool):
+def _compile_where(e, names: list[str], alias: str, header_use: bool,
+                   types=None, resolver=None):
     """Predicate AST -> fn(_Cols) -> bool ndarray replicating the row
     engine's per-element semantics exactly: numeric compare where both
     the cell and the literal parse as numbers, text compare otherwise
-    (sql._cmp_pair)."""
+    (sql._cmp_pair); LIKE/IN/BETWEEN/IS NULL/NOT vectorize by composing
+    the same leaves.  Null cells (JSON missing keys) make every
+    comparison false, as in the row engine.
+
+    `types` (JSON mode): arrow DataType per column.  Only int/float and
+    string columns vectorize exactly; bool columns and numeric-column vs
+    text-literal compares raise _Fallback (their row-engine coercions —
+    str(True), str(5.0) — have no byte-exact arrow equivalent)."""
     import numpy as np
 
     if not _PC_OPS:
         _PC_OPS.update(_pc_ops())
+    if resolver is None:
+        def resolver(nm):
+            return _resolve(names, nm, alias, header_use)
+
+    def _check_col(idx: int, want_text_exact: bool = False) -> None:
+        if types is None:
+            return
+        import pyarrow as pa
+
+        t = types[idx]
+        numeric = pa.types.is_integer(t) or pa.types.is_floating(t)
+        text = pa.types.is_string(t) or pa.types.is_large_string(t)
+        if not (numeric or text):
+            raise _Fallback(f"unsupported column type {t}")
+        if want_text_exact and not text:
+            raise _Fallback(f"text compare on {t} column")
+
+    def _mask_np(arrow_bool):
+        import pyarrow.compute as pc
+
+        return pc.fill_null(arrow_bool, False).to_numpy(
+            zero_copy_only=False).astype(bool)
+
+    def cmp_leaf(idx: int, op: str, lit_v):
+        fn = _OPS[op]
+        numlit = _num(lit_v) if not isinstance(lit_v, bool) else lit_v
+        strlit = str(lit_v)
+        pc_fn = _PC_OPS[op]
+        is_numlit = isinstance(numlit, (int, float)) \
+            and not isinstance(numlit, bool)
+        _check_col(idx, want_text_exact=not is_numlit)
+        if is_numlit:
+            def leaf(c, idx=idx, fn=fn, pc_fn=pc_fn, numlit=numlit,
+                     strlit=strlit):
+                arrow = c.arrow_nums(idx)
+                if arrow is not None:  # clean batch: stay in C++
+                    return _mask_np(pc_fn(arrow, float(numlit)))
+                num = c.nums(idx)
+                isnum = num.notna().to_numpy()
+                res = np.zeros(len(isnum), dtype=bool)
+                if isnum.any():
+                    res[isnum] = fn(num[isnum], numlit).to_numpy()
+                rest = ~isnum & c.valid(idx)
+                if rest.any():
+                    res[rest] = fn(
+                        c.text(idx)[rest].astype(str), strlit).to_numpy()
+                return res
+            return leaf
+
+        def leaf(c, idx=idx, pc_fn=pc_fn, strlit=strlit):
+            # lexicographic string compare entirely in arrow; a numeric
+            # JSON column compares as its text rendering (str(v)), same
+            # as the row engine's _cmp_pair string branch
+            import pyarrow as pa
+
+            col = c.tbl.column(idx)
+            if not pa.types.is_string(col.type) \
+                    and not pa.types.is_large_string(col.type):
+                col = col.cast(pa.string())
+            return _mask_np(pc_fn(col, strlit))
+        return leaf
 
     def comp(node):
+        if isinstance(node, Un):  # NOT expr: _truth(None) is False, so
+            inner = comp(node.e)   # null rows flip to True — plain ~mask
+            return lambda c: ~inner(c)
+        if isinstance(node, Like):
+            base = _like_to_re(
+                str(node.pat.v),
+                str(node.esc.v) if node.esc is not None else None)
+            # inline (?s) instead of flags= — pandas' match() refuses
+            # separate flags with some string backends
+            regex = re.compile("(?s)" + base.pattern)
+            idx = resolver(node.e.name)
+            _check_col(idx, want_text_exact=types is not None)
+            negate = node.negate
+
+            def leaf(c, idx=idx, regex=regex, negate=negate):
+                s = c.text(idx)
+                matched = s.astype(str).str.match(
+                    regex.pattern).to_numpy(dtype=bool, na_value=False)
+                valid = c.valid(idx)
+                # a null value makes LIKE and NOT LIKE both false
+                # (row engine returns None either way)
+                return (valid & ~matched) if negate else (valid & matched)
+            return leaf
+        if isinstance(node, InList):
+            idx = resolver(node.e.name)
+            leaves = [cmp_leaf(idx, "=", x.v) for x in node.items]
+            negate = node.negate
+
+            def leaf(c, idx=idx, leaves=leaves, negate=negate):
+                m = leaves[0](c)
+                for lf in leaves[1:]:
+                    m = m | lf(c)
+                return (c.valid(idx) & ~m) if negate else m
+            return leaf
+        if isinstance(node, Between):
+            idx = resolver(node.e.name)
+            lo = cmp_leaf(idx, ">=", node.lo.v)
+            hi = cmp_leaf(idx, "<=", node.hi.v)
+            negate = node.negate
+
+            def leaf(c, idx=idx, lo=lo, hi=hi, negate=negate):
+                m = lo(c) & hi(c)
+                return (c.valid(idx) & ~m) if negate else m
+            return leaf
+        if isinstance(node, IsNull):
+            idx = resolver(node.e.name)
+            _check_col(idx)
+            negate = node.negate
+
+            def leaf(c, idx=idx, negate=negate):
+                import pyarrow as pa
+                import pyarrow.compute as pc
+
+                col = c.tbl.column(idx)
+                isnull = ~c.valid(idx)
+                if pa.types.is_string(col.type) \
+                        or pa.types.is_large_string(col.type):
+                    # row engine: empty text counts as null
+                    isnull = isnull | _mask_np(pc.equal(col, ""))
+                return ~isnull if negate else isnull
+            return leaf
         if isinstance(node, Bin) and node.op in ("and", "or"):
             lf, rf = comp(node.l), comp(node.r)
             if node.op == "and":
@@ -292,36 +477,9 @@ def _compile_where(e, names: list[str], alias: str, header_use: bool):
         col, lit, flip = node.l, node.r, False
         if isinstance(col, Lit):
             col, lit, flip = node.r, node.l, True
-        idx = _resolve(names, col.name, alias, header_use)
+        idx = resolver(col.name)
         op = _FLIP.get(node.op, node.op) if flip else node.op
-        fn = _OPS[op]
-        numlit = _num(lit.v) if not isinstance(lit.v, bool) else lit.v
-        strlit = str(lit.v)
-        pc_fn = _PC_OPS[op]
-        if isinstance(numlit, (int, float)) and not isinstance(numlit, bool):
-            def leaf(c, idx=idx, fn=fn, pc_fn=pc_fn, numlit=numlit,
-                     strlit=strlit):
-                arrow = c.arrow_nums(idx)
-                if arrow is not None:  # clean batch: stay in C++
-                    return pc_fn(arrow, float(numlit)).to_numpy(
-                        zero_copy_only=False)
-                num = c.nums(idx)
-                isnum = num.notna().to_numpy()
-                res = np.zeros(len(isnum), dtype=bool)
-                if isnum.any():
-                    res[isnum] = fn(num[isnum], numlit).to_numpy()
-                rest = ~isnum
-                if rest.any():
-                    res[rest] = fn(
-                        c.text(idx)[rest].astype(str), strlit).to_numpy()
-                return res
-            return leaf
-
-        def leaf(c, idx=idx, pc_fn=pc_fn, strlit=strlit):
-            # lexicographic string compare entirely in arrow
-            return pc_fn(c.tbl.column(idx), strlit).to_numpy(
-                zero_copy_only=False)
-        return leaf
+        return cmp_leaf(idx, op, lit.v)
 
     return comp(e)
 
@@ -333,6 +491,8 @@ def try_columnar(req, query: Query, rw: Rewindable, object_size: int,
     if not _enabled():
         rw.rewind()
         return None
+    if "JSON" in req.input_ser:
+        return _try_json(req, query, rw, object_size, out)
     if not _eligible(req, query):
         stats["fallback"] += 1
         rw.rewind()
@@ -500,6 +660,167 @@ def try_columnar(req, query: Query, rw: Rewindable, object_size: int,
     return gen()
 
 
+def _try_json(req, query: Query, rw: Rewindable, object_size: int,
+              out) -> Iterator[bytes] | None:
+    """JSON LINES fast path: pyarrow's C++ NDJSON parser + the same
+    vectorized masks/aggregates as CSV (the simdjson analogue,
+    internal/s3select/simdj/reader.go:27).
+
+    Eligibility beyond _shape_ok: Type=LINES; queried columns must be
+    int/float/string (native JSON types compare exactly through arrow;
+    bool and nested columns drop to the row engine).  Documented
+    divergences: SELECT * omits keys that are null/missing (the row
+    engine omits missing keys but keeps explicit nulls); a type conflict
+    in a later block errors in-band instead of switching semantics
+    per-record."""
+    j = req.input_ser["JSON"] if isinstance(req.input_ser["JSON"], dict) \
+        else {}
+    jtype = (j.get("Type", "DOCUMENT") or "DOCUMENT").upper()
+    if jtype != "LINES" or not _shape_ok(query):
+        stats["fallback"] += 1
+        rw.rewind()
+        return None
+    try:
+        import pyarrow as pa
+        import pyarrow.json as pajson
+    except Exception:  # pragma: no cover - pyarrow baked into this env
+        rw.rewind()
+        return None
+
+    compression = req.input_ser.get("CompressionType", "NONE") or "NONE"
+    try:
+        raw = _decomp(rw, compression)
+        reader = pajson.open_json(
+            raw,
+            read_options=pajson.ReadOptions(block_size=4 << 20),
+        )
+        first = reader.read_next_batch()
+    except (pa.ArrowInvalid, pa.ArrowNotImplementedError, StopIteration,
+            OSError, ValueError):
+        stats["fallback"] += 1
+        rw.rewind()
+        return None
+
+    names = [f.name for f in first.schema]
+    types = [f.type for f in first.schema]
+    alias = query.table_alias
+    ev = Evaluator(query)
+
+    def resolver(name: str) -> int:
+        """JSON keys resolve by name only — no positional _N fallback
+        (the row engine would treat an absent '_2' key as a missing
+        field, not column 2)."""
+        parts = name.split(".")
+        if alias and parts and parts[0].lower() == alias:
+            parts = parts[1:]
+        if len(parts) != 1:
+            raise _Fallback(f"nested column {name}")
+        p = parts[0]
+        if p in names:
+            return names.index(p)
+        lowered = [s.lower() for s in names]
+        if p.lower() in lowered:
+            return lowered.index(p.lower())
+        raise _Fallback(f"unknown column {name}")
+
+    try:
+        mask_fn = (_compile_where(query.where, names, alias, True, types,
+                                  resolver=resolver)
+                   if query.where is not None else None)
+        agg_cols: list[int | None] = []
+        proj_cols: list[int] = []
+        if ev.is_aggregate:
+            for p in query.projections:
+                f = p.expr
+                if f.star:
+                    agg_cols.append(None)
+                    continue
+                idx = resolver(f.args[0].name)
+                t = types[idx]
+                if not (pa.types.is_integer(t) or pa.types.is_floating(t)
+                        or pa.types.is_string(t)
+                        or pa.types.is_large_string(t)):
+                    raise _Fallback(f"aggregate over {t} column")
+                agg_cols.append(idx)
+        elif query.star:
+            proj_cols = list(range(len(names)))
+        else:
+            proj_cols = [resolver(p.expr.name)
+                         for p in query.projections]
+    except _Fallback:
+        stats["fallback"] += 1
+        rw.rewind()
+        return None
+
+    stats["fast"] += 1
+    rw.commit()
+
+    def gen() -> Iterator[bytes]:
+        returned = 0
+        buf = bytearray()
+        limit = query.limit
+        n_out = 0
+        try:
+            for batch in chain([first], reader):
+                if (limit is not None and n_out >= limit
+                        and not ev.is_aggregate):
+                    break
+                tbl = pa.Table.from_batches([batch])
+                if mask_fn is not None:
+                    mask = mask_fn(_Cols(tbl))
+                    if not mask.any():
+                        continue
+                    if not mask.all():
+                        tbl = tbl.filter(pa.array(mask))
+                if tbl.num_rows == 0:
+                    continue
+                if ev.is_aggregate:
+                    _accumulate(ev, tbl, agg_cols)
+                    continue
+                take = tbl.num_rows
+                if limit is not None:
+                    take = min(take, limit - n_out)
+                    tbl = tbl.slice(0, take)
+                pull = [tbl.column(i).to_pylist() for i in proj_cols]
+                if query.star:
+                    keys = [names[i] for i in proj_cols]
+                    for row in zip(*pull):
+                        rec = {k: v for k, v in zip(keys, row)
+                               if v is not None}
+                        buf += out.serialize(rec)
+                        if len(buf) >= FLUSH:
+                            returned += len(buf)
+                            yield es.records_message(bytes(buf))
+                            buf.clear()
+                else:
+                    keys = [
+                        p.alias or Evaluator._auto_name(p.expr, i)
+                        for i, p in enumerate(query.projections)
+                    ]
+                    for row in zip(*pull):
+                        buf += out.serialize(dict(zip(keys, row)))
+                        if len(buf) >= FLUSH:
+                            returned += len(buf)
+                            yield es.records_message(bytes(buf))
+                            buf.clear()
+                n_out += take
+            if ev.is_aggregate:
+                buf += out.serialize(ev.aggregate_result())
+            if buf:
+                returned += len(buf)
+                yield es.records_message(bytes(buf))
+            if req.request_progress:
+                yield es.progress_message(object_size, object_size, returned)
+            yield es.stats_message(object_size, object_size, returned)
+            yield es.end_message()
+        except SQLError as e:
+            yield es.error_message("InvalidQuery", str(e))
+        except pa.ArrowInvalid as e:
+            yield es.error_message("InvalidQuery", f"JSON parse: {e}")
+
+    return gen()
+
+
 def _accumulate(ev: Evaluator, tbl, agg_cols) -> None:
     """Vectorized Evaluator.accumulate over a filtered batch: fills the
     evaluator's _agg_state so aggregate_result() serializes identically.
@@ -521,7 +842,10 @@ def _accumulate(ev: Evaluator, tbl, agg_cols) -> None:
             continue
         arrow = cols.arrow_nums(agg_cols[i])
         if arrow is not None:  # clean batch: every cell numeric, stay in C++
-            st["count"] += len(arrow)
+            valid = len(arrow) - arrow.null_count  # JSON missing keys
+            if valid == 0:
+                continue
+            st["count"] += valid
             if f.name in ("sum", "avg"):
                 st["sum"] += float(pc.sum(arrow).as_py())
             if f.name in ("min", "max"):
