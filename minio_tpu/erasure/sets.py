@@ -530,6 +530,10 @@ class ErasureServerPools:
                 return p.get_object(bucket, obj, offset, length, version_id)
             except (errors.ObjectNotFound, errors.VersionNotFound) as ex:
                 last = ex
+        # error path only: a miss in a bucket that does not exist is
+        # NoSuchBucket, not NoSuchKey (AWS + reference semantics)
+        if not self.bucket_exists(bucket):
+            raise errors.BucketNotFound(bucket)
         raise last
 
     def get_object_info(self, bucket, obj, version_id="") -> ObjectInfo:
@@ -539,6 +543,8 @@ class ErasureServerPools:
                 return p.get_object_info(bucket, obj, version_id)
             except (errors.ObjectNotFound, errors.VersionNotFound) as ex:
                 last = ex
+        if not self.bucket_exists(bucket):
+            raise errors.BucketNotFound(bucket)
         raise last
 
     def delete_objects(self, bucket, dels: list) -> list:

@@ -1,0 +1,242 @@
+"""S3 error-table conformance (reference cmd/api-errors.go): table
+integrity, reference-parity spot checks, and live handler error paths
+asserting code + HTTP status end-to-end."""
+
+import os
+import re
+
+import pytest
+
+from minio_tpu.server.s3errors import S3_ERRORS, S3Error
+
+from .s3_harness import S3TestServer
+
+REFERENCE = "/root/reference/cmd/api-errors.go"
+
+
+class TestTableIntegrity:
+    def test_size_and_shape(self):
+        assert len(S3_ERRORS) >= 320
+        for code, (status, msg) in S3_ERRORS.items():
+            assert re.fullmatch(r"[A-Za-z0-9]+", code), code
+            assert 300 <= status <= 599, (code, status)
+            assert isinstance(msg, str), code
+
+    def test_families_present(self):
+        """Every functional family the reference table covers has its
+        codes: replication, select, STS, object lock, SSE, POST policy,
+        admin."""
+        families = {
+            "replication": [
+                "ReplicationConfigurationNotFoundError",
+                "RemoteDestinationNotFoundError",
+                "ReplicationDestinationMissingLockError",
+                "RemoteTargetNotFoundError",
+                "ReplicationRemoteConnectionError",
+                "ReplicationNoMatchingRuleError",
+                "RemoteTargetNotVersionedError",
+                "ReplicationSourceNotVersionedError",
+                "ReplicationNeedsVersioningError",
+                "ReplicationBucketNeedsVersioningError",
+            ],
+            "select": [
+                "SelectParseError",
+                "InvalidExpressionType", "InvalidColumnIndex",
+                "ExpressionTooLong", "IllegalSqlFunctionArgument",
+                "InvalidKeyPath", "InvalidCompressionFormat",
+                "InvalidFileHeaderInfo", "InvalidJsonType",
+                "InvalidQuoteFields", "InvalidRequestParameter",
+                "InvalidDataType", "InvalidTextEncoding", "InvalidDataSource",
+                "InvalidTableAlias", "MissingRequiredParameter",
+                "ObjectSerializationConflict", "UnsupportedSQLOperation",
+                "UnsupportedSQLStructure", "UnsupportedSyntax",
+                "UnsupportedRangeHeader", "LexerInvalidChar",
+                "ParseExpectedDatePart", "ParseExpectedKeyword",
+                "ParseExpectedTokenType", "ParseExpected2TokenTypes",
+                "EvaluatorInvalidArguments",
+            ],
+            "sts": [
+                "ExpiredToken", "InvalidClientGrantsToken",
+                "MalformedPolicyDocument", "MissingParameter",
+                "InvalidParameterValue", "InsecureConnection",
+                "InvalidClientCertificate", "STSNotInitialized",
+            ],
+            "object-lock": [
+                "ObjectLocked", "InvalidRetentionDate",
+                "PastObjectLockRetainDate", "UnknownWORMModeDirective",
+                "ObjectLockInvalidHeaders",
+            ],
+            "sse": [
+                "InvalidEncryptionMethod", "InsecureSSECustomerRequest",
+                "SSEMultipartEncrypted", "SSEEncryptedObject",
+                "InvalidEncryptionParameters", "InvalidSSECustomerAlgorithm",
+                "InvalidSSECustomerKey", "MissingSSECustomerKey",
+                "MissingSSECustomerKeyMD5", "SSECustomerKeyMD5Mismatch",
+                "KMSNotConfigured",
+            ],
+            "post-policy": [
+                "MalformedPOSTRequest", "PostPolicyInvalidKeyName",
+                "IncorrectNumberOfFilesInPostRequest",
+                "MaxPostPreDataLengthExceededError",
+                "SignatureVersionNotSupported",
+            ],
+            "admin": [
+                "XMinioAdminBucketQuotaExceeded", "AdminInvalidArgument",
+                "XMinioAdminNotificationTargetsTestFailed",
+                "XMinioAdminProfilerNotEnabled",
+                "XMinioAdminCredentialsMismatch",
+                "XMinioInsecureClientRequest", "OperationTimedOut",
+            ],
+        }
+        for family, codes in families.items():
+            missing = [c for c in codes if c not in S3_ERRORS]
+            assert not missing, f"{family}: missing {missing}"
+
+
+@pytest.mark.skipif(not os.path.exists(REFERENCE),
+                    reason="reference tree not present")
+class TestReferenceParity:
+    def test_every_reference_code_covered_with_matching_status(self):
+        """Every code in the reference's errorCodes map exists here with
+        the same HTTP status."""
+        src = open(REFERENCE).read()
+        pat = re.compile(
+            r'Code:\s*"([^"]+)",\s*Description:\s*"(?:[^"\\]|\\.)*",'
+            r'\s*HTTPStatusCode:\s*([\w\.]+)', re.S)
+        status_map = {
+            "http.StatusBadRequest": 400, "http.StatusConflict": 409,
+            "http.StatusForbidden": 403,
+            "http.StatusInsufficientStorage": 507,
+            "http.StatusInternalServerError": 500,
+            "http.StatusLengthRequired": 411,
+            "http.StatusMethodNotAllowed": 405, "http.StatusNotFound": 404,
+            "http.StatusNotImplemented": 501,
+            "http.StatusPreconditionFailed": 412,
+            "http.StatusRequestedRangeNotSatisfiable": 416,
+            "http.StatusServiceUnavailable": 503,
+            "http.StatusUnauthorized": 401, "499": 499,
+        }
+        seen = {}
+        for code, st in pat.findall(src):
+            seen.setdefault(code, status_map[st])
+        assert len(seen) >= 200
+        missing = [c for c in seen if c not in S3_ERRORS]
+        assert not missing, f"missing {len(missing)}: {missing[:10]}"
+        diff = [(c, S3_ERRORS[c][0], seen[c]) for c in seen
+                if S3_ERRORS[c][0] != seen[c]]
+        assert not diff, diff[:10]
+
+
+class TestLiveErrorPaths:
+    """Handler error paths end-to-end: response carries the right code
+    AND the table's status for that code."""
+
+    @pytest.fixture(scope="class")
+    def srv(self, tmp_path_factory):
+        s = S3TestServer(str(tmp_path_factory.mktemp("errdrives")))
+        yield s
+        s.close()
+
+    def _check(self, resp, code):
+        body = resp.body if isinstance(resp.body, bytes) else resp.body
+        assert f"<Code>{code}</Code>".encode() in body, body[:300]
+        assert resp.status == S3_ERRORS[code][0], \
+            (code, resp.status, S3_ERRORS[code][0])
+
+    def test_object_and_bucket_errors(self, srv):
+        assert srv.request("PUT", "/errb").status == 200
+        self._check(srv.request("GET", "/errb/missing"), "NoSuchKey")
+        self._check(srv.request("GET", "/nosuchbkt/obj"), "NoSuchBucket")
+        self._check(srv.request("PUT", "/errb"), "BucketAlreadyOwnedByYou")
+        self._check(srv.request("PUT", "/e!!"), "InvalidBucketName")
+        srv.request("PUT", "/errb/x", data=b"d")
+        self._check(srv.request("DELETE", "/errb"), "BucketNotEmpty")
+        self._check(
+            srv.request("GET", "/errb/x",
+                        headers={"Range": "bytes=99999-"}),
+            "InvalidRange")
+        self._check(
+            srv.request("GET", "/errb/x",
+                        query=[("versionId", "not-a-version")]),
+            "NoSuchVersion")
+
+    def test_conditional_and_digest_errors(self, srv):
+        srv.request("PUT", "/errb/c", data=b"d")
+        self._check(
+            srv.request("GET", "/errb/c",
+                        headers={"If-Match": '"wrong-etag"'}),
+            "PreconditionFailed")
+        self._check(
+            srv.request("PUT", "/errb/c", data=b"d",
+                        headers={"Content-MD5": "AAAAAAAAAAAAAAAAAAAAAA=="}),
+            "BadDigest")
+        self._check(
+            srv.request("PUT", "/errb/c", data=b"d",
+                        headers={"Content-MD5": "!!notbase64!!"}),
+            "InvalidDigest")
+
+    def test_multipart_errors(self, srv):
+        self._check(
+            srv.request("PUT", "/errb/mp", data=b"d",
+                        query=[("partNumber", "1"),
+                               ("uploadId", "does-not-exist")]),
+            "NoSuchUpload")
+        r = srv.request("POST", "/errb/mp", query=[("uploads", "")])
+        assert r.status == 200
+        import re as re_mod
+
+        uid = re_mod.search(b"<UploadId>([^<]+)</UploadId>", r.body).group(1)
+        self._check(
+            srv.request("PUT", "/errb/mp", data=b"d",
+                        query=[("partNumber", "0"),
+                               ("uploadId", uid.decode())]),
+            "InvalidArgument")
+        self._check(
+            srv.request("POST", "/errb/mp",
+                        query=[("uploadId", uid.decode())],
+                        data=b"<CompleteMultipartUpload><Part>"
+                             b"<PartNumber>1</PartNumber>"
+                             b"<ETag>bogus</ETag></Part>"
+                             b"</CompleteMultipartUpload>"),
+            "InvalidPart")
+
+    def test_policy_and_config_errors(self, srv):
+        self._check(
+            srv.request("GET", "/errb", query=[("policy", "")]),
+            "NoSuchBucketPolicy")
+        self._check(
+            srv.request("GET", "/errb", query=[("lifecycle", "")]),
+            "NoSuchLifecycleConfiguration")
+        self._check(
+            srv.request("GET", "/errb", query=[("tagging", "")]),
+            "NoSuchTagSet")
+        self._check(
+            srv.request("GET", "/errb", query=[("cors", "")]),
+            "NoSuchCORSConfiguration")
+        self._check(
+            srv.request("GET", "/errb", query=[("encryption", "")]),
+            "ServerSideEncryptionConfigurationNotFoundError")
+        self._check(
+            srv.request("GET", "/errb", query=[("replication", "")]),
+            "ReplicationConfigurationNotFoundError")
+        self._check(
+            srv.request("PUT", "/errb", data=b"<notxml",
+                        query=[("lifecycle", "")]),
+            "MalformedXML")
+
+    def test_auth_errors(self, srv):
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+        conn.request("GET", "/errb/x", headers={
+            "Authorization":
+                "AWS4-HMAC-SHA256 Credential=nosuchkey/20260101/us-east-1/"
+                "s3/aws4_request, SignedHeaders=host, Signature=abc",
+            "x-amz-date": "20260101T000000Z",
+            "x-amz-content-sha256": "UNSIGNED-PAYLOAD",
+        })
+        r = conn.getresponse()
+        body = r.read()
+        conn.close()
+        assert b"InvalidAccessKeyId" in body
+        assert r.status == S3_ERRORS["InvalidAccessKeyId"][0]
