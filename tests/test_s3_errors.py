@@ -85,7 +85,7 @@ class TestTableIntegrity:
                 "XMinioAdminNotificationTargetsTestFailed",
                 "XMinioAdminProfilerNotEnabled",
                 "XMinioAdminCredentialsMismatch",
-                "XMinioInsecureClientRequest", "OperationTimedOut",
+                "XMinioInsecureClientRequest", "RequestTimeout",
             ],
         }
         for family, codes in families.items():
