@@ -1,4 +1,5 @@
-"""Broker-backed notification targets: Kafka, MQTT, Redis, NATS.
+"""Broker-backed notification targets: Kafka, MQTT, Redis, NATS, NSQ,
+AMQP 0-9-1, PostgreSQL.
 
 Wire-protocol clients written directly on sockets (no client libraries in
 this image), each implementing the same target interface as
@@ -9,7 +10,10 @@ semantics of the reference's store-wrapped targets).
 Reference: internal/event/target/kafka.go (sarama producer, :238 Send),
 internal/event/target/mqtt.go (paho client, :168 Send),
 internal/event/target/redis.go (HSET for "namespace" format, RPUSH for
-"access", :238), internal/event/target/nats.go (:301).
+"access", :238), internal/event/target/nats.go (:301),
+internal/event/target/nsq.go (go-nsq producer),
+internal/event/target/amqp.go (streadway/amqp publisher),
+internal/event/target/postgresql.go (database/sql INSERT/UPSERT).
 """
 
 from __future__ import annotations
@@ -448,3 +452,278 @@ class NATSTarget(_SocketTarget):
         sock.sendall(b"PUB %s %d\r\n%s\r\n" % (
             self.subject.encode(), len(body), body))
         self._expect_ok(sock)
+
+
+# ----------------------------------------------------------------------- NSQ
+
+
+class NSQTarget(_SocketTarget):
+    """NSQ TCP protocol v2 publisher: magic "  V2", IDENTIFY, then
+    PUB <topic> frames, each acknowledged with an OK response frame —
+    reference internal/event/target/nsq.go (go-nsq producer)."""
+
+    kind = "nsq"
+
+    def __init__(self, target_name: str, host: str, port: int, topic: str,
+                 timeout: float = 5.0):
+        super().__init__(host, port, timeout)
+        self.name = target_name
+        self.topic = topic
+
+    def _frame(self, sock: socket.socket) -> tuple[int, bytes]:
+        size = struct.unpack(">i", _recv_exact(sock, 4))[0]
+        data = _recv_exact(sock, size)
+        ftype = struct.unpack(">i", data[:4])[0]
+        return ftype, data[4:]
+
+    def _expect_ok(self, sock: socket.socket) -> None:
+        while True:
+            ftype, body = self._frame(sock)
+            if ftype == 0:  # FrameTypeResponse
+                if body == b"_heartbeat_":
+                    sock.sendall(b"NOP\n")
+                    continue
+                if body == b"OK":
+                    return
+                raise TargetError(f"nsq unexpected response {body!r}")
+            if ftype == 1:  # FrameTypeError
+                raise TargetError(f"nsq: {body.decode(errors='replace')}")
+
+    def _handshake(self, sock: socket.socket) -> None:
+        sock.sendall(b"  V2")
+        ident = json.dumps({
+            "client_id": f"minio-tpu-{self.name}",
+            "hostname": socket.gethostname(),
+            "user_agent": "minio-tpu/1",
+            "feature_negotiation": False,
+        }).encode()
+        sock.sendall(b"IDENTIFY\n" + struct.pack(">i", len(ident)) + ident)
+        self._expect_ok(sock)
+
+    def _publish(self, sock: socket.socket, log: dict) -> None:
+        body = json.dumps(log).encode()
+        sock.sendall(b"PUB " + self.topic.encode() + b"\n"
+                     + struct.pack(">i", len(body)) + body)
+        self._expect_ok(sock)
+
+
+# ---------------------------------------------------------------- AMQP 0-9-1
+
+
+def _amqp_short_str(s: str) -> bytes:
+    b = s.encode()
+    return bytes([len(b)]) + b
+
+
+def _amqp_long_str(b: bytes) -> bytes:
+    return struct.pack(">I", len(b)) + b
+
+
+class AMQPTarget(_SocketTarget):
+    """Minimal AMQP 0-9-1 publisher with publisher confirms: the full
+    connection/channel handshake on sockets, then basic.publish of the
+    event JSON to an exchange/routing-key, each awaited with basic.ack
+    (reference internal/event/target/amqp.go via streadway/amqp)."""
+
+    kind = "amqp"
+
+    def __init__(self, target_name: str, host: str, port: int,
+                 exchange: str = "", routing_key: str = "",
+                 username: str = "guest", password: str = "guest",
+                 timeout: float = 5.0):
+        super().__init__(host, port, timeout)
+        self.name = target_name
+        self.exchange = exchange
+        self.routing_key = routing_key or target_name
+        self.username = username
+        self.password = password
+
+    # -- framing ------------------------------------------------------------
+    def _send_frame(self, sock, ftype: int, channel: int,
+                    payload: bytes) -> None:
+        sock.sendall(struct.pack(">BHI", ftype, channel, len(payload))
+                     + payload + b"\xce")
+
+    def _send_method(self, sock, channel: int, class_id: int,
+                     method_id: int, args: bytes) -> None:
+        self._send_frame(sock, 1, channel,
+                         struct.pack(">HH", class_id, method_id) + args)
+
+    def _read_frame(self, sock) -> tuple[int, int, bytes]:
+        hdr = _recv_exact(sock, 7)
+        ftype, channel, size = struct.unpack(">BHI", hdr)
+        payload = _recv_exact(sock, size)
+        if _recv_exact(sock, 1) != b"\xce":
+            raise TargetError("amqp bad frame end")
+        return ftype, channel, payload
+
+    def _wait_method(self, sock, class_id: int,
+                     method_id: int) -> bytes:
+        while True:
+            ftype, _, payload = self._read_frame(sock)
+            if ftype == 8:  # heartbeat
+                continue
+            if ftype != 1:
+                continue
+            cid, mid = struct.unpack(">HH", payload[:4])
+            if (cid, mid) == (class_id, method_id):
+                return payload[4:]
+            if cid == 10 and mid == 50:  # connection.close
+                raise TargetError("amqp connection closed by broker")
+            if cid == 20 and mid == 40:  # channel.close
+                raise TargetError("amqp channel closed by broker")
+
+    def _handshake(self, sock: socket.socket) -> None:
+        sock.sendall(b"AMQP\x00\x00\x09\x01")
+        self._wait_method(sock, 10, 10)  # connection.start
+        sasl = b"\x00" + self.username.encode() + b"\x00" \
+            + self.password.encode()
+        props = struct.pack(">I", 0)  # empty client-properties table
+        self._send_method(sock, 0, 10, 11, props
+                          + _amqp_short_str("PLAIN")
+                          + _amqp_long_str(sasl)
+                          + _amqp_short_str("en_US"))
+        tune = self._wait_method(sock, 10, 30)  # connection.tune
+        channel_max, frame_max, heartbeat = struct.unpack(">HIH", tune[:8])
+        self._send_method(sock, 0, 10, 31, struct.pack(
+            ">HIH", channel_max or 1, frame_max or 131072, 0))
+        self._send_method(sock, 0, 10, 40,  # connection.open vhost "/"
+                          _amqp_short_str("/") + b"\x00\x00")
+        self._wait_method(sock, 10, 41)
+        self._send_method(sock, 1, 20, 10, b"\x00")  # channel.open
+        self._wait_method(sock, 20, 11)
+        self._send_method(sock, 1, 85, 10, b"\x00")  # confirm.select
+        self._wait_method(sock, 85, 11)
+
+    def _publish(self, sock: socket.socket, log: dict) -> None:
+        body = json.dumps(log).encode()
+        # basic.publish: reserved(2) exchange routing-key flags(1)
+        self._send_method(sock, 1, 60, 40, b"\x00\x00"
+                          + _amqp_short_str(self.exchange)
+                          + _amqp_short_str(self.routing_key) + b"\x00")
+        # content header: class(60) weight(0) body-size flags
+        # (content-type + delivery-mode set)
+        props_flags = 0x8000 | 0x1000  # content-type, delivery-mode
+        header = struct.pack(">HHQH", 60, 0, len(body), props_flags) \
+            + _amqp_short_str("application/json") + bytes([2])
+        self._send_frame(sock, 2, 1, header)
+        self._send_frame(sock, 3, 1, body)
+        ack = self._wait_method(sock, 60, 80)  # basic.ack
+        if len(ack) < 9:
+            raise TargetError("amqp short basic.ack")
+
+
+# ------------------------------------------------------------------ Postgres
+
+
+class PostgresTarget(_SocketTarget):
+    """PostgreSQL wire protocol v3: startup + cleartext/md5 auth, then
+    simple-Query INSERTs into an events table (created on first
+    connect) — reference internal/event/target/postgresql.go.
+    format="namespace" upserts one row per object key; "access" appends
+    (event_time, event_data) rows."""
+
+    kind = "postgresql"
+
+    def __init__(self, target_name: str, host: str, port: int, table: str,
+                 database: str = "postgres", username: str = "postgres",
+                 password: str = "", fmt: str = _FMT_ACCESS,
+                 timeout: float = 5.0):
+        if fmt not in (_FMT_NAMESPACE, _FMT_ACCESS):
+            raise ValueError(f"postgresql format {fmt!r}")
+        if not table.replace("_", "").isalnum():
+            raise ValueError(f"unsafe table name {table!r}")
+        super().__init__(host, port, timeout)
+        self.name = target_name
+        self.table = table
+        self.database = database
+        self.username = username
+        self.password = password
+        self.fmt = fmt
+
+    # -- protocol -----------------------------------------------------------
+    def _msg(self, sock) -> tuple[bytes, bytes]:
+        t = _recv_exact(sock, 1)
+        size = struct.unpack(">I", _recv_exact(sock, 4))[0]
+        return t, _recv_exact(sock, size - 4)
+
+    def _send(self, sock, t: bytes, payload: bytes) -> None:
+        sock.sendall(t + struct.pack(">I", len(payload) + 4) + payload)
+
+    def _handshake(self, sock: socket.socket) -> None:
+        params = (b"user\x00" + self.username.encode() + b"\x00"
+                  + b"database\x00" + self.database.encode() + b"\x00"
+                  + b"\x00")
+        startup = struct.pack(">I", 196608) + params  # protocol 3.0
+        sock.sendall(struct.pack(">I", len(startup) + 4) + startup)
+        while True:
+            t, body = self._msg(sock)
+            if t == b"E":
+                raise TargetError(f"postgres: {_pg_error(body)}")
+            if t == b"R":
+                code = struct.unpack(">I", body[:4])[0]
+                if code == 0:
+                    continue  # AuthenticationOk
+                if code == 3:  # cleartext password
+                    self._send(sock, b"p",
+                               self.password.encode() + b"\x00")
+                    continue
+                if code == 5:  # md5(md5(password+user)+salt)
+                    import hashlib as _h
+
+                    salt = body[4:8]
+                    inner = _h.md5(self.password.encode()
+                                   + self.username.encode()).hexdigest()
+                    digest = _h.md5(inner.encode() + salt).hexdigest()
+                    self._send(sock, b"p", b"md5" + digest.encode()
+                               + b"\x00")
+                    continue
+                raise TargetError(
+                    f"postgres auth method {code} unsupported "
+                    "(cleartext/md5 only)")
+            if t == b"Z":  # ReadyForQuery
+                break
+            # parameter status / backend key data: ignore
+        if self.fmt == _FMT_NAMESPACE:
+            ddl = (f'CREATE TABLE IF NOT EXISTS {self.table} '
+                   f'(key TEXT PRIMARY KEY, value TEXT)')
+        else:
+            ddl = (f'CREATE TABLE IF NOT EXISTS {self.table} '
+                   f'(event_time TIMESTAMP, event_data TEXT)')
+        self._query(sock, ddl)
+
+    def _query(self, sock, sql: str) -> None:
+        self._send(sock, b"Q", sql.encode() + b"\x00")
+        err = None
+        while True:
+            t, body = self._msg(sock)
+            if t == b"E":
+                err = _pg_error(body)
+            elif t == b"Z":
+                if err:
+                    raise TargetError(f"postgres: {err}")
+                return
+
+    @staticmethod
+    def _lit(s: str) -> str:
+        return "'" + s.replace("'", "''") + "'"
+
+    def _publish(self, sock: socket.socket, log: dict) -> None:
+        value = self._lit(json.dumps(log))
+        if self.fmt == _FMT_NAMESPACE:
+            key = self._lit(log.get("Key", ""))
+            sql = (f"INSERT INTO {self.table} (key, value) "
+                   f"VALUES ({key}, {value}) "
+                   f"ON CONFLICT (key) DO UPDATE SET value = {value}")
+        else:
+            sql = (f"INSERT INTO {self.table} (event_time, event_data) "
+                   f"VALUES (NOW(), {value})")
+        self._query(sock, sql)
+
+
+def _pg_error(body: bytes) -> str:
+    parts = {}
+    for field in body.split(b"\x00"):
+        if field[:1] and len(field) > 1:
+            parts[chr(field[0])] = field[1:].decode(errors="replace")
+    return parts.get("M", "unknown error")

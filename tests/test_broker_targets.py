@@ -16,7 +16,8 @@ import time
 
 import pytest
 
-from minio_tpu.events.brokers import (KafkaTarget, MQTTTarget, NATSTarget,
+from minio_tpu.events.brokers import (AMQPTarget, KafkaTarget, MQTTTarget,
+                                      NATSTarget, NSQTarget, PostgresTarget,
                                       RedisTarget)
 from minio_tpu.events.targets import TargetError, load_targets_from_env
 
@@ -416,6 +417,312 @@ class TestNATS:
             broker.close()
 
 
+# ------------------------------------------------------------------------ NSQ
+def _nsq_broker(broker, sock):
+    try:
+        assert _read_exact(sock, 4) == b"  V2"
+        f = sock.makefile("rb")
+
+        def read_cmd():
+            line = b""
+            while not line.endswith(b"\n"):
+                c = f.read(1)
+                if not c:
+                    return None, None
+                line += c
+            cmd = line[:-1]
+            if cmd.startswith((b"IDENTIFY", b"PUB")):
+                size = struct.unpack(">i", f.read(4))[0]
+                return cmd, f.read(size)
+            return cmd, b""
+
+        def ok():
+            sock.sendall(struct.pack(">i", 6) + struct.pack(">i", 0) + b"OK")
+
+        while True:
+            cmd, body = read_cmd()
+            if cmd is None:
+                return
+            if cmd == b"IDENTIFY":
+                ok()
+            elif cmd.startswith(b"PUB "):
+                broker.received.append(cmd[4:] + b" " + body)
+                ok()
+            elif cmd == b"NOP":
+                pass
+    except (ConnectionError, OSError, AssertionError):
+        return
+
+
+class TestNSQ:
+    def test_publish(self):
+        broker = _FakeBroker(_nsq_broker)
+        try:
+            t = NSQTarget("q1", "127.0.0.1", broker.port, "minio-topic")
+            t.send({"EventName": "s3:ObjectCreated:Put", "Key": "b/k"})
+            broker.wait(1)
+            topic, payload = broker.received[0].split(b" ", 1)
+            assert topic == b"minio-topic"
+            assert json.loads(payload)["Key"] == "b/k"
+            t.close()
+        finally:
+            broker.close()
+
+    def test_error_frame_raises(self):
+        def bad(broker, sock):
+            try:
+                _read_exact(sock, 4)
+                # reject IDENTIFY with an error frame
+                msg = b"E_BAD_CLIENT go away"
+                sock.sendall(struct.pack(">i", 4 + len(msg))
+                             + struct.pack(">i", 1) + msg)
+            except (ConnectionError, OSError):
+                return
+
+        broker = _FakeBroker(bad)
+        try:
+            t = NSQTarget("q1", "127.0.0.1", broker.port, "t")
+            with pytest.raises(TargetError, match="E_BAD_CLIENT"):
+                t.send({"Key": "x"})
+        finally:
+            broker.close()
+
+    def test_reconnect(self):
+        broker = _FakeBroker(_nsq_broker)
+        t = NSQTarget("q1", "127.0.0.1", broker.port, "t")
+        t.send({"Key": "1"})
+        broker.close()
+        with pytest.raises(TargetError):
+            t.send({"Key": "2"})
+        broker2 = _FakeBroker(_nsq_broker)
+        try:
+            t2 = NSQTarget("q1", "127.0.0.1", broker2.port, "t")
+            t2.send({"Key": "3"})
+            broker2.wait(1)
+        finally:
+            broker2.close()
+
+
+# ----------------------------------------------------------------------- AMQP
+def _amqp_broker(broker, sock, refuse_auth=False):
+    def send_method(channel, cid, mid, args=b""):
+        payload = struct.pack(">HH", cid, mid) + args
+        sock.sendall(struct.pack(">BHI", 1, channel, len(payload))
+                     + payload + b"\xce")
+
+    def read_frame():
+        hdr = _read_exact(sock, 7)
+        ftype, channel, size = struct.unpack(">BHI", hdr)
+        payload = _read_exact(sock, size)
+        assert _read_exact(sock, 1) == b"\xce"
+        return ftype, channel, payload
+
+    try:
+        assert _read_exact(sock, 8) == b"AMQP\x00\x00\x09\x01"
+        send_method(0, 10, 10, b"\x00\x09" + struct.pack(">I", 0)
+                    + struct.pack(">I", 5) + b"PLAIN"
+                    + struct.pack(">I", 5) + b"en_US")  # connection.start
+        _, _, payload = read_frame()  # start-ok
+        # PLAIN sasl: \0user\0pass near the end of the frame
+        if refuse_auth and b"\x00guest\x00guest" in payload:
+            send_method(0, 10, 50, struct.pack(">H", 403)
+                        + bytes([0]) + struct.pack(">HH", 0, 0))
+            return
+        send_method(0, 10, 30, struct.pack(">HIH", 0, 131072, 0))  # tune
+        read_frame()                    # tune-ok
+        read_frame()                    # connection.open
+        send_method(0, 10, 41, b"\x00")  # open-ok
+        read_frame()                    # channel.open
+        send_method(1, 20, 11, struct.pack(">I", 0))  # channel.open-ok
+        read_frame()                    # confirm.select
+        send_method(1, 85, 11)          # select-ok
+        tag = 0
+        while True:
+            ftype, _, payload = read_frame()
+            if ftype == 1:  # basic.publish
+                cid, mid = struct.unpack(">HH", payload[:4])
+                assert (cid, mid) == (60, 40)
+                rest = payload[6:]
+                xlen = rest[0]
+                exchange = rest[1:1 + xlen].decode()
+                rest = rest[1 + xlen:]
+                klen = rest[0]
+                rkey = rest[1:1 + klen].decode()
+                _, _, hdr = read_frame()   # content header
+                body_size = struct.unpack(">Q", hdr[4:12])[0]
+                body = b""
+                while len(body) < body_size:
+                    _, _, chunk = read_frame()
+                    body += chunk
+                broker.received.append(
+                    f"{exchange}|{rkey}".encode() + b"|" + body)
+                tag += 1
+                send_method(1, 60, 80,  # basic.ack
+                            struct.pack(">QB", tag, 0))
+    except (ConnectionError, OSError, AssertionError):
+        return
+
+
+class TestAMQP:
+    def test_publish_with_confirms(self):
+        broker = _FakeBroker(_amqp_broker)
+        try:
+            t = AMQPTarget("a1", "127.0.0.1", broker.port,
+                           exchange="minio", routing_key="events")
+            t.send({"EventName": "s3:ObjectCreated:Put", "Key": "b/k"})
+            t.send({"Key": "b/k2"})
+            broker.wait(2)
+            ex, rk, payload = broker.received[0].split(b"|", 2)
+            assert ex == b"minio" and rk == b"events"
+            assert json.loads(payload)["Key"] == "b/k"
+            t.close()
+        finally:
+            broker.close()
+
+    def test_refused_auth_is_explicit(self):
+        broker = _FakeBroker(
+            lambda b, s: _amqp_broker(b, s, refuse_auth=True))
+        try:
+            t = AMQPTarget("a1", "127.0.0.1", broker.port)
+            with pytest.raises(TargetError):
+                t.send({"Key": "x"})
+        finally:
+            broker.close()
+
+    def test_reconnect(self):
+        broker = _FakeBroker(_amqp_broker)
+        t = AMQPTarget("a1", "127.0.0.1", broker.port, routing_key="r")
+        t.send({"Key": "1"})
+        broker.close()
+        with pytest.raises(TargetError):
+            t.send({"Key": "2"})
+        broker2 = _FakeBroker(_amqp_broker)
+        try:
+            t2 = AMQPTarget("a1", "127.0.0.1", broker2.port,
+                            routing_key="r")
+            t2.send({"Key": "3"})
+            broker2.wait(1)
+        finally:
+            broker2.close()
+
+
+# ------------------------------------------------------------------- Postgres
+def _pg_broker(broker, sock, auth="trust", password="sekrit"):
+    import hashlib as _h
+
+    def send(t, payload):
+        sock.sendall(t + struct.pack(">I", len(payload) + 4) + payload)
+
+    def read_msg(startup=False):
+        if startup:
+            size = struct.unpack(">I", _read_exact(sock, 4))[0]
+            return b"", _read_exact(sock, size - 4)
+        t = _read_exact(sock, 1)
+        size = struct.unpack(">I", _read_exact(sock, 4))[0]
+        return t, _read_exact(sock, size - 4)
+
+    def ready():
+        send(b"Z", b"I")
+
+    try:
+        _, startup = read_msg(startup=True)
+        assert b"user\x00" in startup
+        if auth == "cleartext":
+            send(b"R", struct.pack(">I", 3))
+            t, body = read_msg()
+            if body.rstrip(b"\x00") != password.encode():
+                send(b"E", b"SEV\x00Mpassword authentication failed\x00\x00")
+                return
+        elif auth == "md5":
+            salt = b"ab12"
+            send(b"R", struct.pack(">I", 5) + salt)
+            t, body = read_msg()
+            inner = _h.md5(password.encode() + b"pguser").hexdigest()
+            want = b"md5" + _h.md5(
+                inner.encode() + salt).hexdigest().encode()
+            if body.rstrip(b"\x00") != want:
+                send(b"E", b"SEV\x00Mmd5 auth failed\x00\x00")
+                return
+        send(b"R", struct.pack(">I", 0))  # AuthenticationOk
+        ready()
+        while True:
+            t, body = read_msg()
+            if t == b"Q":
+                sql = body.rstrip(b"\x00").decode()
+                broker.received.append(sql.encode())
+                send(b"C", b"INSERT 0 1\x00")
+                ready()
+            elif t == b"X" or not t:
+                return
+    except (ConnectionError, OSError, AssertionError):
+        return
+
+
+class TestPostgres:
+    def test_access_format_insert(self):
+        broker = _FakeBroker(_pg_broker)
+        try:
+            t = PostgresTarget("p1", "127.0.0.1", broker.port, "minio_events")
+            t.send({"EventName": "s3:ObjectCreated:Put", "Key": "b/k"})
+            broker.wait(2)  # DDL + INSERT
+            assert b"CREATE TABLE IF NOT EXISTS minio_events" \
+                in broker.received[0]
+            assert broker.received[1].startswith(
+                b"INSERT INTO minio_events (event_time, event_data)")
+            assert b"b/k" in broker.received[1]
+        finally:
+            broker.close()
+
+    def test_namespace_format_upsert_and_quoting(self):
+        broker = _FakeBroker(_pg_broker)
+        try:
+            t = PostgresTarget("p1", "127.0.0.1", broker.port, "ns_tbl",
+                               fmt="namespace")
+            t.send({"Key": "b/it's.txt"})
+            broker.wait(2)
+            sql = broker.received[1].decode()
+            assert "ON CONFLICT (key) DO UPDATE" in sql
+            assert "it''s" in sql  # single quotes escaped
+        finally:
+            broker.close()
+
+    def test_md5_auth(self):
+        broker = _FakeBroker(
+            lambda b, s: _pg_broker(b, s, auth="md5"))
+        try:
+            ok = PostgresTarget("p1", "127.0.0.1", broker.port, "t1",
+                                username="pguser", password="sekrit")
+            ok.send({"Key": "x"})
+            broker.wait(2)
+            bad = PostgresTarget("p1", "127.0.0.1", broker.port, "t1",
+                                 username="pguser", password="wrong")
+            with pytest.raises(TargetError):
+                bad.send({"Key": "y"})
+        finally:
+            broker.close()
+
+    def test_unsafe_table_rejected(self):
+        with pytest.raises(ValueError):
+            PostgresTarget("p", "h", 5432, "evil; DROP TABLE x")
+
+    def test_scram_reported_unsupported(self):
+        def scram(broker, sock):
+            try:
+                size = struct.unpack(">I", _read_exact(sock, 4))[0]
+                _read_exact(sock, size - 4)
+                sock.sendall(b"R" + struct.pack(">II", 8, 10))
+            except (ConnectionError, OSError):
+                return
+
+        broker = _FakeBroker(scram)
+        try:
+            t = PostgresTarget("p1", "127.0.0.1", broker.port, "t1")
+            with pytest.raises(TargetError, match="unsupported"):
+                t.send({"Key": "x"})
+        finally:
+            broker.close()
+
+
 # ---------------------------------------------------- end-to-end + env config
 class TestEndToEnd:
     def test_put_event_through_kafka_with_offline_replay(self, tmp_path):
@@ -495,12 +802,34 @@ class TestEndToEnd:
             "MINIO_NOTIFY_NATS_ENABLE_N": "on",
             "MINIO_NOTIFY_NATS_ADDRESS_N": "10.0.0.4:4222",
             "MINIO_NOTIFY_NATS_SUBJECT_N": "sub",
+            "MINIO_NOTIFY_NSQ_ENABLE_Q": "on",
+            "MINIO_NOTIFY_NSQ_NSQD_ADDRESS_Q": "10.0.0.5:4150",
+            "MINIO_NOTIFY_NSQ_TOPIC_Q": "nt",
+            "MINIO_NOTIFY_AMQP_ENABLE_A": "on",
+            "MINIO_NOTIFY_AMQP_URL_A": "amqp://u:pw@10.0.0.6:5672",
+            "MINIO_NOTIFY_AMQP_EXCHANGE_A": "ex",
+            "MINIO_NOTIFY_AMQP_ROUTING_KEY_A": "rk",
+            "MINIO_NOTIFY_POSTGRES_ENABLE_P": "on",
+            "MINIO_NOTIFY_POSTGRES_CONNECTION_STRING_P":
+                "postgres://pu:pp@10.0.0.7:5433/evdb",
+            "MINIO_NOTIFY_POSTGRES_TABLE_P": "minio_events",
             "MINIO_NOTIFY_KAFKA_ENABLE_OFF": "off",
             "MINIO_NOTIFY_KAFKA_BROKERS_OFF": "10.9.9.9:9092",
         }
         targets = load_targets_from_env(env)
         ids = {t.target_id for t in targets}
-        assert ids == {"w:webhook", "k:kafka", "m:mqtt", "r:redis", "n:nats"}
+        assert ids == {"w:webhook", "k:kafka", "m:mqtt", "r:redis",
+                       "n:nats", "q:nsq", "a:amqp", "p:postgresql"}
+        nsq = next(t for t in targets if t.kind == "nsq")
+        assert (nsq.host, nsq.port, nsq.topic) == ("10.0.0.5", 4150, "nt")
+        amqp = next(t for t in targets if t.kind == "amqp")
+        assert (amqp.host, amqp.port, amqp.exchange, amqp.routing_key,
+                amqp.username, amqp.password) == \
+            ("10.0.0.6", 5672, "ex", "rk", "u", "pw")
+        pg = next(t for t in targets if t.kind == "postgresql")
+        assert (pg.host, pg.port, pg.table, pg.database, pg.username,
+                pg.password) == \
+            ("10.0.0.7", 5433, "minio_events", "evdb", "pu", "pp")
         kafka = next(t for t in targets if t.kind == "kafka")
         assert (kafka.host, kafka.port, kafka.topic) == ("10.0.0.1", 9092, "tp")
         mqtt = next(t for t in targets if t.kind == "mqtt")
