@@ -10,6 +10,7 @@ cannot wedge the namespace (lock maintenance in cmd/lock-rest-server.go).
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 import uuid
@@ -19,7 +20,34 @@ from .rpc import RpcClient, RpcRouter
 
 LOCK_TTL = 30.0          # server-side expiry without refresh
 REFRESH_INTERVAL = 10.0
-RETRY_DELAY = 0.05
+RETRY_DELAY = 0.05       # base retry interval; jitter added per attempt
+RETRY_MAX = 0.25         # cap on the jittered backoff (drwmutex.go
+                         # lockRetryMinInterval..lockRetryBackOff)
+
+
+class OwnerRegistry:
+    """Per-node set of lock uids this process actively holds.  The
+    server-side maintenance sweep asks a lock's owner node whether its
+    uid is still alive (lock.holding) and prunes entries whose owner
+    denies or stays unreachable — a crashed client's write lock is
+    reclaimed in seconds instead of the full TTL
+    (cmd/lock-rest-server.go lockMaintenance)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._uids: set[str] = set()
+
+    def add(self, uid: str) -> None:
+        with self._mu:
+            self._uids.add(uid)
+
+    def remove(self, uid: str) -> None:
+        with self._mu:
+            self._uids.discard(uid)
+
+    def holds(self, uid: str) -> bool:
+        with self._mu:
+            return uid in self._uids
 
 
 class LocalLocker:
@@ -33,7 +61,8 @@ class LocalLocker:
     def _entry(self, name: str) -> dict:
         e = self._locks.get(name)
         if e is None:
-            e = {"writer": None, "readers": set(), "expiry": {}}
+            e = {"writer": None, "readers": set(), "expiry": {},
+                 "owner": {}, "granted": {}, "strikes": {}}
             self._locks[name] = e
         return e
 
@@ -41,28 +70,39 @@ class LocalLocker:
         now = time.time()
         dead = [u for u, t in e["expiry"].items() if t < now]
         for u in dead:
-            del e["expiry"][u]
-            if e["writer"] == u:
-                e["writer"] = None
-            e["readers"].discard(u)
+            self._drop_uid(e, u)
 
-    def lock(self, name: str, uid: str) -> bool:
+    @staticmethod
+    def _drop_uid(e: dict, uid: str) -> None:
+        e["expiry"].pop(uid, None)
+        e["owner"].pop(uid, None)
+        e["granted"].pop(uid, None)
+        e["strikes"].pop(uid, None)
+        if e["writer"] == uid:
+            e["writer"] = None
+        e["readers"].discard(uid)
+
+    def lock(self, name: str, uid: str, owner: str = "") -> bool:
         with self._mu:
             e = self._entry(name)
             self._expire(e)
             if e["writer"] is None and not e["readers"]:
                 e["writer"] = uid
                 e["expiry"][uid] = time.time() + LOCK_TTL
+                e["owner"][uid] = owner
+                e["granted"][uid] = time.time()
                 return True
             return e["writer"] == uid  # idempotent re-acquire
 
-    def rlock(self, name: str, uid: str) -> bool:
+    def rlock(self, name: str, uid: str, owner: str = "") -> bool:
         with self._mu:
             e = self._entry(name)
             self._expire(e)
             if e["writer"] is None:
                 e["readers"].add(uid)
                 e["expiry"][uid] = time.time() + LOCK_TTL
+                e["owner"][uid] = owner
+                e["granted"][uid] = time.time()
                 return True
             return False
 
@@ -75,6 +115,9 @@ class LocalLocker:
                 e["writer"] = None
             e["readers"].discard(uid)
             e["expiry"].pop(uid, None)
+            e["owner"].pop(uid, None)
+            e["granted"].pop(uid, None)
+            e["strikes"].pop(uid, None)
             if e["writer"] is None and not e["readers"]:
                 self._locks.pop(name, None)
             return True
@@ -102,12 +145,103 @@ class LocalLocker:
                 })
             return out
 
+    # -- maintenance sweep (cmd/lock-rest-server.go lockMaintenance) ------
+    MAINT_MIN_AGE = 2.0   # leave just-granted locks alone
+    MAINT_STRIKES = 2     # unreachable owners pruned after N sweeps
 
-def register_lock_rpc(router: RpcRouter, locker: LocalLocker) -> None:
+    def maintenance_sweep(self, holding_fn) -> int:
+        """Prune lock entries whose owner no longer holds them.
+        holding_fn(owner, uid) -> True (held) | False (denied) |
+        None (owner unreachable).  Denied entries drop immediately;
+        unreachable owners accumulate strikes and drop at
+        MAINT_STRIKES — a crashed client's lock is reclaimed in a few
+        sweep intervals instead of the full TTL.  Returns pruned count."""
+        with self._mu:
+            candidates = []
+            now = time.time()
+            for name, e in self._locks.items():
+                for uid, granted in list(e["granted"].items()):
+                    if now - granted >= self.MAINT_MIN_AGE:
+                        candidates.append((name, uid, e["owner"].get(uid)))
+        pruned = 0
+        for name, uid, owner in candidates:
+            verdict = holding_fn(owner, uid)
+            with self._mu:
+                e = self._locks.get(name)
+                if e is None or uid not in e["expiry"]:
+                    continue
+                if verdict is True:
+                    e["strikes"].pop(uid, None)
+                    continue
+                if verdict is None:
+                    strikes = e["strikes"].get(uid, 0) + 1
+                    e["strikes"][uid] = strikes
+                    if strikes < self.MAINT_STRIKES:
+                        continue
+                self._drop_uid(e, uid)
+                pruned += 1
+                if e["writer"] is None and not e["readers"]:
+                    self._locks.pop(name, None)
+        return pruned
+
+
+class LockMaintenance:
+    """Background sweep over one node's LocalLocker, validating each
+    entry with its owner over the lock RPC plane."""
+
+    def __init__(self, locker: LocalLocker, registry: OwnerRegistry,
+                 my_addr: str, peer_clients: dict,
+                 interval: float = 5.0, autostart: bool = True):
+        self.locker = locker
+        self.registry = registry
+        self.my_addr = my_addr
+        self.peer_clients = peer_clients
+        self.interval = interval
+        self.pruned = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        if autostart:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="lock-maintenance")
+            self._thread.start()
+
+    def _holding(self, owner: str, uid: str):
+        if not owner or owner == self.my_addr:
+            return self.registry.holds(uid)
+        client = self.peer_clients.get(owner)
+        if client is None:
+            return None  # unknown owner: treat as unreachable
+        try:
+            return bool(client.call("lock.holding", {"uid": uid}).get("ok"))
+        except Exception:
+            return None
+
+    def sweep_once(self) -> int:
+        n = self.locker.maintenance_sweep(self._holding)
+        self.pruned += n
+        return n
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.sweep_once()
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+
+def register_lock_rpc(router: RpcRouter, locker: LocalLocker,
+                      registry: OwnerRegistry | None = None) -> None:
     router.register("lock.lock",
-                    lambda a, b: {"ok": locker.lock(a["name"], a["uid"])})
+                    lambda a, b: {"ok": locker.lock(
+                        a["name"], a["uid"], a.get("owner", ""))})
     router.register("lock.rlock",
-                    lambda a, b: {"ok": locker.rlock(a["name"], a["uid"])})
+                    lambda a, b: {"ok": locker.rlock(
+                        a["name"], a["uid"], a.get("owner", ""))})
     router.register("lock.unlock",
                     lambda a, b: {"ok": locker.unlock(a["name"], a["uid"])})
     router.register("lock.refresh",
@@ -115,6 +249,11 @@ def register_lock_rpc(router: RpcRouter, locker: LocalLocker) -> None:
     router.register("lock.force_unlock",
                     lambda a, b: {"ok": locker.force_unlock(a["name"])})
     router.register("lock.top", lambda a, b: {"locks": locker.top_locks()})
+    if registry is not None:
+        # maintenance probe: does this node's process still hold `uid`?
+        router.register(
+            "lock.holding",
+            lambda a, b: {"ok": registry.holds(a.get("uid", ""))})
 
 
 class _LocalLockerClient:
@@ -126,8 +265,10 @@ class _LocalLockerClient:
     def call(self, method: str, args: dict):
         op = method.split(".", 1)[1]
         fn = {
-            "lock": lambda: self.locker.lock(args["name"], args["uid"]),
-            "rlock": lambda: self.locker.rlock(args["name"], args["uid"]),
+            "lock": lambda: self.locker.lock(
+                args["name"], args["uid"], args.get("owner", "")),
+            "rlock": lambda: self.locker.rlock(
+                args["name"], args["uid"], args.get("owner", "")),
             "unlock": lambda: self.locker.unlock(args["name"], args["uid"]),
             "refresh": lambda: self.locker.refresh(args["name"], args["uid"]),
         }[op]
@@ -140,10 +281,13 @@ class _LocalLockerClient:
 class DRWMutex:
     """Quorum RW mutex over a set of lockers (drwmutex.go:64)."""
 
-    def __init__(self, name: str, clients: list, timeout: float = 30.0):
+    def __init__(self, name: str, clients: list, timeout: float = 30.0,
+                 owner: str = "", registry: OwnerRegistry | None = None):
         self.name = name
         self.clients = clients
         self.timeout = timeout
+        self.owner = owner
+        self.registry = registry
         self.uid = ""
         self._refresher: threading.Thread | None = None
         self._stop = threading.Event()
@@ -187,7 +331,8 @@ class DRWMutex:
         def one(c) -> None:
             ok = False
             try:
-                r = c.call(f"lock.{op}", {"name": self.name, "uid": uid})
+                r = c.call(f"lock.{op}", {"name": self.name, "uid": uid,
+                                          "owner": self.owner})
                 ok = bool(r and r.get("ok"))
             except Exception:
                 ok = False
@@ -221,7 +366,12 @@ class DRWMutex:
         self.lost.clear()
         deadline = time.time() + self.timeout
         uid = str(uuid.uuid4())
+        if self.registry is not None:
+            # registered BEFORE the broadcast so a maintenance probe
+            # racing the grant sees the uid as held
+            self.registry.add(uid)
         need = self.read_quorum if op == "rlock" else self.quorum
+        attempt = 0
         while time.time() < deadline:
             got = self._broadcast(op, uid, need=need)
             if got >= need:
@@ -230,12 +380,18 @@ class DRWMutex:
                 self._need = need
                 self._start_refresher()
                 return True
-            # failed: release whatever we got, back off, retry
+            # failed: release whatever we got, back off with jitter so
+            # competing acquirers don't re-collide in lockstep
+            # (drwmutex.go retry loop with lockRetryMinInterval jitter)
             self._broadcast("unlock", uid)
-            time.sleep(RETRY_DELAY)
+            attempt += 1
+            backoff = min(RETRY_DELAY * attempt, RETRY_MAX)
+            time.sleep(RETRY_DELAY + random.random() * backoff)
         # timed out entirely: make any still-in-flight grants self-release
         self._released.set()
         self._broadcast("unlock", uid)
+        if self.registry is not None:
+            self.registry.remove(uid)
         return False
 
     def lock(self) -> None:
@@ -251,6 +407,8 @@ class DRWMutex:
         self._released.set()
         if self.uid:
             self._broadcast("unlock", self.uid)
+            if self.registry is not None:
+                self.registry.remove(self.uid)
             self.uid = ""
 
     # -- refresh loop (drwmutex.go:221 startContinuousLockRefresh) ----------
@@ -292,13 +450,17 @@ class DistributedNamespaceLock:
     (reference nsLockMap with distributed lockers,
     cmd/namespace-lock.go:86)."""
 
-    def __init__(self, clients_factory, prefix: str = ""):
+    def __init__(self, clients_factory, prefix: str = "",
+                 owner: str = "", registry: OwnerRegistry | None = None):
         """clients_factory() -> list of lock RPC clients (incl. local)."""
         self._factory = clients_factory
         self.prefix = prefix
+        self.owner = owner
+        self.registry = registry
 
     def _mutex(self, key: str) -> DRWMutex:
-        return DRWMutex(f"{self.prefix}{key}", self._factory())
+        return DRWMutex(f"{self.prefix}{key}", self._factory(),
+                        owner=self.owner, registry=self.registry)
 
     class _Ctx:
         def __init__(self, m: DRWMutex, write: bool):

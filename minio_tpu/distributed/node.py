@@ -22,8 +22,8 @@ from minio_tpu.storage import errors
 from minio_tpu.storage.instrumented import InstrumentedStorage
 from minio_tpu.storage.local import LocalStorage
 from .dsync import (
-    DistributedNamespaceLock, LocalLocker, _LocalLockerClient,
-    register_lock_rpc,
+    DistributedNamespaceLock, LocalLocker, LockMaintenance, OwnerRegistry,
+    _LocalLockerClient, register_lock_rpc,
 )
 from .rpc import RpcClient, RpcRouter
 from .storage_rpc import RemoteStorage, register_storage_rpc
@@ -149,13 +149,22 @@ class ClusterNode:
             pool_disks.append(disks)
 
         self.locker = LocalLocker()
+        self.lock_registry = OwnerRegistry()
+        self.lock_maintenance = None
         self.distributed = len(n_nodes) > 1
         if self.distributed:
             def lock_clients():
                 return [_LocalLockerClient(self.locker)] + list(
                     self.peer_clients.values()
                 )
-            ns_lock = DistributedNamespaceLock(lock_clients)
+            ns_lock = DistributedNamespaceLock(
+                lock_clients, owner=my_address,
+                registry=self.lock_registry)
+            # server-side sweep: locks whose owner died are reclaimed in
+            # seconds, not the full TTL (cmd/lock-rest-server.go)
+            self.lock_maintenance = LockMaintenance(
+                self.locker, self.lock_registry, my_address,
+                self.peer_clients)
         else:
             ns_lock = None
 
@@ -182,7 +191,8 @@ class ClusterNode:
         self.app = self.s3.app
         self.router = RpcRouter(secret_key)
         register_storage_rpc(self.router, self.local_drives)
-        register_lock_rpc(self.router, self.locker)
+        register_lock_rpc(self.router, self.locker,
+                          registry=self.lock_registry)
         self.router.register("peer.info", self._peer_info)
         # control-plane fan-out: IAM + bucket-metadata mutations broadcast
         # reloads so peer caches never serve stale policy decisions
@@ -221,6 +231,8 @@ class ClusterNode:
     def close(self) -> None:
         # s3.close() owns the ServiceManager shutdown (attach_services
         # aliased it) plus site/notifier/executor teardown
+        if self.lock_maintenance is not None:
+            self.lock_maintenance.close()
         self.s3.close()
         for c in self.peer_clients.values():
             c.close()
