@@ -195,9 +195,18 @@ class S3Gateway:
             size = len(data)
             reader = io.BytesIO(data)
         meta = dict(opts.user_metadata)
+        sent = [0]
         if size < 0:
-            data = reader.read()
-            body, length = data, len(data)
+            # unknown length: stream with chunked transfer-encoding
+            # instead of buffering the whole object
+            def chunks():
+                while True:
+                    c = reader.read(1 << 20)
+                    if not c:
+                        return
+                    sent[0] += len(c)
+                    yield c
+            body, length = chunks(), None
         else:
             body, length = _reader_chunks(reader, size), size
         if opts.finalize_metadata is not None:
@@ -211,7 +220,7 @@ class S3Gateway:
         return ObjectInfo(bucket=bucket, name=obj,
                           etag=meta.get("etag",
                                         rh.get("etag", "").strip('"')),
-                          size=size if size >= 0 else length,
+                          size=size if size >= 0 else sent[0],
                           metadata=meta)
 
     def get_object_info(self, bucket: str, obj: str,
@@ -351,9 +360,22 @@ class S3Gateway:
     def put_object_part(self, bucket: str, obj: str, upload_id: str,
                         part_number: int, reader, size: int = -1
                         ) -> PartInfo:
+        # known size streams with Content-Length; unknown size streams
+        # with Transfer-Encoding: chunked — either way the part is never
+        # spooled locally (reference streams through,
+        # cmd/gateway/s3/gateway-s3.go)
+        sent = [0]
+
+        def counted():
+            while True:
+                chunk = reader.read(1 << 20)
+                if not chunk:
+                    return
+                sent[0] += len(chunk)
+                yield chunk
+
         if size < 0:
-            data = reader.read()
-            body, length = data, len(data)
+            body, length = counted(), None
         else:
             body, length = _reader_chunks(reader, size), size
         try:
@@ -367,8 +389,9 @@ class S3Gateway:
                 raise errors.InvalidArgument(
                     f"upload id {upload_id} not found")
             raise _map_err(e, bucket, obj)
+        got = size if size >= 0 else sent[0]
         return PartInfo(part_number=part_number,
-                        etag=rh.get("etag", "").strip('"'), size=length)
+                        etag=rh.get("etag", "").strip('"'), size=got)
 
     def complete_multipart_upload(self, bucket: str, obj: str,
                                   upload_id: str,

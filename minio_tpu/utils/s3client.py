@@ -53,17 +53,22 @@ class S3Client:
                  length: int | None = None) -> tuple[int, dict, bytes]:
         """`body` may be bytes (signed payload) or an iterable of bytes
         chunks: iterables stream with Content-Length=`length` and an
-        UNSIGNED-PAYLOAD signature, so large objects never materialize
-        in memory."""
+        UNSIGNED-PAYLOAD signature — or, when `length` is None, with
+        Transfer-Encoding: chunked — so large objects never materialize
+        in memory (the reference gateway streams parts through the same
+        way, cmd/gateway/s3/gateway-s3.go)."""
         path = f"/{bucket}" + (f"/{key}" if key else "")
         quoted = urllib.parse.quote(path)
         headers = dict(headers or {})
         headers["host"] = self.netloc
         query = list(query or [])
         streaming = not isinstance(body, (bytes, bytearray))
-        if streaming:
-            if length is None:
-                raise ValueError("streaming body requires explicit length")
+        chunked = streaming and length is None
+        if chunked:
+            headers["transfer-encoding"] = "chunked"
+            signed = sigv4.sign_request(method, quoted, query, headers, None,
+                                        self.ak, self.sk, region=self.region)
+        elif streaming:
             headers["content-length"] = str(length)
             signed = sigv4.sign_request(method, quoted, query, headers, None,
                                         self.ak, self.sk, region=self.region)
@@ -77,9 +82,20 @@ class S3Client:
         url = quoted + (f"?{qs}" if qs else "")
         conn = self._connect()
         try:
-            conn.request(method, url,
-                         body=body if streaming else (body or None),
-                         headers=signed)
+            if chunked:
+                conn.putrequest(method, url, skip_accept_encoding=True)
+                for k, v in signed.items():
+                    if k.lower() != "content-length":
+                        conn.putheader(k, v)
+                conn.endheaders()
+                for chunk in body:
+                    if chunk:
+                        conn.send(b"%x\r\n%s\r\n" % (len(chunk), chunk))
+                conn.send(b"0\r\n\r\n")
+            else:
+                conn.request(method, url,
+                             body=body if streaming else (body or None),
+                             headers=signed)
             resp = conn.getresponse()
             data = resp.read()
             rh = {k.lower(): v for k, v in resp.getheaders()}
