@@ -1,0 +1,255 @@
+"""LDAP identity provider for STS (AssumeRoleWithLDAPIdentity).
+
+Reference: cmd/sts-handlers.go AssumeRoleWithLDAPIdentity +
+internal/config/identity/ldap (go-ldap client): a lookup-bind service
+account searches for the user's DN, the user's own credentials are
+verified with a second bind, and the user's LDAP groups map to IAM
+policies (policies attached to the group DN in the IAM store).
+
+The client speaks LDAPv3 directly — BER/DER encoding on a TCP socket
+(simple bind + subtree search with an equality filter); no LDAP library
+exists in this image.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+
+
+class LDAPError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------- BER bits
+
+
+def _ber_len(n: int) -> bytes:
+    if n < 0x80:
+        return bytes([n])
+    out = b""
+    while n:
+        out = bytes([n & 0xFF]) + out
+        n >>= 8
+    return bytes([0x80 | len(out)]) + out
+
+
+def _tlv(tag: int, payload: bytes) -> bytes:
+    return bytes([tag]) + _ber_len(len(payload)) + payload
+
+
+def _ber_int(v: int) -> bytes:
+    out = v.to_bytes(max(1, (v.bit_length() + 8) // 8), "big", signed=True)
+    return _tlv(0x02, out)
+
+
+def _ber_str(s: str, tag: int = 0x04) -> bytes:
+    return _tlv(tag, s.encode())
+
+
+def _parse_tlv(buf: bytes, off: int) -> tuple[int, bytes, int]:
+    """-> (tag, payload, next_offset)"""
+    tag = buf[off]
+    ln = buf[off + 1]
+    off += 2
+    if ln & 0x80:
+        nbytes = ln & 0x7F
+        ln = int.from_bytes(buf[off:off + nbytes], "big")
+        off += nbytes
+    return tag, buf[off:off + ln], off + ln
+
+
+# ---------------------------------------------------------------- client
+
+
+class LDAPClient:
+    """One LDAP server connection: bind + search, re-dialed per call
+    (STS exchanges are rare; connection pooling buys nothing)."""
+
+    def __init__(self, host: str, port: int = 389, timeout: float = 5.0):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self._mid = 0
+        self._lock = threading.Lock()
+
+    def _roundtrip(self, sock, op: bytes, want_tag: int) -> list[bytes]:
+        """Send one LDAPMessage; collect response protocol-ops until one
+        with `want_tag` arrives.  Returns all payloads in order."""
+        self._mid += 1
+        msg = _tlv(0x30, _ber_int(self._mid) + op)
+        sock.sendall(msg)
+        out = []
+        buf = b""
+        while True:
+            while True:
+                # need a full outer TLV before parsing
+                try:
+                    if len(buf) >= 2:
+                        _, payload, end = _parse_tlv(buf, 0)
+                        if end <= len(buf):
+                            break
+                except IndexError:
+                    pass
+                chunk = sock.recv(65536)
+                if not chunk:
+                    raise LDAPError("ldap connection closed")
+                buf += chunk
+            _, payload, end = _parse_tlv(buf, 0)
+            buf = buf[end:]
+            # payload = messageID INTEGER + protocolOp
+            _, _, off = _parse_tlv(payload, 0)
+            tag = payload[off]
+            _, op_payload, _ = _parse_tlv(payload, off)
+            out.append(bytes([tag]) + op_payload)
+            if tag == want_tag:
+                return out
+
+    @staticmethod
+    def _result_code(op_payload: bytes) -> tuple[int, str]:
+        _, code_raw, off = _parse_tlv(op_payload, 0)   # resultCode ENUM
+        code = int.from_bytes(code_raw, "big")
+        _, _, off = _parse_tlv(op_payload, off)         # matchedDN
+        _, diag, _ = _parse_tlv(op_payload, off)        # diagnostic
+        return code, diag.decode(errors="replace")
+
+    def bind(self, sock, dn: str, password: str) -> None:
+        """Simple bind (RFC 4511 §4.2); resultCode 49 = bad creds."""
+        op = _tlv(0x60, _ber_int(3) + _ber_str(dn)
+                  + _tlv(0x80, password.encode()))
+        resp = self._roundtrip(sock, op, 0x61)
+        code, diag = self._result_code(resp[-1][1:])
+        if code != 0:
+            raise LDAPError(f"bind failed (code {code}): {diag}")
+
+    def search(self, sock, base: str, attr: str, value: str,
+               want_attrs: list[str]) -> list[tuple[str, dict]]:
+        """Subtree search with an equality filter
+        (RFC 4511 §4.5): -> [(dn, {attr: [values]})]."""
+        filt = _tlv(0xA3, _ber_str(attr) + _ber_str(value))
+        attrs = _tlv(0x30, b"".join(_ber_str(a) for a in want_attrs))
+        op = _tlv(0x63, _ber_str(base)
+                  + _tlv(0x0A, b"\x02")   # scope wholeSubtree
+                  + _tlv(0x0A, b"\x00")   # derefAliases never
+                  + _ber_int(100) + _ber_int(10)
+                  + _tlv(0x01, b"\x00")   # typesOnly FALSE
+                  + filt + attrs)
+        ops = self._roundtrip(sock, op, 0x65)
+        code, diag = self._result_code(ops[-1][1:])
+        if code != 0:
+            raise LDAPError(f"search failed (code {code}): {diag}")
+        entries = []
+        for raw in ops[:-1]:
+            if raw[0] != 0x64:  # SearchResultEntry
+                continue
+            payload = raw[1:]
+            _, dn, off = _parse_tlv(payload, 0)
+            _, attrseq, _ = _parse_tlv(payload, off)
+            got: dict[str, list[str]] = {}
+            o = 0
+            while o < len(attrseq):
+                _, one, o = _parse_tlv(attrseq, o)
+                _, name, vo = _parse_tlv(one, 0)
+                _, valset, _ = _parse_tlv(one, vo)
+                vals, v = [], 0
+                while v < len(valset):
+                    _, val, v = _parse_tlv(valset, v)
+                    vals.append(val.decode(errors="replace"))
+                got[name.decode()] = vals
+            entries.append((dn.decode(), got))
+        return entries
+
+    def connect(self):
+        return socket.create_connection((self.host, self.port),
+                                        self.timeout)
+
+
+class LDAPProvider:
+    """STS-facing provider: authenticate(username, password) ->
+    (user_dn, group_dns)."""
+
+    def __init__(self, host: str, port: int = 389,
+                 lookup_bind_dn: str = "", lookup_bind_password: str = "",
+                 user_base: str = "", user_attr: str = "uid",
+                 group_base: str = "", group_member_attr: str = "member",
+                 timeout: float = 5.0):
+        self.client = LDAPClient(host, port, timeout)
+        self.lookup_bind_dn = lookup_bind_dn
+        self.lookup_bind_password = lookup_bind_password
+        self.user_base = user_base
+        self.user_attr = user_attr
+        self.group_base = group_base
+        self.group_member_attr = group_member_attr
+
+    @classmethod
+    def from_env(cls, environ=None) -> "LDAPProvider | None":
+        """MINIO_IDENTITY_LDAP_* (reference
+        internal/config/identity/ldap/config.go)."""
+        env = os.environ if environ is None else environ
+        addr = env.get("MINIO_IDENTITY_LDAP_SERVER_ADDR", "")
+        if not addr:
+            return None
+        host, _, port = addr.partition(":")
+        return cls(
+            host, int(port or 389),
+            lookup_bind_dn=env.get("MINIO_IDENTITY_LDAP_LOOKUP_BIND_DN", ""),
+            lookup_bind_password=env.get(
+                "MINIO_IDENTITY_LDAP_LOOKUP_BIND_PASSWORD", ""),
+            user_base=env.get(
+                "MINIO_IDENTITY_LDAP_USER_DN_SEARCH_BASE_DN", ""),
+            user_attr=env.get(
+                "MINIO_IDENTITY_LDAP_USER_DN_SEARCH_ATTR", "uid"),
+            group_base=env.get(
+                "MINIO_IDENTITY_LDAP_GROUP_SEARCH_BASE_DN", ""),
+            group_member_attr=env.get(
+                "MINIO_IDENTITY_LDAP_GROUP_MEMBER_ATTR", "member"),
+        )
+
+    def authenticate(self, username: str,
+                     password: str) -> tuple[str, list[str]]:
+        """Lookup-bind -> find user DN -> verify the user's own bind ->
+        collect group DNs.  Empty passwords are rejected outright (an
+        LDAP unauthenticated bind would otherwise 'succeed')."""
+        if not password:
+            raise LDAPError("empty password")
+        sock = self.client.connect()
+        try:
+            if self.lookup_bind_dn:
+                self.client.bind(sock, self.lookup_bind_dn,
+                                 self.lookup_bind_password)
+            entries = self.client.search(
+                sock, self.user_base, self.user_attr, username, ["dn"])
+            if not entries:
+                raise LDAPError(f"user {username!r} not found")
+            if len(entries) > 1:
+                raise LDAPError(f"user {username!r} is ambiguous")
+            user_dn = entries[0][0]
+            # verify the USER's credentials with a second bind
+            self.client.bind(sock, user_dn, password)
+            groups: list[str] = []
+            if self.group_base:
+                # group objects whose member attribute holds the user DN
+                if self.lookup_bind_dn:
+                    self.client.bind(sock, self.lookup_bind_dn,
+                                     self.lookup_bind_password)
+                for dn, _ in self.client.search(
+                        sock, self.group_base, self.group_member_attr,
+                        user_dn, ["cn"]):
+                    groups.append(dn)
+            return user_dn, groups
+        finally:
+            sock.close()
+
+    def policies_for(self, user_dn: str, groups: list[str],
+                     iam) -> list[str]:
+        """Policies attached in the IAM store to the user DN (as a
+        group-style mapping) or to any LDAP group DN (reference policy-DB
+        mappings keyed by DN)."""
+        out: list[str] = []
+        with iam._mu:
+            for key in [user_dn] + groups:
+                g = iam.groups.get(key)
+                if g:
+                    out.extend(g.get("policies", []))
+        return list(dict.fromkeys(out))

@@ -339,9 +339,15 @@ class IAMSys:
         for m in members:
             self._notify("user", m)
 
-    def attach_group_policy(self, group: str, names: list[str]) -> None:
+    def attach_group_policy(self, group: str, names: list[str],
+                            create: bool = False) -> None:
+        """create=True allows attaching policies to a group that has no
+        local members — the LDAP policy-DB case, where `group` is an
+        LDAP user/group DN (reference PolicyDBSet on DNs)."""
         with self._mu:
             g = self.groups.get(group)
+            if g is None and create:
+                g = self.groups[group] = {"members": [], "policies": []}
             if g is None:
                 raise IAMError(f"no such group {group}")
             for n in names:

@@ -221,6 +221,8 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         self.kms = load_kms(object_layer)
         from minio_tpu.iam.oidc import OpenIDProvider
         self.oidc = OpenIDProvider.from_env()
+        from minio_tpu.iam.ldap import LDAPProvider
+        self.ldap = LDAPProvider.from_env()
         self.notifier = EventNotifier(
             self.meta, targets=load_targets_from_env(),
             queue_dir=_event_queue_dir(object_layer), region=region)
@@ -632,6 +634,33 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 extra=("<SubjectFromWebIdentityToken>"
                        f"{escape(subject)}"
                        "</SubjectFromWebIdentityToken>"))
+        if action == "AssumeRoleWithLDAPIdentity":
+            # username+password ARE the credential: no SigV4 auth
+            # (reference cmd/sts-handlers.go AssumeRoleWithLDAPIdentity)
+            if self.ldap is None:
+                raise S3Error("NotImplemented",
+                              "no LDAP identity provider configured")
+            username = form.get("LDAPUsername", "")
+            password = form.get("LDAPPassword", "")
+            if not username or not password:
+                raise S3Error("InvalidArgument",
+                              "missing LDAPUsername/LDAPPassword")
+            from minio_tpu.iam.ldap import LDAPError
+
+            try:
+                user_dn, groups = await self._run(
+                    self.ldap.authenticate, username, password)
+            except LDAPError as e:
+                raise S3Error("AccessDenied", f"LDAP auth failed: {e}")
+            policies = self.ldap.policies_for(user_dn, groups, self.iam)
+            try:
+                ident = await self._run(
+                    self.iam.assume_role_web_identity, f"ldap:{user_dn}",
+                    policies, duration, session_policy
+                )
+            except IAMError as e:
+                raise S3Error("AccessDenied", str(e))
+            return self._sts_creds_xml("AssumeRoleWithLDAPIdentity", ident)
         raise S3Error("InvalidArgument", f"unsupported STS action {action}")
 
     def _sts_creds_xml(self, action: str, ident, extra: str = ""):

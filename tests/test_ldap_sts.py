@@ -1,0 +1,232 @@
+"""LDAP STS: AssumeRoleWithLDAPIdentity against an in-process fake
+LDAP server speaking real BER (reference cmd/sts-handlers.go
+AssumeRoleWithLDAPIdentity + internal/config/identity/ldap)."""
+
+import socketserver
+import threading
+import urllib.parse
+
+import pytest
+
+from minio_tpu.iam.ldap import (
+    LDAPError, LDAPProvider, _ber_int, _ber_str, _parse_tlv, _tlv,
+)
+
+from .s3_harness import S3TestServer
+
+USERS = {
+    "alice": ("uid=alice,ou=people,dc=example,dc=com", "wonder"),
+    "bob": ("uid=bob,ou=people,dc=example,dc=com", "builder"),
+}
+GROUPS = {
+    "cn=devs,ou=groups,dc=example,dc=com":
+        ["uid=alice,ou=people,dc=example,dc=com"],
+}
+LOOKUP_DN = "cn=svc,dc=example,dc=com"
+LOOKUP_PW = "svcpw"
+
+
+class FakeLDAP:
+    """BER LDAP server: simple bind + equality subtree search."""
+
+    def __init__(self):
+        outer = self
+
+        class H(socketserver.BaseRequestHandler):
+            def handle(self):
+                outer._serve(self.request)
+
+        self.srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), H)
+        self.srv.daemon_threads = True
+        self.port = self.srv.server_address[1]
+        threading.Thread(target=self.srv.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+
+    # -- protocol -----------------------------------------------------------
+    def _serve(self, sock):
+        buf = b""
+        try:
+            while True:
+                while True:
+                    try:
+                        if len(buf) >= 2:
+                            _, payload, end = _parse_tlv(buf, 0)
+                            if end <= len(buf):
+                                break
+                    except IndexError:
+                        pass
+                    chunk = sock.recv(65536)
+                    if not chunk:
+                        return
+                    buf += chunk
+                _, payload, end = _parse_tlv(buf, 0)
+                buf = buf[end:]
+                _, mid_raw, off = _parse_tlv(payload, 0)
+                mid = int.from_bytes(mid_raw, "big")
+                tag = payload[off]
+                _, op, _ = _parse_tlv(payload, off)
+                if tag == 0x60:
+                    self._bind(sock, mid, op)
+                elif tag == 0x63:
+                    self._search(sock, mid, op)
+        except (ConnectionError, OSError):
+            return
+
+    def _reply(self, sock, mid, tag, code=0, diag=""):
+        body = (_tlv(0x0A, bytes([code])) + _ber_str("")
+                + _ber_str(diag))
+        msg = _tlv(0x30, _ber_int(mid) + _tlv(tag, body))
+        sock.sendall(msg)
+
+    def _bind(self, sock, mid, op):
+        _, _, off = _parse_tlv(op, 0)          # version
+        _, dn, off = _parse_tlv(op, off)       # name
+        _, pw, _ = _parse_tlv(op, off)         # simple password
+        dn, pw = dn.decode(), pw.decode()
+        ok = (dn == LOOKUP_DN and pw == LOOKUP_PW) or any(
+            dn == udn and pw == upw for udn, upw in USERS.values())
+        self._reply(sock, mid, 0x61, code=0 if ok else 49,
+                    diag="" if ok else "invalid credentials")
+
+    def _search(self, sock, mid, op):
+        _, base, off = _parse_tlv(op, 0)
+        for _ in range(5):                     # scope..typesOnly
+            _, _, off = _parse_tlv(op, off)
+        ftag = op[off]
+        _, filt, off = _parse_tlv(op, off)
+        assert ftag == 0xA3                    # equality filter
+        _, attr, v_off = _parse_tlv(filt, 0)
+        _, value, _ = _parse_tlv(filt, v_off)
+        attr, value = attr.decode(), value.decode()
+        base = base.decode()
+        results = []
+        if "people" in base and attr == "uid":
+            u = USERS.get(value)
+            if u:
+                results.append(u[0])
+        elif "groups" in base and attr == "member":
+            for gdn, members in GROUPS.items():
+                if value in members:
+                    results.append(gdn)
+        for dn in results:
+            entry = _tlv(0x64, _ber_str(dn) + _tlv(0x30, b""))
+            sock.sendall(_tlv(0x30, _ber_int(mid) + entry))
+        self._reply(sock, mid, 0x65)
+
+
+@pytest.fixture(scope="module")
+def ldap():
+    f = FakeLDAP()
+    yield f
+    f.close()
+
+
+def _provider(ldap):
+    return LDAPProvider(
+        "127.0.0.1", ldap.port,
+        lookup_bind_dn=LOOKUP_DN, lookup_bind_password=LOOKUP_PW,
+        user_base="ou=people,dc=example,dc=com", user_attr="uid",
+        group_base="ou=groups,dc=example,dc=com",
+        group_member_attr="member")
+
+
+class TestLDAPProvider:
+    def test_authenticate_and_groups(self, ldap):
+        p = _provider(ldap)
+        dn, groups = p.authenticate("alice", "wonder")
+        assert dn == USERS["alice"][0]
+        assert groups == ["cn=devs,ou=groups,dc=example,dc=com"]
+        dn, groups = p.authenticate("bob", "builder")
+        assert groups == []
+
+    def test_wrong_password_rejected(self, ldap):
+        with pytest.raises(LDAPError, match="bind failed"):
+            _provider(ldap).authenticate("alice", "nope")
+
+    def test_unknown_user_rejected(self, ldap):
+        with pytest.raises(LDAPError, match="not found"):
+            _provider(ldap).authenticate("mallory", "x")
+
+    def test_empty_password_rejected(self, ldap):
+        """An empty simple bind is 'unauthenticated' in LDAP and must
+        never mint credentials."""
+        with pytest.raises(LDAPError, match="empty password"):
+            _provider(ldap).authenticate("alice", "")
+
+    def test_env_construction(self, ldap):
+        env = {
+            "MINIO_IDENTITY_LDAP_SERVER_ADDR": f"127.0.0.1:{ldap.port}",
+            "MINIO_IDENTITY_LDAP_LOOKUP_BIND_DN": LOOKUP_DN,
+            "MINIO_IDENTITY_LDAP_LOOKUP_BIND_PASSWORD": LOOKUP_PW,
+            "MINIO_IDENTITY_LDAP_USER_DN_SEARCH_BASE_DN":
+                "ou=people,dc=example,dc=com",
+            "MINIO_IDENTITY_LDAP_GROUP_SEARCH_BASE_DN":
+                "ou=groups,dc=example,dc=com",
+        }
+        p = LDAPProvider.from_env(env)
+        dn, groups = p.authenticate("alice", "wonder")
+        assert dn == USERS["alice"][0]
+        assert LDAPProvider.from_env({}) is None
+
+
+class TestLDAPSTSEndToEnd:
+    @pytest.fixture()
+    def srv(self, tmp_path, ldap):
+        s = S3TestServer(str(tmp_path / "drives"))
+        s.server.ldap = _provider(ldap)
+        yield s
+        s.close()
+
+    def _exchange(self, srv, username, password):
+        body = urllib.parse.urlencode({
+            "Action": "AssumeRoleWithLDAPIdentity",
+            "Version": "2011-06-15",
+            "LDAPUsername": username,
+            "LDAPPassword": password,
+        }).encode()
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+        conn.request("POST", "/", body=body, headers={
+            "Content-Type": "application/x-www-form-urlencoded"})
+        r = conn.getresponse()
+        out = (r.status, r.read())
+        conn.close()
+        return out
+
+    def test_ldap_sts_yields_scoped_creds(self, srv):
+        # map the devs group DN to a policy in the IAM store
+        iam = srv.server.iam
+        iam.set_policy("ldap-rw", b"""{
+            "Version": "2012-10-17",
+            "Statement": [{"Effect": "Allow", "Action": ["s3:*"],
+                           "Resource": ["arn:aws:s3:::*"]}]}""")
+        iam.attach_group_policy(
+            "cn=devs,ou=groups,dc=example,dc=com", ["ldap-rw"],
+            create=True)
+        status, body = self._exchange(srv, "alice", "wonder")
+        assert status == 200, body
+        import re
+
+        ak = re.search(b"<AccessKeyId>([^<]+)", body).group(1).decode()
+        sk = re.search(b"<SecretAccessKey>([^<]+)", body).group(1).decode()
+        tok = re.search(b"<SessionToken>([^<]+)", body).group(1).decode()
+        # the minted credentials work against the S3 API
+        r = srv.request("PUT", "/ldapbkt", creds=(ak, sk),
+                        headers={"x-amz-security-token": tok})
+        assert r.status == 200
+        r = srv.request("PUT", "/ldapbkt/o", data=b"hi", creds=(ak, sk),
+                        headers={"x-amz-security-token": tok})
+        assert r.status == 200
+
+    def test_bad_ldap_password_denied(self, srv):
+        status, body = self._exchange(srv, "alice", "wrong")
+        assert status == 403 and b"AccessDenied" in body
+
+    def test_unmapped_user_denied(self, srv):
+        # bob authenticates but maps to no policies
+        status, body = self._exchange(srv, "bob", "builder")
+        assert status == 403
