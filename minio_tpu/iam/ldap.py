@@ -15,11 +15,18 @@ from __future__ import annotations
 
 import os
 import socket
-import threading
 
 
 class LDAPError(Exception):
     pass
+
+
+def normalize_dn(dn: str) -> str:
+    """DNs are case-insensitive with insignificant whitespace around
+    RDN separators; policy-DB keys must match regardless of how the
+    directory renders them (the reference normalizes DNs before using
+    them as policy mapping keys)."""
+    return ",".join(part.strip() for part in dn.split(",")).lower()
 
 
 # ---------------------------------------------------------------- BER bits
@@ -71,14 +78,14 @@ class LDAPClient:
         self.host = host
         self.port = port
         self.timeout = timeout
-        self._mid = 0
-        self._lock = threading.Lock()
+
+    _MID = 1  # one outstanding request per roundtrip per socket: a
+              # constant message ID is unambiguous and thread-safe
 
     def _roundtrip(self, sock, op: bytes, want_tag: int) -> list[bytes]:
         """Send one LDAPMessage; collect response protocol-ops until one
         with `want_tag` arrives.  Returns all payloads in order."""
-        self._mid += 1
-        msg = _tlv(0x30, _ber_int(self._mid) + op)
+        msg = _tlv(0x30, _ber_int(self._MID) + op)
         sock.sendall(msg)
         out = []
         buf = b""
@@ -190,9 +197,11 @@ class LDAPProvider:
         addr = env.get("MINIO_IDENTITY_LDAP_SERVER_ADDR", "")
         if not addr:
             return None
-        host, _, port = addr.partition(":")
+        from minio_tpu.events.targets import _host_port
+
+        host, port = _host_port(addr, 389)  # IPv6-bracket aware
         return cls(
-            host, int(port or 389),
+            host, port,
             lookup_bind_dn=env.get("MINIO_IDENTITY_LDAP_LOOKUP_BIND_DN", ""),
             lookup_bind_password=env.get(
                 "MINIO_IDENTITY_LDAP_LOOKUP_BIND_PASSWORD", ""),
@@ -241,15 +250,3 @@ class LDAPProvider:
         finally:
             sock.close()
 
-    def policies_for(self, user_dn: str, groups: list[str],
-                     iam) -> list[str]:
-        """Policies attached in the IAM store to the user DN (as a
-        group-style mapping) or to any LDAP group DN (reference policy-DB
-        mappings keyed by DN)."""
-        out: list[str] = []
-        with iam._mu:
-            for key in [user_dn] + groups:
-                g = iam.groups.get(key)
-                if g:
-                    out.extend(g.get("policies", []))
-        return list(dict.fromkeys(out))

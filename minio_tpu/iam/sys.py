@@ -357,6 +357,21 @@ class IAMSys:
             self.store.save(f"groups/{group}.json", g)
         self._notify("group", group)
 
+    def ldap_policies(self, user_dn: str, groups: list[str]) -> list[str]:
+        """Policies mapped to an LDAP user DN or any of its group DNs
+        (reference policy-DB mappings keyed by DN).  DNs compare
+        normalized — directories render case/whitespace differently
+        from how operators type mapping keys."""
+        from .ldap import normalize_dn
+
+        want = {normalize_dn(d) for d in [user_dn] + list(groups)}
+        out: list[str] = []
+        with self._mu:
+            for key, g in self.groups.items():
+                if normalize_dn(key) in want:
+                    out.extend(g.get("policies", []))
+        return list(dict.fromkeys(out))
+
     def list_groups(self) -> list[str]:
         with self._mu:
             return sorted(self.groups)

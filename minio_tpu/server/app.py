@@ -652,7 +652,13 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                     self.ldap.authenticate, username, password)
             except LDAPError as e:
                 raise S3Error("AccessDenied", f"LDAP auth failed: {e}")
-            policies = self.ldap.policies_for(user_dn, groups, self.iam)
+            except OSError as e:
+                # directory down/unreachable is an availability problem,
+                # not a credentials one
+                raise S3Error("ServiceUnavailable",
+                              f"LDAP server unreachable: {e}")
+            policies = await self._run(
+                self.iam.ldap_policies, user_dn, groups)
             try:
                 ident = await self._run(
                     self.iam.assume_role_web_identity, f"ldap:{user_dn}",

@@ -230,3 +230,34 @@ class TestLDAPSTSEndToEnd:
         # bob authenticates but maps to no policies
         status, body = self._exchange(srv, "bob", "builder")
         assert status == 403
+
+    def test_dn_mapping_is_case_insensitive(self, srv):
+        """AD-style DN rendering (CN=Devs,OU=Groups,...) must match a
+        mapping the operator typed lowercase (review finding)."""
+        iam = srv.server.iam
+        iam.set_policy("ldap-ci", b"""{
+            "Version": "2012-10-17",
+            "Statement": [{"Effect": "Allow", "Action": ["s3:GetObject"],
+                           "Resource": ["arn:aws:s3:::*"]}]}""")
+        iam.attach_group_policy(
+            "CN=Devs, OU=Groups, DC=Example, DC=Com", ["ldap-ci"],
+            create=True)
+        pols = iam.ldap_policies(
+            "uid=alice,ou=people,dc=example,dc=com",
+            ["cn=devs,ou=groups,dc=example,dc=com"])
+        assert pols == ["ldap-ci"]
+
+    def test_unreachable_ldap_is_service_unavailable(self, srv):
+        import socket as sock_mod
+
+        from minio_tpu.iam.ldap import LDAPProvider
+
+        s = sock_mod.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+        s.close()
+        srv.server.ldap = LDAPProvider("127.0.0.1", dead_port,
+                                       user_base="ou=people", timeout=0.3)
+        status, body = self._exchange(srv, "alice", "wonder")
+        assert status == 503, body
+        assert b"ServiceUnavailable" in body
