@@ -230,7 +230,7 @@ def bench_tpu():
     # the tunnel's throughput wanders minute to minute: interleave
     # encode/identity passes so noise hits both equally, report medians
     encs, links = [], []
-    for _ in range(3 if on_tpu else 1):
+    for _ in range(5 if on_tpu else 1):
         encs.append(pipeline(enc_fn))
         links.append(pipeline(identity_parity))
     results["stream_encode"] = float(np.median(encs))
