@@ -777,6 +777,9 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 return await self._handle(request, self.get_object_legal_hold)
             if "acl" in q:
                 return await self._handle(request, self.get_object_acl)
+            if "attributes" in q:
+                return await self._handle(request,
+                                          self.get_object_attributes)
             return await self._handle(request, self.get_object)
         if m == "HEAD":
             return await self._handle(request, self.head_object)
@@ -1208,6 +1211,22 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             h["x-amz-replication-status"] = oi.metadata[REPL_STATUS_KEY]
         return h
 
+    @staticmethod
+    def _checksum_headers(request, oi) -> dict[str, str]:
+        """x-amz-checksum-<algo> when the client asked with
+        x-amz-checksum-mode: ENABLED (reference hash.Checksum
+        AddChecksumHeader)."""
+        if request.headers.get("x-amz-checksum-mode", "").upper() \
+                != "ENABLED":
+            return {}
+        from minio_tpu.utils import checksum as cksum_mod
+
+        stored = oi.metadata.get(cksum_mod.META_CHECKSUM, "")
+        got = cksum_mod.load(stored) if stored else None
+        if got is None:
+            return {}
+        return {cksum_mod.header_name(got[0]): got[1]}
+
     async def put_object(self, request: web.Request) -> web.Response:
         bucket, key = self._object(request)
         sha_claim = request.headers.get("x-amz-content-sha256", "")
@@ -1294,6 +1313,21 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             # the raw body carries signature framing)
             body_md5 = hashlib.md5()
             reader = _TeeHashReader(reader, body_md5)
+        # additional object checksums (x-amz-checksum-*, reference
+        # internal/hash/checksum.go): verified against the decoded
+        # payload and stored with the object
+        from minio_tpu.utils import checksum as cksum_mod
+
+        try:
+            cksum = cksum_mod.from_headers(request.headers)
+        except cksum_mod.ChecksumError as e:
+            raise S3Error("InvalidChecksum", str(e))
+        cksum_hasher = None
+        if cksum is not None:
+            cksum_hasher = cksum_mod.new_hasher(cksum[0])
+            reader = _TeeHashReader(reader, cksum_hasher)
+            opts.user_metadata[cksum_mod.META_CHECKSUM] = \
+                cksum_mod.store(*cksum)
         # server-side encryption wraps the decoded plaintext stream
         # (reference EncryptRequest, cmd/encryption-v1.go:324)
         sse_kind, customer_key = self.sse_kind_for_put(request, bucket)
@@ -1349,7 +1383,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             raise
         if feed_err is not None:
             raise S3Error("IncompleteBody")
-        async def _digest_rollback(msg: str):
+        async def _digest_rollback(msg: str, code: str = "BadDigest"):
             # tampered/corrupted body: roll back the just-written version
             # (reference rejects digest mismatches during the stream)
             try:
@@ -1358,13 +1392,20 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 )
             except Exception:
                 pass
-            raise S3Error("BadDigest", msg)
+            raise S3Error(code, msg)
 
         if body_sha is not None and body_sha.hexdigest() != sha_claim:
             await _digest_rollback("x-amz-content-sha256 does not match body")
         if body_md5 is not None and body_md5.digest() != md5_want:
             await _digest_rollback("Content-MD5 does not match body")
+        if cksum_hasher is not None \
+                and cksum_mod.encode(cksum_hasher.digest()) != cksum[1]:
+            await _digest_rollback(
+                f"x-amz-checksum-{cksum[0]} does not match body",
+                code="XAmzContentChecksumMismatch")
         headers = {"ETag": f'"{oi.etag}"'}
+        if cksum is not None:
+            headers[cksum_mod.header_name(cksum[0])] = cksum[1]
         if oi.version_id:
             headers["x-amz-version-id"] = oi.version_id
         elif vstatus == "Suspended":
@@ -1866,6 +1907,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         status = 200
         offset, length = 0, size
         headers = self._obj_headers(oi)
+        headers.update(self._checksum_headers(request, oi))
         rng = request.headers.get("Range")
         if rng and size > 0:
             start, end = self._parse_range(rng, size)
@@ -1918,6 +1960,72 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         await resp.write_eof()
         return resp
 
+    async def get_object_attributes(self, request: web.Request
+                                    ) -> web.Response:
+        """GetObjectAttributes (?attributes): the requested subset of
+        ETag / Checksum / ObjectSize / StorageClass / ObjectParts
+        (reference getObjectAttributesHandler,
+        cmd/object-handlers.go)."""
+        bucket, key = self._object(request)
+        await self._auth(request, None, "s3:GetObjectAttributes",
+                         bucket, key)
+        wanted = {
+            a.strip() for a in
+            request.headers.get("x-amz-object-attributes", "").split(",")
+            if a.strip()
+        }
+        if not wanted:
+            raise S3Error("InvalidArgument",
+                          "x-amz-object-attributes header is required")
+        valid = {"ETag", "Checksum", "ObjectParts", "StorageClass",
+                 "ObjectSize"}
+        bad = wanted - valid
+        if bad:
+            raise S3Error("InvalidArgument",
+                          f"invalid object attributes: {sorted(bad)}")
+        vid = request.rel_url.query.get("versionId", "")
+        oi = await self._run(self.api.get_object_info, bucket, key, vid)
+        from minio_tpu.utils import checksum as cksum_mod
+        from minio_tpu.utils import compress as compress_mod
+
+        size = oi.size
+        actual = oi.metadata.get(compress_mod.META_ACTUAL_SIZE)
+        if actual:
+            size = int(actual)
+        parts_xml = ""
+        if "ObjectParts" in wanted:
+            nparts = len(getattr(oi, "parts", []) or [])
+            parts_xml = (f"<ObjectParts><TotalPartsCount>{nparts}"
+                         f"</TotalPartsCount></ObjectParts>")
+        body = ['<?xml version="1.0" encoding="UTF-8"?>',
+                f'<GetObjectAttributesOutput xmlns="{XMLNS}">']
+        if "ETag" in wanted:
+            body.append(f"<ETag>{escape(oi.etag)}</ETag>")
+        if "Checksum" in wanted:
+            stored = oi.metadata.get(cksum_mod.META_CHECKSUM, "")
+            got = cksum_mod.load(stored) if stored else None
+            if got is not None:
+                body.append(
+                    f"<Checksum><{cksum_mod.xml_tag(got[0])}>"
+                    f"{escape(got[1])}"
+                    f"</{cksum_mod.xml_tag(got[0])}></Checksum>")
+        if parts_xml:
+            body.append(parts_xml)
+        if "StorageClass" in wanted:
+            body.append("<StorageClass>"
+                        + escape(oi.metadata.get(
+                            "x-amz-storage-class", "STANDARD"))
+                        + "</StorageClass>")
+        if "ObjectSize" in wanted:
+            body.append(f"<ObjectSize>{size}</ObjectSize>")
+        body.append("</GetObjectAttributesOutput>")
+        headers = {"Last-Modified": _http_date(oi.mod_time)}
+        if oi.version_id:
+            headers["x-amz-version-id"] = oi.version_id
+        resp = self._xml(200, "".join(body))
+        resp.headers.update(headers)
+        return resp
+
     async def head_object(self, request: web.Request) -> web.Response:
         from minio_tpu.crypto import sse as sse_mod
 
@@ -1936,6 +2044,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             oi.version_id = "null"
         self.check_preconditions(request, oi)
         headers = self._obj_headers(oi)
+        headers.update(self._checksum_headers(request, oi))
         from minio_tpu.utils import compress as compress_mod
 
         if oi.metadata.get(sse_mod.META_ALGO):

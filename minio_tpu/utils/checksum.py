@@ -1,0 +1,146 @@
+"""S3 additional object checksums (x-amz-checksum-*).
+
+Reference: internal/hash/checksum.go — CRC32 (IEEE), CRC32C
+(Castagnoli), SHA1, SHA256 checksums carried on PUT via
+`x-amz-checksum-<algo>` headers (base64 of the big-endian digest),
+verified server-side against the decoded payload, stored with the
+object, and surfaced on HEAD/GET when `x-amz-checksum-mode: ENABLED`
+and via GetObjectAttributes (cmd/object-handlers.go
+getObjectAttributesHandler).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import zlib
+
+# stored with the object as "<ALGO>:<b64digest>"
+META_CHECKSUM = "x-minio-internal-checksum"
+
+
+def _crc32c_table() -> list[int]:
+    poly = 0x82F63B78  # Castagnoli, reflected
+    table = []
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ poly if c & 1 else c >> 1
+        table.append(c)
+    return table
+
+
+_CRC32C_TABLE = _crc32c_table()
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    """Incremental CRC-32C (pass the previous return as `crc`)."""
+    c = crc ^ 0xFFFFFFFF
+    tab = _CRC32C_TABLE
+    for b in data:
+        c = tab[(c ^ b) & 0xFF] ^ (c >> 8)
+    return c ^ 0xFFFFFFFF
+
+
+class _CrcHasher:
+    def __init__(self, fn):
+        self._fn = fn
+        self._crc = 0
+
+    def update(self, data) -> None:
+        self._crc = self._fn(bytes(data), self._crc)
+
+    def digest(self) -> bytes:
+        return self._crc.to_bytes(4, "big")
+
+
+def _hashlib_hasher(name):
+    class H:
+        def __init__(self):
+            self._h = hashlib.new(name)
+
+        def update(self, data) -> None:
+            self._h.update(data)
+
+        def digest(self) -> bytes:
+            return self._h.digest()
+    return H
+
+
+ALGORITHMS = {
+    "crc32": (lambda: _CrcHasher(zlib.crc32), 4),
+    "crc32c": (lambda: _CrcHasher(crc32c), 4),
+    "sha1": (_hashlib_hasher("sha1"), 20),
+    "sha256": (_hashlib_hasher("sha256"), 32),
+}
+
+# wire order AWS uses in headers/XML
+_CANON = {"crc32": "CRC32", "crc32c": "CRC32C",
+          "sha1": "SHA1", "sha256": "SHA256"}
+
+
+def new_hasher(algo: str):
+    return ALGORITHMS[algo][0]()
+
+
+def header_name(algo: str) -> str:
+    return f"x-amz-checksum-{algo}"
+
+
+def xml_tag(algo: str) -> str:
+    return f"Checksum{_CANON[algo]}"
+
+
+def encode(digest: bytes) -> str:
+    return base64.b64encode(digest).decode()
+
+
+class ChecksumError(ValueError):
+    pass
+
+
+def from_headers(headers) -> tuple[str, str] | None:
+    """-> (algo, b64value) from `x-amz-checksum-<algo>`; None when no
+    checksum was sent.  Multiple checksum headers, an inconsistent
+    x-amz-sdk-checksum-algorithm, or a malformed value all raise."""
+    found: list[tuple[str, str]] = []
+    for algo in ALGORITHMS:
+        v = headers.get(header_name(algo), "")
+        if v:
+            found.append((algo, v))
+    if not found:
+        return None
+    if len(found) > 1:
+        raise ChecksumError("more than one checksum header")
+    algo, value = found[0]
+    declared = headers.get("x-amz-sdk-checksum-algorithm", "")
+    if declared and declared.lower() != algo:
+        raise ChecksumError(
+            f"checksum header does not match declared algorithm {declared}")
+    try:
+        raw = base64.b64decode(value, validate=True)
+    except (ValueError, TypeError):
+        raise ChecksumError("checksum value is not valid base64")
+    if len(raw) != ALGORITHMS[algo][1]:
+        raise ChecksumError(f"bad {algo} checksum length {len(raw)}")
+    return algo, value
+
+
+def store(algo: str, b64: str) -> str:
+    return f"{algo}:{b64}"
+
+
+def load(meta_value: str) -> tuple[str, str] | None:
+    algo, _, b64 = meta_value.partition(":")
+    if algo in ALGORITHMS and b64:
+        return algo, b64
+    return None
+
+
+def composite(algo: str, part_digests: list[bytes]) -> str:
+    """Multipart composite checksum: digest over the concatenated part
+    digests, rendered as b64 + '-<nparts>' (AWS composite semantics)."""
+    h = new_hasher(algo)
+    for d in part_digests:
+        h.update(d)
+    return f"{encode(h.digest())}-{len(part_digests)}"

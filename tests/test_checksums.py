@@ -1,0 +1,159 @@
+"""Additional object checksums (x-amz-checksum-*) + GetObjectAttributes
+(reference internal/hash/checksum.go, cmd/object-handlers.go
+getObjectAttributesHandler)."""
+
+import base64
+import hashlib
+import zlib
+
+import pytest
+
+from minio_tpu.utils import checksum as ck
+
+from .s3_harness import S3TestServer
+
+
+@pytest.fixture(scope="module")
+def srv(tmp_path_factory):
+    s = S3TestServer(str(tmp_path_factory.mktemp("ckdrives")))
+    s.request("PUT", "/ckb")
+    yield s
+    s.close()
+
+
+def _b64(d: bytes) -> str:
+    return base64.b64encode(d).decode()
+
+
+def _expected(algo: str, data: bytes) -> str:
+    if algo == "crc32":
+        return _b64(zlib.crc32(data).to_bytes(4, "big"))
+    if algo == "crc32c":
+        return _b64(ck.crc32c(data).to_bytes(4, "big"))
+    return _b64(hashlib.new(algo, data).digest())
+
+
+class TestChecksumUnit:
+    def test_crc32c_known_vector(self):
+        # RFC 3720 iSCSI test vector: crc32c of 32 zero bytes
+        assert ck.crc32c(b"\x00" * 32) == 0x8A9136AA
+        assert ck.crc32c(b"123456789") == 0xE3069283
+
+    def test_incremental_matches_oneshot(self):
+        data = bytes(range(256)) * 100
+        h = ck.new_hasher("crc32c")
+        for i in range(0, len(data), 999):
+            h.update(data[i:i + 999])
+        assert h.digest() == ck.crc32c(data).to_bytes(4, "big")
+
+    def test_from_headers_validation(self):
+        assert ck.from_headers({}) is None
+        good = {"x-amz-checksum-sha256": _b64(b"\x01" * 32)}
+        assert ck.from_headers(good) == ("sha256", _b64(b"\x01" * 32))
+        with pytest.raises(ck.ChecksumError):
+            ck.from_headers({"x-amz-checksum-crc32": "!!!"})
+        with pytest.raises(ck.ChecksumError):
+            ck.from_headers({"x-amz-checksum-crc32": _b64(b"\x01" * 5)})
+        with pytest.raises(ck.ChecksumError):
+            ck.from_headers({"x-amz-checksum-crc32": _b64(b"\x01" * 4),
+                             "x-amz-checksum-sha1": _b64(b"\x01" * 20)})
+        with pytest.raises(ck.ChecksumError):
+            ck.from_headers({"x-amz-checksum-crc32": _b64(b"\x01" * 4),
+                             "x-amz-sdk-checksum-algorithm": "SHA256"})
+
+
+class TestChecksumAPI:
+    @pytest.mark.parametrize("algo", ["crc32", "crc32c", "sha1", "sha256"])
+    def test_put_and_retrieve(self, srv, algo):
+        data = b"checksummed payload " * 1000
+        want = _expected(algo, data)
+        r = srv.request("PUT", f"/ckb/{algo}-obj", data=data,
+                        headers={f"x-amz-checksum-{algo}": want})
+        assert r.status == 200
+        assert r.headers.get(f"x-amz-checksum-{algo}") == want
+        # checksum mode off: no checksum header
+        r = srv.request("HEAD", f"/ckb/{algo}-obj")
+        assert f"x-amz-checksum-{algo}" not in r.headers
+        # enabled: returned on HEAD and GET
+        r = srv.request("HEAD", f"/ckb/{algo}-obj",
+                        headers={"x-amz-checksum-mode": "ENABLED"})
+        assert r.headers.get(f"x-amz-checksum-{algo}") == want
+        r = srv.request("GET", f"/ckb/{algo}-obj",
+                        headers={"x-amz-checksum-mode": "enabled"})
+        assert r.headers.get(f"x-amz-checksum-{algo}") == want
+        assert r.body == data
+
+    def test_mismatch_rejected_and_rolled_back(self, srv):
+        data = b"payload"
+        wrong = _expected("sha256", b"other")
+        r = srv.request("PUT", "/ckb/bad", data=data,
+                        headers={"x-amz-checksum-sha256": wrong})
+        assert r.status == 400
+        assert b"XAmzContentChecksumMismatch" in r.body
+        assert srv.request("GET", "/ckb/bad").status == 404
+
+    def test_malformed_checksum_rejected(self, srv):
+        r = srv.request("PUT", "/ckb/mal", data=b"x",
+                        headers={"x-amz-checksum-crc32": "notbase64!!"})
+        assert r.status == 400
+        assert b"InvalidChecksum" in r.body
+
+    def test_get_object_attributes(self, srv):
+        data = b"attr payload " * 512
+        want = _expected("crc32c", data)
+        srv.request("PUT", "/ckb/attrs", data=data,
+                    headers={"x-amz-checksum-crc32c": want})
+        r = srv.request(
+            "GET", "/ckb/attrs", query=[("attributes", "")],
+            headers={"x-amz-object-attributes":
+                     "ETag,Checksum,ObjectSize,StorageClass"})
+        assert r.status == 200, r.body
+        assert b"<ETag>" in r.body
+        assert f"<ChecksumCRC32C>{want}</ChecksumCRC32C>".encode() in r.body
+        assert f"<ObjectSize>{len(data)}</ObjectSize>".encode() in r.body
+        assert b"<StorageClass>STANDARD</StorageClass>" in r.body
+        # subset: only what was asked for comes back
+        r = srv.request("GET", "/ckb/attrs", query=[("attributes", "")],
+                        headers={"x-amz-object-attributes": "ObjectSize"})
+        assert b"<ETag>" not in r.body and b"<ObjectSize>" in r.body
+        # missing header errors
+        r = srv.request("GET", "/ckb/attrs", query=[("attributes", "")])
+        assert r.status == 400
+        r = srv.request("GET", "/ckb/attrs", query=[("attributes", "")],
+                        headers={"x-amz-object-attributes": "Bogus"})
+        assert r.status == 400
+
+    def test_attributes_object_parts(self, srv):
+        import re
+
+        r = srv.request("POST", "/ckb/mp-attr", query=[("uploads", "")])
+        uid = re.search(b"<UploadId>([^<]+)</UploadId>", r.body) \
+            .group(1).decode()
+        parts = []
+        for n in (1, 2):
+            pr = srv.request("PUT", "/ckb/mp-attr",
+                             data=bytes([n]) * (5 << 20),
+                             query=[("partNumber", str(n)),
+                                    ("uploadId", uid)])
+            parts.append((n, pr.headers["ETag"]))
+        done = "<CompleteMultipartUpload>" + "".join(
+            f"<Part><PartNumber>{n}</PartNumber><ETag>{e}</ETag></Part>"
+            for n, e in parts) + "</CompleteMultipartUpload>"
+        assert srv.request("POST", "/ckb/mp-attr",
+                           query=[("uploadId", uid)],
+                           data=done.encode()).status == 200
+        r = srv.request("GET", "/ckb/mp-attr", query=[("attributes", "")],
+                        headers={"x-amz-object-attributes": "ObjectParts"})
+        assert b"<TotalPartsCount>2</TotalPartsCount>" in r.body
+
+    def test_checksum_survives_copy(self, srv):
+        data = b"copied with checksum"
+        want = _expected("sha1", data)
+        srv.request("PUT", "/ckb/cp-src", data=data,
+                    headers={"x-amz-checksum-sha1": want})
+        r = srv.request("PUT", "/ckb/cp-dst",
+                        headers={"x-amz-copy-source": "/ckb/cp-src"})
+        assert r.status == 200
+        r = srv.request("HEAD", "/ckb/cp-dst",
+                        headers={"x-amz-checksum-mode": "ENABLED"})
+        assert r.headers.get("x-amz-checksum-sha1") == want
