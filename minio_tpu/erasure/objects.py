@@ -80,6 +80,9 @@ class PutObjectOptions:
     versioned: bool = False
     version_id: str = ""
     storage_class: str = ""  # "STANDARD" | "REDUCED_REDUNDANCY"
+    # nonzero pins the version's mod time (pool decommission moves
+    # versions between pools without reordering history)
+    mod_time: float = 0.0
     # called after the stream is fully consumed, just before metadata
     # commit — lets transforming wrappers (compression) contribute the
     # original size/ETag they only know at EOF
@@ -432,7 +435,7 @@ class ErasureObjects:
                 )
 
         etag = hreader.etag
-        mod_time = time.time()
+        mod_time = opts.mod_time or time.time()
         metadata = dict(opts.user_metadata)
         metadata["etag"] = etag
         if opts.content_type:
@@ -727,6 +730,28 @@ class ErasureObjects:
             _, wq = self._quorum_from([None] * len(self.disks))
             if sum(1 for e2 in errs if e2 is None) < wq:
                 raise errors.ErasureWriteQuorum("transition quorum not met")
+
+    def put_delete_marker(self, bucket: str, obj: str, version_id: str,
+                          mod_time: float) -> None:
+        """Write a delete marker with a PINNED version id and mod time —
+        pool decommission replays markers into the target pool without
+        reordering version history (the reference's decom moves versions
+        verbatim, cmd/erasure-server-pool-decom.go)."""
+        marker = FileInfo(volume=bucket, name=obj, version_id=version_id,
+                          deleted=True, mod_time=mod_time)
+        with self.ns.write(f"{bucket}/{obj}"):
+            def put_marker(i: int) -> None:
+                d = self.disks[i]
+                if d is None or not d.is_online():
+                    raise errors.DiskNotFound(str(i))
+                d.write_metadata(bucket, obj, marker)
+
+            errs = self._fan_out(put_marker, range(len(self.disks)))
+            _, wq = self._quorum_from([None] * len(self.disks))
+            if sum(1 for e2 in errs if e2 is None) < wq:
+                raise errors.ErasureWriteQuorum("delete marker quorum")
+        if self.ns_updated is not None:
+            self.ns_updated(bucket, obj)
 
     # ---------------------------------------------------------------- DELETE
     def delete_object(self, bucket: str, obj: str, version_id: str = "",

@@ -238,6 +238,10 @@ class ErasureSets:
         return self.get_hashed_set(obj).delete_object(bucket, obj, version_id,
                                                       versioned, suspended)
 
+    def put_delete_marker(self, bucket, obj, version_id, mod_time) -> None:
+        self.get_hashed_set(obj).put_delete_marker(
+            bucket, obj, version_id, mod_time)
+
     def heal_object(self, bucket, obj, version_id="", deep=False) -> HealResult:
         return self.get_hashed_set(obj).heal_object(bucket, obj, version_id, deep)
 
@@ -430,6 +434,24 @@ class ErasureServerPools:
         if not pools:
             raise errors.InvalidArgument("no pools")
         self.pools = list(pools)
+        # pools being (or finished being) decommissioned take no new
+        # writes (cmd/erasure-server-pool-decom.go); state persists on
+        # the pool's drives so restarts keep honoring it
+        self._draining: set[int] = set()
+        for i, p in enumerate(self.pools):
+            try:
+                from minio_tpu.services.decom import load_state
+
+                if load_state(p).get("state") in ("draining", "complete"):
+                    self._draining.add(i)
+            except Exception:
+                pass
+
+    def mark_draining(self, idx: int, draining: bool) -> None:
+        if draining:
+            self._draining.add(idx)
+        else:
+            self._draining.discard(idx)
 
     # -- bucket ops over all pools -----------------------------------------
     def make_bucket(self, bucket: str) -> None:
@@ -471,7 +493,10 @@ class ErasureServerPools:
         pool cannot hold `size` more bytes
         (cmd/erasure-server-pool.go:241 getServerPoolsAvailableSpace)."""
         out = []
-        for p in self.pools:
+        for pi, p in enumerate(self.pools):
+            if pi in self._draining:
+                out.append(0)  # decommissioning pools take no new data
+                continue
             s = p.get_hashed_set(obj)
             infos = []
             for d in s.disks:

@@ -23,6 +23,7 @@ import time
 
 from aiohttp import web
 
+from minio_tpu.storage import errors as st
 from minio_tpu.storage.local import SYSTEM_VOL
 
 from .s3errors import S3Error
@@ -50,6 +51,14 @@ class AdminMixin:
             r.add_post(path, wrap(self.admin_heal, "Heal"))
         r.add_get(f"{p}/background-heal/status",
                   wrap(self.admin_bg_heal_status, "Heal"))
+        # pool topology: status / decommission start / cancel (reference
+        # cmd/admin-handlers-pools.go)
+        r.add_get(f"{p}/pools/status",
+                  wrap(self.admin_pools_status, "ServerInfo"))
+        r.add_post(f"{p}/pools/decommission",
+                   wrap(self.admin_pools_decommission, "DecommissionPool"))
+        r.add_post(f"{p}/pools/cancel",
+                   wrap(self.admin_pools_cancel, "DecommissionPool"))
         # users / policies / groups / service accounts
         r.add_put(f"{p}/add-user", wrap(self.admin_add_user, "CreateUser"))
         r.add_delete(f"{p}/remove-user", wrap(self.admin_remove_user, "DeleteUser"))
@@ -717,6 +726,87 @@ class AdminMixin:
 
     async def admin_storage_info(self, request: web.Request, body: bytes):
         return self._json(await self._run(self.api.storage_info))
+
+    # ------------------------------------------------------------ pools
+    def _decom_jobs(self) -> dict:
+        jobs = getattr(self, "_decom_jobs_map", None)
+        if jobs is None:
+            jobs = self._decom_jobs_map = {}
+        return jobs
+
+    def _pool_idx(self, request) -> int:
+        try:
+            return int(request.rel_url.query.get("pool", ""))
+        except ValueError:
+            raise S3Error("AdminInvalidArgument",
+                          "pool must be an integer index")
+
+    async def admin_pools_status(self, request: web.Request, body: bytes):
+        """Per-pool layout + decommission state (reference
+        cmd/admin-handlers-pools.go StatusPool)."""
+        from minio_tpu.services import decom as decom_mod
+
+        if not hasattr(self.api, "pools"):
+            raise S3Error("NotImplemented",
+                          "pool topology does not apply to this backend")
+
+        def run():
+            out = []
+            for i, p in enumerate(self.api.pools):
+                job = self._decom_jobs().get(i)
+                state = (dict(job.state) if job is not None
+                         else decom_mod.load_state(p))
+                info = p.storage_info()
+                out.append({
+                    "pool": i,
+                    "sets": info["sets"],
+                    "drivesPerSet": info["drives_per_set"],
+                    "decommission": state,
+                    "draining": i in self.api._draining,
+                })
+            return out
+
+        return self._json({"pools": await self._run(run)})
+
+    async def admin_pools_decommission(self, request: web.Request,
+                                       body: bytes):
+        """Start draining one pool into the others (reference
+        cmd/admin-handlers-pools.go StartDecommission)."""
+        from minio_tpu.services.decom import PoolDecommission
+
+        if not hasattr(self.api, "pools"):
+            raise S3Error("NotImplemented",
+                          "pool topology does not apply to this backend")
+        idx = self._pool_idx(request)
+
+        def run():
+            jobs = self._decom_jobs()
+            job = jobs.get(idx)
+            if job is not None and job.state.get("state") == "draining":
+                raise S3Error("AdminInvalidArgument",
+                              f"pool {idx} is already draining")
+            job = PoolDecommission(self.api, idx)
+            job.start()
+            jobs[idx] = job
+            return dict(job.state)
+
+        try:
+            return self._json(await self._run(run))
+        except st.InvalidArgument as e:
+            raise S3Error("AdminInvalidArgument", str(e))
+
+    async def admin_pools_cancel(self, request: web.Request, body: bytes):
+        idx = self._pool_idx(request)
+
+        def run():
+            job = self._decom_jobs().get(idx)
+            if job is None:
+                raise S3Error("AdminInvalidArgument",
+                              f"no decommission running for pool {idx}")
+            job.cancel()
+            return dict(job.state)
+
+        return self._json(await self._run(run))
 
     async def admin_data_usage(self, request: web.Request, body: bytes):
         """Cluster usage; with ?bucket= (and optional ?prefix=) the

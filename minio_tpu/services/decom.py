@@ -1,0 +1,202 @@
+"""Pool decommission: drain one server pool's objects into the rest.
+
+Reference: cmd/erasure-server-pool-decom.go — `mc admin decommission
+start myminio/ http://pool1/...` walks every bucket of the draining
+pool, moves each object version into the remaining pools, and records
+resumable progress; placement stops selecting the pool the moment the
+drain starts.
+
+Design here: the drain job walks the source pool's entry stream
+(name + all versions), re-puts each live version into the surviving
+pools with its version id AND mod time pinned (PutObjectOptions
+version_id/mod_time), re-creates delete markers, then deletes the
+source copy.  State persists on the source pool's first online drive
+(`decommission.json`) so a restart resumes (bucket granularity) and a
+completed pool stays excluded from placement.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+import time
+
+from minio_tpu.storage import errors
+from minio_tpu.storage.local import SYSTEM_VOL
+
+DECOM_FILE = "decommission.json"
+
+_STATES = ("none", "draining", "complete", "failed", "canceled")
+
+
+def _state_disk(pool):
+    for d in pool.all_disks:
+        try:
+            if d is not None and d.is_online():
+                return d
+        except Exception:
+            continue
+    return None
+
+
+def load_state(pool) -> dict:
+    d = _state_disk(pool)
+    if d is None:
+        return {"state": "none"}
+    try:
+        return json.loads(d.read_all(SYSTEM_VOL, DECOM_FILE))
+    except Exception:
+        return {"state": "none"}
+
+
+def save_state(pool, state: dict) -> None:
+    d = _state_disk(pool)
+    if d is not None:
+        try:
+            d.write_all(SYSTEM_VOL, DECOM_FILE,
+                        json.dumps(state).encode())
+        except Exception:
+            pass
+
+
+class PoolDecommission:
+    """One drain job over `pools` (ErasureServerPools), emptying
+    pools.pools[idx] into the others."""
+
+    def __init__(self, pools, idx: int):
+        if not 0 <= idx < len(pools.pools):
+            raise errors.InvalidArgument(f"no pool {idx}")
+        if len(pools.pools) < 2:
+            raise errors.InvalidArgument(
+                "cannot decommission the only pool")
+        self.pools = pools
+        self.idx = idx
+        self.src = pools.pools[idx]
+        self.state = load_state(self.src)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- control ------------------------------------------------------------
+    def start(self) -> None:
+        if self.state.get("state") == "draining":
+            raise errors.InvalidArgument("decommission already running")
+        if self.state.get("state") == "complete":
+            raise errors.InvalidArgument("pool already decommissioned")
+        self.state = {
+            "state": "draining", "started": time.time(),
+            "moved_objects": 0, "moved_bytes": 0, "failed_objects": 0,
+            "done_buckets": [],
+        }
+        save_state(self.src, self.state)
+        self.pools.mark_draining(self.idx, True)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"decom-pool-{self.idx}")
+        self._thread.start()
+
+    def cancel(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self.state["state"] = "canceled"
+        save_state(self.src, self.state)
+        self.pools.mark_draining(self.idx, False)
+
+    def wait(self, timeout: float = 600.0) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # -- drain --------------------------------------------------------------
+    def _run(self) -> None:
+        try:
+            for vol in self.src.list_buckets():
+                bucket = vol.name
+                if self._stop.is_set():
+                    return
+                if bucket in self.state["done_buckets"]:
+                    continue
+                self._drain_bucket(bucket)
+                self.state["done_buckets"].append(bucket)
+                save_state(self.src, self.state)
+            self.state["state"] = "complete"
+            self.state["finished"] = time.time()
+        except Exception as e:
+            self.state["state"] = "failed"
+            self.state["error"] = str(e)
+        save_state(self.src, self.state)
+
+    def _drain_bucket(self, bucket: str) -> None:
+        for entry in self.src.list_entries(bucket):
+            if self._stop.is_set():
+                return
+            name = entry.name
+            # oldest-first so xl.meta mod-time ordering (and is_latest)
+            # lands identically in the target pool
+            for oi in reversed(entry.versions):
+                try:
+                    self._move_version(bucket, name, oi)
+                    self.state["moved_objects"] += 1
+                    self.state["moved_bytes"] += max(oi.size, 0)
+                except Exception:
+                    self.state["failed_objects"] += 1
+
+    def _move_version(self, bucket: str, name: str, oi) -> None:
+        from minio_tpu.erasure.objects import PutObjectOptions
+
+        target = self._target_pool(name, max(oi.size, 0))
+        if oi.delete_marker:
+            # replay the marker with its id + mod time pinned, then drop
+            # the source's copy
+            target.put_delete_marker(bucket, name, oi.version_id or "",
+                                     oi.mod_time)
+            self.src.delete_object(bucket, name,
+                                   version_id=oi.version_id or "null")
+            return
+        _, stream = self.src.get_object(
+            bucket, name, version_id=oi.version_id)
+        meta = {k: v for k, v in oi.metadata.items()
+                if k not in ("etag", "content-type")}
+        opts = PutObjectOptions(
+            user_metadata=meta,
+            content_type=oi.content_type,
+            versioned=bool(oi.version_id),
+            version_id=oi.version_id,
+            mod_time=oi.mod_time,
+        )
+        reader = _IterReader(stream)
+        target.put_object(bucket, name, reader, oi.size, opts)
+        self.src.delete_object(bucket, name,
+                               version_id=oi.version_id or "null")
+
+    def _target_pool(self, obj: str, size: int):
+        avail = self.pools._pool_available(obj, size)
+        best, best_a = None, -1
+        for i, (p, a) in enumerate(zip(self.pools.pools, avail)):
+            if i == self.idx:
+                continue
+            if a > best_a:
+                best, best_a = p, a
+        if best is None or best_a <= 0:
+            raise errors.DiskFull("no target pool has space")
+        return best
+
+
+class _IterReader(io.RawIOBase):
+    """File-like over the get_object chunk iterator."""
+
+    def __init__(self, chunks):
+        self._it = iter(chunks)
+        self._buf = b""
+
+    def read(self, n: int = -1) -> bytes:
+        if n is None or n < 0:
+            out = self._buf + b"".join(self._it)
+            self._buf = b""
+            return out
+        while len(self._buf) < n:
+            try:
+                self._buf += next(self._it)
+            except StopIteration:
+                break
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out

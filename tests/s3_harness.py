@@ -62,7 +62,8 @@ class Resp:
 class S3TestServer:
     def __init__(self, root: str, n_drives: int = 4,
                  access_key: str = "testadmin", secret_key: str = "testsecret",
-                 start_services: bool = False, scan_interval: float = 60.0):
+                 start_services: bool = False, scan_interval: float = 60.0,
+                 pools=None):
         # SSE-S3 needs a configured KMS master key (never persisted to the
         # drives); give tests a deterministic one unless a test overrides.
         os.environ.setdefault(
@@ -70,8 +71,10 @@ class S3TestServer:
             "test-key:" + base64.b64encode(b"\x07" * 32).decode(),
         )
         self.ak, self.sk = access_key, secret_key
-        disks = [LocalStorage(f"{root}/d{i}") for i in range(n_drives)]
-        self.pools = ErasureServerPools([ErasureSets(disks)])
+        if pools is None:
+            disks = [LocalStorage(f"{root}/d{i}") for i in range(n_drives)]
+            pools = ErasureServerPools([ErasureSets(disks)])
+        self.pools = pools
         self.app = make_app(self.pools, access_key=access_key,
                             secret_key=secret_key,
                             start_services=start_services,

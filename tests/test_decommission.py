@@ -1,0 +1,156 @@
+"""Pool decommission: drain one pool into the others with version
+history intact (reference cmd/erasure-server-pool-decom.go +
+cmd/admin-handlers-pools.go)."""
+
+import io
+import json
+
+import pytest
+
+from minio_tpu.erasure.objects import PutObjectOptions
+from minio_tpu.erasure.sets import ErasureSets, ErasureServerPools
+from minio_tpu.services.decom import PoolDecommission, load_state
+from minio_tpu.storage.local import LocalStorage
+
+from .s3_harness import S3TestServer
+
+
+def _two_pools(tmp_path, quota=256 << 20):
+    p0 = ErasureSets([LocalStorage(str(tmp_path / f"p0-d{i}"), quota=quota)
+                      for i in range(4)], set_size=4)
+    p1 = ErasureSets([LocalStorage(str(tmp_path / f"p1-d{i}"), quota=quota)
+                      for i in range(4)], set_size=4)
+    return ErasureServerPools([p0, p1])
+
+
+class TestDecommission:
+    def test_drain_moves_everything_and_blocks_placement(self, tmp_path):
+        pools = _two_pools(tmp_path)
+        pools.make_bucket("bkt")
+        payload = {f"obj-{i:02d}": bytes([i]) * (10_000 + i)
+                   for i in range(24)}
+        for name, data in payload.items():
+            pools.put_object("bkt", name, io.BytesIO(data), len(data))
+        src = pools.pools[0]
+        src_names = set(src.list_objects("bkt"))
+        assert src_names, "placement sent nothing to pool 0"
+
+        job = PoolDecommission(pools, 0)
+        job.start()
+        job.wait(60)
+        assert job.state["state"] == "complete", job.state
+        assert job.state["moved_objects"] == len(src_names)
+        assert job.state["failed_objects"] == 0
+
+        # every object readable, none left in pool 0
+        for name, data in payload.items():
+            _, stream = pools.get_object("bkt", name)
+            assert b"".join(stream) == data, name
+        assert src.list_objects("bkt") == []
+
+        # placement never picks the drained pool again
+        for i in range(6):
+            pools.put_object("bkt", f"after-{i}", io.BytesIO(b"n"), 1)
+            assert f"after-{i}" not in src.list_objects("bkt")
+
+    def test_versions_and_markers_survive_with_history(self, tmp_path):
+        pools = _two_pools(tmp_path)
+        pools.make_bucket("vb")
+        # three versions + a delete marker on top, all in whichever pool
+        opts = lambda: PutObjectOptions(versioned=True)  # noqa: E731
+        for i in range(3):
+            pools.put_object("vb", "doc", io.BytesIO(f"v{i}".encode()), 2,
+                             opts())
+        owner = pools._pool_of("vb", "doc")
+        idx = pools.pools.index(owner)
+        marker = owner.delete_object("vb", "doc", versioned=True)
+        before = [(v.version_id, v.delete_marker, round(v.mod_time, 3))
+                  for e in owner.list_entries("vb") for v in e.versions]
+
+        job = PoolDecommission(pools, idx)
+        job.start()
+        job.wait(60)
+        assert job.state["state"] == "complete", job.state
+
+        other = pools.pools[1 - idx]
+        after = [(v.version_id, v.delete_marker, round(v.mod_time, 3))
+                 for e in other.list_entries("vb") for v in e.versions]
+        assert after == before
+        # latest is still the delete marker; older versions fetch by id
+        vids = [v for v, dm, _ in before if not dm]
+        _, stream = pools.get_object("vb", "doc", version_id=vids[-1])
+        assert b"".join(stream) == b"v0"
+
+    def test_state_persists_and_restart_keeps_pool_excluded(self, tmp_path):
+        pools = _two_pools(tmp_path)
+        pools.make_bucket("pb")
+        pools.put_object("pb", "x", io.BytesIO(b"d"), 1)
+        job = PoolDecommission(pools, 0)
+        job.start()
+        job.wait(60)
+        assert load_state(pools.pools[0])["state"] == "complete"
+
+        # a NEW pools object over the same drives re-reads the state
+        pools2 = ErasureServerPools([
+            ErasureSets([LocalStorage(str(tmp_path / f"p0-d{i}"))
+                         for i in range(4)], set_size=4),
+            ErasureSets([LocalStorage(str(tmp_path / f"p1-d{i}"))
+                         for i in range(4)], set_size=4),
+        ])
+        assert 0 in pools2._draining
+        pools2.put_object("pb", "fresh", io.BytesIO(b"n"), 1)
+        assert "fresh" not in pools2.pools[0].list_objects("pb")
+
+    def test_cannot_decommission_only_pool(self, tmp_path):
+        from minio_tpu.storage import errors
+
+        single = ErasureServerPools([
+            ErasureSets([LocalStorage(str(tmp_path / f"d{i}"))
+                         for i in range(4)], set_size=4)])
+        with pytest.raises(errors.InvalidArgument):
+            PoolDecommission(single, 0)
+
+
+class TestDecommissionAdminAPI:
+    def test_admin_flow(self, tmp_path):
+        pools = _two_pools(tmp_path / "drives")
+        srv = S3TestServer(str(tmp_path / "drives"), pools=pools)
+        try:
+            assert srv.request("PUT", "/admbkt").status == 200
+            for i in range(8):
+                srv.request("PUT", f"/admbkt/o{i}", data=b"z" * 5000)
+            r = srv.request("GET", "/minio/admin/v3/pools/status")
+            assert r.status == 200
+            st0 = json.loads(r.body)
+            assert len(st0["pools"]) == 2
+            assert all(not p["draining"] for p in st0["pools"])
+
+            r = srv.request("POST", "/minio/admin/v3/pools/decommission",
+                            query=[("pool", "0")])
+            assert r.status == 200, r.body
+            # wait for the drain to finish
+            import time as time_mod
+
+            deadline = time_mod.time() + 30
+            state = None
+            while time_mod.time() < deadline:
+                r = srv.request("GET", "/minio/admin/v3/pools/status")
+                state = json.loads(r.body)["pools"][0]["decommission"]
+                if state["state"] in ("complete", "failed"):
+                    break
+                time_mod.sleep(0.1)
+            assert state and state["state"] == "complete", state
+            assert json.loads(r.body)["pools"][0]["draining"]
+            # objects all still served
+            for i in range(8):
+                assert srv.request("GET", f"/admbkt/o{i}").body \
+                    == b"z" * 5000
+            # double-start is a clean client error
+            r = srv.request("POST", "/minio/admin/v3/pools/decommission",
+                            query=[("pool", "0")])
+            assert r.status == 400
+            r = srv.request("POST", "/minio/admin/v3/pools/decommission",
+                            query=[("pool", "7")])
+            assert r.status == 400
+        finally:
+            srv.close()
