@@ -12,6 +12,7 @@ admin/metrics queries don't rescan.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -120,12 +121,21 @@ class DataScanner:
 
     def __init__(self, pools, interval: float = 60.0,
                  heal_queue=None, lifecycle_fn=None, autostart: bool = True,
-                 tracker=None):
+                 tracker=None, bitrot_cycle: int = 0):
         self.pools = pools
         self.interval = interval
         self.heal_queue = heal_queue
         self.lifecycle_fn = lifecycle_fn
         self.tracker = tracker  # DataUpdateTracker; None -> always walk
+        # every Nth cycle enqueues bitrot-VERIFYING heals for the objects
+        # it walks (reference `bitrotscan on` scanner mode,
+        # cmd/data-scanner.go healDeepScan / internal/config/heal).
+        # 0 = off (the reference default: deep scans cost full reads).
+        if bitrot_cycle == 0:
+            bitrot_cycle = int(os.environ.get(
+                "MINIO_TPU_SCANNER_BITROT_CYCLE", "0") or 0)
+        self.bitrot_cycle = bitrot_cycle
+        self.deep_heals_queued = 0
         self.buckets_skipped = 0
         self.subtree_rescans = 0  # bounded (non-full) bucket walks
         self.usage = DataUsageInfo()
@@ -291,6 +301,13 @@ class DataScanner:
         if missing and self.heal_queue:
             self.heal_queue(bucket, name, fi.version_id)
             info.heals_triggered += 1
+        elif self.heal_queue and self.bitrot_cycle > 0 \
+                and (self.cycles + 1) % self.bitrot_cycle == 0:
+            # deep cycle: verify every shard's interleaved hashes, not
+            # just presence/size (silent corruption is invisible to the
+            # shallow check) — reference healDeepScan when bitrotscan on
+            self.heal_queue(bucket, name, fi.version_id, deep=True)
+            self.deep_heals_queued += 1
         # lifecycle evaluation
         if self.lifecycle_fn is not None:
             try:
@@ -321,9 +338,13 @@ class DataScanner:
         key = (getattr(es, "pool_index", 0), getattr(es, "set_index", 0))
         prev = self._set_trees.get(key, {})
         out: dict = {}
+        deep = self.bitrot_cycle > 0 \
+            and (self.cycles + 1) % self.bitrot_cycle == 0
         for bucket in _set_buckets(es):
             ptree = prev.get(bucket)
-            tracked = self.tracker is not None \
+            # a deep (bitrot) cycle must walk everything — clean-bucket
+            # reuse and subtree resume would skip the verification
+            tracked = not deep and self.tracker is not None \
                 and self.tracker.history is not None
             if tracked and ptree is not None \
                     and not self.tracker.bucket_dirty(bucket):
