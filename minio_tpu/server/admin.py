@@ -59,6 +59,12 @@ class AdminMixin:
                    wrap(self.admin_pools_decommission, "DecommissionPool"))
         r.add_post(f"{p}/pools/cancel",
                    wrap(self.admin_pools_cancel, "DecommissionPool"))
+        r.add_post(f"{p}/rebalance/start",
+                   wrap(self.admin_rebalance_start, "RebalanceStart"))
+        r.add_post(f"{p}/rebalance/stop",
+                   wrap(self.admin_rebalance_stop, "RebalanceStop"))
+        r.add_get(f"{p}/rebalance/status",
+                  wrap(self.admin_rebalance_status, "RebalanceStatus"))
         # users / policies / groups / service accounts
         r.add_put(f"{p}/add-user", wrap(self.admin_add_user, "CreateUser"))
         r.add_delete(f"{p}/remove-user", wrap(self.admin_remove_user, "DeleteUser"))
@@ -807,6 +813,46 @@ class AdminMixin:
             return dict(job.state)
 
         return self._json(await self._run(run))
+
+    def _rebalance_job(self, create: bool = False):
+        job = getattr(self, "_rebalance_inst", None)
+        if job is None and create:
+            from minio_tpu.services.decom import PoolRebalance
+
+            job = self._rebalance_inst = PoolRebalance(self.api)
+        return job
+
+    async def admin_rebalance_start(self, request: web.Request,
+                                    body: bytes):
+        """`mc admin rebalance start` (reference
+        cmd/admin-handlers-pools.go RebalanceStart)."""
+        if not hasattr(self.api, "pools") or len(self.api.pools) < 2:
+            raise S3Error("AdminInvalidArgument",
+                          "rebalance needs multiple pools")
+
+        def run():
+            job = self._rebalance_job(create=True)
+            if job.state.get("state") == "running":
+                raise S3Error("AdminInvalidArgument",
+                              "rebalance already running")
+            job.start()
+            return job.status()
+
+        return self._json(await self._run(run))
+
+    async def admin_rebalance_stop(self, request: web.Request, body: bytes):
+        job = self._rebalance_job()
+        if job is None:
+            raise S3Error("AdminInvalidArgument", "no rebalance started")
+        await self._run(job.stop)
+        return self._json(job.status())
+
+    async def admin_rebalance_status(self, request: web.Request,
+                                     body: bytes):
+        job = self._rebalance_job()
+        if job is None:
+            return self._json({"state": "none"})
+        return self._json(await self._run(job.status))
 
     async def admin_data_usage(self, request: web.Request, body: bytes):
         """Cluster usage; with ?bucket= (and optional ?prefix=) the

@@ -141,32 +141,8 @@ class PoolDecommission:
                     self.state["failed_objects"] += 1
 
     def _move_version(self, bucket: str, name: str, oi) -> None:
-        from minio_tpu.erasure.objects import PutObjectOptions
-
         target = self._target_pool(name, max(oi.size, 0))
-        if oi.delete_marker:
-            # replay the marker with its id + mod time pinned, then drop
-            # the source's copy
-            target.put_delete_marker(bucket, name, oi.version_id or "",
-                                     oi.mod_time)
-            self.src.delete_object(bucket, name,
-                                   version_id=oi.version_id or "null")
-            return
-        _, stream = self.src.get_object(
-            bucket, name, version_id=oi.version_id)
-        meta = {k: v for k, v in oi.metadata.items()
-                if k not in ("etag", "content-type")}
-        opts = PutObjectOptions(
-            user_metadata=meta,
-            content_type=oi.content_type,
-            versioned=bool(oi.version_id),
-            version_id=oi.version_id,
-            mod_time=oi.mod_time,
-        )
-        reader = _IterReader(stream)
-        target.put_object(bucket, name, reader, oi.size, opts)
-        self.src.delete_object(bucket, name,
-                               version_id=oi.version_id or "null")
+        move_version(self.src, target, bucket, name, oi)
 
     def _target_pool(self, obj: str, size: int):
         avail = self.pools._pool_available(obj, size)
@@ -179,6 +155,172 @@ class PoolDecommission:
         if best is None or best_a <= 0:
             raise errors.DiskFull("no target pool has space")
         return best
+
+
+def move_version(src, target, bucket: str, name: str, oi) -> None:
+    """Move one object version between pools with its version id and
+    mod time pinned — shared by decommission and rebalance."""
+    from minio_tpu.erasure.objects import PutObjectOptions
+
+    if oi.delete_marker:
+        # replay the marker with its id + mod time pinned, then drop
+        # the source's copy
+        target.put_delete_marker(bucket, name, oi.version_id or "",
+                                 oi.mod_time)
+        src.delete_object(bucket, name,
+                          version_id=oi.version_id or "null")
+        return
+    _, stream = src.get_object(bucket, name, version_id=oi.version_id)
+    meta = {k: v for k, v in oi.metadata.items()
+            if k not in ("etag", "content-type")}
+    opts = PutObjectOptions(
+        user_metadata=meta,
+        content_type=oi.content_type,
+        versioned=bool(oi.version_id),
+        version_id=oi.version_id,
+        mod_time=oi.mod_time,
+    )
+    target.put_object(bucket, name, _IterReader(stream), oi.size, opts)
+    src.delete_object(bucket, name, version_id=oi.version_id or "null")
+
+
+class PoolRebalance:
+    """Spread existing objects so pool fill fractions converge — run
+    after expanding a deployment with a new (empty) pool (reference
+    cmd/erasure-server-pool-rebalance.go; `mc admin rebalance start`).
+
+    Pools whose used fraction exceeds the cluster average by more than
+    `tolerance` donate objects to the emptiest pool until they fall
+    within it."""
+
+    def __init__(self, pools, tolerance: float = 0.02):
+        if len(pools.pools) < 2:
+            raise errors.InvalidArgument("rebalance needs multiple pools")
+        self.pools = pools
+        self.tolerance = tolerance
+        self.state = {"state": "none"}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- capacity math ------------------------------------------------------
+    def _capacity(self, fresh: bool = False) -> list[tuple[int, int]]:
+        """[(total, used)] per pool; fresh=True re-measures past any
+        usage caches (the convergence loop must see its own moves)."""
+        out = []
+        for p in self.pools.pools:
+            total = used = 0
+            for d in p.all_disks:
+                try:
+                    if d is None or not d.is_online():
+                        continue
+                    if fresh:
+                        inner = getattr(d, "_inner", d)
+                        inv = getattr(inner, "invalidate_usage_cache", None)
+                        if inv is not None:
+                            inv()
+                    di = d.disk_info()
+                    total += di.total
+                    used += di.used
+                except Exception:
+                    continue
+            out.append((total, used))
+        return out
+
+    def _fractions(self) -> list[float]:
+        return [u / t if t else 0.0 for t, u in self._capacity()]
+
+    def status(self) -> dict:
+        return {**self.state, "fill": [round(f, 4)
+                                       for f in self._fractions()]}
+
+    # -- control ------------------------------------------------------------
+    def start(self) -> None:
+        if self.state.get("state") == "running":
+            raise errors.InvalidArgument("rebalance already running")
+        self.state = {"state": "running", "started": time.time(),
+                      "moved_objects": 0, "moved_bytes": 0,
+                      "failed_objects": 0}
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="pool-rebalance")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        if self.state.get("state") == "running":
+            self.state["state"] = "stopped"
+
+    def wait(self, timeout: float = 600.0) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # -- loop ---------------------------------------------------------------
+    def _run(self) -> None:
+        try:
+            for _ in range(4):  # bounded convergence rounds
+                if self._stop.is_set():
+                    break
+                caps = self._capacity(fresh=True)
+                fracs = [u / t if t else 0.0 for t, u in caps]
+                avg = sum(fracs) / len(fracs)
+                donors = [i for i, f in enumerate(fracs)
+                          if f > avg + self.tolerance
+                          and i not in self.pools._draining]
+                if not donors:
+                    break
+                moved_any = False
+                for i in donors:
+                    # byte budget computed up front: the du cache lags
+                    # moves, so steering by live fractions over-drains
+                    over = int((fracs[i] - avg) * caps[i][0])
+                    if self._donate(i, over, fracs):
+                        moved_any = True
+                if not moved_any:
+                    break
+            self.state["state"] = "complete"
+            self.state["finished"] = time.time()
+        except Exception as e:
+            self.state["state"] = "failed"
+            self.state["error"] = str(e)
+
+    def _donate(self, idx: int, budget: int, fracs: list[float]) -> bool:
+        """Move ~`budget` logical bytes out of pool `idx` into the
+        emptiest other pools; True if anything moved."""
+        src = self.pools.pools[idx]
+        caps = self._capacity()
+        est = list(fracs)  # locally-updated estimates
+        donated = 0
+        moved = 0
+        # erasure overhead: logical bytes land ~N/K larger on disk
+        overhead = 2.0
+        for vol in src.list_buckets():
+            bucket = vol.name
+            for entry in src.list_entries(bucket):
+                if self._stop.is_set() or donated >= budget:
+                    return moved > 0
+                tgt_i = min(
+                    (i for i in range(len(est)) if i != idx
+                     and i not in self.pools._draining),
+                    key=lambda i: est[i], default=None)
+                if tgt_i is None:
+                    return moved > 0
+                target = self.pools.pools[tgt_i]
+                try:
+                    obj_bytes = 0
+                    for oi in reversed(entry.versions):
+                        move_version(src, target, bucket, entry.name, oi)
+                        self.state["moved_objects"] += 1
+                        self.state["moved_bytes"] += max(oi.size, 0)
+                        obj_bytes += max(oi.size, 0)
+                    moved += 1
+                    donated += int(obj_bytes * overhead)
+                    if caps[tgt_i][0]:
+                        est[tgt_i] += obj_bytes * overhead / caps[tgt_i][0]
+                except Exception:
+                    self.state["failed_objects"] += 1
+        return moved > 0
 
 
 class _IterReader(io.RawIOBase):

@@ -452,6 +452,11 @@ class LocalStorage(StorageAPI):
         self._du_cache = (now, used)
         return used
 
+    def invalidate_usage_cache(self) -> None:
+        """Force the next disk_info() to re-measure (rebalance rounds
+        steer by used bytes and must not see the 0.5 s-stale value)."""
+        self._du_cache = (0.0, 0)
+
     def disk_info(self) -> DiskInfo:
         st = shutil.disk_usage(self.root)
         total, free, used = st.total, st.free, st.used

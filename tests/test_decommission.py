@@ -111,6 +111,73 @@ class TestDecommission:
             PoolDecommission(single, 0)
 
 
+class TestRebalance:
+    def test_rebalance_spreads_after_pool_expansion(self, tmp_path):
+        """Classic expansion: pool 0 full of data, pool 1 freshly added
+        and empty — rebalance converges fill fractions and keeps every
+        object readable (cmd/erasure-server-pool-rebalance.go)."""
+        from minio_tpu.services.decom import PoolRebalance
+
+        quota = 8 << 20
+        p0 = ErasureSets([LocalStorage(str(tmp_path / f"p0-d{i}"),
+                                       quota=quota) for i in range(4)],
+                         set_size=4)
+        pools_single = ErasureServerPools([p0])
+        pools_single.make_bucket("rb")
+        payload = {f"o{i:02d}": bytes([i]) * 100_000 for i in range(20)}
+        for name, data in payload.items():
+            pools_single.put_object("rb", name, io.BytesIO(data),
+                                    len(data))
+        # "expand" with a second, empty pool over the same bucket set
+        p1 = ErasureSets([LocalStorage(str(tmp_path / f"p1-d{i}"),
+                                       quota=quota) for i in range(4)],
+                         set_size=4)
+        pools = ErasureServerPools([p0, p1])
+        pools.make_bucket_meta_sync = None  # no-op guard
+        p1.make_bucket("rb")
+
+        job = PoolRebalance(pools, tolerance=0.02)
+        fr_before = job._fractions()
+        assert fr_before[0] > fr_before[1] + 0.1
+        job.start()
+        job.wait(120)
+        assert job.state["state"] == "complete", job.state
+        assert job.state["moved_objects"] > 0
+        fr_after = job._fractions()
+        assert abs(fr_after[0] - fr_after[1]) < 0.15, fr_after
+        for name, data in payload.items():
+            _, stream = pools.get_object("rb", name)
+            assert b"".join(stream) == data, name
+        # both pools now hold a share
+        assert p0.list_objects("rb") and p1.list_objects("rb")
+
+    def test_rebalance_admin_api(self, tmp_path):
+        pools = _two_pools(tmp_path / "drives", quota=16 << 20)
+        srv = S3TestServer(str(tmp_path / "drives"), pools=pools)
+        try:
+            r = srv.request("GET", "/minio/admin/v3/rebalance/status")
+            assert json.loads(r.body)["state"] == "none"
+            srv.request("PUT", "/rbb")
+            for i in range(6):
+                srv.request("PUT", f"/rbb/o{i}", data=b"q" * 50_000)
+            r = srv.request("POST", "/minio/admin/v3/rebalance/start")
+            assert r.status == 200, r.body
+            import time as time_mod
+
+            deadline = time_mod.time() + 30
+            while time_mod.time() < deadline:
+                r = srv.request("GET", "/minio/admin/v3/rebalance/status")
+                if json.loads(r.body)["state"] in ("complete", "failed"):
+                    break
+                time_mod.sleep(0.1)
+            assert json.loads(r.body)["state"] == "complete", r.body
+            for i in range(6):
+                assert srv.request("GET", f"/rbb/o{i}").body \
+                    == b"q" * 50_000
+        finally:
+            srv.close()
+
+
 class TestDecommissionAdminAPI:
     def test_admin_flow(self, tmp_path):
         pools = _two_pools(tmp_path / "drives")
