@@ -69,7 +69,13 @@ class _ChunkedSigReader(io.RawIOBase):
     framing: `hex-size;chunk-signature=...\r\n<bytes>\r\n` (reference
     cmd/streaming-signature-v4.go).  Each chunk's signature is chained from
     the previous one starting at the request's seed signature; a mismatch
-    aborts the upload."""
+    aborts the upload.
+
+    ctx=None decodes WITHOUT per-chunk signature checks — the
+    STREAMING-UNSIGNED-PAYLOAD-TRAILER mode modern SDKs default to
+    (request auth still rides the signed headers).  Trailer lines after
+    the final zero chunk (`x-amz-checksum-*` et al) land in
+    `self.trailers`."""
 
     def __init__(self, raw: io.RawIOBase, ctx: sigv4.V4Context | None):
         self.raw = raw
@@ -78,6 +84,7 @@ class _ChunkedSigReader(io.RawIOBase):
         self.buf = b""
         self.out = b""  # decoded-but-undelivered bytes (read(n) contract)
         self.eof = False
+        self.trailers: dict[str, str] = {}
 
     def _read_line(self) -> bytes:
         while b"\r\n" not in self.buf:
@@ -119,9 +126,32 @@ class _ChunkedSigReader(io.RawIOBase):
             self.prev_sig = want
         if size == 0:
             self.eof = True
+            self._read_trailers()
         else:
             self.out += data
             self._read_n(2)  # trailing \r\n
+
+    # trailer section is small by construction; anything bigger is abuse
+    _MAX_TRAILER = 16 << 10
+
+    def _read_trailers(self) -> None:
+        """Consume `name:value` lines after the zero chunk (aws-chunked
+        trailers).  The x-amz-trailer-signature line is consumed but not
+        independently verified — the trailer values it covers are
+        themselves checked against the decoded payload."""
+        while len(self.buf) < self._MAX_TRAILER:
+            chunk = self.raw.read(65536)
+            if not chunk:
+                break
+            self.buf += chunk
+        for line in self.buf.split(b"\r\n"):
+            line = line.strip()
+            if not line or b":" not in line:
+                continue
+            name, _, value = line.partition(b":")
+            self.trailers[name.decode(errors="replace").strip().lower()] = \
+                value.decode(errors="replace").strip()
+        self.buf = b""
 
     def read(self, n: int = -1) -> bytes:
         while not self.eof and (n < 0 or len(self.out) < n):
@@ -1304,9 +1334,15 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 raise S3Error("InvalidDigest")
 
         pipe = _QueuePipeReader()
-        reader: io.RawIOBase = (
-            _ChunkedSigReader(pipe, ctx) if streaming else pipe
+        # unsigned-trailer streaming (modern SDK default) decodes the
+        # aws-chunked framing without per-chunk signatures; request auth
+        # already rode the signed headers
+        unsigned_stream = streaming and "UNSIGNED" in sha_claim
+        chunk_reader = (
+            _ChunkedSigReader(pipe, None if unsigned_stream else ctx)
+            if streaming else None
         )
+        reader: io.RawIOBase = chunk_reader if streaming else pipe
         body_md5 = None
         if md5_want is not None:
             # hash the DECODED payload (works for aws-chunked too, where
@@ -1328,6 +1364,20 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             reader = _TeeHashReader(reader, cksum_hasher)
             opts.user_metadata[cksum_mod.META_CHECKSUM] = \
                 cksum_mod.store(*cksum)
+        # trailing checksum (x-amz-trailer: x-amz-checksum-<algo>): the
+        # value arrives AFTER the body, so the computed digest is stored
+        # via finalize_metadata and compared against the trailer below
+        trailer_algo = None
+        trailer_hasher = None
+        trailer_decl = request.headers.get("x-amz-trailer", "") \
+            .strip().lower()
+        if chunk_reader is not None and cksum is None \
+                and trailer_decl.startswith("x-amz-checksum-"):
+            algo = trailer_decl[len("x-amz-checksum-"):]
+            if algo in cksum_mod.ALGORITHMS:
+                trailer_algo = algo
+                trailer_hasher = cksum_mod.new_hasher(algo)
+                reader = _TeeHashReader(reader, trailer_hasher)
         # server-side encryption wraps the decoded plaintext stream
         # (reference EncryptRequest, cmd/encryption-v1.go:324)
         sse_kind, customer_key = self.sse_kind_for_put(request, bucket)
@@ -1358,6 +1408,19 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 "etag": creader.etag,  # ETag of the ORIGINAL bytes
             }
             real_size = -1  # compressed length unknown until EOF
+        if trailer_algo is not None:
+            # computed digest committed with the metadata (finalize runs
+            # after EOF); the client's trailer value is compared below
+            prev_fin = opts.finalize_metadata
+
+            def _with_trailer_checksum(prev=prev_fin, algo=trailer_algo,
+                                       hasher=trailer_hasher):
+                extra = dict(prev() or {}) if prev is not None else {}
+                extra[cksum_mod.META_CHECKSUM] = cksum_mod.store(
+                    algo, cksum_mod.encode(hasher.digest()))
+                return extra
+
+            opts.finalize_metadata = _with_trailer_checksum
         put_task = asyncio.ensure_future(self._run(
             self.api.put_object, bucket, key, reader, real_size, opts
         ))
@@ -1403,9 +1466,23 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             await _digest_rollback(
                 f"x-amz-checksum-{cksum[0]} does not match body",
                 code="XAmzContentChecksumMismatch")
+        trailer_value = None
+        if trailer_algo is not None:
+            # the put consumed exactly the decoded payload; the zero
+            # chunk + trailer lines are still in the pipe — drain them
+            if not chunk_reader.eof:
+                await self._run(chunk_reader.read)
+            trailer_value = cksum_mod.encode(trailer_hasher.digest())
+            claimed = chunk_reader.trailers.get(trailer_decl, "")
+            if claimed and claimed != trailer_value:
+                await _digest_rollback(
+                    f"{trailer_decl} trailer does not match body",
+                    code="XAmzContentChecksumMismatch")
         headers = {"ETag": f'"{oi.etag}"'}
         if cksum is not None:
             headers[cksum_mod.header_name(cksum[0])] = cksum[1]
+        elif trailer_value is not None:
+            headers[cksum_mod.header_name(trailer_algo)] = trailer_value
         if oi.version_id:
             headers["x-amz-version-id"] = oi.version_id
         elif vstatus == "Suspended":
@@ -2249,7 +2326,9 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         await self._run(self._quota_check, bucket, real_size)
         pipe = _QueuePipeReader()
         reader: io.RawIOBase = (
-            _ChunkedSigReader(pipe, ctx) if streaming else pipe
+            _ChunkedSigReader(
+                pipe, None if "UNSIGNED" in sha_claim else ctx)
+            if streaming else pipe
         )
         task = asyncio.ensure_future(self._run(
             self.api.put_object_part, bucket, key, uid, part_num, reader,

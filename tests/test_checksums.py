@@ -146,6 +146,77 @@ class TestChecksumAPI:
                         headers={"x-amz-object-attributes": "ObjectParts"})
         assert b"<TotalPartsCount>2</TotalPartsCount>" in r.body
 
+    def _chunked_put(self, srv, path, data, trailer=None, chunk=64 << 10,
+                     extra_headers=None):
+        """Raw STREAMING-UNSIGNED-PAYLOAD-TRAILER upload (the aws-chunked
+        framing modern SDKs send by default)."""
+        import http.client
+
+        from minio_tpu.server import sigv4
+
+        body = b""
+        for i in range(0, len(data), chunk):
+            piece = data[i:i + chunk]
+            body += b"%x\r\n%s\r\n" % (len(piece), piece)
+        body += b"0\r\n"
+        if trailer:
+            name, value = trailer
+            body += name.encode() + b":" + value.encode() + b"\r\n"
+        body += b"\r\n"
+        headers = {
+            "host": f"127.0.0.1:{srv.port}",
+            "content-encoding": "aws-chunked",
+            "x-amz-decoded-content-length": str(len(data)),
+        }
+        if trailer:
+            headers["x-amz-trailer"] = trailer[0]
+        headers.update(extra_headers or {})
+        signed = sigv4.sign_request(
+            "PUT", path, [], headers, None, srv.ak, srv.sk,
+            payload_hash="STREAMING-UNSIGNED-PAYLOAD-TRAILER")
+        signed["content-length"] = str(len(body))
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=20)
+        try:
+            conn.request("PUT", path, body=body, headers=signed)
+            r = conn.getresponse()
+            return r.status, dict(r.getheaders()), r.read()
+        finally:
+            conn.close()
+
+    def test_unsigned_trailer_streaming_put(self, srv):
+        """STREAMING-UNSIGNED-PAYLOAD-TRAILER with a CRC32C trailer —
+        the boto3>=1.36 default upload shape."""
+        data = b"sdk-default-upload " * 20000
+        want = _expected("crc32c", data)
+        status, headers, body = self._chunked_put(
+            srv, "/ckb/trailer-obj", data,
+            trailer=("x-amz-checksum-crc32c", want))
+        assert status == 200, body
+        assert headers.get("x-amz-checksum-crc32c") == want
+        r = srv.request("GET", "/ckb/trailer-obj")
+        assert r.body == data
+        # checksum persisted: retrievable with checksum-mode
+        r = srv.request("HEAD", "/ckb/trailer-obj",
+                        headers={"x-amz-checksum-mode": "ENABLED"})
+        assert r.headers.get("x-amz-checksum-crc32c") == want
+
+    def test_unsigned_trailer_without_checksum(self, srv):
+        data = b"no trailer here" * 5000
+        status, _, body = self._chunked_put(srv, "/ckb/plain-stream", data)
+        assert status == 200, body
+        assert srv.request("GET", "/ckb/plain-stream").body == data
+
+    def test_bad_trailer_checksum_rejected(self, srv):
+        data = b"tampered" * 1000
+        wrong = _expected("crc32", b"something else")
+        status, _, body = self._chunked_put(
+            srv, "/ckb/bad-trailer", data,
+            trailer=("x-amz-checksum-crc32", wrong))
+        assert status == 400
+        assert b"XAmzContentChecksumMismatch" in body
+        assert srv.request("GET", "/ckb/bad-trailer").status == 404
+
     def test_checksum_survives_copy(self, srv):
         data = b"copied with checksum"
         want = _expected("sha1", data)
