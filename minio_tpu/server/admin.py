@@ -65,6 +65,14 @@ class AdminMixin:
                    wrap(self.admin_rebalance_stop, "RebalanceStop"))
         r.add_get(f"{p}/rebalance/status",
                   wrap(self.admin_rebalance_status, "RebalanceStatus"))
+        # KMS plane (reference cmd/kms-handlers.go: KMSStatus,
+        # KMSKeyStatus, KMSCreateKey)
+        r.add_get(f"{p}/kms/status", wrap(self.admin_kms_status,
+                                          "KMSStatus"))
+        r.add_get(f"{p}/kms/key/status",
+                  wrap(self.admin_kms_key_status, "KMSKeyStatus"))
+        r.add_post(f"{p}/kms/key/create",
+                   wrap(self.admin_kms_create_key, "KMSCreateKey"))
         # users / policies / groups / service accounts
         r.add_put(f"{p}/add-user", wrap(self.admin_add_user, "CreateUser"))
         r.add_delete(f"{p}/remove-user", wrap(self.admin_remove_user, "DeleteUser"))
@@ -813,6 +821,62 @@ class AdminMixin:
             return dict(job.state)
 
         return self._json(await self._run(run))
+
+    # ------------------------------------------------------------------ KMS
+    def _kms_or_503(self):
+        kms = getattr(self, "kms", None)
+        if kms is None:
+            raise S3Error("KMSNotConfigured", "no KMS is configured")
+        return kms
+
+    async def admin_kms_status(self, request: web.Request, body: bytes):
+        """reference cmd/kms-handlers.go KMSStatusHandler."""
+        kms = self._kms_or_503()
+        return self._json({
+            "name": type(kms).__name__,
+            "defaultKeyID": getattr(kms, "key_id", ""),
+            "endpoints": {getattr(kms, "endpoint", "local"): "online"},
+        })
+
+    async def admin_kms_key_status(self, request: web.Request, body: bytes):
+        """Round-trip health check of one key: generate a data key under
+        it and unseal the envelope (reference KMSKeyStatusHandler's
+        encrypt/decrypt cycle)."""
+        kms = self._kms_or_503()
+        key_id = request.rel_url.query.get(
+            "key-id", getattr(kms, "key_id", ""))
+        out = {"keyId": key_id}
+
+        def probe():
+            pk, sealed = kms.generate_key("admin-kms-probe")
+            got = kms.decrypt_key(sealed, "admin-kms-probe")
+            return pk == got
+
+        try:
+            ok = await self._run(probe)
+            out["encryptionErr" if not ok else "status"] = (
+                "decrypted key differs" if not ok else "online")
+        except Exception as e:
+            out["encryptionErr"] = str(e)
+        return self._json(out)
+
+    async def admin_kms_create_key(self, request: web.Request, body: bytes):
+        kms = self._kms_or_503()
+        key_id = request.rel_url.query.get("key-id", "")
+        if not key_id:
+            raise S3Error("AdminInvalidArgument", "key-id is required")
+        create = getattr(kms, "create_key", None)
+        if create is None:
+            raise S3Error("NotImplemented",
+                          "the static local KMS cannot create keys "
+                          "(configure a KES server)")
+        from minio_tpu.crypto.kms import KMSError
+
+        try:
+            await self._run(create, key_id)
+        except KMSError as e:
+            raise S3Error("AdminInvalidArgument", str(e))
+        return self._json({"keyId": key_id, "created": True})
 
     def _rebalance_job(self, create: bool = False):
         job = getattr(self, "_rebalance_inst", None)

@@ -305,3 +305,30 @@ class TestBulkDeleteBatch:
         assert r.status == 200
         # S3: deleting a missing key still reports Deleted (idempotent)
         assert r.text().count("<Deleted>") == 2
+
+
+class TestKMSAdmin:
+    """KMS admin plane (reference cmd/kms-handlers.go)."""
+
+    def test_status_and_key_roundtrip(self, tmp_path):
+        from tests.s3_harness import S3TestServer
+
+        srv = S3TestServer(str(tmp_path / "drives"))
+        try:
+            r = srv.request("GET", "/minio/admin/v3/kms/status")
+            assert r.status == 200
+            import json as jmod
+
+            doc = jmod.loads(r.body)
+            assert doc["defaultKeyID"]
+            r = srv.request("GET", "/minio/admin/v3/kms/key/status")
+            assert r.status == 200
+            assert jmod.loads(r.body).get("status") == "online"
+            # static local KMS cannot mint keys: explicit NotImplemented
+            r = srv.request("POST", "/minio/admin/v3/kms/key/create",
+                            query=[("key-id", "new-key")])
+            assert r.status == 501
+            r = srv.request("POST", "/minio/admin/v3/kms/key/create")
+            assert r.status == 400
+        finally:
+            srv.close()
