@@ -338,6 +338,15 @@ def register_peer_rpc(router, s3_server, node=None) -> None:
             return {"replication": {}}
         return {"replication": repl.stats.to_dict()}
 
+    def bandwidth(args, body):
+        """cmd/peer-rest-client.go:980 MonitorBandwidth: this node's
+        per-target replication rates."""
+        svcs = getattr(s3_server, "services", None)
+        repl = getattr(svcs, "replication", None) if svcs else None
+        if repl is None:
+            return {"report": {}}
+        return {"report": repl.bw_monitor.report(args.get("bucket", ""))}
+
     # ----------------------------------------------------------- metacache
     def _metacache():
         from minio_tpu.erasure import metacache as mc_mod
@@ -473,6 +482,7 @@ def register_peer_rpc(router, s3_server, node=None) -> None:
         "peer.get_locks": get_locks,
         "peer.background_heal_status": background_heal_status,
         "peer.bucket_stats": bucket_stats,
+        "peer.bandwidth": bandwidth,
         "peer.metacache_invalidate": metacache_invalidate,
         "peer.metacache_get": metacache_get,
         "peer.metacache_update": metacache_update,

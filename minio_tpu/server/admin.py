@@ -65,6 +65,10 @@ class AdminMixin:
                    wrap(self.admin_rebalance_stop, "RebalanceStop"))
         r.add_get(f"{p}/rebalance/status",
                   wrap(self.admin_rebalance_status, "RebalanceStatus"))
+        # replication bandwidth report (reference
+        # cmd/admin-handlers.go BandwidthMonitorHandler)
+        r.add_get(f"{p}/bandwidth",
+                  wrap(self.admin_bandwidth, "BandwidthMonitor"))
         # KMS plane (reference cmd/kms-handlers.go: KMSStatus,
         # KMSKeyStatus, KMSCreateKey)
         r.add_get(f"{p}/kms/status", wrap(self.admin_kms_status,
@@ -822,6 +826,30 @@ class AdminMixin:
 
         return self._json(await self._run(run))
 
+    async def admin_bandwidth(self, request: web.Request, body: bytes):
+        """Cluster-wide replication bandwidth: this node's monitor plus
+        every peer's over the RPC plane (reference
+        BandwidthMonitorHandler + peer MonitorBandwidth)."""
+        bucket = request.rel_url.query.get("bucket", "")
+        svcs = getattr(self, "services", None)
+        repl = getattr(svcs, "replication", None) if svcs else None
+        me = getattr(self, "node_addr", "") or "local"
+        out = {me: repl.bw_monitor.report(bucket) if repl else {}}
+        clients = getattr(self, "peer_clients", {})
+
+        def probe(addr, client):
+            try:
+                return addr, client.call("peer.bandwidth",
+                                         {"bucket": bucket})["report"]
+            except Exception as e:
+                return addr, {"error": str(e)}
+
+        for addr, report in await asyncio.gather(*[
+            self._run(probe, a, c) for a, c in sorted(clients.items())
+        ]):
+            out[addr] = report
+        return self._json(out)
+
     # ------------------------------------------------------------------ KMS
     def _kms_or_503(self):
         kms = getattr(self, "kms", None)
@@ -1124,6 +1152,7 @@ class AdminMixin:
             access_key=doc.get("accessKey", creds.get("accessKey", "")),
             secret_key=doc.get("secretKey", creds.get("secretKey", "")),
             region=doc.get("region", "us-east-1"),
+            bandwidth_limit=int(doc.get("bandwidth", 0) or 0),
         )
         if not tgt.endpoint or not tgt.bucket:
             raise S3Error("InvalidArgument", "endpoint and targetbucket required")

@@ -62,18 +62,23 @@ class ReplicationTarget:
     access_key: str
     secret_key: str
     region: str = "us-east-1"
+    # bytes/sec cap for replication TO this target; 0 = unlimited
+    # (reference madmin.BucketTarget.BandwidthLimit)
+    bandwidth_limit: int = 0
 
     def to_dict(self) -> dict:
         return {"arn": self.arn, "endpoint": self.endpoint,
                 "bucket": self.bucket, "accessKey": self.access_key,
-                "secretKey": self.secret_key, "region": self.region}
+                "secretKey": self.secret_key, "region": self.region,
+                "bandwidthLimit": self.bandwidth_limit}
 
     @classmethod
     def from_dict(cls, d: dict) -> "ReplicationTarget":
         return cls(arn=d["arn"], endpoint=d["endpoint"], bucket=d["bucket"],
                    access_key=d.get("accessKey", ""),
                    secret_key=d.get("secretKey", ""),
-                   region=d.get("region", "us-east-1"))
+                   region=d.get("region", "us-east-1"),
+                   bandwidth_limit=int(d.get("bandwidthLimit", 0) or 0))
 
     def client(self) -> S3Client:
         return S3Client(self.endpoint, self.access_key, self.secret_key,
@@ -149,9 +154,16 @@ class ReplicationPool:
     (reference replicationPool, cmd/bucket-replication.go bottom)."""
 
     def __init__(self, api, meta, workers: int = 2):
+        from minio_tpu.utils.bandwidth import (BandwidthMonitor,
+                                               LimiterRegistry)
+
         self.api = api
         self.meta = meta
         self.stats = ReplicationStats()
+        # per-target throttles + moving-average monitor (reference
+        # internal/bucket/bandwidth)
+        self.limiters = LimiterRegistry()
+        self.bw_monitor = BandwidthMonitor()
         self._q: queue.Queue = queue.Queue()
         self._stop = threading.Event()
         self._threads = [
@@ -293,7 +305,15 @@ class ReplicationPool:
                 compress_mod.META_COMPRESSION) == compress_mod.SCHEME:
             size = int(oi.metadata.get(compress_mod.META_ACTUAL_SIZE, 0))
             body = compress_mod.decompress_stream(body)
-        # stream the shards straight to the remote: no full-object buffer
+        # stream the shards straight to the remote: no full-object
+        # buffer; a configured target bandwidth limit throttles here and
+        # the monitor records the target's live rate
+        from minio_tpu.utils.bandwidth import ThrottledChunks
+
+        body = ThrottledChunks(
+            body, self.limiters.get(tgt.arn, tgt.bandwidth_limit),
+            on_bytes=lambda n: self.bw_monitor.record(
+                op.bucket, tgt.arn, n))
         try:
             client.put_object(tgt.bucket, op.name, body,
                               headers=headers, length=size)

@@ -1,0 +1,125 @@
+"""Per-target bandwidth limiting + monitoring for replication.
+
+Reference: internal/bucket/bandwidth (monitor.go MonitorBandwidth,
+reader.go MonitoredReader) — each remote target may carry a bandwidth
+limit (madmin.BucketTarget.BandwidthLimit); replication uploads ride a
+token-bucket-throttled reader, and a monitor tracks a moving average of
+bytes/sec per (bucket, target) for `mc admin bandwidth` style reporting.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class TokenBucket:
+    """Debt-based token bucket: `rate` bytes/sec with one second of
+    burst.  acquire(n) may drive the balance negative (a single chunk
+    can exceed the burst) and sleeps until the debt is repaid, so any
+    chunk size paces correctly without deadlock."""
+
+    def __init__(self, rate: float):
+        self.rate = float(rate)
+        self._tokens = float(rate)
+        self._last = time.monotonic()
+        self._mu = threading.Lock()
+
+    def acquire(self, n: int) -> None:
+        with self._mu:
+            now = time.monotonic()
+            self._tokens = min(
+                self.rate, self._tokens + (now - self._last) * self.rate)
+            self._last = now
+            self._tokens -= n
+            wait = (-self._tokens / self.rate) if self._tokens < 0 else 0.0
+        if wait > 0:
+            time.sleep(wait)
+
+
+class ThrottledChunks:
+    """Iterator wrapper metering chunks through a TokenBucket and
+    reporting them to a monitor hook."""
+
+    def __init__(self, chunks, bucket_limiter: TokenBucket | None,
+                 on_bytes=None):
+        self._it = iter(chunks)
+        self._limiter = bucket_limiter
+        self._on_bytes = on_bytes
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        chunk = next(self._it)
+        if chunk:
+            if self._limiter is not None:
+                self._limiter.acquire(len(chunk))
+            if self._on_bytes is not None:
+                self._on_bytes(len(chunk))
+        return chunk
+
+    def close(self) -> None:
+        close = getattr(self._it, "close", None)
+        if close is not None:
+            close()
+
+
+class BandwidthMonitor:
+    """Moving-average bytes/sec per (bucket, target arn) over a sliding
+    window (reference monitor.go's exponential moving average)."""
+
+    WINDOW = 10.0
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        # key -> [window_start, window_bytes, last_rate]
+        self._state: dict[tuple[str, str], list] = {}
+
+    def record(self, bucket: str, arn: str, n: int) -> None:
+        now = time.monotonic()
+        with self._mu:
+            st = self._state.get((bucket, arn))
+            if st is None:
+                self._state[(bucket, arn)] = [now, n, 0.0]
+                return
+            if now - st[0] >= self.WINDOW:
+                st[2] = st[1] / (now - st[0])
+                st[0], st[1] = now, n
+            else:
+                st[1] += n
+
+    def report(self, bucket: str = "") -> dict:
+        """{bucket: {arn: {currentRate, windowBytes}}}."""
+        now = time.monotonic()
+        out: dict = {}
+        with self._mu:
+            for (b, arn), st in self._state.items():
+                if bucket and b != bucket:
+                    continue
+                elapsed = max(now - st[0], 1e-6)
+                live = st[1] / elapsed if elapsed >= 1.0 else st[2]
+                out.setdefault(b, {})[arn] = {
+                    "currentRate": round(live or st[2], 1),
+                    "windowBytes": st[1],
+                }
+        return out
+
+
+class LimiterRegistry:
+    """One TokenBucket per target arn, created from the target's
+    configured limit; limit changes rebuild the bucket."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._limiters: dict[str, tuple[int, TokenBucket]] = {}
+
+    def get(self, arn: str, limit: int) -> TokenBucket | None:
+        if limit <= 0:
+            return None
+        with self._mu:
+            cur = self._limiters.get(arn)
+            if cur is None or cur[0] != limit:
+                cur = (limit, TokenBucket(limit))
+                self._limiters[arn] = cur
+            return cur[1]

@@ -259,3 +259,85 @@ class TestProxyAndTargetStats:
         r = src.request("GET", "/srcbkt/cond",
                         headers={"If-Match": '"deadbeef"'})
         assert r.status == 412
+
+
+class TestBandwidth:
+    """Replication bandwidth limiting + monitoring (reference
+    internal/bucket/bandwidth; madmin BucketTarget.BandwidthLimit)."""
+
+    def test_token_bucket_paces(self):
+        import time as time_mod
+
+        from minio_tpu.utils.bandwidth import ThrottledChunks, TokenBucket
+
+        chunks = [b"x" * 50_000] * 8  # 400 KB at 200 KB/s ~= 1.5+ s
+        tb = TokenBucket(200_000)
+        t0 = time_mod.time()
+        total = sum(len(c) for c in ThrottledChunks(chunks, tb))
+        dt = time_mod.time() - t0
+        assert total == 400_000
+        assert dt >= 0.8, f"throttle too loose: {dt:.2f}s"
+
+    def test_monitor_reports_rates(self):
+        from minio_tpu.utils.bandwidth import BandwidthMonitor
+
+        m = BandwidthMonitor()
+        for _ in range(10):
+            m.record("bkt", "arn:x", 1000)
+        rep = m.report()
+        assert rep["bkt"]["arn:x"]["windowBytes"] == 10_000
+        assert m.report("other") == {}
+
+    def test_throttled_replication_end_to_end(self, tmp_path):
+        """A target with a byte/sec cap still replicates correctly and
+        the admin bandwidth endpoint reports its traffic."""
+        src = S3TestServer(str(tmp_path / "src"), start_services=True,
+                           scan_interval=3600.0)
+        dst = S3TestServer(str(tmp_path / "dst"), start_services=True,
+                           scan_interval=3600.0)
+        try:
+            src.request("PUT", "/bwb")
+            dst.request("PUT", "/bwdst")
+            ver = (b'<VersioningConfiguration><Status>Enabled</Status>'
+                   b'</VersioningConfiguration>')
+            src.request("PUT", "/bwb", query=[("versioning", "")], data=ver)
+            dst.request("PUT", "/bwdst", query=[("versioning", "")],
+                        data=ver)
+            r = src.request("PUT", f"{ADMIN}/set-remote-target",
+                            query=[("bucket", "bwb")],
+                            data=json.dumps({
+                                "endpoint": dst.host,
+                                "targetbucket": "bwdst",
+                                "accessKey": dst.ak, "secretKey": dst.sk,
+                                "bandwidth": 150_000,
+                            }).encode())
+            assert r.status == 200, r.text()
+            arn = json.loads(r.text())["arn"]
+            r = src.request("PUT", "/bwb", query=[("replication", "")],
+                            data=REPL_CFG.format(arn=arn).encode())
+            assert r.status == 200, r.text()
+
+            import os as os_mod
+
+            data = os_mod.urandom(300_000)  # ~2 s at 150 KB/s
+            t0 = time.time()
+            assert src.request("PUT", "/bwb/throttled",
+                               data=data).status == 200
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                if dst.request("GET", "/bwdst/throttled").status == 200:
+                    break
+                time.sleep(0.1)
+            took = time.time() - t0
+            assert dst.request("GET", "/bwdst/throttled").body == data
+            assert took >= 1.0, f"no throttling observed ({took:.2f}s)"
+            r = src.request("GET", f"{ADMIN}/bandwidth",
+                            query=[("bucket", "bwb")])
+            assert r.status == 200
+            report = json.loads(r.body)
+            local = report.get("local") or next(iter(report.values()))
+            assert "bwb" in local and arn in local["bwb"]
+            assert local["bwb"][arn]["windowBytes"] > 0
+        finally:
+            src.close()
+            dst.close()
