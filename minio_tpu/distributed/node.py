@@ -219,6 +219,12 @@ class ClusterNode:
             mc = mc_mod.attach(self.pools)
             if mc is not None:
                 mc.broadcast = self.peers.metacache_invalidate
+            # target bandwidth limits are cluster-wide: each node paces
+            # at limit/node_count (internal/bucket/bandwidth semantics)
+            repl_pool = getattr(self.s3.services, "replication", None) \
+                if self.s3.services else None
+            if repl_pool is not None:
+                repl_pool.node_count = len(self.peer_clients) + 1
         else:
             self.peers = None
         self.s3.node_addr = my_address

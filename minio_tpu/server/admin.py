@@ -1143,6 +1143,14 @@ class AdminMixin:
         except ValueError:
             raise S3Error("InvalidArgument", "body must be JSON")
         creds = doc.get("credentials") or {}
+        # accept both the write key and the read key so a
+        # list -> edit -> set round trip preserves the limit
+        raw_bw = doc.get("bandwidth", doc.get("bandwidthLimit", 0)) or 0
+        try:
+            bw = int(raw_bw)
+        except (TypeError, ValueError):
+            raise S3Error("InvalidArgument",
+                          "bandwidth must be an integer (bytes/sec)")
         tgt = ReplicationTarget(
             arn=doc.get("arn") or
             f"arn:minio:replication::{uuid.uuid4().hex[:12]}:"
@@ -1152,7 +1160,7 @@ class AdminMixin:
             access_key=doc.get("accessKey", creds.get("accessKey", "")),
             secret_key=doc.get("secretKey", creds.get("secretKey", "")),
             region=doc.get("region", "us-east-1"),
-            bandwidth_limit=int(doc.get("bandwidth", 0) or 0),
+            bandwidth_limit=bw,
         )
         if not tgt.endpoint or not tgt.bucket:
             raise S3Error("InvalidArgument", "endpoint and targetbucket required")

@@ -161,9 +161,12 @@ class ReplicationPool:
         self.meta = meta
         self.stats = ReplicationStats()
         # per-target throttles + moving-average monitor (reference
-        # internal/bucket/bandwidth)
+        # internal/bucket/bandwidth).  The configured limit is per
+        # TARGET; each node paces at limit/node_count so a cluster's
+        # aggregate honors it (ClusterNode sets node_count)
         self.limiters = LimiterRegistry()
         self.bw_monitor = BandwidthMonitor()
+        self.node_count = 1
         self._q: queue.Queue = queue.Queue()
         self._stop = threading.Event()
         self._threads = [
@@ -310,8 +313,9 @@ class ReplicationPool:
         # the monitor records the target's live rate
         from minio_tpu.utils.bandwidth import ThrottledChunks
 
+        per_node = tgt.bandwidth_limit // max(self.node_count, 1)
         body = ThrottledChunks(
-            body, self.limiters.get(tgt.arn, tgt.bandwidth_limit),
+            body, self.limiters.get(tgt.arn, per_node),
             on_bytes=lambda n: self.bw_monitor.record(
                 op.bucket, tgt.arn, n))
         try:

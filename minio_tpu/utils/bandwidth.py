@@ -67,13 +67,16 @@ class ThrottledChunks:
 
 class BandwidthMonitor:
     """Moving-average bytes/sec per (bucket, target arn) over a sliding
-    window (reference monitor.go's exponential moving average)."""
+    window (reference monitor.go's exponential moving average).
+    Entries idle past IDLE_TTL are evicted — a removed target must not
+    be reported (or leak) forever."""
 
     WINDOW = 10.0
+    IDLE_TTL = 900.0
 
     def __init__(self):
         self._mu = threading.Lock()
-        # key -> [window_start, window_bytes, last_rate]
+        # key -> [window_start, window_bytes, last_rate, last_seen]
         self._state: dict[tuple[str, str], list] = {}
 
     def record(self, bucket: str, arn: str, n: int) -> None:
@@ -81,8 +84,9 @@ class BandwidthMonitor:
         with self._mu:
             st = self._state.get((bucket, arn))
             if st is None:
-                self._state[(bucket, arn)] = [now, n, 0.0]
+                self._state[(bucket, arn)] = [now, n, 0.0, now]
                 return
+            st[3] = now
             if now - st[0] >= self.WINDOW:
                 st[2] = st[1] / (now - st[0])
                 st[0], st[1] = now, n
@@ -94,6 +98,9 @@ class BandwidthMonitor:
         now = time.monotonic()
         out: dict = {}
         with self._mu:
+            for key in [k for k, st in self._state.items()
+                        if now - st[3] > self.IDLE_TTL]:
+                del self._state[key]
             for (b, arn), st in self._state.items():
                 if bucket and b != bucket:
                     continue
@@ -108,18 +115,28 @@ class BandwidthMonitor:
 
 class LimiterRegistry:
     """One TokenBucket per target arn, created from the target's
-    configured limit; limit changes rebuild the bucket."""
+    configured limit; limit changes rebuild the bucket and idle
+    entries age out so target churn cannot grow the map unboundedly."""
+
+    IDLE_TTL = 900.0
 
     def __init__(self):
         self._mu = threading.Lock()
-        self._limiters: dict[str, tuple[int, TokenBucket]] = {}
+        # arn -> (limit, bucket, last_used)
+        self._limiters: dict[str, list] = {}
 
     def get(self, arn: str, limit: int) -> TokenBucket | None:
-        if limit <= 0:
-            return None
+        now = time.monotonic()
         with self._mu:
+            for key in [k for k, v in self._limiters.items()
+                        if now - v[2] > self.IDLE_TTL]:
+                del self._limiters[key]
+            if limit <= 0:
+                self._limiters.pop(arn, None)
+                return None
             cur = self._limiters.get(arn)
             if cur is None or cur[0] != limit:
-                cur = (limit, TokenBucket(limit))
+                cur = [limit, TokenBucket(limit), now]
                 self._limiters[arn] = cur
+            cur[2] = now
             return cur[1]
