@@ -206,11 +206,18 @@ class LockMaintenance:
             self._thread.start()
 
     def _holding(self, owner: str, uid: str):
-        if not owner or owner == self.my_addr:
+        """True = owner still holds uid, False = owner denies it,
+        None = owner unreachable (strike).  Only owners we can actually
+        map to a node may be denied or struck: an owner string that is
+        neither this node's cluster identity nor a known peer key is
+        KEPT (True) — guessing 'local' here would let the sweep drop a
+        live remote lock and break mutual exclusion (the TTL still
+        bounds truly-dead owners)."""
+        if owner == self.my_addr:
             return self.registry.holds(uid)
         client = self.peer_clients.get(owner)
         if client is None:
-            return None  # unknown owner: treat as unreachable
+            return True  # unmappable owner: keep, let TTL expiry decide
         try:
             return bool(client.call("lock.holding", {"uid": uid}).get("ok"))
         except Exception:

@@ -124,6 +124,15 @@ class ClusterNode:
         pool_disks: list[list] = []
         n_nodes = set()
         local_addrs = _local_host_addrs()
+        # canonical cluster identity: the endpoint-derived host:port under
+        # which PEERS address this node (their peer_clients key).  The raw
+        # --address string is NOT usable as a lock owner — every node may
+        # bind 0.0.0.0:9000, so raw addresses collide across nodes and the
+        # lock-maintenance sweep would misattribute remote locks to the
+        # local registry (reference: globalLocalNodeName comes from
+        # GetLocalPeer over the endpoints, cmd/endpoint.go, not the bind
+        # address).
+        self.cluster_addr = ""
         for spec in pool_specs:
             disks = []
             for host, port, path in spec:
@@ -131,6 +140,8 @@ class ClusterNode:
                     port == my_port and _host_is_me(host, my_host, local_addrs)
                 )
                 n_nodes.add((host, port))
+                if is_local and host is not None and not self.cluster_addr:
+                    self.cluster_addr = f"{host}:{port}"
                 if is_local:
                     d = LocalStorage(path, endpoint=f"{host}:{port}{path}"
                                      if host else path)
@@ -157,13 +168,14 @@ class ClusterNode:
                 return [_LocalLockerClient(self.locker)] + list(
                     self.peer_clients.values()
                 )
+            lock_owner = self.cluster_addr or my_address
             ns_lock = DistributedNamespaceLock(
-                lock_clients, owner=my_address,
+                lock_clients, owner=lock_owner,
                 registry=self.lock_registry)
             # server-side sweep: locks whose owner died are reclaimed in
             # seconds, not the full TTL (cmd/lock-rest-server.go)
             self.lock_maintenance = LockMaintenance(
-                self.locker, self.lock_registry, my_address,
+                self.locker, self.lock_registry, lock_owner,
                 self.peer_clients)
         else:
             ns_lock = None
@@ -227,7 +239,10 @@ class ClusterNode:
                 repl_pool.node_count = len(self.peer_clients) + 1
         else:
             self.peers = None
-        self.s3.node_addr = my_address
+        # display/trace identity follows the cluster identity, like the
+        # reference's globalLocalNodeName (endpoint-derived, not the bind
+        # address)
+        self.s3.node_addr = self.cluster_addr or my_address
         self.router.mount(self.app)
         # format bootstrap probes peers before their servers are up; reset
         # the health cache so the first real use re-probes immediately

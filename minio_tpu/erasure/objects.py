@@ -83,6 +83,12 @@ class PutObjectOptions:
     # nonzero pins the version's mod time (pool decommission moves
     # versions between pools without reordering history)
     mod_time: float = 0.0
+    # non-empty pins the stored ETag instead of recomputing it from the
+    # stream: decommission/rebalance must carry multipart composite
+    # (md5-N) and SSE/compressed ETags verbatim or client caches and
+    # If-Match preconditions break (reference moves versions with
+    # metadata verbatim, cmd/erasure-server-pool-decom.go)
+    etag: str = ""
     # called after the stream is fully consumed, just before metadata
     # commit — lets transforming wrappers (compression) contribute the
     # original size/ETag they only know at EOF
@@ -443,6 +449,9 @@ class ErasureObjects:
         if opts.finalize_metadata is not None:
             metadata.update(opts.finalize_metadata() or {})
             etag = metadata.get("etag", etag)
+        if opts.etag:
+            etag = opts.etag
+            metadata["etag"] = etag
 
         part = ObjectPartInfo(1, total_size, total_size, mod_time, etag)
 

@@ -136,22 +136,38 @@ class _ChunkedSigReader(io.RawIOBase):
 
     def _read_trailers(self) -> None:
         """Consume `name:value` lines after the zero chunk (aws-chunked
-        trailers).  The x-amz-trailer-signature line is consumed but not
-        independently verified — the trailer values it covers are
-        themselves checked against the decoded payload."""
+        trailers).  For signed streams (ctx set) the
+        x-amz-trailer-signature line is verified over the canonical
+        trailer section chained from the final chunk's signature — a
+        forged or truncated trailer block fails here instead of passing
+        silently (reference readTrailers,
+        cmd/streaming-signature-v4.go)."""
         while len(self.buf) < self._MAX_TRAILER:
             chunk = self.raw.read(65536)
             if not chunk:
                 break
             self.buf += chunk
+        ordered: list[tuple[str, str]] = []
         for line in self.buf.split(b"\r\n"):
             line = line.strip()
             if not line or b":" not in line:
                 continue
             name, _, value = line.partition(b":")
-            self.trailers[name.decode(errors="replace").strip().lower()] = \
-                value.decode(errors="replace").strip()
+            k = name.decode(errors="replace").strip().lower()
+            v = value.decode(errors="replace").strip()
+            self.trailers[k] = v
+            if k != "x-amz-trailer-signature":
+                ordered.append((k, v))
         self.buf = b""
+        if self.ctx is not None and ordered:
+            canon = "".join(f"{k}:{v}\n" for k, v in ordered)
+            want = sigv4.trailer_signature(
+                self.ctx.signing_key, self.prev_sig, self.ctx.amz_date,
+                self.ctx.scope, hashlib.sha256(canon.encode()).hexdigest())
+            got = self.trailers.get("x-amz-trailer-signature", "")
+            if got != want:
+                raise S3Error("SignatureDoesNotMatch",
+                              "trailer signature mismatch")
 
     def read(self, n: int = -1) -> bytes:
         while not self.eof and (n < 0 or len(self.out) < n):
@@ -1467,14 +1483,29 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 f"x-amz-checksum-{cksum[0]} does not match body",
                 code="XAmzContentChecksumMismatch")
         trailer_value = None
-        if trailer_algo is not None:
+        if chunk_reader is not None:
             # the put consumed exactly the decoded payload; the zero
             # chunk + trailer lines are still in the pipe — drain them
+            # for EVERY streaming upload (not just supported checksum
+            # algorithms) so chained/trailer signatures always verify
             if not chunk_reader.eof:
-                await self._run(chunk_reader.read)
+                try:
+                    await self._run(chunk_reader.read)
+                except S3Error as e:
+                    # chunk/trailer-signature mismatch surfaces after
+                    # the data was committed: roll the version back
+                    await _digest_rollback(e.message or e.code, code=e.code)
+            if trailer_decl and not chunk_reader.trailers.get(trailer_decl):
+                # the PUT declared this trailer (supported algo or not);
+                # a body whose trailer section omits (or blanks) it is
+                # truncated/forged — do not silently accept
+                await _digest_rollback(
+                    f"declared trailer {trailer_decl} missing from body",
+                    code="IncompleteBody")
+        if trailer_algo is not None:
             trailer_value = cksum_mod.encode(trailer_hasher.digest())
             claimed = chunk_reader.trailers.get(trailer_decl, "")
-            if claimed and claimed != trailer_value:
+            if claimed != trailer_value:
                 await _digest_rollback(
                     f"{trailer_decl} trailer does not match body",
                     code="XAmzContentChecksumMismatch")

@@ -228,6 +228,20 @@ def chunk_signature(signing_key_: bytes, prev_signature: str, amz_date: str,
     return hmac.new(signing_key_, sts.encode(), hashlib.sha256).hexdigest()
 
 
+def trailer_signature(signing_key_: bytes, prev_signature: str,
+                      amz_date: str, scope: str,
+                      trailer_sha256: str) -> str:
+    """x-amz-trailer-signature for STREAMING-AWS4-HMAC-SHA256-PAYLOAD-
+    TRAILER: signs the canonical trailer section (`name:value\\n` per
+    trailer) chained from the final (zero) chunk's signature (reference
+    getTrailerChunkSignature, cmd/streaming-signature-v4.go)."""
+    sts = "\n".join([
+        "AWS4-HMAC-SHA256-TRAILER", amz_date, scope, prev_signature,
+        trailer_sha256,
+    ])
+    return hmac.new(signing_key_, sts.encode(), hashlib.sha256).hexdigest()
+
+
 def verify_v4_presigned(method: str, path: str,
                         query: list[tuple[str, str]], headers: dict[str, str],
                         creds_lookup, region: str = "us-east-1") -> str:

@@ -179,6 +179,10 @@ def move_version(src, target, bucket: str, name: str, oi) -> None:
         versioned=bool(oi.version_id),
         version_id=oi.version_id,
         mod_time=oi.mod_time,
+        # carry the ETag verbatim: a multipart (md5-N) or SSE/compressed
+        # ETag recomputed from the drained stream would differ and break
+        # If-Match / client caches (ADVICE r4 medium)
+        etag=oi.etag or oi.metadata.get("etag", ""),
     )
     target.put_object(bucket, name, _IterReader(stream), oi.size, opts)
     src.delete_object(bucket, name, version_id=oi.version_id or "null")

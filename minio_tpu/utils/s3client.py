@@ -83,7 +83,12 @@ class S3Client:
         conn = self._connect()
         try:
             if chunked:
-                conn.putrequest(method, url, skip_accept_encoding=True)
+                # skip_host: the signed 'host' header below is the only
+                # Host field — putrequest's automatic one would duplicate
+                # it, and RFC 9112 requires strict servers to 400 a
+                # request with two Host headers
+                conn.putrequest(method, url, skip_accept_encoding=True,
+                                skip_host=True)
                 for k, v in signed.items():
                     if k.lower() != "content-length":
                         conn.putheader(k, v)

@@ -149,7 +149,9 @@ class TestChecksumAPI:
     def _chunked_put(self, srv, path, data, trailer=None, chunk=64 << 10,
                      extra_headers=None):
         """Raw STREAMING-UNSIGNED-PAYLOAD-TRAILER upload (the aws-chunked
-        framing modern SDKs send by default)."""
+        framing modern SDKs send by default).  trailer=(name, None)
+        declares the trailer header but omits its line from the body —
+        the truncated-trailer shape the server must reject."""
         import http.client
 
         from minio_tpu.server import sigv4
@@ -159,7 +161,7 @@ class TestChecksumAPI:
             piece = data[i:i + chunk]
             body += b"%x\r\n%s\r\n" % (len(piece), piece)
         body += b"0\r\n"
-        if trailer:
+        if trailer and trailer[1] is not None:
             name, value = trailer
             body += name.encode() + b":" + value.encode() + b"\r\n"
         body += b"\r\n"
@@ -216,6 +218,105 @@ class TestChecksumAPI:
         assert status == 400
         assert b"XAmzContentChecksumMismatch" in body
         assert srv.request("GET", "/ckb/bad-trailer").status == 404
+
+    def test_declared_trailer_missing_rejected(self, srv):
+        """A PUT declaring x-amz-trailer whose body omits that trailer
+        line is truncated/forged — it must NOT be accepted with a
+        server-computed checksum (ADVICE r4 low)."""
+        data = b"truncated trailers" * 500
+        status, _, body = self._chunked_put(
+            srv, "/ckb/no-trailer", data,
+            trailer=("x-amz-checksum-crc32c", None))
+        assert status == 400, body
+        assert b"IncompleteBody" in body
+        assert srv.request("GET", "/ckb/no-trailer").status == 404
+
+    def test_declared_trailer_empty_rejected(self, srv):
+        data = b"empty trailer value" * 500
+        status, _, body = self._chunked_put(
+            srv, "/ckb/empty-trailer", data,
+            trailer=("x-amz-checksum-sha256", ""))
+        assert status == 400, body
+        assert srv.request("GET", "/ckb/empty-trailer").status == 404
+
+    def _signed_trailer_put(self, srv, path, data, trailer_name,
+                            trailer_value, forge_sig=False):
+        """STREAMING-AWS4-HMAC-SHA256-PAYLOAD-TRAILER: chained chunk
+        signatures plus a trailer signature over the canonical trailer
+        section (reference cmd/streaming-signature-v4.go)."""
+        import hashlib as _hl
+
+        from minio_tpu.server import sigv4
+
+        headers = {
+            "host": f"127.0.0.1:{srv.port}",
+            "content-encoding": "aws-chunked",
+            "x-amz-decoded-content-length": str(len(data)),
+            "x-amz-trailer": trailer_name,
+        }
+        signed = sigv4.sign_request(
+            "PUT", path, [], headers, None, srv.ak, srv.sk,
+            payload_hash="STREAMING-AWS4-HMAC-SHA256-PAYLOAD-TRAILER")
+        auth = signed["authorization"]
+        seed = auth.split("Signature=")[1]
+        amz_date = signed["x-amz-date"]
+        scope = auth.split("Credential=")[1].split(",")[0].split("/", 1)[1]
+        skey = sigv4.signing_key(srv.sk, amz_date[:8], "us-east-1")
+        crlf = b"\r\n"
+        framed, prev = b"", seed
+        pieces = [data[i:i + 16384] for i in range(0, len(data), 16384)]
+        for c in pieces + [b""]:
+            sig = sigv4.chunk_signature(
+                skey, prev, amz_date, scope, _hl.sha256(c).hexdigest())
+            framed += f"{len(c):x};chunk-signature={sig}".encode() + crlf
+            framed += c + (crlf if c else b"")
+            prev = sig
+        canon = f"{trailer_name}:{trailer_value}\n"
+        tsig = sigv4.trailer_signature(
+            skey, prev, amz_date, scope,
+            _hl.sha256(canon.encode()).hexdigest())
+        if forge_sig:
+            tsig = "0" * 64
+        framed += f"{trailer_name}:{trailer_value}".encode() + crlf
+        framed += f"x-amz-trailer-signature:{tsig}".encode() + crlf + crlf
+        signed["content-length"] = str(len(framed))
+        return srv.raw_request("PUT", path, data=framed, headers=signed)
+
+    def test_signed_trailer_verified(self, srv):
+        data = b"signed trailer stream " * 3000
+        want = _expected("crc32c", data)
+        r = self._signed_trailer_put(srv, "/ckb/st-ok", data,
+                                     "x-amz-checksum-crc32c", want)
+        assert r.status == 200, r.text()
+        assert srv.request("GET", "/ckb/st-ok").body == data
+
+    def test_signed_trailer_forged_signature_rejected(self, srv):
+        data = b"forged trailer sig " * 3000
+        want = _expected("crc32c", data)
+        r = self._signed_trailer_put(srv, "/ckb/st-forged", data,
+                                     "x-amz-checksum-crc32c", want,
+                                     forge_sig=True)
+        assert r.status in (400, 403), r.status
+        assert "SignatureDoesNotMatch" in r.text()
+        assert srv.request("GET", "/ckb/st-forged").status == 404
+
+    def test_unsupported_trailer_algo_still_enforced(self, srv):
+        """crc64nvme isn't in the supported-checksum table, but a PUT
+        declaring it must still drain + require the trailer line — the
+        enforcement cannot hinge on the algorithm being one we verify."""
+        data = b"nvme trailer " * 1000
+        # declared but missing -> rejected
+        status, _, body = self._chunked_put(
+            srv, "/ckb/nvme-miss", data,
+            trailer=("x-amz-checksum-crc64nvme", None))
+        assert status == 400, body
+        assert srv.request("GET", "/ckb/nvme-miss").status == 404
+        # declared and present -> accepted (value not verified server-side)
+        status, _, body = self._chunked_put(
+            srv, "/ckb/nvme-ok", data,
+            trailer=("x-amz-checksum-crc64nvme", "AAAAAAAAAAA="))
+        assert status == 200, body
+        assert srv.request("GET", "/ckb/nvme-ok").body == data
 
     def test_checksum_survives_copy(self, srv):
         data = b"copied with checksum"

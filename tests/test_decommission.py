@@ -101,6 +101,38 @@ class TestDecommission:
         pools2.put_object("pb", "fresh", io.BytesIO(b"n"), 1)
         assert "fresh" not in pools2.pools[0].list_objects("pb")
 
+    def test_etag_preserved_through_drain(self, tmp_path):
+        """Multipart composite (md5-N) ETags must survive the move
+        verbatim — a recomputed single-stream MD5 would break If-Match
+        and client caches (ADVICE r4 medium; reference decom moves
+        versions with metadata verbatim)."""
+        pools = _two_pools(tmp_path)
+        pools.make_bucket("eb")
+        # multipart object: composite etag "…-2"
+        uid = pools.new_multipart_upload("eb", "mp")
+        part = b"p" * (5 << 20)
+        parts = []
+        for n in (1, 2):
+            pi = pools.put_object_part("eb", "mp", uid, n,
+                                       io.BytesIO(part), len(part))
+            parts.append((n, pi.etag))
+        pools.complete_multipart_upload("eb", "mp", uid, parts)
+        # plain object too
+        pools.put_object("eb", "plain", io.BytesIO(b"z" * 1000), 1000)
+        before = {name: pools.get_object_info("eb", name).etag
+                  for name in ("mp", "plain")}
+        assert before["mp"].endswith("-2"), before
+
+        idx = pools.pools.index(pools._pool_of("eb", "mp"))
+        job = PoolDecommission(pools, idx)
+        job.start()
+        job.wait(60)
+        assert job.state["state"] == "complete", job.state
+        assert "mp" not in pools.pools[idx].list_objects("eb")
+        after = {name: pools.get_object_info("eb", name).etag
+                 for name in ("mp", "plain")}
+        assert after == before
+
     def test_cannot_decommission_only_pool(self, tmp_path):
         from minio_tpu.storage import errors
 

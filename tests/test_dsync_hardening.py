@@ -108,6 +108,57 @@ class TestKilledClientReclaim:
         n2.lock_registry.remove("yes-uid")
 
 
+class TestOwnerIdentity:
+    """Lock owners are canonical cluster identities (the endpoint-derived
+    host:port peers key each other by), never the raw --address string —
+    with every node bound to 0.0.0.0:9000 the raw address collides and
+    the sweep would misattribute remote locks to the local registry
+    (ADVICE r4 high)."""
+
+    def test_cluster_addr_is_endpoint_derived(self, cluster):
+        n1, n2 = cluster
+        assert n1.cluster_addr in n2.peer_clients
+        assert n2.cluster_addr in n1.peer_clients
+        assert n1.cluster_addr != n2.cluster_addr
+
+    def test_unmappable_owner_kept_not_pruned(self):
+        """An owner that maps to neither this node nor any known peer is
+        kept (TTL still bounds it) — never denied via the local registry
+        or struck out as unreachable."""
+        lk = LocalLocker()
+        assert lk.lock("res", "uid-1", owner="unknown-node:9000")
+        lk._locks["res"]["granted"]["uid-1"] -= 10
+        lm = LockMaintenance(lk, OwnerRegistry(), "node-a:9000", {},
+                             autostart=False)
+        for _ in range(5):
+            assert lm.sweep_once() == 0
+        assert not lk.lock("res", "uid-2", owner="node-b:9000")
+
+    def test_remote_lock_checked_with_owner_not_local_registry(self):
+        """Node B's live lock on node A's locker survives A's sweep: the
+        probe goes to B (whose registry holds the uid), not to A's local
+        registry (which does not)."""
+        lk_a = LocalLocker()
+        reg_a = OwnerRegistry()          # A never held uid-b
+        reg_b = OwnerRegistry()
+        reg_b.add("uid-b")
+
+        class FakeClient:
+            def call(self, method, args):
+                assert method == "lock.holding"
+                return {"ok": reg_b.holds(args["uid"])}
+
+        assert lk_a.lock("res", "uid-b", owner="node-b:9000")
+        lk_a._locks["res"]["granted"]["uid-b"] -= 10
+        lm = LockMaintenance(lk_a, reg_a, "node-a:9000",
+                             {"node-b:9000": FakeClient()}, autostart=False)
+        assert lm.sweep_once() == 0      # kept: B still holds it
+        assert not lk_a.lock("res", "uid-x", owner="node-a:9000")
+        reg_b.remove("uid-b")            # B's client released
+        assert lm.sweep_once() == 1      # now pruned via B's denial
+        assert lk_a.lock("res", "uid-x", owner="node-a:9000")
+
+
 class TestJitteredRetry:
     def test_contended_acquisition_succeeds(self):
         """Two writers hammering the same name: the jittered retry loop

@@ -396,3 +396,41 @@ class TestGatewayTransforms:
         assert g.request("PUT", "/gwsse/empty", data=b"").status == 200
         r = g.request("GET", "/gwsse/empty")
         assert r.status == 200 and r.body == b""
+
+
+class TestChunkedWireFormat:
+    def test_chunked_upload_sends_exactly_one_host_header(self):
+        """Unknown-length streaming uploads must carry a single Host
+        field: putrequest's automatic Host plus the signed 'host' header
+        would be two, which RFC 9112 requires strict servers (real S3,
+        most proxies) to reject with 400 (ADVICE r4 medium)."""
+        import socket
+
+        from minio_tpu.utils.s3client import S3Client
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        captured = {}
+
+        def serve():
+            conn, _ = srv.accept()
+            buf = b""
+            while b"\r\n\r\n" not in buf:
+                buf += conn.recv(65536)
+            captured["head"] = buf.split(b"\r\n\r\n", 1)[0]
+            conn.sendall(b"HTTP/1.1 200 OK\r\nContent-Length: 0\r\n\r\n")
+            conn.close()
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        c = S3Client(f"http://127.0.0.1:{port}", "ak", "sk")
+        c.put_object("bkt", "k", iter([b"x" * 10]), length=None)
+        t.join(5)
+        srv.close()
+        lines = captured["head"].split(b"\r\n")
+        hosts = [l for l in lines if l.lower().startswith(b"host:")]
+        assert len(hosts) == 1, captured["head"]
+        assert any(l.lower() == b"transfer-encoding: chunked"
+                   for l in lines), captured["head"]
