@@ -9,12 +9,20 @@ policies (policies attached to the group DN in the IAM store).
 The client speaks LDAPv3 directly — BER/DER encoding on a TCP socket
 (simple bind + subtree search with an equality filter); no LDAP library
 exists in this image.
+
+Transport security matches the reference (internal/config/identity/ldap
+tls.Config + StartTLS): TLS is REQUIRED by default — either implicit
+(ldaps://, port 636) or via the StartTLS extended operation on 389 —
+because every AssumeRoleWithLDAPIdentity carries the user's password in
+a simple bind.  Plaintext is refused unless explicitly opted in with
+MINIO_IDENTITY_LDAP_SERVER_INSECURE=on.
 """
 
 from __future__ import annotations
 
 import os
 import socket
+import ssl
 
 
 class LDAPError(Exception):
@@ -70,14 +78,32 @@ def _parse_tlv(buf: bytes, off: int) -> tuple[int, bytes, int]:
 # ---------------------------------------------------------------- client
 
 
+STARTTLS_OID = "1.3.6.1.4.1.1466.20037"
+
+
 class LDAPClient:
     """One LDAP server connection: bind + search, re-dialed per call
-    (STS exchanges are rare; connection pooling buys nothing)."""
+    (STS exchanges are rare; connection pooling buys nothing).
 
-    def __init__(self, host: str, port: int = 389, timeout: float = 5.0):
+    tls: "ldaps" (implicit TLS, the default), "starttls" (plain dial +
+    StartTLS extended op, RFC 4511 §4.14), or "none" (refused unless
+    insecure_ok — a simple bind sends the password in the clear)."""
+
+    def __init__(self, host: str, port: int | None = None,
+                 timeout: float = 5.0,
+                 tls: str = "ldaps", insecure_ok: bool = False,
+                 skip_verify: bool = False, ca_file: str = ""):
         self.host = host
-        self.port = port
+        # default port follows the TLS mode: 636 for implicit TLS, 389
+        # for StartTLS/plain — a TLS ClientHello to the plaintext port
+        # would fail opaquely
+        self.port = port if port is not None else (
+            636 if tls == "ldaps" else 389)
         self.timeout = timeout
+        self.tls = tls
+        self.insecure_ok = insecure_ok
+        self.skip_verify = skip_verify
+        self.ca_file = ca_file
 
     _MID = 1  # one outstanding request per roundtrip per socket: a
               # constant message ID is unambiguous and thread-safe
@@ -167,21 +193,63 @@ class LDAPClient:
             entries.append((dn.decode(), got))
         return entries
 
+    def _ssl_context(self) -> ssl.SSLContext:
+        if self.skip_verify:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            return ctx
+        # server-cert validation on: system roots, or an explicit CA
+        # bundle (self-signed directories)
+        return ssl.create_default_context(cafile=self.ca_file or None)
+
+    def _starttls(self, sock) -> None:
+        """StartTLS extended operation: upgrade the plain socket before
+        any bind crosses it (RFC 4511 §4.14; the reference dials with
+        DialWithDialer then calls conn.StartTLS)."""
+        op = _tlv(0x77, _tlv(0x80, STARTTLS_OID.encode()))
+        resp = self._roundtrip(sock, op, 0x78)
+        code, diag = self._result_code(resp[-1][1:])
+        if code != 0:
+            raise LDAPError(f"StartTLS refused (code {code}): {diag}")
+
     def connect(self):
-        return socket.create_connection((self.host, self.port),
+        sock = socket.create_connection((self.host, self.port),
                                         self.timeout)
+        try:
+            if self.tls == "ldaps":
+                return self._ssl_context().wrap_socket(
+                    sock, server_hostname=self.host)
+            if self.tls == "starttls":
+                self._starttls(sock)
+                return self._ssl_context().wrap_socket(
+                    sock, server_hostname=self.host)
+            if not self.insecure_ok:
+                raise LDAPError(
+                    "refusing plaintext LDAP: a simple bind would send "
+                    "credentials unencrypted. Use ldaps://, set "
+                    "MINIO_IDENTITY_LDAP_SERVER_STARTTLS=on, or opt in "
+                    "explicitly with MINIO_IDENTITY_LDAP_SERVER_INSECURE=on")
+            return sock
+        except BaseException:
+            sock.close()
+            raise
 
 
 class LDAPProvider:
     """STS-facing provider: authenticate(username, password) ->
     (user_dn, group_dns)."""
 
-    def __init__(self, host: str, port: int = 389,
+    def __init__(self, host: str, port: int | None = None,
                  lookup_bind_dn: str = "", lookup_bind_password: str = "",
                  user_base: str = "", user_attr: str = "uid",
                  group_base: str = "", group_member_attr: str = "member",
-                 timeout: float = 5.0):
-        self.client = LDAPClient(host, port, timeout)
+                 timeout: float = 5.0, tls: str = "ldaps",
+                 insecure_ok: bool = False, skip_verify: bool = False,
+                 ca_file: str = ""):
+        self.client = LDAPClient(host, port, timeout, tls=tls,
+                                 insecure_ok=insecure_ok,
+                                 skip_verify=skip_verify, ca_file=ca_file)
         self.lookup_bind_dn = lookup_bind_dn
         self.lookup_bind_password = lookup_bind_password
         self.user_base = user_base
@@ -199,9 +267,30 @@ class LDAPProvider:
             return None
         from minio_tpu.events.targets import _host_port
 
-        host, port = _host_port(addr, 389)  # IPv6-bracket aware
+        def _on(key: str) -> bool:
+            return env.get(key, "").lower() in ("on", "true", "1", "yes")
+
+        # scheme selects the TLS mode: ldaps:// = implicit TLS (:636
+        # default); ldap:// or bare host:port uses StartTLS when
+        # MINIO_IDENTITY_LDAP_SERVER_STARTTLS=on, else plaintext —
+        # which connect() refuses without the explicit insecure opt-in
+        scheme = ""
+        if "://" in addr:
+            scheme, addr = addr.split("://", 1)
+            scheme = scheme.lower()
+        if scheme == "ldaps":
+            tls, default_port = "ldaps", 636
+        elif _on("MINIO_IDENTITY_LDAP_SERVER_STARTTLS"):
+            tls, default_port = "starttls", 389
+        else:
+            tls, default_port = "none", 389
+        host, port = _host_port(addr, default_port)  # IPv6-bracket aware
         return cls(
             host, port,
+            tls=tls,
+            insecure_ok=_on("MINIO_IDENTITY_LDAP_SERVER_INSECURE"),
+            skip_verify=_on("MINIO_IDENTITY_LDAP_TLS_SKIP_VERIFY"),
+            ca_file=env.get("MINIO_IDENTITY_LDAP_TLS_CA_FILE", ""),
             lookup_bind_dn=env.get("MINIO_IDENTITY_LDAP_LOOKUP_BIND_DN", ""),
             lookup_bind_password=env.get(
                 "MINIO_IDENTITY_LDAP_LOOKUP_BIND_PASSWORD", ""),

@@ -943,7 +943,13 @@ class AdminMixin:
                                      body: bytes):
         job = self._rebalance_job()
         if job is None:
-            return self._json({"state": "none"})
+            if not hasattr(self.api, "pools") or len(self.api.pools) < 2:
+                return self._json({"state": "none"})
+            # no in-process job: instantiate one (its ctor reads the
+            # quorum-persisted state of a previous process's run and
+            # maps a dangling 'running' to 'interrupted') so the
+            # response shape matches the live path
+            job = await self._run(self._rebalance_job, True)
         return self._json(await self._run(job.status))
 
     async def admin_data_usage(self, request: web.Request, body: bytes):

@@ -10,9 +10,14 @@ Design here: the drain job walks the source pool's entry stream
 (name + all versions), re-puts each live version into the surviving
 pools with its version id AND mod time pinned (PutObjectOptions
 version_id/mod_time), re-creates delete markers, then deletes the
-source copy.  State persists on the source pool's first online drive
-(`decommission.json`) so a restart resumes (bucket granularity) and a
-completed pool stays excluded from placement.
+source copy.  State persists to a WRITE QUORUM of the source pool's
+drives (`decommission.json`, seq-versioned) so losing any minority of
+drives — including whichever wrote first — cannot lose drain progress;
+a restart resumes (bucket granularity) and a completed pool stays
+excluded from placement.  Saves that miss quorum mark the job degraded
+in admin status instead of failing silently (reference persists pool
+meta under .minio.sys with quorum semantics,
+cmd/erasure-server-pool-decom.go poolMeta.save).
 """
 
 from __future__ import annotations
@@ -26,38 +31,47 @@ from minio_tpu.storage import errors
 from minio_tpu.storage.local import SYSTEM_VOL
 
 DECOM_FILE = "decommission.json"
+REBAL_FILE = "rebalance.json"
 
 _STATES = ("none", "draining", "complete", "failed", "canceled")
 
 
-def _state_disk(pool):
+def load_state(pool, filename: str = DECOM_FILE) -> dict:
+    """Read every drive's copy and return the newest (highest seq) —
+    any surviving member of the last write quorum is enough to resume."""
+    best, best_seq = {"state": "none"}, -1
     for d in pool.all_disks:
         try:
-            if d is not None and d.is_online():
-                return d
+            if d is None or not d.is_online():
+                continue
+            st = json.loads(d.read_all(SYSTEM_VOL, filename))
+            seq = int(st.get("seq", 0))
+        except Exception:
+            continue  # unreadable/corrupt copy: ignore, others decide
+        if seq > best_seq:
+            best, best_seq = st, seq
+    return best
+
+
+def save_state(pool, state: dict, filename: str = DECOM_FILE) -> bool:
+    """Persist to ALL online drives of the pool; True iff a write
+    quorum (n//2+1 of the pool's drive slots) accepted it.  The seq
+    counter makes load_state pick the newest copy after partial
+    failures."""
+    state["seq"] = int(state.get("seq", 0)) + 1
+    payload = json.dumps(state).encode()
+    disks = [d for d in pool.all_disks if d is not None]
+    quorum = len(disks) // 2 + 1
+    ok = 0
+    for d in disks:
+        try:
+            if not d.is_online():
+                continue
+            d.write_all(SYSTEM_VOL, filename, payload)
+            ok += 1
         except Exception:
             continue
-    return None
-
-
-def load_state(pool) -> dict:
-    d = _state_disk(pool)
-    if d is None:
-        return {"state": "none"}
-    try:
-        return json.loads(d.read_all(SYSTEM_VOL, DECOM_FILE))
-    except Exception:
-        return {"state": "none"}
-
-
-def save_state(pool, state: dict) -> None:
-    d = _state_disk(pool)
-    if d is not None:
-        try:
-            d.write_all(SYSTEM_VOL, DECOM_FILE,
-                        json.dumps(state).encode())
-        except Exception:
-            pass
+    return ok >= quorum
 
 
 class PoolDecommission:
@@ -77,18 +91,34 @@ class PoolDecommission:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
+    def _save(self) -> None:
+        """Quorum-persist; a save that misses quorum marks the job
+        degraded in status (visible via the pools admin API) instead of
+        silently continuing with unpersisted progress."""
+        self.state["degraded"] = False
+        if not save_state(self.src, self.state):
+            self.state["degraded"] = True
+
     # -- control ------------------------------------------------------------
     def start(self) -> None:
-        if self.state.get("state") == "draining":
+        if self.state.get("state") == "draining" \
+                and self._thread is not None and self._thread.is_alive():
             raise errors.InvalidArgument("decommission already running")
         if self.state.get("state") == "complete":
             raise errors.InvalidArgument("pool already decommissioned")
+        # a persisted 'draining' with no live thread is a crashed drain:
+        # restarting resumes from the completed-bucket list, like
+        # failed/canceled restarts
+        resume_from = self.state.get("done_buckets", []) \
+            if self.state.get("state") in ("draining", "failed",
+                                           "canceled") else []
         self.state = {
             "state": "draining", "started": time.time(),
             "moved_objects": 0, "moved_bytes": 0, "failed_objects": 0,
-            "done_buckets": [],
+            "done_buckets": list(resume_from),
+            "seq": int(self.state.get("seq", 0)),
         }
-        save_state(self.src, self.state)
+        self._save()
         self.pools.mark_draining(self.idx, True)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"decom-pool-{self.idx}")
@@ -99,7 +129,7 @@ class PoolDecommission:
         if self._thread is not None:
             self._thread.join(timeout=10)
         self.state["state"] = "canceled"
-        save_state(self.src, self.state)
+        self._save()
         self.pools.mark_draining(self.idx, False)
 
     def wait(self, timeout: float = 600.0) -> None:
@@ -117,13 +147,13 @@ class PoolDecommission:
                     continue
                 self._drain_bucket(bucket)
                 self.state["done_buckets"].append(bucket)
-                save_state(self.src, self.state)
+                self._save()
             self.state["state"] = "complete"
             self.state["finished"] = time.time()
         except Exception as e:
             self.state["state"] = "failed"
             self.state["error"] = str(e)
-        save_state(self.src, self.state)
+        self._save()
 
     def _drain_bucket(self, bucket: str) -> None:
         for entry in self.src.list_entries(bucket):
@@ -202,9 +232,20 @@ class PoolRebalance:
             raise errors.InvalidArgument("rebalance needs multiple pools")
         self.pools = pools
         self.tolerance = tolerance
-        self.state = {"state": "none"}
+        # rebalance meta lives on the FIRST pool's drives, quorum-written
+        # like decom state (reference rebalanceMeta under .minio.sys)
+        self.state = load_state(pools.pools[0], REBAL_FILE)
+        if self.state.get("state") == "running":
+            # persisted 'running' with no thread = a previous process
+            # died mid-rebalance; surface that instead of lying
+            self.state["state"] = "interrupted"
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+
+    def _save(self) -> None:
+        self.state["degraded"] = False
+        if not save_state(self.pools.pools[0], self.state, REBAL_FILE):
+            self.state["degraded"] = True
 
     # -- capacity math ------------------------------------------------------
     def _capacity(self, fresh: bool = False) -> list[tuple[int, int]]:
@@ -243,7 +284,9 @@ class PoolRebalance:
             raise errors.InvalidArgument("rebalance already running")
         self.state = {"state": "running", "started": time.time(),
                       "moved_objects": 0, "moved_bytes": 0,
-                      "failed_objects": 0}
+                      "failed_objects": 0,
+                      "seq": int(self.state.get("seq", 0))}
+        self._save()
         self._stop.clear()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="pool-rebalance")
@@ -255,6 +298,7 @@ class PoolRebalance:
             self._thread.join(timeout=10)
         if self.state.get("state") == "running":
             self.state["state"] = "stopped"
+        self._save()
 
     def wait(self, timeout: float = 600.0) -> None:
         if self._thread is not None:
@@ -281,6 +325,7 @@ class PoolRebalance:
                     over = int((fracs[i] - avg) * caps[i][0])
                     if self._donate(i, over, fracs):
                         moved_any = True
+                self._save()
                 if not moved_any:
                     break
             self.state["state"] = "complete"
@@ -288,6 +333,7 @@ class PoolRebalance:
         except Exception as e:
             self.state["state"] = "failed"
             self.state["error"] = str(e)
+        self._save()
 
     def _donate(self, idx: int, budget: int, fracs: list[float]) -> bool:
         """Move ~`budget` logical bytes out of pool `idx` into the

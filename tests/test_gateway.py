@@ -414,20 +414,25 @@ class TestChunkedWireFormat:
         port = srv.getsockname()[1]
         captured = {}
 
+        done = threading.Event()
+
         def serve():
+            srv.settimeout(30)
             conn, _ = srv.accept()
+            conn.settimeout(30)
             buf = b""
             while b"\r\n\r\n" not in buf:
                 buf += conn.recv(65536)
             captured["head"] = buf.split(b"\r\n\r\n", 1)[0]
             conn.sendall(b"HTTP/1.1 200 OK\r\nContent-Length: 0\r\n\r\n")
             conn.close()
+            done.set()
 
         t = threading.Thread(target=serve, daemon=True)
         t.start()
         c = S3Client(f"http://127.0.0.1:{port}", "ak", "sk")
         c.put_object("bkt", "k", iter([b"x" * 10]), length=None)
-        t.join(5)
+        assert done.wait(30), "fake backend never captured the request"
         srv.close()
         lines = captured["head"].split(b"\r\n")
         hosts = [l for l in lines if l.lower().startswith(b"host:")]
