@@ -376,6 +376,27 @@ def bench_select():
         return len(data) / (time.perf_counter() - t0) / 2**30
 
     json_fast = max(run_json(jbig), run_json(jbig))
+
+    # realistic wide-row corpus (the reference's benchmark records are
+    # ~100 B employee rows, select_benchmark_test.go): structural scan
+    # cost amortizes over row width, so this is the headline scan rate
+    wide = ("id,name,dept,salary,city,notes\n" + "\n".join(
+        f"{i},employee-name-{i % 977},department-{i % 31},"
+        f"{30000 + (i * 37) % 70000},city-{i % 211},"
+        f"note text field number {i % 53} with some length"
+        for i in range(700_000)) + "\n").encode()
+    wreq = sel.SelectRequest(
+        "SELECT COUNT(*) FROM s3object WHERE salary > 60000",
+        {"CSV": {}}, {"CSV": {}},
+    )
+
+    def run_wide(data):
+        t0 = time.perf_counter()
+        out = b"".join(sel.run_select(wreq, iomod.BytesIO(data), len(data)))
+        assert out
+        return len(data) / (time.perf_counter() - t0) / 2**30
+
+    wide_fast = max(run_wide(wide), run_wide(wide))
     os.environ["MINIO_TPU_SELECT_COLUMNAR"] = "0"
     try:
         sl = big[: len(big) // 8]
@@ -386,7 +407,7 @@ def bench_select():
         json_slow = run_json(jsl)
     finally:
         os.environ.pop("MINIO_TPU_SELECT_COLUMNAR", None)
-    return fast, slow, json_fast, json_slow
+    return fast, slow, json_fast, json_slow, wide_fast
 
 
 def main():
@@ -401,7 +422,8 @@ def main():
     ph2, _ = bench_e2e("host")
     e2e_put, e2e_get = max(e2e_put, p2), max(e2e_get, g2)
     e2e_put_host = max(e2e_put_host, ph2)
-    select_fast, select_row, select_json, select_json_row = bench_select()
+    (select_fast, select_row, select_json, select_json_row,
+     select_wide) = bench_select()
     try:
         tpu, link_h2d, link_d2h = bench_tpu()
     except Exception as e:  # pragma: no cover - report CPU-only on failure
@@ -441,6 +463,7 @@ def main():
             "host_memcpy_gibs": round(memcpy_gibs, 3),
             "host_disk_write_gibs": round(disk_write_gibs, 3),
             "select_scan_gibs": round(select_fast, 3),
+            "select_scan_wide_gibs": round(select_wide, 3),
             "select_row_engine_gibs": round(select_row, 3),
             "select_speedup": round(select_fast / select_row, 1),
             "select_json_scan_gibs": round(select_json, 3),

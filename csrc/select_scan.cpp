@@ -1,0 +1,1322 @@
+// Native S3 Select scan kernels: CSV structural scan + predicate masks +
+// aggregates, and an NDJSON top-level-key scanner.
+//
+// This is the TPU-framework analogue of the reference's SIMD Select
+// accelerators (internal/s3select/simdj/reader.go simdjson path and the
+// generated-assembly CSV scanner behind select_benchmark_test.go): the
+// hot loop — tokenize, extract needed fields, evaluate simple predicates,
+// fold aggregates — runs in C++ at memory speed, while the Python driver
+// (minio_tpu/select/native.py) keeps row-engine semantics by re-evaluating
+// any block whose cells are AMBIGUOUS (values Python would coerce
+// differently than the strict C parsers below: whitespace-padded numbers,
+// "inf"/"nan", underscore digits, >2^53 ints, escaped quotes, JSON string
+// escapes, non-canonical number text...).  Ambiguity is a per-call flag:
+// correctness never depends on the fast path guessing.
+//
+// Layout contracts (all little-endian host):
+//   starts/lens: int32 arrays of shape [ncols_needed][max_rows] (row-major
+//   per column).  lens[r] == -1 => column missing in that row (null);
+//   lens[r] == -2 => cell needs Python unquoting (contains doubled quote).
+//   Otherwise [start, start+len) are the cell's logical bytes in buf
+//   (surrounding CSV quotes stripped; trailing \r before \n stripped).
+//
+// Exposed via ctypes (see minio_tpu/select/native.py).
+
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+#include <cstdlib>
+
+#if defined(__SSE2__)
+#include <emmintrin.h>
+#endif
+#if defined(__AVX2__)
+#include <immintrin.h>
+#endif
+
+extern "C" {
+
+// ------------------------------------------------------------------ utils
+
+// Find next byte equal to a or b in [p, end); returns end if none.
+static inline const char *scan2(const char *p, const char *end,
+                                char a, char b) {
+#if defined(__SSE2__)
+    const __m128i va = _mm_set1_epi8(a);
+    const __m128i vb = _mm_set1_epi8(b);
+    while (p + 16 <= end) {
+        __m128i x = _mm_loadu_si128(reinterpret_cast<const __m128i *>(p));
+        int m = _mm_movemask_epi8(
+            _mm_or_si128(_mm_cmpeq_epi8(x, va), _mm_cmpeq_epi8(x, vb)));
+        if (m)
+            return p + __builtin_ctz(m);
+        p += 16;
+    }
+#endif
+    while (p < end && *p != a && *p != b)
+        ++p;
+    return p;
+}
+
+// Strict numeric parse matching the canonical subset of Python
+// int()/float(): [+-]? (D+ | D+.D* | .D+) ([eE][+-]?D+)?
+// Returns 1 and *out on success; 0 otherwise.  Cells with more than 15
+// significant digits report failure (the caller treats them as
+// ambiguous — Python compares big ints exactly, double cannot).
+//
+// Fast path: mantissa accumulated as uint64 (exact for <= 15 digits)
+// scaled by an exact power of ten — one rounding, identical to strtod
+// in this range (the classic Gay fast path).  Exponents outside |22|
+// fall back to strtod for correct rounding.
+static const double POW10[] = {
+    1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,  1e8,  1e9,  1e10,
+    1e11, 1e12, 1e13, 1e14, 1e15, 1e16, 1e17, 1e18, 1e19, 1e20, 1e21,
+    1e22};
+
+// SWAR 8-digit block evaluator (Lemire): `raw` holds eight ASCII digits
+// in memory order (first digit in the lowest byte).
+static inline int all_digits8(uint64_t v) {
+    return (((v & 0xF0F0F0F0F0F0F0F0ULL) |
+             (((v + 0x0606060606060606ULL) & 0xF0F0F0F0F0F0F0F0ULL) >>
+              4)) == 0x3333333333333333ULL);
+}
+
+static inline uint32_t eval8(uint64_t val) {
+    const uint64_t mask = 0x000000FF000000FFULL;
+    const uint64_t mul1 = 0x000F424000000064ULL;  // 100 + (1000000 << 32)
+    const uint64_t mul2 = 0x0000271000000001ULL;  // 1 + (10000 << 32)
+    val -= 0x3030303030303030ULL;
+    val = (val * 10) + (val >> 8);
+    val = (((val & mask) * mul1) + (((val >> 16) & mask) * mul2)) >> 32;
+    return (uint32_t)val;
+}
+
+// op truth table over the 3-way compare c in {-1,0,1}: bit (c+1) of
+// OPMASK[op].  ops: 0 '=', 1 '!=', 2 '<', 3 '<=', 4 '>', 5 '>='
+static const int OPMASK[6] = {2, 5, 1, 3, 4, 6};
+
+// Fast path for pure-integer cells of <= 8 digits.  REQUIRES 8 readable
+// bytes at s (the Python driver pads every block with 8 slack bytes).
+__attribute__((always_inline))
+static inline int parse_int8_swar(const char *s, int32_t n, double *out) {
+    uint64_t raw;
+    memcpy(&raw, s, 8);
+    if (n < 8)
+        raw = (raw << ((8 - n) * 8)) |
+              (0x3030303030303030ULL >> (n * 8));
+    if (!all_digits8(raw))
+        return 0;
+    *out = (double)eval8(raw);
+    return 1;
+}
+
+static inline int parse_num(const char *s, int32_t n, double *out) {
+    if (n <= 0 || n >= 63)
+        return 0;
+    if (n <= 8 && parse_int8_swar(s, n, out))
+        return 1;
+    const char *p = s, *end = s + n;
+    int neg = 0;
+    if (*p == '+' || *p == '-') {
+        neg = (*p == '-');
+        ++p;
+    }
+    uint64_t mant = 0;
+    int digits = 0;
+    while (p < end && (unsigned char)(*p - '0') <= 9) {
+        mant = mant * 10 + (unsigned char)(*p - '0');
+        ++digits;
+        ++p;
+    }
+    int total = digits;
+    int exp10 = 0;
+    if (p < end && *p == '.') {
+        ++p;
+        const char *fs = p;
+        while (p < end && (unsigned char)(*p - '0') <= 9) {
+            mant = mant * 10 + (unsigned char)(*p - '0');
+            ++p;
+        }
+        int fd = (int)(p - fs);
+        total += fd;
+        exp10 -= fd;
+    }
+    if (total == 0)
+        return 0;
+    if (total > 15)
+        return 0;  // exact-int / long-mantissa territory: Python decides
+    if (p < end && (*p == 'e' || *p == 'E')) {
+        ++p;
+        int eneg = 0;
+        if (p < end && (*p == '+' || *p == '-')) {
+            eneg = (*p == '-');
+            ++p;
+        }
+        int ed = 0, ev = 0;
+        while (p < end && (unsigned char)(*p - '0') <= 9) {
+            ev = ev * 10 + (*p - '0');
+            if (ev > 400)
+                ev = 400;
+            ++ed;
+            ++p;
+        }
+        if (!ed)
+            return 0;
+        exp10 += eneg ? -ev : ev;
+    }
+    if (p != end)
+        return 0;
+    double v;
+    if (exp10 == 0) {
+        v = (double)mant;
+    } else if (exp10 > 0 && exp10 <= 22) {
+        v = (double)mant * POW10[exp10];
+    } else if (exp10 < 0 && exp10 >= -22) {
+        v = (double)mant / POW10[-exp10];
+    } else {
+        // rare huge/tiny exponent: strtod for correct rounding
+        char tmp[64];
+        memcpy(tmp, s, n);
+        tmp[n] = 0;
+        char *ep = nullptr;
+        v = strtod(tmp, &ep);
+        if (ep != tmp + n)
+            return 0;
+        *out = v;  // strtod consumed the sign itself
+        return 1;
+    }
+    *out = neg ? -v : v;
+    return 1;
+}
+
+// Would Python's int()/float() possibly accept (or differently coerce)
+// this cell even though parse_num rejected it?  Conservative: any cell
+// starting with whitespace/sign/digit/dot/underscore/'i'/'n' (inf/nan)
+// or a non-ASCII byte (unicode digits/whitespace), or ending with
+// whitespace, is AMBIGUOUS and forces the block onto the Python path.
+static int num_ambiguous(const char *s, int32_t n) {
+    if (n <= 0)
+        return 0;  // empty: Python rejects too => clean text
+    unsigned char c0 = (unsigned char)s[0];
+    unsigned char cl = (unsigned char)s[n - 1];
+    if (c0 >= 0x80 || cl >= 0x80)
+        return 1;
+    if (c0 == ' ' || c0 == '\t' || cl == ' ' || cl == '\t')
+        return 1;
+    if (c0 == '+' || c0 == '-' || c0 == '.' || c0 == '_')
+        return 1;
+    if (c0 >= '0' && c0 <= '9')
+        return 1;
+    if (c0 == 'i' || c0 == 'I' || c0 == 'n' || c0 == 'N')
+        return 1;
+    return 0;
+}
+
+// UTF-8 aware LIKE matcher ('%' = any run, '_' = one codepoint).
+// Pattern arrives pre-processed by Python: escape characters resolved
+// into a literal-mask byte array (1 = literal byte, 0 = wildcard role).
+static int utf8_next(const char *s, int i, int n) {
+    ++i;
+    while (i < n && ((unsigned char)s[i] & 0xC0) == 0x80)
+        ++i;
+    return i;
+}
+
+static int like_match(const char *s, int sn, const char *pat, int pn,
+                      const unsigned char *lit) {
+    // iterative glob with single-% backtracking (classic algorithm)
+    int si = 0, pi = 0, star_p = -1, star_s = -1;
+    while (si < sn) {
+        if (pi < pn && !lit[pi] && pat[pi] == '%') {
+            star_p = ++pi;
+            star_s = si;
+            continue;
+        }
+        if (pi < pn && !lit[pi] && pat[pi] == '_') {
+            si = utf8_next(s, si, sn);
+            ++pi;
+            continue;
+        }
+        if (pi < pn && pat[pi] == s[si] &&
+            (lit[pi] || (pat[pi] != '%' && pat[pi] != '_'))) {
+            ++si;
+            ++pi;
+            continue;
+        }
+        if (star_p >= 0) {
+            star_s = utf8_next(s, star_s, sn);
+            si = star_s;
+            pi = star_p;
+            continue;
+        }
+        return 0;
+    }
+    while (pi < pn && !lit[pi] && pat[pi] == '%')
+        ++pi;
+    return pi == pn;
+}
+
+// -------------------------------------------------------------- CSV scan
+
+// Quote-free fast scan: one linear SIMD pass extracting separator
+// positions, constant work per separator.  Preconditions (checked by
+// the caller): no quote byte anywhere in [buf, len).
+static int64_t csv_scan_fast(const char *buf, int64_t len, char delim,
+                             int final_block, const int32_t *col_idx,
+                             int32_t ncols, int64_t max_rows,
+                             int32_t *starts, int32_t *lens,
+                             int32_t *row_start, int64_t *consumed) {
+    int64_t row = 0;
+    int32_t field = 0, k = 0;
+    int64_t field_start = 0, row_begin = 0;
+    int overflow = 0;
+    const int32_t col0 = col_idx[0];
+    const int single = (ncols == 1);
+    for (int32_t c = 0; c < ncols; ++c)
+        lens[(int64_t)c * max_rows] = -1;
+
+    // handle() -> 0 normal, 1 stop (max_rows), 2 all needed cells of
+    // this row captured (caller may skip remaining delimiters until the
+    // next newline — a large win for wide rows)
+    auto handle = [&](int64_t pos, int is_nl)
+        __attribute__((always_inline)) {
+        int captured = 0;
+        if (single ? (field == col0)
+                   : (k < ncols && col_idx[k] == field)) {
+            int64_t ce = pos;
+            if (is_nl && ce > field_start && buf[ce - 1] == '\r')
+                --ce;
+            starts[(int64_t)k * max_rows + row] = (int32_t)field_start;
+            lens[(int64_t)k * max_rows + row] = (int32_t)(ce - field_start);
+            ++k;
+            captured = (k == ncols);
+        }
+        field_start = pos + 1;
+        if (is_nl) {
+            int64_t rl = pos - row_begin;
+            if (rl == 0 || (rl == 1 && buf[row_begin] == '\r')) {
+                // blank record: csv.reader (the row engine) skips it
+                for (int32_t cc = 0; cc < k; ++cc)
+                    lens[(int64_t)cc * max_rows + row] = -1;
+                row_begin = pos + 1;
+                field = 0;
+                k = 0;
+                return 0;
+            }
+            if (row_start)
+                row_start[row] = (int32_t)row_begin;
+            ++row;
+            row_begin = pos + 1;  // consumed covers every counted row
+            if (row >= max_rows) {
+                overflow = 1;
+                return 1;
+            }
+            for (int32_t cc = 0; cc < ncols; ++cc)
+                lens[(int64_t)cc * max_rows + row] = -1;
+            field = 0;
+            k = 0;
+            return 0;
+        }
+        ++field;
+        return captured ? 2 : 0;
+    };
+
+    int64_t i = 0;
+#if defined(__AVX2__)
+    const __m256i vd = _mm256_set1_epi8(delim);
+    const __m256i vn = _mm256_set1_epi8('\n');
+    int skipping = 0;  // row's needed cells done: only newlines matter
+    while (i + 32 <= len && !overflow) {
+        __m256i x = _mm256_loadu_si256(
+            reinterpret_cast<const __m256i *>(buf + i));
+        uint32_t mn = (uint32_t)_mm256_movemask_epi8(
+            _mm256_cmpeq_epi8(x, vn));
+        if (skipping && mn == 0) {
+            i += 32;  // whole chunk is mid-row noise
+            continue;
+        }
+        uint32_t m = (uint32_t)_mm256_movemask_epi8(
+            _mm256_cmpeq_epi8(x, vd)) | mn;
+        if (skipping) {
+            m &= ~(((uint32_t)1 << __builtin_ctz(mn)) - 1);
+            skipping = 0;
+        }
+        while (m) {
+            int b = __builtin_ctz(m);
+            m &= m - 1;
+            int rc = handle(i + b, (mn >> b) & 1);
+            if (rc == 1)
+                break;
+            if (rc == 2) {
+                // drop delimiter bits until the next newline
+                uint32_t nn = mn & m;
+                if (nn) {
+                    m &= ~(((uint32_t)1 << __builtin_ctz(nn)) - 1);
+                } else {
+                    m = 0;
+                    skipping = 1;
+                }
+                // field counting is moot while skipping: fields between
+                // here and the newline are never needed (k == ncols)
+            }
+        }
+        i += 32;
+    }
+    if (skipping) {
+        // resume the scalar tail at the next newline
+        const char *nlp = static_cast<const char *>(
+            memchr(buf + i, '\n', len - i));
+        i = nlp ? (nlp - buf) : len;
+    }
+#endif
+    while (i < len && !overflow) {
+        char c = buf[i];
+        if (c == delim || c == '\n') {
+            int rc = handle(i, c == '\n');
+            if (rc == 1)
+                break;
+            if (rc == 2) {
+                const char *nlp = static_cast<const char *>(
+                    memchr(buf + i + 1, '\n', len - i - 1));
+                if (nlp == nullptr) {
+                    i = len;
+                    break;
+                }
+                i = nlp - buf;
+                continue;  // process the newline next iteration
+            }
+        }
+        ++i;
+    }
+    if (overflow) {
+        *consumed = row_begin;
+        if (row_start)
+            row_start[row] = (int32_t)row_begin;
+        return row;  // complete rows so far; caller re-feeds the rest
+    }
+    *consumed = row_begin;
+    if (final_block && row_begin < len) {
+        int64_t rl = len - row_begin;
+        if (rl == 0 || (rl == 1 && buf[row_begin] == '\r')) {
+            *consumed = len;  // trailing blank: consumed, no record
+        } else if (row < max_rows) {
+            // trailing record without newline
+            if (k < ncols && col_idx[k] == field) {
+                starts[(int64_t)k * max_rows + row] =
+                    (int32_t)field_start;
+                lens[(int64_t)k * max_rows + row] =
+                    (int32_t)(len - field_start);
+            }
+            if (row_start)
+                row_start[row] = (int32_t)row_begin;
+            ++row;
+            *consumed = len;
+        }
+    }
+    if (row_start)
+        row_start[row] = (int32_t)(*consumed);
+    return row;
+}
+
+// Structural scan of one block.  Returns the number of complete rows
+// scanned (possibly fewer than the block holds when max_rows is hit —
+// *consumed tells the caller where to resume), or -2 on an unterminated
+// quote in the final block.
+// *consumed = bytes of buf covered by the returned records.
+int64_t sel_csv_scan(const char *buf, int64_t len, char delim, char quote,
+                     int final_block,
+                     const int32_t *col_idx, int32_t ncols,
+                     int64_t max_rows,
+                     int32_t *starts, int32_t *lens,
+                     int32_t *row_start, int64_t *consumed) {
+    if (memchr(buf, quote, len) == nullptr)
+        return csv_scan_fast(buf, len, delim, final_block, col_idx, ncols,
+                             max_rows, starts, lens, row_start, consumed);
+    const char *p = buf, *end = buf + len;
+    int64_t row = 0;
+    *consumed = 0;
+    while (p < end) {
+        if (row >= max_rows)
+            break;
+        const char *rec = p;
+        int32_t field = 0, k = 0;
+        // pre-fill this row's needed columns as missing
+        for (int32_t c = 0; c < ncols; ++c)
+            lens[(int64_t)c * max_rows + row] = -1;
+        int done_row = 0;
+        while (!done_row) {
+            int32_t cs, ce;  // logical cell extent
+            int esc = 0;
+            if (p < end && *p == quote) {
+                ++p;
+                const char *q = p;
+                for (;;) {
+                    const char *h = static_cast<const char *>(
+                        memchr(q, quote, end - q));
+                    if (!h) {
+                        if (final_block)
+                            return -2;  // unterminated quote
+                        goto incomplete;
+                    }
+                    if (h + 1 < end && h[1] == quote) {
+                        esc = 1;
+                        q = h + 2;
+                        continue;
+                    }
+                    if (h + 1 == end && !final_block)
+                        goto incomplete;  // closing vs doubled: unknown
+                    cs = (int32_t)(p - buf);
+                    ce = (int32_t)(h - buf);
+                    p = h + 1;
+                    break;
+                }
+                // after closing quote: delimiter, newline, or EOF
+                if (p < end && *p != delim && *p != '\n' && *p != '\r') {
+                    // junk after quote: treat rest as part of the cell
+                    const char *j = scan2(p, end, delim, '\n');
+                    if (j == end && !final_block)
+                        goto incomplete;
+                    ce = (int32_t)(j - buf);
+                    esc = 1;  // Python csv semantics differ: defer
+                    p = j;
+                }
+            } else {
+                const char *st = p;
+                const char *j = scan2(p, end, delim, '\n');
+                if (j == end && !final_block)
+                    goto incomplete;
+                cs = (int32_t)(st - buf);
+                ce = (int32_t)(j - buf);
+                if (ce > cs && buf[ce - 1] == '\r' &&
+                    (j < end && *j == '\n'))
+                    --ce;  // \r\n record delimiter
+                p = j;
+            }
+            if (k < ncols && col_idx[k] == field) {
+                starts[(int64_t)k * max_rows + row] = cs;
+                lens[(int64_t)k * max_rows + row] =
+                    esc ? -2 : (ce - cs);
+                ++k;
+            }
+            ++field;
+            if (p >= end) {
+                if (!final_block)
+                    goto incomplete;
+                done_row = 1;  // final record without trailing newline
+            } else if (*p == '\n') {
+                ++p;
+                done_row = 1;
+            } else {
+                ++p;  // delimiter
+            }
+        }
+        {
+            // blank record (empty line, or lone \r): csv.reader skips
+            const char *rend = p;
+            if (rend > rec && rend[-1] == '\n')
+                --rend;
+            int64_t rl = rend - rec;
+            if (rl == 0 || (rl == 1 && *rec == '\r')) {
+                for (int32_t cc = 0; cc < k; ++cc)
+                    lens[(int64_t)cc * max_rows + row] = -1;
+                *consumed = p - buf;
+                continue;
+            }
+        }
+        row_start[row] = (int32_t)(rec - buf);
+        ++row;
+        *consumed = p - buf;
+        continue;
+    incomplete:
+        break;
+    }
+    row_start[row] = (int32_t)(*consumed);
+    return row;
+}
+
+// --------------------------------------------------------- row emission
+
+// Copy matched rows (verbatim, including their newline) into outbuf.
+// Used for `SELECT * ... WHERE` over quote-free CSV when the output
+// serialization matches the input (records pass through byte-exact).
+// limit < 0 means unlimited.  Returns rows emitted; *out_len = bytes.
+int64_t sel_emit_rows(const char *buf, const int32_t *row_start,
+                      int64_t nrows, const uint8_t *mask, int64_t limit,
+                      char *outbuf, int64_t *out_len) {
+    int64_t n = 0, o = 0;
+    for (int64_t r = 0; r < nrows; ++r) {
+        if (mask && !mask[r])
+            continue;
+        if (limit >= 0 && n >= limit)
+            break;
+        int32_t a = row_start[r], b = row_start[r + 1];
+        memcpy(outbuf + o, buf + a, b - a);
+        o += b - a;
+        if (b > a && outbuf[o - 1] != '\n')
+            outbuf[o++] = '\n';  // final record without trailing newline
+        ++n;
+    }
+    *out_len = o;
+    return n;
+}
+
+// Comparison ops: 0 '=', 1 '!=', 2 '<', 3 '<=', 4 '>', 5 '>='
+static inline int cmp_ok(int op, int c) {
+    switch (op) {
+    case 0: return c == 0;
+    case 1: return c != 0;
+    case 2: return c < 0;
+    case 3: return c <= 0;
+    case 4: return c > 0;
+    case 5: return c >= 0;
+    }
+    return 0;
+}
+
+static inline int bytes_cmp(const char *a, int32_t an,
+                            const char *b, int32_t bn) {
+    int32_t n = an < bn ? an : bn;
+    int c = n ? memcmp(a, b, n) : 0;
+    if (c)
+        return c < 0 ? -1 : 1;
+    return an < bn ? -1 : (an > bn ? 1 : 0);
+}
+
+// Numeric-literal comparison leaf: cells that parse numerically compare
+// against num_lit; everything else (including empty) compares textually
+// against str_lit, replicating sql._cmp_pair.  Returns count of
+// AMBIGUOUS cells (0 => mask is exact).
+int64_t sel_cmp_num(const char *buf, const int32_t *starts,
+                    const int32_t *lens, int64_t n, int op,
+                    double num_lit, const char *str_lit, int32_t str_len,
+                    uint8_t *mask) {
+    int64_t amb = 0;
+    const int opmask = OPMASK[op];
+    for (int64_t i = 0; i < n; ++i) {
+        int32_t l = lens[i];
+        const char *s = buf + starts[i];
+        double v;
+        // hot path: short pure-digit cell, fully inlined SWAR
+        if ((uint32_t)(l - 1) < 8u && parse_int8_swar(s, l, &v)) {
+            int c = (v > num_lit) - (v < num_lit);
+            mask[i] = (uint8_t)((opmask >> (c + 1)) & 1);
+            continue;
+        }
+        if (l < 0) {
+            mask[i] = 0;  // null (or needs-unquote: caller pre-screens)
+            if (l == -2)
+                ++amb;
+            continue;
+        }
+        if (parse_num(s, l, &v)) {
+            int c = (v > num_lit) - (v < num_lit);
+            mask[i] = (uint8_t)((opmask >> (c + 1)) & 1);
+        } else if (num_ambiguous(s, l)) {
+            mask[i] = 0;
+            ++amb;
+        } else {
+            mask[i] = (uint8_t)cmp_ok(op, bytes_cmp(s, l, str_lit,
+                                                    str_len));
+        }
+    }
+    return amb;
+}
+
+// Text-literal comparison leaf: pure byte compare (UTF-8 order == code
+// point order).  Cells are never ambiguous here except -2 (unquote).
+int64_t sel_cmp_str(const char *buf, const int32_t *starts,
+                    const int32_t *lens, int64_t n, int op,
+                    const char *lit, int32_t lit_len, uint8_t *mask) {
+    int64_t amb = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        int32_t l = lens[i];
+        if (l < 0) {
+            mask[i] = 0;
+            if (l == -2)
+                ++amb;
+            continue;
+        }
+        mask[i] = (uint8_t)cmp_ok(op, bytes_cmp(buf + starts[i], l,
+                                                lit, lit_len));
+    }
+    return amb;
+}
+
+// LIKE leaf.  negate handled by the Python driver (needs the valid
+// mask).  lit[] marks pattern bytes that are literals (escape-resolved).
+int64_t sel_like(const char *buf, const int32_t *starts,
+                 const int32_t *lens, int64_t n,
+                 const char *pat, int32_t pat_len,
+                 const unsigned char *lit, uint8_t *mask) {
+    int64_t amb = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        int32_t l = lens[i];
+        if (l < 0) {
+            mask[i] = 0;
+            if (l == -2)
+                ++amb;
+            continue;
+        }
+        mask[i] = (uint8_t)like_match(buf + starts[i], l, pat, pat_len,
+                                      lit);
+    }
+    return amb;
+}
+
+// Validity mask: 1 where the cell exists (len >= 0).  -2 counts as
+// existing but ambiguous.
+void sel_valid(const int32_t *lens, int64_t n, uint8_t *mask) {
+    for (int64_t i = 0; i < n; ++i)
+        mask[i] = lens[i] >= 0 || lens[i] == -2;
+}
+
+// IS NULL mask: missing column or empty text (row engine: None or "").
+void sel_isnull(const int32_t *lens, int64_t n, uint8_t *mask) {
+    for (int64_t i = 0; i < n; ++i)
+        mask[i] = lens[i] == -1 || lens[i] == 0;
+}
+
+// Aggregate fold over one column under an optional row mask.
+// agg op: 0 COUNT, 1 SUM/AVG, 2 MIN/MAX (tracks argmin/argmax).
+// Returns count of cells folded; *amb counts ambiguous cells (caller
+// re-runs the block in Python when nonzero).  For SUM a non-numeric
+// non-empty cell is ambiguous (the row engine raises SQLError — the
+// Python replay reproduces that exactly).
+int64_t sel_agg(const char *buf, const int32_t *starts,
+                const int32_t *lens, int64_t n, const uint8_t *mask,
+                int what, double *sum, double *minv, double *maxv,
+                int64_t *argmin, int64_t *argmax, int64_t *amb) {
+    int64_t cnt = 0;
+    *amb = 0;
+    double s = 0.0;
+    double lo = 0.0, hi = 0.0;
+    int64_t ilo = -1, ihi = -1;
+    for (int64_t i = 0; i < n; ++i) {
+        if (mask && !mask[i])
+            continue;
+        int32_t l = lens[i];
+        if (l == -1 || l == 0)
+            continue;  // null/empty: skipped by accumulate
+        if (l == -2) {
+            ++*amb;
+            continue;
+        }
+        if (what == 0) {
+            ++cnt;
+            continue;
+        }
+        double v;
+        if (!parse_num(buf + starts[i], l, &v)) {
+            ++*amb;  // SUM raises / MIN-MAX mixes text: Python decides
+            continue;
+        }
+        ++cnt;
+        if (what == 1) {
+            s += v;
+        } else {
+            if (ilo < 0 || v < lo) {
+                lo = v;
+                ilo = i;
+            }
+            if (ihi < 0 || v > hi) {
+                hi = v;
+                ihi = i;
+            }
+        }
+    }
+    *sum = s;
+    *minv = lo;
+    *maxv = hi;
+    *argmin = ilo;
+    *argmax = ihi;
+    return cnt;
+}
+
+// ------------------------------------------------------------ NDJSON scan
+
+// Per-line top-level key extraction.  For each needed key the scanner
+// records the value extent and a type code:
+//   0 missing, 1 null, 2 false, 3 true, 4 number, 5 string (no escapes,
+//   extent = inner bytes), 6 ambiguous (string w/ escapes, nested
+//   object/array, any parse doubt)
+// A line that cannot be cleanly parsed sets every needed key on that
+// row to 6 — the Python driver re-evaluates such rows exactly (and the
+// row engine raises on truly invalid JSON, preserving error semantics).
+
+static inline const char *skip_ws(const char *q, const char *le) {
+    while (q < le && (*q == ' ' || *q == '\t' || *q == '\r'))
+        ++q;
+    return q;
+}
+
+// SWAR single-byte finder: cheaper than a memchr call for the short
+// hops typical of compact JSON (keys and values of a few bytes).
+// Returns le when absent.
+__attribute__((always_inline))
+static inline const char *find_byte(const char *p, const char *le,
+                                    char c) {
+    const uint64_t pat = 0x0101010101010101ULL * (unsigned char)c;
+    while (p + 8 <= le) {
+        uint64_t x;
+        memcpy(&x, p, 8);
+        uint64_t v = x ^ pat;
+        uint64_t hit = (v - 0x0101010101010101ULL) & ~v &
+                       0x8080808080808080ULL;
+        if (hit)
+            return p + (__builtin_ctzll(hit) >> 3);
+        p += 8;
+    }
+    while (p < le && *p != c)
+        ++p;
+    return p;
+}
+
+// Fast parse of one line KNOWN to contain no backslash: every '"' is a
+// real string boundary.  Returns 0 on clean parse, 1 when the line
+// needs the slow machine (or is invalid).
+static int json_line_fast(const char *buf, const char *ls, const char *le,
+                          const char *const *keys, const int32_t *key_lens,
+                          int32_t nkeys, int64_t max_rows, int64_t row,
+                          int32_t *starts, int32_t *lens, uint8_t *types) {
+    const char *q = ls;
+    if (*q != '{')
+        return 1;
+    q = skip_ws(q + 1, le);
+    if (q < le && *q == '}')
+        return skip_ws(q + 1, le) == le ? 0 : 1;
+    for (;;) {
+        if (q >= le || *q != '"')
+            return 1;
+        const char *ks = q + 1;
+        const char *kq = find_byte(ks, le, '"');
+        if (kq == le)
+            return 1;
+        int32_t klen = (int32_t)(kq - ks);
+        q = skip_ws(kq + 1, le);
+        if (q >= le || *q != ':')
+            return 1;
+        q = skip_ws(q + 1, le);
+        if (q >= le)
+            return 1;
+        int ki = -1;
+        for (int32_t k = 0; k < nkeys; ++k)
+            if (key_lens[k] == klen &&
+                (klen == 0 || (keys[k][0] == ks[0] &&
+                               memcmp(keys[k], ks, klen) == 0))) {
+                ki = k;
+                break;
+            }
+        uint8_t vt;
+        int32_t vs = (int32_t)(q - buf), vl;
+        char v0 = *q;
+        if (v0 == '"') {
+            const char *ss = q + 1;
+            const char *sq = find_byte(ss, le, '"');
+            if (sq == le)
+                return 1;
+            vt = 5;
+            vs = (int32_t)(ss - buf);
+            vl = (int32_t)(sq - ss);
+            q = sq + 1;
+        } else if (v0 == '{' || v0 == '[') {
+            int d = 0;
+            const char *z = q;
+            while (z < le) {
+                char c = *z;
+                if (c == '"') {
+                    const char *t = static_cast<const char *>(
+                        memchr(z + 1, '"', le - z - 1));
+                    if (!t)
+                        return 1;
+                    z = t + 1;
+                    continue;
+                }
+                if (c == '{' || c == '[') {
+                    ++d;
+                } else if (c == '}' || c == ']') {
+                    --d;
+                    if (d == 0) {
+                        ++z;
+                        break;
+                    }
+                }
+                ++z;
+            }
+            if (d != 0)
+                return 1;
+            vt = 6;  // nested value: Python semantics if needed
+            vl = (int32_t)(z - q);
+            q = z;
+        } else if (v0 == 't') {
+            if (le - q < 4 || memcmp(q, "true", 4) != 0)
+                return 1;
+            vt = 3;
+            vl = 4;
+            q += 4;
+        } else if (v0 == 'f') {
+            if (le - q < 5 || memcmp(q, "false", 5) != 0)
+                return 1;
+            vt = 2;
+            vl = 5;
+            q += 5;
+        } else if (v0 == 'n') {
+            if (le - q < 4 || memcmp(q, "null", 4) != 0)
+                return 1;
+            vt = 1;
+            vl = 4;
+            q += 4;
+        } else {
+            const char *z = q;
+            while (z < le && *z != ',' && *z != '}' && *z != ' ' &&
+                   *z != '\t' && *z != '\r')
+                ++z;
+            vl = (int32_t)(z - q);
+            double dummy;
+            if (!parse_num(q, vl, &dummy))
+                return 1;  // big ints / garbage: slow machine decides
+            vt = 4;
+            q = z;
+        }
+        if (ki >= 0) {  // last occurrence wins (json.loads semantics)
+            starts[(int64_t)ki * max_rows + row] = vs;
+            lens[(int64_t)ki * max_rows + row] = vl;
+            types[(int64_t)ki * max_rows + row] = vt;
+        }
+        q = skip_ws(q, le);
+        if (q < le && *q == ',') {
+            q = skip_ws(q + 1, le);
+            continue;
+        }
+        if (q < le && *q == '}') {
+            q = skip_ws(q + 1, le);
+            return q == le ? 0 : 1;
+        }
+        return 1;
+    }
+}
+
+// Slow per-line machine: handles escapes; anything it cannot cleanly
+// type marks the row ambiguous (types = 6 across the board).
+static void json_line_slow(const char *buf, const char *ls, const char *le,
+                           const char *const *keys, const int32_t *key_lens,
+                           int32_t nkeys, int64_t max_rows, int64_t row,
+                           int32_t *starts, int32_t *lens, uint8_t *types) {
+    int bad = 0;
+    const char *q = ls;
+    if (*q != '{') {
+        bad = 1;  // non-object line (array/scalar): row engine wraps
+    } else {
+        ++q;
+        int depth = 1;
+        while (q < le && depth > 0 && !bad) {
+            char c = *q;
+            if (c == ' ' || c == '\t' || c == '\r') {
+                ++q;
+                continue;
+            }
+            if (c == '}') {
+                --depth;
+                ++q;
+                continue;
+            }
+            if (c != '"') {
+                bad = 1;
+                break;
+            }
+            // key string
+            const char *ks = q + 1;
+            const char *kq = ks;
+            int kesc = 0;
+            for (;;) {
+                const char *h = static_cast<const char *>(
+                    memchr(kq, '"', le - kq));
+                if (!h) {
+                    bad = 1;
+                    break;
+                }
+                int bs = 0;
+                const char *t = h - 1;
+                while (t >= ks && *t == '\\') {
+                    ++bs;
+                    --t;
+                }
+                if (bs % 2) {
+                    kesc = 1;
+                    kq = h + 1;
+                    continue;
+                }
+                kq = h;
+                break;
+            }
+            if (bad)
+                break;
+            if (kesc) {
+                bad = 1;  // escaped key text: let Python decide
+                break;
+            }
+            int32_t klen = (int32_t)(kq - ks);
+            q = skip_ws(kq + 1, le);
+            if (q >= le || *q != ':') {
+                bad = 1;
+                break;
+            }
+            q = skip_ws(q + 1, le);
+            if (q >= le) {
+                bad = 1;
+                break;
+            }
+            int ki = -1;
+            for (int32_t k = 0; k < nkeys; ++k)
+                if (key_lens[k] == klen &&
+                    memcmp(keys[k], ks, klen) == 0) {
+                    ki = k;
+                    break;
+                }
+            uint8_t vt = 6;
+            int32_t vs = (int32_t)(q - buf), vl = 0;
+            char v0 = *q;
+            if (v0 == '"') {
+                const char *ss = q + 1;
+                const char *sq = ss;
+                int sesc = 0;
+                for (;;) {
+                    const char *h = static_cast<const char *>(
+                        memchr(sq, '"', le - sq));
+                    if (!h) {
+                        bad = 1;
+                        break;
+                    }
+                    int bs = 0;
+                    const char *t = h - 1;
+                    while (t >= ss && *t == '\\') {
+                        ++bs;
+                        --t;
+                    }
+                    if (bs % 2) {
+                        sesc = 1;
+                        sq = h + 1;
+                        continue;
+                    }
+                    sq = h;
+                    break;
+                }
+                if (bad)
+                    break;
+                vt = sesc ? 6 : 5;
+                vs = (int32_t)(ss - buf);
+                vl = (int32_t)(sq - ss);
+                q = sq + 1;
+            } else if (v0 == '{' || v0 == '[') {
+                int d2 = 0;
+                int instr = 0;
+                const char *z = q;
+                while (z < le) {
+                    char c2 = *z;
+                    if (instr) {
+                        if (c2 == '\\') {
+                            z += 2;
+                            continue;
+                        }
+                        if (c2 == '"')
+                            instr = 0;
+                    } else if (c2 == '"') {
+                        instr = 1;
+                    } else if (c2 == '{' || c2 == '[') {
+                        ++d2;
+                    } else if (c2 == '}' || c2 == ']') {
+                        --d2;
+                        if (d2 == 0) {
+                            ++z;
+                            break;
+                        }
+                    }
+                    ++z;
+                }
+                if (d2 != 0) {
+                    bad = 1;
+                    break;
+                }
+                vt = 6;  // nested: Python semantics
+                vs = (int32_t)(q - buf);
+                vl = (int32_t)(z - q);
+                q = z;
+            } else if (v0 == 't' && le - q >= 4 &&
+                       memcmp(q, "true", 4) == 0) {
+                vt = 3;
+                vl = 4;
+                q += 4;
+            } else if (v0 == 'f' && le - q >= 5 &&
+                       memcmp(q, "false", 5) == 0) {
+                vt = 2;
+                vl = 5;
+                q += 5;
+            } else if (v0 == 'n' && le - q >= 4 &&
+                       memcmp(q, "null", 4) == 0) {
+                vt = 1;
+                vl = 4;
+                q += 4;
+            } else {
+                const char *z = q;
+                while (z < le && *z != ',' && *z != '}' && *z != ' ' &&
+                       *z != '\t' && *z != '\r')
+                    ++z;
+                double dummy;
+                vl = (int32_t)(z - q);
+                if (!parse_num(q, vl, &dummy)) {
+                    // invalid bare token OR >15-digit int: the row
+                    // engine either raises or parses exactly — replay
+                    bad = 1;
+                    break;
+                }
+                vt = 4;
+                q = z;
+            }
+            if (ki >= 0) {
+                starts[(int64_t)ki * max_rows + row] = vs;
+                lens[(int64_t)ki * max_rows + row] = vl;
+                types[(int64_t)ki * max_rows + row] = vt;
+            }
+            q = skip_ws(q, le);
+            if (q < le && *q == ',') {
+                ++q;
+                continue;
+            }
+            if (q < le && *q == '}') {
+                --depth;
+                ++q;
+                continue;
+            }
+            bad = 1;
+            break;
+        }
+        if (depth != 0)
+            bad = 1;
+        if (skip_ws(q, le) != le)
+            bad = 1;  // trailing junk after the closing brace
+    }
+    if (bad)
+        for (int32_t k = 0; k < nkeys; ++k)
+            types[(int64_t)k * max_rows + row] = 6;
+}
+
+// Returns rows scanned (complete lines; may stop early at max_rows with
+// *consumed marking the resume point).  Blank lines are skipped (row
+// engine skips them too).
+int64_t sel_json_scan(const char *buf, int64_t len, int final_block,
+                      const char *const *keys, const int32_t *key_lens,
+                      int32_t nkeys, int64_t max_rows,
+                      int32_t *starts, int32_t *lens, uint8_t *types,
+                      int32_t *row_start, int32_t *row_len,
+                      int64_t *consumed) {
+    const char *p = buf, *end = buf + len;
+    int64_t row = 0;
+    *consumed = 0;
+    // one block-level probe: no backslash anywhere => every line takes
+    // the memchr-driven fast parser without per-line escape checks
+    const int bs_block = memchr(buf, '\\', len) != nullptr;
+    while (p < end) {
+        const char *nlp = find_byte(p, end, '\n');
+        const char *nl = (nlp == end) ? nullptr : nlp;
+        const char *line_end;
+        if (nl == nullptr) {
+            if (!final_block)
+                break;  // incomplete trailing line
+            line_end = end;
+        } else {
+            line_end = nl;
+        }
+        const char *ls = p, *le = line_end;
+        while (ls < le && (*ls == ' ' || *ls == '\t' || *ls == '\r'))
+            ++ls;
+        while (le > ls && (le[-1] == ' ' || le[-1] == '\t' ||
+                           le[-1] == '\r'))
+            --le;
+        if (ls == le) {  // blank line
+            p = (nl ? nl + 1 : end);
+            *consumed = p - buf;
+            continue;
+        }
+        if (row >= max_rows)
+            break;
+        for (int32_t k = 0; k < nkeys; ++k)
+            types[(int64_t)k * max_rows + row] = 0;  // missing (starts/
+        // lens are only read for types >= 4, so no prefill needed)
+        row_start[row] = (int32_t)(ls - buf);
+        row_len[row] = (int32_t)(le - ls);
+        int need_slow = 1;
+        if (!bs_block || memchr(ls, '\\', le - ls) == nullptr)
+            need_slow = json_line_fast(buf, ls, le, keys, key_lens, nkeys,
+                                       max_rows, row, starts, lens, types);
+        if (need_slow)
+            json_line_slow(buf, ls, le, keys, key_lens, nkeys,
+                           max_rows, row, starts, lens, types);
+        ++row;
+        p = (nl ? nl + 1 : end);
+        *consumed = p - buf;
+    }
+    row_start[row] = (int32_t)(*consumed);
+    return row;
+}
+
+// JSON numeric-literal comparison: number cells (type 4) and
+// numeric-looking string cells (type 5) compare numerically; string
+// cells that don't parse compare textually; bool/null/ambiguous per
+// row-engine rules.  Text compare of a NUMBER cell is ambiguous
+// (Python renders str(5.00) as "5.0" — raw bytes may differ).
+int64_t sel_json_cmp(const char *buf, const int32_t *starts,
+                     const int32_t *lens, const uint8_t *types,
+                     int64_t n, int op, double num_lit, int lit_is_num,
+                     const char *str_lit, int32_t str_len,
+                     uint8_t *mask) {
+    int64_t amb = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        uint8_t t = types[i];
+        if (t == 0 || t == 1) {  // missing/null: compare is false
+            mask[i] = 0;
+            continue;
+        }
+        if (t == 6 || t == 2 || t == 3) {  // ambiguous or bool
+            mask[i] = 0;
+            ++amb;
+            continue;
+        }
+        const char *s = buf + starts[i];
+        int32_t l = lens[i];
+        if (t == 4) {
+            if (!lit_is_num) {  // text compare of number cell: rendering
+                mask[i] = 0;
+                ++amb;
+                continue;
+            }
+            double v;
+            if (!parse_num(s, l, &v)) {  // huge ints etc.
+                mask[i] = 0;
+                ++amb;
+                continue;
+            }
+            int c = v < num_lit ? -1 : (v > num_lit ? 1 : 0);
+            mask[i] = (uint8_t)cmp_ok(op, c);
+            continue;
+        }
+        // string cell
+        double v;
+        if (lit_is_num && parse_num(s, l, &v)) {
+            int c = v < num_lit ? -1 : (v > num_lit ? 1 : 0);
+            mask[i] = (uint8_t)cmp_ok(op, c);
+        } else if (lit_is_num && num_ambiguous(s, l)) {
+            mask[i] = 0;
+            ++amb;
+        } else {
+            mask[i] = (uint8_t)cmp_ok(op, bytes_cmp(s, l, str_lit,
+                                                    str_len));
+        }
+    }
+    return amb;
+}
+
+// JSON LIKE: string cells only (row engine str()s other types —
+// ambiguous).  Missing/null => false.
+int64_t sel_json_like(const char *buf, const int32_t *starts,
+                      const int32_t *lens, const uint8_t *types,
+                      int64_t n, const char *pat, int32_t pat_len,
+                      const unsigned char *lit, uint8_t *mask) {
+    int64_t amb = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        uint8_t t = types[i];
+        if (t == 0 || t == 1) {
+            mask[i] = 0;
+            continue;
+        }
+        if (t != 5) {
+            mask[i] = 0;
+            ++amb;
+            continue;
+        }
+        mask[i] = (uint8_t)like_match(buf + starts[i], lens[i], pat,
+                                      pat_len, lit);
+    }
+    return amb;
+}
+
+// JSON validity (for NOT/negate composition): value present and not null.
+void sel_json_valid(const uint8_t *types, int64_t n, uint8_t *mask) {
+    for (int64_t i = 0; i < n; ++i)
+        mask[i] = types[i] != 0 && types[i] != 1;
+}
+
+// JSON IS NULL: missing key or null value, or an empty string (row
+// engine: v is None or v == "").  Type-6 cells (ambiguous value OR a
+// structurally bad line) are counted in the return value so the
+// driver replays them — a bad NDJSON line must raise like the row
+// engine even when the WHERE is IS NULL-only.
+int64_t sel_json_isnull(const int32_t *lens, const uint8_t *types,
+                        int64_t n, uint8_t *mask) {
+    int64_t amb = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        if (types[i] == 6) {
+            mask[i] = 0;
+            ++amb;
+            continue;
+        }
+        mask[i] = types[i] == 0 || types[i] == 1 ||
+                  (types[i] == 5 && lens[i] == 0);
+    }
+    return amb;
+}
+
+// JSON aggregate fold (same contract as sel_agg).  Number cells and
+// numeric strings fold; bool/nested/escaped => ambiguous; null/missing
+// and empty strings skip.
+int64_t sel_json_agg(const char *buf, const int32_t *starts,
+                     const int32_t *lens, const uint8_t *types,
+                     int64_t n, const uint8_t *mask, int what,
+                     double *sum, double *minv, double *maxv,
+                     int64_t *argmin, int64_t *argmax, int64_t *amb) {
+    int64_t cnt = 0;
+    *amb = 0;
+    double s = 0.0, lo = 0.0, hi = 0.0;
+    int64_t ilo = -1, ihi = -1;
+    for (int64_t i = 0; i < n; ++i) {
+        if (mask && !mask[i])
+            continue;
+        uint8_t t = types[i];
+        if (t == 0 || t == 1)
+            continue;  // missing/null
+        if (t == 5 && lens[i] == 0)
+            continue;  // "" skipped like CSV empty
+        if (t == 6 || t == 2 || t == 3) {
+            ++*amb;
+            continue;
+        }
+        if (what == 0) {
+            ++cnt;
+            continue;
+        }
+        double v;
+        if (!parse_num(buf + starts[i], lens[i], &v)) {
+            ++*amb;
+            continue;
+        }
+        ++cnt;
+        if (what == 1) {
+            s += v;
+        } else {
+            if (ilo < 0 || v < lo) {
+                lo = v;
+                ilo = i;
+            }
+            if (ihi < 0 || v > hi) {
+                hi = v;
+                ihi = i;
+            }
+        }
+    }
+    *sum = s;
+    *minv = lo;
+    *maxv = hi;
+    *argmin = ilo;
+    *argmax = ihi;
+    return cnt;
+}
+
+}  // extern "C"

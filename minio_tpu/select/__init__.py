@@ -116,12 +116,19 @@ def run_select(req: SelectRequest, stream,
     ev = Evaluator(query)
     out = _make_output(req)
 
-    # columnar CSV fast path (pyarrow parse + vectorized mask/aggregates);
-    # probes the first batch and replays consumed bytes into the row
-    # engine when the query/data shape is out of scope
-    from . import columnar
+    # three-tier engine (fastest first, each falling through when the
+    # query/data shape is out of its scope):
+    #  1. native C++ block scan (csrc/select_scan.cpp — the simdjson/
+    #     simd-CSV analogue, internal/s3select/simdj/reader.go:27)
+    #  2. pyarrow columnar (vectorized masks over arrow batches)
+    #  3. the row engine below (full SQL surface)
+    from . import columnar, native
 
     rw = columnar.Rewindable(stream)
+    fast = native.try_native(req, query, rw, object_size, out)
+    if fast is not None:
+        yield from fast
+        return
     fast = columnar.try_columnar(req, query, rw, object_size, out)
     if fast is not None:
         yield from fast
@@ -135,18 +142,24 @@ def run_select(req: SelectRequest, stream,
     returned = 0
     buf = bytearray()
     try:
+        # one-time closure compilation of the predicate/projection —
+        # the row engine's per-record cost is these two calls
+        from .sql import compile_predicate, compile_projection
+
+        matches = compile_predicate(ev)
+        project = compile_projection(ev)
         limit = query.limit
         n_out = 0
         for rec in reader:
             if ev.is_aggregate:
-                if ev.matches(rec):
+                if matches(rec):
                     ev.accumulate(rec)
                 continue
-            if not ev.matches(rec):
+            if not matches(rec):
                 continue
             if limit is not None and n_out >= limit:
                 break
-            buf += out.serialize(ev.project(rec))
+            buf += out.serialize(project(rec))
             n_out += 1
             if len(buf) >= FLUSH:
                 returned += len(buf)

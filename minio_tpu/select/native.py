@@ -1,0 +1,959 @@
+"""Native (C++) S3 Select fast path: block-streamed CSV/NDJSON scans.
+
+The reference accelerates Select with simdjson and a generated-assembly
+CSV scanner (internal/s3select/simdj/reader.go:27,
+select_benchmark_test.go); this is the equivalent here — csrc/
+select_scan.cpp tokenizes blocks, evaluates predicate leaves, and folds
+aggregates at C speed, while this driver composes leaf masks with
+numpy, keeps the cross-block aggregate state, and REPLAYS any block the
+kernels flag as ambiguous through the row engine (sql.Evaluator), so
+semantics match the row engine bit-for-bit even on garbage data
+(whitespace-padded numbers, >2^53 ints, escaped quotes, JSON escapes,
+invalid JSON lines...).
+
+Scope (everything else falls through to the pyarrow columnar path, then
+the row engine):
+- CSV (single-char delim/quote, "\\n" records, no comments) or JSON
+  Type=LINES; any CompressionType (blocks are read post-decompression)
+- aggregate-only projections (COUNT/SUM/MIN/MAX/AVG over a column or
+  COUNT(*)), or CSV `SELECT *` whose output serialization is a byte-
+  passthrough of the input (same delimiter, "\\n" records, CSV output)
+- WHERE: AND/OR/NOT over `col <op> literal`, LIKE, IN, BETWEEN,
+  IS [NOT] NULL — the same leaf language as the columnar path
+
+Disable with MINIO_TPU_SELECT_NATIVE=0 (MINIO_TPU_SELECT_COLUMNAR=0
+disables this path too — it gates everything above the row engine).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Iterator
+
+import numpy as np
+
+from . import eventstream as es
+from .records import _decomp
+from .sql import (AGGREGATES, Between, Bin, Col, Evaluator, Func, InList,
+                  IsNull, Like, Lit, Query, SQLError, Un, _cmp_pair, _num)
+
+CHUNK = 4 << 20
+FLUSH = 256 << 10
+PAD = 8  # kernel SWAR parsers read up to 8 bytes past a cell
+
+stats = {"native": 0, "fallback": 0, "replay_blocks": 0}
+
+_OPS = {"=": 0, "==": 0, "!=": 1, "<>": 1, "<": 2, "<=": 3, ">": 4,
+        ">=": 5}
+_FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+
+_CSRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "csrc")
+_LIBPATH = os.path.join(_CSRC, "libminio_tpu_host.so")
+_lock = threading.Lock()
+_lib = None
+_lib_tried = False
+
+_i64 = ctypes.c_int64
+_dbl = ctypes.c_double
+_vp = ctypes.c_void_p
+_cp = ctypes.c_char_p
+
+
+def _load():
+    global _lib, _lib_tried
+    with _lock:
+        if _lib is not None or _lib_tried:
+            return _lib
+        _lib_tried = True
+        try:
+            lib = ctypes.CDLL(_LIBPATH)
+        except OSError:
+            return None
+        lib.sel_csv_scan.restype = _i64
+        lib.sel_csv_scan.argtypes = [
+            _vp, _i64, ctypes.c_char, ctypes.c_char, ctypes.c_int, _vp,
+            ctypes.c_int32, _i64, _vp, _vp, _vp, ctypes.POINTER(_i64)]
+        lib.sel_cmp_num.restype = _i64
+        lib.sel_cmp_num.argtypes = [
+            _vp, _vp, _vp, _i64, ctypes.c_int, _dbl, _cp, ctypes.c_int32,
+            _vp]
+        lib.sel_cmp_str.restype = _i64
+        lib.sel_cmp_str.argtypes = [
+            _vp, _vp, _vp, _i64, ctypes.c_int, _cp, ctypes.c_int32, _vp]
+        lib.sel_like.restype = _i64
+        lib.sel_like.argtypes = [
+            _vp, _vp, _vp, _i64, _cp, ctypes.c_int32, _cp, _vp]
+        lib.sel_valid.argtypes = [_vp, _i64, _vp]
+        lib.sel_isnull.argtypes = [_vp, _i64, _vp]
+        lib.sel_agg.restype = _i64
+        lib.sel_agg.argtypes = [
+            _vp, _vp, _vp, _i64, _vp, ctypes.c_int, ctypes.POINTER(_dbl),
+            ctypes.POINTER(_dbl), ctypes.POINTER(_dbl),
+            ctypes.POINTER(_i64), ctypes.POINTER(_i64),
+            ctypes.POINTER(_i64)]
+        lib.sel_emit_rows.restype = _i64
+        lib.sel_emit_rows.argtypes = [
+            _vp, _vp, _i64, _vp, _i64, _vp, ctypes.POINTER(_i64)]
+        lib.sel_json_scan.restype = _i64
+        lib.sel_json_scan.argtypes = [
+            _vp, _i64, ctypes.c_int, _vp, _vp, ctypes.c_int32, _i64, _vp,
+            _vp, _vp, _vp, _vp, ctypes.POINTER(_i64)]
+        lib.sel_json_cmp.restype = _i64
+        lib.sel_json_cmp.argtypes = [
+            _vp, _vp, _vp, _vp, _i64, ctypes.c_int, _dbl, ctypes.c_int,
+            _cp, ctypes.c_int32, _vp]
+        lib.sel_json_like.restype = _i64
+        lib.sel_json_like.argtypes = [
+            _vp, _vp, _vp, _vp, _i64, _cp, ctypes.c_int32, _cp, _vp]
+        lib.sel_json_valid.argtypes = [_vp, _i64, _vp]
+        lib.sel_json_isnull.restype = _i64
+        lib.sel_json_isnull.argtypes = [_vp, _vp, _i64, _vp]
+        lib.sel_json_agg.restype = _i64
+        lib.sel_json_agg.argtypes = [
+            _vp, _vp, _vp, _vp, _i64, _vp, ctypes.c_int,
+            ctypes.POINTER(_dbl), ctypes.POINTER(_dbl),
+            ctypes.POINTER(_dbl), ctypes.POINTER(_i64),
+            ctypes.POINTER(_i64), ctypes.POINTER(_i64)]
+        _lib = lib
+        return _lib
+
+
+def _enabled() -> bool:
+    return (os.environ.get("MINIO_TPU_SELECT_NATIVE", "1") != "0"
+            and os.environ.get("MINIO_TPU_SELECT_COLUMNAR", "1") != "0")
+
+
+class _Fallback(Exception):
+    pass
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(_vp)
+
+
+# ------------------------------------------------------------ WHERE plan
+
+
+def _lit_ok(v) -> bool:
+    if v is None:
+        return False
+    if isinstance(v, bool):
+        return False  # bool literals: row-engine coercions, stay off
+    if isinstance(v, int) and abs(v) >= 2**53:
+        return False
+    return True
+
+
+def _like_plan(pat: str, esc: str | None) -> tuple[bytes, bytes]:
+    """SQL LIKE pattern -> (bytes, literal-mask) for the C matcher:
+    mask byte 1 = literal, 0 = wildcard role for '%'/'_'."""
+    out = bytearray()
+    lit = bytearray()
+    i = 0
+    while i < len(pat):
+        c = pat[i]
+        if esc and c == esc and i + 1 < len(pat):
+            for b in pat[i + 1].encode():
+                out.append(b)
+                lit.append(1)
+            i += 2
+            continue
+        for b in c.encode():
+            out.append(b)
+            lit.append(0 if c in "%_" else 1)
+        i += 1
+    return bytes(out), bytes(lit)
+
+
+class _Plan:
+    """Compiled WHERE tree: leaves call C kernels over (starts, lens[,
+    types]) arrays; interior nodes compose numpy bool arrays.  `amb`
+    accumulates the kernels' ambiguous-cell counts for the current
+    block — nonzero means the Python replay must decide the block."""
+
+    def __init__(self, where, resolve, is_json: bool):
+        self.is_json = is_json
+        self.cols: list = []          # resolved column ids, plan order
+        self._col_of: dict = {}
+        self.amb = 0
+        self.fn = self._comp(where, resolve) if where is not None else None
+
+    def _slot(self, resolved) -> int:
+        if resolved not in self._col_of:
+            self._col_of[resolved] = len(self.cols)
+            self.cols.append(resolved)
+        return self._col_of[resolved]
+
+    def mask(self, ctx) -> np.ndarray | None:
+        self.amb = 0
+        if self.fn is None:
+            return None
+        return self.fn(ctx)
+
+    # ctx: object with .buf (ctypes buffer), .starts/.lens/.types lists
+    # of per-slot numpy arrays (length nrows), .n
+    def _leaf_cmp(self, slot: int, op: str, lit_v):
+        lib = _load()
+        opc = _OPS[op]
+        numlit = _num(lit_v)
+        strlit = str(lit_v).encode()
+        is_num = isinstance(numlit, (int, float)) \
+            and not isinstance(numlit, bool)
+        if self.is_json:
+            def leaf(ctx):
+                m = np.empty(ctx.n, dtype=np.uint8)
+                self.amb += lib.sel_json_cmp(
+                    ctx.buf, _ptr(ctx.starts[slot]), _ptr(ctx.lens[slot]),
+                    _ptr(ctx.types[slot]), ctx.n, opc,
+                    float(numlit) if is_num else 0.0, int(is_num),
+                    strlit, len(strlit), _ptr(m))
+                return m.view(bool)
+            return leaf
+        if is_num:
+            def leaf(ctx):
+                m = np.empty(ctx.n, dtype=np.uint8)
+                self.amb += lib.sel_cmp_num(
+                    ctx.buf, _ptr(ctx.starts[slot]), _ptr(ctx.lens[slot]),
+                    ctx.n, opc, float(numlit), strlit, len(strlit),
+                    _ptr(m))
+                return m.view(bool)
+            return leaf
+
+        def leaf(ctx):
+            m = np.empty(ctx.n, dtype=np.uint8)
+            self.amb += lib.sel_cmp_str(
+                ctx.buf, _ptr(ctx.starts[slot]), _ptr(ctx.lens[slot]),
+                ctx.n, opc, strlit, len(strlit), _ptr(m))
+            return m.view(bool)
+        return leaf
+
+    def _valid(self, slot: int):
+        lib = _load()
+        if self.is_json:
+            def v(ctx):
+                m = np.empty(ctx.n, dtype=np.uint8)
+                lib.sel_json_valid(_ptr(ctx.types[slot]), ctx.n, _ptr(m))
+                return m.view(bool)
+            return v
+
+        def v(ctx):
+            m = np.empty(ctx.n, dtype=np.uint8)
+            lib.sel_valid(_ptr(ctx.lens[slot]), ctx.n, _ptr(m))
+            return m.view(bool)
+        return v
+
+    def _comp(self, e, resolve):
+        lib = _load()
+        if isinstance(e, Un):
+            if e.op != "not":
+                raise _Fallback("unary " + e.op)
+            inner = self._comp(e.e, resolve)
+            return lambda ctx: ~inner(ctx)
+        if isinstance(e, Bin) and e.op in ("and", "or"):
+            lf, rf = self._comp(e.l, resolve), self._comp(e.r, resolve)
+            if e.op == "and":
+                return lambda ctx: lf(ctx) & rf(ctx)
+            return lambda ctx: lf(ctx) | rf(ctx)
+        if isinstance(e, Like):
+            if not (isinstance(e.e, Col) and isinstance(e.pat, Lit)
+                    and isinstance(e.pat.v, str)
+                    and (e.esc is None or (isinstance(e.esc, Lit)
+                                           and isinstance(e.esc.v, str)))):
+                raise _Fallback("LIKE shape")
+            slot = self._slot(resolve(e.e.name))
+            pat, litmask = _like_plan(
+                str(e.pat.v), str(e.esc.v) if e.esc is not None else None)
+            negate = e.negate
+            validf = self._valid(slot)
+            fn = lib.sel_json_like if self.is_json else lib.sel_like
+
+            def leaf(ctx, slot=slot, pat=pat, litmask=litmask,
+                     negate=negate, fn=fn):
+                m = np.empty(ctx.n, dtype=np.uint8)
+                if self.is_json:
+                    self.amb += fn(ctx.buf, _ptr(ctx.starts[slot]),
+                                   _ptr(ctx.lens[slot]),
+                                   _ptr(ctx.types[slot]), ctx.n,
+                                   pat, len(pat), litmask, _ptr(m))
+                else:
+                    self.amb += fn(ctx.buf, _ptr(ctx.starts[slot]),
+                                   _ptr(ctx.lens[slot]), ctx.n,
+                                   pat, len(pat), litmask, _ptr(m))
+                mb = m.view(bool)
+                # null cells make LIKE and NOT LIKE both false
+                return (validf(ctx) & ~mb) if negate else mb
+            return leaf
+        if isinstance(e, InList):
+            if not (isinstance(e.e, Col) and all(
+                    isinstance(x, Lit) and _lit_ok(x.v) for x in e.items)):
+                raise _Fallback("IN shape")
+            slot = self._slot(resolve(e.e.name))
+            leaves = [self._leaf_cmp(slot, "=", x.v) for x in e.items]
+            validf = self._valid(slot)
+            negate = e.negate
+
+            def leaf(ctx, leaves=leaves, negate=negate):
+                m = leaves[0](ctx)
+                for lf in leaves[1:]:
+                    m = m | lf(ctx)
+                return (validf(ctx) & ~m) if negate else m
+            return leaf
+        if isinstance(e, Between):
+            if not (isinstance(e.e, Col)
+                    and isinstance(e.lo, Lit) and _lit_ok(e.lo.v)
+                    and isinstance(e.hi, Lit) and _lit_ok(e.hi.v)):
+                raise _Fallback("BETWEEN shape")
+            slot = self._slot(resolve(e.e.name))
+            lo = self._leaf_cmp(slot, ">=", e.lo.v)
+            hi = self._leaf_cmp(slot, "<=", e.hi.v)
+            validf = self._valid(slot)
+            negate = e.negate
+
+            def leaf(ctx, lo=lo, hi=hi, negate=negate):
+                m = lo(ctx) & hi(ctx)
+                return (validf(ctx) & ~m) if negate else m
+            return leaf
+        if isinstance(e, IsNull):
+            if not isinstance(e.e, Col):
+                raise _Fallback("IS NULL shape")
+            slot = self._slot(resolve(e.e.name))
+            negate = e.negate
+            isj = self.is_json
+
+            def leaf(ctx, slot=slot, negate=negate):
+                m = np.empty(ctx.n, dtype=np.uint8)
+                if isj:
+                    self.amb += lib.sel_json_isnull(
+                        _ptr(ctx.lens[slot]), _ptr(ctx.types[slot]),
+                        ctx.n, _ptr(m))
+                else:
+                    lib.sel_isnull(_ptr(ctx.lens[slot]), ctx.n, _ptr(m))
+                mb = m.view(bool)
+                return ~mb if negate else mb
+            return leaf
+        if isinstance(e, Bin) and e.op in ("=", "==", "!=", "<>", "<",
+                                           "<=", ">", ">="):
+            col, lit, flip = e.l, e.r, False
+            if isinstance(col, Lit):
+                col, lit, flip = e.r, e.l, True
+            if not (isinstance(col, Col) and isinstance(lit, Lit)
+                    and _lit_ok(lit.v)):
+                raise _Fallback("cmp shape")
+            slot = self._slot(resolve(col.name))
+            op = _FLIP.get(e.op, e.op) if flip else e.op
+            return self._leaf_cmp(slot, op, lit.v)
+        raise _Fallback(f"unsupported node {type(e).__name__}")
+
+
+# --------------------------------------------------------------- shapes
+
+
+def _agg_shape(q: Query):
+    """-> list of (what, colname|None, func) or None.  what: 0 COUNT,
+    1 SUM/AVG, 2 MIN/MAX."""
+    if q.star or not q.projections:
+        return None
+    out = []
+    for p in q.projections:
+        f = p.expr
+        if not (isinstance(f, Func) and f.name in AGGREGATES):
+            return None
+        if f.star:
+            out.append((0, None, f.name))
+            continue
+        if len(f.args) != 1 or not isinstance(f.args[0], Col):
+            return None
+        what = 0 if f.name == "count" else (
+            1 if f.name in ("sum", "avg") else 2)
+        out.append((what, f.args[0].name, f.name))
+    return out
+
+
+def _alias_strip(name: str, alias: str) -> str:
+    parts = name.split(".")
+    if alias and parts and parts[0].lower() == alias:
+        parts = parts[1:]
+    if len(parts) != 1:
+        raise _Fallback(f"nested column {name}")
+    return parts[0]
+
+
+class _Ctx:
+    pass
+
+
+# ------------------------------------------------------------- CSV path
+
+
+def _csv_opts(req):
+    inp = req.input_ser
+    c = inp["CSV"] if isinstance(inp["CSV"], dict) else {}
+    delim = c.get("FieldDelimiter", ",") or ","
+    quote = c.get("QuoteCharacter", '"') or '"'
+    header = (c.get("FileHeaderInfo", "USE") or "USE").upper()
+    if (c.get("RecordDelimiter", "\n") or "\n") != "\n":
+        raise _Fallback("record delimiter")
+    if len(delim) != 1 or len(quote) != 1 or delim == quote:
+        raise _Fallback("delim/quote")
+    if c.get("Comments"):
+        raise _Fallback("comments")
+    return delim, quote, header
+
+
+def _read_header(raw, quote: str) -> tuple[bytes, bytes]:
+    """-> (header_line_without_newline, leftover buffered bytes).
+    Falls back when the first line contains the quote char (quoted or
+    multi-line headers: rare, pyarrow handles them)."""
+    buf = b""
+    while b"\n" not in buf:
+        chunk = raw.read(65536)
+        if not chunk:
+            break
+        buf += chunk
+        if len(buf) > (1 << 20):
+            raise _Fallback("header line too long")
+    if b"\n" not in buf:
+        return buf, b""
+    line, rest = buf.split(b"\n", 1)
+    if quote.encode() in line:
+        raise _Fallback("quoted header")
+    return line, rest
+
+
+def _try_csv(req, query: Query, rw, object_size: int, out):
+    delim, quote, header = _csv_opts(req)
+    compression = req.input_ser.get("CompressionType", "NONE") or "NONE"
+    aggs = _agg_shape(query)
+    emit = False
+    if aggs is None:
+        # SELECT * passthrough: CSV output whose serialization leaves
+        # unquoted input rows byte-identical
+        if not query.star or query.projections:
+            raise _Fallback("projection shape")
+        o = req.output_ser
+        oc = o.get("CSV")
+        if not isinstance(oc, (dict, type(None))) or "CSV" not in o:
+            raise _Fallback("output serialization")
+        oc = oc if isinstance(oc, dict) else {}
+        if (oc.get("FieldDelimiter", ",") or ",") != delim \
+                or (oc.get("RecordDelimiter", "\n") or "\n") != "\n" \
+                or (oc.get("QuoteCharacter", '"') or '"') != '"':
+            raise _Fallback("output serialization")
+        emit = True
+
+    raw = _decomp(rw, compression)
+    if header == "USE":
+        hline, leftover = _read_header(raw, quote)
+        try:
+            names = [h.strip() for h in
+                     hline.decode("utf-8", "replace").split(delim)]
+        except Exception:
+            raise _Fallback("header decode")
+        if hline.strip() == b"":
+            names = []
+    elif header == "IGNORE":
+        hline, leftover = _read_header(raw, quote)
+        names = []
+    else:
+        names = []
+        leftover = b""
+
+    def resolve(name: str) -> int:
+        import re as re_mod
+
+        p = _alias_strip(name, query.table_alias)
+        if header == "USE" and names:
+            if p in names:
+                return names.index(p)
+            lowered = [s.lower() for s in names]
+            if p.lower() in lowered:
+                return lowered.index(p.lower())
+        if re_mod.fullmatch(r"_\d+", p):
+            i = int(p[1:]) - 1
+            if i >= 0 and (not names or i < len(names)):
+                return i
+        raise _Fallback(f"unknown column {name}")
+
+    plan = _Plan(query.where, resolve, is_json=False)
+    agg_cols: list[int | None] = []
+    if aggs is not None:
+        for what, colname, fname in aggs:
+            agg_cols.append(None if colname is None
+                            else resolve(colname))
+
+    # needed columns, ascending, plus slot remap
+    needed = sorted(set(plan.cols) | {c for c in agg_cols
+                                      if c is not None}) or [0]
+    col_pos = {c: i for i, c in enumerate(needed)}
+    ev = Evaluator(query)
+    lib = _load()
+    if lib is None:
+        raise _Fallback("native lib unavailable")
+    stats["native"] += 1
+    rw.commit()
+    keys = [(names[i] if names and i < len(names) and names[i]
+             else f"_{i + 1}") for i in range(len(names))] if names else []
+
+    def replay_rows(block: bytes, a: int, b: int, collect=None) -> None:
+        """Row-engine evaluation of block[a:b] (complete records)."""
+        import csv as csv_mod
+        import io as io_mod
+
+        stats["replay_blocks"] += 1
+        text = bytes(block[a:b]).decode("utf-8", "replace")
+        rdr = csv_mod.reader(io_mod.StringIO(text), delimiter=delim,
+                             quotechar=quote)
+        for rowvals in rdr:
+            if not rowvals:
+                continue
+            if keys:
+                rec = {}
+                for i, v in enumerate(rowvals):
+                    kk = keys[i] if i < len(keys) else f"_{i + 1}"
+                    rec[kk] = v
+            else:
+                rec = {f"_{i + 1}": v for i, v in enumerate(rowvals)}
+            if collect is not None:
+                if ev.matches(rec):
+                    collect(rec)
+            elif ev.matches(rec):
+                ev.accumulate(rec)
+
+    def emit_collect(rec, sink, limiter):
+        # replayed rows re-serialize through the row-engine writer so
+        # quoted cells round-trip exactly as the slow path would
+        if limiter[0] is not None and limiter[1] >= limiter[0]:
+            return
+        sink += out.serialize(ev.project(rec))
+        limiter[1] += 1
+
+    def gen() -> Iterator[bytes]:
+        max_rows = 1 << 19
+        col_arr = np.array(needed, dtype=np.int32)
+        starts = np.empty((len(needed), max_rows), dtype=np.int32)
+        lens = np.empty((len(needed), max_rows), dtype=np.int32)
+        row_start = np.empty(max_rows + 1, dtype=np.int32)
+        consumed = _i64()
+        out_len = _i64()
+        emit_buf = ctypes.create_string_buffer(CHUNK + (1 << 16)) \
+            if emit else None
+        returned = 0
+        outbuf = bytearray()
+        limit = query.limit
+        n_out = 0
+        tail = leftover
+        qb = quote.encode()
+        # one reusable padded arena: read chunks are copied in ONCE and
+        # kernels take (base + off) pointers — no per-block reallocation
+        ba = bytearray(CHUNK + (1 << 20) + PAD)
+        base = (ctypes.c_char * len(ba)).from_buffer(ba)
+        try:
+            while True:
+                data = raw.read(CHUNK)
+                final = not data
+                blen = len(tail) + len(data or b"")
+                if blen + PAD > len(ba):
+                    base = None
+                    ba = bytearray(blen * 2 + PAD)
+                    base = (ctypes.c_char * len(ba)).from_buffer(ba)
+                if tail:
+                    ba[:len(tail)] = tail
+                if data:
+                    ba[len(tail):blen] = data
+                ba[blen:blen + PAD] = b"\0" * PAD
+                tail = b""
+                if not blen:
+                    break
+                if emit and limit is not None and n_out >= limit:
+                    break
+                off = 0
+                while off < blen:
+                    seg_len = blen - off
+                    pad = memoryview(ba)[off:]
+                    cbuf = ctypes.byref(base, off)
+                    n = lib.sel_csv_scan(
+                        cbuf, seg_len, delim.encode(), quote.encode(),
+                        1 if final else 0, _ptr(col_arr), len(needed),
+                        max_rows, _ptr(starts), _ptr(lens),
+                        _ptr(row_start), ctypes.byref(consumed))
+                    if n == -2:
+                        # unterminated quote at EOF: Python's csv module
+                        # yields the open field as-is — replay exactly
+                        if emit:
+                            lim = [limit, n_out]
+                            replay_rows(pad, 0, seg_len,
+                                        collect=lambda rec: emit_collect(
+                                            rec, outbuf, lim))
+                            n_out = lim[1]
+                        else:
+                            replay_rows(pad, 0, seg_len)
+                        off = blen
+                        break
+                    if n == 0:
+                        break  # need more data
+                    n = int(n)
+                    ctx = _Ctx()
+                    ctx.buf = cbuf
+                    ctx.n = n
+                    ctx.starts = [starts[col_pos[c], :n]
+                                  for c in plan.cols]
+                    ctx.lens = [lens[col_pos[c], :n] for c in plan.cols]
+                    mask = plan.mask(ctx)
+                    ambiguous = plan.amb > 0
+                    if not ambiguous and aggs is not None:
+                        # run every aggregate kernel BEFORE committing
+                        # any state: a later kernel may turn up amb
+                        results = []
+                        kmask = None
+                        if mask is not None:
+                            kmask = np.ascontiguousarray(
+                                mask.astype(np.uint8))
+                        for (what, colname, fname), rcol in zip(
+                                aggs, agg_cols):
+                            if rcol is None:
+                                results.append(
+                                    ("count",
+                                     int(mask.sum()) if mask is not None
+                                     else n, 0.0, None, None))
+                                continue
+                            s = _dbl()
+                            mn = _dbl()
+                            mx = _dbl()
+                            am = _i64()
+                            ax = _i64()
+                            ab = _i64()
+                            sl = col_pos[rcol]
+                            cnt = lib.sel_agg(
+                                cbuf, _ptr(starts[sl, :n]),
+                                _ptr(lens[sl, :n]), n,
+                                _ptr(kmask) if kmask is not None
+                                else None,
+                                what, ctypes.byref(s), ctypes.byref(mn),
+                                ctypes.byref(mx), ctypes.byref(am),
+                                ctypes.byref(ax), ctypes.byref(ab))
+                            if ab.value > 0:
+                                ambiguous = True
+                                break
+                            lo = hi = None
+                            if what == 2 and am.value >= 0:
+                                a0 = int(starts[sl, am.value])
+                                l0 = int(lens[sl, am.value])
+                                lo = _num(bytes(pad[a0:a0 + l0]).decode(
+                                    "utf-8", "replace"))
+                                a1 = int(starts[sl, ax.value])
+                                l1 = int(lens[sl, ax.value])
+                                hi = _num(bytes(pad[a1:a1 + l1]).decode(
+                                    "utf-8", "replace"))
+                            results.append((fname, int(cnt),
+                                            float(s.value), lo, hi))
+                        if not ambiguous:
+                            _commit_agg(ev, results)
+                    if emit and not ambiguous and (
+                            ba.find(qb, off,
+                                    off + int(consumed.value)) >= 0
+                            or ba.find(b"\r", off,
+                                       off + int(consumed.value)) >= 0):
+                        # quoted cells (or bare \r) don't round-trip
+                        # verbatim: the row-engine writer re-quotes —
+                        # replay this batch through it
+                        ambiguous = True
+                    if ambiguous:
+                        if emit:
+                            lim = [limit, n_out]
+                            replay_rows(pad, 0, int(consumed.value),
+                                        collect=lambda rec: emit_collect(
+                                            rec, outbuf, lim))
+                            n_out = lim[1]
+                        else:
+                            replay_rows(pad, 0, int(consumed.value))
+                    elif emit:
+                        km = None
+                        if mask is not None:
+                            km = np.ascontiguousarray(
+                                mask.astype(np.uint8))
+                        lim = -1 if limit is None else max(
+                            0, limit - n_out)
+                        if int(consumed.value) + 1 > \
+                                ctypes.sizeof(emit_buf):
+                            # blocks can outgrow CHUNK when a record
+                            # straddles reads (tail + CHUNK): emitted
+                            # bytes are bounded by consumed + 1
+                            emit_buf = ctypes.create_string_buffer(
+                                int(consumed.value) * 2)
+                        wrote = lib.sel_emit_rows(
+                            cbuf, _ptr(row_start[:n + 1]), n,
+                            _ptr(km) if km is not None else None,
+                            lim, emit_buf, ctypes.byref(out_len))
+                        n_out += int(wrote)
+                        if out_len.value:
+                            outbuf += emit_buf.raw[:out_len.value]
+                            while len(outbuf) >= FLUSH:
+                                returned += FLUSH
+                                yield es.records_message(
+                                    bytes(outbuf[:FLUSH]))
+                                del outbuf[:FLUSH]
+                        if limit is not None and n_out >= limit:
+                            break
+                    off += int(consumed.value)
+                    if int(consumed.value) == 0:
+                        break
+                if off < blen:
+                    tail = bytes(ba[off:blen])
+                    if len(tail) > (64 << 20):
+                        raise SQLError("record too large")
+                if final:
+                    break
+            if aggs is not None:
+                outbuf += out.serialize(ev.aggregate_result())
+            if outbuf:
+                returned += len(outbuf)
+                yield es.records_message(bytes(outbuf))
+            if req.request_progress:
+                yield es.progress_message(object_size, object_size,
+                                          returned)
+            yield es.stats_message(object_size, object_size, returned)
+            yield es.end_message()
+        except SQLError as e:
+            yield es.error_message("InvalidQuery", str(e))
+
+    return gen()
+
+
+def _commit_agg(ev: Evaluator, results) -> None:
+    for i, (fname, cnt, s, lo, hi) in enumerate(results):
+        st = ev._agg_state[i]
+        st["count"] += cnt
+        if fname in ("sum", "avg"):
+            st["sum"] += s
+        if fname in ("min", "max") and lo is not None:
+            if st["min"] is None:
+                st["min"], st["max"] = lo, hi
+            else:
+                a, b = _cmp_pair(lo, st["min"])
+                if a < b:
+                    st["min"] = lo
+                a, b = _cmp_pair(hi, st["max"])
+                if a > b:
+                    st["max"] = hi
+
+
+# ------------------------------------------------------------ JSON path
+
+
+def _try_json(req, query: Query, rw, object_size: int, out):
+    j = req.input_ser["JSON"] if isinstance(req.input_ser["JSON"], dict) \
+        else {}
+    if (j.get("Type", "DOCUMENT") or "DOCUMENT").upper() != "LINES":
+        raise _Fallback("JSON type")
+    aggs = _agg_shape(query)
+    if aggs is None:
+        raise _Fallback("projection shape")  # pyarrow handles these
+    compression = req.input_ser.get("CompressionType", "NONE") or "NONE"
+    raw = _decomp(rw, compression)
+
+    keymap: dict[str, int] = {}
+
+    def resolve(name: str) -> str:
+        p = _alias_strip(name, query.table_alias)
+        return p
+
+    plan = _Plan(query.where, resolve, is_json=True)
+    agg_keys: list[str | None] = []
+    for what, colname, fname in aggs:
+        agg_keys.append(None if colname is None
+                        else resolve(colname))
+    all_keys = list(dict.fromkeys(
+        [k for k in plan.cols] + [k for k in agg_keys if k is not None]))
+    if not all_keys:
+        all_keys = ["\x00none"]  # dummy slot: bad-line detection only
+    for i, k in enumerate(all_keys):
+        keymap[k] = i
+    ev = Evaluator(query)
+    lib = _load()
+    if lib is None:
+        raise _Fallback("native lib unavailable")
+    stats["native"] += 1
+    rw.commit()
+
+    def replay_rows(pad: bytes, rs: np.ndarray, rl: np.ndarray,
+                    rows: np.ndarray) -> None:
+        import json as json_mod
+
+        stats["replay_blocks"] += 1
+        for r in rows:
+            line = bytes(pad[rs[r]:rs[r] + rl[r]]).decode(
+                "utf-8", "replace")
+            try:
+                doc = json_mod.loads(line)
+            except ValueError as e:
+                raise SQLError(f"invalid JSON line: {e}")
+            rec = doc if isinstance(doc, dict) else {"_1": doc}
+            if ev.matches(rec):
+                ev.accumulate(rec)
+
+    def gen() -> Iterator[bytes]:
+        max_rows = 1 << 18
+        nk = len(all_keys)
+        kbytes = [k.encode() for k in all_keys]
+        keys_arr = (ctypes.c_char_p * nk)(*kbytes)
+        key_lens = np.array([len(b) for b in kbytes], dtype=np.int32)
+        starts = np.empty((nk, max_rows), dtype=np.int32)
+        lens = np.empty((nk, max_rows), dtype=np.int32)
+        types = np.empty((nk, max_rows), dtype=np.uint8)
+        row_start = np.empty(max_rows + 1, dtype=np.int32)
+        row_len = np.empty(max_rows, dtype=np.int32)
+        consumed = _i64()
+        returned = 0
+        outbuf = bytearray()
+        tail = b""
+        ba = bytearray(CHUNK + (1 << 20) + PAD)
+        base = (ctypes.c_char * len(ba)).from_buffer(ba)
+        try:
+            while True:
+                data = raw.read(CHUNK)
+                final = not data
+                blen = len(tail) + len(data or b"")
+                if blen + PAD > len(ba):
+                    base = None
+                    ba = bytearray(blen * 2 + PAD)
+                    base = (ctypes.c_char * len(ba)).from_buffer(ba)
+                if tail:
+                    ba[:len(tail)] = tail
+                if data:
+                    ba[len(tail):blen] = data
+                ba[blen:blen + PAD] = b"\0" * PAD
+                tail = b""
+                if not blen:
+                    break
+                off = 0
+                while off < blen:
+                    pad = memoryview(ba)[off:]
+                    cbuf = ctypes.byref(base, off)
+                    n = lib.sel_json_scan(
+                        cbuf, blen - off, 1 if final else 0, keys_arr,
+                        _ptr(key_lens), nk, max_rows, _ptr(starts),
+                        _ptr(lens), _ptr(types), _ptr(row_start),
+                        _ptr(row_len), ctypes.byref(consumed))
+                    if n == 0:
+                        break
+                    n = int(n)
+                    ctx = _Ctx()
+                    ctx.buf = cbuf
+                    ctx.n = n
+                    ctx.starts = [starts[keymap[k], :n]
+                                  for k in plan.cols]
+                    ctx.lens = [lens[keymap[k], :n] for k in plan.cols]
+                    ctx.types = [types[keymap[k], :n]
+                                 for k in plan.cols]
+                    mask = plan.mask(ctx)
+                    ambiguous = plan.amb > 0
+                    # bad lines mark EVERY key slot 6 (incl. dummy)
+                    bad = types[0, :n] == 6
+                    if nk > 1:
+                        for ki in range(1, nk):
+                            bad = bad & (types[ki, :n] == 6)
+                    if bad.any() and not plan.cols and agg_keys.count(
+                            None) == len(agg_keys):
+                        # COUNT(*)-style: kernels never touch types, so
+                        # surface bad lines here
+                        ambiguous = True
+                    if not ambiguous and aggs is not None:
+                        results = []
+                        kmask = None
+                        if mask is not None:
+                            kmask = np.ascontiguousarray(
+                                mask.astype(np.uint8))
+                        for (what, colname, fname), key in zip(
+                                aggs, agg_keys):
+                            if key is None:
+                                if mask is not None:
+                                    results.append(
+                                        ("count", int(mask.sum()), 0.0,
+                                         None, None))
+                                else:
+                                    results.append(
+                                        ("count", n, 0.0, None, None))
+                                continue
+                            sl = keymap[key]
+                            s = _dbl()
+                            mn = _dbl()
+                            mx = _dbl()
+                            am = _i64()
+                            ax = _i64()
+                            ab = _i64()
+                            cnt = lib.sel_json_agg(
+                                cbuf, _ptr(starts[sl, :n]),
+                                _ptr(lens[sl, :n]),
+                                _ptr(types[sl, :n]), n,
+                                _ptr(kmask) if kmask is not None
+                                else None, what,
+                                ctypes.byref(s), ctypes.byref(mn),
+                                ctypes.byref(mx), ctypes.byref(am),
+                                ctypes.byref(ax), ctypes.byref(ab))
+                            if ab.value > 0:
+                                ambiguous = True
+                                break
+                            lo = hi = None
+                            if what == 2 and am.value >= 0:
+                                a0 = int(starts[sl, am.value])
+                                l0 = int(lens[sl, am.value])
+                                lo = _num(bytes(pad[a0:a0 + l0])
+                                          .decode())
+                                a1 = int(starts[sl, ax.value])
+                                l1 = int(lens[sl, ax.value])
+                                hi = _num(bytes(pad[a1:a1 + l1])
+                                          .decode())
+                            results.append((fname, int(cnt),
+                                            float(s.value), lo, hi))
+                        if not ambiguous:
+                            _commit_agg(ev, results)
+                    if ambiguous:
+                        replay_rows(pad, row_start[:n], row_len[:n],
+                                    np.arange(n))
+                    off += int(consumed.value)
+                    if int(consumed.value) == 0:
+                        break
+                if off < blen:
+                    tail = bytes(ba[off:blen])
+                    if len(tail) > (64 << 20):
+                        raise SQLError("record too large")
+                if final:
+                    break
+            outbuf += out.serialize(ev.aggregate_result())
+            returned += len(outbuf)
+            yield es.records_message(bytes(outbuf))
+            if req.request_progress:
+                yield es.progress_message(object_size, object_size,
+                                          returned)
+            yield es.stats_message(object_size, object_size, returned)
+            yield es.end_message()
+        except SQLError as e:
+            yield es.error_message("InvalidQuery", str(e))
+
+    return gen()
+
+
+# -------------------------------------------------------------- dispatch
+
+
+def try_native(req, query: Query, rw, object_size: int,
+               out) -> Iterator[bytes] | None:
+    """Probe + run the native path.  Returns the event-stream iterator,
+    or None (with `rw` rewound) when the pyarrow/row paths must take
+    over."""
+    if not _enabled() or _load() is None:
+        rw.rewind()
+        return None
+    try:
+        if "CSV" in req.input_ser:
+            return _try_csv(req, query, rw, object_size, out)
+        if "JSON" in req.input_ser:
+            return _try_json(req, query, rw, object_size, out)
+    except _Fallback:
+        pass
+    stats["fallback"] += 1
+    rw.rewind()
+    return None

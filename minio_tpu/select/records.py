@@ -16,6 +16,11 @@ from typing import Iterator
 
 from .sql import SQLError
 
+# Python's csv module caps fields at 128 KiB by default; S3 objects can
+# legitimately carry larger cells (the native tier streams them fine),
+# so the row engine must not be the tier that chokes first.
+csv.field_size_limit(1 << 30)
+
 
 def _decomp(stream: io.RawIOBase, compression: str) -> io.RawIOBase:
     comp = (compression or "NONE").upper()
@@ -52,6 +57,7 @@ class CSVInput:
 
     def __iter__(self) -> Iterator[dict]:
         first = True
+        keys: list[str] = []
         for row in self.reader:
             if not row:
                 continue
@@ -61,20 +67,18 @@ class CSVInput:
                 first = False
                 if self.header_info == "USE":
                     self.header = [h.strip() for h in row]
+                    # header-named keys only: SELECT * must not double
+                    # the columns; positional _N lookups resolve by
+                    # index in the evaluator's fallback
+                    keys = [h or f"_{i + 1}"
+                            for i, h in enumerate(self.header)]
                     continue
                 if self.header_info == "IGNORE":
                     continue
-            if self.header:
-                # header-named keys only: SELECT * must not double the
-                # columns; positional _N lookups resolve by index in the
-                # evaluator's fallback
-                rec = {}
-                for i, v in enumerate(row):
-                    h = self.header[i] if i < len(self.header) else ""
-                    rec[h or f"_{i + 1}"] = v
-                yield rec
-            else:
-                yield {f"_{i + 1}": v for i, v in enumerate(row)}
+            if len(row) > len(keys):
+                keys = keys + [f"_{i + 1}"
+                               for i in range(len(keys), len(row))]
+            yield dict(zip(keys, row))
 
 
 class JSONInput:

@@ -509,11 +509,15 @@ class Evaluator:
             return not ok if e.negate else ok
         if isinstance(e, InList):
             v = self.value(e.e, rec)
+            if v is None:
+                return None  # SQL 3VL: NULL [NOT] IN (...) is NULL
             vals = [self.value(x, rec) for x in e.items]
             hit = any(self._eq(v, x) for x in vals)
             return not hit if e.negate else hit
         if isinstance(e, Between):
             v = self.value(e.e, rec)
+            if v is None:
+                return None  # SQL 3VL: NULL [NOT] BETWEEN is NULL
             lo = self.value(e.lo, rec)
             hi = self.value(e.hi, rec)
             a, l2 = _cmp_pair(v, lo)
@@ -756,3 +760,207 @@ class Evaluator:
         if isinstance(e, Col):
             return e.name.split(".")[-1]
         return f"_{i + 1}"
+
+
+# ------------------------------------------------- compiled evaluation
+#
+# The per-record tree walk above (Evaluator.value) pays isinstance
+# dispatch + attribute loads for every AST node on every record; for
+# queries the vectorized tiers cannot take (functions, CAST,
+# arithmetic), that walk IS the scan cost.  compile_predicate/
+# compile_projection translate the AST ONCE into nested closures with
+# all constants (literals, coerced numbers, LIKE regexes, operator
+# functions) bound at compile time — semantics identical to value().
+# (Reference analogue: the evaluator pre-binds per-query state in
+# internal/s3select/sql/statement.go.)
+
+import operator as _op
+
+_ORD_OPS = {"<": _op.lt, "<=": _op.le, ">": _op.gt, ">=": _op.ge}
+
+
+def _compile_expr(e, ev: "Evaluator"):
+    """AST node -> fn(rec) -> value, replicating Evaluator.value."""
+    if isinstance(e, Lit):
+        v = e.v
+        return lambda rec: v
+    if isinstance(e, Col):
+        alias = ev.q.table_alias
+        parts = e.name.split(".")
+        if alias and parts and parts[0].lower() == alias:
+            parts = parts[1:]
+        if len(parts) == 1:
+            k = parts[0]
+
+            def col(rec, k=k, e=e, ev=ev):
+                try:
+                    return rec[k]
+                except (KeyError, TypeError):
+                    return ev._col(e, rec)  # ci/_N/nested fallback
+            return col
+        return lambda rec, e=e, ev=ev: ev._col(e, rec)
+    if isinstance(e, Un):
+        inner = _compile_expr(e.e, ev)
+        if e.op == "neg":
+            def neg(rec, inner=inner):
+                v = _num(inner(rec))
+                if not isinstance(v, (int, float)):
+                    raise SQLError("cannot negate non-number")
+                return -v
+            return neg
+        tr = ev._truth
+        return lambda rec, inner=inner, tr=tr: not tr(inner(rec))
+    if isinstance(e, Bin):
+        lf = _compile_expr(e.l, ev)
+        rf = _compile_expr(e.r, ev)
+        tr = ev._truth
+        if e.op == "and":
+            return lambda rec: tr(lf(rec)) and tr(rf(rec))
+        if e.op == "or":
+            return lambda rec: tr(lf(rec)) or tr(rf(rec))
+        if e.op in ("=", "!="):
+            eq = ev._eq
+            if e.op == "=":
+                return lambda rec: eq(lf(rec), rf(rec))
+
+            def ne(rec):
+                lv, rv = lf(rec), rf(rec)
+                if lv is None or rv is None:
+                    return False
+                return not eq(lv, rv)
+            return ne
+        if e.op in _ORD_OPS:
+            cmpf = _ORD_OPS[e.op]
+
+            def ordcmp(rec, cmpf=cmpf):
+                lv, rv = lf(rec), rf(rec)
+                if lv is None or rv is None:
+                    return False
+                a, b = _cmp_pair(lv, rv)
+                try:
+                    return cmpf(a, b)
+                except TypeError:
+                    raise SQLError("incomparable operands")
+            return ordcmp
+        opc = e.op
+
+        def arith(rec, opc=opc):
+            a, b = _num(lf(rec)), _num(rf(rec))
+            if not isinstance(a, (int, float)) or isinstance(a, bool) \
+                    or not isinstance(b, (int, float)) \
+                    or isinstance(b, bool):
+                raise SQLError(
+                    f"arithmetic on non-numbers: {a!r} {opc} {b!r}")
+            if opc == "+":
+                return a + b
+            if opc == "-":
+                return a - b
+            if opc == "*":
+                return a * b
+            if b == 0:
+                raise SQLError("division by zero")
+            return a / b if opc == "/" else a % b
+        return arith
+    if isinstance(e, Like):
+        vf = _compile_expr(e.e, ev)
+        negate = e.negate
+        if isinstance(e.pat, Lit) and (
+                e.esc is None or isinstance(e.esc, Lit)):
+            # constant pattern: regex compiled ONCE (value() recompiles
+            # per record)
+            rx = _like_to_re(str(e.pat.v),
+                             str(e.esc.v) if e.esc is not None else None)
+
+            def like(rec, rx=rx, negate=negate):
+                v = vf(rec)
+                if v is None:
+                    return None
+                ok = bool(rx.match(str(v)))
+                return not ok if negate else ok
+            return like
+        pf = _compile_expr(e.pat, ev)
+        ef = _compile_expr(e.esc, ev) if e.esc is not None else None
+
+        def like_dyn(rec):
+            v = vf(rec)
+            if v is None:
+                return None
+            ok = bool(_like_to_re(
+                str(pf(rec)), ef(rec) if ef else None).match(str(v)))
+            return not ok if negate else ok
+        return like_dyn
+    if isinstance(e, InList):
+        vf = _compile_expr(e.e, ev)
+        fns = [_compile_expr(x, ev) for x in e.items]
+        negate = e.negate
+        eq = ev._eq
+
+        def inlist(rec):
+            v = vf(rec)
+            if v is None:
+                return None  # SQL 3VL, as in Evaluator.value
+            hit = any(eq(v, f(rec)) for f in fns)
+            return not hit if negate else hit
+        return inlist
+    if isinstance(e, Between):
+        vf = _compile_expr(e.e, ev)
+        lof = _compile_expr(e.lo, ev)
+        hif = _compile_expr(e.hi, ev)
+        negate = e.negate
+
+        def between(rec):
+            v = vf(rec)
+            if v is None:
+                return None  # SQL 3VL, as in Evaluator.value
+            a, l2 = _cmp_pair(v, lof(rec))
+            b, h2 = _cmp_pair(v, hif(rec))
+            ok = l2 <= a and b <= h2
+            return not ok if negate else ok
+        return between
+    if isinstance(e, IsNull):
+        vf = _compile_expr(e.e, ev)
+        negate = e.negate
+
+        def isnull(rec):
+            v = vf(rec)
+            r = v is None or v == ""
+            return not r if negate else r
+        return isnull
+    if isinstance(e, Cast):
+        vf = _compile_expr(e.e, ev)
+        typ = e.typ
+        return lambda rec: ev._cast(vf(rec), typ)
+    if isinstance(e, Func):
+        # bind arg closures; dispatch resolved once via a Func shim
+        # that reuses _scalar_fn's semantics on prepared values
+        shim = Func(e.name, [Lit(None) for _ in e.args], star=e.star)
+        argfs = [_compile_expr(a, ev) for a in e.args]
+
+        def func(rec, shim=shim, argfs=argfs):
+            for lit, f in zip(shim.args, argfs):
+                lit.v = f(rec)
+            return ev._scalar_fn(shim, rec)
+        return func
+    # Star or anything exotic: fall back to the interpreter
+    return lambda rec: ev.value(e, rec)
+
+
+def compile_predicate(ev: "Evaluator"):
+    """-> fn(rec) -> bool equivalent to ev.matches."""
+    if ev.q.where is None:
+        return lambda rec: True
+    f = _compile_expr(ev.q.where, ev)
+    tr = ev._truth
+    return lambda rec: tr(f(rec))
+
+
+def compile_projection(ev: "Evaluator"):
+    """-> fn(rec) -> dict equivalent to ev.project."""
+    if ev.q.star:
+        return lambda rec: rec
+    items = [
+        (p.alias or Evaluator._auto_name(p.expr, i),
+         _compile_expr(p.expr, ev))
+        for i, p in enumerate(ev.q.projections)
+    ]
+    return lambda rec: {k: f(rec) for k, f in items}

@@ -424,6 +424,13 @@ class TestChunkedWireFormat:
             while b"\r\n\r\n" not in buf:
                 buf += conn.recv(65536)
             captured["head"] = buf.split(b"\r\n\r\n", 1)[0]
+            # drain the chunked body fully BEFORE responding: closing
+            # early races the client's sendall into EPIPE
+            while b"0\r\n\r\n" not in buf:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
             conn.sendall(b"HTTP/1.1 200 OK\r\nContent-Length: 0\r\n\r\n")
             conn.close()
             done.set()

@@ -111,6 +111,11 @@ class TestMetricsEndpoint:
         assert "minio_cluster_drive_online_total 4" in body
         assert "minio_node_uptime_seconds" in body
         assert "minio_heal_mrf_pending" in body
+        # select engine-tier counters (VERDICT r4 #1: the fast-path
+        # eligibility cliff is observable)
+        assert "minio_select_native_queries_total" in body
+        assert "minio_select_native_fallback_total" in body
+        assert "minio_select_row_engine_queries_total" in body
 
     def test_public_env_allows_anonymous(self, srv):
         os.environ["MINIO_PROMETHEUS_AUTH_TYPE"] = "public"

@@ -1,0 +1,341 @@
+"""Native C++ Select path: byte-identical to the row engine on clean
+AND garbage data (the ambiguity-replay contract of csrc/select_scan.cpp
++ select/native.py; reference perf analogue internal/s3select/simdj).
+"""
+
+import io
+import os
+
+import pytest
+
+from minio_tpu import select as sel
+from minio_tpu.select import eventstream as es
+from minio_tpu.select import native
+
+
+def _run(expr, data: bytes, inp=None, out=None, tier="native"):
+    """tier: native (default dispatch), row (everything disabled)."""
+    env = {}
+    if tier == "row":
+        env["MINIO_TPU_SELECT_COLUMNAR"] = "0"
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        req = sel.SelectRequest(expr, inp or {"CSV": {}},
+                                out or {"CSV": {}})
+        return b"".join(sel.run_select(req, io.BytesIO(data), len(data)))
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _differential(expr, data, inp=None, out=None, require_native=True):
+    before = native.stats["native"]
+    fast = _run(expr, data, inp, out, tier="native")
+    slow = _run(expr, data, inp, out, tier="row")
+    assert fast == slow, (expr, fast[:300], slow[:300])
+    if require_native:
+        assert native.stats["native"] == before + 1, \
+            f"native path did not engage for {expr}"
+
+
+CLEAN = ("a,b,c\n" + "".join(
+    f"r{i},{i * 37 % 1000},{i % 97}\n" for i in range(5000))).encode()
+
+# garbage: whitespace-padded numbers, underscores, inf/nan, big ints,
+# unicode digits, empty cells, ragged rows — everything the strict C
+# parser must hand back to Python
+DIRTY = (
+    "a,b,c\n"
+    "x, 5 ,1\n"          # whitespace-padded number (Python int(' 5 ')=5)
+    "y,5_0,2\n"          # underscore digits (Python int('5_0')=50)
+    "z,inf,3\n"          # float('inf')
+    "w,nan,4\n"
+    "u,99999999999999999999,5\n"   # > 2^53: exact-int compare
+    "v,٥٠,6\n"           # arabic-indic '50'
+    "t,,7\n"             # empty cell
+    "s,0x1f,8\n"         # not a Python number -> text
+    "r,3.14,9\n"
+    "q,-42,10\n"
+    "p,+17,11\n"
+    "o,1e3,12\n"
+    "n,.5,13\n"
+    "m,5.,14\n"
+).encode()
+
+QUOTED = (
+    'a,b,c\n'
+    '"alpha",1,x\n'
+    '"be,ta",2,y\n'       # embedded delimiter
+    '"ga""mma",3,z\n'     # doubled quote
+    '"del\nta",4,w\n'     # embedded newline
+    'plain,5,v\n'
+    '"600",600,u\n'       # quoted number
+).encode()
+
+
+class TestCSVDifferential:
+    @pytest.mark.parametrize("expr", [
+        "SELECT COUNT(*) FROM s3object",
+        "SELECT COUNT(*) FROM s3object WHERE b > 500",
+        "SELECT COUNT(*) FROM s3object WHERE 500 < b",
+        "SELECT COUNT(*) FROM s3object WHERE b = 111",
+        "SELECT COUNT(*) FROM s3object WHERE b != 0 AND c <= 50",
+        "SELECT COUNT(*) FROM s3object WHERE a LIKE 'r1%'",
+        "SELECT COUNT(*) FROM s3object WHERE a LIKE 'r_2'",
+        "SELECT COUNT(*) FROM s3object WHERE a NOT LIKE 'r%'",
+        "SELECT COUNT(*) FROM s3object WHERE b IN (1, 500, 999)",
+        "SELECT COUNT(*) FROM s3object WHERE a IN ('r1', 'r4999')",
+        "SELECT COUNT(*) FROM s3object WHERE b BETWEEN 100 AND 200",
+        "SELECT COUNT(*) FROM s3object WHERE b NOT BETWEEN 5 AND 995",
+        "SELECT COUNT(*) FROM s3object WHERE a IS NULL",
+        "SELECT COUNT(*) FROM s3object WHERE a IS NOT NULL",
+        "SELECT COUNT(*) FROM s3object WHERE NOT b > 500",
+        "SELECT COUNT(*), SUM(b), MIN(b), MAX(b), AVG(c) FROM s3object",
+        "SELECT SUM(b) FROM s3object WHERE c > 50",
+        "SELECT MIN(a), MAX(a) FROM s3object",
+        "SELECT COUNT(b) FROM s3object WHERE b >= 0",
+        "SELECT COUNT(*) FROM s3object WHERE a = 'r7' OR b = 74",
+    ])
+    def test_clean_data(self, expr):
+        _differential(expr, CLEAN)
+
+    @pytest.mark.parametrize("expr", [
+        "SELECT COUNT(*) FROM s3object WHERE b > 10",
+        "SELECT COUNT(*) FROM s3object WHERE b = 50",
+        "SELECT COUNT(*) FROM s3object WHERE b >= 1000",
+        "SELECT COUNT(*) FROM s3object WHERE b IS NULL",
+        "SELECT MIN(b), MAX(b) FROM s3object WHERE c < 10",
+        "SELECT COUNT(b) FROM s3object",
+    ])
+    def test_dirty_data_replays(self, expr):
+        """Ambiguous cells force the Python replay — results must stay
+        identical to the row engine."""
+        _differential(expr, DIRTY)
+
+    def test_dirty_sum_raises_like_row_engine(self):
+        fast = _run("SELECT SUM(b) FROM s3object", DIRTY)
+        slow = _run("SELECT SUM(b) FROM s3object", DIRTY, tier="row")
+        assert fast == slow  # both yield an in-band error event
+        assert b"InvalidQuery" in fast or b":error" in fast
+
+    @pytest.mark.parametrize("expr", [
+        "SELECT COUNT(*) FROM s3object WHERE b > 2",
+        "SELECT COUNT(*) FROM s3object WHERE a = 'be,ta'",
+        'SELECT COUNT(*) FROM s3object WHERE a = \'ga"mma\'',
+        "SELECT COUNT(*) FROM s3object WHERE b = 600",
+        "SELECT MIN(b), MAX(b) FROM s3object",
+    ])
+    def test_quoted_cells(self, expr):
+        _differential(expr, QUOTED)
+
+    def test_star_passthrough_emit(self):
+        for expr in ("SELECT * FROM s3object WHERE b > 500",
+                     "SELECT * FROM s3object",
+                     "SELECT * FROM s3object WHERE b > 100 LIMIT 7"):
+            _differential(expr, CLEAN)
+
+    def test_star_emit_with_quotes_replays(self):
+        # quoted rows re-serialize through the row-engine writer
+        for expr in ("SELECT * FROM s3object WHERE b >= 1",
+                     "SELECT * FROM s3object LIMIT 3"):
+            _differential(expr, QUOTED)
+
+    def test_blank_lines_and_crlf(self):
+        data = b"a,b\nr1,1\n\nr2,2\r\n\r\nr3,3\n"
+        for expr in ("SELECT COUNT(*) FROM s3object",
+                     "SELECT COUNT(*) FROM s3object WHERE b > 1",
+                     "SELECT * FROM s3object WHERE b > 0"):
+            _differential(expr, data)
+
+    def test_final_record_without_newline(self):
+        data = b"a,b\nr1,1\nr2,2"
+        _differential("SELECT COUNT(*) FROM s3object WHERE b > 0", data)
+        _differential("SELECT * FROM s3object WHERE b = 2", data)
+
+    def test_header_modes(self):
+        data = b"x,y\n1,2\n3,4\n"
+        _differential("SELECT COUNT(*) FROM s3object WHERE _1 > 0", data,
+                      inp={"CSV": {"FileHeaderInfo": "IGNORE"}})
+        _differential("SELECT COUNT(*) FROM s3object WHERE _2 > 2", data,
+                      inp={"CSV": {"FileHeaderInfo": "NONE"}})
+
+    def test_unterminated_quote_matches_row_engine(self):
+        data = b'a,b\n"open,1\n'
+        _differential("SELECT COUNT(*) FROM s3object", data)
+
+    def test_gzip_compression(self):
+        import gzip
+
+        gz = gzip.compress(CLEAN)
+        before = native.stats["native"]
+        fast = _run("SELECT COUNT(*) FROM s3object WHERE b > 500", gz,
+                    inp={"CSV": {}, "CompressionType": "GZIP"})
+        slow = _run("SELECT COUNT(*) FROM s3object WHERE b > 500", gz,
+                    inp={"CSV": {}, "CompressionType": "GZIP"},
+                    tier="row")
+        assert fast == slow
+        assert native.stats["native"] == before + 1
+
+    def test_custom_delimiter(self):
+        data = b"a|b\nr1|5\nr2|10\n"
+        _differential("SELECT COUNT(*) FROM s3object WHERE b > 7", data,
+                      inp={"CSV": {"FieldDelimiter": "|"}})
+
+    def test_json_output_of_aggregate(self):
+        _differential("SELECT COUNT(*), AVG(b) FROM s3object "
+                      "WHERE b < 100", CLEAN, out={"JSON": {}})
+
+    def test_multiblock_stream(self):
+        """Data larger than one 4 MiB chunk streams block-by-block."""
+        big = ("a,b\n" + "".join(
+            f"r{i},{i % 1000}\n" for i in range(400_000))).encode()
+        assert len(big) > (4 << 20)
+        _differential("SELECT COUNT(*) FROM s3object WHERE b > 500", big)
+        _differential("SELECT SUM(b), MIN(b), MAX(b) FROM s3object", big)
+
+
+JLINES = ("".join(
+    '{"k":"u%d","n":%d,"f":%s}\n' % (i, i * 37 % 1000, f"{i * 0.5:g}")
+    for i in range(4000))).encode()
+
+JDIRTY = (
+    '{"k":"a","n":5}\n'
+    '{"k":"b"}\n'                          # missing n
+    '{"k":"c","n":null}\n'
+    '{"k":"d","n":true}\n'                 # bool in numeric compare
+    '{"k":"e","n":"60"}\n'                 # numeric string
+    '{"k":"f","n":"x\\"y"}\n'              # escaped string
+    '{"k":"g","n":{"deep":1}}\n'           # nested value
+    '{"k":"h","n":99999999999999999999}\n'  # big int
+    '\n'                                    # blank line
+    '{"k":"i","n":-3.5e2}\n'
+    '{"n":7,"n":8}\n'                       # duplicate key: last wins
+).encode()
+
+
+class TestJSONDifferential:
+    @pytest.mark.parametrize("expr", [
+        "SELECT COUNT(*) FROM s3object",
+        "SELECT COUNT(*) FROM s3object WHERE n > 500",
+        "SELECT COUNT(*) FROM s3object WHERE k LIKE 'u1%'",
+        "SELECT COUNT(*) FROM s3object WHERE n BETWEEN 10 AND 20",
+        "SELECT COUNT(*) FROM s3object WHERE k IN ('u1', 'u3999')",
+        "SELECT COUNT(*) FROM s3object WHERE n IS NULL",
+        "SELECT COUNT(*), SUM(n), MIN(n), MAX(f), AVG(n) FROM s3object",
+        "SELECT SUM(f) FROM s3object WHERE n < 100",
+        "SELECT COUNT(n) FROM s3object",
+    ])
+    def test_clean_lines(self, expr):
+        _differential(expr, JLINES,
+                      inp={"JSON": {"Type": "LINES"}}, out={"JSON": {}})
+
+    @pytest.mark.parametrize("expr", [
+        "SELECT COUNT(*) FROM s3object WHERE n > 4",
+        "SELECT COUNT(*) FROM s3object WHERE n = 60",
+        "SELECT COUNT(*) FROM s3object WHERE n IS NULL",
+        "SELECT COUNT(n) FROM s3object",
+        "SELECT MIN(n), MAX(n) FROM s3object WHERE n < 1000000",
+    ])
+    def test_dirty_lines_replay(self, expr):
+        _differential(expr, JDIRTY,
+                      inp={"JSON": {"Type": "LINES"}}, out={"JSON": {}})
+
+    def test_invalid_line_errors_like_row_engine(self):
+        bad = b'{"n":1}\n{not json}\n{"n":2}\n'
+        inp = {"JSON": {"Type": "LINES"}}
+        fast = _run("SELECT COUNT(*) FROM s3object", bad, inp,
+                    {"JSON": {}})
+        slow = _run("SELECT COUNT(*) FROM s3object", bad, inp,
+                    {"JSON": {}}, tier="row")
+        assert fast == slow
+        assert b"InvalidQuery" in fast
+
+    def test_count_star_where_on_missing_key(self):
+        _differential("SELECT COUNT(*) FROM s3object WHERE zz > 1",
+                      JDIRTY, inp={"JSON": {"Type": "LINES"}},
+                      out={"JSON": {}})
+
+
+class TestReviewFindings:
+    """Regression cases from the round-5 code review."""
+
+    def test_not_in_not_between_on_missing_cells(self):
+        """SQL 3VL: NULL [NOT] IN / [NOT] BETWEEN is NULL (row filtered)
+        in every tier — ragged rows must not diverge."""
+        data = b"a,b,c\nr1,1,x\nr2\nr3,3,z\n"  # r2 is ragged: b missing
+        for expr in (
+                "SELECT COUNT(*) FROM s3object WHERE b NOT IN (1, 9)",
+                "SELECT COUNT(*) FROM s3object WHERE b IN (1, 3)",
+                "SELECT COUNT(*) FROM s3object "
+                "WHERE b NOT BETWEEN 0 AND 2",
+                "SELECT COUNT(*) FROM s3object WHERE b BETWEEN 0 AND 9"):
+            _differential(expr, data)
+
+    def test_bad_json_line_with_isnull_only_where(self):
+        """A malformed NDJSON line must raise InvalidQuery even when
+        the WHERE is IS [NOT] NULL-only (type-6 rows replay)."""
+        bad = b'{"a":1,"n":2}\n{bad line}\n{"a":3,"n":4}\n'
+        inp = {"JSON": {"Type": "LINES"}}
+        for expr in ("SELECT COUNT(*) FROM s3object WHERE a IS NOT NULL",
+                     "SELECT SUM(n) FROM s3object WHERE a IS NULL"):
+            fast = _run(expr, bad, inp, {"JSON": {}})
+            slow = _run(expr, bad, inp, {"JSON": {}}, tier="row")
+            assert fast == slow, expr
+            assert b"InvalidQuery" in fast, expr
+
+    def test_isnull_on_nested_json_value_replays(self):
+        data = (b'{"a":{"x":1},"n":1}\n'
+                b'{"a":null,"n":2}\n'
+                b'{"n":3}\n'
+                b'{"a":"","n":4}\n')
+        inp = {"JSON": {"Type": "LINES"}}
+        for expr in ("SELECT COUNT(*) FROM s3object WHERE a IS NULL",
+                     "SELECT COUNT(*) FROM s3object WHERE a IS NOT NULL"):
+            _differential(expr, data, inp=inp, out={"JSON": {}})
+
+    def test_giant_record_emit_does_not_overflow(self):
+        """A record larger than the read chunk (tail + CHUNK blocks)
+        must stream through SELECT * without overflowing the emit
+        buffer (review finding: fixed-size emit_buf)."""
+        giant = b"g" * (5 << 20)  # one 5 MiB cell
+        data = b"a,b\n" + b"r1,1\n" + giant + b",2\n" + b"r3,3\n"
+        fast = _run("SELECT * FROM s3object WHERE b > 0", data)
+        slow = _run("SELECT * FROM s3object WHERE b > 0", data,
+                    tier="row")
+
+        def recs(stream):
+            return b"".join(
+                e["payload"] for e in es.decode_all(stream)
+                if e["headers"].get(":event-type") == "Records")
+
+        # flush boundaries may differ for multi-MiB payloads; the
+        # record bytes must not
+        assert recs(fast) == recs(slow)
+
+
+class TestNativeFallbacks:
+    def test_unsupported_queries_fall_through(self):
+        """Functions/CAST/arithmetic are beyond the native leaf
+        language — they must fall back (and count it) yet still answer
+        correctly via the lower tiers."""
+        before = native.stats["fallback"]
+        fast = _run("SELECT COUNT(*) FROM s3object "
+                    "WHERE CHAR_LENGTH(a) > 2", CLEAN)
+        slow = _run("SELECT COUNT(*) FROM s3object "
+                    "WHERE CHAR_LENGTH(a) > 2", CLEAN, tier="row")
+        assert fast == slow
+        assert native.stats["fallback"] == before + 1
+
+    def test_projection_subset_falls_to_columnar(self):
+        from minio_tpu.select import columnar
+
+        before = columnar.stats["fast"]
+        fast = _run("SELECT a FROM s3object WHERE b > 900", CLEAN)
+        slow = _run("SELECT a FROM s3object WHERE b > 900", CLEAN,
+                    tier="row")
+        assert fast == slow
+        assert columnar.stats["fast"] == before + 1
