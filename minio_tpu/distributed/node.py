@@ -91,7 +91,8 @@ class ClusterNode:
                  access_key: str = "minioadmin", secret_key: str = "minioadmin",
                  region: str = "us-east-1", set_size: int | None = None,
                  start_services: bool = True,
-                 scan_interval: float = 60.0, heal_interval: float = 3600.0):
+                 scan_interval: float = 60.0, heal_interval: float = 3600.0,
+                 cache_dir: str = "", cache_size: int = 10 << 30):
         self.secret = secret_key
         # pool grouping (cmd/endpoint-ellipses.go:341
         # createServerEndpoints): args without any ellipses form ONE pool
@@ -186,7 +187,18 @@ class ClusterNode:
             for i, disks in enumerate(pool_disks)
         ])
 
-        self.s3 = S3Server(self.pools, access_key=access_key,
+        # server-mode disk cache: cacheObjects wraps ANY ObjectLayer when
+        # cache drives are configured (reference cmd/disk-cache.go:103) —
+        # the API plane reads through the SSD cache while background
+        # services (heal/scanner/...) keep operating on the erasure layer
+        api_layer = self.pools
+        if cache_dir:
+            from minio_tpu.gateway.cache import CacheLayer
+
+            api_layer = CacheLayer(self.pools, cache_dir,
+                                   max_size=cache_size)
+
+        self.s3 = S3Server(api_layer, access_key=access_key,
                            secret_key=secret_key, region=region)
         self.s3.locker = self.locker
         self.services = None

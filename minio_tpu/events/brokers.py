@@ -1,5 +1,6 @@
 """Broker-backed notification targets: Kafka, MQTT, Redis, NATS, NSQ,
-AMQP 0-9-1, PostgreSQL.
+AMQP 0-9-1, PostgreSQL, MySQL, Elasticsearch — with webhook in
+targets.py that is the reference's full 10-target matrix.
 
 Wire-protocol clients written directly on sockets (no client libraries in
 this image), each implementing the same target interface as
@@ -13,7 +14,9 @@ internal/event/target/redis.go (HSET for "namespace" format, RPUSH for
 "access", :238), internal/event/target/nats.go (:301),
 internal/event/target/nsq.go (go-nsq producer),
 internal/event/target/amqp.go (streadway/amqp publisher),
-internal/event/target/postgresql.go (database/sql INSERT/UPSERT).
+internal/event/target/postgresql.go (database/sql INSERT/UPSERT),
+internal/event/target/mysql.go (:142,187),
+internal/event/target/elasticsearch.go (:155,187).
 """
 
 from __future__ import annotations
@@ -712,9 +715,14 @@ class PostgresTarget(_SocketTarget):
         value = self._lit(json.dumps(log))
         if self.fmt == _FMT_NAMESPACE:
             key = self._lit(log.get("Key", ""))
-            sql = (f"INSERT INTO {self.table} (key, value) "
-                   f"VALUES ({key}, {value}) "
-                   f"ON CONFLICT (key) DO UPDATE SET value = {value}")
+            if log.get("EventName", "").startswith("s3:ObjectRemoved:"):
+                # namespace rows mirror the bucket: removals delete
+                # (reference postgresql.go executeStmts delete branch)
+                sql = f"DELETE FROM {self.table} WHERE key = {key}"
+            else:
+                sql = (f"INSERT INTO {self.table} (key, value) "
+                       f"VALUES ({key}, {value}) "
+                       f"ON CONFLICT (key) DO UPDATE SET value = {value}")
         else:
             sql = (f"INSERT INTO {self.table} (event_time, event_data) "
                    f"VALUES (NOW(), {value})")
@@ -727,3 +735,285 @@ def _pg_error(body: bytes) -> str:
         if field[:1] and len(field) > 1:
             parts[chr(field[0])] = field[1:].decode(errors="replace")
     return parts.get("M", "unknown error")
+
+
+# ------------------------------------------------------------- Elasticsearch
+
+
+class ElasticsearchTarget:
+    """Elasticsearch REST target over a persistent HTTP connection
+    (reference internal/event/target/elasticsearch.go:155,187 — the
+    official client is HTTP underneath).  format="namespace" indexes
+    one document per object key (and DELETEs it again on
+    s3:ObjectRemoved:*); "access" appends auto-id documents with a
+    timestamp."""
+
+    kind = "elasticsearch"
+
+    def __init__(self, target_name: str, host: str, port: int, index: str,
+                 fmt: str = _FMT_ACCESS, username: str = "",
+                 password: str = "", timeout: float = 5.0):
+        if fmt not in (_FMT_NAMESPACE, _FMT_ACCESS):
+            raise ValueError(f"elasticsearch format {fmt!r}")
+        if not index or index != index.lower() or "/" in index:
+            raise ValueError(f"bad elasticsearch index {index!r}")
+        self.name = target_name
+        self.host = host
+        self.port = port
+        self.index = index
+        self.fmt = fmt
+        self.username = username
+        self.password = password
+        self.timeout = timeout
+        self._conn = None
+        self._ready = False
+        self._lock = threading.Lock()
+
+    # -- plumbing -----------------------------------------------------------
+    def _headers(self) -> dict:
+        h = {"Content-Type": "application/json"}
+        if self.username:
+            import base64
+
+            cred = f"{self.username}:{self.password}".encode()
+            h["Authorization"] = "Basic " + base64.b64encode(cred).decode()
+        return h
+
+    def _request(self, method: str, path: str, body: bytes | None = None,
+                 ok=(200, 201), ignore=()) -> tuple[int, bytes]:
+        import http.client
+
+        if self._conn is None:
+            self._conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout)
+        self._conn.request(method, path, body=body,
+                           headers=self._headers())
+        resp = self._conn.getresponse()
+        data = resp.read()
+        if resp.status not in ok and resp.status not in ignore:
+            raise TargetError(
+                f"elasticsearch {method} {path}: {resp.status} "
+                f"{data[:200]!r}")
+        return resp.status, data
+
+    def _ensure_index(self) -> None:
+        if not self._ready:
+            status, data = self._request("PUT", f"/{self.index}", b"{}",
+                                         ignore=(400,))
+            # only "already exists" is a benign 400; any other 400
+            # (invalid_index_name_exception, ...) would otherwise doom
+            # every delivery to an endless retry loop
+            if status == 400 and b"resource_already_exists" not in data:
+                raise TargetError(
+                    f"elasticsearch index {self.index!r} rejected: "
+                    f"{data[:200]!r}")
+            self._ready = True
+
+    def send(self, log: dict) -> None:
+        import urllib.parse as up
+
+        with self._lock:
+            try:
+                self._ensure_index()
+                if self.fmt == _FMT_NAMESPACE:
+                    doc_id = up.quote(log.get("Key", ""), safe="")
+                    ev = log.get("EventName", "")
+                    if ev.startswith("s3:ObjectRemoved:"):
+                        # 404: already gone — deletion is idempotent
+                        self._request(
+                            "DELETE", f"/{self.index}/_doc/{doc_id}",
+                            ignore=(404,))
+                    else:
+                        self._request(
+                            "PUT", f"/{self.index}/_doc/{doc_id}",
+                            json.dumps(log).encode())
+                else:
+                    body = dict(log)
+                    body.setdefault("timestamp", time.strftime(
+                        "%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+                    self._request("POST", f"/{self.index}/_doc",
+                                  json.dumps(body).encode())
+            except TargetError:
+                self._drop()
+                raise
+            except Exception as e:
+                self._drop()
+                raise TargetError(
+                    f"elasticsearch {self.host}:{self.port}: {e}") from e
+
+    def _drop(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
+        self._ready = False
+
+    def close(self) -> None:
+        with self._lock:
+            self._drop()
+
+    @property
+    def target_id(self) -> str:
+        return f"{self.name}:{self.kind}"
+
+    def arn(self, region: str) -> str:
+        return f"arn:minio:sqs:{region}:{self.name}:{self.kind}"
+
+
+# -------------------------------------------------------------------- MySQL
+
+
+class MySQLTarget(_SocketTarget):
+    """MySQL client/server protocol: handshake v10 +
+    mysql_native_password auth, then COM_QUERY INSERT/REPLACE into an
+    events table created on first connect (reference
+    internal/event/target/mysql.go:142,187 via go-sql-driver).
+    format="namespace" keeps one row per object key (REPLACE INTO,
+    DELETE on s3:ObjectRemoved:*); "access" appends
+    (event_time, event_data) rows."""
+
+    kind = "mysql"
+
+    def __init__(self, target_name: str, host: str, port: int, table: str,
+                 database: str = "minio", username: str = "root",
+                 password: str = "", fmt: str = _FMT_ACCESS,
+                 timeout: float = 5.0):
+        if fmt not in (_FMT_NAMESPACE, _FMT_ACCESS):
+            raise ValueError(f"mysql format {fmt!r}")
+        if not table.replace("_", "").isalnum():
+            raise ValueError(f"unsafe table name {table!r}")
+        super().__init__(host, port, timeout)
+        self.name = target_name
+        self.table = table
+        self.database = database
+        self.username = username
+        self.password = password
+        self.fmt = fmt
+
+    # -- packet framing: 3-byte LE length + sequence id ---------------------
+    def _read_packet(self, sock) -> tuple[int, bytes]:
+        head = _recv_exact(sock, 4)
+        size = head[0] | (head[1] << 8) | (head[2] << 16)
+        return head[3], _recv_exact(sock, size)
+
+    def _write_packet(self, sock, seq: int, payload: bytes) -> None:
+        size = len(payload)
+        sock.sendall(bytes((size & 0xFF, (size >> 8) & 0xFF,
+                            (size >> 16) & 0xFF, seq & 0xFF)) + payload)
+
+    @staticmethod
+    def _native_auth(password: str, salt: bytes) -> bytes:
+        """SHA1(pw) XOR SHA1(salt + SHA1(SHA1(pw))) — the
+        mysql_native_password scramble."""
+        import hashlib as _h
+
+        if not password:
+            return b""
+        h1 = _h.sha1(password.encode()).digest()
+        h2 = _h.sha1(h1).digest()
+        h3 = _h.sha1(salt + h2).digest()
+        return bytes(a ^ b for a, b in zip(h1, h3))
+
+    @staticmethod
+    def _err_text(payload: bytes) -> str:
+        # ERR: 0xff, code(2), sql-state-marker '#' + state(5), message
+        msg = payload[3:]
+        if msg[:1] == b"#":
+            msg = msg[6:]
+        return msg.decode(errors="replace")
+
+    def _handshake(self, sock: socket.socket) -> None:
+        seq, pkt = self._read_packet(sock)
+        if pkt[:1] == b"\xff":
+            raise TargetError(f"mysql: {self._err_text(pkt)}")
+        if pkt[0] != 10:
+            raise TargetError(f"mysql protocol {pkt[0]} unsupported")
+        off = 1
+        off = pkt.index(b"\x00", off) + 1        # server version
+        off += 4                                  # thread id
+        salt = pkt[off:off + 8]                   # auth-plugin-data-1
+        off += 8 + 1                              # + filler
+        off += 2                                  # capabilities (low)
+        plugin = b"mysql_native_password"
+        if len(pkt) > off:
+            off += 1 + 2 + 2                      # charset+status+cap hi
+            alen = pkt[off]
+            off += 1 + 10                         # len + reserved
+            extra = max(13, alen - 8) if alen else 13
+            salt += pkt[off:off + extra].rstrip(b"\x00")
+            off += extra
+            if off < len(pkt):
+                plugin = pkt[off:].split(b"\x00", 1)[0]
+        salt = salt[:20]
+        if plugin != b"mysql_native_password":
+            # caching_sha2 full auth needs TLS/RSA; fail with a clear
+            # operator message (create the notify user WITH
+            # mysql_native_password)
+            raise TargetError(
+                f"mysql auth plugin {plugin.decode(errors='replace')!r} "
+                "unsupported (use mysql_native_password)")
+        caps = (0x00000001 | 0x00000008 | 0x00000200 | 0x00002000
+                | 0x00008000 | 0x00080000)
+        # LONG_PASSWORD | CONNECT_WITH_DB | PROTOCOL_41 | TRANSACTIONS
+        # | SECURE_CONNECTION | PLUGIN_AUTH
+        auth = self._native_auth(self.password, salt)
+        payload = (struct.pack("<IIB", caps, 1 << 24, 33)  # utf8
+                   + b"\x00" * 23
+                   + self.username.encode() + b"\x00"
+                   + bytes((len(auth),)) + auth
+                   + self.database.encode() + b"\x00"
+                   + b"mysql_native_password\x00")
+        self._write_packet(sock, seq + 1, payload)
+        seq, pkt = self._read_packet(sock)
+        if pkt[:1] == b"\xfe":  # auth switch request
+            plugin2, _, salt2 = pkt[1:].partition(b"\x00")
+            if plugin2 != b"mysql_native_password":
+                raise TargetError(
+                    f"mysql auth switch to "
+                    f"{plugin2.decode(errors='replace')!r} unsupported")
+            self._write_packet(sock, seq + 1, self._native_auth(
+                self.password, salt2.rstrip(b"\x00")[:20]))
+            seq, pkt = self._read_packet(sock)
+        if pkt[:1] == b"\xff":
+            raise TargetError(f"mysql: {self._err_text(pkt)}")
+        if pkt[:1] != b"\x00":
+            raise TargetError("mysql: unexpected auth reply")
+        if self.fmt == _FMT_NAMESPACE:
+            ddl = (f"CREATE TABLE IF NOT EXISTS {self.table} "
+                   f"(key_name VARCHAR(2048) NOT NULL, value MEDIUMTEXT, "
+                   f"PRIMARY KEY (key_name(255)))")
+        else:
+            ddl = (f"CREATE TABLE IF NOT EXISTS {self.table} "
+                   f"(event_time DATETIME NOT NULL, "
+                   f"event_data MEDIUMTEXT)")
+        self._query(sock, ddl)
+
+    def _query(self, sock, sql: str) -> None:
+        # COM_QUERY starts a fresh sequence
+        self._write_packet(sock, 0, b"\x03" + sql.encode())
+        _, pkt = self._read_packet(sock)
+        if pkt[:1] == b"\xff":
+            raise TargetError(f"mysql: {self._err_text(pkt)}")
+        # OK packet (0x00) expected for DDL/DML; anything else (a
+        # resultset) would mean we sent a SELECT — we never do
+
+    @staticmethod
+    def _lit(s: str) -> str:
+        # MySQL string literal: backslash escapes are on by default
+        return ("'" + s.replace("\\", "\\\\").replace("'", "''") + "'")
+
+    def _publish(self, sock: socket.socket, log: dict) -> None:
+        value = self._lit(json.dumps(log))
+        if self.fmt == _FMT_NAMESPACE:
+            key = self._lit(log.get("Key", ""))
+            if log.get("EventName", "").startswith("s3:ObjectRemoved:"):
+                sql = f"DELETE FROM {self.table} WHERE key_name = {key}"
+            else:
+                sql = (f"REPLACE INTO {self.table} (key_name, value) "
+                       f"VALUES ({key}, {value})")
+        else:
+            sql = (f"INSERT INTO {self.table} (event_time, event_data) "
+                   f"VALUES (NOW(), {value})")
+        self._query(sock, sql)

@@ -3,8 +3,10 @@
 Reference: cmd/disk-cache.go + cmd/disk-cache-backend.go (cacheObjects
 wrapping the ObjectLayer — GETs tee through local SSD cache dirs with
 ETag validation, LRU eviction between low/high watermarks, write paths
-invalidating).  Primarily used in gateway mode, where the backend is a
-remote service and a local cache saves WAN round trips.
+invalidating).  Wraps ANY ObjectLayer: the S3 gateway (saving WAN round
+trips) or the erasure server pools (--cache-dir in server mode, where a
+local SSD shortcuts the quorum read path; the background services keep
+operating on the inner erasure layer).
 """
 
 from __future__ import annotations

@@ -46,10 +46,13 @@ def main(argv=None) -> int:
                         "MINIO_TPU_HEAL_INTERVAL", "3600")))
     ap.add_argument("--no-services", action="store_true",
                     help="do not start heal/MRF/scanner background services")
-    ap.add_argument("--gateway", choices=["s3"], default=None,
-                    help="gateway mode: proxy objects to a remote backend "
-                         "(endpoints arg = backend URL, plus --gateway-"
-                         "metadata-dir for local IAM/config state)")
+    ap.add_argument("--gateway", choices=["s3", "nas"], default=None,
+                    help="gateway mode: 's3' proxies objects to a remote "
+                         "backend (endpoints arg = backend URL, plus "
+                         "--gateway-metadata-dir for local IAM/config "
+                         "state); 'nas' serves a shared filesystem mount "
+                         "as the object store (endpoints arg = the NAS "
+                         "path, reference cmd/gateway/nas)")
     ap.add_argument("--gateway-metadata-dir", default="./gateway-meta",
                     help="local directory for gateway IAM/config state")
     ap.add_argument("--gateway-access-key",
@@ -58,7 +61,9 @@ def main(argv=None) -> int:
                     default=os.environ.get("MINIO_GATEWAY_SECRET_KEY", ""))
     ap.add_argument("--cache-dir",
                     default=os.environ.get("MINIO_CACHE_DIR", ""),
-                    help="local read-cache directory (gateway mode)")
+                    help="local read-cache directory (SSD cache for "
+                         "GETs in server AND gateway mode, reference "
+                         "cmd/disk-cache.go)")
     ap.add_argument("--cache-size", type=int,
                     default=int(os.environ.get(
                         "MINIO_CACHE_SIZE", str(10 << 30))),
@@ -77,6 +82,47 @@ def main(argv=None) -> int:
     except SelfTestError as e:
         print(f"minio-tpu: FATAL: {e}", file=sys.stderr)
         return 1
+
+    if args.gateway == "nas":
+        # `python -m minio_tpu.server --gateway nas /mnt/nas`
+        # (reference `minio gateway nas PATH`, cmd/gateway/nas/
+        # gateway-nas.go) — a filesystem-backed ObjectLayer: the
+        # single-drive erasure layer at k=1,m=0 over the NAS mount, so
+        # objects live as plain shard files + metadata on the share
+        from minio_tpu.erasure.sets import ErasureServerPools, ErasureSets
+        from minio_tpu.server.app import make_app
+        from minio_tpu.storage.local import LocalStorage
+
+        if len(args.endpoints) != 1:
+            print("minio-tpu: nas gateway takes exactly one path",
+                  file=sys.stderr)
+            return 1
+        pools_layer = ErasureServerPools([
+            ErasureSets([LocalStorage(args.endpoints[0])], set_size=1)])
+        layer = pools_layer
+        if args.cache_dir:
+            from minio_tpu.gateway.cache import CacheLayer
+
+            layer = CacheLayer(pools_layer, args.cache_dir,
+                               max_size=args.cache_size)
+        # background services run on the INNER erasure layer — their
+        # scans must not churn the SSD cache (same split as ClusterNode)
+        app = make_app(layer, start_services=False,
+                       access_key=args.access_key,
+                       secret_key=args.secret_key, region=args.region)
+        if not args.no_services:
+            from minio_tpu.server.app import S3_SERVER_KEY
+            from minio_tpu.services import ServiceManager
+
+            app[S3_SERVER_KEY].attach_services(ServiceManager(
+                pools_layer, scan_interval=args.scan_interval,
+                heal_interval=args.heal_interval))
+        host, _, port = args.address.partition(":")
+        print(f"minio-tpu: gateway/nas -> {args.endpoints[0]}, "
+              f"S3 on http://{args.address}", file=sys.stderr)
+        web.run_app(app, host=host or "0.0.0.0",
+                    port=int(port or 9000), print=None)
+        return 0
 
     if args.gateway == "s3":
         # `python -m minio_tpu.server --gateway s3 https://backend`
@@ -115,6 +161,7 @@ def main(argv=None) -> int:
         start_services=not args.no_services,
         scan_interval=args.scan_interval,
         heal_interval=args.heal_interval,
+        cache_dir=args.cache_dir, cache_size=args.cache_size,
     )
     pools_info = node.pools.storage_info()["pools"]
     mode = "distributed" if node.distributed else "standalone"

@@ -19,6 +19,7 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import json
+import os
 import time
 
 from aiohttp import web
@@ -714,6 +715,12 @@ class AdminMixin:
                 # incl. per-target pending/failed/proxied counters
                 # (reference madmin ReplicationInfo / bucket-targets state)
                 info["replication"] = svcs.replication.stats.to_dict()
+        # disk-cache stats when the API layer reads through an SSD cache
+        # (reference madmin CacheStats via cacheObjects)
+        from minio_tpu.gateway.cache import CacheLayer
+
+        if isinstance(self.api, CacheLayer):
+            info["cache"] = self.api.stats()
         # per-server fan-in over the RPC plane (reference madmin
         # InfoMessage.Servers via peer-rest ServerInfo,
         # cmd/peer-rest-client.go:104); offline peers are reported as
@@ -743,7 +750,30 @@ class AdminMixin:
         return self._json(info)
 
     async def admin_storage_info(self, request: web.Request, body: bytes):
-        return self._json(await self._run(self.api.storage_info))
+        def gather():
+            si = self.api.storage_info()
+            # per-drive hardware identity + shared-mount sanity
+            # (reference internal/smart + internal/mountinfo: admin
+            # storage info shows device model/rotational and warns when
+            # "drives" are really one filesystem)
+            from minio_tpu.storage.driveinfo import (_mounts,
+                                                     drive_hardware,
+                                                     shared_mount_warnings)
+
+            mounts = _mounts()  # parse /proc/self/mountinfo ONCE
+            local_paths = []
+            for pool in si.get("pools", []):
+                for d in pool.get("disks", []):
+                    ep = d.get("endpoint", "")
+                    if ep and "//" not in ep and os.path.isdir(ep):
+                        d["hardware"] = drive_hardware(ep, mounts)
+                        local_paths.append(ep)
+            warns = shared_mount_warnings(local_paths, mounts)
+            if warns:
+                si["warnings"] = warns
+            return si
+
+        return self._json(await self._run(gather))
 
     # ------------------------------------------------------------ pools
     def _decom_jobs(self) -> dict:

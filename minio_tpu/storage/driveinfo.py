@@ -1,0 +1,114 @@
+"""Drive hardware diagnostics: mount resolution + SMART-ish identity.
+
+Reference: internal/mountinfo (mountinfo_linux.go — CheckCrossDevice,
+detecting multiple drives that actually share one filesystem) and
+internal/smart (device model / rotational identity surfaced in admin
+storage info).  Pure /proc + /sys readers: no ioctls, no external
+tools, graceful None on non-Linux or containerized environments where
+the block layer is hidden.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _read(path: str) -> str | None:
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            return f.read().strip()
+    except OSError:
+        return None
+
+
+def _mounts() -> list[tuple[str, str, str]]:
+    """[(mount_point, source_device, fstype)] from /proc/self/mountinfo
+    (escape sequences like \\040 decoded)."""
+    out = []
+    try:
+        with open("/proc/self/mountinfo", encoding="utf-8") as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) < 10 or "-" not in parts:
+                    continue
+                dash = parts.index("-")
+                mp = parts[4].encode().decode("unicode_escape")
+                fstype = parts[dash + 1]
+                src = parts[dash + 2]
+                out.append((mp, src, fstype))
+    except OSError:
+        pass
+    return out
+
+
+def mount_of(path: str, mounts=None) -> tuple[str, str, str]:
+    """-> (mount_point, source_device, fstype) of the longest-prefix
+    mount covering `path` ("", "", "") when unresolvable.  Pass a
+    pre-parsed `mounts` list when resolving many paths — re-reading
+    /proc/self/mountinfo per drive is pointless work."""
+    real = os.path.realpath(path)
+    best = ("", "", "")
+    best_len = -1
+    for mp, src, fstype in (mounts if mounts is not None else _mounts()):
+        if (real == mp or real.startswith(mp.rstrip("/") + "/")
+                or mp == "/") and len(mp) > best_len:
+            best = (mp, src, fstype)
+            best_len = len(mp)
+    return best
+
+
+def _block_parent(dev: str) -> str:
+    """Partition -> parent disk name (sda1 -> sda, nvme0n1p2 ->
+    nvme0n1) via /sys/class/block symlinks; unchanged when already a
+    whole disk or unresolvable."""
+    link = f"/sys/class/block/{dev}"
+    try:
+        target = os.path.realpath(link)
+        parent = os.path.basename(os.path.dirname(target))
+        if parent and os.path.exists(f"/sys/block/{parent}"):
+            return parent
+    except OSError:
+        pass
+    return dev
+
+
+def drive_hardware(path: str, mounts=None) -> dict:
+    """Best-effort per-drive hardware identity for admin storage info:
+    mountPoint/fsType always (Linux), rotational/model/device when the
+    block device is visible."""
+    mp, src, fstype = mount_of(path, mounts)
+    info: dict = {"mountPoint": mp, "fsType": fstype}
+    dev = os.path.basename(src) if src.startswith("/dev/") else ""
+    if dev:
+        disk = _block_parent(dev)
+        info["device"] = src
+        rot = _read(f"/sys/block/{disk}/queue/rotational")
+        if rot is not None:
+            info["rotational"] = rot == "1"
+        model = _read(f"/sys/block/{disk}/device/model")
+        if model:
+            info["model"] = model
+    return info
+
+
+def shared_mount_warnings(paths: list[str], mounts=None) -> list[str]:
+    """Drives configured as separate endpoints but living on ONE
+    filesystem give no fault isolation and mis-count capacity — the
+    reference refuses such layouts (mountinfo_linux.go
+    CheckCrossDevice); we surface loud warnings in admin info."""
+    by_fs: dict[tuple, list[str]] = {}
+    for p in paths:
+        try:
+            st = os.stat(p)
+        except OSError:
+            continue
+        by_fs.setdefault((st.st_dev,), []).append(p)
+    warnings = []
+    for key, group in sorted(by_fs.items()):
+        if len(group) > 1:
+            mp, _, _ = mount_of(group[0], mounts)
+            warnings.append(
+                f"drives {', '.join(sorted(group))} share one "
+                f"filesystem (mount {mp or 'unknown'}): no fault "
+                "isolation between them")
+    return warnings

@@ -235,3 +235,85 @@ class TestDistributedCLI:
         finally:
             _stop(n1)
             _stop(n2)
+
+
+class TestNASGatewayCLI:
+    """`--gateway nas PATH`: a shared filesystem mount served as the
+    object store through the single-drive (k=1,m=0) erasure layer
+    (VERDICT r5 #7; reference cmd/gateway/nas)."""
+
+    def _boot_nas(self, path):
+        for _ in range(2):
+            port = _free_port()
+            proc = _spawn(["--gateway", "nas", str(path),
+                           "--address", f"127.0.0.1:{port}",
+                           "--scan-interval", "3600"])
+            if _wait_up(port):
+                return port, proc
+            _stop(proc)
+        raise AssertionError("nas gateway never became healthy")
+
+    def test_conformance_subset(self, tmp_path):
+        nas = tmp_path / "mnt-nas"
+        port, proc = self._boot_nas(nas)
+        try:
+            assert _req(port, "PUT", "/nasbkt")[0] == 200
+            # round trip + range
+            data = os.urandom(150_000)
+            assert _req(port, "PUT", "/nasbkt/a/obj", data=data)[0] == 200
+            s, body = _req(port, "GET", "/nasbkt/a/obj")
+            assert s == 200 and body == data
+            s, body = _req(port, "GET", "/nasbkt/a/obj",
+                           headers={"Range": "bytes=100-199"})
+            assert s == 206 and body == data[100:200]
+            # listing with prefix/delimiter
+            _req(port, "PUT", "/nasbkt/a/x", data=b"1")
+            _req(port, "PUT", "/nasbkt/b/y", data=b"2")
+            s, body = _req(port, "GET", "/nasbkt",
+                           query=[("list-type", "2"), ("prefix", "a/"),
+                                  ("delimiter", "/")])
+            assert s == 200 and b"a/obj" in body and b"b/y" not in body
+            # multipart
+            s, body = _req(port, "POST", "/nasbkt/big",
+                           query=[("uploads", "")])
+            uid = body.split(b"<UploadId>")[1].split(b"</UploadId>")[0]
+            part = os.urandom(5 << 20)
+            s, h = _req(port, "PUT", "/nasbkt/big",
+                        query=[("partNumber", "1"),
+                               ("uploadId", uid.decode())], data=part)[:2]
+            assert s == 200
+            # fetch ETag from a HEAD-free path: list parts
+            s, body = _req(port, "GET", "/nasbkt/big",
+                           query=[("uploadId", uid.decode())])
+            etag = body.split(b"<ETag>")[1].split(b"</ETag>")[0].decode()
+            done = (f'<CompleteMultipartUpload><Part><PartNumber>1'
+                    f'</PartNumber><ETag>{etag}</ETag></Part>'
+                    f'</CompleteMultipartUpload>').encode()
+            s, _ = _req(port, "POST", "/nasbkt/big",
+                        query=[("uploadId", uid.decode())], data=done)
+            assert s == 200
+            s, body = _req(port, "GET", "/nasbkt/big")
+            assert s == 200 and body == part
+            # delete
+            assert _req(port, "DELETE", "/nasbkt/a/obj")[0] == 204
+            assert _req(port, "GET", "/nasbkt/a/obj")[0] == 404
+            # the data lives directly on the NAS path
+            assert nas.exists() and any(nas.iterdir())
+        finally:
+            _stop(proc)
+
+    def test_two_gateways_share_one_mount(self, tmp_path):
+        """Two NAS gateway processes on the same mount see each other's
+        objects — the reference's shared-NAS deployment shape."""
+        nas = tmp_path / "shared-nas"
+        p1, proc1 = self._boot_nas(nas)
+        p2, proc2 = self._boot_nas(nas)
+        try:
+            assert _req(p1, "PUT", "/shared")[0] == 200
+            assert _req(p1, "PUT", "/shared/from-gw1",
+                        data=b"hello via gw1")[0] == 200
+            s, body = _req(p2, "GET", "/shared/from-gw1")
+            assert s == 200 and body == b"hello via gw1"
+        finally:
+            _stop(proc1)
+            _stop(proc2)

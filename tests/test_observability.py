@@ -221,3 +221,46 @@ class TestAuditWebhook:
             os.environ.pop("MINIO_AUDIT_WEBHOOK_ENDPOINT", None)
             log.close()
             sinkd.shutdown()
+
+
+class TestDriveHardwareInfo:
+    """SMART/mountinfo diagnostics in admin storage info (VERDICT r5
+    #10; reference internal/smart + internal/mountinfo)."""
+
+    def test_storage_info_has_hardware_and_shared_mount_warning(
+            self, tmp_path):
+        import json as json_mod
+
+        from tests.s3_harness import S3TestServer
+
+        srv = S3TestServer(str(tmp_path / "drv"))
+        try:
+            r = srv.request("GET", "/minio/admin/v3/storageinfo")
+            assert r.status == 200
+            si = json_mod.loads(r.body)
+            disks = [d for p in si["pools"] for d in p["disks"]]
+            assert disks
+            hw = disks[0].get("hardware")
+            assert hw is not None
+            assert "mountPoint" in hw and "fsType" in hw
+            # all four test drives live under one tmp filesystem: the
+            # shared-mount check must call that out
+            assert any("share one filesystem" in w
+                       for w in si.get("warnings", [])), si.get("warnings")
+        finally:
+            srv.close()
+
+    def test_mount_resolution(self, tmp_path):
+        from minio_tpu.storage.driveinfo import drive_hardware, mount_of
+
+        mp, src, fstype = mount_of(str(tmp_path))
+        assert mp and fstype
+        hw = drive_hardware(str(tmp_path))
+        assert hw["mountPoint"] == mp
+
+    def test_distinct_filesystems_no_warning(self):
+        from minio_tpu.storage.driveinfo import shared_mount_warnings
+
+        # /proc and / are different filesystems on any Linux
+        assert shared_mount_warnings(["/proc", "/"]) == []
+        assert shared_mount_warnings([]) == []
