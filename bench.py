@@ -410,6 +410,83 @@ def bench_select():
     return fast, slow, json_fast, json_slow, wide_fast
 
 
+def bench_heal_12_4():
+    """BASELINE config 3: EC 12+4 heal with 3 shards zeroed (reference
+    cmd/erasure-heal_test.go shape).  The 4 GiB object is sampled as
+    repeated resident (B, 12, S12) reconstructs (same steady-state
+    bytes/s); reports device and host AVX2 rates."""
+    import jax
+
+    from minio_tpu.ops import host, rs_pallas, rs_tpu
+
+    k12, m12, kill = 12, 4, (1, 5, 13)
+    S12 = 96 * 1024  # device-aligned shard (8 KiB multiple)
+    avail = tuple(i for i in range(k12 + m12) if i not in kill)[:k12]
+    rng = np.random.default_rng(2)
+    B = 24  # ~27 MiB source per dispatch
+    src = rng.integers(0, 256, size=(B, k12, S12), dtype=np.uint8)
+
+    hostc = host.HostRSCodec(k12, m12)
+    n = 16
+    t0 = time.perf_counter()
+    for _ in range(n):
+        hostc.reconstruct(src, avail, kill)
+    host_rate = n * src.nbytes / (time.perf_counter() - t0) / 2**30
+
+    dev_rate = 0.0
+    try:
+        on_tpu = jax.default_backend() not in ("cpu",)
+        codec = rs_pallas.PallasRSCodec(k12, m12, interpret=not on_tpu)
+        dsrc = jax.device_put(src)
+        out = codec.reconstruct(dsrc, avail, kill)
+        np.asarray(out)  # compile + warm
+        t0 = time.perf_counter()
+        outs = [codec.reconstruct(dsrc, avail, kill) for _ in range(n)]
+        for o in outs:
+            o.block_until_ready()
+        dev_rate = n * src.nbytes / (time.perf_counter() - t0) / 2**30
+    except Exception:
+        pass
+    return dev_rate, host_rate
+
+
+def bench_multipart_fanout():
+    """BASELINE config 4: 16-drive set, 128 x 5 MiB multipart parts with
+    parallel shard fan-out, through the real object layer + multipart
+    engine on tmpdir drives."""
+    from minio_tpu.erasure import multipart  # noqa: F401  (binds methods)
+    from minio_tpu.erasure.objects import ErasureObjects
+    from minio_tpu.storage.local import LocalStorage
+
+    os.environ.setdefault("MINIO_TPU_FSYNC", "0")
+    tmp = tempfile.mkdtemp(prefix="minio-tpu-bench-mp-")
+    try:
+        disks = [LocalStorage(os.path.join(tmp, f"d{i}"))
+                 for i in range(16)]
+        for d in disks:
+            d.make_volume("bkt")
+        api = ErasureObjects(disks)
+        nparts, psize = 128, 5 << 20
+        part = np.random.default_rng(3).integers(
+            0, 256, psize, dtype=np.uint8).tobytes()
+        uid = api.new_multipart_upload("bkt", "big")
+        pool = ThreadPoolExecutor(8)
+        t0 = time.perf_counter()
+
+        def upload(n):
+            pi = api.put_object_part("bkt", "big", uid, n,
+                                     io.BytesIO(part), psize)
+            return (n, pi.etag)
+
+        parts = list(pool.map(upload, range(1, nparts + 1)))
+        api.complete_multipart_upload("bkt", "big", uid, parts)
+        rate = nparts * psize / (time.perf_counter() - t0) / 2**30
+        pool.shutdown()
+        return rate
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
     cpu_enc, cpu_heal, nthreads = bench_cpu()
     memcpy_gibs, disk_write_gibs = bench_host_ceilings()
@@ -424,6 +501,8 @@ def main():
     e2e_put_host = max(e2e_put_host, ph2)
     (select_fast, select_row, select_json, select_json_row,
      select_wide) = bench_select()
+    heal12_dev, heal12_host = bench_heal_12_4()
+    mp_fanout = bench_multipart_fanout()
     try:
         tpu, link_h2d, link_d2h = bench_tpu()
     except Exception as e:  # pragma: no cover - report CPU-only on failure
@@ -462,6 +541,9 @@ def main():
             "e2e_put_host_gibs": round(e2e_put_host, 3),
             "host_memcpy_gibs": round(memcpy_gibs, 3),
             "host_disk_write_gibs": round(disk_write_gibs, 3),
+            "heal_12_4_device_gibs": round(heal12_dev, 3),
+            "heal_12_4_host_gibs": round(heal12_host, 3),
+            "multipart_fanout_gibs": round(mp_fanout, 3),
             "select_scan_gibs": round(select_fast, 3),
             "select_scan_wide_gibs": round(select_wide, 3),
             "select_row_engine_gibs": round(select_row, 3),

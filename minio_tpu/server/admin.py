@@ -721,6 +721,18 @@ class AdminMixin:
 
         if isinstance(self.api, CacheLayer):
             info["cache"] = self.api.stats()
+        # erasure codec backend: configured backend, per-backend
+        # dispatch/byte counters, auto-probe verdicts — so an operator
+        # can tell which codec their PUTs actually use
+        from minio_tpu.erasure import coding as ec
+
+        info["erasure"] = {
+            "backend": os.environ.get("MINIO_TPU_ERASURE_BACKEND",
+                                      "auto"),
+            "dispatch": {k: dict(v)
+                         for k, v in ec.backend_stats.items()},
+            "deviceProbe": ec.probe_verdicts(),
+        }
         # per-server fan-in over the RPC plane (reference madmin
         # InfoMessage.Servers via peer-rest ServerInfo,
         # cmd/peer-rest-client.go:104); offline peers are reported as

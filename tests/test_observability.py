@@ -264,3 +264,78 @@ class TestDriveHardwareInfo:
         # /proc and / are different filesystems on any Linux
         assert shared_mount_warnings(["/proc", "/"]) == []
         assert shared_mount_warnings([]) == []
+
+
+class TestCodecBackendObservability:
+    """VERDICT r5 #8: probe verdict + per-backend dispatch/byte counters
+    are visible in Prometheus and admin info, and the auto path's
+    device-wins branch is pinned end-to-end."""
+
+    def test_counters_and_admin_info(self, tmp_path):
+        import json as json_mod
+
+        from minio_tpu.erasure import coding as ec
+        from tests.s3_harness import S3TestServer
+
+        srv = S3TestServer(str(tmp_path / "drv"))
+        try:
+            before = ec.backend_stats["host"]["dispatches"]
+            srv.request("PUT", "/ecobkt")
+            srv.request("PUT", "/ecobkt/o", data=b"z" * 300_000)
+            assert ec.backend_stats["host"]["dispatches"] > before
+            r = srv.request("GET", "/minio/admin/v3/info")
+            info = json_mod.loads(r.body)
+            assert info["erasure"]["dispatch"]["host"]["bytes"] > 0
+            assert "deviceProbe" in info["erasure"]
+            r = srv.request("GET", "/minio/v2/metrics/cluster")
+            body = r.text()
+            assert 'minio_erasure_backend_dispatches_total{backend="host"}' \
+                in body
+            assert "minio_erasure_backend_bytes_total" in body
+        finally:
+            srv.close()
+
+    def test_forced_device_win_pins_auto_path(self, tmp_path, monkeypatch):
+        """With the probe verdict forced to 'device wins', the AUTO
+        backend routes big PUT/GET/heal batches through the device codec
+        end-to-end (here a stub wrapping the host codec, since tests run
+        CPU-only)."""
+        import io
+
+        import numpy as np
+
+        from minio_tpu.erasure import coding as ec
+        from minio_tpu.erasure.objects import ErasureObjects
+        from minio_tpu.ops import host as host_mod
+        from minio_tpu.storage.local import LocalStorage
+
+        class StubDeviceCodec:
+            def __init__(self, k, m):
+                self._h = host_mod.HostRSCodec(k, m)
+                self.calls = 0
+
+            def encode(self, batch):
+                self.calls += 1
+                return self._h.encode(batch)
+
+            def reconstruct(self, batch, available, wanted):
+                self.calls += 1
+                return self._h.reconstruct(batch, available, wanted)
+
+        monkeypatch.setenv("MINIO_TPU_ERASURE_BACKEND", "auto")
+        stub = StubDeviceCodec(2, 2)
+        monkeypatch.setitem(ec._DeviceCodec._cache, (2, 2), (stub, True))
+
+        disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+        for d in disks:
+            d.make_volume("bkt")
+        api = ErasureObjects(disks)
+        dev_before = ec.backend_stats["device"]["dispatches"]
+        data = np.random.default_rng(9).integers(
+            0, 256, 24 << 20, dtype=np.uint8).tobytes()  # > DEVICE_MIN
+        api.put_object("bkt", "obj", io.BytesIO(data), len(data))
+        assert stub.calls > 0, "auto never dispatched to the device stub"
+        assert ec.backend_stats["device"]["dispatches"] > dev_before
+        _, stream = api.get_object("bkt", "obj")
+        assert b"".join(stream) == data
+        assert ec.probe_verdicts().get("2+2") is True

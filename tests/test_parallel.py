@@ -174,3 +174,70 @@ class TestMeshBackend:
             e.encode_stream(io.BytesIO(data), ws, len(data), 9)
             outs[backend] = [s.getvalue() for s in sinks]
         assert outs["host"] == outs["mesh"]
+
+
+class TestMeshPipeline:
+    """VERDICT r5 #6: the depth-2 async pipeline covers the mesh codec —
+    tail blocks pad onto the same compiled program instead of dropping
+    to host, and >1 batch stays in flight during a streaming encode."""
+
+    def test_tail_blocks_stay_on_mesh(self, tmp_path, monkeypatch):
+        import io
+
+        import numpy as np
+
+        from minio_tpu.erasure.coding import Erasure, _DeviceCodec
+
+        monkeypatch.setenv("MINIO_TPU_ERASURE_BACKEND", "mesh")
+        codec = _DeviceCodec.get_mesh(8, 4)
+        assert codec is not None
+        er = Erasure(8, 4)
+        # a batch whose shard length is NOT the steady-state shard size
+        # (a streaming tail block, >= half the compiled width) must
+        # still dispatch to the mesh via padding
+        tail = np.random.default_rng(3).integers(
+            0, 256, (1, 8, 100_000), dtype=np.uint8)
+        before = codec.dispatches
+        parity = er._encode_shards(tail)
+        assert codec.dispatches == before + 1, "tail block fell to host"
+        host_parity = er._host.encode(tail)
+        assert np.array_equal(parity, host_parity)
+        # tiny dispatches (small objects) stay on the host codec: a
+        # full-width device round trip per 1 KiB object is a
+        # pessimization, not a feature
+        tiny = tail[:, :, :1000]
+        before = codec.dispatches
+        er._encode_shards(np.ascontiguousarray(tiny))
+        assert codec.dispatches == before, "tiny dispatch went to mesh"
+        # reconstruction takes the padded path too
+        before = codec.dispatches
+        rec = er._reconstruct_shards(
+            tail, available=tuple(range(8)), wanted=(8, 9))
+        assert codec.dispatches == before + 1
+        assert np.array_equal(rec, host_parity[:, :2, :])
+        assert er.max_inflight >= 0  # attribute exists for streams
+
+    def test_stream_keeps_multiple_batches_in_flight(self, tmp_path,
+                                                     monkeypatch):
+        import io
+
+        import numpy as np
+
+        from minio_tpu.erasure.bitrot import BitrotWriter
+        from minio_tpu.erasure.coding import Erasure
+
+        monkeypatch.setenv("MINIO_TPU_ERASURE_BACKEND", "mesh")
+        # small blocks so 6 MiB spans several device batches (the
+        # pipeline only overlaps across batches)
+        er = Erasure(8, 4, block_size=64 << 10)
+        sinks = [io.BytesIO() for _ in range(12)]
+        writers = [BitrotWriter(s, er.shard_size) for s in sinks]
+        data = np.random.default_rng(5).integers(
+            0, 256, 6 << 20, dtype=np.uint8).tobytes()
+        total, failed = er.encode_stream(
+            io.BytesIO(data), writers, len(data), write_quorum=10)
+        assert total == len(data) and not failed
+        assert all(s.tell() > 0 for s in sinks)
+        assert er.max_inflight >= 2, (
+            f"mesh pipeline never overlapped (max_inflight="
+            f"{er.max_inflight})")
