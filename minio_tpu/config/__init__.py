@@ -136,7 +136,44 @@ class ServerConfig:
         return [d for d in pool.all_disks
                 if d is not None and d.is_online()]
 
+    _etcd_client = None  # cached per-instance on first use
+
+    def _etcd(self):
+        """etcd config backend when MINIO_ETCD_ENDPOINTS is set
+        (reference cmd/config-etcd.go: federated deployments share one
+        config plane).  The key lives under the SAME operator namespace
+        as IAM (<MINIO_ETCD_PATH_PREFIX>config/config.json), derived
+        from the env var directly so namespaced clusters never
+        collide."""
+        eps = self.env.get("MINIO_ETCD_ENDPOINTS", "")
+        if not eps:
+            return None
+        from minio_tpu.iam.etcd import EtcdClient, base_prefix
+
+        if self._etcd_client is None:
+            self._etcd_client = EtcdClient(
+                eps,
+                username=self.env.get("MINIO_ETCD_USERNAME", ""),
+                password=self.env.get("MINIO_ETCD_PASSWORD", ""))
+        return (self._etcd_client,
+                base_prefix(self.env) + "config/config.json")
+
     def _load(self) -> None:
+        etcd = self._etcd()
+        if etcd is not None:
+            from minio_tpu.iam.etcd import EtcdError
+
+            client, key = etcd
+            try:
+                raw = client.get(key)
+                doc = json.loads(raw) if raw else {}
+                if isinstance(doc, dict):
+                    self._stored = {
+                        s: dict(kv) for s, kv in doc.items()
+                        if isinstance(kv, dict)}
+                return
+            except (EtcdError, json.JSONDecodeError, ValueError):
+                return
         for d in self._disks():
             try:
                 doc = json.loads(d.read_all(SYSTEM_VOL, CONFIG_PATH))
@@ -149,6 +186,16 @@ class ServerConfig:
                 continue
 
     def _save(self, raw: bytes) -> None:
+        etcd = self._etcd()
+        if etcd is not None:
+            from minio_tpu.iam.etcd import EtcdError
+
+            client, key = etcd
+            try:
+                client.put(key, raw)
+                return
+            except EtcdError as e:
+                raise ConfigError(f"cannot persist config to etcd: {e}")
         ok = 0
         for d in self._disks():
             try:
@@ -222,10 +269,17 @@ class ServerConfig:
             raise ConfigError(
                 f"unknown keys for {subsys}: {', '.join(sorted(bad))}")
         with self._mu:
+            if self._etcd() is not None:
+                # shared config plane: re-read before mutating so two
+                # deployments' edits merge instead of clobbering (the
+                # reference uses etcd transactions; read-merge-write
+                # under the instance lock is our approximation — the
+                # race window is one HTTP round trip)
+                self._load()
             self._stored.setdefault(subsys, {}).update(
                 {k: str(v) for k, v in kvs.items()})
             raw = json.dumps(self._stored).encode()
-        if self.pools is not None:
+        if self.pools is not None or self._etcd() is not None:
             self._save(raw)
         self._apply(subsys)
 
@@ -234,6 +288,8 @@ class ServerConfig:
         if subsys not in SUBSYSTEMS:
             raise ConfigError(f"unknown config subsystem {subsys!r}")
         with self._mu:
+            if self._etcd() is not None:
+                self._load()
             if keys:
                 sub = self._stored.get(subsys, {})
                 for k in keys:
@@ -241,7 +297,7 @@ class ServerConfig:
             else:
                 self._stored.pop(subsys, None)
             raw = json.dumps(self._stored).encode()
-        if self.pools is not None:
+        if self.pools is not None or self._etcd() is not None:
             self._save(raw)
         self._apply(subsys)
 

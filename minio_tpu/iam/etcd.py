@@ -1,0 +1,268 @@
+"""etcd-backed IAM/config store.
+
+Reference: cmd/iam-etcd-store.go:62 + cmd/config-etcd.go — when
+MINIO_ETCD_ENDPOINTS is configured, IAM identities/policies/mappings
+(and config) live in etcd instead of the object store, so gateway and
+federated deployments share one identity plane across clusters.
+
+The client speaks etcd v3's JSON gRPC-gateway (enabled by default on
+every etcd 3.x server): POST {endpoint}/v3/kv/{put,range,deleterange}
+with base64 keys/values, plus /v3/auth/authenticate for token auth.
+No etcd client library exists in this image; this is ~the same REST
+surface the reference's clientv3 uses over gRPC.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import threading
+import urllib.parse
+
+
+class EtcdError(Exception):
+    pass
+
+
+def _b64(b: bytes) -> str:
+    return base64.b64encode(b).decode()
+
+
+def _unb64(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+class EtcdClient:
+    """Minimal etcd v3 JSON-gateway client: put / get / list / delete
+    over a persistent HTTP(S) connection, re-dialed on failure."""
+
+    def __init__(self, endpoints: str | list, username: str = "",
+                 password: str = "", timeout: float = 5.0,
+                 api_prefix: str = "/v3"):
+        if isinstance(endpoints, str):
+            endpoints = [e.strip() for e in endpoints.split(",")
+                         if e.strip()]
+        self.endpoints: list[tuple[str, str, int]] = []
+        for ep in endpoints:
+            u = urllib.parse.urlparse(
+                ep if "://" in ep else f"http://{ep}")
+            self.endpoints.append(
+                (u.scheme or "http", u.hostname or "localhost",
+                 u.port or 2379))
+        if not self.endpoints:
+            raise EtcdError("no etcd endpoints")
+        self._ep = 0  # current endpoint index (rotates on failure)
+        self.username = username
+        self.password = password
+        self.timeout = timeout
+        self.api_prefix = api_prefix
+        self._conn = None
+        self._token: str | None = None
+        self._lock = threading.Lock()
+
+    @property
+    def host(self) -> str:
+        return self.endpoints[self._ep][1]
+
+    @property
+    def port(self) -> int:
+        return self.endpoints[self._ep][2]
+
+    # -- plumbing -----------------------------------------------------------
+    def _dial(self):
+        import http.client
+
+        scheme, host, port = self.endpoints[self._ep]
+        if scheme == "https":
+            return http.client.HTTPSConnection(
+                host, port, timeout=self.timeout)
+        return http.client.HTTPConnection(host, port,
+                                          timeout=self.timeout)
+
+    def _call(self, path: str, body: dict,
+              _attempts: int | None = None) -> dict:
+        # one try per configured endpoint (plus a reconnect retry on the
+        # first): a down member must not take the whole plane with it
+        if _attempts is None:
+            _attempts = len(self.endpoints) + 1
+        payload = json.dumps(body).encode()
+        headers = {"Content-Type": "application/json"}
+        if self._token:
+            headers["Authorization"] = self._token
+        try:
+            if self._conn is None:
+                self._conn = self._dial()
+            self._conn.request("POST", f"{self.api_prefix}{path}",
+                               body=payload, headers=headers)
+            resp = self._conn.getresponse()
+            data = resp.read()
+        except Exception as e:
+            self._drop()
+            if _attempts > 1:
+                self._ep = (self._ep + 1) % len(self.endpoints)
+                return self._call(path, body, _attempts - 1)
+            raise EtcdError(f"etcd {self.host}:{self.port}: {e}") from e
+        if resp.status == 401 and self.username and _attempts > 1:
+            # token expired: re-authenticate once
+            self._token = None
+            self._auth()
+            return self._call(path, body, 1)
+        if resp.status != 200:
+            raise EtcdError(
+                f"etcd {path}: {resp.status} {data[:200]!r}")
+        try:
+            return json.loads(data) if data else {}
+        except ValueError as e:
+            raise EtcdError(f"etcd {path}: bad response: {e}") from e
+
+    def _drop(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
+
+    def _auth(self) -> None:
+        if self._token or not self.username:
+            return
+        out = self._call("/auth/authenticate",
+                         {"name": self.username,
+                          "password": self.password})
+        self._token = out.get("token", "")
+
+    # -- kv ops -------------------------------------------------------------
+    def put(self, key: str, value: bytes) -> None:
+        with self._lock:
+            self._auth()
+            self._call("/kv/put",
+                       {"key": _b64(key.encode()), "value": _b64(value)})
+
+    def get(self, key: str) -> bytes | None:
+        with self._lock:
+            self._auth()
+            out = self._call("/kv/range", {"key": _b64(key.encode())})
+            kvs = out.get("kvs") or []
+            if not kvs:
+                return None
+            return _unb64(kvs[0].get("value", ""))
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._auth()
+            self._call("/kv/deleterange", {"key": _b64(key.encode())})
+
+    def list_keys(self, prefix: str) -> list[str]:
+        """All keys under `prefix` (range with range_end = prefix+1)."""
+        pb = prefix.encode()
+        # successor of the prefix: bump the last non-0xff byte
+        end = bytearray(pb)
+        while end and end[-1] == 0xFF:
+            end.pop()
+        if end:
+            end[-1] += 1
+        else:
+            end = b"\x00"  # full keyspace
+        with self._lock:
+            self._auth()
+            out = self._call("/kv/range", {
+                "key": _b64(pb), "range_end": _b64(bytes(end)),
+                "keys_only": True})
+            return sorted(
+                _unb64(kv["key"]).decode("utf-8", "replace")
+                for kv in out.get("kvs") or [])
+
+    def close(self) -> None:
+        with self._lock:
+            self._drop()
+
+
+class EtcdIamStore:
+    """Drop-in for iam.sys.IamStore (save/load/delete/list) persisting
+    under `prefix` in etcd — the reference's IAMEtcdStore key layout
+    (config/iam/... keys, cmd/iam-etcd-store.go getIAMConfig)."""
+
+    def __init__(self, client: EtcdClient,
+                 prefix: str = "minio_tpu/iam/"):
+        self.client = client
+        self.prefix = prefix
+
+    def save(self, path: str, doc: dict) -> None:
+        from .sys import IAMError
+
+        try:
+            self.client.put(self.prefix + path,
+                            json.dumps(doc).encode())
+        except EtcdError as e:
+            raise IAMError(f"cannot persist {path}: {e}") from e
+
+    def load(self, path: str) -> dict | None:
+        from .sys import IAMError
+
+        try:
+            raw = self.client.get(self.prefix + path)
+        except EtcdError as e:
+            # a transient outage must surface, NOT read as 'absent' —
+            # callers treat None as deleted and would evict live
+            # identities (round-5 review finding)
+            raise IAMError(f"etcd unavailable loading {path}: {e}") from e
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw)
+        except ValueError:
+            return None
+
+    def delete(self, path: str) -> None:
+        from .sys import IAMError
+
+        try:
+            self.client.delete(self.prefix + path)
+        except EtcdError as e:
+            # a swallowed delete would report revocation success while
+            # the credential stays live in every federated deployment
+            raise IAMError(f"cannot delete {path}: {e}") from e
+
+    def list(self, prefix: str) -> list[str]:
+        from .sys import IAMError
+
+        base = f"{self.prefix}{prefix}/"
+        try:
+            keys = self.client.list_keys(base)
+        except EtcdError as e:
+            raise IAMError(f"etcd unavailable listing {prefix}: {e}") \
+                from e
+        names = set()
+        for k in keys:
+            rest = k[len(base):]
+            if rest.endswith(".json") and "/" not in rest:
+                names.add(rest[:-5])
+        return sorted(names)
+
+
+def store_from_env(environ=None) -> EtcdIamStore | None:
+    """MINIO_ETCD_ENDPOINTS (+ optional MINIO_ETCD_USERNAME/PASSWORD /
+    MINIO_ETCD_PATH_PREFIX) -> an etcd-backed IAM store, or None when
+    unset (reference config/etcd env surface)."""
+    env = os.environ if environ is None else environ
+    eps = env.get("MINIO_ETCD_ENDPOINTS", "")
+    if not eps:
+        return None
+    client = EtcdClient(
+        eps,
+        username=env.get("MINIO_ETCD_USERNAME", ""),
+        password=env.get("MINIO_ETCD_PASSWORD", ""),
+    )
+    return EtcdIamStore(client, base_prefix(env) + "iam/")
+
+
+def base_prefix(environ=None) -> str:
+    """The operator's etcd namespace (MINIO_ETCD_PATH_PREFIX), shared
+    by the IAM store (<base>iam/...) and config (<base>config/...) so
+    deliberately-namespaced clusters never collide."""
+    env = os.environ if environ is None else environ
+    base = env.get("MINIO_ETCD_PATH_PREFIX", "") or "minio_tpu/"
+    if not base.endswith("/"):
+        base += "/"
+    return base

@@ -109,7 +109,13 @@ class IAMSys:
     """In-memory identity/policy maps + persistent store."""
 
     def __init__(self, pools, root_access_key: str, root_secret_key: str):
-        self.store = IamStore(pools)
+        # MINIO_ETCD_ENDPOINTS switches identity persistence to etcd
+        # (reference cmd/iam-etcd-store.go:62 — gateway/federated
+        # deployments share one identity plane); default is the
+        # object-backend store over the system volume
+        from .etcd import store_from_env
+
+        self.store = store_from_env() or IamStore(pools)
         self.root = Identity(root_access_key, root_secret_key, kind="root",
                              policies=["consoleAdmin"])
         self._mu = threading.RLock()
