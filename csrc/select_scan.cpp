@@ -23,6 +23,7 @@
 // Exposed via ctypes (see minio_tpu/select/native.py).
 
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <cmath>
 #include <cstdlib>
@@ -560,6 +561,78 @@ int64_t sel_emit_rows(const char *buf, const int32_t *row_start,
     return n;
 }
 
+// ------------------------------------------------- scalar cell functions
+//
+// The WHERE-leaf language extends to `fn(col) <op> literal` for the
+// common scalar functions.  Transforms are exact for ASCII cells;
+// anything containing a byte >= 0x80 (multibyte text whose case/space
+// rules Python applies per codepoint) flags AMBIGUOUS so the block
+// replays through the row engine — same contract as numeric parsing.
+// fn codes: 0 none, 1 LOWER, 2 UPPER, 3 TRIM, 4 LTRIM, 5 RTRIM,
+// 6 CHAR_LENGTH (cell becomes its codepoint count, compared
+// numerically).
+enum { FN_NONE = 0, FN_LOWER, FN_UPPER, FN_TRIM, FN_LTRIM, FN_RTRIM,
+       FN_CHARLEN };
+
+static inline int all_ascii(const char *s, int32_t n) {
+    for (int32_t i = 0; i < n; ++i)
+        if ((unsigned char)s[i] >= 0x80)
+            return 0;
+    return 1;
+}
+
+// Python str.isspace() over ASCII: \t \n \v \f \r space AND the
+// C0 separators \x1c-\x1f (str.strip() removes all of them)
+static inline int py_space(char c) {
+    unsigned char u = (unsigned char)c;
+    return c == ' ' || (u >= 0x09 && u <= 0x0D) ||
+           (u >= 0x1C && u <= 0x1F);
+}
+
+// Apply fn to [s, s+n) into scratch (capacity >= n).  Returns new
+// length, or -1 when ambiguous (non-ASCII byte present).
+static inline int32_t apply_fn(int fn, const char *s, int32_t n,
+                               char *scratch) {
+    if (!all_ascii(s, n))
+        return -1;  // Python unicode semantics: replay
+    const char *b = s, *e = s + n;
+    switch (fn) {
+    case FN_TRIM:
+    case FN_LTRIM:
+        while (b < e && py_space(*b))
+            ++b;
+        if (fn == FN_LTRIM) {
+            memcpy(scratch, b, e - b);
+            return (int32_t)(e - b);
+        }
+        /* fallthrough for TRIM's right side */
+        [[fallthrough]];
+    case FN_RTRIM:
+        if (fn == FN_RTRIM)
+            b = s;
+        while (e > b && py_space(e[-1]))
+            --e;
+        memcpy(scratch, b, e - b);
+        return (int32_t)(e - b);
+    case FN_LOWER:
+        for (int32_t i = 0; i < n; ++i) {
+            char c = s[i];
+            scratch[i] = (c >= 'A' && c <= 'Z') ? (char)(c + 32) : c;
+        }
+        return n;
+    case FN_UPPER:
+        for (int32_t i = 0; i < n; ++i) {
+            char c = s[i];
+            scratch[i] = (c >= 'a' && c <= 'z') ? (char)(c - 32) : c;
+        }
+        return n;
+    }
+    memcpy(scratch, s, n);
+    return n;
+}
+
+#define FN_SCRATCH 4096  // cells longer than this replay (rare)
+
 // Comparison ops: 0 '=', 1 '!=', 2 '<', 3 '<=', 4 '>', 5 '>='
 static inline int cmp_ok(int op, int c) {
     switch (op) {
@@ -589,13 +662,45 @@ static inline int bytes_cmp(const char *a, int32_t an,
 int64_t sel_cmp_num(const char *buf, const int32_t *starts,
                     const int32_t *lens, int64_t n, int op,
                     double num_lit, const char *str_lit, int32_t str_len,
-                    uint8_t *mask) {
+                    uint8_t *mask, int fn) {
     int64_t amb = 0;
     const int opmask = OPMASK[op];
+    char scratch[FN_SCRATCH];
     for (int64_t i = 0; i < n; ++i) {
         int32_t l = lens[i];
         const char *s = buf + starts[i];
         double v;
+        if (fn == FN_CHARLEN) {
+            if (l < 0) {
+                mask[i] = 0;
+                if (l == -2)
+                    ++amb;
+                continue;
+            }
+            if (!all_ascii(s, l)) {  // codepoint counting: Python decides
+                mask[i] = 0;
+                ++amb;
+                continue;
+            }
+            int c = ((double)l > num_lit) - ((double)l < num_lit);
+            mask[i] = (uint8_t)((opmask >> (c + 1)) & 1);
+            continue;
+        }
+        if (fn != FN_NONE && l > 0) {
+            if (l > FN_SCRATCH) {
+                mask[i] = 0;
+                ++amb;
+                continue;
+            }
+            int32_t nl = apply_fn(fn, s, l, scratch);
+            if (nl < 0) {
+                mask[i] = 0;
+                ++amb;
+                continue;
+            }
+            s = scratch;
+            l = nl;
+        }
         // hot path: short pure-digit cell, fully inlined SWAR
         if ((uint32_t)(l - 1) < 8u && parse_int8_swar(s, l, &v)) {
             int c = (v > num_lit) - (v < num_lit);
@@ -626,18 +731,45 @@ int64_t sel_cmp_num(const char *buf, const int32_t *starts,
 // point order).  Cells are never ambiguous here except -2 (unquote).
 int64_t sel_cmp_str(const char *buf, const int32_t *starts,
                     const int32_t *lens, int64_t n, int op,
-                    const char *lit, int32_t lit_len, uint8_t *mask) {
+                    const char *lit, int32_t lit_len, uint8_t *mask,
+                    int fn) {
     int64_t amb = 0;
+    char scratch[FN_SCRATCH];
     for (int64_t i = 0; i < n; ++i) {
         int32_t l = lens[i];
+        const char *s = buf + starts[i];
         if (l < 0) {
             mask[i] = 0;
             if (l == -2)
                 ++amb;
             continue;
         }
-        mask[i] = (uint8_t)cmp_ok(op, bytes_cmp(buf + starts[i], l,
-                                                lit, lit_len));
+        if (fn == FN_CHARLEN) {
+            // text compare of the DECIMAL rendering of the length
+            if (!all_ascii(s, l)) {
+                mask[i] = 0;
+                ++amb;
+                continue;
+            }
+            int32_t nl = (int32_t)snprintf(scratch, 16, "%d", l);
+            s = scratch;
+            l = nl;
+        } else if (fn != FN_NONE && l > 0) {
+            if (l > FN_SCRATCH) {
+                mask[i] = 0;
+                ++amb;
+                continue;
+            }
+            int32_t nl = apply_fn(fn, s, l, scratch);
+            if (nl < 0) {
+                mask[i] = 0;
+                ++amb;
+                continue;
+            }
+            s = scratch;
+            l = nl;
+        }
+        mask[i] = (uint8_t)cmp_ok(op, bytes_cmp(s, l, lit, lit_len));
     }
     return amb;
 }
@@ -647,18 +779,34 @@ int64_t sel_cmp_str(const char *buf, const int32_t *starts,
 int64_t sel_like(const char *buf, const int32_t *starts,
                  const int32_t *lens, int64_t n,
                  const char *pat, int32_t pat_len,
-                 const unsigned char *lit, uint8_t *mask) {
+                 const unsigned char *lit, uint8_t *mask, int fn) {
     int64_t amb = 0;
+    char scratch[FN_SCRATCH];
     for (int64_t i = 0; i < n; ++i) {
         int32_t l = lens[i];
+        const char *s = buf + starts[i];
         if (l < 0) {
             mask[i] = 0;
             if (l == -2)
                 ++amb;
             continue;
         }
-        mask[i] = (uint8_t)like_match(buf + starts[i], l, pat, pat_len,
-                                      lit);
+        if (fn != FN_NONE && l > 0) {
+            if (l > FN_SCRATCH || fn == FN_CHARLEN) {
+                mask[i] = 0;
+                ++amb;
+                continue;
+            }
+            int32_t nl = apply_fn(fn, s, l, scratch);
+            if (nl < 0) {
+                mask[i] = 0;
+                ++amb;
+                continue;
+            }
+            s = scratch;
+            l = nl;
+        }
+        mask[i] = (uint8_t)like_match(s, l, pat, pat_len, lit);
     }
     return amb;
 }
@@ -1167,8 +1315,10 @@ int64_t sel_json_cmp(const char *buf, const int32_t *starts,
                      const int32_t *lens, const uint8_t *types,
                      int64_t n, int op, double num_lit, int lit_is_num,
                      const char *str_lit, int32_t str_len,
-                     uint8_t *mask) {
+                     uint8_t *mask, int fn) {
     int64_t amb = 0;
+    char scratch[FN_SCRATCH];
+    const int opmask = OPMASK[op];
     for (int64_t i = 0; i < n; ++i) {
         uint8_t t = types[i];
         if (t == 0 || t == 1) {  // missing/null: compare is false
@@ -1182,7 +1332,43 @@ int64_t sel_json_cmp(const char *buf, const int32_t *starts,
         }
         const char *s = buf + starts[i];
         int32_t l = lens[i];
-        if (t == 4) {
+        if (fn != FN_NONE) {
+            if (t != 5) {  // fn over a number cell: str() rendering
+                mask[i] = 0;
+                ++amb;
+                continue;
+            }
+            if (fn == FN_CHARLEN) {
+                if (!all_ascii(s, l)) {
+                    mask[i] = 0;
+                    ++amb;
+                    continue;
+                }
+                if (lit_is_num) {
+                    int c = ((double)l > num_lit) - ((double)l < num_lit);
+                    mask[i] = (uint8_t)((opmask >> (c + 1)) & 1);
+                } else {
+                    int32_t nl = (int32_t)snprintf(scratch, 16, "%d", l);
+                    mask[i] = (uint8_t)cmp_ok(
+                        op, bytes_cmp(scratch, nl, str_lit, str_len));
+                }
+                continue;
+            }
+            if (l > FN_SCRATCH) {
+                mask[i] = 0;
+                ++amb;
+                continue;
+            }
+            int32_t nl = apply_fn(fn, s, l, scratch);
+            if (nl < 0) {
+                mask[i] = 0;
+                ++amb;
+                continue;
+            }
+            s = scratch;
+            l = nl;
+        }
+        if (t == 4) {  // fn != NONE already continued above for t != 5
             if (!lit_is_num) {  // text compare of number cell: rendering
                 mask[i] = 0;
                 ++amb;
@@ -1219,8 +1405,9 @@ int64_t sel_json_cmp(const char *buf, const int32_t *starts,
 int64_t sel_json_like(const char *buf, const int32_t *starts,
                       const int32_t *lens, const uint8_t *types,
                       int64_t n, const char *pat, int32_t pat_len,
-                      const unsigned char *lit, uint8_t *mask) {
+                      const unsigned char *lit, uint8_t *mask, int fn) {
     int64_t amb = 0;
+    char scratch[FN_SCRATCH];
     for (int64_t i = 0; i < n; ++i) {
         uint8_t t = types[i];
         if (t == 0 || t == 1) {
@@ -1232,8 +1419,24 @@ int64_t sel_json_like(const char *buf, const int32_t *starts,
             ++amb;
             continue;
         }
-        mask[i] = (uint8_t)like_match(buf + starts[i], lens[i], pat,
-                                      pat_len, lit);
+        const char *s = buf + starts[i];
+        int32_t l = lens[i];
+        if (fn != FN_NONE) {
+            if (l > FN_SCRATCH || fn == FN_CHARLEN) {
+                mask[i] = 0;
+                ++amb;
+                continue;
+            }
+            int32_t nl = apply_fn(fn, s, l, scratch);
+            if (nl < 0) {
+                mask[i] = 0;
+                ++amb;
+                continue;
+            }
+            s = scratch;
+            l = nl;
+        }
+        mask[i] = (uint8_t)like_match(s, l, pat, pat_len, lit);
     }
     return amb;
 }

@@ -48,6 +48,10 @@ stats = {"native": 0, "fallback": 0, "replay_blocks": 0}
 _OPS = {"=": 0, "==": 0, "!=": 1, "<>": 1, "<": 2, "<=": 3, ">": 4,
         ">=": 5}
 _FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+# scalar functions the C kernels evaluate per cell (csrc FN_* codes);
+# non-ASCII cells flag ambiguous and replay, preserving exactness
+_FN_CODES = {"lower": 1, "upper": 2, "trim": 3, "ltrim": 4, "rtrim": 5,
+             "char_length": 6, "length": 6, "character_length": 6}
 
 _CSRC = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "csrc")
@@ -79,13 +83,15 @@ def _load():
         lib.sel_cmp_num.restype = _i64
         lib.sel_cmp_num.argtypes = [
             _vp, _vp, _vp, _i64, ctypes.c_int, _dbl, _cp, ctypes.c_int32,
-            _vp]
+            _vp, ctypes.c_int]
         lib.sel_cmp_str.restype = _i64
         lib.sel_cmp_str.argtypes = [
-            _vp, _vp, _vp, _i64, ctypes.c_int, _cp, ctypes.c_int32, _vp]
+            _vp, _vp, _vp, _i64, ctypes.c_int, _cp, ctypes.c_int32, _vp,
+            ctypes.c_int]
         lib.sel_like.restype = _i64
         lib.sel_like.argtypes = [
-            _vp, _vp, _vp, _i64, _cp, ctypes.c_int32, _cp, _vp]
+            _vp, _vp, _vp, _i64, _cp, ctypes.c_int32, _cp, _vp,
+            ctypes.c_int]
         lib.sel_valid.argtypes = [_vp, _i64, _vp]
         lib.sel_isnull.argtypes = [_vp, _i64, _vp]
         lib.sel_agg.restype = _i64
@@ -104,10 +110,11 @@ def _load():
         lib.sel_json_cmp.restype = _i64
         lib.sel_json_cmp.argtypes = [
             _vp, _vp, _vp, _vp, _i64, ctypes.c_int, _dbl, ctypes.c_int,
-            _cp, ctypes.c_int32, _vp]
+            _cp, ctypes.c_int32, _vp, ctypes.c_int]
         lib.sel_json_like.restype = _i64
         lib.sel_json_like.argtypes = [
-            _vp, _vp, _vp, _vp, _i64, _cp, ctypes.c_int32, _cp, _vp]
+            _vp, _vp, _vp, _vp, _i64, _cp, ctypes.c_int32, _cp, _vp,
+            ctypes.c_int]
         lib.sel_json_valid.argtypes = [_vp, _i64, _vp]
         lib.sel_json_isnull.restype = _i64
         lib.sel_json_isnull.argtypes = [_vp, _vp, _i64, _vp]
@@ -195,7 +202,7 @@ class _Plan:
 
     # ctx: object with .buf (ctypes buffer), .starts/.lens/.types lists
     # of per-slot numpy arrays (length nrows), .n
-    def _leaf_cmp(self, slot: int, op: str, lit_v):
+    def _leaf_cmp(self, slot: int, op: str, lit_v, fn: int = 0):
         lib = _load()
         opc = _OPS[op]
         numlit = _num(lit_v)
@@ -209,7 +216,7 @@ class _Plan:
                     ctx.buf, _ptr(ctx.starts[slot]), _ptr(ctx.lens[slot]),
                     _ptr(ctx.types[slot]), ctx.n, opc,
                     float(numlit) if is_num else 0.0, int(is_num),
-                    strlit, len(strlit), _ptr(m))
+                    strlit, len(strlit), _ptr(m), fn)
                 return m.view(bool)
             return leaf
         if is_num:
@@ -218,7 +225,7 @@ class _Plan:
                 self.amb += lib.sel_cmp_num(
                     ctx.buf, _ptr(ctx.starts[slot]), _ptr(ctx.lens[slot]),
                     ctx.n, opc, float(numlit), strlit, len(strlit),
-                    _ptr(m))
+                    _ptr(m), fn)
                 return m.view(bool)
             return leaf
 
@@ -226,9 +233,19 @@ class _Plan:
             m = np.empty(ctx.n, dtype=np.uint8)
             self.amb += lib.sel_cmp_str(
                 ctx.buf, _ptr(ctx.starts[slot]), _ptr(ctx.lens[slot]),
-                ctx.n, opc, strlit, len(strlit), _ptr(m))
+                ctx.n, opc, strlit, len(strlit), _ptr(m), fn)
             return m.view(bool)
         return leaf
+
+    def _col_fn(self, e, resolve):
+        """Col or fn(Col) -> (slot, fn_code); _Fallback otherwise."""
+        if isinstance(e, Col):
+            return self._slot(resolve(e.name)), 0
+        if isinstance(e, Func) and e.name in _FN_CODES \
+                and len(e.args) == 1 and isinstance(e.args[0], Col):
+            return (self._slot(resolve(e.args[0].name)),
+                    _FN_CODES[e.name])
+        raise _Fallback(f"unsupported operand {type(e).__name__}")
 
     def _valid(self, slot: int):
         lib = _load()
@@ -258,12 +275,14 @@ class _Plan:
                 return lambda ctx: lf(ctx) & rf(ctx)
             return lambda ctx: lf(ctx) | rf(ctx)
         if isinstance(e, Like):
-            if not (isinstance(e.e, Col) and isinstance(e.pat, Lit)
+            if not (isinstance(e.pat, Lit)
                     and isinstance(e.pat.v, str)
                     and (e.esc is None or (isinstance(e.esc, Lit)
                                            and isinstance(e.esc.v, str)))):
                 raise _Fallback("LIKE shape")
-            slot = self._slot(resolve(e.e.name))
+            slot, fncode = self._col_fn(e.e, resolve)
+            if fncode == _FN_CODES["char_length"]:
+                raise _Fallback("LIKE over CHAR_LENGTH")
             pat, litmask = _like_plan(
                 str(e.pat.v), str(e.esc.v) if e.esc is not None else None)
             negate = e.negate
@@ -271,27 +290,30 @@ class _Plan:
             fn = lib.sel_json_like if self.is_json else lib.sel_like
 
             def leaf(ctx, slot=slot, pat=pat, litmask=litmask,
-                     negate=negate, fn=fn):
+                     negate=negate, fn=fn, fncode=fncode):
                 m = np.empty(ctx.n, dtype=np.uint8)
                 if self.is_json:
                     self.amb += fn(ctx.buf, _ptr(ctx.starts[slot]),
                                    _ptr(ctx.lens[slot]),
                                    _ptr(ctx.types[slot]), ctx.n,
-                                   pat, len(pat), litmask, _ptr(m))
+                                   pat, len(pat), litmask, _ptr(m),
+                                   fncode)
                 else:
                     self.amb += fn(ctx.buf, _ptr(ctx.starts[slot]),
                                    _ptr(ctx.lens[slot]), ctx.n,
-                                   pat, len(pat), litmask, _ptr(m))
+                                   pat, len(pat), litmask, _ptr(m),
+                                   fncode)
                 mb = m.view(bool)
                 # null cells make LIKE and NOT LIKE both false
                 return (validf(ctx) & ~mb) if negate else mb
             return leaf
         if isinstance(e, InList):
-            if not (isinstance(e.e, Col) and all(
-                    isinstance(x, Lit) and _lit_ok(x.v) for x in e.items)):
+            if not all(isinstance(x, Lit) and _lit_ok(x.v)
+                       for x in e.items):
                 raise _Fallback("IN shape")
-            slot = self._slot(resolve(e.e.name))
-            leaves = [self._leaf_cmp(slot, "=", x.v) for x in e.items]
+            slot, fncode = self._col_fn(e.e, resolve)
+            leaves = [self._leaf_cmp(slot, "=", x.v, fncode)
+                      for x in e.items]
             validf = self._valid(slot)
             negate = e.negate
 
@@ -302,13 +324,12 @@ class _Plan:
                 return (validf(ctx) & ~m) if negate else m
             return leaf
         if isinstance(e, Between):
-            if not (isinstance(e.e, Col)
-                    and isinstance(e.lo, Lit) and _lit_ok(e.lo.v)
+            if not (isinstance(e.lo, Lit) and _lit_ok(e.lo.v)
                     and isinstance(e.hi, Lit) and _lit_ok(e.hi.v)):
                 raise _Fallback("BETWEEN shape")
-            slot = self._slot(resolve(e.e.name))
-            lo = self._leaf_cmp(slot, ">=", e.lo.v)
-            hi = self._leaf_cmp(slot, "<=", e.hi.v)
+            slot, fncode = self._col_fn(e.e, resolve)
+            lo = self._leaf_cmp(slot, ">=", e.lo.v, fncode)
+            hi = self._leaf_cmp(slot, "<=", e.hi.v, fncode)
             validf = self._valid(slot)
             negate = e.negate
 
@@ -339,12 +360,11 @@ class _Plan:
             col, lit, flip = e.l, e.r, False
             if isinstance(col, Lit):
                 col, lit, flip = e.r, e.l, True
-            if not (isinstance(col, Col) and isinstance(lit, Lit)
-                    and _lit_ok(lit.v)):
+            if not (isinstance(lit, Lit) and _lit_ok(lit.v)):
                 raise _Fallback("cmp shape")
-            slot = self._slot(resolve(col.name))
+            slot, fn = self._col_fn(col, resolve)
             op = _FLIP.get(e.op, e.op) if flip else e.op
-            return self._leaf_cmp(slot, op, lit.v)
+            return self._leaf_cmp(slot, op, lit.v, fn)
         raise _Fallback(f"unsupported node {type(e).__name__}")
 
 
