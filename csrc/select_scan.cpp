@@ -880,6 +880,107 @@ int64_t sel_agg(const char *buf, const int32_t *starts,
     return cnt;
 }
 
+// ---------------------------------------------- numeric expression leaves
+
+// Tiny per-cell numeric program for `expr(col) <op> literal` leaves
+// where expr is an arithmetic/CAST chain over ONE column:
+//   codes: 0 x+k, 1 x-k, 2 x*k, 3 x/k, 4 x%k (Python floor-sign mod),
+//          5 k-x, 6 k/x, 7 trunc(x) (CAST INT), 8 noop (CAST FLOAT)
+// A cell that fails the strict numeric parse is AMBIGUOUS (the row
+// engine raises SQLError for arithmetic on non-numbers — the replay
+// reproduces that exactly), as are div/mod by zero.
+static inline int run_prog(double x, const int32_t *codes,
+                           const double *ops, int plen, double *out) {
+    for (int p = 0; p < plen; ++p) {
+        double k = ops[p];
+        switch (codes[p]) {
+        case 0: x = x + k; break;
+        case 1: x = x - k; break;
+        case 2: x = x * k; break;
+        case 3:
+            if (k == 0.0)
+                return 0;
+            x = x / k;
+            break;
+        case 4: {
+            if (k == 0.0)
+                return 0;
+            double r = fmod(x, k);
+            if (r != 0.0 && ((r < 0.0) != (k < 0.0)))
+                r += k;  // Python floor-sign modulo
+            x = r;
+            break;
+        }
+        case 5: x = k - x; break;
+        case 6:
+            if (x == 0.0)
+                return 0;
+            x = k / x;
+            break;
+        case 7: x = trunc(x); break;
+        case 8: break;
+        }
+        // Exactness guard: beyond 2^53 the row engine's Python big-int
+        // arithmetic diverges from doubles, and NaN/inf compare under
+        // different rules (NaN cmp is always False in Python; the
+        // 3-way compare here would read it as 'equal').  Both fail
+        // this bound (NaN fails every comparison) => replay.
+        if (!(x > -9007199254740992.0 && x < 9007199254740992.0))
+            return 0;
+    }
+    *out = x;
+    return 1;
+}
+
+int64_t sel_cmp_expr(const char *buf, const int32_t *starts,
+                     const int32_t *lens, int64_t n, int op,
+                     double num_lit, const int32_t *codes,
+                     const double *ops, int plen, uint8_t *mask) {
+    int64_t amb = 0;
+    const int opmask = OPMASK[op];
+    for (int64_t i = 0; i < n; ++i) {
+        int32_t l = lens[i];
+        const char *s = buf + starts[i];
+        double v;
+        if (l < 0 || !parse_num(s, l, &v) ||
+            !run_prog(v, codes, ops, plen, &v)) {
+            // null/missing/garbage cells: the row engine RAISES for
+            // arithmetic — replay the block so it can
+            mask[i] = 0;
+            ++amb;
+            continue;
+        }
+        int c = (v > num_lit) - (v < num_lit);
+        mask[i] = (uint8_t)((opmask >> (c + 1)) & 1);
+    }
+    return amb;
+}
+
+int64_t sel_json_cmp_expr(const char *buf, const int32_t *starts,
+                          const int32_t *lens, const uint8_t *types,
+                          int64_t n, int op, double num_lit,
+                          const int32_t *codes, const double *ops,
+                          int plen, uint8_t *mask) {
+    int64_t amb = 0;
+    const int opmask = OPMASK[op];
+    for (int64_t i = 0; i < n; ++i) {
+        uint8_t t = types[i];
+        double v;
+        // number tokens and numeric strings both feed arithmetic in
+        // the row engine (_num coerces); everything else raises there
+        if ((t != 4 && t != 5) ||
+            !parse_num(buf + starts[i], lens[i], &v) ||
+            !run_prog(v, codes, ops, plen, &v)) {
+            mask[i] = 0;
+            ++amb;
+            continue;
+        }
+        int c = (v > num_lit) - (v < num_lit);
+        mask[i] = (uint8_t)((opmask >> (c + 1)) & 1);
+    }
+    return amb;
+}
+
 // ------------------------------------------------------------ NDJSON scan
 
 // Per-line top-level key extraction.  For each needed key the scanner

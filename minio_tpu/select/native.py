@@ -36,8 +36,9 @@ import numpy as np
 
 from . import eventstream as es
 from .records import _decomp
-from .sql import (AGGREGATES, Between, Bin, Col, Evaluator, Func, InList,
-                  IsNull, Like, Lit, Query, SQLError, Un, _cmp_pair, _num)
+from .sql import (AGGREGATES, Between, Bin, Cast, Col, Evaluator, Func,
+                  InList, IsNull, Like, Lit, Query, SQLError, Un,
+                  _cmp_pair, _num)
 
 CHUNK = 4 << 20
 FLUSH = 256 << 10
@@ -92,6 +93,14 @@ def _load():
         lib.sel_like.argtypes = [
             _vp, _vp, _vp, _i64, _cp, ctypes.c_int32, _cp, _vp,
             ctypes.c_int]
+        lib.sel_cmp_expr.restype = _i64
+        lib.sel_cmp_expr.argtypes = [
+            _vp, _vp, _vp, _i64, ctypes.c_int, _dbl, _vp, _vp,
+            ctypes.c_int, _vp]
+        lib.sel_json_cmp_expr.restype = _i64
+        lib.sel_json_cmp_expr.argtypes = [
+            _vp, _vp, _vp, _vp, _i64, ctypes.c_int, _dbl, _vp, _vp,
+            ctypes.c_int, _vp]
         lib.sel_valid.argtypes = [_vp, _i64, _vp]
         lib.sel_isnull.argtypes = [_vp, _i64, _vp]
         lib.sel_agg.restype = _i64
@@ -142,6 +151,11 @@ def _ptr(a: np.ndarray):
 
 
 # ------------------------------------------------------------ WHERE plan
+
+
+def _lit_num(v) -> bool:
+    return (isinstance(v, (int, float)) and not isinstance(v, bool)
+            and (not isinstance(v, int) or abs(v) < 2**53))
 
 
 def _lit_ok(v) -> bool:
@@ -234,6 +248,75 @@ class _Plan:
             self.amb += lib.sel_cmp_str(
                 ctx.buf, _ptr(ctx.starts[slot]), _ptr(ctx.lens[slot]),
                 ctx.n, opc, strlit, len(strlit), _ptr(m), fn)
+            return m.view(bool)
+        return leaf
+
+    def _num_prog(self, e):
+        """Arithmetic/CAST chain over ONE column -> (Col, [(code,
+        operand)]); _Fallback for anything else.  codes match csrc
+        run_prog.  Literal operands must be clean numbers."""
+        def walk(node):
+            if isinstance(node, Col):
+                return node, []
+            if isinstance(node, Un) and node.op == "neg":
+                col, prog = walk(node.e)
+                return col, prog + [(5, 0.0)]  # 0 - x
+            if isinstance(node, Cast):
+                col, prog = walk(node.e)
+                if node.typ in ("int", "integer"):
+                    return col, prog + [(7, 0.0)]
+                if node.typ in ("float", "decimal", "numeric", "double"):
+                    return col, prog + [(8, 0.0)]
+                raise _Fallback(f"CAST {node.typ}")
+            if isinstance(node, Bin) and node.op in "+-*/%":
+                code_l = {"+": 0, "-": 1, "*": 2, "/": 3, "%": 4}
+                if isinstance(node.r, Lit) and _lit_num(node.r.v):
+                    col, prog = walk(node.l)
+                    return col, prog + [(code_l[node.op],
+                                         float(_num(node.r.v)))]
+                if isinstance(node.l, Lit) and _lit_num(node.l.v):
+                    col, prog = walk(node.r)
+                    if node.op == "+":
+                        return col, prog + [(0, float(_num(node.l.v)))]
+                    if node.op == "*":
+                        return col, prog + [(2, float(_num(node.l.v)))]
+                    if node.op == "-":
+                        return col, prog + [(5, float(_num(node.l.v)))]
+                    if node.op == "/":
+                        return col, prog + [(6, float(_num(node.l.v)))]
+                    raise _Fallback("lit % expr")
+            raise _Fallback(f"expr shape {type(node).__name__}")
+
+        col, prog = walk(e)
+        if not prog:
+            raise _Fallback("bare column")  # plain cmp path handles it
+        return col, prog
+
+    def _leaf_expr(self, e, resolve, op: str, lit_v):
+        """expr(col) <op> numeric-literal leaf via sel_cmp_expr."""
+        numlit = _num(lit_v)
+        if not _lit_num(numlit):
+            raise _Fallback("expr vs text literal")  # str() rendering
+        col, prog = self._num_prog(e)
+        slot = self._slot(resolve(col.name))
+        lib = _load()
+        opc = _OPS[op]
+        codes = np.array([c for c, _ in prog], dtype=np.int32)
+        ops = np.array([o for _, o in prog], dtype=np.float64)
+        isj = self.is_json
+
+        def leaf(ctx, slot=slot, codes=codes, ops=ops):
+            m = np.empty(ctx.n, dtype=np.uint8)
+            if isj:
+                self.amb += lib.sel_json_cmp_expr(
+                    ctx.buf, _ptr(ctx.starts[slot]), _ptr(ctx.lens[slot]),
+                    _ptr(ctx.types[slot]), ctx.n, opc, float(numlit),
+                    _ptr(codes), _ptr(ops), len(prog), _ptr(m))
+            else:
+                self.amb += lib.sel_cmp_expr(
+                    ctx.buf, _ptr(ctx.starts[slot]), _ptr(ctx.lens[slot]),
+                    ctx.n, opc, float(numlit), _ptr(codes), _ptr(ops),
+                    len(prog), _ptr(m))
             return m.view(bool)
         return leaf
 
@@ -357,13 +440,26 @@ class _Plan:
             return leaf
         if isinstance(e, Bin) and e.op in ("=", "==", "!=", "<>", "<",
                                            "<=", ">", ">="):
-            col, lit, flip = e.l, e.r, False
-            if isinstance(col, Lit):
-                col, lit, flip = e.r, e.l, True
+            def fold_neg(node):
+                # the parser renders -900 as Un(neg, Lit(900))
+                if isinstance(node, Un) and node.op == "neg" \
+                        and isinstance(node.e, Lit) \
+                        and isinstance(node.e.v, (int, float)) \
+                        and not isinstance(node.e.v, bool):
+                    return Lit(-node.e.v)
+                return node
+
+            col, lit, flip = e.l, fold_neg(e.r), False
+            if isinstance(fold_neg(e.l), Lit):
+                col, lit, flip = e.r, fold_neg(e.l), True
             if not (isinstance(lit, Lit) and _lit_ok(lit.v)):
                 raise _Fallback("cmp shape")
-            slot, fn = self._col_fn(col, resolve)
             op = _FLIP.get(e.op, e.op) if flip else e.op
+            try:
+                slot, fn = self._col_fn(col, resolve)
+            except _Fallback:
+                # arithmetic / CAST chain over one column
+                return self._leaf_expr(col, resolve, op, lit.v)
             return self._leaf_cmp(slot, op, lit.v, fn)
         raise _Fallback(f"unsupported node {type(e).__name__}")
 

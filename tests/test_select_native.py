@@ -319,14 +319,14 @@ class TestReviewFindings:
 
 class TestNativeFallbacks:
     def test_unsupported_queries_fall_through(self):
-        """CAST/arithmetic are beyond the native leaf language — they
-        must fall back (and count it) yet still answer correctly via
-        the lower tiers."""
+        """Leaves beyond the native language (COALESCE, multi-column
+        arithmetic, ...) must fall back (and count it) yet still answer
+        correctly via the lower tiers."""
         before = native.stats["fallback"]
-        fast = _run("SELECT COUNT(*) FROM s3object "
-                    "WHERE CAST(b AS INT) > 500", CLEAN)
-        slow = _run("SELECT COUNT(*) FROM s3object "
-                    "WHERE CAST(b AS INT) > 500", CLEAN, tier="row")
+        expr = ("SELECT COUNT(*) FROM s3object "
+                "WHERE COALESCE(a, 'x') = 'r7'")
+        fast = _run(expr, CLEAN)
+        slow = _run(expr, CLEAN, tier="row")
         assert fast == slow
         assert native.stats["fallback"] == before + 1
 
@@ -433,3 +433,85 @@ class TestNativeScalarFunctions:
         _differential(
             "SELECT COUNT(*) FROM s3object WHERE UPPER(a) LIKE 'WORD1%'",
             data)
+
+
+class TestNativeArithmeticAndCast:
+    """expr(col) <op> numeric-literal leaves compile to a per-cell
+    numeric program in C (run_prog): arithmetic chains, CAST INT/FLOAT,
+    unary minus, Python floor-sign modulo — garbage cells replay so the
+    row engine's SQLError semantics hold."""
+
+    @pytest.mark.parametrize("expr", [
+        "SELECT COUNT(*) FROM s3object WHERE b * 2 > 1000",
+        "SELECT COUNT(*) FROM s3object WHERE b + 1 = 112",
+        "SELECT COUNT(*) FROM s3object WHERE b - 10 >= 980",
+        "SELECT COUNT(*) FROM s3object WHERE b / 2 < 100",
+        "SELECT COUNT(*) FROM s3object WHERE b % 7 = 3",
+        "SELECT COUNT(*) FROM s3object WHERE b * 2 + 1 > 999",
+        "SELECT COUNT(*) FROM s3object WHERE 1000 - b < 500",
+        "SELECT COUNT(*) FROM s3object WHERE -b < -900",
+        "SELECT COUNT(*) FROM s3object WHERE CAST(b AS INT) > 500",
+        "SELECT COUNT(*) FROM s3object WHERE CAST(b AS FLOAT) / 4 > 100",
+        "SELECT COUNT(*) FROM s3object WHERE CAST(b AS INT) % 2 = 0",
+    ])
+    def test_csv_arith_differential(self, expr):
+        _differential(expr, CLEAN)
+
+    def test_arith_on_garbage_raises_like_row_engine(self):
+        """Arithmetic over a non-numeric cell raises SQLError in the
+        row engine; the native block replays and errors identically."""
+        data = b"a,b\nr1,5\nr2,notanum\nr3,7\n"
+        expr = "SELECT COUNT(*) FROM s3object WHERE b * 2 > 5"
+        fast = _run(expr, data)
+        slow = _run(expr, data, tier="row")
+        assert fast == slow
+        assert b"InvalidQuery" in fast
+
+    def test_division_by_zero_cell(self):
+        data = b"a,b\nr1,0\nr2,5\n"
+        expr = "SELECT COUNT(*) FROM s3object WHERE 10 / b > 1"
+        fast = _run(expr, data)
+        slow = _run(expr, data, tier="row")
+        assert fast == slow  # both error in-band
+
+    def test_negative_modulo_matches_python(self):
+        data = b"a,b\nr1,-7\nr2,7\nr3,-3\n"
+        # Python: -7 % 3 == 2 (floor-sign), C fmod would give -1
+        _differential(
+            "SELECT COUNT(*) FROM s3object WHERE b % 3 = 2", data)
+
+    @pytest.mark.parametrize("expr", [
+        "SELECT COUNT(*) FROM s3object WHERE n * 2 > 1000",
+        "SELECT COUNT(*) FROM s3object WHERE CAST(n AS INT) % 5 = 0",
+        "SELECT COUNT(*) FROM s3object WHERE n + 0.5 < 100",
+    ])
+    def test_json_arith_differential(self, expr):
+        _differential(expr, JLINES,
+                      inp={"JSON": {"Type": "LINES"}}, out={"JSON": {}})
+
+    def test_json_arith_on_mixed_types_replays(self):
+        _differential("SELECT COUNT(*) FROM s3object WHERE n * 2 > 8",
+                      JDIRTY, inp={"JSON": {"Type": "LINES"}},
+                      out={"JSON": {}}, require_native=True)
+
+
+class TestArithExactnessGuards:
+    """Round-5 review: NaN results and >=2^53 intermediates replay so
+    Python's big-int exactness and NaN comparison rules hold."""
+
+    def test_inf_times_zero_is_nan_not_equal(self):
+        data = b"a,b\nr1,1e999\nr2,5\n"
+        for expr in ("SELECT COUNT(*) FROM s3object WHERE b * 0 = 0",
+                     "SELECT COUNT(*) FROM s3object WHERE b * 0 <= 0",
+                     "SELECT COUNT(*) FROM s3object WHERE b * 0 != 0"):
+            _differential(expr, data, require_native=False)
+
+    def test_big_int_product_uses_python_exactness(self):
+        data = b"a,b\nr1,999999999999999\nr2,5\n"
+        _differential(
+            "SELECT COUNT(*) FROM s3object "
+            "WHERE b * 999 = 998999999999999001.0",
+            data, require_native=False)
+        _differential(
+            "SELECT COUNT(*) FROM s3object WHERE b * 999 > 0", data,
+            require_native=False)
