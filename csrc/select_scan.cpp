@@ -880,6 +880,44 @@ int64_t sel_agg(const char *buf, const int32_t *starts,
     return cnt;
 }
 
+// ------------------------------------------------------ column emission
+
+// Emit selected columns of masked rows as CSV records (projection
+// path: SELECT a,b ... WHERE).  Caller guarantees the block is free of
+// quote chars and \r (blocks containing either replay through the row
+// engine's csv.writer), so cells copy verbatim: no quoting can ever be
+// required — cells cannot contain the delimiter or newline by
+// construction.  Missing cells (len -1, ragged rows) emit empty, the
+// row engine's rendering of a None projection.  limit < 0 = unlimited.
+// Returns rows emitted; *out_len = bytes written.
+int64_t sel_emit_cols(const char *buf, const int32_t *starts,
+                      const int32_t *lens, int64_t max_rows,
+                      const int32_t *slots, int32_t nslots,
+                      int64_t nrows, const uint8_t *mask, int64_t limit,
+                      char delim, char *outbuf, int64_t *out_len) {
+    int64_t n = 0, o = 0;
+    for (int64_t r = 0; r < nrows; ++r) {
+        if (mask && !mask[r])
+            continue;
+        if (limit >= 0 && n >= limit)
+            break;
+        for (int32_t c = 0; c < nslots; ++c) {
+            if (c)
+                outbuf[o++] = delim;
+            int64_t idx = (int64_t)slots[c] * max_rows + r;
+            int32_t l = lens[idx];
+            if (l > 0) {
+                memcpy(outbuf + o, buf + starts[idx], l);
+                o += l;
+            }
+        }
+        outbuf[o++] = '\n';
+        ++n;
+    }
+    *out_len = o;
+    return n;
+}
+
 // ---------------------------------------------- numeric expression leaves
 
 // Tiny per-cell numeric program for `expr(col) <op> literal` leaves

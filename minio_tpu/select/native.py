@@ -112,6 +112,10 @@ def _load():
         lib.sel_emit_rows.restype = _i64
         lib.sel_emit_rows.argtypes = [
             _vp, _vp, _i64, _vp, _i64, _vp, ctypes.POINTER(_i64)]
+        lib.sel_emit_cols.restype = _i64
+        lib.sel_emit_cols.argtypes = [
+            _vp, _vp, _vp, _i64, _vp, ctypes.c_int32, _i64, _vp, _i64,
+            ctypes.c_char, _vp, ctypes.POINTER(_i64)]
         lib.sel_json_scan.restype = _i64
         lib.sel_json_scan.argtypes = [
             _vp, _i64, ctypes.c_int, _vp, _vp, ctypes.c_int32, _i64, _vp,
@@ -544,11 +548,11 @@ def _try_csv(req, query: Query, rw, object_size: int, out):
     compression = req.input_ser.get("CompressionType", "NONE") or "NONE"
     aggs = _agg_shape(query)
     emit = False
+    proj_cols_ast: list | None = None
     if aggs is None:
-        # SELECT * passthrough: CSV output whose serialization leaves
-        # unquoted input rows byte-identical
-        if not query.star or query.projections:
-            raise _Fallback("projection shape")
+        # SELECT * passthrough, or plain-column projections, both with
+        # CSV output whose serialization matches the input (cells copy
+        # verbatim; quoted/\r blocks replay through the row engine)
         o = req.output_ser
         oc = o.get("CSV")
         if not isinstance(oc, (dict, type(None))) or "CSV" not in o:
@@ -558,7 +562,20 @@ def _try_csv(req, query: Query, rw, object_size: int, out):
                 or (oc.get("RecordDelimiter", "\n") or "\n") != "\n" \
                 or (oc.get("QuoteCharacter", '"') or '"') != '"':
             raise _Fallback("output serialization")
-        emit = True
+        if query.star and not query.projections:
+            emit = True
+        elif query.projections and all(
+                isinstance(p.expr, Col) for p in query.projections):
+            # the row engine projects into a DICT: duplicate output
+            # names collapse to one column — fall back for that shape
+            names_out = [p.alias or Evaluator._auto_name(p.expr, i)
+                         for i, p in enumerate(query.projections)]
+            if len(set(names_out)) != len(names_out):
+                raise _Fallback("duplicate projection names")
+            proj_cols_ast = [p.expr for p in query.projections]
+            emit = True
+        else:
+            raise _Fallback("projection shape")
 
     raw = _decomp(rw, compression)
     if header == "USE":
@@ -599,10 +616,14 @@ def _try_csv(req, query: Query, rw, object_size: int, out):
         for what, colname, fname in aggs:
             agg_cols.append(None if colname is None
                             else resolve(colname))
+    proj_resolved: list[int] = []
+    if proj_cols_ast is not None:
+        proj_resolved = [resolve(c.name) for c in proj_cols_ast]
 
     # needed columns, ascending, plus slot remap
     needed = sorted(set(plan.cols) | {c for c in agg_cols
-                                      if c is not None}) or [0]
+                                      if c is not None}
+                    | set(proj_resolved)) or [0]
     col_pos = {c: i for i, c in enumerate(needed)}
     ev = Evaluator(query)
     lib = _load()
@@ -649,6 +670,14 @@ def _try_csv(req, query: Query, rw, object_size: int, out):
     def gen() -> Iterator[bytes]:
         max_rows = 1 << 19
         col_arr = np.array(needed, dtype=np.int32)
+        slots_arr = np.array([col_pos[c] for c in proj_resolved],
+                             dtype=np.int32)
+        # capacity math: a cell's bytes are emitted ONCE PER SLOT that
+        # references its column (SELECT a AS x, a AS y re-emits a), so
+        # the bound scales by the max per-column multiplicity
+        from collections import Counter
+
+        emit_mult = max(Counter(proj_resolved).values(), default=1)
         starts = np.empty((len(needed), max_rows), dtype=np.int32)
         lens = np.empty((len(needed), max_rows), dtype=np.int32)
         row_start = np.empty(max_rows + 1, dtype=np.int32)
@@ -792,17 +821,27 @@ def _try_csv(req, query: Query, rw, object_size: int, out):
                                 mask.astype(np.uint8))
                         lim = -1 if limit is None else max(
                             0, limit - n_out)
-                        if int(consumed.value) + 1 > \
-                                ctypes.sizeof(emit_buf):
-                            # blocks can outgrow CHUNK when a record
-                            # straddles reads (tail + CHUNK): emitted
-                            # bytes are bounded by consumed + 1
+                        # emitted bytes bound: every cell emits once
+                        # per slot referencing its column, plus per-row
+                        # separators/newline
+                        need_cap = int(consumed.value) * emit_mult + \
+                            1 + n * (len(proj_resolved) + 2)
+                        if need_cap > ctypes.sizeof(emit_buf):
                             emit_buf = ctypes.create_string_buffer(
-                                int(consumed.value) * 2)
-                        wrote = lib.sel_emit_rows(
-                            cbuf, _ptr(row_start[:n + 1]), n,
-                            _ptr(km) if km is not None else None,
-                            lim, emit_buf, ctypes.byref(out_len))
+                                need_cap * 2)
+                        if proj_cols_ast is None:
+                            wrote = lib.sel_emit_rows(
+                                cbuf, _ptr(row_start[:n + 1]), n,
+                                _ptr(km) if km is not None else None,
+                                lim, emit_buf, ctypes.byref(out_len))
+                        else:
+                            wrote = lib.sel_emit_cols(
+                                cbuf, _ptr(starts), _ptr(lens),
+                                max_rows, _ptr(slots_arr),
+                                len(proj_resolved), n,
+                                _ptr(km) if km is not None else None,
+                                lim, delim.encode(), emit_buf,
+                                ctypes.byref(out_len))
                         n_out += int(wrote)
                         if out_len.value:
                             outbuf += emit_buf.raw[:out_len.value]
