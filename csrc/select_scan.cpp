@@ -572,7 +572,12 @@ int64_t sel_emit_rows(const char *buf, const int32_t *row_start,
 // 6 CHAR_LENGTH (cell becomes its codepoint count, compared
 // numerically).
 enum { FN_NONE = 0, FN_LOWER, FN_UPPER, FN_TRIM, FN_LTRIM, FN_RTRIM,
-       FN_CHARLEN };
+       FN_CHARLEN, FN_SUBSTR };
+// FN_SUBSTR takes (start, len) via the fn_a/fn_b kernel params:
+// Python s[max(start-1,0) : max(start-1,0)+len]; fb == -1 is the
+// driver's 'no length' sentinel (slice to end) — explicit negative
+// lengths never reach here (they fall back: Python-slice semantics).
+// Codepoint indexing == byte indexing for the ASCII-only fast path.
 
 static inline int all_ascii(const char *s, int32_t n) {
     for (int32_t i = 0; i < n; ++i)
@@ -592,11 +597,25 @@ static inline int py_space(char c) {
 // Apply fn to [s, s+n) into scratch (capacity >= n).  Returns new
 // length, or -1 when ambiguous (non-ASCII byte present).
 static inline int32_t apply_fn(int fn, const char *s, int32_t n,
-                               char *scratch) {
+                               char *scratch, int32_t fa, int32_t fb) {
     if (!all_ascii(s, n))
         return -1;  // Python unicode semantics: replay
     const char *b = s, *e = s + n;
     switch (fn) {
+    case FN_SUBSTR: {
+        int32_t start0 = fa - 1;
+        if (start0 < 0)
+            start0 = 0;
+        if (start0 > n)
+            start0 = n;
+        int32_t take = (fb < 0) ? (n - start0) : fb;
+        if (take > n - start0)
+            take = n - start0;
+        if (take < 0)
+            take = 0;
+        memcpy(scratch, s + start0, take);
+        return take;
+    }
     case FN_TRIM:
     case FN_LTRIM:
         while (b < e && py_space(*b))
@@ -662,7 +681,7 @@ static inline int bytes_cmp(const char *a, int32_t an,
 int64_t sel_cmp_num(const char *buf, const int32_t *starts,
                     const int32_t *lens, int64_t n, int op,
                     double num_lit, const char *str_lit, int32_t str_len,
-                    uint8_t *mask, int fn) {
+                    uint8_t *mask, int fn, int32_t fn_a, int32_t fn_b) {
     int64_t amb = 0;
     const int opmask = OPMASK[op];
     char scratch[FN_SCRATCH];
@@ -692,7 +711,7 @@ int64_t sel_cmp_num(const char *buf, const int32_t *starts,
                 ++amb;
                 continue;
             }
-            int32_t nl = apply_fn(fn, s, l, scratch);
+            int32_t nl = apply_fn(fn, s, l, scratch, fn_a, fn_b);
             if (nl < 0) {
                 mask[i] = 0;
                 ++amb;
@@ -732,7 +751,7 @@ int64_t sel_cmp_num(const char *buf, const int32_t *starts,
 int64_t sel_cmp_str(const char *buf, const int32_t *starts,
                     const int32_t *lens, int64_t n, int op,
                     const char *lit, int32_t lit_len, uint8_t *mask,
-                    int fn) {
+                    int fn, int32_t fn_a, int32_t fn_b) {
     int64_t amb = 0;
     char scratch[FN_SCRATCH];
     for (int64_t i = 0; i < n; ++i) {
@@ -760,7 +779,7 @@ int64_t sel_cmp_str(const char *buf, const int32_t *starts,
                 ++amb;
                 continue;
             }
-            int32_t nl = apply_fn(fn, s, l, scratch);
+            int32_t nl = apply_fn(fn, s, l, scratch, fn_a, fn_b);
             if (nl < 0) {
                 mask[i] = 0;
                 ++amb;
@@ -779,7 +798,8 @@ int64_t sel_cmp_str(const char *buf, const int32_t *starts,
 int64_t sel_like(const char *buf, const int32_t *starts,
                  const int32_t *lens, int64_t n,
                  const char *pat, int32_t pat_len,
-                 const unsigned char *lit, uint8_t *mask, int fn) {
+                 const unsigned char *lit, uint8_t *mask, int fn,
+                 int32_t fn_a, int32_t fn_b) {
     int64_t amb = 0;
     char scratch[FN_SCRATCH];
     for (int64_t i = 0; i < n; ++i) {
@@ -797,7 +817,7 @@ int64_t sel_like(const char *buf, const int32_t *starts,
                 ++amb;
                 continue;
             }
-            int32_t nl = apply_fn(fn, s, l, scratch);
+            int32_t nl = apply_fn(fn, s, l, scratch, fn_a, fn_b);
             if (nl < 0) {
                 mask[i] = 0;
                 ++amb;
@@ -1454,7 +1474,7 @@ int64_t sel_json_cmp(const char *buf, const int32_t *starts,
                      const int32_t *lens, const uint8_t *types,
                      int64_t n, int op, double num_lit, int lit_is_num,
                      const char *str_lit, int32_t str_len,
-                     uint8_t *mask, int fn) {
+                     uint8_t *mask, int fn, int32_t fn_a, int32_t fn_b) {
     int64_t amb = 0;
     char scratch[FN_SCRATCH];
     const int opmask = OPMASK[op];
@@ -1498,7 +1518,7 @@ int64_t sel_json_cmp(const char *buf, const int32_t *starts,
                 ++amb;
                 continue;
             }
-            int32_t nl = apply_fn(fn, s, l, scratch);
+            int32_t nl = apply_fn(fn, s, l, scratch, fn_a, fn_b);
             if (nl < 0) {
                 mask[i] = 0;
                 ++amb;
@@ -1544,7 +1564,8 @@ int64_t sel_json_cmp(const char *buf, const int32_t *starts,
 int64_t sel_json_like(const char *buf, const int32_t *starts,
                       const int32_t *lens, const uint8_t *types,
                       int64_t n, const char *pat, int32_t pat_len,
-                      const unsigned char *lit, uint8_t *mask, int fn) {
+                      const unsigned char *lit, uint8_t *mask, int fn,
+                 int32_t fn_a, int32_t fn_b) {
     int64_t amb = 0;
     char scratch[FN_SCRATCH];
     for (int64_t i = 0; i < n; ++i) {
@@ -1566,7 +1587,7 @@ int64_t sel_json_like(const char *buf, const int32_t *starts,
                 ++amb;
                 continue;
             }
-            int32_t nl = apply_fn(fn, s, l, scratch);
+            int32_t nl = apply_fn(fn, s, l, scratch, fn_a, fn_b);
             if (nl < 0) {
                 mask[i] = 0;
                 ++amb;

@@ -53,6 +53,7 @@ _FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
 # non-ASCII cells flag ambiguous and replay, preserving exactness
 _FN_CODES = {"lower": 1, "upper": 2, "trim": 3, "ltrim": 4, "rtrim": 5,
              "char_length": 6, "length": 6, "character_length": 6}
+_FN_SUBSTR = 7
 
 _CSRC = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "csrc")
@@ -84,15 +85,15 @@ def _load():
         lib.sel_cmp_num.restype = _i64
         lib.sel_cmp_num.argtypes = [
             _vp, _vp, _vp, _i64, ctypes.c_int, _dbl, _cp, ctypes.c_int32,
-            _vp, ctypes.c_int]
+            _vp, ctypes.c_int, ctypes.c_int32, ctypes.c_int32]
         lib.sel_cmp_str.restype = _i64
         lib.sel_cmp_str.argtypes = [
             _vp, _vp, _vp, _i64, ctypes.c_int, _cp, ctypes.c_int32, _vp,
-            ctypes.c_int]
+            ctypes.c_int, ctypes.c_int32, ctypes.c_int32]
         lib.sel_like.restype = _i64
         lib.sel_like.argtypes = [
             _vp, _vp, _vp, _i64, _cp, ctypes.c_int32, _cp, _vp,
-            ctypes.c_int]
+            ctypes.c_int, ctypes.c_int32, ctypes.c_int32]
         lib.sel_cmp_expr.restype = _i64
         lib.sel_cmp_expr.argtypes = [
             _vp, _vp, _vp, _i64, ctypes.c_int, _dbl, _vp, _vp,
@@ -123,11 +124,12 @@ def _load():
         lib.sel_json_cmp.restype = _i64
         lib.sel_json_cmp.argtypes = [
             _vp, _vp, _vp, _vp, _i64, ctypes.c_int, _dbl, ctypes.c_int,
-            _cp, ctypes.c_int32, _vp, ctypes.c_int]
+            _cp, ctypes.c_int32, _vp, ctypes.c_int, ctypes.c_int32,
+            ctypes.c_int32]
         lib.sel_json_like.restype = _i64
         lib.sel_json_like.argtypes = [
             _vp, _vp, _vp, _vp, _i64, _cp, ctypes.c_int32, _cp, _vp,
-            ctypes.c_int]
+            ctypes.c_int, ctypes.c_int32, ctypes.c_int32]
         lib.sel_json_valid.argtypes = [_vp, _i64, _vp]
         lib.sel_json_isnull.restype = _i64
         lib.sel_json_isnull.argtypes = [_vp, _vp, _i64, _vp]
@@ -220,7 +222,8 @@ class _Plan:
 
     # ctx: object with .buf (ctypes buffer), .starts/.lens/.types lists
     # of per-slot numpy arrays (length nrows), .n
-    def _leaf_cmp(self, slot: int, op: str, lit_v, fn: int = 0):
+    def _leaf_cmp(self, slot: int, op: str, lit_v, fn: int = 0,
+                  fa: int = 0, fb: int = 0):
         lib = _load()
         opc = _OPS[op]
         numlit = _num(lit_v)
@@ -234,7 +237,7 @@ class _Plan:
                     ctx.buf, _ptr(ctx.starts[slot]), _ptr(ctx.lens[slot]),
                     _ptr(ctx.types[slot]), ctx.n, opc,
                     float(numlit) if is_num else 0.0, int(is_num),
-                    strlit, len(strlit), _ptr(m), fn)
+                    strlit, len(strlit), _ptr(m), fn, fa, fb)
                 return m.view(bool)
             return leaf
         if is_num:
@@ -243,7 +246,7 @@ class _Plan:
                 self.amb += lib.sel_cmp_num(
                     ctx.buf, _ptr(ctx.starts[slot]), _ptr(ctx.lens[slot]),
                     ctx.n, opc, float(numlit), strlit, len(strlit),
-                    _ptr(m), fn)
+                    _ptr(m), fn, fa, fb)
                 return m.view(bool)
             return leaf
 
@@ -251,7 +254,7 @@ class _Plan:
             m = np.empty(ctx.n, dtype=np.uint8)
             self.amb += lib.sel_cmp_str(
                 ctx.buf, _ptr(ctx.starts[slot]), _ptr(ctx.lens[slot]),
-                ctx.n, opc, strlit, len(strlit), _ptr(m), fn)
+                ctx.n, opc, strlit, len(strlit), _ptr(m), fn, fa, fb)
             return m.view(bool)
         return leaf
 
@@ -325,13 +328,32 @@ class _Plan:
         return leaf
 
     def _col_fn(self, e, resolve):
-        """Col or fn(Col) -> (slot, fn_code); _Fallback otherwise."""
+        """Col or fn(Col[, args]) -> (slot, fn_code, fn_a, fn_b);
+        _Fallback otherwise."""
         if isinstance(e, Col):
-            return self._slot(resolve(e.name)), 0
+            return self._slot(resolve(e.name)), 0, 0, 0
         if isinstance(e, Func) and e.name in _FN_CODES \
                 and len(e.args) == 1 and isinstance(e.args[0], Col):
             return (self._slot(resolve(e.args[0].name)),
-                    _FN_CODES[e.name])
+                    _FN_CODES[e.name], 0, 0)
+        if isinstance(e, Func) and e.name == "substring" \
+                and 2 <= len(e.args) <= 3 \
+                and isinstance(e.args[0], Col) \
+                and all(isinstance(a, Lit) and isinstance(a.v, int)
+                        and not isinstance(a.v, bool)
+                        and abs(a.v) < 2**31 for a in e.args[1:]):
+            start = int(e.args[1].v)
+            if len(e.args) > 2:
+                ln = int(e.args[2].v)
+                if ln < 0:
+                    # explicit negative lengths have Python-slice
+                    # semantics in the row engine; -1 is also the
+                    # internal 'to end' sentinel — never conflate them
+                    raise _Fallback("negative SUBSTRING length")
+            else:
+                ln = -1  # sentinel: slice to end
+            return (self._slot(resolve(e.args[0].name)), _FN_SUBSTR,
+                    start, ln)
         raise _Fallback(f"unsupported operand {type(e).__name__}")
 
     def _valid(self, slot: int):
@@ -367,7 +389,7 @@ class _Plan:
                     and (e.esc is None or (isinstance(e.esc, Lit)
                                            and isinstance(e.esc.v, str)))):
                 raise _Fallback("LIKE shape")
-            slot, fncode = self._col_fn(e.e, resolve)
+            slot, fncode, fa, fb = self._col_fn(e.e, resolve)
             if fncode == _FN_CODES["char_length"]:
                 raise _Fallback("LIKE over CHAR_LENGTH")
             pat, litmask = _like_plan(
@@ -377,19 +399,19 @@ class _Plan:
             fn = lib.sel_json_like if self.is_json else lib.sel_like
 
             def leaf(ctx, slot=slot, pat=pat, litmask=litmask,
-                     negate=negate, fn=fn, fncode=fncode):
+                     negate=negate, fn=fn, fncode=fncode, fa=fa, fb=fb):
                 m = np.empty(ctx.n, dtype=np.uint8)
                 if self.is_json:
                     self.amb += fn(ctx.buf, _ptr(ctx.starts[slot]),
                                    _ptr(ctx.lens[slot]),
                                    _ptr(ctx.types[slot]), ctx.n,
                                    pat, len(pat), litmask, _ptr(m),
-                                   fncode)
+                                   fncode, fa, fb)
                 else:
                     self.amb += fn(ctx.buf, _ptr(ctx.starts[slot]),
                                    _ptr(ctx.lens[slot]), ctx.n,
                                    pat, len(pat), litmask, _ptr(m),
-                                   fncode)
+                                   fncode, fa, fb)
                 mb = m.view(bool)
                 # null cells make LIKE and NOT LIKE both false
                 return (validf(ctx) & ~mb) if negate else mb
@@ -398,8 +420,8 @@ class _Plan:
             if not all(isinstance(x, Lit) and _lit_ok(x.v)
                        for x in e.items):
                 raise _Fallback("IN shape")
-            slot, fncode = self._col_fn(e.e, resolve)
-            leaves = [self._leaf_cmp(slot, "=", x.v, fncode)
+            slot, fncode, fa, fb = self._col_fn(e.e, resolve)
+            leaves = [self._leaf_cmp(slot, "=", x.v, fncode, fa, fb)
                       for x in e.items]
             validf = self._valid(slot)
             negate = e.negate
@@ -414,9 +436,9 @@ class _Plan:
             if not (isinstance(e.lo, Lit) and _lit_ok(e.lo.v)
                     and isinstance(e.hi, Lit) and _lit_ok(e.hi.v)):
                 raise _Fallback("BETWEEN shape")
-            slot, fncode = self._col_fn(e.e, resolve)
-            lo = self._leaf_cmp(slot, ">=", e.lo.v, fncode)
-            hi = self._leaf_cmp(slot, "<=", e.hi.v, fncode)
+            slot, fncode, fa, fb = self._col_fn(e.e, resolve)
+            lo = self._leaf_cmp(slot, ">=", e.lo.v, fncode, fa, fb)
+            hi = self._leaf_cmp(slot, "<=", e.hi.v, fncode, fa, fb)
             validf = self._valid(slot)
             negate = e.negate
 
@@ -460,11 +482,11 @@ class _Plan:
                 raise _Fallback("cmp shape")
             op = _FLIP.get(e.op, e.op) if flip else e.op
             try:
-                slot, fn = self._col_fn(col, resolve)
+                slot, fn, fa, fb = self._col_fn(col, resolve)
             except _Fallback:
                 # arithmetic / CAST chain over one column
                 return self._leaf_expr(col, resolve, op, lit.v)
-            return self._leaf_cmp(slot, op, lit.v, fn)
+            return self._leaf_cmp(slot, op, lit.v, fn, fa, fb)
         raise _Fallback(f"unsupported node {type(e).__name__}")
 
 

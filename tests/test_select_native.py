@@ -559,3 +559,39 @@ class TestAliasedDuplicateColumns:
             # flush boundaries differ on multi-MiB outputs; record
             # bytes must not
             assert recs(fast) == recs(slow), expr
+
+
+class TestNativeSubstring:
+    @pytest.mark.parametrize("expr", [
+        "SELECT COUNT(*) FROM s3object WHERE SUBSTRING(a, 1, 2) = 'r1'",
+        "SELECT COUNT(*) FROM s3object WHERE SUBSTRING(a, 2) = '42'",
+        "SELECT COUNT(*) FROM s3object WHERE SUBSTRING(a, 1, 1) = 'r'",
+        "SELECT COUNT(*) FROM s3object "
+        "WHERE SUBSTRING(a, 2, 3) BETWEEN '100' AND '200'",
+        "SELECT COUNT(*) FROM s3object WHERE SUBSTRING(a, 1, 2) "
+        "IN ('r1', 'r2')",
+        "SELECT COUNT(*) FROM s3object WHERE SUBSTRING(a, 99) = ''",
+    ])
+    def test_csv_substring_differential(self, expr):
+        _differential(expr, CLEAN)
+
+    def test_substring_edge_starts(self):
+        data = b"a,b\nhello,1\nhi,2\n,3\n"
+        for expr in (
+                "SELECT COUNT(*) FROM s3object "
+                "WHERE SUBSTRING(a, 0, 2) = 'he'",
+                "SELECT COUNT(*) FROM s3object "
+                "WHERE SUBSTRING(a, 4) = 'lo'",
+                "SELECT COUNT(*) FROM s3object "
+                "WHERE SUBSTRING(a, 1, 0) = ''"):
+            _differential(expr, data)
+
+    def test_substring_nonascii_replays(self):
+        data = "a,b\ncafé,1\nplain,2\n".encode()
+        _differential("SELECT COUNT(*) FROM s3object "
+                      "WHERE SUBSTRING(a, 1, 3) = 'caf'", data)
+
+    def test_json_substring(self):
+        _differential("SELECT COUNT(*) FROM s3object "
+                      "WHERE SUBSTRING(k, 1, 2) = 'u1'", JLINES,
+                      inp={"JSON": {"Type": "LINES"}}, out={"JSON": {}})
