@@ -113,7 +113,10 @@ def run_select(req: SelectRequest, stream,
     streaming has begun become an error event in-band, which is the
     only option the framing leaves (reference behaves the same)."""
     query = parse(req.expression)
-    ev = Evaluator(query)
+    # constructing the Evaluator validates the projection shape (mixed
+    # aggregate/scalar raises) BEFORE any bytes stream — HTTP 4xx, not
+    # an in-band error
+    Evaluator(query)
     out = _make_output(req)
 
     # three-tier engine (fastest first, each falling through when the
@@ -136,9 +139,18 @@ def run_select(req: SelectRequest, stream,
     # fallback: replay the probed prefix, then stream WITHOUT recording —
     # the row engine must not accumulate the whole object in memory
     rw.stop_recording()
-    stream = rw
-    reader = _make_input(req, stream)
+    reader = _make_input(req, rw)
+    yield from row_engine_stream(reader, query, out, object_size,
+                                 req.request_progress)
 
+
+def row_engine_stream(reader, query, out, object_size: int,
+                      request_progress: bool) -> Iterator[bytes]:
+    """The row engine proper: records from `reader` through compiled
+    predicate/projection closures into event-stream messages.  Shared
+    by run_select's fallback tier and the columnar module's post-spool
+    Parquet fallback."""
+    ev = Evaluator(query)
     returned = 0
     buf = bytearray()
     try:
@@ -172,7 +184,7 @@ def run_select(req: SelectRequest, stream,
         if buf:
             returned += len(buf)
             yield es.records_message(bytes(buf))
-        if req.request_progress:
+        if request_progress:
             yield es.progress_message(object_size, object_size, returned)
         yield es.stats_message(object_size, object_size, returned)
         yield es.end_message()

@@ -493,6 +493,8 @@ def try_columnar(req, query: Query, rw: Rewindable, object_size: int,
         return None
     if "JSON" in req.input_ser:
         return _try_json(req, query, rw, object_size, out)
+    if "Parquet" in req.input_ser:
+        return _try_parquet(req, query, rw, object_size, out)
     if not _eligible(req, query):
         stats["fallback"] += 1
         rw.rewind()
@@ -660,6 +662,195 @@ def try_columnar(req, query: Query, rw: Rewindable, object_size: int,
     return gen()
 
 
+def _typed_resolver(names: list[str], alias: str):
+    """Name-only column resolution for typed sources (JSON/Parquet):
+    exact then case-insensitive; no positional _N (an absent '_2' key
+    is a missing field, not column 2)."""
+    lowered = [s.lower() for s in names]
+
+    def resolver(name: str) -> int:
+        parts = name.split(".")
+        if alias and parts and parts[0].lower() == alias:
+            parts = parts[1:]
+        if len(parts) != 1:
+            raise _Fallback(f"nested column {name}")
+        p = parts[0]
+        if p in names:
+            return names.index(p)
+        if p.lower() in lowered:
+            return lowered.index(p.lower())
+        raise _Fallback(f"unknown column {name}")
+
+    return resolver
+
+
+def _typed_agg_cols(query: Query, ev: Evaluator, resolver,
+                    types) -> list:
+    """Aggregate column indices for typed sources; only int/float/
+    string columns fold exactly."""
+    import pyarrow as pa
+
+    agg_cols: list[int | None] = []
+    for p in query.projections:
+        f = p.expr
+        if f.star:
+            agg_cols.append(None)
+            continue
+        idx = resolver(f.args[0].name)
+        t = types[idx]
+        if not (pa.types.is_integer(t) or pa.types.is_floating(t)
+                or pa.types.is_string(t) or pa.types.is_large_string(t)):
+            raise _Fallback(f"aggregate over {t} column")
+        agg_cols.append(idx)
+    return agg_cols
+
+
+def _try_parquet(req, query: Query, rw: Rewindable, object_size: int,
+                 out) -> Iterator[bytes] | None:
+    """Parquet columnar path: row groups stream as arrow batches with
+    the same typed masks/aggregates as the JSON tier, instead of
+    per-row dicts through the row engine (reference
+    internal/s3select/parquet reads row groups natively too).
+
+    Projections/SELECT * materialize only the MASKED rows via
+    to_pylist, which the row engine also uses — values (incl. None,
+    timestamps, decimals) render identically."""
+    if (req.input_ser.get("CompressionType", "NONE") or "NONE") \
+            not in ("NONE", ""):
+        rw.rewind()
+        return None  # the reader will raise the SQLError, not us
+    if not _shape_ok(query):
+        stats["fallback"] += 1
+        rw.rewind()
+        return None
+    try:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+    except Exception:  # pragma: no cover - pyarrow baked into this env
+        rw.rewind()
+        return None
+
+    import shutil
+    import tempfile
+
+    # parquet always needs the whole object (footer at the tail), so
+    # commit the rewind buffer BEFORE spooling — recording would pin a
+    # full in-RAM copy alongside the disk spool.  Post-spool fallbacks
+    # run the row engine FROM THE SPOOL (never from rw again).
+    rw.commit()
+    spool = tempfile.SpooledTemporaryFile(max_size=64 << 20)
+
+    def spool_fallback():
+        from . import row_engine_stream
+        from .records import ParquetInput
+
+        stats["fallback"] += 1
+        spool.seek(0)
+
+        def gen_fb():
+            try:
+                yield from row_engine_stream(
+                    ParquetInput(spool), query, out, object_size,
+                    req.request_progress)
+            finally:
+                spool.close()
+
+        return gen_fb()
+
+    try:
+        shutil.copyfileobj(rw, spool, 1 << 20)
+        spool.seek(0)
+        pf = pq.ParquetFile(spool)
+        schema = pf.schema_arrow
+    except Exception:
+        # bad footer etc: the row engine surfaces its InvalidQuery
+        return spool_fallback()
+
+    names = [f.name for f in schema]
+    types = [f.type for f in schema]
+    alias = query.table_alias
+    resolver = _typed_resolver(names, alias)
+
+    ev = Evaluator(query)
+    try:
+        mask_fn = (_compile_where(query.where, names, alias, True, types,
+                                  resolver=resolver)
+                   if query.where is not None else None)
+        agg_cols: list[int | None] = []
+        if ev.is_aggregate:
+            agg_cols = _typed_agg_cols(query, ev, resolver, types)
+    except _Fallback:
+        return spool_fallback()
+
+    stats["fast"] += 1
+
+    from .sql import compile_projection
+
+    project = compile_projection(ev)
+
+    def gen() -> Iterator[bytes]:
+        returned = 0
+        buf = bytearray()
+        limit = query.limit
+        n_out = 0
+        try:
+            try:
+                batches = pf.iter_batches()
+                for batch in batches:
+                    if (limit is not None and n_out >= limit
+                            and not ev.is_aggregate):
+                        break
+                    tbl = pa.Table.from_batches([batch])
+                    if mask_fn is not None:
+                        mask = mask_fn(_Cols(tbl))
+                        if not mask.any():
+                            continue
+                        if not mask.all():
+                            tbl = tbl.filter(pa.array(mask))
+                    if tbl.num_rows == 0:
+                        continue
+                    if ev.is_aggregate:
+                        _accumulate(ev, tbl, agg_cols)
+                        continue
+                    take = tbl.num_rows
+                    if limit is not None:
+                        take = min(take, limit - n_out)
+                        tbl = tbl.slice(0, take)
+                    # masked rows only: to_pylist values (None,
+                    # datetimes, decimals...) are exactly what the row
+                    # engine's reader feeds the compiled projection
+                    for rec in tbl.to_pylist():
+                        buf += out.serialize(project(rec))
+                        if len(buf) >= FLUSH:
+                            returned += len(buf)
+                            yield es.records_message(bytes(buf))
+                            buf.clear()
+                    n_out += take
+                if ev.is_aggregate:
+                    buf += out.serialize(ev.aggregate_result())
+                if buf:
+                    returned += len(buf)
+                    yield es.records_message(bytes(buf))
+                if req.request_progress:
+                    yield es.progress_message(object_size, object_size,
+                                              returned)
+                yield es.stats_message(object_size, object_size,
+                                       returned)
+                yield es.end_message()
+            finally:
+                spool.close()
+        except SQLError as e:
+            yield es.error_message("InvalidQuery", str(e))
+        except Exception as e:
+            # corrupt data pages raise OSError (verified: snappy
+            # corruption), not ArrowInvalid — anything mid-stream must
+            # become an in-band error, matching records.ParquetInput's
+            # broad catch, never a severed connection
+            yield es.error_message("InvalidQuery", f"Parquet: {e}")
+
+    return gen()
+
+
 def _try_json(req, query: Query, rw: Rewindable, object_size: int,
               out) -> Iterator[bytes] | None:
     """JSON LINES fast path: pyarrow's C++ NDJSON parser + the same
@@ -705,23 +896,7 @@ def _try_json(req, query: Query, rw: Rewindable, object_size: int,
     types = [f.type for f in first.schema]
     alias = query.table_alias
     ev = Evaluator(query)
-
-    def resolver(name: str) -> int:
-        """JSON keys resolve by name only — no positional _N fallback
-        (the row engine would treat an absent '_2' key as a missing
-        field, not column 2)."""
-        parts = name.split(".")
-        if alias and parts and parts[0].lower() == alias:
-            parts = parts[1:]
-        if len(parts) != 1:
-            raise _Fallback(f"nested column {name}")
-        p = parts[0]
-        if p in names:
-            return names.index(p)
-        lowered = [s.lower() for s in names]
-        if p.lower() in lowered:
-            return lowered.index(p.lower())
-        raise _Fallback(f"unknown column {name}")
+    resolver = _typed_resolver(names, alias)
 
     try:
         mask_fn = (_compile_where(query.where, names, alias, True, types,
@@ -730,18 +905,7 @@ def _try_json(req, query: Query, rw: Rewindable, object_size: int,
         agg_cols: list[int | None] = []
         proj_cols: list[int] = []
         if ev.is_aggregate:
-            for p in query.projections:
-                f = p.expr
-                if f.star:
-                    agg_cols.append(None)
-                    continue
-                idx = resolver(f.args[0].name)
-                t = types[idx]
-                if not (pa.types.is_integer(t) or pa.types.is_floating(t)
-                        or pa.types.is_string(t)
-                        or pa.types.is_large_string(t)):
-                    raise _Fallback(f"aggregate over {t} column")
-                agg_cols.append(idx)
+            agg_cols = _typed_agg_cols(query, ev, resolver, types)
         elif query.star:
             proj_cols = list(range(len(names)))
         else:
