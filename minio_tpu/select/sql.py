@@ -637,7 +637,13 @@ class Evaluator:
             return None
         try:
             if typ in ("int", "integer"):
-                return int(float(v))
+                # int(float('inf')) raises OverflowError, not
+                # ValueError — it must surface as a SQL error event,
+                # never sever the stream (round-5 fuzz finding)
+                try:
+                    return int(float(v))
+                except OverflowError:
+                    raise ValueError(f"non-finite value {v!r}")
             if typ in ("float", "decimal", "numeric", "double"):
                 return float(v)
             if typ in ("string", "varchar", "char"):

@@ -707,3 +707,18 @@ class TestDifferentialFuzz:
         slow = self._recs(_run(expr, data, inp, {"JSON": {}},
                                tier="row"))
         assert fast == slow, (seed, expr, data[:200])
+
+
+class TestCastOverflowInBand:
+    def test_cast_inf_to_int_errors_in_band(self):
+        """Fuzz finding: int(float('inf')) raises OverflowError, which
+        _cast didn't catch — the stream was severed instead of carrying
+        an error event.  Both tiers must agree and error in-band."""
+        data = b"a,b\nx,inf\ny,5\n"
+        expr = "SELECT COUNT(*) FROM s3object WHERE CAST(b AS INT) = 5"
+        fast = _run(expr, data)
+        slow = _run(expr, data, tier="row")
+        assert fast == slow
+        kinds = [e["headers"].get(":error-code")
+                 for e in es.decode_all(fast)]
+        assert "InvalidQuery" in kinds, kinds
