@@ -1,0 +1,27 @@
+"""AES-GCM primitive gate.
+
+The `cryptography` wheel is an optional dependency: environments without
+it (minimal driver containers) must still import the full server — SSE
+and KMS simply refuse at USE time with a clear error instead of taking
+the whole package down at import time.  Everything crypto-adjacent
+imports AESGCM/InvalidTag from here, never from `cryptography` directly.
+"""
+
+from __future__ import annotations
+
+try:
+    from cryptography.exceptions import InvalidTag  # noqa: F401
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM  # noqa: F401
+
+    HAVE_AESGCM = True
+except ImportError:  # pragma: no cover - exercised only without the wheel
+    HAVE_AESGCM = False
+
+    class InvalidTag(Exception):  # type: ignore[no-redef]
+        pass
+
+    class AESGCM:  # type: ignore[no-redef]
+        def __init__(self, key):
+            raise RuntimeError(
+                "AES-GCM unavailable: install the 'cryptography' package "
+                "to use SSE/KMS features")

@@ -10,8 +10,7 @@ import base64
 import hashlib
 import os
 
-from cryptography.exceptions import InvalidTag
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+from ._aead import AESGCM, InvalidTag
 
 
 class KMSError(Exception):

@@ -25,8 +25,7 @@ import os
 import struct
 from typing import Iterator
 
-from cryptography.exceptions import InvalidTag
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+from ._aead import AESGCM, InvalidTag
 
 CHUNK = 64 * 1024
 TAG = 16

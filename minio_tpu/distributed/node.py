@@ -12,6 +12,7 @@ host:port match.
 from __future__ import annotations
 
 import hashlib
+import os
 import re
 import urllib.parse
 import uuid
@@ -120,7 +121,8 @@ class ClusterNode:
             ",".join(f"{h}:{p}{path}" for h, p, path in all_eps).encode()
         ).digest()))
 
-        self.local_drives: dict[str, LocalStorage] = {}
+        # path -> LocalStorage (or its ChaosDisk interposer under chaos)
+        self.local_drives: dict = {}
         self.peer_clients: dict[str, RpcClient] = {}
         pool_disks: list[list] = []
         n_nodes = set()
@@ -134,6 +136,12 @@ class ClusterNode:
         # GetLocalPeer over the endpoints, cmd/endpoint.go, not the bind
         # address).
         self.cluster_addr = ""
+        # test-only fault plane: with MINIO_TPU_CHAOS=1 every local drive
+        # is interposed by a ChaosDisk (latency/flaky/loss injection) and
+        # the chaos RPC hook is mounted, so distributed chaos drills can
+        # fault REMOTE drives behind the storage RPC plane
+        chaos_enabled = os.environ.get("MINIO_TPU_CHAOS", "") == "1"
+        self.chaos_disks: dict = {}
         for spec in pool_specs:
             disks = []
             for host, port, path in spec:
@@ -146,15 +154,24 @@ class ClusterNode:
                 if is_local:
                     d = LocalStorage(path, endpoint=f"{host}:{port}{path}"
                                      if host else path)
+                    if chaos_enabled:
+                        from minio_tpu.storage.naughty import ChaosDisk
+
+                        d = ChaosDisk(d)
+                        self.chaos_disks[path] = d
                     self.local_drives[path] = d
                     # the object layer sees the instrumented view (per-op
-                    # counters + EWMA latency, reference xlStorageDiskIDCheck)
+                    # counters + EWMA latency + circuit breaker, reference
+                    # xlStorageDiskIDCheck)
                     disks.append(InstrumentedStorage(d))
                 else:
                     key = f"{host}:{port}"
                     client = self.peer_clients.get(key)
                     if client is None:
-                        client = RpcClient(host, port, secret_key)
+                        client = RpcClient(
+                            host, port, secret_key,
+                            timeout=float(os.environ.get(
+                                "MINIO_TPU_RPC_TIMEOUT", "30")))
                         self.peer_clients[key] = client
                     disks.append(
                         InstrumentedStorage(RemoteStorage(client, path)))
@@ -215,6 +232,10 @@ class ClusterNode:
         self.app = self.s3.app
         self.router = RpcRouter(secret_key)
         register_storage_rpc(self.router, self.local_drives)
+        if self.chaos_disks:
+            from minio_tpu.storage.naughty import register_chaos_rpc
+
+            register_chaos_rpc(self.router, self.chaos_disks)
         register_lock_rpc(self.router, self.locker,
                           registry=self.lock_registry)
         self.router.register("peer.info", self._peer_info)
@@ -267,6 +288,18 @@ class ClusterNode:
         if self.lock_maintenance is not None:
             self.lock_maintenance.close()
         self.s3.close()
+        self.router.close()
+        # stop the drives' health-probe threads (a breaker open at
+        # shutdown would otherwise keep probing a dead backend forever in
+        # processes that churn nodes, e.g. in-process test suites)
+        for pool in getattr(self.pools, "pools", []):
+            for es in getattr(pool, "sets", []):
+                for d in getattr(es, "disks", []):
+                    if d is not None:
+                        try:
+                            d.close()
+                        except Exception:
+                            pass
         for c in self.peer_clients.values():
             c.close()
 

@@ -13,6 +13,8 @@ from __future__ import annotations
 import hashlib
 import hmac
 import http.client
+import os
+import random
 import threading
 import time
 import urllib.parse
@@ -23,6 +25,21 @@ from minio_tpu.storage import errors
 
 RPC_PREFIX = "/minio_tpu/rpc/v1"
 HEALTH_INTERVAL = 5.0
+
+# per-attempt timeout for unary idempotent calls: a hung peer costs at
+# most this long before it degrades to an offline mark, not the 30 s
+# whole-transfer budget reserved for streaming bodies (reference
+# storage REST client per-call contexts)
+OP_TIMEOUT = float(os.environ.get("MINIO_TPU_RPC_OP_TIMEOUT", "10"))
+# short budget for liveness probes and probe-through calls
+PROBE_TIMEOUT = float(os.environ.get("MINIO_TPU_RPC_PROBE_TIMEOUT", "2"))
+# total attempts for idempotent calls (first try + retries)
+RETRY_ATTEMPTS = int(os.environ.get("MINIO_TPU_RPC_RETRIES", "3"))
+RETRY_BASE = 0.05   # seconds; exponential, full-jittered
+RETRY_CAP = 1.0
+# while marked offline, calls fail fast for this long before one probe
+# attempt is let through (negative health-cache TTL)
+OFFLINE_TTL = 0.25
 
 # exception class name <-> type, for transporting storage errors
 _ERR_TYPES = {
@@ -65,22 +82,37 @@ def unpack_error(doc: dict) -> Exception:
 class RpcClient:
     """Sync msgpack RPC client for one peer endpoint (host:port)."""
 
-    def __init__(self, host: str, port: int, secret: str, timeout: float = 30.0):
+    def __init__(self, host: str, port: int, secret: str, timeout: float = 30.0,
+                 op_timeout: float | None = None, retries: int | None = None):
         self.host = host
         self.port = port
         self.secret = secret
-        self.timeout = timeout
+        self.timeout = timeout  # streaming/session budget
+        # unary idempotent calls get the shorter per-attempt deadline
+        self.op_timeout = min(op_timeout if op_timeout is not None
+                              else OP_TIMEOUT, timeout)
+        self.retries = max(1, RETRY_ATTEMPTS if retries is None else retries)
         self._online = True
         self._last_check = 0.0
         self._lock = threading.Lock()
         self._pool: list = []  # idle keep-alive connections
 
-    def _get_conn(self):
+    def _get_conn(self, timeout: float | None = None) -> tuple:
+        """-> (conn, pooled); pooled connections get their socket timeout
+        refreshed to this call's budget."""
+        t = self.timeout if timeout is None else timeout
         with self._lock:
             if self._pool:
-                return self._pool.pop()
+                conn = self._pool.pop()
+                conn.timeout = t
+                if conn.sock is not None:
+                    try:
+                        conn.sock.settimeout(t)
+                    except OSError:
+                        pass
+                return conn, True
         return http.client.HTTPConnection(self.host, self.port,
-                                          timeout=self.timeout)
+                                          timeout=t), False
 
     def _put_conn(self, conn) -> None:
         with self._lock:
@@ -114,7 +146,9 @@ class RpcClient:
                 return self._online
             self._last_check = now
         try:
-            self.call("health.ping", {})
+            # _probe bypasses the offline fail-fast gate (it IS the probe)
+            # and caps the attempt at the short probe deadline
+            self.call("health.ping", {}, _probe=True)
             ok = True
         except RpcTransportError:
             ok = False  # no HTTP response at all: the peer is down
@@ -175,33 +209,122 @@ class RpcClient:
         return msgpack.unpackb(data, raw=False)
 
     def call(self, method: str, args: dict, body: bytes = b"",
-             want_stream: bool = False, idempotent: bool = True):
+             want_stream: bool = False, idempotent: bool = True,
+             deadline: float | None = None, slow: bool = False,
+             _probe: bool = False):
         """POST args (+ raw body tail); returns decoded result (or a
         response object for streaming reads).
 
-        Non-idempotent calls (appends, renames) get NO retry: a retry
-        after a mid-request failure could re-apply an operation the server
-        already performed.  For sequences of non-idempotent calls use
-        session() to keep one persistent connection."""
+        Idempotent calls retry transport failures with jittered
+        exponential backoff inside the optional `deadline` budget; each
+        attempt is bounded by op_timeout so a HUNG peer degrades to an
+        offline mark instead of stalling the caller for the full
+        streaming budget.  Non-idempotent calls (appends, renames) get
+        NO retry: a retry after a mid-request failure could re-apply an
+        operation the server already performed.  For sequences of
+        non-idempotent calls use session() to keep one persistent
+        connection.
+
+        While the peer is marked offline, calls fail fast with
+        RpcTransportError for OFFLINE_TTL; after that one short-deadline
+        attempt is let through as a reconnect probe (reference
+        internal/rest/client.go:219 offline marking + reconnect)."""
+        probing = _probe
+        if not _probe:
+            with self._lock:
+                if not self._online:
+                    if time.time() - self._last_check < OFFLINE_TTL:
+                        raise RpcTransportError(
+                            f"rpc {method}: {self.endpoint()} marked offline")
+                    # stale offline mark: this call doubles as the probe
+                    probing = True
+                    self._last_check = time.time()
         payload = msgpack.packb(args, use_bin_type=True)
-        # one retry on a stale pooled connection (idempotent calls only)
-        attempts = (0, 1) if idempotent else (1,)
-        for attempt in attempts:
-            if idempotent:
-                conn = self._get_conn()
-            else:
-                conn = http.client.HTTPConnection(self.host, self.port,
-                                                  timeout=self.timeout)
+        if not idempotent:
+            # no retry; bounded unary deadline unless the op does
+            # O(data) work server-side before its one response (slow=True,
+            # e.g. rename_data fdatasyncing streamed shards) — timing out
+            # a NON-RETRYABLE commit leaves client/server state divergent
+            conn = http.client.HTTPConnection(
+                self.host, self.port,
+                timeout=self.timeout if slow else self.op_timeout)
+            try:
+                conn.connect()
+            except OSError as e:
+                conn.close()
+                self.mark_offline()  # could not even connect: peer is down
+                raise RpcTransportError(f"rpc {method}: {e}")
+            try:
+                resp = self._send_request(conn, method, payload, body)
+            except (OSError, http.client.HTTPException) as e:
+                # the peer ACCEPTED the connection — this is a per-call
+                # (likely per-drive) fault, not peer death: do NOT poison
+                # the peer's other drives by marking the client offline
+                conn.close()
+                raise RpcTransportError(f"rpc {method}: {e}")
+            return self._decode_response(conn, resp, method, want_stream,
+                                         pool=True)
+        # idempotent: bounded jittered-backoff retry within the deadline.
+        # slow=True grants the full streaming budget per attempt: ops like
+        # verify_file hash entire shard files server-side before their one
+        # response — the unary deadline would misread a big healthy drive
+        # as hung and feed the circuit breaker.  A probe-through call
+        # (stale offline mark) loses its retries but NOT its budget:
+        # shrinking a slow/streaming call to the probe deadline would
+        # guarantee spurious failure against a recovered peer
+        attempts = 1 if probing else self.retries
+        per_attempt = (self.timeout if slow
+                       else PROBE_TIMEOUT if probing and not want_stream
+                       else self.op_timeout)
+        t_end = None if deadline is None else time.monotonic() + deadline
+
+        def backoff(attempt: int) -> None:
+            delay = min(RETRY_CAP, RETRY_BASE * (2 ** attempt))
+            delay *= 0.5 + random.random()  # full jitter
+            if t_end is not None:
+                delay = min(delay, max(0.0, t_end - time.monotonic()))
+            time.sleep(delay)
+
+        last: Exception | None = None
+        connect_failed = False
+        for attempt in range(attempts):
+            tmo = per_attempt
+            if t_end is not None:
+                remaining = t_end - time.monotonic()
+                if remaining <= 0:
+                    break
+                tmo = min(tmo, remaining)
+            conn, pooled = self._get_conn(tmo)
+            try:
+                if conn.sock is None:
+                    conn.connect()
+            except OSError as e:
+                conn.close()
+                last, connect_failed = e, True
+                if attempt + 1 < attempts:
+                    backoff(attempt)
+                continue
+            connect_failed = False
             try:
                 resp = self._send_request(conn, method, payload, body)
             except (OSError, http.client.HTTPException) as e:
                 conn.close()
-                if attempt == 0:
-                    continue  # stale keep-alive connection; retry fresh
-                self.mark_offline()
-                raise RpcTransportError(f"rpc {method}: {e}")
+                last = e
+                if isinstance(e, TimeoutError):
+                    break  # hung call: a retry would hang another attempt
+                if attempt + 1 < attempts:
+                    if pooled and attempt == 0:
+                        continue  # stale keep-alive: retry immediately
+                    backoff(attempt)
+                continue
             return self._decode_response(conn, resp, method, want_stream,
                                          pool=True)
+        if connect_failed:
+            # peer unreachable at the TCP level: mark offline so callers
+            # fail fast until the reconnect probe succeeds
+            self.mark_offline()
+        raise RpcTransportError(
+            f"rpc {method}: {last or 'deadline exceeded'}")
 
     def session(self) -> "RpcSession":
         return RpcSession(self)
@@ -224,10 +347,18 @@ class RpcSession:
             )
         payload = msgpack.packb(args, use_bin_type=True)
         try:
+            if self._conn.sock is None:
+                self._conn.connect()
+        except OSError as e:
+            self.close()
+            c.mark_offline()  # unreachable at the TCP level: peer down
+            raise RpcTransportError(f"rpc {method}: {e}")
+        try:
             resp = c._send_request(self._conn, method, payload, body)
         except (OSError, http.client.HTTPException) as e:
+            # connected peer, failed call: a drive-level fault — the
+            # per-drive circuit breaker owns it, the PEER stays online
             self.close()
-            c.mark_offline()
             raise RpcTransportError(f"rpc {method}: {e}")
         return c._decode_response(self._conn, resp, method,
                                   want_stream=False, pool=False)
@@ -263,15 +394,44 @@ class _StreamResponse:
 
 
 class RpcRouter:
-    """Server side: method registry mounted into the aiohttp app."""
+    """Server side: method registry mounted into the aiohttp app.
+
+    Storage calls run on a DEDICATED thread pool, not the event loop's
+    default executor: the default pool is sized min(32, cpus+4), so on a
+    small host a single hung drive (every call sleeping until its client
+    times out) would occupy every worker and starve the node's HEALTHY
+    drives — collapsing write quorums cluster-wide.  The reference bounds
+    this per drive (diskMaxConcurrent); a wide shared pool keeps sibling
+    drives serving while the per-drive breaker isolates the hung one.
+    """
 
     def __init__(self, secret: str):
         self.secret = secret
         self.methods: dict = {"health.ping": lambda args, body: {}}
+        self._executor = None
+        self._exec_lock = threading.Lock()
 
     def register(self, name: str, fn) -> None:
         """fn(args: dict, body: bytes) -> result dict | (headers, byte-iter)"""
         self.methods[name] = fn
+
+    def _pool(self):
+        with self._exec_lock:
+            if self._executor is None:
+                import concurrent.futures as cf
+                import os as _os
+
+                self._executor = cf.ThreadPoolExecutor(
+                    max_workers=int(_os.environ.get(
+                        "MINIO_TPU_RPC_WORKERS", "32")),
+                    thread_name_prefix="rpc-worker")
+            return self._executor
+
+    def close(self) -> None:
+        with self._exec_lock:
+            if self._executor is not None:
+                self._executor.shutdown(wait=False, cancel_futures=True)
+                self._executor = None
 
     def mount(self, app) -> None:
         from aiohttp import web
@@ -290,8 +450,9 @@ class RpcRouter:
             body = raw[args_len:]
             import asyncio
             loop = asyncio.get_running_loop()
+            pool = self._pool()
             try:
-                result = await loop.run_in_executor(None, fn, args, body)
+                result = await loop.run_in_executor(pool, fn, args, body)
             except Exception as e:
                 return web.Response(
                     status=500, body=msgpack.packb(pack_error(e))
@@ -300,12 +461,26 @@ class RpcRouter:
                 resp = web.StreamResponse(status=200)
                 await resp.prepare(request)
                 it = iter(result.chunks)
-                while True:
-                    chunk = await loop.run_in_executor(None, next, it, None)
-                    if chunk is None:
-                        break
-                    await resp.write(chunk)
-                await resp.write_eof()
+                try:
+                    while True:
+                        chunk = await loop.run_in_executor(pool, next, it,
+                                                           None)
+                        if chunk is None:
+                            break
+                        await resp.write(chunk)
+                    await resp.write_eof()
+                except (ConnectionError, ConnectionResetError,
+                        asyncio.CancelledError):
+                    # client abandoned the stream (seek re-issue, range
+                    # shortfall, disconnect): close the source, no noise
+                    pass
+                finally:
+                    closer = getattr(result.chunks, "close", None)
+                    if closer is not None:
+                        try:
+                            closer()
+                        except Exception:
+                            pass
                 return resp
             return web.Response(
                 status=200,

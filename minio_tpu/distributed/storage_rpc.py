@@ -229,6 +229,64 @@ def register_storage_rpc(router: RpcRouter, drives: dict[str, LocalStorage]) -> 
                                 _fi_from_wire(args["fi"]))
 
 
+class _SeekableRemoteStream(io.RawIOBase):
+    """Random-access façade over streamed remote shard reads.
+
+    BitrotReader (and any ranged consumer) seeks to frame-aligned FILE
+    offsets; an HTTP response body can only move forward.  Forward seeks
+    drain the in-flight response (cheap for the interleaved-hash frame
+    skips); backward seeks re-issue the ranged read_file_stream RPC at
+    the absolute offset — the storage RPC server accepts (offset, length)
+    per call, exactly like the reference's ReadFileStream
+    (cmd/storage-rest-client.go).  Without this, every remote shard read
+    silently failed the reader and degraded reads/heals to local-only
+    reconstruction — invisible on small clusters, fatal once k exceeds
+    the local drive count.
+    """
+
+    _DRAIN_MAX = 4 << 20  # forward-drain budget before re-issuing
+
+    def __init__(self, fetch, offset: int):
+        self._fetch = fetch        # (absolute offset) -> stream response
+        self._resp = fetch(offset)  # eager: surface open errors at create
+        self._pos = offset
+
+    def read(self, n: int = -1) -> bytes:
+        if self._resp is None:
+            self._resp = self._fetch(self._pos)
+        data = self._resp.read(n)
+        if data:
+            self._pos += len(data)
+        return data
+
+    def seek(self, offset: int, whence: int = 0) -> int:
+        if whence != 0:
+            raise OSError("only absolute seeks supported")
+        if offset == self._pos:
+            return offset
+        if (self._resp is not None and offset > self._pos
+                and offset - self._pos <= self._DRAIN_MAX):
+            delta = offset - self._pos
+            while delta:
+                chunk = self._resp.read(min(delta, 1 << 16))
+                if not chunk:
+                    break
+                delta -= len(chunk)
+            self._pos = offset - delta
+            if delta == 0:
+                return offset
+        if self._resp is not None:
+            self._resp.close()
+            self._resp = None  # re-issued lazily at the new offset
+        self._pos = offset
+        return offset
+
+    def close(self) -> None:
+        if self._resp is not None:
+            self._resp.close()
+            self._resp = None
+
+
 class _RemoteWriter(io.RawIOBase):
     """Buffers writes, ships whole file on close (small control files) or
     appends in chunks (shard streams)."""
@@ -280,12 +338,13 @@ class RemoteStorage(StorageAPI):
         self._disk_id = ""
 
     def _call(self, method: str, args: dict | None = None, body: bytes = b"",
-              want_stream: bool = False, idempotent: bool = True):
+              want_stream: bool = False, idempotent: bool = True,
+              slow: bool = False):
         a = {"drive": self.drive}
         if args:
             a.update(args)
         return self.client.call(f"storage.{method}", a, body, want_stream,
-                                idempotent=idempotent)
+                                idempotent=idempotent, slow=slow)
 
     # identity / health
     def disk_id(self) -> str:
@@ -358,12 +417,20 @@ class RemoteStorage(StorageAPI):
 
     def read_file_stream(self, volume: str, path: str, offset: int,
                          length: int) -> BinaryIO:
-        return self._call(
-            "read_file_stream",
-            {"volume": volume, "path": path, "offset": offset,
-             "length": length},
-            want_stream=True,
-        )
+        # length bounds the WINDOW [offset, offset+length); a re-issued
+        # ranged fetch after a seek keeps the same window end
+        end = None if length < 0 else offset + length
+
+        def fetch(abs_off: int):
+            ln = -1 if end is None else max(0, end - abs_off)
+            return self._call(
+                "read_file_stream",
+                {"volume": volume, "path": path, "offset": abs_off,
+                 "length": ln},
+                want_stream=True,
+            )
+
+        return _SeekableRemoteStream(fetch, offset)
 
     def read_file(self, volume: str, path: str, offset: int,
                   buf_size: int) -> bytes:
@@ -398,11 +465,15 @@ class RemoteStorage(StorageAPI):
 
     def rename_data(self, src_volume: str, src_path: str, fi: FileInfo,
                     dst_volume: str, dst_path: str) -> None:
+        # non-retryable commit that fdatasyncs the streamed shards
+        # server-side (O(shard bytes)): gets the streaming budget, not the
+        # unary deadline — timing out a commit the server then completes
+        # would leave client/server state divergent
         self._call("rename_data", {
             "src_volume": src_volume, "src_path": src_path,
             "fi": _fi_to_wire(fi), "dst_volume": dst_volume,
             "dst_path": dst_path,
-        }, idempotent=False)
+        }, idempotent=False, slow=True)
 
     # listing / verification
     def list_dir(self, volume: str, path: str, count: int = -1) -> list[str]:
@@ -460,8 +531,10 @@ class RemoteStorage(StorageAPI):
                                      "meta": meta_updates}).encode())
 
     def verify_file(self, volume: str, path: str, fi: FileInfo) -> None:
+        # hashes every part server-side before its one response: needs the
+        # streaming budget, not the unary deadline
         self._call("verify_file", {"volume": volume, "path": path,
-                                   "fi": _fi_to_wire(fi)})
+                                   "fi": _fi_to_wire(fi)}, slow=True)
 
     def check_parts(self, volume: str, path: str, fi: FileInfo) -> None:
         self._call("check_parts", {"volume": volume, "path": path,

@@ -72,10 +72,12 @@ backend_stats = {
 
 
 def _backend_name(dev) -> str:
+    # codecs declare their stats bucket explicitly via a `backend` class
+    # attribute (_PaddedCodec delegates) — no fragile class-name matching
+    # (ADVICE r5)
     if dev is None:
         return "host"
-    inner = getattr(dev, "inner", dev)
-    return "mesh" if type(inner).__name__ == "MeshRSCodec" else "device"
+    return getattr(dev, "backend", "device")
 
 
 _stats_lock = threading.Lock()
@@ -209,6 +211,10 @@ class _PaddedCodec:
     def __init__(self, inner, s_full: int):
         self.inner = inner
         self.s_full = s_full
+
+    @property
+    def backend(self) -> str:
+        return getattr(self.inner, "backend", "device")
 
     def _pad(self, batch: np.ndarray) -> np.ndarray:
         b, k, s = batch.shape

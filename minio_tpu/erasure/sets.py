@@ -407,10 +407,21 @@ class ErasureSets:
                 if hasattr(d, "op_stats"):
                     # instrumented wrapper: per-op counters + EWMA latency
                     entry["opStats"] = d.op_stats()
+                if hasattr(d, "health_stats"):
+                    # circuit-breaker state + trip/reconnect counters
+                    entry["health"] = d.health_stats()
                 disks.append(entry)
             except Exception as ex:
-                disks.append({"endpoint": getattr(d, "root", "?"),
-                              "online": False, "error": str(ex)})
+                # offline/broken drive: keep its identity and breaker
+                # state visible so operators can see WHICH drive is out
+                try:
+                    ep = d.endpoint() or getattr(d, "root", "?")
+                except Exception:
+                    ep = getattr(d, "root", "?")
+                entry = {"endpoint": ep, "online": False, "error": str(ex)}
+                if hasattr(d, "health_stats"):
+                    entry["health"] = d.health_stats()
+                disks.append(entry)
         return {
             "sets": self.set_count, "drives_per_set": self.set_drive_count,
             "disks": disks, "deployment_id": self.deployment_id,

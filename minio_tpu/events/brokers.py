@@ -752,7 +752,8 @@ class ElasticsearchTarget:
 
     def __init__(self, target_name: str, host: str, port: int, index: str,
                  fmt: str = _FMT_ACCESS, username: str = "",
-                 password: str = "", timeout: float = 5.0):
+                 password: str = "", timeout: float = 5.0,
+                 secure: bool = False):
         if fmt not in (_FMT_NAMESPACE, _FMT_ACCESS):
             raise ValueError(f"elasticsearch format {fmt!r}")
         if not index or index != index.lower() or "/" in index:
@@ -765,6 +766,10 @@ class ElasticsearchTarget:
         self.username = username
         self.password = password
         self.timeout = timeout
+        # https:// endpoints MUST get TLS: Basic-auth credentials over
+        # plaintext against a TLS-only cluster fail opaquely AND leak
+        # (same TLS-by-default stance as the LDAP client)
+        self.secure = secure
         self._conn = None
         self._ready = False
         self._lock = threading.Lock()
@@ -784,8 +789,12 @@ class ElasticsearchTarget:
         import http.client
 
         if self._conn is None:
-            self._conn = http.client.HTTPConnection(
-                self.host, self.port, timeout=self.timeout)
+            if self.secure:
+                self._conn = http.client.HTTPSConnection(
+                    self.host, self.port, timeout=self.timeout)
+            else:
+                self._conn = http.client.HTTPConnection(
+                    self.host, self.port, timeout=self.timeout)
         self._conn.request(method, path, body=body,
                            headers=self._headers())
         resp = self._conn.getresponse()

@@ -256,11 +256,19 @@ def _load_one(kind: str, name: str, get, targets: list) -> None:
             import urllib.parse as up
 
             u = up.urlparse(url if "://" in url else f"http://{url}")
+            # default port follows the scheme now that it is honored:
+            # TLS endpoints without an explicit port (Elastic Cloud) are
+            # on 443, not 9200
+            default_port = 443 if u.scheme == "https" else 9200
             targets.append(brokers.ElasticsearchTarget(
-                name, u.hostname or "localhost", u.port or 9200, index,
+                name, u.hostname or "localhost", u.port or default_port,
+                index,
                 fmt=get("FORMAT", "access") or "access",
                 username=up.unquote(u.username or ""),
-                password=up.unquote(u.password or "")))
+                password=up.unquote(u.password or ""),
+                # honor the URL scheme: https means TLS, not silent
+                # plaintext with Basic-auth in the clear
+                secure=u.scheme == "https"))
     elif kind == "MYSQL":
         # MINIO_NOTIFY_MYSQL_DSN_STRING_<id>=
         #   user:pass@tcp(host:3306)/db  (go-sql-driver DSN)

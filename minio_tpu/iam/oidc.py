@@ -21,9 +21,20 @@ import threading
 import time
 import urllib.request
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric import padding, rsa
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
+    _HASHES = {"RS256": hashes.SHA256, "RS384": hashes.SHA384,
+               "RS512": hashes.SHA512}
+except ImportError:  # optional dep gate (see crypto/_aead.py): OIDC JWT
+    # verification refuses at use time, the package still imports
+    class InvalidSignature(Exception):  # keeps `except InvalidSignature` valid
+        pass
+
+    padding = rsa = None
+    _HASHES = {}
 
 
 class OIDCError(Exception):
@@ -34,10 +45,6 @@ def _b64url(data: str | bytes) -> bytes:
     if isinstance(data, str):
         data = data.encode()
     return base64.urlsafe_b64decode(data + b"=" * (-len(data) % 4))
-
-
-_HASHES = {"RS256": hashes.SHA256, "RS384": hashes.SHA384,
-           "RS512": hashes.SHA512}
 
 
 class OpenIDProvider:
@@ -130,6 +137,10 @@ class OpenIDProvider:
         except (ValueError, TypeError):
             raise OIDCError("malformed JWT")
         alg = header.get("alg", "")
+        if not _HASHES:
+            raise OIDCError(
+                "OIDC JWT verification unavailable: install the "
+                "'cryptography' package")
         hash_cls = _HASHES.get(alg)
         if hash_cls is None:
             raise OIDCError(f"unsupported JWT alg {alg!r}")

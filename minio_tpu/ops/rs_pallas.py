@@ -201,6 +201,8 @@ class PallasRSCodec:
     use TpuRSCodec, or pad.
     """
 
+    backend = "device"  # explicit dispatch-stats bucket (ADVICE r5)
+
     def __init__(self, k: int, m: int, *, interpret: bool | None = None):
         if k <= 0 or m <= 0 or k + m > 256:
             raise ValueError(f"invalid RS config {k}+{m}")

@@ -27,6 +27,7 @@ the MXU wants: a (R8, K8) x (K8, B*S) matmul with B*S in the millions.
 from __future__ import annotations
 
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -45,14 +46,52 @@ def encode_bits_matrix(k: int, m: int) -> np.ndarray:
     return gf256.gf_matrix_to_bits(gf256.parity_matrix(k, m)).astype(np.int8)
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=256)
 def reconstruct_bits_matrix(
     k: int, m: int, available: tuple[int, ...], wanted: tuple[int, ...]
 ) -> np.ndarray:
     """(len(wanted)*8, k*8) bit matrix rebuilding `wanted` shards from the
-    first k shards of `available` (sorted ascending)."""
+    first k shards of `available` (sorted ascending).
+
+    Bounded: the (available, wanted) signature space is combinatorial, so
+    churny degraded reads with varying survivor sets would otherwise grow
+    this without limit."""
     rm = gf256.reconstruct_matrix(k, m, available, wanted)
     return gf256.gf_matrix_to_bits(rm).astype(np.int8)
+
+
+class RecMatrixCache:
+    """LRU over per-signature device reconstruct matrices.
+
+    Availability signatures are combinatorial — one cached device array
+    per survivor set seen.  An LRU keeps steady-state hits (a drive stays
+    down -> one signature) while bounding churn (every read a different
+    survivor set) to `cap` entries."""
+
+    def __init__(self, cap: int = 128):
+        import collections
+
+        self.cap = cap
+        self._od = collections.OrderedDict()
+        self._mu = threading.Lock()
+
+    def get(self, sig):
+        with self._mu:
+            mat = self._od.get(sig)
+            if mat is not None:
+                self._od.move_to_end(sig)
+            return mat
+
+    def put(self, sig, mat) -> None:
+        with self._mu:
+            self._od[sig] = mat
+            self._od.move_to_end(sig)
+            while len(self._od) > self.cap:
+                self._od.popitem(last=False)
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._od)
 
 
 # ---------------------------------------------------------------------------
@@ -107,13 +146,15 @@ class TpuRSCodec:
     shape (B, K, S) -> parity (B, M, S).
     """
 
+    backend = "device"  # explicit dispatch-stats bucket (ADVICE r5)
+
     def __init__(self, k: int, m: int):
         if k <= 0 or m <= 0 or k + m > 256:
             raise ValueError(f"invalid RS config {k}+{m}")
         self.k = k
         self.m = m
         self._enc = jnp.asarray(encode_bits_matrix(k, m))
-        self._rec_cache: dict[tuple, jax.Array] = {}
+        self._rec_cache = RecMatrixCache()
 
     # -- encode -------------------------------------------------------------
     def encode(self, data_shards) -> jax.Array:
@@ -146,7 +187,7 @@ class TpuRSCodec:
         mat = self._rec_cache.get(sig)
         if mat is None:
             mat = jnp.asarray(reconstruct_bits_matrix(self.k, self.m, *sig))
-            self._rec_cache[sig] = mat
+            self._rec_cache.put(sig, mat)
         return gf_bitmatmul(mat, jnp.asarray(src_shards, dtype=jnp.uint8))
 
     def decode_data(self, src_shards, available: tuple[int, ...]) -> jax.Array:

@@ -133,6 +133,34 @@ class MetricsMixin:
                   sum(1 for d in drives if d.get("online")))
             gauge("minio_cluster_drive_offline_total", "Offline drives",
                   sum(1 for d in drives if not d.get("online")))
+            # drive-health circuit breaker (reference drive offline
+            # tracking, cmd/xl-storage-disk-id-check.go): open breakers,
+            # lifetime trip/reconnect counters, fast-fail rejections
+            gauge("minio_cluster_drive_breaker_open_total",
+                  "Drives with an open health circuit breaker",
+                  sum(1 for d in drives
+                      if (d.get("health") or {}).get("breakerOpen")))
+            hl = []
+            for name, help_, key in (
+                    ("minio_drive_breaker_trips_total",
+                     "Circuit-breaker trips per drive", "trips"),
+                    ("minio_drive_reconnects_total",
+                     "Probe-driven drive reconnects", "reconnects"),
+                    ("minio_drive_breaker_fast_fails_total",
+                     "Calls rejected while the breaker was open",
+                     "fastFails")):
+                rows = [f"# HELP {name} {help_}", f"# TYPE {name} gauge"]
+                any_ = False
+                for d in drives:
+                    h = d.get("health")
+                    if h and h.get(key):
+                        lbl = _fmt_labels(("drive",), (d["endpoint"],))
+                        rows.append(f"{name}{lbl} {h[key]}")
+                        any_ = True
+                if any_:
+                    hl.append("\n".join(rows) + "\n")
+            for block in hl:
+                g(block)
             # per-drive EWMA latency from the instrumented wrapper
             lat = ["# HELP minio_drive_latency_ms Per-op EWMA drive latency",
                    "# TYPE minio_drive_latency_ms gauge"]
@@ -234,6 +262,12 @@ class MetricsMixin:
             gauge("minio_heal_objects_failed_total",
                   "Objects the MRF queue failed to heal", ms.failed)
             gauge("minio_heal_mrf_pending", "MRF queue depth", ms.pending)
+            gauge("minio_heal_drive_resyncs_total",
+                  "Drive reconnects that enqueued an MRF re-sync",
+                  getattr(svcs, "drive_resyncs", 0))
+            gauge("minio_heal_resync_objects_total",
+                  "Objects enqueued for heal by drive re-syncs",
+                  getattr(svcs, "resync_objects", 0))
             if svcs.replication is not None:
                 rs = svcs.replication.stats
                 gauge("minio_replication_completed_total",

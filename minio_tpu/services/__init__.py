@@ -19,9 +19,14 @@ class ServiceManager:
 
     def __init__(self, object_layer, scan_interval: float = 60.0,
                  heal_interval: float = 3600.0, lifecycle_fn=None,
-                 monitor_interval: float = 10.0):
+                 monitor_interval: float | None = None):
+        import os
+
         from minio_tpu.utils.bloom import DataUpdateTracker
 
+        if monitor_interval is None:
+            monitor_interval = float(
+                os.environ.get("MINIO_TPU_MONITOR_INTERVAL", "10"))
         self.ol = object_layer
         self.mrf = MRFQueue(object_layer)
         self.heals = HealManager(object_layer)
@@ -35,17 +40,72 @@ class ServiceManager:
                                     interval=monitor_interval)
         self.replication = None  # ReplicationPool, wired by attach_services
         self.tier = None         # TierManager, wired by attach_services
+        self.drive_resyncs = 0      # breaker recoveries that kicked a re-sync
+        self.resync_objects = 0     # objects enqueued by those re-syncs
+        # flap damping: a drive bouncing on a bad NIC must not trigger a
+        # full-set enqueue per bounce (MRF already dedups pending tasks;
+        # this bounds the LISTING work too)
+        self._resync_min_interval = float(
+            os.environ.get("MINIO_TPU_RESYNC_MIN_INTERVAL", "60"))
+        self._last_resync: dict = {}  # drive endpoint -> monotonic ts
         self._attach_heal_queue()
 
     def _attach_heal_queue(self) -> None:
-        """Point every erasure set's async-heal hook at the MRF queue and
-        its change hook at the update tracker."""
+        """Point every erasure set's async-heal hook at the MRF queue, its
+        change hook at the update tracker, and every health-tracked
+        drive's reconnect hook at the MRF re-sync."""
         from minio_tpu.erasure.objects import add_ns_update_hook
 
         for pool in getattr(self.ol, "pools", [self.ol]):
             for es in getattr(pool, "sets", []):
                 es.heal_queue = self.mrf.enqueue
+                for d in getattr(es, "disks", []):
+                    if d is not None and hasattr(d, "health_stats"):
+                        # bind the OWNING set: only its objects can have
+                        # shards on this drive, so the re-sync is scoped
+                        # to it, not the whole namespace
+                        d.on_online = (
+                            lambda drv, _es=es: self._drive_reconnected(
+                                drv, _es))
         add_ns_update_hook(self.ol, self.tracker.mark)
+
+    def _drive_reconnected(self, drive, es) -> None:
+        """Breaker-recovery hook: writes that met quorum while this drive
+        was offline are missing their shard here — enqueue the owning
+        erasure set's objects for MRF heal so the drive converges
+        (reference: the MRF queue absorbs partial writes, cmd/mrf.go;
+        reconnect kicks re-sync)."""
+        import time as _time
+
+        from minio_tpu.services.heal import _set_buckets
+        from minio_tpu.utils.logger import log
+
+        try:
+            ep = drive.endpoint()
+        except Exception:
+            ep = str(id(drive))
+        now = _time.monotonic()
+        if now - self._last_resync.get(ep, -1e9) < self._resync_min_interval:
+            return  # flap storm: the previous sweep's heals still cover it
+        self._last_resync[ep] = now
+        try:
+            log.info("drive back online, MRF re-sync", endpoint=ep)
+        except Exception:
+            pass
+        n = 0
+        try:
+            for bucket in _set_buckets(es):
+                try:
+                    objs = es.list_objects(bucket)
+                except Exception:
+                    continue
+                for o in objs:
+                    self.mrf.enqueue(bucket, o)
+                    n += 1
+        except Exception:
+            pass
+        self.drive_resyncs += 1
+        self.resync_objects += n
 
     def close(self) -> None:
         self.scanner.close()

@@ -1,16 +1,29 @@
-"""Instrumented per-drive decorator: per-op counters + EWMA latencies.
+"""Instrumented per-drive decorator: counters, EWMA latencies, and a
+drive-health circuit breaker.
 
 Equivalent of the reference's xlStorageDiskIDCheck
 (cmd/xl-storage-disk-id-check.go:68): wraps any StorageAPI and records,
 per storage operation, the call count, error count, cumulative wall time
 and an exponentially-weighted moving average latency.  The numbers feed
 the admin StorageInfo plane and the Prometheus drive metrics.
+
+On top of the timers sits the health tracker (the reference's
+diskHealthTracker + storage REST client offline marking,
+cmd/xl-storage-disk-id-check.go:170, internal/rest/client.go:219):
+consecutive drive-level faults trip a circuit breaker that marks the
+drive OFFLINE, every further call fails fast with DiskNotFound (no
+quorum-path stall behind a hung drive), and a background reconnect
+probe flips the drive back online — firing the `on_online` hook so the
+owner can enqueue an MRF re-sync of writes the drive missed.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
+
+from minio_tpu.storage import errors
 
 # every data-plane method of StorageAPI gets a timer (control accessors
 # like disk_id/is_online are left untimed on purpose — they are hot and
@@ -27,6 +40,30 @@ TIMED_OPS = (
 )
 
 EWMA_ALPHA = 0.2  # same smoothing idea as the reference's EWMA latency
+
+# consecutive drive-level faults before the breaker opens (reference:
+# diskMaxConcurrent/diskActiveMonitoring heuristics collapse to a small
+# consecutive-failure threshold here)
+BREAKER_THRESHOLD = int(os.environ.get("MINIO_TPU_BREAKER_THRESHOLD", "3"))
+# reconnect probe cadence: starts fast, backs off exponentially
+PROBE_INTERVAL = float(os.environ.get("MINIO_TPU_PROBE_INTERVAL", "0.5"))
+PROBE_MAX_INTERVAL = float(
+    os.environ.get("MINIO_TPU_PROBE_MAX_INTERVAL", "5.0"))
+
+# drive-level faults: the transport/medium failed, as opposed to benign
+# negative results (FileNotFound & friends prove the drive responded and
+# therefore RESET the consecutive-fault counter)
+_FAULT_TYPES = (errors.DiskNotFound, errors.FaultyDisk,
+                errors.UnformattedDisk)
+
+
+def is_drive_fault(e: BaseException) -> bool:
+    if isinstance(e, _FAULT_TYPES):
+        return True
+    if isinstance(e, errors.StorageError):
+        return False
+    # raw OSError/TimeoutError escaping a backend is a medium fault
+    return isinstance(e, (OSError, TimeoutError))
 
 
 class OpStats:
@@ -59,11 +96,24 @@ class OpStats:
 
 
 class InstrumentedStorage:
-    """Transparent timing wrapper around a StorageAPI instance."""
+    """Timing + health wrapper around a StorageAPI instance."""
 
-    def __init__(self, inner):
+    def __init__(self, inner, breaker_threshold: int | None = None):
         self._inner = inner
         self._ops: dict[str, OpStats] = {op: OpStats() for op in TIMED_OPS}
+        self._threshold = (BREAKER_THRESHOLD if breaker_threshold is None
+                           else breaker_threshold)
+        self._health_mu = threading.Lock()
+        self._consec_faults = 0
+        self._breaker_open = False
+        self._offline_since = 0.0
+        self._probe_thread: threading.Thread | None = None
+        self._closed = False
+        self.trips = 0        # breaker open events
+        self.reconnects = 0   # probe-driven recoveries
+        self.fast_fails = 0   # calls rejected while the breaker was open
+        self.on_offline = None  # callable(self), fired when the breaker trips
+        self.on_online = None   # callable(self), fired when the probe recovers
         for op in TIMED_OPS:
             target = getattr(inner, op, None)
             if target is not None:
@@ -73,17 +123,127 @@ class InstrumentedStorage:
         stats = self._ops[op]
 
         def timed(*a, **kw):
+            if self._breaker_open:
+                # fail fast: a tripped drive must cost microseconds, not a
+                # full RPC timeout, or one hung drive stalls every quorum
+                # write (reference: errDiskNotFound short-circuit)
+                with self._health_mu:
+                    self.fast_fails += 1
+                raise errors.DiskNotFound(
+                    f"{self._endpoint_label()}: drive offline "
+                    f"(circuit breaker open)")
             t0 = time.monotonic()
             try:
                 out = fn(*a, **kw)
-            except Exception:
+            except Exception as e:
                 stats.record(time.monotonic() - t0, failed=True)
+                self._note(fault=is_drive_fault(e))
                 raise
             stats.record(time.monotonic() - t0, failed=False)
+            self._note(fault=False)
             return out
 
         timed.__name__ = op
         return timed
+
+    def _endpoint_label(self) -> str:
+        try:
+            return self._inner.endpoint() or repr(self._inner)
+        except Exception:
+            return repr(self._inner)
+
+    # -- breaker ------------------------------------------------------------
+    def _note(self, fault: bool) -> None:
+        tripped = False
+        with self._health_mu:
+            if fault:
+                self._consec_faults += 1
+                if (not self._breaker_open
+                        and self._consec_faults >= self._threshold):
+                    self._breaker_open = True
+                    self._offline_since = time.time()
+                    self.trips += 1
+                    tripped = True
+            else:
+                self._consec_faults = 0
+        if tripped:
+            self._start_probe()
+            cb = self.on_offline
+            if cb is not None:
+                try:
+                    cb(self)
+                except Exception:
+                    pass
+
+    def _start_probe(self) -> None:
+        with self._health_mu:
+            if self._probe_thread is not None and self._probe_thread.is_alive():
+                return
+            t = threading.Thread(target=self._probe_loop, daemon=True,
+                                 name=f"drive-probe-{id(self):x}")
+            self._probe_thread = t
+        t.start()
+
+    def _probe_loop(self) -> None:
+        interval = PROBE_INTERVAL
+        while not self._closed:
+            time.sleep(interval)
+            if self._closed or self._probe_once():
+                return
+            interval = min(interval * 2, PROBE_MAX_INTERVAL)
+
+    def _probe_once(self) -> bool:
+        """One reconnect attempt against the INNER drive (bypassing the
+        breaker).  disk_info is the canonical cheap data-plane op; for
+        remote drives the RPC client's own short-deadline ping runs
+        first so a down peer costs ~nothing."""
+        try:
+            if not self._inner.is_online():
+                return False
+            self._inner.disk_info()
+        except Exception:
+            return False
+        with self._health_mu:
+            if not self._breaker_open:
+                return True  # already recovered elsewhere
+            self._breaker_open = False
+            self._consec_faults = 0
+            self.reconnects += 1
+        cb = self.on_online
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception:
+                pass
+        return True
+
+    # -- health surface -----------------------------------------------------
+    def is_online(self) -> bool:
+        if self._breaker_open:
+            return False
+        try:
+            return self._inner.is_online()
+        except Exception:
+            return False
+
+    def breaker_open(self) -> bool:
+        return self._breaker_open
+
+    def health_stats(self) -> dict:
+        with self._health_mu:
+            return {
+                "breakerOpen": self._breaker_open,
+                "consecFaults": self._consec_faults,
+                "trips": self.trips,
+                "reconnects": self.reconnects,
+                "fastFails": self.fast_fails,
+                "offlineSince": (round(self._offline_since, 3)
+                                 if self._breaker_open else 0),
+            }
+
+    def close(self) -> None:
+        self._closed = True
+        self._inner.close()
 
     # untimed passthroughs (and anything a backend adds beyond the ABC)
     def __getattr__(self, name):

