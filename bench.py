@@ -357,13 +357,17 @@ def bench_host_ceilings():
 
 def bench_select():
     """S3 Select scan rate: SELECT COUNT(*) ... WHERE over a generated CSV
-    through the full engine (event-stream framing included), columnar fast
-    path vs the row engine (reference harness:
-    internal/s3select/select_benchmark_test.go)."""
+    through the full engine (event-stream framing included), fused native
+    scan vs the compiled row tier (reference harness:
+    internal/s3select/select_benchmark_test.go).  Returns a dict with the
+    tier rates plus the corpus shape (row width, column count) and the
+    residual fraction measured over a differential-fuzz-style query
+    corpus, so select numbers are comparable across rounds."""
     import io as iomod
 
     from minio_tpu import select as sel
 
+    # fixed RNG: the corpus is identical every round
     rng = np.random.default_rng(0)
     n = 6_000_000  # ~83 MiB, enough for a stable per-byte rate
     a = rng.integers(0, 1000, n)
@@ -378,13 +382,25 @@ def bench_select():
         {"CSV": {}}, {"CSV": {}},
     )
 
-    def run(data):
-        t0 = time.perf_counter()
-        out = b"".join(sel.run_select(req, iomod.BytesIO(data), len(data)))
-        assert b":event" in out or out  # consumed
-        return len(data) / (time.perf_counter() - t0) / 2**30
+    # the stream is built OUTSIDE the timed region and rewound between
+    # passes: constructing a 40+ MiB BytesIO is a full memcpy, which on
+    # this container costs as much as the scan itself and would measure
+    # the harness, not the engine (both tiers are timed the same way)
+    def run(data, query=req):
+        # best of 3: this container's effective CPU/memory bandwidth
+        # wanders minute to minute (like the TPU tunnel above), so a
+        # single pass under-reports sustained capability
+        bio = iomod.BytesIO(data)
+        best = 0.0
+        for _ in range(3):
+            bio.seek(0)
+            t0 = time.perf_counter()
+            out = b"".join(sel.run_select(query, bio, len(data)))
+            assert b":event" in out or out  # consumed
+            best = max(best, len(data) / (time.perf_counter() - t0) / 2**30)
+        return best
 
-    fast = max(run(big), run(big))
+    fast = run(big)
 
     # JSON LINES scan rate through the pyarrow NDJSON fast path vs the
     # per-row engine (VERDICT r3 #6 done-condition: >= 10x)
@@ -400,12 +416,9 @@ def bench_select():
     )
 
     def run_json(data):
-        t0 = time.perf_counter()
-        out = b"".join(sel.run_select(jreq, iomod.BytesIO(data), len(data)))
-        assert out
-        return len(data) / (time.perf_counter() - t0) / 2**30
+        return run(data, query=jreq)
 
-    json_fast = max(run_json(jbig), run_json(jbig))
+    json_fast = run_json(jbig)
 
     # realistic wide-row corpus (the reference's benchmark records are
     # ~100 B employee rows, select_benchmark_test.go): structural scan
@@ -420,24 +433,106 @@ def bench_select():
         {"CSV": {}}, {"CSV": {}},
     )
 
-    def run_wide(data):
-        t0 = time.perf_counter()
-        out = b"".join(sel.run_select(wreq, iomod.BytesIO(data), len(data)))
-        assert out
-        return len(data) / (time.perf_counter() - t0) / 2**30
-
-    wide_fast = max(run_wide(wide), run_wide(wide))
+    wide_fast = run(wide, query=wreq)
+    # residual row tier: the compiled numpy batch engine (accelerated
+    # tiers disabled), and the pure per-record interpreter under it
+    sl = big[: len(big) // 8]
+    sl = sl[: sl.rfind(b"\n") + 1]
+    jsl = jbig[: len(jbig) // 8]
+    jsl = jsl[: jsl.rfind(b"\n") + 1]
     os.environ["MINIO_TPU_SELECT_COLUMNAR"] = "0"
     try:
-        sl = big[: len(big) // 8]
-        sl = sl[: sl.rfind(b"\n") + 1]
         slow = run(sl)
-        jsl = jbig[: len(jbig) // 8]
-        jsl = jsl[: jsl.rfind(b"\n") + 1]
         json_slow = run_json(jsl)
+        os.environ["MINIO_TPU_SELECT_BATCH"] = "0"
+        interp = run(sl[: len(sl) // 4])
+        json_interp = run_json(jsl[: len(jsl) // 4])
     finally:
         os.environ.pop("MINIO_TPU_SELECT_COLUMNAR", None)
-    return fast, slow, json_fast, json_slow, wide_fast
+        os.environ.pop("MINIO_TPU_SELECT_BATCH", None)
+
+    # residual fraction over a differential-fuzz-style corpus (the
+    # ISSUE 2 acceptance alternative: <5% of queries reach the row
+    # tier).  Query grammar mirrors tests/test_select_native.py's
+    # fuzzer; full dispatch, fixed seed.
+    import random as rnd_mod
+
+    from minio_tpu.select import batch as sel_batch
+
+    rng2 = rnd_mod.Random(0)
+    cells = ["", "0", "5", "500", "-3", "3.14", " 5", "abc", "café",
+             "HELLO", "1e3", "99999999999999999999", 'q"t', "a,b"]
+    ops = ["=", "!=", "<", "<=", ">", ">="]
+    fns = ["", "UPPER", "LOWER", "TRIM", "CHAR_LENGTH"]
+
+    def fuzz_query(r):
+        col = r.choice(["a", "b", "c"])
+        kind = r.randrange(8)
+        if kind == 0:
+            fn = r.choice(fns)
+            lhs = f"{fn}({col})" if fn else col
+            lit = r.choice(["5", "'abc'", "'HELLO'", "3.14", "0"])
+            return (f"SELECT COUNT(*) FROM s3object WHERE {lhs} "
+                    f"{r.choice(ops)} {lit}")
+        if kind == 1:
+            return (f"SELECT COUNT(*) FROM s3object WHERE {col} "
+                    f"LIKE '{r.choice(['%5%', 'a_c', 'H%', '%'])}'")
+        if kind == 2:
+            return (f"SELECT COUNT(*) FROM s3object WHERE {col} "
+                    "IN ('5', 'abc', '3.14')")
+        if kind == 3:
+            return (f"SELECT COUNT(*) FROM s3object WHERE {col} "
+                    "BETWEEN 0 AND 100")
+        if kind == 4:
+            return (f"SELECT COUNT(*) FROM s3object WHERE {col} IS "
+                    f"{'NOT ' if r.random() < .5 else ''}NULL")
+        if kind == 5:
+            return f"SELECT COUNT(b), MIN({col}), MAX({col}) FROM s3object"
+        if kind == 6:
+            return (f"SELECT a, c FROM s3object WHERE b "
+                    f"{r.choice(ops)} 10 LIMIT {r.randrange(1, 8)}")
+        return (f"SELECT COUNT(*) FROM s3object WHERE {col} * 2 + 1 "
+                f"{r.choice(ops)} 11")
+
+    def fuzz_csv(r):
+        lines = ["a,b,c"]
+        for _ in range(r.randrange(1, 40)):
+            vals = []
+            for _ in range(r.choice([3, 3, 3, 2, 4])):
+                v = r.choice(cells)
+                if any(ch in v for ch in ',"\r\n'):
+                    v = '"' + v.replace('"', '""') + '"'
+                vals.append(v)
+            lines.append(",".join(vals))
+        return ("\n".join(lines) + "\n").encode()
+
+    resid_before = sel_batch.stats["batch"] + sel.row_stats["queries"]
+    n_fuzz = 120
+    for _ in range(n_fuzz):
+        q = sel.SelectRequest(fuzz_query(rng2), {"CSV": {}}, {"CSV": {}})
+        data = fuzz_csv(rng2)
+        b"".join(sel.run_select(q, iomod.BytesIO(data), len(data)))
+    residual = (sel_batch.stats["batch"] + sel.row_stats["queries"]
+                - resid_before) / n_fuzz
+
+    return {
+        "select_scan_gibs": fast,
+        "select_scan_wide_gibs": wide_fast,
+        "select_row_engine_gibs": slow,
+        "select_row_interp_gibs": interp,
+        "select_json_scan_gibs": json_fast,
+        "select_json_row_gibs": json_slow,
+        "select_json_interp_gibs": json_interp,
+        "select_row_residual_fraction": residual,
+        "select_corpus": {
+            "narrow_row_bytes": round(len(big) / n, 1),
+            "narrow_columns": 3,
+            "wide_row_bytes": round(len(wide) / 700_000, 1),
+            "wide_columns": 6,
+            "json_line_bytes": round(len(jbig) / (n // 2), 1),
+            "fuzz_queries": n_fuzz,
+        },
+    }
 
 
 def bench_heal_12_4():
@@ -533,8 +628,7 @@ def main():
     # reported NEXT TO the page-cache number so the e2e claim is honest.
     # one pass is enough — bench_e2e already takes min-of-3 internally
     e2e_put_durable, _ = bench_e2e("auto", durable=True)
-    (select_fast, select_row, select_json, select_json_row,
-     select_wide) = bench_select()
+    sel_r = bench_select()
     heal12_dev, heal12_host = bench_heal_12_4()
     mp_fanout = bench_multipart_fanout()
     try:
@@ -579,13 +673,30 @@ def main():
             "heal_12_4_device_gibs": round(heal12_dev, 3),
             "heal_12_4_host_gibs": round(heal12_host, 3),
             "multipart_fanout_gibs": round(mp_fanout, 3),
-            "select_scan_gibs": round(select_fast, 3),
-            "select_scan_wide_gibs": round(select_wide, 3),
-            "select_row_engine_gibs": round(select_row, 3),
-            "select_speedup": round(select_fast / select_row, 1),
-            "select_json_scan_gibs": round(select_json, 3),
-            "select_json_row_gibs": round(select_json_row, 3),
-            "select_json_speedup": round(select_json / select_json_row, 1),
+            "select_scan_gibs": round(sel_r["select_scan_gibs"], 3),
+            "select_scan_wide_gibs": round(
+                sel_r["select_scan_wide_gibs"], 3),
+            "select_row_engine_gibs": round(
+                sel_r["select_row_engine_gibs"], 3),
+            "select_row_interp_gibs": round(
+                sel_r["select_row_interp_gibs"], 3),
+            # guard: a tier rate that rounds to 0 must not blow up the
+            # ratio (report 0.0 rather than a division error / inf)
+            "select_speedup": round(
+                sel_r["select_scan_gibs"] /
+                sel_r["select_row_engine_gibs"], 1)
+            if sel_r["select_row_engine_gibs"] > 1e-9 else 0.0,
+            "select_json_scan_gibs": round(
+                sel_r["select_json_scan_gibs"], 3),
+            "select_json_row_gibs": round(
+                sel_r["select_json_row_gibs"], 3),
+            "select_json_speedup": round(
+                sel_r["select_json_scan_gibs"] /
+                sel_r["select_json_row_gibs"], 1)
+            if sel_r["select_json_row_gibs"] > 1e-9 else 0.0,
+            "select_row_residual_fraction": round(
+                sel_r["select_row_residual_fraction"], 4),
+            "select_corpus": sel_r["select_corpus"],
             "note": (
                 "value = device-resident kernel aggregate; stream number is "
                 "transfer-inclusive and link-bound in this tunneled-TPU "

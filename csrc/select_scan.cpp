@@ -27,6 +27,7 @@
 #include <cstring>
 #include <cmath>
 #include <cstdlib>
+#include <thread>
 
 #if defined(__SSE2__)
 #include <emmintrin.h>
@@ -674,6 +675,318 @@ static inline int bytes_cmp(const char *a, int32_t an,
     return an < bn ? -1 : (an > bn ? 1 : 0);
 }
 
+// Tiny per-cell numeric program for `expr(col) <op> literal` leaves
+// where expr is an arithmetic/CAST chain over ONE column:
+//   codes: 0 x+k, 1 x-k, 2 x*k, 3 x/k, 4 x%k (Python floor-sign mod),
+//          5 k-x, 6 k/x, 7 trunc(x) (CAST INT), 8 noop (CAST FLOAT)
+// A cell that fails the strict numeric parse is AMBIGUOUS (the row
+// engine raises SQLError for arithmetic on non-numbers — the replay
+// reproduces that exactly), as are div/mod by zero.
+static inline int run_prog(double x, const int32_t *codes,
+                           const double *ops, int plen, double *out) {
+    for (int p = 0; p < plen; ++p) {
+        double k = ops[p];
+        switch (codes[p]) {
+        case 0: x = x + k; break;
+        case 1: x = x - k; break;
+        case 2: x = x * k; break;
+        case 3:
+            if (k == 0.0)
+                return 0;
+            x = x / k;
+            break;
+        case 4: {
+            if (k == 0.0)
+                return 0;
+            double r = fmod(x, k);
+            if (r != 0.0 && ((r < 0.0) != (k < 0.0)))
+                r += k;  // Python floor-sign modulo
+            x = r;
+            break;
+        }
+        case 5: x = k - x; break;
+        case 6:
+            if (x == 0.0)
+                return 0;
+            x = k / x;
+            break;
+        case 7: x = trunc(x); break;
+        case 8: break;
+        }
+        // Exactness guard: beyond 2^53 the row engine's Python big-int
+        // arithmetic diverges from doubles, and NaN/inf compare under
+        // different rules (NaN cmp is always False in Python; the
+        // 3-way compare here would read it as 'equal').  Both fail
+        // this bound (NaN fails every comparison) => replay.
+        if (!(x > -9007199254740992.0 && x < 9007199254740992.0))
+            return 0;
+    }
+    *out = x;
+    return 1;
+}
+
+// ---------------------------------------------------- per-cell leaf eval
+//
+// The array kernels (sel_cmp_num & co) and the fused one-pass kernels
+// (sel_csv_agg_fused / sel_json_agg_fused) share these per-cell
+// evaluators so the two paths cannot drift semantically.  Each returns
+// the mask bit for one cell and bumps *amb for cells whose exact value
+// Python must decide (the ambiguity-replay contract).
+
+static inline int cell_cmp_num(const char *cs, int32_t l, int op,
+                               int opmask, double num_lit,
+                               const char *str_lit, int32_t str_len,
+                               int fn, int32_t fn_a, int32_t fn_b,
+                               char *scratch, int64_t *amb) {
+    const char *s = cs;
+    double v;
+    if (fn == FN_CHARLEN) {
+        if (l < 0) {
+            if (l == -2)
+                ++*amb;
+            return 0;
+        }
+        if (!all_ascii(s, l)) {  // codepoint counting: Python decides
+            ++*amb;
+            return 0;
+        }
+        int c = ((double)l > num_lit) - ((double)l < num_lit);
+        return (opmask >> (c + 1)) & 1;
+    }
+    if (fn != FN_NONE && l > 0) {
+        if (l > FN_SCRATCH) {
+            ++*amb;
+            return 0;
+        }
+        int32_t nl = apply_fn(fn, s, l, scratch, fn_a, fn_b);
+        if (nl < 0) {
+            ++*amb;
+            return 0;
+        }
+        s = scratch;
+        l = nl;
+    }
+    // hot path: short pure-digit cell, fully inlined SWAR
+    if ((uint32_t)(l - 1) < 8u && parse_int8_swar(s, l, &v)) {
+        int c = (v > num_lit) - (v < num_lit);
+        return (opmask >> (c + 1)) & 1;
+    }
+    if (l < 0) {
+        if (l == -2)
+            ++*amb;
+        return 0;  // null (or needs-unquote: caller pre-screens)
+    }
+    if (parse_num(s, l, &v)) {
+        int c = (v > num_lit) - (v < num_lit);
+        return (opmask >> (c + 1)) & 1;
+    }
+    if (num_ambiguous(s, l)) {
+        ++*amb;
+        return 0;
+    }
+    return cmp_ok(op, bytes_cmp(s, l, str_lit, str_len));
+}
+
+static inline int cell_cmp_str(const char *cs, int32_t l, int op,
+                               const char *lit, int32_t lit_len, int fn,
+                               int32_t fn_a, int32_t fn_b, char *scratch,
+                               int64_t *amb) {
+    const char *s = cs;
+    if (l < 0) {
+        if (l == -2)
+            ++*amb;
+        return 0;
+    }
+    if (fn == FN_CHARLEN) {
+        // text compare of the DECIMAL rendering of the length
+        if (!all_ascii(s, l)) {
+            ++*amb;
+            return 0;
+        }
+        int32_t nl = (int32_t)snprintf(scratch, 16, "%d", l);
+        s = scratch;
+        l = nl;
+    } else if (fn != FN_NONE && l > 0) {
+        if (l > FN_SCRATCH) {
+            ++*amb;
+            return 0;
+        }
+        int32_t nl = apply_fn(fn, s, l, scratch, fn_a, fn_b);
+        if (nl < 0) {
+            ++*amb;
+            return 0;
+        }
+        s = scratch;
+        l = nl;
+    }
+    return cmp_ok(op, bytes_cmp(s, l, lit, lit_len));
+}
+
+static inline int cell_like(const char *cs, int32_t l, const char *pat,
+                            int32_t pat_len, const unsigned char *lit,
+                            int fn, int32_t fn_a, int32_t fn_b,
+                            char *scratch, int64_t *amb) {
+    const char *s = cs;
+    if (l < 0) {
+        if (l == -2)
+            ++*amb;
+        return 0;
+    }
+    if (fn != FN_NONE && l > 0) {
+        if (l > FN_SCRATCH || fn == FN_CHARLEN) {
+            ++*amb;
+            return 0;
+        }
+        int32_t nl = apply_fn(fn, s, l, scratch, fn_a, fn_b);
+        if (nl < 0) {
+            ++*amb;
+            return 0;
+        }
+        s = scratch;
+        l = nl;
+    }
+    return like_match(s, l, pat, pat_len, lit);
+}
+
+static inline int cell_cmp_expr(const char *s, int32_t l, int opmask,
+                                double num_lit, const int32_t *codes,
+                                const double *ops, int plen,
+                                int64_t *amb) {
+    double v;
+    // null/missing/garbage cells: the row engine RAISES for
+    // arithmetic — replay the block so it can
+    if (l < 0 || !parse_num(s, l, &v) ||
+        !run_prog(v, codes, ops, plen, &v)) {
+        ++*amb;
+        return 0;
+    }
+    int c = (v > num_lit) - (v < num_lit);
+    return (opmask >> (c + 1)) & 1;
+}
+
+// JSON variants over (type, extent) cells.  Type codes: 0 missing,
+// 1 null, 2 false, 3 true, 4 number, 5 string, 6 ambiguous.
+
+static inline int cell_json_cmp(const char *cs, int32_t l, uint8_t t,
+                                int op, int opmask, double num_lit,
+                                int lit_is_num, const char *str_lit,
+                                int32_t str_len, int fn, int32_t fn_a,
+                                int32_t fn_b, char *scratch,
+                                int64_t *amb) {
+    if (t == 0 || t == 1)  // missing/null: compare is false
+        return 0;
+    if (t == 6 || t == 2 || t == 3) {  // ambiguous or bool
+        ++*amb;
+        return 0;
+    }
+    const char *s = cs;
+    if (fn != FN_NONE) {
+        if (t != 5) {  // fn over a number cell: str() rendering
+            ++*amb;
+            return 0;
+        }
+        if (fn == FN_CHARLEN) {
+            if (!all_ascii(s, l)) {
+                ++*amb;
+                return 0;
+            }
+            if (lit_is_num) {
+                int c = ((double)l > num_lit) - ((double)l < num_lit);
+                return (opmask >> (c + 1)) & 1;
+            }
+            int32_t nl = (int32_t)snprintf(scratch, 16, "%d", l);
+            return cmp_ok(op, bytes_cmp(scratch, nl, str_lit, str_len));
+        }
+        if (l > FN_SCRATCH) {
+            ++*amb;
+            return 0;
+        }
+        int32_t nl = apply_fn(fn, s, l, scratch, fn_a, fn_b);
+        if (nl < 0) {
+            ++*amb;
+            return 0;
+        }
+        s = scratch;
+        l = nl;
+    }
+    double v;
+    if (t == 4) {  // fn != NONE already returned above for t != 5
+        if (!lit_is_num) {  // text compare of number cell: rendering
+            ++*amb;
+            return 0;
+        }
+        if (!parse_num(s, l, &v)) {  // huge ints etc.
+            ++*amb;
+            return 0;
+        }
+        int c = v < num_lit ? -1 : (v > num_lit ? 1 : 0);
+        return cmp_ok(op, c);
+    }
+    // string cell
+    if (lit_is_num && parse_num(s, l, &v)) {
+        int c = v < num_lit ? -1 : (v > num_lit ? 1 : 0);
+        return cmp_ok(op, c);
+    }
+    if (lit_is_num && num_ambiguous(s, l)) {
+        ++*amb;
+        return 0;
+    }
+    return cmp_ok(op, bytes_cmp(s, l, str_lit, str_len));
+}
+
+static inline int cell_json_like(const char *cs, int32_t l, uint8_t t,
+                                 const char *pat, int32_t pat_len,
+                                 const unsigned char *lit, int fn,
+                                 int32_t fn_a, int32_t fn_b,
+                                 char *scratch, int64_t *amb) {
+    if (t == 0 || t == 1)
+        return 0;
+    if (t != 5) {
+        ++*amb;
+        return 0;
+    }
+    const char *s = cs;
+    if (fn != FN_NONE) {
+        if (l > FN_SCRATCH || fn == FN_CHARLEN) {
+            ++*amb;
+            return 0;
+        }
+        int32_t nl = apply_fn(fn, s, l, scratch, fn_a, fn_b);
+        if (nl < 0) {
+            ++*amb;
+            return 0;
+        }
+        s = scratch;
+        l = nl;
+    }
+    return like_match(s, l, pat, pat_len, lit);
+}
+
+static inline int cell_json_isnull(int32_t l, uint8_t t, int64_t *amb) {
+    if (t == 6) {
+        ++*amb;
+        return 0;
+    }
+    return t == 0 || t == 1 || (t == 5 && l == 0);
+}
+
+static inline int cell_json_cmp_expr(const char *s, int32_t l, uint8_t t,
+                                     int opmask, double num_lit,
+                                     const int32_t *codes,
+                                     const double *ops, int plen,
+                                     int64_t *amb) {
+    double v;
+    // number tokens and numeric strings both feed arithmetic in
+    // the row engine (_num coerces); everything else raises there
+    if ((t != 4 && t != 5) || !parse_num(s, l, &v) ||
+        !run_prog(v, codes, ops, plen, &v)) {
+        ++*amb;
+        return 0;
+    }
+    int c = (v > num_lit) - (v < num_lit);
+    return (opmask >> (c + 1)) & 1;
+}
+
 // Numeric-literal comparison leaf: cells that parse numerically compare
 // against num_lit; everything else (including empty) compares textually
 // against str_lit, replicating sql._cmp_pair.  Returns count of
@@ -685,64 +998,10 @@ int64_t sel_cmp_num(const char *buf, const int32_t *starts,
     int64_t amb = 0;
     const int opmask = OPMASK[op];
     char scratch[FN_SCRATCH];
-    for (int64_t i = 0; i < n; ++i) {
-        int32_t l = lens[i];
-        const char *s = buf + starts[i];
-        double v;
-        if (fn == FN_CHARLEN) {
-            if (l < 0) {
-                mask[i] = 0;
-                if (l == -2)
-                    ++amb;
-                continue;
-            }
-            if (!all_ascii(s, l)) {  // codepoint counting: Python decides
-                mask[i] = 0;
-                ++amb;
-                continue;
-            }
-            int c = ((double)l > num_lit) - ((double)l < num_lit);
-            mask[i] = (uint8_t)((opmask >> (c + 1)) & 1);
-            continue;
-        }
-        if (fn != FN_NONE && l > 0) {
-            if (l > FN_SCRATCH) {
-                mask[i] = 0;
-                ++amb;
-                continue;
-            }
-            int32_t nl = apply_fn(fn, s, l, scratch, fn_a, fn_b);
-            if (nl < 0) {
-                mask[i] = 0;
-                ++amb;
-                continue;
-            }
-            s = scratch;
-            l = nl;
-        }
-        // hot path: short pure-digit cell, fully inlined SWAR
-        if ((uint32_t)(l - 1) < 8u && parse_int8_swar(s, l, &v)) {
-            int c = (v > num_lit) - (v < num_lit);
-            mask[i] = (uint8_t)((opmask >> (c + 1)) & 1);
-            continue;
-        }
-        if (l < 0) {
-            mask[i] = 0;  // null (or needs-unquote: caller pre-screens)
-            if (l == -2)
-                ++amb;
-            continue;
-        }
-        if (parse_num(s, l, &v)) {
-            int c = (v > num_lit) - (v < num_lit);
-            mask[i] = (uint8_t)((opmask >> (c + 1)) & 1);
-        } else if (num_ambiguous(s, l)) {
-            mask[i] = 0;
-            ++amb;
-        } else {
-            mask[i] = (uint8_t)cmp_ok(op, bytes_cmp(s, l, str_lit,
-                                                    str_len));
-        }
-    }
+    for (int64_t i = 0; i < n; ++i)
+        mask[i] = (uint8_t)cell_cmp_num(buf + starts[i], lens[i], op,
+                                        opmask, num_lit, str_lit, str_len,
+                                        fn, fn_a, fn_b, scratch, &amb);
     return amb;
 }
 
@@ -754,42 +1013,10 @@ int64_t sel_cmp_str(const char *buf, const int32_t *starts,
                     int fn, int32_t fn_a, int32_t fn_b) {
     int64_t amb = 0;
     char scratch[FN_SCRATCH];
-    for (int64_t i = 0; i < n; ++i) {
-        int32_t l = lens[i];
-        const char *s = buf + starts[i];
-        if (l < 0) {
-            mask[i] = 0;
-            if (l == -2)
-                ++amb;
-            continue;
-        }
-        if (fn == FN_CHARLEN) {
-            // text compare of the DECIMAL rendering of the length
-            if (!all_ascii(s, l)) {
-                mask[i] = 0;
-                ++amb;
-                continue;
-            }
-            int32_t nl = (int32_t)snprintf(scratch, 16, "%d", l);
-            s = scratch;
-            l = nl;
-        } else if (fn != FN_NONE && l > 0) {
-            if (l > FN_SCRATCH) {
-                mask[i] = 0;
-                ++amb;
-                continue;
-            }
-            int32_t nl = apply_fn(fn, s, l, scratch, fn_a, fn_b);
-            if (nl < 0) {
-                mask[i] = 0;
-                ++amb;
-                continue;
-            }
-            s = scratch;
-            l = nl;
-        }
-        mask[i] = (uint8_t)cmp_ok(op, bytes_cmp(s, l, lit, lit_len));
-    }
+    for (int64_t i = 0; i < n; ++i)
+        mask[i] = (uint8_t)cell_cmp_str(buf + starts[i], lens[i], op,
+                                        lit, lit_len, fn, fn_a, fn_b,
+                                        scratch, &amb);
     return amb;
 }
 
@@ -802,32 +1029,10 @@ int64_t sel_like(const char *buf, const int32_t *starts,
                  int32_t fn_a, int32_t fn_b) {
     int64_t amb = 0;
     char scratch[FN_SCRATCH];
-    for (int64_t i = 0; i < n; ++i) {
-        int32_t l = lens[i];
-        const char *s = buf + starts[i];
-        if (l < 0) {
-            mask[i] = 0;
-            if (l == -2)
-                ++amb;
-            continue;
-        }
-        if (fn != FN_NONE && l > 0) {
-            if (l > FN_SCRATCH || fn == FN_CHARLEN) {
-                mask[i] = 0;
-                ++amb;
-                continue;
-            }
-            int32_t nl = apply_fn(fn, s, l, scratch, fn_a, fn_b);
-            if (nl < 0) {
-                mask[i] = 0;
-                ++amb;
-                continue;
-            }
-            s = scratch;
-            l = nl;
-        }
-        mask[i] = (uint8_t)like_match(s, l, pat, pat_len, lit);
-    }
+    for (int64_t i = 0; i < n; ++i)
+        mask[i] = (uint8_t)cell_like(buf + starts[i], lens[i], pat,
+                                     pat_len, lit, fn, fn_a, fn_b,
+                                     scratch, &amb);
     return amb;
 }
 
@@ -900,6 +1105,514 @@ int64_t sel_agg(const char *buf, const int32_t *starts,
     return cnt;
 }
 
+// ------------------------------------------------ fused one-pass kernels
+//
+// sel_csv_agg_fused: structural scan + WHERE program + aggregate fold in
+// ONE traversal of a quote-free block (the caller guarantees no quote
+// byte — the same precondition as csv_scan_fast).  No per-row index
+// arrays are materialized: a row's needed cells live in registers/L1
+// between the scan and the predicate, which is what closes the
+// narrow-row perf letter (the multi-pass path wrote ~12 B of starts/
+// lens per 17-B row and then re-walked them per predicate leaf).
+//
+// WHERE program: leaves described by parallel arrays (kind, slot, op,
+// fn, fa, fb, num, aux offset/len into blob/likemask or the expr code/
+// operand pools), composed by a postfix `prog`: entry >= 0 pushes leaf
+// [entry]; -1 AND, -2 OR, -3 NOT.  Leaf kinds: 0 cmp-num, 1 cmp-str,
+// 2 LIKE, 3 IS NULL, 4 valid, 5 expr-prog.  Aggregates: agg_what 0
+// COUNT, 1 SUM/AVG, 2 MIN/MAX; agg_slot -1 = COUNT(*).  MIN/MAX report
+// the winning cell's extent so the driver can coerce its exact text.
+//
+// Ambiguity contract unchanged: any ambiguous cell bumps *amb_out and
+// the driver replays the whole consumed region through the row engine
+// (so once amb != 0 the kernel skips predicate/aggregate work and only
+// finishes the structural scan for *consumed).
+
+#define FUSED_MAX_COLS 16
+#define FUSED_MAX_STACK 64
+#define FUSED_MAX_AGGS 16
+#define FUSED_MAX_THREADS 8
+
+// Scan parallelism (the reference's simdj reader also fans block
+// parsing across goroutines): blocks >= 1 MiB split at newline
+// boundaries across up to hardware_concurrency (cap 4) threads.
+// MINIO_TPU_SELECT_THREADS=1 pins it single-threaded.
+static int fused_threads() {
+    static const int t = [] {
+        const char *e = getenv("MINIO_TPU_SELECT_THREADS");
+        if (e && *e) {
+            int v = atoi(e);
+            if (v >= 1)
+                return v > FUSED_MAX_THREADS ? FUSED_MAX_THREADS : v;
+        }
+        unsigned hc = std::thread::hardware_concurrency();
+        // mild oversubscription (4 scan threads even on 2 cores) rides
+        // out scheduler throttling in quota-bound containers; threads
+        // are short-lived and split work statically, so the only cost
+        // is a couple of extra spawns per >=1 MiB block
+        return (int)(hc >= 2 ? 4 : 1);
+    }();
+    return t;
+}
+
+// Per-thread partial aggregate state + its exact merge.  COUNT/SUM add
+// (SUM merge is the same float block-merge the per-block driver commit
+// already performs); MIN/MAX keep the FIRST occurrence on ties (strict
+// compare, parts merged in byte order) so the reported cell extent is
+// the one the sequential scan would have picked.
+struct FusedPart {
+    int64_t cnt[FUSED_MAX_AGGS];
+    double sum[FUSED_MAX_AGGS], mn[FUSED_MAX_AGGS], mx[FUSED_MAX_AGGS];
+    int32_t mnp[FUSED_MAX_AGGS], mnl[FUSED_MAX_AGGS];
+    int32_t mxp[FUSED_MAX_AGGS], mxl[FUSED_MAX_AGGS];
+    int64_t rows, amb, cons, qhit;
+};
+
+static void fused_merge(const FusedPart *parts, const int64_t *base,
+                        int nt, int32_t naggs,
+                        int64_t *agg_count, double *agg_sum,
+                        double *agg_min, double *agg_max,
+                        int32_t *agg_minpos, int32_t *agg_minlen,
+                        int32_t *agg_maxpos, int32_t *agg_maxlen,
+                        int64_t *rows_out, int64_t *amb_out) {
+    int64_t rows = 0, amb = 0;
+    for (int32_t a = 0; a < naggs; ++a) {
+        agg_count[a] = 0;
+        agg_sum[a] = 0.0;
+        agg_min[a] = agg_max[a] = 0.0;
+        agg_minpos[a] = agg_maxpos[a] = 0;
+        agg_minlen[a] = agg_maxlen[a] = -1;
+    }
+    for (int pi = 0; pi < nt; ++pi) {
+        const FusedPart &P = parts[pi];
+        rows += P.rows;
+        amb += P.amb;
+        for (int32_t a = 0; a < naggs; ++a) {
+            agg_count[a] += P.cnt[a];
+            agg_sum[a] += P.sum[a];
+            if (P.mnl[a] >= 0 &&
+                (agg_minlen[a] < 0 || P.mn[a] < agg_min[a])) {
+                agg_min[a] = P.mn[a];
+                agg_minpos[a] = (int32_t)(P.mnp[a] + base[pi]);
+                agg_minlen[a] = P.mnl[a];
+            }
+            if (P.mxl[a] >= 0 &&
+                (agg_maxlen[a] < 0 || P.mx[a] > agg_max[a])) {
+                agg_max[a] = P.mx[a];
+                agg_maxpos[a] = (int32_t)(P.mxp[a] + base[pi]);
+                agg_maxlen[a] = P.mxl[a];
+            }
+        }
+    }
+    *rows_out = rows;
+    *amb_out = amb;
+}
+
+// Newline-aligned split points for a T-way parallel scan; returns the
+// part count (1 = don't parallelize).  cut[0] = 0, cut[nt] = len, and
+// every interior cut lands just past a '\n' so parts hold whole rows.
+static int fused_cuts(const char *buf, int64_t len, int T,
+                      int64_t *cut) {
+    int nt = 1;
+    cut[0] = 0;
+    for (int t = 1; t < T && nt < FUSED_MAX_THREADS; ++t) {
+        int64_t target = len * t / T;
+        if (target <= cut[nt - 1])
+            continue;
+        const char *nl = static_cast<const char *>(
+            memchr(buf + target, '\n', len - target));
+        if (!nl)
+            break;
+        int64_t c = (nl - buf) + 1;
+        if (c >= len || c <= cut[nt - 1])
+            continue;
+        cut[nt++] = c;
+    }
+    cut[nt] = len;
+    return nt;
+}
+
+static int64_t csv_agg_fused_part(
+    const char *buf, int64_t len, char delim, char quote,
+    int final_block, const int32_t *col_idx, int32_t ncols,
+    int32_t nleaves, const int32_t *lf_kind, const int32_t *lf_slot,
+    const int32_t *lf_op, const int32_t *lf_fn, const int32_t *lf_fa,
+    const int32_t *lf_fb, const double *lf_num, const int32_t *lf_aoff,
+    const int32_t *lf_alen, const char *blob,
+    const unsigned char *likemask, const int32_t *prog, int32_t prog_len,
+    const int32_t *expr_codes, const double *expr_ops,
+    int32_t naggs, const int32_t *agg_what, const int32_t *agg_slot,
+    int64_t *agg_count, double *agg_sum, double *agg_min, double *agg_max,
+    int32_t *agg_minpos, int32_t *agg_minlen,
+    int32_t *agg_maxpos, int32_t *agg_maxlen,
+    int64_t *rows_out, int64_t *amb_out, int64_t *consumed,
+    int64_t *qhit) {
+    int64_t row = 0, amb = 0;
+    int qstop = 0;  // quote seen: stop before the row containing it
+    int32_t cp[FUSED_MAX_COLS], cl[FUSED_MAX_COLS];
+    char scratch[FN_SCRATCH];
+    for (int32_t c = 0; c < ncols; ++c)
+        cl[c] = -1;
+    for (int32_t a = 0; a < naggs; ++a) {
+        agg_count[a] = 0;
+        agg_sum[a] = 0.0;
+        agg_min[a] = agg_max[a] = 0.0;
+        agg_minpos[a] = agg_maxpos[a] = 0;
+        agg_minlen[a] = agg_maxlen[a] = -1;
+    }
+    int32_t field = 0, k = 0;
+    int64_t field_start = 0, row_begin = 0;
+    const int32_t col0 = col_idx[0];
+    const int single = (ncols == 1);
+    // specialize the overwhelmingly common program shape — one numeric
+    // comparison leaf feeding COUNT(*) — so the per-row path is a SWAR
+    // parse + compare + increment with no interpreter dispatch at all
+    const int simple_cmp =
+        nleaves == 1 && prog_len == 1 && lf_kind[0] == 0 &&
+        lf_fn[0] == 0;
+    const int count_star_only =
+        naggs == 1 && agg_slot[0] < 0;
+    const int s_opmask = simple_cmp ? OPMASK[lf_op[0]] : 0;
+    const double s_num = simple_cmp ? lf_num[0] : 0.0;
+    const int32_t s_slot = simple_cmp ? lf_slot[0] : 0;
+
+    // kept out of line: the generic program interpreter must not bloat
+    // the per-separator scan loop's inline expansion
+    auto eval_row_slow = [&]() __attribute__((noinline)) {
+        int ok = 1;
+        if (nleaves) {
+            uint8_t st[FUSED_MAX_STACK];
+            int sp = 0;
+            for (int32_t pi = 0; pi < prog_len; ++pi) {
+                int32_t e = prog[pi];
+                if (e >= 0) {
+                    const int32_t sl = lf_slot[e];
+                    const char *s = buf + cp[sl];
+                    const int32_t l = cl[sl];
+                    int r;
+                    switch (lf_kind[e]) {
+                    case 0:
+                        r = cell_cmp_num(s, l, lf_op[e], OPMASK[lf_op[e]],
+                                         lf_num[e], blob + lf_aoff[e],
+                                         lf_alen[e], lf_fn[e], lf_fa[e],
+                                         lf_fb[e], scratch, &amb);
+                        break;
+                    case 1:
+                        r = cell_cmp_str(s, l, lf_op[e],
+                                         blob + lf_aoff[e], lf_alen[e],
+                                         lf_fn[e], lf_fa[e], lf_fb[e],
+                                         scratch, &amb);
+                        break;
+                    case 2:
+                        r = cell_like(s, l, blob + lf_aoff[e],
+                                      lf_alen[e], likemask + lf_aoff[e],
+                                      lf_fn[e], lf_fa[e], lf_fb[e],
+                                      scratch, &amb);
+                        break;
+                    case 3:  // IS NULL (fast path never sees -2)
+                        r = (l == -1 || l == 0);
+                        break;
+                    case 4:  // valid
+                        r = (l >= 0 || l == -2);
+                        break;
+                    default:  // 5: expr program
+                        r = cell_cmp_expr(s, l, OPMASK[lf_op[e]],
+                                          lf_num[e],
+                                          expr_codes + lf_aoff[e],
+                                          expr_ops + lf_aoff[e],
+                                          lf_alen[e], &amb);
+                    }
+                    st[sp++] = (uint8_t)r;
+                } else if (e == -1) {
+                    st[sp - 2] &= st[sp - 1];
+                    --sp;
+                } else if (e == -2) {
+                    st[sp - 2] |= st[sp - 1];
+                    --sp;
+                } else {
+                    st[sp - 1] ^= 1;
+                }
+            }
+            ok = st[0];
+        }
+        if (!ok || amb)
+            return;
+        for (int32_t a = 0; a < naggs; ++a) {
+            const int32_t sl = agg_slot[a];
+            if (sl < 0) {  // COUNT(*)
+                ++agg_count[a];
+                continue;
+            }
+            const int32_t l = cl[sl];
+            if (l == -1 || l == 0)
+                continue;  // null/empty: skipped by accumulate
+            if (agg_what[a] == 0) {
+                ++agg_count[a];
+                continue;
+            }
+            double v;
+            if (!parse_num(buf + cp[sl], l, &v)) {
+                ++amb;  // SUM raises / MIN-MAX mixes text: Python decides
+                continue;
+            }
+            ++agg_count[a];
+            if (agg_what[a] == 1) {
+                agg_sum[a] += v;
+            } else {
+                if (agg_minlen[a] < 0 || v < agg_min[a]) {
+                    agg_min[a] = v;
+                    agg_minpos[a] = cp[sl];
+                    agg_minlen[a] = l;
+                }
+                if (agg_maxlen[a] < 0 || v > agg_max[a]) {
+                    agg_max[a] = v;
+                    agg_maxpos[a] = cp[sl];
+                    agg_maxlen[a] = l;
+                }
+            }
+        }
+    };
+
+    auto eval_row = [&]() __attribute__((always_inline)) {
+        if (amb)
+            return;  // block will replay: scan only
+        if (count_star_only && nleaves == 0) {
+            ++agg_count[0];
+            return;
+        }
+        if (simple_cmp && count_star_only) {
+            const int32_t l = cl[s_slot];
+            const char *s = buf + cp[s_slot];
+            double v;
+            if ((uint32_t)(l - 1) < 8u && parse_int8_swar(s, l, &v)) {
+                int c = (v > s_num) - (v < s_num);
+                agg_count[0] += (s_opmask >> (c + 1)) & 1;
+                return;
+            }
+            agg_count[0] += cell_cmp_num(
+                s, l, lf_op[0], s_opmask, s_num, blob + lf_aoff[0],
+                lf_alen[0], 0, 0, 0, scratch, &amb) && !amb;
+            return;
+        }
+        eval_row_slow();
+    };
+
+    // handle() -> 0 normal, 2 all needed cells of this row captured
+    // (caller may skip remaining delimiters until the next newline)
+    auto handle = [&](int64_t pos, int is_nl)
+        __attribute__((always_inline)) {
+        if (single ? (field == col0)
+                   : (k < ncols && col_idx[k] == field)) {
+            int64_t ce = pos;
+            if (is_nl && ce > field_start && buf[ce - 1] == '\r')
+                --ce;
+            cp[k] = (int32_t)field_start;
+            cl[k] = (int32_t)(ce - field_start);
+            ++k;
+        }
+        field_start = pos + 1;
+        if (is_nl) {
+            int64_t rl = pos - row_begin;
+            if (!(rl == 0 || (rl == 1 && buf[row_begin] == '\r'))) {
+                // blank records are skipped like csv.reader does
+                eval_row();
+                ++row;
+            }
+            row_begin = pos + 1;
+            for (int32_t c = 0; c < k; ++c)
+                cl[c] = -1;
+            field = 0;
+            k = 0;
+            return 0;
+        }
+        ++field;
+        return (k == ncols) ? 2 : 0;
+    };
+
+    // Quote handling is fused into the scan (no separate memchr pass —
+    // at narrow-row rates an extra memory pass costs as much as the
+    // scan): the first quote byte stops the kernel BEFORE the row
+    // containing it, *qhit tells the driver to route the quoted
+    // stretch through the array kernels, and scanning resumes fused on
+    // the next block.
+    int64_t i = 0;
+#if defined(__AVX2__)
+    const __m256i vd = _mm256_set1_epi8(delim);
+    const __m256i vn = _mm256_set1_epi8('\n');
+    const __m256i vq = _mm256_set1_epi8(quote);
+    int skipping = 0;  // row's needed cells done: only newlines matter
+    while (i + 32 <= len && !qstop) {
+        __m256i x = _mm256_loadu_si256(
+            reinterpret_cast<const __m256i *>(buf + i));
+        uint32_t mn = (uint32_t)_mm256_movemask_epi8(
+            _mm256_cmpeq_epi8(x, vn));
+        uint32_t mq = (uint32_t)_mm256_movemask_epi8(
+            _mm256_cmpeq_epi8(x, vq));
+        // process only separator bits strictly before the first quote
+        const uint32_t limit =
+            mq ? (((uint32_t)1 << __builtin_ctz(mq)) - 1) : 0xFFFFFFFFu;
+        mn &= limit;
+        uint32_t m;
+        if (skipping) {
+            if (mn == 0) {
+                if (mq) {
+                    qstop = 1;
+                    break;
+                }
+                i += 32;  // whole chunk is mid-row noise
+                continue;
+            }
+            m = ((uint32_t)_mm256_movemask_epi8(
+                     _mm256_cmpeq_epi8(x, vd)) | mn) & limit;
+            m &= ~(((uint32_t)1 << __builtin_ctz(mn)) - 1);
+            skipping = 0;
+        } else {
+            m = ((uint32_t)_mm256_movemask_epi8(
+                     _mm256_cmpeq_epi8(x, vd)) | mn) & limit;
+        }
+        while (m) {
+            int b = __builtin_ctz(m);
+            m &= m - 1;
+            if (handle(i + b, (mn >> b) & 1) == 2) {
+                // drop delimiter bits until the next newline
+                uint32_t nn = mn & m;
+                if (nn) {
+                    m &= ~(((uint32_t)1 << __builtin_ctz(nn)) - 1);
+                } else {
+                    m = 0;
+                    skipping = 1;
+                }
+            }
+        }
+        if (mq) {
+            qstop = 1;
+            break;
+        }
+        i += 32;
+    }
+    if (skipping && !qstop) {
+        // resume the scalar tail at the next newline (or quote)
+        const char *z = scan2(buf + i, buf + len, quote, '\n');
+        if (z == buf + len)
+            i = len;
+        else if (*z == quote)
+            qstop = 1;
+        else
+            i = z - buf;
+    }
+#endif
+    while (i < len && !qstop) {
+        char c = buf[i];
+        if (c == quote) {
+            qstop = 1;
+            break;
+        }
+        if (c == delim || c == '\n') {
+            if (handle(i, c == '\n') == 2) {
+                const char *z = scan2(buf + i + 1, buf + len, quote,
+                                      '\n');
+                if (z == buf + len) {
+                    i = len;
+                    break;
+                }
+                if (*z == quote) {
+                    qstop = 1;
+                    break;
+                }
+                i = z - buf;
+                continue;  // process the newline next iteration
+            }
+        }
+        ++i;
+    }
+    *consumed = row_begin;
+    if (final_block && !qstop && row_begin < len) {
+        int64_t rl = len - row_begin;
+        if (rl == 0 || (rl == 1 && buf[row_begin] == '\r')) {
+            *consumed = len;  // trailing blank: consumed, no record
+        } else {
+            // trailing record without newline
+            if (k < ncols && col_idx[k] == field) {
+                cp[k] = (int32_t)field_start;
+                cl[k] = (int32_t)(len - field_start);
+            }
+            eval_row();
+            ++row;
+            *consumed = len;
+        }
+    }
+    *rows_out = row;
+    *amb_out = amb;
+    *qhit = qstop;
+    return row;
+}
+
+int64_t sel_csv_agg_fused(
+    const char *buf, int64_t len, char delim, char quote,
+    int final_block, const int32_t *col_idx, int32_t ncols,
+    int32_t nleaves, const int32_t *lf_kind, const int32_t *lf_slot,
+    const int32_t *lf_op, const int32_t *lf_fn, const int32_t *lf_fa,
+    const int32_t *lf_fb, const double *lf_num, const int32_t *lf_aoff,
+    const int32_t *lf_alen, const char *blob,
+    const unsigned char *likemask, const int32_t *prog, int32_t prog_len,
+    const int32_t *expr_codes, const double *expr_ops,
+    int32_t naggs, const int32_t *agg_what, const int32_t *agg_slot,
+    int64_t *agg_count, double *agg_sum, double *agg_min, double *agg_max,
+    int32_t *agg_minpos, int32_t *agg_minlen,
+    int32_t *agg_maxpos, int32_t *agg_maxlen,
+    int64_t *rows_out, int64_t *amb_out, int64_t *consumed,
+    int64_t *saw_quote) {
+    const int T = fused_threads();
+    if (T > 1 && len >= (1 << 20) && naggs <= FUSED_MAX_AGGS) {
+        int64_t cut[FUSED_MAX_THREADS + 1];
+        const int nt = fused_cuts(buf, len, T, cut);
+        if (nt > 1) {
+            FusedPart parts[FUSED_MAX_THREADS];
+            auto runp = [&](int pi, int fin) {
+                FusedPart &P = parts[pi];
+                csv_agg_fused_part(
+                    buf + cut[pi], cut[pi + 1] - cut[pi], delim, quote,
+                    fin, col_idx, ncols, nleaves, lf_kind, lf_slot,
+                    lf_op, lf_fn, lf_fa, lf_fb, lf_num, lf_aoff,
+                    lf_alen, blob, likemask, prog, prog_len, expr_codes,
+                    expr_ops, naggs, agg_what, agg_slot, P.cnt, P.sum,
+                    P.mn, P.mx, P.mnp, P.mnl, P.mxp, P.mxl, &P.rows,
+                    &P.amb, &P.cons, &P.qhit);
+            };
+            std::thread th[FUSED_MAX_THREADS];
+            for (int pi = 1; pi < nt; ++pi)
+                th[pi] = std::thread(runp, pi,
+                                     pi == nt - 1 ? final_block : 0);
+            runp(0, 0);
+            for (int pi = 1; pi < nt; ++pi)
+                th[pi].join();
+            // a quote stops the merge at that part: later parts'
+            // results describe rows past the stop point and are
+            // discarded (the driver re-scans from *consumed via the
+            // quote-aware array kernels)
+            int nkeep = nt;
+            for (int pi = 0; pi < nt; ++pi)
+                if (parts[pi].qhit) {
+                    nkeep = pi + 1;
+                    break;
+                }
+            fused_merge(parts, cut, nkeep, naggs, agg_count, agg_sum,
+                        agg_min, agg_max, agg_minpos, agg_minlen,
+                        agg_maxpos, agg_maxlen, rows_out, amb_out);
+            *consumed = cut[nkeep - 1] + parts[nkeep - 1].cons;
+            *saw_quote = parts[nkeep - 1].qhit;
+            return *rows_out;
+        }
+    }
+    return csv_agg_fused_part(
+        buf, len, delim, quote, final_block, col_idx, ncols, nleaves,
+        lf_kind, lf_slot, lf_op, lf_fn, lf_fa, lf_fb, lf_num, lf_aoff,
+        lf_alen, blob, likemask, prog, prog_len, expr_codes, expr_ops,
+        naggs, agg_what, agg_slot, agg_count, agg_sum, agg_min, agg_max,
+        agg_minpos, agg_minlen, agg_maxpos, agg_maxlen, rows_out,
+        amb_out, consumed, saw_quote);
+}
+
 // ------------------------------------------------------ column emission
 
 // Emit selected columns of masked rows as CSV records (projection
@@ -939,56 +1652,8 @@ int64_t sel_emit_cols(const char *buf, const int32_t *starts,
 }
 
 // ---------------------------------------------- numeric expression leaves
-
-// Tiny per-cell numeric program for `expr(col) <op> literal` leaves
-// where expr is an arithmetic/CAST chain over ONE column:
-//   codes: 0 x+k, 1 x-k, 2 x*k, 3 x/k, 4 x%k (Python floor-sign mod),
-//          5 k-x, 6 k/x, 7 trunc(x) (CAST INT), 8 noop (CAST FLOAT)
-// A cell that fails the strict numeric parse is AMBIGUOUS (the row
-// engine raises SQLError for arithmetic on non-numbers — the replay
-// reproduces that exactly), as are div/mod by zero.
-static inline int run_prog(double x, const int32_t *codes,
-                           const double *ops, int plen, double *out) {
-    for (int p = 0; p < plen; ++p) {
-        double k = ops[p];
-        switch (codes[p]) {
-        case 0: x = x + k; break;
-        case 1: x = x - k; break;
-        case 2: x = x * k; break;
-        case 3:
-            if (k == 0.0)
-                return 0;
-            x = x / k;
-            break;
-        case 4: {
-            if (k == 0.0)
-                return 0;
-            double r = fmod(x, k);
-            if (r != 0.0 && ((r < 0.0) != (k < 0.0)))
-                r += k;  // Python floor-sign modulo
-            x = r;
-            break;
-        }
-        case 5: x = k - x; break;
-        case 6:
-            if (x == 0.0)
-                return 0;
-            x = k / x;
-            break;
-        case 7: x = trunc(x); break;
-        case 8: break;
-        }
-        // Exactness guard: beyond 2^53 the row engine's Python big-int
-        // arithmetic diverges from doubles, and NaN/inf compare under
-        // different rules (NaN cmp is always False in Python; the
-        // 3-way compare here would read it as 'equal').  Both fail
-        // this bound (NaN fails every comparison) => replay.
-        if (!(x > -9007199254740992.0 && x < 9007199254740992.0))
-            return 0;
-    }
-    *out = x;
-    return 1;
-}
+// (run_prog and the per-cell evaluators live with the other cell
+// helpers above so the fused kernels can share them.)
 
 int64_t sel_cmp_expr(const char *buf, const int32_t *starts,
                      const int32_t *lens, int64_t n, int op,
@@ -996,21 +1661,10 @@ int64_t sel_cmp_expr(const char *buf, const int32_t *starts,
                      const double *ops, int plen, uint8_t *mask) {
     int64_t amb = 0;
     const int opmask = OPMASK[op];
-    for (int64_t i = 0; i < n; ++i) {
-        int32_t l = lens[i];
-        const char *s = buf + starts[i];
-        double v;
-        if (l < 0 || !parse_num(s, l, &v) ||
-            !run_prog(v, codes, ops, plen, &v)) {
-            // null/missing/garbage cells: the row engine RAISES for
-            // arithmetic — replay the block so it can
-            mask[i] = 0;
-            ++amb;
-            continue;
-        }
-        int c = (v > num_lit) - (v < num_lit);
-        mask[i] = (uint8_t)((opmask >> (c + 1)) & 1);
-    }
+    for (int64_t i = 0; i < n; ++i)
+        mask[i] = (uint8_t)cell_cmp_expr(buf + starts[i], lens[i],
+                                         opmask, num_lit, codes, ops,
+                                         plen, &amb);
     return amb;
 }
 
@@ -1021,21 +1675,10 @@ int64_t sel_json_cmp_expr(const char *buf, const int32_t *starts,
                           int plen, uint8_t *mask) {
     int64_t amb = 0;
     const int opmask = OPMASK[op];
-    for (int64_t i = 0; i < n; ++i) {
-        uint8_t t = types[i];
-        double v;
-        // number tokens and numeric strings both feed arithmetic in
-        // the row engine (_num coerces); everything else raises there
-        if ((t != 4 && t != 5) ||
-            !parse_num(buf + starts[i], lens[i], &v) ||
-            !run_prog(v, codes, ops, plen, &v)) {
-            mask[i] = 0;
-            ++amb;
-            continue;
-        }
-        int c = (v > num_lit) - (v < num_lit);
-        mask[i] = (uint8_t)((opmask >> (c + 1)) & 1);
-    }
+    for (int64_t i = 0; i < n; ++i)
+        mask[i] = (uint8_t)cell_json_cmp_expr(
+            buf + starts[i], lens[i], types[i], opmask, num_lit, codes,
+            ops, plen, &amb);
     return amb;
 }
 
@@ -1078,16 +1721,69 @@ static inline const char *find_byte(const char *p, const char *le,
     return p;
 }
 
-// Fast parse of one line KNOWN to contain no backslash: every '"' is a
-// real string boundary.  Returns 0 on clean parse, 1 when the line
-// needs the slow machine (or is invalid).
-static int json_line_fast(const char *buf, const char *ls, const char *le,
-                          const char *const *keys, const int32_t *key_lens,
-                          int32_t nkeys, int64_t max_rows, int64_t row,
-                          int32_t *starts, int32_t *lens, uint8_t *types) {
+// Strict JSON number grammar (json.loads' NUMBER_RE plus the NaN/
+// Infinity/-Infinity constants Python's json accepts by default).
+// parse_num accepts a DIFFERENT set (leading '+', '5.', '.5', '00',
+// underscore-free Python-style) — a token parse_num likes but the
+// grammar rejects is INVALID JSON and the row engine raises, so it
+// must mark the line bad, never type 4.
+static inline int json_num_grammar(const char *s, int32_t n) {
+    if (n <= 0)
+        return 0;
+    int32_t i = 0;
+    if (s[0] == 'N')
+        return n == 3 && memcmp(s, "NaN", 3) == 0;
+    if (s[0] == 'I')
+        return n == 8 && memcmp(s, "Infinity", 8) == 0;
+    if (s[0] == '-') {
+        if (n == 9 && memcmp(s + 1, "Infinity", 8) == 0)
+            return 1;
+        i = 1;
+    }
+    if (i >= n)
+        return 0;
+    if (s[i] == '0') {
+        ++i;
+    } else if (s[i] >= '1' && s[i] <= '9') {
+        while (i < n && (unsigned char)(s[i] - '0') <= 9)
+            ++i;
+    } else {
+        return 0;
+    }
+    if (i < n && s[i] == '.') {
+        ++i;
+        if (i >= n || (unsigned char)(s[i] - '0') > 9)
+            return 0;
+        while (i < n && (unsigned char)(s[i] - '0') <= 9)
+            ++i;
+    }
+    if (i < n && (s[i] == 'e' || s[i] == 'E')) {
+        ++i;
+        if (i < n && (s[i] == '+' || s[i] == '-'))
+            ++i;
+        if (i >= n || (unsigned char)(s[i] - '0') > 9)
+            return 0;
+        while (i < n && (unsigned char)(s[i] - '0') <= 9)
+            ++i;
+    }
+    return i == n;
+}
+
+// Unified per-line machine (escape-capable: a backslash in a string
+// VALUE only makes that one cell ambiguous instead of punting the
+// whole line, so escape-heavy corpora keep the fast path).  Writes
+// needed keys' extents/types at [k*stride + row]; returns 0 on a clean
+// parse, 1 when the line is not a valid compact JSON object (escaped
+// KEY text, structural garbage, invalid bare tokens) — the caller
+// marks every key ambiguous and the Python replay decides (and raises
+// exactly like the row engine on truly invalid lines).
+static int json_parse_line(const char *buf, const char *ls, const char *le,
+                           const char *const *keys, const int32_t *key_lens,
+                           int32_t nkeys, int64_t stride, int64_t row,
+                           int32_t *starts, int32_t *lens, uint8_t *types) {
     const char *q = ls;
     if (*q != '{')
-        return 1;
+        return 1;  // non-object line (array/scalar): row engine wraps
     q = skip_ws(q + 1, le);
     if (q < le && *q == '}')
         return skip_ws(q + 1, le) == le ? 0 : 1;
@@ -1095,9 +1791,23 @@ static int json_line_fast(const char *buf, const char *ls, const char *le,
         if (q >= le || *q != '"')
             return 1;
         const char *ks = q + 1;
-        const char *kq = find_byte(ks, le, '"');
-        if (kq == le)
-            return 1;
+        const char *kq = ks;
+        for (;;) {
+            const char *h = find_byte(kq, le, '"');
+            if (h == le)
+                return 1;
+            int bs = 0;
+            const char *t = h - 1;
+            while (t >= ks && *t == '\\') {
+                ++bs;
+                --t;
+            }
+            if (bs % 2) {
+                return 1;  // escaped key text: let Python decide
+            }
+            kq = h;
+            break;
+        }
         int32_t klen = (int32_t)(kq - ks);
         q = skip_ws(kq + 1, le);
         if (q >= le || *q != ':')
@@ -1118,27 +1828,45 @@ static int json_line_fast(const char *buf, const char *ls, const char *le,
         char v0 = *q;
         if (v0 == '"') {
             const char *ss = q + 1;
-            const char *sq = find_byte(ss, le, '"');
-            if (sq == le)
-                return 1;
-            vt = 5;
+            const char *sq = ss;
+            int sesc = 0;
+            for (;;) {
+                const char *h = find_byte(sq, le, '"');
+                if (h == le)
+                    return 1;
+                int bs = 0;
+                const char *t = h - 1;
+                while (t >= ss && *t == '\\') {
+                    ++bs;
+                    --t;
+                }
+                if (bs % 2) {
+                    sesc = 1;
+                    sq = h + 1;
+                    continue;
+                }
+                sq = h;
+                break;
+            }
+            vt = sesc ? 6 : 5;  // escaped value: Python semantics
             vs = (int32_t)(ss - buf);
             vl = (int32_t)(sq - ss);
             q = sq + 1;
         } else if (v0 == '{' || v0 == '[') {
-            int d = 0;
+            int d = 0, instr = 0;
             const char *z = q;
             while (z < le) {
                 char c = *z;
-                if (c == '"') {
-                    const char *t = static_cast<const char *>(
-                        memchr(z + 1, '"', le - z - 1));
-                    if (!t)
-                        return 1;
-                    z = t + 1;
-                    continue;
-                }
-                if (c == '{' || c == '[') {
+                if (instr) {
+                    if (c == '\\') {
+                        z += 2;
+                        continue;
+                    }
+                    if (c == '"')
+                        instr = 0;
+                } else if (c == '"') {
+                    instr = 1;
+                } else if (c == '{' || c == '[') {
                     ++d;
                 } else if (c == '}' || c == ']') {
                     --d;
@@ -1178,16 +1906,23 @@ static int json_line_fast(const char *buf, const char *ls, const char *le,
                    *z != '\t' && *z != '\r')
                 ++z;
             vl = (int32_t)(z - q);
-            double dummy;
-            if (!parse_num(q, vl, &dummy))
-                return 1;  // big ints / garbage: slow machine decides
-            vt = 4;
+            if (!json_num_grammar(q, vl))
+                return 1;  // invalid bare token: row engine raises
+            if (ki >= 0) {
+                // needed value: exact double or ambiguous (>15-digit
+                // ints, NaN/Infinity — json.loads parses those exactly
+                // or as specials; Python decides)
+                double dummy;
+                vt = parse_num(q, vl, &dummy) ? 4 : 6;
+            } else {
+                vt = 4;  // never read: grammar validity is enough
+            }
             q = z;
         }
         if (ki >= 0) {  // last occurrence wins (json.loads semantics)
-            starts[(int64_t)ki * max_rows + row] = vs;
-            lens[(int64_t)ki * max_rows + row] = vl;
-            types[(int64_t)ki * max_rows + row] = vt;
+            starts[(int64_t)ki * stride + row] = vs;
+            lens[(int64_t)ki * stride + row] = vl;
+            types[(int64_t)ki * stride + row] = vt;
         }
         q = skip_ws(q, le);
         if (q < le && *q == ',') {
@@ -1202,210 +1937,6 @@ static int json_line_fast(const char *buf, const char *ls, const char *le,
     }
 }
 
-// Slow per-line machine: handles escapes; anything it cannot cleanly
-// type marks the row ambiguous (types = 6 across the board).
-static void json_line_slow(const char *buf, const char *ls, const char *le,
-                           const char *const *keys, const int32_t *key_lens,
-                           int32_t nkeys, int64_t max_rows, int64_t row,
-                           int32_t *starts, int32_t *lens, uint8_t *types) {
-    int bad = 0;
-    const char *q = ls;
-    if (*q != '{') {
-        bad = 1;  // non-object line (array/scalar): row engine wraps
-    } else {
-        ++q;
-        int depth = 1;
-        while (q < le && depth > 0 && !bad) {
-            char c = *q;
-            if (c == ' ' || c == '\t' || c == '\r') {
-                ++q;
-                continue;
-            }
-            if (c == '}') {
-                --depth;
-                ++q;
-                continue;
-            }
-            if (c != '"') {
-                bad = 1;
-                break;
-            }
-            // key string
-            const char *ks = q + 1;
-            const char *kq = ks;
-            int kesc = 0;
-            for (;;) {
-                const char *h = static_cast<const char *>(
-                    memchr(kq, '"', le - kq));
-                if (!h) {
-                    bad = 1;
-                    break;
-                }
-                int bs = 0;
-                const char *t = h - 1;
-                while (t >= ks && *t == '\\') {
-                    ++bs;
-                    --t;
-                }
-                if (bs % 2) {
-                    kesc = 1;
-                    kq = h + 1;
-                    continue;
-                }
-                kq = h;
-                break;
-            }
-            if (bad)
-                break;
-            if (kesc) {
-                bad = 1;  // escaped key text: let Python decide
-                break;
-            }
-            int32_t klen = (int32_t)(kq - ks);
-            q = skip_ws(kq + 1, le);
-            if (q >= le || *q != ':') {
-                bad = 1;
-                break;
-            }
-            q = skip_ws(q + 1, le);
-            if (q >= le) {
-                bad = 1;
-                break;
-            }
-            int ki = -1;
-            for (int32_t k = 0; k < nkeys; ++k)
-                if (key_lens[k] == klen &&
-                    memcmp(keys[k], ks, klen) == 0) {
-                    ki = k;
-                    break;
-                }
-            uint8_t vt = 6;
-            int32_t vs = (int32_t)(q - buf), vl = 0;
-            char v0 = *q;
-            if (v0 == '"') {
-                const char *ss = q + 1;
-                const char *sq = ss;
-                int sesc = 0;
-                for (;;) {
-                    const char *h = static_cast<const char *>(
-                        memchr(sq, '"', le - sq));
-                    if (!h) {
-                        bad = 1;
-                        break;
-                    }
-                    int bs = 0;
-                    const char *t = h - 1;
-                    while (t >= ss && *t == '\\') {
-                        ++bs;
-                        --t;
-                    }
-                    if (bs % 2) {
-                        sesc = 1;
-                        sq = h + 1;
-                        continue;
-                    }
-                    sq = h;
-                    break;
-                }
-                if (bad)
-                    break;
-                vt = sesc ? 6 : 5;
-                vs = (int32_t)(ss - buf);
-                vl = (int32_t)(sq - ss);
-                q = sq + 1;
-            } else if (v0 == '{' || v0 == '[') {
-                int d2 = 0;
-                int instr = 0;
-                const char *z = q;
-                while (z < le) {
-                    char c2 = *z;
-                    if (instr) {
-                        if (c2 == '\\') {
-                            z += 2;
-                            continue;
-                        }
-                        if (c2 == '"')
-                            instr = 0;
-                    } else if (c2 == '"') {
-                        instr = 1;
-                    } else if (c2 == '{' || c2 == '[') {
-                        ++d2;
-                    } else if (c2 == '}' || c2 == ']') {
-                        --d2;
-                        if (d2 == 0) {
-                            ++z;
-                            break;
-                        }
-                    }
-                    ++z;
-                }
-                if (d2 != 0) {
-                    bad = 1;
-                    break;
-                }
-                vt = 6;  // nested: Python semantics
-                vs = (int32_t)(q - buf);
-                vl = (int32_t)(z - q);
-                q = z;
-            } else if (v0 == 't' && le - q >= 4 &&
-                       memcmp(q, "true", 4) == 0) {
-                vt = 3;
-                vl = 4;
-                q += 4;
-            } else if (v0 == 'f' && le - q >= 5 &&
-                       memcmp(q, "false", 5) == 0) {
-                vt = 2;
-                vl = 5;
-                q += 5;
-            } else if (v0 == 'n' && le - q >= 4 &&
-                       memcmp(q, "null", 4) == 0) {
-                vt = 1;
-                vl = 4;
-                q += 4;
-            } else {
-                const char *z = q;
-                while (z < le && *z != ',' && *z != '}' && *z != ' ' &&
-                       *z != '\t' && *z != '\r')
-                    ++z;
-                double dummy;
-                vl = (int32_t)(z - q);
-                if (!parse_num(q, vl, &dummy)) {
-                    // invalid bare token OR >15-digit int: the row
-                    // engine either raises or parses exactly — replay
-                    bad = 1;
-                    break;
-                }
-                vt = 4;
-                q = z;
-            }
-            if (ki >= 0) {
-                starts[(int64_t)ki * max_rows + row] = vs;
-                lens[(int64_t)ki * max_rows + row] = vl;
-                types[(int64_t)ki * max_rows + row] = vt;
-            }
-            q = skip_ws(q, le);
-            if (q < le && *q == ',') {
-                ++q;
-                continue;
-            }
-            if (q < le && *q == '}') {
-                --depth;
-                ++q;
-                continue;
-            }
-            bad = 1;
-            break;
-        }
-        if (depth != 0)
-            bad = 1;
-        if (skip_ws(q, le) != le)
-            bad = 1;  // trailing junk after the closing brace
-    }
-    if (bad)
-        for (int32_t k = 0; k < nkeys; ++k)
-            types[(int64_t)k * max_rows + row] = 6;
-}
-
 // Returns rows scanned (complete lines; may stop early at max_rows with
 // *consumed marking the resume point).  Blank lines are skipped (row
 // engine skips them too).
@@ -1418,9 +1949,6 @@ int64_t sel_json_scan(const char *buf, int64_t len, int final_block,
     const char *p = buf, *end = buf + len;
     int64_t row = 0;
     *consumed = 0;
-    // one block-level probe: no backslash anywhere => every line takes
-    // the memchr-driven fast parser without per-line escape checks
-    const int bs_block = memchr(buf, '\\', len) != nullptr;
     while (p < end) {
         const char *nlp = find_byte(p, end, '\n');
         const char *nl = (nlp == end) ? nullptr : nlp;
@@ -1450,19 +1978,606 @@ int64_t sel_json_scan(const char *buf, int64_t len, int final_block,
         // lens are only read for types >= 4, so no prefill needed)
         row_start[row] = (int32_t)(ls - buf);
         row_len[row] = (int32_t)(le - ls);
-        int need_slow = 1;
-        if (!bs_block || memchr(ls, '\\', le - ls) == nullptr)
-            need_slow = json_line_fast(buf, ls, le, keys, key_lens, nkeys,
-                                       max_rows, row, starts, lens, types);
-        if (need_slow)
-            json_line_slow(buf, ls, le, keys, key_lens, nkeys,
-                           max_rows, row, starts, lens, types);
+        if (json_parse_line(buf, ls, le, keys, key_lens, nkeys,
+                            max_rows, row, starts, lens, types))
+            for (int32_t k = 0; k < nkeys; ++k)
+                types[(int64_t)k * max_rows + row] = 6;
         ++row;
         p = (nl ? nl + 1 : end);
         *consumed = p - buf;
     }
     row_start[row] = (int32_t)(*consumed);
     return row;
+}
+
+// Single-pass JSON number: strict JSON grammar (plus NaN/Infinity/
+// -Infinity) fused with parse_num's exact-value computation — one walk
+// where the array path pays three (token scan, grammar check, value
+// parse).  Returns 0 invalid, 4 with *out holding exactly the double
+// parse_num would produce, or 6 for valid-but-Python-decides tokens
+// (>15 significant digits, NaN/Infinity, parse_num's length cap).
+static inline int json_num_fwd(const char *s, const char *end,
+                               const char **zp, double *out) {
+    const char *p = s;
+    int neg = 0;
+    if (p < end && *p == 'N') {
+        if (end - p >= 3 && memcmp(p, "NaN", 3) == 0) {
+            *zp = p + 3;
+            return 6;
+        }
+        return 0;
+    }
+    if (p < end && *p == 'I') {
+        if (end - p >= 8 && memcmp(p, "Infinity", 8) == 0) {
+            *zp = p + 8;
+            return 6;
+        }
+        return 0;
+    }
+    if (p < end && *p == '-') {
+        neg = 1;
+        ++p;
+        if (p < end && *p == 'I') {
+            if (end - p >= 8 && memcmp(p, "Infinity", 8) == 0) {
+                *zp = p + 8;
+                return 6;
+            }
+            return 0;
+        }
+    }
+    if (p >= end || (unsigned char)(*p - '0') > 9)
+        return 0;
+    uint64_t mant = 0;
+    int digits = 0;
+    if (*p == '0') {
+        digits = 1;
+        ++p;
+        if (p < end && (unsigned char)(*p - '0') <= 9)
+            return 0;  // JSON forbids leading zeros
+    } else {
+        while (p < end && (unsigned char)(*p - '0') <= 9) {
+            mant = mant * 10 + (unsigned char)(*p - '0');
+            ++digits;
+            ++p;
+        }
+    }
+    int total = digits, exp10 = 0;
+    if (p < end && *p == '.') {
+        ++p;
+        if (p >= end || (unsigned char)(*p - '0') > 9)
+            return 0;  // JSON requires a digit after '.'
+        const char *fs = p;
+        while (p < end && (unsigned char)(*p - '0') <= 9) {
+            mant = mant * 10 + (unsigned char)(*p - '0');
+            ++p;
+        }
+        int fd = (int)(p - fs);
+        total += fd;
+        exp10 -= fd;
+    }
+    if (p < end && (*p == 'e' || *p == 'E')) {
+        ++p;
+        int eneg = 0;
+        if (p < end && (*p == '+' || *p == '-')) {
+            eneg = (*p == '-');
+            ++p;
+        }
+        if (p >= end || (unsigned char)(*p - '0') > 9)
+            return 0;
+        int ev = 0;
+        while (p < end && (unsigned char)(*p - '0') <= 9) {
+            ev = ev * 10 + (*p - '0');
+            if (ev > 400)
+                ev = 400;
+            ++p;
+        }
+        exp10 += eneg ? -ev : ev;
+    }
+    *zp = p;
+    if (p - s >= 63 || total > 15)
+        return 6;  // parse_num's caps: exact-int territory, replay
+    double v;
+    if (exp10 == 0) {
+        v = (double)mant;
+    } else if (exp10 > 0 && exp10 <= 22) {
+        v = (double)mant * POW10[exp10];
+    } else if (exp10 < 0 && exp10 >= -22) {
+        v = (double)mant / POW10[-exp10];
+    } else {
+        char tmp[64];
+        int n = (int)(p - s);
+        memcpy(tmp, s, n);
+        tmp[n] = 0;
+        char *ep = nullptr;
+        v = strtod(tmp, &ep);
+        if (ep != tmp + n)
+            return 6;
+        *out = v;  // strtod consumed the sign itself
+        return 4;
+    }
+    *out = neg ? -v : v;
+    return 4;
+}
+
+// Forward line parser for the fused JSON path: ONE walk that finds the
+// line end itself (no newline pre-scan), validates, extracts needed
+// keys, and caches exact numeric values in vnum[].  Returns 0 ok
+// (*next = just past the newline / end), 1 bad line (caller resyncs to
+// the next newline and replays), 2 incomplete (hit the block end
+// before the line ended and this is not the final block — the bytes
+// become the next block's tail).  A raw '\n' always ends the line: it
+// cannot legally appear inside a single-line JSON document, matching
+// how the row engine splits the stream.
+static int json_line_fwd(const char *buf, const char *ls, const char *end,
+                         int final_block, const char *const *keys,
+                         const int32_t *key_lens, int32_t nkeys,
+                         int32_t *vpos, int32_t *vlen, uint8_t *vtype,
+                         double *vnum, const char **next) {
+    const char *q = ls;
+    if (*q != '{')
+        return 1;  // non-object line (array/scalar): row engine wraps
+    ++q;
+    int first = 1;
+    for (;;) {
+        while (q < end && (*q == ' ' || *q == '\t' || *q == '\r'))
+            ++q;
+        if (q >= end)
+            return final_block ? 1 : 2;
+        if (first && *q == '}') {  // {} only: {"a":1,} is invalid JSON
+            ++q;
+            while (q < end && (*q == ' ' || *q == '\t' || *q == '\r'))
+                ++q;
+            if (q >= end) {
+                *next = end;
+                return final_block ? 0 : 2;
+            }
+            if (*q == '\n') {
+                *next = q + 1;
+                return 0;
+            }
+            return 1;
+        }
+        first = 0;
+        if (*q != '"')
+            return 1;
+        const char *ks = q + 1;
+        const char *kq = ks;
+        for (;;) {
+            const char *h = scan2(kq, end, '"', '\n');
+            if (h == end)
+                return final_block ? 1 : 2;
+            if (*h == '\n')
+                return 1;  // unterminated key on this line
+            int bs = 0;
+            const char *t = h - 1;
+            while (t >= ks && *t == '\\') {
+                ++bs;
+                --t;
+            }
+            if (bs % 2)
+                return 1;  // escaped key text: let Python decide
+            kq = h;
+            break;
+        }
+        int32_t klen = (int32_t)(kq - ks);
+        q = kq + 1;
+        while (q < end && (*q == ' ' || *q == '\t' || *q == '\r'))
+            ++q;
+        if (q >= end)
+            return final_block ? 1 : 2;
+        if (*q != ':')
+            return 1;
+        ++q;
+        while (q < end && (*q == ' ' || *q == '\t' || *q == '\r'))
+            ++q;
+        if (q >= end)
+            return final_block ? 1 : 2;
+        int ki = -1;
+        for (int32_t k = 0; k < nkeys; ++k)
+            if (key_lens[k] == klen &&
+                (klen == 0 || (keys[k][0] == ks[0] &&
+                               memcmp(keys[k], ks, klen) == 0))) {
+                ki = k;
+                break;
+            }
+        uint8_t vt;
+        int32_t vs = (int32_t)(q - buf), vl;
+        double vv = 0.0;
+        char v0 = *q;
+        if (v0 == '"') {
+            const char *ss = q + 1;
+            const char *sq = ss;
+            int sesc = 0;
+            for (;;) {
+                const char *h = scan2(sq, end, '"', '\n');
+                if (h == end)
+                    return final_block ? 1 : 2;
+                if (*h == '\n')
+                    return 1;  // raw newline in string: invalid JSON
+                int bs = 0;
+                const char *t = h - 1;
+                while (t >= ss && *t == '\\') {
+                    ++bs;
+                    --t;
+                }
+                if (bs % 2) {
+                    sesc = 1;
+                    sq = h + 1;
+                    continue;
+                }
+                sq = h;
+                break;
+            }
+            vt = sesc ? 6 : 5;  // escaped value: Python semantics
+            vs = (int32_t)(ss - buf);
+            vl = (int32_t)(sq - ss);
+            q = sq + 1;
+        } else if (v0 == '{' || v0 == '[') {
+            int d = 0, instr = 0;
+            const char *z = q;
+            while (z < end) {
+                char c = *z;
+                if (c == '\n')
+                    return 1;  // line ends inside the nested value
+                if (instr) {
+                    if (c == '\\') {
+                        z += 2;
+                        continue;
+                    }
+                    if (c == '"')
+                        instr = 0;
+                } else if (c == '"') {
+                    instr = 1;
+                } else if (c == '{' || c == '[') {
+                    ++d;
+                } else if (c == '}' || c == ']') {
+                    --d;
+                    if (d == 0) {
+                        ++z;
+                        break;
+                    }
+                }
+                ++z;
+            }
+            if (d != 0)
+                return final_block ? 1 : 2;
+            vt = 6;  // nested value: Python semantics if needed
+            vl = (int32_t)(z - q);
+            q = z;
+        } else if (v0 == 't') {
+            if (end - q < 4 || memcmp(q, "true", 4) != 0)
+                return (end - q < 4 && !final_block) ? 2 : 1;
+            vt = 3;
+            vl = 4;
+            q += 4;
+        } else if (v0 == 'f') {
+            if (end - q < 5 || memcmp(q, "false", 5) != 0)
+                return (end - q < 5 && !final_block) ? 2 : 1;
+            vt = 2;
+            vl = 5;
+            q += 5;
+        } else if (v0 == 'n') {
+            if (end - q < 4 || memcmp(q, "null", 4) != 0)
+                return (end - q < 4 && !final_block) ? 2 : 1;
+            vt = 1;
+            vl = 4;
+            q += 4;
+        } else {
+            const char *z;
+            int r = json_num_fwd(q, end, &z, &vv);
+            if (r == 0)
+                return 1;
+            if (z == end && !final_block)
+                return 2;  // the number may continue in the next block
+            vt = (uint8_t)r;
+            vl = (int32_t)(z - q);
+            q = z;
+        }
+        if (ki >= 0) {  // last occurrence wins (json.loads semantics)
+            vpos[ki] = vs;
+            vlen[ki] = vl;
+            vtype[ki] = vt;
+            vnum[ki] = vv;
+        }
+        while (q < end && (*q == ' ' || *q == '\t' || *q == '\r'))
+            ++q;
+        if (q >= end)
+            return final_block ? 1 : 2;
+        if (*q == ',') {
+            ++q;
+            continue;
+        }
+        if (*q == '}') {
+            ++q;
+            while (q < end && (*q == ' ' || *q == '\t' || *q == '\r'))
+                ++q;
+            if (q >= end) {
+                *next = end;
+                return final_block ? 0 : 2;
+            }
+            if (*q == '\n') {
+                *next = q + 1;
+                return 0;
+            }
+            return 1;
+        }
+        return 1;
+    }
+}
+
+// Fused one-pass NDJSON aggregate scan: per-line parse + WHERE program
+// + aggregate fold without materializing per-key index arrays.  Same
+// leaf/program encoding as sel_csv_agg_fused, with the JSON leaf
+// evaluators (kind 0 cmp takes lf_isnum instead of splitting num/str).
+// A structurally bad line bumps *amb_out (the whole consumed span
+// replays so the row engine can raise in record order).
+static int64_t json_agg_fused_part(
+    const char *buf, int64_t len, int final_block,
+    const char *const *keys, const int32_t *key_lens, int32_t nkeys,
+    int32_t nleaves, const int32_t *lf_kind, const int32_t *lf_slot,
+    const int32_t *lf_op, const int32_t *lf_isnum, const int32_t *lf_fn,
+    const int32_t *lf_fa, const int32_t *lf_fb, const double *lf_num,
+    const int32_t *lf_aoff, const int32_t *lf_alen, const char *blob,
+    const unsigned char *likemask, const int32_t *prog, int32_t prog_len,
+    const int32_t *expr_codes, const double *expr_ops,
+    int32_t naggs, const int32_t *agg_what, const int32_t *agg_slot,
+    int64_t *agg_count, double *agg_sum, double *agg_min, double *agg_max,
+    int32_t *agg_minpos, int32_t *agg_minlen,
+    int32_t *agg_maxpos, int32_t *agg_maxlen,
+    int64_t *rows_out, int64_t *amb_out, int64_t *consumed) {
+    int32_t vpos[FUSED_MAX_COLS], vlen[FUSED_MAX_COLS];
+    uint8_t vtype[FUSED_MAX_COLS];
+    double vnum[FUSED_MAX_COLS];
+    char scratch[FN_SCRATCH];
+    int64_t row = 0, amb = 0;
+    for (int32_t a = 0; a < naggs; ++a) {
+        agg_count[a] = 0;
+        agg_sum[a] = 0.0;
+        agg_min[a] = agg_max[a] = 0.0;
+        agg_minpos[a] = agg_maxpos[a] = 0;
+        agg_minlen[a] = agg_maxlen[a] = -1;
+    }
+    // common-shape specialization (COUNT(*) with at most one numeric
+    // comparison leaf): per-line work collapses to a cached-value
+    // compare + increment, no program interpreter
+    const int count_star_only = (naggs == 1 && agg_slot[0] < 0);
+    const int simple_cmp =
+        nleaves == 1 && prog_len == 1 && lf_kind[0] == 0 &&
+        lf_fn[0] == 0 && lf_isnum[0] == 1;
+    const int s_opmask = simple_cmp ? OPMASK[lf_op[0]] : 0;
+    const double s_num = simple_cmp ? lf_num[0] : 0.0;
+    const int32_t s_slot = simple_cmp ? lf_slot[0] : 0;
+
+    auto eval_line_slow = [&]() __attribute__((noinline)) {
+        int ok = 1;
+        if (nleaves) {
+            uint8_t st[FUSED_MAX_STACK];
+            int sp = 0;
+            for (int32_t pi = 0; pi < prog_len; ++pi) {
+                int32_t e = prog[pi];
+                if (e >= 0) {
+                    const int32_t sl = lf_slot[e];
+                    const char *s = buf + vpos[sl];
+                    const int32_t l = vlen[sl];
+                    const uint8_t t = vtype[sl];
+                    int r;
+                    switch (lf_kind[e]) {
+                    case 0:
+                        if (t == 4 && lf_isnum[e] &&
+                            lf_fn[e] == FN_NONE) {
+                            // exact value cached by the line parser
+                            const double v = vnum[sl];
+                            const int c = (v > lf_num[e]) -
+                                          (v < lf_num[e]);
+                            r = (OPMASK[lf_op[e]] >> (c + 1)) & 1;
+                            break;
+                        }
+                        r = cell_json_cmp(
+                            s, l, t, lf_op[e], OPMASK[lf_op[e]],
+                            lf_num[e], lf_isnum[e], blob + lf_aoff[e],
+                            lf_alen[e], lf_fn[e], lf_fa[e], lf_fb[e],
+                            scratch, &amb);
+                        break;
+                    case 2:
+                        r = cell_json_like(
+                            s, l, t, blob + lf_aoff[e], lf_alen[e],
+                            likemask + lf_aoff[e], lf_fn[e], lf_fa[e],
+                            lf_fb[e], scratch, &amb);
+                        break;
+                    case 3:
+                        r = cell_json_isnull(l, t, &amb);
+                        break;
+                    case 4:
+                        r = (t != 0 && t != 1);
+                        break;
+                    default:  // 5: expr program
+                        r = cell_json_cmp_expr(
+                            s, l, t, OPMASK[lf_op[e]], lf_num[e],
+                            expr_codes + lf_aoff[e],
+                            expr_ops + lf_aoff[e], lf_alen[e], &amb);
+                    }
+                    st[sp++] = (uint8_t)r;
+                } else if (e == -1) {
+                    st[sp - 2] &= st[sp - 1];
+                    --sp;
+                } else if (e == -2) {
+                    st[sp - 2] |= st[sp - 1];
+                    --sp;
+                } else {
+                    st[sp - 1] ^= 1;
+                }
+            }
+            ok = st[0];
+        }
+        if (!ok || amb)
+            return;
+        for (int32_t a = 0; a < naggs; ++a) {
+            const int32_t sl = agg_slot[a];
+            if (sl < 0) {  // COUNT(*)
+                ++agg_count[a];
+                continue;
+            }
+            const uint8_t t = vtype[sl];
+            const int32_t l = vlen[sl];
+            if (t == 0 || t == 1)
+                continue;  // missing/null
+            if (t == 5 && l == 0)
+                continue;  // "" skipped like CSV empty
+            if (t == 6 || t == 2 || t == 3) {
+                ++amb;
+                continue;
+            }
+            if (agg_what[a] == 0) {
+                ++agg_count[a];
+                continue;
+            }
+            double v;
+            if (t == 4) {
+                v = vnum[sl];
+            } else if (!parse_num(buf + vpos[sl], l, &v)) {
+                ++amb;
+                continue;
+            }
+            ++agg_count[a];
+            if (agg_what[a] == 1) {
+                agg_sum[a] += v;
+            } else {
+                if (agg_minlen[a] < 0 || v < agg_min[a]) {
+                    agg_min[a] = v;
+                    agg_minpos[a] = vpos[sl];
+                    agg_minlen[a] = l;
+                }
+                if (agg_maxlen[a] < 0 || v > agg_max[a]) {
+                    agg_max[a] = v;
+                    agg_maxpos[a] = vpos[sl];
+                    agg_maxlen[a] = l;
+                }
+            }
+        }
+    };
+
+    const char *p = buf, *end = buf + len;
+    int64_t cons = 0;  // local: a per-line store through the out
+    // pointer would be an aliasing barrier in this loop
+    while (p < end) {
+        const char *q = p;
+        while (q < end && (*q == ' ' || *q == '\t' || *q == '\r'))
+            ++q;
+        if (q >= end) {
+            if (final_block)
+                cons = len;  // trailing whitespace only
+            break;
+        }
+        if (*q == '\n') {  // blank line: skipped like the row engine
+            p = q + 1;
+            cons = p - buf;
+            continue;
+        }
+        int st;
+        const char *nx = end;
+        if (!amb) {  // once ambiguous the span replays: resync only
+            for (int32_t k = 0; k < nkeys; ++k)
+                vtype[k] = 0;
+            st = json_line_fwd(buf, q, end, final_block, keys, key_lens,
+                               nkeys, vpos, vlen, vtype, vnum, &nx);
+        } else {
+            st = 1;
+        }
+        if (st == 2)
+            break;  // incomplete trailing line: next block's tail
+        if (st == 1) {
+            // bad (or post-ambiguity resync): the line replays — but
+            // only once it is COMPLETE in this block
+            const char *nl = find_byte(q, end, '\n');
+            if (nl == end) {
+                if (!final_block)
+                    break;  // reparse whole line next block
+                nx = end;
+            } else {
+                nx = nl + 1;
+            }
+            ++amb;
+        } else {
+            if (count_star_only && nleaves == 0)
+                ++agg_count[0];
+            else if (count_star_only && simple_cmp) {
+                const uint8_t t = vtype[s_slot];
+                if (t == 4) {
+                    const double v = vnum[s_slot];
+                    const int c = (v > s_num) - (v < s_num);
+                    agg_count[0] += (s_opmask >> (c + 1)) & 1;
+                } else {
+                    agg_count[0] += cell_json_cmp(
+                        buf + vpos[s_slot], vlen[s_slot], t, lf_op[0],
+                        s_opmask, s_num, 1, blob + lf_aoff[0],
+                        lf_alen[0], 0, 0, 0, scratch, &amb) && !amb;
+                }
+            } else {
+                eval_line_slow();
+            }
+        }
+        ++row;
+        p = nx;
+        cons = p - buf;
+    }
+    *consumed = cons;
+    *rows_out = row;
+    *amb_out = amb;
+    return row;
+}
+
+int64_t sel_json_agg_fused(
+    const char *buf, int64_t len, int final_block,
+    const char *const *keys, const int32_t *key_lens, int32_t nkeys,
+    int32_t nleaves, const int32_t *lf_kind, const int32_t *lf_slot,
+    const int32_t *lf_op, const int32_t *lf_isnum, const int32_t *lf_fn,
+    const int32_t *lf_fa, const int32_t *lf_fb, const double *lf_num,
+    const int32_t *lf_aoff, const int32_t *lf_alen, const char *blob,
+    const unsigned char *likemask, const int32_t *prog, int32_t prog_len,
+    const int32_t *expr_codes, const double *expr_ops,
+    int32_t naggs, const int32_t *agg_what, const int32_t *agg_slot,
+    int64_t *agg_count, double *agg_sum, double *agg_min, double *agg_max,
+    int32_t *agg_minpos, int32_t *agg_minlen,
+    int32_t *agg_maxpos, int32_t *agg_maxlen,
+    int64_t *rows_out, int64_t *amb_out, int64_t *consumed) {
+    const int T = fused_threads();
+    if (T > 1 && len >= (1 << 20) && naggs <= FUSED_MAX_AGGS) {
+        int64_t cut[FUSED_MAX_THREADS + 1];
+        const int nt = fused_cuts(buf, len, T, cut);
+        if (nt > 1) {
+            FusedPart parts[FUSED_MAX_THREADS];
+            auto runp = [&](int pi, int fin) {
+                FusedPart &P = parts[pi];
+                json_agg_fused_part(
+                    buf + cut[pi], cut[pi + 1] - cut[pi], fin, keys,
+                    key_lens, nkeys, nleaves, lf_kind, lf_slot, lf_op,
+                    lf_isnum, lf_fn, lf_fa, lf_fb, lf_num, lf_aoff,
+                    lf_alen, blob, likemask, prog, prog_len, expr_codes,
+                    expr_ops, naggs, agg_what, agg_slot, P.cnt, P.sum,
+                    P.mn, P.mx, P.mnp, P.mnl, P.mxp, P.mxl, &P.rows,
+                    &P.amb, &P.cons);
+            };
+            std::thread th[FUSED_MAX_THREADS];
+            for (int pi = 1; pi < nt; ++pi)
+                th[pi] = std::thread(runp, pi,
+                                     pi == nt - 1 ? final_block : 0);
+            runp(0, 0);
+            for (int pi = 1; pi < nt; ++pi)
+                th[pi].join();
+            fused_merge(parts, cut, nt, naggs, agg_count, agg_sum,
+                        agg_min, agg_max, agg_minpos, agg_minlen,
+                        agg_maxpos, agg_maxlen, rows_out, amb_out);
+            *consumed = cut[nt - 1] + parts[nt - 1].cons;
+            return *rows_out;
+        }
+    }
+    return json_agg_fused_part(
+        buf, len, final_block, keys, key_lens, nkeys, nleaves, lf_kind,
+        lf_slot, lf_op, lf_isnum, lf_fn, lf_fa, lf_fb, lf_num, lf_aoff,
+        lf_alen, blob, likemask, prog, prog_len, expr_codes, expr_ops,
+        naggs, agg_what, agg_slot, agg_count, agg_sum, agg_min, agg_max,
+        agg_minpos, agg_minlen, agg_maxpos, agg_maxlen, rows_out,
+        amb_out, consumed);
 }
 
 // JSON numeric-literal comparison: number cells (type 4) and
@@ -1478,84 +2593,10 @@ int64_t sel_json_cmp(const char *buf, const int32_t *starts,
     int64_t amb = 0;
     char scratch[FN_SCRATCH];
     const int opmask = OPMASK[op];
-    for (int64_t i = 0; i < n; ++i) {
-        uint8_t t = types[i];
-        if (t == 0 || t == 1) {  // missing/null: compare is false
-            mask[i] = 0;
-            continue;
-        }
-        if (t == 6 || t == 2 || t == 3) {  // ambiguous or bool
-            mask[i] = 0;
-            ++amb;
-            continue;
-        }
-        const char *s = buf + starts[i];
-        int32_t l = lens[i];
-        if (fn != FN_NONE) {
-            if (t != 5) {  // fn over a number cell: str() rendering
-                mask[i] = 0;
-                ++amb;
-                continue;
-            }
-            if (fn == FN_CHARLEN) {
-                if (!all_ascii(s, l)) {
-                    mask[i] = 0;
-                    ++amb;
-                    continue;
-                }
-                if (lit_is_num) {
-                    int c = ((double)l > num_lit) - ((double)l < num_lit);
-                    mask[i] = (uint8_t)((opmask >> (c + 1)) & 1);
-                } else {
-                    int32_t nl = (int32_t)snprintf(scratch, 16, "%d", l);
-                    mask[i] = (uint8_t)cmp_ok(
-                        op, bytes_cmp(scratch, nl, str_lit, str_len));
-                }
-                continue;
-            }
-            if (l > FN_SCRATCH) {
-                mask[i] = 0;
-                ++amb;
-                continue;
-            }
-            int32_t nl = apply_fn(fn, s, l, scratch, fn_a, fn_b);
-            if (nl < 0) {
-                mask[i] = 0;
-                ++amb;
-                continue;
-            }
-            s = scratch;
-            l = nl;
-        }
-        if (t == 4) {  // fn != NONE already continued above for t != 5
-            if (!lit_is_num) {  // text compare of number cell: rendering
-                mask[i] = 0;
-                ++amb;
-                continue;
-            }
-            double v;
-            if (!parse_num(s, l, &v)) {  // huge ints etc.
-                mask[i] = 0;
-                ++amb;
-                continue;
-            }
-            int c = v < num_lit ? -1 : (v > num_lit ? 1 : 0);
-            mask[i] = (uint8_t)cmp_ok(op, c);
-            continue;
-        }
-        // string cell
-        double v;
-        if (lit_is_num && parse_num(s, l, &v)) {
-            int c = v < num_lit ? -1 : (v > num_lit ? 1 : 0);
-            mask[i] = (uint8_t)cmp_ok(op, c);
-        } else if (lit_is_num && num_ambiguous(s, l)) {
-            mask[i] = 0;
-            ++amb;
-        } else {
-            mask[i] = (uint8_t)cmp_ok(op, bytes_cmp(s, l, str_lit,
-                                                    str_len));
-        }
-    }
+    for (int64_t i = 0; i < n; ++i)
+        mask[i] = (uint8_t)cell_json_cmp(
+            buf + starts[i], lens[i], types[i], op, opmask, num_lit,
+            lit_is_num, str_lit, str_len, fn, fn_a, fn_b, scratch, &amb);
     return amb;
 }
 
@@ -1568,36 +2609,10 @@ int64_t sel_json_like(const char *buf, const int32_t *starts,
                  int32_t fn_a, int32_t fn_b) {
     int64_t amb = 0;
     char scratch[FN_SCRATCH];
-    for (int64_t i = 0; i < n; ++i) {
-        uint8_t t = types[i];
-        if (t == 0 || t == 1) {
-            mask[i] = 0;
-            continue;
-        }
-        if (t != 5) {
-            mask[i] = 0;
-            ++amb;
-            continue;
-        }
-        const char *s = buf + starts[i];
-        int32_t l = lens[i];
-        if (fn != FN_NONE) {
-            if (l > FN_SCRATCH || fn == FN_CHARLEN) {
-                mask[i] = 0;
-                ++amb;
-                continue;
-            }
-            int32_t nl = apply_fn(fn, s, l, scratch, fn_a, fn_b);
-            if (nl < 0) {
-                mask[i] = 0;
-                ++amb;
-                continue;
-            }
-            s = scratch;
-            l = nl;
-        }
-        mask[i] = (uint8_t)like_match(s, l, pat, pat_len, lit);
-    }
+    for (int64_t i = 0; i < n; ++i)
+        mask[i] = (uint8_t)cell_json_like(
+            buf + starts[i], lens[i], types[i], pat, pat_len, lit, fn,
+            fn_a, fn_b, scratch, &amb);
     return amb;
 }
 
@@ -1615,15 +2630,8 @@ void sel_json_valid(const uint8_t *types, int64_t n, uint8_t *mask) {
 int64_t sel_json_isnull(const int32_t *lens, const uint8_t *types,
                         int64_t n, uint8_t *mask) {
     int64_t amb = 0;
-    for (int64_t i = 0; i < n; ++i) {
-        if (types[i] == 6) {
-            mask[i] = 0;
-            ++amb;
-            continue;
-        }
-        mask[i] = types[i] == 0 || types[i] == 1 ||
-                  (types[i] == 5 && lens[i] == 0);
-    }
+    for (int64_t i = 0; i < n; ++i)
+        mask[i] = (uint8_t)cell_json_isnull(lens[i], types[i], &amb);
     return amb;
 }
 

@@ -20,6 +20,11 @@ from .sql import Evaluator, SQLError, parse
 # (maxRecordSize/bufferSize in internal/s3select)
 FLUSH = 256 << 10
 
+# residual-tier observability: queries that fell through every
+# accelerated tier to the per-record interpreter, and the bytes they
+# scanned (a row-tier query reads the whole object)
+row_stats = {"queries": 0, "bytes": 0}
+
 
 class SelectRequest:
     """Parsed SelectObjectContentRequest XML."""
@@ -119,13 +124,15 @@ def run_select(req: SelectRequest, stream,
     Evaluator(query)
     out = _make_output(req)
 
-    # three-tier engine (fastest first, each falling through when the
+    # tiered engine (fastest first, each falling through when the
     # query/data shape is out of its scope):
     #  1. native C++ block scan (csrc/select_scan.cpp — the simdjson/
     #     simd-CSV analogue, internal/s3select/simdj/reader.go:27)
     #  2. pyarrow columnar (vectorized masks over arrow batches)
-    #  3. the row engine below (full SQL surface)
-    from . import columnar, native
+    #  3. compiled row programs (select/batch.py — numpy batch
+    #     evaluation of residual plans, interpreter per doubtful block)
+    #  4. the per-record interpreter below (full SQL surface)
+    from . import batch, columnar, native
 
     rw = columnar.Rewindable(stream)
     fast = native.try_native(req, query, rw, object_size, out)
@@ -136,8 +143,14 @@ def run_select(req: SelectRequest, stream,
     if fast is not None:
         yield from fast
         return
+    fast = batch.try_batch(req, query, rw, object_size, out)
+    if fast is not None:
+        yield from fast
+        return
     # fallback: replay the probed prefix, then stream WITHOUT recording —
     # the row engine must not accumulate the whole object in memory
+    row_stats["queries"] += 1
+    row_stats["bytes"] += object_size
     rw.stop_recording()
     reader = _make_input(req, rw)
     yield from row_engine_stream(reader, query, out, object_size,

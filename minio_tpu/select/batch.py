@@ -1,0 +1,1116 @@
+"""Compiled residual row engine: numpy batch programs for the row tier.
+
+The interpreter in sql.Evaluator walks the AST per record; for the
+residual tier (queries the native/columnar tiers decline, or builds
+without the native library) that walk IS the scan cost — on narrow rows
+even csv.reader alone costs more per byte than the letter target
+allows.  This module compiles the residual plan once into numpy batch
+programs executed over blocks: structural CSV parsing with
+np.flatnonzero over the raw bytes, cell decoding through right/left-
+aligned digit matrices, predicate masks from vectorized compares, and
+projection gathers emitting row slices — the reference analogue is the
+batch evaluator behind internal/s3select/sql/statement.go.
+
+Exactness contract (the same shape as the native tier's ambiguity
+replay, one level up): a block is vectorized only when every byte of it
+provably evaluates exactly as the interpreter would — quote-free,
+\r-free, column-regular CSV with clean integer cells; JSON LINES whose
+referenced columns are type-uniform ints/floats/strings.  Any doubt
+(odd cells, ragged rows, >2^53 integers, fractional SUMs whose pairwise
+summation could differ in the last ulp) drops that block — or just the
+doubtful rows — to the compiled-closure interpreter, so output stays
+byte-identical to sql.Evaluator, errors included.
+
+Disable with MINIO_TPU_SELECT_BATCH=0 (the differential tests do, to
+keep the pure interpreter as the reference).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import re
+from typing import Iterator
+
+import numpy as np
+
+from . import eventstream as es
+# shared with the native tier (same _Fallback class, so helper raises
+# propagate correctly): request parsing, aggregate shapes/commit, and
+# the header reader — one implementation, no drift between tiers
+from .native import (_Fallback, _agg_shape, _alias_strip, _commit_agg,
+                     _csv_opts, _read_header)
+from .records import _decomp
+from .sql import (Between, Bin, Col, Evaluator, InList, IsNull, Like,
+                  Lit, Query, SQLError, Un, _num, compile_predicate,
+                  compile_projection)
+
+CHUNK = 4 << 20
+FLUSH = 256 << 10
+MAX_W = 32          # cells wider than this take the per-row path
+BIG = float(1 << 53)
+
+stats = {"batch": 0, "fallback": 0, "interp_blocks": 0, "bytes": 0}
+
+
+def _enabled() -> bool:
+    return os.environ.get("MINIO_TPU_SELECT_BATCH", "1") != "0"
+
+
+class _InterpBlock(Exception):
+    """Data shape doubt inside one block: that block replays through
+    the compiled-closure interpreter (exactness preserved)."""
+
+
+def _lit_ok(v) -> bool:
+    if v is None or isinstance(v, bool):
+        return False
+    if isinstance(v, int) and abs(v) >= 2**53:
+        return False
+    return isinstance(v, (int, float, str))
+
+
+_OPS = {"=": 0, "==": 0, "!=": 1, "<>": 1, "<": 2, "<=": 3, ">": 4,
+        ">=": 5}
+_FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+
+
+def _apply_op(op: int, cmp3):
+    """3-way compare array (-1/0/1) -> bool mask for op code."""
+    if op == 0:
+        return cmp3 == 0
+    if op == 1:
+        return cmp3 != 0
+    if op == 2:
+        return cmp3 < 0
+    if op == 3:
+        return cmp3 <= 0
+    if op == 4:
+        return cmp3 > 0
+    return cmp3 >= 0
+
+
+def _num_mask(op: int, vals, lit: float):
+    if op == 0:
+        return vals == lit
+    if op == 1:
+        return vals != lit
+    if op == 2:
+        return vals < lit
+    if op == 3:
+        return vals <= lit
+    if op == 4:
+        return vals > lit
+    return vals >= lit
+
+
+# ---------------------------------------------------------- CSV blocks
+
+
+class _CsvBlock:
+    """One quote-free, \r-free, column-regular CSV block parsed with
+    numpy: separator positions via flatnonzero, per-column cell bounds
+    via a gathered delimiter matrix, numeric/text cell views decoded
+    through alignment matrices.  `bad` collects rows any leaf could not
+    decide exactly; those re-evaluate through the interpreter."""
+
+    def __init__(self, data: bytes, delim: int):
+        self.data = data
+        a = np.frombuffer(data, dtype=np.uint8)
+        self.arr = a
+        nl = np.flatnonzero(a == 10)
+        rs = np.empty(len(nl), dtype=np.int64)
+        if len(nl):
+            rs[0] = 0
+            rs[1:] = nl[:-1] + 1
+        re_ = nl.astype(np.int64)
+        keep = re_ > rs  # blank records: csv.reader skips them
+        self.rs = rs[keep]
+        self.re = re_[keep]
+        self.n = len(self.rs)
+        self.bad = np.zeros(self.n, dtype=bool)
+        self._bounds: dict = {}
+        self._nums: dict = {}
+        self._ncols = -1
+        dl = np.flatnonzero(a == delim)
+        if self.n:
+            di0 = np.searchsorted(dl, self.rs)
+            di1 = np.searchsorted(dl, self.re)
+            nd = di1 - di0
+            if (nd != nd[0]).any():
+                raise _InterpBlock("ragged rows")
+            ndel = int(nd[0])
+            self._ncols = ndel + 1
+            self._D = (dl[di0[:, None] + np.arange(ndel)]
+                       if ndel else np.empty((self.n, 0), dtype=np.int64))
+
+    @property
+    def ncols(self) -> int:
+        return self._ncols
+
+    def bounds(self, j: int):
+        """(cell_start, cell_end) int64 arrays for column j, or None
+        when the column does not exist in this block."""
+        if self.n == 0 or j >= self._ncols:
+            return None
+        if j not in self._bounds:
+            ndel = self._ncols - 1
+            cs = self.rs if j == 0 else self._D[:, j - 1] + 1
+            ce = self._D[:, j] if j < ndel else self.re
+            self._bounds[j] = (cs, ce)
+        return self._bounds[j]
+
+    def nums(self, j: int):
+        """(float64 values, exact bool) for column j: clean [-]?digit
+        cells of <= 15 digits decode exactly through a right-aligned
+        digit matrix; everything else (floats, text, empties, huge
+        ints) is not-exact and takes the per-row path."""
+        if j in self._nums:
+            return self._nums[j]
+        cs, ce = self.bounds(j)
+        w = ce - cs
+        a = self.arr
+        neg = np.zeros(self.n, dtype=bool)
+        has = w > 0
+        idx0 = np.where(has, cs, 0)
+        neg[has] = a[idx0[has]] == 45  # '-'
+        ds = cs + neg  # first digit
+        dw = ce - ds
+        ok = has & (dw > 0) & (dw <= 15)
+        okw = dw[ok]
+        maxw = int(okw.max()) if len(okw) else 0
+        vals = np.zeros(self.n, dtype=np.float64)
+        if maxw:
+            # right-aligned window: positions before the cell read as 0
+            idx = ce[:, None] - maxw + np.arange(maxw)
+            valid = idx >= ds[:, None]
+            digits = a[np.clip(idx, 0, len(a) - 1)].astype(np.int64) - 48
+            digits[~valid] = 0
+            bad_digit = ((digits < 0) | (digits > 9)) & valid
+            ok &= ~bad_digit.any(axis=1)
+            pow10 = (10 ** np.arange(maxw - 1, -1, -1)).astype(np.int64)
+            ivals = digits @ pow10
+            vals = ivals.astype(np.float64)
+            vals[neg] = -vals[neg]
+        self._nums[j] = (vals, ok)
+        return self._nums[j]
+
+    def texts(self, j: int):
+        """(left-aligned char matrix padded with -1, exact bool): ASCII
+        cells of <= MAX_W bytes; -1 padding makes shorter-is-less
+        lexicographic compares match Python's."""
+        key = ("t", j)
+        if key in self._nums:
+            return self._nums[key]
+        cs, ce = self.bounds(j)
+        w = ce - cs
+        ok = w <= MAX_W
+        maxw = MAX_W
+        idx = cs[:, None] + np.arange(maxw)
+        valid = idx < ce[:, None]
+        chars = self.arr[np.clip(idx, 0, len(self.arr) - 1)].astype(
+            np.int16)
+        chars[~valid] = -1
+        ok &= ~((chars >= 0x80) & valid).any(axis=1)  # non-ASCII: Python
+        self._nums[key] = (chars, ok)
+        return self._nums[key]
+
+    def cell(self, j: int, i: int):
+        b = self.bounds(j)
+        if b is None:
+            return None
+        cs, ce = b
+        return self.data[int(cs[i]):int(ce[i])].decode("utf-8",
+                                                       "replace")
+
+
+def _text_cmp3(chars, lit: bytes):
+    """Row-wise 3-way lexicographic compare of the padded char matrix
+    against a literal (padded the same way)."""
+    lb = np.full(chars.shape[1], -1, dtype=np.int16)
+    enc = np.frombuffer(lit, dtype=np.uint8).astype(np.int16)
+    lb[:len(enc)] = enc
+    diff = chars - lb[None, :]
+    nz = diff != 0
+    any_ = nz.any(axis=1)
+    first = nz.argmax(axis=1)
+    d = diff[np.arange(len(chars)), first]
+    return np.where(any_, np.sign(d), 0)
+
+
+class _CsvWhere:
+    """WHERE AST -> fn(block) -> bool mask; rows any leaf marks bad are
+    re-decided by the interpreter afterwards."""
+
+    def __init__(self, where, resolve):
+        self.fn = self._comp(where, resolve) if where is not None else None
+
+    def _leaf_cmp(self, j, op: str, lit):
+        opc = _OPS[op]
+        nlit = _num(lit)
+        is_num = isinstance(nlit, (int, float)) and not isinstance(
+            nlit, bool)
+        if is_num:
+            flit = float(nlit)
+
+            def leaf(blk):
+                if blk.bounds(j) is None:
+                    return np.zeros(blk.n, dtype=bool)
+                vals, ok = blk.nums(j)
+                m = _num_mask(opc, vals, flit)
+                m &= ok
+                blk.bad |= ~ok
+                return m
+            return leaf
+        lb = str(lit).encode()
+        if len(lb) > MAX_W:
+            raise _Fallback("long literal")
+
+        def leaf(blk):
+            if blk.bounds(j) is None:
+                return np.zeros(blk.n, dtype=bool)
+            chars, ok = blk.texts(j)
+            m = _apply_op(opc, _text_cmp3(chars, lb))
+            m &= ok
+            blk.bad |= ~ok
+            return m
+        return leaf
+
+    def _leaf_like(self, j, pat: str, esc, negate: bool):
+        # vectorize the three byte-anchorable shapes; other patterns
+        # (embedded %/_, escapes) take the per-row path wholesale
+        if esc is not None or "_" in pat:
+            raise _Fallback("LIKE shape")
+        body = pat.strip("%")
+        if "%" in body or not body.isascii() or len(body) > MAX_W:
+            raise _Fallback("LIKE shape")
+        kind = ("eq" if "%" not in pat else
+                "prefix" if pat == body + "%" else
+                "suffix" if pat == "%" + body else
+                "contains" if pat == "%" + body + "%" else None)
+        if kind is None or kind == "contains":
+            raise _Fallback("LIKE shape")
+        bb = body.encode()
+
+        def leaf(blk):
+            b = blk.bounds(j)
+            if b is None:
+                return np.zeros(blk.n, dtype=bool)
+            cs, ce = b
+            w = ce - cs
+            chars, ok = blk.texts(j)
+            n = len(bb)
+            enc = np.frombuffer(bb, dtype=np.uint8).astype(np.int16)
+            if kind == "eq":
+                m = (w == n) & (chars[:, :max(n, 1)] ==
+                                (enc[None, :] if n else -1)).all(axis=1) \
+                    if n else (w == 0)
+            elif kind == "prefix":
+                m = (w >= n) & (chars[:, :n] == enc[None, :]).all(axis=1) \
+                    if n else w >= 0
+            else:  # suffix: right-align via gather
+                idx = ce[:, None] - n + np.arange(n)
+                valid = idx >= cs[:, None]
+                tailc = blk.arr[np.clip(idx, 0, len(blk.arr) - 1)].astype(
+                    np.int16)
+                tailc[~valid] = -1
+                m = (w >= n) & (tailc == enc[None, :]).all(axis=1) \
+                    if n else w >= 0
+            m &= ok
+            blk.bad |= ~ok
+            # null is impossible here (column-regular block), so NOT
+            # LIKE is a plain complement
+            return ~m if negate else m
+        return leaf
+
+    def _comp(self, e, resolve):
+        if isinstance(e, Un):
+            if e.op != "not":
+                raise _Fallback("unary " + e.op)
+            inner = self._comp(e.e, resolve)
+            return lambda blk: ~inner(blk)
+        if isinstance(e, Bin) and e.op in ("and", "or"):
+            lf, rf = self._comp(e.l, resolve), self._comp(e.r, resolve)
+            if e.op == "and":
+                return lambda blk: lf(blk) & rf(blk)
+            return lambda blk: lf(blk) | rf(blk)
+        if isinstance(e, Like):
+            if not (isinstance(e.e, Col) and isinstance(e.pat, Lit)
+                    and isinstance(e.pat.v, str)
+                    and (e.esc is None or isinstance(e.esc, Lit))):
+                raise _Fallback("LIKE shape")
+            return self._leaf_like(
+                resolve(e.e.name), e.pat.v,
+                e.esc.v if e.esc is not None else None, e.negate)
+        if isinstance(e, InList):
+            if not (isinstance(e.e, Col) and all(
+                    isinstance(x, Lit) and _lit_ok(x.v) for x in e.items)):
+                raise _Fallback("IN shape")
+            j = resolve(e.e.name)
+            leaves = [self._leaf_cmp(j, "=", x.v) for x in e.items]
+            negate = e.negate
+
+            def leaf(blk):
+                if blk.bounds(j) is None:
+                    return np.zeros(blk.n, dtype=bool)  # NULL: 3VL
+                m = leaves[0](blk)
+                for lf in leaves[1:]:
+                    m = m | lf(blk)
+                return ~m if negate else m
+            return leaf
+        if isinstance(e, Between):
+            if not (isinstance(e.e, Col) and isinstance(e.lo, Lit)
+                    and _lit_ok(e.lo.v) and isinstance(e.hi, Lit)
+                    and _lit_ok(e.hi.v)):
+                raise _Fallback("BETWEEN shape")
+            j = resolve(e.e.name)
+            lo = self._leaf_cmp(j, ">=", e.lo.v)
+            hi = self._leaf_cmp(j, "<=", e.hi.v)
+            negate = e.negate
+
+            def leaf(blk):
+                if blk.bounds(j) is None:
+                    return np.zeros(blk.n, dtype=bool)  # NULL: 3VL
+                m = lo(blk) & hi(blk)
+                return ~m if negate else m
+            return leaf
+        if isinstance(e, IsNull):
+            if not isinstance(e.e, Col):
+                raise _Fallback("IS NULL shape")
+            j = resolve(e.e.name)
+            negate = e.negate
+
+            def leaf(blk):
+                b = blk.bounds(j)
+                if b is None:
+                    m = np.ones(blk.n, dtype=bool)
+                else:
+                    cs, ce = b
+                    m = ce == cs
+                return ~m if negate else m
+            return leaf
+        if isinstance(e, Bin) and e.op in _OPS:
+            def fold_neg(node):
+                if isinstance(node, Un) and node.op == "neg" \
+                        and isinstance(node.e, Lit) \
+                        and isinstance(node.e.v, (int, float)) \
+                        and not isinstance(node.e.v, bool):
+                    return Lit(-node.e.v)
+                return node
+
+            col, lit, flip = e.l, fold_neg(e.r), False
+            if isinstance(fold_neg(e.l), Lit):
+                col, lit, flip = e.r, fold_neg(e.l), True
+            if not (isinstance(col, Col) and isinstance(lit, Lit)
+                    and _lit_ok(lit.v)):
+                raise _Fallback("cmp shape")
+            op = _FLIP.get(e.op, e.op) if flip else e.op
+            return self._leaf_cmp(resolve(col.name), op, lit.v)
+        raise _Fallback(f"unsupported node {type(e).__name__}")
+
+    def mask(self, blk):
+        if self.fn is None:
+            return None
+        return self.fn(blk)
+
+
+# ------------------------------------------------------------- CSV tier
+
+
+def _try_csv(req, query: Query, rw, object_size: int, out):
+    delim, quote, header = _csv_opts(req)
+    compression = req.input_ser.get("CompressionType", "NONE") or "NONE"
+    aggs = _agg_shape(query)
+    proj_cols: list | None = None
+    emit = False
+    if aggs is None:
+        oc = req.output_ser.get("CSV")
+        if "CSV" not in req.output_ser or not isinstance(
+                oc, (dict, type(None))):
+            raise _Fallback("output serialization")
+        oc = oc if isinstance(oc, dict) else {}
+        if (oc.get("FieldDelimiter", ",") or ",") != delim \
+                or (oc.get("RecordDelimiter", "\n") or "\n") != "\n" \
+                or (oc.get("QuoteCharacter", '"') or '"') != '"':
+            raise _Fallback("output serialization")
+        if query.star and not query.projections:
+            emit = True
+        elif query.projections and all(
+                isinstance(p.expr, Col) for p in query.projections):
+            names_out = [p.alias or Evaluator._auto_name(p.expr, i)
+                         for i, p in enumerate(query.projections)]
+            if len(set(names_out)) != len(names_out):
+                raise _Fallback("duplicate projection names")
+            proj_cols = [p.expr for p in query.projections]
+            emit = True
+        else:
+            raise _Fallback("projection shape")
+
+    raw = _decomp(rw, compression)
+    if header in ("USE", "IGNORE"):
+        hline, leftover = _read_header(raw, quote)
+        try:
+            names = [h.strip() for h in
+                     hline.decode("utf-8", "replace").split(delim)] \
+                if header == "USE" else []
+        except Exception:
+            raise _Fallback("header decode")
+        if header == "USE" and hline.strip() == b"":
+            names = []
+    else:
+        names, leftover = [], b""
+    if names:
+        lowered = [s.lower() for s in names]
+        if len(set(names)) != len(names) or \
+                len(set(lowered)) != len(lowered) or \
+                any(re.fullmatch(r"_\d+", s) for s in names):
+            raise _Fallback("ambiguous header names")
+
+    def resolve(name: str) -> int:
+        p = _alias_strip(name, query.table_alias)
+        if names:
+            if p in names:
+                return names.index(p)
+            lw = [s.lower() for s in names]
+            if p.lower() in lw:
+                return lw.index(p.lower())
+        if re.fullmatch(r"_\d+", p):
+            i = int(p[1:]) - 1
+            if i >= 0 and (not names or i < len(names)):
+                return i
+        return 1 << 30  # unknown column: dict lookup yields None
+
+    where = _CsvWhere(query.where, resolve)
+    agg_cols = []
+    if aggs is not None:
+        for what, colname, fname in aggs:
+            agg_cols.append(None if colname is None else resolve(colname))
+    proj_resolved = [resolve(c.name) for c in proj_cols] \
+        if proj_cols is not None else None
+
+    ev = Evaluator(query)
+    matches = compile_predicate(ev)
+    project = compile_projection(ev)
+    stats["batch"] += 1
+    rw.commit()
+    keys = [(names[i] if i < len(names) and names[i] else f"_{i + 1}")
+            for i in range(len(names))]
+    qb, db = quote.encode(), delim.encode()
+
+    def rec_of(blk: _CsvBlock, i: int) -> dict:
+        row = [blk.cell(j, i) for j in range(blk.ncols)]
+        ks = keys if keys else []
+        if len(row) > len(ks):
+            ks = ks + [f"_{k + 1}" for k in range(len(ks), len(row))]
+        return dict(zip(ks, row))
+
+    def gen() -> Iterator[bytes]:
+        returned = 0
+        outbuf = bytearray()
+        limit = query.limit
+        n_out = 0
+        tail = leftover
+        keys_state = list(keys)
+
+        def interp_block(block: bytes):
+            nonlocal n_out
+            import csv as csv_mod
+
+            stats["interp_blocks"] += 1
+            text = block.decode("utf-8", "replace")
+            rdr = csv_mod.reader(io.StringIO(text), delimiter=delim,
+                                 quotechar=quote)
+            for row in rdr:
+                if not row:
+                    continue
+                if len(row) > len(keys_state):
+                    keys_state.extend(
+                        f"_{k + 1}" for k in range(len(keys_state),
+                                                   len(row)))
+                rec = dict(zip(keys_state, row))
+                if aggs is not None:
+                    if matches(rec):
+                        ev.accumulate(rec)
+                    continue
+                if not matches(rec):
+                    continue
+                if limit is not None and n_out >= limit:
+                    return
+                outbuf.extend(out.serialize(project(rec)))
+                n_out += 1
+
+        def vector_block(block: bytes):
+            nonlocal n_out
+            blk = _CsvBlock(block, ord(delim))
+            if blk.n == 0:
+                return
+            mask = where.mask(blk)
+            badidx = np.flatnonzero(blk.bad)
+            if len(badidx) * 2 > blk.n:
+                raise _InterpBlock("mostly non-vector cells")
+            if len(badidx):
+                if mask is None:
+                    mask = np.ones(blk.n, dtype=bool)
+                for i in badidx:
+                    mask[i] = matches(rec_of(blk, int(i)))
+            if aggs is not None:
+                results = []
+                for (what, colname, fname), j in zip(aggs, agg_cols):
+                    if j is None:
+                        results.append(
+                            ("count",
+                             int(mask.sum()) if mask is not None
+                             else blk.n, 0.0, None, None))
+                        continue
+                    b = blk.bounds(j)
+                    if b is None:
+                        results.append((fname, 0, 0.0, None, None))
+                        continue
+                    cs, ce = b
+                    sel = (ce > cs) if mask is None else mask & (ce > cs)
+                    if what == 0:
+                        results.append(("count", int(sel.sum()), 0.0,
+                                        None, None))
+                        continue
+                    vals, ok = blk.nums(j)
+                    if (~ok & sel).any():
+                        # text/float/huge cells under the mask: SUM may
+                        # raise, MIN/MAX mixes _cmp_pair — interpreter
+                        raise _InterpBlock("non-integer aggregate cells")
+                    sv = vals[sel]
+                    if what == 1:
+                        if len(sv) and float(np.abs(sv).sum()) >= BIG:
+                            raise _InterpBlock("sum exactness")
+                        results.append((fname, int(sel.sum()),
+                                        float(sv.sum()) if len(sv)
+                                        else 0.0, None, None))
+                    else:
+                        if not len(sv):
+                            results.append((fname, 0, 0.0, None, None))
+                            continue
+                        si = np.flatnonzero(sel)
+                        lo = _num(blk.cell(j, int(si[int(sv.argmin())])))
+                        hi = _num(blk.cell(j, int(si[int(sv.argmax())])))
+                        results.append((fname, int(sel.sum()), 0.0,
+                                        lo, hi))
+                _commit_agg(ev, results)
+                return
+            # emit path: verbatim row slices / cell gathers
+            sel = np.arange(blk.n) if mask is None else \
+                np.flatnonzero(mask)
+            for i in sel:
+                if limit is not None and n_out >= limit:
+                    return
+                i = int(i)
+                if proj_resolved is None:
+                    outbuf.extend(block[int(blk.rs[i]):
+                                        int(blk.re[i])])
+                    outbuf.extend(b"\n")
+                else:
+                    cells = []
+                    for j in proj_resolved:
+                        b = blk.bounds(j)
+                        cells.append(b"" if b is None else
+                                     block[int(b[0][i]):int(b[1][i])])
+                    outbuf.extend(db.join(cells))
+                    outbuf.extend(b"\n")
+                n_out += 1
+
+        def interp_stream(prefix: bytes):
+            """Quote byte seen: record boundaries are no longer plain
+            newlines (a quoted field may span read blocks, and no
+            block-local rule can place the split soundly — Python csv's
+            in-quote state is sequential).  Hand the REST of the stream
+            to one continuous csv.reader, exactly like the interpreter
+            tier."""
+            nonlocal n_out
+            import csv as csv_mod
+
+            stats["interp_blocks"] += 1
+
+            class _Chain(io.RawIOBase):
+                def __init__(self, head, rest):
+                    self._head = io.BytesIO(head)
+                    self._rest = rest
+
+                def readable(self):
+                    return True
+
+                def readinto(self, b):
+                    got = self._head.readinto(b)
+                    if got:
+                        return got
+                    data = self._rest.read(len(b)) or b""
+                    n = len(data)
+                    b[:n] = data
+                    return n
+
+            text = io.TextIOWrapper(_Chain(prefix, raw),
+                                    encoding="utf-8", errors="replace",
+                                    newline="")
+            rdr = csv_mod.reader(text, delimiter=delim, quotechar=quote)
+            for row in rdr:
+                if not row:
+                    continue
+                stats["bytes"] += sum(len(c) for c in row) + len(row)
+                if len(row) > len(keys_state):
+                    keys_state.extend(
+                        f"_{k + 1}" for k in range(len(keys_state),
+                                                   len(row)))
+                rec = dict(zip(keys_state, row))
+                if aggs is not None:
+                    if matches(rec):
+                        ev.accumulate(rec)
+                    continue
+                if not matches(rec):
+                    continue
+                if limit is not None and n_out >= limit:
+                    return
+                outbuf.extend(out.serialize(project(rec)))
+                n_out += 1
+
+        try:
+            while True:
+                data = raw.read(CHUNK)
+                final = not data
+                buf = tail + (data or b"")
+                tail = b""
+                if not buf:
+                    break
+                if qb in buf:
+                    interp_stream(buf)
+                    break
+                if final:
+                    block = buf
+                else:
+                    k = buf.rfind(b"\n")
+                    if k < 0:
+                        tail = buf
+                        if len(tail) > (64 << 20):
+                            raise SQLError("record too large")
+                        continue
+                    block, tail = buf[:k + 1], buf[k + 1:]
+                stats["bytes"] += len(block)
+                if block and not block.endswith(b"\n"):
+                    block += b"\n"  # final record without newline
+                try:
+                    if b"\r" in block or (emit and b'"' in block):
+                        # bare \r; for emit ALSO the OUTPUT quote char
+                        # (a cell may contain '"' while the input quote
+                        # differs): the writer would re-quote, so the
+                        # interpreter serializes.  \r never splits a
+                        # record across blocks (splits are at '\n'
+                        # only), so per-block replay stays exact here.
+                        raise _InterpBlock("\\r or output-quote block")
+                    vector_block(block)
+                except _InterpBlock:
+                    interp_block(block)
+                while len(outbuf) >= FLUSH:
+                    returned += FLUSH
+                    yield es.records_message(bytes(outbuf[:FLUSH]))
+                    del outbuf[:FLUSH]
+                if emit and limit is not None and n_out >= limit:
+                    break
+                if final:
+                    break
+            if aggs is not None:
+                outbuf.extend(out.serialize(ev.aggregate_result()))
+            if outbuf:
+                returned += len(outbuf)
+                yield es.records_message(bytes(outbuf))
+            if req.request_progress:
+                yield es.progress_message(object_size, object_size,
+                                          returned)
+            yield es.stats_message(object_size, object_size, returned)
+            yield es.end_message()
+        except SQLError as e:
+            yield es.error_message("InvalidQuery", str(e))
+
+    return gen()
+
+
+# ------------------------------------------------------------ JSON tier
+
+
+class _JsonBlock:
+    """A batch of parsed JSON LINES documents with typed column
+    caches."""
+
+    def __init__(self, docs: list):
+        self.docs = docs
+        self.n = len(docs)
+        self._cols: dict = {}
+
+    def col(self, k: str) -> list:
+        if k not in self._cols:
+            self._cols[k] = [d.get(k) for d in self.docs]
+        return self._cols[k]
+
+    def types(self, k: str) -> set:
+        key = ("t", k)
+        if key not in self._cols:
+            self._cols[key] = set(map(type, self.col(k)))
+        return self._cols[key]
+
+    def nums(self, k: str):
+        """float64 values (None -> nan) for an int/float column; raises
+        _InterpBlock on anything exactness can't survive."""
+        key = ("n", k)
+        if key not in self._cols:
+            tps = self.types(k)
+            if not tps <= {int, float, type(None)} or bool in tps:
+                raise _InterpBlock("mixed types")
+            try:
+                vals = np.asarray(self.col(k), dtype=np.float64)
+            except (OverflowError, ValueError, TypeError):
+                raise _InterpBlock("unconvertible numbers")
+            with np.errstate(invalid="ignore"):
+                if (np.abs(vals) >= BIG).any():
+                    raise _InterpBlock("big-int exactness")
+            self._cols[key] = vals
+        return self._cols[key]
+
+    def nulls(self, k: str):
+        key = ("0", k)
+        if key not in self._cols:
+            self._cols[key] = np.fromiter(
+                (v is None for v in self.col(k)), dtype=bool,
+                count=self.n)
+        return self._cols[key]
+
+    def strs(self, k: str):
+        key = ("s", k)
+        if key not in self._cols:
+            tps = self.types(k)
+            if not tps <= {str, type(None)}:
+                raise _InterpBlock("mixed types")
+            self._cols[key] = np.array(
+                ["" if v is None else v for v in self.col(k)])
+        return self._cols[key]
+
+
+class _JsonWhere:
+    def __init__(self, where, resolve):
+        self.fn = self._comp(where, resolve) if where is not None else None
+
+    def mask(self, blk):
+        if self.fn is None:
+            return None
+        return self.fn(blk)
+
+    def _leaf_cmp(self, k, op: str, lit):
+        opc = _OPS[op]
+        nlit = _num(lit)
+        is_num = isinstance(nlit, (int, float)) and not isinstance(
+            nlit, bool)
+
+        def leaf(blk):
+            tps = blk.types(k)
+            if tps <= {int, float, type(None)} and bool not in tps:
+                if not is_num:
+                    # number cells vs text literal: str() renderings —
+                    # the interpreter decides
+                    raise _InterpBlock("number vs text literal")
+                vals = blk.nums(k)
+                with np.errstate(invalid="ignore"):
+                    m = _num_mask(opc, vals, float(nlit))
+                if opc == 1 and type(None) in tps:
+                    m &= ~blk.nulls(k)  # null != lit is FALSE, not True
+                return m
+            if tps <= {str, type(None)}:
+                sv = blk.strs(k)
+                if is_num:
+                    # numeric-string cells compare numerically: the
+                    # interpreter's _cmp_pair semantics, per block
+                    raise _InterpBlock("string vs numeric literal")
+                m = _apply_op(
+                    opc, np.sign(
+                        (sv > str(lit)).astype(np.int8) -
+                        (sv < str(lit)).astype(np.int8)))
+                if type(None) in tps:
+                    nz = blk.nulls(k)
+                    m &= ~nz
+                return m
+            raise _InterpBlock("mixed types")
+        return leaf
+
+    def _valid(self, k):
+        def leaf(blk):
+            return ~blk.nulls(k)
+        return leaf
+
+    def _comp(self, e, resolve):
+        if isinstance(e, Un):
+            if e.op != "not":
+                raise _Fallback("unary " + e.op)
+            inner = self._comp(e.e, resolve)
+            return lambda blk: ~inner(blk)
+        if isinstance(e, Bin) and e.op in ("and", "or"):
+            lf, rf = self._comp(e.l, resolve), self._comp(e.r, resolve)
+            if e.op == "and":
+                return lambda blk: lf(blk) & rf(blk)
+            return lambda blk: lf(blk) | rf(blk)
+        if isinstance(e, InList):
+            if not (isinstance(e.e, Col) and all(
+                    isinstance(x, Lit) and _lit_ok(x.v)
+                    for x in e.items)):
+                raise _Fallback("IN shape")
+            k = resolve(e.e.name)
+            leaves = [self._leaf_cmp(k, "=", x.v) for x in e.items]
+            validf = self._valid(k)
+            negate = e.negate
+
+            def leaf(blk):
+                m = leaves[0](blk)
+                for lf in leaves[1:]:
+                    m = m | lf(blk)
+                return (validf(blk) & ~m) if negate else m
+            return leaf
+        if isinstance(e, Between):
+            if not (isinstance(e.e, Col) and isinstance(e.lo, Lit)
+                    and _lit_ok(e.lo.v) and isinstance(e.hi, Lit)
+                    and _lit_ok(e.hi.v)):
+                raise _Fallback("BETWEEN shape")
+            k = resolve(e.e.name)
+            lo = self._leaf_cmp(k, ">=", e.lo.v)
+            hi = self._leaf_cmp(k, "<=", e.hi.v)
+            validf = self._valid(k)
+            negate = e.negate
+
+            def leaf(blk):
+                m = lo(blk) & hi(blk)
+                return (validf(blk) & ~m) if negate else m
+            return leaf
+        if isinstance(e, IsNull):
+            if not isinstance(e.e, Col):
+                raise _Fallback("IS NULL shape")
+            k = resolve(e.e.name)
+            negate = e.negate
+
+            def leaf(blk):
+                tps = blk.types(k)
+                m = blk.nulls(k).copy()
+                if str in tps:
+                    if not tps <= {str, type(None)}:
+                        raise _InterpBlock("mixed types")
+                    m |= blk.strs(k) == ""
+                elif not tps <= {int, float, type(None)} \
+                        or bool in tps:
+                    raise _InterpBlock("mixed types")
+                return ~m if negate else m
+            return leaf
+        if isinstance(e, Bin) and e.op in _OPS:
+            def fold_neg(node):
+                if isinstance(node, Un) and node.op == "neg" \
+                        and isinstance(node.e, Lit) \
+                        and isinstance(node.e.v, (int, float)) \
+                        and not isinstance(node.e.v, bool):
+                    return Lit(-node.e.v)
+                return node
+
+            col, lit, flip = e.l, fold_neg(e.r), False
+            if isinstance(fold_neg(e.l), Lit):
+                col, lit, flip = e.r, fold_neg(e.l), True
+            if not (isinstance(col, Col) and isinstance(lit, Lit)
+                    and _lit_ok(lit.v)):
+                raise _Fallback("cmp shape")
+            op = _FLIP.get(e.op, e.op) if flip else e.op
+            return self._leaf_cmp(resolve(col.name), op, lit.v)
+        raise _Fallback(f"unsupported node {type(e).__name__}")
+
+
+def _try_json(req, query: Query, rw, object_size: int, out):
+    j = req.input_ser["JSON"] if isinstance(req.input_ser["JSON"], dict) \
+        else {}
+    if (j.get("Type", "DOCUMENT") or "DOCUMENT").upper() != "LINES":
+        raise _Fallback("JSON type")
+    aggs = _agg_shape(query)
+    if aggs is None:
+        raise _Fallback("projection shape")
+    compression = req.input_ser.get("CompressionType", "NONE") or "NONE"
+
+    def resolve(name: str) -> str:
+        return _alias_strip(name, query.table_alias)
+
+    where = _JsonWhere(query.where, resolve)
+    agg_keys = [None if colname is None else resolve(colname)
+                for what, colname, fname in aggs]
+    ev = Evaluator(query)
+    matches = compile_predicate(ev)
+    raw = _decomp(rw, compression)
+    stats["batch"] += 1
+    rw.commit()
+
+    def gen() -> Iterator[bytes]:
+        import json as json_mod
+
+        returned = 0
+        outbuf = bytearray()
+        tail = ""
+        dec = io.TextIOWrapper(_Reader(raw), encoding="utf-8",
+                               errors="replace")
+
+        def run_docs(docs: list) -> None:
+            blk = _JsonBlock(docs)
+            mask = where.mask(blk)
+            results = []
+            for (what, colname, fname), k in zip(aggs, agg_keys):
+                if k is None:
+                    results.append(
+                        ("count",
+                         int(mask.sum()) if mask is not None else blk.n,
+                         0.0, None, None))
+                    continue
+                col = blk.col(k)
+                tps = blk.types(k)
+                present = ~blk.nulls(k)
+                if str in tps:
+                    if not tps <= {str, type(None)}:
+                        raise _InterpBlock("mixed types")
+                    if what == 0:
+                        sel = present & (blk.strs(k) != "")
+                        if mask is not None:
+                            sel &= mask
+                        results.append(("count", int(sel.sum()), 0.0,
+                                        None, None))
+                        continue
+                    raise _InterpBlock("string aggregate cells")
+                vals = blk.nums(k)  # raises _InterpBlock on mixes
+                sel = present if mask is None else mask & present
+                if what == 0:
+                    results.append(("count", int(sel.sum()), 0.0,
+                                    None, None))
+                    continue
+                sv = vals[sel]
+                if what == 1:
+                    if len(sv):
+                        if (sv != np.floor(sv)).any() or \
+                                float(np.abs(sv).sum()) >= BIG:
+                            # fractional or huge sums: pairwise numpy
+                            # addition may differ from the sequential
+                            # interpreter in the last ulp
+                            raise _InterpBlock("sum exactness")
+                    results.append((fname, int(sel.sum()),
+                                    float(sv.sum()) if len(sv) else 0.0,
+                                    None, None))
+                else:
+                    if not len(sv):
+                        results.append((fname, 0, 0.0, None, None))
+                        continue
+                    si = np.flatnonzero(sel)
+                    lo = col[int(si[int(sv.argmin())])]
+                    hi = col[int(si[int(sv.argmax())])]
+                    results.append((fname, int(sel.sum()), 0.0, lo, hi))
+            _commit_agg(ev, results)
+
+        def interp_lines(lines: list) -> None:
+            stats["interp_blocks"] += 1
+            for line in lines:
+                try:
+                    doc = json_mod.loads(line)
+                except ValueError as exc:
+                    raise SQLError(f"invalid JSON line: {exc}")
+                rec = doc if isinstance(doc, dict) else {"_1": doc}
+                if matches(rec):
+                    ev.accumulate(rec)
+
+        try:
+            while True:
+                data = dec.read(CHUNK)
+                final = not data
+                text = tail + (data or "")
+                tail = ""
+                if not text:
+                    break
+                if not final:
+                    k = text.rfind("\n")
+                    if k < 0:
+                        tail = text
+                        if len(tail) > (64 << 20):
+                            raise SQLError("record too large")
+                        continue
+                    text, tail = text[:k + 1], text[k + 1:]
+                stats["bytes"] += len(text)
+                lines = [ln for ln in
+                         (s.strip() for s in text.split("\n")) if ln]
+                if not lines:
+                    if final:
+                        break
+                    continue
+                docs = None
+                try:
+                    docs = json_mod.loads("[" + ",".join(lines) + "]")
+                except ValueError:
+                    interp_lines(lines)  # per-line: exact error order
+                if docs is not None and len(docs) != len(lines):
+                    # a malformed line containing a TOP-LEVEL comma
+                    # ('{"a":2},{"a":3}') parses as extra array
+                    # elements instead of raising — only a 1:1 line:doc
+                    # mapping proves the join was faithful
+                    docs = None
+                    interp_lines(lines)
+                if docs is not None:
+                    try:
+                        run_docs([d if isinstance(d, dict) else
+                                  {"_1": d} for d in docs])
+                    except _InterpBlock:
+                        interp_lines(lines)
+                if final:
+                    break
+            outbuf.extend(out.serialize(ev.aggregate_result()))
+            returned += len(outbuf)
+            yield es.records_message(bytes(outbuf))
+            if req.request_progress:
+                yield es.progress_message(object_size, object_size,
+                                          returned)
+            yield es.stats_message(object_size, object_size, returned)
+            yield es.end_message()
+        except SQLError as e:
+            yield es.error_message("InvalidQuery", str(e))
+
+    return gen()
+
+
+class _Reader(io.RawIOBase):
+    """Minimal adapter so TextIOWrapper accepts our byte streams."""
+
+    def __init__(self, raw):
+        self._raw = raw
+
+    def readable(self) -> bool:
+        return True
+
+    def readinto(self, b) -> int:
+        ri = getattr(self._raw, "readinto", None)
+        if ri is not None:
+            try:
+                return ri(b) or 0
+            except (NotImplementedError, io.UnsupportedOperation):
+                pass
+        data = self._raw.read(len(b)) or b""
+        n = len(data)
+        b[:n] = data
+        return n
+
+
+# -------------------------------------------------------------- dispatch
+
+
+def try_batch(req, query: Query, rw, object_size: int,
+              out) -> Iterator[bytes] | None:
+    """Probe + run the compiled row tier.  Returns the event-stream
+    iterator, or None (with `rw` rewound) when the plain interpreter
+    must take the query."""
+    if not _enabled():
+        rw.rewind()
+        return None
+    try:
+        if "CSV" in req.input_ser:
+            return _try_csv(req, query, rw, object_size, out)
+        if "JSON" in req.input_ser:
+            return _try_json(req, query, rw, object_size, out)
+    except _Fallback:
+        pass
+    stats["fallback"] += 1
+    rw.rewind()
+    return None

@@ -42,6 +42,7 @@ Disable with MINIO_TPU_SELECT_COLUMNAR=0.
 
 from __future__ import annotations
 
+import io
 import operator
 import os
 import re
@@ -98,6 +99,43 @@ class Rewindable:
 
     def rewind(self) -> None:
         self._pos = 0
+
+    def readinto(self, b) -> int:
+        """Read directly into a caller buffer.  Once committed with no
+        replay prefix pending this delegates to the source's readinto —
+        one copy instead of two, which matters to scan consumers whose
+        kernels run at memcpy speed."""
+        if self._recording or self._pos < len(self._buf):
+            data = self.read(len(b))
+            n = len(data)
+            b[:n] = data
+            return n
+        ri = getattr(self.raw, "readinto", None)
+        if ri is not None:
+            try:
+                return ri(b) or 0
+            except (NotImplementedError, io.UnsupportedOperation):
+                pass  # io.RawIOBase subclasses may leave the default
+        data = self.raw.read(len(b)) or b""
+        n = len(data)
+        b[:n] = data
+        return n
+
+    def direct_buffer(self):
+        """Zero-copy view of the remaining stream when the committed
+        source is fully memory-resident (BytesIO), else None.  The
+        source is advanced to EOF — the caller owns the returned view
+        (treat as read-only) and every byte in it."""
+        if self._recording or self._pos < len(self._buf):
+            return None
+        raw = self.raw
+        if not isinstance(raw, io.BytesIO):
+            return None
+        pos = raw.tell()
+        mv = raw.getbuffer()
+        out = mv[pos:]
+        raw.seek(0, 2)
+        return out
 
     def stop_recording(self) -> None:
         """Keep the already-buffered prefix for replay but stop growing

@@ -44,7 +44,11 @@ CHUNK = 4 << 20
 FLUSH = 256 << 10
 PAD = 8  # kernel SWAR parsers read up to 8 bytes past a cell
 
-stats = {"native": 0, "fallback": 0, "replay_blocks": 0}
+stats = {"native": 0, "fallback": 0, "replay_blocks": 0,
+         # per-tier observability: bytes the native kernels consumed and
+         # the subset re-decided by the Python replay (the residual-
+         # replay fraction gauge in server/metrics.py is their ratio)
+         "bytes_scanned": 0, "bytes_replayed": 0}
 
 _OPS = {"=": 0, "==": 0, "!=": 1, "<>": 1, "<": 2, "<=": 3, ">": 4,
         ">=": 5}
@@ -139,6 +143,31 @@ def _load():
             ctypes.POINTER(_dbl), ctypes.POINTER(_dbl),
             ctypes.POINTER(_dbl), ctypes.POINTER(_i64),
             ctypes.POINTER(_i64), ctypes.POINTER(_i64)]
+        # fused one-pass kernels (absent from pre-refactor .so builds:
+        # the driver then stays on the multi-pass array path)
+        try:
+            lib.sel_csv_agg_fused.restype = _i64
+            lib.sel_csv_agg_fused.argtypes = [
+                _vp, _i64, ctypes.c_char, ctypes.c_char, ctypes.c_int,
+                _vp, ctypes.c_int32,
+                ctypes.c_int32, _vp, _vp, _vp, _vp, _vp, _vp, _vp, _vp,
+                _vp, _cp, _cp, _vp, ctypes.c_int32, _vp, _vp,
+                ctypes.c_int32, _vp, _vp, _vp, _vp, _vp, _vp, _vp, _vp,
+                _vp, _vp,
+                ctypes.POINTER(_i64), ctypes.POINTER(_i64),
+                ctypes.POINTER(_i64), ctypes.POINTER(_i64)]
+            lib.sel_json_agg_fused.restype = _i64
+            lib.sel_json_agg_fused.argtypes = [
+                _vp, _i64, ctypes.c_int, _vp, _vp, ctypes.c_int32,
+                ctypes.c_int32, _vp, _vp, _vp, _vp, _vp, _vp, _vp, _vp,
+                _vp, _vp, _cp, _cp, _vp, ctypes.c_int32, _vp, _vp,
+                ctypes.c_int32, _vp, _vp, _vp, _vp, _vp, _vp, _vp, _vp,
+                _vp, _vp,
+                ctypes.POINTER(_i64), ctypes.POINTER(_i64),
+                ctypes.POINTER(_i64)]
+            lib.has_fused = True
+        except AttributeError:
+            lib.has_fused = False
         _lib = lib
         return _lib
 
@@ -201,12 +230,91 @@ class _Plan:
     accumulates the kernels' ambiguous-cell counts for the current
     block — nonzero means the Python replay must decide the block."""
 
+    # Alongside the per-leaf closures, _comp records a flat "fused
+    # program" (leaf descriptor rows + a postfix combiner) that the
+    # one-pass kernels execute per row DURING the structural scan —
+    # every leaf shape _comp accepts is expressible, so f_ok only goes
+    # False on size limits (kernel fixed stacks).
+    F_MAX_LEAVES = 64
+
     def __init__(self, where, resolve, is_json: bool):
         self.is_json = is_json
         self.cols: list = []          # resolved column ids, plan order
         self._col_of: dict = {}
         self.amb = 0
+        self.f_leaves: list = []      # (kind, slot, op, isnum, fn, fa,
+        #                                fb, num, aux, auxmask, expr)
+        self.f_prog: list = []        # postfix: >=0 push leaf; -1 AND,
+        #                                -2 OR, -3 NOT
+        self.f_ok = True
         self.fn = self._comp(where, resolve) if where is not None else None
+
+    def _f_leaf(self, kind, slot, op=0, isnum=0, fn=0, fa=0, fb=0,
+                num=0.0, aux=b"", auxmask=None, expr=None) -> None:
+        if not self.f_ok:
+            return
+        if len(self.f_leaves) >= self.F_MAX_LEAVES:
+            self.f_ok = False
+            return
+        self.f_leaves.append((kind, slot, op, isnum, fn, fa, fb, float(num),
+                              aux, auxmask, expr))
+        self.f_prog.append(len(self.f_leaves) - 1)
+
+    def _f_op(self, code: int) -> None:
+        self.f_prog.append(code)
+
+    def pack_fused(self, slot_map) -> dict | None:
+        """-> ctypes-ready fused-program arrays, with plan slots
+        remapped through slot_map (plan-col -> captured-cell index), or
+        None when the program exceeds the kernel's fixed bounds."""
+        if not self.f_ok:
+            return None
+        n = len(self.f_leaves)
+        blob = bytearray()
+        mask = bytearray()
+        ecodes: list[int] = []
+        eops: list[float] = []
+        kind = np.zeros(n, dtype=np.int32)
+        slot = np.zeros(n, dtype=np.int32)
+        op = np.zeros(n, dtype=np.int32)
+        isnum = np.zeros(n, dtype=np.int32)
+        fn = np.zeros(n, dtype=np.int32)
+        fa = np.zeros(n, dtype=np.int32)
+        fb = np.zeros(n, dtype=np.int32)
+        num = np.zeros(n, dtype=np.float64)
+        aoff = np.zeros(n, dtype=np.int32)
+        alen = np.zeros(n, dtype=np.int32)
+        for i, (k, sl, o, inum, f, a, b, nv, aux, auxmask, expr) in \
+                enumerate(self.f_leaves):
+            kind[i] = k
+            slot[i] = slot_map[sl]
+            op[i] = o
+            isnum[i] = inum
+            fn[i] = f
+            fa[i] = a
+            fb[i] = b
+            num[i] = nv
+            if expr is not None:
+                aoff[i] = len(ecodes)
+                alen[i] = len(expr[0])
+                ecodes.extend(expr[0])
+                eops.extend(expr[1])
+            else:
+                aoff[i] = len(blob)
+                alen[i] = len(aux)
+                blob += aux
+                mask += auxmask if auxmask is not None else b"\0" * len(aux)
+        prog = np.array(self.f_prog, dtype=np.int32) if self.f_prog \
+            else np.zeros(1, dtype=np.int32)
+        return {
+            "nleaves": n, "kind": kind, "slot": slot, "op": op,
+            "isnum": isnum, "fn": fn, "fa": fa, "fb": fb, "num": num,
+            "aoff": aoff, "alen": alen, "blob": bytes(blob),
+            "mask": bytes(mask), "prog": prog,
+            "prog_len": len(self.f_prog),
+            "ecodes": np.array(ecodes or [0], dtype=np.int32),
+            "eops": np.array(eops or [0.0], dtype=np.float64),
+        }
 
     def _slot(self, resolved) -> int:
         if resolved not in self._col_of:
@@ -230,6 +338,15 @@ class _Plan:
         strlit = str(lit_v).encode()
         is_num = isinstance(numlit, (int, float)) \
             and not isinstance(numlit, bool)
+        if self.is_json:
+            self._f_leaf(0, slot, opc, isnum=int(is_num),
+                         num=float(numlit) if is_num else 0.0,
+                         fn=fn, fa=fa, fb=fb, aux=strlit)
+        elif is_num:
+            self._f_leaf(0, slot, opc, num=float(numlit), fn=fn, fa=fa,
+                         fb=fb, aux=strlit)
+        else:
+            self._f_leaf(1, slot, opc, fn=fn, fa=fa, fb=fb, aux=strlit)
         if self.is_json:
             def leaf(ctx):
                 m = np.empty(ctx.n, dtype=np.uint8)
@@ -310,6 +427,8 @@ class _Plan:
         opc = _OPS[op]
         codes = np.array([c for c, _ in prog], dtype=np.int32)
         ops = np.array([o for _, o in prog], dtype=np.float64)
+        self._f_leaf(5, slot, opc, num=float(numlit),
+                     expr=([c for c, _ in prog], [o for _, o in prog]))
         isj = self.is_json
 
         def leaf(ctx, slot=slot, codes=codes, ops=ops):
@@ -377,9 +496,11 @@ class _Plan:
             if e.op != "not":
                 raise _Fallback("unary " + e.op)
             inner = self._comp(e.e, resolve)
+            self._f_op(-3)
             return lambda ctx: ~inner(ctx)
         if isinstance(e, Bin) and e.op in ("and", "or"):
             lf, rf = self._comp(e.l, resolve), self._comp(e.r, resolve)
+            self._f_op(-1 if e.op == "and" else -2)
             if e.op == "and":
                 return lambda ctx: lf(ctx) & rf(ctx)
             return lambda ctx: lf(ctx) | rf(ctx)
@@ -396,6 +517,13 @@ class _Plan:
                 str(e.pat.v), str(e.esc.v) if e.esc is not None else None)
             negate = e.negate
             validf = self._valid(slot)
+            self._f_leaf(2, slot, fn=fncode, fa=fa, fb=fb, aux=pat,
+                         auxmask=litmask)
+            if negate:
+                # null cells make LIKE and NOT LIKE both false
+                self._f_op(-3)
+                self._f_leaf(4, slot)
+                self._f_op(-1)
             fn = lib.sel_json_like if self.is_json else lib.sel_like
 
             def leaf(ctx, slot=slot, pat=pat, litmask=litmask,
@@ -423,8 +551,14 @@ class _Plan:
             slot, fncode, fa, fb = self._col_fn(e.e, resolve)
             leaves = [self._leaf_cmp(slot, "=", x.v, fncode, fa, fb)
                       for x in e.items]
+            for _ in e.items[1:]:
+                self._f_op(-2)
             validf = self._valid(slot)
             negate = e.negate
+            if negate:
+                self._f_op(-3)
+                self._f_leaf(4, slot)
+                self._f_op(-1)
 
             def leaf(ctx, leaves=leaves, negate=negate):
                 m = leaves[0](ctx)
@@ -439,8 +573,13 @@ class _Plan:
             slot, fncode, fa, fb = self._col_fn(e.e, resolve)
             lo = self._leaf_cmp(slot, ">=", e.lo.v, fncode, fa, fb)
             hi = self._leaf_cmp(slot, "<=", e.hi.v, fncode, fa, fb)
+            self._f_op(-1)
             validf = self._valid(slot)
             negate = e.negate
+            if negate:
+                self._f_op(-3)
+                self._f_leaf(4, slot)
+                self._f_op(-1)
 
             def leaf(ctx, lo=lo, hi=hi, negate=negate):
                 m = lo(ctx) & hi(ctx)
@@ -452,6 +591,9 @@ class _Plan:
             slot = self._slot(resolve(e.e.name))
             negate = e.negate
             isj = self.is_json
+            self._f_leaf(3, slot)
+            if negate:
+                self._f_op(-3)
 
             def leaf(ctx, slot=slot, negate=negate):
                 m = np.empty(ctx.n, dtype=np.uint8)
@@ -525,6 +667,154 @@ def _alias_strip(name: str, alias: str) -> str:
 
 class _Ctx:
     pass
+
+
+class _Blocks:
+    """Block feeder for the scan generators.
+
+    Arena mode: stream bytes are readinto() a reusable padded bytearray
+    (ONE copy — the old read()-then-stage path made two, and at fused-
+    scan rates each extra memory pass costs as much as the scan
+    itself).  Direct mode (fused aggregate queries over uncompressed
+    memory-resident sources): segments of the source buffer go to the
+    kernels zero-copy; a record crossing a segment boundary is simply
+    re-scanned from its start (consumed semantics), and the final
+    segment always goes through the arena so the kernels' 8-byte SWAR
+    overread stays inside owned memory.
+    """
+
+    SEG = 16 << 20
+
+    def __init__(self, raw, rw, leftover: bytes, compression: str,
+                 direct_ok: bool):
+        self.raw = raw
+        self.tail = leftover or b""
+        self.ba = bytearray(CHUNK + (1 << 20) + PAD)
+        self.base = (ctypes.c_char * len(self.ba)).from_buffer(self.ba)
+        self.dnp = None
+        self.dpos = 0
+        self._direct_blk = False
+        self._blen = 0
+        if direct_ok and (compression or "NONE").upper() in ("NONE", "") \
+                and raw is rw:
+            mv = rw.direct_buffer()
+            if mv is not None and len(mv) > 0:
+                self._mv = mv  # keeps the source export alive
+                self.dnp = np.frombuffer(mv, dtype=np.uint8)
+
+    def _grow(self, blen: int) -> None:
+        if blen + PAD > len(self.ba):
+            self.base = None
+            self.ba = bytearray(blen * 2 + PAD)
+            self.base = (ctypes.c_char * len(self.ba)).from_buffer(
+                self.ba)
+
+    def _stage(self, data: bytes, final: bool):
+        if len(data) > (64 << 20):
+            raise SQLError("record too large")
+        blen = len(data)
+        self._grow(blen)
+        self.ba[:blen] = data
+        self.ba[blen:blen + PAD] = b"\0" * PAD
+        self.tail = b""
+        self._direct_blk = False
+        self._blen = blen
+        return (ctypes.addressof(self.base), blen, final)
+
+    def _find_nl(self, pos: int) -> int:
+        d = self.dnp
+        w = 1 << 16
+        while True:
+            end = min(pos + w, len(d))
+            hits = np.flatnonzero(d[pos:end] == 10)
+            if len(hits):
+                return pos + int(hits[0])
+            if end >= len(d):
+                return -1
+            w *= 16
+
+    def next(self):
+        """-> (base_address, block_len, final) or None at end."""
+        d = self.dnp
+        if d is not None:
+            L = len(d)
+            pos = self.dpos
+            if pos >= L:
+                self.dnp = None
+                if self.tail:
+                    return self._stage(self.tail, True)
+                return None
+            if self.tail:
+                # stitch: complete the pending partial record with
+                # bytes up to (and including) the next newline
+                nl = self._find_nl(pos)
+                if nl < 0:
+                    self.dnp = None
+                    self.dpos = L
+                    return self._stage(
+                        self.tail + d[pos:].tobytes(), True)
+                data = self.tail + d[pos:nl + 1].tobytes()
+                self.dpos = nl + 1
+                return self._stage(data, False)
+            rem = L - pos
+            if rem > (1 << 16):
+                # direct segment; always leave a staged tail so the
+                # kernels' SWAR overread stays inside owned memory
+                seg = min(self.SEG, rem - 4096)
+                self._direct_blk = True
+                self._blen = seg
+                return (self.dnp.ctypes.data + pos, seg, False)
+            self.dnp = None
+            self.dpos = L
+            return self._stage(d[pos:].tobytes(), True)
+        # arena mode
+        tlen = len(self.tail)
+        self._grow(tlen + CHUNK)
+        if tlen:
+            self.ba[:tlen] = self.tail
+            self.tail = b""
+        got = self.raw.readinto(
+            memoryview(self.ba)[tlen:tlen + CHUNK]) or 0
+        blen = tlen + got
+        if blen == 0:
+            return None
+        self.ba[blen:blen + PAD] = b"\0" * PAD
+        self._direct_blk = False
+        self._blen = blen
+        return (ctypes.addressof(self.base), blen, got == 0)
+
+    def view(self, off: int = 0):
+        """Buffer view of the current block from `off` (for replay)."""
+        if self._direct_blk:
+            return self.dnp[self.dpos + off:self.dpos + self._blen]
+        return memoryview(self.ba)[off:]
+
+    def find(self, needle: bytes, a: int, b: int) -> int:
+        """byte search within the current block (arena blocks only —
+        direct blocks exist only on fused paths, which detect quotes
+        in-kernel)."""
+        if self._direct_blk:
+            return -1
+        return self.ba.find(needle, a, b)
+
+    def advance(self, off: int) -> None:
+        """Consume `off` bytes of the current block; the rest becomes
+        the pending tail for the next one."""
+        if self._direct_blk:
+            if off == 0:
+                # record longer than a direct segment: fall back to
+                # stitched arena staging for this record
+                self.tail = self.dnp[
+                    self.dpos:self.dpos + self._blen].tobytes()
+                self.dpos += self._blen
+            else:
+                self.dpos += off
+            return
+        blen = self._blen
+        if off < blen:
+            self.tail = bytes(self.ba[off:blen])
+            if len(self.tail) > (64 << 20):
+                raise SQLError("record too large")
 
 
 # ------------------------------------------------------------- CSV path
@@ -656,12 +946,30 @@ def _try_csv(req, query: Query, rw, object_size: int, out):
     keys = [(names[i] if names and i < len(names) and names[i]
              else f"_{i + 1}") for i in range(len(names))] if names else []
 
+    # fused one-pass program: aggregate queries whose WHERE compiled and
+    # whose working set fits the kernel's fixed cell registers run scan
+    # + predicate + fold in a single traversal (quote-free blocks only —
+    # a quoted block falls back to the multi-pass array kernels below)
+    fused = None
+    f_aggs = None
+    if aggs is not None and getattr(lib, "has_fused", False) \
+            and len(needed) <= 16:
+        fused = plan.pack_fused([col_pos[c] for c in plan.cols])
+        if fused is not None:
+            f_aggs = {
+                "what": np.array([w for w, _, _ in aggs],
+                                 dtype=np.int32),
+                "slot": np.array([-1 if c is None else col_pos[c]
+                                  for c in agg_cols], dtype=np.int32),
+            }
+
     def replay_rows(block: bytes, a: int, b: int, collect=None) -> None:
         """Row-engine evaluation of block[a:b] (complete records)."""
         import csv as csv_mod
         import io as io_mod
 
         stats["replay_blocks"] += 1
+        stats["bytes_replayed"] += b - a
         text = bytes(block[a:b]).decode("utf-8", "replace")
         rdr = csv_mod.reader(io_mod.StringIO(text), delimiter=delim,
                              quotechar=quote)
@@ -705,47 +1013,104 @@ def _try_csv(req, query: Query, rw, object_size: int, out):
         row_start = np.empty(max_rows + 1, dtype=np.int32)
         consumed = _i64()
         out_len = _i64()
+        naggs = len(aggs) if aggs is not None else 0
+        agg_cnt = np.zeros(naggs, dtype=np.int64)
+        agg_s = np.zeros(naggs, dtype=np.float64)
+        agg_mn = np.zeros(naggs, dtype=np.float64)
+        agg_mx = np.zeros(naggs, dtype=np.float64)
+        agg_mnp = np.zeros(naggs, dtype=np.int32)
+        agg_mnl = np.zeros(naggs, dtype=np.int32)
+        agg_mxp = np.zeros(naggs, dtype=np.int32)
+        agg_mxl = np.zeros(naggs, dtype=np.int32)
+        rows_o = _i64()
+        amb_o = _i64()
         emit_buf = ctypes.create_string_buffer(CHUNK + (1 << 16)) \
             if emit else None
+        saw_q = _i64()
         returned = 0
         outbuf = bytearray()
         limit = query.limit
         n_out = 0
-        tail = leftover
         qb = quote.encode()
-        # one reusable padded arena: read chunks are copied in ONCE and
-        # kernels take (base + off) pointers — no per-block reallocation
-        ba = bytearray(CHUNK + (1 << 20) + PAD)
-        base = (ctypes.c_char * len(ba)).from_buffer(ba)
+        # emit verbatim only when no cell could force the row-engine
+        # writer to quote: input quote char, OUTPUT quote char (they
+        # can differ — a cell may contain '"' while the input quote is
+        # "'"), or a bare \r
+        emit_guards = {qb, b'"', b"\r"}
+        feeder = _Blocks(raw, rw, leftover, compression,
+                         direct_ok=fused is not None)
+        skip_fused = False  # quoted stretch pending: array path decides
         try:
             while True:
-                data = raw.read(CHUNK)
-                final = not data
-                blen = len(tail) + len(data or b"")
-                if blen + PAD > len(ba):
-                    base = None
-                    ba = bytearray(blen * 2 + PAD)
-                    base = (ctypes.c_char * len(ba)).from_buffer(ba)
-                if tail:
-                    ba[:len(tail)] = tail
-                if data:
-                    ba[len(tail):blen] = data
-                ba[blen:blen + PAD] = b"\0" * PAD
-                tail = b""
-                if not blen:
+                blk = feeder.next()
+                if blk is None:
                     break
+                addr, blen, final = blk
                 if emit and limit is not None and n_out >= limit:
                     break
                 off = 0
                 while off < blen:
                     seg_len = blen - off
-                    pad = memoryview(ba)[off:]
-                    cbuf = ctypes.byref(base, off)
+                    pad = feeder.view(off)
+                    cbuf = _vp(addr + off)
+                    if fused is not None and not skip_fused:
+                        lib.sel_csv_agg_fused(
+                            cbuf, seg_len, delim.encode(), qb,
+                            1 if final else 0, _ptr(col_arr),
+                            len(needed), fused["nleaves"],
+                            _ptr(fused["kind"]), _ptr(fused["slot"]),
+                            _ptr(fused["op"]), _ptr(fused["fn"]),
+                            _ptr(fused["fa"]), _ptr(fused["fb"]),
+                            _ptr(fused["num"]), _ptr(fused["aoff"]),
+                            _ptr(fused["alen"]), fused["blob"],
+                            fused["mask"], _ptr(fused["prog"]),
+                            fused["prog_len"], _ptr(fused["ecodes"]),
+                            _ptr(fused["eops"]), naggs,
+                            _ptr(f_aggs["what"]), _ptr(f_aggs["slot"]),
+                            _ptr(agg_cnt), _ptr(agg_s), _ptr(agg_mn),
+                            _ptr(agg_mx), _ptr(agg_mnp), _ptr(agg_mnl),
+                            _ptr(agg_mxp), _ptr(agg_mxl),
+                            ctypes.byref(rows_o), ctypes.byref(amb_o),
+                            ctypes.byref(consumed), ctypes.byref(saw_q))
+                        cons = int(consumed.value)
+                        stats["bytes_scanned"] += cons
+                        if amb_o.value > 0:
+                            replay_rows(pad, 0, cons)
+                        else:
+                            results = []
+                            for ai, (what, colname, fname) in \
+                                    enumerate(aggs):
+                                if agg_cols[ai] is None:
+                                    results.append(
+                                        ("count", int(agg_cnt[ai]), 0.0,
+                                         None, None))
+                                    continue
+                                lo = hi = None
+                                if what == 2 and int(agg_mnl[ai]) >= 0:
+                                    a0 = int(agg_mnp[ai])
+                                    l0 = int(agg_mnl[ai])
+                                    lo = _num(bytes(pad[a0:a0 + l0])
+                                              .decode("utf-8", "replace"))
+                                    a1 = int(agg_mxp[ai])
+                                    l1 = int(agg_mxl[ai])
+                                    hi = _num(bytes(pad[a1:a1 + l1])
+                                              .decode("utf-8", "replace"))
+                                results.append((fname, int(agg_cnt[ai]),
+                                                float(agg_s[ai]), lo, hi))
+                            _commit_agg(ev, results)
+                        off += cons
+                        if int(saw_q.value):
+                            skip_fused = True
+                            continue
+                        if cons == 0:
+                            break
+                        continue
                     n = lib.sel_csv_scan(
                         cbuf, seg_len, delim.encode(), quote.encode(),
                         1 if final else 0, _ptr(col_arr), len(needed),
                         max_rows, _ptr(starts), _ptr(lens),
                         _ptr(row_start), ctypes.byref(consumed))
+                    skip_fused = False  # quoted stretch now consumed
                     if n == -2:
                         # unterminated quote at EOF: Python's csv module
                         # yields the open field as-is — replay exactly
@@ -757,6 +1122,7 @@ def _try_csv(req, query: Query, rw, object_size: int, out):
                             n_out = lim[1]
                         else:
                             replay_rows(pad, 0, seg_len)
+                        stats["bytes_scanned"] += seg_len
                         off = blen
                         break
                     if n == 0:
@@ -818,14 +1184,14 @@ def _try_csv(req, query: Query, rw, object_size: int, out):
                                             float(s.value), lo, hi))
                         if not ambiguous:
                             _commit_agg(ev, results)
-                    if emit and not ambiguous and (
-                            ba.find(qb, off,
-                                    off + int(consumed.value)) >= 0
-                            or ba.find(b"\r", off,
-                                       off + int(consumed.value)) >= 0):
-                        # quoted cells (or bare \r) don't round-trip
-                        # verbatim: the row-engine writer re-quotes —
-                        # replay this batch through it
+                    if emit and not ambiguous and any(
+                            feeder.find(g, off,
+                                        off + int(consumed.value)) >= 0
+                            for g in emit_guards):
+                        # quoted cells (input OR output quote char),
+                        # or bare \r, don't round-trip verbatim: the
+                        # row-engine writer re-quotes — replay this
+                        # batch through it
                         ambiguous = True
                     if ambiguous:
                         if emit:
@@ -874,13 +1240,11 @@ def _try_csv(req, query: Query, rw, object_size: int, out):
                                 del outbuf[:FLUSH]
                         if limit is not None and n_out >= limit:
                             break
+                    stats["bytes_scanned"] += int(consumed.value)
                     off += int(consumed.value)
                     if int(consumed.value) == 0:
                         break
-                if off < blen:
-                    tail = bytes(ba[off:blen])
-                    if len(tail) > (64 << 20):
-                        raise SQLError("record too large")
+                feeder.advance(off)
                 if final:
                     break
             if aggs is not None:
@@ -955,21 +1319,51 @@ def _try_json(req, query: Query, rw, object_size: int, out):
     stats["native"] += 1
     rw.commit()
 
+    # fused one-pass program (parse + predicate + fold per line); the
+    # array path below remains for programs past the kernel bounds
+    fused = None
+    f_aggs = None
+    if getattr(lib, "has_fused", False) and len(all_keys) <= 16:
+        fused = plan.pack_fused([keymap[k] for k in plan.cols])
+        if fused is not None:
+            f_aggs = {
+                "what": np.array([w for w, _, _ in aggs],
+                                 dtype=np.int32),
+                "slot": np.array([-1 if k is None else keymap[k]
+                                  for k in agg_keys], dtype=np.int32),
+            }
+
+    def _replay_line(json_mod, line: bytes) -> None:
+        text = line.decode("utf-8", "replace")
+        try:
+            doc = json_mod.loads(text)
+        except ValueError as e:
+            raise SQLError(f"invalid JSON line: {e}")
+        rec = doc if isinstance(doc, dict) else {"_1": doc}
+        if ev.matches(rec):
+            ev.accumulate(rec)
+
     def replay_rows(pad: bytes, rs: np.ndarray, rl: np.ndarray,
                     rows: np.ndarray) -> None:
         import json as json_mod
 
         stats["replay_blocks"] += 1
         for r in rows:
-            line = bytes(pad[rs[r]:rs[r] + rl[r]]).decode(
-                "utf-8", "replace")
-            try:
-                doc = json_mod.loads(line)
-            except ValueError as e:
-                raise SQLError(f"invalid JSON line: {e}")
-            rec = doc if isinstance(doc, dict) else {"_1": doc}
-            if ev.matches(rec):
-                ev.accumulate(rec)
+            stats["bytes_replayed"] += int(rl[r])
+            _replay_line(json_mod, bytes(pad[rs[r]:rs[r] + rl[r]]))
+
+    def replay_span(pad, nbytes: int) -> None:
+        """Replay a fused-scan span: same per-line semantics as
+        replay_rows, with line splitting done here (the fused kernel
+        materializes no row-extent arrays)."""
+        import json as json_mod
+
+        stats["replay_blocks"] += 1
+        stats["bytes_replayed"] += nbytes
+        for raw_line in bytes(pad[:nbytes]).split(b"\n"):
+            line = raw_line.strip(b" \t\r")
+            if line:
+                _replay_line(json_mod, line)
 
     def gen() -> Iterator[bytes]:
         max_rows = 1 << 18
@@ -983,32 +1377,80 @@ def _try_json(req, query: Query, rw, object_size: int, out):
         row_start = np.empty(max_rows + 1, dtype=np.int32)
         row_len = np.empty(max_rows, dtype=np.int32)
         consumed = _i64()
+        naggs = len(aggs)
+        agg_cnt = np.zeros(naggs, dtype=np.int64)
+        agg_s = np.zeros(naggs, dtype=np.float64)
+        agg_mn = np.zeros(naggs, dtype=np.float64)
+        agg_mx = np.zeros(naggs, dtype=np.float64)
+        agg_mnp = np.zeros(naggs, dtype=np.int32)
+        agg_mnl = np.zeros(naggs, dtype=np.int32)
+        agg_mxp = np.zeros(naggs, dtype=np.int32)
+        agg_mxl = np.zeros(naggs, dtype=np.int32)
+        rows_o = _i64()
+        amb_o = _i64()
         returned = 0
         outbuf = bytearray()
-        tail = b""
-        ba = bytearray(CHUNK + (1 << 20) + PAD)
-        base = (ctypes.c_char * len(ba)).from_buffer(ba)
+        feeder = _Blocks(raw, rw, b"", compression,
+                         direct_ok=fused is not None)
         try:
             while True:
-                data = raw.read(CHUNK)
-                final = not data
-                blen = len(tail) + len(data or b"")
-                if blen + PAD > len(ba):
-                    base = None
-                    ba = bytearray(blen * 2 + PAD)
-                    base = (ctypes.c_char * len(ba)).from_buffer(ba)
-                if tail:
-                    ba[:len(tail)] = tail
-                if data:
-                    ba[len(tail):blen] = data
-                ba[blen:blen + PAD] = b"\0" * PAD
-                tail = b""
-                if not blen:
+                blk = feeder.next()
+                if blk is None:
                     break
+                addr, blen, final = blk
                 off = 0
                 while off < blen:
-                    pad = memoryview(ba)[off:]
-                    cbuf = ctypes.byref(base, off)
+                    pad = feeder.view(off)
+                    cbuf = _vp(addr + off)
+                    if fused is not None:
+                        lib.sel_json_agg_fused(
+                            cbuf, blen - off, 1 if final else 0,
+                            keys_arr, _ptr(key_lens), nk,
+                            fused["nleaves"], _ptr(fused["kind"]),
+                            _ptr(fused["slot"]), _ptr(fused["op"]),
+                            _ptr(fused["isnum"]), _ptr(fused["fn"]),
+                            _ptr(fused["fa"]), _ptr(fused["fb"]),
+                            _ptr(fused["num"]), _ptr(fused["aoff"]),
+                            _ptr(fused["alen"]), fused["blob"],
+                            fused["mask"], _ptr(fused["prog"]),
+                            fused["prog_len"], _ptr(fused["ecodes"]),
+                            _ptr(fused["eops"]), naggs,
+                            _ptr(f_aggs["what"]), _ptr(f_aggs["slot"]),
+                            _ptr(agg_cnt), _ptr(agg_s), _ptr(agg_mn),
+                            _ptr(agg_mx), _ptr(agg_mnp), _ptr(agg_mnl),
+                            _ptr(agg_mxp), _ptr(agg_mxl),
+                            ctypes.byref(rows_o), ctypes.byref(amb_o),
+                            ctypes.byref(consumed))
+                        cons = int(consumed.value)
+                        stats["bytes_scanned"] += cons
+                        if amb_o.value > 0:
+                            replay_span(pad, cons)
+                        else:
+                            results = []
+                            for ai, (what, colname, fname) in \
+                                    enumerate(aggs):
+                                if agg_keys[ai] is None:
+                                    results.append(
+                                        ("count", int(agg_cnt[ai]), 0.0,
+                                         None, None))
+                                    continue
+                                lo = hi = None
+                                if what == 2 and int(agg_mnl[ai]) >= 0:
+                                    a0 = int(agg_mnp[ai])
+                                    l0 = int(agg_mnl[ai])
+                                    lo = _num(bytes(pad[a0:a0 + l0])
+                                              .decode())
+                                    a1 = int(agg_mxp[ai])
+                                    l1 = int(agg_mxl[ai])
+                                    hi = _num(bytes(pad[a1:a1 + l1])
+                                              .decode())
+                                results.append((fname, int(agg_cnt[ai]),
+                                                float(agg_s[ai]), lo, hi))
+                            _commit_agg(ev, results)
+                        off += cons
+                        if cons == 0:
+                            break
+                        continue
                     n = lib.sel_json_scan(
                         cbuf, blen - off, 1 if final else 0, keys_arr,
                         _ptr(key_lens), nk, max_rows, _ptr(starts),
@@ -1090,13 +1532,11 @@ def _try_json(req, query: Query, rw, object_size: int, out):
                     if ambiguous:
                         replay_rows(pad, row_start[:n], row_len[:n],
                                     np.arange(n))
+                    stats["bytes_scanned"] += int(consumed.value)
                     off += int(consumed.value)
                     if int(consumed.value) == 0:
                         break
-                if off < blen:
-                    tail = bytes(ba[off:blen])
-                    if len(tail) > (64 << 20):
-                        raise SQLError("record too large")
+                feeder.advance(off)
                 if final:
                     break
             outbuf += out.serialize(ev.aggregate_result())

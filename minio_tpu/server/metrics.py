@@ -217,6 +217,8 @@ class MetricsMixin:
         # (VERDICT r4 #1 done-condition: the eligibility cliff is
         # observable, not silent)
         try:
+            import minio_tpu.select as sel_pkg
+            from minio_tpu.select import batch as sel_batch
             from minio_tpu.select import columnar as sel_col
             from minio_tpu.select import native as sel_nat
 
@@ -232,9 +234,31 @@ class MetricsMixin:
             gauge("minio_select_columnar_queries_total",
                   "Select queries served by the pyarrow columnar tier",
                   sel_col.stats["fast"])
+            gauge("minio_select_batch_queries_total",
+                  "Select queries served by the compiled row tier",
+                  sel_batch.stats["batch"])
             gauge("minio_select_row_engine_queries_total",
                   "Select queries that fell through to the row engine",
-                  sel_col.stats["fallback"])
+                  sel_pkg.row_stats["queries"])
+            # per-tier bytes scanned + the residual-replay fraction,
+            # so the <5%-residual claim is measurable in production
+            # (ISSUE 2: not just in bench)
+            rows = ["# HELP minio_select_scanned_bytes_total Bytes "
+                    "scanned per Select engine tier",
+                    "# TYPE minio_select_scanned_bytes_total gauge"]
+            for tier, nbytes in (
+                    ("native", sel_nat.stats["bytes_scanned"]),
+                    ("batch", sel_batch.stats["bytes"]),
+                    ("row", sel_pkg.row_stats["bytes"])):
+                rows.append("minio_select_scanned_bytes_total"
+                            f'{{tier="{tier}"}} {nbytes}')
+            g("\n".join(rows) + "\n")
+            scanned = sel_nat.stats["bytes_scanned"]
+            gauge("minio_select_native_replay_fraction",
+                  "Fraction of native-tier bytes re-decided by the "
+                  "Python replay (the residual exactness path)",
+                  round(sel_nat.stats["bytes_replayed"] / scanned, 6)
+                  if scanned else 0.0)
         except Exception:
             pass
 

@@ -25,3 +25,36 @@ os.environ.setdefault("MINIO_TPU_FSYNC", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def _rebuild_native_lib() -> None:
+    """Rebuild csrc/libminio_tpu_host.so when sources are newer than
+    the checked-in binary, so tier-1 containers and dev hosts agree on
+    which kernels they test/benchmark.  Skips silently (keeping the
+    checked-in binary) when no toolchain is present."""
+    import shutil
+    import subprocess
+
+    csrc = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "csrc")
+    lib = os.path.join(csrc, "libminio_tpu_host.so")
+    try:
+        srcs = [f for f in os.listdir(csrc)
+                if f.endswith((".cpp", ".h")) or f == "Makefile"]
+        newest = max(os.path.getmtime(os.path.join(csrc, f))
+                     for f in srcs)
+    except (OSError, ValueError):
+        return
+    if os.path.exists(lib) and os.path.getmtime(lib) >= newest:
+        return
+    if shutil.which("make") is None or (
+            shutil.which("g++") is None and shutil.which("c++") is None):
+        return
+    try:
+        subprocess.run(["make", "-C", csrc], check=False,
+                       capture_output=True, timeout=600)
+    except Exception:
+        pass
+
+
+_rebuild_native_lib()
