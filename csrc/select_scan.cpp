@@ -27,6 +27,11 @@
 #include <cstring>
 #include <cmath>
 #include <cstdlib>
+#include <atomic>
+#include <condition_variable>
+#include <deque>
+#include <functional>
+#include <mutex>
 #include <thread>
 
 #if defined(__SSE2__)
@@ -35,6 +40,81 @@
 #if defined(__AVX2__)
 #include <immintrin.h>
 #endif
+
+// Persistent scan-thread pool.  The fused kernels used to spawn + join
+// a std::thread per part on EVERY >=1 MiB block — thread creation that
+// taxed small-object scans (a 1-2 MiB object paid several clone()s per
+// Select).  Workers are detached process-lifetime daemons created on
+// first demand (cap: FUSED_MAX_THREADS - 1); parts travel over a tiny
+// condvar queue and each batch waits on its own stack latch, so the
+// steady-state cost per block is one lock round per part, not a spawn.
+namespace {
+
+class ScanPool {
+ public:
+  static ScanPool &instance() {
+    // heap singleton, intentionally leaked: a static-storage pool would
+    // be DESTROYED at process exit while detached workers still wait on
+    // its condvar (UB that hangs interpreter shutdown)
+    static ScanPool *pool = new ScanPool();
+    return *pool;
+  }
+
+  // Run fn(pi) for pi in [0, nt): parts 1..nt-1 go to the workers, the
+  // calling thread runs part 0, and the call returns once every part
+  // finished.  Latch lives on the caller's stack — no allocation.
+  void run_parts(int nt, const std::function<void(int)> &fn) {
+    struct Latch {
+      std::mutex mu;
+      std::condition_variable cv;
+      int remaining;
+    } latch;
+    latch.remaining = nt - 1;
+    {
+      std::lock_guard<std::mutex> lk(qmu_);
+      ensure_locked(nt - 1);
+      for (int pi = 1; pi < nt; ++pi)
+        q_.emplace_back([&fn, &latch, pi] {
+          fn(pi);
+          std::lock_guard<std::mutex> lk2(latch.mu);
+          if (--latch.remaining == 0) latch.cv.notify_one();
+        });
+    }
+    qcv_.notify_all();
+    fn(0);
+    std::unique_lock<std::mutex> lk(latch.mu);
+    latch.cv.wait(lk, [&latch] { return latch.remaining == 0; });
+  }
+
+ private:
+  void ensure_locked(int want) {
+    while (nworkers_ < want && nworkers_ < kMaxWorkers) {
+      ++nworkers_;
+      std::thread(&ScanPool::worker, this).detach();
+    }
+  }
+
+  void worker() {
+    for (;;) {
+      std::function<void()> task;
+      {
+        std::unique_lock<std::mutex> lk(qmu_);
+        qcv_.wait(lk, [this] { return !q_.empty(); });
+        task = std::move(q_.front());
+        q_.pop_front();
+      }
+      task();
+    }
+  }
+
+  static const int kMaxWorkers = 7;  // FUSED_MAX_THREADS - 1
+  std::mutex qmu_;
+  std::condition_variable qcv_;
+  std::deque<std::function<void()>> q_;
+  int nworkers_ = 0;
+};
+
+}  // namespace
 
 extern "C" {
 
@@ -1579,13 +1659,9 @@ int64_t sel_csv_agg_fused(
                     P.mn, P.mx, P.mnp, P.mnl, P.mxp, P.mxl, &P.rows,
                     &P.amb, &P.cons, &P.qhit);
             };
-            std::thread th[FUSED_MAX_THREADS];
-            for (int pi = 1; pi < nt; ++pi)
-                th[pi] = std::thread(runp, pi,
-                                     pi == nt - 1 ? final_block : 0);
-            runp(0, 0);
-            for (int pi = 1; pi < nt; ++pi)
-                th[pi].join();
+            ScanPool::instance().run_parts(nt, [&](int pi) {
+                runp(pi, pi == nt - 1 ? final_block : 0);
+            });
             // a quote stops the merge at that part: later parts'
             // results describe rows past the stop point and are
             // discarded (the driver re-scans from *consumed via the
@@ -2557,13 +2633,9 @@ int64_t sel_json_agg_fused(
                     P.mn, P.mx, P.mnp, P.mnl, P.mxp, P.mxl, &P.rows,
                     &P.amb, &P.cons);
             };
-            std::thread th[FUSED_MAX_THREADS];
-            for (int pi = 1; pi < nt; ++pi)
-                th[pi] = std::thread(runp, pi,
-                                     pi == nt - 1 ? final_block : 0);
-            runp(0, 0);
-            for (int pi = 1; pi < nt; ++pi)
-                th[pi].join();
+            ScanPool::instance().run_parts(nt, [&](int pi) {
+                runp(pi, pi == nt - 1 ? final_block : 0);
+            });
             fused_merge(parts, cut, nt, naggs, agg_count, agg_sum,
                         agg_min, agg_max, agg_minpos, agg_minlen,
                         agg_maxpos, agg_maxlen, rows_out, amb_out);

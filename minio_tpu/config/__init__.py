@@ -52,9 +52,44 @@ def register_subsystem(name: str, defaults: dict[str, str],
 
 register_subsystem("api", {
     "requests_max": "auto",
+    "requests_deadline": "1m",
+    "brownout_depth": "auto",
+    "brownout_release": "5s",
 }, [
     HelpKV("requests_max",
            "max concurrent S3 requests (auto = default; needs restart)"),
+    HelpKV("requests_deadline",
+           "per-request deadline budget: admission queue wait beyond it "
+           "sheds with 503 SlowDown, the remainder bounds storage/RPC "
+           "work (duration, e.g. 10s/1m; off = unbounded)"),
+    HelpKV("brownout_depth",
+           "admission-queue depth that engages background brownout "
+           "(auto = half of requests_max)", typ="number"),
+    HelpKV("brownout_release",
+           "quiet time before brownout releases background services "
+           "(duration, e.g. 5s)"),
+])
+
+register_subsystem("audit_kafka", {
+    "enable": "off",
+    "brokers": "",
+    "topic": "",
+}, [
+    HelpKV("enable", "ship audit entries to Kafka", typ="boolean"),
+    HelpKV("brokers", "comma-separated Kafka brokers (host:port)"),
+    HelpKV("topic", "Kafka topic receiving audit entries"),
+])
+
+register_subsystem("logger_kafka", {
+    "enable": "off",
+    "brokers": "",
+    "topic": "",
+    "level": "ERROR",
+}, [
+    HelpKV("enable", "ship server error logs to Kafka", typ="boolean"),
+    HelpKV("brokers", "comma-separated Kafka brokers (host:port)"),
+    HelpKV("topic", "Kafka topic receiving log entries"),
+    HelpKV("level", "minimum level shipped (DEBUG..FATAL)"),
 ])
 
 register_subsystem("scanner", {

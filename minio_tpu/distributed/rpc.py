@@ -22,9 +22,20 @@ import urllib.parse
 import msgpack
 
 from minio_tpu.storage import errors
+from minio_tpu.utils import deadline as deadline_mod
 
 RPC_PREFIX = "/minio_tpu/rpc/v1"
 HEALTH_INTERVAL = 5.0
+
+# remaining deadline budget, in whole milliseconds, forwarded on every
+# hop so a callee (and ITS callees) never spend more time than the
+# original caller has left (reference: context deadlines riding the
+# storage REST calls)
+DEADLINE_HEADER = "x-minio-tpu-deadline-ms"
+
+# observability for the deadline plane (read by server/metrics.py);
+# bare int bumps — the GIL makes them safe enough for counters
+deadline_stats = {"expired_local": 0, "expired_remote": 0}
 
 # per-attempt timeout for unary idempotent calls: a hung peer costs at
 # most this long before it degrades to an offline mark, not the 30 s
@@ -68,6 +79,21 @@ def check_token(secret: str, token: str) -> bool:
         if hmac.compare_digest(want, token):
             return True
     return False
+
+
+def _wire_ms(budget) -> int | None:
+    """Remaining budget as a positive wire value, or None (no header).
+    A sub-millisecond remainder rounds UP to 1 ms instead of truncating
+    to no-header — the hop with the least time left must not be the one
+    that runs unbounded on the server.  A fully expired budget sends no
+    header: it either failed fast client-side (idempotent) or must not
+    doom a commit."""
+    if budget is None:
+        return None
+    rem = budget.remaining()
+    if rem == float("inf") or rem <= 0:
+        return None
+    return max(1, int(rem * 1000))
 
 
 def pack_error(e: Exception) -> dict:
@@ -173,11 +199,14 @@ class RpcClient:
 
     # -- calls --------------------------------------------------------------
     def _send_request(self, conn, method: str, payload: bytes,
-                      body: bytes) -> "http.client.HTTPResponse":
+                      body: bytes, deadline_ms: int | None = None
+                      ) -> "http.client.HTTPResponse":
         path = f"{RPC_PREFIX}/{urllib.parse.quote(method)}"
         conn.putrequest("POST", path)
         conn.putheader("x-minio-tpu-token", auth_token(self.secret))
         conn.putheader("x-args-length", str(len(payload)))
+        if deadline_ms is not None:
+            conn.putheader(DEADLINE_HEADER, str(deadline_ms))
         conn.putheader("Content-Length", str(len(payload) + len(body)))
         conn.endheaders()
         conn.send(payload)
@@ -239,6 +268,21 @@ class RpcClient:
                     # stale offline mark: this call doubles as the probe
                     probing = True
                     self._last_check = time.time()
+        # ambient request budget (utils/deadline): an idempotent call
+        # fails fast once the budget is spent, and its retry loop is
+        # clamped so a retry never exceeds the caller's remaining time;
+        # the remainder travels as a header so the callee's own work and
+        # nested hops inherit it
+        budget = deadline_mod.current()
+        if budget is not None and budget.t_end is None:
+            budget = None  # unbounded: nothing to clamp or forward
+        if budget is not None and idempotent and not _probe:
+            rem = budget.remaining()
+            if rem <= 0:
+                deadline_stats["expired_local"] += 1
+                raise errors.DeadlineExceeded(
+                    f"rpc {method}: request deadline budget exhausted")
+            deadline = rem if deadline is None else min(deadline, rem)
         payload = msgpack.packb(args, use_bin_type=True)
         if not idempotent:
             # no retry; bounded unary deadline unless the op does
@@ -255,7 +299,8 @@ class RpcClient:
                 self.mark_offline()  # could not even connect: peer is down
                 raise RpcTransportError(f"rpc {method}: {e}")
             try:
-                resp = self._send_request(conn, method, payload, body)
+                resp = self._send_request(conn, method, payload, body,
+                                          _wire_ms(budget))
             except (OSError, http.client.HTTPException) as e:
                 # the peer ACCEPTED the connection — this is a per-call
                 # (likely per-drive) fault, not peer death: do NOT poison
@@ -306,7 +351,8 @@ class RpcClient:
                 continue
             connect_failed = False
             try:
-                resp = self._send_request(conn, method, payload, body)
+                resp = self._send_request(conn, method, payload, body,
+                                          _wire_ms(budget))
             except (OSError, http.client.HTTPException) as e:
                 conn.close()
                 last = e
@@ -444,6 +490,24 @@ class RpcRouter:
             fn = self.methods.get(method)
             if fn is None:
                 return web.Response(status=404)
+            # deadline propagation: a hop arriving with its budget spent
+            # is answered immediately — executing it would waste a worker
+            # on a result the caller already abandoned
+            budget = None
+            dl_hdr = request.headers.get(DEADLINE_HEADER, "")
+            if dl_hdr:
+                try:
+                    ms = int(dl_hdr)
+                except ValueError:
+                    ms = None
+                if ms is not None:
+                    if ms <= 0:
+                        deadline_stats["expired_remote"] += 1
+                        return web.Response(status=500, body=msgpack.packb(
+                            pack_error(errors.DeadlineExceeded(
+                                f"rpc {method}: deadline expired on "
+                                "arrival"))))
+                    budget = deadline_mod.Budget.from_millis(ms)
             raw = await request.read()
             args_len = int(request.headers.get("x-args-length", len(raw)))
             args = msgpack.unpackb(raw[:args_len], raw=False) if args_len else {}
@@ -451,8 +515,16 @@ class RpcRouter:
             import asyncio
             loop = asyncio.get_running_loop()
             pool = self._pool()
+
+            def invoke():
+                # install the caller's remaining budget in the worker
+                # thread so the handler's drive gates and nested RPC
+                # hops inherit it
+                with deadline_mod.scope(budget):
+                    return fn(args, body)
+
             try:
-                result = await loop.run_in_executor(pool, fn, args, body)
+                result = await loop.run_in_executor(pool, invoke)
             except Exception as e:
                 return web.Response(
                     status=500, body=msgpack.packb(pack_error(e))

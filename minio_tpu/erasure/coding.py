@@ -520,16 +520,23 @@ class Erasure:
     # -- streaming decode (cmd/erasure-decode.go:206) -----------------------
     def _read_group(self, readers: Sequence, broken: set[int],
                     shard_off: int, read_len: int, nblocks: int,
-                    shard_len: int, pool) -> dict[int, np.ndarray]:
+                    shard_len: int, pool,
+                    prefer: Sequence[int] | None = None
+                    ) -> dict[int, np.ndarray]:
         """Read one group of `nblocks` consecutive shard blocks from the
         first k healthy readers, work-stealing to spare drives on failure
         (parallelReader.Read trigger channels, cmd/erasure-decode.go:101).
+
+        `prefer` reorders the candidates (hedging: the caller puts slow
+        drives last so the first k reads route around them); default is
+        shard-index order.
 
         Returns {shard_index: (nblocks, shard_len) uint8}; exactly k entries.
         """
         n = self.k + self.m
         got: dict[int, np.ndarray] = {}
-        order = [i for i in range(n) if readers[i] is not None and i not in broken]
+        cand = range(n) if prefer is None else prefer
+        order = [i for i in cand if readers[i] is not None and i not in broken]
         idx_iter = iter(order)
         active = []
         try:
@@ -585,7 +592,8 @@ class Erasure:
 
     def decode_stream(self, writer, readers: Sequence, offset: int,
                       length: int, total_length: int,
-                      broken_out: set | None = None) -> int:
+                      broken_out: set | None = None,
+                      prefer: Sequence[int] | None = None) -> int:
         """Read shard streams (None = unavailable), reconstruct if needed,
         write plain object bytes [offset, offset+length) to writer.
 
@@ -633,7 +641,7 @@ class Erasure:
                 shard_len = self.shard_size
                 got = self._read_group(
                     readers, broken, block_idx * shard_len, g * shard_len,
-                    g, shard_len, pool,
+                    g, shard_len, pool, prefer,
                 )
                 data = self._assemble_data(got, g, shard_len)
                 flat = data.reshape(g, self.k * shard_len)
@@ -654,7 +662,7 @@ class Erasure:
                 shard_len = -(-cur_size // self.k)
                 got = self._read_group(
                     readers, broken, block_idx * self.shard_size, shard_len,
-                    1, shard_len, pool,
+                    1, shard_len, pool, prefer,
                 )
                 data = self._assemble_data(got, 1, shard_len)
                 block = data.reshape(-1)[:cur_size]

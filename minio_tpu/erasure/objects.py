@@ -30,11 +30,27 @@ from minio_tpu.storage.xlmeta import (
     ChecksumInfo, ErasureInfo, FileInfo, ObjectPartInfo,
     find_file_info_in_quorum, new_data_dir, new_version_id,
 )
+from minio_tpu.utils import deadline as deadline_mod
 from minio_tpu.utils.hashing import hash_order
 from . import bitrot
 from .coding import BLOCK_SIZE_V2, Erasure, _io_pool
 
 SMALL_FILE_THRESHOLD = 128 << 10  # inline shards into xl.meta below this
+
+# --- deadline-aware read plane -------------------------------------------
+# once a metadata quorum is in hand, stragglers get this much longer
+# before the fan-out abandons them (reference returns at quorum and
+# cancels the rest; tail-at-scale hedging literature in PAPERS.md)
+STRAGGLER_GRACE = float(os.environ.get(
+    "MINIO_TPU_STRAGGLER_GRACE_MS", "50")) / 1000.0
+# a drive whose EWMA read latency crosses this threshold is hedged:
+# deprioritized behind spare (parity) shards so quorum reads route
+# around it while it stays available as a fallback
+HEDGE_EWMA_S = float(os.environ.get(
+    "MINIO_TPU_HEDGE_EWMA_MS", "100")) / 1000.0
+
+# observability (read by server/metrics.py); GIL-safe counter bumps
+hedge_stats = {"hedged": 0, "abandoned": 0}
 
 # tiering stub metadata (never surfaced to clients)
 TRANSITION_STATUS_KEY = "x-minio-internal-transition-status"
@@ -312,8 +328,9 @@ class ErasureObjects:
                            read_data: bool = False
                            ) -> tuple[list[FileInfo | None], list[Exception | None]]:
         disks = self.disks
-        fis: list[FileInfo | None] = [None] * len(disks)
-        errs: list[Exception | None] = [None] * len(disks)
+        n = len(disks)
+        fis: list[FileInfo | None] = [None] * n
+        errs: list[Exception | None] = [None] * n
 
         def read(i: int):
             d = disks[i]
@@ -321,12 +338,62 @@ class ErasureObjects:
                 raise errors.DiskNotFound(str(i))
             return d.read_version(bucket, obj, version_id, read_data)
 
-        futs = {i: _io_pool().submit(read, i) for i in range(len(disks))}
-        for i, f in futs.items():
+        futs = {deadline_mod.ctx_submit(_io_pool(), read, i): i
+                for i in range(n)}
+        budget = deadline_mod.current()
+        if budget is None or budget.t_end is None:
+            # no deadline in play (background scans/heals): preserve the
+            # complete fan-out — health accounting wants every answer
+            for f, i in futs.items():
+                try:
+                    fis[i] = f.result()
+                except Exception as e:
+                    errs[i] = e
+            return fis, errs
+        # deadline-aware: return at quorum, abandon stragglers.  A
+        # FileInfo must actually be ELECTABLE from the answers in hand
+        # (modal signature at the object's own read quorum — RRS parity
+        # and mixed votes during a concurrent overwrite both demand more
+        # than a bare success count) before stragglers are put on the
+        # STRAGGLER_GRACE clock; a +500 ms drive then costs 50 ms, not
+        # the whole RPC timeout (cmd/erasure-metadata-utils.go
+        # readAllFileInfo; hedged-request literature in PAPERS.md).
+        def electable() -> bool:
             try:
-                fis[i] = f.result()
-            except Exception as e:
-                errs[i] = e
+                rq, _ = self._quorum_from(fis)
+                find_file_info_in_quorum(fis, rq)
+                return True
+            except Exception:
+                return False
+
+        pending = set(futs)
+        elected = False
+        while pending:
+            timeout = budget.remaining()
+            if elected:
+                timeout = min(timeout, STRAGGLER_GRACE)
+            if timeout <= 0:
+                break
+            done, pending = cf.wait(pending, timeout=timeout,
+                                    return_when=cf.FIRST_COMPLETED)
+            if not done:
+                break  # grace or budget spent: abandon the rest
+            got_new = False
+            for f in done:
+                i = futs[f]
+                try:
+                    fis[i] = f.result()
+                    got_new = True
+                except Exception as e:
+                    errs[i] = e
+            if got_new and not elected:
+                elected = electable()
+        for f in pending:
+            i = futs[f]
+            f.cancel()  # un-started pool items never run
+            errs[i] = errors.DeadlineExceeded(
+                f"drive {i}: straggler abandoned at quorum")
+            hedge_stats["abandoned"] += 1
         return fis, errs
 
     def _quorum_info(self, bucket, obj, version_id="", read_data=False):
@@ -517,7 +584,11 @@ class ErasureObjects:
         return ObjectInfo.from_file_info(fi, bucket, obj, opts.versioned)
 
     def _fan_out(self, fn: Callable[[int], None], idxs) -> list[Exception | None]:
-        futs = {i: _io_pool().submit(fn, i) for i in idxs}
+        # ctx_submit carries the request's deadline budget into the pool
+        # threads so remote hops clamp their retries; writes still await
+        # EVERY drive (quorum accounting needs all outcomes — only the
+        # read path returns early)
+        futs = {i: deadline_mod.ctx_submit(_io_pool(), fn, i) for i in idxs}
         out: list[Exception | None] = [None] * len(self.disks)
         for i, f in futs.items():
             try:
@@ -643,33 +714,98 @@ class ErasureObjects:
 
                 till = e.shard_file_size(part.size)
                 readers: list[bitrot.BitrotReader | None] = [None] * n
+                # hedge: classify shard sources by EWMA read latency —
+                # a drive past HEDGE_EWMA_S is deprioritized behind the
+                # spare (parity) shards, and its reader is only opened
+                # when the fast shards cannot cover k+1 (quorum + one
+                # steal target).  Slow drives stop taxing every read;
+                # they remain fallbacks if a fast shard fails
+                # (tail-at-scale hedged requests; reference picks
+                # readers by health, cmd/erasure-decode.go).
+                fast: list[int] = []
+                slow: list[int] = []
                 for i in range(n):
                     if inline_by_index[i] is not None:
-                        readers[i] = bitrot.BitrotReader(
-                            io.BytesIO(inline_by_index[i]), till, e.shard_size
-                        )
+                        fast.append(i)
                         continue
                     d = disks_by_index[i]
                     if d is None:
                         heal_needed = True
                         continue
+                    ewma_of = getattr(d, "op_ewma", None)
+                    lat = (ewma_of("read_file_stream")
+                           if ewma_of is not None else 0.0)
+                    (slow if lat > HEDGE_EWMA_S else fast).append(i)
+                # enough fast shards -> slow drives are hedged out
+                # entirely (waiting on a slow spare would reintroduce
+                # the tail); short of k, pull in slow ones + one spare
+                # as steal margin.  A failed fast open falls back to a
+                # second round over the hedged-out drives below.
+                if len(fast) >= e.k:
+                    want = len(fast)
+                else:
+                    want = min(e.k + 1, len(fast) + len(slow))
+                open_set = fast + slow[:max(0, want - len(fast))]
+                skipped = (len(fast) + len(slow)) - len(open_set)
+                if skipped > 0:
+                    hedge_stats["hedged"] += skipped
+                prefer = list(open_set)  # fast first, chosen slow last
+
+                def open_one(i: int):
+                    if inline_by_index[i] is not None:
+                        return bitrot.BitrotReader(
+                            io.BytesIO(inline_by_index[i]), till,
+                            e.shard_size)
+                    fh = disks_by_index[i].read_file_stream(
+                        bucket, f"{obj}/{fi.data_dir}/part.{part.number}",
+                        0, bitrot.bitrot_shard_file_size(
+                            till, e.shard_size, _bitrot_algo_of(fi)),
+                    )
+                    return bitrot.BitrotReader(
+                        fh, till, e.shard_size, algo=_bitrot_algo_of(fi))
+
+                # parallel opens: with injected +500 ms latency the cost
+                # is one round, not one round PER drive
+                open_futs = {i: deadline_mod.ctx_submit(
+                    _io_pool(), open_one, i) for i in open_set}
+                for i, f in open_futs.items():
                     try:
-                        fh = d.read_file_stream(
-                            bucket, f"{obj}/{fi.data_dir}/part.{part.number}",
-                            0, bitrot.bitrot_shard_file_size(
-                                till, e.shard_size, _bitrot_algo_of(fi)),
-                        )
-                        readers[i] = bitrot.BitrotReader(
-                            fh, till, e.shard_size, algo=_bitrot_algo_of(fi))
+                        readers[i] = f.result()
                     except Exception:
                         heal_needed = True
                         readers[i] = None
+                if sum(1 for i in open_set if readers[i] is not None) \
+                        < e.k:
+                    # fast opens fell short of k: the hedged-out slow
+                    # drives are the remaining sources — open them now
+                    rest = [i for i in fast + slow if i not in open_set]
+                    futs2 = {i: deadline_mod.ctx_submit(
+                        _io_pool(), open_one, i) for i in rest}
+                    for i, f in futs2.items():
+                        try:
+                            readers[i] = f.result()
+                        except Exception:
+                            heal_needed = True
+                            readers[i] = None
+                    prefer = prefer + rest
+                else:
+                    # hedged-out drives stay available as LAZY steal
+                    # targets: nothing is opened (no latency paid) until
+                    # a fast shard fails MID-STREAM and the decode
+                    # work-steals to a spare — without this, exactly-k
+                    # fast readers would turn one bitrot hit into a
+                    # read-quorum error while healthy slow shards sit
+                    # unused
+                    lazies = [i for i in slow if i not in open_set]
+                    for i in lazies:
+                        readers[i] = _LazyShardReader(open_one, i)
+                    prefer = prefer + lazies
                 sink = _IterSink()
                 broken: set[int] = set()
                 worker = threading.Thread(
                     target=self._decode_to_sink,
                     args=(e, sink, readers, local_off, local_len, part.size,
-                          broken),
+                          broken, prefer),
                     daemon=True,
                 )
                 worker.start()
@@ -702,10 +838,10 @@ class ErasureObjects:
 
     @staticmethod
     def _decode_to_sink(e, sink, readers, offset, length, total,
-                        broken_out=None):
+                        broken_out=None, prefer=None):
         try:
             e.decode_stream(sink, readers, offset, length, total,
-                            broken_out=broken_out)
+                            broken_out=broken_out, prefer=prefer)
         except Exception as ex:
             sink.error = ex
         finally:
@@ -1210,6 +1346,38 @@ class ErasureObjects:
                     pass
             result.drives_after = list(healthy)
             return result
+
+
+class _LazyShardReader:
+    """Steal-only spare: a hedged-out slow drive's BitrotReader that is
+    opened on FIRST USE, not upfront.  The happy path never touches it
+    (no latency paid); the decode work-steal path resolves it only when
+    a fast shard fails mid-stream, paying the slow open once for the
+    recovery instead of on every read."""
+
+    def __init__(self, open_fn, idx: int):
+        self._open_fn = open_fn
+        self._idx = idx
+        self._inner = None
+        self._mu = threading.Lock()
+
+    def _resolve(self):
+        with self._mu:
+            if self._inner is None:
+                self._inner = self._open_fn(self._idx)  # may raise: steal
+            return self._inner                          # marks it broken
+
+    def read_blocks(self, offset: int, nblocks: int, block_len: int):
+        return self._resolve().read_blocks(offset, nblocks, block_len)
+
+    def read_at(self, offset: int, length: int) -> bytes:
+        return self._resolve().read_at(offset, length)
+
+    def close(self) -> None:
+        with self._mu:
+            inner, self._inner = self._inner, None
+        if inner is not None:
+            inner.close()
 
 
 class MethodNotAllowedDeleteMarker(errors.MethodNotAllowed):

@@ -286,6 +286,19 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             except ValueError:
                 pass
         self.sem = asyncio.Semaphore(max_concurrency)
+        self.max_concurrency = max_concurrency
+        # end-to-end deadline budget (reference requests_deadline,
+        # cmd/handler-api.go:108): admission waits at most this long for
+        # an API slot before shedding 503 SlowDown; the remainder rides
+        # the request into storage/RPC as a budget
+        from minio_tpu.utils import deadline as deadline_mod
+
+        try:
+            self.requests_deadline = deadline_mod.parse_duration(
+                self.config.get("api", "requests_deadline"))
+        except ValueError:
+            self.requests_deadline = 60.0  # typo'd knob: keep the default
+        self._waiters = 0  # event-loop-only counter of admission waiters
         # Dedicated pool sized to the request semaphore so a full house of
         # blocking object-layer calls can never starve body-feed tasks
         # (reference analogue: maxClients semaphore, cmd/handler-api.go:108).
@@ -298,7 +311,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         self.site = SiteReplicationSys(object_layer, self.meta, self.iam)
         eq = _event_queue_dir(object_layer)
         log.init_audit(queue_dir=os.path.join(os.path.dirname(eq), "audit")
-                       if eq else None)
+                       if eq else None, config=self.config)
         self.app = web.Application(client_max_size=1 << 30)
         self.init_metrics()
         # fixed-prefix routes (admin + metrics/health) win over the S3
@@ -376,6 +389,29 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             services.replication = ReplicationPool(
                 self.api, self.meta,
                 workers=self.config.get_int("replication", "workers", 2))
+        if services is not None \
+                and getattr(services, "brownout", None) is not None:
+            # brownout thresholds from config (api.brownout_*): depth
+            # "auto" = half the API slots — queue depth beyond that means
+            # the foreground is saturated and background work must yield
+            from minio_tpu.utils import deadline as deadline_mod
+
+            bo = services.brownout
+            depth_raw = self.config.get("api", "brownout_depth", "auto")
+            if depth_raw not in ("", "auto"):
+                try:
+                    bo.engage_depth = max(1, int(depth_raw))
+                except ValueError:
+                    pass
+            else:
+                bo.engage_depth = max(2, self.max_concurrency // 2)
+            try:
+                rel = deadline_mod.parse_duration(
+                    self.config.get("api", "brownout_release", "5s"))
+                if rel is not None:
+                    bo.release_after = rel
+            except ValueError:
+                pass
         if services is not None:
             # dynamic config application (reference applyDynamicConfig)
             def _apply_scanner(cfg):
@@ -412,8 +448,24 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
 
     # ------------------------------------------------------------------ util
     async def _run(self, fn, *args, **kw):
+        # copy_context carries the request's deadline budget into the
+        # executor thread (run_in_executor alone drops contextvars)
+        import contextvars
+
         loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(self.executor, lambda: fn(*args, **kw))
+        ctx = contextvars.copy_context()
+        return await loop.run_in_executor(
+            self.executor, lambda: ctx.run(fn, *args, **kw))
+
+    async def _run_nobudget(self, fn, *args, **kw):
+        """_run WITHOUT the request's deadline budget: body streaming and
+        other whole-payload phases (PUT bodies, multipart assembly, GET
+        streaming, Select scans) must not be killed mid-transfer when the
+        admission budget — which bounds queue wait and time-to-first-byte
+        work — runs out."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(self.executor,
+                                          lambda: fn(*args, **kw))
 
     async def _feed(self, pipe: "_QueuePipeReader", item, task) -> None:
         """Non-blocking queue feed from the event loop; aborts if the
@@ -556,14 +608,83 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             conditions=conditions,
         ))
 
+    def _request_budget(self, request: web.Request):
+        """Deadline budget for one request: `api.requests_deadline`
+        clamped down by an `x-amz-request-timeout` header (the client may
+        only SHORTEN its budget — a raise would bypass shedding)."""
+        from minio_tpu.utils import deadline as deadline_mod
+
+        seconds = self.requests_deadline
+        hdr = request.headers.get("x-amz-request-timeout")
+        if hdr:
+            try:
+                v = deadline_mod.parse_duration(hdr)
+            except ValueError:
+                v = None  # malformed header: ignore, keep the config knob
+            if v is not None:
+                seconds = v if seconds is None else min(seconds, v)
+        return deadline_mod.Budget(seconds)
+
+    def _shed_response(self, api: str) -> web.Response:
+        """503 SlowDown for a request shed at admission (reference sheds
+        with 503 after requests_deadline, cmd/handler-api.go:108)."""
+        self._m_shed.inc()
+        svcs = self.services
+        if svcs is not None and getattr(svcs, "brownout", None) is not None:
+            svcs.brownout.note_shed()
+        e = S3Error("SlowDown",
+                    "request shed: admission queue wait exceeded the "
+                    "request deadline")
+        return web.Response(
+            status=e.status, body=e.to_xml(secrets.token_hex(8)),
+            content_type="application/xml",
+            headers={"Retry-After": "1"},
+        )
+
     async def _handle(self, request: web.Request, fn) -> web.StreamResponse:
+        from minio_tpu.utils import deadline as deadline_mod
+
         t0 = time.monotonic()
         api = getattr(fn, "__name__", "unknown")
         self._m_inflight.inc()
         status = 500
         tx = 0
+        budget = self._request_budget(request)
         try:
-            async with self.sem:
+            # ---- admission: bounded queue wait, shed on expiry --------
+            # fast path first: a free slot must not count as queue
+            # pressure — only requests that actually find the semaphore
+            # exhausted become waiters (a same-tick burst on an idle
+            # server would otherwise spuriously engage brownout)
+            svcs = self.services
+            if not self.sem.locked():
+                await self.sem.acquire()
+            else:
+                self._waiters += 1
+                self._m_queue_waiting.inc()
+                try:
+                    if svcs is not None \
+                            and getattr(svcs, "brownout", None) is not None:
+                        svcs.brownout.note_pressure(self._waiters)
+                    wait = budget.remaining()
+                    if wait == float("inf"):
+                        await self.sem.acquire()
+                    else:
+                        try:
+                            await asyncio.wait_for(self.sem.acquire(),
+                                                   timeout=wait)
+                        except asyncio.TimeoutError:
+                            status = 503
+                            return self._shed_response(api)
+                except asyncio.CancelledError:
+                    status = 499  # client gave up while queued
+                    raise
+                finally:
+                    self._waiters -= 1
+                    self._m_queue_waiting.dec()
+            self._m_queue_wait.observe(time.monotonic() - t0)
+            token = deadline_mod.set_current(budget)
+            try:
                 try:
                     resp = await fn(request)
                     status = resp.status
@@ -591,6 +712,9 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                         body=s3e.to_xml(secrets.token_hex(8)),
                         content_type="application/xml",
                     )
+            finally:
+                deadline_mod.reset(token)
+                self.sem.release()
         finally:
             dt = time.monotonic() - t0
             self._m_inflight.dec()
@@ -1437,7 +1561,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 return extra
 
             opts.finalize_metadata = _with_trailer_checksum
-        put_task = asyncio.ensure_future(self._run(
+        put_task = asyncio.ensure_future(self._run_nobudget(
             self.api.put_object, bucket, key, reader, real_size, opts
         ))
         check_hash = (
@@ -1829,7 +1953,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             plain = sse_mod.plain_size_of(soi.size)
             _, ct_stream = await self._run(
                 self.api.get_object, sbucket, skey, 0, -1, vid)
-            data = await self._run(lambda: b"".join(sse_mod.decrypt_chunks(
+            data = await self._run_nobudget(lambda: b"".join(sse_mod.decrypt_chunks(
                 iter(ct_stream), obj_key, nonce_prefix,
                 f"{sbucket}/{skey}".encode(), 0, 0, plain)))
             for k in (sse_mod.META_ALGO, sse_mod.META_SEALED_KEY,
@@ -1840,7 +1964,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             oi, stream = await self._run(
                 self.api.get_object, sbucket, skey, 0, -1, vid
             )
-            data = await self._run(lambda: b"".join(stream))
+            data = await self._run_nobudget(lambda: b"".join(stream))
         from minio_tpu.utils import compress as compress_mod
 
         if src_meta.get(
@@ -1885,7 +2009,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 "etag": creader.etag,
             }
             size = -1
-        new_oi = await self._run(
+        new_oi = await self._run_nobudget(
             self.api.put_object, bucket, key, reader, size, opts
         )
         await self._maybe_replicate(request, bucket, key, new_oi)
@@ -1971,7 +2095,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         it = iter(chunks)
         try:
             while True:
-                chunk = await self._run(next, it, None)
+                chunk = await self._run_nobudget(next, it, None)
                 if chunk is None:
                     break
                 await resp.write(chunk)
@@ -2058,7 +2182,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         it = iter(stream)
         try:
             while True:
-                chunk = await self._run(next, it, None)
+                chunk = await self._run_nobudget(next, it, None)
                 if chunk is None:
                     break
                 await resp.write(chunk)
@@ -2257,7 +2381,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             gen = run_select(sreq, stream, src_size)
             # produce the FIRST message on the executor before preparing
             # the response: parse/plan errors still map to clean HTTP 4xx
-            first = await self._run(next, gen, None)
+            first = await self._run_nobudget(next, gen, None)
         except SQLError as e:
             raise S3Error("InvalidArgument", str(e))
         from minio_tpu.events.event import EventName
@@ -2272,7 +2396,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             msg = first
             while msg is not None:
                 await resp.write(msg)
-                msg = await self._run(next, gen, None)
+                msg = await self._run_nobudget(next, gen, None)
         finally:
             if hasattr(raw, "close"):
                 await self._run(raw.close)
@@ -2361,7 +2485,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 pipe, None if "UNSIGNED" in sha_claim else ctx)
             if streaming else pipe
         )
-        task = asyncio.ensure_future(self._run(
+        task = asyncio.ensure_future(self._run_nobudget(
             self.api.put_object_part, bucket, key, uid, part_num, reader,
             real_size
         ))
@@ -2478,7 +2602,9 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         from minio_tpu.erasure.multipart import EntityTooSmall
 
         try:
-            oi = await self._run(
+            # part assembly is O(object bytes): exempt from the admission
+            # budget like the other whole-payload phases
+            oi = await self._run_nobudget(
                 self.api.complete_multipart_upload, bucket, key, uid, parts
             )
         except EntityTooSmall:

@@ -52,6 +52,18 @@ class MetricsMixin:
         self._m_inflight = r.gauge(
             "minio_s3_requests_inflight_total",
             "Currently executing S3 requests")
+        # admission control / deadline plane (reference requests_deadline,
+        # cmd/handler-api.go:108)
+        self._m_queue_wait = r.histogram(
+            "minio_s3_queue_wait_seconds",
+            "Admission queue wait before an API slot was granted",
+            buckets=(.001, .005, .01, .05, .1, .25, .5, 1, 2.5, 5, 10))
+        self._m_queue_waiting = r.gauge(
+            "minio_s3_requests_waiting_total",
+            "Requests currently waiting for an API slot")
+        self._m_shed = r.counter(
+            "minio_s3_requests_shed_total",
+            "Requests shed with 503 SlowDown at admission")
         self._m_rx = r.counter(
             "minio_s3_traffic_received_bytes",
             "Bytes received from S3 clients")
@@ -262,6 +274,46 @@ class MetricsMixin:
         except Exception:
             pass
 
+        # deadline/overload plane: hedged shard reads, abandoned
+        # stragglers, RPC budget expiries, per-drive deadline timeouts
+        try:
+            from minio_tpu.distributed import rpc as rpc_mod
+            from minio_tpu.erasure import objects as eobj
+
+            gauge("minio_read_hedges_total",
+                  "Shard reads steered away from a slow drive to a spare",
+                  eobj.hedge_stats["hedged"])
+            gauge("minio_read_stragglers_abandoned_total",
+                  "Quorum fan-out stragglers abandoned after the grace "
+                  "window", eobj.hedge_stats["abandoned"])
+            gauge("minio_rpc_deadline_expired_total",
+                  "RPC calls refused because the budget was already "
+                  "spent (caller side)",
+                  rpc_mod.deadline_stats["expired_local"])
+            gauge("minio_rpc_deadline_rejected_total",
+                  "RPC requests rejected expired-on-arrival (server "
+                  "side)", rpc_mod.deadline_stats["expired_remote"])
+        except Exception:
+            pass
+        try:
+            # `drives` computed by the capacity block above; absent only
+            # if storage_info failed there (then skip this block too)
+            rows = ["# HELP minio_drive_deadline_timeouts_total Per-op "
+                    "deadline-worker timeouts per drive",
+                    "# TYPE minio_drive_deadline_timeouts_total gauge"]
+            any_ = False
+            for d in drives:
+                h = d.get("health")
+                if h and h.get("deadlineTimeouts"):
+                    lbl = _fmt_labels(("drive",), (d["endpoint"],))
+                    rows.append("minio_drive_deadline_timeouts_total"
+                                f'{lbl} {h["deadlineTimeouts"]}')
+                    any_ = True
+            if any_:
+                g("\n".join(rows) + "\n")
+        except Exception:
+            pass
+
         # usage from the scanner cache (reference BucketUsage group)
         svcs = getattr(self, "services", None)
         if svcs is not None:
@@ -292,6 +344,19 @@ class MetricsMixin:
             gauge("minio_heal_resync_objects_total",
                   "Objects enqueued for heal by drive re-syncs",
                   getattr(svcs, "resync_objects", 0))
+            bo = getattr(svcs, "brownout", None)
+            if bo is not None:
+                bs = bo.stats()
+                gauge("minio_brownout_engaged",
+                      "1 while background services are browned out under "
+                      "foreground overload", 1 if bs["engaged"] else 0)
+                gauge("minio_brownout_engagements_total",
+                      "Brownout engage transitions", bs["engagements"])
+                gauge("minio_brownout_releases_total",
+                      "Brownout release transitions", bs["releases"])
+                gauge("minio_brownout_deferred_ops_total",
+                      "Background operations deferred while browned out",
+                      bs["deferrals"])
             if svcs.replication is not None:
                 rs = svcs.replication.stats
                 gauge("minio_replication_completed_total",

@@ -6,6 +6,7 @@ initAutoHeal/initHealMRF/initDataScanner (cmd/server-main.go:528-585).
 
 from __future__ import annotations
 
+from .brownout import BrownoutController
 from .heal import (BackgroundHealer, HealManager, HealSequence,
                    HealSequenceStatus, heal_fresh_disks,
                    load_healing_tracker, mark_disk_healing)
@@ -28,14 +29,20 @@ class ServiceManager:
             monitor_interval = float(
                 os.environ.get("MINIO_TPU_MONITOR_INTERVAL", "10"))
         self.ol = object_layer
+        # brownout plane: the API front feeds pressure in, every
+        # background worker asks permission before spending drive IOPs
+        self.brownout = BrownoutController()
         self.mrf = MRFQueue(object_layer)
+        self.mrf.throttle = self.brownout.background_allowed
         self.heals = HealManager(object_layer)
         self.tracker = DataUpdateTracker()
         self.scanner = DataScanner(object_layer, interval=scan_interval,
                                    heal_queue=self.mrf.enqueue,
                                    lifecycle_fn=lifecycle_fn,
                                    tracker=self.tracker)
+        self.scanner.throttle = self.brownout.background_allowed
         self.bg_heal = BackgroundHealer(object_layer, interval=heal_interval)
+        self.bg_heal.throttle = self.brownout.background_allowed
         self.monitor = DriveMonitor(object_layer,
                                     interval=monitor_interval)
         self.replication = None  # ReplicationPool, wired by attach_services

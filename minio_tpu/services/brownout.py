@@ -1,0 +1,88 @@
+"""Brownout controller: background services yield under foreground load.
+
+When the API admission queue deepens or requests start shedding, the
+scanner, background heal and the MRF queue are throttled so every drive
+IOP serves a waiting client; when the pressure drains for
+`release_after` seconds they resume.  The reference reaches the same
+end through per-op IO throttling of the scanner
+(cmd/data-scanner.go scannerSleeper + maxIO dynamics); a single
+engage/release controller keeps the policy observable: one gauge says
+whether the cluster is browned out and two counters say how often.
+
+Event-driven by design — no thread of its own.  The API front calls
+`note_pressure`/`note_shed` as load arrives; background loops poll
+`background_allowed()` before each unit of work, and that poll performs
+the time-based release check, so a cluster that goes fully idle (no
+more foreground calls) still releases on the next background tick.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class BrownoutController:
+    def __init__(self, engage_depth: int = 8, release_after: float = 5.0):
+        self.engage_depth = engage_depth    # admission waiters that engage
+        self.release_after = release_after  # quiet seconds before release
+        self._mu = threading.Lock()
+        self._engaged = False
+        self._last_pressure = 0.0
+        self.engagements = 0
+        self.releases = 0
+        self.sheds_seen = 0
+        self.deferrals = 0
+
+    # -- pressure inputs (API front) ----------------------------------------
+    def note_pressure(self, queue_depth: int) -> None:
+        """Called per admission attempt with the current waiter count."""
+        if queue_depth >= self.engage_depth:
+            self._pressure()
+
+    def note_shed(self) -> None:
+        """A request was shed with 503: unconditional pressure."""
+        with self._mu:
+            self.sheds_seen += 1
+        self._pressure()
+
+    def _pressure(self) -> None:
+        with self._mu:
+            self._last_pressure = time.monotonic()
+            if not self._engaged:
+                self._engaged = True
+                self.engagements += 1
+
+    # -- queries (background services) --------------------------------------
+    def engaged(self) -> bool:
+        with self._mu:
+            self._check_release_locked()
+            return self._engaged
+
+    def background_allowed(self) -> bool:
+        """False while browned out; each refusal counts as a deferral."""
+        with self._mu:
+            self._check_release_locked()
+            if self._engaged:
+                self.deferrals += 1
+                return False
+            return True
+
+    def _check_release_locked(self) -> None:
+        if self._engaged and \
+                time.monotonic() - self._last_pressure >= self.release_after:
+            self._engaged = False
+            self.releases += 1
+
+    def stats(self) -> dict:
+        with self._mu:
+            self._check_release_locked()
+            return {
+                "engaged": self._engaged,
+                "engagements": self.engagements,
+                "releases": self.releases,
+                "shedsSeen": self.sheds_seen,
+                "deferrals": self.deferrals,
+                "engageDepth": self.engage_depth,
+                "releaseAfter": self.release_after,
+            }

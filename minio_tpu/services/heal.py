@@ -163,6 +163,9 @@ class BackgroundHealer:
         self.interval = interval
         self.last_status: HealSequenceStatus | None = None
         self.cycles = 0
+        # brownout hook: callable -> bool; False defers the sweep while
+        # foreground load is shedding (wired by ServiceManager)
+        self.throttle = None
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="bg-heal")
@@ -172,6 +175,8 @@ class BackgroundHealer:
         while not self._stop.wait(self.interval):
             if getattr(self, "_paused", False):
                 continue
+            if self.throttle is not None and not self.throttle():
+                continue  # browned out: foreground traffic owns the IOPs
             self.heal_once()
 
     def pause(self) -> None:

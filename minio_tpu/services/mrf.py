@@ -52,10 +52,16 @@ class MRFQueue:
         self.delay = delay
         self.max_retries = max_retries
         self.stats = MRFStats()
+        # brownout hook: callable -> bool; False pauses healing while
+        # foreground load is shedding (wired by ServiceManager)
+        self.throttle = None
         self._q: queue.Queue = queue.Queue(maxsize=self.MAX_PENDING)
         self._inflight: set[_HealTask] = set()
         self._active = 0  # heals currently executing (for drain)
         self._mu = threading.Lock()
+        # signaled whenever the queue may have drained (task finished or
+        # dropped) so drain() wakes immediately instead of busy-polling
+        self._idle = threading.Condition(self._mu)
         self._stop = threading.Event()
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name="mrf-heal")
@@ -78,9 +84,10 @@ class MRFQueue:
             with self._mu:
                 self.stats.pending = self._q.qsize()
         except queue.Full:
-            with self._mu:
+            with self._idle:
                 self._inflight.discard(t)
                 self.stats.dropped += 1
+                self._idle.notify_all()
 
     # -- worker ------------------------------------------------------------
     def _run(self) -> None:
@@ -95,6 +102,14 @@ class MRFQueue:
             with self._mu:
                 self._inflight.discard(t)
                 self._active += 1
+            # brownout: hold the heal while foreground load is shedding —
+            # the task is already claimed, so it runs as soon as the
+            # controller releases
+            while not self._stop.is_set():
+                thr = self.throttle
+                if thr is None or thr():
+                    break
+                time.sleep(0.02)
             # brief settle delay so in-flight renames finish (reference
             # sleeps up to a second before MRF healing)
             if self.delay:
@@ -111,25 +126,37 @@ class MRFQueue:
                 if ok:
                     break
                 time.sleep(self.delay)
-            with self._mu:
+            with self._idle:
                 self._active -= 1
                 if ok:
                     self.stats.healed += 1
                 else:
                     self.stats.failed += 1
                 self.stats.pending = self._q.qsize()
+                self._idle.notify_all()
 
     # -- control -----------------------------------------------------------
+    def _drained(self) -> bool:
+        # callers hold self._mu (the condition's lock)
+        return self._q.empty() and not self._inflight and not self._active
+
     def drain(self, timeout: float = 10.0) -> bool:
-        """Wait until the queue is empty and no task is in flight (tests)."""
+        """Wait until the queue is empty and no task is in flight.
+
+        Condition-variable wait signaled by the worker on every task
+        completion/drop: drain wakes the instant the queue empties
+        instead of burning 20 ms poll cycles (tests call this a lot)."""
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            with self._mu:
-                if self._q.empty() and not self._inflight and not self._active:
-                    return True
-            time.sleep(0.02)
-        return False
+        with self._idle:
+            while not self._drained():
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._idle.wait(remaining)
+            return True
 
     def close(self) -> None:
         self._stop.set()
         self._worker.join(timeout=2)
+        with self._idle:
+            self._idle.notify_all()  # unblock any drain() caller

@@ -138,6 +138,9 @@ class DataScanner:
         self.deep_heals_queued = 0
         self.buckets_skipped = 0
         self.subtree_rescans = 0  # bounded (non-full) bucket walks
+        # brownout hook: callable -> bool; False defers the cycle while
+        # foreground load is shedding (wired by ServiceManager)
+        self.throttle = None
         self.usage = DataUsageInfo()
         # hierarchical usage: per-set trees (persisted per set) + the
         # cross-set/pool merge served to admin queries
@@ -167,6 +170,8 @@ class DataScanner:
         while not self._stop.wait(self.interval):
             if getattr(self, "_paused", False):
                 continue
+            if self.throttle is not None and not self.throttle():
+                continue  # browned out: skip the cycle, retry next tick
             try:
                 self.scan_cycle()
             except Exception:

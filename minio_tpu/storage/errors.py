@@ -46,6 +46,13 @@ class UnformattedDisk(StorageError):
     pass
 
 
+class DeadlineExceeded(StorageError):
+    """The caller's deadline budget ran out before the operation finished
+    (reference context.DeadlineExceeded on the storage REST plane).  NOT
+    a drive fault: the drive may be healthy, the request is just out of
+    time — it must not feed the health circuit breaker."""
+
+
 class ErasureReadQuorum(StorageError):
     """Not enough disks agree to serve a read (errErasureReadQuorum)."""
 

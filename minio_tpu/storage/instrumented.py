@@ -19,11 +19,13 @@ owner can enqueue an MRF re-sync of writes the drive missed.
 
 from __future__ import annotations
 
+import concurrent.futures as cf
 import os
 import threading
 import time
 
 from minio_tpu.storage import errors
+from minio_tpu.utils import deadline as deadline_mod
 
 # every data-plane method of StorageAPI gets a timer (control accessors
 # like disk_id/is_online are left untimed on purpose — they are hot and
@@ -55,6 +57,67 @@ PROBE_MAX_INTERVAL = float(
 # therefore RESET the consecutive-fault counter)
 _FAULT_TYPES = (errors.DiskNotFound, errors.FaultyDisk,
                 errors.UnformattedDisk)
+
+# read-path ops the per-op deadline worker may abandon mid-call: all
+# idempotent and side-effect free, so the orphaned call finishing late
+# changes nothing.  Write/commit ops are NEVER abandoned — timing out a
+# rename/append the drive then completes would leave state divergent
+# (same line the RPC client draws with slow/non-idempotent calls).
+DEADLINE_GATED_OPS = frozenset((
+    "read_all", "read_version", "read_xl", "read_file_stream",
+    "read_file", "list_dir", "list_volumes", "stat_volume",
+    "disk_info", "check_parts",
+))
+
+_dl_pool_lock = threading.Lock()
+_dl_pool: cf.ThreadPoolExecutor | None = None
+
+# a deadline timeout only counts as a drive FAULT (feeding the breaker)
+# when the drive had at least this much time to answer — a read
+# abandoned because the caller arrived with a sliver of budget proves
+# nothing about the drive (a client could otherwise trip every breaker
+# with x-amz-request-timeout: 1ms)
+DEADLINE_FAULT_MIN = float(
+    os.environ.get("MINIO_TPU_DEADLINE_FAULT_MIN", "1.0"))
+# the worker-pool detour (submit + context copy + two thread handoffs
+# per op) only pays for itself when the remaining budget is TIGHT
+# enough that abandoning a hung call matters; relaxed budgets (the
+# default 1m) run inline — the RPC per-attempt timeouts and the breaker
+# already bound hangs at that horizon, and the hot path stays hop-free
+DEADLINE_GATE_MAX = float(
+    os.environ.get("MINIO_TPU_DEADLINE_GATE_MAX", "10.0"))
+
+
+def _deadline_pool() -> cf.ThreadPoolExecutor:
+    """Process-wide worker pool running deadline-gated drive reads (the
+    reference's per-drive health/deadline goroutines collapse to one
+    shared pool here).  Intentionally long-lived, like shard-io.  Sized
+    generously: abandoned reads pin a worker until the drive answers,
+    and the breaker (which trips hung drives into fast-fails) is what
+    keeps that pinning bounded."""
+    global _dl_pool
+    with _dl_pool_lock:
+        if _dl_pool is None:
+            _dl_pool = cf.ThreadPoolExecutor(
+                max_workers=int(os.environ.get(
+                    "MINIO_TPU_DEADLINE_WORKERS", "128")),
+                thread_name_prefix="drive-deadline")
+        return _dl_pool
+
+
+def _close_abandoned(fut: cf.Future) -> None:
+    """When an abandoned read eventually returns a stream handle, close
+    it — nobody else will (keeps remote HTTP conns from lingering)."""
+    try:
+        out = fut.result()
+    except Exception:
+        return
+    closer = getattr(out, "close", None)
+    if closer is not None:
+        try:
+            closer()
+        except Exception:
+            pass
 
 
 def is_drive_fault(e: BaseException) -> bool:
@@ -112,6 +175,8 @@ class InstrumentedStorage:
         self.trips = 0        # breaker open events
         self.reconnects = 0   # probe-driven recoveries
         self.fast_fails = 0   # calls rejected while the breaker was open
+        self.deadline_timeouts = 0  # gated reads abandoned mid-call
+        self.deadline_expired = 0   # gated reads refused: budget spent
         self.on_offline = None  # callable(self), fired when the breaker trips
         self.on_online = None   # callable(self), fired when the probe recovers
         for op in TIMED_OPS:
@@ -121,6 +186,7 @@ class InstrumentedStorage:
 
     def _wrap(self, op: str, fn):
         stats = self._ops[op]
+        gated = op in DEADLINE_GATED_OPS
 
         def timed(*a, **kw):
             if self._breaker_open:
@@ -132,6 +198,10 @@ class InstrumentedStorage:
                 raise errors.DiskNotFound(
                     f"{self._endpoint_label()}: drive offline "
                     f"(circuit breaker open)")
+            budget = deadline_mod.current()
+            if gated and budget is not None and budget.t_end is not None \
+                    and budget.remaining() <= DEADLINE_GATE_MAX:
+                return self._deadline_call(op, fn, stats, budget, a, kw)
             t0 = time.monotonic()
             try:
                 out = fn(*a, **kw)
@@ -145,6 +215,55 @@ class InstrumentedStorage:
 
         timed.__name__ = op
         return timed
+
+    def _deadline_call(self, op: str, fn, stats, budget, a, kw):
+        """Per-op deadline worker (reference diskHealthCheck contexts,
+        cmd/xl-storage-disk-id-check.go): the read runs on the shared
+        deadline pool bounded by the request's remaining budget.  A call
+        the drive holds past the budget is ABANDONED — the caller gets
+        DeadlineExceeded now and the hang feeds the breaker, instead of
+        one slow drive holding a quorum fan-out hostage for the full RPC
+        timeout."""
+        rem = budget.remaining()
+        if rem <= 0:
+            with self._health_mu:
+                self.deadline_expired += 1
+            raise errors.DeadlineExceeded(
+                f"{self._endpoint_label()}: {op} refused, request "
+                f"deadline budget exhausted")
+        fut = deadline_mod.ctx_submit(_deadline_pool(), fn, *a, **kw)
+        t0 = time.monotonic()
+        try:
+            out = fut.result(timeout=rem)
+        except cf.TimeoutError:
+            if fut.cancel():
+                # never started: pool backlog ate the budget — not this
+                # drive's fault; no op sample either (the drive never
+                # saw the call, a failed/slow sample would poison the
+                # EWMA that steers hedging)
+                with self._health_mu:
+                    self.deadline_expired += 1
+            else:
+                stats.record(time.monotonic() - t0, failed=True)
+                fut.add_done_callback(_close_abandoned)
+                with self._health_mu:
+                    self.deadline_timeouts += 1
+                if rem >= DEADLINE_FAULT_MIN:
+                    # the drive had a fair window and still held the
+                    # read: that is a hang, feed the breaker.  A
+                    # sliver-budget abandonment is the CALLER's poverty,
+                    # not a drive fault
+                    self._note(fault=True)
+            raise errors.DeadlineExceeded(
+                f"{self._endpoint_label()}: {op} abandoned after "
+                f"{rem * 1e3:.0f} ms budget")
+        except Exception as e:
+            stats.record(time.monotonic() - t0, failed=True)
+            self._note(fault=is_drive_fault(e))
+            raise
+        stats.record(time.monotonic() - t0, failed=False)
+        self._note(fault=False)
+        return out
 
     def _endpoint_label(self) -> str:
         try:
@@ -237,9 +356,20 @@ class InstrumentedStorage:
                 "trips": self.trips,
                 "reconnects": self.reconnects,
                 "fastFails": self.fast_fails,
+                "deadlineTimeouts": self.deadline_timeouts,
+                "deadlineExpired": self.deadline_expired,
                 "offlineSince": (round(self._offline_since, 3)
                                  if self._breaker_open else 0),
             }
+
+    def op_ewma(self, op: str) -> float:
+        """EWMA latency (seconds) of one op; 0.0 before any sample.  The
+        read path uses this to hedge around chronically slow drives."""
+        s = self._ops.get(op)
+        if s is None:
+            return 0.0
+        with s.mu:
+            return s.ewma_s
 
     def close(self) -> None:
         self._closed = True

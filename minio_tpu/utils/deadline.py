@@ -1,0 +1,134 @@
+"""Per-request deadline budgets, threaded end-to-end.
+
+Reference: the API requests-deadline (cmd/handler-api.go:108 — a request
+waits at most `requests_deadline` for an API slot, then sheds with 503),
+and per-call context deadlines on the storage REST plane
+(cmd/xl-storage-disk-id-check.go health contexts): one budget is minted
+at the HTTP front, consumed by queue wait, and whatever remains travels
+with the request — into the executor threads that run the blocking
+object layer, across the internode RPC hops as a header, and down to
+the per-drive deadline gates — so a retry or straggler can never spend
+more time than the caller has left.
+
+The budget rides a `contextvars.ContextVar`.  Async tasks inherit it for
+free; thread-pool hops must copy the context explicitly — use
+`ctx_submit` (pool fan-outs) or wrap with `scope(budget)`.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import re
+import time
+
+_INF = float("inf")
+
+
+class Budget:
+    """A monotonic deadline: `seconds=None` means unbounded (every
+    accessor then reports infinite headroom and the gates stand down)."""
+
+    __slots__ = ("t0", "t_end")
+
+    def __init__(self, seconds: float | None = None):
+        self.t0 = time.monotonic()
+        self.t_end = None if seconds is None else self.t0 + max(0.0, seconds)
+
+    @classmethod
+    def from_millis(cls, ms: int) -> "Budget":
+        return cls(ms / 1000.0)
+
+    def elapsed(self) -> float:
+        return time.monotonic() - self.t0
+
+    def remaining(self) -> float:
+        if self.t_end is None:
+            return _INF
+        return max(0.0, self.t_end - time.monotonic())
+
+    def remaining_ms(self) -> int | None:
+        """Remaining budget as whole milliseconds for the RPC wire
+        header; None when unbounded."""
+        if self.t_end is None:
+            return None
+        return int(self.remaining() * 1000)
+
+    def expired(self) -> bool:
+        return self.t_end is not None and time.monotonic() >= self.t_end
+
+    def clamp(self, timeout: float) -> float:
+        """min(timeout, remaining) — bound a per-attempt timeout so one
+        attempt can never outlive the whole request."""
+        if self.t_end is None:
+            return timeout
+        return min(timeout, self.remaining())
+
+    def __repr__(self) -> str:  # debugging aid only
+        if self.t_end is None:
+            return "Budget(unbounded)"
+        return f"Budget(remaining={self.remaining():.3f}s)"
+
+
+_DURATION_RE = re.compile(r"^\s*([0-9]*\.?[0-9]+)\s*(ms|s|m|h)?\s*$")
+_UNIT_S = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0, None: 1.0}
+
+
+def parse_duration(text: str | None) -> float | None:
+    """"10s" -> 10.0, "500ms" -> 0.5, "2m" -> 120.0, bare numbers are
+    seconds; "off"/""/"0" -> None (unbounded).  Raises ValueError on
+    anything else so a typo'd config knob fails loudly."""
+    if text is None:
+        return None
+    t = text.strip().lower()
+    if t in ("", "off", "none", "disabled"):
+        return None
+    m = _DURATION_RE.match(t)
+    if m is None:
+        raise ValueError(f"invalid duration {text!r}")
+    v = float(m.group(1)) * _UNIT_S[m.group(2)]
+    return None if v == 0 else v
+
+
+# ---------------------------------------------------------------- context
+_current: contextvars.ContextVar[Budget | None] = contextvars.ContextVar(
+    "minio_tpu_deadline", default=None)
+
+
+def current() -> Budget | None:
+    return _current.get()
+
+
+def set_current(budget: Budget | None):
+    """Install and return the reset token (pair with `reset`)."""
+    return _current.set(budget)
+
+
+def reset(token) -> None:
+    _current.reset(token)
+
+
+class scope:
+    """`with scope(budget): ...` — install a budget for a code block
+    (works in any thread; the var is context-local)."""
+
+    def __init__(self, budget: Budget | None):
+        self.budget = budget
+        self._token = None
+
+    def __enter__(self) -> Budget | None:
+        self._token = _current.set(self.budget)
+        return self.budget
+
+    def __exit__(self, *exc) -> bool:
+        _current.reset(self._token)
+        return False
+
+
+def ctx_submit(pool, fn, *args, **kwargs):
+    """pool.submit that carries the caller's context (and therefore the
+    ambient deadline budget) into the worker thread.  Plain submit drops
+    it — pool threads run in their own default context."""
+    ctx = contextvars.copy_context()
+    if kwargs:
+        return pool.submit(ctx.run, lambda: fn(*args, **kwargs))
+    return pool.submit(ctx.run, fn, *args)

@@ -27,6 +27,71 @@ from .pubsub import PubSub
 LEVELS = ("DEBUG", "INFO", "WARNING", "ERROR", "FATAL")
 
 
+class _FailoverKafka:
+    """Kafka target over a broker LIST: delivery sticks to one broker
+    and rotates to the next on failure, so one dead broker of a
+    multi-broker list cannot strand the queue (the store worker's retry
+    re-sends through the rotated target)."""
+
+    kind = "kafka"
+
+    def __init__(self, name: str, addrs: list, topic: str):
+        from minio_tpu.events.brokers import KafkaTarget
+
+        self.name = name
+        self.topic = topic
+        self._addrs = addrs
+        self._idx = 0
+        self._make = lambda h, p: KafkaTarget(name, h, p, topic)
+        self._t = self._make(*addrs[0])
+
+    def send(self, log: dict) -> None:
+        try:
+            self._t.send(log)
+        except Exception:
+            if len(self._addrs) > 1:
+                self._idx = (self._idx + 1) % len(self._addrs)
+                try:
+                    self._t.close()
+                except Exception:
+                    pass
+                self._t = self._make(*self._addrs[self._idx])
+            raise  # worker keeps the entry; next retry hits the new broker
+
+    def close(self) -> None:
+        self._t.close()
+
+    @property
+    def target_id(self) -> str:
+        return f"{self.name}:{self.kind}"
+
+    def arn(self, region: str) -> str:
+        return f"arn:minio:sqs:{region}:{self.name}:{self.kind}"
+
+
+def _kafka_target(name: str, brokers: str, topic: str):
+    """Kafka target from a comma-separated broker list, reusing the wire
+    client the event notifier already ships (events/brokers.py:288) —
+    the reference's logger/audit kafka targets,
+    internal/logger/target/kafka."""
+    from minio_tpu.events.targets import _host_port
+
+    addrs = [_host_port(b.strip(), 9092)
+             for b in brokers.split(",") if b.strip()]
+    return _FailoverKafka(name, addrs, topic)
+
+
+def _cfg_get(config, subsys: str, key: str, default: str = "") -> str:
+    """Config knob with env fallback: MINIO_<SUBSYS>_<KEY> works even
+    when no ServerConfig is wired (early boot, tests)."""
+    if config is not None:
+        try:
+            return config.get(subsys, key, default)
+        except Exception:
+            pass
+    return os.environ.get(f"MINIO_{subsys.upper()}_{key.upper()}", default)
+
+
 class Logger:
     def __init__(self, ring_size: int = 1000, stream=None):
         self.ring: collections.deque = collections.deque(maxlen=ring_size)
@@ -34,7 +99,9 @@ class Logger:
         self._mu = threading.Lock()
         self._stream = stream if stream is not None else sys.stderr
         self.min_level = os.environ.get("MINIO_TPU_LOG_LEVEL", "INFO").upper()
-        self._audit = None  # AuditTarget, wired by init_audit
+        self._audit_workers: list = []   # _TargetWorker per audit target
+        self._log_worker = None          # _TargetWorker for error logs
+        self._log_level = "ERROR"
 
     def _enabled(self, level: str) -> bool:
         try:
@@ -44,7 +111,13 @@ class Logger:
 
     def log(self, level: str, message: str, **ctx) -> None:
         level = level.upper()
-        if not self._enabled(level):
+        # remote shipping has its OWN level: logger_kafka.level=DEBUG
+        # must ship even when the console min_level is INFO
+        w = self._log_worker
+        ship = (w is not None and level in LEVELS
+                and LEVELS.index(level) >= LEVELS.index(self._log_level))
+        console = self._enabled(level)
+        if not console and not ship:
             return
         entry = {
             "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
@@ -53,14 +126,24 @@ class Logger:
         }
         if ctx:
             entry.update(ctx)
-        with self._mu:
-            self.ring.append(entry)
+        if console:
+            with self._mu:
+                self.ring.append(entry)
+                try:
+                    self._stream.write(json.dumps(entry) + "\n")
+                    self._stream.flush()
+                except Exception:
+                    pass
+            self.pubsub.publish(entry)
+        if ship:
+            # error-log shipping (reference logger kafka target): the
+            # store-backed worker buffers entries and replays them after
+            # a broker outage — logging never blocks on the broker
             try:
-                self._stream.write(json.dumps(entry) + "\n")
-                self._stream.flush()
+                w.store.put(entry)
+                w.signal()
             except Exception:
                 pass
-        self.pubsub.publish(entry)
 
     def debug(self, msg: str, **ctx) -> None:
         self.log("DEBUG", msg, **ctx)
@@ -80,53 +163,87 @@ class Logger:
         with self._mu:
             return list(self.ring)[-n:]
 
-    # -- audit ---------------------------------------------------------------
-    def init_audit(self, queue_dir: str | None = None) -> None:
-        """Wire the audit webhook from env (idempotent; no-op without
-        MINIO_AUDIT_WEBHOOK_ENDPOINT).  Delivery reuses the notifier's
-        persistent-queue worker so audit entries survive restarts and
-        endpoint outages."""
-        endpoint = os.environ.get("MINIO_AUDIT_WEBHOOK_ENDPOINT", "")
-        if not endpoint or self._audit is not None:
-            return
+    # -- audit / log shipping ------------------------------------------------
+    def init_audit(self, queue_dir: str | None = None, config=None) -> None:
+        """Wire audit + log targets from env/config (idempotent).
+
+        Audit targets: the webhook (MINIO_AUDIT_WEBHOOK_ENDPOINT) and/or
+        Kafka (audit_kafka.{enable,brokers,topic} — env
+        MINIO_AUDIT_KAFKA_*).  Error-log target: logger_kafka.*.  Every
+        target sits behind the notifier's persistent QueueStore worker,
+        so entries buffer across broker outages and restart replays
+        deliver them in order (reference store-backed audit/logger kafka
+        targets, internal/logger/target/kafka + internal/store)."""
         import tempfile
 
         from minio_tpu.events.notifier import _TargetWorker
         from minio_tpu.events.targets import QueueStore, WebhookTarget
 
-        target = WebhookTarget(
-            "audit-webhook", endpoint,
-            auth_token=os.environ.get("MINIO_AUDIT_WEBHOOK_AUTH_TOKEN", ""))
-        store = QueueStore(queue_dir or os.path.join(
-            tempfile.gettempdir(), "minio-tpu-audit"))
-        self._audit = _TargetWorker(target, store, retry_interval=3.0)
-        self._audit_store = store
+        if self._audit_workers or self._log_worker is not None:
+            return
+        base = queue_dir or os.path.join(
+            tempfile.gettempdir(), "minio-tpu-audit")
+        endpoint = os.environ.get("MINIO_AUDIT_WEBHOOK_ENDPOINT", "")
+        if endpoint:
+            target = WebhookTarget(
+                "audit-webhook", endpoint,
+                auth_token=os.environ.get(
+                    "MINIO_AUDIT_WEBHOOK_AUTH_TOKEN", ""))
+            self._audit_workers.append(_TargetWorker(
+                target, QueueStore(base), retry_interval=3.0))
+        if _cfg_get(config, "audit_kafka", "enable").lower() in (
+                "on", "true", "1"):
+            brokers = _cfg_get(config, "audit_kafka", "brokers")
+            topic = _cfg_get(config, "audit_kafka", "topic")
+            if brokers and topic:
+                self._audit_workers.append(_TargetWorker(
+                    _kafka_target("audit-kafka", brokers, topic),
+                    QueueStore(base + "-kafka"), retry_interval=3.0))
+        if _cfg_get(config, "logger_kafka", "enable").lower() in (
+                "on", "true", "1"):
+            brokers = _cfg_get(config, "logger_kafka", "brokers")
+            topic = _cfg_get(config, "logger_kafka", "topic")
+            if brokers and topic:
+                lvl = _cfg_get(config, "logger_kafka", "level",
+                               "ERROR").upper()
+                self._log_level = lvl if lvl in LEVELS else "ERROR"
+                self._log_worker = _TargetWorker(
+                    _kafka_target("logger-kafka", brokers, topic),
+                    QueueStore(base + "-log"), retry_interval=3.0)
 
     def audit(self, entry: dict) -> None:
         """Ship one audit entry (reference AuditLog, internal/logger).
-        Fire-and-forget; ordering/retry handled by the queue worker."""
-        if self._audit is None:
+        Fire-and-forget; ordering/retry handled by the queue workers."""
+        if not self._audit_workers:
             return
-        try:
-            self._audit_store.put({
-                "version": "1",
-                "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-                **entry})
-            self._audit.signal()
-        except Exception:
-            pass
+        doc = {
+            "version": "1",
+            "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            **entry}
+        for w in self._audit_workers:
+            try:
+                w.store.put(doc)
+                w.signal()
+            except Exception:
+                pass
 
     @property
     def audit_enabled(self) -> bool:
-        return self._audit is not None
+        return bool(self._audit_workers)
 
     def close(self) -> None:
-        if self._audit is not None:
+        for w in self._audit_workers:
             try:
-                self._audit.close()
+                w.close()
             except Exception:
                 pass
-            self._audit = None
+        self._audit_workers = []
+        if self._log_worker is not None:
+            try:
+                self._log_worker.close()
+            except Exception:
+                pass
+            self._log_worker = None
 
 
 # process-wide instance (reference's global logger singletons)

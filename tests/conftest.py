@@ -58,3 +58,46 @@ def _rebuild_native_lib() -> None:
 
 
 _rebuild_native_lib()
+
+
+# --------------------------------------------------------------- watchdog
+# Per-test watchdog: a deadlocked admission queue (or any other hang)
+# fails ONE test fast with a traceback instead of eating the whole
+# 870 s tier-1 budget.  SIGALRM interrupts the main thread mid-test and
+# the handler raises; pytest records the failure and moves on.  `slow`-
+# marked tests are exempt; MINIO_TPU_TEST_TIMEOUT overrides the default
+# (0 disables).
+
+import signal  # noqa: E402
+import threading  # noqa: E402
+
+import pytest  # noqa: E402
+
+_WATCHDOG_SECONDS = float(os.environ.get("MINIO_TPU_TEST_TIMEOUT", "300"))
+
+
+class _WatchdogTimeout(Exception):
+    pass
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    if (_WATCHDOG_SECONDS <= 0
+            or item.get_closest_marker("slow") is not None
+            or not hasattr(signal, "SIGALRM")
+            or threading.current_thread() is not threading.main_thread()):
+        yield
+        return
+
+    def _fire(signum, frame):
+        raise _WatchdogTimeout(
+            f"watchdog: {item.nodeid} exceeded {_WATCHDOG_SECONDS:.0f}s "
+            "(deadlock?)")
+
+    old = signal.signal(signal.SIGALRM, _fire)
+    signal.setitimer(signal.ITIMER_REAL, _WATCHDOG_SECONDS)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, old)

@@ -1204,3 +1204,158 @@ class TestNewKindsEnvLoading:
         }
         (my,) = load_targets_from_env(env)
         assert (my.host, my.port, my.database) == ("10.1.1.1", 3306, "db1")
+
+
+# -------------------------------------------------- Kafka audit/log targets
+class TestKafkaAuditLogTargets:
+    """utils/logger.py shipping audit entries and error logs to Kafka,
+    reusing the notifier's wire client + persistent-queue replay
+    (reference internal/logger/target/kafka behind internal/store)."""
+
+    def _logger(self, tmp_path, monkeypatch, port, extra_env=()):
+        from minio_tpu.utils.logger import Logger
+
+        monkeypatch.setenv("MINIO_AUDIT_KAFKA_ENABLE", "on")
+        monkeypatch.setenv("MINIO_AUDIT_KAFKA_BROKERS", f"127.0.0.1:{port}")
+        monkeypatch.setenv("MINIO_AUDIT_KAFKA_TOPIC", "minio-audit")
+        for k, v in extra_env:
+            monkeypatch.setenv(k, v)
+        lg = Logger(stream=io.StringIO())
+        lg.init_audit(queue_dir=str(tmp_path / "audit"))
+        return lg
+
+    def test_audit_entry_reaches_kafka(self, tmp_path, monkeypatch):
+        broker = _FakeBroker(_kafka_broker)
+        lg = None
+        try:
+            lg = self._logger(tmp_path, monkeypatch, broker.port)
+            assert lg.audit_enabled
+            lg.audit({"api": "put_object", "path": "/b/k",
+                      "statusCode": 200})
+            broker.wait(1)
+            doc = json.loads(broker.received[0])
+            assert doc["api"] == "put_object"
+            assert doc["version"] == "1"
+        finally:
+            if lg is not None:
+                lg.close()
+            broker.close()
+
+    def test_offline_buffering_and_reconnect_replay(self, tmp_path,
+                                                    monkeypatch):
+        """Broker down at audit time: the entry is HELD in the queue
+        store; once a broker appears on the same port it is replayed."""
+        # reserve a port, then close it so the first delivery fails
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+        lg = None
+        broker = None
+        try:
+            lg = self._logger(tmp_path, monkeypatch, port)
+            lg.audit({"api": "delete_object", "path": "/b/gone"})
+            # delivery failing: entry stays queued
+            worker = lg._audit_workers[0]
+            deadline = time.time() + 5
+            while len(worker.store) == 0 and time.time() < deadline:
+                time.sleep(0.02)
+            assert len(worker.store) >= 1
+
+            # bring a broker up; the worker's retry loop replays the
+            # stored entry once the endpoint answers
+            broker = _FakeBroker(lambda b, s: _kafka_broker(b, s))
+            # rebind the failover target at the live broker's port (the
+            # reserved port may differ): point the rotation list there
+            worker.target._addrs = [("127.0.0.1", broker.port)]
+            worker.target._t.port = broker.port
+            worker.target._t.close()
+            worker.signal()
+            deadline = time.time() + 10
+            while len(worker.store) and time.time() < deadline:
+                time.sleep(0.05)
+            assert len(worker.store) == 0, "entry not replayed"
+            broker.wait(1)
+            assert json.loads(broker.received[0])["api"] == "delete_object"
+        finally:
+            if lg is not None:
+                lg.close()
+            if broker is not None:
+                broker.close()
+
+    def test_broker_list_failover(self, tmp_path):
+        """A dead first broker rotates delivery to the next of the
+        comma-separated list instead of stranding the queue."""
+        from minio_tpu.utils.logger import _kafka_target
+
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        dead_port = probe.getsockname()[1]
+        probe.close()
+        broker = _FakeBroker(_kafka_broker)
+        try:
+            t = _kafka_target(
+                "fo", f"127.0.0.1:{dead_port},127.0.0.1:{broker.port}",
+                "evts")
+            with pytest.raises(Exception):
+                t.send({"Key": "first"})   # dead broker: fails, rotates
+            t.send({"Key": "second"})      # next broker takes delivery
+            broker.wait(1)
+            assert json.loads(broker.received[0])["Key"] == "second"
+            t.close()
+        finally:
+            broker.close()
+
+    def test_log_ship_level_independent_of_console_level(self, tmp_path,
+                                                         monkeypatch):
+        """logger_kafka.level=DEBUG ships DEBUG entries even while the
+        console min_level (INFO default) suppresses them."""
+        broker = _FakeBroker(_kafka_broker)
+        lg = None
+        try:
+            lg = self._logger(
+                tmp_path, monkeypatch, broker.port,
+                extra_env=(
+                    ("MINIO_LOGGER_KAFKA_ENABLE", "on"),
+                    ("MINIO_LOGGER_KAFKA_BROKERS",
+                     f"127.0.0.1:{broker.port}"),
+                    ("MINIO_LOGGER_KAFKA_TOPIC", "minio-logs"),
+                    ("MINIO_LOGGER_KAFKA_LEVEL", "DEBUG"),
+                ))
+            assert lg.min_level == "INFO"
+            lg.debug("ship me", src="test")
+            broker.wait(1)
+            docs = [json.loads(r) for r in broker.received]
+            assert any(d.get("message") == "ship me" for d in docs)
+            # console ring must NOT have recorded it (below min_level)
+            assert not any(e.get("message") == "ship me"
+                           for e in lg.recent(50))
+        finally:
+            if lg is not None:
+                lg.close()
+            broker.close()
+
+    def test_error_log_shipping_respects_level(self, tmp_path,
+                                               monkeypatch):
+        broker = _FakeBroker(_kafka_broker)
+        lg = None
+        try:
+            lg = self._logger(
+                tmp_path, monkeypatch, broker.port,
+                extra_env=(
+                    ("MINIO_LOGGER_KAFKA_ENABLE", "on"),
+                    ("MINIO_LOGGER_KAFKA_BROKERS",
+                     f"127.0.0.1:{broker.port}"),
+                    ("MINIO_LOGGER_KAFKA_TOPIC", "minio-logs"),
+                    ("MINIO_LOGGER_KAFKA_LEVEL", "ERROR"),
+                ))
+            lg.info("routine", detail="ignored")   # below level: dropped
+            lg.error("drive exploded", drive="d3")
+            broker.wait(1)
+            docs = [json.loads(r) for r in broker.received]
+            assert any(d.get("message") == "drive exploded" for d in docs)
+            assert not any(d.get("message") == "routine" for d in docs)
+        finally:
+            if lg is not None:
+                lg.close()
+            broker.close()

@@ -24,7 +24,8 @@ def _settle(baseline: set[str], timeout: float = 5.0) -> set[str]:
                  if not n.startswith("ThreadPoolExecutor")
                  and not n.startswith("asyncio")
                  # process-wide singletons, intentionally long-lived
-                 and not n.startswith("shard-io")}
+                 and not n.startswith("shard-io")
+                 and not n.startswith("drive-deadline")}
         if not extra:
             return set()
         time.sleep(0.2)

@@ -1,0 +1,606 @@
+"""End-to-end deadline propagation, admission shedding, hedged reads,
+and brownout under overload.
+
+Reference behaviours: requests_deadline admission control shedding 503
+(cmd/handler-api.go:108), per-call deadline contexts on the storage
+plane (cmd/xl-storage-disk-id-check.go), and the tail-at-scale
+hedged-request pattern (PAPERS.md).  The overload drill is the ISSUE 3
+acceptance scenario: ChaosDisk +500 ms latency on half the drives under
+4x semaphore oversubscription.
+"""
+
+import asyncio
+import io
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from minio_tpu.storage import errors
+from minio_tpu.utils import deadline as dl
+
+from .s3_harness import S3TestServer
+
+
+# ------------------------------------------------------ budget arithmetic
+class TestBudgetArithmetic:
+    @pytest.mark.parametrize("text,want", [
+        ("10s", 10.0), ("500ms", 0.5), ("2m", 120.0), ("1h", 3600.0),
+        ("1.5", 1.5), ("250", 250.0),
+        ("off", None), ("", None), ("0", None), ("none", None),
+    ])
+    def test_parse_duration(self, text, want):
+        assert dl.parse_duration(text) == want
+
+    @pytest.mark.parametrize("bad", ["10x", "abc", "-5s", "1 2"])
+    def test_parse_duration_rejects_garbage(self, bad):
+        with pytest.raises(ValueError):
+            dl.parse_duration(bad)
+
+    def test_unbounded_budget(self):
+        b = dl.Budget(None)
+        assert b.remaining() == float("inf")
+        assert not b.expired()
+        assert b.remaining_ms() is None
+        assert b.clamp(7.0) == 7.0
+
+    def test_expiry_and_clamp(self):
+        b = dl.Budget(0.05)
+        assert 0 < b.remaining() <= 0.05
+        assert b.clamp(10.0) <= 0.05
+        time.sleep(0.07)
+        assert b.expired()
+        assert b.remaining() == 0.0
+        assert b.clamp(10.0) == 0.0
+
+    def test_wire_round_trip(self):
+        b = dl.Budget(0.25)
+        ms = b.remaining_ms()
+        assert 0 < ms <= 250
+        b2 = dl.Budget.from_millis(ms)
+        assert 0 < b2.remaining() <= 0.25
+
+    def test_context_propagates_through_ctx_submit(self):
+        import concurrent.futures as cf
+
+        pool = cf.ThreadPoolExecutor(max_workers=1)
+        try:
+            with dl.scope(dl.Budget(5.0)):
+                seen = dl.ctx_submit(
+                    pool, lambda: dl.current().remaining()).result()
+            assert 0 < seen <= 5.0
+            # outside the scope the pool thread sees no budget
+            assert dl.ctx_submit(pool, dl.current).result() is None
+        finally:
+            pool.shutdown(wait=True)
+
+
+# -------------------------------------------------------- RPC deadline hop
+class _RpcHarness:
+    """RpcRouter mounted on a real aiohttp server in a thread."""
+
+    def __init__(self, secret: str = "sekrit"):
+        from aiohttp import web
+
+        from minio_tpu.distributed.rpc import RpcRouter
+
+        self.router = RpcRouter(secret)
+        self.app = web.Application()
+        self.router.mount(self.app)
+        self._loop = asyncio.new_event_loop()
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        self._started.wait(10)
+
+    def _serve(self):
+        from aiohttp import web
+
+        asyncio.set_event_loop(self._loop)
+
+        async def start():
+            runner = web.AppRunner(self.app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            self.port = runner.addresses[0][1]
+            self._runner = runner
+            self._started.set()
+
+        self._loop.run_until_complete(start())
+        self._loop.run_forever()
+
+    def close(self):
+        async def stop():
+            await self._runner.cleanup()
+
+        fut = asyncio.run_coroutine_threadsafe(stop(), self._loop)
+        fut.result(10)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(10)
+        self.router.close()
+
+
+class TestRpcDeadline:
+    def test_expired_budget_fails_fast_without_network(self):
+        from minio_tpu.distributed.rpc import RpcClient
+
+        c = RpcClient("127.0.0.1", 1, "s")  # nothing listens on port 1
+        with dl.scope(dl.Budget(0.0)):
+            t0 = time.monotonic()
+            with pytest.raises(errors.DeadlineExceeded):
+                c.call("health.ping", {})
+            assert time.monotonic() - t0 < 0.1
+
+    def test_budget_clamps_hung_peer(self):
+        """A peer that accepts but never answers costs at most the
+        remaining budget, not the 10 s per-attempt op timeout."""
+        import socket
+
+        from minio_tpu.distributed.rpc import RpcClient, RpcTransportError
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(4)
+        try:
+            c = RpcClient("127.0.0.1", srv.getsockname()[1], "s",
+                          retries=5)
+            with dl.scope(dl.Budget(0.5)):
+                t0 = time.monotonic()
+                with pytest.raises(RpcTransportError):
+                    c.call("health.ping", {})
+                assert time.monotonic() - t0 < 2.0
+        finally:
+            srv.close()
+
+    def test_budget_installed_on_server_and_expired_rejected(self):
+        from minio_tpu.distributed.rpc import (DEADLINE_HEADER, RpcClient,
+                                               auth_token)
+
+        calls = []
+
+        h = _RpcHarness()
+        try:
+            def probe(args, body):
+                b = dl.current()
+                calls.append(args.get("tag", ""))
+                return {"remaining": None if b is None else b.remaining()}
+
+            h.router.register("test.probe", probe)
+            c = RpcClient("127.0.0.1", h.port, "sekrit")
+            # hop carries the budget: callee sees a FINITE remaining
+            with dl.scope(dl.Budget(5.0)):
+                out = c.call("test.probe", {"tag": "live"})
+            assert out["remaining"] is not None
+            assert 0 < out["remaining"] <= 5.0
+            # no ambient budget: callee sees none
+            out = c.call("test.probe", {"tag": "free"})
+            assert out["remaining"] is None
+
+            # expired-on-arrival: handler must NOT run
+            import http.client
+
+            import msgpack
+
+            conn = http.client.HTTPConnection("127.0.0.1", h.port,
+                                              timeout=5)
+            payload = msgpack.packb({"tag": "dead"}, use_bin_type=True)
+            conn.request(
+                "POST", "/minio_tpu/rpc/v1/test.probe", body=payload,
+                headers={"x-minio-tpu-token": auth_token("sekrit"),
+                         "x-args-length": str(len(payload)),
+                         DEADLINE_HEADER: "0"})
+            resp = conn.getresponse()
+            doc = msgpack.unpackb(resp.read(), raw=False)
+            conn.close()
+            assert resp.status == 500
+            assert doc["__err__"] == "DeadlineExceeded"
+            assert "dead" not in calls
+        finally:
+            h.close()
+
+
+# ----------------------------------------------------- brownout controller
+class TestBrownoutController:
+    def test_engage_and_release(self):
+        from minio_tpu.services.brownout import BrownoutController
+
+        bo = BrownoutController(engage_depth=4, release_after=0.15)
+        assert bo.background_allowed()
+        bo.note_pressure(2)           # below depth: no engage
+        assert bo.background_allowed()
+        bo.note_pressure(4)           # at depth: engage
+        assert not bo.background_allowed()
+        assert bo.engagements == 1
+        time.sleep(0.2)               # quiet: auto-release on next poll
+        assert bo.background_allowed()
+        assert bo.releases == 1
+        assert bo.stats()["deferrals"] >= 1
+
+    def test_shed_is_unconditional_pressure(self):
+        from minio_tpu.services.brownout import BrownoutController
+
+        bo = BrownoutController(engage_depth=1000, release_after=0.1)
+        bo.note_shed()
+        assert bo.engaged()
+        assert bo.stats()["shedsSeen"] == 1
+
+
+# ------------------------------------------------------- chaos drill utils
+def _chaos_pools(tmp_path, n=8):
+    from minio_tpu.erasure.sets import ErasureSets, ErasureServerPools
+    from minio_tpu.storage.instrumented import InstrumentedStorage
+    from minio_tpu.storage.local import LocalStorage
+    from minio_tpu.storage.naughty import ChaosDisk
+
+    os.environ["MINIO_TPU_FSYNC"] = "0"
+    chaos = [ChaosDisk(LocalStorage(str(tmp_path / f"d{i}")))
+             for i in range(n)]
+    disks = [InstrumentedStorage(c) for c in chaos]
+    pools = ErasureServerPools([ErasureSets(disks, set_size=n)])
+    return pools, chaos
+
+
+def _threads() -> set:
+    return {t.name for t in threading.enumerate() if t.is_alive()}
+
+
+def _leaked(baseline: set, timeout: float = 6.0) -> set:
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        extra = {n for n in _threads() - baseline
+                 if not n.startswith("ThreadPoolExecutor")
+                 and not n.startswith("asyncio")
+                 and not n.startswith("shard-io")
+                 and not n.startswith("drive-deadline")}
+        if not extra:
+            return set()
+        time.sleep(0.2)
+    return extra
+
+
+class TestAdmissionControl:
+    def test_queue_wait_sheds_503_slowdown(self, tmp_path, monkeypatch):
+        """2 API slots held by slow PUTs; a GET with a 150 ms request
+        timeout sheds with 503 SlowDown + Retry-After well inside a
+        second (reference sheds after requests_deadline)."""
+        monkeypatch.setenv("MINIO_API_REQUESTS_MAX", "2")
+        monkeypatch.setenv("MINIO_API_REQUESTS_DEADLINE", "10s")
+        pools, chaos = _chaos_pools(tmp_path, n=4)
+        srv = S3TestServer(str(tmp_path / "x"), pools=pools)
+        try:
+            assert srv.request("PUT", "/bkt").status == 200
+            for c in chaos:
+                c.set_latency(0.4)  # writes now crawl
+
+            def slow_put(i):
+                srv.request("PUT", f"/bkt/slow{i}", data=b"z" * 4096)
+
+            holders = [threading.Thread(target=slow_put, args=(i,))
+                       for i in range(2)]
+            for t in holders:
+                t.start()
+            time.sleep(0.25)  # both slots occupied
+            t0 = time.monotonic()
+            r = srv.request("GET", "/bkt/slow0",
+                            headers={"x-amz-request-timeout": "150ms"})
+            dt = time.monotonic() - t0
+            assert r.status == 503
+            assert b"<Code>SlowDown</Code>" in r.body
+            assert r.headers.get("Retry-After") == "1"
+            assert dt < 1.0, f"shed took {dt:.2f}s"
+            for t in holders:
+                t.join(15)
+        finally:
+            for c in chaos:
+                c.restore()
+            srv.close()
+
+    def test_malformed_timeout_header_ignored(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MINIO_API_REQUESTS_DEADLINE", "10s")
+        srv = S3TestServer(str(tmp_path / "y"))
+        try:
+            r = srv.request("PUT", "/hok",
+                            headers={"x-amz-request-timeout": "banana"})
+            assert r.status == 200
+        finally:
+            srv.close()
+
+
+class TestOverloadDrill:
+    """The ISSUE 3 acceptance drill: 4 of 8 drives at +500 ms under 4x
+    oversubscription — hedged reads keep served-GET p99 inside the
+    deadline, excess load sheds 503 SlowDown before the deadline,
+    brownout engages then releases, and no thread leaks."""
+
+    DEADLINE_S = 3.0
+
+    def test_overload_drill(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MINIO_API_REQUESTS_MAX", "4")
+        monkeypatch.setenv("MINIO_API_REQUESTS_DEADLINE",
+                           f"{self.DEADLINE_S:g}s")
+        monkeypatch.setenv("MINIO_API_BROWNOUT_DEPTH", "3")
+        monkeypatch.setenv("MINIO_API_BROWNOUT_RELEASE", "1s")
+        monkeypatch.setenv("MINIO_PROMETHEUS_AUTH_TYPE", "public")
+        from minio_tpu.erasure import objects as eobj
+
+        baseline_threads = _threads()
+        pools, chaos = _chaos_pools(tmp_path, n=8)
+        srv = S3TestServer(str(tmp_path / "drill"), pools=pools,
+                           start_services=True, scan_interval=3600)
+        record = {}
+        try:
+            assert srv.request("PUT", "/bkt").status == 200
+            payload = os.urandom(1 << 20)  # > inline threshold: real shards
+            for i in range(4):
+                r = srv.request("PUT", f"/bkt/o{i}", data=payload)
+                assert r.status == 200
+
+            # ---- inject: 4 of 8 drives at +500 ms ---------------------
+            for c in chaos[:4]:
+                c.set_latency(0.5)
+            hedges0 = eobj.hedge_stats["hedged"]
+
+            # prime: first GET samples the slow drives' EWMA (the one
+            # slow read that teaches the hedge), later GETs route around
+            r = srv.request("GET", "/bkt/o0")
+            assert r.status == 200 and r.body == payload
+
+            # ---- phase A: 16 clients (4x oversubscription) ------------
+            lat: list[float] = []
+            statuses: list[int] = []
+            mu = threading.Lock()
+
+            def one_get(i):
+                t0 = time.monotonic()
+                r = srv.request("GET", f"/bkt/o{i % 4}")
+                dt = time.monotonic() - t0
+                with mu:
+                    lat.append(dt)
+                    statuses.append(r.status)
+                    if r.status == 200:
+                        assert r.body == payload
+
+            clients = [threading.Thread(target=one_get, args=(i,))
+                       for i in range(16)]
+            t_start = time.monotonic()
+            for t in clients:
+                t.start()
+            for t in clients:
+                t.join(30)
+            served = [d for d, s in zip(lat, statuses) if s == 200]
+            shed_a = sum(1 for s in statuses if s == 503)
+            assert len(served) + shed_a == 16
+            assert len(served) >= 12, f"statuses={statuses}"
+            served.sort()
+            p99 = served[max(0, int(len(served) * 0.99) - 1)]
+            worst = served[-1]
+            assert worst <= self.DEADLINE_S, \
+                f"served GET p100 {worst:.2f}s blew the deadline"
+            assert eobj.hedge_stats["hedged"] > hedges0, \
+                "hedge never engaged"
+
+            # ---- phase B: saturate slots, force sheds -----------------
+            for c in chaos:
+                c.set_latency(0.4)  # every write now crawls
+
+            def slow_put(i):
+                srv.request("PUT", f"/bkt/hold{i}", data=b"h" * 8192)
+
+            holders = [threading.Thread(target=slow_put, args=(i,))
+                       for i in range(4)]
+            for t in holders:
+                t.start()
+            time.sleep(0.3)  # all four slots busy
+            shed_lat: list[float] = []
+            shed_status: list[int] = []
+
+            def short_get(i):
+                t0 = time.monotonic()
+                r = srv.request(
+                    "GET", "/bkt/o0",
+                    headers={"x-amz-request-timeout": "200ms"})
+                with mu:
+                    shed_lat.append(time.monotonic() - t0)
+                    shed_status.append(r.status)
+                    if r.status == 503:
+                        assert b"<Code>SlowDown</Code>" in r.body
+
+            getters = [threading.Thread(target=short_get, args=(i,))
+                       for i in range(8)]
+            for t in getters:
+                t.start()
+            for t in getters:
+                t.join(15)
+            for t in holders:
+                t.join(30)
+            sheds = sum(1 for s in shed_status if s == 503)
+            assert sheds >= 4, f"expected sheds, got {shed_status}"
+            worst_shed = max(d for d, s in zip(shed_lat, shed_status)
+                             if s == 503)
+            assert worst_shed < 1.0, \
+                f"shed answered after {worst_shed:.2f}s (deadline 0.2s)"
+
+            # ---- brownout engaged under pressure, releases after -----
+            bo = srv.server.services.brownout
+            assert bo.engagements >= 1, "brownout never engaged"
+            deadline = time.time() + 5
+            while bo.engaged() and time.time() < deadline:
+                time.sleep(0.1)
+            assert not bo.engaged(), "brownout never released"
+            assert bo.releases >= 1
+
+            # ---- metrics surface -------------------------------------
+            for c in chaos:
+                c.restore()
+            m = srv.request("GET", "/minio/v2/metrics/cluster",
+                            unsigned=True)
+            assert m.status == 200
+            text = m.text()
+            for metric in ("minio_s3_queue_wait_seconds",
+                           "minio_s3_requests_shed_total",
+                           "minio_read_hedges_total",
+                           "minio_brownout_engaged",
+                           "minio_brownout_engagements_total"):
+                assert metric in text, f"{metric} missing from /metrics"
+
+            record = {
+                "pass": True,
+                "deadline_s": self.DEADLINE_S,
+                "drives": 8, "slow_drives": 4,
+                "injected_latency_s": 0.5,
+                "oversubscription": "4x (16 clients / 4 slots)",
+                "phase_a_served": len(served),
+                "phase_a_shed": shed_a,
+                "served_p99_s": round(p99, 3),
+                "served_max_s": round(worst, 3),
+                "phase_b_sheds": sheds,
+                "worst_shed_latency_s": round(worst_shed, 3),
+                "hedged_reads": eobj.hedge_stats["hedged"] - hedges0,
+                "stragglers_abandoned": eobj.hedge_stats["abandoned"],
+                "brownout_engagements": bo.engagements,
+                "brownout_released": not bo.engaged(),
+            }
+        finally:
+            for c in chaos:
+                c.restore()
+            srv.close()
+            leaked = _leaked(baseline_threads)
+            record["thread_leaks"] = sorted(leaked)
+            if record.get("pass"):
+                record["pass"] = not leaked
+            # acceptance: pass/fail line recorded in BENCH_r08.json
+            try:
+                bench_path = os.path.join(
+                    os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__))), "BENCH_r08.json")
+                doc = {}
+                if os.path.exists(bench_path):
+                    with open(bench_path, encoding="utf-8") as f:
+                        doc = json.load(f)
+                doc["overload_drill"] = record
+                with open(bench_path, "w", encoding="utf-8") as f:
+                    json.dump(doc, f, indent=2)
+                    f.write("\n")
+            except Exception:
+                pass
+            assert not leaked, f"leaked threads: {leaked}"
+
+
+# ------------------------------------------------- deadline-gated storage
+class TestDriveDeadlineWorker:
+    def test_gated_read_abandons_hung_drive(self, tmp_path):
+        from minio_tpu.storage.instrumented import InstrumentedStorage
+        from minio_tpu.storage.local import LocalStorage
+        from minio_tpu.storage.naughty import ChaosDisk
+
+        chaos = ChaosDisk(LocalStorage(str(tmp_path / "d0")))
+        d = InstrumentedStorage(chaos)
+        d.make_volume("v")
+        d.write_all("v", "f", b"payload")
+        chaos.set_latency(0.5)
+        with dl.scope(dl.Budget(0.15)):
+            t0 = time.monotonic()
+            with pytest.raises(errors.DeadlineExceeded):
+                d.read_all("v", "f")
+            assert time.monotonic() - t0 < 0.45
+        assert d.deadline_timeouts >= 1
+        assert d.health_stats()["deadlineTimeouts"] >= 1
+        # without a budget the call just takes its time
+        chaos.set_latency(0.05)
+        assert d.read_all("v", "f") == b"payload"
+
+    def test_expired_budget_refused_without_touching_drive(self, tmp_path):
+        from minio_tpu.storage.instrumented import InstrumentedStorage
+        from minio_tpu.storage.local import LocalStorage
+
+        d = InstrumentedStorage(LocalStorage(str(tmp_path / "d0")))
+        d.make_volume("v")
+        d.write_all("v", "f", b"x")
+        with dl.scope(dl.Budget(0.0)):
+            with pytest.raises(errors.DeadlineExceeded):
+                d.read_all("v", "f")
+        assert d.deadline_expired >= 1
+        # writes are never deadline-gated: commits must not be abandoned
+        with dl.scope(dl.Budget(0.0)):
+            d.write_all("v", "g", b"y")
+        assert d.read_all("v", "g") == b"y"
+
+
+class TestQuorumStragglerAbandon:
+    def test_read_returns_at_quorum_with_slow_straggler(self, tmp_path):
+        """One drive at +2 s must not hold a budgeted metadata read
+        hostage: the fan-out returns at quorum + grace."""
+        from minio_tpu.erasure.objects import PutObjectOptions
+
+        pools, chaos = _chaos_pools(tmp_path, n=4)
+        pools.make_bucket("b")
+        data = os.urandom(300_000)
+        pools.put_object("b", "o", io.BytesIO(data), len(data),
+                         PutObjectOptions())
+        chaos[0].set_latency(2.0)
+        try:
+            with dl.scope(dl.Budget(5.0)):
+                t0 = time.monotonic()
+                oi = pools.get_object_info("b", "o")
+                dt = time.monotonic() - t0
+            assert oi.size == len(data)
+            assert dt < 1.5, f"straggler held the read {dt:.2f}s"
+        finally:
+            chaos[0].restore()
+
+    def test_unbudgeted_read_still_waits_for_all(self, tmp_path):
+        """Background paths (no budget) keep the complete fan-out —
+        object_health must see every drive's answer."""
+        from minio_tpu.erasure.objects import PutObjectOptions
+
+        pools, chaos = _chaos_pools(tmp_path, n=4)
+        pools.make_bucket("b")
+        data = os.urandom(200_000)
+        pools.put_object("b", "o", io.BytesIO(data), len(data),
+                         PutObjectOptions())
+        chaos[0].set_latency(0.3)
+        try:
+            t0 = time.monotonic()
+            fi, missing = pools.pools[0].sets[0].object_health("b", "o")
+            dt = time.monotonic() - t0
+            assert missing == 0
+            assert dt >= 0.28, "unbudgeted fan-out returned early"
+        finally:
+            chaos[0].restore()
+
+
+class TestHedgeLazySteal:
+    def test_midstream_corruption_steals_to_hedged_out_drive(self,
+                                                             tmp_path):
+        """Exactly k fast shards, one corrupt on disk: the decode must
+        work-steal into a LAZILY-opened hedged-out slow drive instead of
+        failing the read (review finding: slow spares must stay
+        reachable mid-stream)."""
+        import glob
+
+        from minio_tpu.erasure.objects import PutObjectOptions
+
+        pools, chaos = _chaos_pools(tmp_path, n=8)
+        disks = pools.pools[0].sets[0].disks
+        pools.make_bucket("b")
+        data = os.urandom(600_000)  # non-inline: real shard files
+        pools.put_object("b", "o", io.BytesIO(data), len(data),
+                         PutObjectOptions())
+        # mark 4 drives slow via their read EWMA (hedge input)
+        for d in disks[:4]:
+            st = d._ops["read_file_stream"]
+            st.count, st.ewma_s = 1, 0.5
+        # corrupt one FAST drive's shard bytes on disk
+        fast_roots = [d.unwrap().unwrap().root for d in disks[4:]]
+        part = sorted(glob.glob(os.path.join(
+            fast_roots[0], "b", "o", "*", "part.1")))[0]
+        with open(part, "r+b") as f:
+            f.seek(100)
+            f.write(b"\xff" * 64)
+        with dl.scope(dl.Budget(30.0)):
+            _, stream = pools.get_object("b", "o")
+            out = b"".join(stream)
+        assert out == data, "read did not recover via the lazy spare"
