@@ -1,0 +1,8 @@
+"""Project-native static analysis (`python -m minio_tpu.analysis`).
+
+AST checkers for the invariants the deadline/overload plane rests on —
+see `core.py` for the engine and pragma grammar, `rules/` for the
+checkers.  Run as a tier-1 gate by tests/test_static_analysis.py."""
+
+from .core import (Finding, RULES, analyze_paths,  # noqa: F401
+                   analyze_source)

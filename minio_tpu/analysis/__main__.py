@@ -1,0 +1,64 @@
+"""CLI: `python -m minio_tpu.analysis [paths...]`.
+
+Exits 0 when clean, 1 on findings, 2 on usage errors.  The same engine
+runs in tier-1 (tests/test_static_analysis.py) — the CLI exists so a
+dev loop / pre-push hook can run the gate without pytest."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .core import RULES, analyze_paths
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m minio_tpu.analysis",
+        description="project-native invariant linter")
+    parser.add_argument("paths", nargs="*",
+                        help="files/directories to scan "
+                             "(default: the minio_tpu package)")
+    parser.add_argument("--rule", action="append", dest="rules",
+                        metavar="NAME",
+                        help="run only this rule (repeatable)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="list registered rules and exit")
+    args = parser.parse_args(argv)
+
+    # rule modules register on import
+    from . import rules as _rules  # noqa: F401
+
+    if args.list_rules:
+        width = max(len(n) for n in RULES)
+        for name in sorted(RULES):
+            print(f"{name:<{width}}  {RULES[name][0]}")
+        return 0
+
+    paths = args.paths
+    if not paths:
+        paths = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"error: no such path: {p}", file=sys.stderr)
+            return 2
+    try:
+        findings = analyze_paths(paths, args.rules)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    for f in findings:
+        print(f)
+    if findings:
+        n = len(findings)
+        print(f"\n{n} finding{'s' if n != 1 else ''} "
+              f"({len({f.path for f in findings})} file(s)). "
+              "Fix the violation or suppress with "
+              "`# lint: allow(<rule>): <reason>`.", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,363 @@
+"""Engine for the project-native invariant linter.
+
+The deadline/overload plane (PRs 1-3) rests on conventions no general
+tool checks: cross-thread hops must ride `ctx_submit` or the contextvar
+Budget silently vanishes, handler exceptions must resolve to the S3
+error taxonomy, blocking calls must not run under a `threading.Lock`,
+spawned threads need a shutdown path, and metric rows must match their
+declared families.  MinIO leans on `go vet` + the race detector for
+these bug classes; this package is the Python-side analogue — small
+AST checkers with project knowledge, run as a tier-1 test gate and as
+`python -m minio_tpu.analysis`.
+
+Suppressions are explicit and must carry a reason:
+
+    executor.submit(fn)  # lint: allow(budget-propagation): fire-and-forget audit write, no budget to carry
+
+A pragma may sit on the flagged line or on a comment line directly
+above it.  A pragma without a reason, naming an unknown rule, or
+suppressing nothing is itself a finding (rule `pragma`) so the
+suppression inventory cannot silently rot.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+PRAGMA_RE = re.compile(
+    r"#\s*lint:\s*allow\(\s*([A-Za-z0-9_\-, ]+?)\s*\)\s*(?::\s*(\S.*?))?\s*$")
+
+#: rule name -> (one-line help, check function).  Populated by @rule.
+RULES: dict[str, tuple[str, object]] = {}
+
+#: the meta-rule policing pragma hygiene; always on, not suppressible.
+PRAGMA_RULE = "pragma"
+
+
+def rule(name: str, help_: str):
+    """Register a checker: ``fn(module, project) -> list[Finding]``."""
+
+    def deco(fn):
+        RULES[name] = (help_, fn)
+        return fn
+
+    return deco
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+    #: extra lines where a pragma also suppresses this finding (e.g.
+    #: the `with lock:` header for a finding inside the block).
+    anchors: tuple = ()
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] " \
+               f"{self.message}"
+
+
+@dataclass
+class Pragma:
+    line: int
+    rules: tuple[str, ...]
+    reason: str | None
+    used: bool = field(default=False, compare=False)
+
+
+class Module:
+    """One parsed source file: AST + pragma comments + raw lines."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.pragmas: dict[int, Pragma] = {}
+        self._scan_pragmas()
+
+    def _scan_pragmas(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(
+                iter(self.source.splitlines(keepends=True)).__next__)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = PRAGMA_RE.search(tok.string)
+                if m is None:
+                    continue
+                names = tuple(r.strip() for r in m.group(1).split(",")
+                              if r.strip())
+                self.pragmas[tok.start[0]] = Pragma(
+                    tok.start[0], names, m.group(2))
+        except tokenize.TokenError:
+            pass
+
+    def _comment_only(self, line: int) -> bool:
+        if not 1 <= line <= len(self.lines):
+            return False
+        text = self.lines[line - 1].strip()
+        return text.startswith("#")
+
+    def pragma_for(self, rule_name: str, line: int) -> Pragma | None:
+        """The pragma covering `line` for `rule_name`: on the line
+        itself or on a run of comment-only lines directly above."""
+        probe = line
+        while True:
+            p = self.pragmas.get(probe)
+            if p is not None and rule_name in p.rules:
+                return p
+            probe -= 1
+            if probe < 1 or not self._comment_only(probe):
+                return None
+
+
+class Project:
+    """All scanned modules + lazily computed shared facts (the S3 error
+    table, the from_storage_error mapping, declared metric families)."""
+
+    def __init__(self, modules: list[Module]):
+        self.modules = modules
+        self._s3_codes: set[str] | None = None
+        self._mapped_storage: set[str] | None = None
+        self._declared_metrics: set[str] | None = None
+
+    # -- S3 error taxonomy ---------------------------------------------------
+    @staticmethod
+    def _pkg_file(*rel: str) -> str | None:
+        """Locate a file of the real minio_tpu package (relative to this
+        module, no imports — the linter must not drag in aiohttp/jax)."""
+        pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        path = os.path.join(pkg, *rel)
+        return path if os.path.exists(path) else None
+
+    def _s3errors_path(self) -> str | None:
+        return self._pkg_file("server", "s3errors.py")
+
+    def s3_error_codes(self) -> set[str]:
+        """Registered codes: the keys of the S3_ERRORS dict literal,
+        read from server/s3errors.py's AST."""
+        if self._s3_codes is not None:
+            return self._s3_codes
+        codes: set[str] = set()
+        path = self._s3errors_path()
+        if path is not None:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    tree = ast.parse(f.read())
+                for node in tree.body:
+                    if (isinstance(node, ast.Assign)
+                            and any(isinstance(t, ast.Name)
+                                    and t.id == "S3_ERRORS"
+                                    for t in node.targets)
+                            and isinstance(node.value, ast.Dict)):
+                        for key in node.value.keys:
+                            if isinstance(key, ast.Constant) and \
+                                    isinstance(key.value, str):
+                                codes.add(key.value)
+            except (OSError, SyntaxError):
+                pass
+        self._s3_codes = codes
+        return codes
+
+    def mapped_storage_errors(self) -> set[str]:
+        """Storage-error class names `from_storage_error` maps to a
+        specific S3 code (parsed from its AST: the `(st.X, "Code")`
+        rows of the mapping list)."""
+        if self._mapped_storage is not None:
+            return self._mapped_storage
+        names: set[str] = set()
+        path = self._s3errors_path()
+        if path is not None:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    tree = ast.parse(f.read())
+                for node in ast.walk(tree):
+                    if (isinstance(node, ast.Tuple)
+                            and len(node.elts) == 2
+                            and isinstance(node.elts[1], ast.Constant)
+                            and isinstance(node.elts[1].value, str)):
+                        first = node.elts[0]
+                        if isinstance(first, ast.Attribute):
+                            names.add(first.attr)
+                        elif isinstance(first, ast.Name):
+                            names.add(first.id)
+            except (OSError, SyntaxError):
+                pass
+        self._mapped_storage = names
+        return names
+
+    # -- metric families -----------------------------------------------------
+    def declared_metrics(self) -> set[str]:
+        """Metric families declared in server/metrics.py: Registry
+        counter/gauge/histogram names, the local gauge() helper's first
+        args, and `# HELP <name>` exposition headers."""
+        if self._declared_metrics is not None:
+            return self._declared_metrics
+        declared: set[str] = set()
+        path = self._pkg_file("server", "metrics.py")
+        if path is None:
+            self._declared_metrics = declared
+            return declared
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            self._declared_metrics = declared
+            return declared
+        help_re = re.compile(r"#\s*HELP\s+(minio_[a-z0-9_]+)")
+        name_re = re.compile(r"^minio_[a-z0-9_]+$")
+        for node in ast.walk(tree):
+            # the (name, help, ...) tuple idiom: per-family rows whose
+            # HELP header is built from the tuple at render time
+            if (isinstance(node, ast.Tuple) and len(node.elts) >= 2
+                    and all(isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)
+                            for e in node.elts[:2])
+                    and name_re.match(node.elts[0].value)):
+                declared.add(node.elts[0].value)
+            if isinstance(node, ast.Call):
+                fname = None
+                if isinstance(node.func, ast.Attribute):
+                    fname = node.func.attr
+                elif isinstance(node.func, ast.Name):
+                    fname = node.func.id
+                if fname in ("counter", "gauge", "histogram") and node.args:
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Constant) and \
+                            isinstance(arg.value, str):
+                        declared.add(arg.value)
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                for m in help_re.finditer(node.value):
+                    declared.add(m.group(1))
+        self._declared_metrics = declared
+        return declared
+
+
+def iter_py_files(path: str):
+    if os.path.isfile(path):
+        yield path
+        return
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames[:] = [d for d in sorted(dirnames)
+                       if d != "__pycache__" and not d.startswith(".")]
+        for f in sorted(filenames):
+            if f.endswith(".py"):
+                yield os.path.join(dirpath, f)
+
+
+def load_modules(paths) -> tuple[list[Module], list[Finding]]:
+    modules, errors = [], []
+    for root in paths:
+        for path in iter_py_files(root):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+                modules.append(Module(path, source))
+            except (OSError, SyntaxError, UnicodeDecodeError) as e:
+                errors.append(Finding(path, getattr(e, "lineno", 0) or 0, 0,
+                                      "parse", f"cannot analyze: {e}"))
+    return modules, errors
+
+
+def analyze_modules(modules: list[Module],
+                    rules: list[str] | None = None) -> list[Finding]:
+    """Run checkers over parsed modules, apply pragma suppressions, and
+    police pragma hygiene.  Returns surviving findings sorted by
+    location."""
+    # rule modules register themselves on import
+    from minio_tpu.analysis import rules as _rules  # noqa: F401
+
+    selected = list(RULES) if rules is None else list(rules)
+    unknown = [r for r in selected if r not in RULES]
+    if unknown:
+        raise ValueError(f"unknown rule(s): {', '.join(unknown)}")
+    all_selected = rules is None
+    project = Project(modules)
+    out: list[Finding] = []
+    for mod in modules:
+        for name in selected:
+            _, fn = RULES[name]
+            for finding in fn(mod, project):
+                pragma = mod.pragma_for(finding.rule, finding.line)
+                for anchor in finding.anchors:
+                    if pragma is not None:
+                        break
+                    pragma = mod.pragma_for(finding.rule, anchor)
+                if pragma is not None:
+                    pragma.used = True
+                    if pragma.reason:
+                        continue  # suppressed; reason policed below
+                out.append(finding)
+        # pragma hygiene: reasons are mandatory, names must be real
+        # rules, and (on a full run) every pragma must suppress
+        # something — a stale allow() is how violations sneak back in.
+        for line, pragma in sorted(mod.pragmas.items()):
+            if not pragma.reason:
+                out.append(Finding(
+                    mod.path, line, 0, PRAGMA_RULE,
+                    "suppression without a reason: write "
+                    "`# lint: allow(rule): why this is safe`"))
+            bad = [r for r in pragma.rules if r not in RULES]
+            if bad:
+                out.append(Finding(
+                    mod.path, line, 0, PRAGMA_RULE,
+                    f"unknown rule(s) in pragma: {', '.join(bad)}"))
+            if all_selected and not pragma.used and not bad:
+                out.append(Finding(
+                    mod.path, line, 0, PRAGMA_RULE,
+                    f"unused suppression for "
+                    f"{', '.join(pragma.rules)}: nothing on this line "
+                    "triggers the rule — delete the pragma"))
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def analyze_paths(paths, rules: list[str] | None = None) -> list[Finding]:
+    modules, errors = load_modules(paths)
+    return errors + analyze_modules(modules, rules)
+
+
+def analyze_source(source: str, path: str = "<mem>",
+                   rules: list[str] | None = None) -> list[Finding]:
+    return analyze_modules([Module(path, source)], rules)
+
+
+# ------------------------------------------------------------- AST helpers
+def call_name(node: ast.Call) -> str:
+    """Dotted-ish name of the callee: `a.b.c(...)` -> "a.b.c",
+    `f(...)` -> "f"; empty string for computed callees."""
+    parts: list[str] = []
+    cur = node.func
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+    elif parts:
+        parts.append("?")
+    return ".".join(reversed(parts))
+
+
+def terminal_name(node: ast.expr) -> str:
+    """Last identifier of a Name/Attribute expression ("self._mu" ->
+    "_mu"); empty for anything else."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def expr_source(node: ast.expr) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return "<expr>"

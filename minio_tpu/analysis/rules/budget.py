@@ -1,0 +1,81 @@
+"""budget-propagation: cross-thread hops must carry the deadline Budget.
+
+The Budget rides a contextvar (`utils/deadline.py`).  A raw
+`pool.submit(fn)`, `threading.Thread(target=fn)` or
+`loop.run_in_executor(pool, fn)` runs `fn` in the worker's own default
+context — the budget silently vanishes and every deadline gate
+downstream stands down.  Every hop must either go through
+`deadline.ctx_submit` / an explicit `contextvars.copy_context().run`
+wrapper, or be pragma-documented as a provably budget-free path
+(background service loops, fire-and-forget notification)."""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, call_name, rule
+
+def _carries_context(node: ast.Call) -> bool:
+    """True when the call visibly threads a copied context through:
+    some argument references `.run` ON A CONTEXT — a name containing
+    ctx/context (`ctx.run`, the `lambda: ctx.run(fn)` idiom in
+    server/app.py) or a direct `copy_context().run` chain.  A bare
+    `.run` attribute is NOT enough: `pool.submit(task.run)` is a
+    Runnable idiom that still drops the budget."""
+    for arg in node.args + [kw.value for kw in node.keywords]:
+        for sub in ast.walk(arg):
+            if not (isinstance(sub, ast.Attribute) and sub.attr == "run"):
+                continue
+            recv = sub.value
+            if isinstance(recv, ast.Name) and (
+                    "ctx" in recv.id.lower()
+                    or "context" in recv.id.lower()):
+                return True
+            if isinstance(recv, ast.Call) and call_name(recv).endswith(
+                    "copy_context"):
+                return True
+    return False
+
+
+@rule("budget-propagation",
+      "raw submit/Thread/run_in_executor drops the deadline Budget "
+      "contextvar; use deadline.ctx_submit or pragma a budget-free path")
+def check(module, project):
+    if module.path.replace("\\", "/").endswith("utils/deadline.py"):
+        return []
+    out = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        last = name.rsplit(".", 1)[-1]
+        if last == "submit" and name != "submit":
+            if _carries_context(node):
+                continue
+            out.append(Finding(
+                module.path, node.lineno, node.col_offset,
+                "budget-propagation",
+                f"`{name}(...)` drops the deadline budget contextvar; "
+                "use deadline.ctx_submit(pool, fn, ...) or suppress "
+                "with a reason if this path is budget-free"))
+        elif last == "run_in_executor":
+            if _carries_context(node):
+                continue
+            out.append(Finding(
+                module.path, node.lineno, node.col_offset,
+                "budget-propagation",
+                f"`{name}(...)` drops contextvars; wrap the callable "
+                "in contextvars.copy_context().run (see S3Server._run) "
+                "or suppress with a reason if this path is budget-free"))
+        elif last == "Thread":
+            has_target = any(kw.arg == "target" for kw in node.keywords)
+            if not (has_target or node.args):
+                continue
+            out.append(Finding(
+                module.path, node.lineno, node.col_offset,
+                "budget-propagation",
+                "threading.Thread runs its target in a fresh context "
+                "(no deadline budget); request-path work belongs on a "
+                "pool via deadline.ctx_submit — long-lived workers "
+                "should document budget-freedom with a pragma"))
+    return out

@@ -1,0 +1,65 @@
+"""metrics-drift: every emitted metric family must be declared.
+
+`server/metrics.py` renders two ways: Registry families (counter/
+gauge/histogram declarations carry HELP/TYPE automatically) and
+hand-built exposition rows (`# HELP name ...` headers + f-string
+rows).  A row emitted under a name with no matching declaration is
+invisible drift: Prometheus scrapes a family with no HELP/TYPE (or a
+typo'd name nobody dashboards).  The checker extracts every
+`minio_*<unit>` token from string literals across the package and
+requires it to appear in the declared set from server/metrics.py."""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Finding, rule
+
+#: a string literal is treated as a metric family name only when it
+#: ends in a unit/aggregate suffix — bare `minio_tpu_*` identifiers
+#: (contextvar names, path prefixes) don't look like this.
+_METRIC_RE = re.compile(
+    r"\bminio_[a-z0-9_]+_"
+    r"(?:total|bytes|seconds|ms|millis|fraction|pending|engaged|wins|"
+    r"length|count|ratio|info|percent)\b")
+
+#: prom.py renders histogram children with these suffixes appended to
+#: the declared family name.
+_HISTO_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _strings(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            yield node.lineno, node.col_offset, node.value
+
+
+@rule("metrics-drift",
+      "metric names emitted anywhere must be declared (Registry family "
+      "or # HELP header) in server/metrics.py")
+def check(module, project):
+    declared = project.declared_metrics()
+    if not declared:
+        return []
+    out = []
+    seen: set[tuple[int, str]] = set()
+    for lineno, col, value in _strings(module.tree):
+        for m in _METRIC_RE.finditer(value):
+            name = m.group(0)
+            if name in declared:
+                continue
+            base = name
+            for suf in _HISTO_SUFFIXES:
+                if name.endswith(suf) and name[:-len(suf)] in declared:
+                    base = None
+                    break
+            if base is None or (lineno, name) in seen:
+                continue
+            seen.add((lineno, name))
+            out.append(Finding(
+                module.path, lineno, col, "metrics-drift",
+                f"metric `{name}` is emitted/referenced but never "
+                "declared in server/metrics.py — add a Registry "
+                "family or a # HELP/# TYPE header (or fix the typo)"))
+    return out

@@ -1,0 +1,84 @@
+"""s3-error-coverage: every error a handler can surface must resolve
+to a registered S3 code.
+
+Two failure shapes this catches statically (the reference relies on
+cmd/api-errors.go exhaustiveness for the same contract):
+
+- `S3Error("SomeCode")` / `SigV4Error("SomeCode")` with a code that is
+  not in the `S3_ERRORS` table renders as a 500 "Unknown error." —
+  the taxonomy silently degrades.
+- a storage-error type raised under `server/` handler paths that
+  `from_storage_error` does not map falls through to a generic
+  InternalError, losing the status code S3 clients dispatch on
+  (e.g. DiskFull should surface as 507 XMinioStorageFull)."""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, rule
+
+_ERROR_CTORS = ("S3Error", "SigV4Error")
+
+#: storage-error classes that legitimately have no specific S3 mapping:
+#: they are internal control-flow signals the handlers always catch.
+_INTERNAL_STORAGE_ERRORS = {
+    "StorageError",  # the base class: too generic to map
+}
+
+
+def _under_server(path: str) -> bool:
+    parts = path.replace("\\", "/").split("/")
+    return "server" in parts
+
+
+@rule("s3-error-coverage",
+      "S3Error/SigV4Error codes must be registered in S3_ERRORS; "
+      "storage errors raised under server/ must be mapped by "
+      "from_storage_error")
+def check(module, project):
+    codes = project.s3_error_codes()
+    if not codes:
+        return []
+    norm = module.path.replace("\\", "/")
+    if norm.endswith("server/s3errors.py"):
+        return []  # the table itself
+    out = []
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call):
+            fname = node.func.attr if isinstance(node.func, ast.Attribute) \
+                else node.func.id if isinstance(node.func, ast.Name) else ""
+            if fname in _ERROR_CTORS and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str) and \
+                        arg.value not in codes:
+                    out.append(Finding(
+                        module.path, node.lineno, node.col_offset,
+                        "s3-error-coverage",
+                        f'{fname}("{arg.value}") uses a code that is '
+                        "not registered in server/s3errors.py "
+                        "S3_ERRORS — it will render as a 500 "
+                        '"Unknown error."'))
+        if isinstance(node, ast.Raise) and _under_server(norm):
+            exc = node.exc
+            if isinstance(exc, ast.Call):
+                exc = exc.func
+            name = None
+            if isinstance(exc, ast.Attribute) and \
+                    isinstance(exc.value, ast.Name) and \
+                    exc.value.id in ("st", "errors", "storage_errors"):
+                name = exc.attr
+            if name is None:
+                continue
+            if name in _INTERNAL_STORAGE_ERRORS:
+                continue
+            if name not in project.mapped_storage_errors():
+                out.append(Finding(
+                    module.path, node.lineno, node.col_offset,
+                    "s3-error-coverage",
+                    f"storage error `{name}` raised on a handler path "
+                    "has no from_storage_error mapping — clients get "
+                    "a generic InternalError instead of a specific "
+                    "code/status"))
+    return out

@@ -1,0 +1,81 @@
+"""thread-lifecycle: every spawned thread needs a shutdown path.
+
+`tests/test_leaks.py` catches leaked threads dynamically, per test —
+this rule catches them at review time.  A non-daemon thread with no
+`.join()` anywhere in its module (and no `t.daemon = True`
+re-assignment) outlives `close()` and hangs interpreter exit; the
+repo's convention is `daemon=True` for service loops owned by
+ServiceManager.close()/stop events, and an explicit join for
+bounded-lifetime workers."""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, call_name, rule, terminal_name
+
+_THREADISH = ("thread", "worker", "probe", "proc")
+
+
+def _is_thread_join(node: ast.Call) -> bool:
+    """A `.join()` counts as a THREAD join only when the receiver looks
+    like one (`t.join()`, `self._thread.join()`, `worker.join()`) —
+    `", ".join(parts)` and other str joins must not satisfy the rule
+    for a whole module."""
+    if call_name(node).rsplit(".", 1)[-1] != "join":
+        return False
+    if not isinstance(node.func, ast.Attribute):
+        return False
+    recv = node.func.value
+    if isinstance(recv, ast.Constant):
+        return False  # literal str/bytes receiver
+    name = terminal_name(recv).lower().lstrip("_")
+    return name in ("t", "th") or any(m in name for m in _THREADISH)
+
+
+def _daemon_kw(node: ast.Call):
+    for kw in node.keywords:
+        if kw.arg == "daemon":
+            if isinstance(kw.value, ast.Constant):
+                return bool(kw.value.value)
+            return True  # dynamic value: assume intentional
+    return None
+
+
+@rule("thread-lifecycle",
+      "non-daemon Thread with no join/daemon re-assignment in its "
+      "module leaks past shutdown")
+def check(module, project):
+    has_join = False
+    daemon_assigned = False
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call) and _is_thread_join(node):
+            has_join = True
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute) and tgt.attr == "daemon":
+                    daemon_assigned = True
+    out = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name.rsplit(".", 1)[-1] != "Thread":
+            continue
+        if not (node.args or any(kw.arg == "target"
+                                 for kw in node.keywords)):
+            continue  # bare Thread() reference, not a spawn
+        daemon = _daemon_kw(node)
+        if daemon:
+            continue
+        if daemon is None and (has_join or daemon_assigned):
+            continue
+        if daemon is False and has_join:
+            continue
+        out.append(Finding(
+            module.path, node.lineno, node.col_offset,
+            "thread-lifecycle",
+            "thread spawned without daemon=True and this module never "
+            "joins or daemonizes a thread — it will outlive close() "
+            "and hang interpreter exit; register a stop/join path"))
+    return out

@@ -310,6 +310,7 @@ class ServerConfig:
                 # reference uses etcd transactions; read-merge-write
                 # under the instance lock is our approximation — the
                 # race window is one HTTP round trip)
+                # lint: allow(blocking-under-lock): read-merge-write consistency window; config writes are rare and the doc is tiny
                 self._load()
             self._stored.setdefault(subsys, {}).update(
                 {k: str(v) for k, v in kvs.items()})
@@ -324,6 +325,7 @@ class ServerConfig:
             raise ConfigError(f"unknown config subsystem {subsys!r}")
         with self._mu:
             if self._etcd() is not None:
+                # lint: allow(blocking-under-lock): same read-merge-write window as set_kv
                 self._load()
             if keys:
                 sub = self._stored.get(subsys, {})

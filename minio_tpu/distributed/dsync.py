@@ -16,6 +16,8 @@ import time
 import uuid
 
 from minio_tpu.storage import errors
+from minio_tpu.utils.deadline import service_thread
+
 from .rpc import RpcClient, RpcRouter
 
 LOCK_TTL = 30.0          # server-side expiry without refresh
@@ -201,9 +203,8 @@ class LockMaintenance:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         if autostart:
-            self._thread = threading.Thread(
-                target=self._run, daemon=True, name="lock-maintenance")
-            self._thread.start()
+            self._thread = service_thread(
+                self._run, name="lock-maintenance")
 
     def _holding(self, owner: str, uid: str):
         """True = owner still holds uid, False = owner denies it,
@@ -354,7 +355,9 @@ class DRWMutex:
                     pass
 
         for c in self.clients:
-            threading.Thread(target=one, args=(c,), daemon=True).start()
+            # lock-plane RPC must not die with the caller's budget: a
+            # stray grant MUST be released or the entry leaks till TTL
+            service_thread(one, c, name="dsync-unlock")
         deadline = time.time() + self.timeout + 1.0
         with cv:
             while len(results) < n:
@@ -421,9 +424,8 @@ class DRWMutex:
     # -- refresh loop (drwmutex.go:221 startContinuousLockRefresh) ----------
     def _start_refresher(self) -> None:
         self._stop.clear()
-        t = threading.Thread(target=self._refresh_loop, daemon=True)
-        t.start()
-        self._refresher = t
+        self._refresher = service_thread(self._refresh_loop,
+                                         name="dsync-refresh")
 
     def _stop_refresher(self) -> None:
         self._stop.set()

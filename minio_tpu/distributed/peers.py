@@ -34,6 +34,8 @@ import threading
 import time
 import uuid
 
+from minio_tpu.utils.deadline import service_thread
+
 
 class PeerNotifier:
     """Client side: broadcasts and aggregations over every peer."""
@@ -56,9 +58,9 @@ class PeerNotifier:
                 except Exception:
                     pass  # peer converges via TTL / lazy reload
 
-            t = threading.Thread(target=call, daemon=True)
-            t.start()
-            threads.append(t)
+            # control-plane fan-out: budget-free by design (a metadata
+            # reload must land on peers even if the request dies)
+            threads.append(service_thread(call, name="peer-broadcast"))
         for t in threads:
             t.join(self.timeout)
 
@@ -80,9 +82,7 @@ class PeerNotifier:
                 with lock:
                     results[a] = out
 
-            t = threading.Thread(target=call, daemon=True)
-            t.start()
-            threads.append(t)
+            threads.append(service_thread(call, name=f"peer-fanout-{addr}"))
         for t in threads:
             t.join(self.timeout * 6)  # perf probes run longer than reloads
         for addr in self.clients:

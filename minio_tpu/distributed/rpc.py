@@ -524,6 +524,7 @@ class RpcRouter:
                     return fn(args, body)
 
             try:
+                # lint: allow(budget-propagation): invoke() re-installs the wire-header budget via deadline.scope
                 result = await loop.run_in_executor(pool, invoke)
             except Exception as e:
                 return web.Response(
@@ -535,6 +536,7 @@ class RpcRouter:
                 it = iter(result.chunks)
                 try:
                     while True:
+                        # lint: allow(budget-propagation): stream drain is a whole-payload phase, budget-free by design
                         chunk = await loop.run_in_executor(pool, next, it,
                                                            None)
                         if chunk is None:

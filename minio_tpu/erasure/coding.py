@@ -33,6 +33,7 @@ import numpy as np
 
 from minio_tpu.ops import gf256, host
 from minio_tpu.storage import errors
+from minio_tpu.utils.deadline import ctx_submit
 
 BLOCK_SIZE_V2 = 1 << 20  # reference blockSizeV2, cmd/object-api-common.go:40
 
@@ -445,8 +446,10 @@ class Erasure:
                     for bi in range(rows.shape[0]):
                         writers[i].write(rows[bi, :shard_len])
 
+            # ctx_submit: the caller's deadline budget must ride into
+            # the writer threads so the per-drive gates stay armed
             inflight.update({
-                i: pool.submit(write_drive, i)
+                i: ctx_submit(pool, write_drive, i)
                 for i in range(n)
                 if i not in dead and writers[i] is not None
             })
@@ -556,7 +559,7 @@ class Erasure:
 
         while len(got) < self.k:
             futs = {
-                i: pool.submit(read_one, readers[i])
+                i: ctx_submit(pool, read_one, readers[i])
                 for i in active
             }
             active = []

@@ -802,6 +802,7 @@ class ErasureObjects:
                     prefer = prefer + lazies
                 sink = _IterSink()
                 broken: set[int] = set()
+                # lint: allow(budget-propagation): whole-payload decode stream is deliberately budget-free (see _run_nobudget); joined in finally
                 worker = threading.Thread(
                     target=self._decode_to_sink,
                     args=(e, sink, readers, local_off, local_len, part.size,

@@ -12,6 +12,8 @@ import os
 import tempfile
 import threading
 
+from minio_tpu.utils.deadline import service_thread
+
 from .event import Event
 from .targets import QueueStore, StoreFull, TargetError
 
@@ -28,10 +30,8 @@ class _TargetWorker:
         self._wake = threading.Event()   # new-event arrival signal
         self._stop = threading.Event()   # close signal (retry sleeps on it)
         self._closed = False
-        self._thread = threading.Thread(
-            target=self._loop, daemon=True,
-            name=f"notify-{target.target_id}")
-        self._thread.start()
+        self._thread = service_thread(
+            self._loop, name=f"notify-{target.target_id}")
 
     def signal(self) -> None:
         self._wake.set()

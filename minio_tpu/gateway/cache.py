@@ -18,6 +18,7 @@ import threading
 import time
 from typing import Iterator
 
+from minio_tpu.utils.deadline import service_thread
 from minio_tpu.utils.logger import log
 
 # eviction watermarks, percent of max_size (reference cache watermarks)
@@ -125,9 +126,10 @@ class CacheLayer:
                 if start_fill:
                     self._filling.add(key)
         if start_fill:
-            threading.Thread(target=self._fill,
-                             args=(bucket, obj, key, oi),
-                             daemon=True).start()
+            # background cache fill: deliberately budget-free — the
+            # fill must finish even if the triggering request times out
+            service_thread(self._fill, bucket, obj, key, oi,
+                           name="cache-fill")
         return oi, stream
 
     def _read_cached(self, key: str, offset: int,

@@ -87,6 +87,7 @@ class OpenIDProvider:
             # another thread may have refreshed while we waited
             if time.monotonic() - self._fetched < 1.0:
                 return
+            # lint: allow(blocking-under-lock): single-flight JWKS refresh — this dedicated lock exists to serialize exactly this fetch
             with urllib.request.urlopen(self.jwks_url,
                                         timeout=self.timeout) as resp:
                 doc = json.loads(resp.read())

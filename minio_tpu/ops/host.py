@@ -23,7 +23,9 @@ import numpy as np
 from . import gf256
 
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "csrc")
-_LIBPATH = os.path.join(_CSRC, "libminio_tpu_host.so")
+# sanitizer harness hook: load an alternate (asan/ubsan/tsan) build
+_LIBPATH = os.environ.get("MINIO_TPU_NATIVE_LIB") or os.path.join(
+    _CSRC, "libminio_tpu_host.so")
 _lock = threading.Lock()
 _lib = None
 _lib_tried = False

@@ -61,7 +61,11 @@ _FN_SUBSTR = 7
 
 _CSRC = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "csrc")
-_LIBPATH = os.path.join(_CSRC, "libminio_tpu_host.so")
+# MINIO_TPU_NATIVE_LIB points the loader at an alternate build of the
+# host library — the sanitizer harness uses it to swap in the
+# asan/ubsan/tsan variants (csrc/Makefile `make asan` etc.)
+_LIBPATH = os.environ.get("MINIO_TPU_NATIVE_LIB") or os.path.join(
+    _CSRC, "libminio_tpu_host.so")
 _lock = threading.Lock()
 _lib = None
 _lib_tried = False

@@ -175,8 +175,9 @@ def main(argv=None) -> int:
     if node.distributed:
         # peers may still be starting: retry bootstrap verification in the
         # background for a bounded window (waitForFormatErasure analogue)
-        import threading
         import time as _time
+
+        from minio_tpu.utils.deadline import service_thread
 
         def verify_with_retry():
             for _ in range(30):
@@ -189,7 +190,7 @@ def main(argv=None) -> int:
             for p in problems:
                 print(f"minio-tpu: bootstrap warning: {p}", file=sys.stderr)
 
-        threading.Thread(target=verify_with_retry, daemon=True).start()
+        service_thread(verify_with_retry, name="bootstrap-verify")
 
     host, port = args.address.rsplit(":", 1)
     try:

@@ -485,15 +485,16 @@ class AdminMixin:
         if peers:
             import threading
 
+            from minio_tpu.utils.deadline import service_thread
+
             stop = threading.Event()
 
         def subscribe():
             sub = self.trace.subscribe(filter_fn=flt)
             for addr in peers:
-                threading.Thread(
-                    target=self._follow_peer_trace,
-                    args=(addr, sub, stop, errs_only),
-                    daemon=True).start()
+                service_thread(self._follow_peer_trace,
+                               addr, sub, stop, errs_only,
+                               name=f"trace-follow-{addr}")
             return sub
 
         try:

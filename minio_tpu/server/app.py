@@ -339,6 +339,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                        host=(request.remote or "") if request else "")
         if request is not None:
             ev.user_agent = request.headers.get("User-Agent", "")
+        # lint: allow(budget-propagation): fire-and-forget event delivery must outlive the request's budget
         self.executor.submit(self.notifier.notify, ev)
 
     def close(self) -> None:
@@ -464,6 +465,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         admission budget — which bounds queue wait and time-to-first-byte
         work — runs out."""
         loop = asyncio.get_running_loop()
+        # lint: allow(budget-propagation): dropping the budget is this helper's contract (whole-payload phases)
         return await loop.run_in_executor(self.executor,
                                           lambda: fn(*args, **kw))
 
@@ -739,6 +741,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 self.trace.publish(entry)
                 if log.audit_enabled:
                     # queue-store I/O must not run on the event loop
+                    # lint: allow(budget-propagation): audit QueueStore write is post-response, budget-free by design
                     self.executor.submit(log.audit, entry)
 
     # -------------------------------------------------------------- dispatch

@@ -28,6 +28,7 @@ import threading
 import time
 
 from minio_tpu.storage import errors
+from minio_tpu.utils.deadline import service_thread
 from minio_tpu.storage.local import SYSTEM_VOL
 
 DECOM_FILE = "decommission.json"
@@ -120,9 +121,8 @@ class PoolDecommission:
         }
         self._save()
         self.pools.mark_draining(self.idx, True)
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name=f"decom-pool-{self.idx}")
-        self._thread.start()
+        self._thread = service_thread(
+            self._run, name=f"decom-pool-{self.idx}")
 
     def cancel(self) -> None:
         self._stop.set()
@@ -288,9 +288,7 @@ class PoolRebalance:
                       "seq": int(self.state.get("seq", 0))}
         self._save()
         self._stop.clear()
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="pool-rebalance")
-        self._thread.start()
+        self._thread = service_thread(self._run, name="pool-rebalance")
 
     def stop(self) -> None:
         self._stop.set()

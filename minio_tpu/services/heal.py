@@ -15,6 +15,7 @@ import uuid
 from dataclasses import dataclass, field
 
 from minio_tpu.storage import errors
+from minio_tpu.utils.deadline import service_thread
 from minio_tpu.storage.local import SYSTEM_VOL, HEALING_FILE
 
 
@@ -62,9 +63,8 @@ class HealSequence:
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "HealSequence":
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name=f"heal-{self.status.heal_id[:8]}")
-        self._thread.start()
+        self._thread = service_thread(
+            self._run, name=f"heal-{self.status.heal_id[:8]}")
         return self
 
     def run_sync(self) -> HealSequenceStatus:
@@ -167,9 +167,7 @@ class BackgroundHealer:
         # foreground load is shedding (wired by ServiceManager)
         self.throttle = None
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="bg-heal")
-        self._thread.start()
+        self._thread = service_thread(self._run, name="bg-heal")
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval):

@@ -15,6 +15,7 @@ import json
 import threading
 
 from minio_tpu.storage import errors
+from minio_tpu.utils.deadline import service_thread
 from minio_tpu.storage.local import SYSTEM_VOL
 from minio_tpu.utils.logger import log
 
@@ -29,9 +30,7 @@ class DriveMonitor:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         if autostart:
-            self._thread = threading.Thread(target=self._run, daemon=True,
-                                            name="drive-monitor")
-            self._thread.start()
+            self._thread = service_thread(self._run, name="drive-monitor")
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval):

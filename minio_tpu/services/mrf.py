@@ -14,6 +14,8 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from minio_tpu.utils.deadline import service_thread
+
 
 @dataclass
 class MRFStats:
@@ -63,9 +65,7 @@ class MRFQueue:
         # dropped) so drain() wakes immediately instead of busy-polling
         self._idle = threading.Condition(self._mu)
         self._stop = threading.Event()
-        self._worker = threading.Thread(target=self._run, daemon=True,
-                                        name="mrf-heal")
-        self._worker.start()
+        self._worker = service_thread(self._run, name="mrf-heal")
 
     # -- producer ----------------------------------------------------------
     def enqueue(self, bucket: str, obj: str, version_id: str = "",

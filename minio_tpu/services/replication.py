@@ -23,6 +23,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from minio_tpu.utils.deadline import service_thread
 from minio_tpu.utils.s3client import S3Client, S3ClientError
 
 # version-metadata key carrying replication state (surfaced as the
@@ -170,12 +171,9 @@ class ReplicationPool:
         self._q: queue.Queue = queue.Queue()
         self._stop = threading.Event()
         self._threads = [
-            threading.Thread(target=self._work, daemon=True,
-                             name=f"replication-{i}")
+            service_thread(self._work, name=f"replication-{i}")
             for i in range(workers)
         ]
-        for t in self._threads:
-            t.start()
 
     def close(self) -> None:
         self._stop.set()

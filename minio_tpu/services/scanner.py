@@ -18,6 +18,7 @@ import time
 from dataclasses import dataclass, field
 
 from minio_tpu.storage import errors
+from minio_tpu.utils.deadline import service_thread
 from minio_tpu.storage.local import SYSTEM_VOL
 
 USAGE_CACHE_FILE = "data-usage.json"
@@ -152,9 +153,7 @@ class DataScanner:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         if autostart:
-            self._thread = threading.Thread(target=self._run, daemon=True,
-                                            name="data-scanner")
-            self._thread.start()
+            self._thread = service_thread(self._run, name="data-scanner")
 
     # -- loop ---------------------------------------------------------------
     def _run(self) -> None:

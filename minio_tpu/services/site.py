@@ -23,6 +23,7 @@ import threading
 import urllib.parse
 
 from minio_tpu.storage import errors
+from minio_tpu.utils.deadline import service_thread
 from minio_tpu.storage.local import SYSTEM_VOL
 from minio_tpu.utils.logger import log
 
@@ -77,6 +78,9 @@ class SiteReplicationSys:
         self.iam = iam
         self.peers: dict[str, SitePeer] = {}
         self._mu = threading.Lock()
+        self._io_lock = threading.Lock()  # orders _persist disk writes
+        self._save_seq = 0
+        self._persisted_seq = 0
         # one queue + worker PER PEER: a down peer's retries/timeouts
         # must never stall pushes to healthy peers
         self._queues: dict[str, queue.Queue] = {}
@@ -107,14 +111,34 @@ class SiteReplicationSys:
             except (errors.StorageError, ValueError, KeyError):
                 continue
 
-    def _save(self) -> None:
+    def _snapshot_locked(self) -> tuple[bytes, int]:
+        """Serialize the peer table (caller holds self._mu).  The seq
+        number orders concurrent persists so a stale snapshot can never
+        overwrite a newer one once the disk writes happen outside the
+        hot lock."""
+        self._save_seq += 1
         raw = json.dumps({"peers": [p.to_dict()
                                     for p in self.peers.values()]}).encode()
-        for d in self._disks():
-            try:
-                d.write_all(SYSTEM_VOL, SITE_CONFIG_PATH, raw)
-            except errors.StorageError:
-                continue
+        return raw, self._save_seq
+
+    def _persist(self, raw: bytes, seq: int) -> None:
+        """Write a snapshot to the system volume WITHOUT holding
+        self._mu — metadata writes must not block peer-queue feeders."""
+        # lint: allow(blocking-under-lock): dedicated writer-ordering lock; nothing hot contends on it
+        with self._io_lock:
+            if seq <= self._persisted_seq:
+                return  # a newer snapshot already landed
+            ok = 0
+            for d in self._disks():
+                try:
+                    d.write_all(SYSTEM_VOL, SITE_CONFIG_PATH, raw)
+                    ok += 1
+                except errors.StorageError:
+                    continue
+            if ok:
+                # only a snapshot that actually reached a disk may
+                # supersede older pending ones
+                self._persisted_seq = seq
 
     # -- worker --------------------------------------------------------------
     def _ensure_worker(self, peer_name: str) -> None:
@@ -126,9 +150,8 @@ class SiteReplicationSys:
             t = self._workers.get(peer_name)
             if t is not None and t.is_alive():
                 return
-            t = threading.Thread(target=self._run, args=(peer_name, q),
-                                 daemon=True,
-                                 name=f"site-replication-{peer_name}")
+            t = service_thread(self._run, peer_name, q, start=False,
+                               name=f"site-replication-{peer_name}")
             self._workers[peer_name] = t
         t.start()
 
@@ -197,7 +220,8 @@ class SiteReplicationSys:
                 if not p.name or not p.endpoint:
                     raise ValueError("peer name and endpoint required")
                 self.peers[p.name] = p
-            self._save()
+            raw, seq = self._snapshot_locked()
+        self._persist(raw, seq)
         for p in peers:
             self._ensure_worker(p.name)
             self._initial_sync(p.name)
@@ -207,7 +231,8 @@ class SiteReplicationSys:
             if name not in self.peers:
                 raise KeyError(name)
             del self.peers[name]
-            self._save()
+            raw, seq = self._snapshot_locked()
+        self._persist(raw, seq)
 
     def info(self) -> dict:
         with self._mu:

@@ -26,6 +26,7 @@ from minio_tpu.erasure.objects import (
     TRANSITION_TIER_KEY,
 )
 from minio_tpu.storage import errors
+from minio_tpu.utils.deadline import service_thread
 from minio_tpu.storage.local import SYSTEM_VOL
 from minio_tpu.utils.s3client import S3Client, S3ClientError
 
@@ -167,9 +168,7 @@ class TierJournal:
         self._closed = False
         self.retry = retry
         self.deleted = 0
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="tier-journal")
-        self._thread.start()
+        self._thread = service_thread(self._loop, name="tier-journal")
 
     def defer(self, tier: str, key: str) -> None:
         self.store.put({"tier": tier, "key": key})
@@ -225,6 +224,9 @@ class TierManager:
         self.api = api
         self._backends: dict[str, object] = {}
         self._mu = threading.Lock()
+        self._io_lock = threading.Lock()  # orders _persist disk writes
+        self._save_seq = 0
+        self._persisted_seq = 0
         self.transitioned = 0
         self._load()
         if journal_dir is None:
@@ -253,17 +255,33 @@ class TierManager:
                 continue
         self._cfg = {}
 
-    def _save(self) -> None:
-        raw = json.dumps(self._cfg).encode()
-        ok = 0
-        for d in self._disks():
-            try:
-                d.write_all(SYSTEM_VOL, TIERS_PATH, raw)
-                ok += 1
-            except errors.StorageError:
-                continue
-        if ok == 0:
-            raise TierError("cannot persist tier config")
+    def _snapshot_locked(self) -> tuple[bytes, int]:
+        """Serialize the tier table (caller holds self._mu); the seq
+        orders out-of-lock persists so a stale snapshot cannot clobber
+        a newer one."""
+        self._save_seq += 1
+        return json.dumps(self._cfg).encode(), self._save_seq
+
+    def _persist(self, raw: bytes, seq: int) -> None:
+        """Write a config snapshot WITHOUT holding self._mu, so tier
+        lookups on the GET path never queue behind disk writes."""
+        # lint: allow(blocking-under-lock): dedicated writer-ordering lock; the hot _mu is released before this
+        with self._io_lock:
+            if seq <= self._persisted_seq:
+                return
+            ok = 0
+            for d in self._disks():
+                try:
+                    d.write_all(SYSTEM_VOL, TIERS_PATH, raw)
+                    ok += 1
+                except errors.StorageError:
+                    continue
+            if ok == 0:
+                # advance the seq only on success: a failed persist must
+                # not make an older pending snapshot (whose writes might
+                # succeed) look already-superseded
+                raise TierError("cannot persist tier config")
+            self._persisted_seq = seq
 
     def _wire_hooks(self) -> None:
         hook = self._on_deleted if self._cfg else None
@@ -279,10 +297,11 @@ class TierManager:
             if cfg is None:
                 return
             cfg["objects"] = max(0, int(cfg.get("objects", 0)) + delta)
-            try:
-                self._save()
-            except TierError:
-                pass
+            raw, seq = self._snapshot_locked()
+        try:
+            self._persist(raw, seq)
+        except TierError:
+            pass
 
     def add_tier(self, name: str, cfg: dict) -> None:
         name = name.strip()
@@ -297,7 +316,8 @@ class TierManager:
                 cfg["objects"] = int(prev.get("objects", 0))
             self._cfg[name] = cfg
             self._backends.pop(name, None)
-            self._save()
+            raw, seq = self._snapshot_locked()
+        self._persist(raw, seq)
         self._wire_hooks()
 
     def remove_tier(self, name: str, force: bool = False) -> None:
@@ -311,7 +331,8 @@ class TierManager:
                     "object(s); removing it would orphan them")
             del self._cfg[name]
             self._backends.pop(name, None)
-            self._save()
+            raw, seq = self._snapshot_locked()
+        self._persist(raw, seq)
         self._wire_hooks()
 
     def list_tiers(self) -> list[dict]:

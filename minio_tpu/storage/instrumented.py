@@ -42,6 +42,14 @@ TIMED_OPS = (
 )
 
 EWMA_ALPHA = 0.2  # same smoothing idea as the reference's EWMA latency
+# idle decay half-life for the EWMA (seconds): a drive that stops
+# getting samples — e.g. because its slow reads got it hedged out —
+# decays toward healthy so it un-hedges WITHOUT needing a probe read
+# to refresh the average (ROADMAP deadline/overload follow-up).  A
+# hedged-out drive sees no reads, so without decay its last bad EWMA
+# would pin it slow forever.  0 disables decay.
+EWMA_DECAY_HALFLIFE_S = float(
+    os.environ.get("MINIO_TPU_EWMA_DECAY_HALFLIFE_S", "30"))
 
 # consecutive drive-level faults before the breaker opens (reference:
 # diskMaxConcurrent/diskActiveMonitoring heuristics collapse to a small
@@ -130,13 +138,14 @@ def is_drive_fault(e: BaseException) -> bool:
 
 
 class OpStats:
-    __slots__ = ("count", "errors", "total_s", "ewma_s", "mu")
+    __slots__ = ("count", "errors", "total_s", "ewma_s", "last_t", "mu")
 
     def __init__(self):
         self.count = 0
         self.errors = 0
         self.total_s = 0.0
         self.ewma_s = 0.0
+        self.last_t = 0.0  # monotonic time of the last sample
         self.mu = threading.Lock()
 
     def record(self, dt: float, failed: bool) -> None:
@@ -145,16 +154,43 @@ class OpStats:
             if failed:
                 self.errors += 1
             self.total_s += dt
-            self.ewma_s = (dt if self.count == 1
-                           else EWMA_ALPHA * dt
-                           + (1 - EWMA_ALPHA) * self.ewma_s)
+            # blend against the new sample CLAMPED into
+            # [decayed, raw] history: slow evidence re-validates the
+            # old (undecayed) slow average up to its own magnitude —
+            # a chronically slow drive on a cold bucket keeps hedging
+            # even when each fresh sample sits just under the stale
+            # raw average — while a genuinely fast sample tracks the
+            # decayed history, so recovery after an idle gap does not
+            # resurrect stale slowness.  With no idle gap
+            # (decayed == raw) this is exactly the classic EWMA.
+            if self.count == 1:
+                self.ewma_s = dt
+            else:
+                base = max(self._decayed_locked(), min(dt, self.ewma_s))
+                self.ewma_s = EWMA_ALPHA * dt + (1 - EWMA_ALPHA) * base
+            self.last_t = time.monotonic()
+
+    def _decayed_locked(self, now: float | None = None) -> float:
+        """EWMA with idle decay applied (caller holds self.mu): halves
+        every EWMA_DECAY_HALFLIFE_S without a new sample, so a drive
+        that recovered (or stopped being read because hedging steered
+        around it) drifts back toward healthy instead of staying
+        pinned at its last bad average."""
+        if self.count == 0:
+            return 0.0
+        if EWMA_DECAY_HALFLIFE_S <= 0:
+            return self.ewma_s
+        idle = (time.monotonic() if now is None else now) - self.last_t
+        if idle <= 0:
+            return self.ewma_s
+        return self.ewma_s * 0.5 ** (idle / EWMA_DECAY_HALFLIFE_S)
 
     def to_dict(self) -> dict:
         with self.mu:
             return {
                 "count": self.count, "errors": self.errors,
                 "totalSeconds": round(self.total_s, 6),
-                "ewmaMillis": round(self.ewma_s * 1e3, 3),
+                "ewmaMillis": round(self._decayed_locked() * 1e3, 3),
             }
 
 
@@ -298,8 +334,9 @@ class InstrumentedStorage:
         with self._health_mu:
             if self._probe_thread is not None and self._probe_thread.is_alive():
                 return
-            t = threading.Thread(target=self._probe_loop, daemon=True,
-                                 name=f"drive-probe-{id(self):x}")
+            t = deadline_mod.service_thread(
+                self._probe_loop, start=False,
+                name=f"drive-probe-{id(self):x}")
             self._probe_thread = t
         t.start()
 
@@ -363,13 +400,16 @@ class InstrumentedStorage:
             }
 
     def op_ewma(self, op: str) -> float:
-        """EWMA latency (seconds) of one op; 0.0 before any sample.  The
-        read path uses this to hedge around chronically slow drives."""
+        """EWMA latency (seconds) of one op, with idle decay; 0.0
+        before any sample.  The read path uses this to hedge around
+        chronically slow drives — decay is what lets a hedged-out
+        drive (which by construction gets no new read samples)
+        eventually un-hedge without a probe read."""
         s = self._ops.get(op)
         if s is None:
             return 0.0
         with s.mu:
-            return s.ewma_s
+            return s._decayed_locked()
 
     def close(self) -> None:
         self._closed = True

@@ -21,6 +21,8 @@ import time
 import uuid
 from typing import BinaryIO, Iterator
 
+from minio_tpu.utils.deadline import service_thread
+
 from . import errors
 from .api import DiskInfo, StorageAPI, VolInfo
 from .xlmeta import NULL_VERSION_ID, FileInfo, XLMeta, file_info_from_raw
@@ -369,8 +371,8 @@ class LocalStorage(StorageAPI):
         with self._lock:
             if self._reaper is not None and self._reaper.is_alive():
                 return
-            t = threading.Thread(target=self._reap_loop, daemon=True,
-                                 name=f"trash-reaper:{self.root}")
+            t = service_thread(self._reap_loop, start=False,
+                               name=f"trash-reaper:{self.root}")
             self._reaper = t
         t.start()
 

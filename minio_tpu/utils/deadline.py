@@ -132,3 +132,27 @@ def ctx_submit(pool, fn, *args, **kwargs):
     if kwargs:
         return pool.submit(ctx.run, lambda: fn(*args, **kwargs))
     return pool.submit(ctx.run, fn, *args)
+
+
+def service_thread(target, *args, name: str | None = None,
+                   daemon: bool = True, start: bool = True,
+                   **kwargs):
+    """Spawn an explicitly budget-FREE background worker.
+
+    The counterpart of `ctx_submit` for work that must NOT inherit a
+    request's deadline budget: service loops (scanner, heal, MRF,
+    probes), fire-and-forget control-plane fan-outs, cache fills.  The
+    fresh thread context is the point — a background sweep must not die
+    because the request that happened to trigger it ran out of time.
+    Using this helper (instead of a raw `threading.Thread`) is what the
+    `budget-propagation` checker in minio_tpu.analysis audits for:
+    request-path hops go through ctx_submit, everything else declares
+    budget-freedom by coming through here.
+    """
+    import threading
+
+    t = threading.Thread(target=target, args=args,
+                         kwargs=kwargs or None, name=name, daemon=daemon)
+    if start:
+        t.start()
+    return t

@@ -18,6 +18,8 @@ import threading
 import time
 from collections import Counter
 
+from minio_tpu.utils.deadline import service_thread
+
 
 class Sampler:
     """One process-wide sampling profiler (start is idempotent-exclusive:
@@ -26,9 +28,11 @@ class Sampler:
     def __init__(self, interval: float = 0.005):
         self.interval = interval
         self._thread: threading.Thread | None = None
+        # per-run stop event + counter: a new start() after stop() gets
+        # fresh ones, so a still-draining old sampler can neither be
+        # un-stopped by `clear()` nor pollute the new run's counters
         self._stop = threading.Event()
         self._stacks: Counter = Counter()
-        self._samples = 0
         self._started_at = 0.0
         self._lock = threading.Lock()
 
@@ -40,18 +44,18 @@ class Sampler:
         with self._lock:
             if self.running:
                 return False
-            self._stop.clear()
+            self._stop = threading.Event()
             self._stacks = Counter()
-            self._samples = 0
             self._started_at = time.time()
-            self._thread = threading.Thread(
-                target=self._loop, daemon=True, name="admin-profiler")
+            self._thread = service_thread(
+                self._loop, self._stop, self._stacks, start=False,
+                name="admin-profiler")
             self._thread.start()
             return True
 
-    def _loop(self) -> None:
+    def _loop(self, stop: threading.Event, stacks: Counter) -> None:
         me = threading.get_ident()
-        while not self._stop.wait(self.interval):
+        while not stop.wait(self.interval):
             frames = sys._current_frames()
             for tid, frame in frames.items():
                 if tid == me:
@@ -65,23 +69,29 @@ class Sampler:
                                  f":{code.co_name}")
                     f = f.f_back
                     depth += 1
-                self._stacks[";".join(reversed(stack))] += 1
-                self._samples += 1
+                stacks[";".join(reversed(stack))] += 1
 
     def stop(self) -> bytes:
         """Stop and return the collapsed-stack report."""
         with self._lock:
-            if self._thread is None:
+            t = self._thread
+            if t is None:
                 return b""
             self._stop.set()
-            self._thread.join(2)
             self._thread = None
-            dur = time.time() - self._started_at
-            head = (f"# minio-tpu cpu profile: {self._samples} samples, "
-                    f"{dur:.1f}s, interval {self.interval * 1000:.1f}ms\n")
-            body = "".join(
-                f"{stack} {n}\n"
-                for stack, n in self._stacks.most_common()
-            )
-            return (head + body).encode()
-
+            stacks = self._stacks
+            started_at = self._started_at
+        # join OUTSIDE the lock: the sampler wakes within one interval,
+        # but a lock holder must never wait on another thread's exit
+        # (blocking-under-lock; a concurrent start() spins up its own
+        # run with fresh state, so there is nothing to race on)
+        t.join(2)
+        samples = sum(stacks.values())
+        dur = time.time() - started_at
+        head = (f"# minio-tpu cpu profile: {samples} samples, "
+                f"{dur:.1f}s, interval {self.interval * 1000:.1f}ms\n")
+        body = "".join(
+            f"{stack} {n}\n"
+            for stack, n in stacks.most_common()
+        )
+        return (head + body).encode()

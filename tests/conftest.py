@@ -60,6 +60,59 @@ def _rebuild_native_lib() -> None:
 _rebuild_native_lib()
 
 
+def _wire_sanitized_lib() -> None:
+    """MINIO_TPU_SAN=asan|ubsan|tsan: build the sanitizer variant of the
+    host library (csrc/Makefile `make <san>`) and point the loaders at
+    it via MINIO_TPU_NATIVE_LIB — must run before any minio_tpu module
+    is imported (the loaders read the env var at import time).
+
+    Loading a sanitized .so into a vanilla python needs the matching
+    runtime LD_PRELOADed BEFORE process start, e.g.:
+
+        LD_PRELOAD=$(g++ -print-file-name=libasan.so) \
+            ASAN_OPTIONS=detect_leaks=0 MINIO_TPU_SAN=asan pytest ...
+
+    Without the preload the CDLL load fails and the Python fallbacks
+    silently take over — so we warn loudly rather than guess."""
+    import shutil
+    import subprocess
+    import sys
+
+    san = os.environ.get("MINIO_TPU_SAN", "").strip().lower()
+    if not san:
+        return
+    if san not in ("asan", "ubsan", "tsan"):
+        print(f"conftest: ignoring unknown MINIO_TPU_SAN={san!r}",
+              file=sys.stderr)
+        return
+    csrc = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "csrc")
+    lib = os.path.join(csrc, f"libminio_tpu_host_{san}.so")
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        print(f"conftest: MINIO_TPU_SAN={san} set but no toolchain; "
+              "native tiers will use the Python fallbacks",
+              file=sys.stderr)
+        return
+    try:
+        subprocess.run(["make", "-C", csrc, san], check=True,
+                       capture_output=True, timeout=600)
+    except Exception as e:
+        print(f"conftest: sanitizer build failed ({e}); native tiers "
+              "will use the Python fallbacks", file=sys.stderr)
+        return
+    os.environ["MINIO_TPU_NATIVE_LIB"] = lib
+    runtime = {"asan": "libasan", "ubsan": "libubsan",
+               "tsan": "libtsan"}[san]
+    if runtime not in os.environ.get("LD_PRELOAD", ""):
+        print(f"conftest: MINIO_TPU_SAN={san} but {runtime} is not in "
+              "LD_PRELOAD — the sanitized library will fail to load "
+              f"(run: LD_PRELOAD=$(g++ -print-file-name={runtime}.so) "
+              "pytest ...)", file=sys.stderr)
+
+
+_wire_sanitized_lib()
+
+
 # --------------------------------------------------------------- watchdog
 # Per-test watchdog: a deadlocked admission queue (or any other hang)
 # fails ONE test fast with a traceback instead of eating the whole
@@ -78,6 +131,13 @@ _WATCHDOG_SECONDS = float(os.environ.get("MINIO_TPU_TEST_TIMEOUT", "300"))
 
 class _WatchdogTimeout(Exception):
     pass
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from tier-1 (`-m 'not slow'`); sanitizer "
+        "replays, chaos drills, long benches")
 
 
 @pytest.hookimpl(hookwrapper=True)

@@ -1,0 +1,190 @@
+"""Replay driver for the sanitizer-instrumented native kernels.
+
+Run inside a subprocess whose environment loads a sanitized build of
+libminio_tpu_host (tests/test_sanitizers.py sets MINIO_TPU_NATIVE_LIB
+to the `make asan`/`make ubsan`/`make tsan` artifact and LD_PRELOADs
+the matching runtime).  NOT collected by pytest (no test_ functions) —
+it is the workload, the assertions live in the parent test.
+
+Modes:
+  select    — replay the 512-case Select differential corpus
+              (tests/select_corpus.py) through the native tier and
+              compare byte-for-byte with the pure-Python row engine
+  golden    — GF(2^8) encode/reconstruct golden vectors
+              (cmd/erasure-coding.go self-test table) through the C
+              matmul, plus the HighwayHash-256 reference self-test
+  scanpool  — hammer the fused multi-threaded Select kernels (ScanPool
+              in csrc/select_scan.cpp) from several Python threads at
+              once: cross-thread block handoff under TSan
+
+Exit codes: 0 ok, 1 divergence/failure, 3 native library unavailable
+(parent skips).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _recs(stream: bytes):
+    from tests.select_corpus import canonical_records
+
+    return canonical_records(stream)
+
+
+def _run_select(expr, data, inp, out, tier):
+    from minio_tpu import select as sel
+
+    env = {}
+    if tier == "row":
+        env = {"MINIO_TPU_SELECT_COLUMNAR": "0",
+               "MINIO_TPU_SELECT_BATCH": "0"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        req = sel.SelectRequest(expr, inp, out)
+        return b"".join(sel.run_select(req, io.BytesIO(data), len(data)))
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _require_native() -> None:
+    from minio_tpu.select import native
+
+    if native._load() is None:
+        print("san_replay: native library failed to load "
+              f"({native._LIBPATH}); nothing to sanitize", file=sys.stderr)
+        sys.exit(3)
+
+
+def mode_select() -> None:
+    from tests import select_corpus
+
+    _require_native()
+    n = bad = 0
+    for family, seed, expr, data, inp, out in select_corpus.corpus():
+        n += 1
+        fast = _recs(_run_select(expr, data, inp, out, tier="native"))
+        slow = _recs(_run_select(expr, data, inp, out, tier="row"))
+        if fast != slow:
+            bad += 1
+            print(f"DIVERGENCE {family}/{seed}: {expr!r}",
+                  file=sys.stderr)
+    print(f"san_replay select: {n} cases, {bad} divergences")
+    sys.exit(1 if bad else 0)
+
+
+def mode_golden() -> None:
+    import numpy as np
+    import xxhash
+
+    from minio_tpu.ops import gf256, host
+    from tests.test_rs_golden import GOLDEN, TEST_DATA
+
+    if not host.available():
+        print("san_replay: host library unavailable", file=sys.stderr)
+        sys.exit(3)
+    failures = 0
+    for (k, m), want in sorted(GOLDEN.items()):
+        # shard like encode_data_np, but run the C matmul for parity
+        data_shards = np.stack(gf256.encode_data_np(TEST_DATA, k, m)[:k])
+        codec = host.HostRSCodec(k, m)
+        parity = codec.encode(data_shards)
+        h = xxhash.xxh64()
+        for i, s in enumerate(list(data_shards) + list(parity)):
+            h.update(bytes([i]))
+            h.update(np.asarray(s, dtype=np.uint8).tobytes())
+        if h.intdigest() != want:
+            failures += 1
+            print(f"RS golden mismatch for {k}+{m}", file=sys.stderr)
+        # reconstruct shard 0 from the rest through the C matmul
+        rebuilt = codec.reconstruct(
+            np.stack(list(data_shards[1:]) + list(parity[:1])),
+            list(range(1, k + 1)), [0])
+        if not np.array_equal(rebuilt[0], data_shards[0]):
+            failures += 1
+            print(f"RS reconstruct mismatch for {k}+{m}", file=sys.stderr)
+
+    # HighwayHash-256 reference self-test (cmd/bitrot.go:214)
+    hh = host.HH256()
+    msg, sum_ = b"", b""
+    for _ in range(32):
+        hh.reset()
+        hh.update(msg)
+        sum_ = hh.digest()
+        msg += sum_
+    want_hex = ("39c0407ed3f01b18d22c85db4aeff11e"
+                "060ca5f43131b0126731ca197cd42313")
+    if sum_.hex() != want_hex:
+        failures += 1
+        print("HighwayHash-256 self-test mismatch", file=sys.stderr)
+    # batch entry point (hh256_batch walks a strided matrix)
+    blocks = np.frombuffer(
+        bytes(range(256)) * 32, dtype=np.uint8).reshape(16, 512)
+    got = host.hh256_batch(blocks)
+    for i in range(16):
+        if bytes(got[i]) != host.hh256(blocks[i].tobytes()):
+            failures += 1
+            print(f"hh256_batch row {i} mismatch", file=sys.stderr)
+            break
+    print(f"san_replay golden: {len(GOLDEN)} EC configs, "
+          f"{failures} failures")
+    sys.exit(1 if failures else 0)
+
+
+def mode_scanpool() -> None:
+    import threading
+
+    _require_native()
+    os.environ["MINIO_TPU_SELECT_THREADS"] = "4"
+    # >= 1 MiB blocks engage the ScanPool's newline-split fan-out
+    rows = "".join(f"r{i},{i % 997},{i % 97}\n" for i in range(120_000))
+    data = ("a,b,c\n" + rows).encode()
+    assert len(data) > (1 << 20)
+    exprs = [
+        "SELECT COUNT(*) FROM s3object WHERE b > 500",
+        "SELECT COUNT(*), MIN(b), MAX(c) FROM s3object",
+        "SELECT COUNT(*) FROM s3object WHERE a LIKE 'r1%'",
+        "SELECT COUNT(*) FROM s3object WHERE b BETWEEN 10 AND 900",
+    ]
+    results: dict[int, object] = {}
+
+    def worker(idx: int) -> None:
+        try:
+            for rep in range(3):
+                expr = exprs[(idx + rep) % len(exprs)]
+                out = _run_select(expr, data, {"CSV": {}}, {"CSV": {}},
+                                  tier="native")
+                results.setdefault(idx, []).append(len(out))
+        except Exception as e:  # pragma: no cover - surfaced via exit code
+            results[idx] = e
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+    errs = [v for v in results.values() if isinstance(v, Exception)]
+    if errs or len(results) != 6:
+        print(f"san_replay scanpool: failures {errs}", file=sys.stderr)
+        sys.exit(1)
+    print("san_replay scanpool: 6 threads x 3 scans ok")
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    mode = sys.argv[1] if len(sys.argv) > 1 else "select"
+    {"select": mode_select,
+     "golden": mode_golden,
+     "scanpool": mode_scanpool}[mode]()

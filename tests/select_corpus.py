@@ -1,0 +1,174 @@
+"""Shared Select differential fuzz corpus.
+
+One generator, two consumers: tests/test_select_native.py pins fixed
+seed subsets in tier-1 (fast-tier vs row-engine byte equality), and
+tests/san_replay.py replays the full 512-case corpus through the
+sanitizer-instrumented kernels (ASan/UBSan builds from csrc/Makefile).
+Keeping the generators here means the corpora cannot drift apart.
+
+Four families x 128 seeds = 512 cases:
+  csv          — clean/garbage/unicode/ragged CSV cells
+  json         — typed JSON lines (nulls, bools, bigints, nesting)
+  csv_quoted   — doubled quotes, embedded delimiters/newlines, quoted/
+                 unquoted block transitions (fused-kernel handoff)
+  json_escape  — escape-heavy strings, nested docs, blank lines
+"""
+
+from __future__ import annotations
+
+import json
+import random
+
+CSV_SEEDS = range(0, 128)
+JSON_SEEDS = range(10_000, 10_128)
+CSV_QUOTED_SEEDS = range(20_000, 20_128)
+JSON_ESCAPE_SEEDS = range(30_000, 30_128)
+
+_CELLS = ["", "0", "5", "500", "-3", "3.14", " 5", "5_0", "inf",
+          "abc", "café", "HELLO", "  pad  ", "1e3", ".5", "+7",
+          "99999999999999999999", 'q"t', "a,b", "x\ry", "e" * 50]
+_OPS = ["=", "!=", "<", "<=", ">", ">="]
+_FNS = ["", "UPPER", "LOWER", "TRIM", "CHAR_LENGTH"]
+
+_QCELLS = ["", "5", "500", 'he said ""hi""', "a,b", "line\nbreak",
+           "tail\rcr", "plain", '"', "600", "x" * 40, "-7", "0.25",
+           "café", " sp ", "99999999999999999999"]
+
+
+def gen_csv(rng: random.Random, rows: int) -> bytes:
+    lines = ["a,b,c"]
+    for _ in range(rows):
+        vals = []
+        for _ in range(rng.choice([3, 3, 3, 2, 4])):
+            v = rng.choice(_CELLS)
+            if any(ch in v for ch in ',"\r\n'):
+                v = '"' + v.replace('"', '""') + '"'
+            vals.append(v)
+        lines.append(",".join(vals))
+    return ("\n".join(lines) + "\n").encode()
+
+
+def gen_query(rng: random.Random) -> str:
+    col = rng.choice(["a", "b", "c"])
+    kind = rng.randrange(8)
+    if kind == 0:
+        lit = rng.choice(["5", "'abc'", "'HELLO'", "3.14", "0"])
+        fn = rng.choice(_FNS)
+        lhs = f"{fn}({col})" if fn else col
+        return (f"SELECT COUNT(*) FROM s3object WHERE {lhs} "
+                f"{rng.choice(_OPS)} {lit}")
+    if kind == 1:
+        pat = rng.choice(["%5%", "a_c", "%é", "H%", "%"])
+        return (f"SELECT COUNT(*) FROM s3object WHERE {col} "
+                f"LIKE '{pat}'")
+    if kind == 2:
+        return (f"SELECT COUNT(*) FROM s3object WHERE {col} "
+                "IN ('5', 'abc', '3.14')")
+    if kind == 3:
+        return (f"SELECT COUNT(*) FROM s3object WHERE {col} "
+                "BETWEEN 0 AND 100")
+    if kind == 4:
+        neg = "NOT " if rng.random() < .5 else ""
+        return (f"SELECT COUNT(*) FROM s3object WHERE {col} "
+                f"IS {neg}NULL")
+    if kind == 5:
+        return (f"SELECT COUNT(b), MIN({col}), MAX({col}) "
+                "FROM s3object")
+    if kind == 6:
+        return (f"SELECT a, c FROM s3object WHERE b "
+                f"{rng.choice(_OPS)} 10 "
+                f"LIMIT {rng.randrange(1, 8)}")
+    return (f"SELECT COUNT(*) FROM s3object WHERE {col} * 2 + 1 "
+            f"{rng.choice(_OPS)} 11")
+
+
+# Each case: (expr, data, input_serialization, output_serialization).
+_CSV_IO = ({"CSV": {}}, {"CSV": {}})
+_JSON_IO = ({"JSON": {"Type": "LINES"}}, {"JSON": {}})
+
+
+def csv_case(seed: int):
+    rng = random.Random(seed)
+    data = gen_csv(rng, rng.randrange(1, 40))
+    expr = gen_query(rng)
+    return (expr, data) + _CSV_IO
+
+
+def json_case(seed: int):
+    rng = random.Random(seed)
+    vals = [None, 0, 5, -3, 3.14, True, False, "abc", "", "HELLO",
+            "café", "5", " pad ", 10**20, {"n": 1}, [1, 2], 'q"t']
+    lines = []
+    for _ in range(rng.randrange(1, 30)):
+        doc = {k: rng.choice(vals) for k in ("a", "b", "c")
+               if rng.random() < 0.85}
+        lines.append(json.dumps(doc))
+    data = ("\n".join(lines) + "\n").encode()
+    expr = gen_query(rng)
+    return (expr, data) + _JSON_IO
+
+
+def csv_quoted_case(seed: int):
+    rng = random.Random(seed)
+    lines = ["a,b,c"]
+    for _ in range(rng.randrange(1, 40)):
+        vals = []
+        for _ in range(rng.choice([3, 3, 3, 2, 4])):
+            v = rng.choice(_QCELLS)
+            if any(ch in v for ch in ',"\r\n') or \
+                    rng.random() < 0.25:
+                v = '"' + v.replace('"', '""') + '"'
+            vals.append(v)
+        lines.append(",".join(vals))
+    data = ("\n".join(lines) + "\n").encode()
+    expr = gen_query(rng)
+    return (expr, data) + _CSV_IO
+
+
+def json_escape_case(seed: int):
+    rng = random.Random(seed)
+    vals = ['x\\"y', "tab\there", "nl\nnewline", "b\\slash",
+            "unié", "ctl", "plain", "", 5, -3.5, None,
+            True, {"deep": {"deeper": [1, "two"]}}, [1, [2, [3]]],
+            10**19, "5", 0.125]
+    lines = []
+    for _ in range(rng.randrange(1, 30)):
+        doc = {k: rng.choice(vals) for k in ("a", "b", "c")
+               if rng.random() < 0.9}
+        lines.append(json.dumps(doc))
+        if rng.random() < 0.1:
+            lines.append("")  # blank lines are skipped
+    data = ("\n".join(lines) + "\n").encode()
+    expr = gen_query(rng)
+    return (expr, data) + _JSON_IO
+
+
+def corpus():
+    """Yield (family, seed, expr, data, inp, out) for all 512 cases."""
+    for family, seeds, gen in (
+            ("csv", CSV_SEEDS, csv_case),
+            ("json", JSON_SEEDS, json_case),
+            ("csv_quoted", CSV_QUOTED_SEEDS, csv_quoted_case),
+            ("json_escape", JSON_ESCAPE_SEEDS, json_escape_case)):
+        for seed in seeds:
+            expr, data, inp, out = gen(seed)
+            yield family, seed, expr, data, inp, out
+
+
+def canonical_records(stream: bytes):
+    """Canonicalize a Select event-stream response for differential
+    comparison: concatenated Records payloads + '#' + error codes.
+    Shared by the tier-1 fuzz tests and the sanitizer replay so both
+    compare the same bytes."""
+    from minio_tpu.select import eventstream as es
+
+    try:
+        evs = es.decode_all(stream)
+    except ValueError:
+        return stream
+    out = b"".join(e["payload"] for e in evs
+                   if e["headers"].get(":event-type") == "Records")
+    err = b"|".join((e["headers"].get(":error-code") or "").encode()
+                    for e in evs
+                    if e["headers"].get(":message-type") == "error")
+    return out + b"#" + err

@@ -14,6 +14,8 @@ import time
 
 import pytest
 
+from minio_tpu.crypto._aead import HAVE_AESGCM
+
 from .s3_harness import S3TestServer
 
 ADMIN = "/minio/admin/v3"
@@ -310,6 +312,9 @@ class TestBulkDeleteBatch:
 class TestKMSAdmin:
     """KMS admin plane (reference cmd/kms-handlers.go)."""
 
+    @pytest.mark.skipif(
+        not HAVE_AESGCM,
+        reason="optional 'cryptography' wheel not installed")
     def test_status_and_key_roundtrip(self, tmp_path):
         from tests.s3_harness import S3TestServer
 

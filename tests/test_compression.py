@@ -11,6 +11,8 @@ import os
 
 import pytest
 
+from minio_tpu.crypto._aead import HAVE_AESGCM
+
 from minio_tpu.utils import compress
 from tests.s3_harness import S3TestServer
 
@@ -118,6 +120,9 @@ class TestCompressionE2E:
         assert g.headers["ETag"].strip('"') == \
             hashlib.md5(DATA).hexdigest()
 
+    @pytest.mark.skipif(
+        not HAVE_AESGCM,
+        reason="optional 'cryptography' wheel not installed")
     def test_sse_takes_precedence(self, srv):
         r = srv.request(
             "PUT", "/czbkt/enc.txt", data=DATA[:4096],
@@ -179,6 +184,9 @@ class TestCompressionE2E:
 
 
 class TestCompressedSSECopy:
+    @pytest.mark.skipif(
+        not HAVE_AESGCM,
+        reason="optional 'cryptography' wheel not installed")
     def test_sse_copy_of_compressed_source(self, srv):
         """Copying a compressed object into an SSE destination must
         normalize to original bytes (review regression: encrypted frames

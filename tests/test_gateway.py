@@ -14,6 +14,8 @@ import urllib.parse
 
 import pytest
 
+from minio_tpu.crypto._aead import HAVE_AESGCM
+
 from minio_tpu.gateway import S3Gateway
 from minio_tpu.server import sigv4
 from minio_tpu.server.app import make_app
@@ -350,6 +352,9 @@ class TestGatewayTransforms:
     round-trip via namespaced remote headers (review regression: it was
     dropped, serving ciphertext/frames as plaintext)."""
 
+    @pytest.mark.skipif(
+        not HAVE_AESGCM,
+        reason="optional 'cryptography' wheel not installed")
     def test_sse_through_gateway(self, gw):
         g, backend = gw
         g.request("PUT", "/gwsse")

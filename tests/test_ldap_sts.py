@@ -207,6 +207,8 @@ def tls_material(tmp_path_factory):
     import datetime
     import ipaddress
 
+    pytest.importorskip(
+        "cryptography", reason="optional 'cryptography' wheel not installed")
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import rsa

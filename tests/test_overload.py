@@ -593,6 +593,7 @@ class TestHedgeLazySteal:
         for d in disks[:4]:
             st = d._ops["read_file_stream"]
             st.count, st.ewma_s = 1, 0.5
+            st.last_t = time.monotonic()  # fresh sample: no idle decay
         # corrupt one FAST drive's shard bytes on disk
         fast_roots = [d.unwrap().unwrap().root for d in disks[4:]]
         part = sorted(glob.glob(os.path.join(
@@ -604,3 +605,105 @@ class TestHedgeLazySteal:
             _, stream = pools.get_object("b", "o")
             out = b"".join(stream)
         assert out == data, "read did not recover via the lazy spare"
+
+
+class TestEwmaDecay:
+    """ROADMAP follow-up: a recovered drive's read EWMA decays toward
+    baseline while it gets no samples, so a hedged-out drive un-hedges
+    without needing a probe read to refresh the average."""
+
+    def _stats(self, ewma: float, age_s: float):
+        from minio_tpu.storage.instrumented import OpStats
+
+        st = OpStats()
+        st.count = 1
+        st.ewma_s = ewma
+        st.last_t = time.monotonic() - age_s
+        return st
+
+    def test_fresh_sample_not_decayed(self):
+        st = self._stats(0.5, age_s=0.0)
+        with st.mu:
+            assert st._decayed_locked() == pytest.approx(0.5, rel=1e-3)
+
+    def test_halflife_halves(self, monkeypatch):
+        from minio_tpu.storage import instrumented as ins
+
+        monkeypatch.setattr(ins, "EWMA_DECAY_HALFLIFE_S", 10.0)
+        st = self._stats(0.4, age_s=10.0)
+        with st.mu:
+            assert st._decayed_locked() == pytest.approx(0.2, rel=1e-2)
+        st = self._stats(0.4, age_s=30.0)
+        with st.mu:
+            assert st._decayed_locked() == pytest.approx(0.05, rel=1e-2)
+
+    def test_decay_disabled(self, monkeypatch):
+        from minio_tpu.storage import instrumented as ins
+
+        monkeypatch.setattr(ins, "EWMA_DECAY_HALFLIFE_S", 0.0)
+        st = self._stats(0.5, age_s=3600.0)
+        with st.mu:
+            assert st._decayed_locked() == pytest.approx(0.5)
+
+    def test_fast_sample_tracks_down_after_idle(self):
+        # after ~an hour idle the 0.5 s history has decayed to ~0; a
+        # genuinely FAST 5 ms sample yields ewma ~= dt (the stale slow
+        # average is not resurrected)
+        st = self._stats(0.5, age_s=3600.0)
+        st.record(0.005, failed=False)
+        with st.mu:
+            v = st._decayed_locked()
+        assert v == pytest.approx(0.005, rel=1e-2)
+
+    def test_still_slow_sample_revalidates_history(self):
+        """Review scenario: a hedged-out drive idle 10 min serves a
+        fresh 0.45 s read — slightly under its stale raw 0.5 s average
+        but still 4.5x the hedge threshold.  The sample re-validates
+        the slow history up to its own magnitude: the drive must NOT
+        instantly classify as healthy."""
+        from minio_tpu.erasure import objects as eobj
+
+        st = self._stats(0.5, age_s=600.0)
+        st.record(0.45, failed=False)
+        with st.mu:
+            assert st.ewma_s == pytest.approx(0.45, rel=1e-2)
+            assert st.ewma_s > eobj.HEDGE_EWMA_S
+
+    def test_sparse_slow_drive_keeps_hedging(self):
+        """A chronically slow drive on a cold bucket (one 0.5 s read
+        every few minutes, idle >> half-life) must NOT have its
+        evidence decay-capped at alpha*dt — slow samples blend against
+        the raw history, so the EWMA stays above the hedge threshold
+        at sample time."""
+        from minio_tpu.erasure import objects as eobj
+
+        st = self._stats(0.5, age_s=0.0)
+        for _ in range(5):
+            st.last_t = time.monotonic() - 180.0  # long idle gap
+            st.record(0.5, failed=False)          # still slow
+            with st.mu:
+                assert st.ewma_s > eobj.HEDGE_EWMA_S
+        with st.mu:
+            assert st.ewma_s == pytest.approx(0.5, rel=1e-6)
+
+    def test_slow_drive_unhedges_via_decay(self, monkeypatch):
+        """An InstrumentedStorage whose read EWMA was pinned slow drops
+        under the hedge threshold purely by idle time — no probe read,
+        no new sample."""
+        from minio_tpu.erasure import objects as eobj
+        from minio_tpu.storage import instrumented as ins
+
+        monkeypatch.setattr(ins, "EWMA_DECAY_HALFLIFE_S", 5.0)
+
+        class _Null:
+            def close(self):
+                pass
+
+        d = ins.InstrumentedStorage(_Null(), breaker_threshold=1000)
+        st = d._ops["read_file_stream"]
+        st.count, st.ewma_s = 1, 0.5
+        st.last_t = time.monotonic()
+        assert d.op_ewma("read_file_stream") > eobj.HEDGE_EWMA_S
+        # simulate 60s of silence (12 half-lives): 0.5s -> ~0.12ms
+        st.last_t = time.monotonic() - 60.0
+        assert d.op_ewma("read_file_stream") < eobj.HEDGE_EWMA_S

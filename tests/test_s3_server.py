@@ -7,6 +7,8 @@ import xml.etree.ElementTree as ET
 
 import pytest
 
+from minio_tpu.crypto._aead import HAVE_AESGCM
+
 from minio_tpu.server import sigv4
 from .s3_harness import S3TestServer
 
@@ -489,6 +491,9 @@ class TestConformanceHardening:
         r = srv.request("HEAD", "/tgdbkt/c3")
         assert "x-amz-tagging-count" not in r.headers
 
+    @pytest.mark.skipif(
+        not HAVE_AESGCM,
+        reason="optional 'cryptography' wheel not installed")
     def test_ssec_copy_source(self, srv):
         """Copy of an SSE-C source requires (and honors) the
         x-amz-copy-source-sse-c key triple."""

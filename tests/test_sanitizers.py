@@ -1,0 +1,141 @@
+"""Sanitizer-hardened native kernels (slow; skipped without a toolchain).
+
+Builds the asan/ubsan/tsan variants of libminio_tpu_host
+(csrc/Makefile) and replays real workloads through them in a
+subprocess with the sanitizer runtime LD_PRELOADed:
+
+- ASan + UBSan: the 512-case Select differential corpus
+  (tests/select_corpus.py) and the GF(2^8)/HighwayHash golden vectors
+- TSan: concurrent fused Select scans exercising the detached-thread
+  ScanPool (csrc/select_scan.cpp)
+
+The interpreter itself is NOT instrumented, so ASan leak checking is
+off (CPython "leaks" by design at exit) and TSan races are only
+attributed when a report names our library/source — CPython's own
+uninstrumented atomics can otherwise produce noise we don't own.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CSRC = os.path.join(REPO, "csrc")
+REPLAY = os.path.join(REPO, "tests", "san_replay.py")
+
+pytestmark = pytest.mark.slow
+
+_RUNTIME = {"asan": "libasan.so", "ubsan": "libubsan.so",
+            "tsan": "libtsan.so"}
+
+
+def _toolchain() -> str | None:
+    if shutil.which("make") is None:
+        return "make not installed"
+    if shutil.which("g++") is None:
+        return "g++ not installed"
+    return None
+
+
+def _runtime_path(san: str) -> str | None:
+    try:
+        out = subprocess.run(
+            ["g++", f"-print-file-name={_RUNTIME[san]}"],
+            capture_output=True, text=True, timeout=30).stdout.strip()
+    except Exception:
+        return None
+    # an unresolved -print-file-name echoes the bare name back
+    return out if out and os.path.sep in out and os.path.exists(out) \
+        else None
+
+
+def _build(san: str) -> str:
+    """make <san>; returns the .so path (pytest-skips on any gap)."""
+    missing = _toolchain()
+    if missing:
+        pytest.skip(f"sanitizer build unavailable: {missing}")
+    if _runtime_path(san) is None:
+        pytest.skip(f"{_RUNTIME[san]} runtime not found")
+    proc = subprocess.run(["make", "-C", CSRC, san],
+                          capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        pytest.skip(f"make {san} failed: {proc.stderr[-500:]}")
+    return os.path.join(CSRC, f"libminio_tpu_host_{san}.so")
+
+
+def _replay(san: str, mode: str, extra_env: dict | None = None):
+    lib = _build(san)
+    env = dict(os.environ)
+    env.update({
+        "MINIO_TPU_NATIVE_LIB": lib,
+        "LD_PRELOAD": _runtime_path(san),
+        "JAX_PLATFORMS": "cpu",
+        # leak checking covers the uninstrumented interpreter too —
+        # off; abort early so reports land in stderr before exit
+        "ASAN_OPTIONS": "detect_leaks=0:abort_on_error=0:exitcode=97",
+        "UBSAN_OPTIONS": "halt_on_error=1:print_stacktrace=1",
+        # exitcode=0: we attribute reports ourselves (see module doc)
+        "TSAN_OPTIONS": "exitcode=0:halt_on_error=0",
+    })
+    env.update(extra_env or {})
+    proc = subprocess.run(
+        [sys.executable, REPLAY, mode], capture_output=True, text=True,
+        timeout=1800, env=env, cwd=REPO)
+    if proc.returncode == 3:
+        pytest.skip(f"sanitized library did not load:\n{proc.stderr[-800:]}")
+    return proc
+
+
+def _assert_clean(proc, markers: tuple[str, ...]) -> None:
+    text = proc.stdout + proc.stderr
+    hits = [ln for ln in text.splitlines()
+            if any(m in ln for m in markers)]
+    assert proc.returncode == 0, (
+        f"replay failed rc={proc.returncode}\n{text[-3000:]}")
+    assert not hits, f"sanitizer reported:\n" + "\n".join(hits[:20]) + \
+        "\n" + text[-3000:]
+
+
+class TestASan:
+    def test_select_corpus_clean_under_asan(self):
+        proc = _replay("asan", "select")
+        _assert_clean(proc, ("ERROR: AddressSanitizer",
+                             "SUMMARY: AddressSanitizer"))
+
+    def test_golden_vectors_clean_under_asan(self):
+        proc = _replay("asan", "golden")
+        _assert_clean(proc, ("ERROR: AddressSanitizer",
+                             "SUMMARY: AddressSanitizer"))
+
+
+class TestUBSan:
+    def test_select_corpus_clean_under_ubsan(self):
+        proc = _replay("ubsan", "select")
+        _assert_clean(proc, ("runtime error:",
+                             "SUMMARY: UndefinedBehaviorSanitizer"))
+
+    def test_golden_vectors_clean_under_ubsan(self):
+        proc = _replay("ubsan", "golden")
+        _assert_clean(proc, ("runtime error:",
+                             "SUMMARY: UndefinedBehaviorSanitizer"))
+
+
+class TestTSan:
+    def test_scanpool_concurrency_under_tsan(self):
+        proc = _replay("tsan", "scanpool")
+        text = proc.stdout + proc.stderr
+        assert proc.returncode == 0, (
+            f"replay failed rc={proc.returncode}\n{text[-3000:]}")
+        # attribute reports: a race is ours only if the report block
+        # names our source/library (uninstrumented CPython frames can
+        # trigger unrelated noise)
+        blocks = text.split("WARNING: ThreadSanitizer")
+        ours = [b for b in blocks[1:]
+                if "select_scan" in b or "minio_tpu_host" in b]
+        assert not ours, ("TSan race in the scan kernels:\n"
+                          + ours[0][:3000])

@@ -17,6 +17,8 @@ import os
 
 import pytest
 
+from minio_tpu.crypto._aead import HAVE_AESGCM
+
 from minio_tpu.iam import IAMSys
 from minio_tpu.erasure.sets import ErasureSets, ErasureServerPools
 from minio_tpu.storage.local import LocalStorage
@@ -251,6 +253,9 @@ class TestBulkDeleteCombinedDecision:
 class TestKMSFromEnv:
     SSE_HDR = "x-amz-server-side-encryption"
 
+    @pytest.mark.skipif(
+        not HAVE_AESGCM,
+        reason="optional 'cryptography' wheel not installed")
     def test_sse_s3_roundtrip_with_env_key(self, tmp_path):
         srv = S3TestServer(str(tmp_path))  # harness sets the env key
         try:

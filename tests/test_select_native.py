@@ -4,7 +4,6 @@ AND garbage data (the ambiguity-replay contract of csrc/select_scan.cpp
 """
 
 import io
-import json
 import os
 import random
 
@@ -13,6 +12,8 @@ import pytest
 from minio_tpu import select as sel
 from minio_tpu.select import eventstream as es
 from minio_tpu.select import native
+
+from . import select_corpus
 
 
 def _run(expr, data: bytes, inp=None, out=None, tier="native"):
@@ -620,70 +621,14 @@ class TestDifferentialFuzz:
     ragged/typed-JSON) x random query grammar, every accelerated tier
     (native dispatch AND the compiled row tier) vs the pure-interpreter
     reference.  1000-seed sweeps ran clean during development; these
-    fixed seeds pin the property in CI."""
+    fixed seeds pin the property in CI.
 
-    _CELLS = ["", "0", "5", "500", "-3", "3.14", " 5", "5_0", "inf",
-              "abc", "café", "HELLO", "  pad  ", "1e3", ".5", "+7",
-              "99999999999999999999", 'q"t', "a,b", "x\ry", "e" * 50]
-    _OPS = ["=", "!=", "<", "<=", ">", ">="]
-    _FNS = ["", "UPPER", "LOWER", "TRIM", "CHAR_LENGTH"]
+    The generators live in tests/select_corpus.py, shared with the
+    sanitizer replay harness (tests/san_replay.py) so the ASan/UBSan
+    runs exercise exactly this corpus."""
 
     def _recs(self, stream):
-        try:
-            evs = es.decode_all(stream)
-        except ValueError:
-            return stream
-        out = b"".join(e["payload"] for e in evs
-                       if e["headers"].get(":event-type") == "Records")
-        err = b"|".join((e["headers"].get(":error-code") or "").encode()
-                        for e in evs
-                        if e["headers"].get(":message-type") == "error")
-        return out + b"#" + err
-
-    def _gen_csv(self, rng, rows):
-        lines = ["a,b,c"]
-        for _ in range(rows):
-            vals = []
-            for _ in range(rng.choice([3, 3, 3, 2, 4])):
-                v = rng.choice(self._CELLS)
-                if any(ch in v for ch in ',"\r\n'):
-                    v = '"' + v.replace('"', '""') + '"'
-                vals.append(v)
-            lines.append(",".join(vals))
-        return ("\n".join(lines) + "\n").encode()
-
-    def _gen_query(self, rng):
-        col = rng.choice(["a", "b", "c"])
-        kind = rng.randrange(8)
-        if kind == 0:
-            lit = rng.choice(["5", "'abc'", "'HELLO'", "3.14", "0"])
-            fn = rng.choice(self._FNS)
-            lhs = f"{fn}({col})" if fn else col
-            return (f"SELECT COUNT(*) FROM s3object WHERE {lhs} "
-                    f"{rng.choice(self._OPS)} {lit}")
-        if kind == 1:
-            pat = rng.choice(["%5%", "a_c", "%é", "H%", "%"])
-            return (f"SELECT COUNT(*) FROM s3object WHERE {col} "
-                    f"LIKE '{pat}'")
-        if kind == 2:
-            return (f"SELECT COUNT(*) FROM s3object WHERE {col} "
-                    "IN ('5', 'abc', '3.14')")
-        if kind == 3:
-            return (f"SELECT COUNT(*) FROM s3object WHERE {col} "
-                    "BETWEEN 0 AND 100")
-        if kind == 4:
-            neg = "NOT " if rng.random() < .5 else ""
-            return (f"SELECT COUNT(*) FROM s3object WHERE {col} "
-                    f"IS {neg}NULL")
-        if kind == 5:
-            return (f"SELECT COUNT(b), MIN({col}), MAX({col}) "
-                    "FROM s3object")
-        if kind == 6:
-            return (f"SELECT a, c FROM s3object WHERE b "
-                    f"{rng.choice(self._OPS)} 10 "
-                    f"LIMIT {rng.randrange(1, 8)}")
-        return (f"SELECT COUNT(*) FROM s3object WHERE {col} * 2 + 1 "
-                f"{rng.choice(self._OPS)} 11")
+        return select_corpus.canonical_records(stream)
 
     def test_fuzz_engages_fast_tiers(self):
         """Canary: the fuzz shapes must actually exercise the fast
@@ -692,72 +637,36 @@ class TestDifferentialFuzz:
         from minio_tpu.select import columnar
 
         rng = random.Random(3)
-        data = self._gen_csv(rng, 20)
+        data = select_corpus.gen_csv(rng, 20)
         before = native.stats["native"] + columnar.stats["fast"]
         _run("SELECT COUNT(*) FROM s3object WHERE b > 5", data)
         assert native.stats["native"] + columnar.stats["fast"] == \
             before + 1
 
+    def _differential_case(self, seed, case):
+        expr, data, inp, out = case
+        slow = self._recs(_run(expr, data, inp, out, tier="row"))
+        fast = self._recs(_run(expr, data, inp, out))
+        assert fast == slow, (seed, expr, data[:200])
+        batch = self._recs(_run(expr, data, inp, out, tier="batch"))
+        assert batch == slow, (seed, expr, data[:200])
+
     @pytest.mark.parametrize("seed", list(range(0, 90)))
     def test_csv_fuzz(self, seed):
-        rng = random.Random(seed)
-        data = self._gen_csv(rng, rng.randrange(1, 40))
-        expr = self._gen_query(rng)
-        slow = self._recs(_run(expr, data, tier="row"))
-        fast = self._recs(_run(expr, data))
-        assert fast == slow, (seed, expr, data[:200])
-        batch = self._recs(_run(expr, data, tier="batch"))
-        assert batch == slow, (seed, expr, data[:200])
+        self._differential_case(seed, select_corpus.csv_case(seed))
 
     @pytest.mark.parametrize("seed", list(range(10_000, 10_090)))
     def test_json_fuzz(self, seed):
-        rng = random.Random(seed)
-        vals = [None, 0, 5, -3, 3.14, True, False, "abc", "", "HELLO",
-                "café", "5", " pad ", 10**20, {"n": 1}, [1, 2], 'q"t']
-        lines = []
-        for _ in range(rng.randrange(1, 30)):
-            doc = {k: rng.choice(vals) for k in ("a", "b", "c")
-                   if rng.random() < 0.85}
-            lines.append(json.dumps(doc))
-        data = ("\n".join(lines) + "\n").encode()
-        expr = self._gen_query(rng)
-        inp = {"JSON": {"Type": "LINES"}}
-        slow = self._recs(_run(expr, data, inp, {"JSON": {}},
-                               tier="row"))
-        fast = self._recs(_run(expr, data, inp, {"JSON": {}}))
-        assert fast == slow, (seed, expr, data[:200])
-        batch = self._recs(_run(expr, data, inp, {"JSON": {}},
-                                tier="batch"))
-        assert batch == slow, (seed, expr, data[:200])
+        self._differential_case(seed, select_corpus.json_case(seed))
 
     # quoted/escaped CSV shapes: doubled quotes, embedded delimiters
     # and newlines, quote-free/quoted block TRANSITIONS (the fused
     # kernel stops at the first quote and hands the stretch to the
     # array path mid-block — ISSUE 2 satellite corpus)
-    _QCELLS = ["", "5", "500", 'he said ""hi""', "a,b", "line\nbreak",
-               "tail\rcr", "plain", '"', "600", "x" * 40, "-7", "0.25",
-               "café", " sp ", "99999999999999999999"]
-
     @pytest.mark.parametrize("seed", list(range(20_000, 20_070)))
     def test_csv_quoted_fuzz(self, seed):
-        rng = random.Random(seed)
-        lines = ["a,b,c"]
-        for _ in range(rng.randrange(1, 40)):
-            vals = []
-            for _ in range(rng.choice([3, 3, 3, 2, 4])):
-                v = rng.choice(self._QCELLS)
-                if any(ch in v for ch in ',"\r\n') or \
-                        rng.random() < 0.25:
-                    v = '"' + v.replace('"', '""') + '"'
-                vals.append(v)
-            lines.append(",".join(vals))
-        data = ("\n".join(lines) + "\n").encode()
-        expr = self._gen_query(rng)
-        slow = self._recs(_run(expr, data, tier="row"))
-        fast = self._recs(_run(expr, data))
-        assert fast == slow, (seed, expr, data[:200])
-        batch = self._recs(_run(expr, data, tier="batch"))
-        assert batch == slow, (seed, expr, data[:200])
+        self._differential_case(seed,
+                                select_corpus.csv_quoted_case(seed))
 
     # escape-heavy / nested JSON: escaped strings must keep the fast
     # path for OTHER keys (only the escaped cell is ambiguous), nested
@@ -765,28 +674,8 @@ class TestDifferentialFuzz:
     # exactly like json.loads
     @pytest.mark.parametrize("seed", list(range(30_000, 30_070)))
     def test_json_escape_fuzz(self, seed):
-        rng = random.Random(seed)
-        vals = ['x\\"y', "tab\there", "nl\nnewline", "b\\slash",
-                "unié", "ctl", "plain", "", 5, -3.5, None,
-                True, {"deep": {"deeper": [1, "two"]}}, [1, [2, [3]]],
-                10**19, "5", 0.125]
-        lines = []
-        for _ in range(rng.randrange(1, 30)):
-            doc = {k: rng.choice(vals) for k in ("a", "b", "c")
-                   if rng.random() < 0.9}
-            lines.append(json.dumps(doc))
-            if rng.random() < 0.1:
-                lines.append("")  # blank lines are skipped
-        data = ("\n".join(lines) + "\n").encode()
-        expr = self._gen_query(rng)
-        inp = {"JSON": {"Type": "LINES"}}
-        slow = self._recs(_run(expr, data, inp, {"JSON": {}},
-                               tier="row"))
-        fast = self._recs(_run(expr, data, inp, {"JSON": {}}))
-        assert fast == slow, (seed, expr, data[:200])
-        batch = self._recs(_run(expr, data, inp, {"JSON": {}},
-                                tier="batch"))
-        assert batch == slow, (seed, expr, data[:200])
+        self._differential_case(seed,
+                                select_corpus.json_escape_case(seed))
 
 
 class TestStrictJsonGrammar:

@@ -1,0 +1,393 @@
+"""Tier-1 gate for the project-native invariant linter.
+
+Two jobs:
+1. The whole package must be clean: zero unsuppressed findings across
+   every rule (the same run as `python -m minio_tpu.analysis`).
+2. The linter itself cannot rot: each rule has a known-bad fixture
+   that MUST be flagged and a known-good/pragma'd fixture that MUST
+   pass, plus pragma-hygiene checks (reasons mandatory, unknown rules
+   flagged, stale suppressions flagged).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import textwrap
+
+from minio_tpu.analysis import RULES, analyze_paths, analyze_source
+
+PKG = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "minio_tpu")
+
+
+def _findings(source: str, path: str = "mod.py", rules=None):
+    return analyze_source(textwrap.dedent(source), path, rules)
+
+
+def _rules_hit(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------- the gate
+class TestPackageClean:
+    def test_package_has_zero_unsuppressed_findings(self):
+        findings = analyze_paths([PKG])
+        assert not findings, (
+            "static analysis gate failed:\n"
+            + "\n".join(str(f) for f in findings))
+
+    def test_all_five_rules_registered(self):
+        # importing analyze_paths pulls the rule registry in
+        analyze_paths([os.path.join(PKG, "analysis", "__init__.py")])
+        assert {"budget-propagation", "blocking-under-lock",
+                "s3-error-coverage", "metrics-drift",
+                "thread-lifecycle"} <= set(RULES)
+
+
+# ------------------------------------------------------- budget-propagation
+class TestBudgetPropagationFixtures:
+    def test_raw_submit_flagged(self):
+        bad = """
+        def f(pool, fn):
+            return pool.submit(fn)
+        """
+        assert "budget-propagation" in _rules_hit(
+            _findings(bad, rules=["budget-propagation"]))
+
+    def test_raw_thread_flagged(self):
+        bad = """
+        import threading
+
+        def f(fn):
+            threading.Thread(target=fn, daemon=True).start()
+        """
+        assert "budget-propagation" in _rules_hit(
+            _findings(bad, rules=["budget-propagation"]))
+
+    def test_raw_run_in_executor_flagged(self):
+        bad = """
+        async def f(loop, pool, fn):
+            return await loop.run_in_executor(pool, fn)
+        """
+        assert "budget-propagation" in _rules_hit(
+            _findings(bad, rules=["budget-propagation"]))
+
+    def test_ctx_submit_and_copy_context_pass(self):
+        good = """
+        import contextvars
+
+        from minio_tpu.utils.deadline import ctx_submit, service_thread
+
+        def f(pool, fn):
+            return ctx_submit(pool, fn)
+
+        async def g(loop, pool, fn):
+            ctx = contextvars.copy_context()
+            return await loop.run_in_executor(pool, lambda: ctx.run(fn))
+
+        def h(fn):
+            service_thread(fn, name="worker")
+        """
+        assert not _findings(good, rules=["budget-propagation"])
+
+    def test_runnable_dot_run_still_flagged(self):
+        # `.run` on a non-context receiver is the Runnable idiom, not a
+        # contextvars hand-off — it must not satisfy the rule
+        bad = """
+        def f(pool, task):
+            return pool.submit(task.run)
+        """
+        assert "budget-propagation" in _rules_hit(
+            _findings(bad, rules=["budget-propagation"]))
+
+    def test_copy_context_chain_passes(self):
+        good = """
+        import contextvars
+
+        def f(pool, fn):
+            return pool.submit(contextvars.copy_context().run, fn)
+        """
+        assert not _findings(good, rules=["budget-propagation"])
+
+    def test_pragma_with_reason_suppresses(self):
+        ok = """
+        def f(pool, fn):
+            # lint: allow(budget-propagation): fire-and-forget, no budget to carry
+            return pool.submit(fn)
+        """
+        assert not _findings(ok, rules=["budget-propagation"])
+
+
+# ------------------------------------------------------ blocking-under-lock
+class TestBlockingUnderLockFixtures:
+    def test_sleep_under_lock_flagged(self):
+        bad = """
+        import time
+
+        def f(self):
+            with self._mu:
+                time.sleep(1)
+        """
+        assert "blocking-under-lock" in _rules_hit(
+            _findings(bad, rules=["blocking-under-lock"]))
+
+    def test_future_result_and_rpc_under_lock_flagged(self):
+        bad = """
+        def f(self, fut, client):
+            with self._lock:
+                fut.result()
+                client.call("x", {})
+        """
+        got = _findings(bad, rules=["blocking-under-lock"])
+        assert len(got) == 2
+
+    def test_storage_io_one_call_deep_flagged(self):
+        bad = """
+        class T:
+            def _save(self):
+                self.disk.write_all("v", "p", b"x")
+
+            def mutate(self):
+                with self._mu:
+                    self._save()
+        """
+        assert "blocking-under-lock" in _rules_hit(
+            _findings(bad, rules=["blocking-under-lock"]))
+
+    def test_queue_get_under_lock_flagged_but_dict_get_passes(self):
+        bad = """
+        def f(self):
+            with self._mu:
+                return self.queue.get()
+        """
+        good = """
+        def f(self):
+            with self._mu:
+                return self._queues.get("name")
+        """
+        assert _findings(bad, rules=["blocking-under-lock"])
+        assert not _findings(good, rules=["blocking-under-lock"])
+
+    def test_condition_wait_on_held_cv_passes(self):
+        good = """
+        def f(self):
+            with self._cv:
+                while not self.ready:
+                    self._cv.wait()
+        """
+        assert not _findings(good, rules=["blocking-under-lock"])
+
+    def test_pragma_on_with_header_covers_block(self):
+        ok = """
+        import time
+
+        def f(self):
+            # lint: allow(blocking-under-lock): dedicated writer-ordering lock, nothing hot contends
+            with self._io_lock:
+                time.sleep(0.1)
+        """
+        assert not _findings(ok, rules=["blocking-under-lock"])
+
+
+# ------------------------------------------------------- s3-error-coverage
+class TestS3ErrorCoverageFixtures:
+    def test_unregistered_code_flagged(self):
+        bad = """
+        from minio_tpu.server.s3errors import S3Error
+
+        def handler():
+            raise S3Error("NoSuchFrobnicator")
+        """
+        assert "s3-error-coverage" in _rules_hit(
+            _findings(bad, rules=["s3-error-coverage"]))
+
+    def test_registered_code_passes(self):
+        good = """
+        from minio_tpu.server.s3errors import S3Error
+
+        def handler():
+            raise S3Error("NoSuchKey", resource="b/o")
+        """
+        assert not _findings(good, rules=["s3-error-coverage"])
+
+    def test_unmapped_storage_error_under_server_flagged(self):
+        bad = """
+        from minio_tpu.storage import errors as st
+
+        def handler():
+            raise st.UnformattedDisk("boom")
+        """
+        assert "s3-error-coverage" in _rules_hit(
+            _findings(bad, path="server/handlers.py",
+                      rules=["s3-error-coverage"]))
+        # outside server/ handler paths the raise is fine
+        assert not _findings(bad, path="storage/thing.py",
+                             rules=["s3-error-coverage"])
+
+    def test_mapped_storage_error_under_server_passes(self):
+        good = """
+        from minio_tpu.storage import errors as st
+
+        def handler():
+            raise st.BucketNotFound("b")
+        """
+        assert not _findings(good, path="server/handlers.py",
+                             rules=["s3-error-coverage"])
+
+
+# ----------------------------------------------------------- metrics-drift
+class TestMetricsDriftFixtures:
+    def test_undeclared_metric_flagged(self):
+        bad = """
+        def render(g):
+            g("minio_bogus_made_up_total{x=\\"1\\"} 5")
+        """
+        assert "metrics-drift" in _rules_hit(
+            _findings(bad, rules=["metrics-drift"]))
+
+    def test_declared_metric_passes(self):
+        good = """
+        def render(g):
+            g("minio_s3_requests_total 5")
+            g("minio_s3_ttfb_seconds_bucket 1")  # histogram child
+        """
+        assert not _findings(good, rules=["metrics-drift"])
+
+    def test_non_metric_identifiers_ignored(self):
+        good = """
+        VAR = "minio_tpu_deadline"     # contextvar, not a metric
+        PREFIX = "minio_tpu/iam/"      # path, not a metric
+        """
+        assert not _findings(good, rules=["metrics-drift"])
+
+
+# --------------------------------------------------------- thread-lifecycle
+class TestThreadLifecycleFixtures:
+    def test_nondaemon_unjoined_thread_flagged(self):
+        bad = """
+        import threading
+
+        def f(fn):
+            threading.Thread(target=fn).start()
+        """
+        assert "thread-lifecycle" in _rules_hit(
+            _findings(bad, rules=["thread-lifecycle"]))
+
+    def test_daemon_thread_passes(self):
+        good = """
+        import threading
+
+        def f(fn):
+            threading.Thread(target=fn, daemon=True).start()
+        """
+        assert not _findings(good, rules=["thread-lifecycle"])
+
+    def test_joined_thread_passes(self):
+        good = """
+        import threading
+
+        def f(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+        """
+        assert not _findings(good, rules=["thread-lifecycle"])
+
+    def test_str_join_does_not_mask_leaked_thread(self):
+        bad = """
+        import threading
+
+        def f(fn, names):
+            threading.Thread(target=fn).start()
+            return ", ".join(names)
+        """
+        assert "thread-lifecycle" in _rules_hit(
+            _findings(bad, rules=["thread-lifecycle"]))
+
+
+# ------------------------------------------------------------ pragma rules
+class TestPragmaHygiene:
+    def test_pragma_without_reason_is_a_finding(self):
+        bad = """
+        def f(pool, fn):
+            # lint: allow(budget-propagation)
+            return pool.submit(fn)
+        """
+        got = _findings(bad, rules=["budget-propagation"])
+        assert any(f.rule == "pragma" and "reason" in f.message
+                   for f in got)
+
+    def test_unknown_rule_in_pragma_is_a_finding(self):
+        bad = """
+        X = 1  # lint: allow(no-such-rule): whatever
+        """
+        got = _findings(bad, rules=["budget-propagation"])
+        assert any(f.rule == "pragma" and "unknown rule" in f.message
+                   for f in got)
+
+    def test_unused_pragma_is_a_finding_on_full_runs(self):
+        stale = """
+        def f():
+            # lint: allow(budget-propagation): left over from a refactor
+            return 1
+        """
+        got = _findings(stale)  # all rules -> staleness policed
+        assert any(f.rule == "pragma" and "unused" in f.message
+                   for f in got)
+        # single-rule runs don't police other rules' pragmas
+        assert not _findings(stale, rules=["metrics-drift"])
+
+    def test_pragma_on_preceding_comment_line_applies(self):
+        ok = """
+        def f(pool, fn):
+            # a longer explanation of the design
+            # lint: allow(budget-propagation): fire-and-forget
+            return pool.submit(fn)
+        """
+        assert not [f for f in _findings(ok, rules=["budget-propagation"])
+                    if f.rule != "pragma"]
+
+
+# ------------------------------------------------------------------- CLI
+class TestCli:
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, "-m", "minio_tpu.analysis", *args],
+            capture_output=True, text=True, timeout=300,
+            cwd=os.path.dirname(PKG))
+
+    def test_list_rules(self):
+        proc = self._run("--list-rules")
+        assert proc.returncode == 0
+        for rule in ("budget-propagation", "blocking-under-lock",
+                     "s3-error-coverage", "metrics-drift",
+                     "thread-lifecycle"):
+            assert rule in proc.stdout
+
+    def test_findings_exit_nonzero(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import threading\n"
+                       "threading.Thread(target=print).start()\n")
+        proc = self._run(str(bad))
+        assert proc.returncode == 1
+        assert "budget-propagation" in proc.stdout
+
+    def test_clean_file_exits_zero(self, tmp_path):
+        good = tmp_path / "good.py"
+        good.write_text("X = 1\n")
+        proc = self._run(str(good))
+        assert proc.returncode == 0
+        assert proc.stdout.strip() == ""
+
+    def test_unknown_rule_usage_error(self, tmp_path):
+        good = tmp_path / "good.py"
+        good.write_text("X = 1\n")
+        proc = self._run("--rule", "nope", str(good))
+        assert proc.returncode == 2
+
+    def test_package_scan_via_cli_clean(self):
+        proc = self._run(PKG)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
