@@ -118,6 +118,22 @@ void gf256_matmul(const uint8_t* mat, int rows, int k, const uint8_t* src,
   }
 }
 
+// Batched (B, K, S) -> (B, rows, S) codec call: src is B contiguous
+// blocks of k shards, out is B contiguous blocks of `rows` outputs.
+// Looping blocks INSIDE one call matters beyond convenience: the Python
+// caller marshals arguments and releases the GIL once per chunk instead
+// of once per block — 128 ctypes round trips per 32-block batch convoyed
+// the GIL against the etag-hasher and shard-writer threads and tripled
+// the apparent encode time under load (ISSUE 5 pipeline).
+void gf256_matmul_batch(const uint8_t* mat, int rows, int k,
+                        const uint8_t* src, uint8_t* out, size_t n,
+                        size_t nblocks) {
+  for (size_t b = 0; b < nblocks; b++) {
+    gf256_matmul(mat, rows, k, src + b * (size_t)k * n,
+                 out + b * (size_t)rows * n, n);
+  }
+}
+
 // Convenience single multiply: dst = c * src.
 void gf256_mul(uint8_t c, const uint8_t* src, uint8_t* dst, size_t n) {
 #if defined(__AVX2__)

@@ -322,11 +322,17 @@ class _RemoteWriter(io.RawIOBase):
 
     def close(self) -> None:
         if not self.closed_:
+            # mark closed BEFORE flushing: if the final append times out,
+            # RawIOBase.__del__ calls close() again at GC and would
+            # blind-retry the append — a double-append risk on a drive
+            # that may have applied the first attempt, and a second full
+            # RPC timeout paid on whatever thread the GC runs (observed:
+            # +6s on the PUT response path with a hung drive)
+            self.closed_ = True
             try:
                 self._flush()
             finally:
                 self.session.close()
-            self.closed_ = True
 
 
 class RemoteStorage(StorageAPI):
@@ -412,7 +418,10 @@ class RemoteStorage(StorageAPI):
             w.write(chunk)
         w.close()
 
-    def open_file_writer(self, volume: str, path: str) -> BinaryIO:
+    def open_file_writer(self, volume: str, path: str,
+                         size_hint: int = -1) -> BinaryIO:
+        # size_hint is a local write-strategy hint; the remote side
+        # chooses its own strategy per chunk
         return _RemoteWriter(self.client, self.drive, volume, path)
 
     def read_file_stream(self, volume: str, path: str, offset: int,

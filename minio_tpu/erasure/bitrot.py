@@ -12,6 +12,7 @@ Reads must be shard-size aligned; each block is verified on read
 from __future__ import annotations
 
 import hashlib
+import io
 import os
 from typing import BinaryIO, Callable
 
@@ -19,6 +20,7 @@ import numpy as np
 
 from minio_tpu.ops import host
 from minio_tpu.storage import errors
+from . import stagestats
 
 HASH_SIZE = 32  # size for the default algorithm (HighwayHash-256)
 DEFAULT_ALGO = "highwayhash256S"
@@ -79,9 +81,14 @@ class BitrotWriter:
             raise errors.InvalidArgument(
                 f"bitrot write of {len(block)} exceeds shard size {self.shard_size}"
             )
-        h = self._hash(bytes(block))
-        self.w.write(h)
-        self.w.write(block)
+        # hash straight from the caller's buffer (bytes, memoryview or a
+        # contiguous ndarray row) — no bytes() materialization; hh256
+        # reads any 1-D contiguous buffer zero-copy (ops/host.py)
+        with stagestats.timed("hash", len(block)):
+            h = self._hash(block)
+        with stagestats.timed("write", len(block)):
+            self.w.write(h)
+            self.w.write(block)
         self.written += self._hsize + len(block)
 
     def write_frames(self, blocks: np.ndarray) -> None:
@@ -116,45 +123,59 @@ class BitrotWriter:
             )
         if self.algo not in ("highwayhash256S", "highwayhash256"):
             for row in blocks:
-                self.write(row.tobytes())
+                self.write(row)
             return
         try:
-            hashes = host.hh256_batch(blocks)
+            with stagestats.timed("hash", blocks.nbytes):
+                hashes = host.hh256_batch(blocks)
         except RuntimeError:
             for row in blocks:
-                self.write(row.tobytes())
+                self.write(row)
             return
         fd = None
         try:
             fd = self.w.fileno()
         except (AttributeError, OSError, ValueError):
             pass
-        if fd is not None:
-            self.w.flush()
-            for lo in range(0, nb, 500):  # stay under IOV_MAX segments
-                hi = min(lo + 500, nb)
-                iov: list = []
-                for bi in range(lo, hi):
-                    iov.append(hashes[bi].data)
-                    iov.append(blocks[bi].data)
-                total = (hi - lo) * (self._hsize + length)
-                sent = os.writev(fd, iov)
-                if sent < total:  # partial writev (signals): resume mid-frame
-                    rest = bytearray()
-                    off = 0
-                    for seg in iov:
-                        if off + len(seg) > sent:
-                            rest += seg[max(0, sent - off):]
-                        off += len(seg)
-                    rest = bytes(rest)
-                    while rest:
-                        n = os.write(fd, rest)
-                        rest = rest[n:]
-        else:
-            buf = np.empty((nb, self._hsize + length), dtype=np.uint8)
-            buf[:, : self._hsize] = hashes
-            buf[:, self._hsize:] = blocks
-            self.w.write(buf.reshape(-1).data)
+        with stagestats.timed("write", blocks.nbytes):
+            if fd is not None:
+                self.w.flush()
+                for lo in range(0, nb, 500):  # stay under IOV_MAX segments
+                    hi = min(lo + 500, nb)
+                    iov: list = []
+                    for bi in range(lo, hi):
+                        iov.append(hashes[bi].data)
+                        iov.append(blocks[bi].data)
+                    total = (hi - lo) * (self._hsize + length)
+                    sent = os.writev(fd, iov)
+                    if sent < total:  # partial writev (signals): resume mid-frame
+                        rest = bytearray()
+                        off = 0
+                        for seg in iov:
+                            if off + len(seg) > sent:
+                                rest += seg[max(0, sent - off):]
+                            off += len(seg)
+                        rest = bytes(rest)
+                        while rest:
+                            n = os.write(fd, rest)
+                            rest = rest[n:]
+            elif getattr(self.w, "prefers_row_writes", False):
+                # local staging writer (O_DIRECT): write the frames
+                # row-wise straight into its aligned buffer —
+                # materializing one interleaved [hash|block] buffer
+                # first would cost a full extra memory pass per batch
+                for bi in range(nb):
+                    self.w.write(hashes[bi].data)
+                    self.w.write(blocks[bi].data)
+            else:
+                # unknown sink (remote RPC writer, BytesIO): one
+                # interleaved buffer, ONE write — a row-wise loop would
+                # turn a batch into 2*nb round trips on wire-backed
+                # writers
+                buf = np.empty((nb, self._hsize + length), dtype=np.uint8)
+                buf[:, : self._hsize] = hashes
+                buf[:, self._hsize:] = blocks
+                self.w.write(buf.reshape(-1).data)
         self.written += nb * (self._hsize + length)
 
     def close(self) -> None:
@@ -197,8 +218,41 @@ class BitrotReader:
         short block (then nblocks must be 1)."""
         self._seek_to(offset)
         frame = self._hsize + block_len
-        raw = self.r.read(nblocks * frame)
-        if len(raw) != nblocks * frame:
+        want = nblocks * frame
+        # fill a preallocated frame buffer via readinto when the source
+        # supports it (one copy straight off the O_DIRECT staging buffer
+        # or socket); read()-only streams (remote RPC shards) wrap the
+        # returned bytes zero-copy instead of paying an extra buffer and
+        # a second memory pass
+        raw: bytearray | bytes = b""
+        got = 0
+        ri = getattr(self.r, "readinto", None) \
+            if not getattr(self, "_no_readinto", False) else None
+        if ri is not None:
+            raw = bytearray(want)
+            mv = memoryview(raw)
+            try:
+                while got < want:
+                    n = ri(mv[got:])
+                    if not n:
+                        break
+                    got += n
+            except (NotImplementedError, io.UnsupportedOperation):
+                # RawIOBase subclasses that only implement read()
+                # (remote RPC shard streams) inherit a non-functional
+                # readinto — remember and fall back for this stream.
+                # The default raises before consuming anything, but
+                # reposition defensively in case a partial read landed.
+                self._no_readinto = True
+                ri = None
+                if got:
+                    self._pos = -1
+                    self._seek_to(offset)
+                got = 0
+        if ri is None:
+            raw = self.r.read(want)
+            got = len(raw)
+        if got != want:
             raise errors.FileCorrupt("bitrot: truncated frame group")
         arr = np.frombuffer(raw, dtype=np.uint8).reshape(nblocks, frame)
         hashes = arr[:, : self._hsize]
@@ -215,7 +269,7 @@ class BitrotReader:
             ok = np.array_equal(batched, hashes)
         else:
             ok = all(
-                self._hash(blocks[i].tobytes()) == hashes[i].tobytes()
+                self._hash(blocks[i].data) == hashes[i].tobytes()
                 for i in range(nblocks)
             )
         if not ok:
@@ -223,15 +277,35 @@ class BitrotReader:
         self._pos = offset + nblocks * block_len
         return blocks
 
+    # frames per read_at group: bounds the transient frame buffer while
+    # keeping the one-read/one-hash batching for large ranges
+    READ_AT_GROUP = 256
+
     def read_at(self, offset: int, length: int) -> bytes:
-        out = bytearray()
-        remaining = length
-        pos = offset
-        while remaining > 0:
-            want = min(self.shard_size, remaining)
-            out += self.read_blocks(pos, 1, want)[0].tobytes()
-            pos += want
-            remaining -= want
+        """Verified logical-byte range read.  Preallocates the output and
+        reads full-shard frames in batched groups (one file read + one
+        batched hash verify per group) instead of growing a bytes
+        accumulator one frame at a time — many-small-frame ranges used to
+        go quadratic in the `out +=` rewrite."""
+        if length <= 0:
+            return b""
+        out = bytearray(length)
+        out_arr = np.frombuffer(out, dtype=np.uint8)
+        pos = 0
+        off = offset
+        nfull = length // self.shard_size
+        while nfull > 0:
+            g = min(nfull, self.READ_AT_GROUP)
+            blocks = self.read_blocks(off, g, self.shard_size)
+            span = g * self.shard_size
+            # one vectorized gather from the strided frame rows
+            out_arr[pos: pos + span].reshape(g, self.shard_size)[:] = blocks
+            pos += span
+            off += span
+            nfull -= g
+        rem = length - pos
+        if rem:
+            out_arr[pos:] = self.read_blocks(off, 1, rem)[0]
         return bytes(out)
 
     def close(self) -> None:

@@ -32,8 +32,8 @@ from minio_tpu.storage.xlmeta import (
 )
 from minio_tpu.utils import deadline as deadline_mod
 from minio_tpu.utils.hashing import hash_order
-from . import bitrot
-from .coding import BLOCK_SIZE_V2, Erasure, _io_pool
+from . import bitrot, stagestats
+from .coding import BLOCK_SIZE_V2, Erasure, _io_pool, pipeline_enabled
 
 SMALL_FILE_THRESHOLD = 128 << 10  # inline shards into xl.meta below this
 
@@ -205,23 +205,125 @@ def _etag_of(data_hash: "hashlib._Hash") -> str:
 
 
 class _HashingReader(io.RawIOBase):
-    """Single-pass MD5 + size counter (reference internal/hash.Reader)."""
+    """Single-pass MD5 + size counter (reference internal/hash.Reader).
 
-    def __init__(self, r: BinaryIO, expected_size: int = -1):
+    Pipelined mode (the default, following coding.pipeline_enabled):
+    etag folding happens on a dedicated in-order hasher stage on the
+    shared I/O pool instead of inline on the reading thread — MD5 was
+    ~40% of PUT wall time serial with block split + encode dispatch.
+    `read()` hands each returned bytes object to the chain (immutable,
+    so no lifetime coordination needed); `readinto()` + `hash_view()`
+    is the arena protocol used by Erasure.encode_stream: readinto fills
+    the caller's reusable buffer WITHOUT hashing, and hash_view()
+    queues the fold, returning a future the arena ring waits on before
+    recycling the slot.  `etag` joins the chain, so the result is
+    byte-exact with the serial path (defer=False — the differential
+    suite compares the two).
+    """
+
+    def __init__(self, r: BinaryIO, expected_size: int = -1,
+                 defer: bool | None = None):
         self.r = r
         self.md5 = hashlib.md5()
         self.count = 0
         self.expected = expected_size
+        if defer is None:
+            defer = pipeline_enabled()
+        self._defer = defer
+        self._tail: "cf.Future | None" = None  # newest queued fold
+
+    def _fold(self, view) -> "cf.Future":
+        """Queue one in-order MD5 fold on the I/O pool.  Each task waits
+        on its predecessor, and submissions are FIFO, so folds apply in
+        stream order; depth is bounded by the caller's arena ring (slot
+        recycling waits on the returned future)."""
+        prev = self._tail
+
+        def run() -> None:
+            if prev is not None:
+                prev.result()
+            with stagestats.timed("etag", len(view)):
+                self.md5.update(view)
+
+        fut = deadline_mod.ctx_submit(_io_pool(), run)
+        self._tail = fut
+        return fut
 
     def read(self, n: int = -1) -> bytes:
         data = self.r.read(n)
         if data:
-            self.md5.update(data)
             self.count += len(data)
+            if self._defer:
+                self._fold(data)
+            else:
+                with stagestats.timed("etag", len(data)):
+                    self.md5.update(data)
         return data
+
+    _use_readinto = True  # cleared on the first wrapper lacking readinto
+
+    def readinto(self, b) -> int:
+        """Arena fill: bytes land in the caller's buffer UNHASHED — the
+        caller pairs this with hash_view() so the fold overlaps the
+        encode of the next batch (plain read() keeps hashing itself).
+        Memory-resident sources (BytesIO: POST-object bodies, decom /
+        replication / heal staging) copy via numpy straight out of the
+        source buffer — large numpy copies release the GIL, so the fill
+        overlaps the hasher and writer threads instead of convoying
+        them.  Wrapped sources that only implement read() (chunked-
+        signature decoders, tee hashers, SSE/compression transforms
+        inherit RawIOBase's non-readinto) fall back to read + one numpy
+        copy into the arena — the same byte traffic the old per-batch
+        allocation paid."""
+        mv = memoryview(b)
+        gb = getattr(self.r, "getbuffer", None)
+        if gb is not None:
+            try:
+                src = gb()
+                pos = self.r.tell()
+                got = min(len(mv), len(src) - pos)
+                if got > 0:
+                    np.frombuffer(mv, dtype=np.uint8)[:got] = \
+                        np.frombuffer(src, dtype=np.uint8)[pos:pos + got]
+                    self.r.seek(pos + got)
+                else:
+                    got = 0
+                del src  # release the BytesIO export
+                self.count += got
+                return got
+            except (BufferError, OSError, ValueError):
+                pass
+        ri = getattr(self.r, "readinto", None) if self._use_readinto else None
+        if ri is not None:
+            try:
+                got = ri(mv) or 0
+                self.count += got
+                return got
+            except (NotImplementedError, io.UnsupportedOperation):
+                self._use_readinto = False
+        data = self.r.read(len(mv))
+        got = len(data) if data else 0
+        if got:
+            np.frombuffer(mv, dtype=np.uint8)[:got] = \
+                np.frombuffer(data, dtype=np.uint8)
+        self.count += got
+        return got
+
+    def hash_view(self, view):
+        """Fold `view` into the etag; returns the completion future the
+        arena ring must wait on before recycling the buffer (None when
+        folding ran inline — nothing to wait for)."""
+        if not self._defer:
+            with stagestats.timed("etag", len(view)):
+                self.md5.update(view)
+            return None
+        return self._fold(view)
 
     @property
     def etag(self) -> str:
+        tail = self._tail
+        if tail is not None:
+            tail.result()  # the chain is ordered: the newest fold is last
         return self.md5.hexdigest()
 
 
@@ -325,7 +427,7 @@ class ErasureObjects:
 
     # -------------------------------------------------------------- metadata
     def _read_all_fileinfo(self, bucket: str, obj: str, version_id: str = "",
-                           read_data: bool = False
+                           read_data: bool = False, hedge: bool = False
                            ) -> tuple[list[FileInfo | None], list[Exception | None]]:
         disks = self.disks
         n = len(disks)
@@ -341,7 +443,8 @@ class ErasureObjects:
         futs = {deadline_mod.ctx_submit(_io_pool(), read, i): i
                 for i in range(n)}
         budget = deadline_mod.current()
-        if budget is None or budget.t_end is None:
+        bounded = budget is not None and budget.t_end is not None
+        if not bounded and not hedge:
             # no deadline in play (background scans/heals): preserve the
             # complete fan-out — health accounting wants every answer
             for f, i in futs.items():
@@ -358,6 +461,11 @@ class ErasureObjects:
         # STRAGGLER_GRACE clock; a +500 ms drive then costs 50 ms, not
         # the whole RPC timeout (cmd/erasure-metadata-utils.go
         # readAllFileInfo; hedged-request literature in PAPERS.md).
+        # With hedge=True the same quorum+grace policy applies even
+        # WITHOUT a bounded budget: the foreground read path (GET /
+        # head) must not let one slow drive's read_version stall
+        # first-byte latency — the metadata analogue of the shard-stream
+        # hedging below (ROADMAP deadline-plane follow-up).
         def electable() -> bool:
             try:
                 rq, _ = self._quorum_from(fis)
@@ -369,10 +477,11 @@ class ErasureObjects:
         pending = set(futs)
         elected = False
         while pending:
-            timeout = budget.remaining()
+            timeout = budget.remaining() if bounded else None
             if elected:
-                timeout = min(timeout, STRAGGLER_GRACE)
-            if timeout <= 0:
+                timeout = STRAGGLER_GRACE if timeout is None \
+                    else min(timeout, STRAGGLER_GRACE)
+            if timeout is not None and timeout <= 0:
                 break
             done, pending = cf.wait(pending, timeout=timeout,
                                     return_when=cf.FIRST_COMPLETED)
@@ -396,8 +505,10 @@ class ErasureObjects:
             hedge_stats["abandoned"] += 1
         return fis, errs
 
-    def _quorum_info(self, bucket, obj, version_id="", read_data=False):
-        fis, errs = self._read_all_fileinfo(bucket, obj, version_id, read_data)
+    def _quorum_info(self, bucket, obj, version_id="", read_data=False,
+                     hedge=False):
+        fis, errs = self._read_all_fileinfo(bucket, obj, version_id,
+                                            read_data, hedge)
         not_found = sum(1 for e in errs if isinstance(e, errors.FileNotFound))
         ver_not_found = sum(
             1 for e in errs if isinstance(e, errors.FileVersionNotFound)
@@ -473,23 +584,52 @@ class ErasureObjects:
                 shards_inline[i] = buf.getvalue()
             total_size = size
         else:
-            writers = []
-            for i in range(n):
+            shard_hint = -1 if size < 0 else bitrot.bitrot_shard_file_size(
+                erasure.shard_file_size(size), erasure.shard_size,
+                bitrot.algo_from_env())
+
+            def open_writer(i: int):
                 d = disks[i]
                 if d is None:
-                    writers.append(None)
-                    continue
+                    return None
                 try:
                     fh = d.open_file_writer(SYSTEM_VOL,
-                                            f"{tmp_prefix}/part.1")
+                                            f"{tmp_prefix}/part.1",
+                                            size_hint=shard_hint)
                 except errors.StorageError:
                     # faulty drive: degrade to a missing writer, the
                     # write-quorum accounting decides (reference drops
                     # failed disks before encode, cmd/erasure-encode.go)
-                    writers.append(None)
-                    continue
-                writers.append(bitrot.BitrotWriter(
-                    fh, erasure.shard_size, algo=bitrot.algo_from_env()))
+                    return None
+                return bitrot.BitrotWriter(
+                    fh, erasure.shard_size, algo=bitrot.algo_from_env())
+
+            # parallel writer opens: O_DIRECT open + staging-buffer setup
+            # costs milliseconds per drive — serial, that is a full
+            # drive-count round before the first byte is encoded
+            open_futs = [deadline_mod.ctx_submit(_io_pool(), open_writer, i)
+                         for i in range(n)]
+            writers = []
+            try:
+                for f in open_futs:
+                    writers.append(f.result())
+            except BaseException:
+                # a non-StorageError open (EACCES, MemoryError, ...)
+                # aborts the PUT: close the writers that DID open (raw
+                # O_DIRECT fds + pooled staging buffers have no
+                # finalizer) and sweep their staged tmp files
+                for f in open_futs:
+                    try:
+                        w = f.result()
+                    except Exception:
+                        continue
+                    if w is not None:
+                        try:
+                            w.close()
+                        except Exception:
+                            pass
+                self._cleanup_tmp(tmp_prefix)
+                raise
             try:
                 total_size, failed_shards = erasure.encode_stream(
                     hreader, writers, size, write_quorum
@@ -563,7 +703,13 @@ class ErasureObjects:
                 except errors.StorageError:
                     pass
             commit_errs = self._fan_out(commit, range(n))
-        self._cleanup_tmp(tmp_prefix)
+        if not inline:
+            # a successful commit MOVED the staged dir (rename_data);
+            # only drives whose commit did not land still hold staging —
+            # sweeping all n was a per-PUT fixed cost of n no-op deletes
+            leftover = [i for i in range(n) if commit_errs[i] is not None]
+            if leftover:
+                self._cleanup_tmp(tmp_prefix, leftover)
         ok = sum(1 for e in commit_errs if e is None)
         if ok < write_quorum:
             raise errors.ErasureWriteQuorum(
@@ -587,17 +733,50 @@ class ErasureObjects:
         # ctx_submit carries the request's deadline budget into the pool
         # threads so remote hops clamp their retries; writes still await
         # EVERY drive (quorum accounting needs all outcomes — only the
-        # read path returns early)
-        futs = {i: deadline_mod.ctx_submit(_io_pool(), fn, i) for i in idxs}
+        # read path returns early).  Budget-free all-local fan-outs are
+        # grouped into at most ~2x-core-count tasks: 16 futures of 100us
+        # syscall work each cost more in thread wakeups than in work on
+        # a small host.  A group runs SERIALLY in one worker, so it is
+        # only safe when drives cannot individually stall: under a
+        # deadline budget a slow drive would charge its wall to the
+        # drives queued behind it (failing their clamped ops), and a
+        # hung remote drive would multiply the fan-out wall by its group
+        # size — those keep one task per drive.
+        idxs = list(idxs)
         out: list[Exception | None] = [None] * len(self.disks)
-        for i, f in futs.items():
-            try:
-                f.result()
-            except Exception as e:
-                out[i] = e
+        group_ok = deadline_mod.current() is None and all(
+            self.disks[i] is None or self.disks[i].is_local() for i in idxs)
+        if not group_ok:
+            futs = {i: deadline_mod.ctx_submit(_io_pool(), fn, i)
+                    for i in idxs}
+            for i, f in futs.items():
+                try:
+                    f.result()
+                except Exception as e:
+                    out[i] = e
+            return out
+        ngroups = max(4, 2 * (os.cpu_count() or 4))
+        step = max(1, -(-len(idxs) // ngroups))
+
+        def run_group(group: list[int]) -> list[Exception | None]:
+            res: list[Exception | None] = []
+            for i in group:
+                try:
+                    fn(i)
+                    res.append(None)
+                except Exception as e:
+                    res.append(e)
+            return res
+
+        groups = [idxs[lo: lo + step] for lo in range(0, len(idxs), step)]
+        futs = [(g, deadline_mod.ctx_submit(_io_pool(), run_group, g))
+                for g in groups]
+        for g, f in futs:
+            for i, err in zip(g, f.result()):
+                out[i] = err
         return out
 
-    def _cleanup_tmp(self, tmp_prefix: str) -> None:
+    def _cleanup_tmp(self, tmp_prefix: str, idxs=None) -> None:
         def rm(i: int) -> None:
             d = self.disks[i]
             if d is not None and d.is_online():
@@ -606,7 +785,7 @@ class ErasureObjects:
                 except errors.FileNotFound:
                     pass
 
-        self._fan_out(rm, range(len(self.disks)))
+        self._fan_out(rm, range(len(self.disks)) if idxs is None else idxs)
 
     def contains(self, bucket: str, obj: str) -> bool:
         """Quorum-visible object record exists (ANY version, including a
@@ -623,7 +802,7 @@ class ErasureObjects:
     def get_object_info(self, bucket: str, obj: str, version_id: str = ""
                         ) -> ObjectInfo:
         with self.ns.read(f"{bucket}/{obj}"):
-            fi, _, _ = self._quorum_info(bucket, obj, version_id)
+            fi, _, _ = self._quorum_info(bucket, obj, version_id, hedge=True)
         if fi.deleted:
             if not version_id:
                 raise errors.ObjectNotFound(f"{bucket}/{obj}")
@@ -649,7 +828,7 @@ class ErasureObjects:
                    ) -> tuple[ObjectInfo, Iterator[bytes]]:
         with self.ns.read(f"{bucket}/{obj}"):
             fi, fis, _ = self._quorum_info(bucket, obj, version_id,
-                                           read_data=True)
+                                           read_data=True, hedge=True)
         if fi.deleted:
             raise errors.ObjectNotFound(f"{bucket}/{obj}")
         oi = ObjectInfo.from_file_info(fi, bucket, obj, bool(version_id))
@@ -1301,7 +1480,10 @@ class ErasureObjects:
                             sink, e.shard_size, algo=_bitrot_algo_of(fi))
                     else:
                         fh = shard_disk[i].open_file_writer(
-                            SYSTEM_VOL, f"{TMP_DIR}/{tmp_ids[i]}/part.{part.number}"
+                            SYSTEM_VOL,
+                            f"{TMP_DIR}/{tmp_ids[i]}/part.{part.number}",
+                            size_hint=bitrot.bitrot_shard_file_size(
+                                till, e.shard_size, _bitrot_algo_of(fi)),
                         )
                         writers[i] = bitrot.BitrotWriter(
                             fh, e.shard_size, algo=_bitrot_algo_of(fi))

@@ -59,6 +59,14 @@ def _load():
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
         ]
+        try:
+            lib.gf256_matmul_batch.argtypes = [
+                ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.c_size_t,
+            ]
+        except AttributeError:  # older build without the batched entry
+            pass
         lib.hh256_state_size.restype = ctypes.c_int
         lib.hh256_init.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.hh256_update.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
@@ -110,12 +118,38 @@ class HostRSCodec:
                 out[r] = acc
         return out
 
-    def encode(self, data_shards: np.ndarray) -> np.ndarray:
-        """(K, S) -> (M, S) parity (or batched (B, K, S) -> (B, M, S))."""
+    def _matmul_batch(self, mat: np.ndarray, src: np.ndarray,
+                      out: np.ndarray | None) -> np.ndarray:
+        """(B, K, S) x mat -> (B, rows, S) in ONE C call (GIL released
+        once for the whole batch; `out` writes parity in place, skipping
+        a per-block copy).  Falls back to the per-block path without the
+        batched symbol or the native library."""
+        b, k, s = src.shape
+        rows = mat.shape[0]
+        if out is None:
+            out = np.empty((b, rows, s), dtype=np.uint8)
+        if (self._lib is not None
+                and hasattr(self._lib, "gf256_matmul_batch")
+                and out.flags["C_CONTIGUOUS"]):
+            src = np.ascontiguousarray(src, dtype=np.uint8)
+            self._lib.gf256_matmul_batch(
+                _as_c(np.ascontiguousarray(mat)), rows, k, _as_c(src),
+                out.ctypes.data_as(ctypes.c_char_p), s, b,
+            )
+            return out
+        for bi in range(b):
+            out[bi] = self._matmul(mat, src[bi])
+        return out
+
+    def encode(self, data_shards: np.ndarray,
+               out: np.ndarray | None = None) -> np.ndarray:
+        """(K, S) -> (M, S) parity (or batched (B, K, S) -> (B, M, S);
+        `out` receives batched parity in place when given)."""
         data_shards = np.asarray(data_shards, dtype=np.uint8)
+        mat = np.asarray(gf256.parity_matrix(self.k, self.m))
         if data_shards.ndim == 3:
-            return np.stack([self.encode(b) for b in data_shards])
-        return self._matmul(np.asarray(gf256.parity_matrix(self.k, self.m)), data_shards)
+            return self._matmul_batch(mat, data_shards, out)
+        return self._matmul(mat, data_shards)
 
     def reconstruct(self, src_shards, available_idx, wanted) -> np.ndarray:
         """(K, S) first-K-available -> (len(wanted), S)."""
@@ -124,7 +158,7 @@ class HostRSCodec:
         )
         src = np.asarray(src_shards, dtype=np.uint8)
         if src.ndim == 3:
-            return np.stack([self._matmul(mat, b) for b in src])
+            return self._matmul_batch(np.asarray(mat), src, None)
         return self._matmul(mat, src)
 
 
@@ -166,7 +200,12 @@ class HH256:
 
 
 def hh256(data, key: bytes = MAGIC_HH256_KEY) -> bytes:
-    """One-shot HighwayHash-256."""
+    """One-shot HighwayHash-256.
+
+    Accepts bytes, bytearray, memoryview and uint8 ndarrays; any 1-D
+    contiguous buffer is hashed IN PLACE (no bytes() materialization) —
+    the bitrot write path hands shard rows and arena views straight
+    through, so hashing costs zero extra memory passes."""
     lib = _load()
     if lib is None:
         raise RuntimeError("host library unavailable; build csrc/ (make -C csrc)")
@@ -174,9 +213,15 @@ def hh256(data, key: bytes = MAGIC_HH256_KEY) -> bytes:
     if isinstance(data, np.ndarray):
         data = np.ascontiguousarray(data, dtype=np.uint8)
         lib.hh256_sum(key, data.ctypes.data_as(ctypes.c_char_p), data.nbytes, out)
-    else:
-        data = bytes(data)
+    elif isinstance(data, bytes):
         lib.hh256_sum(key, data, len(data), out)
+    else:
+        mv = memoryview(data)
+        if mv.ndim != 1 or not mv.contiguous:
+            mv = memoryview(bytes(mv))
+        arr = np.frombuffer(mv, dtype=np.uint8)  # zero-copy buffer view
+        lib.hh256_sum(key, arr.ctypes.data_as(ctypes.c_char_p),
+                      arr.nbytes, out)
     return out.raw
 
 

@@ -224,6 +224,33 @@ class MetricsMixin:
         except Exception:
             pass
 
+        # object data-plane stage attribution (ISSUE 5): seconds + bytes
+        # per pipeline stage (read|etag|encode|hash|write|decode|respond)
+        # so the codec-vs-client throughput gap is attributable.  Stages
+        # overlap (that is the pipeline working), so the sum may exceed
+        # request wall time — a stage near wall time names the
+        # bottleneck.
+        try:
+            from minio_tpu.erasure import stagestats
+
+            snap = stagestats.snapshot()
+            srows = ["# HELP minio_dataplane_stage_seconds_total Seconds "
+                     "spent per object data-plane pipeline stage",
+                     "# TYPE minio_dataplane_stage_seconds_total gauge"]
+            brows = ["# HELP minio_dataplane_stage_bytes_total Bytes "
+                     "processed per object data-plane pipeline stage",
+                     "# TYPE minio_dataplane_stage_bytes_total gauge"]
+            for stage, d in snap.items():
+                lbl = _fmt_labels(("stage",), (stage,))
+                srows.append("minio_dataplane_stage_seconds_total"
+                             f"{lbl} {round(d['seconds'], 6)}")
+                brows.append("minio_dataplane_stage_bytes_total"
+                             f"{lbl} {int(d['bytes'])}")
+            g("\n".join(srows) + "\n")
+            g("\n".join(brows) + "\n")
+        except Exception:
+            pass
+
         # S3 Select engine-tier counters: which tier answered queries
         # and how often the fast paths fell back or replayed blocks
         # (VERDICT r4 #1 done-condition: the eligibility cliff is

@@ -362,8 +362,11 @@ class ObjectExtraHandlers:
                            if k.startswith("x-amz-meta-")},
             versioned=await self._versioned(bucket),
         )
-        oi = await self._run(self.api.put_object, bucket, key,
-                             io.BytesIO(file_data), len(file_data), opts)
+        # whole-payload phase: the store of the full form body must not
+        # be budget-aborted mid-write (same contract as the PUT handler)
+        oi = await self._run_nobudget(self.api.put_object, bucket, key,
+                                      io.BytesIO(file_data),
+                                      len(file_data), opts)
 
         from minio_tpu.events.event import EventName
 

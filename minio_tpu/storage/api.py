@@ -80,7 +80,9 @@ class StorageAPI(abc.ABC):
     def read_all(self, volume: str, path: str) -> bytes: ...
 
     @abc.abstractmethod
-    def write_all(self, volume: str, path: str, data: bytes) -> None: ...
+    def write_all(self, volume: str, path: str, data: bytes) -> None:
+        """Write a small flat file atomically (stage to a tmp name,
+        rename into place)."""
 
     @abc.abstractmethod
     def delete(self, volume: str, path: str, recursive: bool = False) -> None: ...
@@ -95,8 +97,11 @@ class StorageAPI(abc.ABC):
                     reader: BinaryIO) -> None: ...
 
     @abc.abstractmethod
-    def open_file_writer(self, volume: str, path: str) -> BinaryIO:
-        """Streaming writer handle (closed by caller)."""
+    def open_file_writer(self, volume: str, path: str,
+                         size_hint: int = -1) -> BinaryIO:
+        """Streaming writer handle (closed by caller).  `size_hint` is
+        the expected final size when known (-1 unknown): implementations
+        may use it to pick a write strategy (buffered vs O_DIRECT)."""
 
     @abc.abstractmethod
     def read_file_stream(self, volume: str, path: str, offset: int,

@@ -39,12 +39,12 @@ class TestPackageClean:
             "static analysis gate failed:\n"
             + "\n".join(str(f) for f in findings))
 
-    def test_all_five_rules_registered(self):
+    def test_all_rules_registered(self):
         # importing analyze_paths pulls the rule registry in
         analyze_paths([os.path.join(PKG, "analysis", "__init__.py")])
         assert {"budget-propagation", "blocking-under-lock",
                 "s3-error-coverage", "metrics-drift",
-                "thread-lifecycle"} <= set(RULES)
+                "thread-lifecycle", "payload-budget"} <= set(RULES)
 
 
 # ------------------------------------------------------- budget-propagation
@@ -306,6 +306,70 @@ class TestThreadLifecycleFixtures:
         """
         assert "thread-lifecycle" in _rules_hit(
             _findings(bad, rules=["thread-lifecycle"]))
+
+
+# -------------------------------------------------------- payload-budget
+class TestPayloadBudgetFixtures:
+    def test_whole_payload_under_run_flagged(self):
+        bad = """
+        async def put(self, request, bucket, key, reader, size, opts):
+            return await self._run(self.api.put_object, bucket, key,
+                                   reader, size, opts)
+        """
+        got = _findings(bad, rules=["payload-budget"])
+        assert "payload-budget" in _rules_hit(got)
+
+    def test_streaming_next_under_run_flagged(self):
+        bad = """
+        async def pump(self, resp, it):
+            while True:
+                chunk = await self._run(next, it, None)
+                if chunk is None:
+                    break
+                await resp.write(chunk)
+        """
+        assert "payload-budget" in _rules_hit(
+            _findings(bad, rules=["payload-budget"]))
+
+    def test_metadata_op_under_nobudget_flagged(self):
+        bad = """
+        async def head(self, bucket, key, vid):
+            return await self._run_nobudget(
+                self.api.get_object_info, bucket, key, vid)
+        """
+        assert "payload-budget" in _rules_hit(
+            _findings(bad, rules=["payload-budget"]))
+
+    def test_correct_funnels_pass(self):
+        ok = """
+        async def handlers(self, request, bucket, key, reader, size,
+                           opts, it):
+            oi = await self._run_nobudget(
+                self.api.put_object, bucket, key, reader, size, opts)
+            info = await self._run(self.api.get_object_info, bucket, key)
+            chunk = await self._run_nobudget(next, it, None)
+            text = await self._run(self._render_metrics)
+            return oi, info, chunk, text
+        """
+        assert not _findings(ok, rules=["payload-budget"])
+
+    def test_lambdas_and_locals_out_of_scope(self):
+        ok = """
+        async def f(self, closer, fn):
+            await self._run(lambda: closer.close())
+            await self._run_nobudget(fn)
+        """
+        assert not _findings(ok, rules=["payload-budget"])
+
+    def test_pragma_with_reason_suppresses(self):
+        ok = """
+        async def special(self, bucket, key):
+            # lint: allow(payload-budget): tiny fixed-size body, budget-bounded on purpose
+            return await self._run(self.api.put_object, bucket, key,
+                                   None, 0, None)
+        """
+        assert not [f for f in _findings(ok, rules=["payload-budget"])
+                    if f.rule != "pragma"]
 
 
 # ------------------------------------------------------------ pragma rules
