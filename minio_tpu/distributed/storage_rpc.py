@@ -250,6 +250,12 @@ class _SeekableRemoteStream(io.RawIOBase):
         self._fetch = fetch        # (absolute offset) -> stream response
         self._resp = fetch(offset)  # eager: surface open errors at create
         self._pos = offset
+        # per-stream drain budget: sequential consumers keep the
+        # default (frame-hash skips are cheaper drained than re-issued);
+        # the repair executor's ranged sub-shard reads set it to 0 so a
+        # survivor ships ONLY the planned fraction — every skip becomes
+        # a re-issued ranged RPC at the new offset
+        self.drain_max = self._DRAIN_MAX
 
     def read(self, n: int = -1) -> bytes:
         if self._resp is None:
@@ -265,7 +271,7 @@ class _SeekableRemoteStream(io.RawIOBase):
         if offset == self._pos:
             return offset
         if (self._resp is not None and offset > self._pos
-                and offset - self._pos <= self._DRAIN_MAX):
+                and offset - self._pos <= self.drain_max):
             delta = offset - self._pos
             while delta:
                 chunk = self._resp.read(min(delta, 1 << 16))

@@ -277,6 +277,22 @@ class BitrotReader:
         self._pos = offset + nblocks * block_len
         return blocks
 
+    def read_at_ranges(self, runs, block_len: int | None = None
+                       ) -> dict[int, np.ndarray]:
+        """Ranged sub-shard read mode (the repair executor's survivor
+        protocol): ``runs`` is [(block_idx, nblocks)] ascending; each
+        run is one seek + one frame-group read + one batched hash
+        verify, so a survivor ships ONLY the requested frames — remote
+        shard streams re-issue their ranged RPC at the new offset
+        instead of draining skipped bytes when their ``drain_max`` is 0
+        (distributed/storage_rpc.py).  Returns {block_idx: (nblocks,
+        block_len) uint8 rows}.  ``block_len`` defaults to shard_size;
+        a short final block must be its own single-block run."""
+        if block_len is None:
+            block_len = self.shard_size
+        return {b0: self.read_blocks(b0 * self.shard_size, nb, block_len)
+                for b0, nb in runs}
+
     # frames per read_at group: bounds the transient frame buffer while
     # keeping the one-read/one-hash batching for large ranges
     READ_AT_GROUP = 256

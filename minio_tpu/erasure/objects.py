@@ -33,6 +33,7 @@ from minio_tpu.storage.xlmeta import (
 from minio_tpu.utils import deadline as deadline_mod
 from minio_tpu.utils.hashing import hash_order
 from . import bitrot, stagestats
+from . import repair as repair_mod
 from .coding import BLOCK_SIZE_V2, Erasure, _io_pool, pipeline_enabled
 
 SMALL_FILE_THRESHOLD = 128 << 10  # inline shards into xl.meta below this
@@ -118,6 +119,12 @@ class HealResult:
     drives_after: list = field(default_factory=list)
     healed_drives: int = 0
     failed: bool = False
+    # repair-planner accounting (erasure/repair.py): which scheme
+    # rebuilt the shards ("subshard" if any part took the ranged path),
+    # survivor frame bytes read, and residual-scan bytes from targets
+    scheme: str = "full"
+    bytes_read: int = 0
+    bytes_scanned: int = 0
 
 
 class NamespaceLock:
@@ -1443,62 +1450,238 @@ class ErasureObjects:
             # stage rebuilt shards of every part, then commit once per drive
             tmp_ids = {i: str(uuid.uuid4()) for i in stale}
             inline_sinks: dict[int, io.BytesIO] = {}
+            algo = _bitrot_algo_of(fi)
+            read_acct = repair_mod.ByteCounter()
+            scan_acct = repair_mod.ByteCounter()
+            local_idx = {i for i in range(n)
+                         if shard_disk[i] is not None
+                         and shard_disk[i].is_local()}
             for part in fi.parts:
                 till = e.shard_file_size(part.size)
+                part_path = f"{obj}/{fi.data_dir}/part.{part.number}"
+                # Survivor readers open LAZILY, after planning: a
+                # sub-shard plan touches only its k helpers, and an
+                # eager open would charge every remote survivor a
+                # full-window stream RPC per part (the remote stream
+                # issues its fetch at create) that the ranged protocol
+                # then abandons.
                 readers: list[bitrot.BitrotReader | None] = [None] * n
-                for i in range(n):
-                    if not healthy[i]:
-                        continue
+                shard_fsize = bitrot.bitrot_shard_file_size(
+                    till, e.shard_size, algo)
+
+                def open_reader(i: int, at_frame: int = 0,
+                                ranged: bool = False):
                     di = shard_meta[i]
-                    algo = _bitrot_algo_of(fi)
                     if di is not None and di.data is not None:
-                        readers[i] = bitrot.BitrotReader(
+                        return bitrot.BitrotReader(
                             io.BytesIO(di.data), till, e.shard_size,
-                            algo=algo,
-                        )
-                    else:
+                            algo=algo)
+                    fh = shard_disk[i].read_file_stream(
+                        bucket, part_path, at_frame,
+                        shard_fsize - at_frame)
+                    if ranged and hasattr(fh, "drain_max"):
+                        # ranged helper: skips re-issue the RPC instead
+                        # of draining, so a remote survivor ships only
+                        # the planned fraction over the wire
+                        fh.drain_max = 0
+                    return bitrot.BitrotReader(
+                        fh, till, e.shard_size, algo=algo)
+
+                def open_survivors(idxs, at_frame: int = 0,
+                                   ranged: bool = False) -> None:
+                    for i in idxs:
+                        if readers[i] is not None:
+                            continue
                         try:
-                            fh = shard_disk[i].read_file_stream(
-                                bucket, f"{obj}/{fi.data_dir}/part.{part.number}",
-                                0, bitrot.bitrot_shard_file_size(
-                                    till, e.shard_size, algo),
-                            )
-                            readers[i] = bitrot.BitrotReader(
-                                fh, till, e.shard_size, algo=algo)
+                            readers[i] = open_reader(i, at_frame, ranged)
                         except Exception:
                             pass
-                if sum(1 for r in readers if r) < e.k:
+
+                candidates = [
+                    i for i in range(n)
+                    if healthy[i] and (
+                        (shard_meta[i] is not None
+                         and shard_meta[i].data is not None)
+                        or shard_disk[i] is not None)]
+                if len(candidates) < e.k:
                     result.failed = True
                     return result
 
-                writers: list[bitrot.BitrotWriter | None] = [None] * n
-                for i in stale:
-                    # healed shards keep the version's recorded algorithm
-                    if inline:
-                        sink = inline_sinks.setdefault(i, io.BytesIO())
-                        writers[i] = bitrot.BitrotWriter(
-                            sink, e.shard_size, algo=_bitrot_algo_of(fi))
-                    else:
-                        fh = shard_disk[i].open_file_writer(
-                            SYSTEM_VOL,
-                            f"{TMP_DIR}/{tmp_ids[i]}/part.{part.number}",
-                            size_hint=bitrot.bitrot_shard_file_size(
-                                till, e.shard_size, _bitrot_algo_of(fi)),
-                        )
-                        writers[i] = bitrot.BitrotWriter(
-                            fh, e.shard_size, algo=_bitrot_algo_of(fi))
-                try:
-                    e.heal(writers, readers, part.size)
-                finally:
+                # -- repair planning (erasure/repair.py): price reusing
+                # the targets' surviving frames against the k-full-shard
+                # decode.  Inline objects stay on the full path (their
+                # shards live in xl.meta; no drive bytes to save).
+                residuals: dict[int, repair_mod.ResidualMap] = {}
+                nblocks_part = -(-till // e.shard_size) if till > 0 else 0
+                # the operator's full-decode override skips the residual
+                # scan entirely: pricing that can't change the decision
+                # must not cost a full target-shard read (remote stale
+                # drives would pay it as an extra RPC transfer per part)
+                ov = "full" if inline else repair_mod.scheme_override()
+                if not inline and till > 0 and ov != "full":
                     for i in stale:
-                        if writers[i] is not None and not inline:
-                            writers[i].close()
+                        rm = None
+                        try:
+                            tfh = shard_disk[i].read_file_stream(
+                                bucket, part_path, 0, -1)
+                        except Exception:
+                            # wiped/rotated drive or stale version: no
+                            # same-version file — every block needs the
+                            # k-wide rebuild
+                            rm = repair_mod.ResidualMap(
+                                nblocks=nblocks_part,
+                                good=np.zeros(nblocks_part, dtype=bool))
+                        if rm is None:
+                            try:
+                                rm = repair_mod.scan_residual(
+                                    tfh, till, e.shard_size, algo=algo)
+                                scan_acct.add(rm.scanned_bytes)
+                            finally:
+                                try:
+                                    tfh.close()
+                                except Exception:
+                                    pass
+                        residuals[i] = rm
+                plan = repair_mod.plan_repair(
+                    e, stale, candidates, part.size,
+                    residuals=residuals or None, local=local_idx,
+                    algo=algo, override=ov)
+
+                def open_writers() -> list:
+                    ws: list[bitrot.BitrotWriter | None] = [None] * n
+                    for i in stale:
+                        # healed shards keep the recorded algorithm
+                        if inline:
+                            sink = inline_sinks.setdefault(i, io.BytesIO())
+                            ws[i] = bitrot.BitrotWriter(
+                                sink, e.shard_size, algo=algo)
+                        else:
+                            fh = shard_disk[i].open_file_writer(
+                                SYSTEM_VOL,
+                                f"{TMP_DIR}/{tmp_ids[i]}/part.{part.number}",
+                                size_hint=bitrot.bitrot_shard_file_size(
+                                    till, e.shard_size, algo),
+                            )
+                            ws[i] = bitrot.BitrotWriter(
+                                fh, e.shard_size, algo=algo)
+                    return ws
+
+                def counted(scheme: str) -> list:
+                    def acct(nb: int, _s=scheme) -> None:
+                        read_acct.add(nb)
+                        repair_mod.add_read(_s, nb)
+                    return [None if r is None
+                            else repair_mod.CountingReader(r, algo, acct)
+                            for r in readers]
+
+                def discard_staging() -> None:
+                    # a failed heal must not leave per-uuid staged part
+                    # files behind (tmp/ has no reaper; MRF retries the
+                    # object, so a leak repeats per attempt)
+                    if inline:
+                        return
+                    for i in stale:
+                        try:
+                            shard_disk[i].delete(
+                                SYSTEM_VOL, f"{TMP_DIR}/{tmp_ids[i]}",
+                                recursive=True)
+                        except Exception:
+                            pass
+
+                def close_readers() -> None:
                     for r in readers:
                         if r is not None:
                             try:
                                 r.close()
                             except Exception:
                                 pass
+
+                done = False
+                if plan.scheme == "full":
+                    # the full decode needs k readable survivor streams;
+                    # prove that BEFORE staging tmp writers so a cleanly
+                    # unhealable object leaves nothing behind
+                    open_survivors(candidates)
+                    if sum(1 for r in readers if r) < e.k:
+                        result.failed = True
+                        close_readers()
+                        return result
+                writers = open_writers()
+                if plan.scheme == "subshard":
+                    # open ONLY the k helpers, positioned at the first
+                    # planned frame so the remote stream's create-time
+                    # fetch starts on useful bytes; ranged mode makes
+                    # later skips re-issue the RPC instead of draining
+                    fb = 0
+                    if plan.bad_blocks is not None \
+                            and plan.bad_blocks.any():
+                        fb = int(np.flatnonzero(plan.bad_blocks)[0])
+                    _, _hs = bitrot.hasher_of(algo)
+                    open_survivors(
+                        plan.helpers,
+                        at_frame=fb * (_hs + e.shard_size), ranged=True)
+                    tstreams: dict[int, object] = {}
+                    try:
+                        for i in stale:
+                            rm = residuals.get(i)
+                            if rm is None or not rm.good.any():
+                                continue
+                            try:
+                                tstreams[i] = shard_disk[i].read_file_stream(
+                                    bucket, part_path, 0, -1)
+                            except Exception:
+                                pass  # rebuilt entirely from helpers
+                        cr = counted("subshard")
+                        repair_mod.execute_subshard(
+                            e, plan,
+                            {h: cr[h] for h in plan.helpers},
+                            {i: writers[i] for i in stale},
+                            tstreams, on_scan=scan_acct.add)
+                        result.scheme = "subshard"
+                        done = True
+                    except repair_mod.SubshardAbort:
+                        # discard the partial staging, fall back to the
+                        # full-shard decode — heal always converges
+                        repair_mod.note_fallback()
+                        for i in stale:
+                            if writers[i] is not None and not inline:
+                                try:
+                                    writers[i].close()
+                                except Exception:
+                                    pass
+                        for h in plan.helpers:
+                            st = getattr(readers[h], "r", None)
+                            if st is not None and hasattr(st, "drain_max"):
+                                st.drain_max = getattr(
+                                    type(st), "_DRAIN_MAX", st.drain_max)
+                        writers = open_writers()
+                part_failed = False
+                try:
+                    if not done:
+                        open_survivors(candidates)
+                        if sum(1 for r in readers if r) < e.k:
+                            result.failed = True
+                            part_failed = True
+                            return result
+                        e.heal(writers, counted("full"), part.size)
+                except BaseException:
+                    part_failed = True
+                    raise
+                finally:
+                    for i in stale:
+                        if writers[i] is not None and not inline:
+                            try:
+                                writers[i].close()
+                            except Exception:
+                                pass
+                    close_readers()
+                    if part_failed:
+                        # after the writer closes: a remote writer's
+                        # close can flush, which would resurrect a file
+                        # deleted first
+                        discard_staging()
+            result.bytes_read = read_acct.n
+            result.bytes_scanned = scan_acct.n
 
             for i in stale:
                 d = shard_disk[i]

@@ -161,6 +161,17 @@ class HostRSCodec:
             return self._matmul_batch(np.asarray(mat), src, None)
         return self._matmul(mat, src)
 
+    def matmul(self, mat: np.ndarray, src: np.ndarray) -> np.ndarray:
+        """Apply an arbitrary (R, K) GF(2^8) matrix to (K, S) shards (or
+        batched (B, K, S) -> (B, R, S)).  The repair executor hands in
+        precomputed, LRU-cached dual-codeword rows (erasure/repair.py)
+        so no per-dispatch matrix construction happens here."""
+        mat = np.asarray(mat, dtype=np.uint8)
+        src = np.asarray(src, dtype=np.uint8)
+        if src.ndim == 3:
+            return self._matmul_batch(mat, src, None)
+        return self._matmul(mat, src)
+
 
 class HH256:
     """Streaming HighwayHash-256 (Go hash.Hash semantics)."""

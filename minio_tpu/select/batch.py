@@ -160,11 +160,19 @@ class _CsvBlock:
             self._bounds[j] = (cs, ce)
         return self._bounds[j]
 
+    # 10^f is an exact float64 for f <= 22; a <= 15-digit mantissa is
+    # exact in int64/float64, so fl(mantissa / 10^f) is the correctly-
+    # rounded value of the decimal — bit-identical to Python's float()
+    # (the Clinger/strtod fast path)
+    _POW10F = 10.0 ** np.arange(16)
+
     def nums(self, j: int):
-        """(float64 values, exact bool) for column j: clean [-]?digit
-        cells of <= 15 digits decode exactly through a right-aligned
-        digit matrix; everything else (floats, text, empties, huge
-        ints) is not-exact and takes the per-row path."""
+        """(float64 values, exact bool) for column j: clean
+        [-]?digits[.digits] cells totalling <= 15 digits decode exactly
+        through a right-aligned digit matrix (integer mantissa with the
+        decimal point squeezed out, divided by an exact power of ten);
+        everything else (exponents, text, empties, huge cells) is
+        not-exact and takes the per-row path."""
         if j in self._nums:
             return self._nums[j]
         cs, ce = self.bounds(j)
@@ -174,23 +182,41 @@ class _CsvBlock:
         has = w > 0
         idx0 = np.where(has, cs, 0)
         neg[has] = a[idx0[has]] == 45  # '-'
-        ds = cs + neg  # first digit
+        ds = cs + neg  # first digit or '.'
         dw = ce - ds
-        ok = has & (dw > 0) & (dw <= 15)
+        # up to 15 digits plus at most one '.'
+        ok = has & (dw > 0) & (dw <= 16)
         okw = dw[ok]
         maxw = int(okw.max()) if len(okw) else 0
         vals = np.zeros(self.n, dtype=np.float64)
         if maxw:
-            # right-aligned window: positions before the cell read as 0
+            # right-aligned window: positions before the cell read as
+            # '0' (so pad slots can never fake a '.')
             idx = ce[:, None] - maxw + np.arange(maxw)
             valid = idx >= ds[:, None]
-            digits = a[np.clip(idx, 0, len(a) - 1)].astype(np.int64) - 48
-            digits[~valid] = 0
-            bad_digit = ((digits < 0) | (digits > 9)) & valid
-            ok &= ~bad_digit.any(axis=1)
+            chars = a[np.clip(idx, 0, len(a) - 1)].astype(np.int64)
+            chars[~valid] = 48
+            isdot = chars == 46
+            digits = np.where(isdot, 0, chars - 48)
+            ok &= ~(((digits < 0) | (digits > 9)) & ~isdot).any(axis=1)
+            ndots = isdot.sum(axis=1)
+            ndigits = dw - ndots
+            ok &= (ndots <= 1) & (ndigits > 0) & (ndigits <= 15)
             pow10 = (10 ** np.arange(maxw - 1, -1, -1)).astype(np.int64)
-            ivals = digits @ pow10
-            vals = ivals.astype(np.float64)
+            base = digits @ pow10
+            hasdot = ndots > 0
+            if hasdot.any():
+                # squeeze the '.' out of the mantissa: digits left of
+                # the dot sit one slot too high in `base`, so subtract
+                # their contribution and re-add it shifted down a place
+                left = np.where(np.cumsum(isdot, axis=1) == 0,
+                                digits, 0) @ pow10
+                mant = np.where(hasdot, base - left + left // 10, base)
+                frac = np.where(
+                    hasdot & ok, maxw - 1 - isdot.argmax(axis=1), 0)
+                vals = mant.astype(np.float64) / self._POW10F[frac]
+            else:
+                vals = base.astype(np.float64)
             vals[neg] = -vals[neg]
         self._nums[j] = (vals, ok)
         return self._nums[j]
@@ -574,12 +600,18 @@ def _try_csv(req, query: Query, rw, object_size: int, out):
                         continue
                     vals, ok = blk.nums(j)
                     if (~ok & sel).any():
-                        # text/float/huge cells under the mask: SUM may
-                        # raise, MIN/MAX mixes _cmp_pair — interpreter
-                        raise _InterpBlock("non-integer aggregate cells")
+                        # text/exponent/huge cells under the mask: SUM
+                        # may raise, MIN/MAX mixes _cmp_pair — interp
+                        raise _InterpBlock("non-numeric aggregate cells")
                     sv = vals[sel]
                     if what == 1:
-                        if len(sv) and float(np.abs(sv).sum()) >= BIG:
+                        # fractional values sum order-dependently (numpy
+                        # pairwise vs the interpreter's sequential adds
+                        # can differ in the last ulp); integer-valued
+                        # floats below 2^53 are associative-exact
+                        if len(sv) and (
+                                (sv != np.floor(sv)).any()
+                                or float(np.abs(sv).sum()) >= BIG):
                             raise _InterpBlock("sum exactness")
                         results.append((fname, int(sel.sum()),
                                         float(sv.sum()) if len(sv)

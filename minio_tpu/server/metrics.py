@@ -301,6 +301,38 @@ class MetricsMixin:
         except Exception:
             pass
 
+        # repair planner/executor (erasure/repair.py): survivor bytes
+        # read per scheme is THE heal-bandwidth signal — sub-shard
+        # repair wins when its bytes_read stays well under full's for
+        # the same healed objects; fallbacks count aborted ranged
+        # repairs that converged via the full decode
+        try:
+            from minio_tpu.erasure import repair as repair_mod
+
+            rsnap = repair_mod.stats_snapshot()
+            rrows = ["# HELP minio_repair_bytes_read_total Survivor "
+                     "frame bytes read per repair scheme",
+                     "# TYPE minio_repair_bytes_read_total gauge"]
+            prows = ["# HELP minio_repair_plans_total Repair planner "
+                     "decisions per scheme",
+                     "# TYPE minio_repair_plans_total gauge"]
+            for scheme in ("full", "subshard"):
+                lbl = _fmt_labels(("scheme",), (scheme,))
+                rrows.append("minio_repair_bytes_read_total"
+                             f"{lbl} {rsnap[scheme]['bytes_read']}")
+                prows.append("minio_repair_plans_total"
+                             f"{lbl} {rsnap[scheme]['plans']}")
+            g("\n".join(rrows) + "\n")
+            g("\n".join(prows) + "\n")
+            gauge("minio_repair_fallbacks_total",
+                  "Sub-shard repairs aborted mid-flight and converged "
+                  "via the full-shard decode", rsnap["fallbacks"])
+            gauge("minio_repair_target_scan_bytes_total",
+                  "Target-shard bytes read by residual scans and "
+                  "executor re-verification", rsnap["target_scan_bytes"])
+        except Exception:
+            pass
+
         # deadline/overload plane: hedged shard reads, abandoned
         # stragglers, RPC budget expiries, per-drive deadline timeouts
         try:

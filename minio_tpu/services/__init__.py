@@ -55,6 +55,12 @@ class ServiceManager:
         self._resync_min_interval = float(
             os.environ.get("MINIO_TPU_RESYNC_MIN_INTERVAL", "60"))
         self._last_resync: dict = {}  # drive endpoint -> monotonic ts
+        self._resync_deferred: set = set()  # endpoints with a sweep queued
+        import threading as _threading
+        self._resync_mu = _threading.Lock()
+        # set by close(): wakes deferred re-sync waits so they exit
+        # instead of firing listings/enqueues against torn-down services
+        self._closing = _threading.Event()
         self._attach_heal_queue()
 
     def _attach_heal_queue(self) -> None:
@@ -85,15 +91,41 @@ class ServiceManager:
         import time as _time
 
         from minio_tpu.services.heal import _set_buckets
+        from minio_tpu.utils.deadline import service_thread
         from minio_tpu.utils.logger import log
 
+        if self._closing.is_set():
+            return
         try:
             ep = drive.endpoint()
         except Exception:
             ep = str(id(drive))
         now = _time.monotonic()
-        if now - self._last_resync.get(ep, -1e9) < self._resync_min_interval:
-            return  # flap storm: the previous sweep's heals still cover it
+        wait = self._resync_min_interval - \
+            (now - self._last_resync.get(ep, -1e9))
+        if wait > 0:
+            # Flap damping bounds the LISTING churn of a drive bouncing
+            # on a bad NIC — but a swallowed re-sync must still HAPPEN.
+            # on_online fires only on the offline->online transition, so
+            # dropping this call outright would leave writes that landed
+            # after the previous sweep unconverged forever (the cluster
+            # -boot probe race reliably consumed the damping budget just
+            # before a real recovery).  Defer one sweep per endpoint to
+            # the end of the window instead.
+            with self._resync_mu:
+                if ep in self._resync_deferred:
+                    return
+                self._resync_deferred.add(ep)
+
+            def _deferred():
+                if self._closing.wait(wait):
+                    return  # shutting down: drop, don't fire
+                with self._resync_mu:
+                    self._resync_deferred.discard(ep)
+                self._drive_reconnected(drive, es)
+
+            service_thread(_deferred, name="mrf-resync-defer")
+            return
         self._last_resync[ep] = now
         try:
             log.info("drive back online, MRF re-sync", endpoint=ep)
@@ -115,6 +147,7 @@ class ServiceManager:
         self.resync_objects += n
 
     def close(self) -> None:
+        self._closing.set()
         self.scanner.close()
         self.bg_heal.close()
         self.monitor.close()

@@ -9,6 +9,7 @@ tracker (cmd/background-newdisks-heal-ops.go).
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 import uuid
@@ -19,10 +20,23 @@ from minio_tpu.utils.deadline import service_thread
 from minio_tpu.storage.local import SYSTEM_VOL, HEALING_FILE
 
 
+def heal_bytes_budget() -> int:
+    """Survivor-bytes-read budget per heal sequence (0 = unlimited).
+    Repair reads are the hidden cost of a heal sweep on a busy cluster;
+    the planner's sub-shard reads make the budget go further, and the
+    budget caps how much drive/network read bandwidth one sequence may
+    consume before it parks (state `budget`), to be resumed by the next
+    background cycle."""
+    try:
+        return int(os.environ.get("MINIO_TPU_HEAL_BYTES_BUDGET", "0"))
+    except ValueError:
+        return 0
+
+
 @dataclass
 class HealSequenceStatus:
     heal_id: str = ""
-    state: str = "running"          # running | finished | stopped | failed
+    state: str = "running"   # running | finished | stopped | failed | budget
     bucket: str = ""
     prefix: str = ""
     start_time: float = 0.0
@@ -31,6 +45,12 @@ class HealSequenceStatus:
     objects_healed: int = 0
     objects_failed: int = 0
     bytes_healed: int = 0
+    # repair-planner accounting (erasure/repair.py via HealResult)
+    bytes_read: int = 0             # survivor frame bytes read
+    bytes_scanned: int = 0          # target residual-scan bytes
+    subshard_objects: int = 0       # objects healed via ranged repair
+    bytes_budget: int = 0           # 0 = unlimited
+    throttle_waits: int = 0         # brownout deferrals mid-sequence
     failed_items: list = field(default_factory=list)
 
     def to_dict(self) -> dict:
@@ -42,6 +62,11 @@ class HealSequenceStatus:
             "objectsHealed": self.objects_healed,
             "objectsFailed": self.objects_failed,
             "bytesHealed": self.bytes_healed,
+            "bytesRead": self.bytes_read,
+            "bytesScanned": self.bytes_scanned,
+            "subshardObjects": self.subshard_objects,
+            "bytesBudget": self.bytes_budget,
+            "throttleWaits": self.throttle_waits,
             "failedItems": self.failed_items[:64],
         }
 
@@ -50,14 +75,21 @@ class HealSequence:
     """One traversal healing every object under bucket/prefix."""
 
     def __init__(self, object_layer, bucket: str = "", prefix: str = "",
-                 deep: bool = False, remove_dangling: bool = False):
+                 deep: bool = False, remove_dangling: bool = False,
+                 throttle=None, bytes_budget: int | None = None):
         self.ol = object_layer
         self.status = HealSequenceStatus(
             heal_id=uuid.uuid4().hex, bucket=bucket, prefix=prefix,
             start_time=time.time(),
+            bytes_budget=(heal_bytes_budget() if bytes_budget is None
+                          else bytes_budget),
         )
         self.deep = deep
         self.remove_dangling = remove_dangling
+        # brownout hook: callable -> bool; False defers the NEXT object
+        # heal while foreground load is shedding (wired by the
+        # BackgroundHealer / ServiceManager)
+        self.throttle = throttle
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -86,12 +118,29 @@ class HealSequence:
                  for b in self.ol.list_buckets()]
         return [n for n in names if not n.startswith(".")]
 
+    def _throttle_wait(self) -> None:
+        """Brownout gate between object heals: while the controller says
+        foreground traffic owns the IOPs, park (bounded poll) instead of
+        issuing more repair reads."""
+        if self.throttle is None or self.throttle():
+            return
+        self.status.throttle_waits += 1
+        while not self._stop.is_set() and not self.throttle():
+            self._stop.wait(0.25)
+
+    def _over_budget(self) -> bool:
+        b = self.status.bytes_budget
+        return bool(b) and self.status.bytes_read >= b
+
     def _run(self) -> None:
         st = self.status
         try:
             for bucket in self._buckets():
                 if self._stop.is_set():
                     st.state = "stopped"
+                    break
+                if self._over_budget():
+                    st.state = "budget"
                     break
                 try:
                     names = self.ol.list_objects(bucket, prefix=st.prefix)
@@ -101,6 +150,12 @@ class HealSequence:
                     if self._stop.is_set():
                         st.state = "stopped"
                         break
+                    if self._over_budget():
+                        # read budget spent: park — the next background
+                        # cycle (or a fresh admin sequence) resumes
+                        st.state = "budget"
+                        break
+                    self._throttle_wait()
                     st.objects_scanned += 1
                     try:
                         res = self.ol.heal_object(bucket, name,
@@ -111,9 +166,15 @@ class HealSequence:
                         else:
                             st.objects_healed += 1
                             st.bytes_healed += getattr(res, "object_size", 0)
+                        st.bytes_read += getattr(res, "bytes_read", 0)
+                        st.bytes_scanned += getattr(res, "bytes_scanned", 0)
+                        if getattr(res, "scheme", "full") == "subshard":
+                            st.subshard_objects += 1
                     except Exception as ex:
                         st.objects_failed += 1
                         st.failed_items.append(f"{bucket}/{name}: {ex}")
+                if st.state not in ("running",):
+                    break
             if st.state == "running":
                 st.state = "finished"
         except Exception:
@@ -184,7 +245,10 @@ class BackgroundHealer:
         self._paused = False
 
     def heal_once(self) -> HealSequenceStatus:
-        seq = HealSequence(self.ol)
+        # the sequence inherits the brownout throttle (defers BETWEEN
+        # object heals, not just between sweeps) and the per-sequence
+        # survivor-bytes-read budget
+        seq = HealSequence(self.ol, throttle=self.throttle)
         self.last_status = seq.run_sync()
         self.cycles += 1
         return self.last_status

@@ -14,7 +14,13 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from minio_tpu.storage import errors as storage_errors
 from minio_tpu.utils.deadline import service_thread
+
+# heal failures that cannot heal themselves with time: the object (or
+# its bucket/version) is gone — requeueing these only burns drive IOPs
+_PERMANENT = (storage_errors.ObjectNotFound, storage_errors.BucketNotFound,
+              storage_errors.VersionNotFound, storage_errors.FileNotFound)
 
 
 @dataclass
@@ -37,6 +43,8 @@ class _HealTask:
     obj: str
     version_id: str = ""
     deep: bool = False
+    # requeue round (excluded from eq/hash so dedup spans rounds)
+    attempts: int = field(default=0, compare=False)
 
 
 class MRFQueue:
@@ -47,6 +55,13 @@ class MRFQueue:
     """
 
     MAX_PENDING = 10000  # reference: mrfOpsQueueSize (cmd/mrf.go:29)
+    # A task whose inner retries all fail re-enqueues with exponential
+    # backoff up to this many rounds before counting as failed.  The
+    # inner retries are 50 ms apart — far shorter than a recovering
+    # drive's settle window (breaker probe + RPC timeouts are seconds),
+    # so without the backoff rounds a re-sync racing a reconnect marks
+    # its heals failed forever and the drive never converges.
+    REQUEUE_MAX = 8
 
     def __init__(self, object_layer, delay: float = 0.05,
                  max_retries: int = 3):
@@ -59,6 +74,7 @@ class MRFQueue:
         self.throttle = None
         self._q: queue.Queue = queue.Queue(maxsize=self.MAX_PENDING)
         self._inflight: set[_HealTask] = set()
+        self._backlog: list[tuple[float, _HealTask]] = []  # (due, task)
         self._active = 0  # heals currently executing (for drain)
         self._mu = threading.Lock()
         # signaled whenever the queue may have drained (task finished or
@@ -92,6 +108,20 @@ class MRFQueue:
     # -- worker ------------------------------------------------------------
     def _run(self) -> None:
         while not self._stop.is_set():
+            now = time.monotonic()
+            with self._mu:
+                due = [t for ts, t in self._backlog if ts <= now]
+                if due:
+                    self._backlog = [(ts, t) for ts, t in self._backlog
+                                     if ts > now]
+            for t in due:
+                try:
+                    self._q.put_nowait(t)
+                except queue.Full:
+                    with self._idle:
+                        self._inflight.discard(t)
+                        self.stats.dropped += 1
+                        self._idle.notify_all()
             try:
                 t = self._q.get(timeout=0.2)
             except queue.Empty:
@@ -115,12 +145,21 @@ class MRFQueue:
             if self.delay:
                 time.sleep(self.delay)
             ok = False
-            for _ in range(self.max_retries):
+            permanent = False
+            # requeue rounds make ONE attempt each — the round spacing
+            # is the retry; only the first round keeps the fast inner
+            # retries (they paper over in-flight rename races)
+            tries = self.max_retries if t.attempts == 0 else 1
+            for _ in range(tries):
                 try:
                     res = self.ol.heal_object(t.bucket, t.obj,
                                               t.version_id,
                                               deep=t.deep)
                     ok = not getattr(res, "failed", False)
+                except _PERMANENT:
+                    ok = False
+                    permanent = True
+                    break
                 except Exception:
                     ok = False
                 if ok:
@@ -131,7 +170,21 @@ class MRFQueue:
                 if ok:
                     self.stats.healed += 1
                 else:
-                    self.stats.failed += 1
+                    nxt = _HealTask(t.bucket, t.obj, t.version_id,
+                                    t.deep, t.attempts + 1)
+                    if (not permanent
+                            and t.attempts + 1 < self.REQUEUE_MAX
+                            and not self._stop.is_set()
+                            and nxt not in self._inflight):
+                        # transient-looking failure (drive mid-reconnect,
+                        # peer restarting): back off and try again rather
+                        # than giving up forever after ~150 ms of retries
+                        self._inflight.add(nxt)
+                        self._backlog.append(
+                            (time.monotonic()
+                             + min(8.0, 0.25 * (2 ** t.attempts)), nxt))
+                    else:
+                        self.stats.failed += 1
                 self.stats.pending = self._q.qsize()
                 self._idle.notify_all()
 

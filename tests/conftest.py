@@ -122,6 +122,8 @@ _wire_sanitized_lib()
 # (0 disables).
 
 import signal  # noqa: E402
+import subprocess  # noqa: E402
+import sys  # noqa: E402
 import threading  # noqa: E402
 
 import pytest  # noqa: E402
@@ -138,6 +140,11 @@ def pytest_configure(config):
         "markers",
         "slow: excluded from tier-1 (`-m 'not slow'`); sanitizer "
         "replays, chaos drills, long benches")
+    config.addinivalue_line(
+        "markers",
+        "serial: latency-ceiling chaos drill; reordered to the END of "
+        "the session and run in a fresh isolated pytest subprocess "
+        "(no inherited background threads) — see conftest.py")
 
 
 @pytest.hookimpl(hookwrapper=True)
@@ -161,3 +168,63 @@ def pytest_runtest_call(item):
     finally:
         signal.setitimer(signal.ITIMER_REAL, 0)
         signal.signal(signal.SIGALRM, old)
+
+
+# ----------------------------------------------------------- serial drills
+# The latency-ceiling chaos drills (tests/test_overload.py overload
+# drill, tests/test_cli_integration.py chaos-healing cluster) measure
+# wall clock against real deadlines; inside a full tier-1 run they were
+# load-flaky: ~1400 earlier tests leave JIT caches, pool workers and
+# service threads competing for this container's few cores, and a 3.0 s
+# p99 ceiling loses to that noise a few percent of the time.  They
+# always passed 3/3 in isolation — so tier-1 now RUNS them in
+# isolation instead of documenting the flake: `serial`-marked items are
+# reordered to the very end of the session and each executes in a
+# fresh pytest subprocess (quiet interpreter, no inherited threads).
+# MINIO_TPU_SERIAL_CHILD guards recursion; MINIO_TPU_SERIAL_ISOLATION=0
+# restores in-process execution (debugging, pdb).
+
+def _serial_isolation_enabled() -> bool:
+    return os.environ.get("MINIO_TPU_SERIAL_ISOLATION", "1") != "0" \
+        and not os.environ.get("MINIO_TPU_SERIAL_CHILD")
+
+
+def _run_serial_isolated(item) -> None:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["MINIO_TPU_SERIAL_CHILD"] = "1"
+    # the child gets the whole remaining watchdog window minus a grace
+    # for its own interpreter+jax startup being included in the parent's
+    # SIGALRM budget
+    budget = _WATCHDOG_SECONDS - 15 if _WATCHDOG_SECONDS > 0 else 870
+    cmd = [sys.executable, "-m", "pytest", item.nodeid, "-q",
+           "-p", "no:cacheprovider"]
+    try:
+        proc = subprocess.run(cmd, cwd=repo, env=env, text=True,
+                              capture_output=True, timeout=max(60, budget))
+    except subprocess.TimeoutExpired as ex:
+        raise AssertionError(
+            f"serial-isolated run of {item.nodeid} timed out after "
+            f"{ex.timeout:.0f}s") from None
+    if proc.returncode != 0:
+        tail = "\n".join(
+            (proc.stdout + "\n" + proc.stderr).strip().splitlines()[-40:])
+        raise AssertionError(
+            f"serial-isolated run of {item.nodeid} failed "
+            f"(rc={proc.returncode}):\n{tail}")
+
+
+def pytest_collection_modifyitems(config, items):
+    if not _serial_isolation_enabled():
+        return
+    serial = [it for it in items
+              if it.get_closest_marker("serial") is not None]
+    if not serial:
+        return
+    rest = [it for it in items
+            if it.get_closest_marker("serial") is None]
+    items[:] = rest + serial
+    for it in serial:
+        # shadow Function.runtest on the instance: the call phase runs
+        # the drill in its own subprocess instead of in-process
+        it.runtest = (lambda _it=it: _run_serial_isolated(_it))

@@ -13,6 +13,12 @@ Modes:
   golden    — GF(2^8) encode/reconstruct golden vectors
               (cmd/erasure-coding.go self-test table) through the C
               matmul, plus the HighwayHash-256 reference self-test
+  repair    — repair-kernel golden vectors (erasure/repair.py): the
+              dual-codeword repair matrices applied through the C
+              GF(2^8) matmul (2-D and batched 3-D) across geometries
+              and multi-loss sets, pinned against
+              gf256.reconstruct_matrix, plus the executor's strided
+              frame-verify path over the batched HighwayHash kernel
   scanpool  — hammer the fused multi-threaded Select kernels (ScanPool
               in csrc/select_scan.cpp) from several Python threads at
               once: cross-thread block handoff under TSan
@@ -142,6 +148,86 @@ def mode_golden() -> None:
     sys.exit(1 if failures else 0)
 
 
+def mode_repair() -> None:
+    import numpy as np
+
+    from minio_tpu.erasure import bitrot, repair as repair_mod
+    from minio_tpu.ops import gf256, host
+
+    if not host.available():
+        print("san_replay: host library unavailable", file=sys.stderr)
+        sys.exit(3)
+    failures = 0
+    payload = bytes(range(256)) * 64  # 16 KiB, deterministic
+    cases = 0
+    for k in (2, 4, 8):
+        for m in (1, 2, 4):
+            shards = np.stack(gf256.encode_data_np(payload, k, m))
+            codec = host.HostRSCodec(k, m)
+            n = k + m
+            loss_sets = [(0,), (n - 1,)]
+            if m >= 2:
+                loss_sets.append((1, n - 1))
+            if m >= 4:
+                loss_sets.append((0, 2, k, n - 1))
+            for lost in loss_sets:
+                surv = [i for i in range(n) if i not in lost]
+                # two helper selections: data-heavy and parity-heavy
+                for helpers in ({tuple(sorted(surv[:k])),
+                                 tuple(sorted(surv[-k:]))}):
+                    cases += 1
+                    mat = repair_mod.repair_matrix(k, m, helpers, lost)
+                    ref = gf256.reconstruct_matrix(k, m, helpers, lost)
+                    if not np.array_equal(mat, ref):
+                        failures += 1
+                        print(f"repair_matrix != reconstruct_matrix "
+                              f"{k}+{m} lost={lost} helpers={helpers}",
+                              file=sys.stderr)
+                    src = np.stack([shards[i] for i in helpers])
+                    rebuilt = codec.matmul(mat, src)   # sanitized C matmul
+                    want = np.stack([shards[i] for i in lost])
+                    if not np.array_equal(rebuilt, want):
+                        failures += 1
+                        print(f"repair matmul mismatch {k}+{m} "
+                              f"lost={lost} helpers={helpers}",
+                              file=sys.stderr)
+                    # batched 3-D dispatch (the executor's block-group
+                    # shape): B block batches through the same matrix
+                    cols = src.reshape(k, 8, -1).transpose(1, 0, 2)
+                    got3 = codec.matmul(mat, np.ascontiguousarray(cols))
+                    want3 = want.reshape(len(lost), 8, -1) \
+                        .transpose(1, 0, 2)
+                    if not np.array_equal(got3, want3):
+                        failures += 1
+                        print(f"batched repair matmul mismatch {k}+{m} "
+                              f"lost={lost}", file=sys.stderr)
+
+    # the executor's frame re-verify: strided [hash|payload] rows through
+    # hh256_batch (a non-contiguous payload view is exactly what
+    # _verify_frames hands the C kernel)
+    algo = bitrot.DEFAULT_ALGO
+    _, hsize = bitrot.hasher_of(algo)
+    blen = 1024
+    g = 32
+    frames = np.zeros((g, hsize + blen), dtype=np.uint8)
+    for i in range(g):
+        block = bytes((i + j) & 0xFF for j in range(blen))
+        frames[i, hsize:] = np.frombuffer(block, dtype=np.uint8)
+        frames[i, :hsize] = np.frombuffer(
+            bitrot.hasher_of(algo)[0](block), dtype=np.uint8)
+    corrupt = [3, 17, 31]
+    for i in corrupt:
+        frames[i, hsize + 5] ^= 0xA5
+    goodmask = repair_mod._verify_frames(frames, hsize, algo)
+    want_mask = np.array([i not in corrupt for i in range(g)])
+    if not np.array_equal(goodmask, want_mask):
+        failures += 1
+        print("frame re-verify mask mismatch", file=sys.stderr)
+
+    print(f"san_replay repair: {cases} matrix cases, {failures} failures")
+    sys.exit(1 if failures else 0)
+
+
 def mode_scanpool() -> None:
     import threading
 
@@ -187,4 +273,5 @@ if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else "select"
     {"select": mode_select,
      "golden": mode_golden,
+     "repair": mode_repair,
      "scanpool": mode_scanpool}[mode]()

@@ -6,12 +6,17 @@ tests/san_replay.py replays the full 512-case corpus through the
 sanitizer-instrumented kernels (ASan/UBSan builds from csrc/Makefile).
 Keeping the generators here means the corpora cannot drift apart.
 
-Four families x 128 seeds = 512 cases:
+Five families x 128 seeds = 640 cases:
   csv          — clean/garbage/unicode/ragged CSV cells
   json         — typed JSON lines (nulls, bools, bigints, nesting)
   csv_quoted   — doubled quotes, embedded delimiters/newlines, quoted/
                  unquoted block transitions (fused-kernel handoff)
   json_escape  — escape-heavy strings, nested docs, blank lines
+  csv_decimal  — decimal-heavy cells vs numeric predicates/aggregates:
+                 the batch tier's exact digit-matrix decimal decode
+                 (ISSUE 6 satellite, carried since PR 2) must be
+                 bit-identical to float(); exponents, >15-digit and
+                 malformed shapes must drop to the per-row path
 """
 
 from __future__ import annotations
@@ -23,6 +28,7 @@ CSV_SEEDS = range(0, 128)
 JSON_SEEDS = range(10_000, 10_128)
 CSV_QUOTED_SEEDS = range(20_000, 20_128)
 JSON_ESCAPE_SEEDS = range(30_000, 30_128)
+CSV_DECIMAL_SEEDS = range(40_000, 40_128)
 
 _CELLS = ["", "0", "5", "500", "-3", "3.14", " 5", "5_0", "inf",
           "abc", "café", "HELLO", "  pad  ", "1e3", ".5", "+7",
@@ -143,13 +149,67 @@ def json_escape_case(seed: int):
     return (expr, data) + _JSON_IO
 
 
+# decimal shapes: exact fast-path candidates, carry/edge cases around
+# the 15-digit mantissa limit, fast-path-ineligible shapes (exponents,
+# double dots, signs/spaces inside, huge digit counts), and text noise
+_DECIMAL_CELLS = [
+    "0", "5", "500", "-3", "3.14", "0.25", "-0.125", ".5", "5.",
+    "00.50", "-0.0", "2.0", "123456.789", "0.1", "-.25", "12.",
+    "999999999999999", "1.23456789012345", "0.000000000000001",
+    "9999999999999999.9", "99999999999999999999.9", "1e3", "-1.5e2",
+    "1..2", "1.2.3", "", "abc", " 1.5", "1.5 ", "+7.5", "-", ".",
+    "3,14", "0.5000000000000001", "2.675",
+]
+
+
+def csv_decimal_case(seed: int):
+    rng = random.Random(seed)
+    lines = ["a,b,c"]
+    for _ in range(rng.randrange(1, 40)):
+        vals = []
+        for _ in range(rng.choice([3, 3, 3, 2, 4])):
+            v = rng.choice(_DECIMAL_CELLS)
+            if any(ch in v for ch in ',"\r\n'):
+                v = '"' + v.replace('"', '""') + '"'
+            vals.append(v)
+        lines.append(",".join(vals))
+    data = ("\n".join(lines) + "\n").encode()
+    col = rng.choice(["a", "b", "c"])
+    kind = rng.randrange(7)
+    if kind == 0:
+        lit = rng.choice(["0.25", "3.14", "-0.125", "0.5", "2.675",
+                          "5", "0.0"])
+        expr = (f"SELECT COUNT(*) FROM s3object WHERE {col} "
+                f"{rng.choice(_OPS)} {lit}")
+    elif kind == 1:
+        neg = "NOT " if rng.random() < .5 else ""
+        expr = (f"SELECT COUNT(*) FROM s3object WHERE {col} "
+                f"{neg}BETWEEN -0.5 AND 100.25")
+    elif kind == 2:
+        expr = (f"SELECT COUNT({col}), MIN({col}), MAX({col}) "
+                "FROM s3object")
+    elif kind == 3:
+        expr = f"SELECT SUM({col}) FROM s3object"
+    elif kind == 4:
+        expr = (f"SELECT COUNT(*) FROM s3object WHERE {col} "
+                "IN (0.25, '.5', 5, 3.14)")
+    elif kind == 5:
+        expr = (f"SELECT a, c FROM s3object WHERE {col} "
+                f"{rng.choice(_OPS)} 2.5 LIMIT {rng.randrange(1, 8)}")
+    else:
+        expr = (f"SELECT AVG({col}) FROM s3object WHERE {col} "
+                f"{rng.choice(_OPS)} 0.125")
+    return (expr, data) + _CSV_IO
+
+
 def corpus():
-    """Yield (family, seed, expr, data, inp, out) for all 512 cases."""
+    """Yield (family, seed, expr, data, inp, out) for all 640 cases."""
     for family, seeds, gen in (
             ("csv", CSV_SEEDS, csv_case),
             ("json", JSON_SEEDS, json_case),
             ("csv_quoted", CSV_QUOTED_SEEDS, csv_quoted_case),
-            ("json_escape", JSON_ESCAPE_SEEDS, json_escape_case)):
+            ("json_escape", JSON_ESCAPE_SEEDS, json_escape_case),
+            ("csv_decimal", CSV_DECIMAL_SEEDS, csv_decimal_case)):
         for seed in seeds:
             expr, data, inp, out = gen(seed)
             yield family, seed, expr, data, inp, out

@@ -237,6 +237,7 @@ class TestDistributedCLI:
             _stop(n2)
 
 
+@pytest.mark.serial
 class TestChaosHealingCLI:
     """BASELINE config 5 analogue of buildscripts/verify-healing.sh
     (Makefile:63-71): boot a REAL multi-node subprocess cluster, kill
@@ -245,6 +246,11 @@ class TestChaosHealingCLI:
 
     Fast-fault env: chaos RPC hook enabled, short RPC deadlines, breaker
     threshold 2, sub-second reconnect probe and drive monitor.
+
+    `serial`: breaker/probe/heal convergence races real sub-second
+    deadlines; conftest runs these drills last, each in an isolated
+    subprocess, so concurrent-load noise from the rest of tier-1
+    cannot flake them.
     """
 
     CHAOS_ENV = {
@@ -254,6 +260,11 @@ class TestChaosHealingCLI:
         "MINIO_TPU_BREAKER_THRESHOLD": "2",
         "MINIO_TPU_PROBE_INTERVAL": "0.25",
         "MINIO_TPU_MONITOR_INTERVAL": "1",
+        # boot-time probe flaps consume the resync damping budget just
+        # before the drill's real recovery; the deferred re-sync sweep
+        # then fires at the end of this window — keep it short so
+        # convergence stays well inside the wait ceilings
+        "MINIO_TPU_RESYNC_MIN_INTERVAL": "5",
     }
 
     def _boot_cluster(self, tmp_path, n_nodes, drives_per_node):
@@ -432,16 +443,20 @@ class TestChaosHealingCLI:
                      and f":{ports[1]}" in d.get("endpoint", "")]
                 return bool(h) and h[0]["online"]
 
-            self._wait_for(back_online, 30,
+            self._wait_for(back_online, 60,
                            "probe never restored the hung drive")
 
-            # MRF re-sync converges the missed shards onto the drive
+            # MRF re-sync converges the missed shards onto the drive.
+            # Generous ceiling: convergence needs a probe round + an MRF
+            # sweep + cross-node heals, and on a noisy shared box the
+            # usual ~40 s can stretch well past it (the poll returns the
+            # moment the drive converges, so a fast box pays nothing).
             def resynced():
                 return all(os.path.exists(
                     f"{hung_drive}/hungbkt/{name}/xl.meta")
                     for name in objs)
 
-            self._wait_for(resynced, 45,
+            self._wait_for(resynced, 150,
                            "MRF re-sync never healed missed writes")
             # everything reads back intact through the other node
             for name, data in objs.items():

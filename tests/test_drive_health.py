@@ -384,3 +384,45 @@ class TestMrfResyncOnReconnect:
             assert b"".join(stream) == data1
         finally:
             svcs.close()
+
+    def test_damped_resync_defers_instead_of_dropping(self, tmp_path,
+                                                      monkeypatch):
+        """Flap damping must DEFER a swallowed re-sync, not drop it:
+        on_online fires only on the offline->online transition, so a
+        recovery landing inside the damping window (e.g. right after the
+        cluster-boot probe race consumed the budget) would otherwise
+        never converge."""
+        from minio_tpu.services import ServiceManager
+
+        monkeypatch.setenv("MINIO_TPU_FSYNC", "0")
+        monkeypatch.setenv("MINIO_TPU_RESYNC_MIN_INTERVAL", "1.0")
+        disks = [InstrumentedStorage(
+            ChaosDisk(LocalStorage(str(tmp_path / f"d{i}"))),
+            breaker_threshold=2) for i in range(4)]
+        pools = ErasureServerPools([ErasureSets(disks)])
+        svcs = ServiceManager(pools, scan_interval=3600,
+                              heal_interval=3600, monitor_interval=3600)
+        try:
+            pools.make_bucket("bkt")
+            data = os.urandom(200_000)
+            pools.put_object("bkt", "o", io.BytesIO(data), len(data),
+                             PutObjectOptions())
+            es = pools.pools[0].sets[0]
+            # first reconnect consumes the damping budget
+            svcs._drive_reconnected(disks[3], es)
+            assert svcs.drive_resyncs == 1
+            base = svcs.mrf.stats.enqueued
+            # a second reconnect inside the window: swallowed but DEFERRED
+            svcs._drive_reconnected(disks[3], es)
+            assert svcs.drive_resyncs == 1  # not run inline
+            # further reconnects inside the window coalesce into the one
+            # deferred sweep
+            svcs._drive_reconnected(disks[3], es)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and svcs.drive_resyncs < 2:
+                time.sleep(0.05)
+            assert svcs.drive_resyncs == 2, \
+                "damped re-sync was dropped, never deferred"
+            assert svcs.mrf.stats.enqueued > base
+        finally:
+            svcs.close()

@@ -309,11 +309,16 @@ class TestAdmissionControl:
             srv.close()
 
 
+@pytest.mark.serial
 class TestOverloadDrill:
     """The ISSUE 3 acceptance drill: 4 of 8 drives at +500 ms under 4x
     oversubscription — hedged reads keep served-GET p99 inside the
     deadline, excess load sheds 503 SlowDown before the deadline,
-    brownout engages then releases, and no thread leaks."""
+    brownout engages then releases, and no thread leaks.
+
+    `serial`: the 3.0 s p99 ceiling is a wall-clock assertion; conftest
+    runs this drill last, in an isolated subprocess, so concurrent-load
+    noise from the rest of tier-1 cannot flake it."""
 
     DEADLINE_S = 3.0
 

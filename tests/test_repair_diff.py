@@ -1,0 +1,466 @@
+"""Differential suite for the bandwidth-optimal repair subsystem
+(erasure/repair.py, ISSUE 6).
+
+Pins three contracts:
+
+* the dual-codeword repair matrix is byte-equivalent to the Gauss-Jordan
+  reconstruct matrix for every legal geometry (the closed form from
+  "Efficient erasure decoding of Reed-Solomon codes", arxiv 0901.1886,
+  must agree with klauspost-style inversion bit for bit);
+* sub-shard repair heals shard files BYTE-IDENTICAL to the full-shard
+  decode across geometries, unaligned sizes and multi-loss cases, with
+  ``MINIO_TPU_REPAIR_SCHEME=full`` keeping the legacy path selectable;
+* any mid-repair failure (a survivor dying between ranged reads) falls
+  back to the full decode and heal still converges.
+"""
+
+import glob
+import io
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from minio_tpu.erasure import repair
+from minio_tpu.erasure.coding import Erasure
+from minio_tpu.erasure.objects import ErasureObjects, PutObjectOptions
+from minio_tpu.ops import gf256
+from minio_tpu.storage import errors
+from minio_tpu.storage.local import LocalStorage
+from minio_tpu.storage.naughty import ChaosDisk
+
+HSIZE = 32  # HighwayHash-256 frame hash
+
+
+def _rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+# ---------------------------------------------------------------- matrices
+
+
+class TestRepairMatrix:
+    @pytest.mark.parametrize("k", range(2, 9))
+    @pytest.mark.parametrize("m", range(1, 5))
+    def test_matches_gauss_jordan_reconstruct(self, k, m):
+        """The Lagrange dual-codeword rows rebuild EXACTLY what the
+        inversion-based reconstruct matrix rebuilds, for data and parity
+        targets alike, from every choice of k helpers."""
+        n = k + m
+        rng = _rng(k * 31 + m)
+        shards = np.stack(gf256.encode_data_np(
+            rng.integers(0, 256, 64 * k, dtype=np.uint8).tobytes(), k, m))
+        for trial in range(6):
+            lost_count = 1 + trial % min(m, n - k)
+            lost = tuple(sorted(
+                rng.choice(n, size=lost_count, replace=False).tolist()))
+            surv = [i for i in range(n) if i not in lost]
+            helpers = tuple(sorted(
+                rng.choice(surv, size=k, replace=False).tolist()))
+            mat = repair.repair_matrix(k, m, helpers, lost)
+            src = shards[list(helpers)]
+            got = np.zeros((len(lost), shards.shape[1]), dtype=np.uint8)
+            for t in range(len(lost)):
+                acc = np.zeros(shards.shape[1], dtype=np.uint8)
+                for c, h in enumerate(helpers):
+                    coef = int(mat[t, c])
+                    if coef:
+                        acc ^= gf256.MUL_TABLE[coef, src[c]]
+                got[t] = acc
+            for t, j in enumerate(lost):
+                assert np.array_equal(got[t], shards[j]), \
+                    f"k={k} m={m} helpers={helpers} lost={j}"
+
+    def test_cache_hit_returns_same_object(self):
+        a = repair.repair_matrix(4, 2, (0, 1, 2, 3), (4,))
+        b = repair.repair_matrix(4, 2, (0, 1, 2, 3), (4,))
+        assert a is b
+        assert not a.flags.writeable
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            repair.repair_matrix(4, 2, (0, 1, 2), (4,))     # too few
+        with pytest.raises(ValueError):
+            repair.repair_matrix(4, 2, (0, 1, 2, 4), (4,))  # overlap
+        with pytest.raises(ValueError):
+            repair.repair_matrix(4, 2, (0, 1, 2, 9), (5,))  # out of range
+
+
+# ------------------------------------------------------------ residual scan
+
+
+def _frames(payload: bytes, shard_size: int) -> bytes:
+    """Build a hash-interleaved shard file like BitrotWriter."""
+    from minio_tpu.ops import host
+
+    out = bytearray()
+    for off in range(0, len(payload), shard_size):
+        block = payload[off:off + shard_size]
+        out += host.hh256(block) + block
+    return bytes(out)
+
+
+class TestScanResidual:
+    SS = 4096
+
+    def test_classifies_damage_exactly(self):
+        payload = _rng(1).integers(0, 256, self.SS * 5 + 100,
+                                   dtype=np.uint8).tobytes()
+        raw = bytearray(_frames(payload, self.SS))
+        # corrupt payload byte of blocks 1 and 3
+        for bi in (1, 3):
+            raw[bi * (HSIZE + self.SS) + HSIZE + 9] ^= 0x55
+        rm = repair.scan_residual(io.BytesIO(bytes(raw)), len(payload),
+                                  self.SS)
+        assert rm.nblocks == 6
+        assert rm.good.tolist() == [True, False, True, False, True, True]
+        assert 0 < rm.bad_fraction < 1
+
+    def test_truncation_marks_tail_bad(self):
+        payload = b"x" * (self.SS * 4)
+        raw = _frames(payload, self.SS)
+        rm = repair.scan_residual(
+            io.BytesIO(raw[: 2 * (HSIZE + self.SS) + 100]),
+            len(payload), self.SS)
+        assert rm.good.tolist() == [True, True, False, False]
+
+    def test_read_error_marks_rest_bad(self):
+        payload = b"y" * (self.SS * 3)
+        raw = _frames(payload, self.SS)
+
+        class Dies(io.RawIOBase):
+            def __init__(self):
+                self.pos = 0
+
+            def read(self, n=-1):
+                if self.pos >= HSIZE + TestScanResidual.SS:
+                    raise OSError("drive error")
+                # at most one frame per call so the error fires mid-scan
+                n = min(n, HSIZE + TestScanResidual.SS)
+                chunk = raw[self.pos: self.pos + n]
+                self.pos += len(chunk)
+                return chunk
+
+        rm = repair.scan_residual(Dies(), len(payload), self.SS)
+        assert rm.good.tolist() == [True, False, False]
+
+
+# ------------------------------------------------------- e2e heal plumbing
+
+
+def _make_layer(tmp_path, n, parity, chaos=False):
+    raw = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(n)]
+    disks = [ChaosDisk(d) for d in raw] if chaos else raw
+    for d in disks:
+        d.make_volume("bkt")
+    return ErasureObjects(disks, default_parity=parity), disks
+
+
+def _put(ol, name, size, seed=0):
+    data = _rng(seed).integers(0, 256, size, dtype=np.uint8).tobytes()
+    ol.put_object("bkt", name, io.BytesIO(data), len(data),
+                  PutObjectOptions())
+    return data
+
+
+def _shard_files(tmp_path, drive_idx):
+    return sorted(glob.glob(
+        str(tmp_path / f"d{drive_idx}" / "bkt" / "**" / "part.*"),
+        recursive=True))
+
+
+def _snapshot(paths):
+    return {p: open(p, "rb").read() for p in paths}
+
+
+def _corrupt_frames(path, frame, which, xor=0xA5):
+    buf = bytearray(open(path, "rb").read())
+    nframes = max(1, len(buf) // frame) or 1
+    for bi in which:
+        if bi * frame + HSIZE < len(buf):
+            off = min(bi * frame + HSIZE + 3, len(buf) - 1)
+            buf[off] ^= xor
+    with open(path, "wb") as f:
+        f.write(bytes(buf))
+    return nframes
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    monkeypatch.delenv("MINIO_TPU_REPAIR_SCHEME", raising=False)
+    repair.reset_stats()
+    yield
+
+
+class TestSubshardDiff:
+    """Sub-shard repair output byte-identical to the full-shard decode."""
+
+    @pytest.mark.parametrize("k,m", [(2, 1), (3, 2), (4, 4), (5, 3),
+                                     (8, 4)])
+    def test_geometries_byte_identical(self, tmp_path, monkeypatch, k, m):
+        ol, _ = _make_layer(tmp_path, k + m, m)
+        e = Erasure(k, m)
+        frame = HSIZE + e.shard_size
+        # unaligned: one full block + a ragged tail
+        size = (1 << 20) + 137 * k
+        _put(ol, "obj", size, seed=k * 7 + m)
+        files = _shard_files(tmp_path, 1)
+        assert files
+        pristine = _snapshot(files)
+
+        # damage one frame per file, heal via the planner
+        for p in files:
+            _corrupt_frames(p, frame, (0,))
+        res = ol.heal_object("bkt", "obj", deep=True)
+        assert not res.failed and res.healed_drives == 1
+        assert res.scheme == "subshard", res.scheme
+        assert _snapshot(files) == pristine, "sub-shard heal diverged"
+
+        # identical damage through the LEGACY path must converge to the
+        # same bytes (the differential pin)
+        for p in files:
+            _corrupt_frames(p, frame, (0,))
+        monkeypatch.setenv("MINIO_TPU_REPAIR_SCHEME", "full")
+        res2 = ol.heal_object("bkt", "obj", deep=True)
+        assert not res2.failed and res2.scheme == "full"
+        assert _snapshot(files) == pristine
+        # sub-shard read strictly fewer survivor bytes for one bad frame
+        assert res.bytes_read < res2.bytes_read
+
+    @pytest.mark.parametrize("size", [
+        (128 << 10) + 1,          # just above inline: single short block
+        (1 << 20) - 7,            # one byte-ragged block
+        2 * (1 << 20) + 13,       # multi-block + tail
+    ])
+    def test_unaligned_sizes(self, tmp_path, size):
+        ol, _ = _make_layer(tmp_path, 6, 2)
+        data = _put(ol, "obj", size, seed=size & 0xFFFF)
+        files = _shard_files(tmp_path, 2)
+        assert files
+        pristine = _snapshot(files)
+        e = Erasure(4, 2)
+        for p in files:
+            nf = max(1, len(pristine[p]) // (HSIZE + e.shard_size))
+            _corrupt_frames(p, HSIZE + e.shard_size, (nf - 1,))
+        res = ol.heal_object("bkt", "obj", deep=True)
+        assert not res.failed
+        assert _snapshot(files) == pristine
+        _, it = ol.get_object("bkt", "obj")
+        assert b"".join(bytes(c) for c in it) == data
+
+    def test_multi_loss_two_partial_drives(self, tmp_path):
+        """Two targets with DIFFERENT damaged frames: the union-bad
+        columns take one k-wide ranged read serving both rebuilds."""
+        ol, _ = _make_layer(tmp_path, 12, 4)
+        _put(ol, "obj", 4 << 20, seed=5)
+        e = Erasure(8, 4)
+        frame = HSIZE + e.shard_size
+        f_a = _shard_files(tmp_path, 0)
+        f_b = _shard_files(tmp_path, 7)
+        assert f_a and f_b
+        pristine = _snapshot(f_a + f_b)
+        _corrupt_frames(f_a[0], frame, (0,))
+        _corrupt_frames(f_b[0], frame, (2,))
+        res = ol.heal_object("bkt", "obj", deep=True)
+        assert not res.failed and res.healed_drives == 2
+        assert res.scheme == "subshard"
+        assert _snapshot(f_a + f_b) == pristine
+
+    def test_partial_plus_wiped_converges_full(self, tmp_path):
+        """A wiped co-loss makes every column union-bad: the planner
+        correctly prices sub-shard at no win and takes the full decode —
+        still byte-identical."""
+        ol, _ = _make_layer(tmp_path, 12, 4)
+        _put(ol, "obj", 2 << 20, seed=6)
+        e = Erasure(8, 4)
+        f_a = _shard_files(tmp_path, 1)
+        f_b = _shard_files(tmp_path, 6)
+        pristine = _snapshot(f_a + f_b)
+        _corrupt_frames(f_a[0], HSIZE + e.shard_size, (1,))
+        shutil.rmtree(tmp_path / "d6" / "bkt" / "obj")
+        res = ol.heal_object("bkt", "obj", deep=True)
+        assert not res.failed and res.healed_drives == 2
+        assert res.scheme == "full"
+        assert _snapshot(f_a + f_b) == pristine
+
+    def test_forced_subshard_on_wiped_drive(self, tmp_path, monkeypatch):
+        """MINIO_TPU_REPAIR_SCHEME=subshard degenerates to an all-bad
+        ranged plan on a wiped drive — byte-identical, no savings."""
+        ol, _ = _make_layer(tmp_path, 6, 2)
+        _put(ol, "obj", 1 << 20, seed=8)
+        files = _shard_files(tmp_path, 3)
+        pristine = _snapshot(files)
+        shutil.rmtree(tmp_path / "d3" / "bkt" / "obj")
+        monkeypatch.setenv("MINIO_TPU_REPAIR_SCHEME", "subshard")
+        res = ol.heal_object("bkt", "obj")
+        assert not res.failed and res.scheme == "subshard"
+        assert _snapshot(files) == pristine
+
+    def test_inline_objects_stay_full(self, tmp_path):
+        """Inline shards live in xl.meta: no drive bytes to save, the
+        planner never routes them through the ranged executor."""
+        ol, disks = _make_layer(tmp_path, 6, 2)
+        _put(ol, "tiny", 4096, seed=9)
+        # drop one drive's xl.meta
+        metas = glob.glob(str(tmp_path / "d4" / "bkt" / "tiny" /
+                              "xl.meta"))
+        assert metas
+        os.unlink(metas[0])
+        res = ol.heal_object("bkt", "tiny")
+        assert not res.failed and res.scheme == "full"
+        assert res.healed_drives == 1
+
+    def test_stats_and_heal_result_accounting(self, tmp_path):
+        ol, _ = _make_layer(tmp_path, 12, 4)
+        _put(ol, "obj", 8 << 20, seed=10)
+        e = Erasure(8, 4)
+        files = _shard_files(tmp_path, 5)
+        _corrupt_frames(files[0], HSIZE + e.shard_size, (0,))
+        repair.reset_stats()
+        res = ol.heal_object("bkt", "obj", deep=True)
+        snap = repair.stats_snapshot()
+        assert res.scheme == "subshard"
+        assert snap["subshard"]["plans"] == 1
+        assert snap["subshard"]["bytes_read"] == res.bytes_read > 0
+        assert res.bytes_scanned > 0
+        # 1 of 8 blocks bad: ranged read is 1/8 of the 8-full-shard read
+        nblocks = (8 << 20) // (1 << 20)
+        full_frame_bytes = 8 * (e.shard_file_size(8 << 20)
+                                + nblocks * HSIZE)
+        assert res.bytes_read == full_frame_bytes // nblocks
+
+
+# ----------------------------------------------------------- chaos drill
+
+
+class _DyingStream:
+    """Read stream that serves `allow` reads, then kills its drive and
+    raises — a survivor dying between ranged repair reads."""
+
+    def __init__(self, inner, chaos, allow, counter):
+        self._inner = inner
+        self._chaos = chaos
+        self._allow = allow
+        self._counter = counter
+
+    def _gate(self):
+        self._counter[0] += 1
+        if self._counter[0] > self._allow:
+            self._chaos.lose()
+            raise errors.DiskNotFound("chaos: survivor died mid-repair")
+
+    def read(self, n=-1):
+        self._gate()
+        return self._inner.read(n)
+
+    def readinto(self, b):
+        self._gate()
+        return self._inner.readinto(b)
+
+    def seek(self, *a, **kw):
+        return self._inner.seek(*a, **kw)
+
+    def close(self):
+        return self._inner.close()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class TestChaosFallback:
+    def test_survivor_dies_mid_repair_falls_back_and_converges(
+            self, tmp_path):
+        """ISSUE 6 drill: a helper drive dies BETWEEN ranged reads of a
+        sub-shard repair.  The executor aborts, the planner's fallback
+        reruns the full-shard decode (work-stealing around the dead
+        drive via parity spares), and heal still converges to
+        byte-identical shards."""
+        ol, disks = _make_layer(tmp_path, 12, 4, chaos=True)
+        data = _put(ol, "obj", 8 << 20, seed=12)
+        e = Erasure(8, 4)
+        frame = HSIZE + e.shard_size
+
+        victim_files = _shard_files(tmp_path, 9)
+        assert victim_files
+        pristine = _snapshot(victim_files)
+        # several NON-adjacent bad blocks -> several ranged runs, so the
+        # dying helper is hit more than once within the repair
+        _corrupt_frames(victim_files[0], frame, (0, 3, 6))
+
+        # arm one OTHER drive: first stream it opens after arming dies
+        # on its 2nd read (mid-repair, after one successful ranged read)
+        helper = disks[2]
+        counter = [0]
+        orig_open = helper.read_file_stream
+
+        def dying_open(volume, path, offset, length):
+            st = orig_open(volume, path, offset, length)
+            if "part." in path:
+                return _DyingStream(st, helper, 1, counter)
+            return st
+
+        helper.read_file_stream = dying_open
+        repair.reset_stats()
+        try:
+            res = ol.heal_object("bkt", "obj", deep=True)
+        finally:
+            helper.read_file_stream = orig_open
+            helper.restore()
+
+        snap = repair.stats_snapshot()
+        # the ranged attempt ran and aborted ...
+        assert snap["fallbacks"] >= 1, snap
+        # ... the full fallback converged
+        assert not res.failed and res.healed_drives == 1
+        assert res.scheme == "full"
+        assert _snapshot(victim_files) == pristine
+        _, it = ol.get_object("bkt", "obj")
+        assert b"".join(bytes(c) for c in it) == data
+
+
+# ------------------------------------------------- heal-sequence plumbing
+
+
+class TestHealSequenceBudget:
+    def test_bytes_budget_parks_sequence(self, tmp_path):
+        from minio_tpu.services.heal import HealSequence
+
+        ol, _ = _make_layer(tmp_path, 6, 2)
+        e = Erasure(4, 2)
+        for i in range(3):
+            _put(ol, f"o{i}", 1 << 20, seed=20 + i)
+        for i in range(3):
+            files = _shard_files(tmp_path, 0)
+            for p in files:
+                _corrupt_frames(p, HSIZE + e.shard_size, (0,))
+        seq = HealSequence(ol, bucket="bkt", deep=True, bytes_budget=1)
+        st = seq.run_sync()
+        assert st.state == "budget"
+        assert 0 < st.objects_scanned < 3
+        assert st.bytes_read >= 1
+
+    def test_throttle_defers_between_objects(self, tmp_path):
+        from minio_tpu.services.heal import HealSequence
+
+        ol, _ = _make_layer(tmp_path, 6, 2)
+        _put(ol, "o0", 256 << 10, seed=30)
+        gates = iter([False, True, True, True, True])
+
+        def throttle():
+            return next(gates, True)
+
+        seq = HealSequence(ol, bucket="bkt", throttle=throttle)
+        st = seq.run_sync()
+        assert st.state == "finished"
+        assert st.throttle_waits >= 1
+
+    def test_status_dict_carries_repair_fields(self, tmp_path):
+        from minio_tpu.services.heal import HealSequence
+
+        ol, _ = _make_layer(tmp_path, 6, 2)
+        seq = HealSequence(ol, bucket="bkt")
+        d = seq.run_sync().to_dict()
+        for key in ("bytesRead", "bytesScanned", "subshardObjects",
+                    "bytesBudget", "throttleWaits"):
+            assert key in d

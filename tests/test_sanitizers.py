@@ -5,7 +5,9 @@ Builds the asan/ubsan/tsan variants of libminio_tpu_host
 subprocess with the sanitizer runtime LD_PRELOADed:
 
 - ASan + UBSan: the 512-case Select differential corpus
-  (tests/select_corpus.py) and the GF(2^8)/HighwayHash golden vectors
+  (tests/select_corpus.py), the GF(2^8)/HighwayHash golden vectors,
+  and the repair-kernel vectors (erasure/repair.py matrices through
+  the batched C matmul + the strided frame-verify path)
 - TSan: concurrent fused Select scans exercising the detached-thread
   ScanPool (csrc/select_scan.cpp)
 
@@ -112,6 +114,11 @@ class TestASan:
         _assert_clean(proc, ("ERROR: AddressSanitizer",
                              "SUMMARY: AddressSanitizer"))
 
+    def test_repair_vectors_clean_under_asan(self):
+        proc = _replay("asan", "repair")
+        _assert_clean(proc, ("ERROR: AddressSanitizer",
+                             "SUMMARY: AddressSanitizer"))
+
 
 class TestUBSan:
     def test_select_corpus_clean_under_ubsan(self):
@@ -121,6 +128,11 @@ class TestUBSan:
 
     def test_golden_vectors_clean_under_ubsan(self):
         proc = _replay("ubsan", "golden")
+        _assert_clean(proc, ("runtime error:",
+                             "SUMMARY: UndefinedBehaviorSanitizer"))
+
+    def test_repair_vectors_clean_under_ubsan(self):
+        proc = _replay("ubsan", "repair")
         _assert_clean(proc, ("runtime error:",
                              "SUMMARY: UndefinedBehaviorSanitizer"))
 
