@@ -779,6 +779,236 @@ def bench_repair_heal(ndrives=12, nobjects=8, obj_mb=16,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_hot_get(ndrives=12, nobjects=64, nthreads=8, n_hot=250,
+                  n_cold=30, zipf_s=1.1):
+    """BENCH_r11: many-client zipf-hot small-object GET drill through
+    the REAL HTTP server, hot-object tier (serving/hotcache.py) on vs
+    off, measured in the same run.
+
+    Honest clauses:
+
+    * Both sides run the FULL stack a client pays: aiohttp server,
+      SigV4-verified setup, anonymous keep-alive GET clients authorized
+      by a public-read bucket policy (the CDN-style hot-serving shape),
+      response bodies verified byte-for-byte against the catalog on
+      EVERY request, hot and cold.
+    * The uncached baseline is an identical 12-drive 8+4 server booted
+      in the same process with the tier disabled, serving the SAME
+      per-thread zipf(``zipf_s``) key sequences (truncated to
+      ``n_cold`` per thread — the uncached path is ~25x slower here, a
+      full-length pass would just multiply runtime, and req/s is
+      length-invariant).
+    * The collapse drill measures ERASURE READS, not cache counters:
+      per-drive shard-stream opens are counted by a wrapper around
+      LocalStorage, a solo cold GET of a 1 MiB object calibrates the
+      per-read open count, then ``nthreads`` barrier-released clients
+      GET one cold key and the drill reports opens/solo-opens — 1.0
+      means the singleflight latch collapsed every concurrent read
+      into one backend fill.
+    """
+    import hashlib  # noqa: F401  (bodies compared raw; md5 not needed)
+    import http.client
+    import threading
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tests"))
+    from s3_harness import S3TestServer
+
+    from minio_tpu.erasure.sets import ErasureServerPools, ErasureSets
+    from minio_tpu.storage.local import LocalStorage
+
+    class CountingDisk:
+        """Counts metadata + shard-stream reads (the erasure-read
+        evidence for the collapse clause)."""
+
+        def __init__(self, inner, counters):
+            self._inner = inner
+            self._c = counters
+
+        def read_version(self, *a, **kw):
+            self._c["read_version"] += 1
+            return self._inner.read_version(*a, **kw)
+
+        def read_file_stream(self, *a, **kw):
+            self._c["read_file_stream"] += 1
+            return self._inner.read_file_stream(*a, **kw)
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    os.environ.setdefault("MINIO_TPU_FSYNC", "0")
+    rng = np.random.default_rng(11)
+    catalog = {}
+    for i in range(nobjects):
+        size = int(rng.integers(4 << 10, 64 << 10))
+        catalog[f"o{i:03d}"] = rng.integers(
+            0, 256, size, dtype=np.uint8).tobytes()
+    names = sorted(catalog)
+    # zipf(s) over popularity ranks; every thread draws its own
+    # deterministic sequence, shared verbatim by the hot and cold runs
+    w = 1.0 / np.arange(1, nobjects + 1, dtype=np.float64) ** zipf_s
+    w /= w.sum()
+    seqs = [list(np.random.default_rng(100 + t).choice(
+        names, size=n_hot, p=w)) for t in range(nthreads)]
+
+    pol = json.dumps({"Statement": [{
+        "Effect": "Allow", "Principal": {"AWS": ["*"]},
+        "Action": ["s3:GetObject"],
+        "Resource": ["arn:aws:s3:::bkt/*"]}]}).encode()
+
+    def boot(root, hot: bool):
+        counters = {"read_version": 0, "read_file_stream": 0}
+        prev = os.environ.pop("MINIO_TPU_HOTCACHE_BYTES", None)
+        if hot:
+            os.environ["MINIO_TPU_HOTCACHE_BYTES"] = str(64 << 20)
+        try:
+            disks = [CountingDisk(
+                LocalStorage(os.path.join(root, f"d{i}")), counters)
+                for i in range(ndrives)]
+            pools = ErasureServerPools([ErasureSets(disks)])
+            srv = S3TestServer(os.path.join(root, "unused"), pools=pools)
+        finally:
+            if prev is not None:
+                os.environ["MINIO_TPU_HOTCACHE_BYTES"] = prev
+            else:
+                os.environ.pop("MINIO_TPU_HOTCACHE_BYTES", None)
+        assert (srv.server.hotcache is not None) == hot
+        srv.request("PUT", "/bkt")
+        srv.request("PUT", "/bkt", query=[("policy", "")], data=pol)
+        for name, data in catalog.items():
+            srv.request("PUT", f"/bkt/{name}", data=data)
+        return srv, counters
+
+    host_of = lambda srv: srv.host.split(":")[0]  # noqa: E731
+
+    def drill(srv, nreq, extra=None):
+        """nthreads anonymous keep-alive clients replaying the zipf
+        sequences; every body verified against the catalog."""
+        bad = []
+        barrier = threading.Barrier(nthreads)
+
+        def worker(t):
+            conn = http.client.HTTPConnection(host_of(srv), srv.port,
+                                              timeout=60)
+            try:
+                barrier.wait(30)
+                for name in seqs[t][:nreq]:
+                    conn.request("GET", f"/bkt/{name}")
+                    r = conn.getresponse()
+                    body = r.read()
+                    if r.status != 200 or body != catalog[name]:
+                        bad.append((t, name, r.status))
+            finally:
+                conn.close()
+
+        ts = [threading.Thread(target=worker, args=(t,))
+              for t in range(nthreads)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        wall = time.perf_counter() - t0
+        return nreq * nthreads / wall, wall, not bad
+
+    tmp = tempfile.mkdtemp(prefix="minio-tpu-bench-hot-")
+    try:
+        hot_srv, hot_counters = boot(os.path.join(tmp, "hot"), True)
+        cold_srv, _ = boot(os.path.join(tmp, "cold"), False)
+        try:
+            # steady-state warm: two full catalog passes clear the
+            # min-2nd-access admission gate for every key
+            for _ in range(2):
+                for name in catalog:
+                    hot_srv.request("GET", f"/bkt/{name}")
+            hot_rps, hot_wall, hot_ok = drill(hot_srv, n_hot)
+            hstats = hot_srv.server.hotcache.stats()
+            cold_rps, cold_wall, cold_ok = drill(cold_srv, n_cold)
+
+            # ---- collapse drill: erasure reads, counted at the drives
+            big = rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()
+            for key in ("solo", "herd"):
+                hot_srv.request("PUT", f"/bkt/{key}", data=big)
+            snap = dict(hot_counters)
+            conn = http.client.HTTPConnection(host_of(hot_srv),
+                                              hot_srv.port, timeout=60)
+            conn.request("GET", "/bkt/solo")
+            r = conn.getresponse()
+            assert r.status == 200 and r.read() == big
+            conn.close()
+            solo_opens = hot_counters["read_file_stream"] \
+                - snap["read_file_stream"]
+            hc0 = hot_srv.server.hotcache.stats()
+            snap = dict(hot_counters)
+            herd_bad = []
+            barrier = threading.Barrier(nthreads)
+
+            def herd_worker():
+                c = http.client.HTTPConnection(host_of(hot_srv),
+                                               hot_srv.port, timeout=60)
+                try:
+                    barrier.wait(30)
+                    c.request("GET", "/bkt/herd")
+                    rr = c.getresponse()
+                    if rr.status != 200 or rr.read() != big:
+                        herd_bad.append(rr.status)
+                finally:
+                    c.close()
+
+            hts = [threading.Thread(target=herd_worker)
+                   for _ in range(nthreads)]
+            for t in hts:
+                t.start()
+            for t in hts:
+                t.join()
+            herd_opens = hot_counters["read_file_stream"] \
+                - snap["read_file_stream"]
+            hc1 = hot_srv.server.hotcache.stats()
+            return {
+                "zipf": {
+                    "hot_rps": round(hot_rps, 1),
+                    "cold_rps": round(cold_rps, 1),
+                    "speedup": round(hot_rps / cold_rps, 1)
+                    if cold_rps else 0.0,
+                    "hot_requests": n_hot * nthreads,
+                    "cold_requests": n_cold * nthreads,
+                    "hot_wall_s": round(hot_wall, 2),
+                    "cold_wall_s": round(cold_wall, 2),
+                    "byte_identical": hot_ok and cold_ok,
+                    "hot_hit_ratio": hstats["hitRatio"],
+                    "hot_tier_bytes": hstats["bytes"],
+                },
+                "collapse": {
+                    "clients": nthreads,
+                    "solo_stream_opens": solo_opens,
+                    "herd_stream_opens": herd_opens,
+                    "erasure_reads": round(herd_opens / solo_opens, 2)
+                    if solo_opens else None,
+                    "fills": hc1["fills"] - hc0["fills"],
+                    # requests that never touched a drive: joined the
+                    # leader's fill mid-flight, or arrived after commit
+                    "collapsed_or_hit":
+                        (hc1["collapsed"] - hc0["collapsed"])
+                        + (hc1["hits"] - hc0["hits"]),
+                    "byte_identical": not herd_bad,
+                },
+                "config": {
+                    "drives": ndrives, "ec": "8+4",
+                    "objects": nobjects, "zipf_s": zipf_s,
+                    "clients": nthreads,
+                    "object_bytes": [len(catalog[n]) for n in names[:4]]
+                    + ["..."],
+                    "catalog_bytes": sum(map(len, catalog.values())),
+                    "hotcache_bytes": 64 << 20,
+                },
+            }
+        finally:
+            hot_srv.close()
+            cold_srv.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_multipart_fanout():
     """BASELINE config 4: 16-drive set, 128 x 5 MiB multipart parts with
     parallel shard fan-out, through the real object layer + multipart
@@ -971,7 +1201,47 @@ def main_repair():
     print(json.dumps(doc, indent=2))
 
 
+def main_hotget():
+    """`python bench.py hotget`: the BENCH_r11 hot-serving letter."""
+    r = bench_hot_get()
+    doc = {
+        "hot_get": {
+            "method": (
+                "12 tmpdir drives EC 8+4 behind the real HTTP server; "
+                "64 small objects (4-64 KiB), 8 anonymous keep-alive "
+                "clients replaying per-thread zipf(1.1) key sequences, "
+                "every response body verified against the catalog; the "
+                "uncached baseline is an identical server booted in "
+                "the same run with the tier disabled, serving the same "
+                "sequences; collapse drill counts per-drive "
+                "shard-stream opens for 8 barrier-released GETs of one "
+                "cold 1 MiB key vs a solo GET"),
+            **r,
+            "acceptance": {
+                "speedup_ge_10x": r["zipf"]["speedup"] >= 10.0,
+                "byte_identical_all": r["zipf"]["byte_identical"]
+                and r["collapse"]["byte_identical"],
+                "collapse_single_erasure_read":
+                    r["collapse"]["erasure_reads"] == 1.0,
+            },
+        },
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_r11.json")
+    existing = {}
+    if os.path.exists(path):
+        with open(path, encoding="utf-8") as f:
+            existing = json.load(f)
+    existing.update(doc)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(existing, f, indent=2)
+        f.write("\n")
+    print(json.dumps(doc, indent=2))
+
+
 if __name__ == "__main__":
     if "repair" in sys.argv[1:]:
         sys.exit(main_repair())
+    if "hotget" in sys.argv[1:]:
+        sys.exit(main_hotget())
     sys.exit(main())

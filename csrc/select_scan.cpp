@@ -1884,6 +1884,12 @@ static int json_parse_line(const char *buf, const char *ls, const char *le,
             kq = h;
             break;
         }
+        // ANY backslash in the key means its raw bytes differ from the
+        // decoded name (\uXXXX, \n, ...): a raw memcmp against the
+        // queried column would silently diverge from the row engine —
+        // same rule as the value side below
+        if (memchr(ks, '\\', (size_t)(kq - ks)))
+            return 1;
         int32_t klen = (int32_t)(kq - ks);
         q = skip_ws(kq + 1, le);
         if (q >= le || *q != ':')
@@ -1924,6 +1930,13 @@ static int json_parse_line(const char *buf, const char *ls, const char *le,
                 sq = h;
                 break;
             }
+            // ANY backslash in the value (not only one escaping the
+            // closing quote) means the raw bytes differ from the
+            // decoded string: \uXXXX, \n, \\ ... — Python decides
+            // (comparing/matching raw `café` against a literal
+            // would silently diverge from the row engine)
+            if (!sesc && memchr(ss, '\\', (size_t)(sq - ss)))
+                sesc = 1;
             vt = sesc ? 6 : 5;  // escaped value: Python semantics
             vs = (int32_t)(ss - buf);
             vl = (int32_t)(sq - ss);
@@ -2235,6 +2248,10 @@ static int json_line_fwd(const char *buf, const char *ls, const char *end,
             kq = h;
             break;
         }
+        // any backslash in the key => raw bytes != decoded name:
+        // replay (same rule as json_parse_line above)
+        if (memchr(ks, '\\', (size_t)(kq - ks)))
+            return 1;
         int32_t klen = (int32_t)(kq - ks);
         q = kq + 1;
         while (q < end && (*q == ' ' || *q == '\t' || *q == '\r'))
@@ -2284,6 +2301,10 @@ static int json_line_fwd(const char *buf, const char *ls, const char *end,
                 sq = h;
                 break;
             }
+            // any backslash => raw bytes != decoded string: replay
+            // (same rule as json_parse_line above)
+            if (!sesc && memchr(ss, '\\', (size_t)(sq - ss)))
+                sesc = 1;
             vt = sesc ? 6 : 5;  // escaped value: Python semantics
             vs = (int32_t)(ss - buf);
             vl = (int32_t)(sq - ss);

@@ -369,6 +369,21 @@ def iter_sets(object_layer):
         yield object_layer
 
 
+def invalidation_plane(object_layer) -> tuple[bool, bool]:
+    """(has_sets, all_local): whether `object_layer` has an erasure
+    plane underneath where ns_updated choke-point hooks can be
+    registered (a pure gateway has none), and whether every drive is
+    node-local.  A remote drive means a PEER node's writes fire
+    ns_updated on that node only — a cache keyed on this node's hook
+    alone would go stale (hot tier auto-disables on that answer; the
+    cross-node broadcast is the ROADMAP follow-up)."""
+    sets = [es for es in iter_sets(object_layer)
+            if hasattr(es, "disks")]
+    all_local = all(d is None or d.is_local()
+                    for es in sets for d in es.disks)
+    return bool(sets), all_local
+
+
 def add_ns_update_hook(object_layer, fn) -> None:
     """Register fn(bucket, obj) on every set without clobbering hooks
     other subsystems installed (scanner bloom tracker, metacache
@@ -1711,6 +1726,13 @@ class ErasureObjects:
                 except Exception:
                     pass
             result.drives_after = list(healthy)
+            if result.healed_drives and self.ns_updated is not None:
+                # heal rewrote shard files: route through the same
+                # invalidation choke point as every other mutation so
+                # serving-tier caches (serving/hotcache.py) and change
+                # trackers observe the rewrite (ISSUE 7 invalidation
+                # matrix)
+                self.ns_updated(bucket, obj)
             return result
 
 

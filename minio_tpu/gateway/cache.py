@@ -59,6 +59,20 @@ class CacheLayer:
         self._total = 0
         os.makedirs(cache_dir, exist_ok=True)
         self._load_index()
+        # when the inner layer is the erasure server, register on its
+        # ns_updated choke point (erasure/objects.py) — the same one
+        # the in-RAM hot tier uses — so mutations that bypass this
+        # wrapper (background heal rewrites, replication writes,
+        # peer-applied deletes) invalidate too, not only the write
+        # methods routed through CacheLayer itself
+        try:
+            from minio_tpu.erasure.objects import (add_ns_update_hook,
+                                                   invalidation_plane)
+
+            if invalidation_plane(inner)[0]:
+                add_ns_update_hook(inner, self._invalidate)
+        except Exception:
+            pass  # pure gateway inner: method-level invalidation only
 
     # -- delegation ----------------------------------------------------------
     def __getattr__(self, name):
@@ -251,21 +265,43 @@ class CacheLayer:
             self._evict_one(key)
             log.debug("cache evicted", key=key)
 
-    def put_object(self, bucket: str, obj: str, *a, **kw):
+    def _invalidate(self, bucket: str, obj: str) -> None:
+        """The single write-path invalidation choke point: every
+        mutation of (bucket, obj) — direct method or inner-layer
+        ns_updated hook — routes through here, mirroring the in-RAM hot
+        tier's invalidate() (serving/hotcache.py)."""
         self._evict_one(self._key(bucket, obj))
+
+    def put_object(self, bucket: str, obj: str, *a, **kw):
+        self._invalidate(bucket, obj)
         return self.inner.put_object(bucket, obj, *a, **kw)
 
+    def copy_object(self, src_bucket: str, src_obj: str,
+                    dst_bucket: str, dst_obj: str, *a, **kw):
+        """Server-side copy ONTO a cached destination must invalidate
+        it (reference CopyObject ordering: source pair, then
+        destination).  Today's server implements CopyObject as
+        get+put, which routes through put_object's invalidation — but
+        the reference ObjectLayer has CopyObject as a first-class op
+        (a layer may short-circuit to a metadata-only copy), and if an
+        inner grows one, bare __getattr__ delegation would silently
+        serve the stale cached destination.  This wrapper closes that
+        protocol hole (regression test drives a copy-capable inner)."""
+        fn = getattr(self.inner, "copy_object")
+        self._invalidate(dst_bucket, dst_obj)
+        return fn(src_bucket, src_obj, dst_bucket, dst_obj, *a, **kw)
+
     def delete_object(self, bucket: str, obj: str, *a, **kw):
-        self._evict_one(self._key(bucket, obj))
+        self._invalidate(bucket, obj)
         return self.inner.delete_object(bucket, obj, *a, **kw)
 
     def delete_objects(self, bucket: str, dels: list, *a, **kw):
         for d in dels:
-            self._evict_one(self._key(bucket, d.get("obj", "")))
+            self._invalidate(bucket, d.get("obj", ""))
         return self.inner.delete_objects(bucket, dels, *a, **kw)
 
     def complete_multipart_upload(self, bucket: str, obj: str, *a, **kw):
-        self._evict_one(self._key(bucket, obj))
+        self._invalidate(bucket, obj)
         return self.inner.complete_multipart_upload(bucket, obj, *a, **kw)
 
     def stats(self) -> dict:

@@ -303,7 +303,7 @@ class _CsvWhere:
         return leaf
 
     def _leaf_like(self, j, pat: str, esc, negate: bool):
-        # vectorize the three byte-anchorable shapes; other patterns
+        # vectorize the four byte-anchorable shapes; other patterns
         # (embedded %/_, escapes) take the per-row path wholesale
         if esc is not None or "_" in pat:
             raise _Fallback("LIKE shape")
@@ -314,7 +314,7 @@ class _CsvWhere:
                 "prefix" if pat == body + "%" else
                 "suffix" if pat == "%" + body else
                 "contains" if pat == "%" + body + "%" else None)
-        if kind is None or kind == "contains":
+        if kind is None:
             raise _Fallback("LIKE shape")
         bb = body.encode()
 
@@ -334,6 +334,19 @@ class _CsvWhere:
             elif kind == "prefix":
                 m = (w >= n) & (chars[:, :n] == enc[None, :]).all(axis=1) \
                     if n else w >= 0
+            elif kind == "contains":
+                # %needle%: vectorized substring scan — one all-rows
+                # window compare per shift (<= MAX_W of them).  The -1
+                # padding can never match a needle byte (needles are
+                # ASCII >= 0), so windows past a cell's end fail
+                # without an explicit bound check.
+                if n == 0:
+                    m = w >= 0  # LIKE '%%' matches every cell
+                else:
+                    m = np.zeros(blk.n, dtype=bool)
+                    for s in range(MAX_W - n + 1):
+                        m |= (chars[:, s:s + n]
+                              == enc[None, :]).all(axis=1)
             else:  # suffix: right-align via gather
                 idx = ce[:, None] - n + np.arange(n)
                 valid = idx >= cs[:, None]

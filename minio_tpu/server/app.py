@@ -287,6 +287,31 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 pass
         self.sem = asyncio.Semaphore(max_concurrency)
         self.max_concurrency = max_concurrency
+        # hot-object serving tier (ISSUE 7, serving/hotcache.py): an
+        # in-RAM cache above the erasure layer, invalidated through the
+        # ns_updated choke point on every mutation.  Hits ride a
+        # dedicated admission lane (hot_sem) so RAM-served reads never
+        # queue behind drive-bound work, never count as admission
+        # pressure, and never engage brownout.
+        from minio_tpu.serving import from_env as _hotcache_from_env
+
+        self.hotcache = _hotcache_from_env()
+        if self.hotcache is not None:
+            from minio_tpu.erasure.objects import (add_ns_update_hook,
+                                                   invalidation_plane)
+
+            has_sets, all_local = invalidation_plane(object_layer)
+            if has_sets and all_local:
+                add_ns_update_hook(object_layer,
+                                   self.hotcache.invalidate)
+            else:
+                # no erasure invalidation plane below (pure gateway),
+                # or a distributed deployment where a peer's write
+                # fires ns_updated only on that node (see
+                # invalidation_plane): serving stale bytes is worse
+                # than serving slowly — tier off
+                self.hotcache = None
+        self.hot_sem = asyncio.Semaphore(max(max_concurrency, 4) * 2)
         # end-to-end deadline budget (reference requests_deadline,
         # cmd/handler-api.go:108): admission waits at most this long for
         # an API slot before shedding 503 SlowDown; the remainder rides
@@ -670,7 +695,8 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             headers={"Retry-After": "1"},
         )
 
-    async def _handle(self, request: web.Request, fn) -> web.StreamResponse:
+    async def _handle(self, request: web.Request, fn,
+                      hot: bool = False) -> web.StreamResponse:
         from minio_tpu.utils import deadline as deadline_mod
 
         t0 = time.monotonic()
@@ -679,6 +705,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         status = 500
         tx = 0
         budget = self._request_budget(request)
+        lane = self.sem
         try:
             # ---- admission: bounded queue wait, shed on expiry --------
             # fast path first: a free slot must not count as queue
@@ -688,7 +715,32 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             svcs = self.services
             if not self.sem.locked():
                 await self.sem.acquire()
+                admitted = True
             else:
+                admitted = False
+                if hot and not self.hot_sem.locked():
+                    # probable cache hit while the API lane is
+                    # saturated: serve from the hot lane.  A RAM hit
+                    # performs zero storage calls, so it must not queue
+                    # behind drive-bound requests, count toward
+                    # brownout pressure, or charge the drive-deadline
+                    # plane (ISSUE 7 economics wiring).  The probe
+                    # re-runs AFTER the acquire: a writer may have
+                    # invalidated the entry since dispatch, and a
+                    # request that will now do drive-bound work must
+                    # pay normal admission below, not ride the
+                    # unmetered hot lane.
+                    await self.hot_sem.acquire()
+                    if self._hot_probe(request):
+                        lane = self.hot_sem
+                        admitted = True
+                        self._m_hot_lane.inc()
+                        if svcs is not None and getattr(
+                                svcs, "brownout", None) is not None:
+                            svcs.brownout.note_hot_bypass()
+                    else:
+                        self.hot_sem.release()
+            if not admitted:
                 self._waiters += 1
                 self._m_queue_waiting.inc()
                 try:
@@ -743,7 +795,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                     )
             finally:
                 deadline_mod.reset(token)
-                self.sem.release()
+                lane.release()
         finally:
             dt = time.monotonic() - t0
             self._m_inflight.dec()
@@ -980,9 +1032,11 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             if "attributes" in q:
                 return await self._handle(request,
                                           self.get_object_attributes)
-            return await self._handle(request, self.get_object)
+            return await self._handle(request, self.get_object,
+                                      hot=self._hot_probe(request))
         if m == "HEAD":
-            return await self._handle(request, self.head_object)
+            return await self._handle(request, self.head_object,
+                                      hot=self._hot_probe(request))
         if m == "PUT":
             if "uploadId" in q and "partNumber" in q:
                 return await self._handle(request, self.upload_part)
@@ -1375,6 +1429,19 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         if not key:
             raise S3Error("InvalidArgument", "empty object key")
         return bucket, key
+
+    def _hot_probe(self, request: web.Request) -> bool:
+        """Advisory pre-admission hit test for the hot-lane dispatch
+        (cheap dict lookup, no auth — auth still runs in the handler)."""
+        hc = self.hotcache
+        if hc is None:
+            return False
+        bucket = request.match_info.get("bucket", "")
+        key = request.match_info.get("key", "")
+        if not bucket or not key:
+            return False
+        return hc.probe(bucket, key,
+                        request.rel_url.query.get("versionId", ""))
 
     @staticmethod
     def _obj_headers(oi) -> dict[str, str]:
@@ -2132,11 +2199,61 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         return resp
 
     async def get_object(self, request: web.Request) -> web.StreamResponse:
-        from minio_tpu.crypto import sse as sse_mod
-
         bucket, key = self._object(request)
         await self._auth(request, None, "s3:GetObject", bucket, key)
         vid = request.rel_url.query.get("versionId", "")
+        hc = self.hotcache
+        if hc is not None:
+            ranged = "Range" in request.headers
+            # a Range miss falls through to the classic path below, so
+            # lookup is its terminal tier interaction: count the miss
+            # (and feed the admission sketch) there; a whole-object
+            # miss is counted by serve() instead
+            ent = hc.lookup(bucket, key, vid, count_miss=ranged)
+            if ent is not None:
+                # RAM hit: zero storage calls from here on — headers,
+                # conditional 304/412 and Range slices all come from
+                # the cached ObjectInfo + buffer
+                return await self._serve_hot(request, bucket, key, vid,
+                                             ent.oi, ent.data)
+            if not ranged:
+                # collapse path: concurrent GETs of one cold key share
+                # ONE erasure read; late arrivals stream from the
+                # filling buffer (serving/hotcache.py singleflight).
+                # The quorum metadata read is time-to-first-byte work,
+                # so it keeps the request's deadline budget (classic
+                # _run parity); the fill streaming stays budget-free
+                # like every whole-payload phase.
+                from minio_tpu.utils import deadline as deadline_mod
+
+                budget = deadline_mod.current()
+
+                def info_fn():
+                    token = deadline_mod.set_current(budget)
+                    try:
+                        return self.api.get_object_info(bucket, key,
+                                                        vid)
+                    finally:
+                        deadline_mod.reset(token)
+
+                try:
+                    kind, oi, payload = await self._run_nobudget(
+                        hc.serve, bucket, key, vid, info_fn,
+                        lambda: self.api.get_object(
+                            bucket, key, 0, -1, vid))
+                except (st.ObjectNotFound, st.FileNotFound) as e:
+                    resp = await self._replication_proxy(
+                        request, bucket, key, vid)
+                    if resp is not None:
+                        return resp
+                    raise e
+                if kind != "miss":
+                    return await self._serve_hot(request, bucket, key,
+                                                 vid, oi, payload)
+                # ineligible object (SSE/compressed/tiered/oversized):
+                # classic path, reusing the oi the leader already read
+                return await self._get_uncached(request, bucket, key,
+                                                vid, oi)
         try:
             oi = await self._run(self.api.get_object_info, bucket, key, vid)
         except (st.ObjectNotFound, st.FileNotFound) as e:
@@ -2144,6 +2261,64 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             if resp is not None:
                 return resp
             raise e
+        return await self._get_uncached(request, bucket, key, vid, oi)
+
+    async def _serve_hot(self, request: web.Request, bucket: str,
+                         key: str, vid: str, oi, payload,
+                         head: bool = False) -> web.StreamResponse:
+        """Serve a GET (or HEAD, ``head=True``) from the hot tier:
+        `payload` is the resident bytes (hit / fill leader) or a
+        progressive iterator over the filling buffer (collapsed
+        follower).  Mirrors the classic plain-object path
+        byte-for-byte (differential-tested)."""
+        import dataclasses
+
+        from minio_tpu.events.event import EventName
+
+        if vid == "null":
+            # cached ObjectInfo is shared/read-only: tweak a copy
+            oi = dataclasses.replace(oi, version_id="null")
+        self.check_preconditions(request, oi)
+        size = oi.size
+        status = 200
+        offset, length = 0, size
+        headers = self._obj_headers(oi)
+        headers.update(self._checksum_headers(request, oi))
+        if head:
+            # hot HEAD: the cached ObjectInfo answers everything —
+            # zero xl.meta reads (same header set as the classic
+            # handler, which ignores Range on HEAD)
+            headers["Content-Length"] = str(size)
+            self._emit(EventName.OBJECT_ACCESSED_HEAD, bucket, key,
+                       size=size, etag=oi.etag,
+                       version_id=oi.version_id, request=request)
+            return web.Response(status=200, headers=headers)
+        rng = request.headers.get("Range")
+        if rng and size > 0:
+            start, end = self._parse_range(rng, size)
+            offset, length = start, end - start + 1
+            status = 206
+            headers["Content-Range"] = f"bytes {start}-{end}/{size}"
+        headers["Content-Length"] = str(length)
+        self._emit(EventName.OBJECT_ACCESSED_GET, bucket, key, size=size,
+                   etag=oi.etag, version_id=oi.version_id, request=request)
+        if isinstance(payload, (bytes, bytearray, memoryview)):
+            body = memoryview(payload)[offset:offset + length] \
+                if (offset or length != size) else payload
+            return web.Response(status=status, body=bytes(body),
+                                headers=headers)
+        # collapsed follower: stream the fill buffer as it grows
+        # (followers are only created for whole-object requests)
+        resp = web.StreamResponse(status=status, headers=headers)
+        await resp.prepare(request)
+        await self._pump_stream(resp, payload)
+        await resp.write_eof()
+        return resp
+
+    async def _get_uncached(self, request: web.Request, bucket: str,
+                            key: str, vid: str, oi) -> web.StreamResponse:
+        from minio_tpu.crypto import sse as sse_mod
+
         if vid == "null":
             oi.version_id = "null"
         self.check_preconditions(request, oi)
@@ -2284,6 +2459,13 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         bucket, key = self._object(request)
         await self._auth(request, None, "s3:GetObject", bucket, key)
         vid = request.rel_url.query.get("versionId", "")
+        hc = self.hotcache
+        if hc is not None:
+            # a HEAD miss never reaches serve(): lookup counts it
+            ent = hc.lookup(bucket, key, vid)
+            if ent is not None:
+                return await self._serve_hot(request, bucket, key, vid,
+                                             ent.oi, ent.data, head=True)
         try:
             oi = await self._run(self.api.get_object_info, bucket, key, vid)
         except (st.ObjectNotFound, st.FileNotFound) as e:

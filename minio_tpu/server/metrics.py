@@ -64,6 +64,12 @@ class MetricsMixin:
         self._m_shed = r.counter(
             "minio_s3_requests_shed_total",
             "Requests shed with 503 SlowDown at admission")
+        # hot-object serving tier (ISSUE 7): probable cache hits that
+        # bypassed the saturated API lane via the dedicated hot lane —
+        # RAM-served reads never queue behind drive-bound work
+        self._m_hot_lane = r.counter(
+            "minio_hotcache_lane_admissions_total",
+            "Requests admitted through the hot-cache fast lane")
         self._m_rx = r.counter(
             "minio_s3_traffic_received_bytes",
             "Bytes received from S3 clients")
@@ -332,6 +338,37 @@ class MetricsMixin:
                   "executor re-verification", rsnap["target_scan_bytes"])
         except Exception:
             pass
+
+        # hot-object serving tier (serving/hotcache.py): hit/miss/fill
+        # economics of the in-RAM tier — collapsed_reads counts GETs
+        # that shared another request's single erasure read, and
+        # invalidations counts choke-point drops (writes racing reads)
+        hc = getattr(self, "hotcache", None)
+        if hc is not None:
+            hs = hc.stats()
+            gauge("minio_hotcache_hits_total",
+                  "GET/HEAD requests served from the hot-object tier",
+                  hs["hits"])
+            gauge("minio_hotcache_misses_total",
+                  "Hot-tier lookups that fell through to the erasure "
+                  "path", hs["misses"])
+            gauge("minio_hotcache_fills_total",
+                  "Completed back-end fill reads led by one request",
+                  hs["fills"])
+            gauge("minio_hotcache_collapsed_reads_total",
+                  "GETs that streamed from another request's in-flight "
+                  "fill instead of touching drives", hs["collapsed"])
+            gauge("minio_hotcache_evictions_total",
+                  "Entries evicted by the segmented-LRU byte budget",
+                  hs["evictions"])
+            gauge("minio_hotcache_invalidations_total",
+                  "Choke-point invalidations (overwrite/copy/delete/"
+                  "multipart/heal rewrites)", hs["invalidations"])
+            gauge("minio_hotcache_bytes",
+                  "Resident bytes in the hot-object tier", hs["bytes"])
+            gauge("minio_hotcache_hit_ratio",
+                  "Fraction of hot-tier lookups served from RAM",
+                  hs["hitRatio"])
 
         # deadline/overload plane: hedged shard reads, abandoned
         # stragglers, RPC budget expiries, per-drive deadline timeouts

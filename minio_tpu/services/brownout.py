@@ -33,6 +33,7 @@ class BrownoutController:
         self.releases = 0
         self.sheds_seen = 0
         self.deferrals = 0
+        self.hot_bypasses = 0
 
     # -- pressure inputs (API front) ----------------------------------------
     def note_pressure(self, queue_depth: int) -> None:
@@ -45,6 +46,16 @@ class BrownoutController:
         with self._mu:
             self.sheds_seen += 1
         self._pressure()
+
+    def note_hot_bypass(self) -> None:
+        """A probable hot-cache hit was admitted through the dedicated
+        fast lane while the API lane was saturated.  RAM-served reads
+        spend no drive IOPs, so they are deliberately NOT pressure —
+        background work must keep running while a hot flood is absorbed
+        from memory — but the count keeps that economics decision
+        observable next to engagements/sheds."""
+        with self._mu:
+            self.hot_bypasses += 1
 
     def _pressure(self) -> None:
         with self._mu:
@@ -83,6 +94,7 @@ class BrownoutController:
                 "releases": self.releases,
                 "shedsSeen": self.sheds_seen,
                 "deferrals": self.deferrals,
+                "hotBypasses": self.hot_bypasses,
                 "engageDepth": self.engage_depth,
                 "releaseAfter": self.release_after,
             }

@@ -1,0 +1,11 @@
+"""Serving-tier layers that sit ABOVE the erasure object layer.
+
+`hotcache` is the in-RAM hot-object tier (ISSUE 7): million-user read
+fan-in is dominated by a small hot set, and a repeat GET of an
+immutable object should not re-pay the xl.meta quorum read, k shard
+opens, bitrot verify and RS decode every time.
+"""
+
+from .hotcache import HotObjectCache, from_env
+
+__all__ = ["HotObjectCache", "from_env"]

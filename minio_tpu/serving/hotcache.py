@@ -1,0 +1,575 @@
+"""Hot-object serving tier: in-RAM cache with request collapsing.
+
+Heavy-traffic read workloads are dominated by a small hot set; today
+every GET — even a repeat GET of the same immutable object — pays the
+full erasure path (xl.meta quorum read, k shard opens, bitrot verify,
+RS decode).  This tier sits ABOVE the erasure layer (the disk-backed
+analogue is gateway/cache.py; reference shape: cmd/disk-cache.go) and
+holds decoded object bytes plus the ObjectInfo needed to answer
+headers, so a hit performs ZERO storage calls — conditional GETs
+(If-None-Match / If-Modified-Since) 304 without touching xl.meta and
+Range requests slice the resident buffer.
+
+Three mechanisms carry the design:
+
+* Segmented LRU + TinyLFU-style admission.  Entries are admitted into a
+  probation segment and promoted to a protected segment (~80% of the
+  byte budget) on re-reference, so a scan of one-hit wonders cannot
+  flush the established hot set.  Admission itself is gated on a
+  per-key access-frequency counter with periodic halving (TinyLFU
+  aging): an object's bytes are only cached from its
+  `MINIO_TPU_HOTCACHE_MIN_HITS`-th access on (default 2), and objects
+  over `MINIO_TPU_HOTCACHE_MAX_OBJ_BYTES` are never admitted so one
+  huge object cannot evict the whole tier.
+
+* Request collapsing (singleflight).  Concurrent GETs for the same
+  (bucket, object, version) share ONE erasure read: the first caller
+  becomes the fill leader, late arrivals stream from the filling buffer
+  AS IT GROWS (no wait-for-whole-object), and losers of the race never
+  touch drives — the memcache-style thundering-herd defense.  Collapse
+  applies even to keys the admission filter later declines: the
+  back-end read is shared either way.  The price is leader latency —
+  the leader's own first byte waits for the full back-end read (a
+  follower's does not) — which is why max_obj_bytes defaults small
+  (<= 64 MiB) and total in-flight fill RAM is capped at the tier
+  budget; over the cap a request streams classically, unbuffered.
+
+* Strict invalidation through one choke point.  Every mutation of an
+  object — overwrite PUT, CompleteMultipartUpload, CopyObject onto a
+  cached destination, DELETE / version delete, heal and replication
+  rewrites — fires the erasure layer's `ns_updated` hook
+  (erasure/objects.py), which calls `invalidate()` here.  Invalidation
+  drops the entries AND bumps a per-object generation counter; a fill
+  commits only if the generation it started under is still current, and
+  a hit re-validates its entry's generation, so a racing writer can
+  never leave stale bytes serveable.
+
+The tier is off by default: set `MINIO_TPU_HOTCACHE_BYTES` to enable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import os
+import threading
+from collections import OrderedDict
+from typing import Callable, Iterator
+
+#: fraction of the byte budget reserved for the protected SLRU segment
+PROTECTED_FRAC = 0.8
+
+#: frequency-sketch aging: halve all counters after this many accesses
+#: (or when the sketch grows past _FREQ_MAX_KEYS) — TinyLFU's reset,
+#: keeping the sketch a bounded recency-weighted estimate.  The key cap
+#: also bounds the rebuild's ``_mu`` hold time: every lock hold in this
+#: module must stay small because lookup() runs on the event loop
+_FREQ_AGE_OPS = 1 << 16
+_FREQ_MAX_KEYS = 1 << 13
+
+#: admission declines rather than evict more than this many entries in
+#: one sweep: a single object displacing thousands of tiny entries is a
+#: poor cache trade AND would hold ``_mu`` through an O(n) sweep while
+#: the event loop's lookup() waits behind it
+_EVICT_SWEEP_MAX = 256
+
+#: streaming chunk size for followers reading a growing fill buffer
+_STREAM_CHUNK = 1 << 18
+
+
+def from_env() -> "HotObjectCache | None":
+    """Build the tier from env knobs; None when disabled (default)."""
+    try:
+        max_bytes = int(os.environ.get("MINIO_TPU_HOTCACHE_BYTES", "0"))
+    except ValueError:
+        max_bytes = 0
+    if max_bytes <= 0:
+        return None
+    def _int_env(name: str) -> int | None:
+        # a malformed sibling knob degrades to its default, same as a
+        # malformed MINIO_TPU_HOTCACHE_BYTES disables the tier —
+        # an operator typo must not fail server boot
+        try:
+            v = os.environ.get(name, "")
+            return int(v) if v else None
+        except ValueError:
+            return None
+
+    min_hits = _int_env("MINIO_TPU_HOTCACHE_MIN_HITS")
+    return HotObjectCache(
+        max_bytes,
+        max_obj_bytes=_int_env("MINIO_TPU_HOTCACHE_MAX_OBJ_BYTES"),
+        min_hits=2 if min_hits is None else min_hits,
+    )
+
+
+class _Entry:
+    __slots__ = ("key", "oi", "data", "gen")
+
+    def __init__(self, key, oi, data: bytes, gen: int):
+        self.key = key
+        self.oi = oi
+        self.data = data
+        self.gen = gen
+
+
+class _Fill:
+    """Per-key singleflight latch: the leader appends decoded chunks,
+    followers stream from the buffer as it grows.  Terminal states:
+
+    * ``done``   — full object buffered; `oi` set
+    * ``miss``   — object exists but is not cacheable (SSE / compressed
+                   / tiered / too big); `oi` set, no data — followers
+                   fall back to their own read
+    * ``failed`` — the back-end read raised; `error` set — followers
+                   re-raise the leader's error (collapsed 404s included)
+    """
+
+    __slots__ = ("gen", "cv", "buf", "oi", "state", "error", "reserved")
+
+    def __init__(self, gen: int):
+        self.gen = gen
+        self.cv = threading.Condition()
+        self.buf = bytearray()
+        self.oi = None
+        self.state = "filling"
+        self.error: BaseException | None = None
+        self.reserved = 0  # bytes charged against the fill-RAM cap
+
+    def append(self, chunk) -> None:
+        with self.cv:
+            self.buf += chunk
+            self.cv.notify_all()
+
+    def set_oi(self, oi) -> None:
+        with self.cv:
+            self.oi = oi
+            self.cv.notify_all()
+
+    def settle(self, state: str, oi=None,
+               error: BaseException | None = None) -> None:
+        with self.cv:
+            if oi is not None:
+                self.oi = oi
+            self.error = error
+            self.state = state
+            self.cv.notify_all()
+
+    def wait_header(self):
+        """Block until the leader has resolved the object's identity
+        (oi known) or the fill reached a terminal state."""
+        with self.cv:
+            while self.oi is None and self.state == "filling":
+                self.cv.wait(1.0)
+            return self.state, self.oi, self.error
+
+    def stream(self) -> Iterator[bytes]:
+        """Yield the buffer progressively; completes when the leader
+        settles.  Raises the leader's error on a failed fill."""
+        pos = 0
+        while True:
+            with self.cv:
+                while len(self.buf) <= pos and self.state == "filling":
+                    self.cv.wait(1.0)
+                if self.error is not None:
+                    raise self.error
+                chunk = bytes(self.buf[pos:pos + _STREAM_CHUNK])
+                finished = self.state != "filling" \
+                    and pos + len(chunk) >= len(self.buf)
+            if chunk:
+                pos += len(chunk)
+                yield chunk
+            if finished:
+                return
+
+
+class HotObjectCache:
+    """Size-bounded in-RAM hot-object tier keyed by
+    (bucket, object, version)."""
+
+    def __init__(self, max_bytes: int, max_obj_bytes: int | None = None,
+                 min_hits: int = 2):
+        self.max_bytes = int(max_bytes)
+        if max_obj_bytes is None:
+            # one object may take at most 1/8 of the tier (floor 1 MiB),
+            # AND no more than 64 MiB by default: the fill leader
+            # buffers the whole object before its client's first byte
+            # (the price of cold-herd collapse), so the default keeps
+            # that worst-case TTFB small even under a many-GiB tier —
+            # operators caching bigger objects raise the env knob
+            max_obj_bytes = max(min(self.max_bytes // 8, 64 << 20),
+                                1 << 20)
+        self.max_obj_bytes = min(int(max_obj_bytes), self.max_bytes)
+        self.min_hits = max(1, int(min_hits))
+        self._mu = threading.Lock()
+        self._prob: "OrderedDict" = OrderedDict()  # probation segment
+        self._prot: "OrderedDict" = OrderedDict()  # protected segment
+        self._bytes = 0
+        self._prot_bytes = 0
+        self._by_obj: dict = {}   # (bucket, obj) -> set of entry keys
+        self._fills: dict = {}    # key3 -> _Fill
+        self._fill_bytes = 0      # reserved RAM of in-flight fills
+        self._gen: dict = {}      # (bucket, obj) -> generation value
+        self._gen_src = itertools.count(1)
+        self._freq: dict = {}     # key3 -> access count (aged)
+        self._freq_ops = 0
+        # counters (surfaced as minio_hotcache_* in server/metrics.py)
+        self.hits = 0
+        self.misses = 0
+        self.fills = 0
+        self.collapsed = 0
+        self.evictions = 0
+        self.invalidations = 0
+
+    # ------------------------------------------------------------ internals
+    def _note_access_locked(self, k) -> None:
+        self._freq[k] = self._freq.get(k, 0) + 1
+        self._freq_ops += 1
+        if self._freq_ops >= _FREQ_AGE_OPS \
+                or len(self._freq) > _FREQ_MAX_KEYS:
+            self._freq = {kk: c // 2 for kk, c in self._freq.items()
+                          if c // 2 > 0}
+            self._freq_ops = 0
+
+    def _gen_of_locked(self, bo) -> int:
+        g = self._gen.get(bo)
+        if g is None:
+            g = next(self._gen_src)
+            self._gen[bo] = g
+        return g
+
+    def _maybe_drop_gen_locked(self, bo) -> None:
+        """Generation cells live only while an entry or fill references
+        the object, so the dict cannot grow with one-shot keys."""
+        if self._by_obj.get(bo):
+            return
+        if any(k[0] == bo[0] and k[1] == bo[1] for k in self._fills):
+            return
+        self._gen.pop(bo, None)
+        self._by_obj.pop(bo, None)
+
+    def _drop_entry_locked(self, k, *, count_eviction: bool) -> None:
+        ent = self._prob.pop(k, None)
+        if ent is None:
+            ent = self._prot.pop(k, None)
+            if ent is not None:
+                self._prot_bytes -= len(ent.data)
+        if ent is None:
+            return
+        self._bytes -= len(ent.data)
+        if count_eviction:
+            self.evictions += 1
+        bo = (k[0], k[1])
+        keys = self._by_obj.get(bo)
+        if keys is not None:
+            keys.discard(k)
+            if not keys:
+                self._by_obj.pop(bo, None)
+        self._maybe_drop_gen_locked(bo)
+
+    def _evict_locked(self) -> None:
+        while self._bytes > self.max_bytes and (self._prob or self._prot):
+            src = self._prob if self._prob else self._prot
+            k, _ = next(iter(src.items()))
+            self._drop_entry_locked(k, count_eviction=True)
+
+    def _admit_locked(self, k, oi, data: bytes, gen: int) -> None:
+        self._drop_entry_locked(k, count_eviction=False)
+        need = self._bytes + len(data) - self.max_bytes
+        if need > 0:
+            # count prospective victims in eviction order (probation
+            # LRU-first, then protected) WITHOUT popping: if making
+            # room exceeds the bounded sweep, decline the admission
+            freed = n = 0
+            for src in (self._prob, self._prot):
+                for ent in src.values():
+                    if freed >= need or n > _EVICT_SWEEP_MAX:
+                        break
+                    freed += len(ent.data)
+                    n += 1
+                if freed >= need or n > _EVICT_SWEEP_MAX:
+                    break
+            if n > _EVICT_SWEEP_MAX:
+                return
+        # a frozen metadata copy: callers treat cached ObjectInfo as
+        # read-only, but the erasure layer hands out live dicts
+        oi = dataclasses.replace(oi, metadata=dict(oi.metadata),
+                                 parts=list(oi.parts))
+        self._prob[k] = _Entry(k, oi, data, gen)
+        self._bytes += len(data)
+        self._by_obj.setdefault((k[0], k[1]), set()).add(k)
+        self._evict_locked()
+
+    def _touch_locked(self, k, ent: _Entry) -> None:
+        """SLRU promotion: probation hit moves to protected; protected
+        overflow demotes its LRU back to probation (not out)."""
+        if k in self._prob:
+            self._prob.pop(k)
+            self._prot[k] = ent
+            self._prot_bytes += len(ent.data)
+            cap = self.max_bytes * PROTECTED_FRAC
+            while self._prot_bytes > cap and len(self._prot) > 1:
+                dk, dent = next(iter(self._prot.items()))
+                self._prot.pop(dk)
+                self._prot_bytes -= len(dent.data)
+                self._prob[dk] = dent
+        elif k in self._prot:
+            self._prot.move_to_end(k)
+
+    def _entry_locked(self, k) -> _Entry | None:
+        ent = self._prob.get(k)
+        if ent is None:
+            ent = self._prot.get(k)
+        if ent is None:
+            return None
+        if self._gen.get((k[0], k[1])) != ent.gen:
+            # a writer invalidated between admit and now: never serve
+            self._drop_entry_locked(k, count_eviction=False)
+            return None
+        return ent
+
+    # ------------------------------------------------------------- queries
+    def probe(self, bucket: str, obj: str, version_id: str = "") -> bool:
+        """Advisory hit test for the admission fast lane: no counters,
+        no LRU movement, and deliberately LOCK-FREE — it runs on the
+        event loop, which must never wait behind an executor thread
+        holding ``_mu`` through an eviction sweep or frequency aging.
+        Single dict reads are safe under the GIL; a stale answer only
+        mis-picks the admission lane, and lookup() re-validates under
+        the lock before any bytes are served."""
+        k = (bucket, obj, version_id)
+        ent = self._prob.get(k) or self._prot.get(k)
+        return ent is not None \
+            and self._gen.get((bucket, obj)) == ent.gen
+
+    def lookup(self, bucket: str, obj: str, version_id: str = "", *,
+               count_miss: bool = True) -> _Entry | None:
+        """Hit path: entry with a generation-valid ObjectInfo + bytes,
+        or None.
+
+        ``count_miss=True`` (HEAD, Range — requests whose miss falls
+        through to the classic path and never reaches serve()) counts
+        the miss and feeds the admission sketch here, so Range/HEAD-hot
+        objects can clear the min-hits gate and the hit-ratio gauge
+        stays honest.  Whole-object GET misses pass ``count_miss=False``
+        because serve() counts that same request — counting twice would
+        defeat the 2nd-access admission gate."""
+        k = (bucket, obj, version_id)
+        with self._mu:
+            ent = self._entry_locked(k)
+            if ent is None:
+                if count_miss:
+                    self._note_access_locked(k)
+                    self.misses += 1
+                return None
+            self._note_access_locked(k)
+            self._touch_locked(k, ent)
+            self.hits += 1
+            return ent
+
+    def cacheable(self, oi) -> bool:
+        """Only plain, fully-resident objects are admitted: encrypted
+        bytes must not sit decrypted in RAM, compressed objects would
+        double-store, tiered stubs have no local bytes, and anything
+        over max_obj_bytes would flush the tier."""
+        from minio_tpu.crypto import sse as sse_mod
+        from minio_tpu.erasure.objects import (TRANSITION_COMPLETE,
+                                               TRANSITION_STATUS_KEY)
+        from minio_tpu.utils import compress as compress_mod
+
+        if oi.delete_marker or not oi.etag:
+            return False
+        if oi.size > self.max_obj_bytes:
+            return False
+        md = oi.metadata
+        if md.get(sse_mod.META_ALGO):
+            return False
+        if md.get(compress_mod.META_COMPRESSION):
+            return False
+        if md.get(TRANSITION_STATUS_KEY) == TRANSITION_COMPLETE:
+            return False
+        return True
+
+    # --------------------------------------------------------------- serve
+    def serve(self, bucket: str, obj: str, version_id: str,
+              info_fn: Callable, data_fn: Callable):
+        """Miss path with request collapsing.  Returns (kind, oi,
+        payload):
+
+        * ("hit", oi, bytes)        — admitted while we queued
+        * ("filled", oi, bytes)     — this caller led the one erasure
+                                      read; bytes are the whole object
+        * ("collapsed", oi, iter)   — joined another caller's fill;
+                                      payload streams from the growing
+                                      buffer (no drive touched)
+        * ("miss", oi, None)        — object not cacheable; caller runs
+                                      the classic path reusing `oi`
+
+        info_fn() -> ObjectInfo and data_fn() -> (ObjectInfo, stream)
+        are only invoked by the fill leader.  Back-end errors (including
+        NotFound) propagate to every collapsed caller.
+        """
+        k = (bucket, obj, version_id)
+        bo = (bucket, obj)
+        with self._mu:
+            self._note_access_locked(k)
+            ent = self._entry_locked(k)
+            if ent is not None:
+                self._touch_locked(k, ent)
+                self.hits += 1
+                return ("hit", ent.oi, ent.data)
+            self.misses += 1
+            fill = self._fills.get(k)
+            if fill is not None:
+                self.collapsed += 1
+                follower = fill
+            else:
+                follower = None
+                fill = _Fill(self._gen_of_locked(bo))
+                self._fills[k] = fill
+        if follower is not None:
+            return self._follow(follower)
+        return self._lead(k, bo, fill, info_fn, data_fn)
+
+    def _follow(self, fill: _Fill):
+        state, oi, err = fill.wait_header()
+        if err is not None:
+            raise err
+        if state == "miss":
+            # leader resolved the object as uncacheable: hand back its
+            # oi, the caller reads drives itself (ineligible objects
+            # are the one case collapse does not cover)
+            return ("miss", oi, None)
+        # "filling" with oi set (leader committed to buffering) or
+        # "done": stream from the buffer; a later leader failure
+        # surfaces through the stream
+        return ("collapsed", oi, fill.stream())
+
+    def _lead(self, k, bo, fill: _Fill, info_fn, data_fn):
+        try:
+            oi = info_fn()
+        except BaseException as e:
+            self._finish(k, bo, fill, state="failed", error=e)
+            raise
+        if not self.cacheable(oi):
+            self._finish(k, bo, fill, state="miss", oi=oi)
+            return ("miss", oi, None)
+        with self._mu:
+            # bound TOTAL in-flight fill RAM by the tier budget: the
+            # entry store is capped at max_bytes, and without this a
+            # burst of concurrent cold GETs of distinct large-ish
+            # objects could hold an unbounded sum of fill buffers
+            # outside that accounting
+            fits = self._fill_bytes + oi.size <= self.max_bytes
+            if fits:
+                fill.reserved = oi.size
+                self._fill_bytes += oi.size
+        if not fits:
+            # over the cap this request takes the classic streaming
+            # path (no collapse, no buffering) — the pre-tier behavior
+            self._finish(k, bo, fill, state="miss", oi=oi)
+            return ("miss", oi, None)
+        fill.set_oi(oi)
+        stream = None
+        try:
+            _, stream = data_fn()
+            for chunk in stream:
+                fill.append(chunk)
+                if len(fill.buf) > oi.size:
+                    # stream longer than the ObjectInfo we told the
+                    # followers about (racing overwrite between
+                    # info_fn and data_fn): fail fast at oi.size, not
+                    # after buffering up to the per-object cap
+                    raise IOError(
+                        "hotcache fill overran the object size")
+        except BaseException as e:
+            self._finish(k, bo, fill, state="failed", error=e)
+            raise
+        finally:
+            close = getattr(stream, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    pass
+        data = bytes(fill.buf)
+        if len(data) != oi.size:
+            e = IOError(f"hotcache fill short read: "
+                        f"{len(data)} != {oi.size}")
+            self._finish(k, bo, fill, state="failed", error=e)
+            raise e
+        self._finish(k, bo, fill, state="done", oi=oi, data=data)
+        return ("filled", oi, data)
+
+    def _finish(self, k, bo, fill: _Fill, *, state: str, oi=None,
+                data: bytes | None = None,
+                error: BaseException | None = None) -> None:
+        with self._mu:
+            # identity check: invalidate() may have detached this fill
+            # and a successor fill may occupy the key by now
+            if self._fills.get(k) is fill:
+                self._fills.pop(k)
+            self._fill_bytes -= fill.reserved
+            fill.reserved = 0
+            if data is not None:
+                self.fills += 1
+                # commit ONLY if no writer invalidated since the fill
+                # started (generation unchanged) and the admission
+                # filter has seen enough demand for this key
+                if self._gen.get(bo) == fill.gen \
+                        and self._freq.get(k, 0) >= self.min_hits:
+                    self._admit_locked(k, oi, data, fill.gen)
+            self._maybe_drop_gen_locked(bo)
+        fill.settle(state, oi=oi, error=error)
+
+    # ---------------------------------------------------------- choke point
+    def invalidate(self, bucket: str, obj: str) -> None:
+        """The single invalidation choke point, fired by the erasure
+        layer's ns_updated hook on EVERY object mutation (overwrite PUT,
+        multipart complete, copy, delete, version delete, heal /
+        replication rewrites).  Drops all cached versions of the object
+        and bumps its generation so in-flight fills cannot commit."""
+        bo = (bucket, obj)
+        with self._mu:
+            keys = self._by_obj.get(bo)
+            stale = [fk for fk in self._fills
+                     if fk[0] == bucket and fk[1] == obj]
+            if not keys and not stale and bo not in self._gen:
+                return
+            for k in list(keys or ()):
+                self._drop_entry_locked(k, count_eviction=False)
+            self._gen.pop(bo, None)
+            for fk in stale:
+                # DETACH in-flight fills: their existing followers keep
+                # streaming the pre-write view (those GETs began before
+                # the write), but a GET arriving from here on must not
+                # join a fill that started before this mutation — it
+                # leads a fresh erasure read instead (read-after-write).
+                # The detached fill can never commit: its generation
+                # predates this bump (the counter never reuses values).
+                self._fills.pop(fk)
+            self.invalidations += 1
+
+    # --------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        # lock-free advisory snapshot (same reasoning as probe(): the
+        # metrics scrape runs on the event loop, and plain int/len
+        # reads are consistent-enough under the GIL — a scrape racing
+        # an admit may be one entry off, never torn)
+        looked = self.hits + self.misses
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "fills": self.fills,
+            "collapsed": self.collapsed,
+            "evictions": self.evictions,
+            "invalidations": self.invalidations,
+            "bytes": self._bytes,
+            "fillBytes": self._fill_bytes,
+            "entries": len(self._prob) + len(self._prot),
+            "protectedBytes": self._prot_bytes,
+            "maxBytes": self.max_bytes,
+            "maxObjBytes": self.max_obj_bytes,
+            "hitRatio": round(self.hits / looked, 6) if looked
+            else 0.0,
+        }

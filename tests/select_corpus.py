@@ -64,7 +64,11 @@ def gen_query(rng: random.Random) -> str:
         return (f"SELECT COUNT(*) FROM s3object WHERE {lhs} "
                 f"{rng.choice(_OPS)} {lit}")
     if kind == 1:
-        pat = rng.choice(["%5%", "a_c", "%é", "H%", "%"])
+        # contains shapes (%needle%) exercise the vectorized substring
+        # scan in select/batch.py (ISSUE 7 satellite) alongside the
+        # prefix/suffix/eq anchors and the per-row-only shapes
+        pat = rng.choice(["%5%", "a_c", "%é", "H%", "%", "%EL%",
+                          "%abc%", "%%", "%.1%"])
         return (f"SELECT COUNT(*) FROM s3object WHERE {col} "
                 f"LIKE '{pat}'")
     if kind == 2:

@@ -1,0 +1,724 @@
+"""Hot-object serving tier differential suite (ISSUE 7).
+
+The in-RAM tier (minio_tpu/serving/hotcache.py) must be INVISIBLE to
+clients except for speed: every cached response byte-identical to the
+uncached path (whole-object, Range, conditional 304/412 with the
+ETag-over-date precedence rules), strict invalidation on every write
+path (overwrite / copy / delete / multipart / heal rewrite, including a
+write racing an in-flight fill), singleflight collapse (N concurrent
+cold GETs -> one erasure read), TinyLFU-gated admission + segmented-LRU
+eviction, and no leaked threads.
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+
+import pytest
+
+from minio_tpu.erasure.objects import ObjectInfo
+from minio_tpu.erasure.sets import ErasureServerPools, ErasureSets
+from minio_tpu.serving.hotcache import HotObjectCache
+from minio_tpu.storage.local import LocalStorage
+
+from .s3_harness import S3TestServer
+
+HOT_ENV = {"MINIO_TPU_HOTCACHE_BYTES": str(8 << 20)}
+
+
+class _CountingDisk:
+    """LocalStorage wrapper counting metadata + shard-stream reads."""
+
+    def __init__(self, inner, counters: dict):
+        self._inner = inner
+        self._c = counters
+
+    def read_version(self, *a, **kw):
+        self._c["read_version"] += 1
+        return self._inner.read_version(*a, **kw)
+
+    def read_file_stream(self, *a, **kw):
+        self._c["read_file_stream"] += 1
+        return self._inner.read_file_stream(*a, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+@pytest.fixture()
+def hot_srv(tmp_path, monkeypatch):
+    for k, v in HOT_ENV.items():
+        monkeypatch.setenv(k, v)
+    counters = {"read_version": 0, "read_file_stream": 0}
+    disks = [_CountingDisk(LocalStorage(str(tmp_path / f"d{i}")),
+                           counters)
+             for i in range(4)]
+    pools = ErasureServerPools([ErasureSets(disks)])
+    srv = S3TestServer(str(tmp_path / "unused"), pools=pools)
+    yield srv, srv.server.hotcache, counters, pools
+    srv.close()
+
+
+@pytest.fixture()
+def cold_srv(tmp_path):
+    # tier off — the uncached differential reference (the env may be
+    # set by hot_srv in the same test: strip it around construction)
+    import os
+
+    old = os.environ.pop("MINIO_TPU_HOTCACHE_BYTES", None)
+    try:
+        srv = S3TestServer(str(tmp_path / "cold"), n_drives=4)
+    finally:
+        if old is not None:
+            os.environ["MINIO_TPU_HOTCACHE_BYTES"] = old
+    assert srv.server.hotcache is None
+    yield srv
+    srv.close()
+
+
+def _warm(srv, path, n=3):
+    """Read until resident (admission needs the 2nd access to fill)."""
+    last = None
+    for _ in range(n):
+        last = srv.request("GET", path)
+    return last
+
+
+# ------------------------------------------------------- byte identity
+class TestByteIdentity:
+    SIZES = [0, 1, 100, 4096, 128 * 1024 + 17, 600 * 1024]
+
+    @pytest.mark.parametrize("size", SIZES)
+    def test_whole_object_identical(self, hot_srv, cold_srv, size):
+        hot, hc, _, _ = hot_srv
+        data = bytes(range(256)) * (size // 256) + b"x" * (size % 256)
+        for s in (hot, cold_srv):
+            s.request("PUT", "/idb")
+            assert s.request("PUT", "/idb/o", data=data).status == 200
+        cold_r = cold_srv.request("GET", "/idb/o")
+        hot_r = _warm(hot, "/idb/o")
+        assert hc.stats()["hits"] >= 1, "tier never engaged"
+        assert hot_r.status == cold_r.status == 200
+        assert hot_r.body == cold_r.body == data
+        for h in ("ETag", "Content-Type", "Content-Length",
+                  "Accept-Ranges"):
+            assert hot_r.headers.get(h) == cold_r.headers.get(h), h
+
+    @pytest.mark.parametrize("rng", ["bytes=0-0", "bytes=10-99",
+                                     "bytes=-17", "bytes=4000-",
+                                     "bytes=0-999999"])
+    def test_range_identical(self, hot_srv, cold_srv, rng):
+        hot, hc, _, _ = hot_srv
+        data = bytes(range(256)) * 40
+        for s in (hot, cold_srv):
+            s.request("PUT", "/rgb")
+            s.request("PUT", "/rgb/o", data=data)
+        _warm(hot, "/rgb/o")
+        h0 = hc.stats()["hits"]
+        hot_r = hot.request("GET", "/rgb/o", headers={"Range": rng})
+        cold_r = cold_srv.request("GET", "/rgb/o", headers={"Range": rng})
+        assert hc.stats()["hits"] == h0 + 1, "range did not hit the tier"
+        assert hot_r.status == cold_r.status
+        assert hot_r.body == cold_r.body
+        assert hot_r.headers.get("Content-Range") == \
+            cold_r.headers.get("Content-Range")
+
+    def test_invalid_range_identical(self, hot_srv, cold_srv):
+        hot, hc, _, _ = hot_srv
+        for s in (hot, cold_srv):
+            s.request("PUT", "/rgc")
+            s.request("PUT", "/rgc/o", data=b"0123456789")
+        _warm(hot, "/rgc/o")
+        hdr = {"Range": "bytes=50-60"}
+        hot_r = hot.request("GET", "/rgc/o", headers=hdr)
+        cold_r = cold_srv.request("GET", "/rgc/o", headers=hdr)
+        assert hot_r.status == cold_r.status == 416
+
+    def test_multipart_object_cached_identical(self, hot_srv):
+        hot, hc, _, _ = hot_srv
+        hot.request("PUT", "/mpb")
+        part = b"p" * (5 << 20)
+        r = hot.request("POST", "/mpb/big", query=[("uploads", "")])
+        uid = r.body.split(b"<UploadId>")[1].split(b"</UploadId>")[0] \
+            .decode()
+        etags = []
+        for n in (1, 2):
+            pr = hot.request("PUT", "/mpb/big",
+                             query=[("uploadId", uid),
+                                    ("partNumber", str(n))], data=part)
+            etags.append(pr.headers["ETag"])
+        body = ("<CompleteMultipartUpload>" + "".join(
+            f"<Part><PartNumber>{n}</PartNumber><ETag>{e}</ETag></Part>"
+            for n, e in zip((1, 2), etags)) +
+            "</CompleteMultipartUpload>").encode()
+        assert hot.request("POST", "/mpb/big",
+                           query=[("uploadId", uid)],
+                           data=body).status == 200
+        # 10 MiB > max_obj_bytes (1 MiB at an 8 MiB tier): every GET
+        # must take the classic path, byte-identical, never admitted
+        r1 = hot.request("GET", "/mpb/big")
+        r2 = hot.request("GET", "/mpb/big")
+        assert r1.body == r2.body == part * 2
+        assert hc.stats()["bytes"] == 0
+
+
+# --------------------------------------------------------- conditional
+class TestConditionalFastPath:
+    def test_304_hit_zero_metadata_reads(self, hot_srv):
+        hot, hc, counters, _ = hot_srv
+        hot.request("PUT", "/cdb")
+        hot.request("PUT", "/cdb/o", data=b"conditional me")
+        r = _warm(hot, "/cdb/o")
+        etag = r.headers["ETag"]
+        lm = r.headers["Last-Modified"]
+        rv0 = counters["read_version"]
+        rf0 = counters["read_file_stream"]
+        r304 = hot.request("GET", "/cdb/o",
+                           headers={"If-None-Match": etag})
+        assert r304.status == 304
+        r304h = hot.request("HEAD", "/cdb/o",
+                            headers={"If-Modified-Since": lm})
+        assert r304h.status == 304
+        assert counters["read_version"] == rv0, \
+            "304 on a cache hit read xl.meta"
+        assert counters["read_file_stream"] == rf0
+
+    def test_precedence_identical_to_uncached(self, hot_srv, cold_srv):
+        """ETag conditions override date conditions (the app.py rules):
+        the hot path must evaluate them in exactly the same order."""
+        hot, hc, _, _ = hot_srv
+        for s in (hot, cold_srv):
+            s.request("PUT", "/pcb")
+            s.request("PUT", "/pcb/o", data=b"precedence")
+        hot_w = _warm(hot, "/pcb/o")
+        cold_w = cold_srv.request("GET", "/pcb/o")
+        cases = [
+            # If-None-Match mismatch wins over a far-future
+            # If-Modified-Since: 200, not 304
+            {"If-None-Match": '"nope"',
+             "If-Modified-Since": "Fri, 01 Jan 2100 00:00:00 GMT"},
+            # matching If-Match overrides If-Unmodified-Since: 200
+            {"If-Match": hot_w.headers["ETag"],
+             "If-Unmodified-Since": "Mon, 01 Jan 1990 00:00:00 GMT"},
+            # If-Match mismatch: 412
+            {"If-Match": '"nope"'},
+            # stale If-Unmodified-Since alone: 412
+            {"If-Unmodified-Since": "Mon, 01 Jan 1990 00:00:00 GMT"},
+            # If-None-Match match: 304
+            {"If-None-Match": hot_w.headers["ETag"]},
+            # future If-Modified-Since alone: 304
+            {"If-Modified-Since": "Fri, 01 Jan 2100 00:00:00 GMT"},
+        ]
+        cold_cases = list(cases)
+        cold_cases[1] = dict(cases[1], **{
+            "If-Match": cold_w.headers["ETag"]})
+        cold_cases[4] = {"If-None-Match": cold_w.headers["ETag"]}
+        h0 = hc.stats()["hits"]
+        for hot_hdr, cold_hdr in zip(cases, cold_cases):
+            hr = hot.request("GET", "/pcb/o", headers=hot_hdr)
+            cr = cold_srv.request("GET", "/pcb/o", headers=cold_hdr)
+            assert hr.status == cr.status, (hot_hdr, hr.status,
+                                            cr.status)
+        assert hc.stats()["hits"] >= h0 + len(cases)
+
+
+# --------------------------------------------------------- invalidation
+class TestInvalidationMatrix:
+    def _put_warm(self, srv, path, data):
+        srv.request("PUT", "/" + path.split("/")[1])
+        srv.request("PUT", path, data=data)
+        _warm(srv, path)
+
+    def test_overwrite_put(self, hot_srv):
+        hot, hc, _, _ = hot_srv
+        self._put_warm(hot, "/ivb/o", b"old-bytes")
+        hot.request("PUT", "/ivb/o", data=b"NEW-bytes")
+        assert hot.request("GET", "/ivb/o").body == b"NEW-bytes"
+        assert _warm(hot, "/ivb/o").body == b"NEW-bytes"
+
+    def test_copy_onto_cached_destination(self, hot_srv):
+        hot, hc, _, _ = hot_srv
+        self._put_warm(hot, "/ivc/dst", b"stale destination")
+        hot.request("PUT", "/ivc/src", data=b"fresh source bytes")
+        r = hot.request("PUT", "/ivc/dst",
+                        headers={"x-amz-copy-source": "/ivc/src"})
+        assert r.status == 200
+        assert hot.request("GET", "/ivc/dst").body == \
+            b"fresh source bytes"
+        assert _warm(hot, "/ivc/dst").body == b"fresh source bytes"
+
+    def test_delete_and_bulk_delete(self, hot_srv):
+        hot, hc, _, _ = hot_srv
+        self._put_warm(hot, "/ivd/o", b"delete me")
+        hot.request("DELETE", "/ivd/o")
+        assert hot.request("GET", "/ivd/o").status == 404
+        self._put_warm(hot, "/ivd/p", b"bulk delete me")
+        body = (b'<Delete><Object><Key>p</Key></Object></Delete>')
+        hot.request("POST", "/ivd", query=[("delete", "")], data=body)
+        assert hot.request("GET", "/ivd/p").status == 404
+
+    def test_version_delete(self, hot_srv):
+        hot, hc, _, _ = hot_srv
+        hot.request("PUT", "/ivv")
+        hot.request("PUT", "/ivv", query=[("versioning", "")], data=(
+            b"<VersioningConfiguration><Status>Enabled</Status>"
+            b"</VersioningConfiguration>"))
+        r1 = hot.request("PUT", "/ivv/o", data=b"v1")
+        vid1 = r1.headers["x-amz-version-id"]
+        hot.request("PUT", "/ivv/o", data=b"v2")
+        for _ in range(3):
+            assert hot.request("GET", "/ivv/o",
+                               query=[("versionId", vid1)]).body == b"v1"
+            assert hot.request("GET", "/ivv/o").body == b"v2"
+        # delete the cached non-latest version: its entries must drop
+        hot.request("DELETE", "/ivv/o", query=[("versionId", vid1)])
+        assert hot.request("GET", "/ivv/o",
+                           query=[("versionId", vid1)]).status == 404
+        assert hot.request("GET", "/ivv/o").body == b"v2"
+
+    def test_multipart_complete_overwrites(self, hot_srv):
+        hot, hc, _, _ = hot_srv
+        self._put_warm(hot, "/ivm/o", b"simple old")
+        r = hot.request("POST", "/ivm/o", query=[("uploads", "")])
+        uid = r.body.split(b"<UploadId>")[1].split(b"</UploadId>")[0] \
+            .decode()
+        data = b"m" * 4096
+        pr = hot.request("PUT", "/ivm/o",
+                         query=[("uploadId", uid), ("partNumber", "1")],
+                         data=data)
+        body = ("<CompleteMultipartUpload><Part><PartNumber>1"
+                f"</PartNumber><ETag>{pr.headers['ETag']}</ETag>"
+                "</Part></CompleteMultipartUpload>").encode()
+        assert hot.request("POST", "/ivm/o", query=[("uploadId", uid)],
+                           data=body).status == 200
+        assert hot.request("GET", "/ivm/o").body == data
+        assert _warm(hot, "/ivm/o").body == data
+
+    def test_heal_rewrite_invalidates(self, hot_srv):
+        hot, hc, _, pools = hot_srv
+        self._put_warm(hot, "/ivh/o", b"heal-rewritten object " * 100)
+        inv0 = hc.stats()["invalidations"]
+        es = pools.pools[0].sets[0]
+        res = es.heal_object("ivh", "o")  # no-op heal: nothing rewritten
+        assert res.healed_drives == 0
+        assert hc.stats()["invalidations"] == inv0, \
+            "a no-op heal must not churn the cache"
+        # now damage one drive's copy and heal for real
+        import os
+        import shutil
+
+        root = es.disks[0].unwrap_root() if hasattr(
+            es.disks[0], "unwrap_root") else None
+        # walk the first drive's bucket dir and drop the object dir
+        d0 = es.disks[0]
+        droot = getattr(d0, "root", None) or getattr(
+            d0._inner, "root")  # _CountingDisk wraps LocalStorage
+        objdir = os.path.join(droot, "ivh", "o")
+        assert os.path.isdir(objdir)
+        shutil.rmtree(objdir)
+        res = es.heal_object("ivh", "o")
+        assert res.healed_drives >= 1
+        assert hc.stats()["invalidations"] == inv0 + 1, \
+            "heal rewrite did not fire the invalidation choke point"
+        assert _warm(hot, "/ivh/o").body == b"heal-rewritten object " * 100
+
+
+# ------------------------------------------------ collapse / race units
+def _oi(size, etag="e1", name="o", bucket="b"):
+    return ObjectInfo(bucket=bucket, name=name, size=size, etag=etag,
+                      mod_time=1.0)
+
+
+class TestSingleflight:
+    def test_n_cold_gets_one_erasure_read(self):
+        import time
+
+        hc = HotObjectCache(1 << 20, min_hits=2)
+        data = b"z" * 10000
+        calls = {"info": 0, "data": 0}
+        joined = threading.Barrier(8)
+
+        def info_fn():
+            calls["info"] += 1
+            return _oi(len(data))
+
+        def data_fn():
+            calls["data"] += 1
+            # the leader streams only once all 7 others are queued at
+            # the latch (followers count `collapsed` at join time), so
+            # the drill is deterministic: nobody can miss the fill
+            deadline = time.monotonic() + 10
+            while hc.stats()["collapsed"] < 7 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.002)
+
+            def stream():
+                for i in range(0, len(data), 1024):
+                    yield data[i:i + 1024]
+            return _oi(len(data)), stream()
+
+        results = [None] * 8
+
+        def worker(i):
+            hc.lookup("b", "o", "")
+            joined.wait(10)
+            kind, oi, payload = hc.serve("b", "o", "", info_fn, data_fn)
+            body = payload if isinstance(payload, bytes) \
+                else b"".join(payload)
+            results[i] = (kind, body)
+
+        ts = [threading.Thread(target=worker, args=(i,))
+              for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(20)
+        assert calls["info"] == 1, "followers read xl.meta"
+        assert calls["data"] == 1, \
+            f"{calls['data']} erasure reads for 8 concurrent GETs"
+        assert all(body == data for _, body in results)
+        kinds = sorted(k for k, _ in results)
+        assert kinds.count("filled") == 1
+        assert kinds.count("collapsed") == 7
+        assert hc.stats()["collapsed"] == 7
+        # 8 accesses >= min_hits: the shared fill was admitted
+        assert hc.lookup("b", "o", "") is not None
+
+    def test_collapsed_error_propagates(self):
+        from minio_tpu.storage import errors as st
+
+        hc = HotObjectCache(1 << 20)
+        started = threading.Event()
+        release = threading.Event()
+
+        def info_fn():
+            started.set()
+            release.wait(10)
+            raise st.ObjectNotFound("b/o")
+
+        def data_fn():  # pragma: no cover - never reached
+            raise AssertionError
+
+        errs = []
+
+        def leader():
+            try:
+                hc.serve("b", "o", "", info_fn, data_fn)
+            except st.ObjectNotFound as e:
+                errs.append(e)
+
+        t = threading.Thread(target=leader)
+        t.start()
+        started.wait(10)
+
+        def follower():
+            try:
+                hc.serve("b", "o", "", info_fn, data_fn)
+            except st.ObjectNotFound as e:
+                errs.append(e)
+
+        t2 = threading.Thread(target=follower)
+        t2.start()
+        # the follower is queued on the latch before the leader fails
+        import time
+        time.sleep(0.05)
+        release.set()
+        t.join(10)
+        t2.join(10)
+        assert len(errs) == 2, "collapsed 404 did not propagate"
+
+
+class TestWriteRacesFill:
+    def test_invalidation_mid_fill_discards_stale_bytes(self):
+        """ChaosDisk-shaped race, deterministic: the choke point fires
+        WHILE a fill is streaming old bytes — the fill must complete
+        for its own client but never become serveable."""
+        hc = HotObjectCache(1 << 20, min_hits=1)
+        old, new = b"OLD" * 1000, b"NEW" * 1000
+        mid_read = threading.Event()
+        wrote = threading.Event()
+
+        def data_fn():
+            def stream():
+                yield old[:1500]
+                mid_read.set()
+                assert wrote.wait(10)  # writer commits + invalidates
+                yield old[1500:]
+            return _oi(len(old)), stream()
+
+        def racer():
+            mid_read.wait(10)
+            hc.invalidate("b", "o")  # the write's choke-point call
+            wrote.set()
+
+        t = threading.Thread(target=racer)
+        t.start()
+        kind, oi, payload = hc.serve("b", "o", "",
+                                     lambda: _oi(len(old)), data_fn)
+        t.join(10)
+        assert kind == "filled" and payload == old  # reader's own view
+        assert hc.lookup("b", "o", "") is None, \
+            "stale bytes became serveable after a racing write"
+        assert hc.stats()["invalidations"] == 1
+
+    def test_get_after_invalidate_never_joins_stale_fill(self):
+        """Read-after-write: a GET arriving AFTER a write completed
+        (and invalidated) must not collapse onto a fill that began
+        before the write — it leads a fresh erasure read.  The stale
+        fill keeps streaming its pre-write view to its own followers
+        but can never commit."""
+        hc = HotObjectCache(1 << 20, min_hits=1)
+        old, new = b"OLD" * 500, b"NEW" * 500
+        mid = threading.Event()
+        go = threading.Event()
+
+        def old_data_fn():
+            def stream():
+                yield old[:100]
+                mid.set()
+                assert go.wait(10)
+                yield old[100:]
+            return _oi(len(old), etag="old"), stream()
+
+        res = {}
+
+        def leader():
+            res["lead"] = hc.serve("b", "o", "",
+                                   lambda: _oi(len(old), etag="old"),
+                                   old_data_fn)
+
+        t = threading.Thread(target=leader)
+        t.start()
+        mid.wait(10)
+        hc.invalidate("b", "o")  # the writer's choke-point call
+        # this GET began after the write: fresh bytes, no collapse
+        kind, oi, payload = hc.serve(
+            "b", "o", "", lambda: _oi(len(new), etag="new"),
+            lambda: (_oi(len(new), etag="new"), iter([new])))
+        assert kind == "filled" and payload == new, \
+            "post-write GET joined a pre-write fill (stale read)"
+        go.set()
+        t.join(10)
+        # the pre-write leader served its own client its own view...
+        assert res["lead"][0] == "filled" and res["lead"][2] == old
+        # ...but only the fresh bytes are serveable
+        ent = hc.lookup("b", "o", "")
+        assert ent is not None and ent.data == new
+        assert ent.oi.etag == "new"
+
+    def test_fill_after_invalidate_commits_fresh(self):
+        hc = HotObjectCache(1 << 20, min_hits=1)
+        hc.invalidate("b", "o")  # nothing cached: no-op
+        data = b"fresh" * 100
+
+        def data_fn():
+            return _oi(len(data)), iter([data])
+
+        hc.serve("b", "o", "", lambda: _oi(len(data)), data_fn)
+        ent = hc.lookup("b", "o", "")
+        assert ent is not None and ent.data == data
+
+
+class TestDistributedGating:
+    def test_tier_disabled_when_any_drive_remote(self, tmp_path,
+                                                 monkeypatch):
+        """ns_updated fires only on the WRITING node, so with remote
+        drives a peer's overwrite would leave this node's RAM tier
+        stale forever: the tier must auto-disable (cross-node
+        invalidation broadcast is the ROADMAP follow-up)."""
+        monkeypatch.setenv("MINIO_TPU_HOTCACHE_BYTES", str(8 << 20))
+
+        class FakeRemote:
+            def __init__(self, inner):
+                self._inner = inner
+
+            def is_local(self):
+                return False
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(3)]
+        disks.append(FakeRemote(LocalStorage(str(tmp_path / "d3"))))
+        pools = ErasureServerPools([ErasureSets(disks)])
+        srv = S3TestServer(str(tmp_path / "unused"), pools=pools)
+        try:
+            assert srv.server.hotcache is None
+        finally:
+            srv.close()
+
+
+class TestFillRamCap:
+    def test_concurrent_fill_bytes_bounded_by_tier_budget(self):
+        """In-flight fill buffers are charged against max_bytes: once
+        reserved fills reach the budget, further cold GETs decline to
+        buffer ('miss' → classic streaming path) instead of holding an
+        unbounded sum of fill RAM."""
+        hc = HotObjectCache(10_000, max_obj_bytes=8_000, min_hits=1)
+        data_a = b"A" * 8_000
+        mid = threading.Event()
+        go = threading.Event()
+
+        def slow_data_fn():
+            def stream():
+                yield data_a[:100]
+                mid.set()
+                assert go.wait(10)
+                yield data_a[100:]
+            return _oi(len(data_a), name="a"), stream()
+
+        res = {}
+
+        def leader():
+            res["a"] = hc.serve("b", "a", "",
+                                lambda: _oi(len(data_a), name="a"),
+                                slow_data_fn)
+
+        t = threading.Thread(target=leader)
+        t.start()
+        mid.wait(10)
+        assert hc.stats()["fillBytes"] == 8_000
+        # a second cold key cannot reserve 8000 more against a 10000
+        # budget: it must fall back, NOT buffer
+        data_b = b"B" * 8_000
+        kind, oi, payload = hc.serve(
+            "b", "other", "", lambda: _oi(len(data_b), name="other"),
+            lambda: (_ for _ in ()).throw(AssertionError(
+                "declined fill must not read")))
+        assert kind == "miss" and payload is None
+        go.set()
+        t.join(10)
+        assert res["a"][0] == "filled" and res["a"][2] == data_a
+        assert hc.stats()["fillBytes"] == 0, "reservation leaked"
+        # with the reservation released, the key fills normally
+        kind, _, payload = hc.serve(
+            "b", "other", "", lambda: _oi(len(data_b), name="other"),
+            lambda: (_oi(len(data_b), name="other"), iter([data_b])))
+        assert kind == "filled" and payload == data_b
+
+
+class TestMissAccounting:
+    def test_lookup_counts_terminal_misses_and_feeds_admission(self):
+        """HEAD/Range misses never reach serve(): lookup counts them
+        (honest hit ratio) and feeds the frequency sketch, so an object
+        only ever probed that way can still clear the min-hits gate."""
+        hc = HotObjectCache(1 << 20, min_hits=2)
+        assert hc.lookup("b", "o", "") is None       # e.g. a cold HEAD
+        assert hc.stats()["misses"] == 1
+        # the GET path does not double-count (serve counts it instead)
+        assert hc.lookup("b", "o", "", count_miss=False) is None
+        assert hc.stats()["misses"] == 1
+        data = b"d" * 100
+        kind, _, _ = hc.serve(
+            "b", "o", "", lambda: _oi(len(data)),
+            lambda: (_oi(len(data)), iter([data])))
+        # freq: lookup(1) + serve(1) = 2 >= min_hits → admitted on what
+        # is only the first full GET
+        assert kind == "filled"
+        assert hc.lookup("b", "o", "") is not None
+        assert hc.stats()["misses"] == 2
+
+
+# ------------------------------------------------- admission / eviction
+class TestAdmissionEviction:
+    def _fill(self, hc, name, data, times=1):
+        for _ in range(times):
+            kind, _, _ = hc.serve(
+                "b", name, "", lambda: _oi(len(data), name=name),
+                lambda: (_oi(len(data), name=name), iter([data])))
+        return kind
+
+    def test_second_access_admission(self):
+        hc = HotObjectCache(1 << 20, min_hits=2)
+        data = b"d" * 1000
+        self._fill(hc, "o", data)
+        assert hc.stats()["bytes"] == 0, "admitted on first access"
+        self._fill(hc, "o", data)
+        assert hc.stats()["bytes"] == len(data)
+        assert hc.lookup("b", "o", "").data == data
+
+    def test_huge_object_never_admitted(self):
+        hc = HotObjectCache(1 << 20, max_obj_bytes=1000, min_hits=1)
+        big = b"B" * 2000
+        kind = self._fill(hc, "big", big, times=3)
+        assert kind == "miss"
+        assert hc.stats()["bytes"] == 0
+
+    def test_eviction_respects_budget_and_counts(self):
+        hc = HotObjectCache(10_000, max_obj_bytes=4000, min_hits=1)
+        for i in range(8):
+            self._fill(hc, f"o{i}", bytes([i]) * 3000)
+        st = hc.stats()
+        assert st["bytes"] <= 10_000
+        assert st["evictions"] >= 5
+        assert st["entries"] == st["bytes"] // 3000
+
+    def test_admission_declines_oversized_eviction_sweep(self):
+        """An admit that would evict thousands of tiny entries is
+        declined: the sweep would hold the cache mutex through O(n)
+        work while the event loop's lookup() waits behind it, and one
+        object displacing a thousand hot entries is a poor trade."""
+        hc = HotObjectCache(100_000, max_obj_bytes=90_000, min_hits=1)
+        for i in range(1000):
+            self._fill(hc, f"t{i}", b"x" * 100)
+        st0 = hc.stats()
+        assert st0["entries"] == 1000
+        kind = self._fill(hc, "big", b"B" * 90_000)
+        st1 = hc.stats()
+        assert kind == "filled"  # the request itself is served
+        assert hc.lookup("b", "big", "") is None, \
+            "oversized-sweep admission was not declined"
+        assert st1["entries"] == 1000 and st1["evictions"] == 0
+        # a small object still admits normally (bounded sweep)
+        self._fill(hc, "small", b"s" * 500)
+        assert hc.lookup("b", "small", "") is not None
+
+    def test_slru_protects_reused_entries_from_scan(self):
+        hc = HotObjectCache(10_000, max_obj_bytes=4000, min_hits=1)
+        hotdata = b"H" * 3000
+        self._fill(hc, "hot", hotdata)
+        assert hc.lookup("b", "hot", "") is not None  # -> protected
+        # scan of one-hit wonders churns probation only
+        for i in range(20):
+            self._fill(hc, f"scan{i}", bytes([i % 251]) * 3000)
+        ent = hc.lookup("b", "hot", "")
+        assert ent is not None and ent.data == hotdata, \
+            "scan flushed the protected segment"
+
+    def test_no_thread_leaks(self, hot_srv):
+        hot, hc, _, _ = hot_srv
+        hot.request("PUT", "/lkb")
+        hot.request("PUT", "/lkb/o", data=b"leak check " * 100)
+        before = threading.active_count()
+        for _ in range(10):
+            hot.request("GET", "/lkb/o")
+        ts = [threading.Thread(
+            target=lambda: hot.request("GET", "/lkb/o"))
+            for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(20)
+        assert threading.active_count() <= before + 1
+
+
+# ------------------------------------------------------------ economics
+class TestEconomics:
+    def test_hot_metrics_rendered(self, hot_srv):
+        hot, hc, _, _ = hot_srv
+        hot.request("PUT", "/mxb")
+        hot.request("PUT", "/mxb/o", data=b"metrics")
+        _warm(hot, "/mxb/o")
+        r = hot.request("GET", "/minio/v2/metrics/cluster")
+        assert r.status == 200
+        text = r.text()
+        for m in ("minio_hotcache_hits_total",
+                  "minio_hotcache_misses_total",
+                  "minio_hotcache_fills_total",
+                  "minio_hotcache_collapsed_reads_total",
+                  "minio_hotcache_evictions_total",
+                  "minio_hotcache_invalidations_total",
+                  "minio_hotcache_bytes",
+                  "minio_hotcache_hit_ratio"):
+            assert m in text, m

@@ -73,6 +73,11 @@ class TestCsvBatch:
         "SELECT COUNT(*) FROM s3object WHERE a LIKE 'r1%'",
         "SELECT COUNT(*) FROM s3object WHERE a LIKE '%9'",
         "SELECT COUNT(*) FROM s3object WHERE a NOT LIKE 'r%'",
+        "SELECT COUNT(*) FROM s3object WHERE a LIKE '%17%'",
+        "SELECT COUNT(*) FROM s3object WHERE a NOT LIKE '%42%'",
+        "SELECT COUNT(*) FROM s3object WHERE a LIKE '%%'",
+        "SELECT COUNT(*) FROM s3object WHERE a LIKE '%r499%'",
+        "SELECT COUNT(*) FROM s3object WHERE b LIKE '%0%'",
         "SELECT COUNT(*) FROM s3object WHERE b IN (1, 500, 999)",
         "SELECT COUNT(*) FROM s3object WHERE b NOT BETWEEN 5 AND 995",
         "SELECT COUNT(*) FROM s3object WHERE a IS NULL",

@@ -799,6 +799,21 @@ class TestStrictJsonGrammar:
                      "SELECT COUNT(a) FROM s3object"):
             _differential(expr, data, inp=inp, out={"JSON": {}})
 
+    def test_escaped_key_replays(self):
+        """A backslash in a KEY means its raw bytes differ from the
+        decoded name: `{"\\u0061":1}` IS the column `a` after decode,
+        but a raw memcmp against `a` misses — the line must replay
+        through Python (same rule as escaped values; pre-fix the C
+        scanners matched keys on raw bytes and silently dropped the
+        field)."""
+        data = (b'{"\\u0061":1,"n":1}\n' * 30 +
+                b'{"a":2,"n":2}\n' * 30)
+        for expr in ("SELECT COUNT(*) FROM s3object WHERE a > 0",
+                     "SELECT SUM(a) FROM s3object",
+                     "SELECT COUNT(a) FROM s3object"):
+            _differential(expr, data, inp={"JSON": {"Type": "LINES"}},
+                          out={"JSON": {}})
+
     def test_escaped_value_keeps_other_keys_fast(self):
         """A backslash in one VALUE no longer punts the whole line:
         querying a different key must not replay (escape-light fast

@@ -105,3 +105,82 @@ class TestServerModeCache:
         r = srv.request("GET", "/cbk7/v", query=[("versionId", vid)])
         assert r.body == b"ver1"
         assert srv.request("GET", "/cbk7/v").body == b"ver2"
+
+
+class TestCopyInvalidation:
+    """ISSUE 7 satellite: a copy overwriting a cached destination must
+    invalidate it — pre-fix, CacheLayer delegated copy_object through
+    __getattr__ and a GET after the copy served the stale cached
+    bytes."""
+
+    def test_server_side_copy_invalidates_destination(self, tmp_path):
+        class Inner:
+            """Minimal object layer with a server-side copy_object
+            (reference CopyObject ordering: src pair, then dst)."""
+
+            def __init__(self):
+                self.objs = {}
+
+            def get_object_info(self, bucket, obj, version_id=""):
+                from minio_tpu.erasure.objects import ObjectInfo
+
+                data, etag = self.objs[(bucket, obj)]
+                return ObjectInfo(bucket=bucket, name=obj,
+                                  size=len(data), etag=etag)
+
+            def get_object(self, bucket, obj, offset=0, length=-1,
+                           version_id=""):
+                data, _ = self.objs[(bucket, obj)]
+                end = len(data) if length < 0 else offset + length
+                return (self.get_object_info(bucket, obj),
+                        iter([data[offset:end]]))
+
+            def put_object(self, bucket, obj, reader, size=-1,
+                           opts=None):
+                data = reader.read()
+                self.objs[(bucket, obj)] = (data, f"e{len(data)}")
+                return self.get_object_info(bucket, obj)
+
+            def copy_object(self, sb, so, db, do):
+                self.objs[(db, do)] = self.objs[(sb, so)]
+                return self.get_object_info(db, do)
+
+        import io as io_mod
+
+        inner = Inner()
+        layer = CacheLayer(inner, str(tmp_path / "dcache"),
+                           max_size=1 << 20)
+        layer.put_object("b", "dst", io_mod.BytesIO(b"old destination"))
+        layer.put_object("b", "src", io_mod.BytesIO(b"fresh source!!"))
+        # warm the cache with the destination's old bytes
+        _, s = layer.get_object("b", "dst")
+        assert b"".join(s) == b"old destination"
+        _, s = layer.get_object("b", "dst")
+        assert b"".join(s) == b"old destination"
+        assert layer.hits >= 1
+        # server-side copy overwrites the cached destination
+        layer.copy_object("b", "src", "b", "dst")
+        _, s = layer.get_object("b", "dst")
+        assert b"".join(s) == b"fresh source!!", \
+            "stale cached destination served after copy_object"
+
+    def test_inner_layer_rewrite_invalidates_via_ns_hook(self, tmp_path):
+        """A write that BYPASSES the wrapper (heal/replication writing
+        through the inner erasure layer) must still invalidate: the
+        CacheLayer now registers on the same ns_updated choke point as
+        the hot tier."""
+        import io as io_mod
+
+        disks = [LocalStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+        pools = ErasureServerPools([ErasureSets(disks)])
+        layer = CacheLayer(pools, str(tmp_path / "dcache2"),
+                           max_size=1 << 20)
+        pools.make_bucket("nsb")
+        layer.put_object("nsb", "k", io_mod.BytesIO(b"version-one"))
+        _, s = layer.get_object("nsb", "k")
+        assert b"".join(s) == b"version-one"
+        # bypass the wrapper: write straight to the inner pools
+        pools.put_object("nsb", "k", io_mod.BytesIO(b"version-TWO"))
+        _, s = layer.get_object("nsb", "k")
+        assert b"".join(s) == b"version-TWO", \
+            "inner-layer rewrite served stale disk-cache bytes"
