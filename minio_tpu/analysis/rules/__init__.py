@@ -1,3 +1,4 @@
 """Checker registry: importing this package registers every rule."""
 
-from . import budget, locks, metrics, payload, s3errors, threads  # noqa: F401
+from . import (budget, locks, metrics, payload, s3errors,  # noqa: F401
+               shared_state, threads)

@@ -1,0 +1,71 @@
+"""shared-state: module-global writes in code imported into worker
+processes.
+
+The multi-process data plane (parallel/workers.py) imports parts of
+this package into SPAWNED worker processes.  A module-level mutable
+global written at runtime is per-process state there: the HTTP front's
+copy and every worker's copy silently diverge — counters under-count,
+caches double-allocate, toggles disagree — and nothing crashes, which
+is exactly why it needs a review-time check (ISSUE 8 satellite).
+
+Scope: modules on the worker import surface (the transitive imports of
+the worker entry, listed in WORKER_SURFACE — extend it when the worker
+grows a new dependency).  Detection: the `global NAME` write idiom —
+the explicit way CPython marks function-scope writes to module state.
+In-place mutation of module-level containers (dict/list updates) is
+out of scope for now; the repo's convention routes those through the
+same `global`-guarded helpers (arena pools, singletons), and flagging
+every `.append` would drown the signal.
+
+A flagged site is either a bug (state the front and workers must
+agree on) or intentionally process-local (a per-process buffer pool, a
+per-process lazy singleton) — the latter carries a reasoned pragma:
+
+    global _pool  # lint: allow(shared-state): per-process staging pool by design — each worker owns its drives' buffers
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, rule
+
+#: modules imported into data-plane worker processes (the worker entry
+#: plus its lazy imports: storage, erasure codec/bitrot, host ops).
+WORKER_SURFACE = (
+    "parallel/workers.py",
+    "storage/local.py",
+    "storage/errors.py",
+    "storage/xlmeta.py",
+    "erasure/coding.py",
+    "erasure/bitrot.py",
+    "erasure/stagestats.py",
+    "ops/host.py",
+    "ops/gf256.py",
+    "utils/deadline.py",
+    "utils/hashing.py",
+)
+
+
+@rule("shared-state",
+      "module-global write in a module imported into worker processes "
+      "is per-process state (front and workers silently diverge); "
+      "pragma it as intentionally process-local or lift it into "
+      "explicit cross-process plumbing")
+def check(module, project):
+    path = module.path.replace("\\", "/")
+    if not any(path.endswith(s) for s in WORKER_SURFACE):
+        return []
+    out = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Global):
+            continue
+        names = ", ".join(node.names)
+        out.append(Finding(
+            module.path, node.lineno, node.col_offset, "shared-state",
+            f"function writes module global(s) {names} in a module "
+            "imported into data-plane worker processes — each process "
+            "gets its own copy and they silently diverge; if this "
+            "state is intentionally per-process (buffer pool, lazy "
+            "singleton), say so with a reasoned pragma"))
+    return out

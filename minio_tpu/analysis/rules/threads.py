@@ -1,4 +1,5 @@
-"""thread-lifecycle: every spawned thread needs a shutdown path.
+"""thread-lifecycle: every spawned thread OR process needs a shutdown
+path.
 
 `tests/test_leaks.py` catches leaked threads dynamically, per test —
 this rule catches them at review time.  A non-daemon thread with no
@@ -6,7 +7,15 @@ this rule catches them at review time.  A non-daemon thread with no
 re-assignment) outlives `close()` and hangs interpreter exit; the
 repo's convention is `daemon=True` for service loops owned by
 ServiceManager.close()/stop events, and an explicit join for
-bounded-lifetime workers."""
+bounded-lifetime workers.
+
+Process spawns (`multiprocessing.Process` / `ctx.Process`, ISSUE 8's
+worker plane) are held to a STRICTER bar: daemon=True is not enough —
+a daemonic child is killed only when the parent exits, so a
+non-supervised worker leaks RAM, fds and shm attachments for the
+parent's whole lifetime.  The module must contain a join/terminate/
+kill path on a process-ish receiver (a supervisor), or pragma why
+not."""
 
 from __future__ import annotations
 
@@ -15,6 +24,8 @@ import ast
 from ..core import Finding, call_name, rule, terminal_name
 
 _THREADISH = ("thread", "worker", "probe", "proc")
+_PROCISH = ("proc", "process", "worker", "child")
+_PROC_REAP = ("join", "terminate", "kill")
 
 
 def _is_thread_join(node: ast.Call) -> bool:
@@ -33,6 +44,21 @@ def _is_thread_join(node: ast.Call) -> bool:
     return name in ("t", "th") or any(m in name for m in _THREADISH)
 
 
+def _is_proc_reap(node: ast.Call) -> bool:
+    """A supervision call on a PROCESS-ish receiver: `proc.join()`,
+    `p.terminate()`, `worker.kill()` — the shutdown path a Process
+    spawn must have somewhere in its module."""
+    if call_name(node).rsplit(".", 1)[-1] not in _PROC_REAP:
+        return False
+    if not isinstance(node.func, ast.Attribute):
+        return False
+    recv = node.func.value
+    if isinstance(recv, ast.Constant):
+        return False
+    name = terminal_name(recv).lower().lstrip("_")
+    return name == "p" or any(m in name for m in _PROCISH)
+
+
 def _daemon_kw(node: ast.Call):
     for kw in node.keywords:
         if kw.arg == "daemon":
@@ -43,14 +69,18 @@ def _daemon_kw(node: ast.Call):
 
 
 @rule("thread-lifecycle",
-      "non-daemon Thread with no join/daemon re-assignment in its "
-      "module leaks past shutdown")
+      "non-daemon Thread (or non-supervised multiprocessing.Process) "
+      "with no join/terminate path in its module leaks past shutdown")
 def check(module, project):
     has_join = False
+    has_proc_reap = False
     daemon_assigned = False
     for node in ast.walk(module.tree):
-        if isinstance(node, ast.Call) and _is_thread_join(node):
-            has_join = True
+        if isinstance(node, ast.Call):
+            if _is_thread_join(node):
+                has_join = True
+            if _is_proc_reap(node):
+                has_proc_reap = True
         if isinstance(node, ast.Assign):
             for tgt in node.targets:
                 if isinstance(tgt, ast.Attribute) and tgt.attr == "daemon":
@@ -60,11 +90,25 @@ def check(module, project):
         if not isinstance(node, ast.Call):
             continue
         name = call_name(node)
-        if name.rsplit(".", 1)[-1] != "Thread":
+        last = name.rsplit(".", 1)[-1]
+        if last not in ("Thread", "Process"):
             continue
         if not (node.args or any(kw.arg == "target"
                                  for kw in node.keywords)):
-            continue  # bare Thread() reference, not a spawn
+            continue  # bare Thread()/Process() reference, not a spawn
+        if last == "Process":
+            # daemon=True does NOT excuse a process: a daemonic child
+            # dies only with the parent, so an unsupervised worker
+            # pins RAM/fds/shm for the parent's whole lifetime
+            if not has_proc_reap:
+                out.append(Finding(
+                    module.path, node.lineno, node.col_offset,
+                    "thread-lifecycle",
+                    "multiprocessing.Process spawned but this module "
+                    "has no join/terminate/kill path on a process — a "
+                    "non-supervised worker process outlives close(); "
+                    "give it a supervisor that reaps it"))
+            continue
         daemon = _daemon_kw(node)
         if daemon:
             continue

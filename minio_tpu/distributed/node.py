@@ -264,6 +264,13 @@ class ClusterNode:
             mc = mc_mod.attach(self.pools)
             if mc is not None:
                 mc.broadcast = self.peers.metacache_invalidate
+            # hot-object tier on a distributed deployment: local
+            # mutations broadcast hotcache_invalidate to peers and a
+            # TTL backstop bounds missed-broadcast staleness — the tier
+            # no longer auto-disables when any drive is remote
+            # (ISSUE 8 satellite / ROADMAP item 3 follow-up)
+            self.s3.enable_distributed_hotcache(
+                self.peers.hotcache_invalidate)
             # target bandwidth limits are cluster-wide: each node paces
             # at limit/node_count (internal/bucket/bandwidth semantics)
             repl_pool = getattr(self.s3.services, "replication", None) \

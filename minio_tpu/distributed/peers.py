@@ -45,8 +45,15 @@ class PeerNotifier:
         self.timeout = timeout
 
     # ------------------------------------------------------------- plumbing
-    def _broadcast(self, method: str, args: dict) -> None:
-        """Fire-and-forget to every online peer concurrently."""
+    def _broadcast(self, method: str, args: dict,
+                   join: bool = True) -> None:
+        """Fire-and-forget to every online peer concurrently.  With
+        join=False the caller does not even wait the bounded join —
+        REQUIRED for broadcasts fired inline on the data path (the
+        hotcache invalidation rides every PUT/DELETE; a hung-but-
+        "online" peer must cost the writer nothing, and the receiver
+        side has a TTL backstop for exactly the missed-delivery
+        case)."""
         threads = []
         for client in self.clients.values():
             if not client.is_online():
@@ -61,6 +68,8 @@ class PeerNotifier:
             # control-plane fan-out: budget-free by design (a metadata
             # reload must land on peers even if the request dies)
             threads.append(service_thread(call, name="peer-broadcast"))
+        if not join:
+            return
         for t in threads:
             t.join(self.timeout)
 
@@ -108,6 +117,19 @@ class PeerNotifier:
         (cmd/peer-rest-client.go:739 UpdateMetacacheListing analogue)."""
         self._broadcast("peer.metacache_invalidate",
                         {"bucket": bucket, "at": at})
+
+    # --------------------------------------------------------- hot tier
+    def hotcache_invalidate(self, bucket: str, obj: str) -> None:
+        """A mutation on this node drops the object's bytes from every
+        peer's in-RAM hot tier (serving/hotcache.py) — the cross-node
+        twin of the local ns_updated choke point, mirroring
+        metacache_invalidate.  Best-effort AND non-blocking
+        (join=False): this fires inline on every PUT/DELETE through
+        ns_updated, so the writer never waits on a sick peer; a peer
+        that misses the broadcast converges via the tier's TTL
+        backstop."""
+        self._broadcast("peer.hotcache_invalidate",
+                        {"bucket": bucket, "obj": obj}, join=False)
 
     # ------------------------------------------------------- config reloads
     def reload_tier_config(self) -> None:
@@ -360,6 +382,14 @@ def register_peer_rpc(router, s3_server, node=None) -> None:
                             float(args.get("at", 0)) or None)
         return {}
 
+    def hotcache_invalidate(args, body):
+        """Drop a mutated object from THIS node's hot tier (a peer's
+        write fired its ns_updated and broadcast here)."""
+        hc = getattr(s3_server, "hotcache", None)
+        if hc is not None:
+            hc.invalidate(args.get("bucket", ""), args.get("obj", ""))
+        return {}
+
     def metacache_get(args, body):
         """Serve this node's in-memory listing cache to a peer
         (cmd/peer-rest-client.go:722 GetMetacacheListing)."""
@@ -484,6 +514,7 @@ def register_peer_rpc(router, s3_server, node=None) -> None:
         "peer.bucket_stats": bucket_stats,
         "peer.bandwidth": bandwidth,
         "peer.metacache_invalidate": metacache_invalidate,
+        "peer.hotcache_invalidate": hotcache_invalidate,
         "peer.metacache_get": metacache_get,
         "peer.metacache_update": metacache_update,
         "peer.signal_service": signal_service,

@@ -161,6 +161,27 @@ def register_storage_rpc(router: RpcRouter, drives: dict[str, LocalStorage]) -> 
                                 _fi_from_wire(args["fi"]),
                                 args["dst_volume"], args["dst_path"])
 
+    @h("rename_data_batch")
+    def _rename_data_batch(args, body):
+        """Node-batched xl.meta commit (ISSUE 8 / ROADMAP item 5
+        foundation): ONE RPC commits a PUT's version on every listed
+        drive of this node, instead of one round trip per drive.  One
+        drive failing must not abort its siblings — per-item results
+        travel back like delete_versions'."""
+        out = []
+        for it in args["items"]:
+            d = drives.get(it.get("drive", ""))
+            try:
+                if d is None:
+                    raise errors.DiskNotFound(it.get("drive", "?"))
+                d.rename_data(args["src_volume"], args["src_path"],
+                              _fi_from_wire(it["fi"]),
+                              args["dst_volume"], args["dst_path"])
+                out.append(None)
+            except Exception as e:
+                out.append({"type": type(e).__name__, "msg": str(e)})
+        return {"results": out}
+
     @h("list_dir")
     def _list_dir(args, body):
         return {"entries": drive(args).list_dir(
@@ -489,6 +510,32 @@ class RemoteStorage(StorageAPI):
             "fi": _fi_to_wire(fi), "dst_volume": dst_volume,
             "dst_path": dst_path,
         }, idempotent=False, slow=True)
+
+    def rename_data_batch(self, src_volume: str, src_path: str,
+                          items: list, dst_volume: str,
+                          dst_path: str) -> list[Exception | None]:
+        """Commit one version on MANY drives of this node in one round
+        trip: items = [(drive_id, FileInfo)], one result slot per item
+        (None = committed).  The PUT commit fan-out groups sibling
+        drives by node onto this call, so a 2-node 12-drive set pays 2
+        commit RPCs instead of 6 + 6."""
+        rep = self._call("rename_data_batch", {
+            "src_volume": src_volume, "src_path": src_path,
+            "dst_volume": dst_volume, "dst_path": dst_path,
+            "items": [{"drive": dr, "fi": _fi_to_wire(fi)}
+                      for dr, fi in items],
+        }, idempotent=False, slow=True)
+        res: list[Exception | None] = []
+        for e in rep["results"]:
+            if e is None:
+                res.append(None)
+            else:
+                cls = getattr(errors, e.get("type", ""), errors.StorageError)
+                if not (isinstance(cls, type)
+                        and issubclass(cls, Exception)):
+                    cls = errors.StorageError
+                res.append(cls(e.get("msg", "")))
+        return res
 
     # listing / verification
     def list_dir(self, volume: str, path: str, count: int = -1) -> list[str]:

@@ -80,6 +80,7 @@ _arena_pool_bytes = 0
 
 
 def _arena_acquire(nbytes: int) -> np.ndarray:
+    # lint: allow(shared-state): per-process arena pool by design — each data-plane worker recycles its own read buffers
     global _arena_pool_bytes
     with _arena_lock:
         bucket = _arena_pool.pop(nbytes, None)
@@ -93,6 +94,7 @@ def _arena_acquire(nbytes: int) -> np.ndarray:
 
 
 def _arena_release(arr: np.ndarray) -> None:
+    # lint: allow(shared-state): per-process arena pool by design — see _arena_acquire
     global _arena_pool_bytes
     with _arena_lock:
         if arr.nbytes > _ARENA_POOL_MAX_BYTES:
@@ -111,6 +113,7 @@ def _arena_release(arr: np.ndarray) -> None:
 
 
 def _io_pool() -> cf.ThreadPoolExecutor:
+    # lint: allow(shared-state): per-process executor singleton by design — worker processes need their own shard-io threads
     global _shared_pool
     with _pool_lock:
         if _shared_pool is None:

@@ -214,13 +214,27 @@ class MultipartMixin:
                 d = self.disks[disk_idx]
                 disks_by_index[pos - 1] = d if d is not None and d.is_online() else None
 
-        hreader = _HashingReader(reader, size)
         # stage INSIDE the upload dir under a tmp suffix: the dir already
         # exists on every drive (created at upload init), so staging
         # costs one open + one same-dir rename per drive instead of a
         # mkdir + cross-dir rename + rmdir round trip — fs metadata op
         # latency, not bytes, dominated small parts on the sampler
         tmp_name = f"part.{part_number}.tmp-{uuid.uuid4().hex[:12]}"
+
+        # multi-process data plane (ISSUE 8): parts ride the worker
+        # plane exactly like single-PUT payloads — encode + shard
+        # writes in the I/O workers, etag in the hash lane, one commit
+        # message per worker for the same-dir rename
+        mp_plane = None
+        mp_roots = mp_groups = None
+        from minio_tpu.parallel import workers as workers_mod
+
+        if workers_mod.worker_count() > 0:
+            mp_roots = workers_mod.plane_roots(disks_by_index)
+            if mp_roots is not None:
+                mp_plane = workers_mod.get_plane()
+        hreader = None if mp_plane is not None \
+            else _HashingReader(reader, size)
 
         def cleanup_tmp() -> None:
             def rm(i: int) -> None:
@@ -235,6 +249,53 @@ class MultipartMixin:
 
         shard_hint = -1 if size < 0 else bitrot.bitrot_shard_file_size(
             e.shard_file_size(size), e.shard_size, upload_algo)
+
+        if mp_plane is not None:
+            from minio_tpu.storage import local as local_mod
+
+            try:
+                total, mp_failed, etag, mp_groups = mp_plane.put_data(
+                    reader, mp_roots, e.k, e.m, ufi.erasure.block_size,
+                    upload_algo, size, SYSTEM_VOL, f"{upath}/{tmp_name}",
+                    shard_hint, local_mod.FSYNC_ENABLED,
+                    abort_path=f"{upath}/{tmp_name}",
+                    abort_recursive=False)
+            except errors.StorageError:
+                cleanup_tmp()
+                raise
+            failed_shards = set(mp_failed)
+            if n - len(failed_shards) < wq:
+                cleanup_tmp()
+                raise errors.ErasureWriteQuorum(
+                    f"{n - len(failed_shards)} worker part streams < "
+                    f"quorum {wq}")
+            if size >= 0 and total != size:
+                cleanup_tmp()
+                raise errors.InvalidArgument(
+                    f"short read {total} != {size}")
+            now = time.time()
+            final_name = _part_fname(part_number, total, etag, now)
+            res = mp_plane.commit(
+                mp_groups, "rename_file", SYSTEM_VOL,
+                f"{upath}/{tmp_name}", dst_vol=SYSTEM_VOL,
+                dst_path=f"{upath}/{final_name}", skip=failed_shards)
+            ok = sum(1 for i in range(n)
+                     if i not in failed_shards and res.get(i, 1) is None)
+            if failed_shards:
+                # reclaim the failed shards' staged files (the commit
+                # path of the in-process plane does the same sweep)
+                def rm_failed(i: int) -> None:
+                    d = disks_by_index[i]
+                    if d is not None and i in failed_shards:
+                        try:
+                            d.delete(SYSTEM_VOL, f"{upath}/{tmp_name}")
+                        except errors.StorageError:
+                            pass
+
+                self._fan_out(rm_failed, sorted(failed_shards))
+            if ok < wq:
+                raise errors.ErasureWriteQuorum("part commit quorum")
+            return PartInfo(part_number, etag, total, now)
 
         def open_writer(i: int):
             d = disks_by_index[i]

@@ -575,7 +575,6 @@ class ErasureObjects:
             )
 
         erasure = Erasure(k, parity, BLOCK_SIZE_V2)
-        hreader = _HashingReader(reader, size)
         version_id = (
             opts.version_id or (new_version_id() if opts.versioned else "")
         )
@@ -586,8 +585,30 @@ class ErasureObjects:
         inline = 0 <= size <= SMALL_FILE_THRESHOLD and \
             erasure.shard_file_size(size) <= SMALL_FILE_THRESHOLD
 
+        # multi-process data plane (ISSUE 8, parallel/workers.py): when
+        # MINIO_TPU_WORKERS > 0 and every drive is node-local, the
+        # payload streams ONCE into a shared-memory ring; worker
+        # processes erasure-encode + bitrot-write the shards and the
+        # hash lane folds the etag — the whole PUT data path leaves this
+        # interpreter.  Inline (small) objects, remote drives and chaos
+        # interposers keep the in-process plane, which stays the
+        # differential reference (tests/test_mp_dataplane_diff.py).
+        mp_plane = None
+        mp_roots: list[str] | None = None
+        mp_groups = None
+        if not inline:
+            from minio_tpu.parallel import workers as workers_mod
+
+            if workers_mod.worker_count() > 0:
+                mp_roots = workers_mod.plane_roots(disks)
+                if mp_roots is not None:
+                    mp_plane = workers_mod.get_plane()
+        hreader = None if mp_plane is not None \
+            else _HashingReader(reader, size)
+
         shards_inline: list[bytes | None] = [None] * n
         failed_shards: set[int] = set()
+        etag = ""
 
         if inline:
             payload = hreader.read(size) if size >= 0 else hreader.read()
@@ -605,6 +626,34 @@ class ErasureObjects:
                     w.write(shards[i])
                 shards_inline[i] = buf.getvalue()
             total_size = size
+        elif mp_plane is not None:
+            from minio_tpu.storage import local as local_mod
+
+            shard_hint = -1 if size < 0 else bitrot.bitrot_shard_file_size(
+                erasure.shard_file_size(size), erasure.shard_size,
+                bitrot.algo_from_env())
+            try:
+                total_size, mp_failed, etag, mp_groups = mp_plane.put_data(
+                    reader, mp_roots, k, parity, BLOCK_SIZE_V2,
+                    bitrot.algo_from_env(), size, SYSTEM_VOL,
+                    f"{tmp_prefix}/part.1", shard_hint,
+                    local_mod.FSYNC_ENABLED)
+            except errors.StorageError:
+                # retryable (WorkerDied and friends): the supervisor is
+                # already respawning; sweep staging and surface it
+                self._cleanup_tmp(tmp_prefix)
+                raise
+            failed_shards = set(mp_failed)
+            if n - len(failed_shards) < write_quorum:
+                self._cleanup_tmp(tmp_prefix)
+                raise errors.ErasureWriteQuorum(
+                    f"{n - len(failed_shards)} worker shard streams < "
+                    f"quorum {write_quorum}")
+            if size >= 0 and total_size != size:
+                self._cleanup_tmp(tmp_prefix)
+                raise errors.InvalidArgument(
+                    f"short read: {total_size} != {size}"
+                )
         else:
             shard_hint = -1 if size < 0 else bitrot.bitrot_shard_file_size(
                 erasure.shard_file_size(size), erasure.shard_size,
@@ -669,7 +718,8 @@ class ErasureObjects:
                     f"short read: {total_size} != {size}"
                 )
 
-        etag = hreader.etag
+        if hreader is not None:
+            etag = hreader.etag
         mod_time = opts.mod_time or time.time()
         metadata = dict(opts.user_metadata)
         metadata["etag"] = etag
@@ -684,16 +734,8 @@ class ErasureObjects:
 
         part = ObjectPartInfo(1, total_size, total_size, mod_time, etag)
 
-        def commit(i: int) -> None:
-            d = disks[i]
-            if d is None:
-                raise errors.DiskNotFound(str(i))
-            if i in failed_shards:
-                # this drive's shard stream failed mid-write: do not commit
-                # metadata claiming a healthy shard (reference drops failed
-                # onlineDisks before renameData, cmd/erasure-object.go:990)
-                raise errors.DiskNotFound(f"shard write failed on {i}")
-            fi = FileInfo(
+        def make_fi(i: int) -> FileInfo:
+            return FileInfo(
                 volume=bucket, name=obj, version_id=version_id,
                 data_dir="" if inline else data_dir, mod_time=mod_time,
                 size=total_size, metadata=metadata, parts=[part],
@@ -706,6 +748,17 @@ class ErasureObjects:
                 ),
                 data=shards_inline[i] if inline else None,
             )
+
+        def commit(i: int) -> None:
+            d = disks[i]
+            if d is None:
+                raise errors.DiskNotFound(str(i))
+            if i in failed_shards:
+                # this drive's shard stream failed mid-write: do not commit
+                # metadata claiming a healthy shard (reference drops failed
+                # onlineDisks before renameData, cmd/erasure-object.go:990)
+                raise errors.DiskNotFound(f"shard write failed on {i}")
+            fi = make_fi(i)
             if inline:
                 d.write_metadata(bucket, obj, fi)
             else:
@@ -724,7 +777,26 @@ class ErasureObjects:
                         replaced_tier_meta = dict(prev.metadata)
                 except errors.StorageError:
                     pass
-            commit_errs = self._fan_out(commit, range(n))
+            if mp_groups is not None:
+                # node-batched commit over the worker plane: one
+                # message per worker commits every drive it wrote
+                res = mp_plane.commit(
+                    mp_groups, "rename_data", SYSTEM_VOL, tmp_prefix,
+                    fi=make_fi(0), bucket=bucket, obj=obj,
+                    skip=failed_shards)
+                commit_errs = [None] * n
+                for i in range(n):
+                    if i in failed_shards:
+                        commit_errs[i] = errors.DiskNotFound(
+                            f"shard write failed on {i}")
+                    elif i in res:
+                        commit_errs[i] = res[i]
+                    else:
+                        commit_errs[i] = errors.DiskNotFound(str(i))
+            else:
+                commit_errs = self._commit_all(commit, make_fi, disks,
+                                               inline, failed_shards,
+                                               tmp_prefix, bucket, obj)
         if not inline:
             # a successful commit MOVED the staged dir (rename_data);
             # only drives whose commit did not land still hold staging —
@@ -796,6 +868,70 @@ class ErasureObjects:
         for g, f in futs:
             for i, err in zip(g, f.result()):
                 out[i] = err
+        return out
+
+    def _commit_all(self, commit, make_fi, disks, inline, failed_shards,
+                    tmp_prefix, bucket, obj) -> list[Exception | None]:
+        """Commit fan-out, optionally NODE-BATCHED for remote drives:
+        with MINIO_TPU_COMMIT_BATCH_RPC=1, sibling drives on one peer
+        commit through a single rename_data_batch RPC (one coalesced
+        round trip per node per PUT, ISSUE 8 — the wire twin of the
+        worker plane's per-worker commit message; the shared
+        foundation for the ROADMAP metadata-journal item).
+
+        OFF by default: the batch handler commits its items
+        sequentially, so ONE hung drive convoys every healthy sibling
+        on its node behind the RPC timeout — the chaos drill's
+        hung-remote-drive PUT blew its latency ceiling exactly this
+        way — and a transport failure after a PARTIAL batch cannot be
+        retried per-drive safely (the committed drives' staging is
+        gone, so the retry reads FileNotFound and votes a spurious
+        quorum loss).  The per-drive fan-out keeps hung-drive damage
+        isolated; item 5's journal layer is where per-node batching
+        gets per-drive isolation for free."""
+        n = len(disks)
+        batched: dict[int, Exception | None] = {}
+        groups: list[tuple[object, list[tuple[int, str]]]] = []
+        batch_enabled = os.environ.get(
+            "MINIO_TPU_COMMIT_BATCH_RPC", "").lower() in ("1", "on", "true")
+        if not inline and batch_enabled:
+            by_client: dict[int, list[tuple[int, str]]] = {}
+            leaders: dict[int, object] = {}
+            for i in range(n):
+                d = disks[i]
+                if d is None or i in failed_shards:
+                    continue
+                inner = d.unwrap() if hasattr(d, "unwrap") else d
+                cl = getattr(inner, "client", None)
+                if cl is None or not hasattr(inner, "rename_data_batch"):
+                    continue
+                key = id(cl)
+                leaders.setdefault(key, inner)
+                by_client.setdefault(key, []).append((i, inner.drive))
+            groups = [(leaders[kk], lst) for kk, lst in by_client.items()
+                      if len(lst) >= 2]
+
+        def run_batch(leader, lst):
+            items = [(dr, make_fi(i)) for i, dr in lst]
+            try:
+                res = leader.rename_data_batch(
+                    SYSTEM_VOL, tmp_prefix, items, bucket, obj)
+            except Exception:
+                return None  # transport trouble: per-drive path decides
+            return {i: r for (i, _dr), r in zip(lst, res)}
+
+        if groups:
+            futs = [(lst, deadline_mod.ctx_submit(
+                _io_pool(), run_batch, leader, lst))
+                for leader, lst in groups]
+            for lst, f in futs:
+                res = f.result()
+                if res is not None:
+                    batched.update(res)
+        rest = [i for i in range(n) if i not in batched]
+        out = self._fan_out(commit, rest)
+        for i, e2 in batched.items():
+            out[i] = e2
         return out
 
     def _cleanup_tmp(self, tmp_prefix: str, idxs=None) -> None:

@@ -39,6 +39,7 @@ MAGIC_HH256_KEY = bytes(
 
 
 def _load():
+    # lint: allow(shared-state): per-process ctypes handle by design — each worker process must dlopen the codec itself
     global _lib, _lib_tried
     with _lock:
         if _lib is not None or _lib_tried:

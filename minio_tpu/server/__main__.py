@@ -27,6 +27,76 @@ import os
 import sys
 
 
+def _prefork_http_front(n: int, argv) -> int:
+    """MINIO_TPU_HTTP_WORKERS=N pre-fork front (ISSUE 8): fork N server
+    processes that all bind the SAME address via SO_REUSEPORT, so
+    accept + HTTP parse + SigV4 verification + response streaming
+    parallelize across interpreters (the kernel load-balances new
+    connections).  Worker 0 runs the background services; the rest
+    start with --no-services so one node never runs N scanners.
+    Children are supervised: a died worker is reforked, SIGTERM/SIGINT
+    fan out and the parent waits for a clean drain.
+
+    Caveat (documented in README): the per-object namespace write lock
+    is per-process, so two workers racing a PUT of the SAME key
+    serialize only at the atomic commit rename (last-writer-wins —
+    the same semantics two distinct NODES have without dsync).  The
+    pre-fork front targets read-heavy / many-client fan-in; use
+    distributed mode when cross-writer locking matters."""
+    import signal
+
+    def spawn(i: int) -> int:
+        pid = os.fork()
+        if pid == 0:
+            # a REFORKED child inherits the supervisor's on_sig handler
+            # (installed below before any refork) — reset to default or
+            # a SIGTERM landing during the child's boot window would be
+            # swallowed by the supervisor handler and the child would
+            # survive its own shutdown, wedging the parent's final wait
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            signal.signal(signal.SIGINT, signal.SIG_DFL)
+            os.environ["_MINIO_TPU_HTTP_WORKER"] = str(i)
+            child_argv = list(argv) if argv is not None else sys.argv[1:]
+            if i > 0 and "--no-services" not in child_argv:
+                child_argv = child_argv + ["--no-services"]
+            os._exit(main(child_argv))
+        return pid
+
+    live = {i: spawn(i) for i in range(n)}
+    print(f"minio-tpu: pre-fork HTTP front, {n} workers "
+          f"(SO_REUSEPORT)", file=sys.stderr)
+    stopping: list[int] = []
+
+    def on_sig(sig, _frame):
+        stopping.append(sig)
+        for pid in live.values():
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except OSError:
+                pass
+
+    signal.signal(signal.SIGTERM, on_sig)
+    signal.signal(signal.SIGINT, on_sig)
+    while live and not stopping:
+        try:
+            pid, _status = os.wait()
+        except ChildProcessError:
+            break
+        except InterruptedError:
+            continue
+        for i, p in list(live.items()):
+            if p == pid:
+                del live[i]
+                if not stopping:
+                    live[i] = spawn(i)  # supervised: refork
+    for pid in live.values():
+        try:
+            os.waitpid(pid, 0)
+        except OSError:
+            pass
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="minio-tpu server")
     ap.add_argument("endpoints", nargs="+",
@@ -69,6 +139,20 @@ def main(argv=None) -> int:
                         "MINIO_CACHE_SIZE", str(10 << 30))),
                     help="max cache bytes (default 10 GiB)")
     args = ap.parse_args(argv)
+
+    # optional pre-fork/SO_REUSEPORT HTTP front: fork BEFORE any heavy
+    # import so each worker boots a clean interpreter
+    try:
+        http_workers = int(os.environ.get(
+            "MINIO_TPU_HTTP_WORKERS", "1") or 1)
+    except ValueError:
+        http_workers = 1
+    import socket as _socket
+
+    if (http_workers > 1 and args.gateway is None
+            and hasattr(_socket, "SO_REUSEPORT")
+            and "_MINIO_TPU_HTTP_WORKER" not in os.environ):
+        return _prefork_http_front(http_workers, argv)
 
     from aiohttp import web
 
@@ -193,8 +277,10 @@ def main(argv=None) -> int:
         service_thread(verify_with_retry, name="bootstrap-verify")
 
     host, port = args.address.rsplit(":", 1)
+    reuse_port = "_MINIO_TPU_HTTP_WORKER" in os.environ or None
     try:
-        web.run_app(node.app, host=host, port=int(port), print=None)
+        web.run_app(node.app, host=host, port=int(port), print=None,
+                    reuse_port=reuse_port)
     finally:
         node.close()
     return 0

@@ -296,6 +296,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         from minio_tpu.serving import from_env as _hotcache_from_env
 
         self.hotcache = _hotcache_from_env()
+        self._hotcache_pending_distributed = None
         if self.hotcache is not None:
             from minio_tpu.erasure.objects import (add_ns_update_hook,
                                                    invalidation_plane)
@@ -304,12 +305,18 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             if has_sets and all_local:
                 add_ns_update_hook(object_layer,
                                    self.hotcache.invalidate)
+            elif has_sets:
+                # distributed deployment: a peer's write fires
+                # ns_updated only on that node, so the tier stays OFF
+                # until the cluster wiring provides the cross-node
+                # hotcache_invalidate broadcast + TTL backstop
+                # (enable_distributed_hotcache, called by ClusterNode
+                # once the PeerNotifier exists — ISSUE 8 satellite)
+                self._hotcache_pending_distributed = self.hotcache
+                self.hotcache = None
             else:
-                # no erasure invalidation plane below (pure gateway),
-                # or a distributed deployment where a peer's write
-                # fires ns_updated only on that node (see
-                # invalidation_plane): serving stale bytes is worse
-                # than serving slowly — tier off
+                # no erasure invalidation plane below (pure gateway):
+                # serving stale bytes is worse than serving slowly
                 self.hotcache = None
         self.hot_sem = asyncio.Semaphore(max(max_concurrency, 4) * 2)
         # end-to-end deadline budget (reference requests_deadline,
@@ -386,6 +393,47 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         except Exception:
             pass
         self.executor.shutdown(wait=False, cancel_futures=True)
+        # worker plane: terminate I/O worker + hash-lane processes and
+        # unlink their shm rings (no-op when MINIO_TPU_WORKERS unset;
+        # a sibling server lazily restarts the plane if it needs it)
+        try:
+            from minio_tpu.parallel import workers as _workers
+
+            _workers.shutdown_plane()
+        except Exception:
+            pass
+
+    #: TTL backstop a distributed hot tier must run with when the
+    #: operator set none: a peer that misses an invalidation broadcast
+    #: (down / partitioned) serves stale bytes for at most this long
+    HOTCACHE_DISTRIBUTED_TTL_S = 30.0
+
+    def enable_distributed_hotcache(self, broadcast) -> bool:
+        """Light the hot-object tier on a DISTRIBUTED deployment
+        (ROADMAP item 3 follow-up): local mutations keep invalidating
+        this node's tier through the ns_updated choke point AND
+        broadcast `hotcache_invalidate` to every peer, so a write
+        anywhere drops the object's cached bytes everywhere.  The
+        broadcast is best-effort (fire-and-forget like every peer
+        reload), so a nonzero TTL backstop is forced — a node that
+        misses a broadcast converges within HOTCACHE_DISTRIBUTED_TTL_S.
+        Returns True when the tier flipped on."""
+        hc = self._hotcache_pending_distributed
+        if hc is None or broadcast is None:
+            return False
+        from minio_tpu.erasure.objects import add_ns_update_hook
+
+        if hc.ttl_s <= 0:
+            hc.ttl_s = self.HOTCACHE_DISTRIBUTED_TTL_S
+
+        def on_update(bucket: str, obj: str) -> None:
+            hc.invalidate(bucket, obj)
+            broadcast(bucket, obj)
+
+        add_ns_update_hook(self.api, on_update)
+        self.hotcache = hc
+        self._hotcache_pending_distributed = None
+        return True
 
     def attach_services(self, services) -> None:
         """Adopt the background ServiceManager (heal/MRF/scanner) so the

@@ -370,6 +370,38 @@ class MetricsMixin:
                   "Fraction of hot-tier lookups served from RAM",
                   hs["hitRatio"])
 
+        # multi-process data plane (parallel/workers.py): job/commit
+        # volume through the worker plane plus its supervision health —
+        # workerDeaths counts in-flight-failing deaths, restarts counts
+        # supervisor respawns (a climbing gap between the two means the
+        # supervisor cannot keep workers alive)
+        try:
+            from minio_tpu.parallel import workers as _workers
+
+            plane = _workers.get_plane(create=False)
+            if plane is not None:
+                ms = plane.stats()
+                gauge("minio_mp_workers",
+                      "I/O worker processes of the data plane",
+                      ms["workers"])
+                gauge("minio_mp_jobs_total",
+                      "PUT data jobs dispatched to the worker plane",
+                      ms["jobs"])
+                gauge("minio_mp_commits_total",
+                      "Node-batched commit rounds through the worker "
+                      "plane", ms["commits"])
+                gauge("minio_mp_job_failures_total",
+                      "Worker-plane jobs that failed (died worker / "
+                      "timeout)", ms["failures"])
+                gauge("minio_mp_worker_deaths_total",
+                      "Worker processes that died with jobs in flight",
+                      ms["workerDeaths"])
+                gauge("minio_mp_worker_restarts_total",
+                      "Worker processes respawned by the supervisor",
+                      ms["restarts"])
+        except Exception:
+            pass
+
         # deadline/overload plane: hedged shard reads, abandoned
         # stragglers, RPC budget expiries, per-drive deadline timeouts
         try:

@@ -62,6 +62,17 @@ class ServiceManager:
         # instead of firing listings/enqueues against torn-down services
         self._closing = _threading.Event()
         self._attach_heal_queue()
+        # multi-process data plane (ISSUE 8): when MINIO_TPU_WORKERS is
+        # set, warm the worker/hash-lane processes at boot so the first
+        # PUT does not pay the spawn+import cost.  The plane never
+        # enqueues background work — heal/scanner/MRF keep the
+        # in-process path — so brownout throttling needs no new wiring:
+        # worker jobs exist only downstream of foreground PUTs the
+        # admission plane already meters.
+        from minio_tpu.parallel import workers as _workers
+
+        if _workers.worker_count() > 0:
+            _workers.get_plane()
 
     def _attach_heal_queue(self) -> None:
         """Point every erasure set's async-heal hook at the MRF queue, its
@@ -156,6 +167,14 @@ class ServiceManager:
             self.replication.close()
         if self.tier is not None:
             self.tier.close()
+        # tear down the worker plane (processes + shm rings).  The
+        # plane is a process-wide singleton: another still-open server
+        # in this process lazily restarts it on its next eligible PUT,
+        # so closing here is always safe and guarantees zero leaked
+        # processes/segments after the LAST server shuts down.
+        from minio_tpu.parallel import workers as _workers
+
+        _workers.shutdown_plane()
 
 
 __all__ = [

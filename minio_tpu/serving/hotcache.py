@@ -53,8 +53,11 @@ import dataclasses
 import itertools
 import os
 import threading
+import time
 from collections import OrderedDict
 from typing import Callable, Iterator
+
+_mono = time.monotonic
 
 #: fraction of the byte budget reserved for the protected SLRU segment
 PROTECTED_FRAC = 0.8
@@ -96,21 +99,27 @@ def from_env() -> "HotObjectCache | None":
             return None
 
     min_hits = _int_env("MINIO_TPU_HOTCACHE_MIN_HITS")
+    try:
+        ttl_s = float(os.environ.get("MINIO_TPU_HOTCACHE_TTL_S", "") or 0)
+    except ValueError:
+        ttl_s = 0.0
     return HotObjectCache(
         max_bytes,
         max_obj_bytes=_int_env("MINIO_TPU_HOTCACHE_MAX_OBJ_BYTES"),
         min_hits=2 if min_hits is None else min_hits,
+        ttl_s=ttl_s,
     )
 
 
 class _Entry:
-    __slots__ = ("key", "oi", "data", "gen")
+    __slots__ = ("key", "oi", "data", "gen", "ts")
 
-    def __init__(self, key, oi, data: bytes, gen: int):
+    def __init__(self, key, oi, data: bytes, gen: int, ts: float = 0.0):
         self.key = key
         self.oi = oi
         self.data = data
         self.gen = gen
+        self.ts = ts  # admit time (monotonic) for the TTL backstop
 
 
 class _Fill:
@@ -188,7 +197,14 @@ class HotObjectCache:
     (bucket, object, version)."""
 
     def __init__(self, max_bytes: int, max_obj_bytes: int | None = None,
-                 min_hits: int = 2):
+                 min_hits: int = 2, ttl_s: float = 0.0):
+        #: TTL backstop (seconds; 0 = entries live until invalidated).
+        #: On a DISTRIBUTED deployment invalidation rides a best-effort
+        #: peer broadcast (distributed/peers.py hotcache_invalidate) —
+        #: a peer that misses it (down, partitioned) must still
+        #: converge, so the cluster wiring sets a nonzero TTL bounding
+        #: the worst-case staleness window (ISSUE 8 satellite).
+        self.ttl_s = float(ttl_s)
         self.max_bytes = int(max_bytes)
         if max_obj_bytes is None:
             # one object may take at most 1/8 of the tier (floor 1 MiB),
@@ -295,7 +311,7 @@ class HotObjectCache:
         # read-only, but the erasure layer hands out live dicts
         oi = dataclasses.replace(oi, metadata=dict(oi.metadata),
                                  parts=list(oi.parts))
-        self._prob[k] = _Entry(k, oi, data, gen)
+        self._prob[k] = _Entry(k, oi, data, gen, ts=_mono())
         self._bytes += len(data)
         self._by_obj.setdefault((k[0], k[1]), set()).add(k)
         self._evict_locked()
@@ -326,6 +342,11 @@ class HotObjectCache:
             # a writer invalidated between admit and now: never serve
             self._drop_entry_locked(k, count_eviction=False)
             return None
+        if self.ttl_s > 0 and _mono() - ent.ts > self.ttl_s:
+            # TTL backstop expired: re-read through the erasure layer
+            # (a missed peer broadcast can leave this entry stale)
+            self._drop_entry_locked(k, count_eviction=False)
+            return None
         return ent
 
     # ------------------------------------------------------------- queries
@@ -340,7 +361,8 @@ class HotObjectCache:
         k = (bucket, obj, version_id)
         ent = self._prob.get(k) or self._prot.get(k)
         return ent is not None \
-            and self._gen.get((bucket, obj)) == ent.gen
+            and self._gen.get((bucket, obj)) == ent.gen \
+            and not (self.ttl_s > 0 and _mono() - ent.ts > self.ttl_s)
 
     def lookup(self, bucket: str, obj: str, version_id: str = "", *,
                count_miss: bool = True) -> _Entry | None:
@@ -570,6 +592,7 @@ class HotObjectCache:
             "protectedBytes": self._prot_bytes,
             "maxBytes": self.max_bytes,
             "maxObjBytes": self.max_obj_bytes,
+            "ttlSeconds": self.ttl_s,
             "hitRatio": round(self.hits / looked, 6) if looked
             else 0.0,
         }

@@ -124,6 +124,25 @@ class scope:
         return False
 
 
+def to_wire_ms() -> int | None:
+    """Remaining budget of the CURRENT context as whole milliseconds —
+    the value that rides a process/RPC hop (`x-minio-tpu-deadline-ms`
+    on the wire, `deadline_ms` in a worker-plane job message); None
+    when no bounded budget is installed."""
+    b = current()
+    if b is None:
+        return None
+    return b.remaining_ms()
+
+
+def from_wire_ms(ms) -> Budget | None:
+    """Rebuild a Budget from a hop header on the receiving side (RPC
+    server, data-plane worker process).  None/absent stays unbounded."""
+    if ms is None:
+        return None
+    return Budget.from_millis(int(ms))
+
+
 def ctx_submit(pool, fn, *args, **kwargs):
     """pool.submit that carries the caller's context (and therefore the
     ambient deadline budget) into the worker thread.  Plain submit drops

@@ -241,6 +241,46 @@ def _run_serial_isolated(item) -> None:
         + "\n\nretry:\n".join(tails))
 
 
+# ------------------------------------------------------- shm leak check
+# The multi-process data plane (minio_tpu/parallel/workers.py) creates
+# named /dev/shm segments (mtpu-ring-*) and spawns worker processes.  A
+# test that leaks either would silently tax every later test (and a
+# SIGKILL'd run would litter /dev/shm for the whole machine), so the
+# session asserts both are gone at teardown — after shutting the plane
+# down itself, which is also what guarantees the check runs even when a
+# test forgot its own cleanup.
+
+@pytest.fixture(scope="session", autouse=True)
+def _mp_plane_leak_check():
+    def shm_litter():
+        try:
+            return sorted(f for f in os.listdir("/dev/shm")
+                          if f.startswith("mtpu-"))
+        except OSError:
+            return []
+
+    before = set(shm_litter())
+    yield
+    from minio_tpu.parallel import workers as _workers
+
+    _workers.shutdown_plane()
+    leaked = [f for f in shm_litter() if f not in before]
+    import multiprocessing as _mp
+
+    kids = [p for p in _mp.active_children()
+            if (p.name or "").startswith("mtpu-")]
+    for p in kids:  # clean up so one failure doesn't cascade
+        p.terminate()
+    for f in leaked:
+        try:
+            os.unlink(os.path.join("/dev/shm", f))
+        except OSError:
+            pass
+    assert not leaked, f"leaked shared-memory segments: {leaked}"
+    assert not kids, ("leaked data-plane worker processes: "
+                      f"{[p.name for p in kids]}")
+
+
 def pytest_collection_modifyitems(config, items):
     if not _serial_isolation_enabled():
         return

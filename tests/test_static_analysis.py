@@ -61,7 +61,8 @@ class TestPackageClean:
         analyze_paths([os.path.join(PKG, "analysis", "__init__.py")])
         assert {"budget-propagation", "blocking-under-lock",
                 "s3-error-coverage", "metrics-drift",
-                "thread-lifecycle", "payload-budget"} <= set(RULES)
+                "thread-lifecycle", "payload-budget",
+                "shared-state"} <= set(RULES)
 
 
 # ------------------------------------------------------- budget-propagation
@@ -472,3 +473,112 @@ class TestCli:
     def test_package_scan_via_cli_clean(self):
         proc = self._run(PKG)
         assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -------------------------------------------------- process lifecycle
+class TestProcessLifecycleFixtures:
+    """ISSUE 8 extension: multiprocessing.Process spawns need a
+    supervisor (join/terminate path) — daemon=True is NOT enough for a
+    process (a daemonic child dies only with the parent)."""
+
+    def test_unsupervised_process_flagged(self):
+        bad = """
+            import multiprocessing as mp
+
+            def spawn():
+                p = mp.Process(target=print, daemon=True)
+                p.start()
+        """
+        assert "thread-lifecycle" in _rules_hit(
+            _findings(bad, rules=["thread-lifecycle"]))
+
+    def test_ctx_process_flagged_too(self):
+        bad = """
+            import multiprocessing as mp
+
+            def spawn():
+                ctx = mp.get_context("spawn")
+                ctx.Process(target=print).start()
+        """
+        assert "thread-lifecycle" in _rules_hit(
+            _findings(bad, rules=["thread-lifecycle"]))
+
+    def test_supervised_process_passes(self):
+        good = """
+            import multiprocessing as mp
+
+            def spawn():
+                proc = mp.Process(target=print, daemon=True)
+                proc.start()
+                return proc
+
+            def close(proc):
+                proc.terminate()
+                proc.join(timeout=2)
+        """
+        assert not _findings(good, rules=["thread-lifecycle"])
+
+    def test_bare_process_reference_ignored(self):
+        good = """
+            import multiprocessing as mp
+
+            def kind_of(x):
+                return isinstance(x, mp.Process)
+        """
+        assert not _findings(good, rules=["thread-lifecycle"])
+
+
+# ------------------------------------------------------- shared-state
+class TestSharedStateFixtures:
+    """Mutable module-global writes in modules imported into worker
+    processes diverge silently per process (ISSUE 8)."""
+
+    SURFACE_PATH = "minio_tpu/storage/local.py"
+
+    def test_global_write_on_worker_surface_flagged(self):
+        bad = """
+            _cache = None
+
+            def get():
+                global _cache
+                if _cache is None:
+                    _cache = {}
+                return _cache
+        """
+        hits = _findings(bad, path=self.SURFACE_PATH,
+                         rules=["shared-state"])
+        assert "shared-state" in _rules_hit(hits)
+        assert "_cache" in hits[0].message
+
+    def test_non_surface_module_not_flagged(self):
+        same = """
+            _cache = None
+
+            def get():
+                global _cache
+                _cache = {}
+        """
+        assert not _findings(same, path="minio_tpu/services/heal.py",
+                             rules=["shared-state"])
+
+    def test_pragma_with_reason_suppresses(self):
+        ok = """
+            _pool = []
+
+            def acquire():
+                # lint: allow(shared-state): per-process buffer pool by design
+                global _pool
+                _pool = []
+        """
+        assert not _findings(ok, path=self.SURFACE_PATH,
+                             rules=["shared-state"])
+
+    def test_read_only_global_not_flagged(self):
+        good = """
+            LIMIT = 7
+
+            def get():
+                return LIMIT
+        """
+        assert not _findings(good, path=self.SURFACE_PATH,
+                             rules=["shared-state"])
