@@ -2,7 +2,13 @@
 
 Exits 0 when clean, 1 on findings, 2 on usage errors.  The same engine
 runs in tier-1 (tests/test_static_analysis.py) — the CLI exists so a
-dev loop / pre-push hook can run the gate without pytest."""
+dev loop / pre-push hook can run the gate without pytest.
+
+`--all` is the one-exit-code CI entry point: AST rules over the
+package, the bounded model check of every registered protocol model
+(including the mutation-liveness proof that each seeded protocol bug
+is caught), and the rule self-tests.  `--models` runs just the model
+checker; `--deep` raises the exploration bounds (the slow sweep)."""
 
 from __future__ import annotations
 
@@ -13,10 +19,44 @@ import sys
 from .core import RULES, analyze_paths
 
 
+def _run_models(deep: bool) -> int:
+    from .concurrency import check_all
+
+    max_states = 2_000_000 if deep else 200_000
+    bad = 0
+    for name, clean, muts in check_all(deep=deep, max_states=max_states):
+        caught = sum(1 for r in muts.values() if not r.ok)
+        line = (f"model {name}: {clean}; mutations "
+                f"{caught}/{len(muts)} caught")
+        print(line)
+        if not clean.ok or clean.truncated:
+            bad += 1
+            print(f"  UNMUTATED MODEL FAILED: {clean}", file=sys.stderr)
+        for mn, res in muts.items():
+            if res.ok:
+                bad += 1
+                print(f"  MUTATION NOT CAUGHT: {name}+{mn} — the "
+                      "invariants are not live for this bug class",
+                      file=sys.stderr)
+    return 1 if bad else 0
+
+
+def _run_selfcheck() -> int:
+    from . import selfcheck
+
+    failures = selfcheck.run()
+    for f in failures:
+        print(f"selfcheck: {f}", file=sys.stderr)
+    if not failures:
+        print(f"selfcheck: {len(selfcheck.SELF_TESTS)} rules live")
+    return 1 if failures else 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m minio_tpu.analysis",
-        description="project-native invariant linter")
+        description="project-native invariant linter + protocol "
+                    "model checker")
     parser.add_argument("paths", nargs="*",
                         help="files/directories to scan "
                              "(default: the minio_tpu package)")
@@ -25,6 +65,13 @@ def main(argv=None) -> int:
                         help="run only this rule (repeatable)")
     parser.add_argument("--list-rules", action="store_true",
                         help="list registered rules and exit")
+    parser.add_argument("--all", action="store_true",
+                        help="AST rules + bounded model check + rule "
+                             "self-tests, one exit code (the CI gate)")
+    parser.add_argument("--models", action="store_true",
+                        help="run only the protocol model checker")
+    parser.add_argument("--deep", action="store_true",
+                        help="raise model-check bounds (slow sweep)")
     args = parser.parse_args(argv)
 
     # rule modules register on import
@@ -35,6 +82,14 @@ def main(argv=None) -> int:
         for name in sorted(RULES):
             print(f"{name:<{width}}  {RULES[name][0]}")
         return 0
+
+    if args.models and not args.all:
+        return _run_models(args.deep)
+
+    rc_models = rc_self = 0
+    if args.all:
+        rc_models = _run_models(args.deep)
+        rc_self = _run_selfcheck()
 
     paths = args.paths
     if not paths:
@@ -57,6 +112,9 @@ def main(argv=None) -> int:
               "Fix the violation or suppress with "
               "`# lint: allow(<rule>): <reason>`.", file=sys.stderr)
         return 1
+    if args.all:
+        print(f"lint: clean ({len(RULES)} rules)")
+        return 1 if (rc_models or rc_self) else 0
     return 0
 
 

@@ -1,0 +1,13 @@
+"""Concurrency correctness plane: exhaustive protocol model checking
+(modelcheck) + a dynamic lockset race detector (racecheck).
+
+The models are the executable specs of the three load-bearing
+protocols (arena ring, hotcache generations, breaker/MRF); tier-1 runs
+them in a fast bounded configuration and proves every invariant live
+via seeded mutations (tests/test_modelcheck.py).  Future protocol work
+(per-tenant QoS locks, the metadata journal) adds a model here first.
+"""
+
+from .modelcheck import (MODELS, Model, Result,  # noqa: F401
+                         Violation, check, check_all, register,
+                         verify_mutations)

@@ -1,0 +1,296 @@
+"""Explicit-state bounded model checker for the data plane's concurrent
+protocols.
+
+PR 8 made correctness rest on hand-reasoned interleavings: the arena
+rings' seqlock slot lifecycle, the hotcache fill/invalidate generation
+dance, and the breaker→probe→MRF re-sync machine.  Review keeps finding
+the same defect classes by eye (write-races-fill, quick-respawn wedging
+the producer, dropped on_online), so this module makes the protocols
+*executable specs*: each is modelled as a set of atomic guarded actions
+over a finite shared state, and the checker enumerates EVERY reachable
+interleaving (BFS, so counterexample traces are shortest-first),
+checking
+
+* **invariants** — predicates that must hold in every reachable state;
+* **terminal invariants** — predicates over *quiescent* states (no
+  action enabled): the bounded stand-in for "eventually" properties
+  like "every dispatched job resolves";
+* **deadlock freedom** — a quiescent state must satisfy the model's
+  ``done`` predicate, or it is a wedge (the respawn-wedges-producer
+  bug class).
+
+The lineage is CHESS (Musuvathi et al., OSDI 2008): bounded exhaustive
+interleaving search over an abstracted program, traded against the real
+code's fidelity.  Models are small on purpose — they encode the
+*protocol*, not the implementation — and the differential/stress suites
+keep the implementation honest against the protocol
+(tests/test_mp_dataplane_diff.py, tests/test_concurrency.py).
+
+A checker that cannot fail is decoration, so every invariant must be
+**proven live** by at least one seeded mutation: a named, documented
+perturbation of the protocol (skip the done-counter wait, commit a
+detached fill, drop the on_online hook) that the checker MUST catch
+with a counterexample trace.  ``verify_mutations`` enforces this and
+tier-1 pins it per model × mutation (tests/test_modelcheck.py).
+
+State values must freeze to hashables: ints, strs, bools, tuples,
+frozensets, and (nested) dicts/lists of those.  Actions receive a deep
+thawed copy and mutate it in place.
+"""
+
+from __future__ import annotations
+
+import copy
+from collections import deque
+from dataclasses import dataclass
+
+#: marker distinguishing a frozen dict from a plain tuple
+_DICT_TAG = "\x00dict"
+
+
+def freeze(value):
+    """Canonical hashable form of a model state value."""
+    if isinstance(value, dict):
+        return (_DICT_TAG,) + tuple(
+            (k, freeze(v)) for k, v in sorted(value.items()))
+    if isinstance(value, (list, tuple)):
+        return tuple(freeze(v) for v in value)
+    if isinstance(value, set):
+        return frozenset(freeze(v) for v in value)
+    return value
+
+
+def thaw(value):
+    """Inverse of freeze: rebuild the mutable working form."""
+    if isinstance(value, tuple):
+        if value[:1] == (_DICT_TAG,):
+            return {k: thaw(v) for k, v in value[1:]}
+        return [thaw(v) for v in value]
+    if isinstance(value, frozenset):
+        return {thaw(v) for v in value}
+    return value
+
+
+@dataclass(frozen=True)
+class Action:
+    """One atomic protocol step: fires when ``guard(state)`` holds,
+    transforming a copy of the state via ``effect(state)``."""
+
+    name: str
+    guard: object
+    effect: object
+
+    def enabled(self, state: dict) -> bool:
+        return True if self.guard is None else bool(self.guard(state))
+
+
+@dataclass
+class Violation:
+    kind: str          # "invariant" | "terminal" | "deadlock"
+    name: str          # invariant name ("deadlock" for wedges)
+    trace: list        # action names from the initial state
+    state: dict        # the offending state (thawed)
+
+    def __str__(self) -> str:
+        steps = " -> ".join(self.trace) or "<initial state>"
+        return (f"{self.kind} `{self.name}` violated after "
+                f"[{steps}]\n  state: {self.state}")
+
+
+@dataclass
+class Result:
+    ok: bool
+    states: int
+    transitions: int
+    violation: Violation | None = None
+    truncated: bool = False  # state/depth bound hit before exhaustion
+
+    def __str__(self) -> str:
+        if self.ok:
+            extra = " (TRUNCATED: bounds hit)" if self.truncated else ""
+            return (f"ok: {self.states} states, "
+                    f"{self.transitions} transitions{extra}")
+        return str(self.violation)
+
+
+class Model:
+    """A protocol model: initial state + atomic actions + invariants +
+    seeded mutations proving the invariants live."""
+
+    def __init__(self, name: str, init: dict, description: str = ""):
+        self.name = name
+        self.description = description
+        self._init = copy.deepcopy(init)
+        self.actions: list[Action] = []
+        self.invariants: dict[str, object] = {}
+        self.terminal_invariants: dict[str, object] = {}
+        #: quiescent states must satisfy this or they are deadlocks
+        self.done = lambda s: True
+        #: name -> (description, transform(model) applied to a copy)
+        self.mutations: dict[str, tuple[str, object]] = {}
+
+    # -- construction -------------------------------------------------------
+    def action(self, name: str, guard=None):
+        def deco(fn):
+            self.actions.append(Action(name, guard, fn))
+            return fn
+        return deco
+
+    def invariant(self, name: str):
+        def deco(fn):
+            self.invariants[name] = fn
+            return fn
+        return deco
+
+    def terminal(self, name: str):
+        def deco(fn):
+            self.terminal_invariants[name] = fn
+            return fn
+        return deco
+
+    def mutation(self, name: str, description: str):
+        def deco(fn):
+            self.mutations[name] = (description, fn)
+            return fn
+        return deco
+
+    # -- mutation helpers ----------------------------------------------------
+    def find_action(self, name: str) -> Action:
+        for a in self.actions:
+            if a.name == name:
+                return a
+        raise KeyError(f"{self.name}: no action named {name!r}")
+
+    def replace_action(self, name: str, guard="keep", effect="keep"):
+        a = self.find_action(name)
+        idx = self.actions.index(a)
+        self.actions[idx] = Action(
+            name,
+            a.guard if guard == "keep" else guard,
+            a.effect if effect == "keep" else effect)
+
+    def drop_action(self, name: str) -> None:
+        self.actions.remove(self.find_action(name))
+
+    def mutated(self, name: str) -> "Model":
+        """A copy of this model with the named seeded mutation applied."""
+        if name not in self.mutations:
+            raise KeyError(f"{self.name}: no mutation named {name!r}")
+        m = Model(f"{self.name}+{name}", self._init, self.description)
+        m.actions = list(self.actions)
+        m.invariants = dict(self.invariants)
+        m.terminal_invariants = dict(self.terminal_invariants)
+        m.done = self.done
+        self.mutations[name][1](m)
+        return m
+
+    # -- initial state ------------------------------------------------------
+    def initial(self) -> dict:
+        return copy.deepcopy(self._init)
+
+
+def check(model: Model, max_states: int = 200_000,
+          max_depth: int = 1_000) -> Result:
+    """Breadth-first exhaustive exploration within bounds.  Returns the
+    first (shortest-trace) violation, or ok with the explored size."""
+    init = model.initial()
+    init_f = freeze(init)
+    # frozen state -> (parent frozen state, action name) for traces
+    parents: dict = {init_f: None}
+    queue: deque = deque([(init_f, 0)])
+    states = 0
+    transitions = 0
+    truncated = False
+
+    def trace_of(frozen) -> list:
+        out = []
+        cur = frozen
+        while parents[cur] is not None:
+            cur, name = parents[cur]
+            out.append(name)
+        out.reverse()
+        return out
+
+    while queue:
+        frozen, depth = queue.popleft()
+        state = thaw(frozen)
+        states += 1
+        for name, pred in model.invariants.items():
+            if not pred(state):
+                return Result(False, states, transitions,
+                              Violation("invariant", name,
+                                        trace_of(frozen), state))
+        enabled = [a for a in model.actions if a.enabled(state)]
+        if not enabled:
+            if not model.done(state):
+                return Result(False, states, transitions,
+                              Violation("deadlock", "deadlock",
+                                        trace_of(frozen), state))
+            for name, pred in model.terminal_invariants.items():
+                if not pred(state):
+                    return Result(False, states, transitions,
+                                  Violation("terminal", name,
+                                            trace_of(frozen), state))
+            continue
+        if depth >= max_depth:
+            truncated = True
+            continue
+        for a in enabled:
+            nxt = thaw(frozen)
+            a.effect(nxt)
+            nxt_f = freeze(nxt)
+            transitions += 1
+            if nxt_f not in parents:
+                if len(parents) >= max_states:
+                    truncated = True
+                    continue
+                parents[nxt_f] = (frozen, a.name)
+                queue.append((nxt_f, depth + 1))
+    return Result(True, states, transitions, truncated=truncated)
+
+
+def verify_mutations(factory, max_states: int = 200_000,
+                     max_depth: int = 1_000) -> dict[str, Result]:
+    """Prove every invariant live: each seeded mutation of the model
+    MUST yield a violation.  Returns {mutation: Result}; a Result with
+    ok=True in the map means the checker failed to catch that mutation
+    (the caller treats it as a gate failure)."""
+    base = factory()
+    out: dict[str, Result] = {}
+    for name in base.mutations:
+        out[name] = check(base.mutated(name), max_states=max_states,
+                          max_depth=max_depth)
+    return out
+
+
+# --------------------------------------------------------------- registry
+#: name -> factory(deep: bool = False) -> Model.  The three load-bearing
+#: protocol models register here on package import; tier-1 pins the
+#: registry contents (tests/test_modelcheck.py) so a model cannot
+#: silently drop out of the gate.
+MODELS: dict[str, object] = {}
+
+
+def register(name: str):
+    def deco(factory):
+        MODELS[name] = factory
+        return factory
+    return deco
+
+
+def check_all(deep: bool = False, max_states: int = 200_000,
+              max_depth: int = 1_000):
+    """(model_name, unmutated Result, {mutation: Result}) per registered
+    model — the `python -m minio_tpu.analysis --all` entry point."""
+    # model modules register on import
+    from minio_tpu.analysis.concurrency import models as _models  # noqa: F401
+
+    out = []
+    for name in sorted(MODELS):
+        factory = MODELS[name]
+        clean = check(factory(deep=deep), max_states=max_states,
+                      max_depth=max_depth)
+        muts = verify_mutations(lambda: factory(deep=deep),
+                                max_states=max_states, max_depth=max_depth)
+        out.append((name, clean, muts))
+    return out

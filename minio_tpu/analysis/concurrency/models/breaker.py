@@ -1,0 +1,220 @@
+"""Model: the drive-health breaker → reconnect probe → MRF re-sync
+machine of storage/instrumented.py + services/.
+
+One drive.  The environment breaks and heals the medium a bounded
+number of times; a bounded supply of storage calls arrives.  The
+protocol under test:
+
+* consecutive drive-level faults trip the breaker (threshold T); while
+  open every call fast-fails WITHOUT touching the drive;
+* a trip starts the reconnect probe, which closes the breaker only
+  after observing a healthy drive, and fires ``on_online`` exactly
+  once per recovery;
+* ``on_online`` enqueues an MRF re-sync; the re-sync converges (runs to
+  completion against an online drive), and every offline→online
+  transition produces exactly one.
+
+Invariants / terminal checks:
+
+* ``never-serve-offline``  — no call reaches the inner drive while the
+                             breaker is open (the fast-fail contract).
+* ``close-only-healthy``   — the probe never closes the breaker
+                             without having observed a healthy drive.
+* ``resync-converges`` (terminal) — at quiescence there are no pending
+                             re-syncs, every trip recovered, and
+                             recoveries produced between one and
+                             trip-count re-syncs (dedup may coalesce,
+                             but zero means a dropped on_online and
+                             more than trips means a double fire).
+"""
+
+from __future__ import annotations
+
+from ..modelcheck import Model, register
+
+
+def build(deep: bool = False) -> Model:
+    threshold = 2
+    breaks = 2 if deep else 1
+    calls = 8 if deep else 5
+
+    init = {
+        "drive_ok": True,
+        "breaks_left": breaks,
+        "heals_left": breaks,     # the medium always recovers eventually
+        "calls_left": calls,
+        "consec": 0,
+        "open": False,
+        "trips": 0,
+        "reconnects": 0,
+        "probe_running": False,
+        "resync_pending": 0,
+        "resyncs": 0,
+        "touched_while_open": False,
+        "closed_unhealthy": False,
+    }
+    m = Model("breaker-mrf", init,
+              "drive breaker / reconnect probe / MRF re-sync machine")
+
+    # -- environment --------------------------------------------------------
+    def do_break(s) -> None:
+        s["drive_ok"] = False
+        s["breaks_left"] -= 1
+
+    m.action("env_break",
+             lambda s: s["drive_ok"] and s["breaks_left"] > 0)(do_break)
+
+    def do_heal(s) -> None:
+        s["drive_ok"] = True
+        s["heals_left"] -= 1
+
+    m.action("env_heal",
+             lambda s: not s["drive_ok"] and s["heals_left"] > 0)(do_heal)
+
+    # -- the instrumented call path -----------------------------------------
+    def can_call(s) -> bool:
+        return s["calls_left"] > 0
+
+    def do_call(s) -> None:
+        s["calls_left"] -= 1
+        if s["open"]:
+            return  # fast-fail: microseconds, no drive touch
+        if s["drive_ok"]:
+            s["consec"] = 0
+            return
+        s["consec"] += 1
+        if s["consec"] >= threshold and not s["open"]:
+            s["open"] = True
+            s["trips"] += 1
+            s["probe_running"] = True  # _start_probe + on_offline
+
+    m.action("call_op", can_call)(do_call)
+
+    # -- reconnect probe -----------------------------------------------------
+    def can_probe(s) -> bool:
+        return s["probe_running"]
+
+    def do_probe(s) -> None:
+        if not s["drive_ok"]:
+            return  # is_online()/disk_info failed: back off, loop
+        if not s["open"]:
+            s["probe_running"] = False  # recovered elsewhere
+            return
+        s["open"] = False
+        s["consec"] = 0
+        s["reconnects"] += 1
+        s["probe_running"] = False
+        s["resync_pending"] += 1  # on_online -> MRF re-sync enqueue
+
+    m.action("probe_attempt", can_probe)(do_probe)
+
+    # -- MRF re-sync ---------------------------------------------------------
+    def can_resync(s) -> bool:
+        # the re-sync only converges against an online drive; while the
+        # drive is down again it stays pending (MRF backoff rounds)
+        return s["resync_pending"] > 0 and s["drive_ok"] and not s["open"]
+
+    def do_resync(s) -> None:
+        s["resync_pending"] -= 1
+        s["resyncs"] += 1
+
+    m.action("mrf_resync", can_resync)(do_resync)
+
+    # -- invariants ---------------------------------------------------------
+    @m.invariant("never-serve-offline")
+    def never_serve_offline(s) -> bool:
+        return not s["touched_while_open"]
+
+    @m.invariant("close-only-healthy")
+    def close_only_healthy(s) -> bool:
+        return not s["closed_unhealthy"]
+
+    @m.terminal("resync-converges")
+    def resync_converges(s) -> bool:
+        if s["resync_pending"] != 0 or s["trips"] != s["reconnects"]:
+            return False
+        if s["trips"] == 0:
+            return s["resyncs"] == 0
+        return 1 <= s["resyncs"] <= s["trips"]
+
+    # quiescence with the probe still running or a pending re-sync is a
+    # wedge (a probe that can never observe a healthy drive is excluded
+    # by heals_left == breaks)
+    m.done = lambda s: not s["probe_running"] and s["resync_pending"] == 0
+
+    # -- seeded mutations ----------------------------------------------------
+    @m.mutation("no-fast-fail",
+                "calls ignore the open breaker and keep touching the "
+                "drive — one hung drive stalls every quorum path")
+    def no_fast_fail(mut: Model) -> None:
+        def do_call_no_breaker(s) -> None:
+            s["calls_left"] -= 1
+            if s["open"]:
+                s["touched_while_open"] = True
+            if s["drive_ok"]:
+                s["consec"] = 0
+                return
+            s["consec"] += 1
+            if s["consec"] >= threshold and not s["open"]:
+                s["open"] = True
+                s["trips"] += 1
+                s["probe_running"] = True
+        mut.replace_action("call_op", effect=do_call_no_breaker)
+
+    @m.mutation("drop-on-online",
+                "the probe recovers the drive but never fires "
+                "on_online — the missed writes never re-sync")
+    def drop_on_online(mut: Model) -> None:
+        def do_probe_silent(s) -> None:
+            if not s["drive_ok"]:
+                return
+            if not s["open"]:
+                s["probe_running"] = False
+                return
+            s["open"] = False
+            s["consec"] = 0
+            s["reconnects"] += 1
+            s["probe_running"] = False
+            # BUG: on_online dropped; no re-sync enqueued
+        mut.replace_action("probe_attempt", effect=do_probe_silent)
+
+    @m.mutation("double-on-online",
+                "recovery fires on_online twice — duplicate re-syncs "
+                "double the heal traffic behind every reconnect")
+    def double_on_online(mut: Model) -> None:
+        def do_probe_double(s) -> None:
+            if not s["drive_ok"]:
+                return
+            if not s["open"]:
+                s["probe_running"] = False
+                return
+            s["open"] = False
+            s["consec"] = 0
+            s["reconnects"] += 1
+            s["probe_running"] = False
+            s["resync_pending"] += 2  # BUG
+        mut.replace_action("probe_attempt", effect=do_probe_double)
+
+    @m.mutation("close-without-health-check",
+                "the probe closes the breaker without disk_info "
+                "succeeding — a still-dead drive rejoins the quorum")
+    def close_without_health_check(mut: Model) -> None:
+        def do_probe_blind(s) -> None:
+            if not s["open"]:
+                s["probe_running"] = False
+                return
+            if not s["drive_ok"]:
+                s["closed_unhealthy"] = True
+            s["open"] = False
+            s["consec"] = 0
+            s["reconnects"] += 1
+            s["probe_running"] = False
+            s["resync_pending"] += 1
+        mut.replace_action("probe_attempt", effect=do_probe_blind)
+
+    return m
+
+
+@register("breaker-mrf")
+def factory(deep: bool = False) -> Model:
+    return build(deep=deep)

@@ -1,0 +1,279 @@
+"""Model: the hotcache fill/invalidate/generation protocol of
+serving/hotcache.py.
+
+One hot key.  The backing store is abstracted to a version counter
+(``disk_v``): a write makes version v readable on disk, the erasure
+layer's ``ns_updated`` hook fires ``invalidate`` (drop entries, pop the
+generation, DETACH in-flight fills), and only then does the PUT ack to
+its client (``acked_v``).  Readers run the serve() state machine: hit
+(generation-validated entry), follow (join a joinable fill, stream its
+buffered version), or lead (create a fill under the current generation,
+read the disk, commit only if the generation is unchanged and the fill
+was not detached).
+
+The correctness contract is read-your-writes *after the ack*: a reader
+whose first step happens after a write's ack must never be served a
+version older than that write.  Readers that started earlier may see
+the pre-write view — that is the documented follower semantics.
+
+Invariants:
+
+* ``no-stale-serve``     — served version >= the acked version the
+                           reader observed when it started.
+* ``no-stale-entry``     — the store never holds an entry whose
+                           generation is not the current one
+                           (invalidate drops entries and pops the
+                           generation in one atomic step; only a
+                           commit that skipped its generation check
+                           can break this).
+* ``detached-never-commits`` — a detached fill's buffer is only for
+                           its existing followers; it must never
+                           become the cached entry.
+
+Seeded mutations prove each invariant live; the ``hook-before-write``
+mutation is the interesting one — it shows WHY ns_updated must fire
+after the data lands: firing it before hands a leader a current
+generation over pre-write bytes, which then commits "validly" and
+serves stale after the ack.
+"""
+
+from __future__ import annotations
+
+from ..modelcheck import Model, register
+
+IDLE, FOLLOWING, DONE = "idle", "following", "done"
+
+
+def build(deep: bool = False) -> Model:
+    nreaders = 3 if deep else 2
+    nwrites = 2 if deep else 1
+    max_fills = 3 if deep else 2
+
+    init = {
+        "disk_v": 0,          # version readable from the erasure layer
+        "acked_v": 0,         # version of the last ACKED write
+        "gen": 0,             # current generation (0 = none assigned)
+        "gen_src": 0,         # monotonic generation counter
+        "entry": None,        # None | [version, gen]
+        # fills: id -> [gen, version|None, detached, done]
+        "fills": {},
+        "fill_src": 0,
+        "writes_left": nwrites,
+        "w_pc": "idle",       # idle | written | invalidated (per write)
+        # readers: [pc, start_acked, fill_id, served_version]
+        "readers": [["new", None, None, None] for _ in range(nreaders)],
+        "stale_commit": False,     # set by a detached/stale-gen commit
+        "detached_committed": False,
+    }
+    m = Model("hotcache", init,
+              "hotcache fill/invalidate/generation protocol")
+
+    # -- helpers ------------------------------------------------------------
+    def gen_of(s) -> int:
+        if s["gen"] == 0:
+            s["gen_src"] += 1
+            s["gen"] = s["gen_src"]
+        return s["gen"]
+
+    def entry_valid(s) -> bool:
+        return s["entry"] is not None and s["gen"] != 0 \
+            and s["entry"][1] == s["gen"]
+
+    # -- writer (sequential writes; each is write -> invalidate -> ack) -----
+    def can_write(s) -> bool:
+        return s["w_pc"] == "idle" and s["writes_left"] > 0
+
+    def do_write(s) -> None:
+        s["disk_v"] += 1
+        s["w_pc"] = "written"
+
+    m.action("w_write", can_write)(do_write)
+
+    def do_invalidate(s) -> None:
+        s["entry"] = None
+        s["gen"] = 0
+        for f in s["fills"].values():
+            f[2] = True  # detach: joinable no more, commit forbidden
+        s["w_pc"] = "invalidated"
+
+    m.action("w_invalidate", lambda s: s["w_pc"] == "written")(do_invalidate)
+
+    def do_ack(s) -> None:
+        s["acked_v"] = s["disk_v"]
+        s["writes_left"] -= 1
+        s["w_pc"] = "idle"
+
+    m.action("w_ack", lambda s: s["w_pc"] == "invalidated")(do_ack)
+
+    # -- readers ------------------------------------------------------------
+    for r in range(nreaders):
+        def can_start(s, r=r) -> bool:
+            return s["readers"][r][0] == "new"
+
+        def do_start(s, r=r) -> None:
+            rd = s["readers"][r]
+            rd[0] = "started"
+            rd[1] = s["acked_v"]  # the ack horizon this GET must honor
+
+        m.action(f"r{r}_start", can_start)(do_start)
+
+        # hit: generation-validated entry
+        def can_hit(s, r=r) -> bool:
+            return s["readers"][r][0] == "started" and entry_valid(s)
+
+        def do_hit(s, r=r) -> None:
+            rd = s["readers"][r]
+            rd[0] = DONE
+            rd[3] = s["entry"][0]
+
+        m.action(f"r{r}_hit", can_hit)(do_hit)
+
+        # follow: join a joinable (non-detached) fill
+        def can_follow(s, r=r) -> bool:
+            return s["readers"][r][0] == "started" and not entry_valid(s) \
+                and any(not f[2] for f in s["fills"].values())
+
+        def do_follow(s, r=r) -> None:
+            rd = s["readers"][r]
+            fid = min(k for k, f in s["fills"].items() if not f[2])
+            rd[0] = FOLLOWING
+            rd[2] = fid
+
+        m.action(f"r{r}_follow", can_follow)(do_follow)
+
+        def can_follow_serve(s, r=r) -> bool:
+            rd = s["readers"][r]
+            return rd[0] == FOLLOWING and s["fills"][rd[2]][3]
+
+        def do_follow_serve(s, r=r) -> None:
+            rd = s["readers"][r]
+            rd[3] = s["fills"][rd[2]][1]
+            rd[0] = DONE
+
+        m.action(f"r{r}_follow_serve", can_follow_serve)(do_follow_serve)
+
+        # lead: create the fill under the current generation
+        def can_lead(s, r=r) -> bool:
+            return (s["readers"][r][0] == "started" and not entry_valid(s)
+                    and not any(not f[2] for f in s["fills"].values())
+                    and s["fill_src"] < max_fills)
+
+        def do_lead(s, r=r) -> None:
+            rd = s["readers"][r]
+            s["fill_src"] += 1
+            fid = s["fill_src"]
+            s["fills"][fid] = [gen_of(s), None, False, False]
+            rd[0] = "leading"
+            rd[2] = fid
+
+        m.action(f"r{r}_lead", can_lead)(do_lead)
+
+        def can_read_disk(s, r=r) -> bool:
+            rd = s["readers"][r]
+            return rd[0] == "leading" and s["fills"][rd[2]][1] is None
+
+        def do_read_disk(s, r=r) -> None:
+            rd = s["readers"][r]
+            s["fills"][rd[2]][1] = s["disk_v"]
+
+        m.action(f"r{r}_read_disk", can_read_disk)(do_read_disk)
+
+        def can_commit(s, r=r) -> bool:
+            rd = s["readers"][r]
+            return rd[0] == "leading" and s["fills"][rd[2]][1] is not None
+
+        def do_commit(s, r=r) -> None:
+            rd = s["readers"][r]
+            fill = s["fills"][rd[2]]
+            # commit ONLY if no writer invalidated since the fill began:
+            # the fill is still attached and its generation is current
+            if not fill[2] and s["gen"] == fill[0]:
+                s["entry"] = [fill[1], fill[0]]
+            fill[3] = True  # settle: followers may serve
+            rd[3] = fill[1]
+            rd[0] = DONE
+
+        m.action(f"r{r}_commit", can_commit)(do_commit)
+
+    # -- invariants ---------------------------------------------------------
+    @m.invariant("no-stale-serve")
+    def no_stale_serve(s) -> bool:
+        """A reader that started after a write's ack must be served at
+        least that write's version (read-your-writes past the ack)."""
+        return all(rd[0] != DONE or rd[3] >= rd[1]
+                   for rd in s["readers"])
+
+    @m.invariant("no-stale-entry")
+    def no_stale_entry(s) -> bool:
+        """The store never holds an entry of a non-current generation
+        (the commit/invalidate generation dance keeps this tight)."""
+        if s["entry"] is None:
+            return True
+        return s["gen"] != 0 and s["entry"][1] == s["gen"] \
+            and not s["stale_commit"]
+
+    @m.invariant("detached-never-commits")
+    def detached_never_commits(s) -> bool:
+        return not s["detached_committed"]
+
+    m.done = lambda s: True  # readers may legitimately end as followers
+
+    # -- seeded mutations ----------------------------------------------------
+    @m.mutation("commit-without-gen-check",
+                "the fill leader commits its buffer even when a writer "
+                "invalidated mid-fill — a detached/stale-generation "
+                "buffer becomes the cached entry")
+    def commit_without_gen_check(mut: Model) -> None:
+        for r in range(nreaders):
+            def do_commit_unchecked(s, r=r) -> None:
+                rd = s["readers"][r]
+                fill = s["fills"][rd[2]]
+                if fill[2]:
+                    s["detached_committed"] = True
+                if s["gen"] != fill[0]:
+                    s["stale_commit"] = True
+                s["entry"] = [fill[1], fill[0]]
+                fill[3] = True
+                rd[3] = fill[1]
+                rd[0] = DONE
+            mut.replace_action(f"r{r}_commit",
+                               effect=do_commit_unchecked)
+
+    @m.mutation("invalidate-skips-detach",
+                "invalidate drops the entry and generation but leaves "
+                "in-flight fills joinable — a post-ack GET collapses "
+                "onto a pre-write fill and streams stale bytes")
+    def invalidate_skips_detach(mut: Model) -> None:
+        def do_invalidate_no_detach(s) -> None:
+            s["entry"] = None
+            s["gen"] = 0
+            s["w_pc"] = "invalidated"
+        mut.replace_action("w_invalidate",
+                           effect=do_invalidate_no_detach)
+
+    @m.mutation("hook-before-write",
+                "ns_updated fires BEFORE the data lands: a leader "
+                "starting in the gap gets a current generation over "
+                "pre-write bytes, commits validly, and serves stale "
+                "after the ack")
+    def hook_before_write(mut: Model) -> None:
+        def do_invalidate_first(s) -> None:
+            s["entry"] = None
+            s["gen"] = 0
+            for f in s["fills"].values():
+                f[2] = True
+            s["w_pc"] = "written"  # hook done, data NOT yet landed
+        def do_write_late(s) -> None:
+            s["disk_v"] += 1
+            s["w_pc"] = "invalidated"  # ready to ack
+        mut.replace_action("w_write", effect=do_invalidate_first)
+        mut.replace_action("w_invalidate",
+                           guard=lambda s: s["w_pc"] == "written",
+                           effect=do_write_late)
+
+    return m
+
+
+@register("hotcache")
+def factory(deep: bool = False) -> Model:
+    return build(deep=deep)

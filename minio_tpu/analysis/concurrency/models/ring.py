@@ -1,0 +1,260 @@
+"""Model: the shared-memory arena-ring producer/consumer/respawn
+protocol of parallel/workers.py.
+
+Abstraction choices (what the model keeps, what it drops):
+
+* One PUT, one ring.  The producer publishes MAXGEN generations into
+  NSLOTS slots; NCONS consumers (I/O workers + the hash lane collapse
+  to the same role here) each consume every generation in order.
+* The seqlock cells are explicit: ``ready[slot]`` is the published
+  generation, ``done[c][slot]`` the per-consumer recycle counter, and
+  ``slotval[slot]`` stands in for the PAYLOAD — it carries the
+  generation whose bytes currently occupy the slot, so a consumer that
+  observes ``slotval != its generation`` mid-read has read torn bytes.
+* Producer writes are two atomic steps (fill payload, then publish
+  ready) exactly because the real bug window sits between them.
+* Consumer reads are two atomic steps (begin holding a view, then
+  publish done) because recycling a slot under a live view is the
+  other real bug window.
+* Supervision: a consumer can be killed once (kills_left) and
+  respawned; the front's reader thread fails the dead worker's
+  in-flight job (``front_fail``), and the producer's liveness oracle
+  ``dead_fn`` is STICKY to the restart generation the job was
+  dispatched at — the respawned process is alive but lost this job,
+  so its frozen done counters must not be waited on.
+
+Invariants / terminal checks:
+
+* ``torn-read``    — a consumer holding a view of generation g never
+                     coexists with slot payload != g.
+* ``no-lap``       — a slot's published generation never exceeds the
+                     slowest live consumer by more than the ring size.
+* ``jobs-resolved``(terminal) — every dispatched job ends completed or
+                     failed-retryable.
+* deadlock freedom — quiescence with the producer unfinished is the
+                     respawn-wedges-producer bug.
+
+Seeded mutations (each must produce a counterexample — the proof the
+invariants are live): see the ``mutation`` blocks at the bottom.
+"""
+
+from __future__ import annotations
+
+from ..modelcheck import Model, register
+
+RUNNING, COMPLETED, FAILED = "running", "completed", "failed"
+
+
+def _dead_fn(s, c: int) -> bool:
+    """The front's per-job liveness oracle: a consumer is dead for THIS
+    job when its process died or when it was respawned since dispatch
+    (restart generation drifted from the job's sticky copy)."""
+    return (not s["alive"][c]) or s["restarts"][c] != s["job_gen"][c]
+
+
+def build(deep: bool = False) -> Model:
+    nslots = 3 if deep else 2
+    ncons = 2
+    maxgen = 5 if deep else 3
+    kills = 2 if deep else 1
+
+    init = {
+        "nslots": nslots,
+        "maxgen": maxgen,
+        "ready": [0] * nslots,        # published generation per slot
+        "slotval": [0] * nslots,      # generation whose PAYLOAD is resident
+        "done": [[0] * nslots for _ in range(ncons)],
+        "pgen": 0,                    # last published generation
+        "p_writing": 0,               # generation mid-fill (0 = none)
+        "p_done": False,
+        "alive": [True] * ncons,
+        "restarts": [0] * ncons,
+        "job_gen": [0] * ncons,       # restart gen the job was dispatched at
+        "job": [RUNNING] * ncons,
+        "cgen": [0] * ncons,          # last generation consumed
+        "view": [None] * ncons,       # (slot, gen) while holding a view
+        "kills_left": kills,
+    }
+    m = Model("arena-ring", init,
+              "workers.py ShmRing producer/consumer/respawn protocol")
+
+    # -- producer -----------------------------------------------------------
+    def can_fill(s) -> bool:
+        if s["p_done"] or s["p_writing"]:
+            return False
+        g = s["pgen"] + 1
+        if g > s["maxgen"]:
+            return False
+        slot = (g - 1) % s["nslots"]
+        floor = g - s["nslots"]
+        if floor <= 0:
+            return True
+        return all(_dead_fn(s, c) or s["done"][c][slot] >= floor
+                   for c in range(ncons))
+
+    def do_fill(s) -> None:
+        g = s["pgen"] + 1
+        s["slotval"][(g - 1) % s["nslots"]] = g
+        s["p_writing"] = g
+
+    m.action("p_fill", can_fill)(do_fill)
+
+    def do_publish(s) -> None:
+        g = s["p_writing"]
+        s["ready"][(g - 1) % s["nslots"]] = g
+        s["pgen"] = g
+        s["p_writing"] = 0
+        if g == s["maxgen"]:
+            s["p_done"] = True
+
+    m.action("p_publish", lambda s: s["p_writing"] > 0)(do_publish)
+
+    # -- consumers ----------------------------------------------------------
+    def working(s, c: int) -> bool:
+        """The worker only advances jobs it was dispatched: a respawned
+        process never resumes a lost job."""
+        return (s["alive"][c] and s["job"][c] == RUNNING
+                and s["restarts"][c] == s["job_gen"][c])
+
+    for c in range(ncons):
+        def can_begin(s, c=c) -> bool:
+            if not working(s, c) or s["view"][c] is not None:
+                return False
+            g = s["cgen"][c] + 1
+            return g <= s["maxgen"] and \
+                s["ready"][(g - 1) % s["nslots"]] >= g
+
+        def do_begin(s, c=c) -> None:
+            g = s["cgen"][c] + 1
+            s["view"][c] = [(g - 1) % s["nslots"], g]
+
+        m.action(f"c{c}_begin_read", can_begin)(do_begin)
+
+        def can_end(s, c=c) -> bool:
+            return working(s, c) and s["view"][c] is not None
+
+        def do_end(s, c=c) -> None:
+            slot, g = s["view"][c]
+            s["done"][c][slot] = g
+            s["cgen"][c] = g
+            s["view"][c] = None
+            if g == s["maxgen"]:
+                s["job"][c] = COMPLETED
+
+        m.action(f"c{c}_end_read", can_end)(do_end)
+
+        # -- supervision ----------------------------------------------------
+        def can_kill(s, c=c) -> bool:
+            return s["kills_left"] > 0 and s["alive"][c] \
+                and s["job"][c] == RUNNING
+
+        def do_kill(s, c=c) -> None:
+            s["kills_left"] -= 1
+            s["alive"][c] = False
+            s["view"][c] = None  # the view died with the process
+
+        m.action(f"kill_c{c}", can_kill)(do_kill)
+
+        def can_respawn(s, c=c) -> bool:
+            return not s["alive"][c]
+
+        def do_respawn(s, c=c) -> None:
+            s["alive"][c] = True
+            s["restarts"][c] += 1
+
+        m.action(f"respawn_c{c}", can_respawn)(do_respawn)
+
+        def can_fail(s, c=c) -> bool:
+            return s["job"][c] == RUNNING and _dead_fn(s, c)
+
+        def do_fail(s, c=c) -> None:
+            s["job"][c] = FAILED
+
+        m.action(f"front_fail_c{c}", can_fail)(do_fail)
+
+    # -- invariants ---------------------------------------------------------
+    @m.invariant("torn-read")
+    def torn_read(s) -> bool:
+        """A live consumer's view of generation g must still see g's
+        payload in the slot — anything else is bytes rewritten under a
+        reader (the write-races-fill class)."""
+        for c in range(ncons):
+            v = s["view"][c]
+            if s["alive"][c] and v is not None \
+                    and s["slotval"][v[0]] != v[1]:
+                return False
+        return True
+
+    @m.invariant("no-lap")
+    def no_lap(s) -> bool:
+        """The producer never laps a live working consumer by more than
+        the ring: published gen - consumed gen <= nslots."""
+        for c in range(ncons):
+            if not _dead_fn(s, c) and s["job"][c] == RUNNING \
+                    and s["pgen"] - s["cgen"][c] > s["nslots"]:
+                return False
+        return True
+
+    @m.terminal("jobs-resolved")
+    def jobs_resolved(s) -> bool:
+        return all(j in (COMPLETED, FAILED) for j in s["job"])
+
+    m.done = lambda s: s["p_done"]
+
+    # -- seeded mutations (liveness proofs) ----------------------------------
+    @m.mutation("skip-done-wait",
+                "producer recycles slots without waiting for consumer "
+                "done counters — rewrites bytes under a live view")
+    def skip_done_wait(mut: Model) -> None:
+        def can_fill_unsafe(s) -> bool:
+            return (not s["p_done"] and not s["p_writing"]
+                    and s["pgen"] + 1 <= s["maxgen"])
+        mut.replace_action("p_fill", guard=can_fill_unsafe)
+
+    @m.mutation("respawn-not-sticky",
+                "dead_fn forgets the job's dispatch generation: a "
+                "killed-and-respawned consumer counts live again and "
+                "its frozen done counters wedge the producer")
+    def respawn_not_sticky(mut: Model) -> None:
+        def can_fill_sticky_less(s) -> bool:
+            if s["p_done"] or s["p_writing"]:
+                return False
+            g = s["pgen"] + 1
+            if g > s["maxgen"]:
+                return False
+            slot = (g - 1) % s["nslots"]
+            floor = g - s["nslots"]
+            if floor <= 0:
+                return True
+            # BUG: liveness by alive-bit only — restart drift ignored
+            return all((not s["alive"][c]) or s["done"][c][slot] >= floor
+                       for c in range(ncons))
+        mut.replace_action("p_fill", guard=can_fill_sticky_less)
+
+    @m.mutation("done-before-copy",
+                "consumer publishes its done counter when it TAKES the "
+                "view instead of when it releases it — the slot is "
+                "recycled under the live read")
+    def done_before_copy(mut: Model) -> None:
+        for c in range(ncons):
+            def do_begin_eager(s, c=c) -> None:
+                g = s["cgen"][c] + 1
+                slot = (g - 1) % s["nslots"]
+                s["view"][c] = [slot, g]
+                s["done"][c][slot] = g  # BUG: recycled while still read
+            mut.replace_action(f"c{c}_begin_read",
+                               effect=do_begin_eager)
+
+    @m.mutation("drop-front-fail",
+                "the reply-reader thread never fails a dead worker's "
+                "in-flight jobs — a dispatched job is lost forever")
+    def drop_front_fail(mut: Model) -> None:
+        for c in range(ncons):
+            mut.drop_action(f"front_fail_c{c}")
+
+    return m
+
+
+@register("arena-ring")
+def factory(deep: bool = False) -> Model:
+    return build(deep=deep)

@@ -1,0 +1,562 @@
+"""Dynamic lockset race detector (Eraser, Savage et al. 1997) with
+deterministic-interleaving scheduler hooks.
+
+The AST rules catch lock-discipline bugs a parser can see; this module
+catches the ones only execution sees: a counter bumped without the lock
+its other writers hold, a check-then-act admission where the check and
+the act ride different locks.  Three pieces:
+
+* **Tracked synchronization.**  ``Lock``/``RLock``/``Condition``
+  wrappers maintain a per-thread *held lockset*.  ``install()``
+  monkeypatches ``threading`` so product objects constructed afterward
+  get tracked locks transparently; tests prefer the narrower
+  ``patched()`` context so only the objects under test are tracked.
+
+* **Watched shared state.**  ``watch(cls, *attrs)`` replaces the named
+  attributes with data descriptors that report every get/set to the
+  tracker.  Works for plain classes and ``__slots__`` classes (the
+  member descriptor is wrapped).  Per watched location the tracker
+  runs the Eraser state machine: virgin → exclusive (first thread) →
+  shared / shared-modified (second thread), refining the candidate
+  lockset ``C(v) ∩= locks_held`` at each post-exclusive WRITE and
+  reporting when a multi-thread write's refined lockset is empty.
+  (Reads neither refine nor report: under the GIL, lock-free advisory
+  reads of locked counters are the repo's sanctioned snapshot idiom.)
+
+* **Scheduler hooks.**  ``gate(key)`` registers a callback fired on
+  every access to a watched location *before* the underlying
+  read/write happens.  A regression test uses it to park one thread
+  between the load and the store of a ``+=`` — the exact interleaving
+  a lost-update race needs — turning "run it 10k times and hope" into
+  a deterministic two-thread schedule (tests/test_racecheck.py).
+
+Waivers ride the PR 4 pragma grammar: a benign racy access (an
+advisory lock-free snapshot) is waived by annotating the attribute's
+assignment in the owning class with ``# lint: allow(racecheck):
+<reason>``; ``watch`` reads the class source and excuses those
+locations.  The static ``racecheck`` rule (analysis/rules/racecheck
+registration below) polices the same reasons-mandatory hygiene as
+every other pragma.  Enabled suite-wide via ``MINIO_TPU_RACECHECK=1``
+(tests/conftest.py installs the tracked primitives and the default
+watch list before product imports).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import sys
+import threading
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_CONDITION = threading.Condition
+
+VIRGIN, EXCLUSIVE, SHARED, MODIFIED = range(4)
+_STATE_NAMES = {VIRGIN: "virgin", EXCLUSIVE: "exclusive",
+                SHARED: "shared", MODIFIED: "shared-modified"}
+
+
+def enabled() -> bool:
+    return os.environ.get("MINIO_TPU_RACECHECK", "") == "1"
+
+
+# ------------------------------------------------------------ held locksets
+class _TLS(threading.local):
+    def __init__(self):
+        self.held: list[int] = []
+
+
+_tls = _TLS()
+
+
+def held_locks() -> frozenset:
+    return frozenset(_tls.held)
+
+
+class Lock:
+    """threading.Lock with held-set tracking."""
+
+    _racecheck_tracked = True
+
+    def __init__(self):
+        self._inner = _REAL_LOCK()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            _tls.held.append(id(self))
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        try:
+            _tls.held.remove(id(self))
+        except ValueError:
+            pass  # released by a different thread than the acquirer
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def _at_fork_reinit(self) -> None:
+        self._inner._at_fork_reinit()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+
+class RLock:
+    """threading.RLock with held-set tracking (one held entry per
+    nesting level keeps release bookkeeping trivial)."""
+
+    _racecheck_tracked = True
+
+    def __init__(self):
+        self._inner = _REAL_RLOCK()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            _tls.held.append(id(self))
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        try:
+            _tls.held.remove(id(self))
+        except ValueError:
+            pass
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def _at_fork_reinit(self) -> None:
+        self._inner._at_fork_reinit()
+
+    # Condition support
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+
+class Condition:
+    """threading.Condition over a tracked lock.  ``wait`` drops the
+    lock from the held set for its sleep window (the real wait releases
+    the lock) and restores it on wakeup."""
+
+    _racecheck_tracked = True
+
+    def __init__(self, lock=None):
+        self._lock = lock if lock is not None else RLock()
+        inner = getattr(self._lock, "_inner", self._lock)
+        self._cond = _REAL_CONDITION(inner)
+
+    def acquire(self, *a, **kw):
+        return self._lock.acquire(*a, **kw)
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def __enter__(self):
+        self._lock.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._lock.release()
+        return False
+
+    def _drop_held(self) -> int:
+        n = _tls.held.count(id(self._lock))
+        for _ in range(n):
+            _tls.held.remove(id(self._lock))
+        return n
+
+    def _readd_held(self, n: int) -> None:
+        _tls.held.extend([id(self._lock)] * n)
+
+    def wait(self, timeout: float | None = None) -> bool:
+        n = self._drop_held()
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            self._readd_held(n)
+
+    def wait_for(self, predicate, timeout: float | None = None):
+        n = self._drop_held()
+        try:
+            return self._cond.wait_for(predicate, timeout)
+        finally:
+            self._readd_held(n)
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+
+def _lock_factory():
+    return Lock()
+
+
+def _rlock_factory():
+    return RLock()
+
+
+_installed = False
+
+
+def install() -> None:
+    """Monkeypatch threading so locks created from here on are tracked.
+    Process-wide; used by the MINIO_TPU_RACECHECK=1 conftest wiring."""
+    global _installed
+    if _installed:
+        return
+    _installed = True
+    threading.Lock = _lock_factory
+    threading.RLock = _rlock_factory
+    threading.Condition = Condition
+
+
+def uninstall() -> None:
+    global _installed
+    if not _installed:
+        return
+    _installed = False
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    threading.Condition = _REAL_CONDITION
+
+
+class patched:
+    """Context manager tracking only locks created inside the block —
+    the drill-scoped alternative to a process-wide install()."""
+
+    def __enter__(self):
+        self._was = _installed
+        install()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if not self._was:
+            uninstall()
+        return False
+
+
+# ------------------------------------------------------------------ tracker
+class _Loc:
+    __slots__ = ("state", "owner", "lockset", "reported", "last_write",
+                 "threads")
+
+    def __init__(self):
+        self.state = VIRGIN
+        self.owner: int | None = None
+        self.lockset: frozenset | None = None
+        self.reported = False
+        self.last_write = ""   # "file:line (thread)" of the latest write
+        self.threads: set = set()
+
+
+class Finding:
+    def __init__(self, key: str, detail: str):
+        self.key = key
+        self.detail = detail
+
+    def __repr__(self) -> str:
+        return f"race on {self.key}: {self.detail}"
+
+
+class Tracker:
+    """Eraser lockset state machine over watched locations."""
+
+    def __init__(self):
+        self._mu = _REAL_LOCK()
+        self._locs: dict[tuple, _Loc] = {}  # (attr key, instance id)
+        self._findings: list[Finding] = []
+        self._waived: dict[str, str] = {}  # key -> reason
+        self._gates: dict[str, object] = {}
+
+    # -- scheduler hooks -----------------------------------------------------
+    def gate(self, key: str, fn) -> None:
+        """Install `fn(is_write)` to run on every access to `key` BEFORE
+        the underlying read/write — the deterministic-interleaving
+        scheduler point.  Pass fn=None to remove."""
+        with self._mu:
+            if fn is None:
+                self._gates.pop(key, None)
+            else:
+                self._gates[key] = fn
+
+    # -- waivers -------------------------------------------------------------
+    def waive(self, key: str, reason: str) -> None:
+        if not reason or not reason.strip():
+            raise ValueError(
+                f"racecheck waiver for {key} needs a reason "
+                "(same contract as `# lint: allow(rule): why`)")
+        with self._mu:
+            self._waived[key] = reason
+
+    # -- the access hook ------------------------------------------------------
+    def note(self, key: str, is_write: bool, inst: int = 0) -> None:
+        """`key` names the class attribute (reports, gates, waivers);
+        `inst` distinguishes INSTANCES — an Eraser location is a memory
+        cell, and two objects constructed on different threads must not
+        alias into one false-shared location."""
+        gate = self._gates.get(key)
+        if gate is not None:
+            gate(is_write)
+        tid = threading.get_ident()
+        held = held_locks()
+        # caller site for the report (2 frames up: descriptor -> caller)
+        try:
+            f = sys._getframe(2)
+            site = f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
+        except Exception:
+            site = "?"
+        with self._mu:
+            loc = self._locs.get((key, inst))
+            if loc is None:
+                loc = self._locs[(key, inst)] = _Loc()
+            loc.threads.add(tid)
+            if is_write:
+                loc.last_write = f"{site} (thread {tid})"
+            if loc.state == VIRGIN:
+                loc.state = EXCLUSIVE
+                loc.owner = tid
+                return
+            # WRITE-lockset discipline: under the GIL a lock-free READ
+            # of a locked counter is the repo's documented advisory-
+            # snapshot idiom (hotcache.stats, probe, metrics scrapes)
+            # and a torn read is impossible for attribute loads — the
+            # harmful classes are lockless read-modify-writes and
+            # split-lock writes.  So the candidate lockset is the
+            # intersection of locks held at WRITES only (reads neither
+            # refine it nor trigger reports — a scrape racing the
+            # concurrent phase must not erase the writers' evidence),
+            # and a report fires at a multi-thread write whose refined
+            # lockset is empty.  This also sidesteps Eraser's classic
+            # post-join false positive (a single-threaded assertion
+            # read after joining the workers).
+            if loc.state == EXCLUSIVE:
+                if tid == loc.owner:
+                    return
+                loc.state = MODIFIED if is_write else SHARED
+                if is_write:
+                    loc.lockset = held
+            elif is_write:
+                loc.state = MODIFIED
+                loc.lockset = held if loc.lockset is None \
+                    else (loc.lockset & held)
+            if is_write and loc.state == MODIFIED \
+                    and loc.lockset is not None and not loc.lockset \
+                    and not loc.reported:
+                loc.reported = True
+                if key not in self._waived:
+                    self._findings.append(Finding(
+                        key,
+                        f"written by {len(loc.threads)} threads with an "
+                        f"empty candidate lockset; last write at "
+                        f"{loc.last_write or site}"))
+
+    # -- results --------------------------------------------------------------
+    def findings(self) -> list[Finding]:
+        with self._mu:
+            return list(self._findings)
+
+    def waived(self) -> dict[str, str]:
+        with self._mu:
+            return dict(self._waived)
+
+    def reset(self, key: str | None = None) -> None:
+        """Forget access history (all keys or one) — used between drill
+        phases so single-threaded setup/teardown does not pollute the
+        concurrent phase's locksets."""
+        with self._mu:
+            if key is None:
+                self._locs.clear()
+                self._findings.clear()
+            else:
+                for k in [k for k in self._locs if k[0] == key]:
+                    del self._locs[k]
+                self._findings[:] = [f for f in self._findings
+                                     if f.key != key]
+
+
+TRACKER = Tracker()
+
+
+# ------------------------------------------------------------ watched attrs
+class _Watched:
+    """Data descriptor reporting get/set of one attribute to TRACKER."""
+
+    def __init__(self, cls, name: str, orig):
+        self.key = f"{cls.__module__}.{cls.__qualname__}.{name}"
+        self.name = name
+        self.orig = orig       # member_descriptor for __slots__, else None
+        self.store = f"_rc__{name}"
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        TRACKER.note(self.key, is_write=False, inst=_inst_of(obj))
+        if self.orig is not None:
+            return self.orig.__get__(obj, objtype)
+        try:
+            return obj.__dict__[self.store]
+        except KeyError:
+            pass
+        try:
+            # instance predating the watch: its value sits under the
+            # plain name (shadowed for writes from here on)
+            return obj.__dict__[self.name]
+        except KeyError:
+            raise AttributeError(self.name) from None
+
+    def __set__(self, obj, value) -> None:
+        TRACKER.note(self.key, is_write=True, inst=_inst_of(obj))
+        if self.orig is not None:
+            self.orig.__set__(obj, value)
+        else:
+            obj.__dict__[self.store] = value
+
+    def __delete__(self, obj) -> None:
+        if self.orig is not None:
+            self.orig.__delete__(obj)
+        else:
+            obj.__dict__.pop(self.store, None)
+
+
+_watch_originals: list[tuple[type, str, object]] = []
+
+_inst_tokens = itertools.count(1)
+
+
+def _inst_of(obj) -> int:
+    """Stable per-instance identity.  id() alone is unusable: CPython
+    recycles addresses, and a new cache allocated where a dead one
+    lived would alias into its location — constructed on a different
+    thread under a different lock, that reads as an empty-lockset
+    false positive.  A monotonic token stashed on the instance never
+    aliases; slots-only objects fall back to id()."""
+    d = getattr(obj, "__dict__", None)
+    if d is None:
+        return id(obj)
+    tok = d.get("_rc_token")
+    if tok is None:
+        tok = d.setdefault("_rc_token", next(_inst_tokens))
+    return tok
+
+
+def _scan_waivers(cls, attrs) -> None:
+    """Honor `# lint: allow(racecheck): reason` pragmas on the watched
+    attributes' assignment lines in the class source — the PR 4 pragma
+    grammar applied to dynamic findings."""
+    import inspect
+
+    try:
+        src_file = inspect.getsourcefile(cls)
+        src, start = inspect.getsourcelines(cls)
+    except (OSError, TypeError):
+        return
+    from minio_tpu.analysis.core import Module
+
+    try:
+        with open(src_file, encoding="utf-8") as f:
+            mod = Module(src_file, f.read())
+    except (OSError, SyntaxError):
+        return
+    for attr in attrs:
+        # `self.attr = ...` in __init__, or a dataclass field line
+        needles = (f"self.{attr}=", f"{attr}:", f"{attr}=")
+        for off, line in enumerate(src):
+            compact = line.split("#", 1)[0].replace(" ", "")
+            if any(compact.startswith(n) for n in needles):
+                p = mod.pragma_for("racecheck", start + off)
+                if p is not None and p.reason:
+                    TRACKER.waive(
+                        f"{cls.__module__}.{cls.__qualname__}.{attr}",
+                        p.reason)
+                    break
+
+
+def watch(cls, *attrs: str) -> None:
+    """Instrument the named attributes of `cls` for the tracker."""
+    _scan_waivers(cls, attrs)
+    for name in attrs:
+        cur = cls.__dict__.get(name)
+        if isinstance(cur, _Watched):
+            continue
+        orig = cur if hasattr(cur, "__set__") else None
+        _watch_originals.append((cls, name, cur))
+        setattr(cls, name, _Watched(cls, name, orig))
+
+
+def unwatch_all() -> None:
+    while _watch_originals:
+        cls, name, cur = _watch_originals.pop()
+        if cur is None:
+            try:
+                delattr(cls, name)
+            except AttributeError:
+                pass
+        else:
+            setattr(cls, name, cur)
+
+
+def key_of(cls, attr: str) -> str:
+    return f"{cls.__module__}.{cls.__qualname__}.{attr}"
+
+
+class TracedDict(dict):
+    """dict reporting item get/set to the tracker — for module-level
+    table state (stagestats' per-stage tables) where there is no class
+    attribute to watch.  Swap it in with monkeypatch, run the REAL
+    code paths over it, and the lockset discipline of every access is
+    checked."""
+
+    def __init__(self, key: str, data):
+        super().__init__(data)
+        self.key = key
+        self._tok = next(_inst_tokens)
+
+    def __getitem__(self, k):
+        TRACKER.note(self.key, is_write=False, inst=self._tok)
+        return dict.__getitem__(self, k)
+
+    def __setitem__(self, k, v) -> None:
+        TRACKER.note(self.key, is_write=True, inst=self._tok)
+        dict.__setitem__(self, k, v)
+
+
+def install_default_watches() -> None:
+    """The designated shared-state surface for suite replays: hotcache,
+    brownout, MRF stats, replication stats, gateway cache counters and
+    the drive-health counters.  Extend as new concurrent subsystems
+    land."""
+    from minio_tpu.gateway.cache import CacheLayer
+    from minio_tpu.services.brownout import BrownoutController
+    from minio_tpu.services.mrf import MRFStats
+    from minio_tpu.services.replication import ReplicationStats
+    from minio_tpu.serving.hotcache import HotObjectCache
+    from minio_tpu.storage.instrumented import InstrumentedStorage
+
+    watch(HotObjectCache, "hits", "misses", "fills", "collapsed",
+          "evictions", "invalidations", "_bytes", "_prot_bytes",
+          "_fill_bytes", "_freq_ops")
+    watch(BrownoutController, "_engaged", "_last_pressure", "engagements",
+          "releases", "sheds_seen", "deferrals", "hot_bypasses")
+    watch(MRFStats, "enqueued", "healed", "failed", "dropped", "pending")
+    watch(ReplicationStats, "queued", "completed", "failed", "deletes",
+          "proxied")
+    watch(CacheLayer, "hits", "misses")
+    watch(InstrumentedStorage, "trips", "reconnects", "fast_fails",
+          "_consec_faults")

@@ -1,4 +1,5 @@
 """Checker registry: importing this package registers every rule."""
 
-from . import (budget, locks, metrics, payload, s3errors,  # noqa: F401
+from . import (budget, locks, metrics, payload,  # noqa: F401
+               racecheck_waivers, resource_lifecycle, s3errors,
                shared_state, threads)

@@ -1,0 +1,32 @@
+"""racecheck: static policing of dynamic race-detector waivers.
+
+The lockset detector (analysis/concurrency/racecheck.py) excuses a
+benign racy location — an advisory lock-free snapshot, a monotonic
+debug counter — when the attribute's assignment carries
+
+    self.hits = 0  # lint: allow(racecheck): advisory metrics snapshot reads lock-free by design
+
+This rule makes those annotations first-class pragmas: each one is
+"used" (so the stale-pragma police does not flag it), and the shared
+grammar rules apply — a reason is mandatory, the rule name must be
+real.  The finding below only surfaces when the pragma is malformed
+(reasonless), which is exactly the contract every other rule has.
+"""
+
+from __future__ import annotations
+
+from ..core import Finding, rule
+
+
+@rule("racecheck",
+      "waiver anchor for the dynamic lockset race detector "
+      "(analysis/concurrency/racecheck.py); reasons are mandatory")
+def check(module, project):
+    out = []
+    for line, pragma in sorted(module.pragmas.items()):
+        if "racecheck" in pragma.rules:
+            out.append(Finding(
+                module.path, line, 0, "racecheck",
+                "dynamic race waiver: the lockset detector will skip "
+                "this location — keep the reason honest"))
+    return out

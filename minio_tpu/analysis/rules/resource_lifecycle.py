@@ -1,0 +1,232 @@
+"""resource-lifecycle: fds, shm segments, staged writers and pooled
+buffers must be released on the exception path.
+
+The recurring review-bug class of PRs 5-8: an `open_file_writer` /
+`SharedMemory` / pool `acquire` whose `.close()`/`.release()` sits on
+the straight-line path only — one exception between acquire and release
+and the fd (or /dev/shm segment, or pooled arena buffer) leaks, taxing
+every later request and, for shm, littering the machine past process
+death.  The PR 8 conftest sweep catches the *symptom* at session end;
+this rule catches the *shape* at review time.
+
+Detection, per function: an assignment ``x = <acquire>(...)`` where the
+callee is a known resource constructor (see ``_ACQUIRES``) and the call
+is not a ``with`` context.  The binding then needs one of:
+
+* a release (``close``/``release``/``unlink``/``os.close``/
+  ``shutdown``) reachable on the exception path — i.e. inside a
+  ``finally`` or ``except`` block, or inside a function the value was
+  handed to before anything fallible runs;
+* an ownership transfer: ``return x``, ``yield x``, ``self.attr = x``,
+  ``container[k] = x`` / ``.append(x)``, or ``x`` passed as a call
+  argument (wrapping writers, registries) — the new owner's lifecycle
+  rules apply there instead.
+
+A release that exists ONLY on the happy path (plain statement, no
+try/finally) is the flagged bug: it proves the author knew the value
+needs releasing and still leaks it on every raise in between.
+
+Intentionally-leaked process-wide singletons carry the usual reasoned
+pragma:
+
+    _pool = ThreadPoolExecutor(...)  # lint: allow(resource-lifecycle): process-lifetime pool, reclaimed at exit
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, call_name, rule
+
+#: callee tails that mint a resource owning an fd / mapping / buffer.
+#: Matched against the LAST component of the dotted callee name.
+_ACQUIRES = {
+    "open": "file handle",
+    "open_file_writer": "staged shard writer (fd + tmp file)",
+    "SharedMemory": "shared-memory segment",
+    "BitrotWriter": "bitrot writer (owns its fd)",
+    "BitrotReader": "bitrot reader (owns its stream)",
+    "socket": "socket",
+    "TemporaryDirectory": "staged tmp dir",
+}
+
+#: `.acquire()` counts only on pool-ish receivers — lock discipline is
+#: blocking-under-lock's turf, token buckets need no release.
+_POOLISH = ("pool", "ring", "arena", "buffers")
+
+_RELEASES = ("close", "release", "unlink", "shutdown", "terminate",
+             "close_all", "abort")
+
+
+def _acquire_kind(node: ast.Call):
+    name = call_name(node)
+    last = name.rsplit(".", 1)[-1]
+    if last in _ACQUIRES:
+        # `os.open` is a raw-fd acquire too; plain `open` must not
+        # match attribute spellings like `gzip.open` twice removed —
+        # keep all of them, the release grammar is the same
+        return _ACQUIRES[last]
+    if last == "acquire" and "." in name:
+        recv = name.rsplit(".", 2)[-2].lower()
+        if any(p in recv for p in _POOLISH):
+            return "pooled buffer"
+    return None
+
+
+def _is_withitem(node: ast.Call, parents) -> bool:
+    p = parents.get(node)
+    return isinstance(p, ast.withitem)
+
+
+def _build_parents(root):
+    parents = {}
+    for parent in ast.walk(root):
+        for child in ast.iter_child_nodes(parent):
+            parents[child] = parent
+    return parents
+
+
+def _exception_reachable(node, parents, fn) -> bool:
+    """True when `node` sits inside a finally or except block of some
+    try statement within `fn` — the release runs even when the body
+    raised."""
+    cur = node
+    while cur is not fn:
+        p = parents.get(cur)
+        if p is None:
+            return False
+        if isinstance(p, ast.Try):
+            if any(cur is n or _contains(n, cur)
+                   for n in p.finalbody):
+                return True
+            for h in p.handlers:
+                if _contains(h, cur):
+                    return True
+        if isinstance(p, ast.ExceptHandler):
+            return True
+        cur = p
+    return False
+
+
+def _contains(root, target) -> bool:
+    if root is target:
+        return True
+    return any(_contains(c, target) for c in ast.iter_child_nodes(root))
+
+
+def _own_nodes(fn):
+    """fn's statements excluding nested function/lambda bodies — each
+    nested def is analyzed as its own function."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+        yield node
+
+
+def _uses_of(fn, name: str):
+    """Every Name load of `name` in fn's own body."""
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Name) and node.id == name \
+                and isinstance(node.ctx, ast.Load):
+            yield node
+
+
+def _captured_by_closure(fn, name: str) -> bool:
+    """True when a nested def/lambda reads `name`: the closure owns the
+    resource's lifetime now (generator finalizers, deferred cleanups)."""
+    stack = list(ast.iter_child_nodes(fn))
+    nested = []
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            nested.append(node)
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    for sub in nested:
+        for node in ast.walk(sub):
+            if isinstance(node, ast.Name) and node.id == name \
+                    and isinstance(node.ctx, ast.Load):
+                return True
+    return False
+
+
+@rule("resource-lifecycle",
+      "fd/shm/writer/pool-buffer acquired without a release on the "
+      "exception path (release in finally/except, `with`, or ownership "
+      "transfer)")
+def check(module, project):
+    out = []
+    for fn in ast.walk(module.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        parents = _build_parents(fn)
+        for node in _own_nodes(fn):
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            kind = _acquire_kind(node.value)
+            if kind is None:
+                continue
+            if len(node.targets) != 1 or \
+                    not isinstance(node.targets[0], ast.Name):
+                continue  # tuple targets / attribute stores transfer
+            var = node.targets[0].id
+            released_safe = False   # release reachable on exceptions
+            released_happy = False  # release on the straight-line path
+            transferred = False
+            for use in _uses_of(fn, var):
+                p = parents.get(use)
+                # `x.close()` / `x.release()` shapes
+                if isinstance(p, ast.Attribute) and \
+                        p.attr in _RELEASES:
+                    if _exception_reachable(use, parents, fn):
+                        released_safe = True
+                    else:
+                        released_happy = True
+                    continue
+                if isinstance(p, ast.Call) and use in p.args:
+                    callee = call_name(p)
+                    last = callee.rsplit(".", 1)[-1]
+                    if last in _RELEASES:  # os.close(fd) etc
+                        if _exception_reachable(use, parents, fn):
+                            released_safe = True
+                        else:
+                            released_happy = True
+                    else:
+                        # handed to another callable: new owner
+                        transferred = True
+                    continue
+                if isinstance(p, (ast.Return, ast.Yield, ast.YieldFrom)):
+                    transferred = True
+                    continue
+                if isinstance(p, ast.Assign) and use is p.value:
+                    # self.attr = x / container[k] = x: ownership moves
+                    if any(isinstance(t, (ast.Attribute, ast.Subscript))
+                           for t in p.targets):
+                        transferred = True
+                    continue
+                if isinstance(p, (ast.Tuple, ast.List, ast.Dict)):
+                    transferred = True  # collected into a structure
+                    continue
+            if released_safe or transferred:
+                continue
+            if not released_happy and _captured_by_closure(fn, var):
+                continue  # a nested def owns the cleanup now
+            if released_happy:
+                msg = (f"{kind} `{var}` is released only on the happy "
+                       "path — an exception between acquire and release "
+                       "leaks it; move the release into try/finally or "
+                       "use `with`")
+            else:
+                msg = (f"{kind} `{var}` is never released in this "
+                       "function and never handed off — leaked on every "
+                       "path; release it in a finally or transfer "
+                       "ownership explicitly")
+            out.append(Finding(module.path, node.lineno, node.col_offset,
+                               "resource-lifecycle", msg))
+    return out

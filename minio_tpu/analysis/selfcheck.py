@@ -1,0 +1,123 @@
+"""Built-in linter self-tests: one known-bad and one known-good
+fixture per rule, runnable without pytest (`python -m
+minio_tpu.analysis --all`).
+
+A linter whose rules silently stop firing is worse than no linter (the
+gate keeps passing while the bug class returns), so the single-exit-
+code CI entry point re-proves each rule live the same way the model
+checker re-proves each invariant live via seeded mutations.  The
+heavyweight fixture matrix lives in tests/test_static_analysis.py;
+this is the minimal always-on liveness probe.
+"""
+
+from __future__ import annotations
+
+import textwrap
+
+from .core import analyze_source
+
+#: rule -> (path, must-flag source, must-pass source)
+SELF_TESTS: dict[str, tuple[str, str, str]] = {
+    "budget-propagation": (
+        "mod.py",
+        "def f(pool, fn):\n    return pool.submit(fn)\n",
+        "from minio_tpu.utils.deadline import ctx_submit\n"
+        "def f(pool, fn):\n    return ctx_submit(pool, fn)\n",
+    ),
+    "blocking-under-lock": (
+        "mod.py",
+        "import time\n"
+        "def f(self):\n    with self._mu:\n        time.sleep(1)\n",
+        "import time\n"
+        "def f(self):\n    with self._mu:\n        x = 1\n    time.sleep(1)\n",
+    ),
+    "thread-lifecycle": (
+        "mod.py",
+        "import threading\n"
+        "def f(fn):\n    threading.Thread(target=fn).start()\n",
+        "import threading\n"
+        "def f(fn):\n"
+        "    threading.Thread(target=fn, daemon=True).start()\n",
+    ),
+    "shared-state": (
+        "minio_tpu/storage/local.py",
+        "_c = None\n"
+        "def f():\n    global _c\n    _c = {}\n",
+        "LIMIT = 7\n"
+        "def f():\n    return LIMIT\n",
+    ),
+    "resource-lifecycle": (
+        "mod.py",
+        "def f(d):\n"
+        "    fh = d.open_file_writer('v', 'p')\n"
+        "    fh.write(b'x')\n"
+        "    fh.close()\n",
+        "def f(d):\n"
+        "    fh = d.open_file_writer('v', 'p')\n"
+        "    try:\n        fh.write(b'x')\n"
+        "    finally:\n        fh.close()\n",
+    ),
+    "metrics-drift": (
+        "mod.py",
+        # lint: allow(metrics-drift): the undeclared name IS the fixture — it must stay unregistered to prove the rule flags it
+        'def render(g):\n    g("minio_bogus_selfcheck_total 1")\n',
+        "X = 1\n",
+    ),
+    "s3-error-coverage": (
+        "mod.py",
+        "from minio_tpu.server.s3errors import S3Error\n"
+        "def handler():\n"
+        "    raise S3Error(\"NoSuchFrobnicator\")\n",
+        "from minio_tpu.server.s3errors import S3Error\n"
+        "def handler():\n"
+        "    raise S3Error(\"NoSuchKey\")\n",
+    ),
+    "payload-budget": (
+        "mod.py",
+        "async def put(self, request, bucket, key, reader, size, opts):\n"
+        "    return await self._run(self.api.put_object, bucket, key,\n"
+        "                           reader, size, opts)\n",
+        "async def put(self, request, bucket, key, reader, size, opts):\n"
+        "    return await self._run_nobudget(self.api.put_object,\n"
+        "                                    bucket, key, reader, size,\n"
+        "                                    opts)\n",
+    ),
+    "racecheck": (
+        "mod.py",
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self.snap = 0  # lint: allow(racecheck)\n",
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        # lint: allow(racecheck): advisory snapshot, read lock-free by design\n"
+        "        self.snap = 0\n",
+    ),
+}
+
+
+def run() -> list[str]:
+    """Returns a list of failure descriptions (empty = all rules live).
+    Every registered rule must have a fixture pair here — a rule the
+    probe does not cover could die silently, which is the exact failure
+    this gate exists to prevent."""
+    from . import rules as _rules  # noqa: F401  (registers on import)
+    from .core import RULES
+
+    failures: list[str] = [
+        f"{name}: registered rule has no self-test fixture pair — "
+        "add one to SELF_TESTS"
+        for name in sorted(set(RULES) - set(SELF_TESTS))]
+    for rule, (path, bad, good) in sorted(SELF_TESTS.items()):
+        got_bad = [f for f in analyze_source(
+            textwrap.dedent(bad), path, [rule]) if f.rule == rule]
+        if not got_bad:
+            failures.append(
+                f"{rule}: known-bad fixture no longer flagged — the "
+                "rule went dead")
+        got_good = [f for f in analyze_source(
+            textwrap.dedent(good), path, [rule]) if f.rule == rule]
+        if got_good:
+            failures.append(
+                f"{rule}: known-good fixture now flagged — the rule "
+                f"over-triggers: {got_good[0]}")
+    return failures

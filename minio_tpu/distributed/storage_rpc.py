@@ -361,6 +361,18 @@ class _RemoteWriter(io.RawIOBase):
             finally:
                 self.session.close()
 
+    def abort(self) -> None:
+        """Release without flushing: the exception path must not commit
+        buffered partial bytes — and must not pay the flush RPC later
+        at GC time on whatever thread collects the writer."""
+        if not self.closed_:
+            self.closed_ = True
+            self.buf.clear()
+            try:
+                self.session.close()
+            except Exception:
+                pass
+
 
 class RemoteStorage(StorageAPI):
     """StorageAPI client for one drive on a peer node."""
@@ -438,11 +450,17 @@ class RemoteStorage(StorageAPI):
     def create_file(self, volume: str, path: str, size: int,
                     reader: BinaryIO) -> None:
         w = self.open_file_writer(volume, path)
-        while True:
-            chunk = reader.read(_CHUNK)
-            if not chunk:
-                break
-            w.write(chunk)
+        try:
+            while True:
+                chunk = reader.read(_CHUNK)
+                if not chunk:
+                    break
+                w.write(chunk)
+        except BaseException:
+            # a reader/transport failure mid-stream must not leak the
+            # RPC session (or flush partial bytes at GC time)
+            w.abort()
+            raise
         w.close()
 
     def open_file_writer(self, volume: str, path: str,

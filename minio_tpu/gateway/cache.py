@@ -121,11 +121,15 @@ class CacheLayer:
         if ent is not None and ent.etag == oi.etag:
             try:
                 stream = self._read_cached(key, offset, length)
-                self.hits += 1
+                # concurrent GETs race the bare += (read-modify-write
+                # loses updates); counters ride the entry-table lock
+                with self._mu:
+                    self.hits += 1
                 return oi, stream
             except OSError:
                 self._evict_one(key)
-        self.misses += 1
+        with self._mu:
+            self.misses += 1
         if offset == 0 and length < 0:
             # full-object miss: tee the backend stream into the cache
             _, stream = self.inner.get_object(bucket, obj, 0, -1)

@@ -593,6 +593,7 @@ def _run_put_data(msg, rings: "_RingCache", drives: dict) -> dict:
     from minio_tpu.erasure import bitrot, stagestats
     from minio_tpu.storage import local as local_mod
 
+    # lint: allow(shared-state): per-process by design — the worker child installs the FRONT's fsync mode for its own drives; the front's copy is the source of truth
     local_mod.FSYNC_ENABLED = bool(msg.get("fsync", True))
     k, m, bs = msg["k"], msg["m"], msg["bs"]
     n = k + m

@@ -154,8 +154,11 @@ class ServiceManager:
                     n += 1
         except Exception:
             pass
-        self.drive_resyncs += 1
-        self.resync_objects += n
+        # several drives can reconnect at once (one probe thread each);
+        # the bare += is a read-modify-write that loses counts
+        with self._resync_mu:
+            self.drive_resyncs += 1
+            self.resync_objects += n
 
     def close(self) -> None:
         self._closing.set()

@@ -132,10 +132,34 @@ class ReplicationStats:
 
     def target(self, arn: str) -> TargetStats:
         with self._lock:
-            ts = self.per_target.get(arn)
-            if ts is None:
-                ts = self.per_target[arn] = TargetStats()
-            return ts
+            return self._target_locked(arn)
+
+    def _target_locked(self, arn: str) -> TargetStats:
+        ts = self.per_target.get(arn)
+        if ts is None:
+            ts = self.per_target[arn] = TargetStats()
+        return ts
+
+    def inc(self, **deltas) -> None:
+        """Counter bumps under the stats lock.  Two replication
+        workers, API-thread enqueues and read-proxy paths all bump
+        these; the bare `+=` they used to run is a read-modify-write
+        that loses updates under contention (lockset-detector finding,
+        pinned by tests/test_racecheck.py)."""
+        with self._lock:
+            for name, n in deltas.items():
+                setattr(self, name, getattr(self, name) + n)
+
+    def inc_target(self, arn: str, last_failure: float | None = None,
+                   **deltas) -> None:
+        """Per-target bumps, same lock: target rows are shared by the
+        same worker/API/proxy threads as the global counters."""
+        with self._lock:
+            ts = self._target_locked(arn)
+            for name, n in deltas.items():
+                setattr(ts, name, getattr(ts, name) + n)
+            if last_failure is not None:
+                ts.last_failure = last_failure
 
     def targets_snapshot(self) -> dict:
         with self._lock:
@@ -184,7 +208,7 @@ class ReplicationPool:
 
     # -- enqueue ------------------------------------------------------------
     def enqueue(self, op: ReplicationOp) -> None:
-        self.stats.queued += 1
+        self.stats.inc(queued=1)
         self._q.put(op)
 
     def replicate_object(self, bucket: str, name: str,
@@ -237,17 +261,17 @@ class ReplicationPool:
                 try:
                     _, tgt = self._rule_and_target(op)
                     if tgt is not None:
-                        ts = self.stats.target(tgt.arn)
-                        ts.last_failure = time.time()
-                        if op.attempts >= MAX_ATTEMPTS:
-                            ts.failed += 1
+                        self.stats.inc_target(
+                            tgt.arn, last_failure=time.time(),
+                            **({"failed": 1}
+                               if op.attempts >= MAX_ATTEMPTS else {}))
                 except Exception:
                     pass
                 if op.attempts < MAX_ATTEMPTS:
                     op.not_before = time.time() + 0.5 * (2 ** op.attempts)
                     self._q.put(op)
                 else:
-                    self.stats.failed += 1
+                    self.stats.inc(failed=1)
                     if not op.delete:
                         self._set_status(op, FAILED)
 
@@ -284,8 +308,8 @@ class ReplicationPool:
             except S3ClientError as e:
                 if e.status != 404:
                     raise
-            self.stats.deletes += 1
-            self.stats.target(tgt.arn).deletes += 1
+            self.stats.inc(deletes=1)
+            self.stats.inc_target(tgt.arn, deletes=1)
             return
 
         oi, stream = self.api.get_object(op.bucket, op.name,
@@ -322,11 +346,9 @@ class ReplicationPool:
         finally:
             if hasattr(stream, "close"):
                 stream.close()
-        self.stats.completed += 1
-        self.stats.bytes_replicated += size
-        ts = self.stats.target(tgt.arn)
-        ts.completed += 1
-        ts.bytes_replicated += size
+        self.stats.inc(completed=1, bytes_replicated=size)
+        self.stats.inc_target(tgt.arn, completed=1,
+                              bytes_replicated=size)
         self._set_status(op, COMPLETED)
 
     def _set_status(self, op: ReplicationOp, status: str) -> None:
@@ -377,8 +399,8 @@ def proxy_get(meta, bucket: str, key: str, range_header: str = "",
                     tgt.bucket, key, headers=fwd or None, ok=ok,
                     with_headers=True)
             if stats is not None:
-                stats.proxied += 1
-                stats.target(tgt.arn).proxied += 1
+                stats.inc(proxied=1)
+                stats.inc_target(tgt.arn, proxied=1)
             return tgt, rh, chunks
         except S3ClientError as e:
             # 404 = the object simply is not on this target; anything

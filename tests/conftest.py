@@ -26,6 +26,22 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# ------------------------------------------------------------ racecheck
+# MINIO_TPU_RACECHECK=1 replays the whole run under the lockset race
+# detector (minio_tpu/analysis/concurrency/racecheck.py): threading
+# primitives created from here on are tracked and the designated
+# shared-state surface (hotcache/brownout/MRF/replication/gateway-
+# cache/drive-health counters) is watched.  Findings print at session
+# end; MINIO_TPU_RACECHECK_STRICT=1 turns them into a session failure.
+# The wiring must precede minio_tpu imports so product locks are the
+# tracked kind.
+
+if os.environ.get("MINIO_TPU_RACECHECK", "") == "1":
+    from minio_tpu.analysis.concurrency import racecheck as _rc
+
+    _rc.install()
+    _rc.install_default_watches()
+
 
 def _rebuild_native_lib() -> None:
     """Rebuild csrc/libminio_tpu_host.so when sources are newer than
@@ -239,6 +255,94 @@ def _run_serial_isolated(item) -> None:
         f"serial-isolated run of {item.nodeid} failed"
         + (" twice" if len(tails) > 1 else "") + ":\n"
         + "\n\nretry:\n".join(tails))
+
+
+# ------------------------------------------------------- fd leak check
+# ISSUE 10 satellite: the shm/process sweep below catches leaked
+# segments and workers; this catches leaked FILE DESCRIPTORS — the
+# resource-lifecycle rule's dynamic counterpart.  Only fds opened onto
+# regular files outside the interpreter/runtime are counted (pipes,
+# sockets, eventfds and the interpreter's own files churn legitimately
+# run to run); deleted-but-open staging files count too, they pin disk.
+
+def _fd_table() -> dict[int, str]:
+    out: dict[int, str] = {}
+    try:
+        for fd in os.listdir("/proc/self/fd"):
+            try:
+                out[int(fd)] = os.readlink(f"/proc/self/fd/{fd}")
+            except (OSError, ValueError):
+                pass
+    except OSError:
+        pass  # non-Linux: the check is a no-op
+    return out
+
+
+_FD_ALLOW_PREFIXES = tuple(p for p in (
+    sys.prefix, getattr(sys, "base_prefix", ""),
+    "/usr", "/proc", "/dev", "/sys",
+    os.path.expanduser("~/.cache"),
+) if p)
+
+
+def _fd_is_leak(target: str) -> bool:
+    deleted = target.endswith(" (deleted)")
+    name = target[:-len(" (deleted)")] if deleted else target
+    if not name.startswith("/"):
+        return False  # pipe:[..], socket:[..], anon_inode:[..]
+    if any(name.startswith(p) for p in _FD_ALLOW_PREFIXES):
+        return False
+    if deleted:
+        return True  # open fd pinning an unlinked staging file
+    return os.path.isfile(name)  # dirs / ptys are not data leaks
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _fd_leak_check():
+    before = _fd_table()
+    yield
+    import gc
+
+    leaked: dict[int, str] = {}
+    for _ in range(10):  # let closers/GC finish before judging
+        gc.collect()
+        # compare fd -> TARGET, not bare numbers: POSIX hands out the
+        # lowest free fd, so a leak can land on a number the snapshot
+        # already held (pointing somewhere else entirely)
+        leaked = {fd: t for fd, t in _fd_table().items()
+                  if before.get(fd) != t and _fd_is_leak(t)}
+        if not leaked:
+            return
+        time.sleep(0.2)
+    raise AssertionError(
+        f"leaked file descriptors onto regular files: {leaked} — some "
+        "test (or product close path) dropped an fd; see the "
+        "resource-lifecycle rule for the usual shapes")
+
+
+# ----------------------------------------------------- racecheck report
+@pytest.fixture(scope="session", autouse=True)
+def _racecheck_report():
+    yield
+    if os.environ.get("MINIO_TPU_RACECHECK", "") != "1":
+        return
+    from minio_tpu.analysis.concurrency import racecheck as _rc
+
+    findings = _rc.TRACKER.findings()
+    waived = _rc.TRACKER.waived()
+    if waived:
+        sys.stderr.write("\n[racecheck] waived locations:\n" + "".join(
+            f"  {k}: {v}\n" for k, v in sorted(waived.items())))
+    if findings:
+        text = "\n".join(f"  {f!r}" for f in findings)
+        sys.stderr.write(f"\n[racecheck] UNWAIVED FINDINGS:\n{text}\n")
+        if os.environ.get("MINIO_TPU_RACECHECK_STRICT", "") == "1":
+            raise AssertionError(
+                f"racecheck: {len(findings)} unwaived lockset "
+                f"finding(s):\n{text}")
+    else:
+        sys.stderr.write("\n[racecheck] clean: no unwaived lockset "
+                         "findings\n")
 
 
 # ------------------------------------------------------- shm leak check

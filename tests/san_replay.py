@@ -228,6 +228,58 @@ def mode_repair() -> None:
     sys.exit(1 if failures else 0)
 
 
+def _tsan_report_paths() -> list:
+    """TSan log files for THIS run, when TSAN_OPTIONS carries a
+    log_path (reports go there instead of stderr)."""
+    import glob
+
+    for part in os.environ.get("TSAN_OPTIONS", "").replace(
+            ",", ":").split(":"):
+        if part.startswith("log_path="):
+            base = part.split("=", 1)[1]
+            return sorted(glob.glob(base + ".*"))
+    return []
+
+
+#: substrings attributing a sanitizer report block to OUR frames
+_OUR_FRAMES = ("select_scan", "gf256_simd", "highwayhash",
+               "minio_tpu_host")
+
+
+def _check_tsan_reports() -> int:
+    """Exit-code contribution for TSan runs: nonzero when any report
+    block names our library/source.  CPython-internal reports are
+    handled by csrc/tsan.supp (instrumented-CPython runs) or by the
+    attribution here (plain runs) — either way a report in OUR frames
+    is fatal, never noise.  Self-attribution needs TSAN_OPTIONS to
+    carry log_path (reports on stderr are invisible to this process);
+    without it, say so loudly — the caller must scan stderr itself
+    (tests/test_sanitizers.py does both)."""
+    if "log_path=" not in os.environ.get("TSAN_OPTIONS", ""):
+        if "tsan" in os.environ.get("LD_PRELOAD", "") \
+                or os.environ.get("MINIO_TPU_SAN", "") == "tsan":
+            print("san_replay: no log_path in TSAN_OPTIONS — "
+                  "self-attribution INACTIVE, reports go to stderr; "
+                  "the caller must attribute them", file=sys.stderr)
+        return 0
+    ours = []
+    for path in _tsan_report_paths():
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                text = f.read()
+        except OSError:
+            continue
+        for block in text.split("WARNING: ThreadSanitizer")[1:]:
+            if any(m in block for m in _OUR_FRAMES):
+                ours.append(block[:2500])
+    if ours:
+        print("san_replay: ThreadSanitizer report attributed to our "
+              f"frames ({len(ours)} block(s)):\n" + ours[0],
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def mode_scanpool() -> None:
     import threading
 
@@ -265,8 +317,10 @@ def mode_scanpool() -> None:
     if errs or len(results) != 6:
         print(f"san_replay scanpool: failures {errs}", file=sys.stderr)
         sys.exit(1)
-    print("san_replay scanpool: 6 threads x 3 scans ok")
-    sys.exit(0)
+    rc = _check_tsan_reports()
+    print(f"san_replay scanpool: 6 threads x 3 scans ok"
+          + ("" if rc == 0 else " — but TSan reported in our frames"))
+    sys.exit(rc)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,170 @@
+"""Tier-1 gate for the protocol model checker (ISSUE 10 tentpole).
+
+Four jobs:
+1. Engine unit tests: BFS exploration, invariant/terminal/deadlock
+   detection, shortest-counterexample traces, bounds.
+2. The three load-bearing protocol models stay REGISTERED (a model
+   silently dropping out of the gate would un-spec its protocol) and
+   their source stays pragma-free (a model is a spec; suppressions in
+   a spec are spec bugs).
+3. Unmutated models explore their bounded state space with ZERO
+   violations inside the tier-1 time budget.
+4. The mutation matrix: every seeded protocol mutation of every model
+   yields a reported counterexample trace — each invariant is proven
+   LIVE, not decoration.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from minio_tpu.analysis.concurrency import (MODELS, Model, check,
+                                            verify_mutations)
+from minio_tpu.analysis.concurrency import models as _models  # noqa: F401
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: the protocols PR 8's correctness rests on; ROADMAP records this
+#: inventory and future protocol PRs extend it
+LOAD_BEARING = ("arena-ring", "hotcache", "breaker-mrf")
+
+
+# ------------------------------------------------------------- engine
+class TestEngine:
+    def _counter_model(self, limit: int = 3) -> Model:
+        m = Model("counter", {"n": 0, "m": 0})
+        m.action("inc", lambda s: s["n"] < limit)(
+            lambda s: s.update(n=s["n"] + 1))
+        m.action("mirror", lambda s: s["m"] < s["n"])(
+            lambda s: s.update(m=s["m"] + 1))
+        return m
+
+    def test_explores_all_states(self):
+        m = self._counter_model()
+        res = check(m)
+        assert res.ok and not res.truncated
+        # reachable (n, m) pairs with m <= n <= 3
+        assert res.states == sum(n + 1 for n in range(4))
+
+    def test_invariant_violation_has_shortest_trace(self):
+        m = self._counter_model()
+        m.invariant("n-small")(lambda s: s["n"] < 2)
+        res = check(m)
+        assert not res.ok
+        assert res.violation.kind == "invariant"
+        assert res.violation.trace == ["inc", "inc"]
+        assert res.violation.state["n"] == 2
+
+    def test_terminal_invariant_checked_at_quiescence_only(self):
+        m = self._counter_model()
+        m.terminal("converged")(lambda s: s["m"] == s["n"] == 3)
+        assert check(m).ok  # holds at the single quiescent state
+        m2 = self._counter_model()
+        m2.terminal("impossible")(lambda s: s["m"] != s["n"])
+        res = check(m2)
+        assert not res.ok and res.violation.kind == "terminal"
+
+    def test_deadlock_detection(self):
+        m = Model("wedge", {"stuck": False})
+        m.action("wedge", lambda s: not s["stuck"])(
+            lambda s: s.update(stuck=True))
+        m.done = lambda s: not s["stuck"]
+        res = check(m)
+        assert not res.ok and res.violation.kind == "deadlock"
+        assert res.violation.trace == ["wedge"]
+
+    def test_state_bound_reports_truncation(self):
+        m = Model("big", {"n": 0})
+        m.action("inc", lambda s: s["n"] < 10_000)(
+            lambda s: s.update(n=s["n"] + 1))
+        res = check(m, max_states=50)
+        assert res.ok and res.truncated
+
+    def test_mutated_copy_does_not_touch_base(self):
+        m = self._counter_model()
+        m.invariant("bounded")(lambda s: s["n"] <= 3)
+        m.mutation("unbound", "drop the guard")(
+            lambda mm: mm.replace_action(
+                "inc", guard=lambda s: s["n"] < 6))
+        assert not check(m.mutated("unbound")).ok
+        assert check(m).ok  # base model unchanged
+
+
+# ----------------------------------------------------------- the gate
+class TestRegistry:
+    def test_load_bearing_models_registered(self):
+        assert set(LOAD_BEARING) <= set(MODELS), (
+            "a protocol model left the registry — the protocol lost "
+            f"its executable spec: {sorted(MODELS)}")
+
+    def test_model_sources_pragma_free(self):
+        d = os.path.join(REPO, "minio_tpu", "analysis", "concurrency",
+                         "models")
+        for f in sorted(os.listdir(d)):
+            if f.endswith(".py"):
+                with open(os.path.join(d, f), encoding="utf-8") as fh:
+                    assert "# lint: allow" not in fh.read(), (
+                        f"pragma crept into protocol model {f} — a "
+                        "spec with suppressions is a spec bug")
+
+    def test_every_model_has_mutations_and_invariants(self):
+        for name in LOAD_BEARING:
+            m = MODELS[name]()
+            assert m.invariants or m.terminal_invariants, name
+            assert len(m.mutations) >= 3, (
+                f"{name}: fewer than 3 seeded mutations — the "
+                "liveness proof thinned out")
+
+
+# --------------------------------------------- fast bounded exploration
+@pytest.mark.parametrize("name", LOAD_BEARING)
+def test_unmutated_model_explores_clean(name):
+    res = check(MODELS[name](), max_states=200_000)
+    assert res.ok, f"{name}: {res}"
+    assert not res.truncated, (
+        f"{name}: fast config no longer fits the bounds — shrink the "
+        "fast parameters, the tier-1 budget is real")
+    assert res.states > 10  # a trivially-empty model proves nothing
+
+
+# ----------------------------------------------------- mutation matrix
+def _matrix():
+    for name in LOAD_BEARING:
+        for mut in MODELS[name]().mutations:
+            yield name, mut
+
+
+@pytest.mark.parametrize("name,mut", list(_matrix()))
+def test_seeded_mutation_caught(name, mut):
+    """Each seeded protocol bug must produce a counterexample trace —
+    the proof that the invariant supposedly guarding it is live."""
+    res = check(MODELS[name]().mutated(mut), max_states=200_000)
+    assert not res.ok, (
+        f"{name}+{mut}: the checker explored clean — the invariant "
+        "this mutation targets is decoration")
+    assert res.violation.trace, "counterexample must carry a trace"
+    assert res.violation.kind in ("invariant", "terminal", "deadlock")
+
+
+@pytest.mark.parametrize("name", LOAD_BEARING)
+def test_verify_mutations_helper(name):
+    out = verify_mutations(MODELS[name])
+    assert out and all(not r.ok for r in out.values()), (
+        f"{name}: verify_mutations missed "
+        f"{[k for k, r in out.items() if r.ok]}")
+
+
+# ------------------------------------------------------------ deep sweep
+@pytest.mark.slow
+@pytest.mark.parametrize("name", LOAD_BEARING)
+def test_deep_sweep(name):
+    """The slow-marked deeper configuration: bigger rings, more
+    writes/readers, two kill/break cycles."""
+    res = check(MODELS[name](deep=True), max_states=2_000_000)
+    assert res.ok and not res.truncated, f"{name}: {res}"
+    muts = verify_mutations(lambda: MODELS[name](deep=True),
+                            max_states=2_000_000)
+    missed = [k for k, r in muts.items() if r.ok]
+    assert not missed, f"{name} deep: mutations not caught: {missed}"
