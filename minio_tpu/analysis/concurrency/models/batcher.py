@@ -1,0 +1,310 @@
+"""Model: the erasure batcher's tick/submit/quiesce protocol
+(erasure/batcher.py, ISSUE 11) — written BEFORE the implementation,
+per the PR 10 convention (protocol work lands with a model change
+first).
+
+Submitters (PUT/GET/heal request threads) enqueue (signature, batch)
+work items and wait on a per-item future.  A single tick thread
+repeatedly COLLECTS the queued items of one geometry signature into a
+tick bucket, DISPATCHES the bucket as one fused device program, and
+resolves every item in it.  Shutdown (quiesce) stops new submissions
+and drains the queue; a tick-thread death fails every queued item
+retryable so callers fall back to the per-request dispatch plane.
+
+The model abstracts the payload to its signature: two submitters with
+per-submitter signature schedules (so same-sig coalescing AND
+mixed-geometry ticks are both reachable), a three-step tick
+(collect / dispatch-ok / dispatch-fail), close, and one crash.
+
+Invariants:
+
+* ``no-double-dispatch``    — no item is resolved by more than one
+                              device dispatch (collect must REMOVE
+                              items from the queue).
+* ``single-signature-tick`` — a tick bucket never mixes geometry
+                              signatures (padding across geometries
+                              would corrupt every item in the batch);
+                              mixed-geometry queues take per-geometry
+                              sub-dispatches instead.
+* ``no-item-dropped``       — terminal: when the system quiesces,
+                              every submitted item is resolved or
+                              failed-retryable — never silently stuck
+                              queued/collected (shutdown drains or
+                              fails-retryable everything; crash fails
+                              everything queued).
+
+Deadlock freedom: a quiescent state must satisfy ``done`` (no item
+left in a non-terminal state) — a wedged drain (close that can never
+finish) would surface here.
+
+Every invariant is proven live by a seeded mutation (tier-1 pins the
+matrix in tests/test_modelcheck.py): drop-on-collect,
+dispatch-leaves-queued, pad-across-signatures, shutdown-drops-queue,
+crash-loses-queue, crash-loses-bucket — the last one reproduces a hole
+the first implementation draft actually had (death handler failed the
+queue but not the collected in-flight bucket).
+"""
+
+from __future__ import annotations
+
+from ..modelcheck import Model, register
+
+#: item states
+QUEUED, COLLECTED, RESOLVED, FAILED = "queued", "collected", "resolved", \
+    "failed"
+
+
+def build(deep: bool = False) -> Model:
+    # per-submitter signature schedules: submitter 0 enqueues two items
+    # of one geometry (coalescing reachable), submitter 1 mixes a second
+    # geometry in (per-geometry sub-dispatch reachable)
+    schedules = (["g1", "g1"], ["g2", "g1"])
+    if deep:
+        schedules = (["g1", "g1", "g2"], ["g2", "g1", "g2"])
+
+    init = {
+        "phase": "run",        # run | closing | stopped | dead
+        "queue": [],           # item ids in FIFO order
+        "bucket": [],          # ids collected for the in-flight tick
+        "bucket_sig": "",      # signature the bucket was collected for
+        "mixed_tick": False,   # set if a collect ever mixed signatures
+        # items: id -> [sig, state, dispatch_count]
+        "items": {},
+        "next_id": 0,
+        # submitters: remaining signature schedule per submitter
+        "subs": [list(s) for s in schedules],
+        "crashes_left": 1,
+    }
+    m = Model("batcher", init,
+              "erasure batcher tick/submit/quiesce protocol")
+
+    def mint(s, sig: str, state: str) -> None:
+        s["next_id"] += 1
+        s["items"][str(s["next_id"])] = [sig, state, 0]
+
+    # -- submitters ---------------------------------------------------------
+    for r in range(len(schedules)):
+        def can_submit(s, r=r) -> bool:
+            return s["phase"] == "run" and bool(s["subs"][r])
+
+        def do_submit(s, r=r) -> None:
+            sig = s["subs"][r].pop(0)
+            mint(s, sig, QUEUED)
+            s["queue"].append(str(s["next_id"]))
+
+        m.action(f"s{r}_submit", can_submit)(do_submit)
+
+        # a submit against a closing/stopped/dead batcher is rejected at
+        # the door: the caller immediately falls back to the per-request
+        # plane (modelled as failed-retryable)
+        def can_reject(s, r=r) -> bool:
+            return s["phase"] != "run" and bool(s["subs"][r])
+
+        def do_reject(s, r=r) -> None:
+            sig = s["subs"][r].pop(0)
+            mint(s, sig, FAILED)
+
+        m.action(f"s{r}_submit_rejected", can_reject)(do_reject)
+
+    # -- tick thread --------------------------------------------------------
+    def can_collect(s) -> bool:
+        return (s["phase"] in ("run", "closing") and bool(s["queue"])
+                and not s["bucket"])
+
+    def do_collect(s) -> None:
+        # one tick serves ONE geometry signature: take every queued item
+        # of the head item's signature, leave the rest queued (they get
+        # their own per-geometry sub-dispatch)
+        sig = s["items"][s["queue"][0]][0]
+        taken = [i for i in s["queue"] if s["items"][i][0] == sig]
+        s["queue"] = [i for i in s["queue"] if s["items"][i][0] != sig]
+        for i in taken:
+            s["items"][i][1] = COLLECTED
+        s["bucket"] = taken
+        s["bucket_sig"] = sig
+        if len({s["items"][i][0] for i in taken}) > 1:
+            s["mixed_tick"] = True
+
+    m.action("t_collect", can_collect)(do_collect)
+
+    def do_dispatch_ok(s) -> None:
+        for i in s["bucket"]:
+            s["items"][i][1] = RESOLVED
+            s["items"][i][2] += 1
+        s["bucket"] = []
+        s["bucket_sig"] = ""
+
+    m.action("t_dispatch_ok", lambda s: bool(s["bucket"]))(do_dispatch_ok)
+
+    def do_dispatch_fail(s) -> None:
+        # the fused program raised (device error): every item in the
+        # bucket fails retryable and the caller re-dispatches inline
+        for i in s["bucket"]:
+            s["items"][i][1] = FAILED
+        s["bucket"] = []
+        s["bucket_sig"] = ""
+
+    m.action("t_dispatch_fail", lambda s: bool(s["bucket"]))(do_dispatch_fail)
+
+    # -- quiesce ------------------------------------------------------------
+    def do_close_begin(s) -> None:
+        s["phase"] = "closing"
+
+    m.action("close_begin", lambda s: s["phase"] == "run")(do_close_begin)
+
+    def can_close_done(s) -> bool:
+        return s["phase"] == "closing" and not s["queue"] \
+            and not s["bucket"]
+
+    def do_close_done(s) -> None:
+        s["phase"] = "stopped"
+
+    m.action("close_done", can_close_done)(do_close_done)
+
+    # -- tick-thread death --------------------------------------------------
+    def can_crash(s) -> bool:
+        return s["phase"] == "run" and s["crashes_left"] > 0
+
+    def do_crash(s) -> None:
+        # the death handler must fail BOTH the still-queued items and
+        # the collected-but-unresolved bucket (the implementation's
+        # `_inflight` list): a fault between collect and resolve must
+        # not strand the bucket's submitters
+        s["crashes_left"] -= 1
+        s["phase"] = "dead"
+        for i in s["queue"] + s["bucket"]:
+            s["items"][i][1] = FAILED
+        s["queue"] = []
+        s["bucket"] = []
+        s["bucket_sig"] = ""
+
+    m.action("t_crash", can_crash)(do_crash)
+
+    # -- invariants ---------------------------------------------------------
+    @m.invariant("no-double-dispatch")
+    def no_double_dispatch(s) -> bool:
+        return all(it[2] <= 1 for it in s["items"].values())
+
+    @m.invariant("single-signature-tick")
+    def single_signature_tick(s) -> bool:
+        return not s["mixed_tick"]
+
+    @m.terminal("no-item-dropped")
+    def no_item_dropped(s) -> bool:
+        """Quiescence: every item ever submitted ended resolved or
+        failed-retryable — shutdown drained or failed everything, crash
+        failed everything, nothing is silently stuck."""
+        return all(it[1] in (RESOLVED, FAILED)
+                   for it in s["items"].values())
+
+    # quiescent non-terminal items are also a WEDGE (a close that can
+    # never drain); the terminal invariant above reports it with the
+    # offending item states either way
+    m.done = lambda s: all(it[1] in (RESOLVED, FAILED)
+                           for it in s["items"].values())
+
+    # -- seeded mutations ----------------------------------------------------
+    @m.mutation("drop-on-collect",
+                "the tick collect loses one queued item of the chosen "
+                "signature (removed from the queue, never added to the "
+                "bucket) — its submitter waits forever")
+    def drop_on_collect(mut: Model) -> None:
+        def do_collect_lossy(s) -> None:
+            sig = s["items"][s["queue"][0]][0]
+            taken = [i for i in s["queue"] if s["items"][i][0] == sig]
+            s["queue"] = [i for i in s["queue"] if s["items"][i][0] != sig]
+            taken.pop(0)  # the dropped item: stays COLLECTED nowhere
+            for i in taken:
+                s["items"][i][1] = COLLECTED
+            s["bucket"] = taken
+            s["bucket_sig"] = sig
+
+        mut.replace_action("t_collect", effect=do_collect_lossy)
+
+    @m.mutation("dispatch-leaves-queued",
+                "collect COPIES items into the bucket without removing "
+                "them from the queue — the next tick re-collects and "
+                "re-dispatches the same items")
+    def dispatch_leaves_queued(mut: Model) -> None:
+        def do_collect_copy(s) -> None:
+            sig = s["items"][s["queue"][0]][0]
+            taken = [i for i in s["queue"] if s["items"][i][0] == sig]
+            for i in taken:
+                s["items"][i][1] = COLLECTED
+            s["bucket"] = taken
+            s["bucket_sig"] = sig
+
+        mut.replace_action("t_collect", effect=do_collect_copy)
+
+    @m.mutation("pad-across-signatures",
+                "the tick pads/concatenates the WHOLE queue regardless "
+                "of geometry signature — every item in the mixed batch "
+                "is corrupted")
+    def pad_across_signatures(mut: Model) -> None:
+        def do_collect_all(s) -> None:
+            taken = list(s["queue"])
+            s["queue"] = []
+            for i in taken:
+                s["items"][i][1] = COLLECTED
+            if len({s["items"][i][0] for i in taken}) > 1:
+                s["mixed_tick"] = True
+            s["bucket"] = taken
+            s["bucket_sig"] = s["items"][taken[0]][0]
+
+        mut.replace_action("t_collect", effect=do_collect_all)
+
+    @m.mutation("shutdown-drops-queue",
+                "close discards still-queued items instead of draining "
+                "or failing them retryable — their submitters hang")
+    def shutdown_drops_queue(mut: Model) -> None:
+        def do_close_drop(s) -> None:
+            s["queue"] = []  # items stay QUEUED in `items`: dropped
+            s["phase"] = "stopped"
+
+        mut.replace_action(
+            "close_done",
+            guard=lambda s: s["phase"] == "closing" and not s["bucket"],
+            effect=do_close_drop)
+
+    @m.mutation("crash-loses-queue",
+                "the tick-thread death handler forgets to fail the "
+                "queued items retryable — submitters wait forever on a "
+                "dead batcher")
+    def crash_loses_queue(mut: Model) -> None:
+        def do_crash_silent(s) -> None:
+            s["crashes_left"] -= 1
+            s["phase"] = "dead"
+            s["queue"] = []  # items stay QUEUED in `items`
+            for i in s["bucket"]:
+                s["items"][i][1] = FAILED
+            s["bucket"] = []
+            s["bucket_sig"] = ""
+
+        mut.replace_action("t_crash", effect=do_crash_silent)
+
+    @m.mutation("crash-loses-bucket",
+                "the death handler fails the queue but forgets the "
+                "collected in-flight bucket (`_inflight`) — a fault "
+                "between collect and resolve strands the bucket's "
+                "submitters (the hole the first implementation draft "
+                "actually had)")
+    def crash_loses_bucket(mut: Model) -> None:
+        def do_crash_queue_only(s) -> None:
+            s["crashes_left"] -= 1
+            s["phase"] = "dead"
+            for i in s["queue"]:
+                s["items"][i][1] = FAILED
+            s["queue"] = []
+            # the dead thread's local bucket vanishes with it, but its
+            # items stay COLLECTED in `items` — stranded forever
+            s["bucket"] = []
+            s["bucket_sig"] = ""
+
+        mut.replace_action("t_crash", effect=do_crash_queue_only)
+
+    return m
+
+
+@register("batcher")
+def factory(deep: bool = False) -> Model:
+    return build(deep=deep)

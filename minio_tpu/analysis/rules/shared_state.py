@@ -46,10 +46,12 @@ WORKER_SURFACE = (
     "storage/errors.py",
     "storage/xlmeta.py",
     "erasure/coding.py",
+    "erasure/batcher.py",
     "erasure/bitrot.py",
     "erasure/stagestats.py",
     "ops/host.py",
     "ops/gf256.py",
+    "ops/residency.py",
     "utils/deadline.py",
     "utils/hashing.py",
 )

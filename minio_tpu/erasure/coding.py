@@ -34,6 +34,7 @@ import numpy as np
 from minio_tpu.ops import gf256, host
 from minio_tpu.storage import errors
 from minio_tpu.utils.deadline import ctx_submit
+from . import batcher as batcher_mod
 from . import stagestats
 
 BLOCK_SIZE_V2 = 1 << 20  # reference blockSizeV2, cmd/object-api-common.go:40
@@ -301,7 +302,8 @@ class Erasure:
     """EC geometry + codec dispatch for one (k, m, block_size)."""
 
     def __init__(self, data_blocks: int, parity_blocks: int,
-                 block_size: int = BLOCK_SIZE_V2, backend: str | None = None):
+                 block_size: int = BLOCK_SIZE_V2, backend: str | None = None,
+                 set_id: int = 0):
         if data_blocks <= 0 or parity_blocks < 0 or data_blocks + parity_blocks > 256:
             raise errors.InvalidArgument(
                 f"invalid erasure config {data_blocks}+{parity_blocks}"
@@ -312,6 +314,9 @@ class Erasure:
         self.backend = backend or os.environ.get(
             "MINIO_TPU_ERASURE_BACKEND", "auto"
         )
+        # erasure-set id of the caller: the request batcher lays tick
+        # batches out set-major so the mesh shards them by erasure set
+        self.set_id = set_id
         self._host = host.HostRSCodec(self.k, self.m)
         # observability: deepest device-pipeline occupancy reached by
         # encode_stream (>1 proves overlapped dispatches)
@@ -378,14 +383,66 @@ class Erasure:
             return None
         return _DeviceCodec.get(self.k, self.m)
 
-    def _encode_shards(self, batch: np.ndarray) -> np.ndarray:
-        """(B, K, S) -> (B, M, S) parity via the selected backend."""
+    # -- batched cross-request dispatch (erasure/batcher.py, ISSUE 11) ------
+    def _batcher(self):
+        """The process batcher, or None (gate off / zero parity)."""
+        if self.m == 0 or not batcher_mod.enabled():
+            return None
+        return batcher_mod.get()
+
+    def _sig(self, kind: str, shard_len: int, extra: tuple = ()) -> tuple:
+        """Geometry signature: items sharing one MUST be concatenable
+        into one fused program (same codec resolution, same matrix)."""
+        return (kind, self.k, self.m, self.backend, shard_len) + extra
+
+    def _via_batcher(self, kind: str, batch: np.ndarray, raw,
+                     extra: tuple = ()):
+        """Route one dispatch through the request batcher: returns
+        ``resolve() -> np.ndarray`` or None when not routed (gate off,
+        zero parity, batcher closing).  EVERY BatcherClosed — at
+        enqueue OR at resolve (fused dispatch failure, tick-thread
+        death, quiesce timeout) — falls back to the per-request `raw`
+        dispatch; the one definition of the fallback semantics shared
+        by encode, reconstruct and repair._dispatch."""
+        bt = self._batcher()
+        if bt is None:
+            return None
+        try:
+            resolve = bt.enqueue_async(
+                self._sig(kind, batch.shape[2], extra), batch, raw,
+                self.set_id)
+        except batcher_mod.BatcherClosed:
+            return None  # closing/closed: straight to the raw plane
+
+        def resolve_or_fallback():
+            # the arena slot backing `batch` stays pinned until this
+            # returns, so a fallback re-dispatch reads live bytes
+            try:
+                return resolve()
+            except batcher_mod.BatcherClosed:
+                return raw(batch)
+
+        return resolve_or_fallback
+
+    def _encode_shards_raw(self, batch: np.ndarray) -> np.ndarray:
+        """(B, K, S) -> (B, M, S) parity via the selected backend — the
+        actual dispatch; the batcher feeds MERGED cross-request batches
+        through here, so `_device` prices the fused size (small
+        per-request dispatches coalesce their way onto the device)."""
         b, k, s = batch.shape
         dev = self._device(batch.nbytes, s)
         _count(_backend_name(dev), batch.nbytes)
         if dev is not None:
             return np.asarray(dev.encode(batch))
         return self._host.encode(batch)
+
+    def _encode_shards(self, batch: np.ndarray) -> np.ndarray:
+        """(B, K, S) -> (B, M, S) parity, coalesced across concurrent
+        requests when the batcher gate is on (per-request otherwise)."""
+        routed = self._via_batcher("enc", batch, self._encode_shards_raw)
+        if routed is not None:
+            return routed()
+        return self._encode_shards_raw(batch)
 
     def _encode_shards_async(self, batch: np.ndarray, pool=None):
         """Non-blocking dispatch: returns resolve() -> (B, M, S) parity.
@@ -399,7 +456,17 @@ class Erasure:
         cmd/erasure-encode.go:73).  Host encodes run on `pool` when one
         is given (the AVX2 C call releases the GIL, so the encode
         overlaps the caller's next read); without a pool they compute
-        here and resolve immediately."""
+        here and resolve immediately.
+
+        With the request batcher gate on, the dispatch is handed to the
+        batcher instead: the tick thread fuses it with concurrent
+        requests' batches and the returned resolve() blocks on the
+        per-item future — the pipeline depth bookkeeping upstream is
+        unchanged, so the read of batch N+1 still overlaps the fused
+        dispatch of batch N."""
+        routed = self._via_batcher("enc", batch, self._encode_shards_raw)
+        if routed is not None:
+            return routed
         b, k, s = batch.shape
         dev = self._device(batch.nbytes, s)
         _count(_backend_name(dev), batch.nbytes)
@@ -450,14 +517,33 @@ class Erasure:
             out = self._host.encode(batch)
         return lambda: out
 
-    def _reconstruct_shards(self, batch: np.ndarray, available: tuple,
-                            wanted: tuple) -> np.ndarray:
+    def _reconstruct_shards_raw(self, batch: np.ndarray, available: tuple,
+                                wanted: tuple) -> np.ndarray:
         b, k, s = batch.shape
         dev = self._device(batch.nbytes, s)
         _count(_backend_name(dev), batch.nbytes)
         if dev is not None:
             return np.asarray(dev.reconstruct(batch, available, wanted))
         return self._host.reconstruct(batch, available, wanted)
+
+    def _reconstruct_shards(self, batch: np.ndarray, available: tuple,
+                            wanted: tuple) -> np.ndarray:
+        """Degraded-read/heal reconstruct, coalesced across concurrent
+        requests when the batcher gate is on.  The signature folds the
+        (available, wanted) matrix identity in, so one fused program
+        serves exactly one reconstruct matrix (matrix stays
+        device-resident via ops/residency.py)."""
+        available = tuple(available)
+        wanted = tuple(wanted)
+
+        def dispatch(cat: np.ndarray) -> np.ndarray:
+            return self._reconstruct_shards_raw(cat, available, wanted)
+
+        routed = self._via_batcher("rec", batch, dispatch,
+                                   (available, wanted))
+        if routed is not None:
+            return routed()
+        return dispatch(batch)
 
     def decode_data_blocks(self, shards: list[np.ndarray | None]) -> list[np.ndarray]:
         """Rebuild missing data shards in a k+m shard list

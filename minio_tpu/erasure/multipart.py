@@ -202,7 +202,7 @@ class MultipartMixin:
         upload_algo = ufi.metadata.get("x-minio-internal-bitrot-algo",
                                        bitrot.DEFAULT_ALGO)
         e = Erasure(ufi.erasure.data_blocks, ufi.erasure.parity_blocks,
-                    ufi.erasure.block_size)
+                    ufi.erasure.block_size, set_id=self.set_index)
         n = e.k + e.m
         wq = e.k + 1 if e.k == e.m else e.k
         upath = _upload_path(bucket, obj, upload_id)
@@ -577,7 +577,7 @@ class MultipartMixin:
         final_etag = hashlib.md5(md5cat).hexdigest() + f"-{len(parts)}"
 
         e = Erasure(ufi.erasure.data_blocks, ufi.erasure.parity_blocks,
-                    ufi.erasure.block_size)
+                    ufi.erasure.block_size, set_id=self.set_index)
         n = e.k + e.m
         wq = e.k + 1 if e.k == e.m else e.k
         dist = ufi.erasure.distribution

@@ -574,7 +574,8 @@ class ErasureObjects:
                 f"{n - offline} online drives < write quorum {write_quorum}"
             )
 
-        erasure = Erasure(k, parity, BLOCK_SIZE_V2)
+        erasure = Erasure(k, parity, BLOCK_SIZE_V2,
+                          set_id=self.set_index)
         version_id = (
             opts.version_id or (new_version_id() if opts.versioned else "")
         )
@@ -1004,7 +1005,7 @@ class ErasureObjects:
         if length == 0 or fi.size == 0:
             return
         e = Erasure(fi.erasure.data_blocks, fi.erasure.parity_blocks,
-                    fi.erasure.block_size)
+                    fi.erasure.block_size, set_id=self.set_index)
         n = e.k + e.m
         # order drives by this object's distribution
         dist = fi.erasure.distribution
@@ -1542,7 +1543,7 @@ class ErasureObjects:
                         "missing" if fis[i] is None else "ok")
                 return result
             e = Erasure(fi.erasure.data_blocks, fi.erasure.parity_blocks,
-                        fi.erasure.block_size)
+                        fi.erasure.block_size, set_id=self.set_index)
             n = e.k + e.m
             dist = fi.erasure.distribution
             result = HealResult(object_size=fi.size)

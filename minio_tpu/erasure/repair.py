@@ -51,14 +51,13 @@ schemes and feeds ``repair_stats`` (surfaced as
 
 from __future__ import annotations
 
-import collections
 import os
 import threading
 from dataclasses import dataclass, field
 
 import numpy as np
 
-from minio_tpu.ops import gf256
+from minio_tpu.ops import gf256, residency
 from . import bitrot
 from . import coding as coding_mod
 
@@ -153,12 +152,6 @@ class SubshardAbort(Exception):
 # k x k inversion, and identical to gf256.reconstruct_matrix's rows
 # (pinned by tests/test_repair_diff.py and the sanitizer replay).
 
-_MAT_CACHE_CAP = 256
-_mat_cache: "collections.OrderedDict[tuple, np.ndarray]" = \
-    collections.OrderedDict()
-_mat_mu = threading.Lock()
-
-
 def _dual_coeffs(points: tuple[int, ...]) -> dict[int, int]:
     """Lagrange denominators u_i over the evaluation points alpha_i = i
     (GF(2^8) subtraction is XOR)."""
@@ -178,17 +171,14 @@ def repair_matrix(k: int, m: int, helpers: tuple[int, ...],
 
     ``helpers`` are exactly k distinct surviving shard indices sorted
     ascending; ``lost`` the shard indices to rebuild (data or parity,
-    disjoint from helpers).  LRU-cached per signature so steady-state
-    heals (one drive down -> one signature) never rebuild rows.
+    disjoint from helpers).  Rows live in the shared signature-keyed
+    matrix residency (ops/residency.py) — ONE LRU-bounded, hit/miss-
+    counted cache with the device codecs' encode/reconstruct matrices,
+    so steady-state heals (one drive down -> one signature) never
+    rebuild rows on any call path.
     """
     helpers = tuple(helpers)
     lost = tuple(lost)
-    key = (k, m, helpers, lost)
-    with _mat_mu:
-        mat = _mat_cache.get(key)
-        if mat is not None:
-            _mat_cache.move_to_end(key)
-            return mat
     if len(helpers) != k or len(set(helpers)) != k:
         raise ValueError(f"need exactly {k} distinct helpers")
     if set(helpers) & set(lost):
@@ -196,19 +186,19 @@ def repair_matrix(k: int, m: int, helpers: tuple[int, ...],
     n = k + m
     if any(not 0 <= i < n for i in helpers + lost):
         raise ValueError("shard index out of range")
-    mat = np.zeros((len(lost), k), dtype=np.uint8)
-    for t, j in enumerate(lost):
-        u = _dual_coeffs(helpers + (j,))
-        uj_inv = gf256.gf_inv(u[j])
-        for c, i in enumerate(helpers):
-            mat[t, c] = gf256.MUL_TABLE[u[i], uj_inv]
-    mat.setflags(write=False)
-    with _mat_mu:
-        _mat_cache[key] = mat
-        _mat_cache.move_to_end(key)
-        while len(_mat_cache) > _MAT_CACHE_CAP:
-            _mat_cache.popitem(last=False)
-    return mat
+
+    def build() -> np.ndarray:
+        mat = np.zeros((len(lost), k), dtype=np.uint8)
+        for t, j in enumerate(lost):
+            u = _dual_coeffs(helpers + (j,))
+            uj_inv = gf256.gf_inv(u[j])
+            for c, i in enumerate(helpers):
+                mat[t, c] = gf256.MUL_TABLE[u[i], uj_inv]
+        mat.setflags(write=False)
+        return mat
+
+    return residency.matrices.get(
+        ("repair-host", k, m, helpers, lost), build)
 
 
 # ------------------------------------------------------- residual scan
@@ -461,14 +451,12 @@ class CountingReader:
 # ------------------------------------------------------------- executor
 
 
-def _dispatch(e, src: np.ndarray, helpers: tuple[int, ...],
-              lost: tuple[int, ...]) -> np.ndarray:
+def _dispatch_raw(e, src: np.ndarray, helpers: tuple[int, ...],
+                  lost: tuple[int, ...]) -> np.ndarray:
     """(B, k, L) helper columns -> (B, len(lost), L) rebuilt rows via
     the configured codec backend: mesh/device codecs for large batches
-    (their reconstruct-matrix caches are already LRU-bounded), the
-    cached dual-codeword row matmul on host — no per-dispatch
-    Gauss-Jordan."""
-    src = np.ascontiguousarray(src, dtype=np.uint8)
+    (matrices device-resident via ops/residency.py), the cached
+    dual-codeword row matmul on host — no per-dispatch Gauss-Jordan."""
     blen = src.shape[2]
     dev = e._device(src.nbytes, blen)
     coding_mod._count(coding_mod._backend_name(dev), src.nbytes)
@@ -476,6 +464,26 @@ def _dispatch(e, src: np.ndarray, helpers: tuple[int, ...],
         return np.asarray(dev.reconstruct(src, helpers, lost))
     mat = repair_matrix(e.k, e.m, helpers, lost)
     return e._host.matmul(mat, src)
+
+
+def _dispatch(e, src: np.ndarray, helpers: tuple[int, ...],
+              lost: tuple[int, ...]) -> np.ndarray:
+    """Repair rebuild dispatch; with the request batcher gate on
+    (MINIO_TPU_BATCHER, erasure/batcher.py) concurrent heals' rebuilds
+    of one (helpers, lost) signature fuse into the same per-tick
+    program as PUT/GET codec work — the third submitter feeding the one
+    device pipeline (ISSUE 11)."""
+    src = np.ascontiguousarray(src, dtype=np.uint8)
+    helpers = tuple(helpers)
+    lost = tuple(lost)
+
+    def raw(cat: np.ndarray) -> np.ndarray:
+        return _dispatch_raw(e, cat, helpers, lost)
+
+    routed = e._via_batcher("repair", src, raw, (helpers, lost))
+    if routed is not None:
+        return routed()
+    return raw(src)
 
 
 def _runs_of(idxs: np.ndarray):

@@ -1,0 +1,88 @@
+"""Signature-keyed matrix residency: ONE cache for every coding matrix.
+
+Before ISSUE 11 the coding/heal matrices lived in four unrelated
+caches: `_DeviceCodec._mesh_cache` held mesh codecs keyed (k, m) but
+each codec grew its own `RecMatrixCache`, `PallasRSCodec` kept an
+unbounded per-instance `_rec_cache`, and `erasure/repair.py` kept its
+own module-level LRU of host dual-codeword rows.  Re-upload behavior
+(does a repeated reconstruct signature re-transfer its matrix to the
+device?) therefore depended on which call PATH reached the codec, and
+none of it was observable.
+
+This module is the one shared home: an LRU keyed by an arbitrary
+hashable *signature* — ("enc", k, m), ("rec", k, m, available,
+wanted), ("repair-host", k, m, helpers, lost), with the backend folded
+in by the caller — holding whatever array object the builder returns
+(a jax device array stays device-RESIDENT while cached: a hit never
+re-transfers).  Hit/miss/eviction counters feed
+``minio_erasure_matrix_residency_*`` in server/metrics.py.
+
+Entry count (not bytes) bounds the cache: coding matrices are tiny
+((R*8, K*8) int8 — ≤ ~2 MiB even at 16+8 across hundreds of
+signatures), it is the combinatorial signature churn of degraded reads
+that needs bounding.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+
+class MatrixResidency:
+    """Thread-safe signature-keyed LRU with a build-on-miss API.
+
+    ``get(sig, builder)`` returns the cached array or builds, caches
+    and returns it.  The builder runs OUTSIDE the lock (a device
+    transfer must not serialize unrelated lookups); two racing builders
+    for one signature both build, the first to insert wins and the
+    loser's array is dropped (coding matrices are pure functions of
+    their signature, so either result is correct).
+    """
+
+    def __init__(self, cap: int = 256):
+        self.cap = cap
+        self._od: collections.OrderedDict = collections.OrderedDict()
+        self._mu = threading.Lock()
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
+
+    def get(self, sig, builder):
+        with self._mu:
+            mat = self._od.get(sig)
+            if mat is not None:
+                self._od.move_to_end(sig)
+                self._hits += 1
+                return mat
+            self._misses += 1
+        mat = builder()
+        with self._mu:
+            cur = self._od.get(sig)
+            if cur is not None:  # lost a racing build: keep theirs
+                self._od.move_to_end(sig)
+                return cur
+            self._od[sig] = mat
+            while len(self._od) > self.cap:
+                self._od.popitem(last=False)
+                self._evictions += 1
+        return mat
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._od)
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                "hits": self._hits,
+                "misses": self._misses,
+                "evictions": self._evictions,
+                "entries": len(self._od),
+            }
+
+
+#: the process-wide residency every codec path shares.  Worker
+#: processes (parallel/workers.py) get their own copy per process —
+#: intentional: each process talks to its own device client.
+matrices = MatrixResidency()

@@ -27,7 +27,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from . import gf256, rs_tpu
+from . import gf256, residency, rs_tpu
 
 # Column-tile width in int32 words (bytes = 4 * _TILE_WORDS per shard row).
 # Tuning notes (measured on v5e): every per-dispatch measurement through
@@ -211,8 +211,12 @@ class PallasRSCodec:
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
         self._interpret = interpret
-        self._enc = jnp.asarray(_permute_mat(rs_tpu.encode_bits_matrix(k, m)))
-        self._rec_cache: dict[tuple, jax.Array] = {}
+        # encode/reconstruct matrices live in the shared signature-keyed
+        # residency (ops/residency.py): device arrays stay resident
+        # across instances and call paths, LRU-bounded, hit/miss counted
+        self._enc = residency.matrices.get(
+            ("pallas-enc", k, m),
+            lambda: jnp.asarray(_permute_mat(rs_tpu.encode_bits_matrix(k, m))))
 
     def _run(self, mat, shards) -> jax.Array:
         shards = jnp.asarray(shards, dtype=jnp.uint8)
@@ -258,13 +262,10 @@ class PallasRSCodec:
 
     def _rec_mat(self, available, wanted) -> jax.Array:
         sig = (tuple(available), tuple(wanted))
-        mat = self._rec_cache.get(sig)
-        if mat is None:
-            mat = jnp.asarray(
-                _permute_mat(rs_tpu.reconstruct_bits_matrix(self.k, self.m, *sig))
-            )
-            self._rec_cache[sig] = mat
-        return mat
+        return residency.matrices.get(
+            ("pallas-rec", self.k, self.m) + sig,
+            lambda: jnp.asarray(_permute_mat(
+                rs_tpu.reconstruct_bits_matrix(self.k, self.m, *sig))))
 
     def encode_blocks(self, data_shards) -> jax.Array:
         d = jnp.asarray(data_shards, dtype=jnp.uint8)

@@ -27,13 +27,12 @@ the MXU wants: a (R8, K8) x (K8, B*S) matmul with B*S in the millions.
 from __future__ import annotations
 
 import functools
-import threading
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import gf256
+from . import gf256, residency
 
 # ---------------------------------------------------------------------------
 # Host-side matrix preparation
@@ -60,38 +59,8 @@ def reconstruct_bits_matrix(
     return gf256.gf_matrix_to_bits(rm).astype(np.int8)
 
 
-class RecMatrixCache:
-    """LRU over per-signature device reconstruct matrices.
-
-    Availability signatures are combinatorial — one cached device array
-    per survivor set seen.  An LRU keeps steady-state hits (a drive stays
-    down -> one signature) while bounding churn (every read a different
-    survivor set) to `cap` entries."""
-
-    def __init__(self, cap: int = 128):
-        import collections
-
-        self.cap = cap
-        self._od = collections.OrderedDict()
-        self._mu = threading.Lock()
-
-    def get(self, sig):
-        with self._mu:
-            mat = self._od.get(sig)
-            if mat is not None:
-                self._od.move_to_end(sig)
-            return mat
-
-    def put(self, sig, mat) -> None:
-        with self._mu:
-            self._od[sig] = mat
-            self._od.move_to_end(sig)
-            while len(self._od) > self.cap:
-                self._od.popitem(last=False)
-
-    def __len__(self) -> int:
-        with self._mu:
-            return len(self._od)
+# (RecMatrixCache, the per-codec LRU, was folded into the shared
+# signature-keyed residency — ops/residency.py, ISSUE 11.)
 
 
 # ---------------------------------------------------------------------------
@@ -153,8 +122,11 @@ class TpuRSCodec:
             raise ValueError(f"invalid RS config {k}+{m}")
         self.k = k
         self.m = m
-        self._enc = jnp.asarray(encode_bits_matrix(k, m))
-        self._rec_cache = RecMatrixCache()
+        # matrices live in the shared signature-keyed residency
+        # (ops/residency.py): one LRU, one hit/miss counter, no
+        # per-instance re-transfer
+        self._enc = residency.matrices.get(
+            ("tpu-enc", k, m), lambda: jnp.asarray(encode_bits_matrix(k, m)))
 
     # -- encode -------------------------------------------------------------
     def encode(self, data_shards) -> jax.Array:
@@ -184,10 +156,10 @@ class TpuRSCodec:
         returns:    (B, len(wanted), S) uint8.
         """
         sig = (tuple(available), tuple(wanted))
-        mat = self._rec_cache.get(sig)
-        if mat is None:
-            mat = jnp.asarray(reconstruct_bits_matrix(self.k, self.m, *sig))
-            self._rec_cache.put(sig, mat)
+        mat = residency.matrices.get(
+            ("tpu-rec", self.k, self.m) + sig,
+            lambda: jnp.asarray(
+                reconstruct_bits_matrix(self.k, self.m, *sig)))
         return gf_bitmatmul(mat, jnp.asarray(src_shards, dtype=jnp.uint8))
 
     def decode_data(self, src_shards, available: tuple[int, ...]) -> jax.Array:

@@ -22,6 +22,7 @@ on a virtual CPU mesh (tests) and a real TPU slice.
 
 from __future__ import annotations
 
+import threading
 from functools import partial
 
 import jax
@@ -34,7 +35,7 @@ try:  # jax >= 0.5 exports shard_map at top level
 except AttributeError:  # older jax: experimental namespace
     from jax.experimental.shard_map import shard_map
 
-from minio_tpu.ops import rs_tpu
+from minio_tpu.ops import residency, rs_tpu
 
 
 def make_mesh(n_devices: int | None = None, *, blocks: int | None = None):
@@ -90,6 +91,26 @@ def sharded_encode_fn(mesh: Mesh, k: int, m: int):
     return partial(sharded_coding_fn(mesh), mat)
 
 
+# The set-major tick-batch ordering that makes the `blocks` axis
+# sharding below a sharding BY ERASURE SET lives with its caller:
+# erasure/batcher.py::set_major_order (jax-free, so the host-only path
+# never imports this module mid-tick).
+
+
+# Collective-launch serialization: two threads launching collective
+# programs concurrently can interleave their per-device enqueues in
+# different orders — device A runs thread 1's psum while device B runs
+# thread 2's, and both wait forever on their missing partners (observed
+# as a hard wedge on a 4-virtual-chip (2,2) mesh; BENCH_r13).  One
+# launch at a time keeps every device's queue in program order.
+# MODULE-level on purpose: codec instances are cached per (k, m)
+# geometry, so a per-instance lock would still let an 8+4 and a 4+2
+# launch race onto the same devices.  The ISSUE 11 request batcher
+# sidesteps the hazard by construction (single tick thread); this lock
+# keeps the PER-REQUEST mesh plane safe too.
+_LAUNCH_MU = threading.Lock()
+
+
 class MeshRSCodec:
     """Production multi-device codec with the host/Pallas codec surface.
 
@@ -115,11 +136,15 @@ class MeshRSCodec:
                 f"k={k} does not divide over the {self.n_sh}-way shards axis"
             )
         self._fn = sharded_coding_fn(mesh)
-        self._enc = jnp.asarray(rs_tpu.encode_bits_matrix(k, m))
-        # availability signatures are combinatorial under churny degraded
-        # reads: bound the per-signature matrix cache like the single-chip
-        # codec's (VERDICT r5 weak #5)
-        self._rec_cache = rs_tpu.RecMatrixCache()
+        # matrices live in the shared signature-keyed residency
+        # (ops/residency.py): re-instantiating a codec or reaching the
+        # same signature from a different call path (encode vs heal vs
+        # repair) never re-transfers a matrix to the devices, and the
+        # combinatorial churn of degraded-read signatures stays
+        # LRU-bounded (VERDICT r5 weak #5) with hit/miss counters
+        self._enc = residency.matrices.get(
+            ("mesh-enc", k, m),
+            lambda: jnp.asarray(rs_tpu.encode_bits_matrix(k, m)))
         self.dispatches = 0  # observability: mesh dispatch count
         from jax.sharding import NamedSharding
 
@@ -133,9 +158,12 @@ class MeshRSCodec:
             batch = np.concatenate(
                 [batch, np.zeros((pad,) + batch.shape[1:], np.uint8)]
             )
-        dev = jax.device_put(batch, self._in_sharding)
-        out = self._fn(mat, dev)
-        self.dispatches += 1
+        with _LAUNCH_MU:
+            # see _LAUNCH_MU: concurrent collective launches can
+            # cross-interleave per-device queues and deadlock
+            dev = jax.device_put(batch, self._in_sharding)
+            out = self._fn(mat, dev)
+            self.dispatches += 1
         return out[:b] if pad else out
 
     def encode(self, data_shards) -> jax.Array:
@@ -145,12 +173,10 @@ class MeshRSCodec:
     def reconstruct(self, src_shards, available, wanted) -> jax.Array:
         """(B, K, S) surviving shards -> (B, len(wanted), S)."""
         sig = (tuple(available), tuple(wanted))
-        mat = self._rec_cache.get(sig)
-        if mat is None:
-            mat = jnp.asarray(
-                rs_tpu.reconstruct_bits_matrix(self.k, self.m, *sig)
-            )
-            self._rec_cache.put(sig, mat)
+        mat = residency.matrices.get(
+            ("mesh-rec", self.k, self.m) + sig,
+            lambda: jnp.asarray(
+                rs_tpu.reconstruct_bits_matrix(self.k, self.m, *sig)))
         return self._run(mat, src_shards)
 
 

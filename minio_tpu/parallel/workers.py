@@ -42,6 +42,16 @@ plane across OS processes:
   item 5 metadata journal; the remote-drive analogue is
   `storage.rename_data_batch` in distributed/storage_rpc.py).
 
+* **Codec work batches per node process** (ISSUE 11): with
+  ``MINIO_TPU_BATCHER=1`` a worker's ``Erasure`` encodes submit to the
+  worker PROCESS's request batcher (erasure/batcher.py) instead of
+  dispatching privately — concurrent PUT jobs interleaving on one
+  worker's job threads coalesce into one fused codec program per tick,
+  exactly like request threads on the front.  The gate env is
+  inherited by the spawned child; `_worker_main` quiesces the child's
+  batcher on exit so shutdown drains or fails-retryable every queued
+  item (the modelled quiesce protocol).
+
 Everything is gated by ``MINIO_TPU_WORKERS`` (default 0 = the
 in-process plane, which stays alive as the differential reference —
 tests/test_mp_dataplane_diff.py pins byte identity).  Workers are
@@ -790,6 +800,15 @@ def _worker_main(conn, kind: str, env: dict | None = None) -> None:
             deadline_mod.service_thread(run_job, msg,
                                         name=f"mp-{kind}-job")
     finally:
+        try:
+            # quiesce the worker-process request batcher: drain or
+            # fail-retryable every queued codec item before the hard
+            # exit (erasure/batcher.py shutdown protocol)
+            from minio_tpu.erasure import batcher as batcher_mod
+
+            batcher_mod.shutdown()
+        except Exception:
+            pass
         rings.close_all()
         os._exit(0)
 
@@ -1356,6 +1375,15 @@ def shutdown_plane() -> None:
     if plane is not None:
         plane.close()
     _unlink_all_segments()
+    try:
+        # the front's request batcher quiesces with the plane: the two
+        # share teardown call sites (ServiceManager/S3Server close,
+        # conftest, atexit) and both must leave zero threads behind
+        from minio_tpu.erasure import batcher as batcher_mod
+
+        batcher_mod.shutdown()
+    except Exception:
+        pass
 
 
 atexit.register(shutdown_plane)

@@ -43,6 +43,7 @@ from minio_tpu.utils.pubsub import PubSub
 from .admin import AdminMixin
 from .metrics import MetricsMixin
 from .sse_handlers import SSEMixin, load_kms
+from .zip_extract import ZipExtractMixin
 
 XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
 VALID_BUCKET = re.compile(r"^[a-z0-9][a-z0-9.\-]{2,62}$")
@@ -248,7 +249,7 @@ class _QueuePipeReader(io.RawIOBase):
 
 
 class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
-               MetricsMixin):
+               MetricsMixin, ZipExtractMixin):
     def __init__(self, object_layer, access_key: str = "minioadmin",
                  secret_key: str = "minioadmin", region: str = "us-east-1",
                  max_concurrency: int = 64, iam=None):
@@ -2249,6 +2250,11 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
     async def get_object(self, request: web.Request) -> web.StreamResponse:
         bucket, key = self._object(request)
         await self._auth(request, None, "s3:GetObject", bucket, key)
+        # x-minio-extract: serve a member from inside a stored zip
+        # (reference cmd/s3-zip-handlers.go:49; server/zip_extract.py)
+        resp = await self._maybe_zip_extract(request, bucket, key)
+        if resp is not None:
+            return resp
         vid = request.rel_url.query.get("versionId", "")
         hc = self.hotcache
         if hc is not None:
@@ -2506,6 +2512,10 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
 
         bucket, key = self._object(request)
         await self._auth(request, None, "s3:GetObject", bucket, key)
+        resp = await self._maybe_zip_extract(request, bucket, key,
+                                             head=True)
+        if resp is not None:
+            return resp
         vid = request.rel_url.query.get("versionId", "")
         hc = self.hotcache
         if hc is not None:

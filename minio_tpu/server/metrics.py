@@ -402,6 +402,63 @@ class MetricsMixin:
         except Exception:
             pass
 
+        # device-resident erasure batcher (erasure/batcher.py, ISSUE
+        # 11): cross-request codec coalescing economics — items vs
+        # dispatches is THE batching signal (N same-tick submissions =
+        # 1 fused program), shed/failed counters show deadline and
+        # fault behavior, and the matrix-residency hit ratio shows
+        # whether re-submitted geometries re-transfer their matrices
+        try:
+            from minio_tpu.erasure import batcher as batcher_mod
+
+            bsnap = batcher_mod.stats_snapshot()
+            if bsnap is not None:
+                gauge("minio_batcher_ticks_total",
+                      "Batcher tick windows flushed", bsnap["ticks"])
+                gauge("minio_batcher_dispatches_total",
+                      "Fused device/host programs dispatched by the "
+                      "batcher", bsnap["dispatches"])
+                gauge("minio_batcher_items_total",
+                      "Codec work items submitted to the batcher",
+                      bsnap["items"])
+                gauge("minio_batcher_coalesced_items_total",
+                      "Items that shared a fused dispatch with at "
+                      "least one other item", bsnap["coalesced_items"])
+                gauge("minio_batcher_batched_bytes_total",
+                      "Payload bytes dispatched through fused batches",
+                      bsnap["batched_bytes"])
+                gauge("minio_batcher_shed_deadline_total",
+                      "Items shed because their budget expired while "
+                      "queued", bsnap["shed_deadline"])
+                gauge("minio_batcher_failed_retryable_total",
+                      "Items failed retryable back to the per-request "
+                      "plane (tick-thread death, dispatch failure)",
+                      bsnap["failed_retryable"])
+                gauge("minio_batcher_deaths_total",
+                      "Batcher tick-thread deaths", bsnap["deaths"])
+                gauge("minio_batcher_queue_length",
+                      "Items currently queued for the next tick",
+                      bsnap["queue_depth"])
+        except Exception:
+            pass
+        try:
+            from minio_tpu.ops import residency as residency_mod
+
+            msnap = residency_mod.matrices.stats()
+            gauge("minio_erasure_matrix_residency_hits_total",
+                  "Coding-matrix lookups served device/host-resident",
+                  msnap["hits"])
+            gauge("minio_erasure_matrix_residency_misses_total",
+                  "Coding-matrix lookups that built (and transferred) "
+                  "a matrix", msnap["misses"])
+            gauge("minio_erasure_matrix_residency_evictions_total",
+                  "Matrices evicted by the residency LRU bound",
+                  msnap["evictions"])
+            gauge("minio_erasure_matrix_residency_entries_count",
+                  "Matrices currently resident", msnap["entries"])
+        except Exception:
+            pass
+
         # deadline/overload plane: hedged shard reads, abandoned
         # stragglers, RPC budget expiries, per-drive deadline timeouts
         try:

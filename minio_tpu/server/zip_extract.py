@@ -1,0 +1,447 @@
+"""``x-minio-extract: true`` — serve members of a stored zip archive
+(reference cmd/s3-zip-handlers.go:49).
+
+A GET/HEAD of ``bucket/archive.zip/member/path`` with the
+``x-minio-extract: true`` header serves ``member/path`` from INSIDE the
+stored archive without materializing it: the archive's central
+directory is read once per (bucket, key, etag) through ranged reads
+(EOCD from the tail, zip64 aware) and cached; each member request then
+ranged-reads ONLY the member's local-header + data span through the
+normal erasure GET plane (``api.get_object(offset, length)``), so
+member reads ride every existing data-plane optimization (hedged shard
+reads, batched decode groups, the ISSUE 11 request batcher) and never
+touch bytes outside the member.
+
+The directory cache is keyed by the archive's etag: overwriting the
+zip mints a new etag, so member reads can never serve a stale
+directory — and because member payloads are ranged reads, they bypass
+the whole-object hot tier entirely (the hotcache interaction pinned by
+tests/test_zip_extract.py: an overwrite invalidates member reads even
+with the hot tier enabled).
+
+Stored (method 0) members stream their exact byte range; deflated
+(method 8) members decompress with a raw zlib window.  Anything else
+is refused like the reference (NotImplemented).
+"""
+
+from __future__ import annotations
+
+import mimetypes
+import struct
+import threading
+import zlib
+from dataclasses import dataclass
+
+from aiohttp import web
+
+from .s3errors import S3Error
+
+EXTRACT_HEADER = "x-minio-extract"
+ARCHIVE_PATTERN = ".zip/"
+
+#: EOCD scan window: EOCD record (22 bytes) + max comment (64 KiB) +
+#: the zip64 locator (20 bytes) that precedes the EOCD — without the
+#: extra 20, a zip64 archive with a maximal comment parses as
+#: "locator missing"
+_EOCD_WINDOW = (64 << 10) + 22 + 20
+_EOCD_SIG = b"PK\x05\x06"
+_EOCD64_LOC_SIG = b"PK\x06\x07"
+_EOCD64_SIG = b"PK\x06\x06"
+_CDH_SIG = b"PK\x01\x02"
+_LFH_SIG = b"PK\x03\x04"
+
+#: refuse to parse directories larger than this (a central directory
+#: this size means millions of members — cap the in-RAM index)
+_MAX_CDIR_BYTES = 64 << 20
+_INDEX_CACHE_CAP = 32
+
+
+def split_zip_key(key: str) -> tuple[str, str] | None:
+    """("archive.zip", "member/path") when `key` addresses inside an
+    archive (first ".zip/" wins, like the reference's strings.Index on
+    archivePattern); None otherwise."""
+    idx = key.find(ARCHIVE_PATTERN)
+    if idx < 0:
+        return None
+    member = key[idx + len(ARCHIVE_PATTERN):]
+    if not member:
+        return None
+    return key[:idx + len(ARCHIVE_PATTERN) - 1], member
+
+
+def wants_extract(request: web.Request) -> bool:
+    return request.headers.get(EXTRACT_HEADER, "").lower() == "true"
+
+
+@dataclass(frozen=True)
+class ZipMember:
+    name: str
+    method: int          # 0 = stored, 8 = deflate
+    comp_size: int
+    uncomp_size: int
+    header_offset: int   # local file header offset in the archive
+    crc32: int
+
+
+class ZipIndex:
+    """One archive's parsed directory + lazily resolved member payload
+    offsets (the local-header read is a quorum erasure GET; resolving
+    it once per cached index keeps repeat member reads at two quorum
+    round-trips, not three)."""
+
+    __slots__ = ("members", "data_offsets")
+
+    def __init__(self, members: dict[str, ZipMember]):
+        self.members = members
+        self.data_offsets: dict[str, int] = {}
+
+    def __len__(self) -> int:
+        return len(self.members)
+
+
+class _IndexCache:
+    """LRU of parsed central directories keyed by
+    (bucket, key, etag, size) — the etag key IS the invalidation: an
+    overwritten archive never serves its old directory.  Bounded by
+    BOTH archive count and total member count: 32 archives near the
+    64 MiB directory cap would otherwise pin GiBs of ZipMember objects
+    (an unauthenticated memory-growth vector)."""
+
+    def __init__(self, cap: int = _INDEX_CACHE_CAP,
+                 max_members: int = 2_000_000):
+        self.cap = cap
+        self.max_members = max_members
+        self._mu = threading.Lock()
+        self._items: dict[tuple, ZipIndex] = {}
+        self._members = 0
+
+    def get(self, key: tuple) -> "ZipIndex | None":
+        with self._mu:
+            idx = self._items.pop(key, None)
+            if idx is not None:
+                self._items[key] = idx  # re-insert: most recent
+            return idx
+
+    def put(self, key: tuple, idx: "ZipIndex") -> None:
+        with self._mu:
+            old = self._items.pop(key, None)
+            if old is not None:
+                self._members -= len(old)
+            self._items[key] = idx
+            self._members += len(idx)
+            while self._items and (len(self._items) > self.cap
+                                   or self._members > self.max_members):
+                oldest = next(iter(self._items))
+                if oldest == key and len(self._items) == 1:
+                    break  # always keep the entry just inserted
+                self._members -= len(self._items.pop(oldest))
+
+
+_index_cache = _IndexCache()
+
+
+def _bad_zip(msg: str) -> S3Error:
+    # the reference surfaces unparsable archives as a 400-class error
+    return S3Error("InvalidRequest", f"invalid zip archive: {msg}")
+
+
+def parse_central_directory(read_at, size: int) -> dict[str, ZipMember]:
+    """Parse the archive's member index via ranged reads.
+
+    ``read_at(offset, length) -> bytes`` is the normal GET plane.  One
+    tail read finds the EOCD (and zip64 locator); one read pulls the
+    whole central directory."""
+    if size < 22:
+        raise _bad_zip("too small for an end-of-central-directory record")
+    tail_len = min(size, _EOCD_WINDOW)
+    tail = read_at(size - tail_len, tail_len)
+    # scan backwards for the REAL EOCD: the signature bytes can also
+    # appear inside the (user-controlled) archive comment, so a
+    # candidate only counts when its recorded comment length lands
+    # exactly on the end of the file
+    pos = tail.rfind(_EOCD_SIG)
+    while pos >= 0:
+        if pos + 22 <= len(tail):
+            clen = struct.unpack("<H", tail[pos + 20:pos + 22])[0]
+            if pos + 22 + clen == len(tail):
+                break
+        pos = tail.rfind(_EOCD_SIG, 0, pos)
+    if pos < 0:
+        raise _bad_zip("end-of-central-directory signature not found")
+    (ndisk, cd_disk, _n_this, n_total, cd_size, cd_off, _clen
+     ) = struct.unpack("<HHHHIIH", tail[pos + 4:pos + 22])
+    if ndisk not in (0, 0xFFFF) or cd_disk not in (0, 0xFFFF):
+        raise _bad_zip("multi-disk archives are not supported")
+    if 0xFFFFFFFF in (cd_size, cd_off) or n_total == 0xFFFF:
+        # zip64: the locator sits immediately before the EOCD
+        loc_at = pos - 20
+        if loc_at < 0 or tail[loc_at:loc_at + 4] != _EOCD64_LOC_SIG:
+            raise _bad_zip("zip64 locator missing")
+        eocd64_off = struct.unpack("<Q", tail[loc_at + 8:loc_at + 16])[0]
+        rec = read_at(eocd64_off, 56)
+        if len(rec) < 56 or rec[:4] != _EOCD64_SIG:
+            # short read included: a crafted locator pointing near EOF
+            # must be a 400, not a struct.error 500
+            raise _bad_zip("zip64 end-of-central-directory missing")
+        n_total = struct.unpack("<Q", rec[32:40])[0]
+        cd_size = struct.unpack("<Q", rec[40:48])[0]
+        cd_off = struct.unpack("<Q", rec[48:56])[0]
+    if cd_size > _MAX_CDIR_BYTES:
+        raise _bad_zip("central directory too large")
+    if cd_off + cd_size > size:
+        raise _bad_zip("central directory extends past the archive")
+    cdir = read_at(cd_off, cd_size)
+
+    members: dict[str, ZipMember] = {}
+    p = 0
+    for _ in range(n_total):
+        if p + 46 > len(cdir) or cdir[p:p + 4] != _CDH_SIG:
+            break
+        (method, crc, csize, usize, nlen, xlen, clen, hdr_off
+         ) = struct.unpack("<H4xIIIHHH8xI", cdir[p + 10:p + 46])
+        name = cdir[p + 46:p + 46 + nlen].decode("utf-8", "replace")
+        extra = cdir[p + 46 + nlen:p + 46 + nlen + xlen]
+        if 0xFFFFFFFF in (csize, usize, hdr_off):
+            # zip64 extra field: values appear in documented order for
+            # exactly the fields that overflowed
+            q = 0
+            while q + 4 <= len(extra):
+                tag, tlen = struct.unpack("<HH", extra[q:q + 4])
+                if tag == 0x0001:
+                    body = extra[q + 4:q + 4 + tlen]
+                    r = 0
+                    if usize == 0xFFFFFFFF and r + 8 <= len(body):
+                        usize = struct.unpack("<Q", body[r:r + 8])[0]
+                        r += 8
+                    if csize == 0xFFFFFFFF and r + 8 <= len(body):
+                        csize = struct.unpack("<Q", body[r:r + 8])[0]
+                        r += 8
+                    if hdr_off == 0xFFFFFFFF and r + 8 <= len(body):
+                        hdr_off = struct.unpack("<Q", body[r:r + 8])[0]
+                    break
+                q += 4 + tlen
+        members[name] = ZipMember(
+            name=name, method=method, comp_size=csize,
+            uncomp_size=usize, header_offset=hdr_off, crc32=crc)
+        p += 46 + nlen + xlen + clen
+    return members
+
+
+def member_data_offset(read_at, member: ZipMember) -> int:
+    """Absolute offset of the member's compressed payload: local file
+    header is 30 fixed bytes + its OWN name/extra lengths (which may
+    differ from the central directory's copy)."""
+    hdr = read_at(member.header_offset, 30)
+    if hdr[:4] != _LFH_SIG:
+        raise _bad_zip("local file header signature mismatch")
+    nlen, xlen = struct.unpack("<HH", hdr[26:30])
+    return member.header_offset + 30 + nlen + xlen
+
+
+class ZipExtractMixin:
+    """S3Server mixin: GET/HEAD zip-member serving."""
+
+    def _zip_read_at(self, bucket: str, key: str, vid: str):
+        """read_at(offset, length) through the erasure GET plane —
+        SYNC, runs on the server executor."""
+        def read_at(offset: int, length: int) -> bytes:
+            if length <= 0:
+                return b""
+            _, stream = self.api.get_object(bucket, key, offset, length,
+                                            vid)
+            return b"".join(bytes(c) for c in stream)
+
+        return read_at
+
+    def _zip_index(self, bucket: str, key: str, vid: str, oi) -> ZipIndex:
+        cache_key = (bucket, key, oi.etag, oi.size)
+        idx = _index_cache.get(cache_key)
+        if idx is None:
+            idx = ZipIndex(parse_central_directory(
+                self._zip_read_at(bucket, key, vid), oi.size))
+            if vid:
+                _index_cache.put(cache_key, idx)
+            else:
+                # unpinned (unversioned) parse may have raced an
+                # overwrite: the bytes just read could belong to a
+                # NEWER archive than the etag in the cache key.  Cache
+                # only if the archive still carries that etag —
+                # otherwise a later A->B->A flip would serve archive
+                # B's offsets against archive A's bytes forever.
+                oi2 = self.api.get_object_info(bucket, key, vid)
+                if oi2.etag == oi.etag and oi2.size == oi.size:
+                    _index_cache.put(cache_key, idx)
+        return idx
+
+    def _zip_data_offset(self, bucket: str, key: str, vid: str,
+                         idx: ZipIndex, member: ZipMember) -> int:
+        """Member payload offset, resolved ONCE per cached index entry:
+        the 30-byte local-header read is a full quorum erasure GET, so
+        repeat member reads must not re-pay it (the offset is immutable
+        for a given archive etag).  Benign write race: the resolved
+        value is a pure function of the archive."""
+        off = idx.data_offsets.get(member.name)
+        if off is None:
+            off = member_data_offset(
+                self._zip_read_at(bucket, key, vid), member)
+            idx.data_offsets[member.name] = off
+        return off
+
+    def _zip_member_stream(self, bucket: str, key: str, vid: str,
+                           oi, idx: ZipIndex, member: ZipMember,
+                           offset: int, length: int):
+        """Iterator of `length` bytes of the member's PLAIN content
+        from `offset` — STREAMED, never the whole member in RAM (a
+        multi-GiB member must cost what a plain GET of the same bytes
+        costs).
+
+        Stored members map the range 1:1 onto the archive and ride the
+        normal ranged GET plane's iterator untouched.  Deflated members
+        stream the compressed span through a raw-window decompressobj,
+        skipping `offset` plain bytes chunk by chunk (members are
+        independent streams, so inflate must start at the member's
+        first byte; the skipped prefix is decompressed but never
+        buffered beyond one chunk).
+
+        Everything that can FAIL — the payload-range bounds check and
+        the payload ``get_object`` call itself — happens eagerly here,
+        BEFORE the handler sends response headers, so a crafted
+        directory or a lost archive is a clean 4xx, never a 200 with
+        an aborted body."""
+        data_off = self._zip_data_offset(bucket, key, vid, idx, member)
+        if data_off + member.comp_size > oi.size:
+            raise _bad_zip("member data extends past the archive")
+        if member.method == 0:  # stored: the range maps 1:1
+            _, stream = self.api.get_object(
+                bucket, key, data_off + offset, length, vid)
+            return stream
+        _, comp = self.api.get_object(
+            bucket, key, data_off, member.comp_size, vid)
+
+        def inflate():
+            try:
+                dec = zlib.decompressobj(-15)
+                skip = offset
+                left = length
+
+                def emit(plain):
+                    nonlocal skip, left
+                    if skip:
+                        drop = min(skip, len(plain))
+                        skip -= drop
+                        plain = plain[drop:]
+                    if plain and left > 0:
+                        out = plain[:left]
+                        left -= len(out)
+                        return out
+                    return b""
+
+                for chunk in comp:
+                    if left <= 0:
+                        break
+                    out = emit(dec.decompress(bytes(chunk)))
+                    if out:
+                        yield out
+                if left > 0:
+                    out = emit(dec.flush())
+                    if out:
+                        yield out
+                if left > 0:
+                    raise _bad_zip("member data truncated")
+            finally:
+                close = getattr(comp, "close", None)
+                if close is not None:
+                    close()
+
+        return inflate()
+
+    async def _maybe_zip_extract(self, request: web.Request, bucket: str,
+                                 key: str, head: bool = False
+                                 ) -> web.Response | None:
+        """Serve a zip-member GET/HEAD; None when the request is not an
+        extract request (caller falls through to the normal handler)."""
+        if not wants_extract(request):
+            return None
+        split = split_zip_key(key)
+        if split is None:
+            return None  # header set but key has no ".zip/": normal GET
+        zip_key, member_name = split
+        vid = request.rel_url.query.get("versionId", "")
+        oi = await self._run(self.api.get_object_info, bucket, zip_key,
+                             vid)
+        # member reads ranged-read the STORED archive bytes: an
+        # SSE-encrypted or server-compressed archive is opaque at that
+        # layer (the reference extracts through the decrypting object
+        # layer) — refuse explicitly rather than failing with a
+        # confusing "invalid zip" parse error
+        from minio_tpu.crypto import sse as sse_mod
+        from minio_tpu.utils import compress as compress_mod
+
+        if oi.metadata.get(sse_mod.META_ALGO) or oi.metadata.get(
+                compress_mod.META_COMPRESSION) == compress_mod.SCHEME:
+            raise S3Error(
+                "NotImplemented",
+                "x-minio-extract is not supported on encrypted or "
+                "compressed archives")
+        # conditional GET/HEAD semantics match the whole-archive GET:
+        # the member is served under the ARCHIVE's etag/mod-time
+        self.check_preconditions(request, oi)
+        # pin the multi-read sequence (index parse, local header,
+        # payload) to the version the info read resolved, so a racing
+        # overwrite on a VERSIONED bucket cannot mix archives mid-read
+        # (on an unversioned bucket the reads resolve latest — the same
+        # window every unversioned multi-call reader has)
+        if not vid and oi.version_id and oi.version_id != "null":
+            vid = oi.version_id
+        index = await self._run(self._zip_index, bucket, zip_key, vid, oi)
+        member = index.members.get(member_name)
+        if member is None:
+            raise S3Error("NoSuchKey", "zip member does not exist")
+        if member.method not in (0, 8):
+            raise S3Error("NotImplemented",
+                          f"zip compression method {member.method} is "
+                          "not supported")
+        size = member.uncomp_size
+        ctype = mimetypes.guess_type(member_name)[0] \
+            or "application/octet-stream"
+        headers = {
+            "ETag": f'"{oi.etag}"',
+            "Last-Modified": self._obj_headers(oi)["Last-Modified"],
+            "Content-Type": ctype,
+            "Accept-Ranges": "bytes",
+            "x-minio-extract": "true",
+        }
+        if oi.version_id:
+            headers["x-amz-version-id"] = oi.version_id
+        status = 200
+        offset, length = 0, size
+        rng = request.headers.get("Range")
+        if rng and size > 0 and not head:
+            start, end = self._parse_range(rng, size)
+            offset, length = start, end - start + 1
+            status = 206
+            headers["Content-Range"] = f"bytes {start}-{end}/{size}"
+        headers["Content-Length"] = str(length)
+        from minio_tpu.events.event import EventName
+
+        if head:
+            self._emit(EventName.OBJECT_ACCESSED_HEAD, bucket, key,
+                       size=size, etag=oi.etag,
+                       version_id=oi.version_id, request=request)
+            return web.Response(status=200, headers=headers)
+        self._emit(EventName.OBJECT_ACCESSED_GET, bucket, key, size=size,
+                   etag=oi.etag, version_id=oi.version_id,
+                   request=request)
+        stream = await self._run(self._zip_member_stream, bucket,
+                                 zip_key, vid, oi, index, member,
+                                 offset, length)
+        resp = web.StreamResponse(status=status, headers=headers)
+        await resp.prepare(request)
+        try:
+            await self._pump_stream(resp, stream)
+        finally:
+            close = getattr(stream, "close", None)
+            if close is not None:
+                await self._run(close)
+        await resp.write_eof()
+        return resp

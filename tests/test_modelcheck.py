@@ -27,8 +27,9 @@ from minio_tpu.analysis.concurrency import models as _models  # noqa: F401
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 #: the protocols PR 8's correctness rests on; ROADMAP records this
-#: inventory and future protocol PRs extend it
-LOAD_BEARING = ("arena-ring", "hotcache", "breaker-mrf")
+#: inventory and future protocol PRs extend it (ISSUE 11 added the
+#: erasure batcher's tick/submit/quiesce protocol)
+LOAD_BEARING = ("arena-ring", "hotcache", "breaker-mrf", "batcher")
 
 
 # ------------------------------------------------------------- engine

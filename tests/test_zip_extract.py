@@ -1,0 +1,177 @@
+"""``x-minio-extract: true`` zip member GET (ISSUE 11 carried S3
+surface gap; reference cmd/s3-zip-handlers.go:49).
+
+Pins: member GET/HEAD for stored and deflated members (bytes verified
+against the archive built with the stdlib zipfile), member Range
+requests, NoSuchKey for absent members, 404 pass-through for an absent
+archive, non-extract requests untouched, and the hotcache interaction:
+overwriting the archive invalidates member reads (the directory cache
+is etag-keyed, member payloads are ranged reads outside the hot tier),
+even with the hot tier enabled."""
+
+from __future__ import annotations
+
+import io
+import zipfile
+
+import pytest
+
+from tests.s3_harness import S3TestServer
+
+BKT = "zips"
+
+
+def _zip_bytes(members: dict[str, bytes], compress=zipfile.ZIP_DEFLATED,
+               comment: bytes = b"") -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", compression=compress) as z:
+        for name, payload in members.items():
+            z.writestr(name, payload)
+        if comment:
+            z.comment = comment
+    return buf.getvalue()
+
+
+@pytest.fixture()
+def srv(tmp_path, monkeypatch):
+    # hot tier ON: the overwrite-invalidation interaction below must
+    # hold with whole-object caching in play
+    monkeypatch.setenv("MINIO_TPU_HOTCACHE_BYTES", str(32 << 20))
+    s = S3TestServer(str(tmp_path))
+    assert s.server.hotcache is not None, "hot tier must be enabled"
+    s.request("PUT", f"/{BKT}")
+    yield s
+    s.close()
+
+
+MEMBERS = {
+    "docs/readme.txt": b"hello from inside the archive\n" * 64,
+    "data/blob.bin": bytes(range(256)) * 512,
+    "empty.txt": b"",
+}
+
+
+class TestZipMemberGet:
+    @pytest.mark.parametrize("compress", [zipfile.ZIP_STORED,
+                                          zipfile.ZIP_DEFLATED])
+    def test_member_get_bytes(self, srv, compress):
+        blob = _zip_bytes(MEMBERS, compress)
+        r = srv.request("PUT", f"/{BKT}/a.zip", data=blob)
+        assert r.status == 200
+        for name, payload in MEMBERS.items():
+            r = srv.request("GET", f"/{BKT}/a.zip/{name}",
+                            headers={"x-minio-extract": "true"})
+            assert r.status == 200, r.text()
+            assert r.body == payload, name
+            assert r.headers["Content-Length"] == str(len(payload))
+
+    def test_member_head(self, srv):
+        srv.request("PUT", f"/{BKT}/a.zip", data=_zip_bytes(MEMBERS))
+        r = srv.request("HEAD", f"/{BKT}/a.zip/data/blob.bin",
+                        headers={"x-minio-extract": "true"})
+        assert r.status == 200
+        assert r.headers["Content-Length"] == \
+            str(len(MEMBERS["data/blob.bin"]))
+        assert r.body == b""
+
+    @pytest.mark.parametrize("compress", [zipfile.ZIP_STORED,
+                                          zipfile.ZIP_DEFLATED])
+    def test_member_range(self, srv, compress):
+        srv.request("PUT", f"/{BKT}/a.zip",
+                    data=_zip_bytes(MEMBERS, compress))
+        payload = MEMBERS["data/blob.bin"]
+        r = srv.request("GET", f"/{BKT}/a.zip/data/blob.bin",
+                        headers={"x-minio-extract": "true",
+                                 "Range": "bytes=1000-4095"})
+        assert r.status == 206
+        assert r.body == payload[1000:4096]
+        assert r.headers["Content-Range"] == \
+            f"bytes 1000-4095/{len(payload)}"
+
+    def test_member_conditional_get(self, srv):
+        """Members serve under the ARCHIVE's etag: If-None-Match with
+        it returns 304 like the whole-archive GET (code-review pin —
+        the member path must run check_preconditions)."""
+        srv.request("PUT", f"/{BKT}/a.zip", data=_zip_bytes(MEMBERS))
+        r = srv.request("GET", f"/{BKT}/a.zip/docs/readme.txt",
+                        headers={"x-minio-extract": "true"})
+        etag = r.headers["ETag"]
+        r = srv.request("GET", f"/{BKT}/a.zip/docs/readme.txt",
+                        headers={"x-minio-extract": "true",
+                                 "If-None-Match": etag})
+        assert r.status == 304
+        assert r.body == b""
+
+    def test_missing_member_404(self, srv):
+        srv.request("PUT", f"/{BKT}/a.zip", data=_zip_bytes(MEMBERS))
+        r = srv.request("GET", f"/{BKT}/a.zip/not/there.txt",
+                        headers={"x-minio-extract": "true"})
+        assert r.status == 404
+        assert "NoSuchKey" in r.text()
+
+    def test_missing_archive_404(self, srv):
+        r = srv.request("GET", f"/{BKT}/absent.zip/member.txt",
+                        headers={"x-minio-extract": "true"})
+        assert r.status == 404
+
+    def test_archive_with_comment(self, srv):
+        """EOCD discovery must survive a trailing archive comment —
+        including one that embeds the EOCD signature bytes themselves
+        (rfind alone would lock onto the fake; the scan validates the
+        candidate's comment length against end-of-file)."""
+        evil = b"x" * 400 + b"PK\x05\x06" + b"\x00" * 18 + b"y" * 400
+        srv.request("PUT", f"/{BKT}/c.zip",
+                    data=_zip_bytes(MEMBERS, comment=evil))
+        r = srv.request("GET", f"/{BKT}/c.zip/docs/readme.txt",
+                        headers={"x-minio-extract": "true"})
+        assert r.status == 200
+        assert r.body == MEMBERS["docs/readme.txt"]
+
+    def test_not_a_zip_rejected(self, srv):
+        srv.request("PUT", f"/{BKT}/junk.zip", data=b"Z" * 4096)
+        r = srv.request("GET", f"/{BKT}/junk.zip/member",
+                        headers={"x-minio-extract": "true"})
+        assert r.status == 400
+        assert "InvalidRequest" in r.text()
+
+    def test_without_header_normal_semantics(self, srv):
+        """No x-minio-extract header: the zip-path key is just a key
+        (absent) and the archive itself GETs whole, byte-identical."""
+        blob = _zip_bytes(MEMBERS)
+        srv.request("PUT", f"/{BKT}/a.zip", data=blob)
+        r = srv.request("GET", f"/{BKT}/a.zip/docs/readme.txt")
+        assert r.status == 404
+        r = srv.request("GET", f"/{BKT}/a.zip")
+        assert r.status == 200 and r.body == blob
+
+    def test_overwrite_invalidates_member_reads(self, srv):
+        """The hotcache-interaction pin: after the archive is
+        overwritten (same key, new content), member reads serve the NEW
+        archive — the etag-keyed directory cache cannot serve stale,
+        and the hot tier's whole-object entry for the old zip cannot
+        leak into ranged member reads."""
+        v1 = _zip_bytes({"m.txt": b"version-one " * 100})
+        srv.request("PUT", f"/{BKT}/o.zip", data=v1)
+        # warm both caches: whole-object GET (hot tier) + member GET
+        # (directory cache)
+        r = srv.request("GET", f"/{BKT}/o.zip")
+        assert r.status == 200 and r.body == v1
+        r = srv.request("GET", f"/{BKT}/o.zip/m.txt",
+                        headers={"x-minio-extract": "true"})
+        assert r.body == b"version-one " * 100
+
+        v2 = _zip_bytes({"m.txt": b"version-TWO! " * 90,
+                         "extra.txt": b"new member"})
+        srv.request("PUT", f"/{BKT}/o.zip", data=v2)
+        r = srv.request("GET", f"/{BKT}/o.zip/m.txt",
+                        headers={"x-minio-extract": "true"})
+        assert r.status == 200
+        assert r.body == b"version-TWO! " * 90, \
+            "stale member served after archive overwrite"
+        r = srv.request("GET", f"/{BKT}/o.zip/extra.txt",
+                        headers={"x-minio-extract": "true"})
+        assert r.status == 200 and r.body == b"new member"
+        # and the whole-object read agrees (hot tier invalidated by the
+        # erasure layer's ns_updated choke point)
+        r = srv.request("GET", f"/{BKT}/o.zip")
+        assert r.body == v2
