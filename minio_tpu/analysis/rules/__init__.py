@@ -2,4 +2,4 @@
 
 from . import (budget, locks, metrics, payload,  # noqa: F401
                racecheck_waivers, resource_lifecycle, s3errors,
-               shared_state, threads)
+               shared_state, threads, trace)

@@ -53,6 +53,7 @@ WORKER_SURFACE = (
     "ops/gf256.py",
     "ops/residency.py",
     "utils/deadline.py",
+    "utils/tracing.py",
     "utils/hashing.py",
 )
 

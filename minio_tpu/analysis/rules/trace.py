@@ -1,0 +1,103 @@
+"""trace-propagation: hops that carry the deadline Budget must carry
+trace context too (the budget-propagation twin, ISSUE 12).
+
+The request trace (utils/tracing.py) rides the SAME three carriers as
+the deadline plane: the contextvar (free — copied contexts carry it),
+the ``x-minio-tpu-trace`` RPC header, and a ``trace`` field in worker
+job messages.  The contextvar leg is policed by budget-propagation
+(any hop that keeps the Budget keeps the trace).  The two EXPLICIT
+legs are the ones that rot silently: a function that serializes the
+budget onto a wire (``deadline.to_wire_ms()``, a ``deadline_ms``
+message field, the ``DEADLINE_HEADER``) or rebuilds it on the
+receiving side (``deadline.from_wire_ms()``) marks a process-escaping
+hop — and every such hop must also reference the tracing carrier
+(``tracing.to_wire`` / ``tracing.continuation`` / ``tracing.graft`` /
+``TRACE_HEADER``), or a new boundary swallows attribution exactly the
+way PR 8's workers and PR 11's batcher once did.
+
+Pure converters that a caller pairs with the trace carrier one frame
+up document themselves with a pragma::
+
+    # lint: allow(trace-propagation): pure converter — run_job pairs it with tracing.continuation
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, call_name, rule
+
+#: call suffixes that mark a budget crossing a process boundary
+_BUDGET_WIRE_CALLS = ("to_wire_ms", "from_wire_ms")
+#: name/attribute identifiers and string keys that mark the same
+_BUDGET_WIRE_NAMES = ("DEADLINE_HEADER",)
+_BUDGET_WIRE_KEYS = ("deadline_ms",)
+
+#: evidence the trace context rides the same hop
+_TRACE_CALL_SUFFIXES = ("to_wire", "continuation", "graft", "wire_scope")
+_TRACE_NAMES = ("TRACE_HEADER",)
+
+#: the planes themselves define the carriers
+_EXEMPT = ("utils/deadline.py", "utils/tracing.py")
+
+
+def _budget_wire_line(fn: ast.AST) -> int | None:
+    """First line inside `fn` where the budget visibly crosses a
+    process boundary; None when it never does."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            last = call_name(node).rsplit(".", 1)[-1]
+            if last in _BUDGET_WIRE_CALLS:
+                return node.lineno
+        elif isinstance(node, ast.Name) and node.id in _BUDGET_WIRE_NAMES:
+            return node.lineno
+        elif isinstance(node, ast.Attribute) \
+                and node.attr in _BUDGET_WIRE_NAMES:
+            return node.lineno
+        elif isinstance(node, ast.Constant) \
+                and node.value in _BUDGET_WIRE_KEYS:
+            return node.lineno
+    return None
+
+
+def _carries_trace(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            last = name.rsplit(".", 1)[-1]
+            if last in _TRACE_CALL_SUFFIXES and "tracing" in name:
+                return True
+        elif isinstance(node, ast.Name) and node.id in _TRACE_NAMES:
+            return True
+        elif isinstance(node, ast.Attribute) and node.attr in _TRACE_NAMES:
+            return True
+    return False
+
+
+@rule("trace-propagation",
+      "a function that ships/rebuilds the deadline budget across a "
+      "process boundary must carry trace context on the same hop "
+      "(tracing.to_wire/continuation/graft or TRACE_HEADER)")
+def check(module, project):
+    path = module.path.replace("\\", "/")
+    if any(path.endswith(e) for e in _EXEMPT):
+        return []
+    out = []
+    seen: set[int] = set()
+    for node in ast.walk(module.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        line = _budget_wire_line(node)
+        if line is None or line in seen:
+            continue
+        if _carries_trace(node):
+            seen.add(line)
+            continue
+        seen.add(line)
+        out.append(Finding(
+            module.path, line, 0, "trace-propagation",
+            "this hop serializes/rebuilds the deadline budget but "
+            "drops the trace context — pair it with tracing.to_wire "
+            "(sender) / tracing.continuation (receiver), or pragma a "
+            "provably trace-free path"))
+    return out

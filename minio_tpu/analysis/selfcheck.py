@@ -82,6 +82,22 @@ SELF_TESTS: dict[str, tuple[str, str, str]] = {
         "                                    bucket, key, reader, size,\n"
         "                                    opts)\n",
     ),
+    "trace-propagation": (
+        "mod.py",
+        "from minio_tpu.utils import deadline\n"
+        "def send(msg):\n"
+        "    ms = deadline.to_wire_ms()\n"
+        "    if ms is not None:\n"
+        "        msg['deadline_ms'] = ms\n",
+        "from minio_tpu.utils import deadline, tracing\n"
+        "def send(msg):\n"
+        "    ms = deadline.to_wire_ms()\n"
+        "    if ms is not None:\n"
+        "        msg['deadline_ms'] = ms\n"
+        "    wire = tracing.to_wire()\n"
+        "    if wire is not None:\n"
+        "        msg['trace'] = wire\n",
+    ),
     "racecheck": (
         "mod.py",
         "class C:\n"

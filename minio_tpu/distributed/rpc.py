@@ -23,6 +23,7 @@ import msgpack
 
 from minio_tpu.storage import errors
 from minio_tpu.utils import deadline as deadline_mod
+from minio_tpu.utils import tracing
 
 RPC_PREFIX = "/minio_tpu/rpc/v1"
 HEALTH_INTERVAL = 5.0
@@ -32,6 +33,10 @@ HEALTH_INTERVAL = 5.0
 # original caller has left (reference: context deadlines riding the
 # storage REST calls)
 DEADLINE_HEADER = "x-minio-tpu-deadline-ms"
+# trace context (trace:span:sampled) riding the same hop so the server
+# side's spans continue the caller's tree (utils/tracing.py — the
+# deadline header's read-side twin)
+TRACE_HEADER = tracing.TRACE_HEADER
 
 # observability for the deadline plane (read by server/metrics.py);
 # bare int bumps — the GIL makes them safe enough for counters
@@ -207,6 +212,9 @@ class RpcClient:
         conn.putheader("x-args-length", str(len(payload)))
         if deadline_ms is not None:
             conn.putheader(DEADLINE_HEADER, str(deadline_ms))
+        trace_wire = tracing.to_wire()
+        if trace_wire is not None:
+            conn.putheader(TRACE_HEADER, trace_wire)
         conn.putheader("Content-Length", str(len(payload) + len(body)))
         conn.endheaders()
         conn.send(payload)
@@ -242,7 +250,21 @@ class RpcClient:
              deadline: float | None = None, slow: bool = False,
              _probe: bool = False):
         """POST args (+ raw body tail); returns decoded result (or a
-        response object for streaming reads).
+        response object for streaming reads).  When a request trace is
+        ambient the hop gets a client span and the wire header carries
+        the context (the server side continues the tree)."""
+        if tracing.current() is None:
+            return self._call_impl(method, args, body, want_stream,
+                                   idempotent, deadline, slow, _probe)
+        with tracing.span(f"rpc.{method}", peer=self.endpoint()):
+            return self._call_impl(method, args, body, want_stream,
+                                   idempotent, deadline, slow, _probe)
+
+    def _call_impl(self, method: str, args: dict, body: bytes = b"",
+                   want_stream: bool = False, idempotent: bool = True,
+                   deadline: float | None = None, slow: bool = False,
+                   _probe: bool = False):
+        """(see call)
 
         Idempotent calls retry transport failures with jittered
         exponential backoff inside the optional `deadline` budget; each
@@ -386,6 +408,13 @@ class RpcSession:
         self._conn = None
 
     def call(self, method: str, args: dict, body: bytes = b""):
+        if tracing.current() is None:
+            return self._call_impl(method, args, body)
+        with tracing.span(f"rpc.{method}",
+                          peer=self.client.endpoint(), session=True):
+            return self._call_impl(method, args, body)
+
+    def _call_impl(self, method: str, args: dict, body: bytes = b""):
         c = self.client
         if self._conn is None:
             self._conn = http.client.HTTPConnection(
@@ -516,12 +545,18 @@ class RpcRouter:
             loop = asyncio.get_running_loop()
             pool = self._pool()
 
+            trace_wire = request.headers.get(TRACE_HEADER) or None
+
             def invoke():
                 # install the caller's remaining budget in the worker
                 # thread so the handler's drive gates and nested RPC
-                # hops inherit it
+                # hops inherit it — and continue the caller's trace the
+                # same way (same-process peers join the original tree;
+                # remote ones record a tail-captured fragment)
                 with deadline_mod.scope(budget):
-                    return fn(args, body)
+                    with tracing.continuation(trace_wire,
+                                              f"rpc.server.{method}"):
+                        return fn(args, body)
 
             try:
                 # lint: allow(budget-propagation): invoke() re-installs the wire-header budget via deadline.scope

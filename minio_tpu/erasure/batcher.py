@@ -65,6 +65,7 @@ import numpy as np
 
 from minio_tpu.storage import errors
 from minio_tpu.utils import deadline as deadline_mod
+from minio_tpu.utils import tracing
 
 _TRUTHY = ("1", "on", "true", "yes")
 
@@ -125,13 +126,19 @@ class BatcherClosed(errors.StorageError):
 
 class _Item:
     __slots__ = ("sig", "batch", "dispatch", "budget", "set_id",
-                 "event", "result", "error", "nbytes")
+                 "event", "result", "error", "nbytes", "trace_ref",
+                 "t_submit")
 
     def __init__(self, sig, batch, dispatch, set_id):
         self.sig = sig
         self.batch = batch
         self.dispatch = dispatch
         self.budget = deadline_mod.current()
+        # span link: the submitting request's (trace, span) — the tick
+        # thread records a batcher.tick span against it so a fused tick
+        # shows up in EVERY request it served (ISSUE 12)
+        self.trace_ref = tracing.current_ref()
+        self.t_submit = time.perf_counter()
         self.set_id = set_id
         self.event = threading.Event()
         self.result = None
@@ -258,13 +265,14 @@ class Batcher:
                     buckets = self._collect()
                     self._inflight = [it for b in buckets for it in b]
                     self.stats["ticks"] += 1
+                    tick_no = self.stats["ticks"]
                     n_items = len(self._inflight)
                     if n_items > self.stats["max_items_per_tick"]:
                         self.stats["max_items_per_tick"] = n_items
                 # dispatch OUTSIDE the lock: submitters keep enqueueing
                 # the next tick while this one runs on the device
                 for bucket in buckets:
-                    self._flush_bucket(bucket)
+                    self._flush_bucket(bucket, tick_no)
                 with self._cv:
                     self._inflight = []
         except BaseException:
@@ -287,7 +295,7 @@ class Batcher:
             if self._phase != "dead":
                 self._phase = "stopped"
 
-    def _flush_bucket(self, bucket: list[_Item]) -> None:
+    def _flush_bucket(self, bucket: list[_Item], tick_no: int = 0) -> None:
         """One geometry bucket -> at most one fused dispatch."""
         live: list[_Item] = []
         for it in bucket:
@@ -303,6 +311,7 @@ class Batcher:
             live.append(it)
         if not live:
             return
+        t_disp = time.perf_counter()
         try:
             if len(live) == 1:
                 out = np.asarray(live[0].dispatch(live[0].batch))
@@ -330,6 +339,19 @@ class Batcher:
                     it.nbytes for it in live)
                 if len(live) > 1:
                     self.stats["coalesced_items"] += len(live)
+            # span links: the fused tick records itself into EVERY
+            # served request's trace — which tick, how many co-batched
+            # items, and how long the item waited in queue, so a slow
+            # request can name its tick and its co-travellers
+            dur = time.perf_counter() - t_disp
+            for it in live:
+                if it.trace_ref is not None:
+                    tracing.record_span(
+                        it.trace_ref, "batcher.tick", dur,
+                        tick=tick_no, kind=str(it.sig[0]),
+                        items=len(live),
+                        wait_ms=round(
+                            (t_disp - it.t_submit) * 1e3, 3))
             for it, rows in zip(live, outs):
                 it.result = rows
                 it.event.set()

@@ -31,6 +31,7 @@ from minio_tpu.storage.xlmeta import (
     find_file_info_in_quorum, new_data_dir, new_version_id,
 )
 from minio_tpu.utils import deadline as deadline_mod
+from minio_tpu.utils import tracing
 from minio_tpu.utils.hashing import hash_order
 from . import bitrot, stagestats
 from . import repair as repair_mod
@@ -525,6 +526,8 @@ class ErasureObjects:
             errs[i] = errors.DeadlineExceeded(
                 f"drive {i}: straggler abandoned at quorum")
             hedge_stats["abandoned"] += 1
+        if pending:
+            tracing.event("read.stragglers_abandoned", count=len(pending))
         return fis, errs
 
     def _quorum_info(self, bucket, obj, version_id="", read_data=False,
@@ -1087,6 +1090,10 @@ class ErasureObjects:
                 skipped = (len(fast) + len(slow)) - len(open_set)
                 if skipped > 0:
                     hedge_stats["hedged"] += skipped
+                    # trace mark: this read steered around slow drives
+                    # (ISSUE 12: hedged reads are visible in the tree)
+                    tracing.event("read.hedged", skipped=skipped,
+                                  part=part.number)
                 prefer = list(open_set)  # fast first, chosen slow last
 
                 def open_one(i: int):
@@ -1140,10 +1147,18 @@ class ErasureObjects:
                     prefer = prefer + lazies
                 sink = _IterSink()
                 broken: set[int] = set()
-                # lint: allow(budget-propagation): whole-payload decode stream is deliberately budget-free (see _run_nobudget); joined in finally
+                # copied context: the caller's context is already
+                # budget-free here (whole-payload phase), but it DOES
+                # carry the request trace — the decode/respond stage
+                # folds must attribute to the live span (ISSUE 12)
+                import contextvars
+
+                decode_ctx = contextvars.copy_context()
+                # lint: allow(budget-propagation): whole-payload decode stream is deliberately budget-free (the copied ctx has no budget — see _run_nobudget); joined in finally
                 worker = threading.Thread(
-                    target=self._decode_to_sink,
-                    args=(e, sink, readers, local_off, local_len, part.size,
+                    target=decode_ctx.run,
+                    args=(self._decode_to_sink, e, sink, readers,
+                          local_off, local_len, part.size,
                           broken, prefer),
                     daemon=True,
                 )

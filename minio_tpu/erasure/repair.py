@@ -58,6 +58,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from minio_tpu.ops import gf256, residency
+from minio_tpu.utils import tracing
 from . import bitrot
 from . import coding as coding_mod
 
@@ -91,6 +92,7 @@ def _add_scan(nbytes: int) -> None:
 def note_fallback() -> None:
     with _stats_mu:
         repair_stats["fallbacks"] += 1
+    tracing.event("repair.fallback")
 
 
 def stats_snapshot() -> dict:
@@ -388,6 +390,11 @@ def plan_repair(e, lost, survivors, part_size: int,
         scheme = "full"
 
     _add_plan(scheme)
+    # trace mark: the planner's verdict with its pricing, so a heal
+    # span shows WHY it read the bytes it read (ISSUE 12)
+    tracing.event("repair.plan", scheme=scheme,
+                  est_bytes_full=int(est_full),
+                  est_bytes_sub=int(est_sub), forced=bool(ov))
     return RepairPlan(
         scheme=scheme, k=e.k, m=e.m, shard_size=e.shard_size, till=till,
         algo=algo, lost=lost, helpers=helpers,

@@ -19,6 +19,8 @@ from __future__ import annotations
 import threading
 import time
 
+from minio_tpu.utils import tracing
+
 STAGES = ("read", "etag", "encode", "hash", "write", "decode", "respond")
 
 _lock = threading.Lock()
@@ -28,10 +30,18 @@ _bytes = {s: 0 for s in STAGES}
 
 def add(stage: str, seconds: float, nbytes: int = 0) -> None:
     """Fold one timed span into a stage (thread-safe; stages are bumped
-    from pool workers, hasher tasks and the main encode thread alike)."""
+    from pool workers, hasher tasks and the main encode thread alike).
+
+    When a request trace is ambient (utils/tracing.py rides the copied
+    context into the same pool threads), the fold ALSO attributes to
+    that trace — per-request read/etag/encode/hash/write/decode
+    seconds, not just the global totals (ISSUE 12)."""
     with _lock:
         _seconds[stage] += seconds
         _bytes[stage] += nbytes
+    tr = tracing.current_trace()
+    if tr is not None:
+        tr.add_stage(stage, seconds)
 
 
 class timed:

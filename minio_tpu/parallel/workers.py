@@ -86,6 +86,7 @@ import numpy as np
 
 from minio_tpu.storage import errors
 from minio_tpu.utils import deadline as deadline_mod
+from minio_tpu.utils import tracing
 
 SHM_PREFIX = "mtpu-ring-"
 
@@ -491,6 +492,7 @@ class _RingCache:
 
 
 def _job_budget(msg):
+    # lint: allow(trace-propagation): pure converter — run_job pairs it with tracing.continuation over the same message
     return deadline_mod.from_wire_ms(msg.get("deadline_ms"))
 
 
@@ -635,8 +637,10 @@ def _run_put_data(msg, rings: "_RingCache", drives: dict) -> dict:
     try:
         # write_quorum=0: quorum is the FRONT's verdict over all
         # workers' answers; this worker reports its own failures only
-        total, dead = e.encode_stream(stream, writers,
-                                      msg.get("size", -1), 0)
+        with tracing.span("mp.encode", shards=len(own),
+                          parity_owned=parity_owned):
+            total, dead = e.encode_stream(stream, writers,
+                                          msg.get("size", -1), 0)
         for s in dead & own_set:
             failed.setdefault(s, ["FaultyDisk",
                                   f"shard {s} write failed in worker"])
@@ -770,23 +774,40 @@ def _worker_main(conn, kind: str, env: dict | None = None) -> None:
 
     def run_job(msg) -> None:
         job = msg.get("job")
+        op = msg.get("op", "?")
+        # trace continuation (utils/tracing.py): the job message's wire
+        # context opens a NON-CAPTURING fragment — the worker's spans
+        # (encode, batcher ticks) and stage folds ship home in the
+        # reply and are grafted under the front's job span, so one PUT
+        # stays ONE tree across the process boundary
+        cont = tracing.continuation(msg.get("trace"), f"mp.{op}",
+                                    capture=False, pid=os.getpid())
         try:
             with deadline_mod.scope(_job_budget(msg)):
-                op = msg["op"]
-                if op == "put_data":
-                    out = _run_put_data(msg, rings, drives)
-                elif op == "hash":
-                    out = _run_hash(msg, rings)
-                elif op == "commit":
-                    out = _run_commit(msg, drives)
-                elif op == "cleanup":
-                    out = _run_cleanup(msg, drives)
-                elif op == "ping":
-                    out = {"pong": True, "pid": os.getpid()}
-                else:
-                    out = {"err": ["InvalidArgument", f"unknown op {op}"]}
+                with cont:
+                    if op == "put_data":
+                        out = _run_put_data(msg, rings, drives)
+                    elif op == "hash":
+                        out = _run_hash(msg, rings)
+                    elif op == "commit":
+                        out = _run_commit(msg, drives)
+                    elif op == "cleanup":
+                        out = _run_cleanup(msg, drives)
+                    elif op == "ping":
+                        out = {"pong": True, "pid": os.getpid()}
+                    else:
+                        out = {"err": ["InvalidArgument",
+                                       f"unknown op {op}"]}
         except BaseException as ex:
             out = {"err": _exc_wire(ex)}
+        exported = cont.export()
+        if exported is not None and exported.get("spans"):
+            # per-stage seconds already travel in the reply's "stage"
+            # field (folded by the front through stagestats, which
+            # attributes to the live trace) — shipping them here too
+            # would double-count the worker's stage time
+            exported.pop("stages", None)
+            out["trace"] = exported
         reply(job, out)
 
     try:
@@ -1106,9 +1127,16 @@ class WorkerPlane:
         wire_ms = deadline_mod.to_wire_ms()
         if wire_ms is not None:
             base["deadline_ms"] = wire_ms
+        # trace context rides the job message like the deadline does;
+        # the worker's exported spans come back in the reply and are
+        # grafted under the per-worker job span begun at send
+        trace_wire = tracing.to_wire()
+        if trace_wire is not None:
+            base["trace"] = trace_wire
         groups: dict[_WorkerHandle, list] = {}
-        pendings: list[tuple[_WorkerHandle, _Pending, list]] = []
+        pendings: list[tuple[_WorkerHandle, _Pending, list, object]] = []
         hash_pending = None
+        hash_span = None
         failed: dict[int, Exception] = {}
         pool_ring = False  # only a fully-drained ring may be pooled
         try:
@@ -1121,7 +1149,9 @@ class WorkerPlane:
                             "drives": drives})
                 try:
                     gens[c] = h.restarts
-                    pendings.append((h, h.send(msg), drives))
+                    sp = tracing.begin("mp.job", op="put_data", worker=c,
+                                       shards=len(drives))
+                    pendings.append((h, h.send(msg), drives, sp))
                 except WorkerDied as ex:
                     dead.add(c)
                     for s, _r in drives:
@@ -1131,6 +1161,7 @@ class WorkerPlane:
                          "drives": []})
             try:
                 gens[len(handles)] = self.hash.restarts
+                hash_span = tracing.begin("mp.job", op="hash")
                 hash_pending = self.hash.send(hmsg)
             except WorkerDied:
                 # no etag lane, no PUT: unblock the io workers (they
@@ -1173,7 +1204,7 @@ class WorkerPlane:
             stagestats.add("read", t_read, total)
             t_fed = time.perf_counter()
 
-            for h, p, drives in pendings:
+            for h, p, drives, sp in pendings:
                 try:
                     out = h.wait(p, timeout)
                 except (WorkerDied, errors.StorageError) as ex:
@@ -1181,14 +1212,22 @@ class WorkerPlane:
                         self.failures += 1
                     for s, _r in drives:
                         failed.setdefault(s, ex)
+                    if sp is not None:
+                        sp.finish(error=type(ex).__name__)
                     continue
                 for s, pair in out.get("failed", {}).items():
                     failed.setdefault(int(s), _exc_unwire(pair))
                 st = out.get("stage", {})
                 for stage, secs in st.items():
                     stagestats.add(stage, secs, 0)
+                if sp is not None:
+                    tracing.graft(out.get("trace"), sp)
+                    sp.finish()
                 self.last_worker_wall = out.get("wall")
             hout = self.hash.wait(hash_pending, timeout)
+            if hash_span is not None:
+                tracing.graft(hout.get("trace"), hash_span)
+                hash_span.finish()
             st = hout.get("stage", {})
             for stage, secs in st.items():
                 stagestats.add(stage, secs, 0)
@@ -1247,12 +1286,17 @@ class WorkerPlane:
             wire_ms = deadline_mod.to_wire_ms()
             if wire_ms is not None:
                 msg["deadline_ms"] = wire_ms
+            trace_wire = tracing.to_wire()
+            if trace_wire is not None:
+                msg["trace"] = trace_wire
             try:
-                sends.append((h, h.send(msg), drives))
+                sp = tracing.begin("mp.job", op="commit",
+                                   shards=len(drives))
+                sends.append((h, h.send(msg), drives, sp))
             except WorkerDied as ex:
                 for s, _r in drives:
                     out[s] = ex
-        for h, p, drives in sends:
+        for h, p, drives, sp in sends:
             try:
                 rep = h.wait(p, timeout)
             except (WorkerDied, errors.StorageError) as ex:
@@ -1260,7 +1304,12 @@ class WorkerPlane:
                     self.failures += 1
                 for s, _r in drives:
                     out[s] = ex
+                if sp is not None:
+                    sp.finish(error=type(ex).__name__)
                 continue
+            if sp is not None:
+                tracing.graft(rep.get("trace"), sp)
+                sp.finish()
             results = rep.get("results", {})
             for s, _r in drives:
                 pair = results.get(s, results.get(str(s)))

@@ -110,6 +110,10 @@ class AdminMixin:
         # observability: live trace + console log streams (reference
         # TraceHandler cmd/admin-handlers.go:1108, ConsoleLogHandler)
         r.add_get(f"{p}/trace", wrap(self.admin_trace, "ServerTrace"))
+        # captured span trees: the tail-based slow/error store
+        # (utils/tracing.py, ISSUE 12)
+        r.add_get(f"{p}/trace/slow",
+                  wrap(self.admin_trace_slow, "ServerTrace"))
         r.add_get(f"{p}/log", wrap(self.admin_console_log, "ConsoleLog"))
         # on-demand cluster profiling (reference StartProfiling /
         # DownloadProfileData, cmd/peer-rest-client.go:469-490)
@@ -549,6 +553,36 @@ class AdminMixin:
             if stop.wait(backoff):
                 return
             backoff = min(backoff * 2, 15.0)
+
+    async def admin_trace_slow(self, request: web.Request,
+                               body: bytes) -> web.Response:
+        """Captured span trees from the tail-based trace store
+        (utils/tracing.py): every trace that ended in an error / 503
+        shed, ran past MINIO_TPU_TRACE_SLOW_MS, or won the head-
+        sampling draw.  ``?id=<traceId>`` fetches one trace (the id a
+        user read off ``x-minio-tpu-trace-id``), ``?err=true`` filters
+        to errors, ``?n=`` bounds the count (default 50)."""
+        from minio_tpu.utils import tracing
+
+        q = request.rel_url.query
+        tid = q.get("id", "")
+        if tid:
+            doc = tracing.store.get(tid)
+            if doc is None:
+                raise S3Error("NoSuchKey", f"no captured trace {tid}")
+            return web.json_response(tracing.span_tree(doc))
+        try:
+            n = max(1, min(1000, int(q.get("n", "50") or "50")))
+        except ValueError:
+            n = 50
+        err_only = q.get("err", "") in ("true", "1")
+        docs = tracing.store.snapshot(n=n, err_only=err_only)
+        return web.json_response({
+            "enabled": tracing.enabled(),
+            "slowMs": tracing.slow_ms(),
+            "store": tracing.store.stats(),
+            "traces": [tracing.span_tree(d) for d in docs],
+        })
 
     async def admin_console_log(self, request: web.Request,
                                 body: bytes) -> web.StreamResponse:

@@ -38,6 +38,7 @@ from .object_extras import (
     ObjectExtraHandlers, parse_tag_query,
 )
 from .s3errors import S3Error, from_storage_error
+from minio_tpu.utils import tracing
 from minio_tpu.utils.logger import log
 from minio_tpu.utils.pubsub import PubSub
 from .admin import AdminMixin
@@ -354,6 +355,10 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         # CORS headers ride on on_response_prepare so STREAMED responses
         # (prepared inside their handlers) are decorated too
         self.app.on_response_prepare.append(self._cors_on_prepare)
+        # every response — 200s, errors AND 503 sheds — carries the
+        # request's trace id so a user report is greppable against the
+        # captured store (ISSUE 12; absent entirely with tracing off)
+        self.app.on_response_prepare.append(self._trace_on_prepare)
         self.app.router.add_route("*", "/", self.dispatch_root)
         self.app.router.add_route("*", "/{bucket}", self.dispatch_bucket)
         self.app.router.add_route("*", "/{bucket}/{key:.*}", self.dispatch_object)
@@ -537,11 +542,28 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         other whole-payload phases (PUT bodies, multipart assembly, GET
         streaming, Select scans) must not be killed mid-transfer when the
         admission budget — which bounds queue wait and time-to-first-byte
-        work — runs out."""
+        work — runs out.
+
+        The rest of the context DOES travel — in particular the request
+        trace (utils/tracing.py): a whole-payload phase is budget-free
+        by contract but its time must still be attributable, so the
+        copied context runs with ONLY the Budget var cleared."""
+        import contextvars
+
+        from minio_tpu.utils import deadline as deadline_mod
+
         loop = asyncio.get_running_loop()
-        # lint: allow(budget-propagation): dropping the budget is this helper's contract (whole-payload phases)
+        ctx = contextvars.copy_context()
+
+        def nobudget():
+            token = deadline_mod.set_current(None)
+            try:
+                return fn(*args, **kw)
+            finally:
+                deadline_mod.reset(token)
+
         return await loop.run_in_executor(self.executor,
-                                          lambda: fn(*args, **kw))
+                                          lambda: ctx.run(nobudget))
 
     async def _pump_stream(self, resp: web.StreamResponse, stream) -> None:
         """Stream an iterator's chunks to the response with one chunk of
@@ -755,6 +777,13 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         tx = 0
         budget = self._request_budget(request)
         lane = self.sem
+        # root span of the request trace (utils/tracing.py): minted
+        # BEFORE admission so a 503 shed still has a greppable trace id;
+        # the id is stamped on every response by _trace_on_prepare
+        root = tracing.begin_request(api, method=request.method,
+                                     path=request.path)
+        if root is not None:
+            request["traceId"] = root.trace.trace_id
         try:
             # ---- admission: bounded queue wait, shed on expiry --------
             # fast path first: a free slot must not count as queue
@@ -805,6 +834,11 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                                                    timeout=wait)
                         except asyncio.TimeoutError:
                             status = 503
+                            if root is not None:
+                                root.defer_child(
+                                    "admission",
+                                    time.monotonic() - t0,
+                                    lane="api", queued=True, shed=True)
                             return self._shed_response(api)
                 except asyncio.CancelledError:
                     status = 499  # client gave up while queued
@@ -812,7 +846,20 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 finally:
                     self._waiters -= 1
                     self._m_queue_waiting.dec()
-            self._m_queue_wait.observe(time.monotonic() - t0)
+            wait_dt = time.monotonic() - t0
+            self._m_queue_wait.observe(wait_dt)
+            if root is not None:
+                # admission-wait child: ~0 on the fast path, the queue
+                # wait otherwise — the first place a slow request's
+                # time can hide.  Deferred: materialized only if the
+                # trace is captured (defer_child is a tuple stash)
+                # queued = actually waited on a semaphore: False for
+                # the fast path AND the (uncontended by construction)
+                # hot-lane admit
+                root.defer_child(
+                    "admission", wait_dt,
+                    lane="hot" if lane is self.hot_sem else "api",
+                    queued=not admitted)
             token = deadline_mod.set_current(budget)
             try:
                 try:
@@ -835,6 +882,8 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                     s3e = from_storage_error(e, request.path)
                     status = s3e.status
                     if status >= 500:
+                        # traceId attaches via the logger's ambient-
+                        # trace hook (utils/logger.py)
                         log.error("request failed", api=api,
                                   path=request.path, error=repr(e))
                     return web.Response(
@@ -850,6 +899,12 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             self._m_inflight.dec()
             self.record_api(api, status, dt,
                             rx=request.content_length or 0, tx=tx)
+            if root is not None:
+                # tail capture: 5xx (incl. the 503 shed) and anything
+                # past the slow threshold is retained; the rest lives
+                # or dies by the head-sampling draw
+                tracing.end_request(root, status=status,
+                                    error=status >= 500, duration=dt)
             # live trace + audit (reference httpTraceAll publishing
             # madmin.TraceInfo, cmd/http-tracer.go:39; audit entries,
             # internal/logger/audit.go)
@@ -866,6 +921,11 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                     "userAgent": request.headers.get("User-Agent", ""),
                     "accessKey": request.get("accessKey", ""),
                 }
+                if root is not None:
+                    # span summary on the live stream: where the time
+                    # went, without shipping the whole tree
+                    entry["traceId"] = root.trace.trace_id
+                    entry["spans"] = tracing.summary(root)
                 self.trace.publish(entry)
                 if log.audit_enabled:
                     # queue-store I/O must not run on the event loop
@@ -1258,6 +1318,16 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             marker = q.get("continuation-token", "") or q.get("start-after", "")
         else:
             marker = q.get("marker", "")
+
+        # x-minio-extract on a prefix into a .zip: list the ARCHIVE's
+        # members through the cached central directory instead of the
+        # bucket namespace (server/zip_extract.py; reference
+        # cmd/s3-zip-handlers.go listObjectsV2InArchive)
+        resp = await self._maybe_zip_list(request, bucket, prefix,
+                                          delimiter, marker, max_keys,
+                                          v2, enc)
+        if resp is not None:
+            return resp
 
         res = await self._run(
             listing_mod.list_objects, self.api, bucket, prefix, delimiter,
@@ -1856,6 +1926,17 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             # make outages look like CORS misconfiguration
             log.warning("CORS decoration failed", bucket=bucket,
                         error=repr(e))
+
+    async def _trace_on_prepare(self, request: web.Request, resp) -> None:
+        """Stamp the request's trace id on the response (fires for plain
+        and streamed responses alike, AFTER the handler returned — the
+        id lives on the request, not the already-reset contextvar)."""
+        try:
+            tid = request.get("traceId", "")
+            if tid and tracing.RESPONSE_HEADER not in resp.headers:
+                resp.headers[tracing.RESPONSE_HEADER] = tid
+        except Exception:
+            pass  # decoration must never break a response
 
     async def _maybe_replicate(self, request, bucket: str, key: str,
                                oi) -> str | None:

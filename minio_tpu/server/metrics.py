@@ -459,6 +459,52 @@ class MetricsMixin:
         except Exception:
             pass
 
+        # request tracing plane (utils/tracing.py, ISSUE 12): recording
+        # volume, tail-capture economics and the bounded store's
+        # honesty counters.  Rendered only while the plane is (or was)
+        # on, so MINIO_TPU_TRACE=0 stays metrics-identical to the
+        # pre-tracing server.
+        try:
+            from minio_tpu.utils import tracing
+
+            if tracing.enabled() or tracing.stats["traces"]:
+                ts = tracing.store.stats()
+                gauge("minio_trace_traces_total",
+                      "Traces recorded (one per request/heal sequence)",
+                      tracing.stats["traces"])
+                gauge("minio_trace_spans_total",
+                      "Spans recorded across all traces",
+                      tracing.stats["spans"])
+                gauge("minio_trace_spans_dropped_total",
+                      "Spans dropped by the per-trace span cap",
+                      tracing.stats["spans_dropped"])
+                gauge("minio_trace_fragments_total",
+                      "Continuation fragments opened for hops whose "
+                      "origin trace lives in another process",
+                      tracing.stats["fragments"])
+                gauge("minio_trace_captures_total",
+                      "Traces retained by tail capture or head "
+                      "sampling", ts["captures"])
+                rows = ["# HELP minio_trace_capture_reason_total "
+                        "Captured traces per retention reason",
+                        "# TYPE minio_trace_capture_reason_total gauge"]
+                for reason, n in sorted(ts["by_reason"].items()):
+                    lbl = _fmt_labels(("reason",), (reason,))
+                    rows.append(
+                        f"minio_trace_capture_reason_total{lbl} {n}")
+                g("\n".join(rows) + "\n")
+                gauge("minio_trace_capture_evictions_total",
+                      "Captured traces evicted by the store bound",
+                      ts["evictions"])
+                gauge("minio_trace_store_bytes",
+                      "Approximate resident bytes of the trace store",
+                      ts["bytes"])
+                gauge("minio_trace_store_entries_count",
+                      "Traces currently resident in the store",
+                      ts["entries"])
+        except Exception:
+            pass
+
         # deadline/overload plane: hedged shard reads, abandoned
         # stragglers, RPC budget expiries, per-drive deadline timeouts
         try:

@@ -355,6 +355,118 @@ class ZipExtractMixin:
 
         return inflate()
 
+    async def _maybe_zip_list(self, request: web.Request, bucket: str,
+                              prefix: str, delimiter: str, marker: str,
+                              max_keys: int, v2: bool, enc: str
+                              ) -> web.Response | None:
+        """List the members INSIDE a stored archive when a
+        ListObjects(V2) arrives with ``x-minio-extract: true`` and a
+        prefix addressing into a ``.zip`` (reference
+        cmd/s3-zip-handlers.go listObjectsV2InArchive).  Rides the same
+        etag-keyed central-directory cache as member GET/HEAD — a
+        listing after an archive overwrite can never serve the old
+        directory.  None when this is not an archive listing (caller
+        falls through to the normal bucket listing)."""
+        if not wants_extract(request):
+            return None
+        idx = prefix.find(ARCHIVE_PATTERN)
+        if idx < 0:
+            return None
+        zip_key = prefix[:idx + len(ARCHIVE_PATTERN) - 1]
+        member_prefix = prefix[idx + len(ARCHIVE_PATTERN):]
+        vid = ""
+        oi = await self._run(self.api.get_object_info, bucket, zip_key,
+                             vid)
+        from minio_tpu.crypto import sse as sse_mod
+        from minio_tpu.utils import compress as compress_mod
+
+        if oi.metadata.get(sse_mod.META_ALGO) or oi.metadata.get(
+                compress_mod.META_COMPRESSION) == compress_mod.SCHEME:
+            raise S3Error(
+                "NotImplemented",
+                "x-minio-extract is not supported on encrypted or "
+                "compressed archives")
+        if not vid and oi.version_id and oi.version_id != "null":
+            # pin index reads to the resolved version (member-GET parity)
+            vid = oi.version_id
+        index = await self._run(self._zip_index, bucket, zip_key, vid, oi)
+
+        from .app import XMLNS, _iso
+
+        names = sorted(n for n in index.members
+                       if n.startswith(member_prefix))
+        entries: list[str] = []
+        prefixes: list[str] = []
+        seen_prefixes: set[str] = set()
+        truncated = False
+        last_key = ""
+        for name in names if max_keys > 0 else ():
+            full = f"{zip_key}/{name}"
+            if marker and full <= marker:
+                continue
+            if delimiter:
+                rest = name[len(member_prefix):]
+                cut = rest.find(delimiter)
+                if cut >= 0:
+                    cp = (f"{zip_key}/{member_prefix}"
+                          f"{rest[:cut + len(delimiter)]}")
+                    # a resumed page's marker IS the rolled-up prefix:
+                    # member keys under it sort AFTER it, so the key
+                    # skip above never fires for them — the whole
+                    # collapsed group must be skipped here or the
+                    # continuation token never advances (infinite
+                    # pagination loop)
+                    if marker and cp <= marker:
+                        continue
+                    if cp in seen_prefixes:
+                        continue
+                    if len(entries) + len(prefixes) >= max_keys:
+                        truncated = True
+                        break
+                    seen_prefixes.add(cp)
+                    prefixes.append(cp)
+                    last_key = cp
+                    continue
+            if len(entries) + len(prefixes) >= max_keys:
+                truncated = True
+                break
+            m = index.members[name]
+            entries.append(
+                f"<Contents><Key>{self._enc_key(full, enc)}</Key>"
+                f"<LastModified>{_iso(oi.mod_time)}</LastModified>"
+                f'<ETag>&quot;{oi.etag}&quot;</ETag>'
+                f"<Size>{m.uncomp_size}</Size>"
+                f"<StorageClass>STANDARD</StorageClass></Contents>")
+            last_key = full
+        parts = entries + [
+            f"<CommonPrefixes><Prefix>{self._enc_key(cp, enc)}</Prefix>"
+            f"</CommonPrefixes>" for cp in prefixes]
+        tag = "ListBucketResult"
+        body = [f'<?xml version="1.0" encoding="UTF-8"?>',
+                f'<{tag} xmlns="{XMLNS}">',
+                f"<Name>{bucket}</Name>",
+                f"<Prefix>{self._enc_key(prefix, enc)}</Prefix>",
+                f"<MaxKeys>{max_keys}</MaxKeys>",
+                f"<Delimiter>{self._enc_key(delimiter, enc)}</Delimiter>",
+                f"<IsTruncated>{'true' if truncated else 'false'}"
+                f"</IsTruncated>"]
+        if v2:
+            body.append(f"<KeyCount>{len(entries) + len(prefixes)}"
+                        f"</KeyCount>")
+            if truncated:
+                # plain-escaped like the bucket listing: the token IS
+                # the last key (the V2 handler feeds it back as marker)
+                body.append("<NextContinuationToken>"
+                            f"{self._enc_key(last_key, '')}"
+                            "</NextContinuationToken>")
+        elif truncated:
+            body.append(f"<NextMarker>{self._enc_key(last_key, enc)}"
+                        f"</NextMarker>")
+        body.extend(parts)
+        body.append(f"</{tag}>")
+        return self._xml(200, "".join(body),
+                         headers={EXTRACT_HEADER: "true"})
+
     async def _maybe_zip_extract(self, request: web.Request, bucket: str,
                                  key: str, head: bool = False
                                  ) -> web.Response | None:

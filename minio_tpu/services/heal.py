@@ -16,6 +16,7 @@ import uuid
 from dataclasses import dataclass, field
 
 from minio_tpu.storage import errors
+from minio_tpu.utils import tracing
 from minio_tpu.utils.deadline import service_thread
 from minio_tpu.storage.local import SYSTEM_VOL, HEALING_FILE
 
@@ -134,6 +135,14 @@ class HealSequence:
 
     def _run(self) -> None:
         st = self.status
+        # one trace per heal sequence (utils/tracing.py): each object
+        # heal is a child span tagged with the repair planner's verdict
+        # (scheme + survivor/scan bytes), so a slow sweep names WHICH
+        # objects and WHICH repair scheme ate the read bandwidth
+        root = tracing.start("heal.sequence", healId=st.heal_id,
+                             bucket=st.bucket, prefix=st.prefix,
+                             deep=self.deep)
+        token = tracing.install(root) if root is not None else None
         try:
             for bucket in self._buckets():
                 if self._stop.is_set():
@@ -158,8 +167,19 @@ class HealSequence:
                     self._throttle_wait()
                     st.objects_scanned += 1
                     try:
-                        res = self.ol.heal_object(bucket, name,
-                                                  deep=self.deep)
+                        with tracing.span("heal.object", bucket=bucket,
+                                          key=name) as sp:
+                            res = self.ol.heal_object(bucket, name,
+                                                      deep=self.deep)
+                            if sp is not None:
+                                sp.tag(
+                                    scheme=getattr(res, "scheme", "full"),
+                                    bytes_read=getattr(
+                                        res, "bytes_read", 0),
+                                    bytes_scanned=getattr(
+                                        res, "bytes_scanned", 0),
+                                    failed=bool(
+                                        getattr(res, "failed", False)))
                         if getattr(res, "failed", False):
                             st.objects_failed += 1
                             st.failed_items.append(f"{bucket}/{name}")
@@ -181,6 +201,13 @@ class HealSequence:
             st.state = "failed"
         finally:
             st.end_time = time.time()
+            if root is not None:
+                tracing.reset(token)
+                root.tag(state=st.state, healed=st.objects_healed,
+                         objects_failed=st.objects_failed)
+                tracing.finish(root, status=200,
+                               error=st.state == "failed"
+                               or st.objects_failed > 0)
 
 
 class HealManager:

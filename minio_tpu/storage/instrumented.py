@@ -26,6 +26,7 @@ import time
 
 from minio_tpu.storage import errors
 from minio_tpu.utils import deadline as deadline_mod
+from minio_tpu.utils import tracing
 
 # every data-plane method of StorageAPI gets a timer (control accessors
 # like disk_id/is_online are left untimed on purpose — they are hot and
@@ -238,14 +239,28 @@ class InstrumentedStorage:
             if gated and budget is not None and budget.t_end is not None \
                     and budget.remaining() <= DEADLINE_GATE_MAX:
                 return self._deadline_call(op, fn, stats, budget, a, kw)
+            # per-drive op span when a request trace is ambient: the
+            # where-did-this-request's-time-go attribution ISSUE 12
+            # exists for (one contextvar read when untraced)
+            ref = tracing.current_ref()
             t0 = time.monotonic()
             try:
                 out = fn(*a, **kw)
             except Exception as e:
-                stats.record(time.monotonic() - t0, failed=True)
+                dt = time.monotonic() - t0
+                stats.record(dt, failed=True)
+                if ref is not None:
+                    tracing.record_span(
+                        ref, f"drive.{op}", dt,
+                        drive=self._endpoint_label(),
+                        error=type(e).__name__)
                 self._note(fault=is_drive_fault(e))
                 raise
-            stats.record(time.monotonic() - t0, failed=False)
+            dt = time.monotonic() - t0
+            stats.record(dt, failed=False)
+            if ref is not None:
+                tracing.record_span(ref, f"drive.{op}", dt,
+                                    drive=self._endpoint_label())
             self._note(fault=False)
             return out
 
@@ -267,6 +282,7 @@ class InstrumentedStorage:
             raise errors.DeadlineExceeded(
                 f"{self._endpoint_label()}: {op} refused, request "
                 f"deadline budget exhausted")
+        ref = tracing.current_ref()
         fut = deadline_mod.ctx_submit(_deadline_pool(), fn, *a, **kw)
         t0 = time.monotonic()
         try:
@@ -281,6 +297,16 @@ class InstrumentedStorage:
                     self.deadline_expired += 1
             else:
                 stats.record(time.monotonic() - t0, failed=True)
+                if ref is not None:
+                    # ABANDONED mark only when the drive actually held
+                    # the read — a cancel()ed (never-started) call is
+                    # the POOL's backlog, and blaming the drive in the
+                    # trace would be the exact misattribution this
+                    # plane exists to prevent
+                    tracing.record_span(ref, f"drive.{op}",
+                                        time.monotonic() - t0,
+                                        drive=self._endpoint_label(),
+                                        abandoned=True)
                 fut.add_done_callback(_close_abandoned)
                 with self._health_mu:
                     self.deadline_timeouts += 1
@@ -294,10 +320,19 @@ class InstrumentedStorage:
                 f"{self._endpoint_label()}: {op} abandoned after "
                 f"{rem * 1e3:.0f} ms budget")
         except Exception as e:
-            stats.record(time.monotonic() - t0, failed=True)
+            dt = time.monotonic() - t0
+            stats.record(dt, failed=True)
+            if ref is not None:
+                tracing.record_span(ref, f"drive.{op}", dt,
+                                    drive=self._endpoint_label(),
+                                    error=type(e).__name__)
             self._note(fault=is_drive_fault(e))
             raise
-        stats.record(time.monotonic() - t0, failed=False)
+        dt = time.monotonic() - t0
+        stats.record(dt, failed=False)
+        if ref is not None:
+            tracing.record_span(ref, f"drive.{op}", dt,
+                                drive=self._endpoint_label())
         self._note(fault=False)
         return out
 

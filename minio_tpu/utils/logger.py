@@ -126,6 +126,15 @@ class Logger:
         }
         if ctx:
             entry.update(ctx)
+        if level in ("WARNING", "ERROR", "FATAL") \
+                and not entry.get("traceId"):
+            # error lines minted inside a traced request carry its id,
+            # so a log line is greppable against the captured span tree
+            from minio_tpu.utils import tracing
+
+            tid = tracing.trace_id()
+            if tid:
+                entry["traceId"] = tid
         if console:
             with self._mu:
                 self.ring.append(entry)

@@ -291,6 +291,23 @@ class TestAdmissionControl:
             assert b"<Code>SlowDown</Code>" in r.body
             assert r.headers.get("Retry-After") == "1"
             assert dt < 1.0, f"shed took {dt:.2f}s"
+            # ISSUE 12: a shed response still carries a trace id so a
+            # user's 503 report is greppable, and the shed trace is
+            # tail-captured as an error in the slow/error store
+            tid = r.headers.get("x-minio-tpu-trace-id")
+            assert tid, "503 shed lost its x-minio-tpu-trace-id"
+            from minio_tpu.utils import tracing
+
+            deadline_t = time.time() + 3.0
+            doc = tracing.store.get(tid)
+            while doc is None and time.time() < deadline_t:
+                time.sleep(0.02)
+                doc = tracing.store.get(tid)
+            assert doc is not None, "shed trace not tail-captured"
+            assert doc["reason"] == "error" and doc["status"] == 503
+            shed_spans = [s for s in doc["spans"]
+                          if s["name"] == "admission" and s.get("shed")]
+            assert shed_spans, "shed admission span missing"
             for t in holders:
                 t.join(15)
         finally:

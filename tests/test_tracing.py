@@ -1,0 +1,597 @@
+"""ISSUE 12: the end-to-end request tracing plane (utils/tracing.py).
+
+Pins the acceptance surface: byte identity with tracing on/off, exact
+span-tree shape across an RPC hop / a workers-on + batcher-on PUT / a
+cross-node GET, honest tail-based capture + eviction, a bounded store
+under a burst of distinct traces, and zero thread leaks (the plane
+spawns none).
+"""
+
+import io
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from minio_tpu.utils import tracing
+
+from .s3_harness import S3TestServer
+
+
+@pytest.fixture(autouse=True)
+def _clean_store():
+    tracing.store.clear()
+    yield
+    tracing.store.clear()
+
+
+def _by_name(spans, name):
+    return [s for s in spans if s["name"] == name]
+
+
+def _wait_doc(tid, timeout=3.0):
+    """Streamed responses complete client-side slightly before the
+    handler's finally captures the trace — poll briefly."""
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        doc = tracing.store.get(tid)
+        if doc is not None:
+            return doc
+        time.sleep(0.02)
+    return None
+
+
+def _tree_ok(doc):
+    """Every span's parent resolves inside the doc (except roots) and
+    there is exactly ONE root — a single connected tree."""
+    ids = {s["id"] for s in doc["spans"]}
+    roots = [s for s in doc["spans"] if s.get("parent") not in ids]
+    return roots
+
+
+# ---------------------------------------------------------------- unit
+class TestSpanPlane:
+    def test_off_is_total_noop(self, monkeypatch):
+        monkeypatch.setenv("MINIO_TPU_TRACE", "0")
+        assert tracing.start("x") is None
+        assert tracing.current() is None
+        assert tracing.to_wire() is None
+        with tracing.span("a") as sp:
+            assert sp is None
+        tracing.event("nothing")  # must not raise
+
+    def test_tree_shape_and_capture(self, monkeypatch):
+        monkeypatch.setenv("MINIO_TPU_TRACE_SLOW_MS", "0")  # capture all
+        root = tracing.start("req", method="GET")
+        token = tracing.install(root)
+        try:
+            with tracing.span("a", k=1) as sa:
+                with tracing.span("b") as sb:
+                    assert sb.parent_id == sa.span_id
+                tracing.event("mark", n=7)
+        finally:
+            tracing.reset(token)
+        doc = tracing.finish(root, status=200)
+        assert doc is not None and doc["reason"] == "slow"
+        # exact shape: root <- a <- (b, mark)
+        spans = doc["spans"]
+        assert len(spans) == 4
+        (a,) = _by_name(spans, "a")
+        (b,) = _by_name(spans, "b")
+        (mark,) = _by_name(spans, "mark")
+        (r,) = _by_name(spans, "req")
+        assert a["parent"] == r["id"]
+        assert b["parent"] == a["id"]
+        assert mark["parent"] == a["id"]
+        assert mark["n"] == 7 and a["k"] == 1
+        assert len(_tree_ok(doc)) == 1
+
+    def test_tail_rules(self, monkeypatch):
+        monkeypatch.setenv("MINIO_TPU_TRACE_SLOW_MS", "60000")
+        monkeypatch.setenv("MINIO_TPU_TRACE_SAMPLE", "0")
+        # fast + ok + unsampled: dropped
+        root = tracing.start("fast")
+        assert tracing.finish(root, status=200) is None
+        # error: always kept
+        root = tracing.start("boom")
+        doc = tracing.finish(root, status=503, error=True)
+        assert doc["reason"] == "error"
+        # slow: always kept
+        root = tracing.start("slowpoke")
+        doc = tracing.finish(root, status=200, duration=120.0)
+        assert doc["reason"] == "slow"
+        # head sampling keeps fast+ok traces
+        monkeypatch.setenv("MINIO_TPU_TRACE_SAMPLE", "1")
+        root = tracing.start("lucky")
+        doc = tracing.finish(root, status=200)
+        assert doc["reason"] == "sampled"
+
+    def test_store_bounded_and_evicts_honestly(self):
+        st = tracing.TraceStore(max_entries=4)
+        for i in range(10):
+            st.add({"traceId": f"t{i}", "reason": "slow", "spans": []})
+        s = st.stats()
+        assert s["entries"] == 4
+        assert s["evictions"] == 6
+        assert s["captures"] == 10
+        # FIFO: the newest 4 survive
+        kept = {d["traceId"] for d in st.snapshot(n=100)}
+        assert kept == {"t6", "t7", "t8", "t9"}
+
+    def test_span_cap_counts_drops(self, monkeypatch):
+        monkeypatch.setenv("MINIO_TPU_TRACE_SLOW_MS", "0")
+        before = tracing.stats["spans_dropped"]
+        root = tracing.start("big")
+        token = tracing.install(root)
+        try:
+            for i in range(tracing.MAX_SPANS_PER_TRACE + 50):
+                tracing.event("e", i=i)
+        finally:
+            tracing.reset(token)
+        doc = tracing.finish(root, status=200)
+        assert len(doc["spans"]) == tracing.MAX_SPANS_PER_TRACE + 1  # +root
+        assert tracing.stats["spans_dropped"] - before == 50
+
+    def test_burst_of_distinct_traces_stays_bounded(self, monkeypatch):
+        monkeypatch.setenv("MINIO_TPU_TRACE_SLOW_MS", "0")
+        monkeypatch.setenv("MINIO_TPU_TRACE_STORE_MAX", "16")
+        for _ in range(300):
+            root = tracing.start("burst")
+            tracing.finish(root, status=200)
+        s = tracing.store.stats()
+        assert s["entries"] <= 16
+        assert s["evictions"] >= 284
+
+    def test_no_threads_spawned(self, monkeypatch):
+        monkeypatch.setenv("MINIO_TPU_TRACE_SLOW_MS", "0")
+        before = threading.active_count()
+        for _ in range(50):
+            root = tracing.start("t")
+            token = tracing.install(root)
+            with tracing.span("inner"):
+                pass
+            tracing.reset(token)
+            tracing.finish(root, status=200)
+        assert threading.active_count() == before
+
+    def test_wire_roundtrip_joins_open_trace(self, monkeypatch):
+        monkeypatch.setenv("MINIO_TPU_TRACE_SLOW_MS", "0")
+        root = tracing.start("origin")
+        token = tracing.install(root)
+        wire = tracing.to_wire()
+        tracing.reset(token)
+        assert wire.startswith(root.trace.trace_id + ":")
+
+        # a continuation in ANOTHER thread/context joins the open trace
+        def server_side():
+            with tracing.continuation(wire, "rpc.server.op") as sp:
+                assert sp is not None
+                assert sp.trace is root.trace  # joined, not a fragment
+                tracing.event("inner.work")
+
+        t = threading.Thread(target=server_side)
+        t.start()
+        t.join(5)
+        doc = tracing.finish(root, status=200)
+        (srv,) = _by_name(doc["spans"], "rpc.server.op")
+        (inner,) = _by_name(doc["spans"], "inner.work")
+        assert srv["parent"] == root.span_id
+        assert inner["parent"] == srv["id"]
+
+    def test_wire_fragment_captured_separately(self, monkeypatch):
+        monkeypatch.setenv("MINIO_TPU_TRACE_SLOW_MS", "0")
+        wire = "feedfacefeedface:abc:1"  # origin lives "elsewhere"
+        with tracing.continuation(wire, "rpc.server.op"):
+            tracing.event("remote.work")
+        doc = tracing.store.get("feedfacefeedface")
+        assert doc is not None and doc["fragment"] is True
+        assert len(_by_name(doc["spans"], "remote.work")) == 1
+
+    def test_graft_reparents_fragment(self, monkeypatch):
+        monkeypatch.setenv("MINIO_TPU_TRACE_SLOW_MS", "0")
+        root = tracing.start("front")
+        token = tracing.install(root)
+        sp = tracing.begin("mp.job", worker=0)
+        exported = {"spans": [
+            {"id": "w1", "parent": "gone", "name": "mp.put_data",
+             "t0": 0.0, "dur": 0.1},
+            {"id": "w2", "parent": "w1", "name": "mp.encode",
+             "t0": 0.01, "dur": 0.05},
+        ], "stages": {"encode": 0.05}}
+        tracing.graft(exported, sp)
+        sp.finish()
+        tracing.reset(token)
+        doc = tracing.finish(root, status=200)
+        (job,) = _by_name(doc["spans"], "mp.job")
+        (w1,) = _by_name(doc["spans"], "mp.put_data")
+        (w2,) = _by_name(doc["spans"], "mp.encode")
+        assert w1["parent"] == job["id"]     # fragment root re-parented
+        assert w2["parent"] == "w1"          # internal links preserved
+        assert doc["stages"]["encode"] == pytest.approx(0.05)
+        assert len(_tree_ok(doc)) == 1
+
+
+# ------------------------------------------------------------- RPC hop
+class TestRpcHop:
+    def test_span_tree_across_rpc(self, monkeypatch):
+        """Client span + server continuation + handler work = one tree
+        with exact parent/child links (loopback peer: the continuation
+        joins the open trace)."""
+        import asyncio
+
+        from aiohttp import web
+
+        from minio_tpu.distributed.rpc import RpcClient, RpcRouter
+
+        monkeypatch.setenv("MINIO_TPU_TRACE_SLOW_MS", "0")
+        router = RpcRouter("sekrit")
+
+        def handler(args, body):
+            tracing.event("handler.work", arg=args.get("x"))
+            return {"ok": True}
+
+        router.register("test.op", handler)
+        app = web.Application()
+        router.mount(app)
+
+        loop = asyncio.new_event_loop()
+        started = threading.Event()
+        state = {}
+
+        def serve():
+            asyncio.set_event_loop(loop)
+
+            async def start():
+                runner = web.AppRunner(app)
+                await runner.setup()
+                site = web.TCPSite(runner, "127.0.0.1", 0)
+                await site.start()
+                state["port"] = runner.addresses[0][1]
+                state["runner"] = runner
+                started.set()
+
+            loop.run_until_complete(start())
+            loop.run_forever()
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        assert started.wait(10)
+        try:
+            client = RpcClient("127.0.0.1", state["port"], "sekrit")
+            root = tracing.start("request")
+            token = tracing.install(root)
+            try:
+                out = client.call("test.op", {"x": 42})
+            finally:
+                tracing.reset(token)
+            assert out == {"ok": True}
+            doc = tracing.finish(root, status=200)
+            spans = doc["spans"]
+            (cli,) = _by_name(spans, "rpc.test.op")
+            (srv,) = _by_name(spans, "rpc.server.test.op")
+            (work,) = _by_name(spans, "handler.work")
+            (r,) = _by_name(spans, "request")
+            assert cli["parent"] == r["id"]
+            assert srv["parent"] == cli["id"]
+            assert work["parent"] == srv["id"]
+            assert work["arg"] == 42
+            assert len(_tree_ok(doc)) == 1
+            assert len(spans) == 4  # count-exact: nothing else recorded
+        finally:
+            async def stop():
+                await state["runner"].cleanup()
+
+            asyncio.run_coroutine_threadsafe(stop(), loop).result(10)
+            loop.call_soon_threadsafe(loop.stop)
+            t.join(10)
+            router.close()
+
+
+# -------------------------------------------------------- HTTP surface
+class TestHttpTracing:
+    def test_trace_id_header_and_byte_identity_on_off(self, tmp_path,
+                                                      monkeypatch):
+        """Every response carries x-minio-tpu-trace-id when the plane is
+        on; with MINIO_TPU_TRACE=0 the header is absent and the payload
+        bytes are identical."""
+        srv = S3TestServer(str(tmp_path / "s"))
+        data = np.random.default_rng(7).integers(
+            0, 256, 300_000, dtype=np.uint8).tobytes()
+        try:
+            assert srv.request("PUT", "/trcb").status == 200
+            r = srv.request("PUT", "/trcb/obj", data=data)
+            assert r.status == 200
+            tid = r.headers.get("x-minio-tpu-trace-id")
+            assert tid, "PUT response lost its trace id"
+
+            r_on = srv.request("GET", "/trcb/obj")
+            assert r_on.status == 200
+            assert r_on.headers.get("x-minio-tpu-trace-id")
+            assert r_on.body == data
+
+            monkeypatch.setenv("MINIO_TPU_TRACE", "0")
+            r_off = srv.request("GET", "/trcb/obj")
+            assert r_off.status == 200
+            assert "x-minio-tpu-trace-id" not in r_off.headers
+            assert r_off.body == data  # byte identity, tracing off
+        finally:
+            srv.close()
+
+    def test_slow_get_captured_with_stage_attribution(self, tmp_path,
+                                                      monkeypatch):
+        """A (threshold-0) GET lands in the store: root -> admission +
+        per-drive op spans + per-request stage seconds."""
+        from minio_tpu.erasure.sets import ErasureServerPools, ErasureSets
+        from minio_tpu.storage.instrumented import instrument
+        from minio_tpu.storage.local import LocalStorage
+
+        monkeypatch.setenv("MINIO_TPU_TRACE_SLOW_MS", "0")
+        disks = instrument([LocalStorage(str(tmp_path / f"d{i}"))
+                            for i in range(4)])
+        pools = ErasureServerPools([ErasureSets(disks)])
+        srv = S3TestServer(str(tmp_path / "s"), pools=pools)
+        data = np.random.default_rng(8).integers(
+            0, 256, 400_000, dtype=np.uint8).tobytes()
+        try:
+            srv.request("PUT", "/slowb")
+            srv.request("PUT", "/slowb/obj", data=data)
+            r = srv.request("GET", "/slowb/obj")
+            assert r.status == 200
+            tid = r.headers["x-minio-tpu-trace-id"]
+            doc = _wait_doc(tid)
+            assert doc is not None
+            assert doc["name"] == "get_object"
+            spans = doc["spans"]
+            (adm,) = _by_name(spans, "admission")
+            (root,) = _by_name(spans, "get_object")
+            assert adm["parent"] == root["id"]
+            drive_ops = [s for s in spans
+                         if s["name"].startswith("drive.")]
+            assert drive_ops, "no per-drive op spans in the GET tree"
+            assert all(d.get("drive") for d in drive_ops)
+            # stagestats folds attribute to THIS trace
+            assert doc["stages"].get("decode", 0) > 0
+            assert doc["stages"].get("respond", 0) > 0
+            assert len(_tree_ok(doc)) == 1
+        finally:
+            srv.close()
+
+    def test_admin_trace_slow_endpoint(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MINIO_TPU_TRACE_SLOW_MS", "0")
+        srv = S3TestServer(str(tmp_path / "s"))
+        try:
+            srv.request("PUT", "/admb")
+            r = srv.request("GET", "/minio/admin/v3/trace/slow",
+                            service="s3")
+            assert r.status == 200
+            out = json.loads(r.body)
+            assert out["enabled"] is True
+            assert out["traces"], "no captured traces served"
+            first = out["traces"][0]
+            assert first["tree"], "span tree not assembled"
+            # a 404 is an error… but 4xx is client-side: only 5xx/503
+            # count as error captures; the bucket PUT above was slow-0
+            assert any(t["name"] == "make_bucket"
+                       for t in out["traces"])
+            # ?id= fetch round-trips one doc
+            tid = first["traceId"]
+            r2 = srv.request("GET", "/minio/admin/v3/trace/slow",
+                             query=[("id", tid)])
+            assert r2.status == 200
+            assert json.loads(r2.body)["traceId"] == tid
+        finally:
+            srv.close()
+
+    def test_error_request_tail_captured(self, tmp_path, monkeypatch):
+        """5xx responses are ALWAYS captured regardless of thresholds,
+        and the error log line carries the trace id."""
+        monkeypatch.setenv("MINIO_TPU_TRACE_SLOW_MS", "60000")
+        monkeypatch.setenv("MINIO_TPU_TRACE_SAMPLE", "0")
+        srv = S3TestServer(str(tmp_path / "s"))
+        try:
+            srv.request("PUT", "/errb")
+            # break the drives under the object layer -> 5xx on GET
+            import shutil
+
+            for d in srv.pools.pools[0].sets[0].disks:
+                shutil.rmtree(d.root, ignore_errors=True)
+            r = srv.request("GET", "/errb/missing-now")
+            assert r.status >= 500
+            tid = r.headers.get("x-minio-tpu-trace-id")
+            assert tid
+            doc = _wait_doc(tid)
+            assert doc is not None and doc["reason"] == "error"
+            assert doc["status"] >= 500
+        finally:
+            srv.close()
+
+    def test_hotcache_outcomes_in_trace(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MINIO_TPU_TRACE_SLOW_MS", "0")
+        monkeypatch.setenv("MINIO_TPU_HOTCACHE_BYTES", str(8 << 20))
+        monkeypatch.setenv("MINIO_TPU_HOTCACHE_MIN_HITS", "1")
+        srv = S3TestServer(str(tmp_path / "s"))
+        data = b"h" * 8192
+        try:
+            srv.request("PUT", "/hotb")
+            srv.request("PUT", "/hotb/obj", data=data)
+            r1 = srv.request("GET", "/hotb/obj")  # miss -> fill leader
+            assert r1.body == data
+            d1 = _wait_doc(r1.headers["x-minio-tpu-trace-id"])
+            hc1 = _by_name(d1["spans"], "hotcache")
+            assert any(s.get("outcome") == "fill-leader" for s in hc1)
+            r2 = srv.request("GET", "/hotb/obj")  # now a RAM hit
+            assert r2.body == data
+            d2 = _wait_doc(r2.headers["x-minio-tpu-trace-id"])
+            # the RAM-hit verdict rides the ROOT span's tags (annotate
+            # — the hot path records no extra span)
+            (root2,) = _by_name(d2["spans"], "get_object")
+            assert root2.get("hotcache") == "hit"
+        finally:
+            srv.close()
+
+
+# ------------------------------------------- workers-on + batcher-on PUT
+class TestWorkerBatcherPut:
+    def test_put_single_tree_spanning_worker_and_tick(self, tmp_path,
+                                                      monkeypatch):
+        """Acceptance: a workers-on + batcher-on PUT yields ONE trace
+        tree HTTP -> admission -> mp.job -> mp.put_data (worker
+        process) -> mp.encode -> batcher.tick, with parent/child links
+        pinned count-exact."""
+        from minio_tpu.parallel import workers as workers_mod
+
+        if workers_mod.worker_count() == 0:
+            monkeypatch.setenv("MINIO_TPU_WORKERS", "2")
+            if workers_mod.worker_count() == 0:
+                pytest.skip("worker plane unavailable (non-TSO machine)")
+        monkeypatch.setenv("MINIO_TPU_WORKERS", "2")
+        monkeypatch.setenv("MINIO_TPU_BATCHER", "1")
+        monkeypatch.setenv("MINIO_TPU_TRACE_SLOW_MS", "0")
+        # fresh plane so the spawned children inherit the batcher gate
+        workers_mod.shutdown_plane()
+        srv = S3TestServer(str(tmp_path / "s"), n_drives=6)
+        data = np.random.default_rng(9).integers(
+            0, 256, 2_000_000, dtype=np.uint8).tobytes()
+        try:
+            srv.request("PUT", "/wrkb")
+            r = srv.request("PUT", "/wrkb/big", data=data)
+            assert r.status == 200
+            tid = r.headers["x-minio-tpu-trace-id"]
+            doc = _wait_doc(tid)
+            assert doc is not None
+            spans = doc["spans"]
+            by_id = {s["id"]: s for s in spans}
+            (root,) = _by_name(spans, "put_object")
+            (adm,) = _by_name(spans, "admission")
+            assert adm["parent"] == root["id"]
+
+            # exactly 2 io-worker jobs + 1 hash job, all under the root
+            put_jobs = [s for s in _by_name(spans, "mp.job")
+                        if s.get("op") == "put_data"]
+            hash_jobs = [s for s in _by_name(spans, "mp.job")
+                         if s.get("op") == "hash"]
+            commit_jobs = [s for s in _by_name(spans, "mp.job")
+                           if s.get("op") == "commit"]
+            assert len(put_jobs) == 2
+            assert len(hash_jobs) == 1
+            assert len(commit_jobs) == 2
+            for j in put_jobs + hash_jobs + commit_jobs:
+                assert j["parent"] == root["id"]
+
+            # each io job grafts its worker fragment: mp.put_data under
+            # mp.job, mp.encode under mp.put_data
+            frags = _by_name(spans, "mp.put_data")
+            assert len(frags) == 2
+            assert {by_id[f["parent"]]["name"] for f in frags} \
+                == {"mp.job"}
+            encodes = _by_name(spans, "mp.encode")
+            assert len(encodes) == 2
+            assert {by_id[e["parent"]]["name"] for e in encodes} \
+                == {"mp.put_data"}
+
+            # the batcher tick recorded itself under the PARITY-owning
+            # worker's encode span (the data-only worker never encodes)
+            ticks = _by_name(spans, "batcher.tick")
+            assert ticks, "no batcher.tick span in the PUT tree"
+            for tk in ticks:
+                assert by_id[tk["parent"]]["name"] == "mp.encode"
+                assert tk["items"] >= 1 and "tick" in tk
+
+            # single connected tree + per-request stage attribution
+            assert len(_tree_ok(doc)) == 1
+            assert doc["stages"].get("write", 0) > 0
+            assert doc["stages"].get("etag", 0) > 0
+        finally:
+            srv.close()
+            workers_mod.shutdown_plane()
+
+
+# ---------------------------------------------------------- cross-node
+class TestCrossNodeGet:
+    def test_slow_cross_node_get_single_tree(self, tmp_path, monkeypatch):
+        """Acceptance: a cross-node GET yields ONE trace tree spanning
+        HTTP -> admission -> per-drive op -> RPC hop (client span +
+        server-side continuation), with parent/child links pinned.  Both
+        nodes live in this process, so the loopback continuation joins
+        the origin trace directly — the single-tree case."""
+        import http.client
+        import socket
+
+        from minio_tpu.server import sigv4
+
+        from .test_distributed import NodeHarness
+
+        from minio_tpu.distributed.node import ClusterNode
+
+        monkeypatch.setenv("MINIO_TPU_TRACE_SLOW_MS", "0")
+        ports = []
+        for _ in range(2):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+            s.close()
+        p1, p2 = ports
+        eps = [f"http://127.0.0.1:{p}{tmp_path}/n{n}/d{i}"
+               for n, p in ((1, p1), (2, p2)) for i in (1, 2, 3)]
+        n1 = ClusterNode(eps, my_address=f"127.0.0.1:{p1}",
+                         start_services=False)
+        n2 = ClusterNode(eps, my_address=f"127.0.0.1:{p2}",
+                         start_services=False)
+        h1, h2 = NodeHarness(n1, p1), NodeHarness(n2, p2)
+        try:
+            data = np.random.default_rng(3).integers(
+                0, 256, 600_000, dtype=np.uint8).tobytes()
+            n1.pools.make_bucket("xbkt")
+            n1.pools.put_object("xbkt", "obj", io.BytesIO(data), len(data))
+
+            host = f"127.0.0.1:{p1}"
+            headers = sigv4.sign_request(
+                "GET", "/xbkt/obj", [], {"host": host}, b"",
+                "minioadmin", "minioadmin")
+            conn = http.client.HTTPConnection("127.0.0.1", p1, timeout=30)
+            conn.request("GET", "/xbkt/obj", headers=headers)
+            resp = conn.getresponse()
+            body = resp.read()
+            tid = resp.getheader("x-minio-tpu-trace-id")
+            conn.close()
+            assert resp.status == 200 and body == data
+            assert tid
+
+            doc = _wait_doc(tid)
+            assert doc is not None
+            spans = doc["spans"]
+            by_id = {s["id"]: s for s in spans}
+            (root,) = _by_name(spans, "get_object")
+            (adm,) = _by_name(spans, "admission")
+            assert adm["parent"] == root["id"]
+
+            # per-drive op spans (instrumented local + remote drives)
+            drive_ops = [s for s in spans if s["name"].startswith("drive.")]
+            assert drive_ops, "no per-drive op spans"
+
+            # the RPC hop: client spans with a server-side continuation
+            # CHILD recorded by node2's handler thread into the SAME
+            # trace (loopback join — the single-tree property)
+            cli = [s for s in spans if s["name"].startswith("rpc.")
+                   and not s["name"].startswith("rpc.server.")]
+            srv_side = [s for s in spans
+                        if s["name"].startswith("rpc.server.")]
+            assert cli, "no client-side RPC spans in the GET tree"
+            assert srv_side, "no server-side RPC continuations joined"
+            for s in srv_side:
+                parent = by_id.get(s["parent"])
+                assert parent is not None \
+                    and parent["name"].startswith("rpc."), \
+                    f"continuation {s['name']} not under its client span"
+
+            # per-request erasure stage attribution rode along
+            assert doc["stages"].get("decode", 0) > 0
+            # one connected tree, exactly one root
+            assert len(_tree_ok(doc)) == 1
+        finally:
+            n1.close()
+            n2.close()
+            h1.close()
+            h2.close()
