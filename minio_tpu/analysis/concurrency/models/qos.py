@@ -1,0 +1,325 @@
+"""Model: the per-tenant QoS admission scheduler's admit/release/
+reweight/shed protocol (server/qos.py, ISSUE 13) — written BEFORE the
+implementation, per the PR 10 convention (protocol work lands with a
+model change first).
+
+The plane replaces the single API semaphore with weighted
+deficit-round-robin admission: requests classify into tenants, each
+tenant owns a bounded FIFO queue, a deficit counter and a concurrency
+cap; a fixed pool of global slots is granted by a dispatch sweep that
+runs synchronously on every release (the implementation's event-loop
+`_dispatch`).  A request arriving at a FULL tenant queue is shed — that
+tenant 503s while every other tenant keeps flowing.  A queued request
+whose deadline budget expires leaves the queue as a DEADLINE shed (the
+one legal not-full departure, modelled as a dequeue).
+
+DRR discipline, modelled exactly as implemented (unit request cost):
+
+* a dispatch visit tops a servable tenant's deficit up by its weight
+  ONCE per visit, and only when the tenant cannot already afford an
+  admission (deficit < 1) — quantum is never banked on top of
+  spendable credit, which bounds the counter by the weight;
+* admissions spend 1 deficit each and stop at the global-slot pool,
+  the tenant cap, an empty queue, or an exhausted deficit;
+* a queue that empties (by admission or expiry) forfeits its residual
+  deficit (classic DRR reset: credit must not accumulate across idle
+  periods);
+* an admin reweight CLAMPS the deficit to the new weight so a lowered
+  weight cannot ride on stale credit.
+
+Invariants:
+
+* ``cap-respected``          — per-tenant inflight never exceeds the
+                               tenant cap, total inflight never exceeds
+                               the global slot pool, and the pool's
+                               used-counter stays consistent.
+* ``deficit-conservation``   — 0 <= deficit <= weight at every state,
+                               and an empty queue holds zero deficit.
+* ``shed-only-when-full``    — an arrival is shed only when its
+                               tenant's queue stood at the limit.
+* ``no-starvation``          — terminal: a quiescent system has no
+                               request left queued (every submitted
+                               request was admitted or shed); a
+                               nonempty positive-weight queue the
+                               rotation can never reach surfaces here
+                               (or as a deadlock).
+
+Deadlock freedom: quiescence additionally requires zero inflight — a
+release protocol that strands grants would surface as a wedge.
+
+Every invariant is proven live by a seeded mutation (tier-1 pins the
+matrix in tests/test_modelcheck.py): rotation-skips-tenant,
+release-skips-dispatch, shed-below-limit, admit-ignores-cap,
+deficit-banked-while-empty, reweight-keeps-stale-deficit.
+"""
+
+from __future__ import annotations
+
+from ..modelcheck import Model, register
+
+#: per-tenant state vector indices
+W, CAP, INFLIGHT, QUEUE, DEFICIT, ADMITTED, SHED, ARRIVALS = range(8)
+
+
+def _dispatch(s, skip: set | None = None, ignore_cap: bool = False,
+              banked: bool = False) -> None:
+    """The release-time DRR sweep.  Mutations perturb it via kwargs so
+    the base discipline stays in one place."""
+    tens = s["tens"]
+    order = [t for t in s["rr"] if not (skip and t in skip)]
+    if not order:
+        return
+    progress = True
+    while progress and s["slots_used"] < s["slots"]:
+        progress = False
+        for off in range(len(order)):
+            t = order[(s["rr_i"] + off) % len(order)]
+            tv = tens[t]
+            servable = (tv[QUEUE] > 0 and s["slots_used"] < s["slots"]
+                        and (ignore_cap or tv[INFLIGHT] < tv[CAP]))
+            if servable:
+                # quantum: once per visit; banked (mutation) tops up
+                # unconditionally, the base only when credit ran out
+                if banked or tv[DEFICIT] < 1:
+                    tv[DEFICIT] += tv[W]
+                while tv[QUEUE] > 0 and tv[DEFICIT] >= 1 \
+                        and s["slots_used"] < s["slots"] \
+                        and (ignore_cap or tv[INFLIGHT] < tv[CAP]):
+                    tv[QUEUE] -= 1
+                    tv[DEFICIT] -= 1
+                    tv[INFLIGHT] += 1
+                    tv[ADMITTED] += 1
+                    s["slots_used"] += 1
+                    progress = True
+            if tv[QUEUE] == 0 and not banked:
+                tv[DEFICIT] = 0  # no credit across idle periods
+        s["rr_i"] = (s["rr_i"] + 1) % len(order)
+
+
+def build(deep: bool = False) -> Model:
+    arrivals = 4 if deep else 3
+    # tenant a: weight 1 (the quiet tenant a hot neighbor must not
+    # starve); tenant b: weight 3 (the heavy tenant an admin may
+    # reweight down mid-flight).  Caps of 1 against a pool of 2 make
+    # the per-tenant cap BIND (a capless model never exercises it).
+    init = {
+        "slots": 2,
+        "slots_used": 0,
+        "rr": ["a", "b"],
+        "rr_i": 0,
+        "limit": 2,            # per-tenant queue bound (shed threshold)
+        # tenant -> [weight, cap, inflight, queue, deficit, admitted,
+        #            shed, arrivals_left]
+        "tens": {"a": [1, 1, 0, 0, 0, 0, 0, arrivals],
+                 "b": [3, 1, 0, 0, 0, 0, 0, arrivals]},
+        "bad_shed": False,     # a shed fired while the queue was not full
+        "reweights_left": 1,
+        # at most one queued request per tenant carries a finite budget
+        # that can expire: expiry must stay an EXIT for individual
+        # requests, not an unbounded drain that could mask a starved
+        # queue at quiescence
+        "expiries_left": {"a": 1, "b": 1},
+    }
+    m = Model("qos", init,
+              "per-tenant QoS DRR admit/release/reweight/shed protocol")
+
+    # -- arrivals -----------------------------------------------------------
+    for t in ("a", "b"):
+        def can_arrive(s, t=t) -> bool:
+            return s["tens"][t][ARRIVALS] > 0
+
+        def do_arrive(s, t=t) -> None:
+            tv = s["tens"][t]
+            tv[ARRIVALS] -= 1
+            if s["slots_used"] < s["slots"] and tv[INFLIGHT] < tv[CAP] \
+                    and tv[QUEUE] == 0:
+                # fast path: idle plane, no queue — admit directly (the
+                # implementation's uncontended no-waiter branch)
+                tv[INFLIGHT] += 1
+                tv[ADMITTED] += 1
+                s["slots_used"] += 1
+            elif tv[QUEUE] >= s["limit"]:
+                # full tenant queue: shed THIS tenant, others unaffected
+                if tv[QUEUE] < s["limit"]:
+                    s["bad_shed"] = True
+                tv[SHED] += 1
+            else:
+                tv[QUEUE] += 1
+
+        m.action(f"{t}_arrive", can_arrive)(do_arrive)
+
+        # a queued request's budget expires: it leaves the queue as a
+        # DEADLINE shed — a dequeue, not a shed-at-arrival, so it can
+        # never trip shed-only-when-full; an emptied queue forfeits its
+        # deficit exactly like a drain-by-admission
+        def can_expire(s, t=t) -> bool:
+            return s["tens"][t][QUEUE] > 0 and s["expiries_left"][t] > 0
+
+        def do_expire(s, t=t) -> None:
+            tv = s["tens"][t]
+            s["expiries_left"][t] -= 1
+            tv[QUEUE] -= 1
+            tv[SHED] += 1
+            if tv[QUEUE] == 0:
+                tv[DEFICIT] = 0
+
+        m.action(f"{t}_budget_expires", can_expire)(do_expire)
+
+        # -- release (request finishes; dispatch sweep runs) ----------------
+        def can_release(s, t=t) -> bool:
+            return s["tens"][t][INFLIGHT] > 0
+
+        def do_release(s, t=t) -> None:
+            tv = s["tens"][t]
+            tv[INFLIGHT] -= 1
+            s["slots_used"] -= 1
+            _dispatch(s)
+
+        m.action(f"{t}_release", can_release)(do_release)
+
+    # -- admin reweight mid-flight ------------------------------------------
+    def can_reweight(s) -> bool:
+        return s["reweights_left"] > 0
+
+    def do_reweight(s) -> None:
+        # admin cuts the heavy tenant's weight 3 -> 1; stale deficit
+        # must be clamped so the old weight's credit cannot be spent
+        s["reweights_left"] -= 1
+        tv = s["tens"]["b"]
+        tv[W] = 1
+        tv[DEFICIT] = min(tv[DEFICIT], tv[W])
+
+    m.action("reweight_b", can_reweight)(do_reweight)
+
+    # -- invariants ---------------------------------------------------------
+    @m.invariant("cap-respected")
+    def cap_respected(s) -> bool:
+        total = sum(tv[INFLIGHT] for tv in s["tens"].values())
+        return total <= s["slots"] and total == s["slots_used"] and all(
+            tv[INFLIGHT] <= tv[CAP] for tv in s["tens"].values())
+
+    @m.invariant("deficit-conservation")
+    def deficit_conservation(s) -> bool:
+        return all(
+            0 <= tv[DEFICIT] <= tv[W]
+            and (tv[QUEUE] > 0 or tv[DEFICIT] == 0)
+            for tv in s["tens"].values())
+
+    @m.invariant("shed-only-when-full")
+    def shed_only_when_full(s) -> bool:
+        return not s["bad_shed"]
+
+    @m.terminal("no-starvation")
+    def no_starvation(s) -> bool:
+        """Quiescence: no request left queued — every arrival was
+        admitted or shed.  A rotation that can never reach a nonempty
+        positive-weight queue fails here (or as a deadlock)."""
+        return all(tv[QUEUE] == 0 for tv in s["tens"].values())
+
+    # a quiescent state must also have drained every grant: stranded
+    # inflight (a release that never fires) is a wedge
+    m.done = lambda s: all(
+        tv[QUEUE] == 0 and tv[INFLIGHT] == 0
+        for tv in s["tens"].values())
+
+    # -- seeded mutations ---------------------------------------------------
+    @m.mutation("rotation-skips-tenant",
+                "the dispatch sweep never visits tenant a — its queued "
+                "requests starve while tenant b keeps flowing (the "
+                "noisy-neighbor failure the plane exists to prevent)")
+    def rotation_skips_tenant(mut: Model) -> None:
+        def release_skip_a(s, t) -> None:
+            tv = s["tens"][t]
+            tv[INFLIGHT] -= 1
+            s["slots_used"] -= 1
+            _dispatch(s, skip={"a"})
+
+        for t in ("a", "b"):
+            mut.replace_action(f"{t}_release",
+                               effect=lambda s, t=t: release_skip_a(s, t))
+
+    @m.mutation("release-skips-dispatch",
+                "release frees the slot but forgets the dispatch sweep "
+                "— queued requests wait forever on an idle plane")
+    def release_skips_dispatch(mut: Model) -> None:
+        def release_no_dispatch(s, t) -> None:
+            tv = s["tens"][t]
+            tv[INFLIGHT] -= 1
+            s["slots_used"] -= 1
+
+        for t in ("a", "b"):
+            mut.replace_action(
+                f"{t}_release",
+                effect=lambda s, t=t: release_no_dispatch(s, t))
+
+    @m.mutation("shed-below-limit",
+                "arrival sheds one slot early (queue >= limit-1): a "
+                "tenant with spare queue room 503s — isolation turned "
+                "into gratuitous unavailability")
+    def shed_below_limit(mut: Model) -> None:
+        def arrive_early_shed(s, t) -> None:
+            tv = s["tens"][t]
+            tv[ARRIVALS] -= 1
+            if s["slots_used"] < s["slots"] and tv[INFLIGHT] < tv[CAP] \
+                    and tv[QUEUE] == 0:
+                tv[INFLIGHT] += 1
+                tv[ADMITTED] += 1
+                s["slots_used"] += 1
+            elif tv[QUEUE] >= s["limit"] - 1:
+                if tv[QUEUE] < s["limit"]:
+                    s["bad_shed"] = True
+                tv[SHED] += 1
+            else:
+                tv[QUEUE] += 1
+
+        for t in ("a", "b"):
+            mut.replace_action(f"{t}_arrive",
+                               effect=lambda s, t=t: arrive_early_shed(s, t))
+
+    @m.mutation("admit-ignores-cap",
+                "the dispatch sweep ignores the per-tenant concurrency "
+                "cap — one tenant monopolizes the whole slot pool")
+    def admit_ignores_cap(mut: Model) -> None:
+        def release_ignore_cap(s, t) -> None:
+            tv = s["tens"][t]
+            tv[INFLIGHT] -= 1
+            s["slots_used"] -= 1
+            _dispatch(s, ignore_cap=True)
+
+        for t in ("a", "b"):
+            mut.replace_action(
+                f"{t}_release",
+                effect=lambda s, t=t: release_ignore_cap(s, t))
+
+    @m.mutation("deficit-banked-while-empty",
+                "quantum accrues on every visit and survives queue "
+                "drain — an idle tenant banks credit and later bursts "
+                "past its weight share")
+    def deficit_banked(mut: Model) -> None:
+        def release_banked(s, t) -> None:
+            tv = s["tens"][t]
+            tv[INFLIGHT] -= 1
+            s["slots_used"] -= 1
+            _dispatch(s, banked=True)
+
+        for t in ("a", "b"):
+            mut.replace_action(f"{t}_release",
+                               effect=lambda s, t=t: release_banked(s, t))
+
+    @m.mutation("reweight-keeps-stale-deficit",
+                "an admin weight cut leaves the old weight's deficit "
+                "credit spendable — the downweighted tenant keeps its "
+                "former share for a round")
+    def reweight_keeps_stale_deficit(mut: Model) -> None:
+        def reweight_no_clamp(s) -> None:
+            s["reweights_left"] -= 1
+            s["tens"]["b"][W] = 1  # deficit NOT clamped
+
+        mut.replace_action("reweight_b", effect=reweight_no_clamp)
+
+    return m
+
+
+@register("qos")
+def factory(deep: bool = False) -> Model:
+    return build(deep=deep)

@@ -70,6 +70,34 @@ register_subsystem("api", {
            "(duration, e.g. 5s)"),
 ])
 
+register_subsystem("qos", {
+    "enable": "off",
+    "default_weight": "1",
+    "default_max_concurrency": "0",
+    "default_bandwidth": "0",
+    "max_queue": "auto",
+    "tenants": "{}",
+}, [
+    HelpKV("enable",
+           "per-tenant QoS admission plane (weighted deficit-round-"
+           "robin + bandwidth isolation); MINIO_TPU_QOS=1/0 overrides",
+           typ="boolean"),
+    HelpKV("default_weight",
+           "DRR weight of the default tenant class", typ="number"),
+    HelpKV("default_max_concurrency",
+           "per-tenant concurrent-request cap for unlisted tenants "
+           "(0 = no cap)", typ="number"),
+    HelpKV("default_bandwidth",
+           "per-tenant data-plane bytes/sec for unlisted tenants "
+           "(0 = unlimited)", typ="number"),
+    HelpKV("max_queue",
+           "per-tenant admission queue bound before that tenant sheds "
+           "503 (auto = 2x requests_max)", typ="number"),
+    HelpKV("tenants",
+           'JSON tenant rules: {"bucket:<name>"|"key:<access-key>": '
+           '{"weight": w, "max_concurrency": c, "bandwidth": bps}}'),
+], dynamic=True)
+
 register_subsystem("audit_kafka", {
     "enable": "off",
     "brokers": "",

@@ -19,6 +19,7 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import json
+import math
 import os
 import time
 
@@ -155,6 +156,11 @@ class AdminMixin:
                      wrap(self.admin_del_config_kv, "ConfigUpdate"))
         r.add_get(f"{p}/help-config-kv",
                   wrap(self.admin_help_config, "ConfigUpdate"))
+        # per-tenant QoS (ISSUE 13): read live tenant stats / set
+        # weights, caps and bandwidth limits at runtime
+        # (config-persisted through the dynamic `qos` subsystem)
+        r.add_get(f"{p}/qos", wrap(self.admin_qos_get, "ServerInfo"))
+        r.add_put(f"{p}/qos", wrap(self.admin_qos_set, "ConfigUpdate"))
 
     # ---------------------------------------------------------------- auth
     def _admin_wrap(self, fn, op: str):
@@ -406,6 +412,129 @@ class AdminMixin:
         from minio_tpu.config import DYNAMIC
 
         return self._json({"restart": subsys not in DYNAMIC})
+
+    # ------------------------------------------------------ per-tenant QoS
+    async def admin_qos_get(self, request: web.Request, body: bytes):
+        """Effective QoS state: gate, rule set, and per-tenant LIVE
+        stats (queue depth, inflight, admissions, sheds, hot-lane
+        folds, metered bytes, moving-average rates)."""
+        qos = getattr(self, "qos", None)
+        out = {"enabled": qos is not None}
+        if qos is not None:
+            out.update(qos.stats())
+            out["rates"] = qos.rates()
+        else:
+            # plane off: still show what WOULD apply, so an operator
+            # can stage rules before flipping the gate
+            from .qos import QosPlane
+
+            staged = QosPlane(self.max_concurrency)
+            staged.load_config(self.config)
+            out["defaults"] = staged.default_rule.to_dict()
+            out["rules"] = {k: r.to_dict()
+                            for k, r in staged.rules.items()}
+        return self._json(out)
+
+    async def admin_qos_set(self, request: web.Request, body: bytes):
+        """Set tenant weights/caps/bandwidth (and optionally the gate)
+        at runtime: persisted through the dynamic `qos` config
+        subsystem, applied to the live plane without restart.  Partial
+        bodies only touch the provided fields."""
+        from minio_tpu.config import ConfigError
+
+        try:
+            doc = json.loads(body) if body else {}
+            if not isinstance(doc, dict):
+                raise ValueError("body must be a JSON object")
+        except ValueError:
+            raise S3Error("InvalidArgument", "malformed JSON body")
+        kvs: dict[str, str] = {}
+        if "enable" in doc:
+            # strict bool: '"off"'/'"false"' strings are truthy in
+            # Python and would silently flip the gate ON
+            if not isinstance(doc["enable"], bool):
+                raise S3Error("InvalidArgument",
+                              "enable must be a JSON boolean")
+            kvs["enable"] = "on" if doc["enable"] else "off"
+        defaults = doc.get("defaults")
+        if defaults is not None:
+            if not isinstance(defaults, dict):
+                raise S3Error("InvalidArgument",
+                              "defaults must be an object")
+            for field, key in (("weight", "default_weight"),
+                               ("max_concurrency",
+                                "default_max_concurrency"),
+                               ("bandwidth", "default_bandwidth")):
+                if field in defaults:
+                    v = defaults[field]
+                    # bool is an int subclass (true would persist as
+                    # the unparseable "True"), and json.loads accepts
+                    # NaN/Infinity literals (a NaN weight starves the
+                    # tenant: deficit arithmetic never reaches 1.0)
+                    if isinstance(v, bool) \
+                            or not isinstance(v, (int, float)) \
+                            or not math.isfinite(v) or v < 0:
+                        raise S3Error(
+                            "InvalidArgument",
+                            f"defaults.{field} must be a finite "
+                            "number >= 0")
+                    kvs[key] = str(v)
+        if "max_queue" in doc:
+            mq = doc["max_queue"]
+            if mq == "auto":
+                kvs["max_queue"] = "auto"
+            elif isinstance(mq, int) and not isinstance(mq, bool) \
+                    and mq > 0:
+                kvs["max_queue"] = str(mq)
+            else:
+                raise S3Error("InvalidArgument",
+                              'max_queue must be a positive integer '
+                              'or "auto"')
+        tenants = doc.get("tenants")
+        if tenants is not None:
+            if not isinstance(tenants, dict):
+                raise S3Error("InvalidArgument",
+                              "tenants must be an object")
+            for key, rule in tenants.items():
+                if not (key == "default" or key.startswith("bucket:")
+                        or key.startswith("key:")):
+                    raise S3Error(
+                        "InvalidArgument",
+                        f'tenant {key!r}: keys are "bucket:<name>", '
+                        '"key:<access-key>" or "default"')
+                if not isinstance(rule, dict):
+                    raise S3Error("InvalidArgument",
+                                  f"tenant {key!r} rule must be an "
+                                  "object")
+                for field in ("weight", "max_concurrency", "bandwidth"):
+                    if field in rule and (
+                            isinstance(rule[field], bool)
+                            or not isinstance(rule[field], (int, float))
+                            or not math.isfinite(rule[field])
+                            or rule[field] < 0):
+                        raise S3Error(
+                            "InvalidArgument",
+                            f"tenant {key!r}: {field} must be a "
+                            "finite number >= 0")
+                unknown = set(rule) - {"weight", "max_concurrency",
+                                       "bandwidth"}
+                if unknown:
+                    raise S3Error(
+                        "InvalidArgument",
+                        f"tenant {key!r}: unknown fields "
+                        f"{sorted(unknown)}")
+            kvs["tenants"] = json.dumps(tenants, sort_keys=True)
+        if not kvs:
+            raise S3Error("InvalidArgument",
+                          "nothing to set: provide enable/defaults/"
+                          "max_queue/tenants")
+        try:
+            # set_kv persists to the drives and fires the dynamic
+            # apply (S3Server._apply_qos_config) — live, no restart
+            await self._run(self.config.set_kv, "qos", kvs)
+        except ConfigError as e:
+            raise S3Error("InvalidArgument", str(e))
+        return await self.admin_qos_get(request, b"")
 
     async def admin_del_config_kv(self, request: web.Request, body: bytes):
         from minio_tpu.config import ConfigError
@@ -768,6 +897,11 @@ class AdminMixin:
                          for k, v in ec.backend_stats.items()},
             "deviceProbe": ec.probe_verdicts(),
         }
+        # per-tenant QoS live stats (ISSUE 13): the health/admin view
+        # of who is queued, admitted, shed and throttled right now
+        qos = getattr(self, "qos", None)
+        if qos is not None:
+            info["qos"] = qos.stats()
         # per-server fan-in over the RPC plane (reference madmin
         # InfoMessage.Servers via peer-rest ServerInfo,
         # cmd/peer-rest-client.go:104); offline peers are reported as

@@ -43,6 +43,7 @@ from minio_tpu.utils.logger import log
 from minio_tpu.utils.pubsub import PubSub
 from .admin import AdminMixin
 from .metrics import MetricsMixin
+from .qos import QosPlane, TenantQueueFull
 from .sse_handlers import SSEMixin, load_kms
 from .zip_extract import ZipExtractMixin
 
@@ -333,6 +334,23 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         except ValueError:
             self.requests_deadline = 60.0  # typo'd knob: keep the default
         self._waiters = 0  # event-loop-only counter of admission waiters
+        # event-loop-only legacy-plane claim counters: slots HELD via
+        # self.sem plus waiters PARKED on it.  A runtime QoS gate flip
+        # seeds the new plane with held+parked (every parked waiter is
+        # a claim on a slot a release will hand it), and each claim
+        # that dissolves — a release no waiter takes, a parked waiter
+        # shedding/disconnecting — frees one seeded plane slot, so
+        # combined admissions never exceed max_concurrency.
+        self._sem_held = 0
+        self._sem_waiters = 0
+        self._srv_loop = None  # serving loop, captured at first request
+        # per-tenant QoS plane (ISSUE 13, server/qos.py): weighted
+        # deficit-round-robin admission + per-tenant bandwidth buckets
+        # replacing the single semaphore above when MINIO_TPU_QOS=1.
+        # Default OFF: self.sem stays the byte- and metrics-identical
+        # reference plane (pinned by tests/test_qos.py).
+        self.qos = QosPlane.from_config(self.config, max_concurrency)
+        self.config.on_change("qos", self._apply_qos_config)
         # Dedicated pool sized to the request semaphore so a full house of
         # blocking object-layer calls can never starve body-feed tasks
         # (reference analogue: maxClients semaphore, cmd/handler-api.go:108).
@@ -565,13 +583,16 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         return await loop.run_in_executor(self.executor,
                                           lambda: ctx.run(nobudget))
 
-    async def _pump_stream(self, resp: web.StreamResponse, stream) -> None:
+    async def _pump_stream(self, resp: web.StreamResponse, stream,
+                           request: web.Request | None = None) -> None:
         """Stream an iterator's chunks to the response with one chunk of
         read-ahead: the executor thread pulls chunk N+1 (shard read +
         verify + decode) while the event loop awaits the socket write of
         chunk N.  Lock-step produce/consume serialized the two — the
         decode pipeline sat idle for every client-write round trip
-        (ISSUE 5 overlapped GET)."""
+        (ISSUE 5 overlapped GET).  With `request` and QoS on, each
+        chunk is metered against the tenant's egress bandwidth bucket
+        (pacing overlaps the prefetch, not the decode)."""
         it = iter(stream)
         nxt = asyncio.ensure_future(self._run_nobudget(next, it, None))
         try:
@@ -581,6 +602,8 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 if chunk is None:
                     break
                 nxt = asyncio.ensure_future(self._run_nobudget(next, it, None))
+                if request is not None:
+                    await self._qos_throttle(request, len(chunk), "out")
                 await resp.write(chunk)
         finally:
             if nxt is not None:
@@ -733,6 +756,49 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             conditions=conditions,
         ))
 
+    def _apply_qos_config(self, cfg) -> None:
+        """Dynamic `qos` subsystem apply (admin PUT /minio/admin/v3/qos
+        or set-config-kv): weights/caps/limits take effect without a
+        restart, and the gate itself can flip at runtime.  In-flight
+        requests release against the plane instance they were admitted
+        by (captured per-request in _handle), so a flip never strands a
+        slot."""
+        if not QosPlane.gate_enabled(cfg):
+            self.qos = None
+            return
+        plane = self.qos
+        if plane is not None:
+            plane.load_config(cfg)
+            return
+        plane = QosPlane.from_config(cfg, self.max_concurrency)
+        loop = self._srv_loop
+        if loop is None or loop.is_closed():
+            # no request has ever run: nothing is in flight to seed
+            self.qos = plane
+            return
+
+        def install() -> None:
+            # on the serving loop, where the claim counters are
+            # maintained: the seed exactly matches the claim-dissolve
+            # credits that will follow (external_release), so combined
+            # admissions never exceed the pool
+            plane.seed_external(self._sem_held + self._sem_waiters)
+            self.qos = plane
+
+        loop.call_soon_threadsafe(install)
+
+    async def _qos_throttle(self, request: web.Request, n: int,
+                            direction: str) -> None:
+        """Meter `n` data-plane bytes (PUT-body ingest direction="in",
+        GET streaming direction="out") against the request tenant's
+        bandwidth bucket; paces with asyncio.sleep so a throttled
+        tenant never blocks the event loop.  No-op with QoS off."""
+        qos = self.qos
+        if qos is None or n <= 0:
+            return
+        tenant = request.get("qosTenant") or qos.classify(request)
+        await qos.throttle(tenant, n, direction)
+
     def _request_budget(self, request: web.Request):
         """Deadline budget for one request: `api.requests_deadline`
         clamped down by an `x-amz-request-timeout` header (the client may
@@ -750,21 +816,115 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 seconds = v if seconds is None else min(seconds, v)
         return deadline_mod.Budget(seconds)
 
-    def _shed_response(self, api: str) -> web.Response:
+    def _shed_response(self, api: str, reason: str = "",
+                       note_brownout: bool = True) -> web.Response:
         """503 SlowDown for a request shed at admission (reference sheds
-        with 503 after requests_deadline, cmd/handler-api.go:108)."""
+        with 503 after requests_deadline, cmd/handler-api.go:108).
+        `reason` distinguishes the per-tenant QoS sheds; unset keeps the
+        legacy message byte-identical.  `note_brownout=False` for QoS
+        sheds fired while the node still had free slots: a capped/full
+        tenant's PRIVATE backlog is isolation working, and must not
+        brown out background heal/scanner on an otherwise idle node."""
         self._m_shed.inc()
         svcs = self.services
-        if svcs is not None and getattr(svcs, "brownout", None) is not None:
+        if note_brownout and svcs is not None \
+                and getattr(svcs, "brownout", None) is not None:
             svcs.brownout.note_shed()
-        e = S3Error("SlowDown",
-                    "request shed: admission queue wait exceeded the "
-                    "request deadline")
+        msg = ("request shed: admission queue wait exceeded the "
+               "request deadline")
+        if reason == "tenant-queue-full":
+            msg = ("request shed: this tenant's admission queue is "
+                   "full (per-tenant QoS)")
+        elif reason == "deadline":
+            msg = ("request shed: budget expired in the tenant "
+                   "admission queue (per-tenant QoS)")
+        e = S3Error("SlowDown", msg)
         return web.Response(
             status=e.status, body=e.to_xml(secrets.token_hex(8)),
             content_type="application/xml",
             headers={"Retry-After": "1"},
         )
+
+    async def _admit_qos(self, request: web.Request, qos, tenant: str,
+                         hot: bool, budget, root, t0: float, api: str,
+                         svcs):
+        """Weighted-DRR admission (server/qos.py, ISSUE 13).
+
+        Returns ``(admitted, lane, shed_resp)``:
+        * ``lane is None``      — granted a QoS slot (release through
+                                  qos.release);
+        * ``lane is hot_sem``   — probable RAM hit rode the hot lane;
+        * ``shed_resp``         — 503 SlowDown (full tenant queue, or
+                                  the budget expired while queued);
+        ``admitted`` is True for the no-wait fast paths (feeds the
+        trace's queued= tag, mirroring the legacy plane)."""
+        if qos.try_admit(tenant):
+            return True, None, None
+        if hot and not self.hot_sem.locked():
+            # same hot-lane economics as the legacy plane (RAM hits
+            # spend no drive IOPs), with the re-probe after acquire;
+            # admits and re-probe REJECTIONS both fold into per-tenant
+            # stats so hit-ratio and shed counters stay honest under
+            # QoS (ISSUE 13 satellite)
+            await self.hot_sem.acquire()
+            if self._hot_probe(request):
+                self._m_hot_lane.inc()
+                qos.note_hot_admit(tenant)
+                if svcs is not None and getattr(
+                        svcs, "brownout", None) is not None:
+                    svcs.brownout.note_hot_bypass()
+                return True, self.hot_sem, None
+            self.hot_sem.release()
+            qos.note_hot_reject(tenant)
+        try:
+            fut, depth = qos.enqueue(tenant)
+        except TenantQueueFull:
+            if root is not None:
+                root.defer_child("admission", time.monotonic() - t0,
+                                 lane="qos", queued=True, shed=True,
+                                 reason="tenant-queue-full")
+            return False, None, self._shed_response(
+                api, reason="tenant-queue-full",
+                note_brownout=qos.saturated())
+        self._waiters += 1
+        self._m_queue_waiting.inc()
+        try:
+            if svcs is not None \
+                    and getattr(svcs, "brownout", None) is not None:
+                # brownout pressure rides the AGGREGATE cross-tenant
+                # depth: one tenant's private backlog is isolation
+                # working, total backlog is the node overloaded
+                svcs.brownout.note_pressure(depth)
+            wait = budget.remaining()
+            try:
+                if wait == float("inf"):
+                    await fut
+                else:
+                    await asyncio.wait_for(fut, timeout=wait)
+            except asyncio.TimeoutError:
+                if fut.done() and not fut.cancelled():
+                    # the grant landed in the very tick the timeout
+                    # fired: give the slot back before shedding
+                    qos.release(tenant)
+                qos.abandon(tenant, fut, deadline=True)
+                if root is not None:
+                    root.defer_child("admission",
+                                     time.monotonic() - t0,
+                                     lane="qos", queued=True,
+                                     shed=True, reason="deadline")
+                return False, None, self._shed_response(
+                    api, reason="deadline",
+                    note_brownout=qos.saturated())
+            except asyncio.CancelledError:
+                if fut.done() and not fut.cancelled():
+                    qos.release(tenant)
+                else:
+                    qos.abandon(tenant, fut)
+                raise
+        finally:
+            self._waiters -= 1
+            self._m_queue_waiting.dec()
+        return False, None, None
 
     async def _handle(self, request: web.Request, fn,
                       hot: bool = False) -> web.StreamResponse:
@@ -772,11 +932,22 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
 
         t0 = time.monotonic()
         api = getattr(fn, "__name__", "unknown")
+        if self._srv_loop is None:
+            self._srv_loop = asyncio.get_running_loop()
         self._m_inflight.inc()
         status = 500
         tx = 0
         budget = self._request_budget(request)
         lane = self.sem
+        # per-tenant QoS (ISSUE 13): classify BEFORE tracing so the
+        # root span carries tenant=, and stash the tenant for the
+        # data-path bandwidth metering (put_object/_pump_stream)
+        qos = self.qos
+        tenant = None
+        qos_admitted = False
+        if qos is not None:
+            tenant = qos.classify(request)
+            request["qosTenant"] = tenant
         # root span of the request trace (utils/tracing.py): minted
         # BEFORE admission so a 503 shed still has a greppable trace id;
         # the id is stamped on every response by _trace_on_prepare
@@ -784,6 +955,8 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                                      path=request.path)
         if root is not None:
             request["traceId"] = root.trace.trace_id
+            if tenant is not None:
+                root.tag(tenant=tenant)
         try:
             # ---- admission: bounded queue wait, shed on expiry --------
             # fast path first: a free slot must not count as queue
@@ -791,7 +964,19 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             # exhausted become waiters (a same-tick burst on an idle
             # server would otherwise spuriously engage brownout)
             svcs = self.services
-            if not self.sem.locked():
+            if qos is not None:
+                try:
+                    admitted, lane, resp = await self._admit_qos(
+                        request, qos, tenant, hot, budget, root, t0,
+                        api, svcs)
+                except asyncio.CancelledError:
+                    status = 499  # client gave up while queued
+                    raise
+                if resp is not None:
+                    status = 503
+                    return resp
+                qos_admitted = lane is None
+            elif not self.sem.locked():
                 await self.sem.acquire()
                 admitted = True
             else:
@@ -818,8 +1003,9 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                             svcs.brownout.note_hot_bypass()
                     else:
                         self.hot_sem.release()
-            if not admitted:
+            if not admitted and qos is None:
                 self._waiters += 1
+                self._sem_waiters += 1
                 self._m_queue_waiting.inc()
                 try:
                     if svcs is not None \
@@ -834,6 +1020,12 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                                                    timeout=wait)
                         except asyncio.TimeoutError:
                             status = 503
+                            qos_now = self.qos
+                            if qos_now is not None:
+                                # the gate flipped while we were
+                                # parked: this waiter's slot claim
+                                # dissolves — credit the live plane
+                                qos_now.external_release()
                             if root is not None:
                                 root.defer_child(
                                     "admission",
@@ -842,10 +1034,18 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                             return self._shed_response(api)
                 except asyncio.CancelledError:
                     status = 499  # client gave up while queued
+                    qos_now = self.qos
+                    if qos_now is not None:
+                        qos_now.external_release()
                     raise
                 finally:
                     self._waiters -= 1
+                    self._sem_waiters -= 1
                     self._m_queue_waiting.dec()
+            if qos is None and lane is self.sem:
+                # slots held via the legacy semaphore are tracked so a
+                # runtime gate flip can seed the new plane with them
+                self._sem_held += 1
             wait_dt = time.monotonic() - t0
             self._m_queue_wait.observe(wait_dt)
             if root is not None:
@@ -858,7 +1058,8 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 # hot-lane admit
                 root.defer_child(
                     "admission", wait_dt,
-                    lane="hot" if lane is self.hot_sem else "api",
+                    lane="hot" if lane is self.hot_sem
+                    else ("qos" if qos_admitted else "api"),
                     queued=not admitted)
             token = deadline_mod.set_current(budget)
             try:
@@ -893,7 +1094,25 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                     )
             finally:
                 deadline_mod.reset(token)
-                lane.release()
+                if qos_admitted:
+                    # release against the plane that granted the slot
+                    # (captured above — a runtime gate flip must not
+                    # strand it); runs the DRR dispatch sweep
+                    qos.release(tenant)
+                else:
+                    lane.release()
+                    if qos is None and lane is self.sem:
+                        self._sem_held -= 1
+                        qos_now = self.qos
+                        if qos_now is not None \
+                                and self._sem_waiters == 0:
+                            # a legacy-admitted request finished after
+                            # a gate flip with no parked waiter to
+                            # hand its slot to: the claim dissolves —
+                            # free its seeded slot in the live plane.
+                            # (With waiters parked, the release hands
+                            # the slot over and total claims stand.)
+                            qos_now.external_release()
         finally:
             dt = time.monotonic() - t0
             self._m_inflight.dec()
@@ -965,36 +1184,24 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         if action == "AssumeRoleWithWebIdentity":
             # the bearer token IS the credential: no SigV4 auth
             # (reference cmd/sts-handlers.go AssumeRoleWithWebIdentity)
-            if self.oidc is None:
-                raise S3Error("NotImplemented",
-                              "no OpenID provider configured")
-            token = form.get("WebIdentityToken", "")
-            if not token:
-                raise S3Error("InvalidArgument", "missing WebIdentityToken")
-            from minio_tpu.iam.oidc import OIDCError
-
-            try:
-                claims = await self._run(self.oidc.validate, token)
-            except OIDCError as e:
-                raise S3Error("AccessDenied", f"invalid web identity: {e}")
-            subject = str(claims.get("sub", ""))
-            policies = self.oidc.policies_for(claims)
-            # credentials must not outlive the identity token that minted
-            # them (reference bounds STS expiry by the JWT exp claim)
-            token_ttl = int(claims["exp"] - time.time())
-            duration = max(1, min(duration, token_ttl))
-            try:
-                ident = await self._run(
-                    self.iam.assume_role_web_identity, subject, policies,
-                    duration, session_policy
-                )
-            except IAMError as e:
-                raise S3Error("AccessDenied", str(e))
-            return self._sts_creds_xml(
-                "AssumeRoleWithWebIdentity", ident,
-                extra=("<SubjectFromWebIdentityToken>"
-                       f"{escape(subject)}"
-                       "</SubjectFromWebIdentityToken>"))
+            return await self._sts_oidc_exchange(
+                form, duration, session_policy,
+                token_field="WebIdentityToken",
+                action="AssumeRoleWithWebIdentity",
+                subject_element="SubjectFromWebIdentityToken",
+                invalid_code="AccessDenied",
+                invalid_prefix="invalid web identity: ")
+        if action == "AssumeRoleWithClientGrants":
+            # legacy alias of the web-identity exchange (reference
+            # cmd/sts-handlers.go AssumeRoleWithClientGrants): same JWT
+            # validation plane, but the token arrives in the `Token`
+            # form field and the response wraps ClientGrants elements
+            return await self._sts_oidc_exchange(
+                form, duration, session_policy,
+                token_field="Token",
+                action="AssumeRoleWithClientGrants",
+                subject_element="SubjectFromToken",
+                invalid_code="InvalidClientGrantsToken")
         if action == "AssumeRoleWithLDAPIdentity":
             # username+password ARE the credential: no SigV4 auth
             # (reference cmd/sts-handlers.go AssumeRoleWithLDAPIdentity)
@@ -1029,6 +1236,47 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 raise S3Error("AccessDenied", str(e))
             return self._sts_creds_xml("AssumeRoleWithLDAPIdentity", ident)
         raise S3Error("InvalidArgument", f"unsupported STS action {action}")
+
+    async def _sts_oidc_exchange(self, form: dict, duration: int,
+                                 session_policy: str, *,
+                                 token_field: str, action: str,
+                                 subject_element: str,
+                                 invalid_code: str,
+                                 invalid_prefix: str = ""):
+        """The OIDC token exchange shared by AssumeRoleWithWebIdentity
+        and its legacy ClientGrants alias: validate the JWT, resolve
+        its policy claim, clamp the credential lifetime to the token's
+        remaining lifetime (creds must not outlive the identity token
+        that minted them), and mint STS creds.  The two actions differ
+        only in form field, error code, and response element names."""
+        from minio_tpu.iam import IAMError
+        from minio_tpu.iam.oidc import OIDCError
+
+        if self.oidc is None:
+            raise S3Error("NotImplemented",
+                          "no OpenID provider configured")
+        token = form.get(token_field, "")
+        if not token:
+            raise S3Error("InvalidArgument", f"missing {token_field}")
+        try:
+            claims = await self._run(self.oidc.validate, token)
+        except OIDCError as e:
+            raise S3Error(invalid_code, invalid_prefix + str(e))
+        subject = str(claims.get("sub", ""))
+        policies = self.oidc.policies_for(claims)
+        token_ttl = int(claims["exp"] - time.time())
+        duration = max(1, min(duration, token_ttl))
+        try:
+            ident = await self._run(
+                self.iam.assume_role_web_identity, subject, policies,
+                duration, session_policy
+            )
+        except IAMError as e:
+            raise S3Error("AccessDenied", str(e))
+        return self._sts_creds_xml(
+            action, ident,
+            extra=(f"<{subject_element}>{escape(subject)}"
+                   f"</{subject_element}>"))
 
     def _sts_creds_xml(self, action: str, ident, extra: str = ""):
         exp = _iso(ident.expiry)
@@ -1790,6 +2038,9 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             async for chunk in request.content.iter_chunked(1 << 20):
                 if body_sha is not None:
                     body_sha.update(chunk)
+                # per-tenant ingest metering (ISSUE 13): paces the
+                # PUT body against the tenant's bandwidth bucket
+                await self._qos_throttle(request, len(chunk), "in")
                 await self._feed(pipe, chunk, put_task)
         except Exception as e:
             feed_err = e
@@ -2320,7 +2571,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         resp = web.StreamResponse(status=status, headers=headers)
         await resp.prepare(request)
         try:
-            await self._pump_stream(resp, chunks)
+            await self._pump_stream(resp, chunks, request)
         finally:
             close = getattr(chunks, "close", None)
             if close is not None:
@@ -2440,13 +2691,17 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         if isinstance(payload, (bytes, bytearray, memoryview)):
             body = memoryview(payload)[offset:offset + length] \
                 if (offset or length != size) else payload
+            # RAM hits are still tenant bytes: one debit for the whole
+            # body (pacing a single response write chunk-by-chunk buys
+            # nothing — the debt carries into the tenant's next chunk)
+            await self._qos_throttle(request, length, "out")
             return web.Response(status=status, body=bytes(body),
                                 headers=headers)
         # collapsed follower: stream the fill buffer as it grows
         # (followers are only created for whole-object requests)
         resp = web.StreamResponse(status=status, headers=headers)
         await resp.prepare(request)
-        await self._pump_stream(resp, payload)
+        await self._pump_stream(resp, payload, request)
         await resp.write_eof()
         return resp
 
@@ -2515,7 +2770,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         resp = web.StreamResponse(status=status, headers=headers)
         await resp.prepare(request)
         try:
-            await self._pump_stream(resp, stream)
+            await self._pump_stream(resp, stream, request)
         finally:
             await self._run(lambda: closer.close()
                             if hasattr(closer, "close") else None)
@@ -2832,6 +3087,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         ))
         try:
             async for chunk in request.content.iter_chunked(1 << 20):
+                await self._qos_throttle(request, len(chunk), "in")
                 await self._feed(pipe, chunk, task)
         finally:
             await self._feed(pipe, None, task)

@@ -370,6 +370,58 @@ class MetricsMixin:
                   "Fraction of hot-tier lookups served from RAM",
                   hs["hitRatio"])
 
+        # per-tenant QoS plane (server/qos.py, ISSUE 13): queue depth,
+        # admissions, sheds, DRR rounds and metered bytes per tenant —
+        # the noisy-neighbor forensics surface.  Rendered only while
+        # the plane is on, so MINIO_TPU_QOS=0 stays metrics-identical
+        # to the single-semaphore server.
+        qos = getattr(self, "qos", None)
+        if qos is not None:
+            qs = qos.stats()
+            gauge("minio_qos_deficit_rounds_total",
+                  "DRR dispatch rotation rounds swept",
+                  qs["deficitRounds"])
+            per_tenant = [
+                ("minio_qos_queue_length",
+                 "Requests queued for admission per tenant",
+                 "queueDepth"),
+                ("minio_qos_inflight_count",
+                 "Granted in-flight requests per tenant", "inflight"),
+                ("minio_qos_admitted_total",
+                 "Requests admitted per tenant", "admitted"),
+                ("minio_qos_hot_lane_rejections_total",
+                 "Hot-lane re-probe failures that fell back to the "
+                 "QoS lane per tenant", "hotLaneRejections"),
+            ]
+            for name, help_, field in per_tenant:
+                rows = [f"# HELP {name} {help_}", f"# TYPE {name} gauge"]
+                for t, ts in sorted(qs["tenants"].items()):
+                    lbl = _fmt_labels(("tenant",), (t,))
+                    rows.append(f"{name}{lbl} {ts[field]}")
+                g("\n".join(rows) + "\n")
+            rows = ["# HELP minio_qos_shed_total Requests shed 503 per "
+                    "tenant and reason (queue_full|deadline)",
+                    "# TYPE minio_qos_shed_total gauge"]
+            for t, ts in sorted(qs["tenants"].items()):
+                for reason, field in (("queue_full", "shedQueueFull"),
+                                      ("deadline", "shedDeadline")):
+                    lbl = _fmt_labels(("tenant", "reason"), (t, reason))
+                    rows.append(f"minio_qos_shed_total{lbl} {ts[field]}")
+            g("\n".join(rows) + "\n")
+            rows = ["# HELP minio_qos_throttled_bytes_total Data-plane "
+                    "bytes metered per tenant and direction (in=PUT "
+                    "ingest, out=GET streaming)",
+                    "# TYPE minio_qos_throttled_bytes_total gauge"]
+            for t, ts in sorted(qs["tenants"].items()):
+                for direction, field in (("in", "throttledInBytes"),
+                                         ("out", "throttledOutBytes")):
+                    lbl = _fmt_labels(("tenant", "direction"),
+                                      (t, direction))
+                    rows.append(
+                        f"minio_qos_throttled_bytes_total{lbl} "
+                        f"{ts[field]}")
+            g("\n".join(rows) + "\n")
+
         # multi-process data plane (parallel/workers.py): job/commit
         # volume through the worker plane plus its supervision health —
         # workerDeaths counts in-flight-failing deaths, restarts counts

@@ -550,7 +550,8 @@ class ZipExtractMixin:
         resp = web.StreamResponse(status=status, headers=headers)
         await resp.prepare(request)
         try:
-            await self._pump_stream(resp, stream)
+            # zip member bytes are tenant egress too (per-tenant QoS)
+            await self._pump_stream(resp, stream, request)
         finally:
             close = getattr(stream, "close", None)
             if close is not None:

@@ -1,10 +1,18 @@
-"""Per-target bandwidth limiting + monitoring for replication.
+"""Bandwidth limiting + monitoring: replication targets AND tenants.
 
 Reference: internal/bucket/bandwidth (monitor.go MonitorBandwidth,
 reader.go MonitoredReader) — each remote target may carry a bandwidth
 limit (madmin.BucketTarget.BandwidthLimit); replication uploads ride a
 token-bucket-throttled reader, and a monitor tracks a moving average of
 bytes/sec per (bucket, target) for `mc admin bandwidth` style reporting.
+
+ISSUE 13 generalizes the same machinery from the replication-only
+upload path to the request data plane: the per-tenant QoS plane
+(server/qos.py) keys TokenBuckets and the BandwidthMonitor by tenant
+instead of target arn, metering PUT-body ingest and GET streaming.
+Async callers (the aiohttp funnel) use ``TokenBucket.debit`` — the
+bucket accounting without the blocking sleep — and pace with
+``asyncio.sleep`` so the event loop is never blocked.
 """
 
 from __future__ import annotations
@@ -25,14 +33,21 @@ class TokenBucket:
         self._last = time.monotonic()
         self._mu = threading.Lock()
 
-    def acquire(self, n: int) -> None:
+    def debit(self, n: int) -> float:
+        """Charge `n` bytes and return how long the caller should pace
+        (0.0 when inside the burst allowance) WITHOUT sleeping — the
+        async data-plane form: the event loop awaits asyncio.sleep on
+        the returned debt instead of blocking a thread."""
         with self._mu:
             now = time.monotonic()
             self._tokens = min(
                 self.rate, self._tokens + (now - self._last) * self.rate)
             self._last = now
             self._tokens -= n
-            wait = (-self._tokens / self.rate) if self._tokens < 0 else 0.0
+            return (-self._tokens / self.rate) if self._tokens < 0 else 0.0
+
+    def acquire(self, n: int) -> None:
+        wait = self.debit(n)
         if wait > 0:
             time.sleep(wait)
 

@@ -526,6 +526,175 @@ class TestOverloadDrill:
             assert not leaked, f"leaked threads: {leaked}"
 
 
+@pytest.mark.serial
+class TestNoisyNeighborDrill:
+    """ISSUE 13 acceptance drill: per-tenant QoS keeps a quiet tenant
+    whole while a hot tenant is 10x oversubscribed.
+
+    4 API slots; the hot tenant (40 concurrent clients = 10x) is
+    weight-1, capped at 2 concurrent slots and bandwidth-limited; the
+    quiet tenant (one sequential client) is weight-4 and unlimited.
+    Green means: ZERO quiet-tenant sheds, quiet p99 inside the request
+    budget, the hot tenant IS being shed (its private queue bound
+    503s), and the hot tenant's bandwidth bucket pacing never touches
+    the quiet tenant.
+
+    `serial`: wall-clock p99 assertion — conftest runs it at session
+    end in an isolated subprocess, like the overload drill."""
+
+    BUDGET_S = 3.0
+    DRILL_S = 4.0
+    HOT_CLIENTS = 40          # 10x the 4 API slots
+    HOT_BW = 8 << 20          # 8 MiB/s egress cap for the hot tenant
+
+    def test_noisy_neighbor_drill(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MINIO_TPU_QOS", "1")
+        monkeypatch.setenv("MINIO_API_REQUESTS_MAX", "4")
+        monkeypatch.setenv("MINIO_API_REQUESTS_DEADLINE",
+                           f"{self.BUDGET_S:g}s")
+        monkeypatch.setenv("MINIO_TPU_QOS_MAX_QUEUE", "6")
+        monkeypatch.setenv("MINIO_TPU_QOS_TENANTS", json.dumps({
+            "bucket:hotb": {"weight": 1, "max_concurrency": 2,
+                            "bandwidth": self.HOT_BW},
+            "bucket:quietb": {"weight": 4},
+        }))
+        monkeypatch.setenv("MINIO_PROMETHEUS_AUTH_TYPE", "public")
+        baseline_threads = _threads()
+        os.environ["MINIO_TPU_FSYNC"] = "0"
+        srv = S3TestServer(str(tmp_path / "nn"), n_drives=8)
+        record = {}
+        try:
+            assert srv.request("PUT", "/hotb").status == 200
+            assert srv.request("PUT", "/quietb").status == 200
+            hot_payload = os.urandom(512 << 10)
+            quiet_payload = os.urandom(128 << 10)
+            assert srv.request("PUT", "/hotb/obj",
+                               data=hot_payload).status == 200
+            assert srv.request("PUT", "/quietb/obj",
+                               data=quiet_payload).status == 200
+
+            stop_at = time.monotonic() + self.DRILL_S
+            mu = threading.Lock()
+            hot_served = [0]
+            hot_shed = [0]
+            hot_bytes = [0]
+            hot_other = [0]
+
+            def hot_client():
+                while time.monotonic() < stop_at:
+                    r = srv.request("GET", "/hotb/obj")
+                    with mu:
+                        if r.status == 200:
+                            hot_served[0] += 1
+                            hot_bytes[0] += len(r.body)
+                        elif r.status == 503:
+                            hot_shed[0] += 1
+                        else:
+                            hot_other[0] += 1
+
+            quiet_lat: list[float] = []
+            quiet_status: list[int] = []
+
+            def quiet_client():
+                # sequential polite traffic for the whole drill window
+                while time.monotonic() < stop_at \
+                        or len(quiet_lat) < 8:
+                    t0 = time.monotonic()
+                    r = srv.request("GET", "/quietb/obj")
+                    quiet_lat.append(time.monotonic() - t0)
+                    quiet_status.append(r.status)
+                    if r.status == 200:
+                        assert r.body == quiet_payload
+                    if len(quiet_lat) >= 64:
+                        break
+
+            hot_threads = [threading.Thread(target=hot_client)
+                           for _ in range(self.HOT_CLIENTS)]
+            qt = threading.Thread(target=quiet_client)
+            t_start = time.monotonic()
+            for t in hot_threads:
+                t.start()
+            qt.start()
+            qt.join(60)
+            for t in hot_threads:
+                t.join(60)
+            elapsed = time.monotonic() - t_start
+
+            # ---- the acceptance clauses ------------------------------
+            quiet_sheds = sum(1 for s in quiet_status if s != 200)
+            assert quiet_sheds == 0, \
+                f"quiet tenant shed {quiet_sheds}: {quiet_status}"
+            lat_sorted = sorted(quiet_lat)
+            p99 = lat_sorted[max(0, int(len(lat_sorted) * 0.99) - 1)]
+            assert p99 <= self.BUDGET_S, \
+                f"quiet p99 {p99:.2f}s blew the {self.BUDGET_S}s budget"
+            assert hot_shed[0] > 0, \
+                "hot tenant was never shed despite 10x oversubscription"
+            assert hot_served[0] > 0, \
+                "hot tenant fully starved — fairness, not a blackout"
+            assert hot_other[0] == 0, f"unexpected statuses: {hot_other}"
+            # bandwidth bucket honored: hot egress stays near its cap
+            # (burst allowance + one in-flight object of slack)
+            hot_rate = hot_bytes[0] / max(elapsed, 1e-6)
+            assert hot_rate <= self.HOT_BW * 2.0, \
+                f"hot egress {hot_rate / 1e6:.1f} MB/s ignored the cap"
+            st = srv.server.qos.stats()["tenants"]
+            assert st["bucket:hotb"]["shedQueueFull"] > 0
+            assert st["bucket:quietb"]["shedQueueFull"] == 0
+            assert st["bucket:quietb"]["shedDeadline"] == 0
+            # the quiet tenant runs WITHOUT a bucket: pacing debt from
+            # the hot tenant structurally cannot leak onto it
+            assert st["bucket:quietb"]["bandwidth"] == 0
+            assert st["bucket:hotb"]["throttledOutBytes"] > 0
+
+            m = srv.request("GET", "/minio/v2/metrics/cluster",
+                            unsigned=True)
+            assert m.status == 200
+            text = m.text()
+            for metric in ("minio_qos_shed_total",
+                           "minio_qos_admitted_total",
+                           "minio_qos_throttled_bytes_total",
+                           "minio_qos_deficit_rounds_total"):
+                assert metric in text, f"{metric} missing from /metrics"
+
+            record = {
+                "pass": True,
+                "budget_s": self.BUDGET_S,
+                "slots": 4,
+                "hot_clients": self.HOT_CLIENTS,
+                "oversubscription": "10x (40 clients / 4 slots)",
+                "hot_bandwidth_cap_mbs": self.HOT_BW / 1e6,
+                "hot_served": hot_served[0],
+                "hot_shed": hot_shed[0],
+                "hot_egress_mbs": round(hot_rate / 1e6, 2),
+                "quiet_requests": len(quiet_lat),
+                "quiet_sheds": quiet_sheds,
+                "quiet_p99_s": round(p99, 3),
+                "quiet_max_s": round(lat_sorted[-1], 3),
+            }
+        finally:
+            srv.close()
+            leaked = _leaked(baseline_threads)
+            record["thread_leaks"] = sorted(leaked)
+            if record.get("pass"):
+                record["pass"] = not leaked
+            try:
+                bench_path = os.path.join(
+                    os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__))), "BENCH_r15.json")
+                doc = {}
+                if os.path.exists(bench_path):
+                    with open(bench_path, encoding="utf-8") as f:
+                        doc = json.load(f)
+                doc["qos_noisy_neighbor_drill"] = record
+                with open(bench_path, "w", encoding="utf-8") as f:
+                    json.dump(doc, f, indent=2)
+                    f.write("\n")
+            except Exception:
+                pass
+            assert not leaked, f"leaked threads: {leaked}"
+
+
 # ------------------------------------------------- deadline-gated storage
 class TestDriveDeadlineWorker:
     def test_gated_read_abandons_hung_drive(self, tmp_path):

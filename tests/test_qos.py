@@ -1,0 +1,842 @@
+"""Per-tenant QoS (ISSUE 13): weighted DRR admission, per-tenant
+bandwidth isolation, the admin surface, and the default-off
+differential.
+
+The scheduler protocol itself is model-checked
+(analysis/concurrency/models/qos.py, tests/test_modelcheck.py); this
+suite keeps the implementation honest against the protocol — DRR
+fairness ratios, mid-flight weight changes (deficit clamp), queue-full
+sheds that hit ONLY the full tenant, budget expiry in a tenant queue,
+and the MINIO_TPU_QOS=0 gate staying byte- and metrics-identical to
+the single-semaphore plane.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import types
+
+import pytest
+
+from minio_tpu.server.qos import (QosPlane, TenantQueueFull, TenantRule)
+from minio_tpu.utils.bandwidth import TokenBucket
+
+from .s3_harness import S3TestServer
+
+
+def _req(bucket: str = "", headers: dict | None = None,
+         query: dict | None = None):
+    """Minimal duck-typed request for classification unit tests."""
+    r = types.SimpleNamespace()
+    r.headers = headers or {}
+    r.match_info = {"bucket": bucket} if bucket else {}
+    r.rel_url = types.SimpleNamespace(query=query or {})
+    return r
+
+
+# --------------------------------------------------------- classification
+class TestClassification:
+    def test_bucket_is_its_own_tenant(self):
+        p = QosPlane(4)
+        assert p.classify(_req(bucket="photos")) == "bucket:photos"
+        assert p.classify(_req(bucket="logs")) == "bucket:logs"
+
+    def test_bucketless_and_anonymous_map_to_default(self):
+        p = QosPlane(4)
+        assert p.classify(_req()) == "default"
+
+    def test_key_rule_wins_over_bucket(self):
+        p = QosPlane(4, rules={"key:AKIDHOT": TenantRule(weight=2)})
+        hdr = {"Authorization":
+               "AWS4-HMAC-SHA256 Credential=AKIDHOT/20260101/us-east-1/"
+               "s3/aws4_request, SignedHeaders=host, Signature=abc"}
+        assert p.classify(_req(bucket="photos", headers=hdr)) \
+            == "key:AKIDHOT"
+        # an UNLISTED access key does not form a tenant: the bucket does
+        hdr2 = {"Authorization":
+                "AWS4-HMAC-SHA256 Credential=AKOTHER/20260101/x/s3/"
+                "aws4_request, SignedHeaders=host, Signature=abc"}
+        assert p.classify(_req(bucket="photos", headers=hdr2)) \
+            == "bucket:photos"
+
+    def test_access_key_parse_forms(self):
+        assert QosPlane.access_key_of(_req(headers={
+            "Authorization": "AWS4-HMAC-SHA256 Credential=AK1/d/r/s3/"
+            "aws4_request, SignedHeaders=h, Signature=s"})) == "AK1"
+        assert QosPlane.access_key_of(_req(headers={
+            "Authorization": "AWS AK2:signature"})) == "AK2"
+        assert QosPlane.access_key_of(_req(query={
+            "X-Amz-Credential": "AK3/d/r/s3/aws4_request"})) == "AK3"
+        assert QosPlane.access_key_of(_req(query={
+            "AWSAccessKeyId": "AK4"})) == "AK4"
+        assert QosPlane.access_key_of(_req()) == ""
+
+
+# ------------------------------------------------------- scheduler (unit)
+class TestScheduler:
+    def test_fast_path_and_pool_bound(self):
+        p = QosPlane(2)
+        assert p.try_admit("bucket:a")
+        assert p.try_admit("bucket:b")
+        assert not p.try_admit("bucket:c")  # pool exhausted
+        p.release("bucket:a")
+        assert p.try_admit("bucket:c")
+
+    def test_cap_blocks_with_free_slots(self):
+        p = QosPlane(4, rules={"bucket:a": TenantRule(max_concurrency=1)})
+        assert p.try_admit("bucket:a")
+        assert not p.try_admit("bucket:a")   # capped
+        assert p.try_admit("bucket:b")       # pool still open to others
+
+    def test_queue_full_sheds_only_that_tenant(self):
+        async def drill():
+            p = QosPlane(1, max_queue=2)
+            assert p.try_admit("bucket:hold")
+            p.enqueue("bucket:hot")
+            p.enqueue("bucket:hot")
+            with pytest.raises(TenantQueueFull):
+                p.enqueue("bucket:hot")      # full: shed THIS tenant
+            fut, depth = p.enqueue("bucket:quiet")  # others keep flowing
+            assert depth == 3
+            st = p.stats()["tenants"]
+            assert st["bucket:hot"]["shedQueueFull"] == 1
+            assert st["bucket:quiet"]["shedQueueFull"] == 0
+
+        asyncio.run(drill())
+
+    def _drain_order(self, p: QosPlane, pend: dict, n: int) -> list:
+        """Release the single slot n times; record which tenant's
+        waiter is granted each time (slots=1 -> exactly one grant per
+        release)."""
+        order = []
+        for _ in range(n):
+            granted = None
+            for t, futs in pend.items():
+                for f in futs:
+                    if f.done():
+                        granted = (t, f)
+                        break
+                if granted:
+                    break
+            assert granted, f"no grant; order so far {order}"
+            t, f = granted
+            pend[t].remove(f)
+            order.append(t)
+            p.release(t)
+        return order
+
+    def test_drr_fairness_ratio(self):
+        """Weight 3 vs 1 over one slot: the heavy tenant gets ~3x the
+        admissions and the light tenant is never starved."""
+        async def drill():
+            p = QosPlane(1, rules={"bucket:h": TenantRule(weight=3),
+                                   "bucket:q": TenantRule(weight=1)})
+            assert p.try_admit("bucket:z")   # hold the slot
+            pend = {
+                "bucket:h": [p.enqueue("bucket:h")[0] for _ in range(9)],
+                "bucket:q": [p.enqueue("bucket:q")[0] for _ in range(3)],
+            }
+            p.release("bucket:z")            # first grant fires
+            return self._drain_order(p, pend, 12)
+
+        order = asyncio.run(drill())
+        assert order.count("bucket:h") == 9
+        assert order.count("bucket:q") == 3
+        # no starvation: the light tenant appears in the first round
+        assert "bucket:q" in order[:5], order
+        # weight dominance: the heavy tenant owns >= 5 of the first 8
+        assert order[:8].count("bucket:h") >= 5, order
+
+    def test_equal_weights_interleave(self):
+        async def drill():
+            p = QosPlane(1)
+            assert p.try_admit("bucket:z")
+            pend = {
+                "bucket:a": [p.enqueue("bucket:a")[0] for _ in range(4)],
+                "bucket:b": [p.enqueue("bucket:b")[0] for _ in range(4)],
+            }
+            p.release("bucket:z")
+            return self._drain_order(p, pend, 8)
+
+        order = asyncio.run(drill())
+        # strict alternation under equal weights and unit costs
+        for i in range(len(order) - 1):
+            assert order[i] != order[i + 1], order
+
+    def test_reweight_mid_flight_clamps_deficit(self):
+        """An admin weight cut applies to queued work immediately and
+        clamps stale deficit (the model's reweight-keeps-stale-deficit
+        mutation)."""
+        async def drill():
+            p = QosPlane(1, rules={"bucket:h": TenantRule(weight=5),
+                                   "bucket:q": TenantRule(weight=1)})
+            assert p.try_admit("bucket:z")
+            pend = {
+                "bucket:h": [p.enqueue("bucket:h")[0] for _ in range(6)],
+                "bucket:q": [p.enqueue("bucket:q")[0] for _ in range(6)],
+            }
+            p.release("bucket:z")
+            head = self._drain_order(p, pend, 2)
+            # heavy tenant holds banked deficit; cut it to 1 mid-flight
+            p.reconfigure(rules={"bucket:h": TenantRule(weight=1),
+                                 "bucket:q": TenantRule(weight=1)})
+            with p._mu:
+                st = p._tenants["bucket:h"]
+                assert st.deficit <= st.rule.weight  # clamped
+            tail = self._drain_order(p, pend, 10)
+            return head, tail
+
+        head, tail = asyncio.run(drill())
+        # after the cut the remaining grants alternate (equal weights):
+        # the heavy tenant cannot spend its old weight-5 credit
+        h_lead = 0
+        for i in range(len(tail) - 1):
+            if tail[i] == tail[i + 1] == "bucket:h":
+                h_lead += 1
+        assert h_lead <= 1, (head, tail)
+
+    def test_abandon_deadline_counts_and_resets_deficit(self):
+        async def drill():
+            p = QosPlane(1)
+            assert p.try_admit("bucket:z")
+            fut, _ = p.enqueue("bucket:t")
+            p.abandon("bucket:t", fut, deadline=True)
+            st = p.stats()["tenants"]["bucket:t"]
+            assert st["shedDeadline"] == 1
+            assert st["queueDepth"] == 0
+            with p._mu:
+                assert p._tenants["bucket:t"].deficit == 0.0
+            # the slot holder releases; nothing strands
+            p.release("bucket:z")
+            assert p.stats()["active"] == 0
+
+        asyncio.run(drill())
+
+    def test_saturated_is_the_aggregate_signal(self):
+        """Brownout rides qos.saturated(): a shed while slots are free
+        (tenant cap/queue bound working) must not read as node
+        overload."""
+        p = QosPlane(2, rules={"bucket:a": TenantRule(max_concurrency=1)})
+        assert not p.saturated()
+        assert p.try_admit("bucket:a")
+        assert not p.try_admit("bucket:a")  # capped, NOT saturated
+        assert not p.saturated()
+        assert p.try_admit("bucket:b")
+        assert p.saturated()
+        p.release("bucket:b")
+        assert not p.saturated()
+
+    def test_reconfigure_raised_cap_dispatches_parked_waiters(self):
+        """Review fix: raising a cap/weight must kick the dispatch
+        sweep — eligible waiters must not sit parked behind free slots
+        until an unrelated release."""
+        async def drill():
+            p = QosPlane(4, rules={"bucket:a": TenantRule(
+                max_concurrency=1)})
+            assert p.try_admit("bucket:a")       # at cap, 3 slots free
+            futs = [p.enqueue("bucket:a")[0] for _ in range(3)]
+            await asyncio.sleep(0)
+            assert not any(f.done() for f in futs)  # cap parks them
+            # admin raises the cap (executor thread in production; the
+            # loop kick is call_soon_threadsafe either way)
+            p.reconfigure(rules={"bucket:a": TenantRule(
+                max_concurrency=4)})
+            for _ in range(5):
+                await asyncio.sleep(0)
+            assert all(f.done() for f in futs), \
+                "raised cap left eligible waiters parked"
+            assert p.stats()["active"] == 4
+
+        asyncio.run(drill())
+
+    def test_aggregate_depth_counter_survives_abandons(self):
+        """Review fix: wait_for cancels the future BEFORE abandon runs;
+        the aggregate depth counter must still pair every enqueue
+        increment exactly once (no permanent +1 per deadline shed that
+        would eventually pin brownout on an idle node)."""
+        async def drill():
+            p = QosPlane(1)
+            assert p.try_admit("bucket:z")
+            # path 1: cancelled externally (as wait_for does), then
+            # abandoned
+            f1, d1 = p.enqueue("bucket:a")
+            assert d1 == 1
+            f1.cancel()
+            p.abandon("bucket:a", f1, deadline=True)
+            assert p._queued == 0
+            # path 2: cancelled future left for the dispatch sweep
+            f2, _ = p.enqueue("bucket:a")
+            f3, d3 = p.enqueue("bucket:b")
+            assert d3 == 2
+            f2.cancel()
+            p.release("bucket:z")  # dispatch skips f2, grants f3
+            assert f3.done()
+            assert p._queued == 0
+            # path 3: cancelled future swept by prune on next enqueue
+            f4, _ = p.enqueue("bucket:b")
+            f4.cancel()
+            p.abandon("bucket:b", f4)
+            _, depth = p.enqueue("bucket:b")
+            assert depth == 1  # not inflated by the abandoned waiter
+
+        asyncio.run(drill())
+
+    def test_non_finite_rule_values_degrade(self):
+        """json.loads accepts NaN/Infinity literals: a NaN weight must
+        not poison the deficit arithmetic into starving the tenant."""
+        r = TenantRule(weight=float("nan"), max_concurrency=float("inf"),
+                       bandwidth=float("nan"))
+        assert r.weight == 1.0
+        assert r.max_concurrency == 0
+        assert r.bandwidth == 0
+
+    def test_gate_flip_seeding_bounds_combined_admissions(self):
+        """Review fix: a runtime gate flip seeds the new plane with the
+        legacy semaphore's in-flight count, so combined admissions
+        never exceed the pool (the executor-sizing invariant)."""
+        p = QosPlane(4)
+        p.seed_external(3)              # 3 legacy requests in flight
+        assert p.try_admit("bucket:a")  # 4th slot
+        assert not p.try_admit("bucket:b"), \
+            "plane ignored the legacy holds: combined overcommit"
+        assert p.saturated()
+        p.external_release()            # one legacy request finished
+        assert p.try_admit("bucket:b")
+        # surplus external releases are guarded no-ops
+        p.external_release()
+        p.external_release()
+        p.external_release()
+        assert p.stats()["active"] == 2  # exactly a + b remain
+
+    def test_hot_lane_folds_into_tenant_stats(self):
+        p = QosPlane(2)
+        p.note_hot_admit("bucket:a")
+        p.note_hot_reject("bucket:a")
+        st = p.stats()["tenants"]["bucket:a"]
+        assert st["hotLaneAdmits"] == 1
+        assert st["hotLaneRejections"] == 1
+
+
+# ----------------------------------------------------- bandwidth buckets
+class TestBandwidth:
+    def test_debit_within_burst_is_free(self):
+        b = TokenBucket(1000.0)
+        assert b.debit(500) == 0.0
+
+    def test_debit_overdraft_returns_wait(self):
+        b = TokenBucket(1000.0)
+        assert b.debit(1000) == 0.0          # burst allowance
+        wait = b.debit(2000)                 # 2 s of debt at 1000 B/s
+        assert 1.8 <= wait <= 2.2
+
+    def test_acquire_still_paces(self):
+        b = TokenBucket(10_000.0)
+        b.debit(10_000)                      # drain the burst
+        t0 = time.monotonic()
+        b.acquire(2_000)                     # 0.2 s of debt
+        assert time.monotonic() - t0 >= 0.15
+
+    def test_per_tenant_buckets_are_isolated(self):
+        p = QosPlane(4, rules={
+            "bucket:hot": TenantRule(bandwidth=1000),
+        })
+        # hot tenant overdraws its own bucket...
+        assert p.bw_wait("bucket:hot", 1000, "out") == 0.0
+        assert p.bw_wait("bucket:hot", 4000, "out") > 0.0
+        # ...and the unlimited quiet tenant never pays for it
+        assert p.bw_wait("bucket:quiet", 1 << 20, "out") == 0.0
+        st = p.stats()["tenants"]
+        assert st["bucket:hot"]["throttledOutBytes"] == 5000
+        assert st["bucket:quiet"]["throttledOutBytes"] == 1 << 20
+
+    def test_reconfigure_rebuilds_bucket_only_on_change(self):
+        p = QosPlane(4, rules={"bucket:a": TenantRule(bandwidth=1000)})
+        p.bw_wait("bucket:a", 1000, "in")    # drain the burst
+        with p._mu:
+            bw_before = p._tenants["bucket:a"].bw
+        # unchanged limit: same bucket (debt preserved — reconfigure
+        # cannot be used to reset pacing)
+        p.reconfigure(rules={"bucket:a": TenantRule(bandwidth=1000,
+                                                    weight=2)})
+        with p._mu:
+            assert p._tenants["bucket:a"].bw is bw_before
+        p.reconfigure(rules={"bucket:a": TenantRule(bandwidth=2000)})
+        with p._mu:
+            assert p._tenants["bucket:a"].bw is not bw_before
+        p.reconfigure(rules={"bucket:a": TenantRule(bandwidth=0)})
+        with p._mu:
+            assert p._tenants["bucket:a"].bw is None
+
+    def test_rates_monitor_reports_per_tenant(self):
+        p = QosPlane(4)
+        p.bw_wait("bucket:a", 5000, "out")
+        rep = p.rates()
+        assert "bucket:a" in rep
+        assert rep["bucket:a"]["out"]["windowBytes"] == 5000
+
+
+# ------------------------------------------------- config / construction
+class TestConfigPlumbing:
+    def test_gate_env_wins(self, monkeypatch):
+        monkeypatch.setenv("MINIO_TPU_QOS", "0")
+        assert not QosPlane.gate_enabled(None)
+        monkeypatch.setenv("MINIO_TPU_QOS", "1")
+        assert QosPlane.gate_enabled(None)
+        monkeypatch.delenv("MINIO_TPU_QOS")
+        assert not QosPlane.gate_enabled(None)  # default off
+
+    def test_env_knobs_and_malformed_tenants_degrade(self, monkeypatch):
+        monkeypatch.setenv("MINIO_TPU_QOS_DEFAULT_WEIGHT", "2.5")
+        monkeypatch.setenv("MINIO_TPU_QOS_MAX_QUEUE", "7")
+        monkeypatch.setenv("MINIO_TPU_QOS_TENANTS", "{not json")
+        p = QosPlane(4)
+        p.load_config(None)
+        assert p.default_rule.weight == 2.5
+        assert p.max_queue == 7
+        assert p.rules == {}  # malformed JSON must not fail boot
+
+    def test_rule_parsing_and_min_weight_clamp(self):
+        rules = QosPlane._parse_rules(
+            json.dumps({"bucket:a": {"weight": 0, "bandwidth": 9},
+                        "key:AK": {"max_concurrency": 3},
+                        "junk": "not-a-dict"}),
+            TenantRule())
+        assert rules["bucket:a"].weight > 0        # clamped positive
+        assert rules["bucket:a"].bandwidth == 9
+        assert rules["key:AK"].max_concurrency == 3
+        assert "junk" not in rules
+
+
+# ------------------------------------------------------ HTTP integration
+class TestQosHTTP:
+    def test_gate_off_is_legacy_plane(self, tmp_path, monkeypatch):
+        """MINIO_TPU_QOS unset: no plane, no qos metrics families, no
+        tenant tags — the single-semaphore path is untouched."""
+        monkeypatch.delenv("MINIO_TPU_QOS", raising=False)
+        monkeypatch.setenv("MINIO_PROMETHEUS_AUTH_TYPE", "public")
+        srv = S3TestServer(str(tmp_path / "off"))
+        try:
+            assert srv.server.qos is None
+            assert srv.request("PUT", "/bkt").status == 200
+            assert srv.request("PUT", "/bkt/o", data=b"x" * 1024).status \
+                == 200
+            r = srv.request("GET", "/bkt/o")
+            assert r.status == 200 and r.body == b"x" * 1024
+            m = srv.request("GET", "/minio/v2/metrics/node",
+                            unsigned=True)
+            assert m.status == 200
+            assert "minio_qos_" not in m.text(), \
+                "gate-off server leaked qos metric families"
+        finally:
+            srv.close()
+
+    def test_gate_differential_byte_identity(self, tmp_path, monkeypatch):
+        """The same uncontended request script returns byte-identical
+        bodies/status/ETags with the gate on and off."""
+        monkeypatch.setenv("MINIO_PROMETHEUS_AUTH_TYPE", "public")
+        payload = b"qos-differential " * 4096
+
+        def script(srv):
+            out = []
+            r = srv.request("PUT", "/bkt")
+            out.append((r.status, b""))
+            r = srv.request("PUT", "/bkt/obj", data=payload)
+            out.append((r.status, b"", r.headers.get("ETag")))
+            r = srv.request("GET", "/bkt/obj")
+            out.append((r.status, r.body, r.headers.get("ETag")))
+            r = srv.request("GET", "/bkt/obj",
+                            headers={"Range": "bytes=100-199"})
+            out.append((r.status, r.body,
+                        r.headers.get("Content-Range")))
+            r = srv.request("HEAD", "/bkt/obj")
+            out.append((r.status, b"",
+                        r.headers.get("Content-Length")))
+            r = srv.request("GET", "/bkt/missing")
+            out.append((r.status,))  # bodies carry random request ids
+            return out
+
+        monkeypatch.delenv("MINIO_TPU_QOS", raising=False)
+        off_srv = S3TestServer(str(tmp_path / "off"))
+        try:
+            off = script(off_srv)
+        finally:
+            off_srv.close()
+        monkeypatch.setenv("MINIO_TPU_QOS", "1")
+        on_srv = S3TestServer(str(tmp_path / "on"))
+        try:
+            assert on_srv.server.qos is not None
+            on = script(on_srv)
+        finally:
+            on_srv.close()
+        assert off == on
+
+    def test_admin_roundtrip_persists_and_applies_live(self, tmp_path,
+                                                       monkeypatch):
+        monkeypatch.setenv("MINIO_TPU_QOS", "1")
+        srv = S3TestServer(str(tmp_path / "adm"))
+        try:
+            assert srv.request("PUT", "/bkt").status == 200
+            body = json.dumps({
+                "defaults": {"weight": 2},
+                "max_queue": 9,
+                "tenants": {
+                    "bucket:bkt": {"weight": 4, "bandwidth": 1 << 20},
+                    "key:AKIDX": {"max_concurrency": 2},
+                },
+            }).encode()
+            r = srv.request("PUT", "/minio/admin/v3/qos", data=body)
+            assert r.status == 200, r.text()
+            doc = json.loads(r.body)
+            assert doc["enabled"]
+            assert doc["rules"]["bucket:bkt"]["weight"] == 4.0
+            assert doc["rules"]["key:AKIDX"]["max_concurrency"] == 2
+            assert doc["maxQueue"] == 9
+            assert doc["defaults"]["weight"] == 2.0
+            # persisted through the config subsystem
+            assert json.loads(
+                srv.server.config.get("qos", "tenants"))[
+                    "bucket:bkt"]["weight"] == 4
+            # applied LIVE to the scheduler (no restart)
+            plane = srv.server.qos
+            assert plane.rules["bucket:bkt"].weight == 4.0
+            assert plane.max_queue == 9
+            # traffic lands under the reweighted tenant
+            assert srv.request("PUT", "/bkt/o", data=b"y").status == 200
+            g = srv.request("GET", "/minio/admin/v3/qos")
+            live = json.loads(g.body)["tenants"]["bucket:bkt"]
+            assert live["weight"] == 4.0
+            assert live["admitted"] >= 1
+        finally:
+            srv.close()
+
+    def test_admin_put_validation(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MINIO_TPU_QOS", "1")
+        srv = S3TestServer(str(tmp_path / "val"))
+        try:
+            for bad in (b"{not json",
+                        json.dumps({"tenants": {
+                            "weird": {"weight": 1}}}).encode(),
+                        json.dumps({"tenants": {
+                            "bucket:x": {"weight": -1}}}).encode(),
+                        json.dumps({"tenants": {
+                            "bucket:x": {"wieght": 1}}}).encode(),
+                        json.dumps({"max_queue": 0}).encode(),
+                        # truthy STRING must not flip the gate ON
+                        json.dumps({"enable": "off"}).encode(),
+                        # bool is an int subclass: not a number
+                        json.dumps({"defaults": {
+                            "weight": True}}).encode(),
+                        json.dumps({"max_queue": True}).encode(),
+                        json.dumps({"tenants": {
+                            "bucket:x": {"bandwidth": True}}}).encode(),
+                        # json.loads parses NaN/Infinity: reject them
+                        b'{"tenants": {"bucket:x": {"weight": NaN}}}',
+                        b'{"defaults": {"weight": Infinity}}',
+                        b"{}"):
+                r = srv.request("PUT", "/minio/admin/v3/qos", data=bad)
+                assert r.status == 400, (bad, r.text())
+        finally:
+            srv.close()
+
+    def test_admin_gate_flip_at_runtime(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("MINIO_TPU_QOS", raising=False)
+        srv = S3TestServer(str(tmp_path / "flip"))
+        try:
+            assert srv.server.qos is None
+            r = srv.request("PUT", "/minio/admin/v3/qos",
+                            data=json.dumps({"enable": True}).encode())
+            assert r.status == 200, r.text()
+            assert srv.server.qos is not None
+            assert srv.request("PUT", "/bkt").status == 200
+            assert srv.request("PUT", "/bkt/o", data=b"z").status == 200
+            assert srv.request("GET", "/bkt/o").body == b"z"
+            r = srv.request("PUT", "/minio/admin/v3/qos",
+                            data=json.dumps({"enable": False}).encode())
+            assert r.status == 200
+            assert srv.server.qos is None
+            assert srv.request("GET", "/bkt/o").body == b"z"
+        finally:
+            srv.close()
+
+    def test_budget_expiry_in_tenant_queue_sheds(self, tmp_path,
+                                                 monkeypatch):
+        """One slot held by a slow PUT; a queued GET with a 150 ms
+        budget sheds 503 SlowDown from INSIDE the tenant queue, with
+        the wait charged to the budget (sub-second shed) and counted
+        per tenant."""
+        monkeypatch.setenv("MINIO_TPU_QOS", "1")
+        monkeypatch.setenv("MINIO_API_REQUESTS_MAX", "1")
+        monkeypatch.setenv("MINIO_API_REQUESTS_DEADLINE", "10s")
+        import os as _os
+        import threading
+
+        from minio_tpu.erasure.sets import (ErasureServerPools,
+                                            ErasureSets)
+        from minio_tpu.storage.instrumented import InstrumentedStorage
+        from minio_tpu.storage.local import LocalStorage
+        from minio_tpu.storage.naughty import ChaosDisk
+
+        _os.environ["MINIO_TPU_FSYNC"] = "0"
+        chaos = [ChaosDisk(LocalStorage(str(tmp_path / f"d{i}")))
+                 for i in range(4)]
+        pools = ErasureServerPools(
+            [ErasureSets([InstrumentedStorage(c) for c in chaos],
+                         set_size=4)])
+        srv = S3TestServer(str(tmp_path / "exp"), pools=pools)
+        try:
+            assert srv.request("PUT", "/bkt").status == 200
+            for c in chaos:
+                c.set_latency(0.4)
+            holder = threading.Thread(
+                target=lambda: srv.request("PUT", "/bkt/slow",
+                                           data=b"s" * 4096))
+            holder.start()
+            time.sleep(0.25)  # the one slot is occupied
+            t0 = time.monotonic()
+            r = srv.request("GET", "/bkt/slow",
+                            headers={"x-amz-request-timeout": "150ms"})
+            dt = time.monotonic() - t0
+            assert r.status == 503
+            assert b"<Code>SlowDown</Code>" in r.body
+            assert b"per-tenant QoS" in r.body
+            assert r.headers.get("Retry-After") == "1"
+            assert dt < 1.0, f"queued shed took {dt:.2f}s"
+            st = srv.server.qos.stats()["tenants"]["bucket:bkt"]
+            assert st["shedDeadline"] == 1
+            holder.join(15)
+        finally:
+            for c in chaos:
+                c.restore()
+            srv.close()
+
+    def test_trace_root_carries_tenant_tag(self, tmp_path, monkeypatch):
+        """ISSUE 13 observability satellite: with QoS on, every request
+        trace root is tagged tenant= so /trace/slow attributes queue
+        wait and sheds to the offending tenant."""
+        monkeypatch.setenv("MINIO_TPU_QOS", "1")
+        monkeypatch.setenv("MINIO_TPU_TRACE", "1")
+        monkeypatch.setenv("MINIO_TPU_TRACE_SAMPLE", "1")
+        from minio_tpu.utils import tracing
+
+        srv = S3TestServer(str(tmp_path / "trc"))
+        try:
+            assert srv.request("PUT", "/bkt").status == 200
+            assert srv.request("PUT", "/bkt/o", data=b"t").status == 200
+            r = srv.request("GET", "/bkt/o")
+            assert r.status == 200
+            tid = r.headers.get("x-minio-tpu-trace-id")
+            assert tid
+            deadline = time.time() + 3.0
+            doc = tracing.store.get(tid)
+            while doc is None and time.time() < deadline:
+                time.sleep(0.02)
+                doc = tracing.store.get(tid)
+            assert doc is not None
+            root = [s for s in doc["spans"] if s.get("parent") is None]
+            assert root and root[0].get("tenant") == "bucket:bkt", root
+            adm = [s for s in doc["spans"] if s["name"] == "admission"]
+            assert adm and adm[0].get("lane") in ("qos", "hot"), adm
+        finally:
+            srv.close()
+
+    def test_queue_full_sheds_tenant_while_other_flows(self, tmp_path,
+                                                       monkeypatch):
+        """Hot tenant's queue bound overflows -> 503 for the hot
+        tenant; a quiet tenant queued at the same moment still gets
+        served."""
+        monkeypatch.setenv("MINIO_TPU_QOS", "1")
+        monkeypatch.setenv("MINIO_API_REQUESTS_MAX", "1")
+        monkeypatch.setenv("MINIO_TPU_QOS_MAX_QUEUE", "1")
+        monkeypatch.setenv("MINIO_API_REQUESTS_DEADLINE", "20s")
+        import os as _os
+        import threading
+
+        from minio_tpu.erasure.sets import (ErasureServerPools,
+                                            ErasureSets)
+        from minio_tpu.storage.instrumented import InstrumentedStorage
+        from minio_tpu.storage.local import LocalStorage
+        from minio_tpu.storage.naughty import ChaosDisk
+
+        _os.environ["MINIO_TPU_FSYNC"] = "0"
+        chaos = [ChaosDisk(LocalStorage(str(tmp_path / f"d{i}")))
+                 for i in range(4)]
+        pools = ErasureServerPools(
+            [ErasureSets([InstrumentedStorage(c) for c in chaos],
+                         set_size=4)])
+        srv = S3TestServer(str(tmp_path / "qf"), pools=pools)
+        try:
+            assert srv.request("PUT", "/hotb").status == 200
+            assert srv.request("PUT", "/quietb").status == 200
+            assert srv.request("PUT", "/hotb/o", data=b"h").status == 200
+            assert srv.request("PUT", "/quietb/o",
+                               data=b"q").status == 200
+            plane = srv.server.qos
+            results = {}
+
+            def req(method, path, tag, data=None):
+                results[tag] = srv.request(method, path, data=data)
+
+            # occupy the single slot with a genuinely slow hot-tenant
+            # PUT, then queue one hot GET behind it (queue bound = 1)
+            for c in chaos:
+                c.set_latency(0.5)
+            holder = threading.Thread(
+                target=req, args=("PUT", "/hotb/slow", "hold",
+                                  b"s" * 4096))
+            holder.start()
+            time.sleep(0.3)  # the slot is now held
+            t1 = threading.Thread(target=req,
+                                  args=("GET", "/hotb/o", "q1"))
+            t1.start()
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if plane.stats()["tenants"].get(
+                        "bucket:hotb", {}).get("queueDepth", 0) >= 1:
+                    break
+                time.sleep(0.02)
+            # hot queue is full (bound 1): next hot request sheds NOW
+            t0 = time.monotonic()
+            r = srv.request("GET", "/hotb/o")
+            assert r.status == 503, r.status
+            assert b"tenant" in r.body
+            assert time.monotonic() - t0 < 1.0
+            # the quiet tenant's own (empty) queue still accepts
+            t2 = threading.Thread(target=req,
+                                  args=("GET", "/quietb/o", "q2"))
+            t2.start()
+            time.sleep(0.1)
+            for c in chaos:
+                c.restore()    # let the backlog drain fast
+            holder.join(20)
+            t1.join(15)
+            t2.join(15)
+            assert results["hold"].status == 200
+            assert results["q1"].status == 200
+            assert results["q2"].status == 200
+            st = plane.stats()["tenants"]
+            assert st["bucket:hotb"]["shedQueueFull"] == 1
+            assert st.get("bucket:quietb", {}).get("shedQueueFull",
+                                                   0) == 0
+        finally:
+            for c in chaos:
+                c.restore()
+            srv.close()
+
+    def test_put_and_get_metered_per_tenant(self, tmp_path, monkeypatch):
+        """A tenant bandwidth limit paces both ingest and egress; an
+        unlimited tenant moving the same bytes is not slowed."""
+        monkeypatch.setenv("MINIO_TPU_QOS", "1")
+        monkeypatch.setenv(
+            "MINIO_TPU_QOS_TENANTS",
+            json.dumps({"bucket:slow": {"bandwidth": 256 * 1024}}))
+        srv = S3TestServer(str(tmp_path / "bw"))
+        try:
+            assert srv.request("PUT", "/slow").status == 200
+            assert srv.request("PUT", "/fast").status == 200
+            payload = b"b" * (768 * 1024)  # 3x the 256 KiB/s limit
+            # burst allowance covers the first second; the rest paces
+            t0 = time.monotonic()
+            assert srv.request("PUT", "/slow/o",
+                               data=payload).status == 200
+            slow_put = time.monotonic() - t0
+            t0 = time.monotonic()
+            assert srv.request("PUT", "/fast/o",
+                               data=payload).status == 200
+            fast_put = time.monotonic() - t0
+            assert slow_put > fast_put + 0.8, (slow_put, fast_put)
+            # egress: the slow tenant's bucket is already deep in debt
+            t0 = time.monotonic()
+            r = srv.request("GET", "/fast/o")
+            assert r.status == 200 and r.body == payload
+            fast_get = time.monotonic() - t0
+            t0 = time.monotonic()
+            r = srv.request("GET", "/slow/o")
+            assert r.status == 200 and r.body == payload
+            slow_get = time.monotonic() - t0
+            assert slow_get > fast_get + 0.8, (slow_get, fast_get)
+            st = srv.server.qos.stats()["tenants"]
+            assert st["bucket:slow"]["throttledInBytes"] >= len(payload)
+            assert st["bucket:slow"]["throttledOutBytes"] >= len(payload)
+        finally:
+            srv.close()
+
+
+# ------------------------------------------------------- STS (carried gap)
+# The full JWKS round trip for AssumeRoleWithClientGrants lives with
+# the other STS tests (tests/test_sts_kms.py TestClientGrantsSTS),
+# which skip without the optional `cryptography` wheel.  This
+# stub-provider variant keeps the handler path (form parsing, alias
+# wiring, ClientGrants response shape, error mapping) exercised in
+# minimal containers.
+class TestClientGrantsHandler:
+    class _StubProvider:
+        def __init__(self):
+            self.policies = ["cgread"]
+
+        def validate(self, token):
+            from minio_tpu.iam.oidc import OIDCError
+
+            if token != "good-token":
+                raise OIDCError("signature check failed")
+            return {"sub": "app-7@idp", "exp": time.time() + 300,
+                    "policy": "cgread"}
+
+        def policies_for(self, claims):
+            return list(self.policies)
+
+    def _exchange(self, srv, token: str | None, duration=900):
+        body = ("Action=AssumeRoleWithClientGrants&Version=2011-06-15"
+                f"&DurationSeconds={duration}")
+        if token is not None:
+            body += f"&Token={token}"
+        return srv.raw_request(
+            "POST", "/", data=body.encode(),
+            headers={"content-type":
+                     "application/x-www-form-urlencoded",
+                     "host": srv.host})
+
+    def test_round_trip_and_errors(self, tmp_path):
+        import re
+
+        srv = S3TestServer(str(tmp_path))
+        try:
+            srv.server.oidc = self._StubProvider()
+            srv.iam.set_policy("cgread", json.dumps({
+                "Statement": [
+                    {"Effect": "Allow", "Action": ["s3:GetObject"],
+                     "Resource": "arn:aws:s3:::cgb/*"},
+                ],
+            }))
+            assert srv.request("PUT", "/cgb").status == 200
+            assert srv.request("PUT", "/cgb/o",
+                               data=b"grant").status == 200
+            r = self._exchange(srv, "good-token")
+            assert r.status == 200, r.text()
+            xml = r.text()
+            assert "<AssumeRoleWithClientGrantsResponse" in xml
+            assert "<SubjectFromToken>app-7@idp</SubjectFromToken>" \
+                in xml
+            assert "WebIdentity" not in xml
+            ak = re.search(r"<AccessKeyId>([^<]+)</AccessKeyId>",
+                           xml).group(1)
+            sk = re.search(
+                r"<SecretAccessKey>([^<]+)</SecretAccessKey>",
+                xml).group(1)
+            assert ak.startswith("STS")
+            assert srv.request("GET", "/cgb/o",
+                               creds=(ak, sk)).body == b"grant"
+            assert srv.request("PUT", "/cgb/new", data=b"x",
+                               creds=(ak, sk)).status == 403
+            # missing Token -> InvalidArgument; bad token -> the
+            # dedicated InvalidClientGrantsToken code
+            assert self._exchange(srv, None).status == 400
+            r = self._exchange(srv, "forged")
+            assert r.status == 400
+            assert "InvalidClientGrantsToken" in r.text()
+            # no provider configured -> NotImplemented
+            srv.server.oidc = None
+            assert self._exchange(srv, "good-token").status == 501
+        finally:
+            srv.close()

@@ -224,6 +224,73 @@ class TestWebIdentitySTS:
             s.close()
 
 
+# -------------------------------------------------- client grants (HTTP)
+class TestClientGrantsSTS:
+    """AssumeRoleWithClientGrants: the legacy alias of the web-identity
+    exchange (reference cmd/sts-handlers.go) — same JWT validation
+    plane, `Token` form field, ClientGrants response elements (ISSUE 13
+    carried S3 gap)."""
+
+    @pytest.fixture
+    def srv(self, tmp_path, idp):
+        s = S3TestServer(str(tmp_path))
+        s.server.oidc = OpenIDProvider(idp.jwks_url, client_id="minio-tpu")
+        yield s
+        s.close()
+
+    def _exchange(self, srv, token, duration=900):
+        body = ("Action=AssumeRoleWithClientGrants&Version=2011-06-15"
+                f"&DurationSeconds={duration}&Token={token}")
+        return srv.raw_request(
+            "POST", "/", data=body.encode(),
+            headers={"content-type": "application/x-www-form-urlencoded",
+                     "host": srv.host})
+
+    def test_request_response_round_trip(self, srv, idp):
+        srv.iam.set_policy("cgread", json.dumps({
+            "Statement": [
+                {"Effect": "Allow", "Action": ["s3:GetObject"],
+                 "Resource": "arn:aws:s3:::cgb/*"},
+            ],
+        }))
+        assert srv.request("PUT", "/cgb").status == 200
+        assert srv.request("PUT", "/cgb/o", data=b"grant").status == 200
+
+        token = idp.mint({"sub": "app-7@idp", "aud": "minio-tpu",
+                          "exp": time.time() + 300, "policy": "cgread"})
+        r = self._exchange(srv, token)
+        assert r.status == 200, r.text()
+        xml = r.text()
+        # ClientGrants element names, NOT the WebIdentity ones
+        assert "<AssumeRoleWithClientGrantsResponse" in xml
+        assert "<AssumeRoleWithClientGrantsResult>" in xml
+        assert "<SubjectFromToken>app-7@idp</SubjectFromToken>" in xml
+        assert "WebIdentity" not in xml
+        ak = re.search(r"<AccessKeyId>([^<]+)</AccessKeyId>", xml).group(1)
+        sk = re.search(r"<SecretAccessKey>([^<]+)</SecretAccessKey>",
+                       xml).group(1)
+        assert ak.startswith("STS")
+        # the minted credentials carry exactly the claimed policy
+        assert srv.request("GET", "/cgb/o", creds=(ak, sk)).body \
+            == b"grant"
+        assert srv.request("PUT", "/cgb/new", data=b"x",
+                           creds=(ak, sk)).status == 403
+
+    def test_missing_and_invalid_token(self, srv, idp):
+        body = "Action=AssumeRoleWithClientGrants&Version=2011-06-15"
+        r = srv.raw_request(
+            "POST", "/", data=body.encode(),
+            headers={"content-type": "application/x-www-form-urlencoded",
+                     "host": srv.host})
+        assert r.status == 400
+        bad = idp.mint({"sub": "x", "aud": "minio-tpu",
+                        "exp": time.time() + 300, "policy": "cgread"},
+                       corrupt_sig=True)
+        r = self._exchange(srv, bad)
+        assert r.status == 400
+        assert "InvalidClientGrantsToken" in r.text()
+
+
 # ----------------------------------------------------------------- fake KES
 class FakeKES:
     """In-memory KES: named AES-256-GCM master keys, the three REST
