@@ -1858,7 +1858,263 @@ def main_trace():
     print(json.dumps(doc, indent=2))
 
 
+def bench_topo(nobjects=96, obj_kib=32, nhot=6):
+    """BENCH_r16: topology-change-under-live-traffic drill (ISSUE 14).
+
+    One two-pool cluster behind the REAL HTTP server (hot tier on) plus
+    a site peer; live writer/reader traffic runs while pool 0
+    decommissions; the drain is KILLED mid-flight (thread dies without
+    a final state save — the closest in-process analogue of SIGKILL)
+    and restarted; the site peer is killed mid-resync and restarted at
+    the same address.  Measures drain throughput and convergence wall
+    time; asserts (and records) zero lost versions, byte-identity
+    versus a never-drained control, read-your-writes through the hot
+    tier, and site convergence through the retried pushes.
+    """
+    import io as _io
+    import shutil
+    import threading
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tests"))
+    from s3_harness import S3TestServer
+
+    from minio_tpu.erasure.sets import ErasureServerPools, ErasureSets
+    from minio_tpu.services import decom as decom_mod
+    from minio_tpu.services.decom import PoolDecommission, load_state
+    from minio_tpu.storage.local import LocalStorage
+
+    os.environ["MINIO_TPU_FSYNC"] = "0"
+    os.environ["MINIO_TPU_HOTCACHE_BYTES"] = str(128 << 20)
+    root = tempfile.mkdtemp(prefix="bench-topo-")
+    out = {"nobjects": nobjects, "obj_kib": obj_kib}
+    try:
+        pools = ErasureServerPools([
+            ErasureSets([LocalStorage(f"{root}/a/p{p}-d{i}")
+                         for i in range(4)], set_size=4, pool_index=p)
+            for p in range(2)])
+        srv = S3TestServer(f"{root}/a", pools=pools)
+        peer = S3TestServer(f"{root}/b")
+        peer_port = peer.port
+        try:
+            r = srv.request(
+                "POST", "/minio/admin/v3/site-replication/add",
+                data=json.dumps({"peers": [{
+                    "name": "siteB",
+                    "endpoint": f"http://127.0.0.1:{peer_port}",
+                    "accessKey": peer.ak,
+                    "secretKey": peer.sk}]}).encode())
+            assert r.status == 200, r.body
+            srv.request("PUT", "/topo")
+            payload = {f"k{i:03d}": bytes([i % 251]) * (obj_kib << 10)
+                       for i in range(nobjects)}
+            t0 = time.perf_counter()
+            for k, v in payload.items():
+                assert srv.request("PUT", f"/topo/{k}",
+                                   data=v).status == 200
+            out["seed_put_s"] = round(time.perf_counter() - t0, 3)
+            n_src = len(pools.pools[0].list_objects("topo"))
+            src_bytes = sum(len(payload[o])
+                            for o in pools.pools[0].list_objects("topo")
+                            if o in payload)
+            out["pool0_objects"] = n_src
+            out["pool0_mib"] = round(src_bytes / (1 << 20), 2)
+
+            stop = threading.Event()
+            mu = threading.Lock()
+            acked, get_errs, gets = {}, [], [0]
+
+            def writer():
+                i = 0
+                while not stop.is_set():
+                    k = f"hot{i % nhot}"
+                    v = f"gen-{i}-".encode() * 64
+                    if srv.request("PUT", f"/topo/{k}",
+                                   data=v).status == 200:
+                        with mu:
+                            acked[k] = v
+                    i += 1
+                    time.sleep(0.005)
+
+            def reader():
+                keys = sorted(payload)
+                i = 0
+                while not stop.is_set():
+                    k = keys[i % len(keys)]
+                    rr = srv.request("GET", f"/topo/{k}")
+                    gets[0] += 1
+                    if rr.status != 200 or rr.body != payload[k]:
+                        get_errs.append(f"{k}:{rr.status}")
+                    i += 1
+
+            threads = [threading.Thread(target=writer, daemon=True),
+                       threading.Thread(target=reader, daemon=True)]
+            for t in threads:
+                t.start()
+
+            kill_at = max(4, n_src // 3)
+            out["kill_after_objects"] = kill_at
+            job = PoolDecommission(pools, 0)
+            job.checkpoint_every = 4
+            job._crash_hook = lambda moved: moved >= kill_at
+            t0 = time.perf_counter()
+            job.start()
+            job.wait(120)
+            killed_at_s = time.perf_counter() - t0
+            st = load_state(pools.pools[0])
+            out["killed_mid_drain"] = st["state"] == "draining" \
+                and not job._thread.is_alive()
+
+            # site peer dies; resync queues against the corpse
+            peer.close()
+            rs = srv.server.site.resync("siteB", tracker=None, full=True)
+            out["resync_docs_queued"] = rs["queued"]
+
+            # restart the drain (process-restart analogue)
+            t1 = time.perf_counter()
+            job2 = PoolDecommission(pools, 0)
+            out["resumed_from_cursor"] = bool(job2.state.get("cursor"))
+            job2.start()
+            time.sleep(0.4)
+            peer2 = S3TestServer(f"{root}/b", port=peer_port)
+            try:
+                job2.wait(240)
+                drain_s = killed_at_s + (time.perf_counter() - t1)
+                stop.set()
+                for t in threads:
+                    t.join(10)
+                out["drain_converged"] = \
+                    job2.state["state"] == "complete"
+                out["failed_objects"] = job2.state["failed_objects"]
+                moved = job.state["moved_objects"] \
+                    + job2.state["moved_objects"]
+                out["moved_objects_total"] = moved
+                out["drain_wall_s"] = round(drain_s, 3)
+                out["drain_objects_per_s"] = round(moved / drain_s, 1) \
+                    if drain_s else None
+                out["gets_during_drain"] = gets[0]
+                out["get_errors_during_drain"] = len(get_errs)
+
+                with mu:
+                    final = dict(payload, **acked)
+                lost = ryw = 0
+                for k, v in final.items():
+                    b1 = srv.request("GET", f"/topo/{k}").body
+                    b2 = srv.request("GET", f"/topo/{k}").body
+                    if b1 != v:
+                        lost += 1
+                    if b2 != v:
+                        ryw += 1
+                out["lost_versions"] = lost
+                out["read_your_writes_violations"] = ryw
+                out["hot_tier_hits"] = \
+                    srv.server.hotcache.stats()["hits"]
+                out["pool0_empty"] = \
+                    pools.pools[0].list_objects("topo") == []
+
+                # byte identity vs a never-drained control
+                ctl = ErasureServerPools([ErasureSets(
+                    [LocalStorage(f"{root}/ctl-d{i}")
+                     for i in range(4)], set_size=4)])
+                ctl.make_bucket("topo")
+                mismatch = 0
+                for k, v in final.items():
+                    ctl.put_object("topo", k, _io.BytesIO(v), len(v))
+                for k in final:
+                    _, s = ctl.get_object("topo", k)
+                    if b"".join(s) != srv.request(
+                            "GET", f"/topo/{k}").body:
+                        mismatch += 1
+                out["control_mismatches"] = mismatch
+
+                deadline = time.time() + 60
+                site_ok = False
+                while time.time() < deadline:
+                    info = srv.server.site.info()
+                    if info["queued"] == 0 and peer2.request(
+                            "HEAD", "/topo").status == 200:
+                        site_ok = True
+                        break
+                    time.sleep(0.25)
+                out["site_converged_after_peer_kill"] = site_ok
+                out["site_push_retries"] = \
+                    srv.server.site.info()["retries"]
+                with decom_mod._stats_mu:
+                    out["topology_counters"] = dict(decom_mod.stats)
+            finally:
+                peer2.close()
+        finally:
+            srv.close()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
+def main_topo():
+    """`python bench.py topo`: the BENCH_r16 topology-change letter
+    (ISSUE 14)."""
+    r = bench_topo()
+    doc = {
+        "topology_change": {
+            "method": (
+                "one two-pool (4+4 drive) cluster behind the real "
+                "HTTP server, hot tier on, plus a site-replication "
+                "peer; 96 x 32 KiB immutable probe objects + 6 hot "
+                "keys overwritten continuously; pool 0 decommissions "
+                "under that traffic, the drain thread is KILLED "
+                "mid-flight without a final state save (SIGKILL "
+                "analogue) and a fresh job resumes from the "
+                "quorum-persisted object cursor; the site peer is "
+                "killed mid-resync and restarted at the same port so "
+                "the retried signed pushes converge"),
+            "results": r,
+            "acceptance": {
+                "killed_mid_drain": r.get("killed_mid_drain"),
+                "converged_after_kill": r.get("drain_converged")
+                and r.get("failed_objects") == 0
+                and r.get("pool0_empty"),
+                "zero_lost_versions": r.get("lost_versions") == 0,
+                "read_your_writes_through_hot_tier":
+                    r.get("read_your_writes_violations") == 0
+                    and (r.get("hot_tier_hits") or 0) > 0,
+                "byte_identity_vs_undrained_control":
+                    r.get("control_mismatches") == 0,
+                "zero_get_errors_during_drain":
+                    r.get("get_errors_during_drain") == 0,
+                "site_converged_after_peer_kill":
+                    r.get("site_converged_after_peer_kill"),
+                "note": (
+                    "honest clause for THIS box: wall times include "
+                    "the deliberate kill + restart + peer-restart "
+                    "sleeps, so drain_objects_per_s understates mover "
+                    "throughput; the correctness clauses (zero lost, "
+                    "byte identity, read-your-writes, convergence) "
+                    "are what this letter certifies — throughput at "
+                    "scale belongs to a multi-core re-run.  The same "
+                    "drill runs serial-isolated in tier-1 "
+                    "(tests/test_topology.py)."),
+            },
+        },
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_r16.json")
+    existing = {}
+    if os.path.exists(path):
+        with open(path, encoding="utf-8") as f:
+            existing = json.load(f)
+    existing.update(doc)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(existing, f, indent=2)
+        f.write("\n")
+    print(json.dumps(doc, indent=2))
+    ok = doc["topology_change"]["acceptance"]
+    return 0 if all(v is True for k, v in ok.items()
+                    if k != "note") else 1
+
+
 if __name__ == "__main__":
+    if "topo" in sys.argv[1:]:
+        sys.exit(main_topo())
     if "trace" in sys.argv[1:]:
         sys.exit(main_trace())
     if "repair" in sys.argv[1:]:

@@ -1,3 +1,3 @@
 """Protocol models: importing this package registers every model."""
 
-from . import batcher, breaker, hotcache, qos, ring  # noqa: F401
+from . import batcher, breaker, hotcache, qos, ring, topology  # noqa: F401

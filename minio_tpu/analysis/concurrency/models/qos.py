@@ -13,14 +13,20 @@ tenant 503s while every other tenant keeps flowing.  A queued request
 whose deadline budget expires leaves the queue as a DEADLINE shed (the
 one legal not-full departure, modelled as a dequeue).
 
-DRR discipline, modelled exactly as implemented (unit request cost):
+DRR discipline, modelled exactly as implemented (ISSUE 14 satellite:
+requests carry a byte-estimated COST, clamped to [1, max_cost], so one
+multipart PUT is priced honestly against N small GETs):
 
 * a dispatch visit tops a servable tenant's deficit up by its weight
-  ONCE per visit, and only when the tenant cannot already afford an
-  admission (deficit < 1) — quantum is never banked on top of
-  spendable credit, which bounds the counter by the weight;
-* admissions spend 1 deficit each and stop at the global-slot pool,
-  the tenant cap, an empty queue, or an exhausted deficit;
+  ONCE per visit, and only when the tenant cannot already afford its
+  queue head (deficit < cost) — quantum is never banked on top of
+  spendable credit, which bounds the counter by weight + max_cost - 1;
+* a top-up that does not yet afford the head COUNTS AS PROGRESS: a
+  heavy request (cost > weight) needs several sweep rounds to save up,
+  and a sweep that only counts admissions as progress would exit early
+  and strand it on an idle plane (the liveness half of byte pricing);
+* admissions spend the request's cost and stop at the global-slot
+  pool, the tenant cap, an empty queue, or an unaffordable head;
 * a queue that empties (by admission or expiry) forfeits its residual
   deficit (classic DRR reset: credit must not accumulate across idle
   periods);
@@ -33,8 +39,14 @@ Invariants:
                                tenant cap, total inflight never exceeds
                                the global slot pool, and the pool's
                                used-counter stays consistent.
-* ``deficit-conservation``   — 0 <= deficit <= weight at every state,
-                               and an empty queue holds zero deficit.
+* ``deficit-conservation``   — 0 <= deficit <= weight + cost - 1 per
+                               tenant at every state (one quantum past
+                               the head's price — saving toward a heavy
+                               head, never hoarding), and an empty
+                               queue holds zero deficit.
+* ``cost-priced``            — deficit spent == cost of admissions
+                               granted, per tenant: a heavy request
+                               cannot ride at unit price.
 * ``shed-only-when-full``    — an arrival is shed only when its
                                tenant's queue stood at the limit.
 * ``no-starvation``          — terminal: a quiescent system has no
@@ -50,7 +62,8 @@ release protocol that strands grants would surface as a wedge.
 Every invariant is proven live by a seeded mutation (tier-1 pins the
 matrix in tests/test_modelcheck.py): rotation-skips-tenant,
 release-skips-dispatch, shed-below-limit, admit-ignores-cap,
-deficit-banked-while-empty, reweight-keeps-stale-deficit.
+deficit-banked-while-empty, reweight-keeps-stale-deficit,
+admit-spends-unit-cost, save-up-not-progress.
 """
 
 from __future__ import annotations
@@ -58,11 +71,13 @@ from __future__ import annotations
 from ..modelcheck import Model, register
 
 #: per-tenant state vector indices
-W, CAP, INFLIGHT, QUEUE, DEFICIT, ADMITTED, SHED, ARRIVALS = range(8)
+(W, CAP, INFLIGHT, QUEUE, DEFICIT, ADMITTED, SHED, ARRIVALS, COST,
+ PAID, SERVED) = range(11)
 
 
 def _dispatch(s, skip: set | None = None, ignore_cap: bool = False,
-              banked: bool = False) -> None:
+              banked: bool = False, unit_spend: bool = False,
+              saving_stalls: bool = False) -> None:
     """The release-time DRR sweep.  Mutations perturb it via kwargs so
     the base discipline stays in one place."""
     tens = s["tens"]
@@ -75,18 +90,27 @@ def _dispatch(s, skip: set | None = None, ignore_cap: bool = False,
         for off in range(len(order)):
             t = order[(s["rr_i"] + off) % len(order)]
             tv = tens[t]
+            cost = tv[COST]
             servable = (tv[QUEUE] > 0 and s["slots_used"] < s["slots"]
                         and (ignore_cap or tv[INFLIGHT] < tv[CAP]))
             if servable:
                 # quantum: once per visit; banked (mutation) tops up
-                # unconditionally, the base only when credit ran out
-                if banked or tv[DEFICIT] < 1:
+                # unconditionally, the base only when the head is not
+                # yet affordable.  Saving toward a heavy head IS
+                # progress — without that, cost > weight wedges
+                # (saving_stalls is the mutation dropping exactly it).
+                if banked or tv[DEFICIT] < cost:
                     tv[DEFICIT] += tv[W]
-                while tv[QUEUE] > 0 and tv[DEFICIT] >= 1 \
+                    if not saving_stalls:
+                        progress = True
+                while tv[QUEUE] > 0 and tv[DEFICIT] >= cost \
                         and s["slots_used"] < s["slots"] \
                         and (ignore_cap or tv[INFLIGHT] < tv[CAP]):
                     tv[QUEUE] -= 1
-                    tv[DEFICIT] -= 1
+                    spend = 1 if unit_spend else cost
+                    tv[DEFICIT] -= spend
+                    tv[PAID] += spend
+                    tv[SERVED] += cost
                     tv[INFLIGHT] += 1
                     tv[ADMITTED] += 1
                     s["slots_used"] += 1
@@ -98,20 +122,24 @@ def _dispatch(s, skip: set | None = None, ignore_cap: bool = False,
 
 def build(deep: bool = False) -> Model:
     arrivals = 4 if deep else 3
-    # tenant a: weight 1 (the quiet tenant a hot neighbor must not
-    # starve); tenant b: weight 3 (the heavy tenant an admin may
-    # reweight down mid-flight).  Caps of 1 against a pool of 2 make
-    # the per-tenant cap BIND (a capless model never exercises it).
+    # tenant a: weight 1 but COST-2 requests (the multipart-PUT shape
+    # byte pricing exists for — cost > weight forces the save-up-
+    # across-sweeps liveness path); tenant b: weight 3, unit cost (the
+    # heavy tenant an admin may reweight down mid-flight).  Caps of 1
+    # against a pool of 2 make the per-tenant cap BIND (a capless model
+    # never exercises it).  Costs arrive pre-clamped to [1, max_cost]
+    # (the clamp itself is input sanitation, pinned by tests/test_qos).
     init = {
         "slots": 2,
         "slots_used": 0,
         "rr": ["a", "b"],
         "rr_i": 0,
         "limit": 2,            # per-tenant queue bound (shed threshold)
+        "max_cost": 2,         # the [1, max_cost] clamp bound
         # tenant -> [weight, cap, inflight, queue, deficit, admitted,
-        #            shed, arrivals_left]
-        "tens": {"a": [1, 1, 0, 0, 0, 0, 0, arrivals],
-                 "b": [3, 1, 0, 0, 0, 0, 0, arrivals]},
+        #            shed, arrivals_left, cost, paid, served]
+        "tens": {"a": [1, 1, 0, 0, 0, 0, 0, arrivals, 2, 0, 0],
+                 "b": [3, 1, 0, 0, 0, 0, 0, arrivals, 1, 0, 0]},
         "bad_shed": False,     # a shed fired while the queue was not full
         "reweights_left": 1,
         # at most one queued request per tenant carries a finite budget
@@ -200,10 +228,20 @@ def build(deep: bool = False) -> Model:
 
     @m.invariant("deficit-conservation")
     def deficit_conservation(s) -> bool:
+        # with byte costs the counter may legitimately save toward an
+        # expensive head across sweeps, but stays under one quantum
+        # past its price: deficit < cost at top-up, plus one weight
         return all(
-            0 <= tv[DEFICIT] <= tv[W]
+            0 <= tv[DEFICIT] <= tv[W] + tv[COST] - 1
             and (tv[QUEUE] > 0 or tv[DEFICIT] == 0)
             for tv in s["tens"].values())
+
+    @m.invariant("cost-priced")
+    def cost_priced(s) -> bool:
+        """Every sweep admission spent exactly its request's cost: a
+        heavy request cannot ride at unit price (the satellite's whole
+        point — one multipart PUT == N small GETs in deficit terms)."""
+        return all(tv[PAID] == tv[SERVED] for tv in s["tens"].values())
 
     @m.invariant("shed-only-when-full")
     def shed_only_when_full(s) -> bool:
@@ -316,6 +354,39 @@ def build(deep: bool = False) -> Model:
             s["tens"]["b"][W] = 1  # deficit NOT clamped
 
         mut.replace_action("reweight_b", effect=reweight_no_clamp)
+
+    @m.mutation("admit-spends-unit-cost",
+                "an admission spends 1 deficit regardless of the "
+                "request's byte cost — a multipart PUT rides at the "
+                "price of a small GET and byte fairness is fiction")
+    def admit_spends_unit(mut: Model) -> None:
+        def release_unit_spend(s, t) -> None:
+            tv = s["tens"][t]
+            tv[INFLIGHT] -= 1
+            s["slots_used"] -= 1
+            _dispatch(s, unit_spend=True)
+
+        for t in ("a", "b"):
+            mut.replace_action(
+                f"{t}_release",
+                effect=lambda s, t=t: release_unit_spend(s, t))
+
+    @m.mutation("save-up-not-progress",
+                "the sweep counts only admissions as progress — a "
+                "request costing more than its tenant's weight can "
+                "never finish saving (the sweep exits after one "
+                "top-up) and strands queued on an idle plane")
+    def save_up_not_progress(mut: Model) -> None:
+        def release_saving_stalls(s, t) -> None:
+            tv = s["tens"][t]
+            tv[INFLIGHT] -= 1
+            s["slots_used"] -= 1
+            _dispatch(s, saving_stalls=True)
+
+        for t in ("a", "b"):
+            mut.replace_action(
+                f"{t}_release",
+                effect=lambda s, t=t: release_saving_stalls(s, t))
 
     return m
 

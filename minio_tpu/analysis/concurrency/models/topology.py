@@ -1,0 +1,326 @@
+"""Model: the pool-drain (decommission) protocol under live traffic and
+crashes (services/decom.py + erasure/pools.py, ISSUE 14) — written
+BEFORE the hardening, per the PR 10 convention.
+
+Two pools; pool 0 drains into pool 1 while a client may overwrite an
+object mid-flight and the drain thread may be KILLED (no final state
+save) and restarted.  Each object is abstracted to its newest
+generation per pool (``p0``/``p1`` hold a generation number or -1) plus
+a cached read route (``route``: the pool a metacache/hot-tier lookup
+would go to, -1 = fan out).  The drain processes objects in order
+through four atomic steps per object — copy, fence (invalidate cached
+routes), delete-source, advance — checkpoints its cursor durably only
+between objects, and a crash loses everything since the last
+checkpoint.
+
+The protocol rules under test (each is a line of services/decom.py):
+
+* **suspend first** — placement stops selecting pool 0 before the first
+  move, so a racing PUT lands on a live pool, never behind the cursor;
+* **commit before delete** — the destination copy exists (write quorum)
+  before the source copy dies;
+* **fence before delete** — cached routes are invalidated before the
+  copy they point at disappears;
+* **never clobber newer** — a destination copy same-or-newer than the
+  source's (an overwrite that landed mid-drain) is kept; the stale
+  source copy is dropped;
+* **checkpoint only completed objects** — the durable cursor advances
+  only after the source-side delete landed, so a crash+resume re-does
+  at most the in-flight object and never skips one.
+
+Invariants:
+
+* ``no-version-lost``   — every object's LIVE generation is readable in
+                          every state: present in some pool, and when a
+                          cached route exists, present in THAT pool.
+* ``no-double-live``    — terminal: at quiescence the drain is done,
+                          pool 0 is empty, and each live generation
+                          lives in exactly one pool.
+* drain-terminates      — the ``done`` predicate: a quiescent state
+                          with the drain not finished is a wedge
+                          (deadlock); crash/resume must converge.
+
+Every invariant is proven live by seeded mutations (tier-1 pins the
+matrix in tests/test_modelcheck.py): delete-before-commit,
+delete-before-fence, copy-clobbers-newer, suspend-after-drain-starts,
+resume-skips-bucket, checkpoint-ahead-of-delete.
+"""
+
+from __future__ import annotations
+
+from ..modelcheck import Model, register
+
+#: drain step cycle per object
+SCAN, COPIED, FENCED, DELETED = "scan", "copied", "fenced", "deleted"
+
+
+def _objs(state) -> dict:
+    return state["objs"]
+
+
+def _cur_obj(state):
+    names = state["names"]
+    i = state["cursor"]
+    return _objs(state)[names[i]] if i < len(names) else None
+
+
+def build(deep: bool = False) -> Model:
+    names = ("x", "y", "z") if deep else ("x", "y")
+    # every object starts as generation 0 in the draining pool; "x" may
+    # be overwritten once mid-flight (the live-traffic hazard)
+    init = {
+        "names": list(names),
+        # per object: p0/p1 = newest generation held (-1 = none),
+        # live = the generation a correct read must return,
+        # route = cached read route (-1 = fan out, else pool index)
+        "objs": {n: {"p0": 0, "p1": -1, "live": 0, "route": -1}
+                 for n in names},
+        "suspended": False,   # pool 0 suspended from placement
+        "drain": "idle",      # idle | run | crashed | done
+        "cursor": 0,          # in-memory object index (lost on crash)
+        "ckpt": 0,            # durable checkpoint (survives crash)
+        "step": SCAN,
+        "puts_left": 2 if deep else 1,
+        "crashes_left": 1,
+        "fills_left": 2,      # bounded route-cache fills
+    }
+    m = Model("topology", init,
+              "pool drain under live traffic: suspend/copy/fence/"
+              "delete/checkpoint with crash+resume")
+
+    n_objs = len(names)
+
+    # -- drain lifecycle ----------------------------------------------------
+    @m.action("start_drain", lambda s: s["drain"] == "idle")
+    def start_drain(s) -> None:
+        # suspension BEFORE the first move (the mutation
+        # suspend-after-drain-starts drops exactly this line)
+        s["suspended"] = True
+        s["drain"] = "run"
+        s["cursor"] = s["ckpt"]
+        s["step"] = SCAN
+
+    def _running(s) -> bool:
+        return s["drain"] == "run" and s["cursor"] < n_objs
+
+    @m.action("copy", lambda s: _running(s) and s["step"] == SCAN)
+    def copy(s) -> None:
+        o = _cur_obj(s)
+        # never clobber a same-or-newer destination copy (an overwrite
+        # PUT that landed on the live pool mid-drain)
+        if o["p0"] >= 0 and o["p1"] < o["p0"]:
+            o["p1"] = o["p0"]  # quorum-committed destination copy
+        s["step"] = COPIED
+
+    @m.action("fence", lambda s: _running(s) and s["step"] == COPIED)
+    def fence(s) -> None:
+        # ns_updated/hotcache invalidation: cached routes die BEFORE
+        # the source copy does
+        _cur_obj(s)["route"] = -1
+        s["step"] = FENCED
+
+    @m.action("delete_src", lambda s: _running(s) and s["step"] == FENCED)
+    def delete_src(s) -> None:
+        o = _cur_obj(s)
+        # the source copy dies only when the destination holds it
+        # same-or-newer (commit-before-delete)
+        if o["p0"] >= 0 and o["p1"] >= o["p0"]:
+            o["p0"] = -1
+        s["step"] = DELETED
+
+    @m.action("advance", lambda s: _running(s) and s["step"] == DELETED)
+    def advance(s) -> None:
+        s["cursor"] += 1
+        s["step"] = SCAN
+
+    @m.action("checkpoint",
+              lambda s: s["drain"] == "run" and s["step"] == SCAN
+              and s["ckpt"] < s["cursor"])
+    def checkpoint(s) -> None:
+        # durable save: records only FULLY moved objects (delete
+        # landed) — the checkpoint-ahead mutation records one more
+        s["ckpt"] = s["cursor"]
+
+    @m.action("finish",
+              lambda s: s["drain"] == "run" and s["cursor"] >= n_objs)
+    def finish(s) -> None:
+        s["ckpt"] = n_objs
+        s["drain"] = "done"
+
+    # -- crash / resume -----------------------------------------------------
+    @m.action("crash",
+              lambda s: s["drain"] == "run" and s["crashes_left"] > 0)
+    def crash(s) -> None:
+        # SIGKILL mid-flight: in-memory cursor and step die, the
+        # durable checkpoint and all committed pool state survive
+        s["crashes_left"] -= 1
+        s["drain"] = "crashed"
+
+    @m.action("resume", lambda s: s["drain"] == "crashed")
+    def resume(s) -> None:
+        s["drain"] = "run"
+        s["cursor"] = s["ckpt"]
+        s["step"] = SCAN
+
+    # -- live traffic -------------------------------------------------------
+    @m.action("client_put", lambda s: s["puts_left"] > 0)
+    def client_put(s) -> None:
+        # overwrite of "x": placement routes to pool 0 unless it is
+        # suspended; the write fires ns_updated (route invalidated)
+        s["puts_left"] -= 1
+        o = _objs(s)["x"]
+        gen = o["live"] + 1
+        o["live"] = gen
+        o["p1" if s["suspended"] else "p0"] = gen
+        o["route"] = -1
+
+    for name in names:
+        def can_fill(s, name=name) -> bool:
+            return s["fills_left"] > 0 and _objs(s)[name]["route"] < 0
+
+        def do_fill(s, name=name) -> None:
+            # a metacache/hot-tier fill caches the pool a read found
+            # the object in — probes live pools first (read_order)
+            s["fills_left"] -= 1
+            o = _objs(s)[name]
+            order = ("p1", "p0") if s["suspended"] else ("p0", "p1")
+            for pool in order:
+                if o[pool] == o["live"]:
+                    o["route"] = 0 if pool == "p0" else 1
+                    return
+
+        m.action(f"route_fill_{name}", can_fill)(do_fill)
+
+    # -- invariants ---------------------------------------------------------
+    @m.invariant("no-version-lost")
+    def no_version_lost(s) -> bool:
+        """Every live generation readable in EVERY state — from some
+        pool, and through the cached route when one exists."""
+        for o in _objs(s).values():
+            if o["live"] not in (o["p0"], o["p1"]):
+                return False
+            if o["route"] == 0 and o["p0"] != o["live"]:
+                return False
+            if o["route"] == 1 and o["p1"] != o["live"]:
+                return False
+        return True
+
+    @m.terminal("no-double-live")
+    def no_double_live(s) -> bool:
+        """Quiescence: drained pool empty, each live generation in
+        exactly one pool."""
+        for o in _objs(s).values():
+            if o["p0"] != -1:
+                return False  # drained pool still holds a copy
+            if o["p1"] != o["live"]:
+                return False
+        return True
+
+    # drain-terminates-or-degrades: a quiescent state must have the
+    # drain DONE (crash/resume converges, never wedges)
+    m.done = lambda s: s["drain"] == "done"
+
+    # -- seeded mutations ---------------------------------------------------
+    @m.mutation("delete-before-commit",
+                "the source copy dies without waiting for the "
+                "destination commit — a kill between the two loses the "
+                "only copy of the version")
+    def delete_before_commit(mut: Model) -> None:
+        def copy_skipped(s) -> None:
+            s["step"] = COPIED  # commit never happens
+
+        def delete_unfenced(s) -> None:
+            o = _cur_obj(s)
+            o["p0"] = -1  # unconditional source delete
+            s["step"] = DELETED
+
+        mut.replace_action("copy", effect=copy_skipped)
+        mut.replace_action("delete_src", effect=delete_unfenced)
+
+    @m.mutation("delete-before-fence",
+                "the source copy dies before cached routes are "
+                "invalidated — a hot-tier/metacache route keeps "
+                "pointing at the deleted copy")
+    def delete_before_fence(mut: Model) -> None:
+        def delete_early(s) -> None:
+            o = _cur_obj(s)
+            if o["p0"] >= 0 and o["p1"] >= o["p0"]:
+                o["p0"] = -1
+            s["step"] = FENCED  # fence happens (too) late
+
+        def fence_after(s) -> None:
+            _cur_obj(s)["route"] = -1
+            s["step"] = DELETED
+
+        # swap the order: COPIED -> delete, FENCED -> fence
+        mut.replace_action("delete_src",
+                           guard=lambda s: _running(s)
+                           and s["step"] == COPIED,
+                           effect=delete_early)
+        mut.replace_action("fence",
+                           guard=lambda s: _running(s)
+                           and s["step"] == FENCED,
+                           effect=fence_after)
+
+    @m.mutation("copy-clobbers-newer",
+                "the drain copies the stale source generation over a "
+                "NEWER destination copy (an overwrite that landed "
+                "mid-drain) — the live version is destroyed")
+    def copy_clobbers_newer(mut: Model) -> None:
+        def copy_unconditional(s) -> None:
+            o = _cur_obj(s)
+            if o["p0"] >= 0:
+                o["p1"] = o["p0"]  # no same-or-newer check
+            s["step"] = COPIED
+
+        mut.replace_action("copy", effect=copy_unconditional)
+
+    @m.mutation("suspend-after-drain-starts",
+                "placement keeps selecting the draining pool — a PUT "
+                "lands behind the cursor and the drain completes with "
+                "the live version still in the drained pool")
+    def suspend_late(mut: Model) -> None:
+        def start_no_suspend(s) -> None:
+            s["drain"] = "run"
+            s["cursor"] = s["ckpt"]
+            s["step"] = SCAN
+
+        def finish_suspends(s) -> None:
+            s["suspended"] = True  # suspension arrives too late
+            s["ckpt"] = len(s["names"])
+            s["drain"] = "done"
+
+        mut.replace_action("start_drain", effect=start_no_suspend)
+        mut.replace_action("finish", effect=finish_suspends)
+
+    @m.mutation("resume-skips-bucket",
+                "a restarted drain resumes one past the checkpoint — "
+                "the in-flight object's move never completes")
+    def resume_skips(mut: Model) -> None:
+        def resume_past(s) -> None:
+            s["drain"] = "run"
+            s["cursor"] = min(s["ckpt"] + 1, len(s["names"]))
+            s["step"] = SCAN
+
+        mut.replace_action("resume", effect=resume_past)
+
+    @m.mutation("checkpoint-ahead-of-delete",
+                "the durable cursor records the in-flight object "
+                "before its source delete landed — a crash+resume "
+                "skips it, leaving a double-live copy behind")
+    def checkpoint_ahead(mut: Model) -> None:
+        def ckpt_ahead(s) -> None:
+            s["ckpt"] = min(s["cursor"] + 1, len(s["names"]))
+
+        mut.replace_action(
+            "checkpoint",
+            guard=lambda s: s["drain"] == "run"
+            and s["ckpt"] <= s["cursor"] < len(s["names"]),
+            effect=ckpt_ahead)
+
+    return m
+
+
+@register("topology")
+def factory(deep: bool = False) -> Model:
+    return build(deep=deep)

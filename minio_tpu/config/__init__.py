@@ -76,6 +76,8 @@ register_subsystem("qos", {
     "default_max_concurrency": "0",
     "default_bandwidth": "0",
     "max_queue": "auto",
+    "cost_unit": "",
+    "max_cost": "",
     "tenants": "{}",
 }, [
     HelpKV("enable",
@@ -93,6 +95,13 @@ register_subsystem("qos", {
     HelpKV("max_queue",
            "per-tenant admission queue bound before that tenant sheds "
            "503 (auto = 2x requests_max)", typ="number"),
+    HelpKV("cost_unit",
+           "bytes of declared body per admission deficit point "
+           "(empty = 1 MiB default, 0 = flat unit pricing)",
+           typ="number"),
+    HelpKV("max_cost",
+           "clamp on a single request's admission cost "
+           "(empty = 32 default)", typ="number"),
     HelpKV("tenants",
            'JSON tenant rules: {"bucket:<name>"|"key:<access-key>": '
            '{"weight": w, "max_concurrency": c, "bandwidth": bps}}'),

@@ -1,0 +1,104 @@
+"""Multi-pool placement and topology state (ISSUE 14).
+
+Reference: cmd/erasure-server-pool.go routes new objects to a pool and
+probes every pool on reads; cmd/erasure-server-pool-decom.go removes a
+decommissioning pool from placement the moment its drain starts.  This
+module is the one place that knows WHICH pools may take new data and in
+WHAT order reads should probe them, so `ErasureServerPools` can gain a
+pool online and drain one away without touching the op methods.
+
+Placement is a deterministic SipHash of the object name over the
+eligible (non-suspended) pools — the same family of routing the sets
+layer uses for drives (utils/hashing.sip_hash_mod) — with a rotated
+fallback order so a pool that cannot fit the object falls over to the
+next choice instead of failing the PUT.  Deterministic routing keeps
+placement stable across restarts and across the nodes of a cluster
+(every node computes the same target), which is what makes a drain's
+"suspended from placement" state enforceable: the eligible list is part
+of the hash domain, so suspending a pool atomically re-routes ONLY new
+objects while reads keep fanning out everywhere.
+
+`MINIO_TPU_POOL_PLACEMENT=space` restores the seed's weighted-random-
+by-free-space placement for deployments that prefer fill-proportional
+spread over routing stability.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from minio_tpu.utils.hashing import sip_hash_mod
+
+#: suspension reasons a pool can carry (mirrors decommission.json states
+#: that exclude a pool from placement)
+SUSPEND_REASONS = ("draining", "complete")
+
+
+def placement_mode() -> str:
+    """`hash` (deterministic, default) or `space` (seed behavior)."""
+    mode = os.environ.get("MINIO_TPU_POOL_PLACEMENT", "hash").lower()
+    return mode if mode in ("hash", "space") else "hash"
+
+
+class TopologyState:
+    """Per-pool "suspended from placement" flags.
+
+    A suspended pool takes no NEW objects (placement skips it, writes to
+    objects it holds route to a live pool) but keeps serving reads so an
+    object stays findable mid-move.  Thread-safe: the drain thread, the
+    admin plane, and the request path all consult it.
+    """
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._suspended: dict[int, str] = {}  # pool idx -> reason
+
+    def suspend(self, idx: int, reason: str = "draining") -> None:
+        with self._mu:
+            self._suspended[idx] = reason
+
+    def resume(self, idx: int) -> None:
+        """Return a pool to placement (decommission canceled)."""
+        with self._mu:
+            self._suspended.pop(idx, None)
+
+    def is_suspended(self, idx: int) -> bool:
+        with self._mu:
+            return idx in self._suspended
+
+    def suspended(self) -> set[int]:
+        with self._mu:
+            return set(self._suspended)
+
+    def snapshot(self) -> dict[int, str]:
+        with self._mu:
+            return dict(self._suspended)
+
+
+def eligible_indices(n_pools: int, suspended: set[int]) -> list[int]:
+    return [i for i in range(n_pools) if i not in suspended]
+
+
+def placement_order(obj: str, eligible: list[int],
+                    deployment_id: bytes) -> list[int]:
+    """Pool indices to try for a NEW object, best first: the SipHash
+    choice over the eligible list, then the remaining eligible pools in
+    rotated order (capacity fallback keeps routing deterministic — every
+    node agrees on choice k+1 when choice k is full)."""
+    if not eligible:
+        return []
+    start = sip_hash_mod(obj, len(eligible), deployment_id)
+    return [eligible[(start + i) % len(eligible)]
+            for i in range(len(eligible))]
+
+
+def read_order(n_pools: int, suspended: set[int]) -> list[int]:
+    """Pool probe order for reads: live pools first (a version moved by
+    a drain is quorum-committed at its destination before the source
+    copy dies, so during a drain the destination answer is the fresh
+    one), suspended pools last so an object is still findable mid-move.
+    """
+    live = [i for i in range(n_pools) if i not in suspended]
+    rest = [i for i in range(n_pools) if i in suspended]
+    return live + rest

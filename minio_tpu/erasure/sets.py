@@ -438,31 +438,80 @@ class ErasureSets:
 
 
 class ErasureServerPools:
-    """Multiple pools; placement by free space, reads probe all pools
-    (cmd/erasure-server-pool.go:222,289)."""
+    """Multiple pools; deterministic-hash placement over non-suspended
+    pools (erasure/pools.py), reads probe pools live-first so an object
+    stays findable mid-drain (cmd/erasure-server-pool.go:222,289)."""
 
     def __init__(self, pools: Sequence[ErasureSets]):
+        from . import pools as pools_mod
+
         if not pools:
             raise errors.InvalidArgument("no pools")
         self.pools = list(pools)
         # pools being (or finished being) decommissioned take no new
         # writes (cmd/erasure-server-pool-decom.go); state persists on
         # the pool's drives so restarts keep honoring it
-        self._draining: set[int] = set()
+        self.topology = pools_mod.TopologyState()
         for i, p in enumerate(self.pools):
-            try:
-                from minio_tpu.services.decom import load_state
+            self._load_suspension(i, p)
 
-                if load_state(p).get("state") in ("draining", "complete"):
-                    self._draining.add(i)
-            except Exception:
-                pass
+    def _load_suspension(self, idx: int, pool: ErasureSets) -> None:
+        from . import pools as pools_mod
+
+        try:
+            from minio_tpu.services.decom import load_state
+
+            if load_state(pool).get("state") in pools_mod.SUSPEND_REASONS:
+                self.topology.suspend(idx)
+        except Exception:
+            pass
+
+    @property
+    def _draining(self) -> set[int]:
+        """Back-compat view of the suspended pool set."""
+        return self.topology.suspended()
 
     def mark_draining(self, idx: int, draining: bool) -> None:
         if draining:
-            self._draining.add(idx)
+            self.topology.suspend(idx)
         else:
-            self._draining.discard(idx)
+            self.topology.resume(idx)
+
+    def add_pool(self, es: ErasureSets) -> int:
+        """Online expansion (reference: restart with a new pool argument,
+        cmd/erasure-server-pool.go — here the pool joins LIVE): existing
+        buckets and their metadata are stamped onto the new pool so the
+        bucket namespace stays uniform, then placement starts routing
+        new objects to it.  Returns the new pool index."""
+        buckets = [v.name for v in self.list_buckets()]
+        for b in buckets:
+            try:
+                es.make_bucket(b)
+            except errors.BucketExists:
+                pass
+            meta = self.get_bucket_metadata(b)
+            if meta:
+                try:
+                    es.set_bucket_metadata(b, meta)
+                except errors.StorageError:
+                    pass  # quorum of the new pool carries it later
+        self.pools.append(es)
+        idx = len(self.pools) - 1
+        # a pool can arrive carrying a persisted drain state (re-added
+        # after a decommission): honor it, same as boot
+        self._load_suspension(idx, es)
+        return idx
+
+    def _read_pools(self) -> list[ErasureSets]:
+        """Pools in read-probe order: live pools first, suspended last —
+        mid-drain both may hold a version, and the destination copy is
+        the authoritative one (write-fence: it is quorum-committed
+        before the source copy dies)."""
+        from . import pools as pools_mod
+
+        order = pools_mod.read_order(len(self.pools),
+                                     self.topology.suspended())
+        return [self.pools[i] for i in order]
 
     # -- bucket ops over all pools -----------------------------------------
     def make_bucket(self, bucket: str) -> None:
@@ -489,8 +538,31 @@ class ErasureServerPools:
     def _pool_of(self, bucket: str, obj: str) -> ErasureSets | None:
         """Pool already holding the object — ANY version counts, including
         a delete-marker latest (else a marker-topped object could never be
-        version-addressed or permanently deleted)."""
-        for p in self.pools:
+        version-addressed or permanently deleted).  Probes in read order
+        (live pools first) so mid-drain the destination copy wins."""
+        for p in self._read_pools():
+            if p.contains(bucket, obj):
+                return p
+        return None
+
+    def _marker_pool(self, bucket: str, obj: str) -> ErasureSets:
+        """Pool for a FRESH delete marker (versioned DELETE of an
+        object no pool holds): placement-routed, so it can never land
+        in a suspended pool and keep a drained pool non-empty."""
+        try:
+            return self._pool_for_new(obj, 0, bucket=bucket)
+        except errors.StorageError:
+            return self.pools[0]
+
+    def _pool_of_write(self, bucket: str, obj: str) -> ErasureSets | None:
+        """Write-routing probe: like _pool_of but NEVER a suspended pool
+        — an overwrite landing mid-drain must go to a live pool, or the
+        drain chases a moving target (the new version would land behind
+        the drain cursor and be left, or worse re-moved, by it)."""
+        suspended = self.topology.suspended()
+        for i, p in enumerate(self.pools):
+            if i in suspended:
+                continue
             if p.contains(bucket, obj):
                 return p
         return None
@@ -504,8 +576,9 @@ class ErasureServerPools:
         pool cannot hold `size` more bytes
         (cmd/erasure-server-pool.go:241 getServerPoolsAvailableSpace)."""
         out = []
+        suspended = self.topology.suspended()
         for pi, p in enumerate(self.pools):
-            if pi in self._draining:
+            if pi in suspended:
                 out.append(0)  # decommissioning pools take no new data
                 continue
             s = p.get_hashed_set(obj)
@@ -531,13 +604,35 @@ class ErasureServerPools:
             out.append(sum(max(i.total - i.used, 0) for i in infos))
         return out
 
-    def _pool_for_new(self, obj: str = "", size: int = 0) -> ErasureSets:
-        """Weighted-random pool choice by available space, so pools fill
-        proportionally and a full pool is never picked
+    def _pool_for_new(self, obj: str = "", size: int = 0,
+                      bucket: str = "") -> ErasureSets:
+        """Pool for a NEW object.  Default: deterministic SipHash over
+        the non-suspended pools with rotated capacity fallback
+        (erasure/pools.py — stable across restarts and identical on
+        every node, which is what makes "suspended from placement"
+        enforceable during a drain).  The hash keys on bucket/object —
+        same-named objects in different buckets must not co-locate.
+        MINIO_TPU_POOL_PLACEMENT=space restores the seed's
+        weighted-random-by-free-space choice
         (cmd/erasure-server-pool.go:222 getAvailablePoolIdx)."""
+        from . import pools as pools_mod
+
         if len(self.pools) == 1:
             return self.pools[0]
         avail = self._pool_available(obj, size)
+        if pools_mod.placement_mode() == "hash":
+            # index domain = len(avail), NOT len(self.pools): a
+            # concurrent add_pool can append between the two reads and
+            # an index past avail would IndexError an in-flight PUT
+            eligible = pools_mod.eligible_indices(
+                len(avail), self.topology.suspended())
+            key = f"{bucket}/{obj}" if bucket else obj
+            for idx in pools_mod.placement_order(
+                    key, eligible, self.pools[0]._dep_bytes):
+                if avail[idx] > 0:
+                    return self.pools[idx]
+            raise errors.DiskFull(
+                f"no pool has space for {size} more bytes")
         total = sum(avail)
         if total == 0:
             raise errors.DiskFull(
@@ -554,14 +649,15 @@ class ErasureServerPools:
     def put_object(self, bucket, obj, reader, size=-1, opts=None) -> ObjectInfo:
         if not self.bucket_exists(bucket):
             raise errors.BucketNotFound(bucket)
-        pool = self._pool_of(bucket, obj) if len(self.pools) > 1 else self.pools[0]
+        pool = self._pool_of_write(bucket, obj) \
+            if len(self.pools) > 1 else self.pools[0]
         if pool is None:
-            pool = self._pool_for_new(obj, max(size, 0))
+            pool = self._pool_for_new(obj, max(size, 0), bucket=bucket)
         return pool.put_object(bucket, obj, reader, size, opts)
 
     def get_object(self, bucket, obj, offset=0, length=-1, version_id=""):
         last: Exception = errors.ObjectNotFound(f"{bucket}/{obj}")
-        for p in self.pools:
+        for p in self._read_pools():
             try:
                 return p.get_object(bucket, obj, offset, length, version_id)
             except (errors.ObjectNotFound, errors.VersionNotFound) as ex:
@@ -574,7 +670,7 @@ class ErasureServerPools:
 
     def get_object_info(self, bucket, obj, version_id="") -> ObjectInfo:
         last: Exception = errors.ObjectNotFound(f"{bucket}/{obj}")
-        for p in self.pools:
+        for p in self._read_pools():
             try:
                 return p.get_object_info(bucket, obj, version_id)
             except (errors.ObjectNotFound, errors.VersionNotFound) as ex:
@@ -596,7 +692,7 @@ class ErasureServerPools:
             if p is None:
                 if (d0.get("versioned") or d0.get("suspended")) \
                         and not d0.get("version_id"):
-                    p = self.pools[0]
+                    p = self._marker_pool(bucket, d0["obj"])
                 else:
                     results[j] = ObjectInfo(
                         bucket=bucket, name=d0["obj"],
@@ -617,9 +713,15 @@ class ErasureServerPools:
         pool = self._pool_of(bucket, obj)
         if pool is None:
             if (versioned or suspended) and not version_id:
-                pool = self.pools[0]
+                pool = self._marker_pool(bucket, obj)
             else:
                 return ObjectInfo(bucket=bucket, name=obj, version_id=version_id)
+        # NOTE: when the owning pool is suspended the marker still
+        # lands THERE — a marker must shadow its versions within one
+        # pool (the read fan-out treats a pool's marker-latest as
+        # not-found and would otherwise keep probing and serve the
+        # undeleted versions).  A marker landing behind the drain
+        # cursor is an entry the verification sweep re-lists and moves.
         return pool.delete_object(bucket, obj, version_id, versioned, suspended)
 
     def heal_object(self, bucket, obj, version_id="", deep=False) -> HealResult:
@@ -699,7 +801,8 @@ class ErasureServerPools:
     def new_multipart_upload(self, bucket, obj, opts=None) -> str:
         if not self.bucket_exists(bucket):
             raise errors.BucketNotFound(bucket)
-        pool = self._pool_of(bucket, obj) or self._pool_for_new(obj)
+        pool = self._pool_of_write(bucket, obj) \
+            or self._pool_for_new(obj, bucket=bucket)
         return pool.new_multipart_upload(bucket, obj, opts)
 
     def _pool_with_upload(self, bucket, obj, upload_id) -> ErasureSets:

@@ -61,6 +61,8 @@ class AdminMixin:
                    wrap(self.admin_pools_decommission, "DecommissionPool"))
         r.add_post(f"{p}/pools/cancel",
                    wrap(self.admin_pools_cancel, "DecommissionPool"))
+        r.add_post(f"{p}/pools/add",
+                   wrap(self.admin_pools_add, "DecommissionPool"))
         r.add_post(f"{p}/rebalance/start",
                    wrap(self.admin_rebalance_start, "RebalanceStart"))
         r.add_post(f"{p}/rebalance/stop",
@@ -146,6 +148,8 @@ class AdminMixin:
                    wrap(self.admin_site_remove, "SiteReplicationRemove"))
         r.add_post(f"{p}/site-replication/apply",
                    wrap(self.admin_site_apply, "SiteReplicationOperation"))
+        r.add_post(f"{p}/site-replication/resync",
+                   wrap(self.admin_site_resync, "SiteReplicationResync"))
         # config KVS (reference cmd/admin-handlers-config-kv.go:
         # GetConfigKVHandler / SetConfigKVHandler / DelConfigKVHandler /
         # HelpConfigKVHandler)
@@ -223,6 +227,24 @@ class AdminMixin:
         except Exception as e:
             raise S3Error("InternalError", str(e))
         return self._json({})
+
+    async def admin_site_resync(self, request: web.Request, body: bytes):
+        """Re-push bucket state to one peer (reference `mc admin
+        replicate resync`).  Uses the scanner's bloom change tracker to
+        skip buckets that cannot have changed; ?full=true forces a
+        complete walk."""
+        name = request.rel_url.query.get("peer", "")
+        if not name:
+            raise S3Error("InvalidArgument", "peer query param required")
+        full = request.rel_url.query.get("full", "").lower() \
+            in ("1", "true", "yes")
+        svcs = getattr(self, "services", None)
+        tracker = getattr(svcs, "tracker", None) if svcs else None
+        try:
+            out = await self._run(self.site.resync, name, tracker, full)
+        except KeyError:
+            raise S3Error("InvalidArgument", f"no such peer {name!r}")
+        return self._json(out)
 
     # ----------------------------------------------------------- speedtest
     @staticmethod
@@ -490,6 +512,25 @@ class AdminMixin:
                 raise S3Error("InvalidArgument",
                               'max_queue must be a positive integer '
                               'or "auto"')
+        if "cost_unit" in doc:
+            cu = doc["cost_unit"]
+            # 0 is legal: flat unit pricing
+            if isinstance(cu, int) and not isinstance(cu, bool) \
+                    and cu >= 0:
+                kvs["cost_unit"] = str(cu)
+            else:
+                raise S3Error("InvalidArgument",
+                              "cost_unit must be an integer >= 0 "
+                              "(bytes per deficit point; 0 = flat)")
+        if "max_cost" in doc:
+            mc = doc["max_cost"]
+            if isinstance(mc, (int, float)) \
+                    and not isinstance(mc, bool) \
+                    and math.isfinite(mc) and mc >= 1:
+                kvs["max_cost"] = str(mc)
+            else:
+                raise S3Error("InvalidArgument",
+                              "max_cost must be a finite number >= 1")
         tenants = doc.get("tenants")
         if tenants is not None:
             if not isinstance(tenants, dict):
@@ -527,7 +568,7 @@ class AdminMixin:
         if not kvs:
             raise S3Error("InvalidArgument",
                           "nothing to set: provide enable/defaults/"
-                          "max_queue/tenants")
+                          "max_queue/cost_unit/max_cost/tenants")
         try:
             # set_kv persists to the drives and fires the dynamic
             # apply (S3Server._apply_qos_config) — live, no restart
@@ -981,6 +1022,8 @@ class AdminMixin:
 
         def run():
             out = []
+            susp = self.api.topology.snapshot() \
+                if hasattr(self.api, "topology") else {}
             for i, p in enumerate(self.api.pools):
                 job = self._decom_jobs().get(i)
                 state = (dict(job.state) if job is not None
@@ -992,6 +1035,8 @@ class AdminMixin:
                     "drivesPerSet": info["drives_per_set"],
                     "decommission": state,
                     "draining": i in self.api._draining,
+                    # suspended-from-placement reason ("" = in placement)
+                    "suspended": susp.get(i, ""),
                 })
             return out
 
@@ -1015,6 +1060,13 @@ class AdminMixin:
                 raise S3Error("AdminInvalidArgument",
                               f"pool {idx} is already draining")
             job = PoolDecommission(self.api, idx)
+            # drain traffic defers to foreground load like every other
+            # background plane (ISSUE 14: metered through the brownout
+            # throttle)
+            svcs = getattr(self, "services", None)
+            if svcs is not None and getattr(svcs, "brownout", None) \
+                    is not None:
+                job.throttle = svcs.brownout.background_allowed
             job.start()
             jobs[idx] = job
             return dict(job.state)
@@ -1034,6 +1086,55 @@ class AdminMixin:
                               f"no decommission running for pool {idx}")
             job.cancel()
             return dict(job.state)
+
+        return self._json(await self._run(run))
+
+    async def admin_pools_add(self, request: web.Request, body: bytes):
+        """Online pool expansion (ISSUE 14): grow the deployment with a
+        new pool of local drives WITHOUT a restart — existing buckets
+        are stamped onto it and placement starts routing new objects
+        there immediately.  (The reference requires a restart with the
+        new pool argument, cmd/erasure-server-pool.go; going past that
+        is the point.)  Body: {"paths": ["/drive1", ...],
+        "setSize": optional}."""
+        if not hasattr(self.api, "pools"):
+            raise S3Error("NotImplemented",
+                          "pool topology does not apply to this backend")
+        try:
+            doc = json.loads(body)
+            paths = doc["paths"]
+            if not (isinstance(paths, list) and paths
+                    and all(isinstance(x, str) and x for x in paths)):
+                raise ValueError
+            set_size = doc.get("setSize")
+            if set_size is not None and (isinstance(set_size, bool)
+                                         or not isinstance(set_size, int)
+                                         or set_size <= 0):
+                raise ValueError
+        except (ValueError, KeyError, TypeError):
+            raise S3Error("AdminInvalidArgument",
+                          'body must be {"paths": ["/drive1", ...], '
+                          '"setSize": optional int}')
+
+        def run():
+            from minio_tpu.erasure.sets import ErasureSets
+            from minio_tpu.storage.local import LocalStorage
+
+            try:
+                es = ErasureSets([LocalStorage(p) for p in paths],
+                                 set_size=set_size,
+                                 pool_index=len(self.api.pools))
+                idx = self.api.add_pool(es)
+            except st.InvalidArgument as e:
+                raise S3Error("AdminInvalidArgument", str(e))
+            # the new pool's sets must feed the same choke points as
+            # the boot-time ones (hot tier, metacache, bloom tracker,
+            # MRF heal queue)
+            rewire = getattr(self, "rewire_topology_hooks", None)
+            if rewire is not None:
+                rewire()
+            return {"pool": idx, "sets": es.set_count,
+                    "drivesPerSet": es.set_drive_count}
 
         return self._json(await self._run(run))
 
@@ -1123,6 +1224,10 @@ class AdminMixin:
             from minio_tpu.services.decom import PoolRebalance
 
             job = self._rebalance_inst = PoolRebalance(self.api)
+            svcs = getattr(self, "services", None)
+            if svcs is not None and getattr(svcs, "brownout", None) \
+                    is not None:
+                job.throttle = svcs.brownout.background_allowed
         return job
 
     async def admin_rebalance_start(self, request: web.Request,

@@ -422,6 +422,86 @@ class MetricsMixin:
                         f"{ts[field]}")
             g("\n".join(rows) + "\n")
 
+        # topology plane (ISSUE 14): pool drain/rebalance volume and
+        # retry/fail classification plus site-resync push economics —
+        # the drain-induced-load forensics surface next to the
+        # decom/resync trace spans.  Rendered only when the deployment
+        # has a multi-pool topology, a drain has run, or site peers
+        # exist, so the single-pool no-decom server stays
+        # metrics-identical to before.
+        try:
+            from minio_tpu.services import decom as decom_mod
+
+            with decom_mod._stats_mu:
+                tsnap = dict(decom_mod.stats)
+            multi_pool = len(getattr(self.api, "pools", [])) > 1
+            if multi_pool or any(tsnap.values()):
+                gauge("minio_topology_drained_objects_total",
+                      "Object versions moved out of draining/"
+                      "rebalancing pools", tsnap["drained_objects"])
+                gauge("minio_topology_drained_bytes_total",
+                      "Logical bytes moved out of draining/"
+                      "rebalancing pools", tsnap["drained_bytes"])
+                gauge("minio_topology_drain_retries_total",
+                      "Per-version move attempts retried "
+                      "(retryable-classified failures)",
+                      tsnap["retries"])
+                rows = ["# HELP minio_topology_drain_failed_total "
+                        "Version moves that exhausted retries, by "
+                        "failure class",
+                        "# TYPE minio_topology_drain_failed_total gauge"]
+                for klass, key in (("retryable", "failed_retryable"),
+                                   ("permanent", "failed_permanent")):
+                    lbl = _fmt_labels(("class",), (klass,))
+                    rows.append("minio_topology_drain_failed_total"
+                                f"{lbl} {tsnap[key]}")
+                g("\n".join(rows) + "\n")
+                gauge("minio_topology_drain_skipped_stale_total",
+                      "Stale source copies dropped because the "
+                      "destination already held same-or-newer",
+                      tsnap["skipped_stale"])
+                gauge("minio_topology_drain_throttle_waits_total",
+                      "Drain pauses deferring to foreground load "
+                      "(brownout)", tsnap["throttle_waits"])
+            if multi_pool and hasattr(self.api, "topology"):
+                susp = self.api.topology.suspended()
+                rows = ["# HELP minio_topology_pool_suspended 1 while "
+                        "the pool is suspended from placement "
+                        "(draining/decommissioned)",
+                        "# TYPE minio_topology_pool_suspended gauge"]
+                for i in range(len(self.api.pools)):
+                    lbl = _fmt_labels(("pool",), (str(i),))
+                    rows.append("minio_topology_pool_suspended"
+                                f"{lbl} {1 if i in susp else 0}")
+                g("\n".join(rows) + "\n")
+        except Exception:
+            pass
+        try:
+            site = getattr(self, "site", None)
+            si = site.info() if site is not None else None
+            if si and (si["peers"] or si["pushed"] or si["failed"]
+                       or si["resyncs"]):
+                gauge("minio_topology_resync_pushes_total",
+                      "Site-replication docs queued by resync sweeps",
+                      si["resyncPushed"])
+                gauge("minio_topology_resync_skipped_total",
+                      "Buckets the bloom change tracker proved clean "
+                      "and resync skipped", si["resyncSkipped"])
+                # push-level counters: ALL site pushes (mutation
+                # propagation included), not just resync docs — named
+                # accordingly so a resync alert cannot key on ordinary
+                # peer-down mutation retries
+                gauge("minio_topology_site_push_retries_total",
+                      "Site-replication push attempts re-queued with "
+                      "backoff (all pushes, resync included)",
+                      si["retries"])
+                gauge("minio_topology_site_push_failures_total",
+                      "Site-replication pushes failed after all "
+                      "retries (all pushes, resync included)",
+                      si["failed"])
+        except Exception:
+            pass
+
         # multi-process data plane (parallel/workers.py): job/commit
         # volume through the worker plane plus its supervision health —
         # workerDeaths counts in-flight-failing deaths, restarts counts

@@ -22,8 +22,21 @@ that single semaphore with a **weighted deficit-round-robin scheduler**
 The admit/release/reweight/shed protocol is specified first as an
 executable model (analysis/concurrency/models/qos.py, per the PR 10
 convention) and this implementation mirrors it action for action:
-quantum tops up once per visit and only when credit ran out, a drained
-queue forfeits its deficit, and a reweight clamps stale credit.
+quantum tops up once per visit and only when the queue head is not yet
+affordable, a drained queue forfeits its deficit, and a reweight clamps
+stale credit.
+
+Scheduler cost is weighted by ESTIMATED BYTES (ISSUE 14 satellite,
+closing the PR 13 leftover): a request's admission spends
+``clamp(ceil(content_length / cost_unit), 1, max_cost)`` deficit
+instead of a flat 1, so one multipart PUT is priced honestly against N
+small GETs.  Requests without a body (GETs — the response size is
+unknown at admission) cost 1.  A top-up that does not yet afford a
+heavy head still counts as sweep progress (the model's
+save-up-not-progress mutation is the wedge this prevents: a request
+costing more than its tenant's weight must be able to finish saving
+across sweep rounds).  ``MINIO_TPU_QOS_COST_UNIT=0`` restores flat
+unit pricing.
 
 Threading: admission calls (try_admit / enqueue / abandon / release)
 run on the aiohttp event loop, exactly like the semaphore they
@@ -36,7 +49,9 @@ Knobs (env wins over the dynamic ``qos`` config subsystem):
 single-semaphore path runs byte- and metrics-identical),
 ``MINIO_TPU_QOS_TENANTS`` (JSON rules), ``MINIO_TPU_QOS_MAX_QUEUE``,
 ``MINIO_TPU_QOS_DEFAULT_WEIGHT``, ``MINIO_TPU_QOS_DEFAULT_BANDWIDTH``,
-``MINIO_TPU_QOS_DEFAULT_MAX_CONCURRENCY``.
+``MINIO_TPU_QOS_DEFAULT_MAX_CONCURRENCY``,
+``MINIO_TPU_QOS_COST_UNIT`` (bytes per deficit point, default 1 MiB;
+0 = flat unit pricing), ``MINIO_TPU_QOS_MAX_COST`` (clamp, default 32).
 """
 
 from __future__ import annotations
@@ -59,6 +74,13 @@ IDLE_TTL_S = 900.0
 #: its own tenant by construction, which the no-starvation invariant
 #: (models/qos.py) forbids for admitted rules
 MIN_WEIGHT = 0.01
+
+#: byte-cost pricing defaults: 1 deficit point per MiB of declared
+#: body, clamped to [1, 32] so an attacker-sized Content-Length cannot
+#: make its own tenant save forever (and bounds the sweep's save-up
+#: rounds at max_cost / MIN_WEIGHT)
+DEFAULT_COST_UNIT = 1 << 20
+DEFAULT_MAX_COST = 32.0
 
 
 class TenantQueueFull(Exception):
@@ -153,10 +175,18 @@ class QosPlane:
     def __init__(self, max_concurrency: int, *,
                  default_rule: TenantRule | None = None,
                  rules: dict[str, TenantRule] | None = None,
-                 max_queue: int = 0):
+                 max_queue: int = 0,
+                 cost_unit: int | None = None,
+                 max_cost: float | None = None):
         self.max_concurrency = max(int(max_concurrency), 1)
         self.default_rule = default_rule or TenantRule()
         self.rules: dict[str, TenantRule] = dict(rules or {})
+        # byte-cost pricing: bytes per deficit point (0 = flat unit
+        # cost) and the [1, max_cost] clamp
+        self.cost_unit = DEFAULT_COST_UNIT if cost_unit is None \
+            else max(int(cost_unit), 0)
+        self.max_cost = DEFAULT_MAX_COST if max_cost is None \
+            else max(float(max_cost), 1.0)
         # per-tenant shed threshold; auto = 2x the slot pool (the old
         # plane queued unboundedly per-budget — the bound is what makes
         # one tenant's backlog finite)
@@ -242,12 +272,21 @@ class QosPlane:
         mq_raw = knob("MINIO_TPU_QOS_MAX_QUEUE", "max_queue")
         max_queue = int(num(mq_raw, 0)) if mq_raw not in ("", "auto") \
             else 0
+        cu_raw = knob("MINIO_TPU_QOS_COST_UNIT", "cost_unit")
+        cost_unit = None if cu_raw in ("", None) \
+            else max(int(num(cu_raw, DEFAULT_COST_UNIT)), 0)
+        mc_raw = knob("MINIO_TPU_QOS_MAX_COST", "max_cost")
+        max_cost = None if mc_raw in ("", None) \
+            else max(num(mc_raw, DEFAULT_MAX_COST), 1.0)
         self.reconfigure(default_rule=default, rules=rules,
-                         max_queue=max_queue)
+                         max_queue=max_queue, cost_unit=cost_unit,
+                         max_cost=max_cost)
 
     def reconfigure(self, *, default_rule: TenantRule | None = None,
                     rules: dict[str, TenantRule] | None = None,
-                    max_queue: int = 0) -> None:
+                    max_queue: int = 0,
+                    cost_unit: int | None = None,
+                    max_cost: float | None = None) -> None:
         """Apply a new rule set atomically; live tenant states pick up
         their new weight/cap/bandwidth immediately (deficit clamped)."""
         with self._mu:
@@ -257,6 +296,10 @@ class QosPlane:
                 self.rules = dict(rules)
             self.max_queue = int(max_queue) if max_queue > 0 \
                 else max(16, 2 * self.max_concurrency)
+            if cost_unit is not None:
+                self.cost_unit = max(int(cost_unit), 0)
+            if max_cost is not None and math.isfinite(float(max_cost)):
+                self.max_cost = max(float(max_cost), 1.0)
             for st in self._tenants.values():
                 st.apply_rule(self.rules.get(st.key, self.default_rule))
             loop = self._loop
@@ -293,6 +336,24 @@ class QosPlane:
         if cred:
             return cred.split("/", 1)[0]
         return q.get("AWSAccessKeyId", "")
+
+    def cost_of(self, request) -> float:
+        """Admission cost of a request, weighted by its DECLARED body
+        size: clamp(ceil(content_length / cost_unit), 1, max_cost).
+        GETs (no body — the response size is unknown pre-admission) and
+        sub-unit bodies cost 1; the clamp bounds both an attacker-sized
+        Content-Length and the sweep's save-up rounds.  cost_unit=0
+        restores flat unit pricing."""
+        if self.cost_unit <= 0:
+            return 1.0
+        try:
+            n = request.content_length or 0
+        except (TypeError, ValueError):
+            n = 0
+        if n <= self.cost_unit:
+            return 1.0
+        return float(min(self.max_cost,
+                         -(-int(n) // self.cost_unit)))
 
     def classify(self, request) -> str:
         """Tenant identity: explicit ``key:`` rule > the request's
@@ -338,11 +399,13 @@ class QosPlane:
         cap = st.rule.max_concurrency
         return cap <= 0 or st.inflight < cap
 
-    def try_admit(self, tenant: str) -> bool:
+    def try_admit(self, tenant: str, cost: float = 1.0) -> bool:
         """Fast path: a free slot, an under-cap tenant and an empty
         tenant queue admit without queueing (the model's direct-admit
         arrival; mirrors the old `not sem.locked()` branch so an idle
-        server never counts spurious pressure)."""
+        server never counts spurious pressure).  Direct admits bypass
+        the deficit (as modeled) — cost prices CONTENDED admissions,
+        where fairness is decided."""
         with self._mu:
             self._gc_locked()
             st = self._state_locked(tenant)
@@ -355,10 +418,12 @@ class QosPlane:
                 return True
             return False
 
-    def enqueue(self, tenant: str):
+    def enqueue(self, tenant: str, cost: float = 1.0):
         """Join the tenant's admission queue.  Returns (future,
         aggregate_depth) — the aggregate cross-tenant depth feeds
-        brownout pressure.  Raises TenantQueueFull at the bound."""
+        brownout pressure.  Raises TenantQueueFull at the bound.  The
+        byte-estimated cost rides the future itself; the dispatch sweep
+        spends it from the tenant's deficit at admission."""
         loop = asyncio.get_running_loop()
         with self._mu:
             self._loop = loop
@@ -368,6 +433,7 @@ class QosPlane:
                 st.shed_full += 1
                 raise TenantQueueFull(tenant)
             fut = loop.create_future()
+            fut._qos_cost = max(float(cost), 1.0)
             st.queue.append(fut)
             self._queued += 1
             depth = self._queued
@@ -403,14 +469,34 @@ class QosPlane:
             self._active = max(0, self._active - 1)
             self._dispatch_locked()
 
+    @staticmethod
+    def _head_cost(st: _TenantState) -> float:
+        """Cost of the tenant's queue head (1.0 for legacy futures)."""
+        return getattr(st.queue[0], "_qos_cost", 1.0)
+
     def _dispatch_locked(self) -> None:
         """The DRR sweep over nonempty queues: quantum once per visit
-        (only when credit ran out), spend 1 per admission, stop at the
-        slot pool / tenant cap / drained queue, forfeit deficit on
-        empty.  Mirrors models/qos.py `_dispatch` exactly."""
+        (only when the head is not yet affordable), spend the head's
+        BYTE COST per admission, stop at the slot pool / tenant cap /
+        drained queue / unaffordable head, forfeit deficit on empty.
+        A top-up that does not yet afford a heavy head still counts as
+        progress — a request costing more than its tenant's weight
+        saves up across rounds instead of stranding (models/qos.py
+        save-up-not-progress).  A round that admitted NOTHING (every
+        servable tenant is saving) fast-forwards the remaining save-up
+        rounds arithmetically — each saver gains k·weight where k is
+        the fewest rounds until some head becomes affordable, exactly
+        what k literal rounds would produce — so the sweep never spins
+        cost/weight iterations under the plane mutex on the event loop
+        (a hostile Content-Length with a tiny weight would otherwise
+        stall the server).  Mirrors models/qos.py `_dispatch` (the
+        fast-forward is state-identical to the model's literal
+        rounds)."""
         progress = True
         while progress and self._active < self.max_concurrency:
             progress = False
+            admitted_this_round = False
+            savers: list[_TenantState] = []
             order = sorted(k for k, t in self._tenants.items() if t.queue)
             if not order:
                 return
@@ -422,25 +508,51 @@ class QosPlane:
                 self._prune_locked(st)
                 if st.queue and self._active < self.max_concurrency \
                         and self._under_cap(st):
-                    if st.deficit < 1.0:
+                    if st.deficit < self._head_cost(st):
                         st.deficit += st.rule.weight
-                    while st.queue and st.deficit >= 1.0 \
+                        progress = True  # saving toward a heavy head
+                    while st.queue \
                             and self._active < self.max_concurrency \
                             and self._under_cap(st):
-                        fut = st.queue.popleft()
-                        self._queued -= 1  # single-owner: we removed it
+                        fut = st.queue[0]
                         if fut.done():
+                            st.queue.popleft()
+                            self._queued -= 1  # single-owner: removed
                             continue
-                        st.deficit -= 1.0
+                        cost = getattr(fut, "_qos_cost", 1.0)
+                        if st.deficit < cost:
+                            break  # keep saving next visit
+                        st.queue.popleft()
+                        self._queued -= 1  # single-owner: we removed it
+                        st.deficit -= cost
                         st.inflight += 1
                         st.admitted += 1
                         self._active += 1
                         st.last_active = time.monotonic()
                         fut.set_result(True)
                         progress = True
+                        admitted_this_round = True
+                    if st.queue and self._under_cap(st) \
+                            and st.deficit < self._head_cost(st):
+                        savers.append(st)
                 if not st.queue:
                     st.deficit = 0.0
             self._rr += 1
+            if progress and not admitted_this_round and savers \
+                    and self._active < self.max_concurrency:
+                # fast-forward: k = rounds until the cheapest saver
+                # affords; each saver gains exactly what k more literal
+                # rounds would grant (growth stops at affordability, so
+                # the deficit bound weight + cost - 1 is preserved)
+                k = min(math.ceil(
+                    (self._head_cost(st) - st.deficit) / st.rule.weight)
+                    for st in savers)
+                if k > 1:
+                    for st in savers:
+                        need = math.ceil(
+                            (self._head_cost(st) - st.deficit)
+                            / st.rule.weight)
+                        st.deficit += min(k, need) * st.rule.weight
 
     def _gc_locked(self) -> None:
         """Age out idle auto-tenancy states (bounded map, bounded
@@ -543,6 +655,8 @@ class QosPlane:
             return {
                 "maxConcurrency": self.max_concurrency,
                 "maxQueue": self.max_queue,
+                "costUnit": self.cost_unit,
+                "maxCost": self.max_cost,
                 "active": self._active,
                 "deficitRounds": self._rounds,
                 "defaults": self.default_rule.to_dict(),

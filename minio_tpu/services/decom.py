@@ -6,35 +6,99 @@ pool, moves each object version into the remaining pools, and records
 resumable progress; placement stops selecting the pool the moment the
 drain starts.
 
-Design here: the drain job walks the source pool's entry stream
-(name + all versions), re-puts each live version into the surviving
-pools with its version id AND mod time pinned (PutObjectOptions
-version_id/mod_time), re-creates delete markers, then deletes the
-source copy.  State persists to a WRITE QUORUM of the source pool's
-drives (`decommission.json`, seq-versioned) so losing any minority of
-drives — including whichever wrote first — cannot lose drain progress;
-a restart resumes (bucket granularity) and a completed pool stays
-excluded from placement.  Saves that miss quorum mark the job degraded
-in admin status instead of failing silently (reference persists pool
-meta under .minio.sys with quorum semantics,
+Design here (ISSUE 14 hardening, protocol modeled in
+analysis/concurrency/models/topology.py): the drain job walks the
+source pool's entry stream (name + all versions) and moves each live
+version with the **write-fence invariant** — a version is deleted from
+the source pool only after the destination copy is quorum-committed
+(put_object met write quorum) AND the source set's ``ns_updated`` choke
+point has fired (hot tier + metacache + change tracker invalidation),
+so a cached route can never point at a deleted copy.  A version the
+destination already holds same-or-newer (an overwrite PUT that landed
+on a live pool mid-drain) is never clobbered: the stale source copy is
+simply dropped (the model's copy-clobbers-newer mutation).
+
+Progress checkpoints at **object granularity**: ``decommission.json``
+(seq-versioned, quorum-persisted on the source pool's drives) carries
+the completed-bucket list AND an in-bucket cursor (last fully-moved
+object name), saved every ``MINIO_TPU_DECOM_CHECKPOINT_EVERY`` objects
+— a kill mid-bucket resumes after the last checkpointed object instead
+of replaying the bucket.  The cursor is advanced only AFTER the
+source-side delete landed (the model's checkpoint-ahead mutation is the
+bug class this ordering kills).  Saves that miss quorum mark the job
+degraded in admin status instead of failing silently (reference
+persists pool meta under .minio.sys with quorum semantics,
 cmd/erasure-server-pool-decom.go poolMeta.save).
+
+Per-object moves run under a deadline budget
+(``MINIO_TPU_DECOM_OBJ_TIMEOUT_S``) and are retried MRF-style with
+permanent/retryable classification (a version deleted mid-drain by a
+client is "gone", not a failure); drain traffic defers to foreground
+load through the brownout throttle like every other background plane.
 """
 
 from __future__ import annotations
 
 import io
 import json
+import os
 import threading
 import time
 
 from minio_tpu.storage import errors
-from minio_tpu.utils.deadline import service_thread
+from minio_tpu.utils import tracing
+from minio_tpu.utils.deadline import Budget, scope, service_thread
 from minio_tpu.storage.local import SYSTEM_VOL
 
 DECOM_FILE = "decommission.json"
 REBAL_FILE = "rebalance.json"
 
 _STATES = ("none", "draining", "complete", "failed", "canceled")
+
+#: topology-plane counters rendered as minio_topology_* gauges
+#: (server/metrics.py); module-level so admin-created jobs and
+#: process-lifetime totals agree
+stats = {
+    "drained_objects": 0,
+    "drained_bytes": 0,
+    "retries": 0,
+    "failed_retryable": 0,
+    "failed_permanent": 0,
+    "skipped_stale": 0,      # source copies dropped (dest same-or-newer)
+    "throttle_waits": 0,
+}
+_stats_mu = threading.Lock()
+
+
+def _bump(key: str, n: int = 1) -> None:
+    with _stats_mu:
+        stats[key] += n
+
+
+class _DrainKilled(BaseException):
+    """Test-only crash injection: the drain thread dies WITHOUT saving
+    state — the closest a thread can come to SIGKILL mid-flight."""
+
+
+class MoveFailed(Exception):
+    def __init__(self, msg: str, permanent: bool):
+        super().__init__(msg)
+        self.permanent = permanent
+
+
+#: errors that mean the version is GONE (deleted mid-drain by a
+#: client) — nothing left to move, not a failure
+_GONE = (errors.ObjectNotFound, errors.VersionNotFound,
+         errors.BucketNotFound, errors.FileNotFound,
+         errors.FileVersionNotFound)
+
+
+def _classify(exc: Exception) -> str:
+    if isinstance(exc, _GONE):
+        return "gone"
+    if isinstance(exc, (errors.InvalidArgument,)):
+        return "permanent"
+    return "retryable"
 
 
 def load_state(pool, filename: str = DECOM_FILE) -> dict:
@@ -91,6 +155,19 @@ class PoolDecommission:
         self.state = load_state(self.src)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # drain traffic defers to foreground load (wired to
+        # services.brownout.background_allowed by the admin plane)
+        self.throttle = None
+        self.checkpoint_every = max(1, int(os.environ.get(
+            "MINIO_TPU_DECOM_CHECKPOINT_EVERY", "32")))
+        self.retries = max(0, int(os.environ.get(
+            "MINIO_TPU_DECOM_RETRIES", "3")))
+        self.obj_timeout = float(os.environ.get(
+            "MINIO_TPU_DECOM_OBJ_TIMEOUT_S", "120"))
+        # test-only: fn(moved_objects) -> True kills the drain thread
+        # without a final save (crash injection for the chaos drill)
+        self._crash_hook = None
+        self._since_ckpt = 0
 
     def _save(self) -> None:
         """Quorum-persist; a save that misses quorum marks the job
@@ -108,19 +185,27 @@ class PoolDecommission:
         if self.state.get("state") == "complete":
             raise errors.InvalidArgument("pool already decommissioned")
         # a persisted 'draining' with no live thread is a crashed drain:
-        # restarting resumes from the completed-bucket list, like
+        # restarting resumes from the checkpointed cursor, like
         # failed/canceled restarts
-        resume_from = self.state.get("done_buckets", []) \
-            if self.state.get("state") in ("draining", "failed",
-                                           "canceled") else []
+        resume = self.state.get("state") in ("draining", "failed",
+                                             "canceled")
+        resume_from = self.state.get("done_buckets", []) if resume else []
+        cursor = self.state.get("cursor") if resume else None
         self.state = {
             "state": "draining", "started": time.time(),
             "moved_objects": 0, "moved_bytes": 0, "failed_objects": 0,
+            "retried_objects": 0, "skipped_stale": 0, "throttle_waits": 0,
             "done_buckets": list(resume_from),
+            "cursor": dict(cursor) if cursor else None,
             "seq": int(self.state.get("seq", 0)),
         }
-        self._save()
+        # placement suspension BEFORE the first move (and before the
+        # durable save, so a crash between the two leaves the pool
+        # suspended-at-boot via the persisted 'draining' state): a PUT
+        # racing the drain start must never land behind the cursor
+        # (the model's suspend-after-drain-starts mutation)
         self.pools.mark_draining(self.idx, True)
+        self._save()
         self._thread = service_thread(
             self._run, name=f"decom-pool-{self.idx}")
 
@@ -128,51 +213,223 @@ class PoolDecommission:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
+        # reconcile BEFORE the pool rejoins read order: overwrites that
+        # landed on live pools while this one was suspended left STALE
+        # copies here, and back in (index-ordered) read order a stale
+        # null version would shadow the newer live-pool copy on every
+        # read — a persistent read-your-writes violation.  Drop every
+        # local copy another pool already holds same-or-newer (this
+        # also clears duplicate version-ids from moves killed between
+        # dest-commit and source-delete).
+        try:
+            self._reconcile_stale()
+        except Exception:
+            pass  # best effort: a later drain/heal converges the rest
         self.state["state"] = "canceled"
         self._save()
+        # a canceled pool returns to placement
         self.pools.mark_draining(self.idx, False)
+
+    def _reconcile_stale(self) -> None:
+        others = [p for i, p in enumerate(self.pools.pools)
+                  if i != self.idx]
+        for vol in self.src.list_buckets():
+            bucket = vol.name
+            try:
+                entries = list(self.src.list_entries(bucket))
+            except errors.StorageError:
+                continue
+            for entry in entries:
+                for oi in entry.versions:
+                    if any(_dest_has_same_or_newer(other, bucket,
+                                                   entry.name, oi)
+                           for other in others):
+                        _bump("skipped_stale")
+                        _fence(self.src, bucket, entry.name)
+                        try:
+                            self.src.delete_object(
+                                bucket, entry.name,
+                                version_id=oi.version_id or "null")
+                        except errors.StorageError:
+                            continue
 
     def wait(self, timeout: float = 600.0) -> None:
         if self._thread is not None:
             self._thread.join(timeout)
 
     # -- drain --------------------------------------------------------------
+    def _throttle_wait(self) -> None:
+        """Brownout deferral between objects: foreground load owns the
+        drives; the drain resumes when the controller releases."""
+        if self.throttle is None or self.throttle():
+            return
+        self.state["throttle_waits"] += 1
+        _bump("throttle_waits")
+        while not self._stop.is_set() and not self.throttle():
+            time.sleep(0.05)
+
+    def _residual_buckets(self) -> list[str]:
+        """Buckets of the source pool still holding ANY object record
+        (versions or delete markers)."""
+        out = []
+        for vol in self.src.list_buckets():
+            try:
+                if next(iter(self.src.list_entries(vol.name)),
+                        None) is not None:
+                    out.append(vol.name)
+            except errors.StorageError:
+                continue
+        return out
+
     def _run(self) -> None:
+        root = tracing.start("topology.decom", pool=self.idx)
+        token = tracing.install(root) if root is not None else None
+        t0 = time.monotonic()
+        status = 200
         try:
-            for vol in self.src.list_buckets():
-                bucket = vol.name
-                if self._stop.is_set():
-                    return
-                if bucket in self.state["done_buckets"]:
-                    continue
-                self._drain_bucket(bucket)
-                self.state["done_buckets"].append(bucket)
-                self._save()
-            self.state["state"] = "complete"
-            self.state["finished"] = time.time()
-        except Exception as e:
-            self.state["state"] = "failed"
-            self.state["error"] = str(e)
-        self._save()
+            try:
+                # The walk + a bounded number of VERIFICATION sweeps.
+                # Placement suspension is marked before the first move,
+                # but a racing PUT can resolve its pool routing BEFORE
+                # the suspension became visible and land its write in
+                # this pool behind the cursor (routing-decision vs
+                # write-landing TOCTOU — the model's client_put is
+                # atomic, the real plane is not).  Re-listing after the
+                # walk catches such stragglers; by the second sweep the
+                # suspension has long been visible, so this converges.
+                for sweep in range(3):
+                    for vol in self.src.list_buckets():
+                        bucket = vol.name
+                        if self._stop.is_set():
+                            return
+                        if bucket in self.state["done_buckets"]:
+                            continue
+                        with tracing.span("decom.bucket", bucket=bucket,
+                                          sweep=sweep):
+                            self._drain_bucket(bucket)
+                        self.state["done_buckets"].append(bucket)
+                        self.state["cursor"] = None
+                        self._save()
+                    if self._stop.is_set():
+                        return
+                    residual = self._residual_buckets()
+                    if not residual:
+                        break
+                    tracing.event("decom.verify.residual",
+                                  buckets=len(residual), sweep=sweep)
+                    self.state["done_buckets"] = [
+                        b for b in self.state["done_buckets"]
+                        if b not in residual]
+                    self.state["cursor"] = None
+                    self._save()
+                else:
+                    residual = self._residual_buckets()
+                    if residual:
+                        self.state["failed_objects"] += 1
+                        self.state.setdefault(
+                            "error", "source pool still non-empty "
+                            "after verification sweeps")
+                if self.state["failed_objects"] > 0:
+                    # objects remain in the source pool: the drain is NOT
+                    # complete — a restart resumes and retries them
+                    self.state["state"] = "failed"
+                    self.state["error"] = (
+                        f"{self.state['failed_objects']} objects failed "
+                        "to move; restart the decommission to retry")
+                    status = 500
+                else:
+                    self.state["state"] = "complete"
+                    self.state["finished"] = time.time()
+            except _DrainKilled:
+                status = 500
+                return  # crash injection: NO save (simulated SIGKILL)
+            except Exception as e:
+                self.state["state"] = "failed"
+                self.state["error"] = str(e)
+                status = 500
+            self._save()
+        finally:
+            if root is not None:
+                root.tag(moved=self.state.get("moved_objects", 0),
+                         failed=self.state.get("failed_objects", 0))
+                tracing.reset(token)
+                tracing.finish(root, status=status, error=status >= 500,
+                               duration=time.monotonic() - t0)
 
     def _drain_bucket(self, bucket: str) -> None:
+        cur = self.state.get("cursor") or {}
+        start_after = cur.get("obj", "") if cur.get("bucket") == bucket \
+            else ""
         for entry in self.src.list_entries(bucket):
             if self._stop.is_set():
+                self._save()  # checkpoint what we finished
                 return
             name = entry.name
+            if start_after and name <= start_after:
+                continue  # already moved before the crash/restart
+            self._throttle_wait()
+            if self._crash_hook is not None \
+                    and self._crash_hook(self.state["moved_objects"]):
+                raise _DrainKilled()
             # oldest-first so xl.meta mod-time ordering (and is_latest)
             # lands identically in the target pool
+            obj_failed = False
             for oi in reversed(entry.versions):
                 try:
                     self._move_version(bucket, name, oi)
                     self.state["moved_objects"] += 1
                     self.state["moved_bytes"] += max(oi.size, 0)
-                except Exception:
+                    _bump("drained_objects")
+                    _bump("drained_bytes", max(oi.size, 0))
+                except MoveFailed as mf:
+                    obj_failed = True
                     self.state["failed_objects"] += 1
+                    _bump("failed_permanent" if mf.permanent
+                          else "failed_retryable")
+                    tracing.event("decom.move.failed", bucket=bucket,
+                                  obj=name, error=str(mf),
+                                  permanent=mf.permanent)
+            if not obj_failed:
+                # object-granular checkpoint: the cursor records only
+                # FULLY moved objects (source delete landed), so a
+                # resume can never skip an in-flight move
+                self.state["cursor"] = {"bucket": bucket, "obj": name}
+                self._since_ckpt += 1
+                if self._since_ckpt >= self.checkpoint_every:
+                    self._since_ckpt = 0
+                    self._save()
 
     def _move_version(self, bucket: str, name: str, oi) -> None:
-        target = self._target_pool(name, max(oi.size, 0))
-        move_version(self.src, target, bucket, name, oi)
+        """One version move with MRF-style retry: permanent failures
+        (and gone-mid-drain versions) never spin, retryable ones back
+        off a few rounds before the object is recorded failed (a
+        restarted drain retries it — convergence over completeness)."""
+        last: Exception | None = None
+        for attempt in range(self.retries + 1):
+            if self._stop.is_set():
+                raise MoveFailed("drain canceled", permanent=False)
+            try:
+                with scope(Budget(self.obj_timeout)):
+                    target = self._target_pool(name, max(oi.size, 0))
+                    move_version(self.src, target, bucket, name, oi)
+                return
+            except _GONE:
+                # deleted mid-drain by a client: nothing left to move
+                return
+            except Exception as e:
+                last = e
+                kind = _classify(e)
+                if kind == "gone":
+                    return
+                if kind == "permanent":
+                    raise MoveFailed(str(e), permanent=True)
+                if attempt < self.retries:
+                    self.state["retried_objects"] += 1
+                    _bump("retries")
+                    tracing.event("decom.move.retry", bucket=bucket,
+                                  obj=name, attempt=attempt + 1)
+                    self._stop.wait(0.1 * (2 ** attempt))
+        raise MoveFailed(str(last), permanent=False)
 
     def _target_pool(self, obj: str, size: int):
         avail = self.pools._pool_available(obj, size)
@@ -187,18 +444,81 @@ class PoolDecommission:
         return best
 
 
+def _dest_version(target, bucket: str, name: str, oi):
+    """ObjectInfo of the destination's copy of this version (delete
+    markers included), or None when the destination has nothing for it.
+    For versioned objects the version id is the identity; for the null
+    version the latest null-version info answers."""
+    from minio_tpu.erasure.objects import MethodNotAllowedDeleteMarker
+
+    try:
+        return target.get_object_info(bucket, name,
+                                      version_id=oi.version_id or "")
+    except MethodNotAllowedDeleteMarker as e:
+        return e.object_info
+    except (errors.ObjectNotFound, errors.VersionNotFound):
+        return None
+    except errors.MethodNotAllowed:
+        return None
+
+
+def _dest_has_same_or_newer(target, bucket: str, name: str, oi) -> bool:
+    """True when the destination already holds this version (or, for
+    the null version, a same-or-newer one): the source copy is stale
+    and must be DROPPED, never copied over the destination (the
+    model's copy-clobbers-newer mutation is exactly this check
+    removed)."""
+    info = _dest_version(target, bucket, name, oi)
+    if info is None:
+        return False
+    if oi.version_id:
+        return True  # exact version already committed at the dest
+    return (info.mod_time or 0) >= (oi.mod_time or 0)
+
+
+def _fence(src, bucket: str, name: str) -> None:
+    """The write-fence's invalidation half: fire the SOURCE set's
+    ns_updated choke point (hot tier, metacache, bloom tracker — and
+    via the PR 8 broadcast, every peer's hot tier) BEFORE the source
+    copy dies, so no cached route can outlive the version it points
+    at."""
+    try:
+        es = src.get_hashed_set(name)
+    except Exception:
+        return
+    hook = getattr(es, "ns_updated", None)
+    if hook is not None:
+        try:
+            hook(bucket, name)
+        except Exception:
+            pass
+
+
 def move_version(src, target, bucket: str, name: str, oi) -> None:
     """Move one object version between pools with its version id and
-    mod time pinned — shared by decommission and rebalance."""
+    mod time pinned — shared by decommission and rebalance.
+
+    Write-fence ordering (models/topology.py): (1) commit the copy at
+    the destination with write quorum, (2) fire invalidation, (3) only
+    then delete the source copy.  A destination that already holds the
+    version same-or-newer skips (1) — the source copy is stale."""
     from minio_tpu.erasure.objects import PutObjectOptions
 
     if oi.delete_marker:
-        # replay the marker with its id + mod time pinned, then drop
-        # the source's copy
-        target.put_delete_marker(bucket, name, oi.version_id or "",
-                                 oi.mod_time)
+        if not _dest_has_same_or_newer(target, bucket, name, oi):
+            # replay the marker with its id + mod time pinned
+            target.put_delete_marker(bucket, name, oi.version_id or "",
+                                     oi.mod_time)
+        _fence(src, bucket, name)
         src.delete_object(bucket, name,
                           version_id=oi.version_id or "null")
+        return
+    if _dest_has_same_or_newer(target, bucket, name, oi):
+        # an overwrite PUT landed at a live pool mid-drain: the source
+        # copy is stale — drop it, never clobber the newer destination
+        _bump("skipped_stale")
+        _fence(src, bucket, name)
+        src.delete_object(bucket, name, version_id=oi.version_id or "null")
         return
     _, stream = src.get_object(bucket, name, version_id=oi.version_id)
     meta = {k: v for k, v in oi.metadata.items()
@@ -214,7 +534,11 @@ def move_version(src, target, bucket: str, name: str, oi) -> None:
         # If-Match / client caches (ADVICE r4 medium)
         etag=oi.etag or oi.metadata.get("etag", ""),
     )
+    # put_object raising means the copy did NOT meet write quorum: the
+    # exception propagates and the source copy survives (no-version-
+    # lost) — the retry loop or a restarted drain converges it
     target.put_object(bucket, name, _IterReader(stream), oi.size, opts)
+    _fence(src, bucket, name)
     src.delete_object(bucket, name, version_id=oi.version_id or "null")
 
 
@@ -225,7 +549,10 @@ class PoolRebalance:
 
     Pools whose used fraction exceeds the cluster average by more than
     `tolerance` donate objects to the emptiest pool until they fall
-    within it."""
+    within it.  Moves share the decommission's fenced move_version (and
+    its stale-source protection), defer to foreground load through the
+    same brownout throttle, and run each move under a deadline budget.
+    """
 
     def __init__(self, pools, tolerance: float = 0.02):
         if len(pools.pools) < 2:
@@ -237,10 +564,18 @@ class PoolRebalance:
         self.state = load_state(pools.pools[0], REBAL_FILE)
         if self.state.get("state") == "running":
             # persisted 'running' with no thread = a previous process
-            # died mid-rebalance; surface that instead of lying
+            # died mid-rebalance; surface that instead of lying.  A
+            # start() from here resumes (rebalance is idempotent: it
+            # re-measures fill fractions and moves only what is still
+            # over tolerance).
             self.state["state"] = "interrupted"
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self.throttle = None
+        self.obj_timeout = float(os.environ.get(
+            "MINIO_TPU_DECOM_OBJ_TIMEOUT_S", "120"))
+        self.retries = max(0, int(os.environ.get(
+            "MINIO_TPU_DECOM_RETRIES", "3")))
 
     def _save(self) -> None:
         self.state["degraded"] = False
@@ -284,7 +619,7 @@ class PoolRebalance:
             raise errors.InvalidArgument("rebalance already running")
         self.state = {"state": "running", "started": time.time(),
                       "moved_objects": 0, "moved_bytes": 0,
-                      "failed_objects": 0,
+                      "failed_objects": 0, "throttle_waits": 0,
                       "seq": int(self.state.get("seq", 0))}
         self._save()
         self._stop.clear()
@@ -302,36 +637,57 @@ class PoolRebalance:
         if self._thread is not None:
             self._thread.join(timeout)
 
+    def _throttle_wait(self) -> None:
+        if self.throttle is None or self.throttle():
+            return
+        self.state["throttle_waits"] += 1
+        _bump("throttle_waits")
+        while not self._stop.is_set() and not self.throttle():
+            time.sleep(0.05)
+
     # -- loop ---------------------------------------------------------------
     def _run(self) -> None:
+        root = tracing.start("topology.rebalance")
+        token = tracing.install(root) if root is not None else None
+        t0 = time.monotonic()
+        status = 200
         try:
-            for _ in range(4):  # bounded convergence rounds
-                if self._stop.is_set():
-                    break
-                caps = self._capacity(fresh=True)
-                fracs = [u / t if t else 0.0 for t, u in caps]
-                avg = sum(fracs) / len(fracs)
-                donors = [i for i, f in enumerate(fracs)
-                          if f > avg + self.tolerance
-                          and i not in self.pools._draining]
-                if not donors:
-                    break
-                moved_any = False
-                for i in donors:
-                    # byte budget computed up front: the du cache lags
-                    # moves, so steering by live fractions over-drains
-                    over = int((fracs[i] - avg) * caps[i][0])
-                    if self._donate(i, over, fracs):
-                        moved_any = True
-                self._save()
-                if not moved_any:
-                    break
-            self.state["state"] = "complete"
-            self.state["finished"] = time.time()
-        except Exception as e:
-            self.state["state"] = "failed"
-            self.state["error"] = str(e)
-        self._save()
+            try:
+                for _ in range(4):  # bounded convergence rounds
+                    if self._stop.is_set():
+                        break
+                    caps = self._capacity(fresh=True)
+                    fracs = [u / t if t else 0.0 for t, u in caps]
+                    avg = sum(fracs) / len(fracs)
+                    suspended = self.pools.topology.suspended()
+                    donors = [i for i, f in enumerate(fracs)
+                              if f > avg + self.tolerance
+                              and i not in suspended]
+                    if not donors:
+                        break
+                    moved_any = False
+                    for i in donors:
+                        # byte budget computed up front: the du cache lags
+                        # moves, so steering by live fractions over-drains
+                        over = int((fracs[i] - avg) * caps[i][0])
+                        if self._donate(i, over, fracs):
+                            moved_any = True
+                    self._save()
+                    if not moved_any:
+                        break
+                self.state["state"] = "complete"
+                self.state["finished"] = time.time()
+            except Exception as e:
+                self.state["state"] = "failed"
+                self.state["error"] = str(e)
+                status = 500
+            self._save()
+        finally:
+            if root is not None:
+                root.tag(moved=self.state.get("moved_objects", 0))
+                tracing.reset(token)
+                tracing.finish(root, status=status, error=status >= 500,
+                               duration=time.monotonic() - t0)
 
     def _donate(self, idx: int, budget: int, fracs: list[float]) -> bool:
         """Move ~`budget` logical bytes out of pool `idx` into the
@@ -343,14 +699,16 @@ class PoolRebalance:
         moved = 0
         # erasure overhead: logical bytes land ~N/K larger on disk
         overhead = 2.0
+        suspended = self.pools.topology.suspended()
         for vol in src.list_buckets():
             bucket = vol.name
             for entry in src.list_entries(bucket):
                 if self._stop.is_set() or donated >= budget:
                     return moved > 0
+                self._throttle_wait()
                 tgt_i = min(
                     (i for i in range(len(est)) if i != idx
-                     and i not in self.pools._draining),
+                     and i not in suspended),
                     key=lambda i: est[i], default=None)
                 if tgt_i is None:
                     return moved > 0
@@ -358,7 +716,9 @@ class PoolRebalance:
                 try:
                     obj_bytes = 0
                     for oi in reversed(entry.versions):
-                        move_version(src, target, bucket, entry.name, oi)
+                        with scope(Budget(self.obj_timeout)):
+                            move_version(src, target, bucket, entry.name,
+                                         oi)
                         self.state["moved_objects"] += 1
                         self.state["moved_bytes"] += max(oi.size, 0)
                         obj_bytes += max(oi.size, 0)
@@ -366,6 +726,8 @@ class PoolRebalance:
                     donated += int(obj_bytes * overhead)
                     if caps[tgt_i][0]:
                         est[tgt_i] += obj_bytes * overhead / caps[tgt_i][0]
+                except _GONE:
+                    continue  # deleted mid-rebalance: nothing to move
                 except Exception:
                     self.state["failed_objects"] += 1
         return moved > 0

@@ -9,20 +9,33 @@ triggers a full initial sync.
 Wire protocol here: each push is a signed POST to the peer's
 `/minio/admin/v3/site-replication/apply` endpoint carrying
 {kind, ...payload} JSON; the receiving side applies it with
-propagation SUPPRESSED (thread-local flag) so changes never loop
-between sites.  Pushes are queued and retried by a background worker,
-so a temporarily-down peer converges when it returns.
+propagation SUPPRESSED (a **contextvar**, sibling of deadline.Budget
+and the tracing span — `ctx_submit`/copied contexts carry it across
+executor hops, where the old `threading.local` silently dropped it and
+an apply that fanned out through the pool could re-push to peers and
+loop).  Pushes are queued and retried by a background worker, so a
+temporarily-down peer converges when it returns.
+
+Resync (ISSUE 14): `resync(peer)` re-pushes bucket state to one peer —
+driven by the bloom change tracker (utils/bloom.py) so only buckets
+that CAN have changed since the last scanner cycle are walked, not the
+full namespace (reference: site replication resync,
+cmd/site-replication.go; the tracker is the same one the scanner uses
+to skip clean subtrees).
 """
 
 from __future__ import annotations
 
+import contextvars
 import http.client
 import json
 import queue
 import threading
+import time
 import urllib.parse
 
 from minio_tpu.storage import errors
+from minio_tpu.utils import tracing
 from minio_tpu.utils.deadline import service_thread
 from minio_tpu.storage.local import SYSTEM_VOL
 from minio_tpu.utils.logger import log
@@ -31,20 +44,25 @@ SITE_CONFIG_PATH = "config/site.json"
 APPLY_PATH = "/minio/admin/v3/site-replication/apply"
 MAX_ATTEMPTS = 5
 
-_local = threading.local()
+#: propagation suppression rides a contextvar so it survives
+#: ctx_submit/executor hops (the threading.local it replaces did not:
+#: an apply fanning out through a pool thread lost the flag and its
+#: mutation hooks re-pushed to peers — a cross-site feedback loop)
+_suppress: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "minio_tpu_site_suppress", default=False)
 
 
 def propagation_suppressed() -> bool:
-    return getattr(_local, "suppress", False)
+    return _suppress.get()
 
 
 class _Suppressed:
     def __enter__(self):
-        _local.suppress = True
+        self._token = _suppress.set(True)
         return self
 
     def __exit__(self, *a):
-        _local.suppress = False
+        _suppress.reset(self._token)
         return False
 
 
@@ -86,8 +104,16 @@ class SiteReplicationSys:
         self._queues: dict[str, queue.Queue] = {}
         self._workers: dict[str, threading.Thread] = {}
         self._stop = threading.Event()
+        # push counters are bumped from per-peer worker threads: a bare
+        # += would be exactly the lost-update class PR 10's detector
+        # flags — one lock owns all of them
+        self._stat_mu = threading.Lock()
         self.pushed = 0
         self.failed = 0
+        self.retries = 0          # re-queued push attempts
+        self.resyncs = 0          # resync sweeps run
+        self.resync_pushed = 0    # docs queued by resyncs
+        self.resync_skipped = 0   # buckets the bloom tracker proved clean
         self._load()
         # mutation hooks (no-ops while propagation is suppressed)
         meta.on_site_change = self._on_bucket_meta
@@ -170,13 +196,17 @@ class SiteReplicationSys:
                 return  # peer removed: drop its queue
             try:
                 self._post(peer, doc)
-                self.pushed += 1
+                with self._stat_mu:
+                    self.pushed += 1
             except Exception as e:
                 if attempts + 1 < MAX_ATTEMPTS:
+                    with self._stat_mu:
+                        self.retries += 1
                     self._stop.wait(0.5 * (2 ** attempts))
                     q.put((doc, attempts + 1))
                 else:
-                    self.failed += 1
+                    with self._stat_mu:
+                        self.failed += 1
                     log.warning("site replication push failed",
                                 peer=peer_name, kind=doc.get("kind"),
                                 error=str(e))
@@ -236,11 +266,17 @@ class SiteReplicationSys:
 
     def info(self) -> dict:
         with self._mu:
+            peers = [p.to_dict(redact=True) for p in self.peers.values()]
+            queued = sum(q.qsize() for q in self._queues.values())
+        with self._stat_mu:
             return {
-                "peers": [p.to_dict(redact=True)
-                          for p in self.peers.values()],
+                "peers": peers,
                 "pushed": self.pushed, "failed": self.failed,
-                "queued": sum(q.qsize() for q in self._queues.values()),
+                "retries": self.retries,
+                "resyncs": self.resyncs,
+                "resyncPushed": self.resync_pushed,
+                "resyncSkipped": self.resync_skipped,
+                "queued": queued,
             }
 
     # -- mutation hooks ------------------------------------------------------
@@ -377,34 +413,87 @@ class SiteReplicationSys:
             else:
                 raise ValueError(f"unknown site-replication kind {kind!r}")
 
-    # -- initial sync --------------------------------------------------------
+    # -- initial sync / resync -----------------------------------------------
+    def _sync_iam(self, peer_name: str) -> int:
+        """Queue the full IAM state for one peer; returns docs queued."""
+        n = 0
+        for name in self.iam.list_policies():
+            doc = self._export_iam("policy", name)
+            if doc:
+                self._queues[peer_name].put((doc, 0))
+                n += 1
+        for u in self.iam.list_users():
+            doc = self._export_iam("user", u.get("accessKey", ""))
+            if doc:
+                self._queues[peer_name].put((doc, 0))
+                n += 1
+        for g in self.iam.list_groups():
+            doc = self._export_iam("group", g)
+            if doc:
+                self._queues[peer_name].put((doc, 0))
+                n += 1
+        return n
+
     def _initial_sync(self, peer_name: str) -> None:
         """Queue the full local state for a newly-added peer
         (reference: site replication bootstraps buckets + IAM)."""
         try:
-            for name in self.iam.list_policies():
-                doc = self._export_iam("policy", name)
-                if doc:
-                    self._queues[peer_name].put((doc, 0))
-            for u in self.iam.list_users():
-                doc = self._export_iam("user", u.get("accessKey", ""))
-                if doc:
-                    self._queues[peer_name].put((doc, 0))
-            for g in self.iam.list_groups():
-                doc = self._export_iam("group", g)
-                if doc:
-                    self._queues[peer_name].put((doc, 0))
-            for v in self.api.list_buckets():
-                self._queues[peer_name].put(
-                    ({"kind": "bucket-create", "bucket": v.name}, 0))
-                meta = self.api.get_bucket_metadata(v.name)
-                if meta:
-                    self._queues[peer_name].put(
-                        ({"kind": "bucket-meta", "bucket": v.name,
-                          "meta": meta}, 0))
+            self._sync_iam(peer_name)
+            self.resync(peer_name, tracker=None, full=True)
         except Exception as e:
             log.warning("site replication initial sync failed",
                         peer=peer_name, error=str(e))
+
+    def resync(self, peer_name: str, tracker=None,
+               full: bool = False) -> dict:
+        """Re-push bucket state to one peer (reference: `mc admin
+        replicate resync`, cmd/site-replication.go) — a peer that was
+        down past the push retry budget converges here without a full
+        namespace walk: buckets the bloom change tracker
+        (utils/bloom.py) proves untouched since the last scanner cycle
+        are SKIPPED (false positives re-push harmlessly, false
+        negatives are impossible by the filter's contract).  full=True
+        (or no tracker) pushes everything.  Pushes ride the normal
+        retried signed-push worker."""
+        with self._mu:
+            if peer_name not in self.peers:
+                raise KeyError(peer_name)
+        self._ensure_worker(peer_name)
+        root = tracing.start("site.resync", peer=peer_name,
+                             full=bool(full))
+        token = tracing.install(root) if root is not None else None
+        t0 = time.monotonic()
+        pushed = skipped = 0
+        status = 200
+        try:
+            q = self._queues[peer_name]
+            for v in self.api.list_buckets():
+                if tracker is not None and not full \
+                        and not tracker.bucket_dirty(v.name):
+                    skipped += 1
+                    continue
+                q.put(({"kind": "bucket-create", "bucket": v.name}, 0))
+                pushed += 1
+                meta = self.api.get_bucket_metadata(v.name)
+                if meta:
+                    q.put(({"kind": "bucket-meta", "bucket": v.name,
+                            "meta": meta}, 0))
+                    pushed += 1
+        except Exception:
+            status = 500
+            raise
+        finally:
+            with self._stat_mu:
+                self.resyncs += 1
+                self.resync_pushed += pushed
+                self.resync_skipped += skipped
+            if root is not None:
+                root.tag(queued=pushed, skippedClean=skipped)
+                tracing.reset(token)
+                tracing.finish(root, status=status, error=status >= 500,
+                               duration=time.monotonic() - t0)
+        return {"peer": peer_name, "queued": pushed,
+                "skippedClean": skipped, "full": bool(full)}
 
     def close(self) -> None:
         self._stop.set()

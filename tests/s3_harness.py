@@ -63,7 +63,13 @@ class S3TestServer:
     def __init__(self, root: str, n_drives: int = 4,
                  access_key: str = "testadmin", secret_key: str = "testsecret",
                  start_services: bool = False, scan_interval: float = 60.0,
-                 pools=None):
+                 pools=None, ssl_ctx=None, port: int = 0):
+        # ssl_ctx: serve TLS (mTLS STS tests build a context requiring
+        # client certs); port: pin the listen port (0 = ephemeral) so a
+        # killed-and-restarted server can come back at the SAME address
+        # (site-replication retry convergence drills need that)
+        self._ssl_ctx = ssl_ctx
+        self._want_port = port
         # SSE-S3 needs a configured KMS master key (never persisted to the
         # drives); give tests a deterministic one unless a test overrides.
         os.environ.setdefault(
@@ -97,7 +103,8 @@ class S3TestServer:
         async def start():
             runner = web.AppRunner(self.app)
             await runner.setup()
-            site = web.TCPSite(runner, "127.0.0.1", 0)
+            site = web.TCPSite(runner, "127.0.0.1", self._want_port,
+                               ssl_context=self._ssl_ctx)
             await site.start()
             self.port = runner.addresses[0][1]
             self._runner = runner

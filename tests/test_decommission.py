@@ -137,15 +137,26 @@ class TestDecommission:
         """VERDICT r5 #3 done-condition: state persists to a write
         quorum, so killing the drive the old single-drive scheme used
         (first online) mid-drain loses nothing — a restarted drain
-        resumes from the last completed bucket."""
+        resumes from the last completed bucket.
+
+        The 'done' bucket is GENUINELY drained first (the verification
+        sweep — ISSUE 14 — re-drains any bucket marked done that still
+        holds content, so a faked done marker no longer suppresses
+        moves)."""
         import shutil
+
+        from minio_tpu.services.decom import move_version
 
         pools = _two_pools(tmp_path)
         for b in ("qa", "qb"):
             pools.make_bucket(b)
-            pools.put_object(b, "o", io.BytesIO(b"x" * 2000), 2000)
+            # place both objects IN pool 0 deterministically
+            pools.pools[0].put_object(b, "o", io.BytesIO(b"x" * 2000),
+                                      2000)
+        # bucket qa really IS drained before the state says so
+        oi = pools.pools[0].get_object_info("qa", "o")
+        move_version(pools.pools[0], pools.pools[1], "qa", "o", oi)
         job = PoolDecommission(pools, 0)
-        # simulate persisted mid-drain progress: bucket qa already done
         job.state = {"state": "draining", "started": 0.0,
                      "moved_objects": 1, "moved_bytes": 2000,
                      "failed_objects": 0, "done_buckets": ["qa"]}
@@ -168,6 +179,10 @@ class TestDecommission:
         assert "qa" in job2.state["done_buckets"]
         # only qb's content was (re)moved in the resumed run
         assert job2.state["moved_objects"] <= 1
+        # and both objects remain readable from the surviving pool
+        for b in ("qa", "qb"):
+            _, s = pools.get_object(b, "o")
+            assert b"".join(s) == b"x" * 2000
 
     def test_save_below_quorum_marks_degraded_then_recovers(self, tmp_path):
         """Saves that miss the write quorum mark the job degraded in
@@ -317,3 +332,223 @@ class TestDecommissionAdminAPI:
             assert r.status == 400
         finally:
             srv.close()
+
+
+class TestCrashResumeSeeds:
+    """ISSUE 14 satellite: coverage for the crash/resume seeds that
+    predate the PR (quorum state, degraded saves, cancel semantics)
+    plus the new object-granular cursor."""
+
+    def test_load_state_picks_highest_seq_quorum_copy(self, tmp_path):
+        """After a PARTIAL save (some drives carry seq N, others the
+        older N-1), load_state must return the newest copy from any
+        surviving quorum member — not whichever drive answers first."""
+        from minio_tpu.services.decom import DECOM_FILE
+        from minio_tpu.storage.local import SYSTEM_VOL
+
+        pools = _two_pools(tmp_path)
+        src = pools.pools[0]
+        old = json.dumps({"state": "draining", "seq": 5,
+                          "done_buckets": ["old"]}).encode()
+        new = json.dumps({"state": "draining", "seq": 7,
+                          "done_buckets": ["old", "new"]}).encode()
+        # drive 0 got only the OLD save; 1..3 carry the newer one
+        src.all_disks[0].write_all(SYSTEM_VOL, DECOM_FILE, old)
+        for d in src.all_disks[1:]:
+            d.write_all(SYSTEM_VOL, DECOM_FILE, new)
+        st = load_state(src)
+        assert st["seq"] == 7
+        assert st["done_buckets"] == ["old", "new"]
+
+    def test_degraded_save_visible_in_admin_status(self, tmp_path):
+        """A save that misses write quorum marks the LIVE job degraded
+        and the pools admin status surfaces it."""
+        import shutil
+
+        pools = _two_pools(tmp_path / "drives")
+        srv = S3TestServer(str(tmp_path / "drives"), pools=pools)
+        try:
+            srv.request("PUT", "/dgb")
+            for i in range(4):
+                srv.request("PUT", f"/dgb/o{i}", data=b"d" * 3000)
+            r = srv.request("POST", "/minio/admin/v3/pools/decommission",
+                            query=[("pool", "0")])
+            assert r.status == 200, r.body
+            job = srv.server._decom_jobs_map[0]
+            job.wait(30)
+            # now 2 of 4 drives die: the next save misses quorum (3)
+            for d in pools.pools[0].all_disks[:2]:
+                shutil.rmtree(d.root)
+            job._save()
+            r = srv.request("GET", "/minio/admin/v3/pools/status")
+            st = json.loads(r.body)["pools"][0]["decommission"]
+            assert st["degraded"] is True
+        finally:
+            srv.close()
+
+    def test_canceled_pool_returns_to_placement(self, tmp_path):
+        pools = _two_pools(tmp_path)
+        pools.make_bucket("cxl")
+        for i in range(8):
+            pools.put_object("cxl", f"o{i}", io.BytesIO(b"c" * 2000),
+                             2000)
+        job = PoolDecommission(pools, 0)
+        job.start()
+        job.cancel()
+        assert job.state["state"] == "canceled"
+        assert 0 not in pools._draining
+        # a NEW pools object over the same drives honors the cancel:
+        # 'canceled' is NOT a suspension reason
+        from minio_tpu.erasure.sets import (ErasureSets as ES,
+                                            ErasureServerPools as ESP)
+        from minio_tpu.storage.local import LocalStorage as LS
+
+        pools2 = ESP([
+            ES([LS(str(tmp_path / f"p0-d{i}")) for i in range(4)],
+               set_size=4),
+            ES([LS(str(tmp_path / f"p1-d{i}")) for i in range(4)],
+               set_size=4),
+        ])
+        assert 0 not in pools2._draining
+        # placement can pick pool 0 again: over many fresh objects some
+        # must land there (deterministic hash spreads across both)
+        for i in range(16):
+            pools2.put_object("cxl", f"fresh-{i}", io.BytesIO(b"n"), 1)
+        assert any(o.startswith("fresh-")
+                   for o in pools2.pools[0].list_objects("cxl"))
+
+    def test_object_cursor_resumes_mid_bucket(self, tmp_path):
+        """A drain killed mid-bucket (no final save — simulated
+        SIGKILL) resumes AFTER the last checkpointed object instead of
+        replaying the bucket, and converges with zero lost versions."""
+        pools = _two_pools(tmp_path)
+        pools.make_bucket("curb")
+        payload = {f"obj-{i:03d}": bytes([i % 251]) * (4000 + i)
+                   for i in range(30)}
+        for name, data in payload.items():
+            pools.put_object("curb", name, io.BytesIO(data), len(data))
+        src = pools.pools[0]
+        n_src = len(src.list_objects("curb"))
+        assert n_src >= 5, "placement sent too little to pool 0"
+
+        job = PoolDecommission(pools, 0)
+        job.checkpoint_every = 2
+        job._crash_hook = lambda moved: moved >= 5
+        job.start()
+        job.wait(30)
+        assert not job._thread.is_alive()
+        # killed without a final save: the durable state is mid-drain
+        st = load_state(src)
+        assert st["state"] == "draining"
+        assert st.get("cursor"), st
+        moved_before = st["cursor"]["obj"]
+
+        job2 = PoolDecommission(pools, 0)
+        assert job2.state["cursor"]["obj"] == moved_before
+        job2.start()
+        job2.wait(60)
+        assert job2.state["state"] == "complete", job2.state
+        # resumed run did NOT replay the checkpointed prefix
+        assert job2.state["moved_objects"] <= n_src - 4
+        # zero lost versions, every byte intact, source empty
+        for name, data in payload.items():
+            _, stream = pools.get_object("curb", name)
+            assert b"".join(stream) == data, name
+        assert src.list_objects("curb") == []
+
+    def test_write_fence_fires_before_source_delete(self, tmp_path):
+        """The write-fence invariant, order-pinned: destination commit,
+        then ns_updated on the SOURCE set, then the source delete
+        (models/topology.py delete-before-fence is this order broken)."""
+        from minio_tpu.services.decom import move_version
+
+        pools = _two_pools(tmp_path)
+        pools.make_bucket("wfb")
+        pools.pools[0].put_object("wfb", "fenced",
+                                  io.BytesIO(b"f" * 1000), 1000)
+        src, dst = pools.pools[0], pools.pools[1]
+        events = []
+        es = src.get_hashed_set("fenced")
+        es.ns_updated = lambda b, o: events.append(("fence", b, o))
+        orig_delete = src.delete_object
+
+        def spying_delete(bucket, obj, **kw):
+            events.append(("delete", bucket, obj))
+            return orig_delete(bucket, obj, **kw)
+
+        src.delete_object = spying_delete
+        oi = src.get_object_info("wfb", "fenced")
+        move_version(src, dst, "wfb", "fenced", oi)
+        kinds = [e[0] for e in events]
+        assert "fence" in kinds and "delete" in kinds
+        assert kinds.index("fence") < kinds.index("delete")
+        # destination committed (readable) — and source empty
+        _, stream = dst.get_object("wfb", "fenced")
+        assert b"".join(stream) == b"f" * 1000
+        assert src.list_objects("wfb") == []
+
+    def test_overwrite_mid_drain_never_clobbered(self, tmp_path):
+        """An overwrite PUT landing on a live pool mid-drain must win:
+        the drain drops the stale source copy instead of copying it
+        over the newer destination (models/topology.py
+        copy-clobbers-newer)."""
+        from minio_tpu.services.decom import move_version
+        from minio_tpu.services import decom as decom_mod
+
+        pools = _two_pools(tmp_path)
+        pools.make_bucket("owb")
+        # object lives in pool 0; capture its pre-drain info
+        pools.pools[0].put_object("owb", "doc", io.BytesIO(b"OLD" * 500),
+                                  1500)
+        stale_oi = pools.pools[0].get_object_info("owb", "doc")
+        # drain starts: pool 0 suspended; the overwrite routes LIVE
+        pools.mark_draining(0, True)
+        pools.put_object("owb", "doc", io.BytesIO(b"NEW" * 600), 1800)
+        assert "doc" in pools.pools[1].list_objects("owb")
+        before = decom_mod.stats["skipped_stale"]
+        # the drain reaches the stale source copy
+        move_version(pools.pools[0], pools.pools[1], "owb", "doc",
+                     stale_oi)
+        assert decom_mod.stats["skipped_stale"] == before + 1
+        # the overwrite's bytes won; the stale copy is gone
+        _, stream = pools.get_object("owb", "doc")
+        assert b"".join(stream) == b"NEW" * 600
+        assert pools.pools[0].list_objects("owb") == []
+
+    def test_verification_sweep_catches_racing_put(self, tmp_path):
+        """Routing-decision vs write-landing TOCTOU: a PUT that
+        resolved its pool BEFORE suspension became visible can land in
+        the draining pool BEHIND the cursor.  The drain's bounded
+        verification sweep re-lists the source pool and moves such
+        stragglers — found live by the chaos drill's serial run."""
+        import io as _io
+
+        pools = _two_pools(tmp_path)
+        pools.make_bucket("rcb")
+        for i in range(10):
+            pools.pools[0].put_object("rcb", f"obj-{i:02d}",
+                                      _io.BytesIO(b"r" * 1200), 1200)
+        job = PoolDecommission(pools, 0)
+        injected = []
+
+        def racing_throttle():
+            # fires between objects: once the cursor has passed the
+            # "aaa" prefix, land a write BEHIND it (the simulated
+            # pre-suspension-routed PUT)
+            if not injected and job.state["moved_objects"] >= 2:
+                injected.append(1)
+                pools.pools[0].put_object(
+                    "rcb", "aaa-racer", _io.BytesIO(b"RACE" * 300),
+                    1200)
+            return True
+
+        job.throttle = racing_throttle
+        job.start()
+        job.wait(60)
+        assert injected, "injection never fired"
+        assert job.state["state"] == "complete", job.state
+        # the straggler was caught by the verification sweep: source
+        # empty, bytes intact at the destination
+        assert pools.pools[0].list_objects("rcb") == []
+        _, s = pools.get_object("rcb", "aaa-racer")
+        assert b"".join(s) == b"RACE" * 300

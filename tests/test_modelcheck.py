@@ -29,8 +29,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 #: the protocols PR 8's correctness rests on; ROADMAP records this
 #: inventory and future protocol PRs extend it (ISSUE 11 added the
 #: erasure batcher's tick/submit/quiesce protocol, ISSUE 13 the
-#: per-tenant QoS DRR admit/release/reweight/shed protocol)
-LOAD_BEARING = ("arena-ring", "hotcache", "breaker-mrf", "batcher", "qos")
+#: per-tenant QoS DRR admit/release/reweight/shed protocol, ISSUE 14
+#: the pool-drain suspend/copy/fence/delete/checkpoint protocol)
+LOAD_BEARING = ("arena-ring", "hotcache", "breaker-mrf", "batcher", "qos",
+                "topology")
 
 
 # ------------------------------------------------------------- engine

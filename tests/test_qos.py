@@ -840,3 +840,182 @@ class TestClientGrantsHandler:
             assert self._exchange(srv, "good-token").status == 501
         finally:
             srv.close()
+
+
+# ----------------------------------------------------- byte-cost pricing
+class TestByteCost:
+    """ISSUE 14 satellite (PR 13 leftover): admission cost weighted by
+    estimated bytes — clamp(ceil(content_length / cost_unit), 1,
+    max_cost) — so one multipart PUT is priced honestly against N
+    small GETs.  The DRR discipline with costs is model-checked
+    (models/qos.py cost-priced + save-up-not-progress); this pins the
+    implementation."""
+
+    def test_cost_of_clamps_and_degrades(self):
+        p = QosPlane(4, cost_unit=1 << 20, max_cost=8)
+        for n, want in ((None, 1.0), (0, 1.0), (100, 1.0),
+                        (1 << 20, 1.0), ((1 << 20) + 1, 2.0),
+                        (5 << 20, 5.0), (100 << 30, 8.0)):
+            r = types.SimpleNamespace(content_length=n)
+            assert p.cost_of(r) == want, (n, want)
+        # cost_unit=0 restores flat unit pricing
+        flat = QosPlane(4, cost_unit=0)
+        assert flat.cost_of(
+            types.SimpleNamespace(content_length=64 << 20)) == 1.0
+
+    def test_mixed_size_fairness_equal_weights(self):
+        """Equal weights, one slot: a tenant of cost-4 multipart PUTs
+        vs a tenant of cost-1 GETs — the small tenant gets ~4 grants
+        per heavy grant (byte fairness), and the heavy tenant still
+        progresses (no starvation: save-up across sweeps works even
+        with cost > weight)."""
+        async def drill():
+            p = QosPlane(1)
+            assert p.try_admit("bucket:z")   # hold the slot
+            pend = {
+                "bucket:heavy": [p.enqueue("bucket:heavy", cost=4.0)[0]
+                                 for _ in range(3)],
+                "bucket:small": [p.enqueue("bucket:small", cost=1.0)[0]
+                                 for _ in range(12)],
+            }
+            p.release("bucket:z")
+            order = []
+            for _ in range(15):
+                granted = None
+                for t, futs in pend.items():
+                    for f in futs:
+                        if f.done():
+                            granted = (t, f)
+                            break
+                    if granted:
+                        break
+                assert granted, f"stranded; order so far {order}"
+                t, f = granted
+                pend[t].remove(f)
+                order.append(t)
+                p.release(t)
+            return order
+
+        order = asyncio.run(drill())
+        assert order.count("bucket:heavy") == 3
+        assert order.count("bucket:small") == 12
+        # byte fairness: among the first 10 grants the small tenant
+        # holds a clear majority (every heavy grant costs 4 credits)
+        assert order[:10].count("bucket:small") >= 7, order
+        # no starvation: the heavy tenant lands within the first 10
+        assert "bucket:heavy" in order[:10], order
+
+    def test_heavy_head_saves_up_and_does_not_strand(self):
+        """cost > weight: the queued heavy request must converge via
+        save-up-across-sweeps (the model's save-up-not-progress wedge)
+        even when it is the ONLY queued work."""
+        async def drill():
+            p = QosPlane(1, max_cost=8.0)
+            assert p.try_admit("bucket:z")
+            fut, _ = p.enqueue("bucket:big", cost=6.0)
+            p.release("bucket:z")  # one release must be enough
+            return fut.done()
+
+        assert asyncio.run(drill())
+
+    def test_enqueue_floors_cost_at_one(self):
+        async def drill():
+            p = QosPlane(1)
+            assert p.try_admit("bucket:z")
+            fut, _ = p.enqueue("bucket:t", cost=0.0)
+            assert fut._qos_cost == 1.0
+            p.release("bucket:z")
+
+        asyncio.run(drill())
+
+    def test_deficit_bound_with_costs(self):
+        """0 <= deficit <= weight + cost - 1 (the model's relaxed
+        conservation bound) and empty queues still forfeit."""
+        async def drill():
+            p = QosPlane(1, max_cost=8.0)
+            assert p.try_admit("bucket:z")
+            pend = [p.enqueue("bucket:t", cost=5.0)[0]
+                    for _ in range(2)]
+            p.release("bucket:z")
+            with p._mu:
+                st = p._tenants["bucket:t"]
+                assert 0.0 <= st.deficit <= st.rule.weight + 5.0 - 1.0
+            while any(not f.done() for f in pend):
+                for f in list(pend):
+                    if f.done():
+                        pend.remove(f)
+                        p.release("bucket:t")
+                        break
+            with p._mu:
+                assert p._tenants["bucket:t"].deficit == 0.0
+
+        asyncio.run(drill())
+
+    def test_env_and_config_knobs(self, monkeypatch):
+        monkeypatch.setenv("MINIO_TPU_QOS", "1")
+        monkeypatch.setenv("MINIO_TPU_QOS_COST_UNIT", str(64 << 10))
+        monkeypatch.setenv("MINIO_TPU_QOS_MAX_COST", "4")
+        p = QosPlane(4)
+        p.load_config(None)
+        assert p.cost_unit == 64 << 10
+        assert p.max_cost == 4.0
+        r = types.SimpleNamespace(content_length=1 << 20)
+        assert p.cost_of(r) == 4.0  # 16 units, clamped to 4
+        # malformed values degrade, never fail boot
+        monkeypatch.setenv("MINIO_TPU_QOS_COST_UNIT", "banana")
+        monkeypatch.setenv("MINIO_TPU_QOS_MAX_COST", "-3")
+        p2 = QosPlane(4)
+        p2.load_config(None)
+        assert p2.cost_unit > 0
+        assert p2.max_cost >= 1.0
+
+    def test_admin_roundtrip_cost_knobs(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MINIO_TPU_QOS", "1")
+        srv = S3TestServer(str(tmp_path / "cost"))
+        try:
+            body = json.dumps({"cost_unit": 64 << 10,
+                               "max_cost": 4}).encode()
+            r = srv.request("PUT", "/minio/admin/v3/qos", data=body)
+            assert r.status == 200, r.text()
+            doc = json.loads(r.body)
+            assert doc["costUnit"] == 64 << 10
+            assert doc["maxCost"] == 4.0
+            # applied LIVE
+            assert srv.server.qos.cost_unit == 64 << 10
+            assert srv.server.qos.max_cost == 4.0
+            # a 256 KiB PUT now costs 4 points (clamped from 4 units)
+            assert srv.request("PUT", "/costb").status == 200
+            assert srv.request("PUT", "/costb/big",
+                               data=b"z" * (256 << 10)).status == 200
+            for bad in (json.dumps({"cost_unit": -1}).encode(),
+                        json.dumps({"cost_unit": True}).encode(),
+                        json.dumps({"max_cost": 0}).encode(),
+                        b'{"max_cost": NaN}'):
+                r = srv.request("PUT", "/minio/admin/v3/qos", data=bad)
+                assert r.status == 400, (bad, r.body)
+        finally:
+            srv.close()
+
+    def test_tiny_weight_heavy_cost_does_not_spin(self):
+        """Review fix: a round that admitted nothing fast-forwards the
+        save-up arithmetic instead of spinning cost/weight iterations
+        under the plane mutex — a hostile Content-Length with a tiny
+        weight must not stall the event loop (literal rounds here would
+        be ~3200)."""
+        async def drill():
+            p = QosPlane(1, rules={"bucket:t": TenantRule(weight=0.01)},
+                         max_cost=32.0)
+            assert p.try_admit("bucket:z")
+            fut, _ = p.enqueue("bucket:t", cost=32.0)
+            r0 = p._rounds
+            p.release("bucket:z")
+            assert fut.done(), "heavy head stranded"
+            # fast-forward: a handful of sweep rounds, not thousands
+            assert p._rounds - r0 < 10, p._rounds - r0
+            with p._mu:
+                st = p._tenants["bucket:t"]
+                assert 0.0 <= st.deficit \
+                    <= st.rule.weight + 32.0 - 1.0 + 1e-9
+            p.release("bucket:t")
+
+        asyncio.run(drill())

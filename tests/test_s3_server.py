@@ -560,3 +560,55 @@ class TestConformanceHardening:
                         headers={"x-amz-copy-source": "/ssecbkt2/plain",
                                  **copy_triple})
         assert r.status == 400 and "InvalidRequest" in r.text()
+
+
+class TestCertificateSTSDegrade:
+    """AssumeRoleWithCertificate degrade paths that need NO TLS and NO
+    `cryptography` wheel — minimal containers keep exercising the
+    handler (the full mTLS round trip lives in tests/test_sts_kms.py
+    behind the optional-dep skip)."""
+
+    def test_plain_http_is_a_clean_client_error(self, tmp_path):
+        srv = S3TestServer(str(tmp_path))
+        try:
+            r = srv.raw_request(
+                "POST", "/",
+                data=b"Action=AssumeRoleWithCertificate"
+                     b"&Version=2011-06-15",
+                headers={"content-type":
+                         "application/x-www-form-urlencoded",
+                         "host": srv.host})
+            assert r.status == 400, r.body
+            assert b"InvalidRequest" in r.body
+            assert b"mTLS" in r.body
+        finally:
+            srv.close()
+
+    def test_bad_cert_degrades_not_crashes(self, tmp_path):
+        """A presented-but-unparseable client cert (or a container
+        without `cryptography`) maps to a clean S3Error, never a 500:
+        NotImplemented when the wheel is absent, AccessDenied when the
+        DER is junk."""
+        import asyncio
+
+        from minio_tpu.server.s3errors import S3Error
+
+        srv = S3TestServer(str(tmp_path))
+        try:
+            class _FakeSSL:
+                def getpeercert(self, binary_form=True):
+                    return b"\x30\x03\x02\x01\x01"  # junk DER
+
+            class _FakeTransport:
+                def get_extra_info(self, key):
+                    return _FakeSSL() if key == "ssl_object" else None
+
+            class _FakeRequest:
+                transport = _FakeTransport()
+
+            with pytest.raises(S3Error) as ei:
+                asyncio.run(srv.server._sts_certificate(
+                    _FakeRequest(), 900, ""))
+            assert ei.value.code in ("NotImplemented", "AccessDenied")
+        finally:
+            srv.close()

@@ -156,3 +156,80 @@ class TestSiteReviewFixes:
         a.server.iam.remove_group_members("team", ["g1"])
         assert _wait(lambda: set(b.server.iam.groups.get(
             "team", {}).get("members", [])) == {"g2"})
+
+
+class TestSuppressionContextvar:
+    """ISSUE 14 satellite: propagation suppression rides a contextvar —
+    the threading.local it replaces was dropped on ctx_submit/executor
+    hops, so an apply whose api call fanned out through a pool thread
+    re-pushed to peers (a cross-site feedback loop)."""
+
+    def test_suppression_survives_executor_hop(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        from minio_tpu.services import site as site_mod
+        from minio_tpu.utils.deadline import ctx_submit
+
+        with ThreadPoolExecutor(1) as ex:
+            with site_mod._Suppressed():
+                assert site_mod.propagation_suppressed()
+                # the executor hop CARRIES the flag (ctx_submit copies
+                # the context, exactly like deadline.Budget/tracing)
+                assert ctx_submit(
+                    ex, site_mod.propagation_suppressed).result()
+            assert not site_mod.propagation_suppressed()
+            # and outside the scope the hop sees it cleared
+            assert not ctx_submit(
+                ex, site_mod.propagation_suppressed).result()
+
+    def test_suppression_nests(self):
+        from minio_tpu.services import site as site_mod
+
+        with site_mod._Suppressed():
+            with site_mod._Suppressed():
+                assert site_mod.propagation_suppressed()
+            # the old thread-local reset to False on ANY exit; the
+            # contextvar token restores the outer scope
+            assert site_mod.propagation_suppressed()
+        assert not site_mod.propagation_suppressed()
+
+    def test_apply_fanning_out_through_pool_does_not_repush(self, sites):
+        """End to end: an apply whose bucket-meta mutation hook fires
+        FROM an executor thread (the erasure layer's ctx_submit
+        fan-outs do exactly this) must stay suppressed — no broadcast
+        back to the peer, no cross-site loop.  With the old
+        threading.local flag the hop saw suppress=False and re-pushed."""
+        import types as _types
+        from concurrent.futures import ThreadPoolExecutor
+
+        from minio_tpu.utils.deadline import ctx_submit
+
+        a, _ = sites
+        site = a.server.site
+        time.sleep(0.3)  # let the join's initial-sync queue drain
+        orig = site.api.set_bucket_metadata
+
+        def fanned(bucket, meta):
+            orig(bucket, meta)
+            # the mutation hook (meta.changed -> _on_bucket_meta) fires
+            # on a pool thread carrying the copied context
+            with ThreadPoolExecutor(1) as ex:
+                ctx_submit(ex, site.meta.changed, bucket).result()
+
+        proxy = _types.SimpleNamespace()
+        for name in ("make_bucket", "delete_bucket", "bucket_exists",
+                     "get_bucket_metadata", "list_buckets"):
+            setattr(proxy, name, getattr(a.server.api, name))
+        proxy.set_bucket_metadata = fanned
+        site.api = proxy
+        try:
+            before = site.info()
+            site.apply({"kind": "bucket-meta", "bucket": "srfan",
+                        "meta": {"versioning": "Enabled"}})
+            time.sleep(0.5)
+            info = site.info()
+            # the apply must not have pushed/queued anything back
+            assert info["queued"] + info["pushed"] \
+                == before["queued"] + before["pushed"], (before, info)
+        finally:
+            site.api = a.server.api

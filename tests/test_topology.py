@@ -1,0 +1,443 @@
+"""Topology change under live traffic (ISSUE 14): multi-pool hash
+placement, online pool expansion, and the two-cluster chaos drill —
+kill the drain AND a site peer mid-flight, restart, prove convergence,
+read-your-writes through the hot tier, zero lost versions and
+byte-identity versus a never-drained control.
+
+The drain protocol itself is model-checked
+(analysis/concurrency/models/topology.py); this suite keeps the
+implementation honest against it.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from minio_tpu.erasure import pools as pools_mod
+from minio_tpu.erasure.sets import ErasureSets, ErasureServerPools
+from minio_tpu.services.decom import PoolDecommission, load_state
+from minio_tpu.storage.local import LocalStorage
+
+from .s3_harness import S3TestServer
+
+
+def _mk_pools(tmp_path, n_pools=2, prefix="p", quota=None):
+    pools = []
+    for p in range(n_pools):
+        pools.append(ErasureSets(
+            [LocalStorage(str(tmp_path / f"{prefix}{p}-d{i}"),
+                          quota=quota) for i in range(4)],
+            set_size=4, pool_index=p))
+    return ErasureServerPools(pools)
+
+
+# ------------------------------------------------------- hash placement
+class TestHashPlacement:
+    def test_read_order_probes_live_pools_first(self):
+        assert pools_mod.read_order(3, {0}) == [1, 2, 0]
+        assert pools_mod.read_order(3, set()) == [0, 1, 2]
+        assert pools_mod.read_order(2, {1}) == [0, 1]
+
+    def test_placement_deterministic_across_instances(self, tmp_path):
+        """Every node (and every restart) must route a new object to
+        the SAME pool — that is what makes 'suspended from placement'
+        enforceable without coordination."""
+        pools = _mk_pools(tmp_path)
+        picks1 = {f"obj-{i}": pools.pools.index(
+            pools._pool_for_new(f"obj-{i}", 100)) for i in range(24)}
+        # a fresh instance over the same drives agrees exactly
+        pools2 = _mk_pools(tmp_path)
+        picks2 = {o: pools2.pools.index(pools2._pool_for_new(o, 100))
+                  for o in picks1}
+        assert picks1 == picks2
+        # and the hash actually spreads (both pools get traffic)
+        assert set(picks1.values()) == {0, 1}
+
+    def test_suspended_pool_excluded_then_returns(self, tmp_path):
+        pools = _mk_pools(tmp_path)
+        pools.mark_draining(1, True)
+        assert all(pools.pools.index(
+            pools._pool_for_new(f"x-{i}", 10)) == 0 for i in range(12))
+        pools.mark_draining(1, False)
+        picks = {pools.pools.index(pools._pool_for_new(f"x-{i}", 10))
+                 for i in range(12)}
+        assert picks == {0, 1}
+
+    def test_space_mode_knob_restores_seed_placement(self, tmp_path,
+                                                     monkeypatch):
+        monkeypatch.setenv("MINIO_TPU_POOL_PLACEMENT", "space")
+        pools = _mk_pools(tmp_path)
+        pools.make_bucket("spb")
+        for i in range(8):
+            pools.put_object("spb", f"o{i}", io.BytesIO(b"s" * 500), 500)
+        # weighted-random still lands everything readably
+        for i in range(8):
+            _, stream = pools.get_object("spb", f"o{i}")
+            assert b"".join(stream) == b"s" * 500
+
+    def test_fresh_delete_marker_avoids_suspended_pool(self, tmp_path):
+        """A versioned DELETE of an object NO pool holds mints a fresh
+        marker — placement-routed, so it cannot land in a drained pool
+        and keep it non-empty forever."""
+        pools = _mk_pools(tmp_path)
+        pools.make_bucket("dmb")
+        pools.mark_draining(0, True)
+        res = pools.delete_object("dmb", "ghost", versioned=True)
+        assert res.delete_marker or res.version_id
+        assert not pools.pools[0].contains("dmb", "ghost")
+        assert pools.pools[1].contains("dmb", "ghost")
+
+    def test_write_routing_skips_suspended_pool(self, tmp_path):
+        """An overwrite PUT mid-drain lands on a live pool and wins the
+        read — the draining pool keeps only the stale copy for the
+        mover to drop."""
+        pools = _mk_pools(tmp_path)
+        pools.make_bucket("wrb")
+        pools.pools[0].put_object("wrb", "doc", io.BytesIO(b"OLD"), 3)
+        pools.mark_draining(0, True)
+        pools.put_object("wrb", "doc", io.BytesIO(b"NEWER"), 5)
+        assert "doc" in pools.pools[1].list_objects("wrb")
+        # reads probe live pools first: the overwrite wins
+        _, stream = pools.get_object("wrb", "doc")
+        assert b"".join(stream) == b"NEWER"
+
+
+# ---------------------------------------------------- online expansion
+class TestAddPool:
+    def test_add_pool_joins_live(self, tmp_path):
+        p0 = ErasureSets([LocalStorage(str(tmp_path / f"a-d{i}"))
+                          for i in range(4)], set_size=4)
+        pools = ErasureServerPools([p0])
+        pools.make_bucket("exp")
+        pools.set_bucket_metadata("exp", {"versioning": "Enabled"})
+        for i in range(6):
+            pools.put_object("exp", f"pre-{i}", io.BytesIO(b"p" * 800),
+                             800)
+        es = ErasureSets([LocalStorage(str(tmp_path / f"b-d{i}"))
+                          for i in range(4)], set_size=4, pool_index=1)
+        idx = pools.add_pool(es)
+        assert idx == 1
+        # the bucket namespace (and its metadata) reached the new pool
+        assert es.bucket_exists("exp")
+        assert es.get_bucket_metadata("exp").get("versioning") \
+            == "Enabled"
+        # placement routes new objects to BOTH pools now
+        for i in range(16):
+            pools.put_object("exp", f"post-{i}", io.BytesIO(b"q" * 100),
+                             100)
+        assert any(o.startswith("post-")
+                   for o in es.list_objects("exp"))
+        # everything stays readable
+        for i in range(6):
+            _, s = pools.get_object("exp", f"pre-{i}")
+            assert b"".join(s) == b"p" * 800
+
+    def test_admin_pools_add_endpoint(self, tmp_path):
+        srv = S3TestServer(str(tmp_path / "drives"))
+        try:
+            assert srv.request("PUT", "/addb").status == 200
+            for i in range(4):
+                srv.request("PUT", f"/addb/o{i}", data=b"x" * 2000)
+            paths = [str(tmp_path / f"newpool-d{i}") for i in range(4)]
+            r = srv.request("POST", "/minio/admin/v3/pools/add",
+                            data=json.dumps({"paths": paths}).encode())
+            assert r.status == 200, r.body
+            doc = json.loads(r.body)
+            assert doc["pool"] == 1
+            st = json.loads(srv.request(
+                "GET", "/minio/admin/v3/pools/status").body)
+            assert len(st["pools"]) == 2
+            assert st["pools"][1]["suspended"] == ""
+            # traffic flows to the expanded layout; old data served
+            for i in range(12):
+                assert srv.request("PUT", f"/addb/n{i}",
+                                   data=b"y" * 500).status == 200
+            for i in range(4):
+                assert srv.request("GET", f"/addb/o{i}").body \
+                    == b"x" * 2000
+            assert any(o.startswith("n")
+                       for o in srv.pools.pools[1].list_objects("addb"))
+            # the new pool's sets feed the bloom tracker choke point
+            assert all(getattr(es, "ns_updated", None) is not None
+                       for es in srv.pools.pools[1].sets) \
+                or srv.server.services is None
+            # malformed bodies are clean client errors
+            for bad in (b"{}", b'{"paths": []}', b'{"paths": "x"}',
+                        b'{"paths": ["/p"], "setSize": true}'):
+                assert srv.request("POST", "/minio/admin/v3/pools/add",
+                                   data=bad).status == 400
+        finally:
+            srv.close()
+
+
+# ----------------------------------------------- gate-off differential
+class TestDefaultOffDifferential:
+    def test_single_pool_no_decom_has_no_topology_metrics(self,
+                                                          tmp_path):
+        """The decom/rebalance-off path stays metrics-identical: a
+        single-pool server that never drained renders NO
+        minio_topology_* family."""
+        from minio_tpu.services import decom as decom_mod
+
+        snap = dict(decom_mod.stats)
+        zeroed = {k: 0 for k in decom_mod.stats}
+        decom_mod.stats.update(zeroed)
+        srv = S3TestServer(str(tmp_path))
+        try:
+            srv.request("PUT", "/plain")
+            srv.request("PUT", "/plain/o", data=b"z")
+            r = srv.request("GET", "/minio/v2/metrics/cluster")
+            assert r.status == 200
+            assert b"minio_topology_" not in r.body
+        finally:
+            srv.close()
+            decom_mod.stats.update(snap)
+
+    def test_multi_pool_renders_suspended_gauge(self, tmp_path):
+        pools = _mk_pools(tmp_path / "drives")
+        srv = S3TestServer(str(tmp_path / "drives"), pools=pools)
+        try:
+            r = srv.request("GET", "/minio/v2/metrics/cluster")
+            assert b'minio_topology_pool_suspended{pool="0"} 0' in r.body
+            assert b'minio_topology_pool_suspended{pool="1"} 0' in r.body
+        finally:
+            srv.close()
+
+
+# ------------------------------------------------------- the chaos drill
+@pytest.mark.serial
+class TestTopologyChaosDrill:
+    """The ISSUE 14 acceptance drill: live PUT/GET traffic against a
+    two-pool cluster while pool 0 decommissions; the drain is KILLED
+    mid-flight (no final save — simulated SIGKILL) and restarted; a
+    site peer is killed mid-resync and restarted at the same address.
+    Asserts: drain converges, zero lost versions, read-your-writes
+    through the hot tier, byte-identity versus a never-drained control,
+    and site convergence through the retried pushes."""
+
+    def test_kill_drain_and_site_peer_mid_flight(self, tmp_path,
+                                                 monkeypatch):
+        monkeypatch.setenv("MINIO_TPU_FSYNC", "0")
+        monkeypatch.setenv("MINIO_TPU_HOTCACHE_BYTES", str(64 << 20))
+        poolsA = _mk_pools(tmp_path / "a")
+        srv = S3TestServer(str(tmp_path / "a"), pools=poolsA)
+        peer = S3TestServer(str(tmp_path / "b"))
+        peer_port = peer.port
+        try:
+            assert srv.server.hotcache is not None, \
+                "drill requires the hot tier on"
+            r = srv.request(
+                "POST", "/minio/admin/v3/site-replication/add",
+                data=json.dumps({"peers": [{
+                    "name": "siteB",
+                    "endpoint": f"http://127.0.0.1:{peer_port}",
+                    "accessKey": peer.ak,
+                    "secretKey": peer.sk}]}).encode())
+            assert r.status == 200, r.body
+
+            # ---- seed: immutable keys (byte-exactness probes) ------
+            assert srv.request("PUT", "/topo").status == 200
+            seeded = {f"k{i:02d}": bytes([i]) * (6000 + 37 * i)
+                      for i in range(40)}
+            for k, v in seeded.items():
+                assert srv.request("PUT", f"/topo/{k}",
+                                   data=v).status == 200
+            n_src = len(poolsA.pools[0].list_objects("topo"))
+            assert n_src >= 6, f"hash sent only {n_src} to pool 0"
+
+            # ---- live traffic while the drain runs -----------------
+            stop = threading.Event()
+            mu = threading.Lock()
+            acked: dict[str, bytes] = {}
+            errors: list[str] = []
+
+            def writer():
+                i = 0
+                while not stop.is_set():
+                    k = f"hot{i % 6}"
+                    v = f"gen-{i}-".encode() * 40
+                    rr = srv.request("PUT", f"/topo/{k}", data=v)
+                    if rr.status == 200:
+                        with mu:
+                            acked[k] = v
+                    else:
+                        errors.append(f"PUT {k} -> {rr.status}")
+                    i += 1
+                    time.sleep(0.01)
+
+            def reader():
+                keys = list(seeded)
+                i = 0
+                while not stop.is_set():
+                    k = keys[i % len(keys)]
+                    rr = srv.request("GET", f"/topo/{k}")
+                    if rr.status != 200 or rr.body != seeded[k]:
+                        errors.append(
+                            f"GET {k} -> {rr.status} "
+                            f"len={len(rr.body)}")
+                    i += 1
+
+            threads = [threading.Thread(target=writer, daemon=True),
+                       threading.Thread(target=reader, daemon=True)]
+            for t in threads:
+                t.start()
+
+            # ---- drain pool 0, KILL it mid-flight ------------------
+            kill_at = max(3, n_src // 3)
+            job = PoolDecommission(poolsA, 0)
+            job.checkpoint_every = 2
+            job._crash_hook = lambda moved: moved >= kill_at
+            job.start()
+            job.wait(60)
+            assert not job._thread.is_alive()
+            st = load_state(poolsA.pools[0])
+            assert st["state"] == "draining", st  # crashed, not saved
+
+            # ---- kill the site peer, then resync against the corpse
+            peer.close()
+            out = srv.server.site.resync("siteB", tracker=None,
+                                         full=True)
+            assert out["queued"] > 0
+
+            # ---- restart the drain (process restart analogue) ------
+            job2 = PoolDecommission(poolsA, 0)
+            assert job2.state.get("cursor") or \
+                job2.state.get("done_buckets")
+            job2.start()
+
+            # ---- bring the peer back AT THE SAME ADDRESS -----------
+            time.sleep(0.4)
+            peer2 = S3TestServer(str(tmp_path / "b"), port=peer_port)
+            try:
+                job2.wait(120)
+                assert job2.state["state"] == "complete", job2.state
+                assert job2.state["failed_objects"] == 0
+
+                stop.set()
+                for t in threads:
+                    t.join(10)
+                assert not errors, errors[:5]
+
+                # ---- zero lost versions + byte identity ------------
+                with mu:
+                    final = dict(seeded, **acked)
+                for k, v in final.items():
+                    rr = srv.request("GET", f"/topo/{k}")
+                    assert rr.status == 200 and rr.body == v, k
+                    # read twice: the second serve exercises the hot
+                    # tier (read-your-writes after the drain's fenced
+                    # invalidations)
+                    rr2 = srv.request("GET", f"/topo/{k}")
+                    assert rr2.body == v, k
+                assert srv.server.hotcache.stats()["hits"] > 0
+                # the drained pool is EMPTY and out of placement
+                assert poolsA.pools[0].list_objects("topo") == []
+                assert 0 in poolsA._draining
+
+                # ---- byte identity vs a never-drained control ------
+                control = _mk_pools(tmp_path / "ctl", n_pools=1,
+                                    prefix="c")
+                control.make_bucket("topo")
+                for k, v in final.items():
+                    control.put_object("topo", k, io.BytesIO(v),
+                                       len(v))
+                for k in final:
+                    _, s = control.get_object("topo", k)
+                    assert b"".join(s) == \
+                        srv.request("GET", f"/topo/{k}").body, k
+
+                # ---- site peer converged through retried pushes ----
+                deadline = time.time() + 30
+                while time.time() < deadline:
+                    if peer2.request("HEAD", "/topo").status == 200 \
+                            and srv.server.site.info()["queued"] == 0:
+                        break
+                    time.sleep(0.2)
+                assert peer2.request("HEAD", "/topo").status == 200
+                info = srv.server.site.info()
+                assert info["queued"] == 0, info
+                assert info["resyncs"] >= 1
+
+                # ---- the topology metrics observed all of it -------
+                m = srv.request("GET",
+                                "/minio/v2/metrics/cluster").body
+                assert b"minio_topology_drained_objects_total" in m
+                assert b'minio_topology_pool_suspended{pool="0"} 1' \
+                    in m
+            finally:
+                peer2.close()
+        finally:
+            try:
+                srv.close()
+            finally:
+                pass
+
+
+class TestReviewRegressions:
+    """Fixes from the ISSUE 14 review rounds, each pinned."""
+
+    def test_cancel_reconciles_stale_copies(self, tmp_path):
+        """Cancel after a mid-drain overwrite: the canceled pool
+        rejoins read order, so its stale null version would shadow the
+        newer live-pool copy forever — cancel() reconciles (drops
+        every local copy another pool holds same-or-newer) first."""
+        pools = _mk_pools(tmp_path)
+        pools.make_bucket("cnb")
+        pools.pools[0].put_object("cnb", "doc", io.BytesIO(b"OLD" * 400),
+                                  1200)
+        job = PoolDecommission(pools, 0)
+        # suspend + overwrite before any move happens (hold the drain)
+        pools.mark_draining(0, True)
+        pools.put_object("cnb", "doc", io.BytesIO(b"NEW" * 500), 1500)
+        assert "doc" in pools.pools[1].list_objects("cnb")
+        job.cancel()
+        assert 0 not in pools._draining
+        # back in index-ordered read probing, the overwrite still wins:
+        # the stale pool-0 copy is gone
+        _, s = pools.get_object("cnb", "doc")
+        assert b"".join(s) == b"NEW" * 500
+        assert pools.pools[0].list_objects("cnb") == []
+
+    def test_versioned_delete_mid_drain_converges_via_sweep(
+            self, tmp_path):
+        """A versioned DELETE mid-drain lands its marker WITH the
+        versions it shadows (a cross-pool split would let the read
+        fan-out skip the marker and serve the undeleted versions); a
+        marker landing behind the cursor is an entry the drain's
+        verification sweep re-lists and moves — the DELETE survives
+        the drain."""
+        from minio_tpu.erasure.objects import PutObjectOptions
+        from minio_tpu.storage import errors as st_errors
+
+        pools = _mk_pools(tmp_path)
+        pools.make_bucket("vdb")
+        data_oi = pools.pools[0].put_object(
+            "vdb", "doc", io.BytesIO(b"v" * 900), 900,
+            PutObjectOptions(versioned=True))
+        pools.mark_draining(0, True)
+        res = pools.delete_object("vdb", "doc", versioned=True)
+        assert res.delete_marker
+        # the marker shadows its versions in the SAME pool: the object
+        # reads as deleted immediately
+        assert pools.pools[0].contains("vdb", "doc")
+        with pytest.raises(st_errors.StorageError):
+            pools.get_object("vdb", "doc")
+        # the drain moves versions AND marker; deletion survives
+        pools.mark_draining(0, False)
+        job = PoolDecommission(pools, 0)
+        job.start()
+        job.wait(30)
+        assert job.state["state"] == "complete", job.state
+        assert pools.pools[0].list_objects("vdb") == []
+        with pytest.raises(st_errors.StorageError):
+            pools.get_object("vdb", "doc")
+        # the shadowed version is still reachable by id from the dest
+        _, s = pools.get_object("vdb", "doc",
+                                version_id=data_oi.version_id)
+        assert b"".join(s) == b"v" * 900
