@@ -33,6 +33,21 @@ from .s3errors import S3Error
 ADMIN_PREFIX = "/minio/admin/v3"
 
 
+def _finite_float(raw: str, name: str) -> float:
+    """Parse a float query param, 400ing non-numbers AND non-finite
+    values (``float('nan')`` parses happily but poisons downstream
+    slot/clamp arithmetic — the QoS-admin NaN-proofing rule).  Range
+    policy stays at the call site."""
+    try:
+        v = float(raw)
+    except ValueError:
+        v = float("nan")
+    if not math.isfinite(v):
+        raise S3Error("InvalidArgument",
+                      f"{name} must be a finite number")
+    return v
+
+
 class AdminMixin:
     """Admin handlers; expects self.api, self.iam, self.services,
     self.locker, self.executor from S3Server."""
@@ -117,6 +132,14 @@ class AdminMixin:
         # (utils/tracing.py, ISSUE 12)
         r.add_get(f"{p}/trace/slow",
                   wrap(self.admin_trace_slow, "ServerTrace"))
+        # aggregate per-stage timing over the retained trace store —
+        # the simulator's (and a human's) "WHICH stage ate the p99"
+        # answer without re-deriving timings by hand (ISSUE 15)
+        r.add_get(f"{p}/trace/summary",
+                  wrap(self.admin_trace_summary, "ServerTrace"))
+        # live SLO objective status: per-class availability/latency vs
+        # declarative objectives + error-budget burn (server/slo.py)
+        r.add_get(f"{p}/slo", wrap(self.admin_slo, "ServerInfo"))
         r.add_get(f"{p}/log", wrap(self.admin_console_log, "ConsoleLog"))
         # on-demand cluster profiling (reference StartProfiling /
         # DownloadProfileData, cmd/peer-rest-client.go:469-490)
@@ -124,6 +147,11 @@ class AdminMixin:
                    wrap(self.admin_profiling_start, "Profiling"))
         r.add_post(f"{p}/profiling/stop",
                    wrap(self.admin_profiling_stop, "Profiling"))
+        # one-shot capture: start, sample for ?seconds=N, return the
+        # collapsed-stack report in the same response (ISSUE 15 — the
+        # two-call start/stop dance is for cluster-wide zips)
+        r.add_post(f"{p}/profile",
+                   wrap(self.admin_profile, "Profiling"))
         # speedtests (reference drive/object perf probes,
         # cmd/peer-rest-client.go:128 dperf + SpeedtestHandler)
         # write-heavy probes get their own action, NOT the read-only
@@ -167,19 +195,43 @@ class AdminMixin:
         r.add_put(f"{p}/qos", wrap(self.admin_qos_set, "ConfigUpdate"))
 
     # ---------------------------------------------------------------- auth
+    #: admin ops whose duration is the CLIENT's choice (live follows,
+    #: deliberate capture sleeps, measured probes) — recording them
+    #: would poison the ADMIN latency objective with by-design walls
+    _SLO_EXEMPT_OPS = frozenset(
+        ("ServerTrace", "ConsoleLog", "Profiling", "SpeedTest"))
+
     def _admin_wrap(self, fn, op: str):
         async def handler(request: web.Request) -> web.StreamResponse:
+            t0 = time.monotonic()
+            status = 500
             try:
                 body = await request.read()
                 await self._admin_auth(request, body, op)
-                return await fn(request, body)
+                resp = await fn(request, body)
+                status = resp.status
+                return resp
+            except asyncio.CancelledError:
+                # client went away: same 499 carve-out as _handle —
+                # neither a success nor server budget spend
+                status = 499
+                raise
             except S3Error as e:
+                status = e.status
                 return web.Response(
                     status=e.status,
                     body=json.dumps({"Code": e.code,
                                      "Message": e.message}).encode(),
                     content_type="application/json",
                 )
+            finally:
+                # admin ops bypass _handle's funnel, so the SLO plane's
+                # ADMIN class records here (server/slo.py, ISSUE 15);
+                # slo.record itself skips 499
+                slo = getattr(self, "slo", None)
+                if slo is not None and op not in self._SLO_EXEMPT_OPS:
+                    slo.record(f"admin_{op}", status,
+                               time.monotonic() - t0)
         return handler
 
     # ----------------------------------------------------- site replication
@@ -754,6 +806,65 @@ class AdminMixin:
             "traces": [tracing.span_tree(d) for d in docs],
         })
 
+    async def admin_trace_summary(self, request: web.Request,
+                                  body: bytes) -> web.Response:
+        """Per-stage latency aggregates over the retained trace store:
+        span-name p50/p99/count/total plus the stagestats fold totals.
+        ``?n=`` bounds how many retained traces feed the aggregate
+        (default: all); ``?since=<epoch-seconds>`` restricts to traces
+        that STARTED at/after the instant (the simulator scopes a
+        violation's attribution to its own scenario this way — the
+        store spans the server's whole life).  This is the forensics
+        surface the simulator (and a human chasing a p99) reads
+        instead of re-deriving stage timings from counters."""
+        from minio_tpu.utils import tracing
+
+        q = request.rel_url.query
+        try:
+            n = max(1, min(10000, int(q.get("n", "10000") or "10000")))
+        except ValueError:
+            n = 10000
+        since = 0.0
+        raw = q.get("since", "")
+        if raw:
+            since = _finite_float(raw, "since")
+            if since < 0:
+                raise S3Error("InvalidArgument",
+                              "since must be a non-negative epoch "
+                              "seconds value")
+        docs = tracing.store.snapshot(n=n)
+        if since:
+            docs = [d for d in docs if d.get("start", 0.0) >= since]
+        out = tracing.summarize_stages(docs)
+        out["enabled"] = tracing.enabled()
+        out["store"] = tracing.store.stats()
+        return web.json_response(out)
+
+    async def admin_slo(self, request: web.Request,
+                        body: bytes) -> web.Response:
+        """Live SLO status (server/slo.py): per-class objective
+        attainment, windowed p50/p99/availability and multi-window
+        error-budget burn; per-tenant splits when the QoS plane is
+        feeding tenant labels.  ``?window=<seconds>`` scopes the
+        measured section (the simulator passes its scenario duration).
+        With the plane off (MINIO_TPU_SLO unset) answers
+        ``{"enabled": false}`` — the S3 and metrics surfaces stay
+        byte-identical; only this new endpoint admits the gate state."""
+        plane = getattr(self, "slo", None)
+        if plane is None:
+            return web.json_response({"enabled": False})
+        q = request.rel_url.query
+        window = None
+        raw = q.get("window", "")
+        if raw:
+            window = _finite_float(raw, "window")
+            if window <= 0:
+                raise S3Error("InvalidArgument",
+                              "window must be a positive number of "
+                              "seconds")
+        doc = await self._run(plane.status, window, True)
+        return web.json_response(doc)
+
     async def admin_console_log(self, request: web.Request,
                                 body: bytes) -> web.StreamResponse:
         """Recent console-log ring + live follow (reference
@@ -803,6 +914,36 @@ class AdminMixin:
 
             p = self._profiler_inst = Sampler()
         return p
+
+    async def admin_profile(self, request: web.Request, body: bytes):
+        """One-shot sampled-stack capture: start the sampler, wait
+        ``?seconds=N`` (default 5, clamped 0.1..60), stop, and return
+        the collapsed-stack report directly (reference's admin
+        profiling, minus the second round trip).  409 while a
+        start/stop-managed capture is already running — a one-shot must
+        not steal its samples."""
+        seconds = min(60.0, max(0.1, _finite_float(
+            request.rel_url.query.get("seconds", "5"), "seconds")))
+        sampler = self._profiler()
+        ok = await self._run(sampler.start)
+        if not ok:
+            return web.json_response(
+                {"error": "a profiling capture is already running"},
+                status=409)
+        try:
+            await asyncio.sleep(seconds)
+        except BaseException:
+            # client went away (or shutdown) mid-capture: stop the
+            # sampler so the thread doesn't sample forever and future
+            # captures aren't 409-blocked; the report is discarded.
+            # Off-loop because stop() joins the sampler thread.
+            # lint: allow(budget-propagation): cancellation cleanup must outlive the dead request
+            self.executor.submit(sampler.stop)
+            raise
+        blob = await self._run(sampler.stop)
+        return web.Response(body=blob, content_type="text/plain",
+                            headers={"X-Minio-Profile-Seconds":
+                                     f"{seconds:g}"})
 
     async def admin_profiling_start(self, request: web.Request, body: bytes):
         """Start the sampling profiler on this node and (unless

@@ -381,6 +381,14 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         # reference plane (pinned by tests/test_qos.py).
         self.qos = QosPlane.from_config(self.config, max_concurrency)
         self.config.on_change("qos", self._apply_qos_config)
+        # closed-loop SLO plane (ISSUE 15, server/slo.py): per-class
+        # latency/outcome accounting against declarative objectives
+        # with multi-window error-budget burn rates.  Default OFF:
+        # self.slo stays None and the server is byte- and metrics-
+        # identical to before (pinned by tests/test_slo.py).
+        from .slo import SloPlane
+
+        self.slo = SloPlane.from_env()
         # Dedicated pool sized to the request semaphore so a full house of
         # blocking object-layer calls can never starve body-feed tasks
         # (reference analogue: maxClients semaphore, cmd/handler-api.go:108).
@@ -1171,6 +1179,11 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             self._m_inflight.dec()
             self.record_api(api, status, dt,
                             rx=request.content_length or 0, tx=tx)
+            slo = self.slo
+            if slo is not None:
+                # outcome vs the class objective; the tenant label (QoS
+                # on) buys the per-tenant split in /minio/admin/v3/slo
+                slo.record(api, status, dt, tenant=tenant)
             if root is not None:
                 # tail capture: 5xx (incl. the 503 shed) and anything
                 # past the slow threshold is retained; the rest lives

@@ -422,6 +422,74 @@ class MetricsMixin:
                         f"{ts[field]}")
             g("\n".join(rows) + "\n")
 
+        # closed-loop SLO plane (server/slo.py, ISSUE 15): per-class
+        # latency histograms over the slow window, objective-attainment
+        # ratios (>= 1.0 means the objective is met) and multi-window
+        # error-budget burn rates.  Rendered only while the plane is on
+        # (MINIO_TPU_SLO), so the default server stays metrics-
+        # identical to before.
+        slo = getattr(self, "slo", None)
+        # presence-guarded like the other conditional families: a
+        # gate-on server that has recorded nothing emits none of them
+        if slo is not None and (snap := slo.snapshot_for_metrics()):
+            lat = ["# HELP minio_slo_latency_bucket Request latency "
+                   "per SLO API class over the slow window "
+                   "(cumulative, seconds)",
+                   "# TYPE minio_slo_latency_bucket gauge"]
+            for cls, d in snap.items():
+                for le, cum in d["buckets"]:
+                    lbl = _fmt_labels(("class", "le"), (cls, str(le)))
+                    lat.append(f"minio_slo_latency_bucket{lbl} {cum}")
+                lbl = _fmt_labels(("class", "le"), (cls, "+Inf"))
+                lat.append(f"minio_slo_latency_bucket{lbl} "
+                           f"{d['count']}")
+            g("\n".join(lat) + "\n")
+            rows = ["# HELP minio_slo_requests_count Requests recorded "
+                    "per SLO API class over the slow window",
+                    "# TYPE minio_slo_requests_count gauge"]
+            srows = ["# HELP minio_slo_latency_sum_seconds Summed "
+                     "request latency per SLO API class over the slow "
+                     "window",
+                     "# TYPE minio_slo_latency_sum_seconds gauge"]
+            for cls, d in snap.items():
+                lbl = _fmt_labels(("class",), (cls,))
+                rows.append(f"minio_slo_requests_count{lbl} "
+                            f"{d['count']}")
+                srows.append(f"minio_slo_latency_sum_seconds{lbl} "
+                             f"{d['sum']}")
+            g("\n".join(rows) + "\n")
+            g("\n".join(srows) + "\n")
+            rows = ["# HELP minio_slo_objective_ratio Measured-vs-"
+                    "objective attainment per class and objective "
+                    "(>= 1.0 = meeting it)",
+                    "# TYPE minio_slo_objective_ratio gauge"]
+            any_ratio = False
+            for cls, d in snap.items():
+                for objective, ratio in sorted(d["ratios"].items()):
+                    lbl = _fmt_labels(("class", "objective"),
+                                      (cls, objective))
+                    rows.append(
+                        f"minio_slo_objective_ratio{lbl} {ratio}")
+                    any_ratio = True
+            if any_ratio:
+                g("\n".join(rows) + "\n")
+            rows = ["# HELP minio_slo_error_budget_burn Error-budget "
+                    "burn rate per class and window (1.0 = spending "
+                    "exactly the budget)",
+                    "# TYPE minio_slo_error_budget_burn gauge"]
+            any_burn = False
+            for cls, d in snap.items():
+                for win in ("fast", "slow"):
+                    burn = d["burn"][win]
+                    if burn is None:
+                        continue
+                    lbl = _fmt_labels(("class", "window"), (cls, win))
+                    rows.append(
+                        f"minio_slo_error_budget_burn{lbl} {burn}")
+                    any_burn = True
+            if any_burn:
+                g("\n".join(rows) + "\n")
+
         # topology plane (ISSUE 14): pool drain/rebalance volume and
         # retry/fail classification plus site-resync push economics —
         # the drain-induced-load forensics surface next to the
@@ -687,13 +755,32 @@ class MetricsMixin:
                   "Scanned object count", usage.total_objects())
             gauge("minio_cluster_bucket_total", "Buckets with usage data",
                   len(usage.buckets))
-            bu = ["# HELP minio_bucket_usage_total_bytes Bucket byte usage",
-                  "# TYPE minio_bucket_usage_total_bytes gauge"]
-            for b, u in sorted(usage.buckets.items()):
-                lbl = _fmt_labels(("bucket",), (b,))
-                bu.append(f"minio_bucket_usage_total_bytes{lbl} {u.size}")
-            if len(bu) > 2:
-                g("\n".join(bu) + "\n")
+            # scanner data-usage detail per bucket (ISSUE 15 satellite;
+            # reference cluster usage metrics): objects/bytes/versions/
+            # delete-markers from the usage tree the scanner maintains
+            # (services/usage_tree.py).  Presence-guarded: an idle
+            # server with no scanned buckets emits none of these and
+            # stays metrics-identical.  minio_usage_bytes supersedes
+            # the old minio_bucket_usage_total_bytes (same label, same
+            # value — one family, not two names that can drift).
+            if usage.buckets:
+                for name, help_, attr in (
+                        ("minio_usage_objects",
+                         "Scanned objects per bucket", "objects"),
+                        ("minio_usage_bytes",
+                         "Scanned logical bytes per bucket", "size"),
+                        ("minio_usage_versions",
+                         "Scanned object versions per bucket",
+                         "versions"),
+                        ("minio_usage_delete_markers",
+                         "Scanned delete markers per bucket",
+                         "delete_markers")):
+                    rows = [f"# HELP {name} {help_}",
+                            f"# TYPE {name} gauge"]
+                    for b, u in sorted(usage.buckets.items()):
+                        lbl = _fmt_labels(("bucket",), (b,))
+                        rows.append(f"{name}{lbl} {getattr(u, attr)}")
+                    g("\n".join(rows) + "\n")
             # heal/MRF (reference HealObjects group)
             ms = svcs.mrf.stats
             gauge("minio_heal_objects_healed_total",

@@ -1,0 +1,469 @@
+"""Closed-loop SLO plane (ISSUE 15 tentpole, half 1).
+
+Every request is classified into an API class (GET/PUT/LIST/DELETE/
+MULTIPART/ADMIN/OTHER) and recorded — latency + outcome — against a
+declarative objective such as ``GET p99 < 250ms, availability 99.9%``.
+Recording goes into ring-buffer histograms (fixed log-spaced latency
+buckets per wall-clock slot) so the plane can answer *windowed*
+questions cheaply and without unbounded memory:
+
+* point-in-time status per class (``GET /minio/admin/v3/slo``):
+  requests, errors, availability, p50/p99 over a caller-chosen window —
+  the traffic simulator asserts its per-scenario SLOs through exactly
+  this endpoint (closing the loop: the server's own accounting is the
+  verdict source, not a client-side stopwatch);
+* multi-window error-budget burn rates, Google-SRE style: the *fast*
+  window (default 5m) catches a sudden cliff, the *slow* window
+  (default 1h) catches a slow bleed.  ``burn = error_rate /
+  (1 - availability_target)`` — 1.0 means the budget is being spent
+  exactly as fast as it accrues, 14.4 is the classic page-now rate.
+
+Per-tenant splits ride the same rings keyed by the QoS plane's tenant
+label when ``MINIO_TPU_QOS`` is on (bounded cardinality: beyond
+``MAX_TENANTS`` distinct tenants fold into ``~other``).
+
+Gated by ``MINIO_TPU_SLO`` (default off).  Off means ``S3Server.slo``
+is None: no recording, no ``minio_slo_*`` metrics families, no admin
+status — byte- and metrics-identical to the pre-SLO server (pinned by
+tests/test_slo.py's gate-off differential).
+
+Objective grammar (``MINIO_TPU_SLO_OBJECTIVES``, JSON merged over the
+defaults)::
+
+    {"GET": {"p99_ms": 250, "availability": 0.999},
+     "PUT": {"p99_ms": 1500}}
+
+Knobs: ``MINIO_TPU_SLO_SLOT_S`` (ring slot width, default 5s — the
+simulator runs 1s slots so scenario windows are sharp),
+``MINIO_TPU_SLO_FAST_S`` / ``MINIO_TPU_SLO_SLOW_S`` (burn windows).
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import os
+import threading
+import time
+
+_TRUTHY = ("1", "on", "true", "yes")
+
+#: latency histogram bounds (seconds) — the server-side API_BUCKETS
+#: shape with a 10ms point added: SLO latency targets live in the
+#: 50ms..2.5s band and need resolution there, not above 30s
+LAT_BUCKETS = (.005, .01, .025, .05, .1, .25, .5, 1.0, 2.5, 5.0, 10.0,
+               30.0)
+
+API_CLASSES = ("GET", "PUT", "LIST", "DELETE", "MULTIPART", "ADMIN",
+               "OTHER")
+
+#: distinct tenant labels tracked before folding into "~other" — the
+#: ring memory is bounded by traffic recency, but the KEY space must be
+#: bounded too (a curl loop over random bucket names is a tenant-minting
+#: loop under bucket auto-tenancy)
+MAX_TENANTS = 32
+
+#: the multipart handler family (app.py fn names) — matched before the
+#: prefix rules so e.g. list_parts lands here, not in LIST
+_MULTIPART_APIS = frozenset((
+    "create_upload", "upload_part", "complete_upload", "abort_upload",
+    "list_parts", "list_uploads", "post_policy_upload",
+))
+
+_DELETE_PREFIXES = ("delete_", "remove_")
+_GET_PREFIXES = ("get_", "head_", "select_", "stat_")
+_PUT_PREFIXES = ("put_", "copy_", "make_", "set_", "append_", "post_")
+
+
+def classify(api: str) -> str:
+    """Map a handler name (``fn.__name__`` — the same label
+    ``record_api`` uses) onto its SLO class."""
+    got = _classify_cache.get(api)
+    if got is not None:
+        return got
+    if api in _MULTIPART_APIS or "multipart" in api:
+        cls = "MULTIPART"
+    elif api.startswith("admin_") or api == "sts_handler":
+        cls = "ADMIN"
+    elif api.startswith("list_"):
+        cls = "LIST"
+    elif api.startswith(_DELETE_PREFIXES):
+        cls = "DELETE"
+    elif api.startswith(_GET_PREFIXES):
+        cls = "GET"
+    elif api.startswith(_PUT_PREFIXES):
+        cls = "PUT"
+    else:
+        cls = "OTHER"
+    if len(_classify_cache) < 4096:  # handler names are finite; belt
+        _classify_cache[api] = cls
+    return cls
+
+
+_classify_cache: dict[str, str] = {}
+
+
+#: objective defaults per class; availability counts 5xx (incl. the
+#: 503 shed) as budget spend, 4xx as client outcomes
+DEFAULT_OBJECTIVES: dict[str, dict] = {
+    "GET": {"p99_ms": 250.0, "availability": 0.999},
+    "PUT": {"p99_ms": 1500.0, "availability": 0.999},
+    "LIST": {"p99_ms": 500.0, "availability": 0.999},
+    "DELETE": {"p99_ms": 500.0, "availability": 0.999},
+    "MULTIPART": {"p99_ms": 2500.0, "availability": 0.999},
+    "ADMIN": {"p99_ms": 2000.0, "availability": 0.99},
+    "OTHER": {"availability": 0.999},
+}
+
+
+def parse_objectives(raw: str | None) -> dict[str, dict]:
+    """Defaults overlaid with the MINIO_TPU_SLO_OBJECTIVES JSON; a
+    malformed value degrades to the defaults (a typo'd knob must not
+    fail server boot — the from_env convention across the repo)."""
+    out = {cls: dict(obj) for cls, obj in DEFAULT_OBJECTIVES.items()}
+    if not raw:
+        return out
+    try:
+        doc = json.loads(raw)
+        if not isinstance(doc, dict):
+            raise ValueError("not an object")
+        for cls, obj in doc.items():
+            cls = str(cls).upper()
+            if cls not in API_CLASSES or not isinstance(obj, dict):
+                continue
+            tgt = out.setdefault(cls, {})
+            # bool is an int subclass (float(True) == 1.0 — a typo'd
+            # `true` would install a 1ms objective) and NaN fails the
+            # self-compare: both degrade to the default, QoS-admin style
+            if "p99_ms" in obj and not isinstance(obj["p99_ms"], bool):
+                v = float(obj["p99_ms"])
+                if v == v and 0 < v:
+                    tgt["p99_ms"] = v
+            if "availability" in obj \
+                    and not isinstance(obj["availability"], bool):
+                v = float(obj["availability"])
+                if v == v and 0.0 < v < 1.0:
+                    tgt["availability"] = v
+    except (ValueError, TypeError):
+        return {cls: dict(obj) for cls, obj in DEFAULT_OBJECTIVES.items()}
+    return out
+
+
+class _Ring:
+    """Per-slot latency histogram ring: one (counts, total, errors,
+    dur_sum) record per ``slot_s`` wall-clock slot, pruned past the
+    slow window.  Slots are allocated lazily (an idle class costs one
+    dict entry per active slot, not a preallocated hour)."""
+
+    __slots__ = ("slot_s", "max_slots", "slots")
+
+    def __init__(self, slot_s: float, max_window_s: float):
+        self.slot_s = slot_s
+        self.max_slots = max(2, int(max_window_s / slot_s) + 2)
+        # slot index -> [total, errors, dur_sum, counts-list]
+        self.slots: dict[int, list] = {}
+
+    def record(self, now: float, dt: float, err: bool) -> None:
+        idx = int(now / self.slot_s)
+        slot = self.slots.get(idx)
+        if slot is None:
+            slot = self.slots[idx] = [
+                0, 0, 0.0, [0] * (len(LAT_BUCKETS) + 1)]
+            if len(self.slots) > self.max_slots:
+                floor = idx - self.max_slots
+                for k in [k for k in self.slots if k < floor]:
+                    del self.slots[k]
+        slot[0] += 1
+        if err:
+            slot[1] += 1
+        slot[2] += dt
+        slot[3][bisect.bisect_left(LAT_BUCKETS, dt)] += 1
+
+    def snapshot(self) -> list:
+        """Slot-reference snapshot for aggregation OUTSIDE the plane
+        lock (the repo's sanctioned advisory-read idiom: a scrape must
+        not make the event-loop record() wait out a full Python scan).
+        Slots mutate in place, so a concurrent record may or may not
+        land in the aggregate — monitoring-grade inconsistency, never
+        a torn structure."""
+        return list(self.slots.items())
+
+
+def _agg_windows(slot_items: list, slot_s: float, now: float, windows
+                 ) -> list[tuple[int, int, float, list[int]]]:
+    """Aggregate several trailing windows in ONE pass over a slot
+    snapshot.  Latency bucket counts are accumulated only for
+    ``windows[0]`` (the measured window); the burn/budget windows need
+    totals alone and get an empty counts list."""
+    floors = [int((now - w) / slot_s) for w in windows]
+    counts = [0] * (len(LAT_BUCKETS) + 1)
+    acc = [[0, 0, 0.0] for _ in windows]
+    for idx, slot in slot_items:
+        for j, floor in enumerate(floors):
+            if idx < floor:
+                continue
+            a = acc[j]
+            a[0] += slot[0]
+            a[1] += slot[1]
+            a[2] += slot[2]
+            if j == 0:
+                sc = slot[3]
+                for i in range(len(counts)):
+                    counts[i] += sc[i]
+    return [(a[0], a[1], a[2], counts if j == 0 else [])
+            for j, a in enumerate(acc)]
+
+
+def percentile(counts: list[int], q: float) -> float | None:
+    """Histogram quantile: linear interpolation inside the winning
+    bucket (prometheus ``histogram_quantile`` semantics); the overflow
+    bucket answers with the last finite bound — an honest floor, not a
+    made-up number."""
+    total = sum(counts)
+    if total == 0:
+        return None
+    rank = q * total
+    cum = 0.0
+    lo = 0.0
+    for i, c in enumerate(counts):
+        if i >= len(LAT_BUCKETS):
+            return LAT_BUCKETS[-1]
+        hi = LAT_BUCKETS[i]
+        if cum + c >= rank and c > 0:
+            return lo + (hi - lo) * ((rank - cum) / c)
+        cum += c
+        lo = hi
+    return LAT_BUCKETS[-1]
+
+
+class SloPlane:
+    """Per-class (and per-tenant) windowed latency/outcome accounting
+    against declarative objectives.  One lock guards ring MUTATION —
+    record() is one acquisition per *finished request*, far off any
+    byte-moving hot path; the read side (status/metrics) snapshots
+    slot references under the lock and aggregates outside it (the
+    repo's advisory-read idiom), so an admin poll or scrape never
+    makes the event loop wait out a full Python scan."""
+
+    def __init__(self, objectives: dict[str, dict] | None = None,
+                 slot_s: float = 5.0, fast_s: float = 300.0,
+                 slow_s: float = 3600.0, max_tenants: int = MAX_TENANTS,
+                 now=time.time):
+        self.objectives = objectives or {
+            cls: dict(obj) for cls, obj in DEFAULT_OBJECTIVES.items()}
+        self.slot_s = float(slot_s)
+        self.fast_s = float(fast_s)
+        self.slow_s = max(float(slow_s), float(fast_s))
+        self.max_tenants = max_tenants
+        self._now = now
+        self._mu = threading.Lock()
+        self._cls: dict[str, _Ring] = {}
+        self._tenant: dict[tuple[str, str], _Ring] = {}
+        self._tenant_names: set[str] = set()
+        self.recorded = 0
+
+    # ------------------------------------------------------------- gate
+    @classmethod
+    def from_env(cls) -> "SloPlane | None":
+        if os.environ.get("MINIO_TPU_SLO", "0").lower() not in _TRUTHY:
+            return None
+
+        def _f(name: str, default: float, lo: float, hi: float) -> float:
+            try:
+                return min(hi, max(lo, float(
+                    os.environ.get(name, str(default)))))
+            except ValueError:
+                return default
+
+        return cls(
+            objectives=parse_objectives(
+                os.environ.get("MINIO_TPU_SLO_OBJECTIVES")),
+            slot_s=_f("MINIO_TPU_SLO_SLOT_S", 5.0, 0.1, 600.0),
+            fast_s=_f("MINIO_TPU_SLO_FAST_S", 300.0, 1.0, 86400.0),
+            slow_s=_f("MINIO_TPU_SLO_SLOW_S", 3600.0, 1.0, 7 * 86400.0),
+        )
+
+    # -------------------------------------------------------- recording
+    def record(self, api: str, status: int, dt: float,
+               tenant: str | None = None) -> None:
+        """One finished request.  499 (client went away) is skipped
+        entirely: neither a success nor server budget spend."""
+        if status == 499:
+            return
+        cls = classify(api)
+        err = status >= 500
+        now = self._now()
+        with self._mu:
+            ring = self._cls.get(cls)
+            if ring is None:
+                ring = self._cls[cls] = _Ring(self.slot_s, self.slow_s)
+            ring.record(now, dt, err)
+            self.recorded += 1
+            if tenant is not None:
+                if tenant not in self._tenant_names:
+                    if len(self._tenant_names) >= self.max_tenants:
+                        tenant = "~other"
+                    self._tenant_names.add(tenant)
+                key = (tenant, cls)
+                tring = self._tenant.get(key)
+                if tring is None:
+                    tring = self._tenant[key] = _Ring(
+                        self.slot_s, self.slow_s)
+                tring.record(now, dt, err)
+
+    # ---------------------------------------------------------- queries
+    @staticmethod
+    def _burn_of(total: int, errors: int,
+                 target: float | None) -> float | None:
+        if target is None:
+            return None
+        if total == 0:
+            return 0.0
+        budget = 1.0 - target
+        if budget <= 0:
+            return None
+        return (errors / total) / budget
+
+    def _class_status(self, cls: str, slot_items: list, now: float,
+                      window_s: float) -> dict:
+        obj = self.objectives.get(cls, {})
+        target_avail = obj.get("availability")
+        target_p99 = obj.get("p99_ms")
+        # one scan answers the measured window, both burn windows and
+        # the slow-window budget (see _agg_windows)
+        ((total, errors, dur_sum, counts),
+         (f_total, f_errors, _, _),
+         (s_total, s_errors, _, _)) = _agg_windows(
+            slot_items, self.slot_s, now,
+            (window_s, self.fast_s, self.slow_s))
+        avail = (total - errors) / total if total else None
+        p50 = percentile(counts, 0.50)
+        p99 = percentile(counts, 0.99)
+        violations = []
+        if total:
+            if target_avail is not None and avail < target_avail:
+                violations.append("availability")
+            if target_p99 is not None and p99 is not None \
+                    and p99 * 1000.0 > target_p99:
+                violations.append("latency")
+        # budget accounting over the SLOW window regardless of the
+        # status window: "how much of this hour's budget is left"
+        budget_total = (1.0 - target_avail) * s_total \
+            if target_avail is not None else None
+        out = {
+            "objective": {
+                "p99Ms": target_p99, "availability": target_avail},
+            "window": {
+                "seconds": window_s,
+                "requests": total,
+                "errors": errors,
+                "availability": round(avail, 6)
+                if avail is not None else None,
+                "p50Ms": round(p50 * 1e3, 3) if p50 is not None else None,
+                "p99Ms": round(p99 * 1e3, 3) if p99 is not None else None,
+                "meanMs": round(dur_sum / total * 1e3, 3)
+                if total else None,
+            },
+            "burn": {
+                "fast": _round(self._burn_of(f_total, f_errors,
+                                             target_avail)),
+                "slow": _round(self._burn_of(s_total, s_errors,
+                                             target_avail)),
+            },
+            "budget": {
+                "total": round(budget_total, 3)
+                if budget_total is not None else None,
+                "spent": s_errors,
+                "remainingFraction": round(
+                    1.0 - s_errors / budget_total, 6)
+                if budget_total else None,
+            },
+            "violations": violations,
+            "ok": not violations,
+        }
+        return out
+
+    def status(self, window_s: float | None = None,
+               tenants: bool = False) -> dict:
+        """Live objective status per class (and per tenant when the QoS
+        plane fed tenant labels).  ``window_s`` scopes the measured
+        section — the simulator passes its scenario duration; default
+        is the slow window."""
+        now = self._now()
+        w = min(max(float(window_s), self.slot_s), self.slow_s) \
+            if window_s else self.slow_s
+        # snapshot slot refs under the lock (cheap), aggregate OUTSIDE
+        # it: the scan is pure Python over possibly thousands of slots
+        # and record() — called per finished request on the event
+        # loop — must never wait it out
+        with self._mu:
+            cls_snaps = [(cls, ring.snapshot())
+                         for cls, ring in sorted(self._cls.items())]
+            tenant_snaps = [(key, ring.snapshot()) for key, ring
+                            in sorted(self._tenant.items())] \
+                if tenants and self._tenant else []
+        classes = {cls: self._class_status(cls, items, now, w)
+                   for cls, items in cls_snaps}
+        doc = {
+            "enabled": True,
+            "slotSeconds": self.slot_s,
+            "windows": {"fast": self.fast_s, "slow": self.slow_s},
+            "objectives": {c: dict(o)
+                           for c, o in self.objectives.items()},
+            "classes": classes,
+            "ok": all(c["ok"] for c in classes.values()),
+        }
+        if tenant_snaps:
+            td: dict[str, dict] = {}
+            for (tenant, cls), items in tenant_snaps:
+                st = self._class_status(cls, items, now, w)
+                td.setdefault(tenant, {})[cls] = {
+                    "window": st["window"], "burn": st["burn"],
+                    "violations": st["violations"], "ok": st["ok"]}
+            doc["tenants"] = td
+        return doc
+
+    def snapshot_for_metrics(self) -> dict:
+        """Slow-window aggregates per class for server/metrics.py:
+        cumulative latency buckets plus objective-attainment ratios and
+        burn rates (ratio >= 1.0 means the objective is met)."""
+        now = self._now()
+        out = {}
+        with self._mu:
+            snaps = [(cls, ring.snapshot())
+                     for cls, ring in sorted(self._cls.items())]
+        for cls, items in snaps:
+            ((total, errors, dur_sum, counts),
+             (f_total, f_errors, _, _)) = _agg_windows(
+                items, self.slot_s, now, (self.slow_s, self.fast_s))
+            cum = []
+            acc = 0
+            for i, b in enumerate(LAT_BUCKETS):
+                acc += counts[i]
+                cum.append((b, acc))
+            obj = self.objectives.get(cls, {})
+            ratios = {}
+            target_avail = obj.get("availability")
+            if target_avail is not None and total:
+                ratios["availability"] = round(
+                    ((total - errors) / total) / target_avail, 6)
+            target_p99 = obj.get("p99_ms")
+            p99 = percentile(counts, 0.99)
+            if target_p99 is not None and p99 is not None:
+                ratios["latency_p99"] = round(
+                    target_p99 / max(p99 * 1e3, 1e-9), 6)
+            out[cls] = {
+                "buckets": cum, "count": total,
+                "sum": round(dur_sum, 6), "ratios": ratios,
+                "burn": {
+                    "fast": _round(self._burn_of(
+                        f_total, f_errors, target_avail)),
+                    "slow": _round(self._burn_of(
+                        total, errors, target_avail)),
+                },
+            }
+        return out
+
+
+def _round(v: float | None) -> float | None:
+    return round(v, 6) if v is not None else None

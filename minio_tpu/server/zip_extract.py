@@ -220,9 +220,16 @@ def parse_central_directory(read_at, size: int) -> dict[str, ZipMember]:
                         hdr_off = struct.unpack("<Q", body[r:r + 8])[0]
                     break
                 q += 4 + tlen
-        members[name] = ZipMember(
-            name=name, method=method, comp_size=csize,
-            uncomp_size=usize, header_offset=hdr_off, crc32=crc)
+        # explicit directory entries (name ends "/", no payload) are
+        # not members: the reference's zipindex omits them, so member
+        # GET answers NoSuchKey and listings never show zero-byte
+        # pseudo-keys next to the CommonPrefixes their children roll
+        # up into (the prefixes still appear — they come from the
+        # children's names, not the directory entry)
+        if not (name.endswith("/") and usize == 0):
+            members[name] = ZipMember(
+                name=name, method=method, comp_size=csize,
+                uncomp_size=usize, header_offset=hdr_off, crc32=crc)
         p += 46 + nlen + xlen + clen
     return members
 

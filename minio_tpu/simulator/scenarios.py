@@ -1,0 +1,157 @@
+"""Declarative traffic scenarios (ISSUE 15).
+
+A :class:`Scenario` is pure data: everything the engine needs to build
+a deterministic arrival schedule (see ``engine.build_schedule`` — same
+seed, same schedule, same request sequence) plus the SLOs the scenario
+asserts after replay and the chaos hook it arms mid-run.
+
+``builtin_scenarios()`` is the production mix ``python bench.py sim``
+replays: zipf read fan-in, multipart ingest storm, list-heavy
+analytics, a multi-tenant QoS mix, and two chaos variants (flaky-drive
+brownout, pool drain under live traffic — the PR 14 harness shape).
+Scenario SLO grammar::
+
+    slo = {
+      "classes": {"GET": {"p99_ms": 400, "availability": 0.995}},
+      "shed_fraction_max": 0.05,          # client-side 503 fraction
+      "buckets": {"simquiet": {"p99_ms": 800, "shed_max": 0}},
+    }
+
+``classes`` asserts against the server's own accounting (the admin SLO
+endpoint, windowed to the scenario); ``buckets`` asserts client-side
+per-bucket latencies (the noisy-neighbor clause of the QoS mix).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Scenario:
+    name: str
+    seed: int
+    duration_s: float
+    clients: int
+    rate: float                      # aggregate Poisson arrival rate, req/s
+    ops: tuple                       # ((op, weight), ...); ops: get|head|
+    #                                  put|list|delete|mpu
+    buckets: tuple = ("sim",)
+    #: fraction of requests aimed at buckets[0]; None = uniform.  The
+    #: QoS mix points most traffic at the hot bucket/tenant.
+    hot_bucket_frac: float | None = None
+    nobjects: int = 48               # catalog keys per bucket (setup PUTs)
+    obj_bytes: tuple = (4 << 10, 64 << 10)
+    zipf_s: float = 1.1              # GET popularity skew
+    put_bytes: tuple = (8 << 10, 96 << 10)
+    mpu_parts: int = 2               # parts per multipart upload
+    mpu_part_bytes: int = 5 << 20    # all-but-last part size (S3 minimum)
+    mpu_last_bytes: int = 64 << 10
+    list_max_keys: int = 100
+    slo: dict = field(default_factory=dict)
+    chaos: str | None = None         # engine chaos-hook name
+    chaos_at_frac: float = 0.25      # hook start, fraction of duration
+    chaos_dur_frac: float = 0.5      # hook length, fraction of duration
+    qos: dict | None = None          # admin qos doc applied for the run
+    description: str = ""
+
+
+def builtin_scenarios(scale: float = 1.0) -> list[Scenario]:
+    """The ``bench.py sim`` set.  ``scale`` multiplies durations
+    (rates are part of each scenario's identity and stay fixed) so a
+    short tier can exercise the same shapes in less wall time; seeds
+    are fixed — the schedule digests in SIM_r01.json are the
+    reproducibility pin."""
+    d = lambda s: max(3.0, s * scale)  # noqa: E731
+
+    return [
+        Scenario(
+            name="zipf_read_fanin", seed=1501, duration_s=d(12),
+            clients=8, rate=160.0, ops=(("get", 92), ("head", 8)),
+            nobjects=64, zipf_s=1.1,
+            slo={"classes": {
+                "GET": {"p99_ms": 900.0, "availability": 0.999}},
+                "shed_fraction_max": 0.01},
+            description="million-user CDN shape: zipf(1.1) GET/HEAD "
+                        "fan-in over a small hot set, served from the "
+                        "hot tier"),
+        Scenario(
+            name="multipart_ingest_storm", seed=1502, duration_s=d(12),
+            clients=6, rate=14.0,
+            ops=(("mpu", 3), ("put", 9), ("get", 4)),
+            nobjects=16, mpu_parts=2,
+            slo={"classes": {
+                "PUT": {"p99_ms": 4000.0, "availability": 0.995},
+                "MULTIPART": {"p99_ms": 9000.0, "availability": 0.995}},
+                "shed_fraction_max": 0.05},
+            description="bulk ingest: multipart uploads (5MiB parts) "
+                        "racing single PUTs and readbacks"),
+        Scenario(
+            name="list_heavy_analytics", seed=1503, duration_s=d(10),
+            clients=6, rate=60.0,
+            ops=(("list", 55), ("get", 35), ("head", 10)),
+            nobjects=96,
+            slo={"classes": {
+                "LIST": {"p99_ms": 1500.0, "availability": 0.999},
+                "GET": {"p99_ms": 1200.0, "availability": 0.999}},
+                "shed_fraction_max": 0.02},
+            description="analytics shape: namespace walks dominating, "
+                        "point reads riding along"),
+        Scenario(
+            name="multi_tenant_qos_mix", seed=1504, duration_s=d(12),
+            clients=10, rate=120.0,
+            ops=(("get", 80), ("put", 15), ("list", 5)),
+            buckets=("simhot", "simquiet"), hot_bucket_frac=0.9,
+            nobjects=32,
+            qos={"enable": True, "max_queue": 64, "tenants": {
+                "bucket:simhot": {"weight": 1, "max_concurrency": 2},
+                "bucket:simquiet": {"weight": 8}}},
+            slo={"buckets": {
+                "simquiet": {"p99_ms": 2500.0, "shed_max": 0}},
+                # the hot tenant IS expected to shed under its cap;
+                # only runaway collapse fails the scenario
+                "shed_fraction_max": 0.75},
+            description="noisy neighbor: 90% of arrivals hammer the "
+                        "capped hot tenant; the quiet tenant must not "
+                        "feel it (weighted DRR isolation)"),
+        Scenario(
+            name="chaos_disk_brownout", seed=1505, duration_s=d(14),
+            clients=8, rate=80.0, ops=(("get", 90), ("put", 10)),
+            nobjects=48, chaos="disk",
+            chaos_at_frac=0.25, chaos_dur_frac=0.4,
+            slo={"classes": {
+                "GET": {"p99_ms": 2500.0, "availability": 0.995}},
+                "shed_fraction_max": 0.05},
+            description="two drives turn slow+flaky mid-run "
+                        "(ChaosDisk); hedged reads + the breaker must "
+                        "hold availability inside parity"),
+        # MUST stay last: its drain decommissions pool 1 of bench_sim's
+        # shared server for good (bench_sim asserts this ordering)
+        Scenario(
+            name="drain_under_traffic", seed=1506, duration_s=d(14),
+            clients=8, rate=70.0, ops=(("get", 85), ("put", 15)),
+            nobjects=48, chaos="drain",
+            chaos_at_frac=0.2, chaos_dur_frac=1.0,
+            slo={"classes": {
+                "GET": {"p99_ms": 2500.0, "availability": 0.995},
+                "PUT": {"p99_ms": 5000.0, "availability": 0.99}},
+                "shed_fraction_max": 0.05},
+            description="PR 14 harness shape: a pool decommission "
+                        "starts mid-traffic; reads stay findable "
+                        "mid-move, writes route to live pools"),
+    ]
+
+
+def smoke_scenario() -> Scenario:
+    """Tier-1 sized: a few seconds against a real server, generous
+    budgets (CI boxes are noisy — this pins the loop closes, not that
+    CI is fast)."""
+    return Scenario(
+        name="smoke_zipf_read", seed=7701, duration_s=3.0, clients=4,
+        rate=40.0, ops=(("get", 80), ("put", 12), ("list", 8)),
+        nobjects=12, obj_bytes=(2 << 10, 8 << 10),
+        put_bytes=(2 << 10, 8 << 10),
+        slo={"classes": {
+            "GET": {"p99_ms": 15000.0, "availability": 0.98}},
+            "shed_fraction_max": 0.2},
+        description="tier-1 smoke: tiny zipf mix, generous budgets")

@@ -690,6 +690,60 @@ class TraceStore:
 store = TraceStore()
 
 
+def quantile(sorted_vals: list, q: float):
+    """Nearest-rank quantile over an already-sorted sample list; None
+    on empty.  Shared by the trace summary below and the simulator's
+    client-side aggregates so the two can never silently diverge."""
+    if not sorted_vals:
+        return None
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           int(len(sorted_vals) * q))]
+
+
+def summarize_stages(docs: list[dict]) -> dict:
+    """Aggregate retained trace docs into per-stage timing: for every
+    span NAME, count / p50 / p99 / total seconds (exact quantiles — the
+    store is bounded, so the sample lists are too), flagging names that
+    ever appear as a trace root (so a consumer attributing a slow
+    scenario can exclude the root request spans and look at the stages
+    under them); plus the stagestats fold totals per pipeline stage.
+    Served by ``GET /minio/admin/v3/trace/summary``."""
+    by_name: dict[str, dict] = {}
+    durs: dict[str, list[float]] = {}
+    stage_totals: dict[str, float] = {}
+    for doc in docs:
+        for stage, secs in (doc.get("stages") or {}).items():
+            stage_totals[stage] = stage_totals.get(stage, 0.0) + secs
+        for rec in doc.get("spans", ()):
+            name = rec.get("name", "")
+            d = by_name.get(name)
+            if d is None:
+                d = by_name[name] = {
+                    "count": 0, "totalS": 0.0, "errors": 0,
+                    "isRoot": False}
+                durs[name] = []
+            dur = rec.get("dur", 0.0)
+            d["count"] += 1
+            d["totalS"] += dur
+            if rec.get("error"):
+                d["errors"] += 1
+            if rec.get("parent") is None:
+                d["isRoot"] = True
+            durs[name].append(dur)
+    for name, d in by_name.items():
+        ds = sorted(durs[name])
+        d["totalS"] = round(d["totalS"], 6)
+        d["p50Ms"] = round(quantile(ds, 0.50) * 1e3, 3)
+        d["p99Ms"] = round(quantile(ds, 0.99) * 1e3, 3)
+        d["maxMs"] = round(ds[-1] * 1e3, 3)
+    return {
+        "traces": len(docs),
+        "spans": dict(sorted(by_name.items())),
+        "stages": {k: {"seconds": round(v, 6)}
+                   for k, v in sorted(stage_totals.items())},
+    }
+
+
 def span_tree(doc: dict) -> dict:
     """Assemble the nested tree view of a captured doc: each span gains
     a ``children`` list; the returned doc's ``tree`` holds the roots

@@ -248,6 +248,34 @@ class TestZipMemberListing:
         assert self._keys(self._list(srv, "a.zip/").body) \
             == ["a.zip/only.txt"]
 
+    def test_directory_entries_omitted(self, srv):
+        """Explicit directory entries (trailing '/', zero bytes — the
+        shape zipfile writes for ZipInfo dirs) are not members: the
+        reference's zipindex omits them, so they neither list as
+        zero-byte pseudo-keys nor answer member GET; their children
+        still roll up into CommonPrefixes (ISSUE 15 carried zip gap)."""
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w") as z:
+            z.writestr("docs/", b"")          # explicit dir entry
+            z.writestr("docs/a.txt", b"hello")
+            z.writestr("emptydir/", b"")      # dir with no children
+        srv.request("PUT", f"/{BKT}/d.zip", data=buf.getvalue())
+        r = self._list(srv, "d.zip/")
+        assert self._keys(r.body) == ["d.zip/docs/a.txt"]
+        r = self._list(srv, "d.zip/", [("delimiter", "/")])
+        assert b"<Prefix>d.zip/docs/</Prefix>" in r.body
+        # an empty directory vanishes entirely (reference parity)
+        assert b"emptydir" not in r.body
+        # member GET of the directory entry is NoSuchKey, not an
+        # empty 200
+        r = srv.request("GET", f"/{BKT}/d.zip/docs/",
+                        headers={"x-minio-extract": "true"})
+        assert r.status == 404
+        # the real member still serves
+        r = srv.request("GET", f"/{BKT}/d.zip/docs/a.txt",
+                        headers={"x-minio-extract": "true"})
+        assert r.status == 200 and r.body == b"hello"
+
     def test_list_without_header_is_namespace_listing(self, srv):
         srv.request("PUT", f"/{BKT}/a.zip", data=_zip_bytes(MEMBERS))
         r = srv.request("GET", f"/{BKT}",
