@@ -1858,6 +1858,251 @@ def main_trace():
     print(json.dumps(doc, indent=2))
 
 
+def _georep_list_keys(srv, bucket):
+    """Sorted object keys of one bucket over the S3 API (None while the
+    server is down/restarting)."""
+    import re as _re
+    try:
+        r = srv.request("GET", f"/{bucket}",
+                        query=[("list-type", "2"), ("max-keys", "1000")])
+    except Exception:
+        return None
+    if r.status != 200:
+        return None
+    return sorted(_re.findall(r"<Key>([^<]+)</Key>",
+                              r.body.decode(errors="replace")))
+
+
+def _georep_converge(primary, peer_box, bucket, timeout_s):
+    """Poll the secondary until it is BYTE-IDENTICAL to the primary for
+    ``bucket``: same key set, same bytes per key, and matching
+    per-key version counts (the duplicate-divergence clause).  Returns
+    the convergence record either way — a timeout is data, not an
+    exception."""
+    t0 = time.time()
+    detail = "no-poll"
+    while time.time() - t0 < timeout_s:
+        peer = peer_box["srv"]
+        ka = _georep_list_keys(primary, bucket)
+        kb = _georep_list_keys(peer, bucket)
+        if ka is None or kb is None or ka != kb:
+            detail = (f"key sets differ: primary={len(ka or [])} "
+                      f"secondary={'down' if kb is None else len(kb)}")
+            time.sleep(0.4)
+            continue
+        mismatch = None
+        for k in ka:
+            ra = primary.request("GET", f"/{bucket}/{k}")
+            rb = peer.request("GET", f"/{bucket}/{k}")
+            if ra.status != 200 or rb.status != 200 \
+                    or ra.body != rb.body:
+                mismatch = f"{k}:{ra.status}/{rb.status}"
+                break
+        if mismatch is not None:
+            detail = f"byte-mismatch {mismatch}"
+            time.sleep(0.4)
+            continue
+        va = {e.name: len(e.versions)
+              for e in primary.server.api.list_entries(bucket)}
+        vb = {e.name: len(e.versions)
+              for e in peer.server.api.list_entries(bucket)}
+        dup = sum(1 for k, n in vb.items() if va.get(k) != n) \
+            + sum(1 for k in va if k not in vb)
+        return {"bucket": bucket, "converged": True,
+                "lagS": round(time.time() - t0, 3),
+                "objects": len(ka), "duplicateDivergence": dup}
+    return {"bucket": bucket, "converged": False, "lagS": None,
+            "objects": None, "duplicateDivergence": None,
+            "detail": detail}
+
+
+def _sim_georep(root, scale):
+    """The multi-region scenario family (ISSUE 16): a FRESH two-cluster
+    pair (primary + site peer, ``MINIO_TPU_GEOREP=1``), the four
+    ``georep_scenarios`` replayed against the PRIMARY and graded by ITS
+    SLO endpoint, chaos hooks supplied here:
+
+    * ``peer_kill`` closes the secondary mid-push and restarts it at
+      the SAME port (the harness's process-restart analogue);
+    * ``worker_kill`` SIGKILLs one mp I/O worker of the primary
+      (``MINIO_TPU_WORKERS=2`` is scoped to THAT scenario only — the
+      plane is process-wide and a peer close would otherwise tear down
+      the primary's workers too).
+
+    After each scenario the harness polls the secondary to byte-
+    identity with the primary (``_georep_converge``) — cross-site
+    convergence, read-your-writes and duplicate-divergence are graded
+    THERE, because the primary-facing SLO deliberately never waits on
+    the WAN.  Returns (scenario result docs, georep meta doc).
+    """
+    from s3_harness import S3TestServer
+
+    from minio_tpu.parallel import workers as workers_mod
+    from minio_tpu.simulator import ScenarioEngine, georep_scenarios
+    from minio_tpu.simulator.engine import body_bytes, build_schedule
+
+    env = {
+        "MINIO_TPU_GEOREP": "1",
+        "MINIO_TPU_GEOREP_INTERVAL_S": "0.5",
+        "MINIO_TPU_GEOREP_BREAKER_THRESHOLD": "2",
+        "MINIO_TPU_GEOREP_BREAKER_COOLDOWN_S": "1",
+    }
+    saved = {k: os.environ.get(k) for k in env}
+    saved["MINIO_TPU_WORKERS"] = os.environ.get("MINIO_TPU_WORKERS")
+    os.environ.update(env)
+    meta = {"convergence": [], "note": (
+        "georep scenarios run on a separate two-cluster pair and are "
+        "excluded from the capacity model's clean envelope; "
+        "convergence/readYourWrites are graded against the SECONDARY "
+        "after each replay — the primary SLO verdicts above "
+        "deliberately never include WAN latency")}
+    results = []
+    try:
+        a = S3TestServer(os.path.join(root, "geo-a"))
+        peer_box = {"srv": S3TestServer(os.path.join(root, "geo-b"))}
+        peer_port = peer_box["srv"].port
+        meta["peerPort"] = peer_port
+        try:
+            r = a.request(
+                "POST", "/minio/admin/v3/site-replication/add",
+                data=json.dumps({"peers": [{
+                    "name": "siteB",
+                    "endpoint": f"http://127.0.0.1:{peer_port}",
+                    "accessKey": peer_box["srv"].ak,
+                    "secretKey": peer_box["srv"].sk}]}).encode())
+            assert r.status == 200, r.body
+
+            # the burst scenario's deletes must replicate: an
+            # unversioned DELETE physically removes the version and
+            # leaves nothing for a push sweep to discover (same rule
+            # as MinIO bucket replication — versioning required), so
+            # its bucket is versioned and deletes become markers
+            assert a.request("PUT", "/grburst").status == 200
+            assert a.request(
+                "PUT", "/grburst", query=[("versioning", "")],
+                data=b"<VersioningConfiguration><Status>Enabled"
+                     b"</Status></VersioningConfiguration>").status \
+                == 200
+
+            def peer_start():
+                meta["peerKill"] = {"killed": True}
+                peer_box["srv"].close()
+
+            def peer_stop():
+                peer_box["srv"] = S3TestServer(
+                    os.path.join(root, "geo-b"), port=peer_port)
+                meta["peerKill"]["restartedSamePort"] = \
+                    peer_box["srv"].port == peer_port
+
+            def worker_start():
+                plane = workers_mod.get_plane(create=False)
+                if plane is None or not plane.io:
+                    # non-TSO box or the plane never spawned: record it
+                    # honestly instead of faking a kill
+                    meta["workerKill"] = {"available": False}
+                    return
+                victim = plane.io[0]
+                meta["workerKill"] = {"available": True,
+                                      "pid": victim.proc.pid}
+                os.kill(victim.proc.pid, 9)
+
+            def worker_stop():
+                wk = meta.get("workerKill") or {}
+                if not wk.get("available"):
+                    return
+                plane = workers_mod.get_plane(create=False)
+                deadline = time.time() + 30
+                while plane is not None and time.time() < deadline:
+                    st = plane.stats()
+                    if st.get("restarts", 0) >= 1 \
+                            and all(h.alive for h in plane.io):
+                        break
+                    time.sleep(0.2)
+                st = plane.stats() if plane is not None else {}
+                wk["workerDeaths"] = st.get("workerDeaths")
+                wk["respawned"] = bool(
+                    plane is not None and st.get("restarts", 0) >= 1
+                    and all(h.alive for h in plane.io))
+
+            engine = ScenarioEngine(
+                "127.0.0.1", a.port, a.ak, a.sk,
+                chaos_hooks={"peer_kill": (peer_start, peer_stop),
+                             "worker_kill": (worker_start, worker_stop)},
+                slo_slot_s=1.0, log=print)
+
+            scs = georep_scenarios(scale)
+            for sc in scs:
+                workers_scoped = sc.name == "worker_kill"
+                if workers_scoped:
+                    os.environ["MINIO_TPU_WORKERS"] = "2"
+                try:
+                    results.append(engine.run(sc))
+                    conv = _georep_converge(
+                        a, peer_box, sc.buckets[0],
+                        timeout_s=120 if sc.chaos else 60)
+                    conv["scenario"] = sc.name
+                    meta["convergence"].append(conv)
+                finally:
+                    if workers_scoped:
+                        if saved["MINIO_TPU_WORKERS"] is None:
+                            os.environ.pop("MINIO_TPU_WORKERS", None)
+                        else:
+                            os.environ["MINIO_TPU_WORKERS"] = \
+                                saved["MINIO_TPU_WORKERS"]
+                        workers_mod.shutdown_plane()
+
+            # read-your-writes ACROSS SITES: every acknowledged write
+            # of the RYW scenario must read back byte-identical from
+            # the SECONDARY (expected bytes re-derived from the seeded
+            # schedule, the same way the replay produced them)
+            ryw_sc = next(s for s in scs
+                          if s.name == "read_your_writes_across_sites")
+            bucket = ryw_sc.buckets[0]
+            on_a = set(_georep_list_keys(a, bucket) or [])
+            checked = mismatches = 0
+            for ent in build_schedule(ryw_sc):
+                if ent["op"] != "put" or ent["key"] not in on_a:
+                    continue
+                want = body_bytes(ryw_sc, f"put:{ent['i']}",
+                                  ent["size"])
+                got = peer_box["srv"].request(
+                    "GET", f"/{bucket}/{ent['key']}")
+                checked += 1
+                if got.status != 200 or got.body != want:
+                    mismatches += 1
+            meta["readYourWrites"] = {
+                "scenario": ryw_sc.name, "writesChecked": checked,
+                "mismatches": mismatches,
+                "converged": checked > 0 and mismatches == 0}
+
+            # attribution surface: the primary's own georep counters
+            # and breaker state, straight from the metrics endpoint
+            # (signed — the scrape sits behind admin auth)
+            scrape = a.request(
+                "GET", "/minio/v2/metrics/cluster").body.decode(
+                errors="replace")
+            meta["metrics"] = {
+                line.split()[0]: float(line.split()[1])
+                for line in scrape.splitlines()
+                if line.startswith("minio_georep_")
+                and "{" not in line.split()[0]}
+            meta["status"] = json.loads(a.request(
+                "GET", "/minio/admin/v3/georep/status").body)
+        finally:
+            try:
+                peer_box["srv"].close()
+            except Exception:
+                pass
+            a.close()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return results, meta
+
+
 def bench_sim(scale=1.0):
     """SIM_r01: production traffic simulator against the REAL HTTP
     server (ISSUE 15) — the regression surface that turns BENCH_* one-
@@ -1885,13 +2130,22 @@ def bench_sim(scale=1.0):
       inside parity); `drain` starts a live pool decommission over the
       admin API mid-traffic (the PR 14 harness shape) and polls it to
       completion so the verdict includes the drained state.
+    * Multi-region family (ISSUE 16): four scenarios against a FRESH
+      primary+secondary pair with object geo-replication on —
+      `peer_kill_mid_push` (secondary killed + restarted at the same
+      port) and `worker_kill` (one mp I/O worker SIGKILLed) among
+      them; primary SLO verdicts come from the same closed loop, and
+      cross-site byte-identity / read-your-writes / duplicate-
+      divergence are graded against the SECONDARY and recorded in
+      the `georep` section.
     """
     sys.path.insert(0, os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "tests"))
     from s3_harness import S3TestServer
 
     from minio_tpu.erasure.sets import ErasureServerPools, ErasureSets
-    from minio_tpu.simulator import ScenarioEngine, builtin_scenarios
+    from minio_tpu.simulator import (ScenarioEngine, builtin_scenarios,
+                                     georep_scenarios)
     from minio_tpu.simulator.engine import build_schedule, \
         schedule_digest
     from minio_tpu.storage.local import LocalStorage
@@ -1983,6 +2237,23 @@ def bench_sim(scale=1.0):
             out.update(doc)
         finally:
             srv.close()
+        # multi-region family (ISSUE 16): a FRESH two-cluster pair;
+        # the four georep scenarios are graded by the PRIMARY's SLO
+        # endpoint like every other scenario, and cross-site
+        # convergence + read-your-writes are graded against the
+        # SECONDARY afterwards (see _sim_georep)
+        geo_results, geo_meta = _sim_georep(root, scale)
+        geo_redrive = {sc.name: schedule_digest(build_schedule(sc))
+                       for sc in georep_scenarios(scale)}
+        for r in geo_results:
+            r["scheduleDeterministic"] = \
+                geo_redrive[r["name"]] == r["scheduleSha256"]
+        out["scenarios"] = out["scenarios"] + geo_results
+        out["passCount"] = sum(1 for r in out["scenarios"]
+                               if r["verdict"] == "pass")
+        out["failCount"] = sum(1 for r in out["scenarios"]
+                               if r["verdict"] == "fail")
+        out["georep"] = geo_meta
     finally:
         shutil.rmtree(root, ignore_errors=True)
         for k, v in saved.items():
@@ -2017,6 +2288,23 @@ def main_sim():
         # a missing/timeout value means the verdict raced the drain
         "drain_reached_terminal": res.get("drainState")
         in ("complete", "failed", "canceled"),
+        # multi-region family: every scenario bucket must reach byte-
+        # identity on the secondary with zero duplicate-divergence,
+        # and the RYW scenario's acknowledged writes must read back
+        # byte-identical ACROSS sites
+        "georep_scenarios_run": sum(
+            1 for r in res.get("scenarios", [])
+            if r.get("name", "").startswith(
+                ("replication_burst", "peer_kill_mid_push",
+                 "worker_kill", "read_your_writes_across_sites"))),
+        "georep_converged": bool(
+            (res.get("georep") or {}).get("convergence"))
+        and all(c.get("converged")
+                and c.get("duplicateDivergence") == 0
+                for c in res["georep"]["convergence"]),
+        "georep_ryw_across_sites": bool(
+            ((res.get("georep") or {}).get("readYourWrites")
+             or {}).get("converged")),
     }
     doc = {
         "bench": "sim",
@@ -2025,6 +2313,12 @@ def main_sim():
         "acceptance": {
             "ran_5_plus_scenarios": ok_structure["scenarios_run"] >= 5,
             "ran_2_plus_chaos": ok_structure["chaos_scenarios"] >= 2,
+            "ran_3_plus_georep_scenarios":
+                ok_structure["georep_scenarios_run"] >= 3,
+            "georep_secondary_byte_identical":
+                ok_structure["georep_converged"],
+            "georep_read_your_writes_across_sites":
+                ok_structure["georep_ryw_across_sites"],
             "schedules_deterministic":
                 ok_structure["all_schedules_deterministic"],
             "violations_attributed":
@@ -2307,11 +2601,300 @@ def main_topo():
                     if k != "note") else 1
 
 
+def bench_georep(nobjects=64, obj_kib=24, nhot=6):
+    """BENCH_r17: the serial two-cluster geo-replication chaos drill
+    (ISSUE 16).
+
+    A primary + site peer pair with object geo-replication ON; 64 x
+    24 KiB immutable probes plus 6 hot keys overwritten continuously.
+    Two kills, in sequence, under that live write load:
+
+    1. the push WORKER dies mid-sweep (crash hook — the sweep raises
+       without a final cursor save, the in-process SIGKILL analogue);
+       the supervisor respawns it and the resumed sweep loads the
+       QUORUM-PERSISTED object cursor;
+    2. the PEER dies mid-push and restarts at the SAME port; the
+       breaker must open during the outage (bounded hammering) and the
+       retried sweeps must converge against the restarted peer.
+
+    Afterwards the letter asserts byte-identical convergence (same key
+    set, same bytes, same per-key version counts — zero lost, zero
+    duplicate-divergence), read-your-writes ACROSS sites, and byte
+    identity of the chaos pair's secondary versus a NEVER-killed
+    control pair that replicated the same final payloads.
+    """
+    import threading
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tests"))
+    from s3_harness import S3TestServer
+
+    env = {
+        "MINIO_TPU_FSYNC": "0",
+        "MINIO_TPU_GEOREP": "1",
+        "MINIO_TPU_GEOREP_INTERVAL_S": "0.2",
+        "MINIO_TPU_GEOREP_CHECKPOINT_EVERY": "4",
+        "MINIO_TPU_GEOREP_BREAKER_THRESHOLD": "2",
+        "MINIO_TPU_GEOREP_BREAKER_COOLDOWN_S": "0.5",
+        "MINIO_TPU_TRACE_SAMPLE": "1.0",
+    }
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    root = tempfile.mkdtemp(prefix="bench-georep-")
+    out = {"nobjects": nobjects, "obj_kib": obj_kib}
+
+    def _poll(cond, timeout=30.0, step=0.1):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if cond():
+                return True
+            time.sleep(step)
+        return False
+
+    def _join(src, dst, name="siteB"):
+        r = src.request(
+            "POST", "/minio/admin/v3/site-replication/add",
+            data=json.dumps({"peers": [{
+                "name": name,
+                "endpoint": f"http://127.0.0.1:{dst.port}",
+                "accessKey": dst.ak,
+                "secretKey": dst.sk}]}).encode())
+        assert r.status == 200, r.body
+
+    try:
+        a = S3TestServer(f"{root}/a")
+        box = {"srv": S3TestServer(f"{root}/b")}
+        b_port = box["srv"].port
+        try:
+            _join(a, box["srv"])
+            assert a.request("PUT", "/geo").status == 200
+            g = a.server.georep
+            assert g is not None, "georep gate did not light"
+
+            payload = {f"k{i:03d}": bytes([i % 251]) * (obj_kib << 10)
+                       for i in range(nobjects)}
+            # stage the namespace with pushes PAUSED (unconditional
+            # crash hook) so the kill lands mid-namespace, mid-sweep
+            g._crash_hook = lambda pushed: True
+            t0 = time.perf_counter()
+            for k, v in payload.items():
+                assert a.request("PUT", f"/geo/{k}",
+                                 data=v).status == 200
+            out["seed_put_s"] = round(time.perf_counter() - t0, 3)
+
+            stop = threading.Event()
+            mu = threading.Lock()
+            acked = {}
+
+            def writer():
+                i = 0
+                while not stop.is_set():
+                    k = f"hot{i % nhot}"
+                    v = f"gen-{i}-".encode() * 64
+                    if a.request("PUT", f"/geo/{k}",
+                                 data=v).status == 200:
+                        with mu:
+                            acked[k] = v
+                    i += 1
+                    time.sleep(0.01)
+
+            wt = threading.Thread(target=writer, daemon=True)
+            wt.start()
+
+            # ---- kill 1: push worker dies mid-sweep, no cursor save
+            kill_at = max(4, nobjects // 3)
+            out["worker_kill_after_objects"] = kill_at
+            kills = {"n": 0}
+
+            def hook(pushed):
+                if pushed >= kill_at and kills["n"] == 0:
+                    kills["n"] += 1
+                    return True
+                return False
+
+            g._crash_hook = hook
+            g.nudge()
+            out["killed_push_worker"] = _poll(
+                lambda: kills["n"] == 1, timeout=60)
+            st = json.loads(a.request(
+                "GET", "/minio/admin/v3/georep/status").body)
+            cursor = (st["peers"]["siteB"] or {}).get("cursor") or {}
+            out["cursor_at_kill"] = cursor
+            out["resumed_from_quorum_cursor"] = bool(cursor)
+            # supervisor respawns the worker; the resumed sweep loads
+            # the quorum cursor and finishes the namespace
+            g._crash_hook = None
+            g.nudge()
+            out["worker_respawned"] = _poll(lambda: json.loads(
+                a.request("GET", "/minio/admin/v3/georep/status").body)
+                ["peers"]["siteB"]["workerAlive"], timeout=30)
+
+            # ---- kill 2: peer dies mid-push, restarts at same port
+            box["srv"].close()
+            # writes keep landing on the primary during the outage
+            time.sleep(1.0)
+
+            def breaker_tripped():
+                doc = json.loads(a.request(
+                    "GET", "/minio/admin/v3/georep/status").body)
+                return doc["peers"]["siteB"]["breaker"] in (
+                    "open", "half-open")
+
+            out["breaker_opened_during_outage"] = _poll(
+                breaker_tripped, timeout=30)
+            box["srv"] = S3TestServer(f"{root}/b", port=b_port)
+            out["peer_restarted_same_port"] = \
+                box["srv"].port == b_port
+
+            time.sleep(1.0)
+            stop.set()
+            wt.join(10)
+            with mu:
+                final = dict(payload, **acked)
+            out["hot_keys_acked"] = len(acked)
+
+            # ---- convergence: byte identity + version counts
+            conv = _georep_converge(a, box, "geo", timeout_s=120)
+            out["convergence"] = conv
+
+            b = box["srv"]
+            lost = ryw = 0
+            for k, v in final.items():
+                if a.request("GET", f"/geo/{k}").body != v:
+                    lost += 1
+                if b.request("GET", f"/geo/{k}").body != v:
+                    ryw += 1
+            out["lost_versions"] = lost
+            out["read_your_writes_across_sites_violations"] = ryw
+
+            # ---- never-killed control pair, same final payloads
+            ctl_a = S3TestServer(f"{root}/ca")
+            ctl_box = {"srv": S3TestServer(f"{root}/cb")}
+            try:
+                _join(ctl_a, ctl_box["srv"], name="ctlB")
+                assert ctl_a.request("PUT", "/geo").status == 200
+                for k, v in final.items():
+                    assert ctl_a.request("PUT", f"/geo/{k}",
+                                         data=v).status == 200
+                ctl_conv = _georep_converge(
+                    ctl_a, ctl_box, "geo", timeout_s=120)
+                out["control_convergence"] = ctl_conv
+                mismatch = 0
+                for k in final:
+                    if ctl_box["srv"].request(
+                            "GET", f"/geo/{k}").body != b.request(
+                            "GET", f"/geo/{k}").body:
+                        mismatch += 1
+                out["control_mismatches"] = mismatch
+            finally:
+                ctl_box["srv"].close()
+                ctl_a.close()
+
+            # ---- attribution: georep counters + retained trace spans
+            scrape = a.request(
+                "GET", "/minio/v2/metrics/cluster").body.decode(
+                errors="replace")
+            out["georep_metrics"] = {
+                line.split()[0]: float(line.split()[1])
+                for line in scrape.splitlines()
+                if line.startswith("minio_georep_")
+                and "{" not in line.split()[0]}
+            trace = json.loads(a.request(
+                "GET", "/minio/admin/v3/trace/summary").body)
+            out["georep_trace_spans"] = sorted(
+                n for n in (trace.get("spans") or {})
+                if n.startswith("georep."))
+            out["georep_status"] = json.loads(a.request(
+                "GET", "/minio/admin/v3/georep/status").body)
+        finally:
+            box["srv"].close()
+            a.close()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
+
+
+def main_georep():
+    """`python bench.py georep` -> BENCH_r17.json: the multi-region
+    chaos-drill letter (ISSUE 16)."""
+    r = bench_georep()
+    conv = r.get("convergence") or {}
+    doc = {
+        "georeplication_chaos": {
+            "method": (
+                "primary + site peer with object geo-replication on "
+                "(sweep 0.2s, cursor checkpoint every 4 objects, "
+                "breaker threshold 2 / cooldown 0.5s); 64 x 24 KiB "
+                "immutable probes + 6 hot keys overwritten "
+                "continuously; the push worker is killed mid-sweep "
+                "without a cursor save (SIGKILL analogue) and resumes "
+                "from the quorum-persisted object cursor; then the "
+                "peer is killed mid-push and restarted at the same "
+                "port; convergence is byte-identity + per-key version "
+                "counts, compared against a never-killed control pair "
+                "replicating the same final payloads"),
+            "results": r,
+            "acceptance": {
+                "killed_push_worker_mid_sweep":
+                    r.get("killed_push_worker"),
+                "resumed_from_quorum_cursor":
+                    r.get("resumed_from_quorum_cursor"),
+                "worker_respawned": r.get("worker_respawned"),
+                "peer_killed_and_restarted_same_port":
+                    r.get("peer_restarted_same_port"),
+                "breaker_opened_during_outage":
+                    r.get("breaker_opened_during_outage"),
+                "converged_byte_identical": conv.get("converged"),
+                "zero_lost_versions": r.get("lost_versions") == 0,
+                "zero_duplicate_divergence":
+                    conv.get("duplicateDivergence") == 0,
+                "read_your_writes_across_sites":
+                    r.get("read_your_writes_across_sites_violations")
+                    == 0,
+                "byte_identity_vs_never_killed_control":
+                    r.get("control_mismatches") == 0,
+                "georep_trace_spans_retained":
+                    len(r.get("georep_trace_spans") or []) > 0,
+                "note": (
+                    "honest clause for THIS box: the kill/restart "
+                    "sleeps and 0.2s sweep cadence dominate wall "
+                    "time, so convergence lag here is a correctness "
+                    "bound, not a WAN throughput claim; the same "
+                    "kill shapes run serial-isolated in tier-1 "
+                    "(tests/test_georep.py) and under live traffic "
+                    "in `python bench.py sim` (the multi-region "
+                    "scenario family)."),
+            },
+        },
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_r17.json")
+    existing = {}
+    if os.path.exists(path):
+        with open(path, encoding="utf-8") as f:
+            existing = json.load(f)
+    existing.update(doc)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(existing, f, indent=2)
+        f.write("\n")
+    print(json.dumps(doc, indent=2))
+    ok = doc["georeplication_chaos"]["acceptance"]
+    return 0 if all(v is True for k, v in ok.items()
+                    if k != "note") else 1
+
+
 if __name__ == "__main__":
     if "sim" in sys.argv[1:]:
         sys.exit(main_sim())
     if "topo" in sys.argv[1:]:
         sys.exit(main_topo())
+    if "georep" in sys.argv[1:]:
+        sys.exit(main_georep())
     if "trace" in sys.argv[1:]:
         sys.exit(main_trace())
     if "repair" in sys.argv[1:]:

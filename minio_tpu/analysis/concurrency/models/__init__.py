@@ -1,3 +1,4 @@
 """Protocol models: importing this package registers every model."""
 
-from . import batcher, breaker, hotcache, qos, ring, topology  # noqa: F401
+from . import (batcher, breaker, georep, hotcache, qos, ring,  # noqa: F401
+               topology)

@@ -1,0 +1,376 @@
+"""Model: the active-active geo-replication push-queue protocol
+(services/georep.py, ISSUE 16) — written BEFORE the implementation, per
+the PR 10 convention.
+
+Two sites, A and B, each accepting client writes (active-active).  Each
+site runs a replication worker that walks its OWN write history in
+order and pushes every version to the peer over an at-least-once wire:
+push (send the version), apply (the peer merges it), ack (the worker
+learns it landed and advances its in-memory cursor), checkpoint (the
+cursor persists durably).  Versions are ``(ts, site)`` pairs — two
+concurrent writes tie on ``ts`` and the deterministic tiebreak is the
+site id, so last-writer-wins is a total order.  A peer APPLY is a
+version-set union plus an LWW merge of the "latest" pointer: applying
+an already-present version changes nothing (idempotent re-push), and
+applying a stale version never regresses "latest".
+
+Faults, each bounded: the worker may be KILLED at any step (in-memory
+cursor and the in-flight wire message die; the durable checkpoint and
+everything the peer already applied survive), the peer site may be
+KILLED (in-flight messages are lost; its durable stores survive) and
+restarted, a send against a down peer FAILS and is retried (the
+MRF-retryable class), and a bounded RESYNC rewinds the cursor to zero
+(full re-push — must be harmless by idempotency).
+
+The protocol rules under test (each is a line of services/georep.py):
+
+* **source never forgets** — a site's own writes stay in its store;
+* **apply is an LWW merge** — union the version, take the LWW max of
+  the latest pointer; never clobber a newer local version with an
+  incoming stale one;
+* **ack before advance** — the cursor (in memory AND durably) only
+  passes a version once the peer acknowledged it; a crash therefore
+  re-pushes at most the unacked suffix, and re-push is idempotent;
+* **retryable means retried** — a failed send leaves the cursor in
+  place; the version is pushed again once the peer returns;
+* **the breaker re-closes** — a peer coming back up must eventually
+  receive everything (wedge-freedom via the ``done`` predicate).
+
+Invariants:
+
+* ``no-version-lost``          — every version a site ever wrote is in
+                                 its own store, and every version the
+                                 worker counts as acknowledged is in
+                                 the peer's store — in EVERY state.
+* ``no-push-of-unacked-stale`` — the durable checkpoint never covers a
+                                 version the peer has not acknowledged:
+                                 a version may only be skipped as
+                                 "already pushed" once its ack landed.
+* ``lww-latest-is-max``        — each site's latest pointer is exactly
+                                 the LWW max of its version set.
+* ``lww-convergence``          — terminal: at quiescence both sites
+                                 hold byte-identical version sets and
+                                 agree on the LWW-max latest.
+* wedge-freedom                — the ``done`` predicate: a quiescent
+                                 state with undelivered versions is a
+                                 wedge (deadlock).
+
+Every invariant is proven live by seeded mutations (tier-1 pins the
+matrix in tests/test_modelcheck.py): cursor-ahead-of-ack,
+resume-skips-inflight, apply-clobbers-newer, retry-drops-on-failure,
+ack-before-apply, breaker-never-recloses.
+"""
+
+from __future__ import annotations
+
+from ..modelcheck import Model, register
+
+#: the two replication directions: (name, source site, destination site)
+DIRS = (("AB", "A", "B"), ("BA", "B", "A"))
+
+
+def _lww_max(a: str, b: str) -> str:
+    """LWW order over ``f"{ts}{site}"`` version ids ("" is "no version
+    yet"): ts is a bounded single digit, so plain lexicographic string
+    order compares timestamps first and breaks ties deterministically
+    by site id."""
+    return max(a, b)
+
+
+def build(deep: bool = False) -> Model:
+    init = {
+        # all versions ever written at each site, in write order — the
+        # worker's scan order (bloom + listing in the implementation)
+        "hist": {"A": [], "B": []},
+        # durable version sets + LWW latest pointer per site
+        "store": {"A": set(), "B": set()},
+        "latest": {"A": "", "B": ""},
+        "writes_left": {"A": 2 if deep else 1, "B": 1},
+        # per direction: in-memory cursor (dies with the worker),
+        # durable checkpoint, monotone count of peer-acknowledged
+        # versions, the wire message, and the worker run state
+        "cursor": {"AB": 0, "BA": 0},
+        "ckpt": {"AB": 0, "BA": 0},
+        "acked": {"AB": 0, "BA": 0},
+        "wire": {"AB": (), "BA": ()},   # () | ("sent", v) | ("applied", v)
+        "worker": {"AB": "run", "BA": "run"},
+        "site_up": {"A": True, "B": True},
+        "crashes_left": 2 if deep else 1,
+        "kills_left": 1,
+        "resyncs_left": 1,
+    }
+    m = Model("georep", init,
+              "active-active geo-replication: enqueue/push/ack/retry/"
+              "resync with worker crashes and peer kills")
+
+    # -- client writes ------------------------------------------------------
+    for site in ("A", "B"):
+        def can_put(s, site=site) -> bool:
+            return s["writes_left"][site] > 0 and s["site_up"][site]
+
+        def do_put(s, site=site) -> None:
+            # ts is the site-local write count: concurrent writes at
+            # both sites TIE on ts and exercise the site-id tiebreak
+            s["writes_left"][site] -= 1
+            v = "%d%s" % (len(s["hist"][site]) + 1, site)
+            s["hist"][site].append(v)
+            s["store"][site].add(v)
+            s["latest"][site] = _lww_max(s["latest"][site], v)
+
+        m.action(f"put_{site}", can_put)(do_put)
+
+    # -- the push/apply/ack/retry cycle, per direction ----------------------
+    for d, src, dst in DIRS:
+        def can_push(s, d=d, src=src) -> bool:
+            return (s["worker"][d] == "run" and s["site_up"][src]
+                    and not s["wire"][d]
+                    and s["cursor"][d] < len(s["hist"][src]))
+
+        def do_push(s, d=d, src=src) -> None:
+            s["wire"][d] = ("sent", s["hist"][src][s["cursor"][d]])
+
+        m.action(f"push_{d}", can_push)(do_push)
+
+        def can_apply(s, d=d, dst=dst) -> bool:
+            w = s["wire"][d]
+            return bool(w) and w[0] == "sent" and s["site_up"][dst]
+
+        def do_apply(s, d=d, dst=dst) -> None:
+            # LWW merge: union the version, never regress latest —
+            # re-applying an already-acked version is a no-op
+            v = s["wire"][d][1]
+            s["store"][dst].add(v)
+            s["latest"][dst] = _lww_max(s["latest"][dst], v)
+            s["wire"][d] = ("applied", v)
+
+        m.action(f"apply_{d}", can_apply)(do_apply)
+
+        def can_ack(s, d=d, src=src) -> bool:
+            w = s["wire"][d]
+            return (bool(w) and w[0] == "applied"
+                    and s["worker"][d] == "run" and s["site_up"][src])
+
+        def do_ack(s, d=d) -> None:
+            s["wire"][d] = ()
+            s["cursor"][d] += 1
+            s["acked"][d] = max(s["acked"][d], s["cursor"][d])
+
+        m.action(f"ack_{d}", can_ack)(do_ack)
+
+        def can_fail(s, d=d, dst=dst) -> bool:
+            w = s["wire"][d]
+            return bool(w) and w[0] == "sent" and not s["site_up"][dst]
+
+        def do_fail(s, d=d) -> None:
+            # retryable: the send is lost, the cursor stays — the same
+            # version is pushed again once the peer is back
+            s["wire"][d] = ()
+
+        m.action(f"fail_{d}", can_fail)(do_fail)
+
+        def can_ckpt(s, d=d) -> bool:
+            return (s["worker"][d] == "run"
+                    and s["ckpt"][d] < s["cursor"][d])
+
+        def do_ckpt(s, d=d) -> None:
+            # durable save: records only acknowledged versions (the
+            # cursor-ahead-of-ack mutation records one more)
+            s["ckpt"][d] = s["cursor"][d]
+
+        m.action(f"checkpoint_{d}", can_ckpt)(do_ckpt)
+
+        def can_crash(s, d=d) -> bool:
+            return s["worker"][d] == "run" and s["crashes_left"] > 0
+
+        def do_crash(s, d=d) -> None:
+            # SIGKILL mid-anything: the in-memory cursor and the wire
+            # message die; the checkpoint and peer-applied state survive
+            s["crashes_left"] -= 1
+            s["worker"][d] = "crashed"
+            s["wire"][d] = ()
+
+        m.action(f"crash_{d}", can_crash)(do_crash)
+
+        def can_resume(s, d=d) -> bool:
+            return s["worker"][d] == "crashed"
+
+        def do_resume(s, d=d) -> None:
+            # resume from the durable checkpoint: at most the unacked
+            # suffix is re-pushed, and re-push is idempotent
+            s["worker"][d] = "run"
+            s["cursor"][d] = s["ckpt"][d]
+            s["wire"][d] = ()
+
+        m.action(f"resume_{d}", can_resume)(do_resume)
+
+        def can_resync(s, d=d) -> bool:
+            return s["worker"][d] == "run" and s["resyncs_left"] > 0
+
+        def do_resync(s, d=d) -> None:
+            # admin full resync: rewind to zero and re-push everything;
+            # idempotent applies make it safe at any time
+            s["resyncs_left"] -= 1
+            s["cursor"][d] = 0
+
+        m.action(f"resync_{d}", can_resync)(do_resync)
+
+    # -- peer kill / restart ------------------------------------------------
+    for site in ("A", "B"):
+        def can_kill(s, site=site) -> bool:
+            return s["kills_left"] > 0 and s["site_up"][site]
+
+        def do_kill(s, site=site) -> None:
+            # process kill: in-flight wire messages touching the site
+            # are lost (sent OR applied-but-unacked); durable stores,
+            # checkpoints and applied versions survive
+            s["kills_left"] -= 1
+            s["site_up"][site] = False
+            for d, src, dst in DIRS:
+                if site in (src, dst):
+                    s["wire"][d] = ()
+
+        m.action(f"kill_{site}", can_kill)(do_kill)
+
+        def can_restart(s, site=site) -> bool:
+            return not s["site_up"][site]
+
+        def do_restart(s, site=site) -> None:
+            s["site_up"][site] = True
+
+        m.action(f"restart_{site}", can_restart)(do_restart)
+
+    # -- invariants ---------------------------------------------------------
+    @m.invariant("no-version-lost")
+    def no_version_lost(s) -> bool:
+        """A site's own writes stay in its store, and every version the
+        worker counts as acknowledged is in the peer's store."""
+        for site in ("A", "B"):
+            for v in s["hist"][site]:
+                if v not in s["store"][site]:
+                    return False
+        for d, src, dst in DIRS:
+            for v in s["hist"][src][:s["acked"][d]]:
+                if v not in s["store"][dst]:
+                    return False
+        return True
+
+    @m.invariant("no-push-of-unacked-stale")
+    def no_unacked_skip(s) -> bool:
+        """The durable checkpoint never covers an unacknowledged
+        version — a version is only ever skipped as already-pushed
+        once its ack landed."""
+        return all(s["ckpt"][d] <= s["acked"][d] for d, _, _ in DIRS)
+
+    @m.invariant("lww-latest-is-max")
+    def lww_latest_is_max(s) -> bool:
+        """Each site's latest pointer is the LWW max of its version
+        set: an incoming stale apply never regresses it."""
+        for site in ("A", "B"):
+            want = ""
+            for v in s["store"][site]:
+                want = _lww_max(want, v)
+            if s["latest"][site] != want:
+                return False
+        return True
+
+    @m.terminal("lww-convergence")
+    def lww_convergence(s) -> bool:
+        """Quiescence: byte-identical version sets at both sites and an
+        agreed LWW-max latest."""
+        if set(s["store"]["A"]) != set(s["store"]["B"]):
+            return False
+        return s["latest"]["A"] == s["latest"]["B"]
+
+    # wedge-freedom: a quiescent state must have every direction fully
+    # delivered (crash/kill/retry must converge, never wedge)
+    m.done = lambda s: all(
+        s["cursor"][d] >= len(s["hist"][src]) for d, src, _ in DIRS)
+
+    # -- seeded mutations ---------------------------------------------------
+    @m.mutation("cursor-ahead-of-ack",
+                "the durable checkpoint records the in-flight version "
+                "before its ack landed — a crash+resume skips it and "
+                "the peer never receives the version")
+    def cursor_ahead(mut: Model) -> None:
+        for d, src, _ in DIRS:
+            def ckpt_ahead(s, d=d, src=src) -> None:
+                s["ckpt"][d] = min(s["cursor"][d] + 1,
+                                   len(s["hist"][src]))
+
+            mut.replace_action(
+                f"checkpoint_{d}",
+                guard=lambda s, d=d, src=src: s["worker"][d] == "run"
+                and s["ckpt"][d] <= s["cursor"][d] < len(s["hist"][src]),
+                effect=ckpt_ahead)
+
+    @m.mutation("resume-skips-inflight",
+                "a restarted worker resumes one past its checkpoint — "
+                "the in-flight version is treated as pushed and is "
+                "never delivered")
+    def resume_skips(mut: Model) -> None:
+        for d, src, _ in DIRS:
+            def resume_past(s, d=d, src=src) -> None:
+                s["worker"][d] = "run"
+                s["cursor"][d] = min(s["ckpt"][d] + 1,
+                                     len(s["hist"][src]))
+                s["wire"][d] = ()
+
+            mut.replace_action(f"resume_{d}", effect=resume_past)
+
+    @m.mutation("apply-clobbers-newer",
+                "the peer applies an incoming version as latest "
+                "unconditionally — a concurrent newer local write is "
+                "clobbered and LWW inverts")
+    def apply_clobbers(mut: Model) -> None:
+        for d, _, dst in DIRS:
+            def apply_clobber(s, d=d, dst=dst) -> None:
+                v = s["wire"][d][1]
+                s["store"][dst].add(v)
+                s["latest"][dst] = v  # no LWW max merge
+                s["wire"][d] = ("applied", v)
+
+            mut.replace_action(f"apply_{d}", effect=apply_clobber)
+
+    @m.mutation("retry-drops-on-failure",
+                "a send failing against a down peer is misclassified "
+                "permanent: the cursor advances and the version is "
+                "never pushed again")
+    def retry_drops(mut: Model) -> None:
+        for d, _, _ in DIRS:
+            def fail_drops(s, d=d) -> None:
+                s["wire"][d] = ()
+                s["cursor"][d] += 1  # dropped, not requeued
+
+            mut.replace_action(f"fail_{d}", effect=fail_drops)
+
+    @m.mutation("ack-before-apply",
+                "the peer acknowledges receipt before the apply lands "
+                "— a peer kill between the two loses the version while "
+                "the worker has already advanced past it")
+    def ack_before_apply(mut: Model) -> None:
+        for d, _, dst in DIRS:
+            def apply_skipped(s, d=d, dst=dst) -> None:
+                v = s["wire"][d][1]
+                s["wire"][d] = ("applied", v)  # acked, never stored
+
+            mut.replace_action(f"apply_{d}", effect=apply_skipped)
+
+    @m.mutation("breaker-never-recloses",
+                "the per-peer breaker never re-closes after a peer "
+                "kill: pushes stop forever and undelivered versions "
+                "wedge")
+    def breaker_wedges(mut: Model) -> None:
+        for d, src, _ in DIRS:
+            mut.replace_action(
+                f"push_{d}",
+                guard=lambda s, d=d, src=src: s["kills_left"] > 0
+                and s["worker"][d] == "run" and s["site_up"][src]
+                and not s["wire"][d]
+                and s["cursor"][d] < len(s["hist"][src]))
+
+    return m
+
+
+@register("georep")
+def factory(deep: bool = False) -> Model:
+    return build(deep=deep)

@@ -78,6 +78,7 @@ register_subsystem("qos", {
     "max_queue": "auto",
     "cost_unit": "",
     "max_cost": "",
+    "hot_share": "",
     "tenants": "{}",
 }, [
     HelpKV("enable",
@@ -102,9 +103,21 @@ register_subsystem("qos", {
     HelpKV("max_cost",
            "clamp on a single request's admission cost "
            "(empty = 32 default)", typ="number"),
+    HelpKV("hot_share",
+           "fraction of the hot (RAM-hit) lane one tenant may hold "
+           "(0.01..1; empty = 0.5 default)", typ="number"),
     HelpKV("tenants",
            'JSON tenant rules: {"bucket:<name>"|"key:<access-key>": '
            '{"weight": w, "max_concurrency": c, "bandwidth": bps}}'),
+], dynamic=True)
+
+register_subsystem("slo", {
+    "enable": "off",
+}, [
+    HelpKV("enable",
+           "closed-loop SLO plane (per-class latency/outcome "
+           "accounting + error-budget burn); MINIO_TPU_SLO=1/0 "
+           "overrides", typ="boolean"),
 ], dynamic=True)
 
 register_subsystem("audit_kafka", {

@@ -138,6 +138,10 @@ class PeerNotifier:
     def reload_site_config(self) -> None:
         self._broadcast("peer.reload_site_config", {})
 
+    def georep_nudge(self) -> None:
+        """Wake every node's geo-replication workers (admin resync)."""
+        self._broadcast("peer.georep_nudge", {})
+
     # ---------------------------------------------------------------- signals
     def signal_service(self, sig: str) -> dict[str, object]:
         """'stop-services' | 'start-services' | 'reload' fan-out
@@ -277,6 +281,12 @@ def register_peer_rpc(router, s3_server, node=None) -> None:
         site = getattr(s3_server, "site", None)
         if site is not None and hasattr(site, "reload"):
             site.reload()
+        return {}
+
+    def georep_nudge(args, body):
+        g = getattr(s3_server, "georep", None)
+        if g is not None and hasattr(g, "nudge"):
+            g.nudge()
         return {}
 
     # ---------------------------------------------------------------- info
@@ -506,6 +516,7 @@ def register_peer_rpc(router, s3_server, node=None) -> None:
         "peer.reload_iam": reload_iam,
         "peer.reload_tier_config": reload_tier_config,
         "peer.reload_site_config": reload_site_config,
+        "peer.georep_nudge": georep_nudge,
         "peer.server_info": server_info,
         "peer.local_storage_info": local_storage_info,
         "peer.local_disk_ids": local_disk_ids,

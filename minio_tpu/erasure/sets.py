@@ -724,6 +724,17 @@ class ErasureServerPools:
         # cursor is an entry the verification sweep re-lists and moves.
         return pool.delete_object(bucket, obj, version_id, versioned, suspended)
 
+    def put_delete_marker(self, bucket, obj, version_id, mod_time) -> None:
+        """Replay a delete marker with its id + mod time pinned (decom
+        move_version, georep apply).  Same routing rule as
+        delete_object: the marker must shadow its versions within the
+        OWNING pool, falling back to the deterministic marker pool for
+        an object this deployment never held."""
+        if not self.bucket_exists(bucket):
+            raise errors.BucketNotFound(bucket)
+        pool = self._pool_of(bucket, obj) or self._marker_pool(bucket, obj)
+        pool.put_delete_marker(bucket, obj, version_id, mod_time)
+
     def heal_object(self, bucket, obj, version_id="", deep=False) -> HealResult:
         for p in self.pools:
             res = p.heal_object(bucket, obj, version_id, deep)

@@ -178,6 +178,18 @@ class AdminMixin:
                    wrap(self.admin_site_apply, "SiteReplicationOperation"))
         r.add_post(f"{p}/site-replication/resync",
                    wrap(self.admin_site_resync, "SiteReplicationResync"))
+        # geo-replication of object data (ISSUE 16, services/georep.py):
+        # the apply channel peer pushes arrive on, live status, and the
+        # per-peer cursor-reset resync — gated MINIO_TPU_GEOREP (status
+        # answers {"enabled": false} when off, like /slo)
+        r.add_post(f"{p}/georep/apply",
+                   wrap(self.admin_georep_apply,
+                        "SiteReplicationOperation"))
+        r.add_get(f"{p}/georep/status",
+                  wrap(self.admin_georep_status, "SiteReplicationInfo"))
+        r.add_post(f"{p}/georep/resync",
+                   wrap(self.admin_georep_resync,
+                        "SiteReplicationResync"))
         # config KVS (reference cmd/admin-handlers-config-kv.go:
         # GetConfigKVHandler / SetConfigKVHandler / DelConfigKVHandler /
         # HelpConfigKVHandler)
@@ -193,6 +205,9 @@ class AdminMixin:
         # (config-persisted through the dynamic `qos` subsystem)
         r.add_get(f"{p}/qos", wrap(self.admin_qos_get, "ServerInfo"))
         r.add_put(f"{p}/qos", wrap(self.admin_qos_set, "ConfigUpdate"))
+        # SLO gate flip (ISSUE 16 satellite): PUT flips the plane live
+        # like QoS; GET is registered with the SLO status route below
+        r.add_put(f"{p}/slo", wrap(self.admin_slo_set, "ConfigUpdate"))
 
     # ---------------------------------------------------------------- auth
     #: admin ops whose duration is the CLIENT's choice (live follows,
@@ -205,6 +220,10 @@ class AdminMixin:
         async def handler(request: web.Request) -> web.StreamResponse:
             t0 = time.monotonic()
             status = 500
+            # SLO plane captured at request start, like _handle: a
+            # runtime gate flip mid-op records against the plane that
+            # watched the op begin (ISSUE 16 satellite)
+            slo = getattr(self, "slo", None)
             try:
                 body = await request.read()
                 await self._admin_auth(request, body, op)
@@ -228,7 +247,6 @@ class AdminMixin:
                 # admin ops bypass _handle's funnel, so the SLO plane's
                 # ADMIN class records here (server/slo.py, ISSUE 15);
                 # slo.record itself skips 499
-                slo = getattr(self, "slo", None)
                 if slo is not None and op not in self._SLO_EXEMPT_OPS:
                     slo.record(f"admin_{op}", status,
                                time.monotonic() - t0)
@@ -296,6 +314,68 @@ class AdminMixin:
             out = await self._run(self.site.resync, name, tracker, full)
         except KeyError:
             raise S3Error("InvalidArgument", f"no such peer {name!r}")
+        return self._json(out)
+
+    # ------------------------------------------- geo-replication (data)
+    async def admin_georep_apply(self, request: web.Request,
+                                 body: bytes):
+        """Receiving end of object-data pushes (services/georep.py):
+        applies version batches with propagation suppressed and
+        answers per-item applied/already/stale results — the sender's
+        ACK.  With the gate off the push bounces 503 (retryable at the
+        sender: the peer may enable geo-replication later, and the
+        sender's breaker owns the backoff meanwhile)."""
+        georep = getattr(self, "georep", None)
+        if georep is None:
+            raise S3Error("SlowDown",
+                          "geo-replication is disabled on this site "
+                          "(MINIO_TPU_GEOREP)")
+        try:
+            doc = json.loads(body)
+        except ValueError:
+            raise S3Error("InvalidArgument", "body must be JSON")
+        try:
+            out = await self._run(georep.apply, doc)
+        except ValueError as e:
+            raise S3Error("InvalidArgument", str(e))
+        except Exception as e:
+            raise S3Error("InternalError", str(e))
+        return self._json(out)
+
+    async def admin_georep_status(self, request: web.Request,
+                                  body: bytes):
+        """Per-peer push-queue status: cursor, breaker state, worker
+        liveness and process-lifetime totals.  ``{"enabled": false}``
+        with the gate off (the /slo idiom — only this new endpoint
+        admits the gate state)."""
+        georep = getattr(self, "georep", None)
+        if georep is None:
+            return web.json_response({"enabled": False})
+        return self._json(await self._run(georep.status))
+
+    async def admin_georep_resync(self, request: web.Request,
+                                  body: bytes):
+        """Reset one peer's push cursor so the next sweep re-walks the
+        namespace (idempotent re-pushes converge a peer that lost
+        data); nudges this node's workers and broadcasts the nudge to
+        cluster siblings."""
+        georep = getattr(self, "georep", None)
+        if georep is None:
+            raise S3Error("InvalidArgument",
+                          "geo-replication is disabled "
+                          "(MINIO_TPU_GEOREP)")
+        name = request.rel_url.query.get("peer", "")
+        if not name:
+            raise S3Error("InvalidArgument", "peer query param required")
+        full = request.rel_url.query.get("full", "true").lower() \
+            in ("1", "true", "yes")
+        try:
+            out = await self._run(georep.resync, name, full)
+        except KeyError:
+            raise S3Error("InvalidArgument", f"no such peer {name!r}")
+        peers = getattr(self, "peers", None)
+        if peers is not None and hasattr(peers, "georep_nudge"):
+            peers.georep_nudge()
         return self._json(out)
 
     # ----------------------------------------------------------- speedtest
@@ -864,6 +944,38 @@ class AdminMixin:
                               "seconds")
         doc = await self._run(plane.status, window, True)
         return web.json_response(doc)
+
+    async def admin_slo_set(self, request: web.Request,
+                            body: bytes) -> web.Response:
+        """Flip the SLO gate at runtime (ISSUE 16 satellite): persisted
+        through the dynamic `slo` config subsystem, applied live by
+        S3Server._apply_slo_config — the QoS-gate idiom.  In-flight
+        requests record against the plane captured at their start.
+        Note MINIO_TPU_SLO env, when set, pins the gate and wins over
+        this knob (gate_enabled precedence)."""
+        from minio_tpu.config import ConfigError
+
+        try:
+            doc = json.loads(body) if body else {}
+            if not isinstance(doc, dict):
+                raise ValueError("body must be a JSON object")
+        except ValueError:
+            raise S3Error("InvalidArgument", "malformed JSON body")
+        if "enable" not in doc:
+            raise S3Error("InvalidArgument",
+                          'nothing to set: provide {"enable": bool}')
+        # strict bool: '"off"'/'"false"' strings are truthy in Python
+        # and would silently flip the gate ON (the QoS-admin rule)
+        if not isinstance(doc["enable"], bool):
+            raise S3Error("InvalidArgument",
+                          "enable must be a JSON boolean")
+        kvs = {"enable": "on" if doc["enable"] else "off"}
+        try:
+            await self._run(self.config.set_kv, "slo", kvs)
+        except ConfigError as e:
+            raise S3Error("InvalidArgument", str(e))
+        plane = getattr(self, "slo", None)
+        return self._json({"enabled": plane is not None})
 
     async def admin_console_log(self, request: web.Request,
                                 body: bytes) -> web.StreamResponse:

@@ -388,7 +388,8 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         # identical to before (pinned by tests/test_slo.py).
         from .slo import SloPlane
 
-        self.slo = SloPlane.from_env()
+        self.slo = SloPlane.from_config(self.config)
+        self.config.on_change("slo", self._apply_slo_config)
         # Dedicated pool sized to the request semaphore so a full house of
         # blocking object-layer calls can never starve body-feed tasks
         # (reference analogue: maxClients semaphore, cmd/handler-api.go:108).
@@ -399,6 +400,19 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         from minio_tpu.services.site import SiteReplicationSys
 
         self.site = SiteReplicationSys(object_layer, self.meta, self.iam)
+        # geo-replication of object DATA (ISSUE 16, services/georep.py):
+        # per-peer push queues over the site plane's peer registry.
+        # Default OFF: self.georep is None and the server is byte- and
+        # metrics-identical (pinned by tests/test_georep.py).
+        from minio_tpu.services.georep import GeoRepSys
+
+        self.georep = GeoRepSys.from_env(object_layer, self.site)
+        if self.georep is not None:
+            from minio_tpu.erasure.objects import add_ns_update_hook
+
+            # a local write nudges the push workers through the same
+            # ns_updated choke point that feeds hot tier/metacache/bloom
+            add_ns_update_hook(object_layer, self.georep.on_ns_update)
         eq = _event_queue_dir(object_layer)
         log.init_audit(queue_dir=os.path.join(os.path.dirname(eq), "audit")
                        if eq else None, config=self.config)
@@ -446,6 +460,11 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             except Exception:
                 pass
             self.services = None
+        if self.georep is not None:
+            try:
+                self.georep.close()
+            except Exception:
+                pass
         try:
             self.site.close()
         except Exception:
@@ -512,6 +531,8 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         mc = getattr(self.api, "_metacache", None)
         if mc is not None:
             add_ns_update_hook(self.api, mc.on_ns_update)
+        if self.georep is not None:
+            add_ns_update_hook(self.api, self.georep.on_ns_update)
         svcs = self.services
         if svcs is not None:
             svcs._attach_heal_queue()
@@ -521,6 +542,11 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         admin plane can reach it (reference: serverMain starting
         initAutoHeal/initHealMRF/initDataScanner, cmd/server-main.go:528)."""
         self.services = services
+        if services is not None and self.georep is not None:
+            # steady-state delta discovery rides the scanner's bloom
+            # change tracker (first sweep is full regardless)
+            self.georep.attach_tracker(
+                getattr(services, "tracker", None))
         if services is not None and getattr(services, "tier", None) is None:
             from minio_tpu.services.tier import TierManager
 
@@ -844,6 +870,21 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
 
         loop.call_soon_threadsafe(install)
 
+    def _apply_slo_config(self, cfg) -> None:
+        """Dynamic `slo` subsystem apply (admin PUT /minio/admin/v3/slo
+        or set-config-kv): the gate flips at runtime like QoS.  Requests
+        record against the plane captured at THEIR start (_handle /
+        _admin_wrap), so a flip mid-request neither loses the sample to
+        a vanished plane nor seeds a fresh plane with pre-flip time.
+        No slot seeding is needed — the SLO plane only observes."""
+        from .slo import SloPlane
+
+        if not SloPlane.gate_enabled(cfg):
+            self.slo = None
+            return
+        if self.slo is None:
+            self.slo = SloPlane.from_config(cfg)
+
     async def _qos_throttle(self, request: web.Request, n: int,
                             direction: str) -> None:
         """Meter `n` data-plane bytes (PUT-body ingest direction="in",
@@ -921,12 +962,18 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         cost = qos.cost_of(request)
         if qos.try_admit(tenant, cost):
             return True, None, None
-        if hot and not self.hot_sem.locked():
+        if hot and not self.hot_sem.locked() \
+                and qos.hot_lane_try(tenant):
             # same hot-lane economics as the legacy plane (RAM hits
             # spend no drive IOPs), with the re-probe after acquire;
             # admits and re-probe REJECTIONS both fold into per-tenant
             # stats so hit-ratio and shed counters stay honest under
-            # QoS (ISSUE 13 satellite)
+            # QoS (ISSUE 13 satellite).  hot_lane_try is the per-tenant
+            # cap (ISSUE 16 satellite): a tenant already holding its
+            # share of the lane falls through to normal QoS admission,
+            # so one tenant's flood of RAM hits can't crowd hot_sem
+            # itself — the slot claim is released on the reject path
+            # here and in _handle's finally on the served path
             await self.hot_sem.acquire()
             if self._hot_probe(request):
                 self._m_hot_lane.inc()
@@ -936,6 +983,7 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                     svcs.brownout.note_hot_bypass()
                 return True, self.hot_sem, None
             self.hot_sem.release()
+            qos.hot_lane_release(tenant)
             qos.note_hot_reject(tenant)
         try:
             fut, depth = qos.enqueue(tenant, cost)
@@ -1004,6 +1052,10 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         # root span carries tenant=, and stash the tenant for the
         # data-path bandwidth metering (put_object/_pump_stream)
         qos = self.qos
+        # SLO plane captured at request START: a runtime gate flip
+        # mid-request must record this request against the plane that
+        # watched it begin, not whatever the flip installed
+        slo = self.slo
         tenant = None
         qos_admitted = False
         if qos is not None:
@@ -1162,6 +1214,10 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                     qos.release(tenant)
                 else:
                     lane.release()
+                    if qos is not None and lane is self.hot_sem:
+                        # hand back the per-tenant hot-lane slot the
+                        # admit claimed (ISSUE 16 satellite)
+                        qos.hot_lane_release(tenant)
                     if qos is None and lane is self.sem:
                         self._sem_held -= 1
                         qos_now = self.qos
@@ -1179,7 +1235,6 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             self._m_inflight.dec()
             self.record_api(api, status, dt,
                             rx=request.content_length or 0, tx=tx)
-            slo = self.slo
             if slo is not None:
                 # outcome vs the class objective; the tenant label (QoS
                 # on) buys the per-tenant split in /minio/admin/v3/slo

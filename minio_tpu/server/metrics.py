@@ -570,6 +570,68 @@ class MetricsMixin:
         except Exception:
             pass
 
+        # geo-replication of object data (services/georep.py): push
+        # economics, LWW conflict outcomes, and the per-peer breaker —
+        # presence-guarded on the MINIO_TPU_GEOREP gate so a gated-off
+        # server's scrape stays byte-identical to the seed
+        try:
+            georep = getattr(self, "georep", None)
+            if georep is not None:
+                from minio_tpu.services import georep as _georep
+
+                with _georep._stats_mu:
+                    gs = dict(_georep.stats)
+                gauge("minio_georep_pushed_objects_total",
+                      "Objects acked by a geo-replication peer",
+                      gs["pushed_objects"])
+                gauge("minio_georep_pushed_versions_total",
+                      "Object versions acked by a geo-replication peer",
+                      gs["pushed_versions"])
+                gauge("minio_georep_pushed_bytes_total",
+                      "Object payload bytes pushed to geo-replication "
+                      "peers", gs["pushed_bytes"])
+                gauge("minio_georep_applied_total",
+                      "Incoming geo-replication versions applied "
+                      "locally", gs["applied"])
+                gauge("minio_georep_already_total",
+                      "Incoming geo-replication versions already "
+                      "present (idempotent re-push)", gs["already"])
+                gauge("minio_georep_stale_dropped_total",
+                      "Incoming versions dropped by last-writer-wins",
+                      gs["stale_dropped"])
+                gauge("minio_georep_failed_retryable_total",
+                      "Push attempts that failed retryably and were "
+                      "re-queued", gs["failed_retryable"])
+                gauge("minio_georep_failed_permanent_total",
+                      "Per-item pushes rejected permanently by a peer",
+                      gs["failed_permanent"])
+                gauge("minio_georep_breaker_opens_total",
+                      "Times a per-peer geo-replication breaker "
+                      "opened", gs["breaker_opens"])
+                gauge("minio_georep_breaker_short_circuits_total",
+                      "Sweeps skipped because a peer breaker was open",
+                      gs["breaker_short_circuits"])
+                gauge("minio_georep_sweeps_total",
+                      "Geo-replication delta sweeps completed",
+                      gs["sweeps"])
+                gauge("minio_georep_lane_waits_total",
+                      "Pushes delayed by the inter-site bandwidth "
+                      "lane", gs["lane_waits"])
+                brows = ["# HELP minio_georep_peer_breaker_open 1 "
+                         "while the peer's push breaker is open",
+                         "# TYPE minio_georep_peer_breaker_open gauge"]
+                emit = False
+                for name, br in list(georep._breakers.items()):
+                    lbl = _fmt_labels(("peer",), (name,))
+                    brows.append(
+                        "minio_georep_peer_breaker_open"
+                        f"{lbl} {1 if br.state() == 'open' else 0}")
+                    emit = True
+                if emit:
+                    g("\n".join(brows) + "\n")
+        except Exception:
+            pass
+
         # multi-process data plane (parallel/workers.py): job/commit
         # volume through the worker plane plus its supervision health —
         # workerDeaths counts in-flight-failing deaths, restarts counts

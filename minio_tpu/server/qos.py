@@ -129,8 +129,8 @@ class _TenantState:
 
     __slots__ = ("key", "rule", "queue", "inflight", "deficit",
                  "admitted", "shed_full", "shed_deadline", "hot_admits",
-                 "hot_rejects", "throttled_in", "throttled_out", "bw",
-                 "last_active")
+                 "hot_rejects", "hot_inflight", "hot_capped",
+                 "throttled_in", "throttled_out", "bw", "last_active")
 
     def __init__(self, key: str, rule: TenantRule):
         self.key = key
@@ -143,6 +143,8 @@ class _TenantState:
         self.shed_deadline = 0
         self.hot_admits = 0
         self.hot_rejects = 0
+        self.hot_inflight = 0   # hot-lane slots this tenant HOLDS
+        self.hot_capped = 0     # hot-lane claims refused at the cap
         self.throttled_in = 0
         self.throttled_out = 0
         self.bw = TokenBucket(rule.bandwidth) if rule.bandwidth > 0 \
@@ -192,6 +194,15 @@ class QosPlane:
         # one tenant's backlog finite)
         self.max_queue = int(max_queue) if max_queue > 0 \
             else max(16, 2 * self.max_concurrency)
+        # per-tenant hot-lane cap (ISSUE 16 satellite): the hot lane
+        # (app.hot_sem, sized max(max_concurrency, 4) * 2) is a SHARED
+        # pool — without a per-tenant bound a hot-tenant flood of RAM
+        # hits crowds the lane itself and other tenants' hits queue
+        # behind drive-bound work.  Each tenant may hold at most
+        # hot_share of the lane; at-cap claims fall through to normal
+        # QoS admission (counted hotLaneCapped).
+        self.hot_capacity = max(self.max_concurrency, 4) * 2
+        self.hot_share = 0.5
         self.monitor = BandwidthMonitor()
         self._mu = threading.Lock()
         self._tenants: dict[str, _TenantState] = {}
@@ -278,15 +289,19 @@ class QosPlane:
         mc_raw = knob("MINIO_TPU_QOS_MAX_COST", "max_cost")
         max_cost = None if mc_raw in ("", None) \
             else max(num(mc_raw, DEFAULT_MAX_COST), 1.0)
+        hs_raw = knob("MINIO_TPU_QOS_HOT_SHARE", "hot_share")
+        hot_share = None if hs_raw in ("", None) \
+            else min(max(num(hs_raw, 0.5), 0.01), 1.0)
         self.reconfigure(default_rule=default, rules=rules,
                          max_queue=max_queue, cost_unit=cost_unit,
-                         max_cost=max_cost)
+                         max_cost=max_cost, hot_share=hot_share)
 
     def reconfigure(self, *, default_rule: TenantRule | None = None,
                     rules: dict[str, TenantRule] | None = None,
                     max_queue: int = 0,
                     cost_unit: int | None = None,
-                    max_cost: float | None = None) -> None:
+                    max_cost: float | None = None,
+                    hot_share: float | None = None) -> None:
         """Apply a new rule set atomically; live tenant states pick up
         their new weight/cap/bandwidth immediately (deficit clamped)."""
         with self._mu:
@@ -300,6 +315,8 @@ class QosPlane:
                 self.cost_unit = max(int(cost_unit), 0)
             if max_cost is not None and math.isfinite(float(max_cost)):
                 self.max_cost = max(float(max_cost), 1.0)
+            if hot_share is not None:
+                self.hot_share = min(max(float(hot_share), 0.01), 1.0)
             for st in self._tenants.values():
                 st.apply_rule(self.rules.get(st.key, self.default_rule))
             loop = self._loop
@@ -563,6 +580,7 @@ class QosPlane:
         self._last_gc = now
         for key in [k for k, t in self._tenants.items()
                     if not t.queue and t.inflight == 0
+                    and t.hot_inflight == 0
                     and now - t.last_active > IDLE_TTL_S]:
             del self._tenants[key]
 
@@ -597,6 +615,30 @@ class QosPlane:
             return self._active >= self.max_concurrency
 
     # -- hot-lane accounting (ISSUE 13 satellite) ----------------------------
+    def hot_cap(self) -> int:
+        """Per-tenant hot-lane slot bound: hot_share of the lane."""
+        return max(1, int(self.hot_capacity * self.hot_share))
+
+    def hot_lane_try(self, tenant: str) -> bool:
+        """Claim one per-tenant hot-lane slot (ISSUE 16 satellite).
+        False when the tenant already holds its share of the lane —
+        the request pays normal QoS admission instead, so one tenant's
+        flood of RAM hits can never crowd `hot_sem` itself and starve
+        other tenants' hits (counted hotLaneCapped)."""
+        with self._mu:
+            st = self._state_locked(tenant)
+            if st.hot_inflight >= self.hot_cap():
+                st.hot_capped += 1
+                return False
+            st.hot_inflight += 1
+            return True
+
+    def hot_lane_release(self, tenant: str) -> None:
+        with self._mu:
+            st = self._tenants.get(tenant)
+            if st is not None and st.hot_inflight > 0:
+                st.hot_inflight -= 1
+
     def note_hot_admit(self, tenant: str) -> None:
         with self._mu:
             self._state_locked(tenant).hot_admits += 1
@@ -649,6 +691,8 @@ class QosPlane:
                     "shedDeadline": st.shed_deadline,
                     "hotLaneAdmits": st.hot_admits,
                     "hotLaneRejections": st.hot_rejects,
+                    "hotLaneInflight": st.hot_inflight,
+                    "hotLaneCapped": st.hot_capped,
                     "throttledInBytes": st.throttled_in,
                     "throttledOutBytes": st.throttled_out,
                 }
@@ -657,6 +701,7 @@ class QosPlane:
                 "maxQueue": self.max_queue,
                 "costUnit": self.cost_unit,
                 "maxCost": self.max_cost,
+                "hotCapPerTenant": self.hot_cap(),
                 "active": self._active,
                 "deficitRounds": self._rounds,
                 "defaults": self.default_rule.to_dict(),

@@ -263,10 +263,34 @@ class SloPlane:
         self.recorded = 0
 
     # ------------------------------------------------------------- gate
+    @staticmethod
+    def gate_enabled(config=None, environ=None) -> bool:
+        """MINIO_TPU_SLO env wins; else the ``slo.enable`` config key —
+        the same env-over-config precedence as the QoS gate, so the
+        admin PUT flips the plane live only where the operator didn't
+        pin it (ISSUE 16 satellite)."""
+        env = os.environ if environ is None else environ
+        v = env.get("MINIO_TPU_SLO")
+        if v is not None:
+            return v.strip().lower() in _TRUTHY
+        if config is None:
+            return False
+        return config.get_bool("slo", "enable", False)
+
+    @classmethod
+    def from_config(cls, config, environ=None) -> "SloPlane | None":
+        if not cls.gate_enabled(config, environ):
+            return None
+        return cls._build()
+
     @classmethod
     def from_env(cls) -> "SloPlane | None":
         if os.environ.get("MINIO_TPU_SLO", "0").lower() not in _TRUTHY:
             return None
+        return cls._build()
+
+    @classmethod
+    def _build(cls) -> "SloPlane":
 
         def _f(name: str, default: float, lo: float, hi: float) -> float:
             try:

@@ -576,6 +576,13 @@ class PoolRebalance:
             "MINIO_TPU_DECOM_OBJ_TIMEOUT_S", "120"))
         self.retries = max(0, int(os.environ.get(
             "MINIO_TPU_DECOM_RETRIES", "3")))
+        self.checkpoint_every = max(1, int(os.environ.get(
+            "MINIO_TPU_DECOM_CHECKPOINT_EVERY", "32")))
+        # test-only crash injection, same contract as the drain's hook:
+        # fn(moved_objects) -> True kills the rebalance thread without
+        # a final save (simulated SIGKILL mid-donation)
+        self._crash_hook = None
+        self._since_ckpt = 0
 
     def _save(self) -> None:
         self.state["degraded"] = False
@@ -617,9 +624,16 @@ class PoolRebalance:
     def start(self) -> None:
         if self.state.get("state") == "running":
             raise errors.InvalidArgument("rebalance already running")
+        # a restart after a mid-donation crash resumes the namespace
+        # walk from the quorum-persisted per-donor cursors instead of
+        # replaying every bucket scan from the top; anything else
+        # (fresh start, completed run) scans from scratch
+        cursors = dict(self.state.get("cursors") or {}) \
+            if self.state.get("state") == "interrupted" else {}
         self.state = {"state": "running", "started": time.time(),
                       "moved_objects": 0, "moved_bytes": 0,
                       "failed_objects": 0, "throttle_waits": 0,
+                      "cursors": cursors,
                       "seq": int(self.state.get("seq", 0))}
         self._save()
         self._stop.clear()
@@ -677,6 +691,12 @@ class PoolRebalance:
                         break
                 self.state["state"] = "complete"
                 self.state["finished"] = time.time()
+                # converged: drop resume cursors so a future rebalance
+                # walks the (changed) namespace from the top
+                self.state.pop("cursors", None)
+            except _DrainKilled:
+                status = 500
+                return  # crash injection: NO save (simulated SIGKILL)
             except Exception as e:
                 self.state["state"] = "failed"
                 self.state["error"] = str(e)
@@ -700,12 +720,28 @@ class PoolRebalance:
         # erasure overhead: logical bytes land ~N/K larger on disk
         overhead = 2.0
         suspended = self.pools.topology.suspended()
-        for vol in src.list_buckets():
+        # object-granular resume: the quorum-persisted cursor records
+        # the last FULLY donated object (all versions moved, source
+        # deletes landed), so a killed rebalance restarts its walk
+        # right after it instead of replaying the whole bucket scan
+        cursors = self.state.setdefault("cursors", {})
+        cur = cursors.get(str(idx)) or {}
+        for vol in sorted(src.list_buckets(), key=lambda v: v.name):
             bucket = vol.name
+            if cur and bucket < cur.get("bucket", ""):
+                continue  # donor walked past this bucket pre-crash
+            start_after = cur.get("obj", "") \
+                if cur.get("bucket") == bucket else ""
             for entry in src.list_entries(bucket):
                 if self._stop.is_set() or donated >= budget:
                     return moved > 0
+                name = entry.name
+                if start_after and name <= start_after:
+                    continue  # already donated before the crash
                 self._throttle_wait()
+                if self._crash_hook is not None \
+                        and self._crash_hook(self.state["moved_objects"]):
+                    raise _DrainKilled()
                 tgt_i = min(
                     (i for i in range(len(est)) if i != idx
                      and i not in suspended),
@@ -717,7 +753,7 @@ class PoolRebalance:
                     obj_bytes = 0
                     for oi in reversed(entry.versions):
                         with scope(Budget(self.obj_timeout)):
-                            move_version(src, target, bucket, entry.name,
+                            move_version(src, target, bucket, name,
                                          oi)
                         self.state["moved_objects"] += 1
                         self.state["moved_bytes"] += max(oi.size, 0)
@@ -730,6 +766,14 @@ class PoolRebalance:
                     continue  # deleted mid-rebalance: nothing to move
                 except Exception:
                     self.state["failed_objects"] += 1
+                    continue  # cursor stays put: a restart retries it
+                cursors[str(idx)] = {"bucket": bucket, "obj": name}
+                self._since_ckpt += 1
+                if self._since_ckpt >= self.checkpoint_every:
+                    self._since_ckpt = 0
+                    self._save()
+        # full namespace walked: a future rebalance starts fresh
+        cursors.pop(str(idx), None)
         return moved > 0
 
 

@@ -1,0 +1,758 @@
+"""Active-active geo-replication of object DATA across site peers.
+
+Reference: cmd/bucket-replication*.go + cmd/site-replication.go — the
+reference replicates object payloads between clusters with per-target
+queues, MRF-style retry classification, and resumable resync.  Our
+site plane (services/site.py, PR 14) converges buckets/IAM/config
+only; this module closes ROADMAP item 3's payload gap: every object
+VERSION written to one cluster converges to every site peer, and a
+kill at any point — source worker, peer, mid-push, mid-ack — resumes
+without losing or duplicating versions.
+
+Protocol (modeled FIRST in analysis/concurrency/models/georep.py;
+invariants no-version-lost, no-push-of-unacked-stale, lww-latest-is-
+max, lww-convergence, wedge-freedom — six seeded mutations all yield
+counterexamples):
+
+* **discover** — a per-peer sweep worker walks the local namespace.
+  The first sweep pushes everything; after that the bloom change
+  tracker (utils/bloom.py) proves untouched buckets CLEAN and the
+  sweep skips them (false negatives are impossible by the filter's
+  contract, false positives re-push harmlessly: apply is idempotent).
+  The ns_updated choke point nudges the workers so a write is pushed
+  within one wakeup, not one interval.
+* **push** — object versions batch into signed POSTs to the peer's
+  ``/minio/admin/v3/georep/apply`` endpoint, paced by a per-peer
+  inter-site bandwidth lane (utils/bandwidth.TokenBucket — the QoS
+  token-bucket machinery generalized to site links).
+* **ack / cursor** — the per-peer cursor (last fully-ACKed object)
+  advances only after the peer's 200 landed, and is quorum-persisted
+  on the first pool's drives (``georep-<peer>.json``, decom's
+  seq-versioned load_state/save_state) every ``checkpoint_every``
+  objects.  A killed worker resumes AFTER the last checkpoint and
+  re-pushes at most the un-checkpointed window — the model's
+  cursor-ahead-of-ack and resume-skips-inflight mutations are exactly
+  the orderings this rules out.
+* **retry / breaker** — failures classify MRF-style: *gone* (version
+  deleted locally mid-push) is not a failure, *permanent* (the peer
+  rejected the item) is counted and skipped, *retryable* (peer down,
+  5xx, timeout) leaves the cursor where it is and trips the per-peer
+  breaker after ``breaker_threshold`` consecutive failures — an open
+  breaker half-opens after ``breaker_cooldown_s`` so a returned peer
+  converges without ever having been hammered while down.
+* **apply (receive)** — versioned ids are identity: a version the
+  destination already holds answers ``already`` (idempotent re-push),
+  otherwise it lands with version id + mod time + etag pinned.  Null
+  versions resolve by **last-writer-wins** on (mod_time, etag) —
+  mod-time first, etag as the deterministic tiebreak — and a LOSING
+  incoming write answers ``stale`` instead of clobbering (the model's
+  apply-clobbers-newer mutation).  Application runs with propagation
+  SUPPRESSED (services/site._Suppressed) so a push can never echo
+  back across sites.
+
+Gated by ``MINIO_TPU_GEOREP`` (default off): ``S3Server.georep`` is
+None, no workers, no ``minio_georep_*`` metric families, and the S3
+surface is byte- and metrics-identical (pinned by
+tests/test_georep.py's gate-off differential).
+
+Knobs: ``MINIO_TPU_GEOREP_INTERVAL_S`` (sweep period, default 5),
+``MINIO_TPU_GEOREP_CHECKPOINT_EVERY`` (objects per cursor save,
+default 16), ``MINIO_TPU_GEOREP_BATCH_BYTES`` / ``_BATCH_OBJECTS``
+(push batch bounds), ``MINIO_TPU_GEOREP_BANDWIDTH`` (per-peer
+bytes/sec lane, 0 = unlimited), ``MINIO_TPU_GEOREP_BREAKER_THRESHOLD``
+/ ``_BREAKER_COOLDOWN_S``, ``MINIO_TPU_GEOREP_MAX_INLINE`` (largest
+version pushed inline; bigger ones are counted ``skipped_large`` —
+an honest gap, not a silent one).
+"""
+
+from __future__ import annotations
+
+import base64
+import http.client
+import json
+import os
+import threading
+import time
+
+from minio_tpu.storage import errors
+from minio_tpu.utils import tracing
+from minio_tpu.utils.bandwidth import TokenBucket
+from minio_tpu.utils.deadline import service_thread
+from minio_tpu.utils.logger import log
+
+from .decom import _GONE, _classify, load_state, save_state
+from .site import _Suppressed, propagation_suppressed
+
+GEOREP_APPLY_PATH = "/minio/admin/v3/georep/apply"
+
+_TRUTHY = ("1", "on", "true", "yes")
+
+#: geo-replication counters rendered as minio_georep_* gauges
+#: (server/metrics.py); module-level so process-lifetime totals and
+#: admin status agree
+stats = {
+    "pushed_objects": 0,      # objects fully ACKed by a peer
+    "pushed_versions": 0,     # versions carried inside those pushes
+    "pushed_bytes": 0,        # payload bytes shipped (pre-base64)
+    "applied": 0,             # receive side: versions landed
+    "already": 0,             # receive side: idempotent re-push hits
+    "stale_dropped": 0,       # receive side: LWW losers not applied
+    "failed_retryable": 0,
+    "failed_permanent": 0,
+    "gone": 0,                # versions deleted locally mid-push
+    "skipped_clean_buckets": 0,
+    "skipped_large": 0,       # versions over the inline size bound
+    "breaker_opens": 0,
+    "breaker_short_circuits": 0,
+    "resyncs": 0,
+    "sweeps": 0,
+    "lane_waits": 0,          # pushes the bandwidth lane paced
+}
+_stats_mu = threading.Lock()
+
+
+def _bump(key: str, n: int = 1) -> None:
+    with _stats_mu:
+        stats[key] += n
+
+
+class _SweepKilled(BaseException):
+    """Test-only crash injection: the push worker dies WITHOUT saving
+    its cursor — the closest a thread can come to SIGKILL mid-push."""
+
+
+class _PeerBreaker:
+    """Consecutive-failure breaker per site peer: open after
+    `threshold` straight retryable failures, half-open (one probe
+    sweep allowed) after `cooldown_s`.  Same shape as utils.mrf's
+    breaker, scoped to an inter-site link."""
+
+    def __init__(self, threshold: int, cooldown_s: float):
+        self.threshold = max(1, int(threshold))
+        self.cooldown_s = float(cooldown_s)
+        self.failures = 0
+        self.open_until = 0.0
+        self.opens = 0
+
+    def allow(self) -> bool:
+        if self.failures < self.threshold:
+            return True
+        return time.monotonic() >= self.open_until  # half-open probe
+
+    def record_ok(self) -> None:
+        self.failures = 0
+        self.open_until = 0.0
+
+    def record_failure(self) -> None:
+        self.failures += 1
+        if self.failures >= self.threshold:
+            if self.open_until <= time.monotonic():
+                self.opens += 1
+                _bump("breaker_opens")
+            self.open_until = time.monotonic() + self.cooldown_s
+
+    def state(self) -> str:
+        if self.failures < self.threshold:
+            return "closed"
+        return "half-open" if time.monotonic() >= self.open_until \
+            else "open"
+
+
+class PushFailed(Exception):
+    """A batch POST that did not fully land (peer down / non-200):
+    the cursor stays put and the sweep ends — retryable by contract."""
+
+
+class GeoRepSys:
+    """Per-peer object-data push queue over the site-replication peer
+    registry.  One sweep worker + bandwidth lane + breaker PER PEER
+    (a down site must never stall convergence to healthy ones), one
+    supervisor thread that adopts peers added after boot."""
+
+    def __init__(self, api, site, environ=None):
+        env = os.environ if environ is None else environ
+        self.api = api
+        self.site = site              # peer registry + credentials
+        self.tracker = None           # bloom tracker, attach_tracker()
+        self.interval_s = _f(env, "MINIO_TPU_GEOREP_INTERVAL_S", 5.0)
+        self.checkpoint_every = max(1, _i(
+            env, "MINIO_TPU_GEOREP_CHECKPOINT_EVERY", 16))
+        self.batch_bytes = max(1, _i(
+            env, "MINIO_TPU_GEOREP_BATCH_BYTES", 1 << 20))
+        self.batch_objects = max(1, _i(
+            env, "MINIO_TPU_GEOREP_BATCH_OBJECTS", 16))
+        self.bandwidth = max(0, _i(env, "MINIO_TPU_GEOREP_BANDWIDTH", 0))
+        self.breaker_threshold = max(1, _i(
+            env, "MINIO_TPU_GEOREP_BREAKER_THRESHOLD", 3))
+        self.breaker_cooldown_s = _f(
+            env, "MINIO_TPU_GEOREP_BREAKER_COOLDOWN_S", 5.0)
+        self.max_inline = max(1, _i(
+            env, "MINIO_TPU_GEOREP_MAX_INLINE", 64 << 20))
+        self._stop = threading.Event()
+        self._mu = threading.Lock()
+        self._workers: dict[str, threading.Thread] = {}
+        self._nudges: dict[str, threading.Event] = {}
+        self._lanes: dict[str, TokenBucket | None] = {}
+        self._breakers: dict[str, _PeerBreaker] = {}
+        self._live: dict[str, dict] = {}   # per-peer live status fields
+        self._wake = threading.Event()     # supervisor wakeup
+        # test-only: fn(pushed_objects) -> True kills the sweep worker
+        # without a cursor save (crash injection for the chaos drill)
+        self._crash_hook = None
+        self._supervisor = service_thread(
+            self._supervise, name="georep-supervisor")
+
+    # ------------------------------------------------------------- gate
+    @staticmethod
+    def gate_enabled(environ=None) -> bool:
+        env = os.environ if environ is None else environ
+        return str(env.get("MINIO_TPU_GEOREP", "0")).lower() in _TRUTHY
+
+    @classmethod
+    def from_env(cls, api, site, environ=None) -> "GeoRepSys | None":
+        if not cls.gate_enabled(environ):
+            return None
+        return cls(api, site, environ)
+
+    def attach_tracker(self, tracker) -> None:
+        """Adopt the scanner's bloom change tracker so steady-state
+        sweeps skip buckets proven untouched."""
+        self.tracker = tracker
+
+    # -------------------------------------------------------- lifecycle
+    def on_ns_update(self, bucket: str, obj: str) -> None:
+        """ns_updated choke-point consumer: a local mutation nudges
+        every push worker.  No-op while propagation is suppressed — an
+        APPLIED push must not nudge a push back (the cross-site
+        feedback loop the site plane's contextvar exists to kill)."""
+        if propagation_suppressed():
+            return
+        self._wake.set()
+        for ev in list(self._nudges.values()):
+            ev.set()
+
+    def nudge(self) -> None:
+        self.on_ns_update("", "")
+
+    def _supervise(self) -> None:
+        """Adopt workers for every registered site peer; peers added
+        after boot get a worker within one interval (or one nudge)."""
+        while not self._stop.is_set():
+            try:
+                self._ensure_workers()
+            except Exception as e:
+                log.warning("georep supervisor", error=str(e))
+            self._wake.wait(self.interval_s)
+            self._wake.clear()
+
+    def _ensure_workers(self) -> None:
+        with self.site._mu:
+            names = list(self.site.peers)
+        for name in names:
+            with self._mu:
+                t = self._workers.get(name)
+                if t is not None and t.is_alive():
+                    continue
+                if name not in self._nudges:
+                    self._nudges[name] = threading.Event()
+                if name not in self._lanes:
+                    self._lanes[name] = TokenBucket(self.bandwidth) \
+                        if self.bandwidth > 0 else None
+                if name not in self._breakers:
+                    self._breakers[name] = _PeerBreaker(
+                        self.breaker_threshold, self.breaker_cooldown_s)
+                t = service_thread(self._worker, name, start=False,
+                                   name=f"georep-{name}")
+                self._workers[name] = t
+            t.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        for ev in list(self._nudges.values()):
+            ev.set()
+        if self._supervisor is not None:
+            self._supervisor.join(2)
+        with self._mu:
+            workers = list(self._workers.values())
+        for t in workers:
+            t.join(2)
+
+    # ----------------------------------------------------------- cursor
+    def _state_pool(self):
+        return self.api.pools[0]
+
+    def _load(self, peer_name: str) -> dict:
+        st = load_state(self._state_pool(), f"georep-{peer_name}.json")
+        if "initial_synced" not in st:
+            st = {"state": "new", "initial_synced": False,
+                  "done_buckets": [], "cursor": None,
+                  "pushed_objects": 0, "pushed_versions": 0, "seq": 0}
+        return st
+
+    def _save(self, peer_name: str, st: dict) -> None:
+        """Quorum-persist the cursor; a miss marks the peer's status
+        degraded instead of silently continuing unpersisted."""
+        st["degraded"] = not save_state(
+            self._state_pool(), st, f"georep-{peer_name}.json")
+
+    # ----------------------------------------------------------- worker
+    def _worker(self, peer_name: str) -> None:
+        ev = self._nudges[peer_name]
+        br = self._breakers[peer_name]
+        while not self._stop.is_set():
+            ev.wait(self.interval_s)
+            ev.clear()
+            if self._stop.is_set():
+                return
+            with self.site._mu:
+                peer = self.site.peers.get(peer_name)
+            if peer is None:
+                return  # peer removed: drop its worker
+            if not br.allow():
+                _bump("breaker_short_circuits")
+                self._set_live(peer_name, breaker=br.state())
+                continue
+            try:
+                self._sweep(peer)
+            except _SweepKilled:
+                return  # crash injection: NO cursor save
+            except PushFailed as e:
+                br.record_failure()
+                self._set_live(peer_name, breaker=br.state(),
+                               lastError=str(e))
+            except Exception as e:
+                br.record_failure()
+                _bump("failed_retryable")
+                log.warning("georep sweep failed", peer=peer_name,
+                            error=str(e))
+                self._set_live(peer_name, breaker=br.state(),
+                               lastError=str(e))
+
+    def _set_live(self, peer_name: str, **kv) -> None:
+        with self._mu:
+            self._live.setdefault(peer_name, {}).update(kv)
+
+    def _sweep(self, peer) -> None:
+        """One push sweep to one peer: full namespace on the first run,
+        bloom-filtered after; cursor-resumed within the in-flight
+        bucket."""
+        st = self._load(peer.name)
+        full = not st.get("initial_synced")
+        root = tracing.start("georep.sweep", peer=peer.name,
+                             full=bool(full))
+        token = tracing.install(root) if root is not None else None
+        t0 = time.monotonic()
+        status = 200
+        _bump("sweeps")
+        skipped = 0
+        try:
+            st["state"] = "syncing"
+            for vol in sorted(self.api.list_buckets(),
+                              key=lambda v: v.name):
+                bucket = vol.name
+                if self._stop.is_set():
+                    self._save(peer.name, st)
+                    return
+                if bucket in st["done_buckets"]:
+                    continue
+                if not full and self.tracker is not None \
+                        and not self.tracker.bucket_dirty(bucket):
+                    skipped += 1
+                    _bump("skipped_clean_buckets")
+                    continue
+                with tracing.span("georep.bucket", bucket=bucket,
+                                  peer=peer.name):
+                    self._sync_bucket(peer, bucket, st)
+                st["done_buckets"].append(bucket)
+                st["cursor"] = None
+                self._save(peer.name, st)
+            # sweep complete: from here on the bloom filter owns delta
+            # discovery, and the next sweep starts a fresh bucket walk
+            st["initial_synced"] = True
+            st["done_buckets"] = []
+            st["cursor"] = None
+            st["state"] = "idle"
+            st["last_sweep"] = time.time()
+            self._save(peer.name, st)
+            self._breakers[peer.name].record_ok()
+            self._set_live(peer.name, breaker="closed", lastError=None,
+                           skippedClean=skipped)
+        except PushFailed:
+            status = 503
+            # cursor stays where the last ACK left it; persist progress
+            # so a process kill during the outage resumes identically
+            st["state"] = "retrying"
+            self._save(peer.name, st)
+            raise
+        except _SweepKilled:
+            status = 500
+            raise  # crash injection: NO save (simulated SIGKILL)
+        finally:
+            if root is not None:
+                root.tag(skippedClean=skipped)
+                tracing.reset(token)
+                tracing.finish(root, status=status, error=status >= 500,
+                               duration=time.monotonic() - t0)
+
+    def _sync_bucket(self, peer, bucket: str, st: dict) -> None:
+        cur = st.get("cursor") or {}
+        start_after = cur.get("obj", "") if cur.get("bucket") == bucket \
+            else ""
+        batch: list[dict] = []
+        batch_names: list[str] = []
+        batch_bytes = 0
+        since_ckpt = 0
+
+        def flush() -> None:
+            nonlocal batch, batch_names, batch_bytes, since_ckpt
+            if not batch:
+                return
+            self._push_batch(peer, bucket, batch)
+            # the peer's 200 IS the ack: only now may the cursor pass
+            # these objects (the model's ack_{d} action — cursor+=1
+            # strictly after wire hit "applied")
+            st["cursor"] = {"bucket": bucket, "obj": batch_names[-1]}
+            st["pushed_objects"] += len(batch_names)
+            st["pushed_versions"] += len(batch)
+            _bump("pushed_objects", len(batch_names))
+            since_ckpt += len(batch_names)
+            batch, batch_names, batch_bytes = [], [], 0
+            if since_ckpt >= self.checkpoint_every:
+                since_ckpt = 0
+                self._save(peer.name, st)
+
+        for entry in self.api.list_entries(bucket):
+            if self._stop.is_set():
+                flush()
+                self._save(peer.name, st)
+                return
+            name = entry.name
+            if start_after and name <= start_after:
+                continue  # ACKed before the kill/restart
+            if self._crash_hook is not None \
+                    and self._crash_hook(st["pushed_objects"]):
+                raise _SweepKilled()
+            items, nbytes = self._object_items(bucket, entry)
+            if items:
+                batch.extend(items)
+                batch_names.append(name)
+                batch_bytes += nbytes
+            else:
+                # nothing pushable (all gone / over inline bound): the
+                # cursor may still pass it once prior pushes ACKed
+                if not batch:
+                    st["cursor"] = {"bucket": bucket, "obj": name}
+            if batch_bytes >= self.batch_bytes \
+                    or len(batch_names) >= self.batch_objects:
+                flush()
+        flush()
+        self._save(peer.name, st)
+
+    def _object_items(self, bucket: str, entry
+                      ) -> tuple[list[dict], int]:
+        """Wire items for every version of one object, oldest first so
+        the peer's xl.meta ordering (and is_latest) lands identically;
+        reads that race a local delete classify `gone` and drop out."""
+        items: list[dict] = []
+        nbytes = 0
+        for oi in reversed(entry.versions):
+            try:
+                item = {
+                    "bucket": bucket, "obj": entry.name,
+                    "versionId": oi.version_id or "",
+                    "modTime": oi.mod_time,
+                    "etag": oi.etag or oi.metadata.get("etag", ""),
+                }
+                if oi.delete_marker:
+                    item["deleteMarker"] = True
+                else:
+                    if max(oi.size, 0) > self.max_inline:
+                        _bump("skipped_large")
+                        continue
+                    _, stream = self.api.get_object(
+                        bucket, entry.name, version_id=oi.version_id)
+                    data = b"".join(stream)
+                    item["data"] = base64.b64encode(data).decode()
+                    item["size"] = len(data)
+                    item["contentType"] = oi.content_type
+                    item["userMeta"] = {
+                        k: v for k, v in oi.metadata.items()
+                        if k not in ("etag", "content-type")}
+                    nbytes += len(data)
+                items.append(item)
+            except _GONE:
+                _bump("gone")
+                continue
+            except Exception as e:
+                kind = _classify(e)
+                if kind == "gone":
+                    _bump("gone")
+                    continue
+                _bump("failed_%s" % ("permanent" if kind == "permanent"
+                                     else "retryable"))
+                if kind != "permanent":
+                    raise PushFailed(
+                        f"read {bucket}/{entry.name}: {e}") from e
+        return items, nbytes
+
+    def _push_batch(self, peer, bucket: str, items: list[dict]) -> None:
+        body_doc = {"items": items}
+        body = json.dumps(body_doc).encode()
+        lane = self._lanes.get(peer.name)
+        if lane is not None:
+            wait = lane.debit(len(body))
+            if wait > 0:
+                _bump("lane_waits")
+                if self._stop.wait(wait):
+                    raise PushFailed("shutdown mid-pacing")
+        t0 = time.monotonic()
+        with tracing.span("georep.push", peer=peer.name, bucket=bucket,
+                          items=len(items), bytes=len(body)):
+            results = self._post(peer, body)
+        self._breakers[peer.name].record_ok()
+        applied = already = stale = perm = 0
+        for r in results:
+            s = r.get("status")
+            if s == "applied":
+                applied += 1
+            elif s == "already":
+                already += 1
+            elif s == "stale":
+                stale += 1
+            elif r.get("retryable", True):
+                # a per-item retryable failure keeps the cursor behind
+                # this batch: the whole batch re-pushes (idempotent)
+                raise PushFailed(
+                    f"peer {peer.name} item failed: "
+                    f"{r.get('error', 'unknown')}")
+            else:
+                perm += 1
+        nbytes = sum(i.get("size", 0) for i in items)
+        _bump("pushed_versions", len(items))
+        _bump("pushed_bytes", nbytes)
+        if perm:
+            _bump("failed_permanent", perm)
+        self._set_live(peer.name, lastPushMs=round(
+            (time.monotonic() - t0) * 1e3, 3), breaker="closed")
+
+    def _post(self, peer, body: bytes) -> list[dict]:
+        """Signed POST of one batch to the peer's apply endpoint (the
+        site plane's wire idiom); non-200 raises PushFailed —
+        retryable by contract, the breaker owns the backoff."""
+        from minio_tpu.server import sigv4
+
+        ep = peer.endpoint
+        tls = ep.startswith("https://")
+        netloc = ep.split("://", 1)[-1].rstrip("/")
+        headers = {"host": netloc, "content-type": "application/json"}
+        signed = sigv4.sign_request("POST", GEOREP_APPLY_PATH, [],
+                                    headers, body, peer.access_key,
+                                    peer.secret_key)
+        host, _, port = netloc.partition(":")
+        cls = http.client.HTTPSConnection if tls \
+            else http.client.HTTPConnection
+        conn = cls(host, int(port or (443 if tls else 80)), timeout=15)
+        try:
+            conn.request("POST", GEOREP_APPLY_PATH, body=body,
+                         headers=signed)
+            resp = conn.getresponse()
+            data = resp.read()
+        except OSError as e:
+            raise PushFailed(f"peer {peer.name} unreachable: {e}") from e
+        finally:
+            conn.close()
+        if resp.status != 200:
+            raise PushFailed(
+                f"peer {peer.name} returned {resp.status}: "
+                f"{data[:200]!r}")
+        try:
+            return json.loads(data).get("results", [])
+        except ValueError as e:
+            raise PushFailed(
+                f"peer {peer.name} sent malformed ack") from e
+
+    # ---------------------------------------------------------- receive
+    def apply(self, doc: dict) -> dict:
+        """Apply one pushed batch from a peer site.  Runs with
+        propagation suppressed: landing a version must not re-push it
+        (cross-site loop) nor nudge our own workers."""
+        items = doc.get("items")
+        if not isinstance(items, list):
+            raise ValueError("georep apply: 'items' list required")
+        results = []
+        with _Suppressed():
+            for item in items:
+                try:
+                    results.append({"status": self._apply_item(item)})
+                except Exception as e:
+                    kind = _classify(e) if isinstance(e, Exception) \
+                        else "retryable"
+                    results.append({
+                        "status": "error", "error": str(e),
+                        "retryable": kind != "permanent"})
+        return {"results": results}
+
+    def _apply_item(self, item: dict) -> str:
+        bucket = item["bucket"]
+        name = item["obj"]
+        version_id = item.get("versionId") or ""
+        mod_time = item.get("modTime")
+        etag = item.get("etag", "")
+        if not self.api.bucket_exists(bucket):
+            # the site plane converges bucket metadata; data arriving
+            # first must not bounce on a not-yet-created bucket
+            try:
+                self.api.make_bucket(bucket)
+            except errors.StorageError:
+                pass
+        if item.get("deleteMarker"):
+            if self._has_version(bucket, name, version_id, mod_time,
+                                 etag, marker=True):
+                _bump("already")
+                return "already"
+            self.api.put_delete_marker(bucket, name, version_id,
+                                       mod_time)
+            _bump("applied")
+            return "applied"
+        if version_id:
+            if self._has_version(bucket, name, version_id, mod_time,
+                                 etag):
+                _bump("already")
+                return "already"
+            self._put_pinned(bucket, name, item, versioned=True)
+            _bump("applied")
+            return "applied"
+        # null version: versioned ids are identity, null versions are
+        # a SLOT — last-writer-wins on (mod_time, etag), etag breaking
+        # mod-time ties deterministically (both sites order any pair
+        # of writes identically: the model's _lww_max)
+        local = self._null_info(bucket, name)
+        if local is not None:
+            lk = (local.mod_time or 0,
+                  local.etag or local.metadata.get("etag", ""))
+            ik = (mod_time or 0, etag)
+            if lk == ik:
+                _bump("already")
+                return "already"
+            if lk > ik:
+                _bump("stale_dropped")
+                return "stale"
+        self._put_pinned(bucket, name, item, versioned=False)
+        _bump("applied")
+        return "applied"
+
+    def _has_version(self, bucket: str, name: str, version_id: str,
+                     mod_time, etag: str, marker: bool = False) -> bool:
+        from minio_tpu.erasure.objects import MethodNotAllowedDeleteMarker
+
+        try:
+            info = self.api.get_object_info(bucket, name,
+                                            version_id=version_id)
+        except MethodNotAllowedDeleteMarker:
+            return True  # the id exists locally (as a marker)
+        except (errors.StorageError, errors.MethodNotAllowed):
+            return False
+        if not version_id and not marker:
+            # null slot: exact-copy check only — LWW decides the rest
+            return (info.mod_time or 0) == (mod_time or 0) and \
+                (info.etag or info.metadata.get("etag", "")) == etag
+        return True
+
+    def _null_info(self, bucket: str, name: str):
+        from minio_tpu.erasure.objects import MethodNotAllowedDeleteMarker
+
+        try:
+            return self.api.get_object_info(bucket, name)
+        except MethodNotAllowedDeleteMarker as e:
+            return e.object_info
+        except (errors.StorageError, errors.MethodNotAllowed):
+            return None
+
+    def _put_pinned(self, bucket: str, name: str, item: dict,
+                    versioned: bool) -> None:
+        import io
+
+        from minio_tpu.erasure.objects import PutObjectOptions
+
+        data = base64.b64decode(item.get("data", ""))
+        opts = PutObjectOptions(
+            user_metadata=dict(item.get("userMeta") or {}),
+            content_type=item.get("contentType", ""),
+            versioned=versioned,
+            version_id=item.get("versionId") or None,
+            mod_time=item.get("modTime"),
+            # the ETag crosses sites verbatim: multipart/SSE ETags
+            # recomputed from the pushed stream would differ and break
+            # If-Match against the replica
+            etag=item.get("etag", ""),
+        )
+        self.api.put_object(bucket, name, io.BytesIO(data), len(data),
+                            opts)
+
+    # ------------------------------------------------------------ admin
+    def resync(self, peer_name: str, full: bool = True) -> dict:
+        """Reset one peer's cursor so the next sweep re-walks (and
+        re-pushes — idempotently) the namespace; `mc admin replicate
+        resync` for payload data."""
+        with self.site._mu:
+            if peer_name not in self.site.peers:
+                raise KeyError(peer_name)
+        st = self._load(peer_name)
+        if full:
+            st["initial_synced"] = False
+        st["done_buckets"] = []
+        st["cursor"] = None
+        st["state"] = "resync-pending"
+        self._save(peer_name, st)
+        _bump("resyncs")
+        self._wake.set()
+        ev = self._nudges.get(peer_name)
+        if ev is not None:
+            ev.set()
+        return {"peer": peer_name, "full": bool(full)}
+
+    def status(self) -> dict:
+        with self.site._mu:
+            names = list(self.site.peers)
+        peers = {}
+        for name in names:
+            st = self._load(name)
+            br = self._breakers.get(name)
+            with self._mu:
+                live = dict(self._live.get(name, {}))
+            worker = self._workers.get(name)
+            peers[name] = {
+                "state": st.get("state", "new"),
+                "initialSynced": bool(st.get("initial_synced")),
+                "cursor": st.get("cursor"),
+                "doneBuckets": len(st.get("done_buckets", [])),
+                "pushedObjects": st.get("pushed_objects", 0),
+                "pushedVersions": st.get("pushed_versions", 0),
+                "degraded": bool(st.get("degraded")),
+                "breaker": br.state() if br is not None else "closed",
+                "breakerOpens": br.opens if br is not None else 0,
+                "workerAlive": bool(worker is not None
+                                    and worker.is_alive()),
+                **live,
+            }
+        with _stats_mu:
+            totals = dict(stats)
+        return {"enabled": True, "intervalSeconds": self.interval_s,
+                "checkpointEvery": self.checkpoint_every,
+                "bandwidth": self.bandwidth, "peers": peers,
+                "totals": totals}
+
+
+def _f(env, key: str, default: float) -> float:
+    try:
+        return float(env.get(key, str(default)))
+    except (TypeError, ValueError):
+        return default
+
+
+def _i(env, key: str, default: int) -> int:
+    try:
+        return int(float(env.get(key, str(default))))
+    except (TypeError, ValueError):
+        return default

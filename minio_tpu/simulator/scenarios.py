@@ -142,6 +142,97 @@ def builtin_scenarios(scale: float = 1.0) -> list[Scenario]:
     ]
 
 
+def georep_scenarios(scale: float = 1.0) -> list[Scenario]:
+    """The multi-region family (ISSUE 16): replayed against the
+    PRIMARY of a two-cluster pair with ``MINIO_TPU_GEOREP=1`` and a
+    joined site peer.  The engine grades the primary-facing SLO (the
+    whole point of the async push queue is that the client never waits
+    on the WAN); cross-site convergence and read-your-writes are graded
+    AFTER replay by the harness polling the secondary for byte-identity
+    (``bench.py sim`` records both next to the scenario verdicts).
+
+    Each scenario owns its bucket so convergence checks can't bleed
+    across scenarios.  Chaos hooks the harness must register:
+
+    * ``peer_kill`` — close the secondary mid-push, restart it at the
+      SAME port (the breaker must open, then the retried sweeps must
+      converge against the restarted peer);
+    * ``worker_kill`` — SIGKILL one mp I/O worker of the primary
+      (``MINIO_TPU_WORKERS>=1``); the plane supervisor respawns it and
+      in-flight PUTs surface as honest errors inside the availability
+      budget.
+    """
+    d = lambda s: max(3.0, s * scale)  # noqa: E731
+
+    return [
+        Scenario(
+            name="replication_burst", seed=1601, duration_s=d(10),
+            clients=6, rate=50.0,
+            ops=(("put", 55), ("get", 38), ("delete", 7)),
+            buckets=("grburst",), nobjects=32,
+            put_bytes=(8 << 10, 64 << 10),
+            slo={"classes": {
+                "PUT": {"p99_ms": 4000.0, "availability": 0.995},
+                "GET": {"p99_ms": 1500.0, "availability": 0.995}},
+                "shed_fraction_max": 0.05},
+            description="write burst while the push queue drains to "
+                        "the peer: primary-facing PUT latency must not "
+                        "absorb the WAN (async replication), deletes "
+                        "replicate as versioned markers"),
+        Scenario(
+            name="peer_kill_mid_push", seed=1602, duration_s=d(12),
+            clients=6, rate=45.0,
+            ops=(("put", 50), ("get", 50)),
+            buckets=("grpeer",), nobjects=32,
+            chaos="peer_kill", chaos_at_frac=0.25, chaos_dur_frac=0.4,
+            slo={"classes": {
+                "PUT": {"p99_ms": 4000.0, "availability": 0.995},
+                "GET": {"p99_ms": 1500.0, "availability": 0.995}},
+                "shed_fraction_max": 0.05},
+            description="secondary killed mid-push and restarted at "
+                        "the same address: breaker opens, primary SLO "
+                        "holds, retried sweeps converge after restart"),
+        Scenario(
+            name="worker_kill", seed=1603, duration_s=d(12),
+            clients=6, rate=45.0,
+            ops=(("put", 45), ("get", 55)),
+            buckets=("grwork",), nobjects=32,
+            # PUT bodies must clear the 128 KiB inline bound: inline
+            # objects never reach the mp worker plane, and a kill that
+            # can't hit an in-flight job tests nothing
+            put_bytes=(160 << 10, 256 << 10),
+            chaos="worker_kill", chaos_at_frac=0.3, chaos_dur_frac=0.3,
+            # the PUT budget PRICES the designed fault: a SIGKILL
+            # deterministically fails the in-flight jobs of the dead
+            # worker until the supervisor respawns it (~2-3% of this
+            # schedule's PUTs on the shared container); 0.95 passes
+            # that baseline while still failing a supervisor that
+            # cannot keep workers alive
+            slo={"classes": {
+                "PUT": {"p99_ms": 5000.0, "availability": 0.95},
+                "GET": {"p99_ms": 2000.0, "availability": 0.99}},
+                "shed_fraction_max": 0.05},
+            description="one mp I/O worker of the primary SIGKILLed "
+                        "mid-run; the plane supervisor respawns it, "
+                        "the kill window's in-flight PUTs fit the "
+                        "availability budget, replication still "
+                        "converges"),
+        Scenario(
+            name="read_your_writes_across_sites", seed=1604,
+            duration_s=d(10), clients=4, rate=30.0,
+            ops=(("put", 60), ("get", 40)),
+            buckets=("grryw",), nobjects=24,
+            slo={"classes": {
+                "PUT": {"p99_ms": 4000.0, "availability": 0.995},
+                "GET": {"p99_ms": 1500.0, "availability": 0.995}},
+                "shed_fraction_max": 0.02},
+            description="every acknowledged write must become readable "
+                        "BYTE-IDENTICAL on the secondary: the harness "
+                        "polls the peer after replay and records the "
+                        "convergence lag next to this verdict"),
+    ]
+
+
 def smoke_scenario() -> Scenario:
     """Tier-1 sized: a few seconds against a real server, generous
     budgets (CI boxes are noisy — this pins the loop closes, not that

@@ -1,0 +1,352 @@
+"""Geo-replication of object DATA (services/georep.py, ISSUE 16).
+
+Two real clusters over localhost sockets: writes on site A converge to
+site B byte-identically, kills mid-push resume from the quorum cursor
+without duplicating versions, null-version conflicts resolve by
+last-writer-wins, and — the differential half — a gated-off server is
+observably identical to a server that predates the subsystem.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from tests.s3_harness import S3TestServer
+
+ADMIN = "/minio/admin/v3"
+VER = (b'<VersioningConfiguration><Status>Enabled</Status>'
+       b'</VersioningConfiguration>')
+
+
+def _wait(cond, timeout=15.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _join(a: S3TestServer, b: S3TestServer, name: str = "siteB") -> None:
+    r = a.request("POST", f"{ADMIN}/site-replication/add",
+                  data=json.dumps({"peers": [{
+                      "name": name, "endpoint": f"http://{b.host}",
+                      "accessKey": b.ak, "secretKey": b.sk}]}).encode())
+    assert r.status == 200, r.text()
+
+
+@pytest.fixture
+def geo_sites(tmp_path, monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_GEOREP", "1")
+    monkeypatch.setenv("MINIO_TPU_GEOREP_INTERVAL_S", "0.2")
+    monkeypatch.setenv("MINIO_TPU_GEOREP_CHECKPOINT_EVERY", "2")
+    a = S3TestServer(str(tmp_path / "a"))
+    b = S3TestServer(str(tmp_path / "b"))
+    _join(a, b)
+    yield a, b
+    a.close()
+    b.close()
+
+
+class TestGateOffDifferential:
+    """MINIO_TPU_GEOREP unset/0 must be byte- and metrics-identical to
+    a server that never had the subsystem (the ISSUE's hard gate)."""
+
+    def test_gate_off_no_subsystem_no_metrics_no_threads(self, tmp_path):
+        assert os.environ.get("MINIO_TPU_GEOREP", "0") == "0" or \
+            "MINIO_TPU_GEOREP" not in os.environ
+        srv = S3TestServer(str(tmp_path / "off"))
+        try:
+            assert srv.server.georep is None
+            # the S3 surface behaves exactly as before
+            assert srv.request("PUT", "/gob").status == 200
+            r = srv.request("PUT", "/gob/o", data=b"payload")
+            assert r.status == 200
+            r = srv.request("GET", "/gob/o")
+            assert r.status == 200 and r.body == b"payload"
+            # no minio_georep_* family leaks into the scrape (signed:
+            # the unsigned endpoint answers 403, which would make this
+            # absence check vacuous)
+            m = srv.request("GET", "/minio/v2/metrics/cluster")
+            assert m.status == 200
+            assert b"minio_georep" not in m.body
+            # no georep worker/supervisor threads exist
+            names = [t.name for t in threading.enumerate()]
+            assert not any(n.startswith("georep") for n in names), names
+            # admin surface: status reports disabled, apply bounces 503
+            r = srv.request("GET", f"{ADMIN}/georep/status")
+            assert r.status == 200
+            assert json.loads(r.body) == {"enabled": False}
+            r = srv.request("POST", f"{ADMIN}/georep/apply",
+                            data=json.dumps({"items": []}).encode())
+            assert r.status == 503, r.text()
+            assert b"SlowDown" in r.body
+        finally:
+            srv.close()
+
+    def test_gate_off_s3_surface_matches_gate_on(self, tmp_path,
+                                                 monkeypatch):
+        """Same op sequence on a gated-off and a gated-on (peerless)
+        server: statuses, bodies, and S3 response headers agree —
+        the gate adds background behavior only."""
+        def run_ops(srv):
+            out = []
+            ops = [("PUT", "/dbkt", None),
+                   ("PUT", "/dbkt/k", b"same-bytes"),
+                   ("GET", "/dbkt/k", None),
+                   ("HEAD", "/dbkt/k", None),
+                   ("DELETE", "/dbkt/k", None),
+                   ("GET", "/dbkt", None)]
+            for method, path, data in ops:
+                r = srv.request(method, path, data=data)
+                hdr = {k.lower(): v for k, v in r.headers.items()
+                       if k.lower() in ("etag", "content-type",
+                                        "x-amz-version-id")}
+                out.append((method, path, r.status, r.body, hdr))
+            return out
+
+        off = S3TestServer(str(tmp_path / "doff"))
+        try:
+            base = run_ops(off)
+        finally:
+            off.close()
+        monkeypatch.setenv("MINIO_TPU_GEOREP", "1")
+        on = S3TestServer(str(tmp_path / "don"))
+        try:
+            assert on.server.georep is not None
+            assert run_ops(on) == base
+        finally:
+            on.close()
+
+
+class TestGeoRepConvergence:
+    def test_objects_converge_byte_identical(self, geo_sites):
+        a, b = geo_sites
+        assert a.request("PUT", "/geo").status == 200
+        payload = {f"o{i:02d}": bytes([65 + i]) * (1000 + i)
+                   for i in range(8)}
+        for name, data in payload.items():
+            assert a.request("PUT", f"/geo/{name}", data=data,
+                             headers={"x-amz-meta-site": "A"}
+                             ).status == 200
+        for name, data in payload.items():
+            assert _wait(lambda n=name, d=data: b.request(
+                "GET", f"/geo/{n}").body == d), name
+        # user metadata rides along
+        r = b.request("HEAD", "/geo/o00")
+        assert r.headers.get("x-amz-meta-site") == "A"
+
+    def test_read_your_writes_across_sites(self, geo_sites):
+        """The RYW drill the chaos family grades: write to A, read the
+        SAME bytes from B within the convergence window."""
+        a, b = geo_sites
+        a.request("PUT", "/ryw")
+        t0 = time.time()
+        assert a.request("PUT", "/ryw/doc", data=b"v-first").status == 200
+        assert _wait(lambda: b.request("GET", "/ryw/doc").body
+                     == b"v-first")
+        lag = time.time() - t0
+        # overwrite converges too (LWW: the newer write wins everywhere)
+        time.sleep(0.05)  # strictly newer mod-time
+        assert a.request("PUT", "/ryw/doc", data=b"v-second").status == 200
+        assert _wait(lambda: b.request("GET", "/ryw/doc").body
+                     == b"v-second")
+        assert lag < 15.0
+
+    def test_versioned_objects_and_delete_markers(self, geo_sites):
+        a, b = geo_sites
+        a.request("PUT", "/vgeo")
+        assert a.request("PUT", "/vgeo", query=[("versioning", "")],
+                         data=VER).status == 200
+        vids = []
+        for i in range(3):
+            r = a.request("PUT", "/vgeo/doc", data=b"ver%d" % i)
+            assert r.status == 200
+            vids.append(r.headers.get("x-amz-version-id"))
+        r = a.request("DELETE", "/vgeo/doc")
+        assert r.status == 204
+        # B ends with the same version ids, same bytes, same tombstone
+        def converged():
+            rr = b.request("GET", "/vgeo/doc")
+            if rr.status != 404:
+                return False
+            for i, vid in enumerate(vids):
+                rr = b.request("GET", "/vgeo/doc",
+                               query=[("versionId", vid)])
+                if rr.status != 200 or rr.body != b"ver%d" % i:
+                    return False
+            return True
+        assert _wait(converged, timeout=20.0)
+
+    def test_status_endpoint_reports_peer_progress(self, geo_sites):
+        a, b = geo_sites
+        a.request("PUT", "/stb")
+        a.request("PUT", "/stb/x", data=b"x")
+        assert _wait(lambda: b.request("GET", "/stb/x").status == 200)
+        r = a.request("GET", f"{ADMIN}/georep/status")
+        assert r.status == 200
+        doc = json.loads(r.body)
+        assert doc["enabled"] is True
+        assert "siteB" in doc["peers"]
+        peer = doc["peers"]["siteB"]
+        assert peer["pushedObjects"] >= 1
+        assert peer["workerAlive"] is True
+        # the scrape carries the push-economics family with real
+        # counts (signed — unsigned scrapes bounce off admin auth)
+        m = a.request("GET", "/minio/v2/metrics/cluster")
+        assert m.status == 200
+        scrape = m.body.decode()
+        assert "minio_georep_pushed_objects_total" in scrape
+        assert "minio_georep_sweeps_total" in scrape
+        pushed = next(
+            float(line.split()[1]) for line in scrape.splitlines()
+            if line.startswith("minio_georep_pushed_objects_total "))
+        assert pushed >= 1
+
+    def test_resync_repushes_idempotently(self, geo_sites):
+        a, b = geo_sites
+        a.request("PUT", "/rsb")
+        a.request("PUT", "/rsb/one", data=b"one")
+        assert _wait(lambda: b.request("GET", "/rsb/one").status == 200)
+        r = a.request("POST", f"{ADMIN}/georep/resync",
+                      query=[("peer", "siteB"), ("full", "true")])
+        assert r.status == 200, r.text()
+        # the resync re-walk completes and the object is still intact
+        def resynced():
+            doc = json.loads(a.request(
+                "GET", f"{ADMIN}/georep/status").body)
+            return doc["peers"]["siteB"]["initialSynced"]
+        assert _wait(resynced, timeout=20.0)
+        assert b.request("GET", "/rsb/one").body == b"one"
+
+
+class TestGeoRepCrashSafety:
+    def test_worker_kill_resumes_from_cursor_no_divergence(
+            self, geo_sites):
+        """Kill the push worker mid-sweep (no cursor save — simulated
+        SIGKILL), let the supervisor respawn it, and require exact
+        convergence: every object lands on B once, byte-identical."""
+        a, b = geo_sites
+        g = a.server.georep
+        a.request("PUT", "/killb")
+        assert _wait(lambda: b.server.api.bucket_exists("killb"))
+        # pause pushes while we stage the namespace: kill unconditionally
+        g._crash_hook = lambda pushed: True
+        payload = {f"k{i:02d}": bytes([97 + i % 26]) * 2000
+                   for i in range(12)}
+        for name, data in payload.items():
+            assert a.request("PUT", f"/killb/{name}", data=data).status == 200
+        # now die after a few ACKed objects — mid-namespace, mid-sweep
+        kills = {"n": 0}
+
+        def hook(pushed):
+            if pushed >= 4 and kills["n"] == 0:
+                kills["n"] += 1
+                return True
+            return False
+        g._crash_hook = hook
+        g.nudge()
+        assert _wait(lambda: kills["n"] == 1, timeout=20.0)
+        # the supervisor respawns the worker; the resumed sweep loads
+        # the quorum cursor and finishes the namespace
+        g._crash_hook = None
+        g.nudge()
+        for name, data in payload.items():
+            assert _wait(lambda n=name, d=data: b.request(
+                "GET", f"/killb/{n}").body == d, timeout=30.0), name
+        # zero duplicate-divergence: one null version per object on B
+        for name in payload:
+            entries = [e for e in b.server.api.list_entries("killb")
+                       if e.name == name]
+            assert len(entries) == 1
+            assert len(entries[0].versions) == 1, name
+
+    def test_peer_down_breaker_then_recovery(self, tmp_path,
+                                             monkeypatch):
+        """Peer killed mid-stream: pushes classify retryable, the
+        breaker opens (bounded hammering), and a RESTARTED peer at the
+        same address converges without a resync."""
+        monkeypatch.setenv("MINIO_TPU_GEOREP", "1")
+        monkeypatch.setenv("MINIO_TPU_GEOREP_INTERVAL_S", "0.2")
+        monkeypatch.setenv("MINIO_TPU_GEOREP_BREAKER_THRESHOLD", "2")
+        monkeypatch.setenv("MINIO_TPU_GEOREP_BREAKER_COOLDOWN_S", "0.5")
+        a = S3TestServer(str(tmp_path / "pa"))
+        b = S3TestServer(str(tmp_path / "pb"))
+        b_port = b.port
+        try:
+            _join(a, b)
+            a.request("PUT", "/pkb")
+            a.request("PUT", "/pkb/before", data=b"before")
+            assert _wait(lambda: b.request(
+                "GET", "/pkb/before").status == 200)
+            b.close()
+            a.request("PUT", "/pkb/during", data=b"during-outage")
+            # the breaker opens after consecutive retryable failures
+            def breaker_tripped():
+                doc = json.loads(a.request(
+                    "GET", f"{ADMIN}/georep/status").body)
+                return doc["peers"]["siteB"]["breaker"] in (
+                    "open", "half-open")
+            assert _wait(breaker_tripped, timeout=20.0)
+            # peer returns at the SAME address (pinned port)
+            b = S3TestServer(str(tmp_path / "pb"), port=b_port)
+            assert _wait(lambda: b.request(
+                "GET", "/pkb/during").body == b"during-outage",
+                timeout=30.0)
+            assert b.request("GET", "/pkb/before").body == b"before"
+        finally:
+            a.close()
+            b.close()
+
+
+class TestGeoRepLww:
+    def test_apply_idempotent_and_stale_dropped(self, geo_sites):
+        """Direct apply-side contract: re-push answers `already`, a
+        LWW-losing null version answers `stale` and never clobbers."""
+        a, b = geo_sites
+        g = b.server.georep
+        b.request("PUT", "/lww")
+        now = time.time()
+        item = {"bucket": "lww", "obj": "doc", "versionId": "",
+                "modTime": now, "etag": "aaa",
+                "data": "bmV3ZXI=", "size": 5, "contentType": "",
+                "userMeta": {}}  # "newer"
+        out = g.apply({"items": [item]})
+        assert out["results"][0]["status"] == "applied"
+        out = g.apply({"items": [dict(item)]})
+        assert out["results"][0]["status"] == "already"
+        stale = dict(item)
+        stale["modTime"] = now - 10
+        stale["etag"] = "zzz"
+        stale["data"] = "b2xkZXI="  # "older"
+        out = g.apply({"items": [stale]})
+        assert out["results"][0]["status"] == "stale"
+        assert b.request("GET", "/lww/doc").body == b"newer"
+        # etag is the deterministic tiebreak at equal mod-time
+        tie = dict(item)
+        tie["etag"] = "aab"  # > "aaa" at the same modTime
+        tie["data"] = "dGllLXdpbg=="  # "tie-win"
+        out = g.apply({"items": [tie]})
+        assert out["results"][0]["status"] == "applied"
+        assert b.request("GET", "/lww/doc").body == b"tie-win"
+
+    def test_active_active_concurrent_writes_converge(self, geo_sites):
+        """Both sites write the same key: after propagation both answer
+        the SAME winner (the model's lww-convergence invariant)."""
+        a, b = geo_sites
+        _join(b, a, name="siteA")  # make it active-active
+        a.request("PUT", "/aab")
+        assert _wait(lambda: b.server.api.bucket_exists("aab"))
+        a.request("PUT", "/aab/key", data=b"from-A")
+        time.sleep(0.05)
+        b.request("PUT", "/aab/key", data=b"from-B-newer")
+
+        def settled():
+            ra = a.request("GET", "/aab/key")
+            rb = b.request("GET", "/aab/key")
+            return (ra.status == rb.status == 200
+                    and ra.body == rb.body == b"from-B-newer")
+        assert _wait(settled, timeout=20.0)

@@ -30,9 +30,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 #: inventory and future protocol PRs extend it (ISSUE 11 added the
 #: erasure batcher's tick/submit/quiesce protocol, ISSUE 13 the
 #: per-tenant QoS DRR admit/release/reweight/shed protocol, ISSUE 14
-#: the pool-drain suspend/copy/fence/delete/checkpoint protocol)
+#: the pool-drain suspend/copy/fence/delete/checkpoint protocol,
+#: ISSUE 16 the geo-replication push/ack/retry/resync protocol)
 LOAD_BEARING = ("arena-ring", "hotcache", "breaker-mrf", "batcher", "qos",
-                "topology")
+                "topology", "georep")
 
 
 # ------------------------------------------------------------- engine

@@ -318,6 +318,49 @@ class TestScheduler:
         assert st["hotLaneAdmits"] == 1
         assert st["hotLaneRejections"] == 1
 
+    def test_hot_lane_per_tenant_cap_two_tenant_drill(self):
+        """ISSUE 16 satellite: one hot tenant flooding the RAM-hit
+        fast lane may hold at most hot_share of its capacity — the
+        second tenant always finds a slot."""
+        p = QosPlane(2)  # hot_capacity = max(2,4)*2 = 8, cap = 4
+        assert p.hot_cap() == 4
+        granted = 0
+        while p.hot_lane_try("bucket:flood"):
+            granted += 1
+            assert granted <= 8, "cap never enforced"
+        assert granted == 4  # the flood stops at its share
+        st = p.stats()["tenants"]["bucket:flood"]
+        assert st["hotLaneInflight"] == 4
+        assert st["hotLaneCapped"] >= 1
+        # the OTHER tenant still gets hot-lane slots
+        assert p.hot_lane_try("bucket:quiet")
+        assert p.stats()["tenants"]["bucket:quiet"]["hotLaneInflight"] \
+            == 1
+        # release frees the flood's slots again
+        for _ in range(4):
+            p.hot_lane_release("bucket:flood")
+        assert p.stats()["tenants"]["bucket:flood"]["hotLaneInflight"] \
+            == 0
+        assert p.hot_lane_try("bucket:flood")
+        p.hot_lane_release("bucket:flood")
+        p.hot_lane_release("bucket:quiet")
+        # release for an unknown tenant must not blow up (flip races)
+        p.hot_lane_release("bucket:never-seen")
+
+    def test_hot_share_reconfigure_and_clamp(self):
+        p = QosPlane(2)
+        p.reconfigure(hot_share=0.125)
+        assert p.hot_cap() == 1  # floor at one slot per tenant
+        assert p.hot_lane_try("bucket:a")
+        assert not p.hot_lane_try("bucket:a")
+        p.reconfigure(hot_share=1.0)
+        assert p.hot_cap() == 8
+        assert p.hot_lane_try("bucket:a")
+        assert p.stats()["hotCapPerTenant"] == 8
+        # a tenant holding hot slots never gets GC'd mid-flight
+        p.hot_lane_release("bucket:a")
+        p.hot_lane_release("bucket:a")
+
 
 # ----------------------------------------------------- bandwidth buckets
 class TestBandwidth:

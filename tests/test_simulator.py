@@ -14,7 +14,7 @@ import pytest
 
 from minio_tpu.simulator import (Scenario, ScenarioEngine,
                                  build_schedule, builtin_scenarios,
-                                 schedule_digest)
+                                 georep_scenarios, schedule_digest)
 from minio_tpu.simulator.engine import OPS, catalog
 from minio_tpu.simulator.scenarios import smoke_scenario
 
@@ -96,6 +96,33 @@ class TestScheduleContract:
         assert len(scs) >= 5
         assert sum(1 for s in scs if s.chaos) >= 2
         assert len({s.seed for s in scs}) == len(scs)
+
+    def test_georep_family_meets_acceptance_shape(self):
+        """ISSUE 16: the multi-region family — four named scenarios,
+        each owning its bucket (convergence checks must not bleed
+        across scenarios), every one graded by server-side SLO
+        classes, chaos limited to the hooks bench.py registers."""
+        scs = georep_scenarios()
+        assert [s.name for s in scs] == [
+            "replication_burst", "peer_kill_mid_push", "worker_kill",
+            "read_your_writes_across_sites"]
+        buckets = [s.buckets[0] for s in scs]
+        assert len(set(buckets)) == len(scs)
+        assert all(s.slo.get("classes") for s in scs)
+        assert {s.chaos for s in scs if s.chaos} == \
+            {"peer_kill", "worker_kill"}
+        # seeds must not collide with the builtin set — SIM_r01.json
+        # keys scenario digests by name but seeds are the identity
+        seeds = {s.seed for s in scs} | \
+            {s.seed for s in builtin_scenarios()}
+        assert len(seeds) == len(scs) + len(builtin_scenarios())
+
+    def test_georep_schedules_reproduce(self):
+        for sc in georep_scenarios(scale=0.25):
+            a = build_schedule(sc)
+            b = build_schedule(sc)
+            assert a == b
+            assert schedule_digest(a) == schedule_digest(b)
 
 
 @pytest.fixture()

@@ -616,3 +616,57 @@ class TestUsageMetrics:
             assert "minio_usage_" not in body
         finally:
             s.close()
+
+
+class TestSloRuntimeFlip:
+    """The SLO gate flips at runtime like QoS (ISSUE 16 satellite):
+    admin PUT /minio/admin/v3/slo persists through the dynamic `slo`
+    config subsystem and applies live — no restart."""
+
+    def test_admin_put_flips_gate_live(self, plain_srv):
+        s = plain_srv
+        assert s.server.slo is None
+        r = s.request("PUT", "/minio/admin/v3/slo",
+                      data=json.dumps({"enable": True}).encode())
+        assert r.status == 200, r.text()
+        assert json.loads(r.body) == {"enabled": True}
+        assert s.server.slo is not None
+        # traffic against the flipped-on plane records
+        s.request("PUT", "/flipb")
+        s.request("PUT", "/flipb/k", data=b"x" * 256)
+        s.request("GET", "/flipb/k")
+        time.sleep(0.3)
+        doc = json.loads(s.request("GET", "/minio/admin/v3/slo").body)
+        assert doc["enabled"] is True
+        # flip off: plane gone, admin answers disabled again — and the
+        # S3 surface keeps working throughout
+        r = s.request("PUT", "/minio/admin/v3/slo",
+                      data=json.dumps({"enable": False}).encode())
+        assert r.status == 200
+        assert json.loads(r.body) == {"enabled": False}
+        assert s.server.slo is None
+        assert json.loads(s.request(
+            "GET", "/minio/admin/v3/slo").body) == {"enabled": False}
+        assert s.request("GET", "/flipb/k").body == b"x" * 256
+
+    def test_strict_bool_validation(self, plain_srv):
+        # '"on"' is truthy in Python — a stringly flip must bounce, not
+        # silently enable (the QoS admin rule)
+        r = plain_srv.request("PUT", "/minio/admin/v3/slo",
+                              data=json.dumps({"enable": "on"}).encode())
+        assert r.status == 400
+        r = plain_srv.request("PUT", "/minio/admin/v3/slo", data=b"{}")
+        assert r.status == 400
+        r = plain_srv.request("PUT", "/minio/admin/v3/slo",
+                              data=b"not-json")
+        assert r.status == 400
+        assert plain_srv.server.slo is None
+
+    def test_env_pin_wins_over_config(self, slo_srv):
+        """MINIO_TPU_SLO=1 pins the gate: a config 'off' cannot kill
+        the plane (env > stored config, the subsystem-wide rule)."""
+        r = slo_srv.request("PUT", "/minio/admin/v3/slo",
+                            data=json.dumps({"enable": False}).encode())
+        assert r.status == 200
+        assert slo_srv.server.slo is not None
+        assert json.loads(r.body) == {"enabled": True}
