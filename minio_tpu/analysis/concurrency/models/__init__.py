@@ -1,4 +1,4 @@
 """Protocol models: importing this package registers every model."""
 
-from . import (batcher, breaker, georep, hotcache, qos, ring,  # noqa: F401
-               topology)
+from . import (batcher, breaker, georep, hotcache,  # noqa: F401
+               metajournal, qos, ring, topology)

@@ -1,0 +1,351 @@
+"""Model: the per-drive xl.meta commit journal's enqueue/flush/ack/
+rotate/replay protocol (storage/metajournal.py, ISSUE 17) — written
+BEFORE the implementation, per the PR 10 convention.
+
+One drive, two hot objects (x, y).  Clients commit xl.meta versions:
+each commit gets a monotone sequence number and joins an in-memory
+batch.  A committer thread drains the batch in three distinct steps —
+write (append the records to the journal file: OS page cache only),
+sync (ONE group fdatasync covering the whole batch), ack (waiters
+wake: the commit is now promised durable) — and then applies each
+record by writing the xl.meta file BUFFERED (tmp+rename, no per-file
+fsync; the group fsync on the journal is what bought durability).
+Rotation bounds the journal: once every record is applied it
+fdatasyncs the CURRENT xl.meta file of each path the journal mentions
+(one sync per distinct path, however many times it was overwritten —
+the dedup that makes group commit pay) and only then truncates.  A
+crash loses the in-memory queue, the unsynced journal tail (torn
+tail) and every buffered xl.meta write; replay rebuilds xl.meta state
+as the per-path newest-sequence-wins fold of the surviving journal
+over the last-rotated on-disk state.
+
+The protocol rules under test (each is a line of metajournal.py):
+
+* **ack only after the group fsync** — a commit is promised durable
+  only once its journal record is fdatasync'd; the torn tail a crash
+  rips off must contain only unacked records;
+* **rotate only past applied records** — truncating the journal is
+  legal only once every record it holds has been applied to xl.meta
+  AND those files are fdatasync'd; otherwise truncation deletes the
+  only durable copy of an acked commit;
+* **apply and replay are newest-seq-wins** — xl.meta state is a max()
+  fold over sequence numbers, so batched same-object overwrites and
+  idempotent replay after crash land on the same final bytes in any
+  order;
+* **replay folds journal OVER disk** — the surviving journal suffix
+  is applied on top of the last-rotated xl.meta state, never instead
+  of it and never underneath it;
+* **the committer survives crashes** — enqueued commits are always
+  eventually flushed (wedge-freedom via the ``done`` predicate).
+
+Invariants:
+
+* ``acked-commit-durable``   — every acked sequence is recoverable
+                               from crash-surviving state (the synced
+                               journal or the rotated xl.meta) in
+                               EVERY state.
+* ``xlmeta-never-regresses`` — the xl.meta a reader sees is never
+                               older than the last rotation's
+                               durable state.
+* ``newest-seq-wins``        — terminal: at quiescence every object's
+                               xl.meta equals the newest durable
+                               commit, and covers every ack.
+* wedge-freedom              — the ``done`` predicate: a quiescent
+                               state with unflushed commits is a
+                               wedge (deadlock).
+
+Every invariant is proven live by seeded mutations (tier-1 pins the
+matrix in tests/test_modelcheck.py): ack-before-fsync,
+rotate-skips-meta-sync, rotate-drops-unapplied,
+apply-ignores-seq-order, replay-skips-journal,
+replay-clobbers-newer-meta, committer-wedges-after-crash.
+"""
+
+from __future__ import annotations
+
+from ..modelcheck import Model, register
+
+#: the two objects; same-object overwrites exercise the newest-wins
+#: fold, the second object exercises rotation's per-path dedup
+OBJS = ("x", "y")
+
+#: bound on concurrently-applied records a batch can hold (= total
+#: commits in the fast config) — apply_i actions index into it so the
+#: checker explores every apply interleaving
+MAX_INFLIGHT = 4
+
+
+def _recoverable(s, obj: str) -> int:
+    """The newest sequence for ``obj`` that survives a crash right
+    now: the per-path max over the synced journal, folded over the
+    last-rotated on-disk xl.meta."""
+    best = s["meta_disk"][obj]
+    for seq, o in s["jrnl"]:
+        if o == obj and seq > best:
+            best = seq
+    return best
+
+
+def build(deep: bool = False) -> Model:
+    init = {
+        # client commits left per object (same-object overwrites on x)
+        "commits_left": {"x": 3 if deep else 2, "y": 1},
+        "next_seq": 1,
+        # in-memory batch: enqueued, not yet written (dies on crash)
+        "queue": [],
+        # journal file page cache: written, not yet fsync'd — the
+        # torn tail a crash rips off (dies on crash)
+        "tail": [],
+        # journal records covered by a group fdatasync (survive crash)
+        "jrnl": [],
+        # synced but waiters not yet woken / not yet applied to xl.meta
+        "to_ack": [],
+        "to_apply": [],
+        # the durability promise: newest acked seq per object (monotone)
+        "acked": {"x": 0, "y": 0},
+        # xl.meta as a reader sees it (buffered; regresses on crash)
+        # vs. what the last rotation made durable
+        "meta_mem": {"x": 0, "y": 0},
+        "meta_disk": {"x": 0, "y": 0},
+        "up": True,
+        "crashes_left": 2 if deep else 1,
+        "rotates_left": 2 if deep else 1,
+    }
+    m = Model("metajournal", init,
+              "per-drive xl.meta commit journal: enqueue/write/sync/"
+              "ack/apply/rotate with crash + torn-tail replay")
+
+    # -- client commits -----------------------------------------------------
+    for obj in OBJS:
+        def can_put(s, obj=obj) -> bool:
+            return s["commits_left"][obj] > 0 and s["up"]
+
+        def do_put(s, obj=obj) -> None:
+            s["commits_left"][obj] -= 1
+            s["queue"].append((s["next_seq"], obj))
+            s["next_seq"] += 1
+
+        m.action(f"put_{obj}", can_put)(do_put)
+
+    # -- the committer: write -> group-fsync -> ack -> apply ----------------
+    def can_write(s) -> bool:
+        return s["up"] and bool(s["queue"])
+
+    def do_write(s) -> None:
+        # append the whole batch to the journal file — page cache
+        # only; nothing is promised yet
+        s["tail"].extend(s["queue"])
+        s["queue"] = []
+
+    m.action("flush_write", can_write)(do_write)
+
+    def can_sync(s) -> bool:
+        return s["up"] and bool(s["tail"])
+
+    def do_sync(s) -> None:
+        # ONE group fdatasync covers every record of the batch
+        s["jrnl"].extend(s["tail"])
+        s["to_ack"].extend(s["tail"])
+        s["tail"] = []
+
+    m.action("group_fsync", can_sync)(do_sync)
+
+    def can_ack(s) -> bool:
+        return s["up"] and bool(s["to_ack"])
+
+    def do_ack(s) -> None:
+        # waiters wake: the commit is now promised durable — legal
+        # only because the group fsync above already landed
+        for seq, obj in s["to_ack"]:
+            if seq > s["acked"][obj]:
+                s["acked"][obj] = seq
+        s["to_apply"].extend(s["to_ack"])
+        s["to_ack"] = []
+
+    m.action("ack_batch", can_ack)(do_ack)
+
+    # apply is per-record and deliberately order-free: the checker
+    # explores every interleaving and newest-seq-wins must make them
+    # all land on the same bytes
+    for i in range(MAX_INFLIGHT):
+        def can_apply(s, i=i) -> bool:
+            return s["up"] and len(s["to_apply"]) > i
+
+        def do_apply(s, i=i) -> None:
+            seq, obj = s["to_apply"].pop(i)
+            if seq > s["meta_mem"][obj]:
+                s["meta_mem"][obj] = seq
+
+        m.action(f"apply_{i}", can_apply)(do_apply)
+
+    # -- rotation -----------------------------------------------------------
+    def can_rotate(s) -> bool:
+        # only once every journal record is applied: truncating
+        # earlier would delete the only durable copy of an acked
+        # commit
+        return (s["up"] and bool(s["jrnl"]) and s["rotates_left"] > 0
+                and not s["to_ack"] and not s["to_apply"])
+
+    def do_rotate(s) -> None:
+        # fdatasync the CURRENT xl.meta of each path the journal
+        # mentions — one sync per distinct path however many times it
+        # was overwritten — then truncate
+        s["rotates_left"] -= 1
+        for _, obj in s["jrnl"]:
+            s["meta_disk"][obj] = s["meta_mem"][obj]
+        s["jrnl"] = []
+
+    m.action("rotate", can_rotate)(do_rotate)
+
+    # -- crash / replay -----------------------------------------------------
+    def can_crash(s) -> bool:
+        return s["up"] and s["crashes_left"] > 0
+
+    def do_crash(s) -> None:
+        # SIGKILL: the queue, the torn journal tail and every
+        # buffered xl.meta write die; the synced journal and the
+        # last-rotated xl.meta survive
+        s["crashes_left"] -= 1
+        s["up"] = False
+        s["queue"] = []
+        s["tail"] = []
+        s["to_ack"] = []
+        s["to_apply"] = []
+        s["meta_mem"] = dict(s["meta_disk"])
+
+    m.action("crash", can_crash)(do_crash)
+
+    def can_replay(s) -> bool:
+        return not s["up"]
+
+    def do_replay(s) -> None:
+        # replay: fold the surviving journal over the on-disk state,
+        # newest sequence wins per path — idempotent, order-free
+        for seq, obj in s["jrnl"]:
+            if seq > s["meta_mem"][obj]:
+                s["meta_mem"][obj] = seq
+        s["up"] = True
+
+    m.action("replay", can_replay)(do_replay)
+
+    # -- invariants ---------------------------------------------------------
+    @m.invariant("acked-commit-durable")
+    def acked_durable(s) -> bool:
+        """Every acked sequence survives a crash at THIS instant: it
+        is covered by the synced journal or by a rotated xl.meta."""
+        return all(s["acked"][o] <= _recoverable(s, o) for o in OBJS)
+
+    @m.invariant("xlmeta-never-regresses")
+    def never_regresses(s) -> bool:
+        """What a reader sees is never older than the last rotation
+        made durable — neither apply, crash fallback nor replay may
+        move an object's xl.meta backwards past it."""
+        return all(s["meta_mem"][o] >= s["meta_disk"][o] for o in OBJS)
+
+    @m.terminal("newest-seq-wins")
+    def newest_wins(s) -> bool:
+        """Quiescence: every object's xl.meta equals the newest
+        durable commit and covers every ack — whatever the apply
+        interleaving, crash points and replay count along the way."""
+        for o in OBJS:
+            if s["meta_mem"][o] != _recoverable(s, o):
+                return False
+            if s["meta_mem"][o] < s["acked"][o]:
+                return False
+        return True
+
+    # wedge-freedom: a quiescent state must have nothing left to
+    # flush, sync, ack or apply (crash/replay must converge, never
+    # strand a batch)
+    m.done = lambda s: (not s["queue"] and not s["tail"]
+                        and not s["to_ack"] and not s["to_apply"])
+
+    # -- seeded mutations ---------------------------------------------------
+    @m.mutation("ack-before-fsync",
+                "waiters are woken off the written-but-unsynced tail "
+                "— a crash rips the torn tail off the journal and the "
+                "acked commit is gone")
+    def ack_early(mut: Model) -> None:
+        def ack_tail(s) -> None:
+            for seq, obj in s["tail"]:
+                if seq > s["acked"][obj]:
+                    s["acked"][obj] = seq
+
+        mut.replace_action(
+            "ack_batch",
+            guard=lambda s: s["up"] and bool(s["tail"]),
+            effect=ack_tail)
+
+    @m.mutation("rotate-skips-meta-sync",
+                "rotation truncates the journal without fdatasyncing "
+                "the xl.meta files it covers — the only durable copy "
+                "of every acked commit is deleted")
+    def rotate_no_sync(mut: Model) -> None:
+        def rotate_truncate_only(s) -> None:
+            s["rotates_left"] -= 1
+            s["jrnl"] = []
+
+        mut.replace_action("rotate", effect=rotate_truncate_only)
+
+    @m.mutation("rotate-drops-unapplied",
+                "rotation no longer waits for the batch to be applied "
+                "— it syncs the STALE xl.meta, truncates, and the "
+                "acked-but-unapplied commit survives nowhere")
+    def rotate_early(mut: Model) -> None:
+        mut.replace_action(
+            "rotate",
+            guard=lambda s: (s["up"] and bool(s["jrnl"])
+                             and s["rotates_left"] > 0))
+
+    @m.mutation("apply-ignores-seq-order",
+                "apply writes the record's bytes unconditionally "
+                "instead of newest-seq-wins — a batched same-object "
+                "overwrite applied out of order rolls xl.meta back")
+    def apply_unconditional(mut: Model) -> None:
+        for i in range(MAX_INFLIGHT):
+            def apply_clobber(s, i=i) -> None:
+                seq, obj = s["to_apply"].pop(i)
+                s["meta_mem"][obj] = seq  # no max() fold
+
+            mut.replace_action(f"apply_{i}", effect=apply_clobber)
+
+    @m.mutation("replay-skips-journal",
+                "replay restores only the last-rotated xl.meta state "
+                "and never folds the surviving journal over it — "
+                "every acked commit since the last rotation vanishes")
+    def replay_no_journal(mut: Model) -> None:
+        mut.replace_action(
+            "replay", effect=lambda s: s.update(up=True))
+
+    @m.mutation("replay-clobbers-newer-meta",
+                "replay rebuilds xl.meta from the journal ALONE — an "
+                "empty post-rotation journal rolls every object back "
+                "past the rotated durable state")
+    def replay_journal_only(mut: Model) -> None:
+        def replay_clobber(s) -> None:
+            for obj in OBJS:
+                best = 0
+                for seq, o in s["jrnl"]:
+                    if o == obj and seq > best:
+                        best = seq
+                s["meta_mem"][obj] = best  # ignores meta_disk
+            s["up"] = True
+
+        mut.replace_action("replay", effect=replay_clobber)
+
+    @m.mutation("committer-wedges-after-crash",
+                "the committer thread is never restarted after a "
+                "crash: post-replay commits enqueue forever and the "
+                "queue wedges")
+    def committer_wedges(mut: Model) -> None:
+        mut.replace_action(
+            "flush_write",
+            guard=lambda s: (s["up"] and bool(s["queue"])
+                             and s["crashes_left"] > 0))
+
+    return m
+
+
+@register("metajournal")
+def factory(deep: bool = False) -> Model:
+    return build(deep=deep)

@@ -77,21 +77,38 @@ def versions_from_xl(bucket: str, name: str, raw: bytes) -> list[ObjectInfo]:
     return versions
 
 
-def union_walk(disks, bucket: str, prefix: str = "") -> list[str]:
-    """Union of per-drive sorted walks, filtered to the (arbitrary string)
-    prefix.  The walk starts from the deepest directory the prefix implies
-    — an S3 prefix need not end on a '/' boundary, so 'photos/sum' walks
-    'photos/' and string-filters the rest.  Raises VolumeNotFound only
-    when NO drive has the bucket dir (a fresh replacement drive must not
-    hide the set's objects)."""
+def union_walk(disks, bucket: str, prefix: str = "",
+               marker: str = "") -> list[str]:
+    """Union of per-drive sorted name streams, filtered to the
+    (arbitrary string) prefix.  A drive whose metadata index can serve
+    the bucket (journal-fed sorted segments, ISSUE 17) answers by
+    merge-reading them — no directory IO; other drives walk.  The walk
+    starts from the deepest directory the prefix implies — an S3 prefix
+    need not end on a '/' boundary, so 'photos/sum' walks 'photos/' and
+    string-filters the rest.  `marker` is a performance pushdown only
+    (index drives binary-search to it; walked names are NOT sliced —
+    callers filter, as before).  Raises VolumeNotFound only when NO
+    drive has the bucket dir (a fresh replacement drive must not hide
+    the set's objects)."""
     base = prefix.rsplit("/", 1)[0] if "/" in prefix else ""
     names: set[str] = set()
+    walked: set[str] = set()
     vol_found = False
     for d in disks:
         if d is None or not d.is_online():
             continue
+        index_names = getattr(d, "index_names", None)
+        if index_names is not None:
+            try:
+                got = index_names(bucket, prefix, marker)
+            except Exception:
+                got = None
+            if got is not None:
+                names.update(got)
+                vol_found = True
+                continue
         try:
-            names.update(d.walk_dir(bucket, base=base))
+            walked.update(d.walk_dir(bucket, base=base))
             vol_found = True
         except errors.VolumeNotFound:
             continue
@@ -99,7 +116,8 @@ def union_walk(disks, bucket: str, prefix: str = "") -> list[str]:
             continue
     if not vol_found:
         raise errors.VolumeNotFound(bucket)
-    return sorted(n for n in names if n.startswith(prefix))
+    names.update(n for n in walked if n.startswith(prefix))
+    return sorted(names)
 
 
 def set_list_entries(eo, bucket: str, prefix: str = "", marker: str = "",
@@ -119,7 +137,7 @@ def set_list_entries(eo, bucket: str, prefix: str = "", marker: str = "",
             return []
         return resolve
 
-    for name in union_walk(eo.disks, bucket, prefix):
+    for name in union_walk(eo.disks, bucket, prefix, marker=marker):
         if marker and (name < marker
                        or (name == marker and not include_marker)):
             continue

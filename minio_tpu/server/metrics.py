@@ -632,6 +632,56 @@ class MetricsMixin:
         except Exception:
             pass
 
+        # metadata plane (storage/metajournal.py, ISSUE 17): commit-
+        # journal batching economics (commits vs batches is THE
+        # coalescing signal), rotation/replay volume and the sorted-
+        # segment index footprint.  Presence-guarded on live journals,
+        # so MINIO_TPU_META_JOURNAL=0 stays metrics-identical to the
+        # per-commit-fsync server.
+        try:
+            from minio_tpu.storage import metajournal as _mj
+
+            msnap = _mj.metrics_snapshot()
+            if msnap:
+                gauge("minio_meta_journals",
+                      "Drives running a metadata commit journal",
+                      msnap["journals"])
+                gauge("minio_meta_journal_queue_length",
+                      "Commits waiting for the next group flush across "
+                      "drives", msnap["queue_depth"])
+                gauge("minio_meta_journal_commits_total",
+                      "xl.meta commits acknowledged through the "
+                      "journal", msnap["commits"])
+                gauge("minio_meta_journal_batches_total",
+                      "Group-fsync flush batches (commits/batches = "
+                      "mean coalescing factor)", msnap["batches"])
+                gauge("minio_meta_journal_last_batch_size",
+                      "Largest most-recent flush batch across drives",
+                      msnap["last_batch"])
+                gauge("minio_meta_journal_flush_seconds_total",
+                      "Seconds spent in journal flushes (write + group "
+                      "fsync + buffered applies)",
+                      round(msnap["flush_seconds"], 6))
+                gauge("minio_meta_journal_rotations_total",
+                      "Journal rotations (in-place xl.meta syncs + "
+                      "truncate)", msnap["rotations"])
+                gauge("minio_meta_journal_replayed_total",
+                      "Paths recovered by startup crash replay",
+                      msnap["replayed"])
+                gauge("minio_meta_journal_bytes",
+                      "Bytes currently in journal files awaiting "
+                      "rotation", msnap["journal_bytes"])
+                gauge("minio_meta_index_segments_count",
+                      "Sorted index segments on disk across drives",
+                      msnap["segments"])
+                gauge("minio_meta_index_spills_total",
+                      "Memtable-to-segment spills", msnap["spills"])
+                gauge("minio_meta_index_compaction_bytes_total",
+                      "Bytes written by full-merge segment compaction",
+                      msnap["compaction_bytes"])
+        except Exception:
+            pass
+
         # multi-process data plane (parallel/workers.py): job/commit
         # volume through the worker plane plus its supervision health —
         # workerDeaths counts in-flight-failing deaths, restarts counts

@@ -139,6 +139,10 @@ class DataScanner:
         self.deep_heals_queued = 0
         self.buckets_skipped = 0
         self.subtree_rescans = 0  # bounded (non-full) bucket walks
+        # dirty-subtree rescans whose name enumeration was served by
+        # the drives' metadata index instead of directory walks
+        # (ISSUE 17: bloom picks the prefixes, the index enumerates)
+        self.index_passes = 0
         # brownout hook: callable -> bool; False defers the cycle while
         # foreground load is shedding (wired by ServiceManager)
         self.throttle = None
@@ -368,6 +372,13 @@ class DataScanner:
                 dirty = sorted(
                     s for s in segs
                     if self.tracker.prefix_dirty(bucket, s))
+                # dirty-prefix enumeration rides the metadata index
+                # when any drive can serve it (union_walk probes
+                # per-drive index_names before walking)
+                indexed = any(
+                    getattr(d, "index_available", None) is not None
+                    and d.index_available(bucket)
+                    for d in es.disks if d is not None)
                 temp = UsageTree()
                 seen: set[str] = set()
                 ok = True
@@ -387,6 +398,8 @@ class DataScanner:
                         tree.replace_top(seg, temp_sub)
                     out[bucket] = tree
                     self.subtree_rescans += 1
+                    if indexed:
+                        self.index_passes += 1
                     continue
             # full walk
             tree = UsageTree()

@@ -25,7 +25,7 @@ import numpy as _np
 
 from minio_tpu.utils.deadline import service_thread
 
-from . import errors
+from . import errors, metajournal
 from .api import DiskInfo, StorageAPI, VolInfo
 from .xlmeta import NULL_VERSION_ID, FileInfo, XLMeta, file_info_from_raw
 
@@ -438,6 +438,26 @@ class LocalStorage(StorageAPI):
         self._reaper: threading.Thread | None = None
         os.makedirs(self.root, exist_ok=True)
         os.makedirs(os.path.join(self.root, SYSTEM_VOL, TMP_DIR), exist_ok=True)
+        # xl.meta commit journal (ISSUE 17): replay a leftover journal
+        # unconditionally — a crashed journal-on process followed by a
+        # journal-off one must still recover its acked commits and must
+        # not leave a stale journal behind to clobber newer writes
+        self._journal: metajournal.MetaJournal | None = None
+        self._index_stale = False  # journal-off invalidation, once
+        if metajournal.JOURNAL_ENABLED:
+            self._journal = metajournal.MetaJournal(
+                self.root, self._apply_xl_raw, self._apply_unlink_raw,
+                list_names=self._walk_names, fsync=FSYNC_ENABLED)
+            self._meta_index = self._journal.index
+        else:
+            metajournal.startup_replay(
+                self.root, self._apply_xl_raw, self._apply_unlink_raw,
+                fsync=FSYNC_ENABLED)
+            # read-only index view: still serves listings if this
+            # process never mutates metadata (first mutation drops the
+            # VALID marker)
+            self._meta_index = metajournal.MetaIndex(
+                self.root, fsync=FSYNC_ENABLED)
         # reap trash a previous process left behind (crash mid-reap)
         trash = os.path.join(self.root, SYSTEM_VOL, TRASH_DIR)
         if os.path.isdir(trash) and os.listdir(trash):
@@ -603,6 +623,10 @@ class LocalStorage(StorageAPI):
         p = self._vol_path(volume)
         if not os.path.isdir(p):
             raise errors.VolumeNotFound(volume)
+        if volume != SYSTEM_VOL:
+            # the bucket's index dies with it (segments would otherwise
+            # resurrect its names if the bucket is recreated)
+            self._meta_index.drop_bucket(volume)
         if force:
             if not self._move_to_trash(p):
                 shutil.rmtree(p, ignore_errors=True)
@@ -822,13 +846,96 @@ class LocalStorage(StorageAPI):
         fi = file_info_from_raw(raw, volume, path, version_id, read_data)
         return fi
 
+    # -- journal plumbing (ISSUE 17) ----------------------------------------
+    def _apply_xl_raw(self, bucket: str, path: str, data: bytes) -> None:
+        """Buffered xl.meta apply (tmp+rename, NO sync): durability is
+        the journal's group fsync; rotation/replay sync the file.
+
+        Hot-path economies (the committer is the ONLY caller, plus the
+        single-threaded startup replay, so one reusable tmp name under
+        the sys dir is race-free): no per-write uuid tmp, and makedirs
+        only on the ENOENT fallback — the target dir almost always
+        exists.  os.replace is atomic across dirs on the same fs, the
+        same .minio.sys/tmp -> bucket rename MinIO itself does."""
+        p = self._meta_path(bucket, path)
+        tmp = os.path.join(self.root, SYSTEM_VOL, "xl-apply.tmp")
+        flags = os.O_WRONLY | os.O_CREAT | os.O_TRUNC
+        try:
+            fd = os.open(tmp, flags, 0o644)
+        except FileNotFoundError:
+            os.makedirs(os.path.dirname(tmp), exist_ok=True)
+            fd = os.open(tmp, flags, 0o644)
+        try:
+            os.write(fd, data)
+        finally:
+            os.close(fd)
+        try:
+            os.replace(tmp, p)
+        except FileNotFoundError:
+            os.makedirs(os.path.dirname(p), exist_ok=True)
+            os.replace(tmp, p)
+
+    def _apply_unlink_raw(self, bucket: str, path: str) -> None:
+        """Idempotent object-dir removal for journal apply/replay."""
+        try:
+            self.delete(bucket, path, recursive=True)
+        except errors.FileNotFound:
+            pass  # replayed unlink already applied
+
+    def _walk_names(self, bucket: str):
+        """Name stream for background index seeding."""
+        return self.walk_dir(bucket)
+
+    def _mark_index_stale(self) -> None:
+        """Journal-off metadata mutation: the on-disk index can no
+        longer trust itself (one unlink, then a cached flag)."""
+        if not self._index_stale:
+            self._index_stale = True
+            self._meta_index.invalidate()
+
+    def index_names(self, bucket: str, prefix: str = "",
+                    marker: str = "") -> list[str] | None:
+        """Sorted live object names from the metadata index, or None
+        when the index can't serve this bucket (caller walks)."""
+        if bucket == SYSTEM_VOL:
+            return None
+        try:
+            return self._meta_index.names(bucket, prefix, marker)
+        except Exception:
+            return None
+
+    def index_available(self, bucket: str) -> bool:
+        return bucket != SYSTEM_VOL and self._meta_index.is_valid() \
+            and self._meta_index.bucket_seeded(bucket)
+
     def _write_xl(self, volume: str, path: str, xl: XLMeta) -> None:
+        if self._journal is not None and volume != SYSTEM_VOL:
+            try:
+                # blocks until the group fsync lands AND the buffered
+                # xl.meta rename is visible (read-your-writes)
+                self._journal.commit(volume, _clean(path), xl.dumps())
+                return
+            except metajournal.JournalDead:
+                pass  # committer gone: fall through to the synced path
+        if volume != SYSTEM_VOL:
+            self._mark_index_stale()
         p = self._meta_path(volume, path)
-        os.makedirs(os.path.dirname(p), exist_ok=True)
         tmp = p + f".tmp.{uuid.uuid4().hex[:8]}"
-        with open(tmp, "wb") as f:
-            f.write(xl.dumps())
-            _fdatasync(f)
+        flags = os.O_WRONLY | os.O_CREAT | os.O_TRUNC
+        try:
+            fd = os.open(tmp, flags, 0o644)
+        except FileNotFoundError:
+            os.makedirs(os.path.dirname(p), exist_ok=True)
+            fd = os.open(tmp, flags, 0o644)
+        try:
+            os.write(fd, xl.dumps())
+            if FSYNC_ENABLED:
+                if hasattr(os, "fdatasync"):
+                    os.fdatasync(fd)
+                else:  # pragma: no cover - macOS fallback
+                    os.fsync(fd)
+        finally:
+            os.close(fd)
         os.replace(tmp, p)
         _fsync_dir(os.path.dirname(p))
 
@@ -882,7 +989,17 @@ class LocalStorage(StorageAPI):
                 self._discard_dir(dpath)
         if xl.versions:
             self._write_xl(volume, path, xl)
+        elif self._journal is not None and volume != SYSTEM_VOL:
+            # journaled unlink: durable once the group fsync lands,
+            # tombstoned in the index, replayed idempotently on crash
+            try:
+                self._journal.unlink(volume, _clean(path))
+            except metajournal.JournalDead:
+                self._mark_index_stale()
+                self.delete(volume, path, recursive=True)
         else:
+            if volume != SYSTEM_VOL:
+                self._mark_index_stale()
             self.delete(volume, path, recursive=True)
 
     def free_version_data(self, volume: str, path: str, version_id: str,

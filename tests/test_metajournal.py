@@ -1,0 +1,577 @@
+"""xl.meta commit journal + sorted-segment metadata index (ISSUE 17).
+
+Crash-replay kill-point fuzz (committer killed before/mid/after the
+group fsync, torn journal tail), the acked-commit durability invariant
+(zero lost, zero duplicated — records carry full xl.meta state so
+replay is idempotent), journal-on/off byte identity, index
+serving/tombstones/compaction, and metacache-invalidation-vs-index
+coherence under concurrent PUTs.  Protocol model:
+analysis/concurrency/models/metajournal.py.
+"""
+
+import io
+import os
+import threading
+
+import pytest
+
+from minio_tpu.erasure import listing
+from minio_tpu.erasure.sets import ErasureSets
+from minio_tpu.storage import errors, metajournal
+from minio_tpu.storage.local import LocalStorage
+from minio_tpu.storage.xlmeta import (
+    ErasureInfo, FileInfo, ObjectPartInfo, XLMeta,
+)
+
+
+def _fi(name, version="", mod_time=1000.0, size=0):
+    return FileInfo(
+        volume="bkt", name=name, version_id=version, data_dir="",
+        mod_time=mod_time, size=size, data=None,
+        erasure=ErasureInfo(
+            algorithm="rs-vandermonde", data_blocks=2, parity_blocks=1,
+            block_size=1 << 20, index=1, distribution=[1, 2, 3],
+        ),
+        parts=[ObjectPartInfo(1, size, size)],
+    )
+
+
+def _xl_bytes(name, versions):
+    """Deterministic xl.meta bytes for `name` with the given version
+    ids (oldest first, increasing mod_time)."""
+    xl = XLMeta()
+    for i, v in enumerate(versions):
+        xl.add_version(_fi(name, version=v, mod_time=1000.0 + i))
+    return xl.dumps()
+
+
+@pytest.fixture
+def jman(monkeypatch):
+    """Journal-on LocalStorage factory; closes every journal it opened
+    (the committer holds an append fd — the session fd-leak check
+    fails otherwise) and disarms kill points on teardown."""
+    monkeypatch.setattr(metajournal, "JOURNAL_ENABLED", True)
+    monkeypatch.setattr(metajournal, "AUTOSEED", False)
+    made = []
+
+    def make(root, journal_on=True):
+        monkeypatch.setattr(metajournal, "JOURNAL_ENABLED", journal_on)
+        d = LocalStorage(str(root))
+        made.append(d)
+        return d
+
+    yield make
+    metajournal.KILL_POINTS.clear()
+    for d in made:
+        if d._journal is not None and not d._journal.closed:
+            d._journal.close()
+
+
+def _restart(make, root, journal_on=True):
+    """Crash-restart: disarm kill points and mount a fresh LocalStorage
+    over the same drive root (startup replay runs in __init__)."""
+    metajournal.KILL_POINTS.clear()
+    return make(root, journal_on=journal_on)
+
+
+# ---------------------------------------------------------------------------
+# basic journaled-commit semantics
+# ---------------------------------------------------------------------------
+class TestJournalCommit:
+    def test_commit_roundtrip_and_batching(self, jman, tmp_path):
+        d = jman(tmp_path / "d0")
+        d.make_volume("bkt")
+        for i in range(10):
+            d.write_metadata("bkt", f"o{i}", _fi(f"o{i}", "v1"))
+        for i in range(10):
+            assert d.read_version("bkt", f"o{i}").version_id == "v1"
+        j = d._journal
+        assert j.commits == 10
+        assert 1 <= j.batches <= 10
+        assert os.path.getsize(j.path) > 0  # records retained until rotation
+        snap = metajournal.metrics_snapshot()
+        assert snap["commits"] >= 10 and snap["journals"] >= 1
+
+    def test_journal_dead_falls_back_to_synced_path(self, jman, tmp_path):
+        d = jman(tmp_path / "d0")
+        d.make_volume("bkt")
+        metajournal.KILL_POINTS.add("pre_write")
+        with pytest.raises(metajournal.JournalDead):
+            d._journal.commit("bkt", "x", _xl_bytes("x", ["v1"]))
+        metajournal.KILL_POINTS.clear()
+        # the storage API stays available: _write_xl falls through to the
+        # direct synced path (and drops the index VALID marker)
+        d.write_metadata("bkt", "y", _fi("y", "v1"))
+        assert d.read_version("bkt", "y").version_id == "v1"
+        assert not d._meta_index.is_valid()
+
+    def test_clean_shutdown_then_restart_replays(self, jman, tmp_path):
+        root = tmp_path / "d0"
+        d = jman(root)
+        d.make_volume("bkt")
+        for i in range(5):
+            d.write_metadata("bkt", f"o{i}", _fi(f"o{i}", "v1"))
+        d._journal.close()  # no rotation ran: journal.bin still holds records
+        d2 = _restart(jman, root)
+        assert d2._journal.replayed == 5  # idempotent re-apply, not a loss
+        for i in range(5):
+            assert d2.read_version("bkt", f"o{i}").version_id == "v1"
+
+
+# ---------------------------------------------------------------------------
+# kill-point fuzz: committer dies before/mid/after flush
+# ---------------------------------------------------------------------------
+FLUSH_POINTS = ("pre_write", "post_write", "post_sync",
+                "mid_apply", "post_apply")
+
+
+class TestKillPoints:
+    @pytest.mark.parametrize("point", FLUSH_POINTS)
+    def test_single_commit_outcome(self, jman, tmp_path, point):
+        """v1 acked, then the committer dies at `point` flushing v2.
+        After restart the object is v1 (kill before the journal write)
+        or the full v2 state (record reached the journal) — never a
+        torn or duplicated state."""
+        root = tmp_path / "d0"
+        d = jman(root)
+        d.make_volume("bkt")
+        v1 = _xl_bytes("o", ["v1"])
+        v2 = _xl_bytes("o", ["v1", "v2"])
+        d._journal.commit("bkt", "o", v1)
+
+        metajournal.KILL_POINTS.add(point)
+        with pytest.raises(metajournal.JournalDead):
+            d._journal.commit("bkt", "o", v2)
+
+        d2 = _restart(jman, root)
+        got = d2.read_xl("bkt", "o")
+        if point == "pre_write":
+            assert got == v1  # v2 never reached the journal
+        else:
+            assert got == v2  # durable in the journal -> replayed
+        assert len(XLMeta.loads(got).versions) in (1, 2)  # no duplication
+
+    @pytest.mark.parametrize("point", FLUSH_POINTS)
+    def test_concurrent_fuzz_no_lost_no_duplicated(self, jman, tmp_path,
+                                                   point):
+        root = tmp_path / "d0"
+        d = jman(root)
+        d.make_volume("bkt")
+        baseline = {f"base/{i}": _xl_bytes(f"base/{i}", ["v1"])
+                    for i in range(4)}
+        for name, raw in baseline.items():
+            d._journal.commit("bkt", name, raw)
+
+        fuzz = {f"fuzz/{i}": _xl_bytes(f"fuzz/{i}", ["v1"])
+                for i in range(8)}
+        acked, failed = [], []
+        lock = threading.Lock()
+        metajournal.KILL_POINTS.add(point)
+
+        def put(name, raw):
+            try:
+                d._journal.commit("bkt", name, raw)
+                with lock:
+                    acked.append(name)
+            except metajournal.JournalDead:
+                with lock:
+                    failed.append(name)
+
+        ts = [threading.Thread(target=put, args=kv) for kv in fuzz.items()]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(acked) + len(failed) == len(fuzz)
+
+        d2 = _restart(jman, root)
+        # zero lost: every ACKED commit survives with its exact bytes
+        for name in acked:
+            assert d2.read_xl("bkt", name) == fuzz[name]
+        for name, raw in baseline.items():
+            assert d2.read_xl("bkt", name) == raw
+        # zero duplicated / torn: an un-acked commit is either absent or
+        # the exact single-version state that was submitted
+        for name, raw in fuzz.items():
+            try:
+                got = d2.read_xl("bkt", name)
+            except errors.FileNotFound:
+                continue
+            assert got == raw
+            assert len(XLMeta.loads(got).versions) == 1
+        if point == "pre_write":
+            # nothing reached the journal: no fuzz object may survive
+            for name in set(fuzz) - set(acked):
+                with pytest.raises(errors.FileNotFound):
+                    d2.read_xl("bkt", name)
+
+    @pytest.mark.parametrize("point",
+                             ("pre_rotate", "pre_truncate", "post_rotate"))
+    def test_kill_during_rotation_keeps_acked(self, jman, tmp_path,
+                                              monkeypatch, point):
+        """Rotation syncs xl.meta in place and truncates the journal; a
+        crash at any step must keep every ACKED commit recoverable."""
+        root = tmp_path / "d0"
+        d = jman(root)
+        d.make_volume("bkt")
+        raws = {f"o{i}": _xl_bytes(f"o{i}", ["v1"]) for i in range(3)}
+        for name, raw in raws.items():
+            d._journal.commit("bkt", name, raw)  # acked
+        monkeypatch.setattr(metajournal, "ROTATE_BYTES", 1)
+        metajournal.KILL_POINTS.add(point)
+        # this commit acks (flush completes), then rotation dies
+        extra = _xl_bytes("extra", ["v1"])
+        d._journal.commit("bkt", "extra", extra)
+        d._journal._thread.join(timeout=5.0)
+        assert d._journal._dead
+
+        monkeypatch.setattr(metajournal, "ROTATE_BYTES", 8 << 20)
+        d2 = _restart(jman, root)
+        for name, raw in {**raws, "extra": extra}.items():
+            assert d2.read_xl("bkt", name) == raw
+
+    def test_unlink_replay_idempotent(self, jman, tmp_path):
+        """A journaled unlink that crashed mid-apply replays cleanly
+        (the object stays gone, replaying over its absence is a no-op)."""
+        root = tmp_path / "d0"
+        d = jman(root)
+        d.make_volume("bkt")
+        d._journal.commit("bkt", "o", _xl_bytes("o", ["v1"]))
+        metajournal.KILL_POINTS.add("post_sync")  # unlink durable, unapplied
+        with pytest.raises(metajournal.JournalDead):
+            d._journal.unlink("bkt", "o")
+        d2 = _restart(jman, root)
+        with pytest.raises(errors.FileNotFound):
+            d2.read_xl("bkt", "o")
+        d3 = _restart(jman, root)  # replay over the tombstoned state
+        with pytest.raises(errors.FileNotFound):
+            d3.read_xl("bkt", "o")
+
+
+# ---------------------------------------------------------------------------
+# torn tail + newest-seq-wins replay
+# ---------------------------------------------------------------------------
+class TestReplay:
+    def _journal_path(self, root):
+        jdir = os.path.join(str(root), ".minio_tpu.sys",
+                            metajournal.JOURNAL_DIR)
+        os.makedirs(jdir, exist_ok=True)
+        return os.path.join(jdir, metajournal.JOURNAL_FILE)
+
+    def test_torn_tail_dropped_prefix_applied(self, jman, tmp_path):
+        root = tmp_path / "d0"
+        a1 = _xl_bytes("a", ["v1"])
+        a2 = _xl_bytes("a", ["v1", "v2"])
+        b1 = _xl_bytes("b", ["v1"])
+        torn = metajournal.encode_record(
+            4, metajournal.OP_COMMIT, "bkt", "c", _xl_bytes("c", ["v1"]))
+        with open(self._journal_path(root), "wb") as f:
+            f.write(metajournal.encode_record(
+                1, metajournal.OP_COMMIT, "bkt", "a", a1))
+            f.write(metajournal.encode_record(
+                2, metajournal.OP_COMMIT, "bkt", "b", b1))
+            f.write(metajournal.encode_record(
+                3, metajournal.OP_COMMIT, "bkt", "a", a2))
+            f.write(torn[:len(torn) // 2])  # the un-fsynced torn tail
+
+        d = jman(root, journal_on=False)  # replay runs even journal-off
+        assert d.read_xl("bkt", "a") == a2  # newest seq wins for 'a'
+        assert d.read_xl("bkt", "b") == b1
+        with pytest.raises(errors.FileNotFound):
+            d.read_xl("bkt", "c")  # torn record never applied
+        assert not os.path.exists(self._journal_path(root).replace(
+            "journal.bin", "journal.bin")) or \
+            os.path.getsize(self._journal_path(root)) == 0
+
+    def test_corrupt_crc_stops_replay_at_tail(self, jman, tmp_path):
+        root = tmp_path / "d0"
+        a1 = _xl_bytes("a", ["v1"])
+        bad = bytearray(metajournal.encode_record(
+            2, metajournal.OP_COMMIT, "bkt", "b", _xl_bytes("b", ["v1"])))
+        bad[-1] ^= 0xFF  # flip a payload byte: CRC check must reject it
+        with open(self._journal_path(root), "wb") as f:
+            f.write(metajournal.encode_record(
+                1, metajournal.OP_COMMIT, "bkt", "a", a1))
+            f.write(bytes(bad))
+        d = jman(root, journal_on=False)
+        assert d.read_xl("bkt", "a") == a1
+        with pytest.raises(errors.FileNotFound):
+            d.read_xl("bkt", "b")
+
+    def test_decode_records_roundtrip(self):
+        recs = [(i, metajournal.OP_COMMIT if i % 2 else metajournal.OP_UNLINK,
+                 "bkt", f"p/{i}", b"d" * i) for i in range(1, 6)]
+        buf = b"".join(metajournal.encode_record(*r) for r in recs)
+        assert list(metajournal.decode_records(buf)) == recs
+        # a short header tail is ignored too
+        assert list(metajournal.decode_records(buf + b"\x01\x02")) == recs
+
+
+# ---------------------------------------------------------------------------
+# journal-on/off byte identity
+# ---------------------------------------------------------------------------
+def _xl_tree(root):
+    out = {}
+    for cur, _dirs, files in os.walk(root):
+        if ".minio_tpu.sys" in cur:
+            continue
+        for f in files:
+            if f == "xl.meta":
+                p = os.path.join(cur, f)
+                with open(p, "rb") as fh:
+                    out[os.path.relpath(p, root)] = fh.read()
+    return out
+
+
+def test_byte_identity_journal_on_vs_off(jman, tmp_path):
+    """The same op sequence leaves byte-identical xl.meta state with the
+    journal on and off (the gate changes durability mechanics only)."""
+    def drive_ops(d):
+        d.make_volume("bkt")
+        for i in range(6):
+            d.write_metadata("bkt", f"o/{i}", _fi(f"o/{i}", "v1"))
+        for i in range(0, 6, 2):  # overwrite: adds v2
+            d.write_metadata("bkt", f"o/{i}",
+                             _fi(f"o/{i}", "v2", mod_time=2000.0))
+        d.delete_version("bkt", "o/1", _fi("o/1", "v1"))     # -> unlink
+        d.delete_version("bkt", "o/2", _fi("o/2", "v1"))     # keeps v2
+
+    d_on = jman(tmp_path / "on", journal_on=True)
+    d_off = jman(tmp_path / "off", journal_on=False)
+    drive_ops(d_on)
+    drive_ops(d_off)
+    on_tree = _xl_tree(d_on.root)
+    off_tree = _xl_tree(d_off.root)
+    assert on_tree == off_tree
+    assert len(on_tree) == 5  # o/1 unlinked, o/0..5 minus it
+
+
+# ---------------------------------------------------------------------------
+# index: serving, tombstones, spill/compaction, trust
+# ---------------------------------------------------------------------------
+class TestMetaIndex:
+    def test_names_serve_prefix_marker_tombstone(self, jman, tmp_path):
+        d = jman(tmp_path / "d0")
+        d.make_volume("bkt")
+        for i in range(20):
+            d.write_metadata("bkt", f"a/{i:03d}", _fi(f"a/{i:03d}", "v"))
+        d.write_metadata("bkt", "b/x", _fi("b/x", "v"))
+        assert d.index_names("bkt") is None  # unseeded: caller walks
+        d._journal.seed_bucket("bkt")
+        names = d.index_names("bkt")
+        assert names == sorted([f"a/{i:03d}" for i in range(20)] + ["b/x"])
+        assert d.index_names("bkt", prefix="b/") == ["b/x"]
+        assert d.index_names("bkt", marker="a/017") == \
+            ["a/017", "a/018", "a/019", "b/x"]
+        # memtable layered over the seed segment
+        d.write_metadata("bkt", "a/new", _fi("a/new", "v"))
+        assert "a/new" in d.index_names("bkt", prefix="a/")
+        # unlink tombstones the name
+        d.delete_version("bkt", "a/005", _fi("a/005", "v"))
+        assert "a/005" not in d.index_names("bkt")
+
+    def test_union_walk_serves_from_index_without_dir_io(self, jman,
+                                                         tmp_path):
+        d = jman(tmp_path / "d0")
+        d.make_volume("bkt")
+        for i in range(5):
+            d.write_metadata("bkt", f"o{i}", _fi(f"o{i}", "v"))
+        d._journal.seed_bucket("bkt")
+
+        def boom(*a, **k):
+            raise AssertionError("index-served listing must not walk")
+
+        d.walk_dir = boom
+        assert listing.union_walk([d], "bkt") == [f"o{i}" for i in range(5)]
+
+    def test_spill_compaction_preserves_names(self, jman, tmp_path,
+                                              monkeypatch):
+        monkeypatch.setattr(metajournal, "COMPACT_SEGMENTS", 2)
+        d = jman(tmp_path / "d0")
+        d.make_volume("bkt")
+        d._journal.seed_bucket("bkt")
+        idx = d._meta_index
+        expect = set()
+        for r in range(4):
+            for i in range(6):
+                name = f"r{r}/o{i}"
+                d.write_metadata("bkt", name, _fi(name, "v"))
+                expect.add(name)
+            idx.spill()  # one segment per round
+        d.delete_version("bkt", "r0/o0", _fi("r0/o0", "v"))
+        expect.discard("r0/o0")
+        idx.spill()
+        idx.compact("bkt")
+        assert set(d.index_names("bkt")) == expect
+        # full merge folded everything into one live segment (+ nothing
+        # stale left on disk) and dropped the tombstone
+        segs = idx._load_segs("bkt")
+        assert len(segs) == 1
+        assert idx.compaction_bytes > 0
+        merged = dict(idx._merge(segs, {}, b""))
+        assert merged.get(b"r0/o0") is None  # tombstone died in the merge
+
+    def test_rotation_spills_memtable_and_truncates(self, jman, tmp_path,
+                                                    monkeypatch):
+        monkeypatch.setattr(metajournal, "ROTATE_BYTES", 1)
+        d = jman(tmp_path / "d0")
+        d.make_volume("bkt")
+        d._journal.seed_bucket("bkt")
+        for i in range(5):
+            d.write_metadata("bkt", f"o{i}", _fi(f"o{i}", "v"))
+        d._journal.drain()
+        # rotation runs just after the final flush acks: poll briefly
+        import time as _t
+        deadline = _t.monotonic() + 5.0
+        while _t.monotonic() < deadline \
+                and os.path.getsize(d._journal.path) != 0:
+            _t.sleep(0.005)
+        assert d._journal.rotations >= 1
+        assert os.path.getsize(d._journal.path) == 0
+        assert set(d.index_names("bkt")) == {f"o{i}" for i in range(5)}
+
+    def test_journal_off_mutation_invalidates_index(self, jman, tmp_path):
+        root = tmp_path / "d0"
+        d = jman(root)
+        d.make_volume("bkt")
+        d.write_metadata("bkt", "o", _fi("o", "v"))
+        d._journal.seed_bucket("bkt")
+        d._journal.close()
+
+        d2 = _restart(jman, root, journal_on=False)
+        # read-only journal-off process: the persisted index still serves
+        assert d2.index_names("bkt") == ["o"]
+        # ... until the first unjournaled mutation drops the trust marker
+        d2.write_metadata("bkt", "o2", _fi("o2", "v"))
+        assert d2.index_names("bkt") is None
+        assert not d2._meta_index.is_valid()
+
+        # journal-on restart finds VALID missing: wipe + start over
+        d3 = _restart(jman, root, journal_on=True)
+        assert d3._meta_index.is_valid()
+        assert not d3._meta_index.bucket_seeded("bkt")
+        d3._journal.seed_bucket("bkt")
+        assert set(d3.index_names("bkt")) == {"o", "o2"}
+
+    def test_delete_volume_drops_bucket_index(self, jman, tmp_path):
+        d = jman(tmp_path / "d0")
+        d.make_volume("bkt")
+        d.write_metadata("bkt", "o", _fi("o", "v"))
+        d.delete_version("bkt", "o", _fi("o", "v"))
+        d._journal.drain()
+        d._journal.seed_bucket("bkt")
+        d.delete_volume("bkt")
+        assert not d._meta_index.bucket_seeded("bkt")
+        assert not os.path.isdir(d._meta_index._bucket_dir("bkt"))
+
+
+# ---------------------------------------------------------------------------
+# metacache invalidation vs index coherence under concurrent PUTs
+# ---------------------------------------------------------------------------
+class TestListingCoherence:
+    def test_concurrent_puts_visible_after_ack(self, jman, tmp_path):
+        """Apply-then-ack: an object is in every drive's index before
+        its PUT returns, and the metacache invalidation makes the next
+        listing re-walk — so a fresh LIST never misses an acked PUT."""
+        disks = [jman(tmp_path / f"d{i}") for i in range(4)]
+        es = ErasureSets(disks, set_size=4)
+        es.make_bucket("mb")
+        es.put_object("mb", "seed/0", io.BytesIO(b"x"), 1)
+        for d in disks:
+            d._journal.seed_bucket("mb")
+
+        # prime the metacache with a truncated page (it persists names)
+        page = listing.list_objects(es, "mb", max_keys=1)
+        assert page.entries[0].name == "seed/0"
+
+        missed = []
+        lock = threading.Lock()
+
+        def worker(t):
+            for i in range(8):
+                name = f"w{t}/o{i}"
+                es.put_object("mb", name, io.BytesIO(b"y"), 1)
+                got = listing.list_objects(es, "mb", prefix=f"w{t}/",
+                                           max_keys=100)
+                if name not in [e.name for e in got.entries]:
+                    with lock:
+                        missed.append(name)
+
+        ts = [threading.Thread(target=worker, args=(t,)) for t in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert missed == []
+        # every drive's index converged on the full namespace
+        expect = {"seed/0"} | {f"w{t}/o{i}" for t in range(4)
+                               for i in range(8)}
+        for d in disks:
+            assert set(d.index_names("mb")) == expect
+
+    def test_metrics_family_gated_and_rendered(self, jman, tmp_path):
+        """minio_meta_* renders only while journals are live (the
+        journal-off scrape stays byte-identical to the seed's)."""
+        from tests.s3_harness import S3TestServer
+
+        from minio_tpu.erasure.sets import ErasureServerPools
+
+        off = [jman(tmp_path / "off" / f"d{i}", journal_on=False)
+               for i in range(4)]
+        srv = S3TestServer(str(tmp_path / "off"), pools=ErasureServerPools(
+            [ErasureSets(off, set_size=4)]))
+        try:
+            assert srv.request("PUT", "/mbkt").status == 200
+            m = srv.request("GET", "/minio/v2/metrics/cluster")
+            assert m.status == 200
+            assert b"minio_meta_" not in m.body
+        finally:
+            srv.close()
+
+        disks = [jman(tmp_path / "on" / f"d{i}") for i in range(4)]
+        pools = ErasureServerPools([ErasureSets(disks, set_size=4)])
+        srv = S3TestServer(str(tmp_path / "on"), pools=pools)
+        try:
+            assert srv.request("PUT", "/mbkt").status == 200
+            assert srv.request("PUT", "/mbkt/o", data=b"x").status == 200
+            m = srv.request("GET", "/minio/v2/metrics/cluster")
+            assert m.status == 200
+            scrape = m.body.decode()
+            for fam in ("minio_meta_journals",
+                        "minio_meta_journal_queue_length",
+                        "minio_meta_journal_commits_total",
+                        "minio_meta_journal_batches_total",
+                        "minio_meta_journal_flush_seconds_total",
+                        "minio_meta_journal_rotations_total",
+                        "minio_meta_journal_replayed_total",
+                        "minio_meta_index_segments_count",
+                        "minio_meta_index_compaction_bytes_total"):
+                assert fam in scrape, fam
+            commits = next(
+                float(line.split()[-1]) for line in scrape.splitlines()
+                if line.startswith("minio_meta_journal_commits_total "))
+            assert commits >= 2  # one xl.meta commit per drive at least
+        finally:
+            srv.close()
+
+    def test_scanner_incremental_pass_rides_index(self, jman, tmp_path):
+        from minio_tpu.services.scanner import DataScanner
+        from minio_tpu.utils.bloom import DataUpdateTracker
+
+        disks = [jman(tmp_path / f"d{i}") for i in range(4)]
+        es = ErasureSets(disks, set_size=4)
+        es.make_bucket("big")
+        tracker = DataUpdateTracker()
+        for i in range(10):
+            es.put_object("big", f"cold/o{i}", io.BytesIO(b"x"), 1)
+        for d in disks:
+            d._journal.seed_bucket("big")
+        sc = DataScanner(es, autostart=False, tracker=tracker)
+        sc.scan_cycle()  # full walk primes the per-set tree
+
+        tracker.mark("big", "hot/new")
+        es.put_object("big", "hot/new", io.BytesIO(b"y"), 1)
+        sc.scan_cycle()
+        assert sc.subtree_rescans >= 1
+        assert sc.index_passes >= 1  # the bounded rescan was index-served
+        assert sc.usage_by_prefix("big", "")["usage"]["objects"] == 11

@@ -31,9 +31,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 #: erasure batcher's tick/submit/quiesce protocol, ISSUE 13 the
 #: per-tenant QoS DRR admit/release/reweight/shed protocol, ISSUE 14
 #: the pool-drain suspend/copy/fence/delete/checkpoint protocol,
-#: ISSUE 16 the geo-replication push/ack/retry/resync protocol)
+#: ISSUE 16 the geo-replication push/ack/retry/resync protocol,
+#: ISSUE 17 the xl.meta commit journal's flush/ack/rotate/replay
+#: protocol)
 LOAD_BEARING = ("arena-ring", "hotcache", "breaker-mrf", "batcher", "qos",
-                "topology", "georep")
+                "topology", "georep", "metajournal")
 
 
 # ------------------------------------------------------------- engine
