@@ -1,4 +1,4 @@
 """Protocol models: importing this package registers every model."""
 
-from . import (batcher, breaker, georep, hotcache,  # noqa: F401
-               metajournal, qos, ring, topology)
+from . import (batcher, breaker, controller, georep,  # noqa: F401
+               hotcache, metajournal, qos, ring, topology)

@@ -1,0 +1,303 @@
+"""Model: the overload controller's sample/decide/actuate loop
+(server/controller.py, ISSUE 18) — written BEFORE the implementation,
+per the PR 10 convention (protocol work lands with a model change
+first).
+
+The controller closes the loop between the SLO plane (multi-window
+error-budget burn rates, PR 15) and the actuators that already exist
+(QoS reweights, GET hedging width, brownout background shed).  Each
+tick it SAMPLES a snapshot of burn + QoS stats, then DECIDES: when burn
+stays high it steps one rung up an intervention ladder (reweight, then
+widen hedging, then shed background work); when burn stays low it steps
+back down.  The failure modes a naive controller exhibits are exactly
+what the invariants pin:
+
+* flapping — acting on a single noisy sample, or re-acting before the
+  previous action had time to take effect;
+* acting on a stale snapshot — an admin reconfigured the plane between
+  sample and act, so the decision is about a world that no longer
+  exists;
+* one-way ratchets — interventions that never revert once the burn
+  subsides, leaving a throttled tenant or widened hedge forever;
+* unbounded intervention — each tick piles on another action until the
+  controller has taken the server away from its operator.
+
+Modelled shape: a single intervention ladder ``depth`` in
+[0, MAX_DEPTH] stands for the controller's total intervention level
+(the implementation keys one ladder per action family; the protocol is
+identical).  The environment raises and lowers a burn signal within a
+finite spike budget — a spike that subsides immediately is a blip the
+hysteresis must ride out, one that persists is a regime shift the
+controller must answer.  An admin action may invalidate a sampled
+snapshot before the controller acts on it (the live `PUT /qos` race).
+Every burn subsidence refills the controller's tick budget, so
+quiescence is only reachable after the controller had ample post-
+recovery ticks — which is what lets "every action reverts" be a
+terminal (quiescent-state) invariant rather than hand-waved liveness.
+
+Invariants:
+
+* ``no-flapping``            — an engage fires only after H consecutive
+                               high samples, a revert only after L
+                               consecutive low samples, and neither
+                               fires while the per-action cooldown from
+                               the previous decision is still running.
+* ``fresh-snapshot-only``    — a decision consumes only a snapshot that
+                               is still valid; an invalidated snapshot
+                               is discarded and resampled, never acted
+                               on.
+* ``bounded-intervention``   — 0 <= depth <= MAX_DEPTH at every state,
+                               and the engaged flag tracks depth > 0
+                               exactly (no ghost engagement).
+* ``reverts-when-burn-subsides`` — terminal: a quiescent system (burn
+                               low, environment exhausted, ticks spent)
+                               has fully stepped back down: depth == 0.
+
+Every invariant is proven live by a seeded mutation (tier-1 pins the
+matrix in tests/test_modelcheck.py): engage-without-hysteresis,
+revert-without-hysteresis, change-ignores-cooldown,
+acts-on-stale-snapshot, revert-dropped, unbounded-intervention.
+"""
+
+from __future__ import annotations
+
+from ..modelcheck import Model, register
+
+#: hysteresis: consecutive high samples required to engage
+H = 2
+#: hysteresis: consecutive low samples required to revert
+L = 2
+#: cooldown ticks after any decision before the next may fire
+COOLDOWN = 2
+#: intervention ladder bound
+MAX_DEPTH = 2
+#: tick budget granted after every burn subsidence — enough for a full
+#: worst-case step-down (MAX_DEPTH reverts, each needing L samples plus
+#: a cooldown gap) plus snapshots an admin race may invalidate
+REFILL = 10
+
+
+def _act(s, h: int = H, low: int = L, respect_cooldown: bool = True,
+         allow_revert: bool = True, depth_max: int = MAX_DEPTH,
+         require_fresh: bool = True) -> None:
+    """The decide step on a previously sampled snapshot.  Mutations
+    perturb it via kwargs so the base discipline stays in one place;
+    effects RECORD the condition an invariant asserts (the qos model's
+    bad_shed pattern) so a guard-removing mutation is caught."""
+    s["has_snap"] = False
+    if require_fresh and not s["snap_valid"]:
+        # base guard never lets this fire; the stale-snapshot mutation
+        # relaxes the guard and lands here
+        s["acted_stale"] = True
+        return
+    if not s["snap_valid"]:
+        s["acted_stale"] = True
+    snap = s["snap"]
+    pre_cooldown = s["cooldown"]
+    # streaks saturate at the base hysteresis windows: beyond the
+    # threshold extra history does not change any decision, and the
+    # cap keeps the state space small
+    if snap:
+        s["streak_high"] = min(s["streak_high"] + 1, H)
+        s["streak_low"] = 0
+    else:
+        s["streak_low"] = min(s["streak_low"] + 1, L)
+        s["streak_high"] = 0
+    decided = False
+    if snap and s["streak_high"] >= h \
+            and (not respect_cooldown or pre_cooldown == 0) \
+            and s["depth"] < depth_max:
+        # engage one rung; record any discipline the mutation dropped
+        if s["streak_high"] < H:
+            s["bad_hysteresis"] = True
+        if pre_cooldown > 0:
+            s["flap"] = True
+        s["depth"] += 1
+        s["engaged"] = True
+        s["cooldown"] = COOLDOWN
+        s["streak_high"] = 0
+        decided = True
+    elif (not snap) and allow_revert and s["streak_low"] >= low \
+            and (not respect_cooldown or pre_cooldown == 0) \
+            and s["depth"] > 0:
+        if s["streak_low"] < L:
+            s["bad_hysteresis"] = True
+        if pre_cooldown > 0:
+            s["flap"] = True
+        s["depth"] -= 1
+        s["engaged"] = s["depth"] > 0
+        s["cooldown"] = COOLDOWN
+        s["streak_low"] = 0
+        decided = True
+    if not decided and s["cooldown"] > 0:
+        s["cooldown"] -= 1
+
+
+def build(deep: bool = False) -> Model:
+    spikes = 3 if deep else 2
+    admin = 2 if deep else 1
+    init = {
+        # -- environment --------------------------------------------------
+        "burn": 0,             # the sampled-world burn signal (0/1)
+        "spikes_left": spikes,  # finite budget of burn raises
+        "admin_left": admin,   # finite budget of snapshot invalidations
+        # -- controller ---------------------------------------------------
+        "ticks_left": REFILL,  # sampling budget; refilled on subsidence
+        "has_snap": False,
+        "snap": 0,
+        "snap_valid": True,
+        "streak_high": 0,
+        "streak_low": 0,
+        "cooldown": 0,
+        "depth": 0,
+        "engaged": False,
+        # -- violation recorders (qos bad_shed pattern) --------------------
+        "flap": False,
+        "bad_hysteresis": False,
+        "acted_stale": False,
+        "skipped_stale": 0,
+    }
+    m = Model("controller", init,
+              "SLO burn-rate feedback controller sample/decide loop")
+
+    # -- environment ------------------------------------------------------
+    def can_spike(s) -> bool:
+        return s["spikes_left"] > 0 and s["burn"] == 0
+
+    @m.action("burn_spike", can_spike)
+    def burn_spike(s) -> None:
+        s["spikes_left"] -= 1
+        s["burn"] = 1
+
+    def can_subside(s) -> bool:
+        return s["burn"] == 1
+
+    @m.action("burn_subside", can_subside)
+    def burn_subside(s) -> None:
+        # a subsidence hands the controller a fresh tick budget: the
+        # step-down path must always be reachable, so "reverts when
+        # burn subsides" is checkable at quiescence instead of being
+        # an unverifiable eventually-claim
+        s["burn"] = 0
+        s["ticks_left"] = max(s["ticks_left"], REFILL)
+
+    def can_admin(s) -> bool:
+        return s["admin_left"] > 0 and s["has_snap"] and s["snap_valid"]
+
+    @m.action("admin_invalidates_snapshot", can_admin)
+    def admin_invalidates(s) -> None:
+        # an admin PUT /qos (or /slo flip) lands between sample and
+        # act: the held snapshot now describes a stale world
+        s["admin_left"] -= 1
+        s["snap_valid"] = False
+
+    # -- controller -------------------------------------------------------
+    def can_sample(s) -> bool:
+        return s["ticks_left"] > 0 and not s["has_snap"]
+
+    @m.action("sample", can_sample)
+    def sample(s) -> None:
+        s["ticks_left"] -= 1
+        s["has_snap"] = True
+        s["snap"] = s["burn"]
+        s["snap_valid"] = True
+
+    def can_decide(s) -> bool:
+        return s["has_snap"] and s["snap_valid"]
+
+    @m.action("decide", can_decide)
+    def decide(s) -> None:
+        _act(s)
+
+    def can_discard(s) -> bool:
+        return s["has_snap"] and not s["snap_valid"]
+
+    @m.action("discard_stale", can_discard)
+    def discard_stale(s) -> None:
+        # the base controller REFUSES a stale snapshot: drop it,
+        # count the refusal, resample next tick
+        s["has_snap"] = False
+        s["skipped_stale"] += 1
+
+    # -- invariants -------------------------------------------------------
+    @m.invariant("no-flapping")
+    def no_flapping(s) -> bool:
+        return not s["flap"] and not s["bad_hysteresis"]
+
+    @m.invariant("fresh-snapshot-only")
+    def fresh_snapshot_only(s) -> bool:
+        return not s["acted_stale"]
+
+    @m.invariant("bounded-intervention")
+    def bounded_intervention(s) -> bool:
+        return 0 <= s["depth"] <= MAX_DEPTH \
+            and s["engaged"] == (s["depth"] > 0)
+
+    @m.terminal("reverts-when-burn-subsides")
+    def reverts_when_burn_subsides(s) -> bool:
+        """Quiescence (burn low, spike budget spent, ticks drained)
+        must find the ladder fully stepped down: every intervention the
+        controller took was reverted once the burn subsided."""
+        return s["depth"] == 0 and not s["engaged"]
+
+    # quiescent states must have consumed the tick budget and hold no
+    # undecided snapshot — a wedged sample (never decided nor
+    # discarded) is a deadlock
+    m.done = lambda s: s["ticks_left"] == 0 and not s["has_snap"] \
+        and s["burn"] == 0
+
+    # -- seeded mutations -------------------------------------------------
+    @m.mutation("engage-without-hysteresis",
+                "the controller engages on the FIRST high sample — a "
+                "single noisy reading throttles a tenant (the flapping "
+                "failure hysteresis exists to prevent)")
+    def engage_without_hysteresis(mut: Model) -> None:
+        mut.replace_action("decide", effect=lambda s: _act(s, h=1))
+
+    @m.mutation("revert-without-hysteresis",
+                "the controller reverts on the FIRST low sample — one "
+                "quiet reading undoes the intervention mid-incident "
+                "and the next tick re-engages: oscillation")
+    def revert_without_hysteresis(mut: Model) -> None:
+        mut.replace_action("decide", effect=lambda s: _act(s, low=1))
+
+    @m.mutation("change-ignores-cooldown",
+                "a decision fires while the previous action's cooldown "
+                "is still running — the controller stacks actions "
+                "faster than the plane can show their effect")
+    def change_ignores_cooldown(mut: Model) -> None:
+        mut.replace_action(
+            "decide", effect=lambda s: _act(s, respect_cooldown=False))
+
+    @m.mutation("acts-on-stale-snapshot",
+                "the decide step no longer checks snapshot validity — "
+                "the controller acts on a world an admin already "
+                "reconfigured out from under it")
+    def acts_on_stale_snapshot(mut: Model) -> None:
+        mut.replace_action(
+            "decide",
+            guard=lambda s: s["has_snap"],
+            effect=lambda s: _act(s, require_fresh=False))
+
+    @m.mutation("revert-dropped",
+                "interventions never step back down once burn subsides "
+                "— a one-way ratchet leaves tenants throttled and "
+                "hedges widened forever")
+    def revert_dropped(mut: Model) -> None:
+        mut.replace_action(
+            "decide", effect=lambda s: _act(s, allow_revert=False))
+
+    @m.mutation("unbounded-intervention",
+                "the ladder has no ceiling — every H high samples pile "
+                "on another action until the controller has taken the "
+                "server away from its operator")
+    def unbounded_intervention(mut: Model) -> None:
+        mut.replace_action(
+            "decide", effect=lambda s: _act(s, depth_max=99))
+
+    return m
+
+
+@register("controller")
+def factory(deep: bool = False) -> Model:
+    return build(deep=deep)

@@ -75,6 +75,7 @@ register_subsystem("qos", {
     "default_weight": "1",
     "default_max_concurrency": "0",
     "default_bandwidth": "0",
+    "default_hot_cap": "0",
     "max_queue": "auto",
     "cost_unit": "",
     "max_cost": "",
@@ -93,6 +94,9 @@ register_subsystem("qos", {
     HelpKV("default_bandwidth",
            "per-tenant data-plane bytes/sec for unlisted tenants "
            "(0 = unlimited)", typ="number"),
+    HelpKV("default_hot_cap",
+           "per-tenant hot-lane slot cap for unlisted tenants "
+           "(0 = hot_share fraction of the lane)", typ="number"),
     HelpKV("max_queue",
            "per-tenant admission queue bound before that tenant sheds "
            "503 (auto = 2x requests_max)", typ="number"),
@@ -108,7 +112,8 @@ register_subsystem("qos", {
            "(0.01..1; empty = 0.5 default)", typ="number"),
     HelpKV("tenants",
            'JSON tenant rules: {"bucket:<name>"|"key:<access-key>": '
-           '{"weight": w, "max_concurrency": c, "bandwidth": bps}}'),
+           '{"weight": w, "max_concurrency": c, "bandwidth": bps, '
+           '"hot_cap": n}}'),
 ], dynamic=True)
 
 register_subsystem("slo", {
@@ -118,6 +123,36 @@ register_subsystem("slo", {
            "closed-loop SLO plane (per-class latency/outcome "
            "accounting + error-budget burn); MINIO_TPU_SLO=1/0 "
            "overrides", typ="boolean"),
+], dynamic=True)
+
+register_subsystem("controller", {
+    "enable": "off",
+    "tick": "5s",
+    "burn_fast": "1.0",
+    "hysteresis": "2",
+    "cooldown": "2",
+    "max_depth": "2",
+}, [
+    HelpKV("enable",
+           "SLO burn-rate overload controller (actuates QoS weights, "
+           "GET hedging and background brownout from the live burn "
+           "signal); MINIO_TPU_CONTROLLER=1/0 overrides",
+           typ="boolean"),
+    HelpKV("tick",
+           "controller sampling period (duration, e.g. 5s)"),
+    HelpKV("burn_fast",
+           "fast-window burn rate at/above which a class is treated "
+           "as burning (1.0 = spending budget exactly at the "
+           "objective rate)", typ="number"),
+    HelpKV("hysteresis",
+           "consecutive over/under-threshold ticks before an action "
+           "engages or reverts", typ="number"),
+    HelpKV("cooldown",
+           "ticks after any action before the same ladder may act "
+           "again", typ="number"),
+    HelpKV("max_depth",
+           "intervention ladder ceiling per action family",
+           typ="number"),
 ], dynamic=True)
 
 register_subsystem("audit_kafka", {

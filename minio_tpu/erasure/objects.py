@@ -54,6 +54,36 @@ HEDGE_EWMA_S = float(os.environ.get(
 # observability (read by server/metrics.py); GIL-safe counter bumps
 hedge_stats = {"hedged": 0, "abandoned": 0}
 
+# --- runtime hedge widening (ISSUE 18) -----------------------------------
+# the overload controller (server/controller.py) scales BOTH hedge knobs
+# down together when GET tail-latency burn dominates: a smaller straggler
+# grace abandons post-quorum stragglers sooner and a lower EWMA threshold
+# routes around more slow drives.  The env/default values are captured at
+# import so every actuation is relative to the operator's configuration,
+# and the scale is clamped so no controller bug can disable hedging
+# entirely or widen it without bound.
+_HEDGE_DEFAULTS = (STRAGGLER_GRACE, HEDGE_EWMA_S)
+_HEDGE_SCALE_MIN = 0.25
+_hedge_scale = 1.0
+
+
+def hedge_scale() -> float:
+    """Current widening factor: 1.0 = configured knobs untouched."""
+    return _hedge_scale
+
+
+def set_hedge_scale(scale: float) -> float:
+    """Rescale the hedge knobs from their configured defaults; returns
+    the clamped scale actually applied.  Module globals are read at
+    call time by the fan-out paths, so this takes effect on the next
+    read with no restart."""
+    global STRAGGLER_GRACE, HEDGE_EWMA_S, _hedge_scale
+    s = min(max(float(scale), _HEDGE_SCALE_MIN), 1.0)
+    _hedge_scale = s
+    STRAGGLER_GRACE = _HEDGE_DEFAULTS[0] * s
+    HEDGE_EWMA_S = _HEDGE_DEFAULTS[1] * s
+    return s
+
 # tiering stub metadata (never surfaced to clients)
 TRANSITION_STATUS_KEY = "x-minio-internal-transition-status"
 TRANSITION_TIER_KEY = "x-minio-internal-transition-tier"

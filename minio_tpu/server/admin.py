@@ -208,6 +208,11 @@ class AdminMixin:
         # SLO gate flip (ISSUE 16 satellite): PUT flips the plane live
         # like QoS; GET is registered with the SLO status route below
         r.add_put(f"{p}/slo", wrap(self.admin_slo_set, "ConfigUpdate"))
+        # overload controller (ISSUE 18): live ladder/decision state;
+        # the gate itself flips through the dynamic `controller`
+        # config subsystem (set-config-kv controller enable=on)
+        r.add_get(f"{p}/controller",
+                  wrap(self.admin_controller, "ServerInfo"))
 
     # ---------------------------------------------------------------- auth
     #: admin ops whose duration is the CLIENT's choice (live follows,
@@ -618,7 +623,8 @@ class AdminMixin:
             for field, key in (("weight", "default_weight"),
                                ("max_concurrency",
                                 "default_max_concurrency"),
-                               ("bandwidth", "default_bandwidth")):
+                               ("bandwidth", "default_bandwidth"),
+                               ("hot_cap", "default_hot_cap")):
                 if field in defaults:
                     v = defaults[field]
                     # bool is an int subclass (true would persist as
@@ -679,7 +685,8 @@ class AdminMixin:
                     raise S3Error("InvalidArgument",
                                   f"tenant {key!r} rule must be an "
                                   "object")
-                for field in ("weight", "max_concurrency", "bandwidth"):
+                for field in ("weight", "max_concurrency", "bandwidth",
+                              "hot_cap"):
                     if field in rule and (
                             isinstance(rule[field], bool)
                             or not isinstance(rule[field], (int, float))
@@ -690,7 +697,7 @@ class AdminMixin:
                             f"tenant {key!r}: {field} must be a "
                             "finite number >= 0")
                 unknown = set(rule) - {"weight", "max_concurrency",
-                                       "bandwidth"}
+                                       "bandwidth", "hot_cap"}
                 if unknown:
                     raise S3Error(
                         "InvalidArgument",
@@ -944,6 +951,19 @@ class AdminMixin:
                               "seconds")
         doc = await self._run(plane.status, window, True)
         return web.json_response(doc)
+
+    async def admin_controller(self, request: web.Request,
+                               body: bytes) -> web.Response:
+        """Live overload-controller state (server/controller.py): per-
+        action ladder depth, engagement/revert counts, stale-snapshot
+        refusals and the pool-add recommendation.  With the gate off
+        answers ``{"enabled": false}`` — the controller-off server
+        stays byte-identical elsewhere."""
+        ctrl = getattr(self, "controller", None)
+        out = {"enabled": ctrl is not None}
+        if ctrl is not None:
+            out.update(ctrl.stats())
+        return web.json_response(out)
 
     async def admin_slo_set(self, request: web.Request,
                             body: bytes) -> web.Response:

@@ -390,6 +390,13 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
 
         self.slo = SloPlane.from_config(self.config)
         self.config.on_change("slo", self._apply_slo_config)
+        # self-driving overload plane (ISSUE 18, server/controller.py):
+        # a burn-rate feedback loop actuating QoS weights, GET hedging
+        # and background brownout.  Constructed in attach_services (it
+        # needs the brownout hook); None here keeps the gate-off server
+        # byte- and metrics-identical (pinned by tests/test_controller)
+        self.controller = None
+        self.config.on_change("controller", self._apply_controller_config)
         # Dedicated pool sized to the request semaphore so a full house of
         # blocking object-layer calls can never starve body-feed tasks
         # (reference analogue: maxClients semaphore, cmd/handler-api.go:108).
@@ -454,6 +461,15 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
         """Release every resource this server owns: background services,
         the site-replication worker, the event notifier, and the request
         executor (leak-checked by tests/test_leaks.py)."""
+        if self.controller is not None:
+            # first: the controller's close() reverts every live
+            # actuation, and it must do so while the planes it touched
+            # are still alive
+            try:
+                self.controller.close()
+            except Exception:
+                pass
+            self.controller = None
         if self.services is not None:
             try:
                 self.services.close()
@@ -612,6 +628,15 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
                 _apply_scanner(self.config)
             if self.config.is_set("heal", "interval"):
                 _apply_heal(self.config)
+        # overload controller (ISSUE 18): built here, not in __init__ —
+        # its background-shed actuator is services.brownout
+        if self.controller is None:
+            from .controller import OverloadController
+
+            self.controller = OverloadController.from_config(
+                self, self.config)
+            if self.controller is not None:
+                self.controller.start()
 
     def _quota_check(self, bucket: str, size: int) -> None:
         """Hard-quota enforcement against the scanner's usage cache
@@ -884,6 +909,24 @@ class S3Server(BucketMetaHandlers, ObjectExtraHandlers, SSEMixin, AdminMixin,
             return
         if self.slo is None:
             self.slo = SloPlane.from_config(cfg)
+
+    def _apply_controller_config(self, cfg) -> None:
+        """Dynamic `controller` subsystem apply: the overload
+        controller starts/stops at runtime.  Stopping reverts every
+        live actuation (OverloadController.close is a stand-down, not
+        an abandonment)."""
+        from .controller import OverloadController
+
+        if not OverloadController.gate_enabled(cfg):
+            if self.controller is not None:
+                ctrl = self.controller
+                self.controller = None
+                ctrl.close()
+            return
+        if self.controller is None:
+            self.controller = OverloadController.from_config(self, cfg)
+            if self.controller is not None:
+                self.controller.start()
 
     async def _qos_throttle(self, request: web.Request, n: int,
                             direction: str) -> None:
@@ -3447,5 +3490,9 @@ def make_app(object_layer, start_services: bool = False,
 
         srv.attach_services(
             ServiceManager(object_layer, scan_interval=scan_interval))
+    else:
+        # no background services, but attach_services still runs the
+        # post-wiring that doesn't need them (overload controller)
+        srv.attach_services(None)
     srv.app[S3_SERVER_KEY] = srv
     return srv.app

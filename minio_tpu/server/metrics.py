@@ -490,6 +490,42 @@ class MetricsMixin:
             if any_burn:
                 g("\n".join(rows) + "\n")
 
+        # self-driving overload plane (server/controller.py, ISSUE 18):
+        # tick/skip counters, per-action ladder depth and decision
+        # counts, and the pool-add recommendation.  Rendered only while
+        # the controller is on, so MINIO_TPU_CONTROLLER=0 stays
+        # metrics-identical (pinned by tests/test_controller.py).
+        ctrl = getattr(self, "controller", None)
+        if ctrl is not None:
+            cs = ctrl.stats()
+            gauge("minio_controller_ticks_total",
+                  "Controller sampling ticks since start", cs["ticks"])
+            gauge("minio_controller_skipped_stale_total",
+                  "Decisions refused because the snapshot went stale "
+                  "between sample and act", cs["skippedStale"])
+            gauge("minio_controller_pool_add_recommended",
+                  "1 while the controller recommends adding a pool "
+                  "(execution stays admin-gated)",
+                  int(cs["poolAddRecommended"]))
+            rows = ["# HELP minio_controller_active Intervention "
+                    "ladder depth per action family",
+                    "# TYPE minio_controller_active gauge"]
+            arow = ["# HELP minio_controller_actions_total Controller "
+                    "decisions per action family and direction",
+                    "# TYPE minio_controller_actions_total gauge"]
+            for name, a in sorted(cs["actions"].items()):
+                lbl = _fmt_labels(("action",), (name,))
+                rows.append(f"minio_controller_active{lbl} "
+                            f"{a['depth']}")
+                for direction, field in (("engage", "engagements"),
+                                         ("revert", "reverts")):
+                    lbl = _fmt_labels(("action", "direction"),
+                                      (name, direction))
+                    arow.append(f"minio_controller_actions_total{lbl} "
+                                f"{a[field]}")
+            g("\n".join(rows) + "\n")
+            g("\n".join(arow) + "\n")
+
         # topology plane (ISSUE 14): pool drain/rebalance volume and
         # retry/fail classification plus site-resync push economics —
         # the drain-induced-load forensics surface next to the

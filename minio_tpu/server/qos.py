@@ -92,10 +92,10 @@ class TenantRule:
     """Admin-settable per-tenant parameters (a missing field falls back
     to the default class)."""
 
-    __slots__ = ("weight", "max_concurrency", "bandwidth")
+    __slots__ = ("weight", "max_concurrency", "bandwidth", "hot_cap")
 
     def __init__(self, weight: float = 1.0, max_concurrency: int = 0,
-                 bandwidth: int = 0):
+                 bandwidth: int = 0, hot_cap: int = 0):
         # NaN poisons the deficit arithmetic (deficit >= 1.0 is never
         # True — total tenant starvation from one config typo) and
         # int(inf) raises: non-finite values degrade to the neutral
@@ -109,11 +109,16 @@ class TenantRule:
             else 0
         bw = float(bandwidth)
         self.bandwidth = max(int(bw), 0) if math.isfinite(bw) else 0
+        # per-tenant hot-lane slot cap (ISSUE 18 satellite): 0 = fall
+        # back to the plane-level hot_share bound
+        hc = float(hot_cap)
+        self.hot_cap = max(int(hc), 0) if math.isfinite(hc) else 0
 
     def to_dict(self) -> dict:
         return {"weight": self.weight,
                 "max_concurrency": self.max_concurrency,
-                "bandwidth": self.bandwidth}
+                "bandwidth": self.bandwidth,
+                "hot_cap": self.hot_cap}
 
     @classmethod
     def from_dict(cls, doc: dict, default: "TenantRule") -> "TenantRule":
@@ -121,7 +126,8 @@ class TenantRule:
             weight=doc.get("weight", default.weight),
             max_concurrency=doc.get("max_concurrency",
                                     default.max_concurrency),
-            bandwidth=doc.get("bandwidth", default.bandwidth))
+            bandwidth=doc.get("bandwidth", default.bandwidth),
+            hot_cap=doc.get("hot_cap", default.hot_cap))
 
 
 class _TenantState:
@@ -218,6 +224,11 @@ class QosPlane:
         # in-flight requests at a runtime gate flip (seed_external)
         self._last_gc = time.monotonic()
         self._loop = None       # event loop, learned at first enqueue
+        # generation counter, bumped on every reconfigure: the overload
+        # controller (server/controller.py) pins the generation it
+        # sampled and refuses to act when an admin write moved it —
+        # the never-acts-on-a-stale-snapshot invariant, live
+        self.reconfigures = 0
 
     # -- construction --------------------------------------------------------
     @staticmethod
@@ -277,7 +288,9 @@ class QosPlane:
                 knob("MINIO_TPU_QOS_DEFAULT_MAX_CONCURRENCY",
                      "default_max_concurrency"), 0)),
             bandwidth=int(num(knob("MINIO_TPU_QOS_DEFAULT_BANDWIDTH",
-                                   "default_bandwidth"), 0)))
+                                   "default_bandwidth"), 0)),
+            hot_cap=int(num(knob("MINIO_TPU_QOS_DEFAULT_HOT_CAP",
+                                 "default_hot_cap"), 0)))
         rules = self._parse_rules(
             knob("MINIO_TPU_QOS_TENANTS", "tenants"), default)
         mq_raw = knob("MINIO_TPU_QOS_MAX_QUEUE", "max_queue")
@@ -319,6 +332,7 @@ class QosPlane:
                 self.hot_share = min(max(float(hot_share), 0.01), 1.0)
             for st in self._tenants.values():
                 st.apply_rule(self.rules.get(st.key, self.default_rule))
+            self.reconfigures += 1
             loop = self._loop
         # a raised cap/weight can make parked waiters eligible NOW:
         # kick a dispatch sweep on the event loop (reconfigure runs on
@@ -616,8 +630,18 @@ class QosPlane:
 
     # -- hot-lane accounting (ISSUE 13 satellite) ----------------------------
     def hot_cap(self) -> int:
-        """Per-tenant hot-lane slot bound: hot_share of the lane."""
+        """Plane-level per-tenant hot-lane slot bound: hot_share of
+        the lane (tenants without an explicit rule cap)."""
         return max(1, int(self.hot_capacity * self.hot_share))
+
+    def hot_cap_of(self, st: "_TenantState") -> int:
+        """Effective hot-lane bound for ONE tenant (ISSUE 18
+        satellite): an explicit TenantRule.hot_cap wins (clamped to
+        the lane size); 0 falls back to the uniform hot_share bound,
+        so existing configs behave exactly as before."""
+        if st.rule.hot_cap > 0:
+            return min(st.rule.hot_cap, self.hot_capacity)
+        return self.hot_cap()
 
     def hot_lane_try(self, tenant: str) -> bool:
         """Claim one per-tenant hot-lane slot (ISSUE 16 satellite).
@@ -627,7 +651,7 @@ class QosPlane:
         other tenants' hits (counted hotLaneCapped)."""
         with self._mu:
             st = self._state_locked(tenant)
-            if st.hot_inflight >= self.hot_cap():
+            if st.hot_inflight >= self.hot_cap_of(st):
                 st.hot_capped += 1
                 return False
             st.hot_inflight += 1
@@ -683,6 +707,7 @@ class QosPlane:
                     "weight": st.rule.weight,
                     "maxConcurrency": st.rule.max_concurrency,
                     "bandwidth": st.rule.bandwidth,
+                    "hotCap": self.hot_cap_of(st),
                     "inflight": st.inflight,
                     "queueDepth": st.depth(),
                     "deficit": round(st.deficit, 6),

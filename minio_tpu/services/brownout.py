@@ -28,12 +28,14 @@ class BrownoutController:
         self.release_after = release_after  # quiet seconds before release
         self._mu = threading.Lock()
         self._engaged = False
+        self._forced = False   # held engaged by the overload controller
         self._last_pressure = 0.0
         self.engagements = 0
         self.releases = 0
         self.sheds_seen = 0
         self.deferrals = 0
         self.hot_bypasses = 0
+        self.forced_engagements = 0
 
     # -- pressure inputs (API front) ----------------------------------------
     def note_pressure(self, queue_depth: int) -> None:
@@ -64,6 +66,24 @@ class BrownoutController:
                 self._engaged = True
                 self.engagements += 1
 
+    # -- controller actuation (server/controller.py, ISSUE 18) --------------
+    def force(self, on: bool) -> None:
+        """Hold the brownout engaged regardless of API pressure — the
+        overload controller sheds background work on fast-window SLO
+        burn the pressure heuristics haven't seen yet.  Releasing the
+        force does NOT release the brownout directly: the normal
+        time-based release path clears it on the next poll, so the two
+        control inputs compose instead of fighting."""
+        with self._mu:
+            if on and not self._forced:
+                self._forced = True
+                self.forced_engagements += 1
+                if not self._engaged:
+                    self._engaged = True
+                    self.engagements += 1
+            elif not on:
+                self._forced = False
+
     # -- queries (background services) --------------------------------------
     def engaged(self) -> bool:
         with self._mu:
@@ -80,7 +100,7 @@ class BrownoutController:
             return True
 
     def _check_release_locked(self) -> None:
-        if self._engaged and \
+        if self._engaged and not self._forced and \
                 time.monotonic() - self._last_pressure >= self.release_after:
             self._engaged = False
             self.releases += 1
@@ -90,6 +110,8 @@ class BrownoutController:
             self._check_release_locked()
             return {
                 "engaged": self._engaged,
+                "forced": self._forced,
+                "forcedEngagements": self.forced_engagements,
                 "engagements": self.engagements,
                 "releases": self.releases,
                 "shedsSeen": self.sheds_seen,

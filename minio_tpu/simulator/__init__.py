@@ -10,7 +10,9 @@ surface.
 """
 
 from .engine import ScenarioEngine, build_schedule, schedule_digest
-from .scenarios import Scenario, builtin_scenarios, georep_scenarios
+from .scenarios import (Scenario, builtin_scenarios,
+                        controller_scenarios, georep_scenarios)
 
 __all__ = ["Scenario", "ScenarioEngine", "build_schedule",
-           "builtin_scenarios", "georep_scenarios", "schedule_digest"]
+           "builtin_scenarios", "controller_scenarios",
+           "georep_scenarios", "schedule_digest"]

@@ -100,23 +100,64 @@ def build_schedule(sc) -> list[dict]:
     zw = _zipf_weights(len(names), sc.zipf_s)
     ops = [op for op, _ in sc.ops]
     weights = [w for _, w in sc.ops]
+    # per-bucket workload override (ISSUE 18): one choices() draw per
+    # request either way, so scenarios without bucket_ops keep their
+    # exact RNG stream (and their pinned digests)
+    bops = {b: ([o for o, _ in mix], [w for _, w in mix])
+            for b, mix in (getattr(sc, "bucket_ops", None) or {}).items()}
+    bops_post = {b: ([o for o, _ in mix], [w for _, w in mix])
+                 for b, mix in (getattr(sc, "bucket_ops_post_flip",
+                                        None) or {}).items()}
+    bclients = getattr(sc, "bucket_clients", None) or {}
     quiet = list(sc.buckets[1:]) or list(sc.buckets)
+    profile = getattr(sc, "rate_profile", ()) or ()
+    flip_frac = getattr(sc, "mix_flip_at_frac", None)
+    flip_at = None if flip_frac is None else flip_frac * sc.duration_s
+
+    def rate_at(now: float) -> float:
+        # piecewise regime-shift multiplier (ISSUE 18): still a pure
+        # function of the scenario, so the digest pins the shift
+        for lo, hi, mult in profile:
+            if lo * sc.duration_s <= now < hi * sc.duration_s:
+                return sc.rate * mult
+        return sc.rate
+
     sched: list[dict] = []
     written: dict[str, list[str]] = {b: [] for b in sc.buckets}
     t = 0.0
     i = 0
     while True:
-        t += rng.expovariate(sc.rate)
+        t += rng.expovariate(rate_at(t))
         if t >= sc.duration_s:
             break
-        if sc.hot_bucket_frac is not None:
+        if flip_at is not None and sc.hot_bucket_frac is not None:
+            # tenant-mix flip: the hot role moves to buckets[1]; the
+            # displaced bucket joins the quiet set.  Gated on the flip
+            # field so pre-existing scenarios keep their exact RNG
+            # stream (and therefore their pinned schedule digests).
+            hot_i = 0 if t < flip_at else 1 % len(sc.buckets)
+            others = [b for j, b in enumerate(sc.buckets)
+                      if j != hot_i] or list(sc.buckets)
+            bucket = sc.buckets[hot_i] \
+                if rng.random() < sc.hot_bucket_frac \
+                else others[rng.randrange(len(others))]
+        elif sc.hot_bucket_frac is not None:
             bucket = sc.buckets[0] if rng.random() < sc.hot_bucket_frac \
                 else quiet[rng.randrange(len(quiet))]
         else:
             bucket = sc.buckets[rng.randrange(len(sc.buckets))]
-        op = rng.choices(ops, weights=weights)[0]
+        cur = bops
+        if bops_post and flip_at is not None and t >= flip_at \
+                and bucket in bops_post:
+            cur = bops_post  # the flood itself moved tenants
+        b_ops, b_weights = cur.get(bucket, (ops, weights))
+        op = rng.choices(b_ops, weights=b_weights)[0]
         ent = {"i": i, "t": round(t, 6), "client": i % sc.clients,
                "op": op, "bucket": bucket}
+        span = bclients.get(bucket)
+        if span is not None:
+            # dedicated pool: the bucket's own clients, round-robin
+            ent["client"] = span[0] + i % span[1]
         if op in ("get", "head"):
             ent["key"] = rng.choices(names, weights=zw)[0]
         elif op == "put":
@@ -587,10 +628,21 @@ class ScenarioEngine:
                 violations.append(
                     f"bucket:{bucket}: p99 {b['p99Ms']}ms > "
                     f"{tgt_p99}ms")
+            tgt_p50 = targets.get("p50_ms")
+            if tgt_p50 is not None and b["p50Ms"] > tgt_p50:
+                violations.append(
+                    f"bucket:{bucket}: p50 {b['p50Ms']}ms > "
+                    f"{tgt_p50}ms")
             shed_max = targets.get("shed_max")
             if shed_max is not None and b["shed"] > shed_max:
                 violations.append(
                     f"bucket:{bucket}: {b['shed']} sheds > {shed_max}")
+            shed_frac = targets.get("shed_frac_max")
+            if shed_frac is not None and b["count"] \
+                    and b["shed"] / b["count"] > shed_frac:
+                violations.append(
+                    f"bucket:{bucket}: shed fraction "
+                    f"{b['shed'] / b['count']:.4f} > {shed_frac}")
 
         doc = {
             "name": sc.name,

@@ -14,7 +14,8 @@ Scenario SLO grammar::
     slo = {
       "classes": {"GET": {"p99_ms": 400, "availability": 0.995}},
       "shed_fraction_max": 0.05,          # client-side 503 fraction
-      "buckets": {"simquiet": {"p99_ms": 800, "shed_max": 0}},
+      "buckets": {"simquiet": {"p99_ms": 800, "p50_ms": 200,
+                               "shed_max": 0, "shed_frac_max": 0.1}},
     }
 
 ``classes`` asserts against the server's own accounting (the admin SLO
@@ -49,6 +50,33 @@ class Scenario:
     mpu_last_bytes: int = 64 << 10
     list_max_keys: int = 100
     slo: dict = field(default_factory=dict)
+    #: deterministic REGIME SHIFTS (ISSUE 18): piecewise arrival-rate
+    #: multipliers ((start_frac, end_frac, mult), ...) applied inside
+    #: build_schedule's Poisson loop — still a pure function of the
+    #: scenario, so the schedule digest pins the shifted shape too
+    rate_profile: tuple = ()
+    #: tenant-mix flip: from this fraction of the run on, the hot role
+    #: (hot_bucket_frac) moves from buckets[0] to buckets[1]; the
+    #: displaced bucket joins the quiet set.  None = no flip.
+    mix_flip_at_frac: float | None = None
+    #: per-bucket op-mix override ``{bucket: ((op, weight), ...)}`` —
+    #: tenants with different WORKLOADS (a PUT-flood offender vs a
+    #: GET-only victim).  Buckets absent here draw from ``ops``.
+    #: Gated: scenarios without it keep their exact RNG stream.
+    bucket_ops: dict | None = None
+    #: role swap riding ``mix_flip_at_frac``: from the flip on,
+    #: buckets named here draw THIS mix instead of their
+    #: ``bucket_ops`` one — the flood itself moves tenants, not just
+    #: the arrival share.  Gated the same way.
+    bucket_ops_post_flip: dict | None = None
+    #: dedicated client pools ``{bucket: (first_client, n_clients)}``:
+    #: that bucket's entries replay on their own closed-loop client
+    #: span.  Without this, a stalled offender throttles the victim's
+    #: OFFERED load too (every client serves every bucket, and a
+    #: closed loop equalizes), hiding the very starvation a scenario
+    #: wants to grade.  Buckets absent here keep the global
+    #: ``i % clients`` assignment.  Gated: no extra RNG draws.
+    bucket_clients: dict | None = None
     chaos: str | None = None         # engine chaos-hook name
     chaos_at_frac: float = 0.25      # hook start, fraction of duration
     chaos_dur_frac: float = 0.5      # hook length, fraction of duration
@@ -139,6 +167,123 @@ def builtin_scenarios(scale: float = 1.0) -> list[Scenario]:
             description="PR 14 harness shape: a pool decommission "
                         "starts mid-traffic; reads stay findable "
                         "mid-move, writes route to live pools"),
+    ]
+
+
+def controller_scenarios(scale: float = 1.0) -> list[Scenario]:
+    """The regime-shift family (ISSUE 18): each scenario is replayed
+    TWICE by ``bench.py controller`` — once with the static config only
+    (``MINIO_TPU_CONTROLLER=0``) and once with the overload controller
+    on — against a deliberately scarce server (4 admission slots,
+    600ms request deadline, hot cache off, a ~40ms floor on every
+    drive op) so saturation is a property of the schedule, not of box
+    noise.
+
+    The starvation mechanism is SLOT-TIME, not grant share.  The DRR
+    admission sweep is grant-fair: every backlogged tenant is visited
+    each round, so a cost-1 victim cannot lose the weight game — but
+    grants are not seconds.  A PUT costs ~10 serialized drive ops
+    (xl.meta + shards + dirs) against a GET's ~2, so a PUT-flood
+    tenant holds an admission slot ~4x longer per grant, the pool's
+    RELEASE RATE collapses, and a GET victim whose demand exceeds
+    release_rate/#backlogged starves into 600ms-deadline sheds — with
+    the static config's weights (offender 16, victim 1) doing nothing
+    to stop it.  The controller's rescue is the one actuator that
+    prices slot-TIME: the offender's max_concurrency rung bounds how
+    many slots its slow PUTs may occupy, restoring the release rate
+    for everyone else.  The flooding tenant is EXPECTED to shed (its
+    demand exceeds capacity by design; under the controller its own
+    queue backs up even further), so the aggregate shed budgets are
+    deliberately loose — victim isolation, not total shed volume, is
+    what is being graded.
+
+    Every scenario partitions its clients (``bucket_clients``): the
+    victim drives the server from its OWN closed-loop pool.  With a
+    shared pool a client stalled on a flooded request stops issuing
+    victim requests too, the victim's offered load collapses in
+    lockstep with the overload, and the grant-fair sweep trivially
+    drains the shrunken victim backlog — the closed loop itself would
+    hide the starvation from the verdict."""
+    d = lambda s: max(3.0, s * scale)  # noqa: E731
+    victim_ops = (("get", 100),)
+    flood_ops = (("put", 70), ("get", 30))
+    return [
+        Scenario(
+            name="flash_crowd", seed=1801, duration_s=d(15),
+            clients=26, rate=16.0,
+            ops=(("get", 100),),
+            buckets=("flashhot", "flashquiet"), hot_bucket_frac=0.7,
+            bucket_ops={"flashhot": flood_ops,
+                        "flashquiet": victim_ops},
+            bucket_clients={"flashhot": (0, 18),
+                            "flashquiet": (18, 8)},
+            nobjects=16, obj_bytes=(4 << 10, 32 << 10),
+            put_bytes=(64 << 10, 256 << 10),
+            rate_profile=((0.3, 1.0, 3.0),),
+            qos={"enable": True, "max_queue": 64, "tenants": {
+                "bucket:flashhot": {"weight": 16},
+                "bucket:flashquiet": {"weight": 1}}},
+            slo={"buckets": {
+                "flashquiet": {"shed_frac_max": 0.25, "p50_ms": 520.0}},
+                "shed_fraction_max": 0.9},
+            description="flash crowd: arrivals triple from 30% of the "
+                        "run on; the PUT-flood tenant's slow writes "
+                        "hold the 4 admission slots and the GET "
+                        "tenant starves unless the offender is "
+                        "conc-capped"),
+        Scenario(
+            name="tenant_mix_flip", seed=1802, duration_s=d(14),
+            clients=26, rate=42.0,
+            ops=(("get", 100),),
+            buckets=("mixa", "mixb", "mixquiet"), hot_bucket_frac=0.55,
+            bucket_ops={"mixa": flood_ops, "mixb": victim_ops,
+                        "mixquiet": victim_ops},
+            bucket_ops_post_flip={"mixa": victim_ops,
+                                  "mixb": flood_ops},
+            bucket_clients={"mixa": (0, 9), "mixb": (9, 9),
+                            "mixquiet": (18, 8)},
+            nobjects=16, obj_bytes=(4 << 10, 32 << 10),
+            put_bytes=(64 << 10, 256 << 10),
+            mix_flip_at_frac=0.5,
+            qos={"enable": True, "max_queue": 64, "tenants": {
+                "bucket:mixa": {"weight": 16},
+                "bucket:mixb": {"weight": 16},
+                "bucket:mixquiet": {"weight": 1}}},
+            slo={"buckets": {
+                "mixquiet": {"shed_frac_max": 0.3, "p50_ms": 500.0}},
+                "shed_fraction_max": 0.9},
+            description="tenant-mix flip: the PUT flood moves from "
+                        "tenant A to tenant B mid-run; a static cap "
+                        "on A is useless after the flip — the "
+                        "controller must re-identify the offender and "
+                        "retarget its cap in one reconfigure"),
+        Scenario(
+            name="brownout_noisy_stacked", seed=1803,
+            duration_s=d(14), clients=26, rate=42.0,
+            ops=(("get", 100),),
+            buckets=("stackhot", "stackquiet"), hot_bucket_frac=0.7,
+            bucket_ops={"stackhot": flood_ops,
+                        "stackquiet": victim_ops},
+            bucket_clients={"stackhot": (0, 18),
+                            "stackquiet": (18, 8)},
+            nobjects=16, obj_bytes=(4 << 10, 32 << 10),
+            put_bytes=(64 << 10, 256 << 10),
+            chaos="disk", chaos_at_frac=0.3, chaos_dur_frac=0.5,
+            qos={"enable": True, "max_queue": 64, "tenants": {
+                "bucket:stackhot": {"weight": 16},
+                "bucket:stackquiet": {"weight": 1}}},
+            slo={"buckets": {
+                # shed is the discriminator here: the victim's p50
+                # rides the chaos disk's added latency, which the
+                # controller can route around (hedge) but not remove —
+                # the p50 clause is a deadline bound, not the grade
+                "stackquiet": {"shed_frac_max": 0.4, "p50_ms": 650.0}},
+                "shed_fraction_max": 0.9},
+            description="stacked faults: a PUT flood saturates "
+                        "admission while one drive turns slow+flaky "
+                        "mid-run; the controller stacks the QoS cap, "
+                        "wider read hedging, and a forced background "
+                        "brownout"),
     ]
 
 

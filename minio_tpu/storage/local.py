@@ -623,7 +623,19 @@ class LocalStorage(StorageAPI):
         p = self._vol_path(volume)
         if not os.path.isdir(p):
             raise errors.VolumeNotFound(volume)
-        if volume != SYSTEM_VOL:
+        if force and volume != SYSTEM_VOL and self._journal is not None:
+            # durable tombstone BEFORE the dir goes: a crash mid-delete
+            # replays the tombstone instead of resurrecting journaled
+            # objects of the dead bucket (the tombstone also drops the
+            # bucket's index inside the committer).  Only the force
+            # path journals — a failed non-force rmdir must not leave a
+            # tombstone that would rmtree a live bucket on replay.
+            try:
+                self._journal.bucket_delete(volume)
+            except metajournal.JournalDead:
+                self._mark_index_stale()
+                self._meta_index.drop_bucket(volume)
+        elif volume != SYSTEM_VOL:
             # the bucket's index dies with it (segments would otherwise
             # resurrect its names if the bucket is recreated)
             self._meta_index.drop_bucket(volume)

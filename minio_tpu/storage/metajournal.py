@@ -71,6 +71,10 @@ SEG_MAGIC = b"MTSI1\n"
 
 OP_COMMIT = 1
 OP_UNLINK = 2
+#: bucket-deletion tombstone (path="", data=b""): folded newest-seq-wins
+#: on replay — object records OLDER than their bucket's tombstone belong
+#: to the deleted generation and must not resurrect the bucket dir
+OP_BUCKET_DELETE = 3
 
 _REC = struct.Struct("<IIQ")          # payload_len, crc32, seq
 _SEG_HDR = struct.Struct("<6sII")     # magic, count, blob_len
@@ -493,7 +497,11 @@ def startup_replay(root: str, apply_commit, apply_unlink,
     """Fold a leftover journal over the drive's xl.meta state: apply
     the per-path NEWEST record (idempotent — every record carries the
     full xl.meta bytes), fdatasync each affected file, then truncate
-    the journal.  Returns the number of paths replayed.
+    the journal.  Bucket-deletion tombstones fold by the same
+    newest-seq-wins rule: the bucket dir is removed and older object
+    records for it are dropped; records newer than the tombstone (the
+    bucket was recreated) still apply.  Returns the number of paths
+    replayed.
 
     Runs unconditionally at LocalStorage init so a crashed journal-on
     process followed by a journal-off one still recovers its acked
@@ -507,11 +515,26 @@ def startup_replay(root: str, apply_commit, apply_unlink,
     except OSError:
         return 0
     newest: dict[tuple, tuple] = {}
+    tombs: dict[str, int] = {}
     for seq, op, bucket, path, data in decode_records(buf):
+        if op == OP_BUCKET_DELETE and seq > tombs.get(bucket, -1):
+            tombs[bucket] = seq
         prev = newest.get((bucket, path))
         if prev is None or seq > prev[0]:
             newest[(bucket, path)] = (seq, op, data)
-    for (bucket, path), (_seq, op, data) in newest.items():
+    # bucket-deletion tombstones fold FIRST (newest-seq-wins, the same
+    # rule as object records): the dir removal is idempotent, and any
+    # object record older than its bucket's tombstone belongs to the
+    # deleted generation — applying it would resurrect the bucket
+    for bucket in tombs:
+        shutil.rmtree(os.path.join(root, bucket), ignore_errors=True)
+    if tombs and fsync:
+        _fsync_dir(root)
+    replayed = 0
+    for (bucket, path), (seq, op, data) in newest.items():
+        if op == OP_BUCKET_DELETE or seq < tombs.get(bucket, -1):
+            continue
+        replayed += 1
         if op == OP_COMMIT:
             apply_commit(bucket, path, bytes(data))
             if fsync:
@@ -532,7 +555,7 @@ def startup_replay(root: str, apply_commit, apply_unlink,
     os.unlink(jpath)
     if fsync:
         _fsync_dir(jdir)
-    return len(newest)
+    return replayed
 
 
 # ---------------------------------------------------------------------------
@@ -614,6 +637,13 @@ class MetaJournal:
 
     def unlink(self, bucket: str, path: str) -> None:
         self._enqueue(OP_UNLINK, bucket, path, b"")
+
+    def bucket_delete(self, bucket: str) -> None:
+        """Journal a bucket-deletion tombstone.  Blocks until the group
+        fsync lands, so the tombstone is durable BEFORE the caller
+        removes the bucket directory — a crash in between replays the
+        tombstone instead of resurrecting journaled objects."""
+        self._enqueue(OP_BUCKET_DELETE, bucket, "", b"")
 
     def _enqueue(self, op: int, bucket: str, path: str,
                  data: bytes) -> None:
@@ -702,12 +732,22 @@ class MetaJournal:
         for _rec, bucket, path, op, data, _w in batch:
             if op == OP_COMMIT:
                 self.apply_commit(bucket, path, data)
+                self._dirty_paths[(bucket, path)] = op
+            elif op == OP_BUCKET_DELETE:
+                # the caller removes the dir after the ack; here the
+                # bucket's index dies and its pending rotate syncs are
+                # moot (their files vanish with the dir)
+                self.index.drop_bucket(bucket)
+                for key in [k for k in self._dirty_paths
+                            if k[0] == bucket]:
+                    del self._dirty_paths[key]
             else:
                 self.apply_unlink(bucket, path)
-            self._dirty_paths[(bucket, path)] = op
+                self._dirty_paths[(bucket, path)] = op
             _kill("mid_apply")
         self.index.apply_batch(
-            [(b, p, op == OP_COMMIT) for _r, b, p, op, _d, _w in batch])
+            [(b, p, op == OP_COMMIT) for _r, b, p, op, _d, _w in batch
+             if op != OP_BUCKET_DELETE])
         _kill("post_apply")
         # ack only now: the journal fsync above made the batch durable
         # and the applies made it visible (read-your-writes)

@@ -1,0 +1,459 @@
+"""Unit matrix for the overload controller (ISSUE 18 satellite):
+hysteresis windows, cooldown spacing, revert-on-recovery, stale-
+snapshot refusal, the bounded-intervention budget, admin re-baselining,
+offender re-targeting — all on an injected clock with hand-built SLO
+snapshots, no sleeping and no live server — plus the gate-off
+differential against a real server (off must be byte- and metrics-
+identical: no controller object, no ``minio_controller_*`` families).
+
+The protocol these tests drive per-transition is the one the bounded
+model checker proves flap-free in aggregate
+(analysis/concurrency/models/controller.py; tests/test_modelcheck.py
+pins the seeded mutations).
+"""
+
+import os
+
+import pytest
+
+from minio_tpu.erasure import objects as eobj
+from minio_tpu.server.controller import OverloadController
+from minio_tpu.server.qos import QosPlane, TenantRule
+
+from .s3_harness import S3TestServer
+
+HOT, QUIET = "bucket:hot", "bucket:quiet"
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+
+class FakeSlo:
+    """Just enough of SloPlane for _sample: a status() document the
+    test mutates between ticks."""
+
+    fast_s = 3.0
+
+    def __init__(self):
+        self.doc = {"classes": {}, "tenants": {}}
+
+    def status(self, window_s=None, tenants=False):
+        return self.doc
+
+
+class FakeBrownout:
+    def __init__(self):
+        self.forced = None
+
+    def force(self, on):
+        self.forced = bool(on)
+
+
+class FakeServices:
+    def __init__(self):
+        self.brownout = FakeBrownout()
+
+
+class FakeServer:
+    def __init__(self, qos=None):
+        self.slo = FakeSlo()
+        self.qos = qos
+        self.services = FakeServices()
+
+
+def burning(slo, *, burn=5.0, get_violations=(), hot_requests=100,
+            quiet_requests=10, quiet_burn=5.0):
+    """A snapshot where the quiet tenant burns while the hot tenant
+    dominates traffic — the offender/victim shape."""
+    slo.doc = {
+        "classes": {"GET": {"burn": {"fast": burn},
+                            "violations": list(get_violations),
+                            "ok": not get_violations and burn < 1.0}},
+        "tenants": {
+            HOT: {"GET": {"window": {"requests": hot_requests},
+                          "burn": {"fast": 0.0}, "ok": True}},
+            QUIET: {"GET": {"window": {"requests": quiet_requests},
+                            "burn": {"fast": quiet_burn},
+                            "ok": quiet_burn < 1.0}},
+        },
+    }
+
+
+def calm(slo):
+    slo.doc = {
+        "classes": {"GET": {"burn": {"fast": 0.0}, "violations": [],
+                            "ok": True}},
+        "tenants": {
+            HOT: {"GET": {"window": {"requests": 100},
+                          "burn": {"fast": 0.0}, "ok": True}},
+            QUIET: {"GET": {"window": {"requests": 10},
+                            "burn": {"fast": 0.0}, "ok": True}},
+        },
+    }
+
+
+def make_controller(*, hysteresis=2, cooldown=1, max_depth=2):
+    qos = QosPlane(4, rules={HOT: TenantRule(weight=16),
+                             QUIET: TenantRule(weight=1)})
+    srv = FakeServer(qos=qos)
+    clk = FakeClock()
+    c = OverloadController(srv, tick_s=0.5, burn_fast=1.0,
+                           hysteresis=hysteresis, cooldown=cooldown,
+                           max_depth=max_depth, clock=clk)
+    return c, srv, qos, clk
+
+
+@pytest.fixture(autouse=True)
+def _restore_hedge():
+    yield
+    eobj.set_hedge_scale(1.0)
+
+
+class TestLadderProtocol:
+    def test_hysteresis_gates_first_engage(self):
+        c, srv, qos, _ = make_controller(hysteresis=3)
+        burning(srv.slo)
+        for expected_depth in (0, 0, 1):
+            c.tick()
+            assert c.ladders["qos"].depth == expected_depth
+        # the engaged rung is a real reconfigure: offender halved off
+        # its admin baseline, victim untouched
+        assert qos.rules[HOT].weight == 8.0
+        assert qos.rules[HOT].max_concurrency == 2
+        assert qos.rules[QUIET].weight == 1.0
+
+    def test_cooldown_spaces_consecutive_rungs(self):
+        c, srv, qos, _ = make_controller(hysteresis=1, cooldown=2)
+        burning(srv.slo)
+        c.tick()
+        assert c.ladders["qos"].depth == 1
+        # cooldown=2: the next two high ticks only drain the cooldown
+        c.tick()
+        c.tick()
+        assert c.ladders["qos"].depth == 1
+        c.tick()
+        assert c.ladders["qos"].depth == 2
+
+    def test_revert_on_recovery_restores_baseline(self):
+        c, srv, qos, _ = make_controller(hysteresis=1, cooldown=0)
+        burning(srv.slo)
+        c.tick()
+        c.tick()
+        assert c.ladders["qos"].depth == 2
+        assert qos.rules[HOT].weight == 4.0
+        calm(srv.slo)
+        c.tick()
+        c.tick()
+        assert c.ladders["qos"].depth == 0
+        # every action reverted: the offender's ADMIN rule is back
+        # verbatim and the bookkeeping is clean
+        assert qos.rules[HOT].weight == 16.0
+        assert qos.rules[HOT].max_concurrency == 0
+        assert c._qos_offender is None
+        assert c.ladders["qos"].reverts == 2
+
+    def test_intervention_budget_bounded(self):
+        c, srv, qos, _ = make_controller(hysteresis=1, cooldown=0,
+                                         max_depth=2)
+        burning(srv.slo)
+        for _ in range(20):
+            c.tick()
+        lad = c.ladders["qos"]
+        assert lad.depth == 2
+        assert lad.engagements == 2        # not one per tick
+        # rungs derive from the admin baseline, never compound off the
+        # controller's own writes
+        assert qos.rules[HOT].weight == 4.0
+
+    def test_burn_below_threshold_never_engages(self):
+        c, srv, qos, _ = make_controller(hysteresis=1, cooldown=0)
+        burning(srv.slo, burn=0.5, quiet_burn=0.5)
+        srv.slo.doc["tenants"][QUIET]["GET"]["ok"] = True
+        srv.slo.doc["classes"]["GET"]["ok"] = True
+        for _ in range(5):
+            c.tick()
+        assert all(lad.depth == 0 for lad in c.ladders.values())
+        assert qos.reconfigures == 0
+
+
+class TestSnapshotFreshness:
+    def test_stale_generation_refused(self):
+        c, srv, qos, _ = make_controller(hysteresis=1, cooldown=0)
+        burning(srv.slo)
+        snap = c._sample()
+        # an admin PUT /qos lands between sample and decide
+        qos.reconfigure(rules=dict(qos.rules), max_queue=qos.max_queue)
+        c.decide(snap)
+        assert c.skipped_stale == 1
+        assert c.ladders["qos"].depth == 0
+
+    def test_stale_clock_refused(self):
+        c, srv, _, clk = make_controller(hysteresis=1, cooldown=0)
+        burning(srv.slo)
+        snap = c._sample()
+        clk.now += 10 * c.tick_s   # thread wedged past the bound
+        c.decide(snap)
+        assert c.skipped_stale == 1
+        assert c.ladders["qos"].depth == 0
+
+    def test_swapped_plane_refused(self):
+        c, srv, _, _ = make_controller(hysteresis=1, cooldown=0)
+        burning(srv.slo)
+        snap = c._sample()
+        srv.qos = QosPlane(4)      # runtime gate flip swapped the plane
+        c.decide(snap)
+        assert c.skipped_stale == 1
+
+    def test_admin_write_rebaselines_ladder(self):
+        c, srv, qos, _ = make_controller(hysteresis=2, cooldown=0)
+        burning(srv.slo)
+        c.tick()
+        c.tick()
+        assert c.ladders["qos"].depth == 1
+        # admin rewrites the rules: gen moves; next tick re-baselines
+        # instead of fighting the admin (depth/streaks/offender drop,
+        # no counter-write happens)
+        admin_rules = {HOT: TenantRule(weight=3),
+                       QUIET: TenantRule(weight=2)}
+        qos.reconfigure(rules=admin_rules, max_queue=qos.max_queue)
+        gen = qos.reconfigures
+        c.tick()
+        assert c.qos_admin_resets == 1
+        assert c.ladders["qos"].depth == 0
+        assert c._qos_offender is None
+        assert qos.reconfigures == gen       # re-baseline writes nothing
+        # if burn persists, the NEXT rung derives from the admin's
+        # rules, not the stale baseline
+        c.tick()
+        assert c.ladders["qos"].depth == 1
+        assert qos.rules[HOT].weight == 1.5
+
+
+class TestOffenderTargeting:
+    def test_no_offender_without_victim(self):
+        c, srv, qos, _ = make_controller(hysteresis=1, cooldown=0)
+        # the top tenant burns its OWN budget; nobody else complains
+        srv.slo.doc = {
+            "classes": {"GET": {"burn": {"fast": 5.0},
+                                "violations": [], "ok": False}},
+            "tenants": {
+                HOT: {"GET": {"window": {"requests": 100},
+                              "burn": {"fast": 5.0}, "ok": False}},
+                QUIET: {"GET": {"window": {"requests": 10},
+                                "burn": {"fast": 0.0}, "ok": True}},
+            },
+        }
+        c.tick()
+        assert c.ladders["qos"].depth == 0       # no qos action...
+        assert c.ladders["brownout"].depth == 1  # ...but burn still
+        #                                          sheds background work
+
+    def test_slot_occupancy_flags_offender_when_requests_equalize(self):
+        # closed-loop saturation equalizes attained request rates, so
+        # the requests-dominance test goes blind; the inflight (slot-
+        # seconds) signal must still find the tenant camped on the pool
+        c, srv, qos, _ = make_controller(hysteresis=1, cooldown=0)
+        for _ in range(3):
+            assert qos.try_admit(HOT)
+        burning(srv.slo, hot_requests=100, quiet_requests=100)
+        c.tick()
+        assert c._qos_offender == HOT
+        assert c.ladders["qos"].depth == 1
+
+    def test_capped_burner_is_not_an_occupancy_victim(self):
+        # the post-rescue shape: the flood sits pinned under its cap
+        # and burns its own budget while the rescued tenant holds the
+        # freed slots — that must NOT read as the quiet tenant
+        # offending, or the controller would chase its own rescue
+        c, srv, qos, _ = make_controller(hysteresis=1, cooldown=0)
+        qos.reconfigure(rules={
+            HOT: TenantRule(weight=16, max_concurrency=2),
+            QUIET: TenantRule(weight=1)})
+        assert qos.try_admit(HOT)
+        for _ in range(3):
+            assert qos.try_admit(QUIET)
+        srv.slo.doc = {
+            "classes": {"GET": {"burn": {"fast": 5.0},
+                                "violations": [], "ok": False}},
+            "tenants": {
+                HOT: {"GET": {"window": {"requests": 100},
+                              "burn": {"fast": 5.0}, "ok": False}},
+                QUIET: {"GET": {"window": {"requests": 100},
+                                "burn": {"fast": 0.0}, "ok": True}},
+            },
+        }
+        c.tick()
+        assert c._qos_offender is None
+        assert c.ladders["qos"].depth == 0
+
+    def test_retarget_moves_cap_in_one_reconfigure(self):
+        c, srv, qos, _ = make_controller(hysteresis=1, cooldown=1,
+                                         max_depth=1)
+        burning(srv.slo)
+        c.tick()
+        assert c._qos_offender == HOT
+        c.tick()            # drains the engage's cooldown
+        gen = qos.reconfigures
+        # regime flips: QUIET now floods while HOT burns
+        srv.slo.doc = {
+            "classes": {"GET": {"burn": {"fast": 5.0},
+                                "violations": [], "ok": False}},
+            "tenants": {
+                HOT: {"GET": {"window": {"requests": 10},
+                              "burn": {"fast": 5.0}, "ok": False}},
+                QUIET: {"GET": {"window": {"requests": 100},
+                                "burn": {"fast": 0.0}, "ok": True}},
+            },
+        }
+        c.tick()
+        assert c._qos_offender == QUIET
+        assert c.offender_switches == 1
+        assert qos.reconfigures == gen + 1   # ONE reconfigure
+        # old offender restored to baseline, new one at the same rung
+        assert qos.rules[HOT].weight == 16.0
+        assert qos.rules[QUIET].weight == 0.5
+        assert c.ladders["qos"].depth == 1   # depth unchanged
+
+
+class TestOtherLadders:
+    def test_hedge_engages_on_get_latency_burn(self):
+        c, srv, _, _ = make_controller(hysteresis=1, cooldown=0)
+        burning(srv.slo, get_violations=("latency",))
+        c.tick()
+        assert c.ladders["hedge"].depth == 1
+        assert eobj.STRAGGLER_GRACE == pytest.approx(
+            eobj._HEDGE_DEFAULTS[0] * 0.5)
+        calm(srv.slo)
+        c.tick()
+        assert c.ladders["hedge"].depth == 0
+        assert eobj.STRAGGLER_GRACE == pytest.approx(
+            eobj._HEDGE_DEFAULTS[0])
+
+    def test_availability_burn_alone_no_hedge(self):
+        c, srv, _, _ = make_controller(hysteresis=1, cooldown=0)
+        burning(srv.slo)            # burn without a latency violation
+        c.tick()
+        assert c.ladders["hedge"].depth == 0
+
+    def test_brownout_forced_and_released(self):
+        c, srv, _, _ = make_controller(hysteresis=1, cooldown=0)
+        burning(srv.slo)
+        c.tick()
+        assert srv.services.brownout.forced is True
+        calm(srv.slo)
+        c.tick()
+        assert srv.services.brownout.forced is False
+
+    def test_pool_add_recommend_and_clear(self):
+        c, srv, qos, _ = make_controller(hysteresis=2, cooldown=0)
+        qos._active = qos.max_concurrency     # saturated pool
+        burning(srv.slo)
+        c.tick()
+        assert not c.pool_add_recommended
+        c.tick()
+        assert c.pool_add_recommended
+        assert c.pool_add_events == 1
+        calm(srv.slo)
+        c.tick()
+        c.tick()
+        assert not c.pool_add_recommended
+        assert c.pool_add_events == 1         # edge-counted, no re-fire
+
+
+class TestStandDown:
+    def test_slo_plane_off_stands_down(self):
+        c, srv, qos, _ = make_controller(hysteresis=1, cooldown=0)
+        burning(srv.slo, get_violations=("latency",))
+        c.tick()
+        assert c.ladders["qos"].depth == 1
+        assert c.ladders["hedge"].depth == 1
+        srv.slo = None                        # runtime gate flip
+        c.tick()
+        assert all(lad.depth == 0 for lad in c.ladders.values())
+        assert qos.rules[HOT].weight == 16.0  # baseline restored
+        assert eobj.STRAGGLER_GRACE == pytest.approx(
+            eobj._HEDGE_DEFAULTS[0])
+        assert srv.services.brownout.forced is False
+
+    def test_close_reverts_everything(self):
+        c, srv, qos, _ = make_controller(hysteresis=1, cooldown=0)
+        burning(srv.slo)
+        c.tick()
+        c.close()
+        assert qos.rules[HOT].weight == 16.0
+        assert all(lad.depth == 0 for lad in c.ladders.values())
+
+
+class TestGate:
+    def test_env_wins_over_config(self):
+        assert OverloadController.gate_enabled(
+            None, environ={"MINIO_TPU_CONTROLLER": "1"})
+        assert not OverloadController.gate_enabled(
+            None, environ={"MINIO_TPU_CONTROLLER": "0"})
+        assert not OverloadController.gate_enabled(None, environ={})
+
+    def test_from_config_off_returns_none(self):
+        assert OverloadController.from_config(
+            None, None, environ={}) is None
+
+    def test_from_config_knobs(self):
+        c = OverloadController.from_config(
+            None, None, environ={
+                "MINIO_TPU_CONTROLLER": "1",
+                "MINIO_TPU_CONTROLLER_TICK_S": "250ms",
+                "MINIO_TPU_CONTROLLER_BURN_FAST": "2.5",
+                "MINIO_TPU_CONTROLLER_HYSTERESIS": "4",
+                "MINIO_TPU_CONTROLLER_COOLDOWN": "3",
+                "MINIO_TPU_CONTROLLER_MAX_DEPTH": "5"})
+        assert c is not None
+        assert c.tick_s == pytest.approx(0.25)
+        assert c.burn_fast == 2.5
+        assert c.hysteresis == 4
+        assert c.cooldown == 3
+        assert c.max_depth == 5
+
+
+class TestGateOffDifferential:
+    """MINIO_TPU_CONTROLLER=0 must be indistinguishable from the seed
+    server: no controller object, no minio_controller_* families, and
+    the admin endpoint answers enabled=false."""
+
+    def _run(self, tmp_path, value):
+        old = os.environ.get("MINIO_TPU_CONTROLLER")
+        os.environ["MINIO_TPU_CONTROLLER"] = value
+        try:
+            srv = S3TestServer(str(tmp_path / f"ctl{value}"))
+            try:
+                metrics = srv.request(
+                    "GET", "/minio/v2/metrics/cluster").body.decode()
+                admin = srv.request(
+                    "GET", "/minio/admin/v3/controller")
+                return srv.server.controller, metrics, admin
+            finally:
+                srv.close()
+        finally:
+            if old is None:
+                os.environ.pop("MINIO_TPU_CONTROLLER", None)
+            else:
+                os.environ["MINIO_TPU_CONTROLLER"] = old
+
+    def test_off_has_no_controller_surface(self, tmp_path):
+        ctrl, metrics, admin = self._run(tmp_path, "0")
+        assert ctrl is None
+        assert "minio_controller_" not in metrics
+        assert admin.status == 200
+        assert b'"enabled": false' in admin.body.replace(b" ", b"") \
+            or b'"enabled":false' in admin.body.replace(b" ", b"")
+
+    def test_on_exports_controller_surface(self, tmp_path):
+        ctrl, metrics, admin = self._run(tmp_path, "1")
+        assert ctrl is not None
+        assert "minio_controller_ticks_total" in metrics
+        assert "minio_controller_active" in metrics
+        assert admin.status == 200
+        assert b"tickSeconds" in admin.body

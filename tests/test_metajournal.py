@@ -308,6 +308,91 @@ class TestReplay:
 
 
 # ---------------------------------------------------------------------------
+# bucket-deletion tombstones (ISSUE 18 satellite)
+# ---------------------------------------------------------------------------
+class TestBucketTombstone:
+    def _journal_path(self, root):
+        jdir = os.path.join(str(root), ".minio_tpu.sys",
+                            metajournal.JOURNAL_DIR)
+        os.makedirs(jdir, exist_ok=True)
+        return os.path.join(jdir, metajournal.JOURNAL_FILE)
+
+    def test_force_delete_journals_tombstone_live(self, jman, tmp_path):
+        d = jman(tmp_path / "d0")
+        d.make_volume("bkt")
+        for i in range(3):
+            d.write_metadata("bkt", f"o{i}", _fi(f"o{i}", "v1"))
+        d.delete_volume("bkt", force=True)
+        assert not os.path.isdir(os.path.join(d.root, "bkt"))
+        assert not d._journal._dead
+        # recreate: the dead generation's names must not resurrect
+        d.make_volume("bkt")
+        with pytest.raises(errors.FileNotFound):
+            d.read_xl("bkt", "o0")
+        d.write_metadata("bkt", "fresh", _fi("fresh", "v1"))
+        assert d.read_version("bkt", "fresh").version_id == "v1"
+
+    @pytest.mark.parametrize("point", FLUSH_POINTS)
+    def test_crash_during_bucket_delete(self, jman, tmp_path, point):
+        """Kill-point regression: the committer dies while flushing the
+        tombstone.  If the tombstone reached the journal, replay must
+        finish the delete (no journaled object of the dead bucket may
+        resurrect); if it died pre-write, the bucket survives whole."""
+        root = tmp_path / "d0"
+        d = jman(root)
+        d.make_volume("bkt")
+        raws = {f"o{i}": _xl_bytes(f"o{i}", ["v1"]) for i in range(3)}
+        for name, raw in raws.items():
+            d._journal.commit("bkt", name, raw)  # acked -> in the journal
+
+        metajournal.KILL_POINTS.add(point)
+        with pytest.raises(metajournal.JournalDead):
+            d._journal.bucket_delete("bkt")
+        # the crash hit BEFORE delete_volume removed the dir: the bucket
+        # is still on disk, its commits still in the journal
+        assert os.path.isdir(os.path.join(str(root), "bkt"))
+
+        d2 = _restart(jman, root)
+        if point == "pre_write":
+            # tombstone never durable: replay restores the full bucket
+            for name, raw in raws.items():
+                assert d2.read_xl("bkt", name) == raw
+        else:
+            # tombstone durable: newest-seq-wins folds the bucket away
+            assert not os.path.isdir(os.path.join(str(root), "bkt"))
+            for name in raws:
+                with pytest.raises(errors.FileNotFound):
+                    d2.read_xl("bkt", name)
+            # idempotent: replaying over the deleted state is a no-op
+            d3 = _restart(jman, root)
+            assert not os.path.isdir(os.path.join(str(root), "bkt"))
+            assert d3 is not None
+
+    def test_tombstone_newest_seq_wins_recreate(self, jman, tmp_path):
+        """Records NEWER than the tombstone (bucket deleted, then
+        recreated before the crash) still apply; older ones fold away."""
+        root = tmp_path / "d0"
+        old = _xl_bytes("old", ["v1"])
+        fresh = _xl_bytes("fresh", ["v1"])
+        # crashed-state disk: 'old' was applied before the tombstone
+        os.makedirs(os.path.join(str(root), "bkt", "old"), exist_ok=True)
+        with open(os.path.join(str(root), "bkt", "old", "xl.meta"),
+                  "wb") as f:
+            f.write(old)
+        with open(self._journal_path(root), "wb") as f:
+            f.write(metajournal.encode_record(
+                1, metajournal.OP_COMMIT, "bkt", "old", old))
+            f.write(metajournal.encode_record(
+                2, metajournal.OP_BUCKET_DELETE, "bkt", "", b""))
+            f.write(metajournal.encode_record(
+                3, metajournal.OP_COMMIT, "bkt", "fresh", fresh))
+        d = jman(root, journal_on=False)  # replay runs even journal-off
+        with pytest.raises(errors.FileNotFound):
+            d.read_xl("bkt", "old")  # older than the tombstone: folded
+        assert d.read_xl("bkt", "fresh") == fresh  # newer: applied
+
+
+# ---------------------------------------------------------------------------
 # journal-on/off byte identity
 # ---------------------------------------------------------------------------
 def _xl_tree(root):

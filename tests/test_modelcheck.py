@@ -33,9 +33,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 #: the pool-drain suspend/copy/fence/delete/checkpoint protocol,
 #: ISSUE 16 the geo-replication push/ack/retry/resync protocol,
 #: ISSUE 17 the xl.meta commit journal's flush/ack/rotate/replay
-#: protocol)
+#: protocol, ISSUE 18 the overload controller's sample/decide/actuate
+#: loop)
 LOAD_BEARING = ("arena-ring", "hotcache", "breaker-mrf", "batcher", "qos",
-                "topology", "georep", "metajournal")
+                "topology", "georep", "metajournal", "controller")
 
 
 # ------------------------------------------------------------- engine

@@ -361,6 +361,63 @@ class TestScheduler:
         p.hot_lane_release("bucket:a")
         p.hot_lane_release("bucket:a")
 
+    def test_per_tenant_hot_cap_rule_binds(self):
+        """ISSUE 18 satellite: an explicit TenantRule.hot_cap bounds
+        ONE tenant below (or above) the uniform hot_share cap — the
+        controller's offender squeeze — while unruled tenants keep the
+        plane-level bound."""
+        p = QosPlane(2, rules={"bucket:flood": TenantRule(hot_cap=2)})
+        assert p.hot_cap() == 4              # uniform bound unchanged
+        granted = 0
+        while p.hot_lane_try("bucket:flood"):
+            granted += 1
+            assert granted <= 8, "rule cap never enforced"
+        assert granted == 2                  # the rule wins
+        st = p.stats()["tenants"]["bucket:flood"]
+        assert st["hotCap"] == 2
+        assert st["hotLaneCapped"] >= 1
+        # an unruled tenant still gets the uniform hot_share bound
+        for _ in range(4):
+            assert p.hot_lane_try("bucket:quiet")
+        assert not p.hot_lane_try("bucket:quiet")
+        assert p.stats()["tenants"]["bucket:quiet"]["hotCap"] == 4
+
+    def test_hot_cap_zero_falls_back_and_clamps_to_lane(self):
+        p = QosPlane(2, rules={
+            "bucket:a": TenantRule(hot_cap=0),     # 0 = no override
+            "bucket:b": TenantRule(hot_cap=999)})  # clamped to lane
+        assert p.stats()["tenants"] == {}          # lazily created
+        for _ in range(4):
+            assert p.hot_lane_try("bucket:a")
+        assert not p.hot_lane_try("bucket:a")      # uniform bound
+        assert p.stats()["tenants"]["bucket:a"]["hotCap"] == 4
+        # the oversized rule is clamped to the whole lane (8), never
+        # beyond — one tenant can at most own the lane, not overcommit
+        granted = 0
+        while p.hot_lane_try("bucket:b"):
+            granted += 1
+            assert granted <= 16, "clamp never enforced"
+        assert granted == 8
+        assert p.stats()["tenants"]["bucket:b"]["hotCap"] == 8
+
+    def test_hot_cap_reconfigure_applies_live(self):
+        """The controller's offender squeeze path: reconfigure() with
+        a hot_cap rule retargets the running plane without restart."""
+        p = QosPlane(2)
+        for _ in range(4):
+            assert p.hot_lane_try("bucket:flood")
+        assert not p.hot_lane_try("bucket:flood")
+        p.reconfigure(rules={"bucket:flood": TenantRule(hot_cap=1)},
+                      max_queue=p.max_queue)
+        # already over the new cap: no new claims until drained to 0
+        assert not p.hot_lane_try("bucket:flood")
+        for _ in range(4):
+            p.hot_lane_release("bucket:flood")
+        assert p.hot_lane_try("bucket:flood")      # 1 slot again
+        assert not p.hot_lane_try("bucket:flood")
+        p.reconfigure(rules={}, max_queue=p.max_queue)
+        assert p.hot_lane_try("bucket:flood")      # back to uniform
+
 
 # ----------------------------------------------------- bandwidth buckets
 class TestBandwidth:

@@ -124,6 +124,92 @@ class TestScheduleContract:
             assert a == b
             assert schedule_digest(a) == schedule_digest(b)
 
+    def test_controller_family_meets_acceptance_shape(self):
+        """ISSUE 18: the regime-shift family — three named scenarios,
+        each pairing a PUT-flood offender (slot-TIME monopoly: a PUT
+        holds an admission slot for ~10 serialized drive ops against a
+        GET's ~2) with a GET-only victim whose SLO clauses are the
+        static-vs-controller discriminator."""
+        from minio_tpu.simulator import controller_scenarios
+
+        scs = controller_scenarios()
+        assert [s.name for s in scs] == [
+            "flash_crowd", "tenant_mix_flip", "brownout_noisy_stacked"]
+        for sc in scs:
+            assert sc.bucket_ops, sc.name
+            flood = [b for b, mix in sc.bucket_ops.items()
+                     if any(op == "put" for op, _ in mix)]
+            victims = [b for b, mix in sc.bucket_ops.items()
+                       if all(op == "get" for op, _ in mix)]
+            assert flood and victims, sc.name
+            # the graded victims are GET-only buckets, each carrying
+            # the budget clauses static must fail and the controller
+            # must hold (a flip scenario may have extra ungraded
+            # GET-only buckets — the pre/post-flip flood roles)
+            graded = sc.slo["buckets"]
+            assert set(graded) <= set(victims), sc.name
+            for v, clause in graded.items():
+                assert "shed_frac_max" in clause \
+                    and "p50_ms" in clause, (sc.name, v)
+            # the offender starts privileged: static weights alone
+            # must not be what rescues the victim
+            for v in graded:
+                assert sc.qos["tenants"][f"bucket:{flood[0]}"]["weight"] \
+                    > sc.qos["tenants"][f"bucket:{v}"]["weight"]
+            # the victim drives from its OWN closed-loop client pool
+            # (a shared pool lets the flood throttle the victim's
+            # offered load and hides the starvation) — pools disjoint
+            # and inside the client count
+            used: set[int] = set()
+            for b, (lo, n) in sc.bucket_clients.items():
+                pool = set(range(lo, lo + n))
+                assert pool and not (pool & used), (sc.name, b)
+                assert lo >= 0 and lo + n <= sc.clients, (sc.name, b)
+                used |= pool
+            assert set(sc.bucket_clients) == set(sc.buckets), sc.name
+        assert [s.name for s in scs if s.mix_flip_at_frac] \
+            == ["tenant_mix_flip"]
+        assert [s.name for s in scs if s.chaos] \
+            == ["brownout_noisy_stacked"]
+        # seeds are the digest identity in BENCH_r19.json: no
+        # collisions inside the family or with the other sets
+        seeds = {s.seed for s in scs} \
+            | {s.seed for s in builtin_scenarios()} \
+            | {s.seed for s in georep_scenarios()}
+        assert len(seeds) == len(scs) + len(builtin_scenarios()) \
+            + len(georep_scenarios())
+
+    def test_controller_schedules_reproduce(self):
+        from minio_tpu.simulator import controller_scenarios
+
+        for sc in controller_scenarios(scale=0.25):
+            a = build_schedule(sc)
+            b = build_schedule(sc)
+            assert a == b
+            assert schedule_digest(a) == schedule_digest(b)
+
+    def test_bucket_ops_overrides_only_named_buckets(self):
+        """The bucket_ops field is gated: a victim bucket draws ONLY
+        its own mix, other buckets draw the scenario mix, and a
+        scenario without the field keeps its exact RNG stream (the
+        pre-existing digests must never move)."""
+        base = Scenario(
+            name="bo", seed=77, duration_s=6.0, clients=4, rate=40.0,
+            ops=(("put", 50), ("get", 50)), buckets=("hot", "quiet"),
+            nobjects=8)
+        plain = build_schedule(base)
+        over = Scenario(**{**base.__dict__, "bucket_ops": {
+            "quiet": (("get", 100),)}})
+        sched = build_schedule(over)
+        quiet_ops = {e["op"] for e in sched if e["bucket"] == "quiet"}
+        hot_ops = {e["op"] for e in sched if e["bucket"] == "hot"}
+        assert quiet_ops == {"get"}
+        assert hot_ops == {"put", "get"}
+        # gate check: bucket_ops=None reproduces the original stream
+        again = Scenario(**{**base.__dict__, "bucket_ops": None})
+        assert schedule_digest(build_schedule(again)) == \
+            schedule_digest(plain)
+
 
 @pytest.fixture()
 def sim_srv(tmp_path, monkeypatch):
