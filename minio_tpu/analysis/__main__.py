@@ -8,15 +8,25 @@ dev loop / pre-push hook can run the gate without pytest.
 package, the bounded model check of every registered protocol model
 (including the mutation-liveness proof that each seeded protocol bug
 is caught), and the rule self-tests.  `--models` runs just the model
-checker; `--deep` raises the exploration bounds (the slow sweep)."""
+checker; `--deep` raises the exploration bounds (the slow sweep).
+
+`--all` also enforces a wall-clock budget (default 15 s, override via
+MINIO_TPU_ANALYSIS_BUDGET_S; 0 disables): a gate that creeps past the
+dev-loop threshold stops being run, so the creep itself is a finding.
+
+`--callgraph <module.fn>` prints a function's resolved call-graph
+entry — color, edges, blocking chain, acquired locks — so reviewing a
+loop-blocking/lock-order waiver doesn't require re-deriving the chain
+by hand."""
 
 from __future__ import annotations
 
 import argparse
 import os
 import sys
+import time
 
-from .core import RULES, analyze_paths
+from .core import RULES, analyze_paths, load_modules
 
 
 def _run_models(deep: bool) -> int:
@@ -72,7 +82,12 @@ def main(argv=None) -> int:
                         help="run only the protocol model checker")
     parser.add_argument("--deep", action="store_true",
                         help="raise model-check bounds (slow sweep)")
+    parser.add_argument("--callgraph", metavar="MODULE.FN",
+                        help="print the resolved call-graph entry "
+                             "(color, edges, blocking chain, locks) "
+                             "for a function and exit")
     args = parser.parse_args(argv)
+    started = time.monotonic()
 
     # rule modules register on import
     from . import rules as _rules  # noqa: F401
@@ -81,6 +96,17 @@ def main(argv=None) -> int:
         width = max(len(n) for n in RULES)
         for name in sorted(RULES):
             print(f"{name:<{width}}  {RULES[name][0]}")
+        return 0
+
+    if args.callgraph:
+        from .callgraph import CallGraph
+
+        roots = args.paths or [os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))]
+        modules, errors = load_modules(roots)
+        for e in errors:
+            print(e, file=sys.stderr)
+        print(CallGraph(modules).describe(args.callgraph))
         return 0
 
     if args.models and not args.all:
@@ -114,6 +140,22 @@ def main(argv=None) -> int:
         return 1
     if args.all:
         print(f"lint: clean ({len(RULES)} rules)")
+        elapsed = time.monotonic() - started
+        try:
+            budget = float(os.environ.get(
+                "MINIO_TPU_ANALYSIS_BUDGET_S", "15"))
+        except ValueError:
+            budget = 15.0
+        print(f"gate: {elapsed:.1f}s wall (budget "
+              f"{budget:.0f}s)" if budget else
+              f"gate: {elapsed:.1f}s wall (budget off)")
+        if budget and elapsed > budget:
+            print(f"gate: BUDGET EXCEEDED — {elapsed:.1f}s > "
+                  f"{budget:.0f}s; a gate this slow stops being run. "
+                  "Profile the new pass or raise "
+                  "MINIO_TPU_ANALYSIS_BUDGET_S deliberately.",
+                  file=sys.stderr)
+            return 1
         return 1 if (rc_models or rc_self) else 0
     return 0
 

@@ -539,15 +539,20 @@ class TracedDict(dict):
 
 def install_default_watches() -> None:
     """The designated shared-state surface for suite replays: hotcache,
-    brownout, MRF stats, replication stats, gateway cache counters and
-    the drive-health counters.  Extend as new concurrent subsystems
-    land."""
+    brownout, MRF stats, replication stats, gateway cache counters,
+    drive-health counters, the overload controller's ladders, and the
+    metadata-journal flush counters.  Module-level tables (georep's
+    ``stats`` dict, stagestats) have no class attribute to watch — the
+    drills swap in a TracedDict instead.  Extend as new concurrent
+    subsystems land."""
     from minio_tpu.gateway.cache import CacheLayer
+    from minio_tpu.server.controller import OverloadController, _Ladder
     from minio_tpu.services.brownout import BrownoutController
     from minio_tpu.services.mrf import MRFStats
     from minio_tpu.services.replication import ReplicationStats
     from minio_tpu.serving.hotcache import HotObjectCache
     from minio_tpu.storage.instrumented import InstrumentedStorage
+    from minio_tpu.storage.metajournal import MetaIndex, MetaJournal
 
     watch(HotObjectCache, "hits", "misses", "fills", "collapsed",
           "evictions", "invalidations", "_bytes", "_prot_bytes",
@@ -560,3 +565,18 @@ def install_default_watches() -> None:
     watch(CacheLayer, "hits", "misses")
     watch(InstrumentedStorage, "trips", "reconnects", "fast_fails",
           "_consec_faults")
+    # PR 18/19: the SLO controller's ladder vector and counters — the
+    # tick thread, admin resets, and status scrapes all touch these;
+    # every WRITE must hold OverloadController._mu.
+    watch(OverloadController, "ticks", "skipped_stale",
+          "qos_admin_resets", "offender_switches", "pool_add_events",
+          "pool_add_recommended", "_sat_streak", "_calm_streak")
+    watch(_Ladder, "depth", "streak_high", "streak_low", "cooldown",
+          "engagements", "reverts")
+    # PR 17/19: metadata-journal flush/rotation counters and the index
+    # spill counter — flusher thread writes, metrics scrape reads
+    # lock-free (the advisory-snapshot idiom: reads never refine the
+    # lockset, writes must hold the journal/index lock).
+    watch(MetaJournal, "commits", "batches", "last_batch", "flush_ns",
+          "rotations", "journal_bytes")
+    watch(MetaIndex, "spills")

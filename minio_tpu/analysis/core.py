@@ -128,6 +128,15 @@ class Project:
         self._s3_codes: set[str] | None = None
         self._mapped_storage: set[str] | None = None
         self._declared_metrics: set[str] | None = None
+        self._callgraph = None
+
+    def callgraph(self):
+        """The whole-package call graph (callgraph.CallGraph), built
+        once per run and shared by every interprocedural rule."""
+        if self._callgraph is None:
+            from .callgraph import CallGraph
+            self._callgraph = CallGraph(self.modules)
+        return self._callgraph
 
     # -- S3 error taxonomy ---------------------------------------------------
     @staticmethod

@@ -1,5 +1,6 @@
 """Checker registry: importing this package registers every rule."""
 
-from . import (budget, locks, metrics, payload,  # noqa: F401
-               racecheck_waivers, resource_lifecycle, s3errors,
-               shared_state, threads, trace)
+from . import (asyncplane, budget, lockorder, locks,  # noqa: F401
+               metrics, payload, racecheck_waivers,
+               resource_lifecycle, s3errors, shared_state, threads,
+               trace)

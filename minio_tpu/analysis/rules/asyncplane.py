@@ -1,0 +1,126 @@
+"""Event-loop discipline: `loop-blocking` and `await-under-lock`.
+
+The server is ONE aiohttp event loop; every handler shares it.  A
+blocking call reachable from an `async def` without an executor hop
+stalls every in-flight request at once — and the repeated review-bug
+of PRs 7-18 was exactly the chain the old one-level rule could not
+see: handler -> sync helper -> sync helper -> disk/RPC/sleep.  These
+rules ride the whole-package call graph (`analysis/callgraph.py`):
+
+* **loop-blocking** — for each `async def`, walk non-hop, non-awaited
+  call edges; any reachable blocking terminal (storage op, RPC,
+  sleep, Future.result, fsync, subprocess, socket, queue.get, lock
+  acquire, thread join/wait) is a finding, reported at the top-level
+  call site with the full resolved chain so the fix target is obvious.
+  `await`ing an async def or an unresolved awaitable is loop-friendly;
+  `await`ing a plain sync def still runs its body inline and is
+  traversed.  `run_in_executor` / `ctx_submit` / thread spawns sever
+  the walk — that IS the sanctioned way to block.
+
+* **await-under-lock** — an `await` lexically inside a sync
+  `with <threading lock>:` region of async code parks the coroutine
+  WITH THE THREAD LOCK HELD: every executor thread and every other
+  handler touching that lock stalls until the awaited thing completes
+  (or never does — awaiting work that needs the same lock is a
+  textbook loop-wide deadlock).  `async with` (asyncio locks) is fine
+  and not matched.
+
+Blind spots (documented, pinned by tests/test_callgraph.py): dynamic
+dispatch through untyped receivers, `__getattr__` delegation
+(gateway/cache.py), and string-built names produce no edges — but the
+name-based terminal tables still classify direct calls, so a storage
+op on an untyped receiver stays visible."""
+
+from __future__ import annotations
+
+import ast
+
+from ..callgraph import is_lockish
+from ..core import Finding, rule, terminal_name
+
+
+def _fmt_chain(chain) -> str:
+    hops = []
+    for name, path, lineno in chain:
+        short = path.replace("\\", "/").rsplit("/", 1)[-1]
+        hops.append(f"{name} ({short}:{lineno})")
+    return " -> ".join(hops)
+
+
+@rule("loop-blocking",
+      "blocking call transitively reachable from an async def without "
+      "an executor hop — stalls the whole event loop")
+def check_loop_blocking(module, project):
+    graph = project.callgraph()
+    out = []
+    for fn in graph.nodes.values():
+        if fn.module is not module or not fn.is_async:
+            continue
+        for site in fn.calls:
+            hit = graph.site_blocking(fn, site)
+            if hit is None:
+                continue
+            chain, why = hit
+            if len(chain) == 1:
+                detail = why
+            else:
+                detail = f"{why}; chain: {_fmt_chain(chain)}"
+            out.append(Finding(
+                module.path, site.lineno, site.col, "loop-blocking",
+                f"async `{fn.key.rsplit('.', 1)[-1]}` can block the "
+                f"event loop: {detail} — hop through run_in_executor/"
+                f"ctx_submit or make the callee loop-safe",
+                anchors=(fn.node.lineno,)))
+    return out
+
+
+def _lock_withs_in(body):
+    """Sync `with <lockish>:` statements lexically in `body`, not
+    descending into nested defs (their awaits run elsewhere/later)."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.With):
+            for item in node.items:
+                name = terminal_name(item.context_expr)
+                if name and is_lockish(name):
+                    yield node, item
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _awaits_in(body):
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Await):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@rule("await-under-lock",
+      "await inside a `with <threading lock>:` region of async code — "
+      "the coroutine suspends with the thread lock held")
+def check_await_under_lock(module, project):
+    out = []
+    for top in ast.walk(module.tree):
+        if not isinstance(top, ast.AsyncFunctionDef):
+            continue
+        for with_node, item in _lock_withs_in(top.body):
+            lock_src = ast.unparse(item.context_expr)
+            for aw in _awaits_in(with_node.body):
+                out.append(Finding(
+                    module.path, aw.lineno, aw.col_offset,
+                    "await-under-lock",
+                    f"await while holding thread lock `{lock_src}` "
+                    f"(taken at line {with_node.lineno}): the "
+                    f"suspension parks the lock across arbitrary "
+                    f"loop turns — narrow the critical section or "
+                    f"use an asyncio lock",
+                    anchors=(with_node.lineno,)))
+    return out

@@ -1,0 +1,56 @@
+"""lock-order: the static lock-acquisition-order graph must be acyclic.
+
+PR 11's mesh-launch deadlock (tick lock and residency lock taken in
+opposite orders on the submit vs evict paths) shipped and was found by
+a bench, not a review.  This rule builds the package-wide order graph
+from the call graph: a directed edge A -> B for every site that
+acquires lock B while holding lock A — lexically (`with a: with b:`,
+`with a, b:`) or interprocedurally (a call under `with a:` whose
+transitive acquired-lock summary contains B, severed at executor
+hops).  A cycle means two code paths can take the same pair of locks
+in opposite orders: a potential deadlock.
+
+Lock identity is per class attribute (`C:<module>.<Class>.<attr>`) or
+per module global (`M:<module>.<name>`) — see callgraph._lock_key.
+Self-edges (the same key twice) are skipped: they are either RLock
+reentrancy or sibling instances of one class (a hierarchy the static
+key cannot split), both of which would drown the signal in false
+positives.
+
+Each cycle is reported ONCE package-wide, anchored at its smallest
+witness site, and only while that site's module is being checked — so
+a pragma on that line waives the whole cycle with one written reason.
+"""
+
+from __future__ import annotations
+
+from ..core import Finding, rule
+
+
+def _fmt_key(key: str) -> str:
+    # "C:minio_tpu.services.georep.GeoReplicator._mu" -> readable form
+    return key.split(":", 1)[-1]
+
+
+@rule("lock-order",
+      "cycle in the static lock-acquisition-order graph — two paths "
+      "take the same locks in opposite orders (potential deadlock)")
+def check(module, project):
+    graph = project.callgraph()
+    out = []
+    for cycle in graph.lock_cycles():
+        # one witness per edge; report the cycle at its smallest site
+        witnesses = [site for (_a, _b, site) in cycle]
+        report_at = min(witnesses)
+        if report_at[0] != module.path:
+            continue
+        steps = []
+        for (a, b, (path, lineno, via)) in cycle:
+            short = path.replace("\\", "/").rsplit("/", 1)[-1]
+            steps.append(f"{_fmt_key(a)} -> {_fmt_key(b)} via {via} "
+                         f"({short}:{lineno})")
+        out.append(Finding(
+            module.path, report_at[1], 0, "lock-order",
+            "lock-order cycle: " + "; ".join(steps) +
+            " — pick one global order or drop a lock from one path"))
+    return out

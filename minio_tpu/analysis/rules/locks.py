@@ -4,189 +4,75 @@ queue.get while holding a `threading.Lock`.
 One blocking call under a hot mutex serializes every other thread that
 touches it — the PR 3 chaos drills showed a single `.result()` under a
 drive-table lock turning one slow drive into a cluster-wide stall.
-The checker walks every `with <lock>:` body (lock-ish names: `_mu`,
-`_lock`, `mutex`, ...) and flags known blocking shapes, following
-same-module/same-class calls one level deep so a one-liner helper
-cannot hide the hop."""
+
+The checker walks every `with <lock>:` region (lock-ish names: `_mu`,
+`_lock`, `mutex`, ...; regions collected by the package call graph)
+and flags two shapes:
+
+* a DIRECT blocking terminal under the lock — the shared
+  `callgraph.classify_blocking` table (storage ops, RPC, sleep,
+  Future.result, fsync, queue.get, thread join, lock acquire, socket
+  and subprocess ops), with the one sanctioned exemption: `cv.wait()`
+  on the held condition releases it;
+* a TRANSITIVE one — a call under the lock whose call-graph blocking
+  summary reaches a terminal any number of hops away (ISSUE 19: the
+  old one-level heuristic missed every helper-behind-a-helper, e.g.
+  the PR 15 under-lock ring scans).  Executor hops sever the walk:
+  handing work to a pool under a lock is fine, waiting for it is not.
+
+Awaited calls are skipped here — `await` under a thread lock is its
+own rule (`await-under-lock`)."""
 
 from __future__ import annotations
 
-import ast
-
-from ..core import Finding, call_name, expr_source, rule, terminal_name
-
-_LOCKISH = ("mu", "mtx", "mutex", "lock", "lk", "cv", "cond", "condition")
-
-#: StorageAPI ops (instrumented.TIMED_OPS): each is a disk touch.
-_STORAGE_OPS = {
-    "make_volume", "list_volumes", "stat_volume", "delete_volume",
-    "read_all", "write_all", "rename_file", "create_file",
-    "open_file_writer", "append_file", "read_file_stream", "read_file",
-    "read_version", "read_xl", "write_metadata", "update_metadata",
-    "delete_version", "delete_versions", "free_version_data",
-    "rename_data", "list_dir", "walk_dir", "verify_file", "check_parts",
-    "disk_info", "read_at", "read_blocks",
-}
-
-#: unconditional blockers by terminal callee name.
-_BLOCKING_CALLS = {
-    "sleep": "time.sleep blocks with the lock held",
-    "result": "Future.result() can wait a full RPC/disk timeout",
-    "urlopen": "network I/O under a lock",
-    "getaddrinfo": "DNS resolution under a lock",
-}
-
-#: RPC entry points (distributed/rpc.py RpcClient and peers).
-_RPC_CALLS = {"call", "call_stream", "broadcast", "invoke"}
-
-_QUEUEISH = ("queue", "_q", "q", "inbox", "jobs")
-_THREADISH = ("thread", "worker", "probe", "proc")
+from ..callgraph import classify_blocking
+from ..core import Finding, rule
 
 
-def _is_lockish(name: str) -> bool:
-    low = name.lower().lstrip("_")
-    return any(low == t or low.endswith("_" + t) or low.startswith(t + "_")
-               or (t in ("mutex", "lock") and t in low)
-               for t in _LOCKISH)
-
-
-def _is_condish(name: str) -> bool:
-    low = name.lower().lstrip("_")
-    return any(t in low for t in ("cv", "cond"))
-
-
-def _queueish(name: str) -> bool:
-    low = name.lower()
-    return ("queue" in low or "inbox" in low or "jobs" in low
-            or low in ("q", "_q") or low.endswith("_q"))
-
-
-def _threadish(name: str) -> bool:
-    low = name.lower().lstrip("_")
-    return low in ("t", "th") or any(t in low for t in _THREADISH)
-
-
-def _blocking_in(body_nodes, lock_src: str, is_cond: bool):
-    """Yield (node, why) for blocking shapes in a statement list.
-    Does not descend into nested function/lambda defs (they run
-    later, not under the lock)."""
-    stack = list(body_nodes)
-    while stack:
-        node = stack.pop()
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.Lambda)):
-            continue
-        for child in ast.iter_child_nodes(node):
-            stack.append(child)
-        if not isinstance(node, ast.Call):
-            continue
-        name = call_name(node)
-        last = name.rsplit(".", 1)[-1]
-        recv = node.func.value if isinstance(node.func, ast.Attribute) \
-            else None
-        if last in _BLOCKING_CALLS:
-            yield node, _BLOCKING_CALLS[last]
-        elif last in ("wait", "wait_for"):
-            # cond.wait() on the held condition RELEASES it — fine;
-            # waiting on anything else blocks with the lock held
-            if recv is not None and expr_source(recv) == lock_src \
-                    and is_cond:
-                continue
-            yield node, f"`{name}` waits with the lock held"
-        elif last == "join" and recv is not None \
-                and _threadish(terminal_name(recv)):
-            yield node, "joining a thread with the lock held"
-        elif last == "get" and recv is not None \
-                and _queueish(terminal_name(recv)) and not node.args:
-            # queue.Queue.get() blocks unless explicitly non-blocking;
-            # positional args mean dict.get(key, ...) — not a queue
-            nonblocking = any(
-                (kw.arg == "block" and isinstance(kw.value, ast.Constant)
-                 and kw.value.value is False) or kw.arg == "timeout"
-                for kw in node.keywords)
-            if not nonblocking:
-                yield node, f"`{name}` can block forever on an empty queue"
-        elif last in _RPC_CALLS and recv is not None:
-            yield node, f"RPC `{name}` under a lock rides the network"
-        elif last in _STORAGE_OPS and recv is not None:
-            yield node, f"storage I/O `{name}` under a lock touches disk"
-
-
-def _local_defs(module):
-    """(scope_key, name) -> FunctionDef for module functions and
-    methods; scope_key is the ClassDef name or "" at module level."""
-    defs = {}
-    for node in module.tree.body:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            defs[("", node.name)] = node
-        elif isinstance(node, ast.ClassDef):
-            for sub in node.body:
-                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    defs[(node.name, sub.name)] = sub
-    return defs
-
-
-def _enclosing_class(module, target):
-    for node in module.tree.body:
-        if isinstance(node, ast.ClassDef):
-            for sub in ast.walk(node):
-                if sub is target:
-                    return node.name
-    return ""
+def _fmt_chain(chain) -> str:
+    hops = []
+    for name, path, lineno in chain:
+        short = path.replace("\\", "/").rsplit("/", 1)[-1]
+        hops.append(f"{name} ({short}:{lineno})")
+    return " -> ".join(hops)
 
 
 @rule("blocking-under-lock",
       "RPC, storage I/O, .result(), sleep or queue.get inside a "
-      "`with lock:` body (direct or one call deep)")
+      "`with lock:` body (direct or transitively via the call graph)")
 def check(module, project):
+    graph = project.callgraph()
     out = []
-    defs = _local_defs(module)
-    for node in ast.walk(module.tree):
-        if not isinstance(node, ast.With):
+    for fn in graph.nodes.values():
+        if fn.module is not module:
             continue
-        for item in node.items:
-            ctx = item.context_expr
-            # unwrap `with lock, other:` items one at a time; accept
-            # `self._mu`, `lock`, and `self._mu.acquire_timeout(..)`-
-            # style names
-            name = terminal_name(ctx)
-            if not name or not _is_lockish(name):
-                continue
-            lock_src = expr_source(ctx)
-            is_cond = _is_condish(name)
-            for call, why in _blocking_in(node.body, lock_src, is_cond):
-                out.append(Finding(
-                    module.path, call.lineno, call.col_offset,
-                    "blocking-under-lock",
-                    f"{why} (lock `{lock_src}` held since line "
-                    f"{node.lineno})", anchors=(node.lineno,)))
-            # one level deep: local helpers called under the lock
-            cls = _enclosing_class(module, node)
-            stack = list(node.body)
-            while stack:
-                sub = stack.pop()
-                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                    ast.Lambda)):
+        for lw in fn.lock_withs:
+            for site in lw.calls:
+                if site.hop or site.awaited:
                     continue
-                stack.extend(ast.iter_child_nodes(sub))
-                if not isinstance(sub, ast.Call):
-                    continue
-                callee = None
-                if isinstance(sub.func, ast.Name):
-                    callee = defs.get(("", sub.func.id))
-                elif isinstance(sub.func, ast.Attribute) and \
-                        isinstance(sub.func.value, ast.Name) and \
-                        sub.func.value.id in ("self", "cls"):
-                    callee = defs.get((cls, sub.func.attr)) \
-                        or defs.get(("", sub.func.attr))
-                if callee is None:
-                    continue
-                for call, why in _blocking_in(
-                        callee.body, lock_src, is_cond):
+                why = classify_blocking(site.call, lock_src=lw.lock_src,
+                                        is_cond=lw.is_cond)
+                if why is not None:
                     out.append(Finding(
-                        module.path, sub.lineno, sub.col_offset,
+                        module.path, site.lineno, site.col,
                         "blocking-under-lock",
-                        f"{why} — inside `{callee.name}` (line "
-                        f"{call.lineno}), called with lock "
-                        f"`{lock_src}` held", anchors=(node.lineno,)))
+                        f"{why} (lock `{lw.lock_src}` held since line "
+                        f"{lw.node.lineno})",
+                        anchors=(lw.node.lineno,)))
+                    continue
+                target = graph.nodes.get(site.target) \
+                    if site.target else None
+                if target is None or target.is_async:
+                    continue
+                hit = graph.blocking_summary(target.key)
+                if hit is None:
+                    continue
+                chain, why = hit
+                out.append(Finding(
+                    module.path, site.lineno, site.col,
+                    "blocking-under-lock",
+                    f"{why} — reached from `{site.name}` with lock "
+                    f"`{lw.lock_src}` held since line "
+                    f"{lw.node.lineno}; chain: {_fmt_chain(chain)}",
+                    anchors=(lw.node.lineno,)))
     return out

@@ -16,7 +16,11 @@ import textwrap
 
 from .core import analyze_source
 
-#: rule -> (path, must-flag source, must-pass source)
+#: rule -> (path, must-flag source, must-pass source).  A key may be
+#: "rule@shape" to pin an EXTRA named fixture pair for the same rule —
+#: the shapes that shipped as real bugs (PR 11 mesh wedge, PR 15
+#: under-lock ring scan) stay pinned here so the exact pattern that
+#: escaped review can never go dark again.
 SELF_TESTS: dict[str, tuple[str, str, str]] = {
     "budget-propagation": (
         "mod.py",
@@ -98,6 +102,138 @@ SELF_TESTS: dict[str, tuple[str, str, str]] = {
         "    if wire is not None:\n"
         "        msg['trace'] = wire\n",
     ),
+    "blocking-under-lock@ring-scan": (
+        # PR 15's shape: the storage scan hides TWO calls below the
+        # `with` — the old one-level heuristic missed it; the
+        # call-graph summary must not.
+        "mod.py",
+        "class Slo:\n"
+        "    def status(self):\n"
+        "        with self._mu:\n"
+        "            return self._rebuild()\n"
+        "    def _rebuild(self):\n"
+        "        return self._scan()\n"
+        "    def _scan(self):\n"
+        "        return self.disk.read_all('v', 'p')\n",
+        "class Slo:\n"
+        "    def status(self):\n"
+        "        with self._mu:\n"
+        "            snap = dict(self.state)\n"
+        "        return self._rebuild(snap)\n"
+        "    def _rebuild(self, snap):\n"
+        "        return self._scan(snap)\n"
+        "    def _scan(self, snap):\n"
+        "        return self.disk.read_all('v', 'p')\n",
+    ),
+    "loop-blocking": (
+        "mod.py",
+        # two hops deep: handler -> _work -> _deep -> time.sleep
+        "import time\n"
+        "class H:\n"
+        "    def _deep(self):\n"
+        "        time.sleep(1)\n"
+        "    def _work(self):\n"
+        "        self._deep()\n"
+        "    async def handler(self):\n"
+        "        self._work()\n",
+        "import asyncio\n"
+        "class H:\n"
+        "    async def handler(self, loop, pool, fn):\n"
+        "        await asyncio.sleep(0)\n"
+        "        return await loop.run_in_executor(pool, fn)\n",
+    ),
+    "await-under-lock": (
+        "mod.py",
+        "class C:\n"
+        "    async def f(self):\n"
+        "        with self._mu:\n"
+        "            await self.g()\n"
+        "    async def g(self):\n"
+        "        return 1\n",
+        "class C:\n"
+        "    async def f(self):\n"
+        "        with self._mu:\n"
+        "            x = self.h()\n"
+        "        await self.g()\n"
+        "    def h(self):\n"
+        "        return 1\n"
+        "    async def g(self):\n"
+        "        return 1\n",
+    ),
+    "lock-order": (
+        "mod.py",
+        # interprocedural cycle over module locks: submit takes a then
+        # b (through _drain), evict takes b then a (through _flush)
+        "import threading\n"
+        "_a_mu = threading.Lock()\n"
+        "_b_mu = threading.Lock()\n"
+        "def submit():\n"
+        "    with _a_mu:\n"
+        "        _drain()\n"
+        "def _drain():\n"
+        "    with _b_mu:\n"
+        "        pass\n"
+        "def evict():\n"
+        "    with _b_mu:\n"
+        "        _flush()\n"
+        "def _flush():\n"
+        "    with _a_mu:\n"
+        "        pass\n",
+        "import threading\n"
+        "_a_mu = threading.Lock()\n"
+        "_b_mu = threading.Lock()\n"
+        "def submit():\n"
+        "    with _a_mu:\n"
+        "        _drain()\n"
+        "def _drain():\n"
+        "    with _b_mu:\n"
+        "        pass\n"
+        "def evict():\n"
+        "    with _a_mu:\n"
+        "        with _b_mu:\n"
+        "            pass\n",
+    ),
+    "lock-order@mesh-wedge": (
+        # PR 11's deadlock: mesh launch under the tick lock on the
+        # submit path, tick under the mesh lock on the drain path —
+        # cross-class, visible only interprocedurally.
+        "mod.py",
+        "import threading\n"
+        "class Mesh:\n"
+        "    def __init__(self):\n"
+        "        self._mesh_mu = threading.Lock()\n"
+        "        self.runner = Runner()\n"
+        "    def launch(self, fn):\n"
+        "        with self._mesh_mu:\n"
+        "            fn()\n"
+        "    def drain(self):\n"
+        "        with self._mesh_mu:\n"
+        "            self.runner.tick()\n"
+        "class Runner:\n"
+        "    def __init__(self):\n"
+        "        self._tick_mu = threading.Lock()\n"
+        "        self.mesh = Mesh()\n"
+        "    def tick(self):\n"
+        "        with self._tick_mu:\n"
+        "            self.mesh.launch(None)\n",
+        "import threading\n"
+        "class Mesh:\n"
+        "    def __init__(self):\n"
+        "        self._mesh_mu = threading.Lock()\n"
+        "        self.runner = Runner()\n"
+        "    def launch(self, fn):\n"
+        "        with self._mesh_mu:\n"
+        "            fn()\n"
+        "    def drain(self):\n"
+        "        self.runner.tick()\n"
+        "class Runner:\n"
+        "    def __init__(self):\n"
+        "        self._tick_mu = threading.Lock()\n"
+        "        self.mesh = Mesh()\n"
+        "    def tick(self):\n"
+        "        with self._tick_mu:\n"
+        "            self.mesh.launch(None)\n",
+    ),
     "racecheck": (
         "mod.py",
         "class C:\n"
@@ -119,21 +255,23 @@ def run() -> list[str]:
     from . import rules as _rules  # noqa: F401  (registers on import)
     from .core import RULES
 
+    covered = {name.split("@", 1)[0] for name in SELF_TESTS}
     failures: list[str] = [
         f"{name}: registered rule has no self-test fixture pair — "
         "add one to SELF_TESTS"
-        for name in sorted(set(RULES) - set(SELF_TESTS))]
-    for rule, (path, bad, good) in sorted(SELF_TESTS.items()):
+        for name in sorted(set(RULES) - covered)]
+    for name, (path, bad, good) in sorted(SELF_TESTS.items()):
+        rule = name.split("@", 1)[0]  # "rule@shape" = extra shape
         got_bad = [f for f in analyze_source(
             textwrap.dedent(bad), path, [rule]) if f.rule == rule]
         if not got_bad:
             failures.append(
-                f"{rule}: known-bad fixture no longer flagged — the "
+                f"{name}: known-bad fixture no longer flagged — the "
                 "rule went dead")
         got_good = [f for f in analyze_source(
             textwrap.dedent(good), path, [rule]) if f.rule == rule]
         if got_good:
             failures.append(
-                f"{rule}: known-good fixture now flagged — the rule "
+                f"{name}: known-good fixture now flagged — the rule "
                 f"over-triggers: {got_good[0]}")
     return failures

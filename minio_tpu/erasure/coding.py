@@ -264,6 +264,7 @@ class _DeviceCodec:
             if codec is None:
                 return None
             if wins is None:
+                # lint: allow(blocking-under-lock): one-time probe per (k, m) under the codec cache lock — the verdict is memoized, later callers never re-enter the build
                 wins = cls._probe(codec, k, m)
                 cls._cache[key] = (codec, wins)
             return codec if wins else None

@@ -47,6 +47,7 @@ def _load():
         _lib_tried = True
         if not os.path.exists(_LIBPATH):
             try:
+                # lint: allow(blocking-under-lock): one-time native build under the dedicated dlopen lock — the lock exists to serialize exactly this init
                 subprocess.run(
                     ["make", "-C", _CSRC, "-s"], check=True, capture_output=True
                 )

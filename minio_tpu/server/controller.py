@@ -190,9 +190,9 @@ class OverloadController:
         if self._thread is not None:
             return
         self._stop.clear()
-        self._thread = threading.Thread(
-            target=self._run, name="overload-controller", daemon=True)
-        self._thread.start()
+        from minio_tpu.utils.deadline import service_thread
+        self._thread = service_thread(
+            self._run, name="overload-controller")
 
     def close(self) -> None:
         """Stop the loop and STEP EVERY LADDER DOWN: the reverts-when-
@@ -231,7 +231,8 @@ class OverloadController:
             # and re-baseline on their rules (no write: their config
             # IS the new ground truth)
             self._reset_qos_ladder()
-            self.qos_admin_resets += 1
+            with self._mu:
+                self.qos_admin_resets += 1
         self._expected_gen = gen
         return {
             "slo_plane": slo,
@@ -354,39 +355,52 @@ class OverloadController:
         sig = self._signals(snap)
         decisions: list[tuple[str, str, int]] = []
 
+        # ladder state flips under _mu (admin/status threads read it,
+        # close() zeroes it); the actuations themselves run OUTSIDE
+        # the lock — they touch other planes with their own locks
         def step(ladder: _Ladder, high: bool, engage, revert) -> None:
-            pre_cd = ladder.cooldown
-            if high:
-                ladder.streak_high = min(ladder.streak_high + 1,
-                                         self.hysteresis)
-                ladder.streak_low = 0
-            else:
-                ladder.streak_low = min(ladder.streak_low + 1,
-                                        self.hysteresis)
-                ladder.streak_high = 0
-            decided = False
-            if high and ladder.streak_high >= self.hysteresis \
-                    and pre_cd == 0 and ladder.depth < self.max_depth:
-                if engage(ladder.depth + 1):
-                    ladder.depth += 1
-                    ladder.engagements += 1
-                    ladder.cooldown = self.cooldown
-                    ladder.streak_high = 0
-                    decided = True
-                    decisions.append((ladder.name, "engage",
-                                      ladder.depth))
-            elif (not high) and ladder.streak_low >= self.hysteresis \
-                    and pre_cd == 0 and ladder.depth > 0:
-                if revert(ladder.depth - 1):
-                    ladder.depth -= 1
-                    ladder.reverts += 1
-                    ladder.cooldown = self.cooldown
+            with self._mu:
+                pre_cd = ladder.cooldown
+                if high:
+                    ladder.streak_high = min(ladder.streak_high + 1,
+                                             self.hysteresis)
                     ladder.streak_low = 0
+                else:
+                    ladder.streak_low = min(ladder.streak_low + 1,
+                                            self.hysteresis)
+                    ladder.streak_high = 0
+                depth = ladder.depth
+                do_engage = (high and pre_cd == 0
+                             and ladder.streak_high >= self.hysteresis
+                             and depth < self.max_depth)
+                do_revert = ((not high) and pre_cd == 0
+                             and ladder.streak_low >= self.hysteresis
+                             and depth > 0)
+            decided = False
+            if do_engage:
+                if engage(depth + 1):
+                    with self._mu:
+                        ladder.depth += 1
+                        ladder.engagements += 1
+                        ladder.cooldown = self.cooldown
+                        ladder.streak_high = 0
+                        new_depth = ladder.depth
                     decided = True
-                    decisions.append((ladder.name, "revert",
-                                      ladder.depth))
-            if not decided and ladder.cooldown > 0:
-                ladder.cooldown -= 1
+                    decisions.append((ladder.name, "engage", new_depth))
+            elif do_revert:
+                if revert(depth - 1):
+                    with self._mu:
+                        ladder.depth -= 1
+                        ladder.reverts += 1
+                        ladder.cooldown = self.cooldown
+                        ladder.streak_low = 0
+                        new_depth = ladder.depth
+                    decided = True
+                    decisions.append((ladder.name, "revert", new_depth))
+            if not decided:
+                with self._mu:
+                    if ladder.cooldown > 0:
+                        ladder.cooldown -= 1
 
         # tenant-mix flip: the ladder is engaged on tenant A but the
         # live offender is now tenant B (the regime shifted under us).
@@ -398,7 +412,8 @@ class OverloadController:
                 and self._qos_offender is not None \
                 and sig["offender"] != self._qos_offender:
             if self._qos_retarget(snap, sig["offender"], qlad.depth):
-                qlad.cooldown = self.cooldown
+                with self._mu:
+                    qlad.cooldown = self.cooldown
                 decisions.append(("qos", "retarget", qlad.depth))
         step(qlad, sig["qos_high"],
              lambda d: self._qos_engage(snap, sig, d),
@@ -486,17 +501,19 @@ class OverloadController:
         rules[offender] = self._qos_rule_at(qos, depth)
         qos.reconfigure(rules=rules, max_queue=qos.max_queue)
         self._expected_gen = qos.reconfigures
-        self.offender_switches += 1
+        with self._mu:
+            self.offender_switches += 1
         return True
 
     def _reset_qos_ladder(self) -> None:
-        ladder = self.ladders["qos"]
-        ladder.depth = 0
-        ladder.streak_high = 0
-        ladder.streak_low = 0
-        ladder.cooldown = 0
-        self._qos_offender = None
-        self._qos_baseline = None
+        with self._mu:
+            ladder = self.ladders["qos"]
+            ladder.depth = 0
+            ladder.streak_high = 0
+            ladder.streak_low = 0
+            ladder.cooldown = 0
+            self._qos_offender = None
+            self._qos_baseline = None
 
     # --------------------------------------------------- hedge actuation
     def _hedge_set(self, depth: int) -> bool:
@@ -523,23 +540,30 @@ class OverloadController:
         else:
             saturated = getattr(self.server, "_waiters", 0) > 0
         high = saturated and sig["burn_high"]
-        if high:
-            self._sat_streak = min(self._sat_streak + 1,
-                                   self.hysteresis)
-            self._calm_streak = 0
-        else:
-            self._calm_streak = min(self._calm_streak + 1,
-                                    self.hysteresis)
-            self._sat_streak = 0
-        if high and self._sat_streak >= self.hysteresis \
-                and not self.pool_add_recommended:
-            # saturation + burn persisting through the hysteresis
-            # window: admission capacity, not a transient, is the
-            # bottleneck — the capacity-model shape (req/s ~ k x
-            # cores; simulator/engine.py capacity_model) says more
-            # hardware, and ONLY an admin may act on that
-            self.pool_add_recommended = True
-            self.pool_add_events += 1
+        with self._mu:
+            if high:
+                self._sat_streak = min(self._sat_streak + 1,
+                                       self.hysteresis)
+                self._calm_streak = 0
+            else:
+                self._calm_streak = min(self._calm_streak + 1,
+                                        self.hysteresis)
+                self._sat_streak = 0
+            recommend = (high and self._sat_streak >= self.hysteresis
+                         and not self.pool_add_recommended)
+            calm = ((not high)
+                    and self._calm_streak >= self.hysteresis)
+            if recommend:
+                # saturation + burn persisting through the hysteresis
+                # window: admission capacity, not a transient, is the
+                # bottleneck — the capacity-model shape (req/s ~ k x
+                # cores; simulator/engine.py capacity_model) says more
+                # hardware, and ONLY an admin may act on that
+                self.pool_add_recommended = True
+                self.pool_add_events += 1
+            elif calm:
+                self.pool_add_recommended = False
+        if recommend:
             root = tracing.start("controller.pool_add",
                                  maxBurnFast=round(sig["max_burn"], 3))
             if root is not None:
@@ -549,8 +573,6 @@ class OverloadController:
                 tracing.finish(root, status=200)
             log.info("controller: pool add recommended "
                      "(saturated while burning; admin-gated)")
-        elif (not high) and self._calm_streak >= self.hysteresis:
-            self.pool_add_recommended = False
 
     # --------------------------------------------------------- stand-down
     def _stand_down(self) -> None:
@@ -570,14 +592,15 @@ class OverloadController:
             self._hedge_set(0)
         if self.ladders["brownout"].depth > 0:
             self._brownout_set(False)
-        for ladder in self.ladders.values():
-            ladder.depth = 0
-            ladder.streak_high = 0
-            ladder.streak_low = 0
-            ladder.cooldown = 0
-        self.pool_add_recommended = False
-        self._sat_streak = 0
-        self._calm_streak = 0
+        with self._mu:
+            for ladder in self.ladders.values():
+                ladder.depth = 0
+                ladder.streak_high = 0
+                ladder.streak_low = 0
+                ladder.cooldown = 0
+            self.pool_add_recommended = False
+            self._sat_streak = 0
+            self._calm_streak = 0
 
     # ------------------------------------------------------ observability
     def stats(self) -> dict:

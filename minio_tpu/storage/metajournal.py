@@ -373,18 +373,37 @@ class MetaIndex:
         return (segs[-1].rank + 1) if segs else 1
 
     def spill(self) -> None:
-        """Write each bucket's memtable as a new sorted segment."""
+        """Write each bucket's memtable as a new sorted segment.
+
+        The segment write (file I/O + fdatasync) runs OUTSIDE the lock
+        — the compact() pattern — so readers don't stall behind the
+        device.  The memtable keeps its entries until the segment is
+        published, then both flip in one locked section: a concurrent
+        names() sees the entry in the memtable or in the segment,
+        never in neither.  Spills come only from the committer thread,
+        so the snapshot cannot lose concurrent writes."""
         with self._lock:
-            mem, self._mem = self._mem, {}
-            for bucket, table in mem.items():
-                if not table:
-                    continue
-                d = self._bucket_dir(bucket)
-                os.makedirs(d, exist_ok=True)
-                rank = self._next_rank(bucket)
-                p = os.path.join(d, f"seg-{rank:08d}.idx")
-                _write_segment(p, sorted(table.items()), self.fsync)
+            plan = []
+            for bucket, table in self._mem.items():
+                if table:
+                    plan.append((bucket, self._next_rank(bucket),
+                                 sorted(table.items())))
+        written = []
+        for bucket, rank, items in plan:
+            d = self._bucket_dir(bucket)
+            os.makedirs(d, exist_ok=True)
+            p = os.path.join(d, f"seg-{rank:08d}.idx")
+            _write_segment(p, items, self.fsync)
+            written.append((bucket, p, rank, items))
+        with self._lock:
+            for bucket, p, rank, items in written:
                 self._load_segs(bucket).append(_Segment(p, rank))
+                table = self._mem.get(bucket)
+                if table is not None:
+                    for name, _present in items:
+                        table.pop(name, None)
+                    if not table:
+                        self._mem.pop(bucket, None)
                 self.spills += 1
         self.maybe_compact()
 
@@ -726,7 +745,8 @@ class MetaJournal:
         if self.fsync:
             _fdatasync_fd(self._fd)  # THE group fsync
         _kill("post_sync")
-        self.journal_bytes += len(buf)
+        with self._lock:
+            self.journal_bytes += len(buf)
         # apply buffered, newest-seq-wins within the batch (same-path
         # records are already in seq order; the last write wins)
         for _rec, bucket, path, op, data, _w in batch:
@@ -753,10 +773,13 @@ class MetaJournal:
         # and the applies made it visible (read-your-writes)
         for item in batch:
             item[5].event.set()
-        self.commits += len(batch)
-        self.batches += 1
-        self.last_batch = len(batch)
-        self.flush_ns += time.perf_counter_ns() - t0
+        # metrics threads read these lock-free (advisory); the WRITES
+        # stay under the journal lock so the racecheck watches hold
+        with self._lock:
+            self.commits += len(batch)
+            self.batches += 1
+            self.last_batch = len(batch)
+            self.flush_ns += time.perf_counter_ns() - t0
 
     def _rotate(self) -> None:
         """fdatasync the CURRENT xl.meta of each distinct dirty path
@@ -785,8 +808,9 @@ class MetaJournal:
         os.ftruncate(self._fd, 0)  # O_APPEND fd: next write lands at 0
         if self.fsync:
             _fdatasync_fd(self._fd)
-        self.journal_bytes = 0
-        self.rotations += 1
+        with self._lock:
+            self.journal_bytes = 0
+            self.rotations += 1
         _kill("post_rotate")
 
     def _idle(self) -> None:

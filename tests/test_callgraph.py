@@ -1,0 +1,263 @@
+"""Pinned resolution semantics of the interprocedural call graph
+(ISSUE 19 satellite): a small fixture package with EXACT expected
+edges, so a refactor that silently breaks method resolution, hop
+severing, or lambda linking fails here — not as a missed finding three
+PRs later.
+
+Pins: cross-module inherited methods (MRO), the mixin/subclass-unique
+fallback, `__getattr__` delegation (a documented BLIND SPOT — pinned
+unresolved so a future fix is a conscious semantics change), closures
+handed to executors (hop edge to the `<locals>` node), lambda hops
+(hop edge to the `<lambda@N>` node whose own body edges resolve), and
+the await-of-sync-def inline traversal.
+"""
+
+from __future__ import annotations
+
+import textwrap
+
+from minio_tpu.analysis.callgraph import CallGraph
+from minio_tpu.analysis.core import Module
+
+
+def _graph(**sources: str) -> CallGraph:
+    """Build a CallGraph from {module_name: source} fixture files laid
+    out as a flat `pkg/` package (dotted names come out `pkg.<name>`)."""
+    mods = [Module(f"pkg/{name}.py", textwrap.dedent(src))
+            for name, src in sources.items()]
+    return CallGraph(mods)
+
+
+def _site(g, key, callee):
+    """The unique call site in node `key` whose display name is
+    `callee` — asserting uniqueness keeps the pins unambiguous."""
+    fn = g.nodes[key]
+    hits = [s for s in fn.calls if s.name == callee]
+    assert len(hits) == 1, (
+        f"expected exactly one `{callee}` site in {key}, "
+        f"got {[s.name for s in fn.calls]}")
+    return hits[0]
+
+
+BASE = """
+    import time
+
+
+    class Base:
+        def ping(self):
+            self.pong()
+
+        def slow(self):
+            time.sleep(1)
+"""
+
+DERIVED = """
+    from pkg.base import Base
+
+
+    class Derived(Base):
+        def pong(self):
+            self.slow()
+"""
+
+
+class TestMethodResolution:
+    def test_inherited_method_resolves_cross_module(self):
+        g = _graph(base=BASE, derived=DERIVED)
+        # Derived.pong calls self.slow() -> the BASE class method,
+        # found through the MRO across the module boundary
+        assert _site(g, "pkg.derived.Derived.pong",
+                     "self.slow").target == "pkg.base.Base.slow"
+
+    def test_subclass_unique_fallback_resolves_mixin_call(self):
+        g = _graph(base=BASE, derived=DERIVED)
+        # Base.ping calls self.pong() which Base does NOT define; the
+        # one concrete descendant (Derived) does, so the mixin-style
+        # fallback resolves it (the server/app.py handler pattern)
+        assert _site(g, "pkg.base.Base.ping",
+                     "self.pong").target == "pkg.derived.Derived.pong"
+
+    def test_ambiguous_subclass_method_stays_unresolved(self):
+        g = _graph(base=BASE, derived=DERIVED, other="""
+            from pkg.base import Base
+
+
+            class Other(Base):
+                def pong(self):
+                    pass
+        """)
+        # two descendants disagree on `pong` -> no unique target
+        assert _site(g, "pkg.base.Base.ping", "self.pong").target is None
+
+    def test_blocking_chain_threads_the_resolved_edges(self):
+        g = _graph(base=BASE, derived=DERIVED)
+        got = g.blocking_summary("pkg.base.Base.ping")
+        assert got is not None
+        chain, why = got
+        assert [name for name, _path, _line in chain] == \
+            ["self.pong", "self.slow", "time.sleep"]
+        assert "sleep" in why
+
+    def test_getattr_delegation_is_a_pinned_blind_spot(self):
+        g = _graph(proxy="""
+            import time
+
+
+            class Inner:
+                def work(self):
+                    time.sleep(1)
+
+
+            class Proxy:
+                def __init__(self):
+                    self._inner = object()
+
+                def __getattr__(self, name):
+                    return getattr(self._inner, name)
+
+
+            def use():
+                p = Proxy()
+                p.work()
+        """)
+        # dynamic delegation: the graph deliberately does NOT follow
+        # __getattr__ — if this pin breaks, the module docstring's
+        # blind-spot list must change with it
+        assert _site(g, "pkg.proxy.use", "p.work").target is None
+        assert g.blocking_summary("pkg.proxy.use") is None
+
+
+class TestHopEdges:
+    SRC = """
+        import time
+
+
+        def do_block():
+            time.sleep(1)
+
+
+        def spawn(pool):
+            def work():
+                do_block()
+            pool.submit(work)
+            pool.submit(lambda: do_block())
+    """
+
+    def test_closure_to_executor_is_a_hop_to_the_locals_node(self):
+        g = _graph(hops=self.SRC)
+        sites = [s for s in g.nodes["pkg.hops.spawn"].calls if s.hop]
+        assert len(sites) == 2
+        assert sites[0].target == "pkg.hops.spawn.<locals>.work"
+        # the closure's OWN edges resolve (it is a first-class node)
+        assert _site(g, "pkg.hops.spawn.<locals>.work",
+                     "do_block").target == "pkg.hops.do_block"
+
+    def test_lambda_hop_becomes_its_own_linked_node(self):
+        g = _graph(hops=self.SRC)
+        lam_key = [s.target for s in g.nodes["pkg.hops.spawn"].calls
+                   if s.hop][1]
+        assert lam_key is not None and ".<lambda@" in lam_key
+        assert _site(g, lam_key,
+                     "do_block").target == "pkg.hops.do_block"
+
+    def test_hop_severs_the_blocking_chain(self):
+        g = _graph(hops=self.SRC)
+        # do_block blocks, work reaches it, but spawn only reaches
+        # work/lambda across a thread boundary -> spawn itself is clean
+        assert g.blocking_summary("pkg.hops.do_block") is not None
+        assert g.blocking_summary(
+            "pkg.hops.spawn.<locals>.work") is not None
+        assert g.blocking_summary("pkg.hops.spawn") is None
+
+
+class TestAsyncColoring:
+    def test_await_of_sync_def_runs_inline_and_is_traversed(self):
+        g = _graph(aio="""
+            import time
+
+
+            def helper():
+                time.sleep(1)
+
+
+            async def handler():
+                await helper()
+        """)
+        h = g.nodes["pkg.aio.handler"]
+        assert h.is_async
+        site = _site(g, "pkg.aio.handler", "helper")
+        assert site.awaited and site.target == "pkg.aio.helper"
+        # awaited-but-sync: the body runs inline before anything is
+        # awaitable, so the chain traverses it
+        assert g.site_blocking(h, site) is not None
+
+    def test_await_of_async_def_parks_the_task(self):
+        g = _graph(aio="""
+            import time
+
+
+            async def helper():
+                time.sleep(1)
+
+
+            async def handler():
+                await helper()
+        """)
+        h = g.nodes["pkg.aio.handler"]
+        site = _site(g, "pkg.aio.handler", "helper")
+        # the await suspends at the coroutine boundary; helper's OWN
+        # body blocking is helper's finding, not handler's
+        assert g.site_blocking(h, site) is None
+
+
+class TestLockGraph:
+    def test_interprocedural_cycle_found_and_order_edges_keyed(self):
+        g = _graph(locks="""
+            import threading
+
+            _a_mu = threading.Lock()
+            _b_mu = threading.Lock()
+
+
+            def fwd():
+                with _a_mu:
+                    inner_b()
+
+
+            def inner_b():
+                with _b_mu:
+                    pass
+
+
+            def rev():
+                with _b_mu:
+                    inner_a()
+
+
+            def inner_a():
+                with _a_mu:
+                    pass
+        """)
+        edges = g.lock_order_edges()
+        assert ("M:pkg.locks._a_mu", "M:pkg.locks._b_mu") in edges
+        assert ("M:pkg.locks._b_mu", "M:pkg.locks._a_mu") in edges
+        cycles = g.lock_cycles()
+        assert len(cycles) == 1
+        assert {a for a, _b, _w in cycles[0]} == \
+            {"M:pkg.locks._a_mu", "M:pkg.locks._b_mu"}
+
+    def test_class_attr_locks_share_one_key_across_instances(self):
+        g = _graph(locks="""
+            import threading
+
+
+            class Box:
+                def __init__(self):
+                    self._mu = threading.Lock()
+
+                def put(self):
+                    with self._mu:
+                        pass
+        """)
+        assert g.nodes["pkg.locks.Box.put"].acquires == \
+            [("C:pkg.locks.Box._mu", 10)]

@@ -469,6 +469,48 @@ class TestMetaIndex:
         d.walk_dir = boom
         assert listing.union_walk([d], "bkt") == [f"o{i}" for i in range(5)]
 
+    def test_spill_never_hides_committed_names(self, tmp_path,
+                                               monkeypatch):
+        """ISSUE 19 regression: spill() used to swap the memtable out
+        BEFORE the segment write, leaving a window (widened here by a
+        slow `_write_segment`) where a committed name was in neither
+        the memtable nor any segment — a concurrent names() read would
+        miss it.  The fix snapshots without clearing and publishes
+        segment + memtable removal in one locked section."""
+        import time as _time
+
+        idx = metajournal.MetaIndex(str(tmp_path / "d0"), fsync=False)
+        idx.activate()
+        idx.seed("bkt", [])
+        for i in range(50):
+            idx.apply("bkt", f"o{i:03d}", True)
+
+        real = metajournal._write_segment
+
+        def slow_write(path, items, fsync):
+            _time.sleep(0.05)
+            return real(path, items, fsync)
+
+        monkeypatch.setattr(metajournal, "_write_segment", slow_write)
+        missing, stop = [], threading.Event()
+
+        def reader():
+            while not stop.is_set():
+                got = idx.names("bkt")
+                if got is not None and "o000" not in got:
+                    missing.append(got)
+
+        t = threading.Thread(target=reader)
+        t.start()
+        try:
+            idx.spill()
+        finally:
+            stop.set()
+            t.join(10)
+        assert not missing, "a committed name vanished mid-spill"
+        assert idx.spills == 1
+        assert idx.names("bkt") == [f"o{i:03d}" for i in range(50)]
+
     def test_spill_compaction_preserves_names(self, jman, tmp_path,
                                               monkeypatch):
         monkeypatch.setattr(metajournal, "COMPACT_SEGMENTS", 2)

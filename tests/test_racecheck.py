@@ -420,6 +420,135 @@ class TestReplayDrills:
             rc.TRACKER.findings()), (
             "the pre-fix bare-increment shape escaped the detector")
 
+    def test_controller_drill_clean(self):
+        """ISSUE 19: the overload controller's ladder vector and
+        counters under the real tick/scrape/admin/stand-down fan-in —
+        one ticker (production is a single daemon thread), a stats
+        scraper, an admin reconfigure racing the sample-decide window,
+        and close() from the main thread (which zeroes every ladder)."""
+        from minio_tpu.server.controller import OverloadController, _Ladder
+        from minio_tpu.server.qos import TenantRule
+
+        from .test_controller import HOT, burning, calm, make_controller
+
+        rc.watch(OverloadController, "ticks", "skipped_stale",
+                 "qos_admin_resets", "offender_switches",
+                 "pool_add_events", "pool_add_recommended",
+                 "_sat_streak", "_calm_streak")
+        rc.watch(_Ladder, "depth", "streak_high", "streak_low",
+                 "cooldown", "engagements", "reverts")
+        with rc.patched():
+            c, srv, qos, clk = make_controller(hysteresis=1, cooldown=0)
+
+            def ticker():
+                for i in range(40):
+                    (burning if i % 4 < 2 else calm)(srv.slo)
+                    clk.now += 1.0
+                    c.tick()
+
+            def scraper():
+                for _ in range(80):
+                    c.stats()
+
+            def admin():
+                for _ in range(10):
+                    qos.reconfigure(rules={HOT: TenantRule(weight=16)},
+                                    max_queue=qos.max_queue)
+                    time.sleep(0.001)
+
+            _run_threads(ticker, scraper, admin)
+            c.close()  # main-thread stand-down: the second writer
+        bad = [f for f in rc.TRACKER.findings()
+               if "controller" in f.key]
+        assert not bad, f"controller lockset findings: {bad}"
+        assert c.ticks == 40  # the drill actually ticked
+
+    def test_georep_stats_drill_clean_and_prefix_shape_flagged(self,
+                                                               monkeypatch):
+        """ISSUE 19: georep's module-level stats table — no class
+        attribute to watch, so the TracedDict swap (the stagestats
+        pattern).  The real `_bump` path under a tracked lock stays
+        clean; the pre-fix bare `stats[k] += n` shape must flag."""
+        from minio_tpu.services import georep
+
+        traced = rc.TracedDict("services.georep.stats",
+                               dict.fromkeys(georep.stats, 0))
+        monkeypatch.setattr(georep, "stats", traced)
+        monkeypatch.setattr(georep, "_stats_mu", rc.Lock())
+
+        def pusher():
+            for _ in range(100):
+                georep._bump("pushed_objects")
+                georep._bump("pushed_bytes", 1024)
+
+        def receiver():
+            for _ in range(100):
+                georep._bump("applied")
+                georep._bump("already")
+
+        def scraper():
+            # the status() totals read, minus the server plumbing
+            for _ in range(50):
+                with georep._stats_mu:
+                    dict(georep.stats)
+
+        _run_threads(pusher, pusher, receiver, scraper)
+        assert not [f for f in rc.TRACKER.findings()
+                    if "georep" in f.key]
+        assert traced["pushed_objects"] == 200
+
+        # the PRE-FIX shape: bare read-modify-write, no _stats_mu
+        rc.TRACKER.reset()
+        bare = rc.TracedDict("services.georep.stats", {"pushed_objects": 0})
+        monkeypatch.setattr(georep, "stats", bare)
+
+        def racy():
+            for _ in range(200):
+                georep.stats["pushed_objects"] += 1
+
+        _run_threads(racy, racy)
+        assert "services.georep.stats" in _keys(rc.TRACKER.findings()), (
+            "the pre-fix unlocked stats bump escaped the detector")
+
+    def test_metajournal_drill_clean(self, tmp_path, monkeypatch):
+        """ISSUE 19: the metadata journal's flush counters and the
+        index spill counter — concurrent producers enqueue commits,
+        the committer thread flushes (counter writes under the journal
+        lock), spills fire on a tiny memtable bound, and a metrics
+        thread reads the counters lock-free (the advisory-snapshot
+        idiom: reads never refine the lockset)."""
+        from minio_tpu.storage import metajournal as mj
+
+        rc.watch(mj.MetaJournal, "commits", "batches", "last_batch",
+                 "flush_ns", "rotations", "journal_bytes")
+        rc.watch(mj.MetaIndex, "spills")
+        monkeypatch.setattr(mj, "MEMTABLE_SPILL", 8)
+        with rc.patched():
+            j = mj.MetaJournal(str(tmp_path / "d0"),
+                               lambda b, p, d: None, lambda b, p: None,
+                               fsync=False)
+            try:
+                def producer(tag):
+                    def run():
+                        for i in range(40):
+                            j.commit("bkt", f"o{tag}-{i}", b"x" * 16)
+                    return run
+
+                def scraper():
+                    for _ in range(100):
+                        (j.commits, j.batches, j.last_batch,
+                         j.journal_bytes, j.index.spills)
+
+                _run_threads(producer(0), producer(1), producer(2),
+                             scraper)
+            finally:
+                j.close()
+        bad = [f for f in rc.TRACKER.findings()
+               if "MetaJournal" in f.key or "MetaIndex" in f.key]
+        assert not bad, f"metajournal lockset findings: {bad}"
+        assert j.commits == 120
+        assert j.index.spills > 0, "the drill never exercised a spill"
+
     def test_drills_actually_observed_concurrency(self):
         """Meta-check: a drill that never leaves the Eraser exclusive
         phase tests nothing — prove the harness records multi-thread

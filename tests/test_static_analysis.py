@@ -63,7 +63,8 @@ class TestPackageClean:
                 "s3-error-coverage", "metrics-drift",
                 "thread-lifecycle", "payload-budget",
                 "shared-state", "resource-lifecycle",
-                "racecheck"} <= set(RULES)
+                "racecheck", "loop-blocking", "await-under-lock",
+                "lock-order"} <= set(RULES)
 
 
 # ------------------------------------------------------- budget-propagation
@@ -209,6 +210,197 @@ class TestBlockingUnderLockFixtures:
                 time.sleep(0.1)
         """
         assert not _findings(ok, rules=["blocking-under-lock"])
+
+    def test_deep_cross_class_chain_flagged(self):
+        """ISSUE 19: the one-level heuristic is gone — the call graph
+        follows the chain through a second class's methods."""
+        bad = """
+        import time
+
+
+        class Backoff:
+            def pause(self):
+                time.sleep(0.5)
+
+
+        class T:
+            def __init__(self):
+                self.bo = Backoff()
+
+            def _retry(self):
+                self.bo.pause()
+
+            def mutate(self):
+                with self._mu:
+                    self._retry()
+        """
+        got = _findings(bad, rules=["blocking-under-lock"])
+        assert len(got) == 1
+        assert "chain" in got[0].message
+
+    def test_executor_hop_under_lock_passes(self):
+        good = """
+        import time
+
+
+        def slow():
+            time.sleep(1)
+
+
+        def f(self, pool):
+            with self._mu:
+                return pool.submit(slow)
+        """
+        assert not _findings(good, rules=["blocking-under-lock"])
+
+
+# ----------------------------------------------------------- loop-blocking
+class TestLoopBlockingFixtures:
+    def test_transitive_sync_chain_flagged(self):
+        bad = """
+        import time
+
+
+        def _deep():
+            time.sleep(1)
+
+
+        def _work():
+            _deep()
+
+
+        class H:
+            async def handler(self):
+                self._go()
+
+            def _go(self):
+                _work()
+        """
+        got = _findings(bad, rules=["loop-blocking"])
+        assert len(got) == 1
+        assert "event loop" in got[0].message
+
+    def test_awaited_coroutine_and_executor_hop_pass(self):
+        good = """
+        import asyncio
+        import time
+
+
+        def slow():
+            time.sleep(1)
+
+
+        class H:
+            async def handler(self, loop, pool):
+                await asyncio.sleep(0)
+                await loop.run_in_executor(pool, slow)
+        """
+        assert not _findings(good, rules=["loop-blocking"])
+
+    def test_await_of_sync_def_is_traversed(self):
+        """`await self._helper()` where _helper is a plain def runs
+        the body inline — the await does not launder the block."""
+        bad = """
+        import time
+
+
+        class H:
+            def _helper(self):
+                time.sleep(1)
+
+            async def handler(self):
+                await self._helper()
+        """
+        assert _findings(bad, rules=["loop-blocking"])
+
+
+# -------------------------------------------------------- await-under-lock
+class TestAwaitUnderLockFixtures:
+    def test_await_inside_threading_lock_flagged(self):
+        bad = """
+        class H:
+            async def handler(self):
+                with self._mu:
+                    await self.refresh()
+        """
+        got = _findings(bad, rules=["await-under-lock"])
+        assert len(got) == 1
+
+    def test_sync_call_under_lock_and_await_outside_pass(self):
+        good = """
+        class H:
+            async def handler(self):
+                with self._mu:
+                    snap = self._snapshot()
+                await self.push(snap)
+        """
+        assert not _findings(good, rules=["await-under-lock"])
+
+
+# -------------------------------------------------------------- lock-order
+class TestLockOrderFixtures:
+    def test_opposite_nesting_cycle_flagged_once(self):
+        bad = """
+        import threading
+
+        _a_mu = threading.Lock()
+        _b_mu = threading.Lock()
+
+
+        def submit():
+            with _a_mu:
+                with _b_mu:
+                    pass
+
+
+        def evict():
+            with _b_mu:
+                with _a_mu:
+                    pass
+        """
+        got = _findings(bad, rules=["lock-order"])
+        assert len(got) == 1  # one cycle, one report
+        assert "_a_mu" in got[0].message and "_b_mu" in got[0].message
+
+    def test_consistent_order_passes(self):
+        good = """
+        import threading
+
+        _a_mu = threading.Lock()
+        _b_mu = threading.Lock()
+
+
+        def submit():
+            with _a_mu:
+                with _b_mu:
+                    pass
+
+
+        def evict():
+            with _a_mu:
+                with _b_mu:
+                    pass
+        """
+        assert not _findings(good, rules=["lock-order"])
+
+    def test_multi_item_with_orders_left_to_right(self):
+        bad = """
+        import threading
+
+        _a_mu = threading.Lock()
+        _b_mu = threading.Lock()
+
+
+        def submit():
+            with _a_mu, _b_mu:
+                pass
+
+
+        def evict():
+            with _b_mu, _a_mu:
+                pass
+        """
+        assert _findings(bad, rules=["lock-order"])
 
 
 # ------------------------------------------------------- s3-error-coverage
@@ -655,11 +847,14 @@ class TestPragmaHygiene:
 
 # ------------------------------------------------------------------- CLI
 class TestCli:
-    def _run(self, *args):
+    def _run(self, *args, env=None):
+        full_env = dict(os.environ)
+        if env:
+            full_env.update(env)
         return subprocess.run(
             [sys.executable, "-m", "minio_tpu.analysis", *args],
             capture_output=True, text=True, timeout=300,
-            cwd=os.path.dirname(PKG))
+            cwd=os.path.dirname(PKG), env=full_env)
 
     def test_list_rules(self):
         proc = self._run("--list-rules")
@@ -696,22 +891,62 @@ class TestCli:
 
     def test_all_gate_single_exit_code(self):
         """ISSUE 10: `--all` = AST rules + bounded model check (with
-        the mutation-liveness proof) + rule self-tests, one exit code."""
-        proc = self._run("--all", PKG)
+        the mutation-liveness proof) + rule self-tests, one exit code.
+        A generous explicit budget keeps a loaded CI box from tripping
+        the wall-clock assertion tested separately below."""
+        proc = self._run("--all", PKG,
+                         env={"MINIO_TPU_ANALYSIS_BUDGET_S": "120"})
         assert proc.returncode == 0, proc.stdout + proc.stderr
         out = proc.stdout
         assert "model arena-ring" in out
         assert "model hotcache" in out
         assert "model breaker-mrf" in out
         assert "selfcheck" in out and "lint: clean" in out
+        # the gate reports its own wall clock (ISSUE 19)
+        assert "gate:" in out and "s wall" in out
+
+    def test_all_gate_budget_exceeded_is_a_finding(self, tmp_path):
+        """ISSUE 19: `--all` asserts its own wall-clock budget — a
+        gate that creeps past the dev-loop threshold exits nonzero."""
+        good = tmp_path / "good.py"
+        good.write_text("X = 1\n")
+        proc = self._run("--all", str(good),
+                         env={"MINIO_TPU_ANALYSIS_BUDGET_S": "0.01"})
+        assert proc.returncode == 1
+        assert "BUDGET EXCEEDED" in proc.stderr
+
+    def test_all_gate_budget_disabled_with_zero(self, tmp_path):
+        good = tmp_path / "good.py"
+        good.write_text("X = 1\n")
+        proc = self._run("--all", str(good),
+                         env={"MINIO_TPU_ANALYSIS_BUDGET_S": "0"})
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "budget off" in proc.stdout
+
+    def test_callgraph_debug_flag_prints_resolved_entry(self):
+        """ISSUE 19: `--callgraph <fn>` prints the node's color and
+        edges so waiver review doesn't re-derive the chain by hand."""
+        proc = self._run("--callgraph",
+                         "minio_tpu.storage.metajournal.MetaIndex.spill")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        out = proc.stdout
+        assert "minio_tpu.storage.metajournal.MetaIndex.spill" in out
+        assert "[sync]" in out
+        assert "->" in out  # at least one resolved/unresolved edge
+
+    def test_callgraph_flag_unknown_node_says_so(self):
+        proc = self._run("--callgraph", "no.such.function_xyz")
+        assert proc.returncode == 0
+        assert "no node matches" in proc.stdout
 
     def test_selfcheck_catches_dead_rule(self):
         from minio_tpu.analysis import selfcheck
 
         assert selfcheck.run() == []
         # a rule the self-test table names must exist in the registry
+        # ("rule@shape" keys pin extra fixtures for the same rule)
         for rule in selfcheck.SELF_TESTS:
-            assert rule in RULES
+            assert rule.split("@", 1)[0] in RULES
 
 
 # -------------------------------------------------- process lifecycle
