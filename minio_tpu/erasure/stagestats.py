@@ -21,7 +21,14 @@ import time
 
 from minio_tpu.utils import tracing
 
-STAGES = ("read", "etag", "encode", "hash", "write", "decode", "respond")
+# "fused_hash" books the frame-hash plane when MINIO_TPU_FUSED_HASH
+# folds it into the encode program (erasure/coding.py): on the device
+# path the bytes land here with ~zero seconds (the hash rides the encode
+# launch — one pass is the point); on the host fallback it carries the
+# tiled hash leg's real seconds so fused vs legacy "hash" stays
+# attributable.
+STAGES = ("read", "etag", "encode", "hash", "fused_hash", "write",
+          "decode", "respond")
 
 _lock = threading.Lock()
 _seconds = {s: 0.0 for s in STAGES}

@@ -984,9 +984,22 @@ class WorkerPlane:
             h = _WorkerHandle(self, "io", i)
             h.spawn()
             self.io.append(h)
-        h = _WorkerHandle(self, "hash", 0)
-        h.spawn()
-        self.hash = h
+        # the dedicated hash-lane process is skipped when the fused
+        # etag fold is available (MINIO_TPU_FUSED_HASH + a device, or
+        # MINIO_TPU_FUSED_ETAG=1): put_data folds MD5 inline via the
+        # device scan (ops/hh_device.py::Md5Fold) instead of shipping
+        # every payload byte to a second process
+        fused_etag = False
+        try:
+            from minio_tpu.ops import hh_device
+
+            fused_etag = hh_device.fused_etag_available()
+        except Exception:
+            fused_etag = False
+        if not fused_etag:
+            h = _WorkerHandle(self, "hash", 0)
+            h.spawn()
+            self.hash = h
 
     def child_env(self, kind: str) -> dict:
         """Env overrides for a child: the O_DIRECT device-write gate is
@@ -1026,7 +1039,8 @@ class WorkerPlane:
     def ping(self, timeout: float = 30.0) -> bool:
         """Round-trip every worker (spawn warmup / tests)."""
         try:
-            ps = [(h, h.send({"op": "ping"})) for h in self.io + [self.hash]]
+            ps = [(h, h.send({"op": "ping"}))
+                  for h in self.io + ([self.hash] if self.hash else [])]
             for h, p in ps:
                 h.wait(p, timeout)
             return True
@@ -1092,7 +1106,9 @@ class WorkerPlane:
             nslots = 2
         parts = self._partition(n, self.nworkers)
         handles = self.io[:len(parts)]
-        nconsumers = len(handles) + 1  # + hash lane
+        # + hash lane, unless the fused etag fold replaced it (then the
+        # producer folds MD5 inline and no hash consumer rides the ring)
+        nconsumers = len(handles) + (1 if self.hash is not None else 0)
         shm = self.rings.acquire(nslots, slot_bytes, nconsumers)
         prod = RingProducer(shm, nslots, slot_bytes, nconsumers)
         if os.environ.get("MINIO_TPU_MP_TRACE"):
@@ -1156,22 +1172,28 @@ class WorkerPlane:
                     dead.add(c)
                     for s, _r in drives:
                         failed[s] = ex
-            hmsg = dict(base)
-            hmsg.update({"op": "hash", "consumer": len(handles),
-                         "drives": []})
-            try:
-                gens[len(handles)] = self.hash.restarts
-                hash_span = tracing.begin("mp.job", op="hash")
-                hash_pending = self.hash.send(hmsg)
-            except WorkerDied:
-                # no etag lane, no PUT: unblock the io workers (they
-                # would otherwise wait out the whole ring window on a
-                # generation that never comes) and surface retryable
+            md5_fold = None
+            if self.hash is not None:
+                hmsg = dict(base)
+                hmsg.update({"op": "hash", "consumer": len(handles),
+                             "drives": []})
                 try:
-                    prod.finish(dead_fn, abort=True, timeout=5.0)
+                    gens[len(handles)] = self.hash.restarts
+                    hash_span = tracing.begin("mp.job", op="hash")
+                    hash_pending = self.hash.send(hmsg)
                 except WorkerDied:
-                    pass
-                raise
+                    # no etag lane, no PUT: unblock the io workers (they
+                    # would otherwise wait out the whole ring window on a
+                    # generation that never comes) and surface retryable
+                    try:
+                        prod.finish(dead_fn, abort=True, timeout=5.0)
+                    except WorkerDied:
+                        pass
+                    raise
+            else:
+                from minio_tpu.ops import hh_device
+
+                md5_fold = hh_device.Md5Fold()
 
             total = 0
             t_read = 0.0
@@ -1189,6 +1211,14 @@ class WorkerPlane:
                     t_read += time.perf_counter() - t0
                     if not got:
                         break
+                    if md5_fold is not None:
+                        # fused etag: fold before publish — the slot's
+                        # bytes are stable here, and the device scan
+                        # dispatches async so the next fill overlaps it
+                        t0 = time.perf_counter()
+                        md5_fold.update(view[:got])
+                        stagestats.add(
+                            "etag", time.perf_counter() - t0, got)
                     prod.publish(got)
                     total += got
                     if got < want:
@@ -1224,18 +1254,26 @@ class WorkerPlane:
                     tracing.graft(out.get("trace"), sp)
                     sp.finish()
                 self.last_worker_wall = out.get("wall")
-            hout = self.hash.wait(hash_pending, timeout)
-            if hash_span is not None:
-                tracing.graft(hout.get("trace"), hash_span)
-                hash_span.finish()
-            st = hout.get("stage", {})
-            for stage, secs in st.items():
-                stagestats.add(stage, secs, 0)
-            etag = hout.get("md5", "")
-            if not etag or hout.get("total") != total:
-                raise WorkerDied(
-                    "hash lane did not observe the full payload "
-                    f"({hout.get('total')} != {total})")
+            if md5_fold is not None:
+                # fused etag: the producer folded every published byte
+                # inline, so the lane's "did you see it all" invariant
+                # holds by construction
+                t0 = time.perf_counter()
+                etag = md5_fold.hexdigest()
+                stagestats.add("etag", time.perf_counter() - t0, 0)
+            else:
+                hout = self.hash.wait(hash_pending, timeout)
+                if hash_span is not None:
+                    tracing.graft(hout.get("trace"), hash_span)
+                    hash_span.finish()
+                st = hout.get("stage", {})
+                for stage, secs in st.items():
+                    stagestats.add(stage, secs, 0)
+                etag = hout.get("md5", "")
+                if not etag or hout.get("total") != total:
+                    raise WorkerDied(
+                        "hash lane did not observe the full payload "
+                        f"({hout.get('total')} != {total})")
             now = time.perf_counter()
             # per-phase wall of the last job (debugging/bench aid):
             # feed = producing into the ring (incl. slot waits),

@@ -247,6 +247,13 @@ class MetricsMixin:
                      "processed per object data-plane pipeline stage",
                      "# TYPE minio_dataplane_stage_bytes_total gauge"]
             for stage, d in snap.items():
+                if (stage == "fused_hash" and not d["seconds"]
+                        and not d["bytes"]):
+                    # the fused-hash stage only exists while
+                    # MINIO_TPU_FUSED_HASH routes work into it: a
+                    # gate-off scrape stays byte-identical to before
+                    # the lane existed (the 0<->1 differential pins it)
+                    continue
                 lbl = _fmt_labels(("stage",), (stage,))
                 srows.append("minio_dataplane_stage_seconds_total"
                              f"{lbl} {round(d['seconds'], 6)}")
@@ -400,11 +407,15 @@ class MetricsMixin:
                     rows.append(f"{name}{lbl} {ts[field]}")
                 g("\n".join(rows) + "\n")
             rows = ["# HELP minio_qos_shed_total Requests shed 503 per "
-                    "tenant and reason (queue_full|deadline)",
+                    "tenant and reason (queue_full|deadline|hot_lane)",
                     "# TYPE minio_qos_shed_total gauge"]
             for t, ts in sorted(qs["tenants"].items()):
+                # hot_lane: hot-lane claims refused at the tenant's cap
+                # (the request fell back to normal QoS admission instead
+                # of crowding hot_sem — the PR 13 carried leftover)
                 for reason, field in (("queue_full", "shedQueueFull"),
-                                      ("deadline", "shedDeadline")):
+                                      ("deadline", "shedDeadline"),
+                                      ("hot_lane", "hotLaneCapped")):
                     lbl = _fmt_labels(("tenant", "reason"), (t, reason))
                     rows.append(f"minio_qos_shed_total{lbl} {ts[field]}")
             g("\n".join(rows) + "\n")

@@ -1,0 +1,198 @@
+"""Fused hash+encode kernels (ISSUE 20): bit-exactness of the batched
+HighwayHash-256 implementations against the streaming C reference.
+
+Three implementations must agree byte-for-byte with ops/host.py::hh256
+(itself golden-pinned against the reference bitrot self-test,
+cmd/bitrot.go:37):
+
+* hh256_batch_np — the vectorized numpy oracle (also the no-C-library
+  fallback on the host fused path);
+* hh256_jax — the XLA kernel the fused encode+hash device program uses;
+* fused_encode_hash — the one-launch program: parity must equal the
+  host codec's, per-shard frame hashes must equal hh256 of the rows.
+
+The JAX kernels compile ~30s PER DISTINCT (N, L) SHAPE on a CPU box
+(lax.scan over packets), so the broad jax sweeps are `slow`; tier-1
+keeps the full numpy-oracle sweep, the reference-self-test extension,
+the Md5Fold differential and the write_frames(hashes=) plumbing.
+"""
+
+import hashlib
+import io
+
+import numpy as np
+import pytest
+
+from minio_tpu.erasure import bitrot
+from minio_tpu.ops import hh_device, host
+from minio_tpu.storage import errors
+
+pytestmark = pytest.mark.skipif(
+    not host.available(), reason="host library build unavailable"
+)
+
+# packet boundary (32), remainder classes (mod4 / &16), scan edges
+LENGTHS = (0, 1, 2, 3, 4, 5, 15, 16, 17, 31, 32, 33, 63, 64, 100,
+           255, 256, 1000, 4096)
+
+
+def _rand(n, l, seed):
+    return np.random.default_rng(seed).integers(
+        0, 256, size=(n, l), dtype=np.uint8)
+
+
+# ------------------------------------------------------ numpy oracle
+class TestOracle:
+    def test_matches_c_streaming_all_shapes(self):
+        """Every length class × batch width vs the C one-shot hash."""
+        for li, l in enumerate(LENGTHS):
+            for n in (1, 3, 7):
+                blocks = _rand(n, l, 1000 * li + n)
+                got = hh_device.hh256_batch_np(blocks)
+                assert got.shape == (n, 32)
+                for i in range(n):
+                    assert bytes(got[i]) == host.hh256(
+                        blocks[i].tobytes()), (n, l, i)
+
+    def test_matches_c_batch_entrypoint(self):
+        blocks = _rand(6, 2048, 7)
+        np.testing.assert_array_equal(
+            hh_device.hh256_batch_np(blocks), host.hh256_batch(blocks))
+
+    def test_reference_selftest_extends_to_batched(self):
+        """The reference bitrot self-test (cmd/bitrot.go:214) driven
+        through the batched oracle: build msg from successive sums with
+        the magic key, expect the same golden final sum test_host.py
+        pins for the streaming C implementation."""
+        size, block = 32, 32
+        msg = b""
+        sum_ = b""
+        for _ in range(0, size * block, size):
+            row = np.frombuffer(msg, dtype=np.uint8).reshape(1, -1)
+            sum_ = bytes(hh_device.hh256_batch_np(row)[0])
+            msg += sum_
+        assert sum_.hex() == (
+            "39c0407ed3f01b18d22c85db4aeff11e060ca5f43131b0126731ca197cd42313")
+
+    def test_custom_key_and_empty_batch(self):
+        key = bytes(range(32))
+        blocks = _rand(2, 100, 11)
+        got = hh_device.hh256_batch_np(blocks, key)
+        for i in range(2):
+            assert bytes(got[i]) == host.hh256(blocks[i].tobytes(), key)
+        assert hh_device.hh256_batch_np(
+            np.empty((0, 64), dtype=np.uint8)).shape == (0, 32)
+
+
+# ------------------------------------------------------ JAX kernels
+class TestJaxKernel:
+    def test_one_shape_matches_oracle(self):
+        """ONE thin tier-1 shape so the device lane never regresses
+        silently; the broad sweep is `slow` (per-shape XLA compile)."""
+        jax = pytest.importorskip("jax")
+        blocks = _rand(3, 100, 21)
+        np.testing.assert_array_equal(
+            hh_device.hh256_jax(blocks), hh_device.hh256_batch_np(blocks))
+
+    @pytest.mark.slow
+    def test_shape_sweep_matches_oracle(self):
+        jax = pytest.importorskip("jax")
+        for n, l in ((1, 0), (1, 1), (2, 17), (3, 32), (2, 255),
+                     (4, 1000), (2, 8192)):
+            blocks = _rand(n, l, 31 * n + l)
+            np.testing.assert_array_equal(
+                hh_device.hh256_jax(blocks),
+                hh_device.hh256_batch_np(blocks), err_msg=str((n, l)))
+
+    @pytest.mark.slow
+    def test_fused_encode_hash_parity_and_hashes(self):
+        """The one-launch program: parity == host codec, hashes ==
+        streaming hh256 of every data AND parity row."""
+        jax = pytest.importorskip("jax")
+        k, m, b, s = 4, 2, 3, 1024
+        batch = np.random.default_rng(41).integers(
+            0, 256, size=(b, k, s), dtype=np.uint8)
+        parity, hashes = hh_device.fused_encode_hash(k, m)(batch)
+        parity, hashes = np.asarray(parity), np.asarray(hashes)
+        np.testing.assert_array_equal(
+            parity, host.HostRSCodec(k, m).encode(batch))
+        assert hashes.shape == (b, k + m, 32)
+        rows = np.concatenate([batch, parity], axis=1)
+        for bi in range(b):
+            for si in range(k + m):
+                assert bytes(hashes[bi, si]) == host.hh256(
+                    rows[bi, si].tobytes()), (bi, si)
+
+
+# ------------------------------------------------------ MD5 etag fold
+class TestMd5Fold:
+    @pytest.mark.slow
+    def test_matches_hashlib_across_padding_classes(self):
+        jax = pytest.importorskip("jax")
+        rng = np.random.default_rng(51)
+        for l in (0, 1, 55, 56, 57, 63, 64, 65, 1000, 100_000):
+            data = rng.integers(0, 256, size=l, dtype=np.uint8).tobytes()
+            f = hh_device.Md5Fold()
+            # odd split sizes exercise the tail-carry re-assembly
+            for off in range(0, l, 977):
+                f.update(data[off:off + 977])
+            if l == 0:
+                f.update(b"")
+            assert f.hexdigest() == hashlib.md5(data).hexdigest(), l
+            assert f.digest() == hashlib.md5(data).digest()
+
+    def test_availability_gate(self, monkeypatch):
+        monkeypatch.setenv("MINIO_TPU_FUSED_ETAG", "0")
+        assert not hh_device.fused_etag_available()
+        monkeypatch.setenv("MINIO_TPU_FUSED_HASH", "0")
+        monkeypatch.setenv("MINIO_TPU_FUSED_ETAG", "1")
+        assert not hh_device.fused_etag_available()  # fused gate off
+        monkeypatch.setenv("MINIO_TPU_FUSED_HASH", "1")
+        assert hh_device.fused_etag_available()      # explicit opt-in
+
+
+# ------------------------------------------------ writer-side plumbing
+class TestWriteFramesPrecomputed:
+    def _frames(self, blocks, hashes=None):
+        buf = io.BytesIO()
+        w = bitrot.BitrotWriter(buf, shard_size=blocks.shape[1])
+        w.write_frames(blocks, hashes=hashes) if hashes is not None \
+            else w.write_frames(blocks)
+        return buf.getvalue()
+
+    def test_precomputed_hashes_byte_identical(self):
+        blocks = _rand(4, 512, 61)
+        hashes = host.hh256_batch(blocks)
+        assert self._frames(blocks, hashes) == self._frames(blocks)
+
+    def test_bad_hash_shape_rejected(self):
+        blocks = _rand(2, 128, 62)
+        buf = io.BytesIO()
+        w = bitrot.BitrotWriter(buf, shard_size=128)
+        with pytest.raises(errors.InvalidArgument):
+            w.write_frames(blocks, hashes=np.zeros((2, 16), np.uint8))
+        with pytest.raises(errors.InvalidArgument):
+            w.write_frames(blocks, hashes=np.zeros((3, 32), np.uint8))
+        assert buf.getvalue() == b""  # nothing partial hit the file
+
+    def test_non_highway_algo_ignores_hashes(self):
+        blocks = _rand(2, 128, 63)
+        buf1, buf2 = io.BytesIO(), io.BytesIO()
+        w1 = bitrot.BitrotWriter(buf1, 128, algo="sha256")
+        w2 = bitrot.BitrotWriter(buf2, 128, algo="sha256")
+        w1.write_frames(blocks, hashes=np.zeros((2, 32), np.uint8))
+        w2.write_frames(blocks)
+        assert buf1.getvalue() == buf2.getvalue()
+
+    def test_precomputed_roundtrip_verifies(self):
+        """Frames written with fused hashes read back through the
+        verifying reader."""
+        blocks = _rand(3, 256, 64)
+        hashes = host.hh256_batch(blocks)
+        buf = io.BytesIO()
+        w = bitrot.BitrotWriter(buf, shard_size=256)
+        w.write_frames(blocks, hashes=hashes)
+        r = bitrot.BitrotReader(io.BytesIO(buf.getvalue()),
+                                till_offset=3 * 256, shard_size=256)
+        got = r.read_blocks(0, 3, 256)
+        np.testing.assert_array_equal(got, blocks)

@@ -1119,3 +1119,47 @@ class TestByteCost:
             p.release("bucket:t")
 
         asyncio.run(drill())
+
+
+# --------------------------------------------------------- metric surface
+class TestHotLaneShedMetric:
+    """PR 13 carried leftover, closed here: per-tenant hot-lane cap
+    refusals (hotLaneCapped) surface on the Prometheus scrape as a
+    reason="hot_lane" row of the EXISTING minio_qos_shed_total family —
+    no new metric name, and no qos family at all while the plane is
+    off (the MINIO_TPU_QOS=0 differential elsewhere pins the byte
+    identity; this pins the rendering itself)."""
+
+    def _render(self, qos):
+        from minio_tpu.server.metrics import MetricsMixin
+
+        class _Reg:
+            def render(self):
+                return ""
+
+        # every other block in _render_metrics is try/except- or
+        # getattr-guarded, so a registry stub + the qos plane is the
+        # whole surface this regression needs
+        srv = types.SimpleNamespace(metrics=_Reg(), api=None, qos=qos)
+        return MetricsMixin._render_metrics(srv)
+
+    def test_hot_lane_capped_renders_as_shed_reason(self):
+        p = QosPlane(2)  # hot_capacity 8, uniform per-tenant cap 4
+        grants = 0
+        while p.hot_lane_try("bucket:flood"):
+            grants += 1
+            assert grants <= 8, "cap never enforced"
+        text = self._render(p)
+        assert ('minio_qos_shed_total{tenant="bucket:flood",'
+                'reason="hot_lane"} 1') in text
+        # sibling reasons stay rendered for the same tenant (one
+        # family, three reasons — dashboards key on the label)
+        assert ('minio_qos_shed_total{tenant="bucket:flood",'
+                'reason="queue_full"} 0') in text
+        assert ('minio_qos_shed_total{tenant="bucket:flood",'
+                'reason="deadline"} 0') in text
+        for _ in range(grants):
+            p.hot_lane_release("bucket:flood")
+
+    def test_plane_off_renders_no_qos_rows(self):
+        assert "minio_qos" not in self._render(None)
